@@ -1,0 +1,175 @@
+// libtpudra — native L0 layer of the TPU DRA driver.
+//
+// The reference driver's native surface is cgo/NVML plus raw syscalls:
+// mknod of IMEX channel device nodes (cmd/compute-domain-kubelet-plugin/
+// nvlib.go:317-376), /proc/devices parsing (nvlib.go:274-315) and recursive
+// unmounts (nvlib.go:378-420).  This library is the TPU build's equivalent,
+// exposed to Python over a C ABI (ctypes; see tpu_dra/tpulib/native.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <algorithm>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/mount.h>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Create a character device node.  Idempotence (right rdev already present)
+// is handled by the Python caller; here an existing path is an error unless
+// it already matches.  Returns 0 or -errno.
+int tpudra_mknod_char(const char* path, int major_no, int minor_no) {
+  struct stat st;
+  if (::stat(path, &st) == 0) {
+    if (S_ISCHR(st.st_mode) && major(st.st_rdev) == (unsigned)major_no &&
+        minor(st.st_rdev) == (unsigned)minor_no) {
+      return 0;
+    }
+    if (::unlink(path) != 0) return -errno;
+  }
+  if (::mknod(path, S_IFCHR | 0666, makedev(major_no, minor_no)) != 0) {
+    return -errno;
+  }
+  return 0;
+}
+
+// Parse a /proc/devices-format file for a char-device major by driver name.
+// Returns the major number, or -1 when absent / unreadable.
+int tpudra_device_major(const char* proc_devices, const char* name) {
+  FILE* f = ::fopen(proc_devices, "re");
+  if (f == nullptr) return -1;
+  char line[256];
+  bool in_char = false;
+  int result = -1;
+  while (::fgets(line, sizeof(line), f) != nullptr) {
+    if (::strncmp(line, "Character devices:", 18) == 0) {
+      in_char = true;
+      continue;
+    }
+    if (::strncmp(line, "Block devices:", 14) == 0) {
+      in_char = false;
+      continue;
+    }
+    if (!in_char) continue;
+    int major_no = -1;
+    char devname[128];
+    if (::sscanf(line, "%d %127s", &major_no, devname) == 2 &&
+        ::strcmp(devname, name) == 0) {
+      result = major_no;
+      break;
+    }
+  }
+  ::fclose(f);
+  return result;
+}
+
+// Unmount every mount at or under `path`, deepest-first.  Returns the number
+// of unmounted entries, or -errno on a read failure of the mount table.
+int tpudra_unmount_recursive(const char* path) {
+  FILE* f = ::fopen("/proc/self/mounts", "re");
+  if (f == nullptr) return -errno;
+  std::string prefix(path);
+  while (!prefix.empty() && prefix.back() == '/') prefix.pop_back();
+  std::vector<std::string> targets;
+  char line[4096];
+  while (::fgets(line, sizeof(line), f) != nullptr) {
+    char dev[1024], mnt[1024];
+    if (::sscanf(line, "%1023s %1023s", dev, mnt) != 2) continue;
+    std::string m(mnt);
+    if (m == prefix ||
+        (m.size() > prefix.size() && m.compare(0, prefix.size(), prefix) == 0 &&
+         m[prefix.size()] == '/')) {
+      targets.push_back(std::move(m));
+    }
+  }
+  ::fclose(f);
+  std::sort(targets.begin(), targets.end(),
+            [](const std::string& a, const std::string& b) {
+              return a.size() > b.size();
+            });
+  int count = 0;
+  for (const auto& t : targets) {
+    if (::umount2(t.c_str(), MNT_DETACH) == 0) ++count;
+  }
+  return count;
+}
+
+// Scan a /dev directory for accelN char devices; fills out_minors (sorted)
+// up to cap entries.  Returns the count found (which may exceed cap).
+int tpudra_scan_accel_devices(const char* dev_dir, int* out_minors, int cap) {
+  DIR* d = ::opendir(dev_dir);
+  if (d == nullptr) return 0;
+  std::vector<int> minors;
+  struct dirent* ent;
+  while ((ent = ::readdir(d)) != nullptr) {
+    int n = -1;
+    if (::sscanf(ent->d_name, "accel%d", &n) == 1 && n >= 0) {
+      minors.push_back(n);
+    }
+  }
+  ::closedir(d);
+  std::sort(minors.begin(), minors.end());
+  for (int i = 0; i < (int)minors.size() && i < cap; ++i) {
+    out_minors[i] = minors[i];
+  }
+  return (int)minors.size();
+}
+
+// CRC32-C (Castagnoli), table-driven — checkpoint checksums
+// (tpu_dra/plugins/*/checkpoint.py; reference uses kubelet's
+// checkpointmanager checksum, gpu checkpoint.go:39-47).
+static uint32_t g_crc_table[8][256];
+static bool g_crc_init = false;
+
+static void crc_init() {
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j) {
+      crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+    }
+    g_crc_table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = g_crc_table[0][i];
+    for (int s = 1; s < 8; ++s) {
+      crc = g_crc_table[0][crc & 0xFF] ^ (crc >> 8);
+      g_crc_table[s][i] = crc;
+    }
+  }
+  g_crc_init = true;
+}
+
+uint32_t tpudra_crc32c(const uint8_t* data, size_t len) {
+  if (!g_crc_init) crc_init();
+  uint32_t crc = 0xFFFFFFFFu;
+  // slice-by-8
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    ::memcpy(&lo, data, 4);
+    ::memcpy(&hi, data + 4, 4);
+    lo ^= crc;
+    crc = g_crc_table[7][lo & 0xFF] ^ g_crc_table[6][(lo >> 8) & 0xFF] ^
+          g_crc_table[5][(lo >> 16) & 0xFF] ^ g_crc_table[4][lo >> 24] ^
+          g_crc_table[3][hi & 0xFF] ^ g_crc_table[2][(hi >> 8) & 0xFF] ^
+          g_crc_table[1][(hi >> 16) & 0xFF] ^ g_crc_table[0][hi >> 24];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) {
+    crc = g_crc_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  }
+  return crc ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

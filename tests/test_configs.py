@@ -1,0 +1,197 @@
+"""Opaque-config tests.
+
+Table-driven after reference
+``api/nvidia.com/resource/v1beta1/sharing_test.go:28-160`` (MPS pinned-memory
+normalization → here MultiProcess hbmLimitPerProcess normalization), plus
+strict-decoder behavior (api.go:47-75).
+"""
+
+import pytest
+
+from tpu_dra.api import (
+    SliceChannelConfig,
+    SliceDaemonConfig,
+    TpuConfig,
+    TpuSubSliceConfig,
+    decode,
+    parse_quantity,
+)
+from tpu_dra.api.configs import (
+    GROUP_VERSION,
+    ConfigError,
+    SHARING_STRATEGY_EXCLUSIVE,
+    SHARING_STRATEGY_MULTI_PROCESS,
+    TpuMultiProcessConfig,
+)
+
+UUID_A = "tpu-aaaaaaaa-aaaa-aaaa-aaaa-aaaaaaaaaaaa"
+UUID_B = "tpu-bbbbbbbb-bbbb-bbbb-bbbb-bbbbbbbbbbbb"
+
+
+# --- quantity ---------------------------------------------------------------
+
+@pytest.mark.parametrize("raw,expected", [
+    ("0", 0),
+    ("1024", 1024),
+    ("1Ki", 1024),
+    ("16Gi", 16 * 2**30),
+    ("1.5Gi", int(1.5 * 2**30)),
+    ("2G", 2 * 10**9),
+    (8192, 8192),
+])
+def test_parse_quantity_ok(raw, expected):
+    assert parse_quantity(raw) == expected
+
+
+@pytest.mark.parametrize("raw", ["", "Gi", "1X", "-5", "1.2.3Gi", True])
+def test_parse_quantity_rejects(raw):
+    with pytest.raises(ValueError):
+        parse_quantity(raw)
+
+
+# --- MultiProcess limit normalization (sharing_test.go analog) --------------
+
+def normalize(limits, uuids=(UUID_A, UUID_B), indices=None):
+    mp = TpuMultiProcessConfig(hbm_limit_per_process=limits)
+    return mp.normalized_limits(
+        list(uuids), indices if indices is not None
+        else {UUID_A: 0, UUID_B: 1})
+
+
+def test_wildcard_applies_to_all_devices():
+    out = normalize({"*": "4Gi"})
+    assert out == {UUID_A: 4 * 2**30, UUID_B: 4 * 2**30}
+
+
+def test_index_key_overrides_wildcard():
+    out = normalize({"*": "4Gi", "1": "2Gi"})
+    assert out == {UUID_A: 4 * 2**30, UUID_B: 2 * 2**30}
+
+
+def test_uuid_key_selects_device():
+    out = normalize({UUID_A: "1Gi"})
+    assert out == {UUID_A: 2**30}
+
+
+def test_index_not_allocated_is_error():
+    with pytest.raises(ConfigError, match="index 7"):
+        normalize({"7": "1Gi"})
+
+
+def test_unknown_uuid_is_error():
+    with pytest.raises(ConfigError, match="neither"):
+        normalize({"tpu-cccccccc-cccc-cccc-cccc-cccccccccccc": "1Gi"})
+
+
+def test_bad_quantity_is_error():
+    cfg = TpuConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess",
+                    "multiProcess": {"hbmLimitPerProcess": {"*": "banana"}}},
+    })
+    with pytest.raises(ConfigError, match="banana"):
+        cfg.validate()
+
+
+# --- TpuConfig normalize/validate -------------------------------------------
+
+def test_normalize_defaults_to_exclusive():
+    cfg = TpuConfig().normalize()
+    assert cfg.sharing.strategy == SHARING_STRATEGY_EXCLUSIVE
+    cfg.validate()
+
+
+def test_normalize_multiprocess_fills_subconfig():
+    cfg = TpuConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess"},
+    }).normalize()
+    assert cfg.sharing.multi_process is not None
+    cfg.validate()
+
+
+def test_exclusive_with_multiprocess_block_rejected():
+    cfg = TpuConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "Exclusive", "multiProcess": {}},
+    })
+    with pytest.raises(ConfigError, match="Exclusive"):
+        cfg.validate()
+
+
+def test_max_processes_bounds():
+    cfg = TpuConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess",
+                    "multiProcess": {"maxProcesses": 65}},
+    })
+    with pytest.raises(ConfigError, match="maxProcesses"):
+        cfg.validate()
+
+
+def test_unknown_sharing_strategy_rejected():
+    cfg = TpuConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "TimeSlicing"},
+    })
+    with pytest.raises(ConfigError, match="TimeSlicing"):
+        cfg.validate()
+
+
+# --- sub-slice config -------------------------------------------------------
+
+def test_subslice_profiles():
+    cfg = TpuSubSliceConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuSubSliceConfig",
+        "profile": "1c"}).normalize()
+    cfg.validate()
+    bad = TpuSubSliceConfig.from_dict({
+        "apiVersion": GROUP_VERSION, "kind": "TpuSubSliceConfig",
+        "profile": "9c"})
+    with pytest.raises(ConfigError, match="profile"):
+        bad.validate()
+
+
+# --- slice-domain configs ---------------------------------------------------
+
+@pytest.mark.parametrize("cls", [SliceChannelConfig, SliceDaemonConfig])
+def test_domain_configs_require_domain_id(cls):
+    cfg = cls.from_dict({"apiVersion": GROUP_VERSION, "kind": cls.KIND})
+    with pytest.raises(ConfigError, match="domainID"):
+        cfg.validate()
+    ok = cls.from_dict({"apiVersion": GROUP_VERSION, "kind": cls.KIND,
+                        "domainID": "uid-1"})
+    ok.validate()
+    assert ok.to_dict()["domainID"] == "uid-1"
+
+
+# --- strict decoder ---------------------------------------------------------
+
+def test_decode_round_trips():
+    cfg = decode({"apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+                  "sharing": {"strategy": "MultiProcess",
+                              "multiProcess": {"maxProcesses": 4}}})
+    assert isinstance(cfg, TpuConfig)
+    assert cfg.sharing.multi_process.max_processes == 4
+
+
+def test_decode_rejects_unknown_kind():
+    with pytest.raises(ConfigError, match="unknown config kind"):
+        decode({"apiVersion": GROUP_VERSION, "kind": "GpuConfig"})
+
+
+def test_decode_rejects_wrong_group():
+    with pytest.raises(ConfigError, match="apiVersion"):
+        decode({"apiVersion": "resource.nvidia.com/v1beta1",
+                "kind": "TpuConfig"})
+
+
+def test_decode_rejects_unknown_fields():
+    with pytest.raises(ConfigError, match="unknown field"):
+        decode({"apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+                "shmSize": "1Gi"})
+
+
+def test_decode_rejects_malformed_json():
+    with pytest.raises(ConfigError, match="malformed"):
+        decode(b"{not json")

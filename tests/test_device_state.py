@@ -1,0 +1,292 @@
+"""DeviceState tests — the Prepare/Unprepare hot path
+(reference device_state.go:128-351) against FakeTpuLib."""
+
+import json
+import os
+
+import pytest
+
+from tpu_dra.api.configs import GROUP_VERSION, ConfigError
+from tpu_dra.plugins.tpu.device_state import (
+    DeviceState,
+    DeviceStateConfig,
+    PrepareError,
+)
+from tpu_dra.tpulib import FakeTpuLib
+from tpu_dra.version import DRIVER_NAME
+
+UID = "claim-uid-1"
+
+
+def make_state(tmp_path, *, family="v5e", chips=4, subslices=True, **kw):
+    lib = FakeTpuLib(family_name=family,
+                     accelerator_type={"v5e": "v5litepod-16",
+                                       "v4": "v4-16"}[family],
+                     topology={"v5e": "4x4", "v4": "2x2x2"}[family],
+                     chips_on_node=chips, **kw)
+    cfg = DeviceStateConfig(
+        tpulib=lib,
+        plugin_dir=str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        enable_subslices=subslices)
+    return DeviceState(cfg)
+
+
+def make_claim(devices=("tpu-0",), uid=UID, configs=None, requests=None):
+    results = []
+    for i, dev in enumerate(devices):
+        results.append({
+            "request": (requests[i] if requests else f"req{i}"),
+            "driver": DRIVER_NAME,
+            "pool": "node-a",
+            "device": dev,
+        })
+    claim = {
+        "metadata": {"uid": uid, "namespace": "default", "name": "c"},
+        "status": {"allocation": {"devices": {"results": results}}},
+    }
+    if configs:
+        claim["status"]["allocation"]["devices"]["config"] = configs
+    return claim
+
+
+def opaque(params, source="FromClaim", requests=()):
+    return {"source": source, "requests": list(requests),
+            "opaque": {"driver": DRIVER_NAME, "parameters": params}}
+
+
+def test_prepare_returns_cdi_ids_and_checkpoints(tmp_path):
+    state = make_state(tmp_path)
+    devices = state.prepare(make_claim())
+    assert len(devices) == 1
+    dev = devices[0]
+    assert dev.cdi_device_ids == [
+        "google.com/tpu=tpu-0",
+        f"k8s.tpu.google.com/claim={UID}-tpu-0",
+    ]
+    # claim spec file written with visible-chips env
+    spec_path = state.cdi.claim_spec_path(UID)
+    spec = json.load(open(spec_path))
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert "TPU_VISIBLE_CHIPS=0" in env
+    # checkpoint survives a restart (crash recovery, device_state.go:141-146)
+    state2 = DeviceState(state.cfg)
+    assert UID in state2.prepared_claims()
+
+
+def test_prepare_is_idempotent(tmp_path):
+    state = make_state(tmp_path)
+    first = state.prepare(make_claim())
+    second = state.prepare(make_claim())
+    assert [d.to_dict() for d in first] == [d.to_dict() for d in second]
+
+
+def test_unprepare_removes_state_and_is_idempotent(tmp_path):
+    state = make_state(tmp_path)
+    state.prepare(make_claim())
+    state.unprepare(UID)
+    assert UID not in state.prepared_claims()
+    assert not os.path.exists(state.cdi.claim_spec_path(UID))
+    state.unprepare(UID)  # absent ⇒ no-op (device_state.go:181-189)
+
+
+def test_prepare_unknown_device_fails(tmp_path):
+    state = make_state(tmp_path)
+    with pytest.raises(PrepareError, match="not on this node"):
+        state.prepare(make_claim(devices=("tpu-99",)))
+
+
+def test_prepare_without_allocation_fails(tmp_path):
+    state = make_state(tmp_path)
+    with pytest.raises(PrepareError, match="no allocation"):
+        state.prepare({"metadata": {"uid": "x"}, "status": {}})
+
+
+def test_foreign_driver_results_ignored(tmp_path):
+    state = make_state(tmp_path)
+    claim = make_claim()
+    claim["status"]["allocation"]["devices"]["results"].append(
+        {"request": "other", "driver": "gpu.nvidia.com", "device": "gpu-0"})
+    devices = state.prepare(claim)
+    assert [d.canonical_name for d in devices] == ["tpu-0"]
+
+
+def test_multiprocess_config_emits_sharing_env(tmp_path):
+    state = make_state(tmp_path)
+    claim = make_claim(configs=[opaque({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess",
+                    "multiProcess": {"maxProcesses": 4,
+                                     "hbmLimitPerProcess": {"*": "4Gi"}}},
+    })])
+    state.prepare(claim)
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    env = dict(e.split("=", 1) for e in
+               spec["devices"][0]["containerEdits"]["env"])
+    assert env["TPU_ALLOW_MULTIPLE_LIBTPU_LOAD"] == "1"
+    assert env["TPU_MULTIPROCESS_MAX"] == "4"
+    assert env["TPU_HBM_LIMIT_BYTES_0"] == str(4 * 2**30)
+
+
+def test_claim_config_overrides_class_config(tmp_path):
+    """Precedence: claim > class (device_state.go:442-495)."""
+    state = make_state(tmp_path)
+    claim = make_claim(configs=[
+        opaque({"apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+                "sharing": {"strategy": "MultiProcess"}},
+               source="FromClass"),
+        opaque({"apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+                "sharing": {"strategy": "Exclusive"}},
+               source="FromClaim"),
+    ])
+    state.prepare(claim)
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert not any(e.startswith("TPU_ALLOW_MULTIPLE_LIBTPU_LOAD")
+                   for e in env)
+
+
+def test_config_scoped_to_request(tmp_path):
+    state = make_state(tmp_path)
+    claim = make_claim(devices=("tpu-0", "tpu-1"),
+                       requests=["shared", "exclusive"],
+                       configs=[opaque({
+                           "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+                           "sharing": {"strategy": "MultiProcess"}},
+                           requests=["shared"])])
+    state.prepare(claim)
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    by_name = {d["name"]: d["containerEdits"].get("env", [])
+               for d in spec["devices"]}
+    assert any("TPU_ALLOW_MULTIPLE_LIBTPU_LOAD=1" in e
+               for e in by_name[f"{UID}-tpu-0"])
+    assert not any("TPU_ALLOW_MULTIPLE_LIBTPU_LOAD=1" in e
+                   for e in by_name[f"{UID}-tpu-1"])
+
+
+def test_invalid_config_rejected(tmp_path):
+    state = make_state(tmp_path)
+    claim = make_claim(configs=[opaque({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "Bogus"}})])
+    with pytest.raises(ConfigError):
+        state.prepare(claim)
+
+
+# --- sub-slice (MIG analog) -------------------------------------------------
+
+def test_core_devices_allocatable_on_v4(tmp_path):
+    state = make_state(tmp_path, family="v4")
+    assert "tpu-0-core-0" in state.allocatable
+    assert "tpu-0-core-1" in state.allocatable
+    claim = make_claim(devices=("tpu-0-core-0",), configs=[opaque({
+        "apiVersion": GROUP_VERSION, "kind": "TpuSubSliceConfig",
+        "profile": "1c"})])
+    devices = state.prepare(claim)
+    assert devices[0].type == "core"
+    assert devices[0].parent_uuid
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    env = dict(e.split("=", 1) for e in
+               spec["devices"][0]["containerEdits"]["env"])
+    assert env["TPU_VISIBLE_CORES"] == "0:0"
+
+
+def test_subslice_config_on_full_chip_rejected(tmp_path):
+    state = make_state(tmp_path, family="v4")
+    claim = make_claim(devices=("tpu-0",), configs=[opaque({
+        "apiVersion": GROUP_VERSION, "kind": "TpuSubSliceConfig"})])
+    with pytest.raises(ConfigError, match="sub-chip cores"):
+        state.prepare(claim)
+
+
+def test_chip_core_overlap_rejected(tmp_path):
+    """Node-side overlap enforcement (memorySlice model,
+    deviceinfo.go:187-192)."""
+    state = make_state(tmp_path, family="v4")
+    state.prepare(make_claim(devices=("tpu-0-core-0",), uid="core-claim",
+                             configs=[opaque({
+                                 "apiVersion": GROUP_VERSION,
+                                 "kind": "TpuSubSliceConfig"})]))
+    with pytest.raises(PrepareError, match="sub-slice cores"):
+        state.prepare(make_claim(devices=("tpu-0",), uid="chip-claim"))
+    # and the reverse direction
+    state.unprepare("core-claim")
+    state.prepare(make_claim(devices=("tpu-0",), uid="chip-claim"))
+    with pytest.raises(PrepareError, match="full chip"):
+        state.prepare(make_claim(devices=("tpu-0-core-1",), uid="c2",
+                                 configs=[opaque({
+                                     "apiVersion": GROUP_VERSION,
+                                     "kind": "TpuSubSliceConfig"})]))
+
+
+def test_fabric_id_env_present_on_multihost(tmp_path):
+    state = make_state(tmp_path)
+    state.prepare(make_claim())
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    env = dict(e.split("=", 1) for e in
+               spec["devices"][0]["containerEdits"]["env"])
+    assert env["TPU_FABRIC_ID"].endswith(".0")
+
+
+def test_base_spec_written_at_startup(tmp_path):
+    state = make_state(tmp_path)
+    spec = json.load(open(state.cdi.base_spec_path()))
+    assert spec["kind"] == "google.com/tpu"
+    names = [d["name"] for d in spec["devices"]]
+    assert "tpu-0" in names and "tpu-3" in names
+    assert any("TPU_DRA_MANAGED=1" in e
+               for e in spec["containerEdits"]["env"])
+
+
+def test_chip_and_own_core_in_same_claim_rejected(tmp_path):
+    """Intra-claim overlap: a claim holding tpu-0 and tpu-0-core-1 must
+    fail prepare (review regression)."""
+    state = make_state(tmp_path, family="v4")
+    claim = make_claim(devices=("tpu-0", "tpu-0-core-1"),
+                       requests=["chip", "core"],
+                       configs=[opaque({
+                           "apiVersion": GROUP_VERSION,
+                           "kind": "TpuSubSliceConfig"},
+                           requests=["core"])])
+    with pytest.raises(PrepareError, match="full chip"):
+        state.prepare(claim)
+    assert UID not in state.prepared_claims()
+
+
+def test_duplicate_device_in_claim_rejected(tmp_path):
+    state = make_state(tmp_path)
+    claim = make_claim(devices=("tpu-0", "tpu-0"), requests=["a", "b"])
+    with pytest.raises(PrepareError, match="twice"):
+        state.prepare(claim)
+
+
+def test_orphaned_claim_spec_cleaned_on_startup(tmp_path):
+    """Crash between create_claim_spec and checkpoint.put leaves an orphan
+    that the next startup must reconcile away (review regression)."""
+    state = make_state(tmp_path)
+    state.cdi.create_claim_spec("orphan-uid", {})
+    assert "orphan-uid" in state.cdi.list_claim_specs()
+    state2 = DeviceState(state.cfg)
+    assert "orphan-uid" not in state2.cdi.list_claim_specs()
+
+
+def test_core_devices_in_base_cdi_spec(tmp_path):
+    """Cores get standard CDI IDs, so the base spec must define them with
+    the parent chip's device nodes (review regression)."""
+    state = make_state(tmp_path, family="v4")
+    spec = json.load(open(state.cdi.base_spec_path()))
+    by_name = {d["name"]: d for d in spec["devices"]}
+    assert "tpu-0-core-0" in by_name
+    nodes = by_name["tpu-0-core-0"]["containerEdits"]["deviceNodes"]
+    assert nodes[0]["path"] == "/dev/accel0"
+
+
+def test_missing_claim_spec_regenerated_on_idempotent_prepare(tmp_path):
+    """Reboot wipes /var/run/cdi but not the checkpoint; a re-prepare must
+    regenerate the claim spec (review regression)."""
+    state = make_state(tmp_path)
+    state.prepare(make_claim())
+    os.remove(state.cdi.claim_spec_path(UID))
+    devices = state.prepare(make_claim())
+    assert devices[0].canonical_name == "tpu-0"
+    assert os.path.exists(state.cdi.claim_spec_path(UID))

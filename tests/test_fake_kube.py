@@ -1,0 +1,162 @@
+"""FakeKube API-machinery semantics the controllers rely on."""
+
+import threading
+
+import pytest
+
+from tpu_dra.k8s import (
+    Conflict,
+    FakeKube,
+    NODES,
+    NotFound,
+    PODS,
+    TPU_SLICE_DOMAINS,
+)
+
+
+def make_pod(name, ns="default", labels=None, node=None):
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": name, "namespace": ns,
+                        "labels": labels or {}},
+           "spec": {}}
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+def test_create_assigns_metadata():
+    k = FakeKube()
+    obj = k.create(PODS, make_pod("a"))
+    assert obj["metadata"]["uid"]
+    assert obj["metadata"]["resourceVersion"]
+    assert obj["metadata"]["creationTimestamp"]
+
+
+def test_create_duplicate_conflicts():
+    k = FakeKube()
+    k.create(PODS, make_pod("a"))
+    with pytest.raises(Conflict):
+        k.create(PODS, make_pod("a"))
+
+
+def test_generate_name():
+    k = FakeKube()
+    obj = k.create(PODS, {"metadata": {"generateName": "pfx-",
+                                       "namespace": "default"}})
+    assert obj["metadata"]["name"].startswith("pfx-")
+
+
+def test_update_conflict_on_stale_rv():
+    k = FakeKube()
+    created = k.create(PODS, make_pod("a"))
+    fresh = k.get(PODS, "a", "default")
+    fresh["spec"]["x"] = 1
+    k.update(PODS, fresh)
+    created["spec"]["x"] = 2  # stale resourceVersion
+    with pytest.raises(Conflict):
+        k.update(PODS, created)
+
+
+def test_update_does_not_touch_status():
+    k = FakeKube()
+    k.create(PODS, make_pod("a"))
+    obj = k.get(PODS, "a", "default")
+    obj["status"] = {"phase": "Running"}
+    k.update_status(PODS, obj)
+    obj = k.get(PODS, "a", "default")
+    obj["spec"]["y"] = 1
+    obj["status"] = {"phase": "Bogus"}
+    updated = k.update(PODS, obj)
+    assert updated["status"]["phase"] == "Running"
+
+
+def test_label_and_field_selectors():
+    k = FakeKube()
+    k.create(PODS, make_pod("a", labels={"app": "x"}, node="n1"))
+    k.create(PODS, make_pod("b", labels={"app": "y"}, node="n2"))
+    assert [p["metadata"]["name"] for p in
+            k.list(PODS, label_selector={"app": "x"})["items"]] == ["a"]
+    assert [p["metadata"]["name"] for p in
+            k.list(PODS, field_selector="spec.nodeName=n2")["items"]] == ["b"]
+    assert [p["metadata"]["name"] for p in
+            k.list(PODS, field_selector="metadata.name=a")["items"]] == ["a"]
+
+
+def test_finalizer_blocks_deletion():
+    """The teardown flow depends on deletionTimestamp-then-remove semantics
+    (reference computedomain.go:234-268)."""
+    k = FakeKube()
+    k.create(NODES, {"metadata": {"name": "cd",
+                                  "finalizers": ["resource.tpu.google.com/f"]}})
+    k.delete(NODES, "cd")
+    obj = k.get(NODES, "cd")
+    assert obj["metadata"]["deletionTimestamp"]
+    # clearing finalizers on a deleting object removes it
+    obj["metadata"]["finalizers"] = []
+    k.update(NODES, obj)
+    with pytest.raises(NotFound):
+        k.get(NODES, "cd")
+
+
+def test_spec_immutability_for_slice_domain():
+    k = FakeKube()
+    k.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "d", "namespace": "default"},
+        "spec": {"numNodes": 4}})
+    obj = k.get(TPU_SLICE_DOMAINS, "d", "default")
+    obj["spec"]["numNodes"] = 8
+    with pytest.raises(Conflict):
+        k.update(TPU_SLICE_DOMAINS, obj)
+
+
+def test_merge_patch():
+    k = FakeKube()
+    k.create(NODES, {"metadata": {"name": "n1",
+                                  "labels": {"a": "1", "b": "2"}}})
+    k.patch(NODES, "n1", {"metadata": {"labels": {"b": None, "c": "3"}}})
+    obj = k.get(NODES, "n1")
+    assert obj["metadata"]["labels"] == {"a": "1", "c": "3"}
+
+
+def test_watch_sees_events_and_replays():
+    k = FakeKube()
+    first = k.create(PODS, make_pod("a"))
+    stop = threading.Event()
+    events = []
+
+    def consume():
+        for ev, obj in k.watch(
+                PODS, namespace="default",
+                resource_version=first["metadata"]["resourceVersion"],
+                stop=stop):
+            events.append((ev, obj["metadata"]["name"]))
+            if len(events) >= 3:
+                stop.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    k.create(PODS, make_pod("b"))
+    obj = k.get(PODS, "b", "default")
+    obj["spec"]["x"] = 1
+    k.update(PODS, obj)
+    k.delete(PODS, "b", "default")
+    t.join(timeout=5)
+    assert events == [("ADDED", "b"), ("MODIFIED", "b"), ("DELETED", "b")]
+
+
+def test_watch_label_scoped():
+    k = FakeKube()
+    stop = threading.Event()
+    events = []
+
+    def consume():
+        for ev, obj in k.watch(PODS, label_selector={"app": "x"}, stop=stop):
+            events.append(obj["metadata"]["name"])
+            stop.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    k.create(PODS, make_pod("skip", labels={"app": "other"}))
+    k.create(PODS, make_pod("hit", labels={"app": "x"}))
+    t.join(timeout=5)
+    assert events == ["hit"]

@@ -1,0 +1,54 @@
+"""Flock tests — reference pkg/flock semantics (flock.go:27-133)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra.util.flock import Flock, FlockTimeout, locked
+
+
+def test_acquire_release(tmp_path):
+    path = str(tmp_path / "pu.lock")
+    lk = Flock(path)
+    lk.acquire()
+    assert lk.held
+    lk.release()
+    assert not lk.held
+
+
+def test_contention_times_out(tmp_path):
+    path = str(tmp_path / "pu.lock")
+    with locked(path):
+        other = Flock(path, timeout=0.15, poll_interval=0.01)
+        t0 = time.monotonic()
+        with pytest.raises(FlockTimeout):
+            other.acquire()
+        assert time.monotonic() - t0 >= 0.15
+
+
+def test_contention_succeeds_after_release(tmp_path):
+    path = str(tmp_path / "pu.lock")
+    first = Flock(path)
+    first.acquire()
+    acquired = threading.Event()
+
+    def contender():
+        with locked(path, timeout=2.0):
+            acquired.set()
+
+    t = threading.Thread(target=contender)
+    t.start()
+    time.sleep(0.05)
+    assert not acquired.is_set()
+    first.release()
+    t.join(timeout=2)
+    assert acquired.is_set()
+
+
+def test_reacquire_same_object_rejected(tmp_path):
+    lk = Flock(str(tmp_path / "pu.lock"))
+    lk.acquire()
+    with pytest.raises(RuntimeError):
+        lk.acquire()
+    lk.release()

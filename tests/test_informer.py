@@ -1,0 +1,117 @@
+"""Informer/store/indexer tests (client-go analog, reference indexers.go)."""
+
+import time
+
+from tpu_dra.k8s import FakeKube, Informer, PODS, TPU_SLICE_DOMAINS
+from tpu_dra.k8s.informer import Store, label_index, uid_index
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def make_pod(name, labels=None):
+    return {"metadata": {"name": name, "namespace": "default",
+                         "labels": labels or {}}, "spec": {}}
+
+
+def test_informer_syncs_and_tracks_events():
+    k = FakeKube()
+    k.create(PODS, make_pod("pre"))
+    inf = Informer(k, PODS, namespace="default").start()
+    assert inf.wait_for_sync()
+    assert inf.store.get("default", "pre") is not None
+
+    adds, updates, deletes = [], [], []
+    inf.add_event_handler(
+        on_add=lambda o: adds.append(o["metadata"]["name"]),
+        on_update=lambda old, new: updates.append(new["metadata"]["name"]),
+        on_delete=lambda o: deletes.append(o["metadata"]["name"]))
+
+    k.create(PODS, make_pod("a"))
+    assert wait_until(lambda: "a" in adds)
+    obj = k.get(PODS, "a", "default")
+    obj["spec"]["x"] = 1
+    k.update(PODS, obj)
+    assert wait_until(lambda: "a" in updates)
+    k.delete(PODS, "a", "default")
+    assert wait_until(lambda: "a" in deletes)
+    inf.stop()
+
+
+def test_uid_index():
+    k = FakeKube()
+    created = k.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "d", "namespace": "default"},
+        "spec": {"numNodes": 2}})
+    inf = Informer(k, TPU_SLICE_DOMAINS, indexers={"uid": uid_index}).start()
+    assert inf.wait_for_sync()
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: inf.store.by_index("uid", uid))
+    assert inf.store.by_index("uid", uid)[0]["metadata"]["name"] == "d"
+    inf.stop()
+
+
+def test_label_index_and_scoped_informer():
+    k = FakeKube()
+    label = "resource.tpu.google.com/sliceDomain"
+    inf = Informer(k, PODS, label_selector={label: "uid-1"},
+                   indexers={"domain": label_index(label)}).start()
+    assert inf.wait_for_sync()
+    k.create(PODS, make_pod("in", labels={label: "uid-1"}))
+    k.create(PODS, make_pod("out", labels={label: "uid-2"}))
+    assert wait_until(lambda: inf.store.get("default", "in") is not None)
+    time.sleep(0.05)
+    assert inf.store.get("default", "out") is None
+    assert [o["metadata"]["name"]
+            for o in inf.store.by_index("domain", "uid-1")] == ["in"]
+    inf.stop()
+
+
+def test_mutation_cache_read_your_writes():
+    """MutationCache analog (reference daemonset.go:94-99)."""
+    store = Store()
+    store.add_or_update({"metadata": {"name": "x", "namespace": "ns",
+                                      "resourceVersion": "1"},
+                         "spec": {"v": 1}})
+    written = {"metadata": {"name": "x", "namespace": "ns",
+                            "resourceVersion": "2"}, "spec": {"v": 2}}
+    store.mutate(written)
+    assert store.get("ns", "x")["spec"]["v"] == 2
+    # watch catches up with the same RV -> mutation entry dropped
+    store.add_or_update(written)
+    assert store.get("ns", "x")["spec"]["v"] == 2
+    # an older event must not resurrect stale data over a newer mutation
+    store.mutate({"metadata": {"name": "x", "namespace": "ns",
+                               "resourceVersion": "3"}, "spec": {"v": 3}})
+    store.add_or_update(written)  # rv 2 < 3: mutation kept
+    assert store.get("ns", "x")["spec"]["v"] == 3
+
+
+def test_relist_dispatches_missed_deletes():
+    """Objects deleted during a watch gap still get a delete event on
+    relist (review regression)."""
+    k = FakeKube()
+    k.create(PODS, make_pod("doomed"))
+    inf = Informer(k, PODS, namespace="default").start()
+    assert inf.wait_for_sync()
+    deletes = []
+    inf.add_event_handler(
+        on_delete=lambda o: deletes.append(o["metadata"]["name"]))
+    # simulate a watch gap: stop the informer loop, delete server-side,
+    # then restart the loop (forces a fresh list)
+    inf.stop()
+    k.close_watchers()
+    time.sleep(0.1)
+    k.delete(PODS, "doomed", "default")
+    inf._stop.clear()
+    import threading as _t
+    _t.Thread(target=inf._run, daemon=True).start()
+    assert wait_until(lambda: "doomed" in deletes)
+    assert inf.store.get("default", "doomed") is None
+    inf.stop()

@@ -1,0 +1,164 @@
+"""End-to-end kubelet-plugin test: real gRPC over unix sockets, FakeKube as
+the API server, FakeTpuLib as the hardware — the full SURVEY §3.1/§3.2 path
+short of a real kubelet."""
+
+import grpc
+import pytest
+
+from tpu_dra.k8s import FakeKube, RESOURCE_CLAIMS, RESOURCE_SLICES
+from tpu_dra.kubeletplugin.proto import (
+    dra_v1beta1_pb2 as dra_pb,
+    pluginregistration_pb2 as reg_pb,
+)
+from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+from tpu_dra.tpulib import FakeTpuLib
+from tpu_dra.version import DRIVER_NAME
+
+
+@pytest.fixture
+def driver(tmp_path):
+    kube = FakeKube()
+    drv = TpuDriver(TpuDriverConfig(
+        node_name="node-a",
+        tpulib=FakeTpuLib(),
+        kube=kube,
+        plugins_dir=str(tmp_path / "plugins"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=2.0))
+    drv.start()
+    yield drv, kube
+    drv.stop()
+
+
+def rpc(socket, method, request, response_cls):
+    with grpc.insecure_channel(f"unix:{socket}") as channel:
+        fn = channel.unary_unary(
+            method,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_cls.FromString)
+        return fn(request, timeout=5)
+
+
+def make_claim(kube, uid="uid-c1", devices=("tpu-0",)):
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "claim1", "namespace": "default", "uid": uid},
+        "spec": {},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME, "pool": "node-a",
+             "device": d} for d in devices]}}},
+    }
+    # FakeKube.create assigns its own uid; force ours afterwards.
+    kube.create(RESOURCE_CLAIMS, claim)
+    stored = kube.get(RESOURCE_CLAIMS, "claim1", "default")
+    stored["metadata"]["uid"] = uid
+    kube.update(RESOURCE_CLAIMS, stored)
+    return stored
+
+
+def test_registration_service(driver):
+    drv, _ = driver
+    info = rpc(drv.server.reg_socket,
+               "/pluginregistration.Registration/GetInfo",
+               reg_pb.InfoRequest(), reg_pb.PluginInfo)
+    assert info.name == DRIVER_NAME
+    assert info.type == "DRAPlugin"
+    assert info.endpoint == drv.server.dra_socket
+    assert "v1beta1" in info.supported_versions
+    rpc(drv.server.reg_socket,
+        "/pluginregistration.Registration/NotifyRegistrationStatus",
+        reg_pb.RegistrationStatus(plugin_registered=True),
+        reg_pb.RegistrationStatusResponse)
+    assert drv.server.registration.registered.is_set()
+
+
+def test_resource_slice_published(driver):
+    drv, kube = driver
+    slices = kube.list(RESOURCE_SLICES)["items"]
+    assert len(slices) == 1
+    spec = slices[0]["spec"]
+    assert spec["driver"] == DRIVER_NAME
+    assert spec["nodeName"] == "node-a"
+    assert spec["pool"]["name"] == "node-a"
+    names = [d["name"] for d in spec["devices"]]
+    assert names == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    attrs = spec["devices"][0]["basic"]["attributes"]
+    assert attrs["family"]["string"] == "v5e"
+    assert attrs["fabricID"]["string"].endswith(".0")
+    assert spec["devices"][0]["basic"]["capacity"]["hbm"]["value"] == "16Gi"
+    # republish bumps the pool generation
+    drv.publish_resources()
+    slices = kube.list(RESOURCE_SLICES)["items"]
+    assert slices[0]["spec"]["pool"]["generation"] == 2
+
+
+def test_prepare_unprepare_over_grpc(driver):
+    drv, kube = driver
+    make_claim(kube)
+    req = dra_pb.NodePrepareResourcesRequest(claims=[
+        dra_pb.Claim(namespace="default", uid="uid-c1", name="claim1")])
+    resp = rpc(drv.server.dra_socket,
+               "/v1beta1.DRAPlugin/NodePrepareResources",
+               req, dra_pb.NodePrepareResourcesResponse)
+    result = resp.claims["uid-c1"]
+    assert result.error == ""
+    assert len(result.devices) == 1
+    assert result.devices[0].device_name == "tpu-0"
+    assert result.devices[0].pool_name == "node-a"
+    assert list(result.devices[0].cdi_device_ids) == [
+        "google.com/tpu=tpu-0",
+        "k8s.tpu.google.com/claim=uid-c1-tpu-0"]
+    assert "uid-c1" in drv.state.prepared_claims()
+
+    unreq = dra_pb.NodeUnprepareResourcesRequest(claims=[
+        dra_pb.Claim(namespace="default", uid="uid-c1", name="claim1")])
+    unresp = rpc(drv.server.dra_socket,
+                 "/v1beta1.DRAPlugin/NodeUnprepareResources",
+                 unreq, dra_pb.NodeUnprepareResourcesResponse)
+    assert unresp.claims["uid-c1"].error == ""
+    assert "uid-c1" not in drv.state.prepared_claims()
+
+
+def test_prepare_missing_claim_reports_error(driver):
+    drv, _ = driver
+    req = dra_pb.NodePrepareResourcesRequest(claims=[
+        dra_pb.Claim(namespace="default", uid="ghost", name="missing")])
+    resp = rpc(drv.server.dra_socket,
+               "/v1beta1.DRAPlugin/NodePrepareResources",
+               req, dra_pb.NodePrepareResourcesResponse)
+    assert "not found" in resp.claims["ghost"].error
+
+
+def test_prepare_uid_mismatch_reports_error(driver):
+    drv, kube = driver
+    make_claim(kube, uid="uid-real")
+    req = dra_pb.NodePrepareResourcesRequest(claims=[
+        dra_pb.Claim(namespace="default", uid="uid-stale", name="claim1")])
+    resp = rpc(drv.server.dra_socket,
+               "/v1beta1.DRAPlugin/NodePrepareResources",
+               req, dra_pb.NodePrepareResourcesResponse)
+    assert "UID mismatch" in resp.claims["uid-stale"].error
+
+
+def test_pool_generation_monotonic_across_restart(tmp_path):
+    """pool.generation must not regress when the driver restarts
+    (review regression)."""
+    kube = FakeKube()
+    cfg = TpuDriverConfig(
+        node_name="node-a", tpulib=FakeTpuLib(), kube=kube,
+        plugins_dir=str(tmp_path / "p"), registry_dir=str(tmp_path / "r"),
+        cdi_root=str(tmp_path / "cdi"))
+    drv = TpuDriver(cfg)
+    drv.start()
+    drv.publish_resources()
+    drv.publish_resources()
+    gen = kube.list(RESOURCE_SLICES)["items"][0]["spec"]["pool"]["generation"]
+    assert gen == 3
+    drv.stop()
+    drv2 = TpuDriver(cfg)   # fresh process: in-memory counter resets
+    drv2.start()
+    gen2 = kube.list(RESOURCE_SLICES)["items"][0]["spec"]["pool"]["generation"]
+    assert gen2 == 4
+    drv2.stop()

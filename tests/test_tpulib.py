@@ -1,0 +1,186 @@
+"""tpulib discovery/topology/native tests (nvlib.go analog surface)."""
+
+import os
+
+import pytest
+
+from tpu_dra.tpulib import (
+    FakeTpuLib,
+    RealTpuLib,
+    chip_coords,
+    parse_topology,
+)
+from tpu_dra.tpulib import native
+from tpu_dra.tpulib.discovery import parse_tpu_env_blob
+from tpu_dra.tpulib.topology import family_for_accelerator_type
+
+
+# --- topology ---------------------------------------------------------------
+
+@pytest.mark.parametrize("s,expected", [
+    ("4x4", (4, 4)),
+    ("2x2x2", (2, 2, 2)),
+    ("1x1", (1, 1)),
+    ("8X8", (8, 8)),
+])
+def test_parse_topology(s, expected):
+    assert parse_topology(s) == expected
+
+
+@pytest.mark.parametrize("s", ["", "4x", "axb", "0x4", "-1x2"])
+def test_parse_topology_rejects(s):
+    with pytest.raises(ValueError):
+        parse_topology(s)
+
+
+def test_chip_coords_row_major():
+    shape = (2, 2, 2)
+    assert chip_coords(0, shape) == (0, 0, 0)
+    assert chip_coords(1, shape) == (0, 0, 1)
+    assert chip_coords(2, shape) == (0, 1, 0)
+    assert chip_coords(7, shape) == (1, 1, 1)
+
+
+@pytest.mark.parametrize("atype,family", [
+    ("v5litepod-16", "v5e"),
+    ("v4-8", "v4"),
+    ("v5p-128", "v5p"),
+    ("v6e-16", "v6e"),
+])
+def test_family_mapping(atype, family):
+    assert family_for_accelerator_type(atype).name == family
+
+
+def test_unknown_family_rejected():
+    with pytest.raises(ValueError):
+        family_for_accelerator_type("h100-80gb")
+
+
+# --- fake lib ---------------------------------------------------------------
+
+def test_fake_enumeration_shape():
+    lib = FakeTpuLib(worker=1)
+    chips = lib.enumerate_chips()
+    assert len(chips) == 4
+    assert chips[0].global_index == 4       # worker 1 × 4 chips/host
+    assert chips[0].coords == (1, 0)        # row-major in a 4x4 mesh
+    assert chips[0].family.cores_per_chip == 1
+    assert lib.fabric_id().endswith(".0")
+
+
+def test_fake_cores_split_hbm():
+    lib = FakeTpuLib(family_name="v4", accelerator_type="v4-8",
+                     topology="2x2x1", chips_on_node=4,
+                     hostnames=["only-one"])
+    chip = lib.enumerate_chips()[0]
+    cores = chip.cores()
+    assert len(cores) == 2
+    assert cores[0].hbm_bytes == chip.family.hbm_bytes // 2
+    assert cores[0].uuid == f"{chip.uuid}-core-0"
+    assert lib.fabric_id() == ""  # single host → not multi-host capable
+
+
+# --- real lib against a synthetic driver root -------------------------------
+
+def make_driver_root(tmp_path, n_chips=4, tpu_env=""):
+    (tmp_path / "dev").mkdir()
+    for i in range(n_chips):
+        (tmp_path / "dev" / f"accel{i}").touch()
+    (tmp_path / "etc").mkdir()
+    (tmp_path / "etc" / "machine-id").write_text("abc123\n")
+    if tpu_env:
+        d = tmp_path / "var" / "lib" / "tpu"
+        d.mkdir(parents=True)
+        (d / "tpu-env").write_text(tpu_env)
+    return str(tmp_path)
+
+
+TPU_ENV_BLOB = """\
+ACCELERATOR_TYPE: 'v5litepod-16'
+TPU_ACCELERATOR_TYPE: 'v5litepod-16'
+TPU_TOPOLOGY: '4x4'
+TPU_WORKER_ID: '2'
+TPU_WORKER_HOSTNAMES: 'w0.local,w1.local,w2.local,w3.local'
+"""
+
+
+def test_parse_tpu_env_blob():
+    meta = parse_tpu_env_blob(TPU_ENV_BLOB)
+    assert meta["TPU_TOPOLOGY"] == "4x4"
+    assert meta["TPU_WORKER_ID"] == "2"
+
+
+def test_real_lib_discovers_chips(tmp_path):
+    root = make_driver_root(tmp_path, n_chips=4, tpu_env=TPU_ENV_BLOB)
+    lib = RealTpuLib(driver_root=root, env={})
+    chips = lib.enumerate_chips()
+    assert len(chips) == 4
+    assert chips[0].accelerator_type == "v5litepod-16"
+    assert chips[0].worker_id == 2
+    assert chips[0].global_index == 8
+    assert chips[0].device_paths == ["/dev/accel0"]
+    assert chips[0].uuid != chips[1].uuid
+    assert lib.worker_hostnames() == ["w0.local", "w1.local", "w2.local",
+                                      "w3.local"]
+    assert lib.fabric_id().endswith(".0")
+
+
+def test_real_lib_env_overrides_metadata(tmp_path):
+    root = make_driver_root(tmp_path, n_chips=1, tpu_env=TPU_ENV_BLOB)
+    lib = RealTpuLib(driver_root=root,
+                     env={"TPU_WORKER_ID": "0", "TPU_TOPOLOGY": "1x1",
+                          "TPU_WORKER_HOSTNAMES": ""})
+    chips = lib.enumerate_chips()
+    assert chips[0].worker_id == 0
+    assert lib.fabric_id() == ""
+
+
+def test_real_lib_defaults_without_metadata(tmp_path):
+    root = make_driver_root(tmp_path, n_chips=2)
+    lib = RealTpuLib(driver_root=root, env={})
+    chips = lib.enumerate_chips()
+    assert len(chips) == 2
+    assert chips[0].topology == "2x1"
+    assert chips[0].family.name == "v5e"
+
+
+def test_visible_chips_env(tmp_path):
+    lib = FakeTpuLib()
+    chips = lib.enumerate_chips()[:2]
+    env = lib.visible_chips_env(chips)
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+# --- native layer -----------------------------------------------------------
+
+def test_crc32c_python_fallback_matches_native():
+    data = b"The quick brown fox jumps over the lazy dog" * 7
+    # force the pure-python path
+    poly_crc = native.crc32c.__wrapped__(data) if hasattr(
+        native.crc32c, "__wrapped__") else None
+    native_val = native.crc32c(data)
+    # known vector regardless of implementation
+    assert native.crc32c(b"123456789") == 0xE3069283
+    if poly_crc is not None:
+        assert poly_crc == native_val
+
+
+def test_device_major_parses(tmp_path):
+    p = tmp_path / "devices"
+    p.write_text("Character devices:\n  1 mem\n 10 misc\n245 accel\n\n"
+                 "Block devices:\n  8 sd\n")
+    assert native.device_major("accel", str(p)) == 245
+    assert native.device_major("mem", str(p)) == 1
+    assert native.device_major("sd", str(p)) == -1      # block, not char
+    assert native.device_major("nvidia", str(p)) == -1
+
+
+def test_mknod_rejected_for_unprivileged_or_creates(tmp_path):
+    # In a privileged container mknod succeeds; unprivileged gets EPERM.
+    path = str(tmp_path / "channels" / "channel0")
+    try:
+        native.mknod_char(path, 1, 3)  # /dev/null's major/minor
+    except OSError:
+        pytest.skip("mknod not permitted in this environment")
+    assert os.path.exists(path)
+    native.mknod_char(path, 1, 3)  # idempotent

@@ -1,0 +1,104 @@
+"""WorkQueue tests — modeled on reference pkg/workqueue/workqueue_test.go:29-87
+plus the slice-plugin retry-deadline semantics (CD driver.go:37-57)."""
+
+import threading
+import time
+
+from tpu_dra.util.workqueue import (
+    ItemExponentialBackoff,
+    PermanentError,
+    RetryDeadlineExceeded,
+    WorkQueue,
+)
+
+
+def make_queue():
+    q = WorkQueue(backoff=ItemExponentialBackoff(base=0.002, cap=0.02))
+    q.run_in_background()
+    return q
+
+
+def test_enqueue_runs_callback():
+    q = make_queue()
+    got = []
+    q.enqueue(lambda obj: got.append(obj), {"a": 1})
+    assert q.drain(2)
+    assert got == [{"a": 1}]
+    q.shutdown()
+
+
+def test_enqueue_deep_copies():
+    """Mutating the object after Enqueue must not affect the worker
+    (reference workqueue.go:46-59)."""
+    q = make_queue()
+    obj = {"a": 1}
+    seen = []
+    block = threading.Event()
+    q.enqueue(lambda o: (block.wait(1), seen.append(o)), obj)
+    obj["a"] = 999
+    block.set()
+    assert q.drain(2)
+    assert seen == [{"a": 1}]
+    q.shutdown()
+
+
+def test_failed_callback_retried_until_success():
+    q = make_queue()
+    attempts = []
+
+    def flaky(obj):
+        attempts.append(obj)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+
+    q.enqueue(flaky, "x", key="k")
+    assert q.drain(5)
+    assert len(attempts) == 3
+    q.shutdown()
+
+
+def test_permanent_error_short_circuits():
+    q = make_queue()
+    attempts = []
+    errors = []
+
+    def always_permanent(obj):
+        attempts.append(obj)
+        raise PermanentError("nope")
+
+    q._push  # noqa: B018 — keep linters quiet about attribute presence
+    q.enqueue_with_deadline(always_permanent, "x", timeout=5.0,
+                            on_error=errors.append)
+    assert q.drain(2)
+    assert len(attempts) == 1
+    assert isinstance(errors[0], PermanentError)
+    q.shutdown()
+
+
+def test_retry_deadline_exceeded():
+    q = make_queue()
+    errors = []
+    n = []
+
+    def always_fails(obj):
+        n.append(1)
+        raise RuntimeError("still not ready")
+
+    q.enqueue_with_deadline(always_fails, "x", timeout=0.05,
+                            on_error=errors.append)
+    deadline = time.monotonic() + 3
+    while not errors and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(errors) == 1
+    assert isinstance(errors[0], RetryDeadlineExceeded)
+    assert len(n) >= 1
+    q.shutdown()
+
+
+def test_backoff_grows_and_forgets():
+    b = ItemExponentialBackoff(base=0.01, cap=1.0)
+    assert b.when("k") == 0.01
+    assert b.when("k") == 0.02
+    assert b.when("k") == 0.04
+    b.forget("k")
+    assert b.when("k") == 0.01

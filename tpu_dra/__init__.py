@@ -1,0 +1,26 @@
+"""tpu-dra-driver: a TPU-native Kubernetes Dynamic Resource Allocation driver.
+
+A from-scratch re-design, for Google TPUs, of the capabilities of NVIDIA's
+``k8s-dra-driver-gpu`` (reference layout mapped in SURVEY.md):
+
+- ``tpu_dra.plugins.tpu``    — node-local chip allocation (full chips, sub-chip
+  partitions, multi-process sharing), the analog of ``cmd/gpu-kubelet-plugin``.
+- ``tpu_dra.controller``     — cluster-level ``TpuSliceDomain`` reconciler, the
+  analog of ``cmd/compute-domain-controller``.
+- ``tpu_dra.plugins.slice``  — slice-domain kubelet plugin, the analog of
+  ``cmd/compute-domain-kubelet-plugin``.
+- ``tpu_dra.daemon``         — per-node slice coordination daemon (JAX
+  ``jax.distributed`` rendezvous), the analog of ``cmd/compute-domain-daemon``
+  which supervises ``nvidia-imex``.
+- ``tpu_dra.api``            — CRD + opaque-config types (analog of
+  ``api/nvidia.com/resource/v1beta1``).
+- ``tpu_dra.k8s``            — minimal from-scratch Kubernetes machinery
+  (REST client, informers, listers, fake clientset) standing in for the
+  generated ``pkg/nvidia.com`` clientset and ``client-go``.
+- ``tpu_dra.tpulib``         — TPU chip/topology discovery, the analog of the
+  NVML/go-nvlib ``deviceLib`` (reference ``cmd/gpu-kubelet-plugin/nvlib.go``).
+- ``tpu_dra.workloads``      — the JAX/XLA workload surface (ICI collectives
+  benchmark, SPMD demo train step) standing in for the nvbandwidth demos.
+"""
+
+from tpu_dra.version import VERSION as __version__  # noqa: F401

@@ -1,0 +1,28 @@
+"""``resource.tpu.google.com/v1beta1`` — the driver's importable API surface.
+
+Analog of reference ``api/nvidia.com/resource/v1beta1`` (api.go:26-75): the
+``TpuSliceDomain`` CRD type, four opaque-config kinds (``TpuConfig``,
+``TpuSubSliceConfig``, ``SliceChannelConfig``, ``SliceDaemonConfig``), a
+strict decoder registry, and the common ``Normalize()/Validate()`` interface.
+"""
+
+from tpu_dra.api.configs import (  # noqa: F401
+    SliceChannelConfig,
+    SliceDaemonConfig,
+    TpuConfig,
+    TpuMultiProcessConfig,
+    TpuSharing,
+    TpuSubSliceConfig,
+    SHARING_STRATEGY_EXCLUSIVE,
+    SHARING_STRATEGY_MULTI_PROCESS,
+)
+from tpu_dra.api.decoder import decode, decode_all, register, registered_kinds  # noqa: F401
+from tpu_dra.api.quantity import parse_quantity  # noqa: F401
+from tpu_dra.api.types import (  # noqa: F401
+    TpuSliceDomain,
+    TpuSliceDomainNode,
+    TpuSliceDomainSpec,
+    TpuSliceDomainStatus,
+    STATUS_READY,
+    STATUS_NOT_READY,
+)

@@ -1,0 +1,304 @@
+"""Opaque DRA device-config kinds.
+
+Analog of reference ``api/nvidia.com/resource/v1beta1``:
+
+- ``TpuConfig``           ↔ ``GpuConfig`` (gpuconfig.go:29-74) — full-chip
+  allocation with a sharing policy.
+- ``TpuSubSliceConfig``   ↔ ``MigDeviceConfig`` (migconfig.go:27-63) — sub-chip
+  (per-TensorCore) allocation.
+- ``SliceChannelConfig``  ↔ ``ComputeDomainChannelConfig`` and
+- ``SliceDaemonConfig``   ↔ ``ComputeDomainDaemonConfig``
+  (computedomainconfig.go:28-85) — slice-domain membership handles.
+
+Sharing is the TPU-honest mapping of TimeSlicing/MPS (api sharing.go:28-89):
+
+- ``Exclusive`` — default; one process owns the chip (TPU default behavior).
+- ``MultiProcess`` — several processes share one chip via libtpu multi-process
+  mechanics (``TPU_ALLOW_MULTIPLE_LIBTPU_LOAD`` + per-process HBM fraction
+  env), the analog of MPS with ``activeThreadPercentage`` + pinned-memory
+  limits.  ``hbm_limit_per_process`` supports the same per-device override map
+  the reference's MPS pinned-memory limit does (sharing.go:190-273): keys are
+  ``"*"`` (all devices), a chip index (``"0"``), or a chip UUID.
+
+There is deliberately no TimeSlicing strategy: TPUs have no nvidia-smi
+time-slice knob, and pretending otherwise would be dishonest (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_dra.api.quantity import parse_quantity
+from tpu_dra.version import API_GROUP, API_VERSION
+
+GROUP_VERSION = f"{API_GROUP}/{API_VERSION}"
+
+SHARING_STRATEGY_EXCLUSIVE = "Exclusive"
+SHARING_STRATEGY_MULTI_PROCESS = "MultiProcess"
+
+_UUID_RE = re.compile(r"^tpu-[0-9a-f]{8}(-[0-9a-f]{4}){3}-[0-9a-f]{12}$")
+_INDEX_RE = re.compile(r"^[0-9]+$")
+
+# Sub-slice profiles (the MIG-profile analog).  v4/v5p chips expose two
+# TensorCores, v5e/v6e one megacore; "1c" = one core with an even HBM split.
+SUBSLICE_PROFILES = ("1c", "2c")
+
+
+class ConfigError(ValueError):
+    """Validation failure for an opaque config (reference validate.go:23-94)."""
+
+
+def _check_unknown(data: dict, allowed: set[str], ctx: str) -> None:
+    """Strict decoding: unknown fields are fatal (reference api.go:47-75 uses
+    a strict JSON decoder)."""
+    unknown = set(data) - allowed
+    if unknown:
+        raise ConfigError(f"{ctx}: unknown field(s) {sorted(unknown)}")
+
+
+@dataclass
+class TpuMultiProcessConfig:
+    """MultiProcess sharing knobs — analog of MpsConfig (sharing.go:63-89)."""
+
+    max_processes: Optional[int] = None
+    # "*" | "<chip index>" | "<chip uuid>" -> quantity string
+    hbm_limit_per_process: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, data: dict, ctx: str = "multiProcess"):
+        _check_unknown(data, {"maxProcesses", "hbmLimitPerProcess"}, ctx)
+        limits = data.get("hbmLimitPerProcess") or {}
+        if not isinstance(limits, dict):
+            raise ConfigError(f"{ctx}.hbmLimitPerProcess: expected a map")
+        return cls(
+            max_processes=data.get("maxProcesses"),
+            hbm_limit_per_process={str(k): str(v) for k, v in limits.items()},
+        )
+
+    def to_dict(self) -> dict:
+        out: dict = {}
+        if self.max_processes is not None:
+            out["maxProcesses"] = self.max_processes
+        if self.hbm_limit_per_process:
+            out["hbmLimitPerProcess"] = dict(self.hbm_limit_per_process)
+        return out
+
+    def normalized_limits(
+        self, uuids: list[str], indices: dict[str, int],
+    ) -> dict[str, int]:
+        """Resolve the per-device limit map to ``{uuid: bytes}``.
+
+        Mirrors the reference's pinned-memory normalization
+        (sharing.go:190-273, tested by sharing_test.go:28-160): ``"*"`` seeds
+        every allocated device; an index key must reference an allocated
+        device's index; a UUID key must be an allocated device.  Specific keys
+        override the wildcard.
+        """
+        out: dict[str, int] = {}
+        wildcard = self.hbm_limit_per_process.get("*")
+        if wildcard is not None:
+            limit = parse_quantity(wildcard)
+            for u in uuids:
+                out[u] = limit
+        index_to_uuid = {v: k for k, v in indices.items()}
+        for key, value in self.hbm_limit_per_process.items():
+            if key == "*":
+                continue
+            if _INDEX_RE.match(key):
+                idx = int(key)
+                if idx not in index_to_uuid:
+                    raise ConfigError(
+                        f"hbmLimitPerProcess: index {idx} not among "
+                        f"allocated devices {sorted(index_to_uuid)}")
+                out[index_to_uuid[idx]] = parse_quantity(value)
+            elif key in uuids:
+                out[key] = parse_quantity(value)
+            else:
+                raise ConfigError(
+                    f"hbmLimitPerProcess: key {key!r} is neither '*', an "
+                    f"allocated chip index, nor an allocated chip UUID")
+        return out
+
+
+@dataclass
+class TpuSharing:
+    """Sharing policy — analog of GpuSharing (sharing.go:28-39)."""
+
+    strategy: str = SHARING_STRATEGY_EXCLUSIVE
+    multi_process: Optional[TpuMultiProcessConfig] = None
+
+    @classmethod
+    def from_dict(cls, data: dict, ctx: str = "sharing"):
+        _check_unknown(data, {"strategy", "multiProcess"}, ctx)
+        mp = data.get("multiProcess")
+        return cls(
+            strategy=data.get("strategy", SHARING_STRATEGY_EXCLUSIVE),
+            multi_process=(TpuMultiProcessConfig.from_dict(mp)
+                           if mp is not None else None),
+        )
+
+    def to_dict(self) -> dict:
+        out: dict = {"strategy": self.strategy}
+        if self.multi_process is not None:
+            out["multiProcess"] = self.multi_process.to_dict()
+        return out
+
+    def is_multi_process(self) -> bool:
+        return self.strategy == SHARING_STRATEGY_MULTI_PROCESS
+
+    def validate(self) -> None:
+        if self.strategy not in (SHARING_STRATEGY_EXCLUSIVE,
+                                 SHARING_STRATEGY_MULTI_PROCESS):
+            raise ConfigError(f"unknown sharing strategy {self.strategy!r}")
+        if self.strategy == SHARING_STRATEGY_EXCLUSIVE and self.multi_process:
+            raise ConfigError(
+                "sharing.multiProcess set but strategy is Exclusive")
+        if self.multi_process:
+            mp = self.multi_process
+            if mp.max_processes is not None and not (
+                    1 <= mp.max_processes <= 64):
+                raise ConfigError(
+                    f"multiProcess.maxProcesses {mp.max_processes} outside "
+                    f"[1, 64]")
+            for key, val in mp.hbm_limit_per_process.items():
+                if key != "*" and not _INDEX_RE.match(key) and \
+                        not _UUID_RE.match(key):
+                    raise ConfigError(
+                        f"hbmLimitPerProcess key {key!r}: must be '*', a chip "
+                        f"index, or a chip uuid")
+                try:
+                    parse_quantity(val)
+                except ValueError as exc:
+                    raise ConfigError(
+                        f"hbmLimitPerProcess[{key!r}]: {exc}") from exc
+
+
+@dataclass
+class TpuConfig:
+    """Full-chip opaque config — analog of GpuConfig (gpuconfig.go:29-74)."""
+
+    KIND = "TpuConfig"
+
+    sharing: Optional[TpuSharing] = None
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        _check_unknown(data, {"apiVersion", "kind", "sharing"}, cls.KIND)
+        sharing = data.get("sharing")
+        return cls(sharing=TpuSharing.from_dict(sharing) if sharing else None)
+
+    def to_dict(self) -> dict:
+        out = {"apiVersion": GROUP_VERSION, "kind": self.KIND}
+        if self.sharing is not None:
+            out["sharing"] = self.sharing.to_dict()
+        return out
+
+    def normalize(self) -> "TpuConfig":
+        """Fill defaults — analog of GpuConfig.Normalize (gpuconfig.go:44-58)."""
+        if self.sharing is None:
+            self.sharing = TpuSharing()
+        if self.sharing.is_multi_process() and \
+                self.sharing.multi_process is None:
+            self.sharing.multi_process = TpuMultiProcessConfig()
+        return self
+
+    def validate(self) -> None:
+        if self.sharing is not None:
+            self.sharing.validate()
+
+
+@dataclass
+class TpuSubSliceConfig:
+    """Sub-chip (per-core) opaque config — analog of MigDeviceConfig
+    (migconfig.go:27-63).  ``profile`` picks how many TensorCores of the
+    parent chip the claim consumes."""
+
+    KIND = "TpuSubSliceConfig"
+
+    profile: str = "1c"
+    sharing: Optional[TpuSharing] = None
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        _check_unknown(data, {"apiVersion", "kind", "profile", "sharing"},
+                       cls.KIND)
+        sharing = data.get("sharing")
+        return cls(profile=data.get("profile", "1c"),
+                   sharing=TpuSharing.from_dict(sharing) if sharing else None)
+
+    def to_dict(self) -> dict:
+        out = {"apiVersion": GROUP_VERSION, "kind": self.KIND,
+               "profile": self.profile}
+        if self.sharing is not None:
+            out["sharing"] = self.sharing.to_dict()
+        return out
+
+    def normalize(self) -> "TpuSubSliceConfig":
+        if self.sharing is None:
+            self.sharing = TpuSharing()
+        if self.sharing.is_multi_process() and \
+                self.sharing.multi_process is None:
+            self.sharing.multi_process = TpuMultiProcessConfig()
+        return self
+
+    def validate(self) -> None:
+        if self.profile not in SUBSLICE_PROFILES:
+            raise ConfigError(
+                f"unknown sub-slice profile {self.profile!r}; valid: "
+                f"{SUBSLICE_PROFILES}")
+        if self.sharing is not None:
+            self.sharing.validate()
+
+
+@dataclass
+class SliceChannelConfig:
+    """Workload-side slice-domain handle — analog of
+    ComputeDomainChannelConfig (computedomainconfig.go:28-55)."""
+
+    KIND = "SliceChannelConfig"
+
+    domain_id: str = ""
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        _check_unknown(data, {"apiVersion", "kind", "domainID"}, cls.KIND)
+        return cls(domain_id=data.get("domainID", ""))
+
+    def to_dict(self) -> dict:
+        return {"apiVersion": GROUP_VERSION, "kind": self.KIND,
+                "domainID": self.domain_id}
+
+    def normalize(self):
+        return self
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ConfigError(f"{self.KIND}: domainID must be set")
+
+
+@dataclass
+class SliceDaemonConfig:
+    """Daemon-side slice-domain handle — analog of
+    ComputeDomainDaemonConfig (computedomainconfig.go:57-85)."""
+
+    KIND = "SliceDaemonConfig"
+
+    domain_id: str = ""
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        _check_unknown(data, {"apiVersion", "kind", "domainID"}, cls.KIND)
+        return cls(domain_id=data.get("domainID", ""))
+
+    def to_dict(self) -> dict:
+        return {"apiVersion": GROUP_VERSION, "kind": self.KIND,
+                "domainID": self.domain_id}
+
+    def normalize(self):
+        return self
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ConfigError(f"{self.KIND}: domainID must be set")

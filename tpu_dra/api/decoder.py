@@ -1,0 +1,63 @@
+"""Strict decoder registry for opaque configs.
+
+Analog of reference ``api/nvidia.com/resource/v1beta1/api.go:47-75``: a
+runtime.Scheme with all config kinds registered and a strict JSON decoder that
+rejects unknown kinds, wrong groups, and unknown fields.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+from tpu_dra.api.configs import (
+    GROUP_VERSION,
+    ConfigError,
+    SliceChannelConfig,
+    SliceDaemonConfig,
+    TpuConfig,
+    TpuSubSliceConfig,
+)
+
+_REGISTRY: dict[str, Any] = {}
+
+
+def register(cls) -> None:
+    _REGISTRY[cls.KIND] = cls
+
+
+def registered_kinds() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+for _cls in (TpuConfig, TpuSubSliceConfig, SliceChannelConfig,
+             SliceDaemonConfig):
+    register(_cls)
+
+
+def decode(raw: bytes | str | dict):
+    """Decode one opaque config.  Strict: unknown kind/group/fields raise
+    :class:`ConfigError`."""
+    if isinstance(raw, (bytes, str)):
+        try:
+            data = json.loads(raw)
+        except json.JSONDecodeError as exc:
+            raise ConfigError(f"malformed opaque config JSON: {exc}") from exc
+    else:
+        data = raw
+    if not isinstance(data, dict):
+        raise ConfigError(f"opaque config must be an object, got {type(data)}")
+    api_version = data.get("apiVersion", "")
+    if api_version != GROUP_VERSION:
+        raise ConfigError(
+            f"unexpected apiVersion {api_version!r}; want {GROUP_VERSION!r}")
+    kind = data.get("kind", "")
+    cls = _REGISTRY.get(kind)
+    if cls is None:
+        raise ConfigError(
+            f"unknown config kind {kind!r}; registered: {registered_kinds()}")
+    return cls.from_dict(data)
+
+
+def decode_all(raws: Iterable[bytes | str | dict]) -> list:
+    return [decode(r) for r in raws]

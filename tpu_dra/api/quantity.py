@@ -1,0 +1,49 @@
+"""Kubernetes-style resource quantities.
+
+The reference relies on ``k8s.io/apimachinery`` ``resource.Quantity`` for MPS
+pinned-memory limits (api sharing.go:190-273); this is the minimal TPU-side
+equivalent: parse ``"16Gi"``-style strings to bytes and render back.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SUFFIXES = {
+    "": 1,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "Ei": 2**60,
+}
+# "E" the decimal exa suffix conflicts with nothing here; "K" alone is
+# invalid per k8s resource.Quantity grammar (binary suffixes are two-letter).
+
+_RE = re.compile(r"^\s*([0-9]+(?:\.[0-9]+)?)\s*(k|Ki|M|Mi|G|Gi|T|Ti|P|Pi|E|Ei)?\s*$")
+
+
+def parse_quantity(value: str | int | float) -> int:
+    """Parse a quantity to an integer number of bytes/units.
+
+    Raises ``ValueError`` on malformed input (strict, like the reference's
+    ``resource.ParseQuantity`` error path in sharing.go:231-238).
+    """
+    if isinstance(value, bool):
+        raise ValueError(f"invalid quantity: {value!r}")
+    if isinstance(value, (int, float)):
+        if value < 0:
+            raise ValueError(f"negative quantity: {value!r}")
+        return int(value)
+    m = _RE.match(value)
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    number, suffix = m.groups()
+    return int(float(number) * _SUFFIXES[suffix or ""])
+
+
+def format_quantity(n: int) -> str:
+    """Render bytes with the largest exact binary suffix (display helper)."""
+    for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+        unit = _SUFFIXES[suffix]
+        if n >= unit and n % unit == 0:
+            return f"{n // unit}{suffix}"
+    return str(n)

@@ -1,0 +1,163 @@
+"""The ``TpuSliceDomain`` CRD type.
+
+Analog of reference ``api/nvidia.com/resource/v1beta1/computedomain.go:35-86``
+(``ComputeDomain``): a cluster-scoped request for an isolated multi-node ICI
+domain.  ``spec.numNodes`` fixes the member count; ``spec.channel`` names the
+workload-facing ResourceClaimTemplate the controller materializes; ``status``
+carries readiness plus the member-node rendezvous list (the reference uses
+``Status.Nodes`` as the membership bus — daemon computedomain.go:145-220).
+
+Spec is immutable after creation (reference CEL rule computedomain.go:53),
+enforced by the CRD manifest and re-checked server-side by the fake API server
+used in tests.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_dra.version import API_GROUP, API_VERSION
+
+STATUS_READY = "Ready"
+STATUS_NOT_READY = "NotReady"
+
+KIND = "TpuSliceDomain"
+PLURAL = "tpuslicedomains"
+GROUP_VERSION = f"{API_GROUP}/{API_VERSION}"
+
+
+@dataclass
+class TpuSliceDomainChannel:
+    """Names the workload ResourceClaimTemplate (computedomain.go:55-66)."""
+
+    resource_claim_template_name: str = ""
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        rct = data.get("resourceClaimTemplate") or {}
+        return cls(resource_claim_template_name=rct.get("name", ""))
+
+    def to_dict(self) -> dict:
+        return {"resourceClaimTemplate":
+                {"name": self.resource_claim_template_name}}
+
+
+@dataclass
+class TpuSliceDomainSpec:
+    num_nodes: int = 0
+    channel: Optional[TpuSliceDomainChannel] = None
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        ch = data.get("channel")
+        return cls(num_nodes=int(data.get("numNodes", 0)),
+                   channel=TpuSliceDomainChannel.from_dict(ch) if ch else None)
+
+    def to_dict(self) -> dict:
+        out: dict = {"numNodes": self.num_nodes}
+        if self.channel is not None:
+            out["channel"] = self.channel.to_dict()
+        return out
+
+
+@dataclass
+class TpuSliceDomainNode:
+    """One member node's rendezvous record (computedomain.go:76-86).
+
+    ``fabric_id`` is the TPU analog of the reference's cliqueID
+    (``clusterUUID.cliqueId``, CD nvlib.go:164-222): ``<slice-uuid>.<partition>``
+    derived from TPU runtime metadata, identifying the ICI partition the node's
+    chips belong to.  Only nodes sharing a fabric_id are ICI-reachable.
+    """
+
+    name: str = ""
+    ip_address: str = ""
+    fabric_id: str = ""
+    worker_id: int = -1
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        return cls(name=data.get("name", ""),
+                   ip_address=data.get("ipAddress", ""),
+                   fabric_id=data.get("fabricID", ""),
+                   worker_id=int(data.get("workerID", -1)))
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ipAddress": self.ip_address,
+                "fabricID": self.fabric_id, "workerID": self.worker_id}
+
+
+@dataclass
+class TpuSliceDomainStatus:
+    status: str = STATUS_NOT_READY
+    nodes: list[TpuSliceDomainNode] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        return cls(status=data.get("status", STATUS_NOT_READY),
+                   nodes=[TpuSliceDomainNode.from_dict(n)
+                          for n in data.get("nodes") or []])
+
+    def to_dict(self) -> dict:
+        return {"status": self.status,
+                "nodes": [n.to_dict() for n in self.nodes]}
+
+
+@dataclass
+class TpuSliceDomain:
+    """The CRD object.  ``metadata`` keeps the raw dict shape so unknown
+    server-managed fields (managedFields, resourceVersion, …) round-trip."""
+
+    metadata: dict = field(default_factory=dict)
+    spec: TpuSliceDomainSpec = field(default_factory=TpuSliceDomainSpec)
+    status: Optional[TpuSliceDomainStatus] = None
+
+    API_VERSION = GROUP_VERSION
+    KIND = KIND
+    PLURAL = PLURAL
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        return cls(
+            metadata=copy.deepcopy(data.get("metadata") or {}),
+            spec=TpuSliceDomainSpec.from_dict(data.get("spec") or {}),
+            status=(TpuSliceDomainStatus.from_dict(data["status"])
+                    if data.get("status") else None),
+        )
+
+    def to_dict(self) -> dict:
+        out = {
+            "apiVersion": self.API_VERSION,
+            "kind": self.KIND,
+            "metadata": copy.deepcopy(self.metadata),
+            "spec": self.spec.to_dict(),
+        }
+        if self.status is not None:
+            out["status"] = self.status.to_dict()
+        return out
+
+    # -- metadata helpers --------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "")
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    @property
+    def deleting(self) -> bool:
+        return bool(self.metadata.get("deletionTimestamp"))
+
+    @property
+    def finalizers(self) -> list[str]:
+        return self.metadata.setdefault("finalizers", [])
+
+    def deepcopy(self) -> "TpuSliceDomain":
+        return TpuSliceDomain.from_dict(self.to_dict())

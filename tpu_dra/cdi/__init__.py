@@ -1,0 +1,1 @@
+from tpu_dra.cdi.spec import CDIHandler, ContainerEdits  # noqa: F401

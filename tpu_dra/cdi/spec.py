@@ -1,0 +1,193 @@
+"""CDI (Container Device Interface) spec generation.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/cdi.go:36-281`` (there built on
+the nvidia-container-toolkit ``nvcdi`` library; here written directly — the
+CDI spec is plain JSON).  Two spec families, mirroring the reference:
+
+- one **base spec** per node, listing every allocatable device with its
+  device-node edits plus common edits (cdi.go:142-208), written at startup;
+- one **transient per-claim spec** carrying config-derived container edits
+  (sharing env, coordination mounts), written during Prepare and removed at
+  Unprepare (cdi.go:210-265).
+
+Workload containers then reference devices by qualified CDI ID
+(``google.com/tpu=tpu-0`` and ``k8s.tpu.google.com/claim=<uid>-…``), which the
+kubelet hands to containerd via the DRA PrepareResult.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+CDI_VERSION = "0.6.0"
+
+VENDOR = "google.com"
+CLASS = "tpu"
+CLAIM_VENDOR = "k8s.tpu.google.com"
+CLAIM_CLASS = "claim"
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.:-]*$")
+
+
+@dataclass
+class ContainerEdits:
+    """A subset of CDI containerEdits: env, device nodes, mounts."""
+
+    env: dict[str, str] = field(default_factory=dict)
+    device_nodes: list[dict] = field(default_factory=list)
+    mounts: list[dict] = field(default_factory=list)
+
+    def add_device_node(self, path: str, *, host_path: Optional[str] = None,
+                        major: Optional[int] = None,
+                        minor: Optional[int] = None,
+                        permissions: str = "rw") -> None:
+        node: dict = {"path": path, "type": "c", "permissions": permissions}
+        if host_path:
+            node["hostPath"] = host_path
+        if major is not None:
+            node["major"] = major
+        if minor is not None:
+            node["minor"] = minor
+        self.device_nodes.append(node)
+
+    def add_mount(self, host_path: str, container_path: str,
+                  options: Optional[list[str]] = None) -> None:
+        self.mounts.append({
+            "hostPath": host_path,
+            "containerPath": container_path,
+            "options": options or ["ro", "nosuid", "nodev", "bind"],
+        })
+
+    def merge(self, other: "ContainerEdits") -> "ContainerEdits":
+        merged = ContainerEdits(
+            env={**self.env, **other.env},
+            device_nodes=self.device_nodes + other.device_nodes,
+            mounts=self.mounts + other.mounts)
+        return merged
+
+    def to_dict(self) -> dict:
+        out: dict = {}
+        if self.env:
+            out["env"] = [f"{k}={v}" for k, v in sorted(self.env.items())]
+        if self.device_nodes:
+            out["deviceNodes"] = list(self.device_nodes)
+        if self.mounts:
+            out["mounts"] = list(self.mounts)
+        return out
+
+
+def _atomic_write(path: str, data: str) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class CDIHandler:
+    """Writes/removes CDI spec files under ``cdi_root`` (normally
+    ``/var/run/cdi``, flag ``--cdi-root`` — reference cdioptions.go:1-81)."""
+
+    def __init__(self, cdi_root: str, driver_root: str = "/") -> None:
+        self.cdi_root = cdi_root
+        self.driver_root = driver_root.rstrip("/") or "/"
+        os.makedirs(cdi_root, exist_ok=True)
+
+    # -- naming ------------------------------------------------------------
+    def base_spec_path(self) -> str:
+        return os.path.join(self.cdi_root, f"{VENDOR}-{CLASS}.json")
+
+    def claim_spec_path(self, claim_uid: str) -> str:
+        return os.path.join(self.cdi_root,
+                            f"{CLAIM_VENDOR}-{CLAIM_CLASS}_{claim_uid}.json")
+
+    @staticmethod
+    def standard_device_id(canonical_name: str) -> str:
+        """Qualified ID in the base spec — cdi.go:267-274 analog."""
+        return f"{VENDOR}/{CLASS}={canonical_name}"
+
+    @staticmethod
+    def claim_device_id(claim_uid: str, canonical_name: str) -> str:
+        """Qualified ID in the per-claim transient spec — cdi.go:276-281."""
+        return f"{CLAIM_VENDOR}/{CLAIM_CLASS}={claim_uid}-{canonical_name}"
+
+    def _host_path(self, container_path: str) -> str:
+        """Root-transform for running containerized — the analog of the
+        reference's transformroot (cdi.go:119-138): device/mount host paths
+        must be resolved under the host driver root."""
+        if self.driver_root in ("", "/"):
+            return container_path
+        return f"{self.driver_root}{container_path}"
+
+    # -- base spec ---------------------------------------------------------
+    def create_standard_spec(self, devices: Iterable, *,
+                             common_env: Optional[dict[str, str]] = None
+                             ) -> str:
+        """``devices`` yields objects with ``canonical_name()`` and
+        ``device_paths`` + ``minor`` attributes (ChipInfo) or a parent chip
+        (CoreInfo).  Mirrors CreateStandardDeviceSpecFile (cdi.go:142-208)."""
+        cdi_devices = []
+        for dev in devices:
+            edits = ContainerEdits()
+            for path in getattr(dev, "device_paths", []):
+                edits.add_device_node(path, host_path=self._host_path(path))
+            name = dev.canonical_name()
+            if not _NAME_RE.match(name):
+                raise ValueError(f"invalid CDI device name {name!r}")
+            cdi_devices.append({"name": name,
+                                "containerEdits": edits.to_dict()})
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": f"{VENDOR}/{CLASS}",
+            "devices": cdi_devices,
+            "containerEdits": ContainerEdits(
+                # The NVIDIA base spec sets NVIDIA_VISIBLE_DEVICES=void so a
+                # vendor runtime can't race CDI injection (cdi.go:190-196);
+                # the TPU analog pins libtpu discovery to explicit grants.
+                env={"TPU_DRA_MANAGED": "1", **(common_env or {})},
+            ).to_dict(),
+        }
+        path = self.base_spec_path()
+        _atomic_write(path, json.dumps(spec, indent=2, sort_keys=True))
+        return path
+
+    # -- claim specs -------------------------------------------------------
+    def create_claim_spec(self, claim_uid: str,
+                          per_device_edits: dict[str, ContainerEdits]) -> str:
+        """Write the transient per-claim spec (cdi.go:210-265).
+
+        ``per_device_edits`` maps canonical device name → edits for the
+        claim-scoped CDI device carrying config-derived env/mounts.
+        """
+        devices = []
+        for name, edits in sorted(per_device_edits.items()):
+            devices.append({"name": f"{claim_uid}-{name}",
+                            "containerEdits": edits.to_dict()})
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": f"{CLAIM_VENDOR}/{CLAIM_CLASS}",
+            "devices": devices,
+        }
+        path = self.claim_spec_path(claim_uid)
+        _atomic_write(path, json.dumps(spec, indent=2, sort_keys=True))
+        return path
+
+    def delete_claim_spec(self, claim_uid: str) -> None:
+        try:
+            os.remove(self.claim_spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def list_claim_specs(self) -> list[str]:
+        """Claim UIDs with a spec on disk (cleanup support)."""
+        prefix = f"{CLAIM_VENDOR}-{CLAIM_CLASS}_"
+        out = []
+        for fn in os.listdir(self.cdi_root):
+            if fn.startswith(prefix) and fn.endswith(".json"):
+                out.append(fn[len(prefix):-len(".json")])
+        return sorted(out)

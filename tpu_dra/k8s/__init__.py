@@ -1,0 +1,28 @@
+"""Minimal from-scratch Kubernetes machinery.
+
+Stands in for what the reference gets from ``client-go`` plus its generated
+clientset (``pkg/nvidia.com``, Makefile:102-160): a typed-enough REST client,
+shared informers with indexers and mutation caches, a rate-limited workqueue
+(``tpu_dra.util.workqueue``), and an in-memory fake API server for tests (the
+analog of the generated fake clientset,
+``pkg/nvidia.com/clientset/versioned/fake``).
+"""
+
+from tpu_dra.k8s.client import (  # noqa: F401
+    ApiError,
+    Conflict,
+    KubeClient,
+    NotFound,
+    ResourceDesc,
+    RestKubeClient,
+    DAEMONSETS,
+    DEPLOYMENTS,
+    NODES,
+    PODS,
+    RESOURCE_CLAIMS,
+    RESOURCE_CLAIM_TEMPLATES,
+    RESOURCE_SLICES,
+    TPU_SLICE_DOMAINS,
+)
+from tpu_dra.k8s.fake import FakeKube  # noqa: F401
+from tpu_dra.k8s.informer import Informer, Store  # noqa: F401

@@ -1,0 +1,380 @@
+"""Kubernetes REST client.
+
+The reference consumes the API server through client-go clientsets
+(``pkg/flags/kubeclient.go:95-115`` builds ``ClientSets{Core, Nvidia}``).
+With no Go toolchain and no ``kubernetes`` Python package in the image, this
+module implements the thin slice of the Kubernetes REST protocol the driver
+needs, from scratch: typed resource descriptors, CRUD + status subresource +
+JSON merge/strategic-ish patch, list with label/field selectors, and chunked
+watch streams.  QPS/burst rate limiting mirrors kubeclient.go:32-41.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+import time
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Optional
+
+from tpu_dra.util import klog
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFound(ApiError):
+    def __init__(self, message: str = ""):
+        super().__init__(404, message)
+
+
+class Conflict(ApiError):
+    def __init__(self, message: str = ""):
+        super().__init__(409, message)
+
+
+def error_for(status: int, message: str = "") -> ApiError:
+    if status == 404:
+        return NotFound(message)
+    if status == 409:
+        return Conflict(message)
+    return ApiError(status, message)
+
+
+@dataclass(frozen=True)
+class ResourceDesc:
+    group: str          # "" for core
+    version: str
+    plural: str
+    kind: str
+    namespaced: bool = True
+
+    @property
+    def api_prefix(self) -> str:
+        if self.group == "":
+            return f"/api/{self.version}"
+        return f"/apis/{self.group}/{self.version}"
+
+    @property
+    def group_version(self) -> str:
+        return self.version if self.group == "" else \
+            f"{self.group}/{self.version}"
+
+    def path(self, namespace: Optional[str] = None,
+             name: Optional[str] = None, subresource: str = "") -> str:
+        parts = [self.api_prefix]
+        if self.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self.plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+
+PODS = ResourceDesc("", "v1", "pods", "Pod")
+NODES = ResourceDesc("", "v1", "nodes", "Node", namespaced=False)
+DAEMONSETS = ResourceDesc("apps", "v1", "daemonsets", "DaemonSet")
+DEPLOYMENTS = ResourceDesc("apps", "v1", "deployments", "Deployment")
+RESOURCE_SLICES = ResourceDesc("resource.k8s.io", "v1beta1",
+                               "resourceslices", "ResourceSlice",
+                               namespaced=False)
+RESOURCE_CLAIMS = ResourceDesc("resource.k8s.io", "v1beta1",
+                               "resourceclaims", "ResourceClaim")
+RESOURCE_CLAIM_TEMPLATES = ResourceDesc("resource.k8s.io", "v1beta1",
+                                        "resourceclaimtemplates",
+                                        "ResourceClaimTemplate")
+TPU_SLICE_DOMAINS = ResourceDesc("resource.tpu.google.com", "v1beta1",
+                                 "tpuslicedomains", "TpuSliceDomain")
+
+
+def match_labels(labels: dict[str, str] | None,
+                 selector: dict[str, str] | str | None) -> bool:
+    """Equality-based label selection (`k=v,k2=v2` or dict)."""
+    if not selector:
+        return True
+    if isinstance(selector, str):
+        pairs = [p.split("=", 1) for p in selector.split(",") if p]
+        selector = {k.strip(): v.strip() for k, v in pairs}
+    labels = labels or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def selector_string(selector: dict[str, str] | str | None) -> str:
+    if not selector:
+        return ""
+    if isinstance(selector, str):
+        return selector
+    return ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+
+
+class _TokenBucket:
+    def __init__(self, qps: float, burst: int):
+        self.qps, self.burst = qps, burst
+        self.tokens = float(burst)
+        self.last = time.monotonic()
+        self._mu = threading.Lock()
+
+    def take(self) -> None:
+        while True:
+            with self._mu:
+                now = time.monotonic()
+                self.tokens = min(self.burst,
+                                  self.tokens + (now - self.last) * self.qps)
+                self.last = now
+                if self.tokens >= 1:
+                    self.tokens -= 1
+                    return
+                wait = (1 - self.tokens) / self.qps
+            # sleep outside the lock, then re-contend for a token: N
+            # concurrent waiters must not all proceed after one interval
+            time.sleep(wait)
+
+
+class KubeClient:
+    """Interface both :class:`RestKubeClient` and the fake implement."""
+
+    def get(self, res: ResourceDesc, name: str,
+            namespace: Optional[str] = None) -> dict:
+        raise NotImplementedError
+
+    def list(self, res: ResourceDesc, namespace: Optional[str] = None,
+             label_selector: dict | str | None = None,
+             field_selector: dict | str | None = None) -> dict:
+        raise NotImplementedError
+
+    def create(self, res: ResourceDesc, obj: dict,
+               namespace: Optional[str] = None) -> dict:
+        raise NotImplementedError
+
+    def update(self, res: ResourceDesc, obj: dict,
+               namespace: Optional[str] = None) -> dict:
+        raise NotImplementedError
+
+    def update_status(self, res: ResourceDesc, obj: dict,
+                      namespace: Optional[str] = None) -> dict:
+        raise NotImplementedError
+
+    def patch(self, res: ResourceDesc, name: str, patch: dict,
+              namespace: Optional[str] = None) -> dict:
+        raise NotImplementedError
+
+    def delete(self, res: ResourceDesc, name: str,
+               namespace: Optional[str] = None) -> None:
+        raise NotImplementedError
+
+    def watch(self, res: ResourceDesc, namespace: Optional[str] = None,
+              label_selector: dict | str | None = None,
+              field_selector: dict | str | None = None,
+              resource_version: str = "",
+              stop: Optional[threading.Event] = None,
+              ) -> Iterator[tuple[str, dict]]:
+        """Yield ``(event_type, object)`` tuples; event_type in
+        ADDED/MODIFIED/DELETED/BOOKMARK."""
+        raise NotImplementedError
+
+
+class RestKubeClient(KubeClient):
+    SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, base_url: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 ca_data: Optional[bytes] = None,
+                 client_cert: Optional[tuple[str, str]] = None,
+                 insecure_skip_tls_verify: bool = False,
+                 qps: float = 50.0, burst: int = 100,
+                 timeout: float = 30.0):
+        import os
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "no base_url and not running in-cluster "
+                    "(KUBERNETES_SERVICE_HOST unset)")
+            base_url = f"https://{host}:{port}"
+            token_path = f"{self.SERVICE_ACCOUNT_DIR}/token"
+            if token is None and os.path.exists(token_path):
+                token = open(token_path).read().strip()
+            ca_path = f"{self.SERVICE_ACCOUNT_DIR}/ca.crt"
+            if ca_file is None and os.path.exists(ca_path):
+                ca_file = ca_path
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        self._bucket = _TokenBucket(qps, burst)
+        if self.base_url.startswith("https"):
+            self._ssl = ssl.create_default_context(
+                cafile=ca_file,
+                cadata=ca_data.decode() if ca_data else None)
+            if client_cert is not None:
+                self._ssl.load_cert_chain(certfile=client_cert[0],
+                                          keyfile=client_cert[1])
+            if ca_file is None and ca_data is None:
+                if not insecure_skip_tls_verify:
+                    raise RuntimeError(
+                        "https API server but no CA configured; pass "
+                        "ca_file/ca_data or insecure_skip_tls_verify=True")
+                klog.warning("TLS verification DISABLED for API server",
+                             server=self.base_url)
+                self._ssl.check_hostname = False
+                self._ssl.verify_mode = ssl.CERT_NONE
+        else:
+            self._ssl = None
+
+    # -- low-level ---------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None,
+                 query: Optional[dict[str, str]] = None,
+                 content_type: str = "application/json",
+                 stream: bool = False):
+        self._bucket.take()
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v})
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if stream else self.timeout,
+                context=self._ssl)
+        except urllib.error.HTTPError as exc:
+            msg = ""
+            try:
+                msg = exc.read().decode(errors="replace")[:2048]
+            except Exception:
+                pass
+            raise error_for(exc.code, msg) from exc
+        if stream:
+            return resp
+        payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    # -- KubeClient --------------------------------------------------------
+    def get(self, res, name, namespace=None):
+        return self._request("GET", res.path(namespace, name))
+
+    def list(self, res, namespace=None, label_selector=None,
+             field_selector=None):
+        return self._request("GET", res.path(namespace), query={
+            "labelSelector": selector_string(label_selector),
+            "fieldSelector": selector_string(field_selector),
+        })
+
+    def create(self, res, obj, namespace=None):
+        ns = namespace or obj.get("metadata", {}).get("namespace")
+        return self._request("POST", res.path(ns), body=obj)
+
+    def update(self, res, obj, namespace=None):
+        meta = obj.get("metadata", {})
+        ns = namespace or meta.get("namespace")
+        return self._request("PUT", res.path(ns, meta["name"]), body=obj)
+
+    def update_status(self, res, obj, namespace=None):
+        meta = obj.get("metadata", {})
+        ns = namespace or meta.get("namespace")
+        return self._request("PUT", res.path(ns, meta["name"], "status"),
+                             body=obj)
+
+    def patch(self, res, name, patch, namespace=None):
+        return self._request(
+            "PATCH", res.path(namespace, name), body=patch,
+            content_type="application/merge-patch+json")
+
+    def delete(self, res, name, namespace=None):
+        self._request("DELETE", res.path(namespace, name))
+
+    def watch(self, res, namespace=None, label_selector=None,
+              field_selector=None, resource_version="", stop=None):
+        query = {
+            "watch": "true",
+            "allowWatchBookmarks": "true",
+            "labelSelector": selector_string(label_selector),
+            "fieldSelector": selector_string(field_selector),
+            "resourceVersion": resource_version,
+        }
+        resp = self._request("GET", res.path(namespace), query=query,
+                             stream=True)
+        try:
+            for line in resp:
+                if stop is not None and stop.is_set():
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    klog.warning("watch: undecodable line", res=res.plural)
+                    continue
+                yield event.get("type", ""), event.get("object", {})
+        finally:
+            resp.close()
+
+
+def new_clients(kubeconfig: Optional[str] = None, qps: float = 50.0,
+                burst: int = 100) -> KubeClient:
+    """Build the client set — analog of kubeclient.go:95-115.
+
+    ``kubeconfig`` supports the shape written by kind/GKE: the
+    current-context's cluster + user, with inline ``*-data`` fields
+    (certificate-authority-data, client-certificate-data, client-key-data)
+    or file paths, bearer tokens, and ``insecure-skip-tls-verify``.
+    """
+    if not kubeconfig:
+        return RestKubeClient(qps=qps, burst=burst)
+    import base64
+    import tempfile
+    import yaml
+    cfg = yaml.safe_load(open(kubeconfig))
+    by_name = {c["name"]: c["cluster"] for c in cfg.get("clusters", [])}
+    users = {u["name"]: u.get("user", {}) for u in cfg.get("users", [])}
+    contexts = {c["name"]: c["context"] for c in cfg.get("contexts", [])}
+    ctx = contexts.get(cfg.get("current-context", ""),
+                       next(iter(contexts.values()), {}))
+    cluster = by_name.get(ctx.get("cluster", ""),
+                          next(iter(by_name.values()), {}))
+    user = users.get(ctx.get("user", ""), next(iter(users.values()), {}))
+
+    ca_data = None
+    if cluster.get("certificate-authority-data"):
+        ca_data = base64.b64decode(cluster["certificate-authority-data"])
+
+    client_cert = None
+    if user.get("client-certificate") and user.get("client-key"):
+        client_cert = (user["client-certificate"], user["client-key"])
+    elif user.get("client-certificate-data") and user.get("client-key-data"):
+        # ssl.load_cert_chain needs files; materialize with 0600 perms
+        def _dump(b64: str, suffix: str) -> str:
+            f = tempfile.NamedTemporaryFile(
+                delete=False, suffix=suffix, prefix="kubecfg-")
+            f.write(base64.b64decode(b64))
+            f.close()
+            return f.name
+        client_cert = (_dump(user["client-certificate-data"], ".crt"),
+                       _dump(user["client-key-data"], ".key"))
+
+    return RestKubeClient(
+        base_url=cluster["server"],
+        token=user.get("token"),
+        ca_file=cluster.get("certificate-authority"),
+        ca_data=ca_data,
+        client_cert=client_cert,
+        insecure_skip_tls_verify=bool(
+            cluster.get("insecure-skip-tls-verify")),
+        qps=qps, burst=burst)

@@ -1,0 +1,290 @@
+"""In-memory fake Kubernetes API server.
+
+Analog of the reference's generated fake clientset
+(``pkg/nvidia.com/clientset/versioned/fake/clientset_generated.go:1-85``) —
+but covering every resource the driver touches, with the API-machinery
+semantics the controller logic actually depends on:
+
+- uid/resourceVersion/creationTimestamp assignment and optimistic-concurrency
+  conflicts on update,
+- finalizer-aware deletion (deletionTimestamp set first; object removed only
+  once finalizers empty — required by the teardown flow in reference
+  ``cmd/compute-domain-controller/computedomain.go:234-268``),
+- label/field selector filtering on list and watch,
+- watch event streams with replay from a resourceVersion,
+- spec immutability for TpuSliceDomain (reference CEL rule
+  computedomain.go:53).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import queue
+import threading
+import time
+from typing import Iterator, Optional
+
+from tpu_dra.k8s.client import (
+    Conflict,
+    KubeClient,
+    NotFound,
+    ResourceDesc,
+    TPU_SLICE_DOMAINS,
+    match_labels,
+)
+
+
+def _merge_patch(target: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch."""
+    out = copy.deepcopy(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_patch(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _field_get(obj: dict, dotted: str):
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _match_fields(obj: dict, selector: dict | str | None) -> bool:
+    if not selector:
+        return True
+    if isinstance(selector, str):
+        pairs = [p.split("=", 1) for p in selector.split(",") if p]
+        selector = {k.strip(): v.strip() for k, v in pairs}
+    return all(str(_field_get(obj, k)) == v for k, v in selector.items())
+
+
+class _Watcher:
+    def __init__(self, res: ResourceDesc, namespace, label_selector,
+                 field_selector):
+        self.res = res
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.field_selector = field_selector
+        self.queue: "queue.Queue[tuple[str, dict] | None]" = queue.Queue()
+
+    def matches(self, obj: dict) -> bool:
+        meta = obj.get("metadata", {})
+        if self.res.namespaced and self.namespace and \
+                meta.get("namespace") != self.namespace:
+            return False
+        return match_labels(meta.get("labels"), self.label_selector) and \
+            _match_fields(obj, self.field_selector)
+
+
+class FakeKube(KubeClient):
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        # {(group, plural): {(namespace, name): obj}}
+        self._stores: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
+        self._rv = 0
+        self._uid = 0
+        self._watchers: list[_Watcher] = []
+        # bounded replay log: [(rv:int, type, obj)]
+        self._log: list[tuple[int, str, ResourceDesc, dict]] = []
+
+    # -- internals ---------------------------------------------------------
+    def _store(self, res: ResourceDesc) -> dict:
+        return self._stores.setdefault((res.group, res.plural), {})
+
+    def _key(self, res: ResourceDesc, obj_or_ns, name=None):
+        if isinstance(obj_or_ns, dict):
+            meta = obj_or_ns.get("metadata", {})
+            ns = meta.get("namespace", "") if res.namespaced else ""
+            return (ns, meta.get("name", ""))
+        return (obj_or_ns or "" if res.namespaced else "", name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, event_type: str, res: ResourceDesc, obj: dict) -> None:
+        self._log.append((int(obj["metadata"]["resourceVersion"]),
+                          event_type, res, copy.deepcopy(obj)))
+        if len(self._log) > 10000:
+            del self._log[:5000]
+        for w in list(self._watchers):
+            if w.res.plural == res.plural and w.res.group == res.group and \
+                    w.matches(obj):
+                w.queue.put((event_type, copy.deepcopy(obj)))
+
+    # -- KubeClient --------------------------------------------------------
+    def get(self, res, name, namespace=None):
+        with self._mu:
+            obj = self._store(res).get(self._key(res, namespace, name))
+            if obj is None:
+                raise NotFound(f"{res.plural} {namespace}/{name}")
+            return copy.deepcopy(obj)
+
+    def list(self, res, namespace=None, label_selector=None,
+             field_selector=None):
+        with self._mu:
+            items = []
+            for (ns, _), obj in sorted(self._store(res).items()):
+                if res.namespaced and namespace and ns != namespace:
+                    continue
+                meta = obj.get("metadata", {})
+                if not match_labels(meta.get("labels"), label_selector):
+                    continue
+                if not _match_fields(obj, field_selector):
+                    continue
+                items.append(copy.deepcopy(obj))
+            return {"apiVersion": "v1", "kind": f"{res.kind}List",
+                    "metadata": {"resourceVersion": str(self._rv)},
+                    "items": items}
+
+    def create(self, res, obj, namespace=None):
+        with self._mu:
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            if namespace and res.namespaced:
+                meta.setdefault("namespace", namespace)
+            if not meta.get("name") and meta.get("generateName"):
+                self._uid += 1
+                meta["name"] = f"{meta['generateName']}{self._uid:05x}"
+            key = self._key(res, obj)
+            if key in self._store(res):
+                raise Conflict(f"{res.plural} {key} already exists")
+            self._uid += 1
+            meta.setdefault("uid", f"uid-{self._uid:08x}")
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("creationTimestamp",
+                            time.strftime("%Y-%m-%dT%H:%M:%SZ"))
+            self._store(res)[key] = obj
+            self._emit("ADDED", res, obj)
+            return copy.deepcopy(obj)
+
+    def _finalize_update(self, res, old: dict, new: dict, key) -> dict:
+        """Shared update path: RV bump, finalizer-aware deletion."""
+        meta = new.setdefault("metadata", {})
+        meta["uid"] = old["metadata"]["uid"]
+        meta["resourceVersion"] = self._next_rv()
+        if old["metadata"].get("deletionTimestamp"):
+            meta["deletionTimestamp"] = old["metadata"]["deletionTimestamp"]
+            if not meta.get("finalizers"):
+                del self._store(res)[key]
+                self._emit("DELETED", res, new)
+                return copy.deepcopy(new)
+        self._store(res)[key] = new
+        self._emit("MODIFIED", res, new)
+        return copy.deepcopy(new)
+
+    def update(self, res, obj, namespace=None):
+        with self._mu:
+            obj = copy.deepcopy(obj)
+            key = self._key(res, obj)
+            old = self._store(res).get(key)
+            if old is None:
+                raise NotFound(f"{res.plural} {key}")
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv and sent_rv != old["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{res.plural} {key}: resourceVersion {sent_rv} != "
+                    f"{old['metadata']['resourceVersion']}")
+            if res is TPU_SLICE_DOMAINS or (
+                    res.group == TPU_SLICE_DOMAINS.group and
+                    res.plural == TPU_SLICE_DOMAINS.plural):
+                if old.get("spec") != obj.get("spec"):
+                    raise ApiErrorInvalid(
+                        "TpuSliceDomain spec is immutable")
+            # update never touches status (subresource semantics)
+            if "status" in old:
+                obj["status"] = copy.deepcopy(old["status"])
+            elif "status" in obj:
+                obj.pop("status")
+            return self._finalize_update(res, old, obj, key)
+
+    def update_status(self, res, obj, namespace=None):
+        with self._mu:
+            key = self._key(res, obj)
+            old = self._store(res).get(key)
+            if old is None:
+                raise NotFound(f"{res.plural} {key}")
+            new = copy.deepcopy(old)
+            new["status"] = copy.deepcopy(obj.get("status", {}))
+            return self._finalize_update(res, old, new, key)
+
+    def patch(self, res, name, patch, namespace=None):
+        with self._mu:
+            key = self._key(res, namespace, name)
+            old = self._store(res).get(key)
+            if old is None:
+                raise NotFound(f"{res.plural} {key}")
+            new = _merge_patch(old, patch)
+            new["metadata"]["name"] = old["metadata"]["name"]
+            return self._finalize_update(res, old, new, key)
+
+    def delete(self, res, name, namespace=None):
+        with self._mu:
+            key = self._key(res, namespace, name)
+            obj = self._store(res).get(key)
+            if obj is None:
+                raise NotFound(f"{res.plural} {key}")
+            if obj["metadata"].get("finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = \
+                        time.strftime("%Y-%m-%dT%H:%M:%SZ")
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._emit("MODIFIED", res, obj)
+                return
+            del self._store(res)[key]
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._emit("DELETED", res, obj)
+
+    def watch(self, res, namespace=None, label_selector=None,
+              field_selector=None, resource_version="",
+              stop: Optional[threading.Event] = None,
+              ) -> Iterator[tuple[str, dict]]:
+        w = _Watcher(res, namespace, label_selector, field_selector)
+        with self._mu:
+            replay = []
+            if resource_version:
+                rv = int(resource_version)
+                for ev_rv, ev_type, ev_res, ev_obj in self._log:
+                    if ev_rv > rv and ev_res.plural == res.plural and \
+                            ev_res.group == res.group and w.matches(ev_obj):
+                        replay.append((ev_type, copy.deepcopy(ev_obj)))
+            self._watchers.append(w)
+        try:
+            yield from replay
+            while stop is None or not stop.is_set():
+                try:
+                    item = w.queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    return
+                yield item
+        finally:
+            with self._mu:
+                if w in self._watchers:
+                    self._watchers.remove(w)
+
+    # -- test helpers ------------------------------------------------------
+    def close_watchers(self) -> None:
+        with self._mu:
+            for w in self._watchers:
+                w.queue.put(None)
+
+    def dump(self) -> str:
+        with self._mu:
+            return json.dumps(
+                {f"{g}/{p}": {f"{ns}/{n}": o for (ns, n), o in s.items()}
+                 for (g, p), s in self._stores.items()}, indent=2,
+                default=str)
+
+
+class ApiErrorInvalid(Conflict):
+    """422-ish invalid update (spec immutability)."""

@@ -1,0 +1,228 @@
+"""Shared informers, stores, indexers, and a mutation cache.
+
+Stands in for client-go's SharedInformerFactory as used throughout the
+reference: uid-indexed CRD informers
+(``cmd/compute-domain-controller/indexers.go:32-75``), label-selector-scoped
+informers with a MutationCache for read-your-writes
+(``cmd/compute-domain-controller/daemonset.go:70-100``), and field-selector
+informers (``cmd/compute-domain-daemon/computedomain.go:42-75``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from tpu_dra.k8s.client import KubeClient, ResourceDesc
+from tpu_dra.util import klog
+
+IndexFunc = Callable[[dict], list[str]]
+
+
+def uid_index(obj: dict) -> list[str]:
+    """Reference indexers.go:32-38 — index by metadata.uid."""
+    uid = obj.get("metadata", {}).get("uid")
+    return [uid] if uid else []
+
+
+def label_index(label: str) -> IndexFunc:
+    """Reference indexers.go:40-54 — index by the value of one label."""
+    def fn(obj: dict) -> list[str]:
+        val = obj.get("metadata", {}).get("labels", {}).get(label)
+        return [val] if val else []
+    return fn
+
+
+class Store:
+    """Thread-safe object store keyed by (namespace, name), with indexers."""
+
+    def __init__(self, indexers: Optional[dict[str, IndexFunc]] = None):
+        self._mu = threading.RLock()
+        self._objs: dict[tuple[str, str], dict] = {}
+        self._indexers = indexers or {}
+        self._indices: dict[str, dict[str, set[tuple[str, str]]]] = \
+            {name: {} for name in self._indexers}
+        # mutation cache: recently-written objects override the informer view
+        # until the watch catches up (reference daemonset.go:94-99)
+        self._mutations: dict[tuple[str, str], tuple[dict, float]] = {}
+        self._mutation_ttl = 10.0
+
+    @staticmethod
+    def key_of(obj: dict) -> tuple[str, str]:
+        meta = obj.get("metadata", {})
+        return (meta.get("namespace", ""), meta.get("name", ""))
+
+    def _reindex(self, key, old: Optional[dict], new: Optional[dict]):
+        for name, fn in self._indexers.items():
+            idx = self._indices[name]
+            if old is not None:
+                for v in fn(old):
+                    idx.get(v, set()).discard(key)
+            if new is not None:
+                for v in fn(new):
+                    idx.setdefault(v, set()).add(key)
+
+    def replace(self, objs: list[dict]) -> None:
+        with self._mu:
+            self._objs.clear()
+            for name in self._indices:
+                self._indices[name].clear()
+            for obj in objs:
+                key = self.key_of(obj)
+                self._objs[key] = obj
+                self._reindex(key, None, obj)
+
+    def add_or_update(self, obj: dict) -> Optional[dict]:
+        with self._mu:
+            key = self.key_of(obj)
+            old = self._objs.get(key)
+            self._objs[key] = obj
+            self._reindex(key, old, obj)
+            mut = self._mutations.get(key)
+            if mut is not None and _rv(obj) >= _rv(mut[0]):
+                del self._mutations[key]
+            return old
+
+    def delete(self, obj: dict) -> None:
+        with self._mu:
+            key = self.key_of(obj)
+            old = self._objs.pop(key, None)
+            self._reindex(key, old, None)
+            self._mutations.pop(key, None)
+
+    def mutate(self, obj: dict) -> None:
+        """Record a write we just made (read-your-writes)."""
+        with self._mu:
+            self._mutations[self.key_of(obj)] = (obj, time.monotonic())
+
+    def get(self, namespace: str, name: str) -> Optional[dict]:
+        with self._mu:
+            key = (namespace, name)
+            mut = self._mutations.get(key)
+            if mut is not None:
+                if time.monotonic() - mut[1] < self._mutation_ttl:
+                    return mut[0]
+                del self._mutations[key]
+            return self._objs.get(key)
+
+    def by_index(self, index_name: str, value: str) -> list[dict]:
+        with self._mu:
+            keys = self._indices.get(index_name, {}).get(value, set())
+            return [self._objs[k] for k in sorted(keys) if k in self._objs]
+
+    def list(self) -> list[dict]:
+        with self._mu:
+            return list(self._objs.values())
+
+
+class Informer:
+    """List+watch loop feeding a :class:`Store` and event handlers."""
+
+    def __init__(self, client: KubeClient, resource: ResourceDesc,
+                 namespace: Optional[str] = None,
+                 label_selector: dict | str | None = None,
+                 field_selector: dict | str | None = None,
+                 indexers: Optional[dict[str, IndexFunc]] = None):
+        self.client = client
+        self.resource = resource
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.field_selector = field_selector
+        self.store = Store(indexers)
+        self._handlers: list[dict[str, Callable]] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_event_handler(self, on_add: Optional[Callable[[dict], None]] = None,
+                          on_update: Optional[
+                              Callable[[dict, dict], None]] = None,
+                          on_delete: Optional[
+                              Callable[[dict], None]] = None) -> None:
+        self._handlers.append(
+            {"add": on_add, "update": on_update, "delete": on_delete})
+
+    def _dispatch(self, kind: str, *args) -> None:
+        for h in self._handlers:
+            fn = h.get(kind)
+            if fn is None:
+                continue
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — handlers must not kill the loop
+                klog.error("informer handler raised",
+                           resource=self.resource.plural, kind=kind)
+
+    def start(self) -> "Informer":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"informer-{self.resource.plural}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def _run(self) -> None:
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                listing = self.client.list(
+                    self.resource, namespace=self.namespace,
+                    label_selector=self.label_selector,
+                    field_selector=self.field_selector)
+                items = listing.get("items", [])
+                old = {Store.key_of(o): o for o in self.store.list()}
+                self.store.replace(items)
+                for obj in items:
+                    key = Store.key_of(obj)
+                    if key in old:
+                        prev = old.pop(key)
+                        self._dispatch("update", prev, obj)
+                    else:
+                        self._dispatch("add", obj)
+                # objects that vanished during a watch gap still owe a
+                # delete event (client-go DeletedFinalStateUnknown analog)
+                for gone in old.values():
+                    self._dispatch("delete", gone)
+                rv = listing.get("metadata", {}).get("resourceVersion", "")
+                self._synced.set()
+                backoff = 0.2
+                for ev_type, obj in self.client.watch(
+                        self.resource, namespace=self.namespace,
+                        label_selector=self.label_selector,
+                        field_selector=self.field_selector,
+                        resource_version=rv, stop=self._stop):
+                    if self._stop.is_set():
+                        return
+                    if ev_type == "BOOKMARK":
+                        continue
+                    if ev_type == "DELETED":
+                        self.store.delete(obj)
+                        self._dispatch("delete", obj)
+                    elif ev_type in ("ADDED", "MODIFIED"):
+                        old = self.store.add_or_update(obj)
+                        if old is None:
+                            self._dispatch("add", obj)
+                        else:
+                            self._dispatch("update", old, obj)
+                # watch ended (server closed) — relist
+            except Exception as exc:  # noqa: BLE001 — loop must survive
+                if self._stop.is_set():
+                    return
+                klog.warning("informer list/watch failed; retrying",
+                             resource=self.resource.plural, err=repr(exc),
+                             backoff=backoff)
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+
+def _rv(obj: dict) -> int:
+    try:
+        return int(obj.get("metadata", {}).get("resourceVersion", 0))
+    except (TypeError, ValueError):
+        return 0

@@ -1,0 +1,196 @@
+"""HTTP facade over :class:`FakeKube` — an envtest analog.
+
+Serves the slice of the Kubernetes REST protocol :class:`RestKubeClient`
+speaks, backed by the in-memory fake.  Used to run the real driver binaries
+end-to-end without a cluster (the reference's equivalent workflow is a kind
+cluster, demo/clusters/kind/*; this is the in-process variant).
+
+Run standalone:  ``python -m tpu_dra.k8s.testserver --port 8001``
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpu_dra.k8s.client import ApiError, ResourceDesc
+from tpu_dra.k8s.fake import FakeKube
+
+_CORE_RE = re.compile(
+    r"^/api/(?P<version>v1)"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<sub>status))?$")
+_GROUP_RE = re.compile(
+    r"^/apis/(?P<group>[^/]+)/(?P<version>[^/]+)"
+    r"(?:/namespaces/(?P<ns>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<sub>status))?$")
+
+
+class KubeTestServer:
+    def __init__(self, fake: Optional[FakeKube] = None,
+                 address: str = "127.0.0.1", port: int = 0) -> None:
+        self.fake = fake or FakeKube()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _parse(self):
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                m = _CORE_RE.match(parsed.path) or \
+                    _GROUP_RE.match(parsed.path)
+                if not m:
+                    return None
+                g = m.groupdict()
+                res = ResourceDesc(
+                    group=g.get("group") or "",
+                    version=g["version"],
+                    plural=g["plural"],
+                    kind=g["plural"].rstrip("s").capitalize(),
+                    namespaced=g.get("ns") is not None)
+                query = {k: v[0] for k, v in
+                         parse_qs(parsed.query).items()}
+                return res, g.get("ns"), g.get("name"), g.get("sub"), query
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else None
+
+            def _dispatch(self, method: str) -> None:
+                parsed = self._parse()
+                if parsed is None:
+                    self._send(404, {"message": f"bad path {self.path}"})
+                    return
+                res, ns, name, sub, query = parsed
+                try:
+                    if method == "GET" and query.get("watch") == "true":
+                        self._watch(res, ns, query)
+                        return
+                    out = self._crud(method, res, ns, name, sub, query)
+                    self._send(200, out if out is not None else {})
+                except ApiError as exc:
+                    self._send(exc.status, {"message": exc.message})
+                except BrokenPipeError:
+                    pass
+
+            def _crud(self, method, res, ns, name, sub, query):
+                fake = outer.fake
+                if method == "GET":
+                    if name:
+                        return fake.get(res, name, ns)
+                    return fake.list(
+                        res, ns,
+                        label_selector=query.get("labelSelector"),
+                        field_selector=query.get("fieldSelector"))
+                if method == "POST":
+                    return fake.create(res, self._body(), ns)
+                if method == "PUT":
+                    body = self._body()
+                    if sub == "status":
+                        return fake.update_status(res, body, ns)
+                    return fake.update(res, body, ns)
+                if method == "PATCH":
+                    return fake.patch(res, name, self._body(), ns)
+                if method == "DELETE":
+                    fake.delete(res, name, ns)
+                    return {"status": "Success"}
+                raise ApiError(405, method)
+
+            def _watch(self, res, ns, query) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                stop = threading.Event()
+                try:
+                    for ev_type, obj in outer.fake.watch(
+                            res, namespace=ns,
+                            label_selector=query.get("labelSelector"),
+                            field_selector=query.get("fieldSelector"),
+                            resource_version=query.get("resourceVersion", ""),
+                            stop=stop):
+                        line = json.dumps(
+                            {"type": ev_type, "object": obj}) + "\n"
+                        data = line.encode()
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    stop.set()
+
+            def do_GET(self):  # noqa: N802
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def do_PUT(self):  # noqa: N802
+                self._dispatch("PUT")
+
+            def do_PATCH(self):  # noqa: N802
+                self._dispatch("PATCH")
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE")
+
+            def log_message(self, *args):
+                pass
+
+        self.server = ThreadingHTTPServer((address, port), Handler)
+        self.port = self.server.server_address[1]
+        self.base_url = f"http://{address}:{self.port}"
+
+    def start(self) -> "KubeTestServer":
+        threading.Thread(target=self.server.serve_forever, daemon=True,
+                         name="kube-testserver").start()
+        return self
+
+    def stop(self) -> None:
+        self.fake.close_watchers()
+        self.server.shutdown()
+
+    def write_kubeconfig(self, path: str) -> str:
+        import yaml
+        cfg = {
+            "apiVersion": "v1", "kind": "Config",
+            "clusters": [{"name": "test",
+                          "cluster": {"server": self.base_url}}],
+            "users": [{"name": "test", "user": {}}],
+            "contexts": [{"name": "test",
+                          "context": {"cluster": "test", "user": "test"}}],
+            "current-context": "test",
+        }
+        with open(path, "w") as f:
+            yaml.safe_dump(cfg, f)
+        return path
+
+
+def main() -> None:
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=8001)
+    args = p.parse_args()
+    server = KubeTestServer(port=args.port)
+    server.start()
+    print(f"kube test server on {server.base_url}", flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

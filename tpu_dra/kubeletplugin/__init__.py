@@ -1,0 +1,6 @@
+from tpu_dra.kubeletplugin.server import (  # noqa: F401
+    ClaimRef,
+    DriverCallbacks,
+    KubeletPluginServer,
+    PrepareResult,
+)

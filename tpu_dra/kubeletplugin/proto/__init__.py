@@ -1,0 +1,11 @@
+import os
+import sys
+
+# The protoc-generated modules expect flat imports; make the package dir
+# importable so `import dra_v1beta1_pb2` resolves regardless of entry point.
+_here = os.path.dirname(os.path.abspath(__file__))
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import dra_v1beta1_pb2  # noqa: E402,F401
+import pluginregistration_pb2  # noqa: E402,F401

@@ -1,0 +1,263 @@
+"""DRA kubelet-plugin server.
+
+Analog of the upstream ``k8s.io/dynamic-resource-allocation/kubeletplugin``
+helper as the reference uses it (gpu driver.go:57-87):
+
+- serves the ``v1beta1.DRAPlugin`` gRPC service on a unix socket under the
+  kubelet plugins dir,
+- serves ``pluginregistration.Registration`` on the kubelet registry socket so
+  the kubelet discovers and registers the plugin,
+- fetches the full ResourceClaim objects the kubelet references by
+  namespace/name/uid before fanning out to driver callbacks (the kubelet only
+  sends claim references),
+- publishes the node's devices as a single ResourceSlice pool named after the
+  node (gpu driver.go:71-84).
+
+``Serialize`` is disabled exactly like the reference (gpu driver.go:62;
+CD driver.go:84-90 explains why: slice-domain prepares are codependent across
+claims, so they must be allowed to run concurrently).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import grpc
+
+from tpu_dra.k8s.client import KubeClient, NotFound, RESOURCE_CLAIMS, \
+    RESOURCE_SLICES
+from tpu_dra.kubeletplugin.proto import (  # noqa: F401 (sys.path setup)
+    dra_v1beta1_pb2 as dra_pb,
+    pluginregistration_pb2 as reg_pb,
+)
+from tpu_dra.util import klog
+
+
+@dataclass
+class ClaimRef:
+    namespace: str
+    uid: str
+    name: str
+
+
+@dataclass
+class PrepareResult:
+    """Per-claim prepare outcome: devices or an error string."""
+
+    devices: list[dict] = field(default_factory=list)
+    # each device: {request_names, pool_name, device_name, cdi_device_ids}
+    error: str = ""
+
+
+@dataclass
+class DriverCallbacks:
+    """The seam the two plugins implement (reference
+    ``PrepareResourceClaims``/``UnprepareResourceClaims``,
+    gpu driver.go:97-118)."""
+
+    prepare: Callable[[list[dict]], dict[str, PrepareResult]]
+    unprepare: Callable[[list[ClaimRef]], dict[str, str]]
+
+
+class _DRAService:
+    def __init__(self, plugin: "KubeletPluginServer"):
+        self.plugin = plugin
+
+    def node_prepare_resources(self, request, context):
+        refs = [ClaimRef(c.namespace, c.uid, c.name) for c in request.claims]
+        klog.info("NodePrepareResources", level=6,
+                  claims=[r.uid for r in refs])
+        response = dra_pb.NodePrepareResourcesResponse()
+        claims, fetch_errors = self.plugin.fetch_claims(refs)
+        results = self.plugin.callbacks.prepare(claims) if claims else {}
+        for ref in refs:
+            out = response.claims[ref.uid]
+            if ref.uid in fetch_errors:
+                out.error = fetch_errors[ref.uid]
+                continue
+            result = results.get(ref.uid)
+            if result is None:
+                out.error = f"no prepare result for claim {ref.uid}"
+            elif result.error:
+                out.error = result.error
+            else:
+                for dev in result.devices:
+                    out.devices.append(dra_pb.Device(
+                        request_names=dev.get("request_names", []),
+                        pool_name=dev.get("pool_name", ""),
+                        device_name=dev.get("device_name", ""),
+                        cdi_device_ids=dev.get("cdi_device_ids", [])))
+        return response
+
+    def node_unprepare_resources(self, request, context):
+        refs = [ClaimRef(c.namespace, c.uid, c.name) for c in request.claims]
+        klog.info("NodeUnprepareResources", level=6,
+                  claims=[r.uid for r in refs])
+        response = dra_pb.NodeUnprepareResourcesResponse()
+        errors = self.plugin.callbacks.unprepare(refs)
+        for ref in refs:
+            out = response.claims[ref.uid]
+            err = errors.get(ref.uid, "")
+            if err:
+                out.error = err
+        return response
+
+
+class _RegistrationService:
+    def __init__(self, plugin: "KubeletPluginServer"):
+        self.plugin = plugin
+        self.registered = threading.Event()
+        self.registration_error: str = ""
+
+    def get_info(self, request, context):
+        return reg_pb.PluginInfo(
+            type="DRAPlugin",
+            name=self.plugin.driver_name,
+            endpoint=self.plugin.dra_socket,
+            supported_versions=["v1beta1"])
+
+    def notify_registration_status(self, request, context):
+        if request.plugin_registered:
+            klog.info("kubelet registered plugin",
+                      driver=self.plugin.driver_name)
+            self.registered.set()
+        else:
+            self.registration_error = request.error
+            klog.error("kubelet registration failed", err=request.error)
+        return reg_pb.RegistrationStatusResponse()
+
+
+def _unary(fn, req_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda msg: msg.SerializeToString())
+
+
+class KubeletPluginServer:
+    """Start/stop both gRPC services and publish ResourceSlices."""
+
+    def __init__(self, driver_name: str, node_name: str, kube: KubeClient,
+                 plugins_dir: str, registry_dir: str,
+                 callbacks: DriverCallbacks) -> None:
+        self.driver_name = driver_name
+        self.node_name = node_name
+        self.kube = kube
+        self.callbacks = callbacks
+        self.plugin_dir = os.path.join(plugins_dir, driver_name)
+        self.dra_socket = os.path.join(self.plugin_dir, "dra.sock")
+        self.reg_socket = os.path.join(registry_dir,
+                                       f"{driver_name}-reg.sock")
+        self.registration = _RegistrationService(self)
+        self._server: Optional[grpc.Server] = None
+        self._pool_generation = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        os.makedirs(os.path.dirname(self.reg_socket), exist_ok=True)
+        for sock in (self.dra_socket, self.reg_socket):
+            if os.path.exists(sock):
+                os.remove(sock)
+        server = grpc.server(thread_pool=ThreadPoolExecutor(max_workers=8))
+        dra = _DRAService(self)
+        server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler("v1beta1.DRAPlugin", {
+                "NodePrepareResources": _unary(
+                    dra.node_prepare_resources,
+                    dra_pb.NodePrepareResourcesRequest),
+                "NodeUnprepareResources": _unary(
+                    dra.node_unprepare_resources,
+                    dra_pb.NodeUnprepareResourcesRequest),
+            }),
+            grpc.method_handlers_generic_handler(
+                "pluginregistration.Registration", {
+                    "GetInfo": _unary(self.registration.get_info,
+                                      reg_pb.InfoRequest),
+                    "NotifyRegistrationStatus": _unary(
+                        self.registration.notify_registration_status,
+                        reg_pb.RegistrationStatus),
+                }),
+        ))
+        server.add_insecure_port(f"unix:{self.dra_socket}")
+        server.add_insecure_port(f"unix:{self.reg_socket}")
+        server.start()
+        self._server = server
+        klog.info("kubelet plugin serving", driver=self.driver_name,
+                  dra_socket=self.dra_socket, reg_socket=self.reg_socket)
+
+    def stop(self, grace: float = 2.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+
+    # -- claims ------------------------------------------------------------
+    def fetch_claims(self, refs: list[ClaimRef]
+                     ) -> tuple[list[dict], dict[str, str]]:
+        """Resolve claim references to full objects; a UID mismatch means the
+        kubelet's view is stale (claim deleted+recreated) and is an error for
+        that claim only."""
+        claims: list[dict] = []
+        errors: dict[str, str] = {}
+        for ref in refs:
+            try:
+                obj = self.kube.get(RESOURCE_CLAIMS, ref.name, ref.namespace)
+            except NotFound:
+                errors[ref.uid] = (
+                    f"ResourceClaim {ref.namespace}/{ref.name} not found")
+                continue
+            if obj.get("metadata", {}).get("uid") != ref.uid:
+                errors[ref.uid] = (
+                    f"ResourceClaim {ref.namespace}/{ref.name} UID mismatch")
+                continue
+            claims.append(obj)
+        return claims, errors
+
+    # -- resource slices ---------------------------------------------------
+    def slice_name(self) -> str:
+        return f"{self.node_name}-{self.driver_name}"
+
+    def publish_resources(self, devices: list[dict]) -> dict:
+        """Create/update the node's ResourceSlice (gpu driver.go:71-84): one
+        pool, named after the node, one slice.  ``pool.generation`` must be
+        monotonic across driver restarts, so it is seeded from the existing
+        slice rather than an in-memory counter."""
+        try:
+            existing = self.kube.get(RESOURCE_SLICES, self.slice_name())
+        except NotFound:
+            existing = None
+        prev_gen = 0
+        if existing is not None:
+            prev_gen = existing.get("spec", {}).get("pool", {}) \
+                .get("generation", 0)
+        self._pool_generation = max(self._pool_generation, prev_gen) + 1
+        slice_obj = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": self.slice_name()},
+            "spec": {
+                "driver": self.driver_name,
+                "nodeName": self.node_name,
+                "pool": {
+                    "name": self.node_name,
+                    "generation": self._pool_generation,
+                    "resourceSliceCount": 1,
+                },
+                "devices": devices,
+            },
+        }
+        if existing is None:
+            return self.kube.create(RESOURCE_SLICES, slice_obj)
+        slice_obj["metadata"]["resourceVersion"] = \
+            existing["metadata"]["resourceVersion"]
+        return self.kube.update(RESOURCE_SLICES, slice_obj)
+
+    def unpublish_resources(self) -> None:
+        try:
+            self.kube.delete(RESOURCE_SLICES, self.slice_name())
+        except NotFound:
+            pass

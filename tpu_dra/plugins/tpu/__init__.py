@@ -1,0 +1,7 @@
+"""tpu-kubelet-plugin — node-local TPU allocation.
+
+Analog of reference ``cmd/gpu-kubelet-plugin`` (SURVEY.md §2.1): discovers
+chips/cores via :mod:`tpu_dra.tpulib`, publishes them as a ResourceSlice for
+the ``tpu.google.com`` driver, and serves DRA Prepare/Unprepare with
+checkpointed, flock-serialized idempotency.
+"""

@@ -1,0 +1,117 @@
+"""Allocatable/prepared device collections.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/allocatable.go:25-99``,
+``prepared.go:25-179`` and ``types.go:19-29``: tagged unions over
+chip/core devices plus UUID-set helpers, and the serializable prepared-device
+records stored in the checkpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo
+
+TYPE_CHIP = "chip"
+TYPE_CORE = "core"
+
+
+@dataclass
+class AllocatableDevice:
+    """Tagged union — exactly one of chip/core is set (allocatable.go:25-99)."""
+
+    chip: Optional[ChipInfo] = None
+    core: Optional[CoreInfo] = None
+
+    def __post_init__(self) -> None:
+        if (self.chip is None) == (self.core is None):
+            raise ValueError("exactly one of chip/core must be set")
+
+    @property
+    def type(self) -> str:
+        return TYPE_CHIP if self.chip is not None else TYPE_CORE
+
+    @property
+    def uuid(self) -> str:
+        return self.chip.uuid if self.chip else self.core.uuid
+
+    def canonical_name(self) -> str:
+        return (self.chip or self.core).canonical_name()
+
+
+def enumerate_allocatable(tpulib, enable_subslices: bool = False
+                          ) -> dict[str, AllocatableDevice]:
+    """Build the allocatable set keyed by canonical device name — analog of
+    ``enumerateAllPossibleDevices`` (gpu nvlib.go:103-154).  Cores are only
+    advertised when sub-slicing is enabled (the MIG-enabled gate analog)."""
+    out: dict[str, AllocatableDevice] = {}
+    for chip in tpulib.enumerate_chips():
+        out[chip.canonical_name()] = AllocatableDevice(chip=chip)
+        if enable_subslices and chip.family.cores_per_chip > 1:
+            for core in chip.cores():
+                out[core.canonical_name()] = AllocatableDevice(core=core)
+    return out
+
+
+@dataclass
+class PreparedDevice:
+    """One device prepared for a claim, as persisted in the checkpoint
+    (prepared.go:25-179).  ``cdi_device_ids`` carries both the standard
+    (base-spec) ID and the per-claim transient ID."""
+
+    type: str
+    uuid: str
+    canonical_name: str
+    request_names: list[str] = field(default_factory=list)
+    cdi_device_ids: list[str] = field(default_factory=list)
+    parent_uuid: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "uuid": self.uuid,
+            "canonicalName": self.canonical_name,
+            "requestNames": list(self.request_names),
+            "cdiDeviceIDs": list(self.cdi_device_ids),
+            "parentUUID": self.parent_uuid,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PreparedDevice":
+        return cls(
+            type=data["type"],
+            uuid=data["uuid"],
+            canonical_name=data["canonicalName"],
+            request_names=list(data.get("requestNames", [])),
+            cdi_device_ids=list(data.get("cdiDeviceIDs", [])),
+            parent_uuid=data.get("parentUUID", ""),
+        )
+
+
+@dataclass
+class PreparedClaim:
+    """Checkpoint record for one claim (gpu checkpoint.go:10-62 stores the
+    full ResourceClaimStatus + prepared devices so Unprepare never needs the
+    API server)."""
+
+    claim_uid: str
+    namespace: str
+    name: str
+    devices: list[PreparedDevice] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"claimUID": self.claim_uid, "namespace": self.namespace,
+                "name": self.name,
+                "devices": [d.to_dict() for d in self.devices]}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PreparedClaim":
+        return cls(claim_uid=data["claimUID"],
+                   namespace=data.get("namespace", ""),
+                   name=data.get("name", ""),
+                   devices=[PreparedDevice.from_dict(d)
+                            for d in data.get("devices", [])])
+
+    def uuids(self) -> list[str]:
+        return [d.uuid for d in self.devices]

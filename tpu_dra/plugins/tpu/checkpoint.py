@@ -1,0 +1,94 @@
+"""Node-local claim checkpointing.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/checkpoint.go:10-62`` (kubelet
+checkpointmanager: JSON + checksum, one ``checkpoint.json`` per plugin dir;
+written at every prepare/unprepare transaction point,
+device_state.go:109-125,160-167).  The checksum is CRC32-C via the native
+library (tpu_dra/tpulib/native.py).
+
+A versioned envelope mirrors the reference's migration path
+(checkpoint_legacy.go:12-143): ``v1`` is current; unknown versions fail
+closed, and a ``migrations`` hook table supports future formats.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional
+
+from tpu_dra.plugins.tpu.allocatable import PreparedClaim
+from tpu_dra.tpulib import native
+
+
+class CorruptCheckpoint(RuntimeError):
+    pass
+
+
+class Checkpoint:
+    VERSION = "v1"
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.prepared: dict[str, PreparedClaim] = {}
+        # version -> converter(old_payload) -> v1 payload
+        self.migrations: dict[str, Callable[[dict], dict]] = {}
+
+    # -- persistence -------------------------------------------------------
+    def _payload(self) -> dict:
+        return {
+            "version": self.VERSION,
+            "preparedClaims": {uid: c.to_dict()
+                               for uid, c in sorted(self.prepared.items())},
+        }
+
+    def save(self) -> None:
+        payload = json.dumps(self._payload(), sort_keys=True)
+        envelope = {"checksum": native.crc32c(payload.encode()),
+                    "data": payload}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(envelope, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def load(self) -> bool:
+        """Returns False when no checkpoint exists yet (first start —
+        reference device_state.go:94-125 creates an empty one)."""
+        try:
+            with open(self.path) as f:
+                envelope = json.load(f)
+        except FileNotFoundError:
+            return False
+        except json.JSONDecodeError as exc:
+            raise CorruptCheckpoint(f"{self.path}: {exc}") from exc
+        data = envelope.get("data", "")
+        if native.crc32c(data.encode()) != envelope.get("checksum"):
+            raise CorruptCheckpoint(f"{self.path}: checksum mismatch")
+        payload = json.loads(data)
+        version = payload.get("version", "")
+        if version != self.VERSION:
+            migrate = self.migrations.get(version)
+            if migrate is None:
+                raise CorruptCheckpoint(
+                    f"{self.path}: unknown checkpoint version {version!r}")
+            payload = migrate(payload)
+        self.prepared = {
+            uid: PreparedClaim.from_dict(c)
+            for uid, c in payload.get("preparedClaims", {}).items()}
+        return True
+
+    # -- claim ops (each saves immediately: crash-consistency point) -------
+    def get(self, claim_uid: str) -> Optional[PreparedClaim]:
+        return self.prepared.get(claim_uid)
+
+    def put(self, claim: PreparedClaim) -> None:
+        self.prepared[claim.claim_uid] = claim
+        self.save()
+
+    def remove(self, claim_uid: str) -> None:
+        if claim_uid in self.prepared:
+            del self.prepared[claim_uid]
+            self.save()

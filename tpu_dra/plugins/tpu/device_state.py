@@ -1,0 +1,311 @@
+"""The prepare/unprepare state machine.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/device_state.go:45-495``:
+idempotent via checkpoint, maps opaque configs to allocation results with the
+reference's precedence rules (claim > class, later > earlier,
+device_state.go:442-495), normalizes/validates configs, applies sharing,
+writes the per-claim CDI spec, and records everything in the checkpoint
+before returning (the crash-consistency point, device_state.go:160-167).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_dra.api import decode
+from tpu_dra.api.configs import (
+    ConfigError,
+    TpuConfig,
+    TpuSubSliceConfig,
+)
+from tpu_dra.cdi.spec import CDIHandler, ContainerEdits
+from tpu_dra.plugins.tpu.allocatable import (
+    AllocatableDevice,
+    PreparedClaim,
+    PreparedDevice,
+    TYPE_CHIP,
+    TYPE_CORE,
+    enumerate_allocatable,
+)
+from tpu_dra.plugins.tpu.checkpoint import Checkpoint
+from tpu_dra.plugins.tpu.sharing import MultiProcessManager
+from tpu_dra.tpulib.discovery import TpuLib
+from tpu_dra.util import klog
+from tpu_dra.version import DRIVER_NAME
+
+CONFIG_SOURCE_CLASS = "FromClass"
+CONFIG_SOURCE_CLAIM = "FromClaim"
+
+
+class PrepareError(RuntimeError):
+    pass
+
+
+@dataclass
+class DeviceConfigState:
+    """One opaque config as it applies to a set of allocation results —
+    analog of the configResultsMap entries (device_state.go:238-269)."""
+
+    config: object
+    source: str
+    requests: list[str] = field(default_factory=list)
+    results: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class DeviceStateConfig:
+    tpulib: TpuLib
+    plugin_dir: str
+    cdi_root: str
+    driver_root: str = "/"
+    enable_subslices: bool = True
+    driver_name: str = DRIVER_NAME
+
+
+class DeviceState:
+    def __init__(self, cfg: DeviceStateConfig) -> None:
+        self.cfg = cfg
+        self._mu = threading.Lock()
+        self.tpulib = cfg.tpulib
+        self.fabric_id = self.tpulib.fabric_id()
+        self.allocatable = enumerate_allocatable(
+            cfg.tpulib, enable_subslices=cfg.enable_subslices)
+        self.cdi = CDIHandler(cfg.cdi_root, cfg.driver_root)
+        # every allocatable device — chips AND cores — needs a base-spec
+        # entry, since prepare hands out a standard CDI ID for each (cores
+        # carry their parent chip's device nodes)
+        self.cdi.create_standard_spec(
+            [d.chip or d.core for d in self.allocatable.values()])
+        self.mp_manager = MultiProcessManager()
+        self.checkpoint = Checkpoint(f"{cfg.plugin_dir}/checkpoint.json")
+        if not self.checkpoint.load():
+            self.checkpoint.save()  # create-if-missing, device_state.go:94-125
+        # reconcile on-disk claim specs against the checkpoint: a crash
+        # between create_claim_spec and checkpoint.put leaves an orphan
+        for uid in self.cdi.list_claim_specs():
+            if uid not in self.checkpoint.prepared:
+                klog.warning("removing orphaned claim CDI spec", claim=uid)
+                self.cdi.delete_claim_spec(uid)
+
+    # -- public API --------------------------------------------------------
+    def prepare(self, claim: dict) -> list[PreparedDevice]:
+        """Prepare one ResourceClaim (device_state.go:128-170).
+
+        ``claim`` is the full ResourceClaim object; its
+        ``status.allocation.devices.results`` names the devices the scheduler
+        allocated from this node's pool.
+        """
+        with self._mu:
+            uid = claim["metadata"]["uid"]
+            existing = self.checkpoint.get(uid)
+            if existing is not None:   # idempotent no-op, :139-146
+                # /var/run/cdi is tmpfs: after a node reboot the checkpoint
+                # (persistent) can outlive the claim spec — regenerate it
+                if not os.path.exists(self.cdi.claim_spec_path(uid)):
+                    _, per_device_edits = self._prepare_devices(claim)
+                    self.cdi.create_claim_spec(uid, per_device_edits)
+                return existing.devices
+            devices, per_device_edits = self._prepare_devices(claim)
+            self.cdi.create_claim_spec(uid, per_device_edits)
+            prepared = PreparedClaim(
+                claim_uid=uid,
+                namespace=claim["metadata"].get("namespace", ""),
+                name=claim["metadata"].get("name", ""),
+                devices=devices)
+            self.checkpoint.put(prepared)
+            return devices
+
+    def unprepare(self, claim_uid: str) -> None:
+        """Unprepare by UID only — checkpoint state is authoritative so the
+        API server is never needed (device_state.go:172-207)."""
+        with self._mu:
+            existing = self.checkpoint.get(claim_uid)
+            if existing is None:       # absent ⇒ no-op, :181-189
+                klog.info("unprepare: no checkpoint entry; no-op", level=4,
+                          claim=claim_uid)
+                return
+            self.cdi.delete_claim_spec(claim_uid)
+            self.checkpoint.remove(claim_uid)
+
+    def prepared_claims(self) -> dict[str, PreparedClaim]:
+        with self._mu:
+            return dict(self.checkpoint.prepared)
+
+    # -- config mapping ----------------------------------------------------
+    def get_opaque_device_configs(self, claim: dict) -> list[DeviceConfigState]:
+        """Decode + order opaque configs (device_state.go:442-495).
+
+        Order encodes precedence: class configs first, claim configs later;
+        within a source, later entries win.  A sentinel default config is
+        appended FIRST so any unconfigured request falls back to exclusive
+        full-chip behavior.
+        """
+        alloc = claim.get("status", {}).get("allocation", {})
+        entries = alloc.get("devices", {}).get("config") or []
+        class_cfgs: list[DeviceConfigState] = []
+        claim_cfgs: list[DeviceConfigState] = []
+        for entry in entries:
+            opaque = entry.get("opaque")
+            if not opaque or opaque.get("driver") != self.cfg.driver_name:
+                continue
+            config = decode(opaque.get("parameters", {}))
+            state = DeviceConfigState(
+                config=config,
+                source=entry.get("source", CONFIG_SOURCE_CLAIM),
+                requests=list(entry.get("requests") or []))
+            if state.source == CONFIG_SOURCE_CLASS:
+                class_cfgs.append(state)
+            else:
+                claim_cfgs.append(state)
+        default = DeviceConfigState(config=TpuConfig(), source="Default",
+                                    requests=[])
+        return [default] + class_cfgs + claim_cfgs
+
+    def _config_for_result(self, configs: list[DeviceConfigState],
+                           result: dict) -> DeviceConfigState:
+        """Last matching config wins (empty requests = matches all)."""
+        chosen: Optional[DeviceConfigState] = None
+        for state in configs:
+            if not state.requests or result.get("request") in state.requests:
+                chosen = state
+        if chosen is None:
+            raise PrepareError(
+                f"no config matches request {result.get('request')!r}")
+        return chosen
+
+    # -- prepare internals -------------------------------------------------
+    def _prepare_devices(
+        self, claim: dict,
+    ) -> tuple[list[PreparedDevice], dict[str, ContainerEdits]]:
+        """device_state.go:209-351: map results→devices, check consistency,
+        apply per-config normalization/validation/sharing, and build both
+        the prepared-device records and the per-device claim CDI edits from
+        the SAME normalized config view."""
+        uid = claim["metadata"]["uid"]
+        alloc = claim.get("status", {}).get("allocation")
+        if not alloc:
+            raise PrepareError(f"claim {uid} has no allocation")
+        results = [r for r in alloc.get("devices", {}).get("results", [])
+                   if r.get("driver") == self.cfg.driver_name]
+        if not results:
+            raise PrepareError(
+                f"claim {uid}: no allocation results for driver "
+                f"{self.cfg.driver_name}")
+        configs = self.get_opaque_device_configs(claim)
+        for result in results:
+            state = self._config_for_result(configs, result)
+            state.results.append(result)
+
+        all_devices: list[AllocatableDevice] = []
+        prepared: list[PreparedDevice] = []
+        edits_out: dict[str, ContainerEdits] = {}
+        for state in configs:
+            if not state.results:
+                continue
+            config = state.config
+            config.normalize()
+            config.validate()
+            devices = [self._lookup(r) for r in state.results]
+            all_devices.extend(devices)
+            self._check_profile(config, devices)
+            edits = self._group_edits(config, devices)
+            for dev, result in zip(devices, state.results):
+                name = dev.canonical_name()
+                prepared.append(PreparedDevice(
+                    type=dev.type,
+                    uuid=dev.uuid,
+                    canonical_name=name,
+                    request_names=[result.get("request", "")],
+                    cdi_device_ids=[
+                        self.cdi.standard_device_id(name),
+                        self.cdi.claim_device_id(uid, name),
+                    ],
+                    parent_uuid=(dev.core.parent_uuid
+                                 if dev.core is not None else ""),
+                ))
+                edits_out[name] = edits
+        self._check_overlap(uid, all_devices)
+        return prepared, edits_out
+
+    def _group_edits(self, config, devices: list[AllocatableDevice]
+                     ) -> ContainerEdits:
+        """CDI edits for one config group (the normalized ``config``)."""
+        edits = ContainerEdits()
+        chips = [d for d in devices if d.type == TYPE_CHIP]
+        if chips:
+            edits.env.update(self.tpulib.visible_chips_env(
+                [d.chip for d in chips]))
+        cores = [d for d in devices if d.type == TYPE_CORE]
+        if cores:
+            parents = sorted({str(d.core.parent_index) for d in cores})
+            edits.env["TPU_VISIBLE_CHIPS"] = ",".join(parents)
+            edits.env["TPU_VISIBLE_CORES"] = ",".join(
+                f"{d.core.parent_index}:{d.core.core_index}" for d in cores)
+        sharing = getattr(config, "sharing", None)
+        if sharing is not None and sharing.is_multi_process():
+            edits = edits.merge(self.mp_manager.apply(sharing, devices))
+        if self.fabric_id:
+            edits.env["TPU_FABRIC_ID"] = self.fabric_id
+        return edits
+
+    def _lookup(self, result: dict) -> AllocatableDevice:
+        name = result.get("device", "")
+        dev = self.allocatable.get(name)
+        if dev is None:
+            raise PrepareError(
+                f"allocated device {name!r} is not on this node "
+                f"(allocatable: {sorted(self.allocatable)})")
+        return dev
+
+    def _check_overlap(self, uid: str,
+                       devices: list[AllocatableDevice]) -> None:
+        """A chip and one of its cores must never be prepared concurrently —
+        the node-side enforcement of the memorySlice overlap model
+        (deviceinfo.go:187-192).  Checked against already-checkpointed
+        claims AND within the claim being prepared."""
+        chips_in_use: set[str] = set()
+        cores_parent_in_use: set[str] = set()
+        for c in self.checkpoint.prepared.values():
+            for d in c.devices:
+                if d.type == TYPE_CHIP:
+                    chips_in_use.add(d.uuid)
+                else:
+                    cores_parent_in_use.add(d.parent_uuid)
+        seen: set[str] = set()
+        for dev in devices:
+            if dev.uuid in seen:
+                raise PrepareError(
+                    f"claim {uid}: device {dev.canonical_name()} allocated "
+                    f"twice in one claim")
+            seen.add(dev.uuid)
+            if dev.type == TYPE_CHIP:
+                if dev.uuid in cores_parent_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: chip {dev.uuid} has sub-slice cores "
+                        f"prepared by another claim")
+                chips_in_use.add(dev.uuid)
+            else:
+                parent = dev.core.parent_uuid
+                if parent in chips_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: parent chip {parent} is prepared as "
+                        f"a full chip (by another claim or this one)")
+                cores_parent_in_use.add(parent)
+
+    @staticmethod
+    def _check_profile(config, devices: list[AllocatableDevice]) -> None:
+        if isinstance(config, TpuSubSliceConfig):
+            bad = [d.canonical_name() for d in devices if d.type != TYPE_CORE]
+            if bad:
+                raise ConfigError(
+                    f"TpuSubSliceConfig applies to sub-chip cores; got {bad}")
+        elif isinstance(config, TpuConfig):
+            pass
+        else:
+            raise ConfigError(
+                f"config kind {type(config).__name__} is not valid for "
+                f"{DRIVER_NAME} devices")
+

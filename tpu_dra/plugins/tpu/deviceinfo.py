@@ -1,0 +1,93 @@
+"""Conversion of discovered chips/cores to ``resource.k8s.io`` Devices.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/deviceinfo.go:30-194``
+(``GpuInfo.GetDevice``/``MigDeviceInfo.GetDevice``): attributes describe the
+device for CEL selectors; capacities model consumable resources.  The
+reference's MIG placement-overlap trick — per-slice ``memorySlice<i>``
+capacities (deviceinfo.go:187-192) — is reused for sub-chip cores: a chip
+advertises all its HBM slices, each core advertises the slices it covers, so
+a scheduler modeling capacity cannot hand out a full chip and one of its
+cores at once.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from tpu_dra.api.quantity import format_quantity
+from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo
+
+
+def _attr_str(v: str) -> dict:
+    return {"string": v}
+
+
+def _attr_int(v: int) -> dict:
+    return {"int": int(v)}
+
+
+def _attr_bool(v: bool) -> dict:
+    return {"bool": bool(v)}
+
+
+def chip_device(chip: ChipInfo, fabric_id: str = "") -> dict:
+    """Full-chip Device — GpuInfo.GetDevice analog (deviceinfo.go:86-130)."""
+    attributes = {
+        "type": _attr_str("chip"),
+        "uuid": _attr_str(chip.uuid),
+        "index": _attr_int(chip.index),
+        "minor": _attr_int(chip.minor),
+        "family": _attr_str(chip.family.name),
+        "acceleratorType": _attr_str(chip.accelerator_type),
+        "topology": _attr_str(chip.topology),
+        "workerID": _attr_int(chip.worker_id),
+        "globalIndex": _attr_int(chip.global_index),
+        "coresPerChip": _attr_int(chip.family.cores_per_chip),
+        "multiHostCapable": _attr_bool(bool(fabric_id)),
+    }
+    for axis, coord in zip("xyz", chip.coords):
+        attributes[f"ici{axis.upper()}"] = _attr_int(coord)
+    if fabric_id:
+        attributes["fabricID"] = _attr_str(fabric_id)
+    capacity = {
+        "hbm": {"value": format_quantity(chip.family.hbm_bytes)},
+        "cores": {"value": str(chip.family.cores_per_chip)},
+    }
+    per_core = chip.family.hbm_bytes // chip.family.cores_per_chip
+    for i in range(chip.family.cores_per_chip):
+        capacity[f"memorySlice{i}"] = {"value": format_quantity(per_core)}
+    return {"name": chip.canonical_name(),
+            "basic": {"attributes": attributes, "capacity": capacity}}
+
+
+def core_device(core: CoreInfo, chip: ChipInfo, fabric_id: str = "") -> dict:
+    """Sub-chip core Device — MigDeviceInfo.GetDevice analog
+    (deviceinfo.go:132-194).  ``parentUUID`` supports the
+    ``matchAttribute: parentUUID`` constraint pattern (gpu-test4 analog)."""
+    attributes = {
+        "type": _attr_str("core"),
+        "uuid": _attr_str(core.uuid),
+        "parentUUID": _attr_str(core.parent_uuid),
+        "parentIndex": _attr_int(core.parent_index),
+        "coreIndex": _attr_int(core.core_index),
+        "profile": _attr_str(core.profile),
+        "family": _attr_str(chip.family.name),
+        "acceleratorType": _attr_str(chip.accelerator_type),
+        "topology": _attr_str(chip.topology),
+        "workerID": _attr_int(chip.worker_id),
+        "multiHostCapable": _attr_bool(bool(fabric_id)),
+    }
+    if fabric_id:
+        attributes["fabricID"] = _attr_str(fabric_id)
+    capacity = {
+        "hbm": {"value": format_quantity(core.hbm_bytes)},
+        "cores": {"value": "1"},
+    }
+    for i in core.memory_slices:
+        capacity[f"memorySlice{i}"] = {"value":
+                                       format_quantity(core.hbm_bytes)}
+    return {"name": core.canonical_name(),
+            "basic": {"attributes": attributes, "capacity": capacity}}
+
+
+AllocatableInfo = Union[ChipInfo, CoreInfo]

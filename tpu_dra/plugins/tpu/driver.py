@@ -1,0 +1,130 @@
+"""DRA driver shim for the TPU kubelet plugin.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/driver.go:39-153``: registers
+with the kubelet, publishes one ResourceSlice pool named after the node, and
+fans Prepare/Unprepare to :class:`DeviceState` under a node-global flock
+(multiple driver pods on one node must serialize, flock rationale
+pkg/flock/flock.go:66-69; lock file ``pu.lock`` in the plugin dir,
+driver.go:37).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tpu_dra.k8s.client import KubeClient
+from tpu_dra.kubeletplugin import (
+    ClaimRef,
+    DriverCallbacks,
+    KubeletPluginServer,
+    PrepareResult,
+)
+from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP
+from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+from tpu_dra.plugins.tpu.deviceinfo import chip_device, core_device
+from tpu_dra.tpulib.discovery import TpuLib
+from tpu_dra.util import klog
+from tpu_dra.util.flock import locked
+from tpu_dra.version import DRIVER_NAME
+
+
+@dataclass
+class TpuDriverConfig:
+    node_name: str
+    tpulib: TpuLib
+    kube: KubeClient
+    plugins_dir: str = "/var/lib/kubelet/plugins"
+    registry_dir: str = "/var/lib/kubelet/plugins_registry"
+    cdi_root: str = "/var/run/cdi"
+    driver_root: str = "/"
+    enable_subslices: bool = True
+    flock_timeout: float = 10.0   # driver.go:121 uses 10s
+
+
+class TpuDriver:
+    def __init__(self, cfg: TpuDriverConfig) -> None:
+        self.cfg = cfg
+        self.plugin_dir = os.path.join(cfg.plugins_dir, DRIVER_NAME)
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        self.flock_path = os.path.join(self.plugin_dir, "pu.lock")
+        self.state = DeviceState(DeviceStateConfig(
+            tpulib=cfg.tpulib,
+            plugin_dir=self.plugin_dir,
+            cdi_root=cfg.cdi_root,
+            driver_root=cfg.driver_root,
+            enable_subslices=cfg.enable_subslices))
+        self.server = KubeletPluginServer(
+            driver_name=DRIVER_NAME,
+            node_name=cfg.node_name,
+            kube=cfg.kube,
+            plugins_dir=cfg.plugins_dir,
+            registry_dir=cfg.registry_dir,
+            callbacks=DriverCallbacks(
+                prepare=self.prepare_resource_claims,
+                unprepare=self.unprepare_resource_claims))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.server.start()
+        self.publish_resources()
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def publish_resources(self) -> None:
+        """driver.go:71-84 — advertise chips (and cores when sub-slicing)."""
+        devices = []
+        fabric = self.state.fabric_id
+        for dev in self.state.allocatable.values():
+            if dev.type == TYPE_CHIP:
+                devices.append(chip_device(dev.chip, fabric))
+            else:
+                parent = next(
+                    d.chip for d in self.state.allocatable.values()
+                    if d.chip is not None and
+                    d.chip.uuid == dev.core.parent_uuid)
+                devices.append(core_device(dev.core, parent, fabric))
+        self.server.publish_resources(devices)
+
+    # -- DRA callbacks -----------------------------------------------------
+    def prepare_resource_claims(self, claims: list[dict]
+                                ) -> dict[str, PrepareResult]:
+        """driver.go:97-118 — per-claim fan-out; errors are per-claim."""
+        results: dict[str, PrepareResult] = {}
+        for claim in claims:
+            uid = claim["metadata"]["uid"]
+            try:
+                results[uid] = self._node_prepare(claim)
+            except Exception as exc:  # noqa: BLE001 — reported per claim
+                klog.error("prepare failed", claim=uid, err=repr(exc))
+                results[uid] = PrepareResult(
+                    error=f"error preparing claim {uid}: {exc}")
+        return results
+
+    def _node_prepare(self, claim: dict) -> PrepareResult:
+        with locked(self.flock_path, timeout=self.cfg.flock_timeout):
+            devices = self.state.prepare(claim)
+        return PrepareResult(devices=[
+            {
+                "request_names": d.request_names,
+                "pool_name": self.cfg.node_name,
+                "device_name": d.canonical_name,
+                "cdi_device_ids": d.cdi_device_ids,
+            }
+            for d in devices
+        ])
+
+    def unprepare_resource_claims(self, refs: list[ClaimRef]
+                                  ) -> dict[str, str]:
+        """driver.go:108-153."""
+        errors: dict[str, str] = {}
+        for ref in refs:
+            try:
+                with locked(self.flock_path,
+                            timeout=self.cfg.flock_timeout):
+                    self.state.unprepare(ref.uid)
+            except Exception as exc:  # noqa: BLE001 — reported per claim
+                klog.error("unprepare failed", claim=ref.uid, err=repr(exc))
+                errors[ref.uid] = f"error unpreparing claim {ref.uid}: {exc}"
+        return errors

@@ -1,0 +1,64 @@
+"""tpu-kubelet-plugin entry point.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/main.go:41-242``: flag parsing
+(with env aliases), client construction, driver startup, and signal-driven
+shutdown.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+from tpu_dra.k8s.client import new_clients
+from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+from tpu_dra.tpulib.discovery import RealTpuLib
+from tpu_dra.util import flags, klog
+from tpu_dra.util.flags import Flag, FlagGroup
+
+
+def plugin_flags() -> FlagGroup:
+    return FlagGroup("TPU plugin", [
+        Flag("enable-subslices", "ENABLE_SUBSLICES",
+             "advertise per-core sub-chip devices", True, bool),
+        Flag("ignore-host-tpu-env", "IGNORE_HOST_TPU_ENV",
+             "discover topology only from the node metadata file, ignoring "
+             "TPU_* variables in the plugin's own environment", False, bool),
+    ])
+
+
+def main(argv=None) -> int:
+    args = flags.parse(
+        "tpu-kubelet-plugin",
+        [flags.plugin_common_flags(), plugin_flags(),
+         flags.kube_client_flags(), flags.logging_flags()],
+        argv,
+        description=__doc__)
+    klog.configure(args.v, args.logging_format)
+    kube = new_clients(args.kubeconfig, args.kube_api_qps,
+                       args.kube_api_burst)
+    driver = TpuDriver(TpuDriverConfig(
+        node_name=args.node_name,
+        tpulib=RealTpuLib(driver_root=args.tpu_driver_root,
+                          env={} if args.ignore_host_tpu_env else None),
+        kube=kube,
+        plugins_dir=args.kubelet_plugins_dir,
+        registry_dir=args.kubelet_registry_dir,
+        cdi_root=args.cdi_root,
+        driver_root=args.tpu_driver_root,
+        enable_subslices=args.enable_subslices))
+    driver.start()
+    klog.info("tpu-kubelet-plugin started", node=args.node_name)
+
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    klog.info("shutting down")
+    driver.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

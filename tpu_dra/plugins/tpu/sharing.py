@@ -1,0 +1,61 @@
+"""Chip-sharing policies.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/sharing.go``.  The reference has
+two managers: TimeSlicing (exec nvidia-smi, sharing.go:98-123) and MPS (a
+spawned control-daemon Deployment, sharing.go:186-444).  On TPU neither
+mechanism exists — multi-process sharing is env/flag mechanics against libtpu
+(SURVEY.md §7.3: "prefer env/flag mechanics; no MPS-daemon-style sidecar
+should be needed"), so the manager here only computes container edits; there
+is no sidecar lifecycle to supervise.
+
+Driver env contract emitted for MultiProcess claims:
+
+- ``TPU_ALLOW_MULTIPLE_LIBTPU_LOAD=1`` — allow several processes to load
+  libtpu against the same chip set.
+- ``TPU_MULTIPROCESS_MAX=<n>`` — advisory process cap (maxProcesses).
+- ``TPU_HBM_LIMIT_BYTES_<minor>=<bytes>`` — per-chip HBM budget each process
+  must respect (JAX: wired through ``TPU_PREMAPPED_BUFFER_SIZE`` /
+  ``XLA_TPU_MAX_HBM`` shims by the workload launcher); the analog of MPS
+  pinned-device-memory limits (sharing.go:190-273).
+"""
+
+from __future__ import annotations
+
+from tpu_dra.api.configs import ConfigError, TpuSharing
+from tpu_dra.cdi.spec import ContainerEdits
+from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP, AllocatableDevice
+
+
+class MultiProcessManager:
+    """Computes MultiProcess sharing edits — the MpsManager analog
+    (sharing.go:52-56,125-156) minus daemon lifecycle."""
+
+    def apply(self, sharing: TpuSharing,
+              devices: list[AllocatableDevice]) -> ContainerEdits:
+        """Validate applicability and return the sharing env edits.
+
+        Full chips only, mirroring TimeSlicing's full-GPU-only rule
+        (sharing.go:98-123): sub-chip cores are already the finest honest
+        partition on TPU.
+        """
+        non_chips = [d.canonical_name() for d in devices
+                     if d.type != TYPE_CHIP]
+        if non_chips:
+            raise ConfigError(
+                f"MultiProcess sharing applies to full chips only; "
+                f"got sub-chip device(s) {non_chips}")
+        mp = sharing.multi_process
+        edits = ContainerEdits(env={"TPU_ALLOW_MULTIPLE_LIBTPU_LOAD": "1"})
+        if mp is None:
+            return edits
+        if mp.max_processes is not None:
+            edits.env["TPU_MULTIPROCESS_MAX"] = str(mp.max_processes)
+        if mp.hbm_limit_per_process:
+            uuids = [d.uuid for d in devices]
+            indices = {d.uuid: d.chip.index for d in devices}
+            limits = mp.normalized_limits(uuids, indices)
+            minor_of = {d.uuid: d.chip.minor for d in devices}
+            for uuid, limit in sorted(limits.items()):
+                edits.env[f"TPU_HBM_LIMIT_BYTES_{minor_of[uuid]}"] = \
+                    str(limit)
+        return edits

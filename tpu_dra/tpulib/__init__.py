@@ -1,0 +1,24 @@
+"""TPU chip, core, and fabric discovery.
+
+Analog of the reference's ``deviceLib`` over NVML/go-nvlib
+(``cmd/gpu-kubelet-plugin/nvlib.go:32-368``) and the fabric/clique probe of the
+compute-domain plugin (``cmd/compute-domain-kubelet-plugin/nvlib.go:164-222``).
+Everything is reached through the :class:`tpu_dra.tpulib.discovery.TpuLib`
+interface so the plugins are unit-testable against
+:class:`tpu_dra.tpulib.fake.FakeTpuLib` (the seam the reference leaves at
+nvlib.go:32-38; SURVEY.md §4 calls this out as the must-have test surface).
+"""
+
+from tpu_dra.tpulib.discovery import (  # noqa: F401
+    ChipInfo,
+    CoreInfo,
+    RealTpuLib,
+    TpuLib,
+)
+from tpu_dra.tpulib.fake import FakeTpuLib  # noqa: F401
+from tpu_dra.tpulib.topology import (  # noqa: F401
+    TpuFamily,
+    FAMILIES,
+    parse_topology,
+    chip_coords,
+)

@@ -1,0 +1,242 @@
+"""Chip/core/fabric discovery.
+
+Analog of reference ``cmd/gpu-kubelet-plugin/nvlib.go``:
+
+- ``enumerate_chips``    ↔ ``enumerateGpusAndMigDevices`` (nvlib.go:117-154)
+- ``ChipInfo``           ↔ ``GpuInfo`` (deviceinfo.go:30-64)
+- ``CoreInfo``           ↔ ``MigDeviceInfo`` (deviceinfo.go:70-130) — the
+  sub-chip (per-TensorCore) allocation unit
+- ``fabric_id``          ↔ cliqueID = clusterUUID.cliqueId
+  (CD nvlib.go:164-222): identifies the ICI partition this host's chips
+  belong to; only same-fabric hosts are ICI-reachable.
+
+Discovery sources, in order: explicit env (GKE injects ``TPU_*`` vars and a
+``tpu-env`` metadata blob onto TPU node pools), then ``/dev`` scanning for
+accel character devices.  There is no NVML-style dynamic query surface on
+TPU (SURVEY.md §7 phase 2 calls this out) — per-family constants come from
+:mod:`tpu_dra.tpulib.topology`.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_dra.tpulib import native
+from tpu_dra.tpulib.topology import (
+    TpuFamily,
+    chip_coords,
+    family_for_accelerator_type,
+    parse_topology,
+)
+
+# Namespace for stable chip UUIDs: uuid5(host machine id, accel path).
+_UUID_NS = uuidlib.UUID("6ba7b812-9dad-11d1-80b4-00c04fd430c8")
+
+
+@dataclass
+class CoreInfo:
+    """One TensorCore of a chip — the sub-slice allocation unit."""
+
+    parent_uuid: str
+    parent_index: int
+    core_index: int           # within the chip
+    profile: str              # "1c"
+    hbm_bytes: int
+    memory_slices: tuple[int, ...]  # which HBM slices of the parent it covers
+    device_paths: list[str] = field(default_factory=list)  # parent's nodes
+
+    @property
+    def uuid(self) -> str:
+        return f"{self.parent_uuid}-core-{self.core_index}"
+
+    def canonical_name(self) -> str:
+        return f"tpu-{self.parent_index}-core-{self.core_index}"
+
+
+@dataclass
+class ChipInfo:
+    """One TPU chip and its place in the ICI mesh."""
+
+    uuid: str
+    index: int                # node-local index
+    minor: int                # /dev/accelN minor / N
+    device_paths: list[str]   # char devices to inject
+    family: TpuFamily
+    accelerator_type: str     # e.g. "v5litepod-16"
+    topology: str             # e.g. "4x4" (the full slice topology)
+    worker_id: int            # this host's worker number within the slice
+    global_index: int         # chip index within the whole slice
+    coords: tuple[int, ...]   # ICI mesh coordinates
+
+    def cores(self) -> list[CoreInfo]:
+        n = self.family.cores_per_chip
+        per_core = self.family.hbm_bytes // n
+        return [
+            CoreInfo(parent_uuid=self.uuid, parent_index=self.index,
+                     core_index=c, profile="1c", hbm_bytes=per_core,
+                     memory_slices=(c,),
+                     device_paths=list(self.device_paths))
+            for c in range(n)
+        ]
+
+    def canonical_name(self) -> str:
+        return f"tpu-{self.index}"
+
+
+class TpuLib:
+    """Interface the plugins program against (seam for FakeTpuLib)."""
+
+    def enumerate_chips(self) -> list[ChipInfo]:
+        raise NotImplementedError
+
+    def fabric_id(self) -> str:
+        """``<slice-uuid>.<partition>`` or "" when not part of a multi-host
+        slice (the reference returns "" for non-MNNVL GPUs,
+        CD nvlib.go:206-213)."""
+        raise NotImplementedError
+
+    def worker_id(self) -> int:
+        raise NotImplementedError
+
+    def worker_hostnames(self) -> list[str]:
+        raise NotImplementedError
+
+    # -- device node management (L0; delegated to the native lib) ---------
+    def create_device_node(self, path: str, major: int, minor: int) -> None:
+        native.mknod_char(path, major, minor)
+
+    def visible_chips_env(self, chips: list[ChipInfo]) -> dict[str, str]:
+        """Environment that scopes libtpu to the allocated chips — the analog
+        of CDI's NVIDIA_VISIBLE_DEVICES edit (cdi.go:190-196)."""
+        ids = ",".join(str(c.minor) for c in chips)
+        return {
+            "TPU_VISIBLE_CHIPS": ids,
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": f"1,1,{len(chips)}",
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+        }
+
+
+_TPU_ENV_RE = re.compile(r"^\s*([A-Z0-9_]+)\s*:\s*'?([^'\n]*)'?\s*$",
+                         re.MULTILINE)
+
+
+def parse_tpu_env_blob(blob: str) -> dict[str, str]:
+    """Parse the GKE ``tpu-env`` metadata blob (``KEY: 'value'`` lines)."""
+    return {k: v for k, v in _TPU_ENV_RE.findall(blob)}
+
+
+@dataclass
+class RealTpuLib(TpuLib):
+    """Discovery against the real node surface.
+
+    ``driver_root`` mirrors the reference's ``--nvidia-driver-root``
+    (gpu root.go:27-81): device paths and metadata files are resolved under
+    it so the plugin works both on-host and containerized.
+    """
+
+    driver_root: str = "/"
+    env: Optional[dict[str, str]] = None  # None → process environment
+    tpu_env_path: str = "/var/lib/tpu/tpu-env"  # optional metadata dump
+
+    def __post_init__(self) -> None:
+        if self.env is None:
+            self.env = dict(os.environ)
+        self._meta: Optional[dict[str, str]] = None
+
+    # -- metadata ----------------------------------------------------------
+    def _metadata(self) -> dict[str, str]:
+        if self._meta is not None:
+            return self._meta
+        meta: dict[str, str] = {}
+        path = os.path.join(self.driver_root,
+                            self.tpu_env_path.lstrip("/"))
+        if os.path.exists(path):
+            with open(path) as f:
+                meta.update(parse_tpu_env_blob(f.read()))
+        # explicit env wins over the metadata file
+        for key in ("TPU_ACCELERATOR_TYPE", "TPU_TOPOLOGY", "TPU_WORKER_ID",
+                    "TPU_WORKER_HOSTNAMES", "TPU_SLICE_NAME",
+                    "TPU_SKIP_MDS_QUERY"):
+            if key in self.env:
+                meta[key] = self.env[key]
+        meta.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+        meta.setdefault("TPU_WORKER_ID", "0")
+        self._meta = meta
+        return meta
+
+    def _machine_id(self) -> str:
+        for p in ("etc/machine-id", "var/lib/dbus/machine-id"):
+            path = os.path.join(self.driver_root, p)
+            if os.path.exists(path):
+                with open(path) as f:
+                    return f.read().strip()
+        return "unknown-machine"
+
+    # -- TpuLib ------------------------------------------------------------
+    def device_paths(self) -> list[str]:
+        """Scan for TPU char devices (``/dev/accel*`` on PCI DIRECT,
+        ``/dev/vfio/*`` on newer stacks)."""
+        root = self.driver_root.rstrip("/")
+        def numeric(p: str) -> int:
+            m = re.search(r"(\d+)$", p)
+            return int(m.group(1)) if m else 0
+
+        paths = sorted(glob.glob(f"{root}/dev/accel[0-9]*"), key=numeric)
+        if not paths:
+            paths = sorted(glob.glob(f"{root}/dev/vfio/[0-9]*"), key=numeric)
+        return paths
+
+    def enumerate_chips(self) -> list[ChipInfo]:
+        meta = self._metadata()
+        accel_type = meta["TPU_ACCELERATOR_TYPE"]
+        family = family_for_accelerator_type(accel_type)
+        topology = meta.get("TPU_TOPOLOGY", "")
+        if not topology:
+            # single-host default: all local chips in one line
+            n = len(self.device_paths()) or 1
+            topology = f"{n}x1"
+        shape = parse_topology(topology)
+        worker = int(meta.get("TPU_WORKER_ID", "0"))
+        machine = self._machine_id()
+        chips: list[ChipInfo] = []
+        paths = self.device_paths()
+        for i, path in enumerate(paths):
+            m = re.search(r"(\d+)$", path)
+            minor = int(m.group(1)) if m else i
+            global_index = worker * family.chips_per_host + i
+            chips.append(ChipInfo(
+                uuid=f"tpu-{uuidlib.uuid5(_UUID_NS, f'{machine}:{path}')}",
+                index=i,
+                minor=minor,
+                device_paths=[path.replace(self.driver_root.rstrip('/'), '', 1)
+                              or path],
+                family=family,
+                accelerator_type=accel_type,
+                topology=topology,
+                worker_id=worker,
+                global_index=global_index,
+                coords=chip_coords(global_index, shape),
+            ))
+        return chips
+
+    def fabric_id(self) -> str:
+        meta = self._metadata()
+        hostnames = meta.get("TPU_WORKER_HOSTNAMES", "")
+        if not hostnames or len(hostnames.split(",")) <= 1:
+            return ""  # single-host: not multi-host-ICI capable
+        slice_name = meta.get("TPU_SLICE_NAME") or hostnames
+        slice_uuid = uuidlib.uuid5(_UUID_NS, slice_name)
+        # partition 0: GKE slices are a single ICI partition today
+        return f"{slice_uuid}.0"
+
+    def worker_id(self) -> int:
+        return int(self._metadata().get("TPU_WORKER_ID", "0"))
+
+    def worker_hostnames(self) -> list[str]:
+        raw = self._metadata().get("TPU_WORKER_HOSTNAMES", "")
+        return [h for h in raw.split(",") if h]

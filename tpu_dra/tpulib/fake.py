@@ -1,0 +1,63 @@
+"""Fake TpuLib for tests.
+
+The test seam SURVEY.md §4 mandates: the reference's ``deviceLib`` wraps all
+NVML access behind one struct (gpu nvlib.go:32-38) but ships no fake; we
+exceed that with a configurable fake so every Prepare/Unprepare path is
+unit-testable without TPU hardware.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dra.tpulib.discovery import ChipInfo, TpuLib
+from tpu_dra.tpulib.topology import FAMILIES, chip_coords, parse_topology
+
+
+@dataclass
+class FakeTpuLib(TpuLib):
+    family_name: str = "v5e"
+    accelerator_type: str = "v5litepod-16"
+    topology: str = "4x4"
+    chips_on_node: int = 4
+    worker: int = 0
+    hostnames: list[str] = field(default_factory=lambda: [
+        "w-0.slice.local", "w-1.slice.local",
+        "w-2.slice.local", "w-3.slice.local"])
+    slice_uuid: str = "11111111-2222-3333-4444-555555555555"
+    created_nodes: list[tuple[str, int, int]] = field(default_factory=list)
+
+    def enumerate_chips(self) -> list[ChipInfo]:
+        family = FAMILIES[self.family_name]
+        shape = parse_topology(self.topology)
+        chips = []
+        for i in range(self.chips_on_node):
+            gidx = self.worker * family.chips_per_host + i
+            chips.append(ChipInfo(
+                uuid=f"tpu-00000000-0000-0000-0000-{self.worker:04d}0000"
+                     f"{i:04d}",
+                index=i,
+                minor=i,
+                device_paths=[f"/dev/accel{i}"],
+                family=family,
+                accelerator_type=self.accelerator_type,
+                topology=self.topology,
+                worker_id=self.worker,
+                global_index=gidx,
+                coords=chip_coords(gidx, shape),
+            ))
+        return chips
+
+    def fabric_id(self) -> str:
+        if len(self.hostnames) <= 1:
+            return ""
+        return f"{self.slice_uuid}.0"
+
+    def worker_id(self) -> int:
+        return self.worker
+
+    def worker_hostnames(self) -> list[str]:
+        return list(self.hostnames)
+
+    def create_device_node(self, path: str, major: int, minor: int) -> None:
+        self.created_nodes.append((path, major, minor))

@@ -1,0 +1,144 @@
+"""ctypes bindings to the native L0 library (``libtpudra.so``).
+
+The reference's L0 surface is cgo + syscalls: mknod of IMEX channel devices
+(CD nvlib.go:317-376), ``/proc/devices`` parsing (CD nvlib.go:274-315), and
+recursive unmounts (CD nvlib.go:378-420).  Here those live in C++
+(``native/tpudra.cpp``) loaded via ctypes; every entry point has a pure-Python
+fallback so tests and non-Linux dev hosts work without the compiled library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import stat
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    candidates = [
+        os.environ.get("TPUDRA_NATIVE_LIB", ""),
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "native", "libtpudra.so"),
+        "libtpudra.so",
+    ]
+    for cand in candidates:
+        if not cand:
+            continue
+        try:
+            lib = ctypes.CDLL(cand)
+        except OSError:
+            continue
+        lib.tpudra_mknod_char.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.tpudra_mknod_char.restype = ctypes.c_int
+        lib.tpudra_device_major.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.tpudra_device_major.restype = ctypes.c_int
+        lib.tpudra_unmount_recursive.argtypes = [ctypes.c_char_p]
+        lib.tpudra_unmount_recursive.restype = ctypes.c_int
+        lib.tpudra_scan_accel_devices.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+        lib.tpudra_scan_accel_devices.restype = ctypes.c_int
+        lib.tpudra_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.tpudra_crc32c.restype = ctypes.c_uint32
+        _LIB = lib
+        return lib
+    return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def mknod_char(path: str, major: int, minor: int) -> None:
+    """Create a character device node — analog of
+    ``createComputeDomainChannelDevice`` (CD nvlib.go:317-346).  Idempotent:
+    an existing node with the right rdev is left alone."""
+    if os.path.exists(path):
+        st = os.stat(path)
+        if stat.S_ISCHR(st.st_mode) and \
+                os.major(st.st_rdev) == major and \
+                os.minor(st.st_rdev) == minor:
+            return
+        os.unlink(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    lib = _load()
+    if lib is not None:
+        rc = lib.tpudra_mknod_char(path.encode(), major, minor)
+        if rc != 0:
+            raise OSError(-rc, f"tpudra_mknod_char({path})")
+        return
+    os.mknod(path, 0o666 | stat.S_IFCHR, os.makedev(major, minor))
+
+
+def device_major(name: str, proc_devices: str = "/proc/devices") -> int:
+    """Find a char-device major by driver name — analog of ``getDeviceMajor``
+    parsing /proc/devices (CD nvlib.go:274-315).  Returns -1 if absent."""
+    lib = _load()
+    if lib is not None:
+        return lib.tpudra_device_major(proc_devices.encode(), name.encode())
+    try:
+        with open(proc_devices) as f:
+            in_char = False
+            for line in f:
+                line = line.strip()
+                if line == "Character devices:":
+                    in_char = True
+                    continue
+                if line == "Block devices:":
+                    in_char = False
+                    continue
+                if in_char and line:
+                    parts = line.split()
+                    if len(parts) == 2 and parts[1] == name:
+                        return int(parts[0])
+    except FileNotFoundError:
+        pass
+    return -1
+
+
+def unmount_recursive(path: str) -> None:
+    """Unmount everything at/under ``path`` — analog of
+    ``unmountRecursively`` (CD nvlib.go:378-420)."""
+    lib = _load()
+    if lib is not None:
+        lib.tpudra_unmount_recursive(path.encode())
+        return
+    # Python fallback: parse /proc/self/mounts deepest-first
+    try:
+        with open("/proc/self/mounts") as f:
+            mounts = [ln.split()[1] for ln in f if len(ln.split()) > 1]
+    except FileNotFoundError:
+        return
+    import ctypes.util
+    libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                       use_errno=True)
+    prefix = path.rstrip("/")
+    for m in sorted((m for m in mounts
+                     if m == prefix or m.startswith(prefix + "/")),
+                    key=len, reverse=True):
+        libc.umount2(m.encode(), 0)
+
+
+def crc32c(data: bytes) -> int:
+    """CRC32-C (Castagnoli) — the checkpoint checksum (the reference uses
+    kubelet's checkpointmanager checksum, gpu checkpoint.go:39-47)."""
+    lib = _load()
+    if lib is not None:
+        return lib.tpudra_crc32c(data, len(data))
+    # Python fallback (bitwise, slow but only used without the native lib)
+    poly = 0x82F63B78
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc ^= b
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+    return crc ^ 0xFFFFFFFF

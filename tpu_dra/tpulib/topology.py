@@ -1,0 +1,81 @@
+"""TPU family tables and ICI topology math.
+
+The reference gets device attributes dynamically from NVML
+(``getGpuInfo``, gpu nvlib.go:156-267).  TPUs expose no NVML equivalent: the
+accelerator family fixes per-chip facts (cores, HBM), and the slice topology
+comes from runtime metadata (GKE ``tpu-env``/env vars).  These tables encode
+the public per-family data sheet; topology strings like ``"4x4"``/``"2x2x2"``
+are parsed into ICI mesh shapes and per-chip mesh coordinates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TpuFamily:
+    name: str                 # "v4", "v5e", "v5p", "v6e"
+    cores_per_chip: int
+    hbm_bytes: int            # per chip
+    chips_per_host: int       # default chips per worker/host VM
+    ici_dims: int             # 2 = 2D torus families (v5e/v6e), 3 = 3D (v4/v5p)
+
+
+FAMILIES: dict[str, TpuFamily] = {
+    "v4":  TpuFamily("v4",  2, 32 * 2**30, 4, 3),
+    "v5e": TpuFamily("v5e", 1, 16 * 2**30, 4, 2),
+    "v5p": TpuFamily("v5p", 2, 95 * 2**30, 4, 3),
+    "v6e": TpuFamily("v6e", 1, 32 * 2**30, 4, 2),
+}
+
+# accelerator-type prefix -> family name (GKE metadata `accelerator-type`
+# values look like "v5litepod-16", "v4-8", "v5p-128", "v6e-16")
+_TYPE_PREFIXES = {
+    "v5litepod": "v5e",
+    "v5e": "v5e",
+    "v4": "v4",
+    "v5p": "v5p",
+    "v6e": "v6e",
+}
+
+
+def family_for_accelerator_type(accel_type: str) -> TpuFamily:
+    prefix = accel_type.split("-", 1)[0]
+    name = _TYPE_PREFIXES.get(prefix)
+    if name is None:
+        raise ValueError(f"unknown accelerator type {accel_type!r}")
+    return FAMILIES[name]
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """``"4x4"`` → (4, 4); ``"2x2x2"`` → (2, 2, 2)."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError as exc:
+        raise ValueError(f"malformed topology {topology!r}") from exc
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"malformed topology {topology!r}")
+    return dims
+
+
+def chip_coords(global_index: int, shape: tuple[int, ...]) -> tuple[int, ...]:
+    """Mesh coordinates of a chip, row-major over the topology shape.
+
+    This is the attribute surface schedulers use to co-locate claims on
+    ICI-adjacent chips (the analog of the reference's MIG placement model,
+    deviceinfo.go:132-194 — there overlap is over memory slices, here
+    adjacency is over the ICI mesh).
+    """
+    coords = []
+    for dim in reversed(shape):
+        coords.append(global_index % dim)
+        global_index //= dim
+    return tuple(reversed(coords))
+
+
+def num_chips(shape: tuple[int, ...]) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n

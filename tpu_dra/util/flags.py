@@ -1,0 +1,111 @@
+"""Reusable CLI flag groups with environment-variable aliases.
+
+Analog of reference ``pkg/flags`` (kubeclient.go:32-115, logging.go:33-88) and
+the urfave/cli pattern used by every binary (e.g.
+``cmd/gpu-kubelet-plugin/main.go:66-161``): each flag has an env alias, and
+flag groups compose (kube client group, logging group, per-binary groups).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+def _env_default(env: str, default: Any) -> Any:
+    return os.environ.get(env, default)
+
+
+@dataclass
+class Flag:
+    name: str                      # e.g. "node-name"
+    env: str                       # e.g. "NODE_NAME"
+    help: str = ""
+    default: Any = None
+    type: type = str
+    required: bool = False
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        default = _env_default(self.env, self.default)
+        if self.type is bool:
+            val = default
+            if isinstance(val, str):
+                val = val.lower() in ("1", "true", "yes", "on")
+            parser.add_argument(f"--{self.name}",
+                                action=argparse.BooleanOptionalAction,
+                                default=val, help=f"{self.help} [${self.env}]")
+            return
+        if default is not None and self.type is not str:
+            default = self.type(default)
+        parser.add_argument(f"--{self.name}", type=self.type, default=default,
+                            required=self.required and default is None,
+                            help=f"{self.help} [${self.env}]")
+
+
+@dataclass
+class FlagGroup:
+    title: str
+    flags: list[Flag] = field(default_factory=list)
+
+    def add_to(self, parser: argparse.ArgumentParser) -> None:
+        group = parser.add_argument_group(self.title)
+        for f in self.flags:
+            f.add_to(group)  # type: ignore[arg-type]
+
+
+def kube_client_flags() -> FlagGroup:
+    """Kube client flag group — reference pkg/flags/kubeclient.go:43-71."""
+    return FlagGroup("Kubernetes client", [
+        Flag("kubeconfig", "KUBECONFIG",
+             "absolute path to a kubeconfig file (empty = in-cluster)"),
+        Flag("kube-api-qps", "KUBE_API_QPS",
+             "client QPS against the API server", 50.0, float),
+        Flag("kube-api-burst", "KUBE_API_BURST",
+             "client burst against the API server", 100, int),
+    ])
+
+
+def logging_flags() -> FlagGroup:
+    """Logging flag group — reference pkg/flags/logging.go:57-77."""
+    return FlagGroup("Logging", [
+        Flag("v", "VERBOSITY", "log verbosity level", 2, int),
+        Flag("logging-format", "LOG_FORMAT", "log format: text or json",
+             "text"),
+    ])
+
+
+def plugin_common_flags() -> FlagGroup:
+    """Flags shared by both kubelet plugins — reference
+    cmd/gpu-kubelet-plugin/main.go:66-161."""
+    return FlagGroup("Kubelet plugin", [
+        Flag("node-name", "NODE_NAME", "node this plugin runs on",
+             required=True),
+        Flag("namespace", "NAMESPACE", "driver namespace", "tpu-dra-driver"),
+        Flag("cdi-root", "CDI_ROOT", "directory for CDI spec files",
+             "/var/run/cdi"),
+        Flag("kubelet-plugins-dir", "KUBELET_PLUGINS_DIR",
+             "kubelet plugins directory", "/var/lib/kubelet/plugins"),
+        Flag("kubelet-registry-dir", "KUBELET_REGISTRY_DIR",
+             "kubelet plugin registration socket directory",
+             "/var/lib/kubelet/plugins_registry"),
+        Flag("tpu-driver-root", "TPU_DRIVER_ROOT",
+             "host root under which libtpu/device files are found", "/"),
+        Flag("image-name", "IMAGE_NAME", "driver image (for spawned pods)",
+             "tpu-dra-driver:latest"),
+    ])
+
+
+def build_parser(prog: str, groups: Sequence[FlagGroup],
+                 description: str = "") -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog=prog, description=description)
+    for g in groups:
+        g.add_to(parser)
+    return parser
+
+
+def parse(prog: str, groups: Sequence[FlagGroup],
+          argv: Optional[Sequence[str]] = None,
+          description: str = "") -> argparse.Namespace:
+    return build_parser(prog, groups, description).parse_args(argv)

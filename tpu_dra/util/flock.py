@@ -1,0 +1,95 @@
+"""Polling advisory file locks.
+
+Analog of reference ``pkg/flock/flock.go:27-133``: multiple driver pods (or a
+driver pod and its own restarted predecessor) on one node must serialize
+prepare/unprepare against shared node state (checkpoint files, CDI specs,
+device nodes) — rationale at flock.go:66-69.  The reference polls
+``flock(LOCK_EX|LOCK_NB)`` with a timeout and a poll interval; we do the same
+with :mod:`fcntl`.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+
+class FlockTimeout(TimeoutError):
+    """Raised when the lock cannot be acquired within the timeout."""
+
+
+@dataclass
+class Flock:
+    """An exclusive advisory lock on a lock file.
+
+    The lock is tied to the file descriptor: releasing closes the fd (reference
+    flock.go releases on fd close).
+    """
+
+    path: str
+    timeout: float = 10.0          # reference driver.go:121 uses 10s
+    poll_interval: float = 0.01
+
+    def __post_init__(self) -> None:
+        self._fd: int | None = None
+
+    def acquire(self) -> None:
+        if self._fd is not None:
+            raise RuntimeError(f"flock {self.path}: already held")
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o600)
+        deadline = time.monotonic() + self.timeout
+        try:
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    self._fd = fd
+                    return
+                except OSError as exc:
+                    if exc.errno not in (errno.EAGAIN, errno.EACCES):
+                        raise
+                if time.monotonic() >= deadline:
+                    raise FlockTimeout(
+                        f"timed out after {self.timeout}s acquiring {self.path}"
+                    )
+                time.sleep(self.poll_interval)
+        except BaseException:
+            if self._fd is None:
+                os.close(fd)
+            raise
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        finally:
+            os.close(self._fd)
+            self._fd = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def __enter__(self) -> "Flock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+@contextmanager
+def locked(path: str, timeout: float = 10.0, poll_interval: float = 0.01):
+    """Convenience context manager mirroring ``flock.Acquire`` usage at
+    reference ``cmd/gpu-kubelet-plugin/driver.go:121``."""
+    lk = Flock(path, timeout=timeout, poll_interval=poll_interval)
+    lk.acquire()
+    try:
+        yield lk
+    finally:
+        lk.release()

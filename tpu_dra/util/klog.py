@@ -1,0 +1,63 @@
+"""klog-style leveled, optionally-JSON structured logging.
+
+Analog of reference ``pkg/flags/logging.go:33-88`` (klog v2 + logsapi: ``-v``
+levels, JSON format support).  High-volume paths log at v(6) like the
+reference's plugins (cmd/gpu-kubelet-plugin/driver.go:98).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from typing import Any
+
+_VERBOSITY = 2
+_JSON = False
+_lock = threading.Lock()
+_logger = logging.getLogger("tpu-dra")
+
+
+def configure(verbosity: int = 2, fmt: str = "text") -> None:
+    global _VERBOSITY, _JSON
+    _VERBOSITY = verbosity
+    _JSON = fmt == "json"
+    if not _logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(message)s"))
+        _logger.addHandler(h)
+    _logger.setLevel(logging.DEBUG)
+
+
+def v(level: int) -> bool:
+    """True when messages at this verbosity are enabled."""
+    return level <= _VERBOSITY
+
+
+def _emit(severity: str, msg: str, kv: dict[str, Any]) -> None:
+    if not _logger.handlers:
+        configure()
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+    if _JSON:
+        rec = {"ts": ts, "severity": severity, "msg": msg, **kv}
+        line = json.dumps(rec, default=str)
+    else:
+        kvs = " ".join(f"{k}={v!r}" for k, v in kv.items())
+        line = f"{severity[0]}{ts} {msg}" + (f" {kvs}" if kvs else "")
+    with _lock:
+        _logger.info(line)
+
+
+def info(msg: str, level: int = 0, **kv: Any) -> None:
+    if level <= _VERBOSITY:
+        _emit("INFO", msg, kv)
+
+
+def warning(msg: str, **kv: Any) -> None:
+    _emit("WARNING", msg, kv)
+
+
+def error(msg: str, **kv: Any) -> None:
+    _emit("ERROR", msg, kv)

@@ -1,0 +1,167 @@
+"""Prometheus-format metrics + profiling HTTP endpoint.
+
+Analog of reference ``cmd/compute-domain-controller/main.go:194-241``
+(``SetupHTTPEndpoint``): a controller-side HTTP server exposing Prometheus
+metrics (there via legacyregistry: Go runtime, client-go REST and workqueue
+metrics) behind ``--metrics-path`` and pprof profiles behind ``--pprof-path``.
+
+Here the registry is hand-rolled (text exposition format needs no library) and
+the pprof analog serves Python thread stack dumps + tracemalloc snapshots.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name, self.help, self.labels = name, help_, labels
+        self._values: dict[tuple[str, ...], float] = {}
+        self._mu = threading.Lock()
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        with self._mu:
+            self._values[label_values] = self._values.get(label_values, 0.0) + by
+
+    def collect(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._mu:
+            items = sorted(self._values.items())
+        for lv, val in items:
+            lbl = ",".join(f'{k}="{v}"' for k, v in zip(self.labels, lv))
+            out.append(f"{self.name}{{{lbl}}} {val}" if lbl
+                       else f"{self.name} {val}")
+        return "\n".join(out)
+
+
+class Gauge(Counter):
+    def set(self, value: float, *label_values: str) -> None:
+        with self._mu:
+            self._values[label_values] = value
+
+    def collect(self) -> str:
+        return super().collect().replace(" counter", " gauge", 1)
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+    def __init__(self, name: str, help_: str,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name, self.help, self.buckets = name, help_, buckets
+        self._counts = [0] * (len(buckets) + 1)
+        self._sum = 0.0
+        self._mu = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._mu:
+            self._sum += value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def collect(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._mu:
+            cum = 0
+            for b, c in zip(self.buckets, self._counts):
+                cum += c
+                out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            cum += self._counts[-1]
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+            out.append(f"{self.name}_sum {self._sum}")
+            out.append(f"{self.name}_count {cum}")
+        return "\n".join(out)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list = []
+        self._mu = threading.Lock()
+
+    def register(self, metric):
+        with self._mu:
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        return self.register(Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        return self.register(Gauge(name, help_, labels))
+
+    def histogram(self, name: str, help_: str,
+                  buckets: tuple[float, ...] = Histogram.DEFAULT_BUCKETS):
+        return self.register(Histogram(name, help_, buckets))
+
+    def expose(self) -> str:
+        with self._mu:
+            metrics = list(self._metrics)
+        return "\n".join(m.collect() for m in metrics) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+def _stacks_dump() -> str:
+    """pprof-goroutine analog: dump every Python thread's stack."""
+    frames = sys._current_frames()
+    out = []
+    for t in threading.enumerate():
+        fr = frames.get(t.ident)
+        out.append(f"--- thread {t.name} (daemon={t.daemon}) ---")
+        if fr is not None:
+            out.extend(traceback.format_stack(fr))
+    return "\n".join(out)
+
+
+def serve_http_endpoint(
+    address: str = "127.0.0.1", port: int = 0,
+    metrics_path: str = "/metrics", pprof_path: str = "/debug/pprof",
+    registry: Optional[Registry] = None,
+    healthz: Optional[Callable[[], bool]] = None,
+) -> ThreadingHTTPServer:
+    """Start the metrics/pprof HTTP server in a daemon thread; returns the
+    server (``server.server_address`` carries the bound port)."""
+    reg = registry or DEFAULT_REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 (http.server API)
+            if self.path == metrics_path:
+                body = reg.expose().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith(pprof_path):
+                body = _stacks_dump().encode()
+                ctype = "text/plain"
+            elif self.path == "/healthz":
+                ok = healthz() if healthz else True
+                self.send_response(200 if ok else 503)
+                self.end_headers()
+                self.wfile.write(b"ok" if ok else b"unhealthy")
+                return
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args) -> None:  # silence per-request logs
+            pass
+
+    server = ThreadingHTTPServer((address, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="metrics-http").start()
+    return server

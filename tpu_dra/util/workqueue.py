@@ -1,0 +1,200 @@
+"""Rate-limited retry work queue.
+
+Analog of reference ``pkg/workqueue/workqueue.go:28-111``, which wraps
+client-go's typed rate-limited queue: enqueued callbacks that fail are
+re-queued with per-item exponential backoff **forever** (workqueue.go:84-111);
+``Enqueue`` deep-copies the object so later mutation by the caller cannot race
+the worker (workqueue.go:46-59).
+
+The slice-domain kubelet plugin additionally needs retry-until-deadline
+semantics for codependent prepares (reference
+``cmd/compute-domain-kubelet-plugin/driver.go:37-57,136-195``); that is built
+here as :meth:`WorkQueue.enqueue_with_deadline` + :class:`PermanentError`.
+"""
+
+from __future__ import annotations
+
+import copy
+import heapq
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class PermanentError(Exception):
+    """Marks an error that must short-circuit retries.
+
+    Analog of ``permanentError`` (reference
+    cmd/compute-domain-kubelet-plugin/driver.go:50-57).
+    """
+
+
+class RetryDeadlineExceeded(Exception):
+    """A retried item exceeded its retry deadline.
+
+    Analog of ``ErrorRetryMaxTimeout`` expiry (reference driver.go:37-48).
+    """
+
+
+class ItemExponentialBackoff:
+    """Per-item exponential backoff, client-go style (base*2^failures, capped)."""
+
+    def __init__(self, base: float = 0.005, cap: float = 30.0) -> None:
+        self.base = base
+        self.cap = cap
+        self._failures: dict[Any, int] = {}
+        self._mu = threading.Lock()
+
+    def when(self, key: Any) -> float:
+        with self._mu:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        return min(self.base * (2**n), self.cap)
+
+    def forget(self, key: Any) -> None:
+        with self._mu:
+            self._failures.pop(key, None)
+
+
+@dataclass(order=True)
+class _Delayed:
+    ready_at: float
+    seq: int
+    item: "_WorkItem" = field(compare=False)
+
+
+@dataclass
+class _WorkItem:
+    callback: Callable[[Any], None]
+    obj: Any
+    key: Any
+    deadline: Optional[float] = None  # monotonic; None = retry forever
+    on_error: Optional[Callable[[BaseException], None]] = None
+
+
+class WorkQueue:
+    """A single-worker queue that retries failed callbacks with backoff.
+
+    ``run()`` blocks until ``shutdown()``; the reference equivalent is
+    ``WorkQueue.Run(ctx)`` (workqueue.go:61-82).
+    """
+
+    def __init__(self, name: str = "workqueue",
+                 backoff: ItemExponentialBackoff | None = None) -> None:
+        self.name = name
+        self._backoff = backoff or ItemExponentialBackoff()
+        self._queue: list[_WorkItem] = []
+        self._delayed: list[_Delayed] = []
+        self._seq = 0
+        self._cv = threading.Condition()
+        self._shutdown = False
+        self._active = 0
+
+    # -- producer side -----------------------------------------------------
+    def enqueue(self, callback: Callable[[Any], None], obj: Any,
+                key: Any = None) -> None:
+        """Deep-copies ``obj`` (reference workqueue.go:46-59) and queues it.
+
+        Failures re-queue with backoff forever.
+        """
+        self._push(_WorkItem(callback, copy.deepcopy(obj),
+                             key if key is not None else id(callback)))
+
+    def enqueue_with_deadline(
+        self, callback: Callable[[Any], None], obj: Any, *,
+        timeout: float, key: Any = None,
+        on_error: Optional[Callable[[BaseException], None]] = None,
+    ) -> None:
+        """Queue with retry-until-deadline semantics.
+
+        After ``timeout`` seconds of failed retries the item is dropped and
+        ``on_error`` fires with :class:`RetryDeadlineExceeded`; a
+        :class:`PermanentError` raised by the callback short-circuits
+        immediately (reference driver.go:197-239 retry loop).
+        """
+        self._push(_WorkItem(callback, copy.deepcopy(obj),
+                             key if key is not None else id(callback),
+                             deadline=time.monotonic() + timeout,
+                             on_error=on_error))
+
+    def _push(self, item: _WorkItem) -> None:
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError(f"workqueue {self.name} is shut down")
+            self._queue.append(item)
+            self._cv.notify()
+
+    def _push_delayed(self, item: _WorkItem, delay: float) -> None:
+        with self._cv:
+            self._seq += 1
+            heapq.heappush(self._delayed,
+                           _Delayed(time.monotonic() + delay, self._seq, item))
+            self._cv.notify()
+
+    # -- consumer side -----------------------------------------------------
+    def _next(self) -> Optional[_WorkItem]:
+        with self._cv:
+            while True:
+                now = time.monotonic()
+                while self._delayed and self._delayed[0].ready_at <= now:
+                    self._queue.append(heapq.heappop(self._delayed).item)
+                if self._queue:
+                    self._active += 1
+                    return self._queue.pop(0)
+                if self._shutdown:
+                    return None
+                timeout = None
+                if self._delayed:
+                    timeout = max(0.0, self._delayed[0].ready_at - now)
+                self._cv.wait(timeout)
+
+    def run(self) -> None:
+        while True:
+            item = self._next()
+            if item is None:
+                return
+            try:
+                try:
+                    item.callback(item.obj)
+                except PermanentError as exc:
+                    self._backoff.forget(item.key)
+                    if item.on_error:
+                        item.on_error(exc)
+                except BaseException as exc:  # noqa: BLE001 — retried below
+                    delay = self._backoff.when(item.key)
+                    if item.deadline is not None and \
+                            time.monotonic() + delay > item.deadline:
+                        self._backoff.forget(item.key)
+                        if item.on_error:
+                            item.on_error(RetryDeadlineExceeded(
+                                f"{self.name}: retries exhausted: {exc!r}"))
+                    else:
+                        self._push_delayed(item, delay)
+                else:
+                    self._backoff.forget(item.key)
+            finally:
+                with self._cv:
+                    self._active -= 1
+                    self._cv.notify_all()
+
+    def run_in_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, name=self.name, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait until both queues are empty and no callback is running."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._delayed or self._active:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.05))
+            return True

@@ -1,0 +1,14 @@
+"""Version stamping.
+
+Analog of reference ``internal/info/version.go:21-43`` (there the version is
+injected via ``-ldflags -X``; here it is a plain module constant optionally
+overridden by the ``TPU_DRA_VERSION`` environment variable at process start).
+"""
+
+import os
+
+VERSION = os.environ.get("TPU_DRA_VERSION", "v0.1.0")
+DRIVER_NAME = "tpu.google.com"
+SLICE_DRIVER_NAME = "slice-domain.tpu.google.com"
+API_GROUP = "resource.tpu.google.com"
+API_VERSION = "v1beta1"
