@@ -1,0 +1,120 @@
+"""Driver benchmark — one JSON line on stdout.
+
+Headline metric: the driver's hot path — ResourceClaim prepare p50 latency
+through the full stack (real gRPC over the DRA unix socket → flock →
+DeviceState → CDI spec write → checkpoint fsync), the node-local half of the
+BASELINE.md north-star "ResourceClaim → pod-Running p50".  The reference
+publishes no numbers (BASELINE.md), so ``vs_baseline`` is 1.0 by definition.
+
+Extra keys report TPU-side vitals measured on the real chip (MXU matmul
+TFLOP/s, and psum bandwidth when >1 device is visible).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_prepare_latency(n_claims: int = 200) -> dict:
+    import grpc
+
+    from tpu_dra.k8s import FakeKube, RESOURCE_CLAIMS
+    from tpu_dra.kubeletplugin.proto import dra_v1beta1_pb2 as dra_pb
+    from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+    from tpu_dra.tpulib import FakeTpuLib
+    from tpu_dra.version import DRIVER_NAME
+
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-bench-")
+    kube = FakeKube()
+    drv = TpuDriver(TpuDriverConfig(
+        node_name="bench-node", tpulib=FakeTpuLib(), kube=kube,
+        plugins_dir=f"{tmp}/plugins", registry_dir=f"{tmp}/registry",
+        cdi_root=f"{tmp}/cdi"))
+    drv.start()
+    channel = grpc.insecure_channel(f"unix:{drv.server.dra_socket}")
+    prepare = channel.unary_unary(
+        "/v1beta1.DRAPlugin/NodePrepareResources",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=dra_pb.NodePrepareResourcesResponse.FromString)
+    unprepare = channel.unary_unary(
+        "/v1beta1.DRAPlugin/NodeUnprepareResources",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=dra_pb.NodeUnprepareResourcesResponse.FromString)
+
+    lat = []
+    try:
+        for i in range(n_claims):
+            uid = f"bench-{i}"
+            kube.create(RESOURCE_CLAIMS, {
+                "metadata": {"name": uid, "namespace": "default",
+                             "uid": uid},
+                "spec": {},
+                "status": {"allocation": {"devices": {"results": [
+                    {"request": "tpu", "driver": DRIVER_NAME,
+                     "pool": "bench-node",
+                     "device": f"tpu-{i % 4}"}]}}}})
+            t0 = time.perf_counter()
+            resp = prepare(dra_pb.NodePrepareResourcesRequest(claims=[
+                dra_pb.Claim(namespace="default", uid=uid, name=uid)]),
+                timeout=10)
+            lat.append(time.perf_counter() - t0)
+            assert resp.claims[uid].error == "", resp.claims[uid].error
+            unprepare(dra_pb.NodeUnprepareResourcesRequest(claims=[
+                dra_pb.Claim(namespace="default", uid=uid, name=uid)]),
+                timeout=10)
+    finally:
+        channel.close()
+        drv.stop()
+    lat.sort()
+    return {
+        "p50_ms": statistics.median(lat) * 1e3,
+        "p95_ms": lat[int(0.95 * len(lat))] * 1e3,
+        "mean_ms": statistics.fmean(lat) * 1e3,
+    }
+
+
+def bench_tpu() -> dict:
+    out: dict = {}
+    try:
+        import jax
+
+        from tpu_dra.workloads.collectives import (
+            make_mesh,
+            matmul_throughput,
+            psum_bandwidth,
+        )
+        devices = jax.devices()
+        out["tpu_devices"] = len(devices)
+        out["tpu_platform"] = devices[0].platform
+        out["tpu_matmul_tflops"] = round(matmul_throughput(4096), 2)
+        if len(devices) > 1:
+            res = psum_bandwidth(make_mesh())
+            out["psum_gbps"] = round(res.algo_bytes_per_s / 1e9, 2)
+    except Exception as exc:  # noqa: BLE001 — bench must still report
+        out["tpu_error"] = repr(exc)
+    return out
+
+
+def main() -> None:
+    prep = bench_prepare_latency()
+    tpu = bench_tpu()
+    print(json.dumps({
+        "metric": "claim_prepare_p50_latency",
+        "value": round(prep["p50_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "p95_ms": round(prep["p95_ms"], 3),
+        "mean_ms": round(prep["mean_ms"], 3),
+        **tpu,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,10 +8,22 @@ validated without TPU hardware): the platform env must be set before the first
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard-set (not setdefault): the axon sitecustomize pre-sets
+# JAX_PLATFORMS=axon in every interpreter on TPU-tunnel machines
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the axon register() (sitecustomize) pins jax_platforms=axon via jax.config,
+# which beats the env var — override it back before any backend init.
+# jax is optional for most of the suite; only workload tests need it.
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass

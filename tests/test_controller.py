@@ -1,0 +1,177 @@
+"""Slice-domain controller tests (reference computedomain.go/daemonset.go
+flows) against FakeKube — the fake-clientset controller-testing pattern
+SURVEY.md §4 calls for."""
+
+import time
+
+import pytest
+
+from tpu_dra.controller.constants import (
+    DOMAIN_LABEL,
+    FINALIZER,
+    daemon_rct_name,
+    ds_name,
+)
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.k8s import (
+    DAEMONSETS,
+    FakeKube,
+    NODES,
+    RESOURCE_CLAIM_TEMPLATES,
+    TPU_SLICE_DOMAINS,
+    NotFound,
+)
+
+NS = "team-a"
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def make_domain(kube, name="dom", num_nodes=4, rct_name="dom-channel"):
+    return kube.create(TPU_SLICE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuSliceDomain",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {"numNodes": num_nodes,
+                 "channel": {"resourceClaimTemplate": {"name": rct_name}}},
+    })
+
+
+@pytest.fixture
+def controller():
+    kube = FakeKube()
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    yield ctrl, kube
+    ctrl.stop()
+    kube.close_watchers()
+
+
+def test_domain_materializes_daemonset_and_rcts(controller):
+    ctrl, kube = controller
+    created = make_domain(kube)
+    uid = created["metadata"]["uid"]
+
+    assert wait_until(lambda: _exists(
+        kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+    ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+    assert ds["metadata"]["labels"][DOMAIN_LABEL] == uid
+    assert ds["spec"]["template"]["spec"]["nodeSelector"][DOMAIN_LABEL] == uid
+
+    daemon_rct = kube.get(RESOURCE_CLAIM_TEMPLATES,
+                          daemon_rct_name("dom", uid), "tpu-dra-driver")
+    params = daemon_rct["spec"]["spec"]["devices"]["config"][0]["opaque"][
+        "parameters"]
+    assert params["kind"] == "SliceDaemonConfig"
+    assert params["domainID"] == uid
+
+    workload_rct = kube.get(RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS)
+    wparams = workload_rct["spec"]["spec"]["devices"]["config"][0]["opaque"][
+        "parameters"]
+    assert wparams["kind"] == "SliceChannelConfig"
+
+    # finalizer + initial status
+    assert wait_until(lambda: FINALIZER in kube.get(
+        TPU_SLICE_DOMAINS, "dom", NS)["metadata"].get("finalizers", []))
+    assert wait_until(lambda: kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+                      .get("status", {}).get("status") == "NotReady")
+
+
+def _exists(kube, res, name, ns):
+    try:
+        kube.get(res, name, ns)
+        return True
+    except NotFound:
+        return False
+
+
+def test_domain_ready_when_daemonset_ready(controller):
+    ctrl, kube = controller
+    created = make_domain(kube, num_nodes=2)
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: _exists(
+        kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+
+    ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+    ds["status"] = {"numberReady": 2}
+    kube.update_status(DAEMONSETS, ds)
+    assert wait_until(lambda: kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+                      .get("status", {}).get("status") == "Ready")
+
+    # a daemon pod dropping out flips the domain back to NotReady
+    ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+    ds["status"] = {"numberReady": 1}
+    kube.update_status(DAEMONSETS, ds)
+    assert wait_until(lambda: kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+                      .get("status", {}).get("status") == "NotReady")
+
+
+def test_teardown_strict_order_and_labels(controller):
+    ctrl, kube = controller
+    created = make_domain(kube)
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: _exists(
+        kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+
+    # a node labeled for the domain (as the slice plugin would)
+    kube.create(NODES, {"metadata": {"name": "n1",
+                                     "labels": {DOMAIN_LABEL: uid}}})
+
+    kube.delete(TPU_SLICE_DOMAINS, "dom", NS)
+    assert wait_until(lambda: not _exists(kube, TPU_SLICE_DOMAINS, "dom", NS))
+    assert not _exists(kube, DAEMONSETS, ds_name("dom", uid),
+                       "tpu-dra-driver")
+    assert not _exists(kube, RESOURCE_CLAIM_TEMPLATES,
+                       daemon_rct_name("dom", uid), "tpu-dra-driver")
+    assert not _exists(kube, RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS)
+    node = kube.get(NODES, "n1")
+    assert DOMAIN_LABEL not in node["metadata"].get("labels", {})
+
+
+def test_gc_removes_stale_objects(controller):
+    ctrl, kube = controller
+    # an orphaned RCT pointing at a domain that never existed
+    kube.create(RESOURCE_CLAIM_TEMPLATES, {
+        "metadata": {"name": "stale", "namespace": NS,
+                     "labels": {DOMAIN_LABEL: "ghost-uid"},
+                     "finalizers": [FINALIZER]},
+        "spec": {"spec": {}}})
+    kube.create(NODES, {"metadata": {"name": "n-stale",
+                                     "labels": {DOMAIN_LABEL: "ghost-uid"}}})
+    for gc in ctrl.gc_managers:
+        gc.run_once()
+    assert not _exists(kube, RESOURCE_CLAIM_TEMPLATES, "stale", NS)
+    node = kube.get(NODES, "n-stale")
+    assert DOMAIN_LABEL not in node["metadata"].get("labels", {})
+
+
+def test_workload_rct_name_collision_not_adopted(controller):
+    ctrl, kube = controller
+    # unrelated object already using the user-chosen name
+    kube.create(RESOURCE_CLAIM_TEMPLATES, {
+        "metadata": {"name": "dom-channel", "namespace": NS},
+        "spec": {"spec": {}}})
+    make_domain(kube)
+    time.sleep(0.3)   # reconcile retries happen; object must stay foreign
+    obj = kube.get(RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS)
+    assert DOMAIN_LABEL not in obj["metadata"].get("labels", {})
+
+
+def test_domain_without_channel_name_does_not_crash(controller):
+    ctrl, kube = controller
+    kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "nochannel", "namespace": NS},
+        "spec": {"numNodes": 1}})
+    time.sleep(0.2)
+    # daemon-side objects still materialize; workload RCT cannot
+    obj = kube.get(TPU_SLICE_DOMAINS, "nochannel", NS)
+    uid = obj["metadata"]["uid"]
+    assert wait_until(lambda: _exists(
+        kube, DAEMONSETS, ds_name("nochannel", uid), "tpu-dra-driver"))

@@ -1,0 +1,172 @@
+"""Slice daemon tests: membership via CR status, nodes-config generation,
+the coordination service, process supervision, and the check probe."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_dra.api.types import TpuSliceDomainNode
+from tpu_dra.daemon.coordservice import CoordState, serve
+from tpu_dra.daemon.main import write_nodes_config
+from tpu_dra.daemon.membership import MembershipManager
+from tpu_dra.daemon.process import ProcessManager
+from tpu_dra.k8s import FakeKube, TPU_SLICE_DOMAINS
+
+NS = "team-a"
+FABRIC = "slice-uuid.0"
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def make_domain(kube, num_nodes=2):
+    return kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": num_nodes}})
+
+
+def make_member(kube, node, ip, worker):
+    m = MembershipManager(kube, "dom", NS, node, ip, FABRIC, worker)
+    m.start()
+    return m
+
+
+def test_membership_rendezvous_two_nodes():
+    """Two daemons publish into status.nodes; both see the full-membership
+    push exactly once (daemon computedomain.go:145-220)."""
+    kube = FakeKube()
+    make_domain(kube, num_nodes=2)
+    m0 = make_member(kube, "n0", "10.0.0.10", 0)
+    m1 = make_member(kube, "n1", "10.0.0.11", 1)
+    try:
+        nodes0 = m0.updates.get(timeout=5)
+        nodes1 = m1.updates.get(timeout=5)
+        assert {n.name for n in nodes0} == {"n0", "n1"}
+        assert {n.ip_address for n in nodes1} == {"10.0.0.10", "10.0.0.11"}
+        # no duplicate pushes for an unchanged IP set
+        time.sleep(0.2)
+        assert m0.updates.empty()
+    finally:
+        m0.stop()
+        m1.stop()
+        kube.close_watchers()
+
+
+def test_pod_ip_change_repropagates():
+    """computedomain.go:177-180: a daemon restarting with a new IP must
+    overwrite its stale status entry, producing a fresh membership push."""
+    kube = FakeKube()
+    make_domain(kube, num_nodes=2)
+    m0 = make_member(kube, "n0", "10.0.0.10", 0)
+    m1 = make_member(kube, "n1", "10.0.0.11", 1)
+    try:
+        m0.updates.get(timeout=5)
+        m1.stop()
+        m1b = make_member(kube, "n1", "10.0.0.99", 1)   # restarted pod
+        nodes = m0.updates.get(timeout=5)
+        assert {n.ip_address for n in nodes} == {"10.0.0.10", "10.0.0.99"}
+        m1b.stop()
+    finally:
+        m0.stop()
+        kube.close_watchers()
+
+
+def test_write_nodes_config_filters_fabric_and_sorts(tmp_path):
+    nodes = [
+        TpuSliceDomainNode("n2", "10.0.0.12", FABRIC, 2),
+        TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0),
+        TpuSliceDomainNode("alien", "10.9.9.9", "other-fabric.0", 1),
+    ]
+    path = write_nodes_config(str(tmp_path), nodes, FABRIC)
+    data = json.load(open(path))
+    assert [n["name"] for n in data["nodes"]] == ["n0", "n2"]
+
+
+def test_coordservice_endpoints(tmp_path):
+    server = serve(str(tmp_path), port=0, address="127.0.0.1")
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/ready", timeout=2)
+        assert exc.value.code == 503
+
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n1", "10.0.0.11", FABRIC, 1),
+            TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0),
+        ], FABRIC)
+
+        assert urllib.request.urlopen(
+            f"{base}/ready", timeout=2).read() == b"READY\n"
+        coord = urllib.request.urlopen(
+            f"{base}/coordinator", timeout=2).read().decode()
+        assert coord == "10.0.0.10:8476"   # rank-0 = lowest worker id
+        who = urllib.request.urlopen(
+            f"{base}/whoami?ip=10.0.0.11", timeout=2).read().decode()
+        assert who == "1"
+        nodes = json.loads(urllib.request.urlopen(
+            f"{base}/nodes", timeout=2).read())
+        assert len(nodes["nodes"]) == 2
+    finally:
+        server.shutdown()
+
+
+def test_coordstate_reload_on_change(tmp_path):
+    state = CoordState(str(tmp_path))
+    assert not state.ready()
+    write_nodes_config(str(tmp_path), [
+        TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+    assert state.ready()
+    assert state.coordinator() == "10.0.0.10:8476"
+
+
+def test_process_manager_watchdog_restarts():
+    pm = ProcessManager(
+        argv_fn=lambda: [sys.executable, "-c",
+                         "import time; time.sleep(60)"],
+        name="sleeper", watchdog_interval=0.05)
+    pm.restart()
+    assert pm.alive()
+    pm.start_watchdog()
+    try:
+        pm._proc.kill()   # simulated crash
+        assert wait_until(lambda: pm.restarts >= 1 and pm.alive(), 5)
+    finally:
+        pm.stop_watchdog()
+        pm.stop()
+    assert not pm.alive()
+
+
+def test_check_probe_against_coordservice(tmp_path):
+    write_nodes_config(str(tmp_path), [
+        TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+    server = serve(str(tmp_path), port=0, address="127.0.0.1")
+    port = server.server_address[1]
+    try:
+        env = dict(os.environ, SLICE_COORDINATOR_PORT=str(port))
+        out = subprocess.run(
+            [sys.executable, "-m", "tpu_dra.daemon.main", "check"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "READY"
+    finally:
+        server.shutdown()
+    # and the failure path: nothing listening
+    env = dict(os.environ, SLICE_COORDINATOR_PORT="1")
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.daemon.main", "check"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 1

@@ -290,3 +290,18 @@ def test_missing_claim_spec_regenerated_on_idempotent_prepare(tmp_path):
     devices = state.prepare(make_claim())
     assert devices[0].canonical_name == "tpu-0"
     assert os.path.exists(state.cdi.claim_spec_path(UID))
+
+
+def test_mixed_chip_core_group_unions_visible_chips(tmp_path):
+    """TPU_VISIBLE_CHIPS must union chip minors across full chips and core
+    parents — never clobber (review regression)."""
+    state = make_state(tmp_path, family="v4")
+    claim = make_claim(devices=("tpu-0", "tpu-1-core-0"),
+                       requests=["chip", "core"])
+    state.prepare(claim)
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    by_name = {d["name"]: dict(e.split("=", 1) for e in
+                               d["containerEdits"].get("env", []))
+               for d in spec["devices"]}
+    assert by_name[f"{UID}-tpu-0"]["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert by_name[f"{UID}-tpu-1-core-0"]["TPU_VISIBLE_CORES"] == "1:0"

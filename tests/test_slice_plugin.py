@@ -1,0 +1,213 @@
+"""Slice-domain kubelet plugin tests, including the full SURVEY §3.3 flow:
+controller + slice plugin against one FakeKube — channel prepare blocks on
+domain readiness, node labeling lets the DaemonSet schedule, daemon prepare
+writes coordination settings, readiness unblocks the channel."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_dra.controller.constants import DOMAIN_LABEL, ds_name
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.k8s import (
+    DAEMONSETS,
+    FakeKube,
+    NODES,
+    TPU_SLICE_DOMAINS,
+)
+from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
+from tpu_dra.version import SLICE_DRIVER_NAME
+
+NS = "team-a"
+NODE = "node-a"
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def world(tmp_path):
+    kube = FakeKube()
+    kube.create(NODES, {"metadata": {"name": NODE, "labels": {}}})
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    drv = SliceDriver(SliceDriverConfig(
+        node_name=NODE, kube=kube,
+        plugins_dir=str(tmp_path / "plugins"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=2.0,
+        retry_timeout=8.0))
+    drv.start()
+    yield kube, ctrl, drv
+    drv.stop()
+    ctrl.stop()
+    kube.close_watchers()
+
+
+def make_domain(kube, name="dom", num_nodes=1):
+    return kube.create(TPU_SLICE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuSliceDomain",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {"numNodes": num_nodes,
+                 "channel": {"resourceClaimTemplate":
+                             {"name": f"{name}-channel"}}},
+    })
+
+
+def slice_claim(uid, device, kind, domain_uid, namespace=NS):
+    return {
+        "metadata": {"uid": uid, "namespace": namespace, "name": uid},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "r0", "driver": SLICE_DRIVER_NAME,
+                         "pool": NODE, "device": device}],
+            "config": [{"requests": ["r0"], "opaque": {
+                "driver": SLICE_DRIVER_NAME,
+                "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": kind, "domainID": domain_uid}}}],
+        }}},
+    }
+
+
+def test_slice_devices_published(world):
+    kube, _, drv = world
+    from tpu_dra.k8s import RESOURCE_SLICES
+    slices = kube.list(RESOURCE_SLICES)["items"]
+    ours = [s for s in slices if s["spec"]["driver"] == SLICE_DRIVER_NAME]
+    assert len(ours) == 1
+    names = [d["name"] for d in ours[0]["spec"]["devices"]]
+    assert names == ["slice-daemon", "channel-0"]
+
+
+def test_codependent_prepare_flow(world):
+    """The §3.3 dance: channel prepare labels the node and blocks until the
+    controller flips the domain Ready (driven here by DaemonSet status)."""
+    kube, ctrl, drv = world
+    created = make_domain(kube, num_nodes=1)
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid) is not None)
+
+    results = {}
+
+    def run_prepare():
+        claim = slice_claim("chan-claim", "channel-0", "SliceChannelConfig",
+                            uid)
+        results.update(drv.prepare_resource_claims([claim]))
+
+    t = threading.Thread(target=run_prepare)
+    t.start()
+
+    # channel prepare labels the node (making the DS schedulable) but blocks
+    assert wait_until(lambda: kube.get(NODES, NODE)["metadata"]
+                      .get("labels", {}).get(DOMAIN_LABEL) == uid)
+    assert not results
+
+    # daemon pod lands on the labeled node; its claim prepares the settings
+    daemon_res = drv.prepare_resource_claims([
+        slice_claim("daemon-claim", "slice-daemon", "SliceDaemonConfig",
+                    uid, namespace="tpu-dra-driver")])
+    assert daemon_res["daemon-claim"].error == ""
+    settings = drv.manager.domain_dir(uid)
+    assert os.path.exists(os.path.join(settings, "config.cfg"))
+
+    # the DS reports ready → controller flips the domain Ready
+    assert wait_until(lambda: _exists(kube, DAEMONSETS,
+                                      ds_name("dom", uid), "tpu-dra-driver"))
+    ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+    ds["status"] = {"numberReady": 1}
+    kube.update_status(DAEMONSETS, ds)
+
+    t.join(timeout=15)
+    assert results["chan-claim"].error == ""
+    devs = results["chan-claim"].devices
+    assert devs[0]["device_name"] == "channel-0"
+    # coordination settings are mounted for the workload
+    import json
+    spec = json.load(open(drv.state.cdi.claim_spec_path("chan-claim")))
+    edits = spec["devices"][0]["containerEdits"]
+    assert any(f"SLICE_DOMAIN_UUID={uid}" in e for e in edits["env"])
+    assert edits["mounts"][0]["containerPath"] == "/etc/tpu-slice"
+
+
+def _exists(kube, res, name, ns):
+    from tpu_dra.k8s import NotFound
+    try:
+        kube.get(res, name, ns)
+        return True
+    except NotFound:
+        return False
+
+
+def test_channel_namespace_mismatch_is_permanent(world):
+    kube, ctrl, drv = world
+    created = make_domain(kube)
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid) is not None)
+    t0 = time.monotonic()
+    res = drv.prepare_resource_claims([
+        slice_claim("bad-ns", "channel-0", "SliceChannelConfig", uid,
+                    namespace="other-team")])
+    elapsed = time.monotonic() - t0
+    assert "does not match" in res["bad-ns"].error
+    assert elapsed < 3.0   # permanent: no 8s retry loop
+
+
+def test_node_bound_to_one_domain_at_a_time(world):
+    kube, ctrl, drv = world
+    d1 = make_domain(kube, name="dom1")
+    d2 = make_domain(kube, name="dom2")
+    uid1, uid2 = d1["metadata"]["uid"], d2["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid2) is not None)
+    drv.manager.add_node_label(uid1)
+    res = drv.prepare_resource_claims([
+        slice_claim("second", "channel-0", "SliceChannelConfig", uid2)])
+    assert "already bound" in res["second"].error
+
+
+def test_unprepare_removes_label_and_settings(world):
+    kube, ctrl, drv = world
+    created = make_domain(kube)
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid) is not None)
+    drv.prepare_resource_claims([
+        slice_claim("d", "slice-daemon", "SliceDaemonConfig", uid,
+                    namespace="tpu-dra-driver")])
+    assert os.path.exists(drv.manager.domain_dir(uid))
+    drv.unprepare_resource_claims(
+        [type("R", (), {"namespace": "tpu-dra-driver", "uid": "d",
+                        "name": "d"})()])
+    assert not os.path.exists(drv.manager.domain_dir(uid))
+
+
+def test_retry_deadline_reports_timeout(world):
+    kube, ctrl, drv = world
+    created = make_domain(kube, num_nodes=4)   # never becomes ready
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid) is not None)
+    drv.cfg.retry_timeout = 1.0
+    t0 = time.monotonic()
+    res = drv.prepare_resource_claims([
+        slice_claim("stuck", "channel-0", "SliceChannelConfig", uid)])
+    assert "retries exhausted" in res["stuck"].error or \
+        "timed out" in res["stuck"].error
+    assert time.monotonic() - t0 < 8.0
+
+
+def test_stale_cleanup(world):
+    kube, ctrl, drv = world
+    os.makedirs(drv.manager.domain_dir("ghost-uid"), exist_ok=True)
+    kube.patch(NODES, NODE,
+               {"metadata": {"labels": {DOMAIN_LABEL: "ghost-uid"}}})
+    cleaned = drv.manager.cleanup_stale()
+    assert cleaned == 2
+    assert not os.path.exists(drv.manager.domain_dir("ghost-uid"))

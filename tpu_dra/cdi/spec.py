@@ -80,13 +80,7 @@ class ContainerEdits:
         return out
 
 
-def _atomic_write(path: str, data: str) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+from tpu_dra.util.fsutil import atomic_write as _atomic_write
 
 
 class CDIHandler:

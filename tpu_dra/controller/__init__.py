@@ -1,0 +1,8 @@
+"""tpu-slice-controller — cluster-level TpuSliceDomain reconciliation.
+
+Analog of reference ``cmd/compute-domain-controller`` (SURVEY.md §2.2): a
+controller that materializes, for each ``TpuSliceDomain`` CR, a per-domain
+daemon DaemonSet plus daemon/workload ResourceClaimTemplates, tracks
+readiness from DaemonSet status, and tears everything down in strict
+finalizer order with periodic garbage collection as the safety net.
+"""

@@ -1,0 +1,72 @@
+"""Generic garbage collection for domain-labeled objects.
+
+Analog of reference ``cmd/compute-domain-controller/cleanup.go:30-159``
+(``CleanupManager[T]``): every ``period`` seconds (or on demand), scan an
+informer store for objects whose domain label points at a ComputeDomain that
+no longer exists, and fire a cleanup callback for each.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from tpu_dra.controller.constants import DOMAIN_LABEL
+from tpu_dra.util import klog
+
+
+class CleanupManager:
+    def __init__(self, name: str,
+                 list_objects: Callable[[], list[dict]],
+                 domain_exists: Callable[[str], bool],
+                 cleanup: Callable[[dict], None],
+                 period: float = 600.0) -> None:
+        self.name = name
+        self.list_objects = list_objects
+        self.domain_exists = domain_exists
+        self.cleanup = cleanup
+        self.period = period
+        self._poke = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CleanupManager":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"cleanup-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._poke.set()
+
+    def enqueue_cleanup(self) -> None:
+        """On-demand trigger (size-1 queue semantics, cleanup.go:84-93)."""
+        self._poke.set()
+
+    def run_once(self) -> int:
+        """One GC pass; returns the number of cleaned objects."""
+        cleaned = 0
+        for obj in self.list_objects():
+            uid = obj.get("metadata", {}).get("labels", {}).get(DOMAIN_LABEL)
+            if not uid or self.domain_exists(uid):
+                continue
+            try:
+                klog.info("cleanup: stale domain object", level=2,
+                          manager=self.name,
+                          object=obj.get("metadata", {}).get("name"),
+                          domain=uid)
+                self.cleanup(obj)
+                cleaned += 1
+            except Exception as exc:  # noqa: BLE001 — next pass retries
+                klog.warning("cleanup failed; will retry",
+                             manager=self.name, err=repr(exc))
+        return cleaned
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._poke.wait(self.period)
+            self._poke.clear()
+            if self._stop.is_set():
+                return
+            self.run_once()

@@ -1,0 +1,25 @@
+"""Shared controller constants.
+
+Analog of reference ``cmd/compute-domain-controller/computedomain.go:35-55``.
+"""
+
+# Node/object label binding a resource to one slice domain (value = CR uid).
+DOMAIN_LABEL = "resource.tpu.google.com/sliceDomain"
+
+# Finalizer guarding ordered teardown.
+FINALIZER = "resource.tpu.google.com/slice-domain"
+
+# Device classes (reference has 4: gpu, mig, daemon, default-channel).
+DEVICE_CLASS_TPU = "tpu.google.com"
+DEVICE_CLASS_SUBSLICE = "tpu-subslice.tpu.google.com"
+DEVICE_CLASS_DAEMON = "slice-domain-daemon.tpu.google.com"
+DEVICE_CLASS_CHANNEL = "slice-domain-default-channel.tpu.google.com"
+
+
+def ds_name(domain_name: str, domain_uid: str) -> str:
+    """Per-domain DaemonSet name, unique across workload namespaces."""
+    return f"{domain_name}-{domain_uid[:8]}-daemon"
+
+
+def daemon_rct_name(domain_name: str, domain_uid: str) -> str:
+    return f"{domain_name}-{domain_uid[:8]}-daemon-claim"

@@ -1,0 +1,102 @@
+"""Controller shell.
+
+Analog of reference ``cmd/compute-domain-controller/controller.go:31-86``:
+builds the shared workqueue, wires the SliceDomainManager and the GC
+managers, and runs until stopped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_dra.controller.cleanup import CleanupManager
+from tpu_dra.controller.constants import DOMAIN_LABEL
+from tpu_dra.controller.slicedomain import SliceDomainManager
+from tpu_dra.k8s.client import (
+    DAEMONSETS,
+    KubeClient,
+    NotFound,
+    RESOURCE_CLAIM_TEMPLATES,
+)
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+from tpu_dra.util.workqueue import WorkQueue
+
+
+@dataclass
+class ControllerConfig:
+    kube: KubeClient
+    driver_namespace: str = "tpu-dra-driver"
+    image_name: str = "tpu-dra-driver:latest"
+    gc_period: float = 600.0   # cleanup.go: 10 min
+
+
+class Controller:
+    def __init__(self, cfg: ControllerConfig) -> None:
+        self.cfg = cfg
+        self.queue = WorkQueue("slice-domain-controller")
+        self.manager = SliceDomainManager(
+            cfg.kube, cfg.driver_namespace, cfg.image_name, self.queue)
+        self.reconciles = DEFAULT_REGISTRY.counter(
+            "tpu_dra_reconciles_total",
+            "TpuSliceDomain reconcile attempts")
+        exists = self.manager.domain_exists
+        self.gc_managers = [
+            CleanupManager(
+                "daemonsets",
+                lambda: self.manager.ds_manager.informer.store.list(),
+                exists,
+                lambda obj: self._delete_stale(DAEMONSETS, obj),
+                period=cfg.gc_period),
+            CleanupManager(
+                "resourceclaimtemplates",
+                lambda: self._labeled_rcts(),
+                exists,
+                lambda obj: self._delete_stale(RESOURCE_CLAIM_TEMPLATES, obj),
+                period=cfg.gc_period),
+            CleanupManager(
+                "node-labels",
+                lambda: [],   # nodes handled in bulk below
+                exists,
+                lambda obj: None,
+                period=cfg.gc_period),
+        ]
+        # the node sweep rides the same period as the other GC managers
+        self.gc_managers[-1].run_once = (  # type: ignore[method-assign]
+            lambda: self.manager.node_manager.remove_stale_labels(exists))
+
+    def _labeled_rcts(self) -> list[dict]:
+        items = []
+        for obj in self.cfg.kube.list(RESOURCE_CLAIM_TEMPLATES)["items"]:
+            if obj.get("metadata", {}).get("labels", {}).get(DOMAIN_LABEL):
+                items.append(obj)
+        return items
+
+    def _delete_stale(self, res, obj: dict) -> None:
+        meta = obj["metadata"]
+        finalizers = [f for f in meta.get("finalizers", [])
+                      if not f.startswith("resource.tpu.google.com/")]
+        if finalizers != meta.get("finalizers", []):
+            meta["finalizers"] = finalizers
+            try:
+                self.cfg.kube.update(res, obj)
+            except NotFound:
+                return
+        try:
+            self.cfg.kube.delete(res, meta["name"], meta.get("namespace"))
+        except NotFound:
+            pass
+
+    def start(self) -> None:
+        self.manager.start()
+        self.queue.run_in_background()
+        for gc in self.gc_managers:
+            gc.start()
+        klog.info("slice-domain controller started",
+                  namespace=self.cfg.driver_namespace)
+
+    def stop(self) -> None:
+        for gc in self.gc_managers:
+            gc.stop()
+        self.queue.shutdown()
+        self.manager.stop()

@@ -1,0 +1,149 @@
+"""Per-domain DaemonSet management + readiness tracking.
+
+Analog of reference ``cmd/compute-domain-controller/daemonset.go:40-371``:
+renders the daemon DaemonSet (nodeSelector = the domain label, so pods start
+only once the slice kubelet plugin labels nodes during channel prepare),
+watches DaemonSet status through a label-scoped informer with a mutation
+cache, and flips the domain CR to Ready when
+``status.numberReady == spec.numNodes`` (daemonset.go:350-358).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from tpu_dra.api.types import (
+    STATUS_NOT_READY,
+    STATUS_READY,
+    TpuSliceDomain,
+)
+from tpu_dra.controller.constants import DOMAIN_LABEL, FINALIZER, \
+    daemon_rct_name, ds_name
+from tpu_dra.controller.resourceclaimtemplate import (
+    DaemonRCTManager,
+    StillExists,
+)
+from tpu_dra.k8s.client import (
+    Conflict,
+    DAEMONSETS,
+    KubeClient,
+    NotFound,
+    TPU_SLICE_DOMAINS,
+)
+from tpu_dra.k8s.informer import Informer, label_index
+from tpu_dra.util import klog
+from tpu_dra.util.template import render_yaml
+
+
+class DaemonSetManager:
+    def __init__(self, kube: KubeClient, driver_namespace: str,
+                 image_name: str,
+                 get_domain_by_uid: Callable[[str], Optional[TpuSliceDomain]],
+                 ) -> None:
+        self.kube = kube
+        self.driver_namespace = driver_namespace
+        self.image_name = image_name
+        self.get_domain_by_uid = get_domain_by_uid
+        self.rct = DaemonRCTManager(kube, driver_namespace)
+        self.informer = Informer(
+            kube, DAEMONSETS, namespace=driver_namespace,
+            indexers={"domain": label_index(DOMAIN_LABEL)})
+        self.informer.add_event_handler(on_add=self._on_change,
+                                        on_update=lambda o, n:
+                                        self._on_change(n))
+
+    def start(self) -> None:
+        self.informer.start()
+        self.informer.wait_for_sync()
+
+    def stop(self) -> None:
+        self.informer.stop()
+
+    # -- create/delete (daemonset.go:168-257) ------------------------------
+    def create(self, domain: TpuSliceDomain) -> dict:
+        self.rct.create(domain)
+        obj = render_yaml("slice-domain-daemon.tmpl.yaml", {
+            "DS_NAME": ds_name(domain.name, domain.uid),
+            "DRIVER_NAMESPACE": self.driver_namespace,
+            "DOMAIN_NAME": domain.name,
+            "DOMAIN_NAMESPACE": domain.namespace,
+            "DOMAIN_UID": domain.uid,
+            "IMAGE_NAME": self.image_name,
+            "DAEMON_CLAIM_TEMPLATE_NAME":
+                daemon_rct_name(domain.name, domain.uid),
+        })
+        try:
+            created = self.kube.create(DAEMONSETS, obj)
+        except Conflict:
+            created = self.kube.get(DAEMONSETS,
+                                    ds_name(domain.name, domain.uid),
+                                    self.driver_namespace)
+        self.informer.store.mutate(created)
+        return created
+
+    def delete(self, domain: TpuSliceDomain) -> None:
+        self.rct.delete(domain)
+        try:
+            self.kube.delete(DAEMONSETS, ds_name(domain.name, domain.uid),
+                             self.driver_namespace)
+        except NotFound:
+            pass
+
+    def remove_finalizer(self, domain: TpuSliceDomain) -> None:
+        try:
+            obj = self.kube.get(DAEMONSETS,
+                                ds_name(domain.name, domain.uid),
+                                self.driver_namespace)
+        except NotFound:
+            return
+        finalizers = obj["metadata"].get("finalizers", [])
+        if FINALIZER in finalizers:
+            finalizers.remove(FINALIZER)
+            self.kube.update(DAEMONSETS, obj)
+
+    def assert_removed(self, domain: TpuSliceDomain) -> None:
+        try:
+            self.kube.get(DAEMONSETS, ds_name(domain.name, domain.uid),
+                          self.driver_namespace)
+        except NotFound:
+            return
+        raise StillExists(
+            f"DaemonSet {ds_name(domain.name, domain.uid)} still exists")
+
+    # -- readiness (daemonset.go:329-361) ----------------------------------
+    def _on_change(self, ds: dict) -> None:
+        uid = ds.get("metadata", {}).get("labels", {}).get(DOMAIN_LABEL)
+        if not uid:
+            return
+        try:
+            self.sync_readiness(uid, ds)
+        except Exception as exc:  # noqa: BLE001 — informer handler
+            klog.warning("readiness sync failed", domain=uid, err=repr(exc))
+
+    def sync_readiness(self, domain_uid: str,
+                       ds: Optional[dict] = None) -> None:
+        domain = self.get_domain_by_uid(domain_uid)
+        if domain is None:
+            return
+        if ds is None:
+            try:
+                ds = self.kube.get(DAEMONSETS,
+                                   ds_name(domain.name, domain.uid),
+                                   self.driver_namespace)
+            except NotFound:
+                return
+        ready = ds.get("status", {}).get("numberReady", 0)
+        desired = domain.spec.num_nodes
+        new_status = STATUS_READY if ready == desired else STATUS_NOT_READY
+        current = domain.status.status if domain.status else ""
+        if current == new_status:
+            return
+        fresh = TpuSliceDomain.from_dict(
+            self.kube.get(TPU_SLICE_DOMAINS, domain.name, domain.namespace))
+        from tpu_dra.api.types import TpuSliceDomainStatus
+        if fresh.status is None:
+            fresh.status = TpuSliceDomainStatus()
+        fresh.status.status = new_status
+        self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+        klog.info("slice domain status updated", domain=domain.name,
+                  status=new_status, ready=ready, desired=desired)

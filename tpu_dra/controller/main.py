@@ -1,0 +1,66 @@
+"""tpu-slice-controller entry point.
+
+Analog of reference ``cmd/compute-domain-controller/main.go:49-241``: flags,
+optional HTTP endpoint with Prometheus metrics + profiling, controller run
+loop.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.k8s.client import new_clients
+from tpu_dra.util import flags, klog, metrics
+from tpu_dra.util.flags import Flag, FlagGroup
+
+
+def controller_flags() -> FlagGroup:
+    return FlagGroup("Controller", [
+        Flag("namespace", "NAMESPACE", "driver namespace", "tpu-dra-driver"),
+        Flag("image-name", "IMAGE_NAME", "driver image for daemon pods",
+             "tpu-dra-driver:latest"),
+        Flag("http-endpoint", "HTTP_ENDPOINT",
+             "host:port for metrics/profiling (empty = disabled)", ""),
+        Flag("metrics-path", "METRICS_PATH", "metrics HTTP path", "/metrics"),
+        Flag("pprof-path", "PPROF_PATH", "profiling HTTP path",
+             "/debug/pprof"),
+        Flag("gc-period-seconds", "GC_PERIOD_SECONDS",
+             "stale-object GC period", 600.0, float),
+    ])
+
+
+def main(argv=None) -> int:
+    args = flags.parse(
+        "tpu-slice-controller",
+        [controller_flags(), flags.kube_client_flags(),
+         flags.logging_flags()],
+        argv, description=__doc__)
+    klog.configure(args.v, args.logging_format)
+    kube = new_clients(args.kubeconfig, args.kube_api_qps,
+                       args.kube_api_burst)
+    if args.http_endpoint:
+        host, _, port = args.http_endpoint.rpartition(":")
+        metrics.serve_http_endpoint(
+            host or "0.0.0.0", int(port),
+            metrics_path=args.metrics_path, pprof_path=args.pprof_path)
+        klog.info("metrics endpoint serving", endpoint=args.http_endpoint)
+    controller = Controller(ControllerConfig(
+        kube=kube,
+        driver_namespace=args.namespace,
+        image_name=args.image_name,
+        gc_period=args.gc_period_seconds))
+    controller.start()
+
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

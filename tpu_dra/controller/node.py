@@ -1,0 +1,47 @@
+"""Node label management.
+
+Analog of reference ``cmd/compute-domain-controller/node.go:33-166``: when a
+domain is deleted, every node still labeled for it must have the label
+removed (the label is what lets the daemon DaemonSet schedule there), plus a
+periodic stale-label sweep.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.controller.constants import DOMAIN_LABEL
+from tpu_dra.k8s.client import KubeClient, NODES
+from tpu_dra.util import klog
+
+
+class NodeManager:
+    def __init__(self, kube: KubeClient) -> None:
+        self.kube = kube
+
+    def nodes_for_domain(self, domain_uid: str) -> list[dict]:
+        return self.kube.list(
+            NODES, label_selector={DOMAIN_LABEL: domain_uid})["items"]
+
+    def remove_domain_labels(self, domain_uid: str) -> int:
+        """node.go:33-69 — list by label selector, strip the label."""
+        removed = 0
+        for node in self.nodes_for_domain(domain_uid):
+            name = node["metadata"]["name"]
+            self.kube.patch(NODES, name,
+                            {"metadata": {"labels": {DOMAIN_LABEL: None}}})
+            klog.info("removed domain label from node", level=2,
+                      node=name, domain=domain_uid)
+            removed += 1
+        return removed
+
+    def remove_stale_labels(self, domain_exists) -> int:
+        """node.go:112-147 — sweep every labeled node whose domain is gone."""
+        removed = 0
+        for node in self.kube.list(NODES)["items"]:
+            uid = node.get("metadata", {}).get("labels", {}) \
+                .get(DOMAIN_LABEL)
+            if uid and not domain_exists(uid):
+                self.kube.patch(
+                    NODES, node["metadata"]["name"],
+                    {"metadata": {"labels": {DOMAIN_LABEL: None}}})
+                removed += 1
+        return removed

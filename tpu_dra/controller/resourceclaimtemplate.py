@@ -1,0 +1,144 @@
+"""ResourceClaimTemplate managers.
+
+Analog of reference
+``cmd/compute-domain-controller/resourceclaimtemplate.go:40-389``: a base
+manager plus two specializations —
+
+- **daemon RCT** in the driver namespace, device class
+  ``slice-domain-daemon.tpu.google.com``, opaque ``SliceDaemonConfig``;
+- **workload RCT** in the workload namespace under the user-chosen name from
+  ``spec.channel.resourceClaimTemplate.name``, device class
+  ``slice-domain-default-channel.tpu.google.com``, opaque
+  ``SliceChannelConfig``.
+
+Both carry the domain label + finalizer and are rendered from yaml templates.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.api.types import TpuSliceDomain
+from tpu_dra.controller.constants import FINALIZER, daemon_rct_name
+from tpu_dra.k8s.client import (
+    Conflict,
+    KubeClient,
+    NotFound,
+    RESOURCE_CLAIM_TEMPLATES,
+)
+from tpu_dra.util import klog
+from tpu_dra.util.template import render_yaml
+
+
+class StillExists(RuntimeError):
+    """Raised by assert_removed — requeues teardown (daemonset.go:329-346)."""
+
+
+class BaseRCTManager:
+    def __init__(self, kube: KubeClient, driver_namespace: str) -> None:
+        self.kube = kube
+        self.driver_namespace = driver_namespace
+
+    # subclasses fill these
+    def name_for(self, domain: TpuSliceDomain) -> str:
+        raise NotImplementedError
+
+    def namespace_for(self, domain: TpuSliceDomain) -> str:
+        raise NotImplementedError
+
+    def render(self, domain: TpuSliceDomain) -> dict:
+        raise NotImplementedError
+
+    # -- shared lifecycle (resourceclaimtemplate.go:60-149) ----------------
+    def create(self, domain: TpuSliceDomain) -> dict:
+        obj = self.render(domain)
+        try:
+            return self.kube.create(RESOURCE_CLAIM_TEMPLATES, obj)
+        except Conflict:
+            return self.kube.get(RESOURCE_CLAIM_TEMPLATES,
+                                 self.name_for(domain),
+                                 self.namespace_for(domain))
+
+    def delete(self, domain: TpuSliceDomain) -> None:
+        try:
+            self.kube.delete(RESOURCE_CLAIM_TEMPLATES,
+                             self.name_for(domain),
+                             self.namespace_for(domain))
+        except NotFound:
+            pass
+
+    def remove_finalizer(self, domain: TpuSliceDomain) -> None:
+        try:
+            obj = self.kube.get(RESOURCE_CLAIM_TEMPLATES,
+                                self.name_for(domain),
+                                self.namespace_for(domain))
+        except NotFound:
+            return
+        finalizers = obj["metadata"].get("finalizers", [])
+        if FINALIZER in finalizers:
+            finalizers.remove(FINALIZER)
+            self.kube.update(RESOURCE_CLAIM_TEMPLATES, obj)
+
+    def assert_removed(self, domain: TpuSliceDomain) -> None:
+        try:
+            self.kube.get(RESOURCE_CLAIM_TEMPLATES, self.name_for(domain),
+                          self.namespace_for(domain))
+        except NotFound:
+            return
+        raise StillExists(
+            f"ResourceClaimTemplate {self.name_for(domain)} still exists")
+
+
+class DaemonRCTManager(BaseRCTManager):
+    """resourceclaimtemplate.go:271-329."""
+
+    def name_for(self, domain: TpuSliceDomain) -> str:
+        return daemon_rct_name(domain.name, domain.uid)
+
+    def namespace_for(self, domain: TpuSliceDomain) -> str:
+        return self.driver_namespace
+
+    def render(self, domain: TpuSliceDomain) -> dict:
+        return render_yaml("slice-domain-daemon-claim-template.tmpl.yaml", {
+            "TEMPLATE_NAME": self.name_for(domain),
+            "DRIVER_NAMESPACE": self.driver_namespace,
+            "DOMAIN_UID": domain.uid,
+        })
+
+
+class WorkloadRCTManager(BaseRCTManager):
+    """resourceclaimtemplate.go:331-389."""
+
+    def name_for(self, domain: TpuSliceDomain) -> str:
+        if domain.spec.channel is None:
+            raise ValueError(
+                f"TpuSliceDomain {domain.namespace}/{domain.name}: "
+                f"spec.channel.resourceClaimTemplate.name is required")
+        return domain.spec.channel.resource_claim_template_name
+
+    def namespace_for(self, domain: TpuSliceDomain) -> str:
+        return domain.namespace
+
+    def render(self, domain: TpuSliceDomain) -> dict:
+        return render_yaml(
+            "slice-domain-workload-claim-template.tmpl.yaml", {
+                "TEMPLATE_NAME": self.name_for(domain),
+                "DOMAIN_NAMESPACE": domain.namespace,
+                "DOMAIN_UID": domain.uid,
+            })
+
+    def create(self, domain: TpuSliceDomain) -> dict:
+        obj = self.render(domain)
+        try:
+            return self.kube.create(RESOURCE_CLAIM_TEMPLATES, obj)
+        except Conflict:
+            existing = self.kube.get(RESOURCE_CLAIM_TEMPLATES,
+                                     self.name_for(domain),
+                                     self.namespace_for(domain))
+            owner = existing.get("metadata", {}).get("labels", {}) \
+                .get("resource.tpu.google.com/sliceDomain")
+            if owner != domain.uid:
+                # user-chosen name collided with an unrelated object —
+                # surfaced as a retried error, never adopted
+                klog.error("workload RCT name collision",
+                           name=self.name_for(domain), owner=owner)
+                raise
+            return existing

@@ -1,0 +1,136 @@
+"""TpuSliceDomain reconciliation.
+
+Analog of reference
+``cmd/compute-domain-controller/computedomain.go:57-286``: a uid-indexed CRD
+informer feeding a retry workqueue; on add/update the manager adds the
+finalizer, triggers async stale-label cleanup, and materializes the
+per-domain DaemonSet + workload ResourceClaimTemplate; on deletion it tears
+down in strict order (workload RCT → DaemonSet+its RCT → node labels → RCT
+finalizers/assert → DS finalizer/assert → CR finalizer), with each unmet
+assertion raising so the workqueue retries until informers confirm removal
+(computedomain.go:234-268).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_dra.api.types import TpuSliceDomain, TpuSliceDomainStatus, \
+    STATUS_NOT_READY
+from tpu_dra.controller.constants import FINALIZER
+from tpu_dra.controller.daemonset import DaemonSetManager
+from tpu_dra.controller.node import NodeManager
+from tpu_dra.controller.resourceclaimtemplate import WorkloadRCTManager
+from tpu_dra.k8s.client import Conflict, KubeClient, NotFound, \
+    TPU_SLICE_DOMAINS
+from tpu_dra.k8s.informer import Informer, uid_index
+from tpu_dra.util import klog
+from tpu_dra.util.workqueue import WorkQueue
+
+
+class SliceDomainManager:
+    def __init__(self, kube: KubeClient, driver_namespace: str,
+                 image_name: str, queue: WorkQueue) -> None:
+        self.kube = kube
+        self.driver_namespace = driver_namespace
+        self.queue = queue
+        self.informer = Informer(kube, TPU_SLICE_DOMAINS,
+                                 indexers={"uid": uid_index})
+        self.informer.add_event_handler(
+            on_add=self._enqueue,
+            on_update=lambda old, new: self._enqueue(new))
+        self.ds_manager = DaemonSetManager(
+            kube, driver_namespace, image_name, self.get_by_uid)
+        self.workload_rct = WorkloadRCTManager(kube, driver_namespace)
+        self.node_manager = NodeManager(kube)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.informer.start()
+        self.informer.wait_for_sync()
+        self.ds_manager.start()
+
+    def stop(self) -> None:
+        self.ds_manager.stop()
+        self.informer.stop()
+
+    # -- lookups -----------------------------------------------------------
+    def get_by_uid(self, uid: str) -> Optional[TpuSliceDomain]:
+        """computedomain.go:160-176."""
+        objs = self.informer.store.by_index("uid", uid)
+        if not objs:
+            return None
+        return TpuSliceDomain.from_dict(objs[0])
+
+    def domain_exists(self, uid: str) -> bool:
+        return bool(self.informer.store.by_index("uid", uid))
+
+    # -- queue plumbing ----------------------------------------------------
+    def _enqueue(self, obj: dict) -> None:
+        self.queue.enqueue(self.on_add_or_update, obj,
+                           key=obj.get("metadata", {}).get("uid"))
+
+    # -- reconcile (computedomain.go:226-286) ------------------------------
+    def on_add_or_update(self, obj: dict) -> None:
+        domain = TpuSliceDomain.from_dict(obj)
+        if domain.deleting:
+            self._teardown(domain)
+            return
+        self._add_finalizer(domain)
+        self.ds_manager.create(domain)
+        self.workload_rct.create(domain)
+        self._ensure_status(domain)
+
+    def _add_finalizer(self, domain: TpuSliceDomain) -> None:
+        """computedomain.go:210-224."""
+        fresh = self.kube.get(TPU_SLICE_DOMAINS, domain.name,
+                              domain.namespace)
+        finalizers = fresh["metadata"].setdefault("finalizers", [])
+        if FINALIZER in finalizers:
+            return
+        finalizers.append(FINALIZER)
+        self.kube.update(TPU_SLICE_DOMAINS, fresh)
+        self.informer.store.mutate(
+            self.kube.get(TPU_SLICE_DOMAINS, domain.name, domain.namespace))
+
+    def _ensure_status(self, domain: TpuSliceDomain) -> None:
+        if domain.status is not None and domain.status.status:
+            return
+        fresh = TpuSliceDomain.from_dict(
+            self.kube.get(TPU_SLICE_DOMAINS, domain.name, domain.namespace))
+        if fresh.status is None or not fresh.status.status:
+            fresh.status = fresh.status or TpuSliceDomainStatus()
+            fresh.status.status = STATUS_NOT_READY
+            self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+
+    def _teardown(self, domain: TpuSliceDomain) -> None:
+        """Strict deletion order (computedomain.go:234-268).  Any failed
+        assertion raises → the workqueue retries with backoff forever."""
+        self.workload_rct.delete(domain)
+        self.ds_manager.delete(domain)
+        self.node_manager.remove_domain_labels(domain.uid)
+        self.workload_rct.remove_finalizer(domain)
+        self.workload_rct.assert_removed(domain)
+        self.ds_manager.rct.remove_finalizer(domain)
+        self.ds_manager.rct.assert_removed(domain)
+        self.ds_manager.remove_finalizer(domain)
+        self.ds_manager.assert_removed(domain)
+        self._remove_domain_finalizer(domain)
+        klog.info("slice domain torn down", domain=domain.name,
+                  uid=domain.uid)
+
+    def _remove_domain_finalizer(self, domain: TpuSliceDomain) -> None:
+        try:
+            fresh = self.kube.get(TPU_SLICE_DOMAINS, domain.name,
+                                  domain.namespace)
+        except NotFound:
+            return
+        finalizers = fresh["metadata"].get("finalizers", [])
+        if FINALIZER not in finalizers:
+            return
+        finalizers.remove(FINALIZER)
+        try:
+            self.kube.update(TPU_SLICE_DOMAINS, fresh)
+        except Conflict:
+            # raced with a status write; workqueue retry will re-fetch
+            raise

@@ -1,0 +1,10 @@
+"""slice-domain-daemon — per-node, per-domain coordination agent.
+
+Analog of reference ``cmd/compute-domain-daemon`` (SURVEY.md §2.4), the
+repo's distributed runtime agent: it publishes this node's
+{name, podIP, fabricID, workerID} into the TpuSliceDomain CR status (the CR
+status IS the membership/rendezvous bus — daemon computedomain.go:145-220),
+and on every full-membership change regenerates the coordination config and
+restarts the supervised coordination service (the ``nvidia-imex`` analog:
+here a JAX-rendezvous HTTP service over the domain's nodes).
+"""

@@ -1,0 +1,136 @@
+"""The coordination service — the supervised fabric daemon.
+
+The ``nvidia-imex`` analog (reference daemon main.go:39-44: the daemon
+supervises the IMEX binary, which forms the fabric).  On TPU there is no
+vendor fabric daemon: what multi-node JAX needs is **rendezvous** — every
+process must learn the coordinator address (rank-0 ip:port) and its own
+process index before calling ``jax.distributed.initialize``
+(SURVEY.md §2.7.2).  This service provides exactly that over the domain:
+
+- ``GET /ready``      → ``READY`` once a full nodes config is loaded (the
+  ``nvidia-imex-ctl -q`` probe analog, main.go:255-289)
+- ``GET /nodes``      → the membership list (JSON)
+- ``GET /coordinator``→ ``ip:port`` of the rank-0 node's JAX coordinator
+- ``GET /whoami?ip=`` → the process index for a member ip
+
+Run standalone:
+``python -m tpu_dra.daemon.coordservice --settings-dir /etc/tpu-slice``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+NODES_CONFIG = "nodes_config.json"
+JAX_COORDINATOR_PORT = 8476   # jax.distributed default
+
+
+class CoordState:
+    def __init__(self, settings_dir: str) -> None:
+        self.settings_dir = settings_dir
+        self._mu = threading.Lock()
+        self._nodes: list[dict] = []
+        self._mtime = 0.0
+        self.reload()
+
+    def reload(self) -> bool:
+        path = os.path.join(self.settings_dir, NODES_CONFIG)
+        try:
+            mtime = os.path.getmtime(path)
+            if mtime == self._mtime:
+                return bool(self._nodes)
+            with open(path) as f:
+                data = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return False
+        with self._mu:
+            self._nodes = data.get("nodes", [])
+            self._mtime = mtime
+        return bool(self._nodes)
+
+    def nodes(self) -> list[dict]:
+        self.reload()
+        with self._mu:
+            return list(self._nodes)
+
+    def ready(self) -> bool:
+        return bool(self.nodes())
+
+    def coordinator(self) -> str:
+        nodes = self.nodes()
+        if not nodes:
+            return ""
+        rank0 = min(nodes, key=lambda n: n.get("workerID", 1 << 30))
+        return f"{rank0['ipAddress']}:{JAX_COORDINATOR_PORT}"
+
+    def process_index(self, ip: str) -> int:
+        for i, node in enumerate(
+                sorted(self.nodes(), key=lambda n: n.get("workerID", 0))):
+            if node.get("ipAddress") == ip:
+                return i
+        return -1
+
+
+def serve(settings_dir: str, port: int,
+          address: str = "0.0.0.0") -> ThreadingHTTPServer:
+    state = CoordState(settings_dir)
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, body: str,
+                  ctype: str = "text/plain") -> None:
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            parsed = urlparse(self.path)
+            if parsed.path == "/ready":
+                if state.ready():
+                    self._send(200, "READY\n")
+                else:
+                    self._send(503, "NOT_READY\n")
+            elif parsed.path == "/nodes":
+                self._send(200, json.dumps({"nodes": state.nodes()}),
+                           "application/json")
+            elif parsed.path == "/coordinator":
+                coord = state.coordinator()
+                self._send(200 if coord else 503, coord or "NO_COORDINATOR")
+            elif parsed.path == "/whoami":
+                ip = parse_qs(parsed.query).get("ip", [""])[0]
+                idx = state.process_index(ip)
+                self._send(200 if idx >= 0 else 404, str(idx))
+            else:
+                self._send(404, "not found")
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer((address, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="coordservice").start()
+    return server
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--settings-dir",
+                   default=os.environ.get("SLICE_SETTINGS_DIR",
+                                          "/etc/tpu-slice"))
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("SLICE_COORDINATOR_PORT",
+                                              "51000")))
+    args = p.parse_args()
+    serve(args.settings_dir, args.port)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""slice-domain-daemon entry point (``run`` / ``check``).
+
+Analog of reference ``cmd/compute-domain-daemon/main.go:39-358``:
+
+- ``run``: env-driven config (injected via the daemon claim's CDI edits);
+  an empty fabric ID means this node isn't multi-host-ICI capable, so the
+  daemon just sleeps (heterogeneous domains, main.go:159-165).  Otherwise
+  three cooperating loops run: the membership controller, the coordination
+  update loop (regenerate nodes config + restart the coordination service on
+  every full-membership change, main.go:231-251), and the process watchdog.
+- ``check``: probe ``GET /ready`` on the local coordination service and
+  require ``READY`` — used as the startup + liveness probe
+  (main.go:255-289).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+import urllib.request
+
+from tpu_dra.api.types import TpuSliceDomainNode
+from tpu_dra.daemon.membership import MembershipManager
+from tpu_dra.daemon.process import ProcessManager
+from tpu_dra.k8s.client import new_clients
+from tpu_dra.tpulib.discovery import RealTpuLib
+from tpu_dra.util import klog
+
+
+def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
+                       my_fabric: str) -> str:
+    """The ``writeNodesConfig`` analog (main.go:292-322): only same-fabric
+    nodes participate (clique filtering), sorted by worker id so rank-0 is
+    deterministic."""
+    members = sorted(
+        (n for n in nodes if n.fabric_id == my_fabric),
+        key=lambda n: (n.worker_id, n.name))
+    path = os.path.join(settings_dir, "nodes_config.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(settings_dir, exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump({"nodes": [n.to_dict() for n in members]}, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
+def run(argv=None) -> int:
+    env = os.environ
+    domain_uid = env.get("SLICE_DOMAIN_UUID", "")
+    domain_name = env.get("SLICE_DOMAIN_NAME", "")
+    domain_namespace = env.get("SLICE_DOMAIN_NAMESPACE", "")
+    node_name = env.get("NODE_NAME", "")
+    pod_ip = env.get("POD_IP", "")
+    settings_dir = env.get("SLICE_SETTINGS_DIR", "/etc/tpu-slice")
+    port = int(env.get("SLICE_COORDINATOR_PORT", "51000"))
+    kubeconfig = env.get("KUBECONFIG", "")
+    klog.configure(int(env.get("VERBOSITY", "2")))
+
+    tpulib = RealTpuLib(
+        driver_root=env.get("TPU_DRIVER_ROOT", "/"),
+        env={} if env.get("TPU_IGNORE_HOST_ENV") else None)
+    fabric = tpulib.fabric_id()
+    if not fabric:
+        # not multi-host-ICI capable: park forever (main.go:159-165)
+        klog.info("node has no multi-host fabric; sleeping",
+                  node=node_name, domain=domain_uid)
+        threading.Event().wait()
+        return 0
+
+    kube = new_clients(kubeconfig or None)
+    membership = MembershipManager(
+        kube, domain_name, domain_namespace, node_name, pod_ip,
+        fabric, tpulib.worker_id())
+    coordservice = ProcessManager(
+        argv_fn=lambda: [sys.executable, "-m",
+                         "tpu_dra.daemon.coordservice",
+                         "--settings-dir", settings_dir,
+                         "--port", str(port)],
+        name="coordservice")
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    def update_loop() -> None:
+        """IMEXDaemonUpdateLoop analog (main.go:231-251)."""
+        while not stop.is_set():
+            try:
+                nodes = membership.updates.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            write_nodes_config(settings_dir, nodes, fabric)
+            klog.info("membership changed; restarting coordination service",
+                      members=len(nodes))
+            coordservice.restart()
+
+    membership.start()
+    coordservice.start_watchdog()
+    updater = threading.Thread(target=update_loop, daemon=True,
+                               name="coord-update-loop")
+    updater.start()
+    klog.info("slice-domain-daemon running", node=node_name,
+              domain=domain_uid, fabric=fabric)
+    stop.wait()
+    coordservice.stop_watchdog()
+    coordservice.stop()
+    membership.stop()
+    return 0
+
+
+def check(argv=None) -> int:
+    """Startup/liveness probe (main.go:255-289)."""
+    port = int(os.environ.get("SLICE_COORDINATOR_PORT", "51000"))
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/ready", timeout=2) as resp:
+            body = resp.read().decode()
+    except Exception as exc:  # noqa: BLE001 — probe failure path
+        print(f"NOT READY: {exc}", file=sys.stderr)
+        return 1
+    if body.strip() != "READY":
+        print(f"NOT READY: {body!r}", file=sys.stderr)
+        return 1
+    print("READY")
+    return 0
+
+
+def main() -> int:
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "run"
+    if cmd == "run":
+        return run(sys.argv[2:])
+    if cmd == "check":
+        return check(sys.argv[2:])
+    print(f"unknown subcommand {cmd!r}; want run|check", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

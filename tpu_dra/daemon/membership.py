@@ -1,0 +1,112 @@
+"""Membership/rendezvous via the CR status subresource.
+
+Analog of reference ``cmd/compute-domain-daemon/computedomain.go:42-233``:
+each daemon pod writes ``{nodeName, podIP, fabricID, workerID}`` into
+``TpuSliceDomain.status.nodes`` (a list-map keyed by node name); once
+``len(status.nodes) == spec.numNodes`` **and** the IP set changed, the full
+node list is pushed to a channel consumed by the coordination update loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from tpu_dra.api.types import (
+    TpuSliceDomain,
+    TpuSliceDomainNode,
+    TpuSliceDomainStatus,
+)
+from tpu_dra.k8s.client import Conflict, KubeClient, TPU_SLICE_DOMAINS
+from tpu_dra.k8s.informer import Informer
+from tpu_dra.util import klog
+
+
+class MembershipManager:
+    def __init__(self, kube: KubeClient, domain_name: str,
+                 domain_namespace: str, node_name: str, pod_ip: str,
+                 fabric_id: str, worker_id: int) -> None:
+        self.kube = kube
+        self.domain_name = domain_name
+        self.domain_namespace = domain_namespace
+        self.self_node = TpuSliceDomainNode(
+            name=node_name, ip_address=pod_ip, fabric_id=fabric_id,
+            worker_id=worker_id)
+        # field-selector-scoped informer on our own CR (daemon
+        # computedomain.go:42-75)
+        self.informer = Informer(
+            kube, TPU_SLICE_DOMAINS, namespace=domain_namespace,
+            field_selector={"metadata.name": domain_name})
+        self.informer.add_event_handler(
+            on_add=self._on_change,
+            on_update=lambda old, new: self._on_change(new))
+        self._updates: "queue.Queue[list[TpuSliceDomainNode]]" = queue.Queue()
+        self._last_ips: Optional[frozenset[str]] = None
+        self._mu = threading.Lock()
+
+    def start(self) -> None:
+        self.informer.start()
+        self.informer.wait_for_sync()
+        self.update_own_node_info()
+
+    def stop(self) -> None:
+        self.informer.stop()
+
+    @property
+    def updates(self) -> "queue.Queue[list[TpuSliceDomainNode]]":
+        """The rendezvous channel (GetNodesUpdateChan analog)."""
+        return self._updates
+
+    # -- status writes (computedomain.go:145-193) --------------------------
+    def update_own_node_info(self, retries: int = 5) -> None:
+        for _ in range(retries):
+            try:
+                obj = self.kube.get(TPU_SLICE_DOMAINS, self.domain_name,
+                                    self.domain_namespace)
+                domain = TpuSliceDomain.from_dict(obj)
+                if domain.status is None:
+                    domain.status = TpuSliceDomainStatus()
+                nodes = [n for n in domain.status.nodes
+                         if n.name != self.self_node.name]
+                nodes.append(self.self_node)
+                nodes.sort(key=lambda n: n.name)
+                if [n.to_dict() for n in nodes] == \
+                        [n.to_dict() for n in domain.status.nodes]:
+                    return
+                domain.status.nodes = nodes
+                self.kube.update_status(TPU_SLICE_DOMAINS, domain.to_dict())
+                klog.info("published node info to domain status", level=2,
+                          node=self.self_node.name, ip=self.self_node.ip_address)
+                return
+            except Conflict:
+                continue   # raced another daemon; re-fetch and retry
+        klog.warning("could not publish node info after retries",
+                     node=self.self_node.name)
+
+    # -- membership detection (computedomain.go:198-220) -------------------
+    def _on_change(self, obj: dict) -> None:
+        domain = TpuSliceDomain.from_dict(obj)
+        # pod IP changes across restarts must be re-propagated
+        # (computedomain.go:177-180)
+        mine = next((n for n in (domain.status.nodes if domain.status else [])
+                     if n.name == self.self_node.name), None)
+        if mine is None or mine.ip_address != self.self_node.ip_address:
+            self.update_own_node_info()
+            return
+        self.maybe_push_nodes_update(domain)
+
+    def maybe_push_nodes_update(self, domain: TpuSliceDomain) -> None:
+        if domain.status is None:
+            return
+        nodes = domain.status.nodes
+        if len(nodes) != domain.spec.num_nodes:
+            return
+        ips = frozenset(n.ip_address for n in nodes)
+        with self._mu:
+            if ips == self._last_ips:
+                return
+            self._last_ips = ips
+        klog.info("full membership reached", level=2,
+                  nodes=[n.name for n in nodes])
+        self._updates.put(list(nodes))

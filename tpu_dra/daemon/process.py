@@ -1,0 +1,95 @@
+"""Child-process supervision.
+
+Analog of reference ``cmd/compute-domain-daemon/process.go:33-201``
+(``ProcessManager``): start with inherited stdio, reap via a wait thread,
+mutex-guarded stop (SIGTERM then wait), and a 1s-tick watchdog that restarts
+the child on unexpected exit.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Callable, Optional
+
+from tpu_dra.util import klog
+
+
+class ProcessManager:
+    def __init__(self, argv_fn: Callable[[], list[str]],
+                 name: str = "coordservice",
+                 watchdog_interval: float = 1.0) -> None:
+        self.argv_fn = argv_fn
+        self.name = name
+        self.watchdog_interval = watchdog_interval
+        self._mu = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._stopping = False
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    # -- lifecycle (process.go:59-141) -------------------------------------
+    def restart(self) -> None:
+        """Stop the current child (if any) and start a fresh one
+        (process.go:50-57)."""
+        with self._mu:
+            self._stop_locked()
+            self._start_locked()
+
+    def _start_locked(self) -> None:
+        argv = self.argv_fn()
+        self._proc = subprocess.Popen(argv)
+        self._stopping = False
+        klog.info("started child process", name=self.name,
+                  pid=self._proc.pid, argv=argv)
+
+    def _stop_locked(self, timeout: float = 10.0) -> None:
+        if self._proc is None:
+            return
+        self._stopping = True
+        proc = self._proc
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(5)
+        self._proc = None
+
+    def stop(self) -> None:
+        with self._mu:
+            self._stop_locked()
+
+    def alive(self) -> bool:
+        with self._mu:
+            return self._proc is not None and self._proc.poll() is None
+
+    # -- watchdog (process.go:147-201) -------------------------------------
+    def start_watchdog(self) -> None:
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog, daemon=True,
+            name=f"watchdog-{self.name}")
+        self._watchdog_thread.start()
+
+    def stop_watchdog(self) -> None:
+        self._watchdog_stop.set()
+
+    def _watchdog(self) -> None:
+        while not self._watchdog_stop.wait(self.watchdog_interval):
+            # TryLock-style lost() detection: if the manager is mid-restart
+            # we skip this tick rather than block (process.go:183-201)
+            if not self._mu.acquire(blocking=False):
+                continue
+            try:
+                proc = self._proc
+                if proc is None or self._stopping:
+                    continue
+                if proc.poll() is not None:
+                    klog.warning("child exited unexpectedly; restarting",
+                                 name=self.name, code=proc.returncode)
+                    self.restarts += 1
+                    self._start_locked()
+            finally:
+                self._mu.release()

@@ -250,8 +250,10 @@ class RestKubeClient(KubeClient):
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
+            # streams get a long read timeout (not None): a half-open
+            # connection must eventually unblock the watch loop
             resp = urllib.request.urlopen(
-                req, timeout=None if stream else self.timeout,
+                req, timeout=300.0 if stream else self.timeout,
                 context=self._ssl)
         except urllib.error.HTTPError as exc:
             msg = ""
@@ -311,9 +313,17 @@ class RestKubeClient(KubeClient):
         resp = self._request("GET", res.path(namespace), query=query,
                              stream=True)
         try:
-            for line in resp:
+            while True:
                 if stop is not None and stop.is_set():
                     return
+                try:
+                    line = resp.readline()
+                except (TimeoutError, OSError):
+                    # read timeout / half-open connection: end this watch so
+                    # the informer relists instead of hanging forever
+                    return
+                if not line:
+                    return   # server closed the stream
                 line = line.strip()
                 if not line:
                     continue

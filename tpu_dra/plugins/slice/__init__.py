@@ -1,0 +1,10 @@
+"""slice-domain-kubelet-plugin — node-side slice-domain membership.
+
+Analog of reference ``cmd/compute-domain-kubelet-plugin`` (SURVEY.md §2.3):
+publishes the daemon device + default channel for the
+``slice-domain.tpu.google.com`` driver, and implements the codependent
+channel/daemon prepare dance: a channel prepare labels the node (letting the
+per-domain DaemonSet schedule) and then blocks on domain readiness with
+retry-until-deadline; a daemon prepare materializes the per-domain
+coordination settings the daemon pod and workloads mount.
+"""

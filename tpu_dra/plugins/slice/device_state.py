@@ -1,0 +1,191 @@
+"""Slice-plugin prepare/unprepare state machine.
+
+Analog of reference
+``cmd/compute-domain-kubelet-plugin/device_state.go:47-508``: the same
+checkpoint/config-mapping skeleton as the TPU plugin but for
+Channel/Daemon configs.
+
+- **Channel apply** (device_state.go:365-393): assert the domain's namespace
+  matches the claim's (permanent error on mismatch), label the node (one
+  domain per node), wait for domain Ready (retryable), emit coordination CDI
+  edits.  There is no IMEX channel device to mknod on TPU — the channel is a
+  logical handle whose prepare gates on readiness (SURVEY.md §7.5a).
+- **Daemon apply** (device_state.go:395-448): write the per-domain settings
+  dir (coordination config) and emit env + settings-mount edits.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpu_dra.api import decode
+from tpu_dra.api.configs import (
+    ConfigError,
+    SliceChannelConfig,
+    SliceDaemonConfig,
+)
+from tpu_dra.cdi.spec import CDIHandler, ContainerEdits
+from tpu_dra.plugins.slice.slicedomain import NodeSliceDomainManager
+from tpu_dra.plugins.tpu.allocatable import PreparedClaim, PreparedDevice
+from tpu_dra.plugins.tpu.checkpoint import Checkpoint
+from tpu_dra.util import klog
+from tpu_dra.util.workqueue import PermanentError
+from tpu_dra.version import SLICE_DRIVER_NAME
+
+TYPE_CHANNEL = "channel"
+TYPE_DAEMON = "daemon"
+
+DEVICE_CHANNEL0 = "channel-0"
+DEVICE_DAEMON = "slice-daemon"
+
+
+class SliceDeviceState:
+    """Only the daemon device and channel 0 are advertised from the node —
+    channels ≠ 0 are deliberately not published (reference
+    driver.go:99-104)."""
+
+    def __init__(self, manager: NodeSliceDomainManager, plugin_dir: str,
+                 cdi_root: str, driver_root: str = "/") -> None:
+        self._mu = threading.Lock()
+        self.manager = manager
+        self.cdi = CDIHandler(cdi_root, driver_root)
+        self.checkpoint = Checkpoint(f"{plugin_dir}/checkpoint.json")
+        if not self.checkpoint.load():
+            self.checkpoint.save()
+        for uid in self.cdi.list_claim_specs():
+            if uid not in self.checkpoint.prepared:
+                self.cdi.delete_claim_spec(uid)
+
+    # -- device publication ------------------------------------------------
+    @staticmethod
+    def allocatable_devices() -> list[dict]:
+        """deviceinfo.go:26-82 — attributes {type, id} only."""
+        return [
+            {"name": DEVICE_DAEMON,
+             "basic": {"attributes": {"type": {"string": TYPE_DAEMON},
+                                      "id": {"int": 0}}}},
+            {"name": DEVICE_CHANNEL0,
+             "basic": {"attributes": {"type": {"string": TYPE_CHANNEL},
+                                      "id": {"int": 0}}}},
+        ]
+
+    # -- prepare/unprepare -------------------------------------------------
+    def prepare(self, claim: dict) -> list[PreparedDevice]:
+        with self._mu:
+            uid = claim["metadata"]["uid"]
+            existing = self.checkpoint.get(uid)
+            if existing is not None:
+                return existing.devices
+            devices, edits = self._prepare_devices(claim)
+            self.cdi.create_claim_spec(uid, edits)
+            self.checkpoint.put(PreparedClaim(
+                claim_uid=uid,
+                namespace=claim["metadata"].get("namespace", ""),
+                name=claim["metadata"].get("name", ""),
+                devices=devices))
+            return devices
+
+    def unprepare(self, claim_uid: str) -> None:
+        """device_state.go:327-352: channel → remove node label; daemon →
+        remove per-domain settings dir."""
+        with self._mu:
+            existing = self.checkpoint.get(claim_uid)
+            if existing is None:
+                return
+            for dev in existing.devices:
+                domain_uid = dev.parent_uuid   # holds the domain uid here
+                if dev.type == TYPE_CHANNEL:
+                    self.manager.remove_node_label(domain_uid)
+                elif dev.type == TYPE_DAEMON:
+                    self.manager.unprepare_settings(domain_uid)
+            self.cdi.delete_claim_spec(claim_uid)
+            self.checkpoint.remove(claim_uid)
+
+    def prepared_claims(self) -> dict[str, PreparedClaim]:
+        with self._mu:
+            return dict(self.checkpoint.prepared)
+
+    # -- internals ---------------------------------------------------------
+    def _prepare_devices(
+        self, claim: dict,
+    ) -> tuple[list[PreparedDevice], dict[str, ContainerEdits]]:
+        uid = claim["metadata"]["uid"]
+        namespace = claim["metadata"].get("namespace", "")
+        alloc = claim.get("status", {}).get("allocation")
+        if not alloc:
+            raise PermanentError(f"claim {uid} has no allocation")
+        results = [r for r in alloc.get("devices", {}).get("results", [])
+                   if r.get("driver") == SLICE_DRIVER_NAME]
+        if not results:
+            raise PermanentError(
+                f"claim {uid}: no results for driver {SLICE_DRIVER_NAME}")
+        configs = self._configs_by_request(claim)
+        prepared: list[PreparedDevice] = []
+        edits_out: dict[str, ContainerEdits] = {}
+        for result in results:
+            request = result.get("request", "")
+            device = result.get("device", "")
+            config = configs.get(request) or configs.get("")
+            if config is None:
+                raise PermanentError(
+                    f"claim {uid}: request {request!r} has no "
+                    f"SliceChannelConfig/SliceDaemonConfig")
+            config.normalize()
+            config.validate()
+            domain_uid = config.domain_id
+            if isinstance(config, SliceChannelConfig):
+                if device != DEVICE_CHANNEL0:
+                    raise PermanentError(
+                        f"claim {uid}: channel config applied to {device!r}")
+                edits = self._apply_channel(uid, namespace, domain_uid)
+                dev_type = TYPE_CHANNEL
+            elif isinstance(config, SliceDaemonConfig):
+                if device != DEVICE_DAEMON:
+                    raise PermanentError(
+                        f"claim {uid}: daemon config applied to {device!r}")
+                edits = self._apply_daemon(domain_uid)
+                dev_type = TYPE_DAEMON
+            else:
+                raise ConfigError(
+                    f"config kind {type(config).__name__} not valid for "
+                    f"{SLICE_DRIVER_NAME}")
+            prepared.append(PreparedDevice(
+                type=dev_type,
+                uuid=f"{domain_uid}-{device}",
+                canonical_name=device,
+                request_names=[request],
+                cdi_device_ids=[self.cdi.claim_device_id(uid, device)],
+                parent_uuid=domain_uid,
+            ))
+            edits_out[device] = edits
+        return prepared, edits_out
+
+    def _configs_by_request(self, claim: dict) -> dict:
+        """Map request name → decoded slice config ('' = all requests)."""
+        out: dict[str, object] = {}
+        entries = claim.get("status", {}).get("allocation", {}) \
+            .get("devices", {}).get("config") or []
+        for entry in entries:
+            opaque = entry.get("opaque")
+            if not opaque or opaque.get("driver") != SLICE_DRIVER_NAME:
+                continue
+            config = decode(opaque.get("parameters", {}))
+            requests = entry.get("requests") or [""]
+            for req in requests:
+                out[req] = config
+        return out
+
+    def _apply_channel(self, claim_uid: str, claim_namespace: str,
+                       domain_uid: str) -> ContainerEdits:
+        """device_state.go:365-393 — the codependent-prepare sequence."""
+        self.manager.assert_domain_namespace(domain_uid, claim_namespace)
+        self.manager.add_node_label(domain_uid)
+        self.manager.assert_domain_ready(domain_uid)   # retried by caller
+        klog.info("channel prepared", level=4, claim=claim_uid,
+                  domain=domain_uid)
+        return self.manager.channel_edits(domain_uid)
+
+    def _apply_daemon(self, domain_uid: str) -> ContainerEdits:
+        """device_state.go:395-448."""
+        self.manager.prepare_settings(domain_uid)
+        return self.manager.daemon_edits(domain_uid)

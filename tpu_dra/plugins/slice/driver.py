@@ -1,0 +1,150 @@
+"""DRA driver shim for the slice-domain kubelet plugin.
+
+Analog of reference ``cmd/compute-domain-kubelet-plugin/driver.go:37-239``.
+The crucial difference from the TPU plugin: prepares are **codependent** — a
+channel prepare blocks until the domain is Ready, which requires daemon
+prepares on other nodes to complete first (rationale comment
+driver.go:84-90).  So every claim runs through a retry workqueue with a
+45-second deadline (``ErrorRetryMaxTimeout``, driver.go:37-48); a
+``PermanentError`` short-circuits retries (driver.go:50-57).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+from tpu_dra.k8s.client import KubeClient
+from tpu_dra.kubeletplugin import (
+    ClaimRef,
+    DriverCallbacks,
+    KubeletPluginServer,
+    PrepareResult,
+)
+from tpu_dra.plugins.slice.device_state import SliceDeviceState
+from tpu_dra.plugins.slice.slicedomain import NodeSliceDomainManager
+from tpu_dra.util import klog
+from tpu_dra.util.flock import locked
+from tpu_dra.util.workqueue import WorkQueue
+from tpu_dra.version import SLICE_DRIVER_NAME
+
+ERROR_RETRY_MAX_TIMEOUT = 45.0   # driver.go:37-48
+
+
+@dataclass
+class SliceDriverConfig:
+    node_name: str
+    kube: KubeClient
+    plugins_dir: str = "/var/lib/kubelet/plugins"
+    registry_dir: str = "/var/lib/kubelet/plugins_registry"
+    cdi_root: str = "/var/run/cdi"
+    driver_root: str = "/"
+    flock_timeout: float = 10.0
+    retry_timeout: float = ERROR_RETRY_MAX_TIMEOUT
+    cleanup_period: float = 600.0
+
+
+class SliceDriver:
+    def __init__(self, cfg: SliceDriverConfig) -> None:
+        self.cfg = cfg
+        self.plugin_dir = os.path.join(cfg.plugins_dir, SLICE_DRIVER_NAME)
+        os.makedirs(self.plugin_dir, exist_ok=True)
+        self.flock_path = os.path.join(self.plugin_dir, "pu.lock")
+        self.manager = NodeSliceDomainManager(cfg.kube, cfg.node_name,
+                                              self.plugin_dir)
+        self.state = SliceDeviceState(self.manager, self.plugin_dir,
+                                      cfg.cdi_root, cfg.driver_root)
+        self.queue = WorkQueue("slice-prepare")
+        self.server = KubeletPluginServer(
+            driver_name=SLICE_DRIVER_NAME,
+            node_name=cfg.node_name,
+            kube=cfg.kube,
+            plugins_dir=cfg.plugins_dir,
+            registry_dir=cfg.registry_dir,
+            callbacks=DriverCallbacks(
+                prepare=self.prepare_resource_claims,
+                unprepare=self.unprepare_resource_claims))
+        self._cleanup_timer: threading.Timer | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.manager.start()
+        self.queue.run_in_background()
+        self.server.start()
+        self.server.publish_resources(self.state.allocatable_devices())
+        self._schedule_cleanup()
+
+    def stop(self) -> None:
+        if self._cleanup_timer is not None:
+            self._cleanup_timer.cancel()
+        self.server.stop()
+        self.queue.shutdown()
+        self.manager.stop()
+
+    def _schedule_cleanup(self) -> None:
+        def tick() -> None:
+            try:
+                self.manager.cleanup_stale()
+            except Exception as exc:  # noqa: BLE001 — periodic task
+                klog.warning("periodic cleanup failed", err=repr(exc))
+            self._schedule_cleanup()
+        self._cleanup_timer = threading.Timer(self.cfg.cleanup_period, tick)
+        self._cleanup_timer.daemon = True
+        self._cleanup_timer.start()
+
+    # -- DRA callbacks -----------------------------------------------------
+    def prepare_resource_claims(self, claims: list[dict]
+                                ) -> dict[str, PrepareResult]:
+        """driver.go:136-195: every claim retries on its own schedule; the
+        gRPC response waits for all claims to succeed, fail permanently, or
+        exhaust the retry deadline."""
+        results: dict[str, PrepareResult] = {}
+        done = threading.Event()
+        pending = {claim["metadata"]["uid"] for claim in claims}
+        lock = threading.Lock()
+
+        def finish(uid: str, result: PrepareResult) -> None:
+            with lock:
+                results[uid] = result
+                pending.discard(uid)
+                if not pending:
+                    done.set()
+
+        for claim in claims:
+            uid = claim["metadata"]["uid"]
+
+            def attempt(obj: dict, _uid: str = uid) -> None:
+                with locked(self.flock_path,
+                            timeout=self.cfg.flock_timeout):
+                    devices = self.state.prepare(obj)
+                finish(_uid, PrepareResult(devices=[
+                    {"request_names": d.request_names,
+                     "pool_name": self.cfg.node_name,
+                     "device_name": d.canonical_name,
+                     "cdi_device_ids": d.cdi_device_ids}
+                    for d in devices]))
+
+            self.queue.enqueue_with_deadline(
+                attempt, claim, timeout=self.cfg.retry_timeout, key=uid,
+                on_error=lambda exc, _uid=uid: finish(
+                    _uid, PrepareResult(
+                        error=f"error preparing claim {_uid}: {exc}")))
+        done.wait(self.cfg.retry_timeout + 5.0)
+        with lock:
+            for uid in list(pending):
+                results[uid] = PrepareResult(
+                    error=f"claim {uid}: prepare timed out")
+        return results
+
+    def unprepare_resource_claims(self, refs: list[ClaimRef]
+                                  ) -> dict[str, str]:
+        errors: dict[str, str] = {}
+        for ref in refs:
+            try:
+                with locked(self.flock_path,
+                            timeout=self.cfg.flock_timeout):
+                    self.state.unprepare(ref.uid)
+            except Exception as exc:  # noqa: BLE001 — reported per claim
+                errors[ref.uid] = f"error unpreparing {ref.uid}: {exc}"
+        return errors

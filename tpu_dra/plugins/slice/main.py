@@ -1,0 +1,44 @@
+"""slice-domain-kubelet-plugin entry point.
+
+Analog of reference ``cmd/compute-domain-kubelet-plugin/main.go:35-235``.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+from tpu_dra.k8s.client import new_clients
+from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
+from tpu_dra.util import flags, klog
+
+
+def main(argv=None) -> int:
+    args = flags.parse(
+        "slice-domain-kubelet-plugin",
+        [flags.plugin_common_flags(), flags.kube_client_flags(),
+         flags.logging_flags()],
+        argv, description=__doc__)
+    klog.configure(args.v, args.logging_format)
+    kube = new_clients(args.kubeconfig, args.kube_api_qps,
+                       args.kube_api_burst)
+    driver = SliceDriver(SliceDriverConfig(
+        node_name=args.node_name,
+        kube=kube,
+        plugins_dir=args.kubelet_plugins_dir,
+        registry_dir=args.kubelet_registry_dir,
+        cdi_root=args.cdi_root,
+        driver_root=args.tpu_driver_root))
+    driver.start()
+    klog.info("slice-domain-kubelet-plugin started", node=args.node_name)
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    driver.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Node-side slice-domain bookkeeping.
+
+Analog of reference
+``cmd/compute-domain-kubelet-plugin/computedomain.go:40-389``: a uid-indexed
+CRD informer, per-domain settings dirs holding the coordination config
+(the ``/etc/nvidia-imex`` analog, computedomain.go:158-192), node label
+add/remove with the one-domain-per-node invariant (computedomain.go:265-311),
+Ready/namespace assertions, and periodic cleanup of stale dirs/labels.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from tpu_dra.api.types import STATUS_READY, TpuSliceDomain
+from tpu_dra.cdi.spec import ContainerEdits
+from tpu_dra.controller.constants import DOMAIN_LABEL
+from tpu_dra.k8s.client import KubeClient, NODES, TPU_SLICE_DOMAINS
+from tpu_dra.k8s.informer import Informer, uid_index
+from tpu_dra.util import klog
+from tpu_dra.util.template import render_file
+from tpu_dra.util.workqueue import PermanentError
+
+COORDINATOR_PORT = 51000
+SETTINGS_MOUNT = "/etc/tpu-slice"   # where workloads see the settings dir
+
+
+class NodeSliceDomainManager:
+    def __init__(self, kube: KubeClient, node_name: str,
+                 plugin_dir: str) -> None:
+        self.kube = kube
+        self.node_name = node_name
+        self.domains_dir = os.path.join(plugin_dir, "domains")
+        os.makedirs(self.domains_dir, exist_ok=True)
+        self.informer = Informer(kube, TPU_SLICE_DOMAINS,
+                                 indexers={"uid": uid_index})
+
+    def start(self) -> None:
+        self.informer.start()
+        self.informer.wait_for_sync()
+
+    def stop(self) -> None:
+        self.informer.stop()
+
+    # -- lookups / assertions ---------------------------------------------
+    def get_by_uid(self, uid: str) -> TpuSliceDomain | None:
+        objs = self.informer.store.by_index("uid", uid)
+        return TpuSliceDomain.from_dict(objs[0]) if objs else None
+
+    def assert_domain_namespace(self, uid: str, claim_namespace: str) -> None:
+        """computedomain.go:233-263 — a channel claim must live in the
+        domain's own namespace; violation is permanent (never retried)."""
+        domain = self.get_by_uid(uid)
+        if domain is None:
+            raise RuntimeError(f"slice domain {uid} not found (yet)")
+        if domain.namespace != claim_namespace:
+            raise PermanentError(
+                f"claim namespace {claim_namespace!r} does not match slice "
+                f"domain namespace {domain.namespace!r}")
+
+    def assert_domain_ready(self, uid: str) -> None:
+        """computedomain.go:194-231 — retried by the caller's workqueue."""
+        domain = self.get_by_uid(uid)
+        if domain is None:
+            raise RuntimeError(f"slice domain {uid} not found (yet)")
+        if domain.status is None or domain.status.status != STATUS_READY:
+            raise RuntimeError(
+                f"slice domain {uid} is not Ready "
+                f"(status={domain.status.status if domain.status else None})")
+
+    # -- node labels (computedomain.go:265-311) ----------------------------
+    def add_node_label(self, uid: str) -> None:
+        node = self.kube.get(NODES, self.node_name)
+        labels = node["metadata"].setdefault("labels", {})
+        current = labels.get(DOMAIN_LABEL)
+        if current == uid:
+            return
+        if current:
+            # one domain per node at a time — the isolation invariant
+            # (computedomain.go:271-274); permanent for THIS domain only
+            # if the other domain still exists
+            raise PermanentError(
+                f"node {self.node_name} already bound to slice domain "
+                f"{current}")
+        self.kube.patch(NODES, self.node_name,
+                        {"metadata": {"labels": {DOMAIN_LABEL: uid}}})
+        klog.info("labeled node for slice domain", node=self.node_name,
+                  domain=uid)
+
+    def remove_node_label(self, uid: str) -> None:
+        node = self.kube.get(NODES, self.node_name)
+        if node["metadata"].get("labels", {}).get(DOMAIN_LABEL) != uid:
+            return
+        self.kube.patch(NODES, self.node_name,
+                        {"metadata": {"labels": {DOMAIN_LABEL: None}}})
+
+    # -- per-domain settings (computedomain.go:50-68,158-192) --------------
+    def domain_dir(self, uid: str) -> str:
+        return os.path.join(self.domains_dir, uid)
+
+    def prepare_settings(self, uid: str) -> str:
+        """Write the per-domain coordination config dir (the nodes_config/
+        config.cfg analog)."""
+        domain = self.get_by_uid(uid)
+        if domain is None:
+            raise RuntimeError(f"slice domain {uid} not found (yet)")
+        d = self.domain_dir(uid)
+        os.makedirs(d, exist_ok=True)
+        cfg = render_file("slice-domain-coordination.tmpl.cfg", {
+            "COORDINATOR_PORT": str(COORDINATOR_PORT),
+            "DOMAIN_UID": uid,
+            "DOMAIN_NAME": domain.name,
+            "DOMAIN_NAMESPACE": domain.namespace,
+            "NUM_NODES": str(domain.spec.num_nodes),
+        })
+        with open(os.path.join(d, "config.cfg"), "w") as f:
+            f.write(cfg)
+        return d
+
+    def unprepare_settings(self, uid: str) -> None:
+        shutil.rmtree(self.domain_dir(uid), ignore_errors=True)
+
+    # -- CDI edits ---------------------------------------------------------
+    def daemon_edits(self, uid: str) -> ContainerEdits:
+        """Edits for the daemon pod's claim — env + settings mount
+        (the /etc/nvidia-imex mount analog, computedomain.go:158-192)."""
+        domain = self.get_by_uid(uid)
+        edits = ContainerEdits(env={
+            "SLICE_DOMAIN_UUID": uid,
+            "SLICE_DOMAIN_NAME": domain.name if domain else "",
+            "SLICE_DOMAIN_NAMESPACE": domain.namespace if domain else "",
+            "SLICE_COORDINATOR_PORT": str(COORDINATOR_PORT),
+        })
+        edits.add_mount(self.domain_dir(uid), SETTINGS_MOUNT,
+                        options=["rw", "nosuid", "nodev", "bind"])
+        return edits
+
+    def channel_edits(self, uid: str) -> ContainerEdits:
+        """Edits for workload channel claims (computedomain.go:129-152):
+        coordination env + read-only settings mount."""
+        edits = ContainerEdits(env={
+            "SLICE_DOMAIN_UUID": uid,
+            "SLICE_COORDINATOR_PORT": str(COORDINATOR_PORT),
+            "JAX_COORDINATION_SERVICE": f"file://{SETTINGS_MOUNT}",
+        })
+        edits.add_mount(self.domain_dir(uid), SETTINGS_MOUNT)
+        return edits
+
+    # -- periodic cleanup (computedomain.go:331-389) -----------------------
+    def cleanup_stale(self) -> int:
+        cleaned = 0
+        for uid in os.listdir(self.domains_dir):
+            if self.get_by_uid(uid) is None:
+                self.unprepare_settings(uid)
+                cleaned += 1
+        node = self.kube.get(NODES, self.node_name)
+        uid = node["metadata"].get("labels", {}).get(DOMAIN_LABEL)
+        if uid and self.get_by_uid(uid) is None:
+            self.kube.patch(NODES, self.node_name,
+                            {"metadata": {"labels": {DOMAIN_LABEL: None}}})
+            cleaned += 1
+        return cleaned

@@ -14,11 +14,11 @@ closed, and a ``migrations`` hook table supports future formats.
 from __future__ import annotations
 
 import json
-import os
 from typing import Callable, Optional
 
 from tpu_dra.plugins.tpu.allocatable import PreparedClaim
 from tpu_dra.tpulib import native
+from tpu_dra.util.fsutil import atomic_write
 
 
 class CorruptCheckpoint(RuntimeError):
@@ -46,13 +46,7 @@ class Checkpoint:
         payload = json.dumps(self._payload(), sort_keys=True)
         envelope = {"checksum": native.crc32c(payload.encode()),
                     "data": payload}
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(envelope, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        atomic_write(self.path, json.dumps(envelope))
 
     def load(self) -> bool:
         """Returns False when no checkpoint exists yet (first start —

@@ -230,20 +230,36 @@ class DeviceState:
         self._check_overlap(uid, all_devices)
         return prepared, edits_out
 
+    def _parent_chip(self, core) -> object:
+        for d in self.allocatable.values():
+            if d.chip is not None and d.chip.uuid == core.parent_uuid:
+                return d.chip
+        raise PrepareError(
+            f"core {core.uuid}: parent chip {core.parent_uuid} not "
+            f"allocatable on this node")
+
     def _group_edits(self, config, devices: list[AllocatableDevice]
                      ) -> ContainerEdits:
-        """CDI edits for one config group (the normalized ``config``)."""
+        """CDI edits for one config group (the normalized ``config``).
+
+        ``TPU_VISIBLE_CHIPS`` always carries chip **minors** (the device-node
+        id space) — for full chips directly, for cores via their parent chip
+        — so mixed groups union rather than clobber, and the env contract is
+        one consistent id space regardless of claim type.
+        """
         edits = ContainerEdits()
-        chips = [d for d in devices if d.type == TYPE_CHIP]
-        if chips:
-            edits.env.update(self.tpulib.visible_chips_env(
-                [d.chip for d in chips]))
-        cores = [d for d in devices if d.type == TYPE_CORE]
+        chips = {d.chip.uuid: d.chip for d in devices if d.type == TYPE_CHIP}
+        cores = [d.core for d in devices if d.type == TYPE_CORE]
+        parent_chips = {c.parent_uuid: self._parent_chip(c) for c in cores}
+        visible = sorted({**chips, **parent_chips}.values(),
+                         key=lambda c: c.minor)
+        if visible:
+            edits.env.update(self.tpulib.visible_chips_env(visible))
         if cores:
-            parents = sorted({str(d.core.parent_index) for d in cores})
-            edits.env["TPU_VISIBLE_CHIPS"] = ",".join(parents)
             edits.env["TPU_VISIBLE_CORES"] = ",".join(
-                f"{d.core.parent_index}:{d.core.core_index}" for d in cores)
+                f"{parent_chips[c.parent_uuid].minor}:{c.core_index}"
+                for c in sorted(cores, key=lambda c: (c.parent_uuid,
+                                                      c.core_index)))
         sharing = getattr(config, "sharing", None)
         if sharing is not None and sharing.is_multi_process():
             edits = edits.merge(self.mp_manager.apply(sharing, devices))

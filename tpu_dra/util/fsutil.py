@@ -1,0 +1,17 @@
+"""Crash-safe filesystem helpers shared by CDI specs and checkpoints."""
+
+from __future__ import annotations
+
+import os
+
+
+def atomic_write(path: str, data: str) -> None:
+    """Write-then-rename with fsync: readers never see a torn file, and the
+    content is durable before the rename lands."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -19,6 +19,8 @@ from typing import Callable, Optional
 
 
 class Counter:
+    KIND = "counter"
+
     def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
         self.name, self.help, self.labels = name, help_, labels
         self._values: dict[tuple[str, ...], float] = {}
@@ -30,7 +32,7 @@ class Counter:
 
     def collect(self) -> str:
         out = [f"# HELP {self.name} {self.help}",
-               f"# TYPE {self.name} counter"]
+               f"# TYPE {self.name} {self.KIND}"]
         with self._mu:
             items = sorted(self._values.items())
         for lv, val in items:
@@ -41,12 +43,11 @@ class Counter:
 
 
 class Gauge(Counter):
+    KIND = "gauge"
+
     def set(self, value: float, *label_values: str) -> None:
         with self._mu:
             self._values[label_values] = value
-
-    def collect(self) -> str:
-        return super().collect().replace(" counter", " gauge", 1)
 
 
 class Histogram:

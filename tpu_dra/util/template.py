@@ -1,0 +1,42 @@
+"""Tiny ``$(VAR)`` template renderer for runtime-rendered YAML/cfg assets.
+
+The reference renders Go text/templates at runtime (DaemonSets, claim
+templates, IMEX config — templates/*.tmpl.*, e.g.
+cmd/compute-domain-controller/daemonset.go:102-157).  Here templates use
+``$(NAME)`` placeholders; unresolved placeholders are an error so a typo
+can't ship an invalid manifest.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import yaml
+
+_VAR_RE = re.compile(r"\$\(([A-Z0-9_]+)\)")
+
+TEMPLATE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "templates")
+
+
+def render(text: str, values: dict[str, str]) -> str:
+    def sub(m: re.Match) -> str:
+        key = m.group(1)
+        if key not in values:
+            raise KeyError(f"template variable $({key}) has no value")
+        return str(values[key])
+    return _VAR_RE.sub(sub, text)
+
+
+def render_file(name: str, values: dict[str, str],
+                template_dir: str | None = None) -> str:
+    path = os.path.join(template_dir or TEMPLATE_DIR, name)
+    with open(path) as f:
+        return render(f.read(), values)
+
+
+def render_yaml(name: str, values: dict[str, str],
+                template_dir: str | None = None) -> dict:
+    return yaml.safe_load(render_file(name, values, template_dir))

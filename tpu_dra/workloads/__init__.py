@@ -1,0 +1,17 @@
+"""JAX/XLA workload surface.
+
+The reference ships measurement/demo workloads, not models (nvbandwidth
+MPIJobs, demo/specs/imex/*; CUDA nbody, demo/specs/quickstart/gpu-test5).
+The TPU analogs here are first-class framework components:
+
+- :mod:`tpu_dra.workloads.collectives` — ICI collective benchmarks
+  (``jax.lax.psum`` bandwidth over a device mesh), the nvbandwidth analog
+  and the BASELINE.md target metric.
+- :mod:`tpu_dra.workloads.train` — a small SPMD transformer train step
+  (DP×TP sharded, bf16, remat) used as the acceptance workload for
+  slice-domain demos and as the graft entry's flagship model.
+- :mod:`tpu_dra.workloads.launcher` — resolves the driver's injected
+  coordination env (``SLICE_*`` / the mounted settings dir) into
+  ``jax.distributed.initialize`` arguments: the consumer side of the
+  rendezvous bus (SURVEY.md §2.7.2).
+"""

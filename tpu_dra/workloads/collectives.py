@@ -1,0 +1,126 @@
+"""ICI collective benchmarks — the nvbandwidth analog.
+
+The reference's multi-node demo measures MNNVL bandwidth with
+``nvbandwidth -t multinode_device_to_device_memcpy_read_ce``
+(demo/specs/imex/nvbandwidth-test-job-1.yaml:44-49).  The TPU-native
+equivalent rides XLA collectives over ICI: a jitted ``lax.psum`` /
+``ppermute`` over a ``Mesh``, timed after compilation, reporting achieved
+bytes/s against the algorithmic bytes each collective moves.
+
+All benchmark ops are static-shaped, bf16, and jitted once (XLA traces a
+single program; no data-dependent Python control flow).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass
+class CollectiveResult:
+    name: str
+    n_devices: int
+    buffer_bytes: int
+    seconds_per_op: float
+    algo_bytes_per_s: float
+
+
+def _time_op(fn, x, iters: int = 10) -> float:
+    """Time one application of ``fn`` (shape-preserving) accurately on
+    remote/async backends.
+
+    ``block_until_ready`` does not round-trip on relayed backends (e.g. the
+    axon TPU tunnel) — only host readback does.  So the op is iterated
+    *inside* one jitted ``fori_loop`` (single dispatch, chained data
+    dependencies) and a scalar is fetched; constant dispatch+readback
+    overhead is removed by differencing an ``iters`` run against a
+    ``2·iters`` run.
+    """
+    def loop(n):
+        @jax.jit
+        def run(v):
+            out = jax.lax.fori_loop(0, n, lambda i, a: fn(a), v)
+            return jnp.sum(out.astype(jnp.float32))
+        return run
+
+    run1, run2 = loop(iters), loop(2 * iters)
+    float(run1(x))   # warm both compilations
+    float(run2(x))
+
+    def best(run, repeats: int = 3) -> float:
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(run(x))
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t1, t2 = best(run1), best(run2)
+    return max((t2 - t1) / iters, 1e-9)
+
+
+def make_mesh(devices=None, axis: str = "x") -> Mesh:
+    import numpy as np
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def psum_bandwidth(mesh: Mesh, mib_per_device: int = 64,
+                   iters: int = 10) -> CollectiveResult:
+    """All-reduce bandwidth.  Ring all-reduce moves 2·(n-1)/n of the buffer
+    per device; achieved B/s is reported against that algorithmic volume."""
+    n = mesh.devices.size
+    elems = mib_per_device * 1024 * 1024 // 2   # bf16
+    x = jnp.ones((n, elems), dtype=jnp.bfloat16)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("x", None),
+             out_specs=P("x", None))
+    def allreduce(v):
+        return jax.lax.psum(v, "x") * jnp.bfloat16(1.0 / n)
+
+    secs = _time_op(allreduce, x, iters=iters)
+    buffer_bytes = elems * 2
+    algo = 2 * (n - 1) / max(n, 1) * buffer_bytes / secs if n > 1 else \
+        buffer_bytes / secs
+    return CollectiveResult("psum", n, buffer_bytes, secs, algo)
+
+
+def ppermute_bandwidth(mesh: Mesh, mib_per_device: int = 64,
+                       iters: int = 10) -> CollectiveResult:
+    """Neighbor-exchange (ring) bandwidth — the point-to-point ICI probe."""
+    n = mesh.devices.size
+    elems = mib_per_device * 1024 * 1024 // 2
+    x = jnp.ones((n, elems), dtype=jnp.bfloat16)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(shard_map, mesh=mesh, in_specs=P("x", None),
+             out_specs=P("x", None))
+    def shift(v):
+        return jax.lax.ppermute(v, "x", perm)
+
+    secs = _time_op(shift, x, iters=iters)
+    buffer_bytes = elems * 2
+    return CollectiveResult("ppermute", n, buffer_bytes, secs,
+                            buffer_bytes / secs)
+
+
+def matmul_throughput(size: int = 4096, iters: int = 50) -> float:
+    """Single-chip MXU sanity: bf16 matmul TFLOP/s (keeps the benchmark
+    honest about the chip actually running)."""
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
+    b = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
+    inv = jnp.bfloat16(1.0 / size)   # keep the chained values finite
+
+    def mm(x):
+        return (x @ b) * inv
+
+    secs = _time_op(mm, a, iters=iters)
+    return 2 * size**3 / secs / 1e12

@@ -1,0 +1,106 @@
+"""Workload-side rendezvous: driver env → ``jax.distributed.initialize``.
+
+The consumer half of the slice-domain rendezvous bus (SURVEY.md §2.7.2): the
+slice kubelet plugin injects ``SLICE_DOMAIN_UUID``, ``SLICE_COORDINATOR_PORT``
+and the ``/etc/tpu-slice`` settings mount into workload containers (the
+``/etc/nvidia-imex`` analog); this module resolves them into the
+``(coordinator_address, num_processes, process_id)`` triple JAX needs, from
+either the mounted nodes config or the per-node coordination service.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class RendezvousInfo:
+    coordinator_address: str     # "ip:port" for jax.distributed
+    num_processes: int
+    process_id: int
+    domain_uid: str = ""
+
+    def initialize(self) -> None:
+        """Call ``jax.distributed.initialize`` with the resolved triple."""
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id)
+
+
+JAX_COORDINATOR_PORT = 8476
+
+
+def _from_settings_dir(settings_dir: str,
+                       my_ip: str) -> Optional[RendezvousInfo]:
+    path = os.path.join(settings_dir, "nodes_config.json")
+    try:
+        with open(path) as f:
+            nodes = json.load(f).get("nodes", [])
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    if not nodes:
+        return None
+    nodes = sorted(nodes, key=lambda n: (n.get("workerID", 0), n["name"]))
+    coordinator = f"{nodes[0]['ipAddress']}:{JAX_COORDINATOR_PORT}"
+    pid = next((i for i, n in enumerate(nodes)
+                if n.get("ipAddress") == my_ip), -1)
+    if pid < 0:
+        return None
+    return RendezvousInfo(coordinator, len(nodes), pid)
+
+
+def _from_coordservice(port: int, my_ip: str) -> Optional[RendezvousInfo]:
+    base = f"http://127.0.0.1:{port}"
+    try:
+        coordinator = urllib.request.urlopen(
+            f"{base}/coordinator", timeout=5).read().decode()
+        nodes = json.loads(urllib.request.urlopen(
+            f"{base}/nodes", timeout=5).read())["nodes"]
+        pid = int(urllib.request.urlopen(
+            f"{base}/whoami?ip={my_ip}", timeout=5).read())
+    except Exception:  # noqa: BLE001 — caller falls back / errors out
+        return None
+    if pid < 0:
+        return None
+    return RendezvousInfo(coordinator, len(nodes), pid)
+
+
+def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
+    """Resolve rendezvous from the driver-injected environment.
+
+    Order: explicit JAX_* overrides → mounted settings dir → local
+    coordination service.  Raises RuntimeError when the claim env is absent
+    (the pod was not given a slice-domain channel claim).
+    """
+    env = dict(os.environ) if env is None else env
+    if env.get("JAX_COORDINATOR_ADDRESS"):
+        return RendezvousInfo(
+            coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
+            process_id=int(env.get("JAX_PROCESS_ID", "0")),
+            domain_uid=env.get("SLICE_DOMAIN_UUID", ""))
+    domain_uid = env.get("SLICE_DOMAIN_UUID", "")
+    if not domain_uid:
+        raise RuntimeError(
+            "no slice-domain claim env present "
+            "(SLICE_DOMAIN_UUID unset): give the pod a channel claim from "
+            "the domain's ResourceClaimTemplate")
+    my_ip = env.get("POD_IP", "")
+    settings = env.get("SLICE_SETTINGS_DIR", "/etc/tpu-slice")
+    info = _from_settings_dir(settings, my_ip)
+    if info is None:
+        port = int(env.get("SLICE_COORDINATOR_PORT", "51000"))
+        info = _from_coordservice(port, my_ip)
+    if info is None:
+        raise RuntimeError(
+            f"slice domain {domain_uid}: could not resolve rendezvous "
+            f"(settings dir {settings!r} empty and coordination service "
+            f"unreachable)")
+    info.domain_uid = domain_uid
+    return info

@@ -1,0 +1,166 @@
+"""SPMD demo transformer — the acceptance workload / flagship model.
+
+The reference's quickstart demos run CUDA samples against claimed GPUs
+(demo/specs/quickstart/*); the slice-domain acceptance run is "a
+``jax.lax.psum`` job across a v5e-16 node pool" (BASELINE.md).  This module
+is the richer acceptance workload: a small decoder-only transformer whose
+train step compiles under ``jit`` over a DP×TP ``Mesh``, exercising exactly
+the shardings a real tenant would run on a claimed slice.
+
+TPU-first design notes:
+- bf16 activations/weights on the matmul path (MXU-friendly), fp32 master
+  params and optimizer state;
+- static shapes everywhere; layers iterated with ``lax.scan`` over stacked
+  parameters (one XLA while-loop, no Python unrolling);
+- ``jax.checkpoint`` on the block fn (rematerialize activations: trade
+  FLOPs for HBM);
+- tensor parallelism via ``NamedSharding``: attention/MLP weights sharded on
+  the feature axis ("tp"), batch on "dp"; XLA inserts the psum/all-gather
+  collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 128
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: ModelConfig, key) -> dict[str, Any]:
+    """Stacked-by-layer params (leading axis = layer) so the forward pass is
+    a single ``lax.scan``."""
+    keys = jax.random.split(key, 8)
+    scale = cfg.d_model ** -0.5
+    L = cfg.n_layers
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape) * scale).astype(jnp.float32)
+
+    return {
+        "embed": norm(keys[0], (cfg.vocab, cfg.d_model)),
+        "pos": norm(keys[1], (cfg.max_seq, cfg.d_model)),
+        "blocks": {
+            "wqkv": norm(keys[2], (L, cfg.d_model, 3 * cfg.d_model)),
+            "wo": norm(keys[3], (L, cfg.d_model, cfg.d_model)),
+            "w1": norm(keys[4], (L, cfg.d_model, cfg.d_ff)),
+            "w2": norm(keys[5], (L, cfg.d_ff, cfg.d_model)),
+            "ln1": jnp.ones((L, cfg.d_model), jnp.float32),
+            "ln2": jnp.ones((L, cfg.d_model), jnp.float32),
+        },
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "unembed": norm(keys[6], (cfg.d_model, cfg.vocab)),
+    }
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6) * g).astype(x.dtype)
+
+
+def _block(cfg: ModelConfig, x, layer):
+    """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
+    B, S, D = x.shape
+    h = _rmsnorm(x, layer["ln1"])
+    qkv = h @ layer["wqkv"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (cfg.d_head ** -0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + out @ layer["wo"].astype(x.dtype)
+
+    h = _rmsnorm(x, layer["ln2"])
+    h = jax.nn.gelu(h @ layer["w1"].astype(x.dtype))
+    return x + h @ layer["w2"].astype(x.dtype)
+
+
+def forward(cfg: ModelConfig, params, tokens):
+    """Logits for a [B, S] int32 token batch."""
+    x = params["embed"].astype(jnp.bfloat16)[tokens]
+    x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
+
+    block = jax.checkpoint(
+        lambda carry, layer: (_block(cfg, carry, layer), None))
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+def loss_fn(cfg: ModelConfig, params, tokens):
+    logits = forward(cfg, params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens):
+    """Full train step (fwd+bwd+update) as one jittable function."""
+    loss, grads = jax.value_and_grad(partial(loss_fn, cfg))(params, tokens)
+    params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+# --- sharding -----------------------------------------------------------------
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
+    """TP shardings: feature-axis sharding on the big matmuls, replicated
+    norms/embeddings.  XLA inserts the reduce/all-gather collectives."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": s(None, "tp"),
+        "pos": s(None, "tp"),
+        "blocks": {
+            "wqkv": s(None, None, "tp"),
+            "wo": s(None, "tp", None),
+            "w1": s(None, None, "tp"),
+            "w2": s(None, "tp", None),
+            "ln1": s(None, None),
+            "ln2": s(None, None),
+        },
+        "ln_f": s(None),
+        "unembed": s(None, "tp"),
+    }
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", None))
+
+
+def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2):
+    """jit the full train step with DP×TP shardings over ``mesh`` (axes
+    "dp", "tp")."""
+    p_shard = param_shardings(cfg, mesh)
+    b_shard = batch_sharding(mesh)
+    step = jax.jit(
+        partial(sgd_train_step, cfg, lr),
+        in_shardings=(p_shard, b_shard),
+        out_shardings=(p_shard, NamedSharding(mesh, P())))
+    return step, p_shard, b_shard
