@@ -1,0 +1,21 @@
+# Driver image: Python control plane + native L0 lib + JAX workload surface.
+# (The reference builds a Go binary image; here one image serves all four
+# entry points — controller, both kubelet plugins, slice daemon — selected
+# by command, exactly like the reference's single driver image.)
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir grpcio protobuf pyyaml jax
+WORKDIR /opt/tpu-dra
+COPY tpu_dra/ tpu_dra/
+COPY templates/ templates/
+COPY hack/ hack/
+COPY --from=build /src/native/libtpudra.so native/libtpudra.so
+ENV PYTHONPATH=/opt/tpu-dra \
+    TPUDRA_NATIVE_LIB=/opt/tpu-dra/native/libtpudra.so
+ENTRYPOINT ["python"]

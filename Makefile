@@ -1,0 +1,28 @@
+# Top-level targets — analog of the reference Makefile (build/test/image).
+
+PYTHON ?= python3
+IMAGE ?= tpu-dra-driver:latest
+
+.PHONY: all native test bench image proto clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+bench: native
+	$(PYTHON) bench.py
+
+proto:
+	cd tpu_dra/kubeletplugin/proto && \
+	protoc --python_out=. dra_v1beta1.proto pluginregistration.proto
+
+image:
+	docker build -t $(IMAGE) .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Build the driver image and load it into the kind cluster — analog of
+# reference demo/clusters/kind/build-dra-driver-gpu.sh.
+
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+IMAGE="${IMAGE:-tpu-dra-driver:latest}"
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+
+docker build -t "$IMAGE" "$REPO_ROOT"
+kind load docker-image --name "$CLUSTER_NAME" "$IMAGE"
+echo "image $IMAGE loaded into kind cluster $CLUSTER_NAME"

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Create a kind cluster wired for DRA + CDI — analog of reference
+# demo/clusters/kind/create-cluster.sh:26-35.  TPU hardware is not required
+# for the control-plane paths (controller, slice plugin, scheduler flows);
+# fake chips can be injected with a synthetic driver root (see
+# demo/clusters/kind/fake-tpu-node.sh).
+
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+
+kind create cluster --name "$CLUSTER_NAME" \
+    --config "$SCRIPT_DIR/kind-cluster-config.yaml"
+
+echo "Cluster $CLUSTER_NAME ready. Next:"
+echo "  ./build-and-load.sh      # build the driver image into the cluster"
+echo "  helm install tpu-dra-driver ../../../deployments/helm/tpu-dra-driver"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Inject a synthetic TPU driver root into a kind worker node so the
+# tpu-kubelet-plugin discovers fake chips — the analog of the reference's
+# nvkind GPU-injection trick (kind-cluster-config.yaml:17-66 + nvkind).
+
+set -euo pipefail
+
+NODE="${1:?usage: fake-tpu-node.sh <kind-node-name> [n_chips]}"
+N_CHIPS="${2:-4}"
+
+docker exec "$NODE" bash -c "
+  mkdir -p /var/lib/tpu
+  for i in \$(seq 0 $((N_CHIPS - 1))); do
+    [ -e /dev/accel\$i ] || mknod /dev/accel\$i c 120 \$i
+  done
+  cat > /var/lib/tpu/tpu-env <<EOF
+TPU_ACCELERATOR_TYPE: 'v5litepod-16'
+TPU_TOPOLOGY: '4x4'
+TPU_WORKER_ID: '0'
+TPU_WORKER_HOSTNAMES: '$NODE'
+EOF
+"
+echo "node $NODE now exposes $N_CHIPS fake TPU chips"

@@ -1,0 +1,52 @@
+#!/bin/sh
+# Prestart validation for the TPU kubelet plugins — analog of reference
+# hack/kubelet-plugin-prestart.sh:1-165, which validates the NVIDIA driver
+# install (nvidia-smi exit codes) and retries forever until healthy.
+#
+# Here: wait until the node exposes TPU device files and (when present)
+# parseable topology metadata under the driver root.  Runs as an init
+# container with /driver-root mounted HostToContainer.
+
+set -u
+
+DRIVER_ROOT="${TPU_DRIVER_ROOT:-/driver-root}"
+RETRY_INTERVAL_SECONDS=10
+
+log() {
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $*"
+}
+
+check_device_files() {
+    # accel char devices (PCI DIRECT stack) or vfio groups (newer stacks)
+    for dev in "$DRIVER_ROOT"/dev/accel[0-9]* "$DRIVER_ROOT"/dev/vfio/[0-9]*; do
+        if [ -e "$dev" ]; then
+            log "found TPU device file: $dev"
+            return 0
+        fi
+    done
+    return 1
+}
+
+check_metadata() {
+    meta="$DRIVER_ROOT/var/lib/tpu/tpu-env"
+    if [ -f "$meta" ]; then
+        if grep -q "TPU_ACCELERATOR_TYPE" "$meta"; then
+            log "topology metadata OK: $(grep TPU_ACCELERATOR_TYPE "$meta")"
+            return 0
+        fi
+        log "WARNING: $meta exists but has no TPU_ACCELERATOR_TYPE"
+        return 1
+    fi
+    # metadata file is optional on single-host nodes
+    log "no tpu-env metadata file (single-host defaults will be used)"
+    return 0
+}
+
+while true; do
+    if check_device_files && check_metadata; then
+        log "TPU node validation passed"
+        exit 0
+    fi
+    log "TPU stack not ready; retrying in ${RETRY_INTERVAL_SECONDS}s"
+    sleep "$RETRY_INTERVAL_SECONDS"
+done

@@ -1,0 +1,192 @@
+"""Deployment-asset validation: every YAML asset must parse, runtime
+templates must render, and the Helm templates must produce valid manifests
+under a minimal in-test renderer (helm itself is not in the image)."""
+
+import os
+import re
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deployments/helm/tpu-dra-driver")
+
+
+def load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+# --- plain YAML assets ------------------------------------------------------
+
+def iter_files(root, suffix=".yaml"):
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            if fn.endswith(suffix):
+                yield os.path.join(dirpath, fn)
+
+
+@pytest.mark.parametrize("path", [
+    *iter_files(os.path.join(REPO, "demo")),
+    *iter_files(os.path.join(CHART, "crds")),
+])
+def test_plain_yaml_parses(path):
+    docs = load_all(path)
+    assert docs, f"{path}: empty"
+    for doc in docs:
+        assert "kind" in doc, f"{path}: doc without kind"
+
+
+def test_crd_matches_api_types():
+    crd = load_all(os.path.join(
+        CHART, "crds/resource.tpu.google.com_tpuslicedomains.yaml"))[0]
+    assert crd["spec"]["group"] == "resource.tpu.google.com"
+    assert crd["spec"]["names"]["plural"] == "tpuslicedomains"
+    version = crd["spec"]["versions"][0]
+    assert version["name"] == "v1beta1"
+    spec_schema = version["schema"]["openAPIV3Schema"]["properties"]["spec"]
+    # the immutability CEL rule (reference computedomain.go:53)
+    assert any(r["rule"] == "self == oldSelf"
+               for r in spec_schema["x-kubernetes-validations"])
+
+
+def test_deviceclasses_cover_all_four():
+    docs = load_all(os.path.join(CHART, "templates/deviceclasses.yaml"))
+    names = {d["metadata"]["name"] for d in docs}
+    assert names == {
+        "tpu.google.com",
+        "tpu-subslice.tpu.google.com",
+        "slice-domain-daemon.tpu.google.com",
+        "slice-domain-default-channel.tpu.google.com",
+    }
+
+
+# --- runtime templates ($(VAR) renderer) ------------------------------------
+
+def test_runtime_templates_render():
+    from tpu_dra.util.template import render_yaml
+    values = {
+        "DS_NAME": "dom-1234-daemon",
+        "DRIVER_NAMESPACE": "tpu-dra-driver",
+        "DOMAIN_NAME": "dom",
+        "DOMAIN_NAMESPACE": "team-a",
+        "DOMAIN_UID": "uid-1",
+        "IMAGE_NAME": "img:latest",
+        "DAEMON_CLAIM_TEMPLATE_NAME": "dom-1234-daemon-claim",
+        "TEMPLATE_NAME": "tmpl",
+    }
+    ds = render_yaml("slice-domain-daemon.tmpl.yaml", values)
+    assert ds["spec"]["template"]["spec"]["nodeSelector"][
+        "resource.tpu.google.com/sliceDomain"] == "uid-1"
+    for name in ("slice-domain-daemon-claim-template.tmpl.yaml",
+                 "slice-domain-workload-claim-template.tmpl.yaml"):
+        obj = render_yaml(name, values)
+        assert obj["kind"] == "ResourceClaimTemplate"
+
+
+def test_runtime_template_missing_var_errors():
+    from tpu_dra.util.template import render
+    with pytest.raises(KeyError, match="DOMAIN_UID"):
+        render("x: $(DOMAIN_UID)", {})
+
+
+# --- helm templates (mini renderer) -----------------------------------------
+
+def _helm_values():
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def _lookup(values, dotted):
+    cur = values
+    for part in dotted.split(".")[2:]:   # skip "", "Values"
+        cur = cur[part]
+    return cur
+
+
+def mini_helm_render(text, values):
+    """Render the template subset this chart uses: value refs (| quote),
+    if/with/end blocks, toYaml|nindent."""
+
+    # strip whole if/with blocks' control lines, keeping bodies (values are
+    # truthy in default values.yaml where it matters)
+    def block_control(m):
+        expr = m.group(1).strip()
+        if expr.startswith(("if ", "with ")):
+            dotted = expr.split(None, 1)[1]
+            try:
+                val = _lookup(values, dotted)
+            except (KeyError, TypeError):
+                val = None
+            # record the current with-context for `toYaml .`
+            if expr.startswith("with "):
+                ctx_stack.append(val)
+            else:
+                ctx_stack.append(ctx_stack[-1])
+            drop_stack.append(not bool(val))
+            return ""
+        if expr == "end":
+            ctx_stack.pop()
+            drop_stack.pop()
+            return ""
+        raise AssertionError(f"unhandled control {expr!r}")
+
+    ctx_stack = [values]
+    drop_stack = [False]
+    out_lines = []
+    for line in text.splitlines():
+        control = re.fullmatch(r"\s*\{\{-?\s*(.*?)\s*-?\}\}\s*", line)
+        if control and re.match(r"(if|with|end)\b", control.group(1)):
+            block_control(control)
+            continue
+        if any(drop_stack):
+            continue
+
+        def sub(m):
+            expr = m.group(1).strip()
+            indent_m = re.search(r"nindent (\d+)", expr)
+            if "toYaml" in expr:
+                target = re.search(r"toYaml\s+(\S+)", expr).group(1)
+                obj = ctx_stack[-1] if target == "." else \
+                    _lookup(values, target)
+                dumped = yaml.safe_dump(obj, default_flow_style=False)
+                pad = " " * int(indent_m.group(1))
+                return "\n" + "\n".join(
+                    pad + ln for ln in dumped.strip().splitlines())
+            parts = [p.strip() for p in expr.split("|")]
+            val = _lookup(values, parts[0])
+            if "quote" in parts[1:]:
+                return f'"{val}"'
+            return str(val)
+
+        out_lines.append(re.sub(r"\{\{-?\s*(.*?)\s*-?\}\}", sub, line))
+    return "\n".join(out_lines)
+
+
+@pytest.mark.parametrize("name", [
+    "rbac.yaml", "controller.yaml", "kubeletplugin.yaml",
+    "validatingadmissionpolicy.yaml", "deviceclasses.yaml",
+])
+def test_helm_templates_render(name):
+    values = _helm_values()
+    with open(os.path.join(CHART, "templates", name)) as f:
+        rendered = mini_helm_render(f.read(), values)
+    docs = [d for d in yaml.safe_load_all(rendered) if d]
+    assert docs, f"{name}: rendered to nothing"
+    for doc in docs:
+        assert "kind" in doc and "metadata" in doc
+
+
+def test_kubeletplugin_daemonset_shape():
+    values = _helm_values()
+    with open(os.path.join(CHART, "templates/kubeletplugin.yaml")) as f:
+        ds = yaml.safe_load(mini_helm_render(f.read(), values))
+    spec = ds["spec"]["template"]["spec"]
+    names = [c["name"] for c in spec["containers"]]
+    assert names == ["tpu-kubelet-plugin", "slice-domain-kubelet-plugin"]
+    assert spec["initContainers"][0]["name"] == "prestart"
+    plugins_mounts = [m for c in spec["containers"]
+                      for m in c["volumeMounts"]
+                      if m["mountPath"] == "/var/lib/kubelet/plugins"]
+    assert all(m["mountPropagation"] == "Bidirectional"
+               for m in plugins_mounts)

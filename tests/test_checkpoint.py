@@ -1,0 +1,78 @@
+"""Checkpoint format, checksum, and legacy-migration tests
+(reference checkpoint.go:10-62 + checkpoint_legacy.go:12-143)."""
+
+import json
+
+import pytest
+
+from tpu_dra.plugins.tpu.allocatable import PreparedClaim, PreparedDevice
+from tpu_dra.plugins.tpu.checkpoint import Checkpoint, CorruptCheckpoint
+from tpu_dra.tpulib import native
+
+
+def make_claim(uid="u1"):
+    return PreparedClaim(
+        claim_uid=uid, namespace="default", name="c",
+        devices=[PreparedDevice(
+            type="chip", uuid="tpu-x", canonical_name="tpu-0",
+            request_names=["tpu"],
+            cdi_device_ids=["google.com/tpu=tpu-0"])])
+
+
+def test_round_trip(tmp_path):
+    ckpt = Checkpoint(str(tmp_path / "checkpoint.json"))
+    ckpt.put(make_claim())
+    loaded = Checkpoint(str(tmp_path / "checkpoint.json"))
+    assert loaded.load()
+    assert loaded.get("u1").devices[0].canonical_name == "tpu-0"
+    loaded.remove("u1")
+    again = Checkpoint(str(tmp_path / "checkpoint.json"))
+    assert again.load()
+    assert again.get("u1") is None
+
+
+def test_missing_file_returns_false(tmp_path):
+    assert not Checkpoint(str(tmp_path / "nope.json")).load()
+
+
+def test_checksum_mismatch_fails_closed(tmp_path):
+    path = tmp_path / "checkpoint.json"
+    ckpt = Checkpoint(str(path))
+    ckpt.put(make_claim())
+    envelope = json.loads(path.read_text())
+    envelope["data"] = envelope["data"].replace("tpu-0", "tpu-9")
+    path.write_text(json.dumps(envelope))
+    with pytest.raises(CorruptCheckpoint, match="checksum"):
+        Checkpoint(str(path)).load()
+
+
+def test_unknown_version_fails_closed(tmp_path):
+    path = tmp_path / "checkpoint.json"
+    payload = json.dumps({"version": "v99", "preparedClaims": {}},
+                         sort_keys=True)
+    path.write_text(json.dumps(
+        {"checksum": native.crc32c(payload.encode()), "data": payload}))
+    with pytest.raises(CorruptCheckpoint, match="v99"):
+        Checkpoint(str(path)).load()
+
+
+def test_legacy_version_migrates(tmp_path):
+    """The versioned-envelope migration path (checkpoint_legacy.go
+    analog): a registered converter upgrades old payloads in place."""
+    path = tmp_path / "checkpoint.json"
+    legacy_payload = json.dumps({
+        "version": "v0",
+        # v0 stored a flat list instead of a map
+        "claims": [make_claim().to_dict()],
+    }, sort_keys=True)
+    path.write_text(json.dumps(
+        {"checksum": native.crc32c(legacy_payload.encode()),
+         "data": legacy_payload}))
+
+    ckpt = Checkpoint(str(path))
+    ckpt.migrations["v0"] = lambda old: {
+        "version": "v1",
+        "preparedClaims": {c["claimUID"]: c for c in old["claims"]},
+    }
+    assert ckpt.load()
+    assert ckpt.get("u1") is not None

@@ -175,3 +175,22 @@ def test_domain_without_channel_name_does_not_crash(controller):
     uid = obj["metadata"]["uid"]
     assert wait_until(lambda: _exists(
         kube, DAEMONSETS, ds_name("nochannel", uid), "tpu-dra-driver"))
+
+
+def test_channelless_domain_deletable(controller):
+    """A domain created without spec.channel must still tear down cleanly
+    (review regression: teardown used to raise forever)."""
+    ctrl, kube = controller
+    kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "nochan", "namespace": NS},
+        "spec": {"numNodes": 1}})
+    obj = kube.get(TPU_SLICE_DOMAINS, "nochan", NS)
+    uid = obj["metadata"]["uid"]
+    assert wait_until(lambda: _exists(
+        kube, DAEMONSETS, ds_name("nochan", uid), "tpu-dra-driver"))
+    # status still reconciles despite the missing channel
+    assert wait_until(lambda: kube.get(TPU_SLICE_DOMAINS, "nochan", NS)
+                      .get("status", {}).get("status") == "NotReady")
+    kube.delete(TPU_SLICE_DOMAINS, "nochan", NS)
+    assert wait_until(lambda: not _exists(kube, TPU_SLICE_DOMAINS,
+                                          "nochan", NS))

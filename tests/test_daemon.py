@@ -170,3 +170,17 @@ def test_check_probe_against_coordservice(tmp_path):
         capture_output=True, text=True, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert out.returncode == 1
+
+
+def test_parked_daemon_serves_ready():
+    """A no-fabric daemon must still pass the readiness probe
+    (review regression)."""
+    from tpu_dra.daemon.main import _serve_parked
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    _serve_parked(port)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/ready", timeout=2).read()
+    assert body == b"READY\n"

@@ -1,0 +1,171 @@
+"""Two-node slice-domain integration: one controller, two slice plugins, two
+daemon membership managers against a single FakeKube — the full SURVEY §3.3
+rendezvous across nodes, in-process."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_dra.controller.constants import DOMAIN_LABEL, ds_name
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.daemon.main import write_nodes_config
+from tpu_dra.daemon.membership import MembershipManager
+from tpu_dra.k8s import DAEMONSETS, FakeKube, NODES, TPU_SLICE_DOMAINS
+from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
+from tpu_dra.version import SLICE_DRIVER_NAME
+
+NS = "team-a"
+FABRIC = "shared-slice.0"
+
+
+def wait_until(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def slice_claim(uid, device, kind, domain_uid, node, ns=NS):
+    return {
+        "metadata": {"uid": uid, "namespace": ns, "name": uid},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "r0", "driver": SLICE_DRIVER_NAME,
+                         "pool": node, "device": device}],
+            "config": [{"requests": ["r0"], "opaque": {
+                "driver": SLICE_DRIVER_NAME,
+                "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": kind, "domainID": domain_uid}}}],
+        }}},
+    }
+
+
+@pytest.mark.parametrize("num_nodes", [2])
+def test_two_node_domain_end_to_end(num_nodes):
+    # unix socket paths are capped at ~107 chars; pytest tmp dirs are too
+    # deep, so use a short mkdtemp root
+    import shutil
+    import tempfile
+    tmp_path = __import__("pathlib").Path(
+        tempfile.mkdtemp(prefix="mn-", dir="/tmp"))
+    kube = FakeKube()
+    nodes = [f"node-{i}" for i in range(num_nodes)]
+    for n in nodes:
+        kube.create(NODES, {"metadata": {"name": n, "labels": {}}})
+
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    drivers = []
+    for n in nodes:
+        drv = SliceDriver(SliceDriverConfig(
+            node_name=n, kube=kube,
+            plugins_dir=str(tmp_path / n / "plugins"),
+            registry_dir=str(tmp_path / n / "registry"),
+            cdi_root=str(tmp_path / n / "cdi"),
+            flock_timeout=2.0, retry_timeout=20.0))
+        drv.start()
+        drivers.append(drv)
+
+    try:
+        created = kube.create(TPU_SLICE_DOMAINS, {
+            "metadata": {"name": "dom", "namespace": NS},
+            "spec": {"numNodes": num_nodes,
+                     "channel": {"resourceClaimTemplate":
+                                 {"name": "dom-channel"}}}})
+        uid = created["metadata"]["uid"]
+        for drv in drivers:
+            assert wait_until(lambda d=drv: d.manager.get_by_uid(uid))
+
+        # one channel prepare per node, all blocking on readiness
+        results: dict[str, dict] = {}
+
+        def run_prepare(drv, claim_uid, node):
+            claim = slice_claim(claim_uid, "channel-0",
+                                "SliceChannelConfig", uid, node)
+            results[claim_uid] = drv.prepare_resource_claims([claim])
+
+        threads = []
+        for i, (drv, node) in enumerate(zip(drivers, nodes)):
+            t = threading.Thread(target=run_prepare,
+                                 args=(drv, f"chan-{i}", node))
+            t.start()
+            threads.append(t)
+
+        # every node gets labeled -> the DS could now schedule everywhere
+        for node in nodes:
+            assert wait_until(
+                lambda n=node: kube.get(NODES, n)["metadata"]
+                .get("labels", {}).get(DOMAIN_LABEL) == uid)
+        assert not results
+
+        # daemon claims prepare per node (as daemon pods would)
+        for i, (drv, node) in enumerate(zip(drivers, nodes)):
+            res = drv.prepare_resource_claims([
+                slice_claim(f"daemon-{i}", "slice-daemon",
+                            "SliceDaemonConfig", uid, node,
+                            ns="tpu-dra-driver")])
+            assert res[f"daemon-{i}"].error == ""
+
+        # daemon processes rendezvous through the CR status
+        members = []
+        for i, node in enumerate(nodes):
+            m = MembershipManager(kube, "dom", NS, node, f"10.0.0.{10 + i}",
+                                  FABRIC, i)
+            m.start()
+            members.append(m)
+        node_lists = [m.updates.get(timeout=10) for m in members]
+        for nl in node_lists:
+            assert {n.name for n in nl} == set(nodes)
+
+        # each daemon writes its nodes config; rank-0 is deterministic
+        for i, (m, drv) in enumerate(zip(members, drivers)):
+            settings = drv.manager.domain_dir(uid)
+            path = write_nodes_config(settings, node_lists[i], FABRIC)
+            import json
+            cfg = json.load(open(path))
+            assert [n["workerID"] for n in cfg["nodes"]] == [0, 1]
+
+        # kube's DS controller reports readiness -> domain Ready ->
+        # all channel prepares complete
+        assert wait_until(lambda: _exists(
+            kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+        ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+        ds["status"] = {"numberReady": num_nodes}
+        kube.update_status(DAEMONSETS, ds)
+
+        for t in threads:
+            t.join(timeout=25)
+        for i in range(num_nodes):
+            res = results[f"chan-{i}"][f"chan-{i}"]
+            assert res.error == "", res.error
+            assert res.devices[0]["device_name"] == "channel-0"
+
+        # teardown unwinds both nodes
+        for m in members:
+            m.stop()
+        kube.delete(TPU_SLICE_DOMAINS, "dom", NS)
+        assert wait_until(
+            lambda: not _exists(kube, TPU_SLICE_DOMAINS, "dom", NS))
+        for node in nodes:
+            assert wait_until(
+                lambda n=node: DOMAIN_LABEL not in
+                kube.get(NODES, n)["metadata"].get("labels", {}))
+    finally:
+        for drv in drivers:
+            drv.stop()
+        ctrl.stop()
+        kube.close_watchers()
+        shutil.rmtree(tmp_path, ignore_errors=True)
+
+
+def _exists(kube, res, name, ns):
+    from tpu_dra.k8s import NotFound
+    try:
+        kube.get(res, name, ns)
+        return True
+    except NotFound:
+        return False

@@ -211,3 +211,23 @@ def test_stale_cleanup(world):
     cleaned = drv.manager.cleanup_stale()
     assert cleaned == 2
     assert not os.path.exists(drv.manager.domain_dir("ghost-uid"))
+
+
+def test_failed_channel_prepare_rolls_back_label(world):
+    """Retry-deadline exhaustion must release the node label so another
+    domain can bind later (review regression)."""
+    kube, ctrl, drv = world
+    created = make_domain(kube, num_nodes=4)   # never Ready
+    uid = created["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid) is not None)
+    drv.cfg.retry_timeout = 1.0
+    res = drv.prepare_resource_claims([
+        slice_claim("doomed", "channel-0", "SliceChannelConfig", uid)])
+    assert res["doomed"].error
+    node = kube.get(NODES, NODE)
+    assert node["metadata"].get("labels", {}).get(DOMAIN_LABEL) != uid
+    # a second domain can now bind the node
+    d2 = make_domain(kube, name="dom2", num_nodes=1)
+    uid2 = d2["metadata"]["uid"]
+    assert wait_until(lambda: drv.manager.get_by_uid(uid2) is not None)
+    drv.manager.add_node_label(uid2)

@@ -15,6 +15,7 @@ from tpu_dra.controller.slicedomain import SliceDomainManager
 from tpu_dra.k8s.client import (
     DAEMONSETS,
     KubeClient,
+    NODES,
     NotFound,
     RESOURCE_CLAIM_TEMPLATES,
 )
@@ -56,14 +57,15 @@ class Controller:
                 period=cfg.gc_period),
             CleanupManager(
                 "node-labels",
-                lambda: [],   # nodes handled in bulk below
+                lambda: [n for n in cfg.kube.list(NODES)["items"]
+                         if n.get("metadata", {}).get("labels", {})
+                         .get(DOMAIN_LABEL)],
                 exists,
-                lambda obj: None,
+                lambda node: cfg.kube.patch(
+                    NODES, node["metadata"]["name"],
+                    {"metadata": {"labels": {DOMAIN_LABEL: None}}}),
                 period=cfg.gc_period),
         ]
-        # the node sweep rides the same period as the other GC managers
-        self.gc_managers[-1].run_once = (  # type: ignore[method-assign]
-            lambda: self.manager.node_manager.remove_stale_labels(exists))
 
     def _labeled_rcts(self) -> list[dict]:
         items = []

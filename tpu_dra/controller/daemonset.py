@@ -138,12 +138,20 @@ class DaemonSetManager:
         current = domain.status.status if domain.status else ""
         if current == new_status:
             return
-        fresh = TpuSliceDomain.from_dict(
-            self.kube.get(TPU_SLICE_DOMAINS, domain.name, domain.namespace))
         from tpu_dra.api.types import TpuSliceDomainStatus
-        if fresh.status is None:
-            fresh.status = TpuSliceDomainStatus()
-        fresh.status.status = new_status
-        self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+        # the write races the daemons' own status.nodes updates exactly when
+        # readiness flips — retry the GET→PUT on conflict
+        for attempt in range(5):
+            fresh = TpuSliceDomain.from_dict(self.kube.get(
+                TPU_SLICE_DOMAINS, domain.name, domain.namespace))
+            if fresh.status is None:
+                fresh.status = TpuSliceDomainStatus()
+            fresh.status.status = new_status
+            try:
+                self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+                break
+            except Conflict:
+                if attempt == 4:
+                    raise
         klog.info("slice domain status updated", domain=domain.name,
                   status=new_status, ready=ready, desired=desired)

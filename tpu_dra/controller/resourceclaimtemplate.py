@@ -107,12 +107,31 @@ class DaemonRCTManager(BaseRCTManager):
 class WorkloadRCTManager(BaseRCTManager):
     """resourceclaimtemplate.go:331-389."""
 
+    @staticmethod
+    def has_channel(domain: TpuSliceDomain) -> bool:
+        return (domain.spec.channel is not None and
+                bool(domain.spec.channel.resource_claim_template_name))
+
     def name_for(self, domain: TpuSliceDomain) -> str:
-        if domain.spec.channel is None:
+        if not self.has_channel(domain):
             raise ValueError(
                 f"TpuSliceDomain {domain.namespace}/{domain.name}: "
                 f"spec.channel.resourceClaimTemplate.name is required")
         return domain.spec.channel.resource_claim_template_name
+
+    # a channel-less domain has no workload RCT: teardown steps must no-op
+    # rather than raise, or the CR finalizer can never be removed
+    def delete(self, domain: TpuSliceDomain) -> None:
+        if self.has_channel(domain):
+            super().delete(domain)
+
+    def remove_finalizer(self, domain: TpuSliceDomain) -> None:
+        if self.has_channel(domain):
+            super().remove_finalizer(domain)
+
+    def assert_removed(self, domain: TpuSliceDomain) -> None:
+        if self.has_channel(domain):
+            super().assert_removed(domain)
 
     def namespace_for(self, domain: TpuSliceDomain) -> str:
         return domain.namespace

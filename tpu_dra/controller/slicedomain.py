@@ -78,7 +78,14 @@ class SliceDomainManager:
             return
         self._add_finalizer(domain)
         self.ds_manager.create(domain)
-        self.workload_rct.create(domain)
+        if self.workload_rct.has_channel(domain):
+            self.workload_rct.create(domain)
+        else:
+            # surfaced but not retried: the spec is immutable, so raising
+            # would hot-loop the workqueue forever on an unfixable object
+            klog.warning("slice domain has no channel template name; no "
+                         "workload RCT will be created",
+                         domain=domain.name, namespace=domain.namespace)
         self._ensure_status(domain)
 
     def _add_finalizer(self, domain: TpuSliceDomain) -> None:

@@ -29,6 +29,7 @@ from tpu_dra.daemon.process import ProcessManager
 from tpu_dra.k8s.client import new_clients
 from tpu_dra.tpulib.discovery import RealTpuLib
 from tpu_dra.util import klog
+from tpu_dra.util.fsutil import atomic_write
 
 
 def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
@@ -40,12 +41,29 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
         (n for n in nodes if n.fabric_id == my_fabric),
         key=lambda n: (n.worker_id, n.name))
     path = os.path.join(settings_dir, "nodes_config.json")
-    tmp = f"{path}.tmp.{os.getpid()}"
-    os.makedirs(settings_dir, exist_ok=True)
-    with open(tmp, "w") as f:
-        json.dump({"nodes": [n.to_dict() for n in members]}, f, indent=2)
-    os.replace(tmp, path)
+    atomic_write(path, json.dumps(
+        {"nodes": [n.to_dict() for n in members]}, indent=2))
     return path
+
+
+def _serve_parked(port: int) -> None:
+    """Minimal READY server for parked (no-fabric) daemons so probes pass."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            body = b"READY\n" if self.path == "/ready" else b"PARKED\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="parked-ready").start()
 
 
 def run(argv=None) -> int:
@@ -65,9 +83,12 @@ def run(argv=None) -> int:
         env={} if env.get("TPU_IGNORE_HOST_ENV") else None)
     fabric = tpulib.fabric_id()
     if not fabric:
-        # not multi-host-ICI capable: park forever (main.go:159-165)
-        klog.info("node has no multi-host fabric; sleeping",
+        # not multi-host-ICI capable: park (main.go:159-165) — but keep the
+        # startup/liveness probes green by serving READY ourselves, or the
+        # kubelet would crash-loop the parked pod forever
+        klog.info("node has no multi-host fabric; parked",
                   node=node_name, domain=domain_uid)
+        _serve_parked(port)
         threading.Event().wait()
         return 0
 

@@ -105,6 +105,29 @@ class SliceDeviceState:
         with self._mu:
             return dict(self.checkpoint.prepared)
 
+    def rollback_channel(self, claim: dict) -> None:
+        """Undo the node label after a channel prepare fails for good.
+
+        The label is applied *before* the readiness gate (the DaemonSet
+        can't schedule without it), so a claim whose retries exhaust must
+        release the node — unless a successfully-prepared claim still
+        references the same domain."""
+        with self._mu:
+            for entry in self._configs_by_request(claim).values():
+                if not isinstance(entry, SliceChannelConfig):
+                    continue
+                domain_uid = entry.domain_id
+                in_use = any(
+                    d.parent_uuid == domain_uid
+                    for c in self.checkpoint.prepared.values()
+                    for d in c.devices)
+                if not in_use:
+                    try:
+                        self.manager.remove_node_label(domain_uid)
+                    except Exception as exc:  # noqa: BLE001 — best effort
+                        klog.warning("label rollback failed",
+                                     domain=domain_uid, err=repr(exc))
+
     # -- internals ---------------------------------------------------------
     def _prepare_devices(
         self, claim: dict,

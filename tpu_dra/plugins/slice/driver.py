@@ -103,9 +103,15 @@ class SliceDriver:
         done = threading.Event()
         pending = {claim["metadata"]["uid"] for claim in claims}
         lock = threading.Lock()
+        closed = False   # once True, late finishers must not touch results
 
         def finish(uid: str, result: PrepareResult) -> None:
+            nonlocal closed
             with lock:
+                if closed:
+                    klog.warning("prepare finished after response deadline",
+                                 claim=uid, err=result.error or "")
+                    return
                 results[uid] = result
                 pending.discard(uid)
                 if not pending:
@@ -125,17 +131,21 @@ class SliceDriver:
                      "cdi_device_ids": d.cdi_device_ids}
                     for d in devices]))
 
+            def on_error(exc, _uid: str = uid, _claim: dict = claim) -> None:
+                self.state.rollback_channel(_claim)
+                finish(_uid, PrepareResult(
+                    error=f"error preparing claim {_uid}: {exc}"))
+
             self.queue.enqueue_with_deadline(
                 attempt, claim, timeout=self.cfg.retry_timeout, key=uid,
-                on_error=lambda exc, _uid=uid: finish(
-                    _uid, PrepareResult(
-                        error=f"error preparing claim {_uid}: {exc}")))
+                on_error=on_error)
         done.wait(self.cfg.retry_timeout + 5.0)
         with lock:
+            closed = True
             for uid in list(pending):
                 results[uid] = PrepareResult(
                     error=f"claim {uid}: prepare timed out")
-        return results
+            return dict(results)
 
     def unprepare_resource_claims(self, refs: list[ClaimRef]
                                   ) -> dict[str, str]:

@@ -1,0 +1,59 @@
+"""The slice-domain acceptance job — the nvbandwidth MPIJob analog.
+
+Each worker pod holds the domain's channel claim; the driver injects the
+coordination env + settings mount.  The job resolves rendezvous, initializes
+``jax.distributed``, and runs the ICI collective benchmarks across every
+chip in the domain (BASELINE.md: "a jax.lax.psum on a GKE v5e-16 node pool").
+
+Run: ``python -m tpu_dra.workloads.psum_job [--mib 64]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mib", type=int, default=64,
+                        help="per-device buffer MiB")
+    parser.add_argument("--local-only", action="store_true",
+                        help="skip jax.distributed (single-host smoke test)")
+    args = parser.parse_args()
+
+    if not args.local_only and os.environ.get("SLICE_DOMAIN_UUID"):
+        from tpu_dra.workloads.launcher import resolve
+        info = resolve()
+        print(f"rendezvous: coordinator={info.coordinator_address} "
+              f"processes={info.num_processes} rank={info.process_id}",
+              flush=True)
+        info.initialize()
+
+    import jax
+
+    from tpu_dra.workloads.collectives import (
+        make_mesh,
+        ppermute_bandwidth,
+        psum_bandwidth,
+    )
+
+    devices = jax.devices()
+    print(f"devices: {len(devices)} × {devices[0].device_kind}", flush=True)
+    results = {}
+    if len(devices) > 1:
+        mesh = make_mesh()
+        psum = psum_bandwidth(mesh, mib_per_device=args.mib)
+        perm = ppermute_bandwidth(mesh, mib_per_device=args.mib)
+        results = {
+            "psum_gbps": round(psum.algo_bytes_per_s / 1e9, 2),
+            "ppermute_gbps": round(perm.algo_bytes_per_s / 1e9, 2),
+        }
+    print(json.dumps({"n_devices": len(devices), **results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
