@@ -93,7 +93,27 @@ def bench_tpu() -> dict:
         devices = jax.devices()
         out["tpu_devices"] = len(devices)
         out["tpu_platform"] = devices[0].platform
+        if devices[0].platform != "tpu":
+            # CI smoke on CPU: a tiny matmul proves the path; the real
+            # numbers only mean something on the chip
+            out["tpu_matmul_tflops"] = round(matmul_throughput(512, iters=3),
+                                             3)
+            return out
         out["tpu_matmul_tflops"] = round(matmul_throughput(4096), 2)
+        try:
+            from tpu_dra.workloads.collectives import _time_op
+            from tpu_dra.workloads.pallas_kernels import matmul as pl_matmul
+            import jax.numpy as jnp
+            n = 4096
+            a = jax.random.normal(jax.random.PRNGKey(0), (n, n),
+                                  jnp.bfloat16)
+            b = jax.random.normal(jax.random.PRNGKey(1), (n, n),
+                                  jnp.bfloat16)
+            inv = jnp.bfloat16(1.0 / n)
+            secs = _time_op(lambda x: pl_matmul(x, b) * inv, a, iters=30)
+            out["pallas_matmul_tflops"] = round(2 * n**3 / secs / 1e12, 2)
+        except Exception as exc:  # noqa: BLE001 — pallas is an extra
+            out["pallas_error"] = repr(exc)[:200]
         if len(devices) > 1:
             res = psum_bandwidth(make_mesh())
             out["psum_gbps"] = round(res.algo_bytes_per_s / 1e9, 2)

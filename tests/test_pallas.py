@@ -1,0 +1,46 @@
+"""Pallas kernel correctness (interpret mode on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dra.workloads.pallas_kernels import fused_rmsnorm_matmul, matmul
+
+
+@pytest.mark.parametrize("m,k,n,bm,bn,bk", [
+    (256, 256, 256, 128, 128, 128),
+    (256, 512, 128, 128, 128, 256),   # multi-step K accumulation
+])
+def test_matmul_matches_xla(m, k, n, bm, bn, bk):
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.bfloat16)
+    y = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.bfloat16)
+    out = matmul(x, y, bm=bm, bn=bn, bk=bk, interpret=True)
+    ref = (x.astype(jnp.float32) @ y.astype(jnp.float32)
+           ).astype(jnp.bfloat16)
+    assert out.shape == (m, n)
+    err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    assert float(err) < 1.0   # 1 ulp at bf16 for these magnitudes
+
+
+def test_matmul_rejects_untileable_shapes():
+    x = jnp.zeros((100, 128), jnp.bfloat16)
+    y = jnp.zeros((128, 128), jnp.bfloat16)
+    with pytest.raises(AssertionError, match="tile"):
+        matmul(x, y, bm=64, bn=64, bk=64, interpret=True)
+
+
+def test_fused_rmsnorm_matmul_matches_reference():
+    m = k = n = 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (m, k), jnp.bfloat16)
+    g = (jax.random.normal(jax.random.PRNGKey(2), (k,)) * 0.1 + 1.0
+         ).astype(jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (k, n), jnp.bfloat16)
+    out = fused_rmsnorm_matmul(x, g, w, bm=128, bn=128, interpret=True)
+    xf = x.astype(jnp.float32)
+    normed = (xf * jax.lax.rsqrt(
+        jnp.mean(jnp.square(xf), -1, keepdims=True) + 1e-6)
+        ) * g.astype(jnp.float32)
+    ref = (normed.astype(jnp.bfloat16).astype(jnp.float32)
+           @ w.astype(jnp.float32)).astype(jnp.bfloat16)
+    err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    assert float(err) < 1.0

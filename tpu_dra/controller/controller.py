@@ -23,6 +23,19 @@ from tpu_dra.util import klog
 from tpu_dra.util.metrics import DEFAULT_REGISTRY
 from tpu_dra.util.workqueue import WorkQueue
 
+_RECONCILES = None
+
+
+def _reconciles_counter():
+    """Module-level singleton: multiple Controller instances (tests) must
+    not register duplicate metric names."""
+    global _RECONCILES
+    if _RECONCILES is None:
+        _RECONCILES = DEFAULT_REGISTRY.counter(
+            "tpu_dra_reconciles_total",
+            "TpuSliceDomain reconcile attempts", labels=("result",))
+    return _RECONCILES
+
 
 @dataclass
 class ControllerConfig:
@@ -36,11 +49,10 @@ class Controller:
     def __init__(self, cfg: ControllerConfig) -> None:
         self.cfg = cfg
         self.queue = WorkQueue("slice-domain-controller")
+        self.reconciles = _reconciles_counter()
         self.manager = SliceDomainManager(
-            cfg.kube, cfg.driver_namespace, cfg.image_name, self.queue)
-        self.reconciles = DEFAULT_REGISTRY.counter(
-            "tpu_dra_reconciles_total",
-            "TpuSliceDomain reconcile attempts")
+            cfg.kube, cfg.driver_namespace, cfg.image_name, self.queue,
+            reconcile_counter=self.reconciles)
         exists = self.manager.domain_exists
         self.gc_managers = [
             CleanupManager(

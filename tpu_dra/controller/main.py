@@ -41,11 +41,9 @@ def main(argv=None) -> int:
     klog.configure(args.v, args.logging_format)
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
-    if args.http_endpoint:
-        host, _, port = args.http_endpoint.rpartition(":")
-        metrics.serve_http_endpoint(
-            host or "0.0.0.0", int(port),
-            metrics_path=args.metrics_path, pprof_path=args.pprof_path)
+    if metrics.serve_from_flag(args.http_endpoint,
+                               metrics_path=args.metrics_path,
+                               pprof_path=args.pprof_path):
         klog.info("metrics endpoint serving", endpoint=args.http_endpoint)
     controller = Controller(ControllerConfig(
         kube=kube,

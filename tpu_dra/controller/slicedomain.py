@@ -30,7 +30,9 @@ from tpu_dra.util.workqueue import WorkQueue
 
 class SliceDomainManager:
     def __init__(self, kube: KubeClient, driver_namespace: str,
-                 image_name: str, queue: WorkQueue) -> None:
+                 image_name: str, queue: WorkQueue,
+                 reconcile_counter=None) -> None:
+        self._reconciles = reconcile_counter
         self.kube = kube
         self.driver_namespace = driver_namespace
         self.queue = queue
@@ -72,6 +74,17 @@ class SliceDomainManager:
 
     # -- reconcile (computedomain.go:226-286) ------------------------------
     def on_add_or_update(self, obj: dict) -> None:
+        try:
+            self._reconcile(obj)
+        except BaseException:
+            if self._reconciles is not None:
+                self._reconciles.inc("error")
+            raise
+        else:
+            if self._reconciles is not None:
+                self._reconciles.inc("ok")
+
+    def _reconcile(self, obj: dict) -> None:
         domain = TpuSliceDomain.from_dict(obj)
         if domain.deleting:
             self._teardown(domain)

@@ -1,0 +1,55 @@
+"""Shared plugin metrics (module-level singletons so repeated driver
+construction in tests doesn't duplicate registrations)."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_METRICS = None
+
+
+def plugin_metrics():
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = {
+            "prepare_seconds": DEFAULT_REGISTRY.histogram(
+                "tpu_dra_prepare_seconds",
+                "NodePrepareResources per-claim latency"),
+            "prepares_total": DEFAULT_REGISTRY.counter(
+                "tpu_dra_prepares_total", "prepare attempts",
+                labels=("driver", "result")),
+            "unprepares_total": DEFAULT_REGISTRY.counter(
+                "tpu_dra_unprepares_total", "unprepare attempts",
+                labels=("driver", "result")),
+        }
+    return _METRICS
+
+
+@contextmanager
+def observe_prepare(driver_name: str):
+    m = plugin_metrics()
+    t0 = time.monotonic()
+    try:
+        yield
+    except BaseException:
+        m["prepares_total"].inc(driver_name, "error")
+        raise
+    else:
+        m["prepares_total"].inc(driver_name, "ok")
+    finally:
+        m["prepare_seconds"].observe(time.monotonic() - t0)
+
+
+@contextmanager
+def observe_unprepare(driver_name: str):
+    m = plugin_metrics()
+    try:
+        yield
+    except BaseException:
+        m["unprepares_total"].inc(driver_name, "error")
+        raise
+    else:
+        m["unprepares_total"].inc(driver_name, "ok")

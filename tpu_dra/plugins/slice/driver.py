@@ -121,8 +121,10 @@ class SliceDriver:
             uid = claim["metadata"]["uid"]
 
             def attempt(obj: dict, _uid: str = uid) -> None:
-                with locked(self.flock_path,
-                            timeout=self.cfg.flock_timeout):
+                from tpu_dra.plugins.metrics import observe_prepare
+                with observe_prepare(SLICE_DRIVER_NAME), \
+                        locked(self.flock_path,
+                               timeout=self.cfg.flock_timeout):
                     devices = self.state.prepare(obj)
                 finish(_uid, PrepareResult(devices=[
                     {"request_names": d.request_names,
@@ -149,11 +151,13 @@ class SliceDriver:
 
     def unprepare_resource_claims(self, refs: list[ClaimRef]
                                   ) -> dict[str, str]:
+        from tpu_dra.plugins.metrics import observe_unprepare
         errors: dict[str, str] = {}
         for ref in refs:
             try:
-                with locked(self.flock_path,
-                            timeout=self.cfg.flock_timeout):
+                with observe_unprepare(SLICE_DRIVER_NAME), \
+                        locked(self.flock_path,
+                               timeout=self.cfg.flock_timeout):
                     self.state.unprepare(ref.uid)
             except Exception as exc:  # noqa: BLE001 — reported per claim
                 errors[ref.uid] = f"error unpreparing {ref.uid}: {exc}"

@@ -103,7 +103,9 @@ class TpuDriver:
         return results
 
     def _node_prepare(self, claim: dict) -> PrepareResult:
-        with locked(self.flock_path, timeout=self.cfg.flock_timeout):
+        from tpu_dra.plugins.metrics import observe_prepare
+        with observe_prepare(DRIVER_NAME), \
+                locked(self.flock_path, timeout=self.cfg.flock_timeout):
             devices = self.state.prepare(claim)
         return PrepareResult(devices=[
             {
@@ -118,11 +120,13 @@ class TpuDriver:
     def unprepare_resource_claims(self, refs: list[ClaimRef]
                                   ) -> dict[str, str]:
         """driver.go:108-153."""
+        from tpu_dra.plugins.metrics import observe_unprepare
         errors: dict[str, str] = {}
         for ref in refs:
             try:
-                with locked(self.flock_path,
-                            timeout=self.cfg.flock_timeout):
+                with observe_unprepare(DRIVER_NAME), \
+                        locked(self.flock_path,
+                               timeout=self.cfg.flock_timeout):
                     self.state.unprepare(ref.uid)
             except Exception as exc:  # noqa: BLE001 — reported per claim
                 klog.error("unprepare failed", claim=ref.uid, err=repr(exc))

@@ -36,6 +36,8 @@ def main(argv=None) -> int:
         argv,
         description=__doc__)
     klog.configure(args.v, args.logging_format)
+    from tpu_dra.util.metrics import serve_from_flag
+    serve_from_flag(args.http_endpoint)
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
     driver = TpuDriver(TpuDriverConfig(

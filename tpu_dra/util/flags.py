@@ -94,6 +94,8 @@ def plugin_common_flags() -> FlagGroup:
              "host root under which libtpu/device files are found", "/"),
         Flag("image-name", "IMAGE_NAME", "driver image (for spawned pods)",
              "tpu-dra-driver:latest"),
+        Flag("http-endpoint", "HTTP_ENDPOINT",
+             "host:port for the metrics/healthz endpoint (empty = off)", ""),
     ])
 
 

@@ -125,6 +125,18 @@ def _stacks_dump() -> str:
     return "\n".join(out)
 
 
+def serve_from_flag(endpoint: str, **kwargs) -> Optional[ThreadingHTTPServer]:
+    """Parse a ``host:port`` / ``:port`` flag value and serve; empty = off.
+    A port-less value is a configuration error, reported as such."""
+    if not endpoint:
+        return None
+    host, _, port = endpoint.rpartition(":")
+    if not port.isdigit():
+        raise ValueError(
+            f"--http-endpoint {endpoint!r}: expected host:port or :port")
+    return serve_http_endpoint(host or "0.0.0.0", int(port), **kwargs)
+
+
 def serve_http_endpoint(
     address: str = "127.0.0.1", port: int = 0,
     metrics_path: str = "/metrics", pprof_path: str = "/debug/pprof",

@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from tpu_dra.util import klog
+
 
 class PermanentError(Exception):
     """Marks an error that must short-circuit retries.
@@ -163,6 +165,9 @@ class WorkQueue:
                         item.on_error(exc)
                 except BaseException as exc:  # noqa: BLE001 — retried below
                     delay = self._backoff.when(item.key)
+                    klog.info("workqueue item failed; backing off", level=4,
+                              queue=self.name, key=str(item.key)[:64],
+                              delay=round(delay, 3), err=repr(exc)[:200])
                     if item.deadline is not None and \
                             time.monotonic() + delay > item.deadline:
                         self._backoff.forget(item.key)

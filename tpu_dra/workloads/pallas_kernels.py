@@ -1,0 +1,125 @@
+"""Pallas TPU kernels for the workload surface.
+
+The driver's demo/benchmark workloads are MXU-bound matmuls; these kernels
+are the hand-tiled fast path used by the benchmark (``bench.py``) and as a
+reference for tenants writing their own.  Layout follows the TPU kernel
+playbook: grid over (M/bm, N/bn), K streamed through VMEM with an fp32
+accumulator in scratch, block shapes multiples of the MXU's 128×128, bf16
+inputs.
+
+Kernels run on real TPUs and, for tests, under ``interpret=True`` on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _matmul_kernel(x_ref, y_ref, out_ref, acc_ref, *, k_steps: int):
+    """One (bm, bn) output tile: accumulate over the K grid axis in fp32
+    scratch, write back on the last step."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_ref[:], y_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_steps - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret"))
+def matmul(x, y, *, bm: int = 1024, bn: int = 1024, bk: int = 512,
+           interpret: bool = False):
+    """Tiled ``x @ y`` (bf16 in, bf16 out, fp32 accumulate).
+
+    Shapes must tile evenly (static-shape discipline: the caller pads).
+    """
+    m, k = x.shape
+    k2, n = y.shape
+    assert k == k2, (x.shape, y.shape)
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    assert m % bm == 0 and n % bn == 0 and k % bk == 0, \
+        f"shapes {(m, k, n)} must tile by {(bm, bk, bn)}"
+    k_steps = k // bk
+    grid = (m // bm, n // bn, k_steps)
+    return pl.pallas_call(
+        functools.partial(_matmul_kernel, k_steps=k_steps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            # M/N tiles are independent; K carries the accumulator — this
+            # unlocks the Mosaic pipeliner across the grid
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, y)
+
+
+def _fused_rmsnorm_matmul_kernel(x_ref, g_ref, w_ref, out_ref, acc_ref, *,
+                                 k_steps: int, eps: float):
+    """Fused RMSNorm(x)·W — the normalization rides along in VMEM so the
+    activation never round-trips HBM between the norm and the matmul."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    # per-row rsqrt of the block's mean-square: correct because the caller
+    # guarantees bk == K (norm axis fits one block)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    normed = (x * jax.lax.rsqrt(var + eps)) * g_ref[:].astype(jnp.float32)
+    acc_ref[:] += jnp.dot(normed.astype(x_ref.dtype), w_ref[:],
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_steps - 1)
+    def _flush():
+        out_ref[:] = acc_ref[:].astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
+def fused_rmsnorm_matmul(x, gamma, w, *, bm: int = 256, bn: int = 256,
+                         eps: float = 1e-6, interpret: bool = False):
+    """``rmsnorm(x, gamma) @ w`` in one kernel (bf16, fp32 accumulate).
+
+    The norm axis (K) is kept whole in VMEM, so K must fit a block.
+    Default blocks budget ~9MB of the 16MB VMEM/core at K=4096 (double
+    buffering included); shrink bm/bn for larger K.
+    """
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2 and gamma.shape == (k,)
+    bm, bn = min(bm, m), min(bn, n)
+    assert m % bm == 0 and n % bn == 0
+    grid = (m // bm, n // bn, 1)
+    return pl.pallas_call(
+        functools.partial(_fused_rmsnorm_matmul_kernel, k_steps=1, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((k,), lambda i, j, kk: (0,)),
+            pl.BlockSpec((k, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, gamma, w)
