@@ -31,8 +31,17 @@ print(json.dumps({{"rank": info.process_id,
 """
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_rendezvous():
     tmp = tempfile.mkdtemp(prefix="jdist-")
+    port = _free_port()
     with open(os.path.join(tmp, "nodes_config.json"), "w") as f:
         json.dump({"nodes": [
             {"name": "n0", "ipAddress": "127.0.0.1", "workerID": 0},
@@ -51,6 +60,8 @@ def test_two_process_rendezvous():
             "SLICE_DOMAIN_UUID": "uid-1",
             "SLICE_SETTINGS_DIR": tmp,
             "POD_IP": ip,
+            # parallel-safe: don't collide on the default coordinator port
+            "JAX_COORDINATOR_PORT": str(port),
         })
         procs.append(subprocess.Popen(
             [sys.executable, script], env=env,

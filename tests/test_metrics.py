@@ -1,0 +1,131 @@
+"""Metrics registry / exposition-format tests (util/metrics.py)."""
+
+import urllib.request
+
+import pytest
+
+from tpu_dra.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    serve_from_flag,
+    serve_http_endpoint,
+)
+
+
+def test_counter_exposition():
+    reg = Registry()
+    c = reg.counter("reqs_total", "requests", labels=("code",))
+    c.inc("200")
+    c.inc("200")
+    c.inc("500")
+    text = reg.expose()
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{code="200"} 2.0' in text
+    assert 'reqs_total{code="500"} 1.0' in text
+
+
+def test_gauge_set():
+    reg = Registry()
+    g = reg.gauge("temp", "temperature")
+    g.set(3.5)
+    assert "temp 3.5" in reg.expose()
+
+
+def test_histogram_unlabeled():
+    reg = Registry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+    assert "lat_sum 5.55" in text
+
+
+def test_histogram_labeled_series():
+    reg = Registry()
+    h = reg.histogram("lat", "latency", buckets=(1.0,), labels=("driver",))
+    h.observe(0.5, "tpu")
+    h.observe(2.0, "slice")
+    text = reg.expose()
+    assert 'lat_bucket{driver="tpu",le="1.0"} 1' in text
+    assert 'lat_bucket{driver="tpu",le="+Inf"} 1' in text
+    assert 'lat_bucket{driver="slice",le="1.0"} 0' in text
+    assert 'lat_bucket{driver="slice",le="+Inf"} 1' in text
+    assert 'lat_sum{driver="tpu"} 0.5' in text
+    assert 'lat_count{driver="slice"} 1' in text
+    # single HELP/TYPE header despite two series
+    assert text.count("# TYPE lat histogram") == 1
+
+
+def test_registry_idempotent_by_name():
+    reg = Registry()
+    a = reg.counter("x_total", "x", labels=("l",))
+    b = reg.counter("x_total", "x", labels=("l",))
+    assert a is b
+    a.inc("v")
+    b.inc("v")
+    assert 'x_total{l="v"} 2.0' in reg.expose()
+    h1 = reg.histogram("h", "h")
+    assert reg.histogram("h", "h") is h1
+
+
+def test_registry_kind_conflict_raises():
+    reg = Registry()
+    reg.counter("m", "m")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("m", "m")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("m", "m")
+
+
+def test_registry_signature_conflict_raises():
+    reg = Registry()
+    reg.histogram("lat", "latency")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("lat", "latency", labels=("driver",))
+    reg.counter("c_total", "c", labels=("a",))
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("c_total", "c", labels=("b",))
+
+
+def test_plugin_metrics_reuse_same_series():
+    from tpu_dra.plugins.metrics import observe_prepare, plugin_metrics
+
+    m1 = plugin_metrics()
+    m2 = plugin_metrics()
+    assert m1["prepare_seconds"] is m2["prepare_seconds"]
+    with observe_prepare("tpu.google.com"):
+        pass
+    text = m1["prepare_seconds"].collect()
+    assert 'driver="tpu.google.com"' in text
+
+
+def test_http_endpoint_serves_metrics_and_healthz():
+    reg = Registry()
+    reg.counter("up_total", "up").inc()
+    server = serve_http_endpoint("127.0.0.1", 0, registry=reg)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "up_total 1.0" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+        assert health.status == 200
+        pprof = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof", timeout=5).read().decode()
+        assert "thread" in pprof
+    finally:
+        server.shutdown()
+
+
+def test_serve_from_flag_validation():
+    assert serve_from_flag("") is None
+    with pytest.raises(ValueError, match="expected host:port"):
+        serve_from_flag("no-port")

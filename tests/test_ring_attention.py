@@ -1,0 +1,127 @@
+"""Ring-attention (sequence parallelism) tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dra.workloads.ring_attention import (
+    make_ring_attention,
+    make_ring_train_step,
+)
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+
+def _dense_attention(q, k, v, causal):
+    """Reference O(S^2)-memory attention in fp32."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense(causal, sp):
+    mesh = _mesh((sp,), ("sp",))
+    B, H, S, D = 2, 3, 8 * sp, 16
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(kk, (B, H, S, D), jnp.float32)
+    v = jax.random.normal(kv, (B, H, S, D), jnp.float32)
+
+    ring = jax.jit(make_ring_attention(mesh, causal=causal))
+    shard = NamedSharding(mesh, P(None, None, "sp", None))
+    out = ring(jax.device_put(q, shard), jax.device_put(k, shard),
+               jax.device_put(v, shard))
+    want = _dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_dp_by_sp_mesh():
+    mesh = _mesh((2, 4), ("dp", "sp"))
+    B, H, S, D = 4, 2, 16, 8
+    q = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D))
+    ring = jax.jit(make_ring_attention(mesh))
+    out = ring(q, q, q)
+    want = _dense_attention(q, q, q, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_bf16_output_dtype():
+    mesh = _mesh((2,), ("sp",))
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 8, 4),
+                          jnp.bfloat16)
+    out = jax.jit(make_ring_attention(mesh))(q, q, q)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_ring_train_step_runs_and_descends():
+    mesh = _mesh((2, 4), ("dp", "sp"))
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    step, tok_sharding = make_ring_train_step(cfg, mesh, lr=5e-2)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 32), 0, 64)
+    targets = jnp.roll(tokens, -1, axis=1)
+    tokens = jax.device_put(tokens, tok_sharding)
+    targets = jax.device_put(targets, tok_sharding)
+
+    params, loss0 = step(params, tokens, targets)
+    for _ in range(10):
+        params, loss = step(params, tokens, targets)
+    assert jnp.isfinite(loss0) and jnp.isfinite(loss)
+    assert float(loss) < float(loss0), (loss0, loss)
+
+
+def test_ring_train_grads_replicated():
+    """Params must stay identical across devices after a step (the explicit
+    grad psum guards against silent divergence under check_rep=False)."""
+    mesh = _mesh((2, 2), ("dp", "sp"))
+    cfg = ModelConfig(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                      d_ff=32, max_seq=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    step, tok_sharding = make_ring_train_step(cfg, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 32)
+    tokens = jax.device_put(tokens, tok_sharding)
+    params, _ = step(params, tokens, jnp.roll(tokens, -1, axis=1))
+    emb = params["embed"]
+    shards = [np.asarray(s.data) for s in emb.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_ring_matches_single_device_train_loss():
+    """DP×SP loss equals the unsharded loss on the same batch."""
+    from tpu_dra.workloads.train import loss_fn
+
+    mesh = _mesh((1, 4), ("dp", "sp"))
+    cfg = ModelConfig(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                      d_ff=32, max_seq=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    step, tok_sharding = make_ring_train_step(cfg, mesh, lr=0.0)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 17), 0, 32)
+    # ring step consumes [B, 16] tokens + globally-shifted targets
+    t_in = jax.device_put(tokens[:, :16], tok_sharding)
+    t_tgt = jax.device_put(tokens[:, 1:17], tok_sharding)
+    _, ring_loss = step(params, t_in, t_tgt)
+    dense_loss = loss_fn(cfg, params, tokens[:, :17])
+    # ring computes scores in fp32 where the dense path's einsum is bf16 —
+    # agreement is bounded by bf16 resolution, not exact
+    np.testing.assert_allclose(float(ring_loss), float(dense_loss),
+                               rtol=2e-3)

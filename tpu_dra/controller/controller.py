@@ -23,20 +23,6 @@ from tpu_dra.util import klog
 from tpu_dra.util.metrics import DEFAULT_REGISTRY
 from tpu_dra.util.workqueue import WorkQueue
 
-_RECONCILES = None
-
-
-def _reconciles_counter():
-    """Module-level singleton: multiple Controller instances (tests) must
-    not register duplicate metric names."""
-    global _RECONCILES
-    if _RECONCILES is None:
-        _RECONCILES = DEFAULT_REGISTRY.counter(
-            "tpu_dra_reconciles_total",
-            "TpuSliceDomain reconcile attempts", labels=("result",))
-    return _RECONCILES
-
-
 @dataclass
 class ControllerConfig:
     kube: KubeClient
@@ -49,7 +35,9 @@ class Controller:
     def __init__(self, cfg: ControllerConfig) -> None:
         self.cfg = cfg
         self.queue = WorkQueue("slice-domain-controller")
-        self.reconciles = _reconciles_counter()
+        self.reconciles = DEFAULT_REGISTRY.counter(
+            "tpu_dra_reconciles_total",
+            "TpuSliceDomain reconcile attempts", labels=("result",))
         self.manager = SliceDomainManager(
             cfg.kube, cfg.driver_namespace, cfg.image_name, self.queue,
             reconcile_counter=self.reconciles)

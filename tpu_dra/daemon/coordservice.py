@@ -31,8 +31,14 @@ JAX_COORDINATOR_PORT = 8476   # jax.distributed default
 
 
 class CoordState:
-    def __init__(self, settings_dir: str) -> None:
+    def __init__(self, settings_dir: str,
+                 coordinator_port: int | None = None) -> None:
         self.settings_dir = settings_dir
+        # same override contract as workloads.launcher._coordinator_port,
+        # so settings-dir and coordservice resolution paths agree
+        self.coordinator_port = coordinator_port if coordinator_port \
+            else int(os.environ.get("JAX_COORDINATOR_PORT",
+                                    JAX_COORDINATOR_PORT))
         self._mu = threading.Lock()
         self._nodes: list[dict] = []
         self._mtime = 0.0
@@ -66,7 +72,7 @@ class CoordState:
         if not nodes:
             return ""
         rank0 = min(nodes, key=lambda n: n.get("workerID", 1 << 30))
-        return f"{rank0['ipAddress']}:{JAX_COORDINATOR_PORT}"
+        return f"{rank0['ipAddress']}:{self.coordinator_port}"
 
     def process_index(self, ip: str) -> int:
         for i, node in enumerate(

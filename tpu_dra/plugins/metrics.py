@@ -1,5 +1,5 @@
-"""Shared plugin metrics (module-level singletons so repeated driver
-construction in tests doesn't duplicate registrations)."""
+"""Shared kubelet-plugin metrics (Registry is idempotent by name, so this is
+plain declaration — repeated driver construction reuses the same series)."""
 
 from __future__ import annotations
 
@@ -8,24 +8,20 @@ from contextlib import contextmanager
 
 from tpu_dra.util.metrics import DEFAULT_REGISTRY
 
-_METRICS = None
-
 
 def plugin_metrics():
-    global _METRICS
-    if _METRICS is None:
-        _METRICS = {
-            "prepare_seconds": DEFAULT_REGISTRY.histogram(
-                "tpu_dra_prepare_seconds",
-                "NodePrepareResources per-claim latency"),
-            "prepares_total": DEFAULT_REGISTRY.counter(
-                "tpu_dra_prepares_total", "prepare attempts",
-                labels=("driver", "result")),
-            "unprepares_total": DEFAULT_REGISTRY.counter(
-                "tpu_dra_unprepares_total", "unprepare attempts",
-                labels=("driver", "result")),
-        }
-    return _METRICS
+    return {
+        "prepare_seconds": DEFAULT_REGISTRY.histogram(
+            "tpu_dra_prepare_seconds",
+            "NodePrepareResources per-claim latency",
+            labels=("driver",)),
+        "prepares_total": DEFAULT_REGISTRY.counter(
+            "tpu_dra_prepares_total", "prepare attempts",
+            labels=("driver", "result")),
+        "unprepares_total": DEFAULT_REGISTRY.counter(
+            "tpu_dra_unprepares_total", "unprepare attempts",
+            labels=("driver", "result")),
+    }
 
 
 @contextmanager
@@ -40,7 +36,7 @@ def observe_prepare(driver_name: str):
     else:
         m["prepares_total"].inc(driver_name, "ok")
     finally:
-        m["prepare_seconds"].observe(time.monotonic() - t0)
+        m["prepare_seconds"].observe(time.monotonic() - t0, driver_name)
 
 
 @contextmanager

@@ -51,62 +51,90 @@ class Gauge(Counter):
 
 
 class Histogram:
+    KIND = "histogram"
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
     def __init__(self, name: str, help_: str,
-                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                 labels: tuple[str, ...] = ()):
         self.name, self.help, self.buckets = name, help_, buckets
-        self._counts = [0] * (len(buckets) + 1)
-        self._sum = 0.0
+        self.labels = labels
+        # per-label-set series: label values -> [bucket counts..., sum]
+        self._series: dict[tuple[str, ...], list] = {}
         self._mu = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, *label_values: str) -> None:
         with self._mu:
-            self._sum += value
+            s = self._series.setdefault(
+                label_values, [0] * (len(self.buckets) + 1) + [0.0])
+            s[-1] += value
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    s[i] += 1
                     return
-            self._counts[-1] += 1
+            s[len(self.buckets)] += 1
 
     def collect(self) -> str:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with self._mu:
+            series = sorted((lv, list(s)) for lv, s in self._series.items())
+        for lv, s in series:
+            lbl = ",".join(f'{k}="{v}"' for k, v in zip(self.labels, lv))
+            pre = lbl + "," if lbl else ""
             cum = 0
-            for b, c in zip(self.buckets, self._counts):
+            for b, c in zip(self.buckets, s):
                 cum += c
-                out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-            cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-            out.append(f"{self.name}_sum {self._sum}")
-            out.append(f"{self.name}_count {cum}")
+                out.append(f'{self.name}_bucket{{{pre}le="{b}"}} {cum}')
+            cum += s[len(self.buckets)]
+            out.append(f'{self.name}_bucket{{{pre}le="+Inf"}} {cum}')
+            suffix = f"{{{lbl}}}" if lbl else ""
+            out.append(f"{self.name}_sum{suffix} {s[-1]}")
+            out.append(f"{self.name}_count{suffix} {cum}")
         return "\n".join(out)
 
 
 class Registry:
+    """Metric registry, idempotent by name: re-requesting an existing name
+    returns the existing instance (same kind required), so modules can
+    declare their metrics at construction time without singleton wrappers."""
+
     def __init__(self) -> None:
-        self._metrics: list = []
+        # name -> (metric, registration args) so a re-request with a
+        # different signature fails loudly instead of silently merging
+        self._metrics: dict[str, tuple] = {}
         self._mu = threading.Lock()
 
-    def register(self, metric):
+    def _get_or_register(self, cls, name, *args):
         with self._mu:
-            self._metrics.append(metric)
-        return metric
+            existing, sig = self._metrics.get(name, (None, None))
+            if existing is not None:
+                if type(existing) is not cls or sig != args:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}{sig}, requested "
+                        f"{cls.__name__}{args}")
+                return existing
+            metric = cls(name, *args)
+            self._metrics[name] = (metric, args)
+            return metric
 
-    def counter(self, name: str, help_: str, labels: tuple[str, ...] = ()):
-        return self.register(Counter(name, help_, labels))
+    def counter(self, name: str, help_: str,
+                labels: tuple[str, ...] = ()) -> Counter:
+        return self._get_or_register(Counter, name, help_, labels)
 
-    def gauge(self, name: str, help_: str, labels: tuple[str, ...] = ()):
-        return self.register(Gauge(name, help_, labels))
+    def gauge(self, name: str, help_: str,
+              labels: tuple[str, ...] = ()) -> Gauge:
+        return self._get_or_register(Gauge, name, help_, labels)
 
     def histogram(self, name: str, help_: str,
-                  buckets: tuple[float, ...] = Histogram.DEFAULT_BUCKETS):
-        return self.register(Histogram(name, help_, buckets))
+                  buckets: tuple[float, ...] = Histogram.DEFAULT_BUCKETS,
+                  labels: tuple[str, ...] = ()) -> Histogram:
+        return self._get_or_register(Histogram, name, help_, buckets, labels)
 
     def expose(self) -> str:
         with self._mu:
-            metrics = list(self._metrics)
+            metrics = [m for m, _ in self._metrics.values()]
         return "\n".join(m.collect() for m in metrics) + "\n"
 
 

@@ -36,8 +36,16 @@ class RendezvousInfo:
 JAX_COORDINATOR_PORT = 8476
 
 
-def _from_settings_dir(settings_dir: str,
-                       my_ip: str) -> Optional[RendezvousInfo]:
+def _coordinator_port(env: Optional[dict] = None) -> int:
+    """Coordinator port, overridable via ``JAX_COORDINATOR_PORT`` (the slice
+    plugin may inject it; tests use it to stay parallel-safe)."""
+    e = os.environ if env is None else env
+    return int(e.get("JAX_COORDINATOR_PORT", JAX_COORDINATOR_PORT))
+
+
+def _from_settings_dir(settings_dir: str, my_ip: str,
+                       env: Optional[dict] = None
+                       ) -> Optional[RendezvousInfo]:
     path = os.path.join(settings_dir, "nodes_config.json")
     try:
         with open(path) as f:
@@ -47,7 +55,7 @@ def _from_settings_dir(settings_dir: str,
     if not nodes:
         return None
     nodes = sorted(nodes, key=lambda n: (n.get("workerID", 0), n["name"]))
-    coordinator = f"{nodes[0]['ipAddress']}:{JAX_COORDINATOR_PORT}"
+    coordinator = f"{nodes[0]['ipAddress']}:{_coordinator_port(env)}"
     pid = next((i for i, n in enumerate(nodes)
                 if n.get("ipAddress") == my_ip), -1)
     if pid < 0:
@@ -93,7 +101,7 @@ def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
             "the domain's ResourceClaimTemplate")
     my_ip = env.get("POD_IP", "")
     settings = env.get("SLICE_SETTINGS_DIR", "/etc/tpu-slice")
-    info = _from_settings_dir(settings, my_ip)
+    info = _from_settings_dir(settings, my_ip, env)
     if info is None:
         port = int(env.get("SLICE_COORDINATOR_PORT", "51000"))
         info = _from_coordservice(port, my_ip)

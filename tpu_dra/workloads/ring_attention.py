@@ -1,0 +1,222 @@
+"""Ring attention — sequence/context parallelism over an ICI mesh axis.
+
+The reference driver wires up the multi-node memory-export fabric that
+NCCL-level collectives ride for long-context training (SURVEY.md §5
+"Long-context"); the workload-level capability itself lives here: a
+TPU-native ring-attention primitive so a claimed slice domain can train with
+sequences sharded across chips.
+
+TPU-first design (not a port — the reference has no model code):
+- sequence axis sharded over a mesh axis (default ``"sp"``); each device
+  holds a ``[B, H, S/n, D]`` block of q/k/v;
+- k/v blocks circulate the ring with ``lax.ppermute`` — nearest-neighbour
+  ICI traffic, overlapping compute with the shift XLA schedules;
+- flash-style online softmax (running max / denominator) so the full
+  ``[S, S]`` score matrix never materializes — HBM stays O(S/n · D);
+- causal masking at block granularity: the local block is processed at ring
+  step 0 so every query row sees its diagonal first, keeping the running max
+  finite; fully-future blocks contribute exp(min - m) == 0.  Work for future
+  blocks is still executed (uniform SPMD schedule — no data-dependent
+  control flow under jit); striping/load-balancing is a later optimization.
+
+All control flow is a ``lax.fori_loop`` with static shapes — XLA compiles
+one program per device, MXU-tiled einsums inside.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (we psum manually), papering
+    over the check_rep→check_vma rename across jax versions."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: False})
+
+
+def _block_attn(q, k, v, m, l, acc, mask, scale):
+    """One online-softmax accumulation step against a single k/v block.
+
+    q: [B,H,Sq,D]; k,v: [B,H,Sk,D]; m,l: [B,H,Sq]; acc: [B,H,Sq,D];
+    mask: [Sq,Sk] bool (True = attend).  All math in fp32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    neg = jnp.finfo(jnp.float32).min
+    s = jnp.where(mask, s, neg)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # Rows that have seen nothing yet (m_new == neg) must not produce
+    # exp(neg - neg) == 1; keep them at zero weight.
+    safe_m = jnp.where(m_new == neg, 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    corr = jnp.where(m == neg, 0.0, jnp.exp(m - safe_m))
+    acc = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v, preferred_element_type=jnp.float32)
+    l = l * corr + jnp.sum(p, axis=-1)
+    return m_new, l, acc
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
+    """Ring self-attention for sequence-sharded q/k/v.
+
+    Call inside ``shard_map`` (or ``shard_map``-decorated code) with the
+    sequence axis sharded over ``axis_name``.  Shapes per device:
+    ``q, k, v: [B, H, S_local, D]``; returns ``[B, H, S_local, D]`` in
+    q.dtype.
+
+    Ring step t: every device attends its q block against the k/v block
+    originating on device ``(idx - t) mod n``, then ppermutes k/v one hop
+    forward.  Causality is enforced block-wise (future source blocks fully
+    masked, the diagonal block intra-masked).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = D ** -0.5
+    qf = q.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+
+    rows = jnp.arange(S)[:, None]
+    cols = jnp.arange(S)[None, :]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_mask(src):
+        if not causal:
+            return jnp.ones((S, S), bool)
+        # block-level relation of source block to my block
+        intra = rows >= cols                      # diagonal block
+        full = jnp.ones((S, S), bool)             # past block
+        none = jnp.zeros((S, S), bool)            # future block
+        return jnp.where(src == idx, intra,
+                         jnp.where(src < idx, full, none))
+
+    def step(t, carry):
+        k_blk, v_blk, m, l, acc = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        m, l, acc = _block_attn(qf, k_blk.astype(jnp.float32),
+                                v_blk.astype(jnp.float32),
+                                m, l, acc, block_mask((idx - t) % n), scale)
+        return k_blk, v_blk, m, l, acc
+
+    # t = 0 (the local block, diagonal included) runs before the loop; the
+    # remaining n-1 steps permute first then accumulate, so exactly n-1 ring
+    # hops are issued per call — no discarded final shift.
+    m0 = jnp.full((B, H, S), neg, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m, l, acc = _block_attn(qf, k.astype(jnp.float32),
+                            v.astype(jnp.float32),
+                            m0, l0, acc0, block_mask(idx), scale)
+    _, _, _, l, acc = jax.lax.fori_loop(
+        1, n, step, (k, v, m, l, acc))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
+                        causal: bool = True):
+    """shard_map-wrapped ring attention for ``[B, H, S, D]`` arrays whose S
+    axis is sharded over ``axis_name`` (batch over "dp" when present)."""
+    batch = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch, None, axis_name, None)
+    fn = shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn
+
+
+# --- sequence-parallel train step --------------------------------------------
+
+
+def _sp_forward(cfg, params, tokens, sp_index, axis_name):
+    """Forward pass on a sequence shard: [B, S/n] tokens → local logits.
+
+    Mirrors train.forward but attention runs over the ring; position
+    embeddings are sliced by global offset.
+    """
+    from tpu_dra.workloads.train import _rmsnorm
+
+    B, S = tokens.shape
+    x = params["embed"].astype(jnp.bfloat16)[tokens]
+    pos = jax.lax.dynamic_slice_in_dim(
+        params["pos"].astype(jnp.bfloat16), sp_index * S, S, axis=0)
+    x = x + pos
+
+    def block(carry, layer):
+        h = _rmsnorm(carry, layer["ln1"])
+        qkv = h @ layer["wqkv"].astype(carry.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(
+                0, 2, 1, 3)
+
+        out = ring_attention(heads(q), heads(k), heads(v),
+                             axis_name=axis_name, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
+        x2 = carry + out @ layer["wo"].astype(carry.dtype)
+        h2 = _rmsnorm(x2, layer["ln2"])
+        h2 = jax.nn.gelu(h2 @ layer["w1"].astype(carry.dtype))
+        return x2 + h2 @ layer["w2"].astype(carry.dtype), None
+
+    x, _ = jax.lax.scan(jax.checkpoint(block), x, params["blocks"])
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
+                         axis_name: str = "sp"):
+    """Full DP×SP train step under ``shard_map``: tokens/targets sharded
+    ``[("dp"), (sp)]``, params replicated, grads psum-averaged over the whole
+    mesh.  Returns ``(step, token_sharding)``; ``step(params, tokens,
+    targets) -> (params, loss)``.
+
+    The caller supplies ``targets`` (tokens shifted by one *globally*) so
+    the next-token boundary between sequence shards stays correct — shifting
+    inside a shard would drop one target per boundary.
+    """
+    batch = "dp" if "dp" in mesh.axis_names else None
+    tok_spec = P(batch, axis_name)
+    rep = P()
+
+    axes = tuple(a for a in (batch, axis_name) if a)
+
+    def local_loss(params, tokens, targets):
+        sp_index = jax.lax.axis_index(axis_name)
+        logits = _sp_forward(cfg, params, tokens, sp_index, axis_name)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.sum(nll), nll.size
+
+    def sharded_step(params, tokens, targets):
+        def total_loss(p):
+            s, cnt = local_loss(p, tokens, targets)
+            return (jax.lax.psum(s, axes) /
+                    jax.lax.psum(jnp.asarray(cnt, jnp.float32), axes))
+
+        loss, grads = jax.value_and_grad(total_loss)(params)
+        # psum transposes to identity: each device's grad holds only its
+        # local data's contribution — sum them to the true (replicated) grad.
+        grads = jax.lax.psum(grads, axes)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    step = shard_map(sharded_step, mesh=mesh,
+                     in_specs=(rep, tok_spec, tok_spec),
+                     out_specs=(rep, rep))
+    tok_sharding = NamedSharding(mesh, tok_spec)
+    return jax.jit(step), tok_sharding
