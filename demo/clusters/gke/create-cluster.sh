@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Create a GKE cluster with a TPU v5e node pool for the tpu-dra-driver —
+# analog of reference demo/clusters/gke/create-cluster.sh (network + DRA-beta
+# cluster + GPU node pool), re-targeted at the BASELINE.md north star:
+# a v5e-16 pool (4 nodes x 4 chips, 4x4 ICI topology) with the DRA feature
+# gates enabled.
+#
+# Requires: gcloud with a project set, TPU quota in $LOCATION.
+
+set -euo pipefail
+
+: "${PROJECT_NAME:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [[ -z ${PROJECT_NAME} ]]; then
+    echo "Project name could not be determined; run 'gcloud config set project'" >&2
+    exit 1
+fi
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+NETWORK_NAME="${NETWORK_NAME:-${CLUSTER_NAME}-net}"
+LOCATION="${LOCATION:-us-central2-b}"          # v5e availability zone
+CLUSTER_VERSION="${CLUSTER_VERSION:-1.32}"     # DRA beta needs >= 1.32
+# v5e-16: ct5lp-hightpu-4t machines, 4 hosts, 4x4 topology
+TPU_MACHINE_TYPE="${TPU_MACHINE_TYPE:-ct5lp-hightpu-4t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-4x4}"
+TPU_NUM_NODES="${TPU_NUM_NODES:-4}"
+
+gcloud compute networks create "${NETWORK_NAME}" \
+    --quiet --project="${PROJECT_NAME}" \
+    --subnet-mode=auto --mtu=8896 --bgp-routing-mode=regional
+
+# DRA is beta-gated: enable the resource.k8s.io APIs + feature gates.
+gcloud container clusters create "${CLUSTER_NAME}" \
+    --quiet --project="${PROJECT_NAME}" \
+    --location="${LOCATION}" \
+    --cluster-version="${CLUSTER_VERSION}" \
+    --network="${NETWORK_NAME}" \
+    --num-nodes=1 \
+    --enable-kubernetes-unstable-apis=resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices \
+    --no-enable-autorepair --no-enable-autoupgrade
+
+# TPU node pool: one ICI-connected v5e slice spread over TPU_NUM_NODES hosts.
+# Pods reach chips through the DRA driver (this repo), not the legacy
+# google.com/tpu device plugin, so the pool is created without it.
+gcloud container node-pools create tpu-pool \
+    --quiet --project="${PROJECT_NAME}" \
+    --location="${LOCATION}" \
+    --cluster="${CLUSTER_NAME}" \
+    --machine-type="${TPU_MACHINE_TYPE}" \
+    --tpu-topology="${TPU_TOPOLOGY}" \
+    --num-nodes="${TPU_NUM_NODES}" \
+    --node-labels=tpu.google.com/dra-managed=true
+
+gcloud container clusters get-credentials "${CLUSTER_NAME}" \
+    --project="${PROJECT_NAME}" --location="${LOCATION}"
+
+echo "Cluster ${CLUSTER_NAME} ready. Next: ./install-dra-driver.sh"

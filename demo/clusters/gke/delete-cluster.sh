@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Tear down the GKE demo cluster + network created by create-cluster.sh.
+
+set -euo pipefail
+
+: "${PROJECT_NAME:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+NETWORK_NAME="${NETWORK_NAME:-${CLUSTER_NAME}-net}"
+LOCATION="${LOCATION:-us-central2-b}"
+
+gcloud container clusters delete "${CLUSTER_NAME}" \
+    --quiet --project="${PROJECT_NAME}" --location="${LOCATION}"
+gcloud compute networks delete "${NETWORK_NAME}" \
+    --quiet --project="${PROJECT_NAME}"

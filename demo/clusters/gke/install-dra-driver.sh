@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Install the tpu-dra-driver helm chart into the current kube context —
+# analog of reference demo/clusters/gke/install-dra-driver-gpu.sh.
+
+set -euo pipefail
+
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+CHART="${SCRIPT_DIR}/../../../deployments/helm/tpu-dra-driver"
+NAMESPACE="${NAMESPACE:-tpu-dra-driver}"
+IMAGE="${IMAGE:-tpu-dra-driver}"
+TAG="${TAG:-latest}"
+
+helm upgrade --install tpu-dra-driver "${CHART}" \
+    --namespace "${NAMESPACE}" --create-namespace \
+    --set image.repository="${IMAGE}" \
+    --set image.tag="${TAG}" \
+    "$@"
+
+kubectl -n "${NAMESPACE}" rollout status ds/tpu-dra-driver-kubelet-plugin \
+    --timeout=300s
+echo "Driver installed. Try: kubectl apply -f ../../specs/quickstart/tpu-test1.yaml"

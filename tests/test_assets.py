@@ -190,3 +190,30 @@ def test_kubeletplugin_daemonset_shape():
                       if m["mountPath"] == "/var/lib/kubelet/plugins"]
     assert all(m["mountPropagation"] == "Bidirectional"
                for m in plugins_mounts)
+
+
+# --- opaque configs in demo specs must strict-decode ------------------------
+
+def _iter_opaque_params(obj):
+    """Yield every opaque.parameters dict found anywhere in a manifest."""
+    if isinstance(obj, dict):
+        opaque = obj.get("opaque")
+        if isinstance(opaque, dict) and "parameters" in opaque:
+            yield opaque["parameters"]
+        for v in obj.values():
+            yield from _iter_opaque_params(v)
+    elif isinstance(obj, list):
+        for v in obj:
+            yield from _iter_opaque_params(v)
+
+
+@pytest.mark.parametrize("path", [
+    *iter_files(os.path.join(REPO, "demo/specs")),
+], ids=os.path.basename)
+def test_demo_opaque_configs_decode_and_validate(path):
+    from tpu_dra.api.decoder import decode
+
+    for doc in load_all(path):
+        for params in _iter_opaque_params(doc):
+            cfg = decode(params)
+            cfg.normalize().validate()

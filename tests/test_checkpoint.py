@@ -76,3 +76,51 @@ def test_legacy_version_migrates(tmp_path):
     }
     assert ckpt.load()
     assert ckpt.get("u1") is not None
+
+
+def test_versionless_go_style_checkpoint_migrates(tmp_path):
+    """A pre-versioning checkpoint (Go-style field names, the default
+    registered v0 migration — checkpoint_legacy.py) loads, converts, and is
+    immediately re-persisted in the current format."""
+    path = tmp_path / "checkpoint.json"
+    legacy_payload = json.dumps({
+        "PreparedClaims": {"uid-old": {
+            "ClaimUID": "uid-old", "Namespace": "ns", "Name": "claim-a",
+            "PreparedDevices": [{
+                "Type": "tpu", "UUID": "tpu-uuid-3",
+                "DeviceName": "tpu-3", "Requests": ["req0"],
+                "CDIDeviceIDs": ["google.com/tpu=tpu-3"],
+            }],
+        }},
+    }, sort_keys=True)
+    path.write_text(json.dumps(
+        {"checksum": native.crc32c(legacy_payload.encode()),
+         "data": legacy_payload}))
+
+    ckpt = Checkpoint(str(path))
+    assert ckpt.load()
+    claim = ckpt.get("uid-old")
+    assert claim.namespace == "ns" and claim.name == "claim-a"
+    dev = claim.devices[0]
+    assert dev.uuid == "tpu-uuid-3"
+    assert dev.canonical_name == "tpu-3"
+    assert dev.request_names == ["req0"]
+    assert dev.cdi_device_ids == ["google.com/tpu=tpu-3"]
+
+    # migration re-persists in the current format: a fresh load needs no
+    # migration hook and the version field is now present
+    on_disk = json.loads(json.loads(path.read_text())["data"])
+    assert on_disk["version"] == "v1"
+    ckpt2 = Checkpoint(str(path))
+    ckpt2.migrations.clear()
+    assert ckpt2.load()
+    assert ckpt2.get("uid-old").uuids() == ["tpu-uuid-3"]
+
+
+def test_versionless_garbage_reports_corrupt(tmp_path):
+    path = tmp_path / "checkpoint.json"
+    payload = json.dumps({"something": "else"}, sort_keys=True)
+    path.write_text(json.dumps(
+        {"checksum": native.crc32c(payload.encode()), "data": payload}))
+    with pytest.raises(CorruptCheckpoint, match="migration failed"):
+        Checkpoint(str(path)).load()

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 from typing import Callable, Optional
 
+from tpu_dra.plugins.tpu import checkpoint_legacy
 from tpu_dra.plugins.tpu.allocatable import PreparedClaim
 from tpu_dra.tpulib import native
 from tpu_dra.util.fsutil import atomic_write
@@ -31,8 +32,12 @@ class Checkpoint:
     def __init__(self, path: str) -> None:
         self.path = path
         self.prepared: dict[str, PreparedClaim] = {}
-        # version -> converter(old_payload) -> v1 payload
-        self.migrations: dict[str, Callable[[dict], dict]] = {}
+        # version -> converter(old_payload) -> v1 payload; version-less
+        # payloads are the pre-versioning ("v0") format
+        # (checkpoint_legacy.go:36-143 fallback order)
+        self.migrations: dict[str, Callable[[dict], dict]] = {
+            checkpoint_legacy.LEGACY_VERSION: checkpoint_legacy.migrate_v0,
+        }
 
     # -- persistence -------------------------------------------------------
     def _payload(self) -> dict:
@@ -63,15 +68,26 @@ class Checkpoint:
             raise CorruptCheckpoint(f"{self.path}: checksum mismatch")
         payload = json.loads(data)
         version = payload.get("version", "")
+        migrated = False
         if version != self.VERSION:
             migrate = self.migrations.get(version)
             if migrate is None:
                 raise CorruptCheckpoint(
                     f"{self.path}: unknown checkpoint version {version!r}")
-            payload = migrate(payload)
+            try:
+                payload = migrate(payload)
+            except (KeyError, TypeError, AttributeError) as exc:
+                raise CorruptCheckpoint(
+                    f"{self.path}: legacy-format migration failed: "
+                    f"{exc!r}") from exc
+            migrated = True
         self.prepared = {
             uid: PreparedClaim.from_dict(c)
             for uid, c in payload.get("preparedClaims", {}).items()}
+        if migrated:
+            # persist in the current format immediately so the legacy path
+            # runs at most once per upgrade
+            self.save()
         return True
 
     # -- claim ops (each saves immediately: crash-consistency point) -------

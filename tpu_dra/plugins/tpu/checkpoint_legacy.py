@@ -1,0 +1,60 @@
+"""Legacy checkpoint-format migration.
+
+Analog of reference ``cmd/compute-domain-kubelet-plugin/checkpoint_legacy.go:
+12-143`` + the fallback unmarshal path (checkpoint.go:48-74): when a
+checkpoint written by a pre-versioning driver build is found on disk, it is
+converted in place of failing, so in-flight claims survive a driver upgrade.
+
+The legacy ("v0") layout pre-dates the ``version`` field and used Go-style
+field names with the flat device list of the early prototype::
+
+    {"PreparedClaims": {"<uid>": {
+        "Namespace": ..., "Name": ...,
+        "PreparedDevices": [{"Type": ..., "UUID": ...,
+                             "DeviceName": ..., "Requests": [...],
+                             "CDIDeviceIDs": [...], "ParentUUID": ...}]}}}
+
+``migrate_v0`` maps it onto the current v1 payload
+(tpu_dra/plugins/tpu/checkpoint.py Checkpoint._payload); Checkpoint installs
+it by default for payloads with no ``version`` key, mirroring the
+reference's try-current-then-legacy order.
+"""
+
+from __future__ import annotations
+
+LEGACY_VERSION = ""   # v0 predates the version field entirely
+
+
+def _migrate_device(dev: dict) -> dict:
+    return {
+        "type": dev.get("Type", dev.get("type", "tpu")),
+        "uuid": dev.get("UUID", dev.get("uuid", "")),
+        "canonicalName": dev.get("DeviceName", dev.get("canonicalName", "")),
+        "requestNames": list(dev.get("Requests",
+                                     dev.get("requestNames", []))),
+        "cdiDeviceIDs": list(dev.get("CDIDeviceIDs",
+                                     dev.get("cdiDeviceIDs", []))),
+        "parentUUID": dev.get("ParentUUID", dev.get("parentUUID", "")),
+    }
+
+
+def migrate_v0(payload: dict) -> dict:
+    """Convert a version-less legacy payload to the current v1 payload.
+
+    Tolerates both Go-style (``PreparedClaims``) and early snake/camel
+    variants; raises KeyError only if the payload has neither claim map,
+    which the caller reports as corruption.
+    """
+    claims = payload.get("PreparedClaims")
+    if claims is None:
+        claims = payload["preparedClaims"]   # may raise KeyError: corrupt
+    out = {}
+    for uid, claim in claims.items():
+        devices = claim.get("PreparedDevices", claim.get("devices", []))
+        out[uid] = {
+            "claimUID": claim.get("ClaimUID", claim.get("claimUID", uid)),
+            "namespace": claim.get("Namespace", claim.get("namespace", "")),
+            "name": claim.get("Name", claim.get("name", "")),
+            "devices": [_migrate_device(d) for d in devices],
+        }
+    return {"version": "v1", "preparedClaims": out}

@@ -18,16 +18,44 @@ _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
 
 
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _try_build() -> None:
+    """Best-effort one-shot build of libtpudra.so (the repo ships source;
+    g++ is part of the supported toolchain).  Failures are silent — every
+    entry point has a Python fallback."""
+    if os.environ.get("TPUDRA_NO_BUILD"):
+        return
+    src = os.path.join(_native_dir(), "tpudra.cpp")
+    out = os.path.join(_native_dir(), "libtpudra.so")
+    if not os.path.exists(src) or os.path.exists(out):
+        return
+    import subprocess
+    tmp = f"{out}.tmp.{os.getpid()}"   # per-process: concurrent builds race
+    try:                               # on os.replace, both fully written
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_TRIED
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
+    _try_build()
     candidates = [
         os.environ.get("TPUDRA_NATIVE_LIB", ""),
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))),
-            "native", "libtpudra.so"),
+        os.path.join(_native_dir(), "libtpudra.so"),
         "libtpudra.so",
     ]
     for cand in candidates:
@@ -128,17 +156,32 @@ def unmount_recursive(path: str) -> None:
         libc.umount2(m.encode(), 0)
 
 
+_CRC32C_TABLE: Optional[list] = None
+
+
+def _crc32c_table() -> list:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC32C_TABLE = table
+    return _CRC32C_TABLE
+
+
 def crc32c(data: bytes) -> int:
     """CRC32-C (Castagnoli) — the checkpoint checksum (the reference uses
     kubelet's checkpointmanager checksum, gpu checkpoint.go:39-47)."""
     lib = _load()
     if lib is not None:
         return lib.tpudra_crc32c(data, len(data))
-    # Python fallback (bitwise, slow but only used without the native lib)
-    poly = 0x82F63B78
+    # table-driven Python fallback, only used without the native lib
+    table = _crc32c_table()
     crc = 0xFFFFFFFF
     for b in data:
-        crc ^= b
-        for _ in range(8):
-            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        crc = (crc >> 8) ^ table[(crc ^ b) & 0xFF]
     return crc ^ 0xFFFFFFFF

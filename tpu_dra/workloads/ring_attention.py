@@ -145,33 +145,21 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
 def _sp_forward(cfg, params, tokens, sp_index, axis_name):
     """Forward pass on a sequence shard: [B, S/n] tokens → local logits.
 
-    Mirrors train.forward but attention runs over the ring; position
-    embeddings are sliced by global offset.
+    Same decoder block as train.forward (train._block) with ring attention
+    swapped in; position embeddings are sliced by global offset.
     """
-    from tpu_dra.workloads.train import _rmsnorm
+    from tpu_dra.workloads.train import _block, _rmsnorm
 
-    B, S = tokens.shape
+    S = tokens.shape[1]
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     pos = jax.lax.dynamic_slice_in_dim(
         params["pos"].astype(jnp.bfloat16), sp_index * S, S, axis=0)
     x = x + pos
 
+    attn = partial(ring_attention, axis_name=axis_name, causal=True)
+
     def block(carry, layer):
-        h = _rmsnorm(carry, layer["ln1"])
-        qkv = h @ layer["wqkv"].astype(carry.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(
-                0, 2, 1, 3)
-
-        out = ring_attention(heads(q), heads(k), heads(v),
-                             axis_name=axis_name, causal=True)
-        out = out.transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
-        x2 = carry + out @ layer["wo"].astype(carry.dtype)
-        h2 = _rmsnorm(x2, layer["ln2"])
-        h2 = jax.nn.gelu(h2 @ layer["w1"].astype(carry.dtype))
-        return x2 + h2 @ layer["w2"].astype(carry.dtype), None
+        return _block(cfg, carry, layer, attn_fn=attn), None
 
     x, _ = jax.lax.scan(jax.checkpoint(block), x, params["blocks"])
     x = _rmsnorm(x, params["ln_f"])

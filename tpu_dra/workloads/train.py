@@ -75,7 +75,18 @@ def _rmsnorm(x, g):
     return (x * jax.lax.rsqrt(var + 1e-6) * g).astype(x.dtype)
 
 
-def _block(cfg: ModelConfig, x, layer):
+def _causal_dense_attention(q, k, v):
+    """Default attention: dense causal softmax over ``[B, H, S, D]`` heads.
+    Sequence-parallel runs swap in ring_attention here."""
+    S = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+
+
+def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
     """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
     B, S, D = x.shape
     h = _rmsnorm(x, layer["ln1"])
@@ -85,12 +96,7 @@ def _block(cfg: ModelConfig, x, layer):
     def heads(t):
         return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (cfg.d_head ** -0.5)
-    mask = jnp.tril(jnp.ones((S, S), bool))
-    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    out = attn_fn(heads(q), heads(k), heads(v))
     out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + out @ layer["wo"].astype(x.dtype)
 
