@@ -1,0 +1,191 @@
+"""Mixture-of-Experts FFN with expert parallelism over an "ep" mesh axis.
+
+Completes the parallelism portfolio (dp/tp in ``train.py``, sp in
+``ring_attention.py``, pp in ``pipeline.py``). The reference driver has no
+model code (SURVEY.md §5) — this is the workload a claimed multi-chip slice
+runs; expert parallelism is the EP in the driver's multi-chip dry run.
+
+TPU-first design (Switch-Transformer-style dense dispatch):
+- top-1 routing with a fixed per-expert **capacity** keeps every shape
+  static — the dispatch/combine tensors are dense one-hots and the whole
+  layer is three einsums, all of which XLA tiles onto the MXU;
+- expert weights ``[E, d, f]`` are sharded over "ep" via ``NamedSharding``;
+  the dispatch einsum's contraction forces XLA to insert the token
+  all-to-all/all-gather over ICI — no hand-written collective;
+- the router runs in fp32 (softmax stability), expert matmuls in bf16;
+- the standard switch load-balance auxiliary loss keeps routing trainable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .train import ModelConfig, _attn_sublayer, _rmsnorm
+
+
+@dataclass(frozen=True)
+class MoEConfig(ModelConfig):
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(self.capacity_factor * n_tokens / self.n_experts))
+
+
+def init_moe_params(cfg: MoEConfig, key) -> dict[str, Any]:
+    """Like ``train.init_params`` but every block's FFN is an expert bank."""
+    keys = jax.random.split(key, 9)
+    scale = cfg.d_model ** -0.5
+    L, E = cfg.n_layers, cfg.n_experts
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape) * scale).astype(jnp.float32)
+
+    return {
+        "embed": norm(keys[0], (cfg.vocab, cfg.d_model)),
+        "pos": norm(keys[1], (cfg.max_seq, cfg.d_model)),
+        "blocks": {
+            "wqkv": norm(keys[2], (L, cfg.d_model, 3 * cfg.d_model)),
+            "wo": norm(keys[3], (L, cfg.d_model, cfg.d_model)),
+            "wg": norm(keys[4], (L, cfg.d_model, E)),
+            "w1": norm(keys[5], (L, E, cfg.d_model, cfg.d_ff)),
+            "w2": norm(keys[6], (L, E, cfg.d_ff, cfg.d_model)),
+            "ln1": jnp.ones((L, cfg.d_model), jnp.float32),
+            "ln2": jnp.ones((L, cfg.d_model), jnp.float32),
+        },
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "unembed": norm(keys[7], (cfg.d_model, cfg.vocab)),
+    }
+
+
+def moe_ffn(cfg: MoEConfig, x, wg, w1, w2, capacity: int | None = None,
+            mesh: Mesh | None = None):
+    """Top-1 switch FFN. ``x``: [B, S, D]; ``wg``: [D, E]; ``w1``: [E, D, F];
+    ``w2``: [E, F, D]. Returns ``(out [B,S,D], aux_loss scalar)``.
+
+    Tokens over their expert's capacity are dropped (residual passes them
+    through unchanged) — the standard static-shape TPU formulation. Pass
+    ``mesh`` (with an "ep" axis) to pin the expert tensors' leading axis.
+    """
+    B, S, D = x.shape
+    E = wg.shape[-1]
+    N = B * S
+    C = capacity if capacity is not None else cfg.capacity(N)
+
+    flat = x.reshape(N, D)
+    logits = (flat.astype(jnp.float32) @ wg.astype(jnp.float32))  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)                       # [N]
+    expert = jnp.argmax(probs, axis=-1)                  # [N]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)          # [N, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot              # [N, E]
+    keep = onehot * (pos < C)                                      # [N, E]
+    slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
+                          dtype=jnp.float32)                       # [N, C]
+    dispatch = keep[:, :, None] * slot[:, None, :]                 # [N, E, C]
+
+    # dispatch → expert banks (contraction over tokens: XLA's all-to-all
+    # point once w1/w2 are "ep"-sharded)
+    d16 = dispatch.astype(jnp.bfloat16)
+    expert_in = jnp.einsum("nec,nd->ecd", d16, flat.astype(jnp.bfloat16))
+    expert_in = _ep_constraint(expert_in, mesh)
+    h = jax.nn.gelu(jnp.einsum(
+        "ecd,edf->ecf", expert_in, w1.astype(jnp.bfloat16)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.bfloat16))
+    expert_out = _ep_constraint(expert_out, mesh)
+
+    combine = (dispatch * gate[:, None, None]).astype(jnp.bfloat16)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+
+    # switch aux loss: E * Σ_e (token fraction_e × mean router prob_e)
+    frac = keep.sum(0) / jnp.maximum(onehot.sum(), 1.0)            # [E]
+    aux = E * jnp.sum(frac * probs.mean(0))
+    return out.reshape(B, S, D).astype(x.dtype), aux
+
+
+def _ep_constraint(t, mesh: Mesh | None):
+    """Pin the leading expert axis to "ep" when a mesh with that axis is
+    given; no-op otherwise (e.g. unit tests on a meshless jit)."""
+    if mesh is None:
+        return t
+    return jax.lax.with_sharding_constraint(
+        t, NamedSharding(mesh, P("ep", *([None] * (t.ndim - 1)))))
+
+
+def _moe_block(cfg: MoEConfig, x, layer, capacity: int | None,
+               mesh: Mesh | None):
+    x = _attn_sublayer(cfg, x, layer)
+    h = _rmsnorm(x, layer["ln2"])
+    ff, aux = moe_ffn(cfg, h, layer["wg"], layer["w1"], layer["w2"],
+                      capacity, mesh)
+    return x + ff, aux
+
+
+def moe_forward(cfg: MoEConfig, params, tokens, capacity: int | None = None,
+                mesh: Mesh | None = None):
+    """Logits + summed aux loss for a [B, S] int32 batch."""
+    x = params["embed"].astype(jnp.bfloat16)[tokens]
+    x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
+
+    block = jax.checkpoint(
+        lambda carry, layer: _moe_block(cfg, carry, layer, capacity, mesh))
+    x, aux = jax.lax.scan(block, x, params["blocks"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
+    return logits, jnp.sum(aux)
+
+
+def moe_loss_fn(cfg: MoEConfig, params, tokens, mesh: Mesh | None = None):
+    logits, aux = moe_forward(cfg, params, tokens[:, :-1], mesh=mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    return nll + cfg.aux_loss_weight * aux
+
+
+def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
+    """Expert banks over "ep"; everything else replicated (attention could
+    additionally be tp-sharded — kept orthogonal here)."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": s(), "pos": s(),
+        "blocks": {
+            "wqkv": s(), "wo": s(), "wg": s(),
+            "w1": s(None, "ep", None, None),
+            "w2": s(None, "ep", None, None),
+            "ln1": s(), "ln2": s(),
+        },
+        "ln_f": s(),
+        "unembed": s(),
+    }
+
+
+def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):
+    """jit the MoE SGD step over ``mesh`` (axes "dp","ep"). Requires
+    ``cfg.n_experts % ep == 0``."""
+    ep = mesh.shape["ep"]
+    if cfg.n_experts % ep:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={ep}")
+
+    p_shard = moe_param_shardings(cfg, mesh)
+    t_shard = NamedSharding(mesh, P("dp", None))
+
+    def sgd(params, tokens):
+        loss, grads = jax.value_and_grad(
+            partial(moe_loss_fn, cfg, mesh=mesh))(params, tokens)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    step = jax.jit(sgd, in_shardings=(p_shard, t_shard),
+                   out_shardings=(p_shard, NamedSharding(mesh, P())))
+    return step, p_shard, t_shard

@@ -1,0 +1,152 @@
+"""Pipeline-parallel train step (GPipe-style microbatching over a "pp" axis).
+
+Reference context: the reference driver contains no model code (SURVEY.md §5
+"long-context / sequence parallelism: absent") — this module is part of the
+workload layer that a claimed slice runs, completing the dp/tp/sp/pp/ep
+parallelism portfolio alongside ``train.py`` (DP×TP), ``ring_attention.py``
+(DP×SP) and ``moe.py`` (DP×EP).
+
+TPU-first design:
+- the transformer blocks are stacked ``[L, ...]`` and sharded over the "pp"
+  mesh axis, so each stage holds ``L / pp`` layers and scans them locally
+  (one XLA while-loop per stage);
+- activations move stage→stage with ``jax.lax.ppermute`` — a neighbour
+  ICI hop, never a global collective;
+- the schedule is a single ``lax.scan`` over ``n_micro + pp - 1`` ticks
+  (static trip count; the pipeline bubble is the usual GPipe
+  ``(pp-1)/(n_micro+pp-1)`` fraction);
+- backward is obtained by differentiating through the ``shard_map``:
+  ``ppermute``'s transpose is the reverse-direction ``ppermute``, so the
+  cotangents flow last-stage→first-stage in the mirrored schedule without
+  any hand-written backward pass;
+- loss is computed on the final stage only and ``psum``-broadcast, so every
+  stage returns the same replicated scalar.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring_attention import shard_map  # version-compatible wrapper
+from .train import ModelConfig, _block, _rmsnorm, init_params  # noqa: F401
+
+
+def _local_stack(cfg: ModelConfig, blocks, x):
+    """Run this stage's resident layers (a leading-axis slice of the stacked
+    block params) over ``x`` with rematerialisation."""
+    f = jax.checkpoint(lambda c, layer: (_block(cfg, c, layer), None))
+    y, _ = jax.lax.scan(f, x, blocks)
+    return y
+
+
+def _pipeline_blocks(cfg: ModelConfig, n_stages: int, blocks, x_micro):
+    """Circulate microbatches through the stage ring.
+
+    ``x_micro``: ``[n_micro, mB, S, D]`` — the full microbatch stack (every
+    stage holds a copy; only stage 0 reads it). Returns ``[n_micro, mB, S,
+    D]`` — valid on the final stage, garbage elsewhere (masked by caller).
+    """
+    stage = jax.lax.axis_index("pp")
+    n_micro = x_micro.shape[0]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        feed = jax.lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        inp = jnp.where(stage == 0, feed, recv)
+        out = _local_stack(cfg, blocks, inp)
+        # the final stage finishes microbatch (t - n_stages + 1) at tick t
+        slot = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        prev = jax.lax.dynamic_index_in_dim(out_buf, slot, 0, keepdims=False)
+        out_buf = jax.lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(t >= n_stages - 1, out, prev), slot, 0)
+        recv = jax.lax.ppermute(out, "pp", perm)
+        return (recv, out_buf), None
+
+    carry0 = (jnp.zeros_like(x_micro[0]), jnp.zeros_like(x_micro))
+    (_, out_buf), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(n_micro + n_stages - 1))
+    return out_buf
+
+
+def _pipeline_loss(cfg: ModelConfig, n_stages: int, n_micro: int,
+                   params, tokens):
+    """Per-shard loss body (runs inside shard_map over a ("dp","pp") mesh)."""
+    stage = jax.lax.axis_index("pp")
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+
+    x = params["embed"].astype(jnp.bfloat16)[inp]
+    x = x + params["pos"].astype(jnp.bfloat16)[: inp.shape[1]]
+    Bl, S, D = x.shape
+    x_micro = x.reshape(n_micro, Bl // n_micro, S, D)
+
+    out = _pipeline_blocks(cfg, n_stages, params["blocks"], x_micro)
+
+    x = out.reshape(Bl, S, D)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+
+    last = (stage == n_stages - 1).astype(jnp.float32)
+    # mean over dp shards of the final-stage loss, replicated everywhere
+    return (jax.lax.psum(nll * last, ("dp", "pp"))
+            / jax.lax.psum(last, ("dp", "pp")))
+
+
+def pipeline_param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    """PartitionSpecs: stacked blocks split over "pp" (layer axis), small
+    tensors replicated on every stage."""
+    return {
+        "embed": P(),
+        "pos": P(),
+        "blocks": {k: P("pp") for k in
+                   ("wqkv", "wo", "w1", "w2", "ln1", "ln2")},
+        "ln_f": P(),
+        "unembed": P(),
+    }
+
+
+def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh,
+                             n_micro: int = 4, lr: float = 1e-2):
+    """jit a full pipeline-parallel SGD step over ``mesh`` (axes "dp","pp").
+
+    Requires ``cfg.n_layers % pp == 0`` and a global batch divisible by
+    ``dp * n_micro``. Returns ``(step, param_shardings, token_sharding)``.
+    """
+    n_stages = mesh.shape["pp"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
+
+    p_specs = pipeline_param_specs(cfg)
+    loss_fn = shard_map(
+        partial(_pipeline_loss, cfg, n_stages, n_micro),
+        mesh=mesh,
+        in_specs=(p_specs, P("dp", None)),
+        out_specs=P(),
+    )
+
+    dp = mesh.shape["dp"]
+
+    def sgd(params, tokens):
+        if tokens.shape[0] % (dp * n_micro):
+            raise ValueError(
+                f"batch {tokens.shape[0]} not divisible by "
+                f"dp*n_micro={dp * n_micro}")
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    p_shard = jax.tree.map(lambda s: NamedSharding(mesh, s), p_specs,
+                           is_leaf=lambda x: isinstance(x, P))
+    t_shard = NamedSharding(mesh, P("dp", None))
+    step = jax.jit(sgd, in_shardings=(p_shard, t_shard),
+                   out_shardings=(p_shard, NamedSharding(mesh, P())))
+    return step, p_shard, t_shard

@@ -86,8 +86,9 @@ def _causal_dense_attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", attn, v)
 
 
-def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
-    """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
+def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
+    """Pre-norm attention residual sublayer, shared by the dense and MoE
+    blocks."""
     B, S, D = x.shape
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
@@ -98,8 +99,12 @@ def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
 
     out = attn_fn(heads(q), heads(k), heads(v))
     out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + out @ layer["wo"].astype(x.dtype)
+    return x + out @ layer["wo"].astype(x.dtype)
 
+
+def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
+    """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
+    x = _attn_sublayer(cfg, x, layer, attn_fn)
     h = _rmsnorm(x, layer["ln2"])
     h = jax.nn.gelu(h @ layer["w1"].astype(x.dtype))
     return x + h @ layer["w2"].astype(x.dtype)
