@@ -110,10 +110,24 @@ def bench_tpu() -> dict:
             b = jax.random.normal(jax.random.PRNGKey(1), (n, n),
                                   jnp.bfloat16)
             inv = jnp.bfloat16(1.0 / n)
-            secs = _time_op(lambda x: pl_matmul(x, b) * inv, a, iters=30)
+            secs = _time_op(lambda x: pl_matmul(x, b) * inv, a, iters=200)
             out["pallas_matmul_tflops"] = round(2 * n**3 / secs / 1e12, 2)
         except Exception as exc:  # noqa: BLE001 — pallas is an extra
             out["pallas_error"] = repr(exc)[:200]
+        try:
+            from tpu_dra.workloads.pallas_kernels import flash_attention
+            bh, s, d = 8, 4096, 128
+            ks = jax.random.split(jax.random.PRNGKey(2), 3)
+            q, k, v = (jax.random.normal(kk, (1, bh, s, d), jnp.bfloat16)
+                       for kk in ks)
+            secs = _time_op(
+                lambda x: flash_attention(x, k, v, causal=True), q,
+                iters=100)
+            # causal: ~half the 4·BH·S²·D matmul flops are masked away
+            flops = 2 * bh * s * s * d
+            out["pallas_flash_tflops"] = round(flops / secs / 1e12, 2)
+        except Exception as exc:  # noqa: BLE001
+            out["flash_error"] = repr(exc)[:200]
         if len(devices) > 1:
             res = psum_bandwidth(make_mesh())
             out["psum_gbps"] = round(res.algo_bytes_per_s / 1e9, 2)

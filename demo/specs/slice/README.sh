@@ -1,0 +1,94 @@
+# Narrated, runnable walkthrough of the slice-domain demos (analog of the
+# reference's demo/specs/imex/README.sh:1-140 — an executable script of
+# kubectl/helm commands you step through, not a document).  Run it line by
+# line, or `bash -x` the whole thing on a cluster with the DRA feature gates
+# and a TPU node pool (demo/clusters/gke/create-cluster.sh).
+
+###########################
+#### Setup and Overview ###
+###########################
+
+# Look at the set of nodes on the cluster
+kubectl get node
+
+# Look at all pods running on the cluster
+kubectl get pod -A
+
+# Look at each node's fabric identity — the slice/ICI topology the driver
+# discovered (the clusterUID.cliqueID analog is tpu.google.com/fabric-id)
+(echo -e "NODE\tACCELERATOR\tTOPOLOGY"; kubectl get nodes -o json | \
+	jq -r '.items[] | [.metadata.name,
+	       .metadata.labels["cloud.google.com/gke-tpu-accelerator"] // "-",
+	       .metadata.labels["cloud.google.com/gke-tpu-topology"] // "-"] | @tsv') | \
+	column -t
+
+# Install the DRA driver for slice domains
+helm upgrade -i \
+	--create-namespace \
+	--namespace tpu-dra-driver \
+	tpu-dra-driver \
+	../../../deployments/helm/tpu-dra-driver \
+	--set resources.tpus.enabled=false \
+	--wait
+
+# Show the DRA driver components running
+kubectl get pod -n tpu-dra-driver
+
+# Show the ResourceSlices each node published (daemon device + channel 0)
+kubectl get resourceslices
+
+# Show two collective jobs: one plain, one referencing a TpuSliceDomain
+vim -O psum-test-no-slice-domain-job.yaml psum-test-job.yaml
+
+# Show the diff between the two jobs — a domain adds only the CR + one
+# shared channel claim per worker
+diff -ruN psum-test-no-slice-domain-job.yaml psum-test-job.yaml
+
+
+#########################################################
+#### Prove channel injection with a 1-node domain     ###
+#########################################################
+
+# Create a single-node TpuSliceDomain and a pod holding its channel claim
+kubectl apply -f channel-injection.yaml
+
+# Watch the domain go Ready (the daemon pod publishes its membership into
+# status.nodes; NumberReady == numNodes flips status)
+kubectl get -o yaml tpuslicedomains.resource.tpu.google.com single-node-domain
+
+# The pod's log proves the injected contract: SLICE_* env vars plus the
+# /etc/tpu-slice settings mount rendered by the node plugin
+kubectl logs channel-injection-test
+
+# Clean up
+kubectl delete -f channel-injection.yaml
+
+
+#########################################################
+#### Run the psum job together *with* a slice domain  ###
+#########################################################
+
+# Create the TpuSliceDomain and run the 4-worker collective job
+kubectl apply -f psum-test-job.yaml
+
+# Look at the worker pods of the job *within* the slice domain
+kubectl get pods
+
+# Look at the slice daemons running on behalf of the job's domain
+kubectl get pods -n tpu-dra-driver
+
+# Look at the status of the newly created TpuSliceDomain — status.nodes is
+# the membership/rendezvous bus: each daemon writes {nodeName, podIP,
+# workerID, fabricID}; the full set makes the domain Ready
+kubectl get -o yaml tpuslicedomains.resource.tpu.google.com psum-domain
+
+# Look at the logs of the psum job: every worker reports the all-reduce
+# bandwidth it measured over ICI
+kubectl logs --tail=-1 -l job-name=psum-test
+
+# Delete the job and its slice domain
+kubectl delete -f psum-test-job.yaml
+
+# Verify workers and slice daemons are gone (finalizer-ordered teardown:
+# workload claim template, then daemonset, then node labels, then the CR)
+kubectl get pod -A

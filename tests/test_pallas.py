@@ -4,7 +4,12 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from tpu_dra.workloads.pallas_kernels import fused_rmsnorm_matmul, matmul
+from tpu_dra.workloads.pallas_kernels import (
+    _attn_reference,
+    flash_attention,
+    fused_rmsnorm_matmul,
+    matmul,
+)
 
 
 @pytest.mark.parametrize("m,k,n,bm,bn,bk", [
@@ -27,6 +32,45 @@ def test_matmul_rejects_untileable_shapes():
     y = jnp.zeros((128, 128), jnp.bfloat16)
     with pytest.raises(AssertionError, match="tile"):
         matmul(x, y, bm=64, bn=64, bk=64, interpret=True)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128), (128, 64)])
+def test_flash_attention_matches_reference(causal, bq, bk):
+    b, h, s, d = 2, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16) for kk in ks)
+    out = flash_attention(q, k, v, causal=causal, bq=bq, bk=bk,
+                          interpret=True)
+    fold = lambda x: x.reshape(b * h, s, d)
+    ref = _attn_reference(fold(q), fold(k), fold(v),
+                          causal=causal).reshape(b, h, s, d)
+    err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    assert float(err) < 2e-2
+
+
+def test_flash_attention_grads_flow():
+    b, h, s, d = 1, 2, 128, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16) for kk in ks)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, bq=64, bk=64, interpret=True).astype(jnp.float32))
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def ref_loss(q, k, v):
+        fold = lambda x: x.reshape(b * h, s, d)
+        return jnp.sum(_attn_reference(fold(q), fold(k), fold(v),
+                                       causal=True).astype(jnp.float32))
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in ((gq, rq.reshape(gq.shape)), (gk, rk.reshape(gk.shape)),
+                      (gv, rv.reshape(gv.shape))):
+        err = jnp.max(jnp.abs(got.astype(jnp.float32) -
+                              want.astype(jnp.float32)))
+        assert float(err) < 5e-2
 
 
 def test_fused_rmsnorm_matmul_matches_reference():

@@ -54,7 +54,7 @@ def _time_op(fn, x, iters: int = 10) -> float:
     float(run1(x))   # warm both compilations
     float(run2(x))
 
-    def best(run, repeats: int = 3) -> float:
+    def best(run, repeats: int = 5) -> float:
         times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
@@ -111,7 +111,7 @@ def ppermute_bandwidth(mesh: Mesh, mib_per_device: int = 64,
                             buffer_bytes / secs)
 
 
-def matmul_throughput(size: int = 4096, iters: int = 50) -> float:
+def matmul_throughput(size: int = 4096, iters: int = 200) -> float:
     """Single-chip MXU sanity: bf16 matmul TFLOP/s (keeps the benchmark
     honest about the chip actually running)."""
     key = jax.random.PRNGKey(0)

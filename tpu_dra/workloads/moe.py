@@ -26,7 +26,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .train import ModelConfig, _attn_sublayer, _rmsnorm
+from .train import (
+    ModelConfig,
+    _attn_sublayer,
+    _rmsnorm,
+    head_logits,
+    head_nll,
+)
 
 
 @dataclass(frozen=True)
@@ -105,8 +111,12 @@ def moe_ffn(cfg: MoEConfig, x, wg, w1, w2, capacity: int | None = None,
     combine = (dispatch * gate[:, None, None]).astype(jnp.bfloat16)
     out = jnp.einsum("nec,ecd->nd", combine, expert_out)
 
-    # switch aux loss: E * Σ_e (token fraction_e × mean router prob_e)
-    frac = keep.sum(0) / jnp.maximum(onehot.sum(), 1.0)            # [E]
+    # switch aux loss: E * Σ_e (token fraction_e × mean router prob_e).
+    # Fraction counts the pre-capacity routing assignment (Switch
+    # Transformer eqs. 4–6): post-drop counts saturate at C/N exactly when
+    # an expert is overloaded, which would cap the penalty in the collapse
+    # regime the loss exists to prevent.
+    frac = onehot.sum(0) / jnp.maximum(onehot.sum(), 1.0)          # [E]
     aux = E * jnp.sum(frac * probs.mean(0))
     return out.reshape(B, S, D).astype(x.dtype), aux
 
@@ -129,25 +139,28 @@ def _moe_block(cfg: MoEConfig, x, layer, capacity: int | None,
     return x + ff, aux
 
 
-def moe_forward(cfg: MoEConfig, params, tokens, capacity: int | None = None,
-                mesh: Mesh | None = None):
-    """Logits + summed aux loss for a [B, S] int32 batch."""
+def _moe_trunk(cfg: MoEConfig, params, tokens, capacity: int | None,
+               mesh: Mesh | None):
+    """Embed + MoE decoder stack → (pre-final-norm activations, Σ aux)."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
     block = jax.checkpoint(
         lambda carry, layer: _moe_block(cfg, carry, layer, capacity, mesh))
     x, aux = jax.lax.scan(block, x, params["blocks"])
-    x = _rmsnorm(x, params["ln_f"])
-    logits = (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
-    return logits, jnp.sum(aux)
+    return x, jnp.sum(aux)
+
+
+def moe_forward(cfg: MoEConfig, params, tokens, capacity: int | None = None,
+                mesh: Mesh | None = None):
+    """Logits + summed aux loss for a [B, S] int32 batch."""
+    x, aux = _moe_trunk(cfg, params, tokens, capacity, mesh)
+    return head_logits(params, x), aux
 
 
 def moe_loss_fn(cfg: MoEConfig, params, tokens, mesh: Mesh | None = None):
-    logits, aux = moe_forward(cfg, params, tokens[:, :-1], mesh=mesh)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    x, aux = _moe_trunk(cfg, params, tokens[:, :-1], None, mesh)
+    nll = head_nll(params, x, tokens[:, 1:]).mean()
     return nll + cfg.aux_loss_weight * aux
 
 

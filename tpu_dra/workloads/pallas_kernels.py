@@ -70,6 +70,150 @@ def matmul(x, y, *, bm: int = 1024, bn: int = 1024, bk: int = 512,
     )(x, y)
 
 
+def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+                       *, k_steps: int, scale: float, causal: bool,
+                       bq: int, bk: int):
+    """Flash attention inner loop: one (batch·head, q-block) tile streamed
+    over k/v blocks with an online softmax (running max ``m``, denominator
+    ``l``, fp32 accumulator) living in VMEM scratch across the k grid axis.
+
+    ``m``/``l`` are stored lane-replicated ``(bq, 128)`` — TPU scratch wants
+    2D lane-tiled shapes; column 0 is the value.
+    """
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    neg = jnp.finfo(jnp.float32).min
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, neg)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip k blocks strictly past the last row of this q block.  The
+    # block-start bound (not j<=i) keeps every query row's diagonal inside an
+    # executed block for any bq/bk combination.
+    run = True if not causal else j * bk < (i + 1) * bq
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, neg)
+        m_prev = m_ref[:, :1]                       # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # Fully-masked-so-far rows: exp(neg - neg) == 1 would leak weight —
+        # recompute against 0 and zero the masked entries explicitly (same
+        # safety pattern as ring_attention._block_attn).
+        safe_m = jnp.where(m_new == neg, 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.where(m_prev == neg, 0.0, jnp.exp(m_prev - safe_m))
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == k_steps - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        out_ref[0] = (acc_ref[:] / l).astype(out_ref.dtype)
+
+
+def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
+                    interpret: bool):
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    bq, bk = min(bq, s), min(bk, sk)
+    assert s % bq == 0 and sk % bk == 0, \
+        f"seq lens {(s, sk)} must tile by {(bq, bk)}"
+    k_steps = sk // bk
+    grid = (bh, s // bq, k_steps)
+    return pl.pallas_call(
+        functools.partial(_flash_attn_kernel, k_steps=k_steps,
+                          scale=d ** -0.5, causal=causal, bq=bq, bk=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _attn_reference(q, k, v, *, causal: bool):
+    """Plain XLA attention in fp32 — the flash kernel's backward pass (and
+    its test oracle).  O(S²) memory, only ever materialized under grad."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (q.shape[-1] ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attn(q, k, v, causal, bq, bk, interpret):
+    return _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
+                           interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, bq, bk, interpret):
+    out = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
+                          interpret=interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, bq, bk, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(functools.partial(_attn_reference, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attn.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "bq", "bk", "interpret"))
+def flash_attention(q, k, v, *, causal: bool = True, bq: int = 512,
+                    bk: int = 512, interpret: bool = False):
+    """Memory-efficient attention for ``[B, H, S, D]`` q/k/v.
+
+    Forward is the Pallas online-softmax kernel (HBM stays O(S·D); the
+    ``[S, S]`` score matrix never leaves VMEM).  Backward is a ``custom_vjp``
+    that rematerializes through the plain XLA attention — correct gradients
+    with zero extra forward residuals, trading backward FLOPs for memory
+    (the ``jax.checkpoint`` idiom).  Complements ``ring_attention``: this is
+    the per-device kernel; the ring handles the sequence-sharded case.
+    """
+    b, h, s, d = q.shape
+    fold = lambda x: x.reshape(b * h, x.shape[2], d)
+    out = _flash_attn(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    return out.reshape(b, h, s, d)
+
+
 def _fused_rmsnorm_matmul_kernel(x_ref, g_ref, w_ref, out_ref, acc_ref, *,
                                  k_steps: int, eps: float):
     """Fused RMSNorm(x)·W — the normalization rides along in VMEM so the

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .ring_attention import shard_map  # version-compatible wrapper
-from .train import ModelConfig, _block, _rmsnorm, init_params  # noqa: F401
+from .train import ModelConfig, _block, head_nll
 
 
 def _local_stack(cfg: ModelConfig, blocks, x):
@@ -89,10 +89,7 @@ def _pipeline_loss(cfg: ModelConfig, n_stages: int, n_micro: int,
     out = _pipeline_blocks(cfg, n_stages, params["blocks"], x_micro)
 
     x = out.reshape(Bl, S, D)
-    x = _rmsnorm(x, params["ln_f"])
-    logits = (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1).mean()
+    nll = head_nll(params, x, tgt).mean()
 
     last = (stage == n_stages - 1).astype(jnp.float32)
     # mean over dp shards of the final-stage loss, replicated everywhere

@@ -142,13 +142,14 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
 # --- sequence-parallel train step --------------------------------------------
 
 
-def _sp_forward(cfg, params, tokens, sp_index, axis_name):
-    """Forward pass on a sequence shard: [B, S/n] tokens → local logits.
+def _sp_trunk(cfg, params, tokens, sp_index, axis_name):
+    """Embed + decoder stack on a sequence shard: [B, S/n] tokens →
+    pre-final-norm activations.
 
     Same decoder block as train.forward (train._block) with ring attention
     swapped in; position embeddings are sliced by global offset.
     """
-    from tpu_dra.workloads.train import _block, _rmsnorm
+    from tpu_dra.workloads.train import _block
 
     S = tokens.shape[1]
     x = params["embed"].astype(jnp.bfloat16)[tokens]
@@ -162,8 +163,7 @@ def _sp_forward(cfg, params, tokens, sp_index, axis_name):
         return _block(cfg, carry, layer, attn_fn=attn), None
 
     x, _ = jax.lax.scan(jax.checkpoint(block), x, params["blocks"])
-    x = _rmsnorm(x, params["ln_f"])
-    return (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
+    return x
 
 
 def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
@@ -184,10 +184,11 @@ def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
     axes = tuple(a for a in (batch, axis_name) if a)
 
     def local_loss(params, tokens, targets):
+        from tpu_dra.workloads.train import head_nll
+
         sp_index = jax.lax.axis_index(axis_name)
-        logits = _sp_forward(cfg, params, tokens, sp_index, axis_name)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        x = _sp_trunk(cfg, params, tokens, sp_index, axis_name)
+        nll = head_nll(params, x, targets)
         return jnp.sum(nll), nll.size
 
     def sharded_step(params, tokens, targets):

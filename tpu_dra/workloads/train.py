@@ -110,24 +110,40 @@ def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
     return x + h @ layer["w2"].astype(x.dtype)
 
 
-def forward(cfg: ModelConfig, params, tokens):
-    """Logits for a [B, S] int32 token batch."""
+def _trunk(cfg: ModelConfig, params, tokens):
+    """Embed + decoder stack; returns pre-final-norm activations."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
     block = jax.checkpoint(
         lambda carry, layer: (_block(cfg, carry, layer), None))
     x, _ = jax.lax.scan(block, x, params["blocks"])
+    return x
+
+
+def head_logits(params, x):
+    """Final norm + unembed on trunk activations."""
     x = _rmsnorm(x, params["ln_f"])
     return (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
 
 
+def head_nll(params, x, targets):
+    """Per-token NLL through the final head (ln_f → unembed → log_softmax →
+    target gather).  The one shared head for the dense/sp/pp/ep losses, so a
+    head change (z-loss, label smoothing, softcap) lands in all of them at
+    once; callers reduce (mean / psum-of-sums) as their sharding requires."""
+    logp = jax.nn.log_softmax(head_logits(params, x), axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+
+
+def forward(cfg: ModelConfig, params, tokens):
+    """Logits for a [B, S] int32 token batch."""
+    return head_logits(params, _trunk(cfg, params, tokens))
+
+
 def loss_fn(cfg: ModelConfig, params, tokens):
-    logits = forward(cfg, params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(head_nll(params, _trunk(cfg, params, tokens[:, :-1]),
+                             tokens[:, 1:]))
 
 
 def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens):
