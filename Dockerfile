@@ -16,6 +16,8 @@ COPY tpu_dra/ tpu_dra/
 COPY templates/ templates/
 COPY hack/ hack/
 COPY --from=build /src/native/libtpudra.so native/libtpudra.so
+COPY --from=build /src/native/coordd native/coordd
 ENV PYTHONPATH=/opt/tpu-dra \
-    TPUDRA_NATIVE_LIB=/opt/tpu-dra/native/libtpudra.so
+    TPUDRA_NATIVE_LIB=/opt/tpu-dra/native/libtpudra.so \
+    SLICE_COORDD=/opt/tpu-dra/native/coordd
 ENTRYPOINT ["python"]

@@ -38,7 +38,8 @@ kubectl get pod -n tpu-dra-driver
 kubectl get resourceslices
 
 # Show two collective jobs: one plain, one referencing a TpuSliceDomain
-vim -O psum-test-no-slice-domain-job.yaml psum-test-job.yaml
+# (editor only when stepping through interactively; skipped under `bash -x`)
+[ -t 0 ] && vim -O psum-test-no-slice-domain-job.yaml psum-test-job.yaml
 
 # Show the diff between the two jobs — a domain adds only the CR + one
 # shared channel claim per worker

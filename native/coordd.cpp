@@ -1,0 +1,418 @@
+// coordd — native slice-domain coordination daemon.
+//
+// The supervised fabric daemon of the slice-domain architecture: the role
+// nvidia-imex plays in the reference (cmd/compute-domain-daemon/main.go:39-44
+// fork/execs and supervises the vendor binary; readiness is probed over its
+// control socket, main.go:255-289).  The TPU build's fabric bootstrap is
+// JAX rendezvous, so the daemon is small enough to own outright: this binary
+// serves the same HTTP contract as tpu_dra/daemon/coordservice.py (which
+// remains the fallback when the binary isn't built):
+//
+//   GET /ready        -> 200 "READY\n" | 503 "NOT_READY\n"
+//   GET /nodes        -> nodes_config.json verbatim (application/json)
+//   GET /coordinator  -> "<rank0-ip>:<port>" | 503 "NO_COORDINATOR"
+//   GET /whoami?ip=X  -> process index of member X | 404 "-1"
+//
+// State is <settings-dir>/nodes_config.json, rendered by the slice daemon's
+// update loop on every full-membership change (the nodes_config.cfg analog,
+// reference main.go:292-322); it is re-read when its mtime moves.
+//
+// Build: make -C native coordd.  Supervised by daemon/process.py
+// (ProcessManager) exactly as the reference supervises nvidia-imex: restart
+// on membership change, watchdog restart on crash, SIGTERM stop.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <algorithm>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kDefaultCoordinatorPort = 8476;  // jax.distributed default
+
+struct Node {
+  std::string name;
+  std::string ip;
+  std::string fabric;
+  long worker_id = -1;
+};
+
+// --- minimal JSON reader (objects/arrays/strings/numbers/bools/null) -------
+// Tolerates any field order / unknown fields; only the shapes our own
+// daemon writes (fsutil.atomic_write of json.dumps) plus whitespace.
+
+class JsonReader {
+ public:
+  explicit JsonReader(const std::string& text) : s_(text) {}
+
+  bool ParseNodes(std::vector<Node>* out) {
+    SkipWs();
+    if (!Consume('{')) return false;
+    while (true) {
+      SkipWs();
+      if (Consume('}')) return true;
+      std::string key;
+      if (!ParseString(&key)) return false;
+      SkipWs();
+      if (!Consume(':')) return false;
+      SkipWs();
+      if (key == "nodes") {
+        if (!ParseNodeArray(out)) return false;
+      } else {
+        if (!SkipValue()) return false;
+      }
+      SkipWs();
+      Consume(',');
+    }
+  }
+
+ private:
+  bool ParseNodeArray(std::vector<Node>* out) {
+    if (!Consume('[')) return false;
+    while (true) {
+      SkipWs();
+      if (Consume(']')) return true;
+      Node n;
+      if (!ParseNodeObject(&n)) return false;
+      out->push_back(std::move(n));
+      SkipWs();
+      Consume(',');
+    }
+  }
+
+  bool ParseNodeObject(Node* n) {
+    if (!Consume('{')) return false;
+    while (true) {
+      SkipWs();
+      if (Consume('}')) return true;
+      std::string key;
+      if (!ParseString(&key)) return false;
+      SkipWs();
+      if (!Consume(':')) return false;
+      SkipWs();
+      if (key == "name") {
+        if (!ParseString(&n->name)) return false;
+      } else if (key == "ipAddress") {
+        if (!ParseString(&n->ip)) return false;
+      } else if (key == "fabricID") {
+        if (!ParseString(&n->fabric)) return false;
+      } else if (key == "workerID") {
+        if (!ParseNumber(&n->worker_id)) return false;
+      } else {
+        if (!SkipValue()) return false;
+      }
+      SkipWs();
+      Consume(',');
+    }
+  }
+
+  bool ParseString(std::string* out) {
+    if (!Consume('"')) return false;
+    out->clear();
+    while (pos_ < s_.size()) {
+      char c = s_[pos_++];
+      if (c == '"') return true;
+      if (c == '\\' && pos_ < s_.size()) {
+        char e = s_[pos_++];
+        switch (e) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'u':  // our writer never emits non-ASCII; keep the raw escape
+            out->push_back('\\');
+            out->push_back('u');
+            break;
+          default: out->push_back(e);
+        }
+      } else {
+        out->push_back(c);
+      }
+    }
+    return false;
+  }
+
+  bool ParseNumber(long* out) {
+    size_t start = pos_;
+    if (pos_ < s_.size() && (s_[pos_] == '-' || s_[pos_] == '+')) ++pos_;
+    while (pos_ < s_.size() &&
+           (isdigit(s_[pos_]) || s_[pos_] == '.' || s_[pos_] == 'e' ||
+            s_[pos_] == 'E' || s_[pos_] == '-' || s_[pos_] == '+')) {
+      ++pos_;
+    }
+    if (pos_ == start) return false;
+    *out = ::strtol(s_.c_str() + start, nullptr, 10);
+    return true;
+  }
+
+  bool SkipValue() {
+    SkipWs();
+    if (pos_ >= s_.size()) return false;
+    char c = s_[pos_];
+    if (c == '"') {
+      std::string tmp;
+      return ParseString(&tmp);
+    }
+    if (c == '{' || c == '[') {
+      char open = c, close = (c == '{') ? '}' : ']';
+      int depth = 0;
+      bool in_str = false;
+      while (pos_ < s_.size()) {
+        c = s_[pos_++];
+        if (in_str) {
+          if (c == '\\') ++pos_;
+          else if (c == '"') in_str = false;
+        } else if (c == '"') {
+          in_str = true;
+        } else if (c == open) {
+          ++depth;
+        } else if (c == close) {
+          if (--depth == 0) return true;
+        }
+      }
+      return false;
+    }
+    // number / true / false / null
+    while (pos_ < s_.size() && s_[pos_] != ',' && s_[pos_] != '}' &&
+           s_[pos_] != ']' && !isspace(s_[pos_])) {
+      ++pos_;
+    }
+    return true;
+  }
+
+  void SkipWs() {
+    while (pos_ < s_.size() && isspace(s_[pos_])) ++pos_;
+  }
+
+  bool Consume(char c) {
+    if (pos_ < s_.size() && s_[pos_] == c) {
+      ++pos_;
+      return true;
+    }
+    return false;
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+// --- state -----------------------------------------------------------------
+
+class CoordState {
+ public:
+  CoordState(std::string settings_dir, int coordinator_port)
+      : path_(std::move(settings_dir) + "/nodes_config.json"),
+        coordinator_port_(coordinator_port) {}
+
+  // Re-read the config when it changed; keeps last-good on parse error.
+  void Reload() {
+    struct stat st;
+    if (::stat(path_.c_str(), &st) != 0) return;
+    // Nanosecond mtime + size pre-check (second-granularity st_mtime would
+    // miss a same-size rewrite landing in the same clock second; the Python
+    // coordservice compares float mtimes, and this must stay drop-in).
+    if (st.st_mtim.tv_sec == mtime_s_ && st.st_mtim.tv_nsec == mtime_ns_ &&
+        raw_.size() == (size_t)st.st_size) {
+      return;
+    }
+    FILE* f = ::fopen(path_.c_str(), "re");
+    if (f == nullptr) return;
+    std::string text;
+    char buf[4096];
+    size_t n;
+    while ((n = ::fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+    ::fclose(f);
+    std::vector<Node> nodes;
+    JsonReader reader(text);
+    if (!reader.ParseNodes(&nodes)) return;
+    std::sort(nodes.begin(), nodes.end(), [](const Node& a, const Node& b) {
+      return a.worker_id != b.worker_id ? a.worker_id < b.worker_id
+                                        : a.name < b.name;
+    });
+    nodes_ = std::move(nodes);
+    raw_ = std::move(text);
+    mtime_s_ = st.st_mtim.tv_sec;
+    mtime_ns_ = st.st_mtim.tv_nsec;
+  }
+
+  bool ready() const { return !nodes_.empty(); }
+  const std::string& raw() const { return raw_; }
+
+  std::string Coordinator() const {
+    if (nodes_.empty()) return "";
+    return nodes_.front().ip + ":" + std::to_string(coordinator_port_);
+  }
+
+  int ProcessIndex(const std::string& ip) const {
+    for (size_t i = 0; i < nodes_.size(); ++i) {
+      if (nodes_[i].ip == ip) return (int)i;
+    }
+    return -1;
+  }
+
+ private:
+  std::string path_;
+  int coordinator_port_;
+  std::vector<Node> nodes_;
+  std::string raw_;
+  time_t mtime_s_ = 0;
+  long mtime_ns_ = -1;
+};
+
+// --- HTTP ------------------------------------------------------------------
+
+void Respond(int fd, int code, const char* status, const std::string& body,
+             const char* ctype = "text/plain") {
+  char head[256];
+  int n = ::snprintf(head, sizeof(head),
+                     "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                     "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                     code, status, ctype, body.size());
+  (void)!::write(fd, head, n);
+  (void)!::write(fd, body.data(), body.size());
+}
+
+std::string QueryParam(const std::string& target, const std::string& key) {
+  size_t q = target.find('?');
+  if (q == std::string::npos) return "";
+  std::string qs = target.substr(q + 1);
+  size_t pos = 0;
+  while (pos < qs.size()) {
+    size_t amp = qs.find('&', pos);
+    std::string pair = qs.substr(pos, amp == std::string::npos ? std::string::npos
+                                                               : amp - pos);
+    size_t eq = pair.find('=');
+    if (eq != std::string::npos && pair.substr(0, eq) == key) {
+      return pair.substr(eq + 1);
+    }
+    if (amp == std::string::npos) break;
+    pos = amp + 1;
+  }
+  return "";
+}
+
+void Handle(int fd, CoordState* state) {
+  char buf[2048];
+  ssize_t n = ::read(fd, buf, sizeof(buf) - 1);
+  if (n <= 0) return;
+  buf[n] = '\0';
+  // request line: METHOD SP target SP version
+  char method[16], target[1024];
+  if (::sscanf(buf, "%15s %1023s", method, target) != 2 ||
+      ::strcmp(method, "GET") != 0) {
+    Respond(fd, 405, "Method Not Allowed", "method not allowed\n");
+    return;
+  }
+  state->Reload();
+  std::string t(target);
+  std::string path = t.substr(0, t.find('?'));
+  if (path == "/ready") {
+    if (state->ready()) Respond(fd, 200, "OK", "READY\n");
+    else Respond(fd, 503, "Service Unavailable", "NOT_READY\n");
+  } else if (path == "/nodes") {
+    Respond(fd, 200, "OK", state->ready() ? state->raw() : "{\"nodes\": []}",
+            "application/json");
+  } else if (path == "/coordinator") {
+    std::string coord = state->Coordinator();
+    if (coord.empty()) Respond(fd, 503, "Service Unavailable", "NO_COORDINATOR");
+    else Respond(fd, 200, "OK", coord);
+  } else if (path == "/whoami") {
+    int idx = state->ProcessIndex(QueryParam(t, "ip"));
+    if (idx >= 0) Respond(fd, 200, "OK", std::to_string(idx));
+    else Respond(fd, 404, "Not Found", "-1");
+  } else {
+    Respond(fd, 404, "Not Found", "not found");
+  }
+}
+
+volatile sig_atomic_t g_stop = 0;
+
+void OnSignal(int) { g_stop = 1; }
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; ++i) {
+    if (::strcmp(argv[i], "--version") == 0) {
+      // also the supervisor's pre-spawn self-test: proves the binary is
+      // loadable and runnable on this machine before it is selected over
+      // the Python fallback (daemon/main.py coordservice_argv)
+      ::printf("coordd 1\n");
+      return 0;
+    }
+  }
+  std::string settings_dir = "/etc/tpu-slice";
+  std::string address = "0.0.0.0";
+  int port = 51000;
+  if (const char* env = ::getenv("SLICE_SETTINGS_DIR")) settings_dir = env;
+  if (const char* env = ::getenv("SLICE_COORDINATOR_PORT")) port = ::atoi(env);
+  for (int i = 1; i < argc - 1; ++i) {
+    if (::strcmp(argv[i], "--settings-dir") == 0) settings_dir = argv[++i];
+    else if (::strcmp(argv[i], "--port") == 0) port = ::atoi(argv[++i]);
+    else if (::strcmp(argv[i], "--address") == 0) address = argv[++i];
+  }
+  int coord_port = kDefaultCoordinatorPort;
+  if (const char* env = ::getenv("JAX_COORDINATOR_PORT")) {
+    coord_port = ::atoi(env);
+  }
+
+  // sigaction without SA_RESTART so a signal interrupts the blocking
+  // accept() (glibc signal() would restart it and the loop would never see
+  // g_stop).
+  struct sigaction sa;
+  ::memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = OnSignal;
+  ::sigaction(SIGINT, &sa, nullptr);
+  ::sigaction(SIGTERM, &sa, nullptr);
+  ::signal(SIGPIPE, SIG_IGN);
+
+  int srv = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) { ::perror("socket"); return 1; }
+  int one = 1;
+  ::setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  ::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (::inet_pton(AF_INET, address.c_str(), &addr.sin_addr) != 1) {
+    ::fprintf(stderr, "bad address %s\n", address.c_str());
+    return 1;
+  }
+  if (::bind(srv, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::perror("bind");
+    return 1;
+  }
+  if (::listen(srv, 64) != 0) { ::perror("listen"); return 1; }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(srv, (struct sockaddr*)&addr, &alen);
+  ::fprintf(stderr, "coordd listening on %s:%d settings=%s\n",
+            address.c_str(), ntohs(addr.sin_port), settings_dir.c_str());
+
+  CoordState state(settings_dir, coord_port);
+  // Probes are sparse (kubelet every few seconds; one burst per workload
+  // start), so a sequential accept loop is the right amount of machinery.
+  while (!g_stop) {
+    int fd = ::accept(srv, nullptr, nullptr);
+    if (fd < 0) {
+      if (g_stop) break;
+      continue;
+    }
+    struct timeval tv = {2, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    Handle(fd, &state);
+    ::close(fd);
+  }
+  ::close(srv);
+  return 0;
+}

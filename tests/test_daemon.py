@@ -184,3 +184,272 @@ def test_parked_daemon_serves_ready():
     body = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/ready", timeout=2).read()
     assert body == b"READY\n"
+
+
+# --- native coordd (the supervised fabric binary, nvidia-imex analog) -------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COORDD = os.path.join(REPO, "native", "coordd")
+
+
+def _build_coordd() -> bool:
+    if os.path.exists(COORDD):
+        return True
+    try:
+        subprocess.run(["make", "-C", os.path.join(REPO, "native"), "coordd"],
+                       check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return os.path.exists(COORDD)
+
+
+@pytest.fixture(scope="module")
+def coordd_bin():
+    if not _build_coordd():
+        pytest.skip("native toolchain unavailable")
+    return COORDD
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_native_coordd_same_contract_as_python_service(coordd_bin, tmp_path):
+    """The C++ daemon must be drop-in for coordservice.py: same routes,
+    same bodies, same status codes (test_coordservice_endpoints twin)."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [coordd_bin, "--settings-dir", str(tmp_path), "--port", str(port),
+         "--address", "127.0.0.1"],
+        stderr=subprocess.PIPE)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        def ready_code():
+            try:
+                return urllib.request.urlopen(
+                    f"{base}/ready", timeout=1).status
+            except urllib.error.HTTPError as err:
+                return err.code
+            except OSError:
+                return 0
+
+        assert wait_until(lambda: ready_code() == 503)
+
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n1", "10.0.0.11", FABRIC, 1),
+            TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0),
+        ], FABRIC)
+
+        assert urllib.request.urlopen(
+            f"{base}/ready", timeout=2).read() == b"READY\n"
+        coord = urllib.request.urlopen(
+            f"{base}/coordinator", timeout=2).read().decode()
+        assert coord == "10.0.0.10:8476"
+        who = urllib.request.urlopen(
+            f"{base}/whoami?ip=10.0.0.11", timeout=2).read().decode()
+        assert who == "1"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/whoami?ip=10.9.9.9", timeout=2)
+        assert exc.value.code == 404
+        nodes = json.loads(urllib.request.urlopen(
+            f"{base}/nodes", timeout=2).read())
+        assert sorted(n["name"] for n in nodes["nodes"]) == ["n0", "n1"]
+
+        # membership change: rewritten config is picked up via mtime
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n9", "10.0.0.99", FABRIC, 0)], FABRIC)
+        assert wait_until(lambda: urllib.request.urlopen(
+            f"{base}/coordinator", timeout=1).read() == b"10.0.0.99:8476")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_native_coordd_check_probe(coordd_bin, tmp_path, monkeypatch):
+    """daemon `check` (the kubelet startup/liveness probe) against the
+    native binary (reference main.go:255-289 probes nvidia-imex-ctl)."""
+    from tpu_dra.daemon.main import check
+
+    port = _free_port()
+    write_nodes_config(str(tmp_path), [
+        TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+    proc = subprocess.Popen(
+        [coordd_bin, "--settings-dir", str(tmp_path), "--port", str(port),
+         "--address", "127.0.0.1"], stderr=subprocess.PIPE)
+    try:
+        monkeypatch.setenv("SLICE_COORDINATOR_PORT", str(port))
+        assert wait_until(lambda: check() == 0)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_coordservice_argv_prefers_native(monkeypatch, tmp_path):
+    from tpu_dra.daemon.main import coordservice_argv
+
+    fake = tmp_path / "coordd"
+    fake.write_text("#!/bin/sh\n")
+    fake.chmod(0o755)
+
+    monkeypatch.setenv("SLICE_COORDD", str(fake))
+    argv = coordservice_argv("/etc/tpu-slice", 51000)
+    assert argv[0] == str(fake)
+
+    monkeypatch.setenv("SLICE_COORDD_NATIVE", "0")
+    argv = coordservice_argv("/etc/tpu-slice", 51000)
+    assert argv[:3] == [sys.executable, "-m", "tpu_dra.daemon.coordservice"]
+
+
+def test_daemon_run_live_with_native_coordd(coordd_bin, tmp_path):
+    """Full slice-daemon e2e: the real ``daemon.main run`` process against
+    the HTTP kube facade — membership via CR status, nodes-config render,
+    native coordd spawn, `check` probe green, coordinator resolution
+    (SURVEY §3.3's daemon leg, with the nvidia-imex analog actually
+    fork/exec'd as a native child)."""
+    from tpu_dra.k8s.testserver import KubeTestServer
+
+    srv = KubeTestServer().start()
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp_path / "kubeconfig"))
+        srv.fake.create(TPU_SLICE_DOMAINS, {
+            "metadata": {"name": "dom", "namespace": NS},
+            "spec": {"numNodes": 1}})
+
+        root = tmp_path / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(4):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-8'\n"
+            "TPU_TOPOLOGY: '2x4'\n"
+            "TPU_WORKER_ID: '0'\n"
+            "TPU_WORKER_HOSTNAMES: 'host-a,host-b'\n")
+
+        settings = tmp_path / "settings"
+        settings.mkdir()
+        port = _free_port()
+        env = {**os.environ,
+               "PYTHONPATH": REPO,
+               "KUBECONFIG": kcfg,
+               "SLICE_DOMAIN_UUID": "uid-dom",
+               "SLICE_DOMAIN_NAME": "dom",
+               "SLICE_DOMAIN_NAMESPACE": NS,
+               "NODE_NAME": "node-a",
+               "POD_IP": "127.0.0.1",
+               "SLICE_SETTINGS_DIR": str(settings),
+               "SLICE_COORDINATOR_PORT": str(port),
+               "TPU_DRIVER_ROOT": str(root),
+               "TPU_IGNORE_HOST_ENV": "1"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.daemon.main", "run"],
+            cwd=REPO, env=env)
+        try:
+            # membership lands in CR status
+            def status_nodes():
+                dom = srv.fake.get(TPU_SLICE_DOMAINS, "dom", namespace=NS)
+                return (dom.get("status") or {}).get("nodes") or []
+            assert wait_until(lambda: len(status_nodes()) == 1, timeout=15)
+            assert status_nodes()[0]["ipAddress"] == "127.0.0.1"
+
+            # full membership → nodes config rendered, coordd serving READY
+            assert wait_until(
+                lambda: (settings / "nodes_config.json").exists(), timeout=15)
+
+            def probe():
+                try:
+                    return urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/ready",
+                        timeout=1).read() == b"READY\n"
+                except OSError:
+                    return False
+            assert wait_until(probe, timeout=15)
+
+            coord = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/coordinator", timeout=2).read()
+            assert coord == b"127.0.0.1:8476"
+
+            # the supervised child really is the native binary
+            children = subprocess.run(
+                ["ps", "--ppid", str(proc.pid), "-o", "args="],
+                capture_output=True, text=True).stdout
+            assert "coordd" in children and "coordservice" not in children
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    finally:
+        srv.stop()
+
+
+def test_coordd_version_selftest(coordd_bin):
+    out = subprocess.run([coordd_bin, "--version"], capture_output=True,
+                         text=True, timeout=10)
+    assert out.returncode == 0 and out.stdout.startswith("coordd")
+
+
+def test_coordd_picks_up_same_size_rewrite(coordd_bin, tmp_path):
+    """A same-length rewrite of nodes_config.json (IP swap) must be visible:
+    reload change-detection needs sub-second mtime + size, not st_mtime."""
+    port = _free_port()
+    write_nodes_config(str(tmp_path), [
+        TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+    proc = subprocess.Popen(
+        [coordd_bin, "--settings-dir", str(tmp_path), "--port", str(port),
+         "--address", "127.0.0.1"], stderr=subprocess.PIPE)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        def coordinator():
+            try:
+                return urllib.request.urlopen(
+                    f"{base}/coordinator", timeout=1).read().decode()
+            except OSError:
+                return ""
+        assert wait_until(lambda: coordinator() == "10.0.0.10:8476")
+        # same byte length, same clock second with high probability
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n0", "10.0.0.20", FABRIC, 0)], FABRIC)
+        assert wait_until(lambda: coordinator() == "10.0.0.20:8476")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_coordservice_argv_rejects_unrunnable_native(monkeypatch, tmp_path):
+    """An executable-but-unrunnable coordd (wrong arch / corrupt) must lose
+    to the Python fallback via the --version self-test."""
+    from tpu_dra.daemon import main as daemon_main
+
+    bad = tmp_path / "coordd"
+    bad.write_bytes(b"\x7fELF garbage not actually runnable")
+    bad.chmod(0o755)
+    monkeypatch.setenv("SLICE_COORDD", str(bad))
+    monkeypatch.setattr(daemon_main, "_coordd_selftest_cache", {})
+    argv = daemon_main.coordservice_argv("/etc/tpu-slice", 51000)
+    # falls through to the next candidate (repo coordd if built, else the
+    # Python service) — never the unrunnable override
+    assert argv[0] != str(bad)
+
+
+def test_process_manager_survives_spawn_failure_then_recovers(tmp_path):
+    """ENOEXEC at spawn must not kill the calling thread; the watchdog keeps
+    retrying argv_fn, so a corrected command takes over."""
+    bad = tmp_path / "notabinary"
+    bad.write_bytes(b"garbage")
+    bad.chmod(0o755)
+    argv_holder = {"argv": [str(bad)]}
+    pm = ProcessManager(argv_fn=lambda: argv_holder["argv"],
+                        name="flaky", watchdog_interval=0.05)
+    pm.restart()          # spawn fails; must not raise
+    assert not pm.alive()
+    pm.start_watchdog()
+    try:
+        argv_holder["argv"] = [sys.executable, "-c",
+                               "import time; time.sleep(60)"]
+        assert wait_until(pm.alive, 5)
+    finally:
+        pm.stop_watchdog()
+        pm.stop()

@@ -46,6 +46,53 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
     return path
 
 
+_coordd_selftest_cache: dict[str, bool] = {}
+
+
+def _coordd_runnable(path: str) -> bool:
+    """Pre-spawn self-test: ``coordd --version`` must execute and exit 0.
+
+    Guards against an executable-but-unrunnable binary (wrong arch,
+    truncated image layer) being selected and then failing every spawn with
+    no fallback — the Python service must win in that case.
+    """
+    cached = _coordd_selftest_cache.get(path)
+    if cached is not None:
+        return cached
+    import subprocess
+    try:
+        ok = subprocess.run([path, "--version"], capture_output=True,
+                            timeout=10).returncode == 0
+    except (OSError, subprocess.SubprocessError):
+        ok = False
+    if not ok:
+        klog.warning("native coordd failed self-test; using Python "
+                     "coordservice", path=path)
+    _coordd_selftest_cache[path] = ok
+    return ok
+
+
+def coordservice_argv(settings_dir: str, port: int) -> list[str]:
+    """Command line for the supervised coordination service.
+
+    Prefers the native daemon (``native/coordd``, the nvidia-imex analog —
+    reference daemon main.go:39-44 supervises a native fabric binary); the
+    pure-Python service is the fallback so unbuilt checkouts still run.
+    ``SLICE_COORDD`` overrides the binary path; ``SLICE_COORDD_NATIVE=0``
+    forces the Python service.
+    """
+    if os.environ.get("SLICE_COORDD_NATIVE", "1") != "0":
+        candidates = [os.environ.get("SLICE_COORDD", "")]
+        candidates.append(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "native", "coordd"))
+        for cand in candidates:
+            if cand and os.access(cand, os.X_OK) and _coordd_runnable(cand):
+                return [cand, "--settings-dir", settings_dir,
+                        "--port", str(port)]
+    return [sys.executable, "-m", "tpu_dra.daemon.coordservice",
+            "--settings-dir", settings_dir, "--port", str(port)]
+
+
 def _serve_parked(port: int) -> None:
     """Minimal READY server for parked (no-fabric) daemons so probes pass."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -97,10 +144,7 @@ def run(argv=None) -> int:
         kube, domain_name, domain_namespace, node_name, pod_ip,
         fabric, tpulib.worker_id())
     coordservice = ProcessManager(
-        argv_fn=lambda: [sys.executable, "-m",
-                         "tpu_dra.daemon.coordservice",
-                         "--settings-dir", settings_dir,
-                         "--port", str(port)],
+        argv_fn=lambda: coordservice_argv(settings_dir, port),
         name="coordservice")
 
     stop = threading.Event()
@@ -114,10 +158,15 @@ def run(argv=None) -> int:
                 nodes = membership.updates.get(timeout=0.5)
             except queue.Empty:
                 continue
-            write_nodes_config(settings_dir, nodes, fabric)
-            klog.info("membership changed; restarting coordination service",
-                      members=len(nodes))
-            coordservice.restart()
+            try:
+                write_nodes_config(settings_dir, nodes, fabric)
+                klog.info("membership changed; restarting coordination "
+                          "service", members=len(nodes))
+                coordservice.restart()
+            except Exception as exc:  # noqa: BLE001 — loop must survive
+                # (e.g. a spawn failure); the watchdog keeps retrying and
+                # the next membership change comes back through here
+                klog.error("coordination update failed", error=str(exc))
 
     membership.start()
     coordservice.start_watchdog()

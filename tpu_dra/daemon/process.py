@@ -27,6 +27,7 @@ class ProcessManager:
         self._stopping = False
         self._watchdog_stop = threading.Event()
         self._watchdog_thread: Optional[threading.Thread] = None
+        self._ever_started = False
         self.restarts = 0
 
     # -- lifecycle (process.go:59-141) -------------------------------------
@@ -39,8 +40,18 @@ class ProcessManager:
 
     def _start_locked(self) -> None:
         argv = self.argv_fn()
-        self._proc = subprocess.Popen(argv)
         self._stopping = False
+        self._ever_started = True   # "start requested": watchdog may retry
+        try:
+            self._proc = subprocess.Popen(argv)
+        except OSError as exc:
+            # Spawn failure (ENOEXEC/ENOENT) must not unwind the caller's
+            # thread: leave _proc None and let the watchdog keep retrying —
+            # argv_fn re-evaluates, so a fallback can take over.
+            klog.error("failed to spawn child process", name=self.name,
+                       argv=argv, error=str(exc))
+            self._proc = None
+            return
         klog.info("started child process", name=self.name,
                   pid=self._proc.pid, argv=argv)
 
@@ -84,9 +95,13 @@ class ProcessManager:
                 continue
             try:
                 proc = self._proc
-                if proc is None or self._stopping:
+                if self._stopping or not self._ever_started:
                     continue
-                if proc.poll() is not None:
+                if proc is None:
+                    # a previous start attempt failed to spawn — retry
+                    self.restarts += 1
+                    self._start_locked()
+                elif proc.poll() is not None:
                     klog.warning("child exited unexpectedly; restarting",
                                  name=self.name, code=proc.returncode)
                     self.restarts += 1
