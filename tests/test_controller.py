@@ -194,3 +194,78 @@ def test_channelless_domain_deletable(controller):
     kube.delete(TPU_SLICE_DOMAINS, "nochan", NS)
     assert wait_until(lambda: not _exists(kube, TPU_SLICE_DOMAINS,
                                           "nochan", NS))
+
+
+def test_controller_main_live_over_http(tmp_path):
+    """Full controller e2e: the real ``controller.main`` process against the
+    HTTP kube facade — CR create → DaemonSet + both RCTs materialize,
+    DS readiness flips the CR, metrics endpoint serves, teardown is
+    finalizer-ordered (SURVEY §3.3/§3.4 controller legs, live)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import urllib.request
+
+    from tpu_dra.k8s.testserver import KubeTestServer
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srv = KubeTestServer().start()
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp_path / "kubeconfig"))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            mport = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.controller.main",
+             "--kubeconfig", kcfg, "--namespace", "tpu-dra-driver",
+             "--http-endpoint", f"127.0.0.1:{mport}"],
+            cwd=repo, env={**os.environ, "PYTHONPATH": repo})
+        try:
+            dom = make_domain(srv.fake)
+            uid = dom["metadata"]["uid"]
+
+            def ds():
+                try:
+                    return srv.fake.get(DAEMONSETS, ds_name("dom", uid),
+                                        namespace="tpu-dra-driver")
+                except NotFound:
+                    return None
+            assert wait_until(lambda: ds() is not None, timeout=15)
+            def rct_exists():
+                try:
+                    srv.fake.get(RESOURCE_CLAIM_TEMPLATES, "dom-channel",
+                                 namespace=NS)
+                    return True
+                except NotFound:
+                    return False
+            assert wait_until(rct_exists, timeout=15)
+
+            # readiness: DS NumberReady == numNodes flips the CR status
+            d = ds()
+            d["status"] = {"numberReady": 4}
+            srv.fake.update_status(DAEMONSETS, d)
+            def cr_status():
+                cr = srv.fake.get(TPU_SLICE_DOMAINS, "dom", namespace=NS)
+                return (cr.get("status") or {}).get("status")
+            assert wait_until(lambda: cr_status() == "Ready", timeout=15)
+
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=2).read()
+            assert b"tpu_dra" in body or b"python" in body
+
+            # deletion: finalizer-ordered teardown removes everything
+            srv.fake.delete(TPU_SLICE_DOMAINS, "dom", namespace=NS)
+            def all_gone():
+                try:
+                    srv.fake.get(TPU_SLICE_DOMAINS, "dom", namespace=NS)
+                    return False
+                except NotFound:
+                    pass
+                return ds() is None
+            assert wait_until(all_gone, timeout=15)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    finally:
+        srv.stop()

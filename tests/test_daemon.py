@@ -427,7 +427,6 @@ def test_coordservice_argv_rejects_unrunnable_native(monkeypatch, tmp_path):
     bad.write_bytes(b"\x7fELF garbage not actually runnable")
     bad.chmod(0o755)
     monkeypatch.setenv("SLICE_COORDD", str(bad))
-    monkeypatch.setattr(daemon_main, "_coordd_selftest_cache", {})
     argv = daemon_main.coordservice_argv("/etc/tpu-slice", 51000)
     # falls through to the next candidate (repo coordd if built, else the
     # Python service) — never the unrunnable override
@@ -450,6 +449,31 @@ def test_process_manager_survives_spawn_failure_then_recovers(tmp_path):
         argv_holder["argv"] = [sys.executable, "-c",
                                "import time; time.sleep(60)"]
         assert wait_until(pm.alive, 5)
+    finally:
+        pm.stop_watchdog()
+        pm.stop()
+
+
+def test_process_manager_stop_is_terminal_after_spawn_failure(tmp_path):
+    """stop() with no live child (spawn failed) must still latch _stopping
+    so the watchdog retry branch cannot respawn into the void."""
+    bad = tmp_path / "notabinary"
+    bad.write_bytes(b"garbage")
+    bad.chmod(0o755)
+    spawned = tmp_path / "spawned"
+    argv_holder = {"argv": [str(bad)]}
+    pm = ProcessManager(argv_fn=lambda: argv_holder["argv"],
+                        name="flaky", watchdog_interval=0.05)
+    pm.start_watchdog()
+    try:
+        pm.restart()          # spawn fails
+        pm.stop()             # terminal: no future respawn
+        argv_holder["argv"] = [sys.executable, "-c",
+                               f"open({str(spawned)!r}, 'w').close(); "
+                               "import time; time.sleep(60)"]
+        time.sleep(0.3)       # several watchdog ticks
+        assert not pm.alive()
+        assert not spawned.exists()
     finally:
         pm.stop_watchdog()
         pm.stop()

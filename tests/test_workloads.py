@@ -83,6 +83,18 @@ def test_psum_and_ppermute_run_on_mesh():
     assert res2.algo_bytes_per_s > 0
 
 
+def test_all_gather_and_reduce_scatter_run_on_mesh():
+    from tpu_dra.workloads.collectives import (
+        all_gather_bandwidth,
+        reduce_scatter_bandwidth,
+    )
+    mesh = make_mesh()
+    res = all_gather_bandwidth(mesh, mib_per_device=1, iters=2)
+    assert res.name == "all_gather" and res.algo_bytes_per_s > 0
+    res2 = reduce_scatter_bandwidth(mesh, mib_per_device=1, iters=2)
+    assert res2.name == "reduce_scatter" and res2.algo_bytes_per_s > 0
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as ge
     fn, args = ge.entry()

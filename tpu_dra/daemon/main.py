@@ -46,19 +46,16 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
     return path
 
 
-_coordd_selftest_cache: dict[str, bool] = {}
-
-
 def _coordd_runnable(path: str) -> bool:
     """Pre-spawn self-test: ``coordd --version`` must execute and exit 0.
 
     Guards against an executable-but-unrunnable binary (wrong arch,
     truncated image layer) being selected and then failing every spawn with
-    no fallback — the Python service must win in that case.
+    no fallback — the Python service must win in that case.  Deliberately
+    uncached: argv_fn re-evaluates on every (re)start, so a binary that
+    breaks — or gets fixed — while the daemon runs changes the verdict on
+    the next restart instead of pinning a stale one.
     """
-    cached = _coordd_selftest_cache.get(path)
-    if cached is not None:
-        return cached
     import subprocess
     try:
         ok = subprocess.run([path, "--version"], capture_output=True,
@@ -68,7 +65,6 @@ def _coordd_runnable(path: str) -> bool:
     if not ok:
         klog.warning("native coordd failed self-test; using Python "
                      "coordservice", path=path)
-    _coordd_selftest_cache[path] = ok
     return ok
 
 

@@ -56,9 +56,12 @@ class ProcessManager:
                   pid=self._proc.pid, argv=argv)
 
     def _stop_locked(self, timeout: float = 10.0) -> None:
+        # Latch _stopping even with no live child: after a spawn failure
+        # (_proc None, _ever_started True) the watchdog's retry branch must
+        # see a stop() as terminal, not respawn into the void.
+        self._stopping = True
         if self._proc is None:
             return
-        self._stopping = True
         proc = self._proc
         if proc.poll() is None:
             proc.terminate()

@@ -111,6 +111,52 @@ def ppermute_bandwidth(mesh: Mesh, mib_per_device: int = 64,
                             buffer_bytes / secs)
 
 
+def all_gather_bandwidth(mesh: Mesh, mib_per_device: int = 64,
+                         iters: int = 10) -> CollectiveResult:
+    """All-gather bandwidth: every device receives the other n-1 shards.
+
+    The timed op must be shape-preserving (``_time_op`` chains it through a
+    fori_loop), so the gathered buffer is folded back to the carry through a
+    tiny scaled reduction — keeps the collective live against DCE while
+    adding negligible work.
+    """
+    n = mesh.devices.size
+    elems = mib_per_device * 1024 * 1024 // 2   # bf16
+    x = jnp.ones((n, elems), dtype=jnp.bfloat16)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("x", None),
+             out_specs=P("x", None))
+    def gather(v):
+        w = jax.lax.all_gather(v, "x", tiled=True)
+        return v + jnp.bfloat16(1e-8) * jnp.mean(w)
+
+    secs = _time_op(gather, x, iters=iters)
+    buffer_bytes = elems * 2
+    algo = (n - 1) * buffer_bytes / secs if n > 1 else buffer_bytes / secs
+    return CollectiveResult("all_gather", n, buffer_bytes, secs, algo)
+
+
+def reduce_scatter_bandwidth(mesh: Mesh, mib_per_device: int = 64,
+                             iters: int = 10) -> CollectiveResult:
+    """Reduce-scatter bandwidth: each device sends its buffer and keeps one
+    reduced shard — the other half of the ring-allreduce decomposition."""
+    n = mesh.devices.size
+    elems = (mib_per_device * 1024 * 1024 // 2) // max(n, 1) * n
+    x = jnp.ones((n, elems), dtype=jnp.bfloat16)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("x", None),
+             out_specs=P("x", None))
+    def scatter(v):
+        r = jax.lax.psum_scatter(v, "x", scatter_dimension=1, tiled=True)
+        return v + jnp.bfloat16(1e-8) * jnp.mean(r)
+
+    secs = _time_op(scatter, x, iters=iters)
+    buffer_bytes = elems * 2
+    algo = (n - 1) / max(n, 1) * buffer_bytes / secs if n > 1 else \
+        buffer_bytes / secs
+    return CollectiveResult("reduce_scatter", n, buffer_bytes, secs, algo)
+
+
 def matmul_throughput(size: int = 4096, iters: int = 200) -> float:
     """Single-chip MXU sanity: bf16 matmul TFLOP/s (keeps the benchmark
     honest about the chip actually running)."""
