@@ -80,8 +80,10 @@ def bench_prepare_latency(n_claims: int = 200) -> dict:
     }
 
 
-def bench_tpu() -> dict:
-    out: dict = {}
+def bench_tpu(out: dict | None = None) -> dict:
+    # `out` may be a shared dict mutated as sections complete, so a caller
+    # with a deadline keeps the sections that finished before a wedge
+    out = {} if out is None else out
     try:
         import jax
 
@@ -159,7 +161,7 @@ def bench_tpu_with_deadline(timeout_s: float = 480.0) -> dict:
     done = threading.Event()
 
     def work() -> None:
-        result.update(bench_tpu())
+        bench_tpu(result)
         done.set()
 
     threading.Thread(target=work, daemon=True, name="bench-tpu").start()

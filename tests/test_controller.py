@@ -220,7 +220,8 @@ def test_controller_main_live_over_http(tmp_path):
             [sys.executable, "-m", "tpu_dra.controller.main",
              "--kubeconfig", kcfg, "--namespace", "tpu-dra-driver",
              "--http-endpoint", f"127.0.0.1:{mport}"],
-            cwd=repo, env={**os.environ, "PYTHONPATH": repo})
+            cwd=repo, env={**os.environ, "PYTHONPATH": os.pathsep.join(
+                p for p in (repo, os.environ.get("PYTHONPATH")) if p)})
         try:
             dom = make_domain(srv.fake)
             uid = dom["metadata"]["uid"]

@@ -334,7 +334,9 @@ def test_daemon_run_live_with_native_coordd(coordd_bin, tmp_path):
         settings.mkdir()
         port = _free_port()
         env = {**os.environ,
-               "PYTHONPATH": REPO,
+               "PYTHONPATH": os.pathsep.join(
+                   p for p in (REPO, os.environ.get("PYTHONPATH"))
+                   if p),
                "KUBECONFIG": kcfg,
                "SLICE_DOMAIN_UUID": "uid-dom",
                "SLICE_DOMAIN_NAME": "dom",

@@ -305,3 +305,18 @@ def test_mixed_chip_core_group_unions_visible_chips(tmp_path):
                for d in spec["devices"]}
     assert by_name[f"{UID}-tpu-0"]["TPU_VISIBLE_CHIPS"] == "0,1"
     assert by_name[f"{UID}-tpu-1-core-0"]["TPU_VISIBLE_CORES"] == "1:0"
+
+
+def test_torn_claim_spec_regenerated_on_idempotent_prepare(tmp_path):
+    """A present-but-corrupt claim spec (crash mid-write on a disk-backed
+    cdi-root: the spec is written without a sync) must be rewritten on the
+    idempotent prepare path, not trusted for existing."""
+    state = make_state(tmp_path)
+    claim = make_claim(uid="uid-torn")
+    state.prepare(claim)
+    path = state.cdi.claim_spec_path("uid-torn")
+    with open(path, "w") as f:
+        f.write('{"cdiVersion": "0.')   # torn JSON
+    state.prepare(claim)                 # idempotent replay
+    spec = json.load(open(path))
+    assert spec["devices"], "torn spec must be regenerated"

@@ -162,3 +162,110 @@ def test_pool_generation_monotonic_across_restart(tmp_path):
     gen2 = kube.list(RESOURCE_SLICES)["items"][0]["spec"]["pool"]["generation"]
     assert gen2 == 4
     drv2.stop()
+
+
+def test_crash_restart_recovery_real_process(tmp_path):
+    """Crash consistency across real process restarts (SURVEY §5
+    checkpoint/resume): SIGKILL the plugin after prepare; after restart the
+    prepare is idempotent (same CDI ids, no rework) and unprepare succeeds
+    even with the claim GONE from the API server — checkpoint-only state,
+    the reference's core durability property (device_state.go:181-189)."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    from tpu_dra.k8s.testserver import KubeTestServer
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srv = KubeTestServer().start()
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp_path / "kubeconfig"))
+        root = tmp_path / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(4):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-4'\nTPU_TOPOLOGY: '2x2'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+        argv = [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+                "--kubeconfig", kcfg, "--node-name", "node-a",
+                "--tpu-driver-root", str(root),
+                "--kubelet-plugins-dir", str(tmp_path / "plugins"),
+                "--kubelet-registry-dir", str(tmp_path / "registry"),
+                "--cdi-root", str(tmp_path / "cdi"),
+                "--ignore-host-tpu-env"]
+        env = {**os.environ, "PYTHONPATH": os.pathsep.join(
+            p for p in (repo, os.environ.get("PYTHONPATH")) if p)}
+        sock = tmp_path / "plugins" / DRIVER_NAME / "dra.sock"
+
+        def start():
+            p = subprocess.Popen(argv, cwd=repo, env=env)
+            deadline = time.time() + 20
+            while time.time() < deadline and not sock.exists():
+                time.sleep(0.1)
+            assert sock.exists(), "plugin socket never appeared"
+            return p
+
+        def rpc_retry(method, request, response_cls, timeout=15.0):
+            # a stale socket file survives SIGKILL, so poll until the
+            # restarted server actually accepts
+            deadline = time.time() + timeout
+            while True:
+                try:
+                    return rpc(str(sock), method, request, response_cls)
+                except grpc.RpcError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.2)
+
+        claim = {"metadata": {"name": "c1", "namespace": "default"},
+                 "spec": {},
+                 "status": {"allocation": {"devices": {"results": [
+                     {"request": "tpus", "driver": DRIVER_NAME,
+                      "pool": "node-a", "device": "tpu-1"}]}}}}
+        uid = srv.fake.create(RESOURCE_CLAIMS, claim)["metadata"]["uid"]
+        req = dra_pb.NodePrepareResourcesRequest()
+        c = req.claims.add()
+        c.uid, c.name, c.namespace = uid, "c1", "default"
+
+        proc = start()
+        try:
+            res = rpc_retry("/v1beta1.DRAPlugin/NodePrepareResources",
+                            req, dra_pb.NodePrepareResourcesResponse)
+            first_ids = list(res.claims[uid].devices[0].cdi_device_ids)
+            assert first_ids and not res.claims[uid].error
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(10)
+
+        proc = start()
+        try:
+            res2 = rpc_retry("/v1beta1.DRAPlugin/NodePrepareResources",
+                             req, dra_pb.NodePrepareResourcesResponse)
+            assert res2.claims[uid].error == ""
+            assert list(res2.claims[uid].devices[0].cdi_device_ids) == \
+                first_ids, "idempotent prepare must replay the checkpoint"
+
+            # worst case for teardown: claim object deleted from the API
+            # server — unprepare must succeed from the checkpoint alone
+            # (the reference's unprepare never needs the API server)
+            srv.fake.delete(RESOURCE_CLAIMS, "c1", namespace="default")
+
+            ureq = dra_pb.NodeUnprepareResourcesRequest()
+            uc = ureq.claims.add()
+            uc.uid, uc.name, uc.namespace = uid, "c1", "default"
+            ures = rpc_retry("/v1beta1.DRAPlugin/NodeUnprepareResources",
+                             ureq, dra_pb.NodeUnprepareResourcesResponse)
+            assert ures.claims[uid].error == ""
+            ckpt = json.load(open(
+                tmp_path / "plugins" / DRIVER_NAME / "checkpoint.json"))
+            assert uid not in json.dumps(ckpt)
+        finally:
+            proc.terminate()
+            proc.wait(10)
+    finally:
+        srv.stop()

@@ -168,7 +168,10 @@ class CDIHandler:
             "devices": devices,
         }
         path = self.claim_spec_path(claim_uid)
-        _atomic_write(path, json.dumps(spec, indent=2, sort_keys=True))
+        # regenerable: idempotent prepare rewrites a missing claim spec from
+        # the checkpoint after a crash, so no sync on the hot path
+        _atomic_write(path, json.dumps(spec, indent=2, sort_keys=True),
+                      durable=False)
         return path
 
     def delete_claim_spec(self, claim_uid: str) -> None:

@@ -46,25 +46,45 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
     return path
 
 
+# path -> (verdict, binary mtime_ns, expires_at monotonic)
+_coordd_selftest_cache: dict[str, tuple[bool, int, float]] = {}
+_COORDD_SELFTEST_TTL = 30.0
+
+
 def _coordd_runnable(path: str) -> bool:
     """Pre-spawn self-test: ``coordd --version`` must execute and exit 0.
 
     Guards against an executable-but-unrunnable binary (wrong arch,
     truncated image layer) being selected and then failing every spawn with
-    no fallback — the Python service must win in that case.  Deliberately
-    uncached: argv_fn re-evaluates on every (re)start, so a binary that
-    breaks — or gets fixed — while the daemon runs changes the verdict on
-    the next restart instead of pinning a stale one.
+    no fallback — the Python service must win in that case.  The verdict is
+    cached per (binary mtime, short TTL): argv_fn runs under the
+    ProcessManager lock and the watchdog re-evaluates it every second
+    during a crash loop, so an uncached probe (subprocess with a multi-
+    second timeout) would stall alive()/stop()/restart() callers; the
+    mtime key still flips the verdict immediately when the binary is
+    replaced, and the TTL re-probes a binary that broke in place.
     """
     import subprocess
+    import time as _time
+
+    try:
+        mtime_ns = os.stat(path).st_mtime_ns
+    except OSError:
+        return False
+    cached = _coordd_selftest_cache.get(path)
+    now = _time.monotonic()
+    if cached is not None and cached[1] == mtime_ns and now < cached[2]:
+        return cached[0]
     try:
         ok = subprocess.run([path, "--version"], capture_output=True,
-                            timeout=10).returncode == 0
+                            timeout=5).returncode == 0
     except (OSError, subprocess.SubprocessError):
         ok = False
     if not ok:
         klog.warning("native coordd failed self-test; using Python "
                      "coordservice", path=path)
+    _coordd_selftest_cache[path] = (ok, mtime_ns,
+                                    now + _COORDD_SELFTEST_TTL)
     return ok
 
 

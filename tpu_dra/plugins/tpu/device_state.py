@@ -10,6 +10,7 @@ before returning (the crash-consistency point, device_state.go:160-167).
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 from dataclasses import dataclass, field
@@ -103,8 +104,11 @@ class DeviceState:
             existing = self.checkpoint.get(uid)
             if existing is not None:   # idempotent no-op, :139-146
                 # /var/run/cdi is tmpfs: after a node reboot the checkpoint
-                # (persistent) can outlive the claim spec — regenerate it
-                if not os.path.exists(self.cdi.claim_spec_path(uid)):
+                # (persistent) can outlive the claim spec — and on a
+                # disk-backed cdi-root a crash can leave a present-but-torn
+                # file (the spec is written without a sync). Regenerate
+                # unless a parseable spec is already in place.
+                if not self._claim_spec_intact(uid):
                     _, per_device_edits = self._prepare_devices(claim)
                     self.cdi.create_claim_spec(uid, per_device_edits)
                 return existing.devices
@@ -177,6 +181,15 @@ class DeviceState:
         return chosen
 
     # -- prepare internals -------------------------------------------------
+    def _claim_spec_intact(self, uid: str) -> bool:
+        """True if the per-claim CDI spec exists and parses as JSON."""
+        try:
+            with open(self.cdi.claim_spec_path(uid)) as f:
+                json.load(f)
+            return True
+        except (OSError, ValueError):
+            return False
+
     def _prepare_devices(
         self, claim: dict,
     ) -> tuple[list[PreparedDevice], dict[str, ContainerEdits]]:
