@@ -139,6 +139,114 @@ def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
     return fn
 
 
+# --- zigzag (load-balanced) causal ring attention ----------------------------
+
+
+def zigzag_indices(seq_len: int, n: int):
+    """Natural→zigzag gather order: the sequence is cut into 2n chunks and
+    device i is assigned chunks (i, 2n-1-i), so every device owns one
+    early and one late chunk and causal work is balanced across the ring
+    (plain contiguous sharding gives device n-1 ~n× the unmasked work of
+    device 0).  Returns the index vector: ``x[..., order, :]`` laid out
+    contiguously is exactly the per-device pairs in device order.
+    """
+    assert seq_len % (2 * n) == 0, (seq_len, n)
+    c = seq_len // (2 * n)
+    order: list[int] = []
+    for i in range(n):
+        order.extend(range(i * c, (i + 1) * c))
+        order.extend(range((2 * n - 1 - i) * c, (2 * n - i) * c))
+    return jnp.asarray(order)
+
+
+def inverse_permutation(order):
+    return jnp.argsort(order)
+
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
+    """Causal ring attention over zigzag-striped shards.
+
+    Per-device shapes ``[B, H, 2C, D]`` where the two C-chunks are global
+    chunks ``(i, 2n-1-i)`` (see ``zigzag_indices``).  Each ring step does
+    exactly two chunk-attends on every device — q_hi×kv_lo always lands
+    fully in the past, and exactly one of q_lo×kv_lo / q_hi×kv_hi is
+    unmasked depending on the source's position — so no device burns MXU
+    time on fully-masked blocks and none is the straggler (the plain
+    ``ring_attention`` executes masked blocks to stay SPMD-uniform).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, S2, D = q.shape
+    C = S2 // 2
+    scale = D ** -0.5
+    neg = jnp.finfo(jnp.float32).min
+
+    split = lambda x: x.astype(jnp.float32).reshape(B, H, 2, C, D)
+    qz = split(q)
+    kv = jnp.stack([k, v])                 # [2, B, H, 2C, D] circulates
+    q_lo, q_hi = qz[:, :, 0], qz[:, :, 1]
+
+    rows = jnp.arange(C)[:, None]
+    cols = jnp.arange(C)[None, :]
+    tril = rows >= cols
+    ones = jnp.ones((C, C), bool)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(carry, qc, kc, vc, mask):
+        m, l, a = carry
+        return _block_attn(qc, kc.astype(jnp.float32),
+                           vc.astype(jnp.float32), m, l, a, mask, scale)
+
+    def zero_carry():
+        return (jnp.full((B, H, C), neg, jnp.float32),
+                jnp.zeros((B, H, C), jnp.float32),
+                jnp.zeros((B, H, C, D), jnp.float32))
+
+    # t = 0: source is self — both diagonals plus q_hi over its own past lo
+    kv0 = kv.reshape(2, B, H, 2, C, D)
+    lo = attend(zero_carry(), q_lo, kv0[0, :, :, 0], kv0[1, :, :, 0], tril)
+    hi = attend(zero_carry(), q_hi, kv0[0, :, :, 1], kv0[1, :, :, 1], tril)
+    hi = attend(hi, q_hi, kv0[0, :, :, 0], kv0[1, :, :, 0], ones)
+
+    def step(t, carry):
+        kv, lo, hi = carry
+        kv = jax.lax.ppermute(kv, axis_name, perm)
+        s = (idx - t) % n
+        kvz = kv.reshape(2, B, H, 2, C, D)
+        k_lo, v_lo = kvz[0, :, :, 0], kvz[1, :, :, 0]
+        k_hi, v_hi = kvz[0, :, :, 1], kvz[1, :, :, 1]
+        # q_hi (chunk 2n-1-idx) is later than every lo chunk (s ≤ n-1)
+        hi = attend(hi, q_hi, k_lo, v_lo, ones)
+        # exactly one of the remaining pairs is unmasked:
+        #   s < idx: q_lo (chunk idx) is past chunk s        → lo × kv_lo
+        #   s > idx: q_hi is past chunk 2n-1-s (s>idx ⇒ 2n-1-s < 2n-1-idx)
+        #            → hi × kv_hi
+        lo, hi = jax.lax.cond(
+            s < idx,
+            lambda lo, hi: (attend(lo, q_lo, k_lo, v_lo, ones), hi),
+            lambda lo, hi: (lo, attend(hi, q_hi, k_hi, v_hi, ones)),
+            lo, hi)
+        return kv, lo, hi
+
+    _, lo, hi = jax.lax.fori_loop(1, n, step, (kv, lo, hi))
+    out = jnp.stack([lo[2] / jnp.maximum(lo[1], 1e-30)[..., None],
+                     hi[2] / jnp.maximum(hi[1], 1e-30)[..., None]],
+                    axis=2)                        # [B, H, 2, C, D]
+    return out.reshape(B, H, S2, D).astype(q.dtype)
+
+
+def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp"):
+    """shard_map-wrapped zigzag ring attention for ``[B, H, S, D]`` arrays
+    whose S axis is sharded over ``axis_name`` in zigzag order (permute
+    with ``zigzag_indices`` before sharding, invert after)."""
+    batch = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch, None, axis_name, None)
+    fn = shard_map(
+        partial(zigzag_ring_attention, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn
+
+
 # --- sequence-parallel train step --------------------------------------------
 
 
