@@ -6,8 +6,16 @@ DeviceState → CDI spec write → checkpoint fsync), the node-local half of the
 BASELINE.md north-star "ResourceClaim → pod-Running p50".  The reference
 publishes no numbers (BASELINE.md), so ``vs_baseline`` is 1.0 by definition.
 
-Extra keys report TPU-side vitals measured on the real chip (MXU matmul
-TFLOP/s, and psum bandwidth when >1 device is visible).
+TPU sections run FIRST and each in its OWN SUBPROCESS with its own deadline
+(round-1 lesson: one wedged backend probe under a single global deadline
+erased every perf number — VERDICT.md "What's weak" 1).  A wedged section
+degrades to an ``<name>_error`` key; completed sections always survive.  The
+probe section is retried once.  Raw TFLOP/s are paired with ``*_mfu_pct``
+against the chip family's data-sheet peak (tpulib/topology.py FAMILIES) so
+"is this actually fast" is answerable from the output alone.
+
+Section mode (internal): ``python bench.py --section NAME`` runs one section
+and prints a single JSON object on the last stdout line.
 """
 
 from __future__ import annotations
@@ -15,12 +23,235 @@ from __future__ import annotations
 import json
 import os
 import statistics
+import subprocess
 import sys
 import tempfile
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
+# Per-section deadlines (seconds).  First backend init over the TPU tunnel
+# can take minutes; compute sections re-init the backend each (isolation
+# price) but reuse the compilation cache.
+_DEADLINES = {
+    "probe": 360,
+    "matmul": 300,
+    "pallas_matmul": 300,
+    "flash": 330,
+    "train": 420,
+    "visibility": 300,
+    "collectives": 300,
+}
+# Global TPU budget: sections still pending when it runs out are skipped
+# (recorded as skipped, not silently dropped).
+_TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", "1800"))
+
+
+def _family_of(device):
+    from tpu_dra.tpulib.topology import family_for_jax_device
+    return family_for_jax_device(device)
+
+
+def _mfu(tflops: float, device) -> float | None:
+    fam = _family_of(device)
+    if fam is None or not fam.peak_bf16_flops:
+        return None
+    return round(100.0 * tflops * 1e12 / fam.peak_bf16_flops, 2)
+
+
+# --- TPU sections (each runs in its own subprocess) --------------------------
+
+def section_probe() -> dict:
+    import jax
+    devices = jax.devices()
+    out = {
+        "tpu_devices": len(devices),
+        "tpu_platform": devices[0].platform,
+        "tpu_device_kind": getattr(devices[0], "device_kind", ""),
+    }
+    fam = _family_of(devices[0])
+    if fam is not None:
+        out["tpu_family"] = fam.name
+        out["tpu_peak_bf16_tflops"] = fam.peak_bf16_flops / 1e12
+    # prove the compute path end to end, not just enumeration
+    import jax.numpy as jnp
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    out["probe_matmul_ok"] = bool(jnp.isfinite(
+        jnp.sum((x @ x).astype(jnp.float32))))
+    return out
+
+
+def section_matmul() -> dict:
+    import jax
+    from tpu_dra.workloads.collectives import matmul_throughput
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        # CI smoke on CPU: a tiny matmul proves the path
+        return {"tpu_matmul_tflops": round(matmul_throughput(512, iters=3), 3)}
+    tflops = matmul_throughput(4096)
+    return {"tpu_matmul_tflops": round(tflops, 2),
+            "tpu_matmul_mfu_pct": _mfu(tflops, dev)}
+
+
+def section_pallas_matmul() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from tpu_dra.workloads.collectives import _time_op
+    from tpu_dra.workloads.pallas_kernels import matmul as pl_matmul
+    dev = jax.devices()[0]
+    n = 4096 if dev.platform == "tpu" else 512
+    iters = 200 if dev.platform == "tpu" else 3
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+    inv = jnp.bfloat16(1.0 / n)
+    interpret = dev.platform != "tpu"
+    secs = _time_op(lambda x: pl_matmul(x, b, interpret=interpret) * inv,
+                    a, iters=iters)
+    tflops = 2 * n**3 / secs / 1e12
+    return {"pallas_matmul_tflops": round(tflops, 2),
+            "pallas_matmul_mfu_pct": _mfu(tflops, dev)}
+
+
+def section_flash() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from tpu_dra.workloads.collectives import _time_op
+    from tpu_dra.workloads.pallas_kernels import flash_attention
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    bh, s, d = (8, 4096, 128) if on_tpu else (2, 512, 64)
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (1, bh, s, d), jnp.bfloat16)
+               for kk in ks)
+    secs = _time_op(
+        lambda x: flash_attention(x, k, v, causal=True, interpret=not on_tpu),
+        q, iters=100 if on_tpu else 2)
+    # causal: ~half the 4·BH·S²·D matmul flops are masked away
+    flops = 2 * bh * s * s * d
+    tflops = flops / secs / 1e12
+    return {"pallas_flash_tflops": round(tflops, 2),
+            "pallas_flash_mfu_pct": _mfu(tflops, dev)}
+
+
+def section_train() -> dict:
+    """Flagship train-step MFU on one chip — the "actually fast?" number
+    for the full fwd+bwd+update path (VERDICT next-round item 2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_dra.workloads.collectives import _time_op  # noqa: F401
+    from tpu_dra.workloads.train import (
+        ModelConfig, init_params, make_sharded_train_step)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg = (ModelConfig(vocab=32768, d_model=1024, n_heads=8, n_layers=8,
+                       d_ff=4096, max_seq=1024) if on_tpu else
+           ModelConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                       d_ff=128, max_seq=64))
+    batch, seq = (8, cfg.max_seq) if on_tpu else (2, cfg.max_seq)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    step, p_shard, b_shard = make_sharded_train_step(cfg, mesh)
+    params = jax.device_put(params, p_shard)
+    tokens = jax.device_put(
+        jnp.zeros((batch, seq), dtype=jnp.int32), b_shard)
+
+    params, loss = step(params, tokens)       # compile + warm
+    jax.block_until_ready(loss)
+    iters = 20 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, loss = step(params, tokens)
+    jax.block_until_ready((params, loss))
+    # host readback closes the async dispatch window on relayed backends
+    lossf = float(loss)
+    secs = (time.perf_counter() - t0) / iters
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    tokens_per_step = batch * (seq - 1)
+    flops = 6 * n_params * tokens_per_step    # fwd 2 + bwd 4 per param·token
+    tflops = flops / secs / 1e12
+    out = {
+        "train_step_tokens_per_s": round(tokens_per_step / secs, 1),
+        "train_step_tflops": round(tflops, 2),
+        "train_step_mfu_pct": _mfu(tflops, dev),
+        "train_params_m": round(n_params / 1e6, 2),
+        "train_loss_finite": bool(np.isfinite(lossf)),
+    }
+    return out
+
+
+def section_visibility() -> dict:
+    """Hardware validation of the CDI visibility env contract (VERDICT
+    next-round item 3): launch a subprocess with the env the driver would
+    inject for a 1-chip claim and assert the device set matches.
+
+    The parent deliberately never initializes a JAX backend: libtpu takes
+    exclusive chip ownership at init, which would make the child fail on
+    exactly the surface this section validates.  Presence of local chips is
+    decided from /dev alone.
+    """
+    from tpu_dra.tpulib.discovery import RealTpuLib
+    lib = RealTpuLib()
+    chips = lib.enumerate_chips()
+    if not lib.device_paths() or not chips:
+        return {
+            "visibility_ok": None,
+            "visibility_note": (
+                "no local /dev/accel* chips; env scoping is enforced by "
+                "libtpu against local devices, not by a relay backend — "
+                "validated only where the chips are local"),
+        }
+    env = dict(os.environ)
+    env.update(lib.visible_chips_env(chips[:1]))
+    code = ("import jax, json; "
+            "print(json.dumps({'n': len(jax.devices()), "
+            "'platform': jax.devices()[0].platform}))")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=240)
+    try:
+        seen = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {"visibility_ok": False,
+                "visibility_error": (proc.stderr or proc.stdout)[-300:]}
+    return {"visibility_ok": seen.get("n") == 1,
+            "visibility_seen_devices": seen.get("n"),
+            "visibility_child_platform": seen.get("platform")}
+
+
+def section_collectives() -> dict:
+    import jax
+    if len(jax.devices()) <= 1:
+        return {"collectives_skipped": "single device"}
+    from tpu_dra.workloads.collectives import (
+        all_gather_bandwidth, make_mesh, psum_bandwidth,
+        reduce_scatter_bandwidth)
+    mesh = make_mesh()
+    return {
+        "psum_gbps": round(psum_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
+        "all_gather_gbps": round(
+            all_gather_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
+        "reduce_scatter_gbps": round(
+            reduce_scatter_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
+    }
+
+
+_SECTIONS = {
+    "probe": section_probe,
+    "matmul": section_matmul,
+    "pallas_matmul": section_pallas_matmul,
+    "flash": section_flash,
+    "train": section_train,
+    "visibility": section_visibility,
+    "collectives": section_collectives,
+}
+
+
+# --- host-side sections (in-process; no TPU backend involved) ----------------
 
 def bench_prepare_latency(n_claims: int = 200) -> dict:
     import grpc
@@ -80,102 +311,98 @@ def bench_prepare_latency(n_claims: int = 200) -> dict:
     }
 
 
-def bench_tpu(out: dict | None = None) -> dict:
-    # `out` may be a shared dict mutated as sections complete, so a caller
-    # with a deadline keeps the sections that finished before a wedge
-    out = {} if out is None else out
-    try:
-        import jax
+def bench_real_discovery() -> dict:
+    """RealTpuLib on the bench machine's actual surface (VERDICT "What's
+    weak" 5: the real discovery path was never on a measured path)."""
+    from tpu_dra.tpulib.discovery import RealTpuLib
+    t0 = time.perf_counter()
+    lib = RealTpuLib()
+    chips = lib.enumerate_chips()
+    fabric = lib.fabric_id()
+    ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "discovery_real_ms": round(ms, 3),
+        "discovery_real_chips": len(chips),
+        "discovery_real_fabric": fabric,
+    }
 
-        from tpu_dra.workloads.collectives import (
-            make_mesh,
-            matmul_throughput,
-            psum_bandwidth,
-        )
-        devices = jax.devices()
-        out["tpu_devices"] = len(devices)
-        out["tpu_platform"] = devices[0].platform
-        if devices[0].platform != "tpu":
-            # CI smoke on CPU: a tiny matmul proves the path; the real
-            # numbers only mean something on the chip
-            out["tpu_matmul_tflops"] = round(matmul_throughput(512, iters=3),
-                                             3)
-            return out
-        out["tpu_matmul_tflops"] = round(matmul_throughput(4096), 2)
-        try:
-            from tpu_dra.workloads.collectives import _time_op
-            from tpu_dra.workloads.pallas_kernels import matmul as pl_matmul
-            import jax.numpy as jnp
-            n = 4096
-            a = jax.random.normal(jax.random.PRNGKey(0), (n, n),
-                                  jnp.bfloat16)
-            b = jax.random.normal(jax.random.PRNGKey(1), (n, n),
-                                  jnp.bfloat16)
-            inv = jnp.bfloat16(1.0 / n)
-            secs = _time_op(lambda x: pl_matmul(x, b) * inv, a, iters=200)
-            out["pallas_matmul_tflops"] = round(2 * n**3 / secs / 1e12, 2)
-        except Exception as exc:  # noqa: BLE001 — pallas is an extra
-            out["pallas_error"] = repr(exc)[:200]
-        try:
-            from tpu_dra.workloads.pallas_kernels import flash_attention
-            bh, s, d = 8, 4096, 128
-            ks = jax.random.split(jax.random.PRNGKey(2), 3)
-            q, k, v = (jax.random.normal(kk, (1, bh, s, d), jnp.bfloat16)
-                       for kk in ks)
-            secs = _time_op(
-                lambda x: flash_attention(x, k, v, causal=True), q,
-                iters=100)
-            # causal: ~half the 4·BH·S²·D matmul flops are masked away
-            flops = 2 * bh * s * s * d
-            out["pallas_flash_tflops"] = round(flops / secs / 1e12, 2)
-        except Exception as exc:  # noqa: BLE001
-            out["flash_error"] = repr(exc)[:200]
-        if len(devices) > 1:
-            from tpu_dra.workloads.collectives import (
-                all_gather_bandwidth,
-                reduce_scatter_bandwidth,
-            )
-            mesh = make_mesh()
-            res = psum_bandwidth(mesh)
-            out["psum_gbps"] = round(res.algo_bytes_per_s / 1e9, 2)
-            out["all_gather_gbps"] = round(
-                all_gather_bandwidth(mesh).algo_bytes_per_s / 1e9, 2)
-            out["reduce_scatter_gbps"] = round(
-                reduce_scatter_bandwidth(mesh).algo_bytes_per_s / 1e9, 2)
-    except Exception as exc:  # noqa: BLE001 — bench must still report
-        out["tpu_error"] = repr(exc)
+
+# --- orchestrator ------------------------------------------------------------
+
+def _run_section(name: str, deadline: float) -> dict:
+    """Run one section in a subprocess; merge its last-stdout-line JSON."""
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            capture_output=True, text=True, timeout=deadline, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {f"{name}_error": f"section exceeded {deadline:.0f}s "
+                                 "(tunnel down or backend wedged)",
+                f"{name}_secs": round(time.perf_counter() - t0, 1)}
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        err = (proc.stderr or proc.stdout or "no output").strip()
+        return {f"{name}_error": err[-400:],
+                f"{name}_secs": round(time.perf_counter() - t0, 1)}
+    try:
+        out = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {f"{name}_error": f"unparsable output: {lines[-1][:200]}"}
+    out[f"{name}_secs"] = round(time.perf_counter() - t0, 1)
     return out
 
 
-def bench_tpu_with_deadline(timeout_s: float = 480.0) -> dict:
-    """Run bench_tpu on a worker thread with a hard deadline.
+def run_tpu_sections() -> dict:
+    out: dict = {}
+    t_start = time.perf_counter()
 
-    The first jax backend probe blocks forever when the TPU tunnel is down;
-    the benchmark line must still be emitted (the driver records exactly one
-    JSON line per round), so a wedged TPU section degrades to an error key
-    instead of hanging the whole benchmark.
-    """
-    import threading
+    def budget_left() -> float:
+        return _TPU_BUDGET_S - (time.perf_counter() - t_start)
 
-    result: dict = {}
-    done = threading.Event()
+    # probe first, with one retry — it validates the tunnel for everything
+    res = _run_section("probe", _DEADLINES["probe"])
+    if "probe_error" in res and budget_left() > _DEADLINES["probe"]:
+        out["probe_retried"] = True
+        res = _run_section("probe", _DEADLINES["probe"])
+    out.update(res)
+    if "probe_error" in res:
+        out["tpu_error"] = res["probe_error"]
+        return out
 
-    def work() -> None:
-        bench_tpu(result)
-        done.set()
-
-    threading.Thread(target=work, daemon=True, name="bench-tpu").start()
-    if not done.wait(timeout_s):
-        # keep whatever sections completed before the wedge
-        return {**dict(result),
-                "tpu_error": f"TPU section exceeded {timeout_s:.0f}s "
-                             "(tunnel down or backend wedged)"}
-    return result
+    order = ["matmul", "pallas_matmul", "flash", "train", "visibility"]
+    if out.get("tpu_devices", 1) > 1:
+        order.append("collectives")
+    for name in order:
+        deadline = min(_DEADLINES[name], max(budget_left(), 0))
+        if deadline < 30:
+            out[f"{name}_skipped"] = "tpu budget exhausted"
+            continue
+        out.update(_run_section(name, deadline))
+    return out
 
 
 def main() -> None:
-    prep = bench_prepare_latency()
-    tpu = bench_tpu_with_deadline()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # honor an explicit CPU request before the first backend probe:
+            # the axon sitecustomize pins jax_platforms via jax.config
+            # (beating the env var), and the first jax.devices() would then
+            # block on the tunnel
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_SECTIONS[sys.argv[2]]()))
+        return
+    tpu = run_tpu_sections()          # TPU first: partials must survive
+    try:
+        prep = bench_prepare_latency()
+    except Exception as exc:  # noqa: BLE001 — bench must still report
+        prep = {"p50_ms": -1, "p95_ms": -1, "mean_ms": -1,
+                "prepare_error": repr(exc)[:300]}
+    try:
+        disc = bench_real_discovery()
+    except Exception as exc:  # noqa: BLE001
+        disc = {"discovery_error": repr(exc)[:300]}
     print(json.dumps({
         "metric": "claim_prepare_p50_latency",
         "value": round(prep["p50_ms"], 3),
@@ -183,6 +410,9 @@ def main() -> None:
         "vs_baseline": 1.0,
         "p95_ms": round(prep["p95_ms"], 3),
         "mean_ms": round(prep["mean_ms"], 3),
+        **{k: v for k, v in prep.items()
+           if k not in ("p50_ms", "p95_ms", "mean_ms")},
+        **disc,
         **tpu,
     }))
 

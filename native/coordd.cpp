@@ -21,6 +21,7 @@
 // (ProcessManager) exactly as the reference supervises nvidia-imex: restart
 // on membership change, watchdog restart on crash, SIGTERM stop.
 
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -271,6 +272,24 @@ class CoordState {
 
 // --- HTTP ------------------------------------------------------------------
 
+// Write the whole buffer, resuming across short writes (signal interrupt);
+// bails out on error or SO_SNDTIMEO expiry so a stalled client can't wedge
+// the accept loop past the socket timeout.
+bool WriteAll(int fd, const char* data, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = ::write(fd, data + off, len - off);
+    if (n > 0) {
+      off += (size_t)n;
+    } else if (n < 0 && errno == EINTR) {
+      continue;
+    } else {
+      return false;  // EAGAIN (send timeout), EPIPE, ...
+    }
+  }
+  return true;
+}
+
 void Respond(int fd, int code, const char* status, const std::string& body,
              const char* ctype = "text/plain") {
   char head[256];
@@ -278,8 +297,9 @@ void Respond(int fd, int code, const char* status, const std::string& body,
                      "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
                      "Content-Length: %zu\r\nConnection: close\r\n\r\n",
                      code, status, ctype, body.size());
-  (void)!::write(fd, head, n);
-  (void)!::write(fd, body.data(), body.size());
+  if (WriteAll(fd, head, (size_t)n)) {
+    WriteAll(fd, body.data(), body.size());
+  }
 }
 
 std::string QueryParam(const std::string& target, const std::string& key) {
@@ -302,10 +322,34 @@ std::string QueryParam(const std::string& target, const std::string& key) {
 }
 
 void Handle(int fd, CoordState* state) {
+  // Read until the end of the request headers ("\r\n\r\n"), bounded by the
+  // buffer AND a per-connection deadline: a request line split across TCP
+  // segments must not 405, but SO_RCVTIMEO only bounds each read() — a
+  // slow-drip client (1 byte per ~2s) would otherwise hold the sequential
+  // accept loop for minutes and starve probes.
+  constexpr long kConnDeadlineMs = 3000;
+  struct timespec t0;
+  ::clock_gettime(CLOCK_MONOTONIC, &t0);
   char buf[2048];
-  ssize_t n = ::read(fd, buf, sizeof(buf) - 1);
-  if (n <= 0) return;
-  buf[n] = '\0';
+  size_t total = 0;
+  while (total < sizeof(buf) - 1) {
+    ssize_t n = ::read(fd, buf + total, sizeof(buf) - 1 - total);
+    if (n < 0 && errno == EINTR) continue;
+    if (n <= 0) break;  // EOF, error, or receive timeout
+    total += (size_t)n;
+    buf[total] = '\0';
+    if (::strstr(buf, "\r\n\r\n") != nullptr ||
+        ::strstr(buf, "\n\n") != nullptr) {
+      break;
+    }
+    struct timespec now;
+    ::clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed_ms = (now.tv_sec - t0.tv_sec) * 1000 +
+                      (now.tv_nsec - t0.tv_nsec) / 1000000;
+    if (elapsed_ms > kConnDeadlineMs) break;
+  }
+  if (total == 0) return;
+  buf[total] = '\0';
   // request line: METHOD SP target SP version
   char method[16], target[1024];
   if (::sscanf(buf, "%15s %1023s", method, target) != 2 ||

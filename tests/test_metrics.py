@@ -129,3 +129,37 @@ def test_serve_from_flag_validation():
     assert serve_from_flag("") is None
     with pytest.raises(ValueError, match="expected host:port"):
         serve_from_flag("no-port")
+
+
+def test_cpu_profile_endpoint():
+    """/debug/pprof/profile analog (reference main.go:216-224): a busy
+    thread must show up in the collapsed-stack sample output."""
+    import threading
+    import time as _time
+
+    stop = threading.Event()
+
+    def burn():
+        # distinctive frame name for the profile to catch
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    t = threading.Thread(target=burn, name="burner", daemon=True)
+    t.start()
+    reg = Registry()
+    server = serve_http_endpoint("127.0.0.1", 0, registry=reg)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/profile"
+            "?seconds=0.3&hz=200", timeout=10).read().decode()
+    finally:
+        stop.set()
+        server.shutdown()
+    lines = body.strip().splitlines()
+    assert lines[0].startswith("# cpu profile:")
+    # every sample line parses as "stack count"
+    for ln in lines[1:]:
+        stack, count = ln.rsplit(" ", 1)
+        assert int(count) > 0 and stack
+    assert any("burn" in ln for ln in lines[1:]), body[:500]

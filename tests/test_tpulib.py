@@ -184,3 +184,65 @@ def test_mknod_rejected_for_unprivileged_or_creates(tmp_path):
         pytest.skip("mknod not permitted in this environment")
     assert os.path.exists(path)
     native.mknod_char(path, 1, 3)  # idempotent
+
+
+# --- ICI partition identity (VERDICT round-2 item 5) ------------------------
+
+def _multihost_env(**extra):
+    env = {"TPU_ACCELERATOR_TYPE": "v5litepod-16", "TPU_TOPOLOGY": "4x4",
+           "TPU_WORKER_ID": "0",
+           "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3"}
+    env.update(extra)
+    return env
+
+
+def test_fabric_partition_from_megascale_slice(tmp_path):
+    """Multislice: each slice is its own ICI partition; the deployment-wide
+    coordinator address is the cluster identity (clusterUUID.cliqueId
+    analog, CD nvlib.go:164-222)."""
+    from tpu_dra.tpulib.discovery import RealTpuLib
+    make_driver_root(tmp_path)
+    s0 = RealTpuLib(driver_root=str(tmp_path), env=_multihost_env(
+        MEGASCALE_SLICE_ID="0", MEGASCALE_COORDINATOR_ADDRESS="coord:8080"))
+    s1 = RealTpuLib(driver_root=str(tmp_path), env=_multihost_env(
+        MEGASCALE_SLICE_ID="1", MEGASCALE_COORDINATOR_ADDRESS="coord:8080"))
+    assert s0.fabric_id().endswith(".0")
+    assert s1.fabric_id().endswith(".1")
+    # same deployment uuid, different partitions -> not ICI-reachable
+    assert s0.fabric_id().split(".")[0] == s1.fabric_id().split(".")[0]
+    assert s0.fabric_id() != s1.fabric_id()
+
+
+def test_fabric_partition_explicit_override(tmp_path):
+    from tpu_dra.tpulib.discovery import RealTpuLib
+    make_driver_root(tmp_path)
+    lib = RealTpuLib(driver_root=str(tmp_path),
+                     env=_multihost_env(TPU_PARTITION_ID="3"))
+    assert lib.fabric_id().endswith(".3")
+    assert lib.partition_id() == 3
+
+
+def test_fabric_mixed_partition_rejected(tmp_path):
+    """Conflicting partition signals are a hard error, like the reference's
+    mixed-clique rejection (CD nvlib.go:164-222)."""
+    import pytest
+    from tpu_dra.tpulib.discovery import RealTpuLib
+    make_driver_root(tmp_path)
+    lib = RealTpuLib(driver_root=str(tmp_path), env=_multihost_env(
+        TPU_PARTITION_ID="1", MEGASCALE_SLICE_ID="2"))
+    with pytest.raises(RuntimeError, match="mixed ICI partitions"):
+        lib.fabric_id()
+    # agreeing signals are fine
+    ok = RealTpuLib(driver_root=str(tmp_path), env=_multihost_env(
+        TPU_PARTITION_ID="2", MEGASCALE_SLICE_ID="2"))
+    assert ok.partition_id() == 2
+
+
+def test_fabric_malformed_partition_rejected(tmp_path):
+    import pytest
+    from tpu_dra.tpulib.discovery import RealTpuLib
+    make_driver_root(tmp_path)
+    lib = RealTpuLib(driver_root=str(tmp_path), env=_multihost_env(
+        MEGASCALE_SLICE_ID="banana"))
+    with pytest.raises(RuntimeError, match="malformed partition"):
+        lib.fabric_id()

@@ -161,7 +161,9 @@ class RealTpuLib(TpuLib):
         # explicit env wins over the metadata file
         for key in ("TPU_ACCELERATOR_TYPE", "TPU_TOPOLOGY", "TPU_WORKER_ID",
                     "TPU_WORKER_HOSTNAMES", "TPU_SLICE_NAME",
-                    "TPU_SKIP_MDS_QUERY"):
+                    "TPU_SKIP_MDS_QUERY", "TPU_PARTITION_ID",
+                    "MEGASCALE_SLICE_ID", "MEGASCALE_NUM_SLICES",
+                    "MEGASCALE_COORDINATOR_ADDRESS"):
             if key in self.env:
                 meta[key] = self.env[key]
         meta.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
@@ -224,15 +226,48 @@ class RealTpuLib(TpuLib):
             ))
         return chips
 
+    def partition_id(self) -> int:
+        """ICI-partition index of this host's chips within the fabric.
+
+        The TPU analog of the reference's per-GPU cliqueId
+        (CD nvlib.go:164-222): in a multislice deployment each slice is its
+        own ICI partition (slices interconnect over DCN, not ICI), surfaced
+        as ``MEGASCALE_SLICE_ID``; ``TPU_PARTITION_ID`` is the explicit
+        override for sub-slice/reservation partitioning.  Like the reference
+        errors when one host's GPUs report different cliques, conflicting
+        partition signals are a hard error — a wrong partition silently
+        merges ICI-unreachable nodes into one domain.
+        """
+        meta = self._metadata()
+        sources = {k: meta[k]
+                   for k in ("TPU_PARTITION_ID", "MEGASCALE_SLICE_ID")
+                   if meta.get(k, "") != ""}
+        values = set()
+        for key, raw in sources.items():
+            try:
+                values.add(int(raw))
+            except ValueError as exc:
+                raise RuntimeError(
+                    f"malformed partition id {key}={raw!r}") from exc
+        if len(values) > 1:
+            raise RuntimeError(
+                f"host reports mixed ICI partitions: {sources} — chips on "
+                f"one host must all belong to one partition")
+        return values.pop() if values else 0
+
     def fabric_id(self) -> str:
         meta = self._metadata()
         hostnames = meta.get("TPU_WORKER_HOSTNAMES", "")
         if not hostnames or len(hostnames.split(",")) <= 1:
             return ""  # single-host: not multi-host-ICI capable
-        slice_name = meta.get("TPU_SLICE_NAME") or hostnames
-        slice_uuid = uuidlib.uuid5(_UUID_NS, slice_name)
-        # partition 0: GKE slices are a single ICI partition today
-        return f"{slice_uuid}.0"
+        # Fabric identity = <deployment-uuid>.<partition> mirroring the
+        # reference's clusterUUID.cliqueId.  For multislice the deployment
+        # spans all slices (coordinator address is deployment-unique); the
+        # partition index separates the per-slice ICI domains within it.
+        cluster_name = (meta.get("MEGASCALE_COORDINATOR_ADDRESS")
+                        or meta.get("TPU_SLICE_NAME") or hostnames)
+        slice_uuid = uuidlib.uuid5(_UUID_NS, cluster_name)
+        return f"{slice_uuid}.{self.partition_id()}"
 
     def worker_id(self) -> int:
         return int(self._metadata().get("TPU_WORKER_ID", "0"))

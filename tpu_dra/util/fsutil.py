@@ -8,18 +8,27 @@ import os
 def atomic_write(path: str, data: str, durable: bool = True) -> None:
     """Write-then-rename: readers never see a torn file.
 
-    ``durable=True`` (default) fdatasyncs before the rename so the content
-    has hit disk when the call returns — required for the checkpoint, which
-    is the prepare transaction's commit point.  Pass ``durable=False`` for
-    files that are merely *regenerable* state (e.g. per-claim CDI specs,
-    which idempotent prepare rewrites after a crash): atomicity is kept,
-    the sync — the dominant cost of the prepare hot path — is skipped.
+    ``durable=True`` (default) fdatasyncs the file before the rename and
+    fsyncs the parent directory after it, so both the content and the rename
+    itself have hit disk when the call returns — required for the
+    checkpoint, which is the prepare transaction's commit point.  Pass
+    ``durable=False`` for files that are merely *regenerable* state (e.g.
+    per-claim CDI specs, which idempotent prepare rewrites after a crash):
+    atomicity is kept, the syncs — the dominant cost of the prepare hot
+    path — are skipped.
     """
     tmp = f"{path}.tmp.{os.getpid()}"
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
     with open(tmp, "w") as f:
         f.write(data)
         if durable:
             f.flush()
             os.fdatasync(f.fileno())
     os.replace(tmp, path)
+    if durable:
+        dfd = os.open(parent, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)

@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
 
 
 class Counter:
@@ -153,6 +155,42 @@ def _stacks_dump() -> str:
     return "\n".join(out)
 
 
+def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
+    """pprof-profile analog (reference compute-domain-controller
+    main.go:216-224): statistical CPU profile over a window.
+
+    Samples every thread's stack at ``hz`` via ``sys._current_frames`` (no
+    signals — works off the main thread, unlike ``signal.setitimer``) and
+    returns collapsed-stack text: ``frame;frame;frame count`` per line,
+    most-sampled first — directly consumable by flamegraph tooling and
+    trivially parsable by tests.
+    """
+    interval = 1.0 / max(hz, 1)
+    counts: dict[str, int] = {}
+    me = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    n_samples = 0
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue   # don't profile the profiler
+            stack = []
+            while frame is not None:
+                code = frame.f_code
+                stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                             f"{frame.f_lineno}:{code.co_name}")
+                frame = frame.f_back
+            key = ";".join(reversed(stack))
+            counts[key] = counts.get(key, 0) + 1
+        n_samples += 1
+        time.sleep(interval)
+    lines = [f"# cpu profile: {n_samples} samples @ {hz}Hz over "
+             f"{seconds:.1f}s (collapsed stacks)"]
+    for key, c in sorted(counts.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{key} {c}")
+    return "\n".join(lines) + "\n"
+
+
 def serve_from_flag(endpoint: str, **kwargs) -> Optional[ThreadingHTTPServer]:
     """Parse a ``host:port`` / ``:port`` flag value and serve; empty = off.
     A port-less value is a configuration error, reported as such."""
@@ -180,6 +218,18 @@ def serve_http_endpoint(
             if self.path == metrics_path:
                 body = reg.expose().encode()
                 ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith(pprof_path + "/profile"):
+                qs = parse_qs(urlparse(self.path).query)
+                try:
+                    secs = min(float(qs.get("seconds", ["5"])[0]), 30.0)
+                    hz = min(int(qs.get("hz", ["100"])[0]), 1000)
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    self.wfile.write(b"bad seconds/hz query param")
+                    return
+                body = cpu_profile(secs, hz).encode()
+                ctype = "text/plain"
             elif self.path.startswith(pprof_path):
                 body = _stacks_dump().encode()
                 ctype = "text/plain"

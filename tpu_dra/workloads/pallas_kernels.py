@@ -209,6 +209,12 @@ def flash_attention(q, k, v, *, causal: bool = True, bq: int = 512,
     the per-device kernel; the ring handles the sequence-sharded case.
     """
     b, h, s, d = q.shape
+    if causal and k.shape[2] != s:
+        # the mask uses start-aligned indices; unequal lengths would give
+        # non-standard causal semantics silently
+        raise ValueError(
+            f"causal flash_attention requires equal q/k lengths, "
+            f"got q seq {s} vs k seq {k.shape[2]}")
     fold = lambda x: x.reshape(b * h, x.shape[2], d)
     out = _flash_attn(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
     return out.reshape(b, h, s, d)
