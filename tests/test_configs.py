@@ -195,3 +195,33 @@ def test_decode_rejects_unknown_fields():
 def test_decode_rejects_malformed_json():
     with pytest.raises(ConfigError, match="malformed"):
         decode(b"{not json")
+
+
+def test_scheduling_priority_roundtrip_and_validation():
+    """schedulingPriority — the TimeSlicing-interval analog
+    (reference sharing.go:168-180)."""
+    GV = GROUP_VERSION
+
+    cfg = TpuConfig.from_dict({
+        "apiVersion": GV, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess",
+                    "multiProcess": {"schedulingPriority": "Low"}}})
+    cfg.normalize()
+    cfg.validate()
+    assert cfg.sharing.multi_process.scheduling_priority == "Low"
+    assert cfg.to_dict()["sharing"]["multiProcess"][
+        "schedulingPriority"] == "Low"
+    # Default is elided from the wire form
+    cfg2 = TpuConfig.from_dict({
+        "apiVersion": GV, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess", "multiProcess": {}}})
+    cfg2.normalize()
+    assert "schedulingPriority" not in cfg2.to_dict()["sharing"].get(
+        "multiProcess", {})
+
+    bad = TpuConfig.from_dict({
+        "apiVersion": GV, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess",
+                    "multiProcess": {"schedulingPriority": "Turbo"}}})
+    with pytest.raises(ConfigError, match="schedulingPriority"):
+        bad.validate()

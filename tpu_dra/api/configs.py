@@ -58,23 +58,37 @@ def _check_unknown(data: dict, allowed: set[str], ctx: str) -> None:
         raise ConfigError(f"{ctx}: unknown field(s) {sorted(unknown)}")
 
 
+SCHEDULING_PRIORITIES = ("Default", "Low", "Normal", "High")
+
+
 @dataclass
 class TpuMultiProcessConfig:
-    """MultiProcess sharing knobs — analog of MpsConfig (sharing.go:63-89)."""
+    """MultiProcess sharing knobs — analog of MpsConfig (sharing.go:63-89).
+
+    ``scheduling_priority`` is the user-facing control that replaces the
+    reference's TimeSlicing interval (sharing.go:168-180): TPU chips have no
+    scheduler time-slice knob, but co-resident processes contend on the
+    host-side dispatch path, and the launcher maps this hint to OS process
+    priority (``workloads/launcher.py apply_scheduling_priority``) — Low
+    niceness for background jobs, elevated for latency-sensitive ones.
+    """
 
     max_processes: Optional[int] = None
     # "*" | "<chip index>" | "<chip uuid>" -> quantity string
     hbm_limit_per_process: dict[str, str] = field(default_factory=dict)
+    scheduling_priority: str = "Default"
 
     @classmethod
     def from_dict(cls, data: dict, ctx: str = "multiProcess"):
-        _check_unknown(data, {"maxProcesses", "hbmLimitPerProcess"}, ctx)
+        _check_unknown(data, {"maxProcesses", "hbmLimitPerProcess",
+                              "schedulingPriority"}, ctx)
         limits = data.get("hbmLimitPerProcess") or {}
         if not isinstance(limits, dict):
             raise ConfigError(f"{ctx}.hbmLimitPerProcess: expected a map")
         return cls(
             max_processes=data.get("maxProcesses"),
             hbm_limit_per_process={str(k): str(v) for k, v in limits.items()},
+            scheduling_priority=data.get("schedulingPriority", "Default"),
         )
 
     def to_dict(self) -> dict:
@@ -83,6 +97,8 @@ class TpuMultiProcessConfig:
             out["maxProcesses"] = self.max_processes
         if self.hbm_limit_per_process:
             out["hbmLimitPerProcess"] = dict(self.hbm_limit_per_process)
+        if self.scheduling_priority != "Default":
+            out["schedulingPriority"] = self.scheduling_priority
         return out
 
     def normalized_limits(
@@ -162,6 +178,11 @@ class TpuSharing:
                 raise ConfigError(
                     f"multiProcess.maxProcesses {mp.max_processes} outside "
                     f"[1, 64]")
+            if mp.scheduling_priority not in SCHEDULING_PRIORITIES:
+                raise ConfigError(
+                    f"multiProcess.schedulingPriority "
+                    f"{mp.scheduling_priority!r}: valid values "
+                    f"{SCHEDULING_PRIORITIES}")
             for key, val in mp.hbm_limit_per_process.items():
                 if key != "*" and not _INDEX_RE.match(key) and \
                         not _UUID_RE.match(key):

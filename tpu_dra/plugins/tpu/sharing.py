@@ -14,9 +14,15 @@ Driver env contract emitted for MultiProcess claims:
   libtpu against the same chip set.
 - ``TPU_MULTIPROCESS_MAX=<n>`` — advisory process cap (maxProcesses).
 - ``TPU_HBM_LIMIT_BYTES_<minor>=<bytes>`` — per-chip HBM budget each process
-  must respect (JAX: wired through ``TPU_PREMAPPED_BUFFER_SIZE`` /
-  ``XLA_TPU_MAX_HBM`` shims by the workload launcher); the analog of MPS
-  pinned-device-memory limits (sharing.go:190-273).
+  must respect; the workload launcher maps it onto the real libtpu bound
+  (``workloads/launcher.py apply_hbm_limits`` appends
+  ``--xla_tpu_max_hbm_size_mib`` to ``LIBTPU_INIT_ARGS``, a flag the
+  shipped libtpu exports).  Analog of MPS pinned-device-memory limits
+  (sharing.go:190-273).
+- ``TPU_PROCESS_PRIORITY=<Low|Normal|High>`` — the TimeSlicing-interval
+  analog (sharing.go:168-180): mapped by the launcher to OS scheduling
+  priority of the dispatch process
+  (``launcher.py apply_scheduling_priority``).
 """
 
 from __future__ import annotations
@@ -50,6 +56,8 @@ class MultiProcessManager:
             return edits
         if mp.max_processes is not None:
             edits.env["TPU_MULTIPROCESS_MAX"] = str(mp.max_processes)
+        if mp.scheduling_priority != "Default":
+            edits.env["TPU_PROCESS_PRIORITY"] = mp.scheduling_priority
         if mp.hbm_limit_per_process:
             uuids = [d.uuid for d in devices]
             indices = {d.uuid: d.chip.index for d in devices}
