@@ -80,7 +80,7 @@ class DeviceState:
         # carry their parent chip's device nodes)
         self.cdi.create_standard_spec(
             [d.chip or d.core for d in self.allocatable.values()])
-        self.mp_manager = MultiProcessManager()
+        self.mp_manager = MultiProcessManager(slots_root=cfg.plugin_dir)
         self.checkpoint = Checkpoint(f"{cfg.plugin_dir}/checkpoint.json")
         if not self.checkpoint.load():
             self.checkpoint.save()  # create-if-missing, device_state.go:94-125
@@ -90,6 +90,10 @@ class DeviceState:
             if uid not in self.checkpoint.prepared:
                 klog.warning("removing orphaned claim CDI spec", claim=uid)
                 self.cdi.delete_claim_spec(uid)
+        for name in self.mp_manager.reconcile(
+                set(self.checkpoint.prepared)):
+            klog.warning("removed orphaned multiprocess slot dir",
+                         dir=name)
 
     # -- public API --------------------------------------------------------
     def prepare(self, claim: dict) -> list[PreparedDevice]:
@@ -131,6 +135,7 @@ class DeviceState:
                 klog.info("unprepare: no checkpoint entry; no-op", level=4,
                           claim=claim_uid)
                 return
+            self.mp_manager.cleanup(claim_uid)
             self.cdi.delete_claim_spec(claim_uid)
             self.checkpoint.remove(claim_uid)
 
@@ -224,7 +229,7 @@ class DeviceState:
             devices = [self._lookup(r) for r in state.results]
             all_devices.extend(devices)
             self._check_profile(config, devices)
-            edits = self._group_edits(config, devices)
+            edits = self._group_edits(config, devices, uid)
             for dev, result in zip(devices, state.results):
                 name = dev.canonical_name()
                 prepared.append(PreparedDevice(
@@ -251,8 +256,8 @@ class DeviceState:
             f"core {core.uuid}: parent chip {core.parent_uuid} not "
             f"allocatable on this node")
 
-    def _group_edits(self, config, devices: list[AllocatableDevice]
-                     ) -> ContainerEdits:
+    def _group_edits(self, config, devices: list[AllocatableDevice],
+                     claim_uid: str = "") -> ContainerEdits:
         """CDI edits for one config group (the normalized ``config``).
 
         ``TPU_VISIBLE_CHIPS`` always carries chip **minors** (the device-node
@@ -275,7 +280,8 @@ class DeviceState:
                                                       c.core_index)))
         sharing = getattr(config, "sharing", None)
         if sharing is not None and sharing.is_multi_process():
-            edits = edits.merge(self.mp_manager.apply(sharing, devices))
+            edits = edits.merge(
+                self.mp_manager.apply(sharing, devices, claim_uid))
         if self.fabric_id:
             edits.env["TPU_FABRIC_ID"] = self.fabric_id
         return edits

@@ -5,14 +5,19 @@ two managers: TimeSlicing (exec nvidia-smi, sharing.go:98-123) and MPS (a
 spawned control-daemon Deployment, sharing.go:186-444).  On TPU neither
 mechanism exists — multi-process sharing is env/flag mechanics against libtpu
 (SURVEY.md §7.3: "prefer env/flag mechanics; no MPS-daemon-style sidecar
-should be needed"), so the manager here only computes container edits; there
-is no sidecar lifecycle to supervise.
+should be needed"), so there is no sidecar lifecycle to supervise; the
+manager computes container edits plus, for capped claims, a host-side slot
+directory that makes the cap enforceable.
 
 Driver env contract emitted for MultiProcess claims:
 
 - ``TPU_ALLOW_MULTIPLE_LIBTPU_LOAD=1`` — allow several processes to load
   libtpu against the same chip set.
-- ``TPU_MULTIPROCESS_MAX=<n>`` — advisory process cap (maxProcesses).
+- ``TPU_MULTIPROCESS_MAX=<n>`` — process cap (maxProcesses), **enforced**
+  via a flock slot pool when set: the manager creates a per-claim-group
+  slot dir (bind-mounted at ``TPU_MULTIPROCESS_SLOT_DIR``) and the
+  workload launcher must hold one ``slot-<i>.lock`` before touching the
+  chip (``workloads/launcher.py acquire_multiprocess_slot``).
 - ``TPU_HBM_LIMIT_BYTES_<minor>=<bytes>`` — per-chip HBM budget each process
   must respect; the workload launcher maps it onto the real libtpu bound
   (``workloads/launcher.py apply_hbm_limits`` appends
@@ -27,17 +32,48 @@ Driver env contract emitted for MultiProcess claims:
 
 from __future__ import annotations
 
+import hashlib
+import os
+import shutil
+from typing import Optional
+
 from tpu_dra.api.configs import ConfigError, TpuSharing
 from tpu_dra.cdi.spec import ContainerEdits
 from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP, AllocatableDevice
+from tpu_dra.util.fsutil import atomic_write
+
+# container-side base path of the per-claim-group slot dirs (the
+# CUDA_MPS_PIPE_DIRECTORY analog, sharing.go:348-368)
+SLOT_DIR_CONTAINER_PATH = "/var/run/tpu-mp"
+
+
+def _group_id(claim_uid: str, uuids: list[str]) -> str:
+    """claimUID + sha256(sorted uuids)[:5] — the reference's per-config MPS
+    daemon ID scheme (sharing.go:186-289)."""
+    digest = hashlib.sha256(",".join(sorted(uuids)).encode()).hexdigest()
+    return f"{claim_uid}-{digest[:5]}"
 
 
 class MultiProcessManager:
     """Computes MultiProcess sharing edits — the MpsManager analog
-    (sharing.go:52-56,125-156) minus daemon lifecycle."""
+    (sharing.go:52-56,125-156).
+
+    Unlike round 1 this is no longer env-advisory-only: when
+    ``maxProcesses`` is set, a per-claim **slot directory** is created under
+    the plugin dir and bind-mounted at ``/var/run/tpu-mp``; the workload
+    launcher acquires a ``flock``-held slot file inside it before touching
+    the chip (``workloads/launcher.py acquire_multiprocess_slot``), so a
+    process beyond the cap fails loudly instead of silently oversubscribing
+    — the enforcement analog of the MPS control daemon's client gate
+    (sharing.go:291-346), without a sidecar to supervise.
+    """
+
+    def __init__(self, slots_root: Optional[str] = None):
+        self.slots_root = slots_root
 
     def apply(self, sharing: TpuSharing,
-              devices: list[AllocatableDevice]) -> ContainerEdits:
+              devices: list[AllocatableDevice],
+              claim_uid: str = "") -> ContainerEdits:
         """Validate applicability and return the sharing env edits.
 
         Full chips only, mirroring TimeSlicing's full-GPU-only rule
@@ -56,6 +92,20 @@ class MultiProcessManager:
             return edits
         if mp.max_processes is not None:
             edits.env["TPU_MULTIPROCESS_MAX"] = str(mp.max_processes)
+            if self.slots_root and claim_uid:
+                # one slot pool per (claim, device group): same ID scheme as
+                # the reference's per-config MPS daemon, claimUID +
+                # sha256(uuids)[:5] (sharing.go:186-289) — two MultiProcess
+                # groups in one claim must not share a pool or a max
+                group = _group_id(claim_uid, [d.uuid for d in devices])
+                host_dir = os.path.join(self.slots_root, "mp-slots", group)
+                os.makedirs(host_dir, exist_ok=True)
+                atomic_write(os.path.join(host_dir, "max"),
+                             str(mp.max_processes), durable=False)
+                container_dir = f"{SLOT_DIR_CONTAINER_PATH}/{group}"
+                edits.add_mount(host_dir, container_dir,
+                                options=["rw", "nosuid", "nodev", "bind"])
+                edits.env["TPU_MULTIPROCESS_SLOT_DIR"] = container_dir
         if mp.scheduling_priority != "Default":
             edits.env["TPU_PROCESS_PRIORITY"] = mp.scheduling_priority
         if mp.hbm_limit_per_process:
@@ -67,3 +117,40 @@ class MultiProcessManager:
                 edits.env[f"TPU_HBM_LIMIT_BYTES_{minor_of[uuid]}"] = \
                     str(limit)
         return edits
+
+    def _slots_base(self) -> str:
+        return os.path.join(self.slots_root or "", "mp-slots")
+
+    def cleanup(self, claim_uid: str) -> None:
+        """Remove the claim's slot pools on unprepare (the MpsControlDaemon
+        Stop/teardown analog, sharing.go:370-405)."""
+        if not (self.slots_root and claim_uid):
+            return
+        base = self._slots_base()
+        try:
+            entries = os.listdir(base)
+        except FileNotFoundError:
+            return
+        for name in entries:
+            if name == claim_uid or name.startswith(f"{claim_uid}-"):
+                shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+
+    def reconcile(self, live_claim_uids: set[str]) -> list[str]:
+        """Sweep slot dirs whose claim is not checkpointed (crash between
+        dir creation and checkpoint.put leaks them otherwise) — the same
+        orphan reconciliation the CDI claim specs get at startup.  Returns
+        the removed dir names."""
+        if not self.slots_root:
+            return []
+        base = self._slots_base()
+        try:
+            entries = os.listdir(base)
+        except FileNotFoundError:
+            return []
+        removed = []
+        for name in entries:
+            uid = name.rsplit("-", 1)[0]
+            if uid not in live_claim_uids and name not in live_claim_uids:
+                shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+                removed.append(name)
+        return removed

@@ -26,9 +26,11 @@ class RendezvousInfo:
 
     def initialize(self) -> None:
         """Call ``jax.distributed.initialize`` with the resolved triple.
-        Driver-injected HBM limits are applied first (they must land in
-        ``LIBTPU_INIT_ARGS`` before the backend initializes), then the
-        scheduling-priority hint."""
+        Every driver-injected resource contract is applied first: the
+        MultiProcess slot gate (fail fast before any backend work), the HBM
+        bound (must land in ``LIBTPU_INIT_ARGS`` before libtpu init), and
+        the scheduling-priority hint."""
+        acquire_multiprocess_slot()
         apply_hbm_limits()
         apply_scheduling_priority()
         import jax
@@ -107,6 +109,54 @@ def apply_hbm_limits(env: Optional[dict[str, str]] = None,
     return limit_bytes
 
 
+# process-lifetime holders for acquired slot locks (fd must stay open)
+_HELD_SLOTS: list[int] = []
+
+
+def acquire_multiprocess_slot(env: Optional[dict[str, str]] = None
+                              ) -> Optional[int]:
+    """Acquire one process slot of a MultiProcess-shared chip claim.
+
+    The driver's MultiProcess edits mount a per-claim slot dir at
+    ``TPU_MULTIPROCESS_SLOT_DIR`` with a ``max`` file
+    (plugins/tpu/sharing.py).  Each co-resident process must hold exactly
+    one ``flock(LOCK_EX)``'d slot file; the lock is held for the process
+    lifetime and released by the kernel on exit (crash included), so slots
+    can never leak.  Exceeding ``maxProcesses`` raises instead of silently
+    oversubscribing the chip — the enforcement analog of the MPS control
+    daemon's client gate (reference sharing.go:291-346).
+
+    Returns the acquired slot index, or None when the claim is not
+    slot-managed (no slot dir env).
+    """
+    import fcntl
+    e = os.environ if env is None else env
+    slot_dir = e.get("TPU_MULTIPROCESS_SLOT_DIR", "")
+    if not slot_dir or not os.path.isdir(slot_dir):
+        return None
+    try:
+        with open(os.path.join(slot_dir, "max")) as f:
+            max_procs = int(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        max_procs = int(e.get("TPU_MULTIPROCESS_MAX", "1"))
+    for slot in range(max_procs):
+        fd = os.open(os.path.join(slot_dir, f"slot-{slot}.lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            continue
+        os.ftruncate(fd, 0)   # clear a crashed holder's longer pid
+        os.write(fd, f"{os.getpid()}\n".encode())
+        _HELD_SLOTS.append(fd)   # keep open: lock lives with the process
+        return slot
+    raise RuntimeError(
+        f"all {max_procs} process slots of this MultiProcess claim are "
+        f"held (TPU_MULTIPROCESS_MAX={max_procs}); refusing to "
+        f"oversubscribe the chip")
+
+
 _PRIORITY_NICE = {"Low": 10, "Normal": 0, "High": -5}
 
 
@@ -130,6 +180,18 @@ def apply_scheduling_priority(env: Optional[dict[str, str]] = None
         return delta
     except OSError:
         return None
+
+
+def init_tpu_workload(env: Optional[dict[str, str]] = None) -> dict:
+    """Apply every driver-injected resource contract, in dependency order:
+    slot gate (fail fast before any backend work), HBM bound (must precede
+    libtpu init), scheduling priority.  The one call a claimed container
+    makes before importing jax; returns what was applied."""
+    return {
+        "slot": acquire_multiprocess_slot(env),
+        "hbm_limit_bytes": apply_hbm_limits(env),
+        "nice": apply_scheduling_priority(env),
+    }
 
 
 def _coordinator_port(env: Optional[dict] = None) -> int:
