@@ -197,10 +197,20 @@ def test_apply_scheduling_priority_nice(monkeypatch):
     calls = []
     monkeypatch.setattr(launcher.os, "nice",
                         lambda d: calls.append(d) or 0)
+
+    def fresh():
+        launcher._PRIORITY_APPLIED = False
+
+    fresh()
     assert launcher.apply_scheduling_priority(
         {"TPU_PROCESS_PRIORITY": "Low"}) == 10
+    # once applied, re-entry is a no-op (no double renice)
+    assert launcher.apply_scheduling_priority(
+        {"TPU_PROCESS_PRIORITY": "Low"}) is None
+    fresh()
     assert launcher.apply_scheduling_priority(
         {"TPU_PROCESS_PRIORITY": "High"}) == -5
+    fresh()
     assert launcher.apply_scheduling_priority({}) is None
     assert launcher.apply_scheduling_priority(
         {"TPU_PROCESS_PRIORITY": "Normal"}) is None
@@ -210,8 +220,10 @@ def test_apply_scheduling_priority_nice(monkeypatch):
     def eperm(_):
         raise OSError("EPERM")
     monkeypatch.setattr(launcher.os, "nice", eperm)
+    fresh()
     assert launcher.apply_scheduling_priority(
         {"TPU_PROCESS_PRIORITY": "High"}) is None
+    launcher._PRIORITY_APPLIED = False
 
 
 def test_multiprocess_manager_emits_priority_env():
@@ -249,52 +261,82 @@ def test_multiprocess_slot_enforcement(tmp_path):
         "strategy": "MultiProcess", "multiProcess": {"maxProcesses": 2}})
     edits = mgr.apply(sharing, devices, claim_uid="uid-1")
 
-    # pool ID = claimUID + sha256(uuids)[:5], the reference's per-config
-    # MPS daemon scheme (sharing.go:186-289)
-    container_dir = edits.env["TPU_MULTIPROCESS_SLOT_DIR"]
-    assert container_dir.startswith("/var/run/tpu-mp/uid-1-")
-    group = container_dir.rsplit("/", 1)[-1]
+    # env points at the BASE dir (identical across groups, so containerd
+    # env merge cannot clobber); each pool is mounted under it with ID =
+    # claimUID + sha256(uuids)[:5], the reference's per-config MPS daemon
+    # scheme (sharing.go:186-289)
+    assert edits.env["TPU_MULTIPROCESS_SLOT_DIR"] == "/var/run/tpu-mp"
+    mount = [m for m in edits.mounts
+             if m["containerPath"].startswith("/var/run/tpu-mp/uid-1-")]
+    assert mount
+    group = mount[0]["containerPath"].rsplit("/", 1)[-1]
     host_dir = tmp_path / "mp-slots" / group
     assert (host_dir / "max").read_text() == "2"
-    mount = [m for m in edits.mounts if m["containerPath"] == container_dir]
-    assert mount and mount[0]["hostPath"] == str(host_dir)
+    assert mount[0]["hostPath"] == str(host_dir)
     assert "rw" in mount[0]["options"]
 
     # a second group (different device set) of the same claim gets its own
-    # pool with its own max — no conflation
+    # pool with its own max — no conflation, and the SAME (mergeable) env
     chips2 = FakeTpuLib().enumerate_chips()[1:2]
     sharing4 = TpuSharing.from_dict({
         "strategy": "MultiProcess", "multiProcess": {"maxProcesses": 4}})
     edits2 = mgr.apply(sharing4, [AllocatableDevice(chip=chips2[0])],
                        claim_uid="uid-1")
-    dir2 = edits2.env["TPU_MULTIPROCESS_SLOT_DIR"]
-    assert dir2 != container_dir
-    group2 = dir2.rsplit("/", 1)[-1]
+    assert edits2.env["TPU_MULTIPROCESS_SLOT_DIR"] == "/var/run/tpu-mp"
+    mount2 = [m for m in edits2.mounts
+              if m["containerPath"].startswith("/var/run/tpu-mp/uid-1-")]
+    group2 = mount2[0]["containerPath"].rsplit("/", 1)[-1]
+    assert group2 != group
     assert (tmp_path / "mp-slots" / group2 / "max").read_text() == "4"
     assert (host_dir / "max").read_text() == "2"   # first pool untouched
 
-    # launcher side: slots 0 and 1 acquire, the third process fails loudly
+    # launcher side: each simulated process clears the per-process pool
+    # cache (in production the cache provides re-entrancy within one
+    # process); slots 0 and 1 acquire, the third process fails loudly
+    import os as _os
     env = {"TPU_MULTIPROCESS_SLOT_DIR": str(host_dir)}
     held_before = len(launcher._HELD_SLOTS)
+
+    def as_new_process():
+        launcher._ACQUIRED_POOLS.clear()
+
     try:
-        assert launcher.acquire_multiprocess_slot(env) == 0
-        assert launcher.acquire_multiprocess_slot(env) == 1
+        as_new_process()
+        assert launcher.acquire_multiprocess_slot(env) == {"": 0}
+        # re-entry in the SAME process returns the held slot, not a new one
+        assert launcher.acquire_multiprocess_slot(env) == {"": 0}
+        as_new_process()
+        assert launcher.acquire_multiprocess_slot(env) == {"": 1}
+        as_new_process()
         with pytest.raises(RuntimeError, match="refusing to oversubscribe"):
             launcher.acquire_multiprocess_slot(env)
     finally:
-        import os as _os
         for fd in launcher._HELD_SLOTS[held_before:]:
             _os.close(fd)
         del launcher._HELD_SLOTS[held_before:]
+        launcher._ACQUIRED_POOLS.clear()
 
     # kernel releases a crashed holder's lock: after closing, a new
     # process can take slot 0 again
-    assert launcher.acquire_multiprocess_slot(env) == 0
-    _os = __import__("os")
+    assert launcher.acquire_multiprocess_slot(env) == {"": 0}
     _os.close(launcher._HELD_SLOTS.pop())
+    launcher._ACQUIRED_POOLS.clear()
 
     # non-slot-managed claim -> no-op
     assert launcher.acquire_multiprocess_slot({}) is None
+
+    # a container holding TWO pools (base-dir layout) takes a slot in each
+    base = tmp_path / "mp-slots"
+    env_base = {"TPU_MULTIPROCESS_SLOT_DIR": str(base)}
+    held_before = len(launcher._HELD_SLOTS)
+    try:
+        got = launcher.acquire_multiprocess_slot(env_base)
+        assert got == {group: 0, group2: 0}, got
+    finally:
+        for fd in launcher._HELD_SLOTS[held_before:]:
+            _os.close(fd)
+        del launcher._HELD_SLOTS[held_before:]
+        launcher._ACQUIRED_POOLS.clear()
 
     # unprepare removes every pool of the claim
     mgr.cleanup("uid-1")

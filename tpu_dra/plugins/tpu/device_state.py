@@ -116,7 +116,14 @@ class DeviceState:
                     _, per_device_edits = self._prepare_devices(claim)
                     self.cdi.create_claim_spec(uid, per_device_edits)
                 return existing.devices
-            devices, per_device_edits = self._prepare_devices(claim)
+            try:
+                devices, per_device_edits = self._prepare_devices(claim)
+            except Exception:
+                # _group_edits may have created slot pools before a later
+                # group/overlap check failed; without a checkpoint entry
+                # unprepare would no-op, leaking them until restart
+                self.mp_manager.cleanup(uid)
+                raise
             self.cdi.create_claim_spec(uid, per_device_edits)
             prepared = PreparedClaim(
                 claim_uid=uid,

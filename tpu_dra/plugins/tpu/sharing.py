@@ -102,10 +102,14 @@ class MultiProcessManager:
                 os.makedirs(host_dir, exist_ok=True)
                 atomic_write(os.path.join(host_dir, "max"),
                              str(mp.max_processes), durable=False)
-                container_dir = f"{SLOT_DIR_CONTAINER_PATH}/{group}"
-                edits.add_mount(host_dir, container_dir,
+                edits.add_mount(host_dir,
+                                f"{SLOT_DIR_CONTAINER_PATH}/{group}",
                                 options=["rw", "nosuid", "nodev", "bind"])
-                edits.env["TPU_MULTIPROCESS_SLOT_DIR"] = container_dir
+                # env points at the BASE dir: a container holding several
+                # MultiProcess groups gets identical (non-clobbering) env
+                # and the launcher acquires a slot in every pool under it
+                edits.env["TPU_MULTIPROCESS_SLOT_DIR"] = \
+                    SLOT_DIR_CONTAINER_PATH
         if mp.scheduling_priority != "Default":
             edits.env["TPU_PROCESS_PRIORITY"] = mp.scheduling_priority
         if mp.hbm_limit_per_process:

@@ -109,38 +109,26 @@ def apply_hbm_limits(env: Optional[dict[str, str]] = None,
     return limit_bytes
 
 
-# process-lifetime holders for acquired slot locks (fd must stay open)
+# process-lifetime holders for acquired slot locks (fd must stay open) and
+# the pools this process already holds a slot in (re-entrancy: a process
+# that calls both init_tpu_workload() and initialize() must not consume two
+# slots — flock on a fresh fd would conflict even within one process)
 _HELD_SLOTS: list[int] = []
+_ACQUIRED_POOLS: dict[str, int] = {}   # abs pool path -> slot index
 
 
-def acquire_multiprocess_slot(env: Optional[dict[str, str]] = None
-                              ) -> Optional[int]:
-    """Acquire one process slot of a MultiProcess-shared chip claim.
-
-    The driver's MultiProcess edits mount a per-claim slot dir at
-    ``TPU_MULTIPROCESS_SLOT_DIR`` with a ``max`` file
-    (plugins/tpu/sharing.py).  Each co-resident process must hold exactly
-    one ``flock(LOCK_EX)``'d slot file; the lock is held for the process
-    lifetime and released by the kernel on exit (crash included), so slots
-    can never leak.  Exceeding ``maxProcesses`` raises instead of silently
-    oversubscribing the chip — the enforcement analog of the MPS control
-    daemon's client gate (reference sharing.go:291-346).
-
-    Returns the acquired slot index, or None when the claim is not
-    slot-managed (no slot dir env).
-    """
+def _acquire_in_pool(pool_dir: str, fallback_max: int) -> int:
     import fcntl
-    e = os.environ if env is None else env
-    slot_dir = e.get("TPU_MULTIPROCESS_SLOT_DIR", "")
-    if not slot_dir or not os.path.isdir(slot_dir):
-        return None
+    key = os.path.realpath(pool_dir)
+    if key in _ACQUIRED_POOLS:
+        return _ACQUIRED_POOLS[key]
     try:
-        with open(os.path.join(slot_dir, "max")) as f:
+        with open(os.path.join(pool_dir, "max")) as f:
             max_procs = int(f.read().strip())
     except (FileNotFoundError, ValueError):
-        max_procs = int(e.get("TPU_MULTIPROCESS_MAX", "1"))
+        max_procs = fallback_max
     for slot in range(max_procs):
-        fd = os.open(os.path.join(slot_dir, f"slot-{slot}.lock"),
+        fd = os.open(os.path.join(pool_dir, f"slot-{slot}.lock"),
                      os.O_CREAT | os.O_RDWR, 0o644)
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
@@ -150,14 +138,51 @@ def acquire_multiprocess_slot(env: Optional[dict[str, str]] = None
         os.ftruncate(fd, 0)   # clear a crashed holder's longer pid
         os.write(fd, f"{os.getpid()}\n".encode())
         _HELD_SLOTS.append(fd)   # keep open: lock lives with the process
+        _ACQUIRED_POOLS[key] = slot
         return slot
     raise RuntimeError(
-        f"all {max_procs} process slots of this MultiProcess claim are "
-        f"held (TPU_MULTIPROCESS_MAX={max_procs}); refusing to "
-        f"oversubscribe the chip")
+        f"all {max_procs} process slots of pool {pool_dir!r} are held "
+        f"(maxProcesses={max_procs}); refusing to oversubscribe the chip")
+
+
+def acquire_multiprocess_slot(env: Optional[dict[str, str]] = None
+                              ) -> Optional[dict[str, int]]:
+    """Acquire one process slot in EVERY pool of this container's
+    MultiProcess claim(s).
+
+    The driver's MultiProcess edits mount one slot dir per claim config
+    group under ``TPU_MULTIPROCESS_SLOT_DIR`` (plugins/tpu/sharing.py); a
+    container consuming several groups sees several pool subdirectories and
+    must hold a slot in each.  Each slot is a ``flock(LOCK_EX)``'d file
+    held for the process lifetime and released by the kernel on exit
+    (crash included), so slots can never leak; re-entry (initialize() after
+    init_tpu_workload()) returns the already-held slots instead of
+    consuming more.  Exceeding ``maxProcesses`` raises instead of silently
+    oversubscribing the chip — the enforcement analog of the MPS control
+    daemon's client gate (reference sharing.go:291-346).
+
+    Returns ``{pool_name: slot_index}`` (pool_name "" when the env points
+    directly at a single pool), or None when the claim is not slot-managed.
+    """
+    e = os.environ if env is None else env
+    base = e.get("TPU_MULTIPROCESS_SLOT_DIR", "")
+    if not base or not os.path.isdir(base):
+        return None
+    fallback_max = int(e.get("TPU_MULTIPROCESS_MAX", "1"))
+    acquired: dict[str, int] = {}
+    if os.path.exists(os.path.join(base, "max")):
+        acquired[""] = _acquire_in_pool(base, fallback_max)
+    for name in sorted(os.listdir(base)):
+        pool = os.path.join(base, name)
+        if os.path.isdir(pool) and os.path.exists(
+                os.path.join(pool, "max")):
+            acquired[name] = _acquire_in_pool(pool, fallback_max)
+    return acquired or None
 
 
 _PRIORITY_NICE = {"Low": 10, "Normal": 0, "High": -5}
+_PRIORITY_APPLIED = False   # renice once: initialize() after
+                            # init_tpu_workload() must not double the delta
 
 
 def apply_scheduling_priority(env: Optional[dict[str, str]] = None
@@ -170,23 +195,39 @@ def apply_scheduling_priority(env: Optional[dict[str, str]] = None
     CAP_SYS_NICE; an EPERM demotes the hint to a no-op rather than failing
     the workload.  Returns the applied nice increment, or None.
     """
+    global _PRIORITY_APPLIED
     e = os.environ if env is None else env
     prio = e.get("TPU_PROCESS_PRIORITY", "")
     delta = _PRIORITY_NICE.get(prio)
-    if not delta:   # unset, Default/Normal (0), or unknown value
-        return None
+    if not delta or _PRIORITY_APPLIED:
+        return None   # unset, Normal (0), unknown, or already applied
     try:
         os.nice(delta)
+        _PRIORITY_APPLIED = True
         return delta
     except OSError:
         return None
 
 
-def init_tpu_workload(env: Optional[dict[str, str]] = None) -> dict:
+def init_tpu_workload(env: Optional[dict[str, str]] = None,
+                      dry_run: bool = False) -> dict:
     """Apply every driver-injected resource contract, in dependency order:
     slot gate (fail fast before any backend work), HBM bound (must precede
     libtpu init), scheduling priority.  The one call a claimed container
-    makes before importing jax; returns what was applied."""
+    makes before importing jax; returns what was applied.
+
+    ``dry_run=True`` computes without side effects on the real process: no
+    slot is locked, ``os.environ`` is untouched (the HBM flag lands only in
+    the provided ``env`` dict), and the process is not reniced.
+    """
+    if dry_run:
+        e = dict(os.environ) if env is None else env
+        return {
+            "slot": None,
+            "hbm_limit_bytes": apply_hbm_limits(e, setenv=False),
+            "nice": _PRIORITY_NICE.get(
+                e.get("TPU_PROCESS_PRIORITY", ""), 0) or None,
+        }
     return {
         "slot": acquire_multiprocess_slot(env),
         "hbm_limit_bytes": apply_hbm_limits(env),
