@@ -71,7 +71,7 @@ def matmul(x, y, *, bm: int = 1024, bn: int = 1024, bk: int = 512,
 
 
 def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
-                       *, k_steps: int, scale: float, causal: bool,
+                       *, k_steps: int, causal: bool,
                        bq: int, bk: int):
     """Flash attention inner loop: one (batch·head, q-block) tile streamed
     over k/v blocks with an online softmax (running max ``m``, denominator
@@ -90,19 +90,18 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: skip k blocks strictly past the last row of this q block.  The
-    # block-start bound (not j<=i) keeps every query row's diagonal inside an
-    # executed block for any bq/bk combination.
-    run = True if not causal else j * bk < (i + 1) * bq
-
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+    def _compute(masked: bool):
+        # q arrives pre-scaled by softmax_scale·log2(e) (see _flash_attn_fwd),
+        # so scores are already in base-2 log space: the softmax uses exp2,
+        # which is cheaper on the VPU than exp, and no per-score scale
+        # multiply is needed.  q/k stay in their storage dtype (bf16) so the
+        # QK^T matmul runs at the MXU's bf16 rate; preferred_element_type
+        # gives fp32 accumulate.  An fp32 upcast here would quarter the MXU
+        # throughput on v5e.
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if masked:
             rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = rows >= cols
@@ -110,20 +109,37 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
         m_prev = m_ref[:, :1]                       # [bq, 1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        # Fully-masked-so-far rows: exp(neg - neg) == 1 would leak weight —
-        # recompute against 0 and zero the masked entries explicitly (same
-        # safety pattern as ring_attention._block_attn).
-        safe_m = jnp.where(m_new == neg, 0.0, m_new)
-        p = jnp.exp(s - safe_m)
-        if causal:
+        if masked:
+            # Fully-masked-so-far rows: exp2(neg - neg) == 1 would leak
+            # weight — recompute against 0 and zero the masked entries
+            # explicitly (same safety pattern as ring_attention._block_attn).
+            safe_m = jnp.where(m_new == neg, 0.0, m_new)
+        else:
+            safe_m = m_new                          # scores finite ⇒ m_new is
+        p = jnp.exp2(s - safe_m)
+        if masked:
             p = jnp.where(mask, p, 0.0)
-        corr = jnp.where(m_prev == neg, 0.0, jnp.exp(m_prev - safe_m))
+        corr = jnp.where(m_prev == neg, 0.0, jnp.exp2(m_prev - safe_m))
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if not causal:
+        _compute(masked=False)
+    else:
+        # Skip k blocks strictly past the last row of this q block (the
+        # block-start bound — not j<=i — keeps every query row's diagonal
+        # inside an executed block for any bq/bk combination), and build the
+        # mask only for blocks that straddle the diagonal; blocks fully below
+        # it take the mask-free path.
+        run = j * bk < (i + 1) * bq
+        straddles = (j + 1) * bk - 1 > i * bq
+        pl.when(run & straddles)(lambda: _compute(masked=True))
+        pl.when(run & jnp.logical_not(straddles))(
+            lambda: _compute(masked=False))
 
     @pl.when(j == k_steps - 1)
     def _flush():
@@ -140,9 +156,13 @@ def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
         f"seq lens {(s, sk)} must tile by {(bq, bk)}"
     k_steps = sk // bk
     grid = (bh, s // bq, k_steps)
+    # Fold softmax scale and the exp→exp2 base change into q once ([S, D])
+    # instead of per score block ([S, S] · k_steps): the kernel's softmax
+    # then runs in base-2 log space with no per-block scale pass.
+    q = (q * (d ** -0.5 * 1.4426950408889634)).astype(q.dtype)
     return pl.pallas_call(
         functools.partial(_flash_attn_kernel, k_steps=k_steps,
-                          scale=d ** -0.5, causal=causal, bq=bq, bk=bk),
+                          causal=causal, bq=bq, bk=bk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -197,8 +217,8 @@ _flash_attn.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "bq", "bk", "interpret"))
-def flash_attention(q, k, v, *, causal: bool = True, bq: int = 512,
-                    bk: int = 512, interpret: bool = False):
+def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
+                    bk: int = 1024, interpret: bool = False):
     """Memory-efficient attention for ``[B, H, S, D]`` q/k/v.
 
     Forward is the Pallas online-softmax kernel (HBM stays O(S·D); the
