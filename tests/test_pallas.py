@@ -49,28 +49,61 @@ def test_flash_attention_matches_reference(causal, bq, bk):
     assert float(err) < 2e-2
 
 
-def test_flash_attention_grads_flow():
-    b, h, s, d = 1, 2, 128, 64
-    ks = jax.random.split(jax.random.PRNGKey(1), 3)
-    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16) for kk in ks)
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("bq,bk", [(64, 64), (64, 128), (128, 64)])
+def test_flash_attention_grads_match_reference(causal, bq, bk):
+    """The Pallas backward kernel pair (dQ; dK/dV) against XLA-attention
+    gradients — a weighted loss so every gradient entry is distinct."""
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks[:3])
+    w = jax.random.normal(ks[3], (b, h, s, d), jnp.float32)
 
     def loss(q, k, v):
-        return jnp.sum(flash_attention(
-            q, k, v, bq=64, bk=64, interpret=True).astype(jnp.float32))
+        return jnp.sum(w * flash_attention(
+            q, k, v, causal=causal, bq=bq, bk=bk,
+            interpret=True).astype(jnp.float32))
 
     gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
     def ref_loss(q, k, v):
         fold = lambda x: x.reshape(b * h, s, d)
-        return jnp.sum(_attn_reference(fold(q), fold(k), fold(v),
-                                       causal=True).astype(jnp.float32))
+        out = _attn_reference(fold(q), fold(k), fold(v),
+                              causal=causal).reshape(b, h, s, d)
+        return jnp.sum(w * out.astype(jnp.float32))
 
     rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
-    for got, want in ((gq, rq.reshape(gq.shape)), (gk, rk.reshape(gk.shape)),
-                      (gv, rv.reshape(gv.shape))):
+    for name, got, want in (("dq", gq, rq), ("dk", gk, rk), ("dv", gv, rv)):
         err = jnp.max(jnp.abs(got.astype(jnp.float32) -
                               want.astype(jnp.float32)))
-        assert float(err) < 5e-2
+        assert float(err) < 8e-2, (name, float(err))
+
+
+def test_flash_attention_cross_length_grads():
+    """Non-causal cross-attention (sk != s) through the backward kernels."""
+    b, h, s, sk_len, d = 1, 1, 128, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, h, sk_len, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, h, sk_len, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=False, bq=64, bk=64,
+            interpret=True).astype(jnp.float32))
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(_attn_reference(
+            q[0], k[0], v[0], causal=False).astype(jnp.float32))
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, got, want in (("dq", gq, rq), ("dk", gk, rk), ("dv", gv, rv)):
+        err = jnp.max(jnp.abs(got.astype(jnp.float32) -
+                              want.astype(jnp.float32)))
+        assert float(err) < 8e-2, (name, float(err))
 
 
 def test_fused_rmsnorm_matmul_matches_reference():
