@@ -158,7 +158,10 @@ def section_train() -> dict:
     batch, seq = (16, cfg.max_seq) if on_tpu else (2, cfg.max_seq)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
     params = init_params(cfg, jax.random.PRNGKey(0))
-    step, p_shard, b_shard = make_sharded_train_step(cfg, mesh)
+    # flash: Pallas fwd+bwd attention kernels — measured 58.7% vs 52.0% MFU
+    # over dense XLA attention at S=1024 (47.5% vs 31.6% at S=4096)
+    step, p_shard, b_shard = make_sharded_train_step(
+        cfg, mesh, attn_impl="flash" if on_tpu else "dense")
     params = jax.device_put(params, p_shard)
     tokens = jax.device_put(
         jnp.zeros((batch, seq), dtype=jnp.int32), b_shard)

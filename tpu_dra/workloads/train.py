@@ -110,13 +110,37 @@ def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
     return x + h @ layer["w2"].astype(x.dtype)
 
 
-def _trunk(cfg: ModelConfig, params, tokens):
+def _flash_attention_fn(q, k, v):
+    """Pallas flash attention as a drop-in for _causal_dense_attention.
+    Wins once S² score materialization dominates (S ≳ 2k on v5e); at short
+    S the dense XLA path fuses better.
+
+    Sequences are zero-padded up to the kernel's tile so any S works: for
+    causal self-attention the padded tail is correctness-free — every
+    padded column is in the future of every real row (col ≥ S > row), so
+    the causal mask removes it; padded rows are sliced off."""
+    from tpu_dra.workloads.pallas_kernels import flash_attention
+    S = q.shape[2]
+    tile = 1024 if S >= 1024 else -(-S // 128) * 128
+    pad = (-S) % tile
+    if pad:
+        widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
+        q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+    out = flash_attention(q, k, v, causal=True,
+                          interpret=jax.default_backend() != "tpu")
+    return out[:, :, :S] if pad else out
+
+
+_ATTN_IMPLS = {"dense": _causal_dense_attention, "flash": _flash_attention_fn}
+
+
+def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention):
     """Embed + decoder stack; returns pre-final-norm activations."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
     block = jax.checkpoint(
-        lambda carry, layer: (_block(cfg, carry, layer), None))
+        lambda carry, layer: (_block(cfg, carry, layer, attn_fn), None))
     x, _ = jax.lax.scan(block, x, params["blocks"])
     return x
 
@@ -136,19 +160,22 @@ def head_nll(params, x, targets):
     return -jnp.take_along_axis(logp, targets[..., None], axis=-1)
 
 
-def forward(cfg: ModelConfig, params, tokens):
+def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
     """Logits for a [B, S] int32 token batch."""
-    return head_logits(params, _trunk(cfg, params, tokens))
+    return head_logits(params, _trunk(cfg, params, tokens,
+                                      _ATTN_IMPLS[attn_impl]))
 
 
-def loss_fn(cfg: ModelConfig, params, tokens):
-    return jnp.mean(head_nll(params, _trunk(cfg, params, tokens[:, :-1]),
-                             tokens[:, 1:]))
+def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
+    trunk = _trunk(cfg, params, tokens[:, :-1], _ATTN_IMPLS[attn_impl])
+    return jnp.mean(head_nll(params, trunk, tokens[:, 1:]))
 
 
-def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens):
+def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens,
+                   attn_impl: str = "dense"):
     """Full train step (fwd+bwd+update) as one jittable function."""
-    loss, grads = jax.value_and_grad(partial(loss_fn, cfg))(params, tokens)
+    loss, grads = jax.value_and_grad(
+        partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl)
     params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return params, loss
 
@@ -181,13 +208,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp", None))
 
 
-def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2):
+def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
+                            attn_impl: str = "dense"):
     """jit the full train step with DP×TP shardings over ``mesh`` (axes
-    "dp", "tp")."""
+    "dp", "tp").  ``attn_impl``: "dense" (XLA, best at short S) or "flash"
+    (Pallas fwd+bwd kernels, best at long S)."""
     p_shard = param_shardings(cfg, mesh)
     b_shard = batch_sharding(mesh)
     step = jax.jit(
-        partial(sgd_train_step, cfg, lr),
+        partial(sgd_train_step, cfg, lr, attn_impl=attn_impl),
         in_shardings=(p_shard, b_shard),
         out_shardings=(p_shard, NamedSharding(mesh, P())))
     return step, p_shard, b_shard
