@@ -40,6 +40,7 @@ _DEADLINES = {
     "pallas_matmul": 300,
     "flash": 330,
     "train": 420,
+    "decode": 330,
     "visibility": 300,
     "multiprocess": 300,
     "collectives": 300,
@@ -196,6 +197,48 @@ def section_train() -> dict:
     return out
 
 
+def section_decode() -> dict:
+    """Serving throughput: greedy KV-cache decode on the flagship model
+    (one jitted prefill + lax.scan over steps).  Decode is HBM-bound by
+    design, so tokens/s — not MFU — is the metric."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.decode import make_decoder
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu:
+        cfg = ModelConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                          d_ff=128, max_seq=64)
+        B, S, steps = 2, 8, 4
+    else:
+        cfg = ModelConfig(vocab=32768, d_model=1024, n_heads=8, n_layers=8,
+                          d_ff=4096, max_seq=1024)
+        B, S, steps = 8, 128, 256
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    # cache sized to the live sequence, not max_seq: decode reads the whole
+    # cache every step, so slack slots are pure HBM waste
+    dec = make_decoder(cfg, steps=steps, max_len=S + steps)
+    toks = dec(params, prompt)
+    _ = int(toks[0, -1])                      # compile + warm, host readback
+    best = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        toks = dec(params, prompt)
+        _ = int(toks[0, -1])
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "decode_tokens_per_s": round(B * steps / best, 1),
+        "decode_steps": steps,
+        "decode_batch": B,
+        "decode_ms_per_token": round(best / steps * 1e3, 3),
+    }
+
+
 def section_visibility() -> dict:
     """Hardware validation of the CDI visibility env contract (VERDICT
     next-round item 3): launch a subprocess with the env the driver would
@@ -322,6 +365,7 @@ _SECTIONS = {
     "pallas_matmul": section_pallas_matmul,
     "flash": section_flash,
     "train": section_train,
+    "decode": section_decode,
     "visibility": section_visibility,
     "multiprocess": section_multiprocess,
     "collectives": section_collectives,
@@ -447,7 +491,8 @@ def run_tpu_sections() -> dict:
         out["tpu_error"] = res["probe_error"]
         return out
 
-    order = ["matmul", "pallas_matmul", "flash", "train", "visibility",
+    order = ["matmul", "pallas_matmul", "flash", "train", "decode",
+             "visibility",
              "multiprocess"]
     if out.get("tpu_devices", 1) > 1:
         order.append("collectives")

@@ -1,0 +1,171 @@
+"""Autoregressive KV-cache decoding for the flagship model — the serving
+half of the workload surface.
+
+The reference's demos exercise claimed GPUs with inference-style CUDA
+samples (``/root/reference/demo/specs/quickstart/gpu-test1.yaml`` runs a
+vector add; gpu-test5 runs nbody); the TPU analog serves the same
+transformer that ``train.py`` trains, so one claimed chip demonstrably
+covers the full train→serve lifecycle.
+
+TPU-first design:
+- static shapes end to end: the KV cache is a pre-allocated
+  ``[L, B, H, S_max, Dh]`` bf16 buffer updated with
+  ``lax.dynamic_update_slice``; the decode loop is one ``lax.scan`` over
+  step indices (one XLA program, no per-token dispatch);
+- decode attention is a masked matvec against the cache — HBM-bound by
+  design, which is why tokens/s (not MFU) is the serving metric;
+- prefill reuses the training forward (``train._trunk``) so the flash
+  kernel path accelerates long prompts, then the cache is filled with one
+  batched pass over the prompt's k/v.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.train import (
+    ModelConfig,
+    _rmsnorm,
+    head_logits,
+)
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, Any]:
+    """Pre-allocated bf16 cache: ``k``/``v`` of [L, B, H, S_max, Dh]."""
+    shape = (cfg.n_layers, batch, cfg.n_heads, max_len, cfg.d_head)
+    return {"k": jnp.zeros(shape, jnp.bfloat16),
+            "v": jnp.zeros(shape, jnp.bfloat16)}
+
+
+def _split_heads(cfg: ModelConfig, t):
+    B, S = t.shape[:2]
+    return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
+
+
+def _layer_kv(cfg: ModelConfig, layer, x):
+    """k/v heads for a whole [B, S, D] activation block (prefill path)."""
+    h = _rmsnorm(x, layer["ln1"])
+    qkv = h @ layer["wqkv"].astype(x.dtype)
+    _, k, v = jnp.split(qkv, 3, axis=-1)
+    return _split_heads(cfg, k), _split_heads(cfg, v)
+
+
+def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
+    """One decoder block for a single-token [B, 1, D] activation against a
+    [B, H, S_max, Dh] cache; returns (x, new_k, new_v) where new_k/new_v
+    are this token's heads [B, H, 1, Dh] (the caller writes them at
+    ``pos`` — they are already reflected in the attention below).
+    """
+    B = x.shape[0]
+    h = _rmsnorm(x, layer["ln1"])
+    qkv = h @ layer["wqkv"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (_split_heads(cfg, t) for t in (q, k, v))   # [B, H, 1, Dh]
+
+    k_all = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+    v_all = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_all) * (cfg.d_head ** -0.5)
+    # mask positions beyond the current token (cache tail is zeros)
+    valid = jnp.arange(k_cache.shape[2])[None, None, None, :] <= pos
+    scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", attn, v_all)
+    out = out.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+    x = x + out @ layer["wo"].astype(x.dtype)
+
+    h2 = _rmsnorm(x, layer["ln2"])
+    h2 = jax.nn.gelu(h2 @ layer["w1"].astype(x.dtype))
+    x = x + h2 @ layer["w2"].astype(x.dtype)
+    return x, k_all, v_all
+
+
+def _token_logits(cfg: ModelConfig, params, cache, pos, token):
+    """One decode step: [B] token ids at position ``pos`` → ([B, vocab]
+    logits, updated cache)."""
+    x = params["embed"].astype(jnp.bfloat16)[token][:, None, :]   # [B, 1, D]
+    x = x + jax.lax.dynamic_slice_in_dim(
+        params["pos"].astype(jnp.bfloat16), pos, 1, axis=0)
+
+    def block(carry, inputs):
+        layer, k_cache, v_cache = inputs
+        x = carry
+        x, k_all, v_all = _decode_block(cfg, x, layer, k_cache, v_cache, pos)
+        return x, (k_all, v_all)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = head_logits(params, x)[:, 0]                         # [B, vocab]
+    return logits, {"k": k_new, "v": v_new}
+
+
+def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
+    """Run the prompt [B, S] through the training trunk, fill the cache for
+    positions [0, S), and return (cache, last-token logits [B, vocab]).
+
+    The trunk recomputes activations layer by layer for the k/v projections
+    — two passes over the prompt total, both batched MXU work (the flash
+    path applies for long prompts via ``attn_impl="flash"``).
+    """
+    from tpu_dra.workloads.train import _ATTN_IMPLS, _block
+
+    S = prompt.shape[1]
+    x = params["embed"].astype(jnp.bfloat16)[prompt]
+    x = x + params["pos"].astype(jnp.bfloat16)[:S]
+    attn_fn = _ATTN_IMPLS[attn_impl]
+
+    def block(carry, inputs):
+        layer = inputs
+        k, v = _layer_kv(cfg, layer, carry)
+        return _block(cfg, carry, layer, attn_fn), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(block, x, params["blocks"])
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
+    }
+    logits = head_logits(params, x[:, -1:])[:, 0]
+    return cache, logits
+
+
+def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
+                  max_len: int | None = None, attn_impl: str = "dense"):
+    """Greedy-decode ``steps`` tokens after a [B, S] prompt.
+
+    Returns [B, steps] int32 tokens.  One jittable function: prefill +
+    ``lax.scan`` over decode steps (donate/jit at the call site —
+    ``make_decoder`` below does both).
+    """
+    B, S = prompt.shape
+    max_len = max_len or cfg.max_seq
+    assert S + steps <= max_len, (S, steps, max_len)
+    cache = init_kv_cache(cfg, B, max_len)
+    cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        cache, token = carry
+        logits, cache = _token_logits(cfg, params, cache, S + i, token)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt), token
+
+    # ys stacks each step's *input* token: t0 (from prefill), t1, …,
+    # t_{steps-1} — exactly the ``steps`` generated tokens in order.
+    _, toks = jax.lax.scan(
+        step, (cache, first), jnp.arange(steps, dtype=jnp.int32))
+    return toks.T
+
+
+def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
+                 attn_impl: str = "dense"):
+    """jit-compiled ``(params, prompt [B, S]) -> tokens [B, steps]``."""
+    return jax.jit(partial(greedy_decode, cfg, steps=steps, max_len=max_len,
+                           attn_impl=attn_impl))
