@@ -67,6 +67,20 @@ def test_greedy_decode_shapes_and_determinism(small):
     assert jnp.array_equal(toks, toks2)
 
 
+def test_sampled_decode_runs_and_respects_vocab(small):
+    cfg, params = small
+    B, S, steps = 2, 4, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    dec = make_decoder(cfg, steps=steps, temperature=0.8, top_k=8)
+    toks = dec(params, prompt, jax.random.PRNGKey(7))
+    assert toks.shape == (B, steps)
+    assert bool(jnp.all((toks >= 0) & (toks < cfg.vocab)))
+    # different rng → different draw (overwhelmingly, with 5 steps × top-8)
+    toks2 = dec(params, prompt, jax.random.PRNGKey(8))
+    assert not jnp.array_equal(toks, toks2)
+
+
 def test_decode_respects_max_len(small):
     cfg, params = small
     prompt = jnp.zeros((1, 30), jnp.int32)

@@ -136,36 +136,68 @@ def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
     return cache, logits
 
 
-def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
-                  max_len: int | None = None, attn_impl: str = "dense"):
-    """Greedy-decode ``steps`` tokens after a [B, S] prompt.
+def _select_token(logits, key, temperature: float, top_k: int):
+    """Greedy (temperature == 0) or temperature/top-k sampling.  Static
+    branch: the sampling mode is fixed at trace time."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, jnp.finfo(logits.dtype).min, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def decode(cfg: ModelConfig, params, prompt, *, steps: int,
+           max_len: int | None = None, attn_impl: str = "dense",
+           temperature: float = 0.0, top_k: int = 0, rng=None):
+    """Decode ``steps`` tokens after a [B, S] prompt — greedy by default,
+    temperature/top-k sampling when ``temperature > 0``.
 
     Returns [B, steps] int32 tokens.  One jittable function: prefill +
-    ``lax.scan`` over decode steps (donate/jit at the call site —
-    ``make_decoder`` below does both).
+    ``lax.scan`` over decode steps (jit at the call site — ``make_decoder``
+    below does).
     """
     B, S = prompt.shape
     max_len = max_len or cfg.max_seq
     assert S + steps <= max_len, (S, steps, max_len)
+    if temperature > 0.0 and rng is None:
+        rng = jax.random.PRNGKey(0)
+    keys = (jax.random.split(rng, steps + 1) if temperature > 0.0
+            else jnp.zeros((steps + 1, 2), jnp.uint32))
     cache = init_kv_cache(cfg, B, max_len)
     cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    first = _select_token(logits, keys[0], temperature, top_k)
 
-    def step(carry, i):
+    def step(carry, inputs):
+        i, key = inputs
         cache, token = carry
         logits, cache = _token_logits(cfg, params, cache, S + i, token)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = _select_token(logits, key, temperature, top_k)
         return (cache, nxt), token
 
     # ys stacks each step's *input* token: t0 (from prefill), t1, …,
     # t_{steps-1} — exactly the ``steps`` generated tokens in order.
     _, toks = jax.lax.scan(
-        step, (cache, first), jnp.arange(steps, dtype=jnp.int32))
+        step, (cache, first),
+        (jnp.arange(steps, dtype=jnp.int32), keys[1:]))
     return toks.T
 
 
+def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
+                  max_len: int | None = None, attn_impl: str = "dense"):
+    """Greedy-decode ``steps`` tokens after a [B, S] prompt."""
+    return decode(cfg, params, prompt, steps=steps, max_len=max_len,
+                  attn_impl=attn_impl)
+
+
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
-                 attn_impl: str = "dense"):
-    """jit-compiled ``(params, prompt [B, S]) -> tokens [B, steps]``."""
-    return jax.jit(partial(greedy_decode, cfg, steps=steps, max_len=max_len,
-                           attn_impl=attn_impl))
+                 attn_impl: str = "dense", temperature: float = 0.0,
+                 top_k: int = 0):
+    """jit-compiled ``(params, prompt [B, S][, rng]) -> tokens [B, steps]``."""
+    if temperature == 0.0:
+        return jax.jit(partial(greedy_decode, cfg, steps=steps,
+                               max_len=max_len, attn_impl=attn_impl))
+    return jax.jit(lambda params, prompt, rng: decode(
+        cfg, params, prompt, steps=steps, max_len=max_len,
+        attn_impl=attn_impl, temperature=temperature, top_k=top_k, rng=rng))
