@@ -204,13 +204,15 @@ def test_crash_restart_recovery_real_process(tmp_path):
 
         def start():
             p = subprocess.Popen(argv, cwd=repo, env=env)
-            deadline = time.time() + 20
+            # generous: under full-suite load the interpreter start +
+            # imports alone have blown a 20s budget
+            deadline = time.time() + 60
             while time.time() < deadline and not sock.exists():
                 time.sleep(0.1)
             assert sock.exists(), "plugin socket never appeared"
             return p
 
-        def rpc_retry(method, request, response_cls, timeout=15.0):
+        def rpc_retry(method, request, response_cls, timeout=30.0):
             # a stale socket file survives SIGKILL, so poll until the
             # restarted server actually accepts
             deadline = time.time() + timeout

@@ -7,6 +7,7 @@ import pytest
 from tpu_dra.workloads.pallas_kernels import (
     _attn_reference,
     flash_attention,
+    flash_attention_with_lse,
     fused_rmsnorm_matmul,
     matmul,
 )
@@ -103,6 +104,56 @@ def test_flash_attention_cross_length_grads():
     for name, got, want in (("dq", gq, rq), ("dk", gk, rk), ("dv", gv, rv)):
         err = jnp.max(jnp.abs(got.astype(jnp.float32) -
                               want.astype(jnp.float32)))
+        assert float(err) < 8e-2, (name, float(err))
+
+
+def _lse_oracle(q, k, v, causal):
+    """fp32 attention + base-2 logsumexp of the scaled scores."""
+    qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * (q.shape[-1] ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[2], k.shape[2]), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    lse2 = jax.nn.logsumexp(s, axis=-1) * 1.4426950408889634
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out, lse2
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_value_and_grads(causal):
+    """flash_attention_with_lse: the l2 output matches base-2 logsumexp of
+    the scaled scores, and a loss touching BOTH outputs gets the right
+    gradients (the l2 cotangent rides the dd term of the bwd kernels)."""
+    b, h, s, d = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(9), 5)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks[:3])
+    w_out = jax.random.normal(ks[3], (b, h, s, d), jnp.float32)
+    w_lse = jax.random.normal(ks[4], (b, h, s), jnp.float32)
+
+    out, lse2 = flash_attention_with_lse(q, k, v, causal=causal, bq=64,
+                                         bk=64, interpret=True)
+    ref_out, ref_lse2 = _lse_oracle(q, k, v, causal)
+    assert float(jnp.max(jnp.abs(
+        out.astype(jnp.float32) - ref_out))) < 2e-2
+    assert float(jnp.max(jnp.abs(lse2 - ref_lse2))) < 2e-2
+
+    def loss(q, k, v):
+        o, l2 = flash_attention_with_lse(q, k, v, causal=causal, bq=64,
+                                         bk=64, interpret=True)
+        return (jnp.sum(w_out * o.astype(jnp.float32)) +
+                jnp.sum(w_lse * l2))
+
+    def ref_loss(q, k, v):
+        o, l2 = _lse_oracle(q, k, v, causal)
+        return jnp.sum(w_out * o) + jnp.sum(w_lse * l2)
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", got, want):
+        err = jnp.max(jnp.abs(a.astype(jnp.float32) -
+                              b_.astype(jnp.float32)))
         assert float(err) < 8e-2, (name, float(err))
 
 

@@ -295,7 +295,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
 
 
 def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
-                    interpret: bool):
+                    interpret: bool, g_l2=None):
     """Pallas flash backward: O(S·D) HBM residency, two kernels (dQ over k
     blocks; dK/dV over q blocks), each recomputing its score block on the
     MXU instead of materializing the [S, S] probability matrix the way the
@@ -318,6 +318,12 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     # D_i = rowsum(dO ∘ O): one fused elementwise pass, [BH, S, 1]
     dd = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                  axis=-1, keepdims=True)
+    if g_l2 is not None:
+        # An l2 (logsumexp) cotangent folds into the same bracket the
+        # kernels already compute: dL/ds_ij gains g_l2_i·log2(e)·P_ij, and
+        # ds = p·(dp − dd) becomes p·(dp − (dd − log2e·g_l2)).  Zero kernel
+        # changes — only the dd operand shifts.
+        dd = dd - _LOG2E * g_l2.astype(jnp.float32).reshape(bh, s, 1)
     common = dict(
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -401,6 +407,51 @@ def _flash_vjp_bwd(causal, bq, bk, interpret, res, g):
 
 
 _flash_attn.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attn_lse(q, k, v, causal, bq, bk, interpret):
+    out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
+                              interpret=interpret)
+    return out, l2[..., 0]
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, bq, bk, interpret):
+    out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
+                              interpret=interpret)
+    return (out, l2[..., 0]), (q, k, v, out, l2)
+
+
+def _flash_lse_vjp_bwd(causal, bq, bk, interpret, res, gs):
+    g_out, g_l2 = gs
+    q, k, v, out, l2 = res
+    return _flash_attn_bwd(q, k, v, out, l2, g_out, causal=causal, bq=bq,
+                           bk=bk, interpret=interpret, g_l2=g_l2)
+
+
+_flash_attn_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "bq", "bk", "interpret"))
+def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
+                             bk: int = 1024, interpret: bool = False):
+    """``flash_attention`` that also returns the per-row base-2 logsumexp
+    ``[B, H, S]`` — the merge statistic for composing partial attentions
+    (ring steps, sharded KV): given normalized partials (oᵃ, l2ᵃ), (oᵇ,
+    l2ᵇ) over disjoint key sets, the combined attention is their
+    l2-softmax-weighted average (see ring_attention._merge_partials).
+    Both outputs are differentiable; the l2 cotangent folds into the same
+    backward kernels."""
+    b, h, s, d = q.shape
+    if causal and k.shape[2] != s:
+        raise ValueError(
+            f"causal flash_attention requires equal q/k lengths, "
+            f"got q seq {s} vs k seq {k.shape[2]}")
+    fold = lambda x: x.reshape(b * h, x.shape[2], d)
+    out, l2 = _flash_attn_lse(fold(q), fold(k), fold(v), causal, bq, bk,
+                              interpret)
+    return out.reshape(b, h, s, d), l2.reshape(b, h, s)
 
 
 @functools.partial(jax.jit,
