@@ -69,6 +69,86 @@ def _block_attn(q, k, v, m, l, acc, mask, scale):
     return m_new, l, acc
 
 
+def _merge_partials(out_a, l2_a, out_b, l2_b):
+    """Combine two normalized partial attentions over disjoint key sets.
+
+    Given per-row base-2 logsumexps, the exact combination is the
+    l2-weighted average: ``w_x = 2^(l2_x - max)``; out = (w_a·out_a +
+    w_b·out_b) / (w_a + w_b); l2 = max + log2(w_a + w_b).  Differentiable
+    — gradients flow into both partials' (out, l2), which the flash
+    kernel's custom_vjp then turns into dq/dk/dv."""
+    m = jnp.maximum(l2_a, l2_b)
+    w_a = jnp.exp2(l2_a - m)[..., None]
+    w_b = jnp.exp2(l2_b - m)[..., None]
+    tot = w_a + w_b
+    out = (w_a * out_a.astype(jnp.float32) +
+           w_b * out_b.astype(jnp.float32)) / tot
+    l2 = m + jnp.log2(tot[..., 0])
+    return out.astype(out_a.dtype), l2
+
+
+def ring_attention_flash(q, k, v, *, axis_name: str = "sp",
+                         causal: bool = True):
+    """Ring self-attention with the Pallas flash kernel as the per-block
+    engine (fwd and bwd) — the MXU-fast long-context path.
+
+    Same contract as ``ring_attention`` (call inside shard_map, per-device
+    ``[B, H, S_local, D]``), different internals: each ring step computes a
+    normalized partial attention + logsumexp via
+    ``flash_attention_with_lse`` and folds it in with ``_merge_partials``
+    instead of carrying raw (m, l, acc) through fp32 einsums.  Causality is
+    block-granular: the local block runs the kernel's causal mode, past
+    source blocks run unmasked, future blocks are skipped via ``lax.cond``
+    (both branches compile; the taken one costs nothing extra — and the
+    skip means no MXU time on fully-masked work, unlike ``ring_attention``
+    which executes it to stay carry-uniform).
+    """
+    from tpu_dra.workloads.pallas_kernels import flash_attention_with_lse
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    interpret = jax.default_backend() != "tpu"
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def attend(kk, vv, is_causal):
+        return flash_attention_with_lse(q, kk, vv, causal=is_causal,
+                                        interpret=interpret)
+
+    out, l2 = attend(k, v, causal)        # local block (diagonal)
+
+    def step(t, carry):
+        k_blk, v_blk, out, l2 = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (idx - t) % n
+
+        def fold(out, l2, k_blk, v_blk):
+            ob, lb = attend(k_blk, v_blk, False)
+            return _merge_partials(out, l2, ob, lb)
+
+        if causal:
+            out, l2 = jax.lax.cond(
+                src < idx, fold, lambda o, l, *_: (o, l),
+                out, l2, k_blk, v_blk)
+        else:
+            out, l2 = fold(out, l2, k_blk, v_blk)
+        return k_blk, v_blk, out, l2
+
+    _, _, out, _ = jax.lax.fori_loop(1, n, step, (k, v, out, l2))
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_flash(mesh: Mesh, *, axis_name: str = "sp",
+                              causal: bool = True):
+    """shard_map-wrapped ``ring_attention_flash`` (see
+    ``make_ring_attention``)."""
+    batch = "dp" if "dp" in mesh.axis_names else None
+    spec = P(batch, None, axis_name, None)
+    return shard_map(
+        partial(ring_attention_flash, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
     """Ring self-attention for sequence-sharded q/k/v.
 
@@ -80,7 +160,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
     Ring step t: every device attends its q block against the k/v block
     originating on device ``(idx - t) mod n``, then ppermutes k/v one hop
     forward.  Causality is enforced block-wise (future source blocks fully
-    masked, the diagonal block intra-masked).
+    masked, the diagonal block intra-masked).  This is the fp32 XLA
+    engine; ``ring_attention_flash`` is the Pallas-kernel variant.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -250,12 +331,13 @@ def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp"):
 # --- sequence-parallel train step --------------------------------------------
 
 
-def _sp_trunk(cfg, params, tokens, sp_index, axis_name):
+def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
     """Embed + decoder stack on a sequence shard: [B, S/n] tokens →
     pre-final-norm activations.
 
     Same decoder block as train.forward (train._block) with ring attention
     swapped in; position embeddings are sliced by global offset.
+    ``ring_impl``: "xla" (fp32 einsum engine) or "flash" (Pallas kernels).
     """
     from tpu_dra.workloads.train import _block
 
@@ -265,7 +347,8 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name):
         params["pos"].astype(jnp.bfloat16), sp_index * S, S, axis=0)
     x = x + pos
 
-    attn = partial(ring_attention, axis_name=axis_name, causal=True)
+    ring_fn = ring_attention_flash if ring_impl == "flash" else ring_attention
+    attn = partial(ring_fn, axis_name=axis_name, causal=True)
 
     def block(carry, layer):
         return _block(cfg, carry, layer, attn_fn=attn), None
@@ -275,7 +358,7 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name):
 
 
 def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
-                         axis_name: str = "sp"):
+                         axis_name: str = "sp", ring_impl: str = "xla"):
     """Full DP×SP train step under ``shard_map``: tokens/targets sharded
     ``[("dp"), (sp)]``, params replicated, grads psum-averaged over the whole
     mesh.  Returns ``(step, token_sharding)``; ``step(params, tokens,
@@ -284,6 +367,9 @@ def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
     The caller supplies ``targets`` (tokens shifted by one *globally*) so
     the next-token boundary between sequence shards stays correct — shifting
     inside a shard would drop one target per boundary.
+
+    ``ring_impl``: "xla" or "flash" (Pallas per-block kernels — the
+    MXU-fast engine for long-context shards).
     """
     batch = "dp" if "dp" in mesh.axis_names else None
     tok_spec = P(batch, axis_name)
@@ -295,7 +381,7 @@ def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
         from tpu_dra.workloads.train import head_nll
 
         sp_index = jax.lax.axis_index(axis_name)
-        x = _sp_trunk(cfg, params, tokens, sp_index, axis_name)
+        x = _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl)
         nll = head_nll(params, x, targets)
         return jnp.sum(nll), nll.size
 
