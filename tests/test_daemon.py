@@ -193,21 +193,38 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 COORDD = os.path.join(REPO, "native", "coordd")
 
 
-def _build_coordd() -> bool:
-    if os.path.exists(COORDD):
-        return True
+def test_native_tree_builds():
+    """`make -C native` (coordd + libtpudra.so) must compile whenever a
+    toolchain exists — the ctypes/fallback seams everywhere else mean a
+    build break would otherwise never fail a test."""
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
     try:
-        subprocess.run(["make", "-C", os.path.join(REPO, "native"), "coordd"],
-                       check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        return False
-    return os.path.exists(COORDD)
+        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       check=True, capture_output=True, text=True,
+                       timeout=180)
+    except subprocess.CalledProcessError as exc:
+        pytest.fail(f"native tree failed to build:\n{exc.stderr[-2000:]}")
 
 
 @pytest.fixture(scope="module")
 def coordd_bin():
-    if not _build_coordd():
+    """Always run make (incremental, so a fresh binary is cheap): a stale
+    pre-built coordd must not mask a broken native build, and with a
+    toolchain present a compile failure is a FAILURE, not a skip — a
+    time.h regression once hid for a full round behind the skip+stale
+    short-circuit while the suite stayed green on the Python fallback."""
+    import shutil
+    if shutil.which("g++") is None and shutil.which("make") is None:
         pytest.skip("native toolchain unavailable")
+    try:
+        subprocess.run(["make", "-C", os.path.join(REPO, "native"), "coordd"],
+                       check=True, capture_output=True, text=True,
+                       timeout=120)
+    except subprocess.CalledProcessError as exc:
+        pytest.fail(f"native coordd failed to BUILD:\n{exc.stderr[-2000:]}")
+    assert os.path.exists(COORDD)
     return COORDD
 
 
