@@ -316,14 +316,72 @@ def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
     return out.reshape(B, H, S2, D).astype(q.dtype)
 
 
-def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp"):
+def zigzag_ring_attention_flash(q, k, v, *, axis_name: str = "sp"):
+    """``zigzag_ring_attention`` with the Pallas flash kernel per chunk and
+    logsumexp merging (see ``ring_attention_flash``) — load-balanced causal
+    SP on the MXU path.  Same chunk schedule: q_hi×kv_lo merges every step,
+    exactly one of q_lo×kv_lo / q_hi×kv_hi merges depending on the source.
+    """
+    from tpu_dra.workloads.pallas_kernels import flash_attention_with_lse
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, S2, D = q.shape
+    C = S2 // 2
+    interpret = jax.default_backend() != "tpu"
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qz = q.reshape(B, H, 2, C, D)
+    q_lo, q_hi = qz[:, :, 0], qz[:, :, 1]
+    kv = jnp.stack([k, v])                 # [2, B, H, 2C, D] circulates
+
+    def attend(qc, kc, vc, is_causal):
+        return flash_attention_with_lse(qc, kc, vc, causal=is_causal,
+                                        interpret=interpret)
+
+    # t = 0: source is self — both diagonals plus q_hi over its own past lo
+    kv0 = kv.reshape(2, B, H, 2, C, D)
+    lo = attend(q_lo, kv0[0, :, :, 0], kv0[1, :, :, 0], True)
+    hi = attend(q_hi, kv0[0, :, :, 1], kv0[1, :, :, 1], True)
+    hi = _merge_partials(*hi, *attend(q_hi, kv0[0, :, :, 0],
+                                      kv0[1, :, :, 0], False))
+
+    def step(t, carry):
+        kv, lo, hi = carry
+        kv = jax.lax.ppermute(kv, axis_name, perm)
+        src = (idx - t) % n
+        kvz = kv.reshape(2, B, H, 2, C, D)
+        k_lo, v_lo = kvz[0, :, :, 0], kvz[1, :, :, 0]
+        k_hi, v_hi = kvz[0, :, :, 1], kvz[1, :, :, 1]
+        # q_hi (chunk 2n-1-idx) is later than every lo chunk (src ≤ n-1)
+        hi = _merge_partials(*hi, *attend(q_hi, k_lo, v_lo, False))
+        # exactly one of the remaining pairs is unmasked (see the xla twin)
+        lo, hi = jax.lax.cond(
+            src < idx,
+            lambda lo, hi: (_merge_partials(
+                *lo, *attend(q_lo, k_lo, v_lo, False)), hi),
+            lambda lo, hi: (lo, _merge_partials(
+                *hi, *attend(q_hi, k_hi, v_hi, False))),
+            lo, hi)
+        return kv, lo, hi
+
+    _, lo, hi = jax.lax.fori_loop(1, n, step, (kv, lo, hi))
+    out = jnp.stack([lo[0], hi[0]], axis=2)        # [B, H, 2, C, D]
+    return out.reshape(B, H, S2, D).astype(q.dtype)
+
+
+def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
+                               impl: str = "xla"):
     """shard_map-wrapped zigzag ring attention for ``[B, H, S, D]`` arrays
     whose S axis is sharded over ``axis_name`` in zigzag order (permute
-    with ``zigzag_indices`` before sharding, invert after)."""
+    with ``zigzag_indices`` before sharding, invert after).
+    ``impl``: "xla" (fp32 einsums) or "flash" (Pallas kernels)."""
     batch = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch, None, axis_name, None)
+    zz = (zigzag_ring_attention_flash if impl == "flash"
+          else zigzag_ring_attention)
     fn = shard_map(
-        partial(zigzag_ring_attention, axis_name=axis_name),
+        partial(zz, axis_name=axis_name),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn
 
