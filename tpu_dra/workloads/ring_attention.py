@@ -244,8 +244,8 @@ def inverse_permutation(order):
     return jnp.argsort(order)
 
 
-def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
-    """Causal ring attention over zigzag-striped shards.
+def _zigzag_schedule(q, k, v, *, axis_name: str, attend, finalize):
+    """The balanced causal chunk schedule shared by both zigzag engines.
 
     Per-device shapes ``[B, H, 2C, D]`` where the two C-chunks are global
     chunks ``(i, 2n-1-i)`` (see ``zigzag_indices``).  Each ring step does
@@ -254,40 +254,27 @@ def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
     unmasked depending on the source's position — so no device burns MXU
     time on fully-masked blocks and none is the straggler (the plain
     ``ring_attention`` executes masked blocks to stay SPMD-uniform).
+
+    The engine is two callbacks: ``attend(carry_or_None, qc, kc, vc,
+    causal)`` folds one chunk-attend into the carry (None = first touch),
+    ``finalize(carry) -> [B, H, C, D]``.  Causality lives here exactly
+    once; engines supply only numerics.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, S2, D = q.shape
     C = S2 // 2
-    scale = D ** -0.5
-    neg = jnp.finfo(jnp.float32).min
 
-    split = lambda x: x.astype(jnp.float32).reshape(B, H, 2, C, D)
-    qz = split(q)
-    kv = jnp.stack([k, v])                 # [2, B, H, 2C, D] circulates
+    qz = q.reshape(B, H, 2, C, D)
     q_lo, q_hi = qz[:, :, 0], qz[:, :, 1]
-
-    rows = jnp.arange(C)[:, None]
-    cols = jnp.arange(C)[None, :]
-    tril = rows >= cols
-    ones = jnp.ones((C, C), bool)
+    kv = jnp.stack([k, v])                 # [2, B, H, 2C, D] circulates
     perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def attend(carry, qc, kc, vc, mask):
-        m, l, a = carry
-        return _block_attn(qc, kc.astype(jnp.float32),
-                           vc.astype(jnp.float32), m, l, a, mask, scale)
-
-    def zero_carry():
-        return (jnp.full((B, H, C), neg, jnp.float32),
-                jnp.zeros((B, H, C), jnp.float32),
-                jnp.zeros((B, H, C, D), jnp.float32))
 
     # t = 0: source is self — both diagonals plus q_hi over its own past lo
     kv0 = kv.reshape(2, B, H, 2, C, D)
-    lo = attend(zero_carry(), q_lo, kv0[0, :, :, 0], kv0[1, :, :, 0], tril)
-    hi = attend(zero_carry(), q_hi, kv0[0, :, :, 1], kv0[1, :, :, 1], tril)
-    hi = attend(hi, q_hi, kv0[0, :, :, 0], kv0[1, :, :, 0], ones)
+    lo = attend(None, q_lo, kv0[0, :, :, 0], kv0[1, :, :, 0], True)
+    hi = attend(None, q_hi, kv0[0, :, :, 1], kv0[1, :, :, 1], True)
+    hi = attend(hi, q_hi, kv0[0, :, :, 0], kv0[1, :, :, 0], False)
 
     def step(t, carry):
         kv, lo, hi = carry
@@ -297,77 +284,69 @@ def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
         k_lo, v_lo = kvz[0, :, :, 0], kvz[1, :, :, 0]
         k_hi, v_hi = kvz[0, :, :, 1], kvz[1, :, :, 1]
         # q_hi (chunk 2n-1-idx) is later than every lo chunk (s ≤ n-1)
-        hi = attend(hi, q_hi, k_lo, v_lo, ones)
+        hi = attend(hi, q_hi, k_lo, v_lo, False)
         # exactly one of the remaining pairs is unmasked:
         #   s < idx: q_lo (chunk idx) is past chunk s        → lo × kv_lo
         #   s > idx: q_hi is past chunk 2n-1-s (s>idx ⇒ 2n-1-s < 2n-1-idx)
         #            → hi × kv_hi
         lo, hi = jax.lax.cond(
             s < idx,
-            lambda lo, hi: (attend(lo, q_lo, k_lo, v_lo, ones), hi),
-            lambda lo, hi: (lo, attend(hi, q_hi, k_hi, v_hi, ones)),
+            lambda lo, hi: (attend(lo, q_lo, k_lo, v_lo, False), hi),
+            lambda lo, hi: (lo, attend(hi, q_hi, k_hi, v_hi, False)),
             lo, hi)
         return kv, lo, hi
 
     _, lo, hi = jax.lax.fori_loop(1, n, step, (kv, lo, hi))
-    out = jnp.stack([lo[2] / jnp.maximum(lo[1], 1e-30)[..., None],
-                     hi[2] / jnp.maximum(hi[1], 1e-30)[..., None]],
-                    axis=2)                        # [B, H, 2, C, D]
+    out = jnp.stack([finalize(lo), finalize(hi)], axis=2)  # [B, H, 2, C, D]
     return out.reshape(B, H, S2, D).astype(q.dtype)
+
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
+    """Causal ring attention over zigzag-striped shards — fp32 XLA engine
+    (running (m, l, acc) online softmax) under ``_zigzag_schedule``."""
+    B, H, S2, D = q.shape
+    C = S2 // 2
+    scale = D ** -0.5
+    neg = jnp.finfo(jnp.float32).min
+
+    rows = jnp.arange(C)[:, None]
+    cols = jnp.arange(C)[None, :]
+    tril = rows >= cols
+    ones = jnp.ones((C, C), bool)
+
+    def attend(carry, qc, kc, vc, causal):
+        if carry is None:
+            carry = (jnp.full((B, H, C), neg, jnp.float32),
+                     jnp.zeros((B, H, C), jnp.float32),
+                     jnp.zeros((B, H, C, D), jnp.float32))
+        m, l, a = carry
+        return _block_attn(qc.astype(jnp.float32), kc.astype(jnp.float32),
+                           vc.astype(jnp.float32), m, l, a,
+                           tril if causal else ones, scale)
+
+    def finalize(carry):
+        _, l, a = carry
+        return a / jnp.maximum(l, 1e-30)[..., None]
+
+    return _zigzag_schedule(q, k, v, axis_name=axis_name, attend=attend,
+                            finalize=finalize)
 
 
 def zigzag_ring_attention_flash(q, k, v, *, axis_name: str = "sp"):
     """``zigzag_ring_attention`` with the Pallas flash kernel per chunk and
     logsumexp merging (see ``ring_attention_flash``) — load-balanced causal
-    SP on the MXU path.  Same chunk schedule: q_hi×kv_lo merges every step,
-    exactly one of q_lo×kv_lo / q_hi×kv_hi merges depending on the source.
-    """
+    SP on the MXU path, same ``_zigzag_schedule``."""
     from tpu_dra.workloads.pallas_kernels import flash_attention_with_lse
 
-    n = jax.lax.psum(1, axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    B, H, S2, D = q.shape
-    C = S2 // 2
     interpret = jax.default_backend() != "tpu"
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
-    qz = q.reshape(B, H, 2, C, D)
-    q_lo, q_hi = qz[:, :, 0], qz[:, :, 1]
-    kv = jnp.stack([k, v])                 # [2, B, H, 2C, D] circulates
-
-    def attend(qc, kc, vc, is_causal):
-        return flash_attention_with_lse(qc, kc, vc, causal=is_causal,
+    def attend(carry, qc, kc, vc, causal):
+        part = flash_attention_with_lse(qc, kc, vc, causal=causal,
                                         interpret=interpret)
+        return part if carry is None else _merge_partials(*carry, *part)
 
-    # t = 0: source is self — both diagonals plus q_hi over its own past lo
-    kv0 = kv.reshape(2, B, H, 2, C, D)
-    lo = attend(q_lo, kv0[0, :, :, 0], kv0[1, :, :, 0], True)
-    hi = attend(q_hi, kv0[0, :, :, 1], kv0[1, :, :, 1], True)
-    hi = _merge_partials(*hi, *attend(q_hi, kv0[0, :, :, 0],
-                                      kv0[1, :, :, 0], False))
-
-    def step(t, carry):
-        kv, lo, hi = carry
-        kv = jax.lax.ppermute(kv, axis_name, perm)
-        src = (idx - t) % n
-        kvz = kv.reshape(2, B, H, 2, C, D)
-        k_lo, v_lo = kvz[0, :, :, 0], kvz[1, :, :, 0]
-        k_hi, v_hi = kvz[0, :, :, 1], kvz[1, :, :, 1]
-        # q_hi (chunk 2n-1-idx) is later than every lo chunk (src ≤ n-1)
-        hi = _merge_partials(*hi, *attend(q_hi, k_lo, v_lo, False))
-        # exactly one of the remaining pairs is unmasked (see the xla twin)
-        lo, hi = jax.lax.cond(
-            src < idx,
-            lambda lo, hi: (_merge_partials(
-                *lo, *attend(q_lo, k_lo, v_lo, False)), hi),
-            lambda lo, hi: (lo, _merge_partials(
-                *hi, *attend(q_hi, k_hi, v_hi, False))),
-            lo, hi)
-        return kv, lo, hi
-
-    _, lo, hi = jax.lax.fori_loop(1, n, step, (kv, lo, hi))
-    out = jnp.stack([lo[0], hi[0]], axis=2)        # [B, H, 2, C, D]
-    return out.reshape(B, H, S2, D).astype(q.dtype)
+    return _zigzag_schedule(q, k, v, axis_name=axis_name, attend=attend,
+                            finalize=lambda carry: carry[0])
 
 
 def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
@@ -376,6 +355,8 @@ def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
     whose S axis is sharded over ``axis_name`` in zigzag order (permute
     with ``zigzag_indices`` before sharding, invert after).
     ``impl``: "xla" (fp32 einsums) or "flash" (Pallas kernels)."""
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"unknown impl {impl!r}; expected 'xla' or 'flash'")
     batch = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch, None, axis_name, None)
     zz = (zigzag_ring_attention_flash if impl == "flash"
@@ -405,6 +386,9 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
         params["pos"].astype(jnp.bfloat16), sp_index * S, S, axis=0)
     x = x + pos
 
+    if ring_impl not in ("xla", "flash"):
+        raise ValueError(
+            f"unknown ring_impl {ring_impl!r}; expected 'xla' or 'flash'")
     ring_fn = ring_attention_flash if ring_impl == "flash" else ring_attention
     attn = partial(ring_fn, axis_name=axis_name, causal=True)
 
