@@ -217,26 +217,38 @@ def section_decode() -> dict:
         cfg = ModelConfig(vocab=32768, d_model=1024, n_heads=8, n_layers=8,
                           d_ff=4096, max_seq=1024)
         B, S, steps = 8, 128, 256
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab,
-                                dtype=jnp.int32)
-    # cache sized to the live sequence, not max_seq: decode reads the whole
-    # cache every step, so slack slots are pure HBM waste
-    dec = make_decoder(cfg, steps=steps, max_len=S + steps)
-    toks = dec(params, prompt)
-    _ = int(toks[0, -1])                      # compile + warm, host readback
-    best = float("inf")
-    for _ in range(3 if on_tpu else 1):
-        t0 = time.perf_counter()
+    def measure(cfg):
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                    cfg.vocab, dtype=jnp.int32)
+        # cache sized to the live sequence, not max_seq: decode reads the
+        # whole cache every step, so slack slots are pure HBM waste
+        dec = make_decoder(cfg, steps=steps, max_len=S + steps)
         toks = dec(params, prompt)
-        _ = int(toks[0, -1])
-        best = min(best, time.perf_counter() - t0)
-    return {
+        _ = int(toks[0, -1])                  # compile + warm, host readback
+        best = float("inf")
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.perf_counter()
+            toks = dec(params, prompt)
+            _ = int(toks[0, -1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    best = measure(cfg)
+    out = {
         "decode_tokens_per_s": round(B * steps / best, 1),
         "decode_steps": steps,
         "decode_batch": B,
         "decode_ms_per_token": round(best / steps * 1e3, 3),
     }
+    # GQA variant: kv_heads = n_heads/4 quarters the cache — the dominant
+    # per-step HBM read — without touching the q-side compute
+    import dataclasses
+    gqa = measure(dataclasses.replace(cfg, n_kv_heads=max(
+        1, cfg.n_heads // 4)))
+    out["decode_gqa_tokens_per_s"] = round(B * steps / gqa, 1)
+    out["decode_gqa_ms_per_token"] = round(gqa / steps * 1e3, 3)
+    return out
 
 
 def section_visibility() -> dict:
@@ -496,12 +508,36 @@ def run_tpu_sections() -> dict:
              "multiprocess"]
     if out.get("tpu_devices", 1) > 1:
         order.append("collectives")
+    consecutive_timeouts = 0
     for name in order:
         deadline = min(_DEADLINES[name], max(budget_left(), 0))
+        if consecutive_timeouts >= 2:
+            # tunnel looks wedged: fail fast (healthy sections finish in
+            # 30-60s) so the retry pass below still has budget when the
+            # tunnel recovers
+            deadline = min(deadline, 150)
         if deadline < 30:
             out[f"{name}_skipped"] = "tpu budget exhausted"
             continue
-        out.update(_run_section(name, deadline))
+        res = _run_section(name, deadline)
+        timed_out = "exceeded" in str(res.get(f"{name}_error", ""))
+        consecutive_timeouts = consecutive_timeouts + 1 if timed_out else 0
+        out.update(res)
+    # One retry pass for wedged sections: a mid-run tunnel drop times out
+    # every section after it (observed in-round: matmul landed, then
+    # pallas/flash/train/decode all hit their deadlines) — by the retry the
+    # tunnel has usually recovered, and completed numbers always survive.
+    for name in order:
+        if f"{name}_error" not in out:
+            continue
+        deadline = min(_DEADLINES[name], max(budget_left(), 0))
+        if deadline < 30:
+            break
+        res = _run_section(name, deadline)
+        if f"{name}_error" not in res:
+            out.pop(f"{name}_error", None)
+            out[f"{name}_retried"] = True
+            out.update(res)
     return out
 
 

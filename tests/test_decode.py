@@ -81,6 +81,28 @@ def test_sampled_decode_runs_and_respects_vocab(small):
     assert not jnp.array_equal(toks, toks2)
 
 
+def test_gqa_decode_matches_forward_oracle():
+    """GQA decode (half-size kv cache) stays pinned to the uncached
+    forward at every step."""
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(10))
+    B, S, steps = 2, 6, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    cache = init_kv_cache(cfg, B, cfg.max_seq)
+    assert cache["k"].shape == (2, B, 2, 32, 8)   # kv_heads=2, not 4
+    cache, logits = prefill(cfg, params, cache, prompt)
+    seq = prompt
+    for i in range(steps):
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, token[:, None]], axis=1)
+        ref = forward(cfg, params, seq)[:, -1]
+        logits, cache = _token_logits(cfg, params, cache, S + i, token)
+        err = jnp.max(jnp.abs(logits - ref))
+        assert float(err) < 5e-2, (i, float(err))
+
+
 def test_decode_respects_max_len(small):
     cfg, params = small
     prompt = jnp.zeros((1, 30), jnp.int32)

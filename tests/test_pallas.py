@@ -107,6 +107,55 @@ def test_flash_attention_cross_length_grads():
         assert float(err) < 8e-2, (name, float(err))
 
 
+@pytest.mark.parametrize("hkv", [1, 2])   # MQA and 2-group GQA
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_gqa_matches_repeated_kv(causal, hkv):
+    """GQA kv-head sharing (index-map // g, no repeat materialization) must
+    equal running the kernel on explicitly repeated kv — values and all
+    three gradients (dk/dv group-sum path included)."""
+    b, h, s, d = 1, 4, 256, 64
+    g = h // hkv
+    ks = jax.random.split(jax.random.PRNGKey(11), 4)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    w = jax.random.normal(ks[3], (b, h, s, d), jnp.float32)
+    rep = lambda t: jnp.repeat(t, g, axis=1)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(w * flash_attention(
+            q, k, v, causal=causal, bq=64, bk=64,
+            interpret=True).astype(jnp.float32))
+
+    def loss_rep(q, k, v):
+        return jnp.sum(w * flash_attention(
+            q, rep(k), rep(v), causal=causal, bq=64, bk=64,
+            interpret=True).astype(jnp.float32))
+
+    out = flash_attention(q, k, v, causal=causal, bq=64, bk=64,
+                          interpret=True)
+    ref = flash_attention(q, rep(k), rep(v), causal=causal, bq=64, bk=64,
+                          interpret=True)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                                 ref.astype(jnp.float32)))) < 1e-6
+
+    got = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    # rep() lives inside loss_rep, so jax.grad already group-sums the
+    # repeated-kv cotangents back to [b, hkv, s, d]
+    want = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+        err = jnp.max(jnp.abs(a.astype(jnp.float32) -
+                              b_.astype(jnp.float32)))
+        assert float(err) < 8e-2, (name, float(err))
+
+
+def test_flash_attention_rejects_bad_head_ratio():
+    q = jnp.zeros((1, 4, 128, 64), jnp.bfloat16)
+    kv = jnp.zeros((1, 3, 128, 64), jnp.bfloat16)
+    with pytest.raises(ValueError, match="kv heads"):
+        flash_attention(q, kv, kv, interpret=True)
+
+
 def _lse_oracle(q, k, v, causal):
     """fp32 attention + base-2 logsumexp of the scaled scores."""
     qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)

@@ -74,6 +74,37 @@ def test_sharded_matches_single_device():
     assert abs(float(ref) - float(sharded_loss)) < 5e-2
 
 
+def test_bad_kv_heads_rejected_at_config():
+    import pytest
+    with pytest.raises(ValueError, match="must divide"):
+        ModelConfig(n_heads=4, n_kv_heads=3)
+
+
+def test_gqa_train_step_descends_and_flash_matches_dense():
+    """GQA config (2 kv heads under 4 q heads): training works and the
+    flash path (kernel-level kv sharing) agrees with the dense path."""
+    cfg = ModelConfig(vocab=32, d_model=32, n_heads=4, n_kv_heads=2,
+                      n_layers=2, d_ff=64, max_seq=16)
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    assert params["blocks"]["wqkv"].shape == (2, 32, 32 + 2 * 16)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 32,
+                                dtype=jnp.int32)
+    dense = loss_fn(cfg, params, tokens, attn_impl="dense")
+    flash = loss_fn(cfg, params, tokens, attn_impl="flash")
+    assert abs(float(dense) - float(flash)) < 5e-2, (dense, flash)
+    step, p_shard, b_shard = make_sharded_train_step(cfg, mesh, lr=0.5)
+    sp = jax.device_put(params, p_shard)
+    st = jax.device_put(tokens, b_shard)
+    first = None
+    for _ in range(5):
+        sp, loss = step(sp, st)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+
+
 def test_flash_attn_impl_matches_dense():
     """attn_impl="flash" (Pallas fwd+bwd, interpret on CPU) must produce the
     same loss and a working update as the dense XLA path — including the

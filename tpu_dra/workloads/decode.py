@@ -35,49 +35,61 @@ from tpu_dra.workloads.train import (
 
 
 def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, Any]:
-    """Pre-allocated bf16 cache: ``k``/``v`` of [L, B, H, S_max, Dh]."""
-    shape = (cfg.n_layers, batch, cfg.n_heads, max_len, cfg.d_head)
+    """Pre-allocated bf16 cache: ``k``/``v`` of [L, B, Hkv, S_max, Dh].
+    GQA shrinks this (and the per-step HBM read that dominates decode) by
+    n_heads / kv_heads."""
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.d_head)
     return {"k": jnp.zeros(shape, jnp.bfloat16),
             "v": jnp.zeros(shape, jnp.bfloat16)}
 
 
-def _split_heads(cfg: ModelConfig, t):
+def _split_heads(cfg: ModelConfig, t, n: int | None = None):
     B, S = t.shape[:2]
-    return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
+    n = n or cfg.n_heads
+    return t.reshape(B, S, n, cfg.d_head).transpose(0, 2, 1, 3)
+
+
+def _split_qkv(cfg: ModelConfig, qkv):
+    D = cfg.d_model
+    return jnp.split(qkv, [D, D + cfg.d_kv], axis=-1)
 
 
 def _layer_kv(cfg: ModelConfig, layer, x):
     """k/v heads for a whole [B, S, D] activation block (prefill path)."""
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
-    _, k, v = jnp.split(qkv, 3, axis=-1)
-    return _split_heads(cfg, k), _split_heads(cfg, v)
+    _, k, v = _split_qkv(cfg, qkv)
+    return (_split_heads(cfg, k, cfg.kv_heads),
+            _split_heads(cfg, v, cfg.kv_heads))
 
 
 def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     """One decoder block for a single-token [B, 1, D] activation against a
-    [B, H, S_max, Dh] cache; returns (x, new_k, new_v) where new_k/new_v
-    are this token's heads [B, H, 1, Dh] (the caller writes them at
-    ``pos`` — they are already reflected in the attention below).
-    """
+    [B, Hkv, S_max, Dh] cache; returns (x, k_all, v_all) with this token's
+    k/v written at ``pos``.  q's n_heads attend the shared kv heads in
+    groups (einsum broadcast, no repeat)."""
     B = x.shape[0]
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k, v = (_split_heads(cfg, t) for t in (q, k, v))   # [B, H, 1, Dh]
+    q, k, v = _split_qkv(cfg, qkv)
+    q = _split_heads(cfg, q)                              # [B, H, 1, Dh]
+    k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, 1, Dh]
+    v = _split_heads(cfg, v, cfg.kv_heads)
 
     k_all = jax.lax.dynamic_update_slice(
         k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
     v_all = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
 
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_all) * (cfg.d_head ** -0.5)
+    hkv, g = cfg.kv_heads, cfg.n_heads // cfg.kv_heads
+    qg = q.reshape(B, hkv, g, cfg.d_head)                 # q len 1 squeezed
+    scores = jnp.einsum("bkgd,bksd->bkgs", qg, k_all) * (cfg.d_head ** -0.5)
     # mask positions beyond the current token (cache tail is zeros)
     valid = jnp.arange(k_cache.shape[2])[None, None, None, :] <= pos
     scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
     attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", attn, v_all)
-    out = out.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+    out = jnp.einsum("bkgs,bksd->bkgd", attn, v_all)
+    out = out.reshape(B, 1, cfg.n_heads * cfg.d_head)
     x = x + out @ layer["wo"].astype(x.dtype)
 
     h2 = _rmsnorm(x, layer["ln2"])

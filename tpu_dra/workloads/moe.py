@@ -58,7 +58,8 @@ def init_moe_params(cfg: MoEConfig, key) -> dict[str, Any]:
         "embed": norm(keys[0], (cfg.vocab, cfg.d_model)),
         "pos": norm(keys[1], (cfg.max_seq, cfg.d_model)),
         "blocks": {
-            "wqkv": norm(keys[2], (L, cfg.d_model, 3 * cfg.d_model)),
+            "wqkv": norm(keys[2],
+                         (L, cfg.d_model, cfg.d_model + 2 * cfg.d_kv)),
             "wo": norm(keys[3], (L, cfg.d_model, cfg.d_model)),
             "wg": norm(keys[4], (L, cfg.d_model, E)),
             "w1": norm(keys[5], (L, E, cfg.d_model, cfg.d_ff)),

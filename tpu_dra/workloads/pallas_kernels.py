@@ -156,9 +156,18 @@ _LOG2E = 1.4426950408889634
 def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
                     interpret: bool):
     """Returns ``(out, l2)`` — l2 is the per-row base-2 logsumexp
-    ``[BH, S, 1]`` residual consumed by the backward kernels."""
+    ``[BH, S, 1]`` residual consumed by the backward kernels.
+
+    GQA/MQA: ``k``/``v`` may carry fewer head-batches than ``q``
+    (``BHkv = BH / g``).  kv sharing costs nothing — the k/v BlockSpec
+    index maps divide the head-batch grid index by ``g``, so the same kv
+    block feeds ``g`` consecutive q heads without materializing a repeat.
+    (Flat layout makes this exact: with heads minor in the fold,
+    ``(batch·H + h) // g == batch·Hkv + h//g``.)"""
     bh, s, d = q.shape
-    sk = k.shape[1]
+    bhkv, sk = k.shape[0], k.shape[1]
+    assert bh % bhkv == 0, (bh, bhkv)
+    g = bh // bhkv
     bq, bk = min(bq, s), min(bk, sk)
     assert s % bq == 0 and sk % bk == 0, \
         f"seq lens {(s, sk)} must tile by {(bq, bk)}"
@@ -174,8 +183,8 @@ def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -304,9 +313,17 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     Blocks are capped at 512 regardless of the forward's: the backward
     holds four [bq, bk] fp32 intermediates (s2/p/dp/ds) per step, so the
     forward's 1024² sweet spot overflows VMEM here (measured 2.6× slower
-    on v5e at S=4096)."""
+    on v5e at S=4096).
+
+    GQA (``k``/``v`` with BHkv = BH/grp head-batches): dQ shares kv blocks
+    through ``// grp`` index maps like the forward; dK/dV runs at per-q-head
+    resolution (each q head's contribution lands in its own [BH, Sk, D]
+    slot — no revisited output blocks, no cross-head races) and the group
+    sum down to [BHkv, Sk, D] happens in one XLA reshape+sum."""
     bh, s, d = q.shape
-    sk = k.shape[1]
+    bhkv, sk = k.shape[0], k.shape[1]
+    assert bh % bhkv == 0, (bh, bhkv)
+    grp = bh // bhkv
     bq, bk = min(bq, s), min(bk, sk)
     if s % 512 == 0:
         bq = min(bq, 512)
@@ -327,8 +344,8 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     common = dict(
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // grp, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // grp, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
@@ -353,8 +370,8 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     dkdv_specs = dict(common)
     dkdv_specs["in_specs"] = [
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // grp, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // grp, j, 0)),
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
@@ -371,6 +388,10 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
                         pltpu.VMEM((bk, d), jnp.float32)],
         **dkdv_specs,
     )(qs, k, v, g, l2.reshape(bh, 1, s), dd.reshape(bh, 1, s))
+    if grp > 1:
+        dk = dk.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
+        dv = dv.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
+        dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
     return dq, dk, dv
 
 
@@ -432,6 +453,23 @@ def _flash_lse_vjp_bwd(causal, bq, bk, interpret, res, gs):
 _flash_attn_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
+def _validate_and_fold(q, k, v, causal):
+    """Shared [B, H, S, D] → [BH, S, D] entry checks+fold for the public
+    flash wrappers: equal q/k lengths under causal (the mask uses
+    start-aligned indices — unequal lengths would silently give
+    non-standard semantics) and a whole number of q heads per kv head."""
+    b, h, s, d = q.shape
+    if causal and k.shape[2] != s:
+        raise ValueError(
+            f"causal flash_attention requires equal q/k lengths, "
+            f"got q seq {s} vs k seq {k.shape[2]}")
+    if h % k.shape[1]:
+        raise ValueError(f"q heads {h} not a multiple of kv heads "
+                         f"{k.shape[1]}")
+    fold = lambda x: x.reshape(b * x.shape[1], x.shape[2], d)
+    return fold(q), fold(k), fold(v)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "bq", "bk", "interpret"))
 def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
@@ -444,13 +482,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
     Both outputs are differentiable; the l2 cotangent folds into the same
     backward kernels."""
     b, h, s, d = q.shape
-    if causal and k.shape[2] != s:
-        raise ValueError(
-            f"causal flash_attention requires equal q/k lengths, "
-            f"got q seq {s} vs k seq {k.shape[2]}")
-    fold = lambda x: x.reshape(b * h, x.shape[2], d)
-    out, l2 = _flash_attn_lse(fold(q), fold(k), fold(v), causal, bq, bk,
-                              interpret)
+    qf, kf, vf = _validate_and_fold(q, k, v, causal)
+    out, l2 = _flash_attn_lse(qf, kf, vf, causal, bq, bk, interpret)
     return out.reshape(b, h, s, d), l2.reshape(b, h, s)
 
 
@@ -468,16 +501,14 @@ def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
     sequences train at the same memory footprint they infer.  Complements
     ``ring_attention``: this is the per-device kernel; the ring handles the
     sequence-sharded case.
+
+    GQA/MQA: ``k``/``v`` may have fewer heads than ``q`` (H % Hkv == 0);
+    kv blocks are shared across the head group inside the kernels via
+    index maps — no repeat materialization in either direction.
     """
     b, h, s, d = q.shape
-    if causal and k.shape[2] != s:
-        # the mask uses start-aligned indices; unequal lengths would give
-        # non-standard causal semantics silently
-        raise ValueError(
-            f"causal flash_attention requires equal q/k lengths, "
-            f"got q seq {s} vs k seq {k.shape[2]}")
-    fold = lambda x: x.reshape(b * h, x.shape[2], d)
-    out = _flash_attn(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    qf, kf, vf = _validate_and_fold(q, k, v, causal)
+    out = _flash_attn(qf, kf, vf, causal, bq, bk, interpret)
     return out.reshape(b, h, s, d)
 
 

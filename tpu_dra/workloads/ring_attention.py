@@ -163,6 +163,12 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
     masked, the diagonal block intra-masked).  This is the fp32 XLA
     engine; ``ring_attention_flash`` is the Pallas-kernel variant.
     """
+    # GQA inputs: the fp32 engine's einsums want matched heads.  Repeat kv
+    # at attend time only — the [B, Hkv, S, D] blocks circulate the ring,
+    # so ppermute moves just the shared heads (the flash engine shares kv
+    # natively via kernel index maps).
+    grp = q.shape[1] // k.shape[1]
+    rep = (lambda t: jnp.repeat(t, grp, axis=1)) if grp > 1 else (lambda t: t)
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, S, D = q.shape
@@ -188,8 +194,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
         k_blk, v_blk, m, l, acc = carry
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        m, l, acc = _block_attn(qf, k_blk.astype(jnp.float32),
-                                v_blk.astype(jnp.float32),
+        m, l, acc = _block_attn(qf, rep(k_blk).astype(jnp.float32),
+                                rep(v_blk).astype(jnp.float32),
                                 m, l, acc, block_mask((idx - t) % n), scale)
         return k_blk, v_blk, m, l, acc
 
@@ -199,8 +205,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
     m0 = jnp.full((B, H, S), neg, jnp.float32)
     l0 = jnp.zeros((B, H, S), jnp.float32)
     acc0 = jnp.zeros((B, H, S, D), jnp.float32)
-    m, l, acc = _block_attn(qf, k.astype(jnp.float32),
-                            v.astype(jnp.float32),
+    m, l, acc = _block_attn(qf, rep(k).astype(jnp.float32),
+                            rep(v).astype(jnp.float32),
                             m0, l0, acc0, block_mask(idx), scale)
     _, _, _, l, acc = jax.lax.fori_loop(
         1, n, step, (k, v, m, l, acc))
@@ -263,15 +269,16 @@ def _zigzag_schedule(q, k, v, *, axis_name: str, attend, finalize):
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, H, S2, D = q.shape
+    Hkv = k.shape[1]                       # may be < H (GQA, flash engine)
     C = S2 // 2
 
     qz = q.reshape(B, H, 2, C, D)
     q_lo, q_hi = qz[:, :, 0], qz[:, :, 1]
-    kv = jnp.stack([k, v])                 # [2, B, H, 2C, D] circulates
+    kv = jnp.stack([k, v])                 # [2, B, Hkv, 2C, D] circulates
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # t = 0: source is self — both diagonals plus q_hi over its own past lo
-    kv0 = kv.reshape(2, B, H, 2, C, D)
+    kv0 = kv.reshape(2, B, Hkv, 2, C, D)
     lo = attend(None, q_lo, kv0[0, :, :, 0], kv0[1, :, :, 0], True)
     hi = attend(None, q_hi, kv0[0, :, :, 1], kv0[1, :, :, 1], True)
     hi = attend(hi, q_hi, kv0[0, :, :, 0], kv0[1, :, :, 0], False)
@@ -280,7 +287,7 @@ def _zigzag_schedule(q, k, v, *, axis_name: str, attend, finalize):
         kv, lo, hi = carry
         kv = jax.lax.ppermute(kv, axis_name, perm)
         s = (idx - t) % n
-        kvz = kv.reshape(2, B, H, 2, C, D)
+        kvz = kv.reshape(2, B, Hkv, 2, C, D)
         k_lo, v_lo = kvz[0, :, :, 0], kvz[1, :, :, 0]
         k_hi, v_hi = kvz[0, :, :, 1], kvz[1, :, :, 1]
         # q_hi (chunk 2n-1-idx) is later than every lo chunk (s ≤ n-1)
@@ -304,6 +311,10 @@ def _zigzag_schedule(q, k, v, *, axis_name: str, attend, finalize):
 def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
     """Causal ring attention over zigzag-striped shards — fp32 XLA engine
     (running (m, l, acc) online softmax) under ``_zigzag_schedule``."""
+    # GQA: circulate the shared kv heads, repeat only at attend time (the
+    # ppermute inside _zigzag_schedule then moves Hkv, not H, heads)
+    grp = q.shape[1] // k.shape[1]
+    rep = (lambda t: jnp.repeat(t, grp, axis=1)) if grp > 1 else (lambda t: t)
     B, H, S2, D = q.shape
     C = S2 // 2
     scale = D ** -0.5
@@ -320,8 +331,9 @@ def zigzag_ring_attention(q, k, v, *, axis_name: str = "sp"):
                      jnp.zeros((B, H, C), jnp.float32),
                      jnp.zeros((B, H, C, D), jnp.float32))
         m, l, a = carry
-        return _block_attn(qc.astype(jnp.float32), kc.astype(jnp.float32),
-                           vc.astype(jnp.float32), m, l, a,
+        return _block_attn(qc.astype(jnp.float32),
+                           rep(kc).astype(jnp.float32),
+                           rep(vc).astype(jnp.float32), m, l, a,
                            tril if causal else ones, scale)
 
     def finalize(carry):

@@ -38,10 +38,31 @@ class ModelConfig:
     n_layers: int = 2
     d_ff: int = 512
     max_seq: int = 128
+    # GQA/MQA: number of shared k/v heads (None → MHA, one kv head per q
+    # head).  Shrinks the qkv projection and — the real win — the decode
+    # KV cache by n_heads/n_kv_heads.
+    n_kv_heads: int | None = None
+
+    def __post_init__(self):
+        # validate the invariant every attention path (dense, flash,
+        # decode, ring) relies on, at config altitude — the per-path
+        # failures are opaque reshape errors deep inside jit
+        if self.n_kv_heads is not None and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_kv_heads {self.n_kv_heads} must divide "
+                f"n_heads {self.n_heads}")
 
     @property
     def d_head(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def d_kv(self) -> int:
+        return self.kv_heads * self.d_head
 
 
 def init_params(cfg: ModelConfig, key) -> dict[str, Any]:
@@ -58,7 +79,8 @@ def init_params(cfg: ModelConfig, key) -> dict[str, Any]:
         "embed": norm(keys[0], (cfg.vocab, cfg.d_model)),
         "pos": norm(keys[1], (cfg.max_seq, cfg.d_model)),
         "blocks": {
-            "wqkv": norm(keys[2], (L, cfg.d_model, 3 * cfg.d_model)),
+            "wqkv": norm(keys[2],
+                         (L, cfg.d_model, cfg.d_model + 2 * cfg.d_kv)),
             "wo": norm(keys[3], (L, cfg.d_model, cfg.d_model)),
             "w1": norm(keys[4], (L, cfg.d_model, cfg.d_ff)),
             "w2": norm(keys[5], (L, cfg.d_ff, cfg.d_model)),
@@ -76,28 +98,35 @@ def _rmsnorm(x, g):
 
 
 def _causal_dense_attention(q, k, v):
-    """Default attention: dense causal softmax over ``[B, H, S, D]`` heads.
-    Sequence-parallel runs swap in ring_attention here."""
-    S = q.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    """Default attention: dense causal softmax over ``[B, H, S, D]`` q
+    against ``[B, Hkv, S, D]`` k/v (Hkv divides H; Hkv == H is plain MHA).
+    kv heads are shared across the group through einsum broadcasting — no
+    repeat materialization.  Sequence-parallel runs swap in ring_attention
+    here."""
+    B, H, S, D = q.shape
+    hkv = k.shape[1]
+    qg = q.reshape(B, hkv, H // hkv, S, D)
+    scores = jnp.einsum("bkgqd,bksd->bkgqs", qg, k) * (D ** -0.5)
     mask = jnp.tril(jnp.ones((S, S), bool))
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    out = jnp.einsum("bkgqs,bksd->bkgqd", attn, v)
+    return out.reshape(B, H, S, D)
 
 
 def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
     """Pre-norm attention residual sublayer, shared by the dense and MoE
-    blocks."""
+    blocks.  GQA-aware: q carries n_heads, k/v carry kv_heads."""
     B, S, D = x.shape
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [D, D + cfg.d_kv], axis=-1)
 
-    def heads(t):
-        return t.reshape(B, S, cfg.n_heads, cfg.d_head).transpose(0, 2, 1, 3)
+    def heads(t, n):
+        return t.reshape(B, S, n, cfg.d_head).transpose(0, 2, 1, 3)
 
-    out = attn_fn(heads(q), heads(k), heads(v))
+    out = attn_fn(heads(q, cfg.n_heads), heads(k, cfg.kv_heads),
+                  heads(v, cfg.kv_heads))
     out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
     return x + out @ layer["wo"].astype(x.dtype)
 
