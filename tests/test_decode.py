@@ -103,6 +103,29 @@ def test_gqa_decode_matches_forward_oracle():
         assert float(err) < 5e-2, (i, float(err))
 
 
+def test_rope_decode_matches_forward_oracle():
+    """RoPE decode: rotated-key cache + rotated q must reproduce the
+    uncached forward exactly — the cache-rotation consistency check."""
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(12))
+    B, S, steps = 2, 6, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    cache = init_kv_cache(cfg, B, cfg.max_seq)
+    cache, logits = prefill(cfg, params, cache, prompt)
+    ref0 = forward(cfg, params, prompt)[:, -1]
+    assert float(jnp.max(jnp.abs(logits - ref0))) < 5e-2
+    seq = prompt
+    for i in range(steps):
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, token[:, None]], axis=1)
+        ref = forward(cfg, params, seq)[:, -1]
+        logits, cache = _token_logits(cfg, params, cache, S + i, token)
+        err = jnp.max(jnp.abs(logits - ref))
+        assert float(err) < 5e-2, (i, float(err))
+
+
 def test_decode_respects_max_len(small):
     cfg, params = small
     prompt = jnp.zeros((1, 30), jnp.int32)

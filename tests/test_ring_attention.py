@@ -228,6 +228,28 @@ def test_ring_matches_single_device_train_loss():
                                rtol=2e-3)
 
 
+def test_rope_sp_trunk_matches_single_device_loss():
+    """RoPE under sequence parallelism: shard-global positions must make
+    the DP×SP loss equal the single-device loss over the same tokens and
+    (globally rolled) targets."""
+    from tpu_dra.workloads.train import _trunk, head_nll
+
+    cfg = ModelConfig(vocab=32, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32, pos_emb="rope")
+    mesh = _mesh((2, 4), ("dp", "sp"))
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    tokens = jax.random.randint(jax.random.PRNGKey(8), (4, 32), 0, 32,
+                                dtype=jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    step, tok_sh = make_ring_train_step(cfg, mesh)
+    _, loss = step(params,
+                   jax.device_put(tokens, tok_sh),
+                   jax.device_put(targets, tok_sh))
+    ref = float(jnp.mean(head_nll(params, _trunk(cfg, params, tokens),
+                                  targets)))
+    assert abs(float(loss) - ref) < 5e-2, (float(loss), ref)
+
+
 def test_zigzag_ring_attention_matches_dense():
     """Zigzag striping must be numerically identical to dense causal
     attention after unpermuting (8-way ring, 16 chunks)."""

@@ -55,12 +55,20 @@ def _split_qkv(cfg: ModelConfig, qkv):
 
 
 def _layer_kv(cfg: ModelConfig, layer, x):
-    """k/v heads for a whole [B, S, D] activation block (prefill path)."""
+    """k/v heads for a whole [B, S, D] activation block (prefill path).
+    With rope, keys are stored ROTATED (standard practice): absolute
+    rotations in the cache + a rotated q give the relative-position
+    dot products without re-rotating history every step."""
+    from tpu_dra.workloads.train import apply_rope
+
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
     _, k, v = _split_qkv(cfg, qkv)
-    return (_split_heads(cfg, k, cfg.kv_heads),
-            _split_heads(cfg, v, cfg.kv_heads))
+    k = _split_heads(cfg, k, cfg.kv_heads)
+    if cfg.pos_emb == "rope":
+        k = apply_rope(k, jnp.arange(x.shape[1], dtype=jnp.int32),
+                       cfg.rope_base)
+    return k, _split_heads(cfg, v, cfg.kv_heads)
 
 
 def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
@@ -75,6 +83,11 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     q = _split_heads(cfg, q)                              # [B, H, 1, Dh]
     k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, 1, Dh]
     v = _split_heads(cfg, v, cfg.kv_heads)
+    if cfg.pos_emb == "rope":
+        from tpu_dra.workloads.train import apply_rope
+        positions = jnp.asarray(pos, jnp.int32)[None]     # [1]
+        q = apply_rope(q, positions, cfg.rope_base)
+        k = apply_rope(k, positions, cfg.rope_base)       # cached rotated
 
     k_all = jax.lax.dynamic_update_slice(
         k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
@@ -102,8 +115,9 @@ def _token_logits(cfg: ModelConfig, params, cache, pos, token):
     """One decode step: [B] token ids at position ``pos`` → ([B, vocab]
     logits, updated cache)."""
     x = params["embed"].astype(jnp.bfloat16)[token][:, None, :]   # [B, 1, D]
-    x = x + jax.lax.dynamic_slice_in_dim(
-        params["pos"].astype(jnp.bfloat16), pos, 1, axis=0)
+    if cfg.pos_emb == "learned":
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos"].astype(jnp.bfloat16), pos, 1, axis=0)
 
     def block(carry, inputs):
         layer, k_cache, v_cache = inputs
@@ -129,7 +143,8 @@ def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
 
     S = prompt.shape[1]
     x = params["embed"].astype(jnp.bfloat16)[prompt]
-    x = x + params["pos"].astype(jnp.bfloat16)[:S]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[:S]
     attn_fn = _ATTN_IMPLS[attn_impl]
 
     def block(carry, inputs):

@@ -54,9 +54,8 @@ def init_moe_params(cfg: MoEConfig, key) -> dict[str, Any]:
     def norm(k, shape):
         return (jax.random.normal(k, shape) * scale).astype(jnp.float32)
 
-    return {
+    params: dict[str, Any] = {
         "embed": norm(keys[0], (cfg.vocab, cfg.d_model)),
-        "pos": norm(keys[1], (cfg.max_seq, cfg.d_model)),
         "blocks": {
             "wqkv": norm(keys[2],
                          (L, cfg.d_model, cfg.d_model + 2 * cfg.d_kv)),
@@ -70,6 +69,9 @@ def init_moe_params(cfg: MoEConfig, key) -> dict[str, Any]:
         "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
         "unembed": norm(keys[7], (cfg.d_model, cfg.vocab)),
     }
+    if cfg.pos_emb == "learned":
+        params["pos"] = norm(keys[1], (cfg.max_seq, cfg.d_model))
+    return params
 
 
 def moe_ffn(cfg: MoEConfig, x, wg, w1, w2, capacity: int | None = None,
@@ -144,7 +146,8 @@ def _moe_trunk(cfg: MoEConfig, params, tokens, capacity: int | None,
                mesh: Mesh | None):
     """Embed + MoE decoder stack → (pre-final-norm activations, Σ aux)."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
-    x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
     block = jax.checkpoint(
         lambda carry, layer: _moe_block(cfg, carry, layer, capacity, mesh))
@@ -171,8 +174,8 @@ def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    return {
-        "embed": s(), "pos": s(),
+    out = {
+        "embed": s(),
         "blocks": {
             "wqkv": s(), "wo": s(), "wg": s(),
             "w1": s(None, "ep", None, None),
@@ -182,6 +185,9 @@ def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
         "ln_f": s(),
         "unembed": s(),
     }
+    if cfg.pos_emb == "learned":
+        out["pos"] = s()
+    return out
 
 
 def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):

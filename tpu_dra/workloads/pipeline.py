@@ -82,7 +82,8 @@ def _pipeline_loss(cfg: ModelConfig, n_stages: int, n_micro: int,
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
 
     x = params["embed"].astype(jnp.bfloat16)[inp]
-    x = x + params["pos"].astype(jnp.bfloat16)[: inp.shape[1]]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[: inp.shape[1]]
     Bl, S, D = x.shape
     x_micro = x.reshape(n_micro, Bl // n_micro, S, D)
 
@@ -100,14 +101,16 @@ def _pipeline_loss(cfg: ModelConfig, n_stages: int, n_micro: int,
 def pipeline_param_specs(cfg: ModelConfig) -> dict[str, Any]:
     """PartitionSpecs: stacked blocks split over "pp" (layer axis), small
     tensors replicated on every stage."""
-    return {
+    out = {
         "embed": P(),
-        "pos": P(),
         "blocks": {k: P("pp") for k in
                    ("wqkv", "wo", "w1", "w2", "ln1", "ln2")},
         "ln_f": P(),
         "unembed": P(),
     }
+    if cfg.pos_emb == "learned":
+        out["pos"] = P()
+    return out
 
 
 def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh,

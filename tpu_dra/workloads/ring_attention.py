@@ -394,9 +394,14 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
 
     S = tokens.shape[1]
     x = params["embed"].astype(jnp.bfloat16)[tokens]
-    pos = jax.lax.dynamic_slice_in_dim(
-        params["pos"].astype(jnp.bfloat16), sp_index * S, S, axis=0)
-    x = x + pos
+    positions = None
+    if cfg.pos_emb == "learned":
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["pos"].astype(jnp.bfloat16), sp_index * S, S, axis=0)
+    else:
+        # rope rotates q/k inside the block — give it this shard's GLOBAL
+        # positions so relative offsets hold across shard boundaries
+        positions = sp_index * S + jnp.arange(S, dtype=jnp.int32)
 
     if ring_impl not in ("xla", "flash"):
         raise ValueError(
@@ -405,7 +410,8 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
     attn = partial(ring_fn, axis_name=axis_name, causal=True)
 
     def block(carry, layer):
-        return _block(cfg, carry, layer, attn_fn=attn), None
+        return _block(cfg, carry, layer, attn_fn=attn,
+                      positions=positions), None
 
     x, _ = jax.lax.scan(jax.checkpoint(block), x, params["blocks"])
     return x

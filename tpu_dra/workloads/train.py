@@ -42,8 +42,18 @@ class ModelConfig:
     # head).  Shrinks the qkv projection and — the real win — the decode
     # KV cache by n_heads/n_kv_heads.
     n_kv_heads: int | None = None
+    # "learned" (absolute embedding table) or "rope" (rotary, applied to
+    # q/k per head — relative positions, no table, extrapolates past
+    # max_seq, standard for current decoder LMs)
+    pos_emb: str = "learned"
+    rope_base: float = 10000.0
 
     def __post_init__(self):
+        if self.pos_emb not in ("learned", "rope"):
+            raise ValueError(f"unknown pos_emb {self.pos_emb!r}")
+        if self.pos_emb == "rope" and self.d_head % 2:
+            raise ValueError(
+                f"rope needs an even head dim, got d_head {self.d_head}")
         # validate the invariant every attention path (dense, flash,
         # decode, ring) relies on, at config altitude — the per-path
         # failures are opaque reshape errors deep inside jit
@@ -75,9 +85,8 @@ def init_params(cfg: ModelConfig, key) -> dict[str, Any]:
     def norm(k, shape):
         return (jax.random.normal(k, shape) * scale).astype(jnp.float32)
 
-    return {
+    params: dict[str, Any] = {
         "embed": norm(keys[0], (cfg.vocab, cfg.d_model)),
-        "pos": norm(keys[1], (cfg.max_seq, cfg.d_model)),
         "blocks": {
             "wqkv": norm(keys[2],
                          (L, cfg.d_model, cfg.d_model + 2 * cfg.d_kv)),
@@ -90,11 +99,32 @@ def init_params(cfg: ModelConfig, key) -> dict[str, Any]:
         "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
         "unembed": norm(keys[6], (cfg.d_model, cfg.vocab)),
     }
+    if cfg.pos_emb == "learned":
+        params["pos"] = norm(keys[1], (cfg.max_seq, cfg.d_model))
+    return params
 
 
 def _rmsnorm(x, g):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6) * g).astype(x.dtype)
+
+
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotate ``[..., S, Dh]`` head vectors by position (RoPE).
+
+    ``positions``: int32 ``[S]`` (broadcast over batch/heads).  Half-split
+    convention (rotate (x[:d/2], x[d/2:]) pairs); computed in fp32, cast
+    back — a pure elementwise op XLA fuses into the surrounding matmuls.
+    """
+    dh = x.shape[-1]
+    half = dh // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]   # [S, half]
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
 def _causal_dense_attention(q, k, v):
@@ -114,9 +144,12 @@ def _causal_dense_attention(q, k, v):
     return out.reshape(B, H, S, D)
 
 
-def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
+def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
+                   positions=None):
     """Pre-norm attention residual sublayer, shared by the dense and MoE
-    blocks.  GQA-aware: q carries n_heads, k/v carry kv_heads."""
+    blocks.  GQA-aware: q carries n_heads, k/v carry kv_heads.  With
+    ``pos_emb="rope"``, q/k rotate by ``positions`` (default: 0..S-1;
+    sequence-parallel callers pass their global offsets)."""
     B, S, D = x.shape
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
@@ -125,15 +158,22 @@ def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
     def heads(t, n):
         return t.reshape(B, S, n, cfg.d_head).transpose(0, 2, 1, 3)
 
-    out = attn_fn(heads(q, cfg.n_heads), heads(k, cfg.kv_heads),
-                  heads(v, cfg.kv_heads))
+    q, k, v = (heads(q, cfg.n_heads), heads(k, cfg.kv_heads),
+               heads(v, cfg.kv_heads))
+    if cfg.pos_emb == "rope":
+        if positions is None:
+            positions = jnp.arange(S, dtype=jnp.int32)
+        q = apply_rope(q, positions, cfg.rope_base)
+        k = apply_rope(k, positions, cfg.rope_base)
+    out = attn_fn(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
     return x + out @ layer["wo"].astype(x.dtype)
 
 
-def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention):
+def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
+           positions=None):
     """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
-    x = _attn_sublayer(cfg, x, layer, attn_fn)
+    x = _attn_sublayer(cfg, x, layer, attn_fn, positions)
     h = _rmsnorm(x, layer["ln2"])
     h = jax.nn.gelu(h @ layer["w1"].astype(x.dtype))
     return x + h @ layer["w2"].astype(x.dtype)
@@ -166,7 +206,8 @@ _ATTN_IMPLS = {"dense": _causal_dense_attention, "flash": _flash_attention_fn}
 def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention):
     """Embed + decoder stack; returns pre-final-norm activations."""
     x = params["embed"].astype(jnp.bfloat16)[tokens]
-    x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
     # Selective remat: save matmul outputs, recompute elementwise ops in the
     # backward.  Measured on v5e @ S=1024/B=16: 60.5% MFU vs 57.0% full
@@ -222,9 +263,8 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
     def s(*spec):
         return NamedSharding(mesh, P(*spec))
 
-    return {
+    out = {
         "embed": s(None, "tp"),
-        "pos": s(None, "tp"),
         "blocks": {
             "wqkv": s(None, None, "tp"),
             "wo": s(None, "tp", None),
@@ -236,6 +276,9 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
         "ln_f": s(None),
         "unembed": s(None, "tp"),
     }
+    if cfg.pos_emb == "learned":
+        out["pos"] = s(None, "tp")
+    return out
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
