@@ -1,0 +1,106 @@
+"""Token-stream data pipeline: memmap dataset → dp-sharded batches →
+device prefetch.
+
+The reference ships no data path (it is infrastructure under workloads);
+a framework a tenant can switch to needs one.  TPU-first shape: the
+dataset is a flat token file read through ``numpy.memmap`` (no copy, OS
+page cache does the caching), batches are cut deterministically so every
+data-parallel worker computes its own disjoint slice from (step, rank)
+alone — no coordination channel, restarts are exact — and an iterator
+wrapper keeps one batch in flight to the device so host IO overlaps the
+train step (the classic double-buffer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+
+class TokenDataset:
+    """Flat binary token file (little-endian integer dtype) as a sequence
+    source.  ``len(ds)`` is the token count; slicing returns np arrays."""
+
+    def __init__(self, path: str, dtype: str = "uint16"):
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        size = os.path.getsize(path)
+        if size % self.dtype.itemsize:
+            raise ValueError(
+                f"{path}: size {size} not a multiple of {self.dtype}")
+        self.tokens = np.memmap(path, dtype=self.dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    @staticmethod
+    def write(path: str, tokens: np.ndarray, dtype: str = "uint16") -> None:
+        """Helper for tests/tools: persist a 1-D token array."""
+        np.asarray(tokens, dtype=np.dtype(dtype)).tofile(path)
+
+
+def batch_index(step: int, rank: int, batch: int, seq: int,
+                n_tokens: int, world: int = 1) -> np.ndarray:
+    """Start offsets for (step, rank): deterministic and disjoint across
+    ranks within a step.  [batch] int64.
+
+    The stream is cut into ``n_windows`` non-overlapping (seq+1)-token
+    windows; a global window counter g = step·B·W + rank·B + i walks them
+    mod n_windows.  Requires batch·world ≤ n_windows (validated) so the
+    windows of one global step are always distinct — a naive byte-offset
+    modulo can alias ranks onto each other once it wraps.
+    """
+    n_windows = (n_tokens - 1) // seq
+    per_step = batch * world
+    if per_step > n_windows:
+        raise ValueError(
+            f"global batch {per_step} windows/step exceeds the dataset's "
+            f"{n_windows} windows of seq {seq} — ranks would collide")
+    g = step * per_step + rank * batch + np.arange(batch, dtype=np.int64)
+    return (g % n_windows) * seq
+
+
+def batches(ds: TokenDataset, *, batch: int, seq: int, rank: int = 0,
+            world: int = 1, start_step: int = 0) -> Iterator[np.ndarray]:
+    """Infinite iterator of ``[batch, seq+1]`` int32 windows (inputs and
+    shifted targets come from the same window; the +1 is the shift).
+
+    Deterministic from (step, rank, world): a resumed run that passes the
+    checkpointed step as ``start_step`` sees exactly the batches the
+    crashed run would have seen.
+    """
+    n = len(ds)
+    if n < seq + 2:
+        raise ValueError(f"dataset has {n} tokens < seq+2 {seq + 2}")
+    step = start_step
+    idx = np.arange(seq + 1, dtype=np.int64)
+    while True:
+        starts = batch_index(step, rank, batch, seq, n, world)
+        yield np.asarray(ds.tokens[starts[:, None] + idx], dtype=np.int32)
+        step += 1
+
+
+def device_prefetch(it: Iterator[np.ndarray], sharding=None,
+                    depth: int = 2) -> Iterator[Any]:
+    """Keep ``depth`` batches in flight to the device.
+
+    ``jax.device_put`` is async: issuing the next transfer before yielding
+    the current batch overlaps host→device copy (and host slicing) with
+    the running step.  ``sharding`` is a ``NamedSharding`` (e.g. the train
+    step's batch sharding) or None for default placement.
+    """
+    from collections import deque
+
+    buf: deque = deque()
+    try:
+        for arr in it:
+            buf.append(jax.device_put(arr, sharding))
+            if len(buf) >= depth:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+    finally:
+        buf.clear()

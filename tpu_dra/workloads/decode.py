@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from tpu_dra.workloads.train import (
     ModelConfig,
     _rmsnorm,
+    apply_rope,
     head_logits,
 )
 
@@ -59,8 +60,6 @@ def _layer_kv(cfg: ModelConfig, layer, x):
     With rope, keys are stored ROTATED (standard practice): absolute
     rotations in the cache + a rotated q give the relative-position
     dot products without re-rotating history every step."""
-    from tpu_dra.workloads.train import apply_rope
-
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
     _, k, v = _split_qkv(cfg, qkv)
@@ -84,7 +83,6 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, 1, Dh]
     v = _split_heads(cfg, v, cfg.kv_heads)
     if cfg.pos_emb == "rope":
-        from tpu_dra.workloads.train import apply_rope
         positions = jnp.asarray(pos, jnp.int32)[None]     # [1]
         q = apply_rope(q, positions, cfg.rope_base)
         k = apply_rope(k, positions, cfg.rope_base)       # cached rotated

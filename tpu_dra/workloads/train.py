@@ -297,3 +297,52 @@ def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
         in_shardings=(p_shard, b_shard),
         out_shardings=(p_shard, NamedSharding(mesh, P())))
     return step, p_shard, b_shard
+
+
+def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
+                          attn_impl: str = "dense"):
+    """Like ``make_sharded_train_step`` but with a real optax optimizer
+    (default: AdamW + global-norm clipping).
+
+    Returns ``(step, init_opt_state, p_shard, b_shard)`` where
+    ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
+    Optimizer state shards like the params it mirrors (optax states are
+    pytrees whose array leaves match param shapes; scalar leaves
+    replicate), so dp×tp layouts carry over moment buffers for free.
+    """
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.chain(optax.clip_by_global_norm(1.0),
+                                optax.adamw(3e-4, weight_decay=0.01))
+    p_shard = param_shardings(cfg, mesh)
+    b_shard = batch_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # jit alone does NOT propagate input shardings through init (XLA is
+    # free to replicate the moment buffers — measured), and leaving the
+    # step's opt_state out_sharding open would let the compiler drop the
+    # layout again after one step.  Build the sharding tree once:
+    # optax.tree_map_params knows which state leaves mirror params (→
+    # that param's sharding); everything else (step counts) replicates.
+    p_shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    opt_shapes = jax.eval_shape(optimizer.init, p_shapes)
+    opt_sh = optax.tree_map_params(
+        optimizer, lambda _leaf, s: s, opt_shapes, p_shard,
+        transform_non_params=lambda _leaf: rep)
+
+    def init_opt_state(params):
+        return jax.jit(optimizer.init, out_shardings=opt_sh)(params)
+
+    step = jax.jit(train_step,
+                   in_shardings=(p_shard, opt_sh, b_shard),
+                   out_shardings=(p_shard, opt_sh, rep))
+    return step, init_opt_state, p_shard, b_shard
