@@ -118,3 +118,60 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
                                 "step": start + steps})
     return FitResult(step=start + steps, loss=lossf, losses=losses,
                      tokens_per_s=tokens_done / max(secs, 1e-9))
+
+
+def main(argv=None):
+    """CLI: train the flagship config on a token file, on whatever chips
+    the claim injected.  ``python -m tpu_dra.workloads.fit --data t.bin``.
+
+    Calls ``launcher.init_tpu_workload()`` first, so inside a claim
+    container this picks up visibility env, MultiProcess slots, HBM
+    limits, and the slice-domain coordination triple exactly like the demo
+    jobs do."""
+    import argparse
+    import os
+
+    from tpu_dra.workloads.launcher import init_tpu_workload
+
+    # honor an explicit platform request before the first backend probe:
+    # the axon sitecustomize pins jax_platforms via jax.config (beating the
+    # env var), and the first device touch would then block on the tunnel
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--data", required=True, help="flat token file")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--n-kv-heads", type=int, default=None)
+    ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--max-seq", type=int, default=512)
+    ap.add_argument("--pos-emb", default="rope",
+                    choices=("rope", "learned"))
+    ap.add_argument("--attn-impl", default="dense",
+                    choices=("dense", "flash"))
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args(argv)
+
+    init_tpu_workload()
+    cfg = ModelConfig(vocab=args.vocab, d_model=args.d_model,
+                      n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+                      n_layers=args.n_layers, d_ff=args.d_ff,
+                      max_seq=args.max_seq, pos_emb=args.pos_emb)
+    res = fit(cfg, args.data, steps=args.steps, batch=args.batch,
+              attn_impl=args.attn_impl, checkpoint_dir=args.checkpoint_dir,
+              checkpoint_every=args.checkpoint_every, resume=args.resume)
+    print(f"done: step {res.step} loss {res.loss:.4f} "
+          f"{res.tokens_per_s:.0f} tok/s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
