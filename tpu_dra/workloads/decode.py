@@ -70,11 +70,24 @@ def _layer_kv(cfg: ModelConfig, layer, x):
     return k, _split_heads(cfg, v, cfg.kv_heads)
 
 
+def _write_kv(cache, new, pos):
+    """Write a [B, Hkv, 1, Dh] entry at ``pos`` — a scalar (dense slice,
+    the fast aligned path) or a per-sequence [B] vector (scatter, the
+    ragged path)."""
+    if jnp.ndim(pos) == 0:
+        return jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype), (0, 0, pos, 0))
+    B = cache.shape[0]
+    return cache.at[jnp.arange(B), :, pos].set(
+        new.astype(cache.dtype)[:, :, 0])
+
+
 def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     """One decoder block for a single-token [B, 1, D] activation against a
     [B, Hkv, S_max, Dh] cache; returns (x, k_all, v_all) with this token's
-    k/v written at ``pos``.  q's n_heads attend the shared kv heads in
-    groups (einsum broadcast, no repeat)."""
+    k/v written at ``pos`` (scalar, or [B] for ragged batches — every
+    sequence at its own position).  q's n_heads attend the shared kv heads
+    in groups (einsum broadcast, no repeat)."""
     B = x.shape[0]
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
@@ -83,20 +96,21 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, 1, Dh]
     v = _split_heads(cfg, v, cfg.kv_heads)
     if cfg.pos_emb == "rope":
-        positions = jnp.asarray(pos, jnp.int32)[None]     # [1]
+        positions = (jnp.asarray(pos, jnp.int32)[None] if jnp.ndim(pos) == 0
+                     else pos.astype(jnp.int32)[:, None])   # [1] or [B, 1]
         q = apply_rope(q, positions, cfg.rope_base)
         k = apply_rope(k, positions, cfg.rope_base)       # cached rotated
 
-    k_all = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
-    v_all = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+    k_all = _write_kv(k_cache, k, pos)
+    v_all = _write_kv(v_cache, v, pos)
 
     hkv, g = cfg.kv_heads, cfg.n_heads // cfg.kv_heads
     qg = q.reshape(B, hkv, g, cfg.d_head)                 # q len 1 squeezed
     scores = jnp.einsum("bkgd,bksd->bkgs", qg, k_all) * (cfg.d_head ** -0.5)
-    # mask positions beyond the current token (cache tail is zeros)
-    valid = jnp.arange(k_cache.shape[2])[None, None, None, :] <= pos
+    # mask positions beyond the current token (cache tail beyond each
+    # sequence's own pos holds zeros or not-yet-overwritten pad junk)
+    valid = (jnp.arange(k_cache.shape[2])[None, None, None, :]
+             <= jnp.reshape(pos, (-1, 1, 1, 1)))
     scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
     attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgs,bksd->bkgd", attn, v_all)
@@ -110,12 +124,14 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
 
 
 def _token_logits(cfg: ModelConfig, params, cache, pos, token):
-    """One decode step: [B] token ids at position ``pos`` → ([B, vocab]
-    logits, updated cache)."""
+    """One decode step: [B] token ids at position ``pos`` (scalar or [B])
+    → ([B, vocab] logits, updated cache)."""
     x = params["embed"].astype(jnp.bfloat16)[token][:, None, :]   # [B, 1, D]
     if cfg.pos_emb == "learned":
-        x = x + jax.lax.dynamic_slice_in_dim(
-            params["pos"].astype(jnp.bfloat16), pos, 1, axis=0)
+        # gather handles both the scalar and per-sequence cases; the
+        # reshape makes a scalar broadcast over the batch
+        x = x + params["pos"].astype(jnp.bfloat16)[
+            jnp.reshape(pos, (-1,))][:, None, :]
 
     def block(carry, inputs):
         layer, k_cache, v_cache = inputs
@@ -129,9 +145,10 @@ def _token_logits(cfg: ModelConfig, params, cache, pos, token):
     return logits, {"k": k_new, "v": v_new}
 
 
-def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
-    """Run the prompt [B, S] through the training trunk, fill the cache for
-    positions [0, S), and return (cache, last-token logits [B, vocab]).
+def _prefill_trunk(cfg: ModelConfig, params, cache, prompt,
+                   attn_impl: str = "dense"):
+    """Shared prefill: run [B, S] through the training trunk, fill the
+    cache for positions [0, S), return (cache, trunk activations [B,S,D]).
 
     The trunk recomputes activations layer by layer for the k/v projections
     — two passes over the prompt total, both batched MXU work (the flash
@@ -157,8 +174,29 @@ def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
         "v": jax.lax.dynamic_update_slice(
             cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
     }
-    logits = head_logits(params, x[:, -1:])[:, 0]
-    return cache, logits
+    return cache, x
+
+
+def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
+    """Prefill for equal-length prompts: (cache, last-token logits)."""
+    cache, x = _prefill_trunk(cfg, params, cache, prompt, attn_impl)
+    return cache, head_logits(params, x[:, -1:])[:, 0]
+
+
+def prefill_ragged(cfg: ModelConfig, params, cache, prompts, lengths,
+                   attn_impl: str = "dense"):
+    """Prefill for right-padded [B, S_pad] prompts with true ``lengths``
+    [B]: (cache, logits at each sequence's own last real token).
+
+    Correctness under padding: causal attention means rows < len_b never
+    see pad columns, and cached pad-slot k/v are only ever attendable
+    AFTER decode has overwritten them (every sequence's write position
+    walks len_b, len_b+1, … and the mask admits ≤ the current position).
+    """
+    cache, x = _prefill_trunk(cfg, params, cache, prompts, attn_impl)
+    B = prompts.shape[0]
+    last = x[jnp.arange(B), lengths - 1][:, None, :]      # [B, 1, D]
+    return cache, head_logits(params, last)[:, 0]
 
 
 def _select_token(logits, key, temperature: float, top_k: int):
@@ -174,30 +212,47 @@ def _select_token(logits, key, temperature: float, top_k: int):
 
 
 def decode(cfg: ModelConfig, params, prompt, *, steps: int,
-           max_len: int | None = None, attn_impl: str = "dense",
-           temperature: float = 0.0, top_k: int = 0, rng=None):
+           lengths=None, max_len: int | None = None,
+           attn_impl: str = "dense", temperature: float = 0.0,
+           top_k: int = 0, rng=None):
     """Decode ``steps`` tokens after a [B, S] prompt — greedy by default,
     temperature/top-k sampling when ``temperature > 0``.
 
-    Returns [B, steps] int32 tokens.  One jittable function: prefill +
-    ``lax.scan`` over decode steps (jit at the call site — ``make_decoder``
-    below does).
+    ``lengths`` (optional [B] int32) makes the batch ragged: ``prompt`` is
+    right-padded and every sequence advances from its own true length
+    (scatter cache writes, per-sequence masks/rotations) — see
+    ``decode_ragged``.  Returns [B, steps] int32 tokens.  One jittable
+    function: prefill + ``lax.scan`` over decode steps (jit at the call
+    site — ``make_decoder`` below does).
     """
     B, S = prompt.shape
     max_len = max_len or cfg.max_seq
     assert S + steps <= max_len, (S, steps, max_len)
+    if lengths is not None:
+        lengths = lengths.astype(jnp.int32)
+        if not isinstance(lengths, jax.core.Tracer):
+            import numpy as np
+            ln = np.asarray(lengths)
+            if (ln < 1).any() or (ln > S).any():
+                raise ValueError(
+                    f"lengths must lie in [1, {S}], got {ln.tolist()}")
     if temperature > 0.0 and rng is None:
         rng = jax.random.PRNGKey(0)
     keys = (jax.random.split(rng, steps + 1) if temperature > 0.0
             else jnp.zeros((steps + 1, 2), jnp.uint32))
     cache = init_kv_cache(cfg, B, max_len)
-    cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
+    if lengths is None:
+        cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
+    else:
+        cache, logits = prefill_ragged(cfg, params, cache, prompt, lengths,
+                                       attn_impl)
     first = _select_token(logits, keys[0], temperature, top_k)
 
     def step(carry, inputs):
         i, key = inputs
         cache, token = carry
-        logits, cache = _token_logits(cfg, params, cache, S + i, token)
+        pos = S + i if lengths is None else lengths + i
+        logits, cache = _token_logits(cfg, params, cache, pos, token)
         nxt = _select_token(logits, key, temperature, top_k)
         return (cache, nxt), token
 
@@ -214,6 +269,23 @@ def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
     """Greedy-decode ``steps`` tokens after a [B, S] prompt."""
     return decode(cfg, params, prompt, steps=steps, max_len=max_len,
                   attn_impl=attn_impl)
+
+
+def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
+                  max_len: int | None = None, attn_impl: str = "dense",
+                  temperature: float = 0.0, top_k: int = 0, rng=None):
+    """Batched decode over right-padded prompts of different lengths —
+    continuous-batching-lite: one compiled program serves a mixed batch,
+    every sequence advancing from its own position (scatter cache writes,
+    per-sequence masks and rope rotations).
+
+    ``prompts``: [B, S_pad] int32 right-padded; ``lengths``: [B] true
+    prompt lengths in [1, S_pad].  Returns [B, steps] tokens.  Thin alias
+    for ``decode(..., lengths=lengths)``.
+    """
+    return decode(cfg, params, prompts, steps=steps, lengths=lengths,
+                  max_len=max_len, attn_impl=attn_impl,
+                  temperature=temperature, top_k=top_k, rng=rng)
 
 
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,

@@ -110,16 +110,19 @@ def _rmsnorm(x, g):
 
 
 def apply_rope(x, positions, base: float = 10000.0):
-    """Rotate ``[..., S, Dh]`` head vectors by position (RoPE).
+    """Rotate ``[B, H, S, Dh]`` head vectors by position (RoPE).
 
-    ``positions``: int32 ``[S]`` (broadcast over batch/heads).  Half-split
-    convention (rotate (x[:d/2], x[d/2:]) pairs); computed in fp32, cast
-    back — a pure elementwise op XLA fuses into the surrounding matmuls.
+    ``positions``: int32 ``[S]`` (shared across the batch) or ``[B, S]``
+    (per-sequence, e.g. ragged decode).  Half-split convention (rotate
+    (x[:d/2], x[d/2:]) pairs); computed in fp32, cast back — a pure
+    elementwise op XLA fuses into the surrounding matmuls.
     """
     dh = x.shape[-1]
     half = dh // 2
     inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]   # [S, half]
+    ang = positions.astype(jnp.float32)[..., :, None] * inv   # [(B,) S, half]
+    if positions.ndim == 2:
+        ang = ang[:, None]                 # [B, 1, S, half]: over heads
     sin, cos = jnp.sin(ang), jnp.cos(ang)
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
