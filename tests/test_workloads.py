@@ -74,6 +74,33 @@ def test_sharded_matches_single_device():
     assert abs(float(ref) - float(sharded_loss)) < 5e-2
 
 
+@pytest.mark.parametrize("vocab", [64, 50])   # 50 % 16 != 0: divisor fallback
+def test_chunked_head_matches_dense_values_and_grads(vocab):
+    """head_impl="chunked" (streamed-vocab online-logsumexp NLL with a
+    custom bwd) must match the dense head: loss value and every param
+    gradient — including for vocabs the default chunk count doesn't
+    divide."""
+    cfg = ModelConfig(vocab=vocab, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, vocab,
+                                dtype=jnp.int32)
+    dense = loss_fn(cfg, params, tokens, head_impl="dense")
+    chunked = loss_fn(cfg, params, tokens, head_impl="chunked")
+    assert abs(float(dense) - float(chunked)) < 2e-3, (dense, chunked)
+
+    gd = jax.grad(lambda p: loss_fn(cfg, p, tokens,
+                                    head_impl="dense"))(params)
+    gc = jax.grad(lambda p: loss_fn(cfg, p, tokens,
+                                    head_impl="chunked"))(params)
+    flat_d = jax.tree_util.tree_leaves_with_path(gd)
+    flat_c = jax.tree.leaves(gc)
+    for (path, a), b in zip(flat_d, flat_c):
+        err = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-6
+        assert err < 5e-2 * max(scale, 1.0), (path, err, scale)
+
+
 def test_optax_train_step_descends_sharded():
     """make_optax_train_step: AdamW+clip under dp×tp shardings descends,
     with moment buffers inheriting the param layouts."""

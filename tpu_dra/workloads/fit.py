@@ -41,7 +41,8 @@ class FitResult:
 
 def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
         steps: int = 100, batch: int = 8, optimizer=None,
-        attn_impl: str = "dense", checkpoint_dir: str | None = None,
+        attn_impl: str = "dense", head_impl: str = "dense",
+        checkpoint_dir: str | None = None,
         checkpoint_every: int = 0, resume: bool = False,
         log_every: int = 10, seed: int = 0,
         log_fn: Callable[[str], None] = print) -> FitResult:
@@ -64,7 +65,8 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
     seq = cfg.max_seq
     ds = TokenDataset(data_path)
     step_fn, init_opt, p_shard, b_shard = make_optax_train_step(
-        cfg, mesh, optimizer=optimizer, attn_impl=attn_impl)
+        cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
+        head_impl=head_impl)
 
     start = 0
     params = jax.device_put(init_params(cfg, jax.random.PRNGKey(seed)),
@@ -120,6 +122,51 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
                      tokens_per_s=tokens_done / max(secs, 1e-9))
 
 
+def evaluate(cfg: ModelConfig, params, data_path: str, *,
+             mesh: Mesh | None = None, batches_n: int = 16, batch: int = 8,
+             attn_impl: str = "dense",
+             head_impl: str = "dense") -> dict[str, float]:
+    """Evaluation over a fixed slice at the TAIL of the window space: mean
+    NLL and perplexity over ``batches_n`` deterministic batches.
+
+    Training from step 0 consumes windows from the front, so the tail
+    slice stays held-out until a run wraps the dataset (train for fewer
+    than ``n_windows/batch - batches_n`` steps to keep it clean).
+    ``head_impl="chunked"`` evaluates without materializing the full
+    [B, S, V] logits — use it wherever training needed it."""
+    from functools import partial
+
+    from tpu_dra.workloads.train import (
+        batch_sharding,
+        loss_fn,
+        param_shardings,
+    )
+
+    if mesh is None:
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
+    if batch % mesh.shape["dp"]:
+        raise ValueError(
+            f"batch {batch} must be divisible by dp {mesh.shape['dp']}")
+    ds = TokenDataset(data_path)
+    p_shard = param_shardings(cfg, mesh)
+    b_shard = batch_sharding(mesh)
+    loss_j = jax.jit(partial(loss_fn, cfg, attn_impl=attn_impl,
+                             head_impl=head_impl),
+                     in_shardings=(p_shard, b_shard))
+    params = jax.device_put(params, p_shard)
+    n_windows = (len(ds) - 1) // cfg.max_seq
+    tail_step = max(0, n_windows // batch - batches_n)
+    it = device_prefetch(
+        batches(ds, batch=batch, seq=cfg.max_seq, start_step=tail_step),
+        b_shard)
+    total = 0.0
+    for _ in range(batches_n):
+        total += float(loss_j(params, next(it)))
+    nll = total / batches_n
+    return {"nll": nll, "perplexity": float(np.exp(nll))}
+
+
 def main(argv=None):
     """CLI: train the flagship config on a token file, on whatever chips
     the claim injected.  ``python -m tpu_dra.workloads.fit --data t.bin``.
@@ -155,6 +202,8 @@ def main(argv=None):
                     choices=("rope", "learned"))
     ap.add_argument("--attn-impl", default="dense",
                     choices=("dense", "flash"))
+    ap.add_argument("--head-impl", default="dense",
+                    choices=("dense", "chunked"))
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", action="store_true")
@@ -166,7 +215,8 @@ def main(argv=None):
                       n_layers=args.n_layers, d_ff=args.d_ff,
                       max_seq=args.max_seq, pos_emb=args.pos_emb)
     res = fit(cfg, args.data, steps=args.steps, batch=args.batch,
-              attn_impl=args.attn_impl, checkpoint_dir=args.checkpoint_dir,
+              attn_impl=args.attn_impl, head_impl=args.head_impl,
+              checkpoint_dir=args.checkpoint_dir,
               checkpoint_every=args.checkpoint_every, resume=args.resume)
     print(f"done: step {res.step} loss {res.loss:.4f} "
           f"{res.tokens_per_s:.0f} tok/s")

@@ -229,13 +229,107 @@ def head_logits(params, x):
     return (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
 
 
-def head_nll(params, x, targets):
+def head_nll(params, x, targets, head_impl: str = "dense",
+             n_chunks: int = 16):
     """Per-token NLL through the final head (ln_f → unembed → log_softmax →
     target gather).  The one shared head for the dense/sp/pp/ep losses, so a
     head change (z-loss, label smoothing, softcap) lands in all of them at
-    once; callers reduce (mean / psum-of-sums) as their sharding requires."""
+    once; callers reduce (mean / psum-of-sums) as their sharding requires.
+
+    ``head_impl="chunked"`` streams the vocab in ``n_chunks`` pieces with
+    an online logsumexp so the [B, S, V] fp32 logits never materialize —
+    HBM drops from O(B·S·V) to O(B·S·V/n_chunks) in forward AND backward
+    (the bwd recomputes each chunk's logits from the saved lse).  Best on
+    single-chip / dp runs; under tp the vocab axis is already sharded and
+    per-chunk slicing would cut across it."""
+    if head_impl == "chunked":
+        B, S, D = x.shape
+        V = params["unembed"].shape[1]
+        # largest divisor of V ≤ the requested chunk count — non-divisible
+        # vocabs (e.g. 50257) degrade gracefully instead of asserting
+        n = min(n_chunks, V)
+        while V % n:
+            n -= 1
+        h = _rmsnorm(x, params["ln_f"]).reshape(B * S, D)
+        w = params["unembed"].astype(jnp.bfloat16)
+        nll = _chunked_nll(h.astype(jnp.bfloat16), w,
+                           targets.reshape(B * S), n)
+        return nll.reshape(B, S, 1)
     logp = jax.nn.log_softmax(head_logits(params, x), axis=-1)
     return -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+
+
+def _chunked_logits_stats(x, w, targets, n_chunks):
+    """Online logsumexp + target-logit over vocab chunks.
+    x [N, D] bf16; w [D, V] bf16; targets [N].  Returns (lse, t_logit)."""
+    N = x.shape[0]
+    V = w.shape[1]
+    C = V // n_chunks
+    assert C * n_chunks == V, (V, n_chunks)
+
+    def body(carry, c):
+        m, l, t = carry
+        wc = jax.lax.dynamic_slice_in_dim(w, c * C, C, axis=1)
+        logits = jnp.dot(x, wc, preferred_element_type=jnp.float32)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        off = targets - c * C
+        hit = (off >= 0) & (off < C)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(off, 0, C - 1)[:, None], axis=1)[:, 0]
+        t = t + jnp.where(hit, picked, 0.0)
+        return (m_new, l, t), None
+
+    init = (jnp.full((N,), jnp.finfo(jnp.float32).min, jnp.float32),
+            jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    (m, l, t), _ = jax.lax.scan(body, init,
+                                jnp.arange(n_chunks, dtype=jnp.int32))
+    return m + jnp.log(l), t
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _chunked_nll(x, w, targets, n_chunks):
+    lse, t = _chunked_logits_stats(x, w, targets, n_chunks)
+    return lse - t
+
+
+def _chunked_nll_fwd(x, w, targets, n_chunks):
+    lse, t = _chunked_logits_stats(x, w, targets, n_chunks)
+    return lse - t, (x, w, targets, lse)
+
+
+def _chunked_nll_bwd(n_chunks, res, g):
+    """d nll/d logits = softmax − onehot(target); recompute each chunk's
+    logits from the saved lse instead of keeping them."""
+    x, w, targets, lse = res
+    V = w.shape[1]
+    C = V // n_chunks
+    gf = g.astype(jnp.float32)
+
+    def body(dx, c):
+        wc = jax.lax.dynamic_slice_in_dim(w, c * C, C, axis=1)
+        logits = jnp.dot(x, wc, preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])
+        off = targets - c * C
+        onehot = (off[:, None] ==
+                  jnp.arange(C, dtype=targets.dtype)[None, :])
+        ds = ((p - onehot) * gf[:, None]).astype(jnp.bfloat16)   # [N, C]
+        dx = dx + jnp.dot(ds, wc.T, preferred_element_type=jnp.float32)
+        dwc = jax.lax.dot_general(                               # [D, C]
+            x, ds, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dx, dwc
+
+    dx0 = jnp.zeros(x.shape, jnp.float32)
+    dx, dwcs = jax.lax.scan(body, dx0,
+                            jnp.arange(n_chunks, dtype=jnp.int32))
+    # [n_chunks, D, C] → [D, n_chunks·C] with chunk c at columns c·C…
+    dw = jnp.moveaxis(dwcs, 0, 1).reshape(x.shape[1], V)
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+_chunked_nll.defvjp(_chunked_nll_fwd, _chunked_nll_bwd)
 
 
 def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
@@ -244,16 +338,18 @@ def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
                                       _ATTN_IMPLS[attn_impl]))
 
 
-def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
+def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
+            head_impl: str = "dense"):
     trunk = _trunk(cfg, params, tokens[:, :-1], _ATTN_IMPLS[attn_impl])
-    return jnp.mean(head_nll(params, trunk, tokens[:, 1:]))
+    return jnp.mean(head_nll(params, trunk, tokens[:, 1:], head_impl))
 
 
 def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens,
-                   attn_impl: str = "dense"):
+                   attn_impl: str = "dense", head_impl: str = "dense"):
     """Full train step (fwd+bwd+update) as one jittable function."""
     loss, grads = jax.value_and_grad(
-        partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl)
+        partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl,
+                               head_impl=head_impl)
     params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return params, loss
 
@@ -289,21 +385,25 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
-                            attn_impl: str = "dense"):
+                            attn_impl: str = "dense",
+                            head_impl: str = "dense"):
     """jit the full train step with DP×TP shardings over ``mesh`` (axes
     "dp", "tp").  ``attn_impl``: "dense" (XLA, best at short S) or "flash"
-    (Pallas fwd+bwd kernels, best at long S)."""
+    (Pallas fwd+bwd kernels, best at long S).  ``head_impl``: "dense" or
+    "chunked" (streamed-vocab NLL, see head_nll)."""
     p_shard = param_shardings(cfg, mesh)
     b_shard = batch_sharding(mesh)
     step = jax.jit(
-        partial(sgd_train_step, cfg, lr, attn_impl=attn_impl),
+        partial(sgd_train_step, cfg, lr, attn_impl=attn_impl,
+                head_impl=head_impl),
         in_shardings=(p_shard, b_shard),
         out_shardings=(p_shard, NamedSharding(mesh, P())))
     return step, p_shard, b_shard
 
 
 def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
-                          attn_impl: str = "dense"):
+                          attn_impl: str = "dense",
+                          head_impl: str = "dense"):
     """Like ``make_sharded_train_step`` but with a real optax optimizer
     (default: AdamW + global-norm clipping).
 
@@ -324,7 +424,8 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl)
+            partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl,
+                                   head_impl=head_impl)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
