@@ -31,6 +31,19 @@ def test_dataset_roundtrip_and_validation(tmp_path, corpus):
         TokenDataset(str(bad), dtype="uint32")
 
 
+def test_encode_bytes_roundtrip(tmp_path):
+    from tpu_dra.workloads.data import encode_bytes
+
+    src = tmp_path / "corpus.txt"
+    src.write_text("hello tpu! ünïcode\n")
+    out = str(tmp_path / "tokens.bin")
+    n = encode_bytes(str(src), out)
+    ds = TokenDataset(out)
+    assert len(ds) == n == len(src.read_bytes())
+    assert bytes(ds.tokens[:5].astype(np.uint8)) == b"hello"
+    assert int(ds.tokens.max()) < 256
+
+
 def test_batch_index_disjoint_across_ranks():
     seen = set()
     for rank in range(4):

@@ -1,0 +1,68 @@
+"""HTTP inference server over the KV-cache decoder (CPU)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dra.workloads.decode import greedy_decode
+from tpu_dra.workloads.serve import serve
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve(cfg, params, port=0)
+    host, port = srv.server_address
+    yield cfg, params, f"http://{host}:{port}"
+    srv.shutdown()
+
+
+def _post(base, body, timeout=120):
+    req = urllib.request.Request(
+        f"{base}/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_healthz(server):
+    _, _, base = server
+    assert urllib.request.urlopen(
+        f"{base}/healthz", timeout=10).read() == b"ok"
+
+
+def test_generate_matches_local_decode(server):
+    cfg, params, base = server
+    prompt = [3, 1, 4, 1, 5]
+    out = _post(base, {"tokens": [prompt], "steps": 6})
+    want = greedy_decode(cfg, params,
+                         jnp.asarray([prompt], jnp.int32), steps=6)
+    assert out["tokens"] == [want[0].tolist()]
+
+
+def test_generate_mixed_lengths_batch(server):
+    cfg, params, base = server
+    rows = [[1, 2, 3], [9, 8, 7, 6, 5, 4, 3]]
+    out = _post(base, {"tokens": rows, "steps": 4})
+    for row, got in zip(rows, out["tokens"]):
+        want = greedy_decode(cfg, params, jnp.asarray([row], jnp.int32),
+                             steps=4)
+        assert got == want[0].tolist(), (row, got, want[0].tolist())
+
+
+def test_generate_rejects_bad_input(server):
+    _, _, base = server
+    for bad in ({"tokens": [], "steps": 2},
+                {"tokens": [[999]], "steps": 2},
+                {"tokens": [[1]], "steps": 999}):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base, bad)
+        assert exc.value.code == 400
+        assert "error" in json.loads(exc.value.read())

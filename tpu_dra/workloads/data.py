@@ -42,6 +42,24 @@ class TokenDataset:
         np.asarray(tokens, dtype=np.dtype(dtype)).tofile(path)
 
 
+def encode_bytes(text_path: str, out_path: str,
+                 chunk_bytes: int = 64 << 20) -> int:
+    """Byte-level tokenization: UTF-8 bytes ARE the tokens (vocab 256).
+    Self-contained (no tokenizer download), exact round-trip, the standard
+    floor for corpus experiments.  Streams in ``chunk_bytes`` pieces —
+    constant memory for arbitrarily large corpora, matching the module's
+    memmap posture.  Returns the token count."""
+    total = 0
+    with open(text_path, "rb") as src, open(out_path, "wb") as dst:
+        while True:
+            buf = src.read(chunk_bytes)
+            if not buf:
+                break
+            np.frombuffer(buf, dtype=np.uint8).astype(np.uint16).tofile(dst)
+            total += len(buf)
+    return total
+
+
 def batch_index(step: int, rank: int, batch: int, seq: int,
                 n_tokens: int, world: int = 1) -> np.ndarray:
     """Start offsets for (step, rank): deterministic and disjoint across

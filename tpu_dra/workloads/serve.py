@@ -1,0 +1,177 @@
+"""Minimal HTTP inference server over the KV-cache decoder.
+
+The serving-side analog of the daemon's coordservice endpoints: a claimed
+chip (or slice) exposes `/healthz` and `/generate` so the quickstart demos
+can exercise inference over the network the way the reference demos
+exercise CUDA samples locally.  stdlib-only (ThreadingHTTPServer), one
+compiled decoder per (batch, prompt-length, steps) bucket — requests are
+padded into the bucket so repeat traffic never recompiles.
+
+POST /generate  {"tokens": [[...]], "steps": N,
+                 "temperature": 0.0, "top_k": 0, "seed": 0}
+             → {"tokens": [[...]]}           (the N generated ids per row)
+GET  /healthz → "ok"
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from functools import partial
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.decode import decode
+from tpu_dra.workloads.train import ModelConfig
+
+
+def _bucket(n: int, buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
+
+
+class DecoderPool:
+    """Compiled-decoder cache keyed by (batch, S_pad, steps, temperature,
+    top_k) buckets; thread-safe (requests may arrive concurrently, JAX
+    dispatch is already serialized internally)."""
+
+    def __init__(self, cfg: ModelConfig, params):
+        self.cfg = cfg
+        self.params = params
+        self._fns: dict = {}
+        self._lock = threading.Lock()
+
+    def generate(self, rows: list[list[int]], steps: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0) -> list[list[int]]:
+        cfg = self.cfg
+        if not rows or not all(rows):
+            raise ValueError("tokens must be a non-empty list of non-empty "
+                             "rows")
+        if any(t < 0 or t >= cfg.vocab for r in rows for t in r):
+            raise ValueError(f"token ids must be in [0, {cfg.vocab})")
+        B = _bucket(len(rows))
+        S = _bucket(max(len(r) for r in rows))
+        if S + steps > cfg.max_seq:
+            raise ValueError(
+                f"prompt bucket {S} + steps {steps} exceeds max_seq "
+                f"{cfg.max_seq}")
+        prompts = jnp.zeros((B, S), jnp.int32)
+        lengths = []
+        for i, r in enumerate(rows):
+            prompts = prompts.at[i, : len(r)].set(jnp.asarray(r, jnp.int32))
+            lengths.append(len(r))
+        lengths += [1] * (B - len(rows))          # dummy rows decode too
+        key = (B, S, steps, float(temperature), int(top_k))
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = jax.jit(partial(
+                    decode, self.cfg, steps=steps,
+                    temperature=temperature, top_k=top_k))
+                self._fns[key] = fn
+        toks = fn(self.params, prompts,
+                  lengths=jnp.asarray(lengths, jnp.int32),
+                  rng=jax.random.PRNGKey(seed) if temperature > 0 else None)
+        return [toks[i].tolist() for i in range(len(rows))]
+
+
+def make_handler(pool: DecoderPool):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):             # quiet by default
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, b"ok", "text/plain")
+            else:
+                self._send(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, b"not found", "text/plain")
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                out = pool.generate(
+                    req["tokens"], int(req.get("steps", 16)),
+                    float(req.get("temperature", 0.0)),
+                    int(req.get("top_k", 0)), int(req.get("seed", 0)))
+                self._send(200, json.dumps({"tokens": out}).encode())
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as exc:
+                self._send(400, json.dumps(
+                    {"error": str(exc)[:300]}).encode())
+
+    return Handler
+
+
+def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
+          port: int = 8477) -> ThreadingHTTPServer:
+    """Start the server on a daemon thread; returns it (``.shutdown()`` to
+    stop).  ``port`` 0 picks a free port (``server.server_address``)."""
+    pool = DecoderPool(cfg, params)
+    srv = ThreadingHTTPServer((host, port), make_handler(pool))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def main(argv=None):
+    """Serve a checkpoint: ``python -m tpu_dra.workloads.serve
+    --checkpoint-dir ck --vocab 32768 ...`` (config must match the one
+    that trained the checkpoint)."""
+    import argparse
+    import os
+
+    from tpu_dra.workloads.checkpointing import restore_train_state
+    from tpu_dra.workloads.launcher import init_tpu_workload
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--port", type=int, default=8477)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--n-kv-heads", type=int, default=None)
+    ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--d-ff", type=int, default=2048)
+    ap.add_argument("--max-seq", type=int, default=512)
+    ap.add_argument("--pos-emb", default="rope")
+    args = ap.parse_args(argv)
+
+    init_tpu_workload()
+    cfg = ModelConfig(vocab=args.vocab, d_model=args.d_model,
+                      n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+                      n_layers=args.n_layers, d_ff=args.d_ff,
+                      max_seq=args.max_seq, pos_emb=args.pos_emb)
+    params = restore_train_state(args.checkpoint_dir)["params"]
+    srv = serve(cfg, params, host=args.host, port=args.port)
+    print(f"serving on {srv.server_address}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
