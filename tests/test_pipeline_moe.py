@@ -135,6 +135,56 @@ def test_moe_training_decreases_loss_on_ep_mesh():
     assert float(loss) < first
 
 
+def test_moe_flash_chunked_engines_match_dense():
+    """MoE with attn_impl="flash" + head_impl="chunked" matches the dense
+    engines' loss and still trains on the ep mesh."""
+    from tpu_dra.workloads.moe import moe_loss_fn
+
+    cfg = MoEConfig(vocab=32, d_model=32, n_heads=2, n_layers=2,
+                    d_ff=64, max_seq=16, n_experts=4, pos_emb="rope")
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32,
+                                dtype=jnp.int32)
+    dense = moe_loss_fn(cfg, params, tokens)
+    fancy = moe_loss_fn(cfg, params, tokens, attn_impl="flash",
+                        head_impl="chunked")
+    assert abs(float(dense) - float(fancy)) < 5e-2, (dense, fancy)
+
+    mesh = _mesh(2, 4, "ep")
+    step, p_shard, t_shard = make_moe_train_step(
+        cfg, mesh, lr=0.3, attn_impl="flash", head_impl="chunked")
+    sp = jax.device_put(params, p_shard)
+    st = jax.device_put(tokens[:4].repeat(2, 0), t_shard)
+    first = None
+    for _ in range(5):
+        sp, loss = step(sp, st)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+
+
+def test_pipeline_chunked_head_matches_dense():
+    """Pipeline-parallel step with head_impl="chunked" reproduces the
+    dense head's loss."""
+    from tpu_dra.workloads.pipeline import make_pipeline_train_step
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab=32, d_model=32, n_heads=2, n_layers=4,
+                      d_ff=64, max_seq=16)
+    mesh = _mesh(2, 4, "pp")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 32,
+                                dtype=jnp.int32)
+    outs = {}
+    for impl in ("dense", "chunked"):
+        step, p_sh, t_sh = make_pipeline_train_step(cfg, mesh, n_micro=2,
+                                                    head_impl=impl)
+        _, loss = step(jax.device_put(params, p_sh),
+                       jax.device_put(tokens, t_sh))
+        outs[impl] = float(loss)
+    assert abs(outs["dense"] - outs["chunked"]) < 2e-3, outs
+
+
 def test_moe_sharded_matches_unsharded():
     cfg = MoEConfig(vocab=32, d_model=32, n_heads=2, n_layers=2,
                     d_ff=64, max_seq=16, n_experts=4)

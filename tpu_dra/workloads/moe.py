@@ -134,8 +134,10 @@ def _ep_constraint(t, mesh: Mesh | None):
 
 
 def _moe_block(cfg: MoEConfig, x, layer, capacity: int | None,
-               mesh: Mesh | None):
-    x = _attn_sublayer(cfg, x, layer)
+               mesh: Mesh | None, attn_fn=None):
+    from tpu_dra.workloads.train import _ATTN_IMPLS
+    x = _attn_sublayer(cfg, x, layer,
+                       attn_fn or _ATTN_IMPLS["dense"])
     h = _rmsnorm(x, layer["ln2"])
     ff, aux = moe_ffn(cfg, h, layer["wg"], layer["w1"], layer["w2"],
                       capacity, mesh)
@@ -143,14 +145,17 @@ def _moe_block(cfg: MoEConfig, x, layer, capacity: int | None,
 
 
 def _moe_trunk(cfg: MoEConfig, params, tokens, capacity: int | None,
-               mesh: Mesh | None):
+               mesh: Mesh | None, attn_impl: str = "dense"):
     """Embed + MoE decoder stack → (pre-final-norm activations, Σ aux)."""
+    from tpu_dra.workloads.train import _ATTN_IMPLS
+    attn_fn = _ATTN_IMPLS[attn_impl]
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     if cfg.pos_emb == "learned":
         x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
     block = jax.checkpoint(
-        lambda carry, layer: _moe_block(cfg, carry, layer, capacity, mesh))
+        lambda carry, layer: _moe_block(cfg, carry, layer, capacity, mesh,
+                                        attn_fn))
     x, aux = jax.lax.scan(block, x, params["blocks"])
     return x, jnp.sum(aux)
 
@@ -162,9 +167,10 @@ def moe_forward(cfg: MoEConfig, params, tokens, capacity: int | None = None,
     return head_logits(params, x), aux
 
 
-def moe_loss_fn(cfg: MoEConfig, params, tokens, mesh: Mesh | None = None):
-    x, aux = _moe_trunk(cfg, params, tokens[:, :-1], None, mesh)
-    nll = head_nll(params, x, tokens[:, 1:]).mean()
+def moe_loss_fn(cfg: MoEConfig, params, tokens, mesh: Mesh | None = None,
+                attn_impl: str = "dense", head_impl: str = "dense"):
+    x, aux = _moe_trunk(cfg, params, tokens[:, :-1], None, mesh, attn_impl)
+    nll = head_nll(params, x, tokens[:, 1:], head_impl).mean()
     return nll + cfg.aux_loss_weight * aux
 
 
@@ -190,9 +196,12 @@ def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
     return out
 
 
-def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):
+def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2,
+                        attn_impl: str = "dense",
+                        head_impl: str = "dense"):
     """jit the MoE SGD step over ``mesh`` (axes "dp","ep"). Requires
-    ``cfg.n_experts % ep == 0``."""
+    ``cfg.n_experts % ep == 0``.  attn_impl/head_impl as in train.py
+    (flash attention kernels / streamed-vocab NLL)."""
     ep = mesh.shape["ep"]
     if cfg.n_experts % ep:
         raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={ep}")
@@ -202,7 +211,8 @@ def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):
 
     def sgd(params, tokens):
         loss, grads = jax.value_and_grad(
-            partial(moe_loss_fn, cfg, mesh=mesh))(params, tokens)
+            partial(moe_loss_fn, cfg, mesh=mesh, attn_impl=attn_impl,
+                    head_impl=head_impl))(params, tokens)
         params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return params, loss
 

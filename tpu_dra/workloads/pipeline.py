@@ -76,7 +76,7 @@ def _pipeline_blocks(cfg: ModelConfig, n_stages: int, blocks, x_micro):
 
 
 def _pipeline_loss(cfg: ModelConfig, n_stages: int, n_micro: int,
-                   params, tokens):
+                   head_impl: str, params, tokens):
     """Per-shard loss body (runs inside shard_map over a ("dp","pp") mesh)."""
     stage = jax.lax.axis_index("pp")
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
@@ -90,7 +90,7 @@ def _pipeline_loss(cfg: ModelConfig, n_stages: int, n_micro: int,
     out = _pipeline_blocks(cfg, n_stages, params["blocks"], x_micro)
 
     x = out.reshape(Bl, S, D)
-    nll = head_nll(params, x, tgt).mean()
+    nll = head_nll(params, x, tgt, head_impl).mean()
 
     last = (stage == n_stages - 1).astype(jnp.float32)
     # mean over dp shards of the final-stage loss, replicated everywhere
@@ -114,11 +114,13 @@ def pipeline_param_specs(cfg: ModelConfig) -> dict[str, Any]:
 
 
 def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh,
-                             n_micro: int = 4, lr: float = 1e-2):
+                             n_micro: int = 4, lr: float = 1e-2,
+                             head_impl: str = "dense"):
     """jit a full pipeline-parallel SGD step over ``mesh`` (axes "dp","pp").
 
     Requires ``cfg.n_layers % pp == 0`` and a global batch divisible by
     ``dp * n_micro``. Returns ``(step, param_shardings, token_sharding)``.
+    ``head_impl="chunked"`` streams the vocab in the final-stage NLL.
     """
     n_stages = mesh.shape["pp"]
     if cfg.n_layers % n_stages:
@@ -127,7 +129,7 @@ def make_pipeline_train_step(cfg: ModelConfig, mesh: Mesh,
 
     p_specs = pipeline_param_specs(cfg)
     loss_fn = shard_map(
-        partial(_pipeline_loss, cfg, n_stages, n_micro),
+        partial(_pipeline_loss, cfg, n_stages, n_micro, head_impl),
         mesh=mesh,
         in_specs=(p_specs, P("dp", None)),
         out_specs=P(),
