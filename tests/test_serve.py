@@ -57,6 +57,26 @@ def test_generate_mixed_lengths_batch(server):
         assert got == want[0].tolist(), (row, got, want[0].tolist())
 
 
+def test_concurrent_requests_all_correct(server):
+    """ThreadingHTTPServer + DecoderPool under concurrent mixed traffic:
+    every response must still match the local oracle (the pool's compile
+    cache is lock-guarded; JAX dispatch is internally serialized)."""
+    import concurrent.futures
+
+    cfg, params, base = server
+    prompts = [[i + 1, (2 * i) % 64, 7] for i in range(8)]
+    want = {tuple(p): greedy_decode(
+        cfg, params, jnp.asarray([p], jnp.int32), steps=3)[0].tolist()
+        for p in prompts}
+
+    def hit(p):
+        return p, _post(base, {"tokens": [p], "steps": 3})["tokens"][0]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        for p, got in ex.map(hit, prompts * 2):
+            assert got == want[tuple(p)], (p, got, want[tuple(p)])
+
+
 def test_generate_rejects_bad_input(server):
     _, _, base = server
     for bad in ({"tokens": [], "steps": 2},
