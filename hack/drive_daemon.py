@@ -1,0 +1,121 @@
+"""Drive the slice daemon (with native coordd) against the testserver facade.
+
+Recreated from .claude/skills/verify/SKILL.md: run `tpu_dra.daemon.main run`
+with the env a real pod would get, populate the second node's status entry,
+and assert /ready, /coordinator, CR status.nodes, and `check` rc 0.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(
+        __import__("os").path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra.k8s.testserver import KubeTestServer            # noqa: E402
+from tpu_dra.k8s import TPU_SLICE_DOMAINS as SLICE_DOMAINS   # noqa: E402
+
+
+def main():
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="drive-daemon-"))
+    srv = KubeTestServer().start()
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp / "kubeconfig"))
+        root = tmp / "driver-root"
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-8'\nTPU_TOPOLOGY: '2x4'\n"
+            "TPU_WORKER_ID: '0'\n"
+            "TPU_WORKER_HOSTNAMES: 'node-a,node-b'\n")
+
+        cd = {"apiVersion": "resource.tpu.google.com/v1beta1",
+              "kind": "TpuSliceDomain",
+              "metadata": {"name": "dom1", "namespace": "default"},
+              "spec": {"numNodes": 2,
+                       "channel": {"resourceClaimTemplate": {"name": "t"}}}}
+        obj = srv.fake.create(SLICE_DOMAINS, cd)
+        uid = obj["metadata"]["uid"]
+
+        settings = tmp / "settings"
+        settings.mkdir()
+        env = {**os.environ, "PYTHONPATH": REPO,
+               "SLICE_DOMAIN_UUID": uid,
+               "SLICE_DOMAIN_NAME": "dom1",
+               "SLICE_DOMAIN_NAMESPACE": "default",
+               "NODE_NAME": "node-a", "POD_IP": "127.0.0.1",
+               "SLICE_SETTINGS_DIR": str(settings),
+               "SLICE_COORDINATOR_PORT": "18476",
+               "KUBECONFIG": kcfg,
+               "TPU_DRIVER_ROOT": str(root),
+               "TPU_IGNORE_HOST_ENV": "1"}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.daemon.main", "run"],
+            cwd=REPO, env=env)
+        try:
+            # wait for the daemon to publish its own node entry
+            deadline = time.time() + 30
+            nodes = []
+            while time.time() < deadline:
+                cur = srv.fake.get(SLICE_DOMAINS, "dom1", "default")
+                nodes = (cur.get("status") or {}).get("nodes") or []
+                if any(n.get("name") == "node-a" for n in nodes):
+                    break
+                time.sleep(0.3)
+            assert any(n.get("name") == "node-a" for n in nodes), nodes
+            print(f"OK membership published: {nodes}")
+
+            # fake the second node completing the set
+            me = next(n for n in nodes if n["name"] == "node-a")
+            cur = srv.fake.get(SLICE_DOMAINS, "dom1", "default")
+            cur.setdefault("status", {})["nodes"] = [
+                me, {**me, "name": "node-b", "ipAddress": "127.0.0.2",
+                     "workerID": 1}]
+            srv.fake.update_status(SLICE_DOMAINS, cur)
+
+            # coordservice (native coordd preferred) must go READY
+            deadline = time.time() + 30
+            ready = ""
+            while time.time() < deadline:
+                try:
+                    ready = urllib.request.urlopen(
+                        "http://127.0.0.1:18476/ready", timeout=2
+                    ).read().decode().strip()
+                    if ready == "READY":
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.3)
+            assert ready == "READY", ready
+            coord = urllib.request.urlopen(
+                "http://127.0.0.1:18476/coordinator", timeout=2
+            ).read().decode().strip()
+            assert coord.endswith(":8476"), coord
+            print(f"OK coordservice READY, coordinator={coord}")
+
+            cfgfile = json.load(open(settings / "nodes_config.json"))
+            assert len(cfgfile["nodes"]) == 2, cfgfile
+            print(f"OK nodes_config.json: {[n.get('name', n.get('node')) for n in cfgfile['nodes']]}")
+
+            # the probe subcommand a pod would use as liveness
+            chk = subprocess.run(
+                [sys.executable, "-m", "tpu_dra.daemon.main", "check"],
+                cwd=REPO, env=env, capture_output=True, text=True, timeout=30)
+            assert chk.returncode == 0, (chk.returncode, chk.stdout, chk.stderr)
+            print("OK `daemon check` rc 0")
+        finally:
+            proc.terminate()
+            proc.wait(10)
+    finally:
+        srv.stop()
+    print("DRIVE DAEMON: ALL OK")
+
+
+if __name__ == "__main__":
+    main()
