@@ -1,15 +1,31 @@
-"""JAX/XLA workload surface.
+"""JAX/XLA workload surface — the complete tenant stack for claimed TPUs.
 
 The reference ships measurement/demo workloads, not models (nvbandwidth
 MPIJobs, demo/specs/imex/*; CUDA nbody, demo/specs/quickstart/gpu-test5).
-The TPU analogs here are first-class framework components:
+The TPU analogs here are first-class framework components
+(`docs/workloads.md` is the design doc):
 
+- :mod:`tpu_dra.workloads.pallas_kernels` — hand-tiled MXU kernels:
+  matmul, fused rmsnorm-matmul, FlashAttention-2 fwd+bwd pair with a
+  composable logsumexp output.
+- :mod:`tpu_dra.workloads.train` — the flagship SPMD transformer: DP×TP
+  train steps (SGD and optax), GQA/MQA + RoPE config axes, flash/dense
+  attention engines, dense/chunked-vocab heads.
+- :mod:`tpu_dra.workloads.ring_attention` — ring + zigzag sequence
+  parallelism (fp32 XLA and Pallas flash engines) and the DP×SP train
+  step.
+- :mod:`tpu_dra.workloads.pipeline` / :mod:`tpu_dra.workloads.moe` —
+  GPipe pipeline and switch-MoE expert parallelism.
+- :mod:`tpu_dra.workloads.decode` — static-shape KV-cache serving:
+  greedy/sampled, ragged mixed-length batches, GQA caches.
+- :mod:`tpu_dra.workloads.serve` — bucketed HTTP inference endpoint.
+- :mod:`tpu_dra.workloads.data` / :mod:`tpu_dra.workloads.fit` /
+  :mod:`tpu_dra.workloads.checkpointing` — memmap data pipeline with a
+  deterministic rank-disjoint schedule, the optax fit loop with
+  bit-exact orbax resume, tail-slice evaluation.
 - :mod:`tpu_dra.workloads.collectives` — ICI collective benchmarks
   (``jax.lax.psum`` bandwidth over a device mesh), the nvbandwidth analog
   and the BASELINE.md target metric.
-- :mod:`tpu_dra.workloads.train` — a small SPMD transformer train step
-  (DP×TP sharded, bf16, remat) used as the acceptance workload for
-  slice-domain demos and as the graft entry's flagship model.
 - :mod:`tpu_dra.workloads.launcher` — resolves the driver's injected
   coordination env (``SLICE_*`` / the mounted settings dir) into
   ``jax.distributed.initialize`` arguments: the consumer side of the
