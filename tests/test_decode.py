@@ -160,6 +160,41 @@ def test_ragged_decode_matches_per_sequence(pos_emb):
                       jnp.asarray([0, 7, 5], jnp.int32), steps=steps)
 
 
+@pytest.mark.parametrize("pos_emb", ["learned", "rope"])
+def test_speculative_decode_exactly_matches_greedy(pos_emb):
+    """Greedy speculative decoding must reproduce vanilla greedy output
+    EXACTLY for any draft: a perfect draft (the target itself — accepts
+    nearly everything) and an adversarial draft (different init — rejects
+    nearly everything) both hit the same tokens."""
+    from tpu_dra.workloads.decode import speculative_decode
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=64, pos_emb=pos_emb)
+    params = init_params(cfg, jax.random.PRNGKey(30))
+    draft_cfg = ModelConfig(vocab=64, d_model=16, n_heads=2, n_layers=1,
+                            d_ff=32, max_seq=64, pos_emb=pos_emb)
+    B, S, steps = 2, 5, 9
+    prompt = jax.random.randint(jax.random.PRNGKey(31), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    want = greedy_decode(cfg, params, prompt, steps=steps)
+
+    passes = {}
+    for name, dcfg, dparams in (
+            ("perfect", cfg, params),
+            ("adversarial", draft_cfg,
+             init_params(draft_cfg, jax.random.PRNGKey(99)))):
+        got, stats = speculative_decode(cfg, params, dcfg, dparams, prompt,
+                                        steps=steps, k=4,
+                                        return_stats=True)
+        assert jnp.array_equal(got, want), (
+            name, got.tolist(), want.tolist())
+        passes[name] = int(stats["target_passes"])
+    # the perfect draft accepts everything → ~steps/k target passes; the
+    # whole point of speculation is passes["perfect"] << steps
+    assert passes["perfect"] <= (steps + 3) // 4 + 1, passes
+    assert passes["adversarial"] <= steps, passes
+
+
 def test_decode_respects_max_len(small):
     cfg, params = small
     prompt = jnp.zeros((1, 30), jnp.int32)

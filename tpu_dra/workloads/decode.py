@@ -70,34 +70,46 @@ def _layer_kv(cfg: ModelConfig, layer, x):
     return k, _split_heads(cfg, v, cfg.kv_heads)
 
 
+def _chunk_positions(pos, m: int):
+    """[B, m] absolute positions for an m-token chunk starting at ``pos``
+    (scalar or [B])."""
+    base = jnp.reshape(jnp.asarray(pos, jnp.int32), (-1, 1))
+    return base + jnp.arange(m, dtype=jnp.int32)[None, :]
+
+
 def _write_kv(cache, new, pos):
-    """Write a [B, Hkv, 1, Dh] entry at ``pos`` — a scalar (dense slice,
-    the fast aligned path) or a per-sequence [B] vector (scatter, the
-    ragged path)."""
-    if jnp.ndim(pos) == 0:
+    """Write [B, Hkv, m, Dh] entries at positions ``pos .. pos+m-1`` —
+    scalar ``pos`` with m==1 takes the dense dynamic_update_slice fast
+    path; otherwise a per-sequence scatter (OOB positions are dropped,
+    which never occurs for in-contract callers)."""
+    m = new.shape[2]
+    if jnp.ndim(pos) == 0 and m == 1:
         return jax.lax.dynamic_update_slice(
             cache, new.astype(cache.dtype), (0, 0, pos, 0))
     B = cache.shape[0]
-    return cache.at[jnp.arange(B), :, pos].set(
-        new.astype(cache.dtype)[:, :, 0])
+    positions = _chunk_positions(pos, m)                   # [B, m]
+    return cache.at[jnp.arange(B)[:, None], :, positions].set(
+        new.astype(cache.dtype).transpose(0, 2, 1, 3), mode="drop")
 
 
 def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
-    """One decoder block for a single-token [B, 1, D] activation against a
-    [B, Hkv, S_max, Dh] cache; returns (x, k_all, v_all) with this token's
-    k/v written at ``pos`` (scalar, or [B] for ragged batches — every
-    sequence at its own position).  q's n_heads attend the shared kv heads
-    in groups (einsum broadcast, no repeat)."""
-    B = x.shape[0]
+    """One decoder block for an m-token [B, m, D] chunk against a
+    [B, Hkv, S_max, Dh] cache; returns (x, k_all, v_all) with the chunk's
+    k/v written at positions ``pos .. pos+m-1`` (``pos`` scalar, or [B]
+    for ragged batches — every sequence at its own position).  m == 1 is
+    plain decode; m > 1 is the speculative verify path.  Causality within
+    the chunk falls out of the cache-position mask (chunk token j may
+    attend cache columns ≤ pos+j, which includes chunk tokens ≤ j).  q's
+    n_heads attend the shared kv heads in groups (einsum broadcast)."""
+    B, m, _ = x.shape
     h = _rmsnorm(x, layer["ln1"])
     qkv = h @ layer["wqkv"].astype(x.dtype)
     q, k, v = _split_qkv(cfg, qkv)
-    q = _split_heads(cfg, q)                              # [B, H, 1, Dh]
-    k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, 1, Dh]
+    q = _split_heads(cfg, q)                              # [B, H, m, Dh]
+    k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, m, Dh]
     v = _split_heads(cfg, v, cfg.kv_heads)
     if cfg.pos_emb == "rope":
-        positions = (jnp.asarray(pos, jnp.int32)[None] if jnp.ndim(pos) == 0
-                     else pos.astype(jnp.int32)[:, None])   # [1] or [B, 1]
+        positions = _chunk_positions(pos, m)              # [B, m]
         q = apply_rope(q, positions, cfg.rope_base)
         k = apply_rope(k, positions, cfg.rope_base)       # cached rotated
 
@@ -105,16 +117,21 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     v_all = _write_kv(v_cache, v, pos)
 
     hkv, g = cfg.kv_heads, cfg.n_heads // cfg.kv_heads
-    qg = q.reshape(B, hkv, g, cfg.d_head)                 # q len 1 squeezed
-    scores = jnp.einsum("bkgd,bksd->bkgs", qg, k_all) * (cfg.d_head ** -0.5)
-    # mask positions beyond the current token (cache tail beyond each
-    # sequence's own pos holds zeros or not-yet-overwritten pad junk)
-    valid = (jnp.arange(k_cache.shape[2])[None, None, None, :]
-             <= jnp.reshape(pos, (-1, 1, 1, 1)))
-    scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
+    qg = q.reshape(B, hkv, g, m, cfg.d_head)
+    scores = jnp.einsum("bkgmd,bksd->bkgms", qg, k_all) * \
+        (cfg.d_head ** -0.5)
+    # chunk token j attends cache columns ≤ its own absolute position;
+    # columns beyond hold zeros or not-yet-overwritten stale entries
+    # (ragged pads, rejected speculative drafts) and must stay invisible
+    col = jnp.arange(k_cache.shape[2])
+    valid = (col[None, None, :] <=
+             _chunk_positions(pos, m)[:, :, None])        # [B, m, S]
+    scores = jnp.where(valid[:, None, None], scores,
+                       jnp.finfo(scores.dtype).min)
     attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgs,bksd->bkgd", attn, v_all)
-    out = out.reshape(B, 1, cfg.n_heads * cfg.d_head)
+    out = jnp.einsum("bkgms,bksd->bkgmd", attn, v_all)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(
+        B, m, cfg.n_heads * cfg.d_head)
     x = x + out @ layer["wo"].astype(x.dtype)
 
     h2 = _rmsnorm(x, layer["ln2"])
@@ -123,15 +140,15 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     return x, k_all, v_all
 
 
-def _token_logits(cfg: ModelConfig, params, cache, pos, token):
-    """One decode step: [B] token ids at position ``pos`` (scalar or [B])
-    → ([B, vocab] logits, updated cache)."""
-    x = params["embed"].astype(jnp.bfloat16)[token][:, None, :]   # [B, 1, D]
+def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens):
+    """Cached forward over an m-token chunk: ``tokens`` [B, m] at
+    positions ``pos .. pos+m-1`` → ([B, m, vocab] logits, updated cache).
+    m == 1 is the plain decode step; m > 1 is the speculative verify."""
+    m = tokens.shape[1]
+    x = params["embed"].astype(jnp.bfloat16)[tokens]              # [B, m, D]
     if cfg.pos_emb == "learned":
-        # gather handles both the scalar and per-sequence cases; the
-        # reshape makes a scalar broadcast over the batch
         x = x + params["pos"].astype(jnp.bfloat16)[
-            jnp.reshape(pos, (-1,))][:, None, :]
+            _chunk_positions(pos, m)]                             # [B, m, D]
 
     def block(carry, inputs):
         layer, k_cache, v_cache = inputs
@@ -141,8 +158,14 @@ def _token_logits(cfg: ModelConfig, params, cache, pos, token):
 
     x, (k_new, v_new) = jax.lax.scan(
         block, x, (params["blocks"], cache["k"], cache["v"]))
-    logits = head_logits(params, x)[:, 0]                         # [B, vocab]
-    return logits, {"k": k_new, "v": v_new}
+    return head_logits(params, x), {"k": k_new, "v": v_new}
+
+
+def _token_logits(cfg: ModelConfig, params, cache, pos, token):
+    """One decode step: [B] token ids at position ``pos`` (scalar or [B])
+    → ([B, vocab] logits, updated cache)."""
+    logits, cache = _chunk_logits(cfg, params, cache, pos, token[:, None])
+    return logits[:, 0], cache
 
 
 def _prefill_trunk(cfg: ModelConfig, params, cache, prompt,
@@ -286,6 +309,124 @@ def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
     return decode(cfg, params, prompts, steps=steps, lengths=lengths,
                   max_len=max_len, attn_impl=attn_impl,
                   temperature=temperature, top_k=top_k, rng=rng)
+
+
+def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
+                       draft_params, prompt, *, steps: int, k: int = 4,
+                       max_len: int | None = None,
+                       attn_impl: str = "dense",
+                       return_stats: bool = False):
+    """Greedy speculative decoding: a cheap draft model proposes ``k-1``
+    tokens autoregressively, the target verifies them in ONE cached
+    ``k``-token chunk forward, and the longest matching prefix plus the
+    target's own next token commit together — target quality at up to
+    ``k`` tokens per target pass.
+
+    Greedy acceptance makes the output EXACTLY ``greedy_decode(target)``
+    for ANY draft (tested with both a perfect and an adversarial draft);
+    the draft only changes speed.  Rejected drafts leave stale cache
+    entries beyond the committed position — the same masked-slot invariant
+    ragged decode relies on makes them invisible until overwritten.
+
+    Both models must share the vocab; returns [B, steps] int32 tokens.
+    """
+    assert k >= 2, k
+    assert cfg.vocab == draft_cfg.vocab, (cfg.vocab, draft_cfg.vocab)
+    B, S = prompt.shape
+    max_len = max_len or cfg.max_seq
+    # every iteration commits ≥1 token and writes ≤k cache slots past the
+    # committed stream; frozen rows stop advancing, so pos ≤ S+steps+k
+    assert S + steps + k <= max_len, (S, steps, k, max_len)
+
+    t_cache = init_kv_cache(cfg, B, max_len)
+    t_cache, t_logits = prefill(cfg, params, t_cache, prompt, attn_impl)
+    d_cache = init_kv_cache(draft_cfg, B, max_len)
+    d_cache, _ = prefill(draft_cfg, draft_params, d_cache, prompt, attn_impl)
+
+    last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # committed #1
+    width = steps + k                                        # overshoot room
+    out = jnp.zeros((B, width), jnp.int32).at[:, 0].set(last)
+    count = jnp.ones((B,), jnp.int32)
+    pos = jnp.full((B,), S, jnp.int32)
+    rows = jnp.arange(B)
+
+    def freeze(done, new, old, batch_axis: int = 0):
+        # caches are [L, B, …]: the done mask must broadcast on the BATCH
+        # axis, not the leading layer axis
+        shape = [1] * new.ndim
+        shape[batch_axis] = -1
+        return jnp.where(jnp.reshape(done, shape), old, new)
+
+    def iteration(carry):
+        t_cache, d_cache, pos, last, out, count, it = carry
+        done = count >= steps
+
+        # 1. draft proposes: processes last, d1, …, d_{k-1} (k steps, so
+        #    its cache covers pos … pos+k-1 — every position a full-accept
+        #    iteration commits; the k-th proposal is discarded)
+        def draft_step(c, j):
+            d_cache, tok = c
+            lg, d_cache = _token_logits(draft_cfg, draft_params, d_cache,
+                                        pos + j, tok)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (d_cache, nxt), nxt
+
+        (d_cache2, _), drafts = jax.lax.scan(
+            draft_step, (d_cache, last),
+            jnp.arange(k, dtype=jnp.int32))
+        drafts = drafts.T[:, : k - 1]                        # [B, k-1]
+
+        # 2. target verifies [last, d1 … d_{k-1}] in one chunk forward
+        chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # [B, k]
+        t_lg, t_cache2 = _chunk_logits(cfg, params, t_cache, pos, chunk)
+        preds = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)       # [B, k]
+
+        # 3. longest prefix where the target agrees with the draft, then
+        #    the target's own next token (the "bonus") commits
+        match = (drafts == preds[:, :-1]).astype(jnp.int32)       # [B, k-1]
+        n = jnp.cumprod(match, axis=1).sum(axis=1)                # [B]
+        bonus = jnp.take_along_axis(preds, n[:, None], axis=1)[:, 0]
+
+        # 4. emit d1…dn then bonus (slot j>n dropped; frozen rows emit
+        #    nothing — their dest is forced out of bounds)
+        j = jnp.arange(k, dtype=jnp.int32)[None, :]
+        padded = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        emit = jnp.where(j < n[:, None], padded,
+                         jnp.where(j == n[:, None], bonus[:, None], 0))
+        dest = count[:, None] + j
+        dest = jnp.where((j <= n[:, None]) & ~done[:, None], dest, width)
+        out = out.at[rows[:, None], dest].set(emit, mode="drop")
+
+        adv = n + 1
+        return (
+            {"k": freeze(done, t_cache2["k"], t_cache["k"], 1),
+             "v": freeze(done, t_cache2["v"], t_cache["v"], 1)},
+            {"k": freeze(done, d_cache2["k"], d_cache["k"], 1),
+             "v": freeze(done, d_cache2["v"], d_cache["v"], 1)},
+            jnp.where(done, pos, pos + adv),
+            jnp.where(done, last, bonus),
+            out,
+            jnp.where(done, count, count + adv),
+            it + 1,
+        )
+
+    def not_done(carry):
+        # early exit the moment every row has its tokens — the whole point
+        # is fewer target passes; steps-1 iterations is the worst case
+        # (count starts at 1, every iteration commits ≥1)
+        count, it = carry[5], carry[6]
+        return jnp.logical_and(jnp.any(count < steps), it < steps)
+
+    (t_cache, d_cache, pos, last, out, count, it) = jax.lax.while_loop(
+        not_done, iteration,
+        (t_cache, d_cache, pos, last, out, count,
+         jnp.zeros((), jnp.int32)))
+    if return_stats:
+        # `it` == number of target verify passes: the speedup observable
+        # (a good draft commits up to k tokens per pass)
+        return out[:, :steps], {"target_passes": it}
+    return out[:, :steps]
 
 
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
