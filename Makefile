@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test bench image proto clean
+.PHONY: all native test bench drive image proto clean
 
 all: native
 
@@ -15,6 +15,12 @@ test: native
 
 bench: native
 	$(PYTHON) bench.py
+
+# end-to-end drives: real plugin over its unix sockets, real slice daemon
+# with the supervised native coordd — no cluster needed
+drive: native
+	$(PYTHON) hack/drive_plugin.py
+	$(PYTHON) hack/drive_daemon.py
 
 proto:
 	cd tpu_dra/kubeletplugin/proto && \
