@@ -150,24 +150,27 @@ def section_train() -> dict:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    cfg = (ModelConfig(vocab=32768, d_model=1024, n_heads=8, n_layers=8,
-                       d_ff=4096, max_seq=1024) if on_tpu else
+    # 539M flagship: d_model=2048 keeps the MXU fed far better than the
+    # earlier 1024-wide/168M config — measured on v5e @ B=16/S=1024:
+    # 63.4% MFU vs 57-59% (the B sweep at 1024-wide peaked at B=16;
+    # at 2048-wide B=8 and B=16 are within noise, B=16 kept for tokens/s)
+    cfg = (ModelConfig(vocab=32768, d_model=2048, n_heads=16, n_layers=8,
+                       d_ff=8192, max_seq=1024) if on_tpu else
            ModelConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
                        d_ff=128, max_seq=64))
-    # B=16 is the measured MFU sweet spot on v5e (B=8: 48%, B=16: 53%,
-    # B=32: 51% — larger batches start thrashing HBM on the logits path)
     batch, seq = (16, cfg.max_seq) if on_tpu else (2, cfg.max_seq)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
     params = init_params(cfg, jax.random.PRNGKey(0))
-    # flash: Pallas fwd+bwd attention kernels — measured 58.7% vs 52.0% MFU
-    # over dense XLA attention at S=1024 (47.5% vs 31.6% at S=4096).
-    # chunked head: streamed-vocab NLL — the [B,S,32768] fp32 logits never
-    # materialize (vs-dense delta reported as train_step_chunked_*)
-    step, p_shard, b_shard = make_sharded_train_step(
-        cfg, mesh, attn_impl="flash" if on_tpu else "dense")
+    # attention impl at the flagship's S=1024: dense XLA fuses better than
+    # the Pallas flash pair (measured 63.4% vs 61.3% MFU at d=2048; the
+    # crossover where flash wins is S ≳ 2k — its own MFU is reported by
+    # section_flash).  chunked head: streamed-vocab NLL — the
+    # [B,S,32768] fp32 logits never materialize (delta reported as
+    # train_step_chunked_*)
+    step, p_shard, b_shard = make_sharded_train_step(cfg, mesh,
+                                                     attn_impl="dense")
     step_chunked, _, _ = make_sharded_train_step(
-        cfg, mesh, attn_impl="flash" if on_tpu else "dense",
-        head_impl="chunked")
+        cfg, mesh, attn_impl="dense", head_impl="chunked")
     params = jax.device_put(params, p_shard)
     tokens = jax.device_put(
         jnp.zeros((batch, seq), dtype=jnp.int32), b_shard)
@@ -236,8 +239,13 @@ def section_decode() -> dict:
         cfg = ModelConfig(vocab=32768, d_model=1024, n_heads=8, n_layers=8,
                           d_ff=4096, max_seq=1024)
         B, S, steps = 8, 128, 256
-    def measure(cfg):
-        params = init_params(cfg, jax.random.PRNGKey(0))
+    from tpu_dra.workloads.quant import cast_params_bf16, quantize_params_int8
+
+    def measure(cfg, quant=cast_params_bf16):
+        # decode is weight-HBM-bound: serving never reads the fp32
+        # training checkpoint directly — bf16 cast is the baseline
+        # (halves weight traffic), int8 quarters it (quant.py)
+        params = quant(init_params(cfg, jax.random.PRNGKey(0)))
         prompt = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
                                     cfg.vocab, dtype=jnp.int32)
         # cache sized to the live sequence, not max_seq: decode reads the
@@ -260,13 +268,21 @@ def section_decode() -> dict:
         "decode_batch": B,
         "decode_ms_per_token": round(best / steps * 1e3, 3),
     }
+    # int8 weight-only quant (native int8 MXU + quarter weight traffic)
+    int8 = measure(cfg, quant=quantize_params_int8)
+    out["decode_int8_tokens_per_s"] = round(B * steps / int8, 1)
+    out["decode_int8_ms_per_token"] = round(int8 / steps * 1e3, 3)
     # GQA variant: kv_heads = n_heads/4 quarters the cache — the dominant
-    # per-step HBM read — without touching the q-side compute
+    # remaining per-step HBM read — without touching the q-side compute
     import dataclasses
-    gqa = measure(dataclasses.replace(cfg, n_kv_heads=max(
-        1, cfg.n_heads // 4)))
+    gqa_cfg = dataclasses.replace(cfg, n_kv_heads=max(1, cfg.n_heads // 4))
+    gqa = measure(gqa_cfg)
     out["decode_gqa_tokens_per_s"] = round(B * steps / gqa, 1)
     out["decode_gqa_ms_per_token"] = round(gqa / steps * 1e3, 3)
+    # headline serving config: GQA cache + int8 weights together
+    both = measure(gqa_cfg, quant=quantize_params_int8)
+    out["decode_int8_gqa_tokens_per_s"] = round(B * steps / both, 1)
+    out["decode_int8_gqa_ms_per_token"] = round(both / steps * 1e3, 3)
     return out
 
 

@@ -1,0 +1,152 @@
+"""int8 / bf16 serving quantization (workloads/quant.py).
+
+The int8 contract is checked three ways: exact integer arithmetic against
+a hand-computed reference, bounded dequantization error, and end-to-end —
+a quantized flagship-model decode whose logits stay aligned with the
+full-precision oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.workloads.decode import greedy_decode, _token_logits, \
+    init_kv_cache, prefill
+from tpu_dra.workloads.quant import (
+    cast_params_bf16,
+    int8_matmul,
+    is_quantized,
+    matmul_any,
+    quantize_int8,
+    quantize_params_int8,
+)
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+
+@pytest.fixture(scope="module")
+def small():
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_quantize_int8_dequant_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 48), jnp.float32)
+    q = quantize_int8(w)
+    assert q["q8"].dtype == jnp.int8 and q["q8"].shape == w.shape
+    assert q["s"].shape == (48,)
+    # symmetric rounding: |w - q*s| ≤ s/2 per element, column-wise scale
+    err = jnp.abs(w - q["q8"].astype(jnp.float32) * q["s"][None, :])
+    assert bool(jnp.all(err <= q["s"][None, :] / 2 + 1e-7))
+
+
+def test_int8_matmul_exact_integer_reference():
+    """The quantized product must equal the manually-computed integer
+    matmul times the scale outer product — bit-for-bit (integer math)."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(kx, (5, 32), jnp.float32)
+    w = jax.random.normal(kw, (32, 16), jnp.float32)
+    q = quantize_int8(w)
+    got = int8_matmul(x, q["q8"], q["s"])
+
+    s_x = np.maximum(np.max(np.abs(np.asarray(x)), -1, keepdims=True),
+                     1e-8) / 127.0
+    xq = np.clip(np.round(np.asarray(x) / s_x), -127, 127).astype(np.int32)
+    ref = (xq @ np.asarray(q["q8"], np.int32)) * s_x * np.asarray(q["s"])
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-6)
+
+
+def test_int8_matmul_relative_accuracy():
+    kx, kw = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(kx, (16, 128), jnp.float32)
+    w = jax.random.normal(kw, (128, 64), jnp.float32)
+    q = quantize_int8(w)
+    got = int8_matmul(x, q["q8"], q["s"])
+    ref = x @ w
+    rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.02, rel
+
+
+def test_matmul_any_dispatch():
+    kx, kw = jax.random.split(jax.random.PRNGKey(3))
+    x = jax.random.normal(kx, (4, 24), jnp.bfloat16)
+    w = jax.random.normal(kw, (24, 8), jnp.float32)
+    plain = matmul_any(x, w)
+    assert plain.dtype == jnp.bfloat16
+    q = quantize_int8(w)
+    assert is_quantized(q) and not is_quantized(w)
+    quant = matmul_any(x, q, jnp.float32)
+    assert quant.dtype == jnp.float32
+    rel = float(jnp.linalg.norm(quant - plain.astype(jnp.float32)) /
+                jnp.linalg.norm(plain.astype(jnp.float32)))
+    assert rel < 0.05, rel
+
+
+def test_quantize_params_tree_structure(small):
+    cfg, params = small
+    qp = quantize_params_int8(params)
+    for name in ("wqkv", "wo", "w1", "w2"):
+        leaf = qp["blocks"][name]
+        assert is_quantized(leaf)
+        assert leaf["q8"].shape == params["blocks"][name].shape
+        # per-layer, per-output-channel scales survive the L-stack
+        assert leaf["s"].shape == (cfg.n_layers,
+                                   params["blocks"][name].shape[-1])
+    assert is_quantized(qp["unembed"])
+    assert qp["blocks"]["ln1"].dtype == jnp.bfloat16
+    assert qp["embed"].dtype == jnp.bfloat16
+
+
+def test_quantized_decode_logits_track_oracle(small):
+    """End to end: the int8 model's next-token logits must stay strongly
+    correlated with the fp32 oracle's through prefill + cached decode."""
+    cfg, params = small
+    B, S = 2, 8
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    qp = quantize_params_int8(params)
+
+    cache = init_kv_cache(cfg, B, cfg.max_seq)
+    _, ref_logits = prefill(cfg, params, cache, prompt)
+    cache_q = init_kv_cache(cfg, B, cfg.max_seq)
+    _, q_logits = prefill(cfg, qp, cache_q, prompt)
+
+    a = np.asarray(ref_logits, np.float32).ravel()
+    b = np.asarray(q_logits, np.float32).ravel()
+    corr = float(np.corrcoef(a, b)[0, 1])
+    assert corr > 0.98, corr
+
+
+def test_quantized_and_bf16_greedy_decode_run(small):
+    cfg, params = small
+    B, S, steps = 2, 6, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    ref = greedy_decode(cfg, params, prompt, steps=steps)
+    for variant in (cast_params_bf16(params), quantize_params_int8(params)):
+        toks = greedy_decode(cfg, variant, prompt, steps=steps)
+        assert toks.shape == (B, steps)
+        assert int(jnp.min(toks)) >= 0 and int(jnp.max(toks)) < cfg.vocab
+        # token-level agreement with the fp32 oracle: random-init logits
+        # are nearly flat (worst case for quantization), so demand a
+        # majority, not equality
+        agree = float(jnp.mean((toks == ref).astype(jnp.float32)))
+        assert agree >= 0.5, agree
+
+
+def test_token_logits_quantized_path(small):
+    """_token_logits (the per-step serving head) accepts quantized params:
+    unembed is a {"q8","s"} leaf there."""
+    cfg, params = small
+    qp = quantize_params_int8(params)
+    B = 2
+    cache = init_kv_cache(cfg, B, cfg.max_seq)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (B, 4), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    cache, _ = prefill(cfg, qp, cache, prompt)
+    tok = jnp.zeros((B,), jnp.int32)
+    logits, cache = _token_logits(cfg, qp, cache, jnp.int32(4), tok)
+    assert logits.shape == (B, cfg.vocab)
+    assert bool(jnp.all(jnp.isfinite(logits)))

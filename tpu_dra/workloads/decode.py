@@ -27,6 +27,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tpu_dra.workloads.quant import matmul_any
 from tpu_dra.workloads.train import (
     ModelConfig,
     _rmsnorm,
@@ -61,7 +62,7 @@ def _layer_kv(cfg: ModelConfig, layer, x):
     rotations in the cache + a rotated q give the relative-position
     dot products without re-rotating history every step."""
     h = _rmsnorm(x, layer["ln1"])
-    qkv = h @ layer["wqkv"].astype(x.dtype)
+    qkv = matmul_any(h, layer["wqkv"], x.dtype)
     _, k, v = _split_qkv(cfg, qkv)
     k = _split_heads(cfg, k, cfg.kv_heads)
     if cfg.pos_emb == "rope":
@@ -103,7 +104,7 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     n_heads attend the shared kv heads in groups (einsum broadcast)."""
     B, m, _ = x.shape
     h = _rmsnorm(x, layer["ln1"])
-    qkv = h @ layer["wqkv"].astype(x.dtype)
+    qkv = matmul_any(h, layer["wqkv"], x.dtype)
     q, k, v = _split_qkv(cfg, qkv)
     q = _split_heads(cfg, q)                              # [B, H, m, Dh]
     k = _split_heads(cfg, k, cfg.kv_heads)                # [B, Hkv, m, Dh]
@@ -132,11 +133,11 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     out = jnp.einsum("bkgms,bksd->bkgmd", attn, v_all)
     out = out.transpose(0, 3, 1, 2, 4).reshape(
         B, m, cfg.n_heads * cfg.d_head)
-    x = x + out @ layer["wo"].astype(x.dtype)
+    x = x + matmul_any(out, layer["wo"], x.dtype)
 
     h2 = _rmsnorm(x, layer["ln2"])
-    h2 = jax.nn.gelu(h2 @ layer["w1"].astype(x.dtype))
-    x = x + h2 @ layer["w2"].astype(x.dtype)
+    h2 = jax.nn.gelu(matmul_any(h2, layer["w1"], x.dtype))
+    x = x + matmul_any(h2, layer["w2"], x.dtype)
     return x, k_all, v_all
 
 

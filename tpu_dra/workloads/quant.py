@@ -1,0 +1,138 @@
+"""Serving-side weight quantization: bf16 casting and int8 weight-only
+quantization for the flagship model's decode path.
+
+Why this exists: KV-cache decode is weight-HBM-bound — every generated
+token re-reads every matmul weight.  The training checkpoint stores fp32
+(4 B/param); measured on the v5e bench chip, the 168M flagship decodes at
+~0.82 ms/token of pure fp32 weight traffic (675 MB / 819 GB/s), which is
+the whole measured 1.18 ms/token step time minus cache reads.  Casting
+weights to bf16 halves that; int8 quarters it.
+
+TPU-first int8 design (the MXU has a native int8×int8→int32 mode at 2×
+the bf16 rate on v5e — the quantized matmul is faster even when
+compute-bound):
+
+- **weights**: symmetric per-output-channel scales over the contraction
+  axis (``s_w[n] = max_k |w[k, n]| / 127``) — one fp32 scale per column,
+  amortized across the whole column's int8 read.
+- **activations**: dynamic symmetric per-row scales computed on the fly
+  (``s_x[b] = max_k |x[b, k]| / 127``) — decode activations are tiny
+  ([B, 1, D]), so the quantize step is free next to the weight read.
+- product: ``dot_general(x_q, w_q) → int32``, rescaled by the rank-1
+  outer product of the two scale vectors.  No zero points: transformer
+  matmul inputs are symmetric enough, and symmetric quant keeps the MXU
+  path a single integer matmul (asymmetric adds cross-term corrections).
+
+The quantized parameter tree keeps the fp32 original's *key layout* —
+``lax.scan`` over layer stacks still slices per layer — but each matmul
+weight leaf becomes a ``{"q8": int8[K, N], "s": f32[N]}`` subtree (the
+treedef changes: don't tree-map a quantized tree against an fp32-shaped
+template such as the train-step sharding specs).  Norm gains, embeddings
+(row-gather reads only B rows/step, not the table), and MoE expert banks
+(4-D einsum operands outside the ``matmul_any`` dispatch) stay high
+precision.
+
+Reference parity note: the reference repo is a DRA driver with no
+inference stack; this module is part of the beyond-reference workload
+surface (SURVEY.md §5 "long-context" note) that proves claimed TPU chips
+serve real models fast.  It is exercised by ``bench.py section_decode``
+on real hardware.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Leaf = Any
+
+#: weight leaves quantized inside each layer of ``params["blocks"]`` and at
+#: the top level.  Everything else (norm gains, embed table, pos table)
+#: is cast, not quantized.  Block leaves must be [L, K, N] stacks — the
+#: ndim guard in quantize_params_int8 skips same-named leaves with extra
+#: leading axes (MoE expert banks are [L, E, K, N] and consumed by raw
+#: einsums, not matmul_any).
+_QUANT_BLOCK_LEAVES = ("wqkv", "wo", "w1", "w2")
+_QUANT_TOP_LEAVES = ("unembed",)
+
+
+def quantize_int8(w: jax.Array) -> dict[str, jax.Array]:
+    """``[..., K, N]`` float → ``{"q8": int8, "s": f32[..., N]}`` with
+    symmetric per-output-channel scales over the contraction axis K."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)                      # [..., N]
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / s[..., None, :]), -127, 127).astype(jnp.int8)
+    return {"q8": q, "s": s}
+
+
+def int8_matmul(x: jax.Array, wq: jax.Array, s_w: jax.Array) -> jax.Array:
+    """``x [..., K] (bf16/f32) @ wq [K, N] (int8)`` with dynamic per-row
+    activation quantization; returns fp32 ``[..., N]``.
+
+    Both operands reach the MXU as int8 (its native 2×-rate mode); the
+    fp32 rescale is a rank-1 outer product fused into the output.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)       # [..., 1]
+    s_x = jnp.maximum(amax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / s_x), -127, 127).astype(jnp.int8)
+    y = jax.lax.dot_general(
+        xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * s_x * s_w
+
+
+def is_quantized(w: Leaf) -> bool:
+    return isinstance(w, dict) and "q8" in w
+
+
+def matmul_any(x: jax.Array, w: Leaf, dtype=None) -> jax.Array:
+    """The one matmul the model paths call: dispatches on the weight
+    leaf's form so fp32, bf16, and int8-quantized parameter trees all
+    flow through the same forward code.
+
+    - plain array: ``x @ w`` in ``dtype`` (default: x.dtype)
+    - ``{"q8", "s"}``: int8 MXU matmul, result cast to ``dtype``
+    """
+    out_dtype = dtype or x.dtype
+    if is_quantized(w):
+        return int8_matmul(x, w["q8"], w["s"]).astype(out_dtype)
+    return x @ w.astype(out_dtype)
+
+
+def cast_params_bf16(params: dict) -> dict:
+    """Serving cast: every float leaf → bf16 (norm gains included — the
+    rmsnorm math itself upcasts to fp32 internally, so bf16 *storage* of
+    the gain loses nothing that matters at serving time)."""
+    def cast(leaf):
+        if isinstance(leaf, jax.Array) and jnp.issubdtype(
+                leaf.dtype, jnp.floating):
+            return leaf.astype(jnp.bfloat16)
+        return leaf
+    return jax.tree.map(cast, params)
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """fp32/bf16 training params → int8 serving params.
+
+    Big matmul weights (per layer: wqkv/wo/w1/w2 + MoE variants; top
+    level: unembed) become ``{"q8", "s"}`` subtrees; everything else is
+    cast to bf16.  The layer stack keeps its leading L dim — ``lax.scan``
+    slices the q8/s leaves per layer exactly as it sliced the fp32 ones.
+    """
+    out = dict(cast_params_bf16(params))
+    blocks = dict(out["blocks"])
+    for name in _QUANT_BLOCK_LEAVES:
+        # quantize from the original full-precision weights, not the
+        # bf16-cast copies — no double rounding.  ndim == 3 restricts to
+        # [L, K, N] dense stacks (see _QUANT_BLOCK_LEAVES note).
+        if name in params["blocks"] and params["blocks"][name].ndim == 3:
+            blocks[name] = quantize_int8(params["blocks"][name])
+    out["blocks"] = blocks
+    for name in _QUANT_TOP_LEAVES:
+        if name in params and params[name].ndim == 2:
+            out[name] = quantize_int8(params[name])
+    return out

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_dra.workloads.quant import matmul_any
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -155,7 +157,7 @@ def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
     sequence-parallel callers pass their global offsets)."""
     B, S, D = x.shape
     h = _rmsnorm(x, layer["ln1"])
-    qkv = h @ layer["wqkv"].astype(x.dtype)
+    qkv = matmul_any(h, layer["wqkv"], x.dtype)
     q, k, v = jnp.split(qkv, [D, D + cfg.d_kv], axis=-1)
 
     def heads(t, n):
@@ -170,7 +172,7 @@ def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
         k = apply_rope(k, positions, cfg.rope_base)
     out = attn_fn(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
-    return x + out @ layer["wo"].astype(x.dtype)
+    return x + matmul_any(out, layer["wo"], x.dtype)
 
 
 def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
@@ -178,8 +180,8 @@ def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
     """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
     x = _attn_sublayer(cfg, x, layer, attn_fn, positions)
     h = _rmsnorm(x, layer["ln2"])
-    h = jax.nn.gelu(h @ layer["w1"].astype(x.dtype))
-    return x + h @ layer["w2"].astype(x.dtype)
+    h = jax.nn.gelu(matmul_any(h, layer["w1"], x.dtype))
+    return x + matmul_any(h, layer["w2"], x.dtype)
 
 
 def _flash_attention_fn(q, k, v):
@@ -226,7 +228,7 @@ def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention):
 def head_logits(params, x):
     """Final norm + unembed on trunk activations."""
     x = _rmsnorm(x, params["ln_f"])
-    return (x @ params["unembed"].astype(jnp.bfloat16)).astype(jnp.float32)
+    return matmul_any(x, params["unembed"], jnp.bfloat16).astype(jnp.float32)
 
 
 def head_nll(params, x, targets, head_impl: str = "dense",
