@@ -136,6 +136,97 @@ def test_quantized_and_bf16_greedy_decode_run(small):
         assert agree >= 0.5, agree
 
 
+def test_quantize_kv_roundtrip_bounded():
+    from tpu_dra.workloads.quant import quantize_kv
+    t = jax.random.normal(jax.random.PRNGKey(7), (2, 3, 5, 16), jnp.bfloat16)
+    q, s = quantize_kv(t)
+    assert q.dtype == jnp.int8 and q.shape == t.shape
+    assert s.shape == (2, 3, 5, 1)
+    err = jnp.abs(t.astype(jnp.float32) - q.astype(jnp.float32) * s)
+    assert bool(jnp.all(err <= s / 2 + 1e-2))   # bf16 input granularity
+
+
+def test_int8_cache_decode_tracks_oracle(small):
+    """Decode with an int8 KV cache must track the bf16-cache oracle:
+    per-step logits strongly correlated, greedy tokens mostly equal."""
+    cfg, params = small
+    B, S, steps = 2, 8, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+
+    cache = init_kv_cache(cfg, B, cfg.max_seq)
+    _, ref_logits = prefill(cfg, params, cache, prompt)
+    cache_q = init_kv_cache(cfg, B, cfg.max_seq, cache_dtype="int8")
+    assert cache_q["k"].dtype == jnp.int8 and "k_s" in cache_q
+    cache_q2, q_logits = prefill(cfg, params, cache_q, prompt)
+    # prefill must not silently widen the cache back to bf16
+    assert cache_q2["k"].dtype == jnp.int8
+
+    a = np.asarray(ref_logits, np.float32).ravel()
+    b = np.asarray(q_logits, np.float32).ravel()
+    corr = float(np.corrcoef(a, b)[0, 1])
+    assert corr > 0.98, corr
+
+    ref_toks = greedy_decode(cfg, params, prompt, steps=steps)
+    q_toks = greedy_decode(cfg, params, prompt, steps=steps,
+                           cache_dtype="int8")
+    assert q_toks.shape == (B, steps)
+    agree = float(jnp.mean((q_toks == ref_toks).astype(jnp.float32)))
+    assert agree >= 0.5, agree
+
+
+def test_int8_cache_composes_with_int8_weights(small):
+    """Full-int8 serving: int8 weights AND int8 cache together."""
+    cfg, params = small
+    B, S, steps = 2, 6, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    qp = quantize_params_int8(params)
+    toks = greedy_decode(cfg, qp, prompt, steps=steps, cache_dtype="int8")
+    assert toks.shape == (B, steps)
+    assert int(jnp.min(toks)) >= 0 and int(jnp.max(toks)) < cfg.vocab
+
+
+def test_int8_cache_ragged_decode(small):
+    """The scatter cache-write path (ragged batches) also quantizes."""
+    from tpu_dra.workloads.decode import decode_ragged
+    cfg, params = small
+    B, S, steps = 2, 8, 4
+    prompts = jax.random.randint(jax.random.PRNGKey(10), (B, S), 0,
+                                 cfg.vocab, dtype=jnp.int32)
+    lengths = jnp.array([5, 8], jnp.int32)
+    ref = decode_ragged(cfg, params, prompts, lengths, steps=steps)
+    got = decode_ragged(cfg, params, prompts, lengths, steps=steps,
+                        cache_dtype="int8")
+    assert got.shape == ref.shape == (B, steps)
+    agree = float(jnp.mean((got == ref).astype(jnp.float32)))
+    assert agree >= 0.5, agree
+
+
+def test_int8_cache_speculative_decode(small):
+    """speculative_decode threads cache_dtype; the freeze step must carry
+    the int8 scale buffers across iterations, and greedy equivalence
+    (spec == plain greedy for any draft) must hold per cache dtype."""
+    from tpu_dra.workloads.decode import speculative_decode
+    cfg, params = small
+    B, S, steps = 2, 6, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    # draft == target: acceptance is total, output must exactly equal the
+    # plain greedy decode with the same cache dtype
+    ref = greedy_decode(cfg, params, prompt, steps=steps,
+                        cache_dtype="int8")
+    got = speculative_decode(cfg, params, cfg, params, prompt, steps=steps,
+                             k=3, cache_dtype="int8")
+    assert bool(jnp.all(got == ref)), (got, ref)
+
+
+def test_init_kv_cache_rejects_unknown_dtype(small):
+    cfg, _ = small
+    with pytest.raises(ValueError):
+        init_kv_cache(cfg, 1, 8, cache_dtype="fp8")
+
+
 def test_token_logits_quantized_path(small):
     """_token_logits (the per-step serving head) accepts quantized params:
     unembed is a {"q8","s"} leaf there."""

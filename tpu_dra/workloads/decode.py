@@ -36,11 +36,28 @@ from tpu_dra.workloads.train import (
 )
 
 
-def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, Any]:
-    """Pre-allocated bf16 cache: ``k``/``v`` of [L, B, Hkv, S_max, Dh].
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int,
+                  cache_dtype: str = "bf16") -> dict[str, Any]:
+    """Pre-allocated cache: ``k``/``v`` of [L, B, Hkv, S_max, Dh].
     GQA shrinks this (and the per-step HBM read that dominates decode) by
-    n_heads / kv_heads."""
+    n_heads / kv_heads.
+
+    ``cache_dtype="int8"`` stores k/v as int8 with per-(position, head)
+    fp32 scales (``k_s``/``v_s`` [L, B, Hkv, S_max, 1] — 4 bytes per 128
+    int8 bytes at Dh=128, ~3% overhead), halving the cache read again; quantization happens at write
+    time (quant.quantize_kv) and the scales are folded into the score /
+    prob tensors at read time, so no dequantized copy ever exists in HBM.
+    """
     shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.d_head)
+    if cache_dtype == "int8":
+        s_shape = shape[:-1] + (1,)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros(s_shape, jnp.float32),
+                "v_s": jnp.zeros(s_shape, jnp.float32)}
+    if cache_dtype != "bf16":
+        raise ValueError(f"cache_dtype must be bf16 or int8, got "
+                         f"{cache_dtype!r}")
     return {"k": jnp.zeros(shape, jnp.bfloat16),
             "v": jnp.zeros(shape, jnp.bfloat16)}
 
@@ -93,7 +110,8 @@ def _write_kv(cache, new, pos):
         new.astype(cache.dtype).transpose(0, 2, 1, 3), mode="drop")
 
 
-def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
+def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
+                  k_s_cache=None, v_s_cache=None):
     """One decoder block for an m-token [B, m, D] chunk against a
     [B, Hkv, S_max, Dh] cache; returns (x, k_all, v_all) with the chunk's
     k/v written at positions ``pos .. pos+m-1`` (``pos`` scalar, or [B]
@@ -101,7 +119,17 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     plain decode; m > 1 is the speculative verify path.  Causality within
     the chunk falls out of the cache-position mask (chunk token j may
     attend cache columns ≤ pos+j, which includes chunk tokens ≤ j).  q's
-    n_heads attend the shared kv heads in groups (einsum broadcast)."""
+    n_heads attend the shared kv heads in groups (einsum broadcast).
+
+    With an int8 cache (``k_s_cache``/``v_s_cache`` [B, Hkv, S_max, 1]
+    given), the chunk's k/v quantize at write time and the return grows
+    to (x, k_all, v_all, k_s_all, v_s_all).  The per-position scales fold
+    *outside* the contractions — into the score tensor (scale is constant
+    over the Dh contraction) and into the softmax probabilities (constant
+    over the S contraction's Dh output) — so the int8 cache is read
+    directly by both einsums (the int8→bf16 convert fuses into the dot's
+    operand load; no dequantized HBM copy)."""
+    quantized = k_s_cache is not None
     B, m, _ = x.shape
     h = _rmsnorm(x, layer["ln1"])
     qkv = matmul_any(h, layer["wqkv"], x.dtype)
@@ -114,13 +142,29 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
         q = apply_rope(q, positions, cfg.rope_base)
         k = apply_rope(k, positions, cfg.rope_base)       # cached rotated
 
-    k_all = _write_kv(k_cache, k, pos)
-    v_all = _write_kv(v_cache, v, pos)
+    if quantized:
+        from tpu_dra.workloads.quant import quantize_kv
+        k_q, k_s = quantize_kv(k)
+        v_q, v_s = quantize_kv(v)
+        k_all = _write_kv(k_cache, k_q, pos)
+        v_all = _write_kv(v_cache, v_q, pos)
+        k_s_all = _write_kv(k_s_cache, k_s, pos)
+        v_s_all = _write_kv(v_s_cache, v_s, pos)
+        k_read = k_all.astype(x.dtype)
+        v_read = v_all.astype(x.dtype)
+    else:
+        k_all = _write_kv(k_cache, k, pos)
+        v_all = _write_kv(v_cache, v, pos)
+        k_read, v_read = k_all, v_all
 
     hkv, g = cfg.kv_heads, cfg.n_heads // cfg.kv_heads
     qg = q.reshape(B, hkv, g, m, cfg.d_head)
-    scores = jnp.einsum("bkgmd,bksd->bkgms", qg, k_all) * \
+    scores = jnp.einsum("bkgmd,bksd->bkgms", qg, k_read) * \
         (cfg.d_head ** -0.5)
+    if quantized:
+        # per-position k scale: [B, Hkv, S, 1] → broadcast over (g, m)
+        scores = scores * k_s_all[..., 0][:, :, None, None, :].astype(
+            scores.dtype)
     # chunk token j attends cache columns ≤ its own absolute position;
     # columns beyond hold zeros or not-yet-overwritten stale entries
     # (ragged pads, rejected speculative drafts) and must stay invisible
@@ -129,8 +173,13 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
              _chunk_positions(pos, m)[:, :, None])        # [B, m, S]
     scores = jnp.where(valid[:, None, None], scores,
                        jnp.finfo(scores.dtype).min)
-    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgms,bksd->bkgmd", attn, v_all)
+    attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    if quantized:
+        # fold the per-position v scale into the probabilities (fp32,
+        # before the serving-dtype cast) so the value einsum reads int8
+        attn = attn * v_s_all[..., 0][:, :, None, None, :]
+    attn = attn.astype(q.dtype)
+    out = jnp.einsum("bkgms,bksd->bkgmd", attn, v_read)
     out = out.transpose(0, 3, 1, 2, 4).reshape(
         B, m, cfg.n_heads * cfg.d_head)
     x = x + matmul_any(out, layer["wo"], x.dtype)
@@ -138,6 +187,8 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos):
     h2 = _rmsnorm(x, layer["ln2"])
     h2 = jax.nn.gelu(matmul_any(h2, layer["w1"], x.dtype))
     x = x + matmul_any(h2, layer["w2"], x.dtype)
+    if quantized:
+        return x, k_all, v_all, k_s_all, v_s_all
     return x, k_all, v_all
 
 
@@ -150,6 +201,19 @@ def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens):
     if cfg.pos_emb == "learned":
         x = x + params["pos"].astype(jnp.bfloat16)[
             _chunk_positions(pos, m)]                             # [B, m, D]
+
+    if "k_s" in cache:
+        def block_q(carry, inputs):
+            layer, k_cache, v_cache, k_s, v_s = inputs
+            outs = _decode_block(cfg, carry, layer, k_cache, v_cache, pos,
+                                 k_s_cache=k_s, v_s_cache=v_s)
+            return outs[0], outs[1:]
+
+        x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+            block_q, x, (params["blocks"], cache["k"], cache["v"],
+                         cache["k_s"], cache["v_s"]))
+        return head_logits(params, x), {"k": k_new, "v": v_new,
+                                        "k_s": ks_new, "v_s": vs_new}
 
     def block(carry, inputs):
         layer, k_cache, v_cache = inputs
@@ -192,6 +256,21 @@ def _prefill_trunk(cfg: ModelConfig, params, cache, prompt,
         return _block(cfg, carry, layer, attn_fn), (k, v)
 
     x, (ks, vs) = jax.lax.scan(block, x, params["blocks"])
+    if "k_s" in cache:
+        from tpu_dra.workloads.quant import quantize_kv
+        ks_q, ks_s = quantize_kv(ks)                # [L, B, Hkv, S, Dh/1]
+        vs_q, vs_s = quantize_kv(vs)
+        cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache["k"], ks_q, (0, 0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache["v"], vs_q, (0, 0, 0, 0, 0)),
+            "k_s": jax.lax.dynamic_update_slice(
+                cache["k_s"], ks_s, (0, 0, 0, 0, 0)),
+            "v_s": jax.lax.dynamic_update_slice(
+                cache["v_s"], vs_s, (0, 0, 0, 0, 0)),
+        }
+        return cache, x
     cache = {
         "k": jax.lax.dynamic_update_slice(
             cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
@@ -238,7 +317,7 @@ def _select_token(logits, key, temperature: float, top_k: int):
 def decode(cfg: ModelConfig, params, prompt, *, steps: int,
            lengths=None, max_len: int | None = None,
            attn_impl: str = "dense", temperature: float = 0.0,
-           top_k: int = 0, rng=None):
+           top_k: int = 0, rng=None, cache_dtype: str = "bf16"):
     """Decode ``steps`` tokens after a [B, S] prompt — greedy by default,
     temperature/top-k sampling when ``temperature > 0``.
 
@@ -252,6 +331,12 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
     B, S = prompt.shape
     max_len = max_len or cfg.max_seq
     assert S + steps <= max_len, (S, steps, max_len)
+    if cfg.pos_emb == "learned" and S + steps > cfg.max_seq:
+        # the learned pos table has cfg.max_seq rows; gathering past it
+        # would silently clamp to the last row instead of failing
+        raise ValueError(
+            f"S + steps = {S + steps} exceeds the learned-position table "
+            f"(max_seq={cfg.max_seq}); grow max_seq or use rope")
     if lengths is not None:
         lengths = lengths.astype(jnp.int32)
         if not isinstance(lengths, jax.core.Tracer):
@@ -264,7 +349,7 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
         rng = jax.random.PRNGKey(0)
     keys = (jax.random.split(rng, steps + 1) if temperature > 0.0
             else jnp.zeros((steps + 1, 2), jnp.uint32))
-    cache = init_kv_cache(cfg, B, max_len)
+    cache = init_kv_cache(cfg, B, max_len, cache_dtype)
     if lengths is None:
         cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
     else:
@@ -289,15 +374,17 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
 
 
 def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
-                  max_len: int | None = None, attn_impl: str = "dense"):
+                  max_len: int | None = None, attn_impl: str = "dense",
+                  cache_dtype: str = "bf16"):
     """Greedy-decode ``steps`` tokens after a [B, S] prompt."""
     return decode(cfg, params, prompt, steps=steps, max_len=max_len,
-                  attn_impl=attn_impl)
+                  attn_impl=attn_impl, cache_dtype=cache_dtype)
 
 
 def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
                   max_len: int | None = None, attn_impl: str = "dense",
-                  temperature: float = 0.0, top_k: int = 0, rng=None):
+                  temperature: float = 0.0, top_k: int = 0, rng=None,
+                  cache_dtype: str = "bf16"):
     """Batched decode over right-padded prompts of different lengths —
     continuous-batching-lite: one compiled program serves a mixed batch,
     every sequence advancing from its own position (scatter cache writes,
@@ -309,14 +396,16 @@ def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
     """
     return decode(cfg, params, prompts, steps=steps, lengths=lengths,
                   max_len=max_len, attn_impl=attn_impl,
-                  temperature=temperature, top_k=top_k, rng=rng)
+                  temperature=temperature, top_k=top_k, rng=rng,
+                  cache_dtype=cache_dtype)
 
 
 def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
                        draft_params, prompt, *, steps: int, k: int = 4,
                        max_len: int | None = None,
                        attn_impl: str = "dense",
-                       return_stats: bool = False):
+                       return_stats: bool = False,
+                       cache_dtype: str = "bf16"):
     """Greedy speculative decoding: a cheap draft model proposes ``k-1``
     tokens autoregressively, the target verifies them in ONE cached
     ``k``-token chunk forward, and the longest matching prefix plus the
@@ -338,10 +427,16 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
     # every iteration commits ≥1 token and writes ≤k cache slots past the
     # committed stream; frozen rows stop advancing, so pos ≤ S+steps+k
     assert S + steps + k <= max_len, (S, steps, k, max_len)
+    if cfg.pos_emb == "learned" and S + steps + k > cfg.max_seq:
+        # same guard as decode(): gathers past the pos table silently
+        # clamp to the last row instead of failing
+        raise ValueError(
+            f"S + steps + k = {S + steps + k} exceeds the learned-position "
+            f"table (max_seq={cfg.max_seq}); grow max_seq or use rope")
 
-    t_cache = init_kv_cache(cfg, B, max_len)
+    t_cache = init_kv_cache(cfg, B, max_len, cache_dtype)
     t_cache, t_logits = prefill(cfg, params, t_cache, prompt, attn_impl)
-    d_cache = init_kv_cache(draft_cfg, B, max_len)
+    d_cache = init_kv_cache(draft_cfg, B, max_len, cache_dtype)
     d_cache, _ = prefill(draft_cfg, draft_params, d_cache, prompt, attn_impl)
 
     last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # committed #1
@@ -401,10 +496,11 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
 
         adv = n + 1
         return (
-            {"k": freeze(done, t_cache2["k"], t_cache["k"], 1),
-             "v": freeze(done, t_cache2["v"], t_cache["v"], 1)},
-            {"k": freeze(done, d_cache2["k"], d_cache["k"], 1),
-             "v": freeze(done, d_cache2["v"], d_cache["v"], 1)},
+            # freeze every cache leaf — including int8 scale buffers
+            {key: freeze(done, t_cache2[key], t_cache[key], 1)
+             for key in t_cache},
+            {key: freeze(done, d_cache2[key], d_cache[key], 1)
+             for key in d_cache},
             jnp.where(done, pos, pos + adv),
             jnp.where(done, last, bonus),
             out,
@@ -432,11 +528,13 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
 
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
                  attn_impl: str = "dense", temperature: float = 0.0,
-                 top_k: int = 0):
+                 top_k: int = 0, cache_dtype: str = "bf16"):
     """jit-compiled ``(params, prompt [B, S][, rng]) -> tokens [B, steps]``."""
     if temperature == 0.0:
         return jax.jit(partial(greedy_decode, cfg, steps=steps,
-                               max_len=max_len, attn_impl=attn_impl))
+                               max_len=max_len, attn_impl=attn_impl,
+                               cache_dtype=cache_dtype))
     return jax.jit(lambda params, prompt, rng: decode(
         cfg, params, prompt, steps=steps, max_len=max_len,
-        attn_impl=attn_impl, temperature=temperature, top_k=top_k, rng=rng))
+        attn_impl=attn_impl, temperature=temperature, top_k=top_k, rng=rng,
+        cache_dtype=cache_dtype))

@@ -173,6 +173,11 @@ def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
         f"seq lens {(s, sk)} must tile by {(bq, bk)}"
     k_steps = sk // bk
     grid = (bh, s // bq, k_steps)
+    # Plain map when there is no GQA sharing: an identity ``b // g``
+    # obscures the index from Mosaic's invariant-block analysis (see the
+    # backward's kv_map note — measured 3× there).
+    kv_map = (lambda b, i, j: (b, j, 0)) if g == 1 else \
+        (lambda b, i, j: (b // g, j, 0))
     # Fold softmax scale and the exp→exp2 base change into q once ([S, D])
     # instead of per score block ([S, S] · k_steps): the kernel's softmax
     # then runs in base-2 log space with no per-block scale pass.
@@ -183,8 +188,8 @@ def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), kv_map),
+            pl.BlockSpec((1, bk, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -207,7 +212,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
     """dQ = scale · (P ∘ (dO·Vᵀ − D)) · K, streamed over k blocks with the
     (bq, d) accumulator in VMEM scratch.  q arrives pre-scaled (base-2 log
     space, see _flash_attn_fwd) so P is recomputed exactly as the forward
-    produced it: P = exp2(qs·kᵀ − l2)."""
+    produced it: P = exp2(qs·kᵀ − l2).
+
+    Like the dK/dV kernel, everything is computed in the TRANSPOSED
+    [bk, bq] orientation: l2/dd arrive as [1, bq] row vectors whose
+    subtraction broadcasts down sublanes (measured 3.6× over the
+    row-major form on v5e — the [bq, 1] lane-broadcast layout stalls),
+    and the final accumulate contracts dSᵀ's axis 0 directly
+    (dot_general ((0,), (0,)) — AᵀB is MXU-native; an explicit
+    [bk, bq]→[bq, bk] relayout instead erases the whole win)."""
     i = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -216,20 +229,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _compute(masked: bool):
-        s2 = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        s2t = jax.lax.dot_general(                  # k·qsᵀ  [bk, bq]
+            k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        p = jnp.exp2(s2 - l2_ref[0])                # [bq, bk], true probs
+        pt = jnp.exp2(s2t - l2_ref[0])              # row broadcast [1, bq]
         if masked:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            p = jnp.where(rows >= cols, p, 0.0)
-        dp = jax.lax.dot_general(                   # dO·Vᵀ  [bq, bk]
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+            pt = jnp.where(rows >= cols, pt, 0.0)
+        dpt = jax.lax.dot_general(                  # V·dOᵀ  [bk, bq]
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - dd_ref[0])                   # [bq, bk] fp32
-        acc_ref[:] += jax.lax.dot_general(          # ds·K  [bq, d]
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        dst = pt * (dpt - dd_ref[0])                # [bk, bq] fp32
+        acc_ref[:] += jax.lax.dot_general(          # dSᵀᵀ·K = [bq, d]
+            dst.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if not causal:
@@ -310,10 +323,14 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     MXU instead of materializing the [S, S] probability matrix the way the
     XLA oracle (_attn_reference) does.
 
-    Blocks are capped at 512 regardless of the forward's: the backward
-    holds four [bq, bk] fp32 intermediates (s2/p/dp/ds) per step, so the
-    forward's 1024² sweet spot overflows VMEM here (measured 2.6× slower
-    on v5e at S=4096).
+    Backward blocks are ASYMMETRIC, independent of the forward's 1024²
+    sweet spot: the inner streamed axis stays at 256 and the accumulator
+    axis goes wide (dq: bq=1024/bk=256; dK/dV: bq=256/bk=1024).  Measured
+    on v5e @ S=4096: square 512² blocks stall the Mosaic pipeline in both
+    kernels (dq 1760→489 µs, dK/dV 1719→607 µs after the split) — the
+    four [bq·bk] fp32 intermediates (s2/p/dp/ds) of a 512² block leave
+    too little VMEM for the pipeliner's double buffering, while 256-wide
+    streamed blocks restore overlap without shrinking the MXU tiles.
 
     GQA (``k``/``v`` with BHkv = BH/grp head-batches): dQ shares kv blocks
     through ``// grp`` index maps like the forward; dK/dV runs at per-q-head
@@ -324,12 +341,19 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     bhkv, sk = k.shape[0], k.shape[1]
     assert bh % bhkv == 0, (bh, bhkv)
     grp = bh // bhkv
-    bq, bk = min(bq, s), min(bk, sk)
-    if s % 512 == 0:
-        bq = min(bq, 512)
-    if sk % 512 == 0:
-        bk = min(bk, 512)
-    assert s % bq == 0 and sk % bk == 0
+    def _cap(n, want):
+        # largest block ≤ want that divides n (shapes are 128-multiples)
+        b = min(n, want)
+        while n % b:
+            b //= 2
+        return b
+
+    # The caller's bq/bk still cap the backward blocks (tests pass tiny
+    # blocks to exercise the multi-block causal paths under interpret);
+    # production callers pass >= the asymmetric sweet spot and land
+    # exactly on it.
+    bq_dq, bk_dq = _cap(s, min(bq, 1024)), _cap(sk, min(bk, 256))
+    bq_kv, bk_kv = _cap(s, min(bq, 256)), _cap(sk, min(bk, 1024))
     scale = d ** -0.5
     qs = (q * (scale * _LOG2E)).astype(q.dtype)
     # D_i = rowsum(dO ∘ O): one fused elementwise pass, [BH, S, 1]
@@ -341,53 +365,67 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
         # ds = p·(dp − dd) becomes p·(dp − (dd − log2e·g_l2)).  Zero kernel
         # changes — only the dd operand shifts.
         dd = dd - _LOG2E * g_l2.astype(jnp.float32).reshape(bh, s, 1)
-    common = dict(
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // grp, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b // grp, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-        ],
-        interpret=interpret,
-        compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )
+    compiler_params = (None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")))
+    # The k/v index maps must be the PLAIN lambda when grp == 1: an
+    # always-identity ``b // grp`` defeats Mosaic's invariant-block
+    # analysis, and the dK/dV kernel (k/v constant across its inner axis)
+    # then re-DMAs both blocks every step — measured 3× slower on v5e
+    # (1895 vs 620 µs at S=4096).  With real GQA groups the division is
+    # semantically required and the re-fetch is the price of sharing.
+    if grp == 1:
+        kv_map_dq = lambda b, i, j: (b, j, 0)
+        kv_map_kv = lambda b, j, i: (b, j, 0)
+    else:
+        kv_map_dq = lambda b, i, j: (b // grp, j, 0)
+        kv_map_kv = lambda b, j, i: (b // grp, j, 0)
+    # Both kernels run transposed, so both take l2/dd as [BH, 1, S] row
+    # vectors (free reshape: (BH, S, 1) and (BH, 1, S) share a layout).
+    l2_row = l2.reshape(bh, 1, s)
+    dd_row = dd.reshape(bh, 1, s)
+    bq, bk = bq_dq, bk_dq
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, k_steps=sk // bk,
                           causal=causal, bq=bq, bk=bk, scale=scale),
         grid=(bh, s // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), kv_map_dq),
+            pl.BlockSpec((1, bk, d), kv_map_dq),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        **common,
-    )(qs, k, v, g, l2, dd)
-    # dK/dV grid: k-block outer (parallel), q-block inner (arbitrary) — the
-    # index maps swap i/j roles relative to the dq call, and l2/dd are fed
-    # as [BH, 1, S] row vectors for the kernel's transposed orientation
-    # (free reshape: (BH, S, 1) and (BH, 1, S) share a memory layout).
-    dkdv_specs = dict(common)
-    dkdv_specs["in_specs"] = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // grp, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b // grp, j, 0)),
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-    ]
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(qs, k, v, g, l2_row, dd_row)
+    # dK/dV grid: k-block outer (parallel), q-block inner (arbitrary) —
+    # the index maps swap i/j roles relative to the dq call.
+    bq, bk = bq_kv, bk_kv
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, q_steps=s // bq,
                           causal=causal, bq=bq, bk=bk),
         grid=(bh, sk // bk, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), kv_map_kv),
+            pl.BlockSpec((1, bk, d), kv_map_kv),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+        ],
         out_specs=[pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        **dkdv_specs,
-    )(qs, k, v, g, l2.reshape(bh, 1, s), dd.reshape(bh, 1, s))
+        interpret=interpret,
+        compiler_params=compiler_params,
+    )(qs, k, v, g, l2_row, dd_row)
     if grp > 1:
         dk = dk.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
         dv = dv.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)

@@ -85,6 +85,23 @@ def int8_matmul(x: jax.Array, wq: jax.Array, s_w: jax.Array) -> jax.Array:
     return y.astype(jnp.float32) * s_x * s_w
 
 
+def quantize_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """``[..., m, Dh]`` bf16 k/v chunk → ``(int8 [..., m, Dh],
+    f32 scales [..., m, 1])`` with symmetric per-position scales.
+
+    Per-(position, head) scaling is the KV-cache-friendly granularity:
+    the scale factors out of the attention contractions (over Dh for
+    scores, over S for the value sum), so the cached int8 never needs a
+    dequantized HBM copy — the score/prob tensors are rescaled instead
+    (see decode._decode_block).
+    """
+    tf = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(tf), axis=-1, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(tf / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
 def is_quantized(w: Leaf) -> bool:
     return isinstance(w, dict) and "q8" in w
 

@@ -39,9 +39,14 @@ class DecoderPool:
     top_k) buckets; thread-safe (requests may arrive concurrently, JAX
     dispatch is already serialized internally)."""
 
-    def __init__(self, cfg: ModelConfig, params):
+    def __init__(self, cfg: ModelConfig, params,
+                 cache_dtype: str = "bf16"):
+        """``params`` may be a full-precision, bf16-cast, or int8-quantized
+        tree (quant.py) — the decode paths dispatch per weight leaf.
+        ``cache_dtype="int8"`` serves with a quantized KV cache."""
         self.cfg = cfg
         self.params = params
+        self.cache_dtype = cache_dtype
         self._fns: dict = {}
         self._lock = threading.Lock()
 
@@ -72,7 +77,8 @@ class DecoderPool:
             if fn is None:
                 fn = jax.jit(partial(
                     decode, self.cfg, steps=steps,
-                    temperature=temperature, top_k=top_k))
+                    temperature=temperature, top_k=top_k,
+                    cache_dtype=self.cache_dtype))
                 self._fns[key] = fn
         toks = fn(self.params, prompts,
                   lengths=jnp.asarray(lengths, jnp.int32),
