@@ -179,16 +179,16 @@ def section_train() -> dict:
     batch, seq = (16, cfg.max_seq) if on_tpu else (2, cfg.max_seq)
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
     params = init_params(cfg, jax.random.PRNGKey(0))
-    # attention impl at the flagship's S=1024: dense XLA fuses better than
-    # the Pallas flash pair (measured 63.4% vs 61.3% MFU at d=2048; the
-    # crossover where flash wins is S ≳ 2k — its own MFU is reported by
-    # section_flash).  chunked head: streamed-vocab NLL — the
-    # [B,S,32768] fp32 logits never materialize (delta reported as
-    # train_step_chunked_*)
+    # attention impl: the Pallas flash pair beats dense XLA attention
+    # since the backward rework (64.5% vs 59.3% MFU at d=2048/S=1024;
+    # 57.6% vs 50.0% at S=2048 — the gap widens with S).  chunked head:
+    # streamed-vocab NLL — the [B,S,32768] fp32 logits never materialize
+    # (delta reported as train_step_chunked_*)
+    attn = "flash" if on_tpu else "dense"
     step, p_shard, b_shard = make_sharded_train_step(cfg, mesh,
-                                                     attn_impl="dense")
+                                                     attn_impl=attn)
     step_chunked, _, _ = make_sharded_train_step(
-        cfg, mesh, attn_impl="dense", head_impl="chunked")
+        cfg, mesh, attn_impl=attn, head_impl="chunked")
     params = jax.device_put(params, p_shard)
     tokens = jax.device_put(
         jnp.zeros((batch, seq), dtype=jnp.int32), b_shard)
@@ -234,6 +234,36 @@ def section_train() -> dict:
     out["train_step_chunked_tokens_per_s"] = round(
         tokens_per_step / secs_c, 1)
     out["train_step_chunked_loss_finite"] = bool(np.isfinite(lossf))
+    if on_tpu:
+        # long-context training on one chip: S=4096 via the flash pair +
+        # chunked-vocab head + selective remat (MFU counts param flops
+        # only, like the headline — attention flops are a bonus on top)
+        import dataclasses
+        lcfg = dataclasses.replace(cfg, max_seq=4096)
+        lstep, lp_shard, lb_shard = make_sharded_train_step(
+            lcfg, mesh, attn_impl="flash", head_impl="chunked")
+        lparams = jax.device_put(init_params(lcfg, jax.random.PRNGKey(0)),
+                                 lp_shard)
+        ltokens = jax.device_put(jnp.zeros((2, 4096), jnp.int32), lb_shard)
+        lparams, loss = lstep(lparams, ltokens)
+        lossf = float(loss)
+        secs_l = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(4):
+                lparams, loss = lstep(lparams, ltokens)
+            lossf = float(loss)
+            secs_l = min(secs_l, (time.perf_counter() - t0) / 4)
+        ltoks = 2 * 4095
+        # count the long model's own params (its learned-pos table is 4x
+        # the headline flagship's)
+        n_params_l = sum(int(np.prod(p.shape))
+                         for p in jax.tree.leaves(lparams))
+        out["train_long_seq"] = 4096
+        out["train_long_tokens_per_s"] = round(ltoks / secs_l, 1)
+        out["train_long_mfu_pct"] = _mfu(
+            6 * n_params_l * ltoks / secs_l / 1e12, dev)
+        out["train_long_loss_finite"] = bool(np.isfinite(lossf))
     return out
 
 
