@@ -17,7 +17,15 @@ The TPU analogs here are first-class framework components
 - :mod:`tpu_dra.workloads.pipeline` / :mod:`tpu_dra.workloads.moe` —
   GPipe pipeline and switch-MoE expert parallelism.
 - :mod:`tpu_dra.workloads.decode` — static-shape KV-cache serving:
-  greedy/sampled, ragged mixed-length batches, GQA caches.
+  greedy/sampled, ragged mixed-length batches, GQA caches, speculative
+  decoding, bf16/int8 caches.
+- :mod:`tpu_dra.workloads.quant` — serving quantization: bf16 cast,
+  per-channel int8 weights + dynamic per-token activation scales on the
+  native int8 MXU, int8 KV caches; the ``matmul_any`` dispatch point
+  every weight form flows through.
+- :mod:`tpu_dra.workloads.lora` — LoRA fine-tuning over a frozen
+  (optionally int8) base: adapter-only grads/moments, exact-at-init
+  wrap, serving merge.
 - :mod:`tpu_dra.workloads.serve` — bucketed HTTP inference endpoint.
 - :mod:`tpu_dra.workloads.data` / :mod:`tpu_dra.workloads.fit` /
   :mod:`tpu_dra.workloads.checkpointing` — memmap data pipeline with a

@@ -68,13 +68,25 @@ def quantize_int8(w: jax.Array) -> dict[str, jax.Array]:
     return {"q8": q, "s": s}
 
 
+@jax.custom_vjp
 def int8_matmul(x: jax.Array, wq: jax.Array, s_w: jax.Array) -> jax.Array:
     """``x [..., K] (bf16/f32) @ wq [K, N] (int8)`` with dynamic per-row
     activation quantization; returns fp32 ``[..., N]``.
 
     Both operands reach the MXU as int8 (its native 2×-rate mode); the
     fp32 rescale is a rank-1 outer product fused into the output.
+
+    Differentiable via a straight-through estimator: the activation
+    round/clip has zero true gradient, so the backward treats the op as
+    ``x @ dequant(wq)`` (dx = (g·s_w)·wqᵀ).  Without this, any training
+    through a quantized matmul — e.g. LoRA adapters over an int8 frozen
+    base — silently receives zero gradients.  wq/s_w get no cotangent
+    (serving weights are frozen by construction).
     """
+    return _int8_matmul_impl(x, wq, s_w)
+
+
+def _int8_matmul_impl(x, wq, s_w):
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)       # [..., 1]
     s_x = jnp.maximum(amax, 1e-8) / 127.0
@@ -83,6 +95,26 @@ def int8_matmul(x: jax.Array, wq: jax.Array, s_w: jax.Array) -> jax.Array:
         xq, wq, (((xq.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
     return y.astype(jnp.float32) * s_x * s_w
+
+
+def _int8_matmul_fwd(x, wq, s_w):
+    # residuals must be JAX types: carry x's dtype as a 0-size array
+    return _int8_matmul_impl(x, wq, s_w), (wq, s_w,
+                                           jnp.zeros((0,), x.dtype))
+
+
+def _int8_matmul_bwd(res, g):
+    import numpy as np
+    wq, s_w, x_proto = res
+    dx = jax.lax.dot_general(
+        (g * s_w).astype(jnp.float32), wq.astype(jnp.float32),
+        (((g.ndim - 1,), (1,)), ((), ()))).astype(x_proto.dtype)
+    # int8 primal ⇒ float0 cotangent (JAX's "no gradient" dtype)
+    d_wq = np.zeros(wq.shape, dtype=jax.dtypes.float0)
+    return dx, d_wq, jnp.zeros_like(s_w)
+
+
+int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
 
 
 def quantize_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -106,15 +138,28 @@ def is_quantized(w: Leaf) -> bool:
     return isinstance(w, dict) and "q8" in w
 
 
+def is_lora(w: Leaf) -> bool:
+    return isinstance(w, dict) and "a" in w and "b" in w
+
+
 def matmul_any(x: jax.Array, w: Leaf, dtype=None) -> jax.Array:
     """The one matmul the model paths call: dispatches on the weight
-    leaf's form so fp32, bf16, and int8-quantized parameter trees all
-    flow through the same forward code.
+    leaf's form so fp32, bf16, int8-quantized, and LoRA-wrapped
+    parameter trees all flow through the same forward code.
 
     - plain array: ``x @ w`` in ``dtype`` (default: x.dtype)
     - ``{"q8", "s"}``: int8 MXU matmul, result cast to ``dtype``
+    - ``{"base", "a", "b", "scale"}`` (lora.py): recursive base matmul
+      (the frozen base may itself be plain or int8) plus the rank-r
+      adapter path ``scale · (x·A)·B`` — r ≪ K, so the adapter adds
+      negligible flops/bytes on top of the base read
     """
     out_dtype = dtype or x.dtype
+    if is_lora(w):
+        base = matmul_any(x, w["base"], out_dtype)
+        xa = x.astype(out_dtype) @ w["a"].astype(out_dtype)
+        ab = (xa @ w["b"].astype(out_dtype)) * w["scale"].astype(out_dtype)
+        return base + ab
     if is_quantized(w):
         return int8_matmul(x, w["q8"], w["s"]).astype(out_dtype)
     return x @ w.astype(out_dtype)
@@ -145,11 +190,20 @@ def quantize_params_int8(params: dict) -> dict:
     for name in _QUANT_BLOCK_LEAVES:
         # quantize from the original full-precision weights, not the
         # bf16-cast copies — no double rounding.  ndim == 3 restricts to
-        # [L, K, N] dense stacks (see _QUANT_BLOCK_LEAVES note).
-        if name in params["blocks"] and params["blocks"][name].ndim == 3:
-            blocks[name] = quantize_int8(params["blocks"][name])
+        # [L, K, N] dense stacks (see _QUANT_BLOCK_LEAVES note); dict
+        # leaves (already-quantized or LoRA-wrapped — merge_lora first)
+        # are skipped.
+        leaf = params["blocks"].get(name)
+        # dict leaves = already-quantized or LoRA-wrapped subtrees; plain
+        # array-likes (jax OR numpy, e.g. an orbax restore without a
+        # template) quantize
+        if leaf is not None and not isinstance(leaf, dict) and \
+                leaf.ndim == 3:
+            blocks[name] = quantize_int8(leaf)
     out["blocks"] = blocks
     for name in _QUANT_TOP_LEAVES:
-        if name in params and params[name].ndim == 2:
-            out[name] = quantize_int8(params[name])
+        leaf = params.get(name)
+        if leaf is not None and not isinstance(leaf, dict) and \
+                leaf.ndim == 2:
+            out[name] = quantize_int8(leaf)
     return out
