@@ -86,3 +86,22 @@ def test_generate_rejects_bad_input(server):
             _post(base, bad)
         assert exc.value.code == 400
         assert "error" in json.loads(exc.value.read())
+
+
+def test_jax_trace_endpoint(server):
+    """/debug/jax-trace returns a tar.gz of an XPlane trace directory (or
+    503 when the backend has no profiler — never a crash)."""
+    import io
+    import tarfile
+    cfg, params, base = server
+    try:
+        with urllib.request.urlopen(f"{base}/debug/jax-trace?seconds=0.2",
+                                    timeout=120) as r:
+            assert r.status == 200
+            data = r.read()
+    except urllib.error.HTTPError as e:
+        assert e.code == 503           # profiler unavailable: clean error
+        return
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        names = tar.getnames()
+    assert any(n.startswith("jax-trace") for n in names), names

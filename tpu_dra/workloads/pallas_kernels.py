@@ -332,11 +332,13 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     too little VMEM for the pipeliner's double buffering, while 256-wide
     streamed blocks restore overlap without shrinking the MXU tiles.
 
-    GQA (``k``/``v`` with BHkv = BH/grp head-batches): dQ shares kv blocks
-    through ``// grp`` index maps like the forward; dK/dV runs at per-q-head
-    resolution (each q head's contribution lands in its own [BH, Sk, D]
-    slot — no revisited output blocks, no cross-head races) and the group
-    sum down to [BHkv, Sk, D] happens in one XLA reshape+sum."""
+    GQA (``k``/``v`` with BHkv = BH/grp head-batches): the kv group
+    expansion is materialized to [BH, Sk, D] before the kernels (see the
+    kv_map note below — index-map sharing via ``// grp`` stalls Mosaic);
+    dK/dV runs at per-q-head resolution (each q head's contribution lands
+    in its own [BH, Sk, D] slot — no revisited output blocks, no
+    cross-head races) and the group sum down to [BHkv, Sk, D] happens in
+    one XLA reshape+sum."""
     bh, s, d = q.shape
     bhkv, sk = k.shape[0], k.shape[1]
     assert bh % bhkv == 0, (bh, bhkv)
@@ -367,18 +369,21 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
         dd = dd - _LOG2E * g_l2.astype(jnp.float32).reshape(bh, s, 1)
     compiler_params = (None if interpret else pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")))
-    # The k/v index maps must be the PLAIN lambda when grp == 1: an
-    # always-identity ``b // grp`` defeats Mosaic's invariant-block
-    # analysis, and the dK/dV kernel (k/v constant across its inner axis)
-    # then re-DMAs both blocks every step — measured 3× slower on v5e
-    # (1895 vs 620 µs at S=4096).  With real GQA groups the division is
-    # semantically required and the re-fetch is the price of sharing.
-    if grp == 1:
-        kv_map_dq = lambda b, i, j: (b, j, 0)
-        kv_map_kv = lambda b, j, i: (b, j, 0)
-    else:
-        kv_map_dq = lambda b, i, j: (b // grp, j, 0)
-        kv_map_kv = lambda b, j, i: (b // grp, j, 0)
+    # The k/v index maps must be the PLAIN lambda: an always-identity
+    # ``b // grp`` defeats Mosaic's invariant-block analysis, and the
+    # dK/dV kernel (k/v constant across its inner axis) then re-DMAs
+    # both blocks every step — measured 3× slower on v5e (1895 vs 620 µs
+    # at S=4096).  So for GQA the kv group expansion is MATERIALIZED
+    # here ([BH, Sk, D] bf16 — a few MB of HBM at bench shapes, trivial
+    # against the 3× kernel stall the division would cost) and the
+    # per-q-head dk/dv get group-summed back after the kernels.
+    if grp > 1:
+        k = jnp.broadcast_to(k[:, None], (bhkv, grp, sk, d)).reshape(
+            bh, sk, d)
+        v = jnp.broadcast_to(v[:, None], (bhkv, grp, sk, d)).reshape(
+            bh, sk, d)
+    kv_map_dq = lambda b, i, j: (b, j, 0)
+    kv_map_kv = lambda b, j, i: (b, j, 0)
     # Both kernels run transposed, so both take l2/dd as [BH, 1, S] row
     # vectors (free reshape: (BH, S, 1) and (BH, 1, S) share a layout).
     l2_row = l2.reshape(bh, 1, s)

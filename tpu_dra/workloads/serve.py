@@ -102,8 +102,48 @@ def make_handler(pool: DecoderPool):
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, b"ok", "text/plain")
+            elif self.path.startswith("/debug/jax-trace"):
+                self._jax_trace()
             else:
                 self._send(404, b"not found", "text/plain")
+
+        def _jax_trace(self):
+            """Device-level trace capture (`jax.profiler.trace`): records
+            XLA/device activity for ``seconds`` (default 1, max 30) while
+            the server keeps answering /generate, and returns the XPlane
+            trace directory as a tar.gz consumable by TensorBoard/XProf.
+            The pprof endpoints on the driver processes (util/metrics.py)
+            profile Python; this is the accelerator-side counterpart for
+            the serving process."""
+            import io
+            import tarfile
+            import tempfile
+            import time as _time
+            import urllib.parse
+
+            q = urllib.parse.urlparse(self.path).query
+            try:
+                secs = float(urllib.parse.parse_qs(q).get(
+                    "seconds", ["1"])[0])
+            except ValueError:
+                self._send(400, json.dumps(
+                    {"error": "seconds must be a number"}).encode())
+                return
+            if not 0 <= secs <= 30:
+                self._send(400, json.dumps(
+                    {"error": "seconds must be in [0, 30]"}).encode())
+                return
+            try:
+                with tempfile.TemporaryDirectory() as td:
+                    with jax.profiler.trace(td):
+                        _time.sleep(secs)
+                    buf = io.BytesIO()
+                    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                        tar.add(td, arcname="jax-trace")
+                    self._send(200, buf.getvalue(), "application/gzip")
+            except Exception as exc:   # profiler availability varies by
+                self._send(503, json.dumps(   # backend (e.g. relays)
+                    {"error": str(exc)[:300]}).encode())
 
         def do_POST(self):
             if self.path != "/generate":
