@@ -102,7 +102,7 @@ def make_handler(pool: DecoderPool):
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, b"ok", "text/plain")
-            elif self.path.startswith("/debug/jax-trace"):
+            elif self.path.split("?", 1)[0] == "/debug/jax-trace":
                 self._jax_trace()
             else:
                 self._send(404, b"not found", "text/plain")
@@ -140,10 +140,14 @@ def make_handler(pool: DecoderPool):
                     buf = io.BytesIO()
                     with tarfile.open(fileobj=buf, mode="w:gz") as tar:
                         tar.add(td, arcname="jax-trace")
-                    self._send(200, buf.getvalue(), "application/gzip")
+                    body = buf.getvalue()
             except Exception as exc:   # profiler availability varies by
                 self._send(503, json.dumps(   # backend (e.g. relays)
                     {"error": str(exc)[:300]}).encode())
+                return
+            # outside the try: a client disconnect mid-download must not
+            # trigger a second response on the same socket
+            self._send(200, body, "application/gzip")
 
         def do_POST(self):
             if self.path != "/generate":
