@@ -495,3 +495,46 @@ def test_multiprocess_slot_enforcement(tmp_path):
     removed = mgr.reconcile(live_claim_uids={"uid-9"})
     assert removed and removed[0].startswith("ghost-uid-")
     assert not any((tmp_path / "mp-slots").iterdir())
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps changes memory, not semantics: accumulated grads and
+    loss must match the single-pass full-batch values."""
+    from tpu_dra.workloads.train import (ModelConfig, grads_fn, init_params)
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    loss1, g1 = jax.jit(
+        lambda p, t: grads_fn(cfg, p, t))(params, tokens)
+    loss2, g2 = jax.jit(
+        lambda p, t: grads_fn(cfg, p, t, accum_steps=2))(params, tokens)
+    assert abs(float(loss1) - float(loss2)) < 1e-5
+    # bf16 activations: different reduction orders shift grads at the
+    # ~0.5% level; semantics equality is to working precision
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        import numpy as np
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.05, atol=1e-4)
+
+
+def test_accum_train_step_sharded():
+    """accum_steps composes with the dp x tp sharded step (microbatch
+    reshape splits the dp-sharded batch axis)."""
+    import numpy as np
+    from tpu_dra.workloads.train import (ModelConfig, init_params,
+                                         make_sharded_train_step)
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=16)
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = jax.sharding.Mesh(devs, ("dp", "tp"))
+    step, p_shard, b_shard = make_sharded_train_step(cfg, mesh,
+                                                     accum_steps=2)
+    params = jax.device_put(init_params(cfg, jax.random.PRNGKey(2)),
+                            p_shard)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0, cfg.vocab,
+                           dtype=jnp.int32), b_shard)
+    params, loss = step(params, tokens)
+    assert bool(jnp.isfinite(loss))

@@ -42,6 +42,7 @@ class FitResult:
 def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
         steps: int = 100, batch: int = 8, optimizer=None,
         attn_impl: str = "dense", head_impl: str = "dense",
+        accum_steps: int = 1,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0, resume: bool = False,
         log_every: int = 10, seed: int = 0,
@@ -58,15 +59,20 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
     if mesh is None:
         devs = np.array(jax.devices())
         mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
-    if batch % mesh.shape["dp"]:
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if batch % (mesh.shape["dp"] * accum_steps):
+        # each scan microbatch (batch/accum_steps rows) must itself split
+        # over dp, or GSPMD reshards the dp-sharded tokens every
+        # microbatch and the accumulation's memory win is lost
         raise ValueError(
-            f"batch {batch} must be divisible by the mesh's dp axis "
-            f"({mesh.shape['dp']})")
+            f"batch {batch} must be divisible by dp x accum_steps "
+            f"({mesh.shape['dp']} x {accum_steps})")
     seq = cfg.max_seq
     ds = TokenDataset(data_path)
     step_fn, init_opt, p_shard, b_shard = make_optax_train_step(
         cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
-        head_impl=head_impl)
+        head_impl=head_impl, accum_steps=accum_steps)
 
     start = 0
     params = jax.device_put(init_params(cfg, jax.random.PRNGKey(seed)),
@@ -204,6 +210,7 @@ def main(argv=None):
                     choices=("dense", "flash"))
     ap.add_argument("--head-impl", default="dense",
                     choices=("dense", "chunked"))
+    ap.add_argument("--accum-steps", type=int, default=1)
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", action="store_true")
@@ -216,6 +223,7 @@ def main(argv=None):
                       max_seq=args.max_seq, pos_emb=args.pos_emb)
     res = fit(cfg, args.data, steps=args.steps, batch=args.batch,
               attn_impl=args.attn_impl, head_impl=args.head_impl,
+              accum_steps=args.accum_steps,
               checkpoint_dir=args.checkpoint_dir,
               checkpoint_every=args.checkpoint_every, resume=args.resume)
     print(f"done: step {res.step} loss {res.loss:.4f} "

@@ -346,12 +346,42 @@ def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
     return jnp.mean(head_nll(params, trunk, tokens[:, 1:], head_impl))
 
 
+def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
+             head_impl: str = "dense", accum_steps: int = 1):
+    """(mean loss, grads) for a [B, S] batch, optionally via gradient
+    accumulation: ``accum_steps > 1`` splits the batch into that many
+    microbatches and runs them through one ``lax.scan`` (one compiled
+    fwd+bwd body, activations live for ONE microbatch at a time) —
+    effective batch B with the activation memory of B/accum_steps.
+    Equal microbatches ⇒ the mean-of-means equals the full-batch mean,
+    so accumulation changes memory, not semantics."""
+    vg = jax.value_and_grad(partial(loss_fn, cfg))
+    if accum_steps == 1:
+        return vg(params, tokens, attn_impl=attn_impl, head_impl=head_impl)
+    B = tokens.shape[0]
+    assert B % accum_steps == 0, (B, accum_steps)
+    micro = tokens.reshape(accum_steps, B // accum_steps, tokens.shape[1])
+
+    def body(carry, batch):
+        loss_acc, g_acc = carry
+        loss, g = vg(params, batch, attn_impl=attn_impl,
+                     head_impl=head_impl)
+        return (loss_acc + loss,
+                jax.tree.map(jnp.add, g_acc, g)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss_sum, g_sum), _ = jax.lax.scan(body, (jnp.float32(0.0), zeros),
+                                        micro)
+    inv = 1.0 / accum_steps
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
+
+
 def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens,
-                   attn_impl: str = "dense", head_impl: str = "dense"):
+                   attn_impl: str = "dense", head_impl: str = "dense",
+                   accum_steps: int = 1):
     """Full train step (fwd+bwd+update) as one jittable function."""
-    loss, grads = jax.value_and_grad(
-        partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl,
-                               head_impl=head_impl)
+    loss, grads = grads_fn(cfg, params, tokens, attn_impl=attn_impl,
+                           head_impl=head_impl, accum_steps=accum_steps)
     params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return params, loss
 
@@ -388,16 +418,20 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
                             attn_impl: str = "dense",
-                            head_impl: str = "dense"):
+                            head_impl: str = "dense",
+                            accum_steps: int = 1):
     """jit the full train step with DP×TP shardings over ``mesh`` (axes
     "dp", "tp").  ``attn_impl``: "dense" (XLA, best at short S) or "flash"
     (Pallas fwd+bwd kernels, best at long S).  ``head_impl``: "dense" or
-    "chunked" (streamed-vocab NLL, see head_nll)."""
+    "chunked" (streamed-vocab NLL, see head_nll).  ``accum_steps``:
+    gradient accumulation over that many microbatches (see grads_fn) —
+    combine with the chunked head to train effective batches whose
+    activations would not fit."""
     p_shard = param_shardings(cfg, mesh)
     b_shard = batch_sharding(mesh)
     step = jax.jit(
         partial(sgd_train_step, cfg, lr, attn_impl=attn_impl,
-                head_impl=head_impl),
+                head_impl=head_impl, accum_steps=accum_steps),
         in_shardings=(p_shard, b_shard),
         out_shardings=(p_shard, NamedSharding(mesh, P())))
     return step, p_shard, b_shard
@@ -405,7 +439,8 @@ def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
 
 def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
                           attn_impl: str = "dense",
-                          head_impl: str = "dense"):
+                          head_impl: str = "dense",
+                          accum_steps: int = 1):
     """Like ``make_sharded_train_step`` but with a real optax optimizer
     (default: AdamW + global-norm clipping).
 
@@ -425,9 +460,9 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
     rep = NamedSharding(mesh, P())
 
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            partial(loss_fn, cfg))(params, tokens, attn_impl=attn_impl,
-                                   head_impl=head_impl)
+        loss, grads = grads_fn(cfg, params, tokens, attn_impl=attn_impl,
+                               head_impl=head_impl,
+                               accum_steps=accum_steps)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
