@@ -111,7 +111,7 @@ def _write_kv(cache, new, pos):
 
 
 def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
-                  k_s_cache=None, v_s_cache=None):
+                  k_s_cache=None, v_s_cache=None, window: int | None = None):
     """One decoder block for an m-token [B, m, D] chunk against a
     [B, Hkv, S_max, Dh] cache; returns (x, k_all, v_all) with the chunk's
     k/v written at positions ``pos .. pos+m-1`` (``pos`` scalar, or [B]
@@ -131,6 +131,8 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
     operand load; no dequantized HBM copy)."""
     quantized = k_s_cache is not None
     B, m, _ = x.shape
+    assert window is None or m == 1, "sliding window is a decode-step " \
+        "(m == 1) feature; chunked verify paths keep the full cache"
     h = _rmsnorm(x, layer["ln1"])
     qkv = matmul_any(h, layer["wqkv"], x.dtype)
     q, k, v = _split_qkv(cfg, qkv)
@@ -142,19 +144,23 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
         q = apply_rope(q, positions, cfg.rope_base)
         k = apply_rope(k, positions, cfg.rope_base)       # cached rotated
 
+    # ring-buffer writes under a sliding window: the SLOT is pos mod W,
+    # while rope rotations and the validity mask below keep using the
+    # absolute position (rope is relative, so wrapped slots stay exact)
+    wpos = pos if window is None else pos % window
     if quantized:
         from tpu_dra.workloads.quant import quantize_kv
         k_q, k_s = quantize_kv(k)
         v_q, v_s = quantize_kv(v)
-        k_all = _write_kv(k_cache, k_q, pos)
-        v_all = _write_kv(v_cache, v_q, pos)
-        k_s_all = _write_kv(k_s_cache, k_s, pos)
-        v_s_all = _write_kv(v_s_cache, v_s, pos)
+        k_all = _write_kv(k_cache, k_q, wpos)
+        v_all = _write_kv(v_cache, v_q, wpos)
+        k_s_all = _write_kv(k_s_cache, k_s, wpos)
+        v_s_all = _write_kv(v_s_cache, v_s, wpos)
         k_read = k_all.astype(x.dtype)
         v_read = v_all.astype(x.dtype)
     else:
-        k_all = _write_kv(k_cache, k, pos)
-        v_all = _write_kv(v_cache, v, pos)
+        k_all = _write_kv(k_cache, k, wpos)
+        v_all = _write_kv(v_cache, v, wpos)
         k_read, v_read = k_all, v_all
 
     hkv, g = cfg.kv_heads, cfg.n_heads // cfg.kv_heads
@@ -169,8 +175,17 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
     # columns beyond hold zeros or not-yet-overwritten stale entries
     # (ragged pads, rejected speculative drafts) and must stay invisible
     col = jnp.arange(k_cache.shape[2])
-    valid = (col[None, None, :] <=
-             _chunk_positions(pos, m)[:, :, None])        # [B, m, S]
+    if window is None:
+        valid = (col[None, None, :] <=
+                 _chunk_positions(pos, m)[:, :, None])    # [B, m, S]
+    else:
+        # slot c holds the latest absolute position ≤ pos congruent to c
+        # (mod W): p_c = pos − ((pos − c) mod W).  Negative p_c ⇒ the
+        # slot has never been written (pre-wrap zeros) and stays masked;
+        # everything else is inside the window by construction.
+        pb = _chunk_positions(pos, m)[:, :, None]         # [B, 1, 1]
+        p_c = pb - jnp.mod(pb - col[None, None, :], window)
+        valid = p_c >= 0                                  # [B, 1, W]
     scores = jnp.where(valid[:, None, None], scores,
                        jnp.finfo(scores.dtype).min)
     attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
@@ -192,7 +207,8 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
     return x, k_all, v_all
 
 
-def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens):
+def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens,
+                  window: int | None = None):
     """Cached forward over an m-token chunk: ``tokens`` [B, m] at
     positions ``pos .. pos+m-1`` → ([B, m, vocab] logits, updated cache).
     m == 1 is the plain decode step; m > 1 is the speculative verify."""
@@ -206,7 +222,8 @@ def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens):
         def block_q(carry, inputs):
             layer, k_cache, v_cache, k_s, v_s = inputs
             outs = _decode_block(cfg, carry, layer, k_cache, v_cache, pos,
-                                 k_s_cache=k_s, v_s_cache=v_s)
+                                 k_s_cache=k_s, v_s_cache=v_s,
+                                 window=window)
             return outs[0], outs[1:]
 
         x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
@@ -218,7 +235,8 @@ def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens):
     def block(carry, inputs):
         layer, k_cache, v_cache = inputs
         x = carry
-        x, k_all, v_all = _decode_block(cfg, x, layer, k_cache, v_cache, pos)
+        x, k_all, v_all = _decode_block(cfg, x, layer, k_cache, v_cache,
+                                        pos, window=window)
         return x, (k_all, v_all)
 
     x, (k_new, v_new) = jax.lax.scan(
@@ -226,21 +244,28 @@ def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens):
     return head_logits(params, x), {"k": k_new, "v": v_new}
 
 
-def _token_logits(cfg: ModelConfig, params, cache, pos, token):
+def _token_logits(cfg: ModelConfig, params, cache, pos, token,
+                  window: int | None = None):
     """One decode step: [B] token ids at position ``pos`` (scalar or [B])
     → ([B, vocab] logits, updated cache)."""
-    logits, cache = _chunk_logits(cfg, params, cache, pos, token[:, None])
+    logits, cache = _chunk_logits(cfg, params, cache, pos, token[:, None],
+                                  window=window)
     return logits[:, 0], cache
 
 
 def _prefill_trunk(cfg: ModelConfig, params, cache, prompt,
-                   attn_impl: str = "dense"):
+                   attn_impl: str = "dense", window: int | None = None):
     """Shared prefill: run [B, S] through the training trunk, fill the
     cache for positions [0, S), return (cache, trunk activations [B,S,D]).
 
     The trunk recomputes activations layer by layer for the k/v projections
     — two passes over the prompt total, both batched MXU work (the flash
     path applies for long prompts via ``attn_impl="flash"``).
+
+    With a sliding ``window``, the last ``min(S, W)`` prompt positions
+    land in their ring slots (pos mod W).  Prefill attention itself stays
+    full-causal over the prompt — the window governs decode; callers who
+    need strict window semantics during prefill cap the prompt at W.
     """
     from tpu_dra.workloads.train import _ATTN_IMPLS, _block
 
@@ -256,33 +281,41 @@ def _prefill_trunk(cfg: ModelConfig, params, cache, prompt,
         return _block(cfg, carry, layer, attn_fn), (k, v)
 
     x, (ks, vs) = jax.lax.scan(block, x, params["blocks"])
+    if window is not None:
+        # ring layout: the last min(S, W) positions land in their slots
+        keep = min(S, window)
+        slots = jnp.arange(S - keep, S, dtype=jnp.int32) % window
+        ks, vs = ks[:, :, :, S - keep:], vs[:, :, :, S - keep:]
+    else:
+        slots = None                       # contiguous write at 0
+
+    def write(buf, new):
+        if slots is None:
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (0, 0, 0, 0, 0))
+        return buf.at[:, :, :, slots].set(new.astype(buf.dtype))
+
     if "k_s" in cache:
         from tpu_dra.workloads.quant import quantize_kv
         ks_q, ks_s = quantize_kv(ks)                # [L, B, Hkv, S, Dh/1]
         vs_q, vs_s = quantize_kv(vs)
-        cache = {
-            "k": jax.lax.dynamic_update_slice(
-                cache["k"], ks_q, (0, 0, 0, 0, 0)),
-            "v": jax.lax.dynamic_update_slice(
-                cache["v"], vs_q, (0, 0, 0, 0, 0)),
-            "k_s": jax.lax.dynamic_update_slice(
-                cache["k_s"], ks_s, (0, 0, 0, 0, 0)),
-            "v_s": jax.lax.dynamic_update_slice(
-                cache["v_s"], vs_s, (0, 0, 0, 0, 0)),
-        }
-        return cache, x
-    cache = {
-        "k": jax.lax.dynamic_update_slice(
-            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
-        "v": jax.lax.dynamic_update_slice(
-            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
-    }
-    return cache, x
+        return {
+            "k": write(cache["k"], ks_q),
+            "v": write(cache["v"], vs_q),
+            "k_s": write(cache["k_s"], ks_s),
+            "v_s": write(cache["v_s"], vs_s),
+        }, x
+    return {
+        "k": write(cache["k"], ks),
+        "v": write(cache["v"], vs),
+    }, x
 
 
-def prefill(cfg: ModelConfig, params, cache, prompt, attn_impl: str = "dense"):
+def prefill(cfg: ModelConfig, params, cache, prompt,
+            attn_impl: str = "dense", window: int | None = None):
     """Prefill for equal-length prompts: (cache, last-token logits)."""
-    cache, x = _prefill_trunk(cfg, params, cache, prompt, attn_impl)
+    cache, x = _prefill_trunk(cfg, params, cache, prompt, attn_impl,
+                              window=window)
     return cache, head_logits(params, x[:, -1:])[:, 0]
 
 
@@ -317,7 +350,8 @@ def _select_token(logits, key, temperature: float, top_k: int):
 def decode(cfg: ModelConfig, params, prompt, *, steps: int,
            lengths=None, max_len: int | None = None,
            attn_impl: str = "dense", temperature: float = 0.0,
-           top_k: int = 0, rng=None, cache_dtype: str = "bf16"):
+           top_k: int = 0, rng=None, cache_dtype: str = "bf16",
+           window: int | None = None):
     """Decode ``steps`` tokens after a [B, S] prompt — greedy by default,
     temperature/top-k sampling when ``temperature > 0``.
 
@@ -327,10 +361,34 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
     ``decode_ragged``.  Returns [B, steps] int32 tokens.  One jittable
     function: prefill + ``lax.scan`` over decode steps (jit at the call
     site — ``make_decoder`` below does).
+
+    ``window``: sliding-window attention over a ring-buffer cache of that
+    many slots — generation length becomes unbounded (HBM is O(window),
+    each token attends its last ``window`` predecessors).  Incremental
+    SWA semantics (Mistral-style): an old token's cached k/v were
+    computed under ITS own window, so information propagates up to
+    window·n_layers positions even though each step attends only
+    ``window``.  Rope only
+    (positions are absolute in the rotation, relative in attention — a
+    learned table cannot express unbounded positions), full batches only
+    (ragged pads could alias live ring slots).
     """
     B, S = prompt.shape
-    max_len = max_len or cfg.max_seq
-    assert S + steps <= max_len, (S, steps, max_len)
+    if window is not None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if cfg.pos_emb != "rope":
+            raise ValueError("sliding-window decode needs pos_emb='rope' "
+                             "(learned tables cannot express unbounded "
+                             "positions)")
+        if lengths is not None:
+            raise ValueError("sliding-window decode does not support "
+                             "ragged batches (pad slots could alias live "
+                             "ring slots)")
+        max_len = window
+    else:
+        max_len = max_len or cfg.max_seq
+        assert S + steps <= max_len, (S, steps, max_len)
     if cfg.pos_emb == "learned" and S + steps > cfg.max_seq:
         # the learned pos table has cfg.max_seq rows; gathering past it
         # would silently clamp to the last row instead of failing
@@ -351,7 +409,8 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
             else jnp.zeros((steps + 1, 2), jnp.uint32))
     cache = init_kv_cache(cfg, B, max_len, cache_dtype)
     if lengths is None:
-        cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
+        cache, logits = prefill(cfg, params, cache, prompt, attn_impl,
+                                window=window)
     else:
         cache, logits = prefill_ragged(cfg, params, cache, prompt, lengths,
                                        attn_impl)
@@ -361,7 +420,8 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
         i, key = inputs
         cache, token = carry
         pos = S + i if lengths is None else lengths + i
-        logits, cache = _token_logits(cfg, params, cache, pos, token)
+        logits, cache = _token_logits(cfg, params, cache, pos, token,
+                                      window=window)
         nxt = _select_token(logits, key, temperature, top_k)
         return (cache, nxt), token
 
@@ -375,10 +435,11 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
 
 def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
                   max_len: int | None = None, attn_impl: str = "dense",
-                  cache_dtype: str = "bf16"):
+                  cache_dtype: str = "bf16", window: int | None = None):
     """Greedy-decode ``steps`` tokens after a [B, S] prompt."""
     return decode(cfg, params, prompt, steps=steps, max_len=max_len,
-                  attn_impl=attn_impl, cache_dtype=cache_dtype)
+                  attn_impl=attn_impl, cache_dtype=cache_dtype,
+                  window=window)
 
 
 def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
@@ -528,13 +589,14 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
 
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
                  attn_impl: str = "dense", temperature: float = 0.0,
-                 top_k: int = 0, cache_dtype: str = "bf16"):
+                 top_k: int = 0, cache_dtype: str = "bf16",
+                 window: int | None = None):
     """jit-compiled ``(params, prompt [B, S][, rng]) -> tokens [B, steps]``."""
     if temperature == 0.0:
         return jax.jit(partial(greedy_decode, cfg, steps=steps,
                                max_len=max_len, attn_impl=attn_impl,
-                               cache_dtype=cache_dtype))
+                               cache_dtype=cache_dtype, window=window))
     return jax.jit(lambda params, prompt, rng: decode(
         cfg, params, prompt, steps=steps, max_len=max_len,
         attn_impl=attn_impl, temperature=temperature, top_k=top_k, rng=rng,
-        cache_dtype=cache_dtype))
+        cache_dtype=cache_dtype, window=window))
