@@ -290,7 +290,7 @@ def section_decode() -> dict:
     from tpu_dra.workloads.quant import cast_params_bf16, quantize_params_int8
 
     def measure(cfg, quant=cast_params_bf16, cache_dtype="bf16",
-                B=B, S=S, steps=steps):
+                B=B, S=S, steps=steps, window=None):
         # decode is weight-HBM-bound: serving never reads the fp32
         # training checkpoint directly — bf16 cast is the baseline
         # (halves weight traffic), int8 quarters it (quant.py)
@@ -299,8 +299,9 @@ def section_decode() -> dict:
                                     cfg.vocab, dtype=jnp.int32)
         # cache sized to the live sequence, not max_seq: decode reads the
         # whole cache every step, so slack slots are pure HBM waste
-        dec = make_decoder(cfg, steps=steps, max_len=S + steps,
-                           cache_dtype=cache_dtype)
+        dec = make_decoder(cfg, steps=steps,
+                           max_len=None if window else S + steps,
+                           cache_dtype=cache_dtype, window=window)
         toks = dec(params, prompt)
         _ = int(toks[0, -1])                  # compile + warm, host readback
         best = float("inf")
@@ -356,6 +357,17 @@ def section_decode() -> dict:
             B * steps / long_int8, 1)
         out["decode_long_full_int8_ms_per_token"] = round(
             long_int8 / steps * 1e3, 3)
+        # sliding-window decode over the same long prompt: the ring
+        # buffer caps the cache read at W=256 slots regardless of
+        # generation length (requires rope; decode.py window docs)
+        rope_cfg = dataclasses.replace(cfg, pos_emb="rope", max_seq=SL)
+        win = measure(rope_cfg, quant=quantize_params_int8,
+                      cache_dtype="int8", B=B, S=SL, steps=steps,
+                      window=256)
+        out["decode_long_window256_int8_tokens_per_s"] = round(
+            B * steps / win, 1)
+        out["decode_long_window256_int8_ms_per_token"] = round(
+            win / steps * 1e3, 3)
     return out
 
 

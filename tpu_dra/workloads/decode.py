@@ -385,6 +385,10 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
             raise ValueError("sliding-window decode does not support "
                              "ragged batches (pad slots could alias live "
                              "ring slots)")
+        if max_len is not None and max_len != window:
+            raise ValueError(
+                f"window={window} fixes the cache at window slots; "
+                f"drop max_len (got {max_len}) or make them equal")
         max_len = window
     else:
         max_len = max_len or cfg.max_seq
