@@ -200,3 +200,51 @@ def test_decode_respects_max_len(small):
     prompt = jnp.zeros((1, 30), jnp.int32)
     with pytest.raises(AssertionError):
         greedy_decode(cfg, params, prompt, steps=8)  # 38 > max_seq 32
+
+
+def test_top_p_sampling(small):
+    """Nucleus sampling: top_p=tiny degenerates to greedy (only the top
+    token survives the mass cutoff); moderate top_p samples valid ids and
+    composes with top_k."""
+    from tpu_dra.workloads.decode import decode
+    cfg, params = small
+    B, S, steps = 2, 6, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(20), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    greedy = decode(cfg, params, prompt, steps=steps)
+    tiny = decode(cfg, params, prompt, steps=steps, temperature=1.0,
+                  top_p=1e-6, rng=jax.random.PRNGKey(0))
+    assert bool(jnp.all(tiny == greedy)), (tiny, greedy)
+    sampled = decode(cfg, params, prompt, steps=steps, temperature=1.0,
+                     top_p=0.9, top_k=16, rng=jax.random.PRNGKey(1))
+    assert sampled.shape == (B, steps)
+    assert int(jnp.min(sampled)) >= 0 and int(jnp.max(sampled)) < cfg.vocab
+
+
+def test_top_p_respects_nucleus():
+    """Direct check on _select_token: with a known distribution, tokens
+    outside the nucleus are never drawn."""
+    from tpu_dra.workloads.decode import _select_token
+    # p = [0.5, 0.3, 0.15, 0.05]: top_p=0.75 keeps exactly {0, 1}
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+    draws = set()
+    for i in range(64):
+        tok = _select_token(logits, jax.random.PRNGKey(i), 1.0, 0,
+                            top_p=0.75)
+        draws.add(int(tok[0]))
+    assert draws <= {0, 1}, draws
+    assert len(draws) == 2, draws
+
+
+def test_top_p_tie_at_cutoff_rank_based():
+    """Tokens tied in logit with the last nucleus member but ranked
+    outside it must NOT be drawn (rank-based mask, not value threshold):
+    p = [0.4, 0.3, 0.3], top_p=0.7 keeps exactly two tokens."""
+    from tpu_dra.workloads.decode import _select_token
+    logits = jnp.log(jnp.array([[0.4, 0.3, 0.3]], jnp.float32))
+    draws = set()
+    for i in range(96):
+        tok = _select_token(logits, jax.random.PRNGKey(i), 1.0, 0,
+                            top_p=0.7)
+        draws.add(int(tok[0]))
+    assert len(draws) == 2 and 0 in draws, draws

@@ -335,23 +335,45 @@ def prefill_ragged(cfg: ModelConfig, params, cache, prompts, lengths,
     return cache, head_logits(params, last)[:, 0]
 
 
-def _select_token(logits, key, temperature: float, top_k: int):
-    """Greedy (temperature == 0) or temperature/top-k sampling.  Static
-    branch: the sampling mode is fixed at trace time."""
+def _select_token(logits, key, temperature: float, top_k: int,
+                  top_p: float = 0.0):
+    """Greedy (temperature == 0) or temperature/top-k/top-p sampling.
+    Static branch: the sampling mode is fixed at trace time.
+
+    ``top_p`` (nucleus): keep the smallest prefix of the
+    probability-sorted vocab whose mass reaches top_p (the top token
+    always survives).  Composes with top_k (both filters apply)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_k:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, jnp.finfo(logits.dtype).min, logits)
+    if top_k or top_p > 0.0:
+        # ONE descending argsort serves both filters, and masking by RANK
+        # (not by a logit-value threshold) keeps exactly the contract
+        # sets even when logits tie at the cutoff
+        order = jnp.argsort(-logits, axis=-1)                    # [B, V]
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        V = logits.shape[-1]
+        keep_sorted = jnp.ones_like(sorted_logits, dtype=bool)
+        if top_k:
+            keep_sorted &= jnp.arange(V)[None, :] < top_k
+        if top_p > 0.0:
+            # nucleus: smallest prefix whose mass reaches top_p (the top
+            # token's mass_before is 0 < top_p, so it always survives)
+            probs = jax.nn.softmax(sorted_logits.astype(jnp.float32),
+                                   axis=-1)
+            mass_before = jnp.cumsum(probs, axis=-1) - probs
+            keep_sorted &= mass_before < top_p
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def decode(cfg: ModelConfig, params, prompt, *, steps: int,
            lengths=None, max_len: int | None = None,
            attn_impl: str = "dense", temperature: float = 0.0,
-           top_k: int = 0, rng=None, cache_dtype: str = "bf16",
-           window: int | None = None):
+           top_k: int = 0, top_p: float = 0.0, rng=None,
+           cache_dtype: str = "bf16", window: int | None = None):
     """Decode ``steps`` tokens after a [B, S] prompt — greedy by default,
     temperature/top-k sampling when ``temperature > 0``.
 
@@ -418,7 +440,7 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
     else:
         cache, logits = prefill_ragged(cfg, params, cache, prompt, lengths,
                                        attn_impl)
-    first = _select_token(logits, keys[0], temperature, top_k)
+    first = _select_token(logits, keys[0], temperature, top_k, top_p)
 
     def step(carry, inputs):
         i, key = inputs
@@ -426,7 +448,7 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
         pos = S + i if lengths is None else lengths + i
         logits, cache = _token_logits(cfg, params, cache, pos, token,
                                       window=window)
-        nxt = _select_token(logits, key, temperature, top_k)
+        nxt = _select_token(logits, key, temperature, top_k, top_p)
         return (cache, nxt), token
 
     # ys stacks each step's *input* token: t0 (from prefill), t1, …,
@@ -448,7 +470,8 @@ def greedy_decode(cfg: ModelConfig, params, prompt, *, steps: int,
 
 def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
                   max_len: int | None = None, attn_impl: str = "dense",
-                  temperature: float = 0.0, top_k: int = 0, rng=None,
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 0.0, rng=None,
                   cache_dtype: str = "bf16"):
     """Batched decode over right-padded prompts of different lengths —
     continuous-batching-lite: one compiled program serves a mixed batch,
@@ -461,8 +484,8 @@ def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
     """
     return decode(cfg, params, prompts, steps=steps, lengths=lengths,
                   max_len=max_len, attn_impl=attn_impl,
-                  temperature=temperature, top_k=top_k, rng=rng,
-                  cache_dtype=cache_dtype)
+                  temperature=temperature, top_k=top_k, top_p=top_p,
+                  rng=rng, cache_dtype=cache_dtype)
 
 
 def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
@@ -593,7 +616,8 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
 
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
                  attn_impl: str = "dense", temperature: float = 0.0,
-                 top_k: int = 0, cache_dtype: str = "bf16",
+                 top_k: int = 0, top_p: float = 0.0,
+                 cache_dtype: str = "bf16",
                  window: int | None = None):
     """jit-compiled ``(params, prompt [B, S][, rng]) -> tokens [B, steps]``."""
     if temperature == 0.0:
@@ -602,5 +626,5 @@ def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
                                cache_dtype=cache_dtype, window=window))
     return jax.jit(lambda params, prompt, rng: decode(
         cfg, params, prompt, steps=steps, max_len=max_len,
-        attn_impl=attn_impl, temperature=temperature, top_k=top_k, rng=rng,
-        cache_dtype=cache_dtype, window=window))
+        attn_impl=attn_impl, temperature=temperature, top_k=top_k,
+        top_p=top_p, rng=rng, cache_dtype=cache_dtype, window=window))

@@ -7,8 +7,8 @@ exercise CUDA samples locally.  stdlib-only (ThreadingHTTPServer), one
 compiled decoder per (batch, prompt-length, steps) bucket — requests are
 padded into the bucket so repeat traffic never recompiles.
 
-POST /generate  {"tokens": [[...]], "steps": N,
-                 "temperature": 0.0, "top_k": 0, "seed": 0}
+POST /generate  {"tokens": [[...]], "steps": N, "temperature": 0.0,
+                 "top_k": 0, "top_p": 0.0, "seed": 0}
              → {"tokens": [[...]]}           (the N generated ids per row)
 GET  /healthz → "ok"
 """
@@ -52,13 +52,15 @@ class DecoderPool:
 
     def generate(self, rows: list[list[int]], steps: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0) -> list[list[int]]:
+                 top_p: float = 0.0, seed: int = 0) -> list[list[int]]:
         cfg = self.cfg
         if not rows or not all(rows):
             raise ValueError("tokens must be a non-empty list of non-empty "
                              "rows")
         if any(t < 0 or t >= cfg.vocab for r in rows for t in r):
             raise ValueError(f"token ids must be in [0, {cfg.vocab})")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
         B = _bucket(len(rows))
         S = _bucket(max(len(r) for r in rows))
         if S + steps > cfg.max_seq:
@@ -71,13 +73,13 @@ class DecoderPool:
             prompts = prompts.at[i, : len(r)].set(jnp.asarray(r, jnp.int32))
             lengths.append(len(r))
         lengths += [1] * (B - len(rows))          # dummy rows decode too
-        key = (B, S, steps, float(temperature), int(top_k))
+        key = (B, S, steps, float(temperature), int(top_k), float(top_p))
         with self._lock:
             fn = self._fns.get(key)
             if fn is None:
                 fn = jax.jit(partial(
                     decode, self.cfg, steps=steps,
-                    temperature=temperature, top_k=top_k,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
                     cache_dtype=self.cache_dtype))
                 self._fns[key] = fn
         toks = fn(self.params, prompts,
@@ -159,7 +161,9 @@ def make_handler(pool: DecoderPool):
                 out = pool.generate(
                     req["tokens"], int(req.get("steps", 16)),
                     float(req.get("temperature", 0.0)),
-                    int(req.get("top_k", 0)), int(req.get("seed", 0)))
+                    int(req.get("top_k", 0)),
+                    float(req.get("top_p", 0.0)),
+                    int(req.get("seed", 0)))
                 self._send(200, json.dumps({"tokens": out}).encode())
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as exc:
