@@ -248,3 +248,65 @@ def test_top_p_tie_at_cutoff_rank_based():
                             top_p=0.7)
         draws.add(int(tok[0]))
     assert len(draws) == 2 and 0 in draws, draws
+
+
+def test_eos_freezes_sequence(small):
+    """Once a row emits eos_id every later slot holds eos_id, and rows
+    that never emit it decode exactly as without the option."""
+    from tpu_dra.workloads.decode import decode
+    cfg, params = small
+    B, S, steps = 2, 6, 8
+    prompt = jax.random.randint(jax.random.PRNGKey(30), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    ref = decode(cfg, params, prompt, steps=steps)
+    eos = int(ref[0, 3])       # force an eos hit mid-stream for row 0
+    got = decode(cfg, params, prompt, steps=steps, eos_id=eos)
+    g = list(map(int, got[0]))
+    if eos in g:
+        first = g.index(eos)
+        assert all(t == eos for t in g[first:]), g
+    # pre-eos tokens match the unconstrained decode (greedy determinism)
+    pre = g[: g.index(eos)] if eos in g else g
+    assert pre == list(map(int, ref[0, : len(pre)]))
+    # a row that never hits eos must decode exactly as without the option
+    if eos not in list(map(int, ref[1])):
+        assert list(map(int, got[1])) == list(map(int, ref[1]))
+
+
+def test_repetition_penalty_blocks_repeats(small):
+    """A huge penalty under greedy decoding makes every generated token
+    distinct (and distinct from the prompt) until vocab runs out."""
+    from tpu_dra.workloads.decode import decode
+    cfg, params = small
+    B, S, steps = 1, 4, 10
+    prompt = jax.random.randint(jax.random.PRNGKey(31), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    got = decode(cfg, params, prompt, steps=steps,
+                 repetition_penalty=1e9)
+    toks = list(map(int, got[0]))
+    assert len(set(toks)) == steps, toks
+    assert not (set(toks) & set(map(int, prompt[0]))), (toks, prompt)
+
+
+def test_eos_penalty_ragged_batch(small):
+    """EOS + repetition penalty through the ragged path: the pad scatter
+    must drop (not clip to the last vocab column), and per-row freezing
+    stays per-row."""
+    from tpu_dra.workloads.decode import decode, decode_ragged
+    cfg, params = small
+    B, S, steps = 2, 6, 8
+    prompts = jax.random.randint(jax.random.PRNGKey(32), (B, S), 0,
+                                 cfg.vocab, dtype=jnp.int32)
+    lengths = jnp.array([4, 6], jnp.int32)
+    ref = decode_ragged(cfg, params, prompts, lengths, steps=steps)
+    # a clip-instead-of-drop pad scatter would penalize token vocab-1
+    # for row 0 (it has pads); with penalty active but huge=False the
+    # outputs should still be well-formed and row-independent
+    got = decode_ragged(cfg, params, prompts, lengths, steps=steps,
+                        eos_id=int(ref[0, 3]), repetition_penalty=1.2)
+    assert got.shape == (B, steps)
+    assert int(jnp.min(got)) >= 0 and int(jnp.max(got)) < cfg.vocab
+    eos = int(ref[0, 3])
+    g0 = list(map(int, got[0]))
+    if eos in g0:
+        assert all(t == eos for t in g0[g0.index(eos):]), g0

@@ -373,7 +373,8 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
            lengths=None, max_len: int | None = None,
            attn_impl: str = "dense", temperature: float = 0.0,
            top_k: int = 0, top_p: float = 0.0, rng=None,
-           cache_dtype: str = "bf16", window: int | None = None):
+           cache_dtype: str = "bf16", window: int | None = None,
+           eos_id: int | None = None, repetition_penalty: float = 1.0):
     """Decode ``steps`` tokens after a [B, S] prompt — greedy by default,
     temperature/top-k sampling when ``temperature > 0``.
 
@@ -394,8 +395,20 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
     (positions are absolute in the rotation, relative in attention — a
     learned table cannot express unbounded positions), full batches only
     (ragged pads could alias live ring slots).
+
+    ``eos_id``: sequences freeze once they emit it — every subsequent
+    output slot holds eos_id (the scan stays static-shape; finished
+    rows just stop changing).  ``repetition_penalty`` > 1 applies
+    CTRL-style score shaping to every token already seen (prompt
+    included): positive logits divide by the penalty, negative multiply.
     """
     B, S = prompt.shape
+    if repetition_penalty <= 0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty} "
+            f"(a negative value would BOOST seen tokens)")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(f"eos_id {eos_id} outside [0, {cfg.vocab})")
     if window is not None:
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
@@ -440,21 +453,55 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
     else:
         cache, logits = prefill_ragged(cfg, params, cache, prompt, lengths,
                                        attn_impl)
+    penalize = repetition_penalty != 1.0
+    if penalize:
+        # [B, vocab] presence mask of every token seen so far; prompt
+        # tokens count (ragged: only real rows, not pads)
+        seen = jnp.zeros((B, cfg.vocab), bool)
+        if lengths is None:
+            seen = seen.at[jnp.arange(B)[:, None], prompt].set(True)
+        else:
+            # pads scatter to column `vocab` (out of bounds → dropped),
+            # so they can never race a real token's True write
+            real = jnp.arange(S)[None, :] < lengths[:, None]
+            cols = jnp.where(real, prompt, cfg.vocab)
+            seen = seen.at[jnp.arange(B)[:, None], cols].set(
+                True, mode="drop")
+
+    def shape_logits(logits, seen):
+        if not penalize:
+            return logits
+        pen = jnp.where(logits > 0, logits / repetition_penalty,
+                        logits * repetition_penalty)
+        return jnp.where(seen, pen, logits)
+
+    if penalize:
+        logits = shape_logits(logits, seen)
     first = _select_token(logits, keys[0], temperature, top_k, top_p)
+    done0 = (jnp.zeros((B,), bool) if eos_id is None
+             else first == eos_id)
 
     def step(carry, inputs):
         i, key = inputs
-        cache, token = carry
+        cache, token, done, seen = carry
         pos = S + i if lengths is None else lengths + i
         logits, cache = _token_logits(cfg, params, cache, pos, token,
                                       window=window)
+        logits = shape_logits(logits, seen)
         nxt = _select_token(logits, key, temperature, top_k, top_p)
-        return (cache, nxt), token
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        if penalize:
+            seen = seen.at[jnp.arange(B), nxt].set(True)
+        return (cache, nxt, done, seen), token
 
+    seen0 = (seen.at[jnp.arange(B), first].set(True) if penalize
+             else jnp.zeros((B, 1), bool))       # dummy when unused
     # ys stacks each step's *input* token: t0 (from prefill), t1, …,
     # t_{steps-1} — exactly the ``steps`` generated tokens in order.
     _, toks = jax.lax.scan(
-        step, (cache, first),
+        step, (cache, first, done0, seen0),
         (jnp.arange(steps, dtype=jnp.int32), keys[1:]))
     return toks.T
 
@@ -472,7 +519,8 @@ def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
                   max_len: int | None = None, attn_impl: str = "dense",
                   temperature: float = 0.0, top_k: int = 0,
                   top_p: float = 0.0, rng=None,
-                  cache_dtype: str = "bf16"):
+                  cache_dtype: str = "bf16", eos_id: int | None = None,
+                  repetition_penalty: float = 1.0):
     """Batched decode over right-padded prompts of different lengths —
     continuous-batching-lite: one compiled program serves a mixed batch,
     every sequence advancing from its own position (scatter cache writes,
@@ -485,7 +533,8 @@ def decode_ragged(cfg: ModelConfig, params, prompts, lengths, *, steps: int,
     return decode(cfg, params, prompts, steps=steps, lengths=lengths,
                   max_len=max_len, attn_impl=attn_impl,
                   temperature=temperature, top_k=top_k, top_p=top_p,
-                  rng=rng, cache_dtype=cache_dtype)
+                  rng=rng, cache_dtype=cache_dtype, eos_id=eos_id,
+                  repetition_penalty=repetition_penalty)
 
 
 def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,

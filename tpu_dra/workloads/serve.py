@@ -8,7 +8,8 @@ compiled decoder per (batch, prompt-length, steps) bucket — requests are
 padded into the bucket so repeat traffic never recompiles.
 
 POST /generate  {"tokens": [[...]], "steps": N, "temperature": 0.0,
-                 "top_k": 0, "top_p": 0.0, "seed": 0}
+                 "top_k": 0, "top_p": 0.0, "seed": 0,
+                 "eos_id": null, "repetition_penalty": 1.0}
              → {"tokens": [[...]]}           (the N generated ids per row)
 GET  /healthz → "ok"
 """
@@ -36,8 +37,9 @@ def _bucket(n: int, buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)) -> int:
 
 class DecoderPool:
     """Compiled-decoder cache keyed by (batch, S_pad, steps, temperature,
-    top_k) buckets; thread-safe (requests may arrive concurrently, JAX
-    dispatch is already serialized internally)."""
+    top_k, top_p, eos_id, repetition_penalty) buckets; thread-safe
+    (requests may arrive concurrently, JAX dispatch is already
+    serialized internally)."""
 
     def __init__(self, cfg: ModelConfig, params,
                  cache_dtype: str = "bf16"):
@@ -52,7 +54,9 @@ class DecoderPool:
 
     def generate(self, rows: list[list[int]], steps: int,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0, seed: int = 0) -> list[list[int]]:
+                 top_p: float = 0.0, seed: int = 0,
+                 eos_id: int | None = None,
+                 repetition_penalty: float = 1.0) -> list[list[int]]:
         cfg = self.cfg
         if not rows or not all(rows):
             raise ValueError("tokens must be a non-empty list of non-empty "
@@ -73,14 +77,20 @@ class DecoderPool:
             prompts = prompts.at[i, : len(r)].set(jnp.asarray(r, jnp.int32))
             lengths.append(len(r))
         lengths += [1] * (B - len(rows))          # dummy rows decode too
-        key = (B, S, steps, float(temperature), int(top_k), float(top_p))
+        if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+            raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
+        if repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
+        key = (B, S, steps, float(temperature), int(top_k), float(top_p),
+               eos_id, float(repetition_penalty))
         with self._lock:
             fn = self._fns.get(key)
             if fn is None:
                 fn = jax.jit(partial(
                     decode, self.cfg, steps=steps,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    cache_dtype=self.cache_dtype))
+                    cache_dtype=self.cache_dtype, eos_id=eos_id,
+                    repetition_penalty=repetition_penalty))
                 self._fns[key] = fn
         toks = fn(self.params, prompts,
                   lengths=jnp.asarray(lengths, jnp.int32),
@@ -158,12 +168,16 @@ def make_handler(pool: DecoderPool):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
+                eos = req.get("eos_id")
                 out = pool.generate(
                     req["tokens"], int(req.get("steps", 16)),
                     float(req.get("temperature", 0.0)),
                     int(req.get("top_k", 0)),
                     float(req.get("top_p", 0.0)),
-                    int(req.get("seed", 0)))
+                    int(req.get("seed", 0)),
+                    eos_id=None if eos is None else int(eos),
+                    repetition_penalty=float(
+                        req.get("repetition_penalty", 1.0)))
                 self._send(200, json.dumps({"tokens": out}).encode())
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as exc:
