@@ -1,0 +1,112 @@
+"""Beam search (decode.beam_decode).
+
+Oracles: beams=1 must equal greedy decode; with beams == vocab and two
+steps, step one keeps EVERY first token, so the best 2-token sequence is
+guaranteed found — brute-force scoring over all vocab² continuations is
+an exact reference.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.workloads.decode import beam_decode, greedy_decode
+from tpu_dra.workloads.train import ModelConfig, forward, init_params
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = ModelConfig(vocab=8, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_beam1_equals_greedy(tiny):
+    cfg, params = tiny
+    B, S, steps = 2, 5, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    ref = greedy_decode(cfg, params, prompt, steps=steps)
+    hist, scores = beam_decode(cfg, params, prompt, steps=steps, beams=1)
+    assert hist.shape == (B, 1, steps) and scores.shape == (B, 1)
+    np.testing.assert_array_equal(np.asarray(hist[:, 0]), np.asarray(ref))
+
+
+def test_full_width_beam_finds_optimum(tiny):
+    """beams == vocab, steps == 2: every first token survives step one,
+    so the true argmax 2-token continuation MUST be beam 0.  The oracle
+    scores all vocab² continuations with the plain forward."""
+    cfg, params = tiny
+    B, S = 1, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    hist, scores = beam_decode(cfg, params, prompt, steps=2,
+                               beams=cfg.vocab)
+
+    best, best_score = None, -np.inf
+    for t0, t1 in itertools.product(range(cfg.vocab), repeat=2):
+        seq = jnp.concatenate(
+            [prompt, jnp.array([[t0, t1]], jnp.int32)], axis=1)
+        logits = forward(cfg, params, seq)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        sc = float(logp[0, S - 1, t0] + logp[0, S, t1])
+        if sc > best_score:
+            best, best_score = (t0, t1), sc
+    assert tuple(map(int, hist[0, 0])) == best, (hist[0, 0], best)
+    assert abs(float(scores[0, 0]) - best_score) < 5e-2, (
+        float(scores[0, 0]), best_score)
+
+
+def test_beam_scores_sorted_desc(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    _, scores = beam_decode(cfg, params, prompt, steps=4, beams=4)
+    sc = np.asarray(scores)
+    assert (np.diff(sc, axis=-1) <= 1e-6).all(), sc
+
+
+def test_beam_eos_freezes_and_pads(tiny):
+    cfg, params = tiny
+    B, S, steps = 1, 4, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    ref, _ = beam_decode(cfg, params, prompt, steps=steps, beams=3)
+    eos = int(ref[0, 0, 2])
+    hist, scores = beam_decode(cfg, params, prompt, steps=steps, beams=3,
+                               eos_id=eos)
+    hit = 0
+    for w in range(3):
+        toks = list(map(int, hist[0, w]))
+        if eos in toks:
+            hit += 1
+            first = toks.index(eos)
+            assert all(t == eos for t in toks[first:]), toks
+    # the eos id came from the best unconstrained beam's own step-2
+    # token, so at least one eos-enabled beam must actually hit it —
+    # otherwise this test is vacuous
+    assert hit > 0, np.asarray(hist)
+
+
+def test_beam_int8_cache(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 4), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    hist, scores = beam_decode(cfg, params, prompt, steps=4, beams=2,
+                               cache_dtype="int8")
+    assert hist.shape == (2, 2, 4)
+    assert bool(jnp.all(jnp.isfinite(scores)))
+
+
+def test_beam_guards(tiny):
+    cfg, params = tiny
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="beams"):
+        beam_decode(cfg, params, prompt, steps=2, beams=cfg.vocab + 1)
+    with pytest.raises(ValueError, match="eos_id"):
+        beam_decode(cfg, params, prompt, steps=2, beams=2,
+                    eos_id=cfg.vocab)

@@ -663,6 +663,99 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
     return out[:, :steps]
 
 
+def beam_decode(cfg: ModelConfig, params, prompt, *, steps: int,
+                beams: int = 4, max_len: int | None = None,
+                attn_impl: str = "dense", cache_dtype: str = "bf16",
+                eos_id: int | None = None, length_penalty: float = 0.0):
+    """Beam search: ([B, beams, steps] tokens, [B, beams] scores),
+    beams sorted best-first per batch row.
+
+    TPU-first shape discipline: the ``beams`` axis folds into the batch
+    (cache [L, B·W, ...]), every step is one cached forward over all
+    B·W hypotheses, and the beam reorder is a gather along the
+    batch-beam axis — O(cache) HBM per step, the price of exact
+    hypothesis tracking (documented; use sampling modes when that
+    matters).  Scores are sum of token logprobs; ``length_penalty`` α
+    applies GNMT-style normalization ``score / ((5+len)/6)^α`` to
+    FINISHED (eos) hypotheses so shorter completions compare fairly.
+
+    With ``eos_id``, a finished beam propagates itself unchanged: its
+    only continuation is eos at logprob 0, so it keeps its score and
+    pads with eos.
+    """
+    B, S = prompt.shape
+    W = beams
+    if not 1 <= W <= cfg.vocab:
+        raise ValueError(f"beams must be in [1, vocab={cfg.vocab}], "
+                         f"got {W}")
+    max_len = max_len or cfg.max_seq
+    assert S + steps <= max_len, (S, steps, max_len)
+    if cfg.pos_emb == "learned" and S + steps > cfg.max_seq:
+        raise ValueError(
+            f"S + steps = {S + steps} exceeds the learned-position table "
+            f"(max_seq={cfg.max_seq}); grow max_seq or use rope")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(f"eos_id {eos_id} outside [0, {cfg.vocab})")
+    if length_penalty < 0:
+        raise ValueError(f"length_penalty must be >= 0, "
+                         f"got {length_penalty}")
+    if length_penalty > 0 and eos_id is None:
+        raise ValueError("length_penalty needs eos_id — without finished "
+                         "hypotheses there is no length to normalize")
+
+    # prefill once per row, then tile the cache across beams
+    cache = init_kv_cache(cfg, B, max_len, cache_dtype)
+    cache, logits = prefill(cfg, params, cache, prompt, attn_impl)
+    cache = {k: jnp.repeat(v, W, axis=1) for k, v in cache.items()}
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # seed: top-W first tokens per row
+    scores, tok0 = jax.lax.top_k(logp, W)              # [B, W]
+    token = tok0.reshape(B * W).astype(jnp.int32)
+    done0 = (token == eos_id) if eos_id is not None else         jnp.zeros((B * W,), bool)
+    hist0 = jnp.zeros((B, W, steps), jnp.int32).at[:, :, 0].set(tok0)
+    rows = jnp.arange(B)[:, None]                      # [B, 1]
+    neg = jnp.float32(-1e30)
+
+    def step(carry, i):
+        cache, token, scores, hist, done = carry
+        logits, cache = _token_logits(cfg, params, cache, S + i, token)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        if eos_id is not None:
+            # finished beams: only eos continues, at logprob 0
+            only_eos = jnp.full_like(logp, neg).at[:, eos_id].set(0.0)
+            logp = jnp.where(done[:, None], only_eos, logp)
+        total = scores.reshape(B * W, 1) + logp        # [B·W, V]
+        flat = total.reshape(B, W * cfg.vocab)
+        scores, idx = jax.lax.top_k(flat, W)           # [B, W]
+        parent = idx // cfg.vocab                      # [B, W] beam index
+        tok = (idx % cfg.vocab).astype(jnp.int32)
+        src = (rows * W + parent).reshape(B * W)       # flat parent rows
+        cache = {k: jnp.take(v, src, axis=1) for k, v in cache.items()}
+        hist = jnp.take_along_axis(
+            hist, parent[:, :, None], axis=1).at[:, :, i + 1].set(tok)
+        done = jnp.take(done, src)
+        if eos_id is not None:
+            done = done | (tok.reshape(B * W) == eos_id)
+        return (cache, tok.reshape(B * W), scores, hist, done), None
+
+    (cache, token, scores, hist, done), _ = jax.lax.scan(
+        step, (cache, token, scores.astype(jnp.float32), hist0, done0),
+        jnp.arange(steps - 1, dtype=jnp.int32))
+
+    if length_penalty > 0.0 and eos_id is not None:
+        # completed length = index of the first eos + 1 (or steps)
+        is_eos = (hist == eos_id)
+        first = jnp.argmax(is_eos, axis=-1)
+        length = jnp.where(is_eos.any(-1), first + 1, steps)
+        norm = ((5.0 + length.astype(jnp.float32)) / 6.0) ** length_penalty
+        scores = jnp.where(done.reshape(B, W), scores / norm, scores)
+        order = jnp.argsort(-scores, axis=-1)
+        scores = jnp.take_along_axis(scores, order, axis=-1)
+        hist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+    return hist, scores
+
+
 def make_decoder(cfg: ModelConfig, *, steps: int, max_len: int | None = None,
                  attn_impl: str = "dense", temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
