@@ -110,3 +110,50 @@ def test_beam_guards(tiny):
     with pytest.raises(ValueError, match="eos_id"):
         beam_decode(cfg, params, prompt, steps=2, beams=2,
                     eos_id=cfg.vocab)
+
+
+def test_beam_length_penalty_normalizes_finished(tiny):
+    """length_penalty>0 divides FINISHED beams' scores by the GNMT norm
+    and re-sorts; with a large alpha a short finished hypothesis's
+    normalized score must equal raw/((5+len)/6)^alpha exactly."""
+    cfg, params = tiny
+    B, S, steps = 1, 4, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    ref_hist, ref_scores = beam_decode(cfg, params, prompt, steps=steps,
+                                       beams=3, eos_id=int(
+                                           jax.random.randint(
+                                               jax.random.PRNGKey(7), (), 0,
+                                               cfg.vocab)))
+    eos = int(ref_hist[0, 0, 1])   # eos hit early for at least beam 0
+    raw_hist, raw_scores = beam_decode(cfg, params, prompt, steps=steps,
+                                       beams=3, eos_id=eos)
+    alpha = 2.0
+    norm_hist, norm_scores = beam_decode(cfg, params, prompt, steps=steps,
+                                         beams=3, eos_id=eos,
+                                         length_penalty=alpha)
+    # recompute the expected normalization from the raw run
+    expected = []
+    for w in range(3):
+        toks = list(map(int, raw_hist[0, w]))
+        sc = float(raw_scores[0, w])
+        if eos in toks:
+            ln = toks.index(eos) + 1
+            sc = sc / (((5.0 + ln) / 6.0) ** alpha)
+        expected.append((sc, toks))
+    expected.sort(key=lambda t: -t[0])
+    got = sorted(
+        [(float(norm_scores[0, w]), list(map(int, norm_hist[0, w])))
+         for w in range(3)], key=lambda t: -t[0])
+    for (es, et), (gs, gt) in zip(expected, got):
+        assert abs(es - gs) < 1e-4, (expected, got)
+    # and the returned order is the normalized order
+    ns = np.asarray(norm_scores[0])
+    assert (np.diff(ns) <= 1e-6).all(), ns
+
+
+def test_beam_guard_length_penalty_without_eos(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="length_penalty"):
+        beam_decode(cfg, params, jnp.zeros((1, 4), jnp.int32), steps=2,
+                    beams=2, length_penalty=0.5)

@@ -17,8 +17,9 @@ The TPU analogs here are first-class framework components
 - :mod:`tpu_dra.workloads.pipeline` / :mod:`tpu_dra.workloads.moe` —
   GPipe pipeline and switch-MoE expert parallelism.
 - :mod:`tpu_dra.workloads.decode` — static-shape KV-cache serving:
-  greedy/sampled, ragged mixed-length batches, GQA caches, speculative
-  decoding, bf16/int8 caches.
+  greedy / temperature / top-k / top-p / beam search, EOS stops and
+  repetition penalty, ragged mixed-length batches, GQA caches,
+  speculative decoding, bf16/int8 caches, sliding-window ring buffers.
 - :mod:`tpu_dra.workloads.quant` — serving quantization: bf16 cast,
   per-channel int8 weights + dynamic per-token activation scales on the
   native int8 MXU, int8 KV caches; the ``matmul_any`` dispatch point

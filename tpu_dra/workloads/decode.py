@@ -712,7 +712,8 @@ def beam_decode(cfg: ModelConfig, params, prompt, *, steps: int,
     # seed: top-W first tokens per row
     scores, tok0 = jax.lax.top_k(logp, W)              # [B, W]
     token = tok0.reshape(B * W).astype(jnp.int32)
-    done0 = (token == eos_id) if eos_id is not None else         jnp.zeros((B * W,), bool)
+    done0 = (jnp.zeros((B * W,), bool) if eos_id is None
+             else token == eos_id)
     hist0 = jnp.zeros((B, W, steps), jnp.int32).at[:, :, 0].set(tok0)
     rows = jnp.arange(B)[:, None]                      # [B, 1]
     neg = jnp.float32(-1e30)

@@ -188,10 +188,11 @@ def make_handler(pool: DecoderPool):
 
 
 def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
-          port: int = 8477) -> ThreadingHTTPServer:
+          port: int = 8477,
+          cache_dtype: str = "bf16") -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``)."""
-    pool = DecoderPool(cfg, params)
+    pool = DecoderPool(cfg, params, cache_dtype=cache_dtype)
     srv = ThreadingHTTPServer((host, port), make_handler(pool))
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -224,6 +225,13 @@ def main(argv=None):
     ap.add_argument("--d-ff", type=int, default=2048)
     ap.add_argument("--max-seq", type=int, default=512)
     ap.add_argument("--pos-emb", default="rope")
+    ap.add_argument("--weights", default="fp32",
+                    choices=("fp32", "bf16", "int8"),
+                    help="serving weight form (quant.py): fp32 serves "
+                         "the checkpoint unmodified; bf16 halves and "
+                         "int8 quarters the per-token weight read")
+    ap.add_argument("--cache-dtype", default="bf16",
+                    choices=("bf16", "int8"))
     args = ap.parse_args(argv)
 
     init_tpu_workload()
@@ -232,7 +240,13 @@ def main(argv=None):
                       n_layers=args.n_layers, d_ff=args.d_ff,
                       max_seq=args.max_seq, pos_emb=args.pos_emb)
     params = restore_train_state(args.checkpoint_dir)["params"]
-    srv = serve(cfg, params, host=args.host, port=args.port)
+    if args.weights != "fp32":
+        from tpu_dra.workloads.quant import (cast_params_bf16,
+                                             quantize_params_int8)
+        params = (quantize_params_int8(params) if args.weights == "int8"
+                  else cast_params_bf16(params))
+    srv = serve(cfg, params, host=args.host, port=args.port,
+                cache_dtype=args.cache_dtype)
     print(f"serving on {srv.server_address}", flush=True)
     try:
         threading.Event().wait()
