@@ -310,3 +310,70 @@ def test_eos_penalty_ragged_batch(small):
     g0 = list(map(int, got[0]))
     if eos in g0:
         assert all(t == eos for t in g0[g0.index(eos):]), g0
+
+
+def test_prefill_chunked_matches_prefill(small):
+    """Chunked prefill equals the one-shot prefill: same final logits,
+    same cache content (to bf16 reduction-order precision)."""
+    from tpu_dra.workloads.decode import (init_kv_cache, prefill,
+                                          prefill_chunked)
+    import numpy as np
+    cfg, params = small
+    B, S = 2, 16
+    prompt = jax.random.randint(jax.random.PRNGKey(40), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    c1 = init_kv_cache(cfg, B, cfg.max_seq)
+    c1, ref = prefill(cfg, params, c1, prompt)
+    c2 = init_kv_cache(cfg, B, cfg.max_seq)
+    c2, got = prefill_chunked(cfg, params, c2, prompt, chunk=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=5e-2)
+    for k in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(c2[k][:, :, :, :S], np.float32),
+            np.asarray(c1[k][:, :, :, :S], np.float32), atol=5e-2)
+    # decode continues identically from either cache
+    from tpu_dra.workloads.decode import _token_logits
+    l1, _ = _token_logits(cfg, params, c1, jnp.int32(S),
+                          jnp.zeros((B,), jnp.int32))
+    l2, _ = _token_logits(cfg, params, c2, jnp.int32(S),
+                          jnp.zeros((B,), jnp.int32))
+    a = np.asarray(l1, np.float32).ravel()
+    b = np.asarray(l2, np.float32).ravel()
+    assert float(np.corrcoef(a, b)[0, 1]) > 0.999
+
+
+def test_prefill_chunked_tail_chunk(small):
+    """Non-multiple prompt lengths run the remainder as a partial chunk."""
+    from tpu_dra.workloads.decode import (init_kv_cache, prefill,
+                                          prefill_chunked)
+    import numpy as np
+    cfg, params = small
+    B, S = 2, 13                      # 3 chunks of 4 + tail of 1
+    prompt = jax.random.randint(jax.random.PRNGKey(42), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    c1 = init_kv_cache(cfg, B, cfg.max_seq)
+    c1, ref = prefill(cfg, params, c1, prompt)
+    c2 = init_kv_cache(cfg, B, cfg.max_seq)
+    c2, got = prefill_chunked(cfg, params, c2, prompt, chunk=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=5e-2)
+
+
+def test_prefill_chunked_int8_cache(small):
+    """int8: chunked tracks the dense int8 prefill (within-chunk
+    quantization noise on top of reduction order — see docstring)."""
+    from tpu_dra.workloads.decode import (init_kv_cache, prefill,
+                                          prefill_chunked)
+    import numpy as np
+    cfg, params = small
+    prompt = jax.random.randint(jax.random.PRNGKey(41), (2, 8), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    c1 = init_kv_cache(cfg, 2, cfg.max_seq, cache_dtype="int8")
+    c1, ref = prefill(cfg, params, c1, prompt)
+    c2 = init_kv_cache(cfg, 2, cfg.max_seq, cache_dtype="int8")
+    c2, logits = prefill_chunked(cfg, params, c2, prompt, chunk=4)
+    assert logits.shape == (2, cfg.vocab)
+    a = np.asarray(ref, np.float32).ravel()
+    b = np.asarray(logits, np.float32).ravel()
+    assert float(np.corrcoef(a, b)[0, 1]) > 0.98

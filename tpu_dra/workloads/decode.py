@@ -207,11 +207,12 @@ def _decode_block(cfg: ModelConfig, x, layer, k_cache, v_cache, pos,
     return x, k_all, v_all
 
 
-def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens,
+def _chunk_hidden(cfg: ModelConfig, params, cache, pos, tokens,
                   window: int | None = None):
-    """Cached forward over an m-token chunk: ``tokens`` [B, m] at
-    positions ``pos .. pos+m-1`` → ([B, m, vocab] logits, updated cache).
-    m == 1 is the plain decode step; m > 1 is the speculative verify."""
+    """Cached trunk forward over an m-token chunk: ``tokens`` [B, m] at
+    positions ``pos .. pos+m-1`` → ([B, m, D] final activations, updated
+    cache) — the pre-head half of ``_chunk_logits`` (chunked prefill
+    skips the vocab head for all but the last token)."""
     m = tokens.shape[1]
     x = params["embed"].astype(jnp.bfloat16)[tokens]              # [B, m, D]
     if cfg.pos_emb == "learned":
@@ -229,8 +230,8 @@ def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens,
         x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
             block_q, x, (params["blocks"], cache["k"], cache["v"],
                          cache["k_s"], cache["v_s"]))
-        return head_logits(params, x), {"k": k_new, "v": v_new,
-                                        "k_s": ks_new, "v_s": vs_new}
+        return x, {"k": k_new, "v": v_new,
+                   "k_s": ks_new, "v_s": vs_new}
 
     def block(carry, inputs):
         layer, k_cache, v_cache = inputs
@@ -241,7 +242,17 @@ def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens,
 
     x, (k_new, v_new) = jax.lax.scan(
         block, x, (params["blocks"], cache["k"], cache["v"]))
-    return head_logits(params, x), {"k": k_new, "v": v_new}
+    return x, {"k": k_new, "v": v_new}
+
+
+def _chunk_logits(cfg: ModelConfig, params, cache, pos, tokens,
+                  window: int | None = None):
+    """Cached forward over an m-token chunk: ``tokens`` [B, m] at
+    positions ``pos .. pos+m-1`` → ([B, m, vocab] logits, updated cache).
+    m == 1 is the plain decode step; m > 1 is the speculative verify."""
+    x, cache = _chunk_hidden(cfg, params, cache, pos, tokens,
+                             window=window)
+    return head_logits(params, x), cache
 
 
 def _token_logits(cfg: ModelConfig, params, cache, pos, token,
@@ -317,6 +328,50 @@ def prefill(cfg: ModelConfig, params, cache, prompt,
     cache, x = _prefill_trunk(cfg, params, cache, prompt, attn_impl,
                               window=window)
     return cache, head_logits(params, x[:, -1:])[:, 0]
+
+
+def prefill_chunked(cfg: ModelConfig, params, cache, prompt,
+                    chunk: int = 256):
+    """Prefill in ``chunk``-token pieces through the cached decode path:
+    peak attention memory is O(B·chunk·S_max) instead of the full
+    prefill's O(B·S²) — the long-context prefill for prompts whose
+    dense score matrix would not fit.  A non-multiple prompt runs its
+    remainder as one final partial chunk; the vocab head runs ONCE, on
+    the final token only.
+
+    Exactness vs ``prefill``: _decode_block's cache-position mask admits
+    column ≤ the token's own absolute position, which inside a chunk
+    reproduces the causal mask (the speculative verify path relies on
+    the same invariant) — equal up to float reduction order with a bf16
+    cache.  With an int8 cache the within-chunk attention reads the
+    QUANTIZED k/v of the current chunk (the dense prefill attends full
+    precision and quantizes only on the way into the cache), so the two
+    differ by within-chunk quantization noise as well.
+    Returns (cache, last-token logits) like ``prefill``.
+    """
+    B, S = prompt.shape
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    n, rem = divmod(S, chunk)
+    last_x = jnp.zeros((B, cfg.d_model), jnp.bfloat16)
+    if n:
+        pieces = prompt[:, : n * chunk].reshape(
+            B, n, chunk).transpose(1, 0, 2)               # [n, B, c]
+
+        def body(carry, inputs):
+            cache, _ = carry
+            i, piece = inputs
+            x, cache = _chunk_hidden(cfg, params, cache, i * chunk, piece)
+            return (cache, x[:, -1]), None
+
+        (cache, last_x), _ = jax.lax.scan(
+            body, (cache, last_x),
+            (jnp.arange(n, dtype=jnp.int32), pieces))
+    if rem:
+        x, cache = _chunk_hidden(cfg, params, cache, n * chunk,
+                                 prompt[:, n * chunk:])
+        last_x = x[:, -1]
+    return cache, head_logits(params, last_x[:, None])[:, 0]
 
 
 def prefill_ragged(cfg: ModelConfig, params, cache, prompts, lengths,
