@@ -134,3 +134,30 @@ def test_fit_resume_is_exact(corpus, tmp_path):
     assert resumed.step == 8
     assert full.losses[4:] == resumed.losses, \
         (full.losses, resumed.losses)
+
+
+def test_fit_cosine_resume_keeps_learning(tmp_path):
+    """A resumed cosine run must size its schedule horizon from the
+    restored step — otherwise the restored optimizer count sits past the
+    schedule end and lr is pinned at ~0."""
+    import numpy as np
+    from tpu_dra.workloads.data import TokenDataset
+    from tpu_dra.workloads.fit import fit
+    from tpu_dra.workloads.train import ModelConfig
+    rng = np.random.default_rng(1)
+    path = str(tmp_path / "toks.bin")
+    TokenDataset.write(path, rng.integers(0, 64, size=40_000))
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                      d_ff=64, max_seq=16)
+    ck = str(tmp_path / "ck")
+    fit(cfg, path, steps=4, batch=8, lr=1e-2, lr_schedule="cosine",
+        warmup_steps=1, checkpoint_dir=ck, checkpoint_every=4,
+        log_every=100)
+    res = fit(cfg, path, steps=6, batch=8, lr=1e-2, lr_schedule="cosine",
+              warmup_steps=1, checkpoint_dir=ck, resume=True,
+              log_every=1, log_fn=lambda _m: None)
+    assert res.step == 10          # 4 + 6: the horizon covered them all
+    # with the schedule horizon fixed the lr is real, so the loss keeps
+    # moving; a 0-lr run would produce identical losses every step
+    spread = max(res.losses) - min(res.losses)
+    assert spread > 1e-4, res.losses

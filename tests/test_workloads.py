@@ -538,3 +538,51 @@ def test_accum_train_step_sharded():
                            dtype=jnp.int32), b_shard)
     params, loss = step(params, tokens)
     assert bool(jnp.isfinite(loss))
+
+
+def test_head_z_loss_and_label_smoothing():
+    """z_loss adds z*lse^2 exactly; label smoothing mixes in the uniform
+    cross-entropy; chunked head rejects both."""
+    import numpy as np
+    import pytest
+    from tpu_dra.workloads.train import (ModelConfig, head_nll,
+                                         init_params, _trunk)
+    cfg = ModelConfig(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                      d_ff=32, max_seq=8)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    x = _trunk(cfg, params, tokens[:, :-1])
+    tgt = tokens[:, 1:]
+    base = head_nll(params, x, tgt)
+    from tpu_dra.workloads.train import head_logits
+    logits = head_logits(params, x)
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    with_z = head_nll(params, x, tgt, z_loss=1e-2)
+    np.testing.assert_allclose(np.asarray(with_z),
+                               np.asarray(base + 1e-2 * lse**2),
+                               rtol=1e-5, atol=1e-6)
+    eps = 0.1
+    smoothed = head_nll(params, x, tgt, label_smoothing=eps)
+    uniform = lse - jnp.mean(logits, axis=-1, keepdims=True)
+    np.testing.assert_allclose(
+        np.asarray(smoothed),
+        np.asarray((1 - eps) * base + eps * uniform),
+        rtol=1e-5, atol=1e-6)
+    with pytest.raises(NotImplementedError):
+        head_nll(params, x, tgt, head_impl="chunked", z_loss=1e-4)
+
+
+def test_fit_cosine_schedule_runs(tmp_path):
+    import numpy as np
+    from tpu_dra.workloads.data import TokenDataset
+    from tpu_dra.workloads.fit import fit
+    from tpu_dra.workloads.train import ModelConfig
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "toks.bin")
+    TokenDataset.write(path, rng.integers(0, 64, size=20_000))
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                      d_ff=64, max_seq=16)
+    res = fit(cfg, path, steps=6, batch=8, lr=1e-3,
+              lr_schedule="cosine", warmup_steps=2, log_every=100)
+    assert np.isfinite(res.loss)

@@ -352,6 +352,13 @@ def prefill_chunked(cfg: ModelConfig, params, cache, prompt,
     B, S = prompt.shape
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    cap = cache["k"].shape[3]
+    if S > cap:
+        # _write_kv's scatter drops out-of-range writes silently; fail
+        # loudly instead (chunked prefill has no sliding-window mode —
+        # use prefill(window=...) for ring caches)
+        raise ValueError(f"prompt length {S} exceeds cache capacity "
+                         f"{cap}")
     n, rem = divmod(S, chunk)
     last_x = jnp.zeros((B, cfg.d_model), jnp.bfloat16)
     if n:

@@ -41,8 +41,11 @@ class FitResult:
 
 def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
         steps: int = 100, batch: int = 8, optimizer=None,
+        lr: float = 3e-4, lr_schedule: str = "constant",
+        warmup_steps: int = 0,
         attn_impl: str = "dense", head_impl: str = "dense",
-        accum_steps: int = 1,
+        accum_steps: int = 1, label_smoothing: float = 0.0,
+        z_loss: float = 0.0,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0, resume: bool = False,
         log_every: int = 10, seed: int = 0,
@@ -70,9 +73,32 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
             f"({mesh.shape['dp']} x {accum_steps})")
     seq = cfg.max_seq
     ds = TokenDataset(data_path)
+    if optimizer is None:
+        import optax
+        # schedules run on the optimizer's ABSOLUTE step count, which a
+        # resume restores — size the horizon from the restored step, or
+        # a resumed cosine run would sit at the schedule's end value
+        sched_horizon = steps
+        if resume and checkpoint_dir:
+            restored = latest_step(checkpoint_dir)
+            if restored is not None:
+                sched_horizon = restored + steps
+        if lr_schedule == "cosine":
+            sched = optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=lr,
+                warmup_steps=max(warmup_steps, 1),
+                decay_steps=max(sched_horizon, warmup_steps + 1))
+        elif lr_schedule == "constant":
+            sched = (optax.linear_schedule(0.0, lr, warmup_steps)
+                     if warmup_steps else lr)
+        else:
+            raise ValueError(f"unknown lr_schedule {lr_schedule!r}")
+        optimizer = optax.chain(optax.clip_by_global_norm(1.0),
+                                optax.adamw(sched, weight_decay=0.01))
     step_fn, init_opt, p_shard, b_shard = make_optax_train_step(
         cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
-        head_impl=head_impl, accum_steps=accum_steps)
+        head_impl=head_impl, accum_steps=accum_steps,
+        label_smoothing=label_smoothing, z_loss=z_loss)
 
     start = 0
     params = jax.device_put(init_params(cfg, jax.random.PRNGKey(seed)),
@@ -211,6 +237,12 @@ def main(argv=None):
     ap.add_argument("--head-impl", default="dense",
                     choices=("dense", "chunked"))
     ap.add_argument("--accum-steps", type=int, default=1)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--lr-schedule", default="constant",
+                    choices=("constant", "cosine"))
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--label-smoothing", type=float, default=0.0)
+    ap.add_argument("--z-loss", type=float, default=0.0)
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0)
     ap.add_argument("--resume", action="store_true")
@@ -223,7 +255,10 @@ def main(argv=None):
                       max_seq=args.max_seq, pos_emb=args.pos_emb)
     res = fit(cfg, args.data, steps=args.steps, batch=args.batch,
               attn_impl=args.attn_impl, head_impl=args.head_impl,
-              accum_steps=args.accum_steps,
+              accum_steps=args.accum_steps, lr=args.lr,
+              lr_schedule=args.lr_schedule,
+              warmup_steps=args.warmup_steps,
+              label_smoothing=args.label_smoothing, z_loss=args.z_loss,
               checkpoint_dir=args.checkpoint_dir,
               checkpoint_every=args.checkpoint_every, resume=args.resume)
     print(f"done: step {res.step} loss {res.loss:.4f} "

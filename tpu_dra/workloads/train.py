@@ -232,7 +232,8 @@ def head_logits(params, x):
 
 
 def head_nll(params, x, targets, head_impl: str = "dense",
-             n_chunks: int = 16):
+             n_chunks: int = 16, label_smoothing: float = 0.0,
+             z_loss: float = 0.0):
     """Per-token NLL through the final head (ln_f → unembed → log_softmax →
     target gather).  The one shared head for the dense/sp/pp/ep losses, so a
     head change (z-loss, label smoothing, softcap) lands in all of them at
@@ -243,7 +244,31 @@ def head_nll(params, x, targets, head_impl: str = "dense",
     HBM drops from O(B·S·V) to O(B·S·V/n_chunks) in forward AND backward
     (the bwd recomputes each chunk's logits from the saved lse).  Best on
     single-chip / dp runs; under tp the vocab axis is already sharded and
-    per-chunk slicing would cut across it."""
+    per-chunk slicing would cut across it.
+
+    ``label_smoothing`` ε mixes the target distribution with uniform:
+    loss = (1−ε)·nll + ε·(lse − mean(logits)).  ``z_loss`` adds the
+    PaLM-style stabilizer ``z_loss · lse²`` (keeps the softmax
+    normalizer from drifting; typical 1e-4).  Dense head only — the
+    chunked head's custom VJP doesn't carry the extra stats (raises).
+    """
+    if label_smoothing or z_loss:
+        if head_impl == "chunked":
+            raise NotImplementedError(
+                "label_smoothing/z_loss need the dense head (the chunked "
+                "custom VJP doesn't carry mean-logit/lse stats)")
+        logits = head_logits(params, x)
+        lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        target_logit = jnp.take_along_axis(logits, targets[..., None],
+                                           axis=-1)
+        nll = lse - target_logit
+        if label_smoothing:
+            uniform_nll = lse - jnp.mean(logits, axis=-1, keepdims=True)
+            nll = (1.0 - label_smoothing) * nll \
+                + label_smoothing * uniform_nll
+        if z_loss:
+            nll = nll + z_loss * jnp.square(lse)
+        return nll
     if head_impl == "chunked":
         B, S, D = x.shape
         V = params["unembed"].shape[1]
@@ -341,13 +366,17 @@ def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
 
 
 def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
-            head_impl: str = "dense"):
+            head_impl: str = "dense", label_smoothing: float = 0.0,
+            z_loss: float = 0.0):
     trunk = _trunk(cfg, params, tokens[:, :-1], _ATTN_IMPLS[attn_impl])
-    return jnp.mean(head_nll(params, trunk, tokens[:, 1:], head_impl))
+    return jnp.mean(head_nll(params, trunk, tokens[:, 1:], head_impl,
+                             label_smoothing=label_smoothing,
+                             z_loss=z_loss))
 
 
 def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
-             head_impl: str = "dense", accum_steps: int = 1):
+             head_impl: str = "dense", accum_steps: int = 1,
+             label_smoothing: float = 0.0, z_loss: float = 0.0):
     """(mean loss, grads) for a [B, S] batch, optionally via gradient
     accumulation: ``accum_steps > 1`` splits the batch into that many
     microbatches and runs them through one ``lax.scan`` (one compiled
@@ -355,7 +384,9 @@ def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
     effective batch B with the activation memory of B/accum_steps.
     Equal microbatches ⇒ the mean-of-means equals the full-batch mean,
     so accumulation changes memory, not semantics."""
-    vg = jax.value_and_grad(partial(loss_fn, cfg))
+    vg = jax.value_and_grad(partial(loss_fn, cfg,
+                                    label_smoothing=label_smoothing,
+                                    z_loss=z_loss))
     if accum_steps == 1:
         return vg(params, tokens, attn_impl=attn_impl, head_impl=head_impl)
     B = tokens.shape[0]
@@ -440,7 +471,9 @@ def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
 def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
                           attn_impl: str = "dense",
                           head_impl: str = "dense",
-                          accum_steps: int = 1):
+                          accum_steps: int = 1,
+                          label_smoothing: float = 0.0,
+                          z_loss: float = 0.0):
     """Like ``make_sharded_train_step`` but with a real optax optimizer
     (default: AdamW + global-norm clipping).
 
@@ -462,7 +495,9 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
     def train_step(params, opt_state, tokens):
         loss, grads = grads_fn(cfg, params, tokens, attn_impl=attn_impl,
                                head_impl=head_impl,
-                               accum_steps=accum_steps)
+                               accum_steps=accum_steps,
+                               label_smoothing=label_smoothing,
+                               z_loss=z_loss)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
