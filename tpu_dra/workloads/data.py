@@ -122,3 +122,45 @@ def device_prefetch(it: Iterator[np.ndarray], sharding=None,
             yield buf.popleft()
     finally:
         buf.clear()
+
+
+def pack_documents(docs, seq: int):
+    """Greedy first-fit packing of variable-length token documents into
+    fixed [N, seq] rows for ``train.packed_loss_fn``.
+
+    Returns ``(tokens, segment_ids, positions)`` int32 arrays of equal
+    shape: segment ids number the documents within a row from 1 (0 =
+    padding), positions restart at 0 per document (per-segment rope /
+    learned-pos lookups).  Documents longer than ``seq`` are truncated —
+    callers who care split beforehand.  Padding token id is 0.
+    """
+    if seq < 1:
+        raise ValueError(f"seq must be >= 1, got {seq}")
+    rows: list[list[np.ndarray]] = []
+    free: list[int] = []                 # remaining space per row
+    for doc in docs:
+        d = np.asarray(doc, np.int32).ravel()[:seq]
+        if not len(d):
+            continue
+        # first-fit: earliest row with space (next-fit wastes rows —
+        # each wasted row is a full seq of padding compute)
+        for r, room in enumerate(free):
+            if len(d) <= room:
+                rows[r].append(d)
+                free[r] -= len(d)
+                break
+        else:
+            rows.append([d])
+            free.append(seq - len(d))
+    N = max(len(rows), 1)
+    tokens = np.zeros((N, seq), np.int32)
+    segs = np.zeros((N, seq), np.int32)
+    pos = np.zeros((N, seq), np.int32)
+    for r, parts in enumerate(rows):
+        at = 0
+        for s_id, part in enumerate(parts, start=1):
+            tokens[r, at: at + len(part)] = part
+            segs[r, at: at + len(part)] = s_id
+            pos[r, at: at + len(part)] = np.arange(len(part))
+            at += len(part)
+    return tokens, segs, pos
