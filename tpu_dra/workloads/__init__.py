@@ -30,8 +30,10 @@ The TPU analogs here are first-class framework components
 - :mod:`tpu_dra.workloads.serve` — bucketed HTTP inference endpoint.
 - :mod:`tpu_dra.workloads.data` / :mod:`tpu_dra.workloads.fit` /
   :mod:`tpu_dra.workloads.checkpointing` — memmap data pipeline with a
-  deterministic rank-disjoint schedule, the optax fit loop with
-  bit-exact orbax resume, tail-slice evaluation.
+  deterministic rank-disjoint schedule and first-fit document packing
+  (segment-aware attention), the optax fit loop with warmup/cosine
+  schedules, loss shaping (label smoothing, z-loss), gradient
+  accumulation, and bit-exact orbax resume; tail-slice evaluation.
 - :mod:`tpu_dra.workloads.collectives` — ICI collective benchmarks
   (``jax.lax.psum`` bandwidth over a device mesh), the nvbandwidth analog
   and the BASELINE.md target metric.
