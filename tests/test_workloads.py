@@ -586,3 +586,52 @@ def test_fit_cosine_schedule_runs(tmp_path):
     res = fit(cfg, path, steps=6, batch=8, lr=1e-3,
               lr_schedule="cosine", warmup_steps=2, log_every=100)
     assert np.isfinite(res.loss)
+
+
+def test_tied_embeddings():
+    """tied_embeddings shares the embed table with the head: fewer
+    params, grads reach the table from both ends, training descends, the
+    chunked head matches the dense head, and decode serves it."""
+    import numpy as np
+    from tpu_dra.workloads.decode import greedy_decode
+    from tpu_dra.workloads.train import head_nll, _trunk
+    tied_cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                           d_ff=64, max_seq=16, tied_embeddings=True)
+    base_cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                           d_ff=64, max_seq=16)
+    tied = init_params(tied_cfg, jax.random.PRNGKey(0))
+    plain = init_params(base_cfg, jax.random.PRNGKey(0))
+    assert "unembed" not in tied
+    n_tied = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(tied))
+    n_plain = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(plain))
+    assert n_plain - n_tied == 64 * 32
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64,
+                                dtype=jnp.int32)
+    loss, grads = jax.value_and_grad(
+        lambda p: loss_fn(tied_cfg, p, tokens))(tied)
+    assert bool(jnp.isfinite(loss))
+    assert float(jnp.max(jnp.abs(grads["embed"]))) > 0
+
+    # chunked head agrees with the dense head on the tied weights
+    x = _trunk(tied_cfg, tied, tokens[:, :-1])
+    dense = head_nll(tied, x, tokens[:, 1:])
+    chunked = head_nll(tied, x, tokens[:, 1:], head_impl="chunked",
+                       n_chunks=4)
+    np.testing.assert_allclose(np.asarray(dense)[..., 0],
+                               np.asarray(chunked)[..., 0],
+                               rtol=2e-2, atol=2e-2)
+
+    # a few SGD steps descend
+    p = tied
+    losses = []
+    for _ in range(6):
+        loss, g = jax.value_and_grad(
+            lambda pp: loss_fn(tied_cfg, pp, tokens))(p)
+        p = jax.tree.map(lambda w, gw: w - 0.5 * gw, p, g)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    # serving path
+    toks = greedy_decode(tied_cfg, tied, tokens[:, :4], steps=3)
+    assert toks.shape == (2, 3)

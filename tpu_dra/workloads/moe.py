@@ -41,6 +41,13 @@ class MoEConfig(ModelConfig):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
 
+    def __post_init__(self):
+        super().__post_init__()
+        if self.tied_embeddings:
+            raise NotImplementedError(
+                "tied_embeddings is not wired through init_moe_params "
+                "(it would be silently ignored)")
+
     def capacity(self, n_tokens: int) -> int:
         return max(1, int(self.capacity_factor * n_tokens / self.n_experts))
 

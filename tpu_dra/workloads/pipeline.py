@@ -106,8 +106,9 @@ def pipeline_param_specs(cfg: ModelConfig) -> dict[str, Any]:
         "blocks": {k: P("pp") for k in
                    ("wqkv", "wo", "w1", "w2", "ln1", "ln2")},
         "ln_f": P(),
-        "unembed": P(),
     }
+    if not cfg.tied_embeddings:
+        out["unembed"] = P()
     if cfg.pos_emb == "learned":
         out["pos"] = P()
     return out

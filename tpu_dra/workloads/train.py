@@ -49,6 +49,9 @@ class ModelConfig:
     # max_seq, standard for current decoder LMs)
     pos_emb: str = "learned"
     rope_base: float = 10000.0
+    # share the input embedding with the output head (logits = x·embedᵀ):
+    # saves vocab·d_model params and often helps small models
+    tied_embeddings: bool = False
 
     def __post_init__(self):
         if self.pos_emb not in ("learned", "rope"):
@@ -99,8 +102,9 @@ def init_params(cfg: ModelConfig, key) -> dict[str, Any]:
             "ln2": jnp.ones((L, cfg.d_model), jnp.float32),
         },
         "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
-        "unembed": norm(keys[6], (cfg.d_model, cfg.vocab)),
     }
+    if not cfg.tied_embeddings:
+        params["unembed"] = norm(keys[6], (cfg.d_model, cfg.vocab))
     if cfg.pos_emb == "learned":
         params["pos"] = norm(keys[1], (cfg.max_seq, cfg.d_model))
     return params
@@ -260,8 +264,18 @@ def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention,
 
 
 def head_logits(params, x):
-    """Final norm + unembed on trunk activations."""
+    """Final norm + unembed on trunk activations.  Tied models (no
+    "unembed" leaf) project against the input embedding transposed."""
     x = _rmsnorm(x, params["ln_f"])
+    if "unembed" not in params:
+        e = params["embed"]
+        if not isinstance(e, jax.Array):          # dict leaf forms
+            raise NotImplementedError(
+                "tied head over a quantized/wrapped embed is unsupported "
+                "— embeddings stay high precision (quant.py)")
+        return jax.lax.dot_general(
+            x.astype(jnp.bfloat16), e.astype(jnp.bfloat16),
+            (((x.ndim - 1,), (1,)), ((), ()))).astype(jnp.float32)
     return matmul_any(x, params["unembed"], jnp.bfloat16).astype(jnp.float32)
 
 
@@ -305,14 +319,16 @@ def head_nll(params, x, targets, head_impl: str = "dense",
         return nll
     if head_impl == "chunked":
         B, S, D = x.shape
-        V = params["unembed"].shape[1]
+        tied = "unembed" not in params
+        w_full = (params["embed"].T if tied else params["unembed"])
+        V = w_full.shape[1]
         # largest divisor of V ≤ the requested chunk count — non-divisible
         # vocabs (e.g. 50257) degrade gracefully instead of asserting
         n = min(n_chunks, V)
         while V % n:
             n -= 1
         h = _rmsnorm(x, params["ln_f"]).reshape(B * S, D)
-        w = params["unembed"].astype(jnp.bfloat16)
+        w = w_full.astype(jnp.bfloat16)
         nll = _chunked_nll(h.astype(jnp.bfloat16), w,
                            targets.reshape(B * S), n)
         return nll.reshape(B, S, 1)
@@ -488,8 +504,9 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
             "ln2": s(None, None),
         },
         "ln_f": s(None),
-        "unembed": s(None, "tp"),
     }
+    if not cfg.tied_embeddings:
+        out["unembed"] = s(None, "tp")
     if cfg.pos_emb == "learned":
         out["pos"] = s(None, "tp")
     return out
