@@ -49,6 +49,22 @@ _DEADLINES = {
 # (recorded as skipped, not silently dropped).
 _TPU_BUDGET_S = float(os.environ.get("BENCH_TPU_BUDGET_S", "1800"))
 
+# Last-good per-section cache (VERDICT r02 item 1).  Every section that
+# completes on real TPU hardware writes its JSON here (with timestamp, git
+# SHA, and the device context it ran under); the final emission merges
+# cached results for any section the live run lost to a tunnel outage,
+# marking each merged section's age + origin.  Populated cache files are
+# committed to git after good hardware runs, so the round-end
+# driver-captured artifact carries machine-recorded TPU numbers — never
+# hand-copied ones — even from a fresh checkout with the tunnel down.
+_CACHE_DIR = os.environ.get("BENCH_CACHE_DIR",
+                            os.path.join(REPO, "bench_cache"))
+# Device context of the current live run (set once the probe succeeds);
+# cached alongside results so a merged artifact states which topology the
+# carried numbers came from.  Only tpu-platform runs are cached — a CPU
+# fallback must never overwrite recorded hardware truth.
+_cache_context: dict | None = None
+
 
 def _family_of(device):
     from tpu_dra.tpulib.topology import family_for_jax_device
@@ -582,6 +598,95 @@ def bench_real_discovery() -> dict:
 
 # --- orchestrator ------------------------------------------------------------
 
+def _git_sha() -> str:
+    try:
+        proc = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              capture_output=True, text=True, cwd=REPO,
+                              timeout=10)
+        return proc.stdout.strip()
+    except Exception:  # noqa: BLE001 — cache metadata only
+        return ""
+
+
+def _cache_worthy(name: str, results: dict) -> bool:
+    """A result is worth caching iff it carries real measurements: no error
+    key, and not a None-valued gate result (e.g. visibility_ok=None means
+    "couldn't test here" — never let that shadow a real recorded run)."""
+    if any(k.endswith("_error") for k in results):
+        return False
+    meaningful = {k: v for k, v in results.items()
+                  if not k.endswith(("_secs", "_note", "_skipped"))}
+    if not meaningful:
+        return False
+    return any(v is not None for v in meaningful.values())
+
+
+def _cache_write(name: str, results: dict) -> None:
+    if not _cache_worthy(name, results):
+        return
+    context = dict(_cache_context or {})
+    platform = results.get("tpu_platform") or context.get("tpu_platform")
+    if platform != "tpu" and not os.environ.get("BENCH_CACHE_ANY_PLATFORM"):
+        return
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        payload = {
+            "section": name,
+            "ts": time.time(),
+            "sha": _git_sha(),
+            "context": context,
+            "results": {k: v for k, v in results.items()
+                        if not k.endswith("_secs")},
+        }
+        path = os.path.join(_CACHE_DIR, f"{name}.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass                          # cache is best-effort, never fatal
+
+
+def _cache_read(name: str) -> dict | None:
+    try:
+        with open(os.path.join(_CACHE_DIR, f"{name}.json")) as f:
+            payload = json.load(f)
+        if not isinstance(payload.get("results"), dict):
+            return None
+        return payload
+    except (OSError, ValueError):
+        return None
+
+
+def _merge_cached(out: dict, names: list[str],
+                  live: dict[str, dict]) -> None:
+    """For every section the live run lost (error / skip / never-ran /
+    completed-without-measurements, e.g. visibility_ok=None on a machine
+    with no local chips), merge the last-good cached results.  Live keys
+    always win; merged sections are marked with ``<name>_cache``
+    {age_s, sha, ts} so the artifact says exactly which numbers are live
+    and which are carried from an earlier recorded run."""
+    for name in names:
+        res = live.get(name)
+        if res is not None and _cache_worthy(name, res):
+            continue
+        payload = _cache_read(name)
+        if payload is None:
+            continue
+        for k, v in payload["results"].items():
+            if out.get(k) is None:    # fill gaps; never mask live values
+                out[k] = v
+        out[f"{name}_cache"] = {
+            "age_s": round(time.time() - payload.get("ts", 0), 1),
+            "sha": payload.get("sha", ""),
+            "ts": payload.get("ts"),
+            # which topology the carried numbers came from — cached
+            # multi-chip collectives in a 1-device artifact must say so
+            "context": payload.get("context", {}),
+        }
+
+
 def _run_section(name: str, deadline: float) -> dict:
     """Run one section in a subprocess; merge its last-stdout-line JSON."""
     t0 = time.perf_counter()
@@ -601,27 +706,39 @@ def _run_section(name: str, deadline: float) -> dict:
     try:
         out = json.loads(lines[-1])
     except json.JSONDecodeError:
-        return {f"{name}_error": f"unparsable output: {lines[-1][:200]}"}
+        return {f"{name}_error": f"unparsable output: {lines[-1][:200]}",
+                f"{name}_secs": round(time.perf_counter() - t0, 1)}
     out[f"{name}_secs"] = round(time.perf_counter() - t0, 1)
+    _cache_write(name, out)
     return out
 
 
 def run_tpu_sections() -> dict:
     out: dict = {}
+    live: dict[str, dict] = {}
     t_start = time.perf_counter()
 
     def budget_left() -> float:
         return _TPU_BUDGET_S - (time.perf_counter() - t_start)
 
     # probe first, with one retry — it validates the tunnel for everything
-    res = _run_section("probe", _DEADLINES["probe"])
-    if "probe_error" in res and budget_left() > _DEADLINES["probe"]:
+    probe_deadline = min(_DEADLINES["probe"], max(budget_left(), 30))
+    res = _run_section("probe", probe_deadline)
+    if "probe_error" in res and budget_left() > probe_deadline:
         out["probe_retried"] = True
-        res = _run_section("probe", _DEADLINES["probe"])
+        res = _run_section("probe", probe_deadline)
     out.update(res)
+    live["probe"] = res
+    all_sections = list(_DEADLINES)   # single source of truth for merging
     if "probe_error" in res:
         out["tpu_error"] = res["probe_error"]
+        _merge_cached(out, all_sections, live)
         return out
+    global _cache_context
+    _cache_context = {k: res.get(k) for k in
+                      ("tpu_devices", "tpu_platform", "tpu_device_kind",
+                       "tpu_family")}
+    _cache_write("probe", res)        # re-write now that context is known
 
     order = ["matmul", "pallas_matmul", "flash", "train", "decode",
              "visibility",
@@ -643,6 +760,7 @@ def run_tpu_sections() -> dict:
         timed_out = "exceeded" in str(res.get(f"{name}_error", ""))
         consecutive_timeouts = consecutive_timeouts + 1 if timed_out else 0
         out.update(res)
+        live[name] = res
     # One retry pass for wedged sections: a mid-run tunnel drop times out
     # every section after it (observed in-round: matmul landed, then
     # pallas/flash/train/decode all hit their deadlines) — by the retry the
@@ -658,6 +776,8 @@ def run_tpu_sections() -> dict:
             out.pop(f"{name}_error", None)
             out[f"{name}_retried"] = True
             out.update(res)
+            live[name] = res
+    _merge_cached(out, all_sections, live)
     return out
 
 
