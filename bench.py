@@ -441,20 +441,32 @@ def section_multiprocess() -> dict:
     limit = chips[0].family.hbm_bytes // 2
     env[f"TPU_HBM_LIMIT_BYTES_{chips[0].minor}"] = str(limit)
     code = (
-        "import json\n"
+        "import json, os\n"
         "from tpu_dra.workloads.launcher import apply_hbm_limits\n"
         "lim = apply_hbm_limits()\n"
         "import jax, jax.numpy as jnp\n"
         "x = jnp.ones((1024, 1024), jnp.bfloat16)\n"
         "s = float(jnp.sum((x @ x).astype(jnp.float32)))\n"
         "stats = jax.devices()[0].memory_stats() or {}\n"
+        "over = None\n"
+        "if os.environ.get('BENCH_MP_OVERALLOC') and lim:\n"
+        "    # try to exceed the per-process cap by 50%: the libtpu bound\n"
+        "    # must reject the allocation (VERDICT r02 item 7's vehicle)\n"
+        "    try:\n"
+        "        big = jnp.ones((int(lim * 1.5) // 4,), jnp.float32)\n"
+        "        jax.block_until_ready(big)\n"
+        "        over = 'allowed'\n"
+        "    except Exception:\n"
+        "        over = 'rejected'\n"
         "print(json.dumps({'ok': s == 1024.0 * 1024 * 1024,\n"
         "                  'limit': lim,\n"
+        "                  'overalloc': over,\n"
         "                  'bytes_limit': stats.get('bytes_limit')}))\n")
-    procs = [subprocess.Popen([sys.executable, "-c", code], env=env,
+    envs = [dict(env, BENCH_MP_OVERALLOC="1"), env]
+    procs = [subprocess.Popen([sys.executable, "-c", code], env=e,
                               stdout=subprocess.PIPE,
                               stderr=subprocess.PIPE, text=True, cwd=REPO)
-             for _ in range(2)]
+             for e in envs]
     results = []
     # shared deadline: both waits together must fit inside this section's
     # own 300s budget, else _run_section kills us and the per-proc results
@@ -485,6 +497,10 @@ def section_multiprocess() -> dict:
         out["multiprocess_bytes_limit"] = ok[0]["bytes_limit"]
         out["multiprocess_limit_respected"] = \
             ok[0]["bytes_limit"] <= ok[0]["limit"]
+    over = [r.get("overalloc") for r in results if r.get("overalloc")]
+    if over:
+        # 'rejected' = the libtpu bound turned the over-cap allocation away
+        out["multiprocess_cap_enforced"] = over[0] == "rejected"
     if not ok:
         out["multiprocess_error"] = str(results)[:300]
     return out
