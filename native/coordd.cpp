@@ -23,6 +23,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <limits>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -48,6 +49,7 @@ struct Node {
   std::string ip;
   std::string fabric;
   long worker_id = -1;
+  long rank = -1;  // explicit global rank (multislice slice-major order)
 };
 
 // --- minimal JSON reader (objects/arrays/strings/numbers/bools/null) -------
@@ -111,6 +113,8 @@ class JsonReader {
         if (!ParseString(&n->fabric)) return false;
       } else if (key == "workerID") {
         if (!ParseNumber(&n->worker_id)) return false;
+      } else if (key == "rank") {
+        if (!ParseNumber(&n->rank)) return false;
       } else {
         if (!SkipValue()) return false;
       }
@@ -237,10 +241,23 @@ class CoordState {
     std::vector<Node> nodes;
     JsonReader reader(text);
     if (!reader.ParseNodes(&nodes)) return;
-    std::sort(nodes.begin(), nodes.end(), [](const Node& a, const Node& b) {
-      return a.worker_id != b.worker_id ? a.worker_id < b.worker_id
-                                        : a.name < b.name;
-    });
+    // explicit global rank (multislice slice-major order) when every node
+    // carries it; legacy (workerID, name) otherwise — in lockstep with
+    // launcher._rank_sorted and the Python coordservice (a missing
+    // workerID sorts LAST there, so the absent-field default of -1 must
+    // map to the same position here)
+    bool all_ranked = !nodes.empty();
+    for (const Node& n : nodes) all_ranked = all_ranked && n.rank >= 0;
+    auto worker_key = [](const Node& n) {
+      return n.worker_id < 0 ? std::numeric_limits<long>::max()
+                             : n.worker_id;
+    };
+    std::sort(nodes.begin(), nodes.end(),
+              [all_ranked, worker_key](const Node& a, const Node& b) {
+                if (all_ranked) return a.rank < b.rank;
+                long wa = worker_key(a), wb = worker_key(b);
+                return wa != wb ? wa < wb : a.name < b.name;
+              });
     nodes_ = std::move(nodes);
     raw_ = std::move(text);
     mtime_s_ = st.st_mtim.tv_sec;

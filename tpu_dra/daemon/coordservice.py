@@ -41,6 +41,7 @@ class CoordState:
                                     JAX_COORDINATOR_PORT))
         self._mu = threading.Lock()
         self._nodes: list[dict] = []
+        self._data: dict = {}
         self._mtime = 0.0
         self.reload()
 
@@ -56,6 +57,7 @@ class CoordState:
             return False
         with self._mu:
             self._nodes = data.get("nodes", [])
+            self._data = data
             self._mtime = mtime
         return bool(self._nodes)
 
@@ -64,19 +66,34 @@ class CoordState:
         with self._mu:
             return list(self._nodes)
 
+    def data(self) -> dict:
+        """The full nodes config (nodes + multislice block), matching the
+        native coordd's verbatim /nodes body."""
+        self.reload()
+        with self._mu:
+            return dict(self._data) or {"nodes": []}
+
     def ready(self) -> bool:
         return bool(self.nodes())
 
+    @staticmethod
+    def _order(nodes: list[dict]) -> list[dict]:
+        # explicit global rank (multislice-aware, slice-major) when the
+        # writer provided it; legacy (workerID, name) otherwise — must stay
+        # in lockstep with workloads.launcher._rank_sorted
+        if all(isinstance(n.get("rank"), int) for n in nodes):
+            return sorted(nodes, key=lambda n: n["rank"])
+        return sorted(nodes, key=lambda n: (n.get("workerID", 1 << 30),
+                                            n.get("name", "")))
+
     def coordinator(self) -> str:
-        nodes = self.nodes()
+        nodes = self._order(self.nodes())
         if not nodes:
             return ""
-        rank0 = min(nodes, key=lambda n: n.get("workerID", 1 << 30))
-        return f"{rank0['ipAddress']}:{self.coordinator_port}"
+        return f"{nodes[0]['ipAddress']}:{self.coordinator_port}"
 
     def process_index(self, ip: str) -> int:
-        for i, node in enumerate(
-                sorted(self.nodes(), key=lambda n: n.get("workerID", 0))):
+        for i, node in enumerate(self._order(self.nodes())):
             if node.get("ipAddress") == ip:
                 return i
         return -1
@@ -104,7 +121,7 @@ def serve(settings_dir: str, port: int,
                 else:
                     self._send(503, "NOT_READY\n")
             elif parsed.path == "/nodes":
-                self._send(200, json.dumps({"nodes": state.nodes()}),
+                self._send(200, json.dumps(state.data()),
                            "application/json")
             elif parsed.path == "/coordinator":
                 coord = state.coordinator()

@@ -32,17 +32,57 @@ from tpu_dra.util import klog
 from tpu_dra.util.fsutil import atomic_write
 
 
+def _split_fabric(fabric: str) -> tuple[str, int]:
+    """``<deployment-uuid>.<partition>`` → (deployment, partition).
+
+    The fabric id embeds the ICI partition after the final dot
+    (tpulib/discovery.py fabric_id); nodes sharing the deployment uuid but
+    not the partition are DCN-reachable multislice peers."""
+    base, _, part = fabric.rpartition(".")
+    try:
+        return base, int(part)
+    except ValueError:
+        return fabric, 0
+
+
 def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
                        my_fabric: str) -> str:
-    """The ``writeNodesConfig`` analog (main.go:292-322): only same-fabric
-    nodes participate (clique filtering), sorted by worker id so rank-0 is
-    deterministic."""
-    members = sorted(
-        (n for n in nodes if n.fabric_id == my_fabric),
-        key=lambda n: (n.worker_id, n.name))
+    """The ``writeNodesConfig`` analog (main.go:292-322), multislice-aware.
+
+    Same-deployment nodes participate; nodes of a different deployment uuid
+    are filtered out (the clique filter).  Within the deployment, nodes are
+    grouped by ICI partition into slices: every node gets an explicit
+    global ``rank`` (slice-major, then worker id, then name — so ranks
+    within a slice are contiguous, which is what MEGASCALE-style multislice
+    init expects) and a ``sliceID``.  When the domain spans >1 partition a
+    ``multislice`` block records {numSlices, sliceID (ours),
+    megascaleCoordinator (slice-0 rank-0 ip)} — the launcher turns it into
+    the ``MEGASCALE_*`` env alongside the ``jax.distributed`` triple.
+    Single-partition domains keep the exact legacy shape (plus the
+    now-always-present rank/sliceID fields, which old readers ignore).
+    """
+    my_deployment, _ = _split_fabric(my_fabric)
+    members = [n for n in nodes
+               if _split_fabric(n.fabric_id)[0] == my_deployment]
+    partitions = sorted({_split_fabric(n.fabric_id)[1] for n in members})
+    slice_of = {p: i for i, p in enumerate(partitions)}
+    members.sort(key=lambda n: (slice_of[_split_fabric(n.fabric_id)[1]],
+                                n.worker_id, n.name))
+    entries = [
+        dict(n.to_dict(), rank=i,
+             sliceID=slice_of[_split_fabric(n.fabric_id)[1]])
+        for i, n in enumerate(members)]
+    data: dict = {"nodes": entries}
+    if len(partitions) > 1:
+        _, my_partition = _split_fabric(my_fabric)
+        data["multislice"] = {
+            "numSlices": len(partitions),
+            "sliceID": slice_of.get(my_partition, 0),
+            "megascaleCoordinator": entries[0]["ipAddress"] if entries
+            else "",
+        }
     path = os.path.join(settings_dir, "nodes_config.json")
-    atomic_write(path, json.dumps(
-        {"nodes": [n.to_dict() for n in members]}, indent=2))
+    atomic_write(path, json.dumps(data, indent=2))
     return path
 
 

@@ -72,6 +72,38 @@ def make_mesh(devices=None, axis: str = "x") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def make_multislice_mesh(num_slices: int, devices=None,
+                         tp: int = 1) -> Mesh:
+    """DCN-style multislice mesh: axes ``("dcn", "dp", "tp")``.
+
+    The "dcn" axis crosses ICI-partition (slice) boundaries — only gradient
+    psums ride it, which is what DCN bandwidth affords — while "dp"/"tp"
+    stay inside a slice on ICI.  Devices are grouped by their real
+    ``slice_index`` when the runtime exposes one (multislice jax.devices()
+    orders by slice), with contiguous-block grouping as the single-slice /
+    CPU-dryrun fallback, so mesh rows always align with slice boundaries
+    and XLA routes each axis's collectives onto the right interconnect.
+    The driver-side counterpart is the per-partition rank blocks in
+    ``nodes_config.json`` (daemon/main.py write_nodes_config).
+    """
+    import numpy as np
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if n % num_slices:
+        raise ValueError(f"{n} devices do not split into {num_slices} "
+                         f"equal slices")
+    per_slice = n // num_slices
+    if per_slice % tp:
+        raise ValueError(f"tp={tp} does not divide slice size {per_slice}")
+    order = {id(d): i for i, d in enumerate(devices)}
+    slice_of = lambda d: (d.slice_index
+                          if getattr(d, "slice_index", None) is not None
+                          else order[id(d)] // per_slice)
+    ordered = sorted(devices, key=lambda d: (slice_of(d), order[id(d)]))
+    arr = np.array(ordered).reshape(num_slices, per_slice // tp, tp)
+    return Mesh(arr, ("dcn", "dp", "tp"))
+
+
 def psum_bandwidth(mesh: Mesh, mib_per_device: int = 64,
                    iters: int = 10) -> CollectiveResult:
     """All-reduce bandwidth.  Ring all-reduce moves 2·(n-1)/n of the buffer

@@ -23,16 +23,52 @@ class RendezvousInfo:
     num_processes: int
     process_id: int
     domain_uid: str = ""
+    # multislice (DCN) rendezvous: set when the domain spans >1 ICI
+    # partition.  slice_id/num_slices mirror MEGASCALE_SLICE_ID /
+    # MEGASCALE_NUM_SLICES; megascale_coordinator is the slice-0 rank-0
+    # host (the MEGASCALE_COORDINATOR_ADDRESS, port separate from the
+    # jax.distributed port)
+    num_slices: int = 1
+    slice_id: int = 0
+    megascale_coordinator: str = ""
+
+    def megascale_env(self, env: Optional[dict[str, str]] = None
+                      ) -> dict[str, str]:
+        """The MEGASCALE_* env for this process — emitted alongside the
+        ``jax.distributed`` triple on multislice domains (the multi-clique
+        analog of the reference's per-clique nodes config,
+        main.go:292-322).  Empty for single-slice domains."""
+        if self.num_slices <= 1:
+            return {}
+        e = os.environ if env is None else env
+        out = {
+            "MEGASCALE_NUM_SLICES": str(self.num_slices),
+            "MEGASCALE_SLICE_ID": str(self.slice_id),
+        }
+        if self.megascale_coordinator:
+            # an explicit host:port is kept verbatim; a bare host gets the
+            # default (overridable) megascale port appended
+            addr = self.megascale_coordinator
+            if ":" not in addr:
+                port = e.get("MEGASCALE_COORDINATOR_PORT",
+                             str(MEGASCALE_COORDINATOR_PORT))
+                addr = f"{addr}:{port}"
+            out["MEGASCALE_COORDINATOR_ADDRESS"] = addr
+        return out
 
     def initialize(self) -> None:
         """Call ``jax.distributed.initialize`` with the resolved triple.
         Every driver-injected resource contract is applied first: the
         MultiProcess slot gate (fail fast before any backend work), the HBM
-        bound (must land in ``LIBTPU_INIT_ARGS`` before libtpu init), and
-        the scheduling-priority hint."""
+        bound (must land in ``LIBTPU_INIT_ARGS`` before libtpu init), the
+        scheduling-priority hint, and — on multislice domains — the
+        MEGASCALE_* env (libtpu reads it at backend init to bridge the
+        per-slice ICI meshes over DCN)."""
         acquire_multiprocess_slot()
         apply_hbm_limits()
         apply_scheduling_priority()
+        for key, val in self.megascale_env().items():
+            os.environ.setdefault(key, val)   # explicit user env wins
         import jax
         jax.distributed.initialize(
             coordinator_address=self.coordinator_address,
@@ -41,6 +77,7 @@ class RendezvousInfo:
 
 
 JAX_COORDINATOR_PORT = 8476
+MEGASCALE_COORDINATOR_PORT = 8080   # libtpu megascale default
 
 
 def apply_hbm_limits(env: Optional[dict[str, str]] = None,
@@ -242,40 +279,68 @@ def _coordinator_port(env: Optional[dict] = None) -> int:
     return int(e.get("JAX_COORDINATOR_PORT", JAX_COORDINATOR_PORT))
 
 
+def _rank_sorted(nodes: list[dict]) -> list[dict]:
+    """Global process order: explicit ``rank`` when the config carries it
+    (multislice-aware, slice-major), legacy (workerID, name) otherwise.
+    The fallback key must stay in LOCKSTEP with coordservice
+    ``CoordState._order`` (missing workerID sorts last, missing name
+    tolerated) — two processes resolving the same config through
+    different paths must agree on every rank."""
+    if all(isinstance(n.get("rank"), int) for n in nodes):
+        return sorted(nodes, key=lambda n: n["rank"])
+    return sorted(nodes, key=lambda n: (n.get("workerID", 1 << 30),
+                                        n.get("name", "")))
+
+
+def _info_from_config(data: dict, my_ip: str,
+                      env: Optional[dict] = None
+                      ) -> Optional[RendezvousInfo]:
+    nodes = data.get("nodes", [])
+    if not nodes:
+        return None
+    nodes = _rank_sorted(nodes)
+    coordinator = f"{nodes[0]['ipAddress']}:{_coordinator_port(env)}"
+    pid = next((i for i, n in enumerate(nodes)
+                if n.get("ipAddress") == my_ip), -1)
+    if pid < 0:
+        return None
+    info = RendezvousInfo(coordinator, len(nodes), pid)
+    ms = data.get("multislice")
+    if ms:
+        info.num_slices = int(ms.get("numSlices", 1))
+        # this PROCESS's slice is its own node's, not the config writer's
+        info.slice_id = int(nodes[pid].get("sliceID",
+                                           ms.get("sliceID", 0)))
+        info.megascale_coordinator = ms.get("megascaleCoordinator", "")
+    return info
+
+
 def _from_settings_dir(settings_dir: str, my_ip: str,
                        env: Optional[dict] = None
                        ) -> Optional[RendezvousInfo]:
     path = os.path.join(settings_dir, "nodes_config.json")
     try:
         with open(path) as f:
-            nodes = json.load(f).get("nodes", [])
+            data = json.load(f)
     except (FileNotFoundError, json.JSONDecodeError):
         return None
-    if not nodes:
-        return None
-    nodes = sorted(nodes, key=lambda n: (n.get("workerID", 0), n["name"]))
-    coordinator = f"{nodes[0]['ipAddress']}:{_coordinator_port(env)}"
-    pid = next((i for i, n in enumerate(nodes)
-                if n.get("ipAddress") == my_ip), -1)
-    if pid < 0:
-        return None
-    return RendezvousInfo(coordinator, len(nodes), pid)
+    return _info_from_config(data, my_ip, env)
 
 
-def _from_coordservice(port: int, my_ip: str) -> Optional[RendezvousInfo]:
+def _from_coordservice(port: int, my_ip: str,
+                       env: Optional[dict] = None
+                       ) -> Optional[RendezvousInfo]:
     base = f"http://127.0.0.1:{port}"
     try:
-        coordinator = urllib.request.urlopen(
-            f"{base}/coordinator", timeout=5).read().decode()
-        nodes = json.loads(urllib.request.urlopen(
-            f"{base}/nodes", timeout=5).read())["nodes"]
-        pid = int(urllib.request.urlopen(
-            f"{base}/whoami?ip={my_ip}", timeout=5).read())
+        # /nodes returns the full nodes config (both the native coordd,
+        # which serves the file verbatim, and the Python coordservice) —
+        # rank order and the multislice block come from there, so this
+        # path and the settings-dir path resolve identically
+        data = json.loads(urllib.request.urlopen(
+            f"{base}/nodes", timeout=5).read())
     except Exception:  # noqa: BLE001 — caller falls back / errors out
         return None
-    if pid < 0:
-        return None
-    return RendezvousInfo(coordinator, len(nodes), pid)
+    return _info_from_config(data, my_ip, env)
 
 
 def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
@@ -291,7 +356,11 @@ def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
             coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
             num_processes=int(env.get("JAX_NUM_PROCESSES", "1")),
             process_id=int(env.get("JAX_PROCESS_ID", "0")),
-            domain_uid=env.get("SLICE_DOMAIN_UUID", ""))
+            domain_uid=env.get("SLICE_DOMAIN_UUID", ""),
+            num_slices=int(env.get("MEGASCALE_NUM_SLICES", "1")),
+            slice_id=int(env.get("MEGASCALE_SLICE_ID", "0")),
+            megascale_coordinator=env.get(
+                "MEGASCALE_COORDINATOR_ADDRESS", ""))
     domain_uid = env.get("SLICE_DOMAIN_UUID", "")
     if not domain_uid:
         raise RuntimeError(
@@ -303,7 +372,7 @@ def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
     info = _from_settings_dir(settings, my_ip, env)
     if info is None:
         port = int(env.get("SLICE_COORDINATOR_PORT", "51000"))
-        info = _from_coordservice(port, my_ip)
+        info = _from_coordservice(port, my_ip, env)
     if info is None:
         raise RuntimeError(
             f"slice domain {domain_uid}: could not resolve rendezvous "

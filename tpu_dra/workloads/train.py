@@ -513,6 +513,11 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh) -> dict[str, Any]:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch over the data axes.  On a multislice ("dcn","dp","tp") mesh
+    the batch shards over BOTH dcn and dp — gradient psums then ride DCN
+    across slices and ICI within one, the standard multislice layout."""
+    if "dcn" in mesh.axis_names:
+        return NamedSharding(mesh, P(("dcn", "dp"), None))
     return NamedSharding(mesh, P("dp", None))
 
 
