@@ -41,6 +41,7 @@ _DEADLINES = {
     "flash": 330,
     "train": 420,
     "decode": 600,
+    "continuous": 420,
     "visibility": 300,
     "multiprocess": 300,
     "collectives": 300,
@@ -387,6 +388,73 @@ def section_decode() -> dict:
     return out
 
 
+def section_continuous() -> dict:
+    """Continuous batching under concurrent mixed-length load: 32 slots,
+    requests joining/leaving the in-flight decode (VERDICT r02 item 6).
+    Reports aggregate tok/s plus p50/p95 per-REQUEST latency — the
+    serving metrics the bucketed decode section can't measure."""
+    import threading
+
+    import jax
+
+    from tpu_dra.workloads.continuous import ContinuousEngine
+    from tpu_dra.workloads.quant import quantize_params_int8
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        # the headline serving config: int8 weights + GQA cache
+        cfg = ModelConfig(vocab=32768, d_model=1024, n_heads=8,
+                          n_kv_heads=2, n_layers=8, d_ff=4096,
+                          max_seq=1024, pos_emb="rope")
+        params = quantize_params_int8(init_params(cfg,
+                                                  jax.random.PRNGKey(0)))
+        slots, chunk, n_req = 32, 8, 96
+        lengths = [16, 32, 64, 128]
+        steps = [32, 64, 96, 128]
+    else:
+        cfg = ModelConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                          d_ff=128, max_seq=64, pos_emb="rope")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        slots, chunk, n_req = 4, 2, 6
+        lengths = [2, 4, 8]
+        steps = [4, 8]
+    eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk)
+    try:
+        # warm the compiled programs (one per prompt bucket + the step),
+        # then zero the stats so compile time never reads as serving
+        # latency
+        for ln in lengths:
+            eng.submit([1] * ln, steps=chunk, timeout=600)
+        eng.reset_stats()
+        reqs = [([7 + i % 100] * lengths[i % len(lengths)],
+                 steps[i % len(steps)]) for i in range(n_req)]
+        t0 = time.perf_counter()
+        handles = [eng.submit_async(p, s) for p, s in reqs]
+        errs = []
+        for h in handles:
+            if not h.done.wait(600):
+                errs.append("timeout: request not done within 600s")
+            elif h.error:
+                errs.append(h.error)
+        secs = time.perf_counter() - t0
+        stats = eng.stats()
+        total_toks = sum(len(h.tokens) for h in handles)
+        out = {
+            "continuous_slots": slots,
+            "continuous_requests": n_req,
+            "continuous_tokens_per_s": round(total_toks / secs, 1),
+            "continuous_req_p50_ms": stats.get("latency_p50_ms"),
+            "continuous_req_p95_ms": stats.get("latency_p95_ms"),
+        }
+        if errs:
+            out["continuous_errors"] = errs[0][:200]
+        return out
+    finally:
+        eng.shutdown()
+
+
 def section_visibility() -> dict:
     """Hardware validation of the CDI visibility env contract (VERDICT
     next-round item 3): launch a subprocess with the env the driver would
@@ -530,6 +598,7 @@ _SECTIONS = {
     "flash": section_flash,
     "train": section_train,
     "decode": section_decode,
+    "continuous": section_continuous,
     "visibility": section_visibility,
     "multiprocess": section_multiprocess,
     "collectives": section_collectives,
@@ -757,6 +826,7 @@ def run_tpu_sections() -> dict:
     _cache_write("probe", res)        # re-write now that context is known
 
     order = ["matmul", "pallas_matmul", "flash", "train", "decode",
+             "continuous",
              "visibility",
              "multiprocess"]
     if out.get("tpu_devices", 1) > 1:

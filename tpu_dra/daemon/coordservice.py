@@ -78,13 +78,8 @@ class CoordState:
 
     @staticmethod
     def _order(nodes: list[dict]) -> list[dict]:
-        # explicit global rank (multislice-aware, slice-major) when the
-        # writer provided it; legacy (workerID, name) otherwise — must stay
-        # in lockstep with workloads.launcher._rank_sorted
-        if all(isinstance(n.get("rank"), int) for n in nodes):
-            return sorted(nodes, key=lambda n: n["rank"])
-        return sorted(nodes, key=lambda n: (n.get("workerID", 1 << 30),
-                                            n.get("name", "")))
+        from tpu_dra.util.rank import rank_sorted
+        return rank_sorted(nodes)
 
     def coordinator(self) -> str:
         nodes = self._order(self.nodes())

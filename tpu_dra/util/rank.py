@@ -1,0 +1,25 @@
+"""Canonical node ordering for nodes_config.json consumers.
+
+ONE implementation shared by the workload launcher (settings-dir path) and
+the Python coordservice (HTTP path) so two processes resolving the same
+config through different paths can never disagree on rank assignment —
+jax.distributed rendezvous hangs if they do.  The native coordd
+(native/coordd.cpp Reload) mirrors this exactly; its contract test
+(tests/test_multislice.py test_native_coordd_multislice_contract) is the
+lockstep guard.
+"""
+
+from __future__ import annotations
+
+
+def rank_sorted(nodes: list[dict]) -> list[dict]:
+    """Global process order over node dicts.
+
+    Explicit ``rank`` when every entry carries it (multislice-aware,
+    slice-major — daemon/main.py write_nodes_config assigns them); legacy
+    ``(workerID, name)`` otherwise, with a missing workerID sorting LAST
+    and a missing name tolerated."""
+    if all(isinstance(n.get("rank"), int) for n in nodes):
+        return sorted(nodes, key=lambda n: n["rank"])
+    return sorted(nodes, key=lambda n: (n.get("workerID", 1 << 30),
+                                        n.get("name", "")))

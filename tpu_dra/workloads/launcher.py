@@ -279,17 +279,8 @@ def _coordinator_port(env: Optional[dict] = None) -> int:
     return int(e.get("JAX_COORDINATOR_PORT", JAX_COORDINATOR_PORT))
 
 
-def _rank_sorted(nodes: list[dict]) -> list[dict]:
-    """Global process order: explicit ``rank`` when the config carries it
-    (multislice-aware, slice-major), legacy (workerID, name) otherwise.
-    The fallback key must stay in LOCKSTEP with coordservice
-    ``CoordState._order`` (missing workerID sorts last, missing name
-    tolerated) — two processes resolving the same config through
-    different paths must agree on every rank."""
-    if all(isinstance(n.get("rank"), int) for n in nodes):
-        return sorted(nodes, key=lambda n: n["rank"])
-    return sorted(nodes, key=lambda n: (n.get("workerID", 1 << 30),
-                                        n.get("name", "")))
+from tpu_dra.util.rank import rank_sorted as _rank_sorted  # noqa: E402
+# (one shared ordering for all config consumers — util/rank.py)
 
 
 def _info_from_config(data: dict, my_ip: str,

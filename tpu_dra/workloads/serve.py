@@ -11,6 +11,10 @@ POST /generate  {"tokens": [[...]], "steps": N, "temperature": 0.0,
                  "top_k": 0, "top_p": 0.0, "seed": 0,
                  "eos_id": null, "repetition_penalty": 1.0}
              → {"tokens": [[...]]}           (the N generated ids per row)
+With ``continuous=True`` /generate runs over a ContinuousEngine
+(workloads/continuous.py): rows join the in-flight decode at chunk
+boundaries and leave on eos/steps, so mixed-length concurrent requests
+never queue behind a long generation.
 POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
                  "eos_id": null, "length_penalty": 0.0}
              → {"tokens": [[[...]]], "scores": [[...]]}   (W best per row,
@@ -31,6 +35,10 @@ import jax.numpy as jnp
 
 from tpu_dra.workloads.decode import beam_decode, decode
 from tpu_dra.workloads.train import ModelConfig
+
+
+# upper bound on one continuous-mode request's wall time (compile included)
+ENGINE_REQUEST_TIMEOUT_S = 600
 
 
 def _bucket(n: int, buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)) -> int:
@@ -145,7 +153,42 @@ class DecoderPool:
                 [scores[i].tolist() for i in range(len(rows))])
 
 
-def make_handler(pool: DecoderPool):
+def make_handler(pool: DecoderPool, engine=None):
+    """``engine`` (a ContinuousEngine) takes over /generate when given:
+    every row becomes its own engine request, fanned in via submit_async
+    so one HTTP call's rows still decode concurrently."""
+
+    def engine_generate(req) -> dict:
+        rows = req["tokens"]
+        if not rows or not all(rows):
+            raise ValueError("tokens must be a non-empty list of "
+                             "non-empty rows")
+        for knob, noop in (("top_k", 0.0), ("top_p", 0.0),
+                           ("repetition_penalty", 1.0)):
+            val = req.get(knob)
+            if val is not None and float(val) != noop:
+                raise ValueError(
+                    f"{knob} is engine-global in continuous mode; start "
+                    f"the server without --continuous for per-request "
+                    f"{knob}")
+        eos = req.get("eos_id")
+        handles = [engine.submit_async(
+            r, int(req.get("steps", 16)),
+            eos_id=None if eos is None else int(eos),
+            temperature=float(req.get("temperature", 0.0)),
+            seed=int(req.get("seed", 0))) for r in rows]
+        out = []
+        for h in handles:
+            # bounded: a dead batcher fails requests via _fail_all, but a
+            # handler thread must never hang forever regardless
+            if not h.done.wait(ENGINE_REQUEST_TIMEOUT_S):
+                raise RuntimeError(
+                    f"request not done within {ENGINE_REQUEST_TIMEOUT_S}s")
+            if h.error:
+                raise RuntimeError(h.error)
+            out.append(h.tokens)
+        return {"tokens": out}
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):             # quiet by default
             pass
@@ -220,6 +263,9 @@ def make_handler(pool: DecoderPool):
                     NotImplementedError, json.JSONDecodeError) as exc:
                 self._send(400, json.dumps(
                     {"error": str(exc)[:300]}).encode())
+            except RuntimeError as exc:   # engine-side failure, not input
+                self._send(500, json.dumps(
+                    {"error": str(exc)[:300]}).encode())
 
         def do_POST(self):
             def eos_of(req):
@@ -236,6 +282,10 @@ def make_handler(pool: DecoderPool):
                     return {"tokens": hyps, "scores": scores}
                 self._json_post(handle)
             elif self.path == "/generate":
+                if engine is not None:
+                    self._json_post(engine_generate)
+                    return
+
                 def handle(req):
                     return {"tokens": pool.generate(
                         req["tokens"], int(req.get("steps", 16)),
@@ -254,11 +304,37 @@ def make_handler(pool: DecoderPool):
 
 def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           port: int = 8477,
-          cache_dtype: str = "bf16") -> ThreadingHTTPServer:
+          cache_dtype: str = "bf16",
+          continuous: bool = False, slots: int = 32,
+          chunk: int = 4) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
-    stop).  ``port`` 0 picks a free port (``server.server_address``)."""
+    stop).  ``port`` 0 picks a free port (``server.server_address``).
+
+    ``continuous=True`` routes /generate through a ContinuousEngine with
+    ``slots`` in-flight sequences: requests join and leave the running
+    decode at ``chunk``-token boundaries, so a short request never waits
+    behind a long generation (no head-of-line blocking; VERDICT r02 item
+    6).  /beam keeps the bucketed pool either way (beam search has no
+    ragged mode), as do /generate's top_k/top_p/repetition_penalty knobs —
+    the engine rejects them, the error names the bucketed path."""
     pool = DecoderPool(cfg, params, cache_dtype=cache_dtype)
-    srv = ThreadingHTTPServer((host, port), make_handler(pool))
+    engine = None
+    if continuous:
+        from tpu_dra.workloads.continuous import ContinuousEngine
+        engine = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                                  cache_dtype=cache_dtype)
+    srv = ThreadingHTTPServer((host, port), make_handler(pool, engine))
+    srv.engine = engine               # reachable for stats
+    if engine is not None:
+        # srv.shutdown() is the documented stop mechanism — it must also
+        # stop the batcher thread and drop the slot cache, or every
+        # start/stop cycle leaks both
+        orig_shutdown = srv.shutdown
+
+        def shutdown():
+            orig_shutdown()
+            engine.shutdown()
+        srv.shutdown = shutdown
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -297,6 +373,15 @@ def main(argv=None):
                          "int8 quarters the per-token weight read")
     ap.add_argument("--cache-dtype", default="bf16",
                     choices=("bf16", "int8"))
+    ap.add_argument("--continuous", action="store_true",
+                    help="continuously-batched /generate: requests join "
+                         "and leave the in-flight decode (no head-of-line "
+                         "blocking)")
+    ap.add_argument("--slots", type=int, default=32,
+                    help="continuous mode: concurrent in-flight sequences")
+    ap.add_argument("--chunk", type=int, default=4,
+                    help="continuous mode: tokens per dispatch (join "
+                         "granularity)")
     args = ap.parse_args(argv)
 
     init_tpu_workload()
@@ -311,7 +396,8 @@ def main(argv=None):
         params = (quantize_params_int8(params) if args.weights == "int8"
                   else cast_params_bf16(params))
     srv = serve(cfg, params, host=args.host, port=args.port,
-                cache_dtype=args.cache_dtype)
+                cache_dtype=args.cache_dtype, continuous=args.continuous,
+                slots=args.slots, chunk=args.chunk)
     print(f"serving on {srv.server_address}", flush=True)
     try:
         threading.Event().wait()
