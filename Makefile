@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test bench drive image proto clean
+.PHONY: all native test bench drive image proto check-proto stress clean
 
 all: native
 
@@ -25,6 +25,23 @@ drive: native
 proto:
 	cd tpu_dra/kubeletplugin/proto && \
 	protoc --python_out=. dra_v1beta1.proto pluginregistration.proto
+
+# check-generate analog (reference .github/workflows/golang.yaml:26-53):
+# the committed _pb2.py must match what `make proto` regenerates, or the
+# wire contract on disk has silently drifted from the .proto source
+check-proto: proto
+	git diff --exit-code -- tpu_dra/kubeletplugin/proto
+
+# -race stand-in (reference Makefile:95-96 runs `go test -race`): repeat
+# the threading-heavy suites; interleaving bugs show up across runs, not
+# in any single one
+STRESS_RUNS ?= 5
+stress:
+	for i in $$(seq 1 $(STRESS_RUNS)); do \
+	  echo "stress run $$i/$(STRESS_RUNS)"; \
+	  $(PYTHON) -m pytest tests/test_stress_concurrency.py \
+	    tests/test_informer.py tests/test_workqueue.py -q -x || exit 1; \
+	done
 
 image:
 	docker build -t $(IMAGE) .
