@@ -24,7 +24,11 @@ before reading it — so slot reuse needs no cache zeroing.
 Sampling: per-request ``temperature`` (0 = greedy) via a per-slot
 temperature vector; ``top_k``/``top_p`` are engine-global statics (a
 per-slot rank filter would put two argsorts in the hot step for a niche
-knob; set them engine-wide or use the bucketed /generate path).
+knob; set them engine-wide or use the bucketed /generate path).  Every
+slot carries its own PRNG stream derived purely from the request's
+``seed``, so sampled outputs are reproducible: same (prompt, steps, seed,
+temperature) ⇒ same tokens, regardless of engine history or what else
+shares the batch.
 """
 
 from __future__ import annotations
@@ -41,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_dra.workloads.decode import (
+    _filter_topk_topp,
     _select_token,
     _token_logits,
     head_logits,
@@ -108,20 +113,26 @@ class ContinuousEngine:
         self._temp = jnp.zeros((slots,), jnp.float32)
         self._eos = jnp.full((slots,), -1, jnp.int32)   # -1: never matches
         self._done = jnp.ones((slots,), bool)           # free ⇒ done
+        # per-slot PRNG streams: a request's sampled tokens depend only on
+        # (its seed, its own logits), never on engine history or what else
+        # shares the batch — same (prompt, seed, temperature) ⇒ same output
+        self._keys = jnp.zeros((slots, 2), jnp.uint32)
         # host state
         self._requests: list[Optional[_Request]] = [None] * slots
         self._emitted: list[int] = [0] * slots
         self._pending: deque[_Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
-        self._rng_counter = 0
-        self._key = jax.random.PRNGKey(0)
         # stats
         self.completed = 0
         self.tokens_out = 0
         self.latencies_s: deque[float] = deque(maxlen=latency_window)
         self._prefill_fns: dict[int, Any] = {}
-        self._step_fn = jax.jit(partial(self._chunk_step_impl, cfg))
+        # donation: the slot cache is the engine's dominant HBM object;
+        # without it every dispatch copies the whole cache (double peak
+        # HBM + a full-cache copy per chunk)
+        self._step_fn = jax.jit(partial(self._chunk_step_impl, cfg),
+                                donate_argnums=(1, 2, 3, 6, 7))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
         self._thread.start()
@@ -152,34 +163,40 @@ class ContinuousEngine:
         return cache, first
 
     def _chunk_step_impl(self, cfg, params, cache, token, pos, temp, eos,
-                         done, key):
+                         done, keys):
         """Advance every slot ``chunk`` tokens: one lax.scan, ragged
-        positions, per-slot temperature/eos.  Finished/free slots keep
-        re-emitting their last token (host trims); their cache writes past
-        max_len are dropped by the scatter's OOB mode."""
-        keys = jax.random.split(key, self.chunk)
+        positions, per-slot temperature/eos/PRNG-stream.  Finished/free
+        slots keep re-emitting their last token (host trims); their cache
+        writes past max_len are dropped by the scatter's OOB mode."""
 
-        def step(carry, key):
-            cache, token, pos, done = carry
+        def step(carry, _):
+            cache, token, pos, done, keys = carry
             logits, cache = _token_logits(cfg, params, cache, pos, token)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            sampled = _select_token(
-                logits / jnp.maximum(temp, 1e-6)[:, None], key, 1.0,
+            # per-slot key streams: split each slot's key, draw with its
+            # own subkey — a slot's samples never depend on its neighbors
+            split = jax.vmap(jax.random.split)(keys)     # [slots, 2, 2]
+            keys, draw = split[:, 0], split[:, 1]
+            filt = _filter_topk_topp(
+                logits / jnp.maximum(temp, 1e-6)[:, None],
                 self.top_k, self.top_p)
-            nxt = jnp.where(temp > 0, sampled, greedy)
+            sampled = jax.vmap(
+                lambda k, lg: jax.random.categorical(k, lg))(draw, filt)
+            nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
             nxt = jnp.where(done, token, nxt)       # frozen slots hold
             done2 = done | (nxt == eos)
             pos = pos + jnp.where(done, 0, 1)
-            return (cache, nxt, pos, done2), nxt
+            return (cache, nxt, pos, done2, keys), nxt
 
-        (cache, token, pos, done), toks = jax.lax.scan(
-            step, (cache, token, pos, done), keys)
-        return cache, token, pos, done, toks.T      # [slots, chunk]
+        (cache, token, pos, done, keys), toks = jax.lax.scan(
+            step, (cache, token, pos, done, keys), None, length=self.chunk)
+        return cache, token, pos, done, keys, toks.T    # [slots, chunk]
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(partial(self._prefill_impl, self.cfg))
+            fn = jax.jit(partial(self._prefill_impl, self.cfg),
+                         donate_argnums=(1,))       # the slot cache
             self._prefill_fns[bucket] = fn
         return fn
 
@@ -264,7 +281,11 @@ class ContinuousEngine:
     def _bucket(self, n: int) -> int:
         for b in _PROMPT_BUCKETS:
             if n <= b:
-                return b
+                # never pad past the cache: a bucket wider than max_len
+                # could not be written into the slot's rows (submit
+                # validation guarantees n + steps <= max_len, so the
+                # clamped bucket still covers the prompt)
+                return min(b, self.max_len)
         raise ValueError(n)
 
     def _admit(self) -> None:
@@ -276,18 +297,21 @@ class ContinuousEngine:
             Sb = self._bucket(len(req.prompt))
             prompt = jnp.asarray(
                 [req.prompt + [0] * (Sb - len(req.prompt))], jnp.int32)
-            self._rng_counter += 1
-            key = jax.random.fold_in(
-                jax.random.PRNGKey(req.seed), self._rng_counter)
+            # reproducible sampling: the key chain is a pure function of
+            # the request's seed (fold 0 draws the first token, the rest
+            # of the stream advances per step in the chunk scan)
+            key = jax.random.PRNGKey(req.seed)
             cache, first = self._prefill_fn(Sb)(
                 self.params, self._cache, prompt,
                 jnp.asarray([len(req.prompt)], jnp.int32),
-                jnp.int32(slot), jnp.float32(req.temperature), key)
+                jnp.int32(slot), jnp.float32(req.temperature),
+                jax.random.fold_in(key, 0))
             self._cache = cache
             first_host = int(first)
             self._token = self._token.at[slot].set(first_host)
             self._pos = self._pos.at[slot].set(len(req.prompt))
             self._temp = self._temp.at[slot].set(req.temperature)
+            self._keys = self._keys.at[slot].set(jax.random.fold_in(key, 1))
             self._eos = self._eos.at[slot].set(
                 -1 if req.eos_id is None else req.eos_id)
             req.tokens.append(first_host)
@@ -339,12 +363,10 @@ class ContinuousEngine:
             self._admit()
             if all(r is None for r in self._requests):
                 continue
-            self._rng_counter += 1
-            key = jax.random.fold_in(self._key, self._rng_counter)
-            (self._cache, self._token, self._pos, self._done,
+            (self._cache, self._token, self._pos, self._done, self._keys,
              toks) = self._step_fn(self.params, self._cache, self._token,
                                    self._pos, self._temp, self._eos,
-                                   self._done, key)
+                                   self._done, self._keys)
             toks_host = np.asarray(toks)            # [slots, chunk]
             for slot, req in enumerate(self._requests):
                 if req is None:

@@ -397,6 +397,31 @@ def prefill_ragged(cfg: ModelConfig, params, cache, prompts, lengths,
     return cache, head_logits(params, last)[:, 0]
 
 
+def _filter_topk_topp(logits, top_k: int, top_p: float):
+    """Mask [B, V] logits to the top-k / nucleus sets (no-op when both are
+    off).  ONE descending argsort serves both filters, and masking by RANK
+    (not by a logit-value threshold) keeps exactly the contract sets even
+    when logits tie at the cutoff."""
+    if not top_k and top_p <= 0.0:
+        return logits
+    order = jnp.argsort(-logits, axis=-1)                    # [B, V]
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    V = logits.shape[-1]
+    keep_sorted = jnp.ones_like(sorted_logits, dtype=bool)
+    if top_k:
+        keep_sorted &= jnp.arange(V)[None, :] < top_k
+    if top_p > 0.0:
+        # nucleus: smallest prefix whose mass reaches top_p (the top
+        # token's mass_before is 0 < top_p, so it always survives)
+        probs = jax.nn.softmax(sorted_logits.astype(jnp.float32),
+                               axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted &= mass_before < top_p
+    inv = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
+
+
 def _select_token(logits, key, temperature: float, top_k: int,
                   top_p: float = 0.0):
     """Greedy (temperature == 0) or temperature/top-k/top-p sampling.
@@ -407,27 +432,7 @@ def _select_token(logits, key, temperature: float, top_k: int,
     always survives).  Composes with top_k (both filters apply)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k or top_p > 0.0:
-        # ONE descending argsort serves both filters, and masking by RANK
-        # (not by a logit-value threshold) keeps exactly the contract
-        # sets even when logits tie at the cutoff
-        order = jnp.argsort(-logits, axis=-1)                    # [B, V]
-        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
-        V = logits.shape[-1]
-        keep_sorted = jnp.ones_like(sorted_logits, dtype=bool)
-        if top_k:
-            keep_sorted &= jnp.arange(V)[None, :] < top_k
-        if top_p > 0.0:
-            # nucleus: smallest prefix whose mass reaches top_p (the top
-            # token's mass_before is 0 < top_p, so it always survives)
-            probs = jax.nn.softmax(sorted_logits.astype(jnp.float32),
-                                   axis=-1)
-            mass_before = jnp.cumsum(probs, axis=-1) - probs
-            keep_sorted &= mass_before < top_p
-        inv = jnp.argsort(order, axis=-1)
-        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
-        logits = jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
+    logits = _filter_topk_topp(logits / temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
