@@ -188,7 +188,17 @@ def test_core_devices_allocatable_on_v4(tmp_path):
     spec = json.load(open(state.cdi.claim_spec_path(UID)))
     env = dict(e.split("=", 1) for e in
                spec["devices"][0]["containerEdits"]["env"])
-    assert env["TPU_VISIBLE_CORES"] == "0:0"
+    # capacity-backed, not hardware-isolated (no libtpu per-core
+    # visibility exists): the core's HBM share rides the enforced
+    # HBM-limit path, co-tenancy is enabled, and no invented
+    # TPU_VISIBLE_CORES contract is emitted
+    assert "TPU_VISIBLE_CORES" not in env
+    assert env["TPU_ALLOW_MULTIPLE_LIBTPU_LOAD"] == "1"
+    half_hbm = int(env["TPU_HBM_LIMIT_BYTES_0"])
+    assert half_hbm > 0
+    mib = half_hbm // (1 << 20)
+    assert env["LIBTPU_INIT_ARGS"] == \
+        f"--xla_tpu_max_hbm_size_mib={mib}"
 
 
 def test_subslice_config_on_full_chip_rejected(tmp_path):
@@ -304,7 +314,14 @@ def test_mixed_chip_core_group_unions_visible_chips(tmp_path):
                                d["containerEdits"].get("env", []))
                for d in spec["devices"]}
     assert by_name[f"{UID}-tpu-0"]["TPU_VISIBLE_CHIPS"] == "0,1"
-    assert by_name[f"{UID}-tpu-1-core-0"]["TPU_VISIBLE_CORES"] == "1:0"
+    core_env = by_name[f"{UID}-tpu-1-core-0"]
+    assert "TPU_VISIBLE_CORES" not in core_env
+    assert "TPU_HBM_LIMIT_BYTES_1" in core_env
+    # a group holding a full (unlimited) chip must NOT get the
+    # container-wide LIBTPU_INIT_ARGS cap — it would cap the exclusive
+    # chip to the core's share (review regression)
+    for env in by_name.values():
+        assert "LIBTPU_INIT_ARGS" not in env
 
 
 def test_torn_claim_spec_regenerated_on_idempotent_prepare(tmp_path):

@@ -52,6 +52,24 @@ from tpu_dra.util.fsutil import atomic_write
 SLOT_DIR_CONTAINER_PATH = "/var/run/tpu-mp"
 
 
+def hbm_defense_env(limits: dict[int, int]) -> dict[str, str]:
+    """LIBTPU_INIT_ARGS defense-in-depth for per-chip HBM budgets (VERDICT
+    r02 item 7): libtpu reads the flag at init regardless of workload
+    cooperation.  Emitted ONLY for uniform budgets — the container-wide
+    flag can't be chip-scoped, and the launcher shim defers to any
+    pre-existing ``--xla_tpu_max_hbm_size_mib``, so a min-of-limits flag
+    would permanently over-cap a process pinned to a looser (or
+    unlimited) chip.  Heterogeneous budgets stay shim-only (per-chip
+    scoping via TPU_VISIBLE_CHIPS, launcher.apply_hbm_limits).  The ONE
+    place this uniformity rule lives; callers must pass every budget the
+    container will see (an unlimited chip in the same group ⇒ call with
+    nothing / skip)."""
+    if not limits or len(set(limits.values())) != 1:
+        return {}
+    mib = max(next(iter(limits.values())) // (1 << 20), 1)
+    return {"LIBTPU_INIT_ARGS": f"--xla_tpu_max_hbm_size_mib={mib}"}
+
+
 def _group_id(claim_uid: str, uuids: list[str]) -> str:
     """claimUID + sha256(sorted uuids)[:5] — the reference's per-config MPS
     daemon ID scheme (sharing.go:186-289)."""
@@ -125,24 +143,14 @@ class MultiProcessManager:
             for uuid, limit in sorted(limits.items()):
                 edits.env[f"TPU_HBM_LIMIT_BYTES_{minor_of[uuid]}"] = \
                     str(limit)
-            # Defense-in-depth (VERDICT r02 item 7): carry the bound in
-            # LIBTPU_INIT_ARGS directly, so libtpu reads it at init even if
-            # the workload never calls launcher.init_tpu_workload().  Only
-            # when the per-chip limits are UNIFORM: the container-wide flag
-            # can't be chip-scoped, and the launcher shim defers to any
-            # pre-existing --xla_tpu_max_hbm_size_mib — a min-of-limits
-            # flag would permanently over-cap a process pinned to a
-            # looser chip.  Heterogeneous limits stay shim-only (per-chip
-            # scoping via TPU_VISIBLE_CHIPS, apply_hbm_limits).
-            # Precedence: CDI env is appended to the OCI spec after
-            # pod-spec env, so on duplicate keys most runtimes resolve to
-            # this value — a pod that sets its own LIBTPU_INIT_ARGS (other
-            # xla tunables) should include its bound explicitly, or call
-            # the launcher shim, which appends the flag when absent.
-            if len(set(limits.values())) == 1:
-                mib = max(next(iter(limits.values())) // (1 << 20), 1)
-                edits.env["LIBTPU_INIT_ARGS"] = \
-                    f"--xla_tpu_max_hbm_size_mib={mib}"
+            # Precedence of the defense-in-depth flag: CDI env is appended
+            # to the OCI spec after pod-spec env, so on duplicate keys most
+            # runtimes resolve to this value — a pod that sets its own
+            # LIBTPU_INIT_ARGS (other xla tunables) should include its
+            # bound explicitly, or call the launcher shim, which appends
+            # the flag when absent.
+            edits.env.update(hbm_defense_env(
+                {minor_of[u]: lim for u, lim in limits.items()}))
         return edits
 
     def _slots_base(self) -> str:
