@@ -1,0 +1,128 @@
+"""Flash-attention kernel autotune sweep — run on a real TPU.
+
+Measures the forward and backward variants across block sizes at the bench
+shape (VERDICT r03 target: fwd >= 60% MFU, fwd+bwd >= 50% effective) and
+prints one JSON line per configuration plus a final ``best`` summary.
+Use the winners to set the defaults in ``pallas_kernels.py`` /
+``bench.py section_flash``.
+
+    python hack/flash_tune.py            # full sweep (bench shape)
+    python hack/flash_tune.py --quick    # fwd sweep only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def time_op(fn, *args, iters=50, warmup=2):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--bh", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=50)
+    args = ap.parse_args()
+
+    from tpu_dra.tpulib.topology import family_for_jax_device
+    from tpu_dra.workloads.pallas_kernels import flash_attention
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(json.dumps({"error": f"need a TPU, got {dev.platform}"}))
+        return 1
+    fam = family_for_jax_device(dev)
+    peak = fam.peak_bf16_flops if fam else None
+
+    bh, s, d = args.bh, args.seq, args.dim
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, bh, s, d), jnp.bfloat16)
+               for kk in ks)
+    flops_fwd = 2 * bh * s * s * d          # causal: half the 4·BH·S²·D
+
+    def mfu(tflops):
+        return round(100 * tflops * 1e12 / peak, 2) if peak else None
+
+    results = []
+    # forward sweep
+    for bq in (512, 1024, 2048):
+        for bk in (256, 512, 1024):
+            if bq > s or bk > s:
+                continue
+            try:
+                secs = time_op(
+                    lambda x: flash_attention(x, k, v, causal=True,
+                                              bq=bq, bk=bk),
+                    q, iters=args.iters)
+            except Exception as exc:  # noqa: BLE001 — record and continue
+                print(json.dumps({"fwd": [bq, bk],
+                                  "error": repr(exc)[:200]}))
+                continue
+            tf = flops_fwd / secs / 1e12
+            rec = {"fwd": [bq, bk], "tflops": round(tf, 2),
+                   "mfu_pct": mfu(tf), "us": round(secs * 1e6, 1)}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    best_fwd = max((r for r in results if "fwd" in r),
+                   key=lambda r: r["tflops"], default=None)
+
+    if not args.quick:
+        # backward sweep: impl × (fwd-block choice feeding the residuals)
+        for impl in ("split", "fused"):
+            for bq in (256, 512, 1024):
+                for bk in (256, 512, 1024):
+                    def fwd_bwd(x, bq=bq, bk=bk, impl=impl):
+                        def f(q_, k_, v_):
+                            return flash_attention(
+                                q_, k_, v_, causal=True, bq=bq, bk=bk,
+                                bwd_impl=impl)
+                        out, vjp = jax.vjp(f, x, k, v)
+                        dq, dk, dv = vjp(jnp.ones_like(out))
+                        return dq + dk + dv
+                    try:
+                        secs = time_op(fwd_bwd, q,
+                                       iters=max(args.iters // 3, 10))
+                    except Exception as exc:  # noqa: BLE001
+                        print(json.dumps({"bwd": [impl, bq, bk],
+                                          "error": repr(exc)[:200]}))
+                        continue
+                    tf = 3 * flops_fwd / secs / 1e12
+                    rec = {"bwd": [impl, bq, bk],
+                           "tflops_effective": round(tf, 2),
+                           "mfu_pct": mfu(tf),
+                           "us": round(secs * 1e6, 1)}
+                    results.append(rec)
+                    print(json.dumps(rec), flush=True)
+
+    best_bwd = max((r for r in results if "bwd" in r),
+                   key=lambda r: r["tflops_effective"], default=None)
+    print(json.dumps({"best_fwd": best_fwd, "best_bwd": best_bwd,
+                      "device": getattr(dev, "device_kind", "")}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

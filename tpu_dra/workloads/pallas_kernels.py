@@ -259,6 +259,39 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
         dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
+def _bwd_kv_block(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref, dk_acc,
+                  dv_acc, i, j, *, bq: int, bk: int, masked: bool,
+                  dqp_ref=None):
+    """ONE transposed backward block, shared by the split dK/dV kernel and
+    the fused kernel: recompute Pᵀ from k·qsᵀ, accumulate dV += Pᵀ·dO and
+    dK += dSᵀ·qs; with ``dqp_ref`` also write this block's dQ partial
+    dSᵀᵀ·K (the fused kernel's extra output).  All dots are MXU-native
+    A·Bᵀ / A·B forms; l2/dd arrive as [1, bq] row vectors (see the kernel
+    docstrings for the orientation rationale)."""
+    s2t = jax.lax.dot_general(                  # k·qsᵀ  [bk, bq]
+        k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    pt = jnp.exp2(s2t - l2_ref[0])              # row-broadcast [1, bq]
+    if masked:
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+        rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+        pt = jnp.where(rows >= cols, pt, 0.0)
+    dv_acc[:] += jax.lax.dot_general(           # Pᵀ·dO  [bk, d]
+        pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dpt = jax.lax.dot_general(                  # V·dOᵀ  [bk, bq]
+        v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dst = pt * (dpt - dd_ref[0])
+    dk_acc[:] += jax.lax.dot_general(           # dSᵀ·qs  [bk, d]
+        dst.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if dqp_ref is not None:
+        dqp_ref[0, 0] = jax.lax.dot_general(    # dSᵀᵀ·K  [bq, d]
+            dst.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
+
+
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
                            dk_ref, dv_ref, dk_acc, dv_acc, *, q_steps: int,
                            causal: bool, bq: int, bk: int):
@@ -280,24 +313,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute(masked: bool):
-        s2t = jax.lax.dot_general(                  # k·qsᵀ  [bk, bq]
-            k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        pt = jnp.exp2(s2t - l2_ref[0])              # row-broadcast [1, bq]
-        if masked:
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
-            pt = jnp.where(rows >= cols, pt, 0.0)
-        dv_acc[:] += jax.lax.dot_general(           # Pᵀ·dO  [bk, d]
-            pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dpt = jax.lax.dot_general(                  # V·dOᵀ  [bk, bq]
-            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dst = pt * (dpt - dd_ref[0])
-        dk_acc[:] += jax.lax.dot_general(           # dSᵀ·qs  [bk, d]
-            dst.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _bwd_kv_block(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
+                      dk_acc, dv_acc, i, j, bq=bq, bk=bk, masked=masked)
 
     if not causal:
         _compute(masked=False)
@@ -316,12 +333,69 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
+                            dk_ref, dv_ref, dqp_ref, dk_acc, dv_acc, *,
+                            q_steps: int, causal: bool, bq: int, bk: int):
+    """Fused backward: dK, dV AND per-k-block dQ partials in ONE pass.
+
+    The split kernel pair recomputes the transposed score/probability
+    block (s2t → pt → dpt → dst) TWICE — once in the dq kernel, once in
+    dK/dV.  Here the recompute happens once and all three gradients come
+    out of it: 5 MXU passes per block instead of 7, and one softmax
+    recompute on the VPU instead of two.  The price is dq's cross-j
+    accumulation — it cannot live in scratch when j is the outer axis, so
+    each (j, i) step writes its dq contribution dstᵀ·K to its own
+    ``dqp[b, j, i·bq:, :]`` slot (bf16; never revisited) and XLA reduces
+    over j afterwards.  Orientation, masking and bounds are exactly the
+    dK/dV kernel's."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute(masked: bool):
+        _bwd_kv_block(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
+                      dk_acc, dv_acc, i, j, bq=bq, bk=bk, masked=masked,
+                      dqp_ref=dqp_ref)
+
+    def _zero_dqp():
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    if not causal:
+        _compute(masked=False)
+    else:
+        run = (i + 1) * bq - 1 >= j * bk
+        straddles = (j + 1) * bk - 1 > i * bq
+        pl.when(run & straddles)(lambda: _compute(masked=True))
+        pl.when(run & jnp.logical_not(straddles))(
+            lambda: _compute(masked=False))
+        # skipped blocks contribute nothing to dq, but their partial slot
+        # is still read by the XLA reduction — write zeros, never garbage
+        pl.when(jnp.logical_not(run))(_zero_dqp)
+
+    @pl.when(i == q_steps - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc[:] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
-                    interpret: bool, g_l2=None):
+                    interpret: bool, g_l2=None, bwd_impl: str = "split"):
     """Pallas flash backward: O(S·D) HBM residency, two kernels (dQ over k
     blocks; dK/dV over q blocks), each recomputing its score block on the
     MXU instead of materializing the [S, S] probability matrix the way the
     XLA oracle (_attn_reference) does.
+
+    ``bwd_impl="fused"`` selects the single-pass kernel
+    (_flash_bwd_fused_kernel): one score recompute feeds dK, dV and
+    per-k-block dQ partials (XLA-reduced afterwards) — 5 MXU passes per
+    block instead of 7 and half the VPU softmax recompute, against extra
+    HBM traffic for the [n_j, S, D] bf16 partials.  Which wins is
+    shape/VMEM dependent: measure with hack/flash_tune.py on the chip
+    before flipping any default.
 
     Backward blocks are ASYMMETRIC, independent of the forward's 1024²
     sweet spot: the inner streamed axis stays at 256 and the accumulator
@@ -339,6 +413,9 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     in its own [BH, Sk, D] slot — no revisited output blocks, no
     cross-head races) and the group sum down to [BHkv, Sk, D] happens in
     one XLA reshape+sum."""
+    if bwd_impl not in ("split", "fused"):
+        raise ValueError(f"bwd_impl must be 'split' or 'fused', "
+                         f"got {bwd_impl!r}")
     bh, s, d = q.shape
     bhkv, sk = k.shape[0], k.shape[1]
     assert bh % bhkv == 0, (bh, bhkv)
@@ -388,6 +465,40 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     # vectors (free reshape: (BH, S, 1) and (BH, 1, S) share a layout).
     l2_row = l2.reshape(bh, 1, s)
     dd_row = dd.reshape(bh, 1, s)
+    if bwd_impl == "fused":
+        bq_f, bk_f = bq_kv, bk_kv
+        n_j = sk // bk_f
+        dk, dv, dqp = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, q_steps=s // bq_f,
+                              causal=causal, bq=bq_f, bk=bk_f),
+            grid=(bh, n_j, s // bq_f),
+            in_specs=[
+                pl.BlockSpec((1, bq_f, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, bk_f, d), kv_map_kv),
+                pl.BlockSpec((1, bk_f, d), kv_map_kv),
+                pl.BlockSpec((1, bq_f, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq_f), lambda b, j, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, bq_f), lambda b, j, i: (b, 0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk_f, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, bk_f, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, 1, bq_f, d), lambda b, j, i: (b, j, i, 0)),
+            ],
+            out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+                       jax.ShapeDtypeStruct((bh, n_j, s, d), q.dtype)],
+            scratch_shapes=[pltpu.VMEM((bk_f, d), jnp.float32),
+                            pltpu.VMEM((bk_f, d), jnp.float32)],
+            interpret=interpret,
+            compiler_params=compiler_params,
+        )(qs, k, v, g, l2_row, dd_row)
+        dq = (dqp.astype(jnp.float32).sum(axis=1) * scale).astype(q.dtype)
+        if grp > 1:
+            dk = dk.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
+            dv = dv.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
+            dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
+        return dq, dk, dv
     bq, bk = bq_dq, bk_dq
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, k_steps=sk // bk,
@@ -451,46 +562,47 @@ def _attn_reference(q, k, v, *, causal: bool):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attn(q, k, v, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attn(q, k, v, causal, bq, bk, interpret, bwd_impl):
     out, _ = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                              interpret=interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, bq, bk, interpret):
+def _flash_vjp_fwd(q, k, v, causal, bq, bk, interpret, bwd_impl):
     out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                               interpret=interpret)
     return out, (q, k, v, out, l2)
 
 
-def _flash_vjp_bwd(causal, bq, bk, interpret, res, g):
+def _flash_vjp_bwd(causal, bq, bk, interpret, bwd_impl, res, g):
     q, k, v, out, l2 = res
     return _flash_attn_bwd(q, k, v, out, l2, g, causal=causal, bq=bq,
-                           bk=bk, interpret=interpret)
+                           bk=bk, interpret=interpret, bwd_impl=bwd_impl)
 
 
 _flash_attn.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attn_lse(q, k, v, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attn_lse(q, k, v, causal, bq, bk, interpret, bwd_impl):
     out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                               interpret=interpret)
     return out, l2[..., 0]
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, bq, bk, interpret):
+def _flash_lse_vjp_fwd(q, k, v, causal, bq, bk, interpret, bwd_impl):
     out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                               interpret=interpret)
     return (out, l2[..., 0]), (q, k, v, out, l2)
 
 
-def _flash_lse_vjp_bwd(causal, bq, bk, interpret, res, gs):
+def _flash_lse_vjp_bwd(causal, bq, bk, interpret, bwd_impl, res, gs):
     g_out, g_l2 = gs
     q, k, v, out, l2 = res
     return _flash_attn_bwd(q, k, v, out, l2, g_out, causal=causal, bq=bq,
-                           bk=bk, interpret=interpret, g_l2=g_l2)
+                           bk=bk, interpret=interpret, g_l2=g_l2,
+                           bwd_impl=bwd_impl)
 
 
 _flash_attn_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -514,9 +626,11 @@ def _validate_and_fold(q, k, v, causal):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "bq", "bk", "interpret"))
+                   static_argnames=("causal", "bq", "bk", "interpret",
+                                    "bwd_impl"))
 def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
-                             bk: int = 1024, interpret: bool = False):
+                             bk: int = 1024, interpret: bool = False,
+                             bwd_impl: str = "split"):
     """``flash_attention`` that also returns the per-row base-2 logsumexp
     ``[B, H, S]`` — the merge statistic for composing partial attentions
     (ring steps, sharded KV): given normalized partials (oᵃ, l2ᵃ), (oᵇ,
@@ -526,14 +640,17 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
     backward kernels."""
     b, h, s, d = q.shape
     qf, kf, vf = _validate_and_fold(q, k, v, causal)
-    out, l2 = _flash_attn_lse(qf, kf, vf, causal, bq, bk, interpret)
+    out, l2 = _flash_attn_lse(qf, kf, vf, causal, bq, bk, interpret,
+                              bwd_impl)
     return out.reshape(b, h, s, d), l2.reshape(b, h, s)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "bq", "bk", "interpret"))
+                   static_argnames=("causal", "bq", "bk", "interpret",
+                                    "bwd_impl"))
 def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
-                    bk: int = 1024, interpret: bool = False):
+                    bk: int = 1024, interpret: bool = False,
+                    bwd_impl: str = "split"):
     """Memory-efficient attention for ``[B, H, S, D]`` q/k/v.
 
     Forward is the Pallas online-softmax kernel (HBM stays O(S·D); the
@@ -551,7 +668,7 @@ def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
     """
     b, h, s, d = q.shape
     qf, kf, vf = _validate_and_fold(q, k, v, causal)
-    out = _flash_attn(qf, kf, vf, causal, bq, bk, interpret)
+    out = _flash_attn(qf, kf, vf, causal, bq, bk, interpret, bwd_impl)
     return out.reshape(b, h, s, d)
 
 
