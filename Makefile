@@ -22,6 +22,11 @@ drive: native
 	$(PYTHON) hack/drive_plugin.py
 	$(PYTHON) hack/drive_daemon.py
 
+# claim->Running with every in-repo component real (scheduler/kubelet
+# simulated); the kind e2e (hack/e2e-kind.sh) covers the rest with docker
+e2e-inprocess:
+	$(PYTHON) hack/e2e_inprocess.py --pods 50
+
 proto:
 	cd tpu_dra/kubeletplugin/proto && \
 	protoc --python_out=. dra_v1beta1.proto pluginregistration.proto
