@@ -16,25 +16,17 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
-
-def time_op(fn, *args, iters=50, warmup=2):
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
+# ONE timing implementation: collectives._time_op iterates inside a jitted
+# loop and closes the async window with a host readback, which is what
+# makes numbers comparable with bench.py section_flash on relayed
+# backends (block_until_ready does not round-trip there)
+from tpu_dra.workloads.collectives import _time_op  # noqa: E402
 
 
 def main():
@@ -72,7 +64,7 @@ def main():
             if bq > s or bk > s:
                 continue
             try:
-                secs = time_op(
+                secs = _time_op(
                     lambda x: flash_attention(x, k, v, causal=True,
                                               bq=bq, bk=bk),
                     q, iters=args.iters)
@@ -90,32 +82,44 @@ def main():
                    key=lambda r: r["tflops"], default=None)
 
     if not args.quick:
-        # backward sweep: impl × (fwd-block choice feeding the residuals)
-        for impl in ("split", "fused"):
-            for bq in (256, 512, 1024):
-                for bk in (256, 512, 1024):
-                    def fwd_bwd(x, bq=bq, bk=bk, impl=impl):
-                        def f(q_, k_, v_):
-                            return flash_attention(
-                                q_, k_, v_, causal=True, bq=bq, bk=bk,
-                                bwd_impl=impl)
-                        out, vjp = jax.vjp(f, x, k, v)
-                        dq, dk, dv = vjp(jnp.ones_like(out))
-                        return dq + dk + dv
-                    try:
-                        secs = time_op(fwd_bwd, q,
-                                       iters=max(args.iters // 3, 10))
-                    except Exception as exc:  # noqa: BLE001
-                        print(json.dumps({"bwd": [impl, bq, bk],
-                                          "error": repr(exc)[:200]}))
-                        continue
-                    tf = 3 * flops_fwd / secs / 1e12
-                    rec = {"bwd": [impl, bq, bk],
-                           "tflops_effective": round(tf, 2),
-                           "mfu_pct": mfu(tf),
-                           "us": round(secs * 1e6, 1)}
-                    results.append(rec)
-                    print(json.dumps(rec), flush=True)
+        # backward sweep over the REAL knobs: bwd_blocks = (bq_dq, bk_dq,
+        # bq_kv, bk_kv) replaces the sweet-spot caps inside
+        # _flash_attn_bwd — sweeping flash_attention's bq/bk instead
+        # would silently re-time the capped config under different labels.
+        # The fused path only reads (bq_kv, bk_kv).
+        fwd_blocks = tuple(best_fwd["fwd"]) if best_fwd else (1024, 1024)
+        split_grid = [(dq_q, dq_k, kv_q, kv_k)
+                      for dq_q in (512, 1024) for dq_k in (256, 512)
+                      for kv_q in (128, 256, 512) for kv_k in (512, 1024)]
+        fused_grid = [(1024, 256, kv_q, kv_k)
+                      for kv_q in (128, 256, 512)
+                      for kv_k in (256, 512, 1024)]
+        for impl, grid_blocks in (("split", split_grid),
+                                  ("fused", fused_grid)):
+            for blocks in grid_blocks:
+                def fwd_bwd(x, blocks=blocks, impl=impl):
+                    def f(q_, k_, v_):
+                        return flash_attention(
+                            q_, k_, v_, causal=True, bq=fwd_blocks[0],
+                            bk=fwd_blocks[1], bwd_impl=impl,
+                            bwd_blocks=blocks)
+                    out, vjp = jax.vjp(f, x, k, v)
+                    dq, dk, dv = vjp(jnp.ones_like(out))
+                    return dq + dk + dv
+                try:
+                    secs = _time_op(fwd_bwd, q,
+                                    iters=max(args.iters // 3, 10))
+                except Exception as exc:  # noqa: BLE001
+                    print(json.dumps({"bwd": [impl, *blocks],
+                                      "error": repr(exc)[:200]}))
+                    continue
+                tf = 3 * flops_fwd / secs / 1e12
+                rec = {"bwd": [impl, *blocks],
+                       "tflops_effective": round(tf, 2),
+                       "mfu_pct": mfu(tf),
+                       "us": round(secs * 1e6, 1)}
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
 
     best_bwd = max((r for r in results if "bwd" in r),
                    key=lambda r: r["tflops_effective"], default=None)

@@ -111,6 +111,35 @@ def test_flash_fused_bwd_matches_split(causal, bq, bk):
         assert float(err) < 4e-2, (name, float(err))
 
 
+@pytest.mark.parametrize("impl", ["split", "fused"])
+def test_flash_bwd_blocks_override(impl):
+    """Explicit bwd_blocks (the autotune knob) must change only speed,
+    never gradients — and an invalid bwd_impl must fail loudly instead of
+    silently timing the split path."""
+    b, h, s, d = 1, 1, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(31), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks)
+
+    def loss(blocks):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, bq=64, bk=64, interpret=True,
+                bwd_impl=impl, bwd_blocks=blocks).astype(jnp.float32))
+        return f
+
+    got = jax.grad(loss((128, 64, 64, 128)), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(None), argnums=(0, 1, 2))(q, k, v)
+    for g_, r_ in zip(got, want):
+        assert float(jnp.max(jnp.abs(g_.astype(jnp.float32) -
+                                     r_.astype(jnp.float32)))) < 4e-2
+
+    with pytest.raises(ValueError, match="bwd_impl"):
+        jax.grad(lambda q_: jnp.sum(flash_attention(
+            q_, k, v, causal=True, bq=64, bk=64, interpret=True,
+            bwd_impl="Fused").astype(jnp.float32)))(q)
+
+
 def test_flash_fused_bwd_gqa_and_lse():
     """Fused backward under GQA (group-summed dk/dv partials) and through
     the lse cotangent fold — against the split kernels."""

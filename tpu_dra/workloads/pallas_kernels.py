@@ -383,7 +383,8 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, l2_ref, dd_ref,
 
 
 def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
-                    interpret: bool, g_l2=None, bwd_impl: str = "split"):
+                    interpret: bool, g_l2=None, bwd_impl: str = "split",
+                    bwd_blocks=None):
     """Pallas flash backward: O(S·D) HBM residency, two kernels (dQ over k
     blocks; dK/dV over q blocks), each recomputing its score block on the
     MXU instead of materializing the [S, S] probability matrix the way the
@@ -430,9 +431,16 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     # The caller's bq/bk still cap the backward blocks (tests pass tiny
     # blocks to exercise the multi-block causal paths under interpret);
     # production callers pass >= the asymmetric sweet spot and land
-    # exactly on it.
-    bq_dq, bk_dq = _cap(s, min(bq, 1024)), _cap(sk, min(bk, 256))
-    bq_kv, bk_kv = _cap(s, min(bq, 256)), _cap(sk, min(bk, 1024))
+    # exactly on it.  ``bwd_blocks`` = (bq_dq, bk_dq, bq_kv, bk_kv)
+    # overrides the sweet-spot caps entirely — the autotune knob
+    # (hack/flash_tune.py): without it the sweep would silently re-time
+    # the capped config under different labels.
+    if bwd_blocks is not None:
+        bq_dq, bk_dq = _cap(s, bwd_blocks[0]), _cap(sk, bwd_blocks[1])
+        bq_kv, bk_kv = _cap(s, bwd_blocks[2]), _cap(sk, bwd_blocks[3])
+    else:
+        bq_dq, bk_dq = _cap(s, min(bq, 1024)), _cap(sk, min(bk, 256))
+        bq_kv, bk_kv = _cap(s, min(bq, 256)), _cap(sk, min(bk, 1024))
     scale = d ** -0.5
     qs = (q * (scale * _LOG2E)).astype(q.dtype)
     # D_i = rowsum(dO ∘ O): one fused elementwise pass, [BH, S, 1]
@@ -494,11 +502,7 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
             compiler_params=compiler_params,
         )(qs, k, v, g, l2_row, dd_row)
         dq = (dqp.astype(jnp.float32).sum(axis=1) * scale).astype(q.dtype)
-        if grp > 1:
-            dk = dk.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
-            dv = dv.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
-            dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
-        return dq, dk, dv
+        return _group_sum_kv(dq, dk, dv, bhkv, grp, sk, d)
     bq, bk = bq_dq, bk_dq
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, k_steps=sk // bk,
@@ -542,10 +546,17 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
         interpret=interpret,
         compiler_params=compiler_params,
     )(qs, k, v, g, l2_row, dd_row)
+    return _group_sum_kv(dq, dk, dv, bhkv, grp, sk, d)
+
+
+def _group_sum_kv(dq, dk, dv, bhkv, grp, sk, d):
+    """GQA tail shared by both backward impls: reduce the per-q-head
+    dk/dv back to the kv-head resolution (fp32 accumulate)."""
     if grp > 1:
-        dk = dk.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
-        dv = dv.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1)
-        dk, dv = dk.astype(k.dtype), dv.astype(v.dtype)
+        dk = dk.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1) \
+            .astype(dk.dtype)
+        dv = dv.reshape(bhkv, grp, sk, d).astype(jnp.float32).sum(1) \
+            .astype(dv.dtype)
     return dq, dk, dv
 
 
@@ -562,47 +573,53 @@ def _attn_reference(q, k, v, *, causal: bool):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attn(q, k, v, causal, bq, bk, interpret, bwd_impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attn(q, k, v, causal, bq, bk, interpret, bwd_impl, bwd_blocks):
     out, _ = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                              interpret=interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, bq, bk, interpret, bwd_impl):
+def _flash_vjp_fwd(q, k, v, causal, bq, bk, interpret, bwd_impl,
+                   bwd_blocks):
     out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                               interpret=interpret)
     return out, (q, k, v, out, l2)
 
 
-def _flash_vjp_bwd(causal, bq, bk, interpret, bwd_impl, res, g):
+def _flash_vjp_bwd(causal, bq, bk, interpret, bwd_impl, bwd_blocks,
+                   res, g):
     q, k, v, out, l2 = res
     return _flash_attn_bwd(q, k, v, out, l2, g, causal=causal, bq=bq,
-                           bk=bk, interpret=interpret, bwd_impl=bwd_impl)
+                           bk=bk, interpret=interpret, bwd_impl=bwd_impl,
+                           bwd_blocks=bwd_blocks)
 
 
 _flash_attn.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attn_lse(q, k, v, causal, bq, bk, interpret, bwd_impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attn_lse(q, k, v, causal, bq, bk, interpret, bwd_impl,
+                    bwd_blocks):
     out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                               interpret=interpret)
     return out, l2[..., 0]
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, bq, bk, interpret, bwd_impl):
+def _flash_lse_vjp_fwd(q, k, v, causal, bq, bk, interpret, bwd_impl,
+                       bwd_blocks):
     out, l2 = _flash_attn_fwd(q, k, v, causal=causal, bq=bq, bk=bk,
                               interpret=interpret)
     return (out, l2[..., 0]), (q, k, v, out, l2)
 
 
-def _flash_lse_vjp_bwd(causal, bq, bk, interpret, bwd_impl, res, gs):
+def _flash_lse_vjp_bwd(causal, bq, bk, interpret, bwd_impl, bwd_blocks,
+                       res, gs):
     g_out, g_l2 = gs
     q, k, v, out, l2 = res
     return _flash_attn_bwd(q, k, v, out, l2, g_out, causal=causal, bq=bq,
                            bk=bk, interpret=interpret, g_l2=g_l2,
-                           bwd_impl=bwd_impl)
+                           bwd_impl=bwd_impl, bwd_blocks=bwd_blocks)
 
 
 _flash_attn_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -627,10 +644,10 @@ def _validate_and_fold(q, k, v, causal):
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "bq", "bk", "interpret",
-                                    "bwd_impl"))
+                                    "bwd_impl", "bwd_blocks"))
 def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
                              bk: int = 1024, interpret: bool = False,
-                             bwd_impl: str = "split"):
+                             bwd_impl: str = "split", bwd_blocks=None):
     """``flash_attention`` that also returns the per-row base-2 logsumexp
     ``[B, H, S]`` — the merge statistic for composing partial attentions
     (ring steps, sharded KV): given normalized partials (oᵃ, l2ᵃ), (oᵇ,
@@ -641,16 +658,16 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
     b, h, s, d = q.shape
     qf, kf, vf = _validate_and_fold(q, k, v, causal)
     out, l2 = _flash_attn_lse(qf, kf, vf, causal, bq, bk, interpret,
-                              bwd_impl)
+                              bwd_impl, bwd_blocks)
     return out.reshape(b, h, s, d), l2.reshape(b, h, s)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("causal", "bq", "bk", "interpret",
-                                    "bwd_impl"))
+                                    "bwd_impl", "bwd_blocks"))
 def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
                     bk: int = 1024, interpret: bool = False,
-                    bwd_impl: str = "split"):
+                    bwd_impl: str = "split", bwd_blocks=None):
     """Memory-efficient attention for ``[B, H, S, D]`` q/k/v.
 
     Forward is the Pallas online-softmax kernel (HBM stays O(S·D); the
@@ -668,7 +685,8 @@ def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
     """
     b, h, s, d = q.shape
     qf, kf, vf = _validate_and_fold(q, k, v, causal)
-    out = _flash_attn(qf, kf, vf, causal, bq, bk, interpret, bwd_impl)
+    out = _flash_attn(qf, kf, vf, causal, bq, bk, interpret, bwd_impl,
+                      bwd_blocks)
     return out.reshape(b, h, s, d)
 
 
