@@ -26,6 +26,7 @@ drive: native
 # simulated); the kind e2e (hack/e2e-kind.sh) covers the rest with docker
 e2e-inprocess:
 	$(PYTHON) hack/e2e_inprocess.py --pods 50
+	$(PYTHON) hack/e2e_slice_domain.py
 
 proto:
 	cd tpu_dra/kubeletplugin/proto && \

@@ -1,0 +1,276 @@
+"""Two-node slice-domain bring-up with every in-repo component as a REAL
+separate process.
+
+The multinode e2e test (tests/test_multinode_e2e.py) runs the stack
+in-process; this drives it the way a cluster would: one real controller
+process, two real slice-plugin processes (own gRPC sockets), and two real
+daemon processes (each supervising a native coordd) against one HTTP API
+server — only the kube DaemonSet controller and kubelet are played by the
+script (DS status write + gRPC prepare calls).  Measures the SURVEY §3.3
+rendezvous end to end: TpuSliceDomain creation → domain Ready → all
+channel claims prepared.  Writes ``E2E_SLICE_r{N}.json`` with ``--out``.
+
+    python hack/e2e_slice_domain.py --out E2E_SLICE_r03.json
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import grpc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra.k8s import (  # noqa: E402
+    DAEMONSETS,
+    NODES,
+    RESOURCE_CLAIMS,
+    TPU_SLICE_DOMAINS,
+)
+from tpu_dra.k8s.testserver import KubeTestServer  # noqa: E402
+from tpu_dra.kubeletplugin.proto import (  # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.version import SLICE_DRIVER_NAME  # noqa: E402
+
+NS = "default"
+DRIVER_NS = "tpu-dra-driver"
+
+
+def wait_until(pred, timeout=30.0, step=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        val = pred()
+        if val:
+            return val
+        time.sleep(step)
+    return None
+
+
+def claim_obj(fake, name, device, kind, domain_uid, node, ns=NS):
+    obj = fake.create(RESOURCE_CLAIMS, {
+        "metadata": {"name": name, "namespace": ns}, "spec": {}})
+    obj["status"] = {"allocation": {"devices": {
+        "results": [{"request": "r0", "driver": SLICE_DRIVER_NAME,
+                     "pool": node, "device": device}],
+        "config": [{"requests": ["r0"], "opaque": {
+            "driver": SLICE_DRIVER_NAME,
+            "parameters": {
+                "apiVersion": "resource.tpu.google.com/v1beta1",
+                "kind": kind, "domainID": domain_uid}}}],
+    }}}
+    fake.update_status(RESOURCE_CLAIMS, obj)
+    return obj["metadata"]["uid"]
+
+
+def grpc_prepare(sock, uid, name, ns, timeout=90.0):
+    """Prepare one claim; returns its NodePrepareResourceResponse entry.
+
+    Retries only socket-not-up / blocked-on-readiness codes; any other
+    RPC failure is terminal and raises immediately so a broken plugin
+    fails the e2e fast instead of burning the deadline.  Asserting the
+    uid is IN the response map matters: protobuf map access inserts a
+    default (error=='') entry, which would turn a missing result into a
+    vacuous pass."""
+    retryable = (grpc.StatusCode.UNAVAILABLE,
+                 grpc.StatusCode.DEADLINE_EXCEEDED)
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with grpc.insecure_channel(f"unix:{sock}") as ch:
+                fn = ch.unary_unary(
+                    "/v1beta1.DRAPlugin/NodePrepareResources",
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=(
+                        dra_pb.NodePrepareResourcesResponse.FromString))
+                req = dra_pb.NodePrepareResourcesRequest()
+                c = req.claims.add()
+                c.uid, c.name, c.namespace = uid, name, ns
+                res = fn(req, timeout=60)
+                assert uid in res.claims, \
+                    f"prepare response missing claim {uid}: {res}"
+                return res.claims[uid]
+        except grpc.RpcError as err:
+            if err.code() not in retryable or time.time() > deadline:
+                raise
+            time.sleep(0.3)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="e2e-slice-", dir="/tmp"))
+    srv = KubeTestServer().start()
+    procs = []
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp / "kubeconfig"))
+        nodes = ["node-a", "node-b"]
+        for n in nodes:
+            srv.fake.create(NODES, {"metadata": {"name": n, "labels": {}}})
+        # synthetic 2-host slice: both roots share the hostnames list
+        roots = {}
+        for i, n in enumerate(nodes):
+            root = tmp / n / "driver-root"
+            (root / "var/lib/tpu").mkdir(parents=True)
+            (root / "var/lib/tpu/tpu-env").write_text(
+                "TPU_ACCELERATOR_TYPE: 'v5litepod-8'\n"
+                "TPU_TOPOLOGY: '2x4'\n"
+                f"TPU_WORKER_ID: '{i}'\n"
+                "TPU_WORKER_HOSTNAMES: 'node-a,node-b'\n")
+            roots[n] = root
+
+        env_base = {**os.environ, "PYTHONPATH": REPO,
+                    "TPU_IGNORE_HOST_ENV": "1"}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.controller.main",
+             "--kubeconfig", kcfg, "--namespace", DRIVER_NS],
+            cwd=REPO, env=env_base))
+        socks = {}
+        for n in nodes:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_dra.plugins.slice.main",
+                 "--kubeconfig", kcfg, "--node-name", n,
+                 "--tpu-driver-root", str(roots[n]),
+                 "--kubelet-plugins-dir", str(tmp / n / "plugins"),
+                 "--kubelet-registry-dir", str(tmp / n / "registry"),
+                 "--cdi-root", str(tmp / n / "cdi")],
+                cwd=REPO, env=env_base))
+            socks[n] = tmp / n / "plugins" / SLICE_DRIVER_NAME / "dra.sock"
+        assert wait_until(lambda: all(s.exists() for s in socks.values()),
+                          30), "plugin sockets never appeared"
+        print("OK controller + 2 slice plugins up (real processes)")
+
+        t_create = time.perf_counter()
+        dom = srv.fake.create(TPU_SLICE_DOMAINS, {
+            "metadata": {"name": "dom", "namespace": NS},
+            "spec": {"numNodes": 2, "channel": {
+                "resourceClaimTemplate": {"name": "dom-channel"}}}})
+        uid = dom["metadata"]["uid"]
+
+        # controller materializes the daemon DS (real controller process)
+        ds = wait_until(lambda: next(
+            (d for d in srv.fake.list(DAEMONSETS, DRIVER_NS)["items"]
+             if d["metadata"].get("labels", {}).get(
+                 "resource.tpu.google.com/sliceDomain") == uid
+             or uid in d["metadata"]["name"]), None), 30)
+        assert ds is not None, "controller never created the daemon DS"
+        print(f"OK daemon DaemonSet created: {ds['metadata']['name']}")
+
+        # kubelet role: channel prepares (block on Ready, retried)
+        chan_results = {}
+
+        def chan_prepare(node, i):
+            cuid = claim_obj(srv.fake, f"chan-{i}", "channel-0",
+                             "SliceChannelConfig", uid, node)
+            chan_results[node] = grpc_prepare(socks[node], cuid,
+                                              f"chan-{i}", NS)
+
+        threads = [threading.Thread(target=chan_prepare, args=(n, i))
+                   for i, n in enumerate(nodes)]
+        for t in threads:
+            t.start()
+
+        # nodes get labeled by the channel prepare → daemon claims prepare
+        for i, n in enumerate(nodes):
+            duid = claim_obj(srv.fake, f"daemon-{i}", "slice-daemon",
+                             "SliceDaemonConfig", uid, n, ns=DRIVER_NS)
+            res = grpc_prepare(socks[n], duid, f"daemon-{i}", DRIVER_NS)
+            assert res.error == "", res.error
+        print("OK daemon claims prepared on both nodes")
+
+        # daemon pods (real processes, native coordd inside)
+        for i, n in enumerate(nodes):
+            settings = (tmp / n / "plugins" / SLICE_DRIVER_NAME /
+                        "domains" / uid)
+            assert settings.is_dir(), f"daemon settings dir missing: " \
+                                      f"{settings}"
+            env = {**env_base,
+                   "SLICE_DOMAIN_UUID": uid, "SLICE_DOMAIN_NAME": "dom",
+                   "SLICE_DOMAIN_NAMESPACE": NS, "NODE_NAME": n,
+                   "POD_IP": f"127.0.0.{10 + i}",
+                   "SLICE_SETTINGS_DIR": str(settings),
+                   "SLICE_COORDINATOR_PORT": str(18480 + i),
+                   "KUBECONFIG": kcfg, "TPU_DRIVER_ROOT": str(roots[n]),
+                   "TPU_IGNORE_HOST_ENV": "1"}
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tpu_dra.daemon.main", "run"],
+                cwd=REPO, env=env))
+
+        # rendezvous: both daemons publish, configs render, coordd READY
+        def ready(port):
+            try:
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready",
+                    timeout=2).read().strip() == b"READY"
+            except OSError:
+                return False
+        assert wait_until(lambda: ready(18480) and ready(18481), 60), \
+            "coordination services never went READY"
+        t_coordd = time.perf_counter()
+        coord = urllib.request.urlopen(
+            "http://127.0.0.1:18480/coordinator", timeout=2
+        ).read().decode()
+        print(f"OK both coordds READY; coordinator={coord}")
+
+        # kube DS controller role: report daemons ready → CR flips Ready
+        ds = srv.fake.get(DAEMONSETS, ds["metadata"]["name"], DRIVER_NS)
+        ds["status"] = {"numberReady": 2}
+        srv.fake.update_status(DAEMONSETS, ds)
+        assert wait_until(lambda: (srv.fake.get(
+            TPU_SLICE_DOMAINS, "dom", NS).get("status") or {}).get(
+                "status") == "Ready", 30), "domain never became Ready"
+        t_ready = time.perf_counter()
+        for t in threads:
+            t.join(90)
+        assert set(chan_results) == set(nodes)
+        for n, r in chan_results.items():
+            assert r.error == "", (n, r.error)
+        t_chans = time.perf_counter()
+        print("OK domain Ready; both blocked channel prepares completed")
+
+        out = {
+            "nodes": 2,
+            "domain_create_to_coordd_ready_s": round(
+                t_coordd - t_create, 3),
+            "domain_create_to_cr_ready_s": round(t_ready - t_create, 3),
+            "domain_create_to_channels_prepared_s": round(
+                t_chans - t_create, 3),
+            "coordinator": coord,
+            "real_components": [
+                "tpu-slice-controller (own process)",
+                "2x slice-domain-kubelet-plugin (own processes, gRPC)",
+                "2x slice-domain-daemon (own processes, native coordd)",
+                "HTTP API server + watch"],
+            "simulated_components": [
+                "kube DaemonSet controller (numberReady status write)",
+                "kubelet (gRPC prepare calls)"],
+        }
+        print(json.dumps(out))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        return 0
+    finally:
+        for p in reversed(procs):
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        srv.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
