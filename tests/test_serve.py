@@ -133,3 +133,51 @@ def test_beam_endpoint(server):
         assert False, "ragged rows must 400"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_metrics_endpoint(server):
+    """/metrics: Prometheus series for requests, token throughput, and
+    latency — the serving counterpart of the driver processes' metrics
+    endpoint (reference controller main.go:194-214)."""
+    _, _, base = server
+    _post(base, {"tokens": [[1, 2], [3]], "steps": 3})
+    body = urllib.request.urlopen(
+        f"{base}/metrics", timeout=10).read().decode()
+    assert "# TYPE tpu_serve_requests_total counter" in body
+    assert 'tpu_serve_requests_total{path="/generate",code="200"}' in body
+    assert "tpu_serve_generated_tokens_total" in body
+    assert "tpu_serve_request_seconds_bucket" in body
+    # bad input lands in the 400 series, not the 200 one (delta-based:
+    # the module-scoped server carries counts from earlier tests)
+    def series_val(text, code):
+        key = f'tpu_serve_requests_total{{path="/generate",code="{code}"}}'
+        for line in text.splitlines():
+            if line.startswith(key):
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    before = series_val(body, 400)
+    with pytest.raises(urllib.error.HTTPError):
+        _post(base, {"tokens": []})
+    body = urllib.request.urlopen(
+        f"{base}/metrics", timeout=10).read().decode()
+    assert series_val(body, 400) == before + 1
+
+
+def test_metrics_include_engine_gauges_when_continuous():
+    from tpu_dra.workloads.serve import serve as serve_fn
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve_fn(cfg, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = srv.server_address
+    try:
+        _post(f"http://{host}:{port}", {"tokens": [[1, 2]], "steps": 2},
+              timeout=180)
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read().decode()
+        assert "tpu_serve_engine_completed 1" in body
+        assert "tpu_serve_engine_tokens_out" in body
+    finally:
+        srv.shutdown()

@@ -21,24 +21,38 @@ POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
                  best first; rows must share one length — beam search has
                  no ragged mode)
 GET  /healthz → "ok"
+GET  /metrics → Prometheus text (version 0.0.4): request counts by
+             path/code, generated-token total, request-latency histogram,
+             and (continuous mode) tpu_serve_engine_* gauges
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from functools import partial
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import jax.numpy as jnp
 
+from tpu_dra.util.metrics import Registry
 from tpu_dra.workloads.decode import beam_decode, decode
 from tpu_dra.workloads.train import ModelConfig
 
-
 # upper bound on one continuous-mode request's wall time (compile included)
 ENGINE_REQUEST_TIMEOUT_S = 600
+
+
+def _count_leaf_tokens(tokens) -> int:
+    """Generated-token count across /generate ([rows][steps]) and /beam
+    ([rows][beams][steps]) response shapes."""
+    if not isinstance(tokens, list):
+        return 0
+    if all(isinstance(t, int) for t in tokens):
+        return len(tokens)
+    return sum(_count_leaf_tokens(t) for t in tokens)
 
 
 def _bucket(n: int, buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)) -> int:
@@ -153,7 +167,36 @@ class DecoderPool:
                 [scores[i].tolist() for i in range(len(rows))])
 
 
-def make_handler(pool: DecoderPool, engine=None):
+class ServeMetrics:
+    """Prometheus series for the inference endpoint (util/metrics
+    registry — same exposition format as the driver processes').  The
+    serving-side counterpart of the controller's /metrics
+    (reference main.go:194-214)."""
+
+    def __init__(self) -> None:
+        self.registry = Registry()
+        self.requests = self.registry.counter(
+            "tpu_serve_requests_total", "HTTP requests", ("path", "code"))
+        self.tokens = self.registry.counter(
+            "tpu_serve_generated_tokens_total", "tokens generated")
+        self.latency = self.registry.histogram(
+            "tpu_serve_request_seconds", "request wall time",
+            # cold requests include JIT compile (tens of seconds) and the
+            # engine timeout is 600s — default buckets top out at 10s and
+            # would collapse every cold hit into +Inf
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+                     5, 10, 30, 60, 120, 300, 600),
+            labels=("path",))
+
+    def observe(self, path: str, code: int, secs: float,
+                tokens: int = 0) -> None:
+        self.requests.inc(path, str(code))
+        self.latency.observe(secs, path)
+        if tokens:
+            self.tokens.inc(by=tokens)
+
+
+def make_handler(pool: DecoderPool, engine=None, metrics=None):
     """``engine`` (a ContinuousEngine) takes over /generate when given:
     every row becomes its own engine request, fanned in via submit_async
     so one HTTP call's rows still decode concurrently."""
@@ -204,6 +247,16 @@ def make_handler(pool: DecoderPool, engine=None):
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, b"ok", "text/plain")
+            elif self.path == "/metrics" and metrics is not None:
+                body = metrics.registry.expose()
+                if engine is not None:
+                    stats = engine.stats()
+                    body += "".join(
+                        f"tpu_serve_engine_{k} {v}\n"
+                        for k, v in stats.items()
+                        if isinstance(v, (int, float)))
+                self._send(200, body.encode(),
+                           "text/plain; version=0.0.4")
             elif self.path.split("?", 1)[0] == "/debug/jax-trace":
                 self._jax_trace()
             else:
@@ -220,7 +273,7 @@ def make_handler(pool: DecoderPool, engine=None):
             import io
             import tarfile
             import tempfile
-            import time as _time
+
             import urllib.parse
 
             q = urllib.parse.urlparse(self.path).query
@@ -238,7 +291,7 @@ def make_handler(pool: DecoderPool, engine=None):
             try:
                 with tempfile.TemporaryDirectory() as td:
                     with jax.profiler.trace(td):
-                        _time.sleep(secs)
+                        time.sleep(secs)
                     buf = io.BytesIO()
                     with tarfile.open(fileobj=buf, mode="w:gz") as tar:
                         tar.add(td, arcname="jax-trace")
@@ -254,18 +307,31 @@ def make_handler(pool: DecoderPool, engine=None):
         def _json_post(self, handle):
             """Shared /generate + /beam plumbing: parse the JSON body,
             call ``handle(req) -> response dict``, map bad input to a
-            400 JSON error."""
+            400 JSON error.  Every request lands in the /metrics series
+            (count by code, wall-time histogram, generated tokens) —
+            recorded BEFORE the response is sent, so a client that has
+            its reply is guaranteed to find the request on a subsequent
+            scrape (observing after the send races the next request on
+            a busy host)."""
+            t0 = time.perf_counter()
+            code, toks = 200, 0
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
-                self._send(200, json.dumps(handle(req)).encode())
+                result = handle(req)
+                toks = _count_leaf_tokens(result.get("tokens"))
+                body = json.dumps(result).encode()
             except (KeyError, ValueError, TypeError,
                     NotImplementedError, json.JSONDecodeError) as exc:
-                self._send(400, json.dumps(
-                    {"error": str(exc)[:300]}).encode())
+                code = 400
+                body = json.dumps({"error": str(exc)[:300]}).encode()
             except RuntimeError as exc:   # engine-side failure, not input
-                self._send(500, json.dumps(
-                    {"error": str(exc)[:300]}).encode())
+                code = 500
+                body = json.dumps({"error": str(exc)[:300]}).encode()
+            if metrics is not None:
+                metrics.observe(self.path, code,
+                                time.perf_counter() - t0, toks)
+            self._send(code, body)
 
         def do_POST(self):
             def eos_of(req):
@@ -323,8 +389,11 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
         from tpu_dra.workloads.continuous import ContinuousEngine
         engine = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
                                   cache_dtype=cache_dtype)
-    srv = ThreadingHTTPServer((host, port), make_handler(pool, engine))
+    metrics = ServeMetrics()
+    srv = ThreadingHTTPServer((host, port),
+                              make_handler(pool, engine, metrics))
     srv.engine = engine               # reachable for stats
+    srv.metrics = metrics
     if engine is not None:
         # srv.shutdown() is the documented stop mechanism — it must also
         # stop the batcher thread and drop the slot cache, or every
@@ -404,7 +473,6 @@ def main(argv=None):
     except KeyboardInterrupt:
         srv.shutdown()
     return 0
-
 
 if __name__ == "__main__":
     raise SystemExit(main())
