@@ -222,6 +222,25 @@ def test_reset_stats_drops_warmup(engine):
     assert s["completed"] == 0 and "latency_p50_ms" not in s
 
 
+def test_int8_weights_and_cache_through_engine(params):
+    """The headline serving quantization (int8 weights + int8 KV cache)
+    must flow through the engine's slot prefill and chunk step, matching
+    the equivalent one-shot ragged decode."""
+    from tpu_dra.workloads.decode import decode
+    from tpu_dra.workloads.quant import quantize_params_int8
+
+    q_params = quantize_params_int8(params)
+    eng = ContinuousEngine(CFG, q_params, slots=2, chunk=2,
+                           cache_dtype="int8")
+    try:
+        toks = eng.submit([1, 2, 3], steps=6)
+        ref = decode(CFG, q_params, jnp.asarray([[1, 2, 3]], jnp.int32),
+                     steps=6, max_len=CFG.max_seq, cache_dtype="int8")
+        assert toks == ref[0].tolist()
+    finally:
+        eng.shutdown()
+
+
 def test_throughput_accounting(engine):
     t0 = time.perf_counter()
     handles = [engine.submit_async([1, 2], steps=6) for _ in range(6)]

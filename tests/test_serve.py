@@ -177,7 +177,9 @@ def test_metrics_include_engine_gauges_when_continuous():
               timeout=180)
         body = urllib.request.urlopen(
             f"http://{host}:{port}/metrics", timeout=10).read().decode()
-        assert "tpu_serve_engine_completed 1" in body
+        assert "# TYPE tpu_serve_engine_completed gauge" in body
+        assert "tpu_serve_engine_completed 1.0" in body
+        assert "tpu_serve_engine_request_p50_seconds" in body
         assert "tpu_serve_engine_tokens_out" in body
     finally:
         srv.shutdown()

@@ -195,6 +195,34 @@ class ServeMetrics:
         if tokens:
             self.tokens.inc(by=tokens)
 
+    def scrape_engine(self, engine) -> None:
+        """Refresh the continuous-engine gauges at scrape time — through
+        the registry (HELP/TYPE metadata, seconds base units), never as
+        hand-formatted bare lines an OpenMetrics-strict scraper would
+        reject."""
+        stats = engine.stats()
+        gauges = {
+            "tpu_serve_engine_completed": ("requests completed",
+                                           stats.get("completed")),
+            "tpu_serve_engine_tokens_out": ("tokens generated",
+                                            stats.get("tokens_out")),
+            "tpu_serve_engine_queued": ("requests waiting for a slot",
+                                        stats.get("queued")),
+            "tpu_serve_engine_active": ("requests decoding in a slot",
+                                        stats.get("active")),
+            "tpu_serve_engine_request_p50_seconds": (
+                "per-request latency p50 over the stats window",
+                stats.get("latency_p50_ms", 0) / 1e3
+                if "latency_p50_ms" in stats else None),
+            "tpu_serve_engine_request_p95_seconds": (
+                "per-request latency p95 over the stats window",
+                stats.get("latency_p95_ms", 0) / 1e3
+                if "latency_p95_ms" in stats else None),
+        }
+        for name, (help_, value) in gauges.items():
+            if value is not None:
+                self.registry.gauge(name, help_).set(float(value))
+
 
 def make_handler(pool: DecoderPool, engine=None, metrics=None):
     """``engine`` (a ContinuousEngine) takes over /generate when given:
@@ -248,14 +276,9 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
             if self.path == "/healthz":
                 self._send(200, b"ok", "text/plain")
             elif self.path == "/metrics" and metrics is not None:
-                body = metrics.registry.expose()
                 if engine is not None:
-                    stats = engine.stats()
-                    body += "".join(
-                        f"tpu_serve_engine_{k} {v}\n"
-                        for k, v in stats.items()
-                        if isinstance(v, (int, float)))
-                self._send(200, body.encode(),
+                    metrics.scrape_engine(engine)
+                self._send(200, metrics.registry.expose().encode(),
                            "text/plain; version=0.0.4")
             elif self.path.split("?", 1)[0] == "/debug/jax-trace":
                 self._jax_trace()
