@@ -430,12 +430,19 @@ def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
 
     ``ring_impl``: "xla" or "flash" (Pallas per-block kernels — the
     MXU-fast engine for long-context shards).
+
+    Multislice: on a ``("dcn", "dp", "sp")`` mesh the batch shards over
+    BOTH dcn and dp while the sequence ring stays inside a slice —
+    gradient psums ride DCN across slices, the per-step kv ppermute ring
+    stays on ICI (DCN latency per ring hop would serialize the whole
+    attention; the batch psum happens once per step and overlaps).
     """
-    batch = "dp" if "dp" in mesh.axis_names else None
+    batch_axes = tuple(a for a in ("dcn", "dp") if a in mesh.axis_names)
+    batch = batch_axes if batch_axes else None
     tok_spec = P(batch, axis_name)
     rep = P()
 
-    axes = tuple(a for a in (batch, axis_name) if a)
+    axes = (*batch_axes, axis_name)
 
     def local_loss(params, tokens, targets):
         from tpu_dra.workloads.train import head_nll
