@@ -183,3 +183,49 @@ def test_metrics_include_engine_gauges_when_continuous():
         assert "tpu_serve_engine_tokens_out" in body
     finally:
         srv.shutdown()
+
+
+def test_speculative_endpoint(server):
+    """/speculative without a draft armed is a 400 with a pointer to the
+    flag; with a draft, tokens EXACTLY equal greedy /generate output and
+    target_passes reports the speedup observable."""
+    cfg, params, base = server
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        req = urllib.request.Request(
+            f"{base}/speculative",
+            data=json.dumps({"tokens": [[1, 2]], "steps": 4}).encode())
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 400
+    assert b"draft" in exc.value.read()
+
+    from tpu_dra.workloads.serve import serve as serve_fn
+
+    draft_cfg = ModelConfig(vocab=cfg.vocab, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32, max_seq=cfg.max_seq)
+    draft_params = init_params(draft_cfg, jax.random.PRNGKey(7))
+    srv = serve_fn(cfg, params, port=0, draft=(draft_cfg, draft_params))
+    host, port = srv.server_address
+    try:
+        body = json.dumps({"tokens": [[1, 2, 3], [4, 5, 6]],
+                           "steps": 6, "k": 3}).encode()
+        resp = json.loads(urllib.request.urlopen(
+            urllib.request.Request(f"http://{host}:{port}/speculative",
+                                   data=body), timeout=180).read())
+        ref = greedy_decode(cfg, params,
+                            jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32),
+                            steps=6, max_len=cfg.max_seq)
+        assert resp["tokens"] == ref.tolist()
+        assert 1 <= resp["target_passes"] <= 6
+    finally:
+        srv.shutdown()
+
+
+def test_speculative_rejects_mismatched_draft_vocab(server):
+    cfg, params, _ = server
+    from tpu_dra.workloads.serve import DecoderPool
+
+    pool = DecoderPool(cfg, params)
+    bad = ModelConfig(vocab=cfg.vocab + 1, d_model=16, n_heads=2,
+                      n_layers=1, d_ff=32, max_seq=cfg.max_seq)
+    with pytest.raises(ValueError, match="vocab"):
+        pool.set_draft(bad, None)

@@ -20,6 +20,11 @@ POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
              → {"tokens": [[[...]]], "scores": [[...]]}   (W best per row,
                  best first; rows must share one length — beam search has
                  no ragged mode)
+POST /speculative {"tokens": [[...]], "steps": N, "k": 4}
+             → {"tokens": [[...]], "target_passes": M}   (draft-assisted
+                 greedy: tokens EXACTLY equal /generate's greedy output;
+                 steps/M ≈ tokens committed per serving-model pass.
+                 Needs --draft-checkpoint-dir; equal-length rows)
 GET  /healthz → "ok"
 GET  /metrics → Prometheus text (version 0.0.4): request counts by
              path/code, generated-token total, request-latency histogram,
@@ -128,6 +133,79 @@ class DecoderPool:
                   rng=jax.random.PRNGKey(seed) if temperature > 0 else None)
         return [toks[i].tolist() for i in range(len(rows))]
 
+    def _prep_equal_length(self, rows: list[list[int]], steps: int,
+                           extra: int = 0, what: str = "this endpoint"):
+        """Shared request prep for the equal-length-rows endpoints (beam,
+        speculative): validation, batch bucketing, first-row padding.
+        Returns (B, S, prompts)."""
+        cfg = self.cfg
+        if not rows or not all(rows):
+            raise ValueError("tokens must be a non-empty list of "
+                             "non-empty rows")
+        if len({len(r) for r in rows}) != 1:
+            raise ValueError(f"{what} needs equal-length rows")
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if any(t < 0 or t >= cfg.vocab for r in rows for t in r):
+            raise ValueError(f"token ids must be in [0, {cfg.vocab})")
+        B = _bucket(len(rows))
+        S = len(rows[0])
+        if S + steps + extra > cfg.max_seq:
+            raise ValueError(
+                f"prompt length {S} + steps {steps}"
+                + (f" + k {extra}" if extra else "")
+                + f" exceeds max_seq {cfg.max_seq}")
+        prompts = jnp.asarray(rows + [rows[0]] * (B - len(rows)),
+                              jnp.int32)
+        return B, S, prompts
+
+    def set_draft(self, draft_cfg: ModelConfig, draft_params) -> None:
+        """Arm /speculative: a small draft model proposes, the serving
+        model verifies in one cached chunk pass (decode.py
+        speculative_decode — output EXACTLY equals greedy on the serving
+        model, the draft only changes speed)."""
+        if draft_cfg.vocab != self.cfg.vocab:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab} != serving vocab "
+                f"{self.cfg.vocab}")
+        with self._lock:
+            # compiled spec fns captured the previous draft_cfg at
+            # closure time — re-arming must drop them or same-shaped
+            # requests retrace the old config against the new params
+            for key in [k for k in self._fns if k[0] == "spec"]:
+                del self._fns[key]
+            self.draft_cfg = draft_cfg
+            self.draft_params = draft_params
+
+    def speculative(self, rows: list[list[int]], steps: int, k: int = 4):
+        """Speculative decode over equal-length rows → (tokens
+        [rows][steps], target verify passes).  Tokens are EXACTLY the
+        greedy serving-model output; ``target_passes`` is the speedup
+        observable (steps/passes ≈ tokens committed per serving-model
+        pass, up to k).  Requires ``set_draft``."""
+        from tpu_dra.workloads.decode import speculative_decode
+
+        if getattr(self, "draft_params", None) is None:
+            raise ValueError("no draft model armed: start the server "
+                             "with --draft-checkpoint-dir")
+        if not 2 <= k <= 16:
+            raise ValueError(f"k must be in [2, 16], got {k}")
+        B, S, prompts = self._prep_equal_length(
+            rows, steps, extra=k, what="speculative decoding")
+        key = ("spec", B, S, steps, int(k))
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = jax.jit(partial(
+                    speculative_decode, self.cfg,
+                    draft_cfg=self.draft_cfg, steps=steps, k=k,
+                    return_stats=True, cache_dtype=self.cache_dtype))
+                self._fns[key] = fn
+        toks, stats = fn(self.params, draft_params=self.draft_params,
+                         prompt=prompts)
+        return ([toks[i].tolist() for i in range(len(rows))],
+                int(stats["target_passes"]))
+
     def beam(self, rows: list[list[int]], steps: int, beams: int = 4,
              eos_id: int | None = None, length_penalty: float = 0.0):
         """Beam search over equal-length rows → (hypotheses
@@ -135,23 +213,10 @@ class DecoderPool:
         must share one length (beam_decode has no ragged mode; padding
         would enter the hypotheses' context)."""
         cfg = self.cfg
-        if not rows or not all(rows):
-            raise ValueError("tokens must be a non-empty list of "
-                             "non-empty rows")
-        if len({len(r) for r in rows}) != 1:
-            raise ValueError("beam search needs equal-length rows")
-        if steps < 1:
-            raise ValueError(f"steps must be >= 1, got {steps}")
-        if any(t < 0 or t >= cfg.vocab for r in rows for t in r):
-            raise ValueError(f"token ids must be in [0, {cfg.vocab})")
-        B = _bucket(len(rows))
-        S = len(rows[0])
-        if S + steps > cfg.max_seq:
-            raise ValueError(
-                f"prompt length {S} + steps {steps} exceeds max_seq "
-                f"{cfg.max_seq}")
-        prompts = jnp.asarray(
-            rows + [rows[0]] * (B - len(rows)), jnp.int32)
+        if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+            raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
+        B, S, prompts = self._prep_equal_length(rows, steps,
+                                                what="beam search")
         key = ("beam", B, S, steps, int(beams), eos_id,
                float(length_penalty))
         with self._lock:
@@ -370,6 +435,13 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                             req.get("length_penalty", 0.0)))
                     return {"tokens": hyps, "scores": scores}
                 self._json_post(handle)
+            elif self.path == "/speculative":
+                def handle(req):
+                    toks, passes = pool.speculative(
+                        req["tokens"], int(req.get("steps", 16)),
+                        int(req.get("k", 4)))
+                    return {"tokens": toks, "target_passes": passes}
+                self._json_post(handle)
             elif self.path == "/generate":
                 if engine is not None:
                     self._json_post(engine_generate)
@@ -395,7 +467,8 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           port: int = 8477,
           cache_dtype: str = "bf16",
           continuous: bool = False, slots: int = 32,
-          chunk: int = 4) -> ThreadingHTTPServer:
+          chunk: int = 4, draft: tuple | None = None
+          ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
 
@@ -407,6 +480,8 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
     ragged mode), as do /generate's top_k/top_p/repetition_penalty knobs —
     the engine rejects them, the error names the bucketed path."""
     pool = DecoderPool(cfg, params, cache_dtype=cache_dtype)
+    if draft is not None:
+        pool.set_draft(*draft)        # (draft_cfg, draft_params)
     engine = None
     if continuous:
         from tpu_dra.workloads.continuous import ContinuousEngine
@@ -474,6 +549,14 @@ def main(argv=None):
     ap.add_argument("--chunk", type=int, default=4,
                     help="continuous mode: tokens per dispatch (join "
                          "granularity)")
+    ap.add_argument("--draft-checkpoint-dir", default="",
+                    help="arm /speculative with this draft model "
+                         "(same vocab; dims via --draft-*)")
+    ap.add_argument("--draft-d-model", type=int, default=128)
+    ap.add_argument("--draft-n-heads", type=int, default=4)
+    ap.add_argument("--draft-n-kv-heads", type=int, default=None)
+    ap.add_argument("--draft-n-layers", type=int, default=2)
+    ap.add_argument("--draft-d-ff", type=int, default=512)
     args = ap.parse_args(argv)
 
     init_tpu_workload()
@@ -487,9 +570,20 @@ def main(argv=None):
                                              quantize_params_int8)
         params = (quantize_params_int8(params) if args.weights == "int8"
                   else cast_params_bf16(params))
+    draft = None
+    if args.draft_checkpoint_dir:
+        draft_cfg = ModelConfig(
+            vocab=args.vocab, d_model=args.draft_d_model,
+            n_heads=args.draft_n_heads,
+            n_kv_heads=args.draft_n_kv_heads,
+            n_layers=args.draft_n_layers,
+            d_ff=args.draft_d_ff, max_seq=args.max_seq,
+            pos_emb=args.pos_emb)
+        draft = (draft_cfg,
+                 restore_train_state(args.draft_checkpoint_dir)["params"])
     srv = serve(cfg, params, host=args.host, port=args.port,
                 cache_dtype=args.cache_dtype, continuous=args.continuous,
-                slots=args.slots, chunk=args.chunk)
+                slots=args.slots, chunk=args.chunk, draft=draft)
     print(f"serving on {srv.server_address}", flush=True)
     try:
         threading.Event().wait()
