@@ -229,3 +229,41 @@ def test_speculative_rejects_mismatched_draft_vocab(server):
                       n_layers=1, d_ff=32, max_seq=cfg.max_seq)
     with pytest.raises(ValueError, match="vocab"):
         pool.set_draft(bad, None)
+
+
+def test_prefix_endpoint_continuous(server):
+    """POST /prefix registers a shared prefix; /generate with prefix_id
+    decodes exactly like the full prompt.  Without --continuous the
+    endpoint is a 400 naming the flag."""
+    cfg, params, base = server
+    req = urllib.request.Request(
+        f"{base}/prefix", data=json.dumps({"tokens": [1, 2]}).encode())
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 400 and b"continuous" in exc.value.read()
+
+    from tpu_dra.workloads.serve import serve as serve_fn
+
+    cfg2 = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                       d_ff=64, max_seq=32, pos_emb="rope")
+    params2 = init_params(cfg2, jax.random.PRNGKey(1))
+    srv = serve_fn(cfg2, params2, port=0, continuous=True, slots=2,
+                   chunk=2)
+    host, port = srv.server_address
+    b2 = f"http://{host}:{port}"
+    try:
+        pid = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"{b2}/prefix",
+            data=json.dumps({"tokens": [7, 3, 9]}).encode()),
+            timeout=120).read())["prefix_id"]
+        out = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"{b2}/generate",
+            data=json.dumps({"tokens": [[2, 8]], "steps": 4,
+                             "prefix_id": pid}).encode()),
+            timeout=180).read())
+        ref = greedy_decode(cfg2, params2,
+                            jnp.asarray([[7, 3, 9, 2, 8]], jnp.int32),
+                            steps=4, max_len=cfg2.max_seq)
+        assert out["tokens"] == [ref[0].tolist()]
+    finally:
+        srv.shutdown()

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_dra.workloads.decode import (
+    _chunk_hidden,
     _filter_topk_topp,
     _select_token,
     _token_logits,
@@ -64,6 +65,7 @@ class _Request:
     eos_id: Optional[int]
     temperature: float
     seed: int
+    prefix_id: Optional[str] = None   # registered shared-KV prefix
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     submitted: float = field(default_factory=time.perf_counter)
@@ -73,6 +75,18 @@ class _Request:
     @property
     def latency_s(self) -> float:
         return self.finished - self.submitted
+
+
+@dataclass
+class _Prefix:
+    """A registered shared prompt prefix: its KV computed ONCE
+    ([L, 1, Hkv, Pb, Dh/1] buffers in the engine's cache dtype) and
+    copied into a slot at join time — the per-request prefill then runs
+    only over the suffix."""
+    tokens: list[int]
+    kv: dict
+    length: int
+    bucket: int
 
 
 class ContinuousEngine:
@@ -90,7 +104,7 @@ class ContinuousEngine:
     def __init__(self, cfg: ModelConfig, params, *, slots: int = 32,
                  max_len: Optional[int] = None, cache_dtype: str = "bf16",
                  chunk: int = 4, top_k: int = 0, top_p: float = 0.0,
-                 latency_window: int = 1024):
+                 latency_window: int = 1024, max_prefixes: int = 8):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if chunk < 1:
@@ -127,7 +141,12 @@ class ContinuousEngine:
         self.completed = 0
         self.tokens_out = 0
         self.latencies_s: deque[float] = deque(maxlen=latency_window)
+        # shared-prefix KV store (LRU, content-addressed)
+        self.max_prefixes = max_prefixes
+        self._prefixes: "dict[str, _Prefix]" = {}
         self._prefill_fns: dict[int, Any] = {}
+        self._prefix_fns: dict[int, Any] = {}
+        self._join_fns: dict[int, Any] = {}
         # donation: the slot cache is the engine's dominant HBM object;
         # without it every dispatch copies the whole cache (double peak
         # HBM + a full-cache copy per chunk)
@@ -200,16 +219,117 @@ class ContinuousEngine:
             self._prefill_fns[bucket] = fn
         return fn
 
+    def _prefix_kv_impl(self, cfg, params, prompt):
+        """Compute a prefix's KV buffers once: [1, Pb] right-padded →
+        {name: [L, 1, Hkv, Pb, ...]} in the engine's cache dtype.  Pad
+        rows carry garbage that stays masked until the suffix/decode
+        overwrites past them (module invariant)."""
+        Pb = prompt.shape[1]
+        small = {name: jnp.zeros(
+            (buf.shape[0], 1, buf.shape[2], Pb, buf.shape[4]), buf.dtype)
+            for name, buf in self._cache.items()}
+        small, _ = _prefill_trunk(cfg, params, small, prompt)
+        return small
+
+    def _prefix_join_impl(self, cfg, params, cache, pkv, suffix, slen,
+                          plen, slot, temp, key):
+        """Join a request whose context = registered prefix + suffix:
+        copy the prefix KV into the slot's rows and run ONLY the suffix
+        through the cached-chunk path at positions [plen, plen+Sb) —
+        the prefix is never recomputed.  Selects the first token from
+        the suffix's last real position.
+
+        The scratch cache is sized to the prefix + suffix buckets (both
+        static), not max_len — a short system prompt must not pay an
+        O(max_len) copy per join.  The slot's columns beyond the scratch
+        keep the previous occupant's stale rows, which the masked-slot
+        invariant keeps invisible until decode overwrites them."""
+        Pb, Sb = pkv["k"].shape[3], suffix.shape[1]
+        width = min(Pb + Sb, self.max_len)
+        small = {name: jnp.zeros(
+            (buf.shape[0], 1, buf.shape[2], width, buf.shape[4]),
+            buf.dtype) for name, buf in cache.items()}
+        small = {name: jax.lax.dynamic_update_slice(
+            small[name], pkv[name].astype(small[name].dtype),
+            (0, 0, 0, 0, 0)) for name in small}
+        # hidden states only — the vocab head runs on the ONE position
+        # whose logits are consumed (decode.py chunked-prefill pattern)
+        x, small = _chunk_hidden(cfg, params, small,
+                                 jnp.reshape(plen, (1,)), suffix)
+        last = x[jnp.arange(1), slen - 1][:, None, :]
+        logits = head_logits(params, last)[:, 0]        # [1, vocab]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = _select_token(logits / jnp.maximum(temp, 1e-6),
+                                key, 1.0, self.top_k, self.top_p)
+        first = jnp.where(temp > 0, sampled, greedy)[0]
+        cache = {name: jax.lax.dynamic_update_slice(
+            cache[name], small[name].astype(cache[name].dtype),
+            (0, slot, 0, 0, 0)) for name in cache}
+        return cache, first
+
+    def _join_fn(self, suffix_bucket: int, prefix_bucket: int):
+        key = (suffix_bucket, prefix_bucket)
+        fn = self._join_fns.get(key)
+        if fn is None:
+            fn = jax.jit(partial(self._prefix_join_impl, self.cfg),
+                         donate_argnums=(1,))
+            self._join_fns[key] = fn
+        return fn
+
+    def register_prefix(self, tokens: list[int]) -> str:
+        """Register a shared prompt prefix (e.g. a system prompt);
+        returns its content-addressed id for ``submit(prefix_id=...)``.
+        The prefix KV is computed once and copied into a slot at every
+        join — requests pay prefill only for their suffix.  LRU-bounded
+        at ``max_prefixes``; re-registering is idempotent."""
+        import hashlib
+
+        cfg = self.cfg
+        if not tokens:
+            raise ValueError("prefix must be non-empty")
+        if any(t < 0 or t >= cfg.vocab for t in tokens):
+            raise ValueError(f"token ids must be in [0, {cfg.vocab})")
+        if len(tokens) >= self.max_len:
+            raise ValueError(f"prefix length {len(tokens)} must leave "
+                             f"room under max_len {self.max_len}")
+        pid = hashlib.sha256(
+            ",".join(map(str, tokens)).encode()).hexdigest()[:16]
+        with self._cv:
+            if pid in self._prefixes:
+                # refresh LRU position
+                self._prefixes[pid] = self._prefixes.pop(pid)
+                return pid
+        Pb = self._bucket(len(tokens))
+        prompt = jnp.asarray([tokens + [0] * (Pb - len(tokens))],
+                             jnp.int32)
+        fn = self._prefix_fns.get(Pb)
+        if fn is None:
+            fn = jax.jit(partial(self._prefix_kv_impl, self.cfg))
+            self._prefix_fns[Pb] = fn
+        kv = fn(self.params, prompt)
+        jax.block_until_ready(kv["k"])
+        with self._cv:
+            while len(self._prefixes) >= self.max_prefixes:
+                evicted = next(iter(self._prefixes))
+                del self._prefixes[evicted]       # LRU: oldest first
+            self._prefixes[pid] = _Prefix(list(tokens), kv, len(tokens),
+                                          Pb)
+        return pid
+
     # -- public API ---------------------------------------------------------
 
     def submit(self, prompt: list[int], steps: int,
                eos_id: Optional[int] = None, temperature: float = 0.0,
-               seed: int = 0, timeout: Optional[float] = None) -> list[int]:
+               seed: int = 0, timeout: Optional[float] = None,
+               prefix_id: Optional[str] = None) -> list[int]:
         """Generate ``steps`` tokens after ``prompt`` (stops early at
         ``eos_id``); blocks until complete.  Thread-safe — concurrent
-        submissions batch dynamically."""
+        submissions batch dynamically.  With ``prefix_id`` the context is
+        ``registered_prefix + prompt`` and only the prompt (suffix) is
+        prefilled."""
         req = self.submit_async(prompt, steps, eos_id=eos_id,
-                                temperature=temperature, seed=seed)
+                                temperature=temperature, seed=seed,
+                                prefix_id=prefix_id)
         if not req.done.wait(timeout):
             raise TimeoutError(f"request not done within {timeout}s")
         if req.error:
@@ -218,7 +338,8 @@ class ContinuousEngine:
 
     def submit_async(self, prompt: list[int], steps: int,
                      eos_id: Optional[int] = None,
-                     temperature: float = 0.0, seed: int = 0) -> _Request:
+                     temperature: float = 0.0, seed: int = 0,
+                     prefix_id: Optional[str] = None) -> _Request:
         """Enqueue without blocking; the returned request's ``done`` event
         fires when ``tokens`` is complete (check ``error`` first).  Lets
         one caller fan several rows into the engine at once."""
@@ -231,15 +352,25 @@ class ContinuousEngine:
             raise ValueError(f"steps must be >= 1, got {steps}")
         if eos_id is not None and not 0 <= eos_id < cfg.vocab:
             raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
-        if len(prompt) + steps > self.max_len:
+        plen = 0
+        if prefix_id is not None:
+            with self._cv:
+                pref = self._prefixes.get(prefix_id)
+                if pref is None:
+                    raise ValueError(f"unknown prefix_id {prefix_id!r} "
+                                     f"(evicted or never registered)")
+                self._prefixes[prefix_id] = self._prefixes.pop(prefix_id)
+            plen = pref.length
+        if plen + len(prompt) + steps > self.max_len:
             raise ValueError(
-                f"prompt {len(prompt)} + steps {steps} exceeds the "
-                f"engine's max_len {self.max_len}")
+                f"prefix {plen} + prompt {len(prompt)} + steps {steps} "
+                f"exceeds the engine's max_len {self.max_len}")
         if len(prompt) > _PROMPT_BUCKETS[-1]:
             raise ValueError(f"prompt exceeds the largest bucket "
                              f"{_PROMPT_BUCKETS[-1]}")
         req = _Request(prompt=list(prompt), steps=steps, eos_id=eos_id,
-                       temperature=float(temperature), seed=seed)
+                       temperature=float(temperature), seed=seed,
+                       prefix_id=prefix_id)
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -301,15 +432,38 @@ class ContinuousEngine:
             # the request's seed (fold 0 draws the first token, the rest
             # of the stream advances per step in the chunk scan)
             key = jax.random.PRNGKey(req.seed)
-            cache, first = self._prefill_fn(Sb)(
-                self.params, self._cache, prompt,
-                jnp.asarray([len(req.prompt)], jnp.int32),
-                jnp.int32(slot), jnp.float32(req.temperature),
-                jax.random.fold_in(key, 0))
+            pref = None
+            if req.prefix_id is not None:
+                with self._cv:
+                    pref = self._prefixes.get(req.prefix_id)
+            if pref is not None:
+                # shared-prefix join: copy the prefix KV, prefill only
+                # the suffix at positions [plen, plen+Sb)
+                cache, first = self._join_fn(Sb, pref.bucket)(
+                    self.params, self._cache, pref.kv, prompt,
+                    jnp.asarray([len(req.prompt)], jnp.int32),
+                    jnp.int32(pref.length), jnp.int32(slot),
+                    jnp.float32(req.temperature),
+                    jax.random.fold_in(key, 0))
+                start_pos = pref.length + len(req.prompt)
+            elif req.prefix_id is not None:
+                # prefix evicted between submit and admission: fail the
+                # request instead of silently decoding without context
+                req.error = (f"prefix {req.prefix_id!r} evicted before "
+                             f"admission; re-register and resubmit")
+                req.done.set()
+                continue
+            else:
+                cache, first = self._prefill_fn(Sb)(
+                    self.params, self._cache, prompt,
+                    jnp.asarray([len(req.prompt)], jnp.int32),
+                    jnp.int32(slot), jnp.float32(req.temperature),
+                    jax.random.fold_in(key, 0))
+                start_pos = len(req.prompt)
             self._cache = cache
             first_host = int(first)
             self._token = self._token.at[slot].set(first_host)
-            self._pos = self._pos.at[slot].set(len(req.prompt))
+            self._pos = self._pos.at[slot].set(start_pos)
             self._temp = self._temp.at[slot].set(req.temperature)
             self._keys = self._keys.at[slot].set(jax.random.fold_in(key, 1))
             self._eos = self._eos.at[slot].set(

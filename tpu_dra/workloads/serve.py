@@ -14,7 +14,10 @@ POST /generate  {"tokens": [[...]], "steps": N, "temperature": 0.0,
 With ``continuous=True`` /generate runs over a ContinuousEngine
 (workloads/continuous.py): rows join the in-flight decode at chunk
 boundaries and leave on eos/steps, so mixed-length concurrent requests
-never queue behind a long generation.
+never queue behind a long generation.  POST /prefix {"tokens": [...]}
+→ {"prefix_id": id} registers a shared prompt prefix (system prompt):
+its KV computes once, and /generate requests carrying "prefix_id"
+prefill only their suffix.
 POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
                  "eos_id": null, "length_penalty": 0.0}
              → {"tokens": [[[...]]], "scores": [[...]]}   (W best per row,
@@ -194,6 +197,9 @@ class DecoderPool:
             rows, steps, extra=k, what="speculative decoding")
         key = ("spec", B, S, steps, int(k))
         with self._lock:
+            # fn and draft_params snapshot TOGETHER: a concurrent
+            # set_draft swaps both, and a fn compiled for the old
+            # draft_cfg must never run the new params
             fn = self._fns.get(key)
             if fn is None:
                 fn = jax.jit(partial(
@@ -201,7 +207,8 @@ class DecoderPool:
                     draft_cfg=self.draft_cfg, steps=steps, k=k,
                     return_stats=True, cache_dtype=self.cache_dtype))
                 self._fns[key] = fn
-        toks, stats = fn(self.params, draft_params=self.draft_params,
+            draft_params = self.draft_params
+        toks, stats = fn(self.params, draft_params=draft_params,
                          prompt=prompts)
         return ([toks[i].tolist() for i in range(len(rows))],
                 int(stats["target_passes"]))
@@ -308,11 +315,13 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                     f"the server without --continuous for per-request "
                     f"{knob}")
         eos = req.get("eos_id")
+        prefix_id = req.get("prefix_id")
         handles = [engine.submit_async(
             r, int(req.get("steps", 16)),
             eos_id=None if eos is None else int(eos),
             temperature=float(req.get("temperature", 0.0)),
-            seed=int(req.get("seed", 0))) for r in rows]
+            seed=int(req.get("seed", 0)),
+            prefix_id=prefix_id) for r in rows]
         out = []
         for h in handles:
             # bounded: a dead batcher fails requests via _fail_all, but a
@@ -426,7 +435,19 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                 eos = req.get("eos_id")
                 return None if eos is None else int(eos)
 
-            if self.path == "/beam":
+            if self.path == "/prefix":
+                if engine is None:
+                    self._send(400, json.dumps(
+                        {"error": "prefix caching needs --continuous "
+                                  "(the slot engine owns the shared "
+                                  "KV)"}).encode())
+                    return
+
+                def handle(req):
+                    return {"prefix_id":
+                            engine.register_prefix(req["tokens"])}
+                self._json_post(handle)
+            elif self.path == "/beam":
                 def handle(req):
                     hyps, scores = pool.beam(
                         req["tokens"], int(req.get("steps", 16)),
