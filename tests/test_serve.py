@@ -267,3 +267,99 @@ def test_prefix_endpoint_continuous(server):
         assert out["tokens"] == [ref[0].tolist()]
     finally:
         srv.shutdown()
+
+
+def test_stream_endpoint_delivers_tokens_incrementally():
+    """POST /stream: NDJSON token lines arrive while the generation is
+    still running (chunked transfer), and the final line's tokens equal
+    the greedy reference."""
+    import http.client
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=64, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = srv.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        conn.request("POST", "/stream",
+                     body=json.dumps({"tokens": [[1, 2, 3]],
+                                      "steps": 20}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(json.loads(line))
+        conn.close()
+        token_lines = [l["token"] for l in lines if "token" in l]
+        final = [l for l in lines if l.get("done")]
+        assert len(token_lines) == 20
+        assert final and final[0]["tokens"] == token_lines
+        ref = greedy_decode(cfg, params, jnp.asarray([[1, 2, 3]],
+                                                     jnp.int32),
+                            steps=20, max_len=cfg.max_seq)
+        assert token_lines == ref[0].tolist()
+
+        # multi-row is rejected with a pointer to /generate
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/stream",
+                     body=json.dumps({"tokens": [[1], [2]],
+                                      "steps": 2}).encode())
+        assert conn.getresponse().status == 400
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_stream_requires_continuous(server):
+    _, _, base = server
+    req = urllib.request.Request(
+        f"{base}/stream",
+        data=json.dumps({"tokens": [[1]], "steps": 2}).encode())
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=60)
+    assert exc.value.code == 400
+    assert b"continuous" in exc.value.read()
+
+
+def test_keepalive_connection_survives_early_errors():
+    """HTTP/1.1 keep-alive: an early-400 POST (body unread at decision
+    time) must drain the request body, or the next request on the same
+    connection parses garbage."""
+    import http.client
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve(cfg, params, port=0)     # no engine: /prefix 400s early
+    host, port = srv.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        body = json.dumps({"tokens": list(range(40))}).encode()
+        conn.request("POST", "/prefix", body=body)
+        assert conn.getresponse().read() and True
+        # same connection: a real request must still parse cleanly
+        conn.request("POST", "/generate",
+                     body=json.dumps({"tokens": [[1, 2]],
+                                      "steps": 2}).encode())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert len(json.loads(resp.read())["tokens"][0]) == 2
+        # unknown path with a body, then another good request
+        conn.request("POST", "/nope", body=b"x" * 512)
+        r404 = conn.getresponse()
+        assert r404.status == 404
+        r404.read()
+        conn.request("POST", "/generate",
+                     body=json.dumps({"tokens": [[3]],
+                                      "steps": 2}).encode())
+        last = conn.getresponse()
+        assert last.status == 200
+        last.read()
+        conn.close()
+    finally:
+        srv.shutdown()

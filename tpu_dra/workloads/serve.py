@@ -23,6 +23,9 @@ POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
              → {"tokens": [[[...]]], "scores": [[...]]}   (W best per row,
                  best first; rows must share one length — beam search has
                  no ragged mode)
+POST /stream    (continuous mode, one row) chunked NDJSON: a
+             {"token": id} line per generated token as it lands, then
+             {"done": true, "tokens": [...]}
 POST /speculative {"tokens": [[...]], "steps": N, "k": 4}
              → {"tokens": [[...]], "target_passes": M}   (draft-assisted
                  greedy: tokens EXACTLY equal /generate's greedy output;
@@ -301,11 +304,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
     every row becomes its own engine request, fanned in via submit_async
     so one HTTP call's rows still decode concurrently."""
 
-    def engine_generate(req) -> dict:
-        rows = req["tokens"]
-        if not rows or not all(rows):
-            raise ValueError("tokens must be a non-empty list of "
-                             "non-empty rows")
+    def reject_engine_knobs(req) -> None:
         for knob, noop in (("top_k", 0.0), ("top_p", 0.0),
                            ("repetition_penalty", 1.0)):
             val = req.get(knob)
@@ -314,6 +313,13 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                     f"{knob} is engine-global in continuous mode; start "
                     f"the server without --continuous for per-request "
                     f"{knob}")
+
+    def engine_generate(req) -> dict:
+        rows = req["tokens"]
+        if not rows or not all(rows):
+            raise ValueError("tokens must be a non-empty list of "
+                             "non-empty rows")
+        reject_engine_knobs(req)
         eos = req.get("eos_id")
         prefix_id = req.get("prefix_id")
         handles = [engine.submit_async(
@@ -335,8 +341,21 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
         return {"tokens": out}
 
     class Handler(BaseHTTPRequestHandler):
+        # chunked transfer (the /stream endpoint) is an HTTP/1.1
+        # construct; a 1.0 status line makes conforming clients ignore
+        # the framing and read raw chunk-size lines as body
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):             # quiet by default
             pass
+
+        def _drain_body(self) -> None:
+            """Consume the request body before an early response: with
+            HTTP/1.1 keep-alive, unread body bytes would be parsed as
+            the start of the NEXT request on the connection."""
+            n = int(self.headers.get("Content-Length", 0))
+            if n:
+                self.rfile.read(n)
 
         def _send(self, code: int, body: bytes,
                   ctype: str = "application/json"):
@@ -401,6 +420,97 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
             # trigger a second response on the same socket
             self._send(200, body, "application/gzip")
 
+        def _stream(self):
+            """POST /stream (continuous mode, ONE row): chunked-transfer
+            NDJSON — one {"token": id} line per generated token as the
+            engine emits it, then {"done": true, "tokens": [...]}.
+            Tokens flush at the engine's chunk cadence, so a client
+            renders output while a long generation is still running."""
+            t0 = time.perf_counter()
+            code, toks = 200, 0
+            try:
+                # body FIRST: on keep-alive (HTTP/1.1) an unread request
+                # body would be parsed as the next request
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                if engine is None:
+                    raise ValueError("streaming needs --continuous")
+                req = json.loads(raw)
+                rows = req.get("tokens")
+                if not isinstance(rows, list) or len(rows) != 1:
+                    raise ValueError("/stream takes exactly one row in "
+                                     "tokens; fan /generate for batches")
+                reject_engine_knobs(req)
+                eos = req.get("eos_id")
+                handle = engine.submit_async(
+                    rows[0], int(req.get("steps", 16)),
+                    eos_id=None if eos is None else int(eos),
+                    temperature=float(req.get("temperature", 0.0)),
+                    seed=int(req.get("seed", 0)),
+                    prefix_id=req.get("prefix_id"))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as exc:
+                if metrics is not None:
+                    metrics.observe(self.path, 400,
+                                    time.perf_counter() - t0)
+                self._send(400, json.dumps(
+                    {"error": str(exc)[:300]}).encode())
+                return
+            except RuntimeError as exc:    # engine shut down mid-request
+                if metrics is not None:
+                    metrics.observe(self.path, 500,
+                                    time.perf_counter() - t0)
+                self._send(500, json.dumps(
+                    {"error": str(exc)[:300]}).encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(obj) -> bool:
+                data = (json.dumps(obj) + "\n").encode()
+                try:
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+                    return True
+                except OSError:
+                    return False       # client went away: stop pushing
+            sent = 0
+            alive = True
+            timed_out = False
+            deadline = t0 + ENGINE_REQUEST_TIMEOUT_S
+            while True:
+                finished = handle.done.wait(0.05)
+                current = list(handle.tokens)       # snapshot
+                for tok in current[sent:]:
+                    alive = alive and chunk({"token": tok})
+                sent = len(current)
+                if finished or not alive:
+                    break
+                if time.perf_counter() > deadline:
+                    # same never-hang bound as engine_generate's waits
+                    timed_out = True
+                    break
+            toks = sent
+            if timed_out:
+                code = 500
+                alive and chunk({"error": f"request not done within "
+                                          f"{ENGINE_REQUEST_TIMEOUT_S}s"})
+            elif handle.error:
+                code = 500
+                alive and chunk({"error": handle.error[:300]})
+            else:
+                alive and chunk({"done": True, "tokens": handle.tokens})
+            try:
+                self.wfile.write(b"0\r\n\r\n")      # chunked terminator
+            except OSError:
+                pass
+            if metrics is not None:
+                metrics.observe(self.path, code,
+                                time.perf_counter() - t0, toks)
+
         def _json_post(self, handle):
             """Shared /generate + /beam plumbing: parse the JSON body,
             call ``handle(req) -> response dict``, map bad input to a
@@ -435,8 +545,11 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                 eos = req.get("eos_id")
                 return None if eos is None else int(eos)
 
-            if self.path == "/prefix":
+            if self.path == "/stream":
+                self._stream()
+            elif self.path == "/prefix":
                 if engine is None:
+                    self._drain_body()
                     self._send(400, json.dumps(
                         {"error": "prefix caching needs --continuous "
                                   "(the slot engine owns the shared "
@@ -479,6 +592,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                             req.get("repetition_penalty", 1.0)))}
                 self._json_post(handle)
             else:
+                self._drain_body()
                 self._send(404, b"not found", "text/plain")
 
     return Handler
