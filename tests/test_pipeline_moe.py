@@ -204,3 +204,85 @@ def test_moe_rejects_indivisible_experts():
     mesh = _mesh(4, 2, "ep")
     with pytest.raises(ValueError, match="not divisible"):
         make_moe_train_step(cfg, mesh)
+
+
+def test_moe_top2_matches_per_token_oracle():
+    """router_top_k=2 with ample capacity: every token goes through its
+    top-2 experts with renormalized gates — must match the per-token
+    two-expert mixture computed directly."""
+    cfg = MoEConfig(d_model=16, d_ff=32, n_experts=4, router_top_k=2)
+    ks = jax.random.split(jax.random.PRNGKey(5), 4)
+    x = jax.random.normal(ks[0], (2, 8, 16), dtype=jnp.float32)
+    wg = jax.random.normal(ks[1], (16, 4)) * 0.5
+    w1 = jax.random.normal(ks[2], (4, 16, 32)) * 0.25
+    w2 = jax.random.normal(ks[3], (4, 32, 16)) * 0.25
+
+    out, aux = moe_ffn(cfg, x, wg, w1, w2, capacity=32)
+
+    flat = x.reshape(-1, 16)
+    probs = jax.nn.softmax(flat @ wg, axis=-1)
+    tp, ti = jax.lax.top_k(probs, 2)
+    gates = tp / tp.sum(-1, keepdims=True)
+
+    def per_token(t, idx, g):
+        def one(e):
+            h = jax.nn.gelu(t.astype(jnp.bfloat16)
+                            @ w1[e].astype(jnp.bfloat16))
+            return (h @ w2[e].astype(jnp.bfloat16)).astype(jnp.float32)
+        return g[0] * one(idx[0]) + g[1] * one(idx[1])
+
+    ref = jax.vmap(per_token)(flat, ti, gates).reshape(x.shape)
+    assert float(jnp.max(jnp.abs(out - ref))) < 0.1
+    assert bool(jnp.isfinite(aux)) and float(aux) > 0
+
+
+def test_moe_top2_capacity_prioritizes_first_choices():
+    """Choice-major slot claiming: when an expert overflows, every kept
+    FIRST choice outranks any second choice — so with capacity exactly
+    equal to the first-choice load of an expert, no second-choice copy
+    lands there."""
+    cfg = MoEConfig(d_model=16, d_ff=32, n_experts=2, router_top_k=2)
+    ks = jax.random.split(jax.random.PRNGKey(6), 4)
+    x = jax.random.normal(ks[0], (1, 8, 16))
+    wg = jax.random.normal(ks[1], (16, 2))
+    w1 = jax.random.normal(ks[2], (2, 16, 32)) * 0.25
+    w2 = jax.random.normal(ks[3], (2, 32, 16)) * 0.25
+    # with E=2 and k=2 EVERY token routes to both experts (8 copies per
+    # expert); capacity 4 drops half of each expert's queue
+    out_tight, _ = moe_ffn(cfg, x, wg, w1, w2, capacity=4)
+    out_ample, _ = moe_ffn(cfg, x, wg, w1, w2, capacity=8)
+    assert float(jnp.max(jnp.abs(out_tight - out_ample))) > 1e-6
+    assert bool(jnp.all(jnp.isfinite(out_tight)))
+    # choice-major priority: capacity equal to the max FIRST-choice load
+    # guarantees every token's first choice is admitted (second choices
+    # only take leftover slots), so no token's output row is all-zero
+    flat = x.reshape(-1, 16)
+    probs = jax.nn.softmax(flat @ wg, axis=-1)
+    first = jnp.argmax(probs, axis=-1)
+    max_first_load = int(jnp.max(jnp.bincount(first, length=2)))
+    out_first, _ = moe_ffn(cfg, x, wg, w1, w2, capacity=max_first_load)
+    rows = out_first.reshape(-1, 16)
+    zero_rows = int(jnp.sum(jnp.all(jnp.abs(rows) < 1e-7, axis=-1)))
+    assert zero_rows == 0, zero_rows
+
+
+def test_moe_top2_trains_on_ep_mesh():
+    mesh = _mesh(2, 4, "ep")
+    cfg = MoEConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                    d_ff=64, max_seq=16, n_experts=4, router_top_k=2)
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    step, p_shard, t_shard = make_moe_train_step(cfg, mesh, lr=5e-2)
+    params = jax.device_put(params, p_shard)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64),
+        t_shard)
+    params, loss0 = step(params, tokens)
+    for _ in range(8):
+        params, loss = step(params, tokens)
+    assert jnp.isfinite(loss0) and float(loss) < float(loss0)
+
+
+def test_moe_rejects_bad_top_k():
+    import pytest
+    with pytest.raises(ValueError, match="router_top_k"):
+        MoEConfig(d_model=16, d_ff=32, n_experts=2, router_top_k=3)

@@ -40,6 +40,9 @@ class MoEConfig(ModelConfig):
     n_experts: int = 8
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
+    # experts per token: 1 = Switch, 2 = GShard-style top-2 (gates
+    # renormalized over the selected pair; capacity scales with k)
+    router_top_k: int = 1
 
     def __post_init__(self):
         super().__post_init__()
@@ -47,9 +50,15 @@ class MoEConfig(ModelConfig):
             raise NotImplementedError(
                 "tied_embeddings is not wired through init_moe_params "
                 "(it would be silently ignored)")
+        if not 1 <= self.router_top_k <= self.n_experts:
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]")
 
     def capacity(self, n_tokens: int) -> int:
-        return max(1, int(self.capacity_factor * n_tokens / self.n_experts))
+        # k routed copies of every token share the expert banks
+        return max(1, int(self.capacity_factor * self.router_top_k *
+                          n_tokens / self.n_experts))
 
 
 def init_moe_params(cfg: MoEConfig, key) -> dict[str, Any]:
@@ -83,30 +92,46 @@ def init_moe_params(cfg: MoEConfig, key) -> dict[str, Any]:
 
 def moe_ffn(cfg: MoEConfig, x, wg, w1, w2, capacity: int | None = None,
             mesh: Mesh | None = None):
-    """Top-1 switch FFN. ``x``: [B, S, D]; ``wg``: [D, E]; ``w1``: [E, D, F];
+    """Top-k expert FFN (k = ``cfg.router_top_k``; 1 = Switch, 2 =
+    GShard-style).  ``x``: [B, S, D]; ``wg``: [D, E]; ``w1``: [E, D, F];
     ``w2``: [E, F, D]. Returns ``(out [B,S,D], aux_loss scalar)``.
 
     Tokens over their expert's capacity are dropped (residual passes them
-    through unchanged) — the standard static-shape TPU formulation. Pass
-    ``mesh`` (with an "ep" axis) to pin the expert tensors' leading axis.
+    through unchanged) — the standard static-shape TPU formulation.  For
+    k > 1 the selected gates renormalize over the pair and capacity slots
+    are claimed CHOICE-MAJOR (every token's first choice outranks any
+    second choice), matching GShard's priority rule.  Pass ``mesh`` (with
+    an "ep" axis) to pin the expert tensors' leading axis.
     """
     B, S, D = x.shape
     E = wg.shape[-1]
     N = B * S
+    K = cfg.router_top_k
     C = capacity if capacity is not None else cfg.capacity(N)
 
     flat = x.reshape(N, D)
     logits = (flat.astype(jnp.float32) @ wg.astype(jnp.float32))  # [N, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.max(probs, axis=-1)                       # [N]
-    expert = jnp.argmax(probs, axis=-1)                  # [N]
+    topk_probs, topk_idx = jax.lax.top_k(probs, K)       # [N, K]
+    if K == 1:
+        gates = topk_probs        # Switch: the RAW router prob scales the
+        #                           expert output (a learning signal —
+        #                           renormalizing to 1.0 would erase it)
+    else:
+        gates = topk_probs / jnp.maximum(
+            topk_probs.sum(-1, keepdims=True), 1e-9)     # GShard pair
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)          # [N, E]
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot              # [N, E]
-    keep = onehot * (pos < C)                                      # [N, E]
+    onehot_k = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [N, K, E]
+    # choice-major capacity: flatten to [K·N, E] with all first choices
+    # before any second choice, so overload drops second choices first
+    flat_oh = onehot_k.transpose(1, 0, 2).reshape(K * N, E)
+    pos = (jnp.cumsum(flat_oh, axis=0) - 1.0) * flat_oh        # [KN, E]
+    keep = flat_oh * (pos < C)
     slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
-                          dtype=jnp.float32)                       # [N, C]
-    dispatch = keep[:, :, None] * slot[:, None, :]                 # [N, E, C]
+                          dtype=jnp.float32)                   # [KN, C]
+    disp_k = (keep[:, :, None] * slot[:, None, :]).reshape(
+        K, N, E, C)                                            # [K,N,E,C]
+    dispatch = disp_k.sum(0)                                   # [N, E, C]
 
     # dispatch → expert banks (contraction over tokens: XLA's all-to-all
     # point once w1/w2 are "ep"-sharded)
@@ -118,15 +143,18 @@ def moe_ffn(cfg: MoEConfig, x, wg, w1, w2, capacity: int | None = None,
     expert_out = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.bfloat16))
     expert_out = _ep_constraint(expert_out, mesh)
 
-    combine = (dispatch * gate[:, None, None]).astype(jnp.bfloat16)
+    combine = jnp.einsum("knec,nk->nec", disp_k,
+                         gates).astype(jnp.bfloat16)           # [N, E, C]
     out = jnp.einsum("nec,ecd->nd", combine, expert_out)
 
     # switch aux loss: E * Σ_e (token fraction_e × mean router prob_e).
-    # Fraction counts the pre-capacity routing assignment (Switch
-    # Transformer eqs. 4–6): post-drop counts saturate at C/N exactly when
-    # an expert is overloaded, which would cap the penalty in the collapse
-    # regime the loss exists to prevent.
-    frac = onehot.sum(0) / jnp.maximum(onehot.sum(), 1.0)          # [E]
+    # Fraction counts the pre-capacity FIRST-choice assignment (Switch
+    # Transformer eqs. 4–6; GShard uses the same top-1 fraction):
+    # post-drop counts saturate at C/N exactly when an expert is
+    # overloaded, which would cap the penalty in the collapse regime the
+    # loss exists to prevent.
+    first = onehot_k[:, 0]                                     # [N, E]
+    frac = first.sum(0) / jnp.maximum(first.sum(), 1.0)        # [E]
     aux = E * jnp.sum(frac * probs.mean(0))
     return out.reshape(B, S, D).astype(x.dtype), aux
 
