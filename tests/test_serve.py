@@ -284,24 +284,33 @@ def test_stream_endpoint_delivers_tokens_incrementally():
         conn = http.client.HTTPConnection(host, port, timeout=300)
         conn.request("POST", "/stream",
                      body=json.dumps({"tokens": [[1, 2, 3]],
-                                      "steps": 20}).encode(),
+                                      "steps": 40}).encode(),
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         assert resp.status == 200
         lines = []
+        still_active_at_first_token = None
         while True:
             line = resp.readline()
             if not line:
                 break
             lines.append(json.loads(line))
+            if still_active_at_first_token is None:
+                # INCREMENTAL delivery: when the first token line lands,
+                # the generation must still be in flight (a buffering
+                # regression would only flush after completion)
+                still_active_at_first_token = \
+                    srv.engine.stats()["active"] >= 1
         conn.close()
+        assert still_active_at_first_token, \
+            "first token arrived only after the generation finished"
         token_lines = [l["token"] for l in lines if "token" in l]
         final = [l for l in lines if l.get("done")]
-        assert len(token_lines) == 20
+        assert len(token_lines) == 40
         assert final and final[0]["tokens"] == token_lines
         ref = greedy_decode(cfg, params, jnp.asarray([[1, 2, 3]],
                                                      jnp.int32),
-                            steps=20, max_len=cfg.max_seq)
+                            steps=40, max_len=cfg.max_seq)
         assert token_lines == ref[0].tolist()
 
         # multi-row is rejected with a pointer to /generate
@@ -311,6 +320,25 @@ def test_stream_endpoint_delivers_tokens_incrementally():
                                       "steps": 2}).encode())
         assert conn.getresponse().status == 400
         conn.close()
+
+        # an HTTP/1.0 client can't parse chunked framing: it gets the
+        # buffered (non-chunked) complete response instead of corruption
+        import socket
+        body = json.dumps({"tokens": [[1, 2]], "steps": 4}).encode()
+        raw = (f"POST /stream HTTP/1.0\r\nHost: t\r\n"
+               f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+        s = socket.create_connection((host, port), timeout=120)
+        s.sendall(raw)
+        data = b""
+        while True:
+            got = s.recv(65536)
+            if not got:
+                break
+            data += got
+        s.close()
+        assert b"Transfer-Encoding: chunked" not in data, data[:200]
+        payload = json.loads(data.split(b"\r\n\r\n", 1)[1])
+        assert payload["done"] and len(payload["tokens"]) == 4
     finally:
         srv.shutdown()
 

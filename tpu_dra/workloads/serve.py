@@ -353,7 +353,13 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
             """Consume the request body before an early response: with
             HTTP/1.1 keep-alive, unread body bytes would be parsed as
             the start of the NEXT request on the connection."""
-            n = int(self.headers.get("Content-Length", 0))
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                # unparsable length: we cannot know where the body ends —
+                # answer, then force the connection closed
+                self.close_connection = True
+                return
             if n:
                 self.rfile.read(n)
 
@@ -462,6 +468,27 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                                     time.perf_counter() - t0)
                 self._send(500, json.dumps(
                     {"error": str(exc)[:300]}).encode())
+                return
+            if self.request_version != "HTTP/1.1":
+                # chunked framing is an HTTP/1.1 construct — a 1.0 client
+                # would read hex size lines as body.  Degrade to the
+                # buffered /generate behavior instead of corrupting it.
+                code, body = 200, b""
+                if not handle.done.wait(ENGINE_REQUEST_TIMEOUT_S):
+                    code, body = 500, json.dumps(
+                        {"error": "request not done within "
+                                  f"{ENGINE_REQUEST_TIMEOUT_S}s"}).encode()
+                elif handle.error:
+                    code, body = 500, json.dumps(
+                        {"error": handle.error[:300]}).encode()
+                else:
+                    body = json.dumps(
+                        {"done": True, "tokens": handle.tokens}).encode()
+                if metrics is not None:
+                    metrics.observe(self.path, code,
+                                    time.perf_counter() - t0,
+                                    len(handle.tokens))
+                self._send(code, body)
                 return
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
