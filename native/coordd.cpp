@@ -12,6 +12,8 @@
 //   GET /nodes        -> nodes_config.json verbatim (application/json)
 //   GET /coordinator  -> "<rank0-ip>:<port>" | 503 "NO_COORDINATOR"
 //   GET /whoami?ip=X  -> process index of member X | 404 "-1"
+//   GET /metrics      -> Prometheus text: request counters by path,
+//                        config reloads, membership size, readiness
 //
 // State is <settings-dir>/nodes_config.json, rendered by the slice daemon's
 // update loop on every full-membership change (the nodes_config.cfg analog,
@@ -23,6 +25,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <atomic>
 #include <limits>
 #include <cstdio>
 #include <cstdlib>
@@ -259,6 +262,7 @@ class CoordState {
                 return wa != wb ? wa < wb : a.name < b.name;
               });
     nodes_ = std::move(nodes);
+    ++reloads_;
     raw_ = std::move(text);
     mtime_s_ = st.st_mtim.tv_sec;
     mtime_ns_ = st.st_mtim.tv_nsec;
@@ -266,6 +270,8 @@ class CoordState {
 
   bool ready() const { return !nodes_.empty(); }
   const std::string& raw() const { return raw_; }
+  long reloads() const { return reloads_; }
+  size_t NodeCount() const { return nodes_.size(); }
 
   std::string Coordinator() const {
     if (nodes_.empty()) return "";
@@ -286,7 +292,16 @@ class CoordState {
   std::string raw_;
   time_t mtime_s_ = 0;
   long mtime_ns_ = -1;
+  long reloads_ = 0;
 };
+
+// request counters by path — exported at /metrics so a scraper sees the
+// daemon's traffic the way the driver processes' registries expose theirs
+struct Counters {
+  std::atomic<long> ready{0}, nodes{0}, coordinator{0}, whoami{0},
+      metrics{0}, notfound{0};
+};
+Counters g_counters;
 
 // --- HTTP ------------------------------------------------------------------
 
@@ -379,20 +394,54 @@ void Handle(int fd, CoordState* state) {
   std::string t(target);
   std::string path = t.substr(0, t.find('?'));
   if (path == "/ready") {
+    ++g_counters.ready;
     if (state->ready()) Respond(fd, 200, "OK", "READY\n");
     else Respond(fd, 503, "Service Unavailable", "NOT_READY\n");
   } else if (path == "/nodes") {
+    ++g_counters.nodes;
     Respond(fd, 200, "OK", state->ready() ? state->raw() : "{\"nodes\": []}",
             "application/json");
   } else if (path == "/coordinator") {
+    ++g_counters.coordinator;
     std::string coord = state->Coordinator();
     if (coord.empty()) Respond(fd, 503, "Service Unavailable", "NO_COORDINATOR");
     else Respond(fd, 200, "OK", coord);
   } else if (path == "/whoami") {
+    ++g_counters.whoami;
     int idx = state->ProcessIndex(QueryParam(t, "ip"));
     if (idx >= 0) Respond(fd, 200, "OK", std::to_string(idx));
     else Respond(fd, 404, "Not Found", "-1");
+  } else if (path == "/metrics") {
+    ++g_counters.metrics;
+    std::string body;
+    body += "# HELP coordd_requests_total requests by path\n";
+    body += "# TYPE coordd_requests_total counter\n";
+    body += "coordd_requests_total{path=\"/ready\"} " +
+            std::to_string(g_counters.ready.load()) + "\n";
+    body += "coordd_requests_total{path=\"/nodes\"} " +
+            std::to_string(g_counters.nodes.load()) + "\n";
+    body += "coordd_requests_total{path=\"/coordinator\"} " +
+            std::to_string(g_counters.coordinator.load()) + "\n";
+    body += "coordd_requests_total{path=\"/whoami\"} " +
+            std::to_string(g_counters.whoami.load()) + "\n";
+    body += "coordd_requests_total{path=\"/metrics\"} " +
+            std::to_string(g_counters.metrics.load()) + "\n";
+    body += "coordd_requests_total{path=\"other\"} " +
+            std::to_string(g_counters.notfound.load()) + "\n";
+    body += "# HELP coordd_config_reloads_total nodes_config.json parses\n";
+    body += "# TYPE coordd_config_reloads_total counter\n";
+    body += "coordd_config_reloads_total " +
+            std::to_string(state->reloads()) + "\n";
+    body += "# HELP coordd_nodes current membership size\n";
+    body += "# TYPE coordd_nodes gauge\n";
+    body += "coordd_nodes " + std::to_string(state->NodeCount()) + "\n";
+    body += "# HELP coordd_ready 1 once a full config is loaded\n";
+    body += "# TYPE coordd_ready gauge\n";
+    body += std::string("coordd_ready ") +
+            (state->ready() ? "1" : "0") + "\n";
+    Respond(fd, 200, "OK", body, "text/plain; version=0.0.4");
   } else {
+    ++g_counters.notfound;
     Respond(fd, 404, "Not Found", "not found");
   }
 }

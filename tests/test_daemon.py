@@ -620,3 +620,54 @@ def test_native_coordd_split_request_and_short_writes(coordd_bin, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_coordservice_metrics_endpoint(tmp_path):
+    """Python coordservice /metrics: request counters, reloads,
+    membership size, readiness."""
+    server = serve(str(tmp_path), port=0, address="127.0.0.1")
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+        urllib.request.urlopen(f"{base}/ready", timeout=2).read()
+        body = urllib.request.urlopen(
+            f"{base}/metrics", timeout=2).read().decode()
+        assert '# TYPE coordd_requests_total counter' in body
+        assert 'coordd_requests_total{path="/ready"} 1' in body
+        assert "coordd_nodes 1" in body
+        assert "coordd_ready 1" in body
+        assert "coordd_config_reloads_total" in body
+    finally:
+        server.shutdown()
+
+
+def test_native_coordd_metrics_endpoint(coordd_bin, tmp_path):
+    """The C++ daemon serves the same /metrics contract."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [coordd_bin, "--settings-dir", str(tmp_path), "--port", str(port),
+         "--address", "127.0.0.1"], stderr=subprocess.PIPE)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+
+        def ready():
+            try:
+                return urllib.request.urlopen(
+                    f"{base}/ready", timeout=1).status == 200
+            except (urllib.error.HTTPError, OSError):
+                return False
+        assert wait_until(ready)
+        body = urllib.request.urlopen(
+            f"{base}/metrics", timeout=2).read().decode()
+        assert '# TYPE coordd_requests_total counter' in body
+        assert 'coordd_requests_total{path="/ready"}' in body
+        assert "coordd_nodes 1" in body
+        assert "coordd_ready 1" in body
+        assert "coordd_config_reloads_total 1" in body
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)

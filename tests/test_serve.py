@@ -281,6 +281,17 @@ def test_stream_endpoint_delivers_tokens_incrementally():
     srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2)
     host, port = srv.server_address
     try:
+        # deterministic pacing: 20 ms per chunk dispatch guarantees the
+        # generation outlives the server's first 50 ms poll regardless of
+        # backend speed, so the incrementality assert below cannot race
+        import time as _time
+        orig_step = srv.engine._step_fn
+
+        def slow_step(*a, **k):
+            _time.sleep(0.02)
+            return orig_step(*a, **k)
+        srv.engine._step_fn = slow_step
+
         conn = http.client.HTTPConnection(host, port, timeout=300)
         conn.request("POST", "/stream",
                      body=json.dumps({"tokens": [[1, 2, 3]],

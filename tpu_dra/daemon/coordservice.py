@@ -12,6 +12,8 @@ process index before calling ``jax.distributed.initialize``
 - ``GET /nodes``      → the membership list (JSON)
 - ``GET /coordinator``→ ``ip:port`` of the rank-0 node's JAX coordinator
 - ``GET /whoami?ip=`` → the process index for a member ip
+- ``GET /metrics``    → Prometheus text: request counters by path, config
+  reloads, membership size, readiness (drop-in with the native coordd)
 
 Run standalone:
 ``python -m tpu_dra.daemon.coordservice --settings-dir /etc/tpu-slice``
@@ -43,6 +45,7 @@ class CoordState:
         self._nodes: list[dict] = []
         self._data: dict = {}
         self._mtime = 0.0
+        self.reloads = 0
         self.reload()
 
     def reload(self) -> bool:
@@ -59,6 +62,7 @@ class CoordState:
             self._nodes = data.get("nodes", [])
             self._data = data
             self._mtime = mtime
+            self.reloads += 1
         return bool(self._nodes)
 
     def nodes(self) -> list[dict]:
@@ -97,6 +101,33 @@ class CoordState:
 def serve(settings_dir: str, port: int,
           address: str = "0.0.0.0") -> ThreadingHTTPServer:
     state = CoordState(settings_dir)
+    counters = {p: 0 for p in ("/ready", "/nodes", "/coordinator",
+                               "/whoami", "/metrics", "other")}
+    counters_mu = threading.Lock()
+
+    def count(path: str) -> None:
+        with counters_mu:
+            counters[path if path in counters else "other"] += 1
+
+    def metrics_body() -> str:
+        with counters_mu:
+            snap = dict(counters)
+        lines = ["# HELP coordd_requests_total requests by path",
+                 "# TYPE coordd_requests_total counter"]
+        lines += [f'coordd_requests_total{{path="{p}"}} {v}'
+                  for p, v in snap.items()]
+        n_nodes = len(state.nodes())      # one reload+copy serves both
+        lines += ["# HELP coordd_config_reloads_total nodes_config.json "
+                  "parses",
+                  "# TYPE coordd_config_reloads_total counter",
+                  f"coordd_config_reloads_total {state.reloads}",
+                  "# HELP coordd_nodes current membership size",
+                  "# TYPE coordd_nodes gauge",
+                  f"coordd_nodes {n_nodes}",
+                  "# HELP coordd_ready 1 once a full config is loaded",
+                  "# TYPE coordd_ready gauge",
+                  f"coordd_ready {1 if n_nodes else 0}"]
+        return "\n".join(lines) + "\n"
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, body: str,
@@ -110,7 +141,11 @@ def serve(settings_dir: str, port: int,
 
         def do_GET(self):  # noqa: N802
             parsed = urlparse(self.path)
-            if parsed.path == "/ready":
+            count(parsed.path)
+            if parsed.path == "/metrics":
+                self._send(200, metrics_body(),
+                           "text/plain; version=0.0.4")
+            elif parsed.path == "/ready":
                 if state.ready():
                     self._send(200, "READY\n")
                 else:

@@ -360,8 +360,10 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                 # answer, then force the connection closed
                 self.close_connection = True
                 return
-            if n:
+            if n > 0:                  # negative would read-to-EOF (hang)
                 self.rfile.read(n)
+            elif n < 0:
+                self.close_connection = True
 
         def _send(self, code: int, body: bytes,
                   ctype: str = "application/json"):
