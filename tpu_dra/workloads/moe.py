@@ -231,6 +231,52 @@ def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
     return out
 
 
+def make_moe_optax_step(cfg: MoEConfig, mesh: Mesh, optimizer=None,
+                        attn_impl: str = "dense",
+                        head_impl: str = "dense"):
+    """MoE training with a real optax optimizer (default: AdamW +
+    global-norm clipping) — the expert-parallel sibling of
+    ``train.make_optax_train_step``.  Returns ``(step, init_opt_state,
+    p_shard, t_shard)``; optimizer moment buffers shard like the params
+    they mirror, so the "ep"-sharded expert banks carry their Adam state
+    on the same devices (no replicated [L, E, D, F] moments)."""
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.chain(optax.clip_by_global_norm(1.0),
+                                optax.adamw(3e-4, weight_decay=0.01))
+    ep = mesh.shape["ep"]
+    if cfg.n_experts % ep:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
+                         f"ep={ep}")
+    p_shard = moe_param_shardings(cfg, mesh)
+    t_shard = NamedSharding(mesh, P("dp", None))
+    rep = NamedSharding(mesh, P())
+
+    p_shapes = jax.eval_shape(
+        lambda: init_moe_params(cfg, jax.random.PRNGKey(0)))
+    opt_shapes = jax.eval_shape(optimizer.init, p_shapes)
+    opt_sh = optax.tree_map_params(
+        optimizer, lambda _leaf, s: s, opt_shapes, p_shard,
+        transform_non_params=lambda _leaf: rep)
+
+    def init_opt_state(params):
+        return jax.jit(optimizer.init, out_shardings=opt_sh)(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            partial(moe_loss_fn, cfg, mesh=mesh, attn_impl=attn_impl,
+                    head_impl=head_impl))(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(train_step,
+                   in_shardings=(p_shard, opt_sh, t_shard),
+                   out_shardings=(p_shard, opt_sh, rep))
+    return step, init_opt_state, p_shard, t_shard
+
+
 def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2,
                         attn_impl: str = "dense",
                         head_impl: str = "dense"):
