@@ -310,7 +310,6 @@ def test_moe_optax_step_trains_and_shards_moments():
     assert jnp.isfinite(loss0) and float(loss) < float(loss0)
 
     # find the w1 moment leaf and assert it is ep-sharded
-    import optax
     shardings = jax.tree.map(lambda x: x.sharding, opt_state,
                              is_leaf=lambda x: hasattr(x, "sharding"))
     specs = [s.spec for s in jax.tree.leaves(

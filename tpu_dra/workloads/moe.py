@@ -242,9 +242,11 @@ def make_moe_optax_step(cfg: MoEConfig, mesh: Mesh, optimizer=None,
     on the same devices (no replicated [L, E, D, F] moments)."""
     import optax
 
+    from tpu_dra.workloads.train import (default_optimizer,
+                                         opt_state_shardings)
+
     if optimizer is None:
-        optimizer = optax.chain(optax.clip_by_global_norm(1.0),
-                                optax.adamw(3e-4, weight_decay=0.01))
+        optimizer = default_optimizer()
     ep = mesh.shape["ep"]
     if cfg.n_experts % ep:
         raise ValueError(f"n_experts={cfg.n_experts} not divisible by "
@@ -253,15 +255,9 @@ def make_moe_optax_step(cfg: MoEConfig, mesh: Mesh, optimizer=None,
     t_shard = NamedSharding(mesh, P("dp", None))
     rep = NamedSharding(mesh, P())
 
-    p_shapes = jax.eval_shape(
-        lambda: init_moe_params(cfg, jax.random.PRNGKey(0)))
-    opt_shapes = jax.eval_shape(optimizer.init, p_shapes)
-    opt_sh = optax.tree_map_params(
-        optimizer, lambda _leaf, s: s, opt_shapes, p_shard,
-        transform_non_params=lambda _leaf: rep)
-
-    def init_opt_state(params):
-        return jax.jit(optimizer.init, out_shardings=opt_sh)(params)
+    opt_sh, init_opt_state = opt_state_shardings(
+        optimizer, lambda: init_moe_params(cfg, jax.random.PRNGKey(0)),
+        p_shard, mesh)
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(

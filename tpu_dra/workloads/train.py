@@ -560,8 +560,7 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
     import optax
 
     if optimizer is None:
-        optimizer = optax.chain(optax.clip_by_global_norm(1.0),
-                                optax.adamw(3e-4, weight_decay=0.01))
+        optimizer = default_optimizer()
     p_shard = param_shardings(cfg, mesh)
     b_shard = batch_sharding(mesh)
     rep = NamedSharding(mesh, P())
@@ -576,14 +575,35 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    # jit alone does NOT propagate input shardings through init (XLA is
-    # free to replicate the moment buffers — measured), and leaving the
-    # step's opt_state out_sharding open would let the compiler drop the
-    # layout again after one step.  Build the sharding tree once:
-    # optax.tree_map_params knows which state leaves mirror params (→
-    # that param's sharding); everything else (step counts) replicates.
-    p_shapes = jax.eval_shape(
-        lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    opt_sh, init_opt_state = opt_state_shardings(
+        optimizer, lambda: init_params(cfg, jax.random.PRNGKey(0)),
+        p_shard, mesh)
+    step = jax.jit(train_step,
+                   in_shardings=(p_shard, opt_sh, b_shard),
+                   out_shardings=(p_shard, opt_sh, rep))
+    return step, init_opt_state, p_shard, b_shard
+
+
+def default_optimizer():
+    import optax
+    return optax.chain(optax.clip_by_global_norm(1.0),
+                       optax.adamw(3e-4, weight_decay=0.01))
+
+
+def opt_state_shardings(optimizer, param_init_fn, p_shard, mesh: Mesh):
+    """(opt_sharding_tree, init_opt_state) for a sharded optimizer.
+
+    jit alone does NOT propagate input shardings through init (XLA is
+    free to replicate the moment buffers — measured), and leaving the
+    step's opt_state out_sharding open would let the compiler drop the
+    layout again after one step.  Build the sharding tree once:
+    optax.tree_map_params knows which state leaves mirror params (→
+    that param's sharding); everything else (step counts) replicates.
+    Shared by the dense, MoE, and any future optax step builders."""
+    import optax
+
+    rep = NamedSharding(mesh, P())
+    p_shapes = jax.eval_shape(param_init_fn)
     opt_shapes = jax.eval_shape(optimizer.init, p_shapes)
     opt_sh = optax.tree_map_params(
         optimizer, lambda _leaf, s: s, opt_shapes, p_shard,
@@ -592,7 +612,4 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
     def init_opt_state(params):
         return jax.jit(optimizer.init, out_shardings=opt_sh)(params)
 
-    step = jax.jit(train_step,
-                   in_shardings=(p_shard, opt_sh, b_shard),
-                   out_shardings=(p_shard, opt_sh, rep))
-    return step, init_opt_state, p_shard, b_shard
+    return opt_sh, init_opt_state
