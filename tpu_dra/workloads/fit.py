@@ -59,11 +59,27 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
       and continue — the data iterator starts at the restored step, so the
       batch schedule is exactly what an uninterrupted run would have seen.
     """
+    from tpu_dra.workloads.moe import MoEConfig, init_moe_params
+
+    is_moe = isinstance(cfg, MoEConfig)
     if mesh is None:
         devs = np.array(jax.devices())
-        mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
+        if is_moe:
+            # default MoE mesh: as much expert parallelism as the device
+            # count and expert count share, data parallel over the rest
+            import math
+            ep = math.gcd(len(devs), cfg.n_experts)
+            mesh = Mesh(devs.reshape(len(devs) // ep, ep), ("dp", "ep"))
+        else:
+            mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if is_moe and (accum_steps != 1 or label_smoothing or z_loss):
+        raise ValueError(
+            "MoE fit supports accum_steps=1 without label smoothing / "
+            "z-loss (the MoE step has no microbatch scan)")
+    if is_moe and not {"dp", "ep"} <= set(mesh.axis_names):
+        raise ValueError("MoE fit needs a mesh with 'dp' and 'ep' axes")
     if batch % (mesh.shape["dp"] * accum_steps):
         # each scan microbatch (batch/accum_steps rows) must itself split
         # over dp, or GSPMD reshards the dp-sharded tokens every
@@ -95,13 +111,20 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
             raise ValueError(f"unknown lr_schedule {lr_schedule!r}")
         optimizer = optax.chain(optax.clip_by_global_norm(1.0),
                                 optax.adamw(sched, weight_decay=0.01))
-    step_fn, init_opt, p_shard, b_shard = make_optax_train_step(
-        cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
-        head_impl=head_impl, accum_steps=accum_steps,
-        label_smoothing=label_smoothing, z_loss=z_loss)
+    if is_moe:
+        from tpu_dra.workloads.moe import make_moe_optax_step
+        step_fn, init_opt, p_shard, b_shard = make_moe_optax_step(
+            cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
+            head_impl=head_impl)
+    else:
+        step_fn, init_opt, p_shard, b_shard = make_optax_train_step(
+            cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
+            head_impl=head_impl, accum_steps=accum_steps,
+            label_smoothing=label_smoothing, z_loss=z_loss)
 
     start = 0
-    params = jax.device_put(init_params(cfg, jax.random.PRNGKey(seed)),
+    init_fn = init_moe_params if is_moe else init_params
+    params = jax.device_put(init_fn(cfg, jax.random.PRNGKey(seed)),
                             p_shard)
     opt_state = init_opt(params)
     if resume and checkpoint_dir and latest_step(checkpoint_dir) is not None:
@@ -168,24 +191,45 @@ def evaluate(cfg: ModelConfig, params, data_path: str, *,
     [B, S, V] logits — use it wherever training needed it."""
     from functools import partial
 
+    from tpu_dra.workloads.moe import (
+        MoEConfig,
+        moe_eval_nll,
+        moe_param_shardings,
+    )
     from tpu_dra.workloads.train import (
         batch_sharding,
         loss_fn,
         param_shardings,
     )
 
+    is_moe = isinstance(cfg, MoEConfig)
     if mesh is None:
         devs = np.array(jax.devices())
-        mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
+        if is_moe:
+            import math
+            ep = math.gcd(len(devs), cfg.n_experts)
+            mesh = Mesh(devs.reshape(len(devs) // ep, ep), ("dp", "ep"))
+        else:
+            mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
+    if is_moe and not {"dp", "ep"} <= set(mesh.axis_names):
+        raise ValueError("MoE evaluate needs a mesh with 'dp' and 'ep' "
+                         "axes")
     if batch % mesh.shape["dp"]:
         raise ValueError(
             f"batch {batch} must be divisible by dp {mesh.shape['dp']}")
     ds = TokenDataset(data_path)
-    p_shard = param_shardings(cfg, mesh)
+    if is_moe:
+        # eval metric is PURE NLL: the training objective's aux
+        # load-balance penalty must not inflate reported perplexity
+        p_shard = moe_param_shardings(cfg, mesh)
+        eval_fn = partial(moe_eval_nll, cfg, mesh=mesh,
+                          attn_impl=attn_impl, head_impl=head_impl)
+    else:
+        p_shard = param_shardings(cfg, mesh)
+        eval_fn = partial(loss_fn, cfg, attn_impl=attn_impl,
+                          head_impl=head_impl)
     b_shard = batch_sharding(mesh)
-    loss_j = jax.jit(partial(loss_fn, cfg, attn_impl=attn_impl,
-                             head_impl=head_impl),
-                     in_shardings=(p_shard, b_shard))
+    loss_j = jax.jit(eval_fn, in_shardings=(p_shard, b_shard))
     params = jax.device_put(params, p_shard)
     n_windows = (len(ds) - 1) // cfg.max_seq
     tail_step = max(0, n_windows // batch - batches_n)

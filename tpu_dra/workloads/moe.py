@@ -209,6 +209,14 @@ def moe_loss_fn(cfg: MoEConfig, params, tokens, mesh: Mesh | None = None,
     return nll + cfg.aux_loss_weight * aux
 
 
+def moe_eval_nll(cfg: MoEConfig, params, tokens, mesh: Mesh | None = None,
+                 attn_impl: str = "dense", head_impl: str = "dense"):
+    """Pure next-token NLL (NO aux loss) — the eval metric.  Perplexity
+    must not carry the load-balance penalty the training objective adds."""
+    x, _ = _moe_trunk(cfg, params, tokens[:, :-1], None, mesh, attn_impl)
+    return head_nll(params, x, tokens[:, 1:], head_impl).mean()
+
+
 def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
     """Expert banks over "ep"; everything else replicated (attention could
     additionally be tp-sharded — kept orthogonal here)."""
