@@ -72,6 +72,11 @@ def test_domain_materializes_daemonset_and_rcts(controller):
     assert params["kind"] == "SliceDaemonConfig"
     assert params["domainID"] == uid
 
+    # created AFTER the DaemonSet by the same queue worker — must be
+    # awaited like the DS, or a loaded host flakes here (seen in CI-style
+    # triple-load runs)
+    assert wait_until(lambda: _exists(
+        kube, RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS))
     workload_rct = kube.get(RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS)
     wparams = workload_rct["spec"]["spec"]["devices"]["config"][0]["opaque"][
         "parameters"]
