@@ -158,27 +158,32 @@ class ContinuousEngine:
 
     # -- compiled programs --------------------------------------------------
 
-    def _prefill_impl(self, cfg, params, cache, prompt, length, slot, temp,
-                      key):
-        """Prefill ONE joining sequence into its slot's cache rows and
-        select its first token.  prompt: [1, Sb] right-padded; the pad
-        rows' k/v land in the cache but stay masked (see module doc)."""
-        Sb = prompt.shape[1]
+    def _prefill_impl(self, cfg, params, cache, prompts, lengths, slots,
+                      temps, keys):
+        """Prefill a BATCH of k joining sequences into their slots' cache
+        rows and select each one's first token — a burst of same-bucket
+        admissions pays one dispatch, not k.  prompts: [k, Sb]
+        right-padded; pad rows' k/v land in the cache but stay masked
+        (see module doc).  One program compiles per (Sb, k) pair."""
+        k, Sb = prompts.shape
         small = {name: jnp.zeros(
-            (buf.shape[0], 1, buf.shape[2], Sb, buf.shape[4]), buf.dtype)
+            (buf.shape[0], k, buf.shape[2], Sb, buf.shape[4]), buf.dtype)
             for name, buf in cache.items()}
-        small, x = _prefill_trunk(cfg, params, small, prompt)
-        last = x[jnp.arange(1), length - 1][:, None, :]
-        logits = head_logits(params, last)[:, 0]
+        small, x = _prefill_trunk(cfg, params, small, prompts)
+        last = x[jnp.arange(k), lengths - 1][:, None, :]
+        logits = head_logits(params, last)[:, 0]        # [k, vocab]
         # per-request temperature: greedy when 0, else temperature-scaled
-        # sampling under the engine-global top_k/top_p filters
+        # sampling under the engine-global top_k/top_p filters, each row
+        # drawing from its own request-seeded key
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        sampled = _select_token(logits / jnp.maximum(temp, 1e-6),
-                                key, 1.0, self.top_k, self.top_p)
-        first = jnp.where(temp > 0, sampled, greedy)[0]
-        cache = {name: jax.lax.dynamic_update_slice(
-            cache[name], small[name].astype(cache[name].dtype),
-            (0, slot, 0, 0, 0)) for name in cache}
+        filt = _filter_topk_topp(
+            logits / jnp.maximum(temps, 1e-6)[:, None],
+            self.top_k, self.top_p)
+        sampled = jax.vmap(
+            lambda kk, lg: jax.random.categorical(kk, lg))(keys, filt)
+        first = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+        cache = {name: cache[name].at[:, slots, :, :Sb, :].set(
+            small[name].astype(cache[name].dtype)) for name in cache}
         return cache, first
 
     def _chunk_step_impl(self, cfg, params, cache, token, pos, temp, eos,
