@@ -340,6 +340,12 @@ def section_decode() -> dict:
     int8 = measure(cfg, quant=quantize_params_int8)
     out["decode_int8_tokens_per_s"] = round(B * steps / int8, 1)
     out["decode_int8_ms_per_token"] = round(int8 / steps * 1e3, 3)
+    # int4 weight-only quant (group-scaled nibbles: XLA:TPU packs two per
+    # byte, halving the weight read again vs int8 — quant.quantize_int4)
+    from tpu_dra.workloads.quant import quantize_params_int4
+    int4 = measure(cfg, quant=quantize_params_int4)
+    out["decode_int4_tokens_per_s"] = round(B * steps / int4, 1)
+    out["decode_int4_ms_per_token"] = round(int4 / steps * 1e3, 3)
     # GQA variant: kv_heads = n_heads/4 quarters the cache — the dominant
     # remaining per-step HBM read — without touching the q-side compute
     import dataclasses
@@ -351,6 +357,10 @@ def section_decode() -> dict:
     both = measure(gqa_cfg, quant=quantize_params_int8)
     out["decode_int8_gqa_tokens_per_s"] = round(B * steps / both, 1)
     out["decode_int8_gqa_ms_per_token"] = round(both / steps * 1e3, 3)
+    # int4 + GQA: the minimum-HBM serving point
+    both4 = measure(gqa_cfg, quant=quantize_params_int4)
+    out["decode_int4_gqa_tokens_per_s"] = round(B * steps / both4, 1)
+    out["decode_int4_gqa_ms_per_token"] = round(both4 / steps * 1e3, 3)
     if on_tpu:
         # batch-throughput point: B=32 amortizes the per-step weight read
         # over 4× the tokens (B=64 measured flat — the per-batch work

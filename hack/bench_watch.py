@@ -1,0 +1,110 @@
+"""Opportunistic hardware-evidence capture — run in the background all round.
+
+The TPU tunnel comes and goes (round 3 lost every hardware number to a
+full-round outage).  This watcher probes the backend on a loop; the moment a
+window opens it runs ``bench.py`` (which writes machine-recorded results to
+``bench_cache/<section>.json``) and, once per process lifetime, the flash
+autotune sweep.  Flag files under ``/tmp/bench_watch/`` tell the interactive
+session something landed so it can commit the cache.
+
+    mkdir -p /tmp/bench_watch && \
+        nohup python hack/bench_watch.py >/tmp/bench_watch/watch.log 2>&1 &
+
+State files (all under /tmp/bench_watch/):
+    status        one line per probe: "up <ts>" / "down <ts>"
+    bench.N.log   full bench.py transcript for capture N
+    tune.log      flash_tune transcript (written once)
+    FRESH         exists => a bench capture succeeded since last commit
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE = "/tmp/bench_watch"
+os.makedirs(STATE, exist_ok=True)
+
+PROBE_TIMEOUT_S = 240
+PROBE_INTERVAL_DOWN_S = 300
+REFRESH_INTERVAL_UP_S = 5400
+BENCH_TIMEOUT_S = 4200
+TUNE_TIMEOUT_S = 2400
+
+PROBE_SRC = ("import jax; d = jax.devices(); "
+             "print(d[0].platform, len(d))")
+
+
+def _log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {msg}"
+    print(line, flush=True)
+    with open(os.path.join(STATE, "status"), "a") as f:
+        f.write(line + "\n")
+
+
+def probe() -> bool:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log("down probe-timeout")
+        return False
+    up = out.returncode == 0 and out.stdout.strip().startswith("tpu")
+    _log(f"up {out.stdout.strip()}" if up
+         else f"down rc={out.returncode} {out.stderr.strip()[-200:]}")
+    return up
+
+
+def run_bench(n: int) -> bool:
+    log_path = os.path.join(STATE, f"bench.{n}.log")
+    env = dict(os.environ, BENCH_TPU_BUDGET_S="3300")
+    try:
+        with open(log_path, "w") as f:
+            rc = subprocess.run(
+                [sys.executable, "bench.py"], stdout=f, stderr=f,
+                timeout=BENCH_TIMEOUT_S, cwd=REPO, env=env).returncode
+    except subprocess.TimeoutExpired:
+        _log(f"bench {n} timed out")
+        return False
+    _log(f"bench {n} rc={rc}")
+    if rc == 0:
+        with open(os.path.join(STATE, "FRESH"), "a") as f:
+            f.write(f"{time.time()} bench.{n}\n")
+    return rc == 0
+
+
+def run_tune() -> None:
+    log_path = os.path.join(STATE, "tune.log")
+    try:
+        with open(log_path, "w") as f:
+            rc = subprocess.run(
+                [sys.executable, "hack/flash_tune.py"], stdout=f, stderr=f,
+                timeout=TUNE_TIMEOUT_S, cwd=REPO).returncode
+        _log(f"flash_tune rc={rc}")
+    except subprocess.TimeoutExpired:
+        _log("flash_tune timed out")
+
+
+def main() -> None:
+    n = 0
+    tuned = False
+    while True:
+        if probe():
+            n += 1
+            ok = run_bench(n)
+            if ok and not tuned:
+                run_tune()
+                tuned = True
+            time.sleep(REFRESH_INTERVAL_UP_S if ok
+                       else PROBE_INTERVAL_DOWN_S)
+        else:
+            time.sleep(PROBE_INTERVAL_DOWN_S)
+
+
+if __name__ == "__main__":
+    main()

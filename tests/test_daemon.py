@@ -216,7 +216,7 @@ def coordd_bin():
     time.h regression once hid for a full round behind the skip+stale
     short-circuit while the suite stayed green on the Python fallback."""
     import shutil
-    if shutil.which("g++") is None and shutil.which("make") is None:
+    if shutil.which("g++") is None or shutil.which("make") is None:
         pytest.skip("native toolchain unavailable")
     try:
         subprocess.run(["make", "-C", os.path.join(REPO, "native"), "coordd"],

@@ -1,0 +1,401 @@
+"""Fault injection: deliberate failures at every seam, recovery asserted.
+
+The reference has no fault-injection framework (SURVEY §5 "No fault-injection
+framework exists"); its recovery story is implied by watchdogs, retries, and
+finalizers.  This suite makes ours explicit — each test injects one concrete
+fault (a flaky API server, a SIGKILLed fabric daemon, a corrupted checkpoint,
+a crashed plugin mid-codependent-prepare, a poison workqueue item) and
+asserts the system converges to the correct state afterwards, mapping to the
+recovery mechanisms listed in SURVEY §5 (watchdog process.go:147-179
+analog, retry-with-deadline driver.go:37-48, checkpoint idempotency
+device_state.go:141-146, finalizer/assert teardown computedomain.go:234-268).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_dra.api.types import TpuSliceDomainNode
+from tpu_dra.controller.constants import DOMAIN_LABEL, ds_name
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.daemon.main import write_nodes_config
+from tpu_dra.daemon.process import ProcessManager
+from tpu_dra.k8s import (
+    ApiError,
+    DAEMONSETS,
+    FakeKube,
+    NODES,
+    NotFound,
+    RESOURCE_CLAIM_TEMPLATES,
+    TPU_SLICE_DOMAINS,
+)
+from tpu_dra.plugins.tpu.checkpoint import Checkpoint, CorruptCheckpoint
+from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
+from tpu_dra.util.workqueue import WorkQueue
+from tpu_dra.version import SLICE_DRIVER_NAME
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COORDD = os.path.join(REPO, "native", "coordd")
+NS = "team-a"
+FABRIC = "slice-uuid.0"
+
+
+def wait_until(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class FlakyKube(FakeKube):
+    """FakeKube that fails the first ``fail_n`` calls of each named verb
+    with a retryable ApiError — the injected fault is a flapping API
+    server, which the reference tolerates via client-go's rate-limited
+    retry queue (pkg/workqueue) and we via util/workqueue backoff."""
+
+    def __init__(self, fail_n: int, verbs=("create", "update", "patch",
+                                           "delete", "update_status")):
+        super().__init__()
+        self._fail_n = fail_n
+        self._verbs = verbs
+        self._fail_remaining: dict[str, int] = {}
+        self._flaky_lock = threading.Lock()
+        self.injected = 0
+
+    def arm(self) -> None:
+        """Start injecting (setup calls made before arm() stay clean)."""
+        with self._flaky_lock:
+            self._fail_remaining = {v: self._fail_n for v in self._verbs}
+
+    def _maybe_fail(self, verb):
+        with self._flaky_lock:
+            left = self._fail_remaining.get(verb, 0)
+            if left > 0:
+                self._fail_remaining[verb] = left - 1
+                self.injected += 1
+                raise ApiError(f"injected fault: {verb} unavailable")
+
+    def create(self, res, obj, namespace=None):
+        self._maybe_fail("create")
+        return super().create(res, obj, namespace)
+
+    def update(self, res, obj, namespace=None):
+        self._maybe_fail("update")
+        return super().update(res, obj, namespace)
+
+    def update_status(self, res, obj, namespace=None):
+        self._maybe_fail("update_status")
+        return super().update_status(res, obj, namespace)
+
+    def patch(self, res, name, patch, namespace=None):
+        self._maybe_fail("patch")
+        return super().patch(res, name, patch, namespace)
+
+    def delete(self, res, name, namespace=None):
+        self._maybe_fail("delete")
+        return super().delete(res, name, namespace)
+
+
+def _exists(kube, res, name, ns):
+    try:
+        kube.get(res, name, ns)
+        return True
+    except NotFound:
+        return False
+
+
+def test_controller_converges_through_flaky_api_server():
+    """Domain materialization (finalizer, DaemonSet, both RCTs) completes
+    even when every mutating verb fails several times first."""
+    kube = FlakyKube(fail_n=3)
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    try:
+        kube.arm()
+        # the test's own setup bypasses injection; every controller call
+        # from the creation event onward sees the flaky server
+        created = FakeKube.create(kube, TPU_SLICE_DOMAINS, {
+            "metadata": {"name": "dom", "namespace": NS},
+            "spec": {"numNodes": 2,
+                     "channel": {"resourceClaimTemplate":
+                                 {"name": "dom-channel"}}}})
+        uid = created["metadata"]["uid"]
+        assert wait_until(lambda: _exists(
+            kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+        assert wait_until(lambda: _exists(
+            kube, RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS))
+        assert wait_until(lambda: kube.get(
+            TPU_SLICE_DOMAINS, "dom", NS)["metadata"].get("finalizers"))
+        assert kube.injected > 0, "fault was never injected"
+        # the retries must not have produced duplicates
+        dss = kube.list(DAEMONSETS, "tpu-dra-driver")["items"]
+        assert len([d for d in dss
+                    if d["metadata"]["name"] == ds_name("dom", uid)]) == 1
+    finally:
+        ctrl.stop()
+        kube.close_watchers()
+
+
+def test_teardown_converges_through_flaky_api_server():
+    """Strict ordered teardown (RCTs → DS → labels → finalizers) survives
+    injected delete/update failures: the domain, its DaemonSet, its RCTs,
+    and its node labels are all gone at the end."""
+    kube = FakeKube()
+    kube.create(NODES, {"metadata": {"name": "node-0", "labels": {}}})
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    try:
+        created = kube.create(TPU_SLICE_DOMAINS, {
+            "metadata": {"name": "dom", "namespace": NS},
+            "spec": {"numNodes": 1,
+                     "channel": {"resourceClaimTemplate":
+                                 {"name": "dom-channel"}}}})
+        uid = created["metadata"]["uid"]
+        assert wait_until(lambda: _exists(
+            kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+        # label a node as the plugin would, so teardown has one to clean
+        node = kube.get(NODES, "node-0")
+        node["metadata"].setdefault("labels", {})[DOMAIN_LABEL] = uid
+        kube.update(NODES, node)
+
+        # inject faults only now, so setup was clean and teardown is dirty
+        fails = {"delete": 3, "update": 3, "patch": 3}
+        orig_delete, orig_update, orig_patch = (
+            kube.delete, kube.update, kube.patch)
+        lock = threading.Lock()
+
+        def flaky(verb, orig):
+            def call(*a, **kw):
+                with lock:
+                    if fails[verb] > 0:
+                        fails[verb] -= 1
+                        raise ApiError(f"injected fault: {verb}")
+                return orig(*a, **kw)
+            return call
+
+        kube.delete = flaky("delete", orig_delete)
+        kube.update = flaky("update", orig_update)
+        kube.patch = flaky("patch", orig_patch)
+
+        orig_delete(TPU_SLICE_DOMAINS, "dom", NS)   # setup bypasses faults
+        assert wait_until(
+            lambda: not _exists(kube, TPU_SLICE_DOMAINS, "dom", NS),
+            timeout=30)
+        assert not _exists(kube, DAEMONSETS, ds_name("dom", uid),
+                           "tpu-dra-driver")
+        assert not _exists(kube, RESOURCE_CLAIM_TEMPLATES, "dom-channel", NS)
+        assert wait_until(lambda: DOMAIN_LABEL not in
+                          kube.get(NODES, "node-0")["metadata"]
+                          .get("labels", {}))
+    finally:
+        ctrl.stop()
+        kube.close_watchers()
+
+
+def test_corrupt_checkpoint_fails_loud(tmp_path):
+    """A corrupted checkpoint must refuse to load (CorruptCheckpoint), not
+    silently come up empty — coming up empty would leak prepared devices
+    forever (the checkpoint is the only unprepare source, reference
+    device_state.go:109-125)."""
+    path = tmp_path / "checkpoint.json"
+    cp = Checkpoint(str(path))
+    from tpu_dra.plugins.tpu.allocatable import PreparedClaim
+    cp.put(PreparedClaim(claim_uid="u1", namespace=NS, name="c1"))
+
+    # bit flip inside the payload: CRC32C must catch it
+    envelope = json.loads(path.read_text())
+    envelope["data"] = envelope["data"].replace('"u1"', '"u2"')
+    path.write_text(json.dumps(envelope))
+    with pytest.raises(CorruptCheckpoint, match="checksum"):
+        Checkpoint(str(path)).load()
+
+    # torn write / garbage file
+    path.write_text('{"half an envel')
+    with pytest.raises(CorruptCheckpoint):
+        Checkpoint(str(path)).load()
+
+    # unknown future version with a valid checksum
+    from tpu_dra.tpulib import native
+    payload = json.dumps({"version": "v99", "preparedClaims": {}})
+    path.write_text(json.dumps({"checksum": native.crc32c(payload.encode()),
+                                "data": payload}))
+    with pytest.raises(CorruptCheckpoint, match="version"):
+        Checkpoint(str(path)).load()
+
+
+def _slice_claim(uid, device, kind, domain_uid, node, ns=NS):
+    return {
+        "metadata": {"uid": uid, "namespace": ns, "name": uid},
+        "status": {"allocation": {"devices": {
+            "results": [{"request": "r0", "driver": SLICE_DRIVER_NAME,
+                         "pool": node, "device": device}],
+            "config": [{"requests": ["r0"], "opaque": {
+                "driver": SLICE_DRIVER_NAME,
+                "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": kind, "domainID": domain_uid}}}],
+        }}},
+    }
+
+
+def test_plugin_crash_mid_codependent_prepare_recovers(tmp_path):
+    """A channel prepare that dies while blocked on domain readiness (the
+    codependent-prepare window, reference driver.go:84-90) must be
+    completable by a restarted plugin: the exhausted first attempt rolls its
+    node label back, and the retried claim on the restarted plugin
+    re-labels and succeeds once the domain is Ready."""
+    import shutil
+    import tempfile
+    short = tempfile.mkdtemp(prefix="fi-", dir="/tmp")
+    kube = FakeKube()
+    kube.create(NODES, {"metadata": {"name": "node-0", "labels": {}}})
+    created = kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": 1,
+                 "channel": {"resourceClaimTemplate": {"name": "ch"}}}})
+    uid = created["metadata"]["uid"]
+
+    def mk_driver(retry_timeout):
+        drv = SliceDriver(SliceDriverConfig(
+            node_name="node-0", kube=kube,
+            plugins_dir=os.path.join(short, "plugins"),
+            registry_dir=os.path.join(short, "registry"),
+            cdi_root=os.path.join(short, "cdi"),
+            flock_timeout=2.0, retry_timeout=retry_timeout))
+        drv.start()
+        return drv
+
+    claim = _slice_claim("chan-0", "channel-0", "SliceChannelConfig",
+                         uid, "node-0")
+    drv1 = mk_driver(retry_timeout=1.0)
+    try:
+        assert wait_until(lambda: drv1.manager.get_by_uid(uid))
+        # first attempt: domain never becomes Ready inside the deadline —
+        # the claim fails (retry window expired) and then the plugin "dies"
+        res = drv1.prepare_resource_claims([claim])
+        assert res["chan-0"].error != ""
+        # exhausted retries roll the label back (beyond-reference: a node
+        # must not stay bound to a domain whose prepare never completed)
+        assert DOMAIN_LABEL not in kube.get(
+            NODES, "node-0")["metadata"].get("labels", {})
+    finally:
+        drv1.stop()
+
+    # "restarted" plugin on the same state dirs
+    drv2 = mk_driver(retry_timeout=20.0)
+    try:
+        assert wait_until(lambda: drv2.manager.get_by_uid(uid))
+        done: dict[str, dict] = {}
+        t = threading.Thread(target=lambda: done.update(
+            drv2.prepare_resource_claims([claim])))
+        t.start()
+        # flip the domain Ready as the controller would
+        assert wait_until(lambda: _exists(
+            kube, TPU_SLICE_DOMAINS, "dom", NS))
+        dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+        dom.setdefault("status", {})["status"] = "Ready"
+        kube.update_status(TPU_SLICE_DOMAINS, dom)
+        t.join(timeout=25)
+        assert not t.is_alive()
+        assert done["chan-0"].error == "", done["chan-0"].error
+        assert done["chan-0"].devices[0]["device_name"] == "channel-0"
+    finally:
+        drv2.stop()
+        kube.close_watchers()
+        shutil.rmtree(short, ignore_errors=True)
+
+
+@pytest.fixture(scope="module")
+def coordd_bin():
+    import shutil
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("native toolchain unavailable")
+    subprocess.run(["make", "-C", os.path.join(REPO, "native"), "coordd"],
+                   check=True, capture_output=True, text=True, timeout=120)
+    assert os.path.exists(COORDD)
+    return COORDD
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_coordd_sigkill_watchdog_restarts_and_reconverges(coordd_bin,
+                                                          tmp_path):
+    """SIGKILL the native fabric daemon mid-flight: the ProcessManager
+    watchdog must restart it (reference process.go:147-179), the restarted
+    daemon must re-serve READY from the on-disk config, and a membership
+    change written AFTER the crash must still be picked up."""
+    port = _free_port()
+    write_nodes_config(str(tmp_path), [
+        TpuSliceDomainNode("n0", "10.0.0.10", FABRIC, 0)], FABRIC)
+    pm = ProcessManager(
+        argv_fn=lambda: [coordd_bin, "--settings-dir", str(tmp_path),
+                         "--port", str(port), "--address", "127.0.0.1"],
+        name="coordd", watchdog_interval=0.05)
+    pm.restart()
+    pm.start_watchdog()
+
+    def ready():
+        try:
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/ready",
+                timeout=1).read() == b"READY\n"
+        except OSError:
+            return False
+
+    try:
+        assert wait_until(ready)
+        pid_before = pm._proc.pid
+        os.kill(pid_before, 9)                      # the injected fault
+        assert wait_until(lambda: pm.restarts >= 1 and pm.alive(), 10)
+        assert pm._proc.pid != pid_before
+        assert wait_until(ready, 10)
+
+        # post-crash membership change flows through the restarted daemon
+        write_nodes_config(str(tmp_path), [
+            TpuSliceDomainNode("n9", "10.0.0.99", FABRIC, 0)], FABRIC)
+        assert wait_until(lambda: urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/coordinator",
+            timeout=1).read() == b"10.0.0.99:8476", 10)
+    finally:
+        pm.stop_watchdog()
+        pm.stop()
+
+
+def test_workqueue_poison_item_does_not_starve_queue():
+    """An always-failing item keeps retrying with backoff but must not
+    block other items from completing (single-worker queue semantics,
+    reference workqueue.go:84-111)."""
+    q = WorkQueue(name="fi")
+    worker = threading.Thread(target=q.run, daemon=True)
+    worker.start()
+    done = threading.Event()
+    poison_calls = []
+
+    def poison(_):
+        poison_calls.append(time.monotonic())
+        raise RuntimeError("always fails")
+
+    try:
+        q.enqueue(poison, {"metadata": {"uid": "poison"}}, key="poison")
+        q.enqueue(lambda obj: done.set(), {"metadata": {"uid": "ok"}},
+                  key="ok")
+        assert done.wait(10), "healthy item starved by poison item"
+        # the poison item is still being retried, not dropped
+        n = len(poison_calls)
+        assert wait_until(lambda: len(poison_calls) > n, 10)
+    finally:
+        q.shutdown()
+        worker.join(timeout=5)

@@ -425,64 +425,107 @@ class ContinuousEngine:
         raise ValueError(n)
 
     def _admit(self) -> None:
-        """Fill free slots from the FIFO queue (join at chunk boundary)."""
+        """Fill free slots from the FIFO queue (join at chunk boundary).
+
+        Plain admissions that land in the same prompt bucket are batched
+        into ONE ``[k, Sb]`` prefill dispatch (_prefill_impl); prefix
+        joins dispatch singly (their program shape depends on the prefix
+        bucket too).  Reproducibility is per row: each request's sampling
+        key chain is a pure function of its own seed, so batching never
+        changes its tokens."""
+        assigned: list[tuple[int, _Request]] = []
         for slot in range(self.slots):
             if self._requests[slot] is not None or not self._pending:
                 continue
-            req = self._pending.popleft()
-            Sb = self._bucket(len(req.prompt))
-            prompt = jnp.asarray(
-                [req.prompt + [0] * (Sb - len(req.prompt))], jnp.int32)
-            # reproducible sampling: the key chain is a pure function of
-            # the request's seed (fold 0 draws the first token, the rest
-            # of the stream advances per step in the chunk scan)
-            key = jax.random.PRNGKey(req.seed)
-            pref = None
+            assigned.append((slot, self._pending.popleft()))
+        plain: dict[int, list[tuple[int, _Request]]] = {}
+        for slot, req in assigned:
             if req.prefix_id is not None:
-                with self._cv:
-                    pref = self._prefixes.get(req.prefix_id)
-            if pref is not None:
-                # shared-prefix join: copy the prefix KV, prefill only
-                # the suffix at positions [plen, plen+Sb)
-                cache, first = self._join_fn(Sb, pref.bucket)(
-                    self.params, self._cache, pref.kv, prompt,
-                    jnp.asarray([len(req.prompt)], jnp.int32),
-                    jnp.int32(pref.length), jnp.int32(slot),
-                    jnp.float32(req.temperature),
-                    jax.random.fold_in(key, 0))
-                start_pos = pref.length + len(req.prompt)
-            elif req.prefix_id is not None:
-                # prefix evicted between submit and admission: fail the
-                # request instead of silently decoding without context
-                req.error = (f"prefix {req.prefix_id!r} evicted before "
-                             f"admission; re-register and resubmit")
-                req.done.set()
-                continue
+                self._admit_prefix(slot, req)
             else:
-                cache, first = self._prefill_fn(Sb)(
-                    self.params, self._cache, prompt,
-                    jnp.asarray([len(req.prompt)], jnp.int32),
-                    jnp.int32(slot), jnp.float32(req.temperature),
-                    jax.random.fold_in(key, 0))
-                start_pos = len(req.prompt)
-            self._cache = cache
-            first_host = int(first)
-            self._token = self._token.at[slot].set(first_host)
-            self._pos = self._pos.at[slot].set(start_pos)
-            self._temp = self._temp.at[slot].set(req.temperature)
-            self._keys = self._keys.at[slot].set(jax.random.fold_in(key, 1))
-            self._eos = self._eos.at[slot].set(
-                -1 if req.eos_id is None else req.eos_id)
-            req.tokens.append(first_host)
-            self._emitted[slot] = 1
-            finished = (req.eos_id is not None and first_host == req.eos_id
-                        ) or req.steps == 1
-            if finished:
-                self._retire(slot, req)
-                self._requests[slot] = None
-            else:
-                self._done = self._done.at[slot].set(False)
-                self._requests[slot] = req
+                plain.setdefault(
+                    self._bucket(len(req.prompt)), []).append((slot, req))
+        for Sb, group in plain.items():
+            # power-of-two chunks: the (Sb, k) program grid stays
+            # O(buckets · log2(slots)) and every size is reused, instead
+            # of lazily compiling one program per distinct burst size on
+            # the serving path (a k=5 burst would stall all five clients
+            # behind a fresh compile; 4+1 reuses warm programs)
+            while group:
+                take = 1 << (len(group).bit_length() - 1)
+                self._admit_plain(Sb, group[:take])
+                group = group[take:]
+
+    def _admit_plain(self, Sb: int,
+                     group: list[tuple[int, "_Request"]]) -> None:
+        """One prefill dispatch for a same-bucket plain admission chunk."""
+        k = len(group)
+        prompts = jnp.asarray(
+            [req.prompt + [0] * (Sb - len(req.prompt))
+             for _, req in group], jnp.int32)            # [k, Sb]
+        lengths = jnp.asarray([len(req.prompt) for _, req in group],
+                              jnp.int32)
+        slots = jnp.asarray([slot for slot, _ in group], jnp.int32)
+        temps = jnp.asarray([req.temperature for _, req in group],
+                            jnp.float32)
+        # reproducible sampling: each key chain is a pure function of its
+        # request's seed (fold 0 draws the first token, the rest of the
+        # stream advances per step in the chunk scan)
+        base_keys = [jax.random.PRNGKey(req.seed) for _, req in group]
+        keys0 = jnp.stack([jax.random.fold_in(kk, 0) for kk in base_keys])
+        cache, first = self._prefill_fn(Sb)(
+            self.params, self._cache, prompts, lengths, slots, temps,
+            keys0)
+        self._cache = cache
+        firsts = [int(t) for t in first.tolist()]   # ONE device readback
+        for (slot, req), key, first_host in zip(group, base_keys, firsts):
+            self._finish_admission(slot, req, first_host,
+                                   len(req.prompt), key)
+
+    def _admit_prefix(self, slot: int, req: "_Request") -> None:
+        """Shared-prefix join: copy the prefix KV, prefill only the
+        suffix at positions [plen, plen+Sb)."""
+        with self._cv:
+            pref = self._prefixes.get(req.prefix_id)
+        if pref is None:
+            # prefix evicted between submit and admission: fail the
+            # request instead of silently decoding without context
+            req.error = (f"prefix {req.prefix_id!r} evicted before "
+                         f"admission; re-register and resubmit")
+            req.done.set()
+            return
+        Sb = self._bucket(len(req.prompt))
+        prompt = jnp.asarray(
+            [req.prompt + [0] * (Sb - len(req.prompt))], jnp.int32)
+        key = jax.random.PRNGKey(req.seed)
+        cache, first = self._join_fn(Sb, pref.bucket)(
+            self.params, self._cache, pref.kv, prompt,
+            jnp.asarray([len(req.prompt)], jnp.int32),
+            jnp.int32(pref.length), jnp.int32(slot),
+            jnp.float32(req.temperature),
+            jax.random.fold_in(key, 0))
+        self._cache = cache
+        self._finish_admission(slot, req, int(first),
+                               pref.length + len(req.prompt), key)
+
+    def _finish_admission(self, slot: int, req: "_Request",
+                          first_host: int, start_pos: int, key) -> None:
+        self._token = self._token.at[slot].set(first_host)
+        self._pos = self._pos.at[slot].set(start_pos)
+        self._temp = self._temp.at[slot].set(req.temperature)
+        self._keys = self._keys.at[slot].set(jax.random.fold_in(key, 1))
+        self._eos = self._eos.at[slot].set(
+            -1 if req.eos_id is None else req.eos_id)
+        req.tokens.append(first_host)
+        self._emitted[slot] = 1
+        finished = (req.eos_id is not None and first_host == req.eos_id
+                    ) or req.steps == 1
+        if finished:
+            self._retire(slot, req)
+            self._requests[slot] = None
+        else:
+            self._done = self._done.at[slot].set(False)
+            self._requests[slot] = req
 
     def _retire(self, slot: int, req: _Request) -> None:
         req.finished = time.perf_counter()

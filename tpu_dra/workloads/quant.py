@@ -117,6 +117,61 @@ def _int8_matmul_bwd(res, g):
 int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
 
 
+def quantize_int4(w: jax.Array, group: int = 128) -> dict[str, jax.Array]:
+    """``[..., K, N]`` float → ``{"q4": int4, "s4": f32[..., K/G, N]}``
+    with symmetric per-(K-group, output-channel) scales.
+
+    int4 needs finer scale granularity than int8's per-column: one outlier
+    in a 2048-long column would cost most of the 4-bit grid.  Scales are
+    per ``group`` positions of the contraction axis (GPTQ/AWQ-style
+    group-wise quant), so an outlier only degrades its own group.
+
+    Storage is ``jnp.int4`` — XLA:TPU packs two nibbles per byte in HBM,
+    so the decode-path weight read halves again vs int8 (CPU stores int4
+    unpacked; the bandwidth win is a TPU property, measured by bench.py
+    ``section_decode``'s int4 config).  ``group`` is clamped to K for
+    small models and must divide K.
+    """
+    wf = w.astype(jnp.float32)
+    k = wf.shape[-2]
+    group = min(group, k)
+    if k % group:
+        raise ValueError(f"group {group} must divide K {k}")
+    grouped = wf.reshape(*wf.shape[:-2], k // group, group, wf.shape[-1])
+    amax = jnp.max(jnp.abs(grouped), axis=-2)             # [..., K/G, N]
+    s = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(grouped / s[..., None, :]), -7, 7)
+    return {"q4": q.reshape(wf.shape).astype(jnp.int4), "s4": s}
+
+
+def int4_matmul(x: jax.Array, q4: jax.Array, s4: jax.Array) -> jax.Array:
+    """``x [..., K] @ q4 [K, N] (int4, group scales s4 [K/G, N])`` →
+    fp32 ``[..., N]``.
+
+    The per-group partial products are computed first and the scales
+    applied after (two einsums), so the int4→bf16 convert fuses into the
+    first dot's operand load and no dequantized ``[K, N]`` copy is ever
+    materialized in HBM — the weight traffic is the packed nibbles plus
+    the scale vectors.  Weight-only (activations stay bf16), so plain
+    autodiff gives the exact dx; the integer primal's cotangent is
+    JAX's float0 automatically (no STE needed, unlike int8_matmul's
+    dynamic activation quantization).
+    """
+    k, n = q4.shape
+    ngroups = s4.shape[0]
+    gsz = k // ngroups
+    # bf16 operands keep the MXU at full rate with f32 accumulate; the CPU
+    # backend's dot thunk has no bf16×bf16→f32 mode, so tests (and any
+    # non-TPU run) take f32 operands — same math, portable
+    cdt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    xg = x.reshape(*x.shape[:-1], ngroups, gsz).astype(cdt)
+    wg = q4.reshape(ngroups, gsz, n).astype(cdt)
+    yg = jnp.einsum("...gk,gkn->...gn", xg, wg,
+                    preferred_element_type=jnp.float32)
+    return jnp.einsum("...gn,gn->...n", yg, s4,
+                      preferred_element_type=jnp.float32)
+
+
 def quantize_kv(t: jax.Array) -> tuple[jax.Array, jax.Array]:
     """``[..., m, Dh]`` bf16 k/v chunk → ``(int8 [..., m, Dh],
     f32 scales [..., m, 1])`` with symmetric per-position scales.
@@ -138,6 +193,10 @@ def is_quantized(w: Leaf) -> bool:
     return isinstance(w, dict) and "q8" in w
 
 
+def is_quantized4(w: Leaf) -> bool:
+    return isinstance(w, dict) and "q4" in w
+
+
 def is_lora(w: Leaf) -> bool:
     return isinstance(w, dict) and "a" in w and "b" in w
 
@@ -149,6 +208,7 @@ def matmul_any(x: jax.Array, w: Leaf, dtype=None) -> jax.Array:
 
     - plain array: ``x @ w`` in ``dtype`` (default: x.dtype)
     - ``{"q8", "s"}``: int8 MXU matmul, result cast to ``dtype``
+    - ``{"q4", "s4"}``: group-scaled int4 weight-only matmul
     - ``{"base", "a", "b", "scale"}`` (lora.py): recursive base matmul
       (the frozen base may itself be plain or int8) plus the rank-r
       adapter path ``scale · (x·A)·B`` — r ≪ K, so the adapter adds
@@ -162,6 +222,8 @@ def matmul_any(x: jax.Array, w: Leaf, dtype=None) -> jax.Array:
         return base + ab
     if is_quantized(w):
         return int8_matmul(x, w["q8"], w["s"]).astype(out_dtype)
+    if is_quantized4(w):
+        return int4_matmul(x, w["q4"], w["s4"]).astype(out_dtype)
     return x @ w.astype(out_dtype)
 
 
@@ -177,13 +239,12 @@ def cast_params_bf16(params: dict) -> dict:
     return jax.tree.map(cast, params)
 
 
-def quantize_params_int8(params: dict) -> dict:
-    """fp32/bf16 training params → int8 serving params.
-
-    Big matmul weights (per layer: wqkv/wo/w1/w2 + MoE variants; top
-    level: unembed) become ``{"q8", "s"}`` subtrees; everything else is
-    cast to bf16.  The layer stack keeps its leading L dim — ``lax.scan``
-    slices the q8/s leaves per layer exactly as it sliced the fp32 ones.
+def _quantize_params(params: dict, qfn) -> dict:
+    """Shared leaf-selection for the serving quantizers: big matmul
+    weights (per layer: wqkv/wo/w1/w2 + MoE variants; top level: unembed)
+    are replaced by ``qfn(leaf)`` subtrees; everything else is cast to
+    bf16.  The layer stack keeps its leading L dim — ``lax.scan`` slices
+    the quantized leaves per layer exactly as it sliced the fp32 ones.
     """
     out = dict(cast_params_bf16(params))
     blocks = dict(out["blocks"])
@@ -192,18 +253,28 @@ def quantize_params_int8(params: dict) -> dict:
         # bf16-cast copies — no double rounding.  ndim == 3 restricts to
         # [L, K, N] dense stacks (see _QUANT_BLOCK_LEAVES note); dict
         # leaves (already-quantized or LoRA-wrapped — merge_lora first)
-        # are skipped.
+        # are skipped; plain array-likes (jax OR numpy, e.g. an orbax
+        # restore without a template) quantize.
         leaf = params["blocks"].get(name)
-        # dict leaves = already-quantized or LoRA-wrapped subtrees; plain
-        # array-likes (jax OR numpy, e.g. an orbax restore without a
-        # template) quantize
         if leaf is not None and not isinstance(leaf, dict) and \
                 leaf.ndim == 3:
-            blocks[name] = quantize_int8(leaf)
+            blocks[name] = qfn(leaf)
     out["blocks"] = blocks
     for name in _QUANT_TOP_LEAVES:
         leaf = params.get(name)
         if leaf is not None and not isinstance(leaf, dict) and \
                 leaf.ndim == 2:
-            out[name] = quantize_int8(leaf)
+            out[name] = qfn(leaf)
     return out
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """fp32/bf16 training params → int8 serving params (``{"q8", "s"}``
+    leaves; see :func:`_quantize_params` for the shared tree rules)."""
+    return _quantize_params(params, quantize_int8)
+
+
+def quantize_params_int4(params: dict, group: int = 128) -> dict:
+    """fp32/bf16 training params → int4 serving params (``{"q4", "s4"}``
+    leaves; see :func:`_quantize_params` for the shared tree rules)."""
+    return _quantize_params(params, lambda w: quantize_int4(w, group))

@@ -85,8 +85,8 @@ class DecoderPool:
 
     def __init__(self, cfg: ModelConfig, params,
                  cache_dtype: str = "bf16"):
-        """``params`` may be a full-precision, bf16-cast, or int8-quantized
-        tree (quant.py) — the decode paths dispatch per weight leaf.
+        """``params`` may be a full-precision, bf16-cast, or int8/int4-
+        quantized tree (quant.py) — the decode paths dispatch per leaf.
         ``cache_dtype="int8"`` serves with a quantized KV cache."""
         self.cfg = cfg
         self.params = params
@@ -698,10 +698,11 @@ def main(argv=None):
     ap.add_argument("--max-seq", type=int, default=512)
     ap.add_argument("--pos-emb", default="rope")
     ap.add_argument("--weights", default="fp32",
-                    choices=("fp32", "bf16", "int8"),
+                    choices=("fp32", "bf16", "int8", "int4"),
                     help="serving weight form (quant.py): fp32 serves "
-                         "the checkpoint unmodified; bf16 halves and "
-                         "int8 quarters the per-token weight read")
+                         "the checkpoint unmodified; bf16 halves, int8 "
+                         "quarters, int4 eighths the per-token weight "
+                         "read (group-scaled nibbles)")
     ap.add_argument("--cache-dtype", default="bf16",
                     choices=("bf16", "int8"))
     ap.add_argument("--continuous", action="store_true",
@@ -731,9 +732,11 @@ def main(argv=None):
     params = restore_train_state(args.checkpoint_dir)["params"]
     if args.weights != "fp32":
         from tpu_dra.workloads.quant import (cast_params_bf16,
+                                             quantize_params_int4,
                                              quantize_params_int8)
-        params = (quantize_params_int8(params) if args.weights == "int8"
-                  else cast_params_bf16(params))
+        params = {"int8": quantize_params_int8,
+                  "int4": quantize_params_int4,
+                  "bf16": cast_params_bf16}[args.weights](params)
     draft = None
     if args.draft_checkpoint_dir:
         draft_cfg = ModelConfig(
