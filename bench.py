@@ -168,6 +168,21 @@ def section_flash() -> dict:
     tflops_fb = 3 * flops / secs_fb / 1e12
     out["pallas_flash_fwd_bwd_tflops_effective"] = round(tflops_fb, 2)
     out["pallas_flash_fwd_bwd_mfu_pct"] = _mfu(tflops_fb, dev)
+    # GQA (4 q heads per kv head on TPU; 2 on the tiny CPU shape so the
+    # grouped kernel still runs): the grouped forward fetches each kv
+    # block once per GROUP (kv HBM traffic ÷ g vs MHA at identical q
+    # flops) — the gap to the MHA number above is the bandwidth win
+    hkv = bh // 4 if on_tpu else bh // 2
+    kg, vg = (jax.random.normal(kk, (1, hkv, s, d), jnp.bfloat16)
+              for kk in ks[1:])
+    secs_g = _time_op(
+        lambda x: flash_attention(x, kg, vg, causal=True,
+                                  interpret=not on_tpu),
+        q, iters=100 if on_tpu else 2)
+    tflops_g = flops / secs_g / 1e12
+    out["pallas_flash_gqa4_tflops"] = round(tflops_g, 2)
+    out["pallas_flash_gqa4_mfu_pct"] = _mfu(tflops_g, dev)
+    out["pallas_flash_gqa4_group"] = bh // hkv
     return out
 
 

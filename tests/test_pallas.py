@@ -196,9 +196,10 @@ def test_flash_attention_cross_length_grads():
 @pytest.mark.parametrize("hkv", [1, 2])   # MQA and 2-group GQA
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_attention_gqa_matches_repeated_kv(causal, hkv):
-    """GQA kv-head sharing (index-map // g, no repeat materialization) must
-    equal running the kernel on explicitly repeated kv — values and all
-    three gradients (dk/dv group-sum path included)."""
+    """GQA kv-head sharing (grouped forward kernel + backward index maps,
+    no repeat materialization) must equal running the kernel on explicitly
+    repeated kv — values and all three gradients (dk/dv group-sum path
+    included)."""
     b, h, s, d = 1, 4, 256, 64
     g = h // hkv
     ks = jax.random.split(jax.random.PRNGKey(11), 4)

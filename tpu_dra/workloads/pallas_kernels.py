@@ -70,6 +70,50 @@ def matmul(x, y, *, bm: int = 1024, bn: int = 1024, bk: int = 512,
     )(x, y)
 
 
+def _online_softmax_step(q_blk, k_blk, v_blk, mask, m_prev, l_prev, acc):
+    """ONE flash step on values (not refs), shared by the per-head and
+    grouped-GQA kernels: score block → online-softmax update →
+    ``(m_new, l_new, acc_new)``.
+
+    q arrives pre-scaled by softmax_scale·log2(e) (see _flash_attn_fwd),
+    so scores are already in base-2 log space: the softmax uses exp2,
+    which is cheaper on the VPU than exp, and no per-score scale multiply
+    is needed.  q/k stay in their storage dtype (bf16) so the QK^T matmul
+    runs at the MXU's bf16 rate; preferred_element_type gives fp32
+    accumulate (an fp32 upcast here would quarter MXU throughput on v5e).
+    ``mask=None`` selects the mask-free path.
+    """
+    neg = jnp.finfo(jnp.float32).min
+    s = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if mask is not None:
+        s = jnp.where(mask, s, neg)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    if mask is not None:
+        # Fully-masked-so-far rows: exp2(neg - neg) == 1 would leak
+        # weight — recompute against 0 and zero the masked entries
+        # explicitly (same safety pattern as ring_attention._block_attn).
+        safe_m = jnp.where(m_new == neg, 0.0, m_new)
+    else:
+        safe_m = m_new                          # scores finite ⇒ m_new is
+    p = jnp.exp2(s - safe_m)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.where(m_prev == neg, 0.0, jnp.exp2(m_prev - safe_m))
+    acc_new = acc * corr + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    return m_new, l_new, acc_new
+
+
+def _causal_block_mask(i, j, bq: int, bk: int):
+    rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
 def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, l2_ref, m_ref, l_ref,
                        acc_ref, *, k_steps: int, causal: bool,
                        bq: int, bk: int):
@@ -91,39 +135,11 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, l2_ref, m_ref, l_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     def _compute(masked: bool):
-        # q arrives pre-scaled by softmax_scale·log2(e) (see _flash_attn_fwd),
-        # so scores are already in base-2 log space: the softmax uses exp2,
-        # which is cheaper on the VPU than exp, and no per-score scale
-        # multiply is needed.  q/k stay in their storage dtype (bf16) so the
-        # QK^T matmul runs at the MXU's bf16 rate; preferred_element_type
-        # gives fp32 accumulate.  An fp32 upcast here would quarter the MXU
-        # throughput on v5e.
-        s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if masked:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = rows >= cols
-            s = jnp.where(mask, s, neg)
-        m_prev = m_ref[:, :1]                       # [bq, 1]
-        l_prev = l_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        if masked:
-            # Fully-masked-so-far rows: exp2(neg - neg) == 1 would leak
-            # weight — recompute against 0 and zero the masked entries
-            # explicitly (same safety pattern as ring_attention._block_attn).
-            safe_m = jnp.where(m_new == neg, 0.0, m_new)
-        else:
-            safe_m = m_new                          # scores finite ⇒ m_new is
-        p = jnp.exp2(s - safe_m)
-        if masked:
-            p = jnp.where(mask, p, 0.0)
-        corr = jnp.where(m_prev == neg, 0.0, jnp.exp2(m_prev - safe_m))
-        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        mask = _causal_block_mask(i, j, bq, bk) if masked else None
+        m_new, l_new, acc_new = _online_softmax_step(
+            q_ref[0], k_ref[0], v_ref[0], mask,
+            m_ref[:, :1], l_ref[:, :1], acc_ref[:])
+        acc_ref[:] = acc_new
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -153,31 +169,131 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, l2_ref, m_ref, l_ref,
 _LOG2E = 1.4426950408889634
 
 
+def _cap_block(n: int, want: int) -> int:
+    """Largest block ≤ ``want`` (reached by halving) that divides ``n`` —
+    shapes are 128-multiples, so this lands on a legal tile."""
+    b = min(n, want)
+    while n % b:
+        b //= 2
+    return b
+
+
+def _flash_attn_gqa_kernel(q_ref, k_ref, v_ref, out_ref, l2_ref, m_ref,
+                           l_ref, acc_ref, *, k_steps: int, causal: bool,
+                           bq: int, bk: int, g: int):
+    """GQA forward with the head group INSIDE the kernel: one resident
+    k/v block feeds ``g`` q heads (statically unrolled), so kv HBM
+    traffic is divided by the group size versus the broadcast index-map
+    path, which re-streams the full kv per (q-head, q-block) grid step.
+    The causal mask is built once per block and reused across the group.
+    Scratch carries per-head online-softmax state ``[g, bq, ·]``."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    neg = jnp.finfo(jnp.float32).min
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, neg)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute(masked: bool):
+        # mask built ONCE per block, shared across the head group
+        mask = _causal_block_mask(i, j, bq, bk) if masked else None
+        for h in range(g):
+            m_new, l_new, acc_new = _online_softmax_step(
+                q_ref[h], k_ref[0], v_ref[0], mask,
+                m_ref[h, :, :1], l_ref[h, :, :1], acc_ref[h])
+            acc_ref[h] = acc_new
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    if not causal:
+        _compute(masked=False)
+    else:
+        run = j * bk < (i + 1) * bq
+        straddles = (j + 1) * bk - 1 > i * bq
+        pl.when(run & straddles)(lambda: _compute(masked=True))
+        pl.when(run & jnp.logical_not(straddles))(
+            lambda: _compute(masked=False))
+
+    @pl.when(j == k_steps - 1)
+    def _flush():
+        for h in range(g):
+            l = jnp.maximum(l_ref[h, :, :1], 1e-30)
+            out_ref[h] = (acc_ref[h] / l).astype(out_ref.dtype)
+            l2_ref[h] = m_ref[h, :, :1] + jnp.log2(l)
+
+
+def _flash_attn_fwd_gqa(q, k, v, *, causal: bool, bq: int, bk: int,
+                        interpret: bool):
+    """Grouped-forward dispatch for GQA/MQA (``g = BH/BHkv > 1``): grid
+    over kv heads, q block ``[g, bq, d]`` covering the whole group.  The
+    flat fold makes the group contiguous (rows ``b·g .. (b+1)·g-1`` of q
+    share kv row ``b``), so the kv index map is the identity — no ``//g``
+    to obscure Mosaic's invariant-block analysis.  Output layout matches
+    _flash_attn_fwd exactly (the backward kernels are shared)."""
+    bh, s, d = q.shape
+    bhkv, sk = k.shape[0], k.shape[1]
+    g = bh // bhkv
+    # VMEM guard: per-head scratch+blocks ≈ bq·(8d + 1024) bytes; keep the
+    # group's working set under ~8 MB by shrinking bq at high g, then land
+    # on a divisor of the sequence (a halved bq need not divide s)
+    want = bq
+    while g * want * (8 * d + 1024) > 8 * 2**20 and want > 128:
+        want //= 2
+    bq, bk = _cap_block(s, want), _cap_block(sk, bk)
+    assert s % bq == 0 and sk % bk == 0, \
+        f"seq lens {(s, sk)} must tile by {(bq, bk)}"
+    k_steps = sk // bk
+    q = (q * (d ** -0.5 * _LOG2E)).astype(q.dtype)
+    return pl.pallas_call(
+        functools.partial(_flash_attn_gqa_kernel, k_steps=k_steps,
+                          causal=causal, bq=bq, bk=bk, g=g),
+        grid=(bhkv, s // bq, k_steps),
+        in_specs=[
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, bq, 128), jnp.float32),
+                        pltpu.VMEM((g, bq, 128), jnp.float32),
+                        pltpu.VMEM((g, bq, d), jnp.float32)],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
 def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
                     interpret: bool):
     """Returns ``(out, l2)`` — l2 is the per-row base-2 logsumexp
     ``[BH, S, 1]`` residual consumed by the backward kernels.
 
-    GQA/MQA: ``k``/``v`` may carry fewer head-batches than ``q``
-    (``BHkv = BH / g``).  kv sharing costs nothing — the k/v BlockSpec
-    index maps divide the head-batch grid index by ``g``, so the same kv
-    block feeds ``g`` consecutive q heads without materializing a repeat.
-    (Flat layout makes this exact: with heads minor in the fold,
-    ``(batch·H + h) // g == batch·Hkv + h//g``.)"""
+    GQA/MQA (``BHkv = BH / g < BH``) dispatches to the grouped kernel
+    (_flash_attn_fwd_gqa): the head group lives INSIDE the kernel, so
+    each kv block is fetched once per group rather than once per head."""
     bh, s, d = q.shape
     bhkv, sk = k.shape[0], k.shape[1]
     assert bh % bhkv == 0, (bh, bhkv)
     g = bh // bhkv
+    if g > 1:
+        # grouped forward: kv blocks fetched once per head GROUP, not
+        # once per head (kv HBM traffic ÷ g)
+        return _flash_attn_fwd_gqa(q, k, v, causal=causal, bq=bq, bk=bk,
+                                   interpret=interpret)
     bq, bk = min(bq, s), min(bk, sk)
     assert s % bq == 0 and sk % bk == 0, \
         f"seq lens {(s, sk)} must tile by {(bq, bk)}"
     k_steps = sk // bk
     grid = (bh, s // bq, k_steps)
-    # Plain map when there is no GQA sharing: an identity ``b // g``
-    # obscures the index from Mosaic's invariant-block analysis (see the
-    # backward's kv_map note — measured 3× there).
-    kv_map = (lambda b, i, j: (b, j, 0)) if g == 1 else \
-        (lambda b, i, j: (b // g, j, 0))
+    kv_map = lambda b, i, j: (b, j, 0)
     # Fold softmax scale and the exp→exp2 base change into q once ([S, D])
     # instead of per score block ([S, S] · k_steps): the kernel's softmax
     # then runs in base-2 log space with no per-block scale pass.
@@ -421,12 +537,7 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
     bhkv, sk = k.shape[0], k.shape[1]
     assert bh % bhkv == 0, (bh, bhkv)
     grp = bh // bhkv
-    def _cap(n, want):
-        # largest block ≤ want that divides n (shapes are 128-multiples)
-        b = min(n, want)
-        while n % b:
-            b //= 2
-        return b
+    _cap = _cap_block
 
     # The caller's bq/bk still cap the backward blocks (tests pass tiny
     # blocks to exercise the multi-block causal paths under interpret);
