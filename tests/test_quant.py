@@ -384,3 +384,41 @@ def test_int4_composes_with_int8_kv_cache(small):
     toks = greedy_decode(cfg, quantize_params_int4(params), prompt,
                          steps=steps, cache_dtype="int8")
     assert toks.shape == (B, steps)
+
+
+def test_serving_shardings_tp_mesh_quantized_decode(small):
+    """int8 and int4 trees decode under a TP mesh with
+    serving_param_shardings and produce the same tokens as single-device
+    execution of the same quantized tree."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_dra.workloads.quant import (quantize_params_int4,
+                                         quantize_params_int8,
+                                         serving_param_shardings)
+    cfg, params = small
+    B, S, steps = 2, 6, 4
+    prompt = jax.random.randint(jax.random.PRNGKey(14), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    for quant in (quantize_params_int8, quantize_params_int4):
+        qp = quant(params)
+        ref = greedy_decode(cfg, qp, prompt, steps=steps)
+        sh = serving_param_shardings(cfg, mesh, qp)
+        qp_sharded = jax.device_put(qp, sh)
+        toks = greedy_decode(cfg, qp_sharded, prompt, steps=steps)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_serving_shardings_plain_tree_matches_train_shardings(small):
+    """A non-quantized serving tree gets exactly train.param_shardings."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_dra.workloads.quant import serving_param_shardings
+    from tpu_dra.workloads.train import param_shardings
+    cfg, params = small
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    got = serving_param_shardings(cfg, mesh, cast_params_bf16(params))
+    want = param_shardings(cfg, mesh)
+    assert jax.tree.structure(got) == jax.tree.structure(want)

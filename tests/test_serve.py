@@ -402,3 +402,34 @@ def test_keepalive_connection_survives_early_errors():
         conn.close()
     finally:
         srv.shutdown()
+
+
+def test_weights_cache_form_and_shape_mismatch_error(tmp_path):
+    """A populated --weights-cache that contradicts the requested form or
+    model flags must be a hard startup error, never a silent stale
+    serve."""
+    import pytest
+
+    from tpu_dra.workloads import serve
+    from tpu_dra.workloads.checkpointing import save_serving_state
+    from tpu_dra.workloads.quant import quantize_params_int8
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    qp = quantize_params_int8(init_params(cfg, jax.random.PRNGKey(0)))
+    dims = {"vocab": 64, "d_model": 32, "n_heads": 2, "n_kv_heads": None,
+            "n_layers": 2, "d_ff": 64, "pos_emb": "rope"}
+    flags = ["--vocab", "64", "--d-model", "32", "--n-heads", "2",
+             "--n-layers", "2", "--d-ff", "64", "--max-seq", "32"]
+
+    wc = str(tmp_path / "wc-form")
+    save_serving_state(wc, qp, meta={"form": "int8", "model": dims})
+    with pytest.raises(SystemExit):
+        serve.main([*flags, "--weights", "int4", "--weights-cache", wc])
+
+    wc2 = str(tmp_path / "wc-shape")
+    save_serving_state(wc2, qp, meta={
+        "form": "int8", "model": {**dims, "d_model": 999}})
+    with pytest.raises(SystemExit):
+        serve.main([*flags, "--weights-cache", wc2])

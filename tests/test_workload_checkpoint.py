@@ -81,3 +81,49 @@ def test_max_to_keep_prunes(cfg_params, tmp_path):
 def test_restore_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         restore_train_state(str(tmp_path / "nope"))
+
+
+def test_serving_state_roundtrip_int4_exact(cfg_params, tmp_path):
+    """save/restore_serving_state must round-trip a quantized tree
+    EXACTLY — int4 nibbles, group scales, bf16 leaves — so quantize-once-
+    at-deploy serving equals quantize-at-start serving bit for bit."""
+    from tpu_dra.workloads.checkpointing import (restore_serving_state,
+                                                 save_serving_state)
+    from tpu_dra.workloads.decode import greedy_decode
+    from tpu_dra.workloads.quant import quantize_params_int4
+
+    cfg, params = cfg_params
+    qp = quantize_params_int4(params)
+    d = str(tmp_path / "serving")
+    save_serving_state(d, qp)
+    back = restore_serving_state(d)
+    assert jax.tree.structure(back) == jax.tree.structure(qp)
+    for a, b in zip(jax.tree.leaves(qp), jax.tree.leaves(back)):
+        assert a.dtype == b.dtype, (a.dtype, b.dtype)
+        np.testing.assert_array_equal(
+            np.asarray(a.astype(jnp.float32)),
+            np.asarray(b.astype(jnp.float32)))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(greedy_decode(cfg, back, prompt, steps=3)),
+        np.asarray(greedy_decode(cfg, qp, prompt, steps=3)))
+
+
+def test_serving_state_overwrites_in_place(cfg_params, tmp_path):
+    from tpu_dra.workloads.checkpointing import (restore_serving_state,
+                                                 save_serving_state)
+    from tpu_dra.workloads.quant import (cast_params_bf16,
+                                         quantize_params_int8)
+
+    cfg, params = cfg_params
+    d = str(tmp_path / "serving")
+    save_serving_state(d, cast_params_bf16(params))
+    save_serving_state(d, quantize_params_int8(params))
+    back = restore_serving_state(d)
+    assert "q8" in back["blocks"]["wqkv"]
+
+
+def test_restore_serving_missing_raises(tmp_path):
+    from tpu_dra.workloads.checkpointing import restore_serving_state
+    with pytest.raises(FileNotFoundError):
+        restore_serving_state(str(tmp_path / "nope"))

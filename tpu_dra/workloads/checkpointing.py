@@ -62,6 +62,50 @@ def latest_step(directory: str) -> int | None:
         return mgr.latest_step()
 
 
+def save_serving_state(directory: str, params: Any,
+                       meta: dict | None = None) -> None:
+    """Persist a SERVING tree — bf16-cast or int8/int4-quantized leaves
+    included (orbax round-trips ``jnp.int4`` exactly, packed storage and
+    all) — so quantization runs once at deploy time, not at every server
+    start.  One snapshot (a re-save lands as step N+1 and max_to_keep=1
+    prunes the old one; overwriting a step in place is unsupported).
+    ``meta`` (e.g. weight form + model dims) lands in a JSON sidecar so a
+    restore can validate it is serving what the operator thinks it is."""
+    import json
+
+    import orbax.checkpoint as ocp
+
+    with _manager(directory, max_to_keep=1, create=True) as mgr:
+        latest = mgr.latest_step()
+        step = 0 if latest is None else latest + 1
+        mgr.save(step, args=ocp.args.StandardSave({"params": params}))
+        mgr.wait_until_finished()
+    if meta is not None:
+        with open(os.path.join(directory, "serving_meta.json"), "w") as f:
+            json.dump(meta, f, sort_keys=True)
+
+
+def serving_meta(directory: str) -> dict | None:
+    """The meta sidecar written by :func:`save_serving_state`, or None
+    (missing directory, or a cache saved without meta)."""
+    import json
+
+    try:
+        with open(os.path.join(directory, "serving_meta.json")) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def restore_serving_state(directory: str) -> Any:
+    """Restore the serving tree saved by :func:`save_serving_state`."""
+    try:
+        return restore_train_state(directory)["params"]
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"no serving checkpoint under {directory}") from None
+
+
 def restore_train_state(directory: str, *, step: int | None = None,
                         template: Any = None) -> dict[str, Any]:
     """Restore ``{params[, extra]}`` from ``directory`` (latest step unless

@@ -274,6 +274,63 @@ def quantize_params_int8(params: dict) -> dict:
     return _quantize_params(params, quantize_int8)
 
 
+def serving_param_shardings(cfg, mesh, params: dict):
+    """Shardings for a SERVING tree — plain, bf16, int8, or int4 — so
+    quantized models ride the same TP mesh as the fp32 train tree.
+
+    Each quantized leaf keeps its weight's spec from
+    ``train.param_shardings``; the scale tensors shard along the axes
+    that survive in their shapes (int8 ``s [..., N]`` drops K, int4
+    ``s4 [..., K/G, N]`` keeps a shrunken K axis, which stays sharded
+    only when the group count divides that mesh axis — otherwise the
+    scales replicate, a negligible cost next to the weight bytes).
+    Returns a tree with the ``params`` treedef, usable directly in
+    ``jax.device_put`` / ``in_shardings``.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dra.workloads.train import param_shardings
+
+    base = param_shardings(cfg, mesh)
+
+    def axis_size(name) -> int:
+        if name is None:
+            return 1
+        if isinstance(name, tuple):
+            return int(np.prod([mesh.shape[n] for n in name]))
+        return mesh.shape[name]
+
+    def leaf(spec_nd, w: Leaf):
+        if not isinstance(w, dict):
+            return spec_nd
+        if not (is_quantized(w) or is_quantized4(w)):
+            raise ValueError(f"unrecognized serving leaf {sorted(w)}")
+        q = w["q8"] if is_quantized(w) else w["q4"]
+        parts = tuple(spec_nd.spec) + (None,) * (
+            q.ndim - len(tuple(spec_nd.spec)))
+        *lead, pk, pn = parts
+        if is_quantized(w):
+            return {"q8": spec_nd,
+                    "s": NamedSharding(mesh, P(*lead, pn))}
+        ngroups = w["s4"].shape[-2]
+        pk_s = pk if pk is not None and ngroups % axis_size(pk) == 0 \
+            else None
+        return {"q4": spec_nd,
+                "s4": NamedSharding(mesh, P(*lead, pk_s, pn))}
+
+    out = dict(base)
+    blocks = dict(base["blocks"])
+    for name in _QUANT_BLOCK_LEAVES:
+        if name in params["blocks"] and name in blocks:
+            blocks[name] = leaf(blocks[name], params["blocks"][name])
+    out["blocks"] = blocks
+    for name in _QUANT_TOP_LEAVES:
+        if name in params and name in base:
+            out[name] = leaf(base[name], params[name])
+    return out
+
+
 def quantize_params_int4(params: dict, group: int = 128) -> dict:
     """fp32/bf16 training params → int4 serving params (``{"q4", "s4"}``
     leaves; see :func:`_quantize_params` for the shared tree rules)."""

@@ -48,6 +48,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import jax
 import jax.numpy as jnp
 
+from tpu_dra.util import klog
 from tpu_dra.util.metrics import Registry
 from tpu_dra.workloads.decode import beam_decode, decode
 from tpu_dra.workloads.train import ModelConfig
@@ -686,7 +687,15 @@ def main(argv=None):
         jax.config.update("jax_platforms", plat)
 
     ap = argparse.ArgumentParser(description=main.__doc__)
-    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="fp32 train checkpoint (optional when "
+                         "--weights-cache already holds a serving tree)")
+    ap.add_argument("--weights-cache", default="",
+                    help="serving-tree checkpoint dir: restored directly "
+                         "when populated (quantize once at deploy, not at "
+                         "every start — the serving node then needs no "
+                         "fp32 checkpoint); populated from "
+                         "--checkpoint-dir + --weights otherwise")
     ap.add_argument("--port", type=int, default=8477)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--vocab", type=int, default=32768)
@@ -697,12 +706,15 @@ def main(argv=None):
     ap.add_argument("--d-ff", type=int, default=2048)
     ap.add_argument("--max-seq", type=int, default=512)
     ap.add_argument("--pos-emb", default="rope")
-    ap.add_argument("--weights", default="fp32",
+    ap.add_argument("--weights", default=None,
                     choices=("fp32", "bf16", "int8", "int4"),
                     help="serving weight form (quant.py): fp32 serves "
                          "the checkpoint unmodified; bf16 halves, int8 "
                          "quarters, int4 eighths the per-token weight "
-                         "read (group-scaled nibbles)")
+                         "read (group-scaled nibbles).  Default: the "
+                         "--weights-cache's recorded form, else fp32.  "
+                         "An explicit form that contradicts a populated "
+                         "cache is an error, not a silent cache hit")
     ap.add_argument("--cache-dtype", default="bf16",
                     choices=("bf16", "int8"))
     ap.add_argument("--continuous", action="store_true",
@@ -729,14 +741,59 @@ def main(argv=None):
                       n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
                       n_layers=args.n_layers, d_ff=args.d_ff,
                       max_seq=args.max_seq, pos_emb=args.pos_emb)
-    params = restore_train_state(args.checkpoint_dir)["params"]
-    if args.weights != "fp32":
-        from tpu_dra.workloads.quant import (cast_params_bf16,
-                                             quantize_params_int4,
-                                             quantize_params_int8)
-        params = {"int8": quantize_params_int8,
-                  "int4": quantize_params_int4,
-                  "bf16": cast_params_bf16}[args.weights](params)
+    model_dims = {"vocab": args.vocab, "d_model": args.d_model,
+                  "n_heads": args.n_heads, "n_kv_heads": args.n_kv_heads,
+                  "n_layers": args.n_layers, "d_ff": args.d_ff,
+                  "pos_emb": args.pos_emb}
+    params = None
+    if args.weights_cache:
+        from tpu_dra.workloads.checkpointing import (restore_serving_state,
+                                                     serving_meta)
+        meta = serving_meta(args.weights_cache)
+        try:
+            params = restore_serving_state(args.weights_cache)
+        except FileNotFoundError:
+            params = None
+        if params is not None and meta is not None:
+            # a cache hit must be what the operator asked for: an
+            # explicitly requested form that contradicts the cache, or a
+            # model-shape mismatch, is a hard error — never a silent
+            # stale-weights serve
+            if args.weights is not None and \
+                    meta.get("form") != args.weights:
+                ap.error(f"--weights-cache {args.weights_cache} holds "
+                         f"form={meta.get('form')!r} but --weights "
+                         f"{args.weights!r} was requested; delete the "
+                         f"cache or drop --weights")
+            if meta.get("model") not in (None, model_dims):
+                ap.error(f"--weights-cache {args.weights_cache} was "
+                         f"saved for model {meta.get('model')} but the "
+                         f"flags describe {model_dims}")
+            klog.info("serving weights restored from cache",
+                      cache=args.weights_cache, form=meta.get("form"))
+        elif params is not None:
+            klog.info("serving weights restored from cache (no meta "
+                      "sidecar; form unverified)",
+                      cache=args.weights_cache)
+    if params is None:
+        if not args.checkpoint_dir:
+            ap.error("--checkpoint-dir required (no populated "
+                     "--weights-cache to restore from)")
+        form = args.weights or "fp32"
+        params = restore_train_state(args.checkpoint_dir)["params"]
+        if form != "fp32":
+            from tpu_dra.workloads.quant import (cast_params_bf16,
+                                                 quantize_params_int4,
+                                                 quantize_params_int8)
+            params = {"int8": quantize_params_int8,
+                      "int4": quantize_params_int4,
+                      "bf16": cast_params_bf16}[form](params)
+        if args.weights_cache:
+            from tpu_dra.workloads.checkpointing import save_serving_state
+            save_serving_state(args.weights_cache, params,
+                               meta={"form": form, "model": model_dims})
+            klog.info("serving weights cached", cache=args.weights_cache,
+                      form=form)
     draft = None
     if args.draft_checkpoint_dir:
         draft_cfg = ModelConfig(
