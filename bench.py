@@ -475,9 +475,43 @@ def section_continuous() -> dict:
         }
         if errs:
             out["continuous_errors"] = errs[0][:200]
-        return out
     finally:
         eng.shutdown()
+
+    # speculative-engine CEILING: draft == target accepts every proposal,
+    # so this is the upper bound of draft-assisted continuous serving
+    # (spec_tokens_per_pass == chunk); a real distilled draft lands
+    # between 1.0 and chunk depending on agreement.  Random-init weights
+    # have no distilled draft to measure honestly, hence the ceiling.
+    eng2 = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                            draft=(cfg, params))
+    try:
+        n2 = max(4, n_req // 3)
+        for ln in lengths:                # warm EVERY prompt bucket, like
+            eng2.submit([1] * ln, steps=chunk, timeout=600)   # the plain path
+        eng2.reset_stats()
+        reqs2 = [([7 + i % 100] * lengths[i % len(lengths)],
+                  steps[i % len(steps)]) for i in range(n2)]
+        t0 = time.perf_counter()
+        handles2 = [eng2.submit_async(p, s) for p, s in reqs2]
+        errs2 = []
+        for h in handles2:
+            if not h.done.wait(600):
+                errs2.append("timeout: request not done within 600s")
+            elif h.error:
+                errs2.append(h.error)
+        secs2 = time.perf_counter() - t0
+        st2 = eng2.stats()
+        total2 = sum(len(h.tokens) for h in handles2)
+        out["continuous_spec_ceiling_tokens_per_s"] = round(
+            total2 / secs2, 1)
+        out["continuous_spec_tokens_per_pass"] = st2.get(
+            "spec_tokens_per_pass")
+        if errs2:
+            out["continuous_spec_errors"] = errs2[0][:200]
+    finally:
+        eng2.shutdown()
+    return out
 
 
 # honor an explicit CPU request in bench child processes: the axon

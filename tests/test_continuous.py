@@ -310,3 +310,124 @@ def test_throughput_accounting(engine):
     s = engine.stats()
     assert s["tokens_out"] >= 36
     assert elapsed > 0
+
+
+# --- speculative mode --------------------------------------------------------
+
+DRAFT_CFG = ModelConfig(vocab=128, d_model=32, n_heads=2, n_layers=1,
+                        d_ff=64, max_seq=96, pos_emb="rope")
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return init_params(DRAFT_CFG, jax.random.PRNGKey(9))
+
+
+def test_speculative_engine_exact_vs_plain(params, draft_params):
+    """Greedy acceptance: for ANY draft — here a random-weight one with
+    near-zero agreement — every request's tokens are EXACTLY the plain
+    engine's (the draft only changes speed)."""
+    prompts = [[1, 2, 3], [5, 6, 7, 8, 9, 10], [11, 12], [4] * 20]
+    steps = [6, 4, 8, 3]
+    spec = ContinuousEngine(CFG, params, slots=4, chunk=3,
+                            draft=(DRAFT_CFG, draft_params))
+    try:
+        results: dict[int, list[int]] = {}
+
+        def go(i):
+            results[i] = spec.submit(prompts[i], steps[i])
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        for i in range(len(prompts)):
+            ref = greedy_decode(CFG, params,
+                                jnp.asarray([prompts[i]], jnp.int32),
+                                steps=steps[i], max_len=CFG.max_seq)
+            assert results[i] == ref[0].tolist(), i
+        st = spec.stats()
+        assert st["spec_target_passes"] >= 1
+    finally:
+        spec.shutdown()
+
+
+def test_speculative_engine_perfect_draft_full_accept(params):
+    """draft == target accepts everything: one request commits ``chunk``
+    tokens per target pass."""
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=4,
+                            draft=(CFG, params))
+    try:
+        toks = spec.submit([1, 2, 3], 8)
+        ref = greedy_decode(CFG, params, jnp.asarray([[1, 2, 3]], jnp.int32),
+                            steps=8, max_len=CFG.max_seq)
+        assert toks == ref[0].tolist()
+        st = spec.stats()
+        assert st["spec_tokens_per_pass"] == pytest.approx(4.0), st
+    finally:
+        spec.shutdown()
+
+
+def test_speculative_engine_eos_stops_early(params):
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=3,
+                            draft=(CFG, params))
+    try:
+        ref = greedy_decode(CFG, params, jnp.asarray([[1, 2, 3]], jnp.int32),
+                            steps=12, max_len=CFG.max_seq)[0].tolist()
+        eos = ref[4]                      # stop mid-stream at a real token
+        toks = spec.submit([1, 2, 3], 12, eos_id=eos)
+        want = ref[: ref.index(eos) + 1]
+        assert toks == want, (toks, want)
+    finally:
+        spec.shutdown()
+
+
+def test_speculative_engine_rejects_sampling_and_prefix(params,
+                                                        draft_params):
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                            draft=(DRAFT_CFG, draft_params))
+    try:
+        with pytest.raises(ValueError, match="greedy-only"):
+            spec.submit([1, 2], 2, temperature=0.7)
+        with pytest.raises(ValueError, match="prefix"):
+            spec.submit([1, 2], 2, prefix_id="abc")
+        with pytest.raises(ValueError, match="chunk >= 2"):
+            ContinuousEngine(CFG, params, slots=2, chunk=1,
+                             draft=(DRAFT_CFG, draft_params))
+        bad = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                          d_ff=64, max_seq=96)
+        with pytest.raises(ValueError, match="vocab"):
+            ContinuousEngine(CFG, params, slots=2, chunk=2,
+                             draft=(bad, draft_params))
+    finally:
+        spec.shutdown()
+
+
+def test_speculative_engine_join_midflight(params, draft_params):
+    """A request admitted while another is mid-decode, plus sequential
+    slot reuse, both match the plain greedy oracle."""
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=3,
+                            draft=(DRAFT_CFG, draft_params))
+    try:
+        # truly mid-flight: the long request is in a slot decoding when
+        # the short one is admitted into the other slot
+        long_req = spec.submit_async([1, 2, 3], 18)
+        time.sleep(0.3)
+        short = spec.submit([7, 8], 4)
+        assert long_req.done.wait(180) and not long_req.error
+        for prompt, steps, got in (([1, 2, 3], 18, long_req.tokens),
+                                   ([7, 8], 4, short)):
+            ref = greedy_decode(CFG, params,
+                                jnp.asarray([prompt], jnp.int32),
+                                steps=steps, max_len=CFG.max_seq)
+            assert got == ref[0].tolist()
+        # sequential slot reuse after both retire
+        again = spec.submit([9, 10, 11], 5)
+        ref = greedy_decode(CFG, params,
+                            jnp.asarray([[9, 10, 11]], jnp.int32),
+                            steps=5, max_len=CFG.max_seq)
+        assert again == ref[0].tolist()
+    finally:
+        spec.shutdown()

@@ -21,6 +21,13 @@ stale cache rows beyond a slot's current position are unreachable — the
 attention mask admits positions <= pos, and decode overwrites position pos
 before reading it — so slot reuse needs no cache zeroing.
 
+Speculative mode (``draft=(draft_cfg, draft_params)``): each chunk
+dispatch becomes one draft-propose / target-verify iteration with
+per-slot accept counts — a slot with an agreeing draft commits ``chunk``
+tokens per target pass while its neighbor commits 1.  Greedy acceptance
+keeps outputs EXACTLY equal to the plain engine's; sampled requests and
+prefix joins are rejected in this mode (see __init__).
+
 Sampling: per-request ``temperature`` (0 = greedy) via a per-slot
 temperature vector; ``top_k``/``top_p`` are engine-global statics (a
 per-slot rank filter would put two argsorts in the hot step for a niche
@@ -46,6 +53,7 @@ import numpy as np
 
 from tpu_dra.workloads.decode import (
     _chunk_hidden,
+    _chunk_logits,
     _filter_topk_topp,
     _select_token,
     _token_logits,
@@ -104,11 +112,32 @@ class ContinuousEngine:
     def __init__(self, cfg: ModelConfig, params, *, slots: int = 32,
                  max_len: Optional[int] = None, cache_dtype: str = "bf16",
                  chunk: int = 4, top_k: int = 0, top_p: float = 0.0,
-                 latency_window: int = 1024, max_prefixes: int = 8):
+                 latency_window: int = 1024, max_prefixes: int = 8,
+                 draft: Optional[tuple] = None):
+        """``draft=(draft_cfg, draft_params)`` turns each chunk dispatch
+        into ONE speculative iteration: the draft proposes ``chunk-1``
+        tokens, the target verifies them in a single ragged chunk
+        forward, and the longest greedy-matching prefix plus the
+        target's own next token commit together — per-slot accept
+        counts, so a slot with a lucky draft advances ``chunk`` tokens
+        for one target pass while its neighbor advances 1.  Greedy
+        acceptance keeps every request's output EXACTLY equal to the
+        non-speculative engine's (the draft only changes speed), which
+        is why speculative mode rejects sampled requests
+        (temperature > 0) and prefix joins (the draft has no prefix KV).
+        """
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if draft is not None:
+            dcfg = draft[0]
+            if dcfg.vocab != cfg.vocab:
+                raise ValueError(f"draft vocab {dcfg.vocab} != target "
+                                 f"vocab {cfg.vocab}")
+            if chunk < 2:
+                raise ValueError("speculative engine needs chunk >= 2 "
+                                 "(chunk-1 drafted + 1 bonus per pass)")
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -121,6 +150,16 @@ class ContinuousEngine:
         self.top_k = top_k
         self.top_p = top_p
         # device state: fixed shapes for the whole engine lifetime
+        self.draft = draft
+        if draft is not None:
+            self._dcache = init_kv_cache(draft[0], slots, self.max_len,
+                                         cache_dtype)
+            # speed observables: committed tokens vs live-slot passes
+            # (tokens per slot-pass is the speculative win: 1.0 is
+            # plain-decode parity, chunk the full-accept ceiling)
+            self.target_passes = 0
+            self.spec_committed = 0
+            self.spec_slot_passes = 0
         self._cache = init_kv_cache(cfg, slots, self.max_len, cache_dtype)
         self._token = jnp.zeros((slots,), jnp.int32)
         self._pos = jnp.zeros((slots,), jnp.int32)
@@ -152,6 +191,11 @@ class ContinuousEngine:
         # HBM + a full-cache copy per chunk)
         self._step_fn = jax.jit(partial(self._chunk_step_impl, cfg),
                                 donate_argnums=(1, 2, 3, 6, 7))
+        if draft is not None:
+            self._spec_step_fn = jax.jit(
+                partial(self._spec_chunk_impl, cfg, draft[0]),
+                donate_argnums=(2, 3))          # both slot caches
+            self._spec_prefill_fns: dict[int, Any] = {}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
         self._thread.start()
@@ -223,6 +267,94 @@ class ContinuousEngine:
                          donate_argnums=(1,))       # the slot cache
             self._prefill_fns[bucket] = fn
         return fn
+
+    def _spec_prefill_impl(self, cfg, dcfg, params, dparams, cache,
+                           dcache, prompts, lengths, slots):
+        """Speculative admission: prefill BOTH models' slot-cache rows
+        for a batch of k joining sequences and select each first token
+        greedily from the target (speculative mode is greedy-only, so no
+        temperature/key plumbing here)."""
+        k, Sb = prompts.shape
+        small = {name: jnp.zeros(
+            (buf.shape[0], k, buf.shape[2], Sb, buf.shape[4]), buf.dtype)
+            for name, buf in cache.items()}
+        small, x = _prefill_trunk(cfg, params, small, prompts)
+        last = x[jnp.arange(k), lengths - 1][:, None, :]
+        first = jnp.argmax(head_logits(params, last)[:, 0],
+                           axis=-1).astype(jnp.int32)
+        cache = {name: cache[name].at[:, slots, :, :Sb, :].set(
+            small[name].astype(cache[name].dtype)) for name in cache}
+        dsmall = {name: jnp.zeros(
+            (buf.shape[0], k, buf.shape[2], Sb, buf.shape[4]), buf.dtype)
+            for name, buf in dcache.items()}
+        dsmall, _ = _prefill_trunk(dcfg, dparams, dsmall, prompts)
+        dcache = {name: dcache[name].at[:, slots, :, :Sb, :].set(
+            dsmall[name].astype(dcache[name].dtype)) for name in dcache}
+        return cache, dcache, first
+
+    def _spec_prefill_fn(self, bucket: int):
+        fn = self._spec_prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(
+                partial(self._spec_prefill_impl, self.cfg, self.draft[0]),
+                donate_argnums=(2, 3))              # both slot caches
+            self._spec_prefill_fns[bucket] = fn
+        return fn
+
+    def _spec_chunk_impl(self, cfg, dcfg, params, dparams, cache, dcache,
+                         token, pos, eos, done):
+        """ONE speculative iteration for every slot (decode.py
+        speculative_decode's loop body, re-shaped for the slot pool):
+        the draft scans ``chunk-1`` proposals from each slot's committed
+        token, the target verifies [token, d1..d_{chunk-1}] in one
+        ragged chunk forward, and per slot the longest greedy-matching
+        prefix plus the target's bonus token commit.  Returns the padded
+        emission block [slots, chunk] and per-slot commit counts; frozen
+        slots hold (count 0).  Stale cache rows beyond each slot's new
+        position stay invisible per the module invariant."""
+        k = self.chunk
+        slots_n = token.shape[0]
+
+        def draft_step(c, j):
+            dcache, tok = c
+            lg, dcache = _token_logits(dcfg, dparams, dcache, pos + j, tok)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, tok, nxt)
+            return (dcache, nxt), nxt
+
+        # k steps, not k-1: a full-accept iteration commits positions
+        # pos..pos+k-1, so the draft cache must cover them all (the k-th
+        # proposal is discarded — speculative_decode's coverage rule)
+        (dcache, _), drafts = jax.lax.scan(
+            draft_step, (dcache, token),
+            jnp.arange(k, dtype=jnp.int32))
+        drafts = drafts.T[:, : k - 1]                    # [slots, k-1]
+
+        chunk_toks = jnp.concatenate([token[:, None], drafts], axis=1)
+        t_lg, cache = _chunk_logits(cfg, params, cache, pos, chunk_toks)
+        preds = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)   # [slots, k]
+
+        match = (drafts == preds[:, :-1]).astype(jnp.int32)
+        n = jnp.cumprod(match, axis=1).sum(axis=1)            # [slots]
+        bonus = jnp.take_along_axis(preds, n[:, None], axis=1)[:, 0]
+
+        j = jnp.arange(k, dtype=jnp.int32)[None, :]
+        padded = jnp.concatenate(
+            [drafts, jnp.zeros((slots_n, 1), jnp.int32)], axis=1)
+        emit = jnp.where(j < n[:, None], padded,
+                         jnp.where(j == n[:, None], bonus[:, None], 0))
+        counts = jnp.where(done, 0, n + 1)                    # [slots]
+
+        # eos anywhere in the committed prefix freezes the slot (the
+        # host trims the emitted tokens at eos; pos overshoot past eos
+        # writes rows the invariant keeps invisible)
+        live = j < counts[:, None]
+        hit = jnp.any(live & (emit == eos[:, None]) & (eos >= 0)[:, None],
+                      axis=1)
+        token2 = jnp.where(done, token, bonus)
+        pos2 = pos + counts
+        done2 = done | hit
+        return cache, dcache, token2, pos2, done2, emit, counts
 
     def _prefix_kv_impl(self, cfg, params, prompt):
         """Compute a prefix's KV buffers once: [1, Pb] right-padded →
@@ -357,6 +489,16 @@ class ContinuousEngine:
             raise ValueError(f"steps must be >= 1, got {steps}")
         if eos_id is not None and not 0 <= eos_id < cfg.vocab:
             raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
+        if self.draft is not None:
+            # greedy acceptance is what makes speculative output exactly
+            # equal the plain engine's; sampled requests and prefix
+            # joins (no draft-side prefix KV) are out of its contract
+            if temperature > 0:
+                raise ValueError("speculative engine is greedy-only "
+                                 "(temperature must be 0)")
+            if prefix_id is not None:
+                raise ValueError("speculative engine does not support "
+                                 "prefix joins")
         plen = 0
         if prefix_id is not None:
             with self._cv:
@@ -366,9 +508,11 @@ class ContinuousEngine:
                                      f"(evicted or never registered)")
                 self._prefixes[prefix_id] = self._prefixes.pop(prefix_id)
             plen = pref.length
-        if plen + len(prompt) + steps > self.max_len:
+        slack = self.chunk if self.draft is not None else 0
+        if plen + len(prompt) + steps + slack > self.max_len:
             raise ValueError(
                 f"prefix {plen} + prompt {len(prompt)} + steps {steps} "
+                f"{'+ speculative overshoot ' + str(slack) + ' ' if slack else ''}"
                 f"exceeds the engine's max_len {self.max_len}")
         if len(prompt) > _PROMPT_BUCKETS[-1]:
             raise ValueError(f"prompt exceeds the largest bucket "
@@ -389,12 +533,22 @@ class ContinuousEngine:
         self.completed = 0
         self.tokens_out = 0
         self.latencies_s.clear()
+        if self.draft is not None:
+            self.target_passes = 0
+            self.spec_committed = 0
+            self.spec_slot_passes = 0
 
     def stats(self) -> dict:
         lat = sorted(self.latencies_s)
         out = {"completed": self.completed, "tokens_out": self.tokens_out,
                "queued": len(self._pending),
                "active": sum(r is not None for r in self._requests)}
+        if self.draft is not None and self.target_passes:
+            # committed tokens per LIVE SLOT per target pass — 1.0 is
+            # plain-decode parity, chunk the full-accept ceiling
+            out["spec_target_passes"] = self.target_passes
+            out["spec_tokens_per_pass"] = round(
+                self.spec_committed / max(1, self.spec_slot_passes), 3)
         if lat:
             out["latency_p50_ms"] = round(
                 1e3 * lat[len(lat) // 2], 3)
@@ -458,7 +612,8 @@ class ContinuousEngine:
 
     def _admit_plain(self, Sb: int,
                      group: list[tuple[int, "_Request"]]) -> None:
-        """One prefill dispatch for a same-bucket plain admission chunk."""
+        """One prefill dispatch for a same-bucket plain admission chunk
+        (speculative engines prefill BOTH models' slot rows)."""
         k = len(group)
         prompts = jnp.asarray(
             [req.prompt + [0] * (Sb - len(req.prompt))
@@ -466,17 +621,24 @@ class ContinuousEngine:
         lengths = jnp.asarray([len(req.prompt) for _, req in group],
                               jnp.int32)
         slots = jnp.asarray([slot for slot, _ in group], jnp.int32)
-        temps = jnp.asarray([req.temperature for _, req in group],
-                            jnp.float32)
         # reproducible sampling: each key chain is a pure function of its
         # request's seed (fold 0 draws the first token, the rest of the
         # stream advances per step in the chunk scan)
         base_keys = [jax.random.PRNGKey(req.seed) for _, req in group]
-        keys0 = jnp.stack([jax.random.fold_in(kk, 0) for kk in base_keys])
-        cache, first = self._prefill_fn(Sb)(
-            self.params, self._cache, prompts, lengths, slots, temps,
-            keys0)
-        self._cache = cache
+        if self.draft is not None:
+            cache, dcache, first = self._spec_prefill_fn(Sb)(
+                self.params, self.draft[1], self._cache, self._dcache,
+                prompts, lengths, slots)
+            self._cache, self._dcache = cache, dcache
+        else:
+            temps = jnp.asarray([req.temperature for _, req in group],
+                                jnp.float32)
+            keys0 = jnp.stack([jax.random.fold_in(kk, 0)
+                               for kk in base_keys])
+            cache, first = self._prefill_fn(Sb)(
+                self.params, self._cache, prompts, lengths, slots, temps,
+                keys0)
+            self._cache = cache
         firsts = [int(t) for t in first.tolist()]   # ONE device readback
         for (slot, req), key, first_host in zip(group, base_keys, firsts):
             self._finish_admission(slot, req, first_host,
@@ -565,15 +727,32 @@ class ContinuousEngine:
             self._admit()
             if all(r is None for r in self._requests):
                 continue
-            (self._cache, self._token, self._pos, self._done, self._keys,
-             toks) = self._step_fn(self.params, self._cache, self._token,
-                                   self._pos, self._temp, self._eos,
-                                   self._done, self._keys)
+            if self.draft is not None:
+                (self._cache, self._dcache, self._token, self._pos,
+                 self._done, toks, counts) = self._spec_step_fn(
+                    self.params, self.draft[1], self._cache, self._dcache,
+                    self._token, self._pos, self._eos, self._done)
+                # ONE device readback for both outputs (admission-path
+                # discipline)
+                toks, counts_host = jax.device_get((toks, counts))
+                counts_host = counts_host.tolist()
+                self.target_passes += 1
+                live = [(c, r) for c, r in zip(counts_host,
+                                               self._requests)
+                        if r is not None]
+                self.spec_committed += sum(c for c, _ in live)
+                self.spec_slot_passes += len(live)
+            else:
+                (self._cache, self._token, self._pos, self._done,
+                 self._keys, toks) = self._step_fn(
+                    self.params, self._cache, self._token, self._pos,
+                    self._temp, self._eos, self._done, self._keys)
+                counts_host = [self.chunk] * self.slots
             toks_host = np.asarray(toks)            # [slots, chunk]
             for slot, req in enumerate(self._requests):
                 if req is None:
                     continue
-                for j in range(self.chunk):
+                for j in range(counts_host[slot]):
                     if self._emitted[slot] >= req.steps:
                         break
                     tok = int(toks_host[slot, j])
