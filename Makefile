@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test bench drive image proto check-proto stress clean
+.PHONY: all native test bench drive image proto check-proto stress racecheck clean
 
 all: native
 
@@ -38,14 +38,19 @@ proto:
 check-proto: proto
 	git diff --exit-code -- tpu_dra/kubeletplugin/proto
 
-# -race stand-in (reference Makefile:95-96 runs `go test -race`): repeat
-# the threading-heavy suites; interleaving bugs show up across runs, not
-# in any single one
+# -race analog (reference Makefile:95-96 runs `go test -race`), two lanes:
+# `racecheck` runs the vector-clock happens-before detector
+# (tpu_dra/util/racecheck.py) over seeded races and the repo's shared-state
+# hot spots; `stress` repeats the threading-heavy suites so residual
+# interleaving bugs surface across runs.
+racecheck:
+	$(PYTHON) -m pytest tests/test_racecheck.py -q -x
+
 STRESS_RUNS ?= 5
 stress:
 	for i in $$(seq 1 $(STRESS_RUNS)); do \
 	  echo "stress run $$i/$(STRESS_RUNS)"; \
-	  $(PYTHON) -m pytest tests/test_stress_concurrency.py \
+	  $(PYTHON) -m pytest tests/test_stress_concurrency.py tests/test_racecheck.py \
 	    tests/test_informer.py tests/test_workqueue.py -q -x || exit 1; \
 	done
 

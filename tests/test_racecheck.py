@@ -1,0 +1,365 @@
+"""The dynamic race detector (``go test -race`` analog, SURVEY.md §5).
+
+Two halves, mirroring how the reference relies on its detector:
+
+1. The detector itself is proven: seeded races (unsynchronized writes,
+   missing publication, concurrent map writes) are *deterministically*
+   detected — happens-before ordering, not lucky interleaving — and every
+   legitimate synchronisation pattern the repo uses (mutex, queue hand-off,
+   Event publication, fork/join) suppresses the report.
+2. The repo's shared-state hot spots run under it: DeviceState concurrent
+   prepares, the retry work queue, and the informer store, with their
+   internals monitored.  A future locking regression in those paths turns
+   into a deterministic failure here, which is exactly what ``-race`` buys
+   the reference (Makefile:95-96).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+from tpu_dra.util import racecheck
+
+
+class Counter:
+    def __init__(self) -> None:
+        self.value = 0
+
+    def bump(self) -> None:
+        self.value += 1
+
+
+class LockedCounter:
+    def __init__(self) -> None:
+        self.value = 0
+        self.mu = threading.Lock()
+
+    def bump(self) -> None:
+        with self.mu:
+            self.value += 1
+
+
+def run_threads(n: int, fn) -> None:
+    threads = [threading.Thread(target=fn, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+
+
+# -------------------------------------------------------------------------
+# Detector correctness: seeded races are found, sync patterns are clean
+# -------------------------------------------------------------------------
+
+
+def test_unsynchronized_counter_is_flagged():
+    with racecheck.checking(Counter, expect_races=True):
+        c = Counter()
+        run_threads(2, lambda i: [c.bump() for _ in range(5)])
+    # context manager asserted at least one race; double-check its shape
+    # is the classic unordered write pair
+    # (races were reset on exit; re-run capturing them explicitly)
+    racecheck.install()
+    racecheck.monitor(Counter)
+    try:
+        c = Counter()
+        run_threads(2, lambda i: [c.bump() for _ in range(5)])
+        kinds = {r.kind for r in racecheck.races()}
+        fields = {r.field for r in racecheck.races()}
+        assert "write-write" in kinds or "read-write" in kinds
+        assert fields == {"value"}
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lock_protected_counter_is_clean():
+    with racecheck.checking(LockedCounter):
+        c = LockedCounter()
+        run_threads(4, lambda i: [c.bump() for _ in range(10)])
+        assert c.value == 40
+
+
+def test_missing_publication_read_is_flagged():
+    """Writer thread sets a field; main thread reads it after a sleep-free
+    busy check with no sync edge: flagged even though the schedule is
+    strictly sequential (HB ordering, not interleaving)."""
+
+    class Box:
+        def __init__(self) -> None:
+            self.payload = None
+
+    with racecheck.checking(Box, expect_races=True):
+        # Two sibling threads, one writes, one reads, no edge between them:
+        # a race regardless of how the scheduler actually interleaved them.
+        b = Box()
+        tw = threading.Thread(target=lambda: setattr(b, "payload", 7))
+        tr = threading.Thread(target=lambda: b.payload)
+        tw.start()
+        tr.start()
+        tw.join()
+        tr.join()
+
+
+def test_queue_handoff_is_clean():
+    """Producer fills an object then puts it; consumer gets and reads.
+    The queue's internal mutex (created post-install) carries the edge."""
+
+    class Msg:
+        def __init__(self) -> None:
+            self.body = ""
+
+    with racecheck.checking(Msg):
+        q: "queue.Queue[Msg]" = queue.Queue()
+        got: list[str] = []
+
+        def producer() -> None:
+            for i in range(20):
+                m = Msg()
+                m.body = f"msg-{i}"
+                q.put(m)
+            q.put(None)  # type: ignore[arg-type]
+
+        def consumer() -> None:
+            while True:
+                m = q.get()
+                if m is None:
+                    return
+                got.append(m.body)
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tp.start(); tc.start()
+        tp.join(timeout=30); tc.join(timeout=30)
+        assert len(got) == 20
+
+
+def test_event_publication_is_clean():
+    class Box:
+        def __init__(self) -> None:
+            self.payload = None
+
+    with racecheck.checking(Box):
+        b = Box()
+        ready = threading.Event()
+        seen: list = []
+
+        def writer() -> None:
+            b.payload = "published"
+            ready.set()
+
+        def reader() -> None:
+            ready.wait(timeout=30)
+            seen.append(b.payload)
+
+        tw = threading.Thread(target=writer)
+        tr = threading.Thread(target=reader)
+        tr.start(); tw.start()
+        tw.join(timeout=30); tr.join(timeout=30)
+        assert seen == ["published"]
+
+
+def test_fork_join_edges_are_clean():
+    class Box:
+        def __init__(self) -> None:
+            self.payload = 0
+
+    with racecheck.checking(Box):
+        b = Box()
+        b.payload = 1          # parent writes before fork
+        t = threading.Thread(target=lambda: setattr(b, "payload", b.payload + 1))
+        t.start()
+        t.join()
+        assert b.payload == 2  # parent reads after join
+
+
+def test_condition_wait_notify_is_clean():
+    class Box:
+        def __init__(self) -> None:
+            self.payload = None
+
+    with racecheck.checking(Box):
+        b = Box()
+        cond = threading.Condition()
+        done = []
+
+        def writer() -> None:
+            with cond:
+                b.payload = "set-under-cond"
+                cond.notify()
+
+        def reader() -> None:
+            with cond:
+                while b.payload is None:
+                    cond.wait(timeout=30)
+                done.append(b.payload)
+
+        tr = threading.Thread(target=reader)
+        tw = threading.Thread(target=writer)
+        tr.start(); tw.start()
+        tr.join(timeout=30); tw.join(timeout=30)
+        assert done == ["set-under-cond"]
+
+
+def test_concurrent_map_writes_are_flagged():
+    """Go's detector aborts on concurrent map writes even to distinct
+    keys; TrackedDict models the same structural conflict."""
+    racecheck.install()
+    try:
+        d = racecheck.TrackedDict()
+
+        def writer(i: int) -> None:
+            for j in range(5):
+                d[f"k-{i}-{j}"] = j
+
+        run_threads(2, writer)
+        assert any(r.field == racecheck.TrackedDict._STRUCT
+                   and r.kind == "write-write" for r in racecheck.races())
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_locked_map_writes_are_clean():
+    racecheck.install()
+    try:
+        d = racecheck.TrackedDict()
+        mu = threading.Lock()
+
+        def writer(i: int) -> None:
+            for j in range(5):
+                with mu:
+                    d[f"k-{i}-{j}"] = j
+
+        run_threads(4, writer)
+        racecheck.assert_no_races()
+        assert len(d) == 20
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+# -------------------------------------------------------------------------
+# The repo's own hot spots under the detector
+# -------------------------------------------------------------------------
+
+
+def test_device_state_concurrent_prepares_race_free(tmp_path):
+    """32 prepare/unprepare cycles across 8 threads with DeviceState
+    monitored and every lock traced: zero unordered conflicting accesses."""
+    racecheck.install()
+    from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+    from tpu_dra.tpulib import FakeTpuLib
+    from tests.test_stress_concurrency import claim_for
+
+    racecheck.monitor(DeviceState)
+    try:
+        state = DeviceState(DeviceStateConfig(
+            tpulib=FakeTpuLib(),
+            plugin_dir=str(tmp_path / "plugin"),
+            cdi_root=str(tmp_path / "cdi"),
+        ))
+        errors: list[BaseException] = []
+
+        def worker(i: int) -> None:
+            try:
+                for round_ in range(4):
+                    uid = f"rc-{i}-{round_}"
+                    state.prepare(claim_for(uid, f"tpu-{i % 4}"))
+                    state.unprepare(uid)
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        run_threads(8, worker)
+        assert not errors, errors[:3]
+        racecheck.assert_no_races()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_workqueue_race_free():
+    racecheck.install()
+    from tpu_dra.util.workqueue import ItemExponentialBackoff, WorkQueue
+
+    racecheck.monitor(ItemExponentialBackoff)
+    racecheck.monitor(WorkQueue)
+    try:
+        wq = WorkQueue()
+        wq.run_in_background()
+        hits: list[int] = []
+        mu = threading.Lock()
+        done = threading.Event()
+
+        def cb(obj) -> None:
+            with mu:
+                hits.append(obj["i"])
+                if len(hits) == 16:
+                    done.set()
+
+        def enqueuer(i: int) -> None:
+            for j in range(4):
+                wq.enqueue(cb, {"i": i * 4 + j})
+
+        run_threads(4, enqueuer)
+        assert done.wait(timeout=30)
+        wq.shutdown()
+        racecheck.assert_no_races()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_informer_store_race_free():
+    """Writer thread feeds add/update/delete events through the informer
+    store while reader threads list and index — the relist-churn path the
+    round-2 fix touched (k8s/informer.py:134-139)."""
+    racecheck.install()
+    from tpu_dra.k8s.informer import Store
+
+    racecheck.monitor(Store)
+    try:
+        store = Store(indexers={"uid": lambda o: [o["metadata"]["uid"]]})
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def obj(i: int, rv: int) -> dict:
+            return {"metadata": {"name": f"o-{i}", "namespace": "d",
+                                 "uid": f"uid-{i}",
+                                 "resourceVersion": str(rv)}}
+
+        def writer() -> None:
+            try:
+                for rv in range(50):
+                    for i in range(4):
+                        store.add_or_update(obj(i, rv))
+                    if rv % 10 == 9:
+                        store.delete(obj(rv % 4, rv))
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        def reader() -> None:
+            try:
+                while not stop.is_set():
+                    store.list()
+                    store.by_index("uid", "uid-1")
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        tw = threading.Thread(target=writer)
+        readers = [threading.Thread(target=reader) for _ in range(2)]
+        tw.start()
+        for r in readers:
+            r.start()
+        tw.join(timeout=30)
+        for r in readers:
+            r.join(timeout=30)
+        assert not errors, errors[:3]
+        racecheck.assert_no_races()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()

@@ -1,0 +1,600 @@
+"""Dynamic data-race detector: the driver's ``go test -race`` analog.
+
+The reference validates its concurrency with the Go race detector on every
+CI run (reference ``Makefile:95-96`` runs ``go test -race``, wired into
+``.github/workflows/golang.yaml:26-44``).  Go's detector is ThreadSanitizer:
+a vector-clock happens-before checker inserted by the compiler.  Python has
+no compiler hook, so this module implements the same algorithm — FastTrack-
+style happens-before tracking (Flanagan & Freund, PLDI'09), kept with full
+vector clocks for clarity at test scale — as a test-time harness:
+
+- :func:`install` monkeypatches ``threading.Lock`` / ``threading.RLock``
+  (and therefore everything built on the module globals: ``Condition``,
+  ``Event``, ``Semaphore``, ``queue.Queue`` via its internal mutex) plus
+  ``Thread.start`` / ``Thread.join`` so that every synchronisation operation
+  publishes / joins vector clocks:
+
+  * ``lock.release()``   — release edge: the lock remembers the releaser's
+    clock; the releaser then ticks its own component.
+  * ``lock.acquire()``   — acquire edge: the acquirer joins the lock's clock.
+  * ``Thread.start()``   — fork edge: the child begins with the parent's
+    clock; the parent ticks.
+  * ``Thread.join()``    — join edge: the joiner absorbs the child's final
+    clock.
+
+  ``queue.Queue`` hand-off, ``Condition.notify``/``wait`` and ``Event.set``/
+  ``wait`` need no dedicated patches: their internal locks are created via
+  the patched module globals, and the mutex release/acquire pair carries the
+  happens-before edge (a slight over-approximation — any earlier ``put`` is
+  ordered before any later ``get`` — which can hide a race but never invents
+  one; same trade Go's detector makes for channel buffers).
+
+- :func:`monitor` instruments a *class* so that instance-field reads and
+  writes are checked: two accesses to the same field from different threads,
+  at least one a write, with neither clock ordered before the other, is a
+  race — reported with both stacks.  Like ``-race``, detection is based on
+  the *ordering* of the clocks, not on the accesses physically interleaving,
+  so a missing lock is caught deterministically even when the schedule
+  happens to serialise the threads.
+
+Production code is untouched (exactly like ``-race``: instrumentation exists
+only in the test build).  ``tests/test_racecheck.py`` seeds known races to
+prove detection and runs the repo's shared-state hot spots (DeviceState,
+informer caches, the work queue) under the detector; the ``make racecheck``
+lane runs it in CI next to the stress lane.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+__all__ = [
+    "install",
+    "uninstall",
+    "monitor",
+    "unmonitor",
+    "races",
+    "reset",
+    "assert_no_races",
+    "Race",
+    "TrackedDict",
+    "checking",
+]
+
+# --------------------------------------------------------------------------
+# Vector clocks
+# --------------------------------------------------------------------------
+
+
+class _VC(dict):
+    """Vector clock: thread-ident -> logical time."""
+
+    def copy(self) -> "_VC":
+        return _VC(self)
+
+    def join(self, other: dict) -> None:
+        for k, v in other.items():
+            if self.get(k, 0) < v:
+                self[k] = v
+
+    def leq(self, other: dict) -> bool:
+        """self happens-before-or-equals other."""
+        for k, v in self.items():
+            if v > other.get(k, 0):
+                return False
+        return True
+
+
+_state_lock = threading.Lock()  # created pre-install: always a raw lock
+_thread_vcs: dict[int, _VC] = {}
+_races: list["Race"] = []
+_installed = False
+_monitored: dict[type, tuple] = {}  # cls -> (orig_getattribute, orig_setattr)
+# Reentrancy guard: detector internals must not re-enter themselves when
+# they touch locks/fields of their own.
+_local = threading.local()
+# OS thread idents are recycled as soon as a thread exits, which would make
+# a later thread indistinguishable from a dead one (its unordered accesses
+# would look same-thread and races would be missed).  Clock components are
+# therefore keyed by a never-reused counter held in thread-local storage.
+_tid_counter = iter(range(1, 1 << 62))
+
+
+def _my_tid() -> int:
+    tid = getattr(_local, "tid", None)
+    if tid is None:
+        tid = next(_tid_counter)
+        _local.tid = tid
+    return tid
+
+
+def _self_vc() -> _VC:
+    tid = _my_tid()
+    with _state_lock:
+        vc = _thread_vcs.get(tid)
+        if vc is None:
+            vc = _VC({tid: 1})
+            _thread_vcs[tid] = vc
+        return vc
+
+
+def _tick(vc: _VC) -> None:
+    tid = _my_tid()
+    vc[tid] = vc.get(tid, 0) + 1
+
+
+@dataclass
+class Race:
+    """One detected race: an unordered conflicting pair on a field."""
+
+    field: str
+    kind: str  # "write-write" | "read-write" | "write-read"
+    first_thread: int
+    second_thread: int
+    first_stack: list[str] = field(default_factory=list)
+    second_stack: list[str] = field(default_factory=list)
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"RACE [{self.kind}] on {self.field}: "
+            f"thread {self.first_thread} vs thread {self.second_thread}\n"
+            f"  first access:\n    " + "    ".join(_fmt(self.first_stack[-4:])) +
+            f"  second access:\n    " + "    ".join(_fmt(self.second_stack[-4:]))
+        )
+
+
+def _stack() -> list:
+    # Raw FrameSummary capture, no source-line lookup: every monitored
+    # access pays this, so it must stay cheap — formatting happens lazily
+    # in Race.__str__, only for accesses that turned out to race.
+    frames = traceback.StackSummary.extract(
+        traceback.walk_stack(None), limit=8, lookup_lines=False)
+    frames.reverse()          # walk_stack yields innermost-first
+    # Drop the detector's own frames (innermost two: _stack/_record).
+    return list(frames)[:-2]
+
+
+def _fmt(frames: list) -> list[str]:
+    try:
+        return traceback.StackSummary.from_list(frames).format()
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return [repr(f) for f in frames]
+
+
+def _report(kind: str, fieldname: str, first_tid: int, first_stack,
+            second_stack) -> None:
+    with _state_lock:
+        _races.append(Race(
+            field=fieldname,
+            kind=kind,
+            first_thread=first_tid,
+            second_thread=_my_tid(),
+            first_stack=list(first_stack or ()),
+            second_stack=second_stack,
+        ))
+
+
+def races() -> list[Race]:
+    with _state_lock:
+        return list(_races)
+
+
+def reset() -> None:
+    with _state_lock:
+        _races.clear()
+        _thread_vcs.clear()
+
+
+def assert_no_races() -> None:
+    found = races()
+    if found:
+        raise AssertionError(
+            f"{len(found)} data race(s) detected:\n" +
+            "\n".join(str(r) for r in found[:10]))
+
+
+# --------------------------------------------------------------------------
+# Instrumented synchronisation primitives
+# --------------------------------------------------------------------------
+
+
+class _TracedLock:
+    """``threading.Lock`` stand-in carrying a vector clock.
+
+    Duck-types the full lock protocol including the private Condition hooks
+    (``_release_save`` etc. are only defined for the RLock variant, matching
+    CPython's Condition fallback behaviour for plain locks).
+    """
+
+    _is_rlock = False
+
+    def __init__(self) -> None:
+        self._rc_lock = _raw_lock_factory()
+        self._rc_vc = _VC()
+        self._rc_owner: Optional[int] = None
+        self._rc_count = 0
+
+    # -- edges ----------------------------------------------------------
+    def _edge_acquire(self) -> None:
+        if getattr(_local, "in_detector", False):
+            return
+        _local.in_detector = True
+        try:
+            vc = _self_vc()
+            with _state_lock:
+                vc.join(self._rc_vc)
+        finally:
+            _local.in_detector = False
+
+    def _edge_release(self) -> None:
+        if getattr(_local, "in_detector", False):
+            return
+        _local.in_detector = True
+        try:
+            vc = _self_vc()
+            with _state_lock:
+                self._rc_vc.join(vc)
+                _tick(vc)
+        finally:
+            _local.in_detector = False
+
+    # -- lock protocol --------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._is_rlock and self._rc_owner == me:
+            self._rc_count += 1
+            return True
+        got = self._rc_lock.acquire(blocking, timeout)
+        if got:
+            self._rc_owner = me
+            self._rc_count = 1
+            self._edge_acquire()
+        return got
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        if self._is_rlock:
+            if self._rc_owner != me:
+                raise RuntimeError("cannot release un-acquired lock")
+            self._rc_count -= 1
+            if self._rc_count:
+                return
+        self._edge_release()
+        self._rc_owner = None
+        self._rc_count = 0
+        self._rc_lock.release()
+
+    def locked(self) -> bool:
+        return self._rc_lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        kind = "RLock" if self._is_rlock else "Lock"
+        return f"<_Traced{kind} owner={self._rc_owner} count={self._rc_count}>"
+
+
+class _TracedRLock(_TracedLock):
+    _is_rlock = True
+
+    # Condition integration (CPython threading.py duck-typing hooks).
+    def _release_save(self):
+        count, owner = self._rc_count, self._rc_owner
+        self._edge_release()
+        self._rc_count = 0
+        self._rc_owner = None
+        self._rc_lock.release()
+        return (count, owner)
+
+    def _acquire_restore(self, state) -> None:
+        self._rc_lock.acquire()
+        self._rc_count, self._rc_owner = state
+        self._edge_acquire()
+
+    def _is_owned(self) -> bool:
+        return self._rc_owner == threading.get_ident()
+
+
+_raw_lock_factory = threading.Lock  # rebound at install() to the true factory
+_orig: dict[str, Any] = {}
+
+
+def install() -> None:
+    """Patch ``threading`` so sync operations carry happens-before edges.
+
+    Must run before the objects under test (and their locks/queues/events)
+    are constructed — primitives created earlier stay untraced, exactly as
+    un-instrumented code is invisible to ``-race``.
+    """
+    global _installed, _raw_lock_factory
+    if _installed:
+        return
+    reset()
+    _raw_lock_factory = threading.Lock
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    _orig["start"] = threading.Thread.start
+    _orig["join"] = threading.Thread.join
+
+    threading.Lock = _TracedLock  # type: ignore[misc,assignment]
+    threading.RLock = _TracedRLock  # type: ignore[misc,assignment]
+
+    orig_start = _orig["start"]
+    orig_join = _orig["join"]
+
+    def traced_start(self: threading.Thread) -> None:
+        parent_vc = _self_vc()
+        with _state_lock:
+            snapshot = parent_vc.copy()
+            _tick(parent_vc)
+        self._rc_parent_vc = snapshot  # type: ignore[attr-defined]
+        inner_run = self.run
+
+        def bootstrapped_run() -> None:
+            tid = _my_tid()
+            with _state_lock:
+                # The interpreter's own bootstrap (``self._started.set()``)
+                # runs before ``run()`` and touches traced locks, so this
+                # thread may already own an advanced clock — join the fork
+                # snapshot into it; never overwrite (clocks must not move
+                # backwards or pre-run edges would order later accesses).
+                child = _thread_vcs.get(tid)
+                if child is None:
+                    child = _VC()
+                    _thread_vcs[tid] = child
+                child.join(snapshot)
+                child[tid] = child.get(tid, 0) + 1
+            try:
+                inner_run()
+            finally:
+                with _state_lock:
+                    self._rc_final_vc = child.copy()  # type: ignore[attr-defined]
+
+        self.run = bootstrapped_run  # type: ignore[method-assign]
+        orig_start(self)
+
+    def traced_join(self: threading.Thread, timeout: Optional[float] = None) -> None:
+        orig_join(self, timeout)
+        final = getattr(self, "_rc_final_vc", None)
+        if final is not None and not self.is_alive():
+            vc = _self_vc()
+            with _state_lock:
+                vc.join(final)
+
+    threading.Thread.start = traced_start  # type: ignore[method-assign]
+    threading.Thread.join = traced_join  # type: ignore[method-assign]
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore ``threading``; monitored classes are restored too."""
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig["Lock"]  # type: ignore[misc]
+    threading.RLock = _orig["RLock"]  # type: ignore[misc]
+    threading.Thread.start = _orig["start"]  # type: ignore[method-assign]
+    threading.Thread.join = _orig["join"]  # type: ignore[method-assign]
+    for cls in list(_monitored):
+        unmonitor(cls)
+    _installed = False
+
+
+# --------------------------------------------------------------------------
+# Field-access monitoring
+# --------------------------------------------------------------------------
+
+_IGNORED_PREFIXES = ("_rc_", "__")
+
+
+class _FieldState:
+    __slots__ = ("write_vc", "write_tid", "write_stack", "reads")
+
+    def __init__(self) -> None:
+        self.write_vc: Optional[_VC] = None
+        self.write_tid = 0
+        self.write_stack: list[str] = []
+        # tid -> (vc-at-read, stack)
+        self.reads: dict[int, tuple[_VC, list[str]]] = {}
+
+
+def _obj_states(obj: Any) -> dict[str, _FieldState]:
+    d = object.__getattribute__(obj, "__dict__")
+    states = d.get("_rc_fields")
+    if states is None:
+        states = {}
+        d["_rc_fields"] = states
+    return states
+
+
+def _record(obj: Any, name: str, is_write: bool) -> None:
+    if getattr(_local, "in_detector", False):
+        return
+    _local.in_detector = True
+    try:
+        me = _my_tid()
+        vc = _self_vc()
+        stack = _stack()
+        found: list[tuple[str, int, list[str]]] = []
+        with _state_lock:
+            st = _obj_states(obj).setdefault(name, _FieldState())
+            my_vc = vc.copy()
+            if is_write:
+                if (st.write_vc is not None and st.write_tid != me
+                        and not st.write_vc.leq(my_vc)):
+                    found.append(("write-write", st.write_tid, st.write_stack))
+                for tid, (rvc, rstack) in st.reads.items():
+                    if tid != me and not rvc.leq(my_vc):
+                        found.append(("read-write", tid, rstack))
+                st.write_vc = my_vc
+                st.write_tid = me
+                st.write_stack = stack
+                st.reads = {}
+            else:
+                if (st.write_vc is not None and st.write_tid != me
+                        and not st.write_vc.leq(my_vc)):
+                    found.append(("write-read", st.write_tid, st.write_stack))
+                st.reads[me] = (my_vc, stack)
+        for kind, tid, first_stack in found:
+            _report(kind, name, tid, first_stack, stack)
+    finally:
+        _local.in_detector = False
+
+
+def monitor(cls: type) -> type:
+    """Instrument ``cls`` so instance-field accesses are race-checked.
+
+    Only *instance* state is tracked (a name present in the instance
+    ``__dict__``): method and class-attribute lookups are reads of immutable
+    shared structure and would be pure noise.  Usable as a decorator in
+    tests or called on production classes (DeviceState, informer caches)
+    before constructing the objects under test.
+    """
+    if cls in _monitored:
+        return cls
+    orig_get = cls.__getattribute__
+    orig_set = cls.__setattr__
+
+    def traced_getattribute(self, name: str):
+        value = orig_get(self, name)
+        if not name.startswith(_IGNORED_PREFIXES):
+            try:
+                in_instance = name in object.__getattribute__(self, "__dict__")
+            except AttributeError:
+                in_instance = False
+            if in_instance:
+                _record(self, name, is_write=False)
+        return value
+
+    def traced_setattr(self, name: str, value) -> None:
+        if not name.startswith(_IGNORED_PREFIXES):
+            _record(self, name, is_write=True)
+        orig_set(self, name, value)
+
+    cls.__getattribute__ = traced_getattribute  # type: ignore[method-assign]
+    cls.__setattr__ = traced_setattr  # type: ignore[method-assign]
+    _monitored[cls] = (orig_get, orig_set)
+    return cls
+
+
+def unmonitor(cls: type) -> None:
+    saved = _monitored.pop(cls, None)
+    if saved is None:
+        return
+    orig_get, orig_set = saved
+    cls.__getattribute__ = orig_get  # type: ignore[method-assign]
+    cls.__setattr__ = orig_set  # type: ignore[method-assign]
+
+
+class TrackedDict(dict):
+    """Race-checked dict: the Go concurrent-map-access analog.
+
+    Go's detector treats any unordered write pair on a map as fatal even
+    for distinct keys; attribute-level monitoring cannot see ``d[k] = v``
+    (the attribute is only *read*), so shared dicts are swapped for this in
+    tests.  Reads record per-key accesses plus a structural read for
+    iteration/len; every mutation records both the key and a structural
+    write, so unordered insert/insert on different keys is flagged exactly
+    like a Go ``concurrent map writes`` crash.
+    """
+
+    _STRUCT = "<struct>"
+
+    def _r(self, key: Any, is_write: bool) -> None:
+        _record(self, f"[{key!r}]", is_write)
+        _record(self, self._STRUCT, is_write)
+
+    def __getitem__(self, key: Any):
+        self._r(key, False)
+        return dict.__getitem__(self, key)
+
+    def get(self, key: Any, default: Any = None):
+        self._r(key, False)
+        return dict.get(self, key, default)
+
+    def __contains__(self, key: Any) -> bool:
+        self._r(key, False)
+        return dict.__contains__(self, key)
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._r(key, True)
+        dict.__setitem__(self, key, value)
+
+    def __delitem__(self, key: Any) -> None:
+        self._r(key, True)
+        dict.__delitem__(self, key)
+
+    def pop(self, key: Any, *default: Any):
+        self._r(key, True)
+        return dict.pop(self, key, *default)
+
+    def setdefault(self, key: Any, default: Any = None):
+        self._r(key, True)
+        return dict.setdefault(self, key, default)
+
+    def update(self, *args: Any, **kw: Any) -> None:
+        _record(self, self._STRUCT, True)
+        dict.update(self, *args, **kw)
+
+    def clear(self) -> None:
+        _record(self, self._STRUCT, True)
+        dict.clear(self)
+
+    def __iter__(self):
+        _record(self, self._STRUCT, False)
+        return dict.__iter__(self)
+
+    def __len__(self) -> int:
+        _record(self, self._STRUCT, False)
+        return dict.__len__(self)
+
+    def items(self):
+        _record(self, self._STRUCT, False)
+        return dict.items(self)
+
+    def values(self):
+        _record(self, self._STRUCT, False)
+        return dict.values(self)
+
+    def keys(self):
+        _record(self, self._STRUCT, False)
+        return dict.keys(self)
+
+
+class checking:
+    """Context manager: ``with racecheck.checking(ClassA, ClassB): ...``.
+
+    Installs the threading patches, monitors the given classes, and on exit
+    asserts no races were found (pass ``expect_races=True`` to invert, for
+    seeded-race tests) before uninstalling.
+    """
+
+    def __init__(self, *classes: type, expect_races: bool = False) -> None:
+        self.classes = classes
+        self.expect_races = expect_races
+
+    def __enter__(self) -> "checking":
+        install()
+        for cls in self.classes:
+            monitor(cls)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if exc_type is None:
+                if self.expect_races:
+                    if not races():
+                        raise AssertionError(
+                            "expected the seeded race to be detected")
+                else:
+                    assert_no_races()
+        finally:
+            uninstall()
+            reset()
