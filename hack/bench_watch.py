@@ -28,14 +28,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STATE = "/tmp/bench_watch"
 os.makedirs(STATE, exist_ok=True)
 
-PROBE_TIMEOUT_S = 240
+# Must cover a COLD backend init over the tunnel plus the probe matmul —
+# bench.py budgets 360s for the same round-trip; stay above that.
+PROBE_TIMEOUT_S = 420
 PROBE_INTERVAL_DOWN_S = 300
 REFRESH_INTERVAL_UP_S = 5400
 BENCH_TIMEOUT_S = 4200
 TUNE_TIMEOUT_S = 2400
 
-PROBE_SRC = ("import jax; d = jax.devices(); "
-             "print(d[0].platform, len(d))")
+# Enumeration alone is not proof — the axon relay can list the device while
+# the compute/compile path is wedged.  Demand a real matmul round-trip.
+PROBE_SRC = (
+    "import jax, jax.numpy as jnp; d = jax.devices(); "
+    "x = jnp.ones((512, 512), jnp.bfloat16); "
+    "s = float(jnp.sum((x @ x).astype(jnp.float32))); "
+    "print(d[0].platform, len(d), s)")
 
 
 def _log(msg: str) -> None:

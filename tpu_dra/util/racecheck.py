@@ -414,14 +414,15 @@ def _obj_states(obj: Any) -> dict[str, _FieldState]:
     return states
 
 
-def _record(obj: Any, name: str, is_write: bool) -> None:
+def _record(obj: Any, name: str, is_write: bool, stack=None) -> None:
     if getattr(_local, "in_detector", False):
         return
     _local.in_detector = True
     try:
         me = _my_tid()
         vc = _self_vc()
-        stack = _stack()
+        if stack is None:
+            stack = _stack()
         found: list[tuple[str, int, list[str]]] = []
         with _state_lock:
             st = _obj_states(obj).setdefault(name, _FieldState())
@@ -508,8 +509,9 @@ class TrackedDict(dict):
     _STRUCT = "<struct>"
 
     def _r(self, key: Any, is_write: bool) -> None:
-        _record(self, f"[{key!r}]", is_write)
-        _record(self, self._STRUCT, is_write)
+        stack = _stack()           # one capture shared by both records
+        _record(self, f"[{key!r}]", is_write, stack=stack)
+        _record(self, self._STRUCT, is_write, stack=stack)
 
     def __getitem__(self, key: Any):
         self._r(key, False)

@@ -166,6 +166,12 @@ def _flash_attn_kernel(q_ref, k_ref, v_ref, out_ref, l2_ref, m_ref, l_ref,
         l2_ref[0] = m_ref[:, :1] + jnp.log2(l)
 
 
+# Mosaic's default scoped-VMEM budget (16 MiB) is smaller than the fp32
+# score intermediates of a 1024-square flash block; the hardware itself has
+# 128 MiB of VMEM per v5e/v4 core.  The flash kernels lift their budget so
+# block-size choice is a *performance* knob, not a compile-crash knob.
+_FLASH_VMEM_LIMIT = 100 * 1024 * 1024
+
 _LOG2E = 1.4426950408889634
 
 
@@ -266,7 +272,8 @@ def _flash_attn_fwd_gqa(q, k, v, *, causal: bool, bq: int, bk: int,
                         pltpu.VMEM((g, bq, 128), jnp.float32),
                         pltpu.VMEM((g, bq, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_FLASH_VMEM_LIMIT),
         interpret=interpret,
     )(q, k, v)
 
@@ -317,7 +324,8 @@ def _flash_attn_fwd(q, k, v, *, causal: bool, bq: int, bk: int,
                         pltpu.VMEM((bq, 128), jnp.float32),
                         pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=_FLASH_VMEM_LIMIT),
         interpret=interpret,
     )(q, k, v)
 
@@ -564,7 +572,8 @@ def _flash_attn_bwd(q, k, v, out, l2, g, *, causal: bool, bq: int, bk: int,
         # changes — only the dd operand shifts.
         dd = dd - _LOG2E * g_l2.astype(jnp.float32).reshape(bh, s, 1)
     compiler_params = (None if interpret else pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary")))
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=_FLASH_VMEM_LIMIT))
     # The k/v index maps must be the PLAIN lambda: an always-identity
     # ``b // grp`` defeats Mosaic's invariant-block analysis, and the
     # dK/dV kernel (k/v constant across its inner axis) then re-DMAs
