@@ -1,0 +1,187 @@
+"""Paged continuous engine (kv_layout="paged").
+
+Contract: paging changes the engine's MEMORY accounting, never its
+tokens — every request's output must be byte-identical to the slab
+engine's for the same (prompt, steps, seed, temperature).  On top of
+that: page bookkeeping must balance (no leaks across admit/retire
+churn), and admission must block on pool exhaustion without reordering
+the FIFO.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dra.workloads.continuous import ContinuousEngine
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+CFG = ModelConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                  d_ff=128, max_seq=64)
+# Random-init logits are nearly uniform — gaps of ~0.01 while bf16
+# cross-implementation noise is ~0.03, so greedy argmax between two
+# CORRECT attention implementations flips on ties.  Scaling the (tied)
+# embedding spreads the logit gaps well past bf16 noise, making exact
+# token parity a meaningful contract (a trained checkpoint is decisive
+# the same way).
+_P0 = init_params(CFG, jax.random.PRNGKey(0))
+PARAMS = dict(_P0, embed=_P0["embed"] * 4.0)
+
+
+def paged_engine(**kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("max_len", 40)
+    kw.setdefault("page_size", 8)
+    return ContinuousEngine(CFG, PARAMS, kv_layout="paged", **kw)
+
+
+def test_rejects_incompatible_modes():
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousEngine(CFG, PARAMS, kv_layout="paged",
+                         draft=(CFG, PARAMS), chunk=2)
+    with pytest.raises(ValueError, match="bf16"):
+        ContinuousEngine(CFG, PARAMS, kv_layout="paged",
+                         cache_dtype="int8")
+    with pytest.raises(ValueError, match="kv_layout"):
+        ContinuousEngine(CFG, PARAMS, kv_layout="pagedd")
+    eng = paged_engine()
+    try:
+        with pytest.raises(ValueError, match="prefix"):
+            eng.register_prefix([1, 2, 3])
+        with pytest.raises(ValueError, match="prefix"):
+            eng.submit([1], 2, prefix_id="nope")
+    finally:
+        eng.shutdown()
+
+
+def test_paged_tokens_equal_slab_tokens():
+    reqs = [([3, 5, 7], 6, 0.0, 0),
+            ([2, 4], 9, 0.0, 0),
+            ([11, 12, 13, 14, 15], 4, 0.8, 7),
+            ([9] * 12, 5, 0.6, 3)]
+    slab = ContinuousEngine(CFG, PARAMS, slots=4, chunk=2, max_len=40)
+    try:
+        want = [slab.submit(p, s, temperature=t, seed=sd, timeout=120)
+                for p, s, t, sd in reqs]
+    finally:
+        slab.shutdown()
+    eng = paged_engine()
+    try:
+        got = [eng.submit(p, s, temperature=t, seed=sd, timeout=120)
+               for p, s, t, sd in reqs]
+    finally:
+        eng.shutdown()
+    assert got == want
+
+
+def test_concurrent_mixed_lengths_and_page_balance():
+    eng = paged_engine(slots=3, total_pages=12)
+    results: dict[int, list[int]] = {}
+    errs: list[BaseException] = []
+
+    def worker(i):
+        try:
+            results[i] = eng.submit([1 + i, 2 + i], 3 + (i % 5),
+                                    timeout=180)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errs, errs[:2]
+        assert len(results) == 10
+        for i, toks in results.items():
+            assert len(toks) == 3 + (i % 5)
+        st = eng.stats()
+        assert st["completed"] == 10
+        # every page returned: the pool must be whole again
+        assert st["kv_pages_free"] == st["kv_pages_total"] == 12
+    finally:
+        eng.shutdown()
+
+    # reproducibility across engines: same request later, same tokens
+    eng2 = paged_engine(slots=3, total_pages=12)
+    try:
+        again = eng2.submit([1, 2], 3, timeout=180)
+        assert again == results[0]
+    finally:
+        eng2.shutdown()
+
+
+def test_admission_blocks_on_page_exhaustion_not_reorders():
+    """Pool sized for ONE long request at a time: the second long request
+    must wait for the first to retire and free pages, and a later short
+    request must not jump the FIFO past the blocked head."""
+    # page_size 8, max_len 40 -> MP 5; pool of 3 pages: prompt 2 + steps
+    # 14 -> 2 pages each
+    eng = paged_engine(slots=2, total_pages=3)
+    try:
+        a = eng.submit_async([1, 2], 14)
+        b = eng.submit_async([3, 4], 14)
+        c = eng.submit_async([5, 6], 2)          # 1 page — would fit NOW
+        assert a.done.wait(180) and not a.error
+        assert b.done.wait(180) and not b.error
+        assert c.done.wait(180) and not c.error
+        # FIFO: c finished AFTER b started (no overtake) — b's first
+        # token timestamp precedes c's completion
+        assert len(a.tokens) == 14 and len(b.tokens) == 14
+        assert len(c.tokens) == 2
+        st = eng.stats()
+        assert st["kv_pages_free"] == 3
+    finally:
+        eng.shutdown()
+
+
+def test_eos_retire_frees_pages_early():
+    eng = paged_engine(slots=2, total_pages=10)
+    try:
+        # find the greedy continuation, then use its first token as eos
+        probe = eng.submit([1, 2, 3], 4, timeout=120)
+        eos = probe[0]
+        out = eng.submit([1, 2, 3], 4, eos_id=eos, timeout=120)
+        assert out == [eos]
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"]
+    finally:
+        eng.shutdown()
+
+
+def test_unservable_request_rejected_not_livelocked():
+    """A request needing more pages than the POOL HAS must fail at
+    submit — the FIFO admission gate would otherwise wait on it forever
+    and starve everything queued behind it."""
+    eng = paged_engine(slots=2, total_pages=2)   # 16 tokens of pool
+    try:
+        with pytest.raises(ValueError, match="KV pages"):
+            eng.submit([1] * 20, 10)
+        # and the engine still serves what fits
+        assert len(eng.submit([1, 2], 3, timeout=120)) == 3
+    finally:
+        eng.shutdown()
+
+
+def test_page_geometry_validated():
+    with pytest.raises(ValueError, match="power of two"):
+        paged_engine(page_size=48)
+    with pytest.raises(ValueError, match="multiple"):
+        ContinuousEngine(CFG, PARAMS, kv_layout="paged", slots=2,
+                         max_len=40, page_size=16)   # 40 % 16 != 0
+    with pytest.raises(ValueError, match="multiple"):
+        ContinuousEngine(CFG, PARAMS, kv_layout="paged", slots=2,
+                         max_len=8, page_size=16)    # page > max_len
+
+
+def test_pool_alloc_zero_is_empty():
+    from tpu_dra.workloads.paged_kv import PagePool
+    pool = PagePool(4, 8)
+    assert pool.alloc(0) == []
+    assert pool.free_pages == 4

@@ -433,3 +433,25 @@ def test_weights_cache_form_and_shape_mismatch_error(tmp_path):
         "form": "int8", "model": {**dims, "d_model": 999}})
     with pytest.raises(SystemExit):
         serve.main([*flags, "--weights-cache", wc2])
+
+
+def test_paged_engine_through_http():
+    """--kv-layout paged end to end: /generate works, engine gauges carry
+    the page-pool stats, and the pool is whole after completion."""
+    from tpu_dra.workloads.serve import serve as serve_fn
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve_fn(cfg, params, port=0, continuous=True, slots=2, chunk=2,
+                   kv_layout="paged", page_size=8)
+    host, port = srv.server_address
+    try:
+        out = _post(f"http://{host}:{port}", {"tokens": [[1, 2]],
+                                              "steps": 3}, timeout=180)
+        assert len(out["tokens"][0]) == 3
+        st = srv.engine.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"]
+        assert st["kv_page_size"] == 8
+    finally:
+        srv.shutdown()

@@ -113,7 +113,9 @@ class ContinuousEngine:
                  max_len: Optional[int] = None, cache_dtype: str = "bf16",
                  chunk: int = 4, top_k: int = 0, top_p: float = 0.0,
                  latency_window: int = 1024, max_prefixes: int = 8,
-                 draft: Optional[tuple] = None):
+                 draft: Optional[tuple] = None,
+                 kv_layout: str = "slab", page_size: int = 64,
+                 total_pages: Optional[int] = None):
         """``draft=(draft_cfg, draft_params)`` turns each chunk dispatch
         into ONE speculative iteration: the draft proposes ``chunk-1``
         tokens, the target verifies them in a single ragged chunk
@@ -138,6 +140,17 @@ class ContinuousEngine:
             if chunk < 2:
                 raise ValueError("speculative engine needs chunk >= 2 "
                                  "(chunk-1 drafted + 1 bonus per pass)")
+        if kv_layout not in ("slab", "paged"):
+            raise ValueError(f"kv_layout must be 'slab' or 'paged', "
+                             f"got {kv_layout!r}")
+        if kv_layout == "paged":
+            if draft is not None:
+                raise ValueError("paged engine does not support "
+                                 "speculative drafts yet (two page pools)")
+            if cache_dtype != "bf16":
+                raise ValueError("paged engine is bf16-only "
+                                 "(int8 paging composes later)")
+        self.kv_layout = kv_layout
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -160,7 +173,35 @@ class ContinuousEngine:
             self.target_passes = 0
             self.spec_committed = 0
             self.spec_slot_passes = 0
-        self._cache = init_kv_cache(cfg, slots, self.max_len, cache_dtype)
+        if kv_layout == "paged":
+            from tpu_dra.workloads.paged_kv import (PagePool,
+                                                    init_paged_cache)
+            ps = page_size
+            # Geometry that keeps every prefill pad inside max_len (and
+            # therefore inside a learned model's position table): with a
+            # power-of-two page and max_len a page multiple, every
+            # clamped prompt bucket pads to <= max_len.  Without this, a
+            # 48-token page against a 64 bucket pads prompts to 96 and a
+            # learned-position trace crashes the batcher.
+            if ps < 1 or ps & (ps - 1):
+                raise ValueError(f"page_size must be a power of two, "
+                                 f"got {ps}")
+            if ps > self.max_len or self.max_len % ps:
+                raise ValueError(
+                    f"max_len {self.max_len} must be a multiple of "
+                    f"page_size {ps} (and at least one page)")
+            self._mp = self.max_len // ps          # pages per slot, max
+            cap = total_pages if total_pages is not None \
+                else slots * self._mp
+            self.pool = PagePool(cap, ps)
+            # CPU runs use the gather oracle; TPU runs the Pallas kernel
+            self._interpret = jax.devices()[0].platform != "tpu"
+            self._cache = init_paged_cache(cfg, cap, ps)
+            self._table = jnp.full((slots, self._mp), -1, jnp.int32)
+            self._page_ids: list[Optional[list[int]]] = [None] * slots
+        else:
+            self._cache = init_kv_cache(cfg, slots, self.max_len,
+                                        cache_dtype)
         self._token = jnp.zeros((slots,), jnp.int32)
         self._pos = jnp.zeros((slots,), jnp.int32)
         self._temp = jnp.zeros((slots,), jnp.float32)
@@ -189,8 +230,13 @@ class ContinuousEngine:
         # donation: the slot cache is the engine's dominant HBM object;
         # without it every dispatch copies the whole cache (double peak
         # HBM + a full-cache copy per chunk)
-        self._step_fn = jax.jit(partial(self._chunk_step_impl, cfg),
-                                donate_argnums=(1, 2, 3, 6, 7))
+        if kv_layout == "paged":
+            self._step_fn = jax.jit(
+                partial(self._paged_chunk_step_impl, cfg),
+                donate_argnums=(1, 2, 3, 6, 7))    # cache/token/pos/done/keys
+        else:
+            self._step_fn = jax.jit(partial(self._chunk_step_impl, cfg),
+                                    donate_argnums=(1, 2, 3, 6, 7))
         if draft is not None:
             self._spec_step_fn = jax.jit(
                 partial(self._spec_chunk_impl, cfg, draft[0]),
@@ -201,6 +247,40 @@ class ContinuousEngine:
         self._thread.start()
 
     # -- compiled programs --------------------------------------------------
+
+    def _first_token(self, logits, temps, keys):
+        """Admission-time token selection, shared by the slab and paged
+        prefills: greedy at temperature 0, else temperature-scaled
+        sampling under the engine-global top_k/top_p filters, each row
+        drawing from its own request-seeded key."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        filt = _filter_topk_topp(
+            logits / jnp.maximum(temps, 1e-6)[:, None],
+            self.top_k, self.top_p)
+        sampled = jax.vmap(
+            lambda kk, lg: jax.random.categorical(kk, lg))(keys, filt)
+        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+    def _advance(self, logits, token, pos, temp, eos, done, keys):
+        """Chunk-scan sample-and-advance tail, shared by the slab and
+        paged step bodies — ONE implementation so the two layouts cannot
+        drift apart on sampling/freeze/eos semantics (the byte-parity
+        contract in tests/test_continuous_paged.py).  Per-slot key
+        streams: split each slot's key, draw with its own subkey — a
+        slot's samples never depend on its neighbors."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        split = jax.vmap(jax.random.split)(keys)         # [slots, 2, 2]
+        keys, draw = split[:, 0], split[:, 1]
+        filt = _filter_topk_topp(
+            logits / jnp.maximum(temp, 1e-6)[:, None],
+            self.top_k, self.top_p)
+        sampled = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg))(draw, filt)
+        nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+        nxt = jnp.where(done, token, nxt)           # frozen slots hold
+        done2 = done | (nxt == eos)
+        pos = pos + jnp.where(done, 0, 1)
+        return nxt, pos, done2, keys
 
     def _prefill_impl(self, cfg, params, cache, prompts, lengths, slots,
                       temps, keys):
@@ -216,16 +296,7 @@ class ContinuousEngine:
         small, x = _prefill_trunk(cfg, params, small, prompts)
         last = x[jnp.arange(k), lengths - 1][:, None, :]
         logits = head_logits(params, last)[:, 0]        # [k, vocab]
-        # per-request temperature: greedy when 0, else temperature-scaled
-        # sampling under the engine-global top_k/top_p filters, each row
-        # drawing from its own request-seeded key
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        filt = _filter_topk_topp(
-            logits / jnp.maximum(temps, 1e-6)[:, None],
-            self.top_k, self.top_p)
-        sampled = jax.vmap(
-            lambda kk, lg: jax.random.categorical(kk, lg))(keys, filt)
-        first = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+        first = self._first_token(logits, temps, keys)
         cache = {name: cache[name].at[:, slots, :, :Sb, :].set(
             small[name].astype(cache[name].dtype)) for name in cache}
         return cache, first
@@ -240,25 +311,59 @@ class ContinuousEngine:
         def step(carry, _):
             cache, token, pos, done, keys = carry
             logits, cache = _token_logits(cfg, params, cache, pos, token)
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            # per-slot key streams: split each slot's key, draw with its
-            # own subkey — a slot's samples never depend on its neighbors
-            split = jax.vmap(jax.random.split)(keys)     # [slots, 2, 2]
-            keys, draw = split[:, 0], split[:, 1]
-            filt = _filter_topk_topp(
-                logits / jnp.maximum(temp, 1e-6)[:, None],
-                self.top_k, self.top_p)
-            sampled = jax.vmap(
-                lambda k, lg: jax.random.categorical(k, lg))(draw, filt)
-            nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
-            nxt = jnp.where(done, token, nxt)       # frozen slots hold
-            done2 = done | (nxt == eos)
-            pos = pos + jnp.where(done, 0, 1)
+            nxt, pos, done2, keys = self._advance(logits, token, pos,
+                                                  temp, eos, done, keys)
             return (cache, nxt, pos, done2, keys), nxt
 
         (cache, token, pos, done, keys), toks = jax.lax.scan(
             step, (cache, token, pos, done, keys), None, length=self.chunk)
         return cache, token, pos, done, keys, toks.T    # [slots, chunk]
+
+    def _paged_prefill_impl(self, cfg, params, cache, prompts, lengths,
+                            temps, keys, rows):
+        """Paged admission: run the prefill trunk, scatter the KV straight
+        into the joining slots' PAGES (``rows`` [k, MP] — no contiguous
+        slot rows exist), and select each first token.  The prompt pad to
+        a page multiple is causal-dead and masked by ``lengths``."""
+        from tpu_dra.workloads.paged_kv import _prefill_kv, scatter_prefill
+        k, Sb = prompts.shape
+        ps = cache["k"].shape[3]
+        pad = (-Sb) % ps
+        if pad:
+            prompts = jnp.pad(prompts, ((0, 0), (0, pad)))
+        ks, vs, x = _prefill_kv(cfg, params, prompts)
+        cache = scatter_prefill(cache, ks, vs, rows)
+        last = x[jnp.arange(k), lengths - 1][:, None, :]
+        logits = head_logits(params, last)[:, 0]
+        return cache, self._first_token(logits, temps, keys)
+
+    def _paged_prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(("paged", bucket))
+        if fn is None:
+            fn = jax.jit(partial(self._paged_prefill_impl, self.cfg),
+                         donate_argnums=(1,))       # the page pool
+            self._prefill_fns[("paged", bucket)] = fn
+        return fn
+
+    def _paged_chunk_step_impl(self, cfg, params, cache, token, pos, temp,
+                               eos, done, keys, table):
+        """Paged analog of _chunk_step_impl: same scan, same sampling and
+        freeze semantics; KV appends land in each slot's pages (retired
+        slots' all-(-1) table rows drop their writes — see paged_kv
+        sentinel handling) and attention walks the block table."""
+        from tpu_dra.workloads.paged_kv import _paged_step
+
+        def step(carry, _):
+            cache, token, pos, done, keys = carry
+            cache, logits, _ = _paged_step(cfg, params, cache, token, pos,
+                                           table, self._interpret)
+            nxt, pos, done2, keys = self._advance(logits, token, pos,
+                                                  temp, eos, done, keys)
+            return (cache, nxt, pos, done2, keys), nxt
+
+        (cache, token, pos, done, keys), toks = jax.lax.scan(
+            step, (cache, token, pos, done, keys), None, length=self.chunk)
+        return cache, token, pos, done, keys, toks.T
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -419,6 +524,9 @@ class ContinuousEngine:
         The prefix KV is computed once and copied into a slot at every
         join — requests pay prefill only for their suffix.  LRU-bounded
         at ``max_prefixes``; re-registering is idempotent."""
+        if self.kv_layout == "paged":
+            raise ValueError("paged engine does not support prefix joins "
+                             "yet (prefix KV lives in slab rows)")
         import hashlib
 
         cfg = self.cfg
@@ -499,6 +607,20 @@ class ContinuousEngine:
             if prefix_id is not None:
                 raise ValueError("speculative engine does not support "
                                  "prefix joins")
+        if self.kv_layout == "paged":
+            if prefix_id is not None:
+                raise ValueError("paged engine does not support prefix "
+                                 "joins yet (prefix KV lives in slab rows)")
+            need = self.pool.pages_for(len(prompt) + steps)
+            if need > self.pool.total_pages:
+                # an unservable request must fail HERE: the FIFO admission
+                # gate would otherwise wait on it forever and starve
+                # everything behind it
+                raise ValueError(
+                    f"request needs {need} KV pages (prompt "
+                    f"{len(prompt)} + steps {steps} @ page_size "
+                    f"{self.pool.page_size}) but the pool only has "
+                    f"{self.pool.total_pages}")
         plen = 0
         if prefix_id is not None:
             with self._cv:
@@ -543,6 +665,10 @@ class ContinuousEngine:
         out = {"completed": self.completed, "tokens_out": self.tokens_out,
                "queued": len(self._pending),
                "active": sum(r is not None for r in self._requests)}
+        if self.kv_layout == "paged":
+            out["kv_pages_total"] = self.pool.total_pages
+            out["kv_pages_free"] = self.pool.free_pages
+            out["kv_page_size"] = self.pool.page_size
         if self.draft is not None and self.target_passes:
             # committed tokens per LIVE SLOT per target pass — 1.0 is
             # plain-decode parity, chunk the full-accept ceiling
@@ -591,6 +717,18 @@ class ContinuousEngine:
         for slot in range(self.slots):
             if self._requests[slot] is not None or not self._pending:
                 continue
+            if self.kv_layout == "paged":
+                # FIFO-preserving page gate: if the HEAD request cannot
+                # get its worst-case pages (prompt + steps), stop
+                # admitting — later smaller requests must not starve it
+                req = self._pending[0]
+                need = self.pool.pages_for(len(req.prompt) + req.steps)
+                if need > self.pool.free_pages:
+                    break
+                ids = self.pool.alloc(need)
+                self._page_ids[slot] = ids
+                self._table = self._table.at[slot].set(
+                    jnp.asarray(self.pool.table_row(ids, self._mp)))
             assigned.append((slot, self._pending.popleft()))
         plain: dict[int, list[tuple[int, _Request]]] = {}
         for slot, req in assigned:
@@ -630,6 +768,16 @@ class ContinuousEngine:
                 self.params, self.draft[1], self._cache, self._dcache,
                 prompts, lengths, slots)
             self._cache, self._dcache = cache, dcache
+        elif self.kv_layout == "paged":
+            temps = jnp.asarray([req.temperature for _, req in group],
+                                jnp.float32)
+            keys0 = jnp.stack([jax.random.fold_in(kk, 0)
+                               for kk in base_keys])
+            rows = self._table[slots]                      # [k, MP]
+            cache, first = self._paged_prefill_fn(Sb)(
+                self.params, self._cache, prompts, lengths, temps,
+                keys0, rows)
+            self._cache = cache
         else:
             temps = jnp.asarray([req.temperature for _, req in group],
                                 jnp.float32)
@@ -690,6 +838,12 @@ class ContinuousEngine:
             self._requests[slot] = req
 
     def _retire(self, slot: int, req: _Request) -> None:
+        if self.kv_layout == "paged" and self._page_ids[slot] is not None:
+            # all-(-1) row first: in-flight chunk appends for this slot
+            # must drop BEFORE its pages go back to the pool
+            self._table = self._table.at[slot].set(-1)
+            self.pool.free(self._page_ids[slot])
+            self._page_ids[slot] = None
         req.finished = time.perf_counter()
         self.completed += 1
         self.tokens_out += len(req.tokens)
@@ -742,6 +896,13 @@ class ContinuousEngine:
                         if r is not None]
                 self.spec_committed += sum(c for c, _ in live)
                 self.spec_slot_passes += len(live)
+            elif self.kv_layout == "paged":
+                (self._cache, self._token, self._pos, self._done,
+                 self._keys, toks) = self._step_fn(
+                    self.params, self._cache, self._token, self._pos,
+                    self._temp, self._eos, self._done, self._keys,
+                    self._table)
+                counts_host = [self.chunk] * self.slots
             else:
                 (self._cache, self._token, self._pos, self._done,
                  self._keys, toks) = self._step_fn(

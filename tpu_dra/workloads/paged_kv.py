@@ -1,0 +1,421 @@
+"""Paged KV cache — block-table serving memory, redesigned TPU-first.
+
+GPU serving stacks get non-contiguous KV memory from vLLM-style
+PagedAttention kernels (pointer-chasing CUDA); the reference driver's
+serving demos simply consume the claimed devices
+(/root/reference/demo/specs/quickstart/gpu-test5.yaml).  The TPU redesign
+keeps every shape XLA-static:
+
+- one fixed page pool per layer, ``[L, Hkv, P, ps, Dh]`` bf16;
+- int32 block tables ``[B, MP]`` (entry -1 = unallocated: scatters drop
+  via ``mode="drop"``, the attention kernel clamps and its length mask
+  zeroes the contribution);
+- decode attention is a Pallas kernel whose k/v blocks are selected by a
+  *scalar-prefetched* block table: the grid walks (slot, page) and the
+  BlockSpec index maps read ``table[slot, page]`` to pick the DMA source —
+  the pipeline hardware (not gather HLOs materializing a contiguous copy)
+  chases the pages, which is the TPU-native analog of PagedAttention's
+  pointer walk.
+
+Why paging at all: the contiguous engine cache (continuous.py) sizes every
+slot at ``max_len``, so short requests strand HBM in the slack of long
+slots.  Pages bound that waste to one page per sequence and let admission
+reason in pages (sum of ceil(len/ps)) instead of worst-case slots.
+
+The allocator (:class:`PagePool`) is host-side state like the engine's
+slot bookkeeping; everything under jit takes the table as a plain int32
+operand.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.decode import (
+    _chunk_positions,
+    _layer_kv,
+    _rmsnorm,
+    _split_heads,
+    _split_qkv,
+)
+from tpu_dra.workloads.quant import matmul_any
+from tpu_dra.workloads.train import ModelConfig, apply_rope, head_logits
+
+_LOG2E = 1.4426950408889634
+
+
+# --------------------------------------------------------------------------
+# Host-side page allocator
+# --------------------------------------------------------------------------
+
+
+class PagePool:
+    """Free-list page allocator: the host half of the paged cache.
+
+    Single-threaded by design — it lives inside the engine loop exactly
+    like slot bookkeeping does (continuous.py keeps all host state on the
+    batcher thread); callers needing cross-thread alloc wrap it in the
+    engine's existing condition variable.
+    """
+
+    def __init__(self, total_pages: int, page_size: int) -> None:
+        if total_pages < 1 or page_size < 1:
+            raise ValueError(f"need positive pool, got "
+                             f"{total_pages}x{page_size}")
+        self.total_pages = total_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(total_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def alloc(self, n_pages: int) -> list[int]:
+        """``n_pages`` page ids, or raise — callers gate admission on
+        :attr:`free_pages` first (the engine's admission control)."""
+        if n_pages <= 0:
+            # [-0:] would slice the WHOLE free list without removing
+            # anything — handing out every page while keeping them free
+            return []
+        if n_pages > len(self._free):
+            raise MemoryError(
+                f"page pool exhausted: want {n_pages}, free "
+                f"{len(self._free)}/{self.total_pages}")
+        taken = self._free[-n_pages:][::-1]
+        del self._free[len(self._free) - n_pages:]
+        return taken
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not 0 <= p < self.total_pages:
+                raise ValueError(f"bad page id {p}")
+        self._free.extend(reversed(pages))
+
+    def table_row(self, pages: list[int], max_pages: int):
+        """int32 ``[max_pages]`` row: allocated ids then -1 sentinels."""
+        import numpy as np
+        row = np.full((max_pages,), -1, np.int32)
+        row[:len(pages)] = pages
+        return row
+
+
+def init_paged_cache(cfg: ModelConfig, total_pages: int,
+                     page_size: int) -> dict[str, Any]:
+    """Page pool arrays ``[L, Hkv, P, ps, Dh]`` (bf16 — the serving
+    default; the int8 variant composes exactly like decode.py's and is
+    left to the contiguous engine until paging is its default)."""
+    shape = (cfg.n_layers, cfg.kv_heads, total_pages, page_size,
+             cfg.d_head)
+    return {"k": jnp.zeros(shape, jnp.bfloat16),
+            "v": jnp.zeros(shape, jnp.bfloat16)}
+
+
+# --------------------------------------------------------------------------
+# jit-side page writes
+# --------------------------------------------------------------------------
+
+
+def _sanitize(table, total_pages: int):
+    """-1 sentinels → ``total_pages`` (one past the end).  ``mode="drop"``
+    only drops indices ≥ n; a raw -1 would WRAP numpy-style and silently
+    clobber the pool's LAST page (verified against jax: ``.at[-1]`` with
+    drop mode writes row n-1)."""
+    return jnp.where(table < 0, total_pages, table)
+
+
+def scatter_prefill(cache: dict, ks, vs, table) -> dict:
+    """Write prefill KV ``[L, B, Hkv, S, Dh]`` (S a page multiple —
+    right-pad the prompt) into the pages of ``table [B, MP]``.  Sentinel
+    (-1) entries drop: a sequence shorter than S simply writes fewer
+    pages; pad slots inside its last page are dead weight masked by the
+    attention length."""
+    L, B, hkv, S, d = ks.shape
+    ps = cache["k"].shape[3]
+    assert S % ps == 0, (S, ps)
+    npg = S // ps
+    ids = _sanitize(table[:, :npg], cache["k"].shape[2])   # [B, npg]
+    kp = ks.reshape(L, B, hkv, npg, ps, d).transpose(0, 2, 1, 3, 4, 5)
+    vp = vs.reshape(L, B, hkv, npg, ps, d).transpose(0, 2, 1, 3, 4, 5)
+    return {
+        "k": cache["k"].at[:, :, ids].set(
+            kp.astype(cache["k"].dtype), mode="drop"),
+        "v": cache["v"].at[:, :, ids].set(
+            vp.astype(cache["v"].dtype), mode="drop"),
+    }
+
+
+def append_token(cache: dict, k_new, v_new, table, lengths) -> dict:
+    """Write one token's KV ``[L, B, Hkv, Dh]`` at position ``lengths``
+    (0-based next index) of every sequence: page ``lengths // ps`` via the
+    table, offset ``lengths % ps``."""
+    ps = cache["k"].shape[3]
+    pidx = lengths // ps                                   # [B]
+    off = lengths % ps
+    ids = _sanitize(
+        jnp.take_along_axis(table, pidx[:, None], axis=1)[:, 0],
+        cache["k"].shape[2])
+    kt = k_new.transpose(0, 2, 1, 3)                       # [L, Hkv, B, Dh]
+    vt = v_new.transpose(0, 2, 1, 3)
+    return {
+        "k": cache["k"].at[:, :, ids, off].set(
+            kt.astype(cache["k"].dtype), mode="drop"),
+        "v": cache["v"].at[:, :, ids, off].set(
+            vt.astype(cache["v"].dtype), mode="drop"),
+    }
+
+
+# --------------------------------------------------------------------------
+# Paged decode attention
+# --------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
+                       m_ref, l_ref, acc_ref, *, ps: int, n_pages: int,
+                       g: int, hkv: int):
+    """One (slot, page) grid step: online softmax over the slot's pages.
+
+    The k/v blocks arriving here were DMA'd from ``table[s, j]`` by the
+    index maps (scalar-prefetched table) — the kernel body only ever sees
+    resident pages.  Pages past the sequence length are skipped
+    compute-side (``base < length``); their DMA fetched the clamped page 0
+    — bandwidth the grid pays for tail pages, bounded by MP − used."""
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    neg = jnp.finfo(jnp.float32).min
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, neg)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[s]
+    base = j * ps
+
+    @pl.when(base < length)
+    def _compute():
+        from tpu_dra.workloads.pallas_kernels import _online_softmax_step
+        q = q_ref[0]                                       # [qh, d]
+        cols = base + jax.lax.broadcasted_iota(jnp.int32, (g, ps), 1)
+        mask = cols < length
+        for h in range(hkv):
+            rows = slice(h * g, (h + 1) * g)
+            m_new, l_new, acc_new = _online_softmax_step(
+                q[rows], k_ref[h, 0], v_ref[h, 0], mask,
+                m_ref[rows, :1], l_ref[rows, :1], acc_ref[rows])
+            acc_ref[rows] = acc_new
+            m_ref[rows] = jnp.broadcast_to(m_new, (g, 128))
+            l_ref[rows] = jnp.broadcast_to(l_new, (g, 128))
+
+    @pl.when(j == n_pages - 1)
+    def _flush():
+        l = l_ref[:, :1]
+        out_ref[0] = (acc_ref[:] /
+                      jnp.where(l == 0.0, 1.0, l)).astype(out_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(q, k_pages, v_pages, table, lengths, *,
+                    interpret: bool = False):
+    """Decode-step attention against a paged cache.
+
+    ``q`` [B, H, Dh] (one position per slot), ``k_pages``/``v_pages``
+    [Hkv, P, ps, Dh], ``table`` [B, MP] int32 (-1 pad), ``lengths`` [B]
+    valid context per slot (INCLUDING the just-appended token).  Returns
+    [B, H, Dh] bf16.  Slots with length 0 return zeros.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, qh, d = q.shape
+    hkv, P, ps, _ = k_pages.shape
+    MP = table.shape[1]
+    assert qh % hkv == 0, (qh, hkv)
+    g = qh // hkv
+    qs = (q * (d ** -0.5 * _LOG2E)).astype(q.dtype)
+    tab = jnp.maximum(table, 0).astype(jnp.int32)   # clamp -1 sentinels
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MP),
+        in_specs=[
+            pl.BlockSpec((1, qh, d), lambda s, j, tab, ln: (s, 0, 0)),
+            pl.BlockSpec((hkv, 1, ps, d),
+                         lambda s, j, tab, ln: (0, tab[s, j], 0, 0)),
+            pl.BlockSpec((hkv, 1, ps, d),
+                         lambda s, j, tab, ln: (0, tab[s, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, qh, d), lambda s, j, tab, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qh, 128), jnp.float32),
+            pltpu.VMEM((qh, 128), jnp.float32),
+            pltpu.VMEM((qh, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        partial(_paged_attn_kernel, ps=ps, n_pages=MP, g=g, hkv=hkv),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, qh, d), jnp.bfloat16),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tab, lengths.astype(jnp.int32), qs, k_pages, v_pages)
+
+
+def paged_attention_ref(q, k_pages, v_pages, table, lengths):
+    """XLA oracle: gather the table into a contiguous [B, MP·ps] view and
+    run masked attention.  Used by tests and as the CPU fallback — the
+    gather materializes the full per-slot context, which is exactly the
+    HBM copy the Pallas kernel exists to avoid."""
+    B, qh, d = q.shape
+    hkv, P, ps, _ = k_pages.shape
+    MP = table.shape[1]
+    g = qh // hkv
+    tab = jnp.maximum(table, 0)
+    k = k_pages[:, tab]                        # [Hkv, B, MP, ps, Dh]
+    v = v_pages[:, tab]
+    k = k.transpose(1, 0, 2, 3, 4).reshape(B, hkv, MP * ps, d)
+    v = v.transpose(1, 0, 2, 3, 4).reshape(B, hkv, MP * ps, d)
+    qg = q.reshape(B, hkv, g, d)
+    scores = jnp.einsum("bkgd,bksd->bkgs", qg, k).astype(jnp.float32)
+    scores = scores * (d ** -0.5)
+    col = jnp.arange(MP * ps)
+    valid = col[None, :] < lengths[:, None]                # [B, S]
+    scores = jnp.where(valid[:, None, None], scores,
+                       jnp.finfo(jnp.float32).min)
+    attn = jax.nn.softmax(scores, axis=-1)
+    # all-masked slots (length 0): uniform rows — zero them like the kernel
+    attn = jnp.where(valid[:, None, None], attn, 0.0).astype(q.dtype)
+    out = jnp.einsum("bkgs,bksd->bkgd", attn, v)
+    return out.reshape(B, qh, d).astype(jnp.bfloat16)
+
+
+# --------------------------------------------------------------------------
+# Paged greedy decoder (prefill → scan), mirroring decode.greedy_decode
+# --------------------------------------------------------------------------
+
+
+def _prefill_kv(cfg: ModelConfig, params, prompt):
+    """Training-trunk prefill pass returning the per-layer KV
+    ``[L, B, Hkv, S, Dh]`` and the last-position logits — the page writer
+    scatters the KV directly, so no contiguous cache is ever allocated
+    (same two-pass structure as decode._prefill_trunk)."""
+    from tpu_dra.workloads.train import _block
+
+    S = prompt.shape[1]
+    x = params["embed"].astype(jnp.bfloat16)[prompt]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[:S]
+
+    def block(carry, layer):
+        k, v = _layer_kv(cfg, layer, carry)
+        return _block(cfg, carry, layer), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(block, x, params["blocks"])
+    return ks, vs, x
+
+
+def _paged_step(cfg: ModelConfig, params, cache, token, lengths, table,
+                interpret: bool):
+    """One decode step: embed → per-layer (project, append to pages,
+    paged attention, mlp) → logits.  ``lengths`` is the context size
+    BEFORE this token; returns (cache', logits, lengths+1)."""
+    B = token.shape[0]
+    pos = lengths                                          # [B]
+    x = params["embed"].astype(jnp.bfloat16)[token][:, None]   # [B, 1, D]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[pos][:, None].reshape(
+            B, 1, -1)
+
+    attn = paged_attention_ref if interpret else partial(
+        paged_attention, interpret=False)
+
+    def block(carry, inputs):
+        x = carry
+        layer, kp, vp = inputs
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = matmul_any(h, layer["wqkv"], x.dtype)
+        q, k, v = _split_qkv(cfg, qkv)
+        q = _split_heads(cfg, q)                           # [B, H, 1, Dh]
+        k = _split_heads(cfg, k, cfg.kv_heads)
+        v = _split_heads(cfg, v, cfg.kv_heads)
+        if cfg.pos_emb == "rope":
+            positions = _chunk_positions(pos, 1)           # [B, 1]
+            q = apply_rope(q, positions, cfg.rope_base)
+            k = apply_rope(k, positions, cfg.rope_base)
+        lcache = append_token(
+            {"k": kp[None], "v": vp[None]},
+            k[:, :, 0][None], v[:, :, 0][None], table, pos)
+        out = attn(q[:, :, 0].astype(jnp.bfloat16), lcache["k"][0],
+                   lcache["v"][0], table, pos + 1)
+        out = out.reshape(B, 1, cfg.n_heads * cfg.d_head).astype(x.dtype)
+        x = x + matmul_any(out, layer["wo"], x.dtype)
+        h2 = _rmsnorm(x, layer["ln2"])
+        h2 = jax.nn.gelu(matmul_any(h2, layer["w1"], x.dtype))
+        x = x + matmul_any(h2, layer["w2"], x.dtype)
+        return x, (lcache["k"][0], lcache["v"][0])
+
+    x, (k_new, v_new) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = head_logits(params, x)[:, 0]
+    return {"k": k_new, "v": v_new}, logits, lengths + 1
+
+
+def paged_greedy_decode(cfg: ModelConfig, params, prompt, table, *,
+                        steps: int, total_pages: int, page_size: int,
+                        lengths=None, interpret: bool = False):
+    """Greedy decode ``steps`` tokens with all KV in pages.
+
+    ``prompt`` [B, S] right-padded to a page multiple; ``lengths`` [B]
+    true prompt lengths (default: full S); ``table`` [B, MP] page ids
+    from a :class:`PagePool` with capacity for ``lengths + steps``.
+    Returns [B, steps] int32 — bit-identical to ``decode.greedy_decode``
+    on the same params (the paged layout changes memory, not math).
+    """
+    B, S = prompt.shape
+    ps = page_size
+    pad = (-S) % ps
+    if pad:
+        prompt = jnp.pad(prompt, ((0, 0), (0, pad)))
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+    cache = init_paged_cache(cfg, total_pages, ps)
+    ks, vs, xs = _prefill_kv(cfg, params, prompt)
+    cache = scatter_prefill(cache, ks, vs, table)
+    # last REAL position's logits (padding never attends backward-only
+    # causality keeps real rows exact; ragged rows pick their own last)
+    last = head_logits(
+        params, jnp.take_along_axis(
+            xs, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1))
+    token0 = jnp.argmax(last[:, 0], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, token, lens = carry
+        cache, logits, lens = _paged_step(cfg, params, cache, token, lens,
+                                          table, interpret)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (cache, nxt, lens), token
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache, token0, lengths), None, length=steps)
+    return toks.T                                          # [B, steps]
+
+
+def make_paged_decoder(cfg: ModelConfig, *, steps: int, total_pages: int,
+                       page_size: int, interpret: bool = False):
+    """jit-compiled ``(params, prompt [B, S], table [B, MP]) -> [B, steps]``
+    greedy decoder over a paged cache (the page table is a plain operand:
+    one compilation serves any allocation pattern)."""
+    return jax.jit(partial(
+        paged_greedy_decode, cfg, steps=steps, total_pages=total_pages,
+        page_size=page_size, interpret=interpret),
+        static_argnames=())

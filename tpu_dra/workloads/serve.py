@@ -640,7 +640,9 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           cache_dtype: str = "bf16",
           continuous: bool = False, slots: int = 32,
           chunk: int = 4, draft: tuple | None = None,
-          speculative_engine: bool = False
+          speculative_engine: bool = False,
+          kv_layout: str = "slab", page_size: int = 64,
+          total_pages: int | None = None
           ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
@@ -671,7 +673,9 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
         engine = ContinuousEngine(
             cfg, params, slots=slots, chunk=chunk,
             cache_dtype=cache_dtype,
-            draft=draft if speculative_engine else None)
+            draft=draft if speculative_engine else None,
+            kv_layout=kv_layout, page_size=page_size,
+            total_pages=total_pages)
     metrics = ServeMetrics()
     srv = ThreadingHTTPServer((host, port),
                               make_handler(pool, engine, metrics))
@@ -746,6 +750,20 @@ def main(argv=None):
     ap.add_argument("--chunk", type=int, default=4,
                     help="continuous mode: tokens per dispatch (join "
                          "granularity)")
+    ap.add_argument("--kv-layout", default="slab",
+                    choices=("slab", "paged"),
+                    help="continuous mode KV memory: 'slab' preallocates "
+                         "max_len per slot; 'paged' allocates block-table "
+                         "pages per request (prompt+steps), so short "
+                         "requests stop stranding HBM in long slots' "
+                         "slack (workloads/paged_kv.py)")
+    ap.add_argument("--page-size", type=int, default=64,
+                    help="paged mode: tokens per KV page")
+    ap.add_argument("--total-pages", type=int, default=None,
+                    help="paged mode: pool capacity (default "
+                         "slots*ceil(max_len/page_size) — slab parity; "
+                         "set lower to oversubscribe slots against real "
+                         "usage)")
     ap.add_argument("--speculative-continuous", action="store_true",
                     help="with --continuous and --draft-checkpoint-dir: "
                          "the engine itself drafts+verifies each chunk "
@@ -835,7 +853,9 @@ def main(argv=None):
     srv = serve(cfg, params, host=args.host, port=args.port,
                 cache_dtype=args.cache_dtype, continuous=args.continuous,
                 slots=args.slots, chunk=args.chunk, draft=draft,
-                speculative_engine=args.speculative_continuous)
+                speculative_engine=args.speculative_continuous,
+                kv_layout=args.kv_layout, page_size=args.page_size,
+                total_pages=args.total_pages)
     print(f"serving on {srv.server_address}", flush=True)
     try:
         threading.Event().wait()
