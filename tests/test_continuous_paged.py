@@ -131,10 +131,14 @@ def test_admission_blocks_on_page_exhaustion_not_reorders():
         assert a.done.wait(180) and not a.error
         assert b.done.wait(180) and not b.error
         assert c.done.wait(180) and not c.error
-        # FIFO: c finished AFTER b started (no overtake) — b's first
-        # token timestamp precedes c's completion
         assert len(a.tokens) == 14 and len(b.tokens) == 14
         assert len(c.tokens) == 2
+        # FIFO no-overtake: c (1 page) COULD have been admitted while a
+        # held 2 of the 3 pages, but b (2 pages) is ahead of it in the
+        # queue and must gate admission — so c can only run after a
+        # retires and frees pages.  If c had jumped the queue it would
+        # finish its 2 steps long before a's 14.
+        assert c.finished > a.finished
         st = eng.stats()
         assert st["kv_pages_free"] == 3
     finally:

@@ -268,15 +268,9 @@ class ContinuousEngine:
         contract in tests/test_continuous_paged.py).  Per-slot key
         streams: split each slot's key, draw with its own subkey — a
         slot's samples never depend on its neighbors."""
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         split = jax.vmap(jax.random.split)(keys)         # [slots, 2, 2]
         keys, draw = split[:, 0], split[:, 1]
-        filt = _filter_topk_topp(
-            logits / jnp.maximum(temp, 1e-6)[:, None],
-            self.top_k, self.top_p)
-        sampled = jax.vmap(
-            lambda k, lg: jax.random.categorical(k, lg))(draw, filt)
-        nxt = jnp.where(temp > 0, sampled.astype(jnp.int32), greedy)
+        nxt = self._first_token(logits, temp, draw)
         nxt = jnp.where(done, token, nxt)           # frozen slots hold
         done2 = done | (nxt == eos)
         pos = pos + jnp.where(done, 0, 1)
@@ -768,25 +762,20 @@ class ContinuousEngine:
                 self.params, self.draft[1], self._cache, self._dcache,
                 prompts, lengths, slots)
             self._cache, self._dcache = cache, dcache
-        elif self.kv_layout == "paged":
-            temps = jnp.asarray([req.temperature for _, req in group],
-                                jnp.float32)
-            keys0 = jnp.stack([jax.random.fold_in(kk, 0)
-                               for kk in base_keys])
-            rows = self._table[slots]                      # [k, MP]
-            cache, first = self._paged_prefill_fn(Sb)(
-                self.params, self._cache, prompts, lengths, temps,
-                keys0, rows)
-            self._cache = cache
         else:
             temps = jnp.asarray([req.temperature for _, req in group],
                                 jnp.float32)
             keys0 = jnp.stack([jax.random.fold_in(kk, 0)
                                for kk in base_keys])
-            cache, first = self._prefill_fn(Sb)(
-                self.params, self._cache, prompts, lengths, slots, temps,
-                keys0)
-            self._cache = cache
+            if self.kv_layout == "paged":
+                rows = self._table[slots]                  # [k, MP]
+                self._cache, first = self._paged_prefill_fn(Sb)(
+                    self.params, self._cache, prompts, lengths, temps,
+                    keys0, rows)
+            else:
+                self._cache, first = self._prefill_fn(Sb)(
+                    self.params, self._cache, prompts, lengths, slots,
+                    temps, keys0)
         firsts = [int(t) for t in first.tolist()]   # ONE device readback
         for (slot, req), key, first_host in zip(group, base_keys, firsts):
             self._finish_admission(slot, req, first_host,

@@ -69,6 +69,7 @@ class PagePool:
         self.total_pages = total_pages
         self.page_size = page_size
         self._free: list[int] = list(range(total_pages - 1, -1, -1))
+        self._free_set: set[int] = set(self._free)
 
     @property
     def free_pages(self) -> int:
@@ -90,13 +91,19 @@ class PagePool:
                 f"{len(self._free)}/{self.total_pages}")
         taken = self._free[-n_pages:][::-1]
         del self._free[len(self._free) - n_pages:]
+        self._free_set.difference_update(taken)
         return taken
 
     def free(self, pages: list[int]) -> None:
         for p in pages:
             if not 0 <= p < self.total_pages:
                 raise ValueError(f"bad page id {p}")
+            if p in self._free_set:
+                # a double-free would alias one physical page to two
+                # future requests — silent cross-request KV corruption
+                raise ValueError(f"double free of page {p}")
         self._free.extend(reversed(pages))
+        self._free_set.update(pages)
 
     def table_row(self, pages: list[int], max_pages: int):
         """int32 ``[max_pages]`` row: allocated ids then -1 sentinels."""

@@ -661,6 +661,10 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
     drafts multiply continuous-batching throughput while tokens stay
     exactly greedy.  /generate then rejects sampled requests (the
     engine's greedy-only contract)."""
+    if kv_layout != "slab" and not continuous:
+        raise ValueError("--kv-layout paged requires --continuous (the "
+                         "bucketed pool has no paged mode); without it "
+                         "the flag would be silently ignored")
     pool = DecoderPool(cfg, params, cache_dtype=cache_dtype)
     if draft is not None:
         pool.set_draft(*draft)        # (draft_cfg, draft_params)
