@@ -95,6 +95,14 @@ class _Prefix:
     kv: dict
     length: int
     bucket: int
+    # paged engines: the prefix's FULL pages (length // page_size worth),
+    # shared zero-copy into every joiner's block table.  Content is
+    # scattered lazily by the batcher thread at first join (the register
+    # thread must never mutate the engine cache).  None = no full pages
+    # (short prefix, or pool was exhausted at registration) — joins then
+    # carry the whole prefix in their own pages.
+    pages: Optional[list[int]] = None
+    pages_written: bool = False
 
 
 class ContinuousEngine:
@@ -199,6 +207,12 @@ class ContinuousEngine:
             self._cache = init_paged_cache(cfg, cap, ps)
             self._table = jnp.full((slots, self._mp), -1, jnp.int32)
             self._page_ids: list[Optional[list[int]]] = [None] * slots
+            # zero-copy prefix pages referenced by each slot's table
+            self._shared_ids: list[list[int]] = [[] for _ in range(slots)]
+            # the pool is mutated from the batcher (admit/retire) AND the
+            # caller thread (register_prefix allocation/eviction)
+            self._pool_mu = threading.Lock()
+            self._paged_join_fns: dict[tuple, Any] = {}
         else:
             self._cache = init_kv_cache(cfg, slots, self.max_len,
                                         cache_dtype)
@@ -461,8 +475,12 @@ class ContinuousEngine:
         rows carry garbage that stays masked until the suffix/decode
         overwrites past them (module invariant)."""
         Pb = prompt.shape[1]
+        # shapes from CFG, not from self._cache: the paged pool's axes
+        # are [L, Hkv, P, ps, Dh] — a slab-assuming buf.shape[2] would
+        # silently size the head axis at the page count
         small = {name: jnp.zeros(
-            (buf.shape[0], 1, buf.shape[2], Pb, buf.shape[4]), buf.dtype)
+            (cfg.n_layers, 1, cfg.kv_heads, Pb,
+             1 if name.endswith("_s") else cfg.d_head), buf.dtype)
             for name, buf in self._cache.items()}
         small, _ = _prefill_trunk(cfg, params, small, prompt)
         return small
@@ -512,15 +530,69 @@ class ContinuousEngine:
             self._join_fns[key] = fn
         return fn
 
+    def _paged_join_impl(self, cfg, start_page, params, cache, pkv,
+                         suffix, slen, plen, row, temp, key):
+        """Paged prefix join: the suffix runs through the SAME contiguous
+        scratch math as the slab join (prefix KV + chunked suffix at
+        positions [plen, plen+Sb)), then only the columns the joiner owns
+        — the prefix tail partial page plus the suffix — scatter into its
+        block-table pages.  Columns [0, start_page·ps) are the prefix's
+        FULL pages: physically shared, never rewritten (zero-copy — the
+        slab engine pays an O(prefix) cache copy per join here).
+
+        ``row`` is the slot's full table row; rows past the join's write
+        window are -1 sentinels and drop (bucket padding can exceed the
+        own-page allocation)."""
+        from tpu_dra.workloads.paged_kv import scatter_prefill
+        Pb, Sb = pkv["k"].shape[3], suffix.shape[1]
+        width = min(Pb + Sb, self.max_len)
+        # scratch shapes from CFG (the paged pool's own axes are
+        # [L, Hkv, P, ps, Dh], not slab [L, slots, Hkv, S, Dh])
+        small = {name: jnp.zeros(
+            (cfg.n_layers, 1, cfg.kv_heads, width, cfg.d_head),
+            buf.dtype) for name, buf in cache.items()}
+        small = {name: jax.lax.dynamic_update_slice(
+            small[name], pkv[name].astype(small[name].dtype),
+            (0, 0, 0, 0, 0)) for name in small}
+        x, small = _chunk_hidden(cfg, params, small,
+                                 jnp.reshape(plen, (1,)), suffix)
+        last = x[jnp.arange(1), slen - 1][:, None, :]
+        logits = head_logits(params, last)[:, 0]        # [1, vocab]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = _select_token(logits / jnp.maximum(temp, 1e-6),
+                                key, 1.0, self.top_k, self.top_p)
+        first = jnp.where(temp > 0, sampled, greedy)[0]
+        ps = cache["k"].shape[3]
+        start_col = start_page * ps
+        n_write = -(-(width - start_col) // ps)
+        pad = start_col + n_write * ps - width
+        cols = {name: small[name][:, :, :, start_col:width]
+                for name in small}
+        if pad:
+            cols = {name: jnp.pad(
+                cols[name], ((0, 0),) * 3 + ((0, pad), (0, 0)))
+                for name in cols}
+        rows_write = row[None, start_page:start_page + n_write]
+        cache = scatter_prefill(cache, cols["k"], cols["v"], rows_write)
+        return cache, first
+
+    def _paged_join_fn(self, suffix_bucket: int, prefix_bucket: int,
+                       start_page: int):
+        key = (suffix_bucket, prefix_bucket, start_page)
+        fn = self._paged_join_fns.get(key)
+        if fn is None:
+            fn = jax.jit(partial(self._paged_join_impl, self.cfg,
+                                 start_page),
+                         donate_argnums=(1,))           # the page pool
+            self._paged_join_fns[key] = fn
+        return fn
+
     def register_prefix(self, tokens: list[int]) -> str:
         """Register a shared prompt prefix (e.g. a system prompt);
         returns its content-addressed id for ``submit(prefix_id=...)``.
         The prefix KV is computed once and copied into a slot at every
         join — requests pay prefill only for their suffix.  LRU-bounded
         at ``max_prefixes``; re-registering is idempotent."""
-        if self.kv_layout == "paged":
-            raise ValueError("paged engine does not support prefix joins "
-                             "yet (prefix KV lives in slab rows)")
         import hashlib
 
         cfg = self.cfg
@@ -547,13 +619,41 @@ class ContinuousEngine:
             self._prefix_fns[Pb] = fn
         kv = fn(self.params, prompt)
         jax.block_until_ready(kv["k"])
+        pages = None
+        if self.kv_layout == "paged":
+            # reserve the prefix's FULL pages for zero-copy sharing; a
+            # short prefix (< one page) or an exhausted pool degrades to
+            # pages=None — joins then pay their own pages, still correct
+            full = len(tokens) // self.pool.page_size
+            if full:
+                with self._pool_mu:
+                    if full <= self.pool.free_pages:
+                        pages = self.pool.alloc(full)
         with self._cv:
+            if pid in self._prefixes:
+                # concurrent registration of the same tokens: the other
+                # thread won between the early idempotency check and
+                # here — release our allocation instead of leaking it
+                if pages:
+                    with self._pool_mu:
+                        self.pool.free(pages)
+                self._prefixes[pid] = self._prefixes.pop(pid)
+                return pid
             while len(self._prefixes) >= self.max_prefixes:
-                evicted = next(iter(self._prefixes))
-                del self._prefixes[evicted]       # LRU: oldest first
+                evicted = self._prefixes.pop(
+                    next(iter(self._prefixes)))   # LRU: oldest first
+                self._evict_prefix_pages(evicted)
             self._prefixes[pid] = _Prefix(list(tokens), kv, len(tokens),
-                                          Pb)
+                                          Pb, pages=pages)
         return pid
+
+    def _evict_prefix_pages(self, pref: "_Prefix") -> None:
+        """Release the registry's reference on an evicted prefix's pages
+        (active joiners keep them live via their own refs)."""
+        if pref.pages:
+            with self._pool_mu:
+                self.pool.free(pref.pages)
+            pref.pages = None
 
     # -- public API ---------------------------------------------------------
 
@@ -602,10 +702,8 @@ class ContinuousEngine:
                 raise ValueError("speculative engine does not support "
                                  "prefix joins")
         if self.kv_layout == "paged":
-            if prefix_id is not None:
-                raise ValueError("paged engine does not support prefix "
-                                 "joins yet (prefix KV lives in slab rows)")
-            need = self.pool.pages_for(len(prompt) + steps)
+            _, need = self._paged_requirements(len(prompt), steps,
+                                               prefix_id)
             if need > self.pool.total_pages:
                 # an unservable request must fail HERE: the FIFO admission
                 # gate would otherwise wait on it forever and starve
@@ -713,17 +811,51 @@ class ContinuousEngine:
                 continue
             if self.kv_layout == "paged":
                 # FIFO-preserving page gate: if the HEAD request cannot
-                # get its worst-case pages (prompt + steps), stop
-                # admitting — later smaller requests must not starve it
+                # get its worst-case pages (prompt + steps, minus any
+                # zero-copy prefix pages it shares), stop admitting —
+                # later smaller requests must not starve it
                 req = self._pending[0]
-                need = self.pool.pages_for(len(req.prompt) + req.steps)
-                if need > self.pool.free_pages:
+                shared, need = self._paged_requirements(
+                    len(req.prompt), req.steps, req.prefix_id,
+                    take_refs=True)
+                # pages held resident by OTHER prefixes can never free
+                # without an eviction; a head request whose own-page need
+                # exceeds what could ever be free must fail now, not
+                # starve the queue waiting for it (submit's total_pages
+                # precheck cannot see future registrations)
+                ceiling = (self.pool.total_pages
+                           - self._resident_prefix_pages() + len(shared))
+                if need > ceiling:
+                    with self._pool_mu:
+                        if shared:
+                            self.pool.free(shared)
+                    bad = self._pending.popleft()
+                    bad.error = (
+                        f"request needs {need} own KV pages but resident "
+                        f"prefixes leave at most {ceiling} allocatable; "
+                        f"evict prefixes or shrink the request")
+                    bad.done.set()
+                    continue
+                admitted = False
+                with self._pool_mu:
+                    if need <= self.pool.free_pages:
+                        own = self.pool.alloc(need)
+                        admitted = True
+                    elif shared:
+                        self.pool.free(shared)      # release gate refs
+                if not admitted:
                     break
-                ids = self.pool.alloc(need)
-                self._page_ids[slot] = ids
-                self._table = self._table.at[slot].set(
-                    jnp.asarray(self.pool.table_row(ids, self._mp)))
-            assigned.append((slot, self._pending.popleft()))
+                self._page_ids[slot] = own
+                self._shared_ids[slot] = list(shared)
+                self._table = self._table.at[slot].set(jnp.asarray(
+                    self.pool.table_row(shared + own, self._mp)))
+            req = self._pending.popleft()
+            # provisional attachment: if admission itself raises, the
+            # request is visible to _fail_all instead of orphaned with
+            # its done event never set (observed: a join trace error
+            # killed the batcher and the submitter hung to timeout)
+            self._requests[slot] = req
+            assigned.append((slot, req))
         plain: dict[int, list[tuple[int, _Request]]] = {}
         for slot, req in assigned:
             if req.prefix_id is not None:
@@ -741,6 +873,48 @@ class ContinuousEngine:
                 take = 1 << (len(group).bit_length() - 1)
                 self._admit_plain(Sb, group[:take])
                 group = group[take:]
+
+    def _paged_requirements(self, prompt_len: int, steps: int,
+                            prefix_id, *, take_refs: bool = False):
+        """(shared prefix pages, own pages needed) for one admission.
+
+        ``take_refs=True`` (the admission gate) acquires the references
+        ATOMICALLY with reading ``pref.pages`` — both under ``_cv``, with
+        ``_pool_mu`` nested inside (the one allowed nesting order) — so a
+        concurrent eviction can neither free the pages out from under the
+        ref nor hand them to another request first.  Callers that take
+        refs own releasing them (``pool.free``) on every non-admission
+        path."""
+        shared: list[int] = []
+        plen = 0
+        with self._cv:
+            if prefix_id is not None:
+                pref = self._prefixes.get(prefix_id)
+                if pref is not None:
+                    plen = pref.length
+                    shared = list(pref.pages or ())
+            if take_refs and shared:
+                with self._pool_mu:
+                    self.pool.ref(shared)
+        need = self.pool.pages_for(plen + prompt_len + steps) - len(shared)
+        return shared, need
+
+    def _resident_prefix_pages(self) -> int:
+        """Pages the prefix registry keeps resident (under ``_cv``)."""
+        with self._cv:
+            return sum(len(p.pages or ()) for p in self._prefixes.values())
+
+    def _release_slot_pages(self, slot: int) -> None:
+        """Sentinel the slot's table row, then release its page refs
+        (own at refcount 1 → freed; shared prefix pages → one ref)."""
+        self._table = self._table.at[slot].set(-1)
+        with self._pool_mu:
+            if self._page_ids[slot]:
+                self.pool.free(self._page_ids[slot])
+            if self._shared_ids[slot]:
+                self.pool.free(self._shared_ids[slot])
+        self._page_ids[slot] = None
+        self._shared_ids[slot] = []
 
     def _admit_plain(self, Sb: int,
                      group: list[tuple[int, "_Request"]]) -> None:
@@ -784,11 +958,25 @@ class ContinuousEngine:
     def _admit_prefix(self, slot: int, req: "_Request") -> None:
         """Shared-prefix join: copy the prefix KV, prefill only the
         suffix at positions [plen, plen+Sb)."""
+        write_pages: Optional[list[int]] = None
         with self._cv:
             pref = self._prefixes.get(req.prefix_id)
+            if pref is not None and self.kv_layout == "paged":
+                # snapshot + claim the one-time content write while the
+                # registry entry is pinned by _cv: a concurrent eviction
+                # after this block can null pref.pages, but our copy (and
+                # the slot's refs from the admission gate) keep the ids
+                # valid, and pages_written flips exactly once
+                if pref.pages and not pref.pages_written:
+                    pref.pages_written = True
+                    write_pages = list(pref.pages)
         if pref is None:
+            if self.kv_layout == "paged":
+                # roll back the admission gate's allocation for this slot
+                self._release_slot_pages(slot)
             # prefix evicted between submit and admission: fail the
             # request instead of silently decoding without context
+            self._requests[slot] = None     # undo provisional attachment
             req.error = (f"prefix {req.prefix_id!r} evicted before "
                          f"admission; re-register and resubmit")
             req.done.set()
@@ -797,12 +985,34 @@ class ContinuousEngine:
         prompt = jnp.asarray(
             [req.prompt + [0] * (Sb - len(req.prompt))], jnp.int32)
         key = jax.random.PRNGKey(req.seed)
-        cache, first = self._join_fn(Sb, pref.bucket)(
-            self.params, self._cache, pref.kv, prompt,
-            jnp.asarray([len(req.prompt)], jnp.int32),
-            jnp.int32(pref.length), jnp.int32(slot),
-            jnp.float32(req.temperature),
-            jax.random.fold_in(key, 0))
+        if self.kv_layout == "paged":
+            from tpu_dra.workloads.paged_kv import scatter_prefill
+            ps = self.pool.page_size
+            if write_pages is not None:
+                # first join writes the shared pages' CONTENT once, on
+                # the batcher thread (the register thread never touches
+                # the engine cache)
+                full_cols = len(write_pages) * ps
+                self._cache = scatter_prefill(
+                    self._cache,
+                    pref.kv["k"][:, :, :, :full_cols],
+                    pref.kv["v"][:, :, :, :full_cols],
+                    jnp.asarray([write_pages], jnp.int32))
+            start_page = len(self._shared_ids[slot])
+            cache, first = self._paged_join_fn(Sb, pref.bucket,
+                                               start_page)(
+                self.params, self._cache, pref.kv, prompt,
+                jnp.asarray([len(req.prompt)], jnp.int32),
+                jnp.int32(pref.length), self._table[slot],
+                jnp.float32(req.temperature),
+                jax.random.fold_in(key, 0))
+        else:
+            cache, first = self._join_fn(Sb, pref.bucket)(
+                self.params, self._cache, pref.kv, prompt,
+                jnp.asarray([len(req.prompt)], jnp.int32),
+                jnp.int32(pref.length), jnp.int32(slot),
+                jnp.float32(req.temperature),
+                jax.random.fold_in(key, 0))
         self._cache = cache
         self._finish_admission(slot, req, int(first),
                                pref.length + len(req.prompt), key)
@@ -830,9 +1040,7 @@ class ContinuousEngine:
         if self.kv_layout == "paged" and self._page_ids[slot] is not None:
             # all-(-1) row first: in-flight chunk appends for this slot
             # must drop BEFORE its pages go back to the pool
-            self._table = self._table.at[slot].set(-1)
-            self.pool.free(self._page_ids[slot])
-            self._page_ids[slot] = None
+            self._release_slot_pages(slot)
         req.finished = time.perf_counter()
         self.completed += 1
         self.tokens_out += len(req.tokens)

@@ -70,6 +70,12 @@ class PagePool:
         self.page_size = page_size
         self._free: list[int] = list(range(total_pages - 1, -1, -1))
         self._free_set: set[int] = set(self._free)
+        # live refcounts (zero-copy page sharing): alloc() hands out
+        # pages at refcount 1; ref() adds readers; free() releases one
+        # reference and only returns the page at zero.  Shared-prefix
+        # pages stay resident while any joiner's block table points at
+        # them — eviction from the prefix registry is just one release.
+        self._refs: dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -92,18 +98,36 @@ class PagePool:
         taken = self._free[-n_pages:][::-1]
         del self._free[len(self._free) - n_pages:]
         self._free_set.difference_update(taken)
+        for p in taken:
+            self._refs[p] = 1
         return taken
 
+    def ref(self, pages: list[int]) -> None:
+        """Add a reference to live pages (zero-copy sharing)."""
+        for p in pages:
+            if p in self._free_set or p not in self._refs:
+                raise ValueError(f"cannot ref non-live page {p}")
+        for p in pages:
+            self._refs[p] += 1
+
     def free(self, pages: list[int]) -> None:
+        """Release one reference per page; pages return to the free list
+        at refcount zero."""
         for p in pages:
             if not 0 <= p < self.total_pages:
                 raise ValueError(f"bad page id {p}")
-            if p in self._free_set:
+            if p in self._free_set or p not in self._refs:
                 # a double-free would alias one physical page to two
                 # future requests — silent cross-request KV corruption
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(reversed(pages))
-        self._free_set.update(pages)
+        released = []
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                released.append(p)
+        self._free.extend(reversed(released))
+        self._free_set.update(released)
 
     def table_row(self, pages: list[int], max_pages: int):
         """int32 ``[max_pages]`` row: allocated ids then -1 sentinels."""
