@@ -43,9 +43,6 @@ def test_rejects_incompatible_modes():
     with pytest.raises(ValueError, match="speculative"):
         ContinuousEngine(CFG, PARAMS, kv_layout="paged",
                          draft=(CFG, PARAMS), chunk=2)
-    with pytest.raises(ValueError, match="bf16"):
-        ContinuousEngine(CFG, PARAMS, kv_layout="paged",
-                         cache_dtype="int8")
     with pytest.raises(ValueError, match="kv_layout"):
         ContinuousEngine(CFG, PARAMS, kv_layout="pagedd")
     eng = paged_engine()
@@ -325,3 +322,47 @@ def test_resident_prefix_pages_fail_oversized_request_fast():
         assert len(eng.submit([1, 2], 3, timeout=300)) == 3
     finally:
         eng.shutdown()
+
+
+# -------------------------------------------------------------------------
+# int8 paged pages
+# -------------------------------------------------------------------------
+
+
+def test_paged_int8_engine_matches_slab_int8():
+    """int8 pages: same quantize-at-write + scale-folding math as the
+    slab int8 cache — tokens must match exactly (CPU oracle path)."""
+    reqs = [([3, 5, 7], 6), ([2, 4], 8), ([9] * 10, 4)]
+    slab = ContinuousEngine(CFG, PARAMS, slots=3, chunk=2, max_len=40,
+                            cache_dtype="int8")
+    try:
+        want = [slab.submit(p, s, timeout=300) for p, s in reqs]
+    finally:
+        slab.shutdown()
+    eng = paged_engine(slots=3, cache_dtype="int8")
+    try:
+        got = [eng.submit(p, s, timeout=300) for p, s in reqs]
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"]
+    finally:
+        eng.shutdown()
+    assert got == want
+
+
+def test_paged_int8_prefix_join_matches_slab_int8():
+    prefix = list(range(11, 27))                        # 2 pages of 8
+    slab = ContinuousEngine(CFG, PARAMS, slots=2, chunk=2, max_len=40,
+                            cache_dtype="int8")
+    try:
+        pid = slab.register_prefix(prefix)
+        want = slab.submit([1, 2], 5, prefix_id=pid, timeout=300)
+    finally:
+        slab.shutdown()
+    eng = paged_engine(slots=2, cache_dtype="int8")
+    try:
+        pid = eng.register_prefix(prefix)
+        assert eng._prefixes[pid].pages is not None
+        got = eng.submit([1, 2], 5, prefix_id=pid, timeout=300)
+    finally:
+        eng.shutdown()
+    assert got == want

@@ -273,3 +273,49 @@ def test_make_paged_decoder_jits_once_for_any_table():
                                for _ in range(2)]))
     b = dec(params, prompt, t2)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -------------------------------------------------------------------------
+# int8 pages
+# -------------------------------------------------------------------------
+
+
+def test_paged_attention_int8_interpret_matches_oracle():
+    import jax.numpy as jnp
+    from tpu_dra.workloads.quant import quantize_kv
+    q, kp, vp, tab, lengths = rand_paged_case(jax.random.PRNGKey(9))
+    kq, ks = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    got = paged_attention(q, kq, vq, tab, lengths, ks, vs,
+                          interpret=True)
+    want = paged_attention_ref(q, kq, vq, tab, lengths, ks, vs)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=0.08, atol=0.08)
+
+
+def test_paged_int8_decode_matches_contiguous_int8():
+    """int8 paged greedy == decode.greedy_decode with an int8 slab cache
+    (identical per-position quantization and scale folding)."""
+    cfg = CFG
+    params = params_for(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 6), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    steps = 5
+    want = greedy_decode(cfg, params, prompt, steps=steps,
+                         max_len=prompt.shape[1] + steps,
+                         cache_dtype="int8")
+    pool = PagePool(total_pages=16, page_size=4)
+    B = prompt.shape[0]
+    need = pool.pages_for(prompt.shape[1] + steps)
+    rows = [pool.table_row(pool.alloc(need), need) for _ in range(B)]
+    table = jnp.asarray(np.stack(rows))
+    got = paged_kv.paged_greedy_decode(
+        cfg, params, prompt, table, steps=steps, total_pages=16,
+        page_size=4, cache_dtype="int8", interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_init_paged_cache_rejects_unknown_dtype():
+    with pytest.raises(ValueError, match="bf16 or int8"):
+        init_paged_cache(CFG, 4, 8, cache_dtype="int4")

@@ -155,9 +155,7 @@ class ContinuousEngine:
             if draft is not None:
                 raise ValueError("paged engine does not support "
                                  "speculative drafts yet (two page pools)")
-            if cache_dtype != "bf16":
-                raise ValueError("paged engine is bf16-only "
-                                 "(int8 paging composes later)")
+
         self.kv_layout = kv_layout
         self.cfg = cfg
         self.params = params
@@ -204,7 +202,7 @@ class ContinuousEngine:
             self.pool = PagePool(cap, ps)
             # CPU runs use the gather oracle; TPU runs the Pallas kernel
             self._interpret = jax.devices()[0].platform != "tpu"
-            self._cache = init_paged_cache(cfg, cap, ps)
+            self._cache = init_paged_cache(cfg, cap, ps, cache_dtype)
             self._table = jnp.full((slots, self._mp), -1, jnp.int32)
             self._page_ids: list[Optional[list[int]]] = [None] * slots
             # zero-copy prefix pages referenced by each slot's table
@@ -543,13 +541,13 @@ class ContinuousEngine:
         ``row`` is the slot's full table row; rows past the join's write
         window are -1 sentinels and drop (bucket padding can exceed the
         own-page allocation)."""
-        from tpu_dra.workloads.paged_kv import scatter_prefill
         Pb, Sb = pkv["k"].shape[3], suffix.shape[1]
         width = min(Pb + Sb, self.max_len)
         # scratch shapes from CFG (the paged pool's own axes are
         # [L, Hkv, P, ps, Dh], not slab [L, slots, Hkv, S, Dh])
         small = {name: jnp.zeros(
-            (cfg.n_layers, 1, cfg.kv_heads, width, cfg.d_head),
+            (cfg.n_layers, 1, cfg.kv_heads, width,
+             1 if name.endswith("_s") else cfg.d_head),
             buf.dtype) for name, buf in cache.items()}
         small = {name: jax.lax.dynamic_update_slice(
             small[name], pkv[name].astype(small[name].dtype),
@@ -573,7 +571,8 @@ class ContinuousEngine:
                 cols[name], ((0, 0),) * 3 + ((0, pad), (0, 0)))
                 for name in cols}
         rows_write = row[None, start_page:start_page + n_write]
-        cache = scatter_prefill(cache, cols["k"], cols["v"], rows_write)
+        from tpu_dra.workloads.paged_kv import scatter_pages_raw
+        cache = scatter_pages_raw(cache, cols, rows_write)
         return cache, first
 
     def _paged_join_fn(self, suffix_bucket: int, prefix_bucket: int,
@@ -986,17 +985,18 @@ class ContinuousEngine:
             [req.prompt + [0] * (Sb - len(req.prompt))], jnp.int32)
         key = jax.random.PRNGKey(req.seed)
         if self.kv_layout == "paged":
-            from tpu_dra.workloads.paged_kv import scatter_prefill
             ps = self.pool.page_size
             if write_pages is not None:
                 # first join writes the shared pages' CONTENT once, on
                 # the batcher thread (the register thread never touches
-                # the engine cache)
+                # the engine cache).  pref.kv is already cache-dtyped
+                # (int8 engines registered it quantized), so raw scatter
                 full_cols = len(write_pages) * ps
-                self._cache = scatter_prefill(
+                from tpu_dra.workloads.paged_kv import scatter_pages_raw
+                self._cache = scatter_pages_raw(
                     self._cache,
-                    pref.kv["k"][:, :, :, :full_cols],
-                    pref.kv["v"][:, :, :, :full_cols],
+                    {name: buf[:, :, :, :full_cols]
+                     for name, buf in pref.kv.items()},
                     jnp.asarray([write_pages], jnp.int32))
             start_page = len(self._shared_ids[slot])
             cache, first = self._paged_join_fn(Sb, pref.bucket,

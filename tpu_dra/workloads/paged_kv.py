@@ -138,12 +138,28 @@ class PagePool:
 
 
 def init_paged_cache(cfg: ModelConfig, total_pages: int,
-                     page_size: int) -> dict[str, Any]:
-    """Page pool arrays ``[L, Hkv, P, ps, Dh]`` (bf16 — the serving
-    default; the int8 variant composes exactly like decode.py's and is
-    left to the contiguous engine until paging is its default)."""
+                     page_size: int,
+                     cache_dtype: str = "bf16") -> dict[str, Any]:
+    """Page pool arrays ``[L, Hkv, P, ps, Dh]``.
+
+    ``cache_dtype="int8"`` stores pages as int8 with per-(position, head)
+    fp32 scales (``k_s``/``v_s`` [L, Hkv, P, ps, 1] — same granularity as
+    the slab cache, decode.init_kv_cache): the page HBM read halves, so
+    the same pool bytes hold twice the context.  Quantization happens at
+    write time inside scatter_prefill/append_token; the attention paths
+    fold the scales into scores/probs, so no dequantized page ever lands
+    in HBM."""
     shape = (cfg.n_layers, cfg.kv_heads, total_pages, page_size,
              cfg.d_head)
+    if cache_dtype == "int8":
+        s_shape = shape[:-1] + (1,)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros(s_shape, jnp.float32),
+                "v_s": jnp.zeros(s_shape, jnp.float32)}
+    if cache_dtype != "bf16":
+        raise ValueError(f"cache_dtype must be bf16 or int8, got "
+                         f"{cache_dtype!r}")
     return {"k": jnp.zeros(shape, jnp.bfloat16),
             "v": jnp.zeros(shape, jnp.bfloat16)}
 
@@ -161,45 +177,62 @@ def _sanitize(table, total_pages: int):
     return jnp.where(table < 0, total_pages, table)
 
 
-def scatter_prefill(cache: dict, ks, vs, table) -> dict:
-    """Write prefill KV ``[L, B, Hkv, S, Dh]`` (S a page multiple —
-    right-pad the prompt) into the pages of ``table [B, MP]``.  Sentinel
-    (-1) entries drop: a sequence shorter than S simply writes fewer
-    pages; pad slots inside its last page are dead weight masked by the
-    attention length."""
-    L, B, hkv, S, d = ks.shape
+def scatter_pages_raw(cache: dict, cols: dict, table) -> dict:
+    """Write already-cache-dtyped columns (``cols[name]`` [L, B, Hkv, S,
+    last], S a page multiple, keys matching ``cache``) into the pages of
+    ``table [B, MP]``.  Sentinel (-1) entries drop: a sequence shorter
+    than S simply writes fewer pages."""
+    S = cols["k"].shape[3]
     ps = cache["k"].shape[3]
     assert S % ps == 0, (S, ps)
     npg = S // ps
     ids = _sanitize(table[:, :npg], cache["k"].shape[2])   # [B, npg]
-    kp = ks.reshape(L, B, hkv, npg, ps, d).transpose(0, 2, 1, 3, 4, 5)
-    vp = vs.reshape(L, B, hkv, npg, ps, d).transpose(0, 2, 1, 3, 4, 5)
-    return {
-        "k": cache["k"].at[:, :, ids].set(
-            kp.astype(cache["k"].dtype), mode="drop"),
-        "v": cache["v"].at[:, :, ids].set(
-            vp.astype(cache["v"].dtype), mode="drop"),
-    }
+    out = {}
+    for name, buf in cache.items():
+        L, B, hkv, _, last = cols[name].shape
+        cp = cols[name].reshape(L, B, hkv, npg, ps, last).transpose(
+            0, 2, 1, 3, 4, 5)
+        out[name] = buf.at[:, :, ids].set(cp.astype(buf.dtype),
+                                          mode="drop")
+    return out
+
+
+def scatter_prefill(cache: dict, ks, vs, table) -> dict:
+    """Write prefill KV ``[L, B, Hkv, S, Dh]`` bf16 (S a page multiple —
+    right-pad the prompt) into the pages of ``table [B, MP]``,
+    quantizing at write when the cache carries scales.  Pad slots inside
+    a sequence's last page are dead weight masked by the attention
+    length."""
+    cols = {"k": ks, "v": vs}
+    if "k_s" in cache:
+        from tpu_dra.workloads.quant import quantize_kv
+        cols["k"], cols["k_s"] = quantize_kv(ks)
+        cols["v"], cols["v_s"] = quantize_kv(vs)
+    return scatter_pages_raw(cache, cols, table)
 
 
 def append_token(cache: dict, k_new, v_new, table, lengths) -> dict:
-    """Write one token's KV ``[L, B, Hkv, Dh]`` at position ``lengths``
-    (0-based next index) of every sequence: page ``lengths // ps`` via the
-    table, offset ``lengths % ps``."""
+    """Write one token's KV ``[L, B, Hkv, Dh]`` bf16 at position
+    ``lengths`` (0-based next index) of every sequence: page
+    ``lengths // ps`` via the table, offset ``lengths % ps``; quantizes
+    at write for int8 pools."""
     ps = cache["k"].shape[3]
     pidx = lengths // ps                                   # [B]
     off = lengths % ps
     ids = _sanitize(
         jnp.take_along_axis(table, pidx[:, None], axis=1)[:, 0],
         cache["k"].shape[2])
-    kt = k_new.transpose(0, 2, 1, 3)                       # [L, Hkv, B, Dh]
-    vt = v_new.transpose(0, 2, 1, 3)
-    return {
-        "k": cache["k"].at[:, :, ids, off].set(
-            kt.astype(cache["k"].dtype), mode="drop"),
-        "v": cache["v"].at[:, :, ids, off].set(
-            vt.astype(cache["v"].dtype), mode="drop"),
-    }
+    cols = {"k": k_new, "v": v_new}
+    if "k_s" in cache:
+        from tpu_dra.workloads.quant import quantize_kv
+        cols["k"], cols["k_s"] = quantize_kv(k_new)
+        cols["v"], cols["v_s"] = quantize_kv(v_new)
+    out = {}
+    for name, buf in cache.items():
+        ct = cols[name].transpose(0, 2, 1, 3)          # [L, Hkv, B, last]
+        out[name] = buf.at[:, :, ids, off].set(ct.astype(buf.dtype),
+                                               mode="drop")
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -207,17 +240,26 @@ def append_token(cache: dict, k_new, v_new, table, lengths) -> dict:
 # --------------------------------------------------------------------------
 
 
-def _paged_attn_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
-                       m_ref, l_ref, acc_ref, *, ps: int, n_pages: int,
-                       g: int, hkv: int):
+def _paged_attn_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                       ps: int, n_pages: int, g: int, hkv: int,
+                       quantized: bool):
     """One (slot, page) grid step: online softmax over the slot's pages.
 
     The k/v blocks arriving here were DMA'd from ``table[s, j]`` by the
     index maps (scalar-prefetched table) — the kernel body only ever sees
     resident pages.  Pages past the sequence length are skipped
     compute-side (``base < length``); their DMA fetched the clamped page 0
-    — bandwidth the grid pays for tail pages, bounded by MP − used."""
+    — bandwidth the grid pays for tail pages, bounded by MP − used.
+
+    ``quantized``: pages arrive int8 plus per-position fp32 scale rows
+    ([Hkv, 1, ps]); dequantization happens in VMEM right before the MXU
+    ops, so HBM only ever moves int8 pages (+3% scale bytes)."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        out_ref, m_ref, l_ref, acc_ref = rest
 
     s = pl.program_id(0)
     j = pl.program_id(1)
@@ -240,8 +282,15 @@ def _paged_attn_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
         mask = cols < length
         for h in range(hkv):
             rows = slice(h * g, (h + 1) * g)
+            if quantized:
+                k_blk = (k_ref[h, 0].astype(jnp.float32)
+                         * ks_ref[h, 0][:, None]).astype(q.dtype)
+                v_blk = (v_ref[h, 0].astype(jnp.float32)
+                         * vs_ref[h, 0][:, None]).astype(q.dtype)
+            else:
+                k_blk, v_blk = k_ref[h, 0], v_ref[h, 0]
             m_new, l_new, acc_new = _online_softmax_step(
-                q[rows], k_ref[h, 0], v_ref[h, 0], mask,
+                q[rows], k_blk, v_blk, mask,
                 m_ref[rows, :1], l_ref[rows, :1], acc_ref[rows])
             acc_ref[rows] = acc_new
             m_ref[rows] = jnp.broadcast_to(m_new, (g, 128))
@@ -255,8 +304,8 @@ def _paged_attn_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref, out_ref,
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def paged_attention(q, k_pages, v_pages, table, lengths, *,
-                    interpret: bool = False):
+def paged_attention(q, k_pages, v_pages, table, lengths, k_s=None,
+                    v_s=None, *, interpret: bool = False):
     """Decode-step attention against a paged cache.
 
     ``q`` [B, H, Dh] (one position per slot), ``k_pages``/``v_pages``
@@ -274,16 +323,27 @@ def paged_attention(q, k_pages, v_pages, table, lengths, *,
     g = qh // hkv
     qs = (q * (d ** -0.5 * _LOG2E)).astype(q.dtype)
     tab = jnp.maximum(table, 0).astype(jnp.int32)   # clamp -1 sentinels
+    kv_spec = pl.BlockSpec((hkv, 1, ps, d),
+                           lambda s, j, tab, ln: (0, tab[s, j], 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, qh, d), lambda s, j, tab, ln: (s, 0, 0)),
+        kv_spec, kv_spec,
+    ]
+    operands = [qs, k_pages, v_pages]
+    quantized = k_s is not None
+    if quantized:
+        # scale rows ride as [Hkv, P, ps] (last axis squeezed: a 1-wide
+        # lane dim tiles poorly on TPU)
+        sc_spec = pl.BlockSpec((hkv, 1, ps),
+                               lambda s, j, tab, ln: (0, tab[s, j], 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_s.reshape(hkv, P, ps), v_s.reshape(hkv, P, ps)]
+    kernel = partial(_paged_attn_kernel, ps=ps, n_pages=MP, g=g,
+                     hkv=hkv, quantized=quantized)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, MP),
-        in_specs=[
-            pl.BlockSpec((1, qh, d), lambda s, j, tab, ln: (s, 0, 0)),
-            pl.BlockSpec((hkv, 1, ps, d),
-                         lambda s, j, tab, ln: (0, tab[s, j], 0, 0)),
-            pl.BlockSpec((hkv, 1, ps, d),
-                         lambda s, j, tab, ln: (0, tab[s, j], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qh, d), lambda s, j, tab, ln: (s, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((qh, 128), jnp.float32),
@@ -292,16 +352,17 @@ def paged_attention(q, k_pages, v_pages, table, lengths, *,
         ],
     )
     return pl.pallas_call(
-        partial(_paged_attn_kernel, ps=ps, n_pages=MP, g=g, hkv=hkv),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, qh, d), jnp.bfloat16),
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(tab, lengths.astype(jnp.int32), qs, k_pages, v_pages)
+    )(tab, lengths.astype(jnp.int32), *operands)
 
 
-def paged_attention_ref(q, k_pages, v_pages, table, lengths):
+def paged_attention_ref(q, k_pages, v_pages, table, lengths, k_s=None,
+                        v_s=None):
     """XLA oracle: gather the table into a contiguous [B, MP·ps] view and
     run masked attention.  Used by tests and as the CPU fallback — the
     gather materializes the full per-slot context, which is exactly the
@@ -311,20 +372,36 @@ def paged_attention_ref(q, k_pages, v_pages, table, lengths):
     MP = table.shape[1]
     g = qh // hkv
     tab = jnp.maximum(table, 0)
-    k = k_pages[:, tab]                        # [Hkv, B, MP, ps, Dh]
-    v = v_pages[:, tab]
-    k = k.transpose(1, 0, 2, 3, 4).reshape(B, hkv, MP * ps, d)
-    v = v.transpose(1, 0, 2, 3, 4).reshape(B, hkv, MP * ps, d)
+
+    def gather(pages, last):
+        t = pages[:, tab]                      # [Hkv, B, MP, ps, last]
+        return t.transpose(1, 0, 2, 3, 4).reshape(B, hkv, MP * ps, last)
+
+    k = gather(k_pages, d)
+    v = gather(v_pages, d)
+    quantized = k_s is not None
+    if quantized:
+        ks_row = gather(k_s, 1)[..., 0]        # [B, Hkv, S]
+        vs_row = gather(v_s, 1)[..., 0]
+        k = k.astype(jnp.bfloat16)
+        v = v.astype(jnp.bfloat16)
     qg = q.reshape(B, hkv, g, d)
     scores = jnp.einsum("bkgd,bksd->bkgs", qg, k).astype(jnp.float32)
     scores = scores * (d ** -0.5)
+    if quantized:
+        # per-position k scale factors out of the Dh contraction
+        scores = scores * ks_row[:, :, None, :]
     col = jnp.arange(MP * ps)
     valid = col[None, :] < lengths[:, None]                # [B, S]
     scores = jnp.where(valid[:, None, None], scores,
                        jnp.finfo(jnp.float32).min)
     attn = jax.nn.softmax(scores, axis=-1)
     # all-masked slots (length 0): uniform rows — zero them like the kernel
-    attn = jnp.where(valid[:, None, None], attn, 0.0).astype(q.dtype)
+    attn = jnp.where(valid[:, None, None], attn, 0.0)
+    if quantized:
+        # per-position v scale folds into the probabilities (fp32)
+        attn = attn * vs_row[:, :, None, :]
+    attn = attn.astype(jnp.bfloat16)
     out = jnp.einsum("bkgs,bksd->bkgd", attn, v)
     return out.reshape(B, qh, d).astype(jnp.bfloat16)
 
@@ -368,10 +445,13 @@ def _paged_step(cfg: ModelConfig, params, cache, token, lengths, table,
 
     attn = paged_attention_ref if interpret else partial(
         paged_attention, interpret=False)
+    names = sorted(cache)            # ["k", "v"] or ["k","k_s","v","v_s"]
+    quantized = "k_s" in cache
 
     def block(carry, inputs):
         x = carry
-        layer, kp, vp = inputs
+        layer = inputs[0]
+        lc_in = {name: buf[None] for name, buf in zip(names, inputs[1:])}
         h = _rmsnorm(x, layer["ln1"])
         qkv = matmul_any(h, layer["wqkv"], x.dtype)
         q, k, v = _split_qkv(cfg, qkv)
@@ -383,26 +463,28 @@ def _paged_step(cfg: ModelConfig, params, cache, token, lengths, table,
             q = apply_rope(q, positions, cfg.rope_base)
             k = apply_rope(k, positions, cfg.rope_base)
         lcache = append_token(
-            {"k": kp[None], "v": vp[None]},
-            k[:, :, 0][None], v[:, :, 0][None], table, pos)
+            lc_in, k[:, :, 0][None], v[:, :, 0][None], table, pos)
+        scales = ({"k_s": lcache["k_s"][0], "v_s": lcache["v_s"][0]}
+                  if quantized else {})
         out = attn(q[:, :, 0].astype(jnp.bfloat16), lcache["k"][0],
-                   lcache["v"][0], table, pos + 1)
+                   lcache["v"][0], table, pos + 1, **scales)
         out = out.reshape(B, 1, cfg.n_heads * cfg.d_head).astype(x.dtype)
         x = x + matmul_any(out, layer["wo"], x.dtype)
         h2 = _rmsnorm(x, layer["ln2"])
         h2 = jax.nn.gelu(matmul_any(h2, layer["w1"], x.dtype))
         x = x + matmul_any(h2, layer["w2"], x.dtype)
-        return x, (lcache["k"][0], lcache["v"][0])
+        return x, tuple(lcache[name][0] for name in names)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        block, x, (params["blocks"], cache["k"], cache["v"]))
+    x, new_bufs = jax.lax.scan(
+        block, x, (params["blocks"],) + tuple(cache[n] for n in names))
     logits = head_logits(params, x)[:, 0]
-    return {"k": k_new, "v": v_new}, logits, lengths + 1
+    return dict(zip(names, new_bufs)), logits, lengths + 1
 
 
 def paged_greedy_decode(cfg: ModelConfig, params, prompt, table, *,
                         steps: int, total_pages: int, page_size: int,
-                        lengths=None, interpret: bool = False):
+                        lengths=None, cache_dtype: str = "bf16",
+                        interpret: bool = False):
     """Greedy decode ``steps`` tokens with all KV in pages.
 
     ``prompt`` [B, S] right-padded to a page multiple; ``lengths`` [B]
@@ -419,7 +501,7 @@ def paged_greedy_decode(cfg: ModelConfig, params, prompt, table, *,
     if lengths is None:
         lengths = jnp.full((B,), S, jnp.int32)
     lengths = lengths.astype(jnp.int32)
-    cache = init_paged_cache(cfg, total_pages, ps)
+    cache = init_paged_cache(cfg, total_pages, ps, cache_dtype)
     ks, vs, xs = _prefill_kv(cfg, params, prompt)
     cache = scatter_prefill(cache, ks, vs, table)
     # last REAL position's logits (padding never attends backward-only
@@ -442,11 +524,13 @@ def paged_greedy_decode(cfg: ModelConfig, params, prompt, table, *,
 
 
 def make_paged_decoder(cfg: ModelConfig, *, steps: int, total_pages: int,
-                       page_size: int, interpret: bool = False):
+                       page_size: int, cache_dtype: str = "bf16",
+                       interpret: bool = False):
     """jit-compiled ``(params, prompt [B, S], table [B, MP]) -> [B, steps]``
     greedy decoder over a paged cache (the page table is a plain operand:
     one compilation serves any allocation pattern)."""
     return jax.jit(partial(
         paged_greedy_decode, cfg, steps=steps, total_pages=total_pages,
-        page_size=page_size, interpret=interpret),
+        page_size=page_size, cache_dtype=cache_dtype,
+        interpret=interpret),
         static_argnames=())
