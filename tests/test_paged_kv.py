@@ -319,3 +319,36 @@ def test_paged_int8_decode_matches_contiguous_int8():
 def test_init_paged_cache_rejects_unknown_dtype():
     with pytest.raises(ValueError, match="bf16 or int8"):
         init_paged_cache(CFG, 4, 8, cache_dtype="int4")
+
+
+def test_paged_decode_under_tp_mesh_matches_single_device():
+    """TP-sharded paged serving: params sharded over a (1, tp) mesh, the
+    page pool and tables riding XLA's propagation — tokens must equal
+    the single-device paged decode exactly."""
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from tpu_dra.workloads.train import param_shardings
+
+    cfg = CFG
+    params = params_for(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 6), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    steps = 4
+    pool = PagePool(total_pages=16, page_size=4)
+    need = pool.pages_for(prompt.shape[1] + steps)
+    rows = [pool.table_row(pool.alloc(need), need) for _ in range(2)]
+    table = jnp.asarray(np.stack(rows))
+    want = paged_kv.paged_greedy_decode(
+        cfg, params, prompt, table, steps=steps, total_pages=16,
+        page_size=4, interpret=True)
+
+    devs = jax.devices()
+    assert len(devs) >= 4, "conftest provides 8 virtual CPU devices"
+    mesh = Mesh(onp.asarray(devs[:4]).reshape(2, 2), ("dp", "tp"))
+    sharded = jax.device_put(params, param_shardings(cfg, mesh))
+    prompt_s = jax.device_put(
+        prompt, NamedSharding(mesh, P("dp", None)))
+    got = paged_kv.paged_greedy_decode(
+        cfg, sharded, prompt_s, table, steps=steps, total_pages=16,
+        page_size=4, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

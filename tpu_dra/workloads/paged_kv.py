@@ -177,6 +177,18 @@ def _sanitize(table, total_pages: int):
     return jnp.where(table < 0, total_pages, table)
 
 
+def _kv_cols(cache: dict, ks, vs) -> dict:
+    """bf16 k/v columns → the cache's write set, quantizing at write when
+    the cache carries scales (one site, shared by prefill scatter and
+    token append — the slab analog is decode's quantize-at-write)."""
+    cols = {"k": ks, "v": vs}
+    if "k_s" in cache:
+        from tpu_dra.workloads.quant import quantize_kv
+        cols["k"], cols["k_s"] = quantize_kv(ks)
+        cols["v"], cols["v_s"] = quantize_kv(vs)
+    return cols
+
+
 def scatter_pages_raw(cache: dict, cols: dict, table) -> dict:
     """Write already-cache-dtyped columns (``cols[name]`` [L, B, Hkv, S,
     last], S a page multiple, keys matching ``cache``) into the pages of
@@ -203,12 +215,7 @@ def scatter_prefill(cache: dict, ks, vs, table) -> dict:
     quantizing at write when the cache carries scales.  Pad slots inside
     a sequence's last page are dead weight masked by the attention
     length."""
-    cols = {"k": ks, "v": vs}
-    if "k_s" in cache:
-        from tpu_dra.workloads.quant import quantize_kv
-        cols["k"], cols["k_s"] = quantize_kv(ks)
-        cols["v"], cols["v_s"] = quantize_kv(vs)
-    return scatter_pages_raw(cache, cols, table)
+    return scatter_pages_raw(cache, _kv_cols(cache, ks, vs), table)
 
 
 def append_token(cache: dict, k_new, v_new, table, lengths) -> dict:
@@ -222,11 +229,7 @@ def append_token(cache: dict, k_new, v_new, table, lengths) -> dict:
     ids = _sanitize(
         jnp.take_along_axis(table, pidx[:, None], axis=1)[:, 0],
         cache["k"].shape[2])
-    cols = {"k": k_new, "v": v_new}
-    if "k_s" in cache:
-        from tpu_dra.workloads.quant import quantize_kv
-        cols["k"], cols["k_s"] = quantize_kv(k_new)
-        cols["v"], cols["v_s"] = quantize_kv(v_new)
+    cols = _kv_cols(cache, k_new, v_new)
     out = {}
     for name, buf in cache.items():
         ct = cols[name].transpose(0, 2, 1, 3)          # [L, Hkv, B, last]
