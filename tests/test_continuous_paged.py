@@ -40,9 +40,6 @@ def paged_engine(**kw):
 
 
 def test_rejects_incompatible_modes():
-    with pytest.raises(ValueError, match="speculative"):
-        ContinuousEngine(CFG, PARAMS, kv_layout="paged",
-                         draft=(CFG, PARAMS), chunk=2)
     with pytest.raises(ValueError, match="kv_layout"):
         ContinuousEngine(CFG, PARAMS, kv_layout="pagedd")
     eng = paged_engine()
@@ -363,6 +360,66 @@ def test_paged_int8_prefix_join_matches_slab_int8():
         pid = eng.register_prefix(prefix)
         assert eng._prefixes[pid].pages is not None
         got = eng.submit([1, 2], 5, prefix_id=pid, timeout=300)
+    finally:
+        eng.shutdown()
+    assert got == want
+
+
+# -------------------------------------------------------------------------
+# Speculative decoding over pages
+# -------------------------------------------------------------------------
+
+
+def test_paged_speculative_matches_plain_paged():
+    """Greedy acceptance: the spec+paged engine's tokens must equal the
+    plain paged engine's exactly (the draft only changes speed), and
+    with draft == target every proposal accepts (tokens-per-pass at the
+    chunk ceiling)."""
+    reqs = [([3, 5, 7], 6), ([2, 4], 9), ([9] * 10, 5)]
+    plain = paged_engine(slots=3)
+    try:
+        want = [plain.submit(p, s, timeout=300) for p, s in reqs]
+    finally:
+        plain.shutdown()
+    eng = paged_engine(slots=3, draft=(CFG, PARAMS))
+    try:
+        got = [eng.submit(p, s, timeout=300) for p, s in reqs]
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"]
+        # draft == target: every pass commits the full chunk
+        assert st["spec_tokens_per_pass"] >= 1.5
+    finally:
+        eng.shutdown()
+    assert got == want
+
+
+def test_paged_speculative_eos_and_balance():
+    eng = paged_engine(slots=2, draft=(CFG, PARAMS))
+    try:
+        probe = eng.submit([1, 2, 3], 6, timeout=300)
+        eos = probe[1]
+        out = eng.submit([1, 2, 3], 6, eos_id=eos, timeout=300)
+        assert out == probe[:probe.index(eos) + 1]
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"]
+    finally:
+        eng.shutdown()
+
+
+def test_paged_speculative_int8_matches_plain_int8():
+    """int8 pages + speculation: exact parity with the plain int8 paged
+    engine — exercises the quantized branches of the chunk verify."""
+    reqs = [([3, 5, 7], 6), ([2, 4], 7)]
+    plain = paged_engine(slots=2, cache_dtype="int8")
+    try:
+        want = [plain.submit(p, s, timeout=300) for p, s in reqs]
+    finally:
+        plain.shutdown()
+    eng = paged_engine(slots=2, cache_dtype="int8", draft=(CFG, PARAMS))
+    try:
+        got = [eng.submit(p, s, timeout=300) for p, s in reqs]
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"]
     finally:
         eng.shutdown()
     assert got == want

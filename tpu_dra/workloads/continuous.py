@@ -151,11 +151,6 @@ class ContinuousEngine:
         if kv_layout not in ("slab", "paged"):
             raise ValueError(f"kv_layout must be 'slab' or 'paged', "
                              f"got {kv_layout!r}")
-        if kv_layout == "paged":
-            if draft is not None:
-                raise ValueError("paged engine does not support "
-                                 "speculative drafts yet (two page pools)")
-
         self.kv_layout = kv_layout
         self.cfg = cfg
         self.params = params
@@ -171,8 +166,9 @@ class ContinuousEngine:
         # device state: fixed shapes for the whole engine lifetime
         self.draft = draft
         if draft is not None:
-            self._dcache = init_kv_cache(draft[0], slots, self.max_len,
-                                         cache_dtype)
+            if kv_layout != "paged":
+                self._dcache = init_kv_cache(draft[0], slots,
+                                             self.max_len, cache_dtype)
             # speed observables: committed tokens vs live-slot passes
             # (tokens per slot-pass is the speculative win: 1.0 is
             # plain-decode parity, chunk the full-accept ceiling)
@@ -203,6 +199,14 @@ class ContinuousEngine:
             # CPU runs use the gather oracle; TPU runs the Pallas kernel
             self._interpret = jax.devices()[0].platform != "tpu"
             self._cache = init_paged_cache(cfg, cap, ps, cache_dtype)
+            if draft is not None:
+                # the draft SHARES the target's block tables and page
+                # ids: one allocator, two pools with identical [P, ps]
+                # indexing (the draft pool just has its own
+                # layer/head/dim axes) — an admission allocates once and
+                # both models' KV lands in the same page slots
+                self._dcache = init_paged_cache(draft[0], cap, ps,
+                                                cache_dtype)
             self._table = jnp.full((slots, self._mp), -1, jnp.int32)
             self._page_ids: list[Optional[list[int]]] = [None] * slots
             # zero-copy prefix pages referenced by each slot's table
@@ -250,9 +254,12 @@ class ContinuousEngine:
             self._step_fn = jax.jit(partial(self._chunk_step_impl, cfg),
                                     donate_argnums=(1, 2, 3, 6, 7))
         if draft is not None:
+            spec_impl = (self._paged_spec_chunk_impl
+                         if kv_layout == "paged" else
+                         self._spec_chunk_impl)
             self._spec_step_fn = jax.jit(
-                partial(self._spec_chunk_impl, cfg, draft[0]),
-                donate_argnums=(2, 3))          # both slot caches
+                partial(spec_impl, cfg, draft[0]),
+                donate_argnums=(2, 3))          # both caches/pools
             self._spec_prefill_fns: dict[int, Any] = {}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
@@ -325,12 +332,13 @@ class ContinuousEngine:
             step, (cache, token, pos, done, keys), None, length=self.chunk)
         return cache, token, pos, done, keys, toks.T    # [slots, chunk]
 
-    def _paged_prefill_impl(self, cfg, params, cache, prompts, lengths,
-                            temps, keys, rows):
-        """Paged admission: run the prefill trunk, scatter the KV straight
-        into the joining slots' PAGES (``rows`` [k, MP] — no contiguous
-        slot rows exist), and select each first token.  The prompt pad to
-        a page multiple is causal-dead and masked by ``lengths``."""
+    def _paged_prefill_core(self, cfg, params, cache, prompts, lengths,
+                            rows):
+        """Target-side paged prefill shared by the plain and speculative
+        admissions: pad the prompt to a page multiple (causal-dead,
+        masked by ``lengths``), run the prefill trunk, scatter the KV
+        into the joining slots' pages, and return (cache', last-position
+        logits, padded prompts)."""
         from tpu_dra.workloads.paged_kv import _prefill_kv, scatter_prefill
         k, Sb = prompts.shape
         ps = cache["k"].shape[3]
@@ -340,7 +348,14 @@ class ContinuousEngine:
         ks, vs, x = _prefill_kv(cfg, params, prompts)
         cache = scatter_prefill(cache, ks, vs, rows)
         last = x[jnp.arange(k), lengths - 1][:, None, :]
-        logits = head_logits(params, last)[:, 0]
+        return cache, head_logits(params, last)[:, 0], prompts
+
+    def _paged_prefill_impl(self, cfg, params, cache, prompts, lengths,
+                            temps, keys, rows):
+        """Paged admission: prefill into pages (no contiguous slot rows
+        exist) and select each joining request's first token."""
+        cache, logits, _ = self._paged_prefill_core(
+            cfg, params, cache, prompts, lengths, rows)
         return cache, self._first_token(logits, temps, keys)
 
     def _paged_prefill_fn(self, bucket: int):
@@ -424,7 +439,6 @@ class ContinuousEngine:
         slots hold (count 0).  Stale cache rows beyond each slot's new
         position stay invisible per the module invariant."""
         k = self.chunk
-        slots_n = token.shape[0]
 
         def draft_step(c, j):
             dcache, tok = c
@@ -443,6 +457,16 @@ class ContinuousEngine:
 
         chunk_toks = jnp.concatenate([token[:, None], drafts], axis=1)
         t_lg, cache = _chunk_logits(cfg, params, cache, pos, chunk_toks)
+        token2, pos2, done2, emit, counts = self._spec_commit(
+            k, token, pos, eos, done, drafts, t_lg)
+        return cache, dcache, token2, pos2, done2, emit, counts
+
+    def _spec_commit(self, k, token, pos, eos, done, drafts, t_lg):
+        """Accept/commit tail shared by the slab and paged speculative
+        steps (ONE implementation — the layouts must not drift on
+        acceptance semantics): longest greedy-matching draft prefix plus
+        the target's bonus token; frozen slots hold."""
+        slots_n = token.shape[0]
         preds = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)   # [slots, k]
 
         match = (drafts == preds[:, :-1]).astype(jnp.int32)
@@ -465,7 +489,62 @@ class ContinuousEngine:
         token2 = jnp.where(done, token, bonus)
         pos2 = pos + counts
         done2 = done | hit
+        return token2, pos2, done2, emit, counts
+
+    def _paged_spec_chunk_impl(self, cfg, dcfg, params, dparams, cache,
+                               dcache, token, pos, eos, done, table):
+        """Paged speculative iteration: the draft proposes over ITS page
+        pool (same block tables and page ids as the target — one
+        allocation covers both models), the target verifies the chunk
+        against its pages, and the shared accept math commits."""
+        from tpu_dra.workloads.paged_kv import (_paged_step,
+                                                paged_chunk_logits)
+        k = self.chunk
+
+        def draft_step(c, j):
+            dcache, tok = c
+            dcache, lg, _ = _paged_step(dcfg, dparams, dcache, tok,
+                                        pos + j, table, self._interpret)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, tok, nxt)
+            return (dcache, nxt), nxt
+
+        (dcache, _), drafts = jax.lax.scan(
+            draft_step, (dcache, token),
+            jnp.arange(k, dtype=jnp.int32))
+        drafts = drafts.T[:, : k - 1]                    # [slots, k-1]
+
+        chunk_toks = jnp.concatenate([token[:, None], drafts], axis=1)
+        t_lg, cache = paged_chunk_logits(cfg, params, cache, chunk_toks,
+                                         pos, table)
+        token2, pos2, done2, emit, counts = self._spec_commit(
+            k, token, pos, eos, done, drafts, t_lg)
         return cache, dcache, token2, pos2, done2, emit, counts
+
+    def _paged_spec_prefill_impl(self, cfg, dcfg, params, dparams, cache,
+                                 dcache, prompts, lengths, rows):
+        """Paged speculative admission: the shared target prefill core
+        plus the draft's prompt KV scattered into the SAME rows of its
+        own pool; first token greedy from the target (speculative mode
+        is greedy-only)."""
+        from tpu_dra.workloads.paged_kv import (_prefill_kv,
+                                                scatter_prefill)
+        cache, logits, prompts = self._paged_prefill_core(
+            cfg, params, cache, prompts, lengths, rows)
+        dks, dvs, _ = _prefill_kv(dcfg, dparams, prompts)
+        dcache = scatter_prefill(dcache, dks, dvs, rows)
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return cache, dcache, first
+
+    def _paged_spec_prefill_fn(self, bucket: int):
+        fn = self._spec_prefill_fns.get(("paged", bucket))
+        if fn is None:
+            fn = jax.jit(
+                partial(self._paged_spec_prefill_impl, self.cfg,
+                        self.draft[0]),
+                donate_argnums=(2, 3))              # both page pools
+            self._spec_prefill_fns[("paged", bucket)] = fn
+        return fn
 
     def _prefix_kv_impl(self, cfg, params, prompt):
         """Compute a prefix's KV buffers once: [1, Pb] right-padded →
@@ -895,7 +974,13 @@ class ContinuousEngine:
             if take_refs and shared:
                 with self._pool_mu:
                     self.pool.ref(shared)
-        need = self.pool.pages_for(plen + prompt_len + steps) - len(shared)
+        # speculative engines overshoot committed positions by up to one
+        # chunk mid-pass (the draft/verify coverage rule, _spec_chunk);
+        # those writes MUST land in real pages or later passes attend
+        # zeros — same reason slab submit reserves max_len slack
+        slack = self.chunk if self.draft is not None else 0
+        need = self.pool.pages_for(
+            plen + prompt_len + steps + slack) - len(shared)
         return shared, need
 
     def _resident_prefix_pages(self) -> int:
@@ -930,7 +1015,13 @@ class ContinuousEngine:
         # request's seed (fold 0 draws the first token, the rest of the
         # stream advances per step in the chunk scan)
         base_keys = [jax.random.PRNGKey(req.seed) for _, req in group]
-        if self.draft is not None:
+        if self.draft is not None and self.kv_layout == "paged":
+            rows = self._table[slots]                      # [k, MP]
+            cache, dcache, first = self._paged_spec_prefill_fn(Sb)(
+                self.params, self.draft[1], self._cache, self._dcache,
+                prompts, lengths, rows)
+            self._cache, self._dcache = cache, dcache
+        elif self.draft is not None:
             cache, dcache, first = self._spec_prefill_fn(Sb)(
                 self.params, self.draft[1], self._cache, self._dcache,
                 prompts, lengths, slots)
@@ -1079,10 +1170,13 @@ class ContinuousEngine:
             if all(r is None for r in self._requests):
                 continue
             if self.draft is not None:
+                spec_args = (self.params, self.draft[1], self._cache,
+                             self._dcache, self._token, self._pos,
+                             self._eos, self._done)
+                if self.kv_layout == "paged":
+                    spec_args += (self._table,)
                 (self._cache, self._dcache, self._token, self._pos,
-                 self._done, toks, counts) = self._spec_step_fn(
-                    self.params, self.draft[1], self._cache, self._dcache,
-                    self._token, self._pos, self._eos, self._done)
+                 self._done, toks, counts) = self._spec_step_fn(*spec_args)
                 # ONE device readback for both outputs (admission-path
                 # discipline)
                 toks, counts_host = jax.device_get((toks, counts))

@@ -366,11 +366,37 @@ def paged_attention(q, k_pages, v_pages, table, lengths, k_s=None,
 
 def paged_attention_ref(q, k_pages, v_pages, table, lengths, k_s=None,
                         v_s=None):
-    """XLA oracle: gather the table into a contiguous [B, MP·ps] view and
-    run masked attention.  Used by tests and as the CPU fallback — the
-    gather materializes the full per-slot context, which is exactly the
-    HBM copy the Pallas kernel exists to avoid."""
-    B, qh, d = q.shape
+    """XLA oracle for the m=1 decode step: the chunk oracle at m=1 with
+    row limit ``lengths - 1`` (a zero-length slot's limit is -1 — every
+    column masks and the output is zeros, matching the kernel's flush
+    guard).  Used by tests and as the CPU fallback — the gather
+    materializes the full per-slot context, which is exactly the HBM
+    copy the Pallas kernel exists to avoid."""
+    out = paged_attention_chunk_ref(
+        q[:, :, None], k_pages, v_pages, table,
+        lengths.astype(jnp.int32) - 1, 1, k_s=k_s, v_s=v_s)
+    return out[:, :, 0]
+
+
+def append_chunk(cache: dict, k_new, v_new, table, lengths, m: int) -> dict:
+    """Write an m-token chunk's KV ``[L, B, Hkv, m, Dh]`` at positions
+    ``lengths .. lengths+m-1``: m static single-token appends (chunks are
+    small — the speculative verify width — and a token may cross a page
+    boundary, which per-token routing handles for free)."""
+    for j in range(m):
+        cache = append_token(cache, k_new[:, :, :, j], v_new[:, :, :, j],
+                             table, lengths + j)
+    return cache
+
+
+def paged_attention_chunk_ref(q, k_pages, v_pages, table, pos, m: int,
+                              k_s=None, v_s=None):
+    """m-token chunk attention against pages (the speculative-verify
+    shape): ``q`` [B, qh, m, Dh], row j attends columns ``<= pos + j``
+    (its own just-appended position included).  Gather-based — the
+    chunk's m·S work amortizes the page gather, and the m=1 decode hot
+    path keeps the scalar-prefetch kernel."""
+    B, qh, _, d = q.shape
     hkv, P, ps, _ = k_pages.shape
     MP = table.shape[1]
     g = qh // hkv
@@ -388,25 +414,71 @@ def paged_attention_ref(q, k_pages, v_pages, table, lengths, k_s=None,
         vs_row = gather(v_s, 1)[..., 0]
         k = k.astype(jnp.bfloat16)
         v = v.astype(jnp.bfloat16)
-    qg = q.reshape(B, hkv, g, d)
-    scores = jnp.einsum("bkgd,bksd->bkgs", qg, k).astype(jnp.float32)
+    qg = q.reshape(B, hkv, g, m, d)
+    scores = jnp.einsum("bkgmd,bksd->bkgms", qg, k).astype(jnp.float32)
     scores = scores * (d ** -0.5)
     if quantized:
-        # per-position k scale factors out of the Dh contraction
-        scores = scores * ks_row[:, :, None, :]
+        scores = scores * ks_row[:, :, None, None, :]
     col = jnp.arange(MP * ps)
-    valid = col[None, :] < lengths[:, None]                # [B, S]
+    limit = pos[:, None] + jnp.arange(m)[None, :]          # [B, m]
+    valid = col[None, None, :] <= limit[:, :, None]        # [B, m, S]
     scores = jnp.where(valid[:, None, None], scores,
                        jnp.finfo(jnp.float32).min)
     attn = jax.nn.softmax(scores, axis=-1)
-    # all-masked slots (length 0): uniform rows — zero them like the kernel
     attn = jnp.where(valid[:, None, None], attn, 0.0)
     if quantized:
-        # per-position v scale folds into the probabilities (fp32)
-        attn = attn * vs_row[:, :, None, :]
+        attn = attn * vs_row[:, :, None, None, :]
     attn = attn.astype(jnp.bfloat16)
-    out = jnp.einsum("bkgs,bksd->bkgd", attn, v)
-    return out.reshape(B, qh, d).astype(jnp.bfloat16)
+    out = jnp.einsum("bkgms,bksd->bkgmd", attn, v)
+    return out.reshape(B, qh, m, d).astype(jnp.bfloat16)
+
+
+def paged_chunk_logits(cfg: ModelConfig, params, cache, tokens, pos,
+                       table):
+    """m-token chunk forward against pages: appends every token's KV and
+    returns ([B, m, vocab] logits, cache') — the paged analog of
+    decode._chunk_logits, used by the speculative verify pass.  Row j
+    runs at absolute position ``pos + j``; causality within the chunk
+    falls out of the per-row column limit."""
+    B, m = tokens.shape
+    names = sorted(cache)
+    quantized = "k_s" in cache
+    positions = _chunk_positions(pos, m)                   # [B, m]
+    x = params["embed"].astype(jnp.bfloat16)[tokens]       # [B, m, D]
+    if cfg.pos_emb == "learned":
+        x = x + params["pos"].astype(jnp.bfloat16)[positions]
+
+    def block(carry, inputs):
+        x = carry
+        layer = inputs[0]
+        lc = {name: buf[None] for name, buf in zip(names, inputs[1:])}
+        h = _rmsnorm(x, layer["ln1"])
+        qkv = matmul_any(h, layer["wqkv"], x.dtype)
+        q, k, v = _split_qkv(cfg, qkv)
+        q = _split_heads(cfg, q)                           # [B, H, m, Dh]
+        k = _split_heads(cfg, k, cfg.kv_heads)
+        v = _split_heads(cfg, v, cfg.kv_heads)
+        if cfg.pos_emb == "rope":
+            q = apply_rope(q, positions, cfg.rope_base)
+            k = apply_rope(k, positions, cfg.rope_base)
+        lc = append_chunk(lc, k[None], v[None], table, pos, m)
+        scales = ({"k_s": lc["k_s"][0], "v_s": lc["v_s"][0]}
+                  if quantized else {})
+        out = paged_attention_chunk_ref(
+            q.astype(jnp.bfloat16), lc["k"][0], lc["v"][0], table, pos,
+            m, **scales)
+        out = out.transpose(0, 2, 1, 3).reshape(
+            B, m, cfg.n_heads * cfg.d_head).astype(x.dtype)
+        x = x + matmul_any(out, layer["wo"], x.dtype)
+        h2 = _rmsnorm(x, layer["ln2"])
+        h2 = jax.nn.gelu(matmul_any(h2, layer["w1"], x.dtype))
+        x = x + matmul_any(h2, layer["w2"], x.dtype)
+        return x, tuple(lc[name][0] for name in names)
+
+    x, new_bufs = jax.lax.scan(
+        block, x, (params["blocks"],) + tuple(cache[n] for n in names))
+    logits = head_logits(params, x)                        # [B, m, V]
+    return logits, dict(zip(names, new_bufs))
 
 
 # --------------------------------------------------------------------------
