@@ -44,7 +44,8 @@ _DEADLINES = {
     "decode_long": 420,
     # plain engine + spec-ceiling engine: two full compile sets + two runs
     "continuous": 720,
-    "paged": 480,
+    # plain + spec-ceiling paged engines: two compile sets
+    "paged": 720,
     "visibility": 300,
     "multiprocess": 300,
     "collectives": 300,
@@ -682,6 +683,38 @@ def section_paged() -> dict:
             out["paged_errors"] = errs[0][:200]
     finally:
         eng.shutdown()
+    # speculative ceiling over pages (draft == target accepts every
+    # proposal — the paged analog of the continuous section's ceiling);
+    # fenced so a spec failure cannot discard the plain paged numbers
+    try:
+        eng2 = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                                kv_layout="paged", page_size=ps,
+                                total_pages=total_pages * 2,
+                                draft=(cfg, params))
+        try:
+            for ln in lengths:
+                eng2.submit([1] * ln, steps=chunk, timeout=600)
+            eng2.reset_stats()
+            n2 = max(4, n_req // 3)
+            reqs2 = [([7 + i % 100] * lengths[i % len(lengths)],
+                      steps[i % len(steps)]) for i in range(n2)]
+            t0 = _time.perf_counter()
+            handles2 = [eng2.submit_async(p, s) for p, s in reqs2]
+            errs2 = [h.error for h in handles2
+                     if not h.done.wait(600) or h.error]
+            secs2 = _time.perf_counter() - t0
+            st2 = eng2.stats()
+            total2 = sum(len(h.tokens) for h in handles2)
+            out["paged_spec_ceiling_tokens_per_s"] = round(
+                total2 / secs2, 1)
+            out["paged_spec_tokens_per_pass"] = st2.get(
+                "spec_tokens_per_pass")
+            if errs2:
+                out["paged_spec_errors"] = str(errs2[0])[:200]
+        finally:
+            eng2.shutdown()
+    except Exception as exc:  # noqa: BLE001 — keep the plain numbers
+        out["paged_spec_errors"] = repr(exc)[:200]
     return out
 
 
