@@ -510,16 +510,29 @@ def section_continuous() -> dict:
     # The spec engine doubles KV-cache HBM (target + draft copies of the
     # full model) and adds its own compiles: any failure here must not
     # discard the plain-engine numbers already in ``out``.
+    _spec_ceiling(
+        out, "continuous",
+        lambda: ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                                 draft=(cfg, params)),
+        chunk, lengths, steps, max(4, n_req // 3))
+    return out
+
+
+def _spec_ceiling(out: dict, prefix: str, make_engine, chunk, lengths,
+                  steps, n_req) -> None:
+    """Shared draft==target ceiling runner (continuous + paged sections):
+    warm every prompt bucket, run the mixed load, report tokens/s and
+    tokens-per-pass under ``<prefix>_spec_*`` keys.  Fenced — any
+    failure records an error key and never discards the section's
+    already-measured plain numbers."""
     try:
-        eng2 = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
-                                draft=(cfg, params))
+        eng2 = make_engine()
         try:
-            n2 = max(4, n_req // 3)
             for ln in lengths:            # warm EVERY prompt bucket, like
                 eng2.submit([1] * ln, steps=chunk, timeout=600)  # plain path
             eng2.reset_stats()
             reqs2 = [([7 + i % 100] * lengths[i % len(lengths)],
-                      steps[i % len(steps)]) for i in range(n2)]
+                      steps[i % len(steps)]) for i in range(n_req)]
             t0 = time.perf_counter()
             handles2 = [eng2.submit_async(p, s) for p, s in reqs2]
             errs2 = []
@@ -531,17 +544,16 @@ def section_continuous() -> dict:
             secs2 = time.perf_counter() - t0
             st2 = eng2.stats()
             total2 = sum(len(h.tokens) for h in handles2)
-            out["continuous_spec_ceiling_tokens_per_s"] = round(
+            out[f"{prefix}_spec_ceiling_tokens_per_s"] = round(
                 total2 / secs2, 1)
-            out["continuous_spec_tokens_per_pass"] = st2.get(
+            out[f"{prefix}_spec_tokens_per_pass"] = st2.get(
                 "spec_tokens_per_pass")
             if errs2:
-                out["continuous_spec_errors"] = errs2[0][:200]
+                out[f"{prefix}_spec_errors"] = errs2[0][:200]
         finally:
             eng2.shutdown()
     except Exception as exc:  # noqa: BLE001 — keep the plain numbers
-        out["continuous_spec_errors"] = repr(exc)[:200]
-    return out
+        out[f"{prefix}_spec_errors"] = repr(exc)[:200]
 
 
 # honor an explicit CPU request in bench child processes: the axon
@@ -684,37 +696,14 @@ def section_paged() -> dict:
     finally:
         eng.shutdown()
     # speculative ceiling over pages (draft == target accepts every
-    # proposal — the paged analog of the continuous section's ceiling);
-    # fenced so a spec failure cannot discard the plain paged numbers
-    try:
-        eng2 = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
-                                kv_layout="paged", page_size=ps,
-                                total_pages=total_pages * 2,
-                                draft=(cfg, params))
-        try:
-            for ln in lengths:
-                eng2.submit([1] * ln, steps=chunk, timeout=600)
-            eng2.reset_stats()
-            n2 = max(4, n_req // 3)
-            reqs2 = [([7 + i % 100] * lengths[i % len(lengths)],
-                      steps[i % len(steps)]) for i in range(n2)]
-            t0 = _time.perf_counter()
-            handles2 = [eng2.submit_async(p, s) for p, s in reqs2]
-            errs2 = [h.error for h in handles2
-                     if not h.done.wait(600) or h.error]
-            secs2 = _time.perf_counter() - t0
-            st2 = eng2.stats()
-            total2 = sum(len(h.tokens) for h in handles2)
-            out["paged_spec_ceiling_tokens_per_s"] = round(
-                total2 / secs2, 1)
-            out["paged_spec_tokens_per_pass"] = st2.get(
-                "spec_tokens_per_pass")
-            if errs2:
-                out["paged_spec_errors"] = str(errs2[0])[:200]
-        finally:
-            eng2.shutdown()
-    except Exception as exc:  # noqa: BLE001 — keep the plain numbers
-        out["paged_spec_errors"] = repr(exc)[:200]
+    # proposal — the paged analog of the continuous section's ceiling)
+    _spec_ceiling(
+        out, "paged",
+        lambda: ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                                 kv_layout="paged", page_size=ps,
+                                 total_pages=total_pages * 2,
+                                 draft=(cfg, params)),
+        chunk, lengths, steps, max(4, n_req // 3))
     return out
 
 

@@ -363,3 +363,88 @@ def test_informer_store_race_free():
     finally:
         racecheck.uninstall()
         racecheck.reset()
+
+
+def test_kubelet_plugin_grpc_path_race_free(tmp_path):
+    """The REAL serving path under the detector: concurrent
+    NodePrepareResources/NodeUnprepareResources through the gRPC DRA
+    socket (grpc's worker threads + the driver's flock/DeviceState/CDI
+    stack), with DeviceState and the driver monitored.  This is the
+    closest Python gets to running the plugin binary under -race."""
+    racecheck.install()
+    import grpc
+
+    from tpu_dra.k8s import FakeKube, RESOURCE_CLAIMS
+    from tpu_dra.kubeletplugin.proto import dra_v1beta1_pb2 as dra_pb
+    from tpu_dra.plugins.tpu.device_state import DeviceState
+    from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+    from tpu_dra.tpulib import FakeTpuLib
+    from tpu_dra.version import DRIVER_NAME
+
+    racecheck.monitor(DeviceState)
+    racecheck.monitor(TpuDriver)
+    kube = FakeKube()
+    drv = TpuDriver(TpuDriverConfig(
+        node_name="node-a",
+        tpulib=FakeTpuLib(),
+        kube=kube,
+        plugins_dir=str(tmp_path / "plugins"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=5.0))
+    drv.start()
+    try:
+        for i in range(8):
+            claim = {
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": f"claim-{i}", "namespace": "default",
+                             "uid": f"uid-{i}"},
+                "spec": {},
+                "status": {"allocation": {"devices": {"results": [
+                    {"request": "tpu", "driver": DRIVER_NAME,
+                     "pool": "node-a", "device": f"tpu-{i % 4}"}]}}},
+            }
+            kube.create(RESOURCE_CLAIMS, claim)
+            stored = kube.get(RESOURCE_CLAIMS, f"claim-{i}", "default")
+            stored["metadata"]["uid"] = f"uid-{i}"
+            kube.update(RESOURCE_CLAIMS, stored)
+
+        def rpc(method, request, response_cls):
+            with grpc.insecure_channel(
+                    f"unix:{drv.server.dra_socket}") as channel:
+                fn = channel.unary_unary(
+                    method,
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=response_cls.FromString)
+                return fn(request, timeout=30)
+
+        errors: list[str] = []
+
+        def worker(i: int) -> None:
+            for _ in range(3):
+                req = dra_pb.NodePrepareResourcesRequest(claims=[
+                    dra_pb.Claim(namespace="default", uid=f"uid-{i}",
+                                 name=f"claim-{i}")])
+                resp = rpc("/v1beta1.DRAPlugin/NodePrepareResources",
+                           req, dra_pb.NodePrepareResourcesResponse)
+                if resp.claims[f"uid-{i}"].error:
+                    errors.append(resp.claims[f"uid-{i}"].error)
+                    return
+                unreq = dra_pb.NodeUnprepareResourcesRequest(claims=[
+                    dra_pb.Claim(namespace="default", uid=f"uid-{i}",
+                                 name=f"claim-{i}")])
+                unresp = rpc("/v1beta1.DRAPlugin/NodeUnprepareResources",
+                             unreq, dra_pb.NodeUnprepareResourcesResponse)
+                if unresp.claims[f"uid-{i}"].error:
+                    errors.append(unresp.claims[f"uid-{i}"].error)
+                    return
+
+        run_threads(8, worker)
+        assert not errors, errors[:3]
+        assert drv.state.prepared_claims() == {}
+        racecheck.assert_no_races()
+    finally:
+        drv.stop()
+        racecheck.uninstall()
+        racecheck.reset()

@@ -270,6 +270,13 @@ class _TracedLock:
     def locked(self) -> bool:
         return self._rc_lock.locked()
 
+    def _at_fork_reinit(self) -> None:
+        # CPython internals (concurrent.futures.thread, threading's
+        # fork handlers) call this on raw locks; delegate and reset
+        self._rc_lock._at_fork_reinit()
+        self._rc_owner = None
+        self._rc_count = 0
+
     def __enter__(self) -> bool:
         return self.acquire()
 
