@@ -51,7 +51,7 @@ stress:
 	for i in $$(seq 1 $(STRESS_RUNS)); do \
 	  echo "stress run $$i/$(STRESS_RUNS)"; \
 	  $(PYTHON) -m pytest tests/test_stress_concurrency.py tests/test_racecheck.py \
-	    tests/test_informer.py tests/test_workqueue.py -q -x || exit 1; \
+	    tests/test_soak.py tests/test_informer.py tests/test_workqueue.py -q -x || exit 1; \
 	done
 
 image:

