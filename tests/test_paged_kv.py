@@ -352,3 +352,50 @@ def test_paged_decode_under_tp_mesh_matches_single_device():
         cfg, sharded, prompt_s, table, steps=steps, total_pages=16,
         page_size=4, interpret=True)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("pc", [2, 3, 8])
+def test_chunked_prefill_matches_one_shot(pc):
+    """Chunked paged prefill (any chunk size vs page geometry, page
+    boundaries crossed mid-chunk and mid-page) produces the same tokens
+    as the one-shot trunk prefill."""
+    cfg = CFG
+    params = params_for(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (2, 6), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    steps = 4
+    pool = PagePool(total_pages=16, page_size=4)
+    need = pool.pages_for(prompt.shape[1] + steps)
+    rows = [pool.table_row(pool.alloc(need), need) for _ in range(2)]
+    table = jnp.asarray(np.stack(rows))
+    want = paged_kv.paged_greedy_decode(
+        cfg, params, prompt, table, steps=steps, total_pages=16,
+        page_size=4, interpret=True)
+    got = paged_kv.paged_greedy_decode(
+        cfg, params, prompt, table, steps=steps, total_pages=16,
+        page_size=4, prefill_chunk=pc, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_chunked_prefill_ragged():
+    cfg = CFG
+    params = params_for(cfg)
+    B, S, steps = 3, 8, 3
+    lengths = jnp.asarray([3, 8, 5], jnp.int32)
+    key = jax.random.PRNGKey(13)
+    prompt = jax.random.randint(key, (B, S), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    mask = np.arange(S)[None, :] < np.asarray(lengths)[:, None]
+    prompt = jnp.where(jnp.asarray(mask), prompt, 0)
+    pool = PagePool(total_pages=16, page_size=4)
+    rows = [pool.table_row(
+        pool.alloc(pool.pages_for(int(lengths[i]) + steps)), 4)
+        for i in range(B)]
+    table = jnp.asarray(np.stack(rows))
+    want = paged_kv.paged_greedy_decode(
+        cfg, params, prompt, table, steps=steps, total_pages=16,
+        page_size=4, lengths=lengths, interpret=True)
+    got = paged_kv.paged_greedy_decode(
+        cfg, params, prompt, table, steps=steps, total_pages=16,
+        page_size=4, lengths=lengths, prefill_chunk=4, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -437,9 +437,18 @@ def paged_chunk_logits(cfg: ModelConfig, params, cache, tokens, pos,
                        table):
     """m-token chunk forward against pages: appends every token's KV and
     returns ([B, m, vocab] logits, cache') — the paged analog of
-    decode._chunk_logits, used by the speculative verify pass.  Row j
-    runs at absolute position ``pos + j``; causality within the chunk
-    falls out of the per-row column limit."""
+    decode._chunk_logits, used by the speculative verify pass."""
+    x, cache = _paged_chunk_hidden(cfg, params, cache, tokens, pos, table)
+    return head_logits(params, x), cache
+
+
+def _paged_chunk_hidden(cfg: ModelConfig, params, cache, tokens, pos,
+                        table):
+    """Chunk forward returning pre-head activations ([B, m, D], cache') —
+    chunked prefill harvests one row per sequence and runs the vocab
+    head ONCE, so the [m, vocab] logits never materialize per chunk.
+    Row j runs at absolute position ``pos + j``; causality within the
+    chunk falls out of the per-row column limit."""
     B, m = tokens.shape
     names = sorted(cache)
     quantized = "k_s" in cache
@@ -477,8 +486,7 @@ def paged_chunk_logits(cfg: ModelConfig, params, cache, tokens, pos,
 
     x, new_bufs = jax.lax.scan(
         block, x, (params["blocks"],) + tuple(cache[n] for n in names))
-    logits = head_logits(params, x)                        # [B, m, V]
-    return logits, dict(zip(names, new_bufs))
+    return x, dict(zip(names, new_bufs))
 
 
 # --------------------------------------------------------------------------
@@ -556,9 +564,51 @@ def _paged_step(cfg: ModelConfig, params, cache, token, lengths, table,
     return dict(zip(names, new_bufs)), logits, lengths + 1
 
 
+def paged_chunked_prefill(cfg: ModelConfig, params, cache, prompt,
+                          lengths, table, chunk: int):
+    """Prefill a [B, S] right-padded prompt into pages ``chunk`` tokens
+    at a time through the cached chunk forward — activations stay
+    O(chunk·D) instead of O(S·D), the paged analog of
+    decode.prefill_chunked: one lax.scan over [n, B, chunk] pieces (the
+    forward graph traces once), the final hidden state carried per row,
+    and the vocab head applied ONCE at the end.  Returns (cache',
+    last-real-position logits [B, vocab]).  Pad positions append garbage
+    KV that decode's append-then-attend ordering overwrites before it is
+    ever attended (module invariant)."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    B, S = prompt.shape
+    assert S % chunk == 0, (S, chunk)
+    n = S // chunk
+    pieces = prompt.reshape(B, n, chunk).transpose(1, 0, 2)
+    bases = jnp.arange(n, dtype=jnp.int32) * chunk
+
+    def body(carry, inp):
+        cache, last_x = carry
+        toks, base = inp
+        x, cache = _paged_chunk_hidden(
+            cfg, params, cache, toks,
+            jnp.full((B,), base, jnp.int32), table)
+        # a row's last real position may land in any chunk: harvest its
+        # hidden state where (lengths-1) falls inside this window
+        idx = jnp.clip(lengths - 1 - base, 0, chunk - 1)
+        row = jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        inside = (lengths - 1 >= base) & (lengths - 1 < base + chunk)
+        last_x = jnp.where(inside[:, None], row.astype(last_x.dtype),
+                           last_x)
+        return (cache, last_x), None
+
+    last0 = jnp.zeros((B, cfg.d_model), jnp.bfloat16)
+    (cache, last_x), _ = jax.lax.scan(body, (cache, last0),
+                                      (pieces, bases))
+    return cache, head_logits(params, last_x[:, None])[:, 0]
+
+
 def paged_greedy_decode(cfg: ModelConfig, params, prompt, table, *,
                         steps: int, total_pages: int, page_size: int,
                         lengths=None, cache_dtype: str = "bf16",
+                        prefill_chunk: int | None = None,
                         interpret: bool = False):
     """Greedy decode ``steps`` tokens with all KV in pages.
 
@@ -577,14 +627,24 @@ def paged_greedy_decode(cfg: ModelConfig, params, prompt, table, *,
         lengths = jnp.full((B,), S, jnp.int32)
     lengths = lengths.astype(jnp.int32)
     cache = init_paged_cache(cfg, total_pages, ps, cache_dtype)
-    ks, vs, xs = _prefill_kv(cfg, params, prompt)
-    cache = scatter_prefill(cache, ks, vs, table)
-    # last REAL position's logits (padding never attends backward-only
-    # causality keeps real rows exact; ragged rows pick their own last)
-    last = head_logits(
-        params, jnp.take_along_axis(
-            xs, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1))
-    token0 = jnp.argmax(last[:, 0], axis=-1).astype(jnp.int32)
+    if prefill_chunk:
+        # pad the prompt again so the chunk tiles it exactly
+        pc = (-prompt.shape[1]) % prefill_chunk
+        if pc:
+            prompt = jnp.pad(prompt, ((0, 0), (0, pc)))
+        cache, last_row = paged_chunked_prefill(
+            cfg, params, cache, prompt, lengths, table, prefill_chunk)
+        token0 = jnp.argmax(last_row, axis=-1).astype(jnp.int32)
+    else:
+        ks, vs, xs = _prefill_kv(cfg, params, prompt)
+        cache = scatter_prefill(cache, ks, vs, table)
+        # last REAL position's logits (padding never attends —
+        # causality keeps real rows exact; ragged rows pick their own)
+        last = head_logits(
+            params, jnp.take_along_axis(
+                xs, (lengths - 1)[:, None, None].astype(jnp.int32),
+                axis=1))
+        token0 = jnp.argmax(last[:, 0], axis=-1).astype(jnp.int32)
 
     def step(carry, _):
         cache, token, lens = carry
