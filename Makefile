@@ -16,8 +16,11 @@ native:
 # cache is the other half of the fix) — and the suite runs ~5x faster
 # warm.  Falls back to a single process when xdist is unavailable.
 test: native
-	$(PYTHON) -m pytest tests/ -q -n 2 || \
-	  $(PYTHON) -m pytest tests/ -q
+	if $(PYTHON) -c "import xdist" 2>/dev/null; then \
+	  $(PYTHON) -m pytest tests/ -q -n 2; \
+	else \
+	  $(PYTHON) -m pytest tests/ -q; \
+	fi
 
 bench: native
 	$(PYTHON) bench.py

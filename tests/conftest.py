@@ -38,7 +38,8 @@ try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
 except ImportError:
     pass
 
@@ -64,3 +65,17 @@ def _resource_log(request):
     with open(path, "a") as f:
         f.write(f"{nfds}\t{threading.active_count()}\t"
                 f"{request.node.nodeid}\n")
+
+
+@_pytest.fixture
+def short_tmp():
+    """Short /tmp dir for unix-socket tests: pytest tmp paths (plus
+    xdist's popen-gwN segment) overflow the ~107-char AF_UNIX limit.
+    Cleans up even when fixture setup after it raises."""
+    import shutil
+    import tempfile
+    d = tempfile.mkdtemp(prefix="st-", dir="/tmp")
+    try:
+        yield d
+    finally:
+        shutil.rmtree(d, ignore_errors=True)

@@ -1,0 +1,166 @@
+"""Seeded input fuzzing for the untrusted-input decoders.
+
+The reference ships no fuzzing (SURVEY §5: "no fuzzing, no sanitizers
+beyond -race"); these decoders sit on the driver's untrusted surface
+(opaque claim configs arrive from arbitrary cluster users via the API
+server, checkpoints from disk), so this suite goes beyond parity:
+thousands of seeded random and mutated inputs against the contract that
+ONLY the documented error type ever escapes —
+
+- ``api.decoder.decode``:    clean result or ``ConfigError``
+- ``api.quantity.parse_quantity``: int or ``ValueError``
+- ``plugins.tpu.checkpoint`` load: state or ``CorruptCheckpoint``
+
+A KeyError/TypeError/AttributeError leak is a crash in the kubelet
+plugin's prepare path — exactly what fuzzing exists to find.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+
+import pytest
+
+from tpu_dra.api.configs import ConfigError
+from tpu_dra.api.decoder import decode, registered_kinds
+from tpu_dra.api.quantity import parse_quantity
+
+SEED = 20260731
+N = 1500
+
+
+def _rand_scalar(rng):
+    return rng.choice([
+        None, True, False, rng.randint(-2**40, 2**40),
+        rng.random() * 1e9, float("nan"), float("inf"),
+        "".join(rng.choices(string.printable, k=rng.randrange(0, 12))),
+        "", "0", "-1", "1Ei", "\x00", "𝕌𝕟𝕚", b"bytes-are-not-json",
+    ])
+
+
+def _rand_value(rng, depth=0):
+    if depth > 3 or rng.random() < 0.55:
+        return _rand_scalar(rng)
+    if rng.random() < 0.5:
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randrange(0, 4))]
+    return {str(_rand_scalar(rng))[:16]: _rand_value(rng, depth + 1)
+            for _ in range(rng.randrange(0, 5))}
+
+
+VALID_TEMPLATES = [
+    {"apiVersion": "resource.tpu.google.com/v1beta1", "kind": k}
+    for k in []  # filled at import below
+]
+
+
+def _mutate(rng, obj):
+    """Start from a valid-shaped config and break one thing."""
+    obj = json.loads(json.dumps(obj))
+    roll = rng.random()
+    if roll < 0.25 and obj:
+        obj.pop(rng.choice(sorted(obj)))                 # drop a field
+    elif roll < 0.5:
+        obj[rng.choice(["kind", "apiVersion",
+                        "x" + str(rng.randrange(99))])] = \
+            _rand_scalar(rng)                            # retype/rename
+    elif roll < 0.75:
+        obj[str(_rand_scalar(rng))[:20]] = _rand_value(rng)  # inject
+    else:
+        k = rng.choice(sorted(obj)) if obj else "kind"
+        obj[k] = _rand_value(rng)                        # deep garbage
+    return obj
+
+
+def test_decoder_only_raises_config_error():
+    rng = random.Random(SEED)
+    kinds = registered_kinds()
+    assert kinds, "registry must not be empty"
+    templates = [{"apiVersion": "resource.tpu.google.com/v1beta1",
+                  "kind": k} for k in kinds]
+    ok = bad = 0
+    for i in range(N):
+        if rng.random() < 0.5:
+            raw = _rand_value(rng)
+        else:
+            raw = _mutate(rng, rng.choice(templates))
+        try:
+            if rng.random() < 0.2:
+                try:
+                    raw = json.dumps(raw)
+                except (TypeError, ValueError):
+                    raw = str(raw)
+            decode(raw)
+            ok += 1
+        except ConfigError:
+            bad += 1
+        # ANY other exception escapes the contract and fails the test
+    assert ok + bad == N
+    assert bad > N // 2          # the generator is genuinely hostile
+
+
+def test_quantity_only_raises_value_error():
+    rng = random.Random(SEED + 1)
+    ok = bad = 0
+    for _ in range(N):
+        v = rng.choice([
+            _rand_scalar(rng),
+            f"{rng.randint(-99, 10**12)}"
+            f"{rng.choice(['', 'Ki', 'Mi', 'Gi', 'Ti', 'K', 'M', 'G',
+                           'zz', 'i', ' Mi', 'Mi ', '-'])}",
+            rng.random() * rng.choice([1, -1, 1e30]),
+        ])
+        if isinstance(v, (bytes, type(None), list, dict)):
+            v = str(v)
+        try:
+            out = parse_quantity(v)
+            assert isinstance(out, int)
+            ok += 1
+        except ValueError:
+            bad += 1
+        except OverflowError:
+            # float('inf')/huge floats: int() overflow is a ValueError
+            # subclass contract violation — fail loudly
+            raise
+    assert ok + bad == N and bad > 0
+
+
+def test_checkpoint_loader_only_raises_corrupt(tmp_path):
+    from tpu_dra.plugins.tpu.checkpoint import Checkpoint, CorruptCheckpoint
+
+    rng = random.Random(SEED + 2)
+    path = tmp_path / "checkpoint.json"
+    # a valid baseline to mutate
+    ck = Checkpoint(str(path))
+    ck.data = {"preparedClaims": {}}
+    ck.save()
+    baseline = path.read_bytes()
+    survived = rejected = 0
+    for i in range(300):
+        roll = rng.random()
+        if roll < 0.3:
+            blob = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 200)))
+        elif roll < 0.6:
+            b = bytearray(baseline)
+            for _ in range(rng.randrange(1, 6)):
+                if b:
+                    b[rng.randrange(len(b))] = rng.randrange(256)
+            blob = bytes(b)
+        else:
+            try:
+                blob = json.dumps(_rand_value(rng)).encode()
+            except (TypeError, ValueError):
+                blob = b"{}"
+        path.write_bytes(blob)
+        ck2 = Checkpoint(str(path))
+        try:
+            ck2.load()
+            survived += 1
+        except CorruptCheckpoint:
+            rejected += 1
+        # any other exception type fails the test
+    assert survived + rejected == 300
+    assert rejected > 50         # mutations genuinely detected

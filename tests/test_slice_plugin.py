@@ -34,13 +34,11 @@ def wait_until(pred, timeout=10.0):
 
 
 @pytest.fixture
-def world(tmp_path):
+def world(tmp_path, short_tmp):
     # unix socket paths cap at ~107 chars; pytest tmp dirs (xdist adds a
     # popen-gwN segment) overflow that with the driver-name suffix, so
-    # sockets live under a short mkdtemp (same fix as test_multinode_e2e)
-    import shutil
-    import tempfile
-    sock_root = tempfile.mkdtemp(prefix="sp-", dir="/tmp")
+    # sockets live under the shared short_tmp fixture
+    sock_root = short_tmp
     kube = FakeKube()
     kube.create(NODES, {"metadata": {"name": NODE, "labels": {}}})
     ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
@@ -57,7 +55,6 @@ def world(tmp_path):
     drv.stop()
     ctrl.stop()
     kube.close_watchers()
-    shutil.rmtree(sock_root, ignore_errors=True)
 
 
 def make_domain(kube, name="dom", num_nodes=1):

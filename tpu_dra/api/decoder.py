@@ -52,6 +52,12 @@ def decode(raw: bytes | str | dict):
         raise ConfigError(
             f"unexpected apiVersion {api_version!r}; want {GROUP_VERSION!r}")
     kind = data.get("kind", "")
+    if not isinstance(kind, str):
+        # an unhashable kind (list/dict) would TypeError out of the
+        # registry lookup — this is untrusted user input (found by
+        # tests/test_fuzz_inputs.py)
+        raise ConfigError(f"config kind must be a string, got "
+                          f"{type(kind).__name__}")
     cls = _REGISTRY.get(kind)
     if cls is None:
         raise ConfigError(

@@ -7,6 +7,7 @@ equivalent: parse ``"16Gi"``-style strings to bytes and render back.
 
 from __future__ import annotations
 
+import math
 import re
 
 _SUFFIXES = {
@@ -30,6 +31,11 @@ def parse_quantity(value: str | int | float) -> int:
     if isinstance(value, bool):
         raise ValueError(f"invalid quantity: {value!r}")
     if isinstance(value, (int, float)):
+        if isinstance(value, float) and not math.isfinite(value):
+            # int(inf) leaks OverflowError (nan already ValueErrors);
+            # YAML happily produces .inf — untrusted input must stay
+            # inside the documented error type (tests/test_fuzz_inputs)
+            raise ValueError(f"non-finite quantity: {value!r}")
         if value < 0:
             raise ValueError(f"negative quantity: {value!r}")
         return int(value)

@@ -61,13 +61,37 @@ class Checkpoint:
                 envelope = json.load(f)
         except FileNotFoundError:
             return False
-        except json.JSONDecodeError as exc:
+        except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+            # a torn write can leave arbitrary bytes — non-UTF-8 content
+            # must read as corruption, not UnicodeDecodeError
             raise CorruptCheckpoint(f"{self.path}: {exc}") from exc
+        # torn/garbage files can hold ANY valid JSON — non-dict envelope,
+        # non-string data, non-dict payload all crashed with
+        # AttributeError/TypeError before (found by test_fuzz_inputs);
+        # corruption must always surface as CorruptCheckpoint
+        if not isinstance(envelope, dict):
+            raise CorruptCheckpoint(
+                f"{self.path}: envelope must be an object, got "
+                f"{type(envelope).__name__}")
         data = envelope.get("data", "")
+        if not isinstance(data, str):
+            raise CorruptCheckpoint(
+                f"{self.path}: data must be a string, got "
+                f"{type(data).__name__}")
         if native.crc32c(data.encode()) != envelope.get("checksum"):
             raise CorruptCheckpoint(f"{self.path}: checksum mismatch")
-        payload = json.loads(data)
+        try:
+            payload = json.loads(data)
+        except json.JSONDecodeError as exc:
+            raise CorruptCheckpoint(f"{self.path}: {exc}") from exc
+        if not isinstance(payload, dict):
+            raise CorruptCheckpoint(
+                f"{self.path}: payload must be an object, got "
+                f"{type(payload).__name__}")
         version = payload.get("version", "")
+        if not isinstance(version, str):
+            raise CorruptCheckpoint(
+                f"{self.path}: version must be a string")
         migrated = False
         if version != self.VERSION:
             migrate = self.migrations.get(version)
