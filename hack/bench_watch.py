@@ -53,17 +53,32 @@ def _log(msg: str) -> None:
 
 
 def probe() -> bool:
+    # Popen + process-group kill, NOT subprocess.run(capture_output=...):
+    # run() only kills the direct child on timeout, and a jax backend
+    # probe forks helpers that inherit the stdout pipe — communicate()
+    # then blocks on pipe EOF long past the timeout (observed: one probe
+    # hung ~2h on a dead tunnel).
+    import signal
+    proc = subprocess.Popen(
+        [sys.executable, "-c", PROBE_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, start_new_session=True)
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-            cwd=REPO)
+        out, _ = proc.communicate(timeout=PROBE_TIMEOUT_S)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
         _log("down probe-timeout")
         return False
-    up = out.returncode == 0 and out.stdout.strip().startswith("tpu")
-    _log(f"up {out.stdout.strip()}" if up
-         else f"down rc={out.returncode} {out.stderr.strip()[-200:]}")
+    up = proc.returncode == 0 and out.strip().startswith("tpu")
+    _log(f"up {out.strip()}" if up
+         else f"down rc={proc.returncode} {out.strip()[-200:]}")
     return up
 
 
