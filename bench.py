@@ -1053,6 +1053,12 @@ def _merge_cached(out: dict, names: list[str],
         }
 
 
+def _uncached_first(names: list[str]) -> list[str]:
+    """Stable partition: sections without a cache file, then the rest."""
+    missing = [n for n in names if _cache_read(n) is None]
+    return missing + [n for n in names if n not in missing]
+
+
 def _run_section(name: str, deadline: float) -> dict:
     """Run one section in a subprocess; merge its last-stdout-line JSON."""
     t0 = time.perf_counter()
@@ -1114,6 +1120,12 @@ def run_tpu_sections() -> dict:
              "multiprocess"]
     if out.get("tpu_devices", 1) > 1:
         order.append("collectives")
+    # Capture-maximizing order: tunnel windows are short and die without
+    # warning (the r04 window lasted ~45 min and closed mid-run, leaving
+    # flash/decode/continuous uncaptured while already-cached matmuls
+    # re-measured first).  Sections with NO last-good cache entry run
+    # first; refreshing cached ones is the luxury of a long window.
+    order = _uncached_first(order)
     consecutive_timeouts = 0
     for name in order:
         deadline = min(_DEADLINES[name], max(budget_left(), 0))

@@ -133,3 +133,17 @@ def test_cache_write_is_atomic_and_parseable(monkeypatch, tmp_path):
         payload = json.load(f)
     assert payload["results"]["tpu_platform"] == "tpu"
     assert not [p for p in os.listdir(bench._CACHE_DIR) if ".tmp." in p]
+
+
+def test_uncached_sections_run_first(tmp_path, monkeypatch):
+    """Short tunnel windows must spend their time on sections with no
+    recorded hardware truth; cached ones re-measure only afterwards."""
+    import bench
+
+    monkeypatch.setattr(bench, "_CACHE_DIR", str(tmp_path))
+    names = ["a", "b", "c", "d"]
+    assert bench._uncached_first(names) == names     # nothing cached yet
+    for n in ("a", "c"):
+        (tmp_path / f"{n}.json").write_text(
+            '{"results": {"x": 1}, "ts": 1}')
+    assert bench._uncached_first(names) == ["b", "d", "a", "c"]
