@@ -1054,8 +1054,14 @@ def _merge_cached(out: dict, names: list[str],
 
 
 def _uncached_first(names: list[str]) -> list[str]:
-    """Stable partition: sections without a cache file, then the rest."""
-    missing = [n for n in names if _cache_read(n) is None]
+    """Sections without a cache file first — cheapest deadline leading —
+    then the cached rest in their original order.  The cheap-first sort
+    keeps a canary property: if the tunnel wedges right after the probe,
+    the first timeouts burn small deadlines (flash 330s, not
+    continuous 720s) before run_tpu_sections' consecutive-timeout clamp
+    engages, preserving budget for the retry pass."""
+    missing = sorted((n for n in names if _cache_read(n) is None),
+                     key=lambda n: _DEADLINES.get(n, 600))
     return missing + [n for n in names if n not in missing]
 
 

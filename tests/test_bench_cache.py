@@ -141,9 +141,13 @@ def test_uncached_sections_run_first(tmp_path, monkeypatch):
     import bench
 
     monkeypatch.setattr(bench, "_CACHE_DIR", str(tmp_path))
-    names = ["a", "b", "c", "d"]
-    assert bench._uncached_first(names) == names     # nothing cached yet
-    for n in ("a", "c"):
+    names = ["continuous", "flash", "decode", "matmul"]
+    # nothing cached: all uncached, ordered cheapest deadline first so
+    # a wedged tunnel burns small timeouts before the fail-fast clamp
+    assert bench._uncached_first(names) == [
+        "matmul", "flash", "decode", "continuous"]
+    for n in ("flash", "matmul"):
         (tmp_path / f"{n}.json").write_text(
             '{"results": {"x": 1}, "ts": 1}')
-    assert bench._uncached_first(names) == ["b", "d", "a", "c"]
+    assert bench._uncached_first(names) == [
+        "decode", "continuous", "flash", "matmul"]
