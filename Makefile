@@ -59,6 +59,7 @@ STRESS_RUNS ?= 5
 stress:
 	for i in $$(seq 1 $(STRESS_RUNS)); do \
 	  echo "stress run $$i/$(STRESS_RUNS)"; \
+	  SOAK_SEED=$$((20260731 + $$i)) \
 	  $(PYTHON) -m pytest tests/test_stress_concurrency.py tests/test_racecheck.py \
 	    tests/test_soak.py tests/test_informer.py tests/test_workqueue.py -q -x || exit 1; \
 	done
