@@ -321,6 +321,81 @@ def test_resident_prefix_pages_fail_oversized_request_fast():
         eng.shutdown()
 
 
+def test_prefix_join_head_over_ceiling_fails_not_stalls():
+    """A HEAD request that joins a prefix and needs more OWN pages than
+    total - resident can ever free must fail at the gate.  The joined
+    prefix's shared pages are resident too — they are shared, never
+    allocatable — so they must NOT inflate the ceiling (a ceiling of
+    total - resident + len(shared) admits need in
+    (total-resident, total-resident+shared] into a permanent stall)."""
+    eng = paged_engine(slots=2, total_pages=4)
+    try:
+        pid = eng.register_prefix(list(range(50, 66)))  # 2 resident pages
+        pages = list(eng._prefixes[pid].pages)
+        # plen 16 + prompt 8 + steps 16 = 40 tokens -> 5 pages; 2 shared
+        # -> need 3 own.  Submit precheck passes (3 <= total 4) but only
+        # total - resident = 2 can ever be free: must fail fast, and
+        # with the old +len(shared) ceiling (4) it would stall forever.
+        h = eng.submit_async([1] * 8, 16, prefix_id=pid)
+        assert h.done.wait(120)
+        assert h.error and "resident prefixes" in h.error
+        # the gate's shared refs were released: registry ref only
+        with eng._pool_mu:
+            assert all(eng.pool._refs[p] == 1 for p in pages)
+        # the queue behind the dead head still serves
+        assert len(eng.submit([1, 2], 3, timeout=300)) == 3
+    finally:
+        eng.shutdown()
+
+
+def test_paged_prefix_evict_reregister_race_fails_request():
+    """Evict + re-register of the same prefix id between the admission
+    gate and the join must FAIL the request: the slot's table was built
+    from the gate snapshot's page ids, while a join against the new
+    registry object would scatter content into different pages — the
+    slot would attend never-written ids (silently wrong output)."""
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.continuous import _Request
+
+    prefix = list(range(50, 66))                        # 2 pages of 8
+    eng = paged_engine(slots=2, total_pages=10)
+    try:
+        pid = eng.register_prefix(prefix)
+        old = eng._prefixes[pid]
+        # -- replay the admission gate for slot 0 by hand ----------------
+        shared, need, gate_pref = eng._paged_requirements(
+            2, 4, pid, take_refs=True)
+        assert gate_pref is old and shared == list(old.pages)
+        with eng._pool_mu:
+            own = eng.pool.alloc(need)
+        slot = 0
+        eng._page_ids[slot] = own
+        eng._shared_ids[slot] = list(shared)
+        eng._table = eng._table.at[slot].set(jnp.asarray(
+            eng.pool.table_row(shared + own, eng._mp)))
+        req = _Request(prompt=[1, 2], steps=4, eos_id=None,
+                       temperature=0.0, seed=0, prefix_id=pid,
+                       gate_prefix=gate_pref)
+        eng._requests[slot] = req
+        # -- the race: evict, then re-register the same tokens -----------
+        with eng._cv:
+            evicted = eng._prefixes.pop(pid)
+        eng._evict_prefix_pages(evicted)
+        assert eng.register_prefix(prefix) == pid
+        assert eng._prefixes[pid] is not old
+        # -- join must refuse the swapped object --------------------------
+        eng._admit_prefix(slot, req)
+        assert req.done.is_set()
+        assert req.error and "evicted" in req.error
+        assert eng._requests[slot] is None
+        # slot refs rolled back; only the NEW registration stays resident
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"] - 2
+    finally:
+        eng.shutdown()
+
+
 # -------------------------------------------------------------------------
 # int8 paged pages
 # -------------------------------------------------------------------------

@@ -74,6 +74,11 @@ class _Request:
     temperature: float
     seed: int
     prefix_id: Optional[str] = None   # registered shared-KV prefix
+    # paged admissions: the _Prefix object the gate priced and ref'd —
+    # _admit_prefix refuses to join any OTHER object under the same id
+    # (evict + re-register between gate and join swaps the registry
+    # entry while the slot's table still holds the old page ids)
+    gate_prefix: Optional["_Prefix"] = None
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     submitted: float = field(default_factory=time.perf_counter)
@@ -780,8 +785,8 @@ class ContinuousEngine:
                 raise ValueError("speculative engine does not support "
                                  "prefix joins")
         if self.kv_layout == "paged":
-            _, need = self._paged_requirements(len(prompt), steps,
-                                               prefix_id)
+            _, need, _ = self._paged_requirements(len(prompt), steps,
+                                                  prefix_id)
             if need > self.pool.total_pages:
                 # an unservable request must fail HERE: the FIFO admission
                 # gate would otherwise wait on it forever and starve
@@ -893,16 +898,19 @@ class ContinuousEngine:
                 # zero-copy prefix pages it shares), stop admitting —
                 # later smaller requests must not starve it
                 req = self._pending[0]
-                shared, need = self._paged_requirements(
+                shared, need, gate_pref = self._paged_requirements(
                     len(req.prompt), req.steps, req.prefix_id,
                     take_refs=True)
-                # pages held resident by OTHER prefixes can never free
-                # without an eviction; a head request whose own-page need
+                # pages held resident by prefixes can never free without
+                # an eviction, and own pages only ever come from the
+                # non-resident remainder (the joined prefix's shared
+                # pages are resident too — they are shared, not
+                # allocatable); a head request whose own-page need
                 # exceeds what could ever be free must fail now, not
                 # starve the queue waiting for it (submit's total_pages
                 # precheck cannot see future registrations)
                 ceiling = (self.pool.total_pages
-                           - self._resident_prefix_pages() + len(shared))
+                           - self._resident_prefix_pages())
                 if need > ceiling:
                     with self._pool_mu:
                         if shared:
@@ -925,6 +933,7 @@ class ContinuousEngine:
                     break
                 self._page_ids[slot] = own
                 self._shared_ids[slot] = list(shared)
+                req.gate_prefix = gate_pref
                 self._table = self._table.at[slot].set(jnp.asarray(
                     self.pool.table_row(shared + own, self._mp)))
             req = self._pending.popleft()
@@ -954,7 +963,8 @@ class ContinuousEngine:
 
     def _paged_requirements(self, prompt_len: int, steps: int,
                             prefix_id, *, take_refs: bool = False):
-        """(shared prefix pages, own pages needed) for one admission.
+        """(shared prefix pages, own pages needed, prefix snapshot) for
+        one admission.
 
         ``take_refs=True`` (the admission gate) acquires the references
         ATOMICALLY with reading ``pref.pages`` — both under ``_cv``, with
@@ -962,9 +972,14 @@ class ContinuousEngine:
         concurrent eviction can neither free the pages out from under the
         ref nor hand them to another request first.  Callers that take
         refs own releasing them (``pool.free``) on every non-admission
-        path."""
+        path.  The returned ``_Prefix`` snapshot pins WHICH registry
+        object the gate priced: ``_admit_prefix`` must see the very same
+        object at join time, or the slot's table (built from this
+        snapshot's page ids) would disagree with a re-registered
+        prefix's pages."""
         shared: list[int] = []
         plen = 0
+        pref = None
         with self._cv:
             if prefix_id is not None:
                 pref = self._prefixes.get(prefix_id)
@@ -981,7 +996,7 @@ class ContinuousEngine:
         slack = self.chunk if self.draft is not None else 0
         need = self.pool.pages_for(
             plen + prompt_len + steps + slack) - len(shared)
-        return shared, need
+        return shared, need, pref
 
     def _resident_prefix_pages(self) -> int:
         """Pages the prefix registry keeps resident (under ``_cv``)."""
@@ -1052,14 +1067,26 @@ class ContinuousEngine:
         with self._cv:
             pref = self._prefixes.get(req.prefix_id)
             if pref is not None and self.kv_layout == "paged":
-                # snapshot + claim the one-time content write while the
-                # registry entry is pinned by _cv: a concurrent eviction
-                # after this block can null pref.pages, but our copy (and
-                # the slot's refs from the admission gate) keep the ids
-                # valid, and pages_written flips exactly once
-                if pref.pages and not pref.pages_written:
-                    pref.pages_written = True
-                    write_pages = list(pref.pages)
+                if (pref is not req.gate_prefix
+                        or list(pref.pages or ())
+                        != self._shared_ids[slot]):
+                    # evict + re-register raced between the admission
+                    # gate and this join: the registry now holds a NEW
+                    # _Prefix whose pages are not the ones the slot's
+                    # table was built from — a join would scatter content
+                    # into the new pages while the slot attends the old
+                    # (never-written) ids.  Fail like the evicted path.
+                    pref = None
+                else:
+                    # snapshot + claim the one-time content write while
+                    # the registry entry is pinned by _cv: a concurrent
+                    # eviction after this block can null pref.pages, but
+                    # our copy (and the slot's refs from the admission
+                    # gate) keep the ids valid, and pages_written flips
+                    # exactly once
+                    if pref.pages and not pref.pages_written:
+                        pref.pages_written = True
+                        write_pages = list(pref.pages)
         if pref is None:
             if self.kv_layout == "paged":
                 # roll back the admission gate's allocation for this slot
