@@ -508,7 +508,12 @@ def test_multiprocess_slot_enforcement(tmp_path):
     held_before = len(launcher._HELD_SLOTS)
 
     def as_new_process():
+        # a new process has neither the in-module pool cache nor the
+        # shim-interop env marker (its pid would differ); the marker
+        # lives in the env mapping the launcher was called with
         launcher._ACQUIRED_POOLS.clear()
+        env.pop("TPU_DRA_SLOTS_HELD", None)
+        _os.environ.pop("TPU_DRA_SLOTS_HELD", None)
 
     try:
         as_new_process()
@@ -525,6 +530,7 @@ def test_multiprocess_slot_enforcement(tmp_path):
             _os.close(fd)
         del launcher._HELD_SLOTS[held_before:]
         launcher._ACQUIRED_POOLS.clear()
+        _os.environ.pop("TPU_DRA_SLOTS_HELD", None)
 
     # kernel releases a crashed holder's lock: after closing, a new
     # process can take slot 0 again

@@ -1,0 +1,262 @@
+"""Driver-injected, tenant-independent sharing enforcement shim.
+
+This file is copied VERBATIM into ``<plugin_dir>/shim/sitecustomize.py``
+by the kubelet plugin (``plugins/tpu/shim.py write_shim_dir``) and
+CDI-mounted read-only into every container of a MultiProcess-capped
+claim, with ``PYTHONPATH`` pointing at the mount.  CPython's ``site``
+module imports ``sitecustomize`` at interpreter startup — BEFORE any
+user code, hence before libtpu can initialize — so the driver's resource
+contract is applied to any Python entrypoint even when the workload
+never imports ``tpu_dra`` (the cooperative ``workloads/launcher.py``
+path).  This is the enforcement analog of the reference's MPS control
+daemon, which caps clients daemon-side with no tenant cooperation
+(reference cmd/gpu-kubelet-plugin/sharing.go:186-289).
+
+MUST stay stdlib-only and import-light: it runs in the TENANT's image,
+which does not have tpu_dra installed, and it runs for every python
+process in the container (pip, health probes, ...), so the startup path
+only touches ``os.environ``; the slot gate and renice fire lazily via a
+``sys.meta_path`` hook the first time the process imports a
+chip-touching stack (``jax``/``jaxlib``/``torch_xla``/``libtpu``) —
+an innocent helper subprocess never consumes a slot.
+
+Enforcement semantics on slot exhaustion: ``SystemExit`` (site.py only
+swallows ``Exception`` from sitecustomize, so SystemExit terminates the
+interpreter) — a process beyond ``maxProcesses`` dies before its first
+jax import completes instead of silently oversubscribing the chip.
+
+When imported under its package name (tests), nothing executes: the
+bottom guard fires only when the module is loaded AS ``sitecustomize``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# one slot per PROCESS touching the chip: fork children re-acquire (their
+# pid differs), same-process re-entry (the cooperative launcher running
+# after this shim) is deduplicated through this env marker.  The marker
+# is a CLAIM, not proof: exec keeps the pid, and although the lock fds
+# are made inheritable so they survive exec, a hardened entrypoint may
+# closefrom() them — so every marker hit is re-verified against the
+# kernel's actual lock state (_verify_held) before it is trusted.
+_MARKER_ENV = "TPU_DRA_SLOTS_HELD"
+_HELD_FDS: list[int] = []
+
+
+def _verify_held(pool_dir: str, slot: int) -> bool:
+    """Does THIS process really hold ``slot-<slot>.lock``?  True iff the
+    lock is held by someone (a fresh-fd flock conflicts — flock locks
+    conflict across fds even within one process) AND the holder wrote
+    our pid into the file (only the acquirer writes it, under the
+    lock)."""
+    import fcntl
+    path = os.path.join(pool_dir, f"slot-{slot}.lock")
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        return False
+    try:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            pid = os.read(fd, 64).decode(errors="replace").strip()
+            return pid == str(os.getpid())
+        fcntl.flock(fd, fcntl.LOCK_UN)   # nobody holds it: marker stale
+        return False
+    finally:
+        os.close(fd)
+
+
+def _parse_marker(env) -> dict[str, int]:
+    """{pool realpath: slot} verifiably held by THIS process.  A pid
+    mismatch (fork child, exec'd stranger) or a failed lock-state check
+    (exec'd entrypoint that closed the inherited fds) drops the entry —
+    the caller then re-acquires honestly."""
+    raw = env.get(_MARKER_ENV, "")
+    if not raw:
+        return {}
+    parts = raw.split(";")
+    if not parts or parts[0] != f"pid={os.getpid()}":
+        return {}
+    held = {}
+    for part in parts[1:]:
+        pool, _, slot = part.rpartition("=")
+        if pool and slot.isdigit() and _verify_held(pool, int(slot)):
+            held[pool] = int(slot)
+    return held
+
+
+def _write_marker(env, held: dict[str, int]) -> None:
+    env[_MARKER_ENV] = ";".join(
+        [f"pid={os.getpid()}"] + [f"{p}={s}" for p, s in sorted(held.items())])
+
+
+def apply_hbm_limit(env) -> "int | None":
+    """Append ``--xla_tpu_max_hbm_size_mib`` to ``LIBTPU_INIT_ARGS`` from
+    the driver's ``TPU_HBM_LIMIT_BYTES_<minor>`` budgets, scoped to the
+    visible chips; an explicit pre-existing user flag wins.  Same
+    contract as ``workloads/launcher.py apply_hbm_limits`` (the
+    cooperative twin — tests pin the parity)."""
+    import re
+    limits = {}
+    for key, val in list(env.items()):
+        m = re.match(r"^TPU_HBM_LIMIT_BYTES_(\d+)$", key)
+        if m:
+            try:
+                limits[int(m.group(1))] = int(val)
+            except ValueError:
+                return None     # malformed: enforcement stays env-level
+    if not limits:
+        return None
+    visible = env.get("TPU_VISIBLE_CHIPS") or env.get("TPU_VISIBLE_DEVICES")
+    scoped = list(limits.values())
+    if visible:
+        minors = [int(v) for v in visible.split(",")
+                  if v.strip().lstrip("-").isdigit()]
+        if minors:
+            scoped = [limits[mn] for mn in minors if mn in limits]
+    if not scoped:
+        return None
+    existing = env.get("LIBTPU_INIT_ARGS", "")
+    if "--xla_tpu_max_hbm_size_mib" in existing:
+        return None
+    limit_bytes = min(scoped)
+    mib = max(limit_bytes // (1 << 20), 1)
+    env["LIBTPU_INIT_ARGS"] = \
+        f"{existing} --xla_tpu_max_hbm_size_mib={mib}".strip()
+    return limit_bytes
+
+
+def _acquire_in_pool(pool_dir: str, fallback_max: int,
+                     held: dict[str, int]) -> None:
+    import fcntl
+    key = os.path.realpath(pool_dir)
+    if key in held:
+        return
+    try:
+        with open(os.path.join(pool_dir, "max")) as f:
+            max_procs = int(f.read().strip())
+    except (OSError, ValueError):
+        max_procs = fallback_max
+    for slot in range(max_procs):
+        try:
+            fd = os.open(os.path.join(pool_dir, f"slot-{slot}.lock"),
+                         os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            continue
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            continue
+        os.ftruncate(fd, 0)
+        os.write(fd, f"{os.getpid()}\n".encode())
+        # inheritable: the lock must survive an entrypoint's os.exec*()
+        # (Python fds are CLOEXEC by default, PEP 446 — exec would
+        # silently release the slot while the env marker kept claiming
+        # it, letting maxProcesses+1 processes onto the chip)
+        os.set_inheritable(fd, True)
+        _HELD_FDS.append(fd)    # lock lives with the process (crash-safe)
+        held[key] = slot
+        return
+    raise SystemExit(
+        f"tpu-dra: all {max_procs} process slots of pool {pool_dir!r} "
+        f"are held (maxProcesses={max_procs}); refusing to oversubscribe "
+        f"the chip")
+
+
+def acquire_slots(env) -> "dict[str, int] | None":
+    """Hold one flock slot in every pool under
+    ``TPU_MULTIPROCESS_SLOT_DIR``; SystemExit when a pool is full."""
+    base = env.get("TPU_MULTIPROCESS_SLOT_DIR", "")
+    if not base or not os.path.isdir(base):
+        return None
+    fallback_max = int(env.get("TPU_MULTIPROCESS_MAX", "1") or "1")
+    held = _parse_marker(env)
+    if os.path.exists(os.path.join(base, "max")):
+        _acquire_in_pool(base, fallback_max, held)
+    for name in sorted(os.listdir(base)):
+        pool = os.path.join(base, name)
+        if os.path.isdir(pool) and os.path.exists(
+                os.path.join(pool, "max")):
+            _acquire_in_pool(pool, fallback_max, held)
+    if held:
+        _write_marker(env, held)
+    return held or None
+
+
+def apply_priority(env) -> None:
+    delta = {"Low": 10, "Normal": 0, "High": -5}.get(
+        env.get("TPU_PROCESS_PRIORITY", ""))
+    if delta:
+        try:
+            os.nice(delta)
+        except OSError:
+            pass                # High needs CAP_SYS_NICE; hint, not fatal
+
+
+# modules whose import means "this process is about to touch the chip";
+# override (colon-separated) for non-default stacks via the driver env
+_DEFAULT_TRIGGERS = "jax:jaxlib:torch_xla:libtpu"
+
+
+class _ChipGateFinder:
+    """``sys.meta_path`` hook: on the first import of a trigger module,
+    enforce the slot gate + priority, then step aside (find_spec returns
+    None so the normal import machinery proceeds)."""
+
+    def __init__(self, triggers: "set[str]") -> None:
+        self.triggers = triggers
+        self._fired = False
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not self._fired and fullname.split(".")[0] in self.triggers:
+            self._fired = True
+            try:
+                sys.meta_path.remove(self)
+            except ValueError:
+                pass
+            acquire_slots(os.environ)    # SystemExit on exhaustion
+            apply_priority(os.environ)
+        return None
+
+
+def install(env=None) -> None:
+    env = os.environ if env is None else env
+    try:
+        apply_hbm_limit(env)
+    except Exception:                    # noqa: BLE001 — never brick python
+        pass
+    if env.get("TPU_MULTIPROCESS_SLOT_DIR") or env.get(
+            "TPU_PROCESS_PRIORITY"):
+        triggers = set(filter(None, env.get(
+            "TPU_DRA_SHIM_TRIGGERS", _DEFAULT_TRIGGERS).split(":")))
+        sys.meta_path.insert(0, _ChipGateFinder(triggers))
+
+
+def _chain_shadowed_sitecustomize() -> None:
+    """The image may ship its own sitecustomize that this mount shadows
+    (PYTHONPATH precedes site-packages): import the next one on the path
+    so tenant startup hooks still run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    saved = list(sys.path)
+    try:
+        sys.path = [p for p in sys.path
+                    if os.path.abspath(p or ".") != here]
+        import importlib
+        spec = importlib.machinery.PathFinder.find_spec(
+            "sitecustomize", sys.path)
+        if spec and spec.loader:
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+    except Exception:                    # noqa: BLE001 — tenant hook bugs
+        pass                             # must not break the interpreter
+    finally:
+        sys.path = saved
+
+
+if __name__ == "sitecustomize":         # only when running AS the shim
+    install()
+    _chain_shadowed_sitecustomize()

@@ -45,6 +45,7 @@ from typing import Optional
 from tpu_dra.api.configs import ConfigError, TpuSharing
 from tpu_dra.cdi.spec import ContainerEdits
 from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP, AllocatableDevice
+from tpu_dra.plugins.tpu.shim import SHIM_CONTAINER_PATH, write_shim_dir
 from tpu_dra.util.fsutil import atomic_write
 
 # container-side base path of the per-claim-group slot dirs (the
@@ -151,6 +152,22 @@ class MultiProcessManager:
             # the flag when absent.
             edits.env.update(hbm_defense_env(
                 {minor_of[u]: lim for u, lim in limits.items()}))
+        if self.slots_root and ("TPU_MULTIPROCESS_SLOT_DIR" in edits.env
+                                or mp.hbm_limit_per_process
+                                or mp.scheduling_priority != "Default"):
+            # tenant-independent enforcement: mount the sitecustomize
+            # shim read-only and point PYTHONPATH at it — any Python
+            # entrypoint then applies the slot gate / HBM bound /
+            # priority before libtpu init, without importing tpu_dra
+            # (shim.py; the MPS-daemon-side-cap analog).  A pod-spec
+            # PYTHONPATH is shadowed by this CDI value on most runtimes;
+            # the shim chain-loads any sitecustomize it shadows, and the
+            # residual (non-Python tenants, stripped env) is documented
+            # in PARITY.md.
+            shim_dir = write_shim_dir(self.slots_root)
+            edits.add_mount(shim_dir, SHIM_CONTAINER_PATH,
+                            options=["ro", "nosuid", "nodev", "bind"])
+            edits.env["PYTHONPATH"] = SHIM_CONTAINER_PATH
         return edits
 
     def _slots_base(self) -> str:

@@ -1,0 +1,49 @@
+"""Host-side management of the tenant-independent enforcement shim.
+
+``write_shim_dir`` materializes ``_shim_sitecustomize.py`` (see its
+docstring for the mechanism) as ``<plugin_dir>/shim/sitecustomize.py``;
+``MultiProcessManager.apply`` mounts that directory read-only into every
+container of a capped claim and points ``PYTHONPATH`` at it, so the
+sharing contract is enforced for any Python entrypoint with zero tenant
+cooperation — the daemon-side-cap analog of the reference MPS control
+daemon (cmd/gpu-kubelet-plugin/sharing.go:186-289).
+
+Residual threat model (documented in PARITY.md): non-Python tenants and
+images that strip ``PYTHONPATH`` still fall back to the CDI-injected
+``LIBTPU_INIT_ARGS`` HBM bound (read by libtpu itself) plus the
+cooperative launcher contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpu_dra.util.fsutil import atomic_write
+
+# container-side mount point of the shim dir; PYTHONPATH points here
+SHIM_CONTAINER_PATH = "/var/run/tpu-dra/shim"
+
+
+def _shim_source() -> str:
+    src_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "_shim_sitecustomize.py")
+    with open(src_path, encoding="utf-8") as f:
+        return f.read()
+
+
+def write_shim_dir(plugin_dir: str) -> str:
+    """Write (idempotently) the shim dir under ``plugin_dir``; returns
+    the host path to mount.  Atomic write: a container must never see a
+    torn ``sitecustomize.py``."""
+    shim_dir = os.path.join(plugin_dir, "shim")
+    os.makedirs(shim_dir, exist_ok=True)
+    target = os.path.join(shim_dir, "sitecustomize.py")
+    src = _shim_source()
+    try:
+        with open(target, encoding="utf-8") as f:
+            if f.read() == src:
+                return shim_dir          # current already
+    except OSError:
+        pass
+    atomic_write(target, src, durable=False)
+    return shim_dir

@@ -154,11 +154,27 @@ _HELD_SLOTS: list[int] = []
 _ACQUIRED_POOLS: dict[str, int] = {}   # abs pool path -> slot index
 
 
-def _acquire_in_pool(pool_dir: str, fallback_max: int) -> int:
+def _acquire_in_pool(pool_dir: str, fallback_max: int,
+                     env=None) -> int:
     import fcntl
+
+    # interop with the driver-injected sitecustomize shim (the
+    # non-cooperative enforcement twin, plugins/tpu/_shim_sitecustomize):
+    # if THIS process already holds a slot through the shim's import
+    # hook, honor its (lock-state-verified) marker instead of flocking a
+    # second slot — flock conflicts across fds even within one process,
+    # so a blind re-acquire would consume two of maxProcesses for one
+    # process.  Marker I/O stays in the caller's env mapping: a private
+    # env dict never leaks into os.environ.
+    from tpu_dra.plugins.tpu import _shim_sitecustomize as _shim
+    e = os.environ if env is None else env
     key = os.path.realpath(pool_dir)
     if key in _ACQUIRED_POOLS:
         return _ACQUIRED_POOLS[key]
+    marker = _shim._parse_marker(e)
+    if key in marker:
+        _ACQUIRED_POOLS[key] = marker[key]
+        return marker[key]
     try:
         with open(os.path.join(pool_dir, "max")) as f:
             max_procs = int(f.read().strip())
@@ -174,8 +190,14 @@ def _acquire_in_pool(pool_dir: str, fallback_max: int) -> int:
             continue
         os.ftruncate(fd, 0)   # clear a crashed holder's longer pid
         os.write(fd, f"{os.getpid()}\n".encode())
+        os.set_inheritable(fd, True)   # hold must survive os.exec*()
         _HELD_SLOTS.append(fd)   # keep open: lock lives with the process
         _ACQUIRED_POOLS[key] = slot
+        # record for the shim (reverse interop: launcher first, then a
+        # late jax import fires the shim's hook — it must see the hold)
+        marker = _shim._parse_marker(e)
+        marker[key] = slot
+        _shim._write_marker(e, marker)
         return slot
     raise RuntimeError(
         f"all {max_procs} process slots of pool {pool_dir!r} are held "
@@ -208,12 +230,12 @@ def acquire_multiprocess_slot(env: Optional[dict[str, str]] = None
     fallback_max = int(e.get("TPU_MULTIPROCESS_MAX", "1"))
     acquired: dict[str, int] = {}
     if os.path.exists(os.path.join(base, "max")):
-        acquired[""] = _acquire_in_pool(base, fallback_max)
+        acquired[""] = _acquire_in_pool(base, fallback_max, e)
     for name in sorted(os.listdir(base)):
         pool = os.path.join(base, name)
         if os.path.isdir(pool) and os.path.exists(
                 os.path.join(pool, "max")):
-            acquired[name] = _acquire_in_pool(pool, fallback_max)
+            acquired[name] = _acquire_in_pool(pool, fallback_max, e)
     return acquired or None
 
 
