@@ -46,6 +46,8 @@ _DEADLINES = {
     "continuous": 720,
     # plain + spec-ceiling paged engines: two compile sets
     "paged": 720,
+    # distill (~150 steps) + plain/spec/paged-spec engine compile sets
+    "spec_real": 720,
     "visibility": 300,
     "multiprocess": 300,
     "collectives": 300,
@@ -707,6 +709,121 @@ def section_paged() -> dict:
     return out
 
 
+def section_spec_real() -> dict:
+    """REAL-draft speculative serving (VERDICT r04 missing #4): truncate
+    the flagship to quarter depth, distill it on-device against the
+    target's logits (workloads/spec_draft.py), then serve the same mixed
+    load through the plain engine and the speculative engine — accept
+    rate and end-to-end speedup are the numbers that decide whether the
+    subsystem earns its complexity (``*_spec_ceiling_*`` is only the
+    draft==target upper bound).  The random-init teacher is the hardest
+    case: its argmax is a max-entropy function, so the recorded accept
+    rate is a FLOOR on what a trained checkpoint would see."""
+    import jax
+
+    from tpu_dra.workloads.continuous import ContinuousEngine
+    from tpu_dra.workloads.quant import quantize_params_int8
+    from tpu_dra.workloads.spec_draft import make_draft
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = ModelConfig(vocab=32768, d_model=1024, n_heads=8,
+                          n_kv_heads=2, n_layers=8, d_ff=4096,
+                          max_seq=1024, pos_emb="rope")
+        fparams = init_params(cfg, jax.random.PRNGKey(0))
+        slots, chunk, n_req = 16, 8, 32
+        lengths = [16, 32, 64, 128]
+        steps = [32, 64, 96, 128]
+        distill = dict(n_layers=2, distill_steps=150, batch=16, seq=256)
+    else:
+        cfg = ModelConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                          d_ff=128, max_seq=64, pos_emb="rope")
+        fparams = init_params(cfg, jax.random.PRNGKey(0))
+        slots, chunk, n_req = 4, 4, 6
+        lengths = [2, 4, 8]
+        steps = [4, 8]
+        distill = dict(n_layers=1, distill_steps=120, batch=8, seq=32)
+
+    t0 = time.perf_counter()
+    dcfg, dfloat = make_draft(cfg, fparams, **distill)
+    distill_secs = time.perf_counter() - t0
+    # serve in the headline configuration: int8 weights for BOTH models
+    # (distill in float, quantize after — gradients need float)
+    if on_tpu:
+        params = quantize_params_int8(fparams)
+        dparams = quantize_params_int8(dfloat)
+    else:
+        params, dparams = fparams, dfloat
+    out = {
+        "spec_real_draft_layers": dcfg.n_layers,
+        "spec_real_target_layers": cfg.n_layers,
+        "spec_real_distill_steps": distill["distill_steps"],
+        "spec_real_distill_secs": round(distill_secs, 1),
+    }
+    reqs = [([7 + i % 100] * lengths[i % len(lengths)],
+             steps[i % len(steps)]) for i in range(n_req)]
+
+    def run_load(eng) -> tuple[float, int, dict]:
+        for ln in lengths:                    # warm every prompt bucket
+            eng.submit([1] * ln, steps=chunk, timeout=600)
+        eng.reset_stats()
+        t0 = time.perf_counter()
+        handles = [eng.submit_async(p, s) for p, s in reqs]
+        for h in handles:
+            if not h.done.wait(600):
+                raise TimeoutError("request not done within 600s")
+            if h.error:
+                raise RuntimeError(h.error)
+        secs = time.perf_counter() - t0
+        return secs, sum(len(h.tokens) for h in handles), eng.stats()
+
+    plain_tps = None
+    try:
+        eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk)
+        try:
+            secs, toks, _ = run_load(eng)
+        finally:
+            eng.shutdown()
+        plain_tps = round(toks / secs, 1)
+        out["spec_real_plain_tokens_per_s"] = plain_tps
+    except Exception as exc:  # noqa: BLE001 — keep what's measured
+        out["spec_real_errors"] = repr(exc)[:200]
+    try:
+        eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                               draft=(dcfg, dparams))
+        try:
+            secs, toks, st = run_load(eng)
+        finally:
+            eng.shutdown()
+        out["spec_real_tokens_per_s"] = round(toks / secs, 1)
+        out["spec_real_accept_rate"] = st.get("spec_accept_rate")
+        out["spec_real_tokens_per_pass"] = st.get("spec_tokens_per_pass")
+        if plain_tps:
+            out["spec_real_speedup_pct"] = round(
+                100.0 * (out["spec_real_tokens_per_s"] / plain_tps - 1), 1)
+    except Exception as exc:  # noqa: BLE001
+        out["spec_real_errors"] = repr(exc)[:200]
+    # same draft over PAGES (the paged engine's block tables are shared
+    # by target and draft) — fenced like everything above
+    try:
+        ps = 64 if on_tpu else 8
+        eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
+                               kv_layout="paged", page_size=ps,
+                               total_pages=(320 if on_tpu else 40),
+                               draft=(dcfg, dparams))
+        try:
+            secs, toks, st = run_load(eng)
+        finally:
+            eng.shutdown()
+        out["paged_spec_real_tokens_per_s"] = round(toks / secs, 1)
+        out["paged_spec_real_accept_rate"] = st.get("spec_accept_rate")
+    except Exception as exc:  # noqa: BLE001
+        out["paged_spec_real_errors"] = repr(exc)[:200]
+    return out
+
+
 def section_visibility() -> dict:
     """Hardware validation of the CDI visibility env contract (VERDICT
     next-round item 3): launch a subprocess with the env the driver would
@@ -880,6 +997,7 @@ _SECTIONS = {
     "decode_long": section_decode_long,
     "continuous": section_continuous,
     "paged": section_paged,
+    "spec_real": section_spec_real,
     "visibility": section_visibility,
     "multiprocess": section_multiprocess,
     "collectives": section_collectives,
@@ -1122,6 +1240,7 @@ def run_tpu_sections() -> dict:
              "decode_long",
              "continuous",
              "paged",
+             "spec_real",
              "visibility",
              "multiprocess"]
     if out.get("tpu_devices", 1) > 1:

@@ -1,0 +1,121 @@
+"""Real-draft speculative decoding (workloads/spec_draft.py).
+
+VERDICT r04 "What's missing" #4: speculation had only a draft==target
+ceiling number.  These tests pin the three properties that make a real
+draft a measurable subsystem: (a) output parity with the plain engine
+under greedy acceptance for a REAL (truncated+distilled) draft, in both
+slab and paged layouts; (b) the engine's accept-rate accounting; (c)
+distillation actually lifts acceptance over the zero-training
+truncation — the draft earns its extra forwards.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from tpu_dra.workloads.continuous import ContinuousEngine
+from tpu_dra.workloads.spec_draft import (distill_draft, make_draft,
+                                          measure_accept_rate,
+                                          truncate_draft)
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+CFG = ModelConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                  d_ff=128, max_seq=64)
+_P0 = init_params(CFG, jax.random.PRNGKey(0))
+PARAMS = dict(_P0, embed=_P0["embed"] * 4.0)   # spread logit gaps (see
+                                               # test_continuous_paged.py)
+PROMPTS = [[3, 5, 7], [2, 4], [11, 12, 13], [9] * 6]
+
+
+@pytest.fixture(scope="module")
+def drafts():
+    """One truncated and one distilled draft, shared across the module
+    (distillation is the expensive part)."""
+    dcfg, trunc = truncate_draft(CFG, PARAMS, 1)
+    distilled = distill_draft(CFG, PARAMS, dcfg, trunc,
+                              steps=300, batch=8, seq=32)
+    return dcfg, trunc, distilled
+
+
+def test_truncate_shapes_and_validation():
+    dcfg, dparams = truncate_draft(CFG, PARAMS, 1)
+    assert dcfg.n_layers == 1 and CFG.n_layers == 2
+    for leaf in dparams["blocks"].values():
+        assert leaf.shape[0] == 1
+    # embedding/head/final norm shared with the target (same objects)
+    assert dparams["embed"] is PARAMS["embed"]
+    assert dparams["ln_f"] is PARAMS["ln_f"]
+    with pytest.raises(ValueError, match="draft depth"):
+        truncate_draft(CFG, PARAMS, 0)
+    with pytest.raises(ValueError, match="draft depth"):
+        truncate_draft(CFG, PARAMS, 3)
+
+
+def test_real_draft_parity_with_plain_engine(drafts):
+    """The greedy-acceptance contract: a REAL draft changes speed, never
+    tokens — byte-identical to the plain engine."""
+    dcfg, _, distilled = drafts
+    plain = ContinuousEngine(CFG, PARAMS, slots=4, chunk=4, max_len=40)
+    try:
+        want = [plain.submit(p, 12, timeout=300) for p in PROMPTS]
+    finally:
+        plain.shutdown()
+    spec = ContinuousEngine(CFG, PARAMS, slots=4, chunk=4, max_len=40,
+                            draft=(dcfg, distilled))
+    try:
+        got = [spec.submit(p, 12, timeout=300) for p in PROMPTS]
+        st = spec.stats()
+    finally:
+        spec.shutdown()
+    assert got == want
+    assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    assert st["spec_tokens_per_pass"] >= 1.0   # bonus token guarantees it
+
+
+def test_real_draft_parity_paged(drafts):
+    """Same parity through the paged speculative engine (draft shares
+    the target's block tables)."""
+    dcfg, _, distilled = drafts
+    plain = ContinuousEngine(CFG, PARAMS, slots=4, chunk=4, max_len=40)
+    try:
+        want = [plain.submit(p, 10, timeout=300) for p in PROMPTS]
+    finally:
+        plain.shutdown()
+    spec = ContinuousEngine(CFG, PARAMS, slots=4, chunk=4, max_len=40,
+                            kv_layout="paged", page_size=8,
+                            draft=(dcfg, distilled))
+    try:
+        got = [spec.submit(p, 10, timeout=300) for p in PROMPTS]
+        st = spec.stats()
+    finally:
+        spec.shutdown()
+    assert got == want
+    assert 0.0 <= st["spec_accept_rate"] <= 1.0
+
+
+def test_distillation_lifts_accept_rate(drafts):
+    """The reason to distill: acceptance must beat the zero-training
+    truncation by a clear margin (fixed seeds — deterministic).  The
+    random-init teacher here is the WORST case (its argmax is a
+    max-entropy function); a trained teacher is strictly easier to
+    imitate."""
+    dcfg, trunc, distilled = drafts
+    r_trunc = measure_accept_rate(CFG, PARAMS, dcfg, trunc,
+                                  prompts=PROMPTS, steps=24,
+                                  max_len=40, chunk=4)
+    r_dist = measure_accept_rate(CFG, PARAMS, dcfg, distilled,
+                                 prompts=PROMPTS, steps=24,
+                                 max_len=40, chunk=4)
+    assert r_dist["outputs"] == r_trunc["outputs"]   # parity again
+    assert r_dist["accept_rate"] >= r_trunc["accept_rate"] + 0.05
+    assert r_dist["accept_rate"] >= 0.25
+    assert r_dist["tokens_per_pass"] > r_trunc["tokens_per_pass"]
+
+
+def test_make_draft_one_call():
+    dcfg, dparams = make_draft(CFG, PARAMS, distill_steps=20,
+                               batch=4, seq=16)
+    assert dcfg.n_layers == 1                        # quarter depth, min 1
+    for leaf in dparams["blocks"].values():
+        assert leaf.shape[0] == 1

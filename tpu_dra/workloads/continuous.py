@@ -180,6 +180,8 @@ class ContinuousEngine:
             self.target_passes = 0
             self.spec_committed = 0
             self.spec_slot_passes = 0
+            self.spec_drafted_proposed = 0
+            self.spec_drafted_accepted = 0
         if kv_layout == "paged":
             from tpu_dra.workloads.paged_kv import (PagePool,
                                                     init_paged_cache)
@@ -834,6 +836,8 @@ class ContinuousEngine:
             self.target_passes = 0
             self.spec_committed = 0
             self.spec_slot_passes = 0
+            self.spec_drafted_proposed = 0
+            self.spec_drafted_accepted = 0
 
     def stats(self) -> dict:
         lat = sorted(self.latencies_s)
@@ -850,6 +854,12 @@ class ContinuousEngine:
             out["spec_target_passes"] = self.target_passes
             out["spec_tokens_per_pass"] = round(
                 self.spec_committed / max(1, self.spec_slot_passes), 3)
+            # fraction of DRAFTED tokens the target accepted — the one
+            # number that says whether the draft earns its k-1 extra
+            # forwards (1.0 = ceiling/draft==target; ~1/vocab = random)
+            out["spec_accept_rate"] = round(
+                self.spec_drafted_accepted
+                / max(1, self.spec_drafted_proposed), 4)
         if lat:
             out["latency_p50_ms"] = round(
                 1e3 * lat[len(lat) // 2], 3)
@@ -1214,6 +1224,12 @@ class ContinuousEngine:
                         if r is not None]
                 self.spec_committed += sum(c for c, _ in live)
                 self.spec_slot_passes += len(live)
+                # accept-rate observables: each live slot-pass proposes
+                # chunk-1 drafted tokens and commits counts-1 of them
+                # (the +1 is the target's bonus, not the draft's credit)
+                active = [c for c, _ in live if c > 0]
+                self.spec_drafted_proposed += (self.chunk - 1) * len(active)
+                self.spec_drafted_accepted += sum(c - 1 for c in active)
             elif self.kv_layout == "paged":
                 (self._cache, self._token, self._pos, self._done,
                  self._keys, toks) = self._step_fn(
