@@ -139,8 +139,14 @@ def main():
             res = prepare(req, timeout=10)
             assert res.claims[uid].error == "", res.claims[uid].error
             # containerd stand-in: resolve + validate the CDI claim spec
+            # against the schema containerd's CDI cache enforces
+            # (cdi/validate.py) — a quarantined spec means the claim
+            # fails at container create despite a clean DRA flow
+            from tpu_dra.cdi.validate import validate_spec_file
             spec_files = list((tmp / "cdi").glob(f"*{uid}*"))
             assert spec_files, f"no claim CDI spec for {uid}"
+            schema_errs = validate_spec_file(str(spec_files[0]))
+            assert not schema_errs, schema_errs
             spec = json.load(open(spec_files[0]))
             env = {e.split("=", 1)[0]
                    for d in spec["devices"]

@@ -1,0 +1,194 @@
+"""CDI schema contract (cdi/validate.py) — the containerd hop, pinned.
+
+The kubelet→containerd CDI application is the one SURVEY §3.2 hop this
+environment cannot run (no docker/kind); containerd validates every
+spec with the CNCF container-device-interface library and quarantines
+failures.  These tests run that validation (re-implemented, strict)
+over every spec the driver actually writes — base + claim specs from
+the REAL tpu DeviceState prepare paths (plain, MultiProcess-capped,
+sub-chip core) and the slice plugin's channel/daemon specs from the
+real codependent-prepare flow — so the untested hop shrinks to
+containerd's own code.  Matching reference behavior: the kind cluster's
+whole purpose is containerd `enable_cdi` acceptance
+(/root/reference/demo/clusters/kind/scripts/kind-cluster-config.yaml:17-66).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpu_dra.cdi.validate import validate_spec, validate_spec_file
+
+from test_device_state import UID, make_claim, make_state, opaque
+
+
+def _assert_valid_file(path):
+    errs = validate_spec_file(path)
+    assert not errs, f"{path}: {errs}"
+
+
+def _all_specs(cdi_root: str) -> list[str]:
+    return [os.path.join(cdi_root, f) for f in os.listdir(cdi_root)
+            if f.endswith(".json")]
+
+
+def test_base_and_plain_claim_specs_validate(tmp_path):
+    state = make_state(tmp_path)
+    state.prepare(make_claim())
+    specs = _all_specs(str(tmp_path / "cdi"))
+    assert len(specs) == 2                    # base + claim
+    for p in specs:
+        _assert_valid_file(p)
+
+
+def test_multiprocess_claim_spec_validates(tmp_path):
+    """The richest edit surface: sharing env + slot-pool mount + shim
+    mount + PYTHONPATH + HBM defense flag must all be schema-clean."""
+    from tpu_dra.api.configs import GROUP_VERSION
+
+    state = make_state(tmp_path)
+    state.prepare(make_claim(configs=[opaque({
+        "apiVersion": GROUP_VERSION, "kind": "TpuConfig",
+        "sharing": {"strategy": "MultiProcess",
+                    "multiProcess": {"maxProcesses": 4,
+                                     "schedulingPriority": "Low",
+                                     "hbmLimitPerProcess": {"*": "4Gi"}}},
+    })]))
+    spec = json.load(open(state.cdi.claim_spec_path(UID)))
+    assert not validate_spec(spec), validate_spec(spec)
+    mounts = spec["devices"][0]["containerEdits"]["mounts"]
+    assert any(m["containerPath"] == "/var/run/tpu-dra/shim"
+               for m in mounts)               # the shim really is there
+
+
+def test_core_subslice_claim_spec_validates(tmp_path):
+    state = make_state(tmp_path, family="v4")  # v4 has 2 cores/chip
+    core = [d for d in state.allocatable.values()
+            if d.type == "core"][0]
+    state.prepare(make_claim(devices=(core.canonical_name(),)))
+    for p in _all_specs(str(tmp_path / "cdi")):
+        _assert_valid_file(p)
+
+
+def test_slice_channel_and_daemon_specs_validate(tmp_path, short_tmp):
+    """Drive the real slice plugin through the §3.3 codependent flow and
+    validate the channel + daemon claim specs it writes."""
+    import threading
+    import time
+
+    from tpu_dra.controller.controller import Controller, ControllerConfig
+    from tpu_dra.k8s import DAEMONSETS, NODES
+    from tpu_dra.k8s.fake import FakeKube
+    from tpu_dra.plugins.slice.driver import (SliceDriver,
+                                              SliceDriverConfig)
+
+    from test_slice_plugin import (NODE, _exists, ds_name, make_domain,
+                                   slice_claim, wait_until)
+
+    kube = FakeKube()
+    kube.create(NODES, {"metadata": {"name": NODE, "labels": {}}})
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    drv = SliceDriver(SliceDriverConfig(
+        node_name=NODE, kube=kube,
+        plugins_dir=os.path.join(short_tmp, "plugins"),
+        registry_dir=os.path.join(short_tmp, "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=2.0, retry_timeout=8.0))
+    drv.start()
+    try:
+        uid = make_domain(kube)["metadata"]["uid"]
+        assert wait_until(lambda: drv.manager.get_by_uid(uid) is not None)
+        results = {}
+        t = threading.Thread(target=lambda: results.update(
+            drv.prepare_resource_claims([slice_claim(
+                "chan-claim", "channel-0", "SliceChannelConfig", uid)])))
+        t.start()
+        drv.prepare_resource_claims([
+            slice_claim("daemon-claim", "slice-daemon",
+                        "SliceDaemonConfig", uid,
+                        namespace="tpu-dra-driver")])
+        assert wait_until(lambda: _exists(
+            kube, DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+        ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+        ds["status"] = {"numberReady": 1}
+        kube.update_status(DAEMONSETS, ds)
+        t.join(timeout=15)
+        assert results["chan-claim"].error == ""
+        for p in _all_specs(str(tmp_path / "cdi")):
+            _assert_valid_file(p)
+    finally:
+        drv.stop()
+        ctrl.stop()
+        kube.close_watchers()
+
+
+# -- validator negative space (what containerd would reject) ---------------
+
+
+def _minimal():
+    return {"cdiVersion": "0.6.0", "kind": "google.com/tpu",
+            "devices": [{"name": "tpu-0", "containerEdits": {}}]}
+
+
+def test_validator_rejects_unknown_version():
+    bad = _minimal() | {"cdiVersion": "0.9.0"}
+    assert any("cdiVersion" in e for e in validate_spec(bad))
+
+
+def test_validator_rejects_bad_kind():
+    for kind in ("notadomain/tpu", "google.com", "google.com/",
+                 "google.com/tpu.core"):
+        bad = _minimal() | {"kind": kind}
+        assert any("kind" in e for e in validate_spec(bad)), kind
+
+
+def test_validator_rejects_bad_devices():
+    assert any("non-empty" in e
+               for e in validate_spec(_minimal() | {"devices": []}))
+    dup = _minimal()
+    dup["devices"] = [{"name": "a", "containerEdits": {}},
+                      {"name": "a", "containerEdits": {}}]
+    assert any("duplicate" in e for e in validate_spec(dup))
+    bad = _minimal()
+    bad["devices"] = [{"name": "-bad", "containerEdits": {}}]
+    assert any("invalid device name" in e for e in validate_spec(bad))
+
+
+def test_validator_rejects_bad_edits():
+    bad = _minimal()
+    bad["devices"][0]["containerEdits"] = {"env": ["NOEQUALS"]}
+    assert any("NAME=value" in e for e in validate_spec(bad))
+    bad["devices"][0]["containerEdits"] = {
+        "deviceNodes": [{"path": "relative/accel0"}]}
+    assert any("absolute" in e for e in validate_spec(bad))
+    bad["devices"][0]["containerEdits"] = {
+        "mounts": [{"hostPath": "/x"}]}       # containerPath missing
+    assert any("containerPath" in e for e in validate_spec(bad))
+    bad["devices"][0]["containerEdits"] = {
+        "deviceNodes": [{"path": "/dev/accel0", "permissions": "rwx"}]}
+    assert any("rwm" in e for e in validate_spec(bad))
+
+
+def test_validator_enforces_feature_min_versions():
+    bad = _minimal() | {"cdiVersion": "0.4.0"}
+    bad["devices"][0]["containerEdits"] = {
+        "deviceNodes": [{"path": "/dev/accel0",
+                         "hostPath": "/real/dev/accel0"}]}
+    assert any("0.5.0" in e for e in validate_spec(bad))
+    ok = _minimal()
+    ok["devices"][0]["containerEdits"] = {
+        "deviceNodes": [{"path": "/dev/accel0",
+                         "hostPath": "/real/dev/accel0"}]}
+    assert not validate_spec(ok)
+
+
+def test_validator_rejects_unknown_fields():
+    bad = _minimal() | {"futureField": 1}
+    assert any("unknown top-level" in e for e in validate_spec(bad))
+    bad = _minimal()
+    bad["devices"][0]["containerEdits"] = {"futureEdit": []}
+    assert any("unknown containerEdits" in e for e in validate_spec(bad))

@@ -1,0 +1,172 @@
+"""CDI spec schema validation — the containerd contract, in-process.
+
+The one hop of the SURVEY §3.2 path this environment cannot exercise is
+kubelet → containerd applying our CDI specs (no docker/kind here;
+`E2E_KIND_r03.json` records the honest `ran: false`).  containerd does
+not apply a spec it cannot validate: its CDI cache parses every file
+under /etc/cdi + /var/run/cdi with the CNCF container-device-interface
+library, and a parse/validation error quarantines the spec — the claim
+then fails at container create, after the DRA flow already reported
+success.  This module re-implements that library's validation rules
+(reference behavior: containerd vendoring of
+tags.cncf.io/container-device-interface pkg/cdi — version table,
+vendor/class/device-name grammars, containerEdits field checks, and
+feature→minimum-version gating) so every spec the driver writes is
+proven containerd-acceptable at test time and in the e2e harness,
+shrinking the untested hop to containerd's own code.
+
+Kept dependency-free and strict: unknown top-level or edit fields are
+errors (forward-compat fields would silently no-op in older containerd,
+which is exactly the class of bug this guards)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+# versions the CDI library in current containerd/CRI-O releases accepts
+# (spec.go validSpecVersions); 0.7.0+ exists upstream but is NOT safe to
+# emit while GKE node runtimes pin older vendored copies
+KNOWN_VERSIONS = ("0.3.0", "0.4.0", "0.5.0", "0.6.0")
+
+# feature → minimum cdiVersion (MinimumRequiredVersion in version.go):
+# emitting a field the declared version predates makes older parsers
+# reject or drop it
+_MIN_VERSION = {
+    "deviceNodes.hostPath": "0.5.0",
+    "annotations": "0.6.0",
+    "mounts.type": "0.4.0",
+}
+
+_VENDOR_RE = re.compile(r"^[A-Za-z][A-Za-z0-9._-]*[A-Za-z0-9]$")
+_CLASS_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]*[A-Za-z0-9]$")
+_DEVNAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.:-]*$")
+_HOOKS = frozenset((
+    "prestart", "createRuntime", "createContainer", "startContainer",
+    "poststart", "poststop"))
+
+
+def _ver(v: str) -> tuple[int, ...]:
+    return tuple(int(x) for x in v.split("."))
+
+
+def _check_edits(edits: Any, where: str, version: str,
+                 errs: list[str]) -> None:
+    if not isinstance(edits, dict):
+        errs.append(f"{where}: containerEdits must be an object")
+        return
+    unknown = set(edits) - {"env", "deviceNodes", "mounts", "hooks",
+                            "intelRdt", "additionalGIDs"}
+    if unknown:
+        errs.append(f"{where}: unknown containerEdits fields {sorted(unknown)}")
+    for i, e in enumerate(edits.get("env") or []):
+        if not isinstance(e, str) or "=" not in e or e.startswith("="):
+            errs.append(f"{where}.env[{i}]: must be 'NAME=value', got {e!r}")
+    for i, node in enumerate(edits.get("deviceNodes") or []):
+        w = f"{where}.deviceNodes[{i}]"
+        if not isinstance(node, dict):
+            errs.append(f"{w}: must be an object")
+            continue
+        path = node.get("path")
+        if not isinstance(path, str) or not path.startswith("/"):
+            errs.append(f"{w}: path must be absolute, got {path!r}")
+        if "hostPath" in node:
+            if _ver(version) < _ver(_MIN_VERSION["deviceNodes.hostPath"]):
+                errs.append(f"{w}: hostPath needs cdiVersion >= "
+                            f"{_MIN_VERSION['deviceNodes.hostPath']}")
+            if not str(node["hostPath"]).startswith("/"):
+                errs.append(f"{w}: hostPath must be absolute")
+        if node.get("type") not in (None, "b", "c", "u", "p"):
+            errs.append(f"{w}: type must be one of b/c/u/p")
+        perm = node.get("permissions")
+        if perm is not None and (not isinstance(perm, str)
+                                 or set(perm) - set("rwm")):
+            errs.append(f"{w}: permissions must be a subset of 'rwm'")
+        for fld in ("major", "minor", "uid", "gid"):
+            if fld in node and not isinstance(node[fld], int):
+                errs.append(f"{w}: {fld} must be an integer")
+    for i, m in enumerate(edits.get("mounts") or []):
+        w = f"{where}.mounts[{i}]"
+        if not isinstance(m, dict):
+            errs.append(f"{w}: must be an object")
+            continue
+        for fld in ("hostPath", "containerPath"):
+            v = m.get(fld)
+            if not isinstance(v, str) or not v.startswith("/"):
+                errs.append(f"{w}: {fld} must be absolute, got {v!r}")
+        if "type" in m and _ver(version) < _ver(_MIN_VERSION["mounts.type"]):
+            errs.append(f"{w}: mount type needs cdiVersion >= "
+                        f"{_MIN_VERSION['mounts.type']}")
+        opts = m.get("options")
+        if opts is not None and (not isinstance(opts, list) or any(
+                not isinstance(o, str) for o in opts)):
+            errs.append(f"{w}: options must be a list of strings")
+    for i, h in enumerate(edits.get("hooks") or []):
+        w = f"{where}.hooks[{i}]"
+        if not isinstance(h, dict) or h.get("hookName") not in _HOOKS:
+            errs.append(f"{w}: hookName must be one of {sorted(_HOOKS)}")
+            continue
+        if not str(h.get("path", "")).startswith("/"):
+            errs.append(f"{w}: hook path must be absolute")
+
+
+def validate_spec(spec: Any) -> list[str]:
+    """Validation errors for one CDI spec dict ([] = containerd would
+    accept it).  Mirrors pkg/cdi Spec.validate()."""
+    errs: list[str] = []
+    if not isinstance(spec, dict):
+        return ["spec must be a JSON object"]
+    unknown = set(spec) - {"cdiVersion", "kind", "devices",
+                           "containerEdits", "annotations"}
+    if unknown:
+        errs.append(f"unknown top-level fields {sorted(unknown)}")
+    version = spec.get("cdiVersion")
+    if version not in KNOWN_VERSIONS:
+        errs.append(f"cdiVersion {version!r} not in {KNOWN_VERSIONS}")
+        return errs                      # nothing else is checkable
+    kind = spec.get("kind", "")
+    vendor, sep, cls = str(kind).partition("/")
+    if not sep or not _VENDOR_RE.match(vendor) or "." not in vendor \
+            or not _CLASS_RE.match(cls):
+        errs.append(f"kind {kind!r} must be '<vendor-domain>/<class>'")
+    if "annotations" in spec and _ver(version) < _ver(
+            _MIN_VERSION["annotations"]):
+        errs.append("annotations need cdiVersion >= 0.6.0")
+    devices = spec.get("devices")
+    if not isinstance(devices, list) or not devices:
+        errs.append("devices must be a non-empty list")
+        devices = []
+    seen: set[str] = set()
+    for i, dev in enumerate(devices):
+        w = f"devices[{i}]"
+        if not isinstance(dev, dict):
+            errs.append(f"{w}: must be an object")
+            continue
+        name = dev.get("name")
+        if not isinstance(name, str) or not _DEVNAME_RE.match(name):
+            errs.append(f"{w}: invalid device name {name!r}")
+        elif name in seen:
+            errs.append(f"{w}: duplicate device name {name!r}")
+        else:
+            seen.add(name)
+        if "containerEdits" not in dev:
+            errs.append(f"{w}: containerEdits required")
+        else:
+            _check_edits(dev["containerEdits"], w, version, errs)
+        extra = set(dev) - {"name", "containerEdits", "annotations"}
+        if extra:
+            errs.append(f"{w}: unknown fields {sorted(extra)}")
+    if "containerEdits" in spec:
+        _check_edits(spec["containerEdits"], "containerEdits",
+                     version, errs)
+    return errs
+
+
+def validate_spec_file(path: str) -> list[str]:
+    import json
+    try:
+        with open(path, encoding="utf-8") as f:
+            spec = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [f"unreadable/unparsable spec {path}: {exc}"]
+    return validate_spec(spec)
