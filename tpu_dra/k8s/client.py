@@ -40,11 +40,24 @@ class Conflict(ApiError):
         super().__init__(409, message)
 
 
+class Gone(ApiError):
+    """410 Expired: the requested resourceVersion was compacted away.
+    The API server answers a too-old watch/list with this (etcd keeps a
+    bounded history); the ONLY recovery is a fresh list from "" —
+    informers must distinguish it from transient failures, which resume
+    the watch from the last seen RV (client-go reflector semantics)."""
+
+    def __init__(self, message: str = ""):
+        super().__init__(410, message)
+
+
 def error_for(status: int, message: str = "") -> ApiError:
     if status == 404:
         return NotFound(message)
     if status == 409:
         return Conflict(message)
+    if status == 410:
+        return Gone(message)
     return ApiError(status, message)
 
 
@@ -332,7 +345,17 @@ class RestKubeClient(KubeClient):
                 except json.JSONDecodeError:
                     klog.warning("watch: undecodable line", res=res.plural)
                     continue
-                yield event.get("type", ""), event.get("object", {})
+                ev_type = event.get("type", "")
+                obj = event.get("object", {})
+                if ev_type == "ERROR":
+                    # in-stream Status event — the API server's way of
+                    # failing an established watch (410 Expired when the
+                    # requested RV was compacted); surface it as the
+                    # typed exception so informers can pick relist vs
+                    # resume
+                    raise error_for(int(obj.get("code") or 500),
+                                    obj.get("message", ""))
+                yield ev_type, obj
         finally:
             resp.close()
 

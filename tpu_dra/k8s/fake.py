@@ -94,6 +94,11 @@ class FakeKube(KubeClient):
         self._watchers: list[_Watcher] = []
         # bounded replay log: [(rv:int, type, obj)]
         self._log: list[tuple[int, str, ResourceDesc, dict]] = []
+        # highest rv dropped from the log: a watch resuming at or below
+        # it CANNOT be replayed faithfully and gets 410 Gone (etcd
+        # compaction semantics) — before this existed, the silent trim
+        # in _emit made such watchers silently miss events
+        self._compacted_rv = 0
 
     # -- internals ---------------------------------------------------------
     def _store(self, res: ResourceDesc) -> dict:
@@ -114,6 +119,7 @@ class FakeKube(KubeClient):
         self._log.append((int(obj["metadata"]["resourceVersion"]),
                           event_type, res, copy.deepcopy(obj)))
         if len(self._log) > 10000:
+            self._compacted_rv = max(self._compacted_rv, self._log[4999][0])
             del self._log[:5000]
         for w in list(self._watchers):
             if w.res.plural == res.plural and w.res.group == res.group and \
@@ -252,6 +258,10 @@ class FakeKube(KubeClient):
             replay = []
             if resource_version:
                 rv = int(resource_version)
+                if rv < self._compacted_rv:
+                    from tpu_dra.k8s.client import Gone
+                    raise Gone(f"too old resource version: {rv} "
+                               f"({self._compacted_rv})")
                 for ev_rv, ev_type, ev_res, ev_obj in self._log:
                     if ev_rv > rv and ev_res.plural == res.plural and \
                             ev_res.group == res.group and w.matches(ev_obj):
@@ -273,6 +283,25 @@ class FakeKube(KubeClient):
                     self._watchers.remove(w)
 
     # -- test helpers ------------------------------------------------------
+    def compact(self) -> int:
+        """Etcd-compaction injection: drop the replay log at the current
+        RV.  Watches resuming at or below the returned RV get 410 Gone;
+        live watchers are unaffected (they hold queues, not RVs)."""
+        with self._mu:
+            self._compacted_rv = self._rv
+            self._log.clear()
+            return self._compacted_rv
+
+    def emit_bookmark(self, res: ResourceDesc) -> None:
+        """Send a BOOKMARK carrying the current RV to matching watchers
+        (the API server does this periodically so idle watches can
+        resume past compaction)."""
+        with self._mu:
+            obj = {"metadata": {"resourceVersion": str(self._rv)}}
+            for w in list(self._watchers):
+                if w.res.plural == res.plural and w.res.group == res.group:
+                    w.queue.put(("BOOKMARK", copy.deepcopy(obj)))
+
     def close_watchers(self) -> None:
         with self._mu:
             for w in self._watchers:

@@ -113,11 +113,19 @@ class KubeTestServer:
                 raise ApiError(405, method)
 
             def _watch(self, res, ns, query) -> None:
+                from tpu_dra.k8s.client import Gone
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 stop = threading.Event()
+
+                def send_event(ev: dict) -> None:
+                    data = (json.dumps(ev) + "\n").encode()
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
                 try:
                     for ev_type, obj in outer.fake.watch(
                             res, namespace=ns,
@@ -125,12 +133,17 @@ class KubeTestServer:
                             field_selector=query.get("fieldSelector"),
                             resource_version=query.get("resourceVersion", ""),
                             stop=stop):
-                        line = json.dumps(
-                            {"type": ev_type, "object": obj}) + "\n"
-                        data = line.encode()
-                        self.wfile.write(
-                            f"{len(data):x}\r\n".encode() + data + b"\r\n")
-                        self.wfile.flush()
+                        send_event({"type": ev_type, "object": obj})
+                except Gone as exc:
+                    # the API server fails an expired watch IN-STREAM:
+                    # 200 + an ERROR event carrying a 410 Status object
+                    try:
+                        send_event({"type": "ERROR", "object": {
+                            "kind": "Status", "apiVersion": "v1",
+                            "status": "Failure", "reason": "Expired",
+                            "code": 410, "message": exc.message}})
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
                 except (BrokenPipeError, ConnectionResetError):
                     stop.set()
 
