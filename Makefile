@@ -19,7 +19,7 @@ test: native
 	if $(PYTHON) -c "import xdist" 2>/dev/null; then \
 	  $(PYTHON) -m pytest tests/ -q -n 2; \
 	else \
-	  $(PYTHON) -m pytest tests/ -q; \
+	  TPU_DRA_ALLOW_SINGLE_PROCESS=1 $(PYTHON) -m pytest tests/ -q; \
 	fi
 
 bench: native

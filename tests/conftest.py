@@ -51,6 +51,28 @@ except ImportError:
 import pytest as _pytest
 
 
+def pytest_collection_modifyitems(config, items):
+    """Guard the bare-pytest trap (VERDICT r04 weak #6): single-process
+    marathon runs of the whole suite crash this machine's jaxlib
+    nondeterministically (see the compile-cache note above) — a
+    contributor running plain ``pytest tests/`` gets a segfault, not a
+    skip.  Running a FILE or a few is fine; the full suite must go
+    through xdist (``make test`` / ``pytest -n 2``).  Override with
+    TPU_DRA_ALLOW_SINGLE_PROCESS=1 if you really mean it."""
+    if os.environ.get("PYTEST_XDIST_WORKER"):
+        return                       # already sharded
+    if config.getoption("numprocesses", default=None):
+        return                       # xdist controller process
+    if os.environ.get("TPU_DRA_ALLOW_SINGLE_PROCESS"):
+        return
+    if len(items) > 200:             # heuristic: "the whole suite"
+        raise _pytest.UsageError(
+            f"{len(items)} tests collected in ONE process: marathon "
+            "single-process runs crash jaxlib nondeterministically on "
+            "this machine. Run `make test` (pytest -n 2), or set "
+            "TPU_DRA_ALLOW_SINGLE_PROCESS=1 to proceed anyway.")
+
+
 @_pytest.fixture(autouse=True)
 def _resource_log(request):
     yield
