@@ -273,6 +273,33 @@ def section_train() -> dict:
         tokens_per_step / secs_c, 1)
     out["train_step_chunked_loss_finite"] = bool(np.isfinite(lossf))
     if on_tpu:
+        # ARMED EXPERIMENT (VERDICT r05 item 9): fused rmsnorm-matmul
+        # Pallas pair in the trunk (norm_impl="fused", custom VJP, remat
+        # policy saves the fused output).  Default stays XLA until this
+        # delta proves the kernel on hardware — fenced so a Mosaic
+        # failure can't cost the already-measured numbers.
+        try:
+            fstep, _, _ = make_sharded_train_step(
+                cfg, mesh, attn_impl=attn, norm_impl="fused")
+            fparams, loss = fstep(params, tokens)
+            lossf = float(loss)
+            secs_f = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fparams, loss = fstep(fparams, tokens)
+                lossf = float(loss)
+                secs_f = min(secs_f, (time.perf_counter() - t0) / iters)
+            out["train_step_fused_mfu_pct"] = _mfu(
+                flops / secs_f / 1e12, dev)
+            out["train_step_fused_tokens_per_s"] = round(
+                tokens_per_step / secs_f, 1)
+            out["train_step_fused_loss_finite"] = bool(np.isfinite(lossf))
+            out["train_step_fused_delta_pct"] = round(
+                100.0 * (secs / secs_f - 1.0), 1)
+        except Exception as exc:  # noqa: BLE001 — keep measured numbers
+            out["train_step_fused_error"] = repr(exc)[:200]
+    if on_tpu:
         # long-context training on one chip: S=4096 via the flash pair +
         # chunked-vocab head + selective remat (MFU counts param flops
         # only, like the headline — attention flops are a bonus on top)

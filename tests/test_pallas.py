@@ -308,3 +308,60 @@ def test_fused_rmsnorm_matmul_matches_reference():
            @ w.astype(jnp.float32)).astype(jnp.bfloat16)
     err = jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
     assert float(err) < 1.0
+
+
+def test_rmsnorm_matmul_train_vjp_matches_xla_grads():
+    """The differentiable fused norm-matmul (custom VJP): loss and all
+    three gradients must match the plain-XLA rmsnorm@matmul pair within
+    bf16 noise — this is what train.py's norm_impl="fused" rides on."""
+    import numpy as np
+
+    from tpu_dra.workloads.pallas_kernels import rmsnorm_matmul_train
+
+    def ref_loss(x, g, w):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        n = (xf * jax.lax.rsqrt(var + 1e-6) * g).astype(x.dtype)
+        return jnp.sum((n @ w).astype(jnp.float32) ** 2)
+
+    def fused_loss(x, g, w):
+        out = rmsnorm_matmul_train(x, g, w, True)    # interpret mode
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(ks[0], (32, 64), jnp.bfloat16)
+    g = jnp.abs(jax.random.normal(ks[1], (64,), jnp.float32)) + 0.5
+    w = (jax.random.normal(ks[2], (64, 128), jnp.float32) * 0.1
+         ).astype(jnp.bfloat16)
+    lr_, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(x, g, w)
+    lf, gf = jax.value_and_grad(fused_loss, argnums=(0, 1, 2))(x, g, w)
+    assert abs(float(lr_ - lf)) / max(abs(float(lr_)), 1e-6) < 1e-3
+    for name, a, b in zip("xgw", gr, gf):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        scale = np.abs(a).max() + 1e-6
+        assert float(np.abs(a - b).max() / scale) < 2e-2, name
+
+
+def test_train_step_fused_norm_matches_dense(tmp_path):
+    """A full train step with norm_impl="fused" must track the XLA pair:
+    same loss trajectory within bf16 noise (the bench's armed
+    train_step_fused_* arm measures only speed, never semantics)."""
+    from tpu_dra.workloads.train import (ModelConfig, init_params,
+                                         sgd_train_step)
+
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=128, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64,
+                                jnp.int32)
+    p1, l1 = sgd_train_step(cfg, 1e-2, params, tokens)
+    p2, l2 = sgd_train_step(cfg, 1e-2, params, tokens,
+                            norm_impl="fused")
+    assert abs(float(l1) - float(l2)) < 2e-2, (float(l1), float(l2))
+    import numpy as np
+    for leaf1, leaf2 in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        a = np.asarray(leaf1, np.float32)
+        b = np.asarray(leaf2, np.float32)
+        scale = np.abs(a).max() + 1e-6
+        assert float(np.abs(a - b).max() / scale) < 5e-2

@@ -833,6 +833,52 @@ def _fused_rmsnorm_matmul_kernel(x_ref, g_ref, w_ref, out_ref, acc_ref, *,
         out_ref[:] = acc_ref[:].astype(out_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def rmsnorm_matmul_train(x, gamma, w, interpret=False):
+    """Differentiable ``rmsnorm(x, gamma) @ w``: Pallas-fused forward
+    (the activation never round-trips HBM between norm and matmul), a
+    plain-XLA backward (bf16 dots, fp32 accumulate — the bwd is
+    matmul-dominated and XLA already fuses the norm recompute into it).
+    Drop-in for the train trunk's ln1→wqkv and ln2→w1 pairs
+    (train.py ``norm_impl="fused"``)."""
+    return fused_rmsnorm_matmul(x, gamma, w, interpret=interpret)
+
+
+def _rmsnorm_matmul_train_fwd(x, gamma, w, interpret):
+    return (fused_rmsnorm_matmul(x, gamma, w, interpret=interpret),
+            (x, gamma, w))
+
+
+def _rmsnorm_matmul_train_bwd(interpret, res, g):
+    x, gamma, w = res
+    eps = 1e-6
+    K = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+                      + eps)                                   # [M, 1]
+    gf = gamma.astype(jnp.float32)
+    n = (xf * r) * gf                                          # normed fp32
+    # dW: normedᵀ · g on the MXU in bf16 (fp32 accumulate)
+    dw = jax.lax.dot_general(
+        n.astype(x.dtype), g.astype(x.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w.dtype)
+    # dNorm: g · Wᵀ
+    dn = jax.lax.dot_general(
+        g.astype(x.dtype), w.astype(x.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                    # [M, K] fp32
+    dgamma = jnp.sum(dn * xf * r, axis=0).astype(gamma.dtype)
+    # rmsnorm bwd: y_j = γ_j·x_j·r, dr/dx_i = -x_i·r³/K
+    dg_gamma = dn * gf
+    dx = (dg_gamma * r
+          - xf * (r ** 3 / K)
+          * jnp.sum(dg_gamma * xf, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dgamma, dw
+
+
+rmsnorm_matmul_train.defvjp(_rmsnorm_matmul_train_fwd,
+                            _rmsnorm_matmul_train_bwd)
+
+
 @functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret"))
 def fused_rmsnorm_matmul(x, gamma, w, *, bm: int = 256, bn: int = 256,
                          eps: float = 1e-6, interpret: bool = False):

@@ -27,6 +27,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_dra.workloads.quant import matmul_any
@@ -163,15 +164,38 @@ def _causal_dense_attention(q, k, v, segment_ids=None):
     return out.reshape(B, H, S, D)
 
 
+def _norm_matmul(x, gamma, w, dtype, norm_impl: str = "dense"):
+    """The pre-norm rmsnorm→matmul pair every sublayer opens with.
+
+    ``norm_impl="fused"`` routes plain-array weights through the Pallas
+    ``rmsnorm_matmul_train`` kernel (custom VJP; the activation never
+    round-trips HBM between norm and matmul) when the flattened shapes
+    admit its block grid; anything else — quantized/LoRA leaves, ragged
+    shapes — falls back to the XLA pair, which is also the default
+    (kernel promotion awaits an in-window hardware delta; armed in
+    bench section_train as train_step_fused_*)."""
+    if norm_impl == "fused" and isinstance(w, jax.Array):
+        B, S, D = x.shape
+        m, n = B * S, w.shape[1]
+        if m % min(256, m) == 0 and n % min(256, n) == 0:
+            from tpu_dra.workloads.pallas_kernels import \
+                rmsnorm_matmul_train
+            out = rmsnorm_matmul_train(
+                x.reshape(m, D), gamma, w.astype(x.dtype),
+                jax.default_backend() != "tpu")
+            out = checkpoint_name(out, "fused_norm_mm")
+            return out.reshape(B, S, n).astype(dtype)
+    return matmul_any(_rmsnorm(x, gamma), w, dtype)
+
+
 def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
-                   positions=None):
+                   positions=None, norm_impl: str = "dense"):
     """Pre-norm attention residual sublayer, shared by the dense and MoE
     blocks.  GQA-aware: q carries n_heads, k/v carry kv_heads.  With
     ``pos_emb="rope"``, q/k rotate by ``positions`` (default: 0..S-1;
     sequence-parallel callers pass their global offsets)."""
     B, S, D = x.shape
-    h = _rmsnorm(x, layer["ln1"])
-    qkv = matmul_any(h, layer["wqkv"], x.dtype)
+    qkv = _norm_matmul(x, layer["ln1"], layer["wqkv"], x.dtype, norm_impl)
     q, k, v = jnp.split(qkv, [D, D + cfg.d_kv], axis=-1)
 
     def heads(t, n):
@@ -190,11 +214,11 @@ def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
 
 
 def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
-           positions=None):
+           positions=None, norm_impl: str = "dense"):
     """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
-    x = _attn_sublayer(cfg, x, layer, attn_fn, positions)
-    h = _rmsnorm(x, layer["ln2"])
-    h = jax.nn.gelu(matmul_any(h, layer["w1"], x.dtype))
+    x = _attn_sublayer(cfg, x, layer, attn_fn, positions, norm_impl)
+    h = _norm_matmul(x, layer["ln2"], layer["w1"], x.dtype, norm_impl)
+    h = jax.nn.gelu(h)
     return x + matmul_any(h, layer["w2"], x.dtype)
 
 
@@ -223,7 +247,7 @@ _ATTN_IMPLS = {"dense": _causal_dense_attention, "flash": _flash_attention_fn}
 
 
 def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention,
-           segment_ids=None, positions=None):
+           segment_ids=None, positions=None, norm_impl: str = "dense"):
     """Embed + decoder stack; returns pre-final-norm activations.
 
     Packing (``segment_ids`` + per-token ``positions`` [B, S]): the dense
@@ -255,10 +279,20 @@ def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention,
     # backward.  Measured on v5e @ S=1024/B=16: 60.5% MFU vs 57.0% full
     # remat vs OOM with no remat — the policy keeps the HBM win of
     # rematerialization without re-running the MXU work.
+    policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if norm_impl == "fused":
+        # the Pallas fused op is not a dot the policy recognizes — name
+        # its output saveable, or remat would recompute the whole fused
+        # matmul in the backward and eat the fusion's win
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            policy,
+            jax.checkpoint_policies.save_only_these_names(
+                "fused_norm_mm"))
     block = jax.checkpoint(
         lambda carry, layer: (_block(cfg, carry, layer, attn_fn,
-                                     positions=positions), None),
-        policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+                                     positions=positions,
+                                     norm_impl=norm_impl), None),
+        policy=policy)
     x, _ = jax.lax.scan(block, x, params["blocks"])
     return x
 
@@ -409,16 +443,19 @@ def _chunked_nll_bwd(n_chunks, res, g):
 _chunked_nll.defvjp(_chunked_nll_fwd, _chunked_nll_bwd)
 
 
-def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense"):
+def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
+            norm_impl: str = "dense"):
     """Logits for a [B, S] int32 token batch."""
     return head_logits(params, _trunk(cfg, params, tokens,
-                                      _ATTN_IMPLS[attn_impl]))
+                                      _ATTN_IMPLS[attn_impl],
+                                      norm_impl=norm_impl))
 
 
 def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
             head_impl: str = "dense", label_smoothing: float = 0.0,
-            z_loss: float = 0.0):
-    trunk = _trunk(cfg, params, tokens[:, :-1], _ATTN_IMPLS[attn_impl])
+            z_loss: float = 0.0, norm_impl: str = "dense"):
+    trunk = _trunk(cfg, params, tokens[:, :-1], _ATTN_IMPLS[attn_impl],
+                   norm_impl=norm_impl)
     return jnp.mean(head_nll(params, trunk, tokens[:, 1:], head_impl,
                              label_smoothing=label_smoothing,
                              z_loss=z_loss))
@@ -444,7 +481,8 @@ def packed_loss_fn(cfg: ModelConfig, params, tokens, segment_ids,
 
 def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
              head_impl: str = "dense", accum_steps: int = 1,
-             label_smoothing: float = 0.0, z_loss: float = 0.0):
+             label_smoothing: float = 0.0, z_loss: float = 0.0,
+             norm_impl: str = "dense"):
     """(mean loss, grads) for a [B, S] batch, optionally via gradient
     accumulation: ``accum_steps > 1`` splits the batch into that many
     microbatches and runs them through one ``lax.scan`` (one compiled
@@ -454,7 +492,8 @@ def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
     so accumulation changes memory, not semantics."""
     vg = jax.value_and_grad(partial(loss_fn, cfg,
                                     label_smoothing=label_smoothing,
-                                    z_loss=z_loss))
+                                    z_loss=z_loss,
+                                    norm_impl=norm_impl))
     if accum_steps == 1:
         return vg(params, tokens, attn_impl=attn_impl, head_impl=head_impl)
     B = tokens.shape[0]
@@ -477,10 +516,11 @@ def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
 
 def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens,
                    attn_impl: str = "dense", head_impl: str = "dense",
-                   accum_steps: int = 1):
+                   accum_steps: int = 1, norm_impl: str = "dense"):
     """Full train step (fwd+bwd+update) as one jittable function."""
     loss, grads = grads_fn(cfg, params, tokens, attn_impl=attn_impl,
-                           head_impl=head_impl, accum_steps=accum_steps)
+                           head_impl=head_impl, accum_steps=accum_steps,
+                           norm_impl=norm_impl)
     params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return params, loss
 
@@ -524,7 +564,8 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
                             attn_impl: str = "dense",
                             head_impl: str = "dense",
-                            accum_steps: int = 1):
+                            accum_steps: int = 1,
+                            norm_impl: str = "dense"):
     """jit the full train step with DP×TP shardings over ``mesh`` (axes
     "dp", "tp").  ``attn_impl``: "dense" (XLA, best at short S) or "flash"
     (Pallas fwd+bwd kernels, best at long S).  ``head_impl``: "dense" or
@@ -536,7 +577,8 @@ def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
     b_shard = batch_sharding(mesh)
     step = jax.jit(
         partial(sgd_train_step, cfg, lr, attn_impl=attn_impl,
-                head_impl=head_impl, accum_steps=accum_steps),
+                head_impl=head_impl, accum_steps=accum_steps,
+                norm_impl=norm_impl),
         in_shardings=(p_shard, b_shard),
         out_shardings=(p_shard, NamedSharding(mesh, P())))
     return step, p_shard, b_shard
