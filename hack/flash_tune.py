@@ -125,6 +125,43 @@ def main():
                    key=lambda r: r["tflops_effective"], default=None)
     print(json.dumps({"best_fwd": best_fwd, "best_bwd": best_bwd,
                       "device": getattr(dev, "device_kind", "")}))
+
+    # PROMOTE: write the winners into bench_cache/flash_tune.json —
+    # flash_attention's None-default blocks resolve through this table
+    # per (S, D), so committing the file applies the sweep everywhere
+    # without a code edit (pallas_kernels._resolve_flash_config).
+    if best_fwd is not None:
+        import subprocess
+        import time as _time
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, "bench_cache", "flash_tune.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = {"entries": {}}
+        entry = {"bq": best_fwd["fwd"][0], "bk": best_fwd["fwd"][1],
+                 "fwd_mfu_pct": best_fwd["mfu_pct"]}
+        if best_bwd is not None:
+            entry["bwd_impl"] = best_bwd["bwd"][0]
+            entry["bwd_blocks"] = best_bwd["bwd"][1:]
+            entry["bwd_mfu_pct"] = best_bwd["mfu_pct"]
+        payload["entries"][f"{s}x{d}"] = entry
+        payload["device_kind"] = getattr(dev, "device_kind", "")
+        payload["ts"] = _time.time()
+        try:
+            payload["sha"] = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+                capture_output=True, text=True).stdout.strip()
+        except OSError:
+            pass
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        print(json.dumps({"promoted": path, "entry": entry}))
     return 0
 
 

@@ -365,3 +365,37 @@ def test_train_step_fused_norm_matches_dense(tmp_path):
         b = np.asarray(leaf2, np.float32)
         scale = np.abs(a).max() + 1e-6
         assert float(np.abs(a - b).max() / scale) < 5e-2
+
+
+def test_flash_tuned_defaults_resolve_from_file(tmp_path, monkeypatch):
+    """flash_attention's None-default blocks resolve through the
+    promoted autotune table; explicit arguments always win."""
+    import json as _json
+
+    from tpu_dra.workloads import pallas_kernels as pk
+
+    tune = tmp_path / "flash_tune.json"
+    tune.write_text(_json.dumps({"entries": {"256x64": {
+        "bq": 128, "bk": 128, "bwd_impl": "fused",
+        "bwd_blocks": [128, 128, 128, 128]}}}))
+    monkeypatch.setattr(pk, "_TUNE_FILE", str(tune))
+    monkeypatch.setattr(pk, "_TUNED_ENTRIES", None)   # drop the cache
+    got = pk._resolve_flash_config(256, 64, None, None, None, None)
+    assert got == (128, 128, "fused", (128, 128, 128, 128))
+    # explicit args win over the table
+    got = pk._resolve_flash_config(256, 64, 512, None, "split", None)
+    assert got == (512, 128, "split", (128, 128, 128, 128))
+    # unknown shape: measured sweet-spot defaults
+    got = pk._resolve_flash_config(512, 64, None, None, None, None)
+    assert got == (1024, 1024, "split", None)
+    # and the tuned path produces the same numbers as the default path
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 256, 64), jnp.bfloat16)
+               for kk in ks)
+    tuned_out = pk.flash_attention(q, k, v, interpret=True)
+    ref_out = pk.flash_attention(q, k, v, bq=1024, bk=1024,
+                                 bwd_impl="split", interpret=True)
+    err = jnp.max(jnp.abs(tuned_out.astype(jnp.float32)
+                          - ref_out.astype(jnp.float32)))
+    assert float(err) < 5e-2
+    monkeypatch.setattr(pk, "_TUNED_ENTRIES", None)   # clean for others

@@ -13,11 +13,45 @@ Kernels run on real TPUs and, for tests, under ``interpret=True`` on CPU.
 from __future__ import annotations
 
 import functools
+import json
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# Autotune promotion file (written by hack/flash_tune.py on a real chip,
+# committed with bench_cache/): flash block defaults resolve through it
+# per (S, D) shape, so an in-window sweep improves every later run
+# without a code edit.  Explicit caller arguments always win.
+_TUNE_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "bench_cache", "flash_tune.json")
+_TUNED_ENTRIES: dict | None = None
+
+
+def _resolve_flash_config(s: int, d: int, bq, bk, bwd_impl, bwd_blocks):
+    """Fill None block arguments from the tuned table (falling back to
+    the measured v5e sweet spots)."""
+    global _TUNED_ENTRIES
+    if _TUNED_ENTRIES is None:
+        try:
+            with open(_TUNE_FILE, encoding="utf-8") as f:
+                _TUNED_ENTRIES = json.load(f).get("entries", {})
+        except (OSError, ValueError):
+            _TUNED_ENTRIES = {}
+    tuned = _TUNED_ENTRIES.get(f"{s}x{d}", {})
+    if bq is None:
+        bq = int(tuned.get("bq", 1024))
+    if bk is None:
+        bk = int(tuned.get("bk", 1024))
+    if bwd_impl is None:
+        bwd_impl = tuned.get("bwd_impl", "split")
+    if bwd_blocks is None:
+        bb = tuned.get("bwd_blocks")
+        bwd_blocks = tuple(int(x) for x in bb) if bb else None
+    return bq, bk, bwd_impl, bwd_blocks
 
 
 def _matmul_kernel(x_ref, y_ref, out_ref, acc_ref, *, k_steps: int):
@@ -765,9 +799,9 @@ def _validate_and_fold(q, k, v, causal):
 @functools.partial(jax.jit,
                    static_argnames=("causal", "bq", "bk", "interpret",
                                     "bwd_impl", "bwd_blocks"))
-def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
-                             bk: int = 1024, interpret: bool = False,
-                             bwd_impl: str = "split", bwd_blocks=None):
+def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq=None,
+                             bk=None, interpret: bool = False,
+                             bwd_impl=None, bwd_blocks=None):
     """``flash_attention`` that also returns the per-row base-2 logsumexp
     ``[B, H, S]`` — the merge statistic for composing partial attentions
     (ring steps, sharded KV): given normalized partials (oᵃ, l2ᵃ), (oᵇ,
@@ -776,18 +810,34 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True, bq: int = 1024,
     Both outputs are differentiable; the l2 cotangent folds into the same
     backward kernels."""
     b, h, s, d = q.shape
+    bq, bk, bwd_impl, bwd_blocks = _resolve_flash_config(
+        s, d, bq, bk, bwd_impl, bwd_blocks)
     qf, kf, vf = _validate_and_fold(q, k, v, causal)
     out, l2 = _flash_attn_lse(qf, kf, vf, causal, bq, bk, interpret,
                               bwd_impl, bwd_blocks)
     return out.reshape(b, h, s, d), l2.reshape(b, h, s)
 
 
+def flash_attention(q, k, v, *, causal: bool = True, bq=None, bk=None,
+                    interpret: bool = False, bwd_impl=None,
+                    bwd_blocks=None):
+    """Tuned-defaults front door: ``None`` block arguments resolve
+    through ``bench_cache/flash_tune.json`` for this (S, D), else the
+    measured sweet spots; explicit arguments always win.  The resolved
+    call hits the jitted kernel below."""
+    bq, bk, bwd_impl, bwd_blocks = _resolve_flash_config(
+        q.shape[2], q.shape[3], bq, bk, bwd_impl, bwd_blocks)
+    return _flash_attention_jit(q, k, v, causal=causal, bq=bq, bk=bk,
+                                interpret=interpret, bwd_impl=bwd_impl,
+                                bwd_blocks=bwd_blocks)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "bq", "bk", "interpret",
                                     "bwd_impl", "bwd_blocks"))
-def flash_attention(q, k, v, *, causal: bool = True, bq: int = 1024,
-                    bk: int = 1024, interpret: bool = False,
-                    bwd_impl: str = "split", bwd_blocks=None):
+def _flash_attention_jit(q, k, v, *, causal: bool = True, bq: int = 1024,
+                         bk: int = 1024, interpret: bool = False,
+                         bwd_impl: str = "split", bwd_blocks=None):
     """Memory-efficient attention for ``[B, H, S, D]`` q/k/v.
 
     Forward is the Pallas online-softmax kernel (HBM stays O(S·D); the
