@@ -455,3 +455,54 @@ def test_paged_engine_through_http():
         assert st["kv_page_size"] == 8
     finally:
         srv.shutdown()
+
+
+def test_auto_draft_speculative_engine_parity():
+    """--auto-draft path: a draft built FROM the serving checkpoint
+    (truncate + distill, build_auto_draft) drives the speculative
+    continuous engine with byte-identical tokens to plain /generate."""
+    from tpu_dra.workloads.serve import build_auto_draft
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    draft = build_auto_draft(cfg, params, steps=40, batch=4)
+    assert draft[0].n_layers == 1
+
+    plain = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = plain.server_address
+    want = _post(f"http://{host}:{port}",
+                 {"tokens": [[3, 5, 7]], "steps": 8})["tokens"]
+    plain.shutdown()
+
+    srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2,
+                draft=draft, speculative_engine=True)
+    host, port = srv.server_address
+    try:
+        got = _post(f"http://{host}:{port}",
+                    {"tokens": [[3, 5, 7]], "steps": 8})["tokens"]
+        st = srv.engine.stats()
+        assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    finally:
+        srv.shutdown()
+    assert got == want
+
+
+def test_auto_draft_flag_validation(tmp_path):
+    """--auto-draft without an fp32 checkpoint (cache-only start) and
+    --auto-draft alongside --draft-checkpoint-dir are startup errors."""
+    from tpu_dra.workloads import serve as serve_mod
+    from tpu_dra.workloads.checkpointing import save_serving_state
+    from tpu_dra.workloads.quant import quantize_params_int8
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    qp = quantize_params_int8(init_params(cfg, jax.random.PRNGKey(0)))
+    dims = {"vocab": 64, "d_model": 32, "n_heads": 2, "n_kv_heads": None,
+            "n_layers": 2, "d_ff": 64, "pos_emb": "rope"}
+    wc = str(tmp_path / "wc")
+    save_serving_state(wc, qp, meta={"form": "int8", "model": dims})
+    flags = ["--vocab", "64", "--d-model", "32", "--n-heads", "2",
+             "--n-layers", "2", "--d-ff", "64", "--max-seq", "32"]
+    with pytest.raises(SystemExit):
+        serve_mod.main([*flags, "--weights-cache", wc, "--auto-draft"])

@@ -635,6 +635,28 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
     return Handler
 
 
+def build_auto_draft(cfg: ModelConfig, fp32_params, *, form: str = "fp32",
+                     n_layers: int | None = None, steps: int = 200,
+                     batch: int = 8):
+    """Self-contained draft for speculation: quarter-depth truncation of
+    the serving model + on-device distillation (spec_draft.make_draft),
+    then quantized to the serving weight ``form`` so the draft's
+    per-token read shrinks with the target's.  Distills from the fp32
+    tree — quantized leaves have no gradients."""
+    from tpu_dra.workloads.spec_draft import make_draft
+
+    dcfg, dparams = make_draft(cfg, fp32_params, n_layers=n_layers,
+                               distill_steps=steps, batch=batch)
+    if form != "fp32":
+        from tpu_dra.workloads.quant import (cast_params_bf16,
+                                             quantize_params_int4,
+                                             quantize_params_int8)
+        dparams = {"int8": quantize_params_int8,
+                   "int4": quantize_params_int4,
+                   "bf16": cast_params_bf16}[form](dparams)
+    return dcfg, dparams
+
+
 def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           port: int = 8477,
           cache_dtype: str = "bf16",
@@ -775,6 +797,19 @@ def main(argv=None):
     ap.add_argument("--draft-checkpoint-dir", default="",
                     help="arm /speculative with this draft model "
                          "(same vocab; dims via --draft-*)")
+    ap.add_argument("--auto-draft", action="store_true",
+                    help="build the draft FROM the serving checkpoint: "
+                         "quarter-depth truncation + on-device "
+                         "distillation (workloads/spec_draft.py) — no "
+                         "separate draft checkpoint needed.  Requires "
+                         "--checkpoint-dir (distillation needs the fp32 "
+                         "tree; a quantized --weights-cache alone cannot "
+                         "be distilled)")
+    ap.add_argument("--auto-draft-layers", type=int, default=None,
+                    help="auto-draft depth (default n_layers//4, min 1)")
+    ap.add_argument("--auto-draft-steps", type=int, default=200,
+                    help="distillation steps at startup (0 = truncation "
+                         "only)")
     ap.add_argument("--draft-d-model", type=int, default=128)
     ap.add_argument("--draft-n-heads", type=int, default=4)
     ap.add_argument("--draft-n-kv-heads", type=int, default=None)
@@ -821,19 +856,21 @@ def main(argv=None):
             klog.info("serving weights restored from cache (no meta "
                       "sidecar; form unverified)",
                       cache=args.weights_cache)
+    fp32_params = None
     if params is None:
         if not args.checkpoint_dir:
             ap.error("--checkpoint-dir required (no populated "
                      "--weights-cache to restore from)")
         form = args.weights or "fp32"
-        params = restore_train_state(args.checkpoint_dir)["params"]
+        fp32_params = restore_train_state(args.checkpoint_dir)["params"]
+        params = fp32_params
         if form != "fp32":
             from tpu_dra.workloads.quant import (cast_params_bf16,
                                                  quantize_params_int4,
                                                  quantize_params_int8)
             params = {"int8": quantize_params_int8,
                       "int4": quantize_params_int4,
-                      "bf16": cast_params_bf16}[form](params)
+                      "bf16": cast_params_bf16}[form](fp32_params)
         if args.weights_cache:
             from tpu_dra.workloads.checkpointing import save_serving_state
             save_serving_state(args.weights_cache, params,
@@ -851,9 +888,23 @@ def main(argv=None):
             pos_emb=args.pos_emb)
         draft = (draft_cfg,
                  restore_train_state(args.draft_checkpoint_dir)["params"])
+    if args.auto_draft:
+        if draft is not None:
+            ap.error("--auto-draft conflicts with --draft-checkpoint-dir "
+                     "(pick one draft source)")
+        if fp32_params is None:
+            ap.error("--auto-draft needs --checkpoint-dir: distillation "
+                     "runs on the fp32 tree (a quantized --weights-cache "
+                     "alone cannot be distilled)")
+        draft = build_auto_draft(cfg, fp32_params,
+                                 form=args.weights or "fp32",
+                                 n_layers=args.auto_draft_layers,
+                                 steps=args.auto_draft_steps)
+        klog.info("auto-draft built", layers=draft[0].n_layers,
+                  steps=args.auto_draft_steps)
     if args.speculative_continuous and not (args.continuous and draft):
-        ap.error("--speculative-continuous needs --continuous and "
-                 "--draft-checkpoint-dir")
+        ap.error("--speculative-continuous needs --continuous and a "
+                 "draft (--draft-checkpoint-dir or --auto-draft)")
     srv = serve(cfg, params, host=args.host, port=args.port,
                 cache_dtype=args.cache_dtype, continuous=args.continuous,
                 slots=args.slots, chunk=args.chunk, draft=draft,
