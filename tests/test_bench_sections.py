@@ -1,0 +1,47 @@
+"""Smoke the rarely-run bench sections' no-backend paths.
+
+``visibility`` and ``multiprocess`` have never been recorded on
+hardware (VERDICT r04 missing #1) — when a tunnel window finally opens
+they run FIRST, so a crash-level bug in them (typo, bad import, broken
+JSON) would waste the window.  These tests execute each section as the
+bench does (own subprocess, ``--section`` entrypoint) on the honest
+no-chips/no-backend path and require one parsable JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_section(name: str, timeout: float = 240) -> dict:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--section", name],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env)
+    assert proc.returncode == 0, (proc.stderr or proc.stdout)[-500:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    return json.loads(lines[-1])
+
+
+def test_section_visibility_no_backend_path():
+    out = _run_section("visibility")
+    assert out["visibility_ok"] is None
+    assert "note" in "".join(out)  # explicit why-None, never a bare null
+
+
+def test_section_multiprocess_no_backend_path():
+    out = _run_section("multiprocess")
+    assert out["multiprocess_ok"] is None
+    assert out.get("multiprocess_note")
+
+
+def test_section_matmul_cpu_smoke():
+    out = _run_section("matmul")
+    assert out["tpu_matmul_tflops"] > 0
