@@ -806,6 +806,16 @@ def section_spec_real() -> dict:
         secs = time.perf_counter() - t0
         return secs, sum(len(h.tokens) for h in handles), eng.stats()
 
+    # Internal time budget: this section runs distillation plus up to
+    # THREE engine compile sets inside one 720 s subprocess deadline —
+    # a bust at the end would lose EVERYTHING (sections are atomic).
+    # Each block checks remaining time and records an explicit skip
+    # instead of gambling the already-measured keys.
+    t_section = time.perf_counter()
+
+    def time_left() -> float:
+        return 660.0 - (time.perf_counter() - t_section)
+
     plain_tps = None
     try:
         eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk)
@@ -817,6 +827,9 @@ def section_spec_real() -> dict:
         out["spec_real_plain_tokens_per_s"] = plain_tps
     except Exception as exc:  # noqa: BLE001 — keep what's measured
         out["spec_real_errors"] = repr(exc)[:200]
+    if time_left() < 120:
+        out["spec_real_skipped"] = "section time budget exhausted"
+        return out
     try:
         eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
                                draft=(dcfg, dparams))
@@ -834,6 +847,9 @@ def section_spec_real() -> dict:
         out["spec_real_errors"] = repr(exc)[:200]
     # same draft over PAGES (the paged engine's block tables are shared
     # by target and draft) — fenced like everything above
+    if time_left() < 120:
+        out["paged_spec_real_skipped"] = "section time budget exhausted"
+        return out
     try:
         ps = 64 if on_tpu else 8
         eng = ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
