@@ -111,7 +111,11 @@ def main():
             print("OK `daemon check` rc 0")
         finally:
             proc.terminate()
-            proc.wait(10)
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()      # never leak the child or its pipe
+                proc.wait(5)
     finally:
         srv.stop()
     print("DRIVE DAEMON: ALL OK")

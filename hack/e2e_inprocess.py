@@ -194,7 +194,11 @@ def main():
     finally:
         if plugin is not None:
             plugin.terminate()
-            plugin.wait(10)
+            try:
+                plugin.wait(10)
+            except subprocess.TimeoutExpired:
+                plugin.kill()    # never leak the child or its pipe
+                plugin.wait(5)
         srv.stop()
 
 
