@@ -384,13 +384,11 @@ def test_speculative_engine_eos_stops_early(params):
         spec.shutdown()
 
 
-def test_speculative_engine_rejects_sampling_and_prefix(params,
-                                                        draft_params):
+def test_speculative_engine_rejects_prefix_and_bad_configs(params,
+                                                           draft_params):
     spec = ContinuousEngine(CFG, params, slots=2, chunk=2,
                             draft=(DRAFT_CFG, draft_params))
     try:
-        with pytest.raises(ValueError, match="greedy-only"):
-            spec.submit([1, 2], 2, temperature=0.7)
         with pytest.raises(ValueError, match="prefix"):
             spec.submit([1, 2], 2, prefix_id="abc")
         with pytest.raises(ValueError, match="chunk >= 2"):
@@ -431,3 +429,89 @@ def test_speculative_engine_join_midflight(params, draft_params):
         assert again == ref[0].tolist()
     finally:
         spec.shutdown()
+
+
+def test_speculative_engine_sampled_requests(params, draft_params):
+    """Speculative SAMPLING: temperature>0 requests run through the
+    rejection scheme — right lengths, reproducible per seed across
+    fresh engines, and greedy requests in the same engine keep byte-
+    parity with the plain engine (the mixed commit routes per slot)."""
+    plain = ContinuousEngine(CFG, params, slots=2, chunk=2)
+    try:
+        greedy_want = plain.submit([3, 5, 7], 8, timeout=300)
+    finally:
+        plain.shutdown()
+
+    def run():
+        eng = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                               draft=(DRAFT_CFG, draft_params))
+        try:
+            sampled = eng.submit([1, 2], 8, temperature=0.8, seed=11,
+                                 timeout=300)
+            sampled2 = eng.submit([1, 2], 8, temperature=0.8, seed=12,
+                                  timeout=300)
+            greedy = eng.submit([3, 5, 7], 8, timeout=300)
+            st = eng.stats()
+        finally:
+            eng.shutdown()
+        return sampled, sampled2, greedy, st
+
+    s1, s2, g1, st1 = run()
+    s1b, s2b, g1b, _ = run()
+    assert len(s1) == 8 and all(0 <= t < CFG.vocab for t in s1)
+    assert (s1, s2, g1) == (s1b, s2b, g1b)   # reproducible per seed
+    assert s1 != s2                          # different seeds diverge
+    assert g1 == greedy_want                 # greedy byte-parity holds
+    assert 0.0 <= st1["spec_accept_rate"] <= 1.0
+
+
+def test_speculative_sampled_mixed_batch_concurrent(params, draft_params):
+    """Sampled and greedy requests IN FLIGHT TOGETHER: the per-slot
+    commit routing must not cross-contaminate (greedy rows still byte-
+    match the plain engine)."""
+    import threading as _t
+
+    plain = ContinuousEngine(CFG, params, slots=2, chunk=2)
+    try:
+        want = plain.submit([3, 5, 7], 10, timeout=300)
+    finally:
+        plain.shutdown()
+    eng = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                           draft=(DRAFT_CFG, draft_params))
+    try:
+        out = {}
+
+        def sampled():
+            out["s"] = eng.submit([1, 2], 10, temperature=0.9, seed=5,
+                                  timeout=300)
+
+        t = _t.Thread(target=sampled)
+        t.start()
+        out["g"] = eng.submit([3, 5, 7], 10, timeout=300)
+        t.join(timeout=300)
+    finally:
+        eng.shutdown()
+    assert out["g"] == want
+    assert len(out["s"]) == 10
+
+
+def test_speculative_sampled_paged(params, draft_params):
+    """The same sampled contract over pages."""
+    eng = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                           kv_layout="paged", page_size=8,
+                           draft=(DRAFT_CFG, draft_params))
+    try:
+        s1 = eng.submit([1, 2], 6, temperature=0.8, seed=3, timeout=300)
+        st = eng.stats()
+        assert len(s1) == 6 and all(0 <= t < CFG.vocab for t in s1)
+        assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    finally:
+        eng.shutdown()
+    eng2 = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                            kv_layout="paged", page_size=8,
+                            draft=(DRAFT_CFG, draft_params))
+    try:
+        assert eng2.submit([1, 2], 6, temperature=0.8, seed=3,
+                           timeout=300) == s1
+    finally:
+        eng2.shutdown()

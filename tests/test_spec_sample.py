@@ -1,0 +1,126 @@
+"""Speculative sampling commit math (workloads/spec_sample.py).
+
+The load-bearing property: for ANY draft distribution, the committed
+stream is distributed exactly as target-only ancestral sampling.  The
+tests verify the first-committed-token marginal against the analytic
+target softmax over many seeds (the whole-stream property follows by
+induction — every later position sees the same accept/resample rule),
+plus the structural edges (frozen slots, eos, full-accept bonus).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.workloads.spec_sample import commit_sampled
+
+V, K = 5, 3
+
+
+def _run_pass(key, t_logits, q_logits, temps=None, eos=-1, done=False):
+    """One single-slot commit pass with drafts honestly sampled from q
+    (the property only holds when drafts come from the claimed draft
+    distribution)."""
+    kd, kc = jax.random.split(key)
+    temps = temps if temps is not None else jnp.ones((1,), jnp.float32)
+    # commit_sampled takes FINAL logits: pre-scale by temperature here,
+    # exactly as the engine pre-scales+filters before the commit
+    t_final = t_logits / temps[0]
+    q_final = q_logits / temps[0]
+    dkeys = jax.random.split(kd, K - 1)
+    drafts = jnp.stack([
+        jax.random.categorical(dkeys[j], q_final[0, j])
+        for j in range(K - 1)])[None].astype(jnp.int32)
+    token = jnp.zeros((1,), jnp.int32)
+    pos = jnp.zeros((1,), jnp.int32)
+    return commit_sampled(
+        token, pos, jnp.full((1,), eos, jnp.int32),
+        jnp.full((1,), done), drafts, t_final, q_final, kc[None])
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_first_token_marginal_matches_target(seed):
+    """Empirical first-committed-token distribution == softmax(p_1) to
+    within binomial noise, for a DIFFERENT draft distribution."""
+    kp, kq = jax.random.split(jax.random.PRNGKey(100 + seed))
+    t_logits = jax.random.normal(kp, (1, K, V)) * 1.5
+    q_logits = jax.random.normal(kq, (1, K - 1, V)) * 1.5
+
+    batch = jax.vmap(lambda k: _run_pass(k, t_logits, q_logits))
+    n = 20000
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    _, _, _, emit, counts = batch(keys)
+    assert int(jnp.min(counts)) >= 1
+    first = np.asarray(emit[:, 0, 0])
+    want = np.asarray(jax.nn.softmax(t_logits[0, 0].astype(jnp.float32)))
+    got = np.bincount(first, minlength=V) / n
+    # 4-sigma binomial tolerance per bucket
+    tol = 4 * np.sqrt(want * (1 - want) / n)
+    assert np.all(np.abs(got - want) <= tol + 1e-3), (got, want)
+
+
+def test_greedyish_temperature_sharpens_to_argmax():
+    """Near-zero temperature concentrates the committed first token on
+    the target argmax regardless of the draft."""
+    kp, kq = jax.random.split(jax.random.PRNGKey(3))
+    t_logits = jax.random.normal(kp, (1, K, V)) * 2.0
+    q_logits = jax.random.normal(kq, (1, K - 1, V)) * 2.0
+    temps = jnp.full((1,), 0.05, jnp.float32)
+    batch = jax.vmap(lambda k: _run_pass(k, t_logits, q_logits, temps))
+    keys = jax.random.split(jax.random.PRNGKey(4), 500)
+    _, _, _, emit, _ = batch(keys)
+    first = np.asarray(emit[:, 0, 0])
+    am = int(jnp.argmax(t_logits[0, 0]))
+    assert (first == am).mean() > 0.99
+
+
+def test_identical_models_accept_everything():
+    """draft == target accepts every proposal: counts == K always (the
+    full-accept ceiling), and the bonus is drawn from the target."""
+    kp = jax.random.PRNGKey(5)
+    t_logits = jax.random.normal(kp, (1, K, V))
+    q_logits = t_logits[:, : K - 1]
+    batch = jax.vmap(lambda k: _run_pass(k, t_logits, q_logits))
+    keys = jax.random.split(jax.random.PRNGKey(6), 300)
+    _, _, _, _, counts = batch(keys)
+    assert np.asarray(counts).min() == K
+
+
+def test_frozen_slot_holds():
+    t_logits = jnp.zeros((1, K, V))
+    q_logits = jnp.zeros((1, K - 1, V))
+    token2, pos2, done2, emit, counts = _run_pass(
+        jax.random.PRNGKey(0), t_logits, q_logits, done=True)
+    assert int(counts[0]) == 0
+    assert int(token2[0]) == 0 and int(pos2[0]) == 0
+    assert bool(done2[0])
+
+
+def test_eos_in_commit_freezes():
+    """An eos anywhere in the committed prefix freezes the slot."""
+    # target puts all mass on token 2 = eos; draft agrees
+    t_logits = jnp.full((1, K, V), -30.0).at[:, :, 2].set(30.0)
+    q_logits = t_logits[:, : K - 1]
+    _, _, done2, emit, counts = _run_pass(
+        jax.random.PRNGKey(1), t_logits, q_logits, eos=2)
+    assert bool(done2[0])
+    assert int(emit[0, 0]) == 2
+
+
+def test_multi_slot_batch_shapes():
+    slots = 4
+    kp, kq, kk = jax.random.split(jax.random.PRNGKey(9), 3)
+    t_logits = jax.random.normal(kp, (slots, K, V))
+    q_logits = jax.random.normal(kq, (slots, K - 1, V))
+    drafts = jax.random.randint(kk, (slots, K - 1), 0, V, jnp.int32)
+    token2, pos2, done2, emit, counts = commit_sampled(
+        jnp.zeros((slots,), jnp.int32), jnp.zeros((slots,), jnp.int32),
+        jnp.full((slots,), -1, jnp.int32), jnp.zeros((slots,), bool),
+        drafts, t_logits, q_logits,
+        jax.random.split(jax.random.PRNGKey(10), slots))
+    assert emit.shape == (slots, K) and counts.shape == (slots,)
+    assert np.all(np.asarray(counts) >= 1)
+    assert np.all(np.asarray(pos2) == np.asarray(counts))

@@ -24,9 +24,15 @@ before reading it — so slot reuse needs no cache zeroing.
 Speculative mode (``draft=(draft_cfg, draft_params)``): each chunk
 dispatch becomes one draft-propose / target-verify iteration with
 per-slot accept counts — a slot with an agreeing draft commits ``chunk``
-tokens per target pass while its neighbor commits 1.  Greedy acceptance
-keeps outputs EXACTLY equal to the plain engine's; sampled requests and
-prefix joins are rejected in this mode (see __init__).
+tokens per target pass while its neighbor commits 1.  Greedy requests
+(temperature 0) commit the longest argmax-matching prefix, keeping
+outputs EXACTLY equal to the plain engine's; sampled requests commit
+via the rejection scheme (``spec_sample.py`` — accept draft token with
+prob min(1, p/q), resample the first rejection from norm(max(p-q, 0)),
+bonus-sample a full accept), so their committed stream is distributed
+exactly as target-only sampling.  Both kinds batch together (the commit
+routes per slot).  Prefix joins are rejected in this mode (see
+__init__).
 
 Sampling: per-request ``temperature`` (0 = greedy) via a per-slot
 temperature vector; ``top_k``/``top_p`` are engine-global statics (a
@@ -264,9 +270,17 @@ class ContinuousEngine:
             spec_impl = (self._paged_spec_chunk_impl
                          if kv_layout == "paged" else
                          self._spec_chunk_impl)
+            # two compiled programs: the greedy-only pass (no
+            # distribution stacks, no draws — the common serving mode
+            # and the armed bench sections) and the mixed sampled pass;
+            # the loop picks per pass by whether any live request
+            # samples
             self._spec_step_fn = jax.jit(
-                partial(spec_impl, cfg, draft[0]),
+                partial(spec_impl, cfg, draft[0], sampled=False),
                 donate_argnums=(2, 3))          # both caches/pools
+            self._spec_step_fn_sampled = jax.jit(
+                partial(spec_impl, cfg, draft[0], sampled=True),
+                donate_argnums=(2, 3))
             self._spec_prefill_fns: dict[int, Any] = {}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
@@ -274,15 +288,22 @@ class ContinuousEngine:
 
     # -- compiled programs --------------------------------------------------
 
-    def _first_token(self, logits, temps, keys):
-        """Admission-time token selection, shared by the slab and paged
-        prefills: greedy at temperature 0, else temperature-scaled
-        sampling under the engine-global top_k/top_p filters, each row
-        drawing from its own request-seeded key."""
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        filt = _filter_topk_topp(
+    def _filtered_logits(self, logits, temps):
+        """FINAL sampling logits: temperature-scaled + engine-global
+        top_k/top_p — the ONE definition of the sampling distribution
+        (admission, chunk scan, draft proposals, and the rejection
+        commit all score against exactly this)."""
+        return _filter_topk_topp(
             logits / jnp.maximum(temps, 1e-6)[:, None],
             self.top_k, self.top_p)
+
+    def _first_token(self, logits, temps, keys):
+        """Admission-time token selection, shared by the slab and paged
+        prefills: greedy at temperature 0, else a draw from
+        ``_filtered_logits``, each row using its own request-seeded
+        key."""
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        filt = self._filtered_logits(logits, temps)
         sampled = jax.vmap(
             lambda kk, lg: jax.random.categorical(kk, lg))(keys, filt)
         return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
@@ -402,19 +423,19 @@ class ContinuousEngine:
         return fn
 
     def _spec_prefill_impl(self, cfg, dcfg, params, dparams, cache,
-                           dcache, prompts, lengths, slots):
+                           dcache, prompts, lengths, slots, temps, keys):
         """Speculative admission: prefill BOTH models' slot-cache rows
-        for a batch of k joining sequences and select each first token
-        greedily from the target (speculative mode is greedy-only, so no
-        temperature/key plumbing here)."""
+        for a batch of k joining sequences; the first token comes from
+        the shared selection (greedy at temperature 0, sampled above —
+        same rule as the plain engine's admission)."""
         k, Sb = prompts.shape
         small = {name: jnp.zeros(
             (buf.shape[0], k, buf.shape[2], Sb, buf.shape[4]), buf.dtype)
             for name, buf in cache.items()}
         small, x = _prefill_trunk(cfg, params, small, prompts)
         last = x[jnp.arange(k), lengths - 1][:, None, :]
-        first = jnp.argmax(head_logits(params, last)[:, 0],
-                           axis=-1).astype(jnp.int32)
+        first = self._first_token(head_logits(params, last)[:, 0],
+                                  temps, keys)
         cache = {name: cache[name].at[:, slots, :, :Sb, :].set(
             small[name].astype(cache[name].dtype)) for name in cache}
         dsmall = {name: jnp.zeros(
@@ -434,39 +455,78 @@ class ContinuousEngine:
             self._spec_prefill_fns[bucket] = fn
         return fn
 
-    def _spec_chunk_impl(self, cfg, dcfg, params, dparams, cache, dcache,
-                         token, pos, eos, done):
-        """ONE speculative iteration for every slot (decode.py
-        speculative_decode's loop body, re-shaped for the slot pool):
-        the draft scans ``chunk-1`` proposals from each slot's committed
-        token, the target verifies [token, d1..d_{chunk-1}] in one
-        ragged chunk forward, and per slot the longest greedy-matching
-        prefix plus the target's bonus token commit.  Returns the padded
-        emission block [slots, chunk] and per-slot commit counts; frozen
-        slots hold (count 0).  Stale cache rows beyond each slot's new
-        position stay invisible per the module invariant."""
+    def _draft_propose(self, dcfg, dparams, dcache, token, pos, done,
+                       temp, keys, step_fn, sampled: bool):
+        """Shared draft-proposal scan for both layouts.  ``sampled`` is
+        a STATIC compile-time flag: the greedy-only program (the common
+        serving mode, and the armed hardware bench sections) proposes
+        pure argmax and never materializes the [slots, k-1, V]
+        distribution stack or draws; the sampled program routes per slot
+        — greedy rows argmax, sampled rows draw from the draft's
+        ``_filtered_logits`` (the q every proposal is scored against at
+        commit — the rejection math needs proposal and score to use the
+        SAME distribution).  Returns (dcache, drafts [slots, k-1],
+        q_filt [slots, k-1, V] | None, keys)."""
         k = self.chunk
 
         def draft_step(c, j):
-            dcache, tok = c
-            lg, dcache = _token_logits(dcfg, dparams, dcache, pos + j, tok)
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            dcache, tok, keys = c
+            lg, dcache = step_fn(dcache, tok, j)
+            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            if not sampled:
+                nxt = jnp.where(done, tok, greedy)
+                return (dcache, nxt, keys), (nxt, jnp.zeros((0,)))
+            split = jax.vmap(jax.random.split)(keys)
+            keys, draw = split[:, 0], split[:, 1]
+            filt = self._filtered_logits(lg, temp)
+            drawn = jax.vmap(
+                lambda kk, l: jax.random.categorical(kk, l))(draw, filt)
+            nxt = jnp.where(temp > 0, drawn.astype(jnp.int32), greedy)
             nxt = jnp.where(done, tok, nxt)
-            return (dcache, nxt), nxt
+            return (dcache, nxt, keys), (nxt, filt)
 
         # k steps, not k-1: a full-accept iteration commits positions
         # pos..pos+k-1, so the draft cache must cover them all (the k-th
         # proposal is discarded — speculative_decode's coverage rule)
-        (dcache, _), drafts = jax.lax.scan(
-            draft_step, (dcache, token),
+        (dcache, _, keys), (drafts, q_filt) = jax.lax.scan(
+            draft_step, (dcache, token, keys),
             jnp.arange(k, dtype=jnp.int32))
         drafts = drafts.T[:, : k - 1]                    # [slots, k-1]
+        if not sampled:
+            return dcache, drafts, None, keys
+        q_filt = q_filt[: k - 1].transpose(1, 0, 2)      # [slots, k-1, V]
+        return dcache, drafts, q_filt, keys
 
+    def _spec_chunk_impl(self, cfg, dcfg, params, dparams, cache, dcache,
+                         token, pos, eos, done, temp, keys,
+                         sampled: bool = False):
+        """ONE speculative iteration for every slot (decode.py
+        speculative_decode's loop body, re-shaped for the slot pool):
+        the draft scans ``chunk-1`` proposals from each slot's committed
+        token, the target verifies [token, d1..d_{chunk-1}] in one
+        ragged chunk forward, and per slot the commit is greedy-matching
+        (temperature 0) or, in the ``sampled`` program, the rejection
+        scheme (spec_sample.commit_sampled).  Returns the padded
+        emission block [slots, chunk] and per-slot commit counts; frozen
+        slots hold (count 0).  Stale cache rows beyond each slot's new
+        position stay invisible per the module invariant."""
+        k = self.chunk
+        dcache, drafts, q_filt, keys = self._draft_propose(
+            dcfg, dparams, dcache, token, pos, done, temp, keys,
+            lambda dc, tok, j: _token_logits(dcfg, dparams, dc,
+                                             pos + j, tok),
+            sampled)
         chunk_toks = jnp.concatenate([token[:, None], drafts], axis=1)
         t_lg, cache = _chunk_logits(cfg, params, cache, pos, chunk_toks)
-        token2, pos2, done2, emit, counts = self._spec_commit(
-            k, token, pos, eos, done, drafts, t_lg)
-        return cache, dcache, token2, pos2, done2, emit, counts
+        if sampled:
+            (token2, pos2, done2, emit, counts,
+             keys) = self._spec_commit_mixed(
+                k, token, pos, eos, done, drafts, t_lg, q_filt, temp,
+                keys)
+        else:
+            token2, pos2, done2, emit, counts = self._spec_commit(
+                k, token, pos, eos, done, drafts, t_lg)
+        return cache, dcache, token2, pos2, done2, emit, counts, keys
 
     def _spec_commit(self, k, token, pos, eos, done, drafts, t_lg):
         """Accept/commit tail shared by the slab and paged speculative
@@ -498,49 +558,82 @@ class ContinuousEngine:
         done2 = done | hit
         return token2, pos2, done2, emit, counts
 
+    def _spec_commit_mixed(self, k, token, pos, eos, done, drafts, t_lg,
+                           q_filt, temp, keys):
+        """Route each slot's commit by its temperature: greedy slots use
+        the argmax-matching rule (byte parity with the plain engine),
+        sampled slots the rejection scheme (spec_sample.commit_sampled —
+        distributional parity).  Both run; the select is elementwise
+        (cheap next to the model forwards).  The target distribution the
+        sampled rule scores against passes through the SAME
+        temperature/top_k/top_p pipeline the plain engine samples from."""
+        from tpu_dra.workloads.spec_sample import commit_sampled
+
+        g = self._spec_commit(k, token, pos, eos, done, drafts, t_lg)
+        slots_n, _, V = t_lg.shape
+        t_filt = self._filtered_logits(
+            t_lg.reshape(slots_n * k, V),
+            jnp.repeat(temp, k)).reshape(slots_n, k, V)
+        s = commit_sampled(token, pos, eos, done, drafts, t_filt,
+                           q_filt, keys)
+        pick = temp > 0
+        token2 = jnp.where(pick, s[0], g[0])
+        pos2 = jnp.where(pick, s[1], g[1])
+        done2 = jnp.where(pick, s[2], g[2])
+        emit = jnp.where(pick[:, None], s[3], g[3])
+        counts = jnp.where(pick, s[4], g[4])
+        # advance every slot's key chain once per pass (sampled slots
+        # also consumed draws inside the proposal scan and the commit)
+        keys = jax.vmap(lambda s_: jax.random.fold_in(s_, 7))(keys)
+        return token2, pos2, done2, emit, counts, keys
+
     def _paged_spec_chunk_impl(self, cfg, dcfg, params, dparams, cache,
-                               dcache, token, pos, eos, done, table):
+                               dcache, token, pos, eos, done, table,
+                               temp, keys, sampled: bool = False):
         """Paged speculative iteration: the draft proposes over ITS page
         pool (same block tables and page ids as the target — one
         allocation covers both models), the target verifies the chunk
-        against its pages, and the shared accept math commits."""
+        against its pages, and the shared accept math commits (greedy
+        program or sampled program, like the slab impl)."""
         from tpu_dra.workloads.paged_kv import (_paged_step,
                                                 paged_chunk_logits)
         k = self.chunk
 
-        def draft_step(c, j):
-            dcache, tok = c
-            dcache, lg, _ = _paged_step(dcfg, dparams, dcache, tok,
-                                        pos + j, table, self._interpret)
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            nxt = jnp.where(done, tok, nxt)
-            return (dcache, nxt), nxt
+        def step_fn(dc, tok, j):
+            dc, lg, _ = _paged_step(dcfg, dparams, dc, tok,
+                                    pos + j, table, self._interpret)
+            return lg, dc
 
-        (dcache, _), drafts = jax.lax.scan(
-            draft_step, (dcache, token),
-            jnp.arange(k, dtype=jnp.int32))
-        drafts = drafts.T[:, : k - 1]                    # [slots, k-1]
-
+        dcache, drafts, q_filt, keys = self._draft_propose(
+            dcfg, dparams, dcache, token, pos, done, temp, keys, step_fn,
+            sampled)
         chunk_toks = jnp.concatenate([token[:, None], drafts], axis=1)
         t_lg, cache = paged_chunk_logits(cfg, params, cache, chunk_toks,
                                          pos, table)
-        token2, pos2, done2, emit, counts = self._spec_commit(
-            k, token, pos, eos, done, drafts, t_lg)
-        return cache, dcache, token2, pos2, done2, emit, counts
+        if sampled:
+            (token2, pos2, done2, emit, counts,
+             keys) = self._spec_commit_mixed(
+                k, token, pos, eos, done, drafts, t_lg, q_filt, temp,
+                keys)
+        else:
+            token2, pos2, done2, emit, counts = self._spec_commit(
+                k, token, pos, eos, done, drafts, t_lg)
+        return cache, dcache, token2, pos2, done2, emit, counts, keys
 
     def _paged_spec_prefill_impl(self, cfg, dcfg, params, dparams, cache,
-                                 dcache, prompts, lengths, rows):
+                                 dcache, prompts, lengths, rows, temps,
+                                 keys):
         """Paged speculative admission: the shared target prefill core
         plus the draft's prompt KV scattered into the SAME rows of its
-        own pool; first token greedy from the target (speculative mode
-        is greedy-only)."""
+        own pool; first token via the shared selection (greedy at
+        temperature 0, sampled above)."""
         from tpu_dra.workloads.paged_kv import (_prefill_kv,
                                                 scatter_prefill)
         cache, logits, prompts = self._paged_prefill_core(
             cfg, params, cache, prompts, lengths, rows)
         dks, dvs, _ = _prefill_kv(dcfg, dparams, prompts)
         dcache = scatter_prefill(dcache, dks, dvs, rows)
-        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        first = self._first_token(logits, temps, keys)
         return cache, dcache, first
 
     def _paged_spec_prefill_fn(self, bucket: int):
@@ -777,12 +870,12 @@ class ContinuousEngine:
         if eos_id is not None and not 0 <= eos_id < cfg.vocab:
             raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
         if self.draft is not None:
-            # greedy acceptance is what makes speculative output exactly
-            # equal the plain engine's; sampled requests and prefix
-            # joins (no draft-side prefix KV) are out of its contract
-            if temperature > 0:
-                raise ValueError("speculative engine is greedy-only "
-                                 "(temperature must be 0)")
+            # greedy requests keep byte-parity with the plain engine
+            # (argmax acceptance); sampled requests commit via the
+            # rejection scheme (spec_sample.py — the committed stream is
+            # distributed exactly as target-only ancestral sampling, for
+            # any draft).  Prefix joins stay out of the speculative
+            # contract (no draft-side prefix KV).
             if prefix_id is not None:
                 raise ValueError("speculative engine does not support "
                                  "prefix joins")
@@ -1040,16 +1133,20 @@ class ContinuousEngine:
         # request's seed (fold 0 draws the first token, the rest of the
         # stream advances per step in the chunk scan)
         base_keys = [jax.random.PRNGKey(req.seed) for _, req in group]
-        if self.draft is not None and self.kv_layout == "paged":
-            rows = self._table[slots]                      # [k, MP]
-            cache, dcache, first = self._paged_spec_prefill_fn(Sb)(
-                self.params, self.draft[1], self._cache, self._dcache,
-                prompts, lengths, rows)
-            self._cache, self._dcache = cache, dcache
-        elif self.draft is not None:
-            cache, dcache, first = self._spec_prefill_fn(Sb)(
-                self.params, self.draft[1], self._cache, self._dcache,
-                prompts, lengths, slots)
+        if self.draft is not None:
+            temps = jnp.asarray([req.temperature for _, req in group],
+                                jnp.float32)
+            keys0 = jnp.stack([jax.random.fold_in(kk, 0)
+                               for kk in base_keys])
+            if self.kv_layout == "paged":
+                rows = self._table[slots]                  # [k, MP]
+                cache, dcache, first = self._paged_spec_prefill_fn(Sb)(
+                    self.params, self.draft[1], self._cache,
+                    self._dcache, prompts, lengths, rows, temps, keys0)
+            else:
+                cache, dcache, first = self._spec_prefill_fn(Sb)(
+                    self.params, self.draft[1], self._cache,
+                    self._dcache, prompts, lengths, slots, temps, keys0)
             self._cache, self._dcache = cache, dcache
         else:
             temps = jnp.asarray([req.temperature for _, req in group],
@@ -1212,8 +1309,14 @@ class ContinuousEngine:
                              self._eos, self._done)
                 if self.kv_layout == "paged":
                     spec_args += (self._table,)
+                spec_args += (self._temp, self._keys)
+                any_sampled = any(r is not None and r.temperature > 0
+                                  for r in self._requests)
+                fn = (self._spec_step_fn_sampled if any_sampled
+                      else self._spec_step_fn)
                 (self._cache, self._dcache, self._token, self._pos,
-                 self._done, toks, counts) = self._spec_step_fn(*spec_args)
+                 self._done, toks, counts,
+                 self._keys) = fn(*spec_args)
                 # ONE device readback for both outputs (admission-path
                 # discipline)
                 toks, counts_host = jax.device_get((toks, counts))

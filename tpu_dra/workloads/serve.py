@@ -680,9 +680,10 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
     ``speculative_engine=True`` (needs ``draft`` and ``continuous``)
     makes the engine itself draft-assisted: each chunk dispatch is one
     speculative iteration with per-slot accept counts, so accepted
-    drafts multiply continuous-batching throughput while tokens stay
-    exactly greedy.  /generate then rejects sampled requests (the
-    engine's greedy-only contract)."""
+    drafts multiply continuous-batching throughput.  Greedy requests
+    keep byte-parity with the plain engine; sampled requests commit via
+    the rejection scheme (spec_sample.py) and stay distributed exactly
+    as target-only sampling."""
     if kv_layout != "slab" and not continuous:
         raise ValueError("--kv-layout paged requires --continuous (the "
                          "bucketed pool has no paged mode); without it "
@@ -791,9 +792,10 @@ def main(argv=None):
                          "set lower to oversubscribe slots against real "
                          "usage)")
     ap.add_argument("--speculative-continuous", action="store_true",
-                    help="with --continuous and --draft-checkpoint-dir: "
-                         "the engine itself drafts+verifies each chunk "
-                         "(per-slot accept counts; greedy-only)")
+                    help="with --continuous and a draft: the engine "
+                         "itself drafts+verifies each chunk (per-slot "
+                         "accept counts; greedy requests keep byte-"
+                         "parity, sampled ones the rejection scheme)")
     ap.add_argument("--draft-checkpoint-dir", default="",
                     help="arm /speculative with this draft model "
                          "(same vocab; dims via --draft-*)")

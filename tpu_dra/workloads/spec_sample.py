@@ -1,0 +1,107 @@
+"""Speculative SAMPLING — rejection-scheme acceptance for temperature>0.
+
+Greedy speculation (decode.speculative_decode, the continuous engines'
+draft mode) commits the longest argmax-matching prefix; its contract is
+byte-equality with the plain greedy engine.  Sampled requests need the
+rejection scheme (Leviathan et al. / Chen et al.): draft token ``d_j``
+sampled from the draft distribution ``q_j`` is ACCEPTED with probability
+``min(1, p_j(d_j)/q_j(d_j))`` against the target distribution ``p_j``;
+the first rejection resamples from the residual ``norm(max(p_j-q_j,0))``
+and stops the chunk; a fully-accepted chunk appends a bonus token drawn
+from the target's next-position distribution.  The committed stream is
+then distributed EXACTLY as target-only ancestral sampling — for any
+draft — which is the sampled analog of greedy mode's byte-parity and the
+property the statistical test pins.
+
+This module holds the pure commit math (shared by both engine layouts,
+like ``_spec_commit`` for greedy); everything is [slots, ...]-batched
+and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def commit_sampled(token, pos, eos, done, drafts, t_logits, q_logits,
+                   keys):
+    """One speculative-sampling accept/commit for every slot — the
+    sampled twin of ``ContinuousEngine._spec_commit`` (same in/out
+    shape so both engine layouts share it).
+
+    Both logit sets must arrive FINAL — already temperature-scaled and
+    top_k/top_p-filtered, exactly as the proposals were drawn (the
+    rejection math is only exact when q-as-scored equals q-as-sampled;
+    one pre-processing site in the engine keeps that alignment, see
+    ``_spec_commit_mixed``).
+
+    Args:
+      token:    [slots] int32 last committed token (held when frozen).
+      pos:      [slots] int32 committed positions.
+      eos:      [slots] int32 eos id (-1 = none).
+      done:     [slots] bool frozen slots (hold, commit 0).
+      drafts:   [slots, k-1] int32 draft-sampled tokens.
+      t_logits: [slots, k, V] final target logits (position j =
+        distribution of the token AFTER j committed chunk tokens).
+      q_logits: [slots, k-1, V] final draft logits for the drafted
+        positions.
+      keys:     [slots] PRNG keys — per-slot draw chain for this pass.
+
+    Returns (token2, pos2, done2, emit [slots, k], counts):
+      counts = accepted + 1 (resample or bonus), 0 for frozen slots;
+      emit rows carry the committed tokens left-aligned, 0 past count.
+    """
+    slots, k, V = t_logits.shape
+    p = jax.nn.softmax(t_logits.astype(jnp.float32), axis=-1)
+    q = jax.nn.softmax(q_logits.astype(jnp.float32), axis=-1)
+
+    draft_p = jnp.take_along_axis(
+        p[:, : k - 1], drafts[..., None], axis=-1)[..., 0]   # p_j(d_j)
+    draft_q = jnp.take_along_axis(
+        q, drafts[..., None], axis=-1)[..., 0]               # q_j(d_j)
+
+    ku, kr, kb = jax.vmap(lambda s: tuple(jax.random.split(s, 3)))(keys)
+    uniforms = jax.vmap(
+        lambda s: jax.random.uniform(s, (k - 1,)))(ku)       # [slots, k-1]
+    ratio = draft_p / jnp.maximum(draft_q, 1e-20)
+    accept = uniforms < jnp.minimum(ratio, 1.0)              # [slots, k-1]
+    n = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+    # rejection at position n: resample from norm(max(p_n - q_n, 0)).
+    # A fully-accepted row has no rejection; index n-1 is clamped junk
+    # there and the final where() routes around it.  Degenerate residual
+    # mass (p == q and still rejected — numerically possible) falls back
+    # to p_n itself.
+    idx = jnp.minimum(n, k - 2)
+    p_rej = jnp.take_along_axis(p, idx[:, None, None], axis=1)[:, 0]
+    q_rej = jnp.take_along_axis(q, idx[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_rej - q_rej, 0.0)
+    mass = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(mass > 1e-12, resid / jnp.maximum(mass, 1e-20),
+                      p_rej)
+    resampled = jax.vmap(
+        lambda s, pr: jax.random.categorical(s, jnp.log(pr + 1e-30))
+    )(kr, resid).astype(jnp.int32)
+
+    # bonus for fully-accepted rows: sample the target's k-th position
+    p_bonus = p[:, k - 1]
+    bonus = jax.vmap(
+        lambda s, pb: jax.random.categorical(s, jnp.log(pb + 1e-30))
+    )(kb, p_bonus).astype(jnp.int32)
+
+    final = jnp.where(n == k - 1, bonus, resampled)          # [slots]
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]
+    padded = jnp.concatenate(
+        [drafts, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+    emit = jnp.where(j < n[:, None], padded,
+                     jnp.where(j == n[:, None], final[:, None], 0))
+    counts = jnp.where(done, 0, n + 1)
+
+    live = j < counts[:, None]
+    hit = jnp.any(live & (emit == eos[:, None]) & (eos >= 0)[:, None],
+                  axis=1)
+    token2 = jnp.where(done, token, final)
+    pos2 = pos + counts
+    done2 = done | hit
+    return token2, pos2, done2, emit, counts
