@@ -506,3 +506,30 @@ def test_auto_draft_flag_validation(tmp_path):
              "--n-layers", "2", "--d-ff", "64", "--max-seq", "32"]
     with pytest.raises(SystemExit):
         serve_mod.main([*flags, "--weights-cache", wc, "--auto-draft"])
+
+
+def test_speculative_engine_sampled_over_http():
+    """Sampled requests through the speculative continuous engine's
+    /generate: valid tokens, reproducible per seed, and engine stats
+    carry the accept rate."""
+    from tpu_dra.workloads.serve import build_auto_draft
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    draft = build_auto_draft(cfg, params, steps=30, batch=4)
+    srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2,
+                draft=draft, speculative_engine=True)
+    host, port = srv.server_address
+    base = f"http://{host}:{port}"
+    try:
+        body = {"tokens": [[3, 5]], "steps": 6, "temperature": 0.8,
+                "seed": 21}
+        got = _post(base, body)["tokens"]
+        assert len(got[0]) == 6
+        assert all(0 <= t < cfg.vocab for t in got[0])
+        assert _post(base, body)["tokens"] == got     # same seed, same out
+        st = srv.engine.stats()
+        assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    finally:
+        srv.shutdown()
