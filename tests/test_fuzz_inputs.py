@@ -164,3 +164,41 @@ def test_checkpoint_loader_only_raises_corrupt(tmp_path):
         # any other exception type fails the test
     assert survived + rejected == 300
     assert rejected > 50         # mutations genuinely detected
+
+
+def test_cdi_validator_never_raises():
+    """cdi/validate.py consumes on-disk JSON (any file under cdi_root):
+    for ANY input shape it must return a list of error strings, never
+    raise — a crash in the validator would take down the e2e harness's
+    containerd stand-in step and the contract tests with it."""
+    from tpu_dra.cdi.validate import validate_spec
+
+    rng = random.Random(SEED + 7)
+    base = {"cdiVersion": "0.6.0", "kind": "google.com/tpu",
+            "devices": [{"name": "tpu-0", "containerEdits": {
+                "env": ["A=b"],
+                "deviceNodes": [{"path": "/dev/accel0"}],
+                "mounts": [{"hostPath": "/x", "containerPath": "/y"}],
+            }}],
+            "containerEdits": {"env": ["B=c"]}}
+    assert validate_spec(base) == []
+    def mutate_nested(rng):
+        # aim garbage INTO the edit fields (env: 5, deviceNodes: "x",
+        # hooks: {...}) — the type-confusion class a top-level mutation
+        # rarely reaches (caught live: scalar edits fields raised
+        # TypeError before the listed() guard)
+        obj = json.loads(json.dumps(base))
+        edits = obj["devices"][0]["containerEdits"]
+        field = rng.choice(["env", "deviceNodes", "mounts", "hooks"])
+        edits[field] = _rand_value(rng)
+        return obj
+
+    for _ in range(N):
+        case = rng.choice([
+            _rand_value(rng),
+            _mutate(rng, base),
+            mutate_nested(rng),
+        ])
+        errs = validate_spec(case)
+        assert isinstance(errs, list)
+        assert all(isinstance(e, str) for e in errs)

@@ -59,10 +59,20 @@ def _check_edits(edits: Any, where: str, version: str,
                             "intelRdt", "additionalGIDs"}
     if unknown:
         errs.append(f"{where}: unknown containerEdits fields {sorted(unknown)}")
-    for i, e in enumerate(edits.get("env") or []):
+
+    def listed(field: str) -> list:
+        v = edits.get(field)
+        if v is None:
+            return []
+        if not isinstance(v, list):
+            errs.append(f"{where}.{field}: must be a list")
+            return []
+        return v
+
+    for i, e in enumerate(listed("env")):
         if not isinstance(e, str) or "=" not in e or e.startswith("="):
             errs.append(f"{where}.env[{i}]: must be 'NAME=value', got {e!r}")
-    for i, node in enumerate(edits.get("deviceNodes") or []):
+    for i, node in enumerate(listed("deviceNodes")):
         w = f"{where}.deviceNodes[{i}]"
         if not isinstance(node, dict):
             errs.append(f"{w}: must be an object")
@@ -85,7 +95,7 @@ def _check_edits(edits: Any, where: str, version: str,
         for fld in ("major", "minor", "uid", "gid"):
             if fld in node and not isinstance(node[fld], int):
                 errs.append(f"{w}: {fld} must be an integer")
-    for i, m in enumerate(edits.get("mounts") or []):
+    for i, m in enumerate(listed("mounts")):
         w = f"{where}.mounts[{i}]"
         if not isinstance(m, dict):
             errs.append(f"{w}: must be an object")
@@ -101,7 +111,7 @@ def _check_edits(edits: Any, where: str, version: str,
         if opts is not None and (not isinstance(opts, list) or any(
                 not isinstance(o, str) for o in opts)):
             errs.append(f"{w}: options must be a list of strings")
-    for i, h in enumerate(edits.get("hooks") or []):
+    for i, h in enumerate(listed("hooks")):
         w = f"{where}.hooks[{i}]"
         if not isinstance(h, dict) or h.get("hookName") not in _HOOKS:
             errs.append(f"{w}: hookName must be one of {sorted(_HOOKS)}")
