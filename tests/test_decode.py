@@ -377,3 +377,43 @@ def test_prefill_chunked_int8_cache(small):
     a = np.asarray(ref, np.float32).ravel()
     b = np.asarray(logits, np.float32).ravel()
     assert float(np.corrcoef(a, b)[0, 1]) > 0.98
+
+
+def test_speculative_decode_sampled():
+    """Sampled speculative decoding (rejection scheme): valid tokens,
+    reproducible per rng, different seeds diverge, and a perfect draft
+    still commits up to k per pass (distribution-exactness is pinned at
+    the commit level in test_spec_sample.py)."""
+    from tpu_dra.workloads.decode import decode, speculative_decode
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(30))
+    draft_cfg = ModelConfig(vocab=64, d_model=16, n_heads=2, n_layers=1,
+                            d_ff=32, max_seq=64)
+    dparams = init_params(draft_cfg, jax.random.PRNGKey(99))
+    B, S, steps = 2, 5, 9
+    prompt = jax.random.randint(jax.random.PRNGKey(31), (B, S), 0,
+                                cfg.vocab, dtype=jnp.int32)
+
+    def run(seed, dcfg=draft_cfg, dp=dparams):
+        return speculative_decode(
+            cfg, params, dcfg, dp, prompt, steps=steps, k=4,
+            temperature=0.9, top_k=8, return_stats=True,
+            rng=jax.random.PRNGKey(seed))
+
+    got, stats = run(1)
+    assert got.shape == (B, steps)
+    assert bool(jnp.all((got >= 0) & (got < cfg.vocab)))
+    got2, _ = run(1)
+    assert jnp.array_equal(got, got2)            # same rng, same tokens
+    got3, _ = run(2)
+    assert not jnp.array_equal(got, got3)        # seeds diverge
+    # perfect draft: acceptance ratio p/q == 1 → accepts everything →
+    # few target passes even when sampling
+    _, pstats = run(1, cfg, params)
+    assert int(pstats["target_passes"]) <= (steps + 3) // 4 + 1
+    # rng is mandatory for sampled mode
+    with pytest.raises(ValueError, match="rng"):
+        speculative_decode(cfg, params, draft_cfg, dparams, prompt,
+                           steps=steps, k=4, temperature=0.5)

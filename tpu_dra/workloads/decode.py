@@ -609,23 +609,33 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
                        max_len: int | None = None,
                        attn_impl: str = "dense",
                        return_stats: bool = False,
-                       cache_dtype: str = "bf16"):
-    """Greedy speculative decoding: a cheap draft model proposes ``k-1``
+                       cache_dtype: str = "bf16",
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 0.0, rng=None):
+    """Speculative decoding: a cheap draft model proposes ``k-1``
     tokens autoregressively, the target verifies them in ONE cached
-    ``k``-token chunk forward, and the longest matching prefix plus the
-    target's own next token commit together — target quality at up to
-    ``k`` tokens per target pass.
+    ``k``-token chunk forward, and up to ``k`` tokens commit per target
+    pass.
 
-    Greedy acceptance makes the output EXACTLY ``greedy_decode(target)``
-    for ANY draft (tested with both a perfect and an adversarial draft);
-    the draft only changes speed.  Rejected drafts leave stale cache
-    entries beyond the committed position — the same masked-slot invariant
+    ``temperature == 0`` (default): greedy acceptance — the output is
+    EXACTLY ``greedy_decode(target)`` for ANY draft (tested with both a
+    perfect and an adversarial draft); the draft only changes speed.
+    ``temperature > 0`` (requires ``rng``): the rejection scheme
+    (spec_sample.commit_sampled) — draft proposals are drawn from the
+    draft's filtered/temperature-scaled distribution and the committed
+    stream is distributed exactly as target-only sampling under the same
+    ``temperature``/``top_k``/``top_p``.  The mode is static at trace
+    time (like ``decode``).  Rejected drafts leave stale cache entries
+    beyond the committed position — the same masked-slot invariant
     ragged decode relies on makes them invisible until overwritten.
 
     Both models must share the vocab; returns [B, steps] int32 tokens.
     """
     assert k >= 2, k
     assert cfg.vocab == draft_cfg.vocab, (cfg.vocab, draft_cfg.vocab)
+    sampling = temperature > 0
+    if sampling and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
     B, S = prompt.shape
     max_len = max_len or cfg.max_seq
     # every iteration commits ≥1 token and writes ≤k cache slots past the
@@ -643,7 +653,14 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
     d_cache = init_kv_cache(draft_cfg, B, max_len, cache_dtype)
     d_cache, _ = prefill(draft_cfg, draft_params, d_cache, prompt, attn_impl)
 
-    last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # committed #1
+    if sampling:
+        keys = jax.random.split(rng, B + 1)
+        first_key, keys = keys[0], keys[1:]
+        last = _select_token(t_logits, first_key, temperature, top_k,
+                             top_p)                          # committed #1
+    else:
+        keys = jnp.zeros((B, 2), jnp.uint32)    # carry placeholder
+        last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
     width = steps + k                                        # overshoot room
     out = jnp.zeros((B, width), jnp.int32).at[:, 0].set(last)
     count = jnp.ones((B,), jnp.int32)
@@ -658,42 +675,66 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
         return jnp.where(jnp.reshape(done, shape), old, new)
 
     def iteration(carry):
-        t_cache, d_cache, pos, last, out, count, it = carry
+        t_cache, d_cache, pos, last, out, count, keys, it = carry
         done = count >= steps
 
         # 1. draft proposes: processes last, d1, …, d_{k-1} (k steps, so
         #    its cache covers pos … pos+k-1 — every position a full-accept
-        #    iteration commits; the k-th proposal is discarded)
+        #    iteration commits; the k-th proposal is discarded).  With
+        #    sampling, proposals are drawn from the SAME filtered/scaled
+        #    distribution the commit scores them against.
         def draft_step(c, j):
-            d_cache, tok = c
+            d_cache, tok, keys = c
             lg, d_cache = _token_logits(draft_cfg, draft_params, d_cache,
                                         pos + j, tok)
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return (d_cache, nxt), nxt
+            if sampling:
+                split = jax.vmap(jax.random.split)(keys)
+                keys, draw = split[:, 0], split[:, 1]
+                filt = _filter_topk_topp(lg / temperature, top_k, top_p)
+                nxt = jax.vmap(
+                    lambda kk, l: jax.random.categorical(kk, l)
+                )(draw, filt).astype(jnp.int32)
+            else:
+                filt = jnp.zeros((0,))
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (d_cache, nxt, keys), (nxt, filt)
 
-        (d_cache2, _), drafts = jax.lax.scan(
-            draft_step, (d_cache, last),
+        (d_cache2, _, keys), (drafts, q_filt) = jax.lax.scan(
+            draft_step, (d_cache, last, keys),
             jnp.arange(k, dtype=jnp.int32))
         drafts = drafts.T[:, : k - 1]                        # [B, k-1]
 
         # 2. target verifies [last, d1 … d_{k-1}] in one chunk forward
         chunk = jnp.concatenate([last[:, None], drafts], axis=1)  # [B, k]
         t_lg, t_cache2 = _chunk_logits(cfg, params, t_cache, pos, chunk)
-        preds = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)       # [B, k]
 
-        # 3. longest prefix where the target agrees with the draft, then
-        #    the target's own next token (the "bonus") commits
-        match = (drafts == preds[:, :-1]).astype(jnp.int32)       # [B, k-1]
-        n = jnp.cumprod(match, axis=1).sum(axis=1)                # [B]
-        bonus = jnp.take_along_axis(preds, n[:, None], axis=1)[:, 0]
-
-        # 4. emit d1…dn then bonus (slot j>n dropped; frozen rows emit
-        #    nothing — their dest is forced out of bounds)
+        # 3. commit: longest agreeing prefix + bonus (greedy) or the
+        #    rejection scheme (sampled)
         j = jnp.arange(k, dtype=jnp.int32)[None, :]
-        padded = jnp.concatenate(
-            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
-        emit = jnp.where(j < n[:, None], padded,
-                         jnp.where(j == n[:, None], bonus[:, None], 0))
+        if sampling:
+            from tpu_dra.workloads.spec_sample import commit_sampled
+            t_filt = _filter_topk_topp(
+                (t_lg / temperature).reshape(B * k, -1), top_k,
+                top_p).reshape(t_lg.shape)
+            q_filt = q_filt[: k - 1].transpose(1, 0, 2)      # [B, k-1, V]
+            last2, _, _, emit, counts = commit_sampled(
+                last, pos, jnp.full((B,), -1, jnp.int32), done,
+                drafts, t_filt, q_filt, keys)
+            keys = jax.vmap(lambda s: jax.random.fold_in(s, 11))(keys)
+            n = jnp.maximum(counts - 1, 0)
+        else:
+            preds = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)  # [B, k]
+            match = (drafts == preds[:, :-1]).astype(jnp.int32)
+            n = jnp.cumprod(match, axis=1).sum(axis=1)           # [B]
+            bonus = jnp.take_along_axis(preds, n[:, None], axis=1)[:, 0]
+            padded = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            emit = jnp.where(j < n[:, None], padded,
+                             jnp.where(j == n[:, None], bonus[:, None], 0))
+            last2 = jnp.where(done, last, bonus)
+
+        # 4. emit d1…dn then the final token (slot j>n dropped; frozen
+        #    rows emit nothing — their dest is forced out of bounds)
         dest = count[:, None] + j
         dest = jnp.where((j <= n[:, None]) & ~done[:, None], dest, width)
         out = out.at[rows[:, None], dest].set(emit, mode="drop")
@@ -706,9 +747,10 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
             {key: freeze(done, d_cache2[key], d_cache[key], 1)
              for key in d_cache},
             jnp.where(done, pos, pos + adv),
-            jnp.where(done, last, bonus),
+            last2,
             out,
             jnp.where(done, count, count + adv),
+            keys,
             it + 1,
         )
 
@@ -716,12 +758,13 @@ def speculative_decode(cfg: ModelConfig, params, draft_cfg: ModelConfig,
         # early exit the moment every row has its tokens — the whole point
         # is fewer target passes; steps-1 iterations is the worst case
         # (count starts at 1, every iteration commits ≥1)
-        count, it = carry[5], carry[6]
+        count, it = carry[5], carry[7]
         return jnp.logical_and(jnp.any(count < steps), it < steps)
 
-    (t_cache, d_cache, pos, last, out, count, it) = jax.lax.while_loop(
+    (t_cache, d_cache, pos, last, out, count, keys,
+     it) = jax.lax.while_loop(
         not_done, iteration,
-        (t_cache, d_cache, pos, last, out, count,
+        (t_cache, d_cache, pos, last, out, count, keys,
          jnp.zeros((), jnp.int32)))
     if return_stats:
         # `it` == number of target verify passes: the speedup observable

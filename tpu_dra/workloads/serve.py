@@ -26,7 +26,9 @@ POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
 POST /stream    (continuous mode, one row) chunked NDJSON: a
              {"token": id} line per generated token as it lands, then
              {"done": true, "tokens": [...]}
-POST /speculative {"tokens": [[...]], "steps": N, "k": 4}
+POST /speculative {"tokens": [[...]], "steps": N, "k": 4,
+                   "temperature": 0.0, "top_k": 0, "top_p": 0.0,
+                   "seed": 0}
              → {"tokens": [[...]], "target_passes": M}   (draft-assisted
                  greedy: tokens EXACTLY equal /generate's greedy output;
                  steps/M ≈ tokens committed per serving-model pass.
@@ -184,12 +186,16 @@ class DecoderPool:
             self.draft_cfg = draft_cfg
             self.draft_params = draft_params
 
-    def speculative(self, rows: list[list[int]], steps: int, k: int = 4):
+    def speculative(self, rows: list[list[int]], steps: int, k: int = 4,
+                    temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 0.0, seed: int = 0):
         """Speculative decode over equal-length rows → (tokens
-        [rows][steps], target verify passes).  Tokens are EXACTLY the
-        greedy serving-model output; ``target_passes`` is the speedup
-        observable (steps/passes ≈ tokens committed per serving-model
-        pass, up to k).  Requires ``set_draft``."""
+        [rows][steps], target verify passes).  At temperature 0 the
+        tokens are EXACTLY the greedy serving-model output; sampled
+        requests commit via the rejection scheme and stay distributed
+        exactly as serving-model-only sampling.  ``target_passes`` is
+        the speedup observable (steps/passes ≈ tokens committed per
+        serving-model pass, up to k).  Requires ``set_draft``."""
         from tpu_dra.workloads.decode import speculative_decode
 
         if getattr(self, "draft_params", None) is None:
@@ -199,7 +205,8 @@ class DecoderPool:
             raise ValueError(f"k must be in [2, 16], got {k}")
         B, S, prompts = self._prep_equal_length(
             rows, steps, extra=k, what="speculative decoding")
-        key = ("spec", B, S, steps, int(k))
+        key = ("spec", B, S, steps, int(k), float(temperature),
+               int(top_k), float(top_p))
         with self._lock:
             # fn and draft_params snapshot TOGETHER: a concurrent
             # set_draft swaps both, and a fn compiled for the old
@@ -209,11 +216,15 @@ class DecoderPool:
                 fn = jax.jit(partial(
                     speculative_decode, self.cfg,
                     draft_cfg=self.draft_cfg, steps=steps, k=k,
+                    temperature=float(temperature), top_k=int(top_k),
+                    top_p=float(top_p),
                     return_stats=True, cache_dtype=self.cache_dtype))
                 self._fns[key] = fn
             draft_params = self.draft_params
         toks, stats = fn(self.params, draft_params=draft_params,
-                         prompt=prompts)
+                         prompt=prompts,
+                         rng=(jax.random.PRNGKey(seed)
+                              if temperature > 0 else None))
         return ([toks[i].tolist() for i in range(len(rows))],
                 int(stats["target_passes"]))
 
@@ -610,7 +621,11 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                 def handle(req):
                     toks, passes = pool.speculative(
                         req["tokens"], int(req.get("steps", 16)),
-                        int(req.get("k", 4)))
+                        int(req.get("k", 4)),
+                        temperature=float(req.get("temperature", 0.0)),
+                        top_k=int(req.get("top_k", 0)),
+                        top_p=float(req.get("top_p", 0.0)),
+                        seed=int(req.get("seed", 0)))
                     return {"tokens": toks, "target_passes": passes}
                 self._json_post(handle)
             elif self.path == "/generate":
