@@ -515,3 +515,46 @@ def test_speculative_sampled_paged(params, draft_params):
                            timeout=300) == s1
     finally:
         eng2.shutdown()
+
+
+def test_speculative_prefix_join_matches_plain(params, draft_params):
+    """Prefix joins through the speculative engine: byte parity with the
+    plain engine's prefix join for any draft (greedy acceptance)."""
+    prefix = list(range(20, 36))
+    suffixes = [([1, 2], 6), ([3], 8)]
+    plain = ContinuousEngine(CFG, params, slots=2, chunk=2)
+    try:
+        pid = plain.register_prefix(prefix)
+        want = [plain.submit(s, st, prefix_id=pid, timeout=300)
+                for s, st in suffixes]
+    finally:
+        plain.shutdown()
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                            draft=(DRAFT_CFG, draft_params))
+    try:
+        pid = spec.register_prefix(prefix)
+        assert spec._prefixes[pid].dkv is not None
+        got = [spec.submit(s, st, prefix_id=pid, timeout=300)
+               for s, st in suffixes]
+    finally:
+        spec.shutdown()
+    assert got == want
+
+
+def test_speculative_prefix_join_draft_sees_context(params):
+    """draft == target through a prefix join must FULL-ACCEPT: if the
+    draft's cache missed the prefix KV, its proposals would diverge from
+    the target's and acceptance would collapse — this is the sharp
+    detector for the dual-cache seeding."""
+    prefix = list(range(40, 56))
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=4,
+                            draft=(CFG, params))
+    try:
+        pid = spec.register_prefix(prefix)
+        out = spec.submit([1, 2], 12, prefix_id=pid, timeout=300)
+        st = spec.stats()
+        assert len(out) == 12
+        assert st["spec_accept_rate"] == 1.0, st
+        assert st["spec_tokens_per_pass"] >= 3.0, st
+    finally:
+        spec.shutdown()

@@ -498,3 +498,48 @@ def test_paged_speculative_int8_matches_plain_int8():
     finally:
         eng.shutdown()
     assert got == want
+
+
+def test_paged_speculative_prefix_join_matches_plain():
+    """Paged spec engine + prefix join: byte parity with the plain slab
+    engine, shared pages written once for BOTH pools, and a
+    draft==target join full-accepts (the dual-pool seeding detector)."""
+    prefix = list(range(60, 76))                        # 2 pages of 8
+    suffixes = [([1, 2], 6), ([3], 8)]
+    plain = ContinuousEngine(CFG, PARAMS, slots=2, chunk=2, max_len=40)
+    try:
+        pid = plain.register_prefix(prefix)
+        want = [plain.submit(s, st, prefix_id=pid, timeout=300)
+                for s, st in suffixes]
+    finally:
+        plain.shutdown()
+    from tpu_dra.workloads.train import ModelConfig, init_params
+    dcfg = ModelConfig(vocab=128, d_model=32, n_heads=2, n_layers=1,
+                       d_ff=64, max_seq=64)
+    dparams = init_params(dcfg, jax.random.PRNGKey(5))
+    spec = paged_engine(slots=2, total_pages=10,
+                        draft=(dcfg, dparams))
+    try:
+        pid = spec.register_prefix(prefix)
+        pref = spec._prefixes[pid]
+        assert pref.dkv is not None and pref.pages is not None
+        got = [spec.submit(s, st, prefix_id=pid, timeout=300)
+               for s, st in suffixes]
+        # pool healthy: registry keeps its 2 pages, slots released
+        st = spec.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"] - 2
+    finally:
+        spec.shutdown()
+    assert got == want
+
+    # full-accept detector over pages: draft == target
+    spec2 = paged_engine(slots=2, chunk=4, total_pages=12,
+                         draft=(CFG, PARAMS))
+    try:
+        pid = spec2.register_prefix(prefix)
+        out = spec2.submit([1, 2], 12, prefix_id=pid, timeout=300)
+        st = spec2.stats()
+        assert len(out) == 12
+        assert st["spec_accept_rate"] == 1.0, st
+    finally:
+        spec2.shutdown()

@@ -114,6 +114,12 @@ class _Prefix:
     # carry the whole prefix in their own pages.
     pages: Optional[list[int]] = None
     pages_written: bool = False
+    # speculative engines: the DRAFT model's prefix KV (same bucket,
+    # dcfg dims) — a spec join must seed both caches, or the draft would
+    # propose against garbage context and acceptance would collapse.
+    # Paged spec engines share the page ids across both pools, so the
+    # one pages_written flag covers the paired content write.
+    dkv: Optional[dict] = None
 
 
 class ContinuousEngine:
@@ -138,14 +144,15 @@ class ContinuousEngine:
         """``draft=(draft_cfg, draft_params)`` turns each chunk dispatch
         into ONE speculative iteration: the draft proposes ``chunk-1``
         tokens, the target verifies them in a single ragged chunk
-        forward, and the longest greedy-matching prefix plus the
-        target's own next token commit together — per-slot accept
-        counts, so a slot with a lucky draft advances ``chunk`` tokens
-        for one target pass while its neighbor advances 1.  Greedy
-        acceptance keeps every request's output EXACTLY equal to the
-        non-speculative engine's (the draft only changes speed), which
-        is why speculative mode rejects sampled requests
-        (temperature > 0) and prefix joins (the draft has no prefix KV).
+        forward, and per-slot accept counts commit — a slot with a lucky
+        draft advances ``chunk`` tokens for one target pass while its
+        neighbor advances 1.  Greedy requests commit the longest
+        argmax-matching prefix (output EXACTLY equal to the plain
+        engine's — the draft only changes speed); sampled requests
+        commit via the rejection scheme (spec_sample.py, distributional
+        parity); prefix joins seed BOTH caches from the registry
+        (_Prefix.dkv).  The full request surface is supported in
+        speculative mode.
         """
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -254,7 +261,7 @@ class ContinuousEngine:
         self.max_prefixes = max_prefixes
         self._prefixes: "dict[str, _Prefix]" = {}
         self._prefill_fns: dict[int, Any] = {}
-        self._prefix_fns: dict[int, Any] = {}
+        self._prefix_fns: dict[tuple, Any] = {}   # ("t"/"d", bucket)
         self._join_fns: dict[int, Any] = {}
         # donation: the slot cache is the engine's dominant HBM object;
         # without it every dispatch copies the whole cache (double peak
@@ -739,6 +746,18 @@ class ContinuousEngine:
         sampled = _select_token(logits / jnp.maximum(temp, 1e-6),
                                 key, 1.0, self.top_k, self.top_p)
         first = jnp.where(temp > 0, sampled, greedy)[0]
+        cache = self._scatter_join_cols(cache, small, width, start_page,
+                                        row)
+        return cache, first
+
+    @staticmethod
+    def _scatter_join_cols(cache, small, width, start_page, row):
+        """Scatter a join scratch's owned columns — the prefix-tail
+        partial page plus the suffix, [start_page·ps, width) — into the
+        slot's block-table pages.  ONE implementation for the plain and
+        speculative paged joins (both pools share page geometry; a
+        write-window fix must hit both or their byte-parity breaks)."""
+        from tpu_dra.workloads.paged_kv import scatter_pages_raw
         ps = cache["k"].shape[3]
         start_col = start_page * ps
         n_write = -(-(width - start_col) // ps)
@@ -750,9 +769,7 @@ class ContinuousEngine:
                 cols[name], ((0, 0),) * 3 + ((0, pad), (0, 0)))
                 for name in cols}
         rows_write = row[None, start_page:start_page + n_write]
-        from tpu_dra.workloads.paged_kv import scatter_pages_raw
-        cache = scatter_pages_raw(cache, cols, rows_write)
-        return cache, first
+        return scatter_pages_raw(cache, cols, rows_write)
 
     def _paged_join_fn(self, suffix_bucket: int, prefix_bucket: int,
                        start_page: int):
@@ -762,6 +779,85 @@ class ContinuousEngine:
             fn = jax.jit(partial(self._paged_join_impl, self.cfg,
                                  start_page),
                          donate_argnums=(1,))           # the page pool
+            self._paged_join_fns[key] = fn
+        return fn
+
+    def _draft_join_cache(self, dcfg, dparams, dcache, dpkv, suffix,
+                          plen, write):
+        """Draft half of a speculative prefix join: seed a scratch with
+        the draft's prefix KV, run the suffix through the draft trunk at
+        positions [plen, plen+Sb), and hand the filled scratch to
+        ``write`` (slot copy for slab, page scatter for paged).  Only
+        the KV writes matter — the draft's logits are not consumed at
+        join time (the first token comes from the target)."""
+        Pb, Sb = dpkv["k"].shape[3], suffix.shape[1]
+        width = min(Pb + Sb, self.max_len)
+        small = {name: jnp.zeros(
+            (dcfg.n_layers, 1, dcfg.kv_heads, width,
+             1 if name.endswith("_s") else dcfg.d_head),
+            buf.dtype) for name, buf in dcache.items()}
+        small = {name: jax.lax.dynamic_update_slice(
+            small[name], dpkv[name].astype(small[name].dtype),
+            (0, 0, 0, 0, 0)) for name in small}
+        _, small = _chunk_hidden(dcfg, dparams, small,
+                                 jnp.reshape(plen, (1,)), suffix)
+        return write(dcache, small, width)
+
+    def _spec_join_impl(self, cfg, dcfg, params, dparams, cache, dcache,
+                        pkv, dpkv, suffix, slen, plen, slot, temp, key):
+        """Slab speculative join: the target half is the plain join
+        (prefix KV copy + suffix chunk + first-token select); the draft
+        half seeds ITS slot rows the same way so proposals attend the
+        full context."""
+        cache, first = self._prefix_join_impl(
+            cfg, params, cache, pkv, suffix, slen, plen, slot, temp,
+            key)
+
+        def write(dcache, small, width):
+            return {name: jax.lax.dynamic_update_slice(
+                dcache[name], small[name].astype(dcache[name].dtype),
+                (0, slot, 0, 0, 0)) for name in dcache}
+
+        dcache = self._draft_join_cache(dcfg, dparams, dcache, dpkv,
+                                        suffix, plen, write)
+        return cache, dcache, first
+
+    def _spec_join_fn(self, suffix_bucket: int, prefix_bucket: int):
+        key = ("spec", suffix_bucket, prefix_bucket)
+        fn = self._join_fns.get(key)
+        if fn is None:
+            fn = jax.jit(partial(self._spec_join_impl, self.cfg,
+                                 self.draft[0]),
+                         donate_argnums=(2, 3))         # both caches
+            self._join_fns[key] = fn
+        return fn
+
+    def _paged_spec_join_impl(self, cfg, dcfg, start_page, params,
+                              dparams, cache, dcache, pkv, dpkv, suffix,
+                              slen, plen, row, temp, key):
+        """Paged speculative join: target half = plain paged join; the
+        draft half scatters its prefix-tail + suffix KV into the SAME
+        block-table pages of its own pool (the pools share page ids)."""
+        cache, first = self._paged_join_impl(
+            cfg, start_page, params, cache, pkv, suffix, slen, plen,
+            row, temp, key)
+
+        def write(dcache, small, width):
+            return self._scatter_join_cols(dcache, small, width,
+                                           start_page, row)
+
+        dcache = self._draft_join_cache(dcfg, dparams, dcache, dpkv,
+                                        suffix, plen, write)
+        return cache, dcache, first
+
+    def _paged_spec_join_fn(self, suffix_bucket: int, prefix_bucket: int,
+                            start_page: int):
+        key = ("spec", suffix_bucket, prefix_bucket, start_page)
+        fn = self._paged_join_fns.get(key)
+        if fn is None:
+            fn = jax.jit(partial(self._paged_spec_join_impl, self.cfg,
+                                 self.draft[0], start_page),
+                         donate_argnums=(2, 3))         # both pools
             self._paged_join_fns[key] = fn
         return fn
 
@@ -791,12 +887,24 @@ class ContinuousEngine:
         Pb = self._bucket(len(tokens))
         prompt = jnp.asarray([tokens + [0] * (Pb - len(tokens))],
                              jnp.int32)
-        fn = self._prefix_fns.get(Pb)
+        fn = self._prefix_fns.get(("t", Pb))
         if fn is None:
             fn = jax.jit(partial(self._prefix_kv_impl, self.cfg))
-            self._prefix_fns[Pb] = fn
+            self._prefix_fns[("t", Pb)] = fn
         kv = fn(self.params, prompt)
         jax.block_until_ready(kv["k"])
+        dkv = None
+        if self.draft is not None:
+            # the draft needs its own prefix KV (dcfg dims; same
+            # cache dtype — _prefix_kv_impl templates dtypes from the
+            # target pool, which both pools share)
+            fnd = self._prefix_fns.get(("d", Pb))
+            if fnd is None:
+                fnd = jax.jit(partial(self._prefix_kv_impl,
+                                      self.draft[0]))
+                self._prefix_fns[("d", Pb)] = fnd
+            dkv = fnd(self.draft[1], prompt)
+            jax.block_until_ready(dkv["k"])
         pages = None
         if self.kv_layout == "paged":
             # reserve the prefix's FULL pages for zero-copy sharing; a
@@ -822,7 +930,7 @@ class ContinuousEngine:
                     next(iter(self._prefixes)))   # LRU: oldest first
                 self._evict_prefix_pages(evicted)
             self._prefixes[pid] = _Prefix(list(tokens), kv, len(tokens),
-                                          Pb, pages=pages)
+                                          Pb, pages=pages, dkv=dkv)
         return pid
 
     def _evict_prefix_pages(self, pref: "_Prefix") -> None:
@@ -869,16 +977,11 @@ class ContinuousEngine:
             raise ValueError(f"steps must be >= 1, got {steps}")
         if eos_id is not None and not 0 <= eos_id < cfg.vocab:
             raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
-        if self.draft is not None:
-            # greedy requests keep byte-parity with the plain engine
-            # (argmax acceptance); sampled requests commit via the
-            # rejection scheme (spec_sample.py — the committed stream is
-            # distributed exactly as target-only ancestral sampling, for
-            # any draft).  Prefix joins stay out of the speculative
-            # contract (no draft-side prefix KV).
-            if prefix_id is not None:
-                raise ValueError("speculative engine does not support "
-                                 "prefix joins")
+        # speculative engines accept the full request surface: greedy
+        # requests keep byte-parity with the plain engine (argmax
+        # acceptance), sampled requests commit via the rejection scheme
+        # (spec_sample.py), and prefix joins seed BOTH caches (the
+        # registry carries the draft's prefix KV, _Prefix.dkv)
         if self.kv_layout == "paged":
             _, need, _ = self._paged_requirements(len(prompt), steps,
                                                   prefix_id)
@@ -1218,27 +1321,55 @@ class ContinuousEngine:
                 # (int8 engines registered it quantized), so raw scatter
                 full_cols = len(write_pages) * ps
                 from tpu_dra.workloads.paged_kv import scatter_pages_raw
+                rows_w = jnp.asarray([write_pages], jnp.int32)
                 self._cache = scatter_pages_raw(
                     self._cache,
                     {name: buf[:, :, :, :full_cols]
                      for name, buf in pref.kv.items()},
-                    jnp.asarray([write_pages], jnp.int32))
+                    rows_w)
+                if self.draft is not None:
+                    # both pools share page ids: the draft's prefix
+                    # content lands in ITS pool under the same rows
+                    self._dcache = scatter_pages_raw(
+                        self._dcache,
+                        {name: buf[:, :, :, :full_cols]
+                         for name, buf in pref.dkv.items()},
+                        rows_w)
             start_page = len(self._shared_ids[slot])
-            cache, first = self._paged_join_fn(Sb, pref.bucket,
-                                               start_page)(
-                self.params, self._cache, pref.kv, prompt,
+            if self.draft is not None:
+                (self._cache, self._dcache,
+                 first) = self._paged_spec_join_fn(Sb, pref.bucket,
+                                                   start_page)(
+                    self.params, self.draft[1], self._cache,
+                    self._dcache, pref.kv, pref.dkv, prompt,
+                    jnp.asarray([len(req.prompt)], jnp.int32),
+                    jnp.int32(pref.length), self._table[slot],
+                    jnp.float32(req.temperature),
+                    jax.random.fold_in(key, 0))
+            else:
+                self._cache, first = self._paged_join_fn(
+                    Sb, pref.bucket, start_page)(
+                    self.params, self._cache, pref.kv, prompt,
+                    jnp.asarray([len(req.prompt)], jnp.int32),
+                    jnp.int32(pref.length), self._table[slot],
+                    jnp.float32(req.temperature),
+                    jax.random.fold_in(key, 0))
+        elif self.draft is not None:
+            (self._cache, self._dcache,
+             first) = self._spec_join_fn(Sb, pref.bucket)(
+                self.params, self.draft[1], self._cache, self._dcache,
+                pref.kv, pref.dkv, prompt,
                 jnp.asarray([len(req.prompt)], jnp.int32),
-                jnp.int32(pref.length), self._table[slot],
+                jnp.int32(pref.length), jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.fold_in(key, 0))
         else:
-            cache, first = self._join_fn(Sb, pref.bucket)(
+            self._cache, first = self._join_fn(Sb, pref.bucket)(
                 self.params, self._cache, pref.kv, prompt,
                 jnp.asarray([len(req.prompt)], jnp.int32),
                 jnp.int32(pref.length), jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.fold_in(key, 0))
-        self._cache = cache
         self._finish_admission(slot, req, int(first),
                                pref.length + len(req.prompt), key)
 
