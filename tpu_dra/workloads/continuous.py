@@ -31,8 +31,9 @@ via the rejection scheme (``spec_sample.py`` — accept draft token with
 prob min(1, p/q), resample the first rejection from norm(max(p-q, 0)),
 bonus-sample a full accept), so their committed stream is distributed
 exactly as target-only sampling.  Both kinds batch together (the commit
-routes per slot).  Prefix joins are rejected in this mode (see
-__init__).
+routes per slot), and prefix joins seed BOTH caches from the registry's
+draft-side prefix KV (``_Prefix.dkv``) — the full request surface works
+in speculative mode.
 
 Sampling: per-request ``temperature`` (0 = greedy) via a per-slot
 temperature vector; ``top_k``/``top_p`` are engine-global statics (a
