@@ -543,3 +543,74 @@ def test_paged_speculative_prefix_join_matches_plain():
         assert st["spec_accept_rate"] == 1.0, st
     finally:
         spec2.shutdown()
+
+
+def test_paged_spec_mixed_churn():
+    """Randomized concurrency churn over the FULL speculative surface:
+    greedy, sampled, and prefix-join requests racing on a paged spec
+    engine.  Invariants: every request completes with the right length,
+    greedy non-prefix outputs byte-match the plain engine, and the page
+    pool heals to registry-only residency."""
+    import random
+
+    from tpu_dra.workloads.train import ModelConfig, init_params
+    dcfg = ModelConfig(vocab=128, d_model=32, n_heads=2, n_layers=1,
+                       d_ff=64, max_seq=64)
+    dparams = init_params(dcfg, jax.random.PRNGKey(5))
+    prefix = list(range(80, 96))                        # 2 pages of 8
+
+    rng = random.Random(20260731)
+    reqs = []
+    for i in range(12):
+        kind = rng.choice(["greedy", "sampled", "prefix"])
+        prompt = [1 + rng.randrange(100) for _ in range(
+            rng.choice([1, 2, 3]))]
+        steps = rng.choice([3, 5, 8])
+        reqs.append((kind, prompt, steps, rng.randrange(1000)))
+
+    plain = ContinuousEngine(CFG, PARAMS, slots=3, chunk=2, max_len=40)
+    try:
+        want = {}
+        for i, (kind, prompt, steps, seed) in enumerate(reqs):
+            if kind == "greedy":
+                want[i] = plain.submit(prompt, steps, timeout=300)
+    finally:
+        plain.shutdown()
+
+    eng = paged_engine(slots=3, total_pages=14, draft=(dcfg, dparams))
+    results: dict[int, list[int]] = {}
+    errs: list[BaseException] = []
+    try:
+        pid = eng.register_prefix(prefix)
+
+        def worker(i, kind, prompt, steps, seed):
+            try:
+                if kind == "greedy":
+                    results[i] = eng.submit(prompt, steps, timeout=300)
+                elif kind == "sampled":
+                    results[i] = eng.submit(prompt, steps,
+                                            temperature=0.8, seed=seed,
+                                            timeout=300)
+                else:
+                    results[i] = eng.submit(prompt, steps,
+                                            prefix_id=pid, timeout=300)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i, *r))
+                   for i, r in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errs, errs[:2]
+        assert len(results) == len(reqs)
+        for i, (kind, prompt, steps, seed) in enumerate(reqs):
+            assert len(results[i]) == steps, (i, kind)
+            if kind == "greedy":
+                assert results[i] == want[i], (i, kind)
+        st = eng.stats()
+        assert st["kv_pages_free"] == st["kv_pages_total"] - 2
+        assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    finally:
+        eng.shutdown()
