@@ -558,3 +558,28 @@ def test_speculative_prefix_join_draft_sees_context(params):
         assert st["spec_tokens_per_pass"] >= 3.0, st
     finally:
         spec.shutdown()
+
+
+def test_speculative_int8_cache_matches_plain_int8(params, draft_params):
+    """Spec engine composes with the int8 KV cache: greedy outputs byte-
+    match the plain int8 engine; sampled + prefix work end to end."""
+    plain = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                             cache_dtype="int8")
+    try:
+        want = plain.submit([3, 5, 7], 8, timeout=300)
+        pid = plain.register_prefix(list(range(20, 28)))
+        want_p = plain.submit([1, 2], 6, prefix_id=pid, timeout=300)
+    finally:
+        plain.shutdown()
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=2,
+                            cache_dtype="int8",
+                            draft=(DRAFT_CFG, draft_params))
+    try:
+        assert spec.submit([3, 5, 7], 8, timeout=300) == want
+        pid = spec.register_prefix(list(range(20, 28)))
+        assert spec.submit([1, 2], 6, prefix_id=pid, timeout=300) == want_p
+        sampled = spec.submit([4, 5], 6, temperature=0.8, seed=3,
+                              timeout=300)
+        assert len(sampled) == 6
+    finally:
+        spec.shutdown()

@@ -312,6 +312,10 @@ class ServeMetrics:
                 "speculative mode: committed tokens per live slot per "
                 "target pass (1.0 parity, chunk ceiling)",
                 stats.get("spec_tokens_per_pass")),
+            "tpu_serve_engine_spec_accept_rate": (
+                "speculative mode: accepted drafted tokens / proposed "
+                "(1.0 ceiling; ~1/vocab random draft)",
+                stats.get("spec_accept_rate")),
         }
         for name, (help_, value) in gauges.items():
             if value is not None:
