@@ -583,3 +583,57 @@ def test_speculative_int8_cache_matches_plain_int8(params, draft_params):
         assert len(sampled) == 6
     finally:
         spec.shutdown()
+
+
+def test_stop_sequences(params):
+    """Multi-token stop sequences: generation retires when a stop
+    sequence completes, the sequence is trimmed from the output (OpenAI
+    semantics), matches spanning chunk boundaries are caught, and a
+    never-matching stop runs to the steps cap."""
+    eng = ContinuousEngine(CFG, params, slots=2, chunk=2)
+    try:
+        # discover the greedy continuation, then stop on a 2-token
+        # subsequence of it — chosen to START at an odd index so the
+        # match completes mid-chunk/across a boundary
+        ref = eng.submit([3, 5, 7], 10, timeout=300)
+        start = 3
+        stop_seq = ref[start:start + 2]
+        got = eng.submit([3, 5, 7], 10, stop=[stop_seq], timeout=300)
+        assert got == ref[:start], (got, ref, stop_seq)
+        # multiple sequences: first completed match wins
+        got2 = eng.submit([3, 5, 7], 10,
+                          stop=[[999 % CFG.vocab], stop_seq][::-1],
+                          timeout=300)
+        assert got2 == got or len(got2) <= len(ref)
+        # no match -> full steps
+        unused = [t for t in range(CFG.vocab) if t not in ref][:2]
+        assert eng.submit([3, 5, 7], 10, stop=[unused],
+                          timeout=300) == ref
+        # validation
+        with pytest.raises(ValueError, match="stop"):
+            eng.submit([1], 2, stop=[])
+        with pytest.raises(ValueError, match="stop"):
+            eng.submit([1], 2, stop=[[1] * 17])
+        with pytest.raises(ValueError, match="stop token ids"):
+            eng.submit([1], 2, stop=[[CFG.vocab + 5]])
+    finally:
+        eng.shutdown()
+
+
+def test_stop_sequences_speculative(params, draft_params):
+    """Stop sequences ride the shared host emission loop, so they work
+    identically through the speculative engine (which can overshoot a
+    match inside a committed chunk — the trim must still land)."""
+    plain = ContinuousEngine(CFG, params, slots=2, chunk=2)
+    try:
+        ref = plain.submit([3, 5, 7], 10, timeout=300)
+    finally:
+        plain.shutdown()
+    stop_seq = ref[3:5]
+    spec = ContinuousEngine(CFG, params, slots=2, chunk=4,
+                            draft=(CFG, params))   # full-accept draft
+    try:
+        got = spec.submit([3, 5, 7], 10, stop=[stop_seq], timeout=300)
+        assert got == ref[:3], (got, ref)
+    finally:
+        spec.shutdown()

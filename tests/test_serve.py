@@ -568,3 +568,22 @@ def test_speculative_endpoint_sampled(server):
         assert post2({**body, "seed": 10})["tokens"] != out["tokens"]
     finally:
         srv.shutdown()
+
+
+def test_engine_generate_stop_sequences():
+    """Engine /generate "stop": retires on the completed sequence and
+    trims it from the returned tokens."""
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = srv.server_address
+    base = f"http://{host}:{port}"
+    try:
+        ref = _post(base, {"tokens": [[3, 5, 7]], "steps": 8})["tokens"][0]
+        stop_seq = ref[2:4]
+        got = _post(base, {"tokens": [[3, 5, 7]], "steps": 8,
+                           "stop": [stop_seq]})["tokens"][0]
+        assert got == ref[:2], (got, ref)
+    finally:
+        srv.shutdown()

@@ -81,6 +81,10 @@ class _Request:
     temperature: float
     seed: int
     prefix_id: Optional[str] = None   # registered shared-KV prefix
+    # multi-token stop sequences (generated tokens only): the host
+    # emission loop suffix-matches after every committed token, trims
+    # the match, and retires the request — no jit surface involved
+    stop: Optional[list[list[int]]] = None
     # paged admissions: the _Prefix object the gate priced and ref'd —
     # _admit_prefix refuses to join any OTHER object under the same id
     # (evict + re-register between gate and join swaps the registry
@@ -947,15 +951,17 @@ class ContinuousEngine:
     def submit(self, prompt: list[int], steps: int,
                eos_id: Optional[int] = None, temperature: float = 0.0,
                seed: int = 0, timeout: Optional[float] = None,
-               prefix_id: Optional[str] = None) -> list[int]:
+               prefix_id: Optional[str] = None,
+               stop: Optional[list[list[int]]] = None) -> list[int]:
         """Generate ``steps`` tokens after ``prompt`` (stops early at
-        ``eos_id``); blocks until complete.  Thread-safe — concurrent
-        submissions batch dynamically.  With ``prefix_id`` the context is
-        ``registered_prefix + prompt`` and only the prompt (suffix) is
-        prefilled."""
+        ``eos_id`` or when a ``stop`` sequence completes — the matched
+        sequence is trimmed from the output); blocks until complete.
+        Thread-safe — concurrent submissions batch dynamically.  With
+        ``prefix_id`` the context is ``registered_prefix + prompt`` and
+        only the prompt (suffix) is prefilled."""
         req = self.submit_async(prompt, steps, eos_id=eos_id,
                                 temperature=temperature, seed=seed,
-                                prefix_id=prefix_id)
+                                prefix_id=prefix_id, stop=stop)
         if not req.done.wait(timeout):
             raise TimeoutError(f"request not done within {timeout}s")
         if req.error:
@@ -965,7 +971,8 @@ class ContinuousEngine:
     def submit_async(self, prompt: list[int], steps: int,
                      eos_id: Optional[int] = None,
                      temperature: float = 0.0, seed: int = 0,
-                     prefix_id: Optional[str] = None) -> _Request:
+                     prefix_id: Optional[str] = None,
+                     stop: Optional[list[list[int]]] = None) -> _Request:
         """Enqueue without blocking; the returned request's ``done`` event
         fires when ``tokens`` is complete (check ``error`` first).  Lets
         one caller fan several rows into the engine at once."""
@@ -1013,9 +1020,20 @@ class ContinuousEngine:
         if len(prompt) > _PROMPT_BUCKETS[-1]:
             raise ValueError(f"prompt exceeds the largest bucket "
                              f"{_PROMPT_BUCKETS[-1]}")
+        if stop is not None:
+            if not stop or len(stop) > 8:
+                raise ValueError("stop must be 1..8 token sequences")
+            for seq in stop:
+                if not seq or len(seq) > 16:
+                    raise ValueError(
+                        "each stop sequence must be 1..16 tokens")
+                if any(t < 0 or t >= cfg.vocab for t in seq):
+                    raise ValueError(
+                        f"stop token ids must be in [0, {cfg.vocab})")
+            stop = [list(seq) for seq in stop]
         req = _Request(prompt=list(prompt), steps=steps, eos_id=eos_id,
                        temperature=float(temperature), seed=seed,
-                       prefix_id=prefix_id)
+                       prefix_id=prefix_id, stop=stop)
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -1384,14 +1402,31 @@ class ContinuousEngine:
             -1 if req.eos_id is None else req.eos_id)
         req.tokens.append(first_host)
         self._emitted[slot] = 1
+        hit_stop = bool(req.stop) and first_host != req.eos_id \
+            and self._match_stop(req)
         finished = (req.eos_id is not None and first_host == req.eos_id
-                    ) or req.steps == 1
+                    ) or req.steps == 1 or hit_stop
         if finished:
             self._retire(slot, req)
             self._requests[slot] = None
         else:
             self._done = self._done.at[slot].set(False)
             self._requests[slot] = req
+
+    @staticmethod
+    def _match_stop(req: "_Request") -> bool:
+        """Suffix-match any of the request's stop sequences against its
+        GENERATED tokens; on match, trim the sequence from the output
+        (OpenAI "stop" semantics: the sequence itself is not returned).
+        O(sequences · max_seq_len) per emitted token, bounded by submit
+        validation (≤ 8 × ≤ 16)."""
+        toks = req.tokens
+        for seq in req.stop:
+            n = len(seq)
+            if len(toks) >= n and toks[-n:] == seq:
+                del toks[-n:]
+                return True
+        return False
 
     def _retire(self, slot: int, req: _Request) -> None:
         if self.kv_layout == "paged" and self._page_ids[slot] is not None:
@@ -1482,6 +1517,7 @@ class ContinuousEngine:
             for slot, req in enumerate(self._requests):
                 if req is None:
                     continue
+                hit_stop = False
                 for j in range(counts_host[slot]):
                     if self._emitted[slot] >= req.steps:
                         break
@@ -1490,9 +1526,13 @@ class ContinuousEngine:
                     self._emitted[slot] += 1
                     if req.eos_id is not None and tok == req.eos_id:
                         break
+                    if req.stop and self._match_stop(req):
+                        hit_stop = True
+                        break
                 hit_eos = (req.eos_id is not None and req.tokens
                            and req.tokens[-1] == req.eos_id)
-                if self._emitted[slot] >= req.steps or hit_eos:
+                if (self._emitted[slot] >= req.steps or hit_eos
+                        or hit_stop):
                     self._retire(slot, req)
                     self._requests[slot] = None
                     self._done = self._done.at[slot].set(True)

@@ -17,7 +17,9 @@ boundaries and leave on eos/steps, so mixed-length concurrent requests
 never queue behind a long generation.  POST /prefix {"tokens": [...]}
 → {"prefix_id": id} registers a shared prompt prefix (system prompt):
 its KV computes once, and /generate requests carrying "prefix_id"
-prefill only their suffix.
+prefill only their suffix.  Engine /generate also takes
+"stop": [[ids...], ...] — generation retires when a stop sequence
+completes and the sequence is trimmed from the output.
 POST /beam      {"tokens": [[...]], "steps": N, "beams": W,
                  "eos_id": null, "length_penalty": 0.0}
              → {"tokens": [[[...]]], "scores": [[...]]}   (W best per row,
@@ -345,12 +347,15 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
         reject_engine_knobs(req)
         eos = req.get("eos_id")
         prefix_id = req.get("prefix_id")
+        stop = req.get("stop")
+        if stop is not None:
+            stop = [[int(t) for t in seq] for seq in stop]
         handles = [engine.submit_async(
             r, int(req.get("steps", 16)),
             eos_id=None if eos is None else int(eos),
             temperature=float(req.get("temperature", 0.0)),
             seed=int(req.get("seed", 0)),
-            prefix_id=prefix_id) for r in rows]
+            prefix_id=prefix_id, stop=stop) for r in rows]
         out = []
         for h in handles:
             # bounded: a dead batcher fails requests via _fail_all, but a
@@ -473,12 +478,19 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                                      "tokens; fan /generate for batches")
                 reject_engine_knobs(req)
                 eos = req.get("eos_id")
+                stop = req.get("stop")
+                if stop is not None:
+                    stop = [[int(t) for t in seq] for seq in stop]
+                # with "stop", incremental lines may include tokens of a
+                # stop sequence the engine trims on match — the final
+                # {"done", "tokens"} payload is authoritative (standard
+                # streaming-stop semantics; clients reconcile)
                 handle = engine.submit_async(
                     rows[0], int(req.get("steps", 16)),
                     eos_id=None if eos is None else int(eos),
                     temperature=float(req.get("temperature", 0.0)),
                     seed=int(req.get("seed", 0)),
-                    prefix_id=req.get("prefix_id"))
+                    prefix_id=req.get("prefix_id"), stop=stop)
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as exc:
                 if metrics is not None:
