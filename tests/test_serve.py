@@ -587,3 +587,36 @@ def test_engine_generate_stop_sequences():
         assert got == ref[:2], (got, ref)
     finally:
         srv.shutdown()
+
+
+def test_stream_stop_final_tokens_authoritative():
+    """/stream with "stop": the final done payload carries the TRIMMED
+    tokens even though stop-sequence tokens may have streamed
+    incrementally before the match completed."""
+    import http.client
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=64, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = srv.server_address
+    try:
+        ref = _post(f"http://{host}:{port}",
+                    {"tokens": [[1, 2, 3]], "steps": 10})["tokens"][0]
+        stop_seq = ref[3:5]
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        conn.request("POST", "/stream",
+                     body=json.dumps({"tokens": [[1, 2, 3]],
+                                      "steps": 10,
+                                      "stop": [stop_seq]}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        lines = [json.loads(ln) for ln in resp.read().decode().splitlines()
+                 if ln.strip()]
+        conn.close()
+        final = lines[-1]
+        assert final.get("done") is True
+        assert final["tokens"] == ref[:3], (final, ref)
+    finally:
+        srv.shutdown()
