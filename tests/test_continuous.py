@@ -637,3 +637,65 @@ def test_stop_sequences_speculative(params, draft_params):
         assert got == ref[:3], (got, ref)
     finally:
         spec.shutdown()
+
+
+def test_cancel_in_flight_and_queued(params):
+    """cancel(): an in-flight request retires at the next pass boundary
+    (slot frees, no completion counted), a queued one never admits, a
+    finished one is untouched, and the engine keeps serving."""
+    import time as _t
+
+    eng = ContinuousEngine(CFG, params, slots=1, chunk=2)
+    try:
+        # occupy the single slot with a long generation
+        long_h = eng.submit_async([1, 2], 80)
+        # queue two behind it; cancel one while queued
+        q1 = eng.submit_async([3, 4], 3)
+        q2 = eng.submit_async([5, 6], 3)
+        eng.cancel(q1)
+        # let the long one emit, then cancel it mid-flight
+        deadline = _t.time() + 120
+        while _t.time() < deadline and not long_h.tokens:
+            _t.sleep(0.01)
+        assert long_h.tokens, "never started emitting"
+        eng.cancel(long_h)
+        assert long_h.done.wait(120)
+        assert long_h.error == "cancelled"
+        assert q1.done.wait(120)
+        assert q1.error == "cancelled"
+        # the slot freed and the live queue kept moving
+        assert q2.done.wait(120) and not q2.error
+        assert len(q2.tokens) == 3
+        st = eng.stats()
+        assert st["cancelled"] == 2
+        assert st["completed"] == 1
+        assert st["active"] == 0 and st["queued"] == 0
+        # cancel after completion is a no-op
+        eng.cancel(q2)
+        assert q2.error is None
+    finally:
+        eng.shutdown()
+
+
+def test_cancel_paged_frees_pages(params):
+    """Cancelling a paged in-flight request returns its pages."""
+    import time as _t
+
+    eng = ContinuousEngine(CFG, params, slots=1, chunk=2,
+                           kv_layout="paged", page_size=8, max_len=64,
+                           total_pages=8)
+    try:
+        h = eng.submit_async([1, 2], 40)
+        deadline = _t.time() + 120
+        while _t.time() < deadline and not h.tokens:
+            _t.sleep(0.01)
+        assert eng.stats()["kv_pages_free"] < 8
+        eng.cancel(h)
+        assert h.done.wait(120)
+        assert h.error == "cancelled"
+        deadline = _t.time() + 60
+        while _t.time() < deadline and eng.stats()["kv_pages_free"] != 8:
+            _t.sleep(0.01)
+        assert eng.stats()["kv_pages_free"] == 8
+    finally:
+        eng.shutdown()

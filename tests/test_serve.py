@@ -620,3 +620,53 @@ def test_stream_stop_final_tokens_authoritative():
         assert final["tokens"] == ref[:3], (final, ref)
     finally:
         srv.shutdown()
+
+
+def test_stream_disconnect_cancels_request():
+    """A client that walks away mid-stream must not burn chip time: the
+    server aborts the request (engine cancel) once the write path
+    notices, the slot frees, and the engine counts the cancellation."""
+    import http.client
+    import time as _t
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=128, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    srv = serve(cfg, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = srv.server_address
+    try:
+        orig_step = srv.engine._step_fn
+
+        def slow_step(*a, **k):
+            _t.sleep(0.02)
+            return orig_step(*a, **k)
+        srv.engine._step_fn = slow_step
+
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/stream",
+                     body=json.dumps({"tokens": [[1, 2, 3]],
+                                      "steps": 120}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.fp.readline()                 # prove tokens are flowing
+        # really sever: close the response file object AND the socket
+        # (resp.fp holds its own reference to the fd via makefile)
+        import socket as _socket
+        try:
+            conn.sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        resp.close()
+        conn.close()                       # client walks away
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            st = srv.engine.stats()
+            if st["cancelled"] >= 1 and st["active"] == 0:
+                break
+            _t.sleep(0.05)
+        st = srv.engine.stats()
+        assert st["cancelled"] >= 1, st
+        assert st["active"] == 0, st
+    finally:
+        srv.shutdown()

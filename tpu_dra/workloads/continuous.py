@@ -90,6 +90,10 @@ class _Request:
     # (evict + re-register between gate and join swaps the registry
     # entry while the slot's table still holds the old page ids)
     gate_prefix: Optional["_Prefix"] = None
+    # set by ContinuousEngine.cancel(): the batcher retires the slot at
+    # the next pass boundary (or drops the request from the queue before
+    # admission) — a disconnected client must not burn chip time
+    cancelled: bool = False
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     submitted: float = field(default_factory=time.perf_counter)
@@ -260,6 +264,7 @@ class ContinuousEngine:
         self._stop = False
         # stats
         self.completed = 0
+        self.cancelled = 0
         self.tokens_out = 0
         self.latencies_s: deque[float] = deque(maxlen=latency_window)
         # shared-prefix KV store (LRU, content-addressed)
@@ -1041,10 +1046,24 @@ class ContinuousEngine:
             self._cv.notify_all()
         return req
 
+    def cancel(self, req: _Request) -> None:
+        """Abort a request from ``submit_async``: a queued request never
+        admits, an in-flight one retires at the next pass boundary (its
+        slot — and pages — free immediately after).  The handle's
+        ``done`` fires with ``error == "cancelled"``; already-finished
+        requests are left untouched.  The vLLM-abort analog for
+        disconnected clients."""
+        with self._cv:
+            if req.done.is_set():
+                return
+            req.cancelled = True
+            self._cv.notify_all()
+
     def reset_stats(self) -> None:
         """Zero the counters/latency window — call after warmup so compile
         time never pollutes measured serving latency."""
         self.completed = 0
+        self.cancelled = 0
         self.tokens_out = 0
         self.latencies_s.clear()
         if self.draft is not None:
@@ -1056,7 +1075,9 @@ class ContinuousEngine:
 
     def stats(self) -> dict:
         lat = sorted(self.latencies_s)
-        out = {"completed": self.completed, "tokens_out": self.tokens_out,
+        out = {"completed": self.completed,
+               "cancelled": self.cancelled,
+               "tokens_out": self.tokens_out,
                "queued": len(self._pending),
                "active": sum(r is not None for r in self._requests)}
         if self.kv_layout == "paged":
@@ -1115,7 +1136,16 @@ class ContinuousEngine:
         changes its tokens."""
         assigned: list[tuple[int, _Request]] = []
         for slot in range(self.slots):
-            if self._requests[slot] is not None or not self._pending:
+            if self._requests[slot] is not None:
+                continue
+            # cancelled-while-queued requests drop at the head instead
+            # of admitting (and a cancelled head must not gate the FIFO)
+            while self._pending and self._pending[0].cancelled:
+                bad = self._pending.popleft()
+                self.cancelled += 1
+                bad.error = "cancelled"
+                bad.done.set()
+            if not self._pending:
                 continue
             if self.kv_layout == "paged":
                 # FIFO-preserving page gate: if the HEAD request cannot
@@ -1516,6 +1546,20 @@ class ContinuousEngine:
             toks_host = np.asarray(toks)            # [slots, chunk]
             for slot, req in enumerate(self._requests):
                 if req is None:
+                    continue
+                if req.cancelled:
+                    # abort: free the slot (and pages) without counting
+                    # a completion; this pass's tokens are dropped — the
+                    # client is gone
+                    if self.kv_layout == "paged" and \
+                            self._page_ids[slot] is not None:
+                        self._release_slot_pages(slot)
+                    self.cancelled += 1
+                    req.error = "cancelled"
+                    req.finished = time.perf_counter()
+                    req.done.set()
+                    self._requests[slot] = None
+                    self._done = self._done.at[slot].set(True)
                     continue
                 hit_stop = False
                 for j in range(counts_host[slot]):

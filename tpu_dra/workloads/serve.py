@@ -361,6 +361,8 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
             # bounded: a dead batcher fails requests via _fail_all, but a
             # handler thread must never hang forever regardless
             if not h.done.wait(ENGINE_REQUEST_TIMEOUT_S):
+                for h2 in handles:        # don't strand slots on timeout
+                    engine.cancel(h2)
                 raise RuntimeError(
                     f"request not done within {ENGINE_REQUEST_TIMEOUT_S}s")
             if h.error:
@@ -558,6 +560,11 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
                     timed_out = True
                     break
             toks = sent
+            if not alive or timed_out:
+                # client gone or engine wedged: abort the request so the
+                # slot (and its pages) free instead of decoding to the
+                # steps cap for nobody
+                engine.cancel(handle)
             if timed_out:
                 code = 500
                 alive and chunk({"error": f"request not done within "
