@@ -146,6 +146,7 @@ class ContinuousEngine:
     def __init__(self, cfg: ModelConfig, params, *, slots: int = 32,
                  max_len: Optional[int] = None, cache_dtype: str = "bf16",
                  chunk: int = 4, top_k: int = 0, top_p: float = 0.0,
+                 logit_bias: Optional[dict[int, float]] = None,
                  latency_window: int = 1024, max_prefixes: int = 8,
                  draft: Optional[tuple] = None,
                  kv_layout: str = "slab", page_size: int = 64,
@@ -190,6 +191,23 @@ class ContinuousEngine:
                 f"table (max_seq={cfg.max_seq})")
         self.top_k = top_k
         self.top_p = top_p
+        # engine-global logit bias (same design precedent as
+        # top_k/top_p: per-slot variants would put a dense [slots, V]
+        # add in every hot path for a niche knob).  Applied by
+        # _biased() at EVERY logits consumption point — greedy argmax,
+        # sampling filters, speculative p AND q — so ban/nudge biases
+        # (e.g. {special_token: -1e9}) hold across all modes and the
+        # cross-layout byte-parity contracts still hold under bias.
+        self._bias = None
+        if logit_bias:
+            bad = [t for t in logit_bias if not 0 <= t < cfg.vocab]
+            if bad:
+                raise ValueError(f"logit_bias token ids out of "
+                                 f"[0, {cfg.vocab}): {bad[:5]}")
+            bias = np.zeros((cfg.vocab,), np.float32)
+            for t, v in logit_bias.items():
+                bias[t] = v
+            self._bias = jnp.asarray(bias)
         # device state: fixed shapes for the whole engine lifetime
         self.draft = draft
         if draft is not None:
@@ -305,13 +323,21 @@ class ContinuousEngine:
 
     # -- compiled programs --------------------------------------------------
 
+    def _biased(self, logits):
+        """Engine-global logit bias, applied wherever logits are about
+        to be CONSUMED (argmax or sampling).  fp32 add so a -1e9 ban
+        survives bf16."""
+        if self._bias is None:
+            return logits
+        return logits.astype(jnp.float32) + self._bias
+
     def _filtered_logits(self, logits, temps):
-        """FINAL sampling logits: temperature-scaled + engine-global
-        top_k/top_p — the ONE definition of the sampling distribution
-        (admission, chunk scan, draft proposals, and the rejection
-        commit all score against exactly this)."""
+        """FINAL sampling logits: bias + temperature scale + the
+        engine-global top_k/top_p — the ONE definition of the sampling
+        distribution (admission, chunk scan, draft proposals, and the
+        rejection commit all score against exactly this)."""
         return _filter_topk_topp(
-            logits / jnp.maximum(temps, 1e-6)[:, None],
+            self._biased(logits) / jnp.maximum(temps, 1e-6)[:, None],
             self.top_k, self.top_p)
 
     def _first_token(self, logits, temps, keys):
@@ -319,7 +345,8 @@ class ContinuousEngine:
         prefills: greedy at temperature 0, else a draw from
         ``_filtered_logits``, each row using its own request-seeded
         key."""
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        greedy = jnp.argmax(self._biased(logits),
+                            axis=-1).astype(jnp.int32)
         filt = self._filtered_logits(logits, temps)
         sampled = jax.vmap(
             lambda kk, lg: jax.random.categorical(kk, lg))(keys, filt)
@@ -489,7 +516,8 @@ class ContinuousEngine:
         def draft_step(c, j):
             dcache, tok, keys = c
             lg, dcache = step_fn(dcache, tok, j)
-            greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            greedy = jnp.argmax(self._biased(lg),
+                                axis=-1).astype(jnp.int32)
             if not sampled:
                 nxt = jnp.where(done, tok, greedy)
                 return (dcache, nxt, keys), (nxt, jnp.zeros((0,)))
@@ -551,7 +579,8 @@ class ContinuousEngine:
         acceptance semantics): longest greedy-matching draft prefix plus
         the target's bonus token; frozen slots hold."""
         slots_n = token.shape[0]
-        preds = jnp.argmax(t_lg, axis=-1).astype(jnp.int32)   # [slots, k]
+        preds = jnp.argmax(self._biased(t_lg),
+                           axis=-1).astype(jnp.int32)         # [slots, k]
 
         match = (drafts == preds[:, :-1]).astype(jnp.int32)
         n = jnp.cumprod(match, axis=1).sum(axis=1)            # [slots]
@@ -706,6 +735,7 @@ class ContinuousEngine:
                                  jnp.reshape(plen, (1,)), suffix)
         last = x[jnp.arange(1), slen - 1][:, None, :]
         logits = head_logits(params, last)[:, 0]        # [1, vocab]
+        logits = self._biased(logits)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         sampled = _select_token(logits / jnp.maximum(temp, 1e-6),
                                 key, 1.0, self.top_k, self.top_p)
@@ -752,6 +782,7 @@ class ContinuousEngine:
                                  jnp.reshape(plen, (1,)), suffix)
         last = x[jnp.arange(1), slen - 1][:, None, :]
         logits = head_logits(params, last)[:, 0]        # [1, vocab]
+        logits = self._biased(logits)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         sampled = _select_token(logits / jnp.maximum(temp, 1e-6),
                                 key, 1.0, self.top_k, self.top_p)

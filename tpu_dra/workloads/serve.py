@@ -702,7 +702,8 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           chunk: int = 4, draft: tuple | None = None,
           speculative_engine: bool = False,
           kv_layout: str = "slab", page_size: int = 64,
-          total_pages: int | None = None
+          total_pages: int | None = None,
+          logit_bias: dict[int, float] | None = None
           ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
@@ -740,7 +741,7 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
             cache_dtype=cache_dtype,
             draft=draft if speculative_engine else None,
             kv_layout=kv_layout, page_size=page_size,
-            total_pages=total_pages)
+            total_pages=total_pages, logit_bias=logit_bias)
     metrics = ServeMetrics()
     srv = ThreadingHTTPServer((host, port),
                               make_handler(pool, engine, metrics))
@@ -829,6 +830,11 @@ def main(argv=None):
                          "slots*ceil(max_len/page_size) — slab parity; "
                          "set lower to oversubscribe slots against real "
                          "usage)")
+    ap.add_argument("--logit-bias", default="",
+                    help="engine-global logit bias 'id:val,id:val' — "
+                         "ban (-1e9) or nudge tokens across ALL modes "
+                         "(greedy, sampled, speculative p and q); "
+                         "continuous engine only")
     ap.add_argument("--speculative-continuous", action="store_true",
                     help="with --continuous and a draft: the engine "
                          "itself drafts+verifies each chunk (per-slot "
@@ -945,12 +951,23 @@ def main(argv=None):
     if args.speculative_continuous and not (args.continuous and draft):
         ap.error("--speculative-continuous needs --continuous and a "
                  "draft (--draft-checkpoint-dir or --auto-draft)")
+    logit_bias = None
+    if args.logit_bias:
+        try:
+            logit_bias = {int(p.split(":")[0]): float(p.split(":")[1])
+                          for p in args.logit_bias.split(",") if p}
+        except (ValueError, IndexError):
+            ap.error(f"--logit-bias must be 'id:val,id:val', got "
+                     f"{args.logit_bias!r}")
+        if not args.continuous:
+            ap.error("--logit-bias needs --continuous (engine-global "
+                     "knob; the bucketed pool has no bias path)")
     srv = serve(cfg, params, host=args.host, port=args.port,
                 cache_dtype=args.cache_dtype, continuous=args.continuous,
                 slots=args.slots, chunk=args.chunk, draft=draft,
                 speculative_engine=args.speculative_continuous,
                 kv_layout=args.kv_layout, page_size=args.page_size,
-                total_pages=args.total_pages)
+                total_pages=args.total_pages, logit_bias=logit_bias)
     print(f"serving on {srv.server_address}", flush=True)
     try:
         threading.Event().wait()
