@@ -761,3 +761,31 @@ def test_logit_bias_bans_and_parity(params, draft_params):
     with pytest.raises(ValueError, match="logit_bias"):
         ContinuousEngine(CFG, params, slots=2,
                          logit_bias={CFG.vocab + 1: -1.0})
+
+
+def test_warmup_compiles_buckets(params):
+    """warmup() pre-compiles every servable prompt bucket (stats reset
+    afterwards), and a post-warmup request matches a cold engine's
+    output."""
+    cold = ContinuousEngine(CFG, params, slots=2, chunk=2, max_len=40)
+    try:
+        want = cold.submit([3, 5, 7], 6, timeout=300)
+    finally:
+        cold.shutdown()
+    eng = ContinuousEngine(CFG, params, slots=2, chunk=2, max_len=40)
+    try:
+        warmed = eng.warmup()
+        assert warmed >= 2               # 16, 32, and the clamped 40
+        st = eng.stats()
+        assert st["completed"] == 0      # stats reset: warmup invisible
+        assert eng.submit([3, 5, 7], 6, timeout=300) == want
+    finally:
+        eng.shutdown()
+    # paged: buckets beyond the pool are skipped, not failed
+    eng2 = ContinuousEngine(CFG, params, slots=2, chunk=2, max_len=40,
+                            kv_layout="paged", page_size=8,
+                            total_pages=3)
+    try:
+        assert eng2.warmup() >= 1        # only small buckets fit 3 pages
+    finally:
+        eng2.shutdown()

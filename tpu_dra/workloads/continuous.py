@@ -1077,6 +1077,33 @@ class ContinuousEngine:
             self._cv.notify_all()
         return req
 
+    def warmup(self, buckets: Optional[list[int]] = None) -> int:
+        """Compile the serving-critical programs before real traffic:
+        one 1-token-prompt admission per prompt bucket (k=1 prefill
+        program + the shared step program on the first pass).  Stats are
+        reset afterwards so compile time never reads as serving latency
+        (what bench.py and operators previously hand-rolled).  Returns
+        the number of buckets warmed."""
+        want = buckets or [b for b in _PROMPT_BUCKETS
+                           if b < self.max_len]
+        if not buckets and self.max_len > (want[-1] if want else 0):
+            want.append(self.max_len)     # the clamped top bucket
+        warmed = 0
+        for b in want:
+            # steps=2 so the chunk-step program compiles too (a steps=1
+            # request finishes at admission without ever stepping)
+            n = min(b, self.max_len - 2)
+            if n < 1:
+                continue
+            if self.kv_layout == "paged":
+                _, need, _ = self._paged_requirements(n, 2, None)
+                if need > self.pool.total_pages:
+                    continue              # bucket unservable at this pool
+            self.submit([1] * n, 2, timeout=600)
+            warmed += 1
+        self.reset_stats()
+        return warmed
+
     def cancel(self, req: _Request) -> None:
         """Abort a request from ``submit_async``: a queued request never
         admits, an in-flight one retires at the next pass boundary (its

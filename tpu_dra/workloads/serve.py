@@ -830,6 +830,10 @@ def main(argv=None):
                          "slots*ceil(max_len/page_size) — slab parity; "
                          "set lower to oversubscribe slots against real "
                          "usage)")
+    ap.add_argument("--warmup", action="store_true",
+                    help="continuous mode: compile every prompt-bucket "
+                         "program before accepting traffic (first "
+                         "requests then never pay compile latency)")
     ap.add_argument("--logit-bias", default="",
                     help="engine-global logit bias 'id:val,id:val' — "
                          "ban (-1e9) or nudge tokens across ALL modes "
@@ -968,6 +972,11 @@ def main(argv=None):
                 speculative_engine=args.speculative_continuous,
                 kv_layout=args.kv_layout, page_size=args.page_size,
                 total_pages=args.total_pages, logit_bias=logit_bias)
+    if args.warmup:
+        if srv.engine is None:
+            ap.error("--warmup needs --continuous")
+        n = srv.engine.warmup()
+        klog.info("engine warmed", buckets=n)
     print(f"serving on {srv.server_address}", flush=True)
     try:
         threading.Event().wait()
