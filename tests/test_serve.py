@@ -670,3 +670,34 @@ def test_stream_disconnect_cancels_request():
         assert st["active"] == 0, st
     finally:
         srv.shutdown()
+
+
+def test_auto_draft_cache_roundtrip(tmp_path):
+    """resolve_auto_draft: first call distills and saves; the second
+    restores the SAME draft without fp32 params; form/model mismatches
+    are hard errors (weights-cache discipline)."""
+    import numpy as np
+
+    from tpu_dra.workloads.serve import resolve_auto_draft
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    dims = {"vocab": 64, "d_model": 32}
+    cache = str(tmp_path / "draft-cache")
+
+    dcfg1, dp1 = resolve_auto_draft(cfg, params, dims, cache=cache,
+                                    steps=20)
+    # restore path: no fp32 tree needed at all
+    dcfg2, dp2 = resolve_auto_draft(cfg, None, dims, cache=cache)
+    assert dcfg2.n_layers == dcfg1.n_layers
+    for a, b in zip(jax.tree.leaves(dp1), jax.tree.leaves(dp2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="form"):
+        resolve_auto_draft(cfg, None, dims, form="int8", cache=cache)
+    with pytest.raises(ValueError, match="distilled for"):
+        resolve_auto_draft(cfg, None, {"vocab": 99}, cache=cache)
+    # no cache + no fp32 tree: the documented error
+    with pytest.raises(ValueError, match="fp32"):
+        resolve_auto_draft(cfg, None, dims)

@@ -695,6 +695,65 @@ def build_auto_draft(cfg: ModelConfig, fp32_params, *, form: str = "fp32",
     return dcfg, dparams
 
 
+def resolve_auto_draft(cfg: ModelConfig, fp32_params, model_dims,
+                       *, form: str = "fp32", cache: str = "",
+                       n_layers: int | None = None, steps: int = 200,
+                       error=None) -> tuple:
+    """Auto-draft with the weights-cache discipline: restore a cached
+    distilled draft when ``cache`` is populated (hard error on a
+    form/model mismatch — never a silent stale-draft serve), else
+    distill from the fp32 tree and save it there, so distillation runs
+    once at deploy, not at every server start."""
+    import dataclasses
+
+    from tpu_dra.workloads.checkpointing import (restore_serving_state,
+                                                 save_serving_state,
+                                                 serving_meta)
+
+    def fail(msg: str):
+        if error is not None:
+            error(msg)
+        raise ValueError(msg)
+
+    if cache:
+        meta = serving_meta(cache)
+        try:
+            dparams = restore_serving_state(cache)
+        except FileNotFoundError:
+            dparams = None
+        if dparams is not None:
+            if meta is not None:
+                if meta.get("form") != form:
+                    fail(f"--auto-draft-cache holds form="
+                         f"{meta.get('form')!r} but the serving form is "
+                         f"{form!r}")
+                if meta.get("model") not in (None, model_dims):
+                    fail(f"--auto-draft-cache was distilled for "
+                         f"{meta.get('model')}, flags describe "
+                         f"{model_dims}")
+                dlayers = int(meta.get("draft_layers",
+                                       max(1, cfg.n_layers // 4)))
+            else:
+                dlayers = n_layers or max(1, cfg.n_layers // 4)
+            klog.info("auto-draft restored from cache", cache=cache,
+                      layers=dlayers)
+            return (dataclasses.replace(cfg, n_layers=dlayers), dparams)
+    if fp32_params is None:
+        fail("--auto-draft needs --checkpoint-dir: distillation runs on "
+             "the fp32 tree (a quantized --weights-cache alone cannot "
+             "be distilled)")
+    draft = build_auto_draft(cfg, fp32_params, form=form,
+                             n_layers=n_layers, steps=steps)
+    klog.info("auto-draft built", layers=draft[0].n_layers, steps=steps)
+    if cache:
+        save_serving_state(cache, draft[1],
+                           meta={"form": form, "model": model_dims,
+                                 "draft_layers": draft[0].n_layers,
+                                 "distill_steps": steps})
+        klog.info("auto-draft cached", cache=cache)
+    return draft
+
+
 def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           port: int = 8477,
           cache_dtype: str = "bf16",
@@ -860,6 +919,12 @@ def main(argv=None):
     ap.add_argument("--auto-draft-steps", type=int, default=200,
                     help="distillation steps at startup (0 = truncation "
                          "only)")
+    ap.add_argument("--auto-draft-cache", default="",
+                    help="directory caching the distilled draft "
+                         "(weights-cache pattern): restored when "
+                         "populated — distillation runs once at deploy, "
+                         "not at every server start — else built from "
+                         "--checkpoint-dir and saved here")
     ap.add_argument("--draft-d-model", type=int, default=128)
     ap.add_argument("--draft-n-heads", type=int, default=4)
     ap.add_argument("--draft-n-kv-heads", type=int, default=None)
@@ -938,20 +1003,15 @@ def main(argv=None):
             pos_emb=args.pos_emb)
         draft = (draft_cfg,
                  restore_train_state(args.draft_checkpoint_dir)["params"])
-    if args.auto_draft:
+    if args.auto_draft or args.auto_draft_cache:
         if draft is not None:
             ap.error("--auto-draft conflicts with --draft-checkpoint-dir "
                      "(pick one draft source)")
-        if fp32_params is None:
-            ap.error("--auto-draft needs --checkpoint-dir: distillation "
-                     "runs on the fp32 tree (a quantized --weights-cache "
-                     "alone cannot be distilled)")
-        draft = build_auto_draft(cfg, fp32_params,
-                                 form=args.weights or "fp32",
-                                 n_layers=args.auto_draft_layers,
-                                 steps=args.auto_draft_steps)
-        klog.info("auto-draft built", layers=draft[0].n_layers,
-                  steps=args.auto_draft_steps)
+        draft = resolve_auto_draft(
+            cfg, fp32_params, model_dims, form=args.weights or "fp32",
+            cache=args.auto_draft_cache,
+            n_layers=args.auto_draft_layers,
+            steps=args.auto_draft_steps, error=ap.error)
     if args.speculative_continuous and not (args.continuous and draft):
         ap.error("--speculative-continuous needs --continuous and a "
                  "draft (--draft-checkpoint-dir or --auto-draft)")
