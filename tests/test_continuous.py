@@ -789,3 +789,36 @@ def test_warmup_compiles_buckets(params):
         assert eng2.warmup() >= 1        # only small buckets fit 3 pages
     finally:
         eng2.shutdown()
+
+
+def test_drain_finishes_in_flight_rejects_new(params):
+    """drain(): in-flight and queued requests complete, new submissions
+    are rejected with a retry-pointing error, and shutdown afterwards
+    has nothing to fail."""
+    eng = ContinuousEngine(CFG, params, slots=1, chunk=2)
+    try:
+        a = eng.submit_async([1, 2], 20)
+        b = eng.submit_async([3, 4], 5)         # queued behind a
+        import threading as _t
+        drained = {}
+        t = _t.Thread(target=lambda: drained.update(
+            ok=eng.drain(timeout=300)))
+        t.start()
+        # the drain gate closes for NEW work quickly
+        deadline = __import__("time").time() + 60
+        while __import__("time").time() < deadline:
+            try:
+                eng.submit_async([5], 2)
+            except RuntimeError as exc:
+                assert "draining" in str(exc)
+                break
+            __import__("time").sleep(0.01)
+        else:
+            raise AssertionError("drain never closed the gate")
+        assert a.done.wait(300) and not a.error
+        assert b.done.wait(300) and not b.error
+        assert len(a.tokens) == 20 and len(b.tokens) == 5
+        t.join(timeout=300)
+        assert drained.get("ok") is True
+    finally:
+        eng.shutdown()

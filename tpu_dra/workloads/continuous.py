@@ -280,6 +280,7 @@ class ContinuousEngine:
         self._pending: deque[_Request] = deque()
         self._cv = threading.Condition()
         self._stop = False
+        self._draining = False
         # stats
         self.completed = 0
         self.cancelled = 0
@@ -1073,6 +1074,10 @@ class ContinuousEngine:
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is shut down")
+            if self._draining:
+                raise RuntimeError("engine is draining (rolling "
+                                   "restart); retry against the new "
+                                   "instance")
             self._pending.append(req)
             self._cv.notify_all()
         return req
@@ -1160,6 +1165,28 @@ class ContinuousEngine:
             out["latency_p95_ms"] = round(
                 1e3 * lat[min(len(lat) - 1, int(0.95 * len(lat)))], 3)
         return out
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful rolling-restart half of shutdown: REJECT new
+        submissions immediately, let queued and in-flight requests run
+        to completion, and return True once the engine is empty (False
+        on timeout — callers then decide between waiting longer and a
+        hard ``shutdown``, which fails whatever is left).  Idempotent;
+        the batcher keeps running so a drained engine still needs
+        ``shutdown()`` to stop its thread."""
+        with self._cv:
+            self._draining = True
+        deadline = None if timeout is None else \
+            time.perf_counter() + timeout
+        while True:
+            with self._cv:
+                empty = (not self._pending
+                         and all(r is None for r in self._requests))
+            if empty:
+                return True
+            if deadline is not None and time.perf_counter() > deadline:
+                return False
+            time.sleep(0.02)
 
     def shutdown(self) -> None:
         with self._cv:
