@@ -589,7 +589,9 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
                           head_impl: str = "dense",
                           accum_steps: int = 1,
                           label_smoothing: float = 0.0,
-                          z_loss: float = 0.0):
+                          z_loss: float = 0.0,
+                          zero1: bool = False,
+                          norm_impl: str = "dense"):
     """Like ``make_sharded_train_step`` but with a real optax optimizer
     (default: AdamW + global-norm clipping).
 
@@ -598,6 +600,10 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
     Optimizer state shards like the params it mirrors (optax states are
     pytrees whose array leaves match param shapes; scalar leaves
     replicate), so dp×tp layouts carry over moment buffers for free.
+    ``zero1=True`` additionally shards the moment buffers over "dp"
+    (see opt_state_shardings) — AdamW's two fp32 moment copies are the
+    largest training buffers after activations, and dp ranks were
+    holding identical replicas.
     """
     import optax
 
@@ -612,14 +618,14 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
                                head_impl=head_impl,
                                accum_steps=accum_steps,
                                label_smoothing=label_smoothing,
-                               z_loss=z_loss)
+                               z_loss=z_loss, norm_impl=norm_impl)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     opt_sh, init_opt_state = opt_state_shardings(
         optimizer, lambda: init_params(cfg, jax.random.PRNGKey(0)),
-        p_shard, mesh)
+        p_shard, mesh, zero1=zero1)
     step = jax.jit(train_step,
                    in_shardings=(p_shard, opt_sh, b_shard),
                    out_shardings=(p_shard, opt_sh, rep))
@@ -632,7 +638,8 @@ def default_optimizer():
                        optax.adamw(3e-4, weight_decay=0.01))
 
 
-def opt_state_shardings(optimizer, param_init_fn, p_shard, mesh: Mesh):
+def opt_state_shardings(optimizer, param_init_fn, p_shard, mesh: Mesh,
+                        zero1: bool = False):
     """(opt_sharding_tree, init_opt_state) for a sharded optimizer.
 
     jit alone does NOT propagate input shardings through init (XLA is
@@ -641,14 +648,36 @@ def opt_state_shardings(optimizer, param_init_fn, p_shard, mesh: Mesh):
     layout again after one step.  Build the sharding tree once:
     optax.tree_map_params knows which state leaves mirror params (→
     that param's sharding); everything else (step counts) replicates.
-    Shared by the dense, MoE, and any future optax step builders."""
+    Shared by the dense, MoE, and any future optax step builders.
+
+    ``zero1=True`` (ZeRO-1 / optimizer-state sharding, the
+    scaling-book's first memory lever beyond remat): each
+    param-mirroring leaf additionally shards over "dp" on its first
+    dp-divisible replicated dimension, cutting moment memory by the dp
+    degree.  GSPMD then partitions the elementwise update over dp
+    (each rank updates its moment shard against its gradient shard)
+    and all-gathers the updates for the replicated params — the
+    ZeRO-1 communication pattern, derived from sharding annotations
+    alone."""
     import optax
 
     rep = NamedSharding(mesh, P())
     p_shapes = jax.eval_shape(param_init_fn)
     opt_shapes = jax.eval_shape(optimizer.init, p_shapes)
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+
+    def moment_sharding(leaf, s):
+        if not zero1 or dp <= 1:
+            return s
+        spec = list(s.spec) + [None] * (len(leaf.shape) - len(s.spec))
+        for dim, (size, entry) in enumerate(zip(leaf.shape, spec)):
+            if entry is None and size % dp == 0:
+                spec[dim] = "dp"
+                return NamedSharding(mesh, P(*spec))
+        return s                           # nothing dp-divisible: keep
+
     opt_sh = optax.tree_map_params(
-        optimizer, lambda _leaf, s: s, opt_shapes, p_shard,
+        optimizer, moment_sharding, opt_shapes, p_shard,
         transform_non_params=lambda _leaf: rep)
 
     def init_opt_state(params):
