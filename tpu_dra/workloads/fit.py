@@ -45,7 +45,7 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
         warmup_steps: int = 0,
         attn_impl: str = "dense", head_impl: str = "dense",
         accum_steps: int = 1, label_smoothing: float = 0.0,
-        z_loss: float = 0.0,
+        z_loss: float = 0.0, zero1: bool = False,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0, resume: bool = False,
         log_every: int = 10, seed: int = 0,
@@ -115,12 +115,13 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
         from tpu_dra.workloads.moe import make_moe_optax_step
         step_fn, init_opt, p_shard, b_shard = make_moe_optax_step(
             cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
-            head_impl=head_impl)
+            head_impl=head_impl, zero1=zero1)
     else:
         step_fn, init_opt, p_shard, b_shard = make_optax_train_step(
             cfg, mesh, optimizer=optimizer, attn_impl=attn_impl,
             head_impl=head_impl, accum_steps=accum_steps,
-            label_smoothing=label_smoothing, z_loss=z_loss)
+            label_smoothing=label_smoothing, z_loss=z_loss,
+            zero1=zero1)
 
     start = 0
     init_fn = init_moe_params if is_moe else init_params

@@ -241,7 +241,8 @@ def moe_param_shardings(cfg: MoEConfig, mesh: Mesh) -> dict[str, Any]:
 
 def make_moe_optax_step(cfg: MoEConfig, mesh: Mesh, optimizer=None,
                         attn_impl: str = "dense",
-                        head_impl: str = "dense"):
+                        head_impl: str = "dense",
+                        zero1: bool = False):
     """MoE training with a real optax optimizer (default: AdamW +
     global-norm clipping) — the expert-parallel sibling of
     ``train.make_optax_train_step``.  Returns ``(step, init_opt_state,
@@ -265,7 +266,7 @@ def make_moe_optax_step(cfg: MoEConfig, mesh: Mesh, optimizer=None,
 
     opt_sh, init_opt_state = opt_state_shardings(
         optimizer, lambda: init_moe_params(cfg, jax.random.PRNGKey(0)),
-        p_shard, mesh)
+        p_shard, mesh, zero1=zero1)
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
