@@ -701,3 +701,48 @@ def test_auto_draft_cache_roundtrip(tmp_path):
     # no cache + no fp32 tree: the documented error
     with pytest.raises(ValueError, match="fp32"):
         resolve_auto_draft(cfg, None, dims)
+
+
+def test_main_sigterm_drains_and_exits(tmp_path):
+    """The serve CLI's SIGTERM path: drain (reject new, finish
+    in-flight) then clean shutdown with exit code 0 — the k8s rolling
+    restart contract."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _t
+
+    from tpu_dra.workloads.checkpointing import save_train_state
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32, pos_emb="rope")
+    ck = str(tmp_path / "ck")
+    save_train_state(ck, 0, init_params(cfg, jax.random.PRNGKey(0)))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.workloads.serve",
+         "--checkpoint-dir", ck, "--vocab", "64", "--d-model", "32",
+         "--n-heads", "2", "--n-layers", "2", "--d-ff", "64",
+         "--max-seq", "32", "--port", "0", "--continuous",
+         "--slots", "2", "--chunk", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=repo)
+    try:
+        deadline = _t.time() + 120
+        line = ""
+        while _t.time() < deadline:
+            line = proc.stdout.readline()
+            if "serving on" in line:
+                break
+        assert "serving on" in line, line
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out[-400:]
+        assert "drain before shutdown" in out, out[-400:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=10)

@@ -1037,11 +1037,28 @@ def main(argv=None):
             ap.error("--warmup needs --continuous")
         n = srv.engine.warmup()
         klog.info("engine warmed", buckets=n)
+    stop = threading.Event()
+
+    def _sigterm(_signum, _frame):
+        # k8s rolling restart: SIGTERM drains (reject new, finish
+        # in-flight up to the pod's grace period) before shutdown —
+        # kubelet sends SIGKILL at terminationGracePeriodSeconds anyway,
+        # so cap the drain below the default 30 s
+        stop.set()
+
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _sigterm)
+    # handler installed BEFORE the ready line: a supervisor that signals
+    # the moment it sees the line must never hit the default handler
     print(f"serving on {srv.server_address}", flush=True)
     try:
-        threading.Event().wait()
+        stop.wait()
     except KeyboardInterrupt:
-        srv.shutdown()
+        pass
+    if srv.engine is not None:
+        drained = srv.engine.drain(timeout=25.0)
+        klog.info("drain before shutdown", complete=drained)
+    srv.shutdown()
     return 0
 
 if __name__ == "__main__":
