@@ -27,7 +27,18 @@ The TPU analogs here are first-class framework components
 - :mod:`tpu_dra.workloads.lora` — LoRA fine-tuning over a frozen
   (optionally int8) base: adapter-only grads/moments, exact-at-init
   wrap, serving merge.
-- :mod:`tpu_dra.workloads.serve` — bucketed HTTP inference endpoint.
+- :mod:`tpu_dra.workloads.continuous` /
+  :mod:`tpu_dra.workloads.paged_kv` — continuously-batched serving
+  engine (slot join/leave, shared-prefix KV, stop sequences,
+  cancellation, drain, warmup, engine-global logit bias) over slab or
+  block-table paged KV memory.
+- :mod:`tpu_dra.workloads.spec_draft` /
+  :mod:`tpu_dra.workloads.spec_sample` — real draft construction
+  (truncate + distill) and the rejection-scheme commit that makes
+  sampled speculation distribution-exact.
+- :mod:`tpu_dra.workloads.serve` — HTTP inference endpoint (bucketed
+  pool or continuous engine; /generate /stream /beam /speculative
+  /prefix /metrics; --auto-draft[-cache], --warmup, SIGTERM drain).
 - :mod:`tpu_dra.workloads.data` / :mod:`tpu_dra.workloads.fit` /
   :mod:`tpu_dra.workloads.checkpointing` — memmap data pipeline with a
   deterministic rank-disjoint schedule and first-fit document packing
