@@ -475,6 +475,8 @@ def section_continuous() -> dict:
 
     import jax
 
+    t_section = time.perf_counter()
+
     from tpu_dra.workloads.continuous import ContinuousEngine
     from tpu_dra.workloads.quant import quantize_params_int8
     from tpu_dra.workloads.train import ModelConfig, init_params
@@ -539,6 +541,12 @@ def section_continuous() -> dict:
     # The spec engine doubles KV-cache HBM (target + draft copies of the
     # full model) and adds its own compiles: any failure here must not
     # discard the plain-engine numbers already in ``out``.
+    # sections are atomic subprocesses: if the plain run ate most of the
+    # 720 s deadline, skip the ceiling instead of losing EVERYTHING to a
+    # bust (the spec_real section's same guard)
+    if time.perf_counter() - t_section > 520:
+        out["continuous_spec_skipped"] = "section time budget exhausted"
+        return out
     _spec_ceiling(
         out, "continuous",
         lambda: ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
@@ -665,6 +673,7 @@ def section_paged() -> dict:
     from tpu_dra.workloads.quant import quantize_params_int8
     from tpu_dra.workloads.train import ModelConfig, init_params
 
+    t_section = time.perf_counter()
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
@@ -726,6 +735,9 @@ def section_paged() -> dict:
         eng.shutdown()
     # speculative ceiling over pages (draft == target accepts every
     # proposal — the paged analog of the continuous section's ceiling)
+    if time.perf_counter() - t_section > 520:
+        out["paged_spec_skipped"] = "section time budget exhausted"
+        return out
     _spec_ceiling(
         out, "paged",
         lambda: ContinuousEngine(cfg, params, slots=slots, chunk=chunk,
