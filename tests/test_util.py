@@ -1,0 +1,129 @@
+"""Direct unit tests for the L1 utility modules (flags, klog, rank,
+fsutil, template) — the reference's own automated tests are exactly this
+class (table-driven config/flag units, SURVEY §4); these modules were
+previously covered only through their consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpu_dra.util import klog
+from tpu_dra.util.flags import Flag, FlagGroup, build_parser
+from tpu_dra.util.fsutil import atomic_write
+from tpu_dra.util.rank import rank_sorted
+from tpu_dra.util.template import render
+
+
+# -- flags -----------------------------------------------------------------
+
+
+def test_flag_env_alias_and_types(monkeypatch):
+    """Every flag reads its env alias as the default (the reference's
+    urfave/cli EnvVars behavior), with type conversion applied."""
+    monkeypatch.setenv("T_NAME", "from-env")
+    monkeypatch.setenv("T_COUNT", "7")
+    group = FlagGroup("t", [
+        Flag("t-name", "T_NAME", default="d"),
+        Flag("t-count", "T_COUNT", default=1, type=int),
+        Flag("t-plain", "T_PLAIN", default="keep"),
+    ])
+    p = build_parser("test", [group])
+    args = p.parse_args([])
+    assert args.t_name == "from-env"
+    assert args.t_count == 7                 # converted, not "7"
+    assert args.t_plain == "keep"
+    # CLI wins over env
+    args = p.parse_args(["--t-name", "cli"])
+    assert args.t_name == "cli"
+
+
+def test_flag_bool_env_parsing(monkeypatch):
+    for raw, want in (("1", True), ("true", True), ("YES", True),
+                      ("on", True), ("0", False), ("false", False),
+                      ("nope", False)):
+        monkeypatch.setenv("T_B", raw)
+        p = build_parser("t", [FlagGroup("g", [
+            Flag("t-b", "T_B", default=False, type=bool)])])
+        assert p.parse_args([]).t_b is want, raw
+    # --no- negation (BooleanOptionalAction)
+    monkeypatch.setenv("T_B", "1")
+    p = build_parser("t", [FlagGroup("g", [
+        Flag("t-b", "T_B", default=False, type=bool)])])
+    assert p.parse_args(["--no-t-b"]).t_b is False
+
+
+def test_flag_required_satisfied_by_env(monkeypatch):
+    """required=True is waived when the env alias provides a value —
+    in-cluster pods set env, not argv."""
+    p = build_parser("t", [FlagGroup("g", [
+        Flag("t-req", "T_REQ", required=True)])])
+    with pytest.raises(SystemExit):
+        p.parse_args([])
+    monkeypatch.setenv("T_REQ", "x")
+    p = build_parser("t", [FlagGroup("g", [
+        Flag("t-req", "T_REQ", required=True)])])
+    assert p.parse_args([]).t_req == "x"
+
+
+# -- klog ------------------------------------------------------------------
+
+
+def test_klog_verbosity_gate_and_formats(capsys):
+    klog.configure(verbosity=2, fmt="text")
+    klog.info("visible", level=2, a=1)
+    klog.info("hidden", level=3)
+    err = capsys.readouterr().err
+    assert "visible" in err and "a=1" in err
+    assert "hidden" not in err
+    assert klog.v(2) and not klog.v(3)
+
+    klog.configure(verbosity=2, fmt="json")
+    klog.warning("w-msg", reason="x")
+    line = [ln for ln in capsys.readouterr().err.splitlines()
+            if "w-msg" in ln][-1]
+    rec = json.loads(line)
+    assert rec["severity"] == "WARNING" and rec["reason"] == "x"
+    klog.configure(verbosity=2, fmt="text")     # restore
+
+
+# -- rank ------------------------------------------------------------------
+
+
+def test_rank_sorted_explicit_and_legacy():
+    explicit = [{"name": "b", "rank": 1}, {"name": "a", "rank": 0}]
+    assert [n["name"] for n in rank_sorted(explicit)] == ["a", "b"]
+    # legacy: (workerID, name); missing workerID sorts LAST
+    legacy = [{"name": "c"}, {"name": "a", "workerID": 1},
+              {"name": "b", "workerID": 0}]
+    assert [n["name"] for n in rank_sorted(legacy)] == ["b", "a", "c"]
+    # a single rank-less entry downgrades the WHOLE list to legacy order
+    mixed = [{"name": "x", "rank": 5}, {"name": "y", "workerID": 0}]
+    assert [n["name"] for n in rank_sorted(mixed)] == ["y", "x"]
+
+
+# -- fsutil ----------------------------------------------------------------
+
+
+def test_atomic_write_replaces_and_leaves_no_temps(tmp_path):
+    target = tmp_path / "f.json"
+    atomic_write(str(target), "one")
+    atomic_write(str(target), "two", durable=False)
+    assert target.read_text() == "two"
+    # no tmp droppings — a crashed writer must never confuse a reader
+    assert [p.name for p in tmp_path.iterdir()] == ["f.json"]
+
+
+# -- template --------------------------------------------------------------
+
+
+def test_template_render_and_unresolved_error():
+    out = render("a=$(A) b=$(B_2)", {"A": "1", "B_2": "x"})
+    assert out == "a=1 b=x"
+    with pytest.raises(KeyError, match="MISSING"):
+        render("$(MISSING)", {})
+    # non-placeholder dollars pass through untouched
+    assert render("cost $5 $(A)", {"A": "ok"}) == "cost $5 ok"
