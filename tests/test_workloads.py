@@ -747,3 +747,26 @@ def test_zero1_shards_moments_and_matches_plain():
         shard_p, shard_z)
     # dp landed on the leading (layer) axis; tp sharding preserved
     assert "dp" in str(mu_z.sharding.spec)
+
+
+def test_psum_job_cli_smoke():
+    """The acceptance job CLI (workloads/psum_job — the nvbandwidth
+    MPIJob analog) runs end to end on the virtual 8-device mesh and
+    reports collective bandwidth as one JSON line."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    env = {**_os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "PYTHONPATH": repo}
+    out = subprocess.run(
+        [_sys.executable, "-m", "tpu_dra.workloads.psum_job",
+         "--local-only", "--mib", "1"],
+        env=env, capture_output=True, text=True, timeout=240, cwd=repo)
+    assert out.returncode == 0, (out.stdout, out.stderr)[1][-400:]
+    rec = _json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["n_devices"] == 8
+    assert rec["psum_gbps"] > 0 and rec["ppermute_gbps"] > 0
