@@ -124,3 +124,28 @@ def test_multi_slot_batch_shapes():
     assert emit.shape == (slots, K) and counts.shape == (slots,)
     assert np.all(np.asarray(counts) >= 1)
     assert np.all(np.asarray(pos2) == np.asarray(counts))
+
+
+@pytest.mark.parametrize("seed", [3])
+def test_second_position_conditional_marginal(seed):
+    """Rows whose first draft was ACCEPTED commit a second token that
+    must be distributed exactly as softmax(p_2) — draws are independent
+    across positions, so conditioning on acceptance at position 1 does
+    not tilt position 2.  This pins the take_along_axis index math
+    (an off-by-one in the rejection row or bonus gather would pass the
+    first-position test and fail here)."""
+    kp, kq = jax.random.split(jax.random.PRNGKey(200 + seed))
+    t_logits = jax.random.normal(kp, (1, K, V)) * 1.5
+    q_logits = jax.random.normal(kq, (1, K - 1, V)) * 1.5
+    batch = jax.vmap(lambda k: _run_pass(k, t_logits, q_logits))
+    n = 40000
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    _, _, _, emit, counts = batch(keys)
+    emit = np.asarray(emit[:, 0])          # [n, K]
+    counts = np.asarray(counts[:, 0])
+    second = emit[counts >= 2, 1]
+    assert len(second) > 3000              # acceptance isn't degenerate
+    want = np.asarray(jax.nn.softmax(t_logits[0, 1].astype(jnp.float32)))
+    got = np.bincount(second, minlength=V) / len(second)
+    tol = 4 * np.sqrt(want * (1 - want) / len(second))
+    assert np.all(np.abs(got - want) <= tol + 2e-3), (got, want)
