@@ -72,21 +72,27 @@ def test_flag_required_satisfied_by_env(monkeypatch):
 # -- klog ------------------------------------------------------------------
 
 
-def test_klog_verbosity_gate_and_formats(capsys):
-    klog.configure(verbosity=2, fmt="text")
-    klog.info("visible", level=2, a=1)
-    klog.info("hidden", level=3)
-    err = capsys.readouterr().err
-    assert "visible" in err and "a=1" in err
-    assert "hidden" not in err
-    assert klog.v(2) and not klog.v(3)
+def test_klog_verbosity_gate_and_formats(caplog):
+    # caplog, not capsys: the module logger's stream handler is created
+    # once per process and may hold an earlier test's captured stderr
+    # under xdist — the logging records are order-independent
+    import logging
 
-    klog.configure(verbosity=2, fmt="json")
-    klog.warning("w-msg", reason="x")
-    line = [ln for ln in capsys.readouterr().err.splitlines()
-            if "w-msg" in ln][-1]
-    rec = json.loads(line)
-    assert rec["severity"] == "WARNING" and rec["reason"] == "x"
+    with caplog.at_level(logging.INFO, logger="tpu-dra"):
+        klog.configure(verbosity=2, fmt="text")
+        klog.info("visible", level=2, a=1)
+        klog.info("hidden", level=3)
+        text = "\n".join(r.getMessage() for r in caplog.records)
+        assert "visible" in text and "a=1" in text
+        assert "hidden" not in text
+        assert klog.v(2) and not klog.v(3)
+
+        klog.configure(verbosity=2, fmt="json")
+        klog.warning("w-msg", reason="x")
+        line = [r.getMessage() for r in caplog.records
+                if "w-msg" in r.getMessage()][-1]
+        rec = json.loads(line)
+        assert rec["severity"] == "WARNING" and rec["reason"] == "x"
     klog.configure(verbosity=2, fmt="text")     # restore
 
 
