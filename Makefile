@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test bench drive image proto check-proto stress racecheck clean
+.PHONY: all native test bench drive image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -54,6 +54,13 @@ check-proto: proto
 # interleaving bugs surface across runs.
 racecheck:
 	$(PYTHON) -m pytest tests/test_racecheck.py -q -x
+
+# go vet analog (reference pairs golangci-lint/go vet with -race in CI):
+# tpudra-vet runs the repo-specific static checkers — lock discipline
+# (the static complement of `racecheck`), reconcile hygiene, jit purity,
+# string-constant drift, exception hygiene.  See docs/static-analysis.md.
+vet:
+	$(PYTHON) -m tpu_dra.analysis tpu_dra/
 
 STRESS_RUNS ?= 5
 stress:

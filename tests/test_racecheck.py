@@ -365,6 +365,83 @@ def test_informer_store_race_free():
         racecheck.reset()
 
 
+def test_membership_manager_race_free():
+    """Two daemons rendezvous through the CR status subresource while
+    MembershipManager is monitored: the informer callback thread and the
+    main thread share ``_last_ips`` (guarded by ``_mu`` — the guarded-by
+    static checker enforces the same contract; test_vet.py cross-wires
+    the two lists)."""
+    racecheck.install()
+    from tpu_dra.daemon.membership import MembershipManager
+    from tpu_dra.k8s import FakeKube, TPU_SLICE_DOMAINS
+
+    racecheck.monitor(MembershipManager)
+    kube = FakeKube()
+    managers = []
+    try:
+        kube.create(TPU_SLICE_DOMAINS, {
+            "metadata": {"name": "dom", "namespace": "team-a"},
+            "spec": {"numNodes": 2}})
+        for i, node in enumerate(("n0", "n1")):
+            m = MembershipManager(kube, "dom", "team-a", node,
+                                  f"10.0.0.{10 + i}", "slice-uuid.0", i)
+            m.start()
+            managers.append(m)
+        for m in managers:
+            nodes = m.updates.get(timeout=10)
+            assert {n.name for n in nodes} == {"n0", "n1"}
+        racecheck.assert_no_races()
+    finally:
+        for m in managers:
+            m.stop()
+        kube.close_watchers()
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_decoder_pool_race_free():
+    """Concurrent /generate-style traffic through DecoderPool with the
+    pool monitored: the compiled-fn cache (``_fns``, guarded by
+    ``_lock``) is the shared state; two threads racing the same cache
+    key must show zero unordered conflicting accesses."""
+    import jax
+
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    racecheck.install()
+    from tpu_dra.workloads.serve import DecoderPool
+
+    racecheck.monitor(DecoderPool)
+    try:
+        pool = DecoderPool(cfg, params)
+        outs: list[list[list[int]]] = []
+        errors: list[BaseException] = []
+        mu = threading.Lock()
+
+        def worker(i: int) -> None:
+            try:
+                # same bucket key: both threads contend on one cache slot
+                toks = pool.generate([[3, 1, 4, 1]], steps=3)
+            except BaseException as exc:  # noqa: BLE001
+                with mu:
+                    errors.append(exc)
+                return
+            with mu:
+                outs.append(toks)
+
+        run_threads(2, worker)
+        assert not errors, errors[:3]
+        assert len(outs) == 2 and outs[0] == outs[1]
+        racecheck.assert_no_races()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
 def test_kubelet_plugin_grpc_path_race_free(tmp_path):
     """The REAL serving path under the detector: concurrent
     NodePrepareResources/NodeUnprepareResources through the gRPC DRA

@@ -1,0 +1,488 @@
+"""tpudra-vet (tpu_dra/analysis): the go-vet analog and its checkers.
+
+Three layers, mirroring how go/analysis checkers are validated:
+
+1. Fixture snippets per checker — one seeded true positive and one
+   clean negative each, so a checker that stops firing (or starts
+   over-firing) is caught immediately.
+2. The framework itself — suppression comments, the JSON reporter
+   schema, CLI exit codes, parse-error handling.
+3. Cross-wiring with the DYNAMIC race lane: every class the guarded-by
+   checker lists as a shared-state hot spot must also be exercised
+   under ``racecheck.monitor`` in tests/test_racecheck.py, so the
+   static and dynamic coverage lists cannot drift apart (the issue the
+   reference avoids by running go vet and -race over the same tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+from tpu_dra.analysis import all_analyzers, run_paths
+from tpu_dra.analysis.checkers import guardedby
+from tpu_dra.analysis.report import JSON_SCHEMA_VERSION
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
+                   "string-constant-drift", "exception-hygiene"}
+
+
+def vet_snippet(tmp_path, relpath: str, source: str,
+                checks: list[str] | None = None):
+    """Write ``source`` at ``tmp_path/relpath`` (the relpath carries the
+    scope, e.g. ``tpu_dra/controller/x.py``) and run the analyzers."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return run_paths([str(path)], checks=checks)
+
+
+def checks_fired(diags) -> set[str]:
+    return {d.check for d in diags}
+
+
+# -------------------------------------------------------------------------
+# Framework
+# -------------------------------------------------------------------------
+
+
+def test_registry_has_the_five_repo_checkers():
+    names = {a.name for a in all_analyzers()}
+    assert EXPECTED_CHECKS <= names
+
+
+def test_suppression_comment_silences_named_check(tmp_path):
+    bad = ("def f():\n    try:\n        pass\n"
+           "    except Exception:\n        pass\n")
+    assert checks_fired(vet_snippet(
+        tmp_path, "tpu_dra/util/a.py", bad)) == {"exception-hygiene"}
+    suppressed = bad.replace(
+        "except Exception:",
+        "except Exception:  # vet: ignore[exception-hygiene]")
+    assert vet_snippet(tmp_path, "tpu_dra/util/b.py", suppressed) == []
+    # a bracketless ignore suppresses every check on the line
+    suppress_all = bad.replace("except Exception:",
+                               "except Exception:  # vet: ignore")
+    assert vet_snippet(tmp_path, "tpu_dra/util/c.py", suppress_all) == []
+    # the wrong name does NOT suppress
+    wrong = bad.replace("except Exception:",
+                        "except Exception:  # vet: ignore[jit-purity]")
+    assert checks_fired(vet_snippet(
+        tmp_path, "tpu_dra/util/d.py", wrong)) == {"exception-hygiene"}
+
+
+def test_suppression_comment_on_preceding_line(tmp_path):
+    src = ("def f():\n"
+           "    try:\n"
+           "        pass\n"
+           "    # vet: ignore[exception-hygiene]\n"
+           "    except Exception:\n"
+           "        pass\n")
+    assert vet_snippet(tmp_path, "tpu_dra/util/e.py", src) == []
+
+
+def test_parse_error_is_a_diagnostic_not_a_crash(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/util/broken.py",
+                        "def f(:\n")
+    assert [d.check for d in diags] == ["parse-error"]
+
+
+def test_cli_json_schema_and_exit_codes(tmp_path):
+    bad = tmp_path / "tpu_dra" / "util" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def f():\n    try:\n        pass\n"
+                   "    except Exception:\n        pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis", "--json", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == JSON_SCHEMA_VERSION
+    assert payload["count"] == len(payload["diagnostics"]) == 1
+    diag = payload["diagnostics"][0]
+    assert set(diag) == {"path", "line", "col", "check", "message"}
+    assert diag["check"] == "exception-hygiene"
+    assert diag["line"] == 4
+
+    clean = tmp_path / "tpu_dra" / "util" / "ok.py"
+    clean.write_text("def f():\n    return 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis", str(clean)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout
+    assert "clean" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis",
+         "--checks", "no-such-check", str(clean)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 2
+    assert "unknown check" in proc.stderr
+
+
+# -------------------------------------------------------------------------
+# guarded-by
+# -------------------------------------------------------------------------
+
+_GUARDED_BAD = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def size(self):
+        return len(self._items)
+"""
+
+_GUARDED_CLEAN = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def size(self):
+        with self._mu:
+            return self._count()
+
+    def _count(self):  # vet: holds[self._mu]
+        return len(self._items)
+"""
+
+
+def test_guardedby_flags_unlocked_access(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/util/gb.py", _GUARDED_BAD,
+                        checks=["guarded-by"])
+    assert len(diags) == 1 and diags[0].check == "guarded-by"
+    assert "Box._items" in diags[0].message
+    assert diags[0].line == 10
+
+
+def test_guardedby_accepts_with_block_and_holds_contract(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/util/gb2.py", _GUARDED_CLEAN,
+                       checks=["guarded-by"]) == []
+
+
+def test_guardedby_nested_def_does_not_inherit_the_lock(tmp_path):
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def schedule(self, pool):
+        with self._mu:
+            pool.submit(lambda: self._items.pop())
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/gb3.py", src,
+                        checks=["guarded-by"])
+    assert len(diags) == 1, diags  # the lambda body runs lock-free later
+
+
+# -------------------------------------------------------------------------
+# reconcile-hygiene
+# -------------------------------------------------------------------------
+
+_RECONCILE_BAD = """\
+import time
+
+
+def reconcile(items):
+    for obj in items:
+        try:
+            obj.sync()
+        except Exception:
+            pass
+
+
+def wait_ready(probe):
+    while not probe():
+        time.sleep(1.0)
+"""
+
+_RECONCILE_CLEAN = """\
+import threading
+
+from tpu_dra.k8s.client import NotFound
+from tpu_dra.util import klog
+
+
+def reconcile(items, queue):
+    for obj in items:
+        try:
+            obj.sync()
+        except NotFound:
+            continue
+        except Exception as exc:
+            klog.error("sync failed", err=repr(exc))
+            queue.enqueue(obj.sync, obj)
+
+
+def wait_ready(stop: threading.Event, probe):
+    while not probe():
+        if stop.wait(1.0):
+            return
+"""
+
+
+def test_reconcile_flags_swallow_and_bare_sleep_loop(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/controller/rh.py",
+                        _RECONCILE_BAD, checks=["reconcile-hygiene"])
+    assert len(diags) == 2
+    lines = sorted(d.line for d in diags)
+    assert lines == [8, 14]
+
+
+def test_reconcile_clean_patterns_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/controller/rh2.py",
+                       _RECONCILE_CLEAN,
+                       checks=["reconcile-hygiene"]) == []
+
+
+def test_reconcile_sleep_rule_does_not_fire_outside_scope(tmp_path):
+    src = "import time\n\n\ndef f():\n    while True:\n        time.sleep(1)\n"
+    assert vet_snippet(tmp_path, "tpu_dra/api/out.py", src,
+                       checks=["reconcile-hygiene"]) == []
+
+
+# -------------------------------------------------------------------------
+# jit-purity
+# -------------------------------------------------------------------------
+
+_JIT_BAD = """\
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    print(x)
+    return np.asarray(x).sum() + x.item()
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def scaled(x, n):
+    return jax.device_get(x) * n
+
+
+def add_kernel(x_ref, y_ref, o_ref):
+    print(x_ref[0])
+    o_ref[:] = x_ref[:] + y_ref[:]
+
+
+_fused = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+
+def caller(buf, other):
+    out = _fused(buf, other)
+    return out + buf
+"""
+
+_JIT_CLEAN = """\
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x={x}", x=x)
+    return jnp.asarray(x).sum()
+
+
+def add_kernel(x_ref, y_ref, o_ref):
+    o_ref[:] = x_ref[:] + y_ref[:]
+
+
+_fused = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+
+def caller(buf, other):
+    buf = _fused(buf, other)
+    return buf + 1
+
+
+def host_side(x):
+    return x.item()
+"""
+
+
+def test_jit_purity_flags_host_syncs_and_donation_reuse(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/workloads/jp.py", _JIT_BAD,
+                        checks=["jit-purity"])
+    msgs = "\n".join(d.message for d in diags)
+    assert "print()" in msgs
+    assert "np.asarray()" in msgs
+    assert ".item()" in msgs
+    assert "jax.device_get()" in msgs
+    assert "Pallas kernel add_kernel" in msgs
+    assert "donated" in msgs
+    assert len(diags) == 6
+
+
+def test_jit_purity_clean_code_and_host_code_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/workloads/jp2.py", _JIT_CLEAN,
+                       checks=["jit-purity"]) == []
+
+
+# -------------------------------------------------------------------------
+# string-constant-drift
+# -------------------------------------------------------------------------
+
+_CONST_BAD = """\
+def owner_of(meta):
+    return meta.get("labels", {}).get("resource.tpu.google.com/sliceDomain")
+
+
+def has_finalizer(meta):
+    return "resource.tpu.google.com/slice-domane" in meta.get(
+        "finalizers", [])
+"""
+
+_CONST_CLEAN = """\
+from tpu_dra.controller.constants import DOMAIN_LABEL, FINALIZER
+
+
+def owner_of(meta):
+    return meta.get("labels", {}).get(DOMAIN_LABEL)
+
+
+def has_finalizer(meta):
+    return FINALIZER in meta.get("finalizers", [])
+"""
+
+
+def test_constant_drift_flags_inline_and_typod_literals(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/controller/cd.py", _CONST_BAD,
+                        checks=["string-constant-drift"])
+    assert len(diags) == 2
+    assert "DOMAIN_LABEL" in diags[0].message       # exact duplicate
+    assert "matches no constant" in diags[1].message  # the typo'd drift
+
+
+def test_constant_drift_clean_when_importing_constants(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/controller/cd2.py",
+                       _CONST_CLEAN,
+                       checks=["string-constant-drift"]) == []
+
+
+def test_constant_drift_out_of_scope_dirs_pass(tmp_path):
+    # workloads/ retyping a label is ugly but not this checker's contract
+    assert vet_snippet(tmp_path, "tpu_dra/workloads/cd3.py", _CONST_BAD,
+                       checks=["string-constant-drift"]) == []
+
+
+# -------------------------------------------------------------------------
+# exception-hygiene
+# -------------------------------------------------------------------------
+
+_EXC_BAD = """\
+def f():
+    try:
+        work()
+    except:
+        return None
+
+
+def g():
+    try:
+        work()
+    except Exception:
+        pass
+"""
+
+_EXC_CLEAN = """\
+from tpu_dra.util import klog
+
+
+def f():
+    try:
+        work()
+    except OSError:
+        return None
+
+
+def g():
+    try:
+        work()
+    except Exception as exc:
+        return {"error": str(exc)}
+
+
+def h():
+    try:
+        work()
+    except Exception:
+        klog.error("work failed")
+        raise
+"""
+
+
+def test_exception_hygiene_flags_bare_and_silent_broad(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/util/eh.py", _EXC_BAD,
+                        checks=["exception-hygiene"])
+    assert len(diags) == 2
+    assert "bare" in diags[0].message
+    assert "broad" in diags[1].message
+
+
+def test_exception_hygiene_clean_patterns_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/util/eh2.py", _EXC_CLEAN,
+                       checks=["exception-hygiene"]) == []
+
+
+def test_exception_hygiene_skips_test_files(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/util/test_eh.py",
+                       _EXC_BAD, checks=["exception-hygiene"]) == []
+
+
+# -------------------------------------------------------------------------
+# The tree itself + the static<->dynamic cross-wire
+# -------------------------------------------------------------------------
+
+
+def test_repo_tree_is_vet_clean():
+    """Acceptance: ``python -m tpu_dra.analysis tpu_dra/`` exits 0."""
+    diags = run_paths([os.path.join(REPO_ROOT, "tpu_dra")])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
+def test_hot_spot_files_declare_their_classes():
+    for suffix, names in guardedby.HOT_SPOTS.items():
+        path = os.path.join(REPO_ROOT, suffix)
+        assert os.path.exists(path), f"HOT_SPOTS names missing file {suffix}"
+        src = open(path).read()
+        for name in names:
+            assert re.search(rf"\bclass {name}\b", src), \
+                f"HOT_SPOTS names {name} but {suffix} has no such class"
+
+
+def test_static_hot_spots_are_exercised_by_dynamic_detector():
+    """Every guarded-by hot-spot class must run under racecheck.monitor
+    in tests/test_racecheck.py: the static lock-discipline list and the
+    dynamic happens-before list cover the same objects, so neither lane
+    can silently lose a shared-state class the other still watches."""
+    src = open(os.path.join(REPO_ROOT, "tests",
+                            "test_racecheck.py")).read()
+    monitored = set(re.findall(r"racecheck\.monitor\((\w+)\)", src))
+    for suffix, names in guardedby.HOT_SPOTS.items():
+        for name in names:
+            assert name in monitored, (
+                f"{name} ({suffix}) is a static guarded-by hot spot but "
+                f"tests/test_racecheck.py never runs it under "
+                f"racecheck.monitor — add a dynamic test or drop it "
+                f"from HOT_SPOTS")

@@ -1,0 +1,29 @@
+"""tpudra-vet: a go-vet-style analyzer framework with repo-specific checks.
+
+Static complement to the dynamic race lane (``tpu_dra/util/racecheck.py``):
+the reference driver gates CI on golangci-lint + ``go vet`` next to
+``go test -race``; this package is the vet half for the Python tree.
+See ``docs/static-analysis.md`` for the checker catalog and how to add
+one; run with ``make vet`` or ``python -m tpu_dra.analysis [paths...]``.
+"""
+
+from tpu_dra.analysis.core import (
+    Analyzer,
+    Diagnostic,
+    FileContext,
+    all_analyzers,
+    register,
+    run_paths,
+)
+from tpu_dra.analysis.report import render_json, render_text
+
+__all__ = [
+    "Analyzer",
+    "Diagnostic",
+    "FileContext",
+    "all_analyzers",
+    "register",
+    "run_paths",
+    "render_json",
+    "render_text",
+]

@@ -1,0 +1,50 @@
+"""``python -m tpu_dra.analysis [paths...]`` — the ``go vet`` entry point.
+
+Exit status: 0 clean, 1 findings, 2 usage error.  ``make vet`` runs this
+over ``tpu_dra/`` next to the dynamic race lane (``make racecheck``),
+mirroring the reference's golangci-lint + ``go test -race`` CI pairing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_dra.analysis.core import all_analyzers, run_paths
+from tpu_dra.analysis.report import render_json, render_text
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_dra.analysis",
+        description="tpudra-vet: repo-specific static analysis")
+    parser.add_argument("paths", nargs="*", default=["tpu_dra"],
+                        help="files or directories to vet "
+                             "(default: tpu_dra)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable JSON instead of text")
+    parser.add_argument("--checks",
+                        help="comma-separated subset of checks to run")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="list registered checks and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for a in all_analyzers():
+            print(f"{a.name}: {a.doc}")
+        return 0
+
+    checks = None
+    if args.checks:
+        checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+    try:
+        diags = run_paths(args.paths or ["tpu_dra"], checks=checks)
+    except ValueError as exc:
+        print(f"vet: {exc}", file=sys.stderr)
+        return 2
+    print(render_json(diags) if args.json else render_text(diags))
+    return 1 if diags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Checker catalog: importing this package registers every checker.
+
+Add a new checker by creating a module here that builds an
+:class:`tpu_dra.analysis.core.Analyzer` and passes it to ``register``,
+then importing it below (registration is the import's side effect, the
+same pattern go/analysis drivers use for their analyzer lists).
+"""
+
+from tpu_dra.analysis.checkers import (  # noqa: F401
+    constants,
+    excepts,
+    guardedby,
+    jitpurity,
+    reconcile,
+)

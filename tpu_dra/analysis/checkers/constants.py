@@ -1,0 +1,126 @@
+"""string-constant-drift: k8s contract strings come from one place.
+
+Finalizers, labels, device-class names, and CDI vendor/kind strings are
+wire contracts: the controller writes them, the plugins and cleanup
+paths match on them, and a retyped literal that drifts by one character
+fails silently (a finalizer that never gets removed, a label selector
+that matches nothing).  The reference centralizes them
+(``cmd/compute-domain-controller/computedomain.go:35-55``); here they
+live in ``tpu_dra/controller/constants.py`` and ``tpu_dra/cdi/spec.py``.
+
+This checker parses those modules for their ``UPPER_CASE = "literal"``
+assignments and flags any equal string literal retyped inline in
+``tpu_dra/controller/``, ``tpu_dra/cdi/``, or ``tpu_dra/plugins/`` —
+plus any literal under the driver's API-group prefix that matches *no*
+known constant (the drift case proper: a typo'd contract string).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from functools import lru_cache
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_SCOPE = ("tpu_dra/controller", "tpu_dra/cdi", "tpu_dra/plugins")
+
+# the modules that own the contract strings (never flagged themselves)
+_SOURCES = (
+    ("tpu_dra/controller/constants.py", "controller.constants"),
+    ("tpu_dra/cdi/spec.py", "cdi.spec"),
+    ("tpu_dra/version.py", "version"),
+)
+
+# group prefixes whose literals are contract strings even when no
+# constant matches (catches the typo'd-drift case, not just duplication)
+_CONTRACT_PREFIXES = ("resource.tpu.google.com/",)
+
+# too-short values ("tpu", "claim") appear legitimately everywhere;
+# only dotted/slashed strings of meaningful length are contracts
+_MIN_LEN = 8
+
+
+@lru_cache(maxsize=1)
+def _constant_table() -> dict[str, str]:
+    """literal value -> qualified constant name, parsed from _SOURCES."""
+    import tpu_dra
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(
+        tpu_dra.__file__)))
+    table: dict[str, str] = {}
+    for rel, modname in _SOURCES:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
+        for node in tree.body:
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Constant) or \
+                    not isinstance(node.value.value, str):
+                continue
+            value = node.value.value
+            if len(value) < _MIN_LEN or \
+                    ("." not in value and "/" not in value):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.isupper():
+                    table.setdefault(value, f"{modname}.{tgt.id}")
+    return table
+
+
+def _docstring_lines(tree: ast.AST) -> set[int]:
+    """Lines covered by docstrings (never contract strings)."""
+    lines: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                doc = body[0].value
+                lines.update(range(doc.lineno,
+                                   (doc.end_lineno or doc.lineno) + 1))
+    return lines
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or not ctx.in_dir(*_SCOPE):
+        return []
+    if any(ctx.path.endswith(rel) for rel, _ in _SOURCES):
+        return []
+    table = _constant_table()
+    doc_lines = _docstring_lines(ctx.tree)
+    diags: list[Diagnostic] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        if node.lineno in doc_lines:
+            continue
+        value = node.value
+        const = table.get(value)
+        if const is not None:
+            diags.append(ctx.diag(
+                node, "string-constant-drift",
+                f"inline literal {value!r} duplicates tpu_dra.{const}; "
+                f"import the constant so the contract cannot drift"))
+        elif any(value.startswith(p) for p in _CONTRACT_PREFIXES):
+            diags.append(ctx.diag(
+                node, "string-constant-drift",
+                f"literal {value!r} is under the driver API group but "
+                f"matches no constant in controller/constants.py — "
+                f"either it drifted from the real contract string or a "
+                f"new constant is missing"))
+    return diags
+
+
+register(Analyzer(
+    name="string-constant-drift",
+    doc="finalizer/label/device-class/CDI strings in controller/, cdi/, "
+        "plugins/ must come from controller.constants or cdi.spec, not "
+        "be retyped inline",
+    run=_run,
+    scope=_SCOPE,
+))

@@ -1,0 +1,86 @@
+"""exception-hygiene: no silent swallows in non-test code.
+
+Two rules:
+
+- a bare ``except:`` is always flagged — it catches ``SystemExit`` /
+  ``KeyboardInterrupt`` and hides everything;
+- ``except Exception`` / ``except BaseException`` is flagged when the
+  handler neither re-raises, nor logs (klog/logging), nor *uses* the
+  bound exception (building an error response from ``exc`` counts as
+  handling; an unused ``as exc`` or no binding at all does not).
+
+Narrowing the type is always an acceptable fix: ``except OSError: pass``
+around a best-effort cleanup says exactly which failures are expected,
+where ``except Exception: pass`` also eats the TypeError that means the
+code is wrong.  Genuinely-must-never-raise sites (interpreter shims,
+diagnostics formatting) carry a justified
+``# vet: ignore[exception-hygiene]``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_LOG_ROOTS = {"klog", "logging", "log", "logger"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "fatal"}
+
+
+def _is_broad(type_node: ast.expr | None) -> bool:
+    if type_node is None:
+        return True
+    for node in ast.walk(type_node):
+        if isinstance(node, ast.Name) and \
+                node.id in ("Exception", "BaseException"):
+            return True
+        if isinstance(node, ast.Attribute) and \
+                node.attr in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_ok(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            root = node.func.value
+            if isinstance(root, ast.Name) and root.id in _LOG_ROOTS and \
+                    node.func.attr in _LOG_METHODS:
+                return True
+    return False
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test():
+        return []
+    diags: list[Diagnostic] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            diags.append(ctx.diag(
+                node, "exception-hygiene",
+                "bare `except:` catches SystemExit/KeyboardInterrupt; "
+                "name the exception type"))
+        elif _is_broad(node.type) and not _handler_ok(node):
+            diags.append(ctx.diag(
+                node, "exception-hygiene",
+                "broad except swallows the error silently: narrow the "
+                "exception type, log via klog, use the bound exception, "
+                "or re-raise"))
+    return diags
+
+
+register(Analyzer(
+    name="exception-hygiene",
+    doc="no bare `except:`; no `except Exception` that neither "
+        "re-raises, logs, nor uses the bound exception",
+    run=_run,
+))

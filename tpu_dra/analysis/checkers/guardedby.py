@@ -1,0 +1,171 @@
+"""guarded-by: lock-discipline checker, the static half of ``-race``.
+
+A field whose ``__init__`` assignment carries a trailing
+``# guarded by self._mu`` comment may only be read or written inside a
+``with self._mu:`` block (or from a method whose ``def`` line declares
+``# vet: holds[self._mu]`` — the caller-acquires contract).  ``__init__``
+itself is exempt: construction happens-before publication, the same
+reasoning the dynamic detector (``tpu_dra/util/racecheck.py``) encodes as
+the fork edge.
+
+The repo's known shared-state hot spots (the classes
+``tests/test_racecheck.py`` exercises under the dynamic detector) MUST
+declare at least one guarded field, so the static and dynamic lanes
+cover the same objects; ``tests/test_vet.py`` cross-checks the two lists
+against each other.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+# file suffix -> classes that must declare guarded fields.  Kept in sync
+# with the dynamic lane: every class named here is also run under
+# racecheck.monitor in tests/test_racecheck.py (cross-wired by
+# tests/test_vet.py so the lists cannot drift apart).
+HOT_SPOTS: dict[str, tuple[str, ...]] = {
+    "tpu_dra/util/workqueue.py": ("WorkQueue", "ItemExponentialBackoff"),
+    "tpu_dra/k8s/informer.py": ("Store",),
+    "tpu_dra/daemon/membership.py": ("MembershipManager",),
+    "tpu_dra/workloads/serve.py": ("DecoderPool",),
+}
+
+_GUARDED_RE = re.compile(r"#.*guarded by\s+self\.(\w+)")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.X`` -> ``X``; anything else -> None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _guard_map(ctx: FileContext, cls: ast.ClassDef) -> dict[str, str]:
+    """field name -> guard name, from ``guarded by`` comments trailing a
+    ``self.X = ...`` assignment (or alone on the line above it) anywhere
+    in the class body."""
+    guards: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        m = _GUARDED_RE.search(ctx.comment_on(node.lineno))
+        if not m:
+            above = node.lineno - 1
+            if above >= 1 and ctx.is_comment_line(above):
+                m = _GUARDED_RE.search(ctx.comment_on(above))
+        if not m:
+            continue
+        for tgt in targets:
+            name = _self_attr(tgt)
+            if name:
+                guards[name] = m.group(1)
+    return guards
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Walk one method tracking which ``self.<lock>`` locks are held."""
+
+    def __init__(self, ctx: FileContext, cls: str, guards: dict[str, str],
+                 held: set[str]):
+        self.ctx = ctx
+        self.cls = cls
+        self.guards = guards
+        self.held = held
+        self.diags: list[Diagnostic] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = set()
+        for item in node.items:
+            name = _self_attr(item.context_expr)
+            if name is not None and name not in self.held:
+                acquired.add(name)
+        self.held |= acquired
+        self.generic_visit(node)
+        self.held -= acquired
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        name = _self_attr(node)
+        guard = self.guards.get(name) if name else None
+        if guard is not None and guard not in self.held:
+            verb = "written" if isinstance(node.ctx, ast.Store) else "read"
+            self.diags.append(self.ctx.diag(
+                node, "guarded-by",
+                f"{self.cls}.{name} is guarded by self.{guard} but "
+                f"{verb} outside `with self.{guard}:` (declare "
+                f"`# vet: holds[self.{guard}]` on the def line if the "
+                f"caller acquires it)"))
+        self.generic_visit(node)
+
+    def _visit_nested(self, node) -> None:
+        # a nested def/lambda may run on another thread after the lock is
+        # gone: its body starts with nothing held
+        saved, self.held = self.held, set()
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_FunctionDef = _visit_nested
+    visit_AsyncFunctionDef = _visit_nested
+    visit_Lambda = _visit_nested
+
+
+def _check_class(ctx: FileContext, cls: ast.ClassDef) -> list[Diagnostic]:
+    guards = _guard_map(ctx, cls)
+    diags: list[Diagnostic] = []
+    if not guards:
+        return diags
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in ("__init__", "__del__", "__post_init__"):
+            continue
+        # the holds declaration may trail any line of a wrapped def header
+        header_end = node.body[0].lineno if node.body else node.lineno + 1
+        held = {h.split(".")[-1]
+                for line in range(node.lineno, header_end)
+                for h in ctx.holds_on(line)}
+        visitor = _MethodVisitor(ctx, cls.name, guards, held)
+        for stmt in node.body:
+            visitor.visit(stmt)
+        diags.extend(visitor.diags)
+    return diags
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test():
+        return []
+    diags: list[Diagnostic] = []
+    classes = {n.name: n for n in ast.walk(ctx.tree)
+               if isinstance(n, ast.ClassDef)}
+    for cls in classes.values():
+        diags.extend(_check_class(ctx, cls))
+    for suffix, names in HOT_SPOTS.items():
+        if not ctx.path.endswith(suffix):
+            continue
+        for name in names:
+            cls = classes.get(name)
+            if cls is None:
+                diags.append(ctx.diag(
+                    1, "guarded-by",
+                    f"hot-spot class {name} not found in {suffix}; "
+                    f"update HOT_SPOTS in the guarded-by checker"))
+            elif not _guard_map(ctx, cls):
+                diags.append(ctx.diag(
+                    cls, "guarded-by",
+                    f"{name} is a shared-state hot spot but declares no "
+                    f"`# guarded by self.<lock>` fields"))
+    return diags
+
+
+register(Analyzer(
+    name="guarded-by",
+    doc="fields annotated `# guarded by self.<lock>` must only be "
+        "accessed under `with self.<lock>:`; hot-spot classes must "
+        "declare their guards",
+    run=_run,
+))

@@ -1,0 +1,123 @@
+"""reconcile-hygiene: retry loops must back off; reconcilers must not
+swallow errors.
+
+Two rules, mirroring the discipline the reference's controller code gets
+from client-go's workqueue + apimachinery ``wait`` helpers:
+
+1. ``time.sleep`` inside a ``while``/``for`` body is a bare spin-retry or
+   poll loop.  Those burn CPU under sustained failure and cannot be
+   interrupted at shutdown.  Use the workqueue's per-item backoff
+   (``tpu_dra.util.workqueue.ItemExponentialBackoff``), an
+   ``Event.wait(timeout)`` / ``Condition.wait(timeout)`` (interruptible),
+   or a justified ``# vet: ignore[reconcile-hygiene]``.  Scope: every
+   control-plane and data-path package (controller, daemon, k8s, plugins,
+   util, workloads).
+
+2. In ``tpu_dra/controller/`` and ``tpu_dra/daemon/`` — the reconcile
+   loops — an ``except`` handler must do *something* with the failure:
+   re-raise, log it (klog), requeue it, or invoke an error callback.  A
+   handler that does none of those turns a reconcile error into silence,
+   which at production scale is an object stuck in a bad state forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_SLEEP_SCOPE = ("tpu_dra/controller", "tpu_dra/daemon", "tpu_dra/k8s",
+                "tpu_dra/plugins", "tpu_dra/util", "tpu_dra/workloads")
+_SWALLOW_SCOPE = ("tpu_dra/controller", "tpu_dra/daemon")
+
+# call names in a handler that count as "the error went somewhere"
+_HANDLED_CALLS = {"enqueue", "enqueue_with_deadline", "requeue",
+                  "on_error", "put", "append"}
+_LOG_ROOTS = {"klog", "logging", "log", "logger"}
+
+
+def _is_time_sleep(node: ast.Call) -> bool:
+    fn = node.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "time")
+
+
+def _loops_with_sleep(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _is_time_sleep(sub):
+                yield sub
+
+
+def _handler_disposes(handler: ast.ExceptHandler) -> bool:
+    """True when the handler routes the error somewhere visible.
+
+    A *narrow* type (``except NotFound: return``) is expected-path
+    handling — the idempotent-delete / conflict-retry idioms — and
+    always passes.  A *broad* catch (bare / ``Exception`` /
+    ``BaseException``) in reconcile code must re-raise, log via klog, or
+    requeue; merely binding ``as exc`` is not enough — a reconcile error
+    that goes nowhere is an object stuck in a bad state forever.
+    """
+    if handler.type is not None and _names_narrow(handler.type):
+        return True
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                root = fn.value
+                if isinstance(root, ast.Name) and root.id in _LOG_ROOTS:
+                    return True
+                if fn.attr in _HANDLED_CALLS:
+                    return True
+            elif isinstance(fn, ast.Name) and fn.id in _HANDLED_CALLS:
+                return True
+    return False
+
+
+def _names_narrow(type_node: ast.expr) -> bool:
+    """True unless the handler catches Exception/BaseException or bare."""
+    names = []
+    for node in ast.walk(type_node):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return bool(names) and not any(
+        n in ("Exception", "BaseException") for n in names)
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test():
+        return []
+    diags: list[Diagnostic] = []
+    if ctx.in_dir(*_SLEEP_SCOPE):
+        for call in _loops_with_sleep(ctx.tree):
+            diags.append(ctx.diag(
+                call, "reconcile-hygiene",
+                "bare time.sleep inside a loop: use "
+                "ItemExponentialBackoff, Event.wait(timeout), or "
+                "Condition.wait(timeout) so retries back off and "
+                "shutdown can interrupt the wait"))
+    if ctx.in_dir(*_SWALLOW_SCOPE):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and \
+                    not _handler_disposes(node):
+                diags.append(ctx.diag(
+                    node, "reconcile-hygiene",
+                    "except handler swallows the error: re-raise, log "
+                    "via klog, or requeue the item"))
+    return diags
+
+
+register(Analyzer(
+    name="reconcile-hygiene",
+    doc="no bare time.sleep retry/poll loops; reconcile error handlers "
+        "must re-raise, log, or requeue",
+    run=_run,
+    scope=_SLEEP_SCOPE,
+))

@@ -1,0 +1,231 @@
+"""Analyzer framework core: the ``golang.org/x/tools/go/analysis`` analog.
+
+The reference gates CI on ``go vet`` + golangci-lint
+(``.github/workflows/golang.yaml``); go vet itself is a thin driver over
+the go/analysis ``Analyzer`` abstraction — a named check with a run
+function over one parsed file, producing positioned diagnostics.  This
+module reproduces that shape for the Python tree:
+
+- :class:`Analyzer` — a named checker with a ``run(FileContext)`` hook;
+- :class:`FileContext` — one file parsed once (AST + raw lines + comment
+  map), shared by every registered analyzer, exactly like a go/analysis
+  Pass shares the parsed ``*ast.File``;
+- :class:`Diagnostic` — check name + file:line:col + message;
+- inline suppressions — ``# vet: ignore[check-name]`` on the offending
+  line (or alone on the line above), the ``//nolint:`` analog;
+- :func:`run_paths` — the driver: walks files, parses, fans out to every
+  analyzer, filters suppressed findings, returns them sorted.
+
+Checkers live in :mod:`tpu_dra.analysis.checkers` and self-register at
+import; ``python -m tpu_dra.analysis`` is the CLI entry point.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+__all__ = [
+    "Analyzer",
+    "Diagnostic",
+    "FileContext",
+    "register",
+    "all_analyzers",
+    "run_paths",
+    "collect_files",
+]
+
+# ``# vet: ignore`` or ``# vet: ignore[name-a, name-b]`` anywhere in a
+# comment; no bracket = suppress every check on that line.
+_IGNORE_RE = re.compile(r"#\s*vet:\s*ignore(?:\[([^\]]*)\])?")
+
+# ``# vet: holds[self._mu]`` on a ``def`` line: the method body runs with
+# that lock held (caller-acquires contract, the +checklocks analog); used
+# by the guarded-by checker.
+_HOLDS_RE = re.compile(r"#\s*vet:\s*holds\[([^\]]*)\]")
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding: where, which check, and what is wrong."""
+
+    path: str
+    line: int
+    col: int
+    check: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.check}] " \
+               f"{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "check": self.check, "message": self.message}
+
+
+class FileContext:
+    """One source file, parsed once and shared by every analyzer."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> comment text (with the leading ``#``); line -> set of
+        # suppressed check names ("*" = all); line -> holds declarations
+        self.comments: dict[int, str] = {}
+        self.suppressions: dict[int, set[str]] = {}
+        self.holds: dict[int, list[str]] = {}
+        self._scan_comments()
+
+    # -- comments / suppressions ---------------------------------------
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                self.comments[line] = tok.string
+                m = _IGNORE_RE.search(tok.string)
+                if m:
+                    names = {"*"} if m.group(1) is None else {
+                        n.strip() for n in m.group(1).split(",") if n.strip()}
+                    target = line
+                    # a comment alone on its line suppresses the next line
+                    if self.is_comment_line(line):
+                        target = line + 1
+                    self.suppressions.setdefault(target, set()).update(names)
+                h = _HOLDS_RE.search(tok.string)
+                if h:
+                    self.holds[line] = [
+                        n.strip() for n in h.group(1).split(",") if n.strip()]
+        except tokenize.TokenError:
+            pass  # a parseable file that won't tokenize cleanly is rare;
+            # analyzers still run, only suppressions are lost
+
+    def is_comment_line(self, line: int) -> bool:
+        """True when the 1-based line holds only a comment — the shared
+        rule for annotations placed alone on the line above their
+        target (suppressions here, ``guarded by`` in the checker)."""
+        text = self.lines[line - 1] if 1 <= line <= len(self.lines) else ""
+        return text.lstrip().startswith("#")
+
+    def suppressed(self, line: int, check: str) -> bool:
+        names = self.suppressions.get(line)
+        return bool(names) and ("*" in names or check in names)
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def holds_on(self, line: int) -> list[str]:
+        return self.holds.get(line, [])
+
+    # -- path scoping ---------------------------------------------------
+    def in_dir(self, *prefixes: str) -> bool:
+        """True when the file lives under any of the repo-relative
+        prefixes (matched as path substrings so fixture trees in tmp
+        dirs scope identically)."""
+        p = "/" + self.path.lstrip("/")
+        return any(f"/{pref.strip('/')}/" in p for pref in prefixes)
+
+    def is_test(self) -> bool:
+        base = self.path.rsplit("/", 1)[-1]
+        return base.startswith("test_") or base == "conftest.py" \
+            or self.in_dir("tests")
+
+    def diag(self, node: ast.AST | int, check: str, message: str,
+             col: int = 0) -> Diagnostic:
+        if isinstance(node, ast.AST):
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+        else:
+            line = node
+        return Diagnostic(self.path, line, col, check, message)
+
+
+@dataclass
+class Analyzer:
+    """A named checker, go/analysis ``Analyzer`` analog."""
+
+    name: str
+    doc: str
+    run: Callable[[FileContext], list[Diagnostic]]
+    # checkers that only ever fire under these path prefixes advertise
+    # them so the driver can skip whole files (and docs can say so)
+    scope: tuple[str, ...] = field(default_factory=tuple)
+
+
+_REGISTRY: dict[str, Analyzer] = {}
+
+
+def register(analyzer: Analyzer) -> Analyzer:
+    if analyzer.name in _REGISTRY:
+        raise ValueError(f"duplicate analyzer {analyzer.name!r}")
+    _REGISTRY[analyzer.name] = analyzer
+    return analyzer
+
+
+def all_analyzers() -> list[Analyzer]:
+    # checkers self-register at import, exactly once
+    from tpu_dra.analysis import checkers  # noqa: F401
+
+    return [_REGISTRY[name] for name in sorted(_REGISTRY)]
+
+
+def collect_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__")
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+        elif os.path.isfile(path) and path.endswith(".py"):
+            out.append(path)
+        else:
+            # a typo'd path must not silently report "clean": that would
+            # green-light CI with zero files analyzed
+            raise ValueError(f"no such file or directory: {path}")
+    return out
+
+
+def run_paths(paths: Iterable[str],
+              checks: Optional[Iterable[str]] = None) -> list[Diagnostic]:
+    """The vet driver: parse each file once, run every analyzer on it."""
+    wanted = set(checks) if checks is not None else None
+    analyzers = [a for a in all_analyzers()
+                 if wanted is None or a.name in wanted]
+    if wanted is not None:
+        unknown = wanted - {a.name for a in analyzers}
+        if unknown:
+            raise ValueError(
+                f"unknown check(s): {', '.join(sorted(unknown))}; "
+                f"known: {', '.join(a.name for a in all_analyzers())}")
+    diags: list[Diagnostic] = []
+    for path in collect_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            ctx = FileContext(path, source)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            diags.append(Diagnostic(
+                path.replace(os.sep, "/"),
+                getattr(exc, "lineno", None) or 1, 0, "parse-error",
+                f"cannot parse: {exc}"))
+            continue
+        for analyzer in analyzers:
+            for d in analyzer.run(ctx):
+                if not ctx.suppressed(d.line, d.check):
+                    diags.append(d)
+    diags.sort(key=lambda d: (d.path, d.line, d.col, d.check))
+    return diags

@@ -1,0 +1,28 @@
+"""Diagnostic reporters: text (one finding per line) and JSON.
+
+The text form matches the ``file:line:col: [check] message`` shape go vet
+prints; the JSON form is a stable machine-readable schema for CI
+annotation tooling (``schema_version`` guards consumers against drift).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_dra.analysis.core import Diagnostic
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_text(diags: list[Diagnostic]) -> str:
+    lines = [str(d) for d in diags]
+    lines.append(f"{len(diags)} finding(s)" if diags else "clean")
+    return "\n".join(lines)
+
+
+def render_json(diags: list[Diagnostic]) -> str:
+    return json.dumps({
+        "schema_version": JSON_SCHEMA_VERSION,
+        "count": len(diags),
+        "diagnostics": [d.to_dict() for d in diags],
+    }, indent=2, sort_keys=True)

@@ -20,6 +20,7 @@ from tpu_dra.k8s.client import (
     RESOURCE_CLAIM_TEMPLATES,
 )
 from tpu_dra.util import klog
+from tpu_dra.version import API_GROUP
 from tpu_dra.util.metrics import DEFAULT_REGISTRY
 from tpu_dra.util.workqueue import WorkQueue
 
@@ -77,7 +78,7 @@ class Controller:
     def _delete_stale(self, res, obj: dict) -> None:
         meta = obj["metadata"]
         finalizers = [f for f in meta.get("finalizers", [])
-                      if not f.startswith("resource.tpu.google.com/")]
+                      if not f.startswith(API_GROUP + "/")]
         if finalizers != meta.get("finalizers", []):
             meta["finalizers"] = finalizers
             try:

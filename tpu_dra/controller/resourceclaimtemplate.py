@@ -17,7 +17,11 @@ Both carry the domain label + finalizer and are rendered from yaml templates.
 from __future__ import annotations
 
 from tpu_dra.api.types import TpuSliceDomain
-from tpu_dra.controller.constants import FINALIZER, daemon_rct_name
+from tpu_dra.controller.constants import (
+    DOMAIN_LABEL,
+    FINALIZER,
+    daemon_rct_name,
+)
 from tpu_dra.k8s.client import (
     Conflict,
     KubeClient,
@@ -153,7 +157,7 @@ class WorkloadRCTManager(BaseRCTManager):
                                      self.name_for(domain),
                                      self.namespace_for(domain))
             owner = existing.get("metadata", {}).get("labels", {}) \
-                .get("resource.tpu.google.com/sliceDomain")
+                .get(DOMAIN_LABEL)
             if owner != domain.uid:
                 # user-chosen name collided with an unrelated object —
                 # surfaced as a retried error, never adopted

@@ -245,7 +245,9 @@ def check(argv=None) -> int:
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/ready", timeout=2) as resp:
             body = resp.read().decode()
-    except Exception as exc:  # noqa: BLE001 — probe failure path
+    # kubelet exec probe: stderr + exit code ARE the reporting channel
+    # (main.go:255-289); klog isn't wired in this subcommand
+    except Exception as exc:  # noqa: BLE001  # vet: ignore[reconcile-hygiene]
         print(f"NOT READY: {exc}", file=sys.stderr)
         return 1
     if body.strip() != "READY":

@@ -42,7 +42,7 @@ class MembershipManager:
             on_add=self._on_change,
             on_update=lambda old, new: self._on_change(new))
         self._updates: "queue.Queue[list[TpuSliceDomainNode]]" = queue.Queue()
-        self._last_ips: Optional[frozenset[str]] = None
+        self._last_ips: Optional[frozenset[str]] = None   # guarded by self._mu
         self._mu = threading.Lock()
 
     def start(self) -> None:

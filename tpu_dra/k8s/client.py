@@ -11,6 +11,7 @@ watch streams.  QPS/burst rate limiting mirrors kubeclient.go:32-41.
 
 from __future__ import annotations
 
+import http.client
 import json
 import ssl
 import threading
@@ -148,8 +149,11 @@ class _TokenBucket:
                     return
                 wait = (1 - self.tokens) / self.qps
             # sleep outside the lock, then re-contend for a token: N
-            # concurrent waiters must not all proceed after one interval
-            time.sleep(wait)
+            # concurrent waiters must not all proceed after one interval.
+            # A bare sleep is the token-bucket pacing primitive itself
+            # (client-go's rate limiter blocks identically), not a retry
+            # loop — there is nothing to back off from or interrupt.
+            time.sleep(wait)  # vet: ignore[reconcile-hygiene]
 
 
 class KubeClient:
@@ -272,8 +276,10 @@ class RestKubeClient(KubeClient):
             msg = ""
             try:
                 msg = exc.read().decode(errors="replace")[:2048]
-            except Exception:
-                pass
+            # IncompleteRead et al. are HTTPException, not OSError: the
+            # typed error_for raise below must still happen
+            except (OSError, ValueError, http.client.HTTPException):
+                pass   # body unreadable: report the bare status code
             raise error_for(exc.code, msg) from exc
         if stream:
             return resp

@@ -39,13 +39,14 @@ class Store:
 
     def __init__(self, indexers: Optional[dict[str, IndexFunc]] = None):
         self._mu = threading.RLock()
-        self._objs: dict[tuple[str, str], dict] = {}
+        self._objs: dict[tuple[str, str], dict] = {}   # guarded by self._mu
         self._indexers = indexers or {}
+        # guarded by self._mu
         self._indices: dict[str, dict[str, set[tuple[str, str]]]] = \
             {name: {} for name in self._indexers}
         # mutation cache: recently-written objects override the informer view
         # until the watch catches up (reference daemonset.go:94-99)
-        self._mutations: dict[tuple[str, str], tuple[dict, float]] = {}
+        self._mutations: dict[tuple[str, str], tuple[dict, float]] = {}  # guarded by self._mu
         self._mutation_ttl = 10.0
 
     @staticmethod
@@ -53,7 +54,8 @@ class Store:
         meta = obj.get("metadata", {})
         return (meta.get("namespace", ""), meta.get("name", ""))
 
-    def _reindex(self, key, old: Optional[dict], new: Optional[dict]):
+    def _reindex(self, key, old: Optional[dict],
+                 new: Optional[dict]):  # vet: holds[self._mu]
         for name, fn in self._indexers.items():
             idx = self._indices[name]
             if old is not None:

@@ -227,8 +227,10 @@ def install(env=None) -> None:
     env = os.environ if env is None else env
     try:
         apply_hbm_limit(env)
-    except Exception:                    # noqa: BLE001 — never brick python
-        pass
+    # the shim runs inside arbitrary tenant interpreters and may not
+    # import klog (or anything): swallowing is the contract here
+    except Exception:  # noqa: BLE001  # vet: ignore[exception-hygiene]
+        pass                             # never brick python
     if env.get("TPU_MULTIPROCESS_SLOT_DIR") or env.get(
             "TPU_PROCESS_PRIORITY"):
         triggers = set(filter(None, env.get(
@@ -251,8 +253,10 @@ def _chain_shadowed_sitecustomize() -> None:
         if spec and spec.loader:
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
-    except Exception:                    # noqa: BLE001 — tenant hook bugs
-        pass                             # must not break the interpreter
+    # tenant hook bugs must not break the interpreter, and the shim has
+    # no logger to route them to (see install() above)
+    except Exception:  # noqa: BLE001  # vet: ignore[exception-hygiene]
+        pass
     finally:
         sys.path = saved
 

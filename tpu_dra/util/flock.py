@@ -56,7 +56,10 @@ class Flock:
                     raise FlockTimeout(
                         f"timed out after {self.timeout}s acquiring {self.path}"
                     )
-                time.sleep(self.poll_interval)
+                # polling LOCK_NB with a deadline IS the reference design
+                # (flock.go:27-133) — flock has no notification to wait
+                # on, and the deadline above bounds the loop
+                time.sleep(self.poll_interval)  # vet: ignore[reconcile-hygiene]
         except BaseException:
             if self._fd is None:
                 os.close(fd)

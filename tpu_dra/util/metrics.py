@@ -183,7 +183,9 @@ def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
             key = ";".join(reversed(stack))
             counts[key] = counts.get(key, 0) + 1
         n_samples += 1
-        time.sleep(interval)
+        # fixed-rate sampling pacing, not a retry loop: the profiler
+        # MUST tick at interval or the sample weights are wrong
+        time.sleep(interval)  # vet: ignore[reconcile-hygiene]
     lines = [f"# cpu profile: {n_samples} samples @ {hz}Hz over "
              f"{seconds:.1f}s (collapsed stacks)"]
     for key, c in sorted(counts.items(), key=lambda kv: -kv[1]):

@@ -160,7 +160,9 @@ def _stack() -> list:
 def _fmt(frames: list) -> list[str]:
     try:
         return traceback.StackSummary.from_list(frames).format()
-    except Exception:  # noqa: BLE001 — diagnostics only
+    # diagnostics-only formatting: a failure here must never mask the
+    # race being reported, so everything degrades to repr
+    except Exception:  # noqa: BLE001  # vet: ignore[exception-hygiene]
         return [repr(f) for f in frames]
 
 

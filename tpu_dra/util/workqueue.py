@@ -45,7 +45,7 @@ class ItemExponentialBackoff:
     def __init__(self, base: float = 0.005, cap: float = 30.0) -> None:
         self.base = base
         self.cap = cap
-        self._failures: dict[Any, int] = {}
+        self._failures: dict[Any, int] = {}   # guarded by self._mu
         self._mu = threading.Lock()
 
     def when(self, key: Any) -> float:
@@ -86,12 +86,12 @@ class WorkQueue:
                  backoff: ItemExponentialBackoff | None = None) -> None:
         self.name = name
         self._backoff = backoff or ItemExponentialBackoff()
-        self._queue: list[_WorkItem] = []
-        self._delayed: list[_Delayed] = []
-        self._seq = 0
+        self._queue: list[_WorkItem] = []     # guarded by self._cv
+        self._delayed: list[_Delayed] = []    # guarded by self._cv
+        self._seq = 0                         # guarded by self._cv
         self._cv = threading.Condition()
-        self._shutdown = False
-        self._active = 0
+        self._shutdown = False                # guarded by self._cv
+        self._active = 0                      # guarded by self._cv
 
     # -- producer side -----------------------------------------------------
     def enqueue(self, callback: Callable[[Any], None], obj: Any,

@@ -1178,15 +1178,20 @@ class ContinuousEngine:
             self._draining = True
         deadline = None if timeout is None else \
             time.perf_counter() + timeout
-        while True:
-            with self._cv:
+        with self._cv:
+            while True:
                 empty = (not self._pending
                          and all(r is None for r in self._requests))
-            if empty:
-                return True
-            if deadline is not None and time.perf_counter() > deadline:
-                return False
-            time.sleep(0.02)
+                if empty:
+                    return True
+                remaining = None if deadline is None else \
+                    deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    return False
+                # woken by the batcher's completion notify_all; the
+                # 20ms cap re-checks even if a notify is missed
+                self._cv.wait(0.02 if remaining is None
+                              else min(0.02, remaining))
 
     def shutdown(self) -> None:
         with self._cv:

@@ -10,6 +10,7 @@ either the mounted nodes config or the per-node coordination service.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import urllib.request
@@ -351,8 +352,9 @@ def _from_coordservice(port: int, my_ip: str,
         # path and the settings-dir path resolve identically
         data = json.loads(urllib.request.urlopen(
             f"{base}/nodes", timeout=5).read())
-    except Exception:  # noqa: BLE001 — caller falls back / errors out
-        return None
+    # HTTPException (e.g. IncompleteRead mid-body) is not an OSError
+    except (OSError, ValueError, http.client.HTTPException):
+        return None   # unreachable / non-JSON: caller falls back / errors
     return _info_from_config(data, my_ip, env)
 
 

@@ -96,7 +96,7 @@ class DecoderPool:
         self.cfg = cfg
         self.params = params
         self.cache_dtype = cache_dtype
-        self._fns: dict = {}
+        self._fns: dict = {}          # guarded by self._lock
         self._lock = threading.Lock()
 
     def generate(self, rows: list[list[int]], steps: int,
