@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test bench drive image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench drive image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -21,6 +21,12 @@ test: native
 	else \
 	  TPU_DRA_ALLOW_SINGLE_PROCESS=1 $(PYTHON) -m pytest tests/ -q; \
 	fi
+
+# fast lane: just the DRA-core subset (state machines, k8s plumbing,
+# plugins — the `core` pytest marker; no JAX workload compiles).  Seconds
+# instead of minutes: run it on every edit, the full `test` before a PR.
+test-core: native
+	TPU_DRA_ALLOW_SINGLE_PROCESS=1 $(PYTHON) -m pytest tests/ -q -m core
 
 bench: native
 	$(PYTHON) bench.py

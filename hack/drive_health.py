@@ -1,0 +1,196 @@
+"""Drive the chip-health subsystem against the REAL plugin binary.
+
+Same harness as hack/drive_plugin.py (HTTP facade over the in-memory
+fake, real `tpu_dra.plugins.tpu.main` subprocess, synthetic driver
+root), but exercising the ISSUE 2 fault path on real surfaces: delete a
+chip's device node out from under the running plugin and assert the
+ResourceSlice drains, /healthz flips to 503, prepares are rejected, a
+Warning Event lands on the pinned claim — then restore the node and
+assert recovery republishes the chip and /healthz returns 200.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra.k8s.testserver import KubeTestServer           # noqa: E402
+from tpu_dra.k8s import EVENTS, RESOURCE_CLAIMS              # noqa: E402
+from tpu_dra.kubeletplugin.proto import (                    # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.version import DRIVER_NAME                      # noqa: E402
+
+
+def rpc(sock, method, request, response_cls, timeout=10.0):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with grpc.insecure_channel(f"unix:{sock}") as ch:
+                fn = ch.unary_unary(
+                    method,
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=response_cls.FromString)
+                return fn(request, timeout=5)
+        except grpc.RpcError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def wait_until(pred, timeout=20.0, what=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def healthz_code(port):
+    try:
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).status
+    except urllib.error.HTTPError as err:
+        return err.code
+
+
+def main():
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="drive-health-"))
+    srv = KubeTestServer().start()
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp / "kubeconfig"))
+        root = tmp / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(4):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "etc").mkdir()
+        (root / "etc" / "machine-id").write_text("deadbeefcafe\n")
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-4'\nTPU_TOPOLOGY: '2x2'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            mport = s.getsockname()[1]
+        env = {**os.environ, "PYTHONPATH": REPO}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+             "--kubeconfig", kcfg, "--node-name", "node-a",
+             "--tpu-driver-root", str(root),
+             "--kubelet-plugins-dir", str(tmp / "plugins"),
+             "--kubelet-registry-dir", str(tmp / "registry"),
+             "--cdi-root", str(tmp / "cdi"),
+             "--http-endpoint", f"127.0.0.1:{mport}",
+             "--health-interval", "0.3",
+             "--health-fail-threshold", "2",
+             "--health-pass-threshold", "1",
+             "--ignore-host-tpu-env"], cwd=REPO, env=env)
+        try:
+            dra_sock = tmp / "plugins" / DRIVER_NAME / "dra.sock"
+            wait_until(dra_sock.exists, what="plugin socket")
+
+            def slice_devices():
+                url = (f"http://127.0.0.1:{srv.port}/apis/resource.k8s.io/"
+                       "v1beta1/resourceslices")
+                items = json.load(urllib.request.urlopen(url))["items"]
+                return [d["name"] for s in items
+                        for d in s["spec"]["devices"]]
+
+            wait_until(lambda: len(slice_devices()) == 4,
+                       what="initial 4-device slice")
+            wait_until(lambda: healthz_code(mport) == 200, what="healthz 200")
+            print(f"OK baseline: {sorted(slice_devices())}, /healthz 200")
+
+            # pin a claim to tpu-1 so remediation has something to report
+            claim = {"metadata": {"name": "c1", "namespace": "default"},
+                     "spec": {},
+                     "status": {"allocation": {"devices": {"results": [
+                         {"request": "tpus", "driver": DRIVER_NAME,
+                          "pool": "node-a", "device": "tpu-1"}]}}}}
+            uid = srv.fake.create(RESOURCE_CLAIMS, claim)["metadata"]["uid"]
+            req = dra_pb.NodePrepareResourcesRequest()
+            c = req.claims.add()
+            c.uid, c.name, c.namespace = uid, "c1", "default"
+            res = rpc(str(dra_sock),
+                      "/v1beta1.DRAPlugin/NodePrepareResources",
+                      req, dra_pb.NodePrepareResourcesResponse)
+            assert res.claims[uid].error == "", res.claims[uid].error
+            print("OK prepared claim on tpu-1")
+
+            # ---- fault: the chip's device node vanishes ----
+            (root / "dev" / "accel1").unlink()
+            wait_until(lambda: "tpu-1" not in slice_devices(),
+                       what="tpu-1 drained from the ResourceSlice")
+            assert "tpu-0" in slice_devices()
+            wait_until(lambda: healthz_code(mport) == 503,
+                       what="/healthz 503")
+            print("OK fault: tpu-1 drained, /healthz 503")
+
+            # a new prepare on the dead chip is rejected
+            claim2 = {"metadata": {"name": "c2", "namespace": "default"},
+                      "spec": {},
+                      "status": {"allocation": {"devices": {"results": [
+                          {"request": "tpus", "driver": DRIVER_NAME,
+                           "pool": "node-a", "device": "tpu-1"}]}}}}
+            uid2 = srv.fake.create(RESOURCE_CLAIMS,
+                                   claim2)["metadata"]["uid"]
+            req2 = dra_pb.NodePrepareResourcesRequest()
+            c2 = req2.claims.add()
+            c2.uid, c2.name, c2.namespace = uid2, "c2", "default"
+            res2 = rpc(str(dra_sock),
+                       "/v1beta1.DRAPlugin/NodePrepareResources",
+                       req2, dra_pb.NodePrepareResourcesResponse)
+            assert "Unhealthy" in res2.claims[uid2].error, \
+                res2.claims[uid2].error
+            print("OK prepare on dead chip rejected")
+
+            # the pinned claim got a Warning Event (event-mode remediation)
+            def unhealthy_event():
+                return any(e["reason"] == "DeviceUnhealthy" and
+                           e["involvedObject"]["name"] == "c1"
+                           for e in srv.fake.list(EVENTS)["items"])
+            wait_until(unhealthy_event, what="DeviceUnhealthy event on c1")
+            print("OK DeviceUnhealthy Warning Event on pinned claim")
+
+            # metrics endpoint shows the state flip
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=5
+            ).read().decode()
+            assert ('tpu_dra_health_state{device="tpu-1",'
+                    'state="Unhealthy"} 1.0') in body
+            print("OK metrics endpoint shows tpu-1 Unhealthy")
+
+            # ---- recovery: the device node returns ----
+            (root / "dev" / "accel1").touch()
+            wait_until(lambda: "tpu-1" in slice_devices(),
+                       what="tpu-1 republished")
+            wait_until(lambda: healthz_code(mport) == 200,
+                       what="/healthz back to 200")
+            print("OK recovery: tpu-1 republished, /healthz 200")
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(5)
+    finally:
+        srv.stop()
+    print("DRIVE HEALTH: ALL OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,11 @@ from tpu_dra.cdi.validate import validate_spec, validate_spec_file
 
 from test_device_state import UID, make_claim, make_state, opaque
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 
 def _assert_valid_file(path):
     errs = validate_spec_file(path)

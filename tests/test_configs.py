@@ -24,6 +24,11 @@ from tpu_dra.api.configs import (
     TpuMultiProcessConfig,
 )
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 UUID_A = "tpu-aaaaaaaa-aaaa-aaaa-aaaa-aaaaaaaaaaaa"
 UUID_B = "tpu-bbbbbbbb-bbbb-bbbb-bbbb-bbbbbbbbbbbb"
 

@@ -22,6 +22,11 @@ from tpu_dra.k8s import (
     NotFound,
 )
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 NS = "team-a"
 
 

@@ -17,6 +17,11 @@ from tpu_dra.daemon.membership import MembershipManager
 from tpu_dra.daemon.process import ProcessManager
 from tpu_dra.k8s import FakeKube, TPU_SLICE_DOMAINS
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 NS = "team-a"
 FABRIC = "slice-uuid.0"
 

@@ -15,6 +15,11 @@ from tpu_dra.plugins.tpu.device_state import (
 from tpu_dra.tpulib import FakeTpuLib
 from tpu_dra.version import DRIVER_NAME
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 UID = "claim-uid-1"
 
 

@@ -13,6 +13,11 @@ from tpu_dra.k8s import (
     TPU_SLICE_DOMAINS,
 )
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 
 def make_pod(name, ns="default", labels=None, node=None):
     pod = {"apiVersion": "v1", "kind": "Pod",

@@ -7,6 +7,11 @@ import pytest
 
 from tpu_dra.util.flock import Flock, FlockTimeout, locked
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 
 def test_acquire_release(tmp_path):
     path = str(tmp_path / "pu.lock")

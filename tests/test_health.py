@@ -1,0 +1,698 @@
+"""Chip health monitoring & fault remediation (tpu_dra/health, ISSUE 2).
+
+Covers the subsystem bottom-up: the debounced per-device state machine,
+each pluggable probe source (with FakeTpuLib fault injection), the
+monitor's listener/metrics/healthz surface, the kubelet-plugin wiring
+(republish-minus-unhealthy, typed prepare rejection, both remediation
+modes), the launcher heartbeat shim, the serve /healthz verdict, the
+doctor CLI, and the in-process e2e acceptance path: injecting a chip
+fault drains the ResourceSlice, rejects prepares, flips the SliceDomain
+DevicesDegraded condition + Event, and shows up on the metrics endpoint
+— then recovery restores all of it.
+"""
+
+import dataclasses
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.health.monitor import HealthMonitor
+from tpu_dra.health.probes import (
+    DeviceNodeProbe,
+    EccProbe,
+    HeartbeatProbe,
+    LivenessProbe,
+    default_probes,
+)
+from tpu_dra.health.state import (
+    DeviceHealth,
+    HEALTHY,
+    RECOVERED,
+    SUSPECT,
+    UNHEALTHY,
+)
+from tpu_dra.k8s import EVENTS, FakeKube, RESOURCE_CLAIMS, RESOURCE_SLICES
+from tpu_dra.plugins.tpu.device_state import DeviceUnhealthyError, \
+    PrepareError
+from tpu_dra.plugins.tpu.driver import (
+    REMEDIATION_UNPREPARE,
+    TpuDriver,
+    TpuDriverConfig,
+)
+from tpu_dra.tpulib import FakeTpuLib
+from tpu_dra.util.metrics import Registry
+from tpu_dra.version import DRIVER_NAME
+
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -------------------------------------------------------------------------
+# State machine: debounce and flap behavior
+# -------------------------------------------------------------------------
+
+
+def machine(fail_threshold=3, pass_threshold=2):
+    dev = DeviceHealth(uuid="u-0", device="tpu-0")
+
+    def observe(healthy, detail=""):
+        return dev.observe(healthy, detail, fail_threshold, pass_threshold)
+
+    return dev, observe
+
+
+def test_single_fail_is_suspect_not_unhealthy():
+    dev, observe = machine()
+    t = observe(False, "probe blip")
+    assert dev.state == SUSPECT
+    assert (t.from_state, t.to_state) == (HEALTHY, SUSPECT)
+    assert dev.serving(), "Suspect chips keep serving (debounce window)"
+
+
+def test_fail_threshold_flips_unhealthy():
+    dev, observe = machine(fail_threshold=3)
+    observe(False)
+    assert observe(False) is None, "Suspect->Suspect is not an edge"
+    t = observe(False)
+    assert (t.from_state, t.to_state) == (SUSPECT, UNHEALTHY)
+    assert not dev.serving()
+    assert observe(False) is None, "Unhealthy stays Unhealthy"
+
+
+def test_flapping_probe_never_reaches_unhealthy():
+    """fail/pass alternation: a single clean poll clears suspicion, so a
+    flapping chip never drains the slice (the debounce contract)."""
+    dev, observe = machine(fail_threshold=2)
+    for _ in range(10):
+        observe(False)
+        assert dev.state == SUSPECT
+        observe(True)
+        assert dev.state == HEALTHY
+    assert dev.serving()
+
+
+def test_recovery_requires_pass_threshold():
+    dev, observe = machine(fail_threshold=1, pass_threshold=2)
+    t = observe(False)
+    assert (t.from_state, t.to_state) == (HEALTHY, UNHEALTHY), \
+        "fail_threshold=1 means no free debounce tick"
+    assert dev.state == UNHEALTHY
+    assert observe(True) is None, "one pass is not recovery"
+    assert dev.state == UNHEALTHY
+    t = observe(True)
+    assert (t.from_state, t.to_state) == (UNHEALTHY, RECOVERED)
+    assert dev.serving(), "Recovered chips serve again"
+    t = observe(True)
+    assert (t.from_state, t.to_state) == (RECOVERED, HEALTHY)
+
+
+def test_fail_during_recovery_goes_back_to_suspect():
+    dev, observe = machine(fail_threshold=2, pass_threshold=1)
+    observe(False)
+    observe(False)
+    assert dev.state == UNHEALTHY
+    observe(True)
+    assert dev.state == RECOVERED
+    t = observe(False)
+    assert (t.from_state, t.to_state) == (RECOVERED, SUSPECT)
+
+
+# -------------------------------------------------------------------------
+# Probe sources
+# -------------------------------------------------------------------------
+
+
+@pytest.fixture
+def chips():
+    return FakeTpuLib().enumerate_chips()
+
+
+def test_device_node_probe(tmp_path, chips):
+    node = tmp_path / "dev" / "accel0"
+    node.parent.mkdir()
+    node.write_bytes(b"")
+    chip = dataclasses.replace(chips[0], device_paths=["/dev/accel0"])
+    probe = DeviceNodeProbe(driver_root=str(tmp_path))
+    assert probe.check(chip).healthy
+    node.unlink()
+    res = probe.check(chip)
+    assert not res.healthy and "gone" in res.detail
+
+
+def test_liveness_probe_fault_injection(chips):
+    lib = FakeTpuLib()
+    probe = LivenessProbe(lib)
+    assert probe.check(chips[1]).healthy
+    lib.fail_chip(1)
+    res = probe.check(chips[1])
+    assert not res.healthy and "liveness" in res.detail
+    lib.recover_chip(1)
+    assert probe.check(chips[1]).healthy
+
+
+def test_liveness_probe_exception_is_failing_verdict(chips):
+    class ExplodingLib(FakeTpuLib):
+        def chip_alive(self, chip):
+            raise RuntimeError("libtpu wedged")
+
+    res = LivenessProbe(ExplodingLib()).check(chips[0])
+    assert not res.healthy and "libtpu wedged" in res.detail
+
+
+def test_heartbeat_probe(tmp_path, chips):
+    chip = chips[0]
+    (tmp_path / "claim-1").mkdir()
+    beat = tmp_path / "claim-1" / "beat"
+    beat.write_bytes(b"")
+    now = time.time()
+    clock = lambda: now  # noqa: E731 — injectable time source
+    pinned = {chip.uuid: ["claim-1"]}
+    probe = HeartbeatProbe(str(tmp_path), pinned_fn=lambda: pinned,
+                           stale_after=60.0, clock=clock)
+    assert probe.check(chip).healthy, "fresh heartbeat passes"
+    clock = lambda: now + 120  # noqa: E731
+    probe.clock = clock
+    res = probe.check(chip)
+    assert not res.healthy and "stale" in res.detail
+    # a claim with no heartbeat file passes: the shim is opt-in
+    pinned[chip.uuid] = ["claim-without-shim"]
+    assert probe.check(chip).healthy
+    # no claim mapping at all passes
+    assert HeartbeatProbe(str(tmp_path)).check(chip).healthy
+
+
+def test_ecc_probe_alarms_on_delta_not_absolute(chips):
+    lib = FakeTpuLib()
+    lib.ecc_errors[0] = 100           # historical count predating us
+    probe = EccProbe(lib, threshold=8)
+    assert probe.check(chips[0]).healthy, "baseline is not an alarm"
+    lib.ecc_errors[0] = 107
+    assert probe.check(chips[0]).healthy, "delta 7 < threshold 8"
+    lib.ecc_errors[0] = 108
+    res = probe.check(chips[0])
+    assert not res.healthy and "8 new" in res.detail
+    # the alarm re-baselines: once the errors stop, the chip can recover
+    # (a slow trickle must not drain it forever) — and a sustained storm
+    # keeps alarming
+    assert probe.check(chips[0]).healthy, "re-baselined after the alarm"
+    lib.ecc_errors[0] = 116
+    assert not probe.check(chips[0]).healthy, "storm keeps alarming"
+    # kernel counter reset (driver reload): re-baseline downward too, so
+    # new errors aren't masked until the count re-climbs the old baseline
+    lib.ecc_errors[0] = 0
+    assert probe.check(chips[0]).healthy
+    lib.ecc_errors[0] = 8
+    assert not probe.check(chips[0]).healthy, \
+        "errors after a counter reset must still alarm"
+
+
+def test_default_probe_set_composition():
+    lib = FakeTpuLib()
+    names = [p.name for p in default_probes(lib)]
+    assert names == ["tpu-liveness", "hbm-ecc"]
+    names = [p.name for p in default_probes(
+        lib, device_node_root="/", heartbeat_dir="/tmp/hb")]
+    assert names == ["device-node", "tpu-liveness", "workload-heartbeat",
+                     "hbm-ecc"]
+
+
+# -------------------------------------------------------------------------
+# Monitor: polling, listeners, metrics, healthz
+# -------------------------------------------------------------------------
+
+
+def test_monitor_poll_transitions_and_listener_fanout():
+    lib = FakeTpuLib()
+    reg = Registry()
+    mon = HealthMonitor(lib, fail_threshold=2, pass_threshold=1,
+                        registry=reg)
+    seen = []
+    mon.add_listener(lambda ts: (_ for _ in ()).throw(RuntimeError("boom")))
+    mon.add_listener(seen.extend)     # must still fire after the bad one
+    assert mon.poll_once() == [], "all healthy: no edges"
+    lib.fail_chip(2)
+    ts = mon.poll_once()
+    assert [(t.device, t.to_state) for t in ts] == [("tpu-2", SUSPECT)]
+    mon.poll_once()
+    assert mon.state_of(lib.enumerate_chips()[2].uuid) == UNHEALTHY
+    assert mon.unhealthy_names() == ["tpu-2"]
+    assert not mon.healthz()
+    assert [(t.device, t.to_state) for t in seen] == [
+        ("tpu-2", SUSPECT), ("tpu-2", UNHEALTHY)]
+    lib.recover_chip(2)
+    mon.poll_once()                   # pass_threshold=1 -> Recovered
+    assert mon.is_serving(lib.enumerate_chips()[2].uuid)
+    assert mon.healthz()
+
+
+def test_monitor_metrics_series():
+    lib = FakeTpuLib()
+    reg = Registry()
+    mon = HealthMonitor(lib, fail_threshold=1, registry=reg)
+    lib.fail_chip(0)
+    mon.poll_once()
+    body = reg.expose()
+    assert 'tpu_dra_health_state{device="tpu-0",state="Unhealthy"} 1.0' \
+        in body
+    assert 'tpu_dra_health_state{device="tpu-0",state="Healthy"} 0.0' \
+        in body
+    assert 'tpu_dra_health_state{device="tpu-1",state="Healthy"} 1.0' \
+        in body
+    assert 'tpu_dra_health_transitions_total{device="tpu-0",' \
+        'from="Healthy",to="Unhealthy"} 1.0' in body
+    assert "tpu_dra_health_probe_seconds" in body
+
+
+def test_monitor_unknown_uuid_serves():
+    mon = HealthMonitor(FakeTpuLib(), registry=Registry())
+    assert mon.is_serving("not-a-chip"), \
+        "the monitor only vetoes chips it tracks"
+    assert mon.state_of("not-a-chip") == "Unknown"
+
+
+def test_monitor_poll_loop_and_stop():
+    lib = FakeTpuLib()
+    mon = HealthMonitor(lib, fail_threshold=1, registry=Registry())
+    mon.start(interval=0.01)
+    lib.fail_chip(3)
+    assert wait_until(lambda: not mon.healthz())
+    mon.stop()
+    assert mon.healthz() is False, "verdict survives the stopped loop"
+
+
+# -------------------------------------------------------------------------
+# Kubelet plugin: republish-minus-unhealthy, prepare veto, remediation
+# -------------------------------------------------------------------------
+
+
+def make_driver(tmp_path, kube, lib, **overrides):
+    cfg = dict(
+        node_name="node-a", tpulib=lib, kube=kube,
+        plugins_dir=str(tmp_path / "plugins"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=2.0,
+        health_interval=0,           # poll manually: deterministic tests
+        health_fail_threshold=2, health_pass_threshold=1)
+    cfg.update(overrides)
+    return TpuDriver(TpuDriverConfig(**cfg))
+
+
+def make_claim(kube, uid="uid-c1", name="claim1", devices=("tpu-0",)):
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default", "uid": uid},
+        "spec": {},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME, "pool": "node-a",
+             "device": d} for d in devices]}}},
+    }
+    kube.create(RESOURCE_CLAIMS, claim)
+    stored = kube.get(RESOURCE_CLAIMS, name, "default")
+    stored["metadata"]["uid"] = uid
+    kube.update(RESOURCE_CLAIMS, stored)
+    return stored
+
+
+def slice_device_names(kube):
+    slices = kube.list(RESOURCE_SLICES)["items"]
+    assert len(slices) == 1
+    return [d["name"] for d in slices[0]["spec"]["devices"]]
+
+
+def test_republish_drops_unhealthy_chip_and_restores_it(tmp_path):
+    kube, lib = FakeKube(), FakeTpuLib()
+    drv = make_driver(tmp_path, kube, lib)
+    drv.start()
+    try:
+        assert "tpu-1" in slice_device_names(kube)
+        lib.fail_chip(1)
+        drv.health.poll_once()        # -> Suspect: still advertised
+        assert "tpu-1" in slice_device_names(kube), \
+            "a Suspect chip must not bounce the ResourceSlice"
+        drv.health.poll_once()        # -> Unhealthy: drained
+        names = slice_device_names(kube)
+        assert "tpu-1" not in names
+        assert {"tpu-0", "tpu-2", "tpu-3"} <= set(names)
+        lib.recover_chip(1)
+        drv.health.poll_once()        # pass_threshold=1 -> Recovered
+        assert "tpu-1" in slice_device_names(kube)
+    finally:
+        drv.stop()
+
+
+def test_prepare_rejected_on_unhealthy_chip_with_typed_error(tmp_path):
+    kube, lib = FakeKube(), FakeTpuLib()
+    drv = make_driver(tmp_path, kube, lib)
+    drv.start()
+    try:
+        lib.fail_chip(0)
+        drv.health.poll_once()
+        drv.health.poll_once()
+        claim = make_claim(kube, devices=("tpu-0",))
+        with pytest.raises(DeviceUnhealthyError, match="tpu-0"):
+            drv.state.prepare(claim)
+        assert issubclass(DeviceUnhealthyError, PrepareError)
+        assert drv.state.prepared_claims() == {}, \
+            "a vetoed prepare must leave no side effects"
+        # a claim on a healthy chip still prepares
+        ok = make_claim(kube, uid="uid-c2", name="claim2",
+                        devices=("tpu-2",))
+        drv.state.prepare(ok)
+        assert "uid-c2" in drv.state.prepared_claims()
+        # recovery lifts the veto
+        lib.recover_chip(0)
+        drv.health.poll_once()
+        drv.state.prepare(claim)
+        assert "uid-c1" in drv.state.prepared_claims()
+    finally:
+        drv.stop()
+
+
+def test_claim_edits_inject_heartbeat_env_and_mount(tmp_path):
+    """The prepare side of the heartbeat contract: the claim's CDI spec
+    bind-mounts the per-claim host heartbeat dir rw into the container
+    under the constant TPU_HEALTH_HEARTBEAT_DIR (same env value from
+    every claim, so multi-claim containers merge edits without one claim
+    clobbering another's key) — without the mount the heartbeat would
+    land in the container's own filesystem and the host-side
+    HeartbeatProbe would never see it."""
+    kube, lib = FakeKube(), FakeTpuLib()
+    drv = make_driver(tmp_path, kube, lib)
+    drv.start()
+    try:
+        drv.state.prepare(make_claim(kube))
+        specs = []
+        for root, _, files in os.walk(str(tmp_path / "cdi")):
+            specs += [json.load(open(os.path.join(root, f)))
+                      for f in files if f.endswith(".json")]
+        blob = json.dumps(specs)
+        assert "TPU_HEALTH_HEARTBEAT_DIR=/var/run/tpu-health" in blob
+        host_dir = os.path.join(drv.plugin_dir, "heartbeats", "uid-c1")
+        assert os.path.isdir(host_dir), "host side of the mount must exist"
+        mounts = [m for spec in specs
+                  for d in spec.get("devices", [])
+                  for m in d.get("containerEdits", {}).get("mounts", [])]
+        mine = [m for m in mounts
+                if m["containerPath"] == "/var/run/tpu-health/uid-c1"]
+        assert mine and mine[0]["hostPath"] == host_dir
+        assert "rw" in mine[0]["options"]
+        # unprepare removes the per-claim host dir (claim uids are
+        # unique — leftovers would accumulate for the node's lifetime)
+        drv.state.unprepare("uid-c1")
+        assert not os.path.exists(host_dir)
+    finally:
+        drv.stop()
+
+
+def test_remediation_event_mode_keeps_claim(tmp_path):
+    kube, lib = FakeKube(), FakeTpuLib()
+    drv = make_driver(tmp_path, kube, lib)   # default: event-only
+    drv.start()
+    try:
+        drv.state.prepare(make_claim(kube, devices=("tpu-0",)))
+        lib.fail_chip(0)
+        drv.health.poll_once()
+        drv.health.poll_once()
+        events = kube.list(EVENTS)["items"]
+        mine = [e for e in events if e["reason"] == "DeviceUnhealthy"]
+        assert len(mine) == 1
+        assert mine[0]["type"] == "Warning"
+        assert mine[0]["involvedObject"]["name"] == "claim1"
+        assert "tpu-0" in mine[0]["message"]
+        assert "uid-c1" in drv.state.prepared_claims(), \
+            "event mode must not touch the prepared claim"
+        assert kube.get(RESOURCE_CLAIMS, "claim1", "default")
+    finally:
+        drv.stop()
+
+
+def test_remediation_unprepare_mode_evicts_claim(tmp_path):
+    from tpu_dra.k8s import NotFound
+
+    kube, lib = FakeKube(), FakeTpuLib()
+    drv = make_driver(tmp_path, kube, lib,
+                      remediation=REMEDIATION_UNPREPARE)
+    drv.start()
+    try:
+        drv.state.prepare(make_claim(kube, devices=("tpu-1",)))
+        # an innocent claim on another chip must survive remediation
+        drv.state.prepare(make_claim(kube, uid="uid-c2", name="claim2",
+                                     devices=("tpu-3",)))
+        lib.fail_chip(1)
+        drv.health.poll_once()
+        drv.health.poll_once()
+        assert "uid-c1" not in drv.state.prepared_claims()
+        assert "uid-c2" in drv.state.prepared_claims()
+        with pytest.raises(NotFound):
+            kube.get(RESOURCE_CLAIMS, "claim1", "default")
+        assert kube.get(RESOURCE_CLAIMS, "claim2", "default")
+        events = [e["reason"] for e in kube.list(EVENTS)["items"]]
+        assert "DeviceUnhealthy" in events
+    finally:
+        drv.stop()
+
+
+def test_invalid_remediation_mode_rejected(tmp_path):
+    with pytest.raises(ValueError, match="remediation"):
+        make_driver(tmp_path, FakeKube(), FakeTpuLib(),
+                    remediation="reboot-the-universe")
+
+
+# -------------------------------------------------------------------------
+# Launcher heartbeat shim
+# -------------------------------------------------------------------------
+
+
+def test_launcher_heartbeat_touches_file(tmp_path):
+    from tpu_dra.workloads.launcher import (
+        start_health_heartbeat,
+        stop_health_heartbeat,
+    )
+
+    # the claim-edits contract: one mounted subdir per claim under the
+    # constant dir, each getting its own beat (multi-claim containers)
+    base = tmp_path / "hb"
+    for uid in ("claim-a", "claim-b"):
+        (base / uid).mkdir(parents=True)
+    try:
+        assert start_health_heartbeat(env={}, interval=0.01) is None, \
+            "no env var -> opt-out no-op"
+        got = start_health_heartbeat(
+            env={"TPU_HEALTH_HEARTBEAT_DIR": str(base)}, interval=0.01)
+        beats = [str(base / "claim-a" / "beat"),
+                 str(base / "claim-b" / "beat")]
+        assert got == beats
+        assert all(os.path.exists(p) for p in beats), \
+            "every mounted claim dir gets its own beat"
+        first = os.stat(beats[1]).st_mtime
+        assert wait_until(lambda: os.stat(beats[1]).st_mtime > first), \
+            "heartbeat must keep refreshing the mtime"
+        stop_health_heartbeat()
+        assert not any(os.path.exists(p) for p in beats), \
+            "a stopped workload must read as 'no heartbeat', not 'stale'"
+    finally:
+        stop_health_heartbeat()
+
+
+# -------------------------------------------------------------------------
+# serve.py /healthz: wedged engine -> 503
+# -------------------------------------------------------------------------
+
+
+class StubEngine:
+    def __init__(self, ok=True, detail="ok"):
+        self.verdict = (ok, detail)
+
+    def healthy(self, stale_after=120.0):
+        return self.verdict
+
+
+def _get_healthz(port):
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+        return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+@pytest.mark.parametrize("engine,health,want_code,want_body", [
+    (None, None, 200, "ok"),
+    (StubEngine(), None, 200, "ok"),
+    (StubEngine(False, "decode loop wedged: no heartbeat for 300s"),
+     None, 503, "wedged"),
+    (StubEngine(), lambda: (False, "chip tpu-0 Unhealthy"), 503,
+     "Unhealthy"),
+    (StubEngine(), lambda: False, 503, "unhealthy"),
+])
+def test_serve_healthz_verdicts(engine, health, want_code, want_body):
+    from http.server import ThreadingHTTPServer
+
+    from tpu_dra.workloads.serve import make_handler
+
+    srv = ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        make_handler(object(), engine=engine, metrics=None, health=health))
+    import threading
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        code, body = _get_healthz(srv.server_address[1])
+        assert code == want_code
+        assert want_body in body
+    finally:
+        srv.shutdown()
+
+
+# -------------------------------------------------------------------------
+# doctor CLI
+# -------------------------------------------------------------------------
+
+
+def test_doctor_fake_all_healthy(capsys):
+    from tpu_dra.tpulib.__main__ import doctor
+
+    assert doctor(["--fake"]) == 0
+    out = capsys.readouterr().out
+    assert "chips discovered: 4" in out
+    assert out.count("[HEALTHY]") == 4
+
+
+def test_doctor_fake_fault_injection(capsys):
+    from tpu_dra.tpulib.__main__ import doctor
+
+    assert doctor(["--fake", "--fail-chip", "1", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    by_name = {c["name"]: c for c in report["chips"]}
+    assert not by_name["tpu-1"]["healthy"]
+    assert by_name["tpu-0"]["healthy"]
+    failing = [r for r in by_name["tpu-1"]["probes"] if not r["healthy"]]
+    assert failing and failing[0]["probe"] == "tpu-liveness"
+
+
+def test_doctor_no_chips_exits_2(tmp_path, capsys):
+    from tpu_dra.tpulib.__main__ import doctor
+
+    assert doctor(["--driver-root", str(tmp_path)]) == 2
+    assert "no TPU chips found" in capsys.readouterr().out
+
+
+def test_doctor_unknown_subcommand(capsys):
+    from tpu_dra.tpulib.__main__ import main
+
+    assert main(["frobnicate"]) == 2
+    assert "doctor" in capsys.readouterr().err
+
+
+# -------------------------------------------------------------------------
+# In-process e2e: fault -> drain + veto + DevicesDegraded + metrics,
+# then recovery restores everything (ISSUE 2 acceptance)
+# -------------------------------------------------------------------------
+
+
+def test_e2e_chip_fault_drains_claim_and_degrades_domain(tmp_path):
+    from tpu_dra.api.types import CONDITION_DEVICES_DEGRADED, TpuSliceDomain
+    from tpu_dra.controller.controller import Controller, ControllerConfig
+    from tpu_dra.daemon.main import start_health_reporting
+    from tpu_dra.daemon.membership import MembershipManager
+    from tpu_dra.k8s import TPU_SLICE_DOMAINS
+    from tpu_dra.util.metrics import DEFAULT_REGISTRY, serve_http_endpoint
+
+    kube, lib = FakeKube(), FakeTpuLib()
+    ns = "team-a"
+    kube.create(TPU_SLICE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuSliceDomain",
+        "metadata": {"name": "dom", "namespace": ns},
+        "spec": {"numNodes": 1},
+    })
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    drv = make_driver(tmp_path, kube, lib)
+    drv.start()
+    membership = MembershipManager(kube, "dom", ns, "node-a", "10.0.0.10",
+                                   "slice-uuid.0", 0)
+    membership.start()
+    daemon_health = start_health_reporting(lib, membership, interval=0.02,
+                                           fail_threshold=2,
+                                           pass_threshold=1)
+    metrics_srv = serve_http_endpoint("127.0.0.1", 0,
+                                      registry=DEFAULT_REGISTRY)
+
+    def degraded_status():
+        dom = TpuSliceDomain.from_dict(
+            kube.get(TPU_SLICE_DOMAINS, "dom", ns))
+        cond = dom.status.condition(CONDITION_DEVICES_DEGRADED) \
+            if dom.status else None
+        return cond["status"] if cond else None
+
+    def scrape():
+        port = metrics_srv.server_address[1]
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+
+    try:
+        # ---- inject the fault ----
+        lib.fail_chip(1)
+        drv.health.poll_once()
+        drv.health.poll_once()        # fail_threshold=2 -> Unhealthy
+
+        # (a) the device is gone from the republished ResourceSlice
+        assert "tpu-1" not in slice_device_names(kube)
+        # (b) a prepare selecting it is rejected with the typed error
+        claim = make_claim(kube, devices=("tpu-1",))
+        with pytest.raises(DeviceUnhealthyError):
+            drv.state.prepare(claim)
+        # (c) the SliceDomain gets DevicesDegraded=True + a Warning Event
+        #     (daemon monitor loop -> membership -> controller)
+        assert wait_until(lambda: degraded_status() == "True"), \
+            "controller never set DevicesDegraded=True"
+        dom = TpuSliceDomain.from_dict(kube.get(TPU_SLICE_DOMAINS, "dom", ns))
+        cond = dom.status.condition(CONDITION_DEVICES_DEGRADED)
+        assert "node-a" in cond["message"] and "tpu-1" in cond["message"]
+        assert wait_until(lambda: any(
+            e["reason"] == "DevicesDegraded" and e["type"] == "Warning"
+            for e in kube.list(EVENTS)["items"]))
+        # (d) the transition is observable on the metrics endpoint
+        assert wait_until(lambda: (
+            'tpu_dra_health_state{device="tpu-1",state="Unhealthy"} 1.0'
+            in scrape()))
+        assert 'tpu_dra_health_transitions_total{device="tpu-1"' \
+            in scrape()
+
+        # ---- recovery restores everything ----
+        lib.recover_chip(1)
+        drv.health.poll_once()        # pass_threshold=1 -> Recovered
+        assert "tpu-1" in slice_device_names(kube)
+        drv.state.prepare(claim)
+        assert "uid-c1" in drv.state.prepared_claims()
+        assert wait_until(lambda: degraded_status() == "False"), \
+            "controller never cleared DevicesDegraded"
+        assert wait_until(lambda: any(
+            e["reason"] == "DevicesRecovered"
+            for e in kube.list(EVENTS)["items"]))
+        assert wait_until(lambda: (
+            'tpu_dra_health_state{device="tpu-1",state="Unhealthy"} 0.0'
+            in scrape()))
+    finally:
+        metrics_srv.shutdown()
+        daemon_health.stop()
+        membership.stop()
+        drv.stop()
+        ctrl.stop()
+        kube.close_watchers()

@@ -4,6 +4,12 @@ import time
 
 from tpu_dra.k8s import FakeKube, Informer, PODS, TPU_SLICE_DOMAINS
 from tpu_dra.k8s.informer import Store, label_index, uid_index
+import pytest
+
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
 
 
 def wait_until(pred, timeout=5.0):

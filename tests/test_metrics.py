@@ -13,6 +13,11 @@ from tpu_dra.util.metrics import (
     serve_http_endpoint,
 )
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 
 def test_counter_exposition():
     reg = Registry()

@@ -16,6 +16,11 @@ from tpu_dra.k8s import DAEMONSETS, FakeKube, NODES, TPU_SLICE_DOMAINS
 from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
 from tpu_dra.version import SLICE_DRIVER_NAME
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 NS = "team-a"
 FABRIC = "shared-slice.0"
 

@@ -525,3 +525,49 @@ def test_kubelet_plugin_grpc_path_race_free(tmp_path):
         drv.stop()
         racecheck.uninstall()
         racecheck.reset()
+
+
+def test_health_monitor_race_free():
+    """The chip HealthMonitor under the detector: the poll loop mutates
+    the per-device state machines (``_devices``, guarded by ``_mu``)
+    while reader threads (the driver's publish/prepare/healthz paths)
+    pull verdicts and fault injection flips chips underneath — zero
+    unordered conflicting accesses.  Static half: the guarded-by
+    checker's HOT_SPOTS names HealthMonitor (test_vet.py cross-wires
+    the two lists)."""
+    import time
+
+    racecheck.install()
+    from tpu_dra.health.monitor import HealthMonitor
+    from tpu_dra.tpulib import FakeTpuLib
+    from tpu_dra.util.metrics import Registry
+
+    racecheck.monitor(HealthMonitor)
+    try:
+        lib = FakeTpuLib()
+        mon = HealthMonitor(lib, fail_threshold=1, pass_threshold=1,
+                            registry=Registry())
+        # a listener that re-enters the monitor, like the driver's
+        # republish path does
+        mon.add_listener(lambda transitions: mon.unhealthy_uuids())
+        uuids = [c.uuid for c in lib.enumerate_chips()]
+        mon.start(interval=0.003)
+
+        def worker(i: int) -> None:
+            deadline = time.monotonic() + 0.5
+            while time.monotonic() < deadline:
+                if i % 2:
+                    lib.fail_chip(i % 4)
+                    mon.is_serving(uuids[i % len(uuids)])
+                    lib.recover_chip(i % 4)
+                else:
+                    mon.unhealthy_uuids()
+                    mon.snapshot()
+                    mon.healthz()
+
+        run_threads(4, worker)
+        mon.stop()
+        racecheck.assert_no_races()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()

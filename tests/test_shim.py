@@ -21,6 +21,11 @@ import pytest
 from tpu_dra.plugins.tpu import _shim_sitecustomize as shim
 from tpu_dra.plugins.tpu.shim import SHIM_CONTAINER_PATH, write_shim_dir
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

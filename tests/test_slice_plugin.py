@@ -20,6 +20,11 @@ from tpu_dra.k8s import (
 from tpu_dra.plugins.slice.driver import SliceDriver, SliceDriverConfig
 from tpu_dra.version import SLICE_DRIVER_NAME
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 NS = "team-a"
 NODE = "node-a"
 

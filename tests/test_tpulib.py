@@ -14,6 +14,11 @@ from tpu_dra.tpulib import native
 from tpu_dra.tpulib.discovery import parse_tpu_env_blob
 from tpu_dra.tpulib.topology import family_for_accelerator_type
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 
 # --- topology ---------------------------------------------------------------
 

@@ -17,6 +17,11 @@ from tpu_dra.util.fsutil import atomic_write
 from tpu_dra.util.rank import rank_sorted
 from tpu_dra.util.template import render
 
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
 
 # -- flags -----------------------------------------------------------------
 

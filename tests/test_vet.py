@@ -25,6 +25,12 @@ import sys
 from tpu_dra.analysis import all_analyzers, run_paths
 from tpu_dra.analysis.checkers import guardedby
 from tpu_dra.analysis.report import JSON_SCHEMA_VERSION
+import pytest
+
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

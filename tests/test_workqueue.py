@@ -10,6 +10,12 @@ from tpu_dra.util.workqueue import (
     RetryDeadlineExceeded,
     WorkQueue,
 )
+import pytest
+
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
 
 
 def make_queue():

@@ -31,6 +31,7 @@ HOT_SPOTS: dict[str, tuple[str, ...]] = {
     "tpu_dra/k8s/informer.py": ("Store",),
     "tpu_dra/daemon/membership.py": ("MembershipManager",),
     "tpu_dra/workloads/serve.py": ("DecoderPool",),
+    "tpu_dra/health/monitor.py": ("HealthMonitor",),
 }
 
 _GUARDED_RE = re.compile(r"#.*guarded by\s+self\.(\w+)")

@@ -23,6 +23,11 @@ from tpu_dra.version import API_GROUP, API_VERSION
 STATUS_READY = "Ready"
 STATUS_NOT_READY = "NotReady"
 
+# status.conditions[].type set by the controller when any member node
+# reports unhealthy devices (tpu_dra/health fan-in via the daemon's
+# MembershipManager)
+CONDITION_DEVICES_DEGRADED = "DevicesDegraded"
+
 KIND = "TpuSliceDomain"
 PLURAL = "tpuslicedomains"
 GROUP_VERSION = f"{API_GROUP}/{API_VERSION}"
@@ -76,33 +81,62 @@ class TpuSliceDomainNode:
     ip_address: str = ""
     fabric_id: str = ""
     worker_id: int = -1
+    # node-local chip health verdict (tpu_dra/health via the daemon's
+    # MembershipManager): the controller aggregates these into the
+    # DevicesDegraded condition.  Old readers ignore the extra keys.
+    devices_healthy: bool = True
+    unhealthy_devices: list[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, data: dict):
         return cls(name=data.get("name", ""),
                    ip_address=data.get("ipAddress", ""),
                    fabric_id=data.get("fabricID", ""),
-                   worker_id=int(data.get("workerID", -1)))
+                   worker_id=int(data.get("workerID", -1)),
+                   devices_healthy=bool(data.get("devicesHealthy", True)),
+                   unhealthy_devices=list(
+                       data.get("unhealthyDevices") or []))
 
     def to_dict(self) -> dict:
-        return {"name": self.name, "ipAddress": self.ip_address,
-                "fabricID": self.fabric_id, "workerID": self.worker_id}
+        out = {"name": self.name, "ipAddress": self.ip_address,
+               "fabricID": self.fabric_id, "workerID": self.worker_id}
+        if not self.devices_healthy:
+            out["devicesHealthy"] = False
+            out["unhealthyDevices"] = list(self.unhealthy_devices)
+        return out
 
 
 @dataclass
 class TpuSliceDomainStatus:
     status: str = STATUS_NOT_READY
     nodes: list[TpuSliceDomainNode] = field(default_factory=list)
+    # k8s-style condition dicts ({type, status, reason, message,
+    # lastTransitionTime}); kept raw so server-set fields round-trip
+    conditions: list[dict] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, data: dict):
         return cls(status=data.get("status", STATUS_NOT_READY),
                    nodes=[TpuSliceDomainNode.from_dict(n)
-                          for n in data.get("nodes") or []])
+                          for n in data.get("nodes") or []],
+                   conditions=[copy.deepcopy(c)
+                               for c in data.get("conditions") or []])
 
     def to_dict(self) -> dict:
-        return {"status": self.status,
-                "nodes": [n.to_dict() for n in self.nodes]}
+        out = {"status": self.status,
+               "nodes": [n.to_dict() for n in self.nodes]}
+        if self.conditions:
+            out["conditions"] = [copy.deepcopy(c) for c in self.conditions]
+        return out
+
+    def condition(self, cond_type: str) -> Optional[dict]:
+        return next((c for c in self.conditions
+                     if c.get("type") == cond_type), None)
+
+    def set_condition(self, cond: dict) -> None:
+        self.conditions = [c for c in self.conditions
+                           if c.get("type") != cond.get("type")]
+        self.conditions.append(cond)
 
 
 @dataclass

@@ -13,16 +13,19 @@ assertion raising so the workqueue retries until informers confirm removal
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
-from tpu_dra.api.types import TpuSliceDomain, TpuSliceDomainStatus, \
-    STATUS_NOT_READY
+from tpu_dra.api.types import CONDITION_DEVICES_DEGRADED, TpuSliceDomain, \
+    TpuSliceDomainStatus, STATUS_NOT_READY
 from tpu_dra.controller.constants import FINALIZER
 from tpu_dra.controller.daemonset import DaemonSetManager
 from tpu_dra.controller.node import NodeManager
 from tpu_dra.controller.resourceclaimtemplate import WorkloadRCTManager
 from tpu_dra.k8s.client import Conflict, KubeClient, NotFound, \
     TPU_SLICE_DOMAINS
+from tpu_dra.k8s.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, \
+    emit_event
 from tpu_dra.k8s.informer import Informer, uid_index
 from tpu_dra.util import klog
 from tpu_dra.util.workqueue import WorkQueue
@@ -100,6 +103,7 @@ class SliceDomainManager:
                          "workload RCT will be created",
                          domain=domain.name, namespace=domain.namespace)
         self._ensure_status(domain)
+        self._ensure_degraded_condition(domain)
 
     def _add_finalizer(self, domain: TpuSliceDomain) -> None:
         """computedomain.go:210-224."""
@@ -122,6 +126,72 @@ class SliceDomainManager:
             fresh.status = fresh.status or TpuSliceDomainStatus()
             fresh.status.status = STATUS_NOT_READY
             self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+
+    @staticmethod
+    def _degraded_verdict(status: TpuSliceDomainStatus
+                          ) -> tuple[str, str, str]:
+        """(status, reason, message) for the DevicesDegraded condition."""
+        degraded = {n.name: n.unhealthy_devices
+                    for n in status.nodes if not n.devices_healthy}
+        if degraded:
+            return ("True", "UnhealthyDevicesReported",
+                    "unhealthy devices reported by " + "; ".join(
+                        f"{node}: {', '.join(devs) or 'unspecified'}"
+                        for node, devs in sorted(degraded.items())))
+        return ("False", "AllDevicesHealthy",
+                "all member nodes report healthy devices")
+
+    def _up_to_date(self, status: Optional[TpuSliceDomainStatus]
+                    ) -> bool:
+        if status is None:
+            return False
+        want, _, message = self._degraded_verdict(status)
+        prev = status.condition(CONDITION_DEVICES_DEGRADED)
+        return prev is not None and prev.get("status") == want and \
+            prev.get("message") == message
+
+    def _ensure_degraded_condition(self, domain: TpuSliceDomain) -> None:
+        """Aggregate the per-node chip-health verdicts the daemons publish
+        into ``status.nodes`` (tpu_dra/health fan-in) into one
+        ``DevicesDegraded`` condition, and emit an Event on each
+        transition.  A status-write Conflict raises → workqueue retry."""
+        # cheap no-op check against the informer copy first: steady-state
+        # resyncs must not cost an extra API GET per reconcile
+        if self._up_to_date(domain.status):
+            return
+        fresh = TpuSliceDomain.from_dict(
+            self.kube.get(TPU_SLICE_DOMAINS, domain.name, domain.namespace))
+        if fresh.status is None:
+            fresh.status = TpuSliceDomainStatus()
+        if self._up_to_date(fresh.status):
+            return      # the informer copy was stale; nothing to write
+        want, reason, message = self._degraded_verdict(fresh.status)
+        prev = fresh.status.condition(CONDITION_DEVICES_DEGRADED)
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        fresh.status.set_condition({
+            "type": CONDITION_DEVICES_DEGRADED,
+            "status": want,
+            "reason": reason,
+            "message": message,
+            # condition contract: lastTransitionTime moves only when the
+            # status flips, never on message-only refinements — condition
+            # age ("degraded for X minutes") must survive them
+            "lastTransitionTime": (
+                prev.get("lastTransitionTime", now)
+                if prev is not None and prev.get("status") == want
+                else now),
+        })
+        self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+        # Events only on real edges (not on first-write of a clean False)
+        if want == "True":
+            emit_event(self.kube, fresh.to_dict(), "DevicesDegraded",
+                       message, EVENT_TYPE_WARNING)
+            klog.warning("slice domain devices degraded",
+                         domain=domain.name, detail=message)
+        elif prev is not None and prev.get("status") == "True":
+            emit_event(self.kube, fresh.to_dict(), "DevicesRecovered",
+                       message, EVENT_TYPE_NORMAL)
+            klog.info("slice domain devices recovered", domain=domain.name)
 
     def _teardown(self, domain: TpuSliceDomain) -> None:
         """Strict deletion order (computedomain.go:234-268).  Any failed

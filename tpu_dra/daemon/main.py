@@ -26,10 +26,30 @@ import urllib.request
 from tpu_dra.api.types import TpuSliceDomainNode
 from tpu_dra.daemon.membership import MembershipManager
 from tpu_dra.daemon.process import ProcessManager
+from tpu_dra.health.monitor import HealthMonitor
 from tpu_dra.k8s.client import new_clients
 from tpu_dra.tpulib.discovery import RealTpuLib
 from tpu_dra.util import klog
 from tpu_dra.util.fsutil import atomic_write
+
+
+def start_health_reporting(tpulib, membership: MembershipManager,
+                           interval: float, fail_threshold: int = 3,
+                           pass_threshold: int = 2) -> HealthMonitor:
+    """Wire a chip HealthMonitor into the membership manager (ISSUE 2):
+    every transition re-derives this node's verdict and publishes it into
+    ``TpuSliceDomain.status.nodes``, from which the controller sets the
+    ``DevicesDegraded`` condition.  Returns the (started) monitor."""
+    monitor = HealthMonitor(tpulib, fail_threshold=fail_threshold,
+                            pass_threshold=pass_threshold)
+
+    def on_transitions(_transitions) -> None:
+        names = monitor.unhealthy_names()
+        membership.set_device_health(not names, names)
+
+    monitor.add_listener(on_transitions)
+    monitor.start(interval=interval)
+    return monitor
 
 
 def _split_fabric(fabric: str) -> tuple[str, int]:
@@ -225,6 +245,11 @@ def run(argv=None) -> int:
                 klog.error("coordination update failed", error=str(exc))
 
     membership.start()
+    health = start_health_reporting(
+        tpulib, membership,
+        interval=float(env.get("HEALTH_INTERVAL", "10")),
+        fail_threshold=int(env.get("HEALTH_FAIL_THRESHOLD", "3")),
+        pass_threshold=int(env.get("HEALTH_PASS_THRESHOLD", "2")))
     coordservice.start_watchdog()
     updater = threading.Thread(target=update_loop, daemon=True,
                                name="coord-update-loop")
@@ -232,6 +257,7 @@ def run(argv=None) -> int:
     klog.info("slice-domain-daemon running", node=node_name,
               domain=domain_uid, fabric=fabric)
     stop.wait()
+    health.stop()
     coordservice.stop_watchdog()
     coordservice.stop()
     membership.stop()

@@ -58,6 +58,32 @@ class MembershipManager:
         """The rendezvous channel (GetNodesUpdateChan analog)."""
         return self._updates
 
+    # -- node health reporting (tpu_dra/health fan-in, ISSUE 2) ------------
+    def set_device_health(self, healthy: bool,
+                          unhealthy_devices: list[str] = ()) -> None:
+        """Record this node's chip-health verdict and push it into
+        ``TpuSliceDomain.status.nodes`` — the controller aggregates the
+        per-node verdicts into the ``DevicesDegraded`` condition.  Called
+        from the HealthMonitor's listener thread; ``self_node`` is
+        replaced wholesale so informer-thread readers see a consistent
+        record."""
+        devices = sorted(unhealthy_devices)
+        cur = self.self_node
+        if cur.devices_healthy == healthy and \
+                cur.unhealthy_devices == devices:
+            return
+        self.self_node = TpuSliceDomainNode(
+            name=cur.name, ip_address=cur.ip_address,
+            fabric_id=cur.fabric_id, worker_id=cur.worker_id,
+            devices_healthy=healthy, unhealthy_devices=devices)
+        if healthy:
+            klog.info("node device health recovered", node=cur.name,
+                      level=2)
+        else:
+            klog.warning("reporting node device health to domain status",
+                         node=cur.name, unhealthy=devices)
+        self.update_own_node_info()
+
     # -- status writes (computedomain.go:145-193) --------------------------
     def update_own_node_info(self, retries: int = 5) -> None:
         for _ in range(retries):
@@ -91,7 +117,10 @@ class MembershipManager:
         # (computedomain.go:177-180)
         mine = next((n for n in (domain.status.nodes if domain.status else [])
                      if n.name == self.self_node.name), None)
-        if mine is None or mine.ip_address != self.self_node.ip_address:
+        if mine is None or \
+                mine.ip_address != self.self_node.ip_address or \
+                mine.devices_healthy != self.self_node.devices_healthy or \
+                mine.unhealthy_devices != self.self_node.unhealthy_devices:
             self.update_own_node_info()
             return
         self.maybe_push_nodes_update(domain)

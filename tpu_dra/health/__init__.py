@@ -1,0 +1,40 @@
+"""Chip health monitoring & fault remediation (ISSUE 2 tentpole).
+
+A node-level subsystem the reference driver lacks entirely: per-chip
+``Healthy → Suspect → Unhealthy → Recovered`` state machines
+(:mod:`tpu_dra.health.state`) fed by pluggable probes
+(:mod:`tpu_dra.health.probes`) and driven by
+:class:`~tpu_dra.health.monitor.HealthMonitor`.  Consumers:
+
+- the TPU kubelet plugin republishes ResourceSlices minus Unhealthy
+  chips, rejects prepares that select them, and remediates pinned claims
+  (``tpu_dra/plugins/tpu/driver.py``);
+- the slice daemon reports node health into ``TpuSliceDomain.status``
+  (``tpu_dra/daemon/main.py`` + ``membership.py``), from which the
+  controller sets the ``DevicesDegraded`` condition and emits Events;
+- ``python -m tpu_dra.tpulib doctor`` runs the probes one-shot against
+  the real host.
+
+See ``docs/health-monitoring.md``.
+"""
+
+from tpu_dra.health.monitor import HealthMonitor  # noqa: F401
+from tpu_dra.health.probes import (  # noqa: F401
+    DeviceNodeProbe,
+    EccProbe,
+    HealthProbe,
+    HeartbeatProbe,
+    LivenessProbe,
+    default_probes,
+)
+from tpu_dra.health.state import (  # noqa: F401
+    ALL_STATES,
+    HEALTHY,
+    RECOVERED,
+    SERVING_STATES,
+    SUSPECT,
+    UNHEALTHY,
+    DeviceHealth,
+    ProbeResult,
+    Transition,
+)

@@ -1,0 +1,233 @@
+"""Node-level chip health monitor.
+
+Owns one :class:`~tpu_dra.health.state.DeviceHealth` state machine per
+discovered chip, polls the probe sources
+(:mod:`tpu_dra.health.probes`), and fans transitions out to listeners —
+the TPU kubelet plugin (republish ResourceSlices minus Unhealthy chips,
+reject prepares, remediate pinned claims) and the slice daemon's
+membership manager (report node health into ``TpuSliceDomain.status``).
+
+Exported metrics (``tpu_dra/util/metrics.py`` registry, same exposition
+endpoint as the plugin processes'):
+
+- ``tpu_dra_health_state{device,state}``            — 1 for the current
+  state, 0 for the other three (per chip)
+- ``tpu_dra_health_probe_seconds{probe}``           — probe latency
+- ``tpu_dra_health_transitions_total{device,from,to}`` — edges taken
+
+Thread model: probes run outside the lock (they do I/O); the state maps
+are mutated only under ``self._mu``.  Listeners are invoked after the
+lock is released so they may call back into the monitor freely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from tpu_dra.health.probes import HealthProbe, default_probes
+from tpu_dra.health.state import (
+    ALL_STATES,
+    DeviceHealth,
+    ProbeResult,
+    Transition,
+    UNHEALTHY,
+)
+from tpu_dra.tpulib.discovery import ChipInfo, TpuLib
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY, Registry
+
+
+class HealthMonitor:
+    """Debounced per-chip health tracking over pluggable probes."""
+
+    def __init__(self, tpulib: TpuLib,
+                 chips: Optional[Iterable[ChipInfo]] = None,
+                 probes: Optional[Iterable[HealthProbe]] = None,
+                 fail_threshold: int = 3, pass_threshold: int = 2,
+                 registry: Optional[Registry] = None) -> None:
+        self.tpulib = tpulib
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.pass_threshold = max(1, int(pass_threshold))
+        self._chips: list[ChipInfo] = list(
+            chips if chips is not None else tpulib.enumerate_chips())
+        self._probes: list[HealthProbe] = list(
+            probes if probes is not None else default_probes(tpulib))
+        self._mu = threading.Lock()
+        # uuid -> state machine            # guarded by self._mu
+        self._devices: dict[str, DeviceHealth] = {
+            c.uuid: DeviceHealth(uuid=c.uuid, device=c.canonical_name())
+            for c in self._chips}
+        # transition callbacks             # guarded by self._mu
+        self._listeners: list[Callable[[list[Transition]], None]] = []
+        # every-poll callbacks             # guarded by self._mu
+        self._poll_listeners: list[Callable[[], None]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        reg = registry or DEFAULT_REGISTRY
+        self._state_gauge = reg.gauge(
+            "tpu_dra_health_state",
+            "chip health state (1 = current state)", ("device", "state"))
+        self._probe_seconds = reg.histogram(
+            "tpu_dra_health_probe_seconds", "health probe latency",
+            labels=("probe",))
+        self._transitions_total = reg.counter(
+            "tpu_dra_health_transitions_total",
+            "health state machine edges taken", ("device", "from", "to"))
+        self._publish_states(
+            {c.canonical_name(): "Healthy" for c in self._chips})
+
+    # -- wiring ------------------------------------------------------------
+    def add_listener(self, cb: Callable[[list[Transition]], None]) -> None:
+        """Register a transition callback; invoked once per poll that took
+        at least one edge, outside the monitor lock."""
+        with self._mu:
+            self._listeners.append(cb)
+
+    def add_poll_listener(self, cb: Callable[[], None]) -> None:
+        """Register a callback invoked after EVERY poll (edges or not),
+        outside the monitor lock — the self-healing hook for consumers
+        whose reaction to an edge can fail transiently (e.g. the driver's
+        ResourceSlice republish): they re-check desired-vs-actual each
+        tick instead of waiting for another edge that may never come."""
+        with self._mu:
+            self._poll_listeners.append(cb)
+
+    # -- polling -----------------------------------------------------------
+    def poll_once(self) -> list[Transition]:
+        """Run every probe against every chip, advance the state machines,
+        publish metrics, and fan transitions out to listeners."""
+        verdicts: dict[str, tuple[bool, str, list[ProbeResult]]] = {}
+        for chip in self._chips:
+            results: list[ProbeResult] = []
+            for probe in self._probes:
+                t0 = time.monotonic()
+                try:
+                    res = probe.check(chip)
+                except Exception as exc:  # noqa: BLE001 — a probe bug must
+                    # degrade to a failing verdict, never kill the monitor
+                    res = ProbeResult(probe=probe.name, healthy=False,
+                                      detail=f"probe raised: {exc!r}")
+                self._probe_seconds.observe(time.monotonic() - t0,
+                                            probe.name)
+                results.append(res)
+            first_bad = next((r for r in results if not r.healthy), None)
+            verdicts[chip.uuid] = (
+                first_bad is None,
+                first_bad.detail if first_bad is not None
+                else "all probes passed",
+                results)
+        transitions: list[Transition] = []
+        with self._mu:
+            for uuid, (healthy, detail, results) in verdicts.items():
+                dev = self._devices.get(uuid)
+                if dev is None:
+                    continue
+                dev.probe_results = results
+                t = dev.observe(healthy, detail, self.fail_threshold,
+                                self.pass_threshold)
+                if t is not None:
+                    transitions.append(t)
+            states = {d.device: d.state for d in self._devices.values()}
+            listeners = list(self._listeners)
+            poll_listeners = list(self._poll_listeners)
+        self._publish_states(states)
+        for t in transitions:
+            self._transitions_total.inc(t.device, t.from_state, t.to_state)
+            klog.info("chip health transition", device=t.device,
+                      from_state=t.from_state, to_state=t.to_state,
+                      detail=t.detail)
+        if transitions:
+            for cb in listeners:
+                try:
+                    cb(list(transitions))
+                except Exception as exc:  # noqa: BLE001 — one listener's
+                    # bug must not starve the others of the transition
+                    klog.error("health listener failed", err=repr(exc))
+        for cb in poll_listeners:
+            try:
+                cb()
+            except Exception as exc:  # noqa: BLE001 — one listener's bug
+                # must not starve the others of the tick
+                klog.error("health poll listener failed", err=repr(exc))
+        return transitions
+
+    def _publish_states(self, states: dict[str, str]) -> None:
+        for device, current in states.items():
+            for s in ALL_STATES:
+                self._state_gauge.set(1.0 if s == current else 0.0,
+                                      device, s)
+
+    # -- background loop ---------------------------------------------------
+    def start(self, interval: float = 10.0) -> None:
+        """Poll every ``interval`` seconds on a daemon thread (no-op when
+        already started or when interval <= 0)."""
+        if self._thread is not None or interval <= 0:
+            return
+        self._stop_evt.clear()
+
+        def loop() -> None:
+            while not self._stop_evt.wait(interval):
+                try:
+                    self.poll_once()
+                except Exception as exc:  # noqa: BLE001 — the loop must
+                    # survive any single poll failure
+                    klog.error("health poll failed", err=repr(exc))
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="chip-health-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- queries -----------------------------------------------------------
+    def state_of(self, uuid: str) -> str:
+        with self._mu:
+            dev = self._devices.get(uuid)
+            return dev.state if dev is not None else "Unknown"
+
+    def is_serving(self, uuid: str) -> bool:
+        """True unless the chip is Unhealthy (Suspect/Recovered still
+        serve — the debounce contract).  Unknown uuids serve: the monitor
+        only vetoes chips it actually tracks."""
+        with self._mu:
+            dev = self._devices.get(uuid)
+            return dev.serving() if dev is not None else True
+
+    def unhealthy_uuids(self) -> set[str]:
+        with self._mu:
+            return {u for u, d in self._devices.items()
+                    if d.state == UNHEALTHY}
+
+    def unhealthy_names(self) -> list[str]:
+        with self._mu:
+            return sorted(d.device for d in self._devices.values()
+                          if d.state == UNHEALTHY)
+
+    def snapshot(self) -> list[dict]:
+        """Per-device view for the doctor CLI and debug endpoints."""
+        with self._mu:
+            return [
+                {"device": d.device, "uuid": d.uuid, "state": d.state,
+                 "fails": d.fails, "passes": d.passes,
+                 "detail": d.last_detail,
+                 "probes": [{"probe": r.probe, "healthy": r.healthy,
+                             "detail": r.detail}
+                            for r in d.probe_results]}
+                for d in sorted(self._devices.values(),
+                                key=lambda d: d.device)]
+
+    def healthz(self) -> bool:
+        """Aggregated node verdict for the /healthz endpoint: no chip
+        Unhealthy, and the poll loop (when started) still running."""
+        thread = self._thread
+        if thread is not None and not thread.is_alive():
+            return False
+        with self._mu:
+            return all(d.state != UNHEALTHY
+                       for d in self._devices.values())

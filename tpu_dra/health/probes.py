@@ -1,0 +1,188 @@
+"""Pluggable chip-health probe sources.
+
+Each probe answers one question about one chip per poll tick.  Probes are
+called only from the monitor's poll path (one thread), so they may keep
+poll-thread-confined state (the ECC baseline) without locking.  A probe
+must never raise out of ``check`` — an unexpected error is itself a
+failing verdict, never a monitor crash.
+
+Sources (ISSUE 2 tentpole):
+
+- :class:`DeviceNodeProbe`   — the chip's ``/dev/accel*`` nodes still exist
+  (a vanished node means the kernel driver dropped the device).
+- :class:`LivenessProbe`     — libtpu-level liveness through the
+  :class:`~tpu_dra.tpulib.discovery.TpuLib` seam (``chip_alive``), so
+  ``FakeTpuLib`` fault injection drives every test path.
+- :class:`HeartbeatProbe`    — workload heartbeat files written by the
+  launcher shim (``tpu_dra/workloads/launcher.py``
+  ``start_health_heartbeat``): a claim pinned to the chip whose heartbeat
+  went stale means the workload wedged on that chip.
+- :class:`EccProbe`          — HBM/ECC error counters via
+  ``TpuLib.ecc_error_count`` (sysfs on real hosts, injectable on fakes);
+  fails on the error *delta* since the current baseline (first
+  observation, re-baselined on every alarm) so historical counts don't
+  condemn a freshly-restarted node and a slow trickle can't drain a
+  chip forever.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Iterable, Mapping, Optional
+
+from tpu_dra.health.state import ProbeResult
+from tpu_dra.tpulib.discovery import ChipInfo, TpuLib, resolve_under_root
+
+
+class HealthProbe:
+    """Base class: ``check(chip)`` returns a :class:`ProbeResult`."""
+
+    name = "probe"
+
+    def check(self, chip: ChipInfo) -> ProbeResult:
+        raise NotImplementedError
+
+    def ok(self, detail: str = "") -> ProbeResult:
+        return ProbeResult(probe=self.name, healthy=True, detail=detail)
+
+    def fail(self, detail: str) -> ProbeResult:
+        return ProbeResult(probe=self.name, healthy=False, detail=detail)
+
+
+class DeviceNodeProbe(HealthProbe):
+    """The chip's character devices are still present under driver_root."""
+
+    name = "device-node"
+
+    def __init__(self, driver_root: str = "/") -> None:
+        self.driver_root = driver_root
+
+    def check(self, chip: ChipInfo) -> ProbeResult:
+        for path in chip.device_paths:
+            resolved = resolve_under_root(self.driver_root, path)
+            if not os.path.exists(resolved):
+                return self.fail(f"device node {resolved} is gone")
+        return self.ok()
+
+
+class LivenessProbe(HealthProbe):
+    """libtpu-level liveness through the TpuLib seam (``chip_alive``)."""
+
+    name = "tpu-liveness"
+
+    def __init__(self, tpulib: TpuLib) -> None:
+        self.tpulib = tpulib
+
+    def check(self, chip: ChipInfo) -> ProbeResult:
+        try:
+            alive = self.tpulib.chip_alive(chip)
+        except Exception as exc:  # noqa: BLE001 — a probe crash IS a verdict
+            return self.fail(f"liveness probe raised: {exc!r}")
+        if not alive:
+            return self.fail(f"chip {chip.index} failed libtpu liveness")
+        return self.ok()
+
+
+class HeartbeatProbe(HealthProbe):
+    """Workload heartbeat files: a claim pinned to this chip whose
+    heartbeat file exists but stopped updating means the workload wedged
+    on the chip.  A missing file passes — not every workload opts into the
+    launcher shim."""
+
+    name = "workload-heartbeat"
+
+    def __init__(self, heartbeat_dir: str,
+                 pinned_fn: Optional[Callable[
+                     [], Mapping[str, Iterable[str]]]] = None,
+                 stale_after: float = 600.0,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.heartbeat_dir = heartbeat_dir
+        self.pinned_fn = pinned_fn
+        self.stale_after = stale_after
+        self.clock = clock
+
+    def check(self, chip: ChipInfo) -> ProbeResult:
+        if self.pinned_fn is None:
+            return self.ok("no claim mapping")
+        try:
+            pinned = self.pinned_fn().get(chip.uuid, ())
+        except Exception as exc:  # noqa: BLE001 — a probe crash IS a verdict
+            return self.fail(f"claim lookup raised: {exc!r}")
+        for claim_uid in pinned:
+            # host view of the per-claim rw bind mount the claim edits
+            # set up (device_state.py _claim_edits): <dir>/<uid>/beat
+            path = os.path.join(self.heartbeat_dir, claim_uid, "beat")
+            try:
+                age = self.clock() - os.stat(path).st_mtime
+            except OSError:
+                continue   # no heartbeat file: workload doesn't use the shim
+            if age > self.stale_after:
+                return self.fail(
+                    f"claim {claim_uid} heartbeat stale for {age:.0f}s "
+                    f"(limit {self.stale_after:.0f}s)")
+        return self.ok()
+
+
+class EccProbe(HealthProbe):
+    """HBM/ECC error counters.  Fails when the count grew by at least
+    ``threshold`` since the current baseline — initially the first
+    observation (a node restarting with a historical count starts
+    clean), then re-baselined on every alarm.  Re-baselining keeps the
+    Unhealthy→Recovered path reachable: only a *sustained* error storm
+    (≥ threshold new errors per poll interval, poll after poll) holds a
+    chip Unhealthy, while a slow benign trickle accumulated over weeks
+    fires one Suspect-inducing alarm at most and can never permanently
+    drain the chip."""
+
+    name = "hbm-ecc"
+
+    def __init__(self, tpulib: TpuLib, threshold: int = 8) -> None:
+        self.tpulib = tpulib
+        self.threshold = threshold
+        # poll-thread-confined (see module docstring): uuid -> baseline
+        self._baseline: dict[str, int] = {}
+
+    def check(self, chip: ChipInfo) -> ProbeResult:
+        try:
+            count = int(self.tpulib.ecc_error_count(chip))
+        except Exception as exc:  # noqa: BLE001 — a probe crash IS a verdict
+            return self.fail(f"ecc counter read raised: {exc!r}")
+        base = self._baseline.setdefault(chip.uuid, count)
+        if count < base:
+            # the kernel counter reset under us (driver reload/rescan):
+            # re-baseline or real new errors would hide until the count
+            # climbed back past the stale baseline
+            base = self._baseline[chip.uuid] = count
+        delta = count - base
+        if delta >= self.threshold:
+            self._baseline[chip.uuid] = count
+            return self.fail(
+                f"{delta} new HBM/ECC errors since baseline {base} "
+                f"(threshold {self.threshold})")
+        return self.ok(f"{delta} new errors")
+
+
+def default_probes(tpulib: TpuLib,
+                   device_node_root: Optional[str] = None,
+                   heartbeat_dir: str = "",
+                   pinned_fn: Optional[Callable[
+                       [], Mapping[str, Iterable[str]]]] = None,
+                   heartbeat_stale_after: float = 600.0,
+                   ecc_threshold: int = 8) -> list[HealthProbe]:
+    """The standard probe set, in check order (cheapest first).
+
+    ``device_node_root`` enables the raw filesystem DeviceNodeProbe and
+    is only meaningful against a real host (the doctor CLI, RealTpuLib
+    deployments); fakes rely on :class:`LivenessProbe`, whose RealTpuLib
+    implementation already covers node presence under driver_root.
+    """
+    probes: list[HealthProbe] = []
+    if device_node_root is not None:
+        probes.append(DeviceNodeProbe(driver_root=device_node_root))
+    probes.append(LivenessProbe(tpulib))
+    if heartbeat_dir:
+        probes.append(HeartbeatProbe(heartbeat_dir, pinned_fn=pinned_fn,
+                                     stale_after=heartbeat_stale_after))
+    probes.append(EccProbe(tpulib, threshold=ecc_threshold))
+    return probes

@@ -1,0 +1,121 @@
+"""Per-device health state machine types.
+
+The reference driver has no node-side health machinery at all — once a GPU
+is enumerated via NVML it stays advertised forever, and the NVIDIA stack
+pushes health checking into the device plugin's NVML event loop.  This
+package closes that gap for TPU: a debounced per-chip state machine fed by
+pluggable probes (``tpu_dra/health/probes.py``), driven by the monitor
+(``tpu_dra/health/monitor.py``).
+
+States::
+
+              probe fail                 fails >= fail_threshold
+    Healthy ─────────────▶ Suspect ────────────────────────────▶ Unhealthy
+       ▲                      │  probe pass                         │
+       │◀─────────────────────┘  (debounce resets)                  │
+       │                                                            │
+       │        probe pass             passes >= pass_threshold     │
+       └──────────────  Recovered ◀─────────────────────────────────┘
+                           │  probe fail
+                           └──────▶ Suspect
+
+Debounce is asymmetric by design: a single failed probe only makes a chip
+*Suspect* (it keeps serving — the ResourceSlice is not touched), and only
+``fail_threshold`` consecutive failures flip it to *Unhealthy* (drained from
+the slice, prepares rejected).  Coming back requires ``pass_threshold``
+consecutive passes through *Recovered* — so a flapping chip cannot bounce
+the published ResourceSlice once per probe tick.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+HEALTHY = "Healthy"
+SUSPECT = "Suspect"
+UNHEALTHY = "Unhealthy"
+RECOVERED = "Recovered"
+
+ALL_STATES = (HEALTHY, SUSPECT, UNHEALTHY, RECOVERED)
+
+# states in which a chip keeps serving traffic (stays in the ResourceSlice,
+# prepares are accepted): everything but Unhealthy — Suspect is the
+# debounce window, Recovered the confirmation window
+SERVING_STATES = (HEALTHY, SUSPECT, RECOVERED)
+
+
+@dataclass
+class ProbeResult:
+    """One probe's verdict for one chip."""
+
+    probe: str
+    healthy: bool
+    detail: str = ""
+
+
+@dataclass
+class Transition:
+    """One state-machine edge taken by one device during a poll."""
+
+    uuid: str
+    device: str           # canonical device name, e.g. "tpu-2"
+    from_state: str
+    to_state: str
+    detail: str = ""
+
+
+@dataclass
+class DeviceHealth:
+    """Mutable per-device record.  NOT thread-safe on its own — the
+    monitor serializes all access under its lock."""
+
+    uuid: str
+    device: str
+    state: str = HEALTHY
+    fails: int = 0            # consecutive failed polls
+    passes: int = 0           # consecutive passing polls (post-Unhealthy)
+    last_detail: str = ""
+    probe_results: list[ProbeResult] = field(default_factory=list)
+
+    def observe(self, healthy: bool, detail: str,
+                fail_threshold: int, pass_threshold: int
+                ) -> Optional[Transition]:
+        """Advance the state machine by one poll verdict; returns the
+        Transition taken, or None when the state did not change."""
+        prev = self.state
+        self.last_detail = detail
+        if healthy:
+            self.fails = 0
+            if self.state == UNHEALTHY:
+                self.passes += 1
+                if self.passes >= pass_threshold:
+                    self.state = RECOVERED
+            elif self.state == RECOVERED:
+                self.state = HEALTHY
+                self.passes = 0
+            elif self.state == SUSPECT:
+                # a single clean poll clears suspicion — debounce is on
+                # the fail side only
+                self.state = HEALTHY
+        else:
+            self.passes = 0
+            if self.state in (HEALTHY, RECOVERED):
+                self.state = SUSPECT
+                self.fails = 1
+            elif self.state == SUSPECT:
+                self.fails += 1
+            # the threshold applies from Suspect regardless of how we got
+            # there — with fail_threshold=1 a single fail goes straight
+            # through (no free debounce tick)
+            if self.state == SUSPECT and self.fails >= fail_threshold:
+                self.state = UNHEALTHY
+            # UNHEALTHY stays UNHEALTHY
+        if self.state == prev:
+            return None
+        return Transition(uuid=self.uuid, device=self.device,
+                          from_state=prev, to_state=self.state,
+                          detail=detail)
+
+    def serving(self) -> bool:
+        return self.state in SERVING_STATES

@@ -17,6 +17,7 @@ from tpu_dra.k8s.client import (  # noqa: F401
     RestKubeClient,
     DAEMONSETS,
     DEPLOYMENTS,
+    EVENTS,
     NODES,
     PODS,
     RESOURCE_CLAIMS,
@@ -24,5 +25,6 @@ from tpu_dra.k8s.client import (  # noqa: F401
     RESOURCE_SLICES,
     TPU_SLICE_DOMAINS,
 )
+from tpu_dra.k8s.events import emit_event  # noqa: F401
 from tpu_dra.k8s.fake import FakeKube  # noqa: F401
 from tpu_dra.k8s.informer import Informer, Store  # noqa: F401

@@ -96,6 +96,7 @@ class ResourceDesc:
 
 PODS = ResourceDesc("", "v1", "pods", "Pod")
 NODES = ResourceDesc("", "v1", "nodes", "Node", namespaced=False)
+EVENTS = ResourceDesc("", "v1", "events", "Event")
 DAEMONSETS = ResourceDesc("apps", "v1", "daemonsets", "DaemonSet")
 DEPLOYMENTS = ResourceDesc("apps", "v1", "deployments", "Deployment")
 RESOURCE_SLICES = ResourceDesc("resource.k8s.io", "v1beta1",

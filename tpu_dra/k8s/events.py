@@ -1,0 +1,60 @@
+"""Kubernetes Event emission.
+
+The reference controller records Events through client-go's
+``record.EventRecorder``; this is the thin equivalent over our REST
+client: build a ``core/v1 Event`` referencing the involved object and
+create it with ``generateName``.  Emission is strictly best-effort —
+an Event that cannot be written must never fail the operation that
+wanted to report it (recorder semantics), so failures log and return
+None.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from tpu_dra.k8s.client import EVENTS, KubeClient
+from tpu_dra.util import klog
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+def emit_event(kube: KubeClient, involved: dict, reason: str,
+               message: str, event_type: str = EVENT_TYPE_WARNING,
+               component: str = "tpu-dra-driver") -> Optional[dict]:
+    """Record one Event against ``involved`` (a full object dict or one
+    with at least apiVersion/kind/metadata).  Returns the created Event,
+    or None when emission failed (already logged)."""
+    meta = involved.get("metadata", {})
+    name = meta.get("name", "object")
+    namespace = meta.get("namespace") or "default"
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {"generateName": f"{name}.",
+                     "namespace": namespace},
+        "involvedObject": {
+            "apiVersion": involved.get("apiVersion", ""),
+            "kind": involved.get("kind", ""),
+            "name": name,
+            "namespace": meta.get("namespace", ""),
+            "uid": meta.get("uid", ""),
+        },
+        "reason": reason,
+        "message": message,
+        "type": event_type,
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+        "source": {"component": component},
+    }
+    try:
+        return kube.create(EVENTS, event)
+    except Exception as exc:  # noqa: BLE001 — recorder semantics: an
+        # unwritable Event must never fail the operation reporting it
+        klog.warning("event emission failed", reason=reason, object=name,
+                     err=repr(exc))
+        return None

@@ -218,6 +218,16 @@ class FakeKube(KubeClient):
             old = self._store(res).get(key)
             if old is None:
                 raise NotFound(f"{res.plural} {key}")
+            # the status subresource enforces optimistic concurrency like
+            # any other write: a writer holding a stale fetch must see
+            # Conflict and retry, not silently clobber a racing status
+            # update (e.g. the controller's readiness write vs. its
+            # DevicesDegraded condition write)
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv and sent_rv != old["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{res.plural} {key}: resourceVersion {sent_rv} != "
+                    f"{old['metadata']['resourceVersion']}")
             new = copy.deepcopy(old)
             new["status"] = copy.deepcopy(obj.get("status", {}))
             return self._finalize_update(res, old, new, key)

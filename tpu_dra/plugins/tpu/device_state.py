@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
@@ -40,9 +41,21 @@ from tpu_dra.version import DRIVER_NAME
 CONFIG_SOURCE_CLASS = "FromClass"
 CONFIG_SOURCE_CLAIM = "FromClaim"
 
+# container-side mount point for the per-claim health heartbeat dir (the
+# host side lives under <plugin_dir>/heartbeats/<claim_uid>; the claim
+# uid in the container path keeps multi-claim containers collision-free)
+HEARTBEAT_CONTAINER_PATH = "/var/run/tpu-health"
+
 
 class PrepareError(RuntimeError):
     pass
+
+
+class DeviceUnhealthyError(PrepareError):
+    """Typed rejection for prepares that select an Unhealthy chip (ISSUE 2):
+    the scheduler raced a health transition — the device has already been
+    (or is about to be) dropped from the republished ResourceSlice, so the
+    claim must be rescheduled elsewhere, not prepared here."""
 
 
 @dataclass
@@ -64,6 +77,9 @@ class DeviceStateConfig:
     driver_root: str = "/"
     enable_subslices: bool = True
     driver_name: str = DRIVER_NAME
+    # duck-typed health veto (tpu_dra.health.HealthMonitor): is_serving
+    # (uuid) + state_of(uuid); None disables the gate
+    health: Optional[object] = None
 
 
 class DeviceState:
@@ -137,6 +153,12 @@ class DeviceState:
         """Unprepare by UID only — checkpoint state is authoritative so the
         API server is never needed (device_state.go:172-207)."""
         with self._mu:
+            # heartbeat dir cleanup happens even without a checkpoint
+            # entry: a prepare that failed after _claim_edits leaves the
+            # dir behind, and claim uids are unique so it would otherwise
+            # accumulate for the node's lifetime
+            shutil.rmtree(os.path.join(self.cfg.plugin_dir, "heartbeats",
+                                       claim_uid), ignore_errors=True)
             existing = self.checkpoint.get(claim_uid)
             if existing is None:       # absent ⇒ no-op, :181-189
                 klog.info("unprepare: no checkpoint entry; no-op", level=4,
@@ -235,6 +257,7 @@ class DeviceState:
             config.validate()
             devices = [self._lookup(r) for r in state.results]
             all_devices.extend(devices)
+            self._check_health(uid, devices)
             self._check_profile(config, devices)
             edits = self._group_edits(config, devices, uid)
             for dev, result in zip(devices, state.results):
@@ -254,6 +277,24 @@ class DeviceState:
                 edits_out[name] = edits
         self._check_overlap(uid, all_devices)
         return prepared, edits_out
+
+    def _check_health(self, uid: str,
+                      devices: list[AllocatableDevice]) -> None:
+        """Reject prepares selecting a chip the health monitor marked
+        Unhealthy (checked BEFORE any side effect — no CDI spec, no slot
+        pool, no checkpoint entry is created for a vetoed claim)."""
+        health = self.cfg.health
+        if health is None:
+            return
+        for dev in devices:
+            chip_uuid = (dev.chip.uuid if dev.chip is not None
+                         else dev.core.parent_uuid)
+            if not health.is_serving(chip_uuid):
+                raise DeviceUnhealthyError(
+                    f"claim {uid}: device {dev.canonical_name()} is on "
+                    f"chip {chip_uuid} currently "
+                    f"{health.state_of(chip_uuid)}; refusing to prepare "
+                    f"a claim on an unhealthy chip")
 
     def _parent_chip(self, core) -> object:
         for d in self.allocatable.values():
@@ -314,6 +355,26 @@ class DeviceState:
                 self.mp_manager.apply(sharing, devices, claim_uid))
         if self.fabric_id:
             edits.env["TPU_FABRIC_ID"] = self.fabric_id
+        if claim_uid:
+            # health heartbeat contract: the launcher shim touches a
+            # ``beat`` file (workloads/launcher.py start_health_heartbeat)
+            # in every claim subdir of TPU_HEALTH_HEARTBEAT_DIR, each an
+            # rw bind mount of the per-claim host dir; the host-side
+            # HeartbeatProbe flags the claim's chips when its beat goes
+            # stale.  The env value is the same constant from every
+            # claim, so a container holding several claims merges the
+            # edits without one claim's key clobbering another's (the
+            # per-claim identity lives in the mount path, not the env) —
+            # and without the mounts the heartbeats would land in the
+            # container's own filesystem, invisible to the host probe.
+            host_dir = os.path.join(self.cfg.plugin_dir, "heartbeats",
+                                    claim_uid)
+            os.makedirs(host_dir, exist_ok=True)
+            edits.add_mount(host_dir,
+                            f"{HEARTBEAT_CONTAINER_PATH}/{claim_uid}",
+                            options=["rw", "nosuid", "nodev", "bind"])
+            edits.env["TPU_HEALTH_HEARTBEAT_DIR"] = \
+                HEARTBEAT_CONTAINER_PATH
         return edits
 
     def _lookup(self, result: dict) -> AllocatableDevice:

@@ -6,14 +6,30 @@ fans Prepare/Unprepare to :class:`DeviceState` under a node-global flock
 (multiple driver pods on one node must serialize, flock rationale
 pkg/flock/flock.go:66-69; lock file ``pu.lock`` in the plugin dir,
 driver.go:37).
+
+Health integration (ISSUE 2, absent from the reference): a
+:class:`~tpu_dra.health.monitor.HealthMonitor` polls the chips; on a
+transition to/from Unhealthy the ResourceSlice is republished minus the
+Unhealthy chips (and their sub-chip cores), prepares selecting them are
+rejected with :class:`DeviceUnhealthyError`, and claims already pinned to
+a newly-Unhealthy chip are remediated per ``remediation``:
+``"event"`` records a Warning Event on the claim; ``"unprepare"``
+additionally unprepares the claim node-side and deletes the
+ResourceClaim so its consumers reschedule — the analog of the reference
+compute-domain daemon's restart-on-IMEX-failure semantics.
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Optional
 
-from tpu_dra.k8s.client import KubeClient
+from tpu_dra.health.monitor import HealthMonitor
+from tpu_dra.health.probes import default_probes
+from tpu_dra.health.state import Transition, UNHEALTHY
+from tpu_dra.k8s.client import KubeClient, NotFound, RESOURCE_CLAIMS
+from tpu_dra.k8s.events import EVENT_TYPE_WARNING, emit_event
 from tpu_dra.kubeletplugin import (
     ClaimRef,
     DriverCallbacks,
@@ -28,6 +44,9 @@ from tpu_dra.util import klog
 from tpu_dra.util.flock import locked
 from tpu_dra.version import DRIVER_NAME
 
+REMEDIATION_EVENT = "event"            # record Events only
+REMEDIATION_UNPREPARE = "unprepare"    # + unprepare and evict the claim
+
 
 @dataclass
 class TpuDriverConfig:
@@ -40,20 +59,51 @@ class TpuDriverConfig:
     driver_root: str = "/"
     enable_subslices: bool = True
     flock_timeout: float = 10.0   # driver.go:121 uses 10s
+    # -- health monitoring -------------------------------------------------
+    health_interval: float = 10.0       # <= 0 disables the poll loop
+    health_fail_threshold: int = 3      # consecutive fails -> Unhealthy
+    health_pass_threshold: int = 2      # consecutive passes -> Recovered
+    heartbeat_stale_after: float = 600.0
+    remediation: str = REMEDIATION_EVENT
 
 
 class TpuDriver:
     def __init__(self, cfg: TpuDriverConfig) -> None:
         self.cfg = cfg
+        if cfg.remediation not in (REMEDIATION_EVENT,
+                                   REMEDIATION_UNPREPARE):
+            raise ValueError(
+                f"remediation must be {REMEDIATION_EVENT!r} or "
+                f"{REMEDIATION_UNPREPARE!r}, got {cfg.remediation!r}")
         self.plugin_dir = os.path.join(cfg.plugins_dir, DRIVER_NAME)
         os.makedirs(self.plugin_dir, exist_ok=True)
         self.flock_path = os.path.join(self.plugin_dir, "pu.lock")
+        self.heartbeat_dir = os.path.join(self.plugin_dir, "heartbeats")
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        self.health = HealthMonitor(
+            cfg.tpulib,
+            # no DeviceNodeProbe here: LivenessProbe's chip_alive already
+            # covers device-node presence under driver_root for RealTpuLib
+            # (the raw filesystem probe's real consumer is the doctor CLI)
+            probes=default_probes(
+                cfg.tpulib,
+                heartbeat_dir=self.heartbeat_dir,
+                pinned_fn=self._pinned_claims,
+                heartbeat_stale_after=cfg.heartbeat_stale_after),
+            fail_threshold=cfg.health_fail_threshold,
+            pass_threshold=cfg.health_pass_threshold)
+        # last successfully published exclusion set; None until the first
+        # publish succeeds            # guarded by the poll thread
+        self._published_down: Optional[set] = None
+        self.health.add_listener(self._on_health_change)
+        self.health.add_poll_listener(self._ensure_published)
         self.state = DeviceState(DeviceStateConfig(
             tpulib=cfg.tpulib,
             plugin_dir=self.plugin_dir,
             cdi_root=cfg.cdi_root,
             driver_root=cfg.driver_root,
-            enable_subslices=cfg.enable_subslices))
+            enable_subslices=cfg.enable_subslices,
+            health=self.health))
         self.server = KubeletPluginServer(
             driver_name=DRIVER_NAME,
             node_name=cfg.node_name,
@@ -68,24 +118,130 @@ class TpuDriver:
     def start(self) -> None:
         self.server.start()
         self.publish_resources()
+        self.health.start(interval=self.cfg.health_interval)
 
     def stop(self) -> None:
+        self.health.stop()
         self.server.stop()
 
     def publish_resources(self) -> None:
-        """driver.go:71-84 — advertise chips (and cores when sub-slicing)."""
+        """driver.go:71-84 — advertise chips (and cores when sub-slicing),
+        minus anything the health monitor holds Unhealthy (a drained chip
+        takes its sub-chip cores with it)."""
         devices = []
         fabric = self.state.fabric_id
+        down = self.health.unhealthy_uuids()
         for dev in self.state.allocatable.values():
             if dev.type == TYPE_CHIP:
+                if dev.chip.uuid in down:
+                    continue
                 devices.append(chip_device(dev.chip, fabric))
             else:
+                if dev.core.parent_uuid in down:
+                    continue
                 parent = next(
                     d.chip for d in self.state.allocatable.values()
                     if d.chip is not None and
                     d.chip.uuid == dev.core.parent_uuid)
                 devices.append(core_device(dev.core, parent, fabric))
+        if down:
+            klog.warning("publishing ResourceSlice minus unhealthy chips",
+                         node=self.cfg.node_name,
+                         unhealthy=self.health.unhealthy_names())
         self.server.publish_resources(devices)
+        self._published_down = down
+
+    # -- health fan-out ----------------------------------------------------
+    def _pinned_claims(self) -> dict[str, list[str]]:
+        """chip uuid -> claim uids currently prepared on it (cores count
+        against their parent chip; a claim holding several cores of one
+        chip appears once) — feeds the HeartbeatProbe and the remediation
+        path."""
+        seen: dict[str, set[str]] = {}
+        for uid, claim in self.state.prepared_claims().items():
+            for dev in claim.devices:
+                chip_uuid = dev.uuid if dev.type == TYPE_CHIP \
+                    else dev.parent_uuid
+                seen.setdefault(chip_uuid, set()).add(uid)
+        return {chip: sorted(uids) for chip, uids in seen.items()}
+
+    def _ensure_published(self) -> None:
+        """Poll listener: republish whenever the advertised set drifted
+        from the monitor's verdict.  Runs EVERY tick, so a republish that
+        failed transiently on the edge (a permanently dead chip never
+        produces another edge to retry on) self-heals on the next poll
+        instead of advertising a dead chip until plugin restart."""
+        if self.health.unhealthy_uuids() == self._published_down:
+            return
+        try:
+            self.publish_resources()
+        except Exception as exc:  # noqa: BLE001 — retried next poll; the
+            # poll loop must survive a flaky API server
+            klog.error("health republish failed", err=repr(exc))
+
+    def _on_health_change(self, transitions: list[Transition]) -> None:
+        """Monitor listener: remediate claims pinned to newly-Unhealthy
+        chips (the republish itself is the poll listener's job —
+        _ensure_published runs after this on the same poll)."""
+        for t in transitions:
+            if t.to_state == UNHEALTHY:
+                self._remediate(t)
+
+    def _remediate(self, t: Transition) -> None:
+        """Handle prepared claims pinned to a chip that just went
+        Unhealthy, per the configured policy."""
+        pinned = self._pinned_claims().get(t.uuid, [])
+        prepared = self.state.prepared_claims()
+        for uid in pinned:
+            claim = prepared.get(uid)
+            if claim is None:
+                continue
+            involved = {
+                "apiVersion":
+                    f"{RESOURCE_CLAIMS.group}/{RESOURCE_CLAIMS.version}",
+                "kind": "ResourceClaim",
+                "metadata": {"name": claim.name,
+                             "namespace": claim.namespace,
+                             "uid": uid},
+            }
+            emit_event(
+                self.cfg.kube, involved, "DeviceUnhealthy",
+                f"chip {t.device} backing this claim went Unhealthy "
+                f"({t.detail}); remediation={self.cfg.remediation}",
+                EVENT_TYPE_WARNING)
+            if self.cfg.remediation != REMEDIATION_UNPREPARE:
+                continue
+            try:
+                with locked(self.flock_path,
+                            timeout=self.cfg.flock_timeout):
+                    self.state.unprepare(uid)
+            except Exception as exc:  # noqa: BLE001 — per-claim: one stuck
+                # unprepare must not block remediating the others
+                klog.error("remediation unprepare failed", claim=uid,
+                           err=repr(exc))
+                continue
+            try:
+                # the checkpoint record can outlive the API object: only
+                # delete the claim the checkpoint actually pinned, never a
+                # same-name successor with a new uid (a recreated claim
+                # may be healthily allocated elsewhere)
+                current = self.cfg.kube.get(RESOURCE_CLAIMS, claim.name,
+                                            claim.namespace)
+                if current.get("metadata", {}).get("uid") == uid:
+                    self.cfg.kube.delete(RESOURCE_CLAIMS, claim.name,
+                                         claim.namespace)
+                else:
+                    klog.warning("remediation skipping claim delete: uid "
+                                 "changed (claim was recreated)",
+                                 claim=uid)
+            except NotFound:
+                pass
+            except Exception as exc:  # noqa: BLE001 — eviction is
+                # best-effort; the unprepare already freed the node side
+                klog.warning("remediation claim delete failed", claim=uid,
+                             err=repr(exc))
+            klog.warning("unprepared and evicted claim on unhealthy chip",
+                         claim=uid, chip=t.device)
 
     # -- DRA callbacks -----------------------------------------------------
     def prepare_resource_claims(self, claims: list[dict]

@@ -25,6 +25,18 @@ def plugin_flags() -> FlagGroup:
         Flag("ignore-host-tpu-env", "IGNORE_HOST_TPU_ENV",
              "discover topology only from the node metadata file, ignoring "
              "TPU_* variables in the plugin's own environment", False, bool),
+        Flag("health-interval", "HEALTH_INTERVAL",
+             "seconds between chip health polls (0 disables)", 10.0, float),
+        Flag("health-fail-threshold", "HEALTH_FAIL_THRESHOLD",
+             "consecutive failed polls before a chip goes Unhealthy",
+             3, int),
+        Flag("health-pass-threshold", "HEALTH_PASS_THRESHOLD",
+             "consecutive passing polls before an Unhealthy chip recovers",
+             2, int),
+        Flag("health-remediation", "HEALTH_REMEDIATION",
+             "what to do with claims pinned to an Unhealthy chip: "
+             "'event' (record Events only) or 'unprepare' (also "
+             "unprepare node-side and delete the claim)", "event"),
     ])
 
 
@@ -36,8 +48,6 @@ def main(argv=None) -> int:
         argv,
         description=__doc__)
     klog.configure(args.v, args.logging_format)
-    from tpu_dra.util.metrics import serve_from_flag
-    serve_from_flag(args.http_endpoint)
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
     driver = TpuDriver(TpuDriverConfig(
@@ -49,7 +59,15 @@ def main(argv=None) -> int:
         registry_dir=args.kubelet_registry_dir,
         cdi_root=args.cdi_root,
         driver_root=args.tpu_driver_root,
-        enable_subslices=args.enable_subslices))
+        enable_subslices=args.enable_subslices,
+        health_interval=args.health_interval,
+        health_fail_threshold=args.health_fail_threshold,
+        health_pass_threshold=args.health_pass_threshold,
+        remediation=args.health_remediation))
+    from tpu_dra.util.metrics import serve_from_flag
+    # /healthz now aggregates the chip health monitor's verdict instead
+    # of a static ok — a node with an Unhealthy chip reports 503
+    serve_from_flag(args.http_endpoint, healthz=driver.health.healthz)
     driver.start()
     klog.info("tpu-kubelet-plugin started", node=args.node_name)
 

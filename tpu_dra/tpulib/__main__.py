@@ -1,0 +1,147 @@
+"""``python -m tpu_dra.tpulib doctor`` — one-shot host diagnostic.
+
+Runs real discovery plus the chip health probes (tpu_dra/health) against
+this host and prints what a kubelet plugin on this node would see: chips
+found (index/minor/uuid/device nodes), topology metadata, and a per-chip
+probe verdict.  The tool every "why does the driver see 0 chips?" or
+"why is my chip drained?" investigation starts with — it exercises the
+exact code paths the plugin uses (``RealTpuLib.enumerate_chips`` and
+``tpu_dra.health.probes``), not a parallel reimplementation.
+
+Exit codes: 0 = chips found, all probes pass; 1 = chips found but a
+probe fails; 2 = no chips discovered (not a TPU host, or the driver/
+device nodes are absent).
+
+``--fake`` swaps in :class:`~tpu_dra.tpulib.fake.FakeTpuLib` (optionally
+with ``--fail-chip N`` fault injection) so the output format and exit
+codes are testable anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_dra.tpulib.discovery import RealTpuLib, TpuLib
+from tpu_dra.health.probes import default_probes
+
+
+def _probe_chip(tpulib: TpuLib, probes, chip) -> list[dict]:
+    results = []
+    for probe in probes:
+        try:
+            res = probe.check(chip)
+        except Exception as exc:  # noqa: BLE001 — doctor reports, never dies
+            results.append({"probe": probe.name, "healthy": False,
+                            "detail": f"probe raised: {exc!r}"})
+            continue
+        results.append({"probe": res.probe, "healthy": res.healthy,
+                        "detail": res.detail})
+    return results
+
+
+def doctor(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_dra.tpulib doctor",
+        description="discover TPU chips on this host and run the health "
+                    "probes against them")
+    parser.add_argument("--driver-root", default="/",
+                        help="root the TPU device nodes live under "
+                             "(default /)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--fake", action="store_true",
+                        help="run against FakeTpuLib instead of the host "
+                             "(output-format/e2e testing)")
+    parser.add_argument("--fail-chip", type=int, action="append",
+                        default=[], metavar="N",
+                        help="with --fake: inject a liveness fault on "
+                             "chip index N (repeatable)")
+    args = parser.parse_args(argv)
+
+    if args.fake:
+        from tpu_dra.tpulib.fake import FakeTpuLib
+        tpulib: TpuLib = FakeTpuLib()
+        for idx in args.fail_chip:
+            tpulib.fail_chip(idx)
+    else:
+        tpulib = RealTpuLib(driver_root=args.driver_root)
+
+    chips = tpulib.enumerate_chips()
+    report = {
+        "fabric_id": tpulib.fabric_id(),
+        "worker_id": tpulib.worker_id() if chips else -1,
+        "chips": [],
+    }
+    # no heartbeat dir / claim mapping in one-shot mode: the doctor checks
+    # the host surface, not a running plugin's claims
+    probes = default_probes(
+        tpulib,
+        device_node_root=None if args.fake else args.driver_root)
+    all_healthy = True
+    for chip in chips:
+        probe_results = _probe_chip(tpulib, probes, chip)
+        healthy = all(r["healthy"] for r in probe_results)
+        all_healthy = all_healthy and healthy
+        report["chips"].append({
+            "name": chip.canonical_name(),
+            "uuid": chip.uuid,
+            "index": chip.index,
+            "minor": chip.minor,
+            "device_paths": list(chip.device_paths),
+            "accelerator_type": chip.accelerator_type,
+            "topology": chip.topology,
+            "healthy": healthy,
+            "probes": probe_results,
+        })
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        _print_human(report)
+
+    if not chips:
+        return 2
+    return 0 if all_healthy else 1
+
+
+def _print_human(report: dict) -> None:
+    chips = report["chips"]
+    print(f"chips discovered: {len(chips)}")
+    if chips:
+        print(f"fabric id: {report['fabric_id'] or '(none: single-host)'}")
+        print(f"worker id: {report['worker_id']}")
+    else:
+        print("no TPU chips found: not a TPU host, or the accelerator "
+              "driver/device nodes are absent (try --driver-root)")
+    for chip in chips:
+        verdict = "HEALTHY" if chip["healthy"] else "UNHEALTHY"
+        print(f"\n{chip['name']}  [{verdict}]")
+        print(f"  uuid: {chip['uuid']}")
+        print(f"  minor: {chip['minor']}  "
+              f"type: {chip['accelerator_type']}  "
+              f"topology: {chip['topology']}")
+        print(f"  device nodes: {', '.join(chip['device_paths']) or '-'}")
+        for res in chip["probes"]:
+            mark = "ok " if res["healthy"] else "FAIL"
+            detail = f" — {res['detail']}" if res["detail"] else ""
+            print(f"  [{mark}] {res['probe']}{detail}")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "doctor":
+        return doctor(argv[1:])
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m tpu_dra.tpulib doctor [options]")
+        return 0
+    print(f"unknown subcommand {argv[0]!r}; want: doctor", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # `doctor | head` must not traceback
+        sys.exit(0)

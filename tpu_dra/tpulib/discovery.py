@@ -38,6 +38,14 @@ from tpu_dra.tpulib.topology import (
 _UUID_NS = uuidlib.UUID("6ba7b812-9dad-11d1-80b4-00c04fd430c8")
 
 
+def resolve_under_root(root: str, path: str) -> str:
+    """A chip's stored device paths are root-relative (mirroring what the
+    CDI spec injects); resolve one against a driver root.  The single
+    rule shared by RealTpuLib liveness and the health DeviceNodeProbe."""
+    r = root.rstrip("/")
+    return f"{r}{path}" if path.startswith("/") else path
+
+
 @dataclass
 class CoreInfo:
     """One TensorCore of a chip — the sub-slice allocation unit."""
@@ -105,6 +113,29 @@ class TpuLib:
 
     def worker_hostnames(self) -> list[str]:
         raise NotImplementedError
+
+    # -- health probes (consumed by tpu_dra/health) -----------------------
+    def chip_alive(self, chip: "ChipInfo") -> bool:
+        """libtpu-level liveness: the chip's device nodes are still
+        present and openable character devices.  There is no NVML-style
+        health-event surface on TPU — node presence IS the kernel
+        driver's liveness signal; richer checks (FakeTpuLib fault
+        injection, sysfs on real hosts) live in the subclasses."""
+        import stat as _stat
+        for path in chip.device_paths:
+            try:
+                st = os.stat(path)
+            except OSError:
+                return False
+            if not (_stat.S_ISCHR(st.st_mode) or _stat.S_ISREG(st.st_mode)):
+                return False
+        return True
+
+    def ecc_error_count(self, chip: "ChipInfo") -> int:
+        """Cumulative HBM/ECC error count for the chip; 0 when the
+        platform exposes no counter (the health EccProbe alarms on the
+        delta, so a constant 0 is simply 'no signal')."""
+        return 0
 
     # -- device node management (L0; delegated to the native lib) ---------
     def create_device_node(self, path: str, major: int, minor: int) -> None:
@@ -289,3 +320,44 @@ class RealTpuLib(TpuLib):
     def worker_hostnames(self) -> list[str]:
         raw = self._metadata().get("TPU_WORKER_HOSTNAMES", "")
         return [h for h in raw.split(",") if h]
+
+    # -- health probes -----------------------------------------------------
+    def chip_alive(self, chip: ChipInfo) -> bool:
+        """Device-node liveness resolved under ``driver_root``."""
+        return all(os.path.exists(resolve_under_root(self.driver_root, p))
+                   for p in chip.device_paths)
+
+    # sysfs locations that carry an ECC/uncorrectable-error counter on
+    # TPU hosts, by stack generation; first readable one wins
+    _ECC_COUNTER_PATHS = (
+        "sys/class/accel/accel{minor}/device/ecc_errors",
+        "sys/class/vfio/{minor}/device/aer_dev_nonfatal",
+    )
+
+    def ecc_error_count(self, chip: ChipInfo) -> int:
+        root = self.driver_root.rstrip("/")
+        for tmpl in self._ECC_COUNTER_PATHS:
+            path = os.path.join(root or "/", tmpl.format(minor=chip.minor))
+            try:
+                with open(path) as f:
+                    raw = f.read().strip()
+            except OSError:
+                continue
+            # counter files are either a bare integer or "key value" lines
+            # (AER stats).  AER files end with a TOTAL_ERR_* line equal to
+            # the sum of the individual counters — counting it too would
+            # double the reported errors and halve the effective alarm
+            # threshold, so per-key lines skip TOTAL_* rows.
+            lines = [ln.split() for ln in raw.splitlines() if ln.split()]
+            if len(lines) == 1 and len(lines[0]) == 1 and \
+                    lines[0][0].lstrip("-").isdigit():
+                return int(lines[0][0])
+            total, parsed = 0, False
+            for toks in lines:
+                if len(toks) == 2 and toks[1].lstrip("-").isdigit() and \
+                        not toks[0].upper().startswith("TOTAL"):
+                    total += int(toks[1])
+                    parsed = True
+            if parsed:
+                return total
+        return 0

@@ -26,6 +26,11 @@ class FakeTpuLib(TpuLib):
         "w-2.slice.local", "w-3.slice.local"])
     slice_uuid: str = "11111111-2222-3333-4444-555555555555"
     created_nodes: list[tuple[str, int, int]] = field(default_factory=list)
+    # health fault injection (the hook ISSUE 2 mandates): indices in
+    # failed_chips fail the liveness probe; ecc_errors maps index ->
+    # cumulative error count for the EccProbe
+    failed_chips: set[int] = field(default_factory=set)
+    ecc_errors: dict[int, int] = field(default_factory=dict)
 
     def enumerate_chips(self) -> list[ChipInfo]:
         family = FAMILIES[self.family_name]
@@ -61,3 +66,17 @@ class FakeTpuLib(TpuLib):
 
     def create_device_node(self, path: str, major: int, minor: int) -> None:
         self.created_nodes.append((path, major, minor))
+
+    # -- health fault injection -------------------------------------------
+    def fail_chip(self, index: int) -> None:
+        """Inject a liveness fault on the node-local chip ``index``."""
+        self.failed_chips.add(index)
+
+    def recover_chip(self, index: int) -> None:
+        self.failed_chips.discard(index)
+
+    def chip_alive(self, chip: ChipInfo) -> bool:
+        return chip.index not in self.failed_chips
+
+    def ecc_error_count(self, chip: ChipInfo) -> int:
+        return self.ecc_errors.get(chip.index, 0)

@@ -281,6 +281,10 @@ class ContinuousEngine:
         self._cv = threading.Condition()
         self._stop = False
         self._draining = False
+        # decode-loop heartbeat (serve.py /healthz): refreshed every
+        # batcher iteration; _failed records a batcher death verbatim
+        self.last_beat = time.perf_counter()
+        self._failed: Optional[str] = None
         # stats
         self.completed = 0
         self.cancelled = 0
@@ -1166,6 +1170,24 @@ class ContinuousEngine:
                 1e3 * lat[min(len(lat) - 1, int(0.95 * len(lat)))], 3)
         return out
 
+    def healthy(self, stale_after: float = 120.0) -> tuple[bool, str]:
+        """Decode-loop liveness for /healthz (ISSUE 2): False when the
+        batcher died, its thread is gone, or its per-iteration heartbeat
+        went stale (a dispatch wedged on-device).  ``stale_after`` must
+        exceed worst-case cold-compile time — a first-hit JIT compile
+        legitimately stalls the loop for tens of seconds."""
+        with self._cv:
+            failed, stopped = self._failed, self._stop
+        if failed:
+            return False, failed
+        if stopped or not self._thread.is_alive():
+            return False, "engine batcher is not running"
+        age = time.perf_counter() - self.last_beat
+        if age > stale_after:
+            return False, (f"decode loop wedged: no heartbeat for "
+                           f"{age:.0f}s (limit {stale_after:.0f}s)")
+        return True, "ok"
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful rolling-restart half of shutdown: REJECT new
         submissions immediately, let queued and in-flight requests run
@@ -1565,6 +1587,7 @@ class ContinuousEngine:
         msg = f"continuous batcher died: {exc!r}"[:500]
         with self._cv:
             self._stop = True
+            self._failed = msg
             victims = [r for r in self._requests if r is not None]
             victims += list(self._pending)
             self._pending.clear()
@@ -1584,9 +1607,11 @@ class ContinuousEngine:
             with self._cv:
                 while (not self._stop and not self._pending
                        and all(r is None for r in self._requests)):
+                    self.last_beat = time.perf_counter()
                     self._cv.wait(timeout=0.5)
                 if self._stop:
                     return
+            self.last_beat = time.perf_counter()
             self._admit()
             if all(r is None for r in self._requests):
                 continue

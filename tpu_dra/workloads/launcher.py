@@ -68,6 +68,7 @@ class RendezvousInfo:
         acquire_multiprocess_slot()
         apply_hbm_limits()
         apply_scheduling_priority()
+        start_health_heartbeat()
         for key, val in self.megascale_env().items():
             os.environ.setdefault(key, val)   # explicit user env wins
         import jax
@@ -269,16 +270,112 @@ def apply_scheduling_priority(env: Optional[dict[str, str]] = None
         return None
 
 
+# heartbeat thread state: one per process (a second start is a no-op)
+_HEARTBEAT_THREAD = None
+_HEARTBEAT_STOP = None
+_HEARTBEAT_PATHS: list[str] = []
+
+
+def _touch_heartbeat(path: str) -> bool:
+    try:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "a"):
+            pass
+        os.utime(path, None)
+        return True
+    except OSError:
+        return False   # heartbeat is advisory: never kill the workload
+
+
+def _heartbeat_paths(e) -> list[str]:
+    """Beat targets from the claim-edits contract: every claim subdir
+    mounted under ``TPU_HEALTH_HEARTBEAT_DIR`` gets a ``beat`` file (the
+    env value is the same constant from every claim, so multi-claim
+    containers see all their mounts); ``TPU_HEALTH_HEARTBEAT_FILE``
+    names a single explicit file (tests, manual opt-in) and wins."""
+    path = e.get("TPU_HEALTH_HEARTBEAT_FILE", "")
+    if path:
+        return [path]
+    base = e.get("TPU_HEALTH_HEARTBEAT_DIR", "")
+    if not base or not os.path.isdir(base):
+        return []
+    return [os.path.join(base, sub, "beat")
+            for sub in sorted(os.listdir(base))
+            if os.path.isdir(os.path.join(base, sub))]
+
+
+def start_health_heartbeat(env: Optional[dict[str, str]] = None,
+                           interval: float = 30.0) -> Optional[list[str]]:
+    """Heartbeat half of the node health contract (ISSUE 2): the kubelet
+    plugin's claim edits bind-mount one dir per claim under
+    ``TPU_HEALTH_HEARTBEAT_DIR``; this shim touches each dir's ``beat``
+    file every ``interval`` seconds from a daemon thread.  The node's
+    ``HeartbeatProbe`` flags a claim's chips when its beat exists but
+    goes stale — a wedged workload is a chip-health signal.  Opt-in and
+    advisory: missing env (or unwritable paths) is a no-op.  Returns the
+    beat paths, or None."""
+    global _HEARTBEAT_THREAD, _HEARTBEAT_STOP, _HEARTBEAT_PATHS
+    import atexit
+    import threading
+    e = os.environ if env is None else env
+    paths = _heartbeat_paths(e)
+    if not paths:
+        return None
+    if _HEARTBEAT_THREAD is not None and _HEARTBEAT_THREAD.is_alive():
+        return list(_HEARTBEAT_PATHS)
+    paths = [p for p in paths if _touch_heartbeat(p)]
+    if not paths:
+        return None
+    stop = threading.Event()
+
+    def beat() -> None:
+        while not stop.wait(interval):
+            for p in paths:
+                _touch_heartbeat(p)
+
+    _HEARTBEAT_STOP = stop
+    _HEARTBEAT_PATHS = list(paths)
+    _HEARTBEAT_THREAD = threading.Thread(
+        target=beat, daemon=True, name="health-heartbeat")
+    _HEARTBEAT_THREAD.start()
+    # unlink on interpreter exit: an exited or crash-looping workload
+    # must read as "no heartbeat" (the probe passes on a missing file),
+    # not accumulate staleness while the claim stays prepared and
+    # falsely condemn a healthy chip.  SIGKILL skips this, but the next
+    # container restart re-touches the files and resets the clock.
+    atexit.register(stop_health_heartbeat)
+    return list(paths)
+
+
+def stop_health_heartbeat() -> None:
+    global _HEARTBEAT_THREAD, _HEARTBEAT_STOP, _HEARTBEAT_PATHS
+    if _HEARTBEAT_STOP is not None:
+        _HEARTBEAT_STOP.set()
+    if _HEARTBEAT_THREAD is not None:
+        _HEARTBEAT_THREAD.join(timeout=5)
+    for p in _HEARTBEAT_PATHS:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass   # advisory, like the touches themselves
+    _HEARTBEAT_THREAD = None
+    _HEARTBEAT_STOP = None
+    _HEARTBEAT_PATHS = []
+
+
 def init_tpu_workload(env: Optional[dict[str, str]] = None,
                       dry_run: bool = False) -> dict:
     """Apply every driver-injected resource contract, in dependency order:
     slot gate (fail fast before any backend work), HBM bound (must precede
-    libtpu init), scheduling priority.  The one call a claimed container
-    makes before importing jax; returns what was applied.
+    libtpu init), scheduling priority, health heartbeat.  The one call a
+    claimed container makes before importing jax; returns what was applied.
 
     ``dry_run=True`` computes without side effects on the real process: no
     slot is locked, ``os.environ`` is untouched (the HBM flag lands only in
-    the provided ``env`` dict), and the process is not reniced.
+    the provided ``env`` dict), the process is not reniced, and no
+    heartbeat thread starts.
     """
     if dry_run:
         e = dict(os.environ) if env is None else env
@@ -287,11 +384,13 @@ def init_tpu_workload(env: Optional[dict[str, str]] = None,
             "hbm_limit_bytes": apply_hbm_limits(e, setenv=False),
             "nice": _PRIORITY_NICE.get(
                 e.get("TPU_PROCESS_PRIORITY", ""), 0) or None,
+            "heartbeat": _heartbeat_paths(e) or None,
         }
     return {
         "slot": acquire_multiprocess_slot(env),
         "hbm_limit_bytes": apply_hbm_limits(env),
         "nice": apply_scheduling_priority(env),
+        "heartbeat": start_health_heartbeat(env),
     }
 
 

@@ -35,7 +35,9 @@ POST /speculative {"tokens": [[...]], "steps": N, "k": 4,
                  greedy: tokens EXACTLY equal /generate's greedy output;
                  steps/M ≈ tokens committed per serving-model pass.
                  Needs --draft-checkpoint-dir; equal-length rows)
-GET  /healthz → "ok"
+GET  /healthz → 200 "ok" while the engine decode loop is live (and any
+             wired chip-health monitor agrees); 503 + reason when the
+             batcher died/wedged, so k8s probes restart a wedged server
 GET  /metrics → Prometheus text (version 0.0.4): request counts by
              path/code, generated-token total, request-latency histogram,
              and (continuous mode) tpu_serve_engine_* gauges
@@ -324,10 +326,31 @@ class ServeMetrics:
                 self.registry.gauge(name, help_).set(float(value))
 
 
-def make_handler(pool: DecoderPool, engine=None, metrics=None):
+def make_handler(pool: DecoderPool, engine=None, metrics=None,
+                 health=None, health_stale_after: float = 600.0):
     """``engine`` (a ContinuousEngine) takes over /generate when given:
     every row becomes its own engine request, fanned in via submit_async
-    so one HTTP call's rows still decode concurrently."""
+    so one HTTP call's rows still decode concurrently.
+
+    ``health``: optional external verdict for /healthz — a callable
+    returning bool or ``(bool, detail)`` (e.g. a node HealthMonitor's
+    ``healthz``); ANDed with the engine's own decode-loop liveness.
+    ``health_stale_after``: seconds without a decode-loop heartbeat
+    before /healthz reports wedged — MUST exceed the model's worst-case
+    cold JIT compile (which legitimately blocks the loop), or a liveness
+    probe mid-compile restarts the pod into a recompile crash loop."""
+
+    def healthz_verdict() -> tuple[bool, str]:
+        ok, detail = True, "ok"
+        if engine is not None:
+            ok, detail = engine.healthy(stale_after=health_stale_after)
+        if ok and health is not None:
+            verdict = health()
+            if isinstance(verdict, tuple):
+                ok, detail = verdict
+            elif not verdict:
+                ok, detail = False, "health monitor reports unhealthy"
+        return ok, detail
 
     def reject_engine_knobs(req) -> None:
         for knob, noop in (("top_k", 0.0), ("top_p", 0.0),
@@ -405,7 +428,9 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None):
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, b"ok", "text/plain")
+                ok, detail = healthz_verdict()
+                self._send(200 if ok else 503,
+                           (detail or "ok").encode(), "text/plain")
             elif self.path == "/metrics" and metrics is not None:
                 if engine is not None:
                     metrics.scrape_engine(engine)
@@ -762,7 +787,8 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           speculative_engine: bool = False,
           kv_layout: str = "slab", page_size: int = 64,
           total_pages: int | None = None,
-          logit_bias: dict[int, float] | None = None
+          logit_bias: dict[int, float] | None = None,
+          health=None, health_stale_after: float = 600.0
           ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
@@ -781,7 +807,11 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
     drafts multiply continuous-batching throughput.  Greedy requests
     keep byte-parity with the plain engine; sampled requests commit via
     the rejection scheme (spec_sample.py) and stay distributed exactly
-    as target-only sampling."""
+    as target-only sampling.
+
+    ``health``: optional external /healthz verdict (bool or
+    ``(bool, detail)`` callable, e.g. a chip HealthMonitor's
+    ``healthz``), ANDed with the engine's decode-loop liveness."""
     if kv_layout != "slab" and not continuous:
         raise ValueError("--kv-layout paged requires --continuous (the "
                          "bucketed pool has no paged mode); without it "
@@ -803,7 +833,8 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
             total_pages=total_pages, logit_bias=logit_bias)
     metrics = ServeMetrics()
     srv = ThreadingHTTPServer((host, port),
-                              make_handler(pool, engine, metrics))
+                              make_handler(pool, engine, metrics, health,
+                                           health_stale_after))
     srv.engine = engine               # reachable for stats
     srv.metrics = metrics
     if engine is not None:
@@ -889,6 +920,11 @@ def main(argv=None):
                          "slots*ceil(max_len/page_size) — slab parity; "
                          "set lower to oversubscribe slots against real "
                          "usage)")
+    ap.add_argument("--health-stale-after", type=float, default=600.0,
+                    help="seconds without a decode-loop heartbeat before "
+                         "/healthz reports 503; must exceed the model's "
+                         "worst-case cold JIT compile or liveness probes "
+                         "restart the pod into a recompile loop")
     ap.add_argument("--warmup", action="store_true",
                     help="continuous mode: compile every prompt-bucket "
                          "program before accepting traffic (first "
@@ -1031,7 +1067,8 @@ def main(argv=None):
                 slots=args.slots, chunk=args.chunk, draft=draft,
                 speculative_engine=args.speculative_continuous,
                 kv_layout=args.kv_layout, page_size=args.page_size,
-                total_pages=args.total_pages, logit_bias=logit_bias)
+                total_pages=args.total_pages, logit_bias=logit_bias,
+                health_stale_after=args.health_stale_after)
     if args.warmup:
         if srv.engine is None:
             ap.error("--warmup needs --continuous")
