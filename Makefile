@@ -43,6 +43,12 @@ e2e-inprocess:
 	$(PYTHON) hack/e2e_inprocess.py --pods 50
 	$(PYTHON) hack/e2e_slice_domain.py
 
+# observability acceptance (docs/observability.md): one trace id through
+# controller -> real kubelet plugin -> launcher shim, /debug/traces
+# Perfetto JSON, workqueue metrics under scripted load
+drive-trace:
+	$(PYTHON) hack/drive_trace.py
+
 proto:
 	cd tpu_dra/kubeletplugin/proto && \
 	protoc --python_out=. dra_v1beta1.proto pluginregistration.proto

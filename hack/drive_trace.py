@@ -1,0 +1,322 @@
+"""Drive one trace id through controller → kubelet plugin → launcher.
+
+The observability acceptance drive (ISSUE 3): the REAL tpu kubelet
+plugin runs as its own process (gRPC unix socket + HTTP /metrics +
+/debug/traces) against the real HTTP API-server facade; an in-process
+controller reconciles a TpuSliceDomain; this script plays the two
+components that are not ours (scheduler + kubelet) and the workload
+container (launcher shim).  It asserts:
+
+1. ONE trace id flows controller reconcile → workload RCT
+   ``spec.metadata`` annotation → ResourceClaim annotation → plugin
+   prepare → claim CDI spec ``TPU_TRACEPARENT`` env → launcher shim span;
+2. the plugin's ``/debug/traces?trace_id=`` serves Perfetto-loadable
+   Chrome trace JSON containing the prepare phase spans of that trace;
+3. ``tpu_dra_workqueue_{depth,queue_duration_seconds,
+   work_duration_seconds,retries_total}`` appear on ``/metrics`` with
+   correct values under a scripted load.
+
+    python hack/drive_trace.py [--out DRIVE_TRACE.json]
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import grpc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra import trace  # noqa: E402
+from tpu_dra.controller.controller import (  # noqa: E402
+    Controller,
+    ControllerConfig,
+)
+from tpu_dra.k8s.client import (  # noqa: E402
+    PODS,
+    RESOURCE_CLAIMS,
+    RESOURCE_CLAIM_TEMPLATES,
+    TPU_SLICE_DOMAINS,
+)
+from tpu_dra.k8s.testserver import KubeTestServer  # noqa: E402
+from tpu_dra.kubeletplugin.proto import (  # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.trace.propagation import (  # noqa: E402
+    TRACEPARENT_ANNOTATION,
+    TRACEPARENT_ENV,
+)
+from tpu_dra.util.metrics import DEFAULT_REGISTRY  # noqa: E402
+from tpu_dra.util.workqueue import WorkQueue  # noqa: E402
+from tpu_dra.version import DRIVER_NAME  # noqa: E402
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(pred, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def http_get(url, timeout=5.0):
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def scripted_workqueue_load(n_ok=25, n_flaky=5) -> dict:
+    """Exercise a workqueue so every metric in the acceptance list has a
+    nonzero, checkable value: n_ok clean items + n_flaky items that each
+    fail twice before succeeding (2 retries apiece)."""
+    from tpu_dra.util.workqueue import ItemExponentialBackoff
+
+    q = WorkQueue("drive-load",
+                  backoff=ItemExponentialBackoff(base=0.002, cap=0.02))
+    q.run_in_background()
+    fails: dict[str, int] = {}
+    mu = threading.Lock()
+
+    def ok(_obj):
+        time.sleep(0.001)
+
+    def flaky(obj):
+        with mu:
+            n = fails.get(obj, 0)
+            fails[obj] = n + 1
+        if n < 2:
+            raise RuntimeError(f"transient {obj}")
+
+    for i in range(n_ok):
+        q.enqueue(ok, i, key=f"ok-{i}")
+    for i in range(n_flaky):
+        q.enqueue(flaky, f"f{i}", key=f"flaky-{i}")
+    assert q.drain(30), "load queue did not drain"
+    q.shutdown()
+    return {"items": n_ok + n_flaky, "expected_retries": 2 * n_flaky}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    trace.configure(service="drive-trace-controller")
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="drive-trace-"))
+    srv = KubeTestServer().start()
+    plugin = None
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp / "kubeconfig"))
+        root = tmp / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(4):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "etc").mkdir()
+        (root / "etc" / "machine-id").write_text("deadbeefcafe\n")
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-4'\nTPU_TOPOLOGY: '2x2'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+        http_port = free_port()
+        plugin = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+             "--kubeconfig", kcfg, "--node-name", "node-a",
+             "--tpu-driver-root", str(root),
+             "--kubelet-plugins-dir", str(tmp / "plugins"),
+             "--kubelet-registry-dir", str(tmp / "registry"),
+             "--cdi-root", str(tmp / "cdi"), "--ignore-host-tpu-env",
+             "--http-endpoint", f"127.0.0.1:{http_port}"],
+            cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+        dra_sock = tmp / "plugins" / DRIVER_NAME / "dra.sock"
+        wait_until(dra_sock.exists, 30, "plugin socket")
+
+        # ---- controller (in-process, real reconcile loop) --------------
+        ctrl = Controller(ControllerConfig(kube=srv.fake, gc_period=3600))
+        ctrl.start()
+        srv.fake.create(TPU_SLICE_DOMAINS, {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuSliceDomain",
+            "metadata": {"name": "dom", "namespace": "default"},
+            "spec": {"numNodes": 1,
+                     "channel": {"resourceClaimTemplate":
+                                 {"name": "dom-channel"}}},
+        })
+
+        def rct():
+            try:
+                return srv.fake.get(RESOURCE_CLAIM_TEMPLATES,
+                                    "dom-channel", "default")
+            except Exception:  # noqa: BLE001 — poll until created
+                return None
+
+        wait_until(lambda: rct() is not None, 15, "workload RCT")
+        template = rct()
+        inherited = template.get("spec", {}).get("metadata", {}) \
+            .get("annotations", {})
+        traceparent = inherited.get(TRACEPARENT_ANNOTATION, "")
+        assert traceparent, \
+            "controller did not stamp traceparent into RCT spec.metadata"
+        trace_id = traceparent.split("-")[1]
+        print(f"controller root trace: {trace_id}")
+
+        # ---- scheduler + kubelet stand-ins ------------------------------
+        url = (f"http://127.0.0.1:{srv.port}/apis/resource.k8s.io/"
+               "v1beta1/resourceslices")
+        slices = json.load(urllib.request.urlopen(url))["items"]
+        devices = [d["name"] for d in slices[0]["spec"]["devices"]
+                   if "-core-" not in d["name"]]
+        assert devices, slices
+
+        srv.fake.create(PODS, {
+            "metadata": {"name": "pod-0", "namespace": "default"},
+            "spec": {"resourceClaims": [{"name": "tpu",
+                                         "resourceClaimName": "pod-0"}]},
+            "status": {"phase": "Pending"}})
+        # the resourceclaim-controller half: a claim born from the RCT
+        # inherits spec.metadata annotations — including traceparent
+        claim = srv.fake.create(RESOURCE_CLAIMS, {
+            "metadata": {"name": "pod-0", "namespace": "default",
+                         "annotations": dict(inherited)},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}}})
+        uid = claim["metadata"]["uid"]
+        claim["status"] = {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME,
+             "pool": "node-a", "device": devices[0]}]}}}
+        srv.fake.update_status(RESOURCE_CLAIMS, claim)
+
+        with grpc.insecure_channel(f"unix:{dra_sock}") as channel:
+            prepare = channel.unary_unary(
+                "/v1beta1.DRAPlugin/NodePrepareResources",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=(
+                    dra_pb.NodePrepareResourcesResponse.FromString))
+            req = dra_pb.NodePrepareResourcesRequest()
+            c = req.claims.add()
+            c.uid, c.name, c.namespace = uid, "pod-0", "default"
+            res = prepare(req, timeout=15)
+            assert res.claims[uid].error == "", res.claims[uid].error
+
+        # ---- assertion 1: one trace id into the CDI env -----------------
+        spec_files = list((tmp / "cdi").glob(f"*{uid}*"))
+        assert spec_files, f"no claim CDI spec for {uid}"
+        spec = json.load(open(spec_files[0]))
+        env_entries = [e for d in spec["devices"]
+                       for e in d["containerEdits"].get("env", [])]
+        tp_env = next(e.split("=", 1)[1] for e in env_entries
+                      if e.startswith(TRACEPARENT_ENV + "="))
+        assert tp_env.split("-")[1] == trace_id, \
+            f"plugin env trace {tp_env} != controller trace {trace_id}"
+        print(f"claim CDI spec carries {TRACEPARENT_ENV} of the same trace")
+
+        # ---- assertion 1b: launcher continues the trace -----------------
+        from tpu_dra.workloads import launcher
+        launcher.init_tpu_workload(env={TRACEPARENT_ENV: tp_env})
+        launcher_spans = trace.DEFAULT_RING.spans(trace_id=trace_id)
+        assert any(s["name"] == "launcher.init_tpu_workload"
+                   for s in launcher_spans), launcher_spans
+        print("launcher shim span joined the controller's trace")
+
+        # ---- assertion 2: /debug/traces is Perfetto-loadable ------------
+        doc = json.loads(http_get(
+            f"http://127.0.0.1:{http_port}/debug/traces"
+            f"?trace_id={trace_id}", timeout=10))
+        complete = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = {e["name"] for e in complete}
+        assert "plugin.prepare" in names, names
+        assert "prepare.select_devices" in names, names
+        for e in complete:
+            assert {"name", "ph", "ts", "dur", "pid", "tid",
+                    "args"} <= set(e)
+            assert e["args"]["trace_id"] == trace_id
+        meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        assert any(e["args"].get("name") == "tpu-kubelet-plugin"
+                   for e in meta), meta
+        print(f"/debug/traces serves {len(complete)} spans of the trace "
+              f"(Chrome trace JSON, names: {sorted(names)})")
+
+        # ---- assertion 3: workqueue metrics under scripted load ---------
+        load = scripted_workqueue_load()
+        body = DEFAULT_REGISTRY.expose()
+
+        def val(name, frag):
+            for line in body.splitlines():
+                if line.startswith(name) and frag in line:
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"{name}{{{frag}}} missing from /metrics")
+
+        # served over HTTP exactly as the controller binary does
+        from tpu_dra.util.metrics import serve_http_endpoint
+        msrv = serve_http_endpoint("127.0.0.1", 0)
+        try:
+            http_body = http_get(
+                f"http://127.0.0.1:{msrv.server_address[1]}/metrics")
+        finally:
+            msrv.shutdown()
+        for metric in ("tpu_dra_workqueue_depth",
+                       "tpu_dra_workqueue_queue_duration_seconds",
+                       "tpu_dra_workqueue_work_duration_seconds",
+                       "tpu_dra_workqueue_retries_total"):
+            assert metric in http_body, f"{metric} missing from /metrics"
+        assert val("tpu_dra_workqueue_depth", 'queue="drive-load"') == 0.0
+        processed = val("tpu_dra_workqueue_queue_duration_seconds_count",
+                        'queue="drive-load"')
+        retries = val("tpu_dra_workqueue_retries_total",
+                      'queue="drive-load"')
+        worked = val("tpu_dra_workqueue_work_duration_seconds_count",
+                     'queue="drive-load"')
+        assert retries == load["expected_retries"], (retries, load)
+        assert processed == worked == load["items"] + retries
+        # the controller's own queue reported too
+        assert val("tpu_dra_workqueue_queue_duration_seconds_count",
+                   'queue="slice-domain-controller"') >= 1.0
+        print(f"workqueue metrics correct under load: "
+              f"{int(processed)} processed, {int(retries)} retries")
+
+        ctrl.stop()
+        out = {
+            "trace_id": trace_id,
+            "chain": ["controller.reconcile (in-process)",
+                      "RCT spec.metadata annotation",
+                      "ResourceClaim annotation",
+                      "plugin.prepare (real binary, gRPC)",
+                      f"CDI {TRACEPARENT_ENV} env",
+                      "launcher.init_tpu_workload"],
+            "debug_traces_spans": sorted(names),
+            "workqueue": {"processed": processed, "retries": retries},
+        }
+        print(json.dumps(out))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        print("DRIVE_TRACE_OK")
+        return 0
+    finally:
+        if plugin is not None:
+            plugin.terminate()
+            try:
+                plugin.wait(10)
+            except subprocess.TimeoutExpired:
+                plugin.kill()
+                plugin.wait(5)
+        srv.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

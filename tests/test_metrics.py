@@ -1,5 +1,6 @@
 """Metrics registry / exposition-format tests (util/metrics.py)."""
 
+import urllib.error
 import urllib.request
 
 import pytest
@@ -134,6 +135,67 @@ def test_serve_from_flag_validation():
     assert serve_from_flag("") is None
     with pytest.raises(ValueError, match="expected host:port"):
         serve_from_flag("no-port")
+
+
+def test_exposition_escapes_label_values_and_help():
+    """Label values containing ``"``, ``\\``, or newline must escape per
+    the text exposition format, or the whole scrape is unparseable."""
+    reg = Registry()
+    c = reg.counter("esc_total", 'help with \\ backslash\nand newline',
+                    labels=("err",))
+    c.inc('quote " backslash \\ newline \n end')
+    h = reg.histogram("esc_seconds", "h", buckets=(1.0,), labels=("err",))
+    h.observe(0.5, 'a"b\\c\nd')
+    text = reg.expose()
+    assert 'err="quote \\" backslash \\\\ newline \\n end"' in text
+    assert "# HELP esc_total help with \\\\ backslash\\nand newline" in text
+    assert 'esc_seconds_bucket{err="a\\"b\\\\c\\nd",le="1.0"} 1' in text
+    # every quote inside a label value is escaped: stripping the \" and
+    # \\ escapes must leave exactly the two value delimiters
+    for line in text.splitlines():
+        if line.startswith("esc_total{"):
+            bare = line.replace('\\\\', "").replace('\\"', "")
+            assert bare.count('"') == 2, line
+
+
+def test_profile_requests_serialized_with_409():
+    """Concurrent /debug/pprof/profile requests: exactly one samples, the
+    loser gets 409 (each would otherwise spin its own sampler loop)."""
+    import threading
+
+    reg = Registry()
+    server = serve_http_endpoint("127.0.0.1", 0, registry=reg)
+    port = server.server_address[1]
+    url = f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=1&hz=50"
+    codes = []
+    codes_mu = threading.Lock()
+
+    def fetch():
+        try:
+            resp = urllib.request.urlopen(url, timeout=10)
+            code, body = resp.status, resp.read()
+        except urllib.error.HTTPError as exc:
+            code, body = exc.code, exc.read()
+        with codes_mu:
+            codes.append((code, body))
+
+    threads = [threading.Thread(target=fetch) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+    finally:
+        server.shutdown()
+    got = sorted(c for c, _ in codes)
+    assert got.count(200) >= 1
+    assert got.count(409) >= 1
+    assert set(got) <= {200, 409}
+    for code, body in codes:
+        if code == 200:
+            assert body.startswith(b"# cpu profile:")
+        else:
+            assert b"already running" in body
 
 
 def test_cpu_profile_endpoint():

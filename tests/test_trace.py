@@ -1,0 +1,437 @@
+"""Distributed-tracing tests (tpu_dra/trace, ISSUE 3): traceparent
+round-trips, automatic parenting, sampling, exporters, the
+``/debug/traces`` endpoint, workqueue span propagation, and the
+cross-process (controller → plugin prepare → launcher) continuation."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.trace import (
+    DEFAULT_RING,
+    JsonlExporter,
+    RingBufferExporter,
+    SpanContext,
+    TRACEPARENT_ANNOTATION,
+    TRACEPARENT_ENV,
+    Tracer,
+    chrome_trace,
+    current_span,
+    current_traceparent,
+    propagation,
+)
+from tpu_dra.trace import start_span as default_start_span
+
+# DRA-core fast lane (`make test-core`, -m core): this module covers the
+# driver machinery itself, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+def make_tracer(ratio=1.0, service="test"):
+    ring = RingBufferExporter(256)
+    return Tracer(service=service, sample_ratio=ratio,
+                  exporters=(ring,)), ring
+
+
+# -------------------------------------------------------------------------
+# SpanContext / traceparent
+# -------------------------------------------------------------------------
+
+
+def test_traceparent_round_trip():
+    ctx = SpanContext(trace_id="ab" * 16, span_id="cd" * 8, sampled=True)
+    assert ctx.to_traceparent() == TP
+    back = SpanContext.from_traceparent(TP)
+    assert back == ctx
+    unsampled = SpanContext(trace_id="ab" * 16, span_id="cd" * 8,
+                            sampled=False)
+    assert unsampled.to_traceparent().endswith("-00")
+    assert SpanContext.from_traceparent(
+        unsampled.to_traceparent()).sampled is False
+
+
+@pytest.mark.parametrize("header", [
+    None,
+    "",
+    "garbage",
+    "00-abc-def-01",                              # short ids
+    "00-" + "ab" * 16 + "-" + "cd" * 8,           # missing flags
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",   # version ff is invalid
+    "00-" + "00" * 16 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "00" * 8 + "-01",   # all-zero span id
+    "00-" + "GG" * 16 + "-" + "cd" * 8 + "-01",   # non-hex
+    "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extra",  # v00 = exactly 4
+    "zz-" + "ab" * 16 + "-" + "cd" * 8 + "-01",   # non-hex version
+])
+def test_traceparent_malformed_rejected(header):
+    assert SpanContext.from_traceparent(header) is None
+
+
+def test_traceparent_future_version_accepted_with_extra_fields():
+    ctx = SpanContext.from_traceparent(
+        "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01-future-stuff")
+    assert ctx is not None and ctx.trace_id == "ab" * 16
+
+
+# -------------------------------------------------------------------------
+# Tracer: parenting, errors, sampling
+# -------------------------------------------------------------------------
+
+
+def test_nested_spans_parent_automatically():
+    tracer, ring = make_tracer()
+    with tracer.start_span("outer") as outer:
+        assert current_span() is outer
+        with tracer.start_span("inner") as inner:
+            assert inner.context.trace_id == outer.context.trace_id
+            assert inner.parent_id == outer.context.span_id
+            assert current_traceparent() == inner.context.to_traceparent()
+        assert current_span() is outer
+    assert current_span() is None
+    assert current_traceparent() == ""
+    names = [s["name"] for s in ring.spans()]
+    assert names == ["inner", "outer"]   # children end first
+
+
+def test_explicit_parent_forms_accepted():
+    tracer, ring = make_tracer()
+    with tracer.start_span("a", parent=TP) as a:
+        assert a.context.trace_id == "ab" * 16
+        assert a.parent_id == "cd" * 8
+    ctx = SpanContext(trace_id="12" * 16, span_id="34" * 8)
+    with tracer.start_span("b", parent=ctx) as b:
+        assert b.context.trace_id == "12" * 16
+    with tracer.start_span("c", parent="not-a-traceparent") as c:
+        assert c.parent_id == ""   # garbage header → new root, not a crash
+
+
+def test_exception_recorded_and_reraised():
+    tracer, ring = make_tracer()
+    with pytest.raises(RuntimeError, match="boom"):
+        with tracer.start_span("failing"):
+            raise RuntimeError("boom")
+    [span] = ring.spans()
+    assert span["status"] == "error"
+    assert "boom" in span["attributes"]["error"]
+    assert current_span() is None   # contextvar restored on the error path
+
+
+def test_sampling_zero_exports_nothing_and_children_inherit():
+    tracer, ring = make_tracer(ratio=0.0)
+    with tracer.start_span("root") as root:
+        assert root.context.sampled is False
+        with tracer.start_span("child") as child:
+            assert child.context.sampled is False
+        # the decision still travels on the wire for downstream processes
+        assert root.context.to_traceparent().endswith("-00")
+    assert ring.spans() == []
+
+
+def test_sampling_decision_is_deterministic_in_trace_id():
+    tracer, _ = make_tracer(ratio=0.5)
+    # the same trace id must sample identically across processes: parse
+    # the id back through a second tracer at the same ratio
+    other = Tracer(service="other", sample_ratio=0.5)
+    for _ in range(32):
+        with tracer.start_span("root") as root:
+            pass
+        with other.start_span("remote",
+                              parent=root.context.to_traceparent()) as r:
+            assert r.context.sampled == root.context.sampled
+
+
+def test_sampled_parent_decision_wins_over_local_ratio():
+    tracer, ring = make_tracer(ratio=0.0)
+    with tracer.start_span("child", parent=TP) as child:
+        assert child.context.sampled is True   # parent said sampled
+    assert len(ring.spans()) == 1
+
+
+# -------------------------------------------------------------------------
+# Exporters + chrome trace JSON
+# -------------------------------------------------------------------------
+
+
+def test_ring_buffer_bounded_and_filterable():
+    ring = RingBufferExporter(capacity=8)
+    for i in range(20):
+        ring.export({"trace_id": f"t{i % 2}", "name": f"s{i}"})
+    assert len(ring) == 8
+    t0 = ring.spans(trace_id="t0")
+    assert t0 and all(s["trace_id"] == "t0" for s in t0)
+    ring.clear()
+    assert ring.spans() == []
+
+
+def test_jsonl_exporter_appends_parseable_lines(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tracer = Tracer(service="jl", exporters=(JsonlExporter(str(path)),))
+    with tracer.start_span("one"):
+        pass
+    with tracer.start_span("two"):
+        pass
+    lines = path.read_text().strip().splitlines()
+    assert [json.loads(ln)["name"] for ln in lines] == ["one", "two"]
+
+
+def test_chrome_trace_is_perfetto_shaped():
+    tracer, ring = make_tracer(service="svc-a")
+    with tracer.start_span("parent", attributes={"claim": "u1"}):
+        with tracer.start_span("child"):
+            time.sleep(0.001)
+    doc = chrome_trace(ring.spans())
+    # round-trips through JSON (what /debug/traces serves)
+    doc = json.loads(json.dumps(doc))
+    events = doc["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {e["name"] for e in meta} >= {"process_name", "thread_name"}
+    assert any(e["args"]["name"] == "svc-a" for e in meta)
+    assert len(complete) == 2
+    for e in complete:
+        assert e["ts"] > 0 and e["dur"] > 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["args"]["trace_id"]
+    child = next(e for e in complete if e["name"] == "child")
+    parent = next(e for e in complete if e["name"] == "parent")
+    assert child["args"]["parent_id"] == parent["args"]["span_id"]
+    assert child["args"]["trace_id"] == parent["args"]["trace_id"]
+
+
+# -------------------------------------------------------------------------
+# klog integration
+# -------------------------------------------------------------------------
+
+
+def test_klog_lines_carry_trace_ids_and_utc_ms_timestamps(capsys):
+    import logging
+    import re
+
+    from tpu_dra.util import klog
+
+    klog.configure()   # install the stderr handler + DEBUG level first
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    klog._logger.addHandler(handler)
+    try:
+        tracer, _ = make_tracer()
+        with tracer.start_span("logging") as span:
+            klog.info("inside", x=1)
+        klog.info("outside")
+    finally:
+        klog._logger.removeHandler(handler)
+    inside, outside = records[-2], records[-1]
+    assert f"trace_id='{span.context.trace_id}'" in inside
+    assert f"span_id='{span.context.span_id}'" in inside
+    assert "trace_id" not in outside
+    # I2026-08-02T12:34:56.789Z — UTC, millisecond precision, zone marker
+    assert re.match(
+        r"^I\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z ", inside)
+
+
+# -------------------------------------------------------------------------
+# propagation helpers
+# -------------------------------------------------------------------------
+
+
+def test_stamp_and_extract_annotation():
+    tracer, _ = make_tracer()
+    obj = {"metadata": {"name": "x"}}
+    assert propagation.extract(obj) is None
+    propagation.stamp(obj)             # outside any span: no-op
+    assert "annotations" not in obj["metadata"]
+    with tracer.start_span("reconcile") as span:
+        propagation.stamp(obj)
+    ctx = propagation.extract(obj)
+    assert ctx is not None and ctx.trace_id == span.context.trace_id
+    assert obj["metadata"]["annotations"][TRACEPARENT_ANNOTATION] == \
+        span.context.to_traceparent()
+
+
+def test_stamp_template_reaches_spec_metadata():
+    tracer, _ = make_tracer()
+    rct = {"metadata": {"name": "t"}, "spec": {"spec": {}}}
+    with tracer.start_span("reconcile") as span:
+        propagation.stamp_template(rct)
+    inherited = rct["spec"]["metadata"]["annotations"][
+        TRACEPARENT_ANNOTATION]
+    assert SpanContext.from_traceparent(inherited).trace_id == \
+        span.context.trace_id
+
+
+def test_stamp_env_does_not_clobber_and_extract_env_round_trips():
+    tracer, _ = make_tracer()
+    env = {}
+    with tracer.start_span("prepare"):
+        propagation.stamp_env(env)
+        first = env[TRACEPARENT_ENV]
+    with tracer.start_span("another"):
+        propagation.stamp_env(env)
+    assert env[TRACEPARENT_ENV] == first     # first writer wins
+    ctx = propagation.extract_env(env)
+    assert ctx is not None and ctx.to_traceparent() == first
+    assert propagation.extract_env({}) is None
+
+
+# -------------------------------------------------------------------------
+# workqueue propagation + metrics (see also test_workqueue.py)
+# -------------------------------------------------------------------------
+
+
+def test_workqueue_continues_the_enqueuers_trace():
+    from tpu_dra.util.workqueue import WorkQueue
+
+    q = WorkQueue("trace-q")
+    q.run_in_background()
+    seen = {}
+    done = threading.Event()
+
+    def work(_obj):
+        seen["traceparent"] = current_traceparent()
+        done.set()
+
+    tracer, _ = make_tracer()
+    with tracer.start_span("producer") as producer:
+        q.enqueue(work, {"x": 1})
+    assert done.wait(5)
+    q.shutdown()
+    ctx = SpanContext.from_traceparent(seen["traceparent"])
+    # worker ran on another thread, same trace, parented under producer
+    assert ctx.trace_id == producer.context.trace_id
+
+
+# -------------------------------------------------------------------------
+# cross-process propagation, in-process: controller stamp → plugin
+# prepare → launcher continuation, one trace id throughout
+# -------------------------------------------------------------------------
+
+
+def test_claim_annotation_flows_to_cdi_env_and_launcher(tmp_path):
+    from tests.test_device_state import make_claim, make_state
+    from tpu_dra.workloads import launcher
+
+    state = make_state(tmp_path)
+    claim = make_claim()
+    # the "controller": a root span stamped onto the claim (the claim
+    # inherits it from the workload RCT's spec.metadata in the real flow)
+    tracer, _ = make_tracer(service="controller")
+    with tracer.start_span("controller.reconcile"):
+        propagation.stamp(claim)
+        trace_id = current_span().context.trace_id
+    # the "kubelet plugin": prepare extracts the annotation via the
+    # driver span; here DeviceState runs under an explicitly-parented
+    # span exactly as TpuDriver._node_prepare does
+    with tracer.start_span("plugin.prepare",
+                           parent=propagation.extract(claim)) as prep:
+        state.prepare(claim)
+    spec = json.load(open(state.cdi.claim_spec_path(claim["metadata"]
+                                                    ["uid"])))
+    env_list = spec["devices"][0]["containerEdits"]["env"]
+    tp = next(e.split("=", 1)[1] for e in env_list
+              if e.startswith(TRACEPARENT_ENV + "="))
+    assert SpanContext.from_traceparent(tp).trace_id == trace_id
+    # the container continues from plugin.prepare itself, not from a
+    # short-lived phase child like prepare.select_devices
+    assert SpanContext.from_traceparent(tp).span_id == \
+        prep.context.span_id
+    # the "launcher": init continues the same trace from the env
+    ring = RingBufferExporter(64)
+    import tpu_dra.trace.tracer as tracer_mod
+    old = tracer_mod._DEFAULT
+    tracer_mod._DEFAULT = Tracer(service="launcher", exporters=(ring,))
+    try:
+        launcher.init_tpu_workload(env={TRACEPARENT_ENV: tp})
+    finally:
+        tracer_mod._DEFAULT = old
+    [span] = ring.spans()
+    assert span["name"] == "launcher.init_tpu_workload"
+    assert span["trace_id"] == trace_id
+
+
+def test_controller_reconcile_stamps_children(tmp_path):
+    """Real controller against FakeKube: the DaemonSet and both RCTs all
+    carry a traceparent of ONE trace, and the workload RCT carries it in
+    spec.metadata (the claim-inheritance half of the contract)."""
+    from tests.test_controller import make_domain, wait_until
+    from tpu_dra.controller.constants import daemon_rct_name, ds_name
+    from tpu_dra.controller.controller import Controller, ControllerConfig
+    from tpu_dra.k8s.client import (
+        DAEMONSETS,
+        NotFound,
+        RESOURCE_CLAIM_TEMPLATES,
+    )
+    from tpu_dra.k8s.fake import FakeKube
+
+    kube = FakeKube()
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600))
+    ctrl.start()
+    try:
+        created = make_domain(kube)
+        uid = created["metadata"]["uid"]
+
+        def _exists(res, name, ns):
+            try:
+                kube.get(res, name, ns)
+                return True
+            except NotFound:
+                return False
+
+        assert wait_until(lambda: _exists(
+            DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver"))
+        assert wait_until(lambda: _exists(
+            RESOURCE_CLAIM_TEMPLATES, "dom-channel", "team-a"))
+        ds = kube.get(DAEMONSETS, ds_name("dom", uid), "tpu-dra-driver")
+        drct = kube.get(RESOURCE_CLAIM_TEMPLATES,
+                        daemon_rct_name("dom", uid), "tpu-dra-driver")
+        wrct = kube.get(RESOURCE_CLAIM_TEMPLATES, "dom-channel", "team-a")
+        ctxs = [propagation.extract(o) for o in (ds, drct, wrct)]
+        assert all(c is not None for c in ctxs)
+        assert len({c.trace_id for c in ctxs}) == 1
+        claim_ctx = SpanContext.from_traceparent(
+            wrct["spec"]["metadata"]["annotations"][TRACEPARENT_ANNOTATION])
+        assert claim_ctx.trace_id == ctxs[0].trace_id
+    finally:
+        ctrl.stop()
+        kube.close_watchers()
+
+
+# -------------------------------------------------------------------------
+# /debug/traces endpoint
+# -------------------------------------------------------------------------
+
+
+def test_debug_traces_serves_chrome_json_with_filter():
+    from tpu_dra.util.metrics import Registry, serve_http_endpoint
+
+    with default_start_span("endpoint-span-a") as a:
+        pass
+    with default_start_span("endpoint-span-b"):
+        pass
+    server = serve_http_endpoint("127.0.0.1", 0, registry=Registry())
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces", timeout=5).read()
+        doc = json.loads(body)
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert {"endpoint-span-a", "endpoint-span-b"} <= names
+        filtered = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces"
+            f"?trace_id={a.context.trace_id}", timeout=5).read())
+        fnames = {e["name"] for e in filtered["traceEvents"]
+                  if e["ph"] == "X"}
+        assert "endpoint-span-a" in fnames
+        assert "endpoint-span-b" not in fnames
+        assert all(e["args"]["trace_id"] == a.context.trace_id
+                   for e in filtered["traceEvents"] if e["ph"] == "X")
+    finally:
+        server.shutdown()
+        # keep the shared ring clean for other tests in this process
+        DEFAULT_RING.clear()

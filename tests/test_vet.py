@@ -35,7 +35,8 @@ pytestmark = pytest.mark.core
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
-                   "string-constant-drift", "exception-hygiene"}
+                   "string-constant-drift", "exception-hygiene",
+                   "metric-hygiene"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,
@@ -57,7 +58,7 @@ def checks_fired(diags) -> set[str]:
 # -------------------------------------------------------------------------
 
 
-def test_registry_has_the_five_repo_checkers():
+def test_registry_has_the_repo_checkers():
     names = {a.name for a in all_analyzers()}
     assert EXPECTED_CHECKS <= names
 
@@ -454,6 +455,79 @@ def test_exception_hygiene_clean_patterns_pass(tmp_path):
 def test_exception_hygiene_skips_test_files(tmp_path):
     assert vet_snippet(tmp_path, "tpu_dra/util/test_eh.py",
                        _EXC_BAD, checks=["exception-hygiene"]) == []
+
+
+# -------------------------------------------------------------------------
+# metric-hygiene
+# -------------------------------------------------------------------------
+
+_METRIC_BAD = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY, Counter
+
+_direct = Counter("tpu_dra_rogue_total", "never reaches /metrics")
+
+_unprefixed = DEFAULT_REGISTRY.counter(
+    "prepare_seconds_total", "driver prepare latency")
+
+_helpless = DEFAULT_REGISTRY.gauge("tpu_dra_depth", "")
+"""
+
+_METRIC_CLEAN = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_reqs = DEFAULT_REGISTRY.counter(
+    "tpu_dra_requests_total", "requests served", labels=("code",))
+
+_lat = DEFAULT_REGISTRY.histogram(
+    "tpu_dra_request_seconds", "request latency")
+
+
+def series_for(counters):
+    # not a registry: .counter on arbitrary receivers is out of scope
+    return counters.counter("whatever", 1)
+"""
+
+
+def test_metric_hygiene_flags_direct_unprefixed_and_helpless(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/mh.py", _METRIC_BAD,
+                        checks=["metric-hygiene"])
+    msgs = "\n".join(d.message for d in diags)
+    assert len(diags) == 3, diags
+    assert "constructed directly" in msgs
+    assert "must match tpu_dra_" in msgs
+    assert "non-empty help" in msgs
+
+
+def test_metric_hygiene_clean_registrations_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/mh2.py", _METRIC_CLEAN,
+                       checks=["metric-hygiene"]) == []
+
+
+def test_metric_hygiene_ignores_collections_counter(tmp_path):
+    src = ("from collections import Counter\n\n\n"
+           "def letters(word):\n"
+           "    return Counter(\"abracadabra\") + Counter(word)\n")
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/mh3.py", src,
+                       checks=["metric-hygiene"]) == []
+
+
+def test_metric_hygiene_skips_owner_module_and_tests(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/util/metrics.py", _METRIC_BAD,
+                       checks=["metric-hygiene"]) == []
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/test_mh.py",
+                       _METRIC_BAD, checks=["metric-hygiene"]) == []
+
+
+def test_metric_hygiene_real_driver_metrics_conform():
+    """Every series the driver fleet actually registers passes the
+    contract — the live complement of the fixture tests (workqueue,
+    informer, health, plugin metrics all go through DEFAULT_REGISTRY)."""
+    diags = run_paths([os.path.join(REPO_ROOT, "tpu_dra", "util"),
+                       os.path.join(REPO_ROOT, "tpu_dra", "k8s"),
+                       os.path.join(REPO_ROOT, "tpu_dra", "health"),
+                       os.path.join(REPO_ROOT, "tpu_dra", "plugins")],
+                      checks=["metric-hygiene"])
+    assert diags == [], "\n".join(str(d) for d in diags)
 
 
 # -------------------------------------------------------------------------

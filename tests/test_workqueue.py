@@ -108,3 +108,109 @@ def test_backoff_grows_and_forgets():
     assert b.when("k") == 0.04
     b.forget("k")
     assert b.when("k") == 0.01
+
+
+# -------------------------------------------------------------------------
+# workqueue metrics (ISSUE 3): the client-go instrumentation set
+# -------------------------------------------------------------------------
+
+
+def expose():
+    from tpu_dra.util.metrics import DEFAULT_REGISTRY
+    return DEFAULT_REGISTRY.expose()
+
+
+def series_value(text, name, label_frag):
+    """Value of the first exposition line for ``name{...label_frag...}``."""
+    for line in text.splitlines():
+        if line.startswith(name) and label_frag in line:
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def test_metrics_depth_under_load_and_zero_after_drain():
+    q = WorkQueue("mq-depth")
+    gate = threading.Event()
+    started = threading.Event()
+
+    def blocker(_obj):
+        started.set()
+        gate.wait(5)
+
+    for i in range(5):
+        q.enqueue(blocker, i, key=f"k{i}")
+    q.run_in_background()
+    assert started.wait(2)
+    # 1 item processing, 4 still queued: the depth gauge counts waiters
+    depth = series_value(expose(), "tpu_dra_workqueue_depth",
+                         'queue="mq-depth"')
+    assert depth == 4.0
+    gate.set()
+    assert q.drain(5)
+    assert series_value(expose(), "tpu_dra_workqueue_depth",
+                        'queue="mq-depth"') == 0.0
+    q.shutdown()
+
+
+def test_metrics_queue_and_work_durations_counted():
+    q = WorkQueue("mq-durations")
+    q.run_in_background()
+    for i in range(7):
+        q.enqueue(lambda obj: time.sleep(0.001), i, key=f"k{i}")
+    assert q.drain(5)
+    q.shutdown()
+    text = expose()
+    assert series_value(
+        text, "tpu_dra_workqueue_queue_duration_seconds_count",
+        'queue="mq-durations"') == 7.0
+    assert series_value(
+        text, "tpu_dra_workqueue_work_duration_seconds_count",
+        'queue="mq-durations"') == 7.0
+    # work took >= 7ms in total; queue time is real but small
+    assert series_value(
+        text, "tpu_dra_workqueue_work_duration_seconds_sum",
+        'queue="mq-durations"') >= 0.007
+
+
+def test_metrics_retries_counted_and_survive_drain():
+    q = WorkQueue("mq-retries",
+                  backoff=ItemExponentialBackoff(base=0.002, cap=0.02))
+    q.run_in_background()
+    attempts = []
+
+    def flaky(obj):
+        attempts.append(obj)
+        if len(attempts) < 4:
+            raise RuntimeError("transient")
+
+    q.enqueue(flaky, "x", key="k")
+    assert q.drain(5)
+    q.shutdown()
+    assert len(attempts) == 4
+    assert series_value(expose(), "tpu_dra_workqueue_retries_total",
+                        'queue="mq-retries"') == 3.0
+
+
+def test_metrics_permanent_failures_by_reason():
+    q = WorkQueue("mq-perm",
+                  backoff=ItemExponentialBackoff(base=0.002, cap=0.02))
+    q.run_in_background()
+    errors = []
+    q.enqueue_with_deadline(
+        lambda obj: (_ for _ in ()).throw(PermanentError("nope")),
+        "x", timeout=5.0, key="p", on_error=errors.append)
+    q.enqueue_with_deadline(
+        lambda obj: (_ for _ in ()).throw(RuntimeError("still failing")),
+        "y", timeout=0.03, key="d", on_error=errors.append)
+    deadline = time.monotonic() + 5
+    while len(errors) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    q.shutdown()
+    assert len(errors) == 2
+    text = expose()
+    assert series_value(
+        text, "tpu_dra_workqueue_permanent_failures_total",
+        'queue="mq-perm",reason="permanent"') == 1.0
+    assert series_value(
+        text, "tpu_dra_workqueue_permanent_failures_total",
+        'queue="mq-perm",reason="deadline"') == 1.0

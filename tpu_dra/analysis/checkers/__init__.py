@@ -11,5 +11,6 @@ from tpu_dra.analysis.checkers import (  # noqa: F401
     excepts,
     guardedby,
     jitpurity,
+    metrichygiene,
     reconcile,
 )

@@ -1,0 +1,133 @@
+"""metric-hygiene: Prometheus series follow the repo's naming contract.
+
+Dashboards, alerts, and the BENCH tooling key on metric names; a series
+that silently appears as ``prepare_seconds`` instead of
+``tpu_dra_prepare_seconds`` (or with an empty HELP line) is invisible to
+every existing query and unexplained to every operator.  Three rules,
+checked over registry registration calls in non-test ``tpu_dra/`` code:
+
+1. metric names passed to ``.counter()`` / ``.gauge()`` /
+   ``.histogram()`` on a registry must match ``tpu_dra_[a-z0-9_]+``
+   (lowercase, driver-prefixed — the Prometheus naming convention);
+2. the help text argument must be a non-empty string;
+3. the metric classes (``Counter``/``Gauge``/``Histogram`` *imported
+   from* ``util/metrics`` — ``collections.Counter`` is not ours) must
+   not be constructed directly outside ``util/metrics.py``: direct
+   construction bypasses the :class:`~tpu_dra.util.metrics.Registry`'s
+   idempotence/conflict checks AND never reaches ``/metrics``.
+
+Deliberately-unprefixed series (e.g. the native coordd's hand-rolled
+``coordd_*`` drop-in exposition) are not registry calls and are out of
+scope; a genuinely-exempt call site carries
+``# vet: ignore[metric-hygiene]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_NAME_RE = re.compile(r"^tpu_dra_[a-z0-9_]+$")
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+# the registry implementation itself registers nothing and legitimately
+# constructs the metric classes
+_OWNER = "tpu_dra/util/metrics.py"
+
+
+def _receiver_is_registry(node: ast.expr) -> bool:
+    """Heuristic receiver filter: ``DEFAULT_REGISTRY.counter``,
+    ``reg.gauge``, ``self._registry.histogram``, ... — anything whose
+    final identifier mentions a registry.  Keeps unrelated ``.counter``
+    attributes (e.g. ``collections.Counter`` instances) out of scope."""
+    name = ""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    low = name.lower()
+    return "registry" in low or low in ("reg", "registry")
+
+
+def _literal_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _metric_class_imports(tree: ast.AST) -> set[str]:
+    """Local names bound to Counter/Gauge/Histogram via
+    ``from tpu_dra.util.metrics import …`` — rule 3 only fires on these,
+    so ``collections.Counter("abracadabra")`` is never a finding."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == "tpu_dra.util.metrics":
+            for alias in node.names:
+                if alias.name in _METRIC_CLASSES:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.path.endswith(_OWNER):
+        return []
+    metric_classes = _metric_class_imports(ctx.tree)
+    diags: list[Diagnostic] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        # rule 3: direct metric construction (of the classes this module
+        # imported from util/metrics — collections.Counter is not ours)
+        if isinstance(fn, ast.Name) and fn.id in metric_classes and \
+                node.args and _literal_str(node.args[0]) is not None:
+            diags.append(ctx.diag(
+                node, "metric-hygiene",
+                f"{fn.id}(...) constructed directly: register through "
+                f"DEFAULT_REGISTRY (util/metrics.py) so the series is "
+                f"deduplicated, conflict-checked, and actually exposed "
+                f"on /metrics"))
+            continue
+        # rules 1+2: registry registration calls
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _REGISTRY_METHODS
+                and _receiver_is_registry(fn.value)):
+            continue
+        if not node.args:
+            continue
+        name = _literal_str(node.args[0])
+        if name is not None and not _NAME_RE.match(name):
+            diags.append(ctx.diag(
+                node, "metric-hygiene",
+                f"metric name {name!r} must match tpu_dra_[a-z0-9_]+ "
+                f"(lowercase, driver-prefixed) so dashboards and alerts "
+                f"can find it"))
+        help_node = None
+        if len(node.args) >= 2:
+            help_node = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg in ("help_", "help"):
+                    help_node = kw.value
+        help_text = _literal_str(help_node) if help_node is not None \
+            else None
+        if help_node is None or (help_text is not None
+                                 and not help_text.strip()):
+            diags.append(ctx.diag(
+                node, "metric-hygiene",
+                f"metric {name or '<dynamic>'!r} needs non-empty help "
+                f"text — the HELP line is the only documentation an "
+                f"operator sees on /metrics"))
+    return diags
+
+
+register(Analyzer(
+    name="metric-hygiene",
+    doc="registry metric names must match tpu_dra_[a-z0-9_]+ with "
+        "non-empty help text; no direct Counter/Gauge/Histogram "
+        "construction outside util/metrics.py",
+    run=_run,
+))

@@ -31,6 +31,7 @@ from tpu_dra.k8s.client import (
     TPU_SLICE_DOMAINS,
 )
 from tpu_dra.k8s.informer import Informer, label_index
+from tpu_dra.trace import propagation
 from tpu_dra.util import klog
 from tpu_dra.util.template import render_yaml
 
@@ -72,6 +73,9 @@ class DaemonSetManager:
             "DAEMON_CLAIM_TEMPLATE_NAME":
                 daemon_rct_name(domain.name, domain.uid),
         })
+        # created objects carry the reconcile span's context so the node
+        # side can join the trace (propagation contract, trace/propagation)
+        propagation.stamp(obj)
         try:
             created = self.kube.create(DAEMONSETS, obj)
         except Conflict:

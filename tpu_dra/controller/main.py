@@ -36,9 +36,11 @@ def main(argv=None) -> int:
     args = flags.parse(
         "tpu-slice-controller",
         [controller_flags(), flags.kube_client_flags(),
-         flags.logging_flags()],
+         flags.logging_flags(), flags.tracing_flags()],
         argv, description=__doc__)
     klog.configure(args.v, args.logging_format)
+    from tpu_dra import trace
+    trace.configure_from_args(args, service="tpu-slice-controller")
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
     if metrics.serve_from_flag(args.http_endpoint,

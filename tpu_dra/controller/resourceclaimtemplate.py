@@ -28,6 +28,7 @@ from tpu_dra.k8s.client import (
     NotFound,
     RESOURCE_CLAIM_TEMPLATES,
 )
+from tpu_dra.trace import propagation
 from tpu_dra.util import klog
 from tpu_dra.util.template import render_yaml
 
@@ -53,7 +54,10 @@ class BaseRCTManager:
 
     # -- shared lifecycle (resourceclaimtemplate.go:60-149) ----------------
     def create(self, domain: TpuSliceDomain) -> dict:
-        obj = self.render(domain)
+        # stamped into spec.metadata too: claims born from the template
+        # inherit the annotation, which is how the reconcile's trace
+        # reaches the kubelet plugin that prepares them
+        obj = propagation.stamp_template(self.render(domain))
         try:
             return self.kube.create(RESOURCE_CLAIM_TEMPLATES, obj)
         except Conflict:
@@ -149,7 +153,7 @@ class WorkloadRCTManager(BaseRCTManager):
             })
 
     def create(self, domain: TpuSliceDomain) -> dict:
-        obj = self.render(domain)
+        obj = propagation.stamp_template(self.render(domain))
         try:
             return self.kube.create(RESOURCE_CLAIM_TEMPLATES, obj)
         except Conflict:

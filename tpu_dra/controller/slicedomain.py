@@ -27,6 +27,7 @@ from tpu_dra.k8s.client import Conflict, KubeClient, NotFound, \
 from tpu_dra.k8s.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, \
     emit_event
 from tpu_dra.k8s.informer import Informer, uid_index
+from tpu_dra.trace import get_tracer, propagation
 from tpu_dra.util import klog
 from tpu_dra.util.workqueue import WorkQueue
 
@@ -77,8 +78,20 @@ class SliceDomainManager:
 
     # -- reconcile (computedomain.go:226-286) ------------------------------
     def on_add_or_update(self, obj: dict) -> None:
+        # the reconcile span is the TRACE ROOT of a domain's rollout
+        # (unless the object itself carries a traceparent annotation —
+        # drives/tests use that to pre-join a trace): everything the
+        # reconcile creates is stamped with a child context, which is
+        # what the kubelet plugins and the launcher later continue
+        meta = obj.get("metadata", {})
         try:
-            self._reconcile(obj)
+            with get_tracer().start_span(
+                    "controller.reconcile",
+                    parent=propagation.extract(obj),
+                    attributes={"domain": meta.get("name", ""),
+                                "namespace": meta.get("namespace", ""),
+                                "uid": meta.get("uid", "")}):
+                self._reconcile(obj)
         except BaseException:
             if self._reconciles is not None:
                 self._reconciles.inc("error")

@@ -29,6 +29,8 @@ from tpu_dra.daemon.process import ProcessManager
 from tpu_dra.health.monitor import HealthMonitor
 from tpu_dra.k8s.client import new_clients
 from tpu_dra.tpulib.discovery import RealTpuLib
+from tpu_dra.trace import configure as trace_configure, get_tracer
+from tpu_dra.trace.propagation import extract_env as _trace_parent
 from tpu_dra.util import klog
 from tpu_dra.util.fsutil import atomic_write
 
@@ -200,6 +202,9 @@ def run(argv=None) -> int:
     port = int(env.get("SLICE_COORDINATOR_PORT", "51000"))
     kubeconfig = env.get("KUBECONFIG", "")
     klog.configure(int(env.get("VERBOSITY", "2")))
+    trace_configure(service="slice-domain-daemon",
+                    sample_ratio=float(env.get("TRACE_SAMPLE_RATIO", "1")),
+                    jsonl_path=env.get("TRACE_FILE") or None)
 
     tpulib = RealTpuLib(
         driver_root=env.get("TPU_DRIVER_ROOT", "/"),
@@ -235,10 +240,20 @@ def run(argv=None) -> int:
             except queue.Empty:
                 continue
             try:
-                write_nodes_config(settings_dir, nodes, fabric)
-                klog.info("membership changed; restarting coordination "
-                          "service", members=len(nodes))
-                coordservice.restart()
+                # one span per full-membership barrier crossing, a child
+                # of the prepare that placed this daemon (TPU_TRACEPARENT
+                # from the slice plugin's daemon CDI edits): the gap
+                # between the claim trace's prepare and this span IS the
+                # time spent waiting for the other member nodes
+                with get_tracer().start_span(
+                        "daemon.coordination_update",
+                        parent=_trace_parent(),
+                        attributes={"domain": domain_uid,
+                                    "members": len(nodes)}):
+                    write_nodes_config(settings_dir, nodes, fabric)
+                    klog.info("membership changed; restarting coordination "
+                              "service", members=len(nodes))
+                    coordservice.restart()
             except Exception as exc:  # noqa: BLE001 — loop must survive
                 # (e.g. a spawn failure); the watchdog keeps retrying and
                 # the next membership change comes back through here

@@ -16,8 +16,26 @@ from typing import Any, Callable, Optional
 
 from tpu_dra.k8s.client import KubeClient, ResourceDesc
 from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
 
 IndexFunc = Callable[[dict], list[str]]
+
+
+def _informer_metrics() -> dict:
+    """Shared event-dispatch instrumentation (idempotent registry): how
+    many add/update/delete events each informer fans out, and how long
+    handlers hold the dispatch path (a slow handler stalls the watch
+    loop — this histogram is how you find it)."""
+    return {
+        "events": DEFAULT_REGISTRY.counter(
+            "tpu_dra_informer_events_total",
+            "informer events dispatched to handlers",
+            labels=("resource", "kind")),
+        "dispatch": DEFAULT_REGISTRY.histogram(
+            "tpu_dra_informer_dispatch_seconds",
+            "time one event spends in all handlers",
+            labels=("resource", "kind")),
+    }
 
 
 def uid_index(obj: dict) -> list[str]:
@@ -142,6 +160,7 @@ class Informer:
         # (VERDICT "What's weak" 6).
         self.resync_period = resync_period
         self._last_resync = 0.0
+        self._metrics = _informer_metrics()
         self._handlers: list[dict[str, Callable]] = []
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -156,15 +175,22 @@ class Informer:
             {"add": on_add, "update": on_update, "delete": on_delete})
 
     def _dispatch(self, kind: str, *args) -> None:
-        for h in self._handlers:
-            fn = h.get(kind)
-            if fn is None:
-                continue
-            try:
-                fn(*args)
-            except Exception:  # noqa: BLE001 — handlers must not kill the loop
-                klog.error("informer handler raised",
-                           resource=self.resource.plural, kind=kind)
+        self._metrics["events"].inc(self.resource.plural, kind)
+        t0 = time.monotonic()
+        try:
+            for h in self._handlers:
+                fn = h.get(kind)
+                if fn is None:
+                    continue
+                try:
+                    fn(*args)
+                except Exception:  # noqa: BLE001 — handlers must not kill
+                    # the loop
+                    klog.error("informer handler raised",
+                               resource=self.resource.plural, kind=kind)
+        finally:
+            self._metrics["dispatch"].observe(
+                time.monotonic() - t0, self.resource.plural, kind)
 
     def start(self) -> "Informer":
         self._thread = threading.Thread(

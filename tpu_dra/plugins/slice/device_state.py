@@ -28,6 +28,7 @@ from tpu_dra.cdi.spec import CDIHandler, ContainerEdits
 from tpu_dra.plugins.slice.slicedomain import NodeSliceDomainManager
 from tpu_dra.plugins.tpu.allocatable import PreparedClaim, PreparedDevice
 from tpu_dra.plugins.tpu.checkpoint import Checkpoint
+from tpu_dra.trace import get_tracer, propagation, start_span
 from tpu_dra.util import klog
 from tpu_dra.util.workqueue import PermanentError
 from tpu_dra.version import SLICE_DRIVER_NAME
@@ -76,13 +77,24 @@ class SliceDeviceState:
             existing = self.checkpoint.get(uid)
             if existing is not None:
                 return existing.devices
-            devices, edits = self._prepare_devices(claim)
-            self.cdi.create_claim_spec(uid, edits)
-            self.checkpoint.put(PreparedClaim(
-                claim_uid=uid,
-                namespace=claim["metadata"].get("namespace", ""),
-                name=claim["metadata"].get("name", ""),
-                devices=devices))
+            # continue the controller's trace (claim annotation inherited
+            # from the RCT); channel/daemon phase spans nest inside
+            with get_tracer().start_span(
+                    "plugin.prepare", parent=propagation.extract(claim),
+                    attributes={"claim": uid,
+                                "driver": SLICE_DRIVER_NAME}):
+                devices, edits = self._prepare_devices(claim)
+                # stamped AFTER the channel/daemon phase spans close, so
+                # the launcher/daemon continue from the plugin.prepare
+                # span, not a short-lived phase child
+                for e in edits.values():
+                    propagation.stamp_env(e.env)
+                self.cdi.create_claim_spec(uid, edits)
+                self.checkpoint.put(PreparedClaim(
+                    claim_uid=uid,
+                    namespace=claim["metadata"].get("namespace", ""),
+                    name=claim["metadata"].get("name", ""),
+                    devices=devices))
             return devices
 
     def unprepare(self, claim_uid: str) -> None:
@@ -201,14 +213,22 @@ class SliceDeviceState:
     def _apply_channel(self, claim_uid: str, claim_namespace: str,
                        domain_uid: str) -> ContainerEdits:
         """device_state.go:365-393 — the codependent-prepare sequence."""
-        self.manager.assert_domain_namespace(domain_uid, claim_namespace)
-        self.manager.add_node_label(domain_uid)
-        self.manager.assert_domain_ready(domain_uid)   # retried by caller
-        klog.info("channel prepared", level=4, claim=claim_uid,
-                  domain=domain_uid)
-        return self.manager.channel_edits(domain_uid)
+        with start_span("slice.channel_prepare",
+                        attributes={"claim": claim_uid,
+                                    "domain": domain_uid}):
+            self.manager.assert_domain_namespace(domain_uid,
+                                                 claim_namespace)
+            self.manager.add_node_label(domain_uid)
+            # the readiness barrier: raises until daemons on every member
+            # node are up, each raise = one retried (spanned) attempt
+            self.manager.assert_domain_ready(domain_uid)
+            klog.info("channel prepared", level=4, claim=claim_uid,
+                      domain=domain_uid)
+            return self.manager.channel_edits(domain_uid)
 
     def _apply_daemon(self, domain_uid: str) -> ContainerEdits:
         """device_state.go:395-448."""
-        self.manager.prepare_settings(domain_uid)
-        return self.manager.daemon_edits(domain_uid)
+        with start_span("slice.daemon_prepare",
+                        attributes={"domain": domain_uid}):
+            self.manager.prepare_settings(domain_uid)
+            return self.manager.daemon_edits(domain_uid)

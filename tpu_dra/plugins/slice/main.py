@@ -18,9 +18,11 @@ def main(argv=None) -> int:
     args = flags.parse(
         "slice-domain-kubelet-plugin",
         [flags.plugin_common_flags(), flags.kube_client_flags(),
-         flags.logging_flags()],
+         flags.logging_flags(), flags.tracing_flags()],
         argv, description=__doc__)
     klog.configure(args.v, args.logging_format)
+    from tpu_dra import trace
+    trace.configure_from_args(args, service="slice-domain-kubelet-plugin")
     from tpu_dra.util.metrics import serve_from_flag
     serve_from_flag(args.http_endpoint)
     kube = new_clients(args.kubeconfig, args.kube_api_qps,

@@ -35,6 +35,7 @@ from tpu_dra.plugins.tpu.allocatable import (
 from tpu_dra.plugins.tpu.checkpoint import Checkpoint
 from tpu_dra.plugins.tpu.sharing import MultiProcessManager, hbm_defense_env
 from tpu_dra.tpulib.discovery import TpuLib
+from tpu_dra.trace import propagation, start_span
 from tpu_dra.util import klog
 from tpu_dra.version import DRIVER_NAME
 
@@ -130,23 +131,33 @@ class DeviceState:
                 # unless a parseable spec is already in place.
                 if not self._claim_spec_intact(uid):
                     _, per_device_edits = self._prepare_devices(claim)
+                    self._stamp_trace_env(per_device_edits)
                     self.cdi.create_claim_spec(uid, per_device_edits)
                 return existing.devices
             try:
-                devices, per_device_edits = self._prepare_devices(claim)
+                # phase span: config mapping + device selection + health
+                # veto + sharing setup (nests under plugin.prepare)
+                with start_span("prepare.select_devices",
+                                attributes={"claim": uid}):
+                    devices, per_device_edits = self._prepare_devices(claim)
             except Exception:
                 # _group_edits may have created slot pools before a later
                 # group/overlap check failed; without a checkpoint entry
                 # unprepare would no-op, leaking them until restart
                 self.mp_manager.cleanup(uid)
                 raise
-            self.cdi.create_claim_spec(uid, per_device_edits)
+            self._stamp_trace_env(per_device_edits)
+            with start_span("prepare.cdi_spec_write",
+                            attributes={"claim": uid}):
+                self.cdi.create_claim_spec(uid, per_device_edits)
             prepared = PreparedClaim(
                 claim_uid=uid,
                 namespace=claim["metadata"].get("namespace", ""),
                 name=claim["metadata"].get("name", ""),
                 devices=devices)
-            self.checkpoint.put(prepared)
+            with start_span("prepare.checkpoint_write",
+                            attributes={"claim": uid}):
+                self.checkpoint.put(prepared)
             return devices
 
     def unprepare(self, claim_uid: str) -> None:
@@ -351,8 +362,10 @@ class DeviceState:
                 edits.env.update(hbm_defense_env(limits))
         sharing = getattr(config, "sharing", None)
         if sharing is not None and sharing.is_multi_process():
-            edits = edits.merge(
-                self.mp_manager.apply(sharing, devices, claim_uid))
+            with start_span("prepare.sharing_setup",
+                            attributes={"claim": claim_uid}):
+                edits = edits.merge(
+                    self.mp_manager.apply(sharing, devices, claim_uid))
         if self.fabric_id:
             edits.env["TPU_FABRIC_ID"] = self.fabric_id
         if claim_uid:
@@ -376,6 +389,18 @@ class DeviceState:
             edits.env["TPU_HEALTH_HEARTBEAT_DIR"] = \
                 HEARTBEAT_CONTAINER_PATH
         return edits
+
+    @staticmethod
+    def _stamp_trace_env(per_device_edits: dict[str, ContainerEdits]
+                         ) -> None:
+        """Trace continuation into the container: the launcher shim and
+        jax.distributed init run as children of the prepare that placed
+        them (TPU_TRACEPARENT, trace/propagation contract).  Called from
+        ``prepare`` AFTER the phase spans close, so the stamped context
+        is the enclosing ``plugin.prepare`` span — not a short-lived
+        phase child — and the trace tree reads correctly in Perfetto."""
+        for edits in per_device_edits.values():
+            propagation.stamp_env(edits.env)   # setdefault: idempotent
 
     def _lookup(self, result: dict) -> AllocatableDevice:
         name = result.get("device", "")

@@ -40,6 +40,7 @@ from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
 from tpu_dra.plugins.tpu.deviceinfo import chip_device, core_device
 from tpu_dra.tpulib.discovery import TpuLib
+from tpu_dra.trace import get_tracer, propagation
 from tpu_dra.util import klog
 from tpu_dra.util.flock import locked
 from tpu_dra.version import DRIVER_NAME
@@ -260,7 +261,17 @@ class TpuDriver:
 
     def _node_prepare(self, claim: dict) -> PrepareResult:
         from tpu_dra.plugins.metrics import observe_prepare
-        with observe_prepare(DRIVER_NAME), \
+        meta = claim.get("metadata", {})
+        # continue the trace the controller started: the claim carries
+        # the reconcile's context in its traceparent annotation
+        # (inherited from the RCT's spec.metadata); phase spans nest
+        # under this one inside DeviceState.prepare
+        with get_tracer().start_span(
+                "plugin.prepare", parent=propagation.extract(claim),
+                attributes={"claim": meta.get("uid", ""),
+                            "name": meta.get("name", ""),
+                            "node": self.cfg.node_name}), \
+                observe_prepare(DRIVER_NAME), \
                 locked(self.flock_path, timeout=self.cfg.flock_timeout):
             devices = self.state.prepare(claim)
         return PrepareResult(devices=[
@@ -280,7 +291,11 @@ class TpuDriver:
         errors: dict[str, str] = {}
         for ref in refs:
             try:
-                with observe_unprepare(DRIVER_NAME), \
+                with get_tracer().start_span(
+                        "plugin.unprepare",
+                        attributes={"claim": ref.uid,
+                                    "node": self.cfg.node_name}), \
+                        observe_unprepare(DRIVER_NAME), \
                         locked(self.flock_path,
                                timeout=self.cfg.flock_timeout):
                     self.state.unprepare(ref.uid)

@@ -44,10 +44,13 @@ def main(argv=None) -> int:
     args = flags.parse(
         "tpu-kubelet-plugin",
         [flags.plugin_common_flags(), plugin_flags(),
-         flags.kube_client_flags(), flags.logging_flags()],
+         flags.kube_client_flags(), flags.logging_flags(),
+         flags.tracing_flags()],
         argv,
         description=__doc__)
     klog.configure(args.v, args.logging_format)
+    from tpu_dra import trace
+    trace.configure_from_args(args, service="tpu-kubelet-plugin")
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
     driver = TpuDriver(TpuDriverConfig(

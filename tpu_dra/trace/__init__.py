@@ -1,0 +1,60 @@
+"""Distributed tracing for the driver (ISSUE 3).
+
+A dependency-free Dapper-style tracer in the spirit of
+``util/metrics.py``: spans with automatic (contextvar) parenting, W3C
+``traceparent`` propagation across the controller → daemon → kubelet
+plugin → launcher process chain, head sampling, bounded in-memory +
+JSONL export, and Chrome trace-event rendering for the
+``/debug/traces`` endpoint (Perfetto-loadable).
+
+See ``docs/observability.md`` for the trace model and the propagation
+contract.
+"""
+
+from tpu_dra.trace import propagation  # noqa: F401
+from tpu_dra.trace.export import (  # noqa: F401
+    JsonlExporter,
+    RingBufferExporter,
+    chrome_trace,
+)
+from tpu_dra.trace.propagation import (  # noqa: F401
+    TRACEPARENT_ANNOTATION,
+    TRACEPARENT_ENV,
+)
+from tpu_dra.trace.span import (  # noqa: F401
+    Span,
+    SpanContext,
+    current_context,
+    current_ids,
+    current_span,
+    current_traceparent,
+)
+from tpu_dra.trace.tracer import (  # noqa: F401
+    DEFAULT_RING,
+    Tracer,
+    configure,
+    configure_from_args,
+    get_tracer,
+    start_span,
+)
+
+__all__ = [
+    "DEFAULT_RING",
+    "JsonlExporter",
+    "RingBufferExporter",
+    "Span",
+    "SpanContext",
+    "TRACEPARENT_ANNOTATION",
+    "TRACEPARENT_ENV",
+    "Tracer",
+    "chrome_trace",
+    "configure",
+    "configure_from_args",
+    "current_context",
+    "current_ids",
+    "current_span",
+    "current_traceparent",
+    "get_tracer",
+    "propagation",
+    "start_span",
+]

@@ -1,0 +1,116 @@
+"""Span exporters + the Perfetto/Chrome trace-event converter.
+
+Two sinks, both bounded-cost so tracing can stay on in production:
+
+- :class:`RingBufferExporter` — fixed-capacity in-memory ring; the
+  backing store of the ``/debug/traces`` endpoint (util/metrics.py).
+- :class:`JsonlExporter` — append-one-JSON-object-per-line file sink
+  for offline analysis; I/O errors are swallowed (tracing is advisory,
+  it must never take the process down).
+
+:func:`chrome_trace` renders exported span dicts as Chrome trace-event
+JSON (the ``{"traceEvents": [...]}`` object format), directly loadable
+in Perfetto (https://ui.perfetto.dev) and ``chrome://tracing``: one
+``"ph": "X"`` complete event per span, pid = service (process), tid =
+thread, with metadata events naming both.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from typing import Any, Optional
+
+
+class RingBufferExporter:
+    """Bounded in-memory span store (newest wins on overflow)."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._spans: collections.deque = collections.deque(
+            maxlen=capacity)   # guarded by self._mu
+
+    def export(self, span: dict[str, Any]) -> None:
+        with self._mu:
+            self._spans.append(span)
+
+    def spans(self, trace_id: Optional[str] = None) -> list[dict[str, Any]]:
+        with self._mu:
+            snap = list(self._spans)
+        if trace_id:
+            snap = [s for s in snap if s.get("trace_id") == trace_id]
+        return snap
+
+    def clear(self) -> None:
+        with self._mu:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._spans)
+
+
+class JsonlExporter:
+    """Append finished spans to a JSONL file (one span object per line)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._mu = threading.Lock()
+
+    def export(self, span: dict[str, Any]) -> None:
+        line = json.dumps(span, default=str)
+        try:
+            with self._mu, open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass   # advisory: a full disk must not kill the traced process
+
+
+def chrome_trace(spans: list[dict[str, Any]]) -> dict[str, Any]:
+    """Span dicts → Chrome trace-event JSON (Perfetto-loadable).
+
+    Services map to synthetic pids and thread names to per-service tids,
+    with ``"M"`` metadata events carrying the human-readable names; each
+    span becomes one complete (``"X"``) event with its ids and
+    attributes in ``args``.
+    """
+    pids: dict[str, int] = {}
+    tids: dict[tuple[str, str], int] = {}
+    events: list[dict[str, Any]] = []
+    for s in spans:
+        service = s.get("service") or "unknown"
+        thread = s.get("thread") or "main"
+        if service not in pids:
+            pids[service] = len(pids) + 1
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pids[service], "tid": 0,
+                           "args": {"name": service}})
+        key = (service, thread)
+        if key not in tids:
+            tids[key] = len([k for k in tids if k[0] == service]) + 1
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pids[service], "tid": tids[key],
+                           "args": {"name": thread}})
+        args = {
+            "trace_id": s.get("trace_id", ""),
+            "span_id": s.get("span_id", ""),
+            "parent_id": s.get("parent_id", ""),
+            "status": s.get("status", ""),
+            **(s.get("attributes") or {}),
+        }
+        if s.get("events"):
+            args["events"] = s["events"]
+        events.append({
+            "name": s.get("name", "span"),
+            "cat": "span",
+            "ph": "X",
+            "ts": round(float(s.get("start", 0.0)) * 1e6, 3),
+            "dur": max(round(float(s.get("duration") or 0.0) * 1e6, 3),
+                       0.001),
+            "pid": pids[service],
+            "tid": tids[key],
+            "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1,0 +1,142 @@
+"""The tracer: span lifecycle, automatic parenting, head sampling.
+
+Styled after ``util/metrics.py``: a hand-rolled, dependency-free
+module-level default (``get_tracer()`` / ``configure()``) that every
+binary shares, with a bounded ring buffer always attached so
+``/debug/traces`` has data even when nothing was configured.
+
+Head-based sampling is deterministic in the trace id (the LEADING 8 hex
+chars — the high 32 bits — compared against the ratio), so every
+process in a distributed trace makes the SAME keep/drop decision
+without coordination — the sampled flag still travels in
+``traceparent`` and wins when present (a parent's decision is
+inherited, never re-rolled).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional, Union
+
+from tpu_dra.trace.export import JsonlExporter, RingBufferExporter
+from tpu_dra.trace.span import (
+    _CURRENT,
+    Span,
+    SpanContext,
+    new_span_id,
+    new_trace_id,
+)
+
+ParentLike = Union[None, str, Span, SpanContext]
+
+# the shared ring every tracer exports into; /debug/traces reads it
+DEFAULT_RING = RingBufferExporter(4096)
+
+
+def _head_sampled(trace_id: str, ratio: float) -> bool:
+    if ratio >= 1.0:
+        return True
+    if ratio <= 0.0:
+        return False
+    return int(trace_id[:8], 16) < int(ratio * 0x1_0000_0000)
+
+
+def _resolve_parent(parent: ParentLike) -> Optional[SpanContext]:
+    if parent is None:
+        cur = _CURRENT.get()
+        return cur.context if cur is not None else None
+    if isinstance(parent, Span):
+        return parent.context
+    if isinstance(parent, SpanContext):
+        return parent
+    return SpanContext.from_traceparent(parent)   # str (or garbage → None)
+
+
+class Tracer:
+    def __init__(self, service: str = "", sample_ratio: float = 1.0,
+                 exporters: tuple = ()) -> None:
+        self.service = service or os.path.basename(sys.argv[0] or "python")
+        self.sample_ratio = sample_ratio
+        self.exporters = tuple(exporters)
+
+    @contextmanager
+    def start_span(self, name: str, parent: ParentLike = None,
+                   attributes: Optional[dict[str, Any]] = None,
+                   ) -> Iterator[Span]:
+        """Open a span for the duration of the ``with`` block.
+
+        ``parent`` may be another span, a :class:`SpanContext`, a
+        ``traceparent`` string (as extracted from an annotation or the
+        ``TPU_TRACEPARENT`` env), or None — in which case the current
+        span (contextvar) parents it, and absent that a new trace root
+        is started with a fresh head-sampling decision.  Exceptions are
+        recorded on the span and re-raised; the span is exported on exit
+        iff its trace is sampled.
+        """
+        pctx = _resolve_parent(parent)
+        if pctx is not None:
+            ctx = SpanContext(trace_id=pctx.trace_id, span_id=new_span_id(),
+                              sampled=pctx.sampled)
+            parent_id = pctx.span_id
+        else:
+            trace_id = new_trace_id()
+            ctx = SpanContext(
+                trace_id=trace_id, span_id=new_span_id(),
+                sampled=_head_sampled(trace_id, self.sample_ratio))
+            parent_id = ""
+        span = Span(name, ctx, parent_id=parent_id, service=self.service,
+                    attributes=attributes)
+        token = _CURRENT.set(span)
+        try:
+            yield span
+        except BaseException as exc:
+            span.record_exception(exc)
+            raise
+        finally:
+            _CURRENT.reset(token)
+            span.end()
+            if ctx.sampled:
+                for exporter in self.exporters:
+                    exporter.export(span.to_dict())
+
+
+_DEFAULT = Tracer(exporters=(DEFAULT_RING,))
+
+
+def configure(service: Optional[str] = None,
+              sample_ratio: Optional[float] = None,
+              jsonl_path: Optional[str] = None) -> Tracer:
+    """(Re)configure the process-wide default tracer; each binary calls
+    this once at startup with its own service name.  The ring buffer
+    exporter is always kept; ``jsonl_path`` adds a file sink."""
+    global _DEFAULT
+    exporters: list = [DEFAULT_RING]
+    if jsonl_path:
+        exporters.append(JsonlExporter(jsonl_path))
+    _DEFAULT = Tracer(
+        service=service or _DEFAULT.service,
+        sample_ratio=(sample_ratio if sample_ratio is not None
+                      else _DEFAULT.sample_ratio),
+        exporters=tuple(exporters))
+    return _DEFAULT
+
+
+def configure_from_args(args, service: str) -> Tracer:
+    """Configure the default tracer from the shared tracing flag group
+    (``util/flags.py tracing_flags``) — the one-liner every binary's
+    main calls so the setup cannot drift between them."""
+    return configure(service=service,
+                     sample_ratio=args.trace_sample_ratio,
+                     jsonl_path=args.trace_file or None)
+
+
+def get_tracer() -> Tracer:
+    return _DEFAULT
+
+
+def start_span(name: str, parent: ParentLike = None,
+               attributes: Optional[dict[str, Any]] = None):
+    """Module-level convenience: a span on the default tracer."""
+    return _DEFAULT.start_span(name, parent=parent, attributes=attributes)

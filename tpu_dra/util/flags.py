@@ -76,6 +76,19 @@ def logging_flags() -> FlagGroup:
     ])
 
 
+def tracing_flags() -> FlagGroup:
+    """Distributed-tracing flag group (tpu_dra/trace): every binary takes
+    the same pair so a fleet-wide sampling ratio is one env var."""
+    return FlagGroup("Tracing", [
+        Flag("trace-sample-ratio", "TRACE_SAMPLE_RATIO",
+             "head-sampling ratio for distributed traces "
+             "(0 disables, 1 keeps everything)", 1.0, float),
+        Flag("trace-file", "TRACE_FILE",
+             "append finished spans to this JSONL file (empty = off; "
+             "the in-memory /debug/traces ring is always on)", ""),
+    ])
+
+
 def plugin_common_flags() -> FlagGroup:
     """Flags shared by both kubelet plugins — reference
     cmd/gpu-kubelet-plugin/main.go:66-161."""

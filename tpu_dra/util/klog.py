@@ -14,6 +14,11 @@ import threading
 import time
 from typing import Any
 
+# stdlib-only module (tpu_dra.trace.span imports nothing back): every
+# line emitted inside a span carries its trace_id/span_id, which is what
+# makes the four binaries' log streams joinable on one trace
+from tpu_dra.trace.span import current_ids as _current_trace_ids
+
 _VERBOSITY = 2
 _JSON = False
 _lock = threading.Lock()
@@ -39,7 +44,16 @@ def v(level: int) -> bool:
 def _emit(severity: str, msg: str, kv: dict[str, Any]) -> None:
     if not _logger.handlers:
         configure()
-    ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+    # UTC with millisecond precision and an explicit zone: second-
+    # granularity local time made cross-binary correlation impossible
+    now = time.time()
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(now)) + \
+        f".{int(now % 1 * 1000):03d}Z"
+    ids = _current_trace_ids()
+    if ids is not None:
+        kv = dict(kv)
+        kv.setdefault("trace_id", ids[0])
+        kv.setdefault("span_id", ids[1])
     if _JSON:
         rec = {"ts": ts, "severity": severity, "msg": msg, **kv}
         line = json.dumps(rec, default=str)

@@ -11,6 +11,7 @@ the pprof analog serves Python thread stack dumps + tracemalloc snapshots.
 
 from __future__ import annotations
 
+import json
 import sys
 import threading
 import time
@@ -18,6 +19,19 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
+
+
+def _escape_label(value: object) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote, and line-feed must be escaped or the line
+    is unparseable (and silently poisons the whole scrape)."""
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and line-feed (no quote escaping)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class Counter:
@@ -33,12 +47,13 @@ class Counter:
             self._values[label_values] = self._values.get(label_values, 0.0) + by
 
     def collect(self) -> str:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} {self.KIND}"]
         with self._mu:
             items = sorted(self._values.items())
         for lv, val in items:
-            lbl = ",".join(f'{k}="{v}"' for k, v in zip(self.labels, lv))
+            lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                           for k, v in zip(self.labels, lv))
             out.append(f"{self.name}{{{lbl}}} {val}" if lbl
                        else f"{self.name} {val}")
         return "\n".join(out)
@@ -77,12 +92,13 @@ class Histogram:
             s[len(self.buckets)] += 1
 
     def collect(self) -> str:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
         with self._mu:
             series = sorted((lv, list(s)) for lv, s in self._series.items())
         for lv, s in series:
-            lbl = ",".join(f'{k}="{v}"' for k, v in zip(self.labels, lv))
+            lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                           for k, v in zip(self.labels, lv))
             pre = lbl + "," if lbl else ""
             cum = 0
             for b, c in zip(self.buckets, s):
@@ -155,6 +171,13 @@ def _stacks_dump() -> str:
     return "\n".join(out)
 
 
+# one statistical profiler at a time: each run spins a sampler loop at
+# ``hz``, so N concurrent /debug/pprof/profile requests would multiply
+# the sampling overhead N-fold AND skew each other's sample weights
+_PROFILE_MU = threading.Lock()
+_PROFILE_UNTIL = 0.0   # monotonic deadline of the in-flight profile
+
+
 def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
     """pprof-profile analog (reference compute-domain-controller
     main.go:216-224): statistical CPU profile over a window.
@@ -208,11 +231,15 @@ def serve_from_flag(endpoint: str, **kwargs) -> Optional[ThreadingHTTPServer]:
 def serve_http_endpoint(
     address: str = "127.0.0.1", port: int = 0,
     metrics_path: str = "/metrics", pprof_path: str = "/debug/pprof",
+    traces_path: str = "/debug/traces",
     registry: Optional[Registry] = None,
     healthz: Optional[Callable[[], bool]] = None,
 ) -> ThreadingHTTPServer:
-    """Start the metrics/pprof HTTP server in a daemon thread; returns the
-    server (``server.server_address`` carries the bound port)."""
+    """Start the metrics/pprof/traces HTTP server in a daemon thread;
+    returns the server (``server.server_address`` carries the bound
+    port).  ``traces_path`` serves the default trace ring buffer as
+    Chrome trace-event JSON (Perfetto-loadable), filterable with
+    ``?trace_id=``."""
     reg = registry or DEFAULT_REGISTRY
 
     class Handler(BaseHTTPRequestHandler):
@@ -220,6 +247,19 @@ def serve_http_endpoint(
             if self.path == metrics_path:
                 body = reg.expose().encode()
                 ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith(traces_path):
+                # lazy import: metrics must stay importable before (and
+                # without) the tracer; the ring is process-global
+                from tpu_dra.trace import DEFAULT_RING, chrome_trace
+                qs = parse_qs(urlparse(self.path).query)
+                trace_id = qs.get("trace_id", [""])[0]
+                spans = DEFAULT_RING.spans(trace_id=trace_id or None)
+                # default=str: one exotic span attribute must degrade to
+                # its str(), not kill the whole endpoint until the span
+                # ages out of the ring
+                body = json.dumps(chrome_trace(spans),
+                                  default=str).encode()
+                ctype = "application/json"
             elif self.path.startswith(pprof_path + "/profile"):
                 qs = parse_qs(urlparse(self.path).query)
                 try:
@@ -230,7 +270,26 @@ def serve_http_endpoint(
                     self.end_headers()
                     self.wfile.write(b"bad seconds/hz query param")
                     return
-                body = cpu_profile(secs, hz).encode()
+                # serialize: concurrent requests would each spin their
+                # own sampler loop and skew each other's weights; the
+                # loser gets 409 + Retry-After (remaining time of the
+                # IN-FLIGHT profile, not its own request's window)
+                # instead of queueing an unbounded pile of 5-30s samplers
+                global _PROFILE_UNTIL
+                if not _PROFILE_MU.acquire(blocking=False):
+                    remaining = _PROFILE_UNTIL - time.monotonic()
+                    self.send_response(409)
+                    self.send_header("Retry-After",
+                                     str(max(int(remaining) + 1, 1)))
+                    self.end_headers()
+                    self.wfile.write(
+                        b"a cpu profile is already running; retry later")
+                    return
+                try:
+                    _PROFILE_UNTIL = time.monotonic() + secs
+                    body = cpu_profile(secs, hz).encode()
+                finally:
+                    _PROFILE_MU.release()
                 ctype = "text/plain"
             elif self.path.startswith(pprof_path):
                 body = _stacks_dump().encode()

@@ -21,7 +21,39 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from tpu_dra.trace import get_tracer
+from tpu_dra.trace.span import SpanContext, current_context
 from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+
+def _queue_metrics() -> dict:
+    """The client-go workqueue metric set the reference gets for free via
+    legacyregistry (MetricsProvider): depth, queue time, work duration,
+    retries, and terminal drops — all labeled by queue name.  Registry
+    lookups are idempotent, so every WorkQueue shares the same series."""
+    return {
+        "depth": DEFAULT_REGISTRY.gauge(
+            "tpu_dra_workqueue_depth",
+            "items waiting in the queue (ready + backoff-delayed)",
+            labels=("queue",)),
+        "queue_duration": DEFAULT_REGISTRY.histogram(
+            "tpu_dra_workqueue_queue_duration_seconds",
+            "time from enqueue (or backoff expiry) to processing start",
+            labels=("queue",)),
+        "work_duration": DEFAULT_REGISTRY.histogram(
+            "tpu_dra_workqueue_work_duration_seconds",
+            "time spent processing one item",
+            labels=("queue",)),
+        "retries": DEFAULT_REGISTRY.counter(
+            "tpu_dra_workqueue_retries_total",
+            "failed items re-queued with backoff",
+            labels=("queue",)),
+        "failures": DEFAULT_REGISTRY.counter(
+            "tpu_dra_workqueue_permanent_failures_total",
+            "items dropped for good (PermanentError or retry deadline)",
+            labels=("queue", "reason")),
+    }
 
 
 class PermanentError(Exception):
@@ -73,6 +105,14 @@ class _WorkItem:
     key: Any
     deadline: Optional[float] = None  # monotonic; None = retry forever
     on_error: Optional[Callable[[BaseException], None]] = None
+    # trace context captured at enqueue time: contextvars don't cross the
+    # producer→worker thread hop, so the queue carries it explicitly and
+    # the processing span parents under the enqueuer's span
+    parent: Optional[SpanContext] = None
+    # monotonic instant the item became *ready* (set on push, reset when
+    # a backoff delay expires — queue time must not count the
+    # intentional backoff wait)
+    ready_since: float = 0.0
 
 
 class WorkQueue:
@@ -92,6 +132,7 @@ class WorkQueue:
         self._cv = threading.Condition()
         self._shutdown = False                # guarded by self._cv
         self._active = 0                      # guarded by self._cv
+        self._metrics = _queue_metrics()
 
     # -- producer side -----------------------------------------------------
     def enqueue(self, callback: Callable[[Any], None], obj: Any,
@@ -101,7 +142,8 @@ class WorkQueue:
         Failures re-queue with backoff forever.
         """
         self._push(_WorkItem(callback, copy.deepcopy(obj),
-                             key if key is not None else id(callback)))
+                             key if key is not None else id(callback),
+                             parent=current_context()))
 
     def enqueue_with_deadline(
         self, callback: Callable[[Any], None], obj: Any, *,
@@ -118,13 +160,20 @@ class WorkQueue:
         self._push(_WorkItem(callback, copy.deepcopy(obj),
                              key if key is not None else id(callback),
                              deadline=time.monotonic() + timeout,
-                             on_error=on_error))
+                             on_error=on_error,
+                             parent=current_context()))
+
+    def _update_depth(self) -> None:  # vet: holds[self._cv]
+        self._metrics["depth"].set(
+            len(self._queue) + len(self._delayed), self.name)
 
     def _push(self, item: _WorkItem) -> None:
         with self._cv:
             if self._shutdown:
                 raise RuntimeError(f"workqueue {self.name} is shut down")
+            item.ready_since = time.monotonic()
             self._queue.append(item)
+            self._update_depth()
             self._cv.notify()
 
     def _push_delayed(self, item: _WorkItem, delay: float) -> None:
@@ -132,6 +181,7 @@ class WorkQueue:
             self._seq += 1
             heapq.heappush(self._delayed,
                            _Delayed(time.monotonic() + delay, self._seq, item))
+            self._update_depth()
             self._cv.notify()
 
     # -- consumer side -----------------------------------------------------
@@ -140,10 +190,17 @@ class WorkQueue:
             while True:
                 now = time.monotonic()
                 while self._delayed and self._delayed[0].ready_at <= now:
-                    self._queue.append(heapq.heappop(self._delayed).item)
+                    ready = heapq.heappop(self._delayed).item
+                    ready.ready_since = now   # backoff wait is not queue time
+                    self._queue.append(ready)
                 if self._queue:
                     self._active += 1
-                    return self._queue.pop(0)
+                    item = self._queue.pop(0)
+                    self._update_depth()
+                    self._metrics["queue_duration"].observe(
+                        max(time.monotonic() - item.ready_since, 0.0),
+                        self.name)
+                    return item
                 if self._shutdown:
                     return None
                 timeout = None
@@ -156,11 +213,21 @@ class WorkQueue:
             item = self._next()
             if item is None:
                 return
+            t0 = time.monotonic()
             try:
                 try:
-                    item.callback(item.obj)
+                    # the processing span parents under the span that
+                    # enqueued the item (captured in _WorkItem.parent) —
+                    # this is the hop that stitches informer-thread
+                    # enqueues to worker-thread reconciles in one trace
+                    with get_tracer().start_span(
+                            f"workqueue.{self.name}", parent=item.parent,
+                            attributes={"queue": self.name,
+                                        "key": str(item.key)[:64]}):
+                        item.callback(item.obj)
                 except PermanentError as exc:
                     self._backoff.forget(item.key)
+                    self._metrics["failures"].inc(self.name, "permanent")
                     if item.on_error:
                         item.on_error(exc)
                 except BaseException as exc:  # noqa: BLE001 — retried below
@@ -171,14 +238,18 @@ class WorkQueue:
                     if item.deadline is not None and \
                             time.monotonic() + delay > item.deadline:
                         self._backoff.forget(item.key)
+                        self._metrics["failures"].inc(self.name, "deadline")
                         if item.on_error:
                             item.on_error(RetryDeadlineExceeded(
                                 f"{self.name}: retries exhausted: {exc!r}"))
                     else:
+                        self._metrics["retries"].inc(self.name)
                         self._push_delayed(item, delay)
                 else:
                     self._backoff.forget(item.key)
             finally:
+                self._metrics["work_duration"].observe(
+                    time.monotonic() - t0, self.name)
                 with self._cv:
                     self._active -= 1
                     self._cv.notify_all()

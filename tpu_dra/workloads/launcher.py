@@ -17,6 +17,9 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Optional
 
+from tpu_dra.trace import get_tracer
+from tpu_dra.trace.propagation import extract_env as _trace_parent
+
 
 @dataclass
 class RendezvousInfo:
@@ -64,18 +67,27 @@ class RendezvousInfo:
         bound (must land in ``LIBTPU_INIT_ARGS`` before libtpu init), the
         scheduling-priority hint, and — on multislice domains — the
         MEGASCALE_* env (libtpu reads it at backend init to bridge the
-        per-slice ICI meshes over DCN)."""
-        acquire_multiprocess_slot()
-        apply_hbm_limits()
-        apply_scheduling_priority()
-        start_health_heartbeat()
-        for key, val in self.megascale_env().items():
-            os.environ.setdefault(key, val)   # explicit user env wins
-        import jax
-        jax.distributed.initialize(
-            coordinator_address=self.coordinator_address,
-            num_processes=self.num_processes,
-            process_id=self.process_id)
+        per-slice ICI meshes over DCN).  The whole init runs as a child
+        span of the prepare that placed this container (the
+        ``TPU_TRACEPARENT`` CDI edit), so "why did this pod take 40s to
+        start" reads as one trace across all four binaries."""
+        with get_tracer().start_span(
+                "launcher.initialize", parent=_trace_parent(),
+                attributes={"coordinator": self.coordinator_address,
+                            "num_processes": self.num_processes,
+                            "process_id": self.process_id}):
+            acquire_multiprocess_slot()
+            apply_hbm_limits()
+            apply_scheduling_priority()
+            start_health_heartbeat()
+            for key, val in self.megascale_env().items():
+                os.environ.setdefault(key, val)   # explicit user env wins
+            import jax
+            with get_tracer().start_span("launcher.jax_distributed_init"):
+                jax.distributed.initialize(
+                    coordinator_address=self.coordinator_address,
+                    num_processes=self.num_processes,
+                    process_id=self.process_id)
 
 
 JAX_COORDINATOR_PORT = 8476
@@ -386,12 +398,20 @@ def init_tpu_workload(env: Optional[dict[str, str]] = None,
                 e.get("TPU_PROCESS_PRIORITY", ""), 0) or None,
             "heartbeat": _heartbeat_paths(e) or None,
         }
-    return {
-        "slot": acquire_multiprocess_slot(env),
-        "hbm_limit_bytes": apply_hbm_limits(env),
-        "nice": apply_scheduling_priority(env),
-        "heartbeat": start_health_heartbeat(env),
-    }
+    # child of the kubelet-plugin prepare span that placed this
+    # container (TPU_TRACEPARENT env, trace/propagation contract)
+    with get_tracer().start_span("launcher.init_tpu_workload",
+                                 parent=_trace_parent(env)) as span:
+        applied = {
+            "slot": acquire_multiprocess_slot(env),
+            "hbm_limit_bytes": apply_hbm_limits(env),
+            "nice": apply_scheduling_priority(env),
+            "heartbeat": start_health_heartbeat(env),
+        }
+        span.set_attribute("slot", bool(applied["slot"]))
+        span.set_attribute("hbm_limited",
+                           applied["hbm_limit_bytes"] is not None)
+        return applied
 
 
 def _coordinator_port(env: Optional[dict] = None) -> int:
@@ -465,6 +485,12 @@ def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
     (the pod was not given a slice-domain channel claim).
     """
     env = dict(os.environ) if env is None else env
+    with get_tracer().start_span("launcher.resolve_rendezvous",
+                                 parent=_trace_parent(env)):
+        return _resolve(env)
+
+
+def _resolve(env: dict[str, str]) -> RendezvousInfo:
     if env.get("JAX_COORDINATOR_ADDRESS"):
         return RendezvousInfo(
             coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
