@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench drive image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench drive drive-trace drive-health drive-chaos image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -48,6 +48,18 @@ e2e-inprocess:
 # Perfetto JSON, workqueue metrics under scripted load
 drive-trace:
 	$(PYTHON) hack/drive_trace.py
+
+# health acceptance (docs/health-monitoring.md): real plugin binary
+# through the fault path — drain, 503, typed rejection, Event, recovery
+drive-health:
+	$(PYTHON) hack/drive_health.py
+
+# resilience acceptance (docs/resilience.md): real plugin binary through
+# crash-at-failpoint -> restart -> converge, and a simulated API-server
+# blackout -> checkpoint-served prepares, breaker open/close, zero
+# remediation evictions
+drive-chaos:
+	$(PYTHON) hack/drive_chaos.py
 
 proto:
 	cd tpu_dra/kubeletplugin/proto && \

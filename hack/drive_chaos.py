@@ -1,0 +1,252 @@
+"""Chaos drive: crash-recovery and API-blackout degradation against the
+REAL plugin binary (``make drive-chaos``, docs/resilience.md).
+
+Same harness as hack/drive_plugin.py / drive_health.py (HTTP facade over
+the in-memory fake, real ``tpu_dra.plugins.tpu.main`` subprocess,
+synthetic driver root), exercising the ISSUE 4 acceptance paths on real
+surfaces:
+
+Phase 1 — crash mid-prepare, restart, converge:
+  the plugin runs with ``TPU_DRA_FAILPOINTS=tpu.prepare.after_cdi_write
+  =crash``; NodePrepareResources kills the process (exit 86) with the
+  claim CDI spec on disk but no checkpoint entry.  A restarted plugin
+  reconciles the orphan and the retried prepare succeeds — the claim
+  converges.
+
+Phase 2 — API-server blackout, degrade, recover:
+  with the healthy plugin running, ``kube.request=error(Transient)`` is
+  written into the ``TPU_DRA_FAILPOINTS_FILE`` plan, simulating a total
+  apiserver outage under a RUNNING binary.  Asserted: the circuit
+  breaker opens (metrics), NodePrepareResources for the already-placed
+  claim is still served from the checkpoint, a chip failure during the
+  blackout causes ZERO remediation evictions (remediation=unprepare is
+  armed!), and once the plan is cleared the breaker re-closes and the
+  claim is still alive on both sides.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import grpc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra.k8s.testserver import KubeTestServer           # noqa: E402
+from tpu_dra.k8s import RESOURCE_CLAIMS                      # noqa: E402
+from tpu_dra.kubeletplugin.proto import (                    # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.resilience import failpoint                     # noqa: E402
+from tpu_dra.version import DRIVER_NAME                      # noqa: E402
+
+CRASH_POINT = "tpu.prepare.after_cdi_write"
+
+
+def rpc(sock, method, request, response_cls, timeout=15.0):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with grpc.insecure_channel(f"unix:{sock}") as ch:
+                fn = ch.unary_unary(
+                    method,
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=response_cls.FromString)
+                return fn(request, timeout=timeout)
+        except grpc.RpcError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def wait_until(pred, timeout=20.0, what=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def metrics_text(port):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+
+
+def breaker_state(port, state):
+    return (f'tpu_dra_client_breaker_state{{state="{state}"}} 1.0'
+            in metrics_text(port))
+
+
+def prepare_request(uid, name):
+    req = dra_pb.NodePrepareResourcesRequest()
+    c = req.claims.add()
+    c.uid, c.name, c.namespace = uid, name, "default"
+    return req
+
+
+def main():
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="drive-chaos-"))
+    srv = KubeTestServer().start()
+    plan = tmp / "failpoints.plan"
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp / "kubeconfig"))
+        root = tmp / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(4):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "etc").mkdir()
+        (root / "etc" / "machine-id").write_text("deadbeefcafe\n")
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-4'\nTPU_TOPOLOGY: '2x2'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            mport = s.getsockname()[1]
+        argv = [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+                "--kubeconfig", kcfg, "--node-name", "node-a",
+                "--tpu-driver-root", str(root),
+                "--kubelet-plugins-dir", str(tmp / "plugins"),
+                "--kubelet-registry-dir", str(tmp / "registry"),
+                "--cdi-root", str(tmp / "cdi"),
+                "--http-endpoint", f"127.0.0.1:{mport}",
+                "--health-interval", "0.3",
+                "--health-fail-threshold", "2",
+                "--health-pass-threshold", "1",
+                "--health-remediation", "unprepare",
+                "--ignore-host-tpu-env"]
+        base_env = {**os.environ, "PYTHONPATH": REPO,
+                    failpoint.FILE_ENV_VAR: str(plan),
+                    "TPU_DRA_BREAKER_THRESHOLD": "3",
+                    "TPU_DRA_BREAKER_OPEN_SECONDS": "3"}
+        dra_sock = tmp / "plugins" / DRIVER_NAME / "dra.sock"
+
+        # the claim both phases converge on, pinned to tpu-1
+        claim = {"metadata": {"name": "c1", "namespace": "default"},
+                 "spec": {},
+                 "status": {"allocation": {"devices": {"results": [
+                     {"request": "tpus", "driver": DRIVER_NAME,
+                      "pool": "node-a", "device": "tpu-1"}]}}}}
+        uid = srv.fake.create(RESOURCE_CLAIMS, claim)["metadata"]["uid"]
+        claim_spec_path = (tmp / "cdi" /
+                           f"k8s.tpu.google.com-claim_{uid}.json")
+
+        # ---- phase 1: crash mid-prepare -> restart -> converge --------
+        proc = subprocess.Popen(
+            argv, cwd=REPO,
+            env={**base_env, failpoint.ENV_VAR: f"{CRASH_POINT}=crash"})
+        wait_until(dra_sock.exists, what="plugin socket")
+        try:
+            rpc(str(dra_sock), "/v1beta1.DRAPlugin/NodePrepareResources",
+                prepare_request(uid, "c1"),
+                dra_pb.NodePrepareResourcesResponse, timeout=10)
+            raise AssertionError("prepare unexpectedly survived the "
+                                 "armed crash failpoint")
+        except grpc.RpcError:
+            pass   # the process died mid-RPC, as intended
+        code = proc.wait(15)
+        assert code == failpoint.CRASH_EXIT_CODE, \
+            f"plugin exited {code}, want {failpoint.CRASH_EXIT_CODE}"
+        specs = list((tmp / "cdi").glob(f"*{uid}*"))
+        assert specs, "crash point is after the CDI write: spec expected"
+        print(f"OK phase1: plugin crashed at {CRASH_POINT} (exit {code}), "
+              "orphan claim CDI spec on disk")
+
+        # restart WITHOUT the crash env: the orphan reconciles and the
+        # kubelet's retried prepare converges
+        proc = subprocess.Popen(argv, cwd=REPO, env=base_env)
+        try:
+            wait_until(dra_sock.exists, what="plugin socket (restart)")
+            res = rpc(str(dra_sock),
+                      "/v1beta1.DRAPlugin/NodePrepareResources",
+                      prepare_request(uid, "c1"),
+                      dra_pb.NodePrepareResourcesResponse)
+            assert res.claims[uid].error == "", res.claims[uid].error
+            assert res.claims[uid].devices[0].device_name == "tpu-1"
+            assert claim_spec_path.exists() or list(
+                (tmp / "cdi").glob(f"*{uid}*")), "claim spec rewritten"
+            print("OK phase1: restarted plugin converged the claim "
+                  "(idempotent re-prepare)")
+
+            # ---- phase 2: API blackout under the running binary -------
+            wait_until(lambda: breaker_state(mport, "closed"),
+                       what="breaker closed at baseline")
+            plan.write_text("kube.request=error(Transient)\n")
+            # the first fetch rides the retry loop until the breaker
+            # trips, then degrades to the checkpoint
+            res = rpc(str(dra_sock),
+                      "/v1beta1.DRAPlugin/NodePrepareResources",
+                      prepare_request(uid, "c1"),
+                      dra_pb.NodePrepareResourcesResponse, timeout=30)
+            assert res.claims[uid].error == "", \
+                f"blackout prepare failed: {res.claims[uid].error}"
+            assert res.claims[uid].devices[0].device_name == "tpu-1"
+            wait_until(lambda: breaker_state(mport, "open"),
+                       what="breaker open during blackout")
+            print("OK phase2: breaker OPEN; prepare served from the "
+                  "checkpoint during the blackout")
+
+            # chip failure DURING the blackout: remediation=unprepare is
+            # armed, but the apiserver (not the chip fleet) went dark —
+            # zero evictions allowed
+            (root / "dev" / "accel1").unlink()
+            wait_until(lambda: 'tpu_dra_health_state{device="tpu-1",'
+                       'state="Unhealthy"} 1.0' in metrics_text(mport),
+                       what="tpu-1 Unhealthy during blackout")
+            time.sleep(1.0)   # several polls' worth of suppressed runs
+            assert srv.fake.get(RESOURCE_CLAIMS, "c1", "default"), \
+                "claim evicted during API blackout"
+            res = rpc(str(dra_sock),
+                      "/v1beta1.DRAPlugin/NodePrepareResources",
+                      prepare_request(uid, "c1"),
+                      dra_pb.NodePrepareResourcesResponse)
+            assert res.claims[uid].error == "", \
+                "claim no longer served from checkpoint: remediation " \
+                "unprepared it during the blackout"
+            print("OK phase2: zero remediation evictions while the API "
+                  "was dark (suppressed + deferred)")
+
+            # chip recovers while still dark -> the deferred remediation
+            # must be dropped, not replayed
+            (root / "dev" / "accel1").touch()
+            wait_until(lambda: 'tpu_dra_health_state{device="tpu-1",'
+                       'state="Unhealthy"} 0.0' in metrics_text(mport),
+                       what="tpu-1 no longer Unhealthy")
+
+            # blackout ends: breaker half-opens after open_duration and
+            # the next request closes it
+            plan.write_text("# blackout over\n")
+            time.sleep(3.5)
+            res = rpc(str(dra_sock),
+                      "/v1beta1.DRAPlugin/NodePrepareResources",
+                      prepare_request(uid, "c1"),
+                      dra_pb.NodePrepareResourcesResponse, timeout=30)
+            assert res.claims[uid].error == "", res.claims[uid].error
+            wait_until(lambda: breaker_state(mport, "closed"),
+                       what="breaker re-closed after blackout")
+            assert srv.fake.get(RESOURCE_CLAIMS, "c1", "default"), \
+                "claim evicted after blackout despite chip recovery"
+            print("OK phase2: breaker re-closed; claim alive on both "
+                  "sides after recovery")
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(5)
+    finally:
+        srv.stop()
+    print("DRIVE CHAOS: ALL OK")
+
+
+if __name__ == "__main__":
+    main()

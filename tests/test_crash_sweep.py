@@ -1,0 +1,178 @@
+"""Crash-recovery sweep: kill the driver at EVERY crash-safe failpoint
+in the prepare/unprepare/checkpoint path and assert convergence.
+
+Where ``test_fault_injection.py`` is artisanal (hand-picked seams), this
+sweep is systematic: it enumerates the failpoint registry
+(``crash_safe=True`` points registered in ``plugins/tpu/device_state.py``
+and ``plugins/tpu/checkpoint.py``), runs the op in a REAL child process
+with ``TPU_DRA_FAILPOINTS=<point>=crash`` armed (``os._exit`` — no
+finally blocks, no atexit, exactly a SIGKILL's view of the filesystem),
+then "restarts the driver" on the same state directories and asserts the
+convergence invariants from docs/resilience.md:
+
+- the checkpoint loads clean (no CorruptCheckpoint — the atomic-write
+  contract held through the crash);
+- no orphaned per-claim CDI specs, multiprocess slot dirs, or heartbeat
+  dirs (everything on disk is named by the checkpoint after the
+  restart's reconcile pass);
+- re-prepare is idempotent and re-unprepare converges to a fully clean
+  node regardless of which instruction the crash interrupted.
+
+A registry-driven completeness check pins the sweep to the catalog: a
+new crash_safe failpoint that this sweep does not exercise fails the
+test, not the next incident.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_dra.plugins.tpu.checkpoint import Checkpoint
+from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+from tpu_dra.resilience import failpoint
+from tpu_dra.tpulib import FakeTpuLib
+from tpu_dra.version import DRIVER_NAME
+
+# DRA-core fast lane: driver machinery only, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+UID = "sweep-claim-uid"
+
+# every crash-safe point and the op that drives execution through it
+PREPARE_POINTS = (
+    "tpu.prepare.begin",
+    "tpu.prepare.after_select",
+    "tpu.prepare.after_cdi_write",
+    "tpu.prepare.after_checkpoint",
+    # checkpoint writes happen inside prepare's checkpoint.put
+    "tpu.checkpoint.before_write",
+    "tpu.checkpoint.after_write",
+)
+UNPREPARE_POINTS = (
+    "tpu.unprepare.begin",
+    "tpu.unprepare.after_heartbeat_rm",
+    "tpu.unprepare.after_slot_cleanup",
+    "tpu.unprepare.after_cdi_delete",
+    "tpu.unprepare.after_checkpoint",
+)
+
+_HARNESS = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+from tpu_dra.tpulib import FakeTpuLib
+
+plugin_dir, cdi_root, op, claim_json = sys.argv[1:5]
+state = DeviceState(DeviceStateConfig(
+    tpulib=FakeTpuLib(), plugin_dir=plugin_dir, cdi_root=cdi_root))
+claim = json.loads(claim_json)
+if op == "prepare":
+    state.prepare(claim)
+else:
+    state.unprepare(claim["metadata"]["uid"])
+print("OP_COMPLETED", flush=True)
+"""
+
+
+def _claim(uid=UID):
+    return {
+        "metadata": {"uid": uid, "namespace": "default", "name": "c-sweep"},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "r0", "driver": DRIVER_NAME,
+             "pool": "node-a", "device": "tpu-0"},
+        ]}}},
+    }
+
+
+def _mk_state(base) -> DeviceState:
+    return DeviceState(DeviceStateConfig(
+        tpulib=FakeTpuLib(),
+        plugin_dir=os.path.join(base, "plugin"),
+        cdi_root=os.path.join(base, "cdi")))
+
+
+def _run_child(base, op: str, point: str) -> subprocess.CompletedProcess:
+    harness = os.path.join(base, "harness.py")
+    if not os.path.exists(harness):
+        with open(harness, "w") as f:
+            f.write(_HARNESS.format(repo=REPO))
+    env = {**os.environ,
+           "PYTHONPATH": REPO,
+           failpoint.ENV_VAR: f"{point}=crash"}
+    return subprocess.run(
+        [sys.executable, harness, os.path.join(base, "plugin"),
+         os.path.join(base, "cdi"), op, json.dumps(_claim())],
+        env=env, capture_output=True, text=True, timeout=60)
+
+
+def _assert_converged(base, point: str) -> None:
+    """Restart the driver state on the crashed directories and assert
+    every convergence invariant."""
+    # 1. the checkpoint must load clean — DeviceState() raises
+    #    CorruptCheckpoint otherwise — and the constructor's reconcile
+    #    pass removes any orphaned CDI spec/slot dir/heartbeat dir
+    state = _mk_state(base)
+    prepared = set(state.checkpoint.prepared)
+    assert set(state.cdi.list_claim_specs()) <= prepared, \
+        f"{point}: orphaned claim CDI spec survived restart"
+    hb_root = os.path.join(base, "plugin", "heartbeats")
+    hb_dirs = set(os.listdir(hb_root)) if os.path.isdir(hb_root) else set()
+    assert hb_dirs <= prepared, \
+        f"{point}: orphaned heartbeat dir survived restart"
+
+    # 2. re-prepare is idempotent (fresh or already-checkpointed)
+    devices = state.prepare(_claim())
+    assert [d.canonical_name for d in devices] == ["tpu-0"], point
+    assert UID in state.prepared_claims(), point
+    with open(state.cdi.claim_spec_path(UID)) as f:
+        json.load(f)   # claim spec present and parseable
+
+    # 3. unprepare converges to a fully clean node
+    state.unprepare(UID)
+    assert state.cdi.list_claim_specs() == [], point
+    assert UID not in state.prepared_claims(), point
+    assert not os.path.isdir(os.path.join(hb_root, UID)), point
+    # and the on-disk checkpoint agrees after yet another restart
+    cp = Checkpoint(os.path.join(base, "plugin", "checkpoint.json"))
+    assert cp.load() and cp.prepared == {}, point
+
+
+@pytest.mark.parametrize("point", PREPARE_POINTS)
+def test_crash_during_prepare_converges(tmp_path, point):
+    base = str(tmp_path)
+    _mk_state(base)   # pre-seed checkpoint + standard CDI specs
+    res = _run_child(base, "prepare", point)
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, \
+        f"{point}: child did not crash at the failpoint\n{res.stderr}"
+    assert "OP_COMPLETED" not in res.stdout
+    _assert_converged(base, point)
+
+
+@pytest.mark.parametrize("point", UNPREPARE_POINTS)
+def test_crash_during_unprepare_converges(tmp_path, point):
+    base = str(tmp_path)
+    state = _mk_state(base)
+    state.prepare(_claim())   # the claim the crashing unprepare targets
+    res = _run_child(base, "unprepare", point)
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, \
+        f"{point}: child did not crash at the failpoint\n{res.stderr}"
+    assert "OP_COMPLETED" not in res.stdout
+    _assert_converged(base, point)
+
+
+def test_sweep_covers_every_crash_safe_failpoint():
+    """Completeness: the sweep must exercise exactly the crash_safe
+    registry — a new crash_safe point fails HERE, not in production."""
+    import tpu_dra.plugins.tpu.checkpoint    # noqa: F401 — registration
+    import tpu_dra.plugins.tpu.device_state  # noqa: F401
+
+    registry = {fp.name for fp in failpoint.registered() if fp.crash_safe}
+    swept = set(PREPARE_POINTS) | set(UNPREPARE_POINTS)
+    assert swept == registry, (
+        f"crash sweep out of sync with the failpoint registry: "
+        f"missing={sorted(registry - swept)} stale={sorted(swept - registry)}")
+    assert len(swept) >= 10   # acceptance floor (ISSUE 4)

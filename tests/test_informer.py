@@ -289,3 +289,96 @@ def test_gone_over_rest_testserver():
         inf.stop()
     finally:
         srv.stop()
+
+
+# -------------------------------------------------------------------------
+# Failpoint-driven relist/resume paths (tpu_dra/resilience/failpoint.py):
+# the systematic replacement for reaching these branches only through the
+# FakeKube etcd-compaction hack above.
+# -------------------------------------------------------------------------
+@pytest.fixture()
+def _failpoints():
+    from tpu_dra.resilience import failpoint
+    failpoint.reset()
+    yield failpoint
+    failpoint.reset()
+
+
+def test_failpoint_gone_forces_relist(_failpoints):
+    """Arm `informer.watch=1*error(Gone)`: the next watch establishment
+    raises the typed 410 and the informer must fall back to a fresh
+    list — no compaction choreography required."""
+    k = _CountingKube()
+    k.create(PODS, make_pod("pre"))
+    inf = Informer(k, PODS, namespace="default").start()
+    assert inf.wait_for_sync()
+    adds = []
+    inf.add_event_handler(on_add=lambda o: adds.append(o["metadata"]["name"]))
+    lists_before = k.lists
+    _failpoints.activate("informer.watch=1*error(Gone)")
+    k.close_watchers()              # end the stream; re-watch hits the FP
+    k.create(PODS, make_pod("late"))
+    assert wait_until(lambda: "late" in adds)
+    assert k.lists > lists_before, "injected 410 must force a relist"
+    assert inf.store.get("default", "late") is not None
+    inf.stop()
+
+
+def test_failpoint_transient_resumes_from_bookmark(_failpoints):
+    """A transient watch failure after a BOOKMARK must resume from the
+    bookmarked RV — no relist, and surviving a compaction that happened
+    behind the bookmark (the full bookmark-resume contract, driven by a
+    failpoint instead of server choreography)."""
+    k = _CountingKube()
+    k.create(PODS, make_pod("mine", labels={"app": "x"}))
+    inf = Informer(k, PODS, namespace="default",
+                   label_selector={"app": "x"}).start()
+    assert inf.wait_for_sync()
+    for i in range(3):
+        k.create(PODS, make_pod(f"other{i}"))   # invisible to the scope
+    k.emit_bookmark(PODS)           # resume point jumps to current RV
+    time.sleep(0.1)                 # let the bookmark drain
+    k.compact()                     # history behind the bookmark is gone
+    lists_before = k.lists
+    adds = []
+    inf.add_event_handler(on_add=lambda o: adds.append(o["metadata"]["name"]))
+    _failpoints.activate("informer.watch=1*error(ApiError)")
+    k.close_watchers()              # re-watch fails transiently once
+    k.create(PODS, make_pod("late", labels={"app": "x"}))
+    assert wait_until(lambda: "late" in adds)
+    assert k.lists == lists_before, \
+        "transient failure after a bookmark must resume, not relist"
+    inf.stop()
+
+
+def test_persistent_watch_failure_reaches_relist_fallback(_failpoints):
+    """Repeated watch failures must degrade to a fresh relist (the
+    fails>=4 safety net) — reachable only because the failure counter
+    resets on DELIVERED EVENTS, not on mere re-establishment
+    (code-review finding on the backoff reset placement)."""
+    k = _CountingKube()
+    k.create(PODS, make_pod("pre"))
+    inf = Informer(k, PODS, namespace="default").start()
+    assert inf.wait_for_sync()
+    lists_before = k.lists
+    _failpoints.activate("informer.watch=5*error(ApiError)")
+    k.close_watchers()              # every re-watch now fails...
+    adds = []
+    inf.add_event_handler(on_add=lambda o: adds.append(o["metadata"]["name"]))
+    k.create(PODS, make_pod("late"))
+    assert wait_until(lambda: "late" in adds, timeout=30)
+    assert k.lists > lists_before, \
+        "4 consecutive watch failures must force the relist fallback"
+    inf.stop()
+
+
+def test_failpoint_relist_failure_backs_off_and_recovers(_failpoints):
+    """`informer.relist=N*error(Transient)`: the initial sync survives
+    injected list failures through the shared jittered backoff."""
+    k = _CountingKube()
+    k.create(PODS, make_pod("pre"))
+    _failpoints.activate("informer.relist=2*error(Transient)")
+    inf = Informer(k, PODS, namespace="default").start()
+    assert inf.wait_for_sync(timeout=15)
+    assert inf.store.get("default", "pre") is not None
+    inf.stop()

@@ -1,0 +1,628 @@
+"""Resilience layer tests: failpoints, retry policy, circuit breaker,
+the Transient/Retry-After client contract, and the API-blackout
+degradation paths (checkpoint-served prepares, suppressed remediation).
+"""
+
+import http.client
+import io
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from tpu_dra.k8s import FakeKube, RESOURCE_CLAIMS
+from tpu_dra.k8s.client import (
+    ApiError,
+    Conflict,
+    Gone,
+    NotFound,
+    PODS,
+    RestKubeClient,
+    Transient,
+    error_for,
+    parse_retry_after,
+)
+from tpu_dra.resilience import failpoint, retry
+from tpu_dra.resilience.breaker import (
+    BreakerOpen,
+    CircuitBreaker,
+    ResilientKubeClient,
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+)
+
+# DRA-core fast lane: no JAX workload compiles
+pytestmark = pytest.mark.core
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoint.reset()
+    yield
+    failpoint.reset()
+
+
+# -- failpoint framework ---------------------------------------------------
+class TestFailpoint:
+    def test_unarmed_hit_is_noop(self):
+        failpoint.register("t.fp.noop", "test point")
+        failpoint.hit("t.fp.noop")   # must not raise
+
+    def test_error_action_default_and_typed(self):
+        failpoint.activate("t.fp.err=error")
+        with pytest.raises(failpoint.FailpointError):
+            failpoint.hit("t.fp.err")
+        failpoint.activate("t.fp.err=error(ValueError)")
+        with pytest.raises(ValueError):
+            failpoint.hit("t.fp.err")
+        # k8s typed exceptions resolve too (the injection currency)
+        failpoint.activate("t.fp.err=error(Transient)")
+        with pytest.raises(Transient):
+            failpoint.hit("t.fp.err")
+        failpoint.activate("t.fp.err=error(Gone)")
+        with pytest.raises(Gone):
+            failpoint.hit("t.fp.err")
+
+    def test_count_prefix_limits_firings(self):
+        failpoint.activate("t.fp.count=2*error(RuntimeError)")
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                failpoint.hit("t.fp.count")
+        failpoint.hit("t.fp.count")   # exhausted: no-op
+
+    def test_sleep_action_blocks(self):
+        failpoint.activate("t.fp.sleep=sleep(60)")
+        t0 = time.monotonic()
+        failpoint.hit("t.fp.sleep")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_stall_until_released(self):
+        failpoint.activate("t.fp.stall=stall")
+        done = threading.Event()
+
+        def stalled():
+            failpoint.hit("t.fp.stall")
+            done.set()
+
+        t = threading.Thread(target=stalled, daemon=True)
+        t.start()
+        assert not done.wait(0.2), "stall did not block"
+        failpoint.release("t.fp.stall")
+        assert done.wait(5), "release did not unblock the stall"
+
+    def test_stall_survives_plan_reload(self):
+        # a live plan rewrite that KEEPS a stall term must not strand a
+        # thread already blocked on the old activation's event
+        # (code-review finding): release() after the reload reaches it
+        failpoint.activate("t.fp.stall2=stall")
+        done = threading.Event()
+
+        def stalled():
+            failpoint.hit("t.fp.stall2")
+            done.set()
+
+        t = threading.Thread(target=stalled, daemon=True)
+        t.start()
+        assert not done.wait(0.2)
+        # plan reload keeping the stall term (plus a new one)
+        failpoint.activate("t.fp.stall2=stall;t.fp.other=error")
+        failpoint.release("t.fp.stall2")
+        assert done.wait(5), "stalled thread stranded across plan reload"
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.setenv(failpoint.ENV_VAR, "t.fp.env=error(OSError)")
+        failpoint.reset()   # force a re-read of the env var
+        with pytest.raises(OSError):
+            failpoint.hit("t.fp.env")
+
+    def test_file_activation_rearms_on_rewrite(self, tmp_path, monkeypatch):
+        plan = tmp_path / "failpoints"
+        plan.write_text("# blackout off\n")
+        monkeypatch.setenv(failpoint.FILE_ENV_VAR, str(plan))
+        failpoint.reset()
+        failpoint.hit("t.fp.file")   # armed with nothing: no-op
+        plan.write_text("t.fp.file=error(RuntimeError)\n")
+        import os
+        os.utime(plan, (time.time() + 2, time.time() + 2))
+        with pytest.raises(RuntimeError):
+            failpoint.hit("t.fp.file")
+        plan.write_text("")
+        os.utime(plan, (time.time() + 4, time.time() + 4))
+        failpoint.hit("t.fp.file")   # disarmed again
+
+    def test_malformed_spec_raises(self):
+        with pytest.raises(ValueError):
+            failpoint.parse_spec("name=explode")
+        with pytest.raises(ValueError):
+            failpoint.parse_spec("not a term")
+
+    def test_registry_rejects_conflicting_reregistration(self):
+        failpoint.register("t.fp.dup", "doc", crash_safe=False)
+        failpoint.register("t.fp.dup", "doc", crash_safe=False)  # same: ok
+        with pytest.raises(ValueError):
+            failpoint.register("t.fp.dup", "other doc")
+
+    def test_crash_exit_code_constant(self):
+        # the sweep and drive_chaos assert on this exact code
+        assert failpoint.CRASH_EXIT_CODE == 86
+
+    def test_error_apierror_carries_int_status(self):
+        # ApiError is status-first: error(ApiError) must inject a 500
+        # the retry/breaker classification recognizes, not a
+        # string-status exception (code-review finding)
+        failpoint.activate("t.fp.api=error(ApiError)")
+        with pytest.raises(ApiError) as exc_info:
+            failpoint.hit("t.fp.api")
+        assert exc_info.value.status == 500
+        assert retry.default_retryable(exc_info.value)
+
+
+# -- retry policy ----------------------------------------------------------
+class TestRetry:
+    def test_backoff_decorrelated_jitter_bounds(self):
+        b = retry.Backoff(base=0.1, cap=2.0)
+        prev = 0.1
+        for _ in range(50):
+            d = b.next()
+            assert 0.1 <= d <= min(2.0, prev * 3) + 1e-9
+            prev = d
+        b.reset()
+        assert b.next() <= 0.3 + 1e-9
+
+    def test_exponential_delay_curve(self):
+        assert retry.exponential_delay(0, 0.005, 30) == 0.005
+        assert retry.exponential_delay(3, 0.005, 30) == 0.04
+        assert retry.exponential_delay(100, 0.005, 30) == 30
+
+    def test_retry_call_retries_transient_then_succeeds(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise Transient("flaky")
+            return "ok"
+
+        policy = retry.RetryPolicy(base=0.001, cap=0.01, deadline=5.0)
+        assert retry.retry_call(fn, policy=policy) == "ok"
+        assert len(calls) == 3
+
+    def test_retry_call_raises_non_retryable_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise NotFound("gone")
+
+        with pytest.raises(NotFound):
+            retry.retry_call(fn)
+        assert len(calls) == 1
+
+    def test_retry_call_deadline_raises_last_error(self):
+        def fn():
+            raise Transient("always")
+
+        policy = retry.RetryPolicy(base=0.01, cap=0.02, deadline=0.05)
+        t0 = time.monotonic()
+        with pytest.raises(Transient):
+            retry.retry_call(fn, policy=policy)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_retry_call_max_attempts(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise Transient("always")
+
+        policy = retry.RetryPolicy(base=0.001, cap=0.01, deadline=None,
+                                   max_attempts=4)
+        with pytest.raises(Transient):
+            retry.retry_call(fn, policy=policy)
+        assert len(calls) == 4
+
+    def test_retry_after_preferred_over_backoff(self):
+        delays = []
+
+        def fn():
+            if not delays:
+                raise ApiError(429, "slow down", retry_after=0.07)
+            return "ok"
+
+        retry.retry_call(
+            fn, policy=retry.RetryPolicy(base=5.0, cap=9.0, deadline=30.0),
+            on_retry=lambda exc, delay: delays.append(delay))
+        # the computed backoff would have been >= 5s; the hint wins
+        assert delays == [0.07]
+
+    def test_classification(self):
+        assert retry.default_retryable(Transient("x"))
+        assert retry.default_retryable(ApiError(500, "boom"))
+        assert retry.default_retryable(ApiError(429, "throttled"))
+        assert retry.default_retryable(ConnectionResetError())
+        assert retry.default_retryable(TimeoutError())
+        assert not retry.default_retryable(NotFound("x"))
+        assert not retry.default_retryable(Conflict("x"))
+        assert not retry.default_retryable(ValueError("x"))
+        assert retry.retryable_or_conflict(Conflict("x"))
+        assert retry.retryable_or_conflict(Transient("x"))
+        assert not retry.retryable_or_conflict(NotFound("x"))
+
+    def test_stop_event_interrupts_backoff(self):
+        stop = threading.Event()
+        stop.set()
+
+        def fn():
+            raise Transient("always")
+
+        t0 = time.monotonic()
+        with pytest.raises(Transient):
+            retry.retry_call(fn, policy=retry.RetryPolicy(
+                base=5.0, cap=9.0, deadline=60.0), stop=stop)
+        assert time.monotonic() - t0 < 1.0
+
+
+# -- Retry-After / Transient client contract -------------------------------
+class TestClientContract:
+    def test_parse_retry_after(self):
+        assert parse_retry_after("7") == 7.0
+        assert parse_retry_after(" 0 ") == 0.0
+        assert parse_retry_after("-3") is None
+        assert parse_retry_after("soon") is None
+        assert parse_retry_after(None) is None
+        from email.utils import format_datetime
+        import datetime
+        when = datetime.datetime.now(datetime.timezone.utc) + \
+            datetime.timedelta(seconds=30)
+        got = parse_retry_after(format_datetime(when, usegmt=True))
+        assert got is not None and 0 <= got <= 31
+        # an HTTP-date in the past clamps to 0, never negative
+        past = datetime.datetime.now(datetime.timezone.utc) - \
+            datetime.timedelta(seconds=600)
+        assert parse_retry_after(format_datetime(past, usegmt=True)) == 0.0
+
+    def test_error_for_carries_retry_after(self):
+        err = error_for(429, "x", retry_after=12.0)
+        assert err.retry_after == 12.0
+        assert retry.retry_after_hint(err) == 12.0
+        assert retry.retry_after_hint(error_for(404, "x")) is None
+
+    def test_request_maps_connection_failures_to_transient(self):
+        # nothing listens on this port: urllib raises URLError, the
+        # client must surface the typed Transient (not urllib internals)
+        client = RestKubeClient(base_url="http://127.0.0.1:1", timeout=0.5)
+        with pytest.raises(Transient) as exc_info:
+            client.get(PODS, "p", "default")
+        assert exc_info.value.transient
+        assert exc_info.value.status == 0
+
+    def test_request_parses_retry_after_header(self, monkeypatch):
+        client = RestKubeClient(base_url="http://example.invalid")
+        import urllib.request as _req
+
+        def urlopen_with_header(req, timeout=None, context=None):
+            hdrs = http.client.HTTPMessage()
+            hdrs["Retry-After"] = "9"
+            raise urllib.error.HTTPError(
+                req.full_url, 429, "Too Many Requests", hdrs,
+                io.BytesIO(b"throttled"))
+
+        monkeypatch.setattr(_req, "urlopen", urlopen_with_header)
+        with pytest.raises(ApiError) as exc_info:
+            client.get(PODS, "p", "default")
+        assert exc_info.value.status == 429
+        assert exc_info.value.retry_after == 9.0
+
+    def test_parse_retry_after_naive_http_date(self):
+        # zone-less HTTP-date (invalid per RFC but seen from proxies):
+        # must parse as UTC, not crash the error-handling path
+        got = parse_retry_after("Wed, 21 Oct 2015 07:28:00")
+        assert got == 0.0   # long past -> clamped
+        assert parse_retry_after("inf") is None
+        assert parse_retry_after("nan") is None
+
+    def test_request_maps_mid_body_failure_to_transient(self, monkeypatch):
+        client = RestKubeClient(base_url="http://example.invalid")
+        import urllib.request as _req
+
+        class TruncatedResponse:
+            def read(self):
+                raise http.client.IncompleteRead(b"half a body")
+
+        monkeypatch.setattr(
+            _req, "urlopen",
+            lambda req, timeout=None, context=None: TruncatedResponse())
+        with pytest.raises(Transient):
+            client.get(PODS, "p", "default")
+
+    def test_kube_request_failpoint_is_the_blackout_switch(self):
+        client = RestKubeClient(base_url="http://127.0.0.1:1", timeout=0.5)
+        failpoint.activate("kube.request=error(Transient)")
+        t0 = time.monotonic()
+        with pytest.raises(Transient):
+            client.get(PODS, "p", "default")
+        # the failpoint fires before the socket: instant, not a timeout
+        assert time.monotonic() - t0 < 0.4
+
+
+# -- circuit breaker -------------------------------------------------------
+class _FlakyInner(FakeKube):
+    """FakeKube whose reads fail with Transient while ``dark`` is set."""
+
+    def __init__(self):
+        super().__init__()
+        self.dark = False
+
+    def get(self, res, name, namespace=None):
+        if self.dark:
+            raise Transient("blackout")
+        return super().get(res, name, namespace)
+
+    def list(self, res, namespace=None, label_selector=None,
+             field_selector=None):
+        if self.dark:
+            raise Transient("blackout")
+        return super().list(res, namespace, label_selector, field_selector)
+
+
+def _fast_client(inner=None, threshold=3, open_duration=0.1):
+    inner = inner or _FlakyInner()
+    breaker = CircuitBreaker(failure_threshold=threshold,
+                             open_duration=open_duration)
+    client = ResilientKubeClient(
+        inner, breaker=breaker,
+        read_policy=retry.RetryPolicy(base=0.001, cap=0.005, deadline=0.05))
+    return client, inner, breaker
+
+
+class TestBreaker:
+    def test_trips_after_consecutive_failures_and_fails_fast(self):
+        client, inner, breaker = _fast_client()
+        inner.dark = True
+        with pytest.raises(Transient):
+            client.get(RESOURCE_CLAIMS, "c", "default")
+        assert breaker.state == STATE_OPEN
+        with pytest.raises(BreakerOpen):
+            client.get(RESOURCE_CLAIMS, "c", "default")
+
+    def test_half_open_probe_closes_on_success(self):
+        client, inner, breaker = _fast_client(open_duration=0.05)
+        inner.dark = True
+        with pytest.raises(Transient):
+            client.list(RESOURCE_CLAIMS, "default")
+        assert breaker.state == STATE_OPEN
+        inner.dark = False
+        time.sleep(0.08)
+        assert breaker.state == STATE_HALF_OPEN
+        client.list(RESOURCE_CLAIMS, "default")   # the probe
+        assert breaker.state == STATE_CLOSED
+
+    def test_half_open_still_counts_as_dark(self):
+        # remediation suppression must hold through HALF_OPEN: the probe
+        # has not yet proven the API server back (code-review finding —
+        # a half-open window used to lift the blackout suppression)
+        client, inner, breaker = _fast_client(open_duration=0.05)
+        inner.dark = True
+        with pytest.raises(Transient):
+            client.list(RESOURCE_CLAIMS, "default")
+        assert breaker.is_open()
+        time.sleep(0.08)
+        assert breaker.state == STATE_HALF_OPEN
+        assert breaker.is_open(), "half-open must still read as dark"
+        inner.dark = False
+        client.list(RESOURCE_CLAIMS, "default")
+        assert not breaker.is_open()
+
+    def test_half_open_probe_failure_reopens(self):
+        client, inner, breaker = _fast_client(open_duration=0.05)
+        inner.dark = True
+        with pytest.raises(Transient):
+            client.list(RESOURCE_CLAIMS, "default")
+        time.sleep(0.08)
+        with pytest.raises(Transient):
+            client.list(RESOURCE_CLAIMS, "default")   # probe fails
+        assert breaker.state == STATE_OPEN
+
+    def test_typed_4xx_does_not_trip_breaker(self):
+        client, inner, breaker = _fast_client(threshold=2)
+        for _ in range(5):
+            with pytest.raises(NotFound):
+                client.get(RESOURCE_CLAIMS, "absent", "default")
+        assert breaker.state == STATE_CLOSED
+
+    def test_mutations_not_blind_retried_on_transient(self):
+        calls = []
+
+        class CountingInner(FakeKube):
+            def create(self, res, obj, namespace=None):
+                calls.append(1)
+                raise Transient("connection dropped mid-flight")
+
+        client, _, _ = _fast_client(inner=CountingInner(), threshold=50)
+        with pytest.raises(Transient):
+            client.create(RESOURCE_CLAIMS, {"metadata": {"name": "c"}},
+                          "default")
+        assert len(calls) == 1, "a create may have committed server-side"
+
+    def test_mutation_retries_on_429(self):
+        calls = []
+
+        class ThrottlingInner(FakeKube):
+            def create(self, res, obj, namespace=None):
+                calls.append(1)
+                if len(calls) < 3:
+                    raise ApiError(429, "throttled", retry_after=0.005)
+                return super().create(res, obj, namespace)
+
+        client, _, _ = _fast_client(inner=ThrottlingInner(), threshold=50)
+        out = client.create(RESOURCE_CLAIMS, {"metadata": {"name": "c"}},
+                            "default")
+        assert out["metadata"]["name"] == "c"
+        assert len(calls) == 3
+
+    def test_breaker_state_metric_flips(self):
+        _, inner, breaker = _fast_client()
+        from tpu_dra.util.metrics import DEFAULT_REGISTRY
+        text = DEFAULT_REGISTRY.expose()
+        assert 'tpu_dra_client_breaker_state{state="closed"} 1.0' in text
+
+
+# -- API-blackout degradation ----------------------------------------------
+class _BlackoutKube(FakeKube):
+    """FakeKube with a breaker-shaped blackout switch: while ``dark``,
+    every verb raises Transient and ``breaker.is_open()`` reports True —
+    the duck-typed surface the TpuDriver degradation paths key on."""
+
+    class _Breaker:
+        def __init__(self, outer):
+            self._outer = outer
+
+        def is_open(self):
+            return self._outer.dark
+
+    def __init__(self):
+        super().__init__()
+        self.dark = False
+        self.breaker = self._Breaker(self)
+
+    def _check(self):
+        if self.dark:
+            raise Transient("blackout")
+
+    def get(self, res, name, namespace=None):
+        self._check()
+        return super().get(res, name, namespace)
+
+    def create(self, res, obj, namespace=None):
+        self._check()
+        return super().create(res, obj, namespace)
+
+    def update(self, res, obj, namespace=None):
+        self._check()
+        return super().update(res, obj, namespace)
+
+    def delete(self, res, name, namespace=None):
+        self._check()
+        return super().delete(res, name, namespace)
+
+
+def _make_driver(tmp_path, kube, lib, **overrides):
+    from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+    cfg = dict(
+        node_name="node-a", tpulib=lib, kube=kube,
+        plugins_dir=str(tmp_path / "plugins"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=2.0,
+        health_interval=0,            # poll manually: deterministic
+        health_fail_threshold=2, health_pass_threshold=1)
+    cfg.update(overrides)
+    return TpuDriver(TpuDriverConfig(**cfg))
+
+
+def _claim_dict(uid="uid-bl", name="c-bl", device="tpu-1"):
+    from tpu_dra.version import DRIVER_NAME
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default", "uid": uid},
+        "spec": {},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME, "pool": "node-a",
+             "device": device}]}}},
+    }
+
+
+class TestBlackoutDegradation:
+    def test_prepare_served_from_checkpoint_during_blackout(self, tmp_path):
+        from tpu_dra.kubeletplugin.server import ClaimRef
+        from tpu_dra.tpulib import FakeTpuLib
+
+        kube = _BlackoutKube()
+        drv = _make_driver(tmp_path, kube, FakeTpuLib())
+        claim = _claim_dict()
+        kube.create(RESOURCE_CLAIMS, dict(claim))
+        devices = drv.state.prepare(claim)
+        assert devices
+
+        kube.dark = True
+        ref = ClaimRef(namespace="default", uid="uid-bl", name="c-bl")
+        claims, errors, cached = drv.server.fetch_claims([ref])
+        assert claims == [] and errors == {}
+        result = cached["uid-bl"]
+        assert result.error == ""
+        assert result.devices[0]["device_name"] == "tpu-1"
+        assert result.devices[0]["cdi_device_ids"]
+
+        # a claim the checkpoint does NOT know fails with a typed error
+        unknown = ClaimRef(namespace="default", uid="uid-x", name="c-x")
+        _, errors, cached = drv.server.fetch_claims([unknown])
+        assert "uid-x" in errors and "unreachable" in errors["uid-x"]
+        assert cached == {}
+
+        # a checkpointed claim whose CDI spec vanished (tmpfs cdi-root
+        # after reboot) must fail typed, not report success for devices
+        # kubelet cannot resolve (code-review finding)
+        import os
+        os.unlink(drv.state.cdi.claim_spec_path("uid-bl"))
+        _, errors, cached = drv.server.fetch_claims([ref])
+        assert cached == {}
+        assert "uid-bl" in errors and "unreachable" in errors["uid-bl"]
+
+    def test_remediation_suppressed_then_replayed(self, tmp_path):
+        from tpu_dra.k8s import NotFound
+        from tpu_dra.plugins.tpu.driver import REMEDIATION_UNPREPARE
+        from tpu_dra.tpulib import FakeTpuLib
+
+        kube = _BlackoutKube()
+        lib = FakeTpuLib()
+        drv = _make_driver(tmp_path, kube, lib,
+                           remediation=REMEDIATION_UNPREPARE)
+        claim = _claim_dict()
+        kube.create(RESOURCE_CLAIMS, dict(claim))
+        drv.state.prepare(claim)
+
+        # blackout first, THEN the chip fails: the transition fires but
+        # remediation must be suppressed (no unprepare, no delete)
+        kube.dark = True
+        lib.fail_chip(1)
+        drv.health.poll_once()
+        drv.health.poll_once()   # fail_threshold=2 -> Unhealthy edge
+        assert "uid-bl" in drv.state.prepared_claims(), \
+            "remediation ran during the API blackout"
+        assert kube.dark  # sanity: still dark
+
+        # blackout ends: the deferred remediation replays on the next
+        # poll — claim unprepared node-side and evicted
+        kube.dark = False
+        drv.health.poll_once()
+        assert "uid-bl" not in drv.state.prepared_claims()
+        with pytest.raises(NotFound):
+            FakeKube.get(kube, RESOURCE_CLAIMS, "c-bl", "default")
+
+    def test_deferred_remediation_dropped_if_chip_recovered(self, tmp_path):
+        from tpu_dra.plugins.tpu.driver import REMEDIATION_UNPREPARE
+        from tpu_dra.tpulib import FakeTpuLib
+
+        kube = _BlackoutKube()
+        lib = FakeTpuLib()
+        drv = _make_driver(tmp_path, kube, lib,
+                           remediation=REMEDIATION_UNPREPARE)
+        claim = _claim_dict()
+        kube.create(RESOURCE_CLAIMS, dict(claim))
+        drv.state.prepare(claim)
+
+        kube.dark = True
+        lib.fail_chip(1)
+        drv.health.poll_once()
+        drv.health.poll_once()
+        # the chip recovers while the API is still dark
+        lib.recover_chip(1)
+        drv.health.poll_once()   # pass_threshold=1 -> Recovered
+        kube.dark = False
+        drv.health.poll_once()
+        # nothing to remediate anymore: the claim survives
+        assert "uid-bl" in drv.state.prepared_claims()
+        assert FakeKube.get(kube, RESOURCE_CLAIMS, "c-bl", "default")

@@ -36,7 +36,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
                    "string-constant-drift", "exception-hygiene",
-                   "metric-hygiene"}
+                   "metric-hygiene", "retry-hygiene"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,
@@ -263,6 +263,112 @@ def test_reconcile_sleep_rule_does_not_fire_outside_scope(tmp_path):
     src = "import time\n\n\ndef f():\n    while True:\n        time.sleep(1)\n"
     assert vet_snippet(tmp_path, "tpu_dra/api/out.py", src,
                        checks=["reconcile-hygiene"]) == []
+
+
+# -------------------------------------------------------------------------
+# retry-hygiene
+# -------------------------------------------------------------------------
+_RETRY_BAD = """\
+import time
+
+
+def sleepy_retry(fn):
+    while True:
+        try:
+            return fn()
+        except OSError:
+            time.sleep(1)
+
+
+def bounded_retry(fn):
+    for _ in range(5):
+        try:
+            return fn()
+        except OSError:
+            continue
+"""
+
+_RETRY_CLEAN = """\
+from tpu_dra.resilience import retry
+
+
+def good(fn):
+    return retry.retry_call(fn, policy=retry.STATUS_WRITE_POLICY)
+
+
+def per_item_fanout(items, fn):
+    out = []
+    for item in items:       # iterating DATA, not attempts: no finding
+        try:
+            out.append(fn(item))
+        except OSError:
+            continue
+    return out
+"""
+
+
+def test_retry_hygiene_flags_sleep_loops_and_range_retries(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/api/rt.py", _RETRY_BAD,
+                        checks=["retry-hygiene"])
+    assert len(diags) == 2
+    msgs = sorted(d.message for d in diags)
+    assert "hand-rolled sleep/backoff loop" in msgs[1]
+    assert "bounded range() retry loop" in msgs[0]
+
+
+def test_retry_hygiene_clean_patterns_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/api/rt2.py", _RETRY_CLEAN,
+                       checks=["retry-hygiene"]) == []
+
+
+def test_retry_hygiene_nested_data_loop_inside_range_is_clean(tmp_path):
+    # an except/continue in an inner DATA loop belongs to that loop,
+    # not to the outer range() attempt counter (code-review finding);
+    # likewise a sleep inside a function merely DEFINED in a loop
+    src = """\
+import time
+
+
+def shard_fanout(n_shards, items, fn):
+    for shard in range(n_shards):
+        for item in items:
+            try:
+                fn(shard, item)
+            except OSError:
+                continue
+
+
+def factories(n):
+    out = []
+    for i in range(n):
+        def waiter():
+            time.sleep(1)
+        out.append(waiter)
+    return out
+"""
+    assert vet_snippet(tmp_path, "tpu_dra/api/rt5.py", src,
+                       checks=["retry-hygiene"]) == []
+
+
+def test_retry_hygiene_one_finding_per_sleep_in_nested_loops(tmp_path):
+    src = ("import time\n\n\ndef f(xs):\n    while True:\n"
+           "        for x in xs:\n            time.sleep(1)\n")
+    diags = vet_snippet(tmp_path, "tpu_dra/api/rt6.py", src,
+                        checks=["retry-hygiene"])
+    assert len(diags) == 1
+
+
+def test_retry_hygiene_exempts_resilience_dir(tmp_path):
+    # the one place allowed to sleep: the retry implementation itself
+    assert vet_snippet(tmp_path, "tpu_dra/resilience/rt3.py", _RETRY_BAD,
+                       checks=["retry-hygiene"]) == []
+
+
+def test_retry_hygiene_ignore_escape(tmp_path):
+    src = ("import time\n\n\ndef pacer():\n    while True:\n"
+           "        time.sleep(0.1)  # vet: ignore[retry-hygiene]\n")
+    assert vet_snippet(tmp_path, "tpu_dra/api/rt4.py", src,
+                       checks=["retry-hygiene"]) == []
 
 
 # -------------------------------------------------------------------------

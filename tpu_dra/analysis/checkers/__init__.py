@@ -13,4 +13,5 @@ from tpu_dra.analysis.checkers import (  # noqa: F401
     jitpurity,
     metrichygiene,
     reconcile,
+    retryhygiene,
 )

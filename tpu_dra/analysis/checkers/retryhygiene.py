@@ -1,0 +1,131 @@
+"""retry-hygiene: no hand-rolled retry loops outside the resilience layer.
+
+PR 4 centralized retry into ``tpu_dra/resilience/retry.py`` (exponential
+backoff with decorrelated jitter, overall deadline, typed retryable
+classification honoring ``Retry-After``).  Before that, retries were ad
+hoc and inconsistent — fixed ``for _ in range(5)`` loops, private
+doubling backoffs, bare sleeps — each with its own bugs (no jitter ⇒
+synchronized retry storms; no deadline ⇒ shutdown hangs; no
+classification ⇒ retrying 404s).  This checker keeps them from growing
+back.  Two rules over non-test ``tpu_dra/`` code, excluding
+``tpu_dra/resilience/`` (the one place allowed to sleep):
+
+1. ``time.sleep`` inside a ``while``/``for`` body is a hand-rolled
+   backoff or pacing loop.  Use
+   :func:`tpu_dra.resilience.retry.retry_call` (or an interruptible
+   ``Event.wait``) — or carry a justified
+   ``# vet: ignore[retry-hygiene]`` (e.g. the kube client's
+   token-bucket pacer, which *is* the pacing primitive).
+
+2. ``for ... in range(...)`` whose body contains an ``except`` handler
+   ending in ``continue`` is a bounded retry loop (the old
+   ``membership.update_own_node_info(retries=5)`` shape): fixed
+   attempt counts with no backoff, no jitter, no deadline.  Same
+   remedy.
+
+Overlaps rule 1 of ``reconcile-hygiene`` on its narrower scope by
+design: that checker says "make the wait interruptible", this one says
+"use the central policy"; a justified sleep needs both ignores, which
+is exactly the friction a new bare retry loop should meet.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_EXEMPT = ("tpu_dra/resilience",)
+
+
+def _is_time_sleep(node: ast.Call) -> bool:
+    fn = node.func
+    return (isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "time")
+
+
+def _is_range_loop(node: ast.For) -> bool:
+    it = node.iter
+    return isinstance(it, ast.Call) and \
+        isinstance(it.func, ast.Name) and it.func.id == "range"
+
+
+def _handler_retries(handler: ast.ExceptHandler) -> bool:
+    """The handler's control flow loops back for another attempt: its
+    last statement is ``continue`` (or it is a bare ``pass`` body, which
+    falls through to the next iteration)."""
+    if not handler.body:
+        return False
+    last = handler.body[-1]
+    return isinstance(last, (ast.Continue, ast.Pass))
+
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_same_iteration(node: ast.AST, *, through_loops: bool):
+    """Descendants that execute as part of THIS node's iteration: never
+    descend into nested function definitions (their bodies run when
+    called, not per loop pass); with ``through_loops=False`` also stop
+    at nested loops — a ``continue``/``sleep`` in an inner data loop
+    belongs to that loop, not to the one under inspection."""
+    stack = [iter(ast.iter_child_nodes(node))]
+    while stack:
+        try:
+            child = next(stack[-1])
+        except StopIteration:
+            stack.pop()
+            continue
+        if isinstance(child, _NESTED_SCOPES):
+            continue
+        if not through_loops and isinstance(child, (ast.For, ast.While)):
+            continue
+        yield child
+        stack.append(iter(ast.iter_child_nodes(child)))
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.in_dir(*_EXEMPT):
+        return []
+    diags: list[Diagnostic] = []
+    flagged_sleeps: set[tuple[int, int]] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.While, ast.For)):
+            # through_loops=True: a sleep anywhere under the loop nest
+            # still paces the outer loop; nested defs are excluded.
+            # The seen-set keeps a sleep in nested loops to ONE finding.
+            for sub in _walk_same_iteration(node, through_loops=True):
+                if isinstance(sub, ast.Call) and _is_time_sleep(sub):
+                    key = (sub.lineno, sub.col_offset)
+                    if key in flagged_sleeps:
+                        continue
+                    flagged_sleeps.add(key)
+                    diags.append(ctx.diag(
+                        sub, "retry-hygiene",
+                        "hand-rolled sleep/backoff loop: use "
+                        "tpu_dra.resilience.retry.retry_call (jittered "
+                        "backoff, deadline, typed classification) or "
+                        "justify with # vet: ignore[retry-hygiene]"))
+        if isinstance(node, ast.For) and _is_range_loop(node):
+            # through_loops=False: an except/continue inside a nested
+            # DATA loop targets that loop, not the attempt counter
+            for sub in _walk_same_iteration(node, through_loops=False):
+                if isinstance(sub, ast.ExceptHandler) and \
+                        _handler_retries(sub):
+                    diags.append(ctx.diag(
+                        node, "retry-hygiene",
+                        "bounded range() retry loop with except/continue: "
+                        "use tpu_dra.resilience.retry.retry_call instead "
+                        "of a fixed attempt count with no backoff or "
+                        "deadline"))
+                    break
+    return diags
+
+
+register(Analyzer(
+    name="retry-hygiene",
+    doc="retry loops must go through tpu_dra/resilience/retry.py, not "
+        "hand-rolled time.sleep or range() attempt loops",
+    run=_run,
+    scope=("tpu_dra",),
+))

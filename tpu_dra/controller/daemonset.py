@@ -143,19 +143,21 @@ class DaemonSetManager:
         if current == new_status:
             return
         from tpu_dra.api.types import TpuSliceDomainStatus
-        # the write races the daemons' own status.nodes updates exactly when
-        # readiness flips — retry the GET→PUT on conflict
-        for attempt in range(5):
+        from tpu_dra.resilience import retry
+
+        # the write races the daemons' own status.nodes updates exactly
+        # when readiness flips — the centralized status-write policy
+        # re-fetches and retries Conflicts with jittered backoff
+        def write() -> None:
             fresh = TpuSliceDomain.from_dict(self.kube.get(
                 TPU_SLICE_DOMAINS, domain.name, domain.namespace))
             if fresh.status is None:
                 fresh.status = TpuSliceDomainStatus()
             fresh.status.status = new_status
-            try:
-                self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
-                break
-            except Conflict:
-                if attempt == 4:
-                    raise
+            self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+
+        retry.retry_call(write, policy=retry.STATUS_WRITE_POLICY,
+                         retryable=retry.retryable_or_conflict,
+                         op="daemonset.sync_readiness")
         klog.info("slice domain status updated", domain=domain.name,
                   status=new_status, ready=ready, desired=desired)

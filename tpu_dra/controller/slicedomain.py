@@ -27,9 +27,15 @@ from tpu_dra.k8s.client import Conflict, KubeClient, NotFound, \
 from tpu_dra.k8s.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, \
     emit_event
 from tpu_dra.k8s.informer import Informer, uid_index
+from tpu_dra.resilience import failpoint
 from tpu_dra.trace import get_tracer, propagation
 from tpu_dra.util import klog
 from tpu_dra.util.workqueue import WorkQueue
+
+_FP_RECONCILE = failpoint.register(
+    "controller.reconcile",
+    "top of every TpuSliceDomain reconcile (error here exercises the "
+    "workqueue's per-item backoff)")
 
 
 class SliceDomainManager:
@@ -101,6 +107,7 @@ class SliceDomainManager:
                 self._reconciles.inc("ok")
 
     def _reconcile(self, obj: dict) -> None:
+        failpoint.hit("controller.reconcile")
         domain = TpuSliceDomain.from_dict(obj)
         if domain.deleting:
             self._teardown(domain)

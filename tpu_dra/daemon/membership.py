@@ -18,9 +18,15 @@ from tpu_dra.api.types import (
     TpuSliceDomainNode,
     TpuSliceDomainStatus,
 )
-from tpu_dra.k8s.client import Conflict, KubeClient, TPU_SLICE_DOMAINS
+from tpu_dra.k8s.client import KubeClient, TPU_SLICE_DOMAINS
 from tpu_dra.k8s.informer import Informer
+from tpu_dra.resilience import failpoint, retry
 from tpu_dra.util import klog
+
+_FP_UPDATE = failpoint.register(
+    "daemon.membership.update",
+    "each attempt to publish this node's info into the domain status "
+    "(error here exercises the centralized retry policy)")
 
 
 class MembershipManager:
@@ -85,30 +91,39 @@ class MembershipManager:
         self.update_own_node_info()
 
     # -- status writes (computedomain.go:145-193) --------------------------
-    def update_own_node_info(self, retries: int = 5) -> None:
-        for _ in range(retries):
-            try:
-                obj = self.kube.get(TPU_SLICE_DOMAINS, self.domain_name,
-                                    self.domain_namespace)
-                domain = TpuSliceDomain.from_dict(obj)
-                if domain.status is None:
-                    domain.status = TpuSliceDomainStatus()
-                nodes = [n for n in domain.status.nodes
-                         if n.name != self.self_node.name]
-                nodes.append(self.self_node)
-                nodes.sort(key=lambda n: n.name)
-                if [n.to_dict() for n in nodes] == \
-                        [n.to_dict() for n in domain.status.nodes]:
-                    return
-                domain.status.nodes = nodes
-                self.kube.update_status(TPU_SLICE_DOMAINS, domain.to_dict())
-                klog.info("published node info to domain status", level=2,
-                          node=self.self_node.name, ip=self.self_node.ip_address)
+    def update_own_node_info(self) -> None:
+        """GET→mutate→PUT of our entry in ``status.nodes``, on the
+        centralized status-write retry policy: Conflicts (racing sibling
+        daemons) and transient API failures re-fetch and retry with
+        jittered backoff until the policy's deadline."""
+        def attempt() -> None:
+            failpoint.hit("daemon.membership.update")
+            obj = self.kube.get(TPU_SLICE_DOMAINS, self.domain_name,
+                                self.domain_namespace)
+            domain = TpuSliceDomain.from_dict(obj)
+            if domain.status is None:
+                domain.status = TpuSliceDomainStatus()
+            nodes = [n for n in domain.status.nodes
+                     if n.name != self.self_node.name]
+            nodes.append(self.self_node)
+            nodes.sort(key=lambda n: n.name)
+            if [n.to_dict() for n in nodes] == \
+                    [n.to_dict() for n in domain.status.nodes]:
                 return
-            except Conflict:
-                continue   # raced another daemon; re-fetch and retry
-        klog.warning("could not publish node info after retries",
-                     node=self.self_node.name)
+            domain.status.nodes = nodes
+            self.kube.update_status(TPU_SLICE_DOMAINS, domain.to_dict())
+            klog.info("published node info to domain status", level=2,
+                      node=self.self_node.name,
+                      ip=self.self_node.ip_address)
+
+        try:
+            retry.retry_call(attempt, policy=retry.STATUS_WRITE_POLICY,
+                             retryable=retry.retryable_or_conflict,
+                             op="membership.update_own_node_info")
+        except Exception as exc:  # noqa: BLE001 — best-effort publish:
+            # the informer re-triggers it on the next domain change
+            klog.warning("could not publish node info after retries",
+                         node=self.self_node.name, err=repr(exc))
 
     # -- membership detection (computedomain.go:198-220) -------------------
     def _on_change(self, obj: dict) -> None:

@@ -12,7 +12,13 @@ import subprocess
 import threading
 from typing import Callable, Optional
 
+from tpu_dra.resilience import failpoint
 from tpu_dra.util import klog
+
+_FP_SPAWN = failpoint.register(
+    "daemon.child.spawn",
+    "before the supervised child process is spawned (error(OSError) "
+    "exercises the spawn-failure watchdog retry path)")
 
 
 class ProcessManager:
@@ -43,6 +49,7 @@ class ProcessManager:
         self._stopping = False
         self._ever_started = True   # "start requested": watchdog may retry
         try:
+            failpoint.hit("daemon.child.spawn")
             self._proc = subprocess.Popen(argv)
         except OSError as exc:
             # Spawn failure (ENOEXEC/ENOENT) must not unwind the caller's

@@ -15,6 +15,7 @@ from tpu_dra.k8s.client import (  # noqa: F401
     NotFound,
     ResourceDesc,
     RestKubeClient,
+    Transient,
     DAEMONSETS,
     DEPLOYMENTS,
     EVENTS,

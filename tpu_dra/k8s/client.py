@@ -21,14 +21,25 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator, Optional
 
+from tpu_dra.resilience import failpoint
 from tpu_dra.util import klog
+
+# every API request funnels through _request — one failpoint covers the
+# whole client surface (a blackout is `kube.request=error(Transient)`)
+_FP_REQUEST = failpoint.register(
+    "kube.request", "before any HTTP request leaves the REST client "
+    "(error(Transient) here = full API-server blackout)")
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str = ""):
+    def __init__(self, status: int, message: str = "",
+                 retry_after: Optional[float] = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        # server-provided Retry-After (seconds), parsed from 429/503
+        # responses; the retry policy prefers it over computed backoff
+        self.retry_after = retry_after
 
 
 class NotFound(ApiError):
@@ -52,14 +63,56 @@ class Gone(ApiError):
         super().__init__(410, message)
 
 
-def error_for(status: int, message: str = "") -> ApiError:
+class Transient(ApiError):
+    """Connection-level failure: the request may never have reached the
+    server (refused/reset/timeout/DNS).  Raised instead of leaking
+    ``urllib`` internals to callers; ``status`` is 0 because no HTTP
+    response exists.  ``transient = True`` is the duck-typed marker the
+    retry classification keys on (``tpu_dra.resilience.retry``)."""
+
+    transient = True
+
+    def __init__(self, message: str = ""):
+        super().__init__(0, message)
+
+
+def error_for(status: int, message: str = "",
+              retry_after: Optional[float] = None) -> ApiError:
     if status == 404:
         return NotFound(message)
     if status == 409:
         return Conflict(message)
     if status == 410:
         return Gone(message)
-    return ApiError(status, message)
+    return ApiError(status, message, retry_after=retry_after)
+
+
+def parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """``Retry-After`` header → seconds: either a non-negative integer
+    or an HTTP-date (RFC 9110 §10.2.3)."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        secs = float(value)
+        import math
+        return secs if secs >= 0 and math.isfinite(secs) else None
+    except ValueError:
+        pass
+    from email.utils import parsedate_to_datetime
+    try:
+        when = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    import datetime
+    if when.tzinfo is None:
+        # zone-less HTTP-date (technically invalid, seen from proxies):
+        # assume UTC rather than crashing the error-handling path on a
+        # naive-vs-aware subtraction
+        when = when.replace(tzinfo=datetime.timezone.utc)
+    delta = (when - datetime.datetime.now(datetime.timezone.utc)
+             ).total_seconds()
+    return max(delta, 0.0)
 
 
 @dataclass(frozen=True)
@@ -154,7 +207,7 @@ class _TokenBucket:
             # A bare sleep is the token-bucket pacing primitive itself
             # (client-go's rate limiter blocks identically), not a retry
             # loop — there is nothing to back off from or interrupt.
-            time.sleep(wait)  # vet: ignore[reconcile-hygiene]
+            time.sleep(wait)  # vet: ignore[reconcile-hygiene, retry-hygiene]
 
 
 class KubeClient:
@@ -256,6 +309,7 @@ class RestKubeClient(KubeClient):
                  content_type: str = "application/json",
                  stream: bool = False):
         self._bucket.take()
+        failpoint.hit("kube.request")
         url = self.base_url + path
         if query:
             url += "?" + urllib.parse.urlencode(
@@ -281,10 +335,24 @@ class RestKubeClient(KubeClient):
             # typed error_for raise below must still happen
             except (OSError, ValueError, http.client.HTTPException):
                 pass   # body unreadable: report the bare status code
-            raise error_for(exc.code, msg) from exc
+            retry_after = None
+            if exc.code in (429, 503):
+                retry_after = parse_retry_after(
+                    exc.headers.get("Retry-After"))
+            raise error_for(exc.code, msg, retry_after=retry_after) from exc
+        except (urllib.error.URLError, TimeoutError, ConnectionResetError,
+                http.client.HTTPException, OSError) as exc:
+            # connection-level failure (refused/reset/timeout/DNS/TLS):
+            # callers get the typed Transient, never raw urllib internals
+            raise Transient(f"{method} {path}: {exc!r}") from exc
         if stream:
             return resp
-        payload = resp.read()
+        try:
+            payload = resp.read()
+        except (TimeoutError, http.client.HTTPException, OSError) as exc:
+            # connection dropped mid-body (IncompleteRead, reset): still
+            # a connection-level failure — same typed mapping as above
+            raise Transient(f"{method} {path}: body read: {exc!r}") from exc
         return json.loads(payload) if payload else {}
 
     # -- KubeClient --------------------------------------------------------
@@ -367,9 +435,27 @@ class RestKubeClient(KubeClient):
             resp.close()
 
 
+def _wrap_resilient(client: KubeClient) -> KubeClient:
+    """Every binary's client goes through the retry/circuit-breaker
+    wrapper (docs/resilience.md).  Imported lazily: resilience.breaker
+    imports this module back.  Breaker tuning comes from the
+    environment (operator knob + chaos drives), not flags — the
+    defaults are right for production."""
+    import os
+    from tpu_dra.resilience.breaker import CircuitBreaker, \
+        ResilientKubeClient
+    breaker = CircuitBreaker(
+        failure_threshold=int(
+            os.environ.get("TPU_DRA_BREAKER_THRESHOLD", "5")),
+        open_duration=float(
+            os.environ.get("TPU_DRA_BREAKER_OPEN_SECONDS", "15")))
+    return ResilientKubeClient(client, breaker=breaker)
+
+
 def new_clients(kubeconfig: Optional[str] = None, qps: float = 50.0,
                 burst: int = 100) -> KubeClient:
-    """Build the client set — analog of kubeclient.go:95-115.
+    """Build the client set — analog of kubeclient.go:95-115, wrapped in
+    the resilience layer's retry + circuit breaker.
 
     ``kubeconfig`` supports the shape written by kind/GKE: the
     current-context's cluster + user, with inline ``*-data`` fields
@@ -377,7 +463,7 @@ def new_clients(kubeconfig: Optional[str] = None, qps: float = 50.0,
     or file paths, bearer tokens, and ``insecure-skip-tls-verify``.
     """
     if not kubeconfig:
-        return RestKubeClient(qps=qps, burst=burst)
+        return _wrap_resilient(RestKubeClient(qps=qps, burst=burst))
     import base64
     import tempfile
     import yaml
@@ -409,7 +495,7 @@ def new_clients(kubeconfig: Optional[str] = None, qps: float = 50.0,
         client_cert = (_dump(user["client-certificate-data"], ".crt"),
                        _dump(user["client-key-data"], ".key"))
 
-    return RestKubeClient(
+    return _wrap_resilient(RestKubeClient(
         base_url=cluster["server"],
         token=user.get("token"),
         ca_file=cluster.get("certificate-authority"),
@@ -417,4 +503,4 @@ def new_clients(kubeconfig: Optional[str] = None, qps: float = 50.0,
         client_cert=client_cert,
         insecure_skip_tls_verify=bool(
             cluster.get("insecure-skip-tls-verify")),
-        qps=qps, burst=burst)
+        qps=qps, burst=burst))

@@ -15,10 +15,21 @@ import time
 from typing import Any, Callable, Optional
 
 from tpu_dra.k8s.client import KubeClient, ResourceDesc
+from tpu_dra.resilience import failpoint
+from tpu_dra.resilience.retry import Backoff
 from tpu_dra.util import klog
 from tpu_dra.util.metrics import DEFAULT_REGISTRY
 
 IndexFunc = Callable[[dict], list[str]]
+
+# arm `informer.watch=error(Gone)` to force the 410-compaction relist
+# path, `=error(Transient)` for the resume-from-last-RV path — the
+# systematic stand-ins for the FakeKube etcd-compaction hack
+_FP_RELIST = failpoint.register(
+    "informer.relist", "before an informer's full list+diff pass")
+_FP_WATCH = failpoint.register(
+    "informer.watch", "before an informer (re-)establishes its watch "
+    "stream (error(Gone) forces the 410 relist path)")
 
 
 def _informer_metrics() -> dict:
@@ -209,6 +220,7 @@ class Informer:
         """Full list + diff-dispatch; returns the listing's RV.  Only
         re-delivers UNCHANGED objects when a resync is due (client-go
         resync semantics — see resync_period above)."""
+        failpoint.hit("informer.relist")
         listing = self.client.list(
             self.resource, namespace=self.namespace,
             label_selector=self.label_selector,
@@ -251,7 +263,9 @@ class Informer:
         """
         from tpu_dra.k8s.client import Gone
 
-        backoff = 0.2
+        # decorrelated jitter (resilience/retry.py): informers across a
+        # fleet that lost the same API server must not relist in lockstep
+        backoff = Backoff(base=0.2, cap=5.0)
         last_rv = ""       # "" => list before watching
         fails = 0
         while not self._stop.is_set():
@@ -260,8 +274,9 @@ class Informer:
                               >= self.resync_period)
                 if not last_rv or resync_due:
                     last_rv = self._relist()
-                backoff = 0.2
-                fails = 0
+                    backoff.reset()
+                    fails = 0
+                failpoint.hit("informer.watch")
                 for ev_type, obj in self.client.watch(
                         self.resource, namespace=self.namespace,
                         label_selector=self.label_selector,
@@ -269,6 +284,13 @@ class Informer:
                         resource_version=last_rv, stop=self._stop):
                     if self._stop.is_set():
                         return
+                    # the reset lives HERE, not before the watch call:
+                    # resetting on mere (re-)establishment would keep a
+                    # persistently-failing watch at the minimum delay
+                    # forever and make the fails>=4 relist fallback
+                    # unreachable — only delivered events prove health
+                    backoff.reset()
+                    fails = 0
                     rv = obj.get("metadata", {}).get("resourceVersion")
                     if rv:
                         last_rv = rv
@@ -288,8 +310,15 @@ class Informer:
                             self._dispatch("add", obj)
                         else:
                             self._dispatch("update", old, obj)
-                # clean end: loop re-watches from last_rv (no relist
-                # unless the resync period says one is due)
+                # clean end (server watch timeout): the server just
+                # served us a whole healthy watch session — reset the
+                # failure budget so sporadic blips on QUIET resources
+                # (days apart, each followed by hours of healthy
+                # watching) can never accumulate into a spurious relist
+                backoff.reset()
+                fails = 0
+                # loop re-watches from last_rv (no relist unless the
+                # resync period says one is due)
             except Gone as exc:
                 if self._stop.is_set():
                     return
@@ -303,11 +332,12 @@ class Informer:
                 if fails >= 4:
                     # persistent failure: stop trusting the resume point
                     last_rv = ""
+                delay = backoff.next()
                 klog.warning("informer list/watch failed; retrying",
                              resource=self.resource.plural, err=repr(exc),
-                             backoff=backoff, resume_rv=last_rv or "(list)")
-                self._stop.wait(backoff)
-                backoff = min(backoff * 2, 5.0)
+                             backoff=round(delay, 3),
+                             resume_rv=last_rv or "(list)")
+                self._stop.wait(delay)
 
 
 def _rv(obj: dict) -> int:

@@ -29,12 +29,18 @@ from typing import Callable, Optional
 import grpc
 
 from tpu_dra.k8s.client import KubeClient, NotFound, RESOURCE_CLAIMS, \
-    RESOURCE_SLICES
+    RESOURCE_SLICES, Transient
 from tpu_dra.kubeletplugin.proto import (  # noqa: F401 (sys.path setup)
     dra_v1beta1_pb2 as dra_pb,
     pluginregistration_pb2 as reg_pb,
 )
+from tpu_dra.resilience import failpoint
 from tpu_dra.util import klog
+
+_FP_PUBLISH = failpoint.register(
+    "plugin.publish_resources",
+    "before a ResourceSlice create/update (error here exercises the "
+    "health republisher's self-heal-on-next-poll path)")
 
 
 @dataclass
@@ -57,10 +63,20 @@ class PrepareResult:
 class DriverCallbacks:
     """The seam the two plugins implement (reference
     ``PrepareResourceClaims``/``UnprepareResourceClaims``,
-    gpu driver.go:97-118)."""
+    gpu driver.go:97-118).
+
+    ``cached_prepare`` is the API-blackout degradation hook
+    (docs/resilience.md): when the claim object cannot be fetched
+    because the API server is unreachable (``Transient``, breaker
+    open), the server asks the driver for a checkpoint-backed result
+    instead of failing the claim — a node whose workloads are already
+    placed must keep serving kubelet retries through an apiserver
+    outage."""
 
     prepare: Callable[[list[dict]], dict[str, PrepareResult]]
     unprepare: Callable[[list[ClaimRef]], dict[str, str]]
+    cached_prepare: Optional[
+        Callable[[ClaimRef], Optional[PrepareResult]]] = None
 
 
 class _DRAService:
@@ -72,8 +88,9 @@ class _DRAService:
         klog.info("NodePrepareResources", level=6,
                   claims=[r.uid for r in refs])
         response = dra_pb.NodePrepareResourcesResponse()
-        claims, fetch_errors = self.plugin.fetch_claims(refs)
+        claims, fetch_errors, cached = self.plugin.fetch_claims(refs)
         results = self.plugin.callbacks.prepare(claims) if claims else {}
+        results.update(cached)   # checkpoint-served (API blackout)
         for ref in refs:
             out = response.claims[ref.uid]
             if ref.uid in fetch_errors:
@@ -197,12 +214,22 @@ class KubeletPluginServer:
 
     # -- claims ------------------------------------------------------------
     def fetch_claims(self, refs: list[ClaimRef]
-                     ) -> tuple[list[dict], dict[str, str]]:
+                     ) -> tuple[list[dict], dict[str, str],
+                                dict[str, PrepareResult]]:
         """Resolve claim references to full objects; a UID mismatch means the
         kubelet's view is stale (claim deleted+recreated) and is an error for
-        that claim only."""
+        that claim only.
+
+        Returns ``(claims, errors, cached)``: ``cached`` holds
+        checkpoint-served results for claims whose fetch failed because
+        the API server is unreachable (``Transient``, breaker open) but
+        the driver's ``cached_prepare`` hook already knows them — the
+        blackout degradation path (docs/resilience.md): an idempotent
+        re-prepare of an already-placed claim must not depend on the
+        API server."""
         claims: list[dict] = []
         errors: dict[str, str] = {}
+        cached: dict[str, PrepareResult] = {}
         for ref in refs:
             try:
                 obj = self.kube.get(RESOURCE_CLAIMS, ref.name, ref.namespace)
@@ -210,12 +237,26 @@ class KubeletPluginServer:
                 errors[ref.uid] = (
                     f"ResourceClaim {ref.namespace}/{ref.name} not found")
                 continue
+            except Transient as exc:
+                result = None
+                if self.callbacks.cached_prepare is not None:
+                    result = self.callbacks.cached_prepare(ref)
+                if result is not None:
+                    klog.warning("API unreachable; serving prepare from "
+                                 "checkpoint", claim=ref.uid,
+                                 err=repr(exc)[:120])
+                    cached[ref.uid] = result
+                else:
+                    errors[ref.uid] = (
+                        f"API server unreachable and claim {ref.uid} not "
+                        f"in the node checkpoint: {exc}")
+                continue
             if obj.get("metadata", {}).get("uid") != ref.uid:
                 errors[ref.uid] = (
                     f"ResourceClaim {ref.namespace}/{ref.name} UID mismatch")
                 continue
             claims.append(obj)
-        return claims, errors
+        return claims, errors, cached
 
     # -- resource slices ---------------------------------------------------
     def slice_name(self) -> str:
@@ -235,6 +276,7 @@ class KubeletPluginServer:
             prev_gen = existing.get("spec", {}).get("pool", {}) \
                 .get("generation", 0)
         self._pool_generation = max(self._pool_generation, prev_gen) + 1
+        failpoint.hit("plugin.publish_resources")
         slice_obj = {
             "apiVersion": "resource.k8s.io/v1beta1",
             "kind": "ResourceSlice",

@@ -24,12 +24,23 @@ from tpu_dra.kubeletplugin import (
 )
 from tpu_dra.plugins.slice.device_state import SliceDeviceState
 from tpu_dra.plugins.slice.slicedomain import NodeSliceDomainManager
+from tpu_dra.resilience import failpoint
+from tpu_dra.resilience.retry import PREPARE_RETRY_DEADLINE
 from tpu_dra.util import klog
 from tpu_dra.util.flock import locked
 from tpu_dra.util.workqueue import WorkQueue
 from tpu_dra.version import SLICE_DRIVER_NAME
 
-ERROR_RETRY_MAX_TIMEOUT = 45.0   # driver.go:37-48
+# driver.go:37-48 — owned by the central retry policy module so every
+# consumer of "how long may a prepare retry" reads one constant
+ERROR_RETRY_MAX_TIMEOUT = PREPARE_RETRY_DEADLINE
+
+_FP_ATTEMPT = failpoint.register(
+    "slice.prepare.attempt",
+    "each workqueue attempt of a codependent channel/daemon prepare "
+    "(error here exercises the retry-until-deadline loop)")
+_FP_UNPREPARE = failpoint.register(
+    "slice.unprepare.begin", "slice unprepare entered under the flock")
 
 
 @dataclass
@@ -122,6 +133,7 @@ class SliceDriver:
 
             def attempt(obj: dict, _uid: str = uid) -> None:
                 from tpu_dra.plugins.metrics import observe_prepare
+                failpoint.hit("slice.prepare.attempt")
                 with observe_prepare(SLICE_DRIVER_NAME), \
                         locked(self.flock_path,
                                timeout=self.cfg.flock_timeout):
@@ -158,6 +170,7 @@ class SliceDriver:
                 with observe_unprepare(SLICE_DRIVER_NAME), \
                         locked(self.flock_path,
                                timeout=self.cfg.flock_timeout):
+                    failpoint.hit("slice.unprepare.begin")
                     self.state.unprepare(ref.uid)
             except Exception as exc:  # noqa: BLE001 — reported per claim
                 errors[ref.uid] = f"error unpreparing {ref.uid}: {exc}"

@@ -140,7 +140,9 @@ def _acquire_in_pool(pool_dir: str, fallback_max: int,
             max_procs = int(f.read().strip())
     except (OSError, ValueError):
         max_procs = fallback_max
-    for slot in range(max_procs):
+    # slot SCAN, not a retry loop: each iteration probes a different
+    # slot file (mirrors launcher._acquire_in_pool)
+    for slot in range(max_procs):  # vet: ignore[retry-hygiene]
         try:
             fd = os.open(os.path.join(pool_dir, f"slot-{slot}.lock"),
                          os.O_CREAT | os.O_RDWR, 0o644)

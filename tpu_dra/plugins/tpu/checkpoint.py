@@ -18,8 +18,18 @@ from typing import Callable, Optional
 
 from tpu_dra.plugins.tpu import checkpoint_legacy
 from tpu_dra.plugins.tpu.allocatable import PreparedClaim
+from tpu_dra.resilience import failpoint
 from tpu_dra.tpulib import native
 from tpu_dra.util.fsutil import atomic_write
+
+_FP_BEFORE_WRITE = failpoint.register(
+    "tpu.checkpoint.before_write",
+    "checkpoint state mutated in memory, nothing on disk yet "
+    "(a crash here must leave the previous checkpoint intact)",
+    crash_safe=True)
+_FP_AFTER_WRITE = failpoint.register(
+    "tpu.checkpoint.after_write",
+    "checkpoint atomically replaced on disk", crash_safe=True)
 
 
 class CorruptCheckpoint(RuntimeError):
@@ -51,7 +61,9 @@ class Checkpoint:
         payload = json.dumps(self._payload(), sort_keys=True)
         envelope = {"checksum": native.crc32c(payload.encode()),
                     "data": payload}
+        failpoint.hit("tpu.checkpoint.before_write")
         atomic_write(self.path, json.dumps(envelope))
+        failpoint.hit("tpu.checkpoint.after_write")
 
     def load(self) -> bool:
         """Returns False when no checkpoint exists yet (first start —

@@ -34,10 +34,55 @@ from tpu_dra.plugins.tpu.allocatable import (
 )
 from tpu_dra.plugins.tpu.checkpoint import Checkpoint
 from tpu_dra.plugins.tpu.sharing import MultiProcessManager, hbm_defense_env
+from tpu_dra.resilience import failpoint
 from tpu_dra.tpulib.discovery import TpuLib
 from tpu_dra.trace import propagation, start_span
 from tpu_dra.util import klog
 from tpu_dra.version import DRIVER_NAME
+
+# the crash-recovery sweep (tests/test_crash_sweep.py, hack/drive_chaos)
+# kills the driver at every crash_safe point below and asserts the next
+# start converges: checkpoint loads clean, orphaned CDI specs/slot
+# pools/heartbeat dirs are reconciled away, re-prepare is idempotent
+_PREPARE_FPS = (
+    failpoint.register(
+        "tpu.prepare.begin",
+        "prepare entered under the state lock, nothing done yet",
+        crash_safe=True),
+    failpoint.register(
+        "tpu.prepare.after_select",
+        "devices selected; slot pools and the heartbeat dir may exist, "
+        "no CDI spec and no checkpoint entry", crash_safe=True),
+    failpoint.register(
+        "tpu.prepare.after_cdi_write",
+        "per-claim CDI spec on disk, checkpoint entry NOT yet written "
+        "(the orphan-spec reconcile window)", crash_safe=True),
+    failpoint.register(
+        "tpu.prepare.after_checkpoint",
+        "claim fully checkpointed; crash before returning means the "
+        "kubelet retries an already-prepared claim", crash_safe=True),
+)
+_UNPREPARE_FPS = (
+    failpoint.register(
+        "tpu.unprepare.begin",
+        "unprepare entered under the state lock, nothing done yet",
+        crash_safe=True),
+    failpoint.register(
+        "tpu.unprepare.after_heartbeat_rm",
+        "heartbeat dir removed; checkpoint entry still present",
+        crash_safe=True),
+    failpoint.register(
+        "tpu.unprepare.after_slot_cleanup",
+        "multiprocess slot pools removed; CDI spec and checkpoint "
+        "entry still present", crash_safe=True),
+    failpoint.register(
+        "tpu.unprepare.after_cdi_delete",
+        "claim CDI spec deleted; checkpoint entry still present "
+        "(a retried unprepare must converge)", crash_safe=True),
+    failpoint.register(
+        "tpu.unprepare.after_checkpoint",
+        "claim fully unprepared and checkpoint saved", crash_safe=True),
+)
 
 CONFIG_SOURCE_CLASS = "FromClass"
 CONFIG_SOURCE_CLAIM = "FromClaim"
@@ -111,6 +156,18 @@ class DeviceState:
                 set(self.checkpoint.prepared)):
             klog.warning("removed orphaned multiprocess slot dir",
                          dir=name)
+        # same reconcile for per-claim heartbeat dirs: a crash between
+        # _group_edits (which creates the dir) and checkpoint.put leaves
+        # an orphan that no unprepare will ever name (claim uids are
+        # unique), so it would accumulate for the node's lifetime
+        hb_root = os.path.join(cfg.plugin_dir, "heartbeats")
+        if os.path.isdir(hb_root):
+            for uid in os.listdir(hb_root):
+                if uid not in self.checkpoint.prepared:
+                    klog.warning("removing orphaned heartbeat dir",
+                                 claim=uid)
+                    shutil.rmtree(os.path.join(hb_root, uid),
+                                  ignore_errors=True)
 
     # -- public API --------------------------------------------------------
     def prepare(self, claim: dict) -> list[PreparedDevice]:
@@ -122,6 +179,7 @@ class DeviceState:
         """
         with self._mu:
             uid = claim["metadata"]["uid"]
+            failpoint.hit("tpu.prepare.begin")
             existing = self.checkpoint.get(uid)
             if existing is not None:   # idempotent no-op, :139-146
                 # /var/run/cdi is tmpfs: after a node reboot the checkpoint
@@ -146,10 +204,12 @@ class DeviceState:
                 # unprepare would no-op, leaking them until restart
                 self.mp_manager.cleanup(uid)
                 raise
+            failpoint.hit("tpu.prepare.after_select")
             self._stamp_trace_env(per_device_edits)
             with start_span("prepare.cdi_spec_write",
                             attributes={"claim": uid}):
                 self.cdi.create_claim_spec(uid, per_device_edits)
+            failpoint.hit("tpu.prepare.after_cdi_write")
             prepared = PreparedClaim(
                 claim_uid=uid,
                 namespace=claim["metadata"].get("namespace", ""),
@@ -158,30 +218,44 @@ class DeviceState:
             with start_span("prepare.checkpoint_write",
                             attributes={"claim": uid}):
                 self.checkpoint.put(prepared)
+            failpoint.hit("tpu.prepare.after_checkpoint")
             return devices
 
     def unprepare(self, claim_uid: str) -> None:
         """Unprepare by UID only — checkpoint state is authoritative so the
         API server is never needed (device_state.go:172-207)."""
         with self._mu:
+            failpoint.hit("tpu.unprepare.begin")
             # heartbeat dir cleanup happens even without a checkpoint
             # entry: a prepare that failed after _claim_edits leaves the
             # dir behind, and claim uids are unique so it would otherwise
             # accumulate for the node's lifetime
             shutil.rmtree(os.path.join(self.cfg.plugin_dir, "heartbeats",
                                        claim_uid), ignore_errors=True)
+            failpoint.hit("tpu.unprepare.after_heartbeat_rm")
             existing = self.checkpoint.get(claim_uid)
             if existing is None:       # absent ⇒ no-op, :181-189
                 klog.info("unprepare: no checkpoint entry; no-op", level=4,
                           claim=claim_uid)
                 return
             self.mp_manager.cleanup(claim_uid)
+            failpoint.hit("tpu.unprepare.after_slot_cleanup")
             self.cdi.delete_claim_spec(claim_uid)
+            failpoint.hit("tpu.unprepare.after_cdi_delete")
             self.checkpoint.remove(claim_uid)
+            failpoint.hit("tpu.unprepare.after_checkpoint")
 
     def prepared_claims(self) -> dict[str, PreparedClaim]:
         with self._mu:
             return dict(self.checkpoint.prepared)
+
+    def claim_spec_intact(self, uid: str) -> bool:
+        """Public probe for consumers that cannot regenerate the spec
+        (the API-blackout cached-prepare path has no claim object to
+        rebuild edits from): is the per-claim CDI spec present and
+        parseable right now?"""
+        with self._mu:
+            return self._claim_spec_intact(uid)
 
     # -- config mapping ----------------------------------------------------
     def get_opaque_device_configs(self, claim: dict) -> list[DeviceConfigState]:

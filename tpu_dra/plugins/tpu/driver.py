@@ -22,6 +22,7 @@ compute-domain daemon's restart-on-IMEX-failure semantics.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -105,6 +106,11 @@ class TpuDriver:
             driver_root=cfg.driver_root,
             enable_subslices=cfg.enable_subslices,
             health=self.health))
+        # remediations suppressed during an API blackout, replayed once
+        # the breaker closes             # guarded by self._deferred_mu
+        self._deferred_remediations: list[Transition] = []
+        self._deferred_mu = threading.Lock()
+        self.health.add_poll_listener(self._flush_deferred_remediations)
         self.server = KubeletPluginServer(
             driver_name=DRIVER_NAME,
             node_name=cfg.node_name,
@@ -113,7 +119,8 @@ class TpuDriver:
             registry_dir=cfg.registry_dir,
             callbacks=DriverCallbacks(
                 prepare=self.prepare_resource_claims,
-                unprepare=self.unprepare_resource_claims))
+                unprepare=self.unprepare_resource_claims,
+                cached_prepare=self.cached_prepare))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -152,6 +159,51 @@ class TpuDriver:
         self.server.publish_resources(devices)
         self._published_down = down
 
+    # -- API-blackout degradation (docs/resilience.md) ---------------------
+    def _api_blackout(self) -> bool:
+        """True while the kube client's circuit breaker is open — the
+        apiserver, not the chips, went dark.  Duck-typed: FakeKube (and
+        tests injecting their own breaker) need only expose
+        ``.breaker.is_open()``."""
+        breaker = getattr(self.cfg.kube, "breaker", None)
+        return breaker is not None and breaker.is_open()
+
+    def cached_prepare(self, ref) -> Optional[PrepareResult]:
+        """Serve an idempotent re-prepare straight from the checkpoint
+        when the claim object cannot be fetched (API blackout): the
+        devices were already prepared and their CDI specs are on disk,
+        so the kubelet's retry must succeed without the API server.
+
+        The CDI spec must actually be intact: after a node reboot
+        (/var/run/cdi is tmpfs) the normal idempotent-prepare path
+        regenerates it from the claim object — which this path does not
+        have — so a checkpoint hit with a missing/torn spec must fail
+        typed rather than report success for devices kubelet cannot
+        resolve."""
+        existing = self.state.prepared_claims().get(ref.uid)
+        if existing is None:
+            return None
+        if not self.state.claim_spec_intact(ref.uid):
+            klog.warning("checkpointed claim's CDI spec missing/torn; "
+                         "cannot serve prepare without the API server",
+                         claim=ref.uid)
+            return None
+        return self._to_prepare_result(existing.devices)
+
+    def _to_prepare_result(self, devices) -> PrepareResult:
+        """One wire-shape builder for BOTH prepare paths (normal and
+        checkpoint-served blackout), so the least-trafficked path can
+        never silently diverge when the device dict grows a field."""
+        return PrepareResult(devices=[
+            {
+                "request_names": d.request_names,
+                "pool_name": self.cfg.node_name,
+                "device_name": d.canonical_name,
+                "cdi_device_ids": d.cdi_device_ids,
+            }
+            for d in devices
+        ])
+
     # -- health fan-out ----------------------------------------------------
     def _pinned_claims(self) -> dict[str, list[str]]:
         """chip uuid -> claim uids currently prepared on it (cores count
@@ -188,9 +240,41 @@ class TpuDriver:
             if t.to_state == UNHEALTHY:
                 self._remediate(t)
 
+    def _flush_deferred_remediations(self) -> None:
+        """Poll listener: replay remediations that were suppressed during
+        an API blackout, once the breaker closes.  A chip that recovered
+        in the meantime is dropped — there is nothing left to remediate."""
+        if self._api_blackout():
+            return
+        with self._deferred_mu:
+            deferred, self._deferred_remediations = \
+                self._deferred_remediations, []
+        for t in deferred:
+            if self.health.state_of(t.uuid) != UNHEALTHY:
+                klog.info("dropping deferred remediation: chip recovered "
+                          "during the API blackout", chip=t.device)
+                continue
+            self._remediate(t)
+
     def _remediate(self, t: Transition) -> None:
         """Handle prepared claims pinned to a chip that just went
-        Unhealthy, per the configured policy."""
+        Unhealthy, per the configured policy.
+
+        Suppressed while the API server is dark (breaker open): every
+        remediation action is an API write, and a blackout must not
+        translate into a node-wide unprepare-and-evict storm the moment
+        connectivity returns for the wrong reason.  Suppressed
+        transitions are replayed by the poll listener once the breaker
+        closes — if the chip is still Unhealthy then."""
+        if self._api_blackout():
+            klog.warning("suppressing remediation during API blackout "
+                         "(the apiserver, not the chip fleet, went dark)",
+                         chip=t.device)
+            with self._deferred_mu:
+                if all(d.uuid != t.uuid
+                       for d in self._deferred_remediations):
+                    self._deferred_remediations.append(t)
+            return
         pinned = self._pinned_claims().get(t.uuid, [])
         prepared = self.state.prepared_claims()
         for uid in pinned:
@@ -274,15 +358,7 @@ class TpuDriver:
                 observe_prepare(DRIVER_NAME), \
                 locked(self.flock_path, timeout=self.cfg.flock_timeout):
             devices = self.state.prepare(claim)
-        return PrepareResult(devices=[
-            {
-                "request_names": d.request_names,
-                "pool_name": self.cfg.node_name,
-                "device_name": d.canonical_name,
-                "cdi_device_ids": d.cdi_device_ids,
-            }
-            for d in devices
-        ])
+        return self._to_prepare_result(devices)
 
     def unprepare_resource_claims(self, refs: list[ClaimRef]
                                   ) -> dict[str, str]:

@@ -1,0 +1,35 @@
+"""Unified resilience layer: failpoints, retry policy, circuit breaker.
+
+The reference driver has no fault-injection framework (SURVEY §5) — its
+recovery story is implied by the ProcessManager watchdog and ad-hoc
+retry loops, never exercised systematically.  This package makes
+recovery a first-class subsystem, following two well-worn designs:
+
+- :mod:`tpu_dra.resilience.failpoint` — etcd's ``gofail``: named
+  injection points compiled into the binaries, no-ops unless activated
+  via ``TPU_DRA_FAILPOINTS`` (env) or ``TPU_DRA_FAILPOINTS_FILE``
+  (re-read at runtime), with ``error``/``crash``/``sleep``/``stall``
+  actions.  ``python -m tpu_dra.resilience list`` prints the catalog.
+- :mod:`tpu_dra.resilience.retry` — client-go's backoff helpers: one
+  exponential-backoff-with-decorrelated-jitter implementation, typed
+  retryable classification (429 honoring ``Retry-After``, 5xx,
+  ``Transient`` connection errors), and an overall deadline.  Every
+  hand-rolled retry loop in the tree migrates onto it (the
+  ``retry-hygiene`` vet checker keeps it that way).
+- :mod:`tpu_dra.resilience.breaker` — a closed/open/half-open circuit
+  breaker and :class:`~tpu_dra.resilience.breaker.ResilientKubeClient`,
+  the retry+breaker wrapper every binary's ``new_clients`` returns.
+  NOTE: ``breaker`` imports ``tpu_dra.k8s.client`` and is therefore NOT
+  imported here (``k8s.client`` imports this package for failpoints);
+  consumers import it directly.
+
+See ``docs/resilience.md`` for the failpoint catalog, activation
+syntax, and the API-blackout degradation contract.
+"""
+
+from tpu_dra.resilience import failpoint  # noqa: F401
+from tpu_dra.resilience.retry import (  # noqa: F401
+    Backoff,
+    RetryPolicy,
+    retry_call,
+)

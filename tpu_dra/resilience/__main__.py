@@ -1,0 +1,74 @@
+"""``python -m tpu_dra.resilience`` — failpoint catalog CLI.
+
+``list`` imports every module that declares failpoints (registration is
+an import side effect, like the vet checker catalog) and prints the
+registry: name, whether the point is crash-safe (enumerated by the
+crash-recovery sweep), and what state the point captures.  ``--json``
+emits machine-readable output for the sweep tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+
+# every module that calls failpoint.register(); keep in sync with the
+# catalog in docs/resilience.md
+REGISTERING_MODULES = (
+    "tpu_dra.k8s.client",
+    "tpu_dra.k8s.informer",
+    "tpu_dra.plugins.tpu.checkpoint",
+    "tpu_dra.plugins.tpu.device_state",
+    "tpu_dra.plugins.tpu.driver",
+    "tpu_dra.plugins.slice.driver",
+    "tpu_dra.kubeletplugin.server",
+    "tpu_dra.daemon.process",
+    "tpu_dra.daemon.membership",
+    "tpu_dra.controller.slicedomain",
+    "tpu_dra.workloads.launcher",
+)
+
+
+def load_all() -> None:
+    for mod in REGISTERING_MODULES:
+        importlib.import_module(mod)
+
+
+def main(argv=None) -> int:
+    from tpu_dra.resilience import failpoint
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_dra.resilience", description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    lst = sub.add_parser("list", help="print the failpoint catalog")
+    lst.add_argument("--json", action="store_true",
+                     help="machine-readable output")
+    lst.add_argument("--crash-safe", action="store_true",
+                     help="only points the crash sweep enumerates")
+    args = parser.parse_args(argv)
+
+    load_all()
+    points = failpoint.registered()
+    if args.crash_safe:
+        points = [p for p in points if p.crash_safe]
+    if args.json:
+        json.dump([{"name": p.name, "crashSafe": p.crash_safe,
+                    "doc": p.doc} for p in points],
+                  sys.stdout, indent=2)
+        print()
+        return 0
+    width = max((len(p.name) for p in points), default=4)
+    print(f"{'NAME':<{width}}  CRASH  DOC")
+    for p in points:
+        print(f"{p.name:<{width}}  {'yes' if p.crash_safe else '-':<5}"
+              f"  {p.doc}")
+    print(f"\n{len(points)} failpoints; activate via "
+          f"{failpoint.ENV_VAR} or {failpoint.FILE_ENV_VAR} "
+          f"(see docs/resilience.md)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,267 @@
+"""Circuit breaker + the retry/breaker-wrapped KubeClient.
+
+During an API-server blackout every component that talks to the server
+fails — the interesting question is *how*.  Without a breaker, each
+caller burns its full retry budget per call (the informer, the status
+writers, the health republisher all stacking 30-second retry loops on a
+dead socket).  With one, the first few transient failures open the
+circuit and everything after fails in microseconds with
+:class:`BreakerOpen`, which the degradation paths key on: the kubelet
+plugin serves NodePrepareResources from its checkpoint, and the health
+monitor's remediation defers instead of mass-evicting claims because
+the apiserver — not the chips — went dark.
+
+States follow the classic closed → open → half-open cycle:
+
+- CLOSED: requests flow; ``failure_threshold`` consecutive
+  breaker-countable failures (connection-level ``Transient`` or 5xx —
+  typed 4xx like NotFound/Conflict are the API *working*) trip it OPEN;
+- OPEN: everything fails fast for ``open_duration`` seconds;
+- HALF_OPEN: one probe request is let through; success closes the
+  circuit, failure re-opens it.
+
+Exported metrics: ``tpu_dra_client_breaker_state{state}`` (1 for the
+current state) and ``tpu_dra_client_retries_total{verb}``.
+
+NOTE: this module imports :mod:`tpu_dra.k8s.client` (which itself
+imports ``tpu_dra.resilience`` for failpoints) — it is deliberately NOT
+re-exported from the package ``__init__`` to keep that edge one-way.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from tpu_dra.k8s.client import KubeClient, ResourceDesc, Transient
+from tpu_dra.resilience import retry
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half-open"
+ALL_STATES = (STATE_CLOSED, STATE_OPEN, STATE_HALF_OPEN)
+
+
+class BreakerOpen(Transient):
+    """Fail-fast rejection while the circuit is open.  A subclass of
+    :class:`~tpu_dra.k8s.client.Transient` so existing "API flaked"
+    handling (workqueue retries, informer backoff) treats it uniformly —
+    but the client wrapper itself never retries through it."""
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold: int = 5,
+                 open_duration: float = 15.0,
+                 name: str = "kube") -> None:
+        self.failure_threshold = max(1, failure_threshold)
+        self.open_duration = open_duration
+        self.name = name
+        self._mu = threading.Lock()
+        self._state = STATE_CLOSED          # guarded by self._mu
+        self._failures = 0                  # guarded by self._mu
+        self._opened_at = 0.0               # guarded by self._mu
+        self._probing = False               # guarded by self._mu
+        self._gauge = DEFAULT_REGISTRY.gauge(
+            "tpu_dra_client_breaker_state",
+            "kube client circuit breaker state (1 = current)",
+            labels=("state",))
+        self._publish(STATE_CLOSED)
+
+    def _publish(self, state: str) -> None:
+        for s in ALL_STATES:
+            self._gauge.set(1.0 if s == state else 0.0, s)
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def is_open(self) -> bool:
+        """True until the circuit has actually re-CLOSED — the signal the
+        degradation paths key on.  HALF_OPEN counts as still-dark: the
+        probe has not yet proven the API server back, and a remediation
+        that fires in that window would race the probe (worst case it
+        half-completes: node-side unprepare succeeds, the claim delete
+        fails and is swallowed).  Deferring one more poll is free; the
+        unhealthy-chip republish traffic guarantees a probe happens."""
+        return self.state != STATE_CLOSED
+
+    def _maybe_half_open_locked(self) -> None:  # vet: holds[self._mu]
+        if self._state == STATE_OPEN and \
+                time.monotonic() - self._opened_at >= self.open_duration:
+            self._state = STATE_HALF_OPEN
+            self._probing = False
+            self._publish(STATE_HALF_OPEN)
+            klog.info("circuit breaker half-open; probing",
+                      breaker=self.name)
+
+    def allow(self) -> bool:
+        """Admission check; half-open admits exactly one probe."""
+        with self._mu:
+            self._maybe_half_open_locked()
+            if self._state == STATE_CLOSED:
+                return True
+            if self._state == STATE_HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def success(self) -> None:
+        with self._mu:
+            if self._state != STATE_CLOSED:
+                klog.info("circuit breaker closed", breaker=self.name)
+            self._state = STATE_CLOSED
+            self._failures = 0
+            self._probing = False
+            self._publish(STATE_CLOSED)
+
+    def failure(self) -> None:
+        with self._mu:
+            if self._state == STATE_HALF_OPEN:
+                self._trip_locked()
+                return
+            self._failures += 1
+            if self._state == STATE_CLOSED and \
+                    self._failures >= self.failure_threshold:
+                self._trip_locked()
+
+    def _trip_locked(self) -> None:  # vet: holds[self._mu]
+        self._state = STATE_OPEN
+        self._opened_at = time.monotonic()
+        self._failures = 0
+        self._probing = False
+        self._publish(STATE_OPEN)
+        klog.warning("circuit breaker OPEN", breaker=self.name,
+                     reopen_after=self.open_duration)
+
+
+def _counts_toward_breaker(exc: BaseException) -> bool:
+    """Connection-level failures and 5xx trip the breaker; typed 4xx
+    (NotFound, Conflict, 429 throttling) mean the server answered."""
+    if isinstance(exc, BreakerOpen):
+        return False    # our own rejection must not feed back
+    if retry.is_transient(exc):
+        return True
+    status = getattr(exc, "status", None)
+    return isinstance(status, int) and status >= 500
+
+
+class ResilientKubeClient(KubeClient):
+    """Retry + circuit-breaker wrapper around a :class:`KubeClient`.
+
+    Reads (get/list) retry transparently on transient/5xx/429 failures
+    under ``read_policy``.  Mutations are NOT blind-retried on
+    connection errors or 5xx — a create that timed out (or got a proxy
+    503) may have committed, and replaying it converts an outage into
+    spurious Conflicts; they retry only on 429, the one status that
+    guarantees the server did not process the request, honoring
+    ``Retry-After``.  Callers that can retry mutations safely
+    (GET→mutate→PUT loops) do so one level up via
+    :func:`tpu_dra.resilience.retry.retry_call`.
+
+    Every underlying attempt feeds the breaker; while it is open all
+    verbs fail fast with :class:`BreakerOpen`.
+    """
+
+    def __init__(self, inner: KubeClient,
+                 breaker: Optional[CircuitBreaker] = None,
+                 read_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
+                 ) -> None:
+        self.inner = inner
+        self.breaker = breaker or CircuitBreaker()
+        self.read_policy = read_policy
+        self._retries = DEFAULT_REGISTRY.counter(
+            "tpu_dra_client_retries_total",
+            "kube client request retries, by verb", labels=("verb",))
+
+    # -- core ------------------------------------------------------------
+    def _attempt(self, verb: str, fn):
+        """One breaker-accounted attempt."""
+        if not self.breaker.allow():
+            raise BreakerOpen(f"kube client circuit open ({verb})")
+        try:
+            result = fn()
+        except BaseException as exc:
+            if _counts_toward_breaker(exc):
+                self.breaker.failure()
+            else:
+                self.breaker.success()
+            raise
+        self.breaker.success()
+        return result
+
+    def _read(self, verb: str, fn):
+        def once():
+            return self._attempt(verb, fn)
+
+        def retryable(exc: BaseException) -> bool:
+            if isinstance(exc, BreakerOpen):
+                return False    # fail fast; the caller's loop backs off
+            return retry.default_retryable(exc)
+
+        return retry.retry_call(
+            once, policy=self.read_policy, retryable=retryable,
+            on_retry=lambda exc, delay: self._retries.inc(verb), op=verb)
+
+    def _mutate(self, verb: str, fn):
+        def once():
+            return self._attempt(verb, fn)
+
+        def retryable(exc: BaseException) -> bool:
+            # only 429 truly guarantees "not processed": a 503 — even
+            # with Retry-After — can come from a proxy that already
+            # forwarded the write (standard LB overload behavior), and
+            # replaying it would turn an outage into spurious Conflicts
+            return getattr(exc, "status", None) == 429
+
+        return retry.retry_call(
+            once, policy=self.read_policy, retryable=retryable,
+            on_retry=lambda exc, delay: self._retries.inc(verb), op=verb)
+
+    # -- KubeClient ------------------------------------------------------
+    def get(self, res: ResourceDesc, name, namespace=None):
+        return self._read("get", lambda: self.inner.get(
+            res, name, namespace))
+
+    def list(self, res: ResourceDesc, namespace=None, label_selector=None,
+             field_selector=None):
+        return self._read("list", lambda: self.inner.list(
+            res, namespace, label_selector, field_selector))
+
+    def create(self, res: ResourceDesc, obj, namespace=None):
+        return self._mutate("create", lambda: self.inner.create(
+            res, obj, namespace))
+
+    def update(self, res: ResourceDesc, obj, namespace=None):
+        return self._mutate("update", lambda: self.inner.update(
+            res, obj, namespace))
+
+    def update_status(self, res: ResourceDesc, obj, namespace=None):
+        return self._mutate("update_status",
+                            lambda: self.inner.update_status(
+                                res, obj, namespace))
+
+    def patch(self, res: ResourceDesc, name, patch, namespace=None):
+        return self._mutate("patch", lambda: self.inner.patch(
+            res, name, patch, namespace))
+
+    def delete(self, res: ResourceDesc, name, namespace=None):
+        return self._mutate("delete", lambda: self.inner.delete(
+            res, name, namespace))
+
+    def watch(self, res: ResourceDesc, namespace=None, label_selector=None,
+              field_selector=None, resource_version="", stop=None):
+        # long-lived stream: no retry wrapper and no breaker accounting —
+        # the informer owns the reconnect loop, and watch() is a
+        # generator (nothing reaches the server until first iteration,
+        # so neither success nor failure here would be truthful).  The
+        # open-circuit fast-fail still applies, via the non-consuming
+        # state check so a watch never burns the half-open probe slot.
+        if self.breaker.state == STATE_OPEN:
+            raise BreakerOpen("kube client circuit open (watch)")
+        return self.inner.watch(res, namespace, label_selector,
+                                field_selector, resource_version, stop)

@@ -1,0 +1,335 @@
+"""Named failpoints — the ``gofail`` analog.
+
+Code declares injection points at import time::
+
+    from tpu_dra.resilience import failpoint
+    _FP = failpoint.register("tpu.prepare.after_cdi_write",
+                             "claim CDI spec written, checkpoint not yet",
+                             crash_safe=True)
+    ...
+    failpoint.hit("tpu.prepare.after_cdi_write")
+
+``hit`` is a no-op (one dict lookup behind a fast-path flag) unless the
+point is activated.  Activation comes from the environment::
+
+    TPU_DRA_FAILPOINTS="tpu.prepare.after_cdi_write=crash;kube.request=2*error(Transient)"
+
+or from a file named by ``TPU_DRA_FAILPOINTS_FILE`` (one ``name=action``
+term per line, ``#`` comments), which is re-read whenever its mtime
+changes — the hook chaos drivers use to flip faults on and off under a
+RUNNING binary.  Programmatic control (tests): :func:`activate`,
+:func:`deactivate`, :func:`reset`.
+
+Action grammar (optional ``N*`` prefix fires the action at most N times,
+then the term deactivates itself)::
+
+    crash            os._exit(CRASH_EXIT_CODE) — simulates a hard kill
+                     at exactly this point (no finally blocks, no atexit)
+    crash(7)         ...with a specific exit code
+    error            raise FailpointError
+    error(ExcName)   raise ExcName("failpoint <name>"); resolved from
+                     builtins or tpu_dra.k8s.client (Transient, Gone, ...)
+    sleep(250)       block 250 ms (widen race windows)
+    stall            block until release(name) / release_all() / deactivate
+
+``crash_safe=True`` marks points where killing the process must leave a
+state the next start converges from — the crash-recovery sweep
+(``tests/test_crash_sweep.py``, ``hack/drive_chaos.py``) enumerates
+exactly those.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+ENV_VAR = "TPU_DRA_FAILPOINTS"
+FILE_ENV_VAR = "TPU_DRA_FAILPOINTS_FILE"
+CRASH_EXIT_CODE = 86   # distinctive: sweeps assert the crash was ours
+
+_TERM_RE = re.compile(
+    r"^(?P<name>[a-zA-Z0-9_.\-]+)="
+    r"(?:(?P<count>\d+)\*)?"
+    r"(?P<action>[a-z]+)"
+    r"(?:\((?P<arg>[^)]*)\))?$")
+
+_ACTIONS = ("crash", "error", "sleep", "stall")
+
+
+class FailpointError(RuntimeError):
+    """Default exception for ``error`` actions with no explicit type."""
+
+
+@dataclass(frozen=True)
+class Failpoint:
+    """One registered injection point (the catalog entry)."""
+
+    name: str
+    doc: str
+    crash_safe: bool = False
+
+
+@dataclass
+class _Activation:
+    action: str
+    arg: str = ""
+    remaining: Optional[int] = None     # None = unlimited
+    release_evt: threading.Event = field(default_factory=threading.Event)
+
+
+_mu = threading.Lock()
+_registry: dict[str, Failpoint] = {}        # guarded by _mu
+_active: dict[str, _Activation] = {}        # guarded by _mu
+# fast path: hit() returns before taking the lock when nothing is active
+_any_active = False
+_load_mu = threading.Lock()                 # serializes env/file loading
+_loaded_env = False                         # guarded by _load_mu
+_file_mtime: Optional[float] = None         # guarded by _load_mu
+
+_hits = DEFAULT_REGISTRY.counter(
+    "tpu_dra_failpoint_hits_total",
+    "failpoint activations fired, by point name", labels=("name",))
+
+
+def register(name: str, doc: str = "", crash_safe: bool = False) -> Failpoint:
+    """Declare an injection point.  Idempotent for identical metadata;
+    two different points must not share a name."""
+    fp = Failpoint(name=name, doc=doc, crash_safe=crash_safe)
+    with _mu:
+        existing = _registry.get(name)
+        if existing is not None and existing != fp:
+            raise ValueError(f"failpoint {name!r} already registered "
+                             f"with different metadata")
+        _registry[name] = fp
+    return fp
+
+
+def registered() -> list[Failpoint]:
+    """The catalog, sorted by name (``python -m tpu_dra.resilience list``)."""
+    with _mu:
+        return sorted(_registry.values(), key=lambda f: f.name)
+
+
+def active() -> dict[str, str]:
+    """Currently-armed activations as ``{name: action-spec}``."""
+    with _mu:
+        out = {}
+        for name, act in _active.items():
+            spec = act.action + (f"({act.arg})" if act.arg else "")
+            if act.remaining is not None:
+                spec = f"{act.remaining}*{spec}"
+            out[name] = spec
+        return out
+
+
+# -- activation ------------------------------------------------------------
+def parse_spec(spec: str) -> dict[str, _Activation]:
+    """Parse ``name=action[;name=action...]`` (``;`` or ``,`` separated,
+    ``#`` starts a comment).  Raises ValueError on malformed terms —
+    a typo'd fault plan must fail loudly, not silently inject nothing."""
+    out: dict[str, _Activation] = {}
+    for raw in re.split(r"[;,\n]", spec):
+        term = raw.split("#", 1)[0].strip()
+        if not term:
+            continue
+        m = _TERM_RE.match(term)
+        if m is None:
+            raise ValueError(f"malformed failpoint term {term!r} "
+                             f"(want name=[N*]action[(arg)])")
+        action = m.group("action")
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown failpoint action {action!r} in "
+                             f"{term!r} (known: {', '.join(_ACTIONS)})")
+        count = m.group("count")
+        out[m.group("name")] = _Activation(
+            action=action, arg=m.group("arg") or "",
+            remaining=int(count) if count else None)
+    return out
+
+
+def _install(acts: dict[str, _Activation], source: str) -> None:
+    global _any_active
+    with _mu:
+        # stall continuity across plan reloads: a thread blocked on an
+        # OLD activation's event must stay controllable — carry the
+        # event over when the term survives the rewrite, release it when
+        # the term vanished (otherwise release()/deactivate()/reset()
+        # would target the new event and strand the waiter forever)
+        for name, old in _active.items():
+            if old.action != "stall":
+                continue
+            new = acts.get(name)
+            if new is not None and new.action == "stall":
+                new.release_evt = old.release_evt
+            else:
+                old.release_evt.set()
+        _active.clear()
+        _active.update(acts)
+        _any_active = bool(_active)
+    if acts:
+        klog.warning("failpoints ARMED", source=source,
+                     points=sorted(acts))
+
+
+def activate(spec: str) -> None:
+    """Arm the terms in ``spec`` (programmatic / test entry point);
+    replaces the current activation set."""
+    _install(parse_spec(spec), source="activate()")
+
+
+def deactivate(name: str) -> None:
+    global _any_active
+    with _mu:
+        act = _active.pop(name, None)
+        if act is not None and act.action == "stall":
+            act.release_evt.set()
+        _any_active = bool(_active)
+
+
+def reset() -> None:
+    """Disarm everything and forget env/file state (test teardown).
+    Lock order mirrors _maybe_load (_load_mu, then _mu) so a concurrent
+    hit() can neither deadlock nor observe pre-reset load state and
+    re-arm the plan this teardown just cleared."""
+    global _any_active, _loaded_env, _file_mtime
+    with _load_mu:
+        _loaded_env = False
+        _file_mtime = None
+        with _mu:
+            for act in _active.values():
+                act.release_evt.set()
+            _active.clear()
+            _any_active = False
+
+
+def release(name: str) -> None:
+    """Unblock a ``stall`` activation (it stays armed for the next hit)."""
+    with _mu:
+        act = _active.get(name)
+    if act is not None:
+        act.release_evt.set()
+
+
+def release_all() -> None:
+    with _mu:
+        acts = list(_active.values())
+    for act in acts:
+        act.release_evt.set()
+
+
+# -- env/file loading ------------------------------------------------------
+def _maybe_load() -> None:
+    """Load the env var once, and re-read the failpoint file whenever its
+    mtime moves.  Called from hit(); cheap (one stat) when a file is
+    configured, free otherwise."""
+    global _loaded_env, _file_mtime
+    with _load_mu:
+        if not _loaded_env:
+            _loaded_env = True
+            spec = os.environ.get(ENV_VAR, "")
+            if spec:
+                try:
+                    _install(parse_spec(spec), source=ENV_VAR)
+                except ValueError as exc:
+                    # a malformed env plan in a long-running binary:
+                    # surface loudly but do not kill the process that
+                    # merely imported us
+                    klog.error("ignoring malformed failpoint spec",
+                               err=str(exc))
+        path = os.environ.get(FILE_ENV_VAR, "")
+        if not path:
+            return
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            if _file_mtime is not None:     # file removed => disarm
+                _file_mtime = None
+                _install({}, source=FILE_ENV_VAR)
+            return
+        if mtime == _file_mtime:
+            return
+        _file_mtime = mtime
+        try:
+            with open(path, encoding="utf-8") as fh:
+                _install(parse_spec(fh.read()), source=FILE_ENV_VAR)
+        except (OSError, ValueError) as exc:
+            klog.error("ignoring malformed failpoint file", path=path,
+                       err=str(exc))
+
+
+def _resolve_exc(name: str) -> type[BaseException]:
+    if not name:
+        return FailpointError
+    import builtins
+    exc = getattr(builtins, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        return exc
+    # the typed client errors are the usual injection currency
+    from tpu_dra.k8s import client as k8s_client
+    exc = getattr(k8s_client, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        return exc
+    raise ValueError(f"failpoint error type {name!r} is neither a builtin "
+                     f"nor a tpu_dra.k8s.client exception")
+
+
+def hit(name: str) -> None:
+    """Fire the failpoint ``name`` if an activation targets it.
+
+    The injected effect happens on the CALLING thread: ``error`` raises,
+    ``crash`` never returns, ``sleep``/``stall`` block.
+    """
+    # fast path: env already consumed and no live plan file configured —
+    # one dict lookup + two global reads, no lock (hit() sits on hot
+    # paths like every kube request)
+    if _loaded_env and not os.environ.get(FILE_ENV_VAR):
+        if not _any_active:
+            return
+    else:
+        _maybe_load()
+        if not _any_active:
+            return
+    with _mu:
+        act = _active.get(name)
+        if act is None:
+            return
+        if act.remaining is not None:
+            if act.remaining <= 0:
+                return
+            act.remaining -= 1
+        release_evt = act.release_evt
+        action, arg = act.action, act.arg
+    _hits.inc(name)
+    klog.warning("failpoint FIRED", name=name, action=action, arg=arg)
+    if action == "crash":
+        code = int(arg) if arg else CRASH_EXIT_CODE
+        # simulate a hard kill at exactly this point: no finally blocks,
+        # no atexit handlers, no flushed buffers beyond this line
+        import sys
+        print(f"failpoint {name}: crashing with exit code {code}",
+              file=sys.stderr, flush=True)
+        os._exit(code)
+    if action == "error":
+        exc_type = _resolve_exc(arg)
+        from tpu_dra.k8s import client as k8s_client
+        if exc_type is k8s_client.ApiError:
+            # ApiError's first positional is the STATUS, not the
+            # message; inject a 500 so the retry/breaker classification
+            # sees the server error the fault plan intended (a
+            # string-status ApiError is silently non-retryable)
+            raise exc_type(500, f"failpoint {name}")
+        raise exc_type(f"failpoint {name}")
+    if action == "sleep":
+        time.sleep((float(arg) if arg else 100.0) / 1000.0)
+        return
+    if action == "stall":
+        release_evt.wait()
+        release_evt.clear()   # re-arm for the next hit
+        return

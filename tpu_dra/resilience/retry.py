@@ -1,0 +1,175 @@
+"""Centralized retry policy — the client-go backoff analog.
+
+One implementation of exponential backoff with decorrelated jitter
+(capped), an overall deadline, and typed retryable classification.  All
+the ad-hoc loops this replaces (membership's fixed ``retries=5``, the
+readiness status writer's ``for attempt in range(5)``, the informer's
+private doubling backoff) migrate onto :func:`retry_call` /
+:class:`Backoff`; the ``retry-hygiene`` vet checker flags hand-rolled
+replacements from growing back.
+
+Classification contract (:func:`default_retryable`):
+
+- connection-level failures — ``Transient`` (the typed mapping
+  ``KubeClient._request`` raises for URLError/timeouts/resets), plus
+  raw ``ConnectionError``/``TimeoutError`` — are retryable;
+- HTTP 429 and 5xx are retryable; a server-provided ``Retry-After``
+  (attached to the exception as ``retry_after``) is PREFERRED over the
+  computed backoff — the server knows its own load shedding;
+- everything else (404, 409, 422, programming errors) is not: those are
+  the API *working*, and blind retries would mask real bugs.
+
+409 Conflict is retryable only through :func:`retryable_or_conflict` —
+the GET→mutate→PUT loops opt into it explicitly, because a conflict
+retry only helps when the closure re-fetches.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tpu_dra.util import klog
+
+# The slice plugin's codependent-prepare deadline (reference
+# driver.go:37-48 ErrorRetryMaxTimeout) — owned here so every consumer
+# of "how long may a prepare retry" reads one constant.
+PREPARE_RETRY_DEADLINE = 45.0
+
+
+def exponential_delay(failures: int, base: float, cap: float) -> float:
+    """Plain capped exponential: ``min(base * 2**failures, cap)`` — the
+    jitter-free curve the workqueue's per-item backoff uses."""
+    return min(base * (2 ** failures), cap)
+
+
+class Backoff:
+    """Decorrelated-jitter backoff (the AWS architecture-blog variant):
+    each delay is drawn from ``uniform(base, prev * 3)``, capped.
+    Spreads N clients that failed together across the retry window
+    instead of synchronizing their storms."""
+
+    def __init__(self, base: float = 0.1, cap: float = 5.0,
+                 rng: Optional[random.Random] = None) -> None:
+        self.base = base
+        self.cap = cap
+        self._rng = rng or random.Random()
+        self._prev = base
+
+    def next(self) -> float:
+        delay = min(self.cap, self._rng.uniform(self.base, self._prev * 3))
+        self._prev = delay
+        return delay
+
+    def reset(self) -> None:
+        self._prev = self.base
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How long and how hard to retry one logical operation."""
+
+    base: float = 0.1          # first backoff draw lower bound (seconds)
+    cap: float = 5.0           # per-delay ceiling
+    deadline: Optional[float] = 30.0   # overall budget; None = forever
+    max_attempts: Optional[int] = None  # None = attempts bounded by deadline
+
+
+# sensible defaults for API-server traffic (reads) and for the
+# codependent slice prepare (threaded into the slice driver's workqueue)
+DEFAULT_POLICY = RetryPolicy(base=0.1, cap=5.0, deadline=30.0)
+PREPARE_RETRY_POLICY = RetryPolicy(base=0.1, cap=5.0,
+                                   deadline=PREPARE_RETRY_DEADLINE)
+# status writers race sibling writers for a handful of milliseconds —
+# short fuse, quick retries
+STATUS_WRITE_POLICY = RetryPolicy(base=0.02, cap=0.5, deadline=10.0,
+                                  max_attempts=8)
+
+
+def retry_after_hint(exc: BaseException) -> Optional[float]:
+    """The server's ``Retry-After`` (seconds), when the typed client
+    attached one (429/503 responses)."""
+    val = getattr(exc, "retry_after", None)
+    if isinstance(val, (int, float)) and val >= 0:
+        return float(val)
+    return None
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Connection-level failure: no HTTP response was received, so the
+    request may not have reached the server at all."""
+    if getattr(exc, "transient", False):    # k8s.client.Transient marker
+        return True
+    return isinstance(exc, (ConnectionError, TimeoutError))
+
+
+def default_retryable(exc: BaseException) -> bool:
+    if is_transient(exc):
+        return True
+    status = getattr(exc, "status", None)
+    if isinstance(status, int):
+        return status == 429 or status >= 500
+    return False
+
+
+def retryable_or_conflict(exc: BaseException) -> bool:
+    """Classification for GET→mutate→PUT loops (status writers): the
+    409 losers re-fetch and rewrite."""
+    if default_retryable(exc):
+        return True
+    return getattr(exc, "status", None) == 409
+
+
+def retry_call(fn: Callable[[], object], *,
+               policy: RetryPolicy = DEFAULT_POLICY,
+               retryable: Callable[[BaseException], bool] = default_retryable,
+               stop: Optional[threading.Event] = None,
+               on_retry: Optional[Callable[[BaseException, float], None]] = None,
+               op: str = ""):
+    """Call ``fn`` until it succeeds, a non-retryable error is raised, or
+    the policy's deadline/attempt budget is exhausted.
+
+    The LAST failure is re-raised unwrapped, so callers keep their typed
+    ``except Conflict`` / ``except Transient`` handling.  ``stop`` makes
+    the backoff wait interruptible (shutdown must not hang in a sleep);
+    a set ``stop`` event ends the loop with the last failure.
+    ``on_retry(exc, delay)`` fires before each backoff wait (metrics,
+    logging).
+    """
+    backoff = Backoff(policy.base, policy.cap)
+    started = time.monotonic()
+    attempts = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 — classified below;
+            # non-retryable errors re-raise immediately
+            attempts += 1
+            if not retryable(exc):
+                raise
+            if policy.max_attempts is not None and \
+                    attempts >= policy.max_attempts:
+                raise
+            delay = backoff.next()
+            hint = retry_after_hint(exc)
+            if hint is not None:
+                delay = hint    # the server's pacing beats our guess
+            if policy.deadline is not None and \
+                    time.monotonic() - started + delay > policy.deadline:
+                raise
+            if stop is not None and stop.is_set():
+                raise
+            if on_retry is not None:
+                on_retry(exc, delay)
+            klog.info("retrying after transient failure", level=4,
+                      op=op or getattr(fn, "__name__", "call"),
+                      attempt=attempts, delay=round(delay, 3),
+                      err=repr(exc)[:200])
+            if stop is not None:
+                if stop.wait(delay):
+                    raise
+            else:
+                time.sleep(delay)

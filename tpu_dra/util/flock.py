@@ -59,7 +59,7 @@ class Flock:
                 # polling LOCK_NB with a deadline IS the reference design
                 # (flock.go:27-133) — flock has no notification to wait
                 # on, and the deadline above bounds the loop
-                time.sleep(self.poll_interval)  # vet: ignore[reconcile-hygiene]
+                time.sleep(self.poll_interval)  # vet: ignore[reconcile-hygiene, retry-hygiene]
         except BaseException:
             if self._fd is None:
                 os.close(fd)

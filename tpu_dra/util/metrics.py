@@ -208,7 +208,7 @@ def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
         n_samples += 1
         # fixed-rate sampling pacing, not a retry loop: the profiler
         # MUST tick at interval or the sample weights are wrong
-        time.sleep(interval)  # vet: ignore[reconcile-hygiene]
+        time.sleep(interval)  # vet: ignore[reconcile-hygiene, retry-hygiene]
     lines = [f"# cpu profile: {n_samples} samples @ {hz}Hz over "
              f"{seconds:.1f}s (collapsed stacks)"]
     for key, c in sorted(counts.items(), key=lambda kv: -kv[1]):

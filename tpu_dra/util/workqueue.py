@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from tpu_dra.resilience.retry import exponential_delay
 from tpu_dra.trace import get_tracer
 from tpu_dra.trace.span import SpanContext, current_context
 from tpu_dra.util import klog
@@ -84,7 +85,10 @@ class ItemExponentialBackoff:
         with self._mu:
             n = self._failures.get(key, 0)
             self._failures[key] = n + 1
-        return min(self.base * (2**n), self.cap)
+        # the shared curve from tpu_dra/resilience/retry.py — per-item
+        # backoff stays jitter-free (deterministic tests; a single queue
+        # worker cannot thundering-herd itself)
+        return exponential_delay(n, self.base, self.cap)
 
     def forget(self, key: Any) -> None:
         with self._mu:

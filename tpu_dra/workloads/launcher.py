@@ -17,8 +17,17 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Optional
 
+from tpu_dra.resilience import failpoint
 from tpu_dra.trace import get_tracer
 from tpu_dra.trace.propagation import extract_env as _trace_parent
+
+_FP_INIT = failpoint.register(
+    "launcher.init",
+    "top of init_tpu_workload, before any resource contract is applied")
+_FP_RESOLVE = failpoint.register(
+    "launcher.resolve",
+    "top of rendezvous resolution (error/sleep here simulates a slow or "
+    "failed settings-mount/coordservice)")
 
 
 @dataclass
@@ -194,7 +203,9 @@ def _acquire_in_pool(pool_dir: str, fallback_max: int,
             max_procs = int(f.read().strip())
     except (FileNotFoundError, ValueError):
         max_procs = fallback_max
-    for slot in range(max_procs):
+    # slot SCAN, not a retry loop: each iteration probes a different
+    # slot file, and exhausting them is a hard error below
+    for slot in range(max_procs):  # vet: ignore[retry-hygiene]
         fd = os.open(os.path.join(pool_dir, f"slot-{slot}.lock"),
                      os.O_CREAT | os.O_RDWR, 0o644)
         try:
@@ -389,6 +400,7 @@ def init_tpu_workload(env: Optional[dict[str, str]] = None,
     the provided ``env`` dict), the process is not reniced, and no
     heartbeat thread starts.
     """
+    failpoint.hit("launcher.init")
     if dry_run:
         e = dict(os.environ) if env is None else env
         return {
@@ -491,6 +503,7 @@ def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
 
 
 def _resolve(env: dict[str, str]) -> RendezvousInfo:
+    failpoint.hit("launcher.resolve")
     if env.get("JAX_COORDINATOR_ADDRESS"):
         return RendezvousInfo(
             coordinator_address=env["JAX_COORDINATOR_ADDRESS"],
