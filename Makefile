@@ -74,17 +74,23 @@ check-proto: proto
 # -race analog (reference Makefile:95-96 runs `go test -race`), two lanes:
 # `racecheck` runs the vector-clock happens-before detector
 # (tpu_dra/util/racecheck.py) over seeded races and the repo's shared-state
-# hot spots; `stress` repeats the threading-heavy suites so residual
-# interleaving bugs surface across runs.
+# hot spots — with runtime lockdep armed, so every lane also validates the
+# observed lock-acquisition graph against the declared-order registry
+# (tpu_dra/analysis/lockregistry.py); `stress` repeats the threading-heavy
+# suites so residual interleaving bugs surface across runs.
 racecheck:
 	$(PYTHON) -m pytest tests/test_racecheck.py -q -x
 
 # go vet analog (reference pairs golangci-lint/go vet with -race in CI):
-# tpudra-vet runs the repo-specific static checkers — lock discipline
-# (the static complement of `racecheck`), reconcile hygiene, jit purity,
-# string-constant drift, exception hygiene.  See docs/static-analysis.md.
+# tpudra-vet runs the repo-specific static checkers — flow-aware lock
+# discipline (guarded-by on lockset facts, lock-order cycle detection,
+# blocking-under-lock: the static complement of `racecheck`), reconcile
+# hygiene, jit purity, string-constant drift, exception hygiene — then
+# the suppression ratchet (`# vet: ignore` counts may shrink or hold vs
+# vet-baseline.json, never grow).  See docs/static-analysis.md.
 vet:
 	$(PYTHON) -m tpu_dra.analysis tpu_dra/
+	$(PYTHON) -m tpu_dra.analysis --stats --baseline vet-baseline.json tpu_dra/
 
 STRESS_RUNS ?= 5
 stress:

@@ -24,6 +24,7 @@ Phase 2 — API-server blackout, degrade, recover:
   claim is still alive on both sides.
 """
 
+import json
 import os
 import pathlib
 import socket
@@ -123,10 +124,17 @@ def main():
                 "--health-pass-threshold", "1",
                 "--health-remediation", "unprepare",
                 "--ignore-host-tpu-env"]
+        lockdep_report = tmp / "lockdep.json"
         base_env = {**os.environ, "PYTHONPATH": REPO,
                     failpoint.FILE_ENV_VAR: str(plan),
                     "TPU_DRA_BREAKER_THRESHOLD": "3",
-                    "TPU_DRA_BREAKER_OPEN_SECONDS": "3"}
+                    "TPU_DRA_BREAKER_OPEN_SECONDS": "3",
+                    # runtime lockdep over the whole chaos run: the
+                    # restarted plugin records its lock-acquisition
+                    # graph and dumps it (with the declared-registry
+                    # check) at clean exit
+                    "TPU_DRA_LOCKDEP": "1",
+                    "TPU_DRA_LOCKDEP_REPORT": str(lockdep_report)}
         dra_sock = tmp / "plugins" / DRIVER_NAME / "dra.sock"
 
         # the claim both phases converge on, pinned to tpu-1
@@ -243,6 +251,19 @@ def main():
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait(5)
+
+        # runtime lockdep verdict, written by the plugin's atexit hook on
+        # its clean SIGTERM exit: the observed lock-order graph over the
+        # crash-recovery + blackout run must be acyclic and consistent
+        # with the static registry (tpu_dra/analysis/lockregistry.py)
+        assert lockdep_report.exists(), \
+            "plugin exited without writing the lockdep report"
+        report = json.loads(lockdep_report.read_text())
+        assert report["violations"] == [], \
+            f"runtime lockdep violations: {report['violations']}"
+        print(f"OK lockdep: {len(report['edges'])} observed lock-order "
+              "edge(s), zero cycles/contradictions vs the declared "
+              "registry")
     finally:
         srv.stop()
     print("DRIVE CHAOS: ALL OK")

@@ -1,0 +1,380 @@
+"""The flow-aware analysis engine: CFG construction + lockset dataflow.
+
+Two layers below the checkers (which tests/test_vet.py covers):
+
+1. CFG *shape*: branch, loop, try/except/finally, and ``with`` produce
+   the right nodes and edges — if-tests fork, loop headers carry back
+   edges, ``while True`` has no fall-through exit, ``with`` enter/exit
+   pair up and collect break/exception unwinding.
+2. Lockset *facts*: must-hold intersection at joins, the explicit
+   acquire/release protocol, ``Condition.wait`` lock retention,
+   reentrant ``with``, the ``# vet: holds[...]`` entry seed, and the
+   per-file cache the three concurrency checkers share.
+"""
+
+from __future__ import annotations
+
+import ast
+
+import pytest
+
+from tpu_dra.analysis import lockset
+from tpu_dra.analysis.cfg import (
+    ENTRY,
+    EXIT,
+    STMT,
+    WITH_ENTER,
+    WITH_EXIT,
+    build_cfg,
+)
+from tpu_dra.analysis.core import FileContext
+
+pytestmark = pytest.mark.core
+
+
+def func_cfg(src: str, name: str | None = None):
+    tree = ast.parse(src)
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    func = funcs[0] if name is None else \
+        next(f for f in funcs if f.name == name)
+    return build_cfg(func)
+
+
+def nodes_at(cfg, line: int, kind: str | None = None):
+    return [n for n in cfg.nodes
+            if n.line == line and (kind is None or n.kind == kind)]
+
+
+def facts_for(src: str, name: str | None = None,
+              path: str = "tpu_dra/util/x.py"):
+    ctx = FileContext(path, src)
+    funcs = {f.name: f for f, _ in lockset.functions_in(ctx.tree)}
+    func = next(iter(funcs.values())) if name is None else funcs[name]
+    return ctx, lockset.analyze(ctx, func)
+
+
+def lockset_at(ctx, facts, line: int) -> set[str]:
+    for node in facts.cfg.nodes:
+        if node.kind == STMT and node.line == line \
+                and facts.reachable(node):
+            return set(facts.lockset(node))
+    raise AssertionError(f"no reachable stmt node at line {line}")
+
+
+# -------------------------------------------------------------------------
+# CFG shape
+# -------------------------------------------------------------------------
+
+
+def test_cfg_branch_forks_and_joins():
+    cfg = func_cfg("def f(x):\n"
+                   "    if x:\n"          # L2
+                   "        a = 1\n"      # L3
+                   "    else:\n"
+                   "        a = 2\n"      # L5
+                   "    return a\n")      # L6
+    (test,) = nodes_at(cfg, 2)
+    assert {s.line for s in test.succs} == {3, 5}
+    (ret,) = nodes_at(cfg, 6)
+    for line in (3, 5):
+        (n,) = nodes_at(cfg, line)
+        assert ret in n.succs
+    assert cfg.exit in ret.succs
+
+
+def test_cfg_if_without_else_joins_through_the_test():
+    cfg = func_cfg("def f(x):\n"
+                   "    if x:\n"          # L2
+                   "        a = 1\n"      # L3
+                   "    return x\n")      # L4
+    (test,) = nodes_at(cfg, 2)
+    assert {s.line for s in test.succs} == {3, 4}
+
+
+def test_cfg_loop_has_back_edge_and_exit():
+    cfg = func_cfg("def f(xs):\n"
+                   "    for x in xs:\n"   # L2
+                   "        y = x\n"      # L3
+                   "    return y\n")      # L4
+    (header,) = nodes_at(cfg, 2)
+    (body,) = nodes_at(cfg, 3)
+    assert header in body.succs              # back edge
+    assert {s.line for s in header.succs} >= {3, 4}
+
+
+def test_cfg_while_true_exits_only_via_break():
+    cfg = func_cfg("def f(q):\n"
+                   "    while True:\n"        # L2
+                   "        if q.empty():\n"  # L3
+                   "            break\n"      # L4
+                   "        q.get()\n"        # L5
+                   "    return 1\n")          # L6
+    (header,) = nodes_at(cfg, 2)
+    assert {s.line for s in header.succs} == {3}     # no fall-through
+    (brk,) = nodes_at(cfg, 4)
+    (ret,) = nodes_at(cfg, 6)
+    assert ret in brk.succs
+
+
+def test_cfg_try_statements_reach_the_handler():
+    cfg = func_cfg("def f():\n"
+                   "    try:\n"
+                   "        risky()\n"        # L3
+                   "    except OSError:\n"    # L4
+                   "        fallback()\n"     # L5
+                   "    return 1\n")          # L6
+    (risky,) = nodes_at(cfg, 3)
+    (handler,) = nodes_at(cfg, 4)
+    assert handler in risky.succs
+    (ret,) = nodes_at(cfg, 6)
+    (fb,) = nodes_at(cfg, 5)
+    assert ret in fb.succs                    # handler falls through
+
+
+def test_cfg_finally_runs_on_normal_and_handler_paths():
+    cfg = func_cfg("def f():\n"
+                   "    try:\n"
+                   "        risky()\n"        # L3
+                   "    except OSError:\n"
+                   "        fallback()\n"     # L5
+                   "    finally:\n"
+                   "        cleanup()\n"      # L7
+                   "    return 1\n")
+    # normal and handler paths route into the finally through its
+    # synthetic head node (one hop)
+    (fin,) = nodes_at(cfg, 7)
+    (risky,) = nodes_at(cfg, 3)
+    (fb,) = nodes_at(cfg, 5)
+    assert fin in risky.succs or any(fin in s.succs for s in risky.succs)
+    assert fin in fb.succs or any(fin in s.succs for s in fb.succs)
+
+
+def test_cfg_with_pairs_enter_and_exit():
+    cfg = func_cfg("def f(self):\n"
+                   "    with self._mu:\n"     # L2
+                   "        self.x = 1\n"     # L3
+                   "    return 1\n")          # L4
+    (enter,) = nodes_at(cfg, 2, WITH_ENTER)
+    (exit_,) = nodes_at(cfg, 2, WITH_EXIT)
+    assert enter.partner is exit_ and exit_.partner is enter
+    (body,) = nodes_at(cfg, 3)
+    assert body in enter.succs and exit_ in body.succs
+    (ret,) = nodes_at(cfg, 4)
+    assert ret in exit_.succs
+
+
+def test_cfg_exception_inside_with_unwinds_through_the_exit():
+    cfg = func_cfg("def f(self):\n"
+                   "    try:\n"
+                   "        with self._mu:\n"   # L3
+                   "            risky()\n"      # L4
+                   "    except OSError:\n"      # L5
+                   "        pass\n")
+    (exit_,) = nodes_at(cfg, 3, WITH_EXIT)
+    (risky,) = nodes_at(cfg, 4)
+    (handler,) = nodes_at(cfg, 5)
+    assert exit_ in risky.succs       # raise releases the lock first...
+    assert handler in exit_.succs     # ...then reaches the handler
+    assert handler not in risky.succs
+
+
+def test_cfg_entry_and_exit_are_connected():
+    cfg = func_cfg("def f():\n    pass\n")
+    assert cfg.entry.kind == ENTRY and cfg.exit.kind == EXIT
+    (p,) = nodes_at(cfg, 2)
+    assert p in cfg.entry.succs and cfg.exit in p.succs
+
+
+# -------------------------------------------------------------------------
+# Lockset dataflow
+# -------------------------------------------------------------------------
+
+
+def test_lockset_with_block_holds_inside_not_outside():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._mu:\n"
+                           "            self.x = 1\n"     # L4
+                           "        self.y = 2\n")        # L5
+    assert lockset_at(ctx, facts, 4) == {"self._mu"}
+    assert lockset_at(ctx, facts, 5) == set()
+
+
+def test_lockset_explicit_acquire_release_protocol():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        self._mu.acquire()\n"
+                           "        try:\n"
+                           "            self.x = 1\n"       # L5
+                           "        finally:\n"
+                           "            self._mu.release()\n"
+                           "        self.y = 2\n")           # L8
+    assert lockset_at(ctx, facts, 5) == {"self._mu"}
+    assert lockset_at(ctx, facts, 8) == set()
+
+
+def test_lockset_must_analysis_drops_branch_only_locks():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self, flag):\n"
+                           "        if flag:\n"
+                           "            self._mu.acquire()\n"
+                           "        self.x = 1\n")           # L5
+    assert lockset_at(ctx, facts, 5) == set()
+
+
+def test_lockset_release_on_one_branch_clears_the_join():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self, flag):\n"
+                           "        self._mu.acquire()\n"
+                           "        if flag:\n"
+                           "            self._mu.release()\n"
+                           "        self.x = 1\n")           # L6
+    assert lockset_at(ctx, facts, 6) == set()
+
+
+def test_lockset_condition_wait_keeps_the_lock_across_the_call():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._cv:\n"
+                           "            while not self.ready:\n"
+                           "                self._cv.wait(0.1)\n"  # L5
+                           "            self.x = 1\n")             # L6
+    assert lockset_at(ctx, facts, 5) == {"self._cv"}
+    assert lockset_at(ctx, facts, 6) == {"self._cv"}
+
+
+def test_lockset_with_exit_resolves_after_join_narrows_the_entry():
+    """Regression (code review): the with-exit's reentrancy decision
+    depends on the enter's solved input — when a later join narrows it
+    (the acquire sits on only one branch), the exit must be re-solved
+    and release the lock, whichever processing order the worklist
+    took."""
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self, flag):\n"
+                           "        if flag:\n"
+                           "            pass\n"
+                           "        else:\n"
+                           "            self._mu.acquire()\n"
+                           "        with self._mu:\n"
+                           "            self.x = 1\n"       # L8
+                           "        self.y = 2\n")          # L9
+    assert lockset_at(ctx, facts, 8) == {"self._mu"}
+    # on the flag=True path the with's exit DID release: not held after
+    assert lockset_at(ctx, facts, 9) == set()
+
+
+def test_lockset_reentrant_with_does_not_release_the_outer_hold():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._mu:\n"
+                           "            with self._mu:\n"
+                           "                self.x = 1\n"    # L5
+                           "            self.y = 2\n")       # L6
+    assert lockset_at(ctx, facts, 5) == {"self._mu"}
+    assert lockset_at(ctx, facts, 6) == {"self._mu"}
+
+
+def test_lockset_try_lock_idiom_holds_only_on_success_branch():
+    """Regression (code review): `if not self._mu.acquire(blocking=
+    False): return` — the daemon/process.py / util/metrics.py idiom —
+    holds the lock on the success path and NOT on the failed one."""
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        if not self._mu.acquire("
+                           "blocking=False):\n"
+                           "            return None\n"       # L4
+                           "        self.x = 1\n"            # L5
+                           "        self._mu.release()\n")
+    assert lockset_at(ctx, facts, 4) == set()
+    assert lockset_at(ctx, facts, 5) == {"self._mu"}
+
+
+def test_lockset_try_lock_positive_form():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        if self._mu.acquire(False):\n"
+                           "            self.x = 1\n"        # L4
+                           "            self._mu.release()\n"
+                           "        self.y = 2\n")           # L6
+    assert lockset_at(ctx, facts, 4) == {"self._mu"}
+    assert lockset_at(ctx, facts, 6) == set()
+
+
+def test_lockset_finally_runs_under_the_lock_when_try_always_returns():
+    """Regression (code review): `with mu: try: return ... finally:`
+    — the finally body executes (before the with __exit__) on the
+    return path; it must exist in the CFG and see the lock held."""
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._mu:\n"
+                           "            try:\n"
+                           "                return self.work()\n"
+                           "            finally:\n"
+                           "                self.x = 1\n")   # L7
+    assert lockset_at(ctx, facts, 7) == {"self._mu"}
+
+
+def test_lockset_holds_annotation_seeds_the_entry_set():
+    ctx, facts = facts_for(
+        "class C:\n"
+        "    def f(self):  # vet: holds[self._mu]\n"
+        "        self.x = 1\n")                              # L3
+    assert lockset_at(ctx, facts, 3) == {"self._mu"}
+
+
+def test_lockset_early_return_inside_with_does_not_leak():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._mu:\n"
+                           "            if self.done:\n"
+                           "                return 1\n"
+                           "            self.x = 1\n"        # L6
+                           "        self.y = 2\n")           # L7
+    assert lockset_at(ctx, facts, 6) == {"self._mu"}
+    assert lockset_at(ctx, facts, 7) == set()
+
+
+def test_lockset_multi_item_with_acquires_in_order():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._a, self._b:\n"
+                           "            self.x = 1\n")       # L4
+    assert lockset_at(ctx, facts, 4) == {"self._a", "self._b"}
+    events = facts.acquire_events()
+    assert [(sorted(h), t) for h, t, _ in events] == \
+        [([], "self._a"), (["self._a"], "self._b")]
+
+
+def test_lockset_acquire_events_see_nesting():
+    ctx, facts = facts_for("class C:\n"
+                           "    def f(self):\n"
+                           "        with self._outer:\n"
+                           "            with self._inner:\n"
+                           "                pass\n")
+    events = facts.acquire_events()
+    assert (frozenset({"self._outer"}), "self._inner") in \
+        {(h, t) for h, t, _ in events}
+
+
+def test_lockset_cache_is_shared_per_context():
+    src = ("class C:\n"
+           "    def f(self):\n"
+           "        with self._mu:\n"
+           "            self.x = 1\n")
+    ctx = FileContext("tpu_dra/util/x.py", src)
+    func = next(f for f, _ in lockset.functions_in(ctx.tree))
+    facts1 = lockset.analyze(ctx, func)
+    facts2 = lockset.analyze(ctx, func)
+    assert facts1 is facts2                 # same solved object
+    assert ctx._flow_cache[id(func)] is facts1.cfg
+
+
+def test_token_of_shapes():
+    def tok(s):
+        return lockset.token_of(ast.parse(s, mode="eval").body)
+    assert tok("self._mu") == "self._mu"
+    assert tok("_load_mu") == "_load_mu"
+    assert tok("self.kube._mu") == "self.kube._mu"
+    assert tok("get_lock()") is None
+    assert tok("locks[0]") is None

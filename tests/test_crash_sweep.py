@@ -164,6 +164,28 @@ def test_crash_during_unprepare_converges(tmp_path, point):
     _assert_converged(base, point)
 
 
+def test_crash_sweep_restart_is_lockdep_clean(tmp_path):
+    """Runtime lockdep over the sweep's restart/converge half: with the
+    lock-acquisition graph recorded, the restarted DeviceState's full
+    reconcile + re-prepare + unprepare cycle must show an order graph
+    that is acyclic and consistent with the declared registry
+    (tpu_dra/analysis/lockregistry.py) — the dynamic cross-check of the
+    static lock-order checker, run over real crash debris."""
+    from tpu_dra.util import racecheck
+
+    base = str(tmp_path)
+    _mk_state(base)
+    res = _run_child(base, "prepare", "tpu.prepare.after_cdi_write")
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, res.stderr
+    racecheck.install(lockdep=True)
+    try:
+        _assert_converged(base, "tpu.prepare.after_cdi_write")
+        racecheck.assert_lockdep_clean()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
 def test_sweep_covers_every_crash_safe_failpoint():
     """Completeness: the sweep must exercise exactly the crash_safe
     registry — a new crash_safe point fails HERE, not in production."""

@@ -61,7 +61,7 @@ def test_unsynchronized_counter_is_flagged():
     # context manager asserted at least one race; double-check its shape
     # is the classic unordered write pair
     # (races were reset on exit; re-run capturing them explicitly)
-    racecheck.install()
+    racecheck.install(lockdep=True)
     racecheck.monitor(Counter)
     try:
         c = Counter()
@@ -206,7 +206,7 @@ def test_condition_wait_notify_is_clean():
 def test_concurrent_map_writes_are_flagged():
     """Go's detector aborts on concurrent map writes even to distinct
     keys; TrackedDict models the same structural conflict."""
-    racecheck.install()
+    racecheck.install(lockdep=True)
     try:
         d = racecheck.TrackedDict()
 
@@ -223,7 +223,7 @@ def test_concurrent_map_writes_are_flagged():
 
 
 def test_locked_map_writes_are_clean():
-    racecheck.install()
+    racecheck.install(lockdep=True)
     try:
         d = racecheck.TrackedDict()
         mu = threading.Lock()
@@ -235,6 +235,7 @@ def test_locked_map_writes_are_clean():
 
         run_threads(4, writer)
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
         assert len(d) == 20
     finally:
         racecheck.uninstall()
@@ -249,7 +250,7 @@ def test_locked_map_writes_are_clean():
 def test_device_state_concurrent_prepares_race_free(tmp_path):
     """32 prepare/unprepare cycles across 8 threads with DeviceState
     monitored and every lock traced: zero unordered conflicting accesses."""
-    racecheck.install()
+    racecheck.install(lockdep=True)
     from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
     from tpu_dra.tpulib import FakeTpuLib
     from tests.test_stress_concurrency import claim_for
@@ -275,13 +276,14 @@ def test_device_state_concurrent_prepares_race_free(tmp_path):
         run_threads(8, worker)
         assert not errors, errors[:3]
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
         racecheck.uninstall()
         racecheck.reset()
 
 
 def test_workqueue_race_free():
-    racecheck.install()
+    racecheck.install(lockdep=True)
     from tpu_dra.util.workqueue import ItemExponentialBackoff, WorkQueue
 
     racecheck.monitor(ItemExponentialBackoff)
@@ -307,6 +309,7 @@ def test_workqueue_race_free():
         assert done.wait(timeout=30)
         wq.shutdown()
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
         racecheck.uninstall()
         racecheck.reset()
@@ -316,7 +319,7 @@ def test_informer_store_race_free():
     """Writer thread feeds add/update/delete events through the informer
     store while reader threads list and index — the relist-churn path the
     round-2 fix touched (k8s/informer.py:134-139)."""
-    racecheck.install()
+    racecheck.install(lockdep=True)
     from tpu_dra.k8s.informer import Store
 
     racecheck.monitor(Store)
@@ -360,6 +363,7 @@ def test_informer_store_race_free():
             r.join(timeout=30)
         assert not errors, errors[:3]
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
         racecheck.uninstall()
         racecheck.reset()
@@ -371,7 +375,7 @@ def test_membership_manager_race_free():
     main thread share ``_last_ips`` (guarded by ``_mu`` — the guarded-by
     static checker enforces the same contract; test_vet.py cross-wires
     the two lists)."""
-    racecheck.install()
+    racecheck.install(lockdep=True)
     from tpu_dra.daemon.membership import MembershipManager
     from tpu_dra.k8s import FakeKube, TPU_SLICE_DOMAINS
 
@@ -391,6 +395,7 @@ def test_membership_manager_race_free():
             nodes = m.updates.get(timeout=10)
             assert {n.name for n in nodes} == {"n0", "n1"}
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
         for m in managers:
             m.stop()
@@ -412,7 +417,7 @@ def test_decoder_pool_race_free():
                       d_ff=64, max_seq=32)
     params = init_params(cfg, jax.random.PRNGKey(0))
 
-    racecheck.install()
+    racecheck.install(lockdep=True)
     from tpu_dra.workloads.serve import DecoderPool
 
     racecheck.monitor(DecoderPool)
@@ -437,6 +442,7 @@ def test_decoder_pool_race_free():
         assert not errors, errors[:3]
         assert len(outs) == 2 and outs[0] == outs[1]
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
         racecheck.uninstall()
         racecheck.reset()
@@ -448,7 +454,7 @@ def test_kubelet_plugin_grpc_path_race_free(tmp_path):
     socket (grpc's worker threads + the driver's flock/DeviceState/CDI
     stack), with DeviceState and the driver monitored.  This is the
     closest Python gets to running the plugin binary under -race."""
-    racecheck.install()
+    racecheck.install(lockdep=True)
     import grpc
 
     from tpu_dra.k8s import FakeKube, RESOURCE_CLAIMS
@@ -521,6 +527,7 @@ def test_kubelet_plugin_grpc_path_race_free(tmp_path):
         assert not errors, errors[:3]
         assert drv.state.prepared_claims() == {}
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
         drv.stop()
         racecheck.uninstall()
@@ -537,7 +544,7 @@ def test_health_monitor_race_free():
     the two lists)."""
     import time
 
-    racecheck.install()
+    racecheck.install(lockdep=True)
     from tpu_dra.health.monitor import HealthMonitor
     from tpu_dra.tpulib import FakeTpuLib
     from tpu_dra.util.metrics import Registry
@@ -568,6 +575,209 @@ def test_health_monitor_race_free():
         run_threads(4, worker)
         mon.stop()
         racecheck.assert_no_races()
+        racecheck.assert_lockdep_clean()
     finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+# -------------------------------------------------------------------------
+# Runtime lockdep (ISSUE 5): the observed lock-order graph
+# -------------------------------------------------------------------------
+
+
+class _LockPair:
+    def __init__(self) -> None:
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self) -> None:
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self) -> None:
+        with self._b:
+            with self._a:
+                pass
+
+
+def test_lockdep_records_the_acquisition_graph():
+    racecheck.install(lockdep=True)
+    try:
+        p = _LockPair()
+        p.forward()
+        edges = racecheck.lockdep_edges()
+        assert ("_LockPair._a", "_LockPair._b") in edges
+        assert racecheck.lockdep_check(declared_orders=[],
+                                       leaf_locks={}) == []
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_detects_seeded_inversion():
+    """The ABBA deadlock candidate is a graph property: both orders are
+    observed (even from the SAME thread, never hanging) and the cycle is
+    reported deterministically — lockdep's whole point."""
+    racecheck.install(lockdep=True)
+    try:
+        p = _LockPair()
+        p.forward()
+        p.backward()
+        violations = racecheck.lockdep_check(declared_orders=[],
+                                             leaf_locks={})
+        assert any("cycle" in v and "_LockPair._a" in v
+                   for v in violations), violations
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_inverted_declared_order_is_detected():
+    """Deliberately invert a registry-declared order and assert the
+    contradiction is reported even though the reverse nesting is never
+    observed at runtime (the static registry supplies it)."""
+    racecheck.install(lockdep=True)
+    try:
+        p = _LockPair()
+        p.backward()        # observed: _b -> _a
+        violations = racecheck.lockdep_check(
+            declared_orders=[("_LockPair._a", "_LockPair._b")],
+            leaf_locks={})
+        assert any("contradicts the declared order" in v
+                   for v in violations), violations
+        assert any("cycle" in v for v in violations), violations
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_leaf_lock_violation_is_detected():
+    racecheck.install(lockdep=True)
+    try:
+        p = _LockPair()
+        p.forward()
+        violations = racecheck.lockdep_check(
+            declared_orders=[],
+            leaf_locks={"_LockPair._a": "nothing nests under _a"})
+        assert any("leaf lock _LockPair._a" in v
+                   for v in violations), violations
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_checking_context_asserts_on_cycle():
+    import pytest
+
+    with pytest.raises(AssertionError, match="lockdep"):
+        with racecheck.checking():
+            p = _LockPair()
+            p.forward()
+            p.backward()
+
+
+def test_lockdep_upgrade_keeps_preexisting_locks_distinct():
+    """Regression (code review): locks constructed before lockdep was
+    armed (install() upgraded mid-run) lose their creation site but must
+    stay DISTINCT graph nodes — one shared anonymous name would conflate
+    unrelated locks into false cycles."""
+    racecheck.install()                     # happens-before only
+    try:
+        early1 = threading.Lock()
+        early2 = threading.Lock()
+        racecheck.install(lockdep=True)     # upgrade in place
+        class Named:
+            def __init__(self) -> None:
+                self._m = threading.Lock()
+        m = Named()._m
+        with early1:
+            with m:
+                pass
+        with m:
+            with early2:
+                pass
+        # early1 -> m -> early2 is NOT a cycle; a shared "<lock>" name
+        # would have made it one
+        assert racecheck.lockdep_check(declared_orders=[],
+                                       leaf_locks={}) == []
+        names = {n for edge in racecheck.lockdep_edges() for n in edge}
+        assert "Named._m" in names
+        assert len(names) == 3              # both anonymous locks distinct
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_held_stack_does_not_leak_across_install_cycles():
+    """Regression (code review): a lock released while lockdep is
+    DISARMED must still pop the thread's held stack, or it poisons every
+    later armed run in the same process with phantom edges."""
+    racecheck.install(lockdep=True)
+    lingering = threading.Lock()
+    lingering.acquire()                 # pushed while armed
+    racecheck.uninstall()
+    racecheck.reset()
+    lingering.release()                 # popped even though disarmed
+    racecheck.install(lockdep=True)
+    try:
+        fresh = threading.Lock()
+        with fresh:
+            pass
+        assert racecheck.lockdep_edges() == {}      # no phantom edge
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_condition_protocol_stays_clean():
+    """wait/notify hand-off must not corrupt held-stack tracking (the
+    notifier releases a waiter lock it never acquired)."""
+    racecheck.install(lockdep=True)
+    try:
+        cv = threading.Condition()
+        items: list[int] = []
+
+        def consumer() -> None:
+            with cv:
+                while not items:
+                    cv.wait(timeout=30)
+
+        def producer() -> None:
+            with cv:
+                items.append(1)
+                cv.notify()
+
+        tc = threading.Thread(target=consumer)
+        tc.start()
+        producer()
+        tc.join(timeout=30)
+        assert racecheck.lockdep_check(declared_orders=[],
+                                       leaf_locks={}) == []
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()
+
+
+def test_lockdep_observed_graph_matches_repo_registry():
+    """Drive the REAL documented nesting (failpoint reset's _load_mu ->
+    _mu) through fresh traced locks and check against the repo registry:
+    the observed graph and the declared orders must agree."""
+    import tpu_dra.resilience.failpoint as fp
+
+    racecheck.install(lockdep=True)
+    saved = fp._load_mu, fp._mu
+    try:
+        # fresh traced locks standing in for the module's (which were
+        # created at import time, before install, and so are invisible)
+        fp._load_mu = threading.Lock()
+        fp._mu = threading.Lock()
+        fp.reset()                        # takes _load_mu then _mu
+        edges = racecheck.lockdep_edges()
+        assert ("failpoint._load_mu", "failpoint._mu") in edges, edges
+        racecheck.assert_lockdep_clean()
+    finally:
+        fp._load_mu, fp._mu = saved
         racecheck.uninstall()
         racecheck.reset()

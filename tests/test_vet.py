@@ -36,7 +36,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
                    "string-constant-drift", "exception-hygiene",
-                   "metric-hygiene", "retry-hygiene"}
+                   "metric-hygiene", "retry-hygiene", "lock-order",
+                   "blocking-under-lock"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,
@@ -197,6 +198,115 @@ class Box:
     diags = vet_snippet(tmp_path, "tpu_dra/util/gb3.py", src,
                         checks=["guarded-by"])
     assert len(diags) == 1, diags  # the lambda body runs lock-free later
+
+
+def test_guardedby_explicit_acquire_release_protocol_is_clean(tmp_path):
+    """v2 (lockset engine): the try/finally acquire/release idiom is as
+    good as `with` — the line-window heuristic could not see this."""
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def drain(self):
+        self._mu.acquire()
+        try:
+            return list(self._items)
+        finally:
+            self._mu.release()
+"""
+    assert vet_snippet(tmp_path, "tpu_dra/util/gb4.py", src,
+                       checks=["guarded-by"]) == []
+
+
+def test_guardedby_branch_release_is_flow_sensitive(tmp_path):
+    """A lock released on one branch is not held after the join."""
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def leaky(self, flag):
+        self._mu.acquire()
+        if flag:
+            self._mu.release()
+        return len(self._items)
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/gb5.py", src,
+                        checks=["guarded-by"])
+    assert len(diags) == 1 and "Box._items" in diags[0].message
+
+
+def test_guardedby_condition_wait_loop_is_clean(tmp_path):
+    """`cv.wait()` reacquires before returning: accesses around the wait
+    are still under the lock (the workqueue/continuous idiom)."""
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._cv
+        self._cv = threading.Condition()
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(0.1)
+            return self._items.pop()
+"""
+    assert vet_snippet(tmp_path, "tpu_dra/util/gb6.py", src,
+                       checks=["guarded-by"]) == []
+
+
+def test_guardedby_second_with_item_sees_the_first_acquired(tmp_path):
+    """Regression (code review): `with self._mu, pin(self._items):` —
+    item 2 evaluates after item 1 acquired, so the guarded read is
+    legitimate, not a false positive."""
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def pinned(self, pin):
+        with self._mu, pin(self._items):
+            return True
+"""
+    assert vet_snippet(tmp_path, "tpu_dra/util/gb7.py", src,
+                       checks=["guarded-by"]) == []
+
+
+def test_guardedby_lambda_nested_in_lambda_is_checked(tmp_path):
+    """Regression (code review): every lambda runs with nothing held,
+    including one nested inside another lambda."""
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def factory(self):
+        with self._mu:
+            return lambda: (lambda: self._items.pop())()
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/gb8.py", src,
+                        checks=["guarded-by"])
+    assert len(diags) == 1 and "Box._items" in diags[0].message
 
 
 # -------------------------------------------------------------------------
@@ -634,6 +744,456 @@ def test_metric_hygiene_real_driver_metrics_conform():
                        os.path.join(REPO_ROOT, "tpu_dra", "plugins")],
                       checks=["metric-hygiene"])
     assert diags == [], "\n".join(str(d) for d in diags)
+
+
+# -------------------------------------------------------------------------
+# lock-order (static lockdep)
+# -------------------------------------------------------------------------
+
+_CYCLE_BAD = """\
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def forward():
+    with _a:
+        with _b:
+            pass
+
+
+def backward():
+    with _b:
+        with _a:
+            pass
+"""
+
+_ORDER_CLEAN = """\
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def forward():
+    with _a:
+        with _b:
+            pass
+
+
+def also_forward():
+    with _a, _b:
+        pass
+"""
+
+
+def test_lockorder_detects_seeded_cycle(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/util/lo.py", _CYCLE_BAD,
+                        checks=["lock-order"])
+    assert len(diags) == 1, diags
+    msg = diags[0].message
+    assert "cycle" in msg and "lo._a" in msg and "lo._b" in msg
+    # both contributing acquisition sites are named
+    assert msg.count("lo.py:") == 2
+
+
+def test_lockorder_consistent_nesting_is_clean(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/util/lo2.py", _ORDER_CLEAN,
+                       checks=["lock-order"]) == []
+
+
+def test_lockorder_contradicting_a_declared_order_is_a_cycle(tmp_path):
+    """Nesting against a registry-declared order closes a cycle even
+    though the reverse nesting never appears in the file (the
+    failpoint._load_mu -> _mu contract, checked by name)."""
+    src = """\
+import threading
+
+_mu = threading.Lock()
+_load_mu = threading.Lock()
+
+
+def inverted():
+    with _mu:
+        with _load_mu:
+            pass
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/resilience/failpoint.py", src,
+                        checks=["lock-order"])
+    assert len(diags) == 1, diags
+    assert "declared order" in diags[0].message
+
+
+def test_lockorder_leaf_lock_violation(tmp_path):
+    src = """\
+import threading
+
+
+class HealthMonitor:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._other = threading.Lock()
+
+    def bad(self):
+        with self._mu:
+            with self._other:
+                pass
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/health/lo3.py", src,
+                        checks=["lock-order"])
+    assert any("leaf lock HealthMonitor._mu" in d.message for d in diags)
+
+
+def test_lockorder_cross_method_edges_merge_on_one_graph(tmp_path):
+    """The cycle may span two classes' methods — edges are keyed by
+    Owner.attr, not by function."""
+    src = """\
+import threading
+
+
+class A:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def into_b(self, b):
+        with self._mu:
+            b.locked_op()
+
+
+class B:
+    def __init__(self):
+        self._mu = threading.Lock()
+"""
+    # no syntactic nesting of A._mu -> B._mu here: clean (the checker is
+    # intra-procedural; cross-procedural orders go in the registry)
+    assert vet_snippet(tmp_path, "tpu_dra/util/lo4.py", src,
+                       checks=["lock-order"]) == []
+
+
+def test_lockorder_state_resets_between_runs(tmp_path):
+    """A second run over clean code must not report edges accumulated by
+    a previous run (the begin() hook)."""
+    assert checks_fired(vet_snippet(
+        tmp_path, "tpu_dra/util/lo5.py", _CYCLE_BAD,
+        checks=["lock-order"])) == {"lock-order"}
+    assert vet_snippet(tmp_path, "tpu_dra/util/lo6.py", _ORDER_CLEAN,
+                       checks=["lock-order"]) == []
+
+
+# -------------------------------------------------------------------------
+# blocking-under-lock
+# -------------------------------------------------------------------------
+
+_BLOCKING_BAD = """\
+import subprocess
+import threading
+import time
+
+from tpu_dra.resilience import failpoint
+
+
+class Worker:
+    def __init__(self, kube):
+        self._mu = threading.Lock()
+        self.kube = kube
+
+    def slow(self, res, name):
+        with self._mu:
+            time.sleep(0.5)
+            self.kube.get(res, name)
+            subprocess.run(["true"])
+            failpoint.hit("worker.step")
+"""
+
+_BLOCKING_CLEAN = """\
+import threading
+import time
+
+from tpu_dra.resilience import failpoint
+
+
+class Worker:
+    def __init__(self, kube):
+        self._mu = threading.Lock()
+        self.kube = kube
+
+    def fast(self, res, name):
+        with self._mu:
+            snapshot = dict(self.state)
+        time.sleep(0.5)
+        self.kube.get(res, name)
+        failpoint.hit("worker.step")
+        return snapshot
+"""
+
+
+def test_blocking_under_lock_flags_all_four_classes(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/util/bl.py", _BLOCKING_BAD,
+                        checks=["blocking-under-lock"])
+    msgs = "\n".join(d.message for d in diags)
+    assert len(diags) == 4, diags
+    assert "time.sleep()" in msgs
+    assert "kube client call .get()" in msgs
+    assert "subprocess.run()" in msgs
+    assert "failpoint.hit()" in msgs
+    assert "self._mu" in msgs
+
+
+def test_blocking_outside_the_lock_is_clean(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/util/bl2.py", _BLOCKING_CLEAN,
+                       checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_condition_wait_on_sole_lock_is_allowed(tmp_path):
+    src = """\
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def take(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait(0.1)
+"""
+    assert vet_snippet(tmp_path, "tpu_dra/util/bl3.py", src,
+                       checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_wait_holding_a_second_lock_is_flagged(tmp_path):
+    src = """\
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._mu = threading.Lock()
+
+    def take(self):
+        with self._mu:
+            with self._cv:
+                self._cv.wait(0.1)
+
+    def stalled(self, evt):
+        with self._mu:
+            evt.wait(1.0)
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/bl4.py", src,
+                        checks=["blocking-under-lock"])
+    msgs = "\n".join(d.message for d in diags)
+    assert len(diags) == 2, diags
+    assert "releases only self._cv" in msgs      # _mu stays held
+    assert "blocking wait" in msgs               # Event under _mu
+
+
+def test_blocking_call_in_a_with_header_is_flagged(tmp_path):
+    """Regression (code review): a blocking context expression — the
+    subprocess spawned *by the with statement itself* — executes with
+    the outer lock held and must be flagged like any other call."""
+    src = """\
+import subprocess
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def spawn_under_lock(self):
+        with self._mu:
+            with subprocess.Popen(["true"]) as proc:
+                proc.wait()
+
+    def multi_item(self, res, name):
+        with self._mu, self.kube.get(res, name):
+            pass
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/bl6.py", src,
+                        checks=["blocking-under-lock"])
+    msgs = "\n".join(d.message for d in diags)
+    assert len(diags) == 3, diags
+    assert "subprocess.Popen()" in msgs       # the header expression
+    assert "blocking wait on proc" in msgs    # child wait under the lock
+    assert "kube client call .get()" in msgs  # second with-item
+
+
+def test_blocking_in_finally_after_return_is_flagged(tmp_path):
+    """Regression (code review): a blocking call in a `finally` whose
+    try always returns still executes under the lock."""
+    src = """\
+import threading
+
+
+class Worker:
+    def __init__(self, kube):
+        self._mu = threading.Lock()
+        self.kube = kube
+
+    def racy(self, res, name):
+        with self._mu:
+            try:
+                return self.compute()
+            finally:
+                self.kube.update(res, name)
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/bl7.py", src,
+                        checks=["blocking-under-lock"])
+    assert len(diags) == 1 and "kube client call" in diags[0].message
+
+
+def test_guardedby_try_lock_idiom_is_clean(tmp_path):
+    """Regression (code review): annotating a field used under the
+    `if not self._mu.acquire(blocking=False): return` idiom must not
+    produce a false positive (and the failed branch stays checked)."""
+    src = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._items = []          # guarded by self._mu
+        self._mu = threading.Lock()
+
+    def try_drain(self):
+        if not self._mu.acquire(blocking=False):
+            return None
+        try:
+            return list(self._items)
+        finally:
+            self._mu.release()
+
+    def leaky_try(self):
+        if self._mu.acquire(blocking=False):
+            self._mu.release()
+        return len(self._items)
+"""
+    diags = vet_snippet(tmp_path, "tpu_dra/util/gb9.py", src,
+                        checks=["guarded-by"])
+    assert len(diags) == 1, diags
+    assert diags[0].line == 20      # only the genuinely unlocked read
+
+
+def test_blocking_under_lock_ignore_escape(tmp_path):
+    src = _BLOCKING_BAD.replace(
+        "time.sleep(0.5)",
+        "time.sleep(0.5)  # vet: ignore[blocking-under-lock]")
+    diags = vet_snippet(tmp_path, "tpu_dra/util/bl5.py", src,
+                        checks=["blocking-under-lock"])
+    assert len(diags) == 3      # only the sleep is excused
+
+
+# -------------------------------------------------------------------------
+# SARIF output
+# -------------------------------------------------------------------------
+
+
+def test_cli_sarif_schema(tmp_path):
+    bad = tmp_path / "tpu_dra" / "util" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def f():\n    try:\n        pass\n"
+                   "    except Exception:\n        pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis", "--format", "sarif",
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    (run,) = sarif["runs"]
+    assert run["tool"]["driver"]["name"] == "tpudra-vet"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert EXPECTED_CHECKS <= rule_ids
+    (result,) = run["results"]
+    assert result["ruleId"] == "exception-hygiene"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 4
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+
+    clean = tmp_path / "tpu_dra" / "util" / "ok.py"
+    clean.write_text("def f():\n    return 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis", "--format", "sarif",
+         str(clean)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["runs"][0]["results"] == []
+
+
+# -------------------------------------------------------------------------
+# Suppression ratchet (--stats / vet-baseline.json)
+# -------------------------------------------------------------------------
+
+
+def _stats_tree(tmp_path) -> str:
+    d = tmp_path / "tpu_dra" / "util"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "s.py").write_text(
+        "import time\n\n\n"
+        "def f():\n"
+        "    time.sleep(1)  # vet: ignore[retry-hygiene]\n"
+        "    time.sleep(2)  # vet: ignore[retry-hygiene, "
+        "reconcile-hygiene]\n"
+        "    time.sleep(3)  # vet: ignore\n")
+    return str(tmp_path / "tpu_dra")
+
+
+def test_stats_counts_ignores_per_check(tmp_path):
+    from tpu_dra.analysis.core import count_suppressions
+    counts = count_suppressions([_stats_tree(tmp_path)])
+    assert counts == {"retry-hygiene": 2, "reconcile-hygiene": 1, "*": 1}
+
+
+def test_stats_ratchet_exit_codes(tmp_path):
+    tree = _stats_tree(tmp_path)
+    baseline = tmp_path / "vet-baseline.json"
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "tpu_dra.analysis", "--stats",
+             *args, tree],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+
+    proc = run("--write-baseline", str(baseline))
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(baseline.read_text())
+    assert payload["ignores"]["retry-hygiene"] == 2
+
+    # unchanged counts: ratchet holds
+    proc = run("--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # a NEW ignore: the ratchet fails with an actionable message
+    extra = tmp_path / "tpu_dra" / "util" / "s2.py"
+    extra.write_text("import time\n\n\ndef g():\n"
+                     "    time.sleep(9)  # vet: ignore[retry-hygiene]\n")
+    proc = run("--baseline", str(baseline))
+    assert proc.returncode == 1
+    assert "suppression ratchet" in proc.stderr
+    assert "retry-hygiene" in proc.stderr
+
+    # removing ignores only ever shrinks the budget: still exit 0
+    extra.unlink()
+    (tmp_path / "tpu_dra" / "util" / "s.py").write_text(
+        "def f():\n    return 1\n")
+    proc = run("--baseline", str(baseline))
+    assert proc.returncode == 0
+
+
+def test_repo_baseline_matches_the_tree():
+    """The committed vet-baseline.json must stay in sync: CI runs the
+    same check, so a drifting baseline fails here first."""
+    from tpu_dra.analysis.core import count_suppressions
+    with open(os.path.join(REPO_ROOT, "vet-baseline.json")) as fh:
+        baseline = json.load(fh)["ignores"]
+    counts = count_suppressions([os.path.join(REPO_ROOT, "tpu_dra")])
+    grown = {k: v for k, v in counts.items() if v > baseline.get(k, 0)}
+    assert not grown, (
+        f"suppressions above the committed baseline: {grown} — remove "
+        f"them or regenerate vet-baseline.json with justification")
 
 
 # -------------------------------------------------------------------------

@@ -1,17 +1,81 @@
 """``python -m tpu_dra.analysis [paths...]`` — the ``go vet`` entry point.
 
-Exit status: 0 clean, 1 findings, 2 usage error.  ``make vet`` runs this
-over ``tpu_dra/`` next to the dynamic race lane (``make racecheck``),
+Exit status: 0 clean, 1 findings (or a grown suppression count in
+``--stats`` mode), 2 usage error.  ``make vet`` runs this over
+``tpu_dra/`` next to the dynamic race lane (``make racecheck``),
 mirroring the reference's golangci-lint + ``go test -race`` CI pairing.
+
+Modes:
+
+- default: run the analyzers and report (``--format text|json|sarif``;
+  the SARIF form is uploaded by the CI lint job so findings annotate PR
+  diffs);
+- ``--stats``: count ``# vet: ignore`` suppressions per check and, with
+  ``--baseline vet-baseline.json``, enforce the ratchet — the count may
+  shrink or hold, never grow.  ``--write-baseline`` regenerates the
+  committed file after deliberately removing (or justifying new)
+  ignores.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
-from tpu_dra.analysis.core import all_analyzers, run_paths
-from tpu_dra.analysis.report import render_json, render_text
+from tpu_dra.analysis.core import (
+    all_analyzers,
+    count_suppressions,
+    run_paths,
+)
+from tpu_dra.analysis.report import (
+    render_json,
+    render_sarif,
+    render_stats,
+    render_text,
+)
+
+BASELINE_SCHEMA_VERSION = 1
+
+
+def _stats_main(paths: list[str], baseline_path: str | None,
+                write_path: str | None) -> int:
+    try:
+        counts = count_suppressions(paths)
+    except ValueError as exc:
+        print(f"vet: {exc}", file=sys.stderr)
+        return 2
+    if write_path:
+        with open(write_path, "w", encoding="utf-8") as fh:
+            json.dump({"schema_version": BASELINE_SCHEMA_VERSION,
+                       "ignores": counts}, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote baseline ({sum(counts.values())} ignore(s)) to "
+              f"{write_path}")
+        return 0
+    baseline = None
+    if baseline_path:
+        try:
+            with open(baseline_path, encoding="utf-8") as fh:
+                baseline = json.load(fh)["ignores"]
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"vet: cannot load baseline {baseline_path}: {exc}",
+                  file=sys.stderr)
+            return 2
+    print(render_stats(counts, baseline))
+    if baseline is not None:
+        grown = {name: (baseline.get(name, 0), n)
+                 for name, n in counts.items()
+                 if n > baseline.get(name, 0)}
+        if grown:
+            for name, (base, cur) in sorted(grown.items()):
+                print(f"vet: suppression ratchet: {name} has {cur} "
+                      f"ignore(s), baseline allows {base} — remove the "
+                      f"new ignore or (with justification) regenerate "
+                      f"via --stats --write-baseline {baseline_path}",
+                      file=sys.stderr)
+            return 1
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -21,18 +85,39 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*", default=["tpu_dra"],
                         help="files or directories to vet "
                              "(default: tpu_dra)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="diagnostic output format (sarif feeds the "
+                             "CI PR-annotation upload)")
     parser.add_argument("--json", action="store_true",
-                        help="emit machine-readable JSON instead of text")
+                        help="alias for --format json")
     parser.add_argument("--checks",
                         help="comma-separated subset of checks to run")
     parser.add_argument("--list-checks", action="store_true",
                         help="list registered checks and exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="report `# vet: ignore` counts per check "
+                             "instead of running the analyzers")
+    parser.add_argument("--baseline",
+                        help="with --stats: committed baseline JSON; "
+                             "exit 1 if any per-check count grew")
+    parser.add_argument("--write-baseline",
+                        help="with --stats: (re)write the baseline file "
+                             "and exit")
     args = parser.parse_args(argv)
 
     if args.list_checks:
         for a in all_analyzers():
             print(f"{a.name}: {a.doc}")
         return 0
+
+    if args.stats:
+        return _stats_main(args.paths or ["tpu_dra"], args.baseline,
+                           args.write_baseline)
+    if args.baseline or args.write_baseline:
+        print("vet: --baseline/--write-baseline require --stats",
+              file=sys.stderr)
+        return 2
 
     checks = None
     if args.checks:
@@ -42,7 +127,13 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as exc:
         print(f"vet: {exc}", file=sys.stderr)
         return 2
-    print(render_json(diags) if args.json else render_text(diags))
+    fmt = "json" if args.json else args.format
+    if fmt == "json":
+        print(render_json(diags))
+    elif fmt == "sarif":
+        print(render_sarif(diags, all_analyzers()))
+    else:
+        print(render_text(diags))
     return 1 if diags else 0
 
 

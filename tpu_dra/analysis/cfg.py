@@ -1,0 +1,365 @@
+"""Per-function control-flow graphs for the flow-aware checkers.
+
+PR 1's checkers were line-local AST passes; the concurrency checkers
+(lock-order, blocking-under-lock, guarded-by v2) need to know which
+locks are held *at each program point*, which is a dataflow question —
+RacerD-style lockset analysis (Blackshear et al., OOPSLA'18) over a CFG.
+This module builds that CFG, one per ``def``:
+
+- one :class:`Node` per *simple* statement; compound statements
+  contribute their header expressions as nodes (``if``/``while`` tests,
+  ``for`` iterables, ``except`` clauses) and their bodies recursively;
+- ``with`` statements get paired ``with_enter``/``with_exit`` nodes —
+  the hooks the lockset transfer function attaches acquire/release
+  semantics to.  The exit node is shared by the normal path, ``break``/
+  ``continue`` unwinding, and exception edges into enclosing handlers,
+  so a lock acquired by ``with`` is released on every path out of the
+  block (the ``__exit__`` guarantee);
+- exception edges are approximated: any node inside a ``try`` body may
+  jump to each of its handlers (and to ``finally``), routed through the
+  ``with_exit`` nodes between the raise point and the handler;
+- ``return`` edges go to the synthetic exit node, ``raise`` to the
+  innermost handler chain (or nowhere — the path leaves the function);
+- ``while True:`` loops (a constant-true test) get no fall-through exit
+  edge: the repo's worker loops only leave via ``break``/``return``,
+  and a spurious exit edge would drain locksets after them;
+- nested ``def``/``lambda``/``class`` bodies are *opaque* here — they
+  run later, possibly on another thread, so each nested function is
+  analyzed separately with an empty entry lockset (see lockset.py).
+
+CFGs are cheap but not free; callers cache them per file via
+:func:`tpu_dra.analysis.lockset.analyze` so the three concurrency
+checkers share one construction per function per ``run_paths`` call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+__all__ = ["Node", "CFG", "build_cfg"]
+
+# Node kinds
+ENTRY = "entry"
+EXIT = "exit"
+STMT = "stmt"
+WITH_ENTER = "with_enter"
+WITH_EXIT = "with_exit"
+
+_OPAQUE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class Node:
+    """One CFG node: a simple statement, a header expression, or a
+    ``with`` enter/exit event."""
+
+    __slots__ = ("kind", "ast", "items", "partner", "succs", "idx")
+
+    def __init__(self, kind: str, ast_node: Optional[ast.AST] = None,
+                 items: Optional[list[ast.withitem]] = None):
+        self.kind = kind
+        self.ast = ast_node
+        self.items = items or []
+        self.partner: Optional["Node"] = None   # with_enter <-> with_exit
+        self.succs: list["Node"] = []
+        self.idx = -1
+
+    @property
+    def line(self) -> int:
+        if self.ast is not None:
+            return getattr(self.ast, "lineno", 0)
+        if self.items:
+            return getattr(self.items[0].context_expr, "lineno", 0)
+        return 0
+
+    def link(self, succ: "Node") -> None:
+        if succ not in self.succs:
+            self.succs.append(succ)
+
+    def scan_asts(self) -> list[ast.AST]:
+        """The AST subtrees that execute *at* this node (headers only for
+        compound statements; nothing for nested def/class bodies)."""
+        if self.kind in (WITH_ENTER, WITH_EXIT):
+            out: list[ast.AST] = []
+            if self.kind == WITH_ENTER:
+                for item in self.items:
+                    out.append(item.context_expr)
+                    if item.optional_vars is not None:
+                        out.append(item.optional_vars)
+            return out
+        node = self.ast
+        if node is None:
+            return []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            return [node.iter, node.target]
+        if isinstance(node, ast.ExceptHandler):
+            return [node.type] if node.type is not None else []
+        if isinstance(node, _OPAQUE):
+            return []
+        return [node]
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        label = type(self.ast).__name__ if self.ast is not None else ""
+        return f"<Node {self.idx} {self.kind} {label} L{self.line}>"
+
+
+class CFG:
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.nodes: list[Node] = []
+        self.entry = self.new(ENTRY)
+        self.exit = self.new(EXIT)
+
+    def new(self, kind: str, ast_node: Optional[ast.AST] = None,
+            items: Optional[list[ast.withitem]] = None) -> Node:
+        node = Node(kind, ast_node, items)
+        node.idx = len(self.nodes)
+        self.nodes.append(node)
+        return node
+
+    def preds(self) -> dict[Node, list[Node]]:
+        out: dict[Node, list[Node]] = {n: [] for n in self.nodes}
+        for n in self.nodes:
+            for s in n.succs:
+                out[s].append(n)
+        return out
+
+
+def _is_const_true(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Constant) and bool(expr.value)
+
+
+def _try_lock(test: ast.AST) -> Optional[tuple[ast.Call, bool]]:
+    """``if X.acquire(...):`` / ``if not X.acquire(...):`` — the
+    try-lock idiom (daemon/process.py, util/metrics.py): the lock is
+    held only on the success branch.  Returns (the acquire call, True
+    when the *body* is the success branch)."""
+    node, on_true = test, True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        node, on_true = node.operand, False
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "acquire":
+        return node, on_true
+    return None
+
+
+class _Builder:
+    """Recursive-descent CFG construction with a frame stack routing
+    break/continue/exception edges through intervening ``with`` exits."""
+
+    def __init__(self, func: ast.AST):
+        self.cfg = CFG(func)
+        # ("with", exit_node) | ("loop", header, breaks) | ("try", targets)
+        self.frames: list[tuple] = []
+
+    def build(self) -> CFG:
+        body = getattr(self.cfg.func, "body", [])
+        out = self._seq(body, [self.cfg.entry])
+        for n in out:
+            n.link(self.cfg.exit)
+        return self.cfg
+
+    # -- frame helpers ----------------------------------------------------
+    def _exc_targets(self) -> list[Node]:
+        """Where an exception raised *here* goes: the innermost enclosing
+        ``with`` exit (which itself chains outward), else the enclosing
+        handlers (plus the finally head — an unmatched exception type
+        skips the handlers but still runs the finally), else a bare
+        ``finally`` head, else nowhere (it leaves the function)."""
+        for frame in reversed(self.frames):
+            if frame[0] == "with":
+                return [frame[1]]
+            if frame[0] in ("try", "finally"):
+                return list(frame[1]) if frame[0] == "try" \
+                    else [frame[1]]
+        return []
+
+    def _route_to_loop(self, node: Node, kind: str) -> None:
+        """break/continue: unwind through with-exits up to the innermost
+        loop, then register with that loop's break/continue targets."""
+        cur = node
+        for frame in reversed(self.frames):
+            if frame[0] == "with":
+                cur.link(frame[1])
+                cur = frame[1]
+            elif frame[0] == "loop":
+                if kind == "break":
+                    frame[2].append(cur)
+                else:
+                    cur.link(frame[1])      # back to the loop header
+                return
+        # break/continue outside a loop is a SyntaxError upstream; treat
+        # the node as terminal
+
+    def _stmt_node(self, stmt: ast.AST, preds: list[Node]) -> Node:
+        node = self.cfg.new(STMT, stmt)
+        for p in preds:
+            p.link(node)
+        for t in self._exc_targets():
+            node.link(t)
+        return node
+
+    # -- statement sequencing ---------------------------------------------
+    def _seq(self, stmts: Iterable[ast.stmt],
+             preds: list[Node]) -> list[Node]:
+        frontier = list(preds)
+        for stmt in stmts:
+            if not frontier:
+                break                        # unreachable code
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _stmt(self, stmt: ast.stmt, preds: list[Node]) -> list[Node]:
+        if isinstance(stmt, ast.If):
+            test = self._stmt_node(stmt.test, preds)
+            body_entry, orelse_entry = [test], [test]
+            tl = _try_lock(stmt.test)
+            if tl is not None:
+                # a synthetic bare-acquire node heads the success branch
+                # so the lockset engine sees the conditional acquisition
+                call, on_true = tl
+                synth = self.cfg.new(
+                    STMT, ast.copy_location(ast.Expr(value=call), call))
+                test.link(synth)
+                if on_true:
+                    body_entry = [synth]
+                else:
+                    orelse_entry = [synth]
+            body_out = self._seq(stmt.body, body_entry)
+            orelse_out = self._seq(stmt.orelse, orelse_entry) \
+                if stmt.orelse else orelse_entry
+            return body_out + orelse_out
+
+        if isinstance(stmt, (ast.While,)):
+            header = self._stmt_node(stmt.test, preds)
+            breaks: list[Node] = []
+            self.frames.append(("loop", header, breaks))
+            body_out = self._seq(stmt.body, [header])
+            self.frames.pop()
+            for n in body_out:
+                n.link(header)
+            exits: list[Node] = [] if _is_const_true(stmt.test) else [header]
+            exits += self._seq(stmt.orelse, [header]) if stmt.orelse else []
+            return exits + breaks
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            header = self._stmt_node(stmt, preds)
+            breaks = []
+            self.frames.append(("loop", header, breaks))
+            body_out = self._seq(stmt.body, [header])
+            self.frames.pop()
+            for n in body_out:
+                n.link(header)
+            exits = self._seq(stmt.orelse, [header]) if stmt.orelse \
+                else [header]
+            return exits + breaks
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            enter = self.cfg.new(WITH_ENTER, stmt, stmt.items)
+            exit_node = self.cfg.new(WITH_EXIT, stmt, stmt.items)
+            enter.partner, exit_node.partner = exit_node, enter
+            for p in preds:
+                p.link(enter)
+            # acquiring may raise -> unwind to the OUTER context
+            for t in self._exc_targets():
+                enter.link(t)
+            # exceptions inside the body unwind through this exit into
+            # the outer context (the __exit__ release runs first)
+            for t in self._exc_targets():
+                exit_node.link(t)
+            self.frames.append(("with", exit_node))
+            body_out = self._seq(stmt.body, [enter])
+            self.frames.pop()
+            for n in body_out:
+                n.link(exit_node)
+            return [exit_node]
+
+        if isinstance(stmt, ast.Try):
+            fin_head: Optional[Node] = None
+            if stmt.finalbody:
+                # synthetic head: return/raise paths inside the try must
+                # reach the finally body even when the try never
+                # completes normally (`try: return x finally: ...`)
+                fin_head = self.cfg.new(STMT, None)
+            handler_nodes = [self.cfg.new(STMT, h) for h in stmt.handlers]
+            # unmatched exception types skip the handlers but still run
+            # the finally on their way out
+            targets: list[Node] = list(handler_nodes)
+            if fin_head is not None:
+                targets.append(fin_head)
+                self.frames.append(("finally", fin_head))
+            self.frames.append(("try", targets))
+            body_out = self._seq(stmt.body, preds)
+            self.frames.pop()                      # the "try" frame
+            # orelse/handler bodies run un-caught by THIS try's handlers,
+            # but their exceptions (and returns) still take the finally
+            orelse_out = self._seq(stmt.orelse, body_out) if stmt.orelse \
+                else body_out
+            handler_outs: list[Node] = []
+            for hnode, handler in zip(handler_nodes, stmt.handlers):
+                for t in self._exc_targets():
+                    hnode.link(t)           # a handler body may re-raise
+                handler_outs += self._seq(handler.body, [hnode])
+            if fin_head is not None:
+                self.frames.pop()                  # the "finally" frame
+            all_out = orelse_out + handler_outs
+            if fin_head is not None:
+                for n in all_out:
+                    n.link(fin_head)
+                fin_out = self._seq(stmt.finalbody, [fin_head])
+                # the return/raise paths that entered the finally leave
+                # the function once it has run
+                for n in fin_out:
+                    n.link(self.cfg.exit)
+                return fin_out
+            return all_out
+
+        if isinstance(stmt, ast.Return):
+            # exc edges stay (the return expression may raise).  The
+            # normal edge unwinds through intervening with-exits into
+            # the innermost enclosing finally (which runs before the
+            # function is left); with no finally it goes straight to
+            # exit — released locks have no checked points after them
+            node = self._stmt_node(stmt, preds)
+            cur = node
+            for frame in reversed(self.frames):
+                if frame[0] == "with":
+                    cur.link(frame[1])
+                    cur = frame[1]
+                elif frame[0] == "finally":
+                    cur.link(frame[1])
+                    break
+            else:
+                cur.link(self.cfg.exit)
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            self._stmt_node(stmt, preds)
+            return []
+
+        if isinstance(stmt, ast.Break):
+            node = self._stmt_node(stmt, preds)
+            self._route_to_loop(node, "break")
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            node = self._stmt_node(stmt, preds)
+            self._route_to_loop(node, "continue")
+            return []
+
+        if isinstance(stmt, ast.Match):
+            subject = self._stmt_node(stmt.subject, preds)
+            outs: list[Node] = []
+            for case in stmt.cases:
+                outs += self._seq(case.body, [subject])
+            # no case may match
+            return outs + [subject]
+
+        # simple statement (or an opaque nested def/class)
+        return [self._stmt_node(stmt, preds)]
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """Build the CFG for one ``FunctionDef``/``AsyncFunctionDef`` (or any
+    node with a ``body`` list — module-level analysis passes the tree)."""
+    return _Builder(func).build()

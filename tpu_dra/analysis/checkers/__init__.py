@@ -7,10 +7,12 @@ same pattern go/analysis drivers use for their analyzer lists).
 """
 
 from tpu_dra.analysis.checkers import (  # noqa: F401
+    blockunderlock,
     constants,
     excepts,
     guardedby,
     jitpurity,
+    lockorder,
     metrichygiene,
     reconcile,
     retryhygiene,

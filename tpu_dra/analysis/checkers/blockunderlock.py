@@ -1,0 +1,130 @@
+"""blocking-under-lock: no slow or indefinite operation inside a
+critical section.
+
+A blocking call under a held lock turns one stalled I/O into a stalled
+*subsystem*: every thread that contends on the lock queues behind the
+sleeper (the failure mode PR 2 fixed by hand when it moved the health
+listener fan-out outside the monitor lock — this checker is that review
+rule, mechanized over the lockset engine).  Flagged while the lockset is
+non-empty:
+
+- ``time.sleep(...)`` — pacing belongs outside the lock (see the
+  token-bucket idiom in ``k8s/client.py``);
+- kube-client calls (``kube.get/list/update/...``) — network round
+  trips with retry loops behind them;
+- ``subprocess.run/Popen/check_*`` — child processes block arbitrarily;
+- ``failpoint.hit(...)`` — an armed ``sleep``/``stall`` action blocks
+  the calling thread; a point that *means* to stall under the state
+  lock (the crash sweep's mid-critical-section kills) carries a
+  justified ignore;
+- ``X.wait(...)`` / ``X.wait_for(...)`` — a ``Condition.wait`` releases
+  only its *own* lock: waiting while the lockset holds anything else
+  (or waiting on an ``Event`` under any lock) parks the thread with
+  locks held.  Waiting on the sole held lock is the condition-variable
+  protocol and is allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis import lockset
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+from tpu_dra.analysis.cfg import STMT, WITH_ENTER
+
+_SLEEP_TOKENS = {"time.sleep", "sleep"}
+_SUBPROCESS_FNS = {"run", "Popen", "call", "check_call", "check_output",
+                   "communicate"}
+_KUBE_RECEIVERS = {"kube", "kube_client"}
+_KUBE_METHODS = {"get", "list", "create", "update", "update_status",
+                 "delete", "patch", "request", "watch", "stream"}
+
+
+def _held_str(held: frozenset[str]) -> str:
+    return ", ".join(sorted(held))
+
+
+def _blocking_reason(call: ast.Call) -> str | None:
+    tok = lockset.token_of(call.func)
+    if tok is None:
+        return None
+    if tok in _SLEEP_TOKENS:
+        return "time.sleep()"
+    parts = tok.split(".")
+    if parts[0] == "subprocess" and parts[-1] in _SUBPROCESS_FNS:
+        return f"subprocess.{parts[-1]}()"
+    if parts[-1] == "hit" and len(parts) >= 2 and parts[-2] == "failpoint":
+        return "failpoint.hit() (an armed sleep/stall blocks here)"
+    if len(parts) >= 2 and parts[-1] in _KUBE_METHODS \
+            and parts[-2] in _KUBE_RECEIVERS:
+        return f"kube client call .{parts[-1]}()"
+    return None
+
+
+def _scan_calls(ctx: FileContext, tree, held: frozenset[str],
+                diags: list[Diagnostic]) -> None:
+    for sub in lockset.walk_scan(tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        if isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in ("wait", "wait_for"):
+            continue        # the wait protocol is judged separately
+        reason = _blocking_reason(sub)
+        if reason is not None:
+            diags.append(ctx.diag(
+                sub, "blocking-under-lock",
+                f"{reason} while holding {_held_str(held)} — move the "
+                f"blocking work outside the critical section"))
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test():
+        return []
+    diags: list[Diagnostic] = []
+    for func, _cls in lockset.functions_in(ctx.tree):
+        facts = lockset.analyze(ctx, func)
+        for node in facts.cfg.nodes:
+            if not facts.reachable(node):
+                continue
+            if node.kind == WITH_ENTER:
+                # `with` items evaluate in order, each after the previous
+                # acquired: a blocking context expression under an
+                # already-held item (or the entry lockset) blocks too
+                held = facts.lockset(node)
+                for item in node.items:
+                    if held:
+                        _scan_calls(ctx, item.context_expr, held, diags)
+                    tok = lockset.token_of(item.context_expr)
+                    if tok is not None:
+                        held = held | {tok}
+                continue
+            if node.kind != STMT:
+                continue
+            held = facts.lockset(node)
+            if not held:
+                continue
+            for tok, call in lockset.wait_calls(node):
+                if tok is not None and tok in held:
+                    others = held - {tok}
+                    if others:
+                        diags.append(ctx.diag(
+                            call, "blocking-under-lock",
+                            f"{tok}.wait() releases only {tok}; "
+                            f"{_held_str(others)} stay(s) held for the "
+                            f"whole wait"))
+                else:
+                    diags.append(ctx.diag(
+                        call, "blocking-under-lock",
+                        f"blocking wait on {tok or 'a non-lock object'} "
+                        f"while holding {_held_str(held)}"))
+            for tree in node.scan_asts():
+                _scan_calls(ctx, tree, held, diags)
+    return diags
+
+
+register(Analyzer(
+    name="blocking-under-lock",
+    doc="no time.sleep, kube client call, subprocess, failpoint stall, "
+        "or foreign wait while a lock is held (lockset-driven)",
+    run=_run,
+))

@@ -1,12 +1,27 @@
 """guarded-by: lock-discipline checker, the static half of ``-race``.
 
-A field whose ``__init__`` assignment carries a trailing
-``# guarded by self._mu`` comment may only be read or written inside a
-``with self._mu:`` block (or from a method whose ``def`` line declares
-``# vet: holds[self._mu]`` — the caller-acquires contract).  ``__init__``
-itself is exempt: construction happens-before publication, the same
-reasoning the dynamic detector (``tpu_dra/util/racecheck.py``) encodes as
-the fork edge.
+A field whose assignment carries a trailing ``# guarded by self._mu``
+comment may only be read or written while ``self._mu`` is in the
+*lockset* — the flow-fact the CFG engine (``analysis/cfg.py`` +
+``analysis/lockset.py``) computes at every program point.  v2 of this
+checker replaced the original line-window/with-visitor heuristic with
+those lockset facts, which buys:
+
+- explicit ``acquire()``/try/finally ``release()`` protocol support;
+- branch sensitivity: a lock released on one path is not "held" after
+  the join (must-analysis intersection), and an early ``return`` inside
+  ``with`` does not leak the hold into later statements;
+- ``Condition.wait`` correctness: the lock is still held across the
+  call site (wait reacquires before returning);
+- one shared CFG per function with the lock-order and
+  blocking-under-lock checkers (cached per file per run).
+
+The caller-acquires contract is unchanged: ``# vet: holds[self._mu]``
+on the ``def`` line seeds the entry lockset.  ``__init__`` stays exempt
+(construction happens-before publication, the same reasoning the
+dynamic detector encodes as the fork edge), and nested ``def``s /
+lambdas never inherit a held lock — they may run on another thread
+after the lock is gone, so they are analyzed with an empty entry set.
 
 The repo's known shared-state hot spots (the classes
 ``tests/test_racecheck.py`` exercises under the dynamic detector) MUST
@@ -20,6 +35,8 @@ from __future__ import annotations
 import ast
 import re
 
+from tpu_dra.analysis import lockset
+from tpu_dra.analysis.cfg import WITH_ENTER
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 
 # file suffix -> classes that must declare guarded fields.  Kept in sync
@@ -35,6 +52,8 @@ HOT_SPOTS: dict[str, tuple[str, ...]] = {
 }
 
 _GUARDED_RE = re.compile(r"#.*guarded by\s+self\.(\w+)")
+
+_EXEMPT_METHODS = ("__init__", "__del__", "__post_init__")
 
 
 def _self_attr(node: ast.AST) -> str | None:
@@ -69,50 +88,55 @@ def _guard_map(ctx: FileContext, cls: ast.ClassDef) -> dict[str, str]:
     return guards
 
 
-class _MethodVisitor(ast.NodeVisitor):
-    """Walk one method tracking which ``self.<lock>`` locks are held."""
+def _methods(cls: ast.ClassDef):
+    """Every def in the class except the construction-exempt methods and
+    anything nested inside them.  Nested defs elsewhere are yielded in
+    their own right: opaque in the parent's CFG, analyzed with an empty
+    entry lockset here."""
+    def visit(node: ast.AST, exempt: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                skip = exempt or (node is cls and
+                                  child.name in _EXEMPT_METHODS)
+                if not skip:
+                    yield child
+                yield from visit(child, skip)
+            elif not isinstance(child, ast.ClassDef):
+                yield from visit(child, exempt)
+    yield from visit(cls, False)
 
-    def __init__(self, ctx: FileContext, cls: str, guards: dict[str, str],
-                 held: set[str]):
-        self.ctx = ctx
-        self.cls = cls
-        self.guards = guards
-        self.held = held
-        self.diags: list[Diagnostic] = []
 
-    def visit_With(self, node: ast.With) -> None:
-        acquired = set()
-        for item in node.items:
-            name = _self_attr(item.context_expr)
-            if name is not None and name not in self.held:
-                acquired.add(name)
-        self.held |= acquired
-        self.generic_visit(node)
-        self.held -= acquired
+def _lambdas_in(func: ast.AST):
+    """Lambdas belonging to ``func`` itself (not to nested defs) —
+    including lambdas nested inside other lambdas, each yielded in its
+    own right (every one runs with nothing held)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Lambda):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
 
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        name = _self_attr(node)
-        guard = self.guards.get(name) if name else None
-        if guard is not None and guard not in self.held:
-            verb = "written" if isinstance(node.ctx, ast.Store) else "read"
-            self.diags.append(self.ctx.diag(
-                node, "guarded-by",
-                f"{self.cls}.{name} is guarded by self.{guard} but "
-                f"{verb} outside `with self.{guard}:` (declare "
-                f"`# vet: holds[self.{guard}]` on the def line if the "
-                f"caller acquires it)"))
-        self.generic_visit(node)
 
-    def _visit_nested(self, node) -> None:
-        # a nested def/lambda may run on another thread after the lock is
-        # gone: its body starts with nothing held
-        saved, self.held = self.held, set()
-        self.generic_visit(node)
-        self.held = saved
-
-    visit_FunctionDef = _visit_nested
-    visit_AsyncFunctionDef = _visit_nested
-    visit_Lambda = _visit_nested
+def _access_diags(ctx: FileContext, cls: str, guards: dict[str, str],
+                  tree: ast.AST, held: frozenset[str],
+                  scope_note: str = "") -> list[Diagnostic]:
+    diags = []
+    for sub in lockset.walk_scan(tree):
+        name = _self_attr(sub) if isinstance(sub, ast.Attribute) else None
+        guard = guards.get(name) if name else None
+        if guard is not None and f"self.{guard}" not in held:
+            verb = "written" if isinstance(sub.ctx, ast.Store) else "read"
+            diags.append(ctx.diag(
+                sub, "guarded-by",
+                f"{cls}.{name} is guarded by self.{guard} but {verb} "
+                f"without self.{guard} in the lockset{scope_note} "
+                f"(declare `# vet: holds[self.{guard}]` on the def line "
+                f"if the caller acquires it)"))
+    return diags
 
 
 def _check_class(ctx: FileContext, cls: ast.ClassDef) -> list[Diagnostic]:
@@ -120,20 +144,36 @@ def _check_class(ctx: FileContext, cls: ast.ClassDef) -> list[Diagnostic]:
     diags: list[Diagnostic] = []
     if not guards:
         return diags
-    for node in cls.body:
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name in ("__init__", "__del__", "__post_init__"):
-            continue
-        # the holds declaration may trail any line of a wrapped def header
-        header_end = node.body[0].lineno if node.body else node.lineno + 1
-        held = {h.split(".")[-1]
-                for line in range(node.lineno, header_end)
-                for h in ctx.holds_on(line)}
-        visitor = _MethodVisitor(ctx, cls.name, guards, held)
-        for stmt in node.body:
-            visitor.visit(stmt)
-        diags.extend(visitor.diags)
+    for method in _methods(cls):
+        facts = lockset.analyze(ctx, method)
+        for node in facts.cfg.nodes:
+            if not facts.reachable(node):
+                continue
+            held = facts.lockset(node)
+            if node.kind == WITH_ENTER:
+                # items evaluate in order, each after the previous item
+                # acquired: `with self._mu, pin(self._items):` reads
+                # _items with _mu already held
+                for item in node.items:
+                    trees = [item.context_expr]
+                    if item.optional_vars is not None:
+                        trees.append(item.optional_vars)
+                    for tree in trees:
+                        diags.extend(_access_diags(
+                            ctx, cls.name, guards, tree, held))
+                    tok = lockset.token_of(item.context_expr)
+                    if tok is not None:
+                        held = held | {tok}
+                continue
+            for tree in node.scan_asts():
+                diags.extend(_access_diags(
+                    ctx, cls.name, guards, tree, held))
+        # a lambda body runs later, possibly on another thread: nothing
+        # from the enclosing lockset carries over
+        for lam in _lambdas_in(method):
+            diags.extend(_access_diags(
+                ctx, cls.name, guards, lam.body, frozenset(),
+                scope_note=" (lambda bodies run with no lock held)"))
     return diags
 
 
@@ -166,7 +206,7 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
 register(Analyzer(
     name="guarded-by",
     doc="fields annotated `# guarded by self.<lock>` must only be "
-        "accessed under `with self.<lock>:`; hot-spot classes must "
-        "declare their guards",
+        "accessed with the lock in the flow-computed lockset; hot-spot "
+        "classes must declare their guards",
     run=_run,
 ))

@@ -1,0 +1,142 @@
+"""lock-order: static lock-acquisition-graph cycle detection (lockdep).
+
+The Linux kernel's lockdep proved that deadlocks are graph properties:
+record every "acquired B while holding A" edge, and any cycle in the
+resulting order graph is a deadlock that some interleaving can reach —
+no need to ever observe the hang.  This checker is the static half of
+that idea for the repo's 20-odd ``threading.Lock``/``Condition`` sites:
+
+- each file contributes its intra-procedural acquisition edges, read
+  off the lockset engine (``with a: with b:``, ``with a, b:``, explicit
+  ``acquire()`` under a held set, and ``# vet: holds[...]`` entry sets
+  all count);
+- locks are named ``Owner.attr`` — enclosing class for ``self.X``
+  tokens, module basename for module-global locks — so the same lock
+  nested from two different methods lands on one graph node;
+- the declared-order registry (:mod:`tpu_dra.analysis.lockregistry`)
+  contributes the orders the tree documents but that no single function
+  shows syntactically (e.g. ``DeviceState._mu`` -> ``failpoint._mu``
+  through the ``failpoint.hit`` call).  An observed edge against a
+  declared order closes a cycle and is reported as a contradiction;
+- a lock declared *leaf* (``LEAF_LOCKS``) must never have anything
+  acquired under it — the fan-out-outside-the-lock rule as a checkable
+  contract instead of a comment.
+
+Cycles are whole-run findings (edges from different files), emitted by
+the ``finish`` hook and anchored at one contributing acquisition site
+so a justified ``# vet: ignore[lock-order]`` there can suppress them.
+The runtime half (``racecheck`` lockdep mode) validates the *observed*
+graph against the same registry — see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tpu_dra.analysis import lockset
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+from tpu_dra.analysis.lockregistry import (
+    LEAF_LOCKS,
+    declared_edges,
+    merged_cycles,
+)
+
+# (outer, inner) -> acquisition sites ("path:line"), accumulated across
+# the run by _run and consumed by _finish; reset by _begin
+_EDGES: dict[tuple[str, str], list[str]] = {}
+
+
+def _begin() -> None:
+    _EDGES.clear()
+
+
+def _module_globals(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    return names
+
+
+def _qualify(tok: str, cls: str | None, mod_globals: set[str],
+             modbase: str) -> str | None:
+    """Token -> graph node name, or None when the lock's identity cannot
+    be resolved statically (locals, cross-object attribute chains)."""
+    if tok.startswith("self.") and tok.count(".") == 1:
+        return f"{cls}.{tok[5:]}" if cls else None
+    if "." not in tok and tok in mod_globals:
+        return f"{modbase}.{tok}"
+    return None
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test():
+        return []
+    diags: list[Diagnostic] = []
+    modbase = os.path.splitext(ctx.path.rsplit("/", 1)[-1])[0]
+    mod_globals = _module_globals(ctx.tree)
+    for func, cls in lockset.functions_in(ctx.tree):
+        facts = lockset.analyze(ctx, func)
+        for held, tok, node in facts.acquire_events():
+            if not held:
+                continue
+            q_new = _qualify(tok, cls, mod_globals, modbase)
+            for h in held:
+                q_held = _qualify(h, cls, mod_globals, modbase)
+                if q_held is None:
+                    continue
+                if q_held in LEAF_LOCKS and q_held != q_new:
+                    diags.append(ctx.diag(
+                        node.line, "lock-order",
+                        f"acquires {tok} while holding leaf lock "
+                        f"{q_held} ({LEAF_LOCKS[q_held]})"))
+                if q_new is not None and q_new != q_held:
+                    _EDGES.setdefault((q_held, q_new), []).append(
+                        f"{ctx.path}:{node.line}")
+    return diags
+
+
+def _finish() -> list[Diagnostic]:
+    observed = {edge: where[0] for edge, where in _EDGES.items()}
+    declared_labels = {
+        edge: f"declared order ({why.split(':')[0]})"
+        for edge, why in declared_edges().items()}
+    diags: list[Diagnostic] = []
+    for edges in merged_cycles(observed, declared_labels):
+        members = sorted({a for a, _, _ in edges} |
+                         {b for _, b, _ in edges})
+        # anchor the finding at a real observed acquisition site so an
+        # inline ignore can suppress it; registry-only cycles (a
+        # self-contradictory DECLARED_ORDERS) anchor at the registry
+        anchor = next((site for a, b, site in edges
+                       if (a, b) in observed), None)
+        if anchor is not None:
+            path, _, line = anchor.rpartition(":")
+        else:
+            path, line = "tpu_dra/analysis/lockregistry.py", "1"
+        detail = "; ".join(f"{a} -> {b} at {site}"
+                           for a, b, site in edges)
+        diags.append(Diagnostic(
+            path, int(line), 0, "lock-order",
+            f"lock-order cycle among {{{', '.join(members)}}}: "
+            f"{detail} — some interleaving of these acquisitions "
+            f"deadlocks"))
+    return diags
+
+
+register(Analyzer(
+    name="lock-order",
+    doc="the static lock-acquisition graph (observed nestings + the "
+        "declared-order registry) must be acyclic, and nothing may be "
+        "acquired under a declared leaf lock",
+    run=_run,
+    begin=_begin,
+    finish=_finish,
+))

@@ -38,6 +38,7 @@ __all__ = [
     "all_analyzers",
     "run_paths",
     "collect_files",
+    "count_suppressions",
 ]
 
 # ``# vet: ignore`` or ``# vet: ignore[name-a, name-b]`` anywhere in a
@@ -107,9 +108,10 @@ class FileContext:
                 if h:
                     self.holds[line] = [
                         n.strip() for n in h.group(1).split(",") if n.strip()]
-        except tokenize.TokenError:
-            pass  # a parseable file that won't tokenize cleanly is rare;
-            # analyzers still run, only suppressions are lost
+        except (tokenize.TokenError, SyntaxError):
+            pass  # a parseable file that won't tokenize cleanly is rare
+            # (3.12's C tokenizer raises SyntaxError); analyzers still
+            # run, only suppressions are lost
 
     def is_comment_line(self, line: int) -> bool:
         """True when the 1-based line holds only a comment — the shared
@@ -161,6 +163,12 @@ class Analyzer:
     # checkers that only ever fire under these path prefixes advertise
     # them so the driver can skip whole files (and docs can say so)
     scope: tuple[str, ...] = field(default_factory=tuple)
+    # whole-run hooks for cross-file checkers (the go/analysis Facts
+    # analog): ``begin()`` resets accumulated state at the start of a
+    # run_paths call, ``finish()`` emits diagnostics computed over every
+    # file (the lock-order cycle check lives there)
+    begin: Optional[Callable[[], None]] = None
+    finish: Optional[Callable[[], "list[Diagnostic]"]] = None
 
 
 _REGISTRY: dict[str, Analyzer] = {}
@@ -211,7 +219,11 @@ def run_paths(paths: Iterable[str],
             raise ValueError(
                 f"unknown check(s): {', '.join(sorted(unknown))}; "
                 f"known: {', '.join(a.name for a in all_analyzers())}")
+    for analyzer in analyzers:
+        if analyzer.begin is not None:
+            analyzer.begin()
     diags: list[Diagnostic] = []
+    ctxs: dict[str, FileContext] = {}
     for path in collect_files(paths):
         try:
             with open(path, encoding="utf-8") as fh:
@@ -223,9 +235,48 @@ def run_paths(paths: Iterable[str],
                 getattr(exc, "lineno", None) or 1, 0, "parse-error",
                 f"cannot parse: {exc}"))
             continue
+        ctxs[ctx.path] = ctx
         for analyzer in analyzers:
             for d in analyzer.run(ctx):
                 if not ctx.suppressed(d.line, d.check):
                     diags.append(d)
+    for analyzer in analyzers:
+        if analyzer.finish is None:
+            continue
+        for d in analyzer.finish():
+            # whole-run findings anchor at one of the contributing sites;
+            # an ignore on that line suppresses like any other finding
+            ctx = ctxs.get(d.path)
+            if ctx is None or not ctx.suppressed(d.line, d.check):
+                diags.append(d)
     diags.sort(key=lambda d: (d.path, d.line, d.col, d.check))
     return diags
+
+
+def count_suppressions(paths: Iterable[str]) -> dict[str, int]:
+    """``# vet: ignore`` occurrences per check name across ``paths``
+    ("*" = bracketless ignore-everything comments) — the input to the
+    suppression ratchet (``--stats`` / vet-baseline.json).  Tokenize
+    only, no AST: the ratchet pass in ``make vet`` runs as a second
+    process and must not re-pay a full parse of the tree."""
+    counts: dict[str, int] = {}
+    for path in collect_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _IGNORE_RE.search(tok.string)
+                if not m:
+                    continue
+                names = {"*"} if m.group(1) is None else {
+                    n.strip() for n in m.group(1).split(",") if n.strip()}
+                for name in names:
+                    counts[name] = counts.get(name, 0) + 1
+        # 3.12's C tokenizer raises SyntaxError (IndentationError
+        # included) where older ones raised TokenError
+        except (UnicodeDecodeError, SyntaxError, tokenize.TokenError):
+            continue
+    return counts

@@ -1,0 +1,158 @@
+"""The declared lock-order registry — the lockdep "lock class" catalog.
+
+One source of truth consumed by BOTH halves of the concurrency lane:
+
+- the **static** lock-order checker
+  (:mod:`tpu_dra.analysis.checkers.lockorder`) merges these declared
+  edges with the acquisition edges it observes in the tree and fails on
+  any cycle — so code that nests locks *against* a declared order is a
+  contradiction even if the reverse nesting never appears syntactically
+  in the same function;
+- the **dynamic** lockdep mode (:func:`tpu_dra.util.racecheck` with
+  ``lockdep=True``) checks the runtime acquisition graph recorded under
+  the racecheck / crash-sweep / drive-chaos lanes against the same
+  registry, so the static claims and observed behavior cross-validate
+  (the same static+dynamic pairing guarded-by shares with
+  ``HOT_SPOTS``).
+
+Lock names are ``Owner.attr``: the enclosing class name for instance
+locks (``DeviceState._mu``), the module basename for module-level locks
+(``failpoint._mu``).  Both the static qualifier and the runtime lock
+namer produce exactly this form, which is what lets one registry serve
+both lanes.
+
+Declared orders are seeded from the orders the tree already documents —
+every entry cites where the contract lives.  Add a pair when you
+introduce a nesting (outer first); add a leaf declaration when a lock's
+thread model promises "nothing is ever acquired under me" (the
+fan-out-outside-the-lock rule).
+"""
+
+from __future__ import annotations
+
+__all__ = ["DECLARED_ORDERS", "LEAF_LOCKS", "declared_edges",
+           "find_cycles", "merged_cycles", "graph_violations"]
+
+# (outer, inner, where-the-contract-is-documented)
+DECLARED_ORDERS: tuple[tuple[str, str, str], ...] = (
+    ("failpoint._load_mu", "failpoint._mu",
+     "resilience/failpoint.py: reset() and _maybe_load() take the load "
+     "lock first so a concurrent hit() can neither deadlock nor re-arm "
+     "a plan that teardown just cleared"),
+    ("ContinuousEngine._cv", "ContinuousEngine._pool_mu",
+     "workloads/continuous.py:_paged_requirements: page-pool refs are "
+     "taken under _cv with _pool_mu nested inside — 'the one allowed "
+     "nesting order'"),
+    ("DeviceState._mu", "failpoint._mu",
+     "plugins/tpu/device_state.py: the crash/stall failpoints fire "
+     "under the prepare/unprepare state lock by design (the sweep "
+     "kills the process mid-critical-section)"),
+)
+
+# locks whose thread model forbids acquiring ANYTHING while they are
+# held (listener fan-out, status pushes etc. all happen after release)
+LEAF_LOCKS: dict[str, str] = {
+    "HealthMonitor._mu":
+        "health/monitor.py thread model: probes run outside the lock, "
+        "listeners are invoked after the lock is released",
+    "MembershipManager._mu":
+        "daemon/membership.py: _mu only guards the _last_ips dedup "
+        "snapshot; the queue push and all kube I/O happen outside it",
+}
+
+
+def declared_edges() -> dict[tuple[str, str], str]:
+    """The declared orders as a graph-edge map: (outer, inner) -> why."""
+    return {(a, b): why for a, b, why in DECLARED_ORDERS}
+
+
+def find_cycles(graph: dict[str, set[str]]) -> list[list[str]]:
+    """DFS back-edge cycle enumeration, one representative per distinct
+    node set — THE cycle algorithm for both lanes (the static checker
+    formats Diagnostics from it, the runtime lane strings), so the two
+    verdicts cannot drift."""
+    cycles: list[list[str]] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def visit(v: str) -> None:
+        color[v] = 1
+        stack.append(v)
+        for w in sorted(graph.get(v, ())):
+            c = color.get(w, 0)
+            if c == 0:
+                visit(w)
+            elif c == 1:
+                cyc = stack[stack.index(w):] + [w]
+                key = tuple(sorted(set(cyc)))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+        stack.pop()
+        color[v] = 2
+
+    for v in sorted(graph):
+        if color.get(v, 0) == 0:
+            visit(v)
+    return cycles
+
+
+def merged_cycles(observed: dict[tuple[str, str], str],
+                  declared_sites: dict[tuple[str, str], str],
+                  ) -> list[list[tuple[str, str, str]]]:
+    """Merge the observed edge map with the declared edges and enumerate
+    cycles, each as its ordered edge list ``[(outer, inner, site)]``
+    (observed sites win over declared labels).  This merge+enumeration
+    is THE shared core of both lanes' cycle verdicts — the static
+    checker formats Diagnostics from it, the runtime lane strings."""
+    graph: dict[str, set[str]] = {}
+    sites: dict[tuple[str, str], str] = {}
+    for (a, b), site in observed.items():
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        sites[(a, b)] = site
+    for (a, b), label in declared_sites.items():
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        sites.setdefault((a, b), label)
+    return [[(a, b, sites.get((a, b), "?"))
+             for a, b in zip(cyc, cyc[1:])]
+            for cyc in find_cycles(graph)]
+
+
+def graph_violations(observed: dict[tuple[str, str], str],
+                     declared_orders=None,
+                     leaf_locks=None) -> list[str]:
+    """The shared static/dynamic verdict on an acquisition-edge map
+    ``(outer, inner) -> site``: orders contradicting a declared pair,
+    acquisitions under a declared leaf lock, and cycles in the observed
+    graph merged with the declared edges (registry-only cycles are the
+    registry's own inconsistency and are skipped here — the static
+    checker reports those).  Defaults to this registry."""
+    if declared_orders is None:
+        declared_orders = [(a, b) for a, b, _ in DECLARED_ORDERS]
+    if leaf_locks is None:
+        leaf_locks = LEAF_LOCKS
+    violations: list[str] = []
+    declared = {(a, b) for a, b in declared_orders}
+    for a, b in sorted(declared):
+        site = observed.get((b, a))
+        if site is not None:
+            violations.append(
+                f"observed lock order {b} -> {a} (at {site}) contradicts "
+                f"the declared order {a} -> {b}")
+    for (a, b), site in sorted(observed.items()):
+        if a in leaf_locks:
+            violations.append(
+                f"acquired {b} while holding leaf lock {a} (at {site}; "
+                f"{leaf_locks[a]})")
+    for edges in merged_cycles(observed,
+                               {e: "declared" for e in declared}):
+        if not any((a, b) in observed for a, b, _ in edges):
+            continue
+        nodes = [a for a, _, _ in edges] + [edges[-1][1]]
+        detail = "; ".join(f"{a} -> {b} at {site}" for a, b, site in edges)
+        violations.append(
+            f"lock-order cycle {' -> '.join(nodes)}: {detail}")
+    return violations

@@ -1,0 +1,266 @@
+"""Reaching lock-set dataflow over the per-function CFG.
+
+The must-hold analysis behind the concurrency checkers: for every CFG
+node, the set of lock tokens held on *all* paths reaching it.  Modeled
+events:
+
+- ``with self._mu:``            — acquires at ``with_enter``, released at
+  the paired ``with_exit`` (normal, break/continue, and exception paths
+  all route through it — cfg.py's ``__exit__`` guarantee);
+- ``self._mu.acquire()`` / ``self._mu.release()`` as standalone
+  expression statements — the explicit protocol (try/finally style);
+- ``cv.wait()`` / ``cv.wait_for()`` — releases the condition's lock for
+  the duration and reacquires before returning, so the lockset is
+  unchanged *across* the call; checkers that care about the blocked
+  window (blocking-under-lock) read the wait tokens separately;
+- ``# vet: holds[self._mu]`` on the ``def`` header — the caller-acquires
+  contract seeds the entry lockset (the ``+checklocks`` analog);
+- reentrancy — a ``with`` over a token already held acquires nothing and
+  its exit releases nothing (the RLock idiom must not drop the outer
+  hold).
+
+Lock *tokens* are dotted source names (``self._mu``, ``_load_mu``): two
+textually identical tokens in one function are assumed to be the same
+lock, which holds for the attribute-and-module-global locking style this
+repo uses everywhere.  Join is set intersection (must-analysis): a lock
+held on only one branch is not held after the join — exactly the
+"released on one branch but held on another" class the line-local
+checker could not see.
+
+Results are cached per :class:`FileContext` so the three concurrency
+checkers share one CFG + one fixpoint per function per vet run (the
+``make vet`` under-10s budget).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from tpu_dra.analysis.cfg import (
+    CFG,
+    Node,
+    STMT,
+    WITH_ENTER,
+    WITH_EXIT,
+    build_cfg,
+)
+
+__all__ = [
+    "FlowFacts",
+    "analyze",
+    "holds_declared",
+    "token_of",
+    "walk_scan",
+    "with_tokens",
+    "wait_calls",
+    "functions_in",
+]
+
+_NESTED = (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def token_of(expr: ast.AST) -> Optional[str]:
+    """``self._mu`` -> ``"self._mu"``; ``_load_mu`` -> ``"_load_mu"``;
+    anything that is not a plain dotted name -> None."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_scan(tree: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested functions, lambdas,
+    or class bodies — their code runs later (possibly on another thread)
+    and is analyzed separately with an empty lockset."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _NESTED):
+                continue
+            stack.append(child)
+
+
+def with_tokens(node: Node) -> list[str]:
+    """Lock tokens of a with_enter/with_exit node, in acquisition order."""
+    toks = []
+    for item in node.items:
+        tok = token_of(item.context_expr)
+        if tok is not None:
+            toks.append(tok)
+    return toks
+
+
+def _bare_lock_call(node: Node) -> Optional[tuple[str, str]]:
+    """``self._mu.acquire()`` / ``.release()`` as a standalone expression
+    statement -> ("acquire"|"release", token)."""
+    stmt = node.ast
+    if not (node.kind == STMT and isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr in ("acquire", "release")):
+        return None
+    tok = token_of(stmt.value.func.value)
+    if tok is None:
+        return None
+    return (stmt.value.func.attr, tok)
+
+
+def wait_calls(node: Node) -> list[tuple[Optional[str], ast.Call]]:
+    """``X.wait(...)`` / ``X.wait_for(...)`` calls executing at this node
+    -> [(token-of-X, call)] — the blocked-window hook for checkers."""
+    out = []
+    for tree in node.scan_asts():
+        for sub in walk_scan(tree):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("wait", "wait_for"):
+                out.append((token_of(sub.func.value), sub))
+    return out
+
+
+class FlowFacts:
+    """The solved locksets for one function: ``lockset(node)`` is the
+    set of tokens held on every path reaching ``node`` (before it runs)."""
+
+    __slots__ = ("cfg", "_entry")
+
+    def __init__(self, cfg: CFG, entry: dict[Node, frozenset[str]]):
+        self.cfg = cfg
+        self._entry = entry
+
+    def lockset(self, node: Node) -> frozenset[str]:
+        s = self._entry.get(node)
+        return s if s is not None else frozenset()
+
+    def reachable(self, node: Node) -> bool:
+        return self._entry.get(node) is not None
+
+    def acquire_events(self) -> list[tuple[frozenset, str, Node]]:
+        """Every static acquisition: (locks already held, token being
+        acquired, node).  ``with a, b:`` yields b with a in the held set;
+        reentrant acquisitions (token already held) are skipped."""
+        events = []
+        for node in self.cfg.nodes:
+            if not self.reachable(node):
+                continue
+            held = self.lockset(node)
+            if node.kind == WITH_ENTER:
+                for tok in with_tokens(node):
+                    if tok not in held:
+                        events.append((held, tok, node))
+                        held = held | {tok}
+            elif node.kind == STMT:
+                call = _bare_lock_call(node)
+                if call is not None and call[0] == "acquire" \
+                        and call[1] not in held:
+                    events.append((held, call[1], node))
+        return events
+
+
+def _transfer(node: Node, inset: frozenset[str],
+              enter_in: dict[Node, frozenset[str]]) -> frozenset[str]:
+    if node.kind == WITH_ENTER:
+        return inset | frozenset(with_tokens(node))
+    if node.kind == WITH_EXIT:
+        # release only what the paired enter actually acquired: a
+        # reentrant `with` over an already-held token must not drop the
+        # outer hold on exit
+        enter = node.partner
+        held_at_enter = enter_in.get(enter, frozenset()) \
+            if enter is not None else frozenset()
+        return inset - (frozenset(with_tokens(node)) - held_at_enter)
+    call = _bare_lock_call(node)
+    if call is not None:
+        op, tok = call
+        return inset | {tok} if op == "acquire" else inset - {tok}
+    return inset
+
+
+def _solve(cfg: CFG, entry_holds: frozenset[str]) -> FlowFacts:
+    preds = cfg.preds()
+    entry: dict[Node, Optional[frozenset[str]]] = \
+        {n: None for n in cfg.nodes}
+    entry[cfg.entry] = entry_holds
+    enter_in: dict[Node, frozenset[str]] = {}
+    worklist = [cfg.entry]
+    while worklist:
+        node = worklist.pop()
+        inset = entry[node]
+        if inset is None:
+            continue
+        if node.kind == WITH_ENTER and enter_in.get(node) != inset:
+            enter_in[node] = inset
+            # the paired exit's transfer reads enter_in (the reentrancy
+            # decision): when a later join narrows this enter's input,
+            # the exit must be re-solved or it keeps a stale
+            # "already held, don't release" verdict
+            if node.partner is not None and \
+                    entry[node.partner] is not None:
+                worklist.append(node.partner)
+        outset = _transfer(node, inset, enter_in)
+        for succ in node.succs:
+            cur = entry[succ]
+            new = outset if cur is None else (cur & outset)
+            if new != cur:
+                entry[succ] = new
+                worklist.append(succ)
+    return FlowFacts(cfg, {n: s for n, s in entry.items() if s is not None})
+
+
+def holds_declared(ctx, func: ast.AST) -> frozenset[str]:
+    """Tokens from ``# vet: holds[...]`` on (any line of) the def header."""
+    body = getattr(func, "body", None)
+    if not body:
+        return frozenset()
+    header_end = body[0].lineno
+    toks = set()
+    for line in range(func.lineno, header_end):
+        for tok in ctx.holds_on(line):
+            toks.add(tok)
+    return frozenset(toks)
+
+
+def analyze(ctx, func: ast.AST,
+            entry_holds: Optional[frozenset[str]] = None) -> FlowFacts:
+    """CFG + solved locksets for ``func``, cached on the FileContext so
+    every checker in a run shares one construction per function."""
+    if entry_holds is None:
+        entry_holds = holds_declared(ctx, func)
+    cache = getattr(ctx, "_flow_cache", None)
+    if cache is None:
+        cache = {}
+        ctx._flow_cache = cache
+    key = (id(func), entry_holds)
+    facts = cache.get(key)
+    if facts is None:
+        cfg = cache.get(id(func))
+        if cfg is None:
+            cfg = build_cfg(func)
+            cache[id(func)] = cfg
+        facts = _solve(cfg, entry_holds)
+        cache[key] = facts
+    return facts
+
+
+def functions_in(tree: ast.AST) -> Iterator[tuple[ast.AST, Optional[str]]]:
+    """Every def in the file (nested ones included), with the name of its
+    nearest enclosing class (None for module-level functions).  Nested
+    defs are yielded in their own right — they are opaque inside their
+    parent's CFG and get an independent (empty-entry) analysis."""
+    def visit(node: ast.AST, cls: Optional[str]) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (child, cls)
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)

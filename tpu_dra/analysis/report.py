@@ -1,17 +1,24 @@
-"""Diagnostic reporters: text (one finding per line) and JSON.
+"""Diagnostic reporters: text, JSON, and SARIF.
 
 The text form matches the ``file:line:col: [check] message`` shape go vet
 prints; the JSON form is a stable machine-readable schema for CI
-annotation tooling (``schema_version`` guards consumers against drift).
+annotation tooling (``schema_version`` guards consumers against drift);
+the SARIF form (2.1.0) is what ``github/codeql-action/upload-sarif``
+ingests so findings annotate PR diffs inline — the CI lint job uploads
+it next to the human-readable run.
 """
 
 from __future__ import annotations
 
 import json
 
-from tpu_dra.analysis.core import Diagnostic
+from tpu_dra.analysis.core import Analyzer, Diagnostic
 
 JSON_SCHEMA_VERSION = 1
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def render_text(diags: list[Diagnostic]) -> str:
@@ -26,3 +33,64 @@ def render_json(diags: list[Diagnostic]) -> str:
         "count": len(diags),
         "diagnostics": [d.to_dict() for d in diags],
     }, indent=2, sort_keys=True)
+
+
+def render_sarif(diags: list[Diagnostic],
+                 analyzers: list[Analyzer]) -> str:
+    """SARIF 2.1.0: one run, one rule per registered analyzer, one result
+    per finding.  Columns are 0-based internally but SARIF is 1-based."""
+    rules = [{
+        "id": a.name,
+        "shortDescription": {"text": a.doc},
+    } for a in analyzers]
+    known = {a.name for a in analyzers}
+    # parse-error is synthesized by the driver, not a registered analyzer
+    extra = sorted({d.check for d in diags} - known)
+    rules += [{"id": name,
+               "shortDescription": {"text": "driver-synthesized finding"}}
+              for name in extra]
+    results = [{
+        "ruleId": d.check,
+        "level": "error",
+        "message": {"text": d.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": d.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, d.line),
+                           "startColumn": d.col + 1},
+            },
+        }],
+    } for d in diags]
+    return json.dumps({
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpudra-vet",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }, indent=2, sort_keys=True)
+
+
+def render_stats(counts: dict[str, int],
+                 baseline: dict[str, int] | None = None) -> str:
+    """Suppression counts per check, with the baseline delta when one is
+    loaded (the ratchet's human-readable view)."""
+    lines = []
+    names = sorted(set(counts) | set(baseline or {}))
+    for name in names:
+        cur = counts.get(name, 0)
+        if baseline is None:
+            lines.append(f"{name}: {cur}")
+        else:
+            base = baseline.get(name, 0)
+            delta = cur - base
+            sign = f"+{delta}" if delta > 0 else str(delta)
+            lines.append(f"{name}: {cur} (baseline {base}, {sign})")
+    lines.append(f"total: {sum(counts.values())} ignore comment(s)")
+    return "\n".join(lines)

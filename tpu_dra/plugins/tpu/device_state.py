@@ -43,7 +43,11 @@ from tpu_dra.version import DRIVER_NAME
 # the crash-recovery sweep (tests/test_crash_sweep.py, hack/drive_chaos)
 # kills the driver at every crash_safe point below and asserts the next
 # start converges: checkpoint loads clean, orphaned CDI specs/slot
-# pools/heartbeat dirs are reconciled away, re-prepare is idempotent
+# pools/heartbeat dirs are reconciled away, re-prepare is idempotent.
+# Every hit() below fires UNDER the state lock by design — a crash or
+# stall mid-critical-section is exactly the scenario the sweep models —
+# so each carries a per-line blocking-under-lock ignore (the registry
+# declares the matching DeviceState._mu -> failpoint._mu order)
 _PREPARE_FPS = (
     failpoint.register(
         "tpu.prepare.begin",
@@ -179,7 +183,7 @@ class DeviceState:
         """
         with self._mu:
             uid = claim["metadata"]["uid"]
-            failpoint.hit("tpu.prepare.begin")
+            failpoint.hit("tpu.prepare.begin")  # vet: ignore[blocking-under-lock]
             existing = self.checkpoint.get(uid)
             if existing is not None:   # idempotent no-op, :139-146
                 # /var/run/cdi is tmpfs: after a node reboot the checkpoint
@@ -204,12 +208,12 @@ class DeviceState:
                 # unprepare would no-op, leaking them until restart
                 self.mp_manager.cleanup(uid)
                 raise
-            failpoint.hit("tpu.prepare.after_select")
+            failpoint.hit("tpu.prepare.after_select")  # vet: ignore[blocking-under-lock]
             self._stamp_trace_env(per_device_edits)
             with start_span("prepare.cdi_spec_write",
                             attributes={"claim": uid}):
                 self.cdi.create_claim_spec(uid, per_device_edits)
-            failpoint.hit("tpu.prepare.after_cdi_write")
+            failpoint.hit("tpu.prepare.after_cdi_write")  # vet: ignore[blocking-under-lock]
             prepared = PreparedClaim(
                 claim_uid=uid,
                 namespace=claim["metadata"].get("namespace", ""),
@@ -218,32 +222,32 @@ class DeviceState:
             with start_span("prepare.checkpoint_write",
                             attributes={"claim": uid}):
                 self.checkpoint.put(prepared)
-            failpoint.hit("tpu.prepare.after_checkpoint")
+            failpoint.hit("tpu.prepare.after_checkpoint")  # vet: ignore[blocking-under-lock]
             return devices
 
     def unprepare(self, claim_uid: str) -> None:
         """Unprepare by UID only — checkpoint state is authoritative so the
         API server is never needed (device_state.go:172-207)."""
         with self._mu:
-            failpoint.hit("tpu.unprepare.begin")
+            failpoint.hit("tpu.unprepare.begin")  # vet: ignore[blocking-under-lock]
             # heartbeat dir cleanup happens even without a checkpoint
             # entry: a prepare that failed after _claim_edits leaves the
             # dir behind, and claim uids are unique so it would otherwise
             # accumulate for the node's lifetime
             shutil.rmtree(os.path.join(self.cfg.plugin_dir, "heartbeats",
                                        claim_uid), ignore_errors=True)
-            failpoint.hit("tpu.unprepare.after_heartbeat_rm")
+            failpoint.hit("tpu.unprepare.after_heartbeat_rm")  # vet: ignore[blocking-under-lock]
             existing = self.checkpoint.get(claim_uid)
             if existing is None:       # absent ⇒ no-op, :181-189
                 klog.info("unprepare: no checkpoint entry; no-op", level=4,
                           claim=claim_uid)
                 return
             self.mp_manager.cleanup(claim_uid)
-            failpoint.hit("tpu.unprepare.after_slot_cleanup")
+            failpoint.hit("tpu.unprepare.after_slot_cleanup")  # vet: ignore[blocking-under-lock]
             self.cdi.delete_claim_spec(claim_uid)
-            failpoint.hit("tpu.unprepare.after_cdi_delete")
+            failpoint.hit("tpu.unprepare.after_cdi_delete")  # vet: ignore[blocking-under-lock]
             self.checkpoint.remove(claim_uid)
-            failpoint.hit("tpu.unprepare.after_checkpoint")
+            failpoint.hit("tpu.unprepare.after_checkpoint")  # vet: ignore[blocking-under-lock]
 
     def prepared_claims(self) -> dict[str, PreparedClaim]:
         with self._mu:

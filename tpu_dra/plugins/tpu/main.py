@@ -41,6 +41,14 @@ def plugin_flags() -> FlagGroup:
 
 
 def main(argv=None) -> int:
+    # chaos-lane lockdep (TPU_DRA_LOCKDEP=1): must arm before the driver
+    # constructs any lock so the runtime acquisition graph is complete;
+    # the observed graph + registry check is dumped at clean exit when
+    # TPU_DRA_LOCKDEP_REPORT names a path (hack/drive_chaos.py reads it)
+    import os
+    if os.environ.get("TPU_DRA_LOCKDEP"):
+        from tpu_dra.util import racecheck
+        racecheck.maybe_install_from_env()
     args = flags.parse(
         "tpu-kubelet-plugin",
         [flags.plugin_common_flags(), plugin_flags(),

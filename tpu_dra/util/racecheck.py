@@ -42,10 +42,28 @@ only in the test build).  ``tests/test_racecheck.py`` seeds known races to
 prove detection and runs the repo's shared-state hot spots (DeviceState,
 informer caches, the work queue) under the detector; the ``make racecheck``
 lane runs it in CI next to the stress lane.
+
+The **lockdep mode** (``install(lockdep=True)``, on by default under
+:class:`checking`) additionally records the runtime lock-acquisition
+graph — every "acquired B while holding A" edge, keyed by lock *names*
+recovered from the construction site (``HealthMonitor._mu`` style, the
+same naming the static lock-order checker and the declared registry in
+``tpu_dra/analysis/lockregistry.py`` use).  :func:`lockdep_check` fails
+on cycles in the observed graph and on orders contradicting the static
+registry, so the static claims and observed behavior cross-validate —
+the Linux-lockdep half of the concurrency lane, run over the racecheck,
+crash-sweep, and drive-chaos lanes (``TPU_DRA_LOCKDEP=1`` arms it in a
+real binary; ``maybe_install_from_env``).
 """
 
 from __future__ import annotations
 
+import atexit
+import json
+import linecache
+import os
+import re
+import sys
 import threading
 import traceback
 from dataclasses import dataclass, field
@@ -62,7 +80,14 @@ __all__ = [
     "Race",
     "TrackedDict",
     "checking",
+    "lockdep_edges",
+    "lockdep_check",
+    "assert_lockdep_clean",
+    "maybe_install_from_env",
 ]
+
+LOCKDEP_ENV_VAR = "TPU_DRA_LOCKDEP"
+LOCKDEP_REPORT_ENV_VAR = "TPU_DRA_LOCKDEP_REPORT"
 
 # --------------------------------------------------------------------------
 # Vector clocks
@@ -92,6 +117,10 @@ _state_lock = threading.Lock()  # created pre-install: always a raw lock
 _thread_vcs: dict[int, _VC] = {}
 _races: list["Race"] = []
 _installed = False
+_lockdep = False
+# (outer-name, inner-name) -> "file:line" of the first acquisition that
+# created the edge                                 # guarded by _state_lock
+_lock_edges: dict[tuple[str, str], str] = {}
 _monitored: dict[type, tuple] = {}  # cls -> (orig_getattribute, orig_setattr)
 # Reentrancy guard: detector internals must not re-enter themselves when
 # they touch locks/fields of their own.
@@ -188,6 +217,7 @@ def reset() -> None:
     with _state_lock:
         _races.clear()
         _thread_vcs.clear()
+        _lock_edges.clear()
 
 
 def assert_no_races() -> None:
@@ -196,6 +226,168 @@ def assert_no_races() -> None:
         raise AssertionError(
             f"{len(found)} data race(s) detected:\n" +
             "\n".join(str(r) for r in found[:10]))
+
+
+# --------------------------------------------------------------------------
+# Lockdep: runtime lock-acquisition graph (the Linux lockdep analog)
+# --------------------------------------------------------------------------
+
+# files whose frames are the allocator's plumbing, not the owning code
+_LOCKDEP_SKIP_FILES = (os.sep + "racecheck.py", os.sep + "threading.py",
+                       os.sep + "queue.py", os.sep + "dataclasses.py",
+                       os.sep + "contextlib.py")
+_ASSIGN_RE = re.compile(r"([A-Za-z_][\w.]*)\s*(?::[^=]+)?=\s*")
+
+
+def _lockdep_name(lock: "_TracedLock") -> None:
+    """Name the lock after its construction site: ``Owner.attr`` — the
+    enclosing instance's class for ``self._mu = Lock()`` lines, the
+    module basename for module globals — matching the static checker's
+    and the registry's naming.  Locks allocated *inside* a ``wait()``
+    (Condition waiter locks) are transient plumbing: mark them internal
+    so held-tracking ignores them."""
+    frame = sys._getframe(2)
+    while frame is not None and \
+            frame.f_code.co_filename.endswith(_LOCKDEP_SKIP_FILES):
+        if frame.f_code.co_name == "wait":
+            lock._rc_internal = True
+            return
+        frame = frame.f_back
+    if frame is None:       # pragma: no cover - interpreter bootstrap
+        lock._rc_name = "<unknown>"
+        return
+    fname = frame.f_code.co_filename
+    modbase = os.path.splitext(os.path.basename(fname))[0]
+    text = linecache.getline(fname, frame.f_lineno).strip()
+    m = _ASSIGN_RE.match(text)
+    if m is None:
+        lock._rc_name = f"{modbase}:{frame.f_lineno}"
+        return
+    target = m.group(1)
+    owner_name, dot, attr = target.partition(".")
+    if dot and "." not in attr:
+        # one attribute hop: resolve the owner — instance (`self._mu`),
+        # module (`failpoint._mu` via monkeypatch), or class
+        owner = frame.f_locals.get(
+            owner_name, frame.f_globals.get(owner_name))
+        if isinstance(owner, type(os)):                 # a module
+            lock._rc_name = \
+                f"{owner.__name__.rsplit('.', 1)[-1]}.{attr}"
+            return
+        if isinstance(owner, type):
+            lock._rc_name = f"{owner.__name__}.{attr}"
+            return
+        if owner is not None:
+            lock._rc_name = f"{type(owner).__name__}.{attr}"
+            return
+    if not dot and frame.f_code.co_name == "<module>":
+        lock._rc_name = f"{modbase}.{target}"
+    else:
+        # a local (or an unresolvable chain): site naming keeps distinct
+        # locks distinct without guessing owners
+        lock._rc_name = f"{modbase}:{frame.f_lineno}({target})"
+
+
+def _lockdep_site() -> str:
+    frame = sys._getframe(2)
+    while frame is not None and \
+            frame.f_code.co_filename.endswith(_LOCKDEP_SKIP_FILES):
+        frame = frame.f_back
+    if frame is None:       # pragma: no cover
+        return "<unknown>"
+    return (f"{os.path.basename(frame.f_code.co_filename)}:"
+            f"{frame.f_lineno}")
+
+
+def _lockdep_acquired(lock: "_TracedLock") -> None:
+    """Record held->lock edges and push onto this thread's held stack."""
+    if getattr(lock, "_rc_internal", False):
+        return
+    if lock._rc_name == "<lock>":
+        # constructed before lockdep was armed (install() upgraded
+        # mid-run): the creation site is gone, but each lock must still
+        # be a DISTINCT graph node — one shared "<lock>" name would
+        # conflate unrelated locks into false cycles (and silently drop
+        # real edges between two of them)
+        lock._rc_name = f"<lock#{id(lock):x}>"
+    held = getattr(_local, "held", None)
+    if held is None:
+        held = _local.held = []
+    if held:
+        me = lock._rc_name
+        site = None
+        for h in held:
+            if h is lock or h._rc_name == me:
+                continue
+            key = (h._rc_name, me)
+            if key not in _lock_edges:
+                if site is None:
+                    site = _lockdep_site()
+                with _state_lock:
+                    _lock_edges.setdefault(key, site)
+    held.append(lock)
+
+
+def _lockdep_released(lock: "_TracedLock") -> None:
+    if getattr(lock, "_rc_internal", False):
+        return
+    held = getattr(_local, "held", None)
+    if held:
+        try:
+            held.remove(lock)
+        except ValueError:
+            pass    # released by a non-owner (Condition notify protocol)
+
+
+def lockdep_edges() -> dict[tuple[str, str], str]:
+    """The observed acquisition graph: (outer, inner) -> first site."""
+    with _state_lock:
+        return dict(_lock_edges)
+
+
+def lockdep_check(declared_orders=None, leaf_locks=None) -> list[str]:
+    """Violations in the observed graph: cycles (with the declared-order
+    registry merged in), orders contradicting a declared pair, and
+    acquisitions under a declared leaf lock.  The verdict itself is the
+    SHARED implementation in ``tpu_dra.analysis.lockregistry`` — the
+    same contract the static lock-order checker enforces, so the two
+    lanes cannot drift.  Defaults to the repo registry."""
+    from tpu_dra.analysis.lockregistry import graph_violations
+    return graph_violations(lockdep_edges(), declared_orders, leaf_locks)
+
+
+def assert_lockdep_clean(declared_orders=None, leaf_locks=None) -> None:
+    found = lockdep_check(declared_orders, leaf_locks)
+    if found:
+        raise AssertionError(
+            f"{len(found)} lockdep violation(s):\n" +
+            "\n".join(f"  - {v}" for v in found))
+
+
+def _write_lockdep_report(path: str) -> None:
+    report = {
+        "edges": [[a, b, site]
+                  for (a, b), site in sorted(lockdep_edges().items())],
+        "violations": lockdep_check(),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+
+
+def maybe_install_from_env() -> bool:
+    """Arm lockdep in a REAL binary when ``TPU_DRA_LOCKDEP=1`` — called
+    first thing from the plugin mains so every lock constructed after
+    startup is traced.  With ``TPU_DRA_LOCKDEP_REPORT=<path>`` the
+    observed graph + violations are dumped there at clean exit (the
+    drive-chaos lane's hook)."""
+    if os.environ.get(LOCKDEP_ENV_VAR, "") not in ("1", "true", "yes"):
+        return False
+    install(lockdep=True)
+    report = os.environ.get(LOCKDEP_REPORT_ENV_VAR, "")
+    if report:
+        atexit.register(_write_lockdep_report, report)
+    return True
 
 
 # --------------------------------------------------------------------------
@@ -218,6 +410,10 @@ class _TracedLock:
         self._rc_vc = _VC()
         self._rc_owner: Optional[int] = None
         self._rc_count = 0
+        self._rc_internal = False
+        self._rc_name = "<lock>"
+        if _lockdep:
+            _lockdep_name(self)
 
     # -- edges ----------------------------------------------------------
     def _edge_acquire(self) -> None:
@@ -254,6 +450,8 @@ class _TracedLock:
             self._rc_owner = me
             self._rc_count = 1
             self._edge_acquire()
+            if _lockdep:
+                _lockdep_acquired(self)
         return got
 
     def release(self) -> None:
@@ -267,6 +465,10 @@ class _TracedLock:
         self._edge_release()
         self._rc_owner = None
         self._rc_count = 0
+        # unconditional pop: a lock acquired while lockdep was armed but
+        # released after disarm must not linger on the thread's held
+        # stack and fabricate phantom edges in a later armed run
+        _lockdep_released(self)
         self._rc_lock.release()
 
     def locked(self) -> bool:
@@ -299,6 +501,7 @@ class _TracedRLock(_TracedLock):
         self._edge_release()
         self._rc_count = 0
         self._rc_owner = None
+        _lockdep_released(self)     # unconditional: see release()
         self._rc_lock.release()
         return (count, owner)
 
@@ -306,6 +509,10 @@ class _TracedRLock(_TracedLock):
         self._rc_lock.acquire()
         self._rc_count, self._rc_owner = state
         self._edge_acquire()
+        if _lockdep:
+            # reacquiring after wait() is an acquisition like any other:
+            # anything still held orders before this lock
+            _lockdep_acquired(self)
 
     def _is_owned(self) -> bool:
         return self._rc_owner == threading.get_ident()
@@ -315,16 +522,21 @@ _raw_lock_factory = threading.Lock  # rebound at install() to the true factory
 _orig: dict[str, Any] = {}
 
 
-def install() -> None:
+def install(lockdep: bool = False) -> None:
     """Patch ``threading`` so sync operations carry happens-before edges.
 
     Must run before the objects under test (and their locks/queues/events)
     are constructed — primitives created earlier stay untraced, exactly as
-    un-instrumented code is invisible to ``-race``.
+    un-instrumented code is invisible to ``-race``.  With ``lockdep=True``
+    every traced lock is named from its construction site and the runtime
+    acquisition graph is recorded (:func:`lockdep_check`); module-level
+    locks created before install stay invisible, same as above.
     """
-    global _installed, _raw_lock_factory
+    global _installed, _raw_lock_factory, _lockdep
     if _installed:
+        _lockdep = _lockdep or lockdep
         return
+    _lockdep = lockdep
     reset()
     _raw_lock_factory = threading.Lock
     _orig["Lock"] = threading.Lock
@@ -384,7 +596,8 @@ def install() -> None:
 
 def uninstall() -> None:
     """Restore ``threading``; monitored classes are restored too."""
-    global _installed
+    global _installed, _lockdep
+    _lockdep = False
     if not _installed:
         return
     threading.Lock = _orig["Lock"]  # type: ignore[misc]
@@ -582,17 +795,22 @@ class TrackedDict(dict):
 class checking:
     """Context manager: ``with racecheck.checking(ClassA, ClassB): ...``.
 
-    Installs the threading patches, monitors the given classes, and on exit
-    asserts no races were found (pass ``expect_races=True`` to invert, for
-    seeded-race tests) before uninstalling.
+    Installs the threading patches (lockdep mode included, so every
+    racecheck lane also validates the runtime lock-order graph against
+    the declared registry), monitors the given classes, and on exit
+    asserts no races and no lockdep violations were found (pass
+    ``expect_races=True`` to invert the race half, for seeded-race
+    tests; ``lockdep=False`` to opt a test out of order checking).
     """
 
-    def __init__(self, *classes: type, expect_races: bool = False) -> None:
+    def __init__(self, *classes: type, expect_races: bool = False,
+                 lockdep: bool = True) -> None:
         self.classes = classes
         self.expect_races = expect_races
+        self.lockdep = lockdep
 
     def __enter__(self) -> "checking":
-        install()
+        install(lockdep=self.lockdep)
         for cls in self.classes:
             monitor(cls)
         return self
@@ -606,6 +824,8 @@ class checking:
                             "expected the seeded race to be detected")
                 else:
                     assert_no_races()
+                if self.lockdep:
+                    assert_lockdep_clean()
         finally:
             uninstall()
             reset()
