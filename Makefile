@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench drive drive-trace drive-health drive-chaos image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -30,6 +30,14 @@ test-core: native
 
 bench: native
 	$(PYTHON) bench.py
+
+# prepare-path latency ratchet (docs/performance.md): the deterministic
+# microbench vs the committed budget — the PR-5 suppression-ratchet
+# pattern applied to latency, so infra PRs can't silently give the hot
+# path back.  Re-baseline (bench host only):
+#   python bench_prepare.py --write-budget bench-budget.json
+bench-gate: native
+	JAX_PLATFORMS=cpu $(PYTHON) bench_prepare.py --gate bench-budget.json > bench-prepare-report.json
 
 # end-to-end drives: real plugin over its unix sockets, real slice daemon
 # with the supervised native coordd — no cluster needed

@@ -1087,6 +1087,8 @@ def bench_prepare_latency(n_claims: int = 200) -> dict:
         request_serializer=lambda m: m.SerializeToString(),
         response_deserializer=dra_pb.NodeUnprepareResourcesResponse.FromString)
 
+    from tpu_dra.trace import DEFAULT_RING
+    DEFAULT_RING.clear()   # phase spans from THIS run only
     lat = []
     try:
         for i in range(n_claims):
@@ -1122,6 +1124,16 @@ def bench_prepare_latency(n_claims: int = 200) -> dict:
         load1, load5, _ = os.getloadavg()
     except OSError:
         load1 = load5 = -1.0
+    # per-phase breakdown from the tracer's own prepare phase spans
+    # (ISSUE 6): BENCH_r06.json onward records where prepare time GOES,
+    # not just the aggregate — bench_prepare.py is the scalpel version
+    phases: dict[str, list[float]] = {}
+    for span in DEFAULT_RING.spans():
+        if span["name"].startswith("prepare."):
+            phases.setdefault(span["name"].split(".", 1)[1], []) \
+                .append(span["duration"])
+    phase_p50 = {name: round(statistics.median(xs) * 1e3, 3)
+                 for name, xs in sorted(phases.items())}
     return {
         "p50_ms": statistics.median(steady) * 1e3,
         "p95_ms": steady[int(0.95 * len(steady))] * 1e3,
@@ -1129,6 +1141,7 @@ def bench_prepare_latency(n_claims: int = 200) -> dict:
         "cold_n": cold_n,
         "cold_p50_ms": round(statistics.median(cold) * 1e3, 3),
         "cold_max_ms": round(max(cold) * 1e3, 3),
+        "phase_p50_ms": phase_p50,
         "host_load_1m": round(load1, 2),
         "host_load_5m": round(load5, 2),
         "host_cpus": os.cpu_count(),

@@ -1,0 +1,463 @@
+"""Prepare-path microbench + the CI latency ratchet (``make bench-gate``).
+
+Where ``bench.py`` is the round artifact (one JSON line, every
+subsystem), this is the scalpel for ROADMAP open item 3: a
+deterministic, seconds-not-minutes benchmark of the NodePrepareResources
+hot path that answers *where the time goes* — per phase
+(``select_devices`` / ``cdi_spec_write`` / ``checkpoint_write`` /
+``sharing_setup``, from the PR-3 tracer's own phase spans), warm vs
+cold, with instrumentation armed (sample ratio 1) vs idle (ratio 0,
+failpoints disarmed) — and *whether it regressed*.
+
+A raw latency gate on shared CI runners is a flaky gate, so the ratchet
+separates what the HOST imposes from what the CODE costs: the bench
+first measures the filesystem floor (one durable ``atomic_write`` — the
+checkpoint commit — plus one plain write — the claim CDI spec — is the
+irreducible fs work of a prepare) and gates primarily on
+``overhead_p50_ms`` = warm p50 − floor, which is the
+instrumentation-plus-logic cost the repo controls and is comparable
+across hosts.  Absolute budgets (the 1.2 ms r01-parity headline) are
+enforced only when the measured floor says the host is at least as fast
+as the bench host; elsewhere they are reported, not gated.
+
+Usage::
+
+    python bench_prepare.py                 # JSON report on stdout
+    python bench_prepare.py --gate bench-budget.json   # exit 1 on regression
+    python bench_prepare.py --write-budget bench-budget.json  # re-baseline
+
+Re-baselining (mirrors vet-baseline.json): run on the bench host, eyeball
+the report, commit the regenerated budget with the PR that justifies the
+new floor.  See docs/performance.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from tpu_dra.plugins.tpu.device_state import (  # noqa: E402
+    DeviceState,
+    DeviceStateConfig,
+)
+from tpu_dra.resilience import failpoint  # noqa: E402
+from tpu_dra.trace import DEFAULT_RING, configure as trace_configure  # noqa: E402
+from tpu_dra.tpulib import FakeTpuLib  # noqa: E402
+from tpu_dra.util.fsutil import atomic_write  # noqa: E402
+from tpu_dra.version import DRIVER_NAME  # noqa: E402
+
+API_GROUP_VERSION = "resource.tpu.google.com/v1beta1"
+PHASES = ("prepare.select_devices", "prepare.cdi_spec_write",
+          "prepare.checkpoint_write", "prepare.sharing_setup")
+
+# deterministic workload shape: claims cycle over 4 chips, every 4th
+# claim carries a MultiProcess sharing config so the sharing_setup
+# phase is on the measured path (it is part of the reference's prepare)
+WARM_N = 240
+COLD_N = 24
+
+
+def _claim(i: int, uid: str) -> dict:
+    claim = {
+        "metadata": {"uid": uid, "namespace": "default", "name": uid},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME,
+             "pool": "bench-node", "device": f"tpu-{i % 4}"},
+        ]}}},
+    }
+    if i % 4 == 3:
+        claim["status"]["allocation"]["devices"]["config"] = [{
+            "source": "FromClaim", "requests": [],
+            "opaque": {"driver": DRIVER_NAME, "parameters": {
+                "apiVersion": API_GROUP_VERSION, "kind": "TpuConfig",
+                "sharing": {"strategy": "MultiProcess",
+                            "multiProcess": {"maxProcesses": 4}},
+            }},
+        }]
+    return claim
+
+
+def _percentiles(samples_s: list[float]) -> dict:
+    xs = sorted(samples_s)
+    return {
+        "n": len(xs),
+        "p50_ms": round(statistics.median(xs) * 1e3, 4),
+        "p95_ms": round(xs[int(0.95 * len(xs))] * 1e3, 4),
+        "mean_ms": round(statistics.fmean(xs) * 1e3, 4),
+    }
+
+
+class FloorProbe:
+    """The irreducible filesystem work of one prepare on THIS host: one
+    durable atomic_write (checkpoint commit: fdatasync + dir fsync) plus
+    one plain atomic_write (claim CDI spec).
+
+    Host weather (CI disk throttling, noisy neighbors) moves by the
+    second, so a floor measured once up front poisons every overhead
+    number computed minutes later — the probe is instead *interleaved*
+    with the section it normalizes: call :meth:`sample` once per bench
+    iteration and subtract p50 from p50 over the SAME window."""
+
+    def __init__(self, base: str, tag: str) -> None:
+        self.d = os.path.join(base, f"fsfloor-{tag}")
+        os.makedirs(self.d, exist_ok=True)
+        self.samples: list[float] = []
+        self._payload = "x" * 600
+
+    def sample(self) -> None:
+        p = os.path.join(self.d, "probe.json")
+        t0 = time.perf_counter()
+        atomic_write(p, self._payload, durable=True)
+        atomic_write(p, self._payload, durable=False)
+        self.samples.append(time.perf_counter() - t0)
+
+    def p50_ms(self) -> float:
+        return round(statistics.median(self.samples) * 1e3, 4)
+
+
+def bench_fs_floor(base: str) -> dict:
+    """Standalone floor numbers for the report header (the per-section
+    overheads use their own interleaved probes)."""
+    probe = FloorProbe(base, "header")
+    for _ in range(60):
+        probe.sample()
+    return {"floor_per_prepare_ms": probe.p50_ms()}
+
+
+def bench_cpu_probe() -> float:
+    """p90 of a fixed CPU-bound unit (json round-trip of a prepare-sized
+    payload, no I/O): the second arming condition for the absolute gate.
+    tmpfs makes the FS floor pass on almost any Linux host, but a
+    CPU-oversubscribed shared runner inflates the gRPC path without
+    touching the fs probe — p90 (not p50) because contention shows up as
+    preemption spikes in the tail of an otherwise-fast C-level loop."""
+    payload = {"preparedClaims": {f"uid-{i}": {"devices": [
+        {"uuid": f"chip-{i}", "cdi": [f"google.com/tpu=tpu-{i}"]}]}
+        for i in range(8)}}
+    samples = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        json.loads(json.dumps(payload, sort_keys=True))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return round(samples[180] * 1e3, 4)
+
+
+def _mk_state(base: str, tag: str) -> DeviceState:
+    return DeviceState(DeviceStateConfig(
+        tpulib=FakeTpuLib(),
+        plugin_dir=os.path.join(base, tag, "plugin"),
+        cdi_root=os.path.join(base, tag, "cdi")))
+
+
+def _phase_breakdown() -> dict:
+    """Per-phase p50s from the tracer ring (the PR-3 phase spans are the
+    measurement instrument — the bench proves them truthful against the
+    end-to-end number: phases + other ≈ p50)."""
+    by_name: dict[str, list[float]] = {}
+    for span in DEFAULT_RING.spans():
+        if span["name"] in PHASES:
+            by_name.setdefault(span["name"], []).append(span["duration"])
+    out = {}
+    for name in PHASES:
+        samples = by_name.get(name)
+        if samples:
+            short = name.split(".", 1)[1]
+            out[short] = {
+                "n": len(samples),
+                "p50_ms": round(statistics.median(samples) * 1e3, 4),
+            }
+    return out
+
+
+def _warm_loop(state: DeviceState, probe: FloorProbe, prefix: str,
+               n: int = WARM_N) -> dict:
+    """One measured warm section: every iteration pays a floor probe
+    (same dir, same weather window) and then one timed prepare; the
+    unprepare keeps the node clean but is untimed, like bench.py."""
+    warm = []
+    for i in range(n):
+        uid = f"{prefix}-{i}"
+        claim = _claim(i, uid)
+        probe.sample()
+        t0 = time.perf_counter()
+        state.prepare(claim)
+        warm.append(time.perf_counter() - t0)
+        state.unprepare(uid)
+    out = _percentiles(warm)
+    out["fs_floor_p50_ms"] = probe.p50_ms()
+    out["overhead_p50_ms"] = round(out["p50_ms"] - out["fs_floor_p50_ms"],
+                                   4)
+    return out
+
+
+def bench_direct(base: str) -> dict:
+    """DeviceState.prepare/unprepare straight (no gRPC, no kube fetch):
+    the driver-owned slice of the hot path, in two instrumentation
+    states — armed (trace ratio 1: every span real and exported) and
+    idle (ratio 0: the zero-cost-when-idle contract)."""
+    out: dict = {}
+
+    # -- armed: sample everything, phases measured from the spans -------
+    trace_configure(service="bench-prepare", sample_ratio=1.0)
+    state = _mk_state(base, "armed")
+    cold = []
+    for i in range(COLD_N):   # cold: first-touch costs, fresh state
+        uid = f"cold-{i}"
+        t0 = time.perf_counter()
+        state.prepare(_claim(i, uid))
+        cold.append(time.perf_counter() - t0)
+    DEFAULT_RING.clear()
+    armed = _warm_loop(state, FloorProbe(base, "armed"), "warm")
+    armed["phases"] = _phase_breakdown()
+    out["warm"] = armed
+    out["cold"] = _percentiles(cold)
+    for i in range(COLD_N):
+        state.unprepare(f"cold-{i}")
+
+    # -- idle: ratio 0, failpoints disarmed — what a production node
+    # with tracing off pays for carrying the instrumentation ----------
+    trace_configure(service="bench-prepare", sample_ratio=0.0)
+    failpoint.reset()
+    state = _mk_state(base, "idle")
+    for i in range(COLD_N):
+        uid = f"ic-{i}"
+        state.prepare(_claim(i, uid))
+        state.unprepare(uid)
+    out["idle"] = _warm_loop(state, FloorProbe(base, "idle"), "iw")
+    trace_configure(service="bench-prepare", sample_ratio=1.0)
+    return out
+
+
+def bench_concurrent(base: str, threads: int = 8,
+                     per_thread: int = 30) -> dict:
+    """Group-commit coalescing under concurrency: N threads preparing
+    distinct claims share checkpoint fsync pairs via the barrier's
+    leader election, so flushes per mutation drop below 1 and aggregate
+    throughput beats serial by more than core-count effects explain."""
+    import threading
+
+    state = _mk_state(base, "conc")
+    start = threading.Barrier(threads)
+    errs: list = []
+
+    def worker(t: int) -> None:
+        try:
+            start.wait()
+            for i in range(per_thread):
+                uid = f"t{t}-{i}"
+                state.prepare(_claim(i, uid))
+                state.unprepare(uid)
+        except Exception as exc:  # noqa: BLE001 — surfaced in the report
+            errs.append(repr(exc))
+
+    ts = [threading.Thread(target=worker, args=(t,), daemon=True)
+          for t in range(threads)]
+    flushes_before = state.checkpoint.flushes
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - t0
+    ops = threads * per_thread * 2          # prepare + unprepare
+    flushes = state.checkpoint.flushes - flushes_before
+    return {
+        "threads": threads,
+        "claims": threads * per_thread,
+        "errors": errs,
+        "ops_per_s": round(ops / wall, 1),
+        "checkpoint_mutations": ops,
+        "checkpoint_flushes": flushes,
+        # < 1.0 means the group commit is actually coalescing
+        "flushes_per_mutation": round(flushes / ops, 3),
+    }
+
+
+def bench_grpc() -> dict:
+    """The full stack (gRPC over the DRA socket → claim fetch → flock →
+    DeviceState → barrier), same path and claim shape as bench.py's
+    headline — THE r01-parity number."""
+    import bench
+    res = bench.bench_prepare_latency(n_claims=150)
+    return {
+        "warm": {"p50_ms": round(res["p50_ms"], 4),
+                 "p95_ms": round(res["p95_ms"], 4),
+                 "mean_ms": round(res["mean_ms"], 4)},
+        "cold": {"p50_ms": res["cold_p50_ms"], "n": res["cold_n"]},
+    }
+
+
+def _pick_workdir() -> str:
+    """Prefer tmpfs (/dev/shm): the gate must measure the CODE, and a
+    shared CI runner's throttled disk injects tens of milliseconds of
+    weather per fsync that no budget can absorb.  tmpfs makes the fs
+    floor small and *stable*, which both steadies the overhead metrics
+    and automatically activates the absolute gates (their
+    ``fs_floor_ceiling_ms`` condition).  Real-disk behavior is a
+    property of the deployment, not of this repo's code — bench.py's
+    round artifact still reports it."""
+    shm = os.environ.get("BENCH_PREPARE_DIR", "/dev/shm")
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        return tempfile.mkdtemp(prefix="tpu-dra-bench-prepare-", dir=shm)
+    return tempfile.mkdtemp(prefix="tpu-dra-bench-prepare-")
+
+
+def run_all() -> dict:
+    base = _pick_workdir()
+    # the grpc section (via bench.py) builds its own tmpdir: point the
+    # process default at the same filesystem so the two sections agree
+    tempfile.tempdir = base
+    report = {
+        "schema": "bench_prepare/v1",
+        "workdir": base,
+        "fs": bench_fs_floor(base),
+        "cpu_probe_p90_ms": bench_cpu_probe(),
+        "direct": bench_direct(base),
+        "concurrent": bench_concurrent(base),
+    }
+    # grpc overhead: everything above the fs floor — the gRPC hop, the
+    # kube claim fetch, flock, and the driver logic.  Its floor is
+    # sampled immediately before the section so the two share weather.
+    probe = FloorProbe(base, "grpc")
+    for _ in range(60):
+        probe.sample()
+    grpc = bench_grpc()
+    grpc["warm"]["fs_floor_p50_ms"] = probe.p50_ms()
+    grpc["warm"]["overhead_p50_ms"] = round(
+        grpc["warm"]["p50_ms"] - probe.p50_ms(), 4)
+    report["grpc"] = grpc
+    try:
+        load1, _, _ = os.getloadavg()
+    except OSError:
+        load1 = -1.0
+    report["host"] = {"cpus": os.cpu_count(), "load_1m": round(load1, 2)}
+    return report
+
+
+# -- the ratchet gate ------------------------------------------------------
+
+def _gates(report: dict) -> dict[str, float]:
+    """Metric name -> measured value, as gated against the budget."""
+    return {
+        "direct_warm_overhead_p50_ms":
+            report["direct"]["warm"]["overhead_p50_ms"],
+        "direct_idle_overhead_p50_ms":
+            report["direct"]["idle"]["overhead_p50_ms"],
+        "grpc_warm_overhead_p50_ms":
+            report["grpc"]["warm"]["overhead_p50_ms"],
+        "flushes_per_mutation":
+            report["concurrent"]["flushes_per_mutation"],
+    }
+
+
+def gate(report: dict, budget: dict) -> list[str]:
+    """Violations of the committed budget; empty = pass.
+
+    Overhead metrics gate unconditionally (they subtract the measured
+    fs floor, so a slow CI disk cannot fail them); the absolute
+    ``grpc_warm_p50_ms`` headline gates only when this host matches the
+    bench-host class on BOTH axes the prepare path is sensitive to —
+    fs floor within ``fs_floor_ceiling_ms`` AND the CPU probe within
+    ``cpu_floor_ceiling_ms`` (tmpfs makes the fs condition pass almost
+    anywhere; a CPU-throttled shared runner fails the second instead of
+    flaking the build)."""
+    violations = []
+    measured = _gates(report)
+    for name, limit in budget.get("gates", {}).items():
+        got = measured.get(name)
+        if got is None:
+            violations.append(f"budget names unknown metric {name!r}")
+        elif got > limit:
+            violations.append(
+                f"{name}: measured {got} > budget {limit}")
+    absolute = budget.get("absolute", {})
+    fs_ceiling = absolute.get("fs_floor_ceiling_ms")
+    cpu_ceiling = absolute.get("cpu_floor_ceiling_ms")
+    floor = report["grpc"]["warm"]["fs_floor_p50_ms"]
+    cpu = report.get("cpu_probe_p90_ms", 0.0)
+    if fs_ceiling is None:
+        return violations
+    fs_ok = floor <= fs_ceiling
+    cpu_ok = cpu_ceiling is None or cpu <= cpu_ceiling
+    if fs_ok and cpu_ok:
+        limit = absolute.get("grpc_warm_p50_ms")
+        got = report["grpc"]["warm"]["p50_ms"]
+        if limit is not None and got > limit:
+            violations.append(
+                f"grpc_warm_p50_ms: measured {got} > budget {limit} "
+                f"(absolute gate active: fs floor {floor} <= "
+                f"{fs_ceiling}, cpu probe {cpu} <= {cpu_ceiling})")
+    else:
+        why = []
+        if not fs_ok:
+            why.append(f"fs floor {floor}ms > {fs_ceiling}ms")
+        if not cpu_ok:
+            why.append(f"cpu probe {cpu}ms > {cpu_ceiling}ms")
+        print(f"# absolute grpc_warm_p50_ms gate skipped: "
+              f"{'; '.join(why)} (overhead gates still enforced)",
+              file=sys.stderr)
+    return violations
+
+
+def write_budget(report: dict, path: str, headroom: float = 1.6) -> None:
+    """Regenerate the budget from this run (re-baseline): measured
+    overheads × ``headroom`` so ordinary jitter passes and a PR-2-5
+    style creep (~+0.4 ms) fails."""
+    budget = {
+        "schema": "bench-budget/v1",
+        "comment": "regenerate with: python bench_prepare.py "
+                   "--write-budget bench-budget.json  (bench host only; "
+                   "see docs/performance.md)",
+        "gates": {
+            # ratio metrics are capped at their arithmetic bound; time
+            # metrics get jitter headroom over this run's measurement
+            name: (min(round(max(value, 0.02) * headroom, 3), 1.0)
+                   if name == "flushes_per_mutation"
+                   else round(max(value, 0.02) * headroom, 3))
+            for name, value in _gates(report).items()},
+        "absolute": {
+            "grpc_warm_p50_ms": 1.2,
+            "fs_floor_ceiling_ms": 0.4,
+            "cpu_floor_ceiling_ms": 0.1,
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(budget, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--gate", metavar="BUDGET_JSON",
+                    help="compare against a committed budget; exit 1 on "
+                         "regression")
+    ap.add_argument("--write-budget", metavar="BUDGET_JSON",
+                    help="re-baseline: write a fresh budget from this run")
+    args = ap.parse_args()
+    report = run_all()
+    print(json.dumps(report, sort_keys=True))
+    if args.write_budget:
+        write_budget(report, args.write_budget)
+        print(f"# wrote {args.write_budget}", file=sys.stderr)
+    if args.gate:
+        with open(args.gate) as f:
+            budget = json.load(f)
+        violations = gate(report, budget)
+        for v in violations:
+            print(f"BENCH-GATE FAIL: {v}", file=sys.stderr)
+        if violations:
+            sys.exit(1)
+        print("# bench-gate: within budget", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

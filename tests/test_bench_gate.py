@@ -1,0 +1,136 @@
+"""bench_prepare gate logic (ISSUE 6): the latency ratchet must be
+deterministic — pass/fail comes from dict comparisons, not re-running
+the bench — so the gate itself is unit-testable with synthetic reports.
+"""
+
+import json
+import os
+
+import pytest
+
+import bench_prepare
+
+pytestmark = pytest.mark.core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
+            grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03):
+    return {
+        "schema": "bench_prepare/v1",
+        "fs": {"floor_per_prepare_ms": grpc_floor},
+        "cpu_probe_p90_ms": cpu,
+        "direct": {
+            "warm": {"p50_ms": grpc_floor + direct_warm_oh,
+                     "overhead_p50_ms": direct_warm_oh},
+            "idle": {"p50_ms": grpc_floor + direct_idle_oh,
+                     "overhead_p50_ms": direct_idle_oh},
+        },
+        "concurrent": {"flushes_per_mutation": flushes},
+        "grpc": {"warm": {"p50_ms": grpc_p50,
+                          "fs_floor_p50_ms": grpc_floor,
+                          "overhead_p50_ms": grpc_oh}},
+    }
+
+
+def _budget(**overrides):
+    budget = {
+        "schema": "bench-budget/v1",
+        "gates": {
+            "direct_warm_overhead_p50_ms": 1.0,
+            "direct_idle_overhead_p50_ms": 0.8,
+            "grpc_warm_overhead_p50_ms": 4.0,
+            "flushes_per_mutation": 1.0,
+        },
+        "absolute": {"grpc_warm_p50_ms": 1.2,
+                     "fs_floor_ceiling_ms": 0.4,
+                     "cpu_floor_ceiling_ms": 0.1},
+    }
+    budget.update(overrides)
+    return budget
+
+
+def test_within_budget_passes():
+    assert bench_prepare.gate(_report(), _budget()) == []
+
+
+def test_overhead_regression_fails():
+    violations = bench_prepare.gate(
+        _report(direct_warm_oh=1.7), _budget())
+    assert len(violations) == 1
+    assert "direct_warm_overhead_p50_ms" in violations[0]
+    assert "1.7" in violations[0] and "1.0" in violations[0]
+
+
+def test_overhead_gate_is_fs_weather_proof():
+    """The same code overhead on a 10x slower disk must still pass: the
+    gated metric subtracts the measured floor, so a throttled CI runner
+    cannot fail the build on its own."""
+    slow_host = _report(grpc_floor=12.0, grpc_p50=14.0)
+    assert bench_prepare.gate(slow_host, _budget()) == []
+
+
+def test_absolute_gate_arms_only_on_fast_hosts():
+    """grpc_warm_p50_ms is the bench-host headline: enforced when the
+    measured floor is under the ceiling, reported otherwise."""
+    fast_bad = _report(grpc_floor=0.2, grpc_p50=1.5, grpc_oh=1.3)
+    violations = bench_prepare.gate(fast_bad, _budget())
+    assert any("grpc_warm_p50_ms" in v and "absolute gate active" in v
+               for v in violations), violations
+    slow_same_code = _report(grpc_floor=5.0, grpc_p50=6.3, grpc_oh=1.3)
+    assert bench_prepare.gate(slow_same_code, _budget()) == []
+
+
+def test_absolute_gate_disarms_on_cpu_contention():
+    """Review regression: tmpfs makes the fs floor pass on nearly any
+    Linux host, so a CPU-oversubscribed runner (fast disk, slow
+    everything else) must ALSO disarm the absolute gate via the cpu
+    probe condition instead of flaking the build."""
+    contended = _report(grpc_floor=0.05, grpc_p50=1.5, grpc_oh=1.45,
+                        cpu=0.8)
+    assert bench_prepare.gate(contended, _budget()) == []
+    # same fast disk with a healthy cpu: the absolute gate fires
+    healthy = _report(grpc_floor=0.05, grpc_p50=1.5, grpc_oh=1.45,
+                      cpu=0.03)
+    assert any("grpc_warm_p50_ms" in v
+               for v in bench_prepare.gate(healthy, _budget()))
+
+
+def test_unknown_budget_metric_is_a_violation():
+    budget = _budget(gates={"no_such_metric_ms": 1.0})
+    violations = bench_prepare.gate(_report(), budget)
+    assert violations and "unknown metric" in violations[0]
+
+
+def test_flushes_per_mutation_gate():
+    violations = bench_prepare.gate(
+        _report(flushes=1.4),        # >1 = barrier writing more than once
+        _budget())
+    assert any("flushes_per_mutation" in v for v in violations)
+
+
+def test_write_budget_round_trips_and_caps_ratios(tmp_path):
+    report = _report(direct_warm_oh=0.5, flushes=0.99)
+    path = tmp_path / "budget.json"
+    bench_prepare.write_budget(report, str(path), headroom=1.6)
+    budget = json.loads(path.read_text())
+    assert budget["schema"] == "bench-budget/v1"
+    assert budget["gates"]["direct_warm_overhead_p50_ms"] == 0.8
+    # ratio metrics never exceed their arithmetic bound
+    assert budget["gates"]["flushes_per_mutation"] == 1.0
+    # a report regenerated from its own run always passes its budget
+    assert bench_prepare.gate(report, budget) == []
+
+
+def test_committed_budget_is_well_formed():
+    """The checked-in bench-budget.json must parse, carry the schema,
+    and name only metrics the gate computes — a typo'd budget would
+    otherwise silently gate nothing."""
+    with open(os.path.join(REPO_ROOT, "bench-budget.json")) as f:
+        budget = json.load(f)
+    assert budget["schema"] == "bench-budget/v1"
+    known = set(bench_prepare._gates(_report()))
+    assert set(budget["gates"]) <= known, \
+        (sorted(set(budget["gates"]) - known), sorted(known))
+    assert budget["absolute"]["grpc_warm_p50_ms"] == 1.2

@@ -124,3 +124,125 @@ def test_versionless_garbage_reports_corrupt(tmp_path):
         {"checksum": native.crc32c(payload.encode()), "data": payload}))
     with pytest.raises(CorruptCheckpoint, match="migration failed"):
         Checkpoint(str(path)).load()
+
+
+# -------------------------------------------------------------------------
+# Group-commit writer (ISSUE 6): coalesced durability + barrier contract
+# -------------------------------------------------------------------------
+
+
+def test_put_flush_true_is_durable_immediately(tmp_path):
+    """The default contract is unchanged: put() returns with the
+    mutation on disk."""
+    path = tmp_path / "checkpoint.json"
+    ckpt = Checkpoint(str(path))
+    ckpt.put(make_claim("a"))
+    fresh = Checkpoint(str(path))
+    assert fresh.load() and "a" in fresh.prepared
+
+
+def test_deferred_mutations_coalesce_into_one_flush(tmp_path):
+    """N flush=False mutations + one barrier = ONE disk write carrying
+    all of them — the group-commit batching, deterministic form."""
+    path = tmp_path / "checkpoint.json"
+    ckpt = Checkpoint(str(path))
+    for i in range(10):
+        ckpt.put(make_claim(f"c{i}"), flush=False)
+    assert not path.exists()          # nothing durable yet
+    before = ckpt.flushes
+    ckpt.barrier()
+    assert ckpt.flushes == before + 1
+    fresh = Checkpoint(str(path))
+    assert fresh.load()
+    assert sorted(fresh.prepared) == sorted(f"c{i}" for i in range(10))
+
+
+def test_barrier_with_nothing_dirty_is_a_no_op(tmp_path):
+    ckpt = Checkpoint(str(tmp_path / "checkpoint.json"))
+    ckpt.put(make_claim("a"))
+    before = ckpt.flushes
+    ckpt.barrier()
+    ckpt.barrier()
+    assert ckpt.flushes == before     # already durable: no extra writes
+
+
+def test_concurrent_barriers_share_the_leaders_flush(tmp_path):
+    """Followers whose mutations the leader's snapshot covers must not
+    write again: mutations land first, then every thread barriers —
+    total flushes <= threads (and the state contains every mutation)."""
+    import threading
+
+    path = tmp_path / "checkpoint.json"
+    ckpt = Checkpoint(str(path))
+    n = 8
+    for i in range(n):
+        ckpt.put(make_claim(f"t{i}"), flush=False)
+    start = threading.Barrier(n)
+
+    def worker():
+        start.wait()
+        ckpt.barrier()
+
+    ts = [threading.Thread(target=worker) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # every barrier target was <= the dirty seq when the first leader
+    # captured it, so one flush CAN serve all; allow stragglers but the
+    # coalescing must beat one-write-per-caller
+    assert 1 <= ckpt.flushes < n
+    fresh = Checkpoint(str(path))
+    assert fresh.load() and len(fresh.prepared) == n
+
+
+def test_quiesce_window_widens_the_batch(tmp_path):
+    """A leader with quiesce_s > 0 picks up mutations that land during
+    its window: the late put rides the SAME flush."""
+    import threading
+    import time as _time
+
+    path = tmp_path / "checkpoint.json"
+    ckpt = Checkpoint(str(path), quiesce_s=0.3)
+    ckpt.put(make_claim("early"), flush=False)
+    done = threading.Event()
+
+    def leader():
+        ckpt.barrier()
+        done.set()
+
+    t = threading.Thread(target=leader)
+    t.start()
+    _time.sleep(0.05)                  # leader is inside its quiesce
+    ckpt.put(make_claim("late"), flush=False)
+    t.join(timeout=10)
+    assert done.is_set()
+    assert ckpt.flushes == 1
+    fresh = Checkpoint(str(path))
+    assert fresh.load() and set(fresh.prepared) == {"early", "late"}
+
+
+def test_failed_flush_propagates_and_retry_recovers(tmp_path, monkeypatch):
+    """A write error surfaces to the barrier caller (not swallowed into
+    a background thread) and the state stays dirty: the next barrier
+    retries and succeeds."""
+    import tpu_dra.plugins.tpu.checkpoint as cp_mod
+
+    path = tmp_path / "checkpoint.json"
+    ckpt = Checkpoint(str(path))
+    boom = {"armed": True}
+    real = cp_mod.atomic_write
+
+    def flaky(p, data, durable=True):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise OSError("disk full")
+        return real(p, data, durable=durable)
+
+    monkeypatch.setattr(cp_mod, "atomic_write", flaky)
+    with pytest.raises(OSError):
+        ckpt.put(make_claim("a"))      # flush=True -> the error surfaces
+    assert not path.exists()
+    ckpt.barrier()                     # retry: state was still dirty
+    fresh = Checkpoint(str(path))
+    assert fresh.load() and "a" in fresh.prepared

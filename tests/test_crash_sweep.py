@@ -61,14 +61,15 @@ UNPREPARE_POINTS = (
 )
 
 _HARNESS = """
-import json, sys
+import json, os, sys
 sys.path.insert(0, {repo!r})
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
 from tpu_dra.tpulib import FakeTpuLib
 
 plugin_dir, cdi_root, op, claim_json = sys.argv[1:5]
 state = DeviceState(DeviceStateConfig(
-    tpulib=FakeTpuLib(), plugin_dir=plugin_dir, cdi_root=cdi_root))
+    tpulib=FakeTpuLib(), plugin_dir=plugin_dir, cdi_root=cdi_root,
+    checkpoint_quiesce_s=float(os.environ.get("SWEEP_QUIESCE_S", "0"))))
 claim = json.loads(claim_json)
 if op == "prepare":
     state.prepare(claim)
@@ -95,13 +96,15 @@ def _mk_state(base) -> DeviceState:
         cdi_root=os.path.join(base, "cdi")))
 
 
-def _run_child(base, op: str, point: str) -> subprocess.CompletedProcess:
+def _run_child(base, op: str, point: str,
+               quiesce_s: float = 0.0) -> subprocess.CompletedProcess:
     harness = os.path.join(base, "harness.py")
     if not os.path.exists(harness):
         with open(harness, "w") as f:
             f.write(_HARNESS.format(repo=REPO))
     env = {**os.environ,
            "PYTHONPATH": REPO,
+           "SWEEP_QUIESCE_S": str(quiesce_s),
            failpoint.ENV_VAR: f"{point}=crash"}
     return subprocess.run(
         [sys.executable, harness, os.path.join(base, "plugin"),
@@ -198,3 +201,38 @@ def test_sweep_covers_every_crash_safe_failpoint():
         f"crash sweep out of sync with the failpoint registry: "
         f"missing={sorted(registry - swept)} stale={sorted(swept - registry)}")
     assert len(swept) >= 10   # acceptance floor (ISSUE 4)
+
+
+@pytest.mark.parametrize("point", (
+    "tpu.checkpoint.before_write",
+    "tpu.prepare.after_cdi_write",
+    "tpu.prepare.after_checkpoint",
+))
+def test_crash_with_quiesce_window_still_converges(tmp_path, point):
+    """ISSUE 6 regression: the group-commit writer with a NON-ZERO
+    quiesce window (the batching knob) must uphold the same crash
+    contract — a leader dying mid-window or mid-flush leaves either the
+    previous checkpoint or the complete batch, never a torn or
+    forgotten mutation."""
+    base = str(tmp_path)
+    _mk_state(base)
+    res = _run_child(base, "prepare", point, quiesce_s=0.05)
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, \
+        f"{point}: child did not crash at the failpoint\n{res.stderr}"
+    assert "OP_COMPLETED" not in res.stdout
+    _assert_converged(base, point)
+
+
+def test_prepare_returns_only_after_checkpoint_is_durable(tmp_path):
+    """The barrier-before-return contract: a crash at
+    tpu.prepare.after_checkpoint (which fires AFTER barrier()) must
+    find the claim already on disk — group commit defers the write, it
+    must never defer it past prepare's success report."""
+    base = str(tmp_path)
+    _mk_state(base)
+    res = _run_child(base, "prepare", "tpu.prepare.after_checkpoint")
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, res.stderr
+    cp = Checkpoint(os.path.join(base, "plugin", "checkpoint.json"))
+    assert cp.load() and UID in cp.prepared, \
+        "claim missing from the checkpoint after the post-barrier crash"
+    _assert_converged(base, "tpu.prepare.after_checkpoint")

@@ -230,3 +230,81 @@ def test_cpu_profile_endpoint():
         stack, count = ln.rsplit(" ", 1)
         assert int(count) > 0 and stack
     assert any("burn" in ln for ln in lines[1:]), body[:500]
+
+
+# -------------------------------------------------------------------------
+# ISSUE 6: lock-free counter accumulation
+# -------------------------------------------------------------------------
+
+
+def test_counter_exact_across_threads():
+    """Per-thread cells: concurrent inc() from many threads loses
+    nothing (each cell is single-writer; collect sums them all)."""
+    import threading
+
+    c = Counter("t_threads_total", "t", labels=("l",))
+
+    def worker():
+        for _ in range(20000):
+            c.inc("a")
+            c.inc("b", by=0.5)
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value("a") == 8 * 20000
+    assert c.value("b") == 8 * 20000 * 0.5
+
+
+def test_counter_survives_thread_death():
+    """A cell's counts outlive its thread: totals are monotonic across
+    scrapes even as worker threads churn."""
+    import threading
+
+    c = Counter("t_death_total", "t")
+
+    def worker():
+        c.inc()
+
+    for _ in range(5):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert c.value() == 5.0
+    c.inc()
+    assert c.value() == 6.0
+    assert "t_death_total 6.0" in c.collect()
+
+
+def test_counter_collect_while_incrementing_is_monotonic():
+    import threading
+
+    c = Counter("t_mono_total", "t")
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            c.inc()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    try:
+        last = 0.0
+        for _ in range(200):
+            now = c.value()
+            assert now >= last
+            last = now
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_gauge_keeps_last_writer_wins_semantics():
+    g = Gauge("t_gauge", "t", labels=("l",))
+    g.set(3.0, "x")
+    g.set(1.5, "x")
+    g.inc("x", by=0.5)
+    assert g.value("x") == 2.0
+    assert 't_gauge{l="x"} 2.0' in g.collect()

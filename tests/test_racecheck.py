@@ -781,3 +781,56 @@ def test_lockdep_observed_graph_matches_repo_registry():
         fp._load_mu, fp._mu = saved
         racecheck.uninstall()
         racecheck.reset()
+
+
+def test_group_commit_writer_lock_order_is_lockdep_clean(tmp_path):
+    """ISSUE 6: the checkpoint group-commit writer introduces
+    Checkpoint._commit_cv nested under DeviceState._mu (_mark_dirty runs
+    under the state lock; barrier() runs outside it).  Drive concurrent
+    prepares/unprepares through the REAL DeviceState under runtime
+    lockdep and assert (a) the declared DeviceState._mu ->
+    Checkpoint._commit_cv edge is what is actually observed and (b) the
+    full graph is clean against the registry."""
+    from tpu_dra.plugins.tpu.device_state import (
+        DeviceState,
+        DeviceStateConfig,
+    )
+    from tpu_dra.tpulib import FakeTpuLib
+    from tpu_dra.version import DRIVER_NAME
+
+    racecheck.install(lockdep=True)
+    try:
+        state = DeviceState(DeviceStateConfig(
+            tpulib=FakeTpuLib(),
+            plugin_dir=str(tmp_path / "plugin"),
+            cdi_root=str(tmp_path / "cdi")))
+
+        def claim(uid, dev):
+            return {
+                "metadata": {"uid": uid, "namespace": "d", "name": uid},
+                "status": {"allocation": {"devices": {"results": [
+                    {"request": "tpu", "driver": DRIVER_NAME,
+                     "pool": "n", "device": dev}]}}},
+            }
+
+        def worker(t):
+            for i in range(6):
+                uid = f"ld-{t}-{i}"
+                state.prepare(claim(uid, f"tpu-{t}"))
+                state.unprepare(uid)
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        edges = racecheck.lockdep_edges()
+        assert ("DeviceState._mu", "Checkpoint._commit_cv") in edges, \
+            sorted(edges)
+        # and never the reverse: barrier() stays off the state lock
+        assert ("Checkpoint._commit_cv", "DeviceState._mu") not in edges
+        racecheck.assert_lockdep_clean()
+    finally:
+        racecheck.uninstall()
+        racecheck.reset()

@@ -626,3 +626,106 @@ class TestBlackoutDegradation:
         # nothing to remediate anymore: the claim survives
         assert "uid-bl" in drv.state.prepared_claims()
         assert FakeKube.get(kube, RESOURCE_CLAIMS, "c-bl", "default")
+
+
+# -------------------------------------------------------------------------
+# ISSUE 6: zero-cost-when-idle fast paths (failpoint + breaker)
+# -------------------------------------------------------------------------
+
+
+class _CountingEnviron(dict):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.gets = 0
+
+    def get(self, key, default=None):
+        self.gets += 1
+        return super().get(key, default)
+
+
+def test_idle_hit_never_consults_environ(monkeypatch):
+    """The hot-path contract: after the first hit resolves the env plan
+    and the plan-file decision, an idle hit() is a single flag read —
+    zero os.environ lookups per call."""
+    failpoint.reset()
+    env = _CountingEnviron()
+    monkeypatch.setattr(failpoint.os, "environ", env)
+    failpoint.hit("warmup")            # consumes env + file decision
+    assert failpoint._hot is False
+    env.gets = 0
+    for _ in range(1000):
+        failpoint.hit("tpu.prepare.begin")
+    assert env.gets == 0
+    failpoint.reset()
+
+
+def test_armed_failpoints_still_fire_after_fast_path(monkeypatch):
+    """Arming AFTER the fast path has settled must still inject: the
+    activate path republishes the hot flag."""
+    failpoint.reset()
+    failpoint.hit("warmup")
+    assert failpoint._hot is False
+    failpoint.activate("p.x=error")
+    assert failpoint._hot is True
+    with pytest.raises(failpoint.FailpointError):
+        failpoint.hit("p.x")
+    failpoint.deactivate("p.x")
+    assert failpoint._hot is False     # back to the single-flag read
+    failpoint.reset()
+
+
+def test_plan_file_decision_cached_until_reset(monkeypatch, tmp_path):
+    """TPU_DRA_FAILPOINTS_FILE is resolved ONCE per load generation: a
+    file configured after the first hit is ignored until reset() starts
+    a new generation (the documented contract — hot paths must not pay
+    an environ lookup per call)."""
+    failpoint.reset()
+    failpoint.hit("warmup")            # decision: no file
+    plan = tmp_path / "plan.fp"
+    plan.write_text("p.late=error\n")
+    monkeypatch.setenv(failpoint.FILE_ENV_VAR, str(plan))
+    failpoint.hit("p.late")            # no injection: decision is cached
+    failpoint.reset()                  # new generation re-resolves
+    with pytest.raises(failpoint.FailpointError):
+        failpoint.hit("p.late")
+    assert failpoint._hot is True      # file keeps the slow path live
+    monkeypatch.delenv(failpoint.FILE_ENV_VAR)
+    failpoint.reset()
+
+
+def test_file_plan_reload_still_works_with_fast_path(monkeypatch,
+                                                     tmp_path):
+    """With a plan file configured the fast flag stays hot and mtime
+    reloads keep working (the chaos-driver live-flip contract)."""
+    plan = tmp_path / "plan.fp"
+    plan.write_text("# empty\n")
+    monkeypatch.setenv(failpoint.FILE_ENV_VAR, str(plan))
+    failpoint.reset()
+    failpoint.hit("p.live")            # loads: nothing armed
+    plan.write_text("p.live=error\n")
+    import os as _os
+    _os.utime(plan, (time.time() + 2, time.time() + 2))
+    with pytest.raises(failpoint.FailpointError):
+        failpoint.hit("p.live")
+    monkeypatch.delenv(failpoint.FILE_ENV_VAR)
+    failpoint.reset()
+
+
+def test_breaker_nominal_path_keeps_failure_semantics():
+    """The lock-free nominal fast path must not change the state
+    machine: consecutive-failure counting, reset-on-success, and
+    trip-at-threshold all behave exactly as before."""
+    b = CircuitBreaker(failure_threshold=5, open_duration=60.0)
+    assert b.state == "closed" and b.allow() and not b.is_open()
+    for _ in range(4):
+        b.failure()
+    b.success()                        # resets the consecutive count
+    for _ in range(4):
+        b.failure()
+    assert b.state == "closed"         # 4 < threshold after reset
+    b.failure()
+    assert b.is_open() and not b.allow()
+    b.success()                        # probe succeeded -> closed
+    assert b.state == "closed" and b.allow()
+    # nominal flag restored: steady-state reads are lock-free again
+    assert b._nominal is True

@@ -435,3 +435,82 @@ def test_debug_traces_serves_chrome_json_with_filter():
         server.shutdown()
         # keep the shared ring clean for other tests in this process
         DEFAULT_RING.clear()
+
+
+# -------------------------------------------------------------------------
+# ISSUE 6: unsampled spans are the one shared no-op span
+# -------------------------------------------------------------------------
+
+
+def test_unsampled_spans_are_the_shared_noop_instance():
+    from tpu_dra.trace.span import NOOP_SPAN
+
+    tracer, ring = make_tracer(ratio=0.0)
+    with tracer.start_span("a") as a:
+        with tracer.start_span("b") as b:
+            assert a is NOOP_SPAN and b is NOOP_SPAN
+            # recording is a no-op, never a crash, never shared state
+            a.set_attribute("k", "v")
+            a.add_event("e")
+            assert dict(a.attributes) == {} and list(a.events) == []
+    assert ring.spans() == []
+
+
+def test_noop_span_still_propagates_the_drop_decision():
+    """current_traceparent() inside a noop span carries sampled=0 so a
+    downstream binary inherits the drop instead of re-rolling a root."""
+    from tpu_dra.trace.span import current_traceparent
+
+    tracer, ring = make_tracer(ratio=0.0)
+    other, other_ring = make_tracer(ratio=1.0)
+    with tracer.start_span("root"):
+        tp = current_traceparent()
+        assert tp.endswith("-00")
+        with other.start_span("remote", parent=tp) as r:
+            assert r.context.sampled is False
+    assert other_ring.spans() == []
+
+
+def test_noop_span_does_not_stamp_klog_ids():
+    from tpu_dra.trace.span import current_ids
+
+    tracer, _ = make_tracer(ratio=0.0)
+    with tracer.start_span("a"):
+        assert current_ids() is None   # no constant ids on log lines
+    sampled, _ = make_tracer(ratio=1.0)
+    with sampled.start_span("a") as s:
+        assert current_ids() == (s.context.trace_id, s.context.span_id)
+
+
+def test_noop_scope_restores_context_on_exceptions():
+    tracer, ring = make_tracer(ratio=0.0)
+    with pytest.raises(RuntimeError):
+        with tracer.start_span("failing"):
+            raise RuntimeError("boom")
+    assert current_span() is None
+    assert ring.spans() == []          # dropped even on error
+
+
+def test_span_ids_remain_unique_and_well_formed():
+    """The PRNG id generator (urandom is a syscall per call — too slow
+    for the hot path) must still produce distinct, hex-valid ids."""
+    from tpu_dra.trace.span import new_span_id, new_trace_id
+
+    trace_ids = {new_trace_id() for _ in range(2000)}
+    span_ids = {new_span_id() for _ in range(2000)}
+    assert len(trace_ids) == 2000 and len(span_ids) == 2000
+    assert all(len(t) == 32 and int(t, 16) for t in trace_ids)
+    assert all(len(s) == 16 and int(s, 16) for s in span_ids)
+
+
+def test_noop_span_as_explicit_parent_inherits_the_drop():
+    """Regression (review): passing the shared noop span itself as
+    ``parent=`` must hand down its unsampled context — not fall through
+    the parent resolution and re-roll a fresh SAMPLED root, which would
+    export an orphan fragment of a trace every other process dropped."""
+    tracer, ring = make_tracer(ratio=0.0)
+    sampled, sampled_ring = make_tracer(ratio=1.0)
+    with tracer.start_span("outer") as outer:
+        with sampled.start_span("inner", parent=outer) as inner:
+            assert inner.context.sampled is False
+    assert ring.spans() == [] and sampled_ring.spans() == []

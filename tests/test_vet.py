@@ -37,7 +37,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
                    "string-constant-drift", "exception-hygiene",
                    "metric-hygiene", "retry-hygiene", "lock-order",
-                   "blocking-under-lock"}
+                   "blocking-under-lock", "hotpath"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,
@@ -1232,3 +1232,73 @@ def test_static_hot_spots_are_exercised_by_dynamic_detector():
                 f"tests/test_racecheck.py never runs it under "
                 f"racecheck.monitor — add a dynamic test or drop it "
                 f"from HOT_SPOTS")
+
+
+# -------------------------------------------------------------------------
+# hotpath (ISSUE 6): no per-iteration instrumentation in device loops
+# -------------------------------------------------------------------------
+
+_HOTPATH_BAD = """\
+from tpu_dra.resilience import failpoint
+from tpu_dra.trace import get_tracer, start_span
+
+
+def prepare(devices):
+    for dev in devices:
+        failpoint.hit("tpu.prepare.per_device")
+        with start_span("prepare.device"):
+            pass
+    i = 0
+    while i < 4:
+        with get_tracer().start_span("poll"):
+            i += 1
+"""
+
+_HOTPATH_CLEAN = """\
+from tpu_dra.resilience import failpoint
+from tpu_dra.trace import start_span
+
+
+def prepare(devices):
+    failpoint.hit("tpu.prepare.begin")
+    with start_span("prepare.select_devices"):
+        out = [d.name for d in devices]
+    for dev in devices:
+        out.append(dev)          # plain per-device work is fine
+    return out
+
+
+def batch(claims):
+    for claim in claims:
+        with start_span("plugin.unprepare"):  # vet: hotpath-ok — span per claim is the retry unit
+            pass
+"""
+
+
+def test_hotpath_flags_instrumentation_inside_loops(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/tpu/hp.py",
+                        _HOTPATH_BAD, checks=["hotpath"])
+    assert len(diags) == 3
+    kinds = sorted(d.message.split(" inside")[0] for d in diags)
+    assert kinds == ["failpoint.hit()", "span creation", "span creation"]
+
+
+def test_hotpath_clean_and_justified_patterns_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/tpu/hp2.py",
+                       _HOTPATH_CLEAN, checks=["hotpath"]) == []
+
+
+def test_hotpath_out_of_scope_and_tests_pass(tmp_path):
+    assert vet_snippet(tmp_path, "tpu_dra/controller/hp3.py",
+                       _HOTPATH_BAD, checks=["hotpath"]) == []
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/tpu/test_hp.py",
+                       _HOTPATH_BAD, checks=["hotpath"]) == []
+
+
+def test_hotpath_ignore_escape_is_ratchet_counted(tmp_path):
+    src = _HOTPATH_BAD.replace(
+        'failpoint.hit("tpu.prepare.per_device")',
+        'failpoint.hit("tpu.prepare.per_device")  # vet: ignore[hotpath]')
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/tpu/hp4.py", src,
+                        checks=["hotpath"])
+    assert len(diags) == 2   # the ignored line is suppressed

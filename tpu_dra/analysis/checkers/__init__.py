@@ -11,6 +11,7 @@ from tpu_dra.analysis.checkers import (  # noqa: F401
     constants,
     excepts,
     guardedby,
+    hotpath,
     jitpurity,
     lockorder,
     metrichygiene,

@@ -1,0 +1,90 @@
+"""hotpath: no per-iteration instrumentation inside device/claim loops.
+
+The zero-cost-when-idle work (ISSUE 6, docs/performance.md) made one
+``failpoint.hit()`` a single flag read and one unsampled span a shared
+no-op — but N of them inside a per-device inner loop multiplies whatever
+cost remains (and, when armed/sampled, multiplies the REAL cost) by the
+device count on every kube request.  Instrumentation belongs at phase
+granularity: one failpoint per transaction point, one span per phase,
+outside the loop over devices/claims.
+
+Flagged inside any ``for``/``while`` body in the node-local serving
+packages (plugins, kubeletplugin, cdi):
+
+- ``failpoint.hit(...)``
+- span creation: ``start_span(...)``, ``X.start_span(...)``,
+  ``get_tracer().start_span(...)``
+
+A loop that *means* to pay per-iteration instrumentation (e.g. a span
+per claim of a gRPC batch — claims are the unit the kubelet retries)
+carries a justification comment on the offending line::
+
+    with get_tracer().start_span(...):  # vet: hotpath-ok — span per claim
+
+The bare ``# vet: hotpath-ok`` token is the contract (the standard
+``# vet: ignore[hotpath]`` also works and is ratchet-counted).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_SCOPE = ("tpu_dra/plugins", "tpu_dra/kubeletplugin", "tpu_dra/cdi")
+_OK_TOKEN = "vet: hotpath-ok"
+
+
+def _instrumentation_kind(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "start_span":
+            return "span creation"
+        if fn.attr == "hit" and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "failpoint":
+            return "failpoint.hit()"
+    elif isinstance(fn, ast.Name) and fn.id == "start_span":
+        return "span creation"
+    return None
+
+
+def _loop_bodies(tree: ast.AST):
+    """Every (loop, node-in-its-body) pair; nested function/class defs
+    inside a loop body are still per-iteration work and stay included."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While)):
+            for field in ("body", "orelse"):
+                for stmt in getattr(node, field, []):
+                    yield from ast.walk(stmt)
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or not ctx.in_dir(*_SCOPE):
+        return []
+    diags: list[Diagnostic] = []
+    seen: set[int] = set()
+    for sub in _loop_bodies(ctx.tree):
+        if not isinstance(sub, ast.Call):
+            continue
+        kind = _instrumentation_kind(sub)
+        if kind is None or sub.lineno in seen:
+            continue
+        seen.add(sub.lineno)
+        if _OK_TOKEN in ctx.comment_on(sub.lineno):
+            continue
+        diags.append(ctx.diag(
+            sub, "hotpath",
+            f"{kind} inside a loop body: per-iteration instrumentation "
+            f"multiplies hot-path cost by the iteration count — hoist "
+            f"it to phase granularity, or justify with "
+            f"`# vet: hotpath-ok — <why per-iteration is the design>`"))
+    return diags
+
+
+register(Analyzer(
+    name="hotpath",
+    doc="no failpoint.hit()/span creation inside per-device or "
+        "per-claim loops without a `# vet: hotpath-ok` justification",
+    run=_run,
+    scope=_SCOPE,
+))

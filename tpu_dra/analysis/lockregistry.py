@@ -47,6 +47,12 @@ DECLARED_ORDERS: tuple[tuple[str, str, str], ...] = (
      "plugins/tpu/device_state.py: the crash/stall failpoints fire "
      "under the prepare/unprepare state lock by design (the sweep "
      "kills the process mid-critical-section)"),
+    ("DeviceState._mu", "Checkpoint._commit_cv",
+     "plugins/tpu/checkpoint.py group-commit writer: put/remove capture "
+     "the dirty snapshot (taking the commit condition) under the state "
+     "lock; barrier() is only ever called OUTSIDE the state lock — the "
+     "whole point of the coalescing — so the reverse nesting must never "
+     "appear"),
 )
 
 # locks whose thread model forbids acquiring ANYTHING while they are

@@ -147,7 +147,10 @@ class CDIHandler:
             ).to_dict(),
         }
         path = self.base_spec_path()
-        _atomic_write(path, json.dumps(spec, indent=2, sort_keys=True))
+        # regenerable: rewritten from device enumeration at every
+        # startup, so the base spec needs atomicity but not durability
+        _atomic_write(path, json.dumps(spec, indent=2, sort_keys=True),
+                      durable=False)
         return path
 
     # -- claim specs -------------------------------------------------------

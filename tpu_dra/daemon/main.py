@@ -104,7 +104,9 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
             else "",
         }
     path = os.path.join(settings_dir, "nodes_config.json")
-    atomic_write(path, json.dumps(data, indent=2))
+    # regenerable: rewritten on every membership update, so atomicity
+    # (no torn config for a concurrent reader) is all it needs
+    atomic_write(path, json.dumps(data, indent=2), durable=False)
     return path
 
 

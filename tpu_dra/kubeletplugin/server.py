@@ -85,8 +85,9 @@ class _DRAService:
 
     def node_prepare_resources(self, request, context):
         refs = [ClaimRef(c.namespace, c.uid, c.name) for c in request.claims]
-        klog.info("NodePrepareResources", level=6,
-                  claims=[r.uid for r in refs])
+        if klog.v(6):   # don't build the uid list just to drop it
+            klog.info("NodePrepareResources", level=6,
+                      claims=[r.uid for r in refs])
         response = dra_pb.NodePrepareResourcesResponse()
         claims, fetch_errors, cached = self.plugin.fetch_claims(refs)
         results = self.plugin.callbacks.prepare(claims) if claims else {}
@@ -112,8 +113,9 @@ class _DRAService:
 
     def node_unprepare_resources(self, request, context):
         refs = [ClaimRef(c.namespace, c.uid, c.name) for c in request.claims]
-        klog.info("NodeUnprepareResources", level=6,
-                  claims=[r.uid for r in refs])
+        if klog.v(6):
+            klog.info("NodeUnprepareResources", level=6,
+                      claims=[r.uid for r in refs])
         response = dra_pb.NodeUnprepareResourcesResponse()
         errors = self.plugin.callbacks.unprepare(refs)
         for ref in refs:

@@ -8,20 +8,29 @@ from contextlib import contextmanager
 
 from tpu_dra.util.metrics import DEFAULT_REGISTRY
 
+_METRICS = None
+
 
 def plugin_metrics():
-    return {
-        "prepare_seconds": DEFAULT_REGISTRY.histogram(
-            "tpu_dra_prepare_seconds",
-            "NodePrepareResources per-claim latency",
-            labels=("driver",)),
-        "prepares_total": DEFAULT_REGISTRY.counter(
-            "tpu_dra_prepares_total", "prepare attempts",
-            labels=("driver", "result")),
-        "unprepares_total": DEFAULT_REGISTRY.counter(
-            "tpu_dra_unprepares_total", "unprepare attempts",
-            labels=("driver", "result")),
-    }
+    # cached: observe_prepare sits on the per-claim hot path, and three
+    # registry lookups (each a lock hop) per prepare are pure overhead —
+    # the registry is idempotent so the first call's instances are THE
+    # instances
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = {
+            "prepare_seconds": DEFAULT_REGISTRY.histogram(
+                "tpu_dra_prepare_seconds",
+                "NodePrepareResources per-claim latency",
+                labels=("driver",)),
+            "prepares_total": DEFAULT_REGISTRY.counter(
+                "tpu_dra_prepares_total", "prepare attempts",
+                labels=("driver", "result")),
+            "unprepares_total": DEFAULT_REGISTRY.counter(
+                "tpu_dra_unprepares_total", "unprepare attempts",
+                labels=("driver", "result")),
+        }
+    return _METRICS
 
 
 @contextmanager

@@ -133,7 +133,7 @@ class SliceDriver:
 
             def attempt(obj: dict, _uid: str = uid) -> None:
                 from tpu_dra.plugins.metrics import observe_prepare
-                failpoint.hit("slice.prepare.attempt")
+                failpoint.hit("slice.prepare.attempt")  # vet: hotpath-ok — one hit per claim attempt: slice prepares are codependent and each claim is the fault-injection unit
                 with observe_prepare(SLICE_DRIVER_NAME), \
                         locked(self.flock_path,
                                timeout=self.cfg.flock_timeout):
@@ -170,7 +170,7 @@ class SliceDriver:
                 with observe_unprepare(SLICE_DRIVER_NAME), \
                         locked(self.flock_path,
                                timeout=self.cfg.flock_timeout):
-                    failpoint.hit("slice.unprepare.begin")
+                    failpoint.hit("slice.unprepare.begin")  # vet: hotpath-ok — per-claim transaction point: the claim is the kubelet retry unit, not an inner device
                     self.state.unprepare(ref.uid)
             except Exception as exc:  # noqa: BLE001 — reported per claim
                 errors[ref.uid] = f"error unpreparing {ref.uid}: {exc}"

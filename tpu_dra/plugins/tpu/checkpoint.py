@@ -9,11 +9,25 @@ library (tpu_dra/tpulib/native.py).
 A versioned envelope mirrors the reference's migration path
 (checkpoint_legacy.go:12-143): ``v1`` is current; unknown versions fail
 closed, and a ``migrations`` hook table supports future formats.
+
+Durability goes through a **group-commit writer** (docs/performance.md):
+mutations capture a serialized snapshot (:meth:`Checkpoint._mark_dirty`)
+and :meth:`Checkpoint.barrier` makes everything dirty-so-far durable with
+ONE ``atomic_write`` + fsync pair (content + parent dir), leader/follower
+style — the first barrier caller writes the LATEST snapshot, concurrent
+callers whose mutations it covers return without touching the disk.  The
+fsync pair is the dominant cost of the prepare hot path, so N concurrent
+prepares pay for one, not N.  ``put``/``remove`` default to
+``flush=True`` (mutate + barrier: exactly the old save-immediately
+semantics); ``DeviceState`` passes ``flush=False`` under its state lock
+and barriers after releasing it, which is what lets concurrent claims
+coalesce.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 from typing import Callable, Optional
 
 from tpu_dra.plugins.tpu import checkpoint_legacy
@@ -39,7 +53,7 @@ class CorruptCheckpoint(RuntimeError):
 class Checkpoint:
     VERSION = "v1"
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, quiesce_s: float = 0.0) -> None:
         self.path = path
         self.prepared: dict[str, PreparedClaim] = {}
         # version -> converter(old_payload) -> v1 payload; version-less
@@ -48,6 +62,19 @@ class Checkpoint:
         self.migrations: dict[str, Callable[[dict], dict]] = {
             checkpoint_legacy.LEGACY_VERSION: checkpoint_legacy.migrate_v0,
         }
+        # -- group-commit writer state, all guarded by _commit_cv ----------
+        # (lock order: DeviceState._mu -> Checkpoint._commit_cv, declared
+        # in analysis/lockregistry.py: _mark_dirty runs under the state
+        # lock; barrier() must be called OUTSIDE it or nothing coalesces)
+        self.quiesce_s = quiesce_s      # leader's extra coalescing window
+        self._commit_cv = threading.Condition()
+        self._dirty_seq = 0             # bumped per captured snapshot
+        self._flushed_seq = 0           # highest snapshot known durable
+        self._flushing = False          # a leader is writing right now
+        self._pending = ""              # serialized envelope of _dirty_seq
+        self.flushes = 0                # disk writes performed (observable
+        # coalescing: tests and bench_prepare assert flushes < mutations
+        # under concurrency)             # guarded by _commit_cv
 
     # -- persistence -------------------------------------------------------
     def _payload(self) -> dict:
@@ -57,13 +84,63 @@ class Checkpoint:
                                for uid, c in sorted(self.prepared.items())},
         }
 
-    def save(self) -> None:
+    def _mark_dirty(self) -> None:
+        """Capture the current in-memory state as the pending snapshot.
+        Must be called with the same exclusion that guarded the mutation
+        (DeviceState._mu, or single-threaded test use): the serialization
+        here is what makes the flush safe to run off the state lock."""
         payload = json.dumps(self._payload(), sort_keys=True)
-        envelope = {"checksum": native.crc32c(payload.encode()),
-                    "data": payload}
-        failpoint.hit("tpu.checkpoint.before_write")
-        atomic_write(self.path, json.dumps(envelope))
-        failpoint.hit("tpu.checkpoint.after_write")
+        envelope = json.dumps({"checksum": native.crc32c(payload.encode()),
+                               "data": payload})
+        with self._commit_cv:
+            self._pending = envelope
+            self._dirty_seq += 1
+
+    def barrier(self) -> None:
+        """Block until every mutation made before this call is durable.
+
+        Group commit: the first caller to find no flush in flight becomes
+        the leader and writes the LATEST pending snapshot (one
+        atomic_write + fsync pair covering every mutation captured so
+        far, its own included); callers whose target sequence that write
+        covers return without writing.  With ``quiesce_s > 0`` the leader
+        waits that long before capturing the snapshot, trading its own
+        latency for a wider batch.  A failed write propagates to the
+        caller that led it; followers retake leadership and retry their
+        own barrier."""
+        cv = self._commit_cv
+        with cv:
+            target = self._dirty_seq
+            while self._flushed_seq < target:
+                if self._flushing:
+                    cv.wait()
+                    continue
+                self._flushing = True
+                if self.quiesce_s > 0:
+                    cv.wait(self.quiesce_s)   # nobody notifies mid-flush:
+                    # this is a plain timed quiesce with the lock dropped
+                envelope, seq = self._pending, self._dirty_seq
+                cv.release()
+                try:
+                    # the two crash-safe points fire on the LEADER thread,
+                    # outside both the state lock and the commit lock —
+                    # before_write: previous checkpoint must survive;
+                    # after_write: the batch is durable
+                    failpoint.hit("tpu.checkpoint.before_write")  # vet: hotpath-ok — fires once per FLUSH (leadership), not per waiter; the flush is the crash-safe transaction point
+                    atomic_write(self.path, envelope)
+                    failpoint.hit("tpu.checkpoint.after_write")  # vet: hotpath-ok — see before_write: per-flush by definition
+                finally:
+                    cv.acquire()
+                    self._flushing = False
+                    cv.notify_all()
+                self._flushed_seq = max(self._flushed_seq, seq)
+                self.flushes += 1
+
+    def save(self) -> None:
+        """Serialize and durably write the current state (synchronous —
+        init/migration path; the hot path uses put/remove + barrier)."""
+        self._mark_dirty()
+        self.barrier()
 
     def load(self) -> bool:
         """Returns False when no checkpoint exists yet (first start —
@@ -126,15 +203,24 @@ class Checkpoint:
             self.save()
         return True
 
-    # -- claim ops (each saves immediately: crash-consistency point) -------
+    # -- claim ops ---------------------------------------------------------
+    # flush=True (default) is the old save-immediately contract: the call
+    # returns with the mutation durable.  flush=False captures the
+    # snapshot but defers the disk write to an explicit barrier() —
+    # DeviceState's hot path, where the barrier runs OUTSIDE the state
+    # lock so concurrent claims share one fsync pair.
     def get(self, claim_uid: str) -> Optional[PreparedClaim]:
         return self.prepared.get(claim_uid)
 
-    def put(self, claim: PreparedClaim) -> None:
+    def put(self, claim: PreparedClaim, flush: bool = True) -> None:
         self.prepared[claim.claim_uid] = claim
-        self.save()
+        self._mark_dirty()
+        if flush:
+            self.barrier()
 
-    def remove(self, claim_uid: str) -> None:
+    def remove(self, claim_uid: str, flush: bool = True) -> None:
         if claim_uid in self.prepared:
             del self.prepared[claim_uid]
-            self.save()
+            self._mark_dirty()
+            if flush:
+                self.barrier()

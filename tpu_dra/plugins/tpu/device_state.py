@@ -44,10 +44,13 @@ from tpu_dra.version import DRIVER_NAME
 # kills the driver at every crash_safe point below and asserts the next
 # start converges: checkpoint loads clean, orphaned CDI specs/slot
 # pools/heartbeat dirs are reconciled away, re-prepare is idempotent.
-# Every hit() below fires UNDER the state lock by design — a crash or
-# stall mid-critical-section is exactly the scenario the sweep models —
-# so each carries a per-line blocking-under-lock ignore (the registry
-# declares the matching DeviceState._mu -> failpoint._mu order)
+# Every hit() below EXCEPT the two after_checkpoint points fires UNDER
+# the state lock by design — a crash or stall mid-critical-section is
+# exactly the scenario the sweep models — so each carries a per-line
+# blocking-under-lock ignore (the registry declares the matching
+# DeviceState._mu -> failpoint._mu order).  after_checkpoint fires after
+# the group-commit barrier, which runs OFF the state lock so concurrent
+# claims coalesce their checkpoint fsyncs (docs/performance.md)
 _PREPARE_FPS = (
     failpoint.register(
         "tpu.prepare.begin",
@@ -130,6 +133,11 @@ class DeviceStateConfig:
     # duck-typed health veto (tpu_dra.health.HealthMonitor): is_serving
     # (uuid) + state_of(uuid); None disables the gate
     health: Optional[object] = None
+    # group-commit quiesce window (seconds): how long a checkpoint
+    # barrier leader waits for more claim mutations before flushing.
+    # 0 (default) flushes immediately — lowest single-claim latency;
+    # raise it only to widen batches under sustained concurrent load
+    checkpoint_quiesce_s: float = 0.0
 
 
 class DeviceState:
@@ -147,7 +155,8 @@ class DeviceState:
         self.cdi.create_standard_spec(
             [d.chip or d.core for d in self.allocatable.values()])
         self.mp_manager = MultiProcessManager(slots_root=cfg.plugin_dir)
-        self.checkpoint = Checkpoint(f"{cfg.plugin_dir}/checkpoint.json")
+        self.checkpoint = Checkpoint(f"{cfg.plugin_dir}/checkpoint.json",
+                                     quiesce_s=cfg.checkpoint_quiesce_s)
         if not self.checkpoint.load():
             self.checkpoint.save()  # create-if-missing, device_state.go:94-125
         # reconcile on-disk claim specs against the checkpoint: a crash
@@ -180,9 +189,16 @@ class DeviceState:
         ``claim`` is the full ResourceClaim object; its
         ``status.allocation.devices.results`` names the devices the scheduler
         allocated from this node's pool.
+
+        The checkpoint mutation happens under the state lock but its
+        durability does not: the group-commit ``barrier()`` runs after
+        the lock is released, so N claims preparing concurrently share
+        one checkpoint fsync pair instead of serializing N of them
+        behind ``_mu`` (docs/performance.md).
         """
+        uid = claim["metadata"]["uid"]
+        fresh = False
         with self._mu:
-            uid = claim["metadata"]["uid"]
             failpoint.hit("tpu.prepare.begin")  # vet: ignore[blocking-under-lock]
             existing = self.checkpoint.get(uid)
             if existing is not None:   # idempotent no-op, :139-146
@@ -195,39 +211,52 @@ class DeviceState:
                     _, per_device_edits = self._prepare_devices(claim)
                     self._stamp_trace_env(per_device_edits)
                     self.cdi.create_claim_spec(uid, per_device_edits)
-                return existing.devices
-            try:
-                # phase span: config mapping + device selection + health
-                # veto + sharing setup (nests under plugin.prepare)
-                with start_span("prepare.select_devices",
+                devices = existing.devices
+            else:
+                fresh = True
+                try:
+                    # phase span: config mapping + device selection +
+                    # health veto + sharing setup (nests under
+                    # plugin.prepare)
+                    with start_span("prepare.select_devices",
+                                    attributes={"claim": uid}):
+                        devices, per_device_edits = \
+                            self._prepare_devices(claim)
+                except Exception:
+                    # _group_edits may have created slot pools before a
+                    # later group/overlap check failed; without a
+                    # checkpoint entry unprepare would no-op, leaking
+                    # them until restart
+                    self.mp_manager.cleanup(uid)
+                    raise
+                failpoint.hit("tpu.prepare.after_select")  # vet: ignore[blocking-under-lock]
+                self._stamp_trace_env(per_device_edits)
+                with start_span("prepare.cdi_spec_write",
                                 attributes={"claim": uid}):
-                    devices, per_device_edits = self._prepare_devices(claim)
-            except Exception:
-                # _group_edits may have created slot pools before a later
-                # group/overlap check failed; without a checkpoint entry
-                # unprepare would no-op, leaking them until restart
-                self.mp_manager.cleanup(uid)
-                raise
-            failpoint.hit("tpu.prepare.after_select")  # vet: ignore[blocking-under-lock]
-            self._stamp_trace_env(per_device_edits)
-            with start_span("prepare.cdi_spec_write",
-                            attributes={"claim": uid}):
-                self.cdi.create_claim_spec(uid, per_device_edits)
-            failpoint.hit("tpu.prepare.after_cdi_write")  # vet: ignore[blocking-under-lock]
-            prepared = PreparedClaim(
-                claim_uid=uid,
-                namespace=claim["metadata"].get("namespace", ""),
-                name=claim["metadata"].get("name", ""),
-                devices=devices)
-            with start_span("prepare.checkpoint_write",
-                            attributes={"claim": uid}):
-                self.checkpoint.put(prepared)
-            failpoint.hit("tpu.prepare.after_checkpoint")  # vet: ignore[blocking-under-lock]
-            return devices
+                    self.cdi.create_claim_spec(uid, per_device_edits)
+                failpoint.hit("tpu.prepare.after_cdi_write")  # vet: ignore[blocking-under-lock]
+                prepared = PreparedClaim(
+                    claim_uid=uid,
+                    namespace=claim["metadata"].get("namespace", ""),
+                    name=claim["metadata"].get("name", ""),
+                    devices=devices)
+                self.checkpoint.put(prepared, flush=False)
+        # group commit, off the state lock: everything mutated above —
+        # and by any concurrent prepare/unprepare — becomes durable with
+        # one fsync pair before prepare reports success.  The idempotent
+        # path barriers too: a previously-failed flush must not let a
+        # retry succeed while the entry only exists in memory.
+        with start_span("prepare.checkpoint_write",
+                        attributes={"claim": uid}):
+            self.checkpoint.barrier()
+        if fresh:
+            failpoint.hit("tpu.prepare.after_checkpoint")
+        return devices
 
     def unprepare(self, claim_uid: str) -> None:
         """Unprepare by UID only — checkpoint state is authoritative so the
-        API server is never needed (device_state.go:172-207)."""
+        API server is never needed (device_state.go:172-207).  Like
+        prepare, the checkpoint barrier runs off the state lock."""
         with self._mu:
             failpoint.hit("tpu.unprepare.begin")  # vet: ignore[blocking-under-lock]
             # heartbeat dir cleanup happens even without a checkpoint
@@ -246,8 +275,9 @@ class DeviceState:
             failpoint.hit("tpu.unprepare.after_slot_cleanup")  # vet: ignore[blocking-under-lock]
             self.cdi.delete_claim_spec(claim_uid)
             failpoint.hit("tpu.unprepare.after_cdi_delete")  # vet: ignore[blocking-under-lock]
-            self.checkpoint.remove(claim_uid)
-            failpoint.hit("tpu.unprepare.after_checkpoint")  # vet: ignore[blocking-under-lock]
+            self.checkpoint.remove(claim_uid, flush=False)
+        self.checkpoint.barrier()
+        failpoint.hit("tpu.unprepare.after_checkpoint")
 
     def prepared_claims(self) -> dict[str, PreparedClaim]:
         with self._mu:

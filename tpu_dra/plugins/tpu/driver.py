@@ -37,6 +37,7 @@ from tpu_dra.kubeletplugin import (
     KubeletPluginServer,
     PrepareResult,
 )
+from tpu_dra.plugins.metrics import observe_prepare, observe_unprepare
 from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
 from tpu_dra.plugins.tpu.deviceinfo import chip_device, core_device
@@ -67,6 +68,8 @@ class TpuDriverConfig:
     health_pass_threshold: int = 2      # consecutive passes -> Recovered
     heartbeat_stale_after: float = 600.0
     remediation: str = REMEDIATION_EVENT
+    # checkpoint group-commit quiesce window (DeviceStateConfig passthrough)
+    checkpoint_quiesce_s: float = 0.0
 
 
 class TpuDriver:
@@ -105,7 +108,8 @@ class TpuDriver:
             cdi_root=cfg.cdi_root,
             driver_root=cfg.driver_root,
             enable_subslices=cfg.enable_subslices,
-            health=self.health))
+            health=self.health,
+            checkpoint_quiesce_s=cfg.checkpoint_quiesce_s))
         # remediations suppressed during an API blackout, replayed once
         # the breaker closes             # guarded by self._deferred_mu
         self._deferred_remediations: list[Transition] = []
@@ -344,7 +348,6 @@ class TpuDriver:
         return results
 
     def _node_prepare(self, claim: dict) -> PrepareResult:
-        from tpu_dra.plugins.metrics import observe_prepare
         meta = claim.get("metadata", {})
         # continue the trace the controller started: the claim carries
         # the reconcile's context in its traceparent annotation
@@ -363,11 +366,10 @@ class TpuDriver:
     def unprepare_resource_claims(self, refs: list[ClaimRef]
                                   ) -> dict[str, str]:
         """driver.go:108-153."""
-        from tpu_dra.plugins.metrics import observe_unprepare
         errors: dict[str, str] = {}
         for ref in refs:
             try:
-                with get_tracer().start_span(
+                with get_tracer().start_span(  # vet: hotpath-ok — one span per claim: the claim is the kubelet's retry/report unit, so per-claim is phase granularity here
                         "plugin.unprepare",
                         attributes={"claim": ref.uid,
                                     "node": self.cfg.node_name}), \

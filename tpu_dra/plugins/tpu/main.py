@@ -37,6 +37,12 @@ def plugin_flags() -> FlagGroup:
              "what to do with claims pinned to an Unhealthy chip: "
              "'event' (record Events only) or 'unprepare' (also "
              "unprepare node-side and delete the claim)", "event"),
+        Flag("checkpoint-quiesce-ms", "CHECKPOINT_QUIESCE_MS",
+             "group-commit quiesce window in ms: how long a checkpoint "
+             "barrier leader waits for more claim mutations before "
+             "flushing (0 = flush immediately; raise only for sustained "
+             "concurrent prepare load — docs/performance.md)",
+             0.0, float),
     ])
 
 
@@ -74,7 +80,8 @@ def main(argv=None) -> int:
         health_interval=args.health_interval,
         health_fail_threshold=args.health_fail_threshold,
         health_pass_threshold=args.health_pass_threshold,
-        remediation=args.health_remediation))
+        remediation=args.health_remediation,
+        checkpoint_quiesce_s=args.checkpoint_quiesce_ms / 1000.0))
     from tpu_dra.util.metrics import serve_from_flag
     # /healthz now aggregates the chip health monitor's verdict instead
     # of a static ok — a node with an Unhealthy chip reports 503

@@ -24,26 +24,42 @@ from tpu_dra.util.fsutil import atomic_write
 SHIM_CONTAINER_PATH = "/var/run/tpu-dra/shim"
 
 
+_src_cache: str = ""
+# shim dirs this process has already verified/written: every sharing
+# prepare calls write_shim_dir, and re-reading two files per prepare to
+# re-prove an identical shim is pure hot-path overhead.  A dir, once
+# written by this process, only changes if something ELSE tampers with
+# it — which the next plugin restart repairs, same as before the cache.
+_written: set[str] = set()
+
+
 def _shim_source() -> str:
-    src_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "_shim_sitecustomize.py")
-    with open(src_path, encoding="utf-8") as f:
-        return f.read()
+    global _src_cache
+    if not _src_cache:
+        src_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "_shim_sitecustomize.py")
+        with open(src_path, encoding="utf-8") as f:
+            _src_cache = f.read()
+    return _src_cache
 
 
 def write_shim_dir(plugin_dir: str) -> str:
-    """Write (idempotently) the shim dir under ``plugin_dir``; returns
-    the host path to mount.  Atomic write: a container must never see a
-    torn ``sitecustomize.py``."""
+    """Write (idempotently, once per process) the shim dir under
+    ``plugin_dir``; returns the host path to mount.  Atomic write: a
+    container must never see a torn ``sitecustomize.py``."""
     shim_dir = os.path.join(plugin_dir, "shim")
+    if shim_dir in _written:
+        return shim_dir
     os.makedirs(shim_dir, exist_ok=True)
     target = os.path.join(shim_dir, "sitecustomize.py")
     src = _shim_source()
     try:
         with open(target, encoding="utf-8") as f:
             if f.read() == src:
+                _written.add(shim_dir)
                 return shim_dir          # current already
     except OSError:
         pass
     atomic_write(target, src, durable=False)
+    _written.add(shim_dir)
     return shim_dir

@@ -64,6 +64,18 @@ class CircuitBreaker:
         self._failures = 0                  # guarded by self._mu
         self._opened_at = 0.0               # guarded by self._mu
         self._probing = False               # guarded by self._mu
+        # nominal fast path (docs/performance.md): True exactly while
+        # state is CLOSED with zero recorded failures — the steady state
+        # of every healthy binary.  allow()/success() read it unlocked
+        # and skip ALL bookkeeping (lock, counters, gauge writes) while
+        # it holds; any failure flips it False under the lock, after
+        # which the full accounting path runs until the circuit proves
+        # healthy again.  The one race — a success racing the FIRST
+        # failure may skip its consecutive-failure reset — costs at most
+        # one stale failure count, cleared by the next slow-path
+        # success; it can never mask an open circuit (failure() and the
+        # state machine always run locked).
+        self._nominal = True
         self._gauge = DEFAULT_REGISTRY.gauge(
             "tpu_dra_client_breaker_state",
             "kube client circuit breaker state (1 = current)",
@@ -76,6 +88,8 @@ class CircuitBreaker:
 
     @property
     def state(self) -> str:
+        if self._nominal:
+            return STATE_CLOSED   # nominal ⇒ CLOSED, no lock needed
         with self._mu:
             self._maybe_half_open_locked()
             return self._state
@@ -101,6 +115,8 @@ class CircuitBreaker:
 
     def allow(self) -> bool:
         """Admission check; half-open admits exactly one probe."""
+        if self._nominal:
+            return True   # steady state: no lock, no bookkeeping
         with self._mu:
             self._maybe_half_open_locked()
             if self._state == STATE_CLOSED:
@@ -111,16 +127,20 @@ class CircuitBreaker:
             return False
 
     def success(self) -> None:
+        if self._nominal:
+            return        # steady state: nothing to reset, no lock
         with self._mu:
             if self._state != STATE_CLOSED:
                 klog.info("circuit breaker closed", breaker=self.name)
+                self._publish(STATE_CLOSED)
             self._state = STATE_CLOSED
             self._failures = 0
             self._probing = False
-            self._publish(STATE_CLOSED)
+            self._nominal = True
 
     def failure(self) -> None:
         with self._mu:
+            self._nominal = False
             if self._state == STATE_HALF_OPEN:
                 self._trip_locked()
                 return
@@ -134,6 +154,7 @@ class CircuitBreaker:
         self._opened_at = time.monotonic()
         self._failures = 0
         self._probing = False
+        self._nominal = False
         self._publish(STATE_OPEN)
         klog.warning("circuit breaker OPEN", breaker=self.name,
                      reopen_after=self.open_duration)

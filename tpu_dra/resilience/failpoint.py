@@ -9,8 +9,10 @@ Code declares injection points at import time::
     ...
     failpoint.hit("tpu.prepare.after_cdi_write")
 
-``hit`` is a no-op (one dict lookup behind a fast-path flag) unless the
-point is activated.  Activation comes from the environment::
+``hit`` is a no-op — a single module-global flag read, no environment
+lookup, no lock (the gofail compiled-out analog, recovered at runtime;
+docs/performance.md) — unless a plan is armed or a live plan file is
+configured.  Activation comes from the environment::
 
     TPU_DRA_FAILPOINTS="tpu.prepare.after_cdi_write=crash;kube.request=2*error(Transient)"
 
@@ -92,6 +94,27 @@ _any_active = False
 _load_mu = threading.Lock()                 # serializes env/file loading
 _loaded_env = False                         # guarded by _load_mu
 _file_mtime: Optional[float] = None         # guarded by _load_mu
+# THE zero-cost-when-idle flag (docs/performance.md): hit() is a single
+# read of this module global — no os.environ lookup, no lock — whenever
+# it is False.  It is False exactly when all three of these hold: the
+# env plan was consumed, no live plan file is configured (the
+# TPU_DRA_FAILPOINTS_FILE decision is resolved ONCE, at first hit, and
+# again only after reset()), and no activation is armed.  Writers
+# recompute it under their lock; the fast-path read is deliberately
+# unlocked — the one race is a hit() racing a concurrent arm, where a
+# stale False can miss an activation installed microseconds earlier,
+# which is the same visibility contract _any_active always had.
+_hot = True
+_file_configured = False                    # guarded by _load_mu
+
+
+def _recompute_hot() -> None:
+    """Refresh the idle-path flag from its three inputs.  Callers hold
+    ``_mu`` or ``_load_mu`` (or both, in the declared _load_mu → _mu
+    order); the inputs are each guarded, the flag itself is a plain
+    publish."""
+    global _hot
+    _hot = bool(_active) or not _loaded_env or _file_configured
 
 _hits = DEFAULT_REGISTRY.counter(
     "tpu_dra_failpoint_hits_total",
@@ -173,6 +196,7 @@ def _install(acts: dict[str, _Activation], source: str) -> None:
         _active.clear()
         _active.update(acts)
         _any_active = bool(_active)
+        _recompute_hot()
     if acts:
         klog.warning("failpoints ARMED", source=source,
                      points=sorted(acts))
@@ -191,6 +215,7 @@ def deactivate(name: str) -> None:
         if act is not None and act.action == "stall":
             act.release_evt.set()
         _any_active = bool(_active)
+        _recompute_hot()
 
 
 def reset() -> None:
@@ -198,15 +223,18 @@ def reset() -> None:
     Lock order mirrors _maybe_load (_load_mu, then _mu) so a concurrent
     hit() can neither deadlock nor observe pre-reset load state and
     re-arm the plan this teardown just cleared."""
-    global _any_active, _loaded_env, _file_mtime
+    global _any_active, _loaded_env, _file_mtime, _file_configured
     with _load_mu:
         _loaded_env = False
         _file_mtime = None
+        _file_configured = False
         with _mu:
             for act in _active.values():
                 act.release_evt.set()
             _active.clear()
             _any_active = False
+            _recompute_hot()   # _loaded_env is False again => hot: the
+            # next hit() re-resolves env AND the plan-file decision
 
 
 def release(name: str) -> None:
@@ -227,12 +255,17 @@ def release_all() -> None:
 # -- env/file loading ------------------------------------------------------
 def _maybe_load() -> None:
     """Load the env var once, and re-read the failpoint file whenever its
-    mtime moves.  Called from hit(); cheap (one stat) when a file is
-    configured, free otherwise."""
-    global _loaded_env, _file_mtime
+    mtime moves.  Called from hit()'s slow path; one stat per call while
+    a plan file is configured, and never called again once _recompute_hot
+    observes "env consumed, no file, nothing armed"."""
+    global _loaded_env, _file_mtime, _file_configured
     with _load_mu:
         if not _loaded_env:
             _loaded_env = True
+            # resolve the plan-file decision exactly once per load
+            # generation (reset() starts a new one): a hot kube-request
+            # path must not pay an os.environ lookup per hit
+            _file_configured = bool(os.environ.get(FILE_ENV_VAR, ""))
             spec = os.environ.get(ENV_VAR, "")
             if spec:
                 try:
@@ -243,7 +276,9 @@ def _maybe_load() -> None:
                     # merely imported us
                     klog.error("ignoring malformed failpoint spec",
                                err=str(exc))
-        path = os.environ.get(FILE_ENV_VAR, "")
+            with _mu:
+                _recompute_hot()
+        path = os.environ.get(FILE_ENV_VAR, "") if _file_configured else ""
         if not path:
             return
         try:
@@ -286,16 +321,20 @@ def hit(name: str) -> None:
     The injected effect happens on the CALLING thread: ``error`` raises,
     ``crash`` never returns, ``sleep``/``stall`` block.
     """
-    # fast path: env already consumed and no live plan file configured —
-    # one dict lookup + two global reads, no lock (hit() sits on hot
-    # paths like every kube request)
-    if _loaded_env and not os.environ.get(FILE_ENV_VAR):
-        if not _any_active:
-            return
-    else:
+    # fast path: a single module-global read, no os.environ lookup, no
+    # lock (hit() sits on hot paths like every kube request and every
+    # prepare) — the gofail disarmed-no-op property, recovered at
+    # runtime.  _hot is False only when the env plan was consumed, no
+    # plan file is configured, and nothing is armed (_recompute_hot).
+    if not _hot:
+        return
+    # slow path: reload only when there is something to (re)load — env
+    # not yet consumed, or a live plan file to stat.  An env/programmatic
+    # arming with no file skips straight to the activation lookup.
+    if not _loaded_env or _file_configured:
         _maybe_load()
-        if not _any_active:
-            return
+    if not _any_active:
+        return
     with _mu:
         act = _active.get(name)
         if act is None:

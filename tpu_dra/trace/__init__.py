@@ -22,6 +22,8 @@ from tpu_dra.trace.propagation import (  # noqa: F401
     TRACEPARENT_ENV,
 )
 from tpu_dra.trace.span import (  # noqa: F401
+    NOOP_SPAN,
+    NoopSpan,
     Span,
     SpanContext,
     current_context,
@@ -41,6 +43,8 @@ from tpu_dra.trace.tracer import (  # noqa: F401
 __all__ = [
     "DEFAULT_RING",
     "JsonlExporter",
+    "NOOP_SPAN",
+    "NoopSpan",
     "RingBufferExporter",
     "Span",
     "SpanContext",

@@ -25,9 +25,11 @@ from __future__ import annotations
 
 import contextvars
 import os
+import random
 import threading
 import time
 from dataclasses import dataclass
+from types import MappingProxyType
 from typing import Any, Optional
 
 # traceparent: version "00" = exactly 4 dash-separated fields
@@ -40,12 +42,24 @@ def _is_hex(s: str) -> bool:
     return bool(s) and all(c in _HEX for c in s)
 
 
+# Trace/span ids need uniqueness, not cryptographic strength, and
+# ``os.urandom`` is a getrandom(2) syscall per call — measured ~8.5us on
+# the bench container, paid TWICE per sampled span.  A process-local
+# PRNG seeded from urandom once is ~20x cheaper; it is reseeded after
+# fork so a forked worker cannot replay the parent's id stream
+# (duplicate span ids would silently merge unrelated traces).
+_rng = random.Random(os.urandom(16))
+if hasattr(os, "register_at_fork"):   # pragma: no branch
+    os.register_at_fork(
+        after_in_child=lambda: _rng.seed(os.urandom(16)))
+
+
 def new_trace_id() -> str:
-    return os.urandom(16).hex()
+    return f"{_rng.getrandbits(128) or 1:032x}"
 
 
 def new_span_id() -> str:
-    return os.urandom(8).hex()
+    return f"{_rng.getrandbits(64) or 1:016x}"
 
 
 @dataclass(frozen=True)
@@ -144,6 +158,58 @@ class Span:
         }
 
 
+# -- the shared no-op span (zero-cost-when-idle invariant) -----------------
+# An unsampled trace must cost its spans nothing: no SpanContext/Span
+# allocation, no urandom span id, no clock reads, no attribute dict — a
+# prepare at sample ratio 0 pays one contextvar set/reset per span and
+# nothing else (docs/performance.md).  One immutable instance is shared
+# by every unsampled span in the process; recording methods are no-ops
+# and its context is a FIXED valid-but-unsampled SpanContext, so
+# propagation still stamps a ``...-00`` traceparent and every downstream
+# binary makes the same drop decision without re-rolling a root.
+NOOP_CONTEXT = SpanContext(trace_id="0" * 31 + "1",
+                           span_id="0" * 15 + "1", sampled=False)
+
+class NoopSpan:
+    """The do-nothing span standing in for every span of an unsampled
+    trace.  Immutable and shared — never export it, never mutate it."""
+
+    __slots__ = ()
+
+    name = ""
+    context = NOOP_CONTEXT
+    parent_id = ""
+    service = ""
+    thread = ""
+    start_time = 0.0
+    duration: Optional[float] = 0.0
+    status = "ok"
+    # immutable views: an accidental direct writer fails loudly instead
+    # of silently poisoning every unsampled span in the process
+    attributes = MappingProxyType({})
+    events: tuple = ()
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def record_exception(self, exc: BaseException) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+    def to_dict(self) -> dict[str, Any]:
+        # defensive: exporters must never be handed a noop span, but a
+        # caller that serializes current_span() should not crash
+        return {"name": "noop", "trace_id": NOOP_CONTEXT.trace_id,
+                "span_id": NOOP_CONTEXT.span_id, "sampled": False}
+
+
+NOOP_SPAN = NoopSpan()
+
 # the current span for this execution context: nested start_span calls
 # parent automatically; threads do NOT inherit it (workqueue captures
 # the enqueuer's context explicitly instead)
@@ -161,12 +227,19 @@ def current_context() -> Optional[SpanContext]:
 
 
 def current_traceparent() -> str:
-    """``traceparent`` of the current span, or "" outside any span."""
+    """``traceparent`` of the current span, or "" outside any span.
+    Inside an unsampled (noop) span this is the fixed unsampled context —
+    still stamped, so downstream processes inherit the drop decision."""
     ctx = current_context()
     return ctx.to_traceparent() if ctx is not None else ""
 
 
 def current_ids() -> Optional[tuple[str, str]]:
-    """(trace_id, span_id) of the current span — klog's hook."""
-    ctx = current_context()
-    return (ctx.trace_id, ctx.span_id) if ctx is not None else None
+    """(trace_id, span_id) of the current span — klog's hook.  None
+    inside a noop span: the shared unsampled ids would stamp every log
+    line of every unsampled request with one meaningless constant."""
+    span = _CURRENT.get()
+    if span is None or span is NOOP_SPAN:
+        return None
+    ctx = span.context
+    return (ctx.trace_id, ctx.span_id)

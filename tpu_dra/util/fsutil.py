@@ -10,17 +10,39 @@ def atomic_write(path: str, data: str, durable: bool = True) -> None:
 
     ``durable=True`` (default) fdatasyncs the file before the rename and
     fsyncs the parent directory after it, so both the content and the rename
-    itself have hit disk when the call returns — required for the
-    checkpoint, which is the prepare transaction's commit point.  Pass
-    ``durable=False`` for files that are merely *regenerable* state (e.g.
-    per-claim CDI specs, which idempotent prepare rewrites after a crash):
-    atomicity is kept, the syncs — the dominant cost of the prepare hot
-    path — are skipped.
+    itself have hit disk when the call returns.  Pass ``durable=False`` for
+    files that are merely *regenerable* state: atomicity is kept, the syncs
+    — the dominant cost of the prepare hot path — are skipped.
+
+    Which writes carry the crash-safety (``durable=True``) contract is a
+    closed list; a new caller must place itself on one side and say why:
+
+    - **durable** — ``plugins/tpu/checkpoint.py`` (the group-commit
+      writer's flush): the checkpoint is the prepare/unprepare
+      transaction's commit point and the ONLY file whose loss or
+      tearing cannot be re-derived after a power/kernel crash — every
+      crash-sweep convergence guarantee is anchored on it.
+    - **regenerable** (``durable=False``) — per-claim CDI specs
+      (idempotent prepare rewrites them from the checkpoint),
+      the node base CDI spec (rewritten from device enumeration at
+      every startup), multiprocess slot-pool ``max`` files and the
+      launcher shim dir (recreated by the next prepare; re-derived on
+      restart), and the slice daemon's ``nodes_config.json`` (rewritten
+      on every membership update).  For all of these a process crash
+      still leaves whole-file-or-nothing state thanks to the rename;
+      only cross-power-cycle freshness is ceded, and each has a
+      restart-time regeneration path.
     """
     tmp = f"{path}.tmp.{os.getpid()}"
     parent = os.path.dirname(path) or "."
-    os.makedirs(parent, exist_ok=True)
-    with open(tmp, "w") as f:
+    try:
+        f = open(tmp, "w")
+    except FileNotFoundError:
+        # first write into a missing directory only: the common case
+        # must not pay a makedirs stat per call on the hot path
+        os.makedirs(parent, exist_ok=True)
+        f = open(tmp, "w")
+    with f:
         f.write(data)
         if durable:
             f.flush()

@@ -34,37 +34,117 @@ def _escape_help(text: str) -> str:
     return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
+def _simple_exposition(name: str, help_: str, kind: str,
+                       labels: tuple[str, ...],
+                       items: list[tuple[tuple[str, ...], float]]) -> str:
+    """Text exposition for single-sample-per-series metrics (counter,
+    gauge) — ONE place owns the HELP/TYPE header and label escaping so a
+    format fix cannot drift between metric kinds (histograms render
+    their bucket/sum/count family themselves)."""
+    out = [f"# HELP {name} {_escape_help(help_)}",
+           f"# TYPE {name} {kind}"]
+    for lv, val in sorted(items):
+        lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                       for k, v in zip(labels, lv))
+        out.append(f"{name}{{{lbl}}} {val}" if lbl else f"{name} {val}")
+    return "\n".join(out)
+
+
 class Counter:
+    """Monotonic counter with a lock-free ``inc()``.
+
+    ``inc`` sits on hot paths (every kube request, every failpoint fire,
+    every prepare) so it must not acquire a lock per call: each thread
+    accumulates into its OWN cell dict — created once per (thread,
+    metric) under the lock, mutated only by its owner thread, which is
+    single-writer and therefore safe under the GIL — and ``collect``
+    sums across cells.  A scrape racing an in-flight ``inc`` can read
+    the pre-inc value (never a torn or double-counted one: each read is
+    one dict item), so totals stay monotonic across scrapes.  Cells of
+    exited threads are kept (strong refs in ``_cells``) — counts must
+    survive thread death; the cost is one small dict per distinct
+    incrementing thread, fine for this repo's long-lived pools."""
+
     KIND = "counter"
+
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
+        self.name, self.help, self.labels = name, help_, labels
+        self._cells: list[dict[tuple[str, ...], float]] = []  # guarded by _mu
+        self._tl = threading.local()
+        self._mu = threading.Lock()
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        try:
+            cell = self._tl.cell
+        except AttributeError:
+            cell = self._new_cell()
+        cell[label_values] = cell.get(label_values, 0.0) + by
+
+    def _new_cell(self) -> dict:
+        cell: dict[tuple[str, ...], float] = {}
+        with self._mu:
+            self._cells.append(cell)
+        self._tl.cell = cell
+        return cell
+
+    def _totals(self) -> dict[tuple[str, ...], float]:
+        with self._mu:
+            cells = list(self._cells)
+        totals: dict[tuple[str, ...], float] = {}
+        for cell in cells:
+            while True:
+                try:
+                    items = list(cell.items())
+                    break
+                except RuntimeError:
+                    # the owner thread inserted a NEW label set mid-
+                    # iteration (resize); re-snapshot — bounded by the
+                    # metric's label cardinality, not by inc volume
+                    continue
+            for lv, val in items:
+                totals[lv] = totals.get(lv, 0.0) + val
+        return totals
+
+    def value(self, *label_values: str) -> float:
+        """Current total for one label set (tests / introspection)."""
+        return self._totals().get(label_values, 0.0)
+
+    def collect(self) -> str:
+        return _simple_exposition(self.name, self.help, self.KIND,
+                                  self.labels,
+                                  list(self._totals().items()))
+
+
+class Gauge:
+    """Last-writer-wins gauge.  Unlike :class:`Counter` this keeps the
+    per-call lock: ``set`` is cross-thread last-writer-wins state (not
+    an accumulation), and no gauge sits on a hot path."""
+
+    KIND = "gauge"
 
     def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
         self.name, self.help, self.labels = name, help_, labels
         self._values: dict[tuple[str, ...], float] = {}
         self._mu = threading.Lock()
 
-    def inc(self, *label_values: str, by: float = 1.0) -> None:
-        with self._mu:
-            self._values[label_values] = self._values.get(label_values, 0.0) + by
-
-    def collect(self) -> str:
-        out = [f"# HELP {self.name} {_escape_help(self.help)}",
-               f"# TYPE {self.name} {self.KIND}"]
-        with self._mu:
-            items = sorted(self._values.items())
-        for lv, val in items:
-            lbl = ",".join(f'{k}="{_escape_label(v)}"'
-                           for k, v in zip(self.labels, lv))
-            out.append(f"{self.name}{{{lbl}}} {val}" if lbl
-                       else f"{self.name} {val}")
-        return "\n".join(out)
-
-
-class Gauge(Counter):
-    KIND = "gauge"
-
     def set(self, value: float, *label_values: str) -> None:
         with self._mu:
             self._values[label_values] = value
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        with self._mu:
+            self._values[label_values] = \
+                self._values.get(label_values, 0.0) + by
+
+    def value(self, *label_values: str) -> float:
+        with self._mu:
+            return self._values.get(label_values, 0.0)
+
+    def collect(self) -> str:
+        with self._mu:
+            items = list(self._values.items())
+        return _simple_exposition(self.name, self.help, self.KIND,
+                                  self.labels, items)
 
 
 class Histogram:
