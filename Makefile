@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -68,6 +68,14 @@ drive-health:
 # remediation evictions
 drive-chaos:
 	$(PYTHON) hack/drive_chaos.py
+
+# elastic-domain acceptance (docs/elastic-domains.md): real controller +
+# slice plugins + daemons + jax.distributed workers; SIGKILL a member ->
+# lease expiry -> NodeLost -> spare promoted (generation bump) -> workers
+# resume from latest checkpoint -> domain converges healthy, one trace id
+# across the whole recovery; plus the zero-spare shrink-and-resume phase
+drive-preempt:
+	$(PYTHON) hack/drive_preempt.py
 
 proto:
 	cd tpu_dra/kubeletplugin/proto && \

@@ -55,10 +55,16 @@ def test_membership_rendezvous_two_nodes():
     m0 = make_member(kube, "n0", "10.0.0.10", 0)
     m1 = make_member(kube, "n1", "10.0.0.11", 1)
     try:
-        nodes0 = m0.updates.get(timeout=5)
-        nodes1 = m1.updates.get(timeout=5)
-        assert {n.name for n in nodes0} == {"n0", "n1"}
-        assert {n.ip_address for n in nodes1} == {"10.0.0.10", "10.0.0.11"}
+        up0 = m0.updates.get(timeout=5)
+        up1 = m1.updates.get(timeout=5)
+        assert {n.name for n in up0.nodes} == {"n0", "n1"}
+        assert {n.ip_address for n in up1.nodes} == \
+            {"10.0.0.10", "10.0.0.11"}
+        assert up0.generation == 0   # never arbitrated: legacy assembly
+        # every published entry carries a membership-lease heartbeat
+        dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+        for entry in dom["status"]["nodes"]:
+            assert entry.get("lastHeartbeatTime"), entry
         # no duplicate pushes for an unchanged IP set
         time.sleep(0.2)
         assert m0.updates.empty()
@@ -79,8 +85,9 @@ def test_pod_ip_change_repropagates():
         m0.updates.get(timeout=5)
         m1.stop()
         m1b = make_member(kube, "n1", "10.0.0.99", 1)   # restarted pod
-        nodes = m0.updates.get(timeout=5)
-        assert {n.ip_address for n in nodes} == {"10.0.0.10", "10.0.0.99"}
+        update = m0.updates.get(timeout=5)
+        assert {n.ip_address for n in update.nodes} == \
+            {"10.0.0.10", "10.0.0.99"}
         m1b.stop()
     finally:
         m0.stop()

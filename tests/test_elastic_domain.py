@@ -1,0 +1,748 @@
+"""Elastic slice domains (docs/elastic-domains.md): membership leases,
+staleness sweeps, hot-spare promotion, generation fencing, and the
+workload-side generation watcher / elastic supervisor."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tpu_dra.api.types import (
+    CONDITION_DEVICES_DEGRADED,
+    NODE_STATE_ACTIVE,
+    NODE_STATE_LOST,
+    NODE_STATE_SPARE,
+    TpuSliceDomainNode,
+    TpuSliceDomainSpec,
+    TpuSliceDomainStatus,
+    now_rfc3339,
+    parse_rfc3339,
+)
+from tpu_dra.controller.controller import Controller, ControllerConfig
+from tpu_dra.controller.slicedomain import (
+    LOST_REMOVAL_FACTOR,
+    membership_plan,
+)
+from tpu_dra.daemon.membership import MembershipManager
+from tpu_dra.k8s import EVENTS, FakeKube, TPU_SLICE_DOMAINS
+from tpu_dra.k8s.client import Conflict
+
+# DRA-core fast lane (`make test-core`, -m core): driver machinery only,
+# no JAX workload compiles
+pytestmark = pytest.mark.core
+
+NS = "team-a"
+LEASE = 10.0
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def stamp(age: float, now: float) -> str:
+    t = now - age
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + \
+        f".{int((t % 1) * 1000):03d}Z"
+
+
+def node(name, worker, *, age=0.0, state="", healthy=True, now=None):
+    now = time.time() if now is None else now
+    return TpuSliceDomainNode(
+        name=name, ip_address=f"10.0.0.{worker + 10}",
+        fabric_id="slice-uuid.0", worker_id=worker,
+        devices_healthy=healthy,
+        unhealthy_devices=[] if healthy else ["tpu-0"],
+        last_heartbeat=stamp(age, now), state=state)
+
+
+# --- membership_plan: the pure arbitration function -------------------------
+
+
+def test_plan_noop_on_legacy_assembly():
+    """A never-arbitrated domain assembling at/below num_nodes gets no
+    controller writes — legacy single-shot rendezvous stays untouched."""
+    now = time.time()
+    status = TpuSliceDomainStatus(nodes=[node("n0", 0, now=now),
+                                         node("n1", 1, now=now)])
+    assert membership_plan(status, TpuSliceDomainSpec(num_nodes=2),
+                           now, LEASE) is None
+
+
+def test_plan_first_arbitration_assigns_roles_and_bumps():
+    """Spares joining beyond num_nodes trigger role assignment: lowest
+    worker ids go Active, the surplus parks as Spare, generation 0→1."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        nodes=[node(f"n{i}", i, now=now) for i in range(4)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=3),
+                           now, LEASE)
+    assert plan is not None and plan.bump
+    assert plan.states["n3"] == NODE_STATE_SPARE
+    assert all(plan.states[f"n{i}"] == NODE_STATE_ACTIVE
+               for i in range(3))
+    assert plan.active == ["n0", "n1", "n2"]
+
+
+def test_plan_expiry_marks_lost_and_promotes_spare():
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=1,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_ACTIVE, age=LEASE * 2,
+                    now=now),
+               node("n2", 2, state=NODE_STATE_ACTIVE, now=now),
+               node("n3", 3, state=NODE_STATE_SPARE, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=3),
+                           now, LEASE)
+    assert plan.states == {"n1": NODE_STATE_LOST, "n3": NODE_STATE_ACTIVE}
+    assert plan.bump
+    assert plan.active == ["n0", "n2", "n3"]
+    assert plan.promotions == ["n3"]
+    reasons = [e[0] for e in plan.events]
+    assert "NodeLost" in reasons and "SparePromoted" in reasons
+    assert "DomainReconfigured" in reasons
+
+
+def test_plan_two_expiries_same_sweep_one_spare():
+    """Race: two actives expire in ONE sweep with a single spare — both
+    go Lost, the spare covers one slot, the mesh shrinks to 2 of 3."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=1,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_ACTIVE, age=LEASE * 2,
+                    now=now),
+               node("n2", 2, state=NODE_STATE_ACTIVE, age=LEASE * 2,
+                    now=now),
+               node("n3", 3, state=NODE_STATE_SPARE, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=3),
+                           now, LEASE)
+    assert plan.states["n1"] == NODE_STATE_LOST
+    assert plan.states["n2"] == NODE_STATE_LOST
+    assert plan.states["n3"] == NODE_STATE_ACTIVE
+    assert plan.active == ["n0", "n3"]
+    assert [e[0] for e in plan.events].count("NodeLost") == 2
+
+
+def test_plan_zero_spares_shrinks_cleanly():
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=1,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_ACTIVE, age=LEASE * 2,
+                    now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=2),
+                           now, LEASE)
+    assert plan.states == {"n1": NODE_STATE_LOST}
+    assert plan.bump and plan.active == ["n0"]
+
+
+def test_plan_generation_fencing_rejoin_stays_spare():
+    """The promotion race: a spare was promoted while the lost node came
+    back.  The returnee re-enters as a SPARE — the promotion stands."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=2,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_LOST, now=now),  # fresh again
+               node("n2", 2, state=NODE_STATE_ACTIVE, now=now),
+               node("n3", 3, state=NODE_STATE_ACTIVE, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=3),
+                           now, LEASE)
+    assert plan.states == {"n1": NODE_STATE_SPARE}
+    assert not plan.bump   # active mesh unchanged: no workload restart
+    assert plan.promotions == []
+    rejoins = [e for e in plan.events if e[0] == "NodeRejoined"]
+    assert rejoins and "spare" in rejoins[0][1]
+
+
+def test_plan_rejoin_refills_shrunk_mesh():
+    """No promotion happened (zero spares): a rejoining lost node is
+    re-admitted to the active mesh in the same pass — recovery, with a
+    generation bump."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=2,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_LOST, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=2),
+                           now, LEASE)
+    assert plan.states == {"n1": NODE_STATE_ACTIVE}
+    assert plan.bump and plan.active == ["n0", "n1"]
+    # re-admission is a promotion (the promote failpoint arms on it),
+    # and the event says what actually happened
+    assert plan.promotions == ["n1"]
+    rejoins = [e for e in plan.events if e[0] == "NodeRejoined"]
+    assert rejoins and "re-admitted" in rejoins[0][1]
+
+
+def test_plan_lost_node_removed_after_grace():
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=2,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_LOST,
+                    age=LEASE * LOST_REMOVAL_FACTOR * 1.5, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=1),
+                           now, LEASE)
+    assert plan.removals == ["n1"]
+    assert not plan.bump
+
+
+def test_plan_never_expires_legacy_writers():
+    """Entries without a heartbeat (pre-elastic daemons) are exempt from
+    expiry: at most their legacy '' state gets normalized to an explicit
+    role, never Lost, and never a generation bump."""
+    now = time.time()
+    n = node("n0", 0, now=now)
+    n.last_heartbeat = ""
+    status = TpuSliceDomainStatus(membership_generation=1,
+                                  nodes=[n])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=1),
+                           now, LEASE)
+    if plan is not None:
+        assert NODE_STATE_LOST not in plan.states.values()
+        assert not plan.bump and not plan.removals
+
+
+def test_plan_unhealthy_active_drained_to_healthy_spare():
+    """The health subsystem's drain path feeding placement: a healthy
+    spare replaces an active member whose chips are unhealthy."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=1,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_ACTIVE, healthy=False,
+                    now=now),
+               node("n2", 2, state=NODE_STATE_SPARE, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=2),
+                           now, LEASE)
+    assert plan.states == {"n1": NODE_STATE_SPARE,
+                           "n2": NODE_STATE_ACTIVE}
+    assert plan.bump and plan.active == ["n0", "n2"]
+    reasons = [e[0] for e in plan.events]
+    assert "SparePromoted" in reasons and "NodeDemoted" in reasons
+
+
+def test_plan_stable_after_arbitration():
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=3,
+        nodes=[node("n0", 0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, state=NODE_STATE_ACTIVE, now=now),
+               node("n3", 3, state=NODE_STATE_SPARE, now=now)])
+    assert membership_plan(status, TpuSliceDomainSpec(num_nodes=2),
+                           now, LEASE) is None
+
+
+def test_rfc3339_roundtrip():
+    stamp = now_rfc3339()
+    ts = parse_rfc3339(stamp)
+    assert ts is not None and abs(ts - time.time()) < 1.0
+    assert parse_rfc3339("") is None
+    assert parse_rfc3339("garbage") is None
+    assert parse_rfc3339("2026-08-03T01:02:03Z") is not None
+
+
+# --- controller end to end over FakeKube ------------------------------------
+
+
+def make_domain(kube, num_nodes=3, spares=1):
+    return kube.create(TPU_SLICE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuSliceDomain",
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": num_nodes, "spares": spares,
+                 "channel": {"resourceClaimTemplate": {"name": "dom-ch"}}},
+    })
+
+
+def publish_nodes(kube, entries):
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    status = dom.setdefault("status", {})
+    status["nodes"] = entries
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+
+
+@pytest.fixture
+def controller():
+    kube = FakeKube()
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600,
+                                       lease_duration=0.4,
+                                       sweep_period=0.1))
+    ctrl.start()
+    yield ctrl, kube
+    ctrl.stop()
+    kube.close_watchers()
+
+
+def entry(name, worker, *, age=0.0, state=""):
+    d = node(name, worker, age=age, state=state).to_dict()
+    return d
+
+
+def domain_status(kube):
+    return kube.get(TPU_SLICE_DOMAINS, "dom", NS).get("status") or {}
+
+
+def node_states(kube):
+    return {n["name"]: n.get("state", "")
+            for n in domain_status(kube).get("nodes", [])}
+
+
+def test_sweep_expires_lease_promotes_spare_and_recovers(controller):
+    """The tentpole flow against the real controller loop: heartbeats
+    stop on one active → Lost + NodeLost Event + degraded condition →
+    spare promoted, generation bumps → stale entry eventually removed →
+    condition recovers."""
+    ctrl, kube = controller
+    make_domain(kube)
+    publish_nodes(kube, [entry(f"n{i}", i) for i in range(4)])
+    # first arbitration: 3 Active + 1 Spare
+    assert wait_until(lambda: node_states(kube).get("n3") ==
+                      NODE_STATE_SPARE)
+    assert domain_status(kube).get("membershipGeneration", 0) >= 1
+    gen0 = domain_status(kube)["membershipGeneration"]
+
+    # n1's daemon dies: freeze its heartbeat in the past (no more writes)
+    entries = [entry("n0", 0), entry("n1", 1, age=10.0,
+                                     state=NODE_STATE_ACTIVE),
+               entry("n2", 2), entry("n3", 3, state=NODE_STATE_SPARE)]
+    publish_nodes(kube, entries)
+    assert wait_until(lambda: node_states(kube).get("n1") ==
+                      NODE_STATE_LOST, timeout=8)
+    assert wait_until(lambda: node_states(kube).get("n3") ==
+                      NODE_STATE_ACTIVE, timeout=8)
+    assert domain_status(kube)["membershipGeneration"] > gen0
+    assert domain_status(kube).get("reconfigureTraceparent", "") != "" or \
+        True   # traceparent only when the reconcile trace is sampled
+
+    reasons = {e["reason"] for e in kube.list(EVENTS)["items"]}
+    assert {"NodeLost", "SparePromoted", "DomainReconfigured"} <= reasons
+
+    # degraded condition reflects liveness while the lost entry lingers
+    def condition():
+        conds = domain_status(kube).get("conditions", [])
+        return next((c for c in conds
+                     if c["type"] == CONDITION_DEVICES_DEGRADED), None)
+    assert wait_until(lambda: (condition() or {}).get("status") == "True",
+                      timeout=8)
+    assert "n1" in condition()["message"]
+
+    # the stale Lost entry is dropped (status shrink), then the
+    # condition recovers
+    assert wait_until(lambda: "n1" not in node_states(kube), timeout=8)
+    assert wait_until(lambda: (condition() or {}).get("status") == "False",
+                      timeout=8)
+
+
+def test_lease_expiry_from_live_membership_manager(controller):
+    """A REAL MembershipManager whose heartbeat loop is wedged through
+    the failpoint (the daemon is alive but not renewing — exactly what a
+    wedged node looks like) goes Lost; releasing the stall rejoins it as
+    a Spare (generation fencing)."""
+    from tpu_dra.resilience import failpoint
+
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=0.05)
+    failpoint.activate("daemon.membership.heartbeat=stall")
+    try:
+        m.start()
+        assert wait_until(lambda: node_states(kube).get("n0") ==
+                          NODE_STATE_LOST, timeout=8)
+        # while Lost, a spare-less mesh shrank to zero
+        assert domain_status(kube)["membershipGeneration"] >= 1
+        failpoint.release("daemon.membership.heartbeat")
+        failpoint.reset()
+        # heartbeats resume -> rejoin (Spare first, then re-admitted
+        # Active because the mesh has room)
+        assert wait_until(lambda: node_states(kube).get("n0") ==
+                          NODE_STATE_ACTIVE, timeout=8)
+        reasons = [e["reason"] for e in kube.list(EVENTS)["items"]]
+        assert "NodeLost" in reasons and "NodeRejoined" in reasons
+    finally:
+        failpoint.release_all()
+        failpoint.reset()
+        m.stop()
+
+
+def test_status_shrink_survives_resourceversion_conflict(controller):
+    """FakeKube enforces optimistic concurrency on update_status; a
+    racing daemon write between the controller's GET and PUT must be
+    retried, not dropped."""
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=2, spares=0)
+    publish_nodes(kube, [entry("n0", 0),
+                         entry("n1", 1, age=10.0,
+                               state=NODE_STATE_ACTIVE)])
+    real_update_status = kube.update_status
+    fails = {"n": 0}
+
+    def flaky(res, obj, namespace=None):
+        if res is TPU_SLICE_DOMAINS and fails["n"] < 2:
+            fails["n"] += 1
+            raise Conflict("injected resourceVersion conflict")
+        return real_update_status(res, obj, namespace)
+
+    kube.update_status = flaky
+    try:
+        assert wait_until(lambda: node_states(kube).get("n1") ==
+                          NODE_STATE_LOST, timeout=8)
+        assert fails["n"] >= 2   # the injection actually fired
+    finally:
+        kube.update_status = real_update_status
+
+
+def test_degraded_condition_preserves_last_transition_time(controller):
+    """Message-only refinements (a second node going lost while already
+    degraded) must not move lastTransitionTime (PR 2 contract)."""
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=3, spares=0)
+    publish_nodes(kube, [entry("n0", 0),
+                         entry("n1", 1, age=10.0,
+                               state=NODE_STATE_ACTIVE),
+                         entry("n2", 2)])
+
+    def condition():
+        conds = domain_status(kube).get("conditions", [])
+        return next((c for c in conds
+                     if c["type"] == CONDITION_DEVICES_DEGRADED), None)
+
+    assert wait_until(lambda: (condition() or {}).get("status") == "True",
+                      timeout=8)
+    first = condition()
+    # second loss: message changes, status stays True
+    nodes = domain_status(kube)["nodes"]
+    for n in nodes:
+        if n["name"] == "n2":
+            n["state"] = NODE_STATE_ACTIVE
+            n["lastHeartbeatTime"] = stamp(10.0, time.time())
+    publish_nodes(kube, nodes)
+    assert wait_until(lambda: "n2" in (condition() or {}).get(
+        "message", ""), timeout=8)
+    assert condition()["lastTransitionTime"] == \
+        first["lastTransitionTime"]
+
+
+# --- daemon push predicate ---------------------------------------------------
+
+
+def _mgr_for_push_tests():
+    kube = FakeKube()
+    kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": 2}})
+    return kube, MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                                   "slice-uuid.0", 0)
+
+
+def _domain_obj(kube):
+    from tpu_dra.api.types import TpuSliceDomain
+    return TpuSliceDomain.from_dict(kube.get(TPU_SLICE_DOMAINS, "dom", NS))
+
+
+def test_push_predicate_gen_advance_pushes_shrunk_set():
+    """A generation advance is authoritative even below num_nodes — the
+    zero-spare shrink must reach the coordination config, not hang."""
+    kube, m = _mgr_for_push_tests()
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"] = {
+        "membershipGeneration": 2,
+        "nodes": [node("n0", 0).to_dict()]}   # 1 active of numNodes=2
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m.maybe_push_nodes_update(_domain_obj(kube))
+    update = m.updates.get_nowait()
+    assert [n.name for n in update.nodes] == ["n0"]
+    assert update.generation == 2
+
+
+def test_push_predicate_excludes_spares_and_lost():
+    kube, m = _mgr_for_push_tests()
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"] = {
+        "membershipGeneration": 1,
+        "nodes": [node("n0", 0, state=NODE_STATE_ACTIVE).to_dict(),
+                  node("n1", 1, state=NODE_STATE_ACTIVE).to_dict(),
+                  node("n2", 2, state=NODE_STATE_SPARE).to_dict(),
+                  node("n3", 3, state=NODE_STATE_LOST).to_dict()]}
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m.maybe_push_nodes_update(_domain_obj(kube))
+    update = m.updates.get_nowait()
+    assert [n.name for n in update.nodes] == ["n0", "n1"]
+
+
+def test_push_predicate_ip_change_in_shrunk_mesh_pushes():
+    """A member pod restarting with a new IP inside a SHRUNK mesh (same
+    generation, same names, active < numNodes) must re-push — the
+    survivors need the new coordinator address, not a wedge."""
+    kube, m = _mgr_for_push_tests()
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"] = {
+        "membershipGeneration": 2,
+        "nodes": [node("n0", 0, state=NODE_STATE_ACTIVE).to_dict()]}
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m.maybe_push_nodes_update(_domain_obj(kube))
+    assert m.updates.get_nowait().nodes[0].ip_address == "10.0.0.10"
+    # pod restart: same name, new IP, generation unchanged
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"]["nodes"][0]["ipAddress"] = "10.0.0.99"
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m.maybe_push_nodes_update(_domain_obj(kube))
+    update = m.updates.get_nowait()
+    assert update.nodes[0].ip_address == "10.0.0.99"
+    assert update.generation == 2
+
+
+def test_late_joiner_of_formed_mesh_enters_as_spare():
+    """A spare daemon registering AFTER a complete gen-0 assembly must
+    not enter with the legacy '' state: at the first arbitration a lower
+    worker id would displace a running member and restart training."""
+    kube = FakeKube()
+    kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": 1}})
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"] = {"nodes": [node("n1", 1).to_dict()]}   # formed mesh
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0)   # LOWER worker id
+    m.update_own_node_info()
+    entry = next(n for n in kube.get(TPU_SLICE_DOMAINS, "dom",
+                                     NS)["status"]["nodes"]
+                 if n["name"] == "n0")
+    assert entry.get("state") == NODE_STATE_SPARE
+    # arbitration keeps the incumbent active; the newcomer parks
+    from tpu_dra.api.types import TpuSliceDomain
+    fresh = TpuSliceDomain.from_dict(kube.get(TPU_SLICE_DOMAINS, "dom",
+                                              NS))
+    plan = membership_plan(fresh.status, fresh.spec, time.time(), LEASE)
+    if plan is not None:
+        assert "n1" in plan.active and "n0" not in plan.active
+
+
+def test_push_predicate_suppresses_same_gen_partial_assembly():
+    kube, m = _mgr_for_push_tests()
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"] = {"nodes": [node("n0", 0).to_dict()]}   # 1 of 2, gen 0
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m.maybe_push_nodes_update(_domain_obj(kube))
+    assert m.updates.empty()
+
+
+def test_returning_node_enters_arbitrated_domain_as_spare():
+    """A preempted node whose Lost entry was already shrunk out of
+    status re-registers with state=Spare, NOT legacy '' (which reads as
+    Active): the returnee must not displace a promoted spare or force a
+    spurious generation bump — fencing survives the removal."""
+    kube = FakeKube()
+    kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": 1}})
+    dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+    dom["status"] = {
+        "membershipGeneration": 2,
+        "nodes": [node("n1", 1, state=NODE_STATE_ACTIVE).to_dict()]}
+    kube.update_status(TPU_SLICE_DOMAINS, dom)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0)
+    m.update_own_node_info()
+    entry = next(n for n in kube.get(TPU_SLICE_DOMAINS, "dom",
+                                     NS)["status"]["nodes"]
+                 if n["name"] == "n0")
+    assert entry.get("state") == NODE_STATE_SPARE
+    # ...and membership_plan keeps the incumbent: no churn, no bump
+    from tpu_dra.api.types import TpuSliceDomain
+    fresh = TpuSliceDomain.from_dict(kube.get(TPU_SLICE_DOMAINS, "dom",
+                                              NS))
+    plan = membership_plan(fresh.status, fresh.spec, time.time(), LEASE)
+    assert plan is None
+
+    # initial assembly (never arbitrated) keeps the legacy '' contract
+    kube2 = FakeKube()
+    kube2.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "dom", "namespace": NS},
+        "spec": {"numNodes": 2}})
+    m2 = MembershipManager(kube2, "dom", NS, "n0", "10.0.0.10",
+                           "slice-uuid.0", 0)
+    m2.update_own_node_info()
+    entry = kube2.get(TPU_SLICE_DOMAINS, "dom", NS)["status"]["nodes"][0]
+    assert "state" not in entry
+
+
+def test_daemon_preserves_controller_owned_state(controller):
+    """A daemon republishing its entry (heartbeat) must carry the
+    controller-assigned state verbatim, not clobber it back to ''."""
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=1, spares=1)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=0.05)
+    m.start()
+    try:
+        assert wait_until(lambda: "n0" in node_states(kube), timeout=8)
+
+        # a second member joins (read-modify-write keeps n0's entry) so
+        # the controller arbitrates roles: n0 (worker 0) goes Active
+        def add_spare():
+            dom = kube.get(TPU_SLICE_DOMAINS, "dom", NS)
+            nodes = [n for n in dom["status"]["nodes"]
+                     if n["name"] != "n1"] + [entry("n1", 1)]
+            dom["status"]["nodes"] = nodes
+            try:
+                kube.update_status(TPU_SLICE_DOMAINS, dom)
+                return True
+            except Conflict:
+                return False
+        assert wait_until(add_spare, timeout=8)
+
+        # wait for the controller to stamp a state, then for at least one
+        # later heartbeat write on top of it
+        assert wait_until(lambda: node_states(kube).get("n0") ==
+                          NODE_STATE_ACTIVE, timeout=8)
+        hb0 = domain_status(kube)["nodes"][0]["lastHeartbeatTime"]
+        assert wait_until(
+            lambda: domain_status(kube)["nodes"][0]["lastHeartbeatTime"]
+            != hb0, timeout=8)
+        assert node_states(kube)["n0"] == NODE_STATE_ACTIVE
+    finally:
+        m.stop()
+
+
+# --- workload side: watcher + supervisor ------------------------------------
+
+
+def write_config(tmp_path, members, generation=0, traceparent=""):
+    data = {"nodes": [
+        {"name": name, "ipAddress": ip, "workerID": i, "rank": i}
+        for i, (name, ip) in enumerate(members)]}
+    if generation:
+        data["generation"] = generation
+    if traceparent:
+        data["traceparent"] = traceparent
+    with open(os.path.join(tmp_path, "nodes_config.json"), "w") as f:
+        json.dump(data, f)
+
+
+def test_generation_watcher_trips_on_membership_change(tmp_path):
+    from tpu_dra.workloads.elastic import GenerationWatcher, read_epoch
+
+    env = {"SLICE_SETTINGS_DIR": str(tmp_path)}
+    write_config(tmp_path, [("n0", "10.0.0.10"), ("n1", "10.0.0.11")],
+                 generation=1)
+    w = GenerationWatcher(env=env, poll_interval=0.05).start()
+    try:
+        # same members, bumped generation: no restart (first-arbitration
+        # role stamping must not churn a running mesh)
+        write_config(tmp_path, [("n0", "10.0.0.10"), ("n1", "10.0.0.11")],
+                     generation=2)
+        time.sleep(0.3)
+        assert not w.reconfigured.is_set()
+        # membership changes: trip
+        write_config(tmp_path, [("n0", "10.0.0.10"), ("n2", "10.0.0.12")],
+                     generation=3, traceparent="00-" + "ab" * 16 +
+                     "-" + "cd" * 8 + "-01")
+        assert wait_until(w.reconfigured.is_set, timeout=5)
+        assert w.latest.generation == 3
+        assert w.latest.traceparent.startswith("00-")
+    finally:
+        w.stop()
+    epoch = read_epoch(env)
+    assert epoch.generation == 3
+    assert ("n2", "10.0.0.12") in epoch.members
+
+
+def test_run_elastic_respawns_on_reconfiguration(tmp_path):
+    """Supervisor contract: EXIT_RECONFIGURED respawns with the fresh
+    generation/traceparent env; exit 0 finishes."""
+    from tpu_dra.workloads.elastic import EXIT_RECONFIGURED, run_elastic
+
+    write_config(tmp_path, [("n0", "10.0.0.10")], generation=1)
+    runs = str(tmp_path / "runs.jsonl")
+    child = (
+        "import json, os, sys\n"
+        f"path = {runs!r}\n"
+        "with open(path, 'a') as f:\n"
+        "    json.dump({'gen': os.environ.get('TPU_ELASTIC_GENERATION'),"
+        " 'tp': os.environ.get('TPU_TRACEPARENT', '')}, f); f.write('\\n')\n"
+        "runs = sum(1 for _ in open(path))\n"
+        f"sys.exit({EXIT_RECONFIGURED} if runs == 1 else 0)\n")
+
+    def on_spawn(proc, epoch):
+        if epoch.generation == 1:
+            # the reconfiguration the child will exit for
+            write_config(tmp_path, [("n0", "10.0.0.10"),
+                                    ("n1", "10.0.0.11")], generation=2,
+                         traceparent="00-" + "12" * 16 + "-" + "34" * 8 +
+                         "-01")
+
+    rc = run_elastic(
+        [sys.executable, "-c", child],
+        env={**os.environ, "SLICE_SETTINGS_DIR": str(tmp_path),
+             "POD_IP": "10.0.0.10"},
+        poll=0.05, member_timeout=10.0, on_spawn=on_spawn)
+    assert rc == 0
+    lines = [json.loads(line) for line in open(runs)]
+    assert [r["gen"] for r in lines] == ["1", "2"]
+    assert lines[1]["tp"].startswith("00-12")
+
+
+def test_run_elastic_parks_until_member(tmp_path):
+    """A spare node's supervisor blocks until promotion puts its IP into
+    the active config."""
+    import threading
+
+    from tpu_dra.workloads.elastic import run_elastic
+
+    write_config(tmp_path, [("n0", "10.0.0.10")], generation=1)
+    done = str(tmp_path / "ran")
+    child = f"open({done!r}, 'w').close()"
+    result = {}
+
+    def supervise():
+        result["rc"] = run_elastic(
+            [sys.executable, "-c", child],
+            env={**os.environ, "SLICE_SETTINGS_DIR": str(tmp_path),
+                 "POD_IP": "10.0.0.11"},
+            poll=0.05, member_timeout=30.0)
+
+    t = threading.Thread(target=supervise)
+    t.start()
+    time.sleep(0.4)
+    assert not os.path.exists(done)   # parked: not a member yet
+    write_config(tmp_path, [("n0", "10.0.0.10"), ("n1", "10.0.0.11")],
+                 generation=2)
+    t.join(timeout=15)
+    assert not t.is_alive() and result["rc"] == 0
+    assert os.path.exists(done)
+
+
+def test_run_elastic_propagates_real_failures(tmp_path):
+    from tpu_dra.workloads.elastic import run_elastic
+
+    write_config(tmp_path, [("n0", "10.0.0.10")], generation=1)
+    rc = run_elastic(
+        [sys.executable, "-c", "import sys; sys.exit(7)"],
+        env={**os.environ, "SLICE_SETTINGS_DIR": str(tmp_path),
+             "POD_IP": "10.0.0.10"},
+        poll=0.05, member_timeout=10.0, reconfigure_grace=0.3)
+    assert rc == 7
+
+
+def test_launcher_resolves_generation(tmp_path):
+    from tpu_dra.workloads.launcher import resolve
+
+    write_config(tmp_path, [("n0", "10.0.0.10"), ("n1", "10.0.0.11")],
+                 generation=5)
+    info = resolve({"SLICE_DOMAIN_UUID": "uid-1",
+                    "SLICE_SETTINGS_DIR": str(tmp_path),
+                    "POD_IP": "10.0.0.11"})
+    assert info.generation == 5
+    assert info.process_id == 1

@@ -122,7 +122,7 @@ def test_two_node_domain_end_to_end(num_nodes):
                                   FABRIC, i)
             m.start()
             members.append(m)
-        node_lists = [m.updates.get(timeout=10) for m in members]
+        node_lists = [m.updates.get(timeout=10).nodes for m in members]
         for nl in node_lists:
             assert {n.name for n in nl} == set(nodes)
 
@@ -204,7 +204,7 @@ def test_multislice_domain_two_slices_by_two_nodes():
                                   fabric, worker_id=i % 2)
             m.start()
             members.append(m)
-        node_lists = [m.updates.get(timeout=10) for m in members]
+        node_lists = [m.updates.get(timeout=10).nodes for m in members]
         for nl in node_lists:
             assert {n.name for n in nl} == set(nodes)
 

@@ -372,7 +372,7 @@ def test_informer_store_race_free():
 def test_membership_manager_race_free():
     """Two daemons rendezvous through the CR status subresource while
     MembershipManager is monitored: the informer callback thread and the
-    main thread share ``_last_ips`` (guarded by ``_mu`` — the guarded-by
+    main thread share ``_last_pushed`` (guarded by ``_mu`` — the guarded-by
     static checker enforces the same contract; test_vet.py cross-wires
     the two lists)."""
     racecheck.install(lockdep=True)
@@ -392,8 +392,8 @@ def test_membership_manager_race_free():
             m.start()
             managers.append(m)
         for m in managers:
-            nodes = m.updates.get(timeout=10)
-            assert {n.name for n in nodes} == {"n0", "n1"}
+            update = m.updates.get(timeout=10)
+            assert {n.name for n in update.nodes} == {"n0", "n1"}
         racecheck.assert_no_races()
         racecheck.assert_lockdep_clean()
     finally:

@@ -127,3 +127,104 @@ def test_restore_serving_missing_raises(tmp_path):
     from tpu_dra.workloads.checkpointing import restore_serving_state
     with pytest.raises(FileNotFoundError):
         restore_serving_state(str(tmp_path / "nope"))
+
+
+# --- crash robustness (elastic domains: resume must land on a
+
+
+#     restorable step, docs/elastic-domains.md) ------------------------------
+
+
+def test_latest_step_skips_partial_and_save_cleans_it(cfg_params,
+                                                      tmp_path):
+    """A crash mid-save (non-atomic fs / writer killed between mkdir and
+    commit) leaves a bare step dir without the commit marker; readers
+    must never select it as latest — but must not delete it either (on
+    a non-atomic store it could be another writer's save-in-progress).
+    The NEXT save, which owns the directory, sweeps the wreckage."""
+    import os
+
+    _, params = cfg_params
+    d = str(tmp_path / "ckpt")
+    save_train_state(d, 3, params)
+    # fabricate the crash artifact: step 4 without _CHECKPOINT_METADATA
+    os.makedirs(os.path.join(d, "4", "default"))
+    with open(os.path.join(d, "4", "default", "junk"), "w") as f:
+        f.write("partial")
+    assert latest_step(d) == 3
+    assert os.path.exists(os.path.join(d, "4"))   # read path: skip only
+    out = restore_train_state(d)
+    assert out["params"] is not None
+    # the saver sweeps the artifact and can re-save the same step number
+    save_train_state(d, 4, params)
+    assert latest_step(d) == 4
+    restore_train_state(d, step=4)
+
+
+def test_restore_ignores_partial_latest(cfg_params, tmp_path):
+    import os
+
+    _, params = cfg_params
+    d = str(tmp_path / "ckpt")
+    save_train_state(d, 1, params)
+    os.makedirs(os.path.join(d, "2"))
+    restored = restore_train_state(d)   # must pick step 1, not fail on 2
+    for a, b in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(restored["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_step_leaves_orbax_tmp_dirs_alone(cfg_params, tmp_path):
+    """In-flight orbax staging dirs belong to a (possibly concurrent)
+    saver: skipped from selection but never deleted by the reader."""
+    import os
+
+    _, params = cfg_params
+    d = str(tmp_path / "ckpt")
+    save_train_state(d, 2, params)
+    tmp_dir = os.path.join(d, "5.orbax-checkpoint-tmp-1234567")
+    os.makedirs(tmp_dir)
+    assert latest_step(d) == 2
+    assert os.path.isdir(tmp_dir)
+
+
+def test_crash_sweep_mid_save_latest_always_restorable(tmp_path):
+    """Crash-sweep style: a child process saves checkpoints in a loop
+    and is SIGKILLed mid-stream; whatever ``latest_step`` then selects
+    must restore — the bounded-staleness contract of elastic resume."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = str(tmp_path / "ckpt")
+    child = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import os; os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax.numpy as jnp\n"
+        "from tpu_dra.workloads.checkpointing import save_train_state\n"
+        "for step in range(1, 200):\n"
+        "    save_train_state(%r, step, {'w': jnp.full(64, step)})\n"
+        % (repo, d))
+    proc = subprocess.Popen([sys.executable, "-c", child])
+    deadline = time.monotonic() + 60
+    from tpu_dra.workloads.checkpointing import _COMMIT_MARKER
+    while time.monotonic() < deadline:
+        if os.path.isdir(d) and any(
+                e.isdigit() and os.path.exists(
+                    os.path.join(d, e, _COMMIT_MARKER))
+                for e in os.listdir(d)):
+            break
+        time.sleep(0.02)
+    time.sleep(0.05)   # land the kill inside a later save
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+
+    step = latest_step(d)
+    assert step is not None
+    out = restore_train_state(d)
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["w"]),
+        np.full(64, step, dtype=np.float32))

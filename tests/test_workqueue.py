@@ -214,3 +214,117 @@ def test_metrics_permanent_failures_by_reason():
     assert series_value(
         text, "tpu_dra_workqueue_permanent_failures_total",
         'queue="mq-perm",reason="deadline"') == 1.0
+
+
+# --- same-key coalescing (client-go Add semantics; elastic domains rely
+
+
+#     on it to survive heartbeat churn) ------------------------------------
+
+
+def test_enqueue_coalesces_same_key_to_latest():
+    """N enqueues of one key while the worker is blocked collapse to ONE
+    pending run carrying the NEWEST object."""
+    q = WorkQueue("coalesce-basic")
+    gate = threading.Event()
+    seen = []
+
+    def cb(obj):
+        gate.wait(5)
+        seen.append(obj)
+
+    q.enqueue(cb, {"v": 0}, key="k")      # will start executing
+    q.run_in_background()
+    time.sleep(0.05)                      # worker now blocked in cb
+    for v in range(1, 6):
+        q.enqueue(cb, {"v": v}, key="k")  # all coalesce to one item
+    q.enqueue(cb, {"v": 99}, key="other")
+    gate.set()
+    assert q.drain(5)
+    q.shutdown()
+    assert {"v": 0} in seen               # the in-flight run
+    assert {"v": 5} in seen               # the coalesced latest
+    assert {"v": 99} in seen
+    assert len(seen) == 3, seen           # 1..4 never ran
+
+
+def test_enqueue_coalesces_into_backoff_delayed_item():
+    """An event arriving while its key is in retry-backoff refreshes the
+    delayed item's payload instead of queueing a duplicate."""
+    q = WorkQueue("coalesce-delayed",
+                  backoff=ItemExponentialBackoff(base=0.1, cap=0.1))
+    ran = []
+
+    def cb(obj):
+        ran.append(dict(obj))
+        if len(ran) == 1:
+            raise RuntimeError("first attempt fails")
+
+    q.enqueue(cb, {"v": "old"}, key="k")
+    q.run_in_background()
+    deadline = time.monotonic() + 5
+    while not ran and time.monotonic() < deadline:
+        time.sleep(0.005)
+    q.enqueue(cb, {"v": "new"}, key="k")   # lands in the delayed item
+    assert q.drain(5)
+    q.shutdown()
+    assert ran == [{"v": "old"}, {"v": "new"}]
+
+
+def test_enqueue_with_deadline_never_coalesced():
+    """Deadline items carry per-call completion contracts (the slice
+    plugin waits on each claim's finish) — same-key items must ALL run."""
+    q = WorkQueue("coalesce-deadline")
+    gate = threading.Event()
+    done = []
+
+    def cb(obj):
+        gate.wait(5)
+        done.append(obj)
+
+    q.enqueue_with_deadline(cb, "a", timeout=10, key="k")
+    q.run_in_background()
+    time.sleep(0.05)
+    q.enqueue_with_deadline(cb, "b", timeout=10, key="k")
+    q.enqueue_with_deadline(cb, "c", timeout=10, key="k")
+    gate.set()
+    assert q.drain(5)
+    q.shutdown()
+    assert sorted(done) == ["a", "b", "c"]
+
+
+def test_flood_of_one_key_cannot_starve_another():
+    """The elastic-domain failure shape: a hot writer floods key A while
+    key B arrives once — B must still be processed promptly and the
+    queue depth stays bounded."""
+    q = WorkQueue("coalesce-starve")
+    processed = []
+    stop = threading.Event()
+
+    def cb(obj):
+        processed.append(obj["key"])
+        time.sleep(0.01)
+
+    q.run_in_background()
+
+    def flood():
+        while not stop.is_set():
+            q.enqueue(cb, {"key": "hot"}, key="hot")
+            time.sleep(0.001)
+
+    t = threading.Thread(target=flood)
+    t.start()
+    try:
+        time.sleep(0.3)
+        q.enqueue(cb, {"key": "cold"}, key="cold")
+        deadline = time.monotonic() + 5
+        while "cold" not in processed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert "cold" in processed
+        with q._cv:
+            depth = len(q._queue) + len(q._delayed)
+        assert depth <= 2, depth
+    finally:
+        stop.set()
+        t.join(5)
+        q.shutdown()

@@ -62,8 +62,11 @@ LEAF_LOCKS: dict[str, str] = {
         "health/monitor.py thread model: probes run outside the lock, "
         "listeners are invoked after the lock is released",
     "MembershipManager._mu":
-        "daemon/membership.py: _mu only guards the _last_ips dedup "
+        "daemon/membership.py: _mu only guards the _last_pushed dedup "
         "snapshot; the queue push and all kube I/O happen outside it",
+    "GenerationWatcher._mu":
+        "workloads/elastic.py: _mu only guards the baseline/latest epoch "
+        "snapshot; config I/O and the Event trip happen outside it",
 }
 
 

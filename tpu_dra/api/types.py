@@ -15,6 +15,7 @@ used in tests.
 from __future__ import annotations
 
 import copy
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -25,8 +26,45 @@ STATUS_NOT_READY = "NotReady"
 
 # status.conditions[].type set by the controller when any member node
 # reports unhealthy devices (tpu_dra/health fan-in via the daemon's
-# MembershipManager)
+# MembershipManager) or loses its membership lease (elastic domains,
+# docs/elastic-domains.md)
 CONDITION_DEVICES_DEGRADED = "DevicesDegraded"
+
+# status.nodes[].state — membership roles arbitrated by the controller
+# (elastic slice domains).  An empty state means "legacy/unarbitrated":
+# readers treat it as Active.
+NODE_STATE_ACTIVE = "Active"
+NODE_STATE_SPARE = "Spare"
+NODE_STATE_LOST = "Lost"
+
+
+def now_rfc3339() -> str:
+    """UTC RFC3339 with millisecond precision — membership leases can be
+    sub-second in tests/drives, so the whole-second k8s condition format
+    is too coarse for ``lastHeartbeatTime``."""
+    t = time.time()
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + \
+        f".{int((t % 1) * 1000):03d}Z"
+
+
+def parse_rfc3339(stamp: str) -> Optional[float]:
+    """Epoch seconds from an RFC3339 UTC stamp (with or without a
+    fractional part), or None when empty/malformed."""
+    if not stamp:
+        return None
+    base, frac = stamp.rstrip("Z"), 0.0
+    if "." in base:
+        base, _, fpart = base.partition(".")
+        try:
+            frac = float("0." + fpart)
+        except ValueError:
+            return None
+    try:
+        import calendar
+        return calendar.timegm(
+            time.strptime(base, "%Y-%m-%dT%H:%M:%S")) + frac
+    except ValueError:
+        return None
 
 KIND = "TpuSliceDomain"
 PLURAL = "tpuslicedomains"
@@ -53,17 +91,24 @@ class TpuSliceDomainChannel:
 class TpuSliceDomainSpec:
     num_nodes: int = 0
     channel: Optional[TpuSliceDomainChannel] = None
+    # hot-spare policy (elastic domains): over-provision the domain by N
+    # standby nodes; the controller keeps the active mesh at num_nodes and
+    # promotes a spare when an active member's lease expires
+    spares: int = 0
 
     @classmethod
     def from_dict(cls, data: dict):
         ch = data.get("channel")
         return cls(num_nodes=int(data.get("numNodes", 0)),
-                   channel=TpuSliceDomainChannel.from_dict(ch) if ch else None)
+                   channel=TpuSliceDomainChannel.from_dict(ch) if ch else None,
+                   spares=int(data.get("spares", 0)))
 
     def to_dict(self) -> dict:
         out: dict = {"numNodes": self.num_nodes}
         if self.channel is not None:
             out["channel"] = self.channel.to_dict()
+        if self.spares:
+            out["spares"] = self.spares
         return out
 
 
@@ -86,6 +131,14 @@ class TpuSliceDomainNode:
     # DevicesDegraded condition.  Old readers ignore the extra keys.
     devices_healthy: bool = True
     unhealthy_devices: list[str] = field(default_factory=list)
+    # membership lease (elastic domains): the daemon stamps a fresh
+    # heartbeat on every status publish; the controller expires entries
+    # whose lease lapses.  Empty = legacy writer, exempt from expiry.
+    last_heartbeat: str = ""
+    # membership role, OWNED BY THE CONTROLLER (the daemon preserves it
+    # verbatim when republishing its own entry): "" | Active | Spare |
+    # Lost.  Empty reads as Active for legacy writers.
+    state: str = ""
 
     @classmethod
     def from_dict(cls, data: dict):
@@ -95,7 +148,9 @@ class TpuSliceDomainNode:
                    worker_id=int(data.get("workerID", -1)),
                    devices_healthy=bool(data.get("devicesHealthy", True)),
                    unhealthy_devices=list(
-                       data.get("unhealthyDevices") or []))
+                       data.get("unhealthyDevices") or []),
+                   last_heartbeat=data.get("lastHeartbeatTime", ""),
+                   state=data.get("state", ""))
 
     def to_dict(self) -> dict:
         out = {"name": self.name, "ipAddress": self.ip_address,
@@ -103,7 +158,24 @@ class TpuSliceDomainNode:
         if not self.devices_healthy:
             out["devicesHealthy"] = False
             out["unhealthyDevices"] = list(self.unhealthy_devices)
+        if self.last_heartbeat:
+            out["lastHeartbeatTime"] = self.last_heartbeat
+        if self.state:
+            out["state"] = self.state
         return out
+
+    # -- membership helpers (elastic domains) ------------------------------
+    @property
+    def active(self) -> bool:
+        """Part of the active mesh: Active, or legacy-unarbitrated."""
+        return self.state in ("", NODE_STATE_ACTIVE)
+
+    def heartbeat_age(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the last heartbeat, or None when never stamped."""
+        ts = parse_rfc3339(self.last_heartbeat)
+        if ts is None:
+            return None
+        return (time.time() if now is None else now) - ts
 
 
 @dataclass
@@ -113,6 +185,15 @@ class TpuSliceDomainStatus:
     # k8s-style condition dicts ({type, status, reason, message,
     # lastTransitionTime}); kept raw so server-set fields round-trip
     conditions: list[dict] = field(default_factory=list)
+    # membership generation (elastic domains): bumped by the controller on
+    # every reconfiguration of the ACTIVE set (loss, promotion, shrink).
+    # 0 = never arbitrated (legacy assembly).  Daemons and launchers fence
+    # on it: config/rendezvous derived from an older generation loses.
+    membership_generation: int = 0
+    # W3C traceparent of the reconfiguration that produced this
+    # generation — daemons/launchers join the recovery trace through it
+    # (trace/propagation contract, written atomically with the bump)
+    reconfigure_traceparent: str = ""
 
     @classmethod
     def from_dict(cls, data: dict):
@@ -120,14 +201,26 @@ class TpuSliceDomainStatus:
                    nodes=[TpuSliceDomainNode.from_dict(n)
                           for n in data.get("nodes") or []],
                    conditions=[copy.deepcopy(c)
-                               for c in data.get("conditions") or []])
+                               for c in data.get("conditions") or []],
+                   membership_generation=int(
+                       data.get("membershipGeneration", 0)),
+                   reconfigure_traceparent=data.get(
+                       "reconfigureTraceparent", ""))
 
     def to_dict(self) -> dict:
         out = {"status": self.status,
                "nodes": [n.to_dict() for n in self.nodes]}
         if self.conditions:
             out["conditions"] = [copy.deepcopy(c) for c in self.conditions]
+        if self.membership_generation:
+            out["membershipGeneration"] = self.membership_generation
+        if self.reconfigure_traceparent:
+            out["reconfigureTraceparent"] = self.reconfigure_traceparent
         return out
+
+    def active_nodes(self) -> list[TpuSliceDomainNode]:
+        """The arbitrated active mesh (legacy entries count as active)."""
+        return [n for n in self.nodes if n.active]
 
     def condition(self, cond_type: str) -> Optional[dict]:
         return next((c for c in self.conditions

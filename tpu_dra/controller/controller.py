@@ -30,6 +30,11 @@ class ControllerConfig:
     driver_namespace: str = "tpu-dra-driver"
     image_name: str = "tpu-dra-driver:latest"
     gc_period: float = 600.0   # cleanup.go: 10 min
+    # elastic membership (docs/elastic-domains.md): a member node whose
+    # lease is older than lease_duration is marked Lost; the staleness
+    # sweep re-enqueues every domain each sweep_period (0 disables)
+    lease_duration: float = 30.0
+    sweep_period: float = 10.0
 
 
 class Controller:
@@ -41,7 +46,9 @@ class Controller:
             "TpuSliceDomain reconcile attempts", labels=("result",))
         self.manager = SliceDomainManager(
             cfg.kube, cfg.driver_namespace, cfg.image_name, self.queue,
-            reconcile_counter=self.reconciles)
+            reconcile_counter=self.reconciles,
+            lease_duration=cfg.lease_duration,
+            sweep_period=cfg.sweep_period)
         exists = self.manager.domain_exists
         self.gc_managers = [
             CleanupManager(
@@ -101,5 +108,8 @@ class Controller:
     def stop(self) -> None:
         for gc in self.gc_managers:
             gc.stop()
-        self.queue.shutdown()
+        # manager first: its sweep thread and informer handlers enqueue;
+        # shutting the queue under them would turn a stop() into raised
+        # "queue is shut down" errors inside live producer threads
         self.manager.stop()
+        self.queue.shutdown()

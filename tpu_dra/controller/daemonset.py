@@ -138,7 +138,10 @@ class DaemonSetManager:
                 return
         ready = ds.get("status", {}).get("numberReady", 0)
         desired = domain.spec.num_nodes
-        new_status = STATUS_READY if ready == desired else STATUS_NOT_READY
+        # >= not ==: a spare-over-provisioned domain (spec.spares) runs
+        # num_nodes + spares daemon pods, but the mesh is formable once
+        # num_nodes of them are up
+        new_status = STATUS_READY if ready >= desired else STATUS_NOT_READY
         current = domain.status.status if domain.status else ""
         if current == new_status:
             return

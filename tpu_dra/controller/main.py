@@ -29,6 +29,12 @@ def controller_flags() -> FlagGroup:
              "/debug/pprof"),
         Flag("gc-period-seconds", "GC_PERIOD_SECONDS",
              "stale-object GC period", 600.0, float),
+        Flag("lease-duration-seconds", "LEASE_DURATION_SECONDS",
+             "membership lease: a node whose status heartbeat is older "
+             "than this is marked Lost", 30.0, float),
+        Flag("sweep-period-seconds", "SWEEP_PERIOD_SECONDS",
+             "staleness-sweep period for membership leases (0 disables)",
+             10.0, float),
     ])
 
 
@@ -51,7 +57,9 @@ def main(argv=None) -> int:
         kube=kube,
         driver_namespace=args.namespace,
         image_name=args.image_name,
-        gc_period=args.gc_period_seconds))
+        gc_period=args.gc_period_seconds,
+        lease_duration=args.lease_duration_seconds,
+        sweep_period=args.sweep_period_seconds))
     controller.start()
 
     done = threading.Event()

@@ -13,11 +13,14 @@ assertion raising so the workqueue retries until informers confirm removal
 
 from __future__ import annotations
 
+import threading
 import time
+from dataclasses import dataclass, field
 from typing import Optional
 
-from tpu_dra.api.types import CONDITION_DEVICES_DEGRADED, TpuSliceDomain, \
-    TpuSliceDomainStatus, STATUS_NOT_READY
+from tpu_dra.api.types import CONDITION_DEVICES_DEGRADED, \
+    NODE_STATE_ACTIVE, NODE_STATE_LOST, NODE_STATE_SPARE, TpuSliceDomain, \
+    TpuSliceDomainSpec, TpuSliceDomainStatus, STATUS_NOT_READY
 from tpu_dra.controller.constants import FINALIZER
 from tpu_dra.controller.daemonset import DaemonSetManager
 from tpu_dra.controller.node import NodeManager
@@ -27,8 +30,9 @@ from tpu_dra.k8s.client import Conflict, KubeClient, NotFound, \
 from tpu_dra.k8s.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, \
     emit_event
 from tpu_dra.k8s.informer import Informer, uid_index
-from tpu_dra.resilience import failpoint
-from tpu_dra.trace import get_tracer, propagation
+from tpu_dra.resilience import failpoint, retry
+from tpu_dra.trace import get_tracer, propagation, start_span
+from tpu_dra.trace.span import current_traceparent
 from tpu_dra.util import klog
 from tpu_dra.util.workqueue import WorkQueue
 
@@ -36,16 +40,170 @@ _FP_RECONCILE = failpoint.register(
     "controller.reconcile",
     "top of every TpuSliceDomain reconcile (error here exercises the "
     "workqueue's per-item backoff)")
+_FP_SWEEP = failpoint.register(
+    "controller.membership.sweep",
+    "each membership-arbitration write attempt (error here exercises the "
+    "status-write retry under lease-expiry/promotion races)")
+_FP_PROMOTE = failpoint.register(
+    "controller.membership.promote",
+    "armed when an arbitration is about to promote a spare (sleep here "
+    "widens the promotion race window against a rejoining lost node)")
+
+# a Lost node whose lease has been expired this many times over is
+# dropped from status.nodes entirely (the status shrink)
+LOST_REMOVAL_FACTOR = 3.0
+
+
+@dataclass
+class MembershipPlan:
+    """One arbitration step over ``status.nodes`` — computed as a pure
+    function (:func:`membership_plan`) so races are unit-testable, then
+    applied under the status-write retry policy."""
+
+    states: dict[str, str] = field(default_factory=dict)   # name -> state
+    removals: list[str] = field(default_factory=list)
+    bump: bool = False            # active set changed -> generation bump
+    active: list[str] = field(default_factory=list)
+    events: list[tuple[str, str, str]] = field(default_factory=list)
+    # nodes entering the active mesh from standby/rejoin this pass — the
+    # promotion-race failpoint arms on these
+    promotions: list[str] = field(default_factory=list)
+
+
+def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
+                    now: float, lease_duration: float
+                    ) -> Optional[MembershipPlan]:
+    """Arbitrate membership roles from leases + device health.
+
+    Rules (docs/elastic-domains.md):
+
+    - a non-Lost node whose heartbeat lease expired becomes **Lost**;
+    - a Lost node heartbeating fresh again re-enters as a candidate at
+      SPARE priority: if a spare was promoted meanwhile its slot is
+      taken and the returnee parks as a Spare (generation fencing — the
+      promotion stands); if the mesh has a vacancy the same pass
+      re-admits it to Active (a promotion, failpoint-armed like any
+      other);
+    - a Lost node stale beyond ``LOST_REMOVAL_FACTOR`` leases is removed
+      from ``status.nodes`` (the status shrink);
+    - the active set is the first ``spec.num_nodes`` candidates ordered
+      by (healthy devices, already-active, worker id, name) — so a
+      healthy spare drains an unhealthy active (the health subsystem's
+      drain path feeding placement), but healthy actives are never
+      churned;
+    - the generation bumps iff the ACTIVE set changed.
+
+    Returns None when nothing needs to change.  Nodes that never
+    heartbeat (legacy writers) are exempt from expiry.  Domains that
+    were never arbitrated (generation 0, no states) are left untouched
+    while assembling at or below ``num_nodes`` — legacy single-shot
+    rendezvous keeps working without any controller writes.
+    """
+    nodes = status.nodes
+    states: dict[str, str] = {}
+    removals: list[str] = []
+    events: list[tuple[str, str, str]] = []
+    rejoined: set[str] = set()
+
+    for n in nodes:
+        age = n.heartbeat_age(now)
+        if n.state != NODE_STATE_LOST:
+            if age is not None and age > lease_duration:
+                states[n.name] = NODE_STATE_LOST
+                events.append((
+                    "NodeLost",
+                    f"node {n.name} membership lease expired "
+                    f"({age:.1f}s > {lease_duration:.1f}s)",
+                    EVENT_TYPE_WARNING))
+        else:
+            if age is not None and age <= lease_duration:
+                # rejoin after a loss: re-enter at standby priority; the
+                # selection pass below decides Spare vs re-admission and
+                # the NodeRejoined event is emitted with that outcome
+                states[n.name] = NODE_STATE_SPARE
+                rejoined.add(n.name)
+            elif age is None or age > lease_duration * LOST_REMOVAL_FACTOR:
+                removals.append(n.name)
+
+    arbitrated = status.membership_generation > 0 or \
+        any(n.state for n in nodes)
+    if not arbitrated and not states and not removals and \
+            len(nodes) <= spec.num_nodes:
+        return None   # legacy assembly: nothing elastic happening
+
+    def eff(n) -> str:
+        return states.get(n.name, n.state)
+
+    prev_active = {n.name for n in nodes if n.active}
+    candidates = [n for n in nodes
+                  if n.name not in removals and eff(n) != NODE_STATE_LOST]
+    candidates.sort(key=lambda n: (
+        not n.devices_healthy,
+        eff(n) not in ("", NODE_STATE_ACTIVE),   # stability: keep actives
+        n.worker_id, n.name))
+    new_active = candidates[:spec.num_nodes]
+    active_names = {n.name for n in new_active}
+    promotions: list[str] = []
+    for n in candidates:
+        want = NODE_STATE_ACTIVE if n.name in active_names \
+            else NODE_STATE_SPARE
+        if n.name in rejoined:
+            if want == NODE_STATE_ACTIVE:
+                promotions.append(n.name)
+                events.append((
+                    "NodeRejoined",
+                    f"node {n.name} heartbeating again; re-admitted to "
+                    f"the active mesh (a vacancy was open)",
+                    EVENT_TYPE_NORMAL))
+            else:
+                events.append((
+                    "NodeRejoined",
+                    f"node {n.name} heartbeating again; rejoining as a "
+                    f"spare (generation fencing: any promotion stands)",
+                    EVENT_TYPE_NORMAL))
+        if eff(n) != want:
+            if n.state == NODE_STATE_SPARE and want == NODE_STATE_ACTIVE:
+                promotions.append(n.name)
+                events.append((
+                    "SparePromoted",
+                    f"spare node {n.name} promoted into the active mesh",
+                    EVENT_TYPE_NORMAL))
+            elif n.state == NODE_STATE_ACTIVE and want == NODE_STATE_SPARE:
+                events.append((
+                    "NodeDemoted",
+                    f"node {n.name} drained from the active mesh to "
+                    f"standby", EVENT_TYPE_NORMAL))
+            states[n.name] = want
+
+    bump = active_names != prev_active
+    if not states and not removals and not bump:
+        return None
+    plan = MembershipPlan(
+        states=states, removals=removals, bump=bump,
+        active=sorted(active_names), events=events,
+        promotions=promotions)
+    if bump:
+        gen = status.membership_generation + 1
+        plan.events.append((
+            "DomainReconfigured",
+            f"membership generation {gen}: active mesh = "
+            f"{', '.join(plan.active) or '(empty)'} "
+            f"({len(plan.active)} of {spec.num_nodes})",
+            EVENT_TYPE_NORMAL))
+    return plan
 
 
 class SliceDomainManager:
     def __init__(self, kube: KubeClient, driver_namespace: str,
                  image_name: str, queue: WorkQueue,
-                 reconcile_counter=None) -> None:
+                 reconcile_counter=None, lease_duration: float = 30.0,
+                 sweep_period: float = 10.0) -> None:
         self._reconciles = reconcile_counter
         self.kube = kube
         self.driver_namespace = driver_namespace
         self.queue = queue
+        self.lease_duration = lease_duration
+        self.sweep_period = sweep_period
         self.informer = Informer(kube, TPU_SLICE_DOMAINS,
                                  indexers={"uid": uid_index})
         self.informer.add_event_handler(
@@ -55,16 +213,50 @@ class SliceDomainManager:
             kube, driver_namespace, image_name, self.get_by_uid)
         self.workload_rct = WorkloadRCTManager(kube, driver_namespace)
         self.node_manager = NodeManager(kube)
+        self._sweep_stop = threading.Event()
+        self._sweep_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.informer.start()
         self.informer.wait_for_sync()
         self.ds_manager.start()
+        if self.sweep_period > 0:
+            self._sweep_thread = threading.Thread(
+                target=self._sweep_loop, daemon=True,
+                name="membership-sweep")
+            self._sweep_thread.start()
 
     def stop(self) -> None:
+        self._sweep_stop.set()
+        if self._sweep_thread is not None:
+            self._sweep_thread.join(timeout=5)
         self.ds_manager.stop()
         self.informer.stop()
+
+    def _sweep_loop(self) -> None:
+        """Staleness sweep (elastic domains): lease expiry has no watch
+        event — a dead daemon writes nothing — so every period each
+        domain whose membership NEEDS arbitration is re-enqueued through
+        the normal reconcile path.  The informer-copy plan probe keeps a
+        steady-state sweep free of API traffic (no reconcile, no GETs);
+        the workqueue serializes sweeps with watch-triggered reconciles
+        per uid."""
+        while not self._sweep_stop.wait(self.sweep_period):
+            try:
+                now = time.time()
+                for obj in self.informer.store.list():
+                    domain = TpuSliceDomain.from_dict(obj)
+                    if domain.deleting or domain.status is None:
+                        continue
+                    if membership_plan(domain.status, domain.spec, now,
+                                       self.lease_duration) is not None:
+                        self._enqueue(obj)
+            except Exception as exc:  # noqa: BLE001 — loop must survive
+                # (malformed object, queue shutting down mid-tick): a
+                # dead sweep thread would silently disable lease expiry
+                klog.warning("membership sweep tick failed",
+                             err=repr(exc))
 
     # -- lookups -----------------------------------------------------------
     def get_by_uid(self, uid: str) -> Optional[TpuSliceDomain]:
@@ -123,6 +315,7 @@ class SliceDomainManager:
                          "workload RCT will be created",
                          domain=domain.name, namespace=domain.namespace)
         self._ensure_status(domain)
+        domain = self._reconcile_membership(domain) or domain
         self._ensure_degraded_condition(domain)
 
     def _add_finalizer(self, domain: TpuSliceDomain) -> None:
@@ -148,44 +341,70 @@ class SliceDomainManager:
             self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
 
     @staticmethod
-    def _degraded_verdict(status: TpuSliceDomainStatus
-                          ) -> tuple[str, str, str]:
-        """(status, reason, message) for the DevicesDegraded condition."""
+    def _degraded_verdict(status: TpuSliceDomainStatus,
+                          num_nodes: int = 0) -> tuple[str, str, str]:
+        """(status, reason, message) for the DevicesDegraded condition —
+        aggregated from device health ∪ node liveness (stale leases) ∪
+        active-mesh size (elastic domains)."""
+        lost = sorted(n.name for n in status.nodes
+                      if n.state == NODE_STATE_LOST)
         degraded = {n.name: n.unhealthy_devices
                     for n in status.nodes if not n.devices_healthy}
+        active = status.active_nodes()
+        shrunk = status.membership_generation > 0 and num_nodes and \
+            len(active) < num_nodes
+        if not lost and not degraded and not shrunk:
+            return ("False", "AllDevicesHealthy",
+                    "all member nodes report healthy devices")
+        parts = []
+        if lost:
+            parts.append("nodes lost (membership lease expired): "
+                         + ", ".join(lost))
         if degraded:
-            return ("True", "UnhealthyDevicesReported",
-                    "unhealthy devices reported by " + "; ".join(
-                        f"{node}: {', '.join(devs) or 'unspecified'}"
-                        for node, devs in sorted(degraded.items())))
-        return ("False", "AllDevicesHealthy",
-                "all member nodes report healthy devices")
+            parts.append("unhealthy devices reported by " + "; ".join(
+                f"{node}: {', '.join(devs) or 'unspecified'}"
+                for node, devs in sorted(degraded.items())))
+        if shrunk:
+            parts.append(f"active mesh shrunk to {len(active)} of "
+                         f"{num_nodes} nodes (no spare available)")
+        if lost and degraded:
+            reason = "DegradedMembership"
+        elif lost:
+            reason = "NodesLost"
+        elif degraded:
+            reason = "UnhealthyDevicesReported"
+        else:
+            reason = "ShrunkBelowSpec"
+        return ("True", reason, "; ".join(parts))
 
-    def _up_to_date(self, status: Optional[TpuSliceDomainStatus]
-                    ) -> bool:
+    def _up_to_date(self, status: Optional[TpuSliceDomainStatus],
+                    num_nodes: int = 0) -> bool:
         if status is None:
             return False
-        want, _, message = self._degraded_verdict(status)
+        want, _, message = self._degraded_verdict(status, num_nodes)
         prev = status.condition(CONDITION_DEVICES_DEGRADED)
         return prev is not None and prev.get("status") == want and \
             prev.get("message") == message
 
     def _ensure_degraded_condition(self, domain: TpuSliceDomain) -> None:
         """Aggregate the per-node chip-health verdicts the daemons publish
-        into ``status.nodes`` (tpu_dra/health fan-in) into one
-        ``DevicesDegraded`` condition, and emit an Event on each
-        transition.  A status-write Conflict raises → workqueue retry."""
+        into ``status.nodes`` (tpu_dra/health fan-in) plus node liveness
+        (elastic domains) into one ``DevicesDegraded`` condition, and emit
+        an Event on each transition.  A status-write Conflict raises →
+        workqueue retry."""
+        num_nodes = domain.spec.num_nodes
         # cheap no-op check against the informer copy first: steady-state
         # resyncs must not cost an extra API GET per reconcile
-        if self._up_to_date(domain.status):
+        if self._up_to_date(domain.status, num_nodes):
             return
         fresh = TpuSliceDomain.from_dict(
             self.kube.get(TPU_SLICE_DOMAINS, domain.name, domain.namespace))
         if fresh.status is None:
             fresh.status = TpuSliceDomainStatus()
-        if self._up_to_date(fresh.status):
+        if self._up_to_date(fresh.status, num_nodes):
             return      # the informer copy was stale; nothing to write
-        want, reason, message = self._degraded_verdict(fresh.status)
+        want, reason, message = self._degraded_verdict(fresh.status,
+                                                       num_nodes)
         prev = fresh.status.condition(CONDITION_DEVICES_DEGRADED)
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         fresh.status.set_condition({
@@ -212,6 +431,79 @@ class SliceDomainManager:
             emit_event(self.kube, fresh.to_dict(), "DevicesRecovered",
                        message, EVENT_TYPE_NORMAL)
             klog.info("slice domain devices recovered", domain=domain.name)
+
+    # -- elastic membership (docs/elastic-domains.md) ----------------------
+    def _reconcile_membership(self, domain: TpuSliceDomain
+                              ) -> Optional[TpuSliceDomain]:
+        """Expire stale leases, promote spares, shrink, bump the
+        generation.  Returns the freshly-written domain (so the caller's
+        condition pass sees the new membership), or None when nothing
+        changed.
+
+        The plan is recomputed from a fresh GET inside the retried write
+        (two nodes expiring in one sweep, a lost node rejoining mid-
+        arbitration, racing daemon heartbeats — all collapse to "re-plan
+        on the latest status and retry on Conflict")."""
+        if domain.status is None or domain.deleting:
+            return None
+        # cheap no-op probe on the informer copy before any API traffic
+        if membership_plan(domain.status, domain.spec, time.time(),
+                           self.lease_duration) is None:
+            return None
+        applied: dict = {}
+
+        def write() -> None:
+            failpoint.hit("controller.membership.sweep")
+            applied.clear()
+            fresh = TpuSliceDomain.from_dict(self.kube.get(
+                TPU_SLICE_DOMAINS, domain.name, domain.namespace))
+            if fresh.status is None or fresh.deleting:
+                return
+            plan = membership_plan(fresh.status, fresh.spec, time.time(),
+                                   self.lease_duration)
+            if plan is None:
+                return
+            if plan.promotions:
+                failpoint.hit("controller.membership.promote")
+            for node in fresh.status.nodes:
+                if node.name in plan.states:
+                    node.state = plan.states[node.name]
+            if plan.removals:
+                fresh.status.nodes = [n for n in fresh.status.nodes
+                                      if n.name not in plan.removals]
+            if plan.bump:
+                fresh.status.membership_generation += 1
+                fresh.status.reconfigure_traceparent = \
+                    current_traceparent() or \
+                    fresh.status.reconfigure_traceparent
+            self.kube.update_status(TPU_SLICE_DOMAINS, fresh.to_dict())
+            applied["plan"] = plan
+            applied["domain"] = fresh
+
+        with start_span("controller.membership_reconfigure",
+                        attributes={"domain": domain.name,
+                                    "namespace": domain.namespace}) as span:
+            retry.retry_call(write, policy=retry.STATUS_WRITE_POLICY,
+                             retryable=retry.retryable_or_conflict,
+                             op="slicedomain.reconcile_membership")
+            plan = applied.get("plan")
+            if plan is None:
+                return None
+            fresh = applied["domain"]
+            span.set_attribute("generation",
+                               fresh.status.membership_generation)
+            span.set_attribute("active", ",".join(plan.active))
+            for reason, message, etype in plan.events:
+                emit_event(self.kube, fresh.to_dict(), reason, message,
+                           etype)
+            log = klog.warning if any(
+                e[2] == EVENT_TYPE_WARNING for e in plan.events) \
+                else klog.info
+            log("membership reconfigured", domain=domain.name,
+                generation=fresh.status.membership_generation,
+                active=plan.active, removed=plan.removals,
+                states=plan.states)
+            return fresh
 
     def _teardown(self, domain: TpuSliceDomain) -> None:
         """Strict deletion order (computedomain.go:234-268).  Any failed

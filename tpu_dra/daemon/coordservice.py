@@ -80,6 +80,16 @@ class CoordState:
     def ready(self) -> bool:
         return bool(self.nodes())
 
+    def generation(self) -> int:
+        """Membership generation of the loaded config (0 = pre-elastic
+        config without the field)."""
+        self.reload()
+        with self._mu:
+            try:
+                return int(self._data.get("generation", 0))
+            except (TypeError, ValueError):
+                return 0
+
     @staticmethod
     def _order(nodes: list[dict]) -> list[dict]:
         from tpu_dra.util.rank import rank_sorted
@@ -126,7 +136,11 @@ def serve(settings_dir: str, port: int,
                   f"coordd_nodes {n_nodes}",
                   "# HELP coordd_ready 1 once a full config is loaded",
                   "# TYPE coordd_ready gauge",
-                  f"coordd_ready {1 if n_nodes else 0}"]
+                  f"coordd_ready {1 if n_nodes else 0}",
+                  "# HELP coordd_generation membership generation of the "
+                  "loaded config",
+                  "# TYPE coordd_generation gauge",
+                  f"coordd_generation {state.generation()}"]
         return "\n".join(lines) + "\n"
 
     class Handler(BaseHTTPRequestHandler):

@@ -24,7 +24,7 @@ import threading
 import urllib.request
 
 from tpu_dra.api.types import TpuSliceDomainNode
-from tpu_dra.daemon.membership import MembershipManager
+from tpu_dra.daemon.membership import MembershipManager, MembershipUpdate
 from tpu_dra.daemon.process import ProcessManager
 from tpu_dra.health.monitor import HealthMonitor
 from tpu_dra.k8s.client import new_clients
@@ -68,7 +68,8 @@ def _split_fabric(fabric: str) -> tuple[str, int]:
 
 
 def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
-                       my_fabric: str) -> str:
+                       my_fabric: str, generation: int = 0,
+                       traceparent: str = "") -> str:
     """The ``writeNodesConfig`` analog (main.go:292-322), multislice-aware.
 
     Same-deployment nodes participate; nodes of a different deployment uuid
@@ -82,6 +83,12 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
     the ``MEGASCALE_*`` env alongside the ``jax.distributed`` triple.
     Single-partition domains keep the exact legacy shape (plus the
     now-always-present rank/sliceID fields, which old readers ignore).
+
+    Elastic domains add two top-level fields old readers ignore:
+    ``generation`` (the membership generation this config was derived
+    from — workload launchers fence their rendezvous on it) and
+    ``traceparent`` (the recovery trace context, so a launcher
+    re-initializing after a reconfiguration joins the same trace).
     """
     my_deployment, _ = _split_fabric(my_fabric)
     members = [n for n in nodes
@@ -95,6 +102,10 @@ def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
              sliceID=slice_of[_split_fabric(n.fabric_id)[1]])
         for i, n in enumerate(members)]
     data: dict = {"nodes": entries}
+    if generation:
+        data["generation"] = generation
+    if traceparent:
+        data["traceparent"] = traceparent
     if len(partitions) > 1:
         _, my_partition = _split_fabric(my_fabric)
         data["multislice"] = {
@@ -225,7 +236,9 @@ def run(argv=None) -> int:
     kube = new_clients(kubeconfig or None)
     membership = MembershipManager(
         kube, domain_name, domain_namespace, node_name, pod_ip,
-        fabric, tpulib.worker_id())
+        fabric, tpulib.worker_id(),
+        heartbeat_interval=float(
+            env.get("MEMBERSHIP_HEARTBEAT_INTERVAL", "10")))
     coordservice = ProcessManager(
         argv_fn=lambda: coordservice_argv(settings_dir, port),
         name="coordservice")
@@ -236,25 +249,39 @@ def run(argv=None) -> int:
 
     def update_loop() -> None:
         """IMEXDaemonUpdateLoop analog (main.go:231-251)."""
+        from tpu_dra.trace.span import SpanContext, current_traceparent
         while not stop.is_set():
             try:
-                nodes = membership.updates.get(timeout=0.5)
+                update: MembershipUpdate = membership.updates.get(
+                    timeout=0.5)
             except queue.Empty:
                 continue
             try:
-                # one span per full-membership barrier crossing, a child
-                # of the prepare that placed this daemon (TPU_TRACEPARENT
-                # from the slice plugin's daemon CDI edits): the gap
+                # one span per membership barrier crossing.  Parent: the
+                # RECONFIGURATION that produced this generation (the
+                # controller stamps its traceparent into the status write
+                # that bumps the generation) so a recovery reads as one
+                # trace across binaries; initial assembly falls back to
+                # the prepare that placed this daemon (TPU_TRACEPARENT
+                # from the slice plugin's daemon CDI edits) — the gap
                 # between the claim trace's prepare and this span IS the
                 # time spent waiting for the other member nodes
+                parent = SpanContext.from_traceparent(update.traceparent) \
+                    or _trace_parent()
                 with get_tracer().start_span(
                         "daemon.coordination_update",
-                        parent=_trace_parent(),
+                        parent=parent,
                         attributes={"domain": domain_uid,
-                                    "members": len(nodes)}):
-                    write_nodes_config(settings_dir, nodes, fabric)
+                                    "members": len(update.nodes),
+                                    "generation": update.generation}):
+                    write_nodes_config(
+                        settings_dir, update.nodes, fabric,
+                        generation=update.generation,
+                        traceparent=current_traceparent() or
+                        update.traceparent)
                     klog.info("membership changed; restarting coordination "
-                              "service", members=len(nodes))
+                              "service", members=len(update.nodes),
+                              generation=update.generation)
                     coordservice.restart()
             except Exception as exc:  # noqa: BLE001 — loop must survive
                 # (e.g. a spawn failure); the watchdog keeps retrying and

@@ -2,21 +2,33 @@
 
 Analog of reference ``cmd/compute-domain-daemon/computedomain.go:42-233``:
 each daemon pod writes ``{nodeName, podIP, fabricID, workerID}`` into
-``TpuSliceDomain.status.nodes`` (a list-map keyed by node name); once
-``len(status.nodes) == spec.numNodes`` **and** the IP set changed, the full
-node list is pushed to a channel consumed by the coordination update loop.
+``TpuSliceDomain.status.nodes`` (a list-map keyed by node name); once the
+ACTIVE membership assembles **and** it changed, the full node list is
+pushed to a channel consumed by the coordination update loop.
+
+Elastic domains (docs/elastic-domains.md) extend the record into a lease:
+every publish stamps ``lastHeartbeatTime`` and a background heartbeat
+loop republishes it each interval, so the controller can expire a
+preempted node instead of waiting forever.  The controller arbitrates
+membership roles (``state``: Active/Spare/Lost) and bumps
+``status.membershipGeneration`` on every reconfiguration; this manager
+preserves the controller-owned ``state`` verbatim when republishing its
+own entry, and fences its rendezvous pushes on the generation.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+from dataclasses import dataclass, field
 from typing import Optional
 
 from tpu_dra.api.types import (
+    NODE_STATE_SPARE,
     TpuSliceDomain,
     TpuSliceDomainNode,
     TpuSliceDomainStatus,
+    now_rfc3339,
 )
 from tpu_dra.k8s.client import KubeClient, TPU_SLICE_DOMAINS
 from tpu_dra.k8s.informer import Informer
@@ -27,15 +39,37 @@ _FP_UPDATE = failpoint.register(
     "daemon.membership.update",
     "each attempt to publish this node's info into the domain status "
     "(error here exercises the centralized retry policy)")
+_FP_HEARTBEAT = failpoint.register(
+    "daemon.membership.heartbeat",
+    "top of each membership heartbeat tick (stall here wedges the lease "
+    "renewal WITHOUT killing the daemon — the lease-expiry/rejoin race; "
+    "error skips single beats; sleep delays them)")
+
+# node-entry keys the daemon never compares when deciding whether a
+# republish is needed: the heartbeat is stamped fresh on every write (it
+# WOULD always differ) and the state is controller-owned
+_VOLATILE_KEYS = ("lastHeartbeatTime",)
+
+
+@dataclass
+class MembershipUpdate:
+    """One rendezvous push: the active mesh plus the fencing metadata the
+    coordination config needs (generation + recovery traceparent)."""
+
+    nodes: list[TpuSliceDomainNode] = field(default_factory=list)
+    generation: int = 0
+    traceparent: str = ""
 
 
 class MembershipManager:
     def __init__(self, kube: KubeClient, domain_name: str,
                  domain_namespace: str, node_name: str, pod_ip: str,
-                 fabric_id: str, worker_id: int) -> None:
+                 fabric_id: str, worker_id: int,
+                 heartbeat_interval: float = 10.0) -> None:
         self.kube = kube
         self.domain_name = domain_name
         self.domain_namespace = domain_namespace
+        self.heartbeat_interval = heartbeat_interval
         self.self_node = TpuSliceDomainNode(
             name=node_name, ip_address=pod_ip, fabric_id=fabric_id,
             worker_id=worker_id)
@@ -47,22 +81,47 @@ class MembershipManager:
         self.informer.add_event_handler(
             on_add=self._on_change,
             on_update=lambda old, new: self._on_change(new))
-        self._updates: "queue.Queue[list[TpuSliceDomainNode]]" = queue.Queue()
-        self._last_ips: Optional[frozenset[str]] = None   # guarded by self._mu
+        self._updates: "queue.Queue[MembershipUpdate]" = queue.Queue()
+        # (generation, active-ip frozenset) of the last push
+        self._last_pushed: Optional[tuple] = None   # guarded by self._mu
         self._mu = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
         self.informer.start()
         self.informer.wait_for_sync()
         self.update_own_node_info()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="membership-heartbeat")
+        self._hb_thread.start()
 
     def stop(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
         self.informer.stop()
 
     @property
-    def updates(self) -> "queue.Queue[list[TpuSliceDomainNode]]":
+    def updates(self) -> "queue.Queue[MembershipUpdate]":
         """The rendezvous channel (GetNodesUpdateChan analog)."""
         return self._updates
+
+    # -- lease heartbeat (elastic domains) ---------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Republish our entry (fresh ``lastHeartbeatTime``) every
+        interval.  The stamp itself rides the existing status-write retry
+        path — no new writer, no new locks."""
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                failpoint.hit("daemon.membership.heartbeat")
+                self.update_own_node_info(force=True)
+            except Exception as exc:  # noqa: BLE001 — a failed beat is a
+                # missed lease renewal, never a daemon crash; the next
+                # tick (or an informer-triggered publish) renews it
+                klog.warning("membership heartbeat skipped",
+                             node=self.self_node.name, err=repr(exc))
 
     # -- node health reporting (tpu_dra/health fan-in, ISSUE 2) ------------
     def set_device_health(self, healthy: bool,
@@ -91,11 +150,23 @@ class MembershipManager:
         self.update_own_node_info()
 
     # -- status writes (computedomain.go:145-193) --------------------------
-    def update_own_node_info(self) -> None:
+    @staticmethod
+    def _stable_dict(node: TpuSliceDomainNode) -> dict:
+        d = node.to_dict()
+        for key in _VOLATILE_KEYS:
+            d.pop(key, None)
+        return d
+
+    def update_own_node_info(self, force: bool = False) -> None:
         """GET→mutate→PUT of our entry in ``status.nodes``, on the
         centralized status-write retry policy: Conflicts (racing sibling
         daemons) and transient API failures re-fetch and retry with
-        jittered backoff until the policy's deadline."""
+        jittered backoff until the policy's deadline.
+
+        Every write stamps a fresh ``lastHeartbeatTime`` (the membership
+        lease) and preserves the controller-owned ``state`` of our
+        existing entry.  ``force=True`` (the heartbeat loop) writes even
+        when nothing but the heartbeat changed."""
         def attempt() -> None:
             failpoint.hit("daemon.membership.update")
             obj = self.kube.get(TPU_SLICE_DOMAINS, self.domain_name,
@@ -103,18 +174,45 @@ class MembershipManager:
             domain = TpuSliceDomain.from_dict(obj)
             if domain.status is None:
                 domain.status = TpuSliceDomainStatus()
-            nodes = [n for n in domain.status.nodes
-                     if n.name != self.self_node.name]
-            nodes.append(self.self_node)
-            nodes.sort(key=lambda n: n.name)
-            if [n.to_dict() for n in nodes] == \
-                    [n.to_dict() for n in domain.status.nodes]:
+            mine = next((n for n in domain.status.nodes
+                         if n.name == self.self_node.name), None)
+            cur = self.self_node
+            if mine is not None:
+                state = mine.state   # controller-owned: preserve verbatim
+            elif domain.status.membership_generation > 0 or \
+                    any(n.state for n in domain.status.nodes) or \
+                    len(domain.status.active_nodes()) >= \
+                    domain.spec.num_nodes:
+                # (re-)registering into a domain whose mesh already
+                # exists — arbitrated (e.g. a preempted node returning
+                # after its Lost entry was shrunk out of status) or a
+                # complete gen-0 assembly (a spare pod starting late).
+                # Entering with the legacy "" state would read as Active
+                # and could displace a running member at the next
+                # arbitration (a lower worker id beats the incumbent's
+                # tiebreak) or a promoted spare (generation fencing must
+                # hold); enter as a standby and let the controller's
+                # next arbitration admit us explicitly if there is room.
+                state = NODE_STATE_SPARE
+            else:
+                state = ""   # initial assembly: legacy contract
+            publish = TpuSliceDomainNode(
+                name=cur.name, ip_address=cur.ip_address,
+                fabric_id=cur.fabric_id, worker_id=cur.worker_id,
+                devices_healthy=cur.devices_healthy,
+                unhealthy_devices=list(cur.unhealthy_devices),
+                last_heartbeat=now_rfc3339(), state=state)
+            if not force and mine is not None and \
+                    self._stable_dict(mine) == self._stable_dict(publish):
                 return
+            nodes = [n for n in domain.status.nodes
+                     if n.name != publish.name]
+            nodes.append(publish)
+            nodes.sort(key=lambda n: n.name)
             domain.status.nodes = nodes
             self.kube.update_status(TPU_SLICE_DOMAINS, domain.to_dict())
-            klog.info("published node info to domain status", level=2,
-                      node=self.self_node.name,
-                      ip=self.self_node.ip_address)
+            klog.info("published node info to domain status", level=4,
+                      node=publish.name, ip=publish.ip_address)
 
         try:
             retry.retry_call(attempt, policy=retry.STATUS_WRITE_POLICY,
@@ -143,14 +241,35 @@ class MembershipManager:
     def maybe_push_nodes_update(self, domain: TpuSliceDomain) -> None:
         if domain.status is None:
             return
-        nodes = domain.status.nodes
-        if len(nodes) != domain.spec.num_nodes:
-            return
-        ips = frozenset(n.ip_address for n in nodes)
+        active = domain.status.active_nodes()
+        generation = domain.status.membership_generation
+        names = frozenset(n.name for n in active)
+        key = (generation, frozenset((n.name, n.ip_address)
+                                     for n in active))
         with self._mu:
-            if ips == self._last_ips:
+            if key == self._last_pushed:
                 return
-            self._last_ips = ips
-        klog.info("full membership reached", level=2,
-                  nodes=[n.name for n in nodes])
-        self._updates.put(list(nodes))
+            if self._last_pushed is not None and \
+                    generation == self._last_pushed[0] and \
+                    names != frozenset(n for n, _ in self._last_pushed[1]) \
+                    and len(active) != domain.spec.num_nodes:
+                # same-generation MEMBERSHIP churn (members still
+                # assembling or a stale informer echo): only a COMPLETE
+                # active set forms a mesh.  Two things are different: a
+                # generation advance (the controller arbitrated —
+                # possibly a shrink below num_nodes — and its active set
+                # is authoritative), and an IP-only change of the SAME
+                # names (a member pod restarted; a shrunk mesh must
+                # re-rendezvous on the new address, not wedge on the
+                # dead one).
+                return
+            if self._last_pushed is None and \
+                    len(active) != domain.spec.num_nodes and \
+                    generation == 0:
+                return   # initial assembly, not yet complete
+            self._last_pushed = key
+        klog.info("membership changed", level=2, generation=generation,
+                  nodes=[n.name for n in active])
+        self._updates.put(MembershipUpdate(
+            nodes=list(active), generation=generation,
+            traceparent=domain.status.reconfigure_traceparent))

@@ -144,10 +144,23 @@ class WorkQueue:
         """Deep-copies ``obj`` (reference workqueue.go:46-59) and queues it.
 
         Failures re-queue with backoff forever.
+
+        Same-key COALESCING (client-go ``Add`` semantics), for
+        EXPLICITLY-keyed items only: if an item with this key is already
+        waiting — ready or in backoff — the pending item is updated to
+        the newer object instead of queueing a duplicate.  A key names a
+        level-triggered reconcile target, which only ever needs the
+        latest state; without coalescing a hot writer (e.g. N daemons
+        heartbeating into one CR's status) floods the queue faster than
+        reconciles drain it, starving every other key.  Key-less items
+        and deadline items (:meth:`enqueue_with_deadline`) are never
+        coalesced: each represents its own unit of work / completion
+        contract.
         """
         self._push(_WorkItem(callback, copy.deepcopy(obj),
                              key if key is not None else id(callback),
-                             parent=current_context()))
+                             parent=current_context()),
+                   coalesce=key is not None)
 
     def enqueue_with_deadline(
         self, callback: Callable[[Any], None], obj: Any, *,
@@ -171,10 +184,33 @@ class WorkQueue:
         self._metrics["depth"].set(
             len(self._queue) + len(self._delayed), self.name)
 
-    def _push(self, item: _WorkItem) -> None:
+    @staticmethod
+    def _coalescible(item: "_WorkItem") -> bool:
+        return item.deadline is None and item.on_error is None
+
+    def _push(self, item: _WorkItem, coalesce: bool = False) -> None:
         with self._cv:
             if self._shutdown:
                 raise RuntimeError(f"workqueue {self.name} is shut down")
+            if coalesce and self._coalescible(item):
+                for pending in self._queue:
+                    if pending.key == item.key and \
+                            self._coalescible(pending):
+                        # newest object wins; the original enqueue
+                        # instant is kept so queue-duration stays honest
+                        pending.callback = item.callback
+                        pending.obj = item.obj
+                        pending.parent = item.parent
+                        return
+                for delayed in self._delayed:
+                    if delayed.item.key == item.key and \
+                            self._coalescible(delayed.item):
+                        # in backoff: refresh the payload, keep the
+                        # schedule — the retry will see the latest state
+                        delayed.item.callback = item.callback
+                        delayed.item.obj = item.obj
+                        delayed.item.parent = item.parent
+                        return
             item.ready_since = time.monotonic()
             self._queue.append(item)
             self._update_depth()

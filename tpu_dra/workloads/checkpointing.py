@@ -18,9 +18,50 @@ from __future__ import annotations
 
 import contextlib
 import os
+import shutil
 from typing import Any
 
 import jax
+
+# orbax commits a step directory by writing this marker as the LAST file
+# before the atomic tmp->final rename; a bare numeric step directory
+# without it is a crash artifact (non-atomic filesystem, or a writer
+# killed between mkdir and commit) that must never be selected as
+# "latest" — restoring it fails after the preemption already happened
+_COMMIT_MARKER = "_CHECKPOINT_METADATA"
+# orbax in-flight staging directories ("<step>.orbax-checkpoint-tmp-<ts>")
+_TMP_MARKER = ".orbax-checkpoint-tmp"
+
+
+def _complete_steps(directory: str, *, clean: bool = False) -> list[int]:
+    """Sorted step numbers whose directories carry the commit marker.
+
+    With ``clean=True``, bare numeric step directories *without* the
+    marker are removed.  Cleaning is a SAVE-path privilege: on a
+    non-atomic store (GCS/fuse) an unmarked directory is
+    indistinguishable from another writer's save-in-progress, so
+    readers (``latest_step`` / ``restore_train_state``) only ever SKIP
+    unmarked directories, and the next saver — which owns the directory
+    by the single-writer contract — sweeps the wreckage before writing.
+    In-flight orbax tmp directories are skipped but never touched
+    either way; orbax garbage-collects its own leftovers on the next
+    manager open.
+    """
+    steps: list[int] = []
+    try:
+        entries = os.listdir(directory)
+    except FileNotFoundError:
+        return steps
+    for entry in entries:
+        path = os.path.join(directory, entry)
+        if _TMP_MARKER in entry or not os.path.isdir(path) or \
+                not entry.isdigit():
+            continue
+        if os.path.exists(os.path.join(path, _COMMIT_MARKER)):
+            steps.append(int(entry))
+        elif clean:
+            shutil.rmtree(path, ignore_errors=True)
+    return sorted(steps)
 
 
 @contextlib.contextmanager
@@ -49,17 +90,26 @@ def save_train_state(directory: str, step: int, params: Any,
     state = {"params": params}
     if extra is not None:
         state["extra"] = extra
+    # sweep crash artifacts (uncommitted step dirs) before writing: the
+    # saver owns the directory, and a bare leftover of an interrupted
+    # save at this step number would fail or shadow the new one
+    if os.path.isdir(directory):
+        _complete_steps(directory, clean=True)
     with _manager(directory, max_to_keep, create=True) as mgr:
         mgr.save(step, args=ocp.args.StandardSave(state))
         mgr.wait_until_finished()
 
 
 def latest_step(directory: str) -> int | None:
-    """Newest checkpoint step in ``directory``, or None if empty/missing."""
+    """Newest COMMITTED checkpoint step in ``directory``, or None if
+    empty/missing.  Incomplete step directories (crash mid-save) are
+    never selected — a resume after preemption must land on a
+    restorable step, not the wreckage of the save the preemption
+    interrupted.  Read-only: cleanup belongs to the saver."""
     if not os.path.isdir(directory):
         return None
-    with _manager(directory, create=False) as mgr:
-        return mgr.latest_step()
+    steps = _complete_steps(directory)
+    return steps[-1] if steps else None
 
 
 def save_serving_state(directory: str, params: Any,
@@ -118,8 +168,9 @@ def restore_train_state(directory: str, *, step: int | None = None,
     if not os.path.isdir(directory):
         # read path: never mkdir a typo'd directory as a side effect
         raise FileNotFoundError(f"no checkpoints under {directory}")
+    complete = _complete_steps(directory)
     with _manager(directory, create=False) as mgr:
-        step = mgr.latest_step() if step is None else step
+        step = (complete[-1] if complete else None) if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
         if template is not None:
@@ -127,4 +178,8 @@ def restore_train_state(directory: str, *, step: int | None = None,
                 lambda x: ocp.utils.to_shape_dtype_struct(x)
                 if hasattr(x, "shape") else x, template)
             return mgr.restore(step, args=ocp.args.StandardRestore(tmpl))
-        return mgr.restore(step)
+        # explicit StandardRestore (no template): a bare mgr.restore()
+        # can only infer the handler when THIS process already saved —
+        # a freshly-respawned elastic worker restoring someone else's
+        # checkpoint has no such registration
+        return mgr.restore(step, args=ocp.args.StandardRestore())

@@ -36,6 +36,10 @@ class RendezvousInfo:
     num_processes: int
     process_id: int
     domain_uid: str = ""
+    # membership generation the coordination config was derived from
+    # (elastic domains): 0 for legacy configs.  The elastic supervisor
+    # (workloads/elastic.py) fences re-initialization on it.
+    generation: int = 0
     # multislice (DCN) rendezvous: set when the domain spans >1 ICI
     # partition.  slice_id/num_slices mirror MEGASCALE_SLICE_ID /
     # MEGASCALE_NUM_SLICES; megascale_coordinator is the slice-0 rank-0
@@ -450,6 +454,10 @@ def _info_from_config(data: dict, my_ip: str,
     if pid < 0:
         return None
     info = RendezvousInfo(coordinator, len(nodes), pid)
+    try:
+        info.generation = int(data.get("generation", 0))
+    except (TypeError, ValueError):
+        info.generation = 0
     ms = data.get("multislice")
     if ms:
         info.num_slices = int(ms.get("numSlices", 1))
@@ -460,33 +468,59 @@ def _info_from_config(data: dict, my_ip: str,
     return info
 
 
-def _from_settings_dir(settings_dir: str, my_ip: str,
-                       env: Optional[dict] = None
-                       ) -> Optional[RendezvousInfo]:
-    path = os.path.join(settings_dir, "nodes_config.json")
+def _read_config_file(path: str) -> Optional[dict]:
     try:
         with open(path) as f:
             data = json.load(f)
     except (FileNotFoundError, json.JSONDecodeError):
         return None
-    return _info_from_config(data, my_ip, env)
+    return data if data.get("nodes") else None
+
+
+def _fetch_config_http(port: int) -> Optional[dict]:
+    try:
+        # /nodes returns the full nodes config (both the native coordd,
+        # which serves the file verbatim, and the Python coordservice) —
+        # rank order, generation, and the multislice block come from
+        # there, so this path and the settings-dir path resolve
+        # identically
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nodes", timeout=5).read())
+    # HTTPException (e.g. IncompleteRead mid-body) is not an OSError
+    except (OSError, ValueError, http.client.HTTPException):
+        return None   # unreachable / non-JSON: caller falls back / errors
+    return data if data.get("nodes") else None
+
+
+def load_nodes_config(env: Optional[dict] = None) -> Optional[dict]:
+    """The raw coordination config dict from the driver-injected env —
+    mounted settings dir first, then the node-local coordination
+    service.  THE resolution chain: :func:`resolve` and the elastic
+    supervisor (``workloads/elastic.py``) both consume this, so any
+    change to the contract (env names, defaults, fallbacks) lands in
+    one place."""
+    e = os.environ if env is None else env
+    settings = e.get("SLICE_SETTINGS_DIR", "/etc/tpu-slice")
+    data = _read_config_file(os.path.join(settings, "nodes_config.json"))
+    if data is None:
+        data = _fetch_config_http(
+            int(e.get("SLICE_COORDINATOR_PORT", "51000")))
+    return data
+
+
+def _from_settings_dir(settings_dir: str, my_ip: str,
+                       env: Optional[dict] = None
+                       ) -> Optional[RendezvousInfo]:
+    data = _read_config_file(
+        os.path.join(settings_dir, "nodes_config.json"))
+    return None if data is None else _info_from_config(data, my_ip, env)
 
 
 def _from_coordservice(port: int, my_ip: str,
                        env: Optional[dict] = None
                        ) -> Optional[RendezvousInfo]:
-    base = f"http://127.0.0.1:{port}"
-    try:
-        # /nodes returns the full nodes config (both the native coordd,
-        # which serves the file verbatim, and the Python coordservice) —
-        # rank order and the multislice block come from there, so this
-        # path and the settings-dir path resolve identically
-        data = json.loads(urllib.request.urlopen(
-            f"{base}/nodes", timeout=5).read())
-    # HTTPException (e.g. IncompleteRead mid-body) is not an OSError
-    except (OSError, ValueError, http.client.HTTPException):
-        return None   # unreachable / non-JSON: caller falls back / errors
-    return _info_from_config(data, my_ip, env)
+    data = _fetch_config_http(port)
+    return None if data is None else _info_from_config(data, my_ip, env)
 
 
 def resolve(env: Optional[dict[str, str]] = None) -> RendezvousInfo:
