@@ -132,6 +132,31 @@ def bench_fs_floor(base: str) -> dict:
     return {"floor_per_prepare_ms": probe.p50_ms()}
 
 
+def bench_observe_idle(n: int = 50_000, repeats: int = 3) -> dict:
+    """ISSUE 8 idle-exemplar gate: ``Histogram.observe()`` with tracing
+    UNSAMPLED (ratio 0 — the production idle default, current span the
+    shared no-op) must stay lock-free and allocation-free: the exemplar
+    lookup is two pointer compares, never a dict build.  A regression
+    here (an accidental lock, a per-observe exemplar allocation) lands
+    on every prepare and every serve request.  Best-of-``repeats`` so a
+    scheduler preemption mid-loop cannot inflate the number."""
+    from tpu_dra.trace import get_tracer
+    from tpu_dra.util.metrics import Registry
+
+    trace_configure(service="bench-prepare", sample_ratio=0.0)
+    h = Registry().histogram("bench_observe_seconds",
+                             "idle observe probe", labels=("l",))
+    best = float("inf")
+    with get_tracer().start_span("idle"):   # the shared NoopSpan
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                h.observe(0.0042, "x")
+            best = min(best, time.perf_counter() - t0)
+    trace_configure(service="bench-prepare", sample_ratio=1.0)
+    return {"n": n, "per_observe_us": round(best / n * 1e6, 4)}
+
+
 def bench_cpu_probe() -> float:
     """p90 of a fixed CPU-bound unit (json round-trip of a prepare-sized
     payload, no I/O): the second arming condition for the absolute gate.
@@ -321,6 +346,7 @@ def run_all() -> dict:
         "workdir": base,
         "fs": bench_fs_floor(base),
         "cpu_probe_p90_ms": bench_cpu_probe(),
+        "observe_idle": bench_observe_idle(),
         "direct": bench_direct(base),
         "concurrent": bench_concurrent(base),
     }
@@ -356,6 +382,8 @@ def _gates(report: dict) -> dict[str, float]:
             report["grpc"]["warm"]["overhead_p50_ms"],
         "flushes_per_mutation":
             report["concurrent"]["flushes_per_mutation"],
+        "histogram_observe_idle_us":
+            report["observe_idle"]["per_observe_us"],
     }
 
 
@@ -419,9 +447,14 @@ def write_budget(report: dict, path: str, headroom: float = 1.6) -> None:
                    "see docs/performance.md)",
         "gates": {
             # ratio metrics are capped at their arithmetic bound; time
-            # metrics get jitter headroom over this run's measurement
+            # metrics get jitter headroom over this run's measurement;
+            # microsecond-scale microbench gates get a 2us floor — they
+            # exist to catch a lock/allocation landing on the idle path
+            # (a >=5us cliff), not 0.2us of scheduler weather
             name: (min(round(max(value, 0.02) * headroom, 3), 1.0)
                    if name == "flushes_per_mutation"
+                   else round(max(value * headroom, 2.0), 3)
+                   if name.endswith("_us")
                    else round(max(value, 0.02) * headroom, 3))
             for name, value in _gates(report).items()},
         "absolute": {

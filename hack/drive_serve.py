@@ -1,0 +1,478 @@
+"""Serving SLO drive: scripted QPS against the REAL serve binary with
+latency gates, exemplar↔trace round-trip, and goodput-across-
+reconfiguration proof (``make drive-serve``, docs/observability.md).
+
+Phase 1 — serving SLOs (the data-plane half of ISSUE 8):
+  a tiny checkpoint is trained/saved, then ``python -m
+  tpu_dra.workloads.serve --continuous`` serves it as a REAL subprocess.
+  A load generator sustains a scripted QPS schedule with per-tenant
+  ``X-Tenant`` headers.  Asserted:
+  - every response 200 and client-side p99 latency under the gate
+    (post-warmup — the first request legitimately pays JIT compile);
+  - achieved throughput within 80% of the scripted schedule;
+  - /metrics carries per-tenant request + TTFT + inter-token
+    histograms, still answers plain 0.0.4 text to a legacy scraper,
+    and upgrades to OpenMetrics (exemplars + ``# EOF``) when the
+    client Accepts it;
+  - at least one histogram exemplar's trace_id RESOLVES in
+    /debug/traces on the same process (the metric→trace jump);
+  - the deprecated engine p50/p95 gauges are still exported (one
+    release of dashboard compatibility) alongside the histograms;
+  - /debug/slo reports zero availability burn and a live latency
+    objective.
+
+Phase 2 — goodput across a forced reconfiguration:
+  a real elastic supervisor (``workloads/elastic.run_elastic``, goodput
+  tracker attached) spawns a real worker subprocess (``--worker`` mode
+  of this file) that accrues productive-step time through the
+  ``TPU_GOODPUT_FILE`` ledger.  The drive then plays controller: the
+  worker is told to die mid-run, its node is dropped from the
+  coordination config, and ~0.8s later the config returns at
+  generation 2 with a fresh recovery traceparent.  Asserted:
+  - the supervisor records EXACTLY the park time as ``reconfiguration``
+    downtime, stamped with the generation-2 traceparent;
+  - the downtime histogram's exemplar carries the recovery trace id,
+    and that id resolves on the supervisor's /debug/traces endpoint;
+  - the merged ledger (worker steps + supervisor downtime) yields a
+    goodput ratio at or above the floor.
+"""
+
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# phase 1 gates
+P99_GATE_S = 2.0            # post-warmup client-side p99 (CPU jax, tiny model)
+QPS_SCHEDULE = ((6, 3.0), (12, 3.0))   # (target qps, seconds) steps
+QPS_FLOOR = 0.8             # achieved/target
+# phase 2 gates
+GOODPUT_FLOOR = 0.5         # step seconds / wall seconds, merged ledger
+DOWNTIME_MIN_S = 0.5        # the drive parks the worker for ~0.8s
+
+MODEL_FLAGS = ["--vocab", "64", "--d-model", "32", "--n-heads", "2",
+               "--n-layers", "2", "--d-ff", "64", "--max-seq", "64"]
+
+
+def log(msg: str) -> None:
+    print(f"[drive-serve] {msg}", flush=True)
+
+
+def die(msg: str) -> None:
+    print(f"[drive-serve] FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+def free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_until(pred, timeout=60.0, step=0.1, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        val = pred()
+        if val:
+            return val
+        time.sleep(step)
+    die(f"timeout waiting for {what}")
+
+
+def http_get(url: str, accept: str = "", timeout: float = 10.0):
+    req = urllib.request.Request(
+        url, headers={"Accept": accept} if accept else {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), \
+            resp.read().decode()
+
+
+# --------------------------------------------------------------------------
+# worker mode (phase 2): the elastic train stand-in the supervisor spawns
+# --------------------------------------------------------------------------
+
+
+def worker_main() -> int:
+    """Accrue goodput step time through the env-injected ledger; on the
+    first run, signal the drive (marker) and exit EXIT_RECONFIGURED so
+    the supervisor observes a real worker death; on the second, finish
+    clean."""
+    from tpu_dra.workloads import goodput
+    from tpu_dra.workloads.elastic import EXIT_RECONFIGURED
+
+    tracker = goodput.start_from_env()
+    assert tracker is not None, "TPU_GOODPUT_FILE not injected"
+    marker = os.environ["DRIVE_SERVE_MARKER"]
+    first_run = not os.path.exists(marker)
+    for _ in range(6):
+        with goodput.measure(goodput.SEG_STEP):
+            time.sleep(0.3)
+    if first_run:
+        # signal the drive's controller BEFORE dying, then linger long
+        # enough for it to drop this node from the config — so the
+        # supervisor observes a real park (measurable downtime), not an
+        # instant respawn
+        open(marker, "w").write(str(os.getpid()))
+        time.sleep(1.0)
+        tracker.stop()
+        return EXIT_RECONFIGURED
+    tracker.stop()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# phase 1: serving SLOs against the real binary
+# --------------------------------------------------------------------------
+
+
+def make_checkpoint(base: str) -> str:
+    """Train-state checkpoint for the serve binary, written by a clean
+    child process so the drive itself keeps jax/orbax out of its own
+    interpreter (same discipline as drive_preempt)."""
+    ckpt = os.path.join(base, "ckpt")
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "from tpu_dra.workloads.train import ModelConfig, init_params\n"
+        "from tpu_dra.workloads.checkpointing import save_train_state\n"
+        "cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,"
+        " d_ff=64, max_seq=64, pos_emb='rope')\n"
+        f"save_train_state({ckpt!r}, 1,"
+        " init_params(cfg, jax.random.PRNGKey(0)))\n")
+    subprocess.run([sys.executable, "-c", script], check=True,
+                   timeout=300)
+    return ckpt
+
+
+class LoadResult:
+    def __init__(self):
+        self.latencies: list[float] = []
+        self.errors: list[str] = []
+        self.sent = 0
+        self.mu = threading.Lock()
+
+
+def run_load(base_url: str, schedule=QPS_SCHEDULE) -> LoadResult:
+    """Open-loop scripted load: one pacing thread enqueues request
+    threads at the scheduled rate (an open loop, so a slow server shows
+    up as latency, not as a silently lower offered rate)."""
+    result = LoadResult()
+    tenants = ("alpha", "beta")
+    threads: list[threading.Thread] = []
+
+    def one(i: int) -> None:
+        body = json.dumps({"tokens": [[(i % 60) + 1, 2, 3]],
+                           "steps": 4}).encode()
+        req = urllib.request.Request(
+            f"{base_url}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": tenants[i % len(tenants)]})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+                code = resp.status
+        except urllib.error.HTTPError as exc:
+            code = exc.code
+        except Exception as exc:  # noqa: BLE001 — recorded and gated
+            with result.mu:
+                result.errors.append(repr(exc))
+            return
+        lat = time.perf_counter() - t0
+        with result.mu:
+            result.latencies.append(lat)
+            if code != 200:
+                result.errors.append(f"HTTP {code}")
+
+    i = 0
+    for qps, secs in schedule:
+        interval = 1.0 / qps
+        t_next = time.perf_counter()
+        t_end = t_next + secs
+        while time.perf_counter() < t_end:
+            t = threading.Thread(target=one, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+            result.sent += 1
+            i += 1
+            t_next += interval
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+    for t in threads:
+        t.join(timeout=90)
+    return result
+
+
+def phase_serving(base: str) -> None:
+    ckpt = make_checkpoint(base)
+    port = free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TRACE_SAMPLE_RATIO="1.0")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.workloads.serve",
+         "--checkpoint-dir", ckpt, "--host", "127.0.0.1",
+         "--port", str(port), "--pos-emb", "rope", *MODEL_FLAGS,
+         "--continuous", "--slots", "8", "--chunk", "2",
+         "--slo-latency-threshold", "2.5"],
+        env=env, cwd=REPO)
+    base_url = f"http://127.0.0.1:{port}"
+    try:
+        def up():
+            try:
+                return http_get(f"{base_url}/healthz")[0] == 200
+            except OSError:
+                return False
+        wait_until(up, timeout=180, what="serve /healthz")
+        log("serve binary up; warming the engine bucket")
+        t0 = time.perf_counter()
+        run_load(base_url, schedule=((2, 1.0),))    # compile happens here
+        log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
+        log(f"running scripted QPS schedule {QPS_SCHEDULE}")
+        t0 = time.perf_counter()
+        result = run_load(base_url)
+        wall = time.perf_counter() - t0
+        if result.errors:
+            die(f"{len(result.errors)} request errors, first: "
+                f"{result.errors[0]}")
+        achieved = len(result.latencies) / wall
+        offered = result.sent / wall
+        lats = sorted(result.latencies)
+        p50 = statistics.median(lats)
+        p99 = lats[int(0.99 * (len(lats) - 1))]
+        log(f"load done: {len(lats)} ok in {wall:.1f}s "
+            f"(offered {offered:.1f}/s, completed {achieved:.1f}/s), "
+            f"p50 {p50 * 1e3:.0f}ms p99 {p99 * 1e3:.0f}ms")
+        if p99 > P99_GATE_S:
+            die(f"p99 {p99:.3f}s exceeds the {P99_GATE_S}s gate")
+        if achieved < QPS_FLOOR * offered:
+            die(f"completed rate {achieved:.1f}/s under {QPS_FLOOR:.0%} "
+                f"of offered {offered:.1f}/s")
+
+        # -- exposition contract ---------------------------------------
+        _, ctype, plain = http_get(f"{base_url}/metrics")
+        if not ctype.startswith("text/plain"):
+            die(f"plain scrape got content-type {ctype}")
+        if "# {" in plain or "# EOF" in plain:
+            die("exemplar syntax leaked into the 0.0.4 exposition")
+        for needle in (
+                'tpu_serve_requests_total{path="/generate",code="200",'
+                'tenant="alpha"}',
+                'tpu_serve_request_seconds_bucket{path="/generate",'
+                'tenant="beta"',
+                'tpu_serve_ttft_seconds_bucket{tenant="alpha"',
+                'tpu_serve_inter_token_seconds_bucket{tenant="beta"',
+                "tpu_serve_engine_request_p50_seconds",   # deprecated,
+                "tpu_serve_engine_request_p95_seconds"):  # still emitted
+            if needle not in plain:
+                die(f"/metrics missing {needle!r}")
+        _, ctype, om = http_get(f"{base_url}/metrics",
+                                accept="application/openmetrics-text")
+        if not ctype.startswith("application/openmetrics-text"):
+            die(f"openmetrics scrape got content-type {ctype}")
+        if not om.endswith("# EOF\n"):
+            die("openmetrics exposition missing # EOF terminator")
+        ex = re.search(
+            r'tpu_serve_request_seconds_bucket\{[^}]*\} \d+ '
+            r'# \{trace_id="([0-9a-f]{32})"\}', om)
+        if ex is None:
+            die("no trace_id exemplar on tpu_serve_request_seconds")
+        trace_id = ex.group(1)
+
+        # -- exemplar -> trace round trip ------------------------------
+        _, _, traces = http_get(
+            f"{base_url}/debug/traces?trace_id={trace_id}")
+        events = json.loads(traces)["traceEvents"]
+        names = {e.get("name") for e in events}
+        if "serve.request" not in names:
+            die(f"exemplar trace {trace_id} did not resolve to a "
+                f"serve.request span in /debug/traces (got {names})")
+        log(f"exemplar trace {trace_id[:8]}… resolves to "
+            f"{len(events)} trace events")
+
+        # -- /debug/slo ------------------------------------------------
+        _, _, slo_raw = http_get(f"{base_url}/debug/slo")
+        slo = json.loads(slo_raw)
+        avail = slo["objectives"]["availability"]
+        if avail["lifetime"]["bad"] != 0:
+            die(f"availability SLO saw 5xx: {avail['lifetime']}")
+        for win in avail["windows"].values():
+            if win["burn_rate"] != 0.0:
+                die(f"availability burn rate nonzero: {win}")
+        lat_obj = slo["objectives"]["latency"]
+        if lat_obj["lifetime"]["total"] < len(lats):
+            die(f"latency objective saw {lat_obj['lifetime']['total']} "
+                f"requests, load sent {len(lats)}")
+        log(f"/debug/slo: availability burn 0.0 across "
+            f"{list(avail['windows'])}, latency objective over "
+            f"{lat_obj['lifetime']['total']:.0f} requests "
+            f"(error rate {lat_obj['lifetime']['error_rate']})")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    log("phase 1 (serving SLOs) OK")
+
+
+# --------------------------------------------------------------------------
+# phase 2: goodput across a forced reconfiguration
+# --------------------------------------------------------------------------
+
+
+def phase_goodput(base: str) -> None:
+    from tpu_dra.trace.span import SpanContext
+    from tpu_dra.util.metrics import DEFAULT_REGISTRY, serve_http_endpoint
+    from tpu_dra.workloads.elastic import run_elastic
+    from tpu_dra.workloads.goodput import (
+        SEG_RECONFIGURATION,
+        SEG_STEP,
+        GoodputTracker,
+    )
+
+    settings = os.path.join(base, "settings")
+    os.makedirs(settings)
+    cfg_path = os.path.join(settings, "nodes_config.json")
+    my_ip = "10.77.0.1"
+    gen1_tp = "00-" + "1a" * 16 + "-" + "2b" * 8 + "-01"
+    gen2_tp = "00-" + "3c" * 16 + "-" + "4d" * 8 + "-01"
+
+    def write_cfg(nodes, generation, traceparent):
+        with open(cfg_path + ".tmp", "w") as f:
+            json.dump({"nodes": nodes, "generation": generation,
+                       "traceparent": traceparent}, f)
+        os.replace(cfg_path + ".tmp", cfg_path)
+
+    write_cfg([{"name": "n0", "ipAddress": my_ip}], 1, gen1_tp)
+    marker = os.path.join(base, "marker")
+    state = os.path.join(base, "goodput.json")
+    tracker = GoodputTracker(registry=DEFAULT_REGISTRY,
+                             state_path=state)
+
+    # the drive's "controller": when the worker signals (marker), drop
+    # its node from the config — the worker lingers ~1s after the
+    # signal, so the drop is visible before the supervisor re-resolves
+    # membership and it must PARK — then, only once the worker process
+    # is actually DEAD (pid from the marker), park it for park_s more
+    # and readmit at generation 2 with the recovery traceparent.
+    # Keying the readmission on process death (not a wall-clock guess)
+    # keeps the measured downtime >= park_s however slowly the worker
+    # tears down on a loaded host.
+    park_s = 1.2
+
+    def controller():
+        wait_until(lambda: os.path.exists(marker), timeout=60,
+                   what="worker death marker")
+        write_cfg([{"name": "n1", "ipAddress": "10.77.0.9"}], 1, gen1_tp)
+        pid = int(open(marker).read())
+
+        def worker_dead():
+            try:
+                os.kill(pid, 0)
+                return False
+            except OSError:
+                return True
+        wait_until(worker_dead, timeout=60, what="worker process exit")
+        time.sleep(park_s)
+        write_cfg([{"name": "n0", "ipAddress": my_ip}], 2, gen2_tp)
+        log("controller: node readmitted at generation 2")
+
+    ctl = threading.Thread(target=controller, daemon=True)
+    ctl.start()
+    env = dict(os.environ, SLICE_SETTINGS_DIR=settings, POD_IP=my_ip,
+               DRIVE_SERVE_MARKER=marker, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    rc = run_elastic([sys.executable, os.path.abspath(__file__),
+                      "--worker"],
+                     env=env, poll=0.05, member_timeout=60.0,
+                     goodput_tracker=tracker)
+    wall = time.monotonic() - t0
+    ctl.join(timeout=10)
+    if rc != 0:
+        die(f"elastic supervisor returned {rc}")
+
+    report = tracker.report()
+    log(f"goodput report after {wall:.1f}s wall: "
+        f"{json.dumps(report['totals'])} ratio "
+        f"{report['goodput_ratio']}")
+    recs = report["reconfigurations"]
+    if len(recs) != 1:
+        die(f"expected 1 reconfiguration record, got {recs}")
+    if recs[0]["generation"] != 2 or recs[0]["traceparent"] != gen2_tp:
+        die(f"downtime not stamped with the recovery epoch: {recs[0]}")
+    down = report["totals"].get(SEG_RECONFIGURATION, 0.0)
+    if not DOWNTIME_MIN_S <= down <= wall:
+        die(f"reconfiguration downtime {down:.2f}s outside "
+            f"[{DOWNTIME_MIN_S}, {wall:.1f}]s (parked {park_s}s)")
+    if report["totals"].get(SEG_STEP, 0.0) < 3.0:
+        die(f"worker step time missing from the merged ledger: "
+            f"{report['totals']}")
+    if report["goodput_ratio"] < GOODPUT_FLOOR:
+        die(f"goodput ratio {report['goodput_ratio']} under the "
+            f"{GOODPUT_FLOOR} floor")
+
+    # the supervisor's own observability endpoint: downtime exemplar on
+    # /metrics, recovery trace resolvable on /debug/traces
+    srv = serve_http_endpoint("127.0.0.1", 0)
+    try:
+        port = srv.server_address[1]
+        _, ctype, om = http_get(
+            f"http://127.0.0.1:{port}/metrics",
+            accept="application/openmetrics-text")
+        if not ctype.startswith("application/openmetrics-text"):
+            die(f"supervisor /metrics negotiation failed: {ctype}")
+        rec_tid = SpanContext.from_traceparent(gen2_tp).trace_id
+        if f'segment="{SEG_RECONFIGURATION}"' not in om:
+            die("tpu_goodput_seconds_total missing the reconfiguration "
+                "segment")
+        if not re.search(
+                r'tpu_goodput_downtime_seconds_bucket\{[^}]*\} \d+ '
+                r'# \{trace_id="' + rec_tid + r'"\}', om):
+            die("downtime histogram exemplar does not carry the "
+                "recovery trace id")
+        _, _, traces = http_get(
+            f"http://127.0.0.1:{port}/debug/traces?trace_id={rec_tid}")
+        names = {e.get("name")
+                 for e in json.loads(traces)["traceEvents"]}
+        if "goodput.reconfiguration_downtime" not in names:
+            die(f"recovery trace {rec_tid} has no downtime span "
+                f"({names})")
+    finally:
+        srv.shutdown()
+    log(f"phase 2 (goodput) OK: downtime {down:.2f}s attributed to "
+        f"'{SEG_RECONFIGURATION}' with recovery trace "
+        f"{gen2_tp.split('-')[1][:8]}…, ratio "
+        f"{report['goodput_ratio']} >= {GOODPUT_FLOOR}")
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        return worker_main()
+    base = tempfile.mkdtemp(prefix="drive-serve-")
+    log(f"workdir {base}")
+    phase_serving(os.path.join(base, "p1"))
+    phase_goodput(os.path.join(base, "p2"))
+    log("OK: serving SLO gates + exemplar round-trip + goodput "
+        "reconfiguration accounting all passed")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--worker" not in sys.argv:
+        os.makedirs("/tmp", exist_ok=True)
+    sys.exit(main())

@@ -16,11 +16,13 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
-            grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03):
+            grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
+            observe_us=0.8):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
         "cpu_probe_p90_ms": cpu,
+        "observe_idle": {"n": 50000, "per_observe_us": observe_us},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
                      "overhead_p50_ms": direct_warm_oh},
@@ -42,6 +44,7 @@ def _budget(**overrides):
             "direct_idle_overhead_p50_ms": 0.8,
             "grpc_warm_overhead_p50_ms": 4.0,
             "flushes_per_mutation": 1.0,
+            "histogram_observe_idle_us": 2.5,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
                      "fs_floor_ceiling_ms": 0.4,
@@ -108,6 +111,14 @@ def test_flushes_per_mutation_gate():
         _report(flushes=1.4),        # >1 = barrier writing more than once
         _budget())
     assert any("flushes_per_mutation" in v for v in violations)
+
+
+def test_idle_observe_gate():
+    """ISSUE 8: a lock or per-call exemplar allocation landing on the
+    unsampled Histogram.observe path must fail the ratchet."""
+    violations = bench_prepare.gate(_report(observe_us=6.0), _budget())
+    assert any("histogram_observe_idle_us" in v for v in violations)
+    assert bench_prepare.gate(_report(observe_us=0.4), _budget()) == []
 
 
 def test_write_budget_round_trips_and_caps_ratios(tmp_path):

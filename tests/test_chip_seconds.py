@@ -1,0 +1,156 @@
+"""Chip-seconds utilization accounting (plugins/tpu/utilization.py,
+ISSUE 8)."""
+
+import os
+
+import pytest
+
+from tpu_dra.health.state import HEALTHY, UNHEALTHY
+from tpu_dra.plugins.tpu.utilization import ChipSecondsAccountant
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+pytestmark = pytest.mark.core
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _accountant(tmp_path, clock, pinned=None, states=None,
+                chips=("chip-0", "chip-1")):
+    return ChipSecondsAccountant(
+        chips_fn=lambda: list(chips),
+        pinned_fn=lambda: dict(pinned or {}),
+        state_of=(lambda uuid: (states or {}).get(uuid, HEALTHY)),
+        heartbeat_dir=str(tmp_path),
+        active_stale_after=60.0,
+        clock=clock)
+
+
+def test_idle_chips_accrue_idle(tmp_path):
+    clock = FakeClock()
+    acc = _accountant(tmp_path, clock)
+    acc.tick()                 # epoch only
+    clock.t += 10.0
+    acc.tick()
+    assert acc.report()["totals_s"]["idle"] == pytest.approx(20.0)
+
+
+def test_allocated_vs_active_by_heartbeat(tmp_path):
+    clock = FakeClock()
+    pinned = {"chip-0": ["claim-a"], "chip-1": ["claim-b"]}
+    acc = _accountant(tmp_path, clock, pinned=pinned)
+    # claim-a beats (fresh mtime = now); claim-b never wrote one
+    beat = tmp_path / "claim-a"
+    beat.mkdir()
+    (beat / "beat").write_text("1")
+    acc.tick()
+    clock.t += 10.0
+    acc.tick()
+    totals = acc.report()["totals_s"]
+    assert totals["active"] == pytest.approx(10.0)
+    assert totals["allocated"] == pytest.approx(10.0)
+    assert totals["idle"] == 0.0
+    per = acc.report()["per_claim"]
+    assert per["claim-a"]["active_s"] == pytest.approx(10.0)
+    assert per["claim-a"]["allocated_s"] == pytest.approx(10.0)
+    assert per["claim-b"]["active_s"] == 0.0
+    assert per["claim-b"]["allocated_s"] == pytest.approx(10.0)
+
+
+def test_stale_heartbeat_demotes_to_allocated(tmp_path):
+    clock = FakeClock()
+    acc = _accountant(tmp_path, clock, pinned={"chip-0": ["claim-a"]},
+                      chips=("chip-0",))
+    beat = tmp_path / "claim-a"
+    beat.mkdir()
+    path = beat / "beat"
+    path.write_text("1")
+    os.utime(path, (1.0, 1.0))       # mtime in 1970: long stale
+    acc.tick()
+    clock.t += 5.0
+    acc.tick()
+    totals = acc.report()["totals_s"]
+    assert totals["allocated"] == pytest.approx(5.0)
+    assert totals["active"] == 0.0
+
+
+def test_unhealthy_wins_over_allocation(tmp_path):
+    clock = FakeClock()
+    acc = _accountant(tmp_path, clock,
+                      pinned={"chip-0": ["claim-a"]},
+                      states={"chip-0": UNHEALTHY},
+                      chips=("chip-0",))
+    acc.tick()
+    clock.t += 7.0
+    acc.tick()
+    totals = acc.report()["totals_s"]
+    assert totals["unhealthy"] == pytest.approx(7.0)
+    assert totals["allocated"] == 0.0
+    # unhealthy time is excluded from the utilization denominator
+    assert acc.report()["per_claim"] == {}
+
+
+def test_fleet_metric_and_ratio_exported(tmp_path):
+    clock = FakeClock()
+    pinned = {"chip-0": ["claim-a"]}
+    acc = _accountant(tmp_path, clock, pinned=pinned,
+                      chips=("chip-0", "chip-1"))
+    beat = tmp_path / "claim-a"
+    beat.mkdir()
+    (beat / "beat").write_text("1")
+    from tpu_dra.plugins.tpu.utilization import _metrics
+    before = _metrics()["chip_seconds"].value("active")
+    acc.tick()
+    clock.t += 4.0
+    acc.tick()
+    text = DEFAULT_REGISTRY.expose()
+    assert 'tpu_dra_chip_seconds_total{state="active"}' in text
+    assert "tpu_dra_chip_utilization_ratio" in text
+    after = _metrics()["chip_seconds"].value("active")
+    assert after - before == pytest.approx(4.0)
+
+
+def test_per_claim_entries_bounded_by_eviction(tmp_path):
+    """Claim churn on a long-lived plugin: once past the cap, unpinned
+    claims' entries evict oldest-first; pinned claims always survive."""
+    clock = FakeClock()
+    pinned = {"chip-0": ["live-claim"]}
+    acc = _accountant(tmp_path, clock, pinned=pinned, chips=("chip-0",))
+    acc.tick()
+    cap = ChipSecondsAccountant.MAX_CLAIM_ENTRIES
+    # simulate historical churn: pre-seed dead claims beyond the cap
+    for i in range(cap + 50):
+        acc._per_claim[f"dead-{i}"] = {"allocated_s": 1.0,
+                                       "active_s": 0.0}
+    clock.t += 1.0
+    acc.tick()
+    assert len(acc._per_claim) <= cap
+    assert "live-claim" in acc._per_claim       # pinned never evicted
+    assert "dead-0" not in acc._per_claim       # oldest went first
+
+
+def test_tick_never_raises(tmp_path):
+    clock = FakeClock()
+    acc = ChipSecondsAccountant(
+        chips_fn=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+        pinned_fn=dict, state_of=None, heartbeat_dir=str(tmp_path),
+        clock=clock)
+    acc.tick()                        # poll listener: must not raise
+    clock.t += 1.0
+    acc.tick()
+
+
+def test_driver_wires_accountant():
+    """TpuDriver registers the accountant as a health poll listener and
+    points it at the real heartbeat dir."""
+    import inspect
+
+    from tpu_dra.plugins.tpu.driver import TpuDriver
+    src = inspect.getsource(TpuDriver.__init__)
+    assert "ChipSecondsAccountant" in src
+    assert "add_poll_listener(self.utilization.tick)" in src

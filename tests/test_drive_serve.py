@@ -1,0 +1,24 @@
+"""The full serving-SLO/goodput drive as a suite-runnable e2e.
+
+``slow`` (NOT ``core``): real serve binary + supervisor/worker
+subprocesses under sustained load — excluded from tier-1
+(``-m 'not slow'``) and from the `make test-core` fast lane; the
+dedicated CI lane is ``make drive-serve``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_drive_serve_full_e2e():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "drive_serve.py")],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]

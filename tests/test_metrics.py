@@ -308,3 +308,251 @@ def test_gauge_keeps_last_writer_wins_semantics():
     g.inc("x", by=0.5)
     assert g.value("x") == 2.0
     assert 't_gauge{l="x"} 2.0' in g.collect()
+
+
+# -------------------------------------------------------------------------
+# ISSUE 8: lock-free histograms, OpenMetrics exemplars, negotiation
+# -------------------------------------------------------------------------
+
+
+def _sampled_span():
+    from tpu_dra.trace import Tracer
+    return Tracer(service="t", sample_ratio=1.0).start_span("req")
+
+
+def test_histogram_exact_across_threads():
+    """Per-thread cells (the Counter trick ported): concurrent observe()
+    from 8 threads loses nothing — bucket counts, count, and sum all
+    reconcile exactly after the join."""
+    import threading
+
+    h = Histogram("t_h_seconds", "t", buckets=(0.1, 1.0), labels=("l",))
+
+    def worker():
+        for _ in range(10000):
+            h.observe(0.05, "a")
+            h.observe(0.5, "a")
+            h.observe(5.0, "b")
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    text = h.collect()
+    assert 't_h_seconds_bucket{l="a",le="0.1"} 80000' in text
+    assert 't_h_seconds_bucket{l="a",le="1.0"} 160000' in text
+    assert 't_h_seconds_bucket{l="a",le="+Inf"} 160000' in text
+    assert 't_h_seconds_count{l="a"} 160000' in text
+    assert 't_h_seconds_count{l="b"} 80000' in text
+    snap = h.snapshot()
+    assert snap[("a",)]["cumulative"] == [80000, 160000]
+    assert abs(snap[("a",)]["sum"] - 80000 * 0.55) < 1e-6
+
+
+def test_histogram_collect_while_observing_is_monotonic():
+    import threading
+
+    h = Histogram("t_h_mono_seconds", "t", buckets=(1.0,))
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            h.observe(0.5)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    try:
+        last = 0
+        for _ in range(200):
+            now = h.snapshot().get((), {}).get("count", 0)
+            assert now >= last
+            last = now
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_histogram_rejects_non_monotonic_buckets():
+    with pytest.raises(ValueError, match="strictly increasing"):
+        Histogram("t_bad_seconds", "t", buckets=(0.1, 0.1, 1.0))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        Histogram("t_bad2_seconds", "t", buckets=(1.0, 0.5))
+
+
+def test_histogram_plain_exposition_parity_without_exemplars():
+    """The 0.0.4 output must be byte-identical to the pre-exemplar
+    format — existing scrapers parse it line by line."""
+    reg = Registry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+    assert "lat_sum 5.55" in text
+    assert "# {" not in text          # exemplars never leak into 0.0.4
+    assert "# EOF" not in text
+    assert not reg.has_exemplars()
+
+
+def test_observe_in_sampled_span_attaches_trace_id_exemplar():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "l", buckets=(0.1, 1.0))
+    with _sampled_span() as span:
+        h.observe(0.05)
+        tid = span.context.trace_id
+    assert reg.has_exemplars()
+    om = reg.expose(openmetrics=True)
+    assert f'lat_seconds_bucket{{le="0.1"}} 1 # {{trace_id="{tid}"}} ' \
+           f'0.05' in om
+    assert om.endswith("# EOF\n")
+    # the plain exposition still hides it
+    assert "# {" not in reg.expose()
+
+
+def test_observe_unsampled_and_explicit_exemplars():
+    from tpu_dra.trace import Tracer
+
+    h = Histogram("t_ex_seconds", "t", buckets=(1.0,))
+    # unsampled span (the shared noop): NO exemplar recorded
+    with Tracer(service="t", sample_ratio=0.0).start_span("req"):
+        h.observe(0.5)
+    assert not h.has_exemplars()
+    # outside any span: none either
+    h.observe(0.5)
+    assert not h.has_exemplars()
+    # explicit exemplar (the goodput downtime path) wins without a span
+    h.observe(0.5, exemplar={"trace_id": "ab" * 16})
+    om = h.collect(openmetrics=True)
+    assert f'# {{trace_id="{"ab" * 16}"}} 0.5' in om
+    # exemplar label set is restricted (vet rule 5's runtime backstop),
+    # and the rejection happens BEFORE the observation mutates the
+    # series — a raised observe must not be half-recorded
+    count_before = h.snapshot()[()]["count"]
+    with pytest.raises(ValueError, match="restricted"):
+        h.observe(0.5, exemplar={"tenant": "acme"})
+    assert h.snapshot()[()]["count"] == count_before
+
+
+def test_newest_exemplar_wins_per_bucket():
+    h = Histogram("t_new_seconds", "t", buckets=(1.0,))
+    h.observe(0.2, exemplar={"trace_id": "aa" * 16})
+    h.observe(0.3, exemplar={"trace_id": "bb" * 16})
+    h.observe(7.0, exemplar={"trace_id": "cc" * 16})   # +Inf bucket
+    om = h.collect(openmetrics=True)
+    assert 'le="1.0"} 2 # {trace_id="' + "bb" * 16 in om
+    assert 'le="+Inf"} 3 # {trace_id="' + "cc" * 16 in om
+
+
+def test_exemplar_label_values_escaped():
+    """A hostile trace id (impossible from the tracer, possible via the
+    explicit exemplar API) must escape like any label value."""
+    h = Histogram("t_esc2_seconds", "t", buckets=(1.0,))
+    h.observe(0.5, exemplar={"trace_id": 'a"b\\c\nd'})
+    om = h.collect(openmetrics=True)
+    assert '# {trace_id="a\\"b\\\\c\\nd"} 0.5' in om
+
+
+def test_openmetrics_counter_family_drops_total_suffix():
+    reg = Registry()
+    c = reg.counter("reqs_total", "requests")
+    c.inc()
+    om = reg.expose(openmetrics=True)
+    assert "# TYPE reqs counter" in om
+    assert "# HELP reqs requests" in om
+    assert "reqs_total 1.0" in om     # sample lines keep the suffix
+
+
+def test_counter_reclaims_dead_thread_cells():
+    """Thread-per-connection servers churn threads: a dead thread's
+    cell folds into the retired accumulator at collect time (totals
+    preserved) instead of accumulating one cell per connection forever."""
+    import threading
+
+    c = Counter("t_reclaim_total", "t")
+
+    def worker():
+        c.inc(by=2.0)
+
+    for _ in range(10):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert c.value() == 20.0            # collect folds the dead cells
+    assert len(c._cells) == 0
+    assert c.value() == 20.0            # folding happened exactly once
+    c.inc()
+    assert c.value() == 21.0
+
+
+def test_histogram_reclaims_dead_thread_cells():
+    import threading
+
+    h = Histogram("t_reclaim_seconds", "t", buckets=(1.0,))
+
+    def worker():
+        h.observe(0.5, exemplar={"trace_id": "ab" * 16})
+
+    for _ in range(10):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    snap = h.snapshot()
+    assert snap[()]["count"] == 10
+    assert len(h._cells) == 0
+    # exemplars survive the fold too, and the totals are stable
+    assert f'trace_id="{"ab" * 16}"' in h.collect(openmetrics=True)
+    assert h.snapshot()[()]["count"] == 10
+
+
+def test_downtime_exemplar_skipped_for_unsampled_recovery_trace():
+    """goodput.record_downtime: an unsampled ('-00') recovery trace
+    resolves to nothing in /debug/traces, so no exemplar must advertise
+    it — the record keeps the traceparent either way."""
+    from tpu_dra.util.metrics import Registry as _Registry
+    from tpu_dra.workloads.goodput import GoodputTracker
+
+    reg = _Registry()
+    t = GoodputTracker(registry=reg).start()
+    unsampled = "00-" + "0a" * 16 + "-" + "0b" * 8 + "-00"
+    t.record_downtime(1.0, traceparent=unsampled, generation=9)
+    assert t.reconfigurations()[0]["traceparent"] == unsampled
+    assert "0a0a" not in reg.expose(openmetrics=True)
+
+
+def test_metrics_content_type_negotiation():
+    """/metrics serves OpenMetrics iff the client Accepts it AND
+    exemplars exist; plain 0.0.4 text otherwise."""
+    reg = Registry()
+    h = reg.histogram("neg_seconds", "n", buckets=(1.0,))
+    h.observe(0.5)
+    server = serve_http_endpoint("127.0.0.1", 0, registry=reg)
+    try:
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}/metrics"
+
+        def get(accept=None):
+            req = urllib.request.Request(
+                url, headers={"Accept": accept} if accept else {})
+            resp = urllib.request.urlopen(req, timeout=5)
+            return resp.headers.get("Content-Type"), \
+                resp.read().decode()
+
+        # no exemplars yet: plain text even when openmetrics is asked
+        ctype, body = get("application/openmetrics-text")
+        assert ctype.startswith("text/plain")
+        assert "# EOF" not in body
+        h.observe(0.2, exemplar={"trace_id": "ab" * 16})
+        ctype, body = get("application/openmetrics-text")
+        assert ctype.startswith("application/openmetrics-text")
+        assert '# {trace_id="' in body and body.endswith("# EOF\n")
+        # a plain scraper keeps the old exposition
+        ctype, body = get()
+        assert ctype.startswith("text/plain")
+        assert "# {" not in body
+    finally:
+        server.shutdown()

@@ -144,13 +144,15 @@ def test_metrics_endpoint(server):
     body = urllib.request.urlopen(
         f"{base}/metrics", timeout=10).read().decode()
     assert "# TYPE tpu_serve_requests_total counter" in body
-    assert 'tpu_serve_requests_total{path="/generate",code="200"}' in body
+    assert 'tpu_serve_requests_total{path="/generate",code="200",' \
+           'tenant="default"}' in body
     assert "tpu_serve_generated_tokens_total" in body
     assert "tpu_serve_request_seconds_bucket" in body
     # bad input lands in the 400 series, not the 200 one (delta-based:
     # the module-scoped server carries counts from earlier tests)
     def series_val(text, code):
-        key = f'tpu_serve_requests_total{{path="/generate",code="{code}"}}'
+        key = (f'tpu_serve_requests_total{{path="/generate",'
+               f'code="{code}",tenant="default"}}')
         for line in text.splitlines():
             if line.startswith(key):
                 return float(line.rsplit(" ", 1)[1])
@@ -746,3 +748,102 @@ def test_main_sigterm_drains_and_exits(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.communicate(timeout=10)
+
+
+# -------------------------------------------------------------------------
+# ISSUE 8: per-tenant SLO metrics, exemplars, /debug/slo
+# -------------------------------------------------------------------------
+
+
+def test_metrics_tenant_label_and_exemplar_roundtrip(server):
+    """A request with X-Tenant lands in every per-tenant series; the
+    OpenMetrics scrape carries its trace id as an exemplar, and that id
+    resolves on the server's own /debug/traces."""
+    import re
+
+    from tpu_dra.trace import configure
+
+    configure(service="serve-test", sample_ratio=1.0)
+    _, _, base = server
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"tokens": [[1, 2, 3]], "steps": 2}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Tenant": "acme"})
+    urllib.request.urlopen(req, timeout=120).read()
+    plain = urllib.request.urlopen(
+        f"{base}/metrics", timeout=10).read().decode()
+    assert 'tpu_serve_requests_total{path="/generate",code="200",' \
+           'tenant="acme"}' in plain
+    assert 'tenant="acme"' in plain
+    assert "# {" not in plain            # 0.0.4 stays exemplar-free
+    om_req = urllib.request.Request(
+        f"{base}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    resp = urllib.request.urlopen(om_req, timeout=10)
+    assert resp.headers.get_content_type() == \
+        "application/openmetrics-text"
+    om = resp.read().decode()
+    assert om.endswith("# EOF\n")
+    m = re.search(r'tpu_serve_request_seconds_bucket\{[^}]*\} \d+ '
+                  r'# \{trace_id="([0-9a-f]{32})"\}', om)
+    assert m, om[:800]
+    traces = json.loads(urllib.request.urlopen(
+        f"{base}/debug/traces?trace_id={m.group(1)}",
+        timeout=10).read())
+    assert any(e.get("name") == "serve.request"
+               for e in traces["traceEvents"])
+
+
+def test_tenant_cardinality_capped():
+    """X-Tenant is untrusted input becoming a metric label: past the
+    cap, new values collapse into 'other' instead of growing series
+    without bound; known values keep their own series."""
+    from tpu_dra.workloads.serve import ServeMetrics
+
+    m = ServeMetrics()
+    assert m.tenant_label("acme") == "acme"
+    assert m.tenant_label("") == "default"
+    for i in range(ServeMetrics.MAX_TENANTS + 20):
+        m.tenant_label(f"tenant-{i}")
+    assert m.tenant_label("one-more") == ServeMetrics.OVERFLOW_TENANT
+    assert m.tenant_label("acme") == "acme"        # early values stick
+    assert len(m.tenant_label("x" * 500)) <= 64
+    # no client-chosen header value can claim the overflow sentinel's
+    # series (strangers' post-cap traffic must never merge into a real
+    # tenant's SLOs): the sentinel's "~" is stripped from client input
+    m2 = ServeMetrics()
+    assert m2.tenant_label(ServeMetrics.OVERFLOW_TENANT) != \
+        ServeMetrics.OVERFLOW_TENANT
+
+
+def test_missing_tenant_header_collapses_to_default(server):
+    _, _, base = server
+    _post(base, {"tokens": [[4, 5]], "steps": 2})
+    plain = urllib.request.urlopen(
+        f"{base}/metrics", timeout=10).read().decode()
+    assert 'tenant="default"' in plain
+
+
+def test_debug_slo_burn_rates(server):
+    """/debug/slo: availability and latency objectives with multi-window
+    burn rates computed from the live registry."""
+    _, _, base = server
+    _post(base, {"tokens": [[1, 2]], "steps": 2})
+    slo = json.loads(urllib.request.urlopen(
+        f"{base}/debug/slo", timeout=10).read())
+    assert set(slo["objectives"]) == {"availability", "latency"}
+    avail = slo["objectives"]["availability"]
+    assert avail["target"] == 0.999
+    assert avail["lifetime"]["total"] >= 1
+    for win in slo["windows_s"]:
+        w = avail["windows"][f"{win}s"]
+        assert w["burn_rate"] == 0.0, w
+    # a 400 counts against availability? no — only 5xx does
+    try:
+        _post(base, {"tokens": [], "steps": 2})
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+    slo = json.loads(urllib.request.urlopen(
+        f"{base}/debug/slo", timeout=10).read())
+    assert slo["objectives"]["availability"]["lifetime"]["bad"] == 0

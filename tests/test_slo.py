@@ -1,0 +1,104 @@
+"""Multi-window SLO burn rates (workloads/slo.py, ISSUE 8)."""
+
+import pytest
+
+from tpu_dra.util.metrics import Registry
+from tpu_dra.workloads.slo import (
+    Objective,
+    SloTracker,
+    counter_good_total,
+    histogram_under,
+)
+
+pytestmark = pytest.mark.core
+
+
+def test_objective_validates_target():
+    with pytest.raises(ValueError, match="target"):
+        Objective("bad", 1.0, lambda: (0, 0))
+    with pytest.raises(ValueError, match="target"):
+        Objective("bad", 0.0, lambda: (0, 0))
+
+
+def test_counter_good_total_classifies_by_label():
+    reg = Registry()
+    c = reg.counter("t_req_total", "t", labels=("path", "code"))
+    c.inc("/a", "200", by=95)
+    c.inc("/a", "503", by=5)
+    good, total = counter_good_total(
+        c, is_bad=lambda lv: lv[1].startswith("5"))()
+    assert (good, total) == (95.0, 100.0)
+
+
+def test_histogram_under_uses_tightest_bucket_not_optimistic():
+    reg = Registry()
+    h = reg.histogram("t_lat_seconds", "t", buckets=(0.1, 0.25, 1.0))
+    for v in (0.05, 0.2, 0.2, 0.9, 5.0):
+        h.observe(v)
+    # threshold 0.5 rounds DOWN to the 0.25 bucket: 3 good of 5
+    good, total = histogram_under(h, 0.5)()
+    assert (good, total) == (3, 5)
+    with pytest.raises(ValueError, match="below the smallest bucket"):
+        histogram_under(h, 0.01)
+
+
+def test_burn_rates_from_windowed_deltas():
+    state = {"good": 0.0, "total": 0.0}
+    tracker = SloTracker(
+        [Objective("availability", 0.99,
+                   lambda: (state["good"], state["total"]))],
+        windows_s=(60,), interval_s=1000.0)   # manual sampling only
+    # warm sample: all good
+    state.update(good=100.0, total=100.0)
+    tracker.sample_now()
+    # 10% of the NEW traffic fails
+    state.update(good=190.0, total=200.0)
+    out = tracker.burn_rates()
+    win = out["objectives"]["availability"]["windows"]["60s"]
+    assert win["total"] == 100.0
+    assert win["bad"] == 10.0
+    assert win["error_rate"] == pytest.approx(0.1)
+    # 0.1 error rate against a 1% budget: burning 10x too fast
+    assert win["burn_rate"] == pytest.approx(10.0)
+    life = out["objectives"]["availability"]["lifetime"]
+    assert life["error_rate"] == pytest.approx(0.05)
+
+
+def test_burn_rates_reads_fresh_edge_without_growing_ring():
+    """Request-driven reads must not consume ring capacity: a dashboard
+    polling /debug/slo would otherwise shrink the span the slow window
+    actually covers while still labeling it with the full width."""
+    state = {"good": 100.0, "total": 100.0}
+    tracker = SloTracker(
+        [Objective("a", 0.99,
+                   lambda: (state["good"], state["total"]))],
+        windows_s=(60,), interval_s=1000.0)
+    tracker.sample_now()
+    ring_len = len(tracker._rings["a"])
+    state.update(good=150.0, total=160.0)
+    for _ in range(10):
+        out = tracker.burn_rates()
+    assert len(tracker._rings["a"]) == ring_len     # no appends
+    win = out["objectives"]["a"]["windows"]["60s"]
+    assert win["bad"] == 10.0                       # fresh edge used
+    assert win["total"] == 60.0
+
+
+def test_cold_ring_reports_covered_window():
+    tracker = SloTracker([Objective("a", 0.9, lambda: (1.0, 1.0))],
+                         windows_s=(3600,), interval_s=1000.0)
+    out = tracker.burn_rates()
+    win = out["objectives"]["a"]["windows"]["3600s"]
+    # one sample: zero covered span, zero traffic, no crash
+    assert win["window_covered_s"] < 1.0
+    assert win["burn_rate"] == 0.0
+
+
+def test_tracker_thread_start_stop():
+    tracker = SloTracker([Objective("a", 0.9, lambda: (1.0, 1.0))],
+                         interval_s=0.05).start()
+    try:
+        out = tracker.burn_rates()
+        assert "a" in out["objectives"]
+    finally:
+        tracker.stop()

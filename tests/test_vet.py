@@ -734,6 +734,76 @@ def test_metric_hygiene_skips_owner_module_and_tests(tmp_path):
                        _METRIC_BAD, checks=["metric-hygiene"]) == []
 
 
+_HISTOGRAM_BAD_BUCKETS = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_lat = DEFAULT_REGISTRY.histogram(
+    "tpu_dra_lat_seconds", "latency",
+    buckets=(0.005, 0.01, 0.01, 0.1))
+
+_rev = DEFAULT_REGISTRY.histogram(
+    "tpu_dra_rev_seconds", "latency", buckets=(1.0, 0.5))
+"""
+
+_HISTOGRAM_OK_BUCKETS = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_lat = DEFAULT_REGISTRY.histogram(
+    "tpu_dra_lat_seconds", "latency",
+    buckets=(0.005, 0.01, 0.1, 1.0), labels=("driver",))
+
+_default = DEFAULT_REGISTRY.histogram(
+    "tpu_dra_lat2_seconds", "latency")       # DEFAULT_BUCKETS: no check
+
+_dynamic = DEFAULT_REGISTRY.histogram(
+    "tpu_dra_lat3_seconds", "latency", buckets=tuple(sorted([1, 2])))
+"""
+
+
+def test_metric_hygiene_histogram_buckets_must_increase(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/mh4.py",
+                        _HISTOGRAM_BAD_BUCKETS,
+                        checks=["metric-hygiene"])
+    assert len(diags) == 2, diags
+    assert all("strictly increasing" in d.message for d in diags)
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/mh5.py",
+                       _HISTOGRAM_OK_BUCKETS,
+                       checks=["metric-hygiene"]) == []
+
+
+_EXEMPLAR_BAD = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_lat = DEFAULT_REGISTRY.histogram("tpu_dra_lat_seconds", "latency")
+
+
+def record(secs, tenant):
+    _lat.observe(secs, exemplar={"tenant": tenant})
+"""
+
+_EXEMPLAR_OK = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_lat = DEFAULT_REGISTRY.histogram("tpu_dra_lat_seconds", "latency")
+
+
+def record(secs, ctx, labels):
+    _lat.observe(secs, exemplar={"trace_id": ctx.trace_id})
+    _lat.observe(secs, exemplar={"trace_id": ctx.trace_id,
+                                 "span_id": ctx.span_id})
+    _lat.observe(secs, exemplar=labels)     # dynamic: out of scope
+"""
+
+
+def test_metric_hygiene_exemplar_labels_restricted(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/mh6.py",
+                        _EXEMPLAR_BAD, checks=["metric-hygiene"])
+    assert len(diags) == 1, diags
+    assert "exemplar label 'tenant' not allowed" in diags[0].message
+    assert vet_snippet(tmp_path, "tpu_dra/plugins/mh7.py",
+                       _EXEMPLAR_OK, checks=["metric-hygiene"]) == []
+
+
 def test_metric_hygiene_real_driver_metrics_conform():
     """Every series the driver fleet actually registers passes the
     contract — the live complement of the fixture tests (workqueue,

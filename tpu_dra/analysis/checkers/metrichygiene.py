@@ -14,7 +14,16 @@ checked over registry registration calls in non-test ``tpu_dra/`` code:
    from* ``util/metrics`` — ``collections.Counter`` is not ours) must
    not be constructed directly outside ``util/metrics.py``: direct
    construction bypasses the :class:`~tpu_dra.util.metrics.Registry`'s
-   idempotence/conflict checks AND never reaches ``/metrics``.
+   idempotence/conflict checks AND never reaches ``/metrics``;
+4. a literal ``buckets=(…)`` tuple on a ``.histogram()`` registration
+   must be strictly increasing — a non-monotonic tuple silently
+   mis-bins every observation (the Histogram constructor backstops
+   this at runtime, but the registration may sit on a path no test
+   executes);
+5. an explicit ``exemplar={…}`` dict literal passed to ``.observe()``
+   may only carry the trace-linkage keys ``trace_id``/``span_id`` —
+   OpenMetrics exemplars are a metric→trace jump, not a side channel
+   for unbounded extra labels.
 
 Deliberately-unprefixed series (e.g. the native coordd's hand-rolled
 ``coordd_*`` drop-in exposition) are not registry calls and are out of
@@ -57,6 +66,71 @@ def _literal_str(node: ast.expr) -> str | None:
     return None
 
 
+# exemplar label keys the exposition accepts (util/metrics.py
+# EXEMPLAR_LABELS) — duplicated as literals on purpose: the analyzer
+# must not import the code under analysis
+_EXEMPLAR_LABELS = {"trace_id", "span_id"}
+
+
+def _literal_numbers(node: ast.expr) -> list[float] | None:
+    """The values of a tuple/list literal of numeric constants; None
+    when the node is anything else (dynamic buckets are out of scope)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: list[float] = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and \
+                isinstance(elt.value, (int, float)) and \
+                not isinstance(elt.value, bool):
+            out.append(float(elt.value))
+        else:
+            return None
+    return out
+
+
+def _check_buckets(ctx: FileContext, node: ast.Call,
+                   name: str | None) -> list[Diagnostic]:
+    """Rule 4: a literal buckets tuple must be strictly increasing."""
+    bucket_node = None
+    if len(node.args) >= 3:
+        bucket_node = node.args[2]
+    for kw in node.keywords:
+        if kw.arg == "buckets":
+            bucket_node = kw.value
+    if bucket_node is None:
+        return []
+    values = _literal_numbers(bucket_node)
+    if values is None:
+        return []
+    if any(a >= b for a, b in zip(values, values[1:])):
+        return [ctx.diag(
+            node, "metric-hygiene",
+            f"histogram {name or '<dynamic>'!r} buckets must be "
+            f"strictly increasing — a non-monotonic tuple silently "
+            f"mis-bins every observation")]
+    return []
+
+
+def _check_exemplar(ctx: FileContext, node: ast.Call) -> list[Diagnostic]:
+    """Rule 5: ``.observe(..., exemplar={…})`` dict-literal keys must be
+    trace-linkage labels only."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "observe"):
+        return []
+    for kw in node.keywords:
+        if kw.arg != "exemplar" or not isinstance(kw.value, ast.Dict):
+            continue
+        for key in kw.value.keys:
+            name = _literal_str(key) if key is not None else None
+            if name is not None and name not in _EXEMPLAR_LABELS:
+                return [ctx.diag(
+                    node, "metric-hygiene",
+                    f"exemplar label {name!r} not allowed: exemplars "
+                    f"link metrics to traces, so the label set is "
+                    f"restricted to {sorted(_EXEMPLAR_LABELS)}")]
+    return []
+
+
 def _metric_class_imports(tree: ast.AST) -> set[str]:
     """Local names bound to Counter/Gauge/Histogram via
     ``from tpu_dra.util.metrics import …`` — rule 3 only fires on these,
@@ -91,7 +165,9 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
                 f"deduplicated, conflict-checked, and actually exposed "
                 f"on /metrics"))
             continue
-        # rules 1+2: registry registration calls
+        # rule 5: exemplar label restriction on observe() calls
+        diags.extend(_check_exemplar(ctx, node))
+        # rules 1+2+4: registry registration calls
         if not (isinstance(fn, ast.Attribute)
                 and fn.attr in _REGISTRY_METHODS
                 and _receiver_is_registry(fn.value)):
@@ -99,6 +175,8 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
         if not node.args:
             continue
         name = _literal_str(node.args[0])
+        if fn.attr == "histogram":
+            diags.extend(_check_buckets(ctx, node, name))
         if name is not None and not _NAME_RE.match(name):
             diags.append(ctx.diag(
                 node, "metric-hygiene",

@@ -40,6 +40,7 @@ from tpu_dra.kubeletplugin import (
 from tpu_dra.plugins.metrics import observe_prepare, observe_unprepare
 from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+from tpu_dra.plugins.tpu.utilization import ChipSecondsAccountant
 from tpu_dra.plugins.tpu.deviceinfo import chip_device, core_device
 from tpu_dra.tpulib.discovery import TpuLib
 from tpu_dra.trace import get_tracer, propagation
@@ -115,6 +116,19 @@ class TpuDriver:
         self._deferred_remediations: list[Transition] = []
         self._deferred_mu = threading.Lock()
         self.health.add_poll_listener(self._flush_deferred_remediations)
+        # chip-seconds utilization accounting (ISSUE 8): every chip's
+        # wall time classified active/allocated/idle/unhealthy off the
+        # same health poll — tpu_dra_chip_seconds_total is the fleet
+        # capacity signal the ROADMAP's router/autoscaler work consumes
+        self.utilization = ChipSecondsAccountant(
+            chips_fn=lambda: [d.chip.uuid
+                              for d in self.state.allocatable.values()
+                              if d.type == TYPE_CHIP],
+            pinned_fn=self._pinned_claims,
+            state_of=self.health.state_of,
+            heartbeat_dir=self.heartbeat_dir,
+            active_stale_after=cfg.heartbeat_stale_after)
+        self.health.add_poll_listener(self.utilization.tick)
         self.server = KubeletPluginServer(
             driver_name=DRIVER_NAME,
             node_name=cfg.node_name,

@@ -1,0 +1,190 @@
+"""Chip-seconds utilization accounting (ISSUE 8).
+
+The fleet-capacity signal ROADMAP item 2's router/autoscaler consumes:
+every chip on the node contributes one chip-second per wall second, and
+this module classifies where it went —
+
+- ``active``    — allocated to a claim AND a workload heartbeat
+  (the PR-2 heartbeat dirs the launcher shim beats) is fresh: the chip
+  is actually being driven
+- ``allocated`` — pinned to a prepared claim but no fresh heartbeat:
+  paid for, not (yet/anymore) working — startup, wedge, or a workload
+  that doesn't run the shim
+- ``idle``      — healthy and unclaimed: bin-packing headroom
+- ``unhealthy`` — drained by the health monitor: capacity lost, not
+  merely unused
+
+Exported as ``tpu_dra_chip_seconds_total{state=…}`` (counter — rate()
+over it is the fleet utilization curve) plus a
+``tpu_dra_chip_utilization_ratio`` gauge (active over not-unhealthy,
+cumulative) for dashboards that want one number.  Per-claim
+allocated/active splits stay in :meth:`ChipSecondsAccountant.report`
+(claim uids are unbounded label cardinality — they do not belong on a
+Prometheus series).
+
+Driven by the health monitor's poll loop (``add_poll_listener``), so the
+accounting cadence equals the health cadence and costs zero extra
+threads.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from tpu_dra.health.state import UNHEALTHY
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+STATE_ACTIVE = "active"
+STATE_ALLOCATED = "allocated"
+STATE_IDLE = "idle"
+STATE_UNHEALTHY = "unhealthy"
+STATES = (STATE_ACTIVE, STATE_ALLOCATED, STATE_IDLE, STATE_UNHEALTHY)
+
+
+def _metrics():
+    return {
+        "chip_seconds": DEFAULT_REGISTRY.counter(
+            "tpu_dra_chip_seconds_total",
+            "chip wall time by utilization state (active=fresh workload "
+            "heartbeat, allocated=claimed but not beating, idle=free, "
+            "unhealthy=drained)", ("state",)),
+        "utilization": DEFAULT_REGISTRY.gauge(
+            "tpu_dra_chip_utilization_ratio",
+            "active chip-seconds over not-unhealthy chip-seconds "
+            "(cumulative since plugin start)"),
+    }
+
+
+class ChipSecondsAccountant:
+    """Accrue per-chip wall time into utilization states on each tick.
+
+    ``chips_fn``   — chip uuids on this node (all of them: drained chips
+    keep accruing, as ``unhealthy``).
+    ``pinned_fn``  — chip uuid -> claim uids prepared on it (the
+    driver's ``_pinned_claims``).
+    ``state_of``   — health verdict per uuid (``HealthMonitor.state_of``);
+    None disables the unhealthy classification.
+    ``heartbeat_dir`` — the PR-2 per-claim heartbeat root
+    (``<dir>/<claim-uid>/beat``); a beat younger than
+    ``active_stale_after`` marks the claim's chips active.
+
+    The per-claim split is bounded: a long-lived plugin sees unbounded
+    claim churn, so once :data:`MAX_CLAIM_ENTRIES` is reached, entries
+    of claims that are no longer pinned are evicted oldest-first —
+    currently-pinned claims always keep their accounting.
+    """
+
+    MAX_CLAIM_ENTRIES = 256
+
+    def __init__(self, chips_fn: Callable[[], Iterable[str]],
+                 pinned_fn: Callable[[], dict[str, list[str]]],
+                 state_of: Optional[Callable[[str], str]] = None,
+                 heartbeat_dir: str = "",
+                 active_stale_after: float = 120.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._chips_fn = chips_fn
+        self._pinned_fn = pinned_fn
+        self._state_of = state_of
+        self._heartbeat_dir = heartbeat_dir
+        self._active_stale_after = active_stale_after
+        self._clock = clock
+        self._m = _metrics()
+        self._mu = threading.Lock()
+        # guarded by _mu
+        self._t_last: Optional[float] = None
+        self._totals: dict[str, float] = {s: 0.0 for s in STATES}
+        self._per_claim: dict[str, dict[str, float]] = {}
+
+    # -- classification ----------------------------------------------------
+    def _beat_fresh(self, claim_uid: str, now_wall: float) -> bool:
+        if not self._heartbeat_dir:
+            return False
+        path = os.path.join(self._heartbeat_dir, claim_uid, "beat")
+        try:
+            age = now_wall - os.stat(path).st_mtime
+        except OSError:
+            return False   # no beat file: workload doesn't run the shim
+        return age < self._active_stale_after
+
+    def tick(self) -> None:
+        """Classify every chip and accrue the elapsed interval.  Poll-
+        listener safe: never raises (a stat hiccup must not kill the
+        health loop), first call only establishes the epoch."""
+        try:
+            self._tick()
+        except Exception as exc:  # noqa: BLE001 — accounting is
+            # advisory and rides the health poll loop, which must
+            # survive a stat/classification hiccup
+            klog.error("chip-seconds tick failed", err=repr(exc))
+
+    def _tick(self) -> None:
+        now = self._clock()
+        now_wall = time.time()
+        with self._mu:
+            if self._t_last is None:
+                self._t_last = now
+                return
+            dt = now - self._t_last
+            self._t_last = now
+            if dt <= 0:
+                return
+            pinned = self._pinned_fn()
+            # heartbeat freshness per CLAIM, checked once even when the
+            # claim spans several chips
+            fresh: dict[str, bool] = {}
+            for uids in pinned.values():
+                for uid in uids:
+                    if uid not in fresh:
+                        fresh[uid] = self._beat_fresh(uid, now_wall)
+            for chip in self._chips_fn():
+                if self._state_of is not None and \
+                        self._state_of(chip) == UNHEALTHY:
+                    state = STATE_UNHEALTHY
+                elif chip in pinned and pinned[chip]:
+                    state = STATE_ACTIVE if any(
+                        fresh.get(uid) for uid in pinned[chip]) \
+                        else STATE_ALLOCATED
+                    for uid in pinned[chip]:
+                        per = self._per_claim.setdefault(
+                            uid, {"allocated_s": 0.0, "active_s": 0.0})
+                        per["allocated_s"] += dt
+                        if fresh.get(uid):
+                            per["active_s"] += dt
+                else:
+                    state = STATE_IDLE
+                self._totals[state] += dt
+                self._m["chip_seconds"].inc(state, by=dt)
+            if len(self._per_claim) > self.MAX_CLAIM_ENTRIES:
+                pinned_uids = {uid for uids in pinned.values()
+                               for uid in uids}
+                for uid in list(self._per_claim):   # insertion order =
+                    if len(self._per_claim) <= \
+                            self.MAX_CLAIM_ENTRIES:  # oldest first
+                        break
+                    if uid not in pinned_uids:
+                        del self._per_claim[uid]
+            up = (self._totals[STATE_ACTIVE]
+                  + self._totals[STATE_ALLOCATED]
+                  + self._totals[STATE_IDLE])
+            if up > 0:
+                self._m["utilization"].set(
+                    self._totals[STATE_ACTIVE] / up)
+
+    # -- reporting ---------------------------------------------------------
+    def report(self) -> dict:
+        """Node totals + the per-claim allocated-vs-active split (the
+        "what did claim X actually use" answer that stays off the
+        fleet series)."""
+        with self._mu:
+            return {
+                "totals_s": {s: round(v, 3)
+                             for s, v in self._totals.items()},
+                "per_claim": {uid: {k: round(v, 3)
+                                    for k, v in per.items()}
+                              for uid, per in
+                              sorted(self._per_claim.items())},
+            }

@@ -114,3 +114,22 @@ def chrome_trace(spans: list[dict[str, Any]]) -> dict[str, Any]:
             "args": args,
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def debug_traces_body(path: str) -> bytes:
+    """The ``/debug/traces[?trace_id=…]`` response body: the default
+    span ring as Chrome trace JSON.  ONE implementation shared by the
+    driver binaries' HTTP endpoint (util/metrics.py) and the serve
+    binary's handler — the exemplar→trace resolution contract must not
+    drift between them.  ``default=str``: one exotic span attribute
+    must degrade to its str(), not kill the endpoint until the span
+    ages out of the ring."""
+    from urllib.parse import parse_qs, urlparse
+
+    # lazy: the ring lives in tracer.py, which imports this module
+    from tpu_dra.trace.tracer import DEFAULT_RING
+
+    qs = parse_qs(urlparse(path).query)
+    trace_id = qs.get("trace_id", [""])[0]
+    spans = DEFAULT_RING.spans(trace_id=trace_id or None)
+    return json.dumps(chrome_trace(spans), default=str).encode()

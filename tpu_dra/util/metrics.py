@@ -20,6 +20,46 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+# trace/span.py is deliberately dependency-free (stdlib only), so this
+# import can never cycle back; klog.py relies on the same property.
+# Histogram.observe consults the current-span contextvar to attach the
+# sampled trace id as an OpenMetrics exemplar — and must recognize the
+# shared no-op span so unsampled traffic pays two pointer compares, not
+# an allocation (the zero-cost-when-idle invariant, docs/performance.md)
+from tpu_dra.trace.span import _CURRENT as _CURRENT_SPAN, NOOP_SPAN
+
+# exemplar label keys the exposition accepts — OpenMetrics limits an
+# exemplar's label set to 128 UTF-8 chars, and the only linkage this
+# repo promises is metric↔trace (enforced for literal call sites by the
+# metric-hygiene vet checker)
+EXEMPLAR_LABELS = ("trace_id", "span_id")
+
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def negotiate_exposition(accept: str, registry: "Registry"
+                         ) -> tuple[str, str]:
+    """(body, content-type) for a /metrics request: OpenMetrics when the
+    client asked for it AND the registry actually holds exemplars —
+    exemplar-free scrapes keep the plain 0.0.4 text every existing
+    scraper already parses."""
+    if "application/openmetrics-text" in (accept or "") and \
+            registry.has_exemplars():
+        return registry.expose(openmetrics=True), OPENMETRICS_CONTENT_TYPE
+    return registry.expose(), TEXT_CONTENT_TYPE
+
+
+def _current_exemplar() -> Optional[dict]:
+    """``{"trace_id": …}`` of the current SAMPLED span, else None.
+    Unsampled spans are the shared NOOP_SPAN (identity compare, no
+    attribute access); outside any span the contextvar is None."""
+    span = _CURRENT_SPAN.get()
+    if span is None or span is NOOP_SPAN:
+        return None
+    return {"trace_id": span.context.trace_id}
+
 
 def _escape_label(value: object) -> str:
     """Escape a label value per the Prometheus text exposition format:
@@ -36,13 +76,17 @@ def _escape_help(text: str) -> str:
 
 def _simple_exposition(name: str, help_: str, kind: str,
                        labels: tuple[str, ...],
-                       items: list[tuple[tuple[str, ...], float]]) -> str:
+                       items: list[tuple[tuple[str, ...], float]],
+                       family: Optional[str] = None) -> str:
     """Text exposition for single-sample-per-series metrics (counter,
     gauge) — ONE place owns the HELP/TYPE header and label escaping so a
     format fix cannot drift between metric kinds (histograms render
-    their bucket/sum/count family themselves)."""
-    out = [f"# HELP {name} {_escape_help(help_)}",
-           f"# TYPE {name} {kind}"]
+    their bucket/sum/count family themselves).  ``family`` overrides the
+    HELP/TYPE metric-family name (OpenMetrics strips a counter's
+    ``_total`` suffix there while the sample lines keep it)."""
+    fam = family or name
+    out = [f"# HELP {fam} {_escape_help(help_)}",
+           f"# TYPE {fam} {kind}"]
     for lv, val in sorted(items):
         lbl = ",".join(f'{k}="{_escape_label(v)}"'
                        for k, v in zip(labels, lv))
@@ -60,16 +104,26 @@ class Counter:
     single-writer and therefore safe under the GIL — and ``collect``
     sums across cells.  A scrape racing an in-flight ``inc`` can read
     the pre-inc value (never a torn or double-counted one: each read is
-    one dict item), so totals stay monotonic across scrapes.  Cells of
-    exited threads are kept (strong refs in ``_cells``) — counts must
-    survive thread death; the cost is one small dict per distinct
-    incrementing thread, fine for this repo's long-lived pools."""
+    one dict item), so totals stay monotonic across scrapes.
+
+    Cells whose owner thread has DIED are folded into a shared
+    ``_retired`` accumulator at collect time and dropped — counts
+    survive thread death, but the per-cell memory does not accumulate
+    per thread forever.  That matters for thread-per-connection servers
+    (serve.py's ThreadingHTTPServer): without reclamation every
+    connection would permanently add a cell, growing memory and scrape
+    cost without bound.  Folding a dead thread's cell is safe because a
+    thread that reports not-alive has returned from run() and can never
+    mutate its cell again."""
 
     KIND = "counter"
 
     def __init__(self, name: str, help_: str, labels: tuple[str, ...] = ()):
         self.name, self.help, self.labels = name, help_, labels
-        self._cells: list[dict[tuple[str, ...], float]] = []  # guarded by _mu
+        # (owner thread, cell) pairs            # guarded by _mu
+        self._cells: list[tuple[threading.Thread,
+                                dict[tuple[str, ...], float]]] = []
+        self._retired: dict[tuple[str, ...], float] = {}  # guarded by _mu
         self._tl = threading.local()
         self._mu = threading.Lock()
 
@@ -83,25 +137,36 @@ class Counter:
     def _new_cell(self) -> dict:
         cell: dict[tuple[str, ...], float] = {}
         with self._mu:
-            self._cells.append(cell)
+            self._cells.append((threading.current_thread(), cell))
         self._tl.cell = cell
         return cell
 
+    @staticmethod
+    def _cell_items(cell: dict) -> list:
+        while True:
+            try:
+                return list(cell.items())
+            except RuntimeError:
+                # the owner thread inserted a NEW label set mid-
+                # iteration (resize); re-snapshot — bounded by the
+                # metric's label cardinality, not by inc volume
+                continue
+
     def _totals(self) -> dict[tuple[str, ...], float]:
         with self._mu:
-            cells = list(self._cells)
-        totals: dict[tuple[str, ...], float] = {}
+            live = []
+            for owner, cell in self._cells:
+                if owner.is_alive():
+                    live.append((owner, cell))
+                else:         # frozen: the owner can never write again
+                    for lv, val in cell.items():
+                        self._retired[lv] = \
+                            self._retired.get(lv, 0.0) + val
+            self._cells = live
+            totals = dict(self._retired)
+            cells = [cell for _, cell in live]
         for cell in cells:
-            while True:
-                try:
-                    items = list(cell.items())
-                    break
-                except RuntimeError:
-                    # the owner thread inserted a NEW label set mid-
-                    # iteration (resize); re-snapshot — bounded by the
-                    # metric's label cardinality, not by inc volume
-                    continue
-            for lv, val in items:
+            for lv, val in self._cell_items(cell):
                 totals[lv] = totals.get(lv, 0.0) + val
         return totals
 
@@ -109,10 +174,21 @@ class Counter:
         """Current total for one label set (tests / introspection)."""
         return self._totals().get(label_values, 0.0)
 
-    def collect(self) -> str:
+    def totals(self) -> dict[tuple[str, ...], float]:
+        """All label sets with their totals — the SLO tracker's read
+        path (workloads/slo.py)."""
+        return self._totals()
+
+    def collect(self, openmetrics: bool = False) -> str:
+        # OpenMetrics: the metric FAMILY drops the _total suffix in
+        # HELP/TYPE; sample lines keep the full name
+        family = None
+        if openmetrics and self.name.endswith("_total"):
+            family = self.name[: -len("_total")]
         return _simple_exposition(self.name, self.help, self.KIND,
                                   self.labels,
-                                  list(self._totals().items()))
+                                  list(self._totals().items()),
+                                  family=family)
 
 
 class Gauge:
@@ -140,7 +216,7 @@ class Gauge:
         with self._mu:
             return self._values.get(label_values, 0.0)
 
-    def collect(self) -> str:
+    def collect(self, openmetrics: bool = False) -> str:
         with self._mu:
             items = list(self._values.items())
         return _simple_exposition(self.name, self.help, self.KIND,
@@ -148,44 +224,210 @@ class Gauge:
 
 
 class Histogram:
+    """Histogram with a lock-free ``observe()`` and OpenMetrics
+    exemplars.
+
+    ``observe`` sits on the same hot paths as ``Counter.inc`` (every
+    prepare, every serve request), so it borrows the Counter's
+    per-thread-cell trick: each thread accumulates into its OWN
+    ``label values -> [bucket counts…, overflow, sum]`` dict — created
+    once per (thread, metric) under the lock, then mutated only by its
+    owner thread — and ``collect`` sums across cells.  A scrape racing
+    an in-flight observe can see the bucket count without the matching
+    sum delta (each list slot is one atomic read), which Prometheus
+    scrape semantics already tolerate; per-cell values only ever grow,
+    so totals stay monotonic across scrapes.
+
+    Exemplars: when an observe happens inside a SAMPLED trace span, the
+    (trace_id, value, timestamp) triple is remembered for the bucket the
+    value landed in — the newest per bucket wins at collect time — and
+    the OpenMetrics exposition emits it as
+    ``… # {trace_id="…"} value ts``, the metric→trace jump dashboards
+    need.  Unsampled traffic pays two pointer compares and nothing else
+    (the shared no-op span, docs/performance.md).  An explicit
+    ``exemplar={"trace_id": …}`` overrides the ambient span; keys are
+    restricted to :data:`EXEMPLAR_LABELS`."""
+
     KIND = "histogram"
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
     def __init__(self, name: str, help_: str,
                  buckets: tuple[float, ...] = DEFAULT_BUCKETS,
                  labels: tuple[str, ...] = ()):
-        self.name, self.help, self.buckets = name, help_, buckets
+        if any(b1 >= b2 for b1, b2 in zip(buckets, buckets[1:])):
+            # runtime backstop for the vet rule: a non-monotonic bucket
+            # tuple silently mis-bins every observation
+            raise ValueError(
+                f"histogram {name!r} buckets must be strictly "
+                f"increasing, got {buckets}")
+        self.name, self.help, self.buckets = name, help_, tuple(buckets)
         self.labels = labels
-        # per-label-set series: label values -> [bucket counts..., sum]
-        self._series: dict[tuple[str, ...], list] = {}
+        # per-thread (owner, counts cell, exemplar cell) triples:
+        # counts cell: lv -> [bucket counts…, overflow, sum]
+        # exemplar cell: lv -> [latest (exemplar dict, value, ts) or None
+        #                       per bucket, +Inf included]
+        # dead owners' cells are folded into the retired accumulators at
+        # collect time (see Counter: thread-per-connection servers would
+        # otherwise grow one cell per connection forever)
+        self._cells: list[tuple[threading.Thread, dict, dict]] = []
+        self._retired: dict[tuple[str, ...], list] = {}   # guarded by _mu
+        self._retired_ex: dict[tuple[str, ...], list] = {}  # guarded by _mu
+        self._has_exemplars = False     # latched on first exemplar write
+        self._tl = threading.local()
         self._mu = threading.Lock()
 
-    def observe(self, value: float, *label_values: str) -> None:
-        with self._mu:
-            s = self._series.setdefault(
-                label_values, [0] * (len(self.buckets) + 1) + [0.0])
-            s[-1] += value
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    s[i] += 1
-                    return
-            s[len(self.buckets)] += 1
+    def observe(self, value: float, *label_values: str,
+                exemplar: Optional[dict] = None) -> None:
+        # validate BEFORE mutating: a rejected exemplar must not leave
+        # the observation half-recorded behind the raised error
+        if exemplar and any(k not in EXEMPLAR_LABELS for k in exemplar):
+            raise ValueError(
+                f"exemplar labels restricted to {EXEMPLAR_LABELS}, "
+                f"got {tuple(exemplar)}")
+        try:
+            cell = self._tl.cell
+        except AttributeError:
+            cell = self._new_cell()
+        s = cell.get(label_values)
+        if s is None:
+            s = cell[label_values] = \
+                [0] * (len(self.buckets) + 1) + [0.0]
+            self._tl.ex[label_values] = [None] * (len(self.buckets) + 1)
+        s[-1] += value
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                idx = i
+                break
+        s[idx] += 1
+        if exemplar is None:
+            exemplar = _current_exemplar()
+        if exemplar:
+            self._tl.ex[label_values][idx] = \
+                (dict(exemplar), float(value), time.time())
+            self._has_exemplars = True    # benign race: latch-only
 
-    def collect(self) -> str:
+    def _new_cell(self) -> dict:
+        cell: dict[tuple[str, ...], list] = {}
+        ex: dict[tuple[str, ...], list] = {}
+        with self._mu:
+            self._cells.append((threading.current_thread(), cell, ex))
+        self._tl.cell = cell
+        self._tl.ex = ex
+        return cell
+
+    @staticmethod
+    def _merge_counts(agg: dict, cell: dict) -> None:
+        for lv, s in Counter._cell_items(cell):
+            dst = agg.get(lv)
+            if dst is None:
+                agg[lv] = list(s)
+            else:
+                for i, v in enumerate(list(s)):
+                    dst[i] += v
+
+    def _merge_exemplars(self, agg: dict, cell: dict) -> None:
+        for lv, exs in Counter._cell_items(cell):
+            dst = agg.setdefault(lv, [None] * (len(self.buckets) + 1))
+            for i, ex in enumerate(list(exs)):
+                if ex is not None and (dst[i] is None
+                                       or ex[2] > dst[i][2]):
+                    dst[i] = ex
+
+    def _fold_dead_locked(self) -> list[tuple]:
+        """Caller holds ``_mu``: fold dead owners' cells into the
+        retired accumulators (they can never be written again), prune
+        them, return the live triples."""
+        live = []
+        for owner, cell, ex in self._cells:
+            if owner.is_alive():
+                live.append((owner, cell, ex))
+            else:
+                self._merge_counts(self._retired, cell)
+                self._merge_exemplars(self._retired_ex, ex)
+        self._cells = live
+        return live
+
+    def _totals(self) -> dict[tuple[str, ...], list]:
+        # fold + retired copy + live snapshot in ONE critical section:
+        # releasing the lock between them would let a concurrent collect
+        # fold a just-died cell into _retired while our stale live list
+        # still holds it — double-counting it in this scrape (and making
+        # the next one appear to go backward)
+        with self._mu:
+            live = self._fold_dead_locked()
+            totals = {lv: list(s) for lv, s in self._retired.items()}
+            cells = [cell for _, cell, _ in live]
+        for cell in cells:
+            self._merge_counts(totals, cell)
+        return totals
+
+    def _exemplars(self) -> dict[tuple[str, ...], list]:
+        """Per label set: newest exemplar per bucket across all cells
+        (same single-critical-section discipline as ``_totals``)."""
+        with self._mu:
+            live = self._fold_dead_locked()
+            merged = {lv: list(exs)
+                      for lv, exs in self._retired_ex.items()}
+            exs_cells = [ex for _, _, ex in live]
+        for ex in exs_cells:
+            self._merge_exemplars(merged, ex)
+        return merged
+
+    def has_exemplars(self) -> bool:
+        # a latched boolean, not a full exemplar merge: negotiation
+        # runs on EVERY /metrics request and only needs yes/no
+        return self._has_exemplars
+
+    def snapshot(self) -> dict[tuple[str, ...], dict]:
+        """Per label set: cumulative finite-bucket counts, total count,
+        and sum — the SLO tracker's read path (workloads/slo.py)."""
+        out = {}
+        for lv, s in self._totals().items():
+            cumulative = []
+            cum = 0
+            for c in s[: len(self.buckets)]:
+                cum += c
+                cumulative.append(cum)
+            out[lv] = {"cumulative": cumulative,
+                       "count": cum + s[len(self.buckets)],
+                       "sum": s[-1]}
+        return out
+
+    @staticmethod
+    def _format_exemplar(ex: tuple) -> str:
+        labels, value, ts = ex
+        lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                       for k, v in sorted(labels.items()))
+        return f" # {{{lbl}}} {value} {round(ts, 3)}"
+
+    def collect(self, openmetrics: bool = False) -> str:
+        """Text exposition.  The default (0.0.4) output is byte-for-byte
+        what the pre-exemplar Histogram emitted — exemplars appear ONLY
+        in the OpenMetrics form, because 0.0.4 parsers reject the
+        ``# {…}`` suffix."""
         out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
-        with self._mu:
-            series = sorted((lv, list(s)) for lv, s in self._series.items())
+        series = sorted(self._totals().items())
+        exemplars = self._exemplars() if openmetrics else {}
         for lv, s in series:
             lbl = ",".join(f'{k}="{_escape_label(v)}"'
                            for k, v in zip(self.labels, lv))
             pre = lbl + "," if lbl else ""
+            exs = exemplars.get(lv, ())
             cum = 0
-            for b, c in zip(self.buckets, s):
+            for i, (b, c) in enumerate(zip(self.buckets, s)):
                 cum += c
-                out.append(f'{self.name}_bucket{{{pre}le="{b}"}} {cum}')
+                line = f'{self.name}_bucket{{{pre}le="{b}"}} {cum}'
+                if openmetrics and i < len(exs) and exs[i] is not None:
+                    line += self._format_exemplar(exs[i])
+                out.append(line)
             cum += s[len(self.buckets)]
-            out.append(f'{self.name}_bucket{{{pre}le="+Inf"}} {cum}')
+            line = f'{self.name}_bucket{{{pre}le="+Inf"}} {cum}'
+            inf_i = len(self.buckets)
+            if openmetrics and len(exs) > inf_i and exs[inf_i] is not None:
+                line += self._format_exemplar(exs[inf_i])
+            out.append(line)
             suffix = f"{{{lbl}}}" if lbl else ""
             out.append(f"{self.name}_sum{suffix} {s[-1]}")
             out.append(f"{self.name}_count{suffix} {cum}")
@@ -230,10 +472,25 @@ class Registry:
                   labels: tuple[str, ...] = ()) -> Histogram:
         return self._get_or_register(Histogram, name, help_, buckets, labels)
 
-    def expose(self) -> str:
+    def has_exemplars(self) -> bool:
+        """Any histogram in this registry holding at least one exemplar
+        — the content-negotiation predicate for /metrics."""
         with self._mu:
             metrics = [m for m, _ in self._metrics.values()]
-        return "\n".join(m.collect() for m in metrics) + "\n"
+        return any(isinstance(m, Histogram) and m.has_exemplars()
+                   for m in metrics)
+
+    def expose(self, openmetrics: bool = False) -> str:
+        """Text exposition of every registered metric.  The default is
+        the Prometheus 0.0.4 text format (unchanged, exemplar-free);
+        ``openmetrics=True`` emits OpenMetrics 1.0 — counter families
+        drop their ``_total`` suffix in HELP/TYPE, histogram buckets
+        carry exemplars, and the payload terminates with ``# EOF``."""
+        with self._mu:
+            metrics = [m for m, _ in self._metrics.values()]
+        body = "\n".join(m.collect(openmetrics=openmetrics)
+                         for m in metrics) + "\n"
+        return body + "# EOF\n" if openmetrics else body
 
 
 DEFAULT_REGISTRY = Registry()
@@ -325,20 +582,16 @@ def serve_http_endpoint(
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self) -> None:  # noqa: N802 (http.server API)
             if self.path == metrics_path:
-                body = reg.expose().encode()
-                ctype = "text/plain; version=0.0.4"
+                text, ctype = negotiate_exposition(
+                    self.headers.get("Accept", ""), reg)
+                body = text.encode()
             elif self.path.startswith(traces_path):
                 # lazy import: metrics must stay importable before (and
-                # without) the tracer; the ring is process-global
-                from tpu_dra.trace import DEFAULT_RING, chrome_trace
-                qs = parse_qs(urlparse(self.path).query)
-                trace_id = qs.get("trace_id", [""])[0]
-                spans = DEFAULT_RING.spans(trace_id=trace_id or None)
-                # default=str: one exotic span attribute must degrade to
-                # its str(), not kill the whole endpoint until the span
-                # ages out of the ring
-                body = json.dumps(chrome_trace(spans),
-                                  default=str).encode()
+                # without) the tracer; the ring is process-global.  The
+                # body builder is shared with serve.py's handler so the
+                # exemplar→trace contract cannot drift between them
+                from tpu_dra.trace.export import debug_traces_body
+                body = debug_traces_body(self.path)
                 ctype = "application/json"
             elif self.path.startswith(pprof_path + "/profile"):
                 qs = parse_qs(urlparse(self.path).query)

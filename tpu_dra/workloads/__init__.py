@@ -52,4 +52,10 @@ The TPU analogs here are first-class framework components
   coordination env (``SLICE_*`` / the mounted settings dir) into
   ``jax.distributed.initialize`` arguments: the consumer side of the
   rendezvous bus (SURVEY.md §2.7.2).
+- :mod:`tpu_dra.workloads.goodput` /
+  :mod:`tpu_dra.workloads.slo` — workload SLO layer
+  (``docs/observability.md``): goodput/badput wall-time segmentation
+  with a cross-process ledger (reconfiguration downtime stamped with
+  the recovery trace id), and multi-window error-budget burn rates
+  computed over the live metrics registry (serve's ``/debug/slo``).
 """

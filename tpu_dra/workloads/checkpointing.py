@@ -23,6 +23,8 @@ from typing import Any
 
 import jax
 
+from tpu_dra.workloads import goodput
+
 # orbax commits a step directory by writing this marker as the LAST file
 # before the atomic tmp->final rename; a bare numeric step directory
 # without it is a crash artifact (non-atomic filesystem, or a writer
@@ -90,14 +92,19 @@ def save_train_state(directory: str, step: int, params: Any,
     state = {"params": params}
     if extra is not None:
         state["extra"] = extra
-    # sweep crash artifacts (uncommitted step dirs) before writing: the
-    # saver owns the directory, and a bare leftover of an interrupted
-    # save at this step number would fail or shadow the new one
-    if os.path.isdir(directory):
-        _complete_steps(directory, clean=True)
-    with _manager(directory, max_to_keep, create=True) as mgr:
-        mgr.save(step, args=ocp.args.StandardSave(state))
-        mgr.wait_until_finished()
+    # goodput hook: durability time is badput every caller pays here, so
+    # the segmentation lives here too (no-op unless the workload opted
+    # into goodput accounting — workloads/goodput.py)
+    with goodput.measure(goodput.SEG_CHECKPOINT_SAVE):
+        # sweep crash artifacts (uncommitted step dirs) before writing:
+        # the saver owns the directory, and a bare leftover of an
+        # interrupted save at this step number would fail or shadow the
+        # new one
+        if os.path.isdir(directory):
+            _complete_steps(directory, clean=True)
+        with _manager(directory, max_to_keep, create=True) as mgr:
+            mgr.save(step, args=ocp.args.StandardSave(state))
+            mgr.wait_until_finished()
 
 
 def latest_step(directory: str) -> int | None:
@@ -168,18 +175,25 @@ def restore_train_state(directory: str, *, step: int | None = None,
     if not os.path.isdir(directory):
         # read path: never mkdir a typo'd directory as a side effect
         raise FileNotFoundError(f"no checkpoints under {directory}")
-    complete = _complete_steps(directory)
-    with _manager(directory, create=False) as mgr:
-        step = (complete[-1] if complete else None) if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
-        if template is not None:
-            tmpl = jax.tree.map(
-                lambda x: ocp.utils.to_shape_dtype_struct(x)
-                if hasattr(x, "shape") else x, template)
-            return mgr.restore(step, args=ocp.args.StandardRestore(tmpl))
-        # explicit StandardRestore (no template): a bare mgr.restore()
-        # can only infer the handler when THIS process already saved —
-        # a freshly-respawned elastic worker restoring someone else's
-        # checkpoint has no such registration
-        return mgr.restore(step, args=ocp.args.StandardRestore())
+    # goodput hook: restore time is recovery badput (the elastic resume
+    # path lands here after every reconfiguration)
+    with goodput.measure(goodput.SEG_RESTORE):
+        complete = _complete_steps(directory)
+        with _manager(directory, create=False) as mgr:
+            step = (complete[-1] if complete else None) \
+                if step is None else step
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {directory}")
+            if template is not None:
+                tmpl = jax.tree.map(
+                    lambda x: ocp.utils.to_shape_dtype_struct(x)
+                    if hasattr(x, "shape") else x, template)
+                return mgr.restore(
+                    step, args=ocp.args.StandardRestore(tmpl))
+            # explicit StandardRestore (no template): a bare
+            # mgr.restore() can only infer the handler when THIS process
+            # already saved — a freshly-respawned elastic worker
+            # restoring someone else's checkpoint has no such
+            # registration
+            return mgr.restore(step, args=ocp.args.StandardRestore())

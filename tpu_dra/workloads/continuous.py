@@ -97,6 +97,10 @@ class _Request:
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     submitted: float = field(default_factory=time.perf_counter)
+    # when the FIRST generated token landed (perf_counter): the serving
+    # layer's TTFT numerator; 0.0 until then.  With finished and
+    # len(tokens) it also yields the request's mean inter-token gap.
+    first_token_at: float = 0.0
     finished: float = 0.0
     error: Optional[str] = None
 
@@ -1542,6 +1546,7 @@ class ContinuousEngine:
         self._keys = self._keys.at[slot].set(jax.random.fold_in(key, 1))
         self._eos = self._eos.at[slot].set(
             -1 if req.eos_id is None else req.eos_id)
+        req.first_token_at = time.perf_counter()
         req.tokens.append(first_host)
         self._emitted[slot] = 1
         hit_stop = bool(req.stop) and first_host != req.eos_id \
@@ -1681,6 +1686,8 @@ class ContinuousEngine:
                     if self._emitted[slot] >= req.steps:
                         break
                     tok = int(toks_host[slot, j])
+                    if not req.first_token_at:
+                        req.first_token_at = time.perf_counter()
                     req.tokens.append(tok)
                     self._emitted[slot] += 1
                     if req.eos_id is not None and tok == req.eos_id:

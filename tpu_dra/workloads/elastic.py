@@ -185,7 +185,8 @@ def run_elastic(argv: list[str], env: Optional[dict] = None,
                 member_timeout: Optional[float] = None,
                 reconfigure_grace: float = 60.0,
                 stop: Optional[threading.Event] = None,
-                on_spawn: Optional[Callable] = None) -> int:
+                on_spawn: Optional[Callable] = None,
+                goodput_tracker=None) -> int:
     """Supervise an elastic train process (no jax in THIS process).
 
     Each round waits until this node is an active member, then spawns
@@ -203,24 +204,47 @@ def run_elastic(argv: list[str], env: Optional[dict] = None,
     real failure before the membership change that explains it becomes
     visible.  The 60s default covers the controller defaults; lower it
     in lockstep when the domain runs with shorter leases.
+
+    ``goodput_tracker`` (a ``workloads/goodput.GoodputTracker``): the
+    supervisor is the only process that can SEE reconfiguration downtime
+    — the worker is dead for all of it — so the worker-exit → respawn
+    interval is recorded here, attributed to the ``reconfiguration``
+    segment and stamped with the recovery traceparent from the new
+    coordination config.  When the tracker carries a state file its path
+    is injected as ``TPU_GOODPUT_FILE`` so the spawned worker's own
+    segments (steps, compile, checkpoints) merge into the same ledger.
     """
     e = dict(os.environ) if env is None else dict(env)
     reconfigurations = 0
+    downtime_from: Optional[float] = None
     while True:
         epoch = wait_until_member(e, poll=poll, timeout=member_timeout,
                                   stop=stop)
         if epoch is None:
             return 130   # stopped while parked
+        if goodput_tracker is not None and downtime_from is not None:
+            # downtime closes HERE — membership re-resolved, about to
+            # respawn — so the segment covers detection + arbitration +
+            # config propagation, the whole recovery the workload felt
+            goodput_tracker.record_downtime(
+                time.monotonic() - downtime_from,
+                traceparent=epoch.traceparent,
+                generation=epoch.generation)
+        downtime_from = None
         child_env = dict(e)
         child_env["TPU_ELASTIC_GENERATION"] = str(epoch.generation)
         if epoch.traceparent:
             child_env["TPU_TRACEPARENT"] = epoch.traceparent
+        if goodput_tracker is not None and goodput_tracker.state_path:
+            from tpu_dra.workloads.goodput import STATE_ENV
+            child_env[STATE_ENV] = goodput_tracker.state_path
         proc = subprocess.Popen(argv, env=child_env)
         if on_spawn is not None:
             on_spawn(proc, epoch)
         rc = proc.wait()
         if rc == 0:
             return 0
+        downtime_from = time.monotonic()
         changed = rc == EXIT_RECONFIGURED
         waiter = stop if stop is not None else threading.Event()
         deadline = time.monotonic() + reconfigure_grace

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from tpu_dra.workloads import goodput
 from tpu_dra.workloads.checkpointing import (
     latest_step,
     restore_train_state,
@@ -153,7 +154,18 @@ def fit(cfg: ModelConfig, data_path: str, *, mesh: Mesh | None = None,
     tokens_done = 0
     for step in range(start, start + steps):
         tokens = next(it)
-        params, opt_state, loss = step_fn(params, opt_state, tokens)
+        # goodput hooks (workloads/goodput.py, no-ops unless opted in):
+        # the first step carries the JIT compile and is badput; data
+        # stalls between steps fall into the `blocked` catch-all; the
+        # save below segments itself inside checkpointing.py
+        seg = goodput.SEG_COMPILE if step == start else goodput.SEG_STEP
+        with goodput.measure(seg):
+            params, opt_state, loss = step_fn(params, opt_state, tokens)
+            # step time must include the device work, not just dispatch:
+            # only materialize when accounting is on (block_until_ready
+            # on every step would serialize the async dispatch pipeline)
+            if goodput.default_tracker().started:
+                jax.block_until_ready(loss)
         tokens_done += tokens.shape[0] * (tokens.shape[1] - 1)
         if log_every and (step + 1) % log_every == 0:
             lossf = float(loss)

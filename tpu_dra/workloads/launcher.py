@@ -418,11 +418,17 @@ def init_tpu_workload(env: Optional[dict[str, str]] = None,
     # container (TPU_TRACEPARENT env, trace/propagation contract)
     with get_tracer().start_span("launcher.init_tpu_workload",
                                  parent=_trace_parent(env)) as span:
+        # goodput accounting rides the same opt-in pattern as the
+        # heartbeat: the supervisor (or operator) sets TPU_GOODPUT_FILE
+        # and every workload entry point starts segmenting (no-op
+        # otherwise — workloads/goodput.py)
+        from tpu_dra.workloads import goodput
         applied = {
             "slot": acquire_multiprocess_slot(env),
             "hbm_limit_bytes": apply_hbm_limits(env),
             "nice": apply_scheduling_priority(env),
             "heartbeat": start_health_heartbeat(env),
+            "goodput": goodput.start_from_env(env) is not None,
         }
         span.set_attribute("slot", bool(applied["slot"]))
         span.set_attribute("hbm_limited",

@@ -38,9 +38,17 @@ POST /speculative {"tokens": [[...]], "steps": N, "k": 4,
 GET  /healthz → 200 "ok" while the engine decode loop is live (and any
              wired chip-health monitor agrees); 503 + reason when the
              batcher died/wedged, so k8s probes restart a wedged server
-GET  /metrics → Prometheus text (version 0.0.4): request counts by
-             path/code, generated-token total, request-latency histogram,
-             and (continuous mode) tpu_serve_engine_* gauges
+GET  /metrics → Prometheus text: request counts by path/code/tenant,
+             generated-token total, request-latency + TTFT + inter-token
+             histograms (per-tenant via the X-Tenant header), and
+             (continuous mode) tpu_serve_engine_* gauges.  With
+             ``Accept: application/openmetrics-text`` (and exemplars
+             present) the exposition is OpenMetrics 1.0 with trace-id
+             exemplars on the histogram buckets.
+GET  /debug/slo → multi-window error-budget burn rates (availability +
+             latency objectives) computed from the live registry
+GET  /debug/traces[?trace_id=] → Chrome trace JSON of this process's
+             span ring — where /metrics exemplar trace ids resolve
 """
 
 from __future__ import annotations
@@ -54,9 +62,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import jax
 import jax.numpy as jnp
 
+from tpu_dra.trace import get_tracer
+from tpu_dra.trace.export import debug_traces_body
 from tpu_dra.util import klog
-from tpu_dra.util.metrics import Registry
+from tpu_dra.util.metrics import Registry, negotiate_exposition
 from tpu_dra.workloads.decode import beam_decode, decode
+from tpu_dra.workloads.slo import (
+    Objective,
+    SloTracker,
+    counter_good_total,
+    histogram_under,
+)
 from tpu_dra.workloads.train import ModelConfig
 
 # upper bound on one continuous-mode request's wall time (compile included)
@@ -262,15 +278,38 @@ class ServeMetrics:
     """Prometheus series for the inference endpoint (util/metrics
     registry — same exposition format as the driver processes').  The
     serving-side counterpart of the controller's /metrics
-    (reference main.go:194-214)."""
+    (reference main.go:194-214).
+
+    Per-tenant SLO labeling: every request series carries a ``tenant``
+    label (the ``X-Tenant`` request header; ``default`` when absent) so
+    one shared server's latency/error budgets split by customer.  The
+    header is untrusted input becoming a metric label, so cardinality is
+    capped: the first :data:`MAX_TENANTS` distinct values keep their own
+    series, everything later collapses into ``other`` (and values are
+    length-clamped) — an anonymous client cycling header values must not
+    be able to grow series memory and scrape size without bound.
+
+    The request/TTFT/ITL histograms attach the serving span's trace id
+    as an OpenMetrics exemplar — scrape with
+    ``Accept: application/openmetrics-text`` and jump from a slow bucket
+    straight to its trace in /debug/traces."""
+
+    MAX_TENANTS = 64
+    # the overflow sentinel contains "~", which tenant_label strips from
+    # client input — no client-chosen header value can claim this series
+    # and have strangers' post-cap traffic merged into its SLOs
+    OVERFLOW_TENANT = "~overflow~"
 
     def __init__(self) -> None:
         self.registry = Registry()
+        self._tenants: set[str] = set()        # guarded by _tenant_mu
+        self._tenant_mu = threading.Lock()
         # tpu_serve_* is the TENANT-side serving namespace on a private
         # registry (the workload's own endpoint, not the driver fleet's
         # /metrics) — exempt from the driver's tpu_dra_* naming contract
         self.requests = self.registry.counter(  # vet: ignore[metric-hygiene]
-            "tpu_serve_requests_total", "HTTP requests", ("path", "code"))
+            "tpu_serve_requests_total", "HTTP requests",
+            ("path", "code", "tenant"))
         self.tokens = self.registry.counter(  # vet: ignore[metric-hygiene]
             "tpu_serve_generated_tokens_total", "tokens generated")
         self.latency = self.registry.histogram(  # vet: ignore[metric-hygiene]
@@ -280,14 +319,53 @@ class ServeMetrics:
             # would collapse every cold hit into +Inf
             buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
                      5, 10, 30, 60, 120, 300, 600),
-            labels=("path",))
+            labels=("path", "tenant"))
+        self.ttft = self.registry.histogram(  # vet: ignore[metric-hygiene]
+            "tpu_serve_ttft_seconds",
+            "time to first generated token (continuous engine requests)",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+                     5, 10, 30, 60),
+            labels=("tenant",))
+        self.itl = self.registry.histogram(  # vet: ignore[metric-hygiene]
+            "tpu_serve_inter_token_seconds",
+            "mean gap between generated tokens, one observation per "
+            "continuous-engine request of 2+ tokens",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1, 2.5),
+            labels=("tenant",))
+
+    def tenant_label(self, raw: str) -> str:
+        """Bound the untrusted ``X-Tenant`` header into a safe label
+        value (see class docstring)."""
+        tenant = (raw or "default").replace("~", "_")[:64] or "default"
+        with self._tenant_mu:
+            if tenant in self._tenants:
+                return tenant
+            if len(self._tenants) < self.MAX_TENANTS:
+                self._tenants.add(tenant)
+                return tenant
+        return self.OVERFLOW_TENANT
 
     def observe(self, path: str, code: int, secs: float,
-                tokens: int = 0) -> None:
-        self.requests.inc(path, str(code))
-        self.latency.observe(secs, path)
+                tokens: int = 0, tenant: str = "default") -> None:
+        self.requests.inc(path, str(code), tenant)
+        self.latency.observe(secs, path, tenant)
         if tokens:
             self.tokens.inc(by=tokens)
+
+    def observe_engine_timing(self, tenant: str, handle) -> None:
+        """TTFT + mean inter-token gap from a finished engine handle's
+        timestamps (continuous mode; the bucketed pool decodes in one
+        jit call and has no first-token observable)."""
+        if not handle.first_token_at:
+            return
+        self.ttft.observe(handle.first_token_at - handle.submitted,
+                          tenant)
+        n = len(handle.tokens)
+        end = handle.finished or handle.first_token_at
+        if n >= 2 and end > handle.first_token_at:
+            self.itl.observe((end - handle.first_token_at) / (n - 1),
+                             tenant)
 
     def scrape_engine(self, engine) -> None:
         """Refresh the continuous-engine gauges at scrape time — through
@@ -304,12 +382,21 @@ class ServeMetrics:
                                         stats.get("queued")),
             "tpu_serve_engine_active": ("requests decoding in a slot",
                                         stats.get("active")),
+            # engine-computed quantiles are DEPRECATED in favor of
+            # histogram_quantile() over tpu_serve_request_seconds (a
+            # gauge quantile cannot be aggregated across replicas and
+            # carries no exemplars); both are emitted for one release so
+            # existing dashboards keep rendering — docs/observability.md
             "tpu_serve_engine_request_p50_seconds": (
-                "per-request latency p50 over the stats window",
+                "per-request latency p50 over the stats window "
+                "(DEPRECATED: use histogram_quantile(0.5, "
+                "tpu_serve_request_seconds); removed next release)",
                 stats.get("latency_p50_ms", 0) / 1e3
                 if "latency_p50_ms" in stats else None),
             "tpu_serve_engine_request_p95_seconds": (
-                "per-request latency p95 over the stats window",
+                "per-request latency p95 over the stats window "
+                "(DEPRECATED: use histogram_quantile(0.95, "
+                "tpu_serve_request_seconds); removed next release)",
                 stats.get("latency_p95_ms", 0) / 1e3
                 if "latency_p95_ms" in stats else None),
             "tpu_serve_engine_spec_target_passes": (
@@ -330,7 +417,8 @@ class ServeMetrics:
 
 
 def make_handler(pool: DecoderPool, engine=None, metrics=None,
-                 health=None, health_stale_after: float = 600.0):
+                 health=None, health_stale_after: float = 600.0,
+                 slo=None):
     """``engine`` (a ContinuousEngine) takes over /generate when given:
     every row becomes its own engine request, fanned in via submit_async
     so one HTTP call's rows still decode concurrently.
@@ -341,7 +429,9 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
     ``health_stale_after``: seconds without a decode-loop heartbeat
     before /healthz reports wedged — MUST exceed the model's worst-case
     cold JIT compile (which legitimately blocks the loop), or a liveness
-    probe mid-compile restarts the pod into a recompile crash loop."""
+    probe mid-compile restarts the pod into a recompile crash loop.
+    ``slo``: an :class:`~tpu_dra.workloads.slo.SloTracker`; when given,
+    GET /debug/slo answers with its multi-window burn rates."""
 
     def healthz_verdict() -> tuple[bool, str]:
         ok, detail = True, "ok"
@@ -365,7 +455,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     f"the server without --continuous for per-request "
                     f"{knob}")
 
-    def engine_generate(req) -> dict:
+    def engine_generate(req, tenant: str = "default") -> dict:
         rows = req["tokens"]
         if not rows or not all(rows):
             raise ValueError("tokens must be a non-empty list of "
@@ -393,6 +483,8 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     f"request not done within {ENGINE_REQUEST_TIMEOUT_S}s")
             if h.error:
                 raise RuntimeError(h.error)
+            if metrics is not None:
+                metrics.observe_engine_timing(tenant, h)
             out.append(h.tokens)
         return {"tokens": out}
 
@@ -437,8 +529,17 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
             elif self.path == "/metrics" and metrics is not None:
                 if engine is not None:
                     metrics.scrape_engine(engine)
-                self._send(200, metrics.registry.expose().encode(),
-                           "text/plain; version=0.0.4")
+                text, ctype = negotiate_exposition(
+                    self.headers.get("Accept", ""), metrics.registry)
+                self._send(200, text.encode(), ctype)
+            elif self.path == "/debug/slo" and slo is not None:
+                self._send(200, json.dumps(slo.burn_rates()).encode())
+            elif self.path.split("?", 1)[0] == "/debug/traces":
+                # the SHARED body builder (trace/export.py) — same
+                # contract as the driver binaries' endpoint; the
+                # exemplar trace ids on /metrics resolve HERE, on the
+                # same process
+                self._send(200, debug_traces_body(self.path))
             elif self.path.split("?", 1)[0] == "/debug/jax-trace":
                 self._jax_trace()
             else:
@@ -494,6 +595,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
             renders output while a long generation is still running."""
             t0 = time.perf_counter()
             code, toks = 200, 0
+            tenant = self._tenant()
             try:
                 # body FIRST: on keep-alive (HTTP/1.1) an unread request
                 # body would be parsed as the next request
@@ -525,14 +627,16 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     json.JSONDecodeError) as exc:
                 if metrics is not None:
                     metrics.observe(self.path, 400,
-                                    time.perf_counter() - t0)
+                                    time.perf_counter() - t0,
+                                    tenant=tenant)
                 self._send(400, json.dumps(
                     {"error": str(exc)[:300]}).encode())
                 return
             except RuntimeError as exc:    # engine shut down mid-request
                 if metrics is not None:
                     metrics.observe(self.path, 500,
-                                    time.perf_counter() - t0)
+                                    time.perf_counter() - t0,
+                                    tenant=tenant)
                 self._send(500, json.dumps(
                     {"error": str(exc)[:300]}).encode())
                 return
@@ -552,9 +656,10 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     body = json.dumps(
                         {"done": True, "tokens": handle.tokens}).encode()
                 if metrics is not None:
+                    metrics.observe_engine_timing(tenant, handle)
                     metrics.observe(self.path, code,
                                     time.perf_counter() - t0,
-                                    len(handle.tokens))
+                                    len(handle.tokens), tenant)
                 self._send(code, body)
                 return
             self.send_response(200)
@@ -607,36 +712,57 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
             except OSError:
                 pass
             if metrics is not None:
+                metrics.observe_engine_timing(tenant, handle)
                 metrics.observe(self.path, code,
-                                time.perf_counter() - t0, toks)
+                                time.perf_counter() - t0, toks, tenant)
+
+        def _tenant(self) -> str:
+            """Per-tenant SLO attribution: the ``X-Tenant`` header names
+            the customer; absent/empty collapses into ``default``,
+            and the value is cardinality-capped before it becomes a
+            label (ServeMetrics.tenant_label)."""
+            raw = self.headers.get("X-Tenant", "") or "default"
+            return metrics.tenant_label(raw) if metrics is not None \
+                else raw
 
         def _json_post(self, handle):
             """Shared /generate + /beam plumbing: parse the JSON body,
-            call ``handle(req) -> response dict``, map bad input to a
-            400 JSON error.  Every request lands in the /metrics series
-            (count by code, wall-time histogram, generated tokens) —
-            recorded BEFORE the response is sent, so a client that has
-            its reply is guaranteed to find the request on a subsequent
-            scrape (observing after the send races the next request on
-            a busy host)."""
+            call ``handle(req, tenant) -> response dict``, map bad input
+            to a 400 JSON error.  Every request lands in the /metrics
+            series (count by code, wall-time histogram, generated
+            tokens) — recorded BEFORE the response is sent, so a client
+            that has its reply is guaranteed to find the request on a
+            subsequent scrape (observing after the send races the next
+            request on a busy host).
+
+            The whole request runs inside a ``serve.request`` span
+            (standard head sampling), and the latency observation
+            happens INSIDE it: a sampled request's trace id rides the
+            histogram as an OpenMetrics exemplar, so an operator can go
+            from a slow bucket to the exact trace."""
             t0 = time.perf_counter()
             code, toks = 200, 0
-            try:
-                n = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(n))
-                result = handle(req)
-                toks = _count_leaf_tokens(result.get("tokens"))
-                body = json.dumps(result).encode()
-            except (KeyError, ValueError, TypeError,
-                    NotImplementedError, json.JSONDecodeError) as exc:
-                code = 400
-                body = json.dumps({"error": str(exc)[:300]}).encode()
-            except RuntimeError as exc:   # engine-side failure, not input
-                code = 500
-                body = json.dumps({"error": str(exc)[:300]}).encode()
-            if metrics is not None:
-                metrics.observe(self.path, code,
-                                time.perf_counter() - t0, toks)
+            tenant = self._tenant()
+            with get_tracer().start_span(
+                    "serve.request",
+                    attributes={"path": self.path, "tenant": tenant}):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    result = handle(req, tenant)
+                    toks = _count_leaf_tokens(result.get("tokens"))
+                    body = json.dumps(result).encode()
+                except (KeyError, ValueError, TypeError,
+                        NotImplementedError, json.JSONDecodeError) as exc:
+                    code = 400
+                    body = json.dumps({"error": str(exc)[:300]}).encode()
+                except RuntimeError as exc:   # engine failure, not input
+                    code = 500
+                    body = json.dumps({"error": str(exc)[:300]}).encode()
+                if metrics is not None:
+                    metrics.observe(self.path, code,
+                                    time.perf_counter() - t0, toks,
+                                    tenant)
             self._send(code, body)
 
         def do_POST(self):
@@ -645,7 +771,14 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                 return None if eos is None else int(eos)
 
             if self.path == "/stream":
-                self._stream()
+                # span opened out here so every metrics observation the
+                # stream makes (latency, TTFT, ITL) can carry its trace
+                # id as an exemplar
+                with get_tracer().start_span(
+                        "serve.request",
+                        attributes={"path": self.path,
+                                    "tenant": self._tenant()}):
+                    self._stream()
             elif self.path == "/prefix":
                 if engine is None:
                     self._drain_body()
@@ -655,12 +788,12 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                                   "KV)"}).encode())
                     return
 
-                def handle(req):
+                def handle(req, tenant):
                     return {"prefix_id":
                             engine.register_prefix(req["tokens"])}
                 self._json_post(handle)
             elif self.path == "/beam":
-                def handle(req):
+                def handle(req, tenant):
                     hyps, scores = pool.beam(
                         req["tokens"], int(req.get("steps", 16)),
                         int(req.get("beams", 4)), eos_id=eos_of(req),
@@ -669,7 +802,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     return {"tokens": hyps, "scores": scores}
                 self._json_post(handle)
             elif self.path == "/speculative":
-                def handle(req):
+                def handle(req, tenant):
                     toks, passes = pool.speculative(
                         req["tokens"], int(req.get("steps", 16)),
                         int(req.get("k", 4)),
@@ -684,7 +817,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     self._json_post(engine_generate)
                     return
 
-                def handle(req):
+                def handle(req, tenant):
                     return {"tokens": pool.generate(
                         req["tokens"], int(req.get("steps", 16)),
                         float(req.get("temperature", 0.0)),
@@ -791,7 +924,10 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           kv_layout: str = "slab", page_size: int = 64,
           total_pages: int | None = None,
           logit_bias: dict[int, float] | None = None,
-          health=None, health_stale_after: float = 600.0
+          health=None, health_stale_after: float = 600.0,
+          slo_latency_threshold: float = 1.0,
+          slo_latency_target: float = 0.99,
+          slo_availability_target: float = 0.999,
           ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
@@ -835,21 +971,39 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
             kv_layout=kv_layout, page_size=page_size,
             total_pages=total_pages, logit_bias=logit_bias)
     metrics = ServeMetrics()
+    # /debug/slo: multi-window error-budget burn rates computed over the
+    # live registry (workloads/slo.py) — availability (non-5xx) and the
+    # latency objective ("slo_latency_target of requests under
+    # slo_latency_threshold seconds", rounded down to a histogram
+    # bucket boundary so the verdict is never optimistic)
+    slo = SloTracker([
+        Objective("availability", slo_availability_target,
+                  counter_good_total(
+                      metrics.requests,
+                      is_bad=lambda lv: lv[1].startswith("5")),
+                  description="non-5xx responses over all responses"),
+        Objective("latency", slo_latency_target,
+                  histogram_under(metrics.latency, slo_latency_threshold),
+                  description=f"requests faster than "
+                              f"{slo_latency_threshold}s"),
+    ]).start()
     srv = ThreadingHTTPServer((host, port),
                               make_handler(pool, engine, metrics, health,
-                                           health_stale_after))
+                                           health_stale_after, slo=slo))
     srv.engine = engine               # reachable for stats
     srv.metrics = metrics
-    if engine is not None:
-        # srv.shutdown() is the documented stop mechanism — it must also
-        # stop the batcher thread and drop the slot cache, or every
-        # start/stop cycle leaks both
-        orig_shutdown = srv.shutdown
+    srv.slo = slo
+    # srv.shutdown() is the documented stop mechanism — it must also
+    # stop the SLO sampler (and in continuous mode the batcher thread +
+    # slot cache), or every start/stop cycle leaks them
+    orig_shutdown = srv.shutdown
 
-        def shutdown():
-            orig_shutdown()
+    def shutdown():
+        orig_shutdown()
+        slo.stop()
+        if engine is not None:
             engine.shutdown()
-        srv.shutdown = shutdown
+    srv.shutdown = shutdown
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -928,6 +1082,18 @@ def main(argv=None):
                          "/healthz reports 503; must exceed the model's "
                          "worst-case cold JIT compile or liveness probes "
                          "restart the pod into a recompile loop")
+    ap.add_argument("--slo-latency-threshold", type=float, default=1.0,
+                    help="latency SLO threshold in seconds (rounded down "
+                         "to a tpu_serve_request_seconds bucket boundary "
+                         "for the /debug/slo burn-rate computation)")
+    ap.add_argument("--slo-latency-target", type=float, default=0.99,
+                    help="fraction of requests that must beat the "
+                         "latency threshold")
+    ap.add_argument("--slo-availability-target", type=float,
+                    default=0.999,
+                    help="fraction of requests that must not 5xx")
+    from tpu_dra.util.flags import tracing_flags
+    tracing_flags().add_to(ap)
     ap.add_argument("--warmup", action="store_true",
                     help="continuous mode: compile every prompt-bucket "
                          "program before accepting traffic (first "
@@ -971,6 +1137,8 @@ def main(argv=None):
     ap.add_argument("--draft-d-ff", type=int, default=512)
     args = ap.parse_args(argv)
 
+    from tpu_dra.trace import configure_from_args
+    configure_from_args(args, service="tpu-serve")
     init_tpu_workload()
     cfg = ModelConfig(vocab=args.vocab, d_model=args.d_model,
                       n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
@@ -1071,7 +1239,10 @@ def main(argv=None):
                 speculative_engine=args.speculative_continuous,
                 kv_layout=args.kv_layout, page_size=args.page_size,
                 total_pages=args.total_pages, logit_bias=logit_bias,
-                health_stale_after=args.health_stale_after)
+                health_stale_after=args.health_stale_after,
+                slo_latency_threshold=args.slo_latency_threshold,
+                slo_latency_target=args.slo_latency_target,
+                slo_availability_target=args.slo_availability_target)
     if args.warmup:
         if srv.engine is None:
             ap.error("--warmup needs --continuous")
