@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -88,6 +88,15 @@ drive-preempt:
 drive-serve:
 	$(PYTHON) hack/drive_serve.py
 
+# overload acceptance (docs/resilience.md "Overload and drain"): a truly
+# open-loop generator drives the REAL serve binary at ~4x its
+# (failpoint-pinned) sustainable QPS — admitted p99 within gate, sheds
+# answered fast with valid Retry-After, tenant fairness under flood,
+# deadline expiry frees paged-KV pages, mid-load SIGTERM drains with
+# zero in-flight losses
+drive-overload:
+	$(PYTHON) hack/drive_overload.py
+
 proto:
 	cd tpu_dra/kubeletplugin/proto && \
 	protoc --python_out=. dra_v1beta1.proto pluginregistration.proto
@@ -117,6 +126,7 @@ racecheck:
 # vet-baseline.json, never grow).  See docs/static-analysis.md.
 vet:
 	$(PYTHON) -m tpu_dra.analysis tpu_dra/
+	$(PYTHON) -m tpu_dra.analysis --checks deadline-hygiene hack/
 	$(PYTHON) -m tpu_dra.analysis --stats --baseline vet-baseline.json tpu_dra/
 
 STRESS_RUNS ?= 5

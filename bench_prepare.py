@@ -157,6 +157,27 @@ def bench_observe_idle(n: int = 50_000, repeats: int = 3) -> dict:
     return {"n": n, "per_observe_us": round(best / n * 1e6, 4)}
 
 
+def bench_admission_idle(n: int = 20_000, repeats: int = 3) -> dict:
+    """ISSUE 9 admission gate: one ``acquire``/``release`` round trip on
+    an UNSATURATED controller (no backlog, one tenant — the production
+    idle shape) must stay a disarmed-failpoint flag read plus a few
+    integer compares under an uncontended lock.  A regression here (a
+    list scan, an allocation burst, an armed-path lookup) lands on
+    EVERY serve request, exactly the cost class PR 6 evicted from the
+    prepare path.  Best-of-``repeats`` so a scheduler preemption cannot
+    inflate the number."""
+    from tpu_dra.workloads.admission import AdmissionController
+
+    ctl = AdmissionController(1_000_000)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctl.release(ctl.acquire("bench", 100), completed=False)
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_check_us": round(best / n * 1e6, 4)}
+
+
 def bench_cpu_probe() -> float:
     """p90 of a fixed CPU-bound unit (json round-trip of a prepare-sized
     payload, no I/O): the second arming condition for the absolute gate.
@@ -347,6 +368,7 @@ def run_all() -> dict:
         "fs": bench_fs_floor(base),
         "cpu_probe_p90_ms": bench_cpu_probe(),
         "observe_idle": bench_observe_idle(),
+        "admission_idle": bench_admission_idle(),
         "direct": bench_direct(base),
         "concurrent": bench_concurrent(base),
     }
@@ -384,6 +406,8 @@ def _gates(report: dict) -> dict[str, float]:
             report["concurrent"]["flushes_per_mutation"],
         "histogram_observe_idle_us":
             report["observe_idle"]["per_observe_us"],
+        "admission_check_idle_us":
+            report["admission_idle"]["per_check_us"],
     }
 
 

@@ -105,7 +105,8 @@ def main():
             def slice_devices():
                 url = (f"http://127.0.0.1:{srv.port}/apis/resource.k8s.io/"
                        "v1beta1/resourceslices")
-                items = json.load(urllib.request.urlopen(url))["items"]
+                items = json.load(
+                    urllib.request.urlopen(url, timeout=10))["items"]
                 return [d["name"] for s in items
                         for d in s["spec"]["devices"]]
 

@@ -90,7 +90,8 @@ def main():
             # 2. ResourceSlice published, visible over the HTTP facade
             url = (f"http://127.0.0.1:{srv.port}/apis/resource.k8s.io/"
                    "v1beta1/resourceslices")
-            slices = json.load(urllib.request.urlopen(url))["items"]
+            slices = json.load(
+                urllib.request.urlopen(url, timeout=10))["items"]
             assert len(slices) == 1, slices
             devs = [d["name"] for d in slices[0]["spec"]["devices"]]
             assert devs == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], devs

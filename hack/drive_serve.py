@@ -16,8 +16,9 @@ Phase 1 — serving SLOs (the data-plane half of ISSUE 8):
     client Accepts it;
   - at least one histogram exemplar's trace_id RESOLVES in
     /debug/traces on the same process (the metric→trace jump);
-  - the deprecated engine p50/p95 gauges are still exported (one
-    release of dashboard compatibility) alongside the histograms;
+  - the engine p50/p95 gauges (deprecated one release in PR 8) are
+    now ABSENT — histogram_quantile over the request histogram is the
+    replacement;
   - /debug/slo reports zero availability burn and a live latency
     objective.
 
@@ -157,44 +158,94 @@ def make_checkpoint(base: str) -> str:
     return ckpt
 
 
+# bounded per-request connect/read timeout for the load generator: a
+# saturated listener must turn into RECORDED timeout errors at the
+# offered rate, never into requests blocking without bound — a
+# generator whose threads all sit in 60s connects degenerates into a
+# closed loop (offered rate ≈ live_threads / timeout) and masks the
+# very overload it is supposed to demonstrate
+LOAD_TIMEOUT_S = 15.0
+
+
 class LoadResult:
     def __init__(self):
-        self.latencies: list[float] = []
-        self.errors: list[str] = []
+        self.latencies: list[float] = []       # 200s only
+        self.errors: list[str] = []            # non-2xx + transport
+        # every attempt: (tenant, code, latency_s, retry_after_raw);
+        # code None = transport error/timeout — the overload drive
+        # gates fairness and shed latency on these
+        self.records: list[tuple] = []
         self.sent = 0
         self.mu = threading.Lock()
 
+    def by_tenant(self) -> dict:
+        out: dict[str, dict[str, int]] = {}
+        with self.mu:
+            for tenant, code, _lat, _ra in self.records:
+                bucket = out.setdefault(
+                    tenant, {"ok": 0, "shed": 0, "other": 0})
+                if code == 200:
+                    bucket["ok"] += 1
+                elif code == 503:
+                    bucket["shed"] += 1
+                else:
+                    bucket["other"] += 1
+        return out
 
-def run_load(base_url: str, schedule=QPS_SCHEDULE) -> LoadResult:
-    """Open-loop scripted load: one pacing thread enqueues request
-    threads at the scheduled rate (an open loop, so a slow server shows
-    up as latency, not as a silently lower offered rate)."""
+
+def run_load(base_url: str, schedule=QPS_SCHEDULE, *, path="/generate",
+             body_of=None, tenant_of=None, headers_of=None,
+             timeout_s=LOAD_TIMEOUT_S, ok_codes=(200,)) -> LoadResult:
+    """Truly open-loop scripted load: one pacing thread spawns request
+    threads at the scheduled rate and NEVER touches the network itself,
+    and every request carries a bounded connect/read timeout — a slow
+    or saturated server shows up as latency, shed codes, or timeout
+    errors, never as a silently lower offered rate.
+
+    ``body_of(i)``/``tenant_of(i)``/``headers_of(i)`` parameterize the
+    per-request payload so overload drives (hack/drive_overload.py)
+    reuse this generator; ``ok_codes`` widens which statuses stay out
+    of ``errors`` (an overload drive EXPECTS 503s)."""
     result = LoadResult()
     tenants = ("alpha", "beta")
     threads: list[threading.Thread] = []
+    if tenant_of is None:
+        tenant_of = lambda i: tenants[i % len(tenants)]  # noqa: E731
+    if body_of is None:
+        body_of = lambda i: {"tokens": [[(i % 60) + 1, 2, 3]],  # noqa: E731
+                             "steps": 4}
 
     def one(i: int) -> None:
-        body = json.dumps({"tokens": [[(i % 60) + 1, 2, 3]],
-                           "steps": 4}).encode()
+        tenant = tenant_of(i)
+        headers = {"Content-Type": "application/json",
+                   "X-Tenant": tenant}
+        if headers_of is not None:
+            headers.update(headers_of(i))
         req = urllib.request.Request(
-            f"{base_url}/generate", data=body,
-            headers={"Content-Type": "application/json",
-                     "X-Tenant": tenants[i % len(tenants)]})
+            f"{base_url}{path}", data=json.dumps(body_of(i)).encode(),
+            headers=headers)
         t0 = time.perf_counter()
+        retry_after = None
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 resp.read()
                 code = resp.status
         except urllib.error.HTTPError as exc:
             code = exc.code
+            retry_after = exc.headers.get("Retry-After")
+            exc.read()
         except Exception as exc:  # noqa: BLE001 — recorded and gated
             with result.mu:
                 result.errors.append(repr(exc))
+                result.records.append(
+                    (tenant, None, time.perf_counter() - t0, None))
             return
         lat = time.perf_counter() - t0
         with result.mu:
-            result.latencies.append(lat)
-            if code != 200:
+            result.records.append((tenant, code, lat, retry_after))
+            if code == 200:
+                result.latencies.append(lat)
+            if code not in ok_codes:
                 result.errors.append(f"HTTP {code}")
 
     i = 0
@@ -212,8 +263,11 @@ def run_load(base_url: str, schedule=QPS_SCHEDULE) -> LoadResult:
             delay = t_next - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
+    # every thread dies by its own bounded timeout; the join bound is
+    # just slack over that, so a wedged server cannot hang the drive
+    deadline = time.monotonic() + timeout_s + 10.0
     for t in threads:
-        t.join(timeout=90)
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
     return result
 
 
@@ -276,10 +330,15 @@ def phase_serving(base: str) -> None:
                 'tenant="beta"',
                 'tpu_serve_ttft_seconds_bucket{tenant="alpha"',
                 'tpu_serve_inter_token_seconds_bucket{tenant="beta"',
-                "tpu_serve_engine_request_p50_seconds",   # deprecated,
-                "tpu_serve_engine_request_p95_seconds"):  # still emitted
+                "tpu_serve_engine_batch_occupancy"):
             if needle not in plain:
                 die(f"/metrics missing {needle!r}")
+        # the engine-computed quantile gauges served their one
+        # deprecated release (PR 8) and must now be GONE
+        for gone in ("tpu_serve_engine_request_p50_seconds",
+                     "tpu_serve_engine_request_p95_seconds"):
+            if gone in plain:
+                die(f"removed gauge {gone!r} is still exported")
         _, ctype, om = http_get(f"{base_url}/metrics",
                                 accept="application/openmetrics-text")
         if not ctype.startswith("application/openmetrics-text"):

@@ -178,7 +178,8 @@ def main():
         # ---- scheduler + kubelet stand-ins ------------------------------
         url = (f"http://127.0.0.1:{srv.port}/apis/resource.k8s.io/"
                "v1beta1/resourceslices")
-        slices = json.load(urllib.request.urlopen(url))["items"]
+        slices = json.load(
+            urllib.request.urlopen(url, timeout=10))["items"]
         devices = [d["name"] for d in slices[0]["spec"]["devices"]
                    if "-core-" not in d["name"]]
         assert devices, slices

@@ -1,0 +1,201 @@
+"""Admission control for the serving data plane (workloads/admission.py,
+ISSUE 9): bounded cost, tenant fair share, Retry-After from the live
+drain rate, and the drain state machine."""
+
+import threading
+
+import pytest
+
+from tpu_dra.workloads.admission import (
+    REASON_COST,
+    REASON_DRAINING,
+    REASON_QUEUE_FULL,
+    REASON_TENANT_QUOTA,
+    AdmissionController,
+    DrainRate,
+    ShedError,
+    parse_deadline_ms,
+    request_cost,
+)
+
+pytestmark = pytest.mark.core
+
+
+def test_admits_until_capacity_then_sheds_queue_full():
+    ctl = AdmissionController(100, burst_fraction=1.0)
+    tickets = [ctl.acquire("t", 40), ctl.acquire("t", 40)]
+    with pytest.raises(ShedError) as exc:
+        ctl.acquire("t", 40)
+    assert exc.value.reason == REASON_QUEUE_FULL
+    assert exc.value.retry_after_s >= 1
+    ctl.release(tickets[0])
+    assert ctl.acquire("t", 40).cost == 40
+
+
+def test_oversized_request_fails_fast_not_retryable_wait():
+    ctl = AdmissionController(100)
+    with pytest.raises(ShedError) as exc:
+        ctl.acquire("t", 101)
+    assert exc.value.reason == REASON_COST
+    # no outstanding state leaked by the rejection
+    assert ctl.snapshot()["outstanding_cost"] == 0
+
+
+def test_tenant_fair_share_protects_polite_tenant():
+    """A flooding tenant may burst past its fair share only up to
+    burst_fraction of capacity; the reserve admits tenants still under
+    their share — flood cannot starve polite."""
+    ctl = AdmissionController(100, burst_fraction=0.7)
+    flood = []
+    # the flood fills up to the burst line (70), then sheds
+    while True:
+        try:
+            flood.append(ctl.acquire("flood", 10))
+        except ShedError as exc:
+            assert exc.reason == REASON_TENANT_QUOTA
+            break
+    assert sum(t.cost for t in flood) == 70
+    # polite is under its fair share (100/2 = 50): admitted from the
+    # reserve the burst cap kept open
+    polite = ctl.acquire("polite", 10)
+    assert polite.cost == 10
+    # and flood still cannot grow
+    with pytest.raises(ShedError):
+        ctl.acquire("flood", 10)
+
+
+def test_single_tenant_is_not_halved_by_fairness():
+    """Work conservation: with one tenant, fair share = full capacity
+    (up to the burst fraction) — fairness must not tax the common
+    single-tenant server."""
+    ctl = AdmissionController(100, burst_fraction=0.7)
+    got = 0
+    try:
+        while True:
+            ctl.acquire("only", 10)
+            got += 10
+    except ShedError:
+        pass
+    assert got == 70
+
+
+def test_retry_after_tracks_drain_rate():
+    ctl = AdmissionController(100, burst_fraction=1.0)
+    # warm the rate estimator: ~100 cost/s of completions
+    rate = DrainRate(halflife_s=10.0)
+    ctl._rate = rate
+    now = 1000.0
+    for i in range(20):
+        rate.observe(10.0, now=now + i * 0.1)
+    t = ctl.acquire("t", 90)
+    with pytest.raises(ShedError) as exc:
+        ctl.acquire("t", 50)
+    # backlog of ~40-over at ~100/s: a small, valid integer — not the
+    # cold-start 1 and not the clamp ceiling
+    assert 1 <= exc.value.retry_after_s <= 30
+    ctl.release(t)
+
+
+def test_retry_after_cold_start_is_valid():
+    ctl = AdmissionController(10)
+    ctl.acquire("t", 10)
+    with pytest.raises(ShedError) as exc:
+        ctl.acquire("t", 5)
+    assert exc.value.retry_after_s == 1     # no rate yet: optimistic
+
+
+def test_drain_state_machine():
+    ctl = AdmissionController(100, drain_grace_s=7.0)
+    t = ctl.acquire("t", 10)
+    assert not ctl.draining
+    ctl.begin_drain()
+    assert ctl.draining
+    with pytest.raises(ShedError) as exc:
+        ctl.acquire("t", 1)
+    assert exc.value.reason == REASON_DRAINING
+    assert exc.value.retry_after_s == 7
+    # wait_idle blocks on the outstanding ticket, then returns True
+    assert ctl.wait_idle(timeout=0.05) is False
+    done = threading.Event()
+
+    def releaser():
+        ctl.release(t)
+        done.set()
+
+    threading.Timer(0.05, releaser).start()
+    assert ctl.wait_idle(timeout=5.0) is True
+    assert done.is_set()
+    # idempotent
+    ctl.begin_drain()
+    assert ctl.wait_idle(timeout=0.1) is True
+
+
+def test_release_is_idempotent_and_feeds_rate_only_on_completion():
+    ctl = AdmissionController(100)
+    t = ctl.acquire("t", 50)
+    ctl.release(t, completed=False)
+    ctl.release(t, completed=False)          # double release tolerated
+    snap = ctl.snapshot()
+    assert snap["outstanding_cost"] == 0
+    assert snap["released_total"] == 1
+    assert snap["drain_rate_cost_per_s"] == 0.0   # nothing completed
+    t2 = ctl.acquire("t", 50)
+    ctl.release(t2, completed=True)
+    assert ctl.snapshot()["drain_rate_cost_per_s"] > 0.0
+
+
+def test_snapshot_shape_for_debug_overload():
+    ctl = AdmissionController(64)
+    ctl.acquire("a", 10)
+    ctl.record_shed(REASON_QUEUE_FULL)
+    snap = ctl.snapshot()
+    assert snap["state"] == "running"
+    assert snap["outstanding_by_tenant"] == {"a": 10}
+    assert snap["shed_total"][REASON_QUEUE_FULL] == 1
+    assert isinstance(snap["retry_after_s"], int)
+
+
+def test_request_cost_model():
+    assert request_cost([[1, 2, 3]], 16) == 19
+    assert request_cost([[1], [2, 3]], 4) == 11    # 3 prompt + 2*4 new
+    assert request_cost([], 16) == 1               # floor, not a crash
+    assert request_cost(None, 16) == 1
+    assert request_cost([[1]], 0) == 2             # steps floor of 1
+
+
+def test_parse_deadline_ms_rejects_garbage():
+    assert parse_deadline_ms("250") == 0.25
+    assert parse_deadline_ms("") is None
+    assert parse_deadline_ms(None) is None
+    assert parse_deadline_ms("abc") is None
+    assert parse_deadline_ms("-5") is None
+    assert parse_deadline_ms("0") is None
+    assert parse_deadline_ms("inf") is None
+    assert parse_deadline_ms("nan") is None
+
+
+def test_concurrent_acquire_release_conserves_cost():
+    """The gate is the serving hot path: hammer it from threads and
+    check conservation (no lost or duplicated cost)."""
+    ctl = AdmissionController(10_000)
+    errs: list[BaseException] = []
+
+    def worker(seed: int) -> None:
+        try:
+            for i in range(200):
+                t = ctl.acquire(f"t{seed % 4}", (i % 7) + 1)
+                ctl.release(t, completed=i % 2 == 0)
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+    snap = ctl.snapshot()
+    assert snap["outstanding_cost"] == 0
+    assert snap["outstanding_by_tenant"] == {}
+    assert snap["admitted_total"] == snap["released_total"] == 1600

@@ -17,12 +17,13 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
             grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
-            observe_us=0.8):
+            observe_us=0.8, admission_us=4.0):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
         "cpu_probe_p90_ms": cpu,
         "observe_idle": {"n": 50000, "per_observe_us": observe_us},
+        "admission_idle": {"n": 20000, "per_check_us": admission_us},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
                      "overhead_p50_ms": direct_warm_oh},
@@ -45,6 +46,7 @@ def _budget(**overrides):
             "grpc_warm_overhead_p50_ms": 4.0,
             "flushes_per_mutation": 1.0,
             "histogram_observe_idle_us": 2.5,
+            "admission_check_idle_us": 12.0,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
                      "fs_floor_ceiling_ms": 0.4,

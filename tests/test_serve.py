@@ -181,7 +181,14 @@ def test_metrics_include_engine_gauges_when_continuous():
             f"http://{host}:{port}/metrics", timeout=10).read().decode()
         assert "# TYPE tpu_serve_engine_completed gauge" in body
         assert "tpu_serve_engine_completed 1.0" in body
-        assert "tpu_serve_engine_request_p50_seconds" in body
+        # the engine-computed p50/p95 gauges were deprecated for one
+        # release (PR 8) and are now REMOVED — histogram_quantile over
+        # tpu_serve_request_seconds replaces them
+        assert "tpu_serve_engine_request_p50_seconds" not in body
+        assert "tpu_serve_engine_request_p95_seconds" not in body
+        # the saturation surface replaces them on the gauge namespace
+        assert "tpu_serve_engine_batch_occupancy" in body
+        assert "tpu_serve_engine_slots 2.0" in body
         assert "tpu_serve_engine_tokens_out" in body
     finally:
         srv.shutdown()

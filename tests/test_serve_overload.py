@@ -1,0 +1,330 @@
+"""Overload protection on the serving data plane (ISSUE 9): admission
+503s with Retry-After, deadline propagation into the engine (paged-KV
+release + badput attribution), /debug/overload, and graceful drain.
+
+The ``serve.engine.slow_decode`` failpoint pins the engine
+deterministically slow where a test needs requests to still be in
+flight — no reliance on CPU weather."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from tpu_dra.resilience import failpoint
+from tpu_dra.workloads.serve import serve
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+CFG = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                  d_ff=64, max_seq=64, pos_emb="rope")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture()
+def overload_server(params):
+    """Continuous paged engine with a small admission bound; each test
+    gets a fresh server so shed counters and pool occupancy start
+    clean."""
+    srv = serve(CFG, params, port=0, continuous=True, slots=2, chunk=2,
+                kv_layout="paged", page_size=8,
+                admission_max_cost=66, drain_grace_s=10.0)
+    host, port = srv.server_address
+    yield srv, f"http://{host}:{port}"
+    failpoint.reset()
+    srv.shutdown()
+
+
+def _post(base, body, headers=None, timeout=180):
+    req = urllib.request.Request(
+        f"{base}/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _metrics(base) -> str:
+    return urllib.request.urlopen(
+        f"{base}/metrics", timeout=10).read().decode()
+
+
+def _overload(base) -> dict:
+    return json.loads(urllib.request.urlopen(
+        f"{base}/debug/overload", timeout=10).read())
+
+
+def test_oversized_request_sheds_fast_503_with_retry_after(
+        overload_server):
+    srv, base = overload_server
+    t0 = time.perf_counter()
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, {"tokens": [[1] * 40, [2] * 40], "steps": 20})
+    wall = time.perf_counter() - t0
+    assert exc.value.code == 503
+    body = json.loads(exc.value.read())
+    assert body["reason"] == "cost_too_large"
+    ra = exc.value.headers.get("Retry-After")
+    assert ra is not None and ra.isdigit() and int(ra) >= 1
+    # the shed never touched JAX: answered in milliseconds even on a
+    # cold server (generous CI bound; the drive gates the real 50ms)
+    assert wall < 2.0
+    assert 'tpu_serve_shed_total{reason="cost_too_large"} 1' \
+        in _metrics(base)
+    snap = _overload(base)
+    assert snap["admission"]["shed_total"]["cost_too_large"] == 1
+
+
+def test_queue_full_sheds_while_engine_is_pinned_busy(overload_server):
+    srv, base = overload_server
+    # warm the compile first so the pinned phase is decode-only
+    _post(base, {"tokens": [[1, 2, 3]], "steps": 2})
+    failpoint.activate("serve.engine.slow_decode=sleep(150)")
+    try:
+        # cost 35 each: two fill 70 > 66 — the second must shed while
+        # the first decodes behind the 150ms/pass failpoint
+        slow = threading.Thread(
+            target=lambda: _post(base,
+                                 {"tokens": [[1, 2, 3]], "steps": 32}),
+            daemon=True)
+        slow.start()
+        # wait until the slow request's cost is actually outstanding —
+        # probing earlier can win the admission race, and then the SLOW
+        # request is the one that sheds
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _overload(base)["admission"]["outstanding_cost"] >= 35:
+                break
+            time.sleep(0.01)
+        shed = None
+        while time.monotonic() < deadline and shed is None:
+            try:
+                # cost 36: fits capacity alone, overflows it on top of
+                # the 35-cost request decoding behind the failpoint
+                _post(base, {"tokens": [[4] * 8, [5] * 8],
+                             "steps": 10}, timeout=10)
+            except urllib.error.HTTPError as exc:
+                if exc.code == 503:
+                    shed = json.loads(exc.read())
+                    assert shed["reason"] in ("queue_full",
+                                              "tenant_quota")
+                    assert int(exc.headers["Retry-After"]) >= 1
+            time.sleep(0.02)
+        assert shed is not None, "no shed while the engine was pinned"
+        slow.join(timeout=60)
+    finally:
+        failpoint.reset()
+
+
+def test_deadline_expiry_releases_paged_kv_and_counts_badput(
+        overload_server):
+    """THE acceptance criterion: a deadline that expires mid-decode
+    504s, the paged-KV pool returns to its idle baseline (pages freed,
+    not leaked), and the burned slot time is badput, not goodput."""
+    srv, base = overload_server
+    _post(base, {"tokens": [[1, 2, 3]], "steps": 2})      # warm compile
+    baseline = _overload(base)["engine"]
+    assert baseline["kv_pages_free"] == baseline["kv_pages_total"]
+    goodput0 = baseline["goodput_slot_s"]
+    failpoint.activate("serve.engine.slow_decode=sleep(100)")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base, {"tokens": [[1, 2, 3]], "steps": 40},
+                  headers={"X-Deadline-Ms": "250"})
+        assert exc.value.code == 504
+        assert json.loads(exc.value.read())["reason"] == \
+            "deadline_expired"
+    finally:
+        failpoint.reset()
+    deadline = time.monotonic() + 30
+    eng = None
+    while time.monotonic() < deadline:
+        eng = _overload(base)["engine"]
+        if eng["kv_pages_free"] == eng["kv_pages_total"]:
+            break
+        time.sleep(0.05)
+    assert eng["kv_pages_free"] == eng["kv_pages_total"], \
+        f"paged-KV pages leaked after deadline expiry: {eng}"
+    assert eng["expired_active"] == 1
+    assert eng["badput_slot_s"]["deadline_expired"] > 0
+    # the aborted request's residency is NOT goodput
+    assert eng["goodput_slot_s"] == pytest.approx(goodput0, abs=1.0)
+    assert 'tpu_serve_shed_total{reason="deadline_expired"} 1' \
+        in _metrics(base)
+
+
+def test_queued_request_expires_without_burning_chip_time(
+        overload_server):
+    """A request whose deadline passes while it is still waiting in the
+    engine queue fails with 504 and zero badput — it never held a
+    slot."""
+    srv, base = overload_server
+    _post(base, {"tokens": [[1, 2, 3]], "steps": 2})      # warm compile
+    failpoint.activate("serve.engine.slow_decode=sleep(120)")
+    try:
+        # two long requests occupy both slots (distinct tenants, so the
+        # per-tenant accumulation cap doesn't shed the second one)...
+        occupiers = [threading.Thread(
+            target=lambda s=seed: _post(
+                base, {"tokens": [[s, 2, 3]], "steps": 24},
+                headers={"X-Tenant": f"occ{s}"}),
+            daemon=True) for seed in (1, 2)]
+        for t in occupiers:
+            t.start()
+        time.sleep(0.4)               # both admitted and decoding
+        # ...so this one queues; its 200ms deadline expires in-queue
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base, {"tokens": [[9, 8, 7]], "steps": 2},
+                  headers={"X-Deadline-Ms": "200"}, timeout=30)
+        assert exc.value.code == 504
+        for t in occupiers:
+            t.join(timeout=60)
+    finally:
+        failpoint.reset()
+    eng = _overload(base)["engine"]
+    assert eng["expired_queued"] >= 1
+    assert eng["badput_slot_s"]["deadline_expired"] == 0.0
+
+
+def test_invalid_deadline_header_is_ignored(overload_server):
+    srv, base = overload_server
+    for bad in ("abc", "-5", "inf", "nan", ""):
+        code, out = _post(base, {"tokens": [[1, 2, 3]], "steps": 2},
+                          headers={"X-Deadline-Ms": bad})
+        assert code == 200 and len(out["tokens"][0]) == 2
+
+
+def test_drain_closes_admission_and_finishes_in_flight(overload_server):
+    srv, base = overload_server
+    _post(base, {"tokens": [[1, 2, 3]], "steps": 2})      # warm compile
+    failpoint.activate("serve.engine.slow_decode=sleep(100)")
+    result = {}
+
+    def in_flight():
+        try:
+            result["resp"] = _post(base,
+                                   {"tokens": [[1, 2, 3]], "steps": 24})
+        except Exception as exc:  # noqa: BLE001 — asserted below
+            result["error"] = exc
+
+    t = threading.Thread(target=in_flight, daemon=True)
+    t.start()
+    time.sleep(0.4)                       # admitted and decoding
+    drain_box = {}
+
+    def drain():
+        drain_box["ok"] = srv.drain(20.0)
+
+    dt = threading.Thread(target=drain, daemon=True)
+    dt.start()
+    time.sleep(0.2)                       # drain has begun
+    # readiness flips not-ready immediately
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(f"{base}/healthz", timeout=10)
+    assert exc.value.code == 503
+    assert b"draining" in exc.value.read()
+    # new work sheds with the typed reason + Retry-After
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base, {"tokens": [[4, 5]], "steps": 2})
+    assert exc.value.code == 503
+    body = json.loads(exc.value.read())
+    assert body["reason"] == "draining"
+    assert int(exc.value.headers["Retry-After"]) >= 1
+    failpoint.reset()                     # let the in-flight one finish
+    dt.join(timeout=30)
+    t.join(timeout=30)
+    assert drain_box.get("ok") is True
+    assert "error" not in result, result
+    code, out = result["resp"]
+    assert code == 200 and len(out["tokens"][0]) == 24
+    assert _overload(base)["state"] == "draining"
+
+
+def test_pool_mode_admission_without_engine(params):
+    """Admission also guards the bucketed pool path (no engine): the
+    controller is engine-agnostic."""
+    srv = serve(CFG, params, port=0, admission_max_cost=30)
+    host, port = srv.server_address
+    base = f"http://{host}:{port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base, {"tokens": [[1] * 20], "steps": 20})
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["reason"] == \
+            "cost_too_large"
+        code, out = _post(base, {"tokens": [[1, 2, 3]], "steps": 4})
+        assert code == 200 and len(out["tokens"][0]) == 4
+    finally:
+        srv.shutdown()
+
+
+def test_no_admission_flag_means_open_admission(params):
+    """Without admission_max_cost the server behaves exactly as before
+    (no 503s, no /debug/overload admission block) — overload
+    protection is opt-in."""
+    srv = serve(CFG, params, port=0)
+    host, port = srv.server_address
+    base = f"http://{host}:{port}"
+    try:
+        code, _ = _post(base, {"tokens": [[1] * 30], "steps": 20})
+        assert code == 200
+        snap = json.loads(urllib.request.urlopen(
+            f"{base}/debug/overload", timeout=10).read())
+        assert snap["state"] == "running"
+        assert snap["admission"] is None
+    finally:
+        srv.shutdown()
+
+
+def test_engine_only_drain_flips_healthz_without_admission(params):
+    """Even with no admission controller armed, a drain entered through
+    the engine (the pre-ISSUE-9 SIGTERM path) must flip /healthz
+    not-ready — otherwise the LB keeps routing to a pod that rejects
+    everything for the whole grace period."""
+    srv = serve(CFG, params, port=0, continuous=True, slots=2, chunk=2)
+    host, port = srv.server_address
+    base = f"http://{host}:{port}"
+    try:
+        assert urllib.request.urlopen(
+            f"{base}/healthz", timeout=10).status == 200
+        assert srv.engine.drain(timeout=10.0) is True
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz", timeout=10)
+        assert exc.value.code == 503
+        assert b"draining" in exc.value.read()
+    finally:
+        srv.shutdown()
+
+
+def test_stream_deadline_504_counts_in_both_shed_surfaces(
+        overload_server):
+    """/stream deadline expiries must land in BOTH tpu_serve_shed_total
+    and /debug/overload's admission shed snapshot (the two surfaces
+    may not diverge), and the admission ticket must come back."""
+    srv, base = overload_server
+    _post(base, {"tokens": [[1, 2, 3]], "steps": 2})      # warm compile
+    failpoint.activate("serve.engine.slow_decode=sleep(100)")
+    try:
+        req = urllib.request.Request(
+            f"{base}/stream",
+            data=json.dumps({"tokens": [[1, 2, 3]],
+                             "steps": 40}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Deadline-Ms": "250"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            lines = [json.loads(ln) for ln in
+                     resp.read().decode().splitlines() if ln]
+        assert lines and lines[-1].get("reason") == "deadline_expired"
+    finally:
+        failpoint.reset()
+    assert 'tpu_serve_shed_total{reason="deadline_expired"} 1' \
+        in _metrics(base)
+    snap = _overload(base)
+    assert snap["admission"]["shed_total"]["deadline_expired"] == 1
+    assert snap["admission"]["outstanding_cost"] == 0   # ticket back

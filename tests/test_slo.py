@@ -102,3 +102,92 @@ def test_tracker_thread_start_stop():
         assert "a" in out["objectives"]
     finally:
         tracker.stop()
+
+
+# -------------------------------------------------------------------------
+# shedding x burn-rate interaction (ISSUE 9)
+# -------------------------------------------------------------------------
+
+
+def _availability_is_bad(lv):
+    """serve.py's availability classifier: 5xx burns the budget EXCEPT
+    504 — a client-deadline expiry is the client abandoning the
+    request, not the server failing, and is attributed distinctly via
+    tpu_serve_shed_total{reason="deadline_expired"}."""
+    return lv[1].startswith("5") and lv[1] != "504"
+
+
+def test_shed_503s_burn_the_availability_budget():
+    """Admission sheds are 503s and MUST count as availability burn: a
+    sustained overload has to page, not hide behind "we answered
+    quickly"."""
+    from tpu_dra.workloads.serve import ServeMetrics
+
+    m = ServeMetrics()
+    tracker = SloTracker(
+        [Objective("availability", 0.999,
+                   counter_good_total(m.requests,
+                                      is_bad=_availability_is_bad))],
+        windows_s=(60,), interval_s=1000.0)
+    for _ in range(90):
+        m.observe("/generate", 200, 0.01)
+    tracker.sample_now()
+    # overload hits: 10 sheds land as 503s (+ the reason counter)
+    for _ in range(10):
+        m.observe("/generate", 503, 0.002)
+        m.shed.inc("queue_full")
+    for _ in range(90):
+        m.observe("/generate", 200, 0.01)
+    out = tracker.burn_rates()
+    win = out["objectives"]["availability"]["windows"]["60s"]
+    assert win["bad"] == 10.0
+    assert win["error_rate"] == pytest.approx(0.1)
+    assert win["burn_rate"] == pytest.approx(100.0)   # 10% vs 0.1% budget
+    assert m.shed.value("queue_full") == 10.0
+
+
+def test_client_deadline_504s_attributed_distinctly_not_as_burn():
+    """A client that sets a 1ms deadline must not be able to page the
+    on-call: 504s stay OUT of the availability burn but are fully
+    visible in tpu_serve_shed_total{reason="deadline_expired"}."""
+    from tpu_dra.workloads.serve import ServeMetrics
+
+    m = ServeMetrics()
+    tracker = SloTracker(
+        [Objective("availability", 0.999,
+                   counter_good_total(m.requests,
+                                      is_bad=_availability_is_bad))],
+        windows_s=(60,), interval_s=1000.0)
+    tracker.sample_now()
+    for _ in range(95):
+        m.observe("/generate", 200, 0.01)
+    for _ in range(5):
+        m.observe("/generate", 504, 0.3)
+        m.shed.inc("deadline_expired")
+    out = tracker.burn_rates()
+    win = out["objectives"]["availability"]["windows"]["60s"]
+    assert win["bad"] == 0.0                 # no budget burn
+    assert win["burn_rate"] == 0.0
+    # ...but the sheds are not hidden: the reason split carries them
+    assert m.shed.value("deadline_expired") == 5.0
+    # and a REAL server failure (500) still burns alongside
+    m.observe("/generate", 500, 0.01)
+    out = tracker.burn_rates()
+    assert out["objectives"]["availability"]["windows"]["60s"][
+        "bad"] == 1.0
+
+
+def test_shed_reason_split_is_per_reason_not_aggregated():
+    from tpu_dra.workloads.serve import ServeMetrics
+
+    m = ServeMetrics()
+    for reason, n in (("queue_full", 3), ("tenant_quota", 2),
+                      ("draining", 1), ("deadline_expired", 4)):
+        for _ in range(n):
+            m.shed.inc(reason)
+    assert m.shed.value("queue_full") == 3.0
+    assert m.shed.value("tenant_quota") == 2.0
+    assert m.shed.value("draining") == 1.0
+    assert m.shed.value("deadline_expired") == 4.0
+    text = m.registry.expose()
+    assert 'tpu_serve_shed_total{reason="tenant_quota"} 2' in text

@@ -37,7 +37,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
                    "string-constant-drift", "exception-hygiene",
                    "metric-hygiene", "retry-hygiene", "lock-order",
-                   "blocking-under-lock", "hotpath"}
+                   "blocking-under-lock", "hotpath",
+                   "deadline-hygiene"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,
@@ -1372,3 +1373,74 @@ def test_hotpath_ignore_escape_is_ratchet_counted(tmp_path):
     diags = vet_snippet(tmp_path, "tpu_dra/plugins/tpu/hp4.py", src,
                         checks=["hotpath"])
     assert len(diags) == 2   # the ignored line is suppressed
+
+
+# -------------------------------------------------------------------------
+# deadline-hygiene (ISSUE 9): outbound HTTP/socket calls need timeouts
+# -------------------------------------------------------------------------
+
+_DEADLINE_BAD = """\
+import socket
+import urllib.request
+import requests
+from urllib.request import urlopen
+
+
+def poll(url):
+    urllib.request.urlopen(url).read()          # no timeout
+    urlopen(url)                                # bare import, no timeout
+    socket.create_connection(("h", 80))         # no timeout
+    requests.get(url)                           # no timeout
+"""
+
+_DEADLINE_CLEAN = """\
+import socket
+import urllib.request
+import requests
+from urllib.request import urlopen
+import http.client
+
+
+def poll(url):
+    urllib.request.urlopen(url, timeout=5).read()
+    urlopen(url, None, 5)                       # positional timeout
+    socket.create_connection(("h", 80), 3)      # positional timeout
+    socket.create_connection(("h", 80), timeout=3)
+    requests.get(url, timeout=(3, 10))
+    http.client.HTTPConnection("h", timeout=5)
+"""
+
+
+def test_deadline_hygiene_flags_timeoutless_outbound_calls(tmp_path):
+    diags = vet_snippet(tmp_path, "hack/drive_x.py", _DEADLINE_BAD,
+                        checks=["deadline-hygiene"])
+    assert len(diags) == 4
+    assert all("timeout" in d.message for d in diags)
+
+
+def test_deadline_hygiene_accepts_explicit_timeouts(tmp_path):
+    assert vet_snippet(tmp_path, "hack/drive_ok.py", _DEADLINE_CLEAN,
+                       checks=["deadline-hygiene"]) == []
+
+
+def test_deadline_hygiene_scope_is_data_plane_and_harnesses(tmp_path):
+    # workloads/serve.py and continuous.py are in scope...
+    assert len(vet_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py", _DEADLINE_BAD,
+        checks=["deadline-hygiene"])) == 4
+    # ...other modules (e.g. the kube client, which owns its own
+    # timeout policy) and non-drive hack scripts are not
+    assert vet_snippet(tmp_path, "tpu_dra/k8s/client2.py",
+                       _DEADLINE_BAD, checks=["deadline-hygiene"]) == []
+    assert vet_snippet(tmp_path, "hack/bench_helper.py", _DEADLINE_BAD,
+                       checks=["deadline-hygiene"]) == []
+
+
+def test_deadline_hygiene_ignore_escape(tmp_path):
+    src = _DEADLINE_BAD.replace(
+        "urllib.request.urlopen(url).read()          # no timeout",
+        "urllib.request.urlopen(url).read()  "
+        "# vet: ignore[deadline-hygiene]")
+    diags = vet_snippet(tmp_path, "hack/drive_y.py", src,
+                        checks=["deadline-hygiene"])
+    assert len(diags) == 3

@@ -1,0 +1,125 @@
+"""deadline-hygiene: outbound HTTP/socket calls must carry a timeout.
+
+The overload work (ISSUE 9) made the data plane's failure mode *fast
+and typed*: a saturated server answers 503 + Retry-After in
+milliseconds.  That contract is worthless if the CLIENT side of any
+hop can block forever — a timeout-less ``urlopen`` in a drive harness
+turns an open-loop load generator into a closed loop (every generator
+thread parked in connect/read, offered rate silently collapsing to
+``live_threads / ∞``), and a timeout-less socket call in the serving
+workloads turns one wedged peer into a wedged handler thread.
+
+Scope (the data plane and the harnesses that drive it):
+``tpu_dra/workloads/serve.py``, ``tpu_dra/workloads/continuous.py``,
+and every ``hack/drive_*.py`` — the ``make vet`` target runs this
+checker over both trees.
+
+Flagged calls, unless they pass an explicit ``timeout`` (keyword, or
+the positional slot that means timeout):
+
+- ``urllib.request.urlopen(...)`` / bare ``urlopen(...)``
+  (3rd positional is timeout);
+- ``socket.create_connection(...)`` (2nd positional is timeout);
+- ``http.client.HTTPConnection(...)`` / ``HTTPSConnection(...)``;
+- ``requests.get/post/put/patch/delete/head/request(...)``.
+
+``sock.connect()`` after ``settimeout()`` is fine and not tracked
+(dataflow, not this checker's altitude); wrap such sites in a
+``# vet: ignore[deadline-hygiene]`` only if they ever get flagged by
+a future rule.  A deliberate infinite wait needs the ignore plus a
+justification comment — the friction is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_REQUESTS_METHODS = ("get", "post", "put", "patch", "delete", "head",
+                     "request")
+
+# (matcher description, positional index that can carry the timeout;
+# None = keyword-only as far as this checker trusts itself)
+_TIMEOUT_POS = {
+    "urlopen": 2,               # urlopen(url, data=None, timeout=...)
+    "create_connection": 1,     # create_connection(address, timeout=...)
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """``a.b.c`` for Attribute/Name chains, "" otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_kind(call: ast.Call) -> str | None:
+    """Classify an outbound-call site; None = not in this checker's
+    catalog."""
+    name = _dotted(call.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last == "urlopen":
+        return "urlopen"
+    if name in ("socket.create_connection", "create_connection"):
+        return "create_connection"
+    if last in ("HTTPConnection", "HTTPSConnection"):
+        return "http_connection"
+    head = name.split(".", 1)[0]
+    if head == "requests" and last in _REQUESTS_METHODS:
+        return "requests"
+    return None
+
+
+def _has_timeout(call: ast.Call, kind: str) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    pos = _TIMEOUT_POS.get(kind)
+    return pos is not None and len(call.args) > pos
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    p = ctx.path
+    if p.endswith("workloads/serve.py") or \
+            p.endswith("workloads/continuous.py"):
+        return True
+    # any drive_*.py, wherever it lives (hack/ in the repo; tmp dirs in
+    # the checker's own tests)
+    base = p.rsplit("/", 1)[-1]
+    return base.startswith("drive_") and base.endswith(".py")
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if not _in_scope(ctx):
+        return []
+    diags: list[Diagnostic] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _call_kind(node)
+        if kind is None or _has_timeout(node, kind):
+            continue
+        diags.append(ctx.diag(
+            node, "deadline-hygiene",
+            f"outbound {_dotted(node.func) or kind}() without an "
+            f"explicit timeout: a wedged peer blocks this thread "
+            f"forever (and turns an open-loop load generator into a "
+            f"closed loop); pass timeout=... or justify with "
+            f"# vet: ignore[deadline-hygiene]"))
+    return diags
+
+
+register(Analyzer(
+    name="deadline-hygiene",
+    doc="outbound HTTP/socket calls in the serving data plane and the "
+        "drive harnesses must carry an explicit timeout",
+    run=_run,
+    scope=("tpu_dra/workloads", "hack"),
+))

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_dra.resilience import failpoint
 from tpu_dra.workloads.decode import (
     _chunk_hidden,
     _chunk_logits,
@@ -71,6 +72,16 @@ from tpu_dra.workloads.decode import (
 from tpu_dra.workloads.train import ModelConfig
 
 _PROMPT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# the error string a deadline-expired request fails with — serve.py maps
+# it to 504 (admission.DeadlineExceeded) and attributes it distinctly
+# from server-refused sheds in tpu_serve_shed_total
+DEADLINE_ERROR = "deadline exceeded"
+
+failpoint.register("serve.engine.slow_decode",
+                   "once per batcher pass with live slots — sleep() here "
+                   "makes the engine deterministically slow, so overload "
+                   "tests saturate at low QPS without compile jitter")
 
 
 @dataclass
@@ -94,9 +105,19 @@ class _Request:
     # the next pass boundary (or drops the request from the queue before
     # admission) — a disconnected client must not burn chip time
     cancelled: bool = False
+    # absolute client deadline (perf_counter clock, serve.py's
+    # X-Deadline-Ms header): the batcher fails expired queued requests
+    # without admitting them and aborts expired in-flight ones at the
+    # next pass boundary, freeing their slot and paged-KV pages —
+    # finishing an answer nobody waits for is pure badput
+    deadline: Optional[float] = None
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     submitted: float = field(default_factory=time.perf_counter)
+    # when the request entered its slot (perf_counter): retirement
+    # attributes the slot residency to goodput (completed) or badput
+    # (deadline-expired / cancelled) from this mark
+    admitted_at: float = 0.0
     # when the FIRST generated token landed (perf_counter): the serving
     # layer's TTFT numerator; 0.0 until then.  With finished and
     # len(tokens) it also yields the request's mean inter-token gap.
@@ -293,6 +314,17 @@ class ContinuousEngine:
         self.completed = 0
         self.cancelled = 0
         self.tokens_out = 0
+        # deadline sheds, split by where the request was when it
+        # expired: queued (zero chip time burned) vs active (its slot
+        # residency is badput)
+        self.expired_queued = 0
+        self.expired_active = 0
+        # slot-seconds by outcome — the serving-side analog of the PR-8
+        # goodput/badput wall-time segmentation: chip time spent on
+        # answers somebody received vs answers nobody waited for
+        self.goodput_slot_s = 0.0
+        self.badput_slot_s: dict[str, float] = {
+            "deadline_expired": 0.0, "cancelled": 0.0}
         self.latencies_s: deque[float] = deque(maxlen=latency_window)
         # shared-prefix KV store (LRU, content-addressed)
         self.max_prefixes = max_prefixes
@@ -1017,10 +1049,17 @@ class ContinuousEngine:
                      eos_id: Optional[int] = None,
                      temperature: float = 0.0, seed: int = 0,
                      prefix_id: Optional[str] = None,
-                     stop: Optional[list[list[int]]] = None) -> _Request:
+                     stop: Optional[list[list[int]]] = None,
+                     deadline: Optional[float] = None) -> _Request:
         """Enqueue without blocking; the returned request's ``done`` event
         fires when ``tokens`` is complete (check ``error`` first).  Lets
-        one caller fan several rows into the engine at once."""
+        one caller fan several rows into the engine at once.
+
+        ``deadline`` (absolute, ``time.perf_counter`` clock): past it
+        the engine stops working on the request — queued requests fail
+        without admitting, in-flight ones retire at the next pass
+        boundary and free their slot and KV pages.  The handle's
+        ``error`` is then :data:`DEADLINE_ERROR`."""
         cfg = self.cfg
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -1078,7 +1117,7 @@ class ContinuousEngine:
             stop = [list(seq) for seq in stop]
         req = _Request(prompt=list(prompt), steps=steps, eos_id=eos_id,
                        temperature=float(temperature), seed=seed,
-                       prefix_id=prefix_id, stop=stop)
+                       prefix_id=prefix_id, stop=stop, deadline=deadline)
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -1136,6 +1175,10 @@ class ContinuousEngine:
         self.completed = 0
         self.cancelled = 0
         self.tokens_out = 0
+        self.expired_queued = 0
+        self.expired_active = 0
+        self.goodput_slot_s = 0.0
+        self.badput_slot_s = {"deadline_expired": 0.0, "cancelled": 0.0}
         self.latencies_s.clear()
         if self.draft is not None:
             self.target_passes = 0
@@ -1150,7 +1193,18 @@ class ContinuousEngine:
                "cancelled": self.cancelled,
                "tokens_out": self.tokens_out,
                "queued": len(self._pending),
-               "active": sum(r is not None for r in self._requests)}
+               "active": sum(r is not None for r in self._requests),
+               "slots": self.slots,
+               "draining": self._draining,
+               # deadline sheds + the goodput/badput slot-seconds split
+               # (the serving analog of the PR-8 goodput segmentation):
+               # chip time that produced answered requests vs time spent
+               # on work nobody waited for
+               "expired_queued": self.expired_queued,
+               "expired_active": self.expired_active,
+               "goodput_slot_s": round(self.goodput_slot_s, 4),
+               "badput_slot_s": {k: round(v, 4)
+                                 for k, v in self.badput_slot_s.items()}}
         if self.kv_layout == "paged":
             out["kv_pages_total"] = self.pool.total_pages
             out["kv_pages_free"] = self.pool.free_pages
@@ -1191,6 +1245,14 @@ class ContinuousEngine:
             return False, (f"decode loop wedged: no heartbeat for "
                            f"{age:.0f}s (limit {stale_after:.0f}s)")
         return True, "ok"
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` has begun (terminal): new
+        submissions are rejected; serve.py's /healthz reports not-ready
+        off this even when no admission controller is armed."""
+        with self._cv:
+            return self._draining
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful rolling-restart half of shutdown: REJECT new
@@ -1250,6 +1312,7 @@ class ContinuousEngine:
         bucket too).  Reproducibility is per row: each request's sampling
         key chain is a pure function of its own seed, so batching never
         changes its tokens."""
+        self._expire_queued()
         assigned: list[tuple[int, _Request]] = []
         for slot in range(self.slots):
             if self._requests[slot] is not None:
@@ -1331,6 +1394,33 @@ class ContinuousEngine:
                 take = 1 << (len(group).bit_length() - 1)
                 self._admit_plain(Sb, group[:take])
                 group = group[take:]
+
+    def _expire_queued(self) -> None:
+        """Fail every queued request whose client deadline has already
+        passed — admitting it would spend prefill + decode on an answer
+        nobody is waiting for.  Zero chip time has been burned, so this
+        counts as a shed, not badput."""
+        if not self._pending:
+            return
+        now = time.perf_counter()
+        expired: list[_Request] = []
+        with self._cv:      # submit_async appends under the same lock
+            if not any(r.deadline is not None and now > r.deadline
+                       and not r.cancelled for r in self._pending):
+                return      # common case: nothing expired, no rebuild
+            keep: deque[_Request] = deque()
+            for req in self._pending:
+                if req.deadline is not None and now > req.deadline \
+                        and not req.cancelled:
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._pending = keep
+        for req in expired:
+            self.expired_queued += 1
+            req.error = DEADLINE_ERROR
+            req.finished = time.perf_counter()
+            req.done.set()
 
     def _paged_requirements(self, prompt_len: int, steps: int,
                             prefix_id, *, take_refs: bool = False):
@@ -1546,6 +1636,7 @@ class ContinuousEngine:
         self._keys = self._keys.at[slot].set(jax.random.fold_in(key, 1))
         self._eos = self._eos.at[slot].set(
             -1 if req.eos_id is None else req.eos_id)
+        req.admitted_at = req.admitted_at or time.perf_counter()
         req.first_token_at = time.perf_counter()
         req.tokens.append(first_host)
         self._emitted[slot] = 1
@@ -1581,10 +1672,31 @@ class ContinuousEngine:
             # must drop BEFORE its pages go back to the pool
             self._release_slot_pages(slot)
         req.finished = time.perf_counter()
+        if req.admitted_at:
+            self.goodput_slot_s += req.finished - req.admitted_at
         self.completed += 1
         self.tokens_out += len(req.tokens)
         self.latencies_s.append(req.latency_s)
         req.done.set()
+
+    def _abort_slot(self, slot: int, req: _Request, error: str,
+                    badput_reason: str) -> None:
+        """Shared cancel/deadline-expiry retirement: free the slot (and
+        its pages) without counting a completion; attribute the slot
+        residency as badput — chip time spent on an answer nobody is
+        waiting for."""
+        if self.kv_layout == "paged" and \
+                self._page_ids[slot] is not None:
+            self._release_slot_pages(slot)
+        req.error = error
+        req.finished = time.perf_counter()
+        if req.admitted_at:
+            self.badput_slot_s[badput_reason] = (
+                self.badput_slot_s.get(badput_reason, 0.0)
+                + req.finished - req.admitted_at)
+        req.done.set()
+        self._requests[slot] = None
+        self._done = self._done.at[slot].set(True)
 
     def _fail_all(self, exc: BaseException) -> None:
         """A dead batcher must never strand a waiter: every in-flight and
@@ -1663,23 +1775,26 @@ class ContinuousEngine:
                     self.params, self._cache, self._token, self._pos,
                     self._temp, self._eos, self._done, self._keys)
                 counts_host = [self.chunk] * self.slots
+            failpoint.hit("serve.engine.slow_decode")
             toks_host = np.asarray(toks)            # [slots, chunk]
+            now = time.perf_counter()
             for slot, req in enumerate(self._requests):
                 if req is None:
                     continue
                 if req.cancelled:
-                    # abort: free the slot (and pages) without counting
-                    # a completion; this pass's tokens are dropped — the
+                    # abort: this pass's tokens are dropped — the
                     # client is gone
-                    if self.kv_layout == "paged" and \
-                            self._page_ids[slot] is not None:
-                        self._release_slot_pages(slot)
                     self.cancelled += 1
-                    req.error = "cancelled"
-                    req.finished = time.perf_counter()
-                    req.done.set()
-                    self._requests[slot] = None
-                    self._done = self._done.at[slot].set(True)
+                    self._abort_slot(slot, req, "cancelled", "cancelled")
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    # the client stopped waiting: finishing would be
+                    # pure badput — retire NOW so the slot and its
+                    # paged-KV pages return to the pool this pass,
+                    # not at the steps cap
+                    self.expired_active += 1
+                    self._abort_slot(slot, req, DEADLINE_ERROR,
+                                     "deadline_expired")
                     continue
                 hit_stop = False
                 for j in range(counts_host[slot]):

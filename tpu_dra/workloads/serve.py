@@ -47,8 +47,20 @@ GET  /metrics → Prometheus text: request counts by path/code/tenant,
              exemplars on the histogram buckets.
 GET  /debug/slo → multi-window error-budget burn rates (availability +
              latency objectives) computed from the live registry
+GET  /debug/overload → the saturation/backpressure surface: drain
+             state, admission backlog (total + per tenant), live drain
+             rate, shed counts by reason, engine queue depth / batch
+             occupancy / KV-pool pressure (docs/resilience.md
+             "Overload and drain")
 GET  /debug/traces[?trace_id=] → Chrome trace JSON of this process's
              span ring — where /metrics exemplar trace ids resolve
+
+Overload protection (``--admission-max-cost``): decode endpoints pass
+an admission gate first — excess load sheds with an immediate typed
+503 + ``Retry-After``; the ``X-Deadline-Ms`` request header propagates
+into the engine so expired requests abort and free their KV slots
+(504, ``reason: deadline_expired``); SIGTERM drains gracefully (reject
+new, finish in-flight, then exit).
 """
 
 from __future__ import annotations
@@ -66,6 +78,15 @@ from tpu_dra.trace import get_tracer
 from tpu_dra.trace.export import debug_traces_body
 from tpu_dra.util import klog
 from tpu_dra.util.metrics import Registry, negotiate_exposition
+from tpu_dra.workloads.admission import (
+    REASON_DEADLINE,
+    REASON_DRAINING,
+    AdmissionController,
+    DeadlineExceeded,
+    ShedError,
+    parse_deadline_ms,
+    request_cost,
+)
 from tpu_dra.workloads.decode import beam_decode, decode
 from tpu_dra.workloads.slo import (
     Objective,
@@ -333,6 +354,16 @@ class ServeMetrics:
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1, 2.5),
             labels=("tenant",))
+        # overload observability: every shed decision lands here, split
+        # by the typed reason (admission.SHED_REASONS) — server-refused
+        # work (queue_full/tenant_quota/draining/cost_too_large, 503)
+        # burns the availability SLO budget; deadline_expired (504) is
+        # the client abandoning the request and is attributed distinctly
+        # (tests/test_slo.py)
+        self.shed = self.registry.counter(  # vet: ignore[metric-hygiene]
+            "tpu_serve_shed_total",
+            "requests shed instead of served, by typed reason",
+            ("reason",))
 
     def tenant_label(self, raw: str) -> str:
         """Bound the untrusted ``X-Tenant`` header into a safe label
@@ -373,6 +404,7 @@ class ServeMetrics:
         hand-formatted bare lines an OpenMetrics-strict scraper would
         reject."""
         stats = engine.stats()
+        slots = stats.get("slots") or 0
         gauges = {
             "tpu_serve_engine_completed": ("requests completed",
                                            stats.get("completed")),
@@ -382,23 +414,30 @@ class ServeMetrics:
                                         stats.get("queued")),
             "tpu_serve_engine_active": ("requests decoding in a slot",
                                         stats.get("active")),
-            # engine-computed quantiles are DEPRECATED in favor of
-            # histogram_quantile() over tpu_serve_request_seconds (a
-            # gauge quantile cannot be aggregated across replicas and
-            # carries no exemplars); both are emitted for one release so
-            # existing dashboards keep rendering — docs/observability.md
-            "tpu_serve_engine_request_p50_seconds": (
-                "per-request latency p50 over the stats window "
-                "(DEPRECATED: use histogram_quantile(0.5, "
-                "tpu_serve_request_seconds); removed next release)",
-                stats.get("latency_p50_ms", 0) / 1e3
-                if "latency_p50_ms" in stats else None),
-            "tpu_serve_engine_request_p95_seconds": (
-                "per-request latency p95 over the stats window "
-                "(DEPRECATED: use histogram_quantile(0.95, "
-                "tpu_serve_request_seconds); removed next release)",
-                stats.get("latency_p95_ms", 0) / 1e3
-                if "latency_p95_ms" in stats else None),
+            # the engine-computed p50/p95 gauges that used to live here
+            # were deprecated in the previous release (gauge quantiles
+            # aggregate across neither replicas nor time and carry no
+            # exemplars) and are now REMOVED: use histogram_quantile()
+            # over tpu_serve_request_seconds — docs/observability.md
+            #
+            # saturation surface (the overload/backpressure signals the
+            # router/autoscaler and /debug/overload balance on)
+            "tpu_serve_engine_slots": ("concurrent sequence capacity",
+                                       slots or None),
+            "tpu_serve_engine_batch_occupancy": (
+                "live slots over slot capacity (1.0 = decode batch "
+                "full; admission pressure follows)",
+                (stats.get("active", 0) / slots) if slots else None),
+            "tpu_serve_engine_kv_pages_free": (
+                "paged-KV pool pages currently free",
+                stats.get("kv_pages_free")),
+            "tpu_serve_engine_kv_pages_total": (
+                "paged-KV pool capacity in pages",
+                stats.get("kv_pages_total")),
+            "tpu_serve_engine_goodput_slot_seconds": (
+                "cumulative slot residency of requests that completed "
+                "(the serving goodput segment)",
+                stats.get("goodput_slot_s")),
             "tpu_serve_engine_spec_target_passes": (
                 "speculative mode: target verify passes",
                 stats.get("spec_target_passes")),
@@ -414,11 +453,20 @@ class ServeMetrics:
         for name, (help_, value) in gauges.items():
             if value is not None:
                 self.registry.gauge(name, help_).set(float(value))
+        badput = stats.get("badput_slot_s") or {}
+        if badput:
+            g = self.registry.gauge(  # vet: ignore[metric-hygiene]
+                "tpu_serve_engine_badput_slot_seconds",
+                "cumulative slot residency of aborted requests (chip "
+                "time nobody waited for), by reason", ("reason",))
+            for reason, secs in badput.items():
+                g.set(float(secs), reason)
 
 
 def make_handler(pool: DecoderPool, engine=None, metrics=None,
                  health=None, health_stale_after: float = 600.0,
-                 slo=None):
+                 slo=None, admission=None,
+                 default_deadline_s: float | None = None):
     """``engine`` (a ContinuousEngine) takes over /generate when given:
     every row becomes its own engine request, fanned in via submit_async
     so one HTTP call's rows still decode concurrently.
@@ -431,9 +479,29 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
     cold JIT compile (which legitimately blocks the loop), or a liveness
     probe mid-compile restarts the pod into a recompile crash loop.
     ``slo``: an :class:`~tpu_dra.workloads.slo.SloTracker`; when given,
-    GET /debug/slo answers with its multi-window burn rates."""
+    GET /debug/slo answers with its multi-window burn rates.
+    ``admission``: an :class:`~tpu_dra.workloads.admission.\
+AdmissionController` — every decode endpoint acquires a cost ticket
+    before touching the engine, so overload produces a fast typed 503
+    with ``Retry-After`` (and drain closes admission) instead of an
+    unbounded queue.  ``default_deadline_s``: deadline applied to
+    requests that carry no ``X-Deadline-Ms`` header (None = none)."""
+
+    def _draining_shed(detail: str) -> ShedError:
+        retry = int(admission.drain_grace_s) if admission is not None \
+            else 5
+        return ShedError(REASON_DRAINING, max(1, retry), detail)
 
     def healthz_verdict() -> tuple[bool, str]:
+        if (admission is not None and admission.draining) or \
+                (engine is not None and engine.draining):
+            # readiness goes not-ready the moment drain begins —
+            # whether the drain entered through the admission
+            # controller or straight through the engine (no
+            # --admission-max-cost): the LB stops routing while
+            # in-flight requests finish
+            return False, "draining: shutting down after in-flight " \
+                          "requests complete"
         ok, detail = True, "ok"
         if engine is not None:
             ok, detail = engine.healthy(stale_after=health_stale_after)
@@ -455,7 +523,9 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     f"the server without --continuous for per-request "
                     f"{knob}")
 
-    def engine_generate(req, tenant: str = "default") -> dict:
+    def engine_generate(req, tenant: str = "default",
+                        deadline: float | None = None) -> dict:
+        from tpu_dra.workloads.continuous import DEADLINE_ERROR
         rows = req["tokens"]
         if not rows or not all(rows):
             raise ValueError("tokens must be a non-empty list of "
@@ -466,12 +536,24 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
         stop = req.get("stop")
         if stop is not None:
             stop = [[int(t) for t in seq] for seq in stop]
-        handles = [engine.submit_async(
-            r, int(req.get("steps", 16)),
-            eos_id=None if eos is None else int(eos),
-            temperature=float(req.get("temperature", 0.0)),
-            seed=int(req.get("seed", 0)),
-            prefix_id=prefix_id, stop=stop) for r in rows]
+        handles = []
+        try:
+            for r in rows:
+                handles.append(engine.submit_async(
+                    r, int(req.get("steps", 16)),
+                    eos_id=None if eos is None else int(eos),
+                    temperature=float(req.get("temperature", 0.0)),
+                    seed=int(req.get("seed", 0)),
+                    prefix_id=prefix_id, stop=stop,
+                    deadline=deadline))
+        except RuntimeError as exc:
+            for h in handles:     # don't strand already-submitted rows
+                engine.cancel(h)
+            if "draining" in str(exc):
+                # admission won the race against begin_drain but the
+                # engine already closed: still a typed, retryable shed
+                raise _draining_shed(str(exc))
+            raise
         out = []
         for h in handles:
             # bounded: a dead batcher fails requests via _fail_all, but a
@@ -482,6 +564,11 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                 raise RuntimeError(
                     f"request not done within {ENGINE_REQUEST_TIMEOUT_S}s")
             if h.error:
+                if h.error == DEADLINE_ERROR:
+                    # the engine aborted (or refused) the row because
+                    # the client's deadline passed; its KV pages are
+                    # already back in the pool
+                    raise DeadlineExceeded(h.error)
                 raise RuntimeError(h.error)
             if metrics is not None:
                 metrics.observe_engine_timing(tenant, h)
@@ -514,12 +601,57 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                 self.close_connection = True
 
         def _send(self, code: int, body: bytes,
-                  ctype: str = "application/json"):
+                  ctype: str = "application/json", headers=None):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
+
+        def _count_shed(self, reason: str) -> None:
+            """ONE accounting point for every shed decision: the
+            Prometheus counter and /debug/overload's snapshot must
+            never diverge."""
+            if metrics is not None:
+                metrics.shed.inc(reason)
+            if admission is not None:
+                admission.record_shed(reason)
+
+        @staticmethod
+        def _shed_payload(shed: ShedError) -> tuple[bytes, dict]:
+            """ONE builder for the typed 503 wire shape (body +
+            Retry-After header) — the /stream and /generate shed
+            contracts must not drift."""
+            return (json.dumps(
+                {"error": str(shed)[:300], "reason": shed.reason,
+                 "retry_after_s": shed.retry_after_s}).encode(),
+                {"Retry-After": str(shed.retry_after_s)})
+
+        def _shed_503(self, shed: ShedError, t0: float,
+                      tenant: str) -> None:
+            """The typed shed response — counters, latency observation,
+            JSON body, and Retry-After header from one implementation
+            so the surfaces cannot drift."""
+            self._count_shed(shed.reason)
+            if metrics is not None:
+                metrics.observe(self.path, 503,
+                                time.perf_counter() - t0, tenant=tenant)
+            body, headers = self._shed_payload(shed)
+            self._send(503, body, headers=headers)
+
+        def _deadline(self) -> float | None:
+            """Absolute request deadline (perf_counter clock) from the
+            ``X-Deadline-Ms`` relative-budget header, falling back to
+            the server-wide default; None = no deadline."""
+            budget = parse_deadline_ms(
+                self.headers.get("X-Deadline-Ms"))
+            if budget is None:
+                budget = default_deadline_s
+            if budget is None:
+                return None
+            return time.perf_counter() + budget
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -534,6 +666,40 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                 self._send(200, text.encode(), ctype)
             elif self.path == "/debug/slo" and slo is not None:
                 self._send(200, json.dumps(slo.burn_rates()).encode())
+            elif self.path == "/debug/overload":
+                # one stop for the overload surface: drain state,
+                # admission backlog + per-tenant fair-share usage, shed
+                # counts, and the engine's saturation signals (queue
+                # depth, batch occupancy, KV-pool pressure) — what the
+                # future router/autoscaler balances on
+                draining = (admission is not None
+                            and admission.draining) or \
+                           (engine is not None and engine.draining)
+                out: dict = {
+                    # same verdict as /healthz: an engine-only drain
+                    # (no --admission-max-cost) is still draining
+                    "state": "draining" if draining else "running",
+                    "admission": (admission.snapshot()
+                                  if admission is not None else None),
+                }
+                if engine is not None:
+                    stats = engine.stats()
+                    slots = stats.get("slots") or 0
+                    out["engine"] = {
+                        "queued": stats.get("queued"),
+                        "active": stats.get("active"),
+                        "slots": slots,
+                        "batch_occupancy": round(
+                            stats.get("active", 0) / slots, 3)
+                        if slots else None,
+                        "kv_pages_free": stats.get("kv_pages_free"),
+                        "kv_pages_total": stats.get("kv_pages_total"),
+                        "expired_queued": stats.get("expired_queued"),
+                        "expired_active": stats.get("expired_active"),
+                        "goodput_slot_s": stats.get("goodput_slot_s"),
+                        "badput_slot_s": stats.get("badput_slot_s"),
+                    }
+                self._send(200, json.dumps(out).encode())
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 # the SHARED body builder (trace/export.py) — same
                 # contract as the driver binaries' endpoint; the
@@ -596,6 +762,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
             t0 = time.perf_counter()
             code, toks = 200, 0
             tenant = self._tenant()
+            ticket = None
             try:
                 # body FIRST: on keep-alive (HTTP/1.1) an unread request
                 # body would be parsed as the next request
@@ -613,6 +780,10 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                 stop = req.get("stop")
                 if stop is not None:
                     stop = [[int(t) for t in seq] for seq in stop]
+                deadline = self._deadline()
+                if admission is not None:
+                    ticket = admission.acquire(
+                        tenant, request_cost(rows, req.get("steps", 16)))
                 # with "stop", incremental lines may include tokens of a
                 # stop sequence the engine trims on match — the final
                 # {"done", "tokens"} payload is authoritative (standard
@@ -622,9 +793,19 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     eos_id=None if eos is None else int(eos),
                     temperature=float(req.get("temperature", 0.0)),
                     seed=int(req.get("seed", 0)),
-                    prefix_id=req.get("prefix_id"), stop=stop)
+                    prefix_id=req.get("prefix_id"), stop=stop,
+                    deadline=deadline)
+            except ShedError as exc:
+                # shed before any chip work — the response is buffered
+                # JSON (streaming never started), immediate by design
+                if ticket is not None:
+                    admission.release(ticket, completed=False)
+                self._shed_503(exc, t0, tenant)
+                return
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as exc:
+                if ticket is not None:
+                    admission.release(ticket, completed=False)
                 if metrics is not None:
                     metrics.observe(self.path, 400,
                                     time.perf_counter() - t0,
@@ -633,6 +814,13 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     {"error": str(exc)[:300]}).encode())
                 return
             except RuntimeError as exc:    # engine shut down mid-request
+                if ticket is not None:
+                    admission.release(ticket, completed=False)
+                if "draining" in str(exc):
+                    # engine closed between admission and submit: a
+                    # typed retryable shed, not a server error
+                    self._shed_503(_draining_shed(str(exc)), t0, tenant)
+                    return
                 if metrics is not None:
                     metrics.observe(self.path, 500,
                                     time.perf_counter() - t0,
@@ -640,15 +828,25 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                 self._send(500, json.dumps(
                     {"error": str(exc)[:300]}).encode())
                 return
+            from tpu_dra.workloads.continuous import DEADLINE_ERROR
             if self.request_version != "HTTP/1.1":
                 # chunked framing is an HTTP/1.1 construct — a 1.0 client
                 # would read hex size lines as body.  Degrade to the
                 # buffered /generate behavior instead of corrupting it.
                 code, body = 200, b""
                 if not handle.done.wait(ENGINE_REQUEST_TIMEOUT_S):
+                    # same as the chunked path's timeout: abort so the
+                    # slot and its pages free instead of the zombie
+                    # decoding on while its admission cost is returned
+                    engine.cancel(handle)
                     code, body = 500, json.dumps(
                         {"error": "request not done within "
                                   f"{ENGINE_REQUEST_TIMEOUT_S}s"}).encode()
+                elif handle.error == DEADLINE_ERROR:
+                    code, body = 504, json.dumps(
+                        {"error": handle.error,
+                         "reason": REASON_DEADLINE}).encode()
+                    self._count_shed(REASON_DEADLINE)
                 elif handle.error:
                     code, body = 500, json.dumps(
                         {"error": handle.error[:300]}).encode()
@@ -660,61 +858,96 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                     metrics.observe(self.path, code,
                                     time.perf_counter() - t0,
                                     len(handle.tokens), tenant)
-                self._send(code, body)
-                return
-            self.send_response(200)
-            self.send_header("Content-Type", "application/x-ndjson")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-
-            def chunk(obj) -> bool:
-                data = (json.dumps(obj) + "\n").encode()
                 try:
-                    self.wfile.write(f"{len(data):x}\r\n".encode())
-                    self.wfile.write(data + b"\r\n")
-                    self.wfile.flush()
-                    return True
-                except OSError:
-                    return False       # client went away: stop pushing
-            sent = 0
-            alive = True
-            timed_out = False
-            deadline = t0 + ENGINE_REQUEST_TIMEOUT_S
-            while True:
-                finished = handle.done.wait(0.05)
-                current = list(handle.tokens)       # snapshot
-                for tok in current[sent:]:
-                    alive = alive and chunk({"token": tok})
-                sent = len(current)
-                if finished or not alive:
-                    break
-                if time.perf_counter() > deadline:
-                    # same never-hang bound as engine_generate's waits
-                    timed_out = True
-                    break
-            toks = sent
-            if not alive or timed_out:
-                # client gone or engine wedged: abort the request so the
-                # slot (and its pages) free instead of decoding to the
-                # steps cap for nobody
-                engine.cancel(handle)
-            if timed_out:
-                code = 500
-                alive and chunk({"error": f"request not done within "
-                                          f"{ENGINE_REQUEST_TIMEOUT_S}s"})
-            elif handle.error:
-                code = 500
-                alive and chunk({"error": handle.error[:300]})
-            else:
-                alive and chunk({"done": True, "tokens": handle.tokens})
+                    self._send(code, body)
+                finally:
+                    if ticket is not None:   # after the response write
+                        admission.release(ticket, completed=code == 200)
+                return
             try:
-                self.wfile.write(b"0\r\n\r\n")      # chunked terminator
-            except OSError:
-                pass
-            if metrics is not None:
-                metrics.observe_engine_timing(tenant, handle)
-                metrics.observe(self.path, code,
-                                time.perf_counter() - t0, toks, tenant)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj) -> bool:
+                    data = (json.dumps(obj) + "\n").encode()
+                    try:
+                        self.wfile.write(f"{len(data):x}\r\n".encode())
+                        self.wfile.write(data + b"\r\n")
+                        self.wfile.flush()
+                        return True
+                    except OSError:
+                        return False   # client went away: stop pushing
+                sent = 0
+                alive = True
+                timed_out = False
+                deadline = t0 + ENGINE_REQUEST_TIMEOUT_S
+                while True:
+                    finished = handle.done.wait(0.05)
+                    current = list(handle.tokens)       # snapshot
+                    for tok in current[sent:]:
+                        alive = alive and chunk({"token": tok})
+                    sent = len(current)
+                    if finished or not alive:
+                        break
+                    if time.perf_counter() > deadline:
+                        # same never-hang bound as engine_generate's
+                        timed_out = True
+                        break
+                toks = sent
+                if not alive or timed_out:
+                    # client gone or engine wedged: abort the request so
+                    # the slot (and its pages) free instead of decoding
+                    # to the steps cap for nobody
+                    engine.cancel(handle)
+                if timed_out:
+                    code = 500
+                    alive and chunk(
+                        {"error": f"request not done within "
+                                  f"{ENGINE_REQUEST_TIMEOUT_S}s"})
+                elif handle.error == DEADLINE_ERROR:
+                    # already streaming, so the status line said 200;
+                    # the error chunk is the in-band signal.  504 in the
+                    # metrics keeps SLO attribution honest.
+                    code = 504
+                    alive and chunk({"error": handle.error,
+                                     "reason": REASON_DEADLINE})
+                    self._count_shed(REASON_DEADLINE)
+                elif handle.error:
+                    code = 500
+                    alive and chunk({"error": handle.error[:300]})
+                else:
+                    alive and chunk(
+                        {"done": True, "tokens": handle.tokens})
+                try:
+                    self.wfile.write(b"0\r\n\r\n")  # chunked terminator
+                except OSError:
+                    pass
+                if ticket is not None:
+                    # completed feeds the drain-rate estimate: a
+                    # cancelled request (client gone, engine timeout)
+                    # did not drain through the engine even though
+                    # `code` is still 200 — handle.error only lands at
+                    # the next batcher pass, after cancel()
+                    admission.release(
+                        ticket,
+                        completed=code == 200 and alive and not timed_out)
+                if metrics is not None:
+                    metrics.observe_engine_timing(tenant, handle)
+                    metrics.observe(self.path, code,
+                                    time.perf_counter() - t0, toks,
+                                    tenant)
+            finally:
+                # backstop for exceptions escaping mid-stream (e.g.
+                # BrokenPipe on the header write): never leak the slot
+                # or the admission ticket.  cancel() is a no-op once
+                # the request is done; release() is idempotent, so the
+                # normal path's release above (with its accurate
+                # ``completed`` flag) wins when it ran.
+                engine.cancel(handle)
+                if ticket is not None:
+                    admission.release(ticket, completed=False)
 
         def _tenant(self) -> str:
             """Per-tenant SLO attribution: the ``X-Tenant`` header names
@@ -725,15 +958,23 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
             return metrics.tenant_label(raw) if metrics is not None \
                 else raw
 
-        def _json_post(self, handle):
+        def _json_post(self, handle, admit: bool = False):
             """Shared /generate + /beam plumbing: parse the JSON body,
-            call ``handle(req, tenant) -> response dict``, map bad input
-            to a 400 JSON error.  Every request lands in the /metrics
-            series (count by code, wall-time histogram, generated
-            tokens) — recorded BEFORE the response is sent, so a client
-            that has its reply is guaranteed to find the request on a
-            subsequent scrape (observing after the send races the next
-            request on a busy host).
+            call ``handle(req, tenant, deadline) -> response dict``, map
+            bad input to a 400 JSON error.  Every request lands in the
+            /metrics series (count by code, wall-time histogram,
+            generated tokens) — recorded BEFORE the response is sent, so
+            a client that has its reply is guaranteed to find the
+            request on a subsequent scrape (observing after the send
+            races the next request on a busy host).
+
+            ``admit=True`` (the decode endpoints) runs the request
+            through the admission gate first: a shed is an immediate
+            typed 503 + ``Retry-After`` — computed before any JAX work,
+            so a saturated server still answers rejections in
+            milliseconds; a deadline that expires before completion is
+            a 504, attributed distinctly (the client gave up, the
+            server did not refuse).
 
             The whole request runs inside a ``serve.request`` span
             (standard head sampling), and the latency observation
@@ -742,28 +983,60 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
             from a slow bucket to the exact trace."""
             t0 = time.perf_counter()
             code, toks = 200, 0
+            headers = None
             tenant = self._tenant()
-            with get_tracer().start_span(
-                    "serve.request",
-                    attributes={"path": self.path, "tenant": tenant}):
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    result = handle(req, tenant)
-                    toks = _count_leaf_tokens(result.get("tokens"))
-                    body = json.dumps(result).encode()
-                except (KeyError, ValueError, TypeError,
-                        NotImplementedError, json.JSONDecodeError) as exc:
-                    code = 400
-                    body = json.dumps({"error": str(exc)[:300]}).encode()
-                except RuntimeError as exc:   # engine failure, not input
-                    code = 500
-                    body = json.dumps({"error": str(exc)[:300]}).encode()
-                if metrics is not None:
-                    metrics.observe(self.path, code,
-                                    time.perf_counter() - t0, toks,
-                                    tenant)
-            self._send(code, body)
+            ticket = None
+            try:
+                with get_tracer().start_span(
+                        "serve.request",
+                        attributes={"path": self.path, "tenant": tenant}):
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n))
+                        deadline = self._deadline()
+                        if admit and admission is not None:
+                            ticket = admission.acquire(
+                                tenant,
+                                request_cost(req.get("tokens") or [],
+                                             req.get("steps", 16)))
+                        if deadline is not None and \
+                                time.perf_counter() > deadline:
+                            raise DeadlineExceeded(
+                                "deadline expired before admission")
+                        result = handle(req, tenant, deadline)
+                        toks = _count_leaf_tokens(result.get("tokens"))
+                        body = json.dumps(result).encode()
+                    except ShedError as exc:
+                        code = 503
+                        body, headers = self._shed_payload(exc)
+                        self._count_shed(exc.reason)
+                    except DeadlineExceeded as exc:
+                        code = 504
+                        body = json.dumps(
+                            {"error": str(exc)[:300],
+                             "reason": REASON_DEADLINE}).encode()
+                        self._count_shed(REASON_DEADLINE)
+                    except (KeyError, ValueError, TypeError,
+                            NotImplementedError,
+                            json.JSONDecodeError) as exc:
+                        code = 400
+                        body = json.dumps(
+                            {"error": str(exc)[:300]}).encode()
+                    except RuntimeError as exc:   # engine, not input
+                        code = 500
+                        body = json.dumps(
+                            {"error": str(exc)[:300]}).encode()
+                    if metrics is not None:
+                        metrics.observe(self.path, code,
+                                        time.perf_counter() - t0, toks,
+                                        tenant)
+                self._send(code, body, headers=headers)
+            finally:
+                # released AFTER the response bytes are written: the
+                # drain sequence's wait_idle() must not return while a
+                # handler thread still owes its client a response
+                if ticket is not None:
+                    admission.release(ticket, completed=code == 200)
 
         def do_POST(self):
             def eos_of(req):
@@ -788,21 +1061,21 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                                   "KV)"}).encode())
                     return
 
-                def handle(req, tenant):
+                def handle(req, tenant, deadline):
                     return {"prefix_id":
                             engine.register_prefix(req["tokens"])}
                 self._json_post(handle)
             elif self.path == "/beam":
-                def handle(req, tenant):
+                def handle(req, tenant, deadline):
                     hyps, scores = pool.beam(
                         req["tokens"], int(req.get("steps", 16)),
                         int(req.get("beams", 4)), eos_id=eos_of(req),
                         length_penalty=float(
                             req.get("length_penalty", 0.0)))
                     return {"tokens": hyps, "scores": scores}
-                self._json_post(handle)
+                self._json_post(handle, admit=True)
             elif self.path == "/speculative":
-                def handle(req, tenant):
+                def handle(req, tenant, deadline):
                     toks, passes = pool.speculative(
                         req["tokens"], int(req.get("steps", 16)),
                         int(req.get("k", 4)),
@@ -811,13 +1084,13 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                         top_p=float(req.get("top_p", 0.0)),
                         seed=int(req.get("seed", 0)))
                     return {"tokens": toks, "target_passes": passes}
-                self._json_post(handle)
+                self._json_post(handle, admit=True)
             elif self.path == "/generate":
                 if engine is not None:
-                    self._json_post(engine_generate)
+                    self._json_post(engine_generate, admit=True)
                     return
 
-                def handle(req, tenant):
+                def handle(req, tenant, deadline):
                     return {"tokens": pool.generate(
                         req["tokens"], int(req.get("steps", 16)),
                         float(req.get("temperature", 0.0)),
@@ -826,7 +1099,7 @@ def make_handler(pool: DecoderPool, engine=None, metrics=None,
                         int(req.get("seed", 0)), eos_id=eos_of(req),
                         repetition_penalty=float(
                             req.get("repetition_penalty", 1.0)))}
-                self._json_post(handle)
+                self._json_post(handle, admit=True)
             else:
                 self._drain_body()
                 self._send(404, b"not found", "text/plain")
@@ -928,6 +1201,10 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           slo_latency_threshold: float = 1.0,
           slo_latency_target: float = 0.99,
           slo_availability_target: float = 0.999,
+          admission_max_cost: int | None = None,
+          admission_burst_fraction: float = 0.7,
+          default_deadline_s: float | None = None,
+          drain_grace_s: float = 25.0,
           ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
@@ -950,7 +1227,15 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
 
     ``health``: optional external /healthz verdict (bool or
     ``(bool, detail)`` callable, e.g. a chip HealthMonitor's
-    ``healthz``), ANDed with the engine's decode-loop liveness."""
+    ``healthz``), ANDed with the engine's decode-loop liveness.
+
+    ``admission_max_cost`` arms overload protection (None = open, the
+    historical behavior): total outstanding token cost (prompt + max
+    new tokens) is bounded, excess sheds with fast typed 503 +
+    ``Retry-After``, per-tenant fair share holds under flood, client
+    deadlines (``X-Deadline-Ms``) propagate into the engine, and
+    ``srv.drain()`` runs the graceful-drain state machine
+    (docs/resilience.md "Overload and drain")."""
     if kv_layout != "slab" and not continuous:
         raise ValueError("--kv-layout paged requires --continuous (the "
                          "bucketed pool has no paged mode); without it "
@@ -975,24 +1260,67 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
     # live registry (workloads/slo.py) — availability (non-5xx) and the
     # latency objective ("slo_latency_target of requests under
     # slo_latency_threshold seconds", rounded down to a histogram
-    # bucket boundary so the verdict is never optimistic)
+    # bucket boundary so the verdict is never optimistic).
+    # Shed 503s ARE availability burn: the server refused work it
+    # advertises capacity for, and the operator budget must notice a
+    # sustained overload.  504s are NOT: the CLIENT's deadline expired
+    # — the server did not fail, the client stopped waiting — so they
+    # are attributed distinctly via tpu_serve_shed_total{reason=
+    # "deadline_expired"} instead of silently burning the budget
+    # (tests/test_slo.py).
     slo = SloTracker([
         Objective("availability", slo_availability_target,
                   counter_good_total(
                       metrics.requests,
-                      is_bad=lambda lv: lv[1].startswith("5")),
-                  description="non-5xx responses over all responses"),
+                      is_bad=lambda lv: lv[1].startswith("5")
+                      and lv[1] != "504"),
+                  description="non-5xx responses over all responses "
+                              "(504 client-deadline expiries excluded; "
+                              "see tpu_serve_shed_total)"),
         Objective("latency", slo_latency_target,
                   histogram_under(metrics.latency, slo_latency_threshold),
                   description=f"requests faster than "
                               f"{slo_latency_threshold}s"),
     ]).start()
+    admission = None
+    if admission_max_cost is not None:
+        admission = AdmissionController(
+            admission_max_cost, burst_fraction=admission_burst_fraction,
+            drain_grace_s=drain_grace_s)
     srv = ThreadingHTTPServer((host, port),
                               make_handler(pool, engine, metrics, health,
-                                           health_stale_after, slo=slo))
+                                           health_stale_after, slo=slo,
+                                           admission=admission,
+                                           default_deadline_s=(
+                                               default_deadline_s)))
     srv.engine = engine               # reachable for stats
     srv.metrics = metrics
     srv.slo = slo
+    srv.admission = admission
+
+    def drain(timeout: float | None = None) -> bool:
+        """Graceful-drain state machine (SIGTERM path): admission
+        closes (503 + Retry-After) and /healthz goes not-ready
+        IMMEDIATELY, in-flight requests run to completion, and the call
+        returns once every admitted request has released its ticket —
+        True when fully drained inside ``timeout`` (default: the
+        server's drain grace).  The caller then calls ``shutdown()``;
+        zero in-flight requests are lost."""
+        budget = drain_grace_s if timeout is None else timeout
+        deadline = time.perf_counter() + budget
+        if admission is not None:
+            admission.begin_drain()
+        ok = True
+        if engine is not None:
+            ok = engine.drain(
+                timeout=max(0.0, deadline - time.perf_counter()))
+        if admission is not None:
+            # engine-empty is not response-sent: wait for the handler
+            # threads to hand every admitted client its bytes
+            ok = admission.wait_idle(
+                max(0.0, deadline - time.perf_counter())) and ok
+        return ok
+    srv.drain = drain
     # srv.shutdown() is the documented stop mechanism — it must also
     # stop the SLO sampler (and in continuous mode the batcher thread +
     # slot cache), or every start/stop cycle leaks them
@@ -1092,6 +1420,31 @@ def main(argv=None):
     ap.add_argument("--slo-availability-target", type=float,
                     default=0.999,
                     help="fraction of requests that must not 5xx")
+    ap.add_argument("--admission-max-cost", type=int, default=None,
+                    help="arm overload protection: bound total "
+                         "outstanding token cost (prompt + max new "
+                         "tokens across admitted requests); excess "
+                         "sheds with fast 503 + Retry-After computed "
+                         "from the live drain rate.  Unset = open "
+                         "admission (the historical behavior)")
+    ap.add_argument("--admission-burst-fraction", type=float,
+                    default=0.7,
+                    help="fraction of admission capacity one tenant "
+                         "may hold past its fair share when no other "
+                         "tenant wants it; the remainder is reserved "
+                         "for tenants under their share (flood "
+                         "isolation)")
+    ap.add_argument("--default-deadline-ms", type=float, default=None,
+                    help="deadline applied to requests without an "
+                         "X-Deadline-Ms header; past it the engine "
+                         "aborts generation and frees the KV slot "
+                         "(504).  Unset = no default deadline")
+    ap.add_argument("--drain-grace", type=float, default=25.0,
+                    help="SIGTERM drain budget in seconds: admission "
+                         "closes and /healthz goes not-ready "
+                         "immediately, in-flight requests get this "
+                         "long to finish before exit; keep below the "
+                         "pod's terminationGracePeriodSeconds")
     from tpu_dra.util.flags import tracing_flags
     tracing_flags().add_to(ap)
     ap.add_argument("--warmup", action="store_true",
@@ -1242,7 +1595,13 @@ def main(argv=None):
                 health_stale_after=args.health_stale_after,
                 slo_latency_threshold=args.slo_latency_threshold,
                 slo_latency_target=args.slo_latency_target,
-                slo_availability_target=args.slo_availability_target)
+                slo_availability_target=args.slo_availability_target,
+                admission_max_cost=args.admission_max_cost,
+                admission_burst_fraction=args.admission_burst_fraction,
+                default_deadline_s=(
+                    None if args.default_deadline_ms is None
+                    else args.default_deadline_ms / 1e3),
+                drain_grace_s=args.drain_grace)
     if args.warmup:
         if srv.engine is None:
             ap.error("--warmup needs --continuous")
@@ -1266,9 +1625,18 @@ def main(argv=None):
         stop.wait()
     except KeyboardInterrupt:
         pass
-    if srv.engine is not None:
-        drained = srv.engine.drain(timeout=25.0)
-        klog.info("drain before shutdown", complete=drained)
+    # graceful drain (docs/resilience.md "Overload and drain"):
+    # admission closes + readiness flips not-ready at once, in-flight
+    # requests finish inside the grace, tickets release only after
+    # their responses are written — zero in-flight losses, then exit
+    drained = srv.drain(args.drain_grace)
+    klog.info("drain before shutdown", complete=drained)
+    # lame-duck linger: serve_forever polls its accept socket every
+    # 0.5s, so a connection that raced into the kernel backlog as the
+    # drain finished would get an RST if the listener closed now —
+    # linger briefly so stragglers still receive their typed 503
+    # (the preStop-sleep / endpoint-removal-propagation pattern)
+    time.sleep(min(1.5, max(0.0, args.drain_grace)))
     srv.shutdown()
     return 0
 
