@@ -218,7 +218,7 @@ def section_train() -> dict:
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "tp"))
     params = init_params(cfg, jax.random.PRNGKey(0))
     # attention impl: the Pallas flash pair beats dense XLA attention
-    # since the backward rework (64.5% vs 59.3% MFU at d=2048/S=1024;
+    # since the backward rework (64.7% vs 59.3% MFU at d=2048/S=1024;
     # 57.6% vs 50.0% at S=2048 — the gap widens with S).  chunked head:
     # streamed-vocab NLL — the [B,S,32768] fp32 logits never materialize
     # (delta reported as train_step_chunked_*)
@@ -1029,17 +1029,93 @@ def section_collectives() -> dict:
     import jax
     if len(jax.devices()) <= 1:
         return {"collectives_skipped": "single device"}
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
     from tpu_dra.workloads.collectives import (
-        all_gather_bandwidth, make_mesh, psum_bandwidth,
-        reduce_scatter_bandwidth)
+        _time_op, all_gather_bandwidth, make_mesh, ppermute_bandwidth,
+        psum_bandwidth, reduce_scatter_bandwidth)
+    # the check_rep/check_vma-bridging wrapper (replication checking off
+    # — the Pallas collectives manage their own invariants), NOT the raw
+    # version-dependent import
+    from tpu_dra.workloads.ring_attention import shard_map
     mesh = make_mesh()
-    return {
+    # the full ICI floor suite (psum_job runs the same four): the
+    # all_gather/reduce_scatter numbers are the EXPOSED-communication
+    # floor the fused collective-matmul kernels below overlap away
+    out = {
         "psum_gbps": round(psum_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
+        "ppermute_gbps": round(
+            ppermute_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
         "all_gather_gbps": round(
             all_gather_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
         "reduce_scatter_gbps": round(
             reduce_scatter_bandwidth(mesh).algo_bytes_per_s / 1e9, 2),
     }
+    # fused collective matmul (pallas_kernels ring kernels) vs the
+    # unfused XLA gather-then-matmul / matmul-then-scatter over the SAME
+    # shapes: the delta is exactly the communication exposure the fusion
+    # recovers.  Fenced — a Mosaic/interpret failure must not cost the
+    # bandwidth numbers above.
+    try:
+        from tpu_dra.workloads.pallas_kernels import (
+            _ag_matmul_call, _matmul_rs_call)
+
+        dev = jax.devices()[0]
+        on_tpu = dev.platform == "tpu"
+        interpret = not on_tpu
+        n = mesh.devices.size
+        m, K, N = (1024, 2048, 2048) if on_tpu else (64, 128, 128)
+        M = n * m
+        w = jax.random.normal(jax.random.PRNGKey(0), (K, N),
+                              jnp.bfloat16)
+        x = jax.random.normal(jax.random.PRNGKey(1), (M, K), jnp.bfloat16)
+        eps = jnp.bfloat16(1e-8)
+
+        def fold(make_y):
+            # shape-preserving wrapper for _time_op: fold the matmul
+            # output back into the carry through a tiny reduction
+            def f(v):
+                y = make_y(v)
+                return v + eps * jnp.mean(y).astype(v.dtype)
+            return shard_map(f, mesh=mesh, in_specs=P("x", None),
+                             out_specs=P("x", None))
+
+        pairs = {
+            # per-device flops: AG computes the full [M, N] against the
+            # local w; RS computes its [M, K]@w share of the reduction
+            "ag_matmul": (
+                lambda v: _ag_matmul_call(v, w, "x", interpret)[0],
+                lambda v: jnp.dot(
+                    jax.lax.all_gather(v, "x", tiled=True), w,
+                    preferred_element_type=jnp.float32).astype(v.dtype),
+                2 * M * K * N),
+            "matmul_rs": (
+                # mm-RS consumes the FULL [M, K] per device (each holds a
+                # partial product); tile the shard up — content is
+                # irrelevant to timing, shape is what matters
+                lambda v: _matmul_rs_call(
+                    jnp.tile(v, (n, 1)), w, "x", interpret),
+                lambda v: jax.lax.psum_scatter(
+                    jnp.dot(jnp.tile(v, (n, 1)), w,
+                            preferred_element_type=jnp.float32),
+                    "x", scatter_dimension=0, tiled=True).astype(v.dtype),
+                2 * M * K * N),
+        }
+        iters = None if on_tpu else 2
+        for name, (fused, unfused, flops) in pairs.items():
+            secs_f = _time_op(fold(fused), x, iters=iters)
+            secs_u = _time_op(fold(unfused), x, iters=iters)
+            out[f"{name}_fused_tflops"] = round(flops / secs_f / 1e12, 2)
+            out[f"{name}_xla_tflops"] = round(flops / secs_u / 1e12, 2)
+            out[f"{name}_overlap_win_pct"] = round(
+                100.0 * (secs_u / secs_f - 1.0), 1)
+            if on_tpu:
+                out[f"{name}_fused_mfu_pct"] = _mfu(
+                    flops / secs_f / 1e12, dev)
+    except Exception as exc:  # noqa: BLE001 — keep the bandwidth numbers
+        out["collective_matmul_error"] = repr(exc)[:200]
+    return out
 
 
 _SECTIONS = {

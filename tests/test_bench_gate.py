@@ -147,3 +147,60 @@ def test_committed_budget_is_well_formed():
     assert set(budget["gates"]) <= known, \
         (sorted(set(budget["gates"]) - known), sorted(known))
     assert budget["absolute"]["grpc_warm_p50_ms"] == 1.2
+    # the kernel-throughput floors name only metrics the section emits
+    floors = budget["kernels"]["floors"]
+    assert set(floors) <= set(bench_prepare._KERNEL_FLOOR_DEFAULTS), floors
+
+
+def _kern_budget(**floors):
+    return _budget(kernels={"floors": {
+        "pallas_matmul_tflops": 145.0, **floors}})
+
+
+def test_kernel_floors_disarmed_without_tpu():
+    """CPU-only CI (JAX_PLATFORMS=cpu in the bench-gate lane) must never
+    gate kernel throughput — interpret-mode numbers measure the
+    emulator, not the chip (the PR-6 arming trick applied to compute)."""
+    report = _report()
+    report["kernels"] = {"armed": False, "reason": "no TPU backend"}
+    assert bench_prepare.gate(report, _kern_budget()) == []
+    # a report with no kernels section at all (old producer) also skips
+    assert bench_prepare.gate(_report(), _kern_budget()) == []
+
+
+def test_kernel_floor_regression_fails_when_armed():
+    report = _report()
+    report["kernels"] = {"armed": True, "pallas_matmul_tflops": 120.0}
+    violations = bench_prepare.gate(report, _kern_budget())
+    assert any("pallas_matmul_tflops" in v and "below floor" in v
+               for v in violations), violations
+    report["kernels"]["pallas_matmul_tflops"] = 170.0
+    assert bench_prepare.gate(report, _kern_budget()) == []
+
+
+def test_kernel_floor_armed_but_unmeasured_fails():
+    """An armed run that silently lost the gated section must fail —
+    a wedged bench.py subprocess cannot read as a pass."""
+    report = _report()
+    report["kernels"] = {"armed": True}
+    violations = bench_prepare.gate(report, _kern_budget())
+    assert any("armed but not measured" in v for v in violations)
+
+
+def test_null_kernel_floor_is_pending_not_gated():
+    report = _report()
+    report["kernels"] = {"armed": True, "pallas_matmul_tflops": 170.0}
+    budget = _kern_budget(ag_matmul_fused_tflops=None)
+    assert bench_prepare.gate(report, budget) == []
+
+
+def test_write_budget_fills_kernel_floors_from_armed_run(tmp_path):
+    report = _report()
+    report["kernels"] = {"armed": True, "pallas_matmul_tflops": 170.0,
+                         "ag_matmul_fused_tflops": 100.0}
+    path = tmp_path / "budget.json"
+    bench_prepare.write_budget(report, str(path))
+    floors = json.loads(path.read_text())["kernels"]["floors"]
+    assert floors["pallas_matmul_tflops"] == 144.5       # 170 * 0.85
+    assert floors["ag_matmul_fused_tflops"] == 85.0
+    assert floors["pallas_flash_tflops"] is None         # still pending

@@ -1,0 +1,274 @@
+"""Fused collective-compute kernels: interpret-mode numerics on CPU.
+
+The remote-DMA ring kernels (pallas_kernels.all_gather_matmul /
+matmul_reduce_scatter / ring_shift) must be provably correct WITHOUT
+hardware — tier-1 runs ``JAX_PLATFORMS=cpu`` — so every contract here is
+checked under ``interpret=True`` against a plain jnp/XLA reference, at
+1/2/4 shards, forward AND vjp.  Single-axis meshes exercise the actual
+Pallas ring (jax's interpret-mode remote DMA supports one named axis);
+the train-step integration on a dp×tp mesh additionally covers the
+multi-axis XLA-emulated ring the CPU path takes there.
+
+Marked ``core``: these are the correctness gates for the kernel family
+the fused-collective trunk and the ring hop ride (ISSUE 10).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_dra.workloads.pallas_kernels import (
+    _ag_matmul_call,
+    all_gather_matmul,
+    matmul_reduce_scatter,
+    ring_shift,
+)
+from tpu_dra.workloads.ring_attention import shard_map
+
+pytestmark = pytest.mark.core
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("x",))
+
+
+def _rand(key, shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape,
+                             jnp.float32).astype(jnp.bfloat16)
+
+
+def _rel_err(got, ref):
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    return np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+
+
+# --- all_gather_matmul --------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_ag_matmul_forward_matches_xla(n):
+    """y = all_gather_rows(x) @ w_d per device, vs the einsum oracle."""
+    mesh = _mesh(n)
+    M, K, N = 4 * n, 16, 8
+    x = _rand(0, (M, K))
+    w = _rand(1, (n, K, N))                    # per-device weight shard
+
+    def f(xs, ws):
+        return all_gather_matmul(xs, ws[0], "x", True)[None]
+
+    y = jax.jit(shard_map(f, mesh=mesh,
+                          in_specs=(P("x", None), P("x", None, None)),
+                          out_specs=P("x", None, None)))(x, w)
+    ref = jnp.einsum("mk,dkn->dmn", x.astype(jnp.float32),
+                     w.astype(jnp.float32))
+    assert _rel_err(y, ref) < 0.05
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_ag_matmul_gathered_residual_exact(n):
+    """The gathered byproduct (the vjp's dw operand) is byte-exact: the
+    ring only MOVES shards, never rounds them."""
+    mesh = _mesh(n)
+    x = _rand(0, (4 * n, 16))
+
+    def f(xs):
+        _, a = _ag_matmul_call(xs, jnp.eye(16, 8, dtype=jnp.bfloat16),
+                               "x", True)
+        return a[None]
+
+    a = jax.jit(shard_map(f, mesh=mesh, in_specs=P("x", None),
+                          out_specs=P("x", None, None)))(x)
+    assert np.array_equal(np.asarray(a[0]), np.asarray(x))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_ag_matmul_vjp_matches_xla(n):
+    mesh = _mesh(n)
+    M, K, N = 4 * n, 16, 8
+    x = _rand(0, (M, K))
+    w = _rand(1, (n, K, N))
+
+    def loss(x, w):
+        def f(xs, ws):
+            y = all_gather_matmul(xs, ws[0], "x", True)
+            return jnp.sum(y.astype(jnp.float32) ** 2)[None]
+        return jnp.sum(shard_map(f, mesh=mesh,
+                                 in_specs=(P("x", None), P("x", None, None)),
+                                 out_specs=P("x"))(x, w))
+
+    def ref_loss(x, w):
+        y = jnp.einsum("mk,dkn->dmn", x.astype(jnp.float32),
+                       w.astype(jnp.float32))
+        return jnp.sum(y ** 2)
+
+    dx, dw = jax.jit(jax.grad(loss, argnums=(0, 1)))(x, w)
+    rdx, rdw = jax.grad(ref_loss, argnums=(0, 1))(x, w)
+    assert _rel_err(dx, rdx) < 0.08
+    assert _rel_err(dw, rdw) < 0.08
+
+
+def test_ag_matmul_odd_rows_takes_unidirectional_ring():
+    """m odd disables the bidirectional half-shard split; the full-shard
+    ring must produce the same numbers."""
+    n = 4
+    mesh = _mesh(n)
+    x = _rand(0, (3 * n, 16))                  # m = 3 rows per shard
+    w = _rand(1, (16, 8))
+
+    def f(xs):
+        return all_gather_matmul(xs, w, "x", True)[None]
+
+    y = jax.jit(shard_map(f, mesh=mesh, in_specs=P("x", None),
+                          out_specs=P("x", None, None)))(x)
+    ref = jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))
+    assert _rel_err(y[0], ref) < 0.05
+
+
+# --- matmul_reduce_scatter ----------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_matmul_rs_forward_matches_xla(n):
+    """y_d = (sum_e x_e @ w_e)[rows of shard d], vs the einsum oracle."""
+    mesh = _mesh(n)
+    M, K, N = 4 * n, 16, 8
+    xd = jnp.stack([_rand(d, (M, K)) for d in range(n)])
+    w = _rand(9, (n, K, N))
+
+    def f(xs, ws):
+        return matmul_reduce_scatter(xs[0], ws[0], "x", True)
+
+    y = jax.jit(shard_map(f, mesh=mesh,
+                          in_specs=(P("x", None, None),) * 2,
+                          out_specs=P("x", None)))(xd, w)
+    ref = jnp.einsum("dmk,dkn->mn", xd.astype(jnp.float32),
+                     w.astype(jnp.float32))
+    assert _rel_err(y, ref) < 0.05
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+def test_matmul_rs_vjp_matches_xla(n):
+    mesh = _mesh(n)
+    M, K, N = 4 * n, 16, 8
+    xd = jnp.stack([_rand(d, (M, K)) for d in range(n)])
+    w = _rand(9, (n, K, N))
+
+    def loss(xd, w):
+        def f(xs, ws):
+            y = matmul_reduce_scatter(xs[0], ws[0], "x", True)
+            return y
+        y = shard_map(f, mesh=mesh, in_specs=(P("x", None, None),) * 2,
+                      out_specs=P("x", None))(xd, w)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def ref_loss(xd, w):
+        y = jnp.einsum("dmk,dkn->mn", xd.astype(jnp.float32),
+                       w.astype(jnp.float32))
+        return jnp.sum(y ** 2)
+
+    dx, dw = jax.jit(jax.grad(loss, argnums=(0, 1)))(xd, w)
+    rdx, rdw = jax.grad(ref_loss, argnums=(0, 1))(xd, w)
+    assert _rel_err(dx, rdx) < 0.08
+    assert _rel_err(dw, rdw) < 0.08
+
+
+# --- ring_shift ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+@pytest.mark.parametrize("reverse", [False, True])
+def test_ring_shift_matches_ppermute(n, reverse):
+    mesh = _mesh(n)
+    x = _rand(3, (2 * n, 4, 8))
+
+    def f(v):
+        return ring_shift(v, "x", reverse, True)
+
+    y = jax.jit(shard_map(f, mesh=mesh, in_specs=P("x", None, None),
+                          out_specs=P("x", None, None)))(x)
+    step = -1 if reverse else 1
+    ref = jnp.roll(x.reshape(n, 2, 4, 8), step, axis=0).reshape(x.shape)
+    assert np.array_equal(np.asarray(y), np.asarray(ref))
+
+
+def test_ring_shift_vjp_is_opposite_shift():
+    n = 4
+    mesh = _mesh(n)
+    x = _rand(3, (2 * n, 8))
+    cot = _rand(4, (2 * n, 8)).astype(jnp.float32)
+
+    def loss(v):
+        f = shard_map(lambda t: ring_shift(t, "x", False, True), mesh=mesh,
+                      in_specs=P("x", None), out_specs=P("x", None))
+        return jnp.sum(f(v).astype(jnp.float32) * cot)
+
+    g = jax.jit(jax.grad(loss))(x)
+    ref = jnp.roll(cot.reshape(n, 2, 8), -1, axis=0).reshape(x.shape)
+    assert np.allclose(np.asarray(g, np.float32), np.asarray(ref),
+                       atol=1e-2)
+
+
+# --- ring-attention hop + trunk integration -----------------------------------
+
+
+def test_ring_attention_pallas_hop_parity():
+    from tpu_dra.workloads.ring_attention import (
+        make_ring_attention, make_ring_attention_flash)
+
+    mesh = _mesh(4)
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (2, 2, 16, 8)).astype(jnp.bfloat16)
+               for kk in ks)
+    for maker in (make_ring_attention, make_ring_attention_flash):
+        a = jax.jit(maker(mesh, axis_name="x"))(q, k, v)
+        b = jax.jit(maker(mesh, axis_name="x", hop_impl="pallas"))(q, k, v)
+        assert _rel_err(b, np.asarray(a, np.float32)) < 0.02
+
+
+def test_ring_attention_rejects_unknown_hop_impl():
+    from tpu_dra.workloads.ring_attention import ring_attention
+    with pytest.raises(ValueError, match="hop_impl"):
+        ring_attention(jnp.zeros((1, 1, 4, 4), jnp.bfloat16),
+                       jnp.zeros((1, 1, 4, 4), jnp.bfloat16),
+                       jnp.zeros((1, 1, 4, 4), jnp.bfloat16),
+                       hop_impl="bogus")
+
+
+@pytest.mark.parametrize("seq", [32, 33])
+def test_fused_collective_train_step_matches_dense(seq):
+    """The full dp×tp train step with matmul_impl="fused_collective"
+    (Megatron-SP layout over the ring wrappers) reproduces the dense
+    step's loss.  The loss trunk sees tokens-1 rows, so seq=33 gives an
+    even 32-row split over tp=2 and seq=32 gives 31 rows — the
+    token-padding path."""
+    from tpu_dra.workloads.train import (
+        ModelConfig, init_params, make_sharded_train_step)
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                      d_ff=64, max_seq=seq)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, seq), 0, 64,
+                                jnp.int32)
+    step_d, p_sh, b_sh = make_sharded_train_step(cfg, mesh)
+    step_f, _, _ = make_sharded_train_step(cfg, mesh,
+                                           matmul_impl="fused_collective")
+    pd = jax.device_put(params, p_sh)
+    pf = jax.device_put(params, p_sh)
+    tk = jax.device_put(tokens, b_sh)
+    for _ in range(2):
+        pd, ld = step_d(pd, tk)
+        pf, lf = step_f(pf, tk)
+        assert np.isfinite(float(lf))
+        assert abs(float(ld) - float(lf)) < 0.02 * max(abs(float(ld)), 1.0)
+
+
+def test_make_sharded_train_step_rejects_unknown_matmul_impl():
+    from tpu_dra.workloads.train import ModelConfig, make_sharded_train_step
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "tp"))
+    with pytest.raises(ValueError, match="matmul_impl"):
+        make_sharded_train_step(ModelConfig(), mesh, matmul_impl="bogus")

@@ -19,8 +19,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 
 @dataclass
@@ -32,7 +36,8 @@ class CollectiveResult:
     algo_bytes_per_s: float
 
 
-def _time_op(fn, x, iters: int = 10) -> float:
+def _time_op(fn, x, iters: int | None = None,
+             budget_s: float = 0.25) -> float:
     """Time one application of ``fn`` (shape-preserving) accurately on
     remote/async backends.
 
@@ -42,6 +47,13 @@ def _time_op(fn, x, iters: int = 10) -> float:
     dependencies) and a scalar is fetched; constant dispatch+readback
     overhead is removed by differencing an ``iters`` run against a
     ``2·iters`` run.
+
+    ``iters=None`` (the default) sizes the loop ADAPTIVELY to
+    ``budget_s`` of wall clock per measured window: a fixed count
+    under-samples fast small-buffer ops (dispatch noise dominates) and
+    stalls the dryrun on slow large-buffer ones — the calibration run
+    times a single compiled iteration and picks
+    ``clamp(budget/t, 3, 1000)``.  Explicit ``iters`` always wins.
     """
     def loop(n):
         @jax.jit
@@ -49,6 +61,14 @@ def _time_op(fn, x, iters: int = 10) -> float:
             out = jax.lax.fori_loop(0, n, lambda i, a: fn(a), v)
             return jnp.sum(out.astype(jnp.float32))
         return run
+
+    if iters is None:
+        cal = loop(1)
+        float(cal(x))                      # compile + warm
+        t0 = time.perf_counter()
+        float(cal(x))
+        t_one = max(time.perf_counter() - t0, 1e-9)
+        iters = max(3, min(1000, int(budget_s / t_one)))
 
     run1, run2 = loop(iters), loop(2 * iters)
     float(run1(x))   # warm both compilations
@@ -105,7 +125,7 @@ def make_multislice_mesh(num_slices: int, devices=None,
 
 
 def psum_bandwidth(mesh: Mesh, mib_per_device: int = 64,
-                   iters: int = 10) -> CollectiveResult:
+                   iters: int | None = None) -> CollectiveResult:
     """All-reduce bandwidth.  Ring all-reduce moves 2·(n-1)/n of the buffer
     per device; achieved B/s is reported against that algorithmic volume."""
     n = mesh.devices.size
@@ -125,7 +145,7 @@ def psum_bandwidth(mesh: Mesh, mib_per_device: int = 64,
 
 
 def ppermute_bandwidth(mesh: Mesh, mib_per_device: int = 64,
-                       iters: int = 10) -> CollectiveResult:
+                       iters: int | None = None) -> CollectiveResult:
     """Neighbor-exchange (ring) bandwidth — the point-to-point ICI probe."""
     n = mesh.devices.size
     elems = mib_per_device * 1024 * 1024 // 2
@@ -144,7 +164,7 @@ def ppermute_bandwidth(mesh: Mesh, mib_per_device: int = 64,
 
 
 def all_gather_bandwidth(mesh: Mesh, mib_per_device: int = 64,
-                         iters: int = 10) -> CollectiveResult:
+                         iters: int | None = None) -> CollectiveResult:
     """All-gather bandwidth: every device receives the other n-1 shards.
 
     The timed op must be shape-preserving (``_time_op`` chains it through a
@@ -169,7 +189,7 @@ def all_gather_bandwidth(mesh: Mesh, mib_per_device: int = 64,
 
 
 def reduce_scatter_bandwidth(mesh: Mesh, mib_per_device: int = 64,
-                             iters: int = 10) -> CollectiveResult:
+                             iters: int | None = None) -> CollectiveResult:
     """Reduce-scatter bandwidth: each device sends its buffer and keeps one
     reduced shard — the other half of the ring-allreduce decomposition."""
     n = mesh.devices.size
@@ -189,7 +209,8 @@ def reduce_scatter_bandwidth(mesh: Mesh, mib_per_device: int = 64,
     return CollectiveResult("reduce_scatter", n, buffer_bytes, secs, algo)
 
 
-def matmul_throughput(size: int = 4096, iters: int = 200) -> float:
+def matmul_throughput(size: int = 4096,
+                      iters: int | None = None) -> float:
     """Single-chip MXU sanity: bf16 matmul TFLOP/s (keeps the benchmark
     honest about the chip actually running)."""
     key = jax.random.PRNGKey(0)

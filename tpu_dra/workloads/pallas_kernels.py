@@ -959,3 +959,410 @@ def fused_rmsnorm_matmul(x, gamma, w, *, bm: int = 256, bn: int = 256,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, gamma, w)
+
+
+# --- fused collective-compute kernels (ICI overlap) ---------------------------
+#
+# The gap between pure-matmul MFU (88.7%) and train-step MFU (64.7%) on the
+# bench chip is mostly *exposed* ICI communication: XLA schedules the tp
+# collectives around the big dots instead of inside them.  These kernels fuse
+# the ring collective INTO the MXU loop with `pltpu` async remote DMA
+# (`make_async_remote_copy` + semaphores): each step matmuls the shard it
+# already holds while the interconnect ships the next one.
+#
+#   all_gather_matmul    y = all_gather_rows(x) @ w      (per-device w shard)
+#   matmul_reduce_scatter y = reduce_scatter_rows(x @ w)  (per-device x·w
+#                                                          partial products)
+#   ring_shift           the ppermute hop as one remote DMA (ring_attention)
+#
+# All three are per-device functions: call them INSIDE shard_map over the
+# ring axis (see train.py `matmul_impl="fused_collective"` for the trunk
+# wiring and tests/test_collective_matmul.py for the contract).  They are
+# trainable: each matmul kernel's custom_vjp is built from the *other*
+# kernel (the transpose of a row-gather matmul is a matmul-row-scatter and
+# vice versa), and the dw half contracts against the gathered operand the
+# forward ring already materialized — so the backward adds no collective
+# beyond the one the math requires.
+#
+# Ring-protocol notes (each a correctness cliff, see docs/workloads.md):
+# - AG circulates shards into their own slot of the *output* buffer
+#   (jax.experimental.pallas.ops.tpu.all_gather's trick): every slot is
+#   written exactly once, so double-buffer reuse hazards cannot exist and
+#   no flow control is needed beyond wait-previous-before-forward.
+# - AG is bidirectional when the shard row count is even (and n > 2): the
+#   two half-shards travel opposite directions, so both ICI links of the
+#   ring axis carry payload every step — 2× the unidirectional bandwidth.
+# - RS circulates a *partial-sum* chunk (fp32 — the VMEM accumulator IS
+#   the wire payload), which forces buffer reuse; the receive buffers are
+#   protected by a credit handshake (a REGULAR semaphore signalled to the
+#   left neighbour after each chunk is consumed; senders wait one credit
+#   per reuse) because a device with no right-side backpressure can
+#   otherwise run two steps ahead and overwrite a buffer mid-read.
+# - Every kernel opens with a neighbour barrier on real hardware (remote
+#   DMA into a peer that has not entered the kernel lands in unallocated
+#   scratch); under interpret=True the emulator is ordered, and the
+#   barrier/credit semaphore ops are elided.
+#
+# VMEM ceilings: all refs are whole-array resident (no grid), so per-device
+# x + w + y (+ gathered A for the AG kernel, + 4 fp32 chunk buffers for RS)
+# must fit the lifted _FLASH_VMEM_LIMIT.  The d_model=2048 flagship at
+# B=16/S=1024 over tp<=8 fits; HBM-staged gathered output is the known
+# scaling knob beyond that.
+
+_AG_COLLECTIVE_ID = 1
+_RS_COLLECTIVE_ID = 2
+_SHIFT_COLLECTIVE_ID = 3
+
+
+def _interpret_ring_unsupported(interpret: bool) -> bool:
+    """Whether the CPU path must take the XLA-emulated ring instead of
+    the interpreted Pallas kernel: jax's interpret-mode remote-DMA
+    discharge (``dma_start_discharge_rule``) only handles a SINGLE named
+    axis in scope, so under a multi-axis mesh (dp×tp, dp×sp) the
+    emulation path keeps the op runnable on CPU.  Real hardware
+    (``interpret=False``) always runs the kernel — Mosaic linearizes
+    logical device ids itself."""
+    if not interpret:
+        return False
+    try:  # the axis-env probe is internal API; location varies by version
+        try:
+            from jax.core import get_axis_env
+        except ImportError:
+            from jax._src.core import get_axis_env
+        env = get_axis_env()
+        names = [n for n in env.axis_sizes if n is not None]
+        return len(names) > 1
+    except (ImportError, AttributeError, TypeError):
+        # can't prove a single named axis on this jax: take the safe
+        # XLA-emulated ring under interpret (hardware is unaffected)
+        return True
+
+
+def _ring_neighbor_barrier(left, right):
+    """Block until both ring neighbours have entered the kernel (hardware
+    only): a remote DMA must never land in a peer's unallocated scratch."""
+    sem = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(sem, 1, device_id=left)
+    pltpu.semaphore_signal(sem, 1, device_id=right)
+    pltpu.semaphore_wait(sem, 2)
+
+
+def _collective_params(interpret: bool, collective_id: int):
+    # collective_id names the barrier semaphore; kernels that can run in
+    # the same program need distinct ids
+    return None if interpret else pltpu.CompilerParams(
+        collective_id=collective_id,
+        vmem_limit_bytes=_FLASH_VMEM_LIMIT)
+
+
+def _ag_matmul_kernel(x_ref, w_ref, y_ref, a_ref, send_sems, recv_sems, *,
+                      axis_name: str, n: int, bidir: bool, interpret: bool):
+    """All-gather-matmul ring step: matmul the shard in hand while the DMA
+    ships the next one.
+
+    Per device: x [m, K] row shard, w [K, N] local; outputs y [n·m, N]
+    (the full gathered matmul against MY w) and a [n, m, K] (the gathered
+    operand — the vjp's dw residual, materialized for free because the
+    ring already moves every shard through every device).  Shards land in
+    their own ``a`` slot, so no buffer is ever written twice.
+    """
+    my_id = jax.lax.axis_index(axis_name)
+    m = x_ref.shape[0]
+    right = jax.lax.rem(my_id + 1, n)
+    left = jax.lax.rem(my_id + n - 1, n)
+
+    a_ref[pl.ds(my_id, 1)] = x_ref[...][None]
+    if not interpret:
+        _ring_neighbor_barrier(left, right)
+
+    def dot_rows(slot, off, rows):
+        blk = a_ref[pl.ds(slot, 1), pl.ds(off, rows)][0]
+        # bf16 (storage dtype) operands into the MXU, fp32 out
+        y_ref[pl.ds(slot * m + off, rows)] = jnp.dot(
+            blk, w_ref[...],
+            preferred_element_type=jnp.float32).astype(y_ref.dtype)
+
+    if not bidir:
+        # unidirectional full-shard ring: slot (my_id - i) arrives at step
+        # i; forward it before computing it so transfer i+1 overlaps dot i
+        dma = None
+        for i in range(n):
+            if dma is not None:
+                dma.wait()          # my fwd sent AND slot (my_id-i) landed
+            slot = jax.lax.rem(my_id + 2 * n - i, n)
+            if i < n - 1:
+                dma = pltpu.make_async_remote_copy(
+                    src_ref=a_ref.at[pl.ds(slot, 1)],
+                    dst_ref=a_ref.at[pl.ds(slot, 1)],
+                    send_sem=send_sems.at[0], recv_sem=recv_sems.at[0],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                dma.start()
+            dot_rows(slot, 0, m)
+        return
+
+    # bidirectional: the shard's two row halves travel opposite
+    # directions — both ICI links busy every step.  Right ring carries
+    # the high halves of slots my_id-i, left ring the low halves of
+    # slots my_id+i; at i = n/2 (n even) they meet on the same slot's
+    # two DIFFERENT halves, so nothing is computed twice.
+    half = m // 2
+    rdma = ldma = None
+    for i in range(n):
+        if rdma is not None:
+            rdma.wait()
+            ldma.wait()
+        rslot = jax.lax.rem(my_id + 2 * n - i, n)
+        lslot = jax.lax.rem(my_id + i, n)
+        if i < n - 1:
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=a_ref.at[pl.ds(rslot, 1), pl.ds(half, half)],
+                dst_ref=a_ref.at[pl.ds(rslot, 1), pl.ds(half, half)],
+                send_sem=send_sems.at[0], recv_sem=recv_sems.at[0],
+                device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            ldma = pltpu.make_async_remote_copy(
+                src_ref=a_ref.at[pl.ds(lslot, 1), pl.ds(0, half)],
+                dst_ref=a_ref.at[pl.ds(lslot, 1), pl.ds(0, half)],
+                send_sem=send_sems.at[1], recv_sem=recv_sems.at[1],
+                device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL)
+            ldma.start()
+        if i == 0:
+            dot_rows(rslot, 0, m)
+        else:
+            dot_rows(rslot, half, half)
+            dot_rows(lslot, 0, half)
+
+
+def _ag_matmul_call(x, w, axis_name: str, interpret: bool):
+    """(y, gathered) = (all_gather(x) @ w, all_gather(x)) — the raw ring
+    call both custom_vjps build on.  Per-device; call inside shard_map."""
+    n = jax.lax.psum(1, axis_name)
+    m, k = x.shape
+    nn = w.shape[1]
+    w = w.astype(x.dtype)
+    if n == 1:
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+        return y, x
+    if _interpret_ring_unsupported(interpret):
+        a = jax.lax.all_gather(x, axis_name, tiled=True)
+        y = jnp.dot(a, w, preferred_element_type=jnp.float32).astype(x.dtype)
+        return y, a
+    bidir = (m % 2 == 0) and n > 2
+    y, a = pl.pallas_call(
+        functools.partial(_ag_matmul_kernel, axis_name=axis_name, n=n,
+                          bidir=bidir, interpret=interpret),
+        out_shape=[jax.ShapeDtypeStruct((n * m, nn), x.dtype),
+                   jax.ShapeDtypeStruct((n, m, k), x.dtype)],
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,))],
+        compiler_params=_collective_params(interpret, _AG_COLLECTIVE_ID),
+        interpret=interpret,
+    )(x, w)
+    return y, a.reshape(n * m, k)
+
+
+def _matmul_rs_kernel(x_ref, w_ref, y_ref, comm_in, comm_out, send_sems,
+                      recv_sems, cap_sem, *, axis_name: str, n: int, m: int,
+                      interpret: bool):
+    """Matmul-reduce-scatter ring step: the fp32 partial-sum chunk IS the
+    wire payload.
+
+    Chunk c starts on device c+1 and walks right gathering each device's
+    x[c·m:(c+1)·m] @ w partial, arriving fully reduced on device c after
+    n-1 hops.  The dot for step t overlaps the in-flight transfer from
+    step t-1; comm_in reuse is protected by the credit handshake (module
+    docstring).
+    """
+    my_id = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my_id + 1, n)
+    left = jax.lax.rem(my_id + n - 1, n)
+    if not interpret:
+        _ring_neighbor_barrier(left, right)
+    dma = None
+    for t in range(n):
+        c = jax.lax.rem(my_id + 2 * n - 1 - t, n)
+        p = jnp.dot(x_ref[pl.ds(c * m, m)], w_ref[...],
+                    preferred_element_type=jnp.float32)
+        if t > 0:
+            dma.wait()      # chunk c's partial sum landed in comm_in[t%2]
+            p = p + comm_in[t % 2]
+        if t < n - 1:
+            if not interpret and t >= 2:
+                # comm_in slot reuse on the right neighbour: wait for its
+                # "consumed" credit before overwriting
+                pltpu.semaphore_wait(cap_sem, 1)
+            comm_out[t % 2] = p
+            dma = pltpu.make_async_remote_copy(
+                src_ref=comm_out.at[t % 2],
+                dst_ref=comm_in.at[(t + 1) % 2],
+                send_sem=send_sems.at[t % 2],
+                recv_sem=recv_sems.at[(t + 1) % 2],
+                device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+            dma.start()
+        else:
+            y_ref[...] = p.astype(y_ref.dtype)
+        if not interpret and t > 0:
+            pltpu.semaphore_signal(cap_sem, 1, device_id=left)
+    if not interpret:
+        # drain the credits nobody waits for (the right neighbour sends
+        # n-1 but only n-3 gate a send) — semaphores must exit at zero
+        pltpu.semaphore_wait(cap_sem, 2 if n > 2 else 1)
+
+
+def _matmul_rs_call(x, w, axis_name: str, interpret: bool):
+    """reduce_scatter(x @ w) over rows — the raw ring call.  Per-device;
+    x [n·m, K] (this device's full partial-product operand), w [K, N]
+    local; returns this device's fully-reduced [m, N] row chunk."""
+    n = jax.lax.psum(1, axis_name)
+    mk = x.shape[0]
+    nn = w.shape[1]
+    w = w.astype(x.dtype)
+    if n == 1:
+        return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(
+            x.dtype)
+    if _interpret_ring_unsupported(interpret):
+        p = jnp.dot(x, w, preferred_element_type=jnp.float32)
+        return jax.lax.psum_scatter(p, axis_name, scatter_dimension=0,
+                                    tiled=True).astype(x.dtype)
+    assert mk % n == 0, (mk, n)
+    m = mk // n
+    return pl.pallas_call(
+        functools.partial(_matmul_rs_kernel, axis_name=axis_name, n=n, m=m,
+                          interpret=interpret),
+        out_shape=jax.ShapeDtypeStruct((m, nn), x.dtype),
+        scratch_shapes=[pltpu.VMEM((2, m, nn), jnp.float32),
+                        pltpu.VMEM((2, m, nn), jnp.float32),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.REGULAR],
+        compiler_params=_collective_params(interpret, _RS_COLLECTIVE_ID),
+        interpret=interpret,
+    )(x, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def all_gather_matmul(x, w, axis_name, interpret=False):
+    """``all_gather_rows(x) @ w`` with the gather fused into the MXU loop.
+
+    Per-device semantics (call inside shard_map over ``axis_name``):
+    ``x [m, K]`` is this device's row shard of the global ``[n·m, K]``
+    operand, ``w [K, N]`` is local (tp-sharded weights pass their local
+    shard), and the result ``[n·m, N]`` is the full gathered matmul
+    against THIS device's w.  Differentiable: dx rides the matching
+    matmul_reduce_scatter ring, dw is a local contraction against the
+    gathered operand the forward already produced.
+    """
+    y, _ = _ag_matmul_call(x, w, axis_name, interpret)
+    return y
+
+
+def _ag_matmul_vjp_fwd(x, w, axis_name, interpret):
+    y, a = _ag_matmul_call(x, w, axis_name, interpret)
+    return y, (a, w)
+
+
+def _ag_matmul_vjp_bwd(axis_name, interpret, res, g):
+    a, w = res
+    # dx = reduce_scatter_rows(g @ wᵀ): the transpose of a row-gather
+    # matmul is a matmul-row-scatter — the other kernel, used as-is
+    dx = _matmul_rs_call(g, w.T.astype(g.dtype), axis_name, interpret)
+    # dw = gatheredᵀ @ g: local MXU contraction, fp32 accumulate
+    dw = jax.lax.dot_general(
+        a, g.astype(a.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx.astype(a.dtype), dw
+
+
+all_gather_matmul.defvjp(_ag_matmul_vjp_fwd, _ag_matmul_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_reduce_scatter(x, w, axis_name, interpret=False):
+    """``reduce_scatter_rows(x @ w)`` with the reduction fused into the
+    MXU loop.
+
+    Per-device semantics (call inside shard_map over ``axis_name``):
+    ``x [n·m, K]`` and ``w [K, N]`` are this device's operands of a
+    contraction whose K axis is sharded over the ring (each device holds
+    a partial product); the result ``[m, N]`` is this device's fully
+    reduced row chunk.  Differentiable: dx rides all_gather_matmul, dw
+    contracts x against the gathered cotangent that ring produced.
+    """
+    return _matmul_rs_call(x, w, axis_name, interpret)
+
+
+def _matmul_rs_vjp_fwd(x, w, axis_name, interpret):
+    return _matmul_rs_call(x, w, axis_name, interpret), (x, w)
+
+
+def _matmul_rs_vjp_bwd(axis_name, interpret, res, g):
+    x, w = res
+    # dx = all_gather_rows(g) @ wᵀ — the other kernel; its gathered
+    # byproduct is exactly the operand dw needs
+    dx, gg = _ag_matmul_call(g, w.T.astype(g.dtype), axis_name, interpret)
+    dw = jax.lax.dot_general(
+        x, gg.astype(x.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx.astype(x.dtype), dw
+
+
+matmul_reduce_scatter.defvjp(_matmul_rs_vjp_fwd, _matmul_rs_vjp_bwd)
+
+
+def _ring_shift_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name: str,
+                       n: int, reverse: bool, interpret: bool):
+    my_id = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my_id + 1, n)
+    left = jax.lax.rem(my_id + n - 1, n)
+    if not interpret:
+        _ring_neighbor_barrier(left, right)
+    dma = pltpu.make_async_remote_copy(
+        src_ref=x_ref, dst_ref=o_ref,
+        send_sem=send_sem, recv_sem=recv_sem,
+        device_id=left if reverse else right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL)
+    dma.start()
+    dma.wait()
+
+
+def _ring_shift_call(x, axis_name: str, reverse: bool, interpret: bool):
+    n = jax.lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    if _interpret_ring_unsupported(interpret):
+        step = n - 1 if reverse else 1
+        perm = [(i, (i + step) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis_name, perm)
+    return pl.pallas_call(
+        functools.partial(_ring_shift_kernel, axis_name=axis_name, n=n,
+                          reverse=reverse, interpret=interpret),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        compiler_params=_collective_params(interpret, _SHIFT_COLLECTIVE_ID),
+        interpret=interpret,
+    )(x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def ring_shift(x, axis_name, reverse=False, interpret=False):
+    """The ring ``ppermute`` hop as ONE async remote DMA: send this
+    device's block to its right neighbour (``reverse=True``: left) and
+    return the block received — semantics of ``lax.ppermute`` with
+    ``perm=[(i, (i±1) % n)]``.  Per-device; call inside shard_map.
+    ring_attention's kv hop (``hop_impl="pallas"``) rides this.
+    Differentiable: the cotangent shifts the opposite direction.
+    """
+    return _ring_shift_call(x, axis_name, reverse, interpret)
+
+
+def _ring_shift_vjp_fwd(x, axis_name, reverse, interpret):
+    return _ring_shift_call(x, axis_name, reverse, interpret), None
+
+
+def _ring_shift_vjp_bwd(axis_name, reverse, interpret, _res, g):
+    return (_ring_shift_call(g, axis_name, not reverse, interpret),)
+
+
+ring_shift.defvjp(_ring_shift_vjp_fwd, _ring_shift_vjp_bwd)

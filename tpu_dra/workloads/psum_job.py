@@ -35,22 +35,31 @@ def main() -> int:
     import jax
 
     from tpu_dra.workloads.collectives import (
+        all_gather_bandwidth,
         make_mesh,
         ppermute_bandwidth,
         psum_bandwidth,
+        reduce_scatter_bandwidth,
     )
 
     devices = jax.devices()
     print(f"devices: {len(devices)} × {devices[0].device_kind}", flush=True)
     results = {}
     if len(devices) > 1:
+        # the full nvbandwidth-analog suite: all four ICI collectives the
+        # workloads ride — psum (gradients), ppermute (ring attention),
+        # all-gather / reduce-scatter (the exposed-communication floor the
+        # fused collective-matmul kernels overlap away; pallas_kernels)
         mesh = make_mesh()
-        psum = psum_bandwidth(mesh, mib_per_device=args.mib)
-        perm = ppermute_bandwidth(mesh, mib_per_device=args.mib)
-        results = {
-            "psum_gbps": round(psum.algo_bytes_per_s / 1e9, 2),
-            "ppermute_gbps": round(perm.algo_bytes_per_s / 1e9, 2),
+        suite = {
+            "psum": psum_bandwidth,
+            "ppermute": ppermute_bandwidth,
+            "all_gather": all_gather_bandwidth,
+            "reduce_scatter": reduce_scatter_bandwidth,
         }
+        for name, bench in suite.items():
+            res = bench(mesh, mib_per_device=args.mib)
+            results[f"{name}_gbps"] = round(res.algo_bytes_per_s / 1e9, 2)
     print(json.dumps({"n_devices": len(devices), **results}))
     return 0
 

@@ -46,6 +46,25 @@ def shard_map(f, *, mesh, in_specs, out_specs):
                       out_specs=out_specs, **{_CHECK_KW: False})
 
 
+def _hop(x, axis_name: str, hop_impl: str, perm):
+    """ONE kv ring hop, shared by both ring engines.  ``hop_impl``:
+    "xla" (``lax.ppermute`` — XLA schedules the shift around the block
+    compute) or "pallas" (``pallas_kernels.ring_shift`` — the hop as one
+    async remote DMA, the same kernel family the fused collective
+    matmuls ride; differentiable via its custom_vjp)."""
+    if hop_impl == "pallas":
+        from tpu_dra.workloads.pallas_kernels import ring_shift
+        return ring_shift(x, axis_name, False,
+                          jax.default_backend() != "tpu")
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def _check_hop_impl(hop_impl: str) -> None:
+    if hop_impl not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown hop_impl {hop_impl!r}; expected 'xla' or 'pallas'")
+
+
 def _block_attn(q, k, v, m, l, acc, mask, scale):
     """One online-softmax accumulation step against a single k/v block.
 
@@ -88,7 +107,7 @@ def _merge_partials(out_a, l2_a, out_b, l2_b):
 
 
 def ring_attention_flash(q, k, v, *, axis_name: str = "sp",
-                         causal: bool = True):
+                         causal: bool = True, hop_impl: str = "xla"):
     """Ring self-attention with the Pallas flash kernel as the per-block
     engine (fwd and bwd) — the MXU-fast long-context path.
 
@@ -105,6 +124,7 @@ def ring_attention_flash(q, k, v, *, axis_name: str = "sp",
     """
     from tpu_dra.workloads.pallas_kernels import flash_attention_with_lse
 
+    _check_hop_impl(hop_impl)
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     interpret = jax.default_backend() != "tpu"
@@ -118,8 +138,8 @@ def ring_attention_flash(q, k, v, *, axis_name: str = "sp",
 
     def step(t, carry):
         k_blk, v_blk, out, l2 = carry
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        k_blk = _hop(k_blk, axis_name, hop_impl, perm)
+        v_blk = _hop(v_blk, axis_name, hop_impl, perm)
         src = (idx - t) % n
 
         def fold(out, l2, k_blk, v_blk):
@@ -139,17 +159,19 @@ def ring_attention_flash(q, k, v, *, axis_name: str = "sp",
 
 
 def make_ring_attention_flash(mesh: Mesh, *, axis_name: str = "sp",
-                              causal: bool = True):
+                              causal: bool = True, hop_impl: str = "xla"):
     """shard_map-wrapped ``ring_attention_flash`` (see
     ``make_ring_attention``)."""
     batch = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch, None, axis_name, None)
     return shard_map(
-        partial(ring_attention_flash, axis_name=axis_name, causal=causal),
+        partial(ring_attention_flash, axis_name=axis_name, causal=causal,
+                hop_impl=hop_impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
 
 
-def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                   hop_impl: str = "xla"):
     """Ring self-attention for sequence-sharded q/k/v.
 
     Call inside ``shard_map`` (or ``shard_map``-decorated code) with the
@@ -167,6 +189,7 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
     # at attend time only — the [B, Hkv, S, D] blocks circulate the ring,
     # so ppermute moves just the shared heads (the flash engine shares kv
     # natively via kernel index maps).
+    _check_hop_impl(hop_impl)
     grp = q.shape[1] // k.shape[1]
     rep = (lambda t: jnp.repeat(t, grp, axis=1)) if grp > 1 else (lambda t: t)
     n = jax.lax.psum(1, axis_name)
@@ -192,8 +215,8 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
 
     def step(t, carry):
         k_blk, v_blk, m, l, acc = carry
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        k_blk = _hop(k_blk, axis_name, hop_impl, perm)
+        v_blk = _hop(v_blk, axis_name, hop_impl, perm)
         m, l, acc = _block_attn(qf, rep(k_blk).astype(jnp.float32),
                                 rep(v_blk).astype(jnp.float32),
                                 m, l, acc, block_mask((idx - t) % n), scale)
@@ -215,13 +238,14 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True):
 
 
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
-                        causal: bool = True):
+                        causal: bool = True, hop_impl: str = "xla"):
     """shard_map-wrapped ring attention for ``[B, H, S, D]`` arrays whose S
     axis is sharded over ``axis_name`` (batch over "dp" when present)."""
     batch = "dp" if "dp" in mesh.axis_names else None
     spec = P(batch, None, axis_name, None)
     fn = shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
+        partial(ring_attention, axis_name=axis_name, causal=causal,
+                hop_impl=hop_impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn
 
@@ -382,7 +406,8 @@ def make_zigzag_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
 # --- sequence-parallel train step --------------------------------------------
 
 
-def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
+def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla",
+              hop_impl="xla"):
     """Embed + decoder stack on a sequence shard: [B, S/n] tokens →
     pre-final-norm activations.
 
@@ -407,7 +432,8 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
         raise ValueError(
             f"unknown ring_impl {ring_impl!r}; expected 'xla' or 'flash'")
     ring_fn = ring_attention_flash if ring_impl == "flash" else ring_attention
-    attn = partial(ring_fn, axis_name=axis_name, causal=True)
+    attn = partial(ring_fn, axis_name=axis_name, causal=True,
+                   hop_impl=hop_impl)
 
     def block(carry, layer):
         return _block(cfg, carry, layer, attn_fn=attn,
@@ -418,7 +444,8 @@ def _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl="xla"):
 
 
 def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
-                         axis_name: str = "sp", ring_impl: str = "xla"):
+                         axis_name: str = "sp", ring_impl: str = "xla",
+                         hop_impl: str = "xla"):
     """Full DP×SP train step under ``shard_map``: tokens/targets sharded
     ``[("dp"), (sp)]``, params replicated, grads psum-averaged over the whole
     mesh.  Returns ``(step, token_sharding)``; ``step(params, tokens,
@@ -429,7 +456,10 @@ def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
     inside a shard would drop one target per boundary.
 
     ``ring_impl``: "xla" or "flash" (Pallas per-block kernels — the
-    MXU-fast engine for long-context shards).
+    MXU-fast engine for long-context shards).  ``hop_impl``: "xla"
+    (lax.ppermute) or "pallas" (the ring_shift remote-DMA kernel — one
+    async DMA per kv hop, same kernel family as the fused collective
+    matmuls).
 
     Multislice: on a ``("dcn", "dp", "sp")`` mesh the batch shards over
     BOTH dcn and dp while the sequence ring stays inside a slice —
@@ -448,7 +478,8 @@ def make_ring_train_step(cfg, mesh: Mesh, lr: float = 1e-2,
         from tpu_dra.workloads.train import head_nll
 
         sp_index = jax.lax.axis_index(axis_name)
-        x = _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl)
+        x = _sp_trunk(cfg, params, tokens, sp_index, axis_name, ring_impl,
+                      hop_impl)
         nll = head_nll(params, x, targets)
         return jnp.sum(nll), nll.size
 

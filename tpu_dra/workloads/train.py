@@ -164,8 +164,118 @@ def _causal_dense_attention(q, k, v, segment_ids=None):
     return out.reshape(B, H, S, D)
 
 
-def _norm_matmul(x, gamma, w, dtype, norm_impl: str = "dense"):
+def _mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _fc_batch_axes(mesh: Mesh):
+    """Batch partition axes for the fused-collective shard_map specs —
+    the same dcn/dp layering batch_sharding uses."""
+    return tuple(a for a in ("dcn", "dp") if a in mesh.axis_names) or None
+
+
+def _fc_active(x, w, mesh, matmul_impl: str, contract_sharded: bool) -> bool:
+    """Whether this matmul can take the fused-collective ring kernels:
+    a real >1-way "tp" axis, a plain-array weight (quantized/LoRA leaves
+    keep the XLA path — matmul_any owns those forms), and shapes that
+    split evenly over the ring.  Falling back is always CORRECT — the
+    fused path only changes which device computes what, never the math —
+    so a mixed trunk (some sublayers fused, some XLA) is legal."""
+    if matmul_impl != "fused_collective" or mesh is None:
+        return False
+    if not isinstance(w, jax.Array) or x.ndim != 3:
+        return False
+    tp = _mesh_axis_sizes(mesh).get("tp", 1)
+    if tp <= 1 or x.shape[1] % tp:
+        return False
+    # AG shards the weight's output axis, RS its contraction axis
+    shard_dim = w.shape[0] if contract_sharded else w.shape[1]
+    return shard_dim % tp == 0
+
+
+def _fc_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (the Pallas collective
+    kernels manage their own cross-device invariants), reusing
+    ring_attention's version-bridging wrapper."""
+    from tpu_dra.workloads.ring_attention import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _fc_ag_norm_matmul(x, gamma, w, mesh: Mesh, dtype):
+    """rmsnorm on the sequence shard, then the all-gather-matmul ring
+    kernel: the sublayer entry of the Megatron-SP layout — activations
+    live sequence-sharded over "tp" between sublayers, the norm runs on
+    1/tp of the rows, and the gather overlaps the qkv/w1 matmul on the
+    MXU instead of being scheduled around it by XLA."""
+    from tpu_dra.workloads.pallas_kernels import all_gather_matmul
+
+    interpret = jax.default_backend() != "tpu"
+    batch = _fc_batch_axes(mesh)
+    tp = _mesh_axis_sizes(mesh)["tp"]
+    D = x.shape[-1]
+
+    def inner(xs, g, wl):
+        bl, sl, _ = xs.shape
+        normed = _rmsnorm(xs, g)
+        # fold (seq-major) so the gathered row blocks ARE the seq blocks
+        xf = normed.transpose(1, 0, 2).reshape(sl * bl, D)
+        y = all_gather_matmul(xf, wl, "tp", interpret)
+        return y.reshape(tp * sl, bl, wl.shape[1]).transpose(1, 0, 2)
+
+    out = _fc_shard_map(
+        inner, mesh,
+        in_specs=(P(batch, "tp", None), P(None), P(None, "tp")),
+        out_specs=P(batch, None, "tp"))(x, gamma, w.astype(x.dtype))
+    out = checkpoint_name(out, "fc_collective_mm")
+    return out.astype(dtype)
+
+
+def _fc_matmul_rs(x, w, mesh: Mesh, dtype):
+    """The matching sublayer exit: the contraction axis (heads / d_ff) is
+    tp-sharded, so each device holds a partial product — the
+    matmul-reduce-scatter ring kernel reduces it while scattering the
+    rows back to the sequence-sharded residual stream."""
+    from tpu_dra.workloads.pallas_kernels import matmul_reduce_scatter
+
+    interpret = jax.default_backend() != "tpu"
+    batch = _fc_batch_axes(mesh)
+    tp = _mesh_axis_sizes(mesh)["tp"]
+
+    def inner(xs, wl):
+        bl, s, kl = xs.shape
+        xf = xs.transpose(1, 0, 2).reshape(s * bl, kl)
+        y = matmul_reduce_scatter(xf, wl, "tp", interpret)
+        return y.reshape(s // tp, bl, wl.shape[1]).transpose(1, 0, 2)
+
+    out = _fc_shard_map(
+        inner, mesh,
+        in_specs=(P(batch, None, "tp"), P("tp", None)),
+        out_specs=P(batch, "tp", None))(x, w.astype(x.dtype))
+    out = checkpoint_name(out, "fc_collective_mm")
+    return out.astype(dtype)
+
+
+def _out_matmul(x, w, dtype, matmul_impl: str = "dense", mesh=None):
+    """The sublayer-closing projection (wo / w2).  With
+    ``matmul_impl="fused_collective"`` and a tp-sharded contraction axis
+    it rides the matmul-reduce-scatter ring kernel; otherwise the plain
+    matmul_any dispatch (XLA inserts the psum)."""
+    if _fc_active(x, w, mesh, matmul_impl, contract_sharded=True):
+        return _fc_matmul_rs(x, w, mesh, dtype)
+    return matmul_any(x, w, dtype)
+
+
+def _norm_matmul(x, gamma, w, dtype, norm_impl: str = "dense",
+                 matmul_impl: str = "dense", mesh=None):
     """The pre-norm rmsnorm→matmul pair every sublayer opens with.
+
+    ``matmul_impl="fused_collective"`` (with a >1-way "tp" mesh axis)
+    routes plain-array weights through the all-gather-matmul ring kernel
+    (pallas_kernels.all_gather_matmul): activations stay sequence-sharded
+    over "tp", the norm runs on the shard, and the gather overlaps the
+    matmul on the MXU — the Megatron-SP entry half (exit half:
+    _out_matmul).  Mutually exclusive with ``norm_impl="fused"`` (the
+    collective path subsumes the norm fusion for sharded runs).
 
     ``norm_impl="fused"`` routes plain-array weights through the Pallas
     ``rmsnorm_matmul_train`` kernel (custom VJP; the activation never
@@ -174,6 +284,8 @@ def _norm_matmul(x, gamma, w, dtype, norm_impl: str = "dense"):
     shapes — falls back to the XLA pair, which is also the default
     (kernel promotion awaits an in-window hardware delta; armed in
     bench section_train as train_step_fused_*)."""
+    if _fc_active(x, w, mesh, matmul_impl, contract_sharded=False):
+        return _fc_ag_norm_matmul(x, gamma, w, mesh, dtype)
     if norm_impl == "fused" and isinstance(w, jax.Array):
         B, S, D = x.shape
         m, n = B * S, w.shape[1]
@@ -189,13 +301,15 @@ def _norm_matmul(x, gamma, w, dtype, norm_impl: str = "dense"):
 
 
 def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
-                   positions=None, norm_impl: str = "dense"):
+                   positions=None, norm_impl: str = "dense",
+                   matmul_impl: str = "dense", mesh=None):
     """Pre-norm attention residual sublayer, shared by the dense and MoE
     blocks.  GQA-aware: q carries n_heads, k/v carry kv_heads.  With
     ``pos_emb="rope"``, q/k rotate by ``positions`` (default: 0..S-1;
     sequence-parallel callers pass their global offsets)."""
     B, S, D = x.shape
-    qkv = _norm_matmul(x, layer["ln1"], layer["wqkv"], x.dtype, norm_impl)
+    qkv = _norm_matmul(x, layer["ln1"], layer["wqkv"], x.dtype, norm_impl,
+                       matmul_impl, mesh)
     q, k, v = jnp.split(qkv, [D, D + cfg.d_kv], axis=-1)
 
     def heads(t, n):
@@ -210,16 +324,19 @@ def _attn_sublayer(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
         k = apply_rope(k, positions, cfg.rope_base)
     out = attn_fn(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, D)
-    return x + matmul_any(out, layer["wo"], x.dtype)
+    return x + _out_matmul(out, layer["wo"], x.dtype, matmul_impl, mesh)
 
 
 def _block(cfg: ModelConfig, x, layer, attn_fn=_causal_dense_attention,
-           positions=None, norm_impl: str = "dense"):
+           positions=None, norm_impl: str = "dense",
+           matmul_impl: str = "dense", mesh=None):
     """One decoder block in bf16; wrapped in jax.checkpoint by forward()."""
-    x = _attn_sublayer(cfg, x, layer, attn_fn, positions, norm_impl)
-    h = _norm_matmul(x, layer["ln2"], layer["w1"], x.dtype, norm_impl)
+    x = _attn_sublayer(cfg, x, layer, attn_fn, positions, norm_impl,
+                       matmul_impl, mesh)
+    h = _norm_matmul(x, layer["ln2"], layer["w1"], x.dtype, norm_impl,
+                     matmul_impl, mesh)
     h = jax.nn.gelu(h)
-    return x + matmul_any(h, layer["w2"], x.dtype)
+    return x + _out_matmul(h, layer["w2"], x.dtype, matmul_impl, mesh)
 
 
 def _flash_attention_fn(q, k, v):
@@ -247,19 +364,49 @@ _ATTN_IMPLS = {"dense": _causal_dense_attention, "flash": _flash_attention_fn}
 
 
 def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention,
-           segment_ids=None, positions=None, norm_impl: str = "dense"):
+           segment_ids=None, positions=None, norm_impl: str = "dense",
+           matmul_impl: str = "dense", mesh=None):
     """Embed + decoder stack; returns pre-final-norm activations.
 
     Packing (``segment_ids`` + per-token ``positions`` [B, S]): the dense
     attention gets the block-diagonal segment mask and rope rotates by
     the per-segment positions (each document starts at 0).  Dense
-    attention only — the flash kernel has no segment mask."""
+    attention only — the flash kernel has no segment mask.
+
+    ``matmul_impl="fused_collective"`` (with ``mesh``): the residual
+    stream runs SEQUENCE-SHARDED over "tp" between sublayers and every
+    sublayer's entry/exit matmul rides the fused ring kernels — the
+    Megatron-SP layout, with the collectives overlapped into the MXU
+    loop instead of scheduled around it by XLA."""
     if segment_ids is not None:
         if attn_fn is not _causal_dense_attention:
             raise NotImplementedError(
                 "packed segment masks need the dense attention path")
         attn_fn = partial(_causal_dense_attention,
                           segment_ids=segment_ids)
+
+    fc = (matmul_impl == "fused_collective" and mesh is not None
+          and _mesh_axis_sizes(mesh).get("tp", 1) > 1
+          and segment_ids is None and positions is None)
+    S = tokens.shape[1]
+    pad = 0
+    if fc:
+        # The sequence axis must split over the ring: zero-pad the TOKEN
+        # tail up to a tp multiple (the loss trunk's S is tokens-1, so
+        # the flagship's 1023 needs it; padding the embedded activations
+        # instead trips XLA's partitioner against the embed gather —
+        # measured, not hypothetical).  Correctness-free for causal
+        # attention — every padded column is in the future of every real
+        # row (same argument as _flash_attention_fn's tile padding), the
+        # norms/residuals are row-local, and the tail rows are sliced
+        # off before the head.
+        pad = (-S) % _mesh_axis_sizes(mesh)["tp"]
+        if pad and cfg.pos_emb == "learned" and S + pad > cfg.max_seq:
+            # padding would walk off the learned-position table; keep
+            # the XLA path for this shape (fall back, never clamp)
+            fc, pad = False, 0
+        if pad:
+            tokens = jnp.pad(tokens, [(0, 0), (0, pad)])
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     if cfg.pos_emb == "learned":
         if positions is not None:
@@ -275,26 +422,33 @@ def _trunk(cfg: ModelConfig, params, tokens, attn_fn=_causal_dense_attention,
         else:
             x = x + params["pos"].astype(jnp.bfloat16)[: tokens.shape[1]]
 
+    # No explicit sharding constraint for the fused-collective layout:
+    # each sublayer's shard_map in_specs/out_specs already pin the
+    # residual stream sequence-sharded over "tp".
+
     # Selective remat: save matmul outputs, recompute elementwise ops in the
     # backward.  Measured on v5e @ S=1024/B=16: 60.5% MFU vs 57.0% full
     # remat vs OOM with no remat — the policy keeps the HBM win of
     # rematerialization without re-running the MXU work.
     policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-    if norm_impl == "fused":
-        # the Pallas fused op is not a dot the policy recognizes — name
-        # its output saveable, or remat would recompute the whole fused
-        # matmul in the backward and eat the fusion's win
+    if norm_impl == "fused" or fc:
+        # the Pallas fused ops are not dots the policy recognizes — name
+        # their outputs saveable, or remat would recompute the whole
+        # fused matmul (for the collective kernels: re-run the RING) in
+        # the backward and eat the fusion's win
         policy = jax.checkpoint_policies.save_from_both_policies(
             policy,
             jax.checkpoint_policies.save_only_these_names(
-                "fused_norm_mm"))
+                "fused_norm_mm", "fc_collective_mm"))
     block = jax.checkpoint(
         lambda carry, layer: (_block(cfg, carry, layer, attn_fn,
                                      positions=positions,
-                                     norm_impl=norm_impl), None),
+                                     norm_impl=norm_impl,
+                                     matmul_impl=matmul_impl,
+                                     mesh=mesh), None),
         policy=policy)
     x, _ = jax.lax.scan(block, x, params["blocks"])
-    return x
+    return x[:, :S] if pad else x
 
 
 def head_logits(params, x):
@@ -444,18 +598,21 @@ _chunked_nll.defvjp(_chunked_nll_fwd, _chunked_nll_bwd)
 
 
 def forward(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
-            norm_impl: str = "dense"):
+            norm_impl: str = "dense", matmul_impl: str = "dense",
+            mesh=None):
     """Logits for a [B, S] int32 token batch."""
     return head_logits(params, _trunk(cfg, params, tokens,
                                       _ATTN_IMPLS[attn_impl],
-                                      norm_impl=norm_impl))
+                                      norm_impl=norm_impl,
+                                      matmul_impl=matmul_impl, mesh=mesh))
 
 
 def loss_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
             head_impl: str = "dense", label_smoothing: float = 0.0,
-            z_loss: float = 0.0, norm_impl: str = "dense"):
+            z_loss: float = 0.0, norm_impl: str = "dense",
+            matmul_impl: str = "dense", mesh=None):
     trunk = _trunk(cfg, params, tokens[:, :-1], _ATTN_IMPLS[attn_impl],
-                   norm_impl=norm_impl)
+                   norm_impl=norm_impl, matmul_impl=matmul_impl, mesh=mesh)
     return jnp.mean(head_nll(params, trunk, tokens[:, 1:], head_impl,
                              label_smoothing=label_smoothing,
                              z_loss=z_loss))
@@ -482,7 +639,8 @@ def packed_loss_fn(cfg: ModelConfig, params, tokens, segment_ids,
 def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
              head_impl: str = "dense", accum_steps: int = 1,
              label_smoothing: float = 0.0, z_loss: float = 0.0,
-             norm_impl: str = "dense"):
+             norm_impl: str = "dense", matmul_impl: str = "dense",
+             mesh=None):
     """(mean loss, grads) for a [B, S] batch, optionally via gradient
     accumulation: ``accum_steps > 1`` splits the batch into that many
     microbatches and runs them through one ``lax.scan`` (one compiled
@@ -493,7 +651,9 @@ def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
     vg = jax.value_and_grad(partial(loss_fn, cfg,
                                     label_smoothing=label_smoothing,
                                     z_loss=z_loss,
-                                    norm_impl=norm_impl))
+                                    norm_impl=norm_impl,
+                                    matmul_impl=matmul_impl,
+                                    mesh=mesh))
     if accum_steps == 1:
         return vg(params, tokens, attn_impl=attn_impl, head_impl=head_impl)
     B = tokens.shape[0]
@@ -516,11 +676,13 @@ def grads_fn(cfg: ModelConfig, params, tokens, attn_impl: str = "dense",
 
 def sgd_train_step(cfg: ModelConfig, lr: float, params, tokens,
                    attn_impl: str = "dense", head_impl: str = "dense",
-                   accum_steps: int = 1, norm_impl: str = "dense"):
+                   accum_steps: int = 1, norm_impl: str = "dense",
+                   matmul_impl: str = "dense", mesh=None):
     """Full train step (fwd+bwd+update) as one jittable function."""
     loss, grads = grads_fn(cfg, params, tokens, attn_impl=attn_impl,
                            head_impl=head_impl, accum_steps=accum_steps,
-                           norm_impl=norm_impl)
+                           norm_impl=norm_impl, matmul_impl=matmul_impl,
+                           mesh=mesh)
     params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
     return params, loss
 
@@ -565,20 +727,27 @@ def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-2,
                             attn_impl: str = "dense",
                             head_impl: str = "dense",
                             accum_steps: int = 1,
-                            norm_impl: str = "dense"):
+                            norm_impl: str = "dense",
+                            matmul_impl: str = "dense"):
     """jit the full train step with DP×TP shardings over ``mesh`` (axes
     "dp", "tp").  ``attn_impl``: "dense" (XLA, best at short S) or "flash"
     (Pallas fwd+bwd kernels, best at long S).  ``head_impl``: "dense" or
     "chunked" (streamed-vocab NLL, see head_nll).  ``accum_steps``:
     gradient accumulation over that many microbatches (see grads_fn) —
     combine with the chunked head to train effective batches whose
-    activations would not fit."""
+    activations would not fit.  ``matmul_impl``: "dense" (XLA schedules
+    the tp collectives) or "fused_collective" (the Pallas remote-DMA
+    ring kernels overlap them with the MXU loop — see _trunk; no-op on
+    a 1-way "tp" axis)."""
+    if matmul_impl not in ("dense", "fused_collective"):
+        raise ValueError(f"unknown matmul_impl {matmul_impl!r}; expected "
+                         f"'dense' or 'fused_collective'")
     p_shard = param_shardings(cfg, mesh)
     b_shard = batch_sharding(mesh)
     step = jax.jit(
         partial(sgd_train_step, cfg, lr, attn_impl=attn_impl,
                 head_impl=head_impl, accum_steps=accum_steps,
-                norm_impl=norm_impl),
+                norm_impl=norm_impl, matmul_impl=matmul_impl, mesh=mesh),
         in_shardings=(p_shard, b_shard),
         out_shardings=(p_shard, NamedSharding(mesh, P())))
     return step, p_shard, b_shard
@@ -591,7 +760,8 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
                           label_smoothing: float = 0.0,
                           z_loss: float = 0.0,
                           zero1: bool = False,
-                          norm_impl: str = "dense"):
+                          norm_impl: str = "dense",
+                          matmul_impl: str = "dense"):
     """Like ``make_sharded_train_step`` but with a real optax optimizer
     (default: AdamW + global-norm clipping).
 
@@ -618,7 +788,8 @@ def make_optax_train_step(cfg: ModelConfig, mesh: Mesh, optimizer=None,
                                head_impl=head_impl,
                                accum_steps=accum_steps,
                                label_smoothing=label_smoothing,
-                               z_loss=z_loss, norm_impl=norm_impl)
+                               z_loss=z_loss, norm_impl=norm_impl,
+                               matmul_impl=matmul_impl, mesh=mesh)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
