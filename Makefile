@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-fleetsim image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -80,6 +80,17 @@ drive-chaos:
 # across the whole recovery; plus the zero-spare shrink-and-resume phase
 drive-preempt:
 	$(PYTHON) hack/drive_preempt.py
+
+# fleet-scale membership acceptance (docs/elastic-domains.md "Fleet
+# scale"): the REAL controller + membership code against ~200 synthetic
+# nodes over FakeKube — per-domain CR writes O(1) in member count (vs
+# the measured O(members) status-heartbeat baseline), zero false Lost,
+# bounded workqueue depth, blackout/crash/wedge/skew chaos with every
+# victim recovering through Lost -> promote -> rejoin.  The full
+# 1000-node sweep (hack/fleetsim.py --full) runs under the `slow`
+# pytest marker in tests/test_fleetsim.py, not here.
+drive-fleetsim:
+	$(PYTHON) hack/fleetsim.py
 
 # serving-SLO acceptance (docs/observability.md, ISSUE 8): scripted QPS
 # against the REAL serve binary with a p99 gate, per-tenant histograms,

@@ -1,0 +1,741 @@
+"""Fleet-scale membership simulator (``make drive-fleetsim``,
+docs/elastic-domains.md "Fleet scale").
+
+Drives the REAL controller (`Controller` → `SliceDomainManager` sweep →
+workqueue → arbitration writes) and the REAL daemon membership path
+(`MembershipManager.heartbeat_once` → per-node Lease renewals on the
+centralized retry policy) against thousands of synthetic nodes over
+FakeKube — three orders of magnitude beyond what `hack/drive_preempt.py`
+can run with real processes.  One scheduler thread pool drives every
+node's beats through `heartbeat_once()`, so the renewal code under test
+is exactly what ships; only the process/thread packaging is synthetic.
+
+What it measures (and asserts):
+
+- **O(1) API writes**: per-domain steady-state CR-status writes per
+  sweep interval must stay flat as the fleet scales 10 → 1000 nodes
+  (`phase scale`), versus the pre-Lease status-heartbeat contract whose
+  per-domain writes grow with member count (`phase baseline` runs the
+  SAME harness in ``heartbeat_mode=status`` at two domain sizes).
+- **Fault robustness** (`phase faults`): API blackout (all reads/writes
+  raise `Transient`; the controller's circuit breaker opens and the
+  sweep's blackout guard holds + rebases — zero false expiries), N%
+  simultaneous node crash (every victim walks Lost → promote → rejoin),
+  wedged renewals (daemon alive, lease aging), ±skew node wall clocks
+  (expiry decisions ride the controller's observation clock), and the
+  documented degradation — never a crash — of the armed
+  `daemon.lease.renew` / `controller.lease.sweep` failpoints.
+- **Control-plane health**: workqueue depth stays bounded (same-key
+  coalescing), reconcile throughput, and the sweep-tick latency
+  distribution (`tpu_dra_membership_sweep_seconds`).
+
+Simplifications vs a real cluster, on purpose: watch streams are
+in-process queues (a blackout blocks request traffic but not already-
+open watches — quiet anyway, since nobody can write), and a "node" is a
+`MembershipManager` without its informer/loop threads.
+
+Exit 0 = every assertion held; the JSON report goes to stdout (and
+``--report PATH``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import re
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, replace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra.api.types import NODE_STATE_ACTIVE, NODE_STATE_LOST  # noqa: E402
+from tpu_dra.controller.controller import Controller, ControllerConfig  # noqa: E402
+from tpu_dra.daemon.membership import MembershipManager  # noqa: E402
+from tpu_dra.k8s.client import (  # noqa: E402
+    EVENTS,
+    KubeClient,
+    LEASES,
+    TPU_SLICE_DOMAINS,
+    Transient,
+)
+from tpu_dra.k8s.fake import FakeKube  # noqa: E402
+from tpu_dra.resilience import failpoint  # noqa: E402
+from tpu_dra.resilience.breaker import CircuitBreaker, ResilientKubeClient  # noqa: E402
+from tpu_dra.resilience.retry import RetryPolicy  # noqa: E402
+from tpu_dra.util.metrics import DEFAULT_REGISTRY  # noqa: E402
+
+NS = "fleet"
+QUEUE = "slice-domain-controller"
+_LOST_RE = re.compile(r"node (\S+) membership lease expired")
+
+# short-fused write budget for simulated daemons: a blacked-out renewal
+# costs one skipped beat (~10ms), not a 10s stall of the shared
+# scheduler pool; conflicts still get a couple of quick retries
+SIM_RETRY = RetryPolicy(base=0.005, cap=0.05, deadline=1.0,
+                        max_attempts=3)
+
+
+class CountingKube(KubeClient):
+    """Transparent request-counting + blackout-injecting wrapper.
+
+    Counts every API attempt by (resource, verb) — failed attempts
+    included, because they are real apiserver traffic — and, while
+    ``blackout`` is set, fails every request with ``Transient`` (the
+    connection-level error class a dead apiserver produces), which is
+    what opens the controller client's circuit breaker."""
+
+    def __init__(self, inner: KubeClient) -> None:
+        self.inner = inner
+        self._mu = threading.Lock()
+        self.counts: dict[tuple[str, str], int] = {}   # guarded by self._mu
+        self.blackout = threading.Event()
+
+    def _tick(self, res, verb: str) -> None:
+        with self._mu:
+            key = (res.plural, verb)
+            self.counts[key] = self.counts.get(key, 0) + 1
+        if self.blackout.is_set():
+            raise Transient("fleetsim: injected API blackout")
+
+    def snapshot(self) -> dict[tuple[str, str], int]:
+        with self._mu:
+            return dict(self.counts)
+
+    def get(self, res, name, namespace=None):
+        self._tick(res, "get")
+        return self.inner.get(res, name, namespace)
+
+    def list(self, res, namespace=None, label_selector=None,
+             field_selector=None):
+        self._tick(res, "list")
+        return self.inner.list(res, namespace, label_selector,
+                               field_selector)
+
+    def create(self, res, obj, namespace=None):
+        self._tick(res, "create")
+        return self.inner.create(res, obj, namespace)
+
+    def update(self, res, obj, namespace=None):
+        self._tick(res, "update")
+        return self.inner.update(res, obj, namespace)
+
+    def update_status(self, res, obj, namespace=None):
+        self._tick(res, "update_status")
+        return self.inner.update_status(res, obj, namespace)
+
+    def patch(self, res, name, patch, namespace=None):
+        self._tick(res, "patch")
+        return self.inner.patch(res, name, patch, namespace)
+
+    def delete(self, res, name, namespace=None):
+        self._tick(res, "delete")
+        return self.inner.delete(res, name, namespace)
+
+    def watch(self, res, namespace=None, label_selector=None,
+              field_selector=None, resource_version="", stop=None):
+        # in-process event queues; see module docstring
+        return self.inner.watch(res, namespace, label_selector,
+                                field_selector, resource_version, stop)
+
+
+@dataclass
+class Config:
+    nodes: int = 200
+    domain_size: int = 8          # spec.numNodes
+    spares: int = 2               # spec.spares (nodes per domain = size+spares)
+    heartbeat: float = 0.5
+    lease_duration: float = 3.0
+    sweep_period: float = 0.5
+    skew: float = 1.0             # max |node wall-clock skew| seconds
+    measure_intervals: int = 6    # sweep intervals per measurement window
+    scale_points: tuple[int, ...] = (10, 60, 200)
+    crash_fraction: float = 0.05
+    wedge_count: int = 4
+    workers: int = 8              # beat scheduler pool
+    seed: int = 20260803
+    settle_timeout: float = 60.0
+
+
+@dataclass
+class SimNode:
+    name: str
+    domain: str
+    manager: MembershipManager
+    skew: float
+    alive: bool = True
+    wedged: bool = False
+    next_due: float = 0.0
+    beats_ok: int = 0
+    beats_failed: int = 0
+
+
+@dataclass
+class Check:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+class Fleet:
+    """One FakeKube universe: domains, simulated daemons, the real
+    controller, a beat scheduler, and a workqueue-depth sampler."""
+
+    def __init__(self, cfg: Config, mode: str = "lease") -> None:
+        self.cfg = cfg
+        self.mode = mode
+        self.rng = random.Random(cfg.seed)
+        self.fake = FakeKube()
+        self.counting = CountingKube(self.fake)
+        self.breaker = CircuitBreaker(failure_threshold=3,
+                                      open_duration=cfg.sweep_period * 2,
+                                      name="fleetsim")
+        self.controller = Controller(ControllerConfig(
+            kube=ResilientKubeClient(self.counting, breaker=self.breaker),
+            gc_period=3600.0,
+            lease_duration=cfg.lease_duration,
+            sweep_period=cfg.sweep_period))
+        per = cfg.domain_size + cfg.spares
+        self.n_domains = max(1, cfg.nodes // per)
+        self.domains = [f"dom-{d:03d}" for d in range(self.n_domains)]
+        self.nodes: list[SimNode] = []
+        for d, dom in enumerate(self.domains):
+            for i in range(per):
+                name = f"d{d:03d}-n{i:02d}"
+                skew = self.rng.uniform(-cfg.skew, cfg.skew)
+                mgr = MembershipManager(
+                    self.counting, dom, NS, name,
+                    f"10.{d % 250}.{i}.1", f"slice-{d}.0", i,
+                    heartbeat_interval=cfg.heartbeat,
+                    heartbeat_mode=mode,
+                    now_fn=(lambda s=skew: time.time() + s),
+                    retry_policy=SIM_RETRY)
+                self.nodes.append(SimNode(name, dom, mgr, skew))
+        self.by_name = {n.name: n for n in self.nodes}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._pool: ThreadPoolExecutor | None = None
+        self.depth_samples: list[float] = []
+        self._depth_gauge = DEFAULT_REGISTRY.gauge(
+            "tpu_dra_workqueue_depth",
+            "items waiting in the queue (ready + backoff-delayed)",
+            labels=("queue",))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        for dom in self.domains:
+            self.fake.create(TPU_SLICE_DOMAINS, {
+                "apiVersion": "resource.tpu.google.com/v1beta1",
+                "kind": "TpuSliceDomain",
+                "metadata": {"name": dom, "namespace": NS},
+                "spec": {"numNodes": self.cfg.domain_size,
+                         "spares": self.cfg.spares,
+                         "channel": {"resourceClaimTemplate":
+                                     {"name": f"{dom}-ch"}}},
+            })
+        self._depth_gauge.set(0.0, QUEUE)   # fresh fleet, fresh baseline
+        self.controller.start()
+        workers = max(self.cfg.workers, len(self.nodes) // 64)
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="beat")
+        # beats FIRST (renew_lease creates each Lease on its first
+        # tick), registration second: at 1000 nodes a registration
+        # burst takes tens of seconds of CR-conflict churn, and leases
+        # created up front would age past expiry before the first
+        # renewal — a harness artifact, not a membership signal
+        now = time.monotonic()
+        for n in self.nodes:
+            n.next_due = now + self.rng.uniform(0, self.cfg.heartbeat)
+        for target, name in ((self._beat_loop, "fleetsim-beats"),
+                             (self._sample_loop, "fleetsim-sampler")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        # identity into status ONCE per node; sequential = one status
+        # writer per domain at a time, so conflict retries stay rare
+        for n in self.nodes:
+            self._register(n)
+        for n in self.nodes:       # conflict-starved stragglers, retry
+            if not self._registered(n):
+                self._register(n)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        self.controller.stop()
+        self.fake.close_watchers()
+
+    def _register(self, node: SimNode) -> None:
+        node.manager.update_own_node_info()
+        if self.mode != "status":
+            try:
+                node.manager.renew_lease()
+            except Exception:  # noqa: BLE001 — next beat recreates it
+                node.beats_failed += 1
+
+    def _registered(self, node: SimNode) -> bool:
+        status = self._status(node.domain)
+        return any(n.get("name") == node.name
+                   for n in status.get("nodes", []))
+
+    # -- beat scheduler ---------------------------------------------------
+    def _beat_loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            due = []
+            for n in self.nodes:
+                if n.next_due <= now:
+                    while n.next_due <= now:
+                        n.next_due += self.cfg.heartbeat
+                    if n.alive and not n.wedged:
+                        due.append(n)
+            if due:
+                list(self._pool.map(self._beat, due))
+            self._stop.wait(min(self.cfg.heartbeat, 0.05) / 2)
+
+    def _beat(self, node: SimNode) -> None:
+        try:
+            node.manager.heartbeat_once()
+            node.beats_ok += 1
+        except Exception:  # noqa: BLE001 — the daemon loop's contract:
+            # a failed beat is a missed renewal, never a crash
+            node.beats_failed += 1
+
+    def _sample_loop(self) -> None:
+        while not self._stop.wait(self.cfg.sweep_period / 2):
+            self.depth_samples.append(self._depth_gauge.value(QUEUE))
+
+    # -- observation (raw fake reads: never counted as driver traffic) ----
+    def _status(self, dom: str) -> dict:
+        return self.fake.get(TPU_SLICE_DOMAINS, dom, NS).get("status") or {}
+
+    def states(self, dom: str) -> dict[str, str]:
+        return {n["name"]: n.get("state", "")
+                for n in self._status(dom).get("nodes", [])}
+
+    def lost_event_nodes(self) -> set[str]:
+        names = set()
+        for ev in self.fake.list(EVENTS, namespace=NS)["items"]:
+            if ev.get("reason") == "NodeLost":
+                m = _LOST_RE.search(ev.get("message", ""))
+                if m:
+                    names.add(m.group(1))
+        return names
+
+    def event_count(self, reason: str) -> int:
+        return sum(1 for ev in self.fake.list(EVENTS, namespace=NS)["items"]
+                   if ev.get("reason") == reason)
+
+    def all_settled(self) -> bool:
+        """Every domain's roles are stamped and its active mesh is full.
+        Generation is NOT part of this: the initial role stamping
+        deliberately does not bump it (the active set didn't change)."""
+        for dom in self.domains:
+            nodes = self._status(dom).get("nodes", [])
+            active = [n for n in nodes
+                      if n.get("state") == NODE_STATE_ACTIVE]
+            if len(nodes) != self.cfg.domain_size + self.cfg.spares or \
+                    len(active) != self.cfg.domain_size or \
+                    any(not n.get("state") for n in nodes):
+                return False
+        return True
+
+    def wait_for(self, pred, timeout: float, what: str) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(min(self.cfg.sweep_period / 2, 0.25))
+        raise AssertionError(f"timed out after {timeout:.0f}s waiting "
+                             f"for {what}")
+
+    def settle(self) -> None:
+        self.wait_for(self.all_settled, self.cfg.settle_timeout,
+                      "every domain arbitrated to a full active mesh")
+
+    # -- measurement ------------------------------------------------------
+    def measure(self, intervals: int) -> dict:
+        """Steady-state API write rates over ``intervals`` sweep
+        periods, normalized per domain / per node / per interval."""
+        t0 = self.counting.snapshot()
+        depth_mark = len(self.depth_samples)
+        time.sleep(intervals * self.cfg.sweep_period)
+        t1 = self.counting.snapshot()
+        delta = {k: t1.get(k, 0) - t0.get(k, 0)
+                 for k in set(t0) | set(t1)}
+        status_writes = delta.get((TPU_SLICE_DOMAINS.plural,
+                                   "update_status"), 0)
+        lease_writes = delta.get((LEASES.plural, "update"), 0) + \
+            delta.get((LEASES.plural, "create"), 0)
+        window = self.depth_samples[depth_mark:]
+        return {
+            "nodes": len(self.nodes),
+            "domains": self.n_domains,
+            "members_per_domain": self.cfg.domain_size + self.cfg.spares,
+            "intervals": intervals,
+            "status_writes_per_domain_per_interval": round(
+                status_writes / self.n_domains / intervals, 3),
+            "lease_writes_per_node_per_interval": round(
+                lease_writes / len(self.nodes) / intervals, 3),
+            "workqueue_depth_max": max(window, default=0.0),
+        }
+
+
+def hist_quantiles(before: dict, after: dict,
+                   buckets: list[float]) -> dict:
+    """Approximate quantiles of a histogram's delta between two
+    ``snapshot()`` calls (upper bucket bound at the target rank)."""
+    b = (before or {}).get((), {"cumulative": [0] * len(buckets),
+                                "count": 0})
+    a = (after or {}).get((), b)
+    cum = [ac - bc for ac, bc in zip(a["cumulative"], b["cumulative"])]
+    total = a["count"] - b["count"]
+    out = {"count": total}
+    for q in (0.5, 0.99):
+        label = f"p{int(q * 100)}"
+        if total <= 0:
+            out[label] = None
+            continue
+        rank = q * total
+        out[label] = next(
+            (buckets[i] for i, c in enumerate(cum) if c >= rank), None)
+    return out
+
+
+# -------------------------------------------------------------------------
+# phases
+
+
+def phase_baseline(cfg: Config, checks: list[Check]) -> dict:
+    """The O(members) proof: the same harness, pre-Lease status
+    heartbeats vs Lease renewals, at two domain sizes."""
+    out: dict = {}
+    rates: dict[tuple[str, int], float] = {}
+    for mode in ("status", "lease"):
+        for size in (4, 16):
+            c = replace(cfg, nodes=3 * (size + 1), domain_size=size,
+                        spares=1,
+                        lease_duration=max(cfg.lease_duration,
+                                           6 * cfg.heartbeat))
+            fleet = Fleet(c, mode=mode)
+            fleet.start()
+            try:
+                fleet.settle()
+                m = fleet.measure(cfg.measure_intervals)
+                rates[(mode, size)] = \
+                    m["status_writes_per_domain_per_interval"]
+                out[f"{mode}_size{size}"] = m
+            finally:
+                fleet.stop()
+    growth = rates[("status", 16)] / max(rates[("status", 4)], 0.001)
+    checks.append(Check(
+        "baseline: status-mode per-domain writes grow with member count",
+        growth >= 2.0,
+        f"size-16/size-4 write ratio {growth:.1f} (heartbeats ride the "
+        f"shared CR)"))
+    lease_worst = max(rates[("lease", 4)], rates[("lease", 16)])
+    checks.append(Check(
+        "baseline: lease-mode per-domain CR writes flat and near zero",
+        lease_worst <= 0.5 and
+        abs(rates[("lease", 16)] - rates[("lease", 4)]) <= 0.5,
+        f"size-4 {rates[('lease', 4)]}, size-16 {rates[('lease', 16)]} "
+        f"writes/domain/interval"))
+    out["growth_status_mode"] = round(growth, 2)
+    return out
+
+
+def phase_scale(cfg: Config, checks: list[Check]) -> dict:
+    """Lease-mode steady state across fleet sizes: per-domain CR writes
+    must be flat (O(1) in member count and fleet size alike)."""
+    out: dict = {}
+    rates = []
+    sweep_hist = DEFAULT_REGISTRY.histogram(
+        "tpu_dra_membership_sweep_seconds",
+        "wall time of one membership staleness-sweep tick")
+    for n in cfg.scale_points:
+        fleet = Fleet(replace(cfg, nodes=n))
+        before = sweep_hist.snapshot()
+        fleet.start()
+        try:
+            fleet.settle()
+            m = fleet.measure(cfg.measure_intervals)
+            m["sweep_seconds"] = hist_quantiles(
+                before, sweep_hist.snapshot(), sweep_hist.buckets)
+            m["false_lost"] = sorted(fleet.lost_event_nodes())
+            rates.append(m["status_writes_per_domain_per_interval"])
+            out[f"nodes{n}"] = m
+            checks.append(Check(
+                f"scale {n}: zero false-positive Lost",
+                not m["false_lost"], str(m["false_lost"])))
+            checks.append(Check(
+                f"scale {n}: workqueue depth bounded",
+                m["workqueue_depth_max"] <= fleet.n_domains + 32,
+                f"max depth {m['workqueue_depth_max']} vs bound "
+                f"{fleet.n_domains + 32}"))
+        finally:
+            fleet.stop()
+    checks.append(Check(
+        "scale: per-domain CR status writes flat 10x-100x",
+        max(rates) - min(rates) <= 0.5 and max(rates) <= 0.5,
+        f"writes/domain/interval across {list(cfg.scale_points)}: "
+        f"{rates}"))
+    out["rates"] = rates
+    return out
+
+
+def phase_faults(cfg: Config, checks: list[Check]) -> dict:
+    """The 1000-node chaos pass (at whatever --nodes says): blackout,
+    crash, wedge, skew, armed failpoints — all against one fleet."""
+    out: dict = {}
+    lease, sweep = cfg.lease_duration, cfg.sweep_period
+    expiry_wait = lease + 4 * sweep + 5.0
+    fleet = Fleet(cfg)
+    reconciles = DEFAULT_REGISTRY.counter(
+        "tpu_dra_reconciles_total",
+        "TpuSliceDomain reconcile attempts", labels=("result",))
+    rec0, t_start = reconciles.value("ok"), time.monotonic()
+    fleet.start()
+    try:
+        fleet.settle()
+        out["settle_reconciles_per_s"] = round(
+            (reconciles.value("ok") - rec0) /
+            max(time.monotonic() - t_start, 0.001), 1)
+
+        # 1. steady state under clock skew: nobody may be expired
+        time.sleep(max(lease, cfg.measure_intervals * sweep))
+        checks.append(Check(
+            "faults: zero Lost in skewed steady state",
+            not fleet.lost_event_nodes(),
+            f"skew ±{cfg.skew}s, lost={sorted(fleet.lost_event_nodes())}"))
+
+        # 2. armed daemon.lease.renew=error for < lease/2: beats skip
+        #    (documented degradation), nobody expires, nothing crashes
+        failed0 = sum(n.beats_failed for n in fleet.nodes)
+        lost_before = set(fleet.lost_event_nodes())
+        failpoint.activate("daemon.lease.renew=error")
+        time.sleep(min(lease / 3, 2 * cfg.heartbeat + 1.0))
+        failpoint.deactivate("daemon.lease.renew")
+        failpoint.reset()
+        failed1 = sum(n.beats_failed for n in fleet.nodes)
+        time.sleep(2 * cfg.heartbeat)   # re-fresh every lease
+        checks.append(Check(
+            "faults: daemon.lease.renew=error degrades to skipped beats",
+            failed1 > failed0 and
+            not fleet.lost_event_nodes() - lost_before,
+            f"{failed1 - failed0} beats skipped, zero Lost"))
+        out["renew_failpoint_skipped_beats"] = failed1 - failed0
+
+        # 3. N% simultaneous crash -> Lost -> promote -> revive -> rejoin
+        n_victims = max(1, int(len(fleet.nodes) * cfg.crash_fraction))
+        victims = fleet.rng.sample(fleet.nodes, n_victims)
+        victim_names = {v.name for v in victims}
+        lost_before = set(fleet.lost_event_nodes())
+        for v in victims:
+            v.alive = False
+        fleet.wait_for(
+            lambda: victim_names <= fleet.lost_event_nodes(),
+            expiry_wait, "every crash victim to be marked Lost")
+        checks.append(Check(
+            "faults: only crash victims marked Lost",
+            fleet.lost_event_nodes() - lost_before <= victim_names,
+            f"victims {len(victim_names)}, lost "
+            f"{len(fleet.lost_event_nodes() - lost_before)}"))
+        promoted = fleet.event_count("SparePromoted")
+        checks.append(Check(
+            "faults: spares promoted to cover crashed actives",
+            promoted > 0, f"{promoted} SparePromoted events"))
+        for v in victims:       # pod restarts: republish identity, beat
+            v.alive = True
+            v.manager.update_own_node_info()
+        fleet.wait_for(
+            lambda: all(NODE_STATE_LOST not in fleet.states(d).values()
+                        for d in fleet.domains) and fleet.all_settled(),
+            expiry_wait + lease * 3,
+            "every victim to rejoin and every mesh to refill")
+        rejoined = fleet.event_count("NodeRejoined")
+        checks.append(Check(
+            "faults: victims recovered through Lost -> promote -> rejoin",
+            rejoined > 0, f"{rejoined} NodeRejoined events"))
+        out["crash"] = {"victims": n_victims, "promoted": promoted,
+                        "rejoined": rejoined}
+
+        # 4. API blackout: breaker opens, sweep holds, ages rebase on
+        #    recovery -> zero NEW Lost from the outage
+        lost_before = set(fleet.lost_event_nodes())
+        fleet.counting.blackout.set()
+        fleet.wait_for(lambda: fleet.breaker.is_open(),
+                       lease + 10.0, "the circuit breaker to open")
+        time.sleep(1.5 * lease)     # well past every lease's expiry
+        fleet.counting.blackout.clear()
+        time.sleep(2 * lease + 2 * sweep)   # recover + re-fresh + sweep
+        new_lost = fleet.lost_event_nodes() - lost_before
+        checks.append(Check(
+            "faults: blackout causes zero false Lost (guard + rebase)",
+            not new_lost, f"new Lost after blackout: {sorted(new_lost)}"))
+        checks.append(Check(
+            "faults: breaker re-closed after blackout",
+            not fleet.breaker.is_open(), fleet.breaker.state))
+        out["blackout_held_sweeps"] = True
+
+        # 5. wedged renewals: daemon alive, lease aging -> Lost -> unwedge
+        #    -> rejoin (the lease-expiry/rejoin race, at fleet scale)
+        wedged = fleet.rng.sample(
+            [n for n in fleet.nodes if n.name not in victim_names],
+            min(cfg.wedge_count, len(fleet.nodes)))
+        wedged_names = {w.name for w in wedged}
+        lost_before = set(fleet.lost_event_nodes())
+        for w in wedged:
+            w.wedged = True
+        fleet.wait_for(
+            lambda: wedged_names <= fleet.lost_event_nodes(),
+            expiry_wait, "wedged nodes to be marked Lost")
+        checks.append(Check(
+            "faults: only wedged nodes newly Lost",
+            fleet.lost_event_nodes() - lost_before <= wedged_names,
+            str(sorted(fleet.lost_event_nodes() - lost_before))))
+        for w in wedged:
+            w.wedged = False
+        fleet.wait_for(
+            lambda: all(NODE_STATE_LOST not in fleet.states(d).values()
+                        for d in fleet.domains) and fleet.all_settled(),
+            expiry_wait + lease * 3, "wedged nodes to rejoin")
+        out["wedge"] = {"wedged": len(wedged_names)}
+
+        # 6. controller.lease.sweep=error: expiry is DELAYED (the
+        #    documented degradation), then resumes on disarm
+        canary = fleet.rng.choice(
+            [n for n in fleet.nodes
+             if n.name not in victim_names | wedged_names])
+        lost_before = set(fleet.lost_event_nodes())
+        failpoint.activate("controller.lease.sweep=error")
+        canary.wedged = True
+        time.sleep(lease + 3 * sweep)
+        held = canary.name not in fleet.lost_event_nodes()
+        failpoint.deactivate("controller.lease.sweep")
+        failpoint.reset()
+        fleet.wait_for(
+            lambda: canary.name in fleet.lost_event_nodes(),
+            expiry_wait, "expiry to resume after sweep failpoint disarm")
+        checks.append(Check(
+            "faults: controller.lease.sweep=error delays expiry, "
+            "no crash",
+            held and (fleet.lost_event_nodes() - lost_before ==
+                      {canary.name}),
+            f"held_while_armed={held}"))
+        canary.wedged = False
+        fleet.wait_for(
+            lambda: all(NODE_STATE_LOST not in fleet.states(d).values()
+                        for d in fleet.domains) and fleet.all_settled(),
+            expiry_wait + lease * 3, "canary to rejoin")
+
+        out["beats_ok"] = sum(n.beats_ok for n in fleet.nodes)
+        out["beats_failed"] = sum(n.beats_failed for n in fleet.nodes)
+        out["workqueue_depth_max"] = max(fleet.depth_samples, default=0.0)
+        # one queued copy per domain (same-key coalescing) plus one
+        # processing copy per domain (no client-go dirty-set dedupe),
+        # plus slack — vs the unbounded pre-coalescing flood (PR 7
+        # measured depth 1965 from FOUR daemons)
+        checks.append(Check(
+            "faults: workqueue depth bounded through all faults",
+            out["workqueue_depth_max"] <= 2 * fleet.n_domains + 32,
+            f"max depth {out['workqueue_depth_max']} vs bound "
+            f"{2 * fleet.n_domains + 32}"))
+    finally:
+        failpoint.release_all()
+        failpoint.reset()
+        fleet.stop()
+    return out
+
+
+# -------------------------------------------------------------------------
+
+
+def parse_args(argv=None) -> tuple[Config, list[str], str]:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--nodes", type=int, default=200)
+    ap.add_argument("--domain-size", type=int, default=8)
+    ap.add_argument("--spares", type=int, default=2)
+    ap.add_argument("--heartbeat", type=float, default=0.5)
+    ap.add_argument("--lease-duration", type=float, default=3.0)
+    ap.add_argument("--sweep-period", type=float, default=0.5)
+    ap.add_argument("--skew", type=float, default=1.0)
+    ap.add_argument("--scale-points", default="10,60,200")
+    ap.add_argument("--measure-intervals", type=int, default=6)
+    ap.add_argument("--crash-fraction", type=float, default=0.05)
+    ap.add_argument("--wedge-count", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--phases", default="baseline,scale,faults")
+    ap.add_argument("--report", default="")
+    ap.add_argument("--full", action="store_true",
+                    help="the 1000-node acceptance sweep: ±5s skew, "
+                         "8s leases (slow; runs under the `slow` pytest "
+                         "marker, not in the smoke lane)")
+    args = ap.parse_args(argv)
+    if args.full:
+        args.nodes, args.scale_points = 1000, "10,100,1000"
+        args.heartbeat, args.lease_duration = 1.0, 8.0
+        args.sweep_period, args.skew = 2.0, 5.0
+        args.measure_intervals = 5
+    cfg = Config(
+        nodes=args.nodes, domain_size=args.domain_size,
+        spares=args.spares, heartbeat=args.heartbeat,
+        lease_duration=args.lease_duration,
+        sweep_period=args.sweep_period, skew=args.skew,
+        measure_intervals=args.measure_intervals,
+        scale_points=tuple(int(p) for p in
+                           args.scale_points.split(",") if p),
+        crash_fraction=args.crash_fraction,
+        wedge_count=args.wedge_count, workers=args.workers,
+        seed=args.seed)
+    return cfg, [p.strip() for p in args.phases.split(",") if p.strip()], \
+        args.report
+
+
+def run(cfg: Config, phases: list[str]) -> tuple[dict, list[Check]]:
+    checks: list[Check] = []
+    report: dict = {"config": {
+        "nodes": cfg.nodes, "domain_size": cfg.domain_size,
+        "spares": cfg.spares, "heartbeat_s": cfg.heartbeat,
+        "lease_duration_s": cfg.lease_duration,
+        "sweep_period_s": cfg.sweep_period, "skew_s": cfg.skew,
+        "phases": phases}}
+    runners = {"baseline": phase_baseline, "scale": phase_scale,
+               "faults": phase_faults}
+    for phase in phases:
+        t0 = time.monotonic()
+        try:
+            report[phase] = runners[phase](cfg, checks)
+        except AssertionError as exc:
+            checks.append(Check(f"{phase}: completed", False, str(exc)))
+        report.setdefault("phase_secs", {})[phase] = round(
+            time.monotonic() - t0, 1)
+    report["checks"] = [{"name": c.name, "ok": c.ok, "detail": c.detail}
+                        for c in checks]
+    report["ok"] = all(c.ok for c in checks)
+    return report, checks
+
+
+def main(argv=None) -> int:
+    cfg, phases, report_path = parse_args(argv)
+    report, checks = run(cfg, phases)
+    print(json.dumps(report, indent=1))
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=1)
+    for c in checks:
+        print(f"{'PASS' if c.ok else 'FAIL'}  {c.name}"
+              + (f"  [{c.detail}]" if c.detail else ""), file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

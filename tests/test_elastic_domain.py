@@ -28,6 +28,7 @@ from tpu_dra.controller.slicedomain import (
 from tpu_dra.daemon.membership import MembershipManager
 from tpu_dra.k8s import EVENTS, FakeKube, TPU_SLICE_DOMAINS
 from tpu_dra.k8s.client import Conflict
+from tpu_dra.k8s.leases import lease_name
 
 # DRA-core fast lane (`make test-core`, -m core): driver machinery only,
 # no JAX workload compiles
@@ -580,11 +581,14 @@ def test_returning_node_enters_arbitrated_domain_as_spare():
 
 def test_daemon_preserves_controller_owned_state(controller):
     """A daemon republishing its entry (heartbeat) must carry the
-    controller-assigned state verbatim, not clobber it back to ''."""
+    controller-assigned state verbatim, not clobber it back to ''.
+    Runs in ``dual`` mode: only the legacy status-heartbeat channel
+    republishes the entry every beat (lease mode writes it once)."""
     ctrl, kube = controller
     make_domain(kube, num_nodes=1, spares=1)
     m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
-                          "slice-uuid.0", 0, heartbeat_interval=0.05)
+                          "slice-uuid.0", 0, heartbeat_interval=0.05,
+                          heartbeat_mode="dual")
     m.start()
     try:
         assert wait_until(lambda: "n0" in node_states(kube), timeout=8)
@@ -746,3 +750,279 @@ def test_launcher_resolves_generation(tmp_path):
                     "POD_IP": "10.0.0.11"})
     assert info.generation == 5
     assert info.process_id == 1
+
+
+# --- per-node Leases (ISSUE 11): plan compat, clock skew, O(1) writes -------
+
+
+def test_effective_age_min_freshness():
+    from tpu_dra.controller.slicedomain import effective_age
+
+    now = time.time()
+    # lease-mode daemon: status stamp stale by design, lease fresh
+    n = node("n0", 0, age=120.0, now=now)
+    assert effective_age(n, now, {"n0": 0.5}) == pytest.approx(0.5)
+    # no lease tracked -> legacy status heartbeat
+    assert effective_age(n, now, {}) == pytest.approx(120.0, abs=0.1)
+    # dual-mode daemon whose lease writes fail but status succeeds:
+    # the freshest signal wins — it IS alive
+    fresh = node("n1", 1, age=0.2, now=now)
+    assert effective_age(fresh, now, {"n1": 60.0}) == \
+        pytest.approx(0.2, abs=0.1)
+    # never heartbeated anywhere: exempt (legacy writer)
+    legacy = node("n2", 2, now=now)
+    legacy.last_heartbeat = ""
+    assert effective_age(legacy, now, {}) is None
+
+
+def test_plan_lease_age_expires_and_boundary():
+    """Expiry decisions ride the controller-observed lease age; the
+    boundary is strict (age must EXCEED the lease duration)."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=1,
+        nodes=[node("n0", 0, age=500.0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, age=500.0, state=NODE_STATE_ACTIVE, now=now),
+               node("n2", 2, age=500.0, state=NODE_STATE_SPARE, now=now)])
+    # all status stamps stale (lease-mode daemons): ages come from the
+    # tracker.  n1 just under the boundary, n0 just over.
+    plan = membership_plan(
+        status, TpuSliceDomainSpec(num_nodes=2), now, LEASE,
+        lease_ages={"n0": LEASE + 0.01, "n1": LEASE - 0.01, "n2": 0.0})
+    assert plan.states["n0"] == NODE_STATE_LOST
+    assert plan.states["n2"] == NODE_STATE_ACTIVE   # spare promoted
+    assert "n1" not in plan.states or \
+        plan.states["n1"] != NODE_STATE_LOST
+
+
+def test_plan_lease_rejoin_race_fencing_holds():
+    """Expiry-vs-rejoin race on lease ages: the lost node renews again
+    AFTER a spare was promoted into its slot — it must park as Spare
+    (the promotion stands), even though its lease age is now the
+    freshest in the domain."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=2,
+        nodes=[node("n0", 0, age=500.0, state=NODE_STATE_ACTIVE, now=now),
+               node("n1", 1, age=500.0, state=NODE_STATE_LOST, now=now),
+               node("n2", 2, age=500.0, state=NODE_STATE_ACTIVE, now=now)])
+    plan = membership_plan(
+        status, TpuSliceDomainSpec(num_nodes=2), now, LEASE,
+        lease_ages={"n0": 1.0, "n1": 0.0, "n2": 1.0})
+    assert plan.states == {"n1": NODE_STATE_SPARE}
+    assert not plan.bump and plan.promotions == []
+    rejoins = [e for e in plan.events if e[0] == "NodeRejoined"]
+    assert rejoins and "fencing" in rejoins[0][1]
+
+
+def count_status_writes(kube):
+    """Monkeypatch-count update_status on the domain CR."""
+    real = kube.update_status
+    counter = {"n": 0}
+
+    def counting(res, obj, namespace=None):
+        if res is TPU_SLICE_DOMAINS:
+            counter["n"] += 1
+        return real(res, obj, namespace)
+
+    kube.update_status = counting
+    return counter
+
+
+def test_lease_mode_heartbeats_never_touch_status():
+    """THE O(1) contract at unit level: after registration, N heartbeat
+    ticks in lease mode produce N lease renewals and ZERO CR status
+    writes."""
+    from tpu_dra.k8s.client import LEASES
+
+    kube = FakeKube()
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=9999)
+    m.update_own_node_info()     # registration (1 status write)
+    counter = count_status_writes(kube)
+    for _ in range(5):
+        m.heartbeat_once()
+    assert counter["n"] == 0
+    lease = kube.get(LEASES, lease_name("dom", "n0"), NS)
+    assert lease["spec"]["holderIdentity"] == "n0"
+    # renewals actually happened: RV moved past the create
+    assert int(lease["metadata"]["resourceVersion"]) >= 5
+
+
+def test_dual_mode_heartbeats_write_both_channels():
+    from tpu_dra.k8s.client import LEASES
+
+    kube = FakeKube()
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=9999,
+                          heartbeat_mode="dual")
+    m.update_own_node_info()
+    counter = count_status_writes(kube)
+    for _ in range(3):
+        m.heartbeat_once()
+    assert counter["n"] == 3     # legacy channel still renews
+    kube.get(LEASES, lease_name("dom", "n0"), NS)   # lease channel too
+
+
+def test_dual_mode_lease_failure_still_beats_status(monkeypatch):
+    """A broken lease channel (RBAC gap — the cluster dual mode
+    bridges) must not abort the beat NOR report it skipped: the status
+    stamp the legacy controller reads still runs, and heartbeat_once
+    returns cleanly.  In lease mode the same failure IS the whole beat
+    and propagates (the loop/fleetsim count it as skipped)."""
+    kube = FakeKube()
+    make_domain(kube, num_nodes=1, spares=0)
+
+    def broken_lease():
+        raise RuntimeError("rbac: leases.coordination.k8s.io forbidden")
+
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=9999,
+                          heartbeat_mode="dual")
+    m.update_own_node_info()
+    monkeypatch.setattr(m, "renew_lease", broken_lease)
+    counter = count_status_writes(kube)
+    m.heartbeat_once()          # no raise: the status channel renewed
+    assert counter["n"] == 1
+
+    m2 = MembershipManager(kube, "dom", NS, "n1", "10.0.0.11",
+                           "slice-uuid.1", 1, heartbeat_interval=9999,
+                           heartbeat_mode="lease")
+    monkeypatch.setattr(m2, "renew_lease", broken_lease)
+    with pytest.raises(RuntimeError):
+        m2.heartbeat_once()     # lease mode: the beat really skipped
+
+
+def test_status_mode_skips_lease_entirely():
+    from tpu_dra.k8s.client import LEASES, NotFound as NF
+
+    kube = FakeKube()
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=9999,
+                          heartbeat_mode="status")
+    m.update_own_node_info()
+    m.heartbeat_once()
+    with pytest.raises(NF):
+        kube.get(LEASES, lease_name("dom", "n0"), NS)
+
+
+def test_bad_heartbeat_mode_rejected():
+    with pytest.raises(ValueError):
+        MembershipManager(FakeKube(), "dom", NS, "n0", "ip", "f", 0,
+                          heartbeat_mode="carrier-pigeon")
+
+
+def test_skewed_clocks_no_false_expiry_e2e():
+    """Nodes with wall clocks skewed beyond the lease duration renew
+    happily: the controller ages leases on ITS observation clock, so
+    skew can never produce a false Lost (the fleetsim runs this at
+    1000 nodes; this is the deterministic 2-node core version)."""
+    kube = FakeKube()
+    ctrl = Controller(ControllerConfig(kube=kube, gc_period=3600,
+                                       lease_duration=1.0,
+                                       sweep_period=0.1))
+    ctrl.start()
+    managers = []
+    try:
+        make_domain(kube, num_nodes=2, spares=0)
+        for i, skew in enumerate((-5.0, 5.0)):   # 5x the lease duration
+            m = MembershipManager(
+                kube, "dom", NS, f"n{i}", f"10.0.0.1{i}",
+                "slice-uuid.0", i, heartbeat_interval=0.05,
+                now_fn=(lambda s=skew: time.time() + s))
+            m.start()
+            managers.append(m)
+        assert wait_until(lambda: len(node_states(kube)) == 2, timeout=8)
+        time.sleep(2.5)          # several full lease durations
+        reasons = [e["reason"] for e in kube.list(EVENTS)["items"]]
+        assert "NodeLost" not in reasons
+        assert NODE_STATE_LOST not in node_states(kube).values()
+    finally:
+        for m in managers:
+            m.stop()
+        ctrl.stop()
+        kube.close_watchers()
+
+
+def test_controller_sweep_failpoint_delays_expiry_no_crash(controller):
+    """controller.lease.sweep=error: ticks skip (the documented
+    degradation — Lost is DELAYED, the sweep thread survives), expiry
+    resumes on disarm."""
+    from tpu_dra.resilience import failpoint
+
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=0.05)
+    failpoint.activate("controller.lease.sweep=error")
+    try:
+        m.start()
+        assert wait_until(lambda: "n0" in node_states(kube), timeout=8)
+        m.stop()                 # daemon dies; lease starts aging
+        time.sleep(1.2)          # 3x the fixture's lease duration
+        assert node_states(kube).get("n0") != NODE_STATE_LOST
+        failpoint.deactivate("controller.lease.sweep")
+        failpoint.reset()
+        assert wait_until(lambda: node_states(kube).get("n0") ==
+                          NODE_STATE_LOST, timeout=8)
+    finally:
+        failpoint.release_all()
+        failpoint.reset()
+        m.stop()
+
+
+def test_daemon_renew_failpoint_skips_beats_no_crash(controller):
+    """daemon.lease.renew=error: renewals skip (lease ages toward
+    expiry -> Lost), the daemon never crashes, and disarming rejoins
+    through the standard Lost -> Spare path."""
+    from tpu_dra.resilience import failpoint
+
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=0.05)
+    m.start()
+    try:
+        # single-node gen-0 assembly stays legacy ("" state) until the
+        # first membership event — presence is the registration signal
+        assert wait_until(lambda: "n0" in node_states(kube), timeout=8)
+        failpoint.activate("daemon.lease.renew=error")
+        assert wait_until(lambda: node_states(kube).get("n0") ==
+                          NODE_STATE_LOST, timeout=8)
+        assert m._hb_thread.is_alive()   # degradation, not a crash
+        failpoint.deactivate("daemon.lease.renew")
+        failpoint.reset()
+        assert wait_until(lambda: node_states(kube).get("n0") ==
+                          NODE_STATE_ACTIVE, timeout=8)
+    finally:
+        failpoint.release_all()
+        failpoint.reset()
+        m.stop()
+
+
+def test_controller_gcs_lease_of_removed_node(controller):
+    """A Lost entry shrunk out of status takes its Lease with it —
+    the tracker and the API stay clean at fleet scale."""
+    from tpu_dra.k8s.client import LEASES, NotFound as NF
+
+    ctrl, kube = controller
+    make_domain(kube, num_nodes=1, spares=0)
+    m = MembershipManager(kube, "dom", NS, "n0", "10.0.0.10",
+                          "slice-uuid.0", 0, heartbeat_interval=0.05)
+    m.start()
+    assert wait_until(lambda: "n0" in node_states(kube), timeout=8)
+    m.stop()                     # dies for good
+    assert wait_until(lambda: "n0" not in node_states(kube), timeout=8)
+    assert wait_until(
+        lambda: _lease_gone(kube, LEASES, lease_name("dom", "n0")), timeout=8)
+
+
+def _lease_gone(kube, leases, name):
+    try:
+        kube.get(leases, name, NS)
+        return False
+    except Exception:  # noqa: BLE001 — NotFound means GC'd
+        return True

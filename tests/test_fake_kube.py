@@ -165,3 +165,81 @@ def test_watch_label_scoped():
     k.create(PODS, make_pod("hit", labels={"app": "x"}))
     t.join(timeout=5)
     assert events == ["hit"]
+
+
+# --- coordination.k8s.io/v1 Leases (per-node membership, ISSUE 11) ----------
+
+
+def make_lease(name="l0", ns="team", renew="2026-08-03T10:00:00.000000Z"):
+    return {"apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"holderIdentity": name, "renewTime": renew}}
+
+
+def test_lease_crud_and_conflict_enforcement():
+    """Lease updates without a resourceVersion are rejected outright:
+    optimistic concurrency is the POINT of a renewal, so every writer is
+    forced through the GET->mutate->PUT retry policy (the enforcement
+    update_status gained in PR 2, applied to Leases)."""
+    from tpu_dra.k8s import LEASES
+    from tpu_dra.k8s.fake import ApiErrorInvalid
+
+    k = FakeKube()
+    created = k.create(LEASES, make_lease())
+    assert created["metadata"]["resourceVersion"]
+
+    blind = make_lease(renew="2026-08-03T10:00:05.000000Z")
+    with pytest.raises(ApiErrorInvalid):
+        k.update(LEASES, blind, "team")
+
+    fresh = k.get(LEASES, "l0", "team")
+    fresh["spec"]["renewTime"] = "2026-08-03T10:00:05.000000Z"
+    k.update(LEASES, fresh, "team")
+
+    # a second writer holding the stale fetch loses with Conflict
+    fresh["spec"]["renewTime"] = "2026-08-03T10:00:06.000000Z"
+    with pytest.raises(Conflict):
+        k.update(LEASES, fresh, "team")
+
+
+def test_lease_rejects_malformed_microtime():
+    """A malformed renewTime would silently disable expiry — the fake
+    rejects it server-side like the real API's MicroTime schema."""
+    from tpu_dra.k8s import LEASES
+    from tpu_dra.k8s.fake import ApiErrorInvalid
+
+    k = FakeKube()
+    with pytest.raises(ApiErrorInvalid):
+        k.create(LEASES, make_lease(renew="not-a-time"))
+    k.create(LEASES, make_lease())
+    fresh = k.get(LEASES, "l0", "team")
+    fresh["spec"]["acquireTime"] = "yesterday-ish"
+    with pytest.raises(ApiErrorInvalid):
+        k.update(LEASES, fresh, "team")
+
+
+def test_lease_list_and_watch_by_label():
+    from tpu_dra.k8s import LEASES
+    from tpu_dra.k8s.leases import (
+        MEMBERSHIP_LEASE_LABEL, MEMBERSHIP_LEASE_VALUE, build_lease)
+
+    k = FakeKube()
+    k.create(LEASES, build_lease("dom", "team", "n0", 10.0, now=1000.0))
+    k.create(LEASES, make_lease("foreign"))
+    sel = {MEMBERSHIP_LEASE_LABEL: MEMBERSHIP_LEASE_VALUE}
+    items = k.list(LEASES, namespace="team", label_selector=sel)["items"]
+    assert [o["spec"]["holderIdentity"] for o in items] == ["n0"]
+
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for ev, obj in k.watch(LEASES, label_selector=sel, stop=stop):
+            seen.append((ev, obj["spec"]["holderIdentity"]))
+            stop.set()
+
+    t = threading.Thread(target=consume)
+    t.start()
+    k.create(LEASES, build_lease("dom", "team", "n1", 10.0, now=1000.0))
+    t.join(timeout=5)
+    assert seen == [("ADDED", "n1")]

@@ -1,0 +1,129 @@
+"""Fleet-scale membership simulator (hack/fleetsim.py).
+
+Two tiers, per the house pattern:
+
+- fast ``core`` tests cover the harness's own logic (request counting,
+  blackout injection, quantile math, the ``--full`` acceptance preset)
+  so a broken simulator can't silently "pass" the smoke lane;
+- the ``slow``-marked sweeps actually run it: the ~200-node smoke
+  (the ``make drive-fleetsim`` CI lane is the same invocation) and the
+  full 1000-node acceptance run (`--full`: ±5 s skew, 8 s leases, API
+  blackout + 5% simultaneous crash + wedged renewals + armed
+  failpoints) — excluded from tier-1 (``-m 'not slow'``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "hack"))
+
+import fleetsim  # noqa: E402
+
+from tpu_dra.k8s.client import TPU_SLICE_DOMAINS, Transient  # noqa: E402
+from tpu_dra.k8s.fake import FakeKube  # noqa: E402
+
+
+@pytest.mark.core
+def test_counting_kube_counts_and_blackout():
+    kube = fleetsim.CountingKube(FakeKube())
+    kube.create(TPU_SLICE_DOMAINS, {
+        "metadata": {"name": "d", "namespace": "ns"}, "spec": {}})
+    kube.get(TPU_SLICE_DOMAINS, "d", "ns")
+    kube.get(TPU_SLICE_DOMAINS, "d", "ns")
+    snap = kube.snapshot()
+    assert snap[(TPU_SLICE_DOMAINS.plural, "create")] == 1
+    assert snap[(TPU_SLICE_DOMAINS.plural, "get")] == 2
+
+    kube.blackout.set()
+    with pytest.raises(Transient):
+        kube.get(TPU_SLICE_DOMAINS, "d", "ns")
+    # failed attempts are still counted: they are real apiserver traffic
+    assert kube.snapshot()[(TPU_SLICE_DOMAINS.plural, "get")] == 3
+    kube.blackout.clear()
+    kube.get(TPU_SLICE_DOMAINS, "d", "ns")
+
+
+@pytest.mark.core
+def test_hist_quantiles_delta():
+    buckets = [0.1, 0.5, 1.0]
+    before = {(): {"cumulative": [2, 2, 2], "count": 2}}
+    after = {(): {"cumulative": [2, 10, 12], "count": 12}}
+    q = fleetsim.hist_quantiles(before, after, buckets)
+    assert q["count"] == 10
+    assert q["p50"] == 0.5
+    assert q["p99"] == 1.0
+    # empty delta -> no quantiles, not a crash
+    empty = fleetsim.hist_quantiles(before, before, buckets)
+    assert empty["count"] == 0 and empty["p50"] is None
+
+
+@pytest.mark.core
+def test_parse_args_full_preset():
+    cfg, phases, _ = fleetsim.parse_args(["--full"])
+    assert cfg.nodes == 1000
+    assert cfg.scale_points == (10, 100, 1000)
+    assert cfg.skew == 5.0
+    assert phases == ["baseline", "scale", "faults"]
+    cfg2, phases2, report = fleetsim.parse_args(
+        ["--nodes", "30", "--phases", "scale", "--report", "r.json",
+         "--scale-points", "10,30"])
+    assert cfg2.nodes == 30 and cfg2.scale_points == (10, 30)
+    assert phases2 == ["scale"] and report == "r.json"
+
+
+@pytest.mark.core
+def test_fleet_topology_construction():
+    cfg = fleetsim.Config(nodes=30, domain_size=8, spares=2)
+    fleet = fleetsim.Fleet(cfg)
+    assert fleet.n_domains == 3
+    assert len(fleet.nodes) == 30
+    # every node's manager renews in lease mode with its own skewed clock
+    skews = {n.skew for n in fleet.nodes}
+    assert len(skews) > 1
+    assert all(abs(s) <= cfg.skew for s in skews)
+
+
+def _run(args, timeout):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "fleetsim.py"),
+         *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO)
+
+
+@pytest.mark.slow
+def test_fleetsim_smoke_200_nodes(tmp_path):
+    """The `make drive-fleetsim` smoke lane, suite-runnable: default
+    config (~200 nodes), all three phases, bounded wall time."""
+    report = tmp_path / "fleetsim.json"
+    proc = _run(["--report", str(report)], timeout=560)
+    assert proc.returncode == 0, \
+        proc.stdout[-4000:] + proc.stderr[-4000:]
+    data = json.loads(report.read_text())
+    assert data["ok"]
+    assert data["scale"]["rates"], data["scale"]
+    assert data["faults"]["crash"]["rejoined"] > 0
+
+
+@pytest.mark.slow
+def test_fleetsim_full_1000_nodes(tmp_path):
+    """The acceptance sweep (ISSUE 11): 1000 nodes, scale points
+    10/100/1000 with flat per-domain writes, ±5 s clock skew, API
+    blackout, 5% simultaneous crash, wedged renewals, armed
+    `daemon.lease.renew`/`controller.lease.sweep` failpoints — zero
+    false-positive Lost, bounded workqueue depth, every faulted node
+    recovering through Lost -> promote -> rejoin."""
+    report = tmp_path / "fleetsim_full.json"
+    proc = _run(["--full", "--report", str(report)], timeout=1500)
+    assert proc.returncode == 0, \
+        proc.stdout[-4000:] + proc.stderr[-4000:]
+    data = json.loads(report.read_text())
+    assert data["ok"], [c for c in data["checks"] if not c["ok"]]
+    # the headline acceptance numbers, asserted from the artifact
+    rates = data["scale"]["rates"]
+    assert max(rates) <= 0.5 and max(rates) - min(rates) <= 0.5, rates
+    assert not data["scale"]["nodes1000"]["false_lost"]

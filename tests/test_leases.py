@@ -1,0 +1,180 @@
+"""Per-node membership Leases (tpu_dra/k8s/leases.py): naming, MicroTime,
+and the observation-based LeaseTracker's clock-skew semantics — the
+controller must age leases on ITS clock, so a renewer's skewed wall
+clock can neither expire it early nor keep it alive forever."""
+
+import pytest
+
+from tpu_dra.k8s.leases import (
+    DOMAIN_NAME_LABEL,
+    LeaseTracker,
+    MEMBERSHIP_LEASE_LABEL,
+    MEMBERSHIP_LEASE_VALUE,
+    NODE_NAME_LABEL,
+    build_lease,
+    lease_identity,
+    lease_name,
+    micro_time,
+    parse_micro_time,
+)
+
+pytestmark = pytest.mark.core
+
+
+class Clock:
+    """Deterministic injectable monotonic + wall pair."""
+
+    def __init__(self, mono=1000.0, wall=2000.0):
+        self.mono, self.wall = mono, wall
+
+    def tick(self, dt):
+        self.mono += dt
+        self.wall += dt
+
+
+def tracker(clock):
+    return LeaseTracker(monotonic=lambda: clock.mono,
+                        wall=lambda: clock.wall)
+
+
+def lease(node="n0", domain="dom", ns="team", renew_at=None):
+    obj = build_lease(domain, ns, node, renew_interval=10.0,
+                      now=renew_at)
+    return obj
+
+
+# --- naming / wire shape ----------------------------------------------------
+
+
+def test_lease_name_stable_and_bounded():
+    name = lease_name("dom", "node-1")
+    assert name.startswith("tpu-slice-dom-node-1-")
+    assert name == lease_name("dom", "node-1")
+    # the digest hashes the PAIR, not the joined string: hyphenated
+    # names would otherwise collide across domain/node boundaries
+    assert lease_name("a", "b-c") != lease_name("a-b", "c")
+    long = lease_name("d" * 200, "n" * 200)
+    assert len(long) <= 253
+    # deterministic, collision-resistant truncation
+    assert long == lease_name("d" * 200, "n" * 200)
+    assert long != lease_name("d" * 200, "n" * 199 + "x")
+
+
+def test_micro_time_roundtrip():
+    ts = 1754200000.123456
+    stamp = micro_time(ts)
+    assert stamp.endswith("Z") and "." in stamp
+    back = parse_micro_time(stamp)
+    assert back is not None and abs(back - ts) < 1e-5
+    assert parse_micro_time("") is None
+    assert parse_micro_time("garbage") is None
+
+
+def test_build_lease_labels_and_identity():
+    obj = build_lease("dom", "team", "n3", renew_interval=5.0, now=123.0)
+    labels = obj["metadata"]["labels"]
+    assert labels[MEMBERSHIP_LEASE_LABEL] == MEMBERSHIP_LEASE_VALUE
+    assert labels[DOMAIN_NAME_LABEL] == "dom"
+    assert labels[NODE_NAME_LABEL] == "n3"
+    assert obj["spec"]["holderIdentity"] == "n3"
+    assert obj["spec"]["leaseDurationSeconds"] == 15
+    assert lease_identity(obj) == ("team", "dom", "n3")
+    # foreign Lease without our labels → not ours
+    assert lease_identity({"metadata": {"name": "x"}}) is None
+
+
+# --- LeaseTracker: observation-based aging ----------------------------------
+
+
+def test_observed_renewal_ages_on_controller_clock():
+    clock = Clock()
+    t = tracker(clock)
+    t.observe(lease(renew_at=clock.wall))
+    clock.tick(4.0)
+    # renewal stamped by a daemon whose wall clock is 5s SLOW: the stamp
+    # moved, so age restarts on OUR clock — the skew is irrelevant
+    t.observe(lease(renew_at=clock.wall - 5.0))
+    clock.tick(2.0)
+    assert t.ages("team", "dom")["n0"] == pytest.approx(2.0)
+
+
+def test_relist_echo_does_not_reset_age():
+    clock = Clock()
+    t = tracker(clock)
+    obj = lease(renew_at=clock.wall)
+    t.observe(obj)
+    clock.tick(7.0)
+    t.observe(obj)   # same renewTime: an informer relist, not a renewal
+    assert t.ages("team", "dom")["n0"] == pytest.approx(7.0)
+
+
+def test_first_sight_seeds_from_stamp_clamped():
+    clock = Clock()
+    t = tracker(clock)
+    # controller restart: first sight of a lease last renewed 30s ago
+    t.observe(lease(node="stale", renew_at=clock.wall - 30.0))
+    # ... and of one stamped by a FAST clock (5s in the future): clamp
+    # to age 0 — a fast clock must not make a dead node look immortal
+    # (negative age would take that long to reach expiry)
+    t.observe(lease(node="fast", renew_at=clock.wall + 5.0))
+    ages = t.ages("team", "dom")
+    assert ages["stale"] == pytest.approx(30.0)
+    assert ages["fast"] == pytest.approx(0.0)
+
+
+def test_first_sight_bounded_by_creation_timestamp():
+    """A lease freshly CREATED by a slow-clock daemon carries a
+    renewTime minutes in the past; the server-assigned
+    creationTimestamp bounds the seeded age, so the node cannot be
+    falsely expired before its first observed renewal."""
+    clock = Clock()
+    t = tracker(clock)
+    obj = lease(renew_at=clock.wall - 300.0)   # 5-minute-slow clock
+    obj["metadata"]["creationTimestamp"] = micro_time(clock.wall - 1.0)
+    t.observe(obj)
+    assert t.ages("team", "dom")["n0"] == pytest.approx(1.0)
+    # controller restart over a genuinely OLD lease: creation long ago,
+    # renewTime recent -> the renew stamp dominates
+    t2 = tracker(clock)
+    old = lease(node="old", renew_at=clock.wall - 12.0)
+    old["metadata"]["creationTimestamp"] = micro_time(clock.wall - 9000)
+    t2.observe(old)
+    assert t2.ages("team", "dom")["old"] == pytest.approx(12.0)
+
+
+def test_forget_and_tracked():
+    clock = Clock()
+    t = tracker(clock)
+    t.observe(lease(node="a"))
+    t.observe(lease(node="b"))
+    assert t.tracked() == 2
+    t.forget(lease(node="a"))
+    assert t.tracked() == 1
+    assert set(t.ages("team", "dom")) == {"b"}
+
+
+def test_rebase_restarts_every_age():
+    """The blackout-recovery contract: ages measured across an
+    observation gap are artifacts; rebase gives the whole fleet one
+    fresh lease_duration to renew (expiry delayed, never wrong)."""
+    clock = Clock()
+    t = tracker(clock)
+    t.observe(lease(node="a", renew_at=clock.wall))
+    t.observe(lease(node="b", domain="dom2", renew_at=clock.wall))
+    clock.tick(60.0)   # the blackout: nobody could renew
+    assert t.ages("team", "dom")["a"] == pytest.approx(60.0)
+    assert t.rebase() == 2
+    assert t.ages("team", "dom")["a"] == pytest.approx(0.0)
+    assert t.ages("team", "dom2")["b"] == pytest.approx(0.0)
+    # a dead node's age grows again from the rebase point
+    clock.tick(10.0)
+    assert t.ages("team", "dom")["a"] == pytest.approx(10.0)
+
+
+def test_ages_scoped_per_domain():
+    clock = Clock()
+    t = tracker(clock)
+    t.observe(lease(node="a", domain="dom1"))
+    t.observe(lease(node="a", domain="dom2"))
+    assert set(t.ages("team", "dom1")) == {"a"}
+    assert t.ages("team", "nosuch") == {}

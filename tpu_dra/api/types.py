@@ -38,11 +38,12 @@ NODE_STATE_SPARE = "Spare"
 NODE_STATE_LOST = "Lost"
 
 
-def now_rfc3339() -> str:
+def now_rfc3339(t: Optional[float] = None) -> str:
     """UTC RFC3339 with millisecond precision — membership leases can be
     sub-second in tests/drives, so the whole-second k8s condition format
-    is too coarse for ``lastHeartbeatTime``."""
-    t = time.time()
+    is too coarse for ``lastHeartbeatTime``.  ``t`` overrides the wall
+    clock (clock-skew injection in the fleet simulator)."""
+    t = time.time() if t is None else t
     return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + \
         f".{int((t % 1) * 1000):03d}Z"
 

@@ -25,15 +25,18 @@ from tpu_dra.controller.constants import FINALIZER
 from tpu_dra.controller.daemonset import DaemonSetManager
 from tpu_dra.controller.node import NodeManager
 from tpu_dra.controller.resourceclaimtemplate import WorkloadRCTManager
-from tpu_dra.k8s.client import Conflict, KubeClient, NotFound, \
+from tpu_dra.k8s.client import Conflict, KubeClient, LEASES, NotFound, \
     TPU_SLICE_DOMAINS
 from tpu_dra.k8s.events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, \
     emit_event
 from tpu_dra.k8s.informer import Informer, uid_index
+from tpu_dra.k8s.leases import DOMAIN_NAME_LABEL, LeaseTracker, \
+    MEMBERSHIP_LEASE_LABEL, MEMBERSHIP_LEASE_VALUE, lease_name
 from tpu_dra.resilience import failpoint, retry
 from tpu_dra.trace import get_tracer, propagation, start_span
 from tpu_dra.trace.span import current_traceparent
 from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
 from tpu_dra.util.workqueue import WorkQueue
 
 _FP_RECONCILE = failpoint.register(
@@ -48,6 +51,32 @@ _FP_PROMOTE = failpoint.register(
     "controller.membership.promote",
     "armed when an arbitration is about to promote a spare (sleep here "
     "widens the promotion race window against a rejoining lost node)")
+_FP_LEASE_SWEEP = failpoint.register(
+    "controller.lease.sweep",
+    "top of each lease staleness-sweep tick (error skips the tick, "
+    "stall freezes the sweep thread — either way Lost transitions are "
+    "DELAYED until the next healthy tick, never wrong, and the "
+    "controller never crashes)")
+
+
+def _membership_metrics() -> dict:
+    """Sweep observability (idempotent registry): how long one tick
+    takes at fleet scale, how many per-node Leases the tracker holds,
+    and how often expiry decisions were deliberately withheld (API
+    dark).  `hack/fleetsim.py` reads these to characterize 1000-node
+    behavior."""
+    return {
+        "sweep_seconds": DEFAULT_REGISTRY.histogram(
+            "tpu_dra_membership_sweep_seconds",
+            "wall time of one membership staleness-sweep tick"),
+        "leases": DEFAULT_REGISTRY.gauge(
+            "tpu_dra_membership_leases_tracked",
+            "per-node membership Leases the controller sweep tracks"),
+        "holds": DEFAULT_REGISTRY.counter(
+            "tpu_dra_membership_sweep_holds_total",
+            "sweep ticks that withheld lease-expiry decisions",
+            labels=("reason",)),
+    }
 
 # a Lost node whose lease has been expired this many times over is
 # dropped from status.nodes entirely (the status shrink)
@@ -70,10 +99,48 @@ class MembershipPlan:
     promotions: list[str] = field(default_factory=list)
 
 
+def effective_age(node, now: float,
+                  lease_ages: Optional[dict[str, float]] = None
+                  ) -> Optional[float]:
+    """Seconds since the freshest liveness signal for ``node``: the
+    controller-observed age of its per-node Lease when one is tracked,
+    the legacy ``lastHeartbeatTime`` status stamp otherwise — and the
+    MINIMUM when both exist.  Min-freshness is the mixed-fleet compat
+    rule: a lease-mode daemon's status stamp goes stale by design
+    (written once at registration), and a dual-mode daemon renewing
+    either channel is alive; taking the freshest signal means a rollout
+    can never mass-expire half the fleet.  None = no signal ever
+    (legacy writer, exempt from expiry)."""
+    ages = []
+    hb = node.heartbeat_age(now)
+    if hb is not None:
+        ages.append(hb)
+    if lease_ages is not None and node.name in lease_ages:
+        ages.append(lease_ages[node.name])
+    return min(ages) if ages else None
+
+
 def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
-                    now: float, lease_duration: float
+                    now: float, lease_duration: float,
+                    lease_ages: Optional[dict[str, float]] = None,
+                    status_grace: bool = False
                     ) -> Optional[MembershipPlan]:
     """Arbitrate membership roles from leases + device health.
+
+    ``lease_ages`` maps node name → seconds since the controller last
+    OBSERVED that node's per-node Lease renew (``LeaseTracker``); nodes
+    absent from it fall back to the legacy status heartbeat via
+    :func:`effective_age`.
+
+    ``status_grace`` is the blackout-recovery analog of the tracker
+    rebase for the channel that CANNOT be rebased: a legacy/status-mode
+    node's age comes from its wall-clock ``lastHeartbeatTime`` stamp,
+    which froze during an API outage because nobody could write — not
+    because the node died.  While True, nodes whose only liveness
+    signal is the status stamp are exempt from NEW expiry (tracked
+    leases were rebased and keep expiring normally); the caller holds
+    the flag for one ``lease_duration`` after the API comes back, long
+    enough for every live daemon to re-stamp.
 
     Rules (docs/elastic-domains.md):
 
@@ -106,9 +173,12 @@ def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
     rejoined: set[str] = set()
 
     for n in nodes:
-        age = n.heartbeat_age(now)
+        age = effective_age(n, now, lease_ages)
+        status_only = lease_ages is None or n.name not in lease_ages
         if n.state != NODE_STATE_LOST:
             if age is not None and age > lease_duration:
+                if status_grace and status_only:
+                    continue   # outage artifact, not death: see docstring
                 states[n.name] = NODE_STATE_LOST
                 events.append((
                     "NodeLost",
@@ -123,6 +193,8 @@ def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
                 states[n.name] = NODE_STATE_SPARE
                 rejoined.add(n.name)
             elif age is None or age > lease_duration * LOST_REMOVAL_FACTOR:
+                if status_grace and status_only and age is not None:
+                    continue   # frozen stamp inflated the staleness too
                 removals.append(n.name)
 
     arbitrated = status.membership_generation > 0 or \
@@ -209,17 +281,45 @@ class SliceDomainManager:
         self.informer.add_event_handler(
             on_add=self._enqueue,
             on_update=lambda old, new: self._enqueue(new))
+        # per-node membership Leases (docs/elastic-domains.md): ONE
+        # shared informer over the marker label feeds an observation
+        # tracker; renewals never touch the CR status, so steady-state
+        # per-domain API writes are O(1) in member count.  Renewal
+        # events deliberately do NOT enqueue reconciles — expiry has no
+        # watch event anyway (a dead daemon writes nothing), so the
+        # periodic sweep owns all lease-driven arbitration.
+        self.lease_tracker = LeaseTracker()
+        self.lease_informer = Informer(
+            kube, LEASES,
+            label_selector={MEMBERSHIP_LEASE_LABEL: MEMBERSHIP_LEASE_VALUE})
+        self.lease_informer.add_event_handler(
+            on_add=self.lease_tracker.observe,
+            on_update=lambda old, new: self.lease_tracker.observe(new),
+            on_delete=self.lease_tracker.forget)
         self.ds_manager = DaemonSetManager(
             kube, driver_namespace, image_name, self.get_by_uid)
         self.workload_rct = WorkloadRCTManager(kube, driver_namespace)
         self.node_manager = NodeManager(kube)
+        self._metrics = _membership_metrics()
+        # True after a sweep tick saw the API dark (breaker open): the
+        # tracker could not have observed renewals through the outage,
+        # so the first light tick rebases ages before any expiry runs.
+        # Written by the sweep thread, read by reconcile workers; a
+        # race costs at worst one extra (idempotent) rebase.
+        self._was_dark = False
+        # wall-clock deadline until which status-stamp-only expiry is
+        # withheld after a blackout (the un-rebasable channel's grace;
+        # see membership_plan's status_grace)
+        self._status_grace_until = 0.0
         self._sweep_stop = threading.Event()
         self._sweep_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.informer.start()
+        self.lease_informer.start()
         self.informer.wait_for_sync()
+        self.lease_informer.wait_for_sync()
         self.ds_manager.start()
         if self.sweep_period > 0:
             self._sweep_thread = threading.Thread(
@@ -232,31 +332,86 @@ class SliceDomainManager:
         if self._sweep_thread is not None:
             self._sweep_thread.join(timeout=5)
         self.ds_manager.stop()
+        self.lease_informer.stop()
         self.informer.stop()
+
+    # -- lease plumbing (elastic domains at fleet scale) -------------------
+    def _api_dark(self) -> bool:
+        """True while the kube client's circuit breaker (when wrapped by
+        ``ResilientKubeClient``) says the API server is unreachable."""
+        breaker = getattr(self.kube, "breaker", None)
+        return breaker is not None and breaker.is_open()
+
+    def _blackout_guard(self) -> bool:
+        """Returns True while expiry decisions must be withheld.
+
+        During an API blackout NOBODY can renew — observed lease ages
+        grow fleet-wide as a monitoring artifact, and acting on them at
+        recovery would mass-expire healthy nodes.  While the breaker is
+        open this holds all expiry (the arbitration couldn't commit its
+        writes anyway); on the first call after the dark period it
+        rebases every tracked age, giving the fleet one fresh
+        ``lease_duration`` to renew.  A truly-dead node expires one
+        lease later: DELAYED, never wrong."""
+        if self._api_dark():
+            self._was_dark = True
+            return True
+        if self._was_dark:
+            self._was_dark = False
+            rebased = self.lease_tracker.rebase()
+            # the status-heartbeat channel has no tracker to rebase:
+            # its wall-clock stamps froze with the API — hold expiry on
+            # that channel for one lease so live daemons can re-stamp
+            self._status_grace_until = time.time() + self.lease_duration
+            self._metrics["holds"].inc("rebase")
+            klog.warning("API blackout ended; lease ages rebased",
+                         leases=rebased)
+        return False
+
+    def _in_status_grace(self) -> bool:
+        return time.time() < self._status_grace_until
+
+    def _lease_ages(self, namespace: str, name: str) -> dict[str, float]:
+        return self.lease_tracker.ages(namespace, name)
 
     def _sweep_loop(self) -> None:
         """Staleness sweep (elastic domains): lease expiry has no watch
         event — a dead daemon writes nothing — so every period each
         domain whose membership NEEDS arbitration is re-enqueued through
-        the normal reconcile path.  The informer-copy plan probe keeps a
-        steady-state sweep free of API traffic (no reconcile, no GETs);
-        the workqueue serializes sweeps with watch-triggered reconciles
-        per uid."""
+        the normal reconcile path.  The informer-copy plan probe (fed
+        lease ages from the tracker) keeps a steady-state sweep free of
+        API traffic (no reconcile, no GETs); the workqueue serializes
+        sweeps with watch-triggered reconciles per uid."""
         while not self._sweep_stop.wait(self.sweep_period):
+            t0 = time.monotonic()
             try:
+                failpoint.hit("controller.lease.sweep")
+                if self._blackout_guard():
+                    self._metrics["holds"].inc("api-dark")
+                    klog.info("membership sweep held: API dark", level=2)
+                    continue
                 now = time.time()
                 for obj in self.informer.store.list():
                     domain = TpuSliceDomain.from_dict(obj)
                     if domain.deleting or domain.status is None:
                         continue
-                    if membership_plan(domain.status, domain.spec, now,
-                                       self.lease_duration) is not None:
+                    ages = self._lease_ages(domain.namespace, domain.name)
+                    if membership_plan(
+                            domain.status, domain.spec, now,
+                            self.lease_duration, lease_ages=ages,
+                            status_grace=self._in_status_grace()
+                            ) is not None:
                         self._enqueue(obj)
+                self._metrics["leases"].set(self.lease_tracker.tracked())
             except Exception as exc:  # noqa: BLE001 — loop must survive
-                # (malformed object, queue shutting down mid-tick): a
-                # dead sweep thread would silently disable lease expiry
+                # (malformed object, queue shutting down mid-tick, an
+                # armed controller.lease.sweep failpoint): a dead sweep
+                # thread would silently disable lease expiry
                 klog.warning("membership sweep tick failed",
                              err=repr(exc))
+            finally:
+                self._metrics["sweep_seconds"].observe(
+                    time.monotonic() - t0)
 
     # -- lookups -----------------------------------------------------------
     def get_by_uid(self, uid: str) -> Optional[TpuSliceDomain]:
@@ -446,9 +601,16 @@ class SliceDomainManager:
         on the latest status and retry on Conflict")."""
         if domain.status is None or domain.deleting:
             return None
+        # a blackout (or its not-yet-rebased aftermath) must hold expiry
+        # on THIS path too: a watch-triggered reconcile racing the sweep's
+        # rebase would otherwise act on artifact ages
+        if self._blackout_guard():
+            return None
+        ages = self._lease_ages(domain.namespace, domain.name)
         # cheap no-op probe on the informer copy before any API traffic
         if membership_plan(domain.status, domain.spec, time.time(),
-                           self.lease_duration) is None:
+                           self.lease_duration, lease_ages=ages,
+                           status_grace=self._in_status_grace()) is None:
             return None
         applied: dict = {}
 
@@ -459,8 +621,11 @@ class SliceDomainManager:
                 TPU_SLICE_DOMAINS, domain.name, domain.namespace))
             if fresh.status is None or fresh.deleting:
                 return
-            plan = membership_plan(fresh.status, fresh.spec, time.time(),
-                                   self.lease_duration)
+            plan = membership_plan(
+                fresh.status, fresh.spec, time.time(),
+                self.lease_duration,
+                lease_ages=self._lease_ages(domain.namespace, domain.name),
+                status_grace=self._in_status_grace())
             if plan is None:
                 return
             if plan.promotions:
@@ -493,6 +658,20 @@ class SliceDomainManager:
             span.set_attribute("generation",
                                fresh.status.membership_generation)
             span.set_attribute("active", ",".join(plan.active))
+            # GC the removed nodes' Leases with their status entries —
+            # best-effort: a failed delete leaves a stale tracked lease
+            # that keeps aging harmlessly, and a rejoining daemon
+            # recreates its Lease on the next renewal either way
+            for name in plan.removals:
+                try:
+                    self.kube.delete(LEASES,
+                                     lease_name(domain.name, name),
+                                     domain.namespace)
+                except NotFound:
+                    pass
+                except Exception as exc:  # noqa: BLE001 — see above
+                    klog.warning("membership lease GC failed",
+                                 node=name, err=repr(exc))
             for reason, message, etype in plan.events:
                 emit_event(self.kube, fresh.to_dict(), reason, message,
                            etype)
@@ -511,6 +690,7 @@ class SliceDomainManager:
         self.workload_rct.delete(domain)
         self.ds_manager.delete(domain)
         self.node_manager.remove_domain_labels(domain.uid)
+        self._delete_domain_leases(domain)
         self.workload_rct.remove_finalizer(domain)
         self.workload_rct.assert_removed(domain)
         self.ds_manager.rct.remove_finalizer(domain)
@@ -520,6 +700,22 @@ class SliceDomainManager:
         self._remove_domain_finalizer(domain)
         klog.info("slice domain torn down", domain=domain.name,
                   uid=domain.uid)
+
+    def _delete_domain_leases(self, domain: TpuSliceDomain) -> None:
+        """Drop every per-node membership Lease the domain owns.  Raises
+        on transient API failure → workqueue retries the teardown (the
+        strict-order contract); a concurrently-renewing daemon recreating
+        one is harmless — the next teardown retry sweeps it again."""
+        selector = {MEMBERSHIP_LEASE_LABEL: MEMBERSHIP_LEASE_VALUE,
+                    DOMAIN_NAME_LABEL: domain.name}
+        listing = self.kube.list(LEASES, namespace=domain.namespace,
+                                 label_selector=selector)
+        for obj in listing.get("items", []):
+            try:
+                self.kube.delete(LEASES, obj["metadata"]["name"],
+                                 domain.namespace)
+            except NotFound:
+                pass
 
     def _remove_domain_finalizer(self, domain: TpuSliceDomain) -> None:
         try:

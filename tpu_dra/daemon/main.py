@@ -238,7 +238,10 @@ def run(argv=None) -> int:
         kube, domain_name, domain_namespace, node_name, pod_ip,
         fabric, tpulib.worker_id(),
         heartbeat_interval=float(
-            env.get("MEMBERSHIP_HEARTBEAT_INTERVAL", "10")))
+            env.get("MEMBERSHIP_HEARTBEAT_INTERVAL", "10")),
+        # lease (default) | status (pre-Lease fleets) | dual (rollout
+        # bridge while the controller still sweeps status heartbeats)
+        heartbeat_mode=env.get("MEMBERSHIP_HEARTBEAT_MODE", "lease"))
     coordservice = ProcessManager(
         argv_fn=lambda: coordservice_argv(settings_dir, port),
         name="coordservice")

@@ -6,22 +6,32 @@ each daemon pod writes ``{nodeName, podIP, fabricID, workerID}`` into
 ACTIVE membership assembles **and** it changed, the full node list is
 pushed to a channel consumed by the coordination update loop.
 
-Elastic domains (docs/elastic-domains.md) extend the record into a lease:
-every publish stamps ``lastHeartbeatTime`` and a background heartbeat
-loop republishes it each interval, so the controller can expire a
-preempted node instead of waiting forever.  The controller arbitrates
-membership roles (``state``: Active/Spare/Lost) and bumps
-``status.membershipGeneration`` on every reconfiguration; this manager
-preserves the controller-owned ``state`` verbatim when republishing its
-own entry, and fences its rendezvous pushes on the generation.
+Elastic domains (docs/elastic-domains.md) make membership lease-based:
+each daemon renews its own ``coordination.k8s.io/v1`` Lease (labeled with
+domain + node) every interval, so the controller can expire a preempted
+node instead of waiting forever — and renewals cost O(1) API writes per
+node regardless of domain size, because they never touch the shared CR
+status.  Node identity (name/IP/fabric/health) still lives in
+``status.nodes`` but is written once at registration and on change, not
+per heartbeat.  The controller arbitrates membership roles (``state``:
+Active/Spare/Lost) and bumps ``status.membershipGeneration`` on every
+reconfiguration; this manager preserves the controller-owned ``state``
+verbatim when republishing its own entry, and fences its rendezvous
+pushes on the generation.
+
+``heartbeat_mode`` selects the renewal channel for mixed-version
+rollouts: ``lease`` (default), ``status`` (the pre-Lease contract —
+stamp ``lastHeartbeatTime`` into the shared status every interval), or
+``dual`` (both, for fleets whose controller predates the Lease sweep).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from tpu_dra.api.types import (
     NODE_STATE_SPARE,
@@ -30,8 +40,10 @@ from tpu_dra.api.types import (
     TpuSliceDomainStatus,
     now_rfc3339,
 )
-from tpu_dra.k8s.client import KubeClient, TPU_SLICE_DOMAINS
+from tpu_dra.k8s.client import KubeClient, LEASES, NotFound, \
+    TPU_SLICE_DOMAINS
 from tpu_dra.k8s.informer import Informer
+from tpu_dra.k8s.leases import build_lease, lease_name, micro_time
 from tpu_dra.resilience import failpoint, retry
 from tpu_dra.util import klog
 
@@ -44,6 +56,16 @@ _FP_HEARTBEAT = failpoint.register(
     "top of each membership heartbeat tick (stall here wedges the lease "
     "renewal WITHOUT killing the daemon — the lease-expiry/rejoin race; "
     "error skips single beats; sleep delays them)")
+_FP_RENEW = failpoint.register(
+    "daemon.lease.renew",
+    "each per-node Lease write attempt (error skips renewals so the "
+    "lease ages toward expiry while the daemon stays alive; stall wedges "
+    "the renewal mid-write — both degrade to Lost + rejoin, never crash)")
+
+# heartbeat_mode values (MEMBERSHIP_HEARTBEAT_MODE in the daemon env)
+HEARTBEAT_MODE_LEASE = "lease"
+HEARTBEAT_MODE_STATUS = "status"
+HEARTBEAT_MODE_DUAL = "dual"
 
 # node-entry keys the daemon never compares when deciding whether a
 # republish is needed: the heartbeat is stamped fresh on every write (it
@@ -65,11 +87,33 @@ class MembershipManager:
     def __init__(self, kube: KubeClient, domain_name: str,
                  domain_namespace: str, node_name: str, pod_ip: str,
                  fabric_id: str, worker_id: int,
-                 heartbeat_interval: float = 10.0) -> None:
+                 heartbeat_interval: float = 10.0,
+                 heartbeat_mode: str = HEARTBEAT_MODE_LEASE,
+                 now_fn: Callable[[], float] = time.time,
+                 retry_policy: Optional[retry.RetryPolicy] = None) -> None:
+        if heartbeat_mode not in (HEARTBEAT_MODE_LEASE,
+                                  HEARTBEAT_MODE_STATUS,
+                                  HEARTBEAT_MODE_DUAL):
+            raise ValueError(f"bad heartbeat_mode {heartbeat_mode!r}")
         self.kube = kube
         self.domain_name = domain_name
         self.domain_namespace = domain_namespace
         self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_mode = heartbeat_mode
+        # injectable wall clock: the fleet simulator skews it per node to
+        # prove expiry decisions don't depend on renderer/sweeper clock
+        # agreement (the controller ages leases on ITS clock)
+        self._now = now_fn
+        # write-retry budget: production keeps the centralized status
+        # policy; the fleet simulator passes a short-fused one so a
+        # blacked-out renewal costs a skipped beat, not a 10s stall of
+        # the shared scheduler thread
+        self._retry_policy = retry_policy or retry.STATUS_WRITE_POLICY
+        self._lease_name = lease_name(domain_name, node_name)
+        # the object our last Lease write returned (fresh RV): renewals
+        # mutate it in place so steady state is one PUT, zero GETs.
+        # Only the heartbeat path touches it — no lock needed.
+        self._lease_cache: Optional[dict] = None
         self.self_node = TpuSliceDomainNode(
             name=node_name, ip_address=pod_ip, fabric_id=fabric_id,
             worker_id=worker_id)
@@ -91,7 +135,16 @@ class MembershipManager:
     def start(self) -> None:
         self.informer.start()
         self.informer.wait_for_sync()
+        # registration: identity/IP into status ONCE (O(1) in fleet size
+        # from here on — renewals ride the per-node Lease, not the CR)
         self.update_own_node_info()
+        if self.heartbeat_mode != HEARTBEAT_MODE_STATUS:
+            try:
+                self.renew_lease()
+            except Exception as exc:  # noqa: BLE001 — like a missed
+                # beat: the loop's next tick (re-)creates the lease
+                klog.warning("initial lease write failed; will retry",
+                             node=self.self_node.name, err=repr(exc))
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name="membership-heartbeat")
@@ -109,19 +162,93 @@ class MembershipManager:
         return self._updates
 
     # -- lease heartbeat (elastic domains) ---------------------------------
+    def heartbeat_once(self) -> None:
+        """One heartbeat tick: renew the per-node Lease (and/or stamp the
+        legacy status heartbeat, per ``heartbeat_mode``).  Factored out of
+        the loop so the fleet simulator can drive thousands of managers
+        from one scheduler thread through the REAL renewal path.
+
+        The channels are independent: in ``dual`` mode a broken lease
+        channel (RBAC gap, admission webhook — exactly the clusters dual
+        mode bridges) must not starve the status stamp the legacy
+        controller is reading, so the status write runs regardless — and
+        when it did, the beat was NOT skipped: the lease failure is
+        logged channel-accurately instead of raised.  In ``lease`` mode
+        a renewal failure IS the whole beat, so it propagates (the loop
+        and the fleet simulator count it as a skipped beat)."""
+        failpoint.hit("daemon.membership.heartbeat")
+        lease_err: Optional[Exception] = None
+        if self.heartbeat_mode != HEARTBEAT_MODE_STATUS:
+            try:
+                self.renew_lease()
+            except Exception as exc:  # noqa: BLE001 — see docstring
+                klog.info("lease renewal failed", level=4,
+                          node=self.self_node.name, err=repr(exc))
+                lease_err = exc
+        if self.heartbeat_mode != HEARTBEAT_MODE_LEASE:
+            self.update_own_node_info(force=True)
+            if lease_err is not None:
+                klog.warning(
+                    "lease channel failed; status heartbeat written",
+                    node=self.self_node.name, err=repr(lease_err))
+                return
+        if lease_err is not None:
+            raise lease_err
+
     def _heartbeat_loop(self) -> None:
-        """Republish our entry (fresh ``lastHeartbeatTime``) every
-        interval.  The stamp itself rides the existing status-write retry
-        path — no new writer, no new locks."""
         while not self._hb_stop.wait(self.heartbeat_interval):
             try:
-                failpoint.hit("daemon.membership.heartbeat")
-                self.update_own_node_info(force=True)
+                self.heartbeat_once()
             except Exception as exc:  # noqa: BLE001 — a failed beat is a
                 # missed lease renewal, never a daemon crash; the next
                 # tick (or an informer-triggered publish) renews it
                 klog.warning("membership heartbeat skipped",
                              node=self.self_node.name, err=repr(exc))
+
+    def renew_lease(self) -> None:
+        """Renew our own Lease (create on first renewal or after a
+        controller GC), on the centralized status-write retry policy +
+        breaker stack.  O(1): never touches the shared CR.
+
+        Steady state is ONE apiserver request per beat: the object
+        returned by the previous write (it carries the fresh
+        resourceVersion) is cached and mutated in place — the
+        kubelet node-lease pattern.  Conflict/NotFound drops the cache
+        so the retrying attempt re-fetches (or re-creates)."""
+        def attempt() -> None:
+            failpoint.hit("daemon.lease.renew")
+            obj = self._lease_cache
+            if obj is None:
+                try:
+                    obj = self.kube.get(LEASES, self._lease_name,
+                                        self.domain_namespace)
+                except NotFound:
+                    self._lease_cache = self.kube.create(
+                        LEASES,
+                        build_lease(self.domain_name,
+                                    self.domain_namespace,
+                                    self.self_node.name,
+                                    self.heartbeat_interval,
+                                    self._now()),
+                        self.domain_namespace)
+                    klog.info("membership lease created", level=4,
+                              lease=self._lease_name)
+                    return
+            spec = obj.setdefault("spec", {})
+            spec["holderIdentity"] = self.self_node.name
+            spec["renewTime"] = micro_time(self._now())
+            try:
+                self._lease_cache = self.kube.update(
+                    LEASES, obj, self.domain_namespace)
+            except Exception:
+                # stale RV (a writer we didn't see) or GC'd mid-flight:
+                # the retried attempt must re-fetch, not re-send
+                self._lease_cache = None
+                raise
+
+        retry.retry_call(attempt, policy=self._retry_policy,
+                         retryable=retry.retryable_or_conflict,
+                         op="membership.renew_lease")
 
     # -- node health reporting (tpu_dra/health fan-in, ISSUE 2) ------------
     def set_device_health(self, healthy: bool,
@@ -163,10 +290,13 @@ class MembershipManager:
         daemons) and transient API failures re-fetch and retry with
         jittered backoff until the policy's deadline.
 
-        Every write stamps a fresh ``lastHeartbeatTime`` (the membership
-        lease) and preserves the controller-owned ``state`` of our
-        existing entry.  ``force=True`` (the heartbeat loop) writes even
-        when nothing but the heartbeat changed."""
+        Every write stamps a fresh ``lastHeartbeatTime`` (the legacy
+        status heartbeat — controllers predating the Lease sweep still
+        read it) and preserves the controller-owned ``state`` of our
+        existing entry.  In ``lease`` mode this runs at registration and
+        on identity/health changes only; ``force=True`` (the heartbeat
+        loop, ``status``/``dual`` modes) writes even when nothing but
+        the heartbeat changed."""
         def attempt() -> None:
             failpoint.hit("daemon.membership.update")
             obj = self.kube.get(TPU_SLICE_DOMAINS, self.domain_name,
@@ -201,7 +331,7 @@ class MembershipManager:
                 fabric_id=cur.fabric_id, worker_id=cur.worker_id,
                 devices_healthy=cur.devices_healthy,
                 unhealthy_devices=list(cur.unhealthy_devices),
-                last_heartbeat=now_rfc3339(), state=state)
+                last_heartbeat=now_rfc3339(self._now()), state=state)
             if not force and mine is not None and \
                     self._stable_dict(mine) == self._stable_dict(publish):
                 return
@@ -215,7 +345,7 @@ class MembershipManager:
                       node=publish.name, ip=publish.ip_address)
 
         try:
-            retry.retry_call(attempt, policy=retry.STATUS_WRITE_POLICY,
+            retry.retry_call(attempt, policy=self._retry_policy,
                              retryable=retry.retryable_or_conflict,
                              op="membership.update_own_node_info")
         except Exception as exc:  # noqa: BLE001 — best-effort publish:

@@ -19,6 +19,7 @@ from tpu_dra.k8s.client import (  # noqa: F401
     DAEMONSETS,
     DEPLOYMENTS,
     EVENTS,
+    LEASES,
     NODES,
     PODS,
     RESOURCE_CLAIMS,

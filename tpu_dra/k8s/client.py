@@ -162,6 +162,10 @@ RESOURCE_CLAIM_TEMPLATES = ResourceDesc("resource.k8s.io", "v1beta1",
                                         "ResourceClaimTemplate")
 TPU_SLICE_DOMAINS = ResourceDesc("resource.tpu.google.com", "v1beta1",
                                  "tpuslicedomains", "TpuSliceDomain")
+# per-node membership leases (elastic domains, docs/elastic-domains.md):
+# renewals ride these dedicated objects instead of the shared CR status,
+# keeping per-domain status writes O(1) in member count
+LEASES = ResourceDesc("coordination.k8s.io", "v1", "leases", "Lease")
 
 
 def match_labels(labels: dict[str, str] | None,

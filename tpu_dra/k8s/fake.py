@@ -28,11 +28,41 @@ from typing import Iterator, Optional
 from tpu_dra.k8s.client import (
     Conflict,
     KubeClient,
+    LEASES,
     NotFound,
     ResourceDesc,
     TPU_SLICE_DOMAINS,
     match_labels,
 )
+
+
+def _is_res(res: ResourceDesc, desc: ResourceDesc) -> bool:
+    return res is desc or (res.group == desc.group and
+                           res.plural == desc.plural)
+
+
+def _validate_lease(obj: dict, *, require_rv: bool) -> None:
+    """First-class ``coordination.k8s.io/v1`` Lease semantics:
+
+    - ``spec.renewTime``/``spec.acquireTime``, when present, must parse
+      as MicroTime (a malformed stamp would silently disable expiry);
+    - updates must carry ``metadata.resourceVersion`` — optimistic
+      concurrency is the POINT of a lease renewal, so the fake rejects
+      blind writes outright, forcing every Lease writer in tests through
+      the GET→mutate→PUT retry policy (the same enforcement
+      ``update_status`` carries for the CR status subresource).
+    """
+    if require_rv and not obj.get("metadata", {}).get("resourceVersion"):
+        raise ApiErrorInvalid(
+            "Lease update without resourceVersion: renewals must "
+            "GET→mutate→PUT under the retry policy")
+    spec = obj.get("spec") or {}
+    from tpu_dra.api.types import parse_rfc3339
+    for field in ("renewTime", "acquireTime"):
+        stamp = spec.get(field)
+        if stamp and parse_rfc3339(str(stamp)) is None:
+            raise ApiErrorInvalid(
+                f"Lease spec.{field} {stamp!r} is not a MicroTime")
 
 
 def _merge_patch(target: dict, patch: dict) -> dict:
@@ -160,6 +190,8 @@ class FakeKube(KubeClient):
             if not meta.get("name") and meta.get("generateName"):
                 self._uid += 1
                 meta["name"] = f"{meta['generateName']}{self._uid:05x}"
+            if _is_res(res, LEASES):
+                _validate_lease(obj, require_rv=False)
             key = self._key(res, obj)
             if key in self._store(res):
                 raise Conflict(f"{res.plural} {key} already exists")
@@ -199,12 +231,12 @@ class FakeKube(KubeClient):
                 raise Conflict(
                     f"{res.plural} {key}: resourceVersion {sent_rv} != "
                     f"{old['metadata']['resourceVersion']}")
-            if res is TPU_SLICE_DOMAINS or (
-                    res.group == TPU_SLICE_DOMAINS.group and
-                    res.plural == TPU_SLICE_DOMAINS.plural):
+            if _is_res(res, TPU_SLICE_DOMAINS):
                 if old.get("spec") != obj.get("spec"):
                     raise ApiErrorInvalid(
                         "TpuSliceDomain spec is immutable")
+            if _is_res(res, LEASES):
+                _validate_lease(obj, require_rv=True)
             # update never touches status (subresource semantics)
             if "status" in old:
                 obj["status"] = copy.deepcopy(old["status"])

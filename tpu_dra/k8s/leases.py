@@ -1,0 +1,221 @@
+"""Per-node membership Leases (``coordination.k8s.io/v1``).
+
+The elastic-domain membership heartbeat (docs/elastic-domains.md) used to
+multiplex through the shared ``TpuSliceDomain.status`` subresource: every
+renewal was a GET→PUT on one object, so per-domain steady-state API writes
+grew O(members) and racing daemons paid conflict retries — the write
+amplification PR 7 measured the hard way (4 daemons flooded one controller
+queue to depth 1965).  This module moves renewals onto dedicated per-node
+Lease objects — the same escape hatch kubelet node heartbeats took — so the
+shared CR status carries only real membership changes and per-node renewal
+cost is O(1) regardless of domain size.
+
+Object contract:
+
+- one Lease per (domain, node), named :func:`lease_name`, in the domain's
+  namespace;
+- labels: :data:`MEMBERSHIP_LEASE_LABEL` = ``node-lease`` (the equality
+  selector one shared controller informer watches), :data:`DOMAIN_NAME_LABEL`
+  = the domain name, :data:`NODE_NAME_LABEL` = the node name;
+- ``spec.holderIdentity`` = node name, ``spec.renewTime`` = MicroTime of the
+  last renewal, ``spec.leaseDurationSeconds`` = the renewer's advertised
+  interval*3 (informational — the sweeper's ``--lease-duration-seconds`` is
+  authoritative, exactly as node-lifecycle-controller ignores the kubelet's
+  advertised duration).
+
+Clock-skew robustness (:class:`LeaseTracker`): expiry decisions are made on
+the CONTROLLER's clock, not the renewer's.  The tracker records
+``time.monotonic()`` whenever an informer event shows ``renewTime`` moved;
+a lease's age is "seconds since the controller last *observed* a renewal".
+A daemon with a skewed wall clock therefore cannot expire early or live
+forever — only watch latency (bounded, local) shifts the decision.  The
+stamped ``renewTime`` is consulted once per lease, at first sight (initial
+list / controller restart), as the starting age estimate — bounded by the
+server-assigned ``creationTimestamp`` (a fresh lease cannot be older than
+its own creation, whatever its renewer's clock says) and clamped to ≥ 0 so
+a fast clock cannot make a dead node immortal.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Optional
+
+from tpu_dra.k8s.client import LEASES  # noqa: F401  (re-export for callers)
+from tpu_dra.version import API_GROUP
+
+# equality selector for the one shared Lease informer: presence selectors
+# don't exist in our label matcher, so membership leases carry a fixed
+# marker value
+MEMBERSHIP_LEASE_LABEL = f"{API_GROUP}/membership"
+MEMBERSHIP_LEASE_VALUE = "node-lease"
+# which domain/node a lease renews — the tracker groups on these
+DOMAIN_NAME_LABEL = f"{API_GROUP}/domainName"
+NODE_NAME_LABEL = f"{API_GROUP}/node"
+
+_NAME_MAX = 253   # DNS subdomain limit on Lease names
+
+
+def lease_name(domain_name: str, node_name: str) -> str:
+    """Unique per (domain, node) within the domain's namespace.
+
+    The digest suffix hashes the NUL-separated pair, not the joined
+    string: both names may themselves contain hyphens, so a bare join
+    would collide (domain ``a`` / node ``b-c`` vs domain ``a-b`` /
+    node ``c``) and two daemons from different domains would fight
+    over — and the removal GC would delete — one shared Lease."""
+    digest = hashlib.sha256(
+        f"{domain_name}\x00{node_name}".encode()).hexdigest()[:8]
+    name = f"tpu-slice-{domain_name}-{node_name}-{digest}"
+    if len(name) <= _NAME_MAX:
+        return name
+    return f"{name[:_NAME_MAX - 9]}-{digest}"
+
+
+def micro_time(t: Optional[float] = None) -> str:
+    """k8s MicroTime: RFC3339 UTC with microsecond precision."""
+    t = time.time() if t is None else t
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + \
+        f".{int((t % 1) * 1e6):06d}Z"
+
+
+def parse_micro_time(stamp: str) -> Optional[float]:
+    """Epoch seconds, or None when empty/malformed (shares the RFC3339
+    grammar with status heartbeats)."""
+    from tpu_dra.api.types import parse_rfc3339
+    return parse_rfc3339(stamp)
+
+
+def build_lease(domain_name: str, domain_namespace: str, node_name: str,
+                renew_interval: float, now: Optional[float] = None) -> dict:
+    stamp = micro_time(now)
+    return {
+        "apiVersion": f"{LEASES.group}/{LEASES.version}",
+        "kind": LEASES.kind,
+        "metadata": {
+            "name": lease_name(domain_name, node_name),
+            "namespace": domain_namespace,
+            "labels": {
+                MEMBERSHIP_LEASE_LABEL: MEMBERSHIP_LEASE_VALUE,
+                DOMAIN_NAME_LABEL: domain_name,
+                NODE_NAME_LABEL: node_name,
+            },
+        },
+        "spec": {
+            "holderIdentity": node_name,
+            "leaseDurationSeconds": max(1, round(renew_interval * 3)),
+            "acquireTime": stamp,
+            "renewTime": stamp,
+        },
+    }
+
+
+def lease_identity(obj: dict) -> Optional[tuple[str, str, str]]:
+    """(namespace, domain, node) from a membership Lease's labels, or
+    None for foreign Leases that slipped past the selector."""
+    meta = obj.get("metadata", {})
+    labels = meta.get("labels", {}) or {}
+    domain = labels.get(DOMAIN_NAME_LABEL)
+    node = labels.get(NODE_NAME_LABEL)
+    if not domain or not node:
+        return None
+    return (meta.get("namespace", ""), domain, node)
+
+
+class LeaseTracker:
+    """Observation-based lease ages, keyed (namespace, domain) → node.
+
+    Thread-safe; fed from informer handler threads, read by the sweep
+    and reconcile threads.  ``monotonic``/``wall`` are injectable for
+    deterministic tests and the fleet simulator.
+    """
+
+    def __init__(self, monotonic: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time) -> None:
+        self._monotonic = monotonic
+        self._wall = wall
+        self._mu = threading.Lock()
+        # {(ns, domain): {node: (renew_stamp, observed_monotonic)}}
+        self._seen: dict[tuple[str, str],
+                         dict[str, tuple[str, float]]] = {}   # guarded by self._mu
+
+    def observe(self, obj: dict) -> None:
+        ident = lease_identity(obj)
+        if ident is None:
+            return
+        ns, domain, node = ident
+        stamp = (obj.get("spec") or {}).get("renewTime", "")
+        now_mono = self._monotonic()
+        with self._mu:
+            nodes = self._seen.setdefault((ns, domain), {})
+            prev = nodes.get(node)
+            if prev is not None and prev[0] == stamp:
+                return   # no renewal: a relist echo must not reset age
+            if prev is None:
+                # first sight (initial list / controller restart): seed
+                # from the stamped renewTime — but bounded by the
+                # SERVER-assigned creationTimestamp, which is on the API
+                # server's clock, not the renewer's.  A lease freshly
+                # created by a slow-clock daemon carries a renewTime
+                # minutes in the past; trusting it raw would seed a
+                # stale age and falsely expire the node before its first
+                # renewal is observed.  Clamped ≥ 0 so a fast clock
+                # cannot make a dead node immortal either.
+                wall = self._wall()
+                created = obj.get("metadata", {}).get(
+                    "creationTimestamp", "")
+                candidates = [
+                    wall - ts
+                    for ts in (parse_micro_time(stamp),
+                               parse_micro_time(created))
+                    if ts is not None]
+                initial_age = max(min(candidates), 0.0) \
+                    if candidates else 0.0
+                nodes[node] = (stamp, now_mono - initial_age)
+            else:
+                # an OBSERVED renewal: age restarts on OUR clock — the
+                # renewer's wall-clock skew is irrelevant from here on
+                nodes[node] = (stamp, now_mono)
+
+    def forget(self, obj: dict) -> None:
+        ident = lease_identity(obj)
+        if ident is None:
+            return
+        ns, domain, node = ident
+        with self._mu:
+            nodes = self._seen.get((ns, domain))
+            if nodes is not None:
+                nodes.pop(node, None)
+                if not nodes:
+                    del self._seen[(ns, domain)]
+
+    def rebase(self) -> int:
+        """Restart every tracked age at zero; returns how many leases
+        were rebased.  Called when observation itself was interrupted
+        (API blackout, watch outage): ages measured across the gap are
+        monitoring artifacts — the daemons could not renew because the
+        API was dark, not because they died.  Rebasing gives the whole
+        fleet one fresh ``lease_duration`` to renew; a truly-dead node
+        simply expires that much later.  Expiry DELAYED, never wrong."""
+        now_mono = self._monotonic()
+        with self._mu:
+            count = 0
+            for nodes in self._seen.values():
+                for node_name, (stamp, _) in list(nodes.items()):
+                    nodes[node_name] = (stamp, now_mono)
+                    count += 1
+            return count
+
+    def ages(self, namespace: str, domain: str) -> dict[str, float]:
+        """Seconds since each node's last observed renewal."""
+        now_mono = self._monotonic()
+        with self._mu:
+            nodes = self._seen.get((namespace, domain), {})
+            return {node: max(now_mono - observed, 0.0)
+                    for node, (_, observed) in nodes.items()}
+
+    def tracked(self) -> int:
+        with self._mu:
+            return sum(len(nodes) for nodes in self._seen.values())
