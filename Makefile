@@ -136,8 +136,13 @@ racecheck:
 # the suppression ratchet (`# vet: ignore` counts may shrink or hold vs
 # vet-baseline.json, never grow).  See docs/static-analysis.md.
 vet:
-	$(PYTHON) -m tpu_dra.analysis tpu_dra/
-	$(PYTHON) -m tpu_dra.analysis --checks deadline-hygiene hack/
+	$(PYTHON) -m tpu_dra.analysis --timings --max-seconds 15 \
+		--cache .vet-cache.json tpu_dra/
+	# tpu_dra/ rides along so drive->helper calls resolve: a drive
+	# calling a tpu_dra wrapper around an un-timeouted urlopen is only
+	# catchable when the whole-program layer can see the helper
+	$(PYTHON) -m tpu_dra.analysis --checks deadline-hygiene \
+		--cache .vet-cache.json hack/ tpu_dra/
 	$(PYTHON) -m tpu_dra.analysis --stats --baseline vet-baseline.json tpu_dra/
 
 STRESS_RUNS ?= 5

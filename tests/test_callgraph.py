@@ -1,0 +1,399 @@
+"""Whole-program layer: call-graph resolution, effect summaries, SCC
+fixpoint, and the mtime-keyed facts cache (tpu_dra/analysis/callgraph.py,
+effects.py, cache.py).
+
+The checkers' interprocedural behavior (wrapper-defeats-checker
+regressions, contract-drift pair types) lives in test_vet.py; this
+module unit-tests the engine those checkers stand on.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tpu_dra.analysis.cache import FactsCache
+from tpu_dra.analysis.callgraph import Program, module_dotted
+from tpu_dra.analysis.core import FileContext
+
+pytestmark = pytest.mark.core
+
+
+def build(tmp_path, files: dict[str, str], cache=None):
+    """Write ``files`` under tmp_path and build a Program over them."""
+    ctxs = {}
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        ctx = FileContext(str(p), src)
+        ctxs[ctx.path] = ctx
+    return Program(ctxs, cache)
+
+
+def path_of(tmp_path, rel: str) -> str:
+    return str(tmp_path / rel).replace(os.sep, "/")
+
+
+# -------------------------------------------------------------------------
+# module naming + call resolution
+# -------------------------------------------------------------------------
+
+
+def test_module_dotted_forms():
+    assert module_dotted("tpu_dra/analysis/core.py") == \
+        "tpu_dra.analysis.core"
+    assert module_dotted("pkg/__init__.py") == "pkg"
+
+
+def test_same_module_function_resolves(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "def helper():\n    pass\n"
+        "def caller():\n    helper()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert prog.resolve(a, None, "helper") == f"{a}::helper"
+
+
+def test_self_and_cls_methods_resolve(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "class C:\n"
+        "    def meth(self):\n        pass\n"
+        "    @classmethod\n"
+        "    def cm(cls):\n        cls.meth(None)\n"
+        "    def caller(self):\n        self.meth()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert prog.resolve(a, "C", "self.meth") == f"{a}::C.meth"
+    assert prog.resolve(a, "C", "cls.meth") == f"{a}::C.meth"
+
+
+def test_base_class_method_resolves_through_inheritance(tmp_path):
+    prog = build(tmp_path, {
+        "tpu_dra/base.py": (
+            "class Base:\n    def shared(self):\n        pass\n"),
+        "tpu_dra/a.py": (
+            "from tpu_dra.base import Base\n"
+            "class C(Base):\n"
+            "    def caller(self):\n        self.shared()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    base = path_of(tmp_path, "tpu_dra/base.py")
+    assert prog.resolve(a, "C", "self.shared") == f"{base}::Base.shared"
+
+
+def test_from_import_symbol_resolves(tmp_path):
+    prog = build(tmp_path, {
+        "tpu_dra/util/h.py": "def work():\n    pass\n",
+        "tpu_dra/a.py": (
+            "from tpu_dra.util.h import work\n"
+            "def caller():\n    work()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    h = path_of(tmp_path, "tpu_dra/util/h.py")
+    assert prog.resolve(a, None, "work") == f"{h}::work"
+
+
+def test_module_alias_call_resolves(tmp_path):
+    prog = build(tmp_path, {
+        "tpu_dra/resilience/failpoint.py": "def hit(name):\n    pass\n",
+        "tpu_dra/a.py": (
+            "from tpu_dra.resilience import failpoint\n"
+            "def caller():\n    failpoint.hit('x')\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    fp = path_of(tmp_path, "tpu_dra/resilience/failpoint.py")
+    assert prog.resolve(a, None, "failpoint.hit") == f"{fp}::hit"
+
+
+def test_constructor_resolves_to_init(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "class C:\n    def __init__(self):\n        pass\n"
+        "def caller():\n    C()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert prog.resolve(a, None, "C") == f"{a}::C.__init__"
+
+
+def test_unresolved_call_is_open_effect_not_blocking(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import json\n"
+        "def caller():\n    json.dumps({})\n    mystery()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    s = prog.summaries()[f"{a}::caller"]
+    assert "mystery" in s.open_calls
+    assert "json.dumps" in s.open_calls
+    assert s.blocking() == []   # open is unknown, never guessed
+
+
+# -------------------------------------------------------------------------
+# effect summaries
+# -------------------------------------------------------------------------
+
+
+def test_direct_sleep_effect(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import time\n"
+        "def pace():\n    time.sleep(1)\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    s = prog.summaries()[f"{a}::pace"]
+    assert [(e.kind, e.chain) for e in s.blocking()] == \
+        [("sleep", ())]
+
+
+def test_transitive_effect_carries_chain(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import time\n"
+        "def inner():\n    time.sleep(1)\n"
+        "def middle():\n    inner()\n"
+        "def outer():\n    middle()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    s = prog.summaries()[f"{a}::outer"]
+    (eff,) = s.blocking()
+    assert eff.kind == "sleep"
+    assert eff.line == 3
+    assert [q.split("::")[1] for q in eff.chain] == ["middle", "inner"]
+
+
+def test_recursive_scc_reaches_fixpoint(tmp_path):
+    # A <-> B mutual recursion, B also calls C which sleeps: both A and
+    # B must inherit the sleep (the around-the-cycle propagation case)
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import time\n"
+        "def c():\n    time.sleep(1)\n"
+        "def a(n):\n    b(n)\n"
+        "def b(n):\n    a(n)\n    c()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    for fn in ("a", "b"):
+        kinds = {e.kind for e in prog.summaries()[f"{a}::{fn}"]
+                 .blocking()}
+        assert kinds == {"sleep"}, fn
+
+
+def test_cross_file_effect_propagates(tmp_path):
+    prog = build(tmp_path, {
+        "tpu_dra/util/slow.py": (
+            "import time\n"
+            "def pause():\n    time.sleep(2)\n"),
+        "tpu_dra/a.py": (
+            "from tpu_dra.util.slow import pause\n"
+            "def caller():\n    pause()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    slow = path_of(tmp_path, "tpu_dra/util/slow.py")
+    (eff,) = prog.summaries()[f"{a}::caller"].blocking()
+    assert (eff.path, eff.line, eff.kind) == (slow, 3, "sleep")
+
+
+def test_acquires_propagate_through_calls(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import threading\n"
+        "_mu = threading.Lock()\n"
+        "def locked():\n    with _mu:\n        pass\n"
+        "def caller():\n    locked()\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert "a._mu" in prog.summaries()[f"{a}::caller"].acquires
+
+
+def test_classified_blocking_call_does_not_expand_internals(tmp_path):
+    # failpoint.hit is classified AT the call; the summary must not ALSO
+    # drag in hit()'s implementation (its own sleep/stall plumbing)
+    prog = build(tmp_path, {
+        "tpu_dra/resilience/failpoint.py": (
+            "import time\n"
+            "def hit(name):\n    time.sleep(9)\n"),
+        "tpu_dra/a.py": (
+            "from tpu_dra.resilience import failpoint\n"
+            "def caller():\n    failpoint.hit('p')\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    effs = prog.summaries()[f"{a}::caller"].blocking()
+    assert [e.kind for e in effs] == ["failpoint"]
+
+
+def test_wait_and_net_and_subprocess_and_kube_effects(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import subprocess\n"
+        "from urllib.request import urlopen\n"
+        "def f(self, evt, kube):\n"
+        "    evt.wait()\n"
+        "    subprocess.run(['x'])\n"
+        "    urlopen('http://h')\n"
+        "    kube.get('pods', 'x')\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    kinds = sorted(e.kind for e in prog.summaries()[f"{a}::f"]
+                   .blocking())
+    assert kinds == ["kube", "net", "subprocess", "wait"]
+
+
+def test_net_call_with_timeout_is_not_an_effect(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "from urllib.request import urlopen\n"
+        "def f():\n    urlopen('http://h', timeout=5)\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert prog.summaries()[f"{a}::f"].blocking() == []
+
+
+def test_nested_defs_do_not_leak_into_parent_summary(tmp_path):
+    prog = build(tmp_path, {"tpu_dra/a.py": (
+        "import time\n"
+        "def outer():\n"
+        "    def worker():\n        time.sleep(1)\n"
+        "    return worker\n")})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert prog.summaries()[f"{a}::outer"].blocking() == []
+
+
+# -------------------------------------------------------------------------
+# the facts cache
+# -------------------------------------------------------------------------
+
+_CACHED_SRC = ("import time\n"
+               "def pace():\n    time.sleep(1)\n")
+
+
+def test_nested_def_cannot_capture_a_method_qualname(tmp_path):
+    """A nested def sharing a method's name must not contribute the
+    method's facts entry: only module-level functions and class-body
+    methods are resolvable call targets, so only they get entries —
+    regardless of source order."""
+    src = ("import time\n\n\n"
+           "class C:\n"
+           "    def a(self):\n"
+           "        def b():\n"
+           "            time.sleep(1)\n"
+           "        return b\n\n"
+           "    def b(self):\n"
+           "        pass\n")
+    prog = build(tmp_path, {"tpu_dra/a.py": src})
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    # the REAL method b (line 10, empty) owns the qualname, not the
+    # nested sleeper that textually precedes it
+    assert prog.summaries()[f"{a}::C.b"].blocking() == []
+    # and the nested def has no entry of its own
+    assert all(not q.endswith("::b") for q in prog.summaries())
+
+
+def test_cache_round_trip_and_invalidation(tmp_path):
+    cpath = str(tmp_path / "cache.json")
+    cache = FactsCache(cpath)
+    build(tmp_path, {"tpu_dra/a.py": _CACHED_SRC}, cache)
+    cache.save()
+    assert os.path.exists(cpath)
+
+    # warm: facts come from the cache and summaries still solve
+    cache2 = FactsCache(cpath)
+    a_path = str(tmp_path / "tpu_dra" / "a.py")
+    assert cache2.get(a_path) is not None
+    prog = build(tmp_path, {"tpu_dra/a.py": _CACHED_SRC}, cache2)
+    a = path_of(tmp_path, "tpu_dra/a.py")
+    assert [e.kind for e in prog.summaries()[f"{a}::pace"]
+            .blocking()] == ["sleep"]
+
+    # a byte-level change invalidates the entry
+    (tmp_path / "tpu_dra" / "a.py").write_text(
+        _CACHED_SRC + "\ndef extra():\n    pass\n")
+    os.utime(a_path, ns=(1, 1))      # force a distinct mtime key
+    cache3 = FactsCache(cpath)
+    assert cache3.get(a_path) is None
+
+
+def test_cache_respelled_path_is_a_miss_not_a_crash(tmp_path):
+    """Facts embed the path SPELLING inside function qualnames, so a
+    record cached under one spelling handed to a run that resolves
+    another would key summaries one way and resolve call edges the
+    other (KeyError inside the solve).  The cache keys by verbatim
+    spelling: a re-spelled path is a plain miss that re-extracts."""
+    files = {
+        "tpu_dra/util/slowmod.py":
+            "import time\ndef pause():\n    time.sleep(1)\n",
+        "tpu_dra/caller.py":
+            "from tpu_dra.util.slowmod import pause\n"
+            "def f():\n    pause()\n",
+    }
+    cpath = str(tmp_path / "cache.json")
+    cache = FactsCache(cpath)
+    build(tmp_path, files, cache)
+    cache.save()
+
+    # same tree, every path re-spelled with a `/./` segment (as a
+    # different cwd or abs-vs-relative invocation would): all lookups
+    # miss, extraction reruns, and the solve stays consistent
+    cache2 = FactsCache(cpath)
+    ctxs = {}
+    for rel in files:
+        spelled = f"{tmp_path}/./{rel}"
+        ctxs[spelled] = FileContext(spelled,
+                                    (tmp_path / rel).read_text())
+    prog = Program(ctxs, cache2)      # must not raise
+    effs = prog.summaries()[f"{tmp_path}/./tpu_dra/caller.py::f"] \
+        .blocking()
+    assert [e.kind for e in effs] == ["sleep"]
+
+
+def test_cache_rejects_other_schema_versions(tmp_path):
+    cpath = tmp_path / "cache.json"
+    cpath.write_text('{"schema_version": 999, "files": {"x": 1}}')
+    cache = FactsCache(str(cpath))
+    assert cache.get("x") is None
+
+
+def test_cache_invalidated_when_extractors_change(tmp_path):
+    """Facts depend on the extractor code as much as on the analyzed
+    file: a cache written by a different tpu_dra/analysis/ source state
+    (fingerprint mismatch) is discarded wholesale — no stale
+    classifications just because nobody bumped SCHEMA_VERSION."""
+    import json
+
+    cpath = tmp_path / "cache.json"
+    cache = FactsCache(str(cpath))
+    cache.put(__file__, {"symbols": {}})
+    cache.save()
+    data = json.loads(cpath.read_text())
+    assert data["extractors"] == cache._fingerprint
+
+    data["extractors"] = "someone-elses-extractor-state"
+    cpath.write_text(json.dumps(data))
+    assert FactsCache(str(cpath)).get(__file__) is None
+
+
+def test_corrupt_cache_is_ignored(tmp_path):
+    cpath = tmp_path / "cache.json"
+    cpath.write_text("{not json")
+    cache = FactsCache(str(cpath))     # must not raise
+    assert cache.get("anything") is None
+
+
+# -------------------------------------------------------------------------
+# driver integration: timings + cache flag
+# -------------------------------------------------------------------------
+
+
+def test_run_paths_fills_timings_and_uses_cache(tmp_path):
+    from tpu_dra.analysis.core import run_paths
+
+    p = tmp_path / "tpu_dra" / "a.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("def f():\n    pass\n")
+    timings: dict[str, float] = {}
+    cpath = str(tmp_path / "facts.json")
+    diags = run_paths([str(p)], cache_path=cpath, timings=timings)
+    assert diags == []
+    assert "(parse)" in timings and "(program)" in timings
+    assert any(not k.startswith("(") for k in timings)
+    assert os.path.exists(cpath)
+
+
+def test_cli_max_seconds_gate(tmp_path):
+    import subprocess
+    import sys
+
+    p = tmp_path / "tpu_dra" / "a.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("def f():\n    pass\n")
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ok = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis",
+         "--max-seconds", "600", str(p)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert ok.returncode == 0, ok.stderr
+    slow = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis",
+         "--max-seconds", "0.000001", str(p)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert slow.returncode == 1
+    assert "--max-seconds" in slow.stderr

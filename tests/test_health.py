@@ -516,6 +516,11 @@ def test_launcher_heartbeat_touches_file(tmp_path):
 
 
 class StubEngine:
+    # the serve handler's healthz contract grew `draining` with the
+    # PR-9 graceful-drain work; a stub without it crashed every
+    # /healthz request (the 4 long-standing "pre-existing" failures)
+    draining = False
+
     def __init__(self, ok=True, detail="ok"):
         self.verdict = (ok, detail)
 

@@ -38,7 +38,7 @@ EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
                    "string-constant-drift", "exception-hygiene",
                    "metric-hygiene", "retry-hygiene", "lock-order",
                    "blocking-under-lock", "hotpath",
-                   "deadline-hygiene"}
+                   "deadline-hygiene", "contract-drift"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,
@@ -1444,3 +1444,563 @@ def test_deadline_hygiene_ignore_escape(tmp_path):
     diags = vet_snippet(tmp_path, "hack/drive_y.py", src,
                         checks=["deadline-hygiene"])
     assert len(diags) == 3
+
+
+# -------------------------------------------------------------------------
+# Interprocedural effect summaries (the whole-program engine, ISSUE 12)
+# -------------------------------------------------------------------------
+
+
+def vet_tree(tmp_path, files: dict[str, str],
+             checks: list[str] | None = None):
+    """Write a multi-file fixture tree and run the analyzers over ALL
+    of it (the whole-program engine resolves calls across the files)."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return run_paths([str(tmp_path)], checks=checks)
+
+
+_WRAPPED_SLEEP = """\
+import threading
+import time
+
+
+class C:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def _pace(self):
+        time.sleep(1)
+
+    def caller(self):
+        with self._mu:
+            self._pace()
+"""
+
+
+def test_blocking_wrapper_one_deep_is_flagged_at_the_call_site(tmp_path):
+    diags = vet_snippet(tmp_path, "tpu_dra/a.py", _WRAPPED_SLEEP,
+                        checks=["blocking-under-lock"])
+    assert len(diags) == 1
+    # the finding anchors at the CALL under the lock (line 14), citing
+    # the sleep's origin...
+    assert diags[0].line == 14
+    assert "reaches time.sleep()" in diags[0].message
+    assert "a.py:10" in diags[0].message
+    # ...and NOT at the sleep itself: _pace's own lockset is empty,
+    # which is exactly why the pre-interprocedural checker (per-function
+    # locksets only) could never flag this shape
+    assert all(d.line != 10 for d in diags)
+
+
+def test_blocking_wrapper_two_deep_cites_the_chain(tmp_path):
+    src = _WRAPPED_SLEEP.replace(
+        "    def caller(self):",
+        "    def _mid(self):\n"
+        "        self._pace()\n\n"
+        "    def caller(self):").replace(
+        "            self._pace()", "            self._mid()")
+    diags = vet_snippet(tmp_path, "tpu_dra/a.py", src,
+                        checks=["blocking-under-lock"])
+    assert len(diags) == 1
+    # the call names the first hop, the chain the rest: together the
+    # full path _mid -> _pace to the origin
+    assert "call to self._mid()" in diags[0].message
+    assert "via C._pace" in diags[0].message
+
+
+def test_blocking_wrapper_across_files_regression_proof(tmp_path):
+    """Both sides of the acceptance fixture: the caller file ALONE
+    (what the pre-PR per-file engine saw) is clean — the helper is an
+    unresolved open effect, never guessed blocking — while the whole
+    program flags the call site."""
+    helper = ("import time\n"
+              "def pause():\n"
+              "    time.sleep(2)\n")
+    caller = ("import threading\n"
+              "from tpu_dra.util.slowmod import pause\n"
+              "_mu = threading.Lock()\n"
+              "def caller():\n"
+              "    with _mu:\n"
+              "        pause()\n")
+    (tmp_path / "tpu_dra" / "util").mkdir(parents=True)
+    (tmp_path / "tpu_dra" / "util" / "slowmod.py").write_text(helper)
+    (tmp_path / "tpu_dra" / "caller.py").write_text(caller)
+    alone = run_paths([str(tmp_path / "tpu_dra" / "caller.py")],
+                      checks=["blocking-under-lock"])
+    assert alone == []
+    whole = run_paths([str(tmp_path)], checks=["blocking-under-lock"])
+    assert len(whole) == 1
+    assert whole[0].path.endswith("caller.py")
+    assert "reaches time.sleep()" in whole[0].message
+    assert "slowmod.py:3" in whole[0].message
+
+
+def test_blocking_urlopen_wrapper_under_lock_is_flagged(tmp_path):
+    src = _WRAPPED_SLEEP.replace(
+        "import time\n", "from urllib.request import urlopen\n").replace(
+        "        time.sleep(1)", "        urlopen('http://peer')")
+    diags = vet_snippet(tmp_path, "tpu_dra/a.py", src,
+                        checks=["blocking-under-lock"])
+    assert len(diags) == 1
+    assert "urlopen() without a timeout" in diags[0].message
+
+
+def test_blocking_interproc_origin_ignore_covers_all_callers(tmp_path):
+    src = _WRAPPED_SLEEP.replace(
+        "        time.sleep(1)",
+        "        time.sleep(1)  # vet: ignore[blocking-under-lock]")
+    assert vet_snippet(tmp_path, "tpu_dra/a.py", src,
+                       checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_interproc_call_site_ignore(tmp_path):
+    src = _WRAPPED_SLEEP.replace(
+        "            self._pace()",
+        "            self._pace()  # vet: ignore[blocking-under-lock]")
+    assert vet_snippet(tmp_path, "tpu_dra/a.py", src,
+                       checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_unresolved_call_under_lock_is_clean(tmp_path):
+    src = _WRAPPED_SLEEP.replace("            self._pace()",
+                                 "            mystery_helper()")
+    assert vet_snippet(tmp_path, "tpu_dra/a.py", src,
+                       checks=["blocking-under-lock"]) == []
+
+
+_WRAPPED_CV_WAIT = """\
+import threading
+
+
+class C:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._other = threading.Lock()
+
+    def _block(self):
+        self._cv.wait()
+
+    def caller(self):
+        with self._cv:
+            self._block()
+"""
+
+
+def test_blocking_wrapped_cv_wait_on_sole_held_lock_is_clean(tmp_path):
+    """The condition-variable protocol survives a wrapper: waiting on
+    the SOLE held lock is sanctioned inline, so a helper doing the same
+    wait must not be flagged at its call site (the interprocedural path
+    applies the same judgment as the direct scan)."""
+    assert vet_snippet(tmp_path, "tpu_dra/a.py", _WRAPPED_CV_WAIT,
+                       checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_wrapped_wait_under_another_lock_is_flagged(tmp_path):
+    # holding a DIFFERENT lock than the one the helper waits on parks
+    # the thread with that lock held — flagged, same as inline
+    src = _WRAPPED_CV_WAIT.replace("        with self._cv:",
+                                   "        with self._other:")
+    diags = vet_snippet(tmp_path, "tpu_dra/a.py", src,
+                        checks=["blocking-under-lock"])
+    assert len(diags) == 1
+    assert "self._cv.wait()" in diags[0].message
+    # and a second lock held alongside the CV also flags: the wait
+    # releases only its own condition
+    src2 = _WRAPPED_CV_WAIT.replace(
+        "        with self._cv:",
+        "        with self._other, self._cv:")
+    diags2 = vet_snippet(tmp_path, "tpu_dra/a.py", src2,
+                         checks=["blocking-under-lock"])
+    assert any("self._cv.wait()" in d.message for d in diags2)
+
+
+def test_blocking_wrapped_wait_cross_module_same_spelling_flagged(
+        tmp_path):
+    """Two module globals both spelled ``_cv`` are DIFFERENT locks: a
+    helper waiting on its own module's ``_cv`` while the caller holds
+    the caller module's ``_cv`` parks the thread forever — the CV
+    exemption compares qualified lock identities, not raw spellings."""
+    helper = ("import threading\n"
+              "_cv = threading.Condition()\n"
+              "def block():\n"
+              "    _cv.wait()\n")
+    caller = ("import threading\n"
+              "from tpu_dra.w import block\n"
+              "_cv = threading.Condition()\n"
+              "def caller():\n"
+              "    with _cv:\n"
+              "        block()\n")
+    diags = vet_tree(tmp_path, {"tpu_dra/w.py": helper,
+                                "tpu_dra/caller.py": caller},
+                     checks=["blocking-under-lock"])
+    assert len(diags) == 1
+    assert "_cv.wait()" in diags[0].message
+    # …while the SAME module's global CV through a helper is the
+    # protocol, identical spelling and all
+    same = helper + ("def caller():\n"
+                     "    with _cv:\n"
+                     "        block()\n")
+    assert vet_snippet(tmp_path / "same", "tpu_dra/w.py", same,
+                       checks=["blocking-under-lock"]) == []
+    # two files with the SAME basename (the repo has nine mod.py-style
+    # duplicates) qualify their globals identically — still different
+    # locks, still flagged: the exemption also requires the wait to
+    # originate in the caller's own file
+    caller2 = caller.replace("from tpu_dra.w import block",
+                             "from tpu_dra.pkg_a.mod import block")
+    diags2 = vet_tree(tmp_path / "dup",
+                      {"tpu_dra/pkg_a/mod.py": helper,
+                       "tpu_dra/pkg_b/mod.py": caller2},
+                      checks=["blocking-under-lock"])
+    assert len(diags2) == 1
+    assert "_cv.wait()" in diags2[0].message
+
+
+def test_retry_hygiene_wrapped_sleep_in_loop(tmp_path):
+    src = ("import time\n"
+           "def _pause():\n"
+           "    time.sleep(0.1)\n"
+           "def poll():\n"
+           "    while True:\n"
+           "        _pause()\n")
+    diags = vet_snippet(tmp_path, "tpu_dra/util/a.py", src,
+                        checks=["retry-hygiene"])
+    assert len(diags) == 1
+    assert "pacing loop wearing a wrapper" in diags[0].message
+    assert "a.py:3" in diags[0].message
+
+
+def test_retry_hygiene_resilience_layer_calls_are_sanctioned(tmp_path):
+    files = {
+        "tpu_dra/resilience/retry.py": (
+            "import time\n"
+            "def retry_call(fn):\n"
+            "    time.sleep(0.1)  # vet: ignore[retry-hygiene]\n"
+            "    return fn()\n"),
+        "tpu_dra/util/a.py": (
+            "from tpu_dra.resilience.retry import retry_call\n"
+            "def poll(fn):\n"
+            "    while True:\n"
+            "        retry_call(fn)\n"),
+    }
+    assert vet_tree(tmp_path, files, checks=["retry-hygiene"]) == []
+
+
+def test_deadline_hygiene_wrapped_urlopen_from_a_drive(tmp_path):
+    files = {
+        "tpu_dra/util/h.py": (
+            "from urllib.request import urlopen\n"
+            "def fetch(url):\n"
+            "    return urlopen(url)\n"),
+        "hack/drive_x.py": (
+            "from tpu_dra.util.h import fetch\n"
+            "def main():\n"
+            "    fetch('http://server')\n"),
+    }
+    diags = vet_tree(tmp_path, files, checks=["deadline-hygiene"])
+    assert len(diags) == 1
+    assert diags[0].path.endswith("drive_x.py")
+    assert "h.py:3" in diags[0].message
+
+
+def test_deadline_hygiene_wrapped_with_timeout_is_clean(tmp_path):
+    files = {
+        "tpu_dra/util/h.py": (
+            "from urllib.request import urlopen\n"
+            "def fetch(url):\n"
+            "    return urlopen(url, timeout=5)\n"),
+        "hack/drive_x.py": (
+            "from tpu_dra.util.h import fetch\n"
+            "def main():\n"
+            "    fetch('http://server')\n"),
+    }
+    assert vet_tree(tmp_path, files, checks=["deadline-hygiene"]) == []
+
+
+def test_lockorder_cycle_through_helper_calls(tmp_path):
+    src = ("import threading\n"
+           "_a = threading.Lock()\n"
+           "_b = threading.Lock()\n"
+           "def take_b():\n"
+           "    with _b:\n"
+           "        pass\n"
+           "def take_a():\n"
+           "    with _a:\n"
+           "        pass\n"
+           "def f1():\n"
+           "    with _a:\n"
+           "        take_b()\n"
+           "def f2():\n"
+           "    with _b:\n"
+           "        take_a()\n")
+    diags = vet_snippet(tmp_path, "tpu_dra/util/ab.py", src,
+                        checks=["lock-order"])
+    assert len(diags) == 1
+    assert "lock-order cycle" in diags[0].message
+    assert "ab._a" in diags[0].message and "ab._b" in diags[0].message
+
+
+def test_lockorder_leaf_violation_through_a_call(tmp_path):
+    src = ("import threading\n"
+           "class HealthMonitor:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self._other = threading.Lock()\n"
+           "    def _grab(self):\n"
+           "        with self._other:\n"
+           "            pass\n"
+           "    def bad(self):\n"
+           "        with self._mu:\n"
+           "            self._grab()\n")
+    diags = vet_snippet(tmp_path, "tpu_dra/health/m2.py", src,
+                        checks=["lock-order"])
+    assert any("leaf lock HealthMonitor._mu" in d.message
+               for d in diags)
+
+
+# -------------------------------------------------------------------------
+# contract-drift: one fixture per cross-binary pair type (ISSUE 12)
+# -------------------------------------------------------------------------
+
+
+def drift_msgs(diags) -> list[str]:
+    return [d.message for d in diags if d.check == "contract-drift"]
+
+
+def test_contract_drift_env_written_never_read(tmp_path):
+    diags = vet_snippet(
+        tmp_path, "tpu_dra/cdi/seed.py",
+        "import os\n"
+        "def seed():\n"
+        "    os.environ[\"SEEDED_UNREAD_VAR\"] = \"1\"\n",
+        checks=["contract-drift"])
+    (msg,) = drift_msgs(diags)
+    assert "SEEDED_UNREAD_VAR" in msg and "never read" in msg
+
+
+def test_contract_drift_env_read_never_written(tmp_path):
+    diags = vet_snippet(
+        tmp_path, "tpu_dra/util/seed.py",
+        "import os\n"
+        "def read():\n"
+        "    os.environ.get(\"PHANTOM_READ_VAR\")\n"
+        "    os.environ.get(\"NODE_NAME\")  # declared EXTERNAL_ENV\n",
+        checks=["contract-drift"])
+    (msg,) = drift_msgs(diags)
+    assert "PHANTOM_READ_VAR" in msg and "missing producer" in msg
+
+
+def test_contract_drift_env_pair_and_dict_producers_are_clean(tmp_path):
+    files = {
+        "tpu_dra/cdi/w.py": (
+            "def edits(edits):\n"
+            "    edits.env[\"SEEDED_PAIRED_VAR\"] = \"1\"\n"
+            "def common():\n"
+            "    common_env = {\"SEEDED_DICT_VAR\": \"x\"}\n"
+            "    return common_env\n"),
+        "tpu_dra/workloads/r.py": (
+            "import os\n"
+            "def read():\n"
+            "    os.environ.get(\"SEEDED_PAIRED_VAR\")\n"
+            "    return os.environ[\"SEEDED_DICT_VAR\"]\n"),
+    }
+    assert vet_tree(tmp_path, files, checks=["contract-drift"]) == []
+
+
+def test_contract_drift_env_ignore_suppresses_one_pair(tmp_path):
+    diags = vet_snippet(
+        tmp_path, "tpu_dra/cdi/seed.py",
+        "import os\n"
+        "def seed():\n"
+        "    os.environ[\"SEEDED_UNREAD_VAR\"] = \"1\""
+        "  # vet: ignore[contract-drift]\n",
+        checks=["contract-drift"])
+    assert drift_msgs(diags) == []
+
+
+def test_contract_drift_wire_channel_both_directions(tmp_path):
+    files = {
+        "tpu_dra/daemon/w.py": (
+            "def write_cfg():\n"
+            "    # contract: wire-test[writer]\n"
+            "    return {\"alpha\": 1, \"beta\": 2}\n"),
+        "tpu_dra/workloads/r.py": (
+            "def read_cfg(data):\n"
+            "    # contract: wire-test[reader]\n"
+            "    return data.get(\"alpha\"), data.get(\"gamma\")\n"),
+    }
+    msgs = drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"]))
+    assert len(msgs) == 2
+    assert any("'beta'" in m and "written here but no declared reader"
+               in m for m in msgs)
+    assert any("'gamma'" in m and "never writes it" in m for m in msgs)
+
+
+def test_contract_drift_wire_channel_single_sided_run_is_silent(
+        tmp_path):
+    # only the writer in the analyzed set: nothing to compare against
+    diags = vet_snippet(
+        tmp_path, "tpu_dra/daemon/w.py",
+        "def write_cfg():\n"
+        "    # contract: wire-test[writer]\n"
+        "    return {\"alpha\": 1}\n",
+        checks=["contract-drift"])
+    assert drift_msgs(diags) == []
+
+
+def test_contract_drift_metric_catalog_both_directions(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(
+        "# Metrics\n\n"
+        "- `tpu_dra_ghost_metric_total` — documented, never registered\n"
+        "- `tpu_dra_live_metric_total` — the paired one\n"
+        "- **REMOVED:** `tpu_dra_gone_metric_total` — migration note,\n"
+        "  not live contract\n")
+    files = {
+        "tpu_dra/util/m.py": (
+            "def setup(reg):\n"
+            "    reg.counter(\"tpu_dra_live_metric_total\", \"ok\")\n"
+            "    reg.counter(\"tpu_dra_rogue_metric_total\", \"x\")\n"),
+    }
+    msgs = drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"]))
+    assert len(msgs) == 2
+    assert any("tpu_dra_rogue_metric_total" in m and
+               "missing from the" in m for m in msgs)
+    assert any("tpu_dra_ghost_metric_total" in m and
+               "documented here but never registered" in m
+               for m in msgs)
+    # the REMOVED bullet never shows up as drift
+    assert not any("tpu_dra_gone_metric_total" in m for m in msgs)
+
+
+def test_contract_drift_failpoint_directions(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "resilience.md").write_text(
+        "# Resilience\n\n"
+        "## Failpoint catalog (by binary)\n\n"
+        "| point | where |\n|---|---|\n"
+        "| `t.seeded.dead/alive` | fixture |\n"
+        "| `t.seeded.doconly` | documented, never registered |\n")
+    files = {
+        "tpu_dra/util/fp.py": (
+            "from tpu_dra.resilience import failpoint\n"
+            "def setup():\n"
+            "    failpoint.register(\"t.seeded.dead\", \"never hit\")\n"
+            "    failpoint.register(\"t.seeded.alive\", \"ok\")\n"
+            "def work():\n"
+            "    failpoint.hit(\"t.seeded.alive\")\n"
+            "    failpoint.hit(\"t.seeded.ghost\")\n"),
+        "hack/drive_seed.py": (
+            "PLAN = \"t.seeded.typo=crash\"\n"),
+    }
+    msgs = drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"]))
+    assert any("'t.seeded.ghost'" in m and "never registered" in m
+               for m in msgs)
+    assert any("'t.seeded.dead'" in m and "no code path ever hits" in m
+               for m in msgs)
+    assert any("'t.seeded.typo'" in m and "silently no-ops" in m
+               for m in msgs)
+    assert any("'t.seeded.doconly'" in m and
+               "documented in the catalog" in m for m in msgs)
+    # the slash-compressed table form expands: t.seeded.alive is
+    # documented AND registered AND hit — no drift for it
+    assert not any("'t.seeded.alive'" in m for m in msgs)
+
+
+def test_contract_drift_event_reason_never_asserted(tmp_path):
+    (tmp_path / "docs").mkdir()   # root marker for the aux scan
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_seen.py").write_text(
+        "def test_x(events):\n"
+        "    assert events[0].reason == \"SeededSeenEvent\"\n")
+    files = {
+        "tpu_dra/controller/ev.py": (
+            "def reconcile(kube, obj, emit_event):\n"
+            "    emit_event(kube, obj, \"SeededSeenEvent\", \"m\")\n"
+            "    emit_event(kube, obj, \"SeededGhostEvent\", \"m\")\n"),
+    }
+    msgs = drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"]))
+    (msg,) = msgs
+    assert "'SeededGhostEvent'" in msg and "never asserted" in msg
+
+
+def test_contract_drift_crd_fields_both_directions(tmp_path):
+    (tmp_path / "docs").mkdir()
+    crds = tmp_path / "deployments" / "helm" / "x" / "crds"
+    crds.mkdir(parents=True)
+    (crds / "seed.yaml").write_text(
+        "spec:\n"
+        "  properties:\n"
+        "    specField:\n"
+        "      type: string\n"
+        "    deadField:\n"
+        "      type: string\n")
+    files = {
+        "tpu_dra/api/types.py": (
+            "def from_dict(data):\n"
+            "    return data.get(\"specField\"), "
+            "data.get(\"phantomField\")\n"),
+    }
+    msgs = drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"]))
+    assert len(msgs) == 2
+    assert any("'phantomField'" in m and "absent from the CRD schema"
+               in m for m in msgs)
+    assert any("'deadField'" in m and "never referenced" in m
+               for m in msgs)
+
+
+def test_contract_drift_crd_required_list_names_fields(tmp_path):
+    """A field that appears only in a spaced ``required: [...]`` list
+    (mid-migration schemas do this) counts as schema-side — the
+    required form is matched BEFORE the generic key regex, which the
+    spaced spelling also satisfies."""
+    (tmp_path / "docs").mkdir()
+    crds = tmp_path / "deployments" / "helm" / "x" / "crds"
+    crds.mkdir(parents=True)
+    (crds / "seed.yaml").write_text(
+        "spec:\n"
+        "  properties:\n"
+        "    specField:\n"
+        "      type: string\n"
+        "  required: [\"specField\", \"migrField\"]\n")
+    files = {
+        "tpu_dra/api/types.py": (
+            "def from_dict(data):\n"
+            "    return data.get(\"specField\"), "
+            "data.get(\"migrField\")\n"),
+    }
+    assert drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"])) == []
+
+
+def test_contract_drift_doc_side_ignore_marker(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(
+        "# Metrics\n\n"
+        "- `tpu_dra_waved_metric_total` — out-of-tree registration "
+        "<!-- vet: ignore[contract-drift] -->\n")
+    files = {"tpu_dra/util/m.py": "def noop():\n    pass\n"}
+    assert drift_msgs(vet_tree(tmp_path, files,
+                               checks=["contract-drift"])) == []
+
+
+def test_contract_drift_is_silent_without_whole_program(tmp_path):
+    # belt-and-braces: a context built outside the driver (program is
+    # None) must not crash the finish hook
+    from tpu_dra.analysis.checkers import contractdrift
+
+    contractdrift._begin()
+    path = tmp_path / "x.py"
+    path.write_text("import os\n")
+    from tpu_dra.analysis.core import FileContext
+
+    contractdrift._run(FileContext(str(path), path.read_text()))
+    assert contractdrift._finish() == []

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 
 from tpu_dra.analysis.core import (
     all_analyzers,
@@ -98,6 +100,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--stats", action="store_true",
                         help="report `# vet: ignore` counts per check "
                              "instead of running the analyzers")
+    parser.add_argument("--timings", action="store_true",
+                        help="print per-checker wall time (plus the "
+                             "parse and whole-program phases) to stderr "
+                             "after the run")
+    parser.add_argument("--max-seconds", type=float, default=None,
+                        help="fail (exit 1) when the whole run takes "
+                             "longer than this — the CI vet latency "
+                             "gate")
+    parser.add_argument("--cache", default=None,
+                        help="mtime-keyed facts cache file for the "
+                             "whole-program pass (default: "
+                             "$TPU_DRA_VET_CACHE; unset = no cache)")
     parser.add_argument("--baseline",
                         help="with --stats: committed baseline JSON; "
                              "exit 1 if any per-check count grew")
@@ -122,11 +136,16 @@ def main(argv: list[str] | None = None) -> int:
     checks = None
     if args.checks:
         checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+    cache_path = args.cache or os.environ.get("TPU_DRA_VET_CACHE")
+    timings: dict[str, float] = {}
+    t0 = time.perf_counter()
     try:
-        diags = run_paths(args.paths or ["tpu_dra"], checks=checks)
+        diags = run_paths(args.paths or ["tpu_dra"], checks=checks,
+                          cache_path=cache_path, timings=timings)
     except ValueError as exc:
         print(f"vet: {exc}", file=sys.stderr)
         return 2
+    elapsed = time.perf_counter() - t0
     fmt = "json" if args.json else args.format
     if fmt == "json":
         print(render_json(diags))
@@ -134,6 +153,17 @@ def main(argv: list[str] | None = None) -> int:
         print(render_sarif(diags, all_analyzers()))
     else:
         print(render_text(diags))
+    if args.timings:
+        for name, secs in sorted(timings.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"vet: {name}: {secs * 1000:.0f}ms", file=sys.stderr)
+        print(f"vet: total: {elapsed:.2f}s", file=sys.stderr)
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(f"vet: run took {elapsed:.2f}s, over the "
+              f"--max-seconds {args.max_seconds:g} gate — profile with "
+              f"--timings, fix the regression (or warm the --cache)",
+              file=sys.stderr)
+        return 1
     return 1 if diags else 0
 
 

@@ -1,0 +1,116 @@
+"""mtime-keyed on-disk cache for per-file analysis facts.
+
+The whole-program pass (callgraph + effect summaries + contract
+extraction) re-walks every file's AST on every ``make vet``; the facts
+it produces are plain JSON and depend only on the file's bytes, so they
+persist between runs keyed by ``(mtime_ns, size)`` — the same
+commit-a-machine-readable-artifact pattern as bench-budget.json and
+vet-baseline.json, except this one is a *throwaway* accelerator (never
+committed; ``.vet-cache.json`` is gitignored and safe to delete).
+
+Resolution, the SCC fixpoint, and every checker still run fresh each
+time — only the extraction walks are skipped for unchanged files.  The
+cache is opt-in (``--cache`` / ``TPU_DRA_VET_CACHE``) so test-fixture
+runs in tmp dirs never write one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+__all__ = ["FactsCache"]
+
+# bump whenever the facts record SHAPE changes (new extraction fields,
+# different call-tuple arity, …): entries from other versions are
+# ignored wholesale, so a stale cache can never feed a newer extractor
+SCHEMA_VERSION = 2
+
+
+def _extractor_fingerprint() -> str:
+    """mtime/size digest of the analysis package's own sources.  The
+    facts a file yields depend on the EXTRACTORS as much as on the file
+    (a new _SLEEP_TOKENS entry, a new env-producer idiom): an edit
+    anywhere under tpu_dra/analysis/ invalidates the whole cache, so a
+    content-affecting change can never serve stale classifications just
+    because nobody remembered to bump SCHEMA_VERSION."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for dirpath, dirs, files in os.walk(root):
+        dirs.sort()
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(p, root)}:"
+                     f"{st.st_mtime_ns}:{st.st_size}\n".encode())
+    return h.hexdigest()
+
+
+class FactsCache:
+    def __init__(self, path: str):
+        self.path = path
+        self._files: dict[str, dict] = {}
+        self._dirty = False
+        self._fingerprint = _extractor_fingerprint()
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+            if data.get("schema_version") == SCHEMA_VERSION and \
+                    data.get("extractors") == self._fingerprint:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            pass    # cold or corrupt cache: plain re-extraction
+
+    @staticmethod
+    def _key(path: str) -> Optional[list]:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return [st.st_mtime_ns, st.st_size]
+
+    def get(self, path: str) -> Optional[dict]:
+        # keyed by the VERBATIM path spelling, not abspath: the facts
+        # embed the spelling inside function qualnames, so a record
+        # cached under `tpu_dra/x.py` handed to a run that resolves
+        # `/repo/tpu_dra/x.py` would key summaries one way and resolve
+        # calls the other (KeyError in the solve).  A different
+        # spelling is a plain miss and re-extracts.
+        ent = self._files.get(path)
+        if ent is None:
+            return None
+        key = self._key(path)
+        if key is None or ent.get("key") != key:
+            return None
+        return ent.get("facts")
+
+    def put(self, path: str, facts: dict) -> None:
+        key = self._key(path)
+        if key is None:
+            return
+        self._files[path] = {"key": key, "facts": facts}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        # prune entries whose file is gone or changed since put():
+        # deleted/renamed files would otherwise persist forever
+        live = {p: ent for p, ent in self._files.items()
+                if self._key(p) == ent.get("key")}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"schema_version": SCHEMA_VERSION,
+                           "extractors": self._fingerprint,
+                           "files": live}, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass    # a read-only checkout just runs uncached

@@ -1,0 +1,324 @@
+"""Project-wide call graph: the whole-program half of tpudra-vet.
+
+PR 5 grew the checkers from line-local AST passes to per-function
+CFG/lockset dataflow; this module takes the next step the same way
+go/analysis drivers do (facts flowing between packages): every file in a
+``run_paths`` invocation contributes a serializable *facts* record
+(symbols, functions, call sites, direct effects, contract surfaces), and
+a :class:`Program` built over all of them resolves calls into a
+project-wide graph.  The effect engine (:mod:`tpu_dra.analysis.effects`)
+computes transitive summaries over it, and the flow checkers consult
+those summaries so a ``time.sleep`` hidden one-or-more helper calls deep
+is attributed to the call site where the lock is actually held.
+
+Resolution is deliberately syntactic (no type inference), matching the
+repo's calling idioms:
+
+- ``helper()`` — a module-level function of the same module;
+- ``self.meth()`` / ``cls.meth()`` — a method of the enclosing class,
+  or of a statically-resolvable base class (depth-limited);
+- ``mod.func()`` / ``alias.func()`` — through ``import``/``from``
+  aliases, resolved against the set of analyzed files by dotted-name
+  suffix (so fixture trees under tmp dirs resolve identically to the
+  real ``tpu_dra/`` tree);
+- ``Class()`` — the constructor resolves to ``Class.__init__`` when one
+  is defined.
+
+Anything else (locals, attribute chains like ``self.kube.get``, stdlib)
+is *unresolved* and recorded as an **open effect** on the caller's
+summary — the summary is honest about its own incompleteness instead of
+guessing.
+
+Facts are plain JSON (lists/dicts/strings) so the mtime-keyed on-disk
+cache (:mod:`tpu_dra.analysis.cache`) can persist them between vet runs;
+resolution and the summary fixpoint are recomputed from facts each run
+(pure dict work, a few ms for the whole tree).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpu_dra.analysis import lockset
+
+__all__ = ["Program", "extract_symbols", "extract_functions",
+           "toplevel_functions", "qualname"]
+
+_FUNC = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def toplevel_functions(tree: ast.Module):
+    """``(func, class-or-None)`` for module-level functions and
+    class-body methods — the only defs call resolution can target.
+    Nested defs are invisible to callers and must not contribute facts
+    entries (a nested def sharing a method's name would otherwise
+    capture its qualname and mis-attribute effects)."""
+    for stmt in tree.body:
+        if isinstance(stmt, _FUNC):
+            yield stmt, None
+        elif isinstance(stmt, ast.ClassDef):
+            for s in stmt.body:
+                if isinstance(s, _FUNC):
+                    yield s, stmt.name
+
+
+def qualname(path: str, cls: Optional[str], name: str) -> str:
+    """Stable project-wide function id: ``path::Class.name`` /
+    ``path::name`` — unambiguous and readable in diagnostics."""
+    return f"{path}::{cls}.{name}" if cls else f"{path}::{name}"
+
+
+def dotted_of(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a plain dotted Attribute/Name chain, else None —
+    THE flattener every layer shares (delegates to lockset.token_of),
+    so the direct and summary classifications cannot drift apart."""
+    return lockset.token_of(expr)
+
+
+def module_dotted(path: str) -> str:
+    """``tpu_dra/analysis/core.py`` -> ``tpu_dra.analysis.core``;
+    ``pkg/__init__.py`` -> ``pkg``.  Absolute fixture paths keep their
+    tmp prefix — suffix matching (below) makes them resolve the same."""
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.strip("/").replace("/", ".")
+
+
+def extract_symbols(tree: ast.Module, path: str) -> dict:
+    """The module-level symbol table: defs, classes (methods + bases),
+    and import aliases — everything call resolution needs, as JSON."""
+    defs: list[str] = []
+    classes: dict[str, dict] = {}
+    imports: dict[str, list] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, _FUNC):
+            defs.append(stmt.name)
+        elif isinstance(stmt, ast.ClassDef):
+            classes[stmt.name] = {
+                "methods": [s.name for s in stmt.body
+                            if isinstance(s, _FUNC)],
+                "bases": [d for d in (dotted_of(b) for b in stmt.bases)
+                          if d is not None],
+            }
+        elif isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if alias.asname:
+                    imports[alias.asname] = ["module", alias.name]
+                else:
+                    # `import a.b` binds `a`; dotted use sites carry the
+                    # rest of the path themselves
+                    root = alias.name.split(".")[0]
+                    imports[root] = ["module", root]
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.level:
+                parts = module_dotted(path).split(".")
+                base_parts = parts[: len(parts) - stmt.level] \
+                    if stmt.level <= len(parts) else []
+                base = ".".join(base_parts + ([stmt.module]
+                                              if stmt.module else []))
+            else:
+                base = stmt.module or ""
+            if not base:
+                continue
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue
+                imports[alias.asname or alias.name] = \
+                    ["from", base, alias.name]
+    return {"defs": defs, "classes": classes, "imports": imports}
+
+
+def extract_functions(ctx) -> dict:
+    """Per-function raw facts for one file: line, enclosing class, and
+    every call site's dotted callee text.  Effects/acquires are appended
+    by :mod:`tpu_dra.analysis.effects` extraction (one walk, shared)."""
+    from tpu_dra.analysis import effects
+
+    out: dict[str, dict] = {}
+    for func, cls in toplevel_functions(ctx.tree):
+        qual = qualname(ctx.path, cls, func.name)
+        if qual in out:      # same-named redefinition: keep the first
+            continue
+        calls: list[list] = []
+        for sub in lockset.walk_scan(func):
+            if isinstance(sub, ast.Call):
+                dotted = dotted_of(sub.func)
+                if dotted is not None:
+                    # a call the effect catalog classifies directly
+                    # (failpoint.hit, kube.get, …) contributes its
+                    # CLASSIFICATION, not its implementation's innards:
+                    # summaries skip merging through it
+                    skip = 1 if effects.blocking_reason(sub) else 0
+                    calls.append([dotted, sub.lineno, sub.col_offset,
+                                  skip])
+        out[qual] = {"line": func.lineno, "cls": cls, "name": func.name,
+                     "calls": calls, "effects": [], "acquires": []}
+    return out
+
+
+class Program:
+    """All files of one ``run_paths`` invocation: per-file facts (from
+    the cache or freshly extracted), the resolved call graph, and the
+    lazily-computed effect summaries + contract registry."""
+
+    def __init__(self, ctxs: dict, cache=None):
+        self.ctxs = ctxs                    # path -> FileContext
+        self.facts: dict[str, dict] = {}    # path -> facts record
+        self._summaries = None
+        self._contracts = None
+        self._mod_index: dict[str, list[str]] = {}
+        from tpu_dra.analysis import contracts as _contracts
+        from tpu_dra.analysis import effects as _effects
+        for path, ctx in ctxs.items():
+            cached = cache.get(path) if cache is not None else None
+            if cached is not None:
+                rec = cached
+            else:
+                rec = {
+                    "symbols": extract_symbols(ctx.tree, path),
+                    "functions": extract_functions(ctx),
+                    "contracts": _contracts.extract_file(ctx),
+                }
+                _effects.extract_direct(ctx, rec)
+                if cache is not None:
+                    cache.put(path, rec)
+            self.facts[path] = rec
+            ctx.program = self
+        # dotted-module suffix index over the analyzed set
+        for path in self.facts:
+            dotted = module_dotted(path)
+            self._mod_index.setdefault(dotted, []).append(path)
+
+    # -- module / class / call resolution -------------------------------
+    def find_module(self, dotted: str) -> Optional[str]:
+        """Path of the module named ``dotted``: exact match, else the
+        unique analyzed module whose dotted path ends with it."""
+        hit = self._mod_index.get(dotted)
+        if hit:
+            return hit[0] if len(hit) == 1 else None
+        suffix = "." + dotted
+        found = [p for d, paths in self._mod_index.items()
+                 if d.endswith(suffix) for p in paths]
+        return found[0] if len(found) == 1 else None
+
+    def _resolve_class(self, path: str, name: str,
+                       ) -> Optional[tuple[str, str]]:
+        """(path, class) for a class name visible in ``path``."""
+        syms = self.facts[path]["symbols"]
+        if name in syms["classes"]:
+            return (path, name)
+        imp = syms["imports"].get(name.split(".")[0])
+        if imp is None:
+            return None
+        if "." in name:             # mod_alias.Class
+            alias, clsname = name.split(".", 1)
+            if imp[0] == "module":
+                mpath = self.find_module(imp[1])
+            else:
+                mpath = self.find_module(f"{imp[1]}.{imp[2]}")
+            if mpath and "." not in clsname and \
+                    clsname in self.facts[mpath]["symbols"]["classes"]:
+                return (mpath, clsname)
+            return None
+        if imp[0] == "from":
+            mpath = self.find_module(imp[1])
+            if mpath and imp[2] in self.facts[mpath]["symbols"]["classes"]:
+                return (mpath, imp[2])
+        return None
+
+    def _method_in(self, path: str, cls: str, meth: str,
+                   depth: int = 0) -> Optional[str]:
+        info = self.facts[path]["symbols"]["classes"].get(cls)
+        if info is None:
+            return None
+        if meth in info["methods"]:
+            return qualname(path, cls, meth)
+        if depth >= 3:
+            return None
+        for base in info["bases"]:
+            loc = self._resolve_class(path, base)
+            if loc is not None:
+                found = self._method_in(loc[0], loc[1], meth, depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _func_in_module(self, mpath: str, name: str) -> Optional[str]:
+        syms = self.facts[mpath]["symbols"]
+        if name in syms["defs"]:
+            return qualname(mpath, None, name)
+        if name in syms["classes"]:          # constructor
+            return self._method_in(mpath, name, "__init__")
+        return None
+
+    def resolve(self, path: str, cls: Optional[str],
+                dotted: str) -> Optional[str]:
+        """Resolve a dotted call target written in ``path`` (inside
+        class ``cls``) to a project function qualname, or None."""
+        if path not in self.facts:
+            return None
+        syms = self.facts[path]["symbols"]
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            local = self._func_in_module(path, name)
+            if local is not None:
+                return local
+            imp = syms["imports"].get(name)
+            if imp is not None and imp[0] == "from":
+                mpath = self.find_module(imp[1])
+                if mpath is not None:
+                    return self._func_in_module(mpath, imp[2])
+            return None
+        if parts[0] in ("self", "cls") and cls is not None \
+                and len(parts) == 2:
+            return self._method_in(path, cls, parts[1])
+        # class-qualified in this module: Class.method / Class().__?
+        if parts[0] in syms["classes"] and len(parts) == 2:
+            return self._method_in(path, parts[0], parts[1])
+        imp = syms["imports"].get(parts[0])
+        if imp is None:
+            return None
+        if imp[0] == "module":
+            full = ".".join([imp[1]] + parts[1:])
+        else:                                 # from base import name
+            full = ".".join([imp[1], imp[2]] + parts[1:])
+        # longest module prefix of `full`, remainder = func or Class.meth
+        segs = full.split(".")
+        for cut in range(len(segs) - 1, 0, -1):
+            mpath = self.find_module(".".join(segs[:cut]))
+            if mpath is None:
+                continue
+            rest = segs[cut:]
+            if len(rest) == 1:
+                return self._func_in_module(mpath, rest[0])
+            if len(rest) == 2:
+                return self._method_in(mpath, rest[0], rest[1])
+            return None
+        return None
+
+    # -- derived layers --------------------------------------------------
+    def summaries(self) -> dict:
+        """qualname -> :class:`tpu_dra.analysis.effects.Summary`,
+        computed bottom-up over SCCs on first use."""
+        if self._summaries is None:
+            from tpu_dra.analysis import effects
+            self._summaries = effects.solve(self)
+        return self._summaries
+
+    def summary_for(self, path: str, cls: Optional[str],
+                    dotted: str):
+        """The callee summary for a call written in ``path``/``cls``,
+        or None when the call does not resolve in-project."""
+        qual = self.resolve(path, cls, dotted)
+        if qual is None:
+            return None
+        return self.summaries().get(qual)
+
+    def contracts(self):
+        if self._contracts is None:
+            from tpu_dra.analysis import contracts
+            self._contracts = contracts.Registry(self)
+        return self._contracts

@@ -9,6 +9,7 @@ same pattern go/analysis drivers use for their analyzer lists).
 from tpu_dra.analysis.checkers import (  # noqa: F401
     blockunderlock,
     constants,
+    contractdrift,
     deadlinehygiene,
     excepts,
     guardedby,

@@ -1,5 +1,5 @@
 """blocking-under-lock: no slow or indefinite operation inside a
-critical section.
+critical section — including one hidden behind helper calls.
 
 A blocking call under a held lock turns one stalled I/O into a stalled
 *subsystem*: every thread that contends on the lock queues behind the
@@ -17,71 +17,119 @@ non-empty:
   the calling thread; a point that *means* to stall under the state
   lock (the crash sweep's mid-critical-section kills) carries a
   justified ignore;
+- un-timeouted outbound HTTP/socket calls (the deadline-hygiene
+  catalog) — a wedged peer parks the thread with the lock held;
 - ``X.wait(...)`` / ``X.wait_for(...)`` — a ``Condition.wait`` releases
   only its *own* lock: waiting while the lockset holds anything else
   (or waiting on an ``Event`` under any lock) parks the thread with
   locks held.  Waiting on the sole held lock is the condition-variable
   protocol and is allowed.
+
+**Interprocedural:** a call to a project function whose effect summary
+(:mod:`tpu_dra.analysis.effects`) reaches any of the above is flagged
+at the CALL SITE under the lock, citing the origin and the helper chain
+— a trivial wrapper no longer defeats the check.  A justified
+``# vet: ignore[blocking-under-lock]`` at the blocking ORIGIN covers
+every caller (one design decision, one ignore); an ignore at the call
+site covers just that caller.  Unresolved calls are open effects and
+are never guessed blocking.
 """
 
 from __future__ import annotations
 
 import ast
 
-from tpu_dra.analysis import lockset
+from tpu_dra.analysis import effects, lockset
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 from tpu_dra.analysis.cfg import STMT, WITH_ENTER
 
-_SLEEP_TOKENS = {"time.sleep", "sleep"}
-_SUBPROCESS_FNS = {"run", "Popen", "call", "check_call", "check_output",
-                   "communicate"}
-_KUBE_RECEIVERS = {"kube", "kube_client"}
-_KUBE_METHODS = {"get", "list", "create", "update", "update_status",
-                 "delete", "patch", "request", "watch", "stream"}
+_CHECK = "blocking-under-lock"
 
 
 def _held_str(held: frozenset[str]) -> str:
     return ", ".join(sorted(held))
 
 
-def _blocking_reason(call: ast.Call) -> str | None:
-    tok = lockset.token_of(call.func)
-    if tok is None:
-        return None
-    if tok in _SLEEP_TOKENS:
-        return "time.sleep()"
-    parts = tok.split(".")
-    if parts[0] == "subprocess" and parts[-1] in _SUBPROCESS_FNS:
-        return f"subprocess.{parts[-1]}()"
-    if parts[-1] == "hit" and len(parts) >= 2 and parts[-2] == "failpoint":
-        return "failpoint.hit() (an armed sleep/stall blocks here)"
-    if len(parts) >= 2 and parts[-1] in _KUBE_METHODS \
-            and parts[-2] in _KUBE_RECEIVERS:
-        return f"kube client call .{parts[-1]}()"
-    return None
+def _origin_suppressed(program, eff) -> bool:
+    octx = program.ctxs.get(eff.path) if program is not None else None
+    return octx is not None and octx.suppressed(eff.line, _CHECK)
 
 
-def _scan_calls(ctx: FileContext, tree, held: frozenset[str],
-                diags: list[Diagnostic]) -> None:
+def _scan_calls(ctx: FileContext, cls, tree, held: frozenset[str],
+                diags: list[Diagnostic], seen_calls: set[tuple],
+                mod_globals: set[str], modbase: str) -> None:
+    program = ctx.program
     for sub in lockset.walk_scan(tree):
         if not isinstance(sub, ast.Call):
             continue
         if isinstance(sub.func, ast.Attribute) and \
                 sub.func.attr in ("wait", "wait_for"):
             continue        # the wait protocol is judged separately
-        reason = _blocking_reason(sub)
+        reason = effects.blocking_reason(sub)
         if reason is not None:
             diags.append(ctx.diag(
-                sub, "blocking-under-lock",
-                f"{reason} while holding {_held_str(held)} — move the "
-                f"blocking work outside the critical section"))
+                sub, _CHECK,
+                f"{reason[1]} while holding {_held_str(held)} — move "
+                f"the blocking work outside the critical section"))
+            continue
+        net = effects.net_call(sub)
+        if net is not None:
+            diags.append(ctx.diag(
+                sub, _CHECK,
+                f"{net}() without a timeout while holding "
+                f"{_held_str(held)} — a wedged peer parks this thread "
+                f"with the lock held"))
+            continue
+        # interprocedural: does the callee's summary block?
+        if program is None:
+            continue
+        dotted = lockset.token_of(sub.func)
+        if dotted is None:
+            continue
+        summary = program.summary_for(ctx.path, cls, dotted)
+        if summary is None:
+            continue
+        for eff in summary.blocking():
+            # condition-variable protocol, same judgment as the direct
+            # scan: a helper waiting on the SOLE held lock is the
+            # sanctioned pattern (`with self._cv: self._helper()` where
+            # the helper does `self._cv.wait()`), not a finding.
+            # Compared as QUALIFIED lock identities AND restricted to
+            # same-file origins: the Owner.attr namespace is basename-
+            # scoped (shared with lock-order), so two `mod.py` files'
+            # `_cv` globals qualify identically while being different
+            # locks — and every sanctioned wrapper shape (helper method
+            # of the class, same-module helper function) lives in the
+            # file that owns the lock anyway
+            if eff.kind == "wait" and len(held) == 1 and eff.recv \
+                    and eff.path == ctx.path:
+                qh = effects.qualify_lock(next(iter(held)), cls,
+                                          mod_globals, modbase)
+                if qh is not None and qh == eff.recv:
+                    continue
+            key = (sub.lineno, sub.col_offset, eff.kind, eff.path,
+                   eff.line)
+            if key in seen_calls or _origin_suppressed(program, eff):
+                continue
+            seen_calls.add(key)
+            via = effects.chain_str(eff)
+            where = f"{eff.path}:{eff.line}" + (f" ({via})" if via
+                                                else "")
+            diags.append(ctx.diag(
+                sub, _CHECK,
+                f"call to {dotted}() while holding {_held_str(held)} "
+                f"reaches {eff.detail} at {where} — move the blocking "
+                f"work outside the critical section"))
 
 
 def _run(ctx: FileContext) -> list[Diagnostic]:
     if ctx.is_test():
         return []
     diags: list[Diagnostic] = []
-    for func, _cls in lockset.functions_in(ctx.tree):
+    seen_calls: set[tuple] = set()
+    modbase = effects.modbase_of(ctx.path)
+    mod_globals = effects.module_globals(ctx.tree)
+    for func, cls in lockset.functions_in(ctx.tree):
         facts = lockset.analyze(ctx, func)
         for node in facts.cfg.nodes:
             if not facts.reachable(node):
@@ -93,7 +141,9 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
                 held = facts.lockset(node)
                 for item in node.items:
                     if held:
-                        _scan_calls(ctx, item.context_expr, held, diags)
+                        _scan_calls(ctx, cls, item.context_expr, held,
+                                    diags, seen_calls, mod_globals,
+                                    modbase)
                     tok = lockset.token_of(item.context_expr)
                     if tok is not None:
                         held = held | {tok}
@@ -108,23 +158,27 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
                     others = held - {tok}
                     if others:
                         diags.append(ctx.diag(
-                            call, "blocking-under-lock",
+                            call, _CHECK,
                             f"{tok}.wait() releases only {tok}; "
                             f"{_held_str(others)} stay(s) held for the "
                             f"whole wait"))
                 else:
                     diags.append(ctx.diag(
-                        call, "blocking-under-lock",
+                        call, _CHECK,
                         f"blocking wait on {tok or 'a non-lock object'} "
                         f"while holding {_held_str(held)}"))
             for tree in node.scan_asts():
-                _scan_calls(ctx, tree, held, diags)
+                _scan_calls(ctx, cls, tree, held, diags, seen_calls,
+                            mod_globals, modbase)
     return diags
 
 
 register(Analyzer(
-    name="blocking-under-lock",
+    name=_CHECK,
     doc="no time.sleep, kube client call, subprocess, failpoint stall, "
-        "or foreign wait while a lock is held (lockset-driven)",
+        "un-timeouted outbound call, or foreign wait while a lock is "
+        "held — directly or through any chain of helper calls "
+        "(lockset + effect-summary driven)",
     run=_run,
+    whole_program=True,
 ))

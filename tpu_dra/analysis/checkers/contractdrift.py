@@ -1,0 +1,76 @@
+"""contract-drift: one-sided cross-binary contracts.
+
+The four binaries compose through strings — env vars, the
+``nodes_config.json`` wire fields, metric names, failpoint names, Event
+reasons, CRD fields.  A typo or a stale rename on either side is not a
+type error, not a test failure, and usually not even a log line: the
+producer keeps writing into the void, or the consumer keeps reading a
+default, until someone debugs the composed system.  This checker builds
+the cross-binary contract registry
+(:mod:`tpu_dra.analysis.contracts`) over the whole program plus the
+doc/manifest catalogs and reports every ONE-SIDED pair:
+
+- env var written-never-read / read-never-written (modulo the declared
+  EXTERNAL_ENV / EXPORTED_ENV contracts);
+- declared wire-channel fields (``# contract: name[writer|reader]``)
+  written-never-read / read-never-written;
+- metrics registered-never-documented / documented-never-registered
+  (docs/observability.md is the catalog of record);
+- failpoints hit-never-registered, registered-never-hit,
+  armed-never-registered (a typo'd chaos plan silently no-ops), and
+  both directions against the docs/resilience.md catalog table;
+- Event reasons emitted but never asserted by any test or drive;
+- CRD fields referenced in ``api/types.py`` but absent from the helm
+  CRD schema (structural pruning drops them), and schema properties
+  nothing references.
+
+Findings anchor at the surviving side's site and cite the place the
+missing side was expected, so ``# vet: ignore[contract-drift]`` on an
+intentionally one-sided line (plus a justification) suppresses exactly
+one pair.  Doc-anchored findings are suppressed in the doc itself
+(``vet: ignore[contract-drift]`` on the line, or a REMOVED bullet in
+the metrics catalog).  See docs/static-analysis.md for the
+declare-a-new-contract recipe.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.analysis import contracts
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+# path -> ctx, accumulated by _run, consumed by _finish
+_CTXS: dict[str, FileContext] = {}
+
+
+def _begin() -> None:
+    _CTXS.clear()
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    _CTXS[ctx.path] = ctx
+    return []
+
+
+def _finish() -> list[Diagnostic]:
+    if not _CTXS:
+        return []
+    any_ctx = next(iter(_CTXS.values()))
+    program = any_ctx.program
+    if program is None:
+        return []
+    root = contracts.detect_root(_CTXS.keys())
+    registry = program.contracts()
+    return [Diagnostic(path, line, 0, "contract-drift", message)
+            for path, line, message in registry.drift(root)]
+
+
+register(Analyzer(
+    name="contract-drift",
+    doc="cross-binary string contracts (env vars, wire fields, metrics "
+        "vs docs, failpoints vs catalog/armed names, Event reasons, CRD "
+        "fields vs manifests) must have both sides",
+    run=_run,
+    begin=_begin,
+    finish=_finish,
+    whole_program=True,
+))

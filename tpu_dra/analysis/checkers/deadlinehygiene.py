@@ -28,91 +28,93 @@ the positional slot that means timeout):
 ``# vet: ignore[deadline-hygiene]`` only if they ever get flagged by
 a future rule.  A deliberate infinite wait needs the ignore plus a
 justification comment — the friction is the point.
+
+**Interprocedural:** an in-scope call to a project function whose
+effect summary reaches an un-timeouted outbound call is flagged at the
+call site, citing origin + helper chain — the catalog (shared with the
+effect engine, :func:`tpu_dra.analysis.effects.net_call`) cannot be
+defeated by wrapping the ``urlopen`` in a helper, in this file or any
+other.  Origins already in scope are skipped (the direct finding at
+the origin is the actionable one); an origin-side
+``# vet: ignore[deadline-hygiene]`` covers every caller.
 """
 
 from __future__ import annotations
 
 import ast
 
+from tpu_dra.analysis import effects as _effects
+from tpu_dra.analysis import lockset
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 
-_REQUESTS_METHODS = ("get", "post", "put", "patch", "delete", "head",
-                     "request")
 
-# (matcher description, positional index that can carry the timeout;
-# None = keyword-only as far as this checker trusts itself)
-_TIMEOUT_POS = {
-    "urlopen": 2,               # urlopen(url, data=None, timeout=...)
-    "create_connection": 1,     # create_connection(address, timeout=...)
-}
-
-
-def _dotted(node: ast.AST) -> str:
-    """``a.b.c`` for Attribute/Name chains, "" otherwise."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _call_kind(call: ast.Call) -> str | None:
-    """Classify an outbound-call site; None = not in this checker's
-    catalog."""
-    name = _dotted(call.func)
-    if not name:
-        return None
-    last = name.rsplit(".", 1)[-1]
-    if last == "urlopen":
-        return "urlopen"
-    if name in ("socket.create_connection", "create_connection"):
-        return "create_connection"
-    if last in ("HTTPConnection", "HTTPSConnection"):
-        return "http_connection"
-    head = name.split(".", 1)[0]
-    if head == "requests" and last in _REQUESTS_METHODS:
-        return "requests"
-    return None
-
-
-def _has_timeout(call: ast.Call, kind: str) -> bool:
-    if any(kw.arg == "timeout" for kw in call.keywords):
-        return True
-    pos = _TIMEOUT_POS.get(kind)
-    return pos is not None and len(call.args) > pos
-
-
-def _in_scope(ctx: FileContext) -> bool:
-    p = ctx.path
-    if p.endswith("workloads/serve.py") or \
-            p.endswith("workloads/continuous.py"):
+def _path_in_scope(path: str) -> bool:
+    """ONE scope predicate for both the per-file gate and the
+    origin-side skip below — a file added to one but not the other
+    would be double-reported (direct finding at the origin plus a
+    call-site finding at every caller)."""
+    if path.endswith("workloads/serve.py") or \
+            path.endswith("workloads/continuous.py"):
         return True
     # any drive_*.py, wherever it lives (hack/ in the repo; tmp dirs in
     # the checker's own tests)
-    base = p.rsplit("/", 1)[-1]
+    base = path.rsplit("/", 1)[-1]
     return base.startswith("drive_") and base.endswith(".py")
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return _path_in_scope(ctx.path)
 
 
 def _run(ctx: FileContext) -> list[Diagnostic]:
     if not _in_scope(ctx):
         return []
     diags: list[Diagnostic] = []
+    program = ctx.program
+    enclosing = _effects.enclosing_class_map(ctx.tree)
+    seen: set[tuple] = set()
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
-        kind = _call_kind(node)
-        if kind is None or _has_timeout(node, kind):
+        name = _effects.net_call(node)
+        if name is not None:
+            diags.append(ctx.diag(
+                node, "deadline-hygiene",
+                f"outbound {name}() without an explicit timeout: a "
+                f"wedged peer blocks this thread forever (and turns an "
+                f"open-loop load generator into a closed loop); pass "
+                f"timeout=... or justify with "
+                f"# vet: ignore[deadline-hygiene]"))
             continue
-        diags.append(ctx.diag(
-            node, "deadline-hygiene",
-            f"outbound {_dotted(node.func) or kind}() without an "
-            f"explicit timeout: a wedged peer blocks this thread "
-            f"forever (and turns an open-loop load generator into a "
-            f"closed loop); pass timeout=... or justify with "
-            f"# vet: ignore[deadline-hygiene]"))
+        if program is None:
+            continue
+        dotted = lockset.token_of(node.func)
+        if dotted is None:
+            continue
+        summary = program.summary_for(ctx.path, enclosing.get(id(node)),
+                                      dotted)
+        if summary is None:
+            continue
+        for eff in summary.blocking():
+            if eff.kind != "net" or _path_in_scope(eff.path):
+                continue     # in-scope origins get the direct finding
+            octx = program.ctxs.get(eff.path)
+            if octx is not None and \
+                    octx.suppressed(eff.line, "deadline-hygiene"):
+                continue
+            key = (node.lineno, node.col_offset, eff.path, eff.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            via = _effects.chain_str(eff)
+            where = f"{eff.path}:{eff.line}" + (f" ({via})" if via
+                                                else "")
+            diags.append(ctx.diag(
+                node, "deadline-hygiene",
+                f"call to {dotted}() reaches {eff.detail} at {where} "
+                f"— the data plane must carry explicit timeouts even "
+                f"through helpers; pass timeout=... at the origin or "
+                f"justify there"))
     return diags
 
 
@@ -122,4 +124,5 @@ register(Analyzer(
         "drive harnesses must carry an explicit timeout",
     run=_run,
     scope=("tpu_dra/workloads", "hack"),
+    whole_program=True,
 ))

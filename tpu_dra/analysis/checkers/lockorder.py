@@ -32,9 +32,10 @@ graph against the same registry — see docs/static-analysis.md.
 from __future__ import annotations
 
 import ast
-import os
 
+from tpu_dra.analysis import effects as _effects
 from tpu_dra.analysis import lockset
+from tpu_dra.analysis.cfg import STMT, WITH_ENTER
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 from tpu_dra.analysis.lockregistry import (
     LEAF_LOCKS,
@@ -51,37 +52,19 @@ def _begin() -> None:
     _EDGES.clear()
 
 
-def _module_globals(tree: ast.Module) -> set[str]:
-    names: set[str] = set()
-    for stmt in tree.body:
-        targets = []
-        if isinstance(stmt, ast.Assign):
-            targets = stmt.targets
-        elif isinstance(stmt, ast.AnnAssign):
-            targets = [stmt.target]
-        for tgt in targets:
-            if isinstance(tgt, ast.Name):
-                names.add(tgt.id)
-    return names
-
-
-def _qualify(tok: str, cls: str | None, mod_globals: set[str],
-             modbase: str) -> str | None:
-    """Token -> graph node name, or None when the lock's identity cannot
-    be resolved statically (locals, cross-object attribute chains)."""
-    if tok.startswith("self.") and tok.count(".") == 1:
-        return f"{cls}.{tok[5:]}" if cls else None
-    if "." not in tok and tok in mod_globals:
-        return f"{modbase}.{tok}"
-    return None
+# lock naming is shared with the effect engine so call-propagated
+# acquisitions land on the same graph nodes as directly-observed ones
+_module_globals = _effects.module_globals
+_qualify = _effects.qualify_lock
 
 
 def _run(ctx: FileContext) -> list[Diagnostic]:
     if ctx.is_test():
         return []
     diags: list[Diagnostic] = []
-    modbase = os.path.splitext(ctx.path.rsplit("/", 1)[-1])[0]
+    modbase = _effects.modbase_of(ctx.path)
     mod_globals = _module_globals(ctx.tree)
+    program = ctx.program
     for func, cls in lockset.functions_in(ctx.tree):
         facts = lockset.analyze(ctx, func)
         for held, tok, node in facts.acquire_events():
@@ -100,6 +83,58 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
                 if q_new is not None and q_new != q_held:
                     _EDGES.setdefault((q_held, q_new), []).append(
                         f"{ctx.path}:{node.line}")
+        if program is None:
+            continue
+        # interprocedural: a call made while a lock is held contributes
+        # the callee's (transitive) acquisitions as order edges — the
+        # cross-function nesting the DECLARED_ORDERS registry used to
+        # paper over by hand
+        seen_call_edges: set[tuple] = set()
+        for node in facts.cfg.nodes:
+            if not facts.reachable(node) or \
+                    node.kind not in (STMT, WITH_ENTER):
+                continue
+            held = facts.lockset(node)
+            if not held:
+                continue
+            q_held_set = [q for q in
+                          (_qualify(h, cls, mod_globals, modbase)
+                           for h in held) if q is not None]
+            if not q_held_set:
+                continue
+            for tree in node.scan_asts():
+                for sub in lockset.walk_scan(tree):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = lockset.token_of(sub.func)
+                    if dotted is None:
+                        continue
+                    summary = program.summary_for(ctx.path, cls, dotted)
+                    if summary is None:
+                        continue
+                    for inner, (opath, oline, _chain) in \
+                            sorted(summary.acquires.items()):
+                        octx = program.ctxs.get(opath)
+                        if octx is not None and octx.suppressed(
+                                oline, "lock-order"):
+                            continue
+                        for q_held in q_held_set:
+                            if inner == q_held:
+                                continue
+                            key = (q_held, inner, sub.lineno)
+                            if key in seen_call_edges:
+                                continue
+                            seen_call_edges.add(key)
+                            if q_held in LEAF_LOCKS:
+                                diags.append(ctx.diag(
+                                    sub.lineno, "lock-order",
+                                    f"call to {dotted}() while holding "
+                                    f"leaf lock {q_held} acquires "
+                                    f"{inner} ({opath}:{oline}) "
+                                    f"({LEAF_LOCKS[q_held]})"))
+                            _EDGES.setdefault(
+                                (q_held, inner), []).append(
+                                f"{ctx.path}:{sub.lineno}")
     return diags
 
 
@@ -139,4 +174,5 @@ register(Analyzer(
     run=_run,
     begin=_begin,
     finish=_finish,
+    whole_program=True,
 ))

@@ -23,6 +23,16 @@ back.  Two rules over non-test ``tpu_dra/`` code, excluding
    attempt counts with no backoff, no jitter, no deadline.  Same
    remedy.
 
+3. **Interprocedural rule 1:** a loop-body call to a project function
+   whose effect summary (:mod:`tpu_dra.analysis.effects`) reaches a
+   ``time.sleep`` is the same pacing loop wearing a wrapper — flagged
+   at the call site, citing the sleep's origin and helper chain.
+   Sleeps originating inside ``tpu_dra/resilience/`` are exempt:
+   calling ``retry_call`` (which sleeps by design) in a loop IS the
+   sanctioned pattern.  A justified
+   ``# vet: ignore[retry-hygiene]`` at the sleep's origin covers every
+   caller.
+
 Overlaps rule 1 of ``reconcile-hygiene`` on its narrower scope by
 design: that checker says "make the wait interruptible", this one says
 "use the central policy"; a justified sleep needs both ignores, which
@@ -33,9 +43,17 @@ from __future__ import annotations
 
 import ast
 
+from tpu_dra.analysis import effects as _effects
+from tpu_dra.analysis import lockset
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 
 _EXEMPT = ("tpu_dra/resilience",)
+
+
+def _origin_exempt(path: str) -> bool:
+    """Effects born in the resilience layer are the sanctioned
+    primitives, not hand-rolled pacing."""
+    return f"/{_EXEMPT[0].strip('/')}/" in "/" + path.lstrip("/")
 
 
 def _is_time_sleep(node: ast.Call) -> bool:
@@ -88,14 +106,18 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
     if ctx.is_test() or ctx.in_dir(*_EXEMPT):
         return []
     diags: list[Diagnostic] = []
-    flagged_sleeps: set[tuple[int, int]] = set()
+    flagged_sleeps: set[tuple] = set()
+    program = ctx.program
+    enclosing = _effects.enclosing_class_map(ctx.tree)
     for node in ast.walk(ctx.tree):
         if isinstance(node, (ast.While, ast.For)):
             # through_loops=True: a sleep anywhere under the loop nest
             # still paces the outer loop; nested defs are excluded.
             # The seen-set keeps a sleep in nested loops to ONE finding.
             for sub in _walk_same_iteration(node, through_loops=True):
-                if isinstance(sub, ast.Call) and _is_time_sleep(sub):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_time_sleep(sub):
                     key = (sub.lineno, sub.col_offset)
                     if key in flagged_sleeps:
                         continue
@@ -106,6 +128,38 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
                         "tpu_dra.resilience.retry.retry_call (jittered "
                         "backoff, deadline, typed classification) or "
                         "justify with # vet: ignore[retry-hygiene]"))
+                    continue
+                if program is None:
+                    continue
+                dotted = lockset.token_of(sub.func)
+                if dotted is None:
+                    continue
+                cls = enclosing.get(id(sub))
+                summary = program.summary_for(ctx.path, cls, dotted)
+                if summary is None:
+                    continue
+                for eff in summary.blocking():
+                    if eff.kind != "sleep" or _origin_exempt(eff.path):
+                        continue
+                    octx = program.ctxs.get(eff.path)
+                    if octx is not None and \
+                            octx.suppressed(eff.line, "retry-hygiene"):
+                        continue
+                    key = (sub.lineno, sub.col_offset, eff.path,
+                           eff.line)
+                    if key in flagged_sleeps:
+                        continue
+                    flagged_sleeps.add(key)
+                    via = _effects.chain_str(eff)
+                    where = f"{eff.path}:{eff.line}" + \
+                        (f" ({via})" if via else "")
+                    diags.append(ctx.diag(
+                        sub, "retry-hygiene",
+                        f"loop-body call to {dotted}() reaches "
+                        f"time.sleep() at {where} — a pacing loop "
+                        f"wearing a wrapper; use "
+                        f"tpu_dra.resilience.retry.retry_call or "
+                        f"justify at the sleep's origin"))
         if isinstance(node, ast.For) and _is_range_loop(node):
             # through_loops=False: an except/continue inside a nested
             # DATA loop targets that loop, not the attempt counter
@@ -128,4 +182,5 @@ register(Analyzer(
         "hand-rolled time.sleep or range() attempt loops",
     run=_run,
     scope=("tpu_dra",),
+    whole_program=True,
 ))

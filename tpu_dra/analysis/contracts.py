@@ -1,0 +1,731 @@
+"""Cross-binary contract registry: extraction + drift computation.
+
+The four binaries (controller, kubelet plugin, slice daemon, workload
+launcher/serve) compose through *stringly-typed* contracts: env vars
+set by CDI edits and read by the launcher, ``nodes_config.json`` wire
+fields, metric names vs the docs catalog, failpoint names vs the
+resilience catalog and the names chaos drives arm, Event reasons vs
+the tests that assert them, CRD fields vs the helm manifests.  Nothing
+type-checks these — a typo on either side is a silent no-op that ships.
+This module extracts both sides of every such pair from the tree and
+reports ONE-SIDED contracts through the ``contract-drift`` checker.
+
+Surfaces and their extraction rules (deliberately narrow — each rule
+matches the one idiom the repo actually uses):
+
+- **env** — writes: ``os.environ["X"] =``, ``<edits>.env["X"] =``,
+  env-dict literals (assigned to ``*env*`` names, passed as
+  ``env=``/``common_env=``, or ``.update()``-ed into an env object);
+  reads: ``os.environ.get/[]``, ``os.getenv``, and ``.get("X")`` on
+  receivers named ``env``/``environ``/``e``.  Only ALL_CAPS names with
+  an underscore count.  Vars produced by the outside world (kubelet,
+  downward API, operators) are declared in :data:`EXTERNAL_ENV`; vars
+  exported for out-of-tree consumers (libtpu, JAX, container runtimes)
+  in :data:`EXPORTED_ENV` — the how-to-declare recipe is in
+  docs/static-analysis.md.
+- **wire channels** — a function carrying ``# contract: <name>[writer]``
+  (or ``[reader]``) on its def header contributes the string keys it
+  writes (dict keys, ``out["k"] =``) or reads (``.get("k")``,
+  ``["k"]``) to the named channel; one-sided keys across the whole
+  program are drift.  ``nodes-config`` is the seed channel.
+- **metrics** — registrations (``.counter/.gauge/.histogram("tpu_…")``
+  and metric-shaped dict keys, the serve.py gauge-table idiom) vs the
+  docs/observability.md catalog (bullets marked REMOVED are migration
+  notes, not live contract).
+- **failpoints** — ``register()`` vs ``hit()`` vs the names armed in
+  drives/tests (``name=action`` terms) vs the docs/resilience.md
+  catalog table.
+- **events** — reasons passed to ``emit_event`` or built as
+  ``events.append(("Reason", …))`` tuples vs the tests/drives that
+  assert them.
+- **CRD fields** — camel/lower field literals in ``api/types.py``
+  (the one wire surface; the controller reads through it) vs the CRD
+  schema properties in ``deployments/**/crds/*.yaml``.
+
+Doc/manifest catalogs and the tests/hack aux scan are resolved from the
+repo root, detected as the nearest ancestor of any analyzed file that
+contains a ``docs`` directory — absent (bare fixture trees), the
+doc-side passes silently skip, which also keeps every pre-existing
+checker fixture inert under the new checker unless it opts in by
+shipping a ``docs/`` dir.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from tpu_dra.analysis.callgraph import dotted_of
+
+__all__ = ["extract_file", "Registry", "detect_root",
+           "EXTERNAL_ENV", "EXPORTED_ENV"]
+
+_ENV_RE = re.compile(r"^[A-Z][A-Z0-9]*(?:_[A-Z0-9]+)+$")
+_METRIC_RE = re.compile(r"^tpu_[a-z0-9]+(?:_[a-z0-9]+)+$")
+_FP_RE = re.compile(r"^[a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+$")
+_REASON_RE = re.compile(r"^[A-Z][a-z][a-zA-Z0-9]+$")
+_KEY_RE = re.compile(r"^[a-z][a-zA-Z0-9]*$")
+_CONTRACT_RE = re.compile(
+    r"#\s*contract:\s*([a-z0-9-]+)\[(reader|writer)\]")
+_ARM_RE = re.compile(
+    r"([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)=(?:\d+\*)?"
+    r"(?:crash|error|sleep|stall)")
+_DOC_METRIC_RE = re.compile(r"`(tpu_[a-z0-9_]+)")
+_DOC_IGNORE = "vet: ignore[contract-drift]"
+
+# Environment variables the outside world produces: reading them without
+# an in-tree writer is the contract working as designed.  Keep the WHY.
+EXTERNAL_ENV: dict[str, str] = {
+    "KUBERNETES_SERVICE_HOST": "kubelet-injected API endpoint",
+    "KUBERNETES_SERVICE_PORT": "kubelet-injected API endpoint",
+    "NODE_NAME": "downward-API fieldRef on every driver pod",
+    "POD_IP": "downward-API fieldRef (daemon/launcher identity)",
+    "HOSTNAME": "container runtime default",
+    "JAX_PLATFORMS": "operator/test harness backend override",
+    "TPU_DRA_FAILPOINTS": "operator chaos knob (resilience catalog)",
+    "TPU_DRA_FAILPOINTS_FILE": "operator chaos knob (live plan file)",
+    "TPU_DRA_LOCKDEP": "operator debug knob (runtime lockdep)",
+    "TPU_DRA_LOCKDEP_REPORT": "operator debug knob (lockdep dump path)",
+    "TPU_DRA_BREAKER_THRESHOLD": "operator tuning knob (breaker.py)",
+    "TPU_DRA_BREAKER_OPEN_SECONDS": "operator tuning knob (breaker.py)",
+    "TPU_DRA_VET_CACHE": "vet driver cache path (Makefile)",
+    "MEMBERSHIP_HEARTBEAT_INTERVAL": "operator tuning knob (daemon)",
+    "MEMBERSHIP_HEARTBEAT_MODE": "rollout knob: lease|status|dual",
+    "TPUDRA_NO_BUILD": "dev knob: skip the native build",
+    "TPUDRA_NATIVE_LIB": "dev knob: prebuilt libtpudra.so path",
+    "SLICE_COORDD": "dev knob: coordd binary override",
+    "SLICE_COORDD_NATIVE": "dev knob: native coordd toggle",
+    "TPU_DRA_VERSION": "build-injected version stamp",
+    "ELASTIC_STEP_TIME": "drive/test pacing knob (workloads/elastic)",
+    "PALLAS_AXON_POOL_IPS": "bench-host sitecustomize toggle",
+    "HEALTH_FAIL_THRESHOLD": "operator tuning knob (daemon health)",
+    "HEALTH_PASS_THRESHOLD": "operator tuning knob (daemon health)",
+    "TPU_HEALTH_HEARTBEAT_FILE": "manual/test override: one explicit "
+                                 "beat file wins over the claim dir",
+    "TPU_DRA_SHIM_TRIGGERS": "operator knob: launcher shim trigger list",
+    "MEGASCALE_COORDINATOR_PORT": "operator port override (multislice)",
+    "JAX_COORDINATOR_ADDRESS": "operator override: full rendezvous "
+                               "triple bypasses the claim env",
+    "JAX_NUM_PROCESSES": "operator override (with JAX_COORDINATOR_*)",
+    "JAX_PROCESS_ID": "operator override (with JAX_COORDINATOR_*)",
+    "MEGASCALE_NUM_SLICES": "operator override (multislice triple)",
+    "MEGASCALE_SLICE_ID": "operator override (multislice triple)",
+    "MEGASCALE_COORDINATOR_ADDRESS": "operator override (multislice)",
+}
+
+# Environment variables written for OUT-OF-TREE consumers: libtpu, JAX,
+# the container runtime, or the workload image.  Writing them with no
+# in-tree reader is the contract working as designed.
+EXPORTED_ENV: dict[str, str] = {
+    "TPU_DRA_MANAGED": "CDI marker for workload images/debugging",
+    "TPU_ALLOW_MULTIPLE_LIBTPU_LOAD": "consumed by libtpu",
+    "LIBTPU_INIT_ARGS": "consumed by libtpu",
+    "TPU_VISIBLE_CHIPS": "consumed by libtpu (visibility scoping)",
+    "TPU_VISIBLE_DEVICES": "consumed by libtpu (legacy spelling)",
+    "MEGASCALE_NUM_SLICES": "consumed by libtpu multislice init",
+    "MEGASCALE_SLICE_ID": "consumed by libtpu multislice init",
+    "MEGASCALE_COORDINATOR_ADDRESS": "consumed by libtpu multislice",
+    "JAX_COORDINATOR_ADDRESS": "consumed by jax.distributed",
+    "JAX_NUM_PROCESSES": "consumed by jax.distributed",
+    "JAX_PROCESS_ID": "consumed by jax.distributed",
+    "JAX_COORDINATION_SERVICE": "consumed by JAX coordination-service "
+                                "resolution in the workload container",
+    "TPU_FABRIC_ID": "claim's ICI fabric id, exported for workload "
+                     "introspection/debugging",
+    "TPU_CHIPS_PER_PROCESS_BOUNDS": "consumed by libtpu (topology "
+                                    "bounds)",
+    "TPU_PROCESS_BOUNDS": "consumed by libtpu (topology bounds)",
+}
+
+# standard k8s condition keys: the CRD schema leaves conditions as
+# x-kubernetes-preserve-unknown-fields (metav1.Condition shape)
+_CRD_META = {
+    "apiVersion", "kind", "metadata", "namespace", "uid", "items",
+    "finalizers", "deletionTimestamp", "resourceVersion", "labels",
+    "annotations", "generation",
+    "type", "status", "reason", "message", "lastTransitionTime",
+    "observedGeneration",
+}
+
+_ENV_RECEIVERS = {"env", "environ", "e", "_env"}
+
+
+def _dotted(node: ast.AST) -> str:
+    return dotted_of(node) or ""
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_env_receiver(dotted: str) -> bool:
+    return dotted.endswith("environ") or dotted in _ENV_RECEIVERS
+
+
+def _is_env_sink(dotted: str) -> bool:
+    """A thing whose string-keyed writes are env writes."""
+    return dotted.endswith("environ") or dotted.endswith(".env") \
+        or dotted in ("env", "_env")
+
+
+def _contract_markers(ctx, func: ast.AST) -> list[tuple[str, str]]:
+    """``# contract: name[role]`` declarations on the def header."""
+    body = getattr(func, "body", None)
+    if not body:
+        return []
+    out = []
+    for line in range(func.lineno, body[0].lineno):
+        m = _CONTRACT_RE.search(ctx.comment_on(line))
+        if m:
+            out.append((m.group(1), m.group(2)))
+    return out
+
+
+def _wire_keys(func: ast.AST, role: str) -> list[tuple[str, int]]:
+    """String keys the marked function writes/reads, per role.  Plain
+    ``ast.walk``: sort-key lambdas and local helpers inside a marked
+    function are part of its contract surface."""
+    out: list[tuple[str, int]] = []
+    seen: set[str] = set()
+
+    def add(key: Optional[str], line: int) -> None:
+        if key and _KEY_RE.match(key) and key not in seen:
+            seen.add(key)
+            out.append((key, line))
+
+    for sub in ast.walk(func):
+        if role == "writer":
+            if isinstance(sub, ast.Dict):
+                for k in sub.keys:
+                    if k is not None:
+                        add(_str_const(k), k.lineno)
+            elif isinstance(sub, ast.Subscript) and \
+                    isinstance(sub.ctx, ast.Store):
+                add(_str_const(sub.slice), sub.lineno)
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id == "dict":
+                # dict(base, rank=i, sliceID=...) — keyword keys are
+                # written fields too
+                for kw in sub.keywords:
+                    if kw.arg:
+                        add(kw.arg, sub.lineno)
+        else:
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "get" and sub.args:
+                add(_str_const(sub.args[0]), sub.lineno)
+            elif isinstance(sub, ast.Subscript) and \
+                    isinstance(sub.ctx, ast.Load):
+                add(_str_const(sub.slice), sub.lineno)
+    return out
+
+
+def _env_dicts(tree: ast.Module) -> list[ast.Dict]:
+    """Dict literals in env-producing positions: assigned to ``*env*``
+    names, passed as ``env=``/``common_env=``/``environ=`` kwargs,
+    ``.update()``-ed into an env receiver, or anywhere inside a
+    function whose NAME says it builds env (``megascale_env``-style
+    builders that return the dict)."""
+    from tpu_dra.analysis import lockset
+
+    out: list[ast.AST] = []
+    for func, _cls in lockset.functions_in(tree):
+        if "env" not in func.name.lower():
+            continue
+        for sub in lockset.walk_scan(func):
+            if isinstance(sub, (ast.Dict, ast.Subscript)):
+                out.append(sub)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Dict) and any(
+                    isinstance(t, ast.Name) and "env" in t.id.lower()
+                    for t in node.targets):
+                out.append(node.value)
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and "env" in kw.arg.lower() and \
+                        isinstance(kw.value, ast.Dict):
+                    out.append(kw.value)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("update", "setdefault") and \
+                    _is_env_sink(_dotted(node.func.value)):
+                if node.args and isinstance(node.args[0], ast.Dict):
+                    out.append(node.args[0])
+    return out
+
+
+def extract_file(ctx) -> dict:
+    """The serializable contract facts of one Python file."""
+    rec: dict = {"env_reads": [], "env_writes": [], "metric_regs": [],
+                 "fp_registers": [], "fp_hits": [], "fp_arms": [],
+                 "event_emits": [], "wire": {}, "crd_refs": []}
+    if ctx.is_test():
+        return rec
+    tree = ctx.tree
+    env_dict_nodes = {id(d) for d in _env_dicts(tree)}
+    is_types = ctx.path.endswith("api/types.py")
+
+    for node in ast.walk(tree):
+        # ---- env reads / writes ---------------------------------------
+        if isinstance(node, ast.Call):
+            fn = node.func
+            dotted = _dotted(fn)
+            if dotted.endswith("os.getenv") or dotted == "getenv":
+                name = _str_const(node.args[0]) if node.args else None
+                if name and _ENV_RE.match(name):
+                    rec["env_reads"].append([name, node.lineno])
+            elif isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                    and node.args and _is_env_receiver(_dotted(fn.value)):
+                name = _str_const(node.args[0])
+                if name and _ENV_RE.match(name):
+                    rec["env_reads"].append([name, node.lineno])
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr == "setdefault" and node.args and \
+                    _is_env_sink(_dotted(fn.value)):
+                name = _str_const(node.args[0])
+                if name and _ENV_RE.match(name):
+                    rec["env_writes"].append([name, node.lineno])
+            # ---- metric registrations ---------------------------------
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("counter", "gauge", "histogram") \
+                    and node.args:
+                name = _str_const(node.args[0])
+                if name and _METRIC_RE.match(name):
+                    rec["metric_regs"].append([name, node.lineno])
+            # ---- failpoints -------------------------------------------
+            last = dotted.rsplit(".", 1)[-1] if dotted else ""
+            if last == "register" and node.args:
+                name = _str_const(node.args[0])
+                if name and _FP_RE.match(name):
+                    rec["fp_registers"].append([name, node.lineno])
+            elif last == "hit" and node.args:
+                name = _str_const(node.args[0])
+                if name and _FP_RE.match(name):
+                    rec["fp_hits"].append([name, node.lineno])
+            elif last in ("activate", "arm") and node.args:
+                term = _str_const(node.args[0])
+                if term:
+                    for m in _ARM_RE.finditer(term):
+                        rec["fp_arms"].append([m.group(1), node.lineno])
+            # ---- event reasons ----------------------------------------
+            if last == "emit_event":
+                reason = None
+                if len(node.args) >= 3:
+                    reason = _str_const(node.args[2])
+                for kw in node.keywords:
+                    if kw.arg == "reason":
+                        reason = _str_const(kw.value)
+                if reason and _REASON_RE.match(reason):
+                    rec["event_emits"].append([reason, node.lineno])
+            elif last == "append" and isinstance(fn, ast.Attribute) \
+                    and _dotted(fn.value).endswith("events") \
+                    and node.args and isinstance(node.args[0], ast.Tuple) \
+                    and node.args[0].elts:
+                reason = _str_const(node.args[0].elts[0])
+                if reason and _REASON_RE.match(reason):
+                    rec["event_emits"].append([reason, node.lineno])
+        elif isinstance(node, ast.Subscript):
+            recv = _dotted(node.value)
+            name = _str_const(node.slice)
+            if name and _ENV_RE.match(name):
+                if isinstance(node.ctx, ast.Store) and \
+                        (_is_env_sink(recv) or
+                         id(node) in env_dict_nodes):
+                    rec["env_writes"].append([name, node.lineno])
+                elif isinstance(node.ctx, ast.Load) and \
+                        _is_env_receiver(recv):
+                    rec["env_reads"].append([name, node.lineno])
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                key = _str_const(k) if k is not None else None
+                if key is None:
+                    continue
+                if _METRIC_RE.match(key):
+                    rec["metric_regs"].append([key, k.lineno])
+                if _ENV_RE.match(key) and id(node) in env_dict_nodes:
+                    rec["env_writes"].append([key, k.lineno])
+
+        # ---- CRD field references (api/types.py only) -----------------
+        if is_types:
+            key = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args:
+                key = _str_const(node.args[0])
+            elif isinstance(node, ast.Subscript):
+                key = _str_const(node.slice)
+            elif isinstance(node, ast.Dict):
+                for k in node.keys:
+                    kk = _str_const(k) if k is not None else None
+                    if kk and _KEY_RE.match(kk) and kk not in _CRD_META:
+                        rec["crd_refs"].append([kk, node.lineno])
+            if key and _KEY_RE.match(key) and key not in _CRD_META:
+                rec["crd_refs"].append([key, node.lineno])
+
+    # ---- declared wire channels ---------------------------------------
+    from tpu_dra.analysis import lockset
+
+    for func, _cls in lockset.functions_in(tree):
+        for channel, role in _contract_markers(ctx, func):
+            bucket = rec["wire"].setdefault(channel, {})
+            keys = bucket.setdefault(role, [])
+            for key, line in _wire_keys(func, role):
+                keys.append([key, line])
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# repo-root resolution + doc/manifest catalogs + aux scans
+# ---------------------------------------------------------------------------
+
+def detect_root(paths) -> Optional[str]:
+    """Nearest ancestor of any analyzed file containing a ``docs``
+    directory — the repo root for catalog/manifest/aux lookups.  None
+    when no such ancestor exists (bare fixture trees: doc-side passes
+    skip)."""
+    for path in paths:
+        cur = os.path.dirname(os.path.abspath(path))
+        while True:
+            if os.path.isdir(os.path.join(cur, "docs")):
+                return cur
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+    return None
+
+
+def _display(root: str, *parts: str) -> str:
+    full = os.path.join(root, *parts)
+    rel = os.path.relpath(full)
+    return rel if not rel.startswith("..") else full
+
+
+def metrics_catalog(root: str) -> dict[str, int]:
+    """Live metric names documented in docs/observability.md -> line.
+    Bullets marked REMOVED (deprecation migration notes) and lines
+    carrying a contract-drift ignore are skipped."""
+    path = os.path.join(root, "docs", "observability.md")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}
+    out: dict[str, int] = {}
+    bullet: list[tuple[int, str]] = []
+
+    def flush():
+        text = " ".join(t for _, t in bullet)
+        if "REMOVED" in text or _DOC_IGNORE in text:
+            return
+        for lineno, t in bullet:
+            for m in _DOC_METRIC_RE.finditer(t):
+                name = m.group(1)
+                if _METRIC_RE.match(name):
+                    out.setdefault(name, lineno)
+
+    for i, line in enumerate(lines, 1):
+        if line.lstrip().startswith("- ") or not line.startswith(" "):
+            flush()
+            bullet = [(i, line)]
+        else:
+            bullet.append((i, line))
+    flush()
+    return out
+
+
+def failpoint_catalog(root: str) -> dict[str, int]:
+    """Failpoint names in the docs/resilience.md catalog section ->
+    line; the compressed ``a.b.c/d/e`` table form expands."""
+    path = os.path.join(root, "docs", "resilience.md")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}
+    out: dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(lines, 1):
+        if line.startswith("## "):
+            in_section = "failpoint catalog" in line.lower()
+            continue
+        if not in_section or _DOC_IGNORE in line:
+            continue
+        for tok in re.findall(r"`([^`]+)`", line):
+            for part_group in tok.split(","):
+                segs = part_group.strip().split("/")
+                if not segs or "." not in segs[0] or \
+                        not _FP_RE.match(segs[0]):
+                    continue
+                out.setdefault(segs[0], i)
+                prefix = segs[0].rsplit(".", 1)[0]
+                for seg in segs[1:]:
+                    name = seg if "." in seg else f"{prefix}.{seg}"
+                    if _FP_RE.match(name):
+                        out.setdefault(name, i)
+    return out
+
+
+def crd_properties(root: str) -> dict[str, tuple[str, int]]:
+    """Schema property names in every CRD manifest -> (path, line).
+    Textual indent-stack parse so findings carry real line numbers (and
+    no yaml dependency)."""
+    import glob
+
+    out: dict[str, tuple[str, int]] = {}
+    pattern = os.path.join(root, "deployments", "**", "crds", "*.yaml")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            continue
+        disp = _display(root, os.path.relpath(path, root))
+        stack: list[tuple[int, str]] = []   # (indent, key)
+        for i, line in enumerate(lines, 1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            # required: ["a", "b"] lists field names too — matched
+            # BEFORE the generic key regex, which the spaced spelling
+            # (`required: [...]`) also satisfies; `required` itself is
+            # a schema keyword, not a property, and stays off the stack
+            rm = re.match(r"^required:\s*\[(.*)\]", stripped)
+            if rm:
+                for name in re.findall(r'"([A-Za-z0-9]+)"',
+                                       rm.group(1)):
+                    out.setdefault(name, (disp, i))
+                continue
+            m = re.match(r"^([A-Za-z][A-Za-z0-9]*):(\s|$)", stripped)
+            if not m:
+                continue
+            indent = len(line) - len(line.lstrip())
+            while stack and stack[-1][0] >= indent:
+                stack.pop()
+            key = m.group(1)
+            if stack and stack[-1][1] == "properties":
+                out.setdefault(key, (disp, i))
+            stack.append((indent, key))
+    return out
+
+
+def scan_aux(root: str) -> dict:
+    """Raw-text scan of hack/ + tests/: failpoint arm terms (hack/
+    only: drives arming a typo is the silent-no-op footgun, while tests
+    routinely arm ad-hoc fixture names they register — or deliberately
+    don't — at runtime), quoted ALL_CAPS env mentions in hack (drives
+    are legitimate env producers), and the full text for event-reason
+    assertion checks."""
+    arms: dict[str, tuple[str, int]] = {}
+    registers: set[str] = set()
+    hack_env: dict[str, tuple[str, int]] = {}
+    texts: list[str] = []
+    for sub in ("hack", "tests"):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fname)
+                try:
+                    with open(fpath, encoding="utf-8") as fh:
+                        text = fh.read()
+                except OSError:
+                    continue
+                texts.append(text)
+                disp = _display(root, os.path.relpath(fpath, root))
+                # tests register their own fixture failpoints at
+                # runtime; count those registrations so arming them is
+                # not misread as drift (register( and the name may be
+                # on different lines — scan the whole text)
+                for m in re.finditer(
+                        r'register\(\s*["\']([a-z0-9_.]+)["\']', text):
+                    registers.add(m.group(1))
+                for i, line in enumerate(text.splitlines(), 1):
+                    if sub != "hack":
+                        continue
+                    for m in _ARM_RE.finditer(line):
+                        arms.setdefault(m.group(1), (disp, i))
+                    for m in re.finditer(
+                            r'["\']([A-Z][A-Z0-9]*(?:_[A-Z0-9]+)+)'
+                            r'["\']', line):
+                        hack_env.setdefault(m.group(1), (disp, i))
+    return {"arms": arms, "registers": registers, "hack_env": hack_env,
+            "texts": texts}
+
+
+# ---------------------------------------------------------------------------
+# the registry + drift computation
+# ---------------------------------------------------------------------------
+
+class Registry:
+    """Aggregated contract facts for one Program, plus the doc-side
+    catalogs; :meth:`drift` yields the one-sided findings."""
+
+    def __init__(self, program):
+        self.program = program
+        # name -> (path, line): first site wins per side
+        self.env_reads: dict[str, tuple[str, int]] = {}
+        self.env_writes: dict[str, tuple[str, int]] = {}
+        self.metric_regs: dict[str, tuple[str, int]] = {}
+        self.fp_registers: dict[str, tuple[str, int]] = {}
+        self.fp_hits: dict[str, tuple[str, int]] = {}
+        self.fp_arms: dict[str, tuple[str, int]] = {}
+        self.event_emits: dict[str, tuple[str, int]] = {}
+        self.crd_refs: dict[str, tuple[str, int]] = {}
+        # channel -> role -> key -> (path, line)
+        self.wire: dict[str, dict[str, dict[str, tuple[str, int]]]] = {}
+        for path, rec in sorted(program.facts.items()):
+            c = rec["contracts"]
+            for field, dst in (("env_reads", self.env_reads),
+                               ("env_writes", self.env_writes),
+                               ("metric_regs", self.metric_regs),
+                               ("fp_registers", self.fp_registers),
+                               ("fp_hits", self.fp_hits),
+                               ("fp_arms", self.fp_arms),
+                               ("event_emits", self.event_emits),
+                               ("crd_refs", self.crd_refs)):
+                for name, line in c[field]:
+                    dst.setdefault(name, (path, line))
+            for channel, roles in c["wire"].items():
+                ch = self.wire.setdefault(channel, {})
+                for role, keys in roles.items():
+                    side = ch.setdefault(role, {})
+                    for key, line in keys:
+                        side.setdefault(key, (path, line))
+
+    def drift(self, root: Optional[str]) -> list[tuple]:
+        """One-sided contracts: ``(path, line, message)`` tuples.  Doc
+        and manifest catalogs only participate when ``root`` resolved."""
+        out: list[tuple] = []
+
+        def say(site: tuple[str, int], msg: str) -> None:
+            out.append((site[0], site[1], msg))
+
+        aux = scan_aux(root) if root else \
+            {"arms": {}, "registers": set(), "hack_env": {}, "texts": []}
+
+        # ---- env ------------------------------------------------------
+        produced = set(self.env_writes) | set(EXTERNAL_ENV) | \
+            set(aux["hack_env"])
+        consumed = set(self.env_reads) | set(EXPORTED_ENV) | \
+            set(aux["hack_env"])
+        for name in sorted(set(self.env_writes) - consumed):
+            say(self.env_writes[name],
+                f"env var {name} is written here but never read by any "
+                f"binary, drive, or declared out-of-tree consumer — "
+                f"dead contract or missing consumer; declare it in "
+                f"EXPORTED_ENV (analysis/contracts.py) if something "
+                f"outside the tree reads it")
+        for name in sorted(set(self.env_reads) - produced):
+            say(self.env_reads[name],
+                f"env var {name} is read here but nothing in the tree "
+                f"(CDI edits, launcher, drives) writes it and it is not "
+                f"declared in EXTERNAL_ENV (analysis/contracts.py) — "
+                f"phantom contract or missing producer")
+
+        # ---- wire channels --------------------------------------------
+        for channel, roles in sorted(self.wire.items()):
+            writers = roles.get("writer", {})
+            readers = roles.get("reader", {})
+            if not writers or not readers:
+                continue    # one side not in this run: can't judge
+            for key in sorted(set(writers) - set(readers)):
+                r_path, r_line = next(iter(sorted(readers.values())))
+                say(writers[key],
+                    f"wire field {key!r} of channel {channel!r} is "
+                    f"written here but no declared reader (e.g. "
+                    f"{r_path}:{r_line}) ever reads it")
+            for key in sorted(set(readers) - set(writers)):
+                w_path, w_line = next(iter(sorted(writers.values())))
+                say(readers[key],
+                    f"wire field {key!r} of channel {channel!r} is read "
+                    f"here but the declared writer ({w_path}:{w_line}) "
+                    f"never writes it")
+
+        # ---- metrics vs the docs catalog ------------------------------
+        if root:
+            catalog = metrics_catalog(root)
+            if catalog:
+                doc_path = _display(root, "docs", "observability.md")
+                for name in sorted(set(self.metric_regs) - set(catalog)):
+                    say(self.metric_regs[name],
+                        f"metric {name} is registered here but missing "
+                        f"from the {doc_path} catalog — document it or "
+                        f"drop the series")
+                for name in sorted(set(catalog) - set(self.metric_regs)):
+                    out.append((doc_path, catalog[name],
+                                f"metric {name} is documented here but "
+                                f"never registered by any binary — "
+                                f"stale catalog entry"))
+
+        # ---- failpoints ----------------------------------------------
+        regs = set(self.fp_registers)
+        for name in sorted(set(self.fp_hits) - regs):
+            say(self.fp_hits[name],
+                f"failpoint {name!r} is hit here but never registered "
+                f"— the hit is a permanent no-op")
+        for name in sorted(regs - set(self.fp_hits)):
+            say(self.fp_registers[name],
+                f"failpoint {name!r} is registered here but no code "
+                f"path ever hits it — dead injection point")
+        armed = dict(self.fp_arms)
+        for name, site in aux["arms"].items():
+            armed.setdefault(name, site)
+        for name in sorted(set(armed) - regs - aux["registers"]):
+            out.append((armed[name][0], armed[name][1],
+                        f"failpoint {name!r} is armed here but never "
+                        f"registered — the chaos injection silently "
+                        f"no-ops"))
+        if root:
+            catalog = failpoint_catalog(root)
+            if catalog:
+                doc_path = _display(root, "docs", "resilience.md")
+                for name in sorted(regs - set(catalog)):
+                    say(self.fp_registers[name],
+                        f"failpoint {name!r} is registered here but "
+                        f"missing from the {doc_path} catalog table")
+                for name in sorted(set(catalog) - regs):
+                    out.append((doc_path, catalog[name],
+                                f"failpoint {name!r} is documented in "
+                                f"the catalog but never registered"))
+
+        # ---- event reasons -------------------------------------------
+        if root and aux["texts"]:
+            blob = "\n".join(aux["texts"])
+            for reason in sorted(self.event_emits):
+                if f'"{reason}"' not in blob and \
+                        f"'{reason}'" not in blob:
+                    say(self.event_emits[reason],
+                        f"Event reason {reason!r} is emitted here but "
+                        f"never asserted by any test or drive — "
+                        f"unobserved telemetry")
+
+        # ---- CRD fields vs the manifests ------------------------------
+        if root and self.crd_refs:
+            props = crd_properties(root)
+            if props:
+                for name in sorted(set(self.crd_refs) - set(props)):
+                    say(self.crd_refs[name],
+                        f"CRD field {name!r} is referenced here but "
+                        f"absent from the CRD schema properties — the "
+                        f"API server prunes it on structural CRDs")
+                # _CRD_META names are excluded from BOTH sides: they
+                # double as standard condition keys, so their code
+                # references were never collected
+                for name in sorted(set(props) - set(self.crd_refs)
+                                   - _CRD_META):
+                    path, line = props[name]
+                    out.append((path, line,
+                                f"CRD schema property {name!r} is never "
+                                f"referenced by api/types.py — dead "
+                                f"schema surface"))
+        return out

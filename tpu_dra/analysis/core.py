@@ -83,6 +83,9 @@ class FileContext:
         self.comments: dict[int, str] = {}
         self.suppressions: dict[int, set[str]] = {}
         self.holds: dict[int, list[str]] = {}
+        # the whole-program layer; run_paths attaches it after every
+        # file has parsed (None for contexts built outside the driver)
+        self.program = None
         self._scan_comments()
 
     # -- comments / suppressions ---------------------------------------
@@ -169,6 +172,9 @@ class Analyzer:
     # file (the lock-order cycle check lives there)
     begin: Optional[Callable[[], None]] = None
     finish: Optional[Callable[[], "list[Diagnostic]"]] = None
+    # consumes ctx.program (summaries/contracts): the driver only pays
+    # for the whole-program extraction when a selected checker does
+    whole_program: bool = False
 
 
 _REGISTRY: dict[str, Analyzer] = {}
@@ -208,8 +214,17 @@ def collect_files(paths: Iterable[str]) -> list[str]:
 
 
 def run_paths(paths: Iterable[str],
-              checks: Optional[Iterable[str]] = None) -> list[Diagnostic]:
-    """The vet driver: parse each file once, run every analyzer on it."""
+              checks: Optional[Iterable[str]] = None,
+              cache_path: Optional[str] = None,
+              timings: Optional[dict] = None) -> list[Diagnostic]:
+    """The vet driver, in two phases: parse EVERY file first and build
+    the whole-program layer (call graph, effect summaries, contract
+    facts — :class:`tpu_dra.analysis.callgraph.Program`, reachable from
+    each context as ``ctx.program``), then fan out to the analyzers.
+    ``cache_path`` persists per-file facts mtime-keyed between runs;
+    ``timings`` (a dict) receives per-checker wall seconds."""
+    import time as _time
+
     wanted = set(checks) if checks is not None else None
     analyzers = [a for a in all_analyzers()
                  if wanted is None or a.name in wanted]
@@ -219,9 +234,14 @@ def run_paths(paths: Iterable[str],
             raise ValueError(
                 f"unknown check(s): {', '.join(sorted(unknown))}; "
                 f"known: {', '.join(a.name for a in all_analyzers())}")
-    for analyzer in analyzers:
-        if analyzer.begin is not None:
-            analyzer.begin()
+
+    def _lap(name: str, t0: float) -> float:
+        t1 = _time.perf_counter()
+        if timings is not None:
+            timings[name] = timings.get(name, 0.0) + (t1 - t0)
+        return t1
+
+    t0 = _time.perf_counter()
     diags: list[Diagnostic] = []
     ctxs: dict[str, FileContext] = {}
     for path in collect_files(paths):
@@ -236,19 +256,39 @@ def run_paths(paths: Iterable[str],
                 f"cannot parse: {exc}"))
             continue
         ctxs[ctx.path] = ctx
-        for analyzer in analyzers:
+    t0 = _lap("(parse)", t0)
+
+    if any(a.whole_program for a in analyzers):
+        from tpu_dra.analysis.cache import FactsCache
+        from tpu_dra.analysis.callgraph import Program
+
+        cache = FactsCache(cache_path) if cache_path else None
+        Program(ctxs, cache)
+        if cache is not None:
+            cache.save()
+        t0 = _lap("(program)", t0)
+
+    for analyzer in analyzers:
+        if analyzer.begin is not None:
+            analyzer.begin()
+    for analyzer in analyzers:
+        t0 = _time.perf_counter()
+        for ctx in ctxs.values():
             for d in analyzer.run(ctx):
                 if not ctx.suppressed(d.line, d.check):
                     diags.append(d)
+        _lap(analyzer.name, t0)
     for analyzer in analyzers:
         if analyzer.finish is None:
             continue
+        t0 = _time.perf_counter()
         for d in analyzer.finish():
             # whole-run findings anchor at one of the contributing sites;
             # an ignore on that line suppresses like any other finding
             ctx = ctxs.get(d.path)
             if ctx is None or not ctx.suppressed(d.line, d.check):
                 diags.append(d)
+        _lap(analyzer.name, t0)
     diags.sort(key=lambda d: (d.path, d.line, d.col, d.check))
     return diags
 

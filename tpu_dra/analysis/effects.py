@@ -1,0 +1,394 @@
+"""Interprocedural effect summaries over the project call graph.
+
+The per-function half of the concurrency checkers (PR 5) answers "which
+locks are held at this statement"; this module answers the dual question
+the function boundary used to hide: "what does *calling this function*
+do".  Each function gets a :class:`Summary`:
+
+- **effects** — blocking operations reachable from it: ``time.sleep``
+  (kind ``sleep``), kube client calls (``kube``), subprocess spawns
+  (``subprocess``), ``failpoint.hit`` (``failpoint``), condition/event
+  waits (``wait``), and un-timeouted outbound HTTP/socket calls
+  (``net``).  Every effect keeps its *origin* site (path:line) and the
+  call **chain** it was inherited through, so a diagnostic at the call
+  site can cite the sleep three helpers down;
+- **acquires** — lock nodes (qualified ``Owner.attr``, the lock-order
+  graph namespace) the function may take, transitively — how the
+  lock-order checker sees an acquisition edge that crosses a call;
+- **open_calls** — unresolved call targets: the summary's honesty
+  marker.  Checkers treat open calls as *unknown*, never as blocking
+  (no type inference means no proof either way).
+
+Summaries are computed bottom-up over Tarjan SCCs of the call graph
+with a fixed point inside each SCC, so recursion (direct or mutual)
+converges instead of looping; the whole solve is pure dict work over
+the serializable facts records and costs milliseconds for the full
+tree.
+
+The classification helpers here are THE shared catalog: blocking-under-
+lock, retry-hygiene, and deadline-hygiene import them, so the direct
+(intra-procedural) and summary (interprocedural) verdicts can never
+disagree about what counts as blocking.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import NamedTuple, Optional
+
+from tpu_dra.analysis import lockset
+from tpu_dra.analysis.callgraph import dotted_of
+
+__all__ = [
+    "Effect",
+    "Summary",
+    "blocking_reason",
+    "net_call",
+    "extract_direct",
+    "solve",
+    "chain_str",
+    "qualify_lock",
+    "modbase_of",
+    "module_globals",
+    "enclosing_class_map",
+]
+
+_SLEEP_TOKENS = {"time.sleep", "sleep"}
+_SUBPROCESS_FNS = {"run", "Popen", "call", "check_call", "check_output",
+                   "communicate"}
+_KUBE_RECEIVERS = {"kube", "kube_client"}
+_KUBE_METHODS = {"get", "list", "create", "update", "update_status",
+                 "delete", "patch", "request", "watch", "stream"}
+
+_REQUESTS_METHODS = ("get", "post", "put", "patch", "delete", "head",
+                     "request")
+# positional slot that can carry the timeout (None = keyword-only)
+_TIMEOUT_POS = {
+    "urlopen": 2,               # urlopen(url, data=None, timeout=...)
+    "create_connection": 1,     # create_connection(address, timeout=...)
+}
+
+_CHAIN_CAP = 5
+_OPEN_CAP = 200
+
+
+def blocking_reason(call: ast.Call) -> Optional[tuple[str, str]]:
+    """(kind, human reason) for a call that blocks the thread, or None.
+    The single classification both the direct scan and the summaries
+    use."""
+    tok = lockset.token_of(call.func)
+    if tok is None:
+        return None
+    if tok in _SLEEP_TOKENS:
+        return ("sleep", "time.sleep()")
+    parts = tok.split(".")
+    if parts[0] == "subprocess" and parts[-1] in _SUBPROCESS_FNS:
+        return ("subprocess", f"subprocess.{parts[-1]}()")
+    if parts[-1] == "hit" and len(parts) >= 2 and parts[-2] == "failpoint":
+        return ("failpoint",
+                "failpoint.hit() (an armed sleep/stall blocks here)")
+    if len(parts) >= 2 and parts[-1] in _KUBE_METHODS \
+            and parts[-2] in _KUBE_RECEIVERS:
+        return ("kube", f"kube client call .{parts[-1]}()")
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    return dotted_of(node) or ""
+
+
+def net_call(call: ast.Call) -> Optional[str]:
+    """The outbound-call name when ``call`` is an HTTP/socket call
+    WITHOUT an explicit timeout (the deadline-hygiene catalog), else
+    None."""
+    name = _dotted(call.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    kind = None
+    if last == "urlopen":
+        kind = "urlopen"
+    elif name in ("socket.create_connection", "create_connection"):
+        kind = "create_connection"
+    elif last in ("HTTPConnection", "HTTPSConnection"):
+        kind = "http_connection"
+    elif name.split(".", 1)[0] == "requests" and last in _REQUESTS_METHODS:
+        kind = "requests"
+    if kind is None:
+        return None
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return None
+    pos = _TIMEOUT_POS.get(kind)
+    if pos is not None and len(call.args) > pos:
+        return None
+    return name
+
+
+def enclosing_class_map(tree: ast.Module) -> dict[int, Optional[str]]:
+    """``id(node) -> enclosing class name`` for every node under a
+    function — how a checker walking the raw tree recovers the ``cls``
+    context :meth:`Program.resolve` needs for ``self.``/``cls.``
+    calls."""
+    enclosing: dict[int, Optional[str]] = {}
+    for f, c in lockset.functions_in(tree):
+        for sub in ast.walk(f):
+            enclosing.setdefault(id(sub), c)
+    return enclosing
+
+
+def modbase_of(path: str) -> str:
+    """Filename stem — the module half of the ``Owner.attr`` lock
+    namespace.  ONE derivation, shared by every site that names lock
+    graph nodes (here, blocking-under-lock, lock-order): independent
+    spellings could drift and silently split one lock into two nodes."""
+    base = path.rsplit("/", 1)[-1]
+    return base[:-3] if base.endswith(".py") else base
+
+
+def module_globals(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    return names
+
+
+def qualify_lock(tok: str, cls: Optional[str], mod_globals: set[str],
+                 modbase: str) -> Optional[str]:
+    """Lock token -> ``Owner.attr`` graph node, or None when the lock's
+    identity cannot be resolved statically (locals, cross-object
+    chains) — shared with the lock-order checker."""
+    if tok.startswith("self.") and tok.count(".") == 1:
+        return f"{cls}.{tok[5:]}" if cls else None
+    if "." not in tok and tok in mod_globals:
+        return f"{modbase}.{tok}"
+    return None
+
+
+class Effect(NamedTuple):
+    kind: str           # sleep | kube | subprocess | failpoint | wait | net
+    detail: str         # human reason ("time.sleep()", token, call name)
+    path: str           # origin file
+    line: int           # origin line
+    chain: tuple = ()   # callee qualnames the effect was inherited through
+    recv: str = ""      # wait-effect receiver as a QUALIFIED lock node
+                        # ("C._cv") — lets callers honor the
+                        # condition-variable protocol by identity
+
+
+class Summary:
+    __slots__ = ("effects", "acquires", "open_calls")
+
+    def __init__(self):
+        # (kind, path, line) -> Effect — dedup by origin site
+        self.effects: dict[tuple, Effect] = {}
+        # qualified lock -> (path, line, chain)
+        self.acquires: dict[str, tuple] = {}
+        self.open_calls: set[str] = set()
+
+    def blocking(self):
+        return list(self.effects.values())
+
+
+def chain_str(effect: Effect) -> str:
+    """``via _helper -> _pace`` (short names), empty for direct."""
+    if not effect.chain:
+        return ""
+    names = [q.split("::", 1)[-1] for q in effect.chain]
+    return "via " + " -> ".join(names)
+
+
+def extract_direct(ctx, rec: dict) -> None:
+    """Fill ``rec['functions'][qual]['effects'/'acquires']`` with the
+    function's DIRECT facts (one walk per function; serializable)."""
+    from tpu_dra.analysis.callgraph import qualname, toplevel_functions
+
+    modbase = modbase_of(ctx.path)
+    mod_globals = module_globals(ctx.tree)
+    for func, cls in toplevel_functions(ctx.tree):
+        ent = rec["functions"].get(qualname(ctx.path, cls, func.name))
+        if ent is None or ent["line"] != func.lineno:
+            continue
+        effects: list[list] = ent["effects"]
+        acquires: list[list] = ent["acquires"]
+        seen_locks: set[str] = set()
+        for sub in lockset.walk_scan(func):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    tok = lockset.token_of(item.context_expr)
+                    if tok is None:
+                        continue
+                    q = qualify_lock(tok, cls, mod_globals, modbase)
+                    if q is not None and q not in seen_locks:
+                        seen_locks.add(q)
+                        acquires.append([q, sub.lineno])
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("wait", "wait_for"):
+                tok = lockset.token_of(sub.func.value)
+                # the receiver travels as a QUALIFIED lock identity
+                # (Owner.attr) — two module globals both spelled `_cv`
+                # are different locks, and a raw-token comparison would
+                # exempt the cross-module deadlock shape
+                q = qualify_lock(tok, cls, mod_globals, modbase) \
+                    if tok else None
+                effects.append(
+                    ["wait",
+                     f"a blocking {tok or '<expr>'}.{sub.func.attr}()",
+                     sub.lineno, q or ""])
+                continue
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "acquire":
+                tok = lockset.token_of(sub.func.value)
+                if tok is not None:
+                    q = qualify_lock(tok, cls, mod_globals, modbase)
+                    if q is not None and q not in seen_locks:
+                        seen_locks.add(q)
+                        acquires.append([q, sub.lineno])
+                continue
+            hit = blocking_reason(sub)
+            if hit is not None:
+                effects.append([hit[0], hit[1], sub.lineno])
+            net = net_call(sub)
+            if net is not None:
+                effects.append(["net", f"{net}() without a timeout",
+                                sub.lineno])
+
+
+def _merge(dst: Summary, callee_qual: str, src: Summary) -> bool:
+    """Inherit ``src``'s effects/acquires into ``dst`` through a call to
+    ``callee_qual``; True when anything new landed (fixpoint driver)."""
+    grew = False
+    for key, eff in src.effects.items():
+        if key in dst.effects:
+            continue
+        chain = (callee_qual,) + eff.chain
+        if len(chain) > _CHAIN_CAP:
+            chain = chain[:_CHAIN_CAP]
+        dst.effects[key] = Effect(eff.kind, eff.detail, eff.path,
+                                  eff.line, chain, eff.recv)
+        grew = True
+    for lock, (path, line, chain) in src.acquires.items():
+        if lock not in dst.acquires:
+            nchain = ((callee_qual,) + chain)[:_CHAIN_CAP]
+            dst.acquires[lock] = (path, line, nchain)
+            grew = True
+    if not dst.open_calls >= src.open_calls:
+        extra = (src.open_calls - dst.open_calls)
+        room = _OPEN_CAP - len(dst.open_calls)
+        if room > 0:
+            dst.open_calls |= set(sorted(extra)[:room])
+            grew = True
+    return grew
+
+
+def _sccs(order: list[str], edges: dict[str, list[str]]
+          ) -> list[list[str]]:
+    """Tarjan, iterative; returns SCCs in reverse topological order
+    (callees before callers)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    for root in order:
+        if root in index:
+            continue
+        work = [(root, 0)]
+        while work:
+            node, ei = work[-1]
+            if ei == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            succs = edges.get(node, ())
+            advanced = False
+            while ei < len(succs):
+                succ = succs[ei]
+                ei += 1
+                if succ not in index:
+                    work[-1] = (node, ei)
+                    work.append((succ, 0))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+def solve(program) -> dict[str, Summary]:
+    """All summaries, bottom-up over SCCs with an in-SCC fixed point."""
+    # resolved call edges + per-function direct summaries
+    edges: dict[str, list[str]] = {}
+    summaries: dict[str, Summary] = {}
+    order: list[str] = []
+    for path, rec in program.facts.items():
+        for qual, ent in rec["functions"].items():
+            order.append(qual)
+            s = Summary()
+            for eff in ent["effects"]:
+                kind, detail, line = eff[0], eff[1], eff[2]
+                recv = eff[3] if len(eff) > 3 else ""
+                s.effects[(kind, path, line)] = \
+                    Effect(kind, detail, path, line, recv=recv)
+            for lock, line in ent["acquires"]:
+                s.acquires.setdefault(lock, (path, line, ()))
+            succ: list[str] = []
+            for dotted, _line, _col, skip in ent["calls"]:
+                if skip:
+                    continue    # directly-classified blocking call: the
+                                # classification subsumes the callee
+                target = program.resolve(path, ent["cls"], dotted)
+                if target is None:
+                    if len(s.open_calls) < _OPEN_CAP:
+                        s.open_calls.add(dotted)
+                elif target != qual:
+                    succ.append(target)
+            summaries[qual] = s
+            edges[qual] = succ
+
+    for scc in _sccs(order, edges):
+        multi = len(scc) > 1
+        changed = True
+        while changed:
+            changed = False
+            for qual in scc:
+                dst = summaries[qual]
+                for target in edges.get(qual, ()):
+                    src = summaries.get(target)
+                    if src is None:
+                        continue
+                    # any growth re-sweeps a multi-member SCC: an effect
+                    # inherited from OUTSIDE the cycle by one member
+                    # still has to propagate around it (A<->B, B->C:
+                    # A needs C's effects through B).  Singletons have
+                    # no intra-SCC edges and settle in one pass.
+                    if _merge(dst, target, src) and multi:
+                        changed = True
+    return summaries

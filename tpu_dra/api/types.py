@@ -143,6 +143,10 @@ class TpuSliceDomainNode:
 
     @classmethod
     def from_dict(cls, data: dict):
+        # contract: nodes-config[reader] — node entries round-trip
+        # through this dataclass into both the CRD status and
+        # nodes_config.json; a to_dict field from_dict cannot parse (or
+        # vice versa) is wire drift
         return cls(name=data.get("name", ""),
                    ip_address=data.get("ipAddress", ""),
                    fabric_id=data.get("fabricID", ""),
@@ -154,6 +158,7 @@ class TpuSliceDomainNode:
                    state=data.get("state", ""))
 
     def to_dict(self) -> dict:
+        # contract: nodes-config[writer] — see from_dict
         out = {"name": self.name, "ipAddress": self.ip_address,
                "fabricID": self.fabric_id, "workerID": self.worker_id}
         if not self.devices_healthy:

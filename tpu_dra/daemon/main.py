@@ -70,6 +70,8 @@ def _split_fabric(fabric: str) -> tuple[str, int]:
 def write_nodes_config(settings_dir: str, nodes: list[TpuSliceDomainNode],
                        my_fabric: str, generation: int = 0,
                        traceparent: str = "") -> str:
+    # contract: nodes-config[writer] — the cross-binary wire format the
+    # launcher/elastic readers parse; contract-drift checks both sides
     """The ``writeNodesConfig`` analog (main.go:292-322), multislice-aware.
 
     Same-deployment nodes participate; nodes of a different deployment uuid

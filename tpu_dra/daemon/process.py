@@ -49,7 +49,12 @@ class ProcessManager:
         self._stopping = False
         self._ever_started = True   # "start requested": watchdog may retry
         try:
+            # _mu IS the spawn/stop serialization: callers expect at
+            # most one child transition in flight, and the watchdog
+            # try-locks so it never queues behind a slow spawn
+            # vet: ignore[blocking-under-lock] — see above
             failpoint.hit("daemon.child.spawn")
+            # vet: ignore[blocking-under-lock] — same contract as above
             self._proc = subprocess.Popen(argv)
         except OSError as exc:
             # Spawn failure (ENOEXEC/ENOENT) must not unwind the caller's
@@ -73,9 +78,14 @@ class ProcessManager:
         if proc.poll() is None:
             proc.terminate()
             try:
+                # bounded (10s) and deliberate: stop() under _mu is the
+                # one serialized child transition; the watchdog
+                # try-locks around it
+                # vet: ignore[blocking-under-lock] — see above
                 proc.wait(timeout)
             except subprocess.TimeoutExpired:
                 proc.kill()
+                # vet: ignore[blocking-under-lock] — bounded (5s), as above
                 proc.wait(5)
         self._proc = None
 

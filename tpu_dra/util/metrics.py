@@ -544,8 +544,12 @@ def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
             counts[key] = counts.get(key, 0) + 1
         n_samples += 1
         # fixed-rate sampling pacing, not a retry loop: the profiler
-        # MUST tick at interval or the sample weights are wrong
-        time.sleep(interval)  # vet: ignore[reconcile-hygiene, retry-hygiene]
+        # MUST tick at interval or the sample weights are wrong.  The
+        # blocking-under-lock ignore covers the /debug/pprof handler,
+        # which deliberately holds _PROFILE_MU for the whole profile —
+        # that lock EXISTS to serialize profilers (the loser gets 409 +
+        # Retry-After), so the holder blocking on it is the design.
+        time.sleep(interval)  # vet: ignore[reconcile-hygiene, retry-hygiene, blocking-under-lock]
     lines = [f"# cpu profile: {n_samples} samples @ {hz}Hz over "
              f"{seconds:.1f}s (collapsed stacks)"]
     for key, c in sorted(counts.items(), key=lambda kv: -kv[1]):

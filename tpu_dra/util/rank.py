@@ -13,6 +13,8 @@ from __future__ import annotations
 
 
 def rank_sorted(nodes: list[dict]) -> list[dict]:
+    # contract: nodes-config[reader] — consumes the writer's rank /
+    # workerID / name fields; contract-drift checks both sides
     """Global process order over node dicts.
 
     Explicit ``rank`` when every entry carries it (multislice-aware,

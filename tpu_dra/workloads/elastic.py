@@ -51,6 +51,8 @@ class Epoch:
 
 
 def read_epoch(env: Optional[dict] = None) -> Optional[Epoch]:
+    # contract: nodes-config[reader] — the elastic supervisor's view of
+    # the same wire format _info_from_config parses
     """The current :class:`Epoch`, or None while no config is readable.
     Config resolution is the launcher's (``load_nodes_config``): the
     supervisor and the train process it spawns always read the same

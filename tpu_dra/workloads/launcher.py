@@ -450,6 +450,8 @@ from tpu_dra.util.rank import rank_sorted as _rank_sorted  # noqa: E402
 def _info_from_config(data: dict, my_ip: str,
                       env: Optional[dict] = None
                       ) -> Optional[RendezvousInfo]:
+    # contract: nodes-config[reader] — parses daemon/main.py
+    # write_nodes_config output; contract-drift checks both sides
     nodes = data.get("nodes", [])
     if not nodes:
         return None
