@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-fleetsim image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -91,6 +91,18 @@ drive-preempt:
 # pytest marker in tests/test_fleetsim.py, not here.
 drive-fleetsim:
 	$(PYTHON) hack/fleetsim.py
+
+# topology-aware allocation acceptance (docs/scaling.md "Topology-aware
+# allocation", ISSUE 13): the REAL best-fit selector vs the naive
+# first-fit baseline over ~50 boards rebuilt from the published
+# ResourceSlice attribute surface, through a seeded allocate/free/
+# preempt churn — fewer failed multi-chip allocations, lower torus
+# fragmentation, hot-path scoring inside the alloc_score_us budget,
+# and the real-controller compact-packing checks.  The 1000-node
+# acceptance sweep runs under the `slow` marker in tests/test_fleetsim
+# (artifact: ALLOC_r13.json).
+drive-fleetsim-alloc:
+	$(PYTHON) hack/fleetsim.py --phases alloc --nodes 200
 
 # serving-SLO acceptance (docs/observability.md, ISSUE 8): scripted QPS
 # against the REAL serve binary with a p99 gate, per-tenant histograms,

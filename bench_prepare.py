@@ -178,6 +178,32 @@ def bench_admission_idle(n: int = 20_000, repeats: int = 3) -> dict:
     return {"n": n, "per_check_us": round(best / n * 1e6, 4)}
 
 
+def bench_alloc_score(n: int = 5_000, repeats: int = 3) -> dict:
+    """ISSUE 13 placement gate: ``claim_score`` — the ICI-contiguity
+    scoring every multi-chip prepare runs inside its select_devices
+    phase — must stay microseconds, or topology awareness hands back
+    the warm-prepare overhead PR 6 won (the 1.2 ms budget).  Measured
+    over the two shapes the path actually sees: a contiguous 4-chip
+    claim (the common case: one submesh check) and a scattered one (the
+    expensive branch: pairwise torus distances + the ideal-submesh
+    comparison).  Best-of-``repeats``, like the other idle gates."""
+    from tpu_dra.plugins.tpu.placement import claim_score
+    from tpu_dra.tpulib.fake import FakeTpuLib
+
+    contiguous = FakeTpuLib().enumerate_chips()          # 4 chips, one row
+    scattered = [FakeTpuLib(worker=w).enumerate_chips()[i]
+                 for w, i in ((0, 0), (1, 2), (2, 1), (3, 3))]
+    assert claim_score(contiguous) == 1.0
+    assert claim_score(scattered) < 1.0
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(n):
+            claim_score(contiguous if i % 2 else scattered)
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_score_us": round(best / n * 1e6, 4)}
+
+
 def bench_kernel_throughput() -> dict:
     """Kernel-throughput ratchet section (ISSUE 10): floors for the
     Pallas kernel family (matmul, flash, the fused collective matmuls),
@@ -420,6 +446,7 @@ def run_all() -> dict:
         "cpu_probe_p90_ms": bench_cpu_probe(),
         "observe_idle": bench_observe_idle(),
         "admission_idle": bench_admission_idle(),
+        "alloc_score": bench_alloc_score(),
         "kernels": bench_kernel_throughput(),
         "direct": bench_direct(base),
         "concurrent": bench_concurrent(base),
@@ -460,6 +487,8 @@ def _gates(report: dict) -> dict[str, float]:
             report["observe_idle"]["per_observe_us"],
         "admission_check_idle_us":
             report["admission_idle"]["per_check_us"],
+        "alloc_score_us":
+            report["alloc_score"]["per_score_us"],
     }
 
 

@@ -28,6 +28,17 @@ What it measures (and asserts):
 - **Control-plane health**: workqueue depth stays bounded (same-key
   coalescing), reconcile throughput, and the sweep-tick latency
   distribution (`tpu_dra_membership_sweep_seconds`).
+- **Allocation quality** (`phase alloc`, ISSUE 13): the REAL
+  topology-aware selector (`tpu_dra/plugins/tpu/placement.py`) against
+  boards reconstructed from the REAL published ResourceSlice attribute
+  surface (`chip_device` → `coordX/Y/Z`/`iciNeighbors` →
+  `device_coords`), through a seeded allocate/free/preempt churn
+  schedule — best-fit must beat the naive first-fit baseline (kept
+  behind the strategy flag) on torus fragmentation AND multi-chip
+  allocation success rate, with per-claim scoring cost inside the
+  committed `alloc_score_us` bench budget; plus a REAL-controller
+  packing pass asserting spare promotion heals toward a compact
+  worker-id mesh.
 
 Simplifications vs a real cluster, on purpose: watch streams are
 in-process queues (a blackout blocks request traffic but not already-
@@ -65,6 +76,13 @@ from tpu_dra.k8s.client import (  # noqa: E402
     Transient,
 )
 from tpu_dra.k8s.fake import FakeKube  # noqa: E402
+from tpu_dra.plugins.tpu.deviceinfo import chip_device  # noqa: E402
+from tpu_dra.plugins.tpu.placement import (  # noqa: E402
+    TopologySelector,
+    claim_score,
+    device_coords,
+    fragmentation_ratio,
+)
 from tpu_dra.resilience import failpoint  # noqa: E402
 from tpu_dra.resilience.breaker import CircuitBreaker, ResilientKubeClient  # noqa: E402
 from tpu_dra.resilience.retry import RetryPolicy  # noqa: E402
@@ -160,6 +178,7 @@ class Config:
     workers: int = 8              # beat scheduler pool
     seed: int = 20260803
     settle_timeout: float = 60.0
+    alloc_steps: int = 400        # churn-schedule length (phase alloc)
 
 
 @dataclass
@@ -656,6 +675,315 @@ def phase_faults(cfg: Config, checks: list[Check]) -> dict:
 
 
 # -------------------------------------------------------------------------
+# phase alloc: topology-aware allocation quality (ISSUE 13)
+
+
+@dataclass
+class Board:
+    """One slice's torus, as the scheduler sees it: built by running the
+    REAL discovery (`FakeTpuLib.enumerate_chips`) and the REAL publish
+    surface (`chip_device`) for each of its worker nodes, then parsing
+    the coordinates back OUT of the published attributes
+    (`device_coords`) — if the ResourceSlice surface ever stops carrying
+    the torus, this constructor fails, not just the metrics."""
+
+    name: str
+    shape: tuple
+    chips: dict            # coords -> ChipInfo
+    free: set
+
+
+def build_boards(n_nodes: int) -> list[Board]:
+    from tpu_dra.tpulib.fake import FakeTpuLib
+    from tpu_dra.tpulib.topology import parse_topology
+
+    boards = []
+    for b in range(max(1, n_nodes // 4)):
+        chips = {}
+        shape = None
+        for w in range(4):
+            lib = FakeTpuLib(worker=w)
+            for chip in lib.enumerate_chips():
+                dev = chip_device(chip, fabric_id=f"board-{b}.0")
+                coords = device_coords(dev)
+                assert coords == chip.coords, \
+                    "published attributes lost the torus coordinates"
+                shape = parse_topology(
+                    dev["basic"]["attributes"]["topology"]["string"])
+                chips[coords] = chip
+        boards.append(Board(f"board-{b:03d}", shape, chips, set(chips)))
+    return boards
+
+
+# claim-size mix of the churn schedule: mostly small tenants, a steady
+# diet of 4s and 8s — the multi-chip claims whose success rate the
+# acceptance gates
+ALLOC_SIZES = (1, 2, 4, 8)
+ALLOC_WEIGHTS = (0.35, 0.25, 0.25, 0.15)
+ALLOC_TTL = (20, 60)               # claim lifetime, in schedule steps
+ALLOC_UTIL_TARGET = 0.95           # offered load as a fraction of chips:
+# near the capacity ceiling, where fragmentation — not raw free count —
+# decides whether a multi-chip claim finds a home
+
+
+def gen_alloc_schedule(total_chips: int, steps: int, seed: int) -> list:
+    """Pre-generated arrival schedule, identical for both selector arms:
+    per step a list of (size, ttl) plus a preempt marker.  Offered load
+    is sized by Little's law to hold the fleet near ALLOC_UTIL_TARGET,
+    which is where fragmentation decides who allocates and who fails.
+    ``total_chips`` comes from the BUILT boards, so a change to the
+    board topology can't silently drift the load off the target."""
+    rng = random.Random(seed)
+    avg_size = sum(s * w for s, w in zip(ALLOC_SIZES, ALLOC_WEIGHTS))
+    avg_ttl = sum(ALLOC_TTL) / 2
+    per_step = total_chips * ALLOC_UTIL_TARGET / (avg_size * avg_ttl)
+    schedule = []
+    carry = 0.0
+    for step in range(steps):
+        carry += per_step
+        arrivals = []
+        while carry >= 1.0:
+            carry -= 1.0
+            size = rng.choices(ALLOC_SIZES, ALLOC_WEIGHTS)[0]
+            arrivals.append((size, rng.randint(*ALLOC_TTL)))
+        # preempt mix: every ~20 steps the oldest claim is killed early
+        schedule.append((arrivals, step % 20 == 19))
+    return schedule
+
+
+def run_alloc_schedule(boards: list[Board], schedule: list,
+                       strategy: str) -> dict:
+    """Replay one arrival schedule through the REAL selector.  Returns
+    fragmentation trajectory, per-size success counts, selector latency
+    and hot-path scoring cost (`claim_score`, the function the prepare
+    path runs — timed here over the same claims)."""
+    selector = TopologySelector(strategy)
+    expiries: dict[int, list] = {}
+    # (expire step, allocation step, board, cells)
+    live: list[tuple[int, int, int, frozenset]] = []
+    attempts = {s: 0 for s in ALLOC_SIZES}
+    failures = {s: 0 for s in ALLOC_SIZES}
+    latencies: list[float] = []
+    score_s: list[float] = []
+    frag: list[float] = []
+    for step, (arrivals, preempt) in enumerate(schedule):
+        for bi, cells in expiries.pop(step, []):
+            boards[bi].free |= cells
+        live = [c for c in live if c[0] > step]
+        if preempt and live:
+            # the OLDEST claim (earliest allocation step) dies early —
+            # preemption perturbs long-lived placements, not ones about
+            # to expire anyway
+            victim = min(range(len(live)), key=lambda i: live[i][1])
+            exp, _, bi, cells = live.pop(victim)
+            expiries[exp] = [e for e in expiries.get(exp, [])
+                             if not (e[0] == bi and e[1] == cells)]
+            boards[bi].free |= cells
+        for size, ttl in arrivals:
+            # the whole placement decision — board choice AND cell
+            # choice — belongs to the strategy under test
+            # (select_board); a claim FAILS only when no board in the
+            # fleet can host a contiguous placement
+            attempts[size] += 1
+            t0 = time.perf_counter()
+            placed = selector.select_board(size, boards)
+            latencies.append(time.perf_counter() - t0)
+            if placed is None:
+                failures[size] += 1
+                continue
+            bi, cells = placed
+            cellset = frozenset(cells)
+            boards[bi].free -= cellset
+            expiries.setdefault(step + ttl, []).append((bi, cellset))
+            live.append((step + ttl, step, bi, cellset))
+            if size > 1:
+                t0 = time.perf_counter()
+                score = claim_score([boards[bi].chips[c] for c in cells])
+                score_s.append(time.perf_counter() - t0)
+                assert score == 1.0, \
+                    f"{strategy} returned a non-contiguous placement"
+        if step % 5 == 0:
+            frag.append(round(sum(
+                fragmentation_ratio(b.free, b.shape) for b in boards)
+                / len(boards), 4))
+    latencies.sort()
+    score_s.sort()
+    # bookkeeping invariant surfaced in the report (and asserted by the
+    # harness tests): chips held by live claims == chips missing from
+    # the boards' free sets — a double-free or leaked expiry breaks it
+    final_live = sum(len(c[3]) for c in live)
+    final_busy = sum(len(b.chips) - len(b.free) for b in boards)
+
+    def pct(xs, q):
+        return round(xs[min(int(q * len(xs)), len(xs) - 1)] * 1e3, 4) \
+            if xs else None
+
+    multi_att = sum(attempts[s] for s in ALLOC_SIZES if s > 1)
+    multi_fail = sum(failures[s] for s in ALLOC_SIZES if s > 1)
+    return {
+        "strategy": strategy,
+        "attempts": attempts,
+        "failures": failures,
+        "multi_attempts": multi_att,
+        "multi_failures": multi_fail,
+        "multi_success_rate": round(1 - multi_fail / max(multi_att, 1), 4),
+        "alloc_p50_ms": pct(latencies, 0.50),
+        "alloc_p99_ms": pct(latencies, 0.99),
+        "score_p50_us": round(score_s[len(score_s) // 2] * 1e6, 2)
+        if score_s else None,
+        "score_p99_us": round(
+            score_s[min(int(0.99 * len(score_s)), len(score_s) - 1)]
+            * 1e6, 2) if score_s else None,
+        "fragmentation_trajectory": frag,
+        "fragmentation_mean": round(sum(frag) / max(len(frag), 1), 4),
+        "fragmentation_final": frag[-1] if frag else 0.0,
+        "final_live_chips": final_live,
+        "final_busy_chips": final_busy,
+    }
+
+
+def alloc_controller_packing(cfg: Config, checks: list[Check]) -> dict:
+    """Drive the REAL controller through the ISSUE-13 packing path:
+    workers at ids {0, 4..8} must arbitrate to the COMPACT window
+    {4,5,6,7} (legacy lowest-id would take {0,4,5,6}); killing worker 5
+    must promote the window-adjacent spare 8, never far-away 0."""
+    fake = FakeKube()
+    controller = Controller(ControllerConfig(
+        kube=fake, gc_period=3600.0,
+        lease_duration=cfg.lease_duration,
+        sweep_period=cfg.sweep_period))
+    fake.create(TPU_SLICE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuSliceDomain",
+        "metadata": {"name": "pack", "namespace": NS},
+        "spec": {"numNodes": 4, "spares": 2,
+                 "channel": {"resourceClaimTemplate": {"name": "pk-ch"}}},
+    })
+    workers = [0, 4, 5, 6, 7, 8]
+    managers = {
+        w: MembershipManager(
+            fake, "pack", NS, f"pk-n{w:02d}", f"10.9.0.{w + 1}",
+            "pack-slice.0", w, heartbeat_interval=cfg.heartbeat,
+            retry_policy=SIM_RETRY)
+        for w in workers
+    }
+    dead: set = set()
+    stop = threading.Event()
+
+    def beats() -> None:
+        while not stop.wait(cfg.heartbeat):
+            for w, mgr in managers.items():
+                if w in dead:
+                    continue
+                try:
+                    mgr.heartbeat_once()
+                except Exception:  # noqa: BLE001 — a missed beat is the
+                    pass           # daemon contract, never a crash
+
+    controller.start()
+    beat_thread = threading.Thread(target=beats, daemon=True,
+                                   name="pack-beats")
+    beat_thread.start()
+    out: dict = {}
+    try:
+        # the mesh incumbents register FIRST: gen-0 assembly fills from
+        # registration order (daemons joining a complete assembly
+        # self-stamp as Spare, and healthy actives are never churned for
+        # compactness alone), so the discriminating scenario is built by
+        # order — actives {4,5,6,7}, spares parked at 0 and 8
+        for w in (4, 5, 6, 7, 0, 8):
+            managers[w].renew_lease()
+            managers[w].update_own_node_info()
+
+        def active_workers() -> set:
+            status = fake.get(TPU_SLICE_DOMAINS, "pack", NS) \
+                .get("status") or {}
+            return {int(n["name"][-2:]) for n in status.get("nodes", [])
+                    if n.get("state") == NODE_STATE_ACTIVE}
+
+        deadline = time.monotonic() + cfg.settle_timeout
+        while time.monotonic() < deadline and \
+                active_workers() != {4, 5, 6, 7}:
+            time.sleep(0.1)
+        initial = sorted(active_workers())
+        checks.append(Check(
+            "alloc: initial arbitration picks the compact worker window",
+            initial == [4, 5, 6, 7],
+            f"active workers {initial} (legacy lowest-id would be "
+            f"[0, 4, 5, 6])"))
+        dead.add(5)
+        expiry_wait = cfg.lease_duration + 4 * cfg.sweep_period + 5.0
+        deadline = time.monotonic() + expiry_wait
+        while time.monotonic() < deadline and \
+                active_workers() != {4, 6, 7, 8}:
+            time.sleep(0.1)
+        healed = sorted(active_workers())
+        checks.append(Check(
+            "alloc: spare promotion heals toward the compact mesh",
+            healed == [4, 6, 7, 8],
+            f"active workers after losing 5: {healed} (spare 8 extends "
+            f"the window by 1; spare 0 would stretch it by 4)"))
+        out["initial_active"] = initial
+        out["healed_active"] = healed
+    finally:
+        stop.set()
+        beat_thread.join(timeout=5)
+        controller.stop()
+        fake.close_watchers()
+    return out
+
+
+def phase_alloc(cfg: Config, checks: list[Check]) -> dict:
+    """Best-fit vs first-fit through one seeded churn schedule over
+    boards rebuilt from the published attribute surface, plus the
+    real-controller packing pass.  Acceptance (ISSUE 13): best-fit wins
+    on fragmentation AND multi-chip success (≥20% fewer failures), with
+    hot-path scoring inside the committed `alloc_score_us` budget."""
+    boards = build_boards(cfg.nodes)
+    out: dict = {"nodes": cfg.nodes, "boards": len(boards),
+                 "chips": sum(len(b.chips) for b in boards),
+                 "steps": cfg.alloc_steps}
+    schedule = gen_alloc_schedule(out["chips"], cfg.alloc_steps,
+                                  cfg.seed)
+    out["offered_claims"] = sum(len(a) for a, _ in schedule)
+    out["first-fit"] = run_alloc_schedule(boards, schedule, "first-fit")
+    out["best-fit"] = run_alloc_schedule(
+        build_boards(cfg.nodes), schedule, "best-fit")
+    bf, ff = out["best-fit"], out["first-fit"]
+    checks.append(Check(
+        "alloc: best-fit beats first-fit on torus fragmentation",
+        bf["fragmentation_mean"] < ff["fragmentation_mean"],
+        f"mean fragmentation best-fit {bf['fragmentation_mean']} vs "
+        f"first-fit {ff['fragmentation_mean']}"))
+    checks.append(Check(
+        "alloc: >=20% fewer failed multi-chip allocations",
+        ff["multi_failures"] > 0 and
+        bf["multi_failures"] <= 0.8 * ff["multi_failures"],
+        f"multi-chip failures best-fit {bf['multi_failures']} vs "
+        f"first-fit {ff['multi_failures']} "
+        f"({bf['multi_attempts']} attempts)"))
+    checks.append(Check(
+        "alloc: selector latency bounded",
+        bf["alloc_p99_ms"] is not None and bf["alloc_p99_ms"] <= 50.0,
+        f"best-fit alloc p50/p99 {bf['alloc_p50_ms']}/"
+        f"{bf['alloc_p99_ms']} ms"))
+    budget_path = os.path.join(REPO, "bench-budget.json")
+    try:
+        with open(budget_path) as f:
+            budget_us = json.load(f)["gates"]["alloc_score_us"]
+    except (OSError, KeyError, ValueError):
+        budget_us = None
+    checks.append(Check(
+        "alloc: hot-path claim scoring inside the committed budget",
+        budget_us is not None and bf["score_p50_us"] is not None and
+        bf["score_p50_us"] <= budget_us,
+        f"claim_score p50 {bf['score_p50_us']}us vs alloc_score_us "
+        f"budget {budget_us}us"))
+    out["packing"] = alloc_controller_packing(cfg, checks)
+    return out
+
+
+# -------------------------------------------------------------------------
 
 
 def parse_args(argv=None) -> tuple[Config, list[str], str]:
@@ -673,6 +1001,7 @@ def parse_args(argv=None) -> tuple[Config, list[str], str]:
     ap.add_argument("--wedge-count", type=int, default=4)
     ap.add_argument("--workers", type=int, default=8)
     ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--alloc-steps", type=int, default=400)
     ap.add_argument("--phases", default="baseline,scale,faults")
     ap.add_argument("--report", default="")
     ap.add_argument("--full", action="store_true",
@@ -695,7 +1024,7 @@ def parse_args(argv=None) -> tuple[Config, list[str], str]:
                            args.scale_points.split(",") if p),
         crash_fraction=args.crash_fraction,
         wedge_count=args.wedge_count, workers=args.workers,
-        seed=args.seed)
+        seed=args.seed, alloc_steps=args.alloc_steps)
     return cfg, [p.strip() for p in args.phases.split(",") if p.strip()], \
         args.report
 
@@ -709,7 +1038,7 @@ def run(cfg: Config, phases: list[str]) -> tuple[dict, list[Check]]:
         "sweep_period_s": cfg.sweep_period, "skew_s": cfg.skew,
         "phases": phases}}
     runners = {"baseline": phase_baseline, "scale": phase_scale,
-               "faults": phase_faults}
+               "faults": phase_faults, "alloc": phase_alloc}
     for phase in phases:
         t0 = time.monotonic()
         try:

@@ -17,13 +17,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
             grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
-            observe_us=0.8, admission_us=4.0):
+            observe_us=0.8, admission_us=4.0, alloc_us=15.0):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
         "cpu_probe_p90_ms": cpu,
         "observe_idle": {"n": 50000, "per_observe_us": observe_us},
         "admission_idle": {"n": 20000, "per_check_us": admission_us},
+        "alloc_score": {"n": 5000, "per_score_us": alloc_us},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
                      "overhead_p50_ms": direct_warm_oh},
@@ -47,6 +48,7 @@ def _budget(**overrides):
             "flushes_per_mutation": 1.0,
             "histogram_observe_idle_us": 2.5,
             "admission_check_idle_us": 12.0,
+            "alloc_score_us": 40.0,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
                      "fs_floor_ceiling_ms": 0.4,
@@ -113,6 +115,16 @@ def test_flushes_per_mutation_gate():
         _report(flushes=1.4),        # >1 = barrier writing more than once
         _budget())
     assert any("flushes_per_mutation" in v for v in violations)
+
+
+def test_alloc_score_gate():
+    """ISSUE 13: the ICI-contiguity scoring added to the select_devices
+    hot path is budgeted like every other prepare-path cost — an
+    accidental fragmentation() call landing there (~200us) must fail
+    the ratchet."""
+    violations = bench_prepare.gate(_report(alloc_us=210.0), _budget())
+    assert any("alloc_score_us" in v for v in violations)
+    assert bench_prepare.gate(_report(alloc_us=14.0), _budget()) == []
 
 
 def test_idle_observe_gate():

@@ -243,6 +243,67 @@ def test_plan_stable_after_arbitration():
                            now, LEASE) is None
 
 
+def test_plan_spare_promotion_prefers_compact_mesh():
+    """ISSUE 13 slice-domain packing: when a lost active leaves a
+    vacancy and two spares are equally healthy, the promoted one is the
+    spare that keeps the active worker-id window contiguous (dp-outer/
+    tp-inner packing, docs/scaling.md) — NOT the lowest worker id."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        membership_generation=1,
+        nodes=[node("a-sp0", 0, state=NODE_STATE_SPARE, now=now),
+               node("n4", 4, state=NODE_STATE_ACTIVE, now=now),
+               node("n5", 5, state=NODE_STATE_ACTIVE, age=LEASE * 2,
+                    now=now),
+               node("n6", 6, state=NODE_STATE_ACTIVE, now=now),
+               node("n7", 7, state=NODE_STATE_ACTIVE, now=now),
+               node("z-sp8", 8, state=NODE_STATE_SPARE, now=now)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=4),
+                           now, LEASE)
+    # worker 8 extends the surviving [4,7] window by 1; worker 0 would
+    # stretch it to [0,7] — the compact choice wins the promotion
+    assert plan.states["n5"] == NODE_STATE_LOST
+    assert plan.states["z-sp8"] == NODE_STATE_ACTIVE
+    assert "a-sp0" not in plan.states   # parked spare, unchanged
+    assert plan.active == ["n4", "n6", "n7", "z-sp8"]
+    assert plan.promotions == ["z-sp8"]
+
+
+def test_plan_compact_choice_reduces_to_legacy_on_ties():
+    """When compactness doesn't distinguish the spares, the pick is the
+    legacy lowest-(worker_id, name) one — first arbitration of a fresh
+    domain must still activate the lowest worker ids."""
+    now = time.time()
+    status = TpuSliceDomainStatus(
+        nodes=[node(f"n{i}", i, now=now) for i in range(5)])
+    plan = membership_plan(status, TpuSliceDomainSpec(num_nodes=3),
+                           now, LEASE)
+    assert plan.active == ["n0", "n1", "n2"]
+
+
+def test_compact_fill_extends_toward_nearest_side():
+    from tpu_dra.controller.slicedomain import _compact_fill
+
+    class N:
+        def __init__(self, name, worker):
+            self.name, self.worker_id = name, worker
+
+    # fixed mesh [10, 13]; candidates at 2, 8, 9, 15: two slots go to
+    # 8 and 9 (extension 2) over 15 (ext 2 for one but 2+5 via 2) etc.
+    pool = [N("a", 2), N("b", 8), N("c", 9), N("d", 15)]
+    picked = _compact_fill([10, 11, 12, 13], pool, 2)
+    assert sorted(n.worker_id for n in picked) == [8, 9]
+    # inside-the-window candidate 11 is span-free and always picked;
+    # 15 extends the [10,12] window by 3, 2 would extend it by 8
+    pool = [N("a", 2), N("in", 11), N("d", 15)]
+    picked = _compact_fill([10, 12], pool, 2)
+    assert {n.worker_id for n in picked} == {11, 15}
+    # no fixed mesh: minimal-span sliding window, earliest on ties
+    pool = [N("a", 0), N("b", 4), N("c", 5), N("d", 6), N("e", 20)]
+    picked = _compact_fill([], pool, 3)
+    assert [n.worker_id for n in picked] == [4, 5, 6]
+
+
 def test_rfc3339_roundtrip():
     stamp = now_rfc3339()
     ts = parse_rfc3339(stamp)

@@ -27,12 +27,18 @@ pytestmark = pytest.mark.core
     ("2x2x2", (2, 2, 2)),
     ("1x1", (1, 1)),
     ("8X8", (8, 8)),
+    # degenerate-but-real shapes (ISSUE 13): single chip, bare-count 1D
+    # slices, and 3D spellings padded with unit axes
+    ("1", (1,)),
+    ("8", (8,)),
+    ("2x4x1", (2, 4, 1)),
+    (" 4x4 ", (4, 4)),
 ])
 def test_parse_topology(s, expected):
     assert parse_topology(s) == expected
 
 
-@pytest.mark.parametrize("s", ["", "4x", "axb", "0x4", "-1x2"])
+@pytest.mark.parametrize("s", ["", "4x", "axb", "0x4", "-1x2", "x", "  "])
 def test_parse_topology_rejects(s):
     with pytest.raises(ValueError):
         parse_topology(s)
@@ -44,6 +50,51 @@ def test_chip_coords_row_major():
     assert chip_coords(1, shape) == (0, 0, 1)
     assert chip_coords(2, shape) == (0, 1, 0)
     assert chip_coords(7, shape) == (1, 1, 1)
+
+
+def test_chip_coords_rejects_out_of_range():
+    """The old behavior silently wrapped the outermost axis (two chips
+    on one coordinate); placement lives on these coordinates now, so an
+    impossible index must raise."""
+    with pytest.raises(ValueError, match="outside topology"):
+        chip_coords(16, (4, 4))
+    with pytest.raises(ValueError, match="outside topology"):
+        chip_coords(-1, (4, 4))
+    from tpu_dra.tpulib.topology import coords_to_index
+    with pytest.raises(ValueError, match="outside topology"):
+        coords_to_index((0, 4), (4, 4))
+    with pytest.raises(ValueError, match="outside topology"):
+        coords_to_index((0,), (4, 4))
+
+
+# representative topology per family, every family the driver knows
+# (family_for_accelerator_type's table), incl. the degenerate spellings
+_FAMILY_TOPOLOGIES = [
+    ("v5litepod-1", "1"),          # single-chip v5e host
+    ("v5litepod-8", "8"),          # 1D v5e slice
+    ("v5litepod-16", "4x4"),       # 2D v5e
+    ("v5e-16", "4x4"),
+    ("v4-8", "2x2x1"),             # v4 sub-cube with a unit axis
+    ("v4-32", "2x2x4"),
+    ("v5p-16", "2x2x2"),
+    ("v6e-16", "4x4"),
+]
+
+
+@pytest.mark.parametrize("atype,topology", _FAMILY_TOPOLOGIES)
+def test_coords_index_round_trip_per_family(atype, topology):
+    """Property: coords↔index round-trips for EVERY chip of every
+    family's representative topology (ISSUE 13 satellite)."""
+    from tpu_dra.tpulib.topology import coords_to_index, num_chips
+    family_for_accelerator_type(atype)       # family must resolve
+    shape = parse_topology(topology)
+    seen = set()
+    for i in range(num_chips(shape)):
+        coords = chip_coords(i, shape)
+        assert coords_to_index(coords, shape) == i
+        assert all(0 <= c < d for c, d in zip(coords, shape))
+        seen.add(coords)
+    assert len(seen) == num_chips(shape)     # bijective, no wrapping
 
 
 @pytest.mark.parametrize("atype,family", [
@@ -147,6 +198,28 @@ def test_real_lib_defaults_without_metadata(tmp_path):
     assert len(chips) == 2
     assert chips[0].topology == "2x1"
     assert chips[0].family.name == "v5e"
+
+
+def test_real_lib_skewed_metadata_degrades_to_node_local_board(tmp_path):
+    """Review regression (ISSUE 13): TPU_WORKER_ID set with no/too-small
+    TPU_TOPOLOGY used to silently wrap coordinates; with chip_coords
+    now strict it must DEGRADE to a node-local board — never fail
+    discovery (a node that can't enumerate publishes nothing)."""
+    root = make_driver_root(tmp_path, n_chips=4)
+    # worker 1, but the default fallback topology only covers 4 chips
+    lib = RealTpuLib(driver_root=root, env={"TPU_WORKER_ID": "1"})
+    chips = lib.enumerate_chips()
+    assert len(chips) == 4
+    assert chips[0].topology == "4x1"
+    assert chips[0].worker_id == 0            # re-anchored node-local
+    assert [c.coords for c in chips] == \
+        [(0, 0), (1, 0), (2, 0), (3, 0)]
+    # explicit-but-too-small topology degrades the same way
+    lib2 = RealTpuLib(driver_root=root, env={
+        "TPU_WORKER_ID": "2", "TPU_TOPOLOGY": "2x2"})
+    chips2 = lib2.enumerate_chips()
+    assert len(chips2) == 4
+    assert chips2[0].topology == "4x1"
 
 
 def test_visible_chips_env(tmp_path):

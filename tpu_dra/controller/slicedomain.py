@@ -120,6 +120,83 @@ def effective_age(node, now: float,
     return min(ages) if ages else None
 
 
+def _compact_fill(fixed_ids: list[int], pool: list, room: int) -> list:
+    """Pick ``room`` nodes from ``pool`` minimizing the worker-id span of
+    the resulting active set (``fixed_ids`` ∪ picked) — the slice-domain
+    packing rule (docs/scaling.md "Topology-aware allocation"): worker
+    ids ARE positions along the slice's host ordering, so a contiguous
+    worker-id window is the mesh whose tp-inner collectives ride
+    nearest-neighbor ICI.  Deterministic: ties resolve toward the
+    lexicographically smallest picked worker-id tuple (then name), which
+    reduces to the legacy lowest-worker-id-first choice whenever
+    compactness doesn't distinguish the options.
+
+    ``pool`` entries need ``worker_id``/``name``; callers pass
+    same-priority candidates only (health and active-stability tiers are
+    decided before compactness ever gets a vote)."""
+    pool = sorted(pool, key=lambda n: (n.worker_id, n.name))
+    if room >= len(pool):
+        return list(pool)
+    if not fixed_ids:
+        # sliding window over the sorted pool: the minimal-span subset
+        # of size `room` is always `room` consecutive sorted entries
+        best = None
+        for i in range(len(pool) - room + 1):
+            window = pool[i:i + room]
+            span = window[-1].worker_id - window[0].worker_id
+            if best is None or span < best[0]:
+                best = (span, window)
+        return list(best[1])
+    lo, hi = min(fixed_ids), max(fixed_ids)
+    inside = [n for n in pool if lo <= n.worker_id <= hi]
+    picked = inside[:room]          # span-free picks first
+    need = room - len(picked)
+    if need <= 0:
+        return picked
+    left = sorted((n for n in pool if n.worker_id < lo),
+                  key=lambda n: (-n.worker_id, n.name))   # nearest first
+    right = [n for n in pool if n.worker_id > hi]         # nearest first
+    best = None
+    for take_left in range(need + 1):
+        take_right = need - take_left
+        if take_left > len(left) or take_right > len(right):
+            continue
+        ext = (lo - left[take_left - 1].worker_id if take_left else 0) \
+            + (right[take_right - 1].worker_id - hi if take_right else 0)
+        chosen = left[:take_left] + right[:take_right]
+        key = (ext, sorted((n.worker_id, n.name) for n in chosen))
+        if best is None or key < best[0]:
+            best = (key, chosen)
+    return picked + (best[1] if best else [])
+
+
+def _select_active(candidates: list, num_nodes: int, eff) -> list:
+    """The active-mesh choice: health first, incumbent-stability second
+    (healthy actives are never churned), mesh compactness third.  Within
+    the marginal tier — the one that only partially fits — spares are
+    picked to keep the domain's worker-id window contiguous
+    (:func:`_compact_fill`), so spare promotion heals toward a compact
+    dp-outer/tp-inner mesh instead of scattering it."""
+    tiers: dict[tuple[bool, bool], list] = {}
+    for n in candidates:
+        key = (not n.devices_healthy,
+               eff(n) not in ("", NODE_STATE_ACTIVE))
+        tiers.setdefault(key, []).append(n)
+    chosen: list = []
+    for key in sorted(tiers):
+        room = num_nodes - len(chosen)
+        if room <= 0:
+            break
+        pool = tiers[key]
+        if len(pool) <= room:
+            chosen.extend(sorted(pool,
+                                 key=lambda n: (n.worker_id, n.name)))
+        else:
+            chosen.extend(_compact_fill(
+                [n.worker_id for n in chosen], pool, room))
+    return chosen
+
+
 def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
                     now: float, lease_duration: float,
                     lease_ages: Optional[dict[str, float]] = None,
@@ -153,11 +230,13 @@ def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
       other);
     - a Lost node stale beyond ``LOST_REMOVAL_FACTOR`` leases is removed
       from ``status.nodes`` (the status shrink);
-    - the active set is the first ``spec.num_nodes`` candidates ordered
-      by (healthy devices, already-active, worker id, name) — so a
-      healthy spare drains an unhealthy active (the health subsystem's
-      drain path feeding placement), but healthy actives are never
-      churned;
+    - the active set is chosen by (healthy devices, already-active,
+      mesh compactness, worker id, name) — so a healthy spare drains an
+      unhealthy active (the health subsystem's drain path feeding
+      placement), healthy actives are never churned, and among
+      otherwise-equal spares the one keeping the active worker-id
+      window contiguous wins (ISSUE 13: spare promotion heals toward a
+      compact dp-outer/tp-inner mesh, docs/scaling.md);
     - the generation bumps iff the ACTIVE set changed.
 
     Returns None when nothing needs to change.  Nodes that never
@@ -213,7 +292,7 @@ def membership_plan(status: TpuSliceDomainStatus, spec: TpuSliceDomainSpec,
         not n.devices_healthy,
         eff(n) not in ("", NODE_STATE_ACTIVE),   # stability: keep actives
         n.worker_id, n.name))
-    new_active = candidates[:spec.num_nodes]
+    new_active = _select_active(candidates, spec.num_nodes, eff)
     active_names = {n.name for n in new_active}
     promotions: list[str] = []
     for n in candidates:

@@ -14,6 +14,7 @@ import json
 import os
 import shutil
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -33,6 +34,7 @@ from tpu_dra.plugins.tpu.allocatable import (
     enumerate_allocatable,
 )
 from tpu_dra.plugins.tpu.checkpoint import Checkpoint
+from tpu_dra.plugins.tpu.placement import claim_score, placement_metrics
 from tpu_dra.plugins.tpu.sharing import MultiProcessManager, hbm_defense_env
 from tpu_dra.resilience import failpoint
 from tpu_dra.tpulib.discovery import TpuLib
@@ -395,7 +397,39 @@ class DeviceState:
                 ))
                 edits_out[name] = edits
         self._check_overlap(uid, all_devices)
+        self._score_placement(uid, all_devices)
         return prepared, edits_out
+
+    def _score_placement(self, uid: str,
+                         devices: list[AllocatableDevice]) -> None:
+        """ICI-contiguity scoring of the scheduler's multi-chip choice
+        (ISSUE 13, docs/scaling.md "Topology-aware allocation").  The
+        driver cannot re-place a claim the scheduler already bound, but
+        it is the one component that KNOWS the torus — so every
+        multi-chip prepare measures how good the placement is, exports
+        the scoring cost (``tpu_dra_alloc_score_seconds``, gated by the
+        ``alloc_score_us`` bench budget), and warns when a claim landed
+        on non-adjacent chips (the exposed-comm floor the fused kernels
+        exist to hide is about to be paid for avoidable reasons)."""
+        chips = [d.chip for d in devices if d.chip is not None]
+        if len(chips) <= 1:
+            return
+        t0 = time.perf_counter()
+        try:
+            score = claim_score(chips)
+        except ValueError:
+            return   # unparseable topology: nothing to score
+        placement_metrics()["alloc_score_seconds"].observe(
+            time.perf_counter() - t0)
+        if score < 1.0:
+            klog.warning(
+                "multi-chip claim is not an ICI-contiguous sub-mesh; "
+                "collectives will pay dilated hops",
+                claim=uid, score=round(score, 3),
+                chips=[c.canonical_name() for c in chips])
+        else:
+            klog.info("multi-chip claim placement ICI-contiguous",
+                      level=4, claim=uid, chips=len(chips))
 
     def _check_health(self, uid: str,
                       devices: list[AllocatableDevice]) -> None:

@@ -16,6 +16,11 @@ from typing import Union
 
 from tpu_dra.api.quantity import format_quantity
 from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo
+from tpu_dra.tpulib.topology import (
+    coords_to_index,
+    parse_topology,
+    torus_neighbors,
+)
 
 
 def _attr_str(v: str) -> dict:
@@ -45,8 +50,19 @@ def chip_device(chip: ChipInfo, fabric_id: str = "") -> dict:
         "coresPerChip": _attr_int(chip.family.cores_per_chip),
         "multiHostCapable": _attr_bool(bool(fabric_id)),
     }
-    for axis, coord in zip("xyz", chip.coords):
-        attributes[f"ici{axis.upper()}"] = _attr_int(coord)
+    # the torus surface a topology-aware scheduler allocates on
+    # (ISSUE 13): per-axis mesh coordinates plus the chip's first-degree
+    # ICI neighbors as global indices — enough to reconstruct adjacency
+    # without re-deriving the wraparound rules driver-side
+    for axis, coord in zip("XYZ", chip.coords):
+        attributes[f"coord{axis}"] = _attr_int(coord)
+    try:
+        shape = parse_topology(chip.topology)
+        attributes["iciNeighbors"] = _attr_str(",".join(
+            str(coords_to_index(n, shape))
+            for n in torus_neighbors(chip.coords, shape)))
+    except ValueError:
+        pass   # unparseable topology string: no adjacency advertised
     if fabric_id:
         attributes["fabricID"] = _attr_str(fabric_id)
     capacity = {

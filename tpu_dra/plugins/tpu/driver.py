@@ -40,6 +40,11 @@ from tpu_dra.kubeletplugin import (
 from tpu_dra.plugins.metrics import observe_prepare, observe_unprepare
 from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+from tpu_dra.plugins.tpu.placement import (
+    board_from_chips,
+    fragmentation_ratio,
+    placement_metrics,
+)
 from tpu_dra.plugins.tpu.utilization import ChipSecondsAccountant
 from tpu_dra.plugins.tpu.deviceinfo import chip_device, core_device
 from tpu_dra.tpulib.discovery import TpuLib
@@ -129,6 +134,11 @@ class TpuDriver:
             heartbeat_dir=self.heartbeat_dir,
             active_stale_after=cfg.heartbeat_stale_after)
         self.health.add_poll_listener(self.utilization.tick)
+        # torus fragmentation (ISSUE 13): how much of this node's free
+        # board is still reachable through one contiguous sub-mesh —
+        # computed off the poll loop (never the prepare hot path) from
+        # the same pinned/unhealthy views the utilization accountant uses
+        self.health.add_poll_listener(self._update_fragmentation)
         self.server = KubeletPluginServer(
             driver_name=DRIVER_NAME,
             node_name=cfg.node_name,
@@ -176,6 +186,25 @@ class TpuDriver:
                          unhealthy=self.health.unhealthy_names())
         self.server.publish_resources(devices)
         self._published_down = down
+
+    def _update_fragmentation(self) -> Optional[float]:
+        """Poll listener: recompute ``tpu_dra_torus_fragmentation_ratio``
+        over the node-local board.  Free = healthy chips with no
+        prepared claim pinned to them (a chip whose cores are claimed
+        counts as busy: no full-chip sub-mesh can include it).  Returns
+        the ratio it published (None on a chipless node)."""
+        chips = [d.chip for d in self.state.allocatable.values()
+                 if d.chip is not None]
+        if not chips:
+            return None
+        shape, coords = board_from_chips(chips)
+        down = self.health.unhealthy_uuids()
+        busy = set(self._pinned_claims())
+        free = {coords[c.uuid] for c in chips
+                if c.uuid not in down and c.uuid not in busy}
+        ratio = fragmentation_ratio(free, shape)
+        placement_metrics()["fragmentation_ratio"].set(ratio)
+        return ratio
 
     # -- API-blackout degradation (docs/resilience.md) ---------------------
     def _api_blackout(self) -> bool:

@@ -1,0 +1,239 @@
+"""Topology-aware device placement (ISSUE 13, docs/scaling.md
+"Topology-aware allocation").
+
+The DeviceClass advertises ICI-topology attributes precisely so
+multi-chip claims stay ICI-reachable (PAPER.md: "tpu.google.com
+DeviceClass with ICI-topology attributes"); this module is the layer
+that actually USES them.  Three surfaces:
+
+- **Selector** (:class:`TopologySelector`): given a claim's chip count
+  and the free coordinate set of a board, pick an axis-aligned
+  contiguous sub-mesh.  ``best-fit`` (the default) places into the
+  smallest box of the free set's rectangle decomposition that fits, so
+  big contiguous blocks survive for the multi-chip claims that need
+  them; ``first-fit`` (the pre-ISSUE-13 naive baseline, kept behind the
+  strategy flag as the fleetsim control arm) takes the first feasible
+  placement in scan order.
+- **Scoring** (:func:`claim_score`): how ICI-usable an already-chosen
+  chip set is — the prepare hot path scores every multi-chip claim it
+  binds (``tpu_dra_alloc_score_seconds``) and logs a warning when the
+  scheduler handed it a non-contiguous set.  Must stay microseconds:
+  gated by ``alloc_score_us`` in bench-budget.json.
+- **Board accounting** (:func:`board_from_chips`,
+  :func:`fragmentation_ratio`): normalize a node's chips into a local
+  board (its axis-aligned slice of the full torus) and compute the
+  fleet fragmentation score the driver exports as
+  ``tpu_dra_torus_fragmentation_ratio``.
+
+The scheduler-side consumer is `hack/fleetsim.py`'s ``phase alloc``; it
+re-derives the board from the PUBLISHED ResourceSlice attributes
+(``coordX``/``coordY``/``coordZ`` + ``iciNeighbors``,
+:func:`device_coords`), proving the advertised surface carries enough
+topology to allocate on.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from tpu_dra.tpulib.discovery import ChipInfo
+from tpu_dra.tpulib.topology import (
+    contiguity_score,
+    fragmentation,
+    num_chips,
+    parse_topology,
+    rectangle_decomposition,
+    submesh_cells,
+    submesh_origins,
+    submesh_shapes,
+)
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_METRICS = None
+
+
+def placement_metrics():
+    # cached like plugin_metrics(): claim_score sits on the per-claim
+    # prepare hot path and the registry lookup is a lock hop
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = {
+            "alloc_score_seconds": DEFAULT_REGISTRY.histogram(
+                "tpu_dra_alloc_score_seconds",
+                "wall time spent scoring a multi-chip claim's ICI "
+                "contiguity on the prepare path",
+                buckets=(5e-6, 2e-5, 5e-5, 1e-4, 5e-4, 2e-3, 1e-2)),
+            "fragmentation_ratio": DEFAULT_REGISTRY.gauge(
+                "tpu_dra_torus_fragmentation_ratio",
+                "1 - largest allocatable axis-aligned sub-mesh / free "
+                "chips on this node's board (0 = every free chip "
+                "reachable through one contiguous block)"),
+        }
+    return _METRICS
+
+
+# -- board normalization ----------------------------------------------------
+
+def board_from_chips(chips: Iterable[ChipInfo]
+                     ) -> tuple[tuple[int, ...], dict]:
+    """(local board shape, uuid → local coords) for one node's chips.
+
+    A node holds an axis-aligned slab of the slice torus (its worker's
+    chips are consecutive global indices → a contiguous coordinate
+    box), so fragmentation/contiguity over the node-local board is
+    exact for the links the node can actually allocate across."""
+    chips = list(chips)
+    if not chips:
+        return (), {}
+    dims = len(chips[0].coords)
+    los = tuple(min(c.coords[a] for c in chips) for a in range(dims))
+    his = tuple(max(c.coords[a] for c in chips) for a in range(dims))
+    shape = tuple(h - l + 1 for l, h in zip(los, his))
+    coords = {c.uuid: tuple(x - l for x, l in zip(c.coords, los))
+              for c in chips}
+    return shape, coords
+
+
+def fragmentation_ratio(free: "set[tuple[int, ...]]",
+                        shape: tuple[int, ...]) -> float:
+    """The exported fleet fragmentation score (see topology.fragmentation
+    for the definition; re-exported here so the driver and the simulator
+    share one callsite-visible contract)."""
+    return fragmentation(free, shape)
+
+
+# -- hot-path claim scoring -------------------------------------------------
+
+def claim_score(chips: list[ChipInfo]) -> float:
+    """ICI-contiguity score of an already-allocated chip set, in (0, 1]
+    (1.0 = axis-aligned contiguous sub-mesh; see
+    topology.contiguity_score).  Coordinates come straight off the
+    discovered chips; the slice topology string on the first chip names
+    the torus the distances wrap on."""
+    if len(chips) <= 1:
+        return 1.0
+    shape = parse_topology(chips[0].topology)
+    return contiguity_score({c.coords for c in chips}, shape)
+
+
+# -- selection --------------------------------------------------------------
+
+STRATEGY_BEST_FIT = "best-fit"
+STRATEGY_FIRST_FIT = "first-fit"
+
+
+class TopologySelector:
+    """Pick an axis-aligned contiguous sub-mesh of ``count`` free chips.
+
+    ``select`` places within one board; ``select_board`` is the
+    fleet-level entry (a list of boards) and is where the strategies
+    diverge HARDEST — measured by the fleetsim alloc phase, board
+    policy dominates cell policy:
+
+    - ``best-fit``: boards fullest-feasible-first (bin packing: small
+      claims densify already-busy boards, keeping empty boards whole as
+      reserves for the big sub-mesh claims), cells by best-fit on the
+      free set's rectangle decomposition (smallest box that fits,
+      anchored at its corner), compact shapes first.
+    - ``first-fit`` (the pre-ISSUE-13 naive baseline, kept behind this
+      flag as the fleetsim control arm): boards most-free-first (the
+      spread policy of a topology-blind least-allocated scorer), cells
+      by first feasible placement in raw factorization scan order.
+
+    Both only ever return contiguous placements (``None`` = the
+    multi-chip allocation failure the alloc phase counts); the
+    difference is what they leave behind."""
+
+    def __init__(self, strategy: str = STRATEGY_BEST_FIT) -> None:
+        if strategy not in (STRATEGY_BEST_FIT, STRATEGY_FIRST_FIT):
+            raise ValueError(f"unknown placement strategy {strategy!r}")
+        self.strategy = strategy
+
+    def select(self, count: int, free: "set[tuple[int, ...]]",
+               shape: tuple[int, ...]
+               ) -> Optional[list[tuple[int, ...]]]:
+        if count <= 0 or count > len(free):
+            return None
+        if count == 1:
+            if self.strategy == STRATEGY_FIRST_FIT:
+                return [min(free)]
+            # best-fit singles too: burn a chip out of the smallest
+            # fragment so 1-chip claims stop nibbling the big blocks
+            rects = rectangle_decomposition(free, shape)
+            origin, _ = min(rects, key=lambda r: (num_chips(r[1]), r[0]))
+            return [origin]
+        if self.strategy == STRATEGY_FIRST_FIT:
+            return _scan(submesh_shapes(count, shape, compact=False),
+                         free, shape)
+        return self._best_fit(count, free, shape)
+
+    def select_board(self, count: int, boards: list
+                     ) -> Optional[tuple[int, list[tuple[int, ...]]]]:
+        """Fleet-level placement over ``boards`` (each with ``free`` and
+        ``shape``): (board index, cells) or None when no board can host
+        a contiguous placement."""
+        if self.strategy == STRATEGY_FIRST_FIT:
+            order = sorted(
+                (i for i in range(len(boards))
+                 if len(boards[i].free) >= count),
+                key=lambda i: (-len(boards[i].free), i))
+        else:
+            order = sorted(
+                (i for i in range(len(boards))
+                 if len(boards[i].free) >= count),
+                key=lambda i: (len(boards[i].free), i))
+        for bi in order:
+            cells = self.select(count, boards[bi].free, boards[bi].shape)
+            if cells is not None:
+                return bi, cells
+        return None
+
+    @staticmethod
+    def _best_fit(count, free, shape):
+        """Best-fit on the rectangle decomposition: place into the
+        smallest free box that can contain the claim (tightest
+        leftover), anchored at the box corner so the remnant stays one
+        box.  Falls back to the compact-order feasibility scan when the
+        claim only fits straddling decomposition boundaries."""
+        shapes = submesh_shapes(count, shape)
+        rects = sorted(rectangle_decomposition(free, shape),
+                       key=lambda r: (num_chips(r[1]), r[0]))
+        for origin, rect in rects:
+            if num_chips(rect) < count:
+                continue
+            for sub in shapes:
+                if all(s <= r for s, r in zip(sub, rect)):
+                    return submesh_cells(origin, sub)
+        return _scan(shapes, free, shape)
+
+
+def _scan(shapes, free, shape):
+    """First feasible placement in the given shape order."""
+    for sub in shapes:
+        for origin in submesh_origins(sub, shape):
+            cells = submesh_cells(origin, sub)
+            if all(c in free for c in cells):
+                return cells
+    return None
+
+
+# -- published-attribute round trip (the scheduler's view) ------------------
+
+_COORD_AXES = ("coordX", "coordY", "coordZ")
+
+
+def device_coords(device: dict) -> Optional[tuple[int, ...]]:
+    """Coordinates of a published ResourceSlice chip Device, from its
+    ``coordX``/``coordY``/``coordZ`` attributes (None for cores and
+    pre-ISSUE-13 producers).  This is the contract the fleetsim
+    scheduler — and any real topology-aware scheduler plugin —
+    allocates on."""
+    attrs = device.get("basic", {}).get("attributes", {})
+    if attrs.get("type", {}).get("string") != "chip":
+        return None
+    coords = []
+    for axis in _COORD_AXES:
+        if axis not in attrs:
+            break
+        coords.append(int(attrs[axis]["int"]))
+    return tuple(coords) if coords else None

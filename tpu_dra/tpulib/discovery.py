@@ -31,8 +31,10 @@ from tpu_dra.tpulib.topology import (
     TpuFamily,
     chip_coords,
     family_for_accelerator_type,
+    num_chips,
     parse_topology,
 )
+from tpu_dra.util import klog
 
 # Namespace for stable chip UUIDs: uuid5(host machine id, accel path).
 _UUID_NS = uuidlib.UUID("6ba7b812-9dad-11d1-80b4-00c04fd430c8")
@@ -249,9 +251,25 @@ class RealTpuLib(TpuLib):
             topology = f"{n}x1"
         shape = parse_topology(topology)
         worker = int(meta.get("TPU_WORKER_ID", "0"))
+        paths = self.device_paths()
+        if worker * family.chips_per_host + len(paths) > num_chips(shape):
+            # skewed metadata (a worker id with no/too-small topology):
+            # chip_coords would reject the out-of-range indices, and
+            # pre-ISSUE-13 they silently wrapped onto other chips'
+            # coordinates — either way the advertised torus would be a
+            # lie.  Degrade to a node-local board (this host as its own
+            # line, worker 0) instead of failing discovery: the chips
+            # still publish and prepare; only cross-host placement
+            # quality is lost, and the log says why.
+            klog.warning(
+                "TPU topology does not cover this worker's chips; "
+                "falling back to a node-local board",
+                topology=topology, worker=worker, chips=len(paths))
+            topology = f"{len(paths) or 1}x1"
+            shape = parse_topology(topology)
+            worker = 0
         machine = self._machine_id()
         chips: list[ChipInfo] = []
-        paths = self.device_paths()
         for i, path in enumerate(paths):
             m = re.search(r"(\d+)$", path)
             minor = int(m.group(1)) if m else i

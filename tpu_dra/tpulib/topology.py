@@ -75,9 +75,16 @@ def family_for_accelerator_type(accel_type: str) -> TpuFamily:
 
 
 def parse_topology(topology: str) -> tuple[int, ...]:
-    """``"4x4"`` → (4, 4); ``"2x2x2"`` → (2, 2, 2)."""
+    """``"4x4"`` → (4, 4); ``"2x2x2"`` → (2, 2, 2).
+
+    Degenerate forms are real: single-chip hosts report ``"1"``/``"1x1"``
+    and 1D slices report a bare chip count (``"8"``) or a padded 3D form
+    with unit axes (``"2x4x1"``, the v4 sub-cube spelling) — all parse to
+    their literal shapes, unit axes preserved (a unit axis still names a
+    coordinate the scheduler sees in the published attributes).
+    """
     try:
-        dims = tuple(int(d) for d in topology.lower().split("x"))
+        dims = tuple(int(d) for d in topology.strip().lower().split("x"))
     except ValueError as exc:
         raise ValueError(f"malformed topology {topology!r}") from exc
     if not dims or any(d <= 0 for d in dims):
@@ -91,8 +98,15 @@ def chip_coords(global_index: int, shape: tuple[int, ...]) -> tuple[int, ...]:
     This is the attribute surface schedulers use to co-locate claims on
     ICI-adjacent chips (the analog of the reference's MIG placement model,
     deviceinfo.go:132-194 — there overlap is over memory slices, here
-    adjacency is over the ICI mesh).
+    adjacency is over the ICI mesh).  An out-of-range index raises: the
+    old behavior silently wrapped the outermost axis, mapping two chips
+    onto one coordinate — exactly the corruption a placement layer built
+    on these coordinates must never inherit.
     """
+    if not 0 <= global_index < num_chips(shape):
+        raise ValueError(
+            f"chip index {global_index} outside topology {shape} "
+            f"({num_chips(shape)} chips)")
     coords = []
     for dim in reversed(shape):
         coords.append(global_index % dim)
@@ -100,8 +114,241 @@ def chip_coords(global_index: int, shape: tuple[int, ...]) -> tuple[int, ...]:
     return tuple(reversed(coords))
 
 
+def coords_to_index(coords: tuple[int, ...], shape: tuple[int, ...]) -> int:
+    """Inverse of :func:`chip_coords` (row-major).  Rejects coordinates
+    outside the shape — the round-trip ``coords_to_index(chip_coords(i))
+    == i`` holds for every in-range index."""
+    if len(coords) != len(shape) or \
+            any(not 0 <= c < d for c, d in zip(coords, shape)):
+        raise ValueError(f"coords {coords} outside topology {shape}")
+    index = 0
+    for c, dim in zip(coords, shape):
+        index = index * dim + c
+    return index
+
+
 def num_chips(shape: tuple[int, ...]) -> int:
     n = 1
     for d in shape:
         n *= d
     return n
+
+
+# -- torus model (topology-aware allocation, docs/scaling.md) ---------------
+#
+# Everything below treats a slice as an axis-aligned box of chips.  The
+# physical ICI fabric is a torus (wraparound links close each ring), so
+# distances honor the wrap, but sub-mesh/rectangle enumeration is
+# deliberately wrap-free: a wrapped rectangle is a valid mesh only when
+# the whole axis ring participates, and being conservative here means a
+# "contiguous" verdict is never optimistic.
+
+def torus_neighbors(coords: tuple[int, ...],
+                    shape: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """First-degree ICI neighbors of a chip, with torus wraparound.
+
+    Along an axis of size 1 there is no link; size 2 has ONE link to the
+    peer (the +1 and wrap-around neighbor are the same chip — emitting it
+    twice would double-count the edge); size ≥3 links both ways."""
+    out: list[tuple[int, ...]] = []
+    for axis, dim in enumerate(shape):
+        if dim <= 1:
+            continue
+        steps = (1,) if dim == 2 else (1, -1)
+        for step in steps:
+            n = list(coords)
+            n[axis] = (coords[axis] + step) % dim
+            out.append(tuple(n))
+    return out
+
+
+def ici_distance(a: tuple[int, ...], b: tuple[int, ...],
+                 shape: tuple[int, ...]) -> int:
+    """Minimal ICI hop count between two chips: per-axis ring distance
+    (the shorter way around the torus), summed."""
+    total = 0
+    for x, y, dim in zip(a, b, shape):
+        d = abs(x - y)
+        total += min(d, dim - d)
+    return total
+
+
+def submesh_shapes(count: int, shape: tuple[int, ...],
+                   compact: bool = True) -> list[tuple[int, ...]]:
+    """Axis-aligned sub-mesh shapes holding exactly ``count`` chips that
+    fit inside ``shape``.  With ``compact=True`` (the topology-aware
+    order) most compact first — smallest max axis, then smallest
+    perimeter: ``(2, 2)`` before ``(1, 4)`` on a ``4x4`` board, the
+    minimum-diameter mesh a latency-minimizing selector should try
+    first.  ``compact=False`` returns raw factorization order (strips
+    first) — what a topology-blind allocator stumbles into, kept as the
+    naive-baseline contract."""
+    out: list[tuple[int, ...]] = []
+
+    def rec(axis: int, remaining: int, dims: list[int]) -> None:
+        if axis == len(shape):
+            if remaining == 1:
+                out.append(tuple(dims))
+            return
+        for d in range(1, min(remaining, shape[axis]) + 1):
+            if remaining % d == 0:
+                dims.append(d)
+                rec(axis + 1, remaining // d, dims)
+                dims.pop()
+
+    rec(0, count, [])
+    if compact:
+        out.sort(key=lambda dims: (max(dims), sum(dims), dims))
+    return out
+
+
+def submesh_cells(origin: tuple[int, ...],
+                  sub: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """All coordinates of the axis-aligned box ``sub`` anchored at
+    ``origin`` (no wrap — callers enumerate only in-bounds origins)."""
+    cells = [origin]
+    for axis, size in enumerate(sub):
+        if size == 1:
+            continue
+        cells = [c[:axis] + (c[axis] + k,) + c[axis + 1:]
+                 for c in cells for k in range(size)]
+    return cells
+
+
+def submesh_origins(sub: tuple[int, ...],
+                    shape: tuple[int, ...]) -> list[tuple[int, ...]]:
+    """Row-major origins where the box ``sub`` fits inside ``shape`` —
+    THE origin-enumeration rule, shared by the selector's feasibility
+    scans and the decomposition/fragmentation walkers below so the two
+    can never disagree about where a box may sit."""
+    ranges = [range(dim - s + 1) for s, dim in zip(sub, shape)]
+    coords: list[tuple[int, ...]] = [()]
+    for r in ranges:
+        coords = [c + (k,) for c in coords for k in r]
+    return coords
+
+
+# every axis-aligned box shape that fits the board, largest volume
+# first — depends only on the board shape, so the handful of shapes a
+# process ever sees are enumerated once
+_BOX_CACHE: dict = {}
+
+
+def _all_boxes(shape: tuple[int, ...]) -> list[tuple[int, ...]]:
+    boxes = _BOX_CACHE.get(shape)
+    if boxes is None:
+        boxes = [()]
+        for dim in shape:
+            boxes = [b + (d,) for b in boxes for d in range(1, dim + 1)]
+        boxes.sort(key=num_chips, reverse=True)
+        _BOX_CACHE[shape] = boxes
+    return boxes
+
+
+def is_submesh(coords: "set[tuple[int, ...]] | frozenset",
+               shape: tuple[int, ...]) -> bool:
+    """True iff ``coords`` is exactly one axis-aligned sub-mesh: each
+    axis's values form a contiguous interval and the set is the full
+    cross product (no holes)."""
+    if not coords:
+        return False
+    spans = []
+    for axis in range(len(shape)):
+        vals = {c[axis] for c in coords}
+        lo, hi = min(vals), max(vals)
+        if len(vals) != hi - lo + 1:
+            return False
+        spans.append(hi - lo + 1)
+    return num_chips(tuple(spans)) == len(coords)
+
+
+def contiguity_score(coords: "set[tuple[int, ...]]",
+                     shape: tuple[int, ...]) -> float:
+    """How ICI-usable a chip set is, in (0, 1].
+
+    1.0 = an axis-aligned contiguous sub-mesh (collectives ride
+    nearest-neighbor ICI with no dilation).  Otherwise the ratio of the
+    best achievable mean pairwise hop distance (the most compact
+    sub-mesh of the same size) to the set's actual mean pairwise hop
+    distance — a scattered placement scores low in proportion to the
+    extra wire every collective pays."""
+    n = len(coords)
+    if n <= 1:
+        return 1.0
+    if is_submesh(coords, shape):
+        return 1.0
+    pts = list(coords)
+    actual = sum(ici_distance(pts[i], pts[j], shape)
+                 for i in range(n) for j in range(i + 1, n))
+    shapes = submesh_shapes(n, shape)
+    if shapes:
+        ideal_cells = submesh_cells(tuple(0 for _ in shape), shapes[0])
+        ideal = sum(ici_distance(ideal_cells[i], ideal_cells[j], shape)
+                    for i in range(n) for j in range(i + 1, n))
+    else:   # count doesn't factor into the box: compare against a line
+        ideal = sum(abs(i - j)
+                    for i in range(n) for j in range(i + 1, n))
+    if actual <= 0:
+        return 1.0
+    return min(1.0, max(ideal, 1) / actual)
+
+
+def largest_free_submesh(free: "set[tuple[int, ...]]",
+                         shape: tuple[int, ...]) -> int:
+    """Chip count of the largest axis-aligned sub-mesh whose cells are
+    all free — the "biggest claim still placeable" number and the
+    numerator of the fragmentation score.  Largest volumes first with
+    early exit, so the common healthy-board case is one probe."""
+    if not free:
+        return 0
+    best = 0
+    for sub in _all_boxes(shape):
+        vol = num_chips(sub)
+        if vol <= best or vol > len(free):
+            continue
+        for origin in submesh_origins(sub, shape):
+            if all(c in free for c in submesh_cells(origin, sub)):
+                best = vol
+                break
+    return best
+
+
+def fragmentation(free: "set[tuple[int, ...]]",
+                  shape: tuple[int, ...]) -> float:
+    """Fleet fragmentation score in [0, 1): ``1 − largest allocatable
+    axis-aligned sub-mesh / free chips``.  0.0 = every free chip is
+    reachable through one contiguous block (a fully-busy board is also
+    0.0: nothing free means nothing fragmented)."""
+    if not free:
+        return 0.0
+    return round(1.0 - largest_free_submesh(free, shape) / len(free), 6)
+
+
+def rectangle_decomposition(
+        free: "set[tuple[int, ...]]", shape: tuple[int, ...]
+) -> list[tuple[tuple[int, ...], tuple[int, ...]]]:
+    """Greedy decomposition of the free set into disjoint axis-aligned
+    boxes, largest first: repeatedly carve out the biggest all-free box
+    until nothing is left.  The best-fit selector places claims into the
+    SMALLEST box of the decomposition that fits, keeping the large
+    blocks intact for the multi-chip claims that need them."""
+    remaining = set(free)
+    out: list[tuple[tuple[int, ...], tuple[int, ...]]] = []
+    while remaining:
+        found = None
+        for sub in _all_boxes(shape):
+            if num_chips(sub) > len(remaining):
+                continue
+            for origin in submesh_origins(sub, shape):
+                cells = submesh_cells(origin, sub)
+                if all(c in remaining for c in cells):
+                    found = (origin, sub, cells)
+                    break
+            if found:
+                break
+        if found is None:   # unreachable: a 1-cell box always fits
+            break
+        origin, sub, cells = found
+        out.append((origin, sub))
+        remaining.difference_update(cells)
+    return out
