@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-fleet drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -119,6 +119,16 @@ drive-serve:
 # zero in-flight losses
 drive-overload:
 	$(PYTHON) hack/drive_overload.py
+
+# cluster-serving acceptance (docs/scaling.md "Cluster serving",
+# ISSUE 14): REAL kubelet plugin + REAL serve replicas on REAL gRPC-
+# prepared claims behind the REAL router binary — disaggregated
+# prefill/decode byte-identity, an N=4 fleet sustaining >=3x the
+# pinned single-replica QPS under a p99 gate while one replica is
+# drained+killed mid-run and the autoscaler replaces it through the
+# claim path with zero in-flight losses
+drive-fleet:
+	$(PYTHON) hack/drive_fleet.py
 
 proto:
 	cd tpu_dra/kubeletplugin/proto && \

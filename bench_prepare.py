@@ -204,6 +204,35 @@ def bench_alloc_score(n: int = 5_000, repeats: int = 3) -> dict:
     return {"n": n, "per_score_us": round(best / n * 1e6, 4)}
 
 
+def bench_router_decision(n: int = 50_000, repeats: int = 3) -> dict:
+    """ISSUE 14 router gate: ``Router.decide`` — the per-request
+    routing decision (replica scoring scan + session-affinity lookup)
+    — must stay O(10µs), or the cluster front-end becomes the new
+    hot-path regression on EVERY fleet request.  Measured over the
+    production shape: a 4-replica fleet with probed scores, half the
+    decisions affinity hits and half fresh sessions (the LRU insert is
+    part of the decision cost).  Best-of-``repeats`` like the other
+    idle gates."""
+    from tpu_dra.workloads.router import Replica, Router
+
+    router = Router(probe_interval_s=3600.0)   # prober never started
+    for i in range(4):
+        rep = Replica(name=f"r{i}", url=f"http://127.0.0.1:{9000 + i}")
+        rep.score = 0.1 * i
+        router._replicas[rep.name] = rep
+    with router._mu:
+        router._publish_locked()
+    assert router.decide().name == "r0"
+    assert router.decide(session="warm").name == "r0"
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(n):
+            router.decide(session="warm" if i % 2 else f"s{i % 1024}")
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_decision_us": round(best / n * 1e6, 4)}
+
+
 def bench_kernel_throughput() -> dict:
     """Kernel-throughput ratchet section (ISSUE 10): floors for the
     Pallas kernel family (matmul, flash, the fused collective matmuls),
@@ -447,6 +476,7 @@ def run_all() -> dict:
         "observe_idle": bench_observe_idle(),
         "admission_idle": bench_admission_idle(),
         "alloc_score": bench_alloc_score(),
+        "router_decision": bench_router_decision(),
         "kernels": bench_kernel_throughput(),
         "direct": bench_direct(base),
         "concurrent": bench_concurrent(base),
@@ -489,6 +519,8 @@ def _gates(report: dict) -> dict[str, float]:
             report["admission_idle"]["per_check_us"],
         "alloc_score_us":
             report["alloc_score"]["per_score_us"],
+        "router_decision_us":
+            report["router_decision"]["per_decision_us"],
     }
 
 

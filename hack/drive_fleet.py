@@ -1,0 +1,623 @@
+"""Cluster-serving acceptance drive (``make drive-fleet``, ISSUE 14,
+docs/scaling.md "Cluster serving").
+
+Everything real: the kubelet plugin runs as a subprocess over its DRA
+unix socket against the KubeTestServer facade, every replica's chip is
+claimed through REAL gRPC ``NodePrepareResources`` (and released
+through ``NodeUnprepareResources``), the replicas are REAL serve
+binaries, the router is the REAL ``python -m tpu_dra.workloads.router``
+binary discovering them through the fleet file + the plugin's claim
+checkpoint, and the load generator is drive_serve's open-loop
+``run_load`` pointed at the router via its target hook.
+
+Replica capacity is pinned with the ``serve.engine.slow_decode``
+failpoint (the drive_overload trick): sustainable QPS is a property of
+the schedule, not CPU weather.
+
+Phase 1 — disaggregated prefill/decode:
+  a prefill-role and a decode-role replica (each on its own prepared
+  claim) behind a ``--disaggregate`` router.  Asserted: /generate via
+  the router (prefill → KV blob → decode_handoff) returns EXACTLY the
+  tokens the decode replica's own /generate returns — disaggregation
+  must never change model output — and the router counted the handoff.
+
+Phase 2 — fleet throughput + autoscaler through the claim path:
+  one replica is prepared and baselined at an offered rate safely
+  under its pinned capacity.  The autoscaler (fleet_state = the
+  router's /debug/fleet) is started with target 4 and ASSEMBLES the
+  fleet itself — three heal actions, each a real claim prepare + spawn.
+  The fleet then takes ~3.5x the single-replica offered rate while,
+  mid-run, one replica is drained (SIGTERM → graceful drain → exit 0)
+  and killed.  Asserted:
+  - the router ejects the draining replica within a probe interval and
+    the autoscaler replaces it through the claim path (a fresh
+    prepared claim + spawned replica joins the rotation);
+  - ZERO client-visible errors (the router retries draining sheds) and
+    the victim exits 0 — zero in-flight losses;
+  - fleet completed QPS >= 3x the measured single-replica QPS with
+    client p99 under the gate;
+  - the victim's claim is unprepared (real gRPC) after its drain, and
+    the checkpoint's prepared set matches the live fleet;
+  - one trace id spans client → router → replica (the replica's
+    /debug/traces resolves the client's traceparent).
+"""
+
+import json
+import os
+import pathlib
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from drive_plugin import rpc  # noqa: E402 — the shared gRPC helper
+from drive_serve import (  # noqa: E402 — the shared open-loop generator
+    free_port,
+    http_get,
+    make_checkpoint,
+    run_load,
+    wait_until,
+)
+from tpu_dra.k8s import RESOURCE_CLAIMS  # noqa: E402
+from tpu_dra.k8s.testserver import KubeTestServer  # noqa: E402
+from tpu_dra.kubeletplugin.proto import (  # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.version import DRIVER_NAME  # noqa: E402
+from tpu_dra.workloads.router import (  # noqa: E402
+    Autoscaler,
+    fleet_state_http,
+)
+
+N_CHIPS = 8                     # fake node size: fleet + replacement slack
+SLOW_DECODE_MS = 60             # pinned engine speed (per batcher pass)
+# steps=3 with chunk=2: one token at admission + one chunk pass — each
+# request holds its slot for ONE pinned pass, so per-replica capacity
+# (and therefore the fleet's latency margin through the replacement
+# window) is a deterministic ~2 slots / 60ms, not a CPU-weather number
+STEPS = 3
+SINGLE_QPS = 4                  # offered baseline, under pinned capacity
+# replicas run WITH admission armed (~8 requests' worth of cost): when
+# the replacement's cold start starves the survivors on a small CI
+# host, the dip degrades into TYPED 503s + Retry-After that the router
+# passes through — never into silent client timeouts (the pre-PR-9
+# failure mode).  Sheds are not losses; the zero-loss gate below
+# distinguishes them.  12 requests bounds worst-case queueing delay
+# under the 15s client timeout even when CPU weather stretches a pass
+# to ~1s, while staying loose enough that the baseline's ordinary
+# weather tail admits instead of shedding.
+PROMPT_TOKENS = 3
+ADMISSION_MAX_COST = 12 * (PROMPT_TOKENS + STEPS)
+BASELINE_SECS = 6.0
+FLEET_TARGET = 4
+# ~3.25x the baseline offered rate: enough headroom over the 3.0x
+# completed-rate floor, while staying comfortably inside what a small
+# shared CI host can aggregate across 4 concurrent jax processes —
+# gating AT the host's capacity edge made the verdict CPU weather
+FLEET_QPS = 13
+FLEET_SECS = 24.0
+KILL_AT_S = 6.0                 # victim drained+killed this far into load
+FLEET_FACTOR_FLOOR = 3.0        # fleet completed >= 3x single completed
+# sanity bound, not a tight latency claim: with the failpoint pinning
+# capacity, THROUGHPUT is the deterministic gate — p99 on a shared
+# 2-core CI host carries the CPU weather of 4+ concurrent jax
+# processes, so the bound only needs to catch queueing collapse
+# (pre-admission overload drove p99 to client timeout, ~15s)
+P99_GATE_S = 8.0
+DRAIN_GRACE_S = 10.0
+PROBE_INTERVAL_S = 0.5
+
+MODEL_FLAGS = ["--vocab", "64", "--d-model", "32", "--n-heads", "2",
+               "--n-layers", "2", "--d-ff", "64", "--max-seq", "64"]
+
+
+def log(msg: str) -> None:
+    print(f"[drive-fleet] {msg}", flush=True)
+
+
+def die(msg: str) -> None:
+    print(f"[drive-fleet] FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+class LineReader:
+    """Drain a child's stdout on a thread (a full pipe wedges the
+    child) and expose the lines for readiness scanning."""
+
+    def __init__(self, proc: subprocess.Popen) -> None:
+        self.lines: list[str] = []
+        self._mu = threading.Lock()
+
+        def pump():
+            for line in proc.stdout:
+                with self._mu:
+                    self.lines.append(line.rstrip())
+        threading.Thread(target=pump, daemon=True).start()
+
+    def saw(self, needle: str) -> bool:
+        with self._mu:
+            return any(needle in ln for ln in self.lines)
+
+
+class Drive:
+    """Shared plugin/cluster context for both phases."""
+
+    def __init__(self, base: str) -> None:
+        self.base = pathlib.Path(base)
+        self.srv = KubeTestServer().start()
+        self.kcfg = self.srv.write_kubeconfig(str(self.base / "kubeconfig"))
+        root = self.base / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(N_CHIPS):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "etc").mkdir()
+        (root / "etc" / "machine-id").write_text("deadbeefcafe\n")
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            f"TPU_ACCELERATOR_TYPE: 'v5litepod-{N_CHIPS}'\n"
+            f"TPU_TOPOLOGY: '2x4'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+        env = {**os.environ, "PYTHONPATH": REPO}
+        self.plugin = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+             "--kubeconfig", self.kcfg, "--node-name", "node-a",
+             "--tpu-driver-root", str(root),
+             "--kubelet-plugins-dir", str(self.base / "plugins"),
+             "--kubelet-registry-dir", str(self.base / "registry"),
+             "--cdi-root", str(self.base / "cdi"),
+             "--ignore-host-tpu-env"], cwd=REPO, env=env)
+        self.dra_sock = str(self.base / "plugins" / DRIVER_NAME /
+                            "dra.sock")
+        self.ckpt_path = str(self.base / "plugins" / DRIVER_NAME /
+                             "checkpoint.json")
+        wait_until(lambda: os.path.exists(self.dra_sock), timeout=60,
+                   what="plugin DRA socket")
+        self.model_ckpt = make_checkpoint(str(self.base))
+        # one shared persistent compile cache: later replica spawns
+        # (and the mid-run replacement) warm up in seconds, not minutes
+        self.compile_cache = str(self.base / "jax-cache")
+
+    def prepared_claims(self) -> dict:
+        with open(self.ckpt_path) as f:
+            payload = json.load(f)
+        data = payload.get("data")
+        if isinstance(data, str):
+            payload = json.loads(data)
+        return payload.get("preparedClaims", {})
+
+    def stop(self) -> None:
+        self.plugin.terminate()
+        try:
+            self.plugin.wait(10)
+        except subprocess.TimeoutExpired:
+            self.plugin.kill()
+            self.plugin.wait(5)
+        self.srv.stop()
+
+
+class FleetLauncher:
+    """The Autoscaler's launcher, speaking the REAL claim path: every
+    ``prepare`` is a ResourceClaim + gRPC NodePrepareResources + a
+    spawned serve binary + a fleet-file registration; every
+    ``unprepare`` is the gRPC release.  ``drain`` is the k8s-shaped
+    SIGTERM graceful drain the serve binary implements."""
+
+    def __init__(self, drive: Drive, fleet_file: str) -> None:
+        self.drive = drive
+        self.fleet_file = fleet_file
+        self.replicas: dict[str, dict] = {}
+        self.free_devices = deque(range(N_CHIPS))
+        self.counter = 0
+        self.mu = threading.Lock()
+        self.unprepared: list[str] = []     # uids released (audit)
+        self._write_fleet()
+
+    def _write_fleet(self) -> None:
+        entries = [{"name": name, "url": rec["url"],
+                    "role": rec["role"], "claim_uid": rec["uid"]}
+                   for name, rec in self.replicas.items()
+                   if not rec.get("gone")]
+        tmp = self.fleet_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"replicas": entries}, f)
+        os.replace(tmp, self.fleet_file)
+
+    def _grpc_prepare(self, name: str, device: str) -> str:
+        claim = {"metadata": {"name": name, "namespace": "default"},
+                 "spec": {},
+                 "status": {"allocation": {"devices": {"results": [
+                     {"request": "tpus", "driver": DRIVER_NAME,
+                      "pool": "node-a", "device": device}]}}}}
+        uid = self.drive.srv.fake.create(
+            RESOURCE_CLAIMS, claim)["metadata"]["uid"]
+        req = dra_pb.NodePrepareResourcesRequest()
+        c = req.claims.add()
+        c.uid, c.name, c.namespace = uid, name, "default"
+        res = rpc(self.drive.dra_sock,
+                  "/v1beta1.DRAPlugin/NodePrepareResources",
+                  req, dra_pb.NodePrepareResourcesResponse)
+        if res.claims[uid].error:
+            die(f"claim prepare failed: {res.claims[uid].error}")
+        return uid
+
+    def _grpc_unprepare(self, name: str, uid: str) -> None:
+        req = dra_pb.NodeUnprepareResourcesRequest()
+        c = req.claims.add()
+        c.uid, c.name, c.namespace = uid, name, "default"
+        res = rpc(self.drive.dra_sock,
+                  "/v1beta1.DRAPlugin/NodeUnprepareResources",
+                  req, dra_pb.NodeUnprepareResourcesResponse)
+        if res.claims[uid].error:
+            die(f"claim unprepare failed: {res.claims[uid].error}")
+
+    def prepare(self, role: str = "any") -> str:
+        self.reap()
+        with self.mu:
+            name = f"rep{self.counter}"
+            self.counter += 1
+            dev = self.free_devices.popleft()
+        uid = self._grpc_prepare(name, f"tpu-{dev}")
+        port = free_port()
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+            TRACE_SAMPLE_RATIO="1.0",
+            JAX_COMPILATION_CACHE_DIR=self.drive.compile_cache,
+            TPU_DRA_FAILPOINTS=(
+                f"serve.engine.slow_decode=sleep({SLOW_DECODE_MS})"))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.workloads.serve",
+             "--checkpoint-dir", self.drive.model_ckpt,
+             "--host", "127.0.0.1", "--port", str(port),
+             "--pos-emb", "rope", *MODEL_FLAGS,
+             "--continuous", "--slots", "2", "--chunk", "2",
+             "--kv-layout", "paged", "--page-size", "8",
+             "--admission-max-cost", str(ADMISSION_MAX_COST),
+             "--pool-role", role, "--warmup",
+             "--drain-grace", str(DRAIN_GRACE_S)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+        reader = LineReader(proc)
+        # "serving on" prints AFTER --warmup: the replica joins the
+        # fleet file only once it can answer without compile stalls
+        wait_until(lambda: reader.saw("serving on") or
+                   proc.poll() is not None,
+                   timeout=420, what=f"{name} warmed up")
+        if proc.poll() is not None:
+            die(f"{name} exited {proc.returncode} during startup")
+        with self.mu:
+            self.replicas[name] = {
+                "proc": proc, "reader": reader, "uid": uid,
+                "device": dev, "role": role, "port": port,
+                "url": f"http://127.0.0.1:{port}"}
+            self._write_fleet()
+        log(f"prepared {name}: claim {uid[:8]}… on tpu-{dev}, "
+            f"serving :{port} role={role}")
+        return name
+
+    def drain(self, name: str) -> bool:
+        rec = self.replicas[name]
+        rec["proc"].send_signal(signal.SIGTERM)
+        try:
+            rc = rec["proc"].wait(DRAIN_GRACE_S + 20)
+        except subprocess.TimeoutExpired:
+            rec["proc"].kill()
+            return False
+        rec["rc"] = rc
+        return rc == 0
+
+    def unprepare(self, name: str) -> None:
+        rec = self.replicas[name]
+        if rec.get("gone"):
+            return
+        rec["gone"] = True
+        self._grpc_unprepare(name, rec["uid"])
+        # release the API object too: the claim's full lifecycle is
+        # create -> prepare -> unprepare -> delete
+        self.drive.srv.fake.delete(RESOURCE_CLAIMS, name,
+                                   namespace="default")
+        with self.mu:
+            self.free_devices.append(rec["device"])
+            self.unprepared.append(rec["uid"])
+            self._write_fleet()
+        log(f"unprepared {name} (claim {rec['uid'][:8]}…)")
+
+    def reap(self) -> None:
+        """Release the claims of replicas whose process has exited —
+        how a drained-and-killed replica's chip returns to the pool for
+        the replacement's claim."""
+        for name, rec in list(self.replicas.items()):
+            if not rec.get("gone") and rec["proc"].poll() is not None:
+                self.unprepare(name)
+
+    def stop_all(self) -> None:
+        for name, rec in list(self.replicas.items()):
+            if rec["proc"].poll() is None:
+                rec["proc"].terminate()
+                try:
+                    rec["proc"].wait(15)
+                except subprocess.TimeoutExpired:
+                    rec["proc"].kill()
+            self.reap()
+
+
+def start_router(drive: Drive, fleet_file: str, *args) -> tuple:
+    port = free_port()
+    env = dict(os.environ, PYTHONPATH=REPO, TRACE_SAMPLE_RATIO="1.0")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.workloads.router",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--fleet-file", fleet_file,
+         "--claims-checkpoint", drive.ckpt_path,
+         "--probe-interval", str(PROBE_INTERVAL_S), *args],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+    reader = LineReader(proc)
+    wait_until(lambda: reader.saw("routing on"), timeout=60,
+               what="router up")
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def stop_proc(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _post(url: str, payload: dict, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# --------------------------------------------------------------------------
+# phase 1: disaggregated prefill/decode through the router
+# --------------------------------------------------------------------------
+
+
+def phase_disagg(drive: Drive) -> None:
+    fleet_file = str(drive.base / "fleet-disagg.json")
+    launcher = FleetLauncher(drive, fleet_file)
+    router = None
+    try:
+        launcher.prepare(role="prefill")
+        dec_name = launcher.prepare(role="decode")
+        dec_url = launcher.replicas[dec_name]["url"]
+        router, router_url = start_router(drive, fleet_file,
+                                          "--disaggregate")
+        wait_until(lambda: fleet_state_http(router_url)["routable"] == 2,
+                   timeout=30, what="both pools routable")
+        prompt, steps = [3, 5, 7, 11], 6
+        single = _post(f"{dec_url}/generate",
+                       {"tokens": [prompt], "steps": steps})["tokens"][0]
+        routed = _post(f"{router_url}/generate",
+                       {"tokens": [prompt], "steps": steps})["tokens"][0]
+        if routed != single:
+            die(f"disaggregated output diverged: router {routed} vs "
+                f"single-engine {single}")
+        _, _, metrics_text = http_get(f"{router_url}/metrics")
+        if 'tpu_router_handoffs_total{result="ok"} 1' not in \
+                metrics_text:
+            die("router did not count the prefill->decode handoff")
+        log(f"phase 1 OK: disaggregated /generate byte-identical "
+            f"({routed})")
+    finally:
+        if router is not None:
+            stop_proc(router)
+        launcher.stop_all()
+    claims = drive.prepared_claims()
+    if claims:
+        die(f"phase 1 claims leaked: {list(claims)}")
+
+
+# --------------------------------------------------------------------------
+# phase 2: fleet throughput + autoscaler via the claim path
+# --------------------------------------------------------------------------
+
+
+def _load_stats(result, wall: float) -> tuple[float, float]:
+    lats = sorted(result.latencies)
+    if not lats:
+        die("no successful requests")
+    p99 = lats[int(0.99 * (len(lats) - 1))]
+    return len(lats) / wall, p99
+
+
+def phase_fleet(drive: Drive) -> None:
+    fleet_file = str(drive.base / "fleet.json")
+    launcher = FleetLauncher(drive, fleet_file)
+    router = None
+    autoscaler = None
+    try:
+        first = launcher.prepare()
+        router, router_url = start_router(drive, fleet_file)
+        wait_until(lambda: fleet_state_http(router_url)["routable"] == 1,
+                   timeout=30, what="first replica routable")
+
+        log(f"baseline: {SINGLE_QPS} qps for {BASELINE_SECS}s via the "
+            f"router")
+        t0 = time.perf_counter()
+        res = run_load(router_url,
+                       schedule=((SINGLE_QPS, BASELINE_SECS),),
+                       body_of=lambda i: {"tokens": [[(i % 60) + 1, 2,
+                                                      3]],
+                                          "steps": STEPS},
+                       ok_codes=(200, 503))
+        wall = time.perf_counter() - t0
+        if res.errors:
+            die(f"baseline errors: {res.errors[:3]}")
+        single_rate, p99 = _load_stats(res, wall)
+        if single_rate < 0.7 * SINGLE_QPS:
+            # occasional typed sheds under CPU weather are tolerable;
+            # a mostly-shedding baseline means something is broken
+            die(f"baseline completed only {single_rate:.1f}/s of "
+                f"{SINGLE_QPS} offered")
+        log(f"baseline: {single_rate:.1f}/s completed, p99 "
+            f"{p99 * 1e3:.0f}ms")
+
+        # the autoscaler assembles the fleet itself: 3 heal actions,
+        # each one REAL claim prepare + spawn + fleet-file registration.
+        # min == target: this drive exercises heal + replace — the
+        # scale-down path (drain-before-unprepare ordering) is
+        # unit-tested, and firing it against the post-load idle fleet
+        # would race the replacement asserts below
+        autoscaler = Autoscaler(
+            lambda: fleet_state_http(router_url), launcher,
+            target_replicas=FLEET_TARGET, min_replicas=FLEET_TARGET,
+            max_replicas=N_CHIPS, interval_s=1.0).start()
+        wait_until(
+            lambda: fleet_state_http(router_url)["routable"]
+            == FLEET_TARGET,
+            timeout=600, what=f"autoscaler heals to {FLEET_TARGET}")
+        heals = [e for e in autoscaler.events
+                 if e["action"] == "prepare" and e["reason"] == "heal"]
+        if len(heals) < FLEET_TARGET - 1:
+            die(f"expected {FLEET_TARGET - 1} heal prepares, got "
+                f"{autoscaler.events}")
+        log(f"fleet assembled: {FLEET_TARGET} replicas via "
+            f"{len(heals)} autoscaler heals through the claim path")
+
+        # mid-run victim: drained (graceful) and killed
+        victim = first
+        drain_result: dict = {}
+
+        def kill_victim():
+            time.sleep(KILL_AT_S)
+            log(f"draining victim {victim} mid-load")
+            drain_result["ok"] = launcher.drain(victim)
+            drain_result["rc"] = launcher.replicas[victim].get("rc")
+        killer = threading.Thread(target=kill_victim, daemon=True)
+
+        log(f"fleet load: {FLEET_QPS} qps for {FLEET_SECS}s, victim "
+            f"dies at t={KILL_AT_S}s")
+        killer.start()
+        t0 = time.perf_counter()
+        res = run_load(
+            router_url, schedule=((FLEET_QPS, FLEET_SECS),),
+            body_of=lambda i: {"tokens": [[(i % 60) + 1, 2, 3]],
+                               "steps": STEPS},
+            ok_codes=(200, 503))
+        wall = time.perf_counter() - t0
+        killer.join(timeout=DRAIN_GRACE_S + 30)
+
+        # zero in-flight LOSSES: no transport errors/timeouts and no
+        # untyped failures — a capacity dip during the replacement
+        # window may SHED (typed 503 + Retry-After through the
+        # router's passthrough), which is backpressure, not loss
+        if res.errors:
+            die(f"{len(res.errors)} client-visible errors under fleet "
+                f"load (zero-loss contract): {res.errors[:5]}")
+        sheds = [r for r in res.records if r[1] == 503]
+        for _, _, _, retry_after in sheds:
+            if retry_after is None or int(retry_after) < 1:
+                die(f"a fleet 503 lacked a valid Retry-After: {sheds[:3]}")
+        if not drain_result.get("ok"):
+            die(f"victim drain was not clean: {drain_result}")
+        fleet_rate, p99 = _load_stats(res, wall)
+        log(f"fleet: {fleet_rate:.1f}/s completed (single "
+            f"{single_rate:.1f}/s -> {fleet_rate / single_rate:.2f}x), "
+            f"p99 {p99 * 1e3:.0f}ms, {len(sheds)} typed sheds during "
+            f"the replacement window")
+        if fleet_rate < FLEET_FACTOR_FLOOR * single_rate:
+            die(f"fleet {fleet_rate:.1f}/s under "
+                f"{FLEET_FACTOR_FLOOR}x single {single_rate:.1f}/s")
+        if p99 > P99_GATE_S:
+            die(f"fleet p99 {p99:.3f}s exceeds {P99_GATE_S}s gate")
+
+        # the autoscaler replaced the victim through the claim path
+        wait_until(
+            lambda: fleet_state_http(router_url)["routable"]
+            == FLEET_TARGET,
+            timeout=300, what="replacement joins the rotation")
+        replace_heals = [e for e in autoscaler.events
+                         if e["action"] == "prepare"
+                         and e["reason"] == "heal"
+                         and e["at"] > heals[-1]["at"]]
+        if not replace_heals:
+            die(f"no heal prepare after the kill: {autoscaler.events}")
+        autoscaler.stop()
+        launcher.reap()            # victim exited: release its claim
+        victim_uid = launcher.replicas[victim]["uid"]
+        claims = drive.prepared_claims()
+        if victim_uid in claims:
+            die("victim's claim still prepared after drain+reap")
+        live_uids = {rec["uid"] for rec in launcher.replicas.values()
+                     if not rec.get("gone")}
+        if set(claims) != live_uids:
+            die(f"checkpoint claims {set(claims)} != live fleet "
+                f"{live_uids}")
+        if victim_uid not in launcher.unprepared:
+            die("victim claim was not released via gRPC unprepare")
+
+        # one trace id spans client -> router -> replica: send ONE
+        # sampled-traceparent request against the healed fleet (the
+        # survivors + replacement — a mid-load probe could land on the
+        # victim, whose trace ring died with it) and resolve the trace
+        # on whichever replica served it
+        trace_tp = "00-" + "5f" * 16 + "-" + "6a" * 8 + "-01"
+        _post(f"{router_url}/generate",
+              {"tokens": [[9, 8, 7]], "steps": STEPS},
+              headers={"traceparent": trace_tp})
+        trace_id = trace_tp.split("-")[1]
+        found = False
+        for rec in launcher.replicas.values():
+            if rec.get("gone"):
+                continue
+            try:
+                _, _, body = http_get(
+                    f"{rec['url']}/debug/traces?trace_id={trace_id}")
+            except (OSError, urllib.error.URLError):
+                continue
+            names = {e.get("name")
+                     for e in json.loads(body)["traceEvents"]}
+            if "serve.request" in names:
+                found = True
+                break
+        if not found:
+            die(f"trace {trace_id} did not resolve to a serve.request "
+                f"span on any replica (traceparent not forwarded?)")
+
+        _, _, metrics_text = http_get(f"{router_url}/metrics")
+        if not re.search(r'tpu_router_ejections_total\{[^}]*\} [1-9]',
+                         metrics_text):
+            die("router metrics show no ejection of the drained "
+                "victim")
+        log("phase 2 OK: fleet >=3x single QPS, victim drained+killed "
+            "with zero losses, autoscaler replaced it through the "
+            "real claim path, one trace id spans router->replica")
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        if router is not None:
+            stop_proc(router)
+        launcher.stop_all()
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="drive-fleet-")
+    log(f"workdir {base}")
+    drive = Drive(base)
+    try:
+        phase_disagg(drive)
+        phase_fleet(drive)
+    finally:
+        drive.stop()
+    log("OK: disaggregated byte-identity + N=4 fleet throughput + "
+        "drain/kill/replace through the DRA claim path all passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

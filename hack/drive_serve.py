@@ -195,7 +195,8 @@ class LoadResult:
 
 def run_load(base_url: str, schedule=QPS_SCHEDULE, *, path="/generate",
              body_of=None, tenant_of=None, headers_of=None,
-             timeout_s=LOAD_TIMEOUT_S, ok_codes=(200,)) -> LoadResult:
+             target_of=None, timeout_s=LOAD_TIMEOUT_S,
+             ok_codes=(200,)) -> LoadResult:
     """Truly open-loop scripted load: one pacing thread spawns request
     threads at the scheduled rate and NEVER touches the network itself,
     and every request carries a bounded connect/read timeout — a slow
@@ -204,7 +205,10 @@ def run_load(base_url: str, schedule=QPS_SCHEDULE, *, path="/generate",
 
     ``body_of(i)``/``tenant_of(i)``/``headers_of(i)`` parameterize the
     per-request payload so overload drives (hack/drive_overload.py)
-    reuse this generator; ``ok_codes`` widens which statuses stay out
+    reuse this generator; ``target_of(i)`` selects the per-request base
+    URL (the fleet drive points every request at the router, and the
+    baseline phase at one replica, through ONE generator —
+    hack/drive_fleet.py); ``ok_codes`` widens which statuses stay out
     of ``errors`` (an overload drive EXPECTS 503s)."""
     result = LoadResult()
     tenants = ("alpha", "beta")
@@ -214,6 +218,8 @@ def run_load(base_url: str, schedule=QPS_SCHEDULE, *, path="/generate",
     if body_of is None:
         body_of = lambda i: {"tokens": [[(i % 60) + 1, 2, 3]],  # noqa: E731
                              "steps": 4}
+    if target_of is None:
+        target_of = lambda i: base_url  # noqa: E731
 
     def one(i: int) -> None:
         tenant = tenant_of(i)
@@ -222,7 +228,7 @@ def run_load(base_url: str, schedule=QPS_SCHEDULE, *, path="/generate",
         if headers_of is not None:
             headers.update(headers_of(i))
         req = urllib.request.Request(
-            f"{base_url}{path}", data=json.dumps(body_of(i)).encode(),
+            f"{target_of(i)}{path}", data=json.dumps(body_of(i)).encode(),
             headers=headers)
         t0 = time.perf_counter()
         retry_after = None

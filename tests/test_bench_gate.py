@@ -17,7 +17,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
             grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
-            observe_us=0.8, admission_us=4.0, alloc_us=15.0):
+            observe_us=0.8, admission_us=4.0, alloc_us=15.0,
+            router_us=2.0):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
@@ -25,6 +26,7 @@ def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
         "observe_idle": {"n": 50000, "per_observe_us": observe_us},
         "admission_idle": {"n": 20000, "per_check_us": admission_us},
         "alloc_score": {"n": 5000, "per_score_us": alloc_us},
+        "router_decision": {"n": 50000, "per_decision_us": router_us},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
                      "overhead_p50_ms": direct_warm_oh},
@@ -49,6 +51,7 @@ def _budget(**overrides):
             "histogram_observe_idle_us": 2.5,
             "admission_check_idle_us": 12.0,
             "alloc_score_us": 40.0,
+            "router_decision_us": 10.0,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
                      "fs_floor_ceiling_ms": 0.4,
@@ -125,6 +128,16 @@ def test_alloc_score_gate():
     violations = bench_prepare.gate(_report(alloc_us=210.0), _budget())
     assert any("alloc_score_us" in v for v in violations)
     assert bench_prepare.gate(_report(alloc_us=14.0), _budget()) == []
+
+
+def test_router_decision_gate():
+    """ISSUE 14: the per-request routing decision must stay O(10µs) —
+    an accidental probe/IO/sort landing on Router.decide (a >=100µs
+    cliff) must fail the ratchet, so the cluster front-end can never
+    become the new hot-path regression."""
+    violations = bench_prepare.gate(_report(router_us=120.0), _budget())
+    assert any("router_decision_us" in v for v in violations)
+    assert bench_prepare.gate(_report(router_us=1.5), _budget()) == []
 
 
 def test_idle_observe_gate():

@@ -1,0 +1,397 @@
+"""KV handoff (disaggregated prefill/decode, ISSUE 14).
+
+The load-bearing contract is byte-identity: a prefill-pool export →
+wire blob → decode-pool import must produce EXACTLY the tokens a
+single engine produces for the same request — anything less means the
+router's disaggregation silently changes model output.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dra.workloads import kv_handoff
+from tpu_dra.workloads.continuous import ContinuousEngine
+from tpu_dra.workloads.kv_handoff import (
+    KVHandoff,
+    PrefillExporter,
+    decode_blob,
+    encode,
+    model_dims,
+)
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+CFG = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                  d_ff=64, max_seq=64, pos_emb="rope")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("chunk", 2)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    return ContinuousEngine(CFG, params, **kw)
+
+
+# --------------------------------------------------------------------------
+# wire format
+# --------------------------------------------------------------------------
+
+
+def test_blob_round_trip(params):
+    exp = PrefillExporter(CFG, params, page_size=8)
+    h = exp.export([3, 5, 7])
+    blob = encode(h)
+    back = decode_blob(blob)
+    assert back.prompt == [3, 5, 7]
+    assert back.length == 3
+    assert back.page_size == 8
+    assert back.model == model_dims(CFG)
+    np.testing.assert_array_equal(np.asarray(h.ks), np.asarray(back.ks))
+    np.testing.assert_array_equal(np.asarray(h.vs), np.asarray(back.vs))
+    np.testing.assert_array_equal(np.asarray(h.last_logits),
+                                  np.asarray(back.last_logits))
+    assert back.pages() == 1
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b"XXXX" + b[4:],                 # bad magic
+    lambda b: b[:40],                          # truncated
+    lambda b: b[:4] + b"\xff\xff\xff\x7f" + b[8:],   # absurd header len
+])
+def test_blob_rejects_malformed(params, mutate):
+    blob = encode(PrefillExporter(CFG, params, page_size=8).export([1]))
+    with pytest.raises(ValueError):
+        decode_blob(mutate(blob))
+
+
+# --------------------------------------------------------------------------
+# byte-identity: single engine vs prefill-pool -> decode-pool
+# --------------------------------------------------------------------------
+
+
+def _single_engine_tokens(params, prompt, steps, **submit_kw):
+    eng = _engine(params)
+    try:
+        return eng.submit(list(prompt), steps, timeout=120, **submit_kw)
+    finally:
+        eng.shutdown()
+
+
+def _handoff_tokens(params, prompt, steps, *, cache_dtype="bf16",
+                    **submit_kw):
+    exp = PrefillExporter(CFG, params, page_size=8)
+    blob = encode(exp.export(list(prompt)))     # the full wire trip
+    eng = _engine(params, cache_dtype=cache_dtype)
+    try:
+        req = eng.submit_handoff(decode_blob(blob), steps, **submit_kw)
+        assert req.done.wait(120)
+        assert req.error is None, req.error
+        return req.tokens
+    finally:
+        eng.shutdown()
+
+
+def test_handoff_decode_byte_identical_greedy(params):
+    prompt, steps = [3, 5, 7, 11, 13], 10
+    single = _single_engine_tokens(params, prompt, steps)
+    disagg = _handoff_tokens(params, prompt, steps)
+    assert disagg == single
+    assert len(disagg) == steps
+
+
+def test_handoff_decode_byte_identical_sampled(params):
+    # sampling parity: the first token draws from the blob's logits with
+    # the request's own seed chain — same fold_in chain as a local
+    # prefill, so sampled outputs match token for token
+    kw = dict(temperature=0.8, seed=42)
+    single = _single_engine_tokens(params, [2, 4, 6], 8, **kw)
+    assert _handoff_tokens(params, [2, 4, 6], 8, **kw) == single
+
+
+def test_handoff_eos_and_multi_page_prompt(params):
+    # an 11-token prompt spans two 8-token pages; eos semantics ride
+    # through unchanged
+    prompt = list(range(1, 12))
+    single = _single_engine_tokens(params, prompt, 12, eos_id=9)
+    assert _handoff_tokens(params, prompt, 12, eos_id=9) == single
+
+
+def test_handoff_into_int8_pool_matches_int8_single_engine(params):
+    # the wire carries bf16; an int8 destination quantizes at page-write
+    # exactly like its own prefill would — parity holds per cache dtype
+    prompt, steps = [3, 1, 4, 1, 5], 8
+    eng = _engine(params, cache_dtype="int8")
+    try:
+        single = eng.submit(list(prompt), steps, timeout=120)
+    finally:
+        eng.shutdown()
+    assert _handoff_tokens(params, prompt, steps,
+                           cache_dtype="int8") == single
+
+
+def test_handoff_pages_return_to_pool(params):
+    exp = PrefillExporter(CFG, params, page_size=8)
+    eng = _engine(params)
+    try:
+        baseline = eng.pool.free_pages
+        req = eng.submit_handoff(exp.export([1, 2, 3]), 4)
+        assert req.done.wait(120) and req.error is None
+        # retirement frees the slot's pages at the pass boundary
+        deadline = threading.Event()
+        for _ in range(100):
+            if eng.pool.free_pages == baseline:
+                break
+            deadline.wait(0.05)
+        assert eng.pool.free_pages == baseline
+    finally:
+        eng.shutdown()
+
+
+def test_handoff_concurrent_with_local_requests(params):
+    """Handoff admissions interleave with plain prefill admissions in
+    one engine without perturbing either (the batcher treats them as
+    just another admission kind)."""
+    exp = PrefillExporter(CFG, params, page_size=8)
+    single_a = _single_engine_tokens(params, [7, 8, 9], 6)
+    single_b = _single_engine_tokens(params, [10, 11], 6)
+    eng = _engine(params, slots=4)
+    try:
+        ha = eng.submit_handoff(exp.export([7, 8, 9]), 6)
+        hb = eng.submit_async([10, 11], 6)
+        assert ha.done.wait(120) and ha.error is None
+        assert hb.done.wait(120) and hb.error is None
+        assert ha.tokens == single_a
+        assert hb.tokens == single_b
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------------------------
+# HTTP surface: /prefill on one replica -> /decode_handoff on another
+# --------------------------------------------------------------------------
+
+
+def _post(port, path, payload):
+    import json as _json
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=_json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return _json.loads(resp.read())
+
+
+def test_http_prefill_to_decode_handoff_matches_single_generate(params):
+    """The full wire trip the router performs: POST /prefill on a
+    prefill-pool replica, POST /decode_handoff with the blob on a
+    decode-pool replica — output equals one replica's /generate."""
+    from tpu_dra.workloads.serve import serve
+
+    prefill = serve(CFG, params, port=0, continuous=True, slots=2,
+                    chunk=2, kv_layout="paged", page_size=8,
+                    pool_role="prefill")
+    decode = serve(CFG, params, port=0, continuous=True, slots=2,
+                   chunk=2, kv_layout="paged", page_size=8,
+                   pool_role="decode")
+    try:
+        pport = prefill.server_address[1]
+        dport = decode.server_address[1]
+        prompt, steps = [3, 5, 7, 11], 8
+        single = _post(dport, "/generate",
+                       {"tokens": [prompt], "steps": steps})["tokens"][0]
+        pre = _post(pport, "/prefill", {"tokens": prompt})
+        assert pre["length"] == len(prompt)
+        out = _post(dport, "/decode_handoff",
+                    {"blob": pre["blob"], "prompt_len": pre["length"],
+                     "steps": steps})
+        assert out["tokens"][0] == single
+        # roles are advertised for the router's probe
+        import urllib.request as _rq
+        import json as _json
+        for port, want in ((pport, "prefill"), (dport, "decode")):
+            with _rq.urlopen(f"http://127.0.0.1:{port}/debug/overload",
+                             timeout=30) as resp:
+                assert _json.loads(resp.read())["role"] == want
+    finally:
+        prefill.shutdown()
+        decode.shutdown()
+
+
+def test_http_decode_handoff_rejects_garbage_blob(params):
+    import urllib.error
+    from tpu_dra.workloads.serve import serve
+
+    srv = serve(CFG, params, port=0, continuous=True, slots=2, chunk=2,
+                kv_layout="paged", page_size=8)
+    try:
+        port = srv.server_address[1]
+        for payload in ({"blob": "not base64!!", "steps": 2},
+                        {"blob": "QUJDRA==", "steps": 2}):   # bad magic
+            try:
+                _post(port, "/decode_handoff", payload)
+                raise AssertionError("expected HTTP 400")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 400
+                exc.read()
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# refusal surface
+# --------------------------------------------------------------------------
+
+
+def test_handoff_model_mismatch_rejected(params):
+    other = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                        d_ff=64, max_seq=64, pos_emb="rope")
+    h = PrefillExporter(
+        other, init_params(other, jax.random.PRNGKey(1)),
+        page_size=8).export([1, 2])
+    eng = _engine(params)
+    try:
+        with pytest.raises(ValueError, match="different model"):
+            eng.submit_handoff(h, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_handoff_page_size_mismatch_rejected(params):
+    h = PrefillExporter(CFG, params, page_size=16).export([1, 2])
+    eng = _engine(params)          # page_size=8
+    try:
+        with pytest.raises(ValueError, match="page_size"):
+            eng.submit_handoff(h, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_handoff_requires_paged_engine(params):
+    h = PrefillExporter(CFG, params, page_size=8).export([1, 2])
+    eng = ContinuousEngine(CFG, params, slots=2, chunk=2)   # slab
+    try:
+        with pytest.raises(ValueError, match="paged"):
+            eng.submit_handoff(h, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_handoff_overlong_rejected(params):
+    h = PrefillExporter(CFG, params, page_size=8).export([1, 2, 3])
+    eng = _engine(params)
+    try:
+        with pytest.raises(ValueError, match="max_len"):
+            eng.submit_handoff(h, 64)
+    finally:
+        eng.shutdown()
+
+
+def test_handoff_malformed_shapes_rejected_without_killing_engine(
+        params):
+    """A blob whose declared array shapes don't match the model must
+    400 the ONE request on the caller's thread — reaching the jit'd
+    scatter on the batcher thread would _fail_all the engine (one
+    crafted request = a dead replica)."""
+    good = PrefillExporter(CFG, params, page_size=8).export([1, 2, 3])
+    eng = _engine(params)
+    try:
+        bad_kv = KVHandoff(
+            prompt=[1], length=1, page_size=8, model=model_dims(CFG),
+            ks=np.zeros((1, 1, 1, 8, 1), np.float32),
+            vs=np.zeros((1, 1, 1, 8, 1), np.float32),
+            last_logits=np.zeros((CFG.vocab,), np.float32))
+        with pytest.raises(ValueError, match="KV shape"):
+            eng.submit_handoff(bad_kv, 2)
+        bad_cols = KVHandoff(
+            prompt=list(good.prompt), length=good.length, page_size=8,
+            model=model_dims(CFG),
+            ks=np.asarray(good.ks)[:, :, :, :5],   # not a page multiple
+            vs=np.asarray(good.vs)[:, :, :, :5],
+            last_logits=np.asarray(good.last_logits))
+        with pytest.raises(ValueError, match="page multiple"):
+            eng.submit_handoff(bad_cols, 2)
+        bad_logits = KVHandoff(
+            prompt=list(good.prompt), length=good.length, page_size=8,
+            model=model_dims(CFG), ks=good.ks, vs=good.vs,
+            last_logits=np.zeros((3,), np.float32))
+        with pytest.raises(ValueError, match="last_logits"):
+            eng.submit_handoff(bad_logits, 2)
+        # the engine survived every rejection and still serves
+        req = eng.submit_handoff(good, 4)
+        assert req.done.wait(120) and req.error is None
+    finally:
+        eng.shutdown()
+
+
+def test_peek_prompt_len_reads_header_without_arrays(params):
+    """Admission prices /decode_handoff from the blob's own header —
+    peek must return the true length from a few base64 chars and None
+    for garbage (never trusting a client-asserted field)."""
+    import base64
+
+    from tpu_dra.workloads.kv_handoff import peek_prompt_len
+
+    h = PrefillExporter(CFG, params, page_size=8).export(
+        list(range(1, 12)))
+    blob_b64 = base64.b64encode(encode(h)).decode()
+    assert peek_prompt_len(blob_b64) == 11
+    assert peek_prompt_len("") is None
+    assert peek_prompt_len("not base64!!") is None
+    assert peek_prompt_len(
+        base64.b64encode(b"XXXXjunkjunkjunk").decode()) is None
+
+
+def test_handoff_not_a_kvhandoff_rejected(params):
+    eng = _engine(params)
+    try:
+        with pytest.raises(ValueError, match="KVHandoff"):
+            eng.submit_handoff({"ks": 1}, 4)
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------------------------
+# ICI fast path (interpret-mode proof; capability gate on real fleets)
+# --------------------------------------------------------------------------
+
+
+def test_ici_shift_moves_pages_one_hop():
+    """The remote-DMA transfer primitive: each device's page buffers
+    land on its ring neighbour (prefill chip -> decode chip).  Run in
+    interpret mode on the CPU mesh — the hardware path is the same
+    ring_shift kernel PR 10 proved."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from tpu_dra.workloads.ring_attention import shard_map
+
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("h",))
+    x = np.arange(2 * 4 * 8, dtype=np.float32).reshape(2, 4, 8)
+
+    f = shard_map(
+        lambda t: kv_handoff.ici_shift(t, "h", interpret=True),
+        mesh=mesh, in_specs=P("h"), out_specs=P("h"))
+    out = np.asarray(jax.jit(f)(x))
+    # device 0's block arrived at device 1 and vice versa
+    np.testing.assert_array_equal(out[1], x[0])
+    np.testing.assert_array_equal(out[0], x[1])
+
+
+def test_ici_supported_is_false_on_cpu():
+    assert kv_handoff.ici_supported() is False
+    # and transfer() therefore takes the wire path
+    h = KVHandoff(prompt=[1], length=1, page_size=8,
+                  model=model_dims(CFG),
+                  ks=np.zeros((2, 1, 2, 8, 16), np.float32),
+                  vs=np.zeros((2, 1, 2, 8, 16), np.float32),
+                  last_logits=np.zeros((64,), np.float32))
+    with pytest.raises(ValueError):
+        kv_handoff.transfer(h, via="bogus")

@@ -1,0 +1,801 @@
+"""Claim-aware router + autoscaler (ISSUE 14, docs/scaling.md
+"Cluster serving").
+
+jax-free by design: the router is pure control plane, so these run in
+the core lane against scripted fake replicas (real HTTP servers with
+scripted /debug/overload payloads — the wire contract, not mocks of
+the router's own internals).
+"""
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_dra.workloads.router import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    STATE_DRAINING,
+    STATE_EJECTED,
+    STATE_HEALTHY,
+    Autoscaler,
+    PooledClient,
+    Replica,
+    Router,
+    parse_replica_flag,
+    replica_score,
+    route_decision,
+    serve_router,
+)
+
+pytestmark = pytest.mark.core
+
+
+# --------------------------------------------------------------------------
+# scripted fake replica
+# --------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """A real HTTP server speaking the replica wire contract, with a
+    scriptable /debug/overload payload and per-path response hooks."""
+
+    def __init__(self):
+        self.overload = {"state": "running", "role": "any",
+                         "admission": None,
+                         "engine": {"queued": 0, "active": 0,
+                                    "slots": 4, "batch_occupancy": 0.0,
+                                    "kv_pages_free": 8,
+                                    "kv_pages_total": 8}}
+        self.slo = {"objectives": {"availability": {"windows": {
+            "60s": {"burn_rate": 0.0}}}}}
+        self.requests = []              # (path, headers, body) log
+        self.respond = {}               # path -> (code, body, headers)
+        self.mu = threading.Lock()
+        self.conns = []                 # live sockets, closed on stop()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                with fake.mu:
+                    fake.conns.append(self.connection)
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                with fake.mu:
+                    if self.path == "/debug/overload":
+                        self._send(200, json.dumps(
+                            fake.overload).encode())
+                    elif self.path == "/debug/slo":
+                        self._send(200, json.dumps(fake.slo).encode())
+                    else:
+                        self._send(404, b"{}")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with fake.mu:
+                    fake.requests.append(
+                        (self.path, dict(self.headers), body))
+                    code, payload, headers = fake.respond.get(
+                        self.path,
+                        (200, json.dumps(
+                            {"tokens": [[1, 2, 3]],
+                             "served_by": fake.name}).encode(), None))
+                self._send(code, payload, headers)
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.srv.server_address[1]
+        self.name = f"fake-{self.port}"
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def set_overload(self, **engine):
+        with self.mu:
+            self.overload["engine"].update(engine)
+
+    def stop(self):
+        self.srv.shutdown()
+        # model process DEATH, not a wedge: close the listener (new
+        # connects refuse) AND every live keep-alive socket (a pooled
+        # client's reused connection must fail like it would against a
+        # dead process, not keep talking to a zombie handler thread)
+        self.srv.server_close()
+        with self.mu:
+            conns, self.conns = self.conns, []
+        for conn in conns:
+            try:
+                conn.shutdown(__import__("socket").SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+
+
+@pytest.fixture
+def fakes():
+    reps = [FakeReplica() for _ in range(3)]
+    yield reps
+    for r in reps:
+        r.stop()
+
+
+def _router(fakes, **kw):
+    kw.setdefault("probe_interval_s", 0.1)
+    kw.setdefault("probe_timeout_s", 2.0)
+    kw.setdefault("request_timeout_s", 10.0)
+    router = Router(**kw)
+    for f in fakes:
+        router.add_replica(Replica(name=f.name, url=f.url))
+    return router
+
+
+def _wait(pred, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {what}")
+
+
+def _post(port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+# --------------------------------------------------------------------------
+# scoring + decision
+# --------------------------------------------------------------------------
+
+
+def test_replica_score_orders_by_load():
+    idle = {"engine": {"queued": 0, "slots": 4, "batch_occupancy": 0.0,
+                       "kv_pages_free": 8, "kv_pages_total": 8}}
+    busy = {"engine": {"queued": 6, "slots": 4, "batch_occupancy": 1.0,
+                       "kv_pages_free": 0, "kv_pages_total": 8}}
+    assert replica_score(idle, None, 0.0) < replica_score(busy, None,
+                                                          0.0)
+    # shedding dominates mere occupancy
+    assert replica_score(idle, None, 3.0) > replica_score(busy, None,
+                                                          0.0)
+    # availability burn raises the score
+    burning = {"objectives": {"availability": {"windows": {
+        "60s": {"burn_rate": 5.0}}}}}
+    assert replica_score(idle, burning, 0.0) > replica_score(idle, None,
+                                                             0.0)
+    # a 4-chip claim absorbs the same backlog 4x more comfortably
+    assert replica_score(busy, None, 0.0, weight=4.0) < \
+        replica_score(busy, None, 0.0, weight=1.0)
+
+
+def test_route_decision_picks_lowest_score_and_affinity_sticks():
+    a = Replica(name="a", url="http://x:1")
+    b = Replica(name="b", url="http://x:2")
+    a.score, b.score = 1.0, 0.2
+    assert route_decision((a, b), None) is b
+    # affinity wins while the sticky replica stays healthy
+    assert route_decision((a, b), a) is a
+    a.state = STATE_EJECTED
+    assert route_decision((a, b), a) is b
+    # in-flight pressure breaks score ties
+    a.state = STATE_HEALTHY
+    a.score = b.score = 0.5
+    b.inflight = 50
+    assert route_decision((a, b), None) is a
+
+
+def test_parse_replica_flag():
+    rep = parse_replica_flag(
+        "r0=http://127.0.0.1:9999;role=prefill;claim=uid-1;weight=4")
+    assert (rep.name, rep.role, rep.claim_uid, rep.weight) == \
+        ("r0", "prefill", "uid-1", 4.0)
+    with pytest.raises(ValueError):
+        parse_replica_flag("nourl")
+    with pytest.raises(ValueError):
+        parse_replica_flag("r0=http://x;role=bogus")
+
+
+# --------------------------------------------------------------------------
+# probing: ejection, readmission, draining, claims introspection
+# --------------------------------------------------------------------------
+
+
+def test_probe_scores_and_prefers_idle_replica(fakes):
+    fakes[0].set_overload(queued=8, batch_occupancy=1.0)
+    fakes[1].set_overload(queued=0, batch_occupancy=0.0)
+    fakes[2].set_overload(queued=3, batch_occupancy=0.6)
+    router = _router(fakes)
+    try:
+        router.start()
+        _wait(lambda: all(
+            r.signals for r in router._replicas.values()),
+            what="first probe")
+        rep = router.decide()
+        assert rep.name == fakes[1].name
+    finally:
+        router.stop()
+
+
+def test_dead_replica_ejected_within_one_probe_interval(fakes):
+    router = _router(fakes)
+    try:
+        router.start()
+        _wait(lambda: len(router._view_decode) == 3, what="3 routable")
+        victim = fakes[0]
+        victim.stop()                       # replica dies
+        _wait(lambda: len(router._view_decode) == 2,
+              timeout=3.0, what="ejection")
+        states = {r.name: r.state for r in router._replicas.values()}
+        assert states[victim.name] == STATE_EJECTED
+        # the survivors keep serving decisions
+        assert router.decide().name in (fakes[1].name, fakes[2].name)
+    finally:
+        router.stop()
+
+
+def test_draining_replica_stops_receiving_and_readmits(fakes):
+    router = _router(fakes)
+    try:
+        router.start()
+        _wait(lambda: len(router._view_decode) == 3, what="3 routable")
+        victim = fakes[0]
+        with victim.mu:
+            victim.overload["state"] = "draining"
+        _wait(lambda: len(router._view_decode) == 2,
+              timeout=3.0, what="draining ejection")
+        rep = router._replicas[victim.name]
+        assert rep.state == STATE_DRAINING
+        # drain cancelled (rolling restart aborted): readmission
+        with victim.mu:
+            victim.overload["state"] = "running"
+        _wait(lambda: len(router._view_decode) == 3,
+              timeout=3.0, what="readmission")
+        assert rep.state == STATE_HEALTHY
+    finally:
+        router.stop()
+
+
+def test_claims_introspection_ejects_unprepared_claim(fakes, tmp_path):
+    ckpt = tmp_path / "checkpoint.json"
+
+    def write_claims(uids):
+        payload = {"preparedClaims": {
+            uid: {"claimUID": uid,
+                  "devices": [{"uuid": f"chip-{i}"} for i in range(2)]}
+            for uid in uids}}
+        # the envelope shape the plugin writes (checksum + data string)
+        ckpt.write_text(json.dumps(
+            {"checksum": 0, "data": json.dumps(payload)}))
+
+    write_claims(["uid-0", "uid-1", "uid-2"])
+    router = Router(probe_interval_s=0.1,
+                    claims_checkpoint=str(ckpt))
+    for i, f in enumerate(fakes):
+        router.add_replica(Replica(name=f.name, url=f.url,
+                                   claim_uid=f"uid-{i}"))
+    try:
+        router.start()
+        _wait(lambda: len(router._view_decode) == 3, what="3 routable")
+        # the claim's device count became the capacity weight
+        assert all(r.weight == 2.0
+                   for r in router._replicas.values())
+        write_claims(["uid-1", "uid-2"])    # uid-0 unprepared
+        _wait(lambda: len(router._view_decode) == 2,
+              timeout=3.0, what="claim-gone ejection")
+        gone = router._replicas[fakes[0].name]
+        assert gone.state == STATE_EJECTED
+        assert "claim_gone" in gone.eject_reason
+        write_claims(["uid-0", "uid-1", "uid-2"])   # re-prepared
+        _wait(lambda: len(router._view_decode) == 3,
+              timeout=3.0, what="claim readmission")
+    finally:
+        router.stop()
+
+
+def test_fleet_file_discovery_adds_and_removes(fakes, tmp_path):
+    fleet = tmp_path / "fleet.json"
+    fleet.write_text(json.dumps({"replicas": [
+        {"name": fakes[0].name, "url": fakes[0].url}]}))
+    router = Router(probe_interval_s=0.1, fleet_file=str(fleet))
+    try:
+        router.start()
+        _wait(lambda: len(router._view_decode) == 1, what="discovery")
+        # grow
+        time.sleep(0.05)
+        fleet.write_text(json.dumps({"replicas": [
+            {"name": f.name, "url": f.url, "weight": 2}
+            for f in fakes]}))
+        _wait(lambda: len(router._view_decode) == 3, what="growth")
+        # shrink: dropped entries leave the rotation
+        time.sleep(0.05)
+        fleet.write_text(json.dumps({"replicas": [
+            {"name": fakes[1].name, "url": fakes[1].url}]}))
+        _wait(lambda: len(router._view_decode) == 1, what="shrink")
+        assert router.decide().name == fakes[1].name
+    finally:
+        router.stop()
+
+
+# --------------------------------------------------------------------------
+# HTTP front-end: proxy, passthrough, retries, affinity, headers
+# --------------------------------------------------------------------------
+
+
+def test_proxy_forwards_headers_and_traceparent(fakes):
+    router = _router(fakes[:1])
+    srv = serve_router(router)
+    try:
+        port = srv.server_address[1]
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        status, _, body = _post(
+            port, "/generate", {"tokens": [[1]], "steps": 2},
+            headers={"X-Tenant": "acme", "X-Deadline-Ms": "30000",
+                     "X-Session-Id": "sess-1", "traceparent": tp})
+        assert status == 200
+        assert body["served_by"] == fakes[0].name
+        path, headers, _ = fakes[0].requests[-1]
+        assert path == "/generate"
+        assert headers["X-Tenant"] == "acme"
+        assert headers["X-Deadline-Ms"] == "30000"
+        assert headers["X-Session-Id"] == "sess-1"
+        # ONE trace id spans router -> replica (same trace, new span)
+        fwd = headers.get("traceparent", "")
+        assert fwd.split("-")[1] == tp.split("-")[1]
+    finally:
+        srv.shutdown()
+
+
+def test_shed_503_passes_through_with_retry_after(fakes):
+    shedding = fakes[0]
+    shedding.respond["/generate"] = (
+        503, json.dumps({"error": "full", "reason": "queue_full",
+                         "retry_after_s": 7}).encode(),
+        {"Retry-After": "7"})
+    router = _router([shedding])
+    srv = serve_router(router)
+    try:
+        port = srv.server_address[1]
+        try:
+            _post(port, "/generate", {"tokens": [[1]]})
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert exc.headers["Retry-After"] == "7"
+            body = json.loads(exc.read())
+            assert body["reason"] == "queue_full"
+        # a capacity shed is passed through, never retried
+        assert len(shedding.requests) == 1
+    finally:
+        srv.shutdown()
+
+
+def test_draining_503_retries_on_another_replica(fakes):
+    draining, healthy = fakes[0], fakes[1]
+    draining.respond["/generate"] = (
+        503, json.dumps({"error": "bye", "reason": "draining",
+                         "retry_after_s": 5}).encode(),
+        {"Retry-After": "5"})
+    # bias the decision toward the draining replica first
+    healthy.set_overload(queued=4, batch_occupancy=0.9)
+    router = _router([draining, healthy])
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_decode) == 2, what="2 routable")
+        port = srv.server_address[1]
+        status, _, body = _post(port, "/generate", {"tokens": [[1]]})
+        assert status == 200
+        assert body["served_by"] == healthy.name
+        # and the draining replica left the rotation immediately
+        assert router._replicas[draining.name].state == STATE_DRAINING
+    finally:
+        srv.shutdown()
+
+
+def test_transport_error_ejects_and_retries(fakes):
+    dead, alive = fakes[0], fakes[1]
+    router = _router([dead, alive])
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_decode) == 2, what="2 routable")
+        dead.stop()
+        port = srv.server_address[1]
+        # every request lands somewhere; the dead replica ejects on
+        # first contact and stays out
+        for _ in range(4):
+            status, _, body = _post(port, "/generate",
+                                    {"tokens": [[1]]})
+            assert status == 200
+            assert body["served_by"] == alive.name
+        assert router._replicas[dead.name].state == STATE_EJECTED
+    finally:
+        srv.shutdown()
+
+
+def test_no_replica_is_typed_503(fakes):
+    router = Router(probe_interval_s=0.1)
+    srv = serve_router(router)
+    try:
+        port = srv.server_address[1]
+        try:
+            _post(port, "/generate", {"tokens": [[1]]})
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert json.loads(exc.read())["reason"] == "no_replica"
+            assert int(exc.headers["Retry-After"]) >= 1
+        # router /healthz mirrors the empty fleet
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5)
+    finally:
+        srv.shutdown()
+
+
+def test_session_affinity_sticks_across_requests(fakes):
+    router = _router(fakes)
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_decode) == 3, what="3 routable")
+        port = srv.server_address[1]
+        served = set()
+        for _ in range(6):
+            _, _, body = _post(port, "/generate", {"tokens": [[1]]},
+                               headers={"X-Session-Id": "s-42"})
+            served.add(body["served_by"])
+        assert len(served) == 1, served
+        # without a session, load spreads by score/inflight — not
+        # asserted stochastically here; affinity map is bounded
+        assert router.fleet_snapshot()["affinity_sessions"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_affinity_map_is_lru_bounded(fakes):
+    router = _router(fakes[:1], affinity_max=4)
+    try:
+        router.start()
+        _wait(lambda: len(router._view_decode) == 1, what="routable")
+        for i in range(10):
+            router.decide(session=f"s-{i}")
+        assert len(router._affinity) == 4
+        assert "s-9" in router._affinity and "s-0" not in \
+            router._affinity
+    finally:
+        router.stop()
+
+
+# --------------------------------------------------------------------------
+# disaggregated /generate through the router
+# --------------------------------------------------------------------------
+
+
+def test_disagg_generate_splices_prefill_and_decode(fakes):
+    prefill, decode = fakes[0], fakes[1]
+    blob = base64.b64encode(b"TKVH-fake").decode()
+    prefill.respond["/prefill"] = (
+        200, json.dumps({"blob": blob, "length": 3}).encode(), None)
+    decode.respond["/decode_handoff"] = (
+        200, json.dumps({"tokens": [[7, 8, 9]]}).encode(), None)
+    router = Router(probe_interval_s=0.1, disaggregate=True)
+    router.add_replica(Replica(name="pre", url=prefill.url,
+                               role=ROLE_PREFILL))
+    router.add_replica(Replica(name="dec", url=decode.url,
+                               role=ROLE_DECODE))
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_prefill) == 1
+              and len(router._view_decode) == 1, what="pools up")
+        port = srv.server_address[1]
+        status, _, body = _post(
+            port, "/generate",
+            {"tokens": [[3, 5, 7]], "steps": 3, "seed": 1})
+        assert status == 200
+        assert body == {"tokens": [[7, 8, 9]]}
+        ppath, _, pbody = prefill.requests[-1]
+        assert ppath == "/prefill"
+        assert json.loads(pbody) == {"tokens": [3, 5, 7]}
+        dpath, _, dbody = decode.requests[-1]
+        assert dpath == "/decode_handoff"
+        dreq = json.loads(dbody)
+        assert dreq["blob"] == blob
+        assert dreq["prompt_len"] == 3
+        assert dreq["steps"] == 3 and dreq["seed"] == 1
+        assert "tokens" not in dreq
+    finally:
+        srv.shutdown()
+
+
+def test_disagg_draining_decode_fails_over(fakes):
+    """The disaggregation hops carry the SAME failover contract as the
+    plain proxy: a decode replica's draining 503 re-routes to another
+    decode replica instead of bouncing the client (rolling restarts
+    must be invisible with --disaggregate on)."""
+    prefill, draining, healthy = fakes[0], fakes[1], fakes[2]
+    blob = base64.b64encode(b"TKVH-fake").decode()
+    prefill.respond["/prefill"] = (
+        200, json.dumps({"blob": blob, "length": 2}).encode(), None)
+    draining.respond["/decode_handoff"] = (
+        503, json.dumps({"error": "bye", "reason": "draining",
+                         "retry_after_s": 5}).encode(),
+        {"Retry-After": "5"})
+    healthy.respond["/decode_handoff"] = (
+        200, json.dumps({"tokens": [[4, 5]]}).encode(), None)
+    # bias the decision toward the draining decode replica first
+    healthy.set_overload(queued=4, batch_occupancy=0.9)
+    router = Router(probe_interval_s=0.1, disaggregate=True)
+    router.add_replica(Replica(name="pre", url=prefill.url,
+                               role=ROLE_PREFILL))
+    router.add_replica(Replica(name="drain", url=draining.url,
+                               role=ROLE_DECODE))
+    router.add_replica(Replica(name="ok", url=healthy.url,
+                               role=ROLE_DECODE))
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_decode) == 2, what="pools up")
+        port = srv.server_address[1]
+        status, _, body = _post(port, "/generate",
+                                {"tokens": [[1, 2]], "steps": 2})
+        assert status == 200
+        assert body == {"tokens": [[4, 5]]}
+        assert router._replicas["drain"].state == STATE_DRAINING
+    finally:
+        srv.shutdown()
+
+
+def test_disagg_multi_row_fans_out(fakes):
+    prefill, decode = fakes[0], fakes[1]
+    blob = base64.b64encode(b"TKVH-fake").decode()
+    prefill.respond["/prefill"] = (
+        200, json.dumps({"blob": blob, "length": 2}).encode(), None)
+    decode.respond["/decode_handoff"] = (
+        200, json.dumps({"tokens": [[7]]}).encode(), None)
+    router = Router(probe_interval_s=0.1, disaggregate=True)
+    router.add_replica(Replica(name="pre", url=prefill.url,
+                               role=ROLE_PREFILL))
+    router.add_replica(Replica(name="dec", url=decode.url,
+                               role=ROLE_DECODE))
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_prefill) == 1, what="pool up")
+        port = srv.server_address[1]
+        status, _, body = _post(
+            port, "/generate",
+            {"tokens": [[1, 2], [3, 4], [5, 6]], "steps": 1})
+        assert status == 200
+        assert body == {"tokens": [[7], [7], [7]]}
+        assert len([r for r in prefill.requests
+                    if r[0] == "/prefill"]) == 3
+    finally:
+        srv.shutdown()
+
+
+def test_unknown_paths_collapse_into_one_metric_label(fakes):
+    router = _router(fakes[:1])
+    srv = serve_router(router)
+    try:
+        port = srv.server_address[1]
+        for path in ("/a", "/b", "/c"):
+            try:
+                _post(port, path, {})
+            except urllib.error.HTTPError as exc:
+                exc.read()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 'path="other"' in text
+        for path in ("/a", "/b", "/c"):
+            assert f'path="{path}"' not in text
+    finally:
+        srv.shutdown()
+
+
+def test_disagg_prefill_error_passes_through(fakes):
+    prefill, decode = fakes[0], fakes[1]
+    prefill.respond["/prefill"] = (
+        503, json.dumps({"error": "full",
+                         "reason": "queue_full"}).encode(),
+        {"Retry-After": "3"})
+    router = Router(probe_interval_s=0.1, disaggregate=True)
+    router.add_replica(Replica(name="pre", url=prefill.url,
+                               role=ROLE_PREFILL))
+    router.add_replica(Replica(name="dec", url=decode.url,
+                               role=ROLE_DECODE))
+    srv = serve_router(router)
+    try:
+        _wait(lambda: len(router._view_prefill) == 1, what="pool up")
+        port = srv.server_address[1]
+        try:
+            _post(port, "/generate", {"tokens": [[1, 2]]})
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert exc.headers["Retry-After"] == "3"
+            exc.read()
+        assert decode.requests == []       # decode hop never ran
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# autoscaler policy + ordering
+# --------------------------------------------------------------------------
+
+
+class FakeLauncher:
+    def __init__(self):
+        self.calls = []
+        self.n = 0
+
+    def prepare(self):
+        self.n += 1
+        self.calls.append(("prepare", f"r{self.n}"))
+        return f"r{self.n}"
+
+    def drain(self, name):
+        self.calls.append(("drain", name))
+        return True
+
+    def unprepare(self, name):
+        self.calls.append(("unprepare", name))
+
+
+def _state(routable=4, occupancy=0.5, queued=0, shed=0.0, burn=0.0,
+           replicas=None):
+    return {"routable": routable,
+            "replicas": replicas or [
+                {"name": f"r{i}", "state": STATE_HEALTHY,
+                 "batch_occupancy": occupancy, "inflight": 0}
+                for i in range(routable)],
+            "aggregate": {"mean_occupancy": occupancy,
+                          "queued": queued, "shed_rate": shed,
+                          "burn_rate": burn}}
+
+
+def test_autoscaler_heals_missing_replica():
+    launcher = FakeLauncher()
+    asc = Autoscaler(lambda: _state(routable=3), launcher,
+                     target_replicas=4)
+    asc.tick()
+    assert launcher.calls == [("prepare", "r1")]
+    assert asc.events[0]["reason"] == "heal"
+
+
+def test_autoscaler_scales_up_on_shed_and_burn():
+    launcher = FakeLauncher()
+    asc = Autoscaler(lambda: _state(shed=2.0), launcher,
+                     target_replicas=4, max_replicas=5)
+    asc.tick()
+    assert asc.target == 5
+    assert ("prepare", "r1") in launcher.calls
+    # at max_replicas the policy holds
+    asc.tick()
+    assert asc.target == 5
+    assert len([c for c in launcher.calls if c[0] == "prepare"]) <= 2
+
+    launcher2 = FakeLauncher()
+    asc2 = Autoscaler(lambda: _state(burn=3.0), launcher2,
+                      target_replicas=2, max_replicas=4)
+    asc2.tick()
+    assert asc2.target == 3
+
+
+def test_autoscaler_scale_down_is_drain_then_unprepare():
+    launcher = FakeLauncher()
+    idle = _state(routable=4, occupancy=0.0)
+    # the idlest replica is the victim
+    idle["replicas"][2]["batch_occupancy"] = 0.0
+    idle["replicas"][0]["batch_occupancy"] = 0.4
+    asc = Autoscaler(lambda: idle, launcher, target_replicas=4,
+                     min_replicas=2, low_evals=3)
+    for _ in range(2):
+        asc.tick()
+        assert launcher.calls == []        # not before low_evals
+    asc.tick()
+    # THE ordering contract: drain completes before unprepare
+    kinds = [c[0] for c in launcher.calls]
+    assert kinds == ["drain", "unprepare"]
+    victim = launcher.calls[0][1]
+    assert launcher.calls[1][1] == victim
+    assert asc.target == 3
+
+
+def test_autoscaler_failed_drain_keeps_the_claim():
+    """An incomplete drain must NOT release the claim: the replica may
+    still be serving on those chips — the victim stays prepared and
+    the capacity target is restored."""
+    class StubbornLauncher(FakeLauncher):
+        def drain(self, name):
+            self.calls.append(("drain", name))
+            return False
+    launcher = StubbornLauncher()
+    asc = Autoscaler(lambda: _state(routable=4, occupancy=0.0),
+                     launcher, target_replicas=4, min_replicas=2,
+                     low_evals=1)
+    asc.tick()
+    kinds = [c[0] for c in launcher.calls]
+    assert kinds == ["drain"]              # no unprepare after a
+    assert asc.target == 4                 # failed drain; target
+    assert any(e["action"] == "drain_failed"   # restored
+               for e in asc.events)
+
+
+def test_autoscaler_never_scales_below_min():
+    launcher = FakeLauncher()
+    asc = Autoscaler(lambda: _state(routable=2, occupancy=0.0),
+                     launcher, target_replicas=2, min_replicas=2,
+                     low_evals=1)
+    for _ in range(5):
+        asc.tick()
+    assert launcher.calls == []
+
+
+def test_autoscaler_busy_fleet_resets_low_streak():
+    launcher = FakeLauncher()
+    states = [_state(occupancy=0.0), _state(occupancy=0.0),
+              _state(occupancy=0.9), _state(occupancy=0.0),
+              _state(occupancy=0.0)]
+    it = iter(states)
+    asc = Autoscaler(lambda: next(it), launcher, target_replicas=4,
+                     min_replicas=1, low_evals=3)
+    for _ in range(5):
+        asc.tick()
+    assert launcher.calls == []            # the busy tick broke the run
+
+
+# --------------------------------------------------------------------------
+# pooled client
+# --------------------------------------------------------------------------
+
+
+def test_pooled_client_reuses_connections(fakes):
+    client = PooledClient("127.0.0.1", fakes[0].port, timeout_s=5.0)
+    try:
+        for _ in range(3):
+            status, _, body = client.request(
+                "POST", "/generate", body=b"{}",
+                headers={"Content-Type": "application/json"})
+            assert status == 200
+        with client._mu:
+            assert len(client._idle) == 1      # one conn, reused
+    finally:
+        client.close()
+
+
+def test_pooled_client_recovers_from_stale_keepalive(fakes):
+    """A keep-alive socket the replica closed between requests must
+    retry once on a fresh connection instead of failing the request."""
+    client = PooledClient("127.0.0.1", fakes[0].port, timeout_s=5.0)
+    try:
+        client.request("POST", "/generate", body=b"{}")
+        # sabotage the pooled connection under the client
+        with client._mu:
+            conn = client._idle[0]
+        conn.sock.close()
+        status, _, _ = client.request("POST", "/generate", body=b"{}")
+        assert status == 200
+    finally:
+        client.close()

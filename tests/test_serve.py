@@ -38,6 +38,29 @@ def test_healthz(server):
         f"{base}/healthz", timeout=10).read() == b"ok"
 
 
+def test_request_joins_incoming_traceparent(server):
+    """ISSUE 14 propagation contract: a request carrying a (sampled)
+    traceparent must run its serve.request span INSIDE that trace —
+    the router forwards its traceparent so one trace id spans
+    client -> router -> replica -> engine, and the id must resolve on
+    this replica's /debug/traces."""
+    _, _, base = server
+    tp = "00-" + "7e" * 16 + "-" + "1b" * 8 + "-01"
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"tokens": [[1, 2]], "steps": 2}).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": tp})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        r.read()
+    trace_id = tp.split("-")[1]
+    with urllib.request.urlopen(
+            f"{base}/debug/traces?trace_id={trace_id}",
+            timeout=30) as r:
+        events = json.loads(r.read())["traceEvents"]
+    assert "serve.request" in {e.get("name") for e in events}
+
+
 def test_generate_matches_local_decode(server):
     cfg, params, base = server
     prompt = [3, 1, 4, 1, 5]

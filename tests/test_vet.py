@@ -805,6 +805,47 @@ def test_metric_hygiene_exemplar_labels_restricted(tmp_path):
                        _EXEMPLAR_OK, checks=["metric-hygiene"]) == []
 
 
+_METRIC_WORKLOAD = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_reqs = DEFAULT_REGISTRY.counter(
+    "tpu_serve_requests_total", "requests", labels=("code",))
+
+_goodput = DEFAULT_REGISTRY.counter(
+    "tpu_goodput_seconds_total", "wall time", labels=("segment",))
+
+_decision = DEFAULT_REGISTRY.histogram(
+    "tpu_router_decision_seconds", "decision time")
+"""
+
+
+def test_metric_hygiene_workload_namespaces_allowed_in_workloads(
+        tmp_path):
+    """serve/goodput/router own their tenant-facing namespaces — but
+    ONLY under tpu_dra/workloads/ (the binaries with private
+    registries); the same names in driver code are still findings."""
+    assert vet_snippet(tmp_path, "tpu_dra/workloads/mh8.py",
+                       _METRIC_WORKLOAD,
+                       checks=["metric-hygiene"]) == []
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/mh8.py",
+                        _METRIC_WORKLOAD, checks=["metric-hygiene"])
+    assert len(diags) == 3, diags
+    assert all("must match tpu_dra_" in d.message for d in diags)
+    # an unknown workload namespace is a finding even in workloads/
+    rogue = ('from tpu_dra.util.metrics import DEFAULT_REGISTRY\n\n'
+             '_x = DEFAULT_REGISTRY.counter("tpu_rogue_total", "x")\n')
+    assert len(vet_snippet(tmp_path, "tpu_dra/workloads/mh9.py",
+                           rogue, checks=["metric-hygiene"])) == 1
+
+
+def test_metric_hygiene_real_workload_metrics_conform():
+    """The live serve/goodput/router registrations pass with ZERO
+    ignores — the namespaces are first-class, not exemptions."""
+    diags = run_paths([os.path.join(REPO_ROOT, "tpu_dra", "workloads")],
+                      checks=["metric-hygiene"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
 def test_metric_hygiene_real_driver_metrics_conform():
     """Every series the driver fleet actually registers passes the
     contract — the live complement of the fixture tests (workqueue,

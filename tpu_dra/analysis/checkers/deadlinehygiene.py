@@ -11,8 +11,10 @@ workloads turns one wedged peer into a wedged handler thread.
 
 Scope (the data plane and the harnesses that drive it):
 ``tpu_dra/workloads/serve.py``, ``tpu_dra/workloads/continuous.py``,
-and every ``hack/drive_*.py`` — the ``make vet`` target runs this
-checker over both trees.
+``tpu_dra/workloads/router.py`` (the cluster front-end: every proxied
+hop and every probe must carry a timeout, or one wedged replica parks
+router threads), and every ``hack/drive_*.py`` — the ``make vet``
+target runs this checker over both trees.
 
 Flagged calls, unless they pass an explicit ``timeout`` (keyword, or
 the positional slot that means timeout):
@@ -54,7 +56,8 @@ def _path_in_scope(path: str) -> bool:
     would be double-reported (direct finding at the origin plus a
     call-site finding at every caller)."""
     if path.endswith("workloads/serve.py") or \
-            path.endswith("workloads/continuous.py"):
+            path.endswith("workloads/continuous.py") or \
+            path.endswith("workloads/router.py"):
         return True
     # any drive_*.py, wherever it lives (hack/ in the repo; tmp dirs in
     # the checker's own tests)

@@ -8,7 +8,15 @@ checked over registry registration calls in non-test ``tpu_dra/`` code:
 
 1. metric names passed to ``.counter()`` / ``.gauge()`` /
    ``.histogram()`` on a registry must match ``tpu_dra_[a-z0-9_]+``
-   (lowercase, driver-prefixed — the Prometheus naming convention);
+   (lowercase, driver-prefixed — the Prometheus naming convention).
+   Files under ``tpu_dra/workloads/`` may additionally use the
+   workload-side namespaces ``tpu_serve_*`` / ``tpu_goodput_*`` /
+   ``tpu_router_*`` — those binaries expose PRIVATE registries on
+   their own endpoints (serve.py, router.py, goodput.py), and their
+   tenant-facing series are a first-class contract documented in
+   docs/observability.md, not an exemption.  Outside workloads/ the
+   driver prefix stays mandatory: a fleet-side series sneaking into a
+   workload namespace would vanish from the driver dashboards;
 2. the help text argument must be a non-empty string;
 3. the metric classes (``Counter``/``Gauge``/``Histogram`` *imported
    from* ``util/metrics`` — ``collections.Counter`` is not ours) must
@@ -39,6 +47,10 @@ import re
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 
 _NAME_RE = re.compile(r"^tpu_dra_[a-z0-9_]+$")
+# workload binaries (serve/router/goodput) own their tenant-facing
+# namespaces on private registries — legal ONLY under tpu_dra/workloads/
+_WORKLOAD_NAME_RE = re.compile(
+    r"^tpu_(serve|goodput|router)_[a-z0-9_]+$")
 _REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 _METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # the registry implementation itself registers nothing and legitimately
@@ -145,6 +157,18 @@ def _metric_class_imports(tree: ast.AST) -> set[str]:
     return names
 
 
+def _name_ok(path: str, name: str) -> bool:
+    """Rule 1 with the workloads carve-out: driver prefix everywhere,
+    plus the serve/goodput/router namespaces under tpu_dra/workloads/
+    (their catalog of record is still docs/observability.md — the
+    contract-drift checker pairs every registration with it)."""
+    if _NAME_RE.match(name):
+        return True
+    norm = path.replace("\\", "/")
+    return "/workloads/" in norm and \
+        bool(_WORKLOAD_NAME_RE.match(name))
+
+
 def _run(ctx: FileContext) -> list[Diagnostic]:
     if ctx.is_test() or ctx.path.endswith(_OWNER):
         return []
@@ -177,12 +201,13 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
         name = _literal_str(node.args[0])
         if fn.attr == "histogram":
             diags.extend(_check_buckets(ctx, node, name))
-        if name is not None and not _NAME_RE.match(name):
+        if name is not None and not _name_ok(ctx.path, name):
             diags.append(ctx.diag(
                 node, "metric-hygiene",
                 f"metric name {name!r} must match tpu_dra_[a-z0-9_]+ "
-                f"(lowercase, driver-prefixed) so dashboards and alerts "
-                f"can find it"))
+                f"(lowercase, driver-prefixed; tpu_serve_/tpu_goodput_/"
+                f"tpu_router_ allowed only under tpu_dra/workloads/) "
+                f"so dashboards and alerts can find it"))
         help_node = None
         if len(node.args) >= 2:
             help_node = node.args[1]

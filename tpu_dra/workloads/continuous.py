@@ -111,6 +111,11 @@ class _Request:
     # next pass boundary, freeing their slot and paged-KV pages —
     # finishing an answer nobody waits for is pure badput
     deadline: Optional[float] = None
+    # disaggregated serving (kv_handoff.py): the prompt's KV arrives as
+    # serialized pages from a prefill-pool engine instead of being
+    # prefilled here — admission imports the pages and seeds the slot
+    # from the blob's last-position logits (submit_handoff)
+    handoff: Optional[Any] = None
     tokens: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     submitted: float = field(default_factory=time.perf_counter)
@@ -280,6 +285,7 @@ class ContinuousEngine:
                 self._dcache = init_paged_cache(draft[0], cap, ps,
                                                 cache_dtype)
             self._table = jnp.full((slots, self._mp), -1, jnp.int32)
+            self._handoff_fns: dict[int, Any] = {}
             self._page_ids: list[Optional[list[int]]] = [None] * slots
             # zero-copy prefix pages referenced by each slot's table
             self._shared_ids: list[list[int]] = [[] for _ in range(slots)]
@@ -1129,17 +1135,125 @@ class ContinuousEngine:
             self._cv.notify_all()
         return req
 
-    def warmup(self, buckets: Optional[list[int]] = None) -> int:
+    def submit_handoff(self, handoff, steps: int,
+                       eos_id: Optional[int] = None,
+                       temperature: float = 0.0, seed: int = 0,
+                       stop: Optional[list[list[int]]] = None,
+                       deadline: Optional[float] = None) -> _Request:
+        """Enqueue a prefill-pool handoff (kv_handoff.KVHandoff): the
+        prompt's KV arrives as serialized pages from another engine, so
+        admission scatters the pages into this pool and selects the
+        first token from the blob's last-position logits — through the
+        SAME ``_first_token`` path a local prefill would use, which is
+        what makes the cross-engine decode byte-identical to the
+        single-engine one (tests/test_kv_handoff.py).
+
+        Paged engines only (the page table is what makes the KV
+        addressable); speculative engines refuse — the draft cache has
+        no imported context, so the draft would propose against garbage
+        and the handoff's latency win would evaporate silently."""
+        cfg = self.cfg
+        if self.kv_layout != "paged":
+            raise ValueError("KV handoff needs kv_layout='paged' (the "
+                             "page table is what makes a sequence's KV "
+                             "addressable for import)")
+        if self.draft is not None:
+            raise ValueError(
+                "speculative engines cannot import a handoff: the "
+                "draft model's cache has no context for the imported "
+                "pages; serve the decode pool without a draft")
+        from tpu_dra.workloads.kv_handoff import KVHandoff, model_dims
+        if not isinstance(handoff, KVHandoff):
+            raise ValueError(f"handoff must be a KVHandoff, got "
+                             f"{type(handoff).__name__}")
+        mine = model_dims(cfg)
+        if handoff.model != mine:
+            raise ValueError(
+                f"handoff was prefilled by a different model "
+                f"({handoff.model} != {mine}); decoding its pages "
+                f"would be silent garbage")
+        if handoff.page_size != self.pool.page_size:
+            raise ValueError(
+                f"handoff page_size {handoff.page_size} != engine "
+                f"page_size {self.pool.page_size}")
+        # array-shape validation HERE, on the caller's thread: a
+        # malformed blob must 400 the one request — reaching the jit'd
+        # scatter on the batcher thread would _fail_all the ENGINE
+        # (one crafted request = a dead replica)
+        ks_shape = tuple(np.asarray(handoff.ks).shape)
+        if ks_shape != tuple(np.asarray(handoff.vs).shape):
+            raise ValueError(
+                f"handoff k/v shapes disagree: {ks_shape} vs "
+                f"{tuple(np.asarray(handoff.vs).shape)}")
+        want = (cfg.n_layers, 1, cfg.kv_heads)
+        if len(ks_shape) != 5 or ks_shape[:3] != want or \
+                ks_shape[4] != cfg.d_head:
+            raise ValueError(
+                f"handoff KV shape {ks_shape} does not match this "
+                f"model's [L={cfg.n_layers}, 1, Hkv={cfg.kv_heads}, "
+                f"S_pad, Dh={cfg.d_head}] layout")
+        s_pad = ks_shape[3]
+        if s_pad % handoff.page_size or s_pad < handoff.length:
+            raise ValueError(
+                f"handoff KV columns {s_pad} must be a page multiple "
+                f"covering length {handoff.length}")
+        logits_shape = tuple(np.asarray(handoff.last_logits).shape)
+        if logits_shape != (cfg.vocab,):
+            raise ValueError(
+                f"handoff last_logits shape {logits_shape} != "
+                f"({cfg.vocab},)")
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+            raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
+        if handoff.length + steps > self.max_len:
+            raise ValueError(
+                f"handoff length {handoff.length} + steps {steps} "
+                f"exceeds the engine's max_len {self.max_len}")
+        if self.pool.pages_for(handoff.length + steps) > \
+                self.pool.total_pages:
+            raise ValueError(
+                f"handoff needs "
+                f"{self.pool.pages_for(handoff.length + steps)} KV "
+                f"pages but the pool only has {self.pool.total_pages}")
+        if stop is not None:
+            stop = [list(seq) for seq in stop]
+        req = _Request(prompt=list(handoff.prompt), steps=steps,
+                       eos_id=eos_id, temperature=float(temperature),
+                       seed=seed, stop=stop, deadline=deadline,
+                       handoff=handoff)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            if self._draining:
+                raise RuntimeError("engine is draining (rolling "
+                                   "restart); retry against the new "
+                                   "instance")
+            self._pending.append(req)
+            self._cv.notify_all()
+        return req
+
+    def warmup(self, buckets: Optional[list[int]] = None,
+               burst: Optional[int] = None) -> int:
         """Compile the serving-critical programs before real traffic:
-        one 1-token-prompt admission per prompt bucket (k=1 prefill
-        program + the shared step program on the first pass).  Stats are
-        reset afterwards so compile time never reads as serving latency
-        (what bench.py and operators previously hand-rolled).  Returns
-        the number of buckets warmed."""
+        per prompt bucket, one 1-token-prompt admission (k=1 prefill
+        program + the shared step program on the first pass) and then
+        one ``burst``-wide concurrent admission — ``_admit`` coalesces
+        same-bucket arrivals into power-of-two ``[k, Sb]`` prefill
+        dispatches, so the k>1 programs MUST compile here too or the
+        first real traffic burst stalls the whole serving loop behind
+        a fresh compile (observed: a warmed fleet's first seconds under
+        load collapsed into admission sheds while every replica
+        compiled its k=2 prefill).  ``burst`` defaults to
+        ``min(slots, 4)``: the small-burst programs real traffic hits
+        immediately; wider bursts amortize their own compiles.  Stats
+        are reset afterwards so compile time never reads as serving
+        latency.  Returns the number of buckets warmed."""
         want = buckets or [b for b in _PROMPT_BUCKETS
                            if b < self.max_len]
         if not buckets and self.max_len > (want[-1] if want else 0):
             want.append(self.max_len)     # the clamped top bucket
+        k = min(self.slots, 4) if burst is None else burst
         warmed = 0
         for b in want:
             # steps=2 so the chunk-step program compiles too (a steps=1
@@ -1151,7 +1265,19 @@ class ContinuousEngine:
                 _, need, _ = self._paged_requirements(n, 2, None)
                 if need > self.pool.total_pages:
                     continue              # bucket unservable at this pool
+                if k > 1 and need * k > self.pool.total_pages:
+                    # pool can't hold the full burst: warm what fits
+                    k = max(1, self.pool.total_pages // max(1, need))
             self.submit([1] * n, 2, timeout=600)
+            if k > 1:
+                group = [self.submit_async([1] * n, 2)
+                         for _ in range(k)]
+                for req in group:
+                    if not req.done.wait(600):
+                        raise TimeoutError(
+                            "warmup burst not done within 600s")
+                    if req.error:
+                        raise RuntimeError(req.error)
             warmed += 1
         self.reset_stats()
         return warmed
@@ -1332,9 +1458,18 @@ class ContinuousEngine:
                 # zero-copy prefix pages it shares), stop admitting —
                 # later smaller requests must not starve it
                 req = self._pending[0]
-                shared, need, gate_pref = self._paged_requirements(
-                    len(req.prompt), req.steps, req.prefix_id,
-                    take_refs=True)
+                if req.handoff is not None:
+                    # handoff admissions carry their KV with them: no
+                    # prefix shares, own pages sized to the imported
+                    # context + the decode budget (submit_handoff
+                    # already bounded this against the pool)
+                    shared, gate_pref = [], None
+                    need = self.pool.pages_for(
+                        req.handoff.length + req.steps)
+                else:
+                    shared, need, gate_pref = self._paged_requirements(
+                        len(req.prompt), req.steps, req.prefix_id,
+                        take_refs=True)
                 # pages held resident by prefixes can never free without
                 # an eviction, and own pages only ever come from the
                 # non-resident remainder (the joined prefix's shared
@@ -1379,7 +1514,9 @@ class ContinuousEngine:
             assigned.append((slot, req))
         plain: dict[int, list[tuple[int, _Request]]] = {}
         for slot, req in assigned:
-            if req.prefix_id is not None:
+            if req.handoff is not None:
+                self._admit_handoff(slot, req)
+            elif req.prefix_id is not None:
                 self._admit_prefix(slot, req)
             else:
                 plain.setdefault(
@@ -1524,6 +1661,43 @@ class ContinuousEngine:
         for (slot, req), key, first_host in zip(group, base_keys, firsts):
             self._finish_admission(slot, req, first_host,
                                    len(req.prompt), key)
+
+    def _handoff_impl(self, cfg, cache, ks, vs, logits, rows, temps,
+                      keys):
+        """Import a handoff's pages and select the first token — the
+        paged-prefill admission with the trunk replaced by a scatter
+        (the prefill-pool engine already ran the trunk).  Quantizing
+        pools quantize at page-write inside scatter_prefill, exactly as
+        a local prefill would."""
+        from tpu_dra.workloads.paged_kv import scatter_prefill
+        cache = scatter_prefill(cache, ks, vs, rows)
+        return cache, self._first_token(logits, temps, keys)[0]
+
+    def _admit_handoff(self, slot: int, req: "_Request") -> None:
+        """Admit a prefill-pool handoff: scatter the blob's KV columns
+        into the slot's pages (columns beyond the allocation drop via
+        the scatter's sentinel mode — same bucket-vs-pages slack as a
+        local prefill) and seed position/sampling state at the imported
+        length.  Runs on the batcher thread: only it mutates the engine
+        cache (the prefix-join discipline)."""
+        h = req.handoff
+        S_pad = int(np.asarray(h.ks).shape[3])
+        fn = self._handoff_fns.get(S_pad)
+        if fn is None:
+            fn = jax.jit(partial(self._handoff_impl, self.cfg),
+                         donate_argnums=(0,))        # the page pool
+            self._handoff_fns[S_pad] = fn
+        key = jax.random.PRNGKey(req.seed)
+        self._cache, first = fn(
+            self._cache,
+            jnp.asarray(np.asarray(h.ks), jnp.bfloat16),
+            jnp.asarray(np.asarray(h.vs), jnp.bfloat16),
+            jnp.asarray(np.asarray(h.last_logits),
+                        jnp.float32)[None],
+            self._table[slot][None],
+            jnp.asarray([req.temperature], jnp.float32),
+            jax.random.fold_in(key, 0)[None])
+        self._finish_admission(slot, req, int(first), h.length, key)
 
     def _admit_prefix(self, slot: int, req: "_Request") -> None:
         """Shared-prefix join: copy the prefix KV, prefill only the

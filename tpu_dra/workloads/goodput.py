@@ -149,14 +149,14 @@ class GoodputTracker:
                     merged.get("reconfigurations", []))
             # tpu_goodput_* is the TENANT-side workload namespace (like
             # tpu_serve_*) — exempt from the driver's tpu_dra_* contract
-            self._seconds = self._registry.counter(  # vet: ignore[metric-hygiene]
+            self._seconds = self._registry.counter(
                 "tpu_goodput_seconds_total",
                 "training wall time by goodput segment", ("segment",))
-            self._ratio = self._registry.gauge(  # vet: ignore[metric-hygiene]
+            self._ratio = self._registry.gauge(
                 "tpu_goodput_ratio",
                 "rolling productive-step fraction of wall time "
                 f"(window {int(self._window_s)}s)")
-            self._downtime = self._registry.histogram(  # vet: ignore[metric-hygiene]
+            self._downtime = self._registry.histogram(
                 "tpu_goodput_downtime_seconds",
                 "reconfiguration downtime per recovery (exemplar: the "
                 "recovery trace id)",

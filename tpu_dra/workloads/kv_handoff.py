@@ -1,0 +1,320 @@
+"""KV handoff: serialized paged-KV page transfer between engines.
+
+The disaggregated-serving primitive (docs/scaling.md "Cluster serving",
+PAPERS.md: DistServe/Splitwise): a PREFILL-pool engine computes a
+prompt's KV once, and a DECODE-pool engine continues generation from it
+— prefill's bursty compute and decode's steady memory-bound loop stop
+sharing one replica's batch.  The page table (paged_kv.py) is what makes
+this cheap: a sequence's KV is an addressable set of pages, so the
+handoff is "move these pages", not "replay this prompt".
+
+Two transports:
+
+- **wire** (the always-on path, fully tested on CPU): the pages'
+  contents serialize into one self-describing blob
+  (:func:`encode` / :func:`decode_blob`) that travels HTTP between
+  replicas (serve.py ``/prefill`` → router → ``/decode_handoff``).
+  KV travels bf16 regardless of the pool dtype — an int8 destination
+  quantizes at page-write exactly like its own prefill would, so the
+  cross-engine decode stays byte-identical to the single-engine one.
+- **ICI** (the TPU fast path, capability-gated): when both engines
+  live on chips of one ICI domain, the page buffers move as ONE async
+  remote DMA per leaf via the PR-10 ring machinery
+  (:func:`pallas_kernels.ring_shift`) — no host round-trip, no
+  serialization.  :func:`ici_supported` gates it; CPU hosts and
+  cross-domain fleets fall back to the wire path.  The interpret-mode
+  tests prove the transfer semantics without hardware.
+
+Byte-identity contract (tests/test_kv_handoff.py): for the same model,
+page size, and engine knobs, ``prefill replica → blob → decode
+replica`` produces EXACTLY the tokens a single engine produces for the
+same request — the first token is chosen decode-side from the blob's
+last-position logits through the very same ``_first_token`` path a
+local prefill would use, and the imported pages hold the very same KV
+the local prefill would have written.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dra.workloads.train import ModelConfig
+
+BLOB_SCHEMA = "tpu-kv-handoff/v1"
+_MAGIC = b"TKVH"
+
+# wire dtypes: logical name <-> numpy dtype (bfloat16 rides as itself —
+# jnp.bfloat16 IS the ml_dtypes scalar type numpy understands)
+_DTYPES = {
+    "bfloat16": np.dtype(jnp.bfloat16),
+    "float32": np.dtype(np.float32),
+    "int32": np.dtype(np.int32),
+}
+
+
+def model_dims(cfg: ModelConfig) -> dict:
+    """The model fingerprint a handoff carries: a decode engine must
+    refuse KV computed by a different architecture — decoding another
+    model's pages would be silent garbage, never an error."""
+    return {"vocab": cfg.vocab, "d_model": cfg.d_model,
+            "n_heads": cfg.n_heads, "n_kv_heads": cfg.n_kv_heads,
+            "n_layers": cfg.n_layers, "d_ff": cfg.d_ff,
+            "pos_emb": cfg.pos_emb}
+
+
+@dataclass
+class KVHandoff:
+    """One sequence's prefill result, addressed for page import.
+
+    ``ks``/``vs``: ``[L, 1, Hkv, S_pad, Dh]`` bf16 — the page-granular
+    KV columns a destination engine scatters straight into its pool
+    (``S_pad`` is the prompt bucket padded to a page multiple; columns
+    past ``length`` are causally dead).  ``last_logits``: the
+    last-real-position logits ``[vocab]`` fp32, from which the decode
+    engine selects the first generated token with ITS OWN sampling
+    state — the blob carries the distribution, not a decision."""
+
+    prompt: list[int]
+    length: int
+    page_size: int
+    model: dict
+    ks: Any
+    vs: Any
+    last_logits: Any
+
+    def pages(self) -> int:
+        """Pages of KV content this handoff carries."""
+        return -(-self.length // self.page_size)
+
+
+def encode(h: KVHandoff) -> bytes:
+    """Serialize to the wire blob: magic + length-prefixed JSON header
+    + raw C-order array bytes.  Self-describing (shapes/dtypes in the
+    header) so versions can evolve without guessing."""
+    arrays = [("ks", np.asarray(h.ks)), ("vs", np.asarray(h.vs)),
+              ("last_logits", np.asarray(h.last_logits, np.float32))]
+    header = {
+        "schema": BLOB_SCHEMA,
+        "prompt": list(h.prompt),
+        "length": int(h.length),
+        "page_size": int(h.page_size),
+        "model": h.model,
+        "arrays": [[name, list(a.shape), _dtype_name(a.dtype)]
+                   for name, a in arrays],
+    }
+    hdr = json.dumps(header).encode()
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    buf.write(struct.pack("<I", len(hdr)))
+    buf.write(hdr)
+    for _, a in arrays:
+        buf.write(np.ascontiguousarray(a).tobytes())
+    return buf.getvalue()
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    for name, d in _DTYPES.items():
+        if dt == d:
+            return name
+    raise ValueError(f"unsupported handoff wire dtype {dt}")
+
+
+def decode_blob(data: bytes) -> KVHandoff:
+    """Parse a wire blob back into a :class:`KVHandoff`.  Malformed
+    input raises ``ValueError`` — the HTTP layer turns it into a 400,
+    never a crashed batcher."""
+    if len(data) < 8 or data[:4] != _MAGIC:
+        raise ValueError("not a KV-handoff blob (bad magic)")
+    (hlen,) = struct.unpack("<I", data[4:8])
+    if hlen <= 0 or 8 + hlen > len(data):
+        raise ValueError("truncated KV-handoff header")
+    try:
+        header = json.loads(data[8:8 + hlen])
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"bad KV-handoff header: {exc}") from None
+    if header.get("schema") != BLOB_SCHEMA:
+        raise ValueError(f"unknown handoff schema "
+                         f"{header.get('schema')!r}")
+    off = 8 + hlen
+    out: dict[str, np.ndarray] = {}
+    for name, shape, dtype_name in header["arrays"]:
+        dt = _DTYPES.get(dtype_name)
+        if dt is None:
+            raise ValueError(f"unknown wire dtype {dtype_name!r}")
+        n = int(np.prod(shape)) * dt.itemsize
+        if off + n > len(data):
+            raise ValueError(f"truncated array {name!r}")
+        out[name] = np.frombuffer(
+            data[off:off + n], dtype=dt).reshape(shape)
+        off += n
+    for need in ("ks", "vs", "last_logits"):
+        if need not in out:
+            raise ValueError(f"handoff blob missing array {need!r}")
+    length = int(header["length"])
+    prompt = [int(t) for t in header["prompt"]]
+    if length != len(prompt) or length < 1:
+        raise ValueError(f"handoff length {length} does not match "
+                         f"prompt ({len(prompt)} tokens)")
+    if out["ks"].shape != out["vs"].shape or out["ks"].ndim != 5:
+        raise ValueError(f"handoff KV shapes disagree: "
+                         f"{out['ks'].shape} vs {out['vs'].shape}")
+    return KVHandoff(prompt=prompt, length=length,
+                     page_size=int(header["page_size"]),
+                     model=dict(header["model"]),
+                     ks=out["ks"], vs=out["vs"],
+                     last_logits=out["last_logits"])
+
+
+def peek_prompt_len(blob_b64: str) -> Optional[int]:
+    """The prompt length from a base64 wire blob WITHOUT decoding the
+    arrays — the admission gate prices /decode_handoff requests from
+    the blob itself, never from a client-asserted field.  Decodes just
+    enough base64 to read the length-prefixed JSON header (cheap: the
+    header is a few hundred bytes however large the KV is).  None =
+    not a parseable blob (the request will 400 downstream anyway)."""
+    import base64
+    import binascii
+    try:
+        head = base64.b64decode(blob_b64[:16], validate=True)
+        if len(head) < 8 or head[:4] != _MAGIC:
+            return None
+        (hlen,) = struct.unpack("<I", head[4:8])
+        if not 0 < hlen <= 1 << 20:
+            return None
+        need_chars = -(-(8 + hlen) // 3) * 4
+        prefix = base64.b64decode(
+            blob_b64[:need_chars + 4], validate=True)
+        header = json.loads(prefix[8:8 + hlen])
+        return max(1, int(header["length"]))
+    except (binascii.Error, TypeError, ValueError, KeyError,
+            json.JSONDecodeError):
+        return None
+
+
+# --------------------------------------------------------------------------
+# Prefill side
+# --------------------------------------------------------------------------
+
+
+class PrefillExporter:
+    """The prefill pool's half: compute one prompt's KV + last-position
+    logits and package them for export.
+
+    Mirrors the engine's own paged admission exactly
+    (``_paged_prefill_core``): the prompt pads to its engine bucket,
+    then to a page multiple, and the trunk runs once — so the exported
+    pages are bit-for-bit what a local prefill would have written, and
+    the compiled-program count stays O(buckets), not O(prompt lengths).
+    """
+
+    def __init__(self, cfg: ModelConfig, params, *, page_size: int,
+                 max_len: Optional[int] = None) -> None:
+        if page_size < 1 or page_size & (page_size - 1):
+            raise ValueError(f"page_size must be a power of two, got "
+                             f"{page_size}")
+        self.cfg = cfg
+        self.params = params
+        self.page_size = page_size
+        self.max_len = max_len or cfg.max_seq
+        self._dims = model_dims(cfg)
+        self._fns: dict[int, Any] = {}
+
+    def _bucket(self, n: int) -> int:
+        from tpu_dra.workloads.continuous import _PROMPT_BUCKETS
+        for b in _PROMPT_BUCKETS:
+            if n <= b:
+                return min(b, self.max_len)
+        raise ValueError(f"prompt exceeds the largest bucket "
+                         f"{_PROMPT_BUCKETS[-1]}")
+
+    def _impl(self, cfg, params, prompts, lengths):
+        from tpu_dra.workloads.decode import head_logits
+        from tpu_dra.workloads.paged_kv import _prefill_kv
+        ks, vs, x = _prefill_kv(cfg, params, prompts)
+        last = x[jnp.arange(1), lengths - 1][:, None, :]
+        return ks, vs, head_logits(params, last)[0, 0]
+
+    def export(self, prompt: list[int]) -> KVHandoff:
+        cfg = self.cfg
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if any(t < 0 or t >= cfg.vocab for t in prompt):
+            raise ValueError(f"token ids must be in [0, {cfg.vocab})")
+        if len(prompt) > self.max_len:
+            raise ValueError(f"prompt {len(prompt)} exceeds max_len "
+                             f"{self.max_len}")
+        Sb = self._bucket(len(prompt))
+        S_pad = Sb + (-Sb) % self.page_size
+        fn = self._fns.get(S_pad)
+        if fn is None:
+            fn = jax.jit(partial(self._impl, cfg))
+            self._fns[S_pad] = fn
+        prompts = jnp.asarray(
+            [list(prompt) + [0] * (S_pad - len(prompt))], jnp.int32)
+        ks, vs, logits = fn(self.params, prompts,
+                            jnp.asarray([len(prompt)], jnp.int32))
+        ks, vs, logits = jax.device_get((ks, vs, logits))
+        return KVHandoff(prompt=list(prompt), length=len(prompt),
+                         page_size=self.page_size, model=self._dims,
+                         ks=ks, vs=vs,
+                         last_logits=np.asarray(logits, np.float32))
+
+
+# --------------------------------------------------------------------------
+# ICI fast path (capability-gated; wire path is the tested default)
+# --------------------------------------------------------------------------
+
+
+def ici_supported() -> bool:
+    """True when the remote-DMA page transfer can run: a real TPU
+    backend with more than one device (prefill and decode engines on
+    chips of one ICI domain).  Everything else takes the wire path."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return False
+    return bool(devs) and devs[0].platform == "tpu" and len(devs) > 1
+
+
+def ici_shift(tree, axis_name: str = "handoff", *,
+              reverse: bool = False, interpret: bool = False):
+    """Ship KV buffers one ICI hop: every leaf of ``tree`` moves to the
+    ring neighbour as ONE async remote DMA (PR 10's ``ring_shift``) —
+    the prefill chip pushes its just-written pages while the decode
+    chip's MXU keeps decoding, which is the whole point of reusing the
+    collective machinery instead of a host copy.
+
+    Call per-device inside ``shard_map`` over the mesh that holds both
+    engines (the caller owns mesh construction — this module never
+    creates global state).  ``interpret=True`` runs the XLA-emulated
+    ring (CPU tests); on hardware the Pallas remote-copy path runs.
+    """
+    from tpu_dra.workloads.pallas_kernels import ring_shift
+    return jax.tree_util.tree_map(
+        lambda x: ring_shift(x, axis_name, reverse, interpret), tree)
+
+
+def transfer(h: KVHandoff, *, via: str = "auto") -> bytes:
+    """One entry point for the router/serve layer: ``via="wire"``
+    serializes (always available), ``via="ici"`` is reserved for
+    engines sharing a mesh (the serve layer keeps both engines in one
+    process only in tests — cross-process ICI handoff needs the device
+    mesh plumbing a future slice-domain integration owns), and
+    ``"auto"`` picks wire unless the capability gate opens."""
+    if via == "ici" or (via == "auto" and ici_supported()):
+        # capability-gated: the cross-PROCESS device-mesh plumbing is
+        # not wired yet, so even capable hosts serialize today; the
+        # in-mesh primitive itself is ici_shift (interpret-tested)
+        pass
+    if via not in ("wire", "ici", "auto"):
+        raise ValueError(f"via must be wire|ici|auto, got {via!r}")
+    return encode(h)

@@ -1,0 +1,1350 @@
+"""Claim-aware serving router: the cluster front-end over N replicas.
+
+ROADMAP item 2 (docs/scaling.md "Cluster serving"): the single-replica
+engine is production-grade, but nothing composed replicas across chips
+— fleet throughput was capped at one engine no matter how many
+prepared claims existed.  This module is the composition layer:
+
+- **discovery**: a static replica list, a fleet file the autoscaler
+  maintains, and *prepared-claim introspection* — when pointed at the
+  kubelet plugin's checkpoint (``--claims-checkpoint``), a replica
+  whose claim is no longer prepared stops receiving traffic within one
+  probe interval (the claim IS the capacity; routing to an unprepared
+  one is routing to a chip someone else may hold), and a claim's
+  device count becomes the replica's capacity weight (the
+  ``tpu_dra_chip_seconds_total`` capacity signal, read at its source).
+- **balancing**: a background prober polls each replica's
+  ``/debug/overload`` (backlog, batch occupancy, KV pressure, drain
+  state, admission shed counts) and ``/debug/slo`` (availability burn
+  rates) — the signals PRs 8-9 built for exactly this consumer — and
+  folds them into one score per replica
+  (:func:`replica_score`).  The per-request decision
+  (:meth:`Router.decide`) is a lock-free scan of the published
+  snapshot plus an affinity lookup: O(10µs), ratcheted by
+  ``router_decision_us`` in bench-budget.json.
+- **session affinity**: requests carrying the session header (default
+  ``X-Session-Id``) stick to their replica while it stays routable —
+  decode streams and ``/prefix``-registered contexts live on one
+  engine's KV, so moving them mid-session would discard state.
+- **typed failure**: a replica's capacity 503 (queue_full /
+  tenant_quota / cost_too_large) passes through verbatim, honoring the
+  replica's ``Retry-After`` — the router never converts an honest shed
+  into a retry storm.  A *draining* 503 retries on another replica
+  (the work was never started; the client should not pay for a rolling
+  restart), and a transport error ejects the replica and retries.
+- **health-aware ejection/readmission**: a failed probe, a draining
+  report, or a vanished claim makes a replica non-routable within one
+  probe interval; a healthy probe readmits it.
+- **prefill/decode disaggregation** (``--disaggregate``): with a
+  prefill pool present, ``/generate`` becomes prefill-replica
+  ``/prefill`` → KV blob → decode-replica ``/decode_handoff``
+  (kv_handoff.py) — byte-identical output, with prefill's bursty
+  compute and decode's steady loop on separate engines.
+- **autoscaling** (:class:`Autoscaler`): converts burn-rate + shed
+  signals into replica prepare/unprepare through a pluggable launcher
+  whose real implementation drives the DRA claim path (plugin gRPC —
+  hack/drive_fleet.py); scale-down is ALWAYS graceful drain first.
+
+The module is deliberately jax-free: the router is pure control plane
+and its tests run in the core lane.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from tpu_dra.trace import get_tracer
+from tpu_dra.trace.span import current_traceparent
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import Registry, negotiate_exposition
+
+# typed router-origin shed reasons (the replica-origin reasons pass
+# through verbatim — admission.SHED_REASONS)
+REASON_NO_REPLICA = "no_replica"
+
+ROLE_ANY = "any"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+
+STATE_HEALTHY = "healthy"
+STATE_EJECTED = "ejected"
+STATE_DRAINING = "draining"
+
+# headers the router forwards replica-ward so one trace id and one
+# deadline span router -> replica -> engine
+_FORWARD_HEADERS = ("X-Tenant", "X-Deadline-Ms", "Content-Type")
+
+# the replica endpoint surface — request paths outside this set still
+# proxy (the replica answers 404) but collapse into one "other" metric
+# label so client-chosen paths cannot grow series without bound
+_KNOWN_PATHS = frozenset((
+    "/generate", "/stream", "/beam", "/speculative", "/prefix",
+    "/prefill", "/decode_handoff"))
+
+# score weights (lower score = better target).  Backlog dominates —
+# queued work is latency already committed; occupancy and KV pressure
+# are leading indicators; sheds and availability burn are trailing
+# proof the replica is refusing work.
+_W_BACKLOG = 1.0
+_W_OCCUPANCY = 0.5
+_W_KV_PRESSURE = 0.25
+_W_ADMISSION = 0.5
+_W_SHED = 2.0
+_W_BURN = 0.5
+# advisory in-flight pressure added per outstanding router-side request
+# during the decision — spreads simultaneous arrivals between probes
+_W_INFLIGHT = 0.05
+
+
+class PooledClient:
+    """Keep-alive HTTP/1.1 connection pool for ONE replica.
+
+    Every connection carries an explicit timeout (the deadline-hygiene
+    contract: a wedged replica turns into a recorded timeout, never a
+    parked router thread), and a request that fails on a REUSED
+    connection retries once on a fresh one — a keep-alive socket the
+    replica closed between requests is indistinguishable from a dead
+    replica until one write fails.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 timeout_s: float = 30.0, pool_size: int = 8) -> None:
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.pool_size = pool_size
+        self._mu = threading.Lock()
+        self._idle: list[http.client.HTTPConnection] = []  # guarded by _mu
+
+    def _get_conn(self) -> tuple[http.client.HTTPConnection, bool]:
+        with self._mu:
+            if self._idle:
+                return self._idle.pop(), True
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s), False
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._mu:
+            if len(self._idle) < self.pool_size:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None,
+                headers: Optional[dict] = None,
+                stream: bool = False):
+        """-> ``(status, headers, body_bytes)`` — or, with
+        ``stream=True``, ``(status, headers, response, done)`` where
+        ``response`` is the live :class:`http.client.HTTPResponse` and
+        ``done()`` returns the connection to the pool (call it after
+        draining the response)."""
+        attempt = 0
+        while True:
+            conn, reused = self._get_conn()
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                if reused and attempt == 0:
+                    # stale keep-alive socket: retry once, fresh
+                    attempt += 1
+                    continue
+                raise
+            if stream:
+                def done(c=conn, r=resp):
+                    if r.will_close:
+                        c.close()
+                    else:
+                        self._put_conn(c)
+                return resp.status, dict(resp.getheaders()), resp, done
+            data = resp.read()
+            if resp.will_close:
+                conn.close()
+            else:
+                self._put_conn(conn)
+            return resp.status, dict(resp.getheaders()), data
+
+    def close(self) -> None:
+        with self._mu:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
+
+
+@dataclass
+class Replica:
+    """One serving replica as the router sees it."""
+
+    name: str
+    url: str                       # http://host:port
+    role: str = ROLE_ANY
+    claim_uid: str = ""            # prepared-claim introspection key
+    weight: float = 1.0            # capacity (chips in the claim)
+    source: str = "static"         # static | fleet-file
+
+    client: Optional[PooledClient] = None
+    probe_client: Optional[PooledClient] = None
+    # mutable state — written by the prober under Router._mu; the
+    # decision path reads score/inflight lock-free (a stale read
+    # misroutes one request by one probe interval, never corrupts)
+    state: str = STATE_HEALTHY
+    eject_reason: str = ""
+    fails: int = 0
+    score: float = 0.0
+    inflight: int = 0
+    signals: dict = field(default_factory=dict)
+    _last_shed_total: float = 0.0
+    _shed_rate: float = 0.0
+
+    def routable(self) -> bool:
+        return self.state == STATE_HEALTHY
+
+    def base(self) -> tuple[str, int]:
+        rest = self.url.split("//", 1)[-1]
+        host, _, port = rest.partition(":")
+        return host, int(port or 80)
+
+
+def parse_replica_flag(value: str) -> Replica:
+    """``name=url[;role=ROLE][;claim=UID][;weight=W]`` — the static
+    discovery source."""
+    name, _, rest = value.partition("=")
+    if not name or not rest:
+        raise ValueError(f"--replica must be name=url[;role=...], got "
+                         f"{value!r}")
+    parts = rest.split(";")
+    rep = Replica(name=name, url=parts[0].rstrip("/"))
+    for part in parts[1:]:
+        k, _, v = part.partition("=")
+        if k == "role":
+            rep.role = v
+        elif k == "claim":
+            rep.claim_uid = v
+        elif k == "weight":
+            rep.weight = float(v)
+        else:
+            raise ValueError(f"unknown replica attribute {k!r} in "
+                             f"{value!r}")
+    if rep.role not in (ROLE_ANY, ROLE_PREFILL, ROLE_DECODE):
+        raise ValueError(f"replica role must be any|prefill|decode, "
+                         f"got {rep.role!r}")
+    return rep
+
+
+def replica_score(overload: dict, slo: Optional[dict],
+                  shed_rate: float, weight: float = 1.0) -> float:
+    """Fold one replica's probe payloads into a single load score
+    (lower = better).  Pure — benched and unit-tested standalone."""
+    eng = overload.get("engine") or {}
+    slots = eng.get("slots") or 0
+    queued = eng.get("queued") or 0
+    backlog = queued / max(1.0, float(slots))
+    occupancy = eng.get("batch_occupancy") or 0.0
+    kv_total = eng.get("kv_pages_total") or 0
+    kv_pressure = (1.0 - (eng.get("kv_pages_free") or 0) / kv_total) \
+        if kv_total else 0.0
+    adm = overload.get("admission") or {}
+    adm_frac = 0.0
+    if adm.get("max_cost"):
+        adm_frac = (adm.get("outstanding_cost") or 0) / adm["max_cost"]
+    burn = 0.0
+    if slo:
+        avail = (slo.get("objectives") or {}).get("availability") or {}
+        for win in (avail.get("windows") or {}).values():
+            burn = max(burn, win.get("burn_rate") or 0.0)
+    raw = (_W_BACKLOG * backlog + _W_OCCUPANCY * occupancy
+           + _W_KV_PRESSURE * kv_pressure + _W_ADMISSION * adm_frac
+           + _W_SHED * min(shed_rate, 5.0) + _W_BURN * min(burn, 10.0))
+    return raw / max(weight, 1e-6)
+
+
+def route_decision(view: tuple, sticky: Optional[Replica]) -> \
+        Optional[Replica]:
+    """The per-request decision over a published snapshot: affinity
+    first, else the lowest (score + in-flight pressure).  Pure and
+    lock-free — ``bench_prepare.py``'s ``bench_router_decision``
+    ratchets it (``router_decision_us``), so this function must stay a
+    plain scan: no allocation, no sorting, no I/O."""
+    if sticky is not None and sticky.state == STATE_HEALTHY:
+        return sticky
+    best = None
+    best_key = 0.0
+    for rep in view:
+        key = rep.score + _W_INFLIGHT * rep.inflight
+        if best is None or key < best_key:
+            best, best_key = rep, key
+    return best
+
+
+def _parse_prepared_claims(path: str) -> Optional[dict[str, int]]:
+    """Prepared claim uid -> device count from the kubelet plugin's
+    checkpoint file (checksum envelope tolerated).  None = unreadable
+    (treat as "no information", never as "everything vanished")."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    data = payload.get("data")
+    if isinstance(data, str):
+        try:
+            payload = json.loads(data)
+        except json.JSONDecodeError:
+            return None
+    claims = payload.get("preparedClaims")
+    if not isinstance(claims, dict):
+        return None
+    return {uid: len((rec or {}).get("devices") or ())
+            for uid, rec in claims.items()}
+
+
+class RouterMetrics:
+    """The ``tpu_router_*`` namespace (docs/observability.md).  Private
+    registry, same discipline as ServeMetrics — the router is a
+    workload-side binary, not part of the driver fleet's
+    ``tpu_dra_*`` surface."""
+
+    def __init__(self) -> None:
+        self.registry = Registry()
+        reg = self.registry
+        self.requests = reg.counter(
+            "tpu_router_requests_total",
+            "client requests through the router", ("path", "code"))
+        self.replica_requests = reg.counter(
+            "tpu_router_replica_requests_total",
+            "requests proxied per replica", ("replica", "code"))
+        self.latency = reg.histogram(
+            "tpu_router_request_seconds",
+            "router-side request wall time",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+                     5, 10, 30, 60, 120, 300, 600),
+            labels=("path",))
+        self.decision = reg.histogram(
+            "tpu_router_decision_seconds",
+            "per-request routing decision time (scoring + affinity)",
+            buckets=(1e-6, 2e-6, 5e-6, 1e-5, 2e-5, 5e-5, 1e-4, 1e-3))
+        self.routable = reg.gauge(
+            "tpu_router_replica_routable",
+            "1 while the replica receives traffic, else 0", ("replica",))
+        self.score = reg.gauge(
+            "tpu_router_replica_score",
+            "the replica's current load score (lower = preferred)",
+            ("replica",))
+        self.ejections = reg.counter(
+            "tpu_router_ejections_total",
+            "replicas removed from rotation, by reason",
+            ("replica", "reason"))
+        self.readmissions = reg.counter(
+            "tpu_router_readmissions_total",
+            "replicas returned to rotation after a healthy probe",
+            ("replica",))
+        self.retries = reg.counter(
+            "tpu_router_retries_total",
+            "requests re-routed to another replica, by cause",
+            ("reason",))
+        self.shed = reg.counter(
+            "tpu_router_shed_total",
+            "router-origin 503s plus replica sheds passed through, by "
+            "typed reason", ("reason",))
+        self.affinity = reg.gauge(
+            "tpu_router_affinity_sessions",
+            "sessions currently pinned to a replica")
+        self.handoffs = reg.counter(
+            "tpu_router_handoffs_total",
+            "disaggregated prefill->decode handoffs, by result",
+            ("result",))
+
+
+class Router:
+    """Replica registry + prober + decision engine (the HTTP front-end
+    is :func:`make_router_handler`; :func:`serve_router` binds both)."""
+
+    def __init__(self, *, probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 request_timeout_s: float = 630.0,
+                 eject_after: int = 1,
+                 retries: int = 2,
+                 affinity_max: int = 4096,
+                 session_header: str = "X-Session-Id",
+                 fleet_file: str = "",
+                 claims_checkpoint: str = "",
+                 disaggregate: bool = False) -> None:
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.eject_after = max(1, eject_after)
+        self.retries = retries
+        self.session_header = session_header
+        self.fleet_file = fleet_file
+        self.claims_checkpoint = claims_checkpoint
+        self.disaggregate = disaggregate
+        self.metrics = RouterMetrics()
+        self._mu = threading.Lock()
+        self._replicas: dict[str, Replica] = {}      # guarded by _mu
+        self._affinity: OrderedDict[str, str] = OrderedDict()
+        self._affinity_max = affinity_max
+        self._fleet_mtime = 0.0
+        # published snapshots — rebuilt under _mu, read lock-free by
+        # the decision path (tuple swap is atomic)
+        self._view_decode: tuple = ()
+        self._view_prefill: tuple = ()
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+
+    # -- discovery ---------------------------------------------------------
+
+    def add_replica(self, rep: Replica) -> None:
+        host, port = rep.base()
+        rep.client = PooledClient(host, port,
+                                  timeout_s=self.request_timeout_s)
+        # persistent probe client (pool of 1): the prober reuses one
+        # keep-alive socket per replica instead of a connect/teardown
+        # pair every interval forever
+        rep.probe_client = PooledClient(host, port,
+                                        timeout_s=self.probe_timeout_s,
+                                        pool_size=1)
+        with self._mu:
+            old = self._replicas.get(rep.name)
+            self._replicas[rep.name] = rep
+            self._publish_locked()
+        self._close_clients(old)   # a replaced replica's pooled
+        klog.info("router: replica added", name=rep.name, url=rep.url,
+                  role=rep.role, source=rep.source)
+
+    @staticmethod
+    def _close_clients(rep: Optional[Replica]) -> None:
+        """Release a displaced/removed replica's pooled sockets — a
+        replace cycle (same name, new port) must not leak the old
+        keep-alive connections in the long-lived router process."""
+        if rep is None:
+            return
+        for client in (rep.client, getattr(rep, "probe_client", None)):
+            if client is not None:
+                client.close()
+
+    def remove_replica(self, name: str) -> None:
+        with self._mu:
+            rep = self._replicas.pop(name, None)
+            self._publish_locked()
+        if rep is not None:
+            self._close_clients(rep)
+            klog.info("router: replica removed", name=name)
+
+    def _load_fleet_file(self) -> None:
+        """Sync the replica set with the autoscaler-maintained fleet
+        file (mtime-gated).  Static replicas are never file-managed."""
+        if not self.fleet_file:
+            return
+        try:
+            mtime = os.stat(self.fleet_file).st_mtime
+        except OSError:
+            return
+        if mtime == self._fleet_mtime:
+            return
+        try:
+            with open(self.fleet_file) as f:
+                entries = json.load(f).get("replicas") or []
+        except (OSError, json.JSONDecodeError) as exc:
+            klog.warning("router: fleet file unreadable",
+                         path=self.fleet_file, err=str(exc)[:120])
+            return
+        self._fleet_mtime = mtime
+        seen = set()
+        for ent in entries:
+            name = ent.get("name")
+            url = (ent.get("url") or "").rstrip("/")
+            if not name or not url:
+                continue
+            seen.add(name)
+            with self._mu:
+                cur = self._replicas.get(name)
+                fresh = cur is None or cur.url != url
+            if fresh:
+                self.add_replica(Replica(
+                    name=name, url=url,
+                    role=ent.get("role", ROLE_ANY),
+                    claim_uid=ent.get("claim_uid", ""),
+                    weight=float(ent.get("weight", 1.0)),
+                    source="fleet-file"))
+        with self._mu:
+            gone = [n for n, r in self._replicas.items()
+                    if r.source == "fleet-file" and n not in seen]
+        for name in gone:
+            self.remove_replica(name)
+
+    # -- probing / health --------------------------------------------------
+
+    def start(self) -> "Router":
+        self._load_fleet_file()
+        self._probe_all()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="router-prober")
+        self._probe_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10)
+        with self._mu:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            self._close_clients(rep)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self._load_fleet_file()
+                self._probe_all()
+            except Exception as exc:  # noqa: BLE001 — prober must survive
+                klog.error("router: probe pass failed",
+                           err=repr(exc)[:200])
+
+    def _probe_all(self) -> None:
+        claims = _parse_prepared_claims(self.claims_checkpoint) \
+            if self.claims_checkpoint else None
+        with self._mu:
+            reps = list(self._replicas.values())
+        threads = [threading.Thread(target=self._probe_one,
+                                    args=(rep, claims), daemon=True)
+                   for rep in reps]
+        for t in threads:
+            t.start()
+        for t in threads:
+            # bounded by the probe client timeout; the join slack only
+            # guards against scheduler weather
+            t.join(timeout=self.probe_timeout_s + 2.0)
+        with self._mu:
+            self._publish_locked()
+
+    def _probe_one(self, rep: Replica, claims: Optional[dict]) -> None:
+        """Refresh one replica's signals/score/state.  HTTP strictly
+        outside the lock; the state fold happens under ``_mu``."""
+        if claims is not None and rep.claim_uid and \
+                rep.claim_uid not in claims:
+            with self._mu:
+                self._eject_locked(rep, "claim_gone")
+            return
+        probe = rep.probe_client
+        if probe is None:                       # replicas registered
+            probe = PooledClient(                # outside add_replica
+                *rep.base(), timeout_s=self.probe_timeout_s,
+                pool_size=1)
+            rep.probe_client = probe
+        overload = slo = None
+        err = ""
+        try:
+            status, _, body = probe.request("GET", "/debug/overload")
+            if status == 200:
+                overload = json.loads(body)
+                s2, _, body2 = probe.request("GET", "/debug/slo")
+                if s2 == 200:
+                    slo = json.loads(body2)
+            else:
+                err = f"HTTP {status} from /debug/overload"
+        except (http.client.HTTPException, OSError,
+                json.JSONDecodeError) as exc:
+            err = repr(exc)[:120]
+        now = time.monotonic()
+        with self._mu:
+            if overload is None:
+                rep.fails += 1
+                if rep.fails >= self.eject_after:
+                    self._eject_locked(rep, f"probe: {err}")
+                return
+            rep.fails = 0
+            if claims is not None and rep.claim_uid:
+                rep.weight = max(1.0, float(claims.get(rep.claim_uid,
+                                                       rep.weight)))
+            shed_total = 0.0
+            adm = overload.get("admission") or {}
+            for n in (adm.get("shed_total") or {}).values():
+                shed_total += n
+            dt = max(self.probe_interval_s, 1e-3)
+            rate = max(0.0, shed_total - rep._last_shed_total) / dt
+            rep._last_shed_total = shed_total
+            rep._shed_rate = rate
+            burn = 0.0
+            if slo:
+                avail = (slo.get("objectives") or {}).get(
+                    "availability") or {}
+                for win in (avail.get("windows") or {}).values():
+                    burn = max(burn, win.get("burn_rate") or 0.0)
+            rep.signals = {"overload": overload, "burn_rate": burn,
+                           "probed_at": now}
+            rep.score = replica_score(overload, slo, rate, rep.weight)
+            if overload.get("state") == "draining":
+                self._eject_locked(rep, "draining",
+                                   state=STATE_DRAINING)
+            elif rep.state != STATE_HEALTHY:
+                rep.state = STATE_HEALTHY
+                rep.eject_reason = ""
+                self.metrics.readmissions.inc(rep.name)
+                klog.info("router: replica readmitted", name=rep.name)
+
+    def _eject_locked(self, rep: Replica, reason: str,
+                      state: str = STATE_EJECTED) -> None:
+        if rep.state == STATE_HEALTHY:
+            self.metrics.ejections.inc(rep.name, reason.split(":")[0])
+            klog.warning("router: replica ejected", name=rep.name,
+                         reason=reason[:160])
+        rep.state = state
+        rep.eject_reason = reason
+
+    def note_request_failure(self, rep: Replica, reason: str) -> None:
+        """A proxied request hit a transport error or a draining 503:
+        stop routing to the replica NOW (the next probe may readmit)."""
+        with self._mu:
+            self._eject_locked(
+                rep, reason,
+                state=STATE_DRAINING if reason == "draining"
+                else STATE_EJECTED)
+            self._publish_locked()
+
+    def _publish_locked(self) -> None:
+        decode, prefill = [], []
+        for rep in self._replicas.values():
+            routable = rep.routable()
+            self.metrics.routable.set(1.0 if routable else 0.0,
+                                      rep.name)
+            self.metrics.score.set(rep.score, rep.name)
+            if not routable:
+                continue
+            if rep.role in (ROLE_ANY, ROLE_DECODE):
+                decode.append(rep)
+            if rep.role in (ROLE_ANY, ROLE_PREFILL):
+                prefill.append(rep)
+        self._view_decode = tuple(decode)
+        # disaggregation uses DEDICATED prefill replicas when any
+        # exist (that is the point of the split pools); "any" replicas
+        # only back-fill an all-dedicated pool's total outage
+        dedicated = tuple(r for r in prefill if r.role == ROLE_PREFILL)
+        self._view_prefill = dedicated or tuple(prefill)
+
+    # -- the decision (benched) -------------------------------------------
+
+    def decide(self, session: Optional[str] = None,
+               role: str = ROLE_DECODE) -> Optional[Replica]:
+        """Pick the target replica: affinity lookup + snapshot scan.
+        This is the benched hot path (``router_decision_us``)."""
+        view = self._view_prefill if role == ROLE_PREFILL \
+            else self._view_decode
+        sticky = None
+        if session:
+            with self._mu:
+                name = self._affinity.get(session)
+                if name is not None:
+                    self._affinity.move_to_end(session)
+                    sticky = self._replicas.get(name)
+        rep = route_decision(view, sticky)
+        if session and rep is not None and rep is not sticky:
+            with self._mu:
+                self._affinity[session] = rep.name
+                self._affinity.move_to_end(session)
+                while len(self._affinity) > self._affinity_max:
+                    self._affinity.popitem(last=False)
+        return rep
+
+    def begin_request(self, rep: Replica) -> None:
+        with self._mu:
+            rep.inflight += 1
+
+    def end_request(self, rep: Replica) -> None:
+        with self._mu:
+            rep.inflight = max(0, rep.inflight - 1)
+
+    # -- observability -----------------------------------------------------
+
+    def fleet_snapshot(self) -> dict:
+        """The /debug/fleet payload — also the autoscaler's input."""
+        with self._mu:
+            reps = list(self._replicas.values())
+            affinity = len(self._affinity)
+        self.metrics.affinity.set(float(affinity))
+        out = []
+        routable = 0
+        occ_sum, queued_sum, shed_sum, burn_max = 0.0, 0, 0.0, 0.0
+        for rep in reps:
+            eng = (rep.signals.get("overload") or {}).get("engine") or {}
+            if rep.routable():
+                routable += 1
+                occ_sum += eng.get("batch_occupancy") or 0.0
+                queued_sum += eng.get("queued") or 0
+                shed_sum += rep._shed_rate
+                burn_max = max(burn_max,
+                               rep.signals.get("burn_rate") or 0.0)
+            out.append({
+                "name": rep.name, "url": rep.url, "role": rep.role,
+                "state": rep.state, "reason": rep.eject_reason,
+                "score": round(rep.score, 4), "weight": rep.weight,
+                "inflight": rep.inflight, "claim_uid": rep.claim_uid,
+                "source": rep.source,
+                "queued": eng.get("queued"),
+                "batch_occupancy": eng.get("batch_occupancy"),
+                "shed_rate": round(rep._shed_rate, 3),
+            })
+        return {
+            "replicas": out,
+            "routable": routable,
+            "affinity_sessions": affinity,
+            "disaggregate": self.disaggregate,
+            "aggregate": {
+                "mean_occupancy": round(occ_sum / routable, 4)
+                if routable else 0.0,
+                "queued": queued_sum,
+                "shed_rate": round(shed_sum, 3),
+                "burn_rate": round(burn_max, 4),
+            },
+        }
+
+
+# --------------------------------------------------------------------------
+# HTTP front-end
+# --------------------------------------------------------------------------
+
+
+def _shed_body(reason: str, retry_after_s: int, detail: str) -> \
+        tuple[bytes, dict]:
+    return (json.dumps({"error": detail[:300], "reason": reason,
+                        "retry_after_s": retry_after_s}).encode(),
+            {"Retry-After": str(retry_after_s)})
+
+
+def make_router_handler(router: Router):
+    metrics = router.metrics
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):              # quiet by default
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json", headers=None):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _forward_headers(self) -> dict:
+            headers = {}
+            for name in _FORWARD_HEADERS:
+                val = self.headers.get(name)
+                if val is not None:
+                    headers[name] = val
+            sess = self.headers.get(router.session_header)
+            if sess:
+                headers[router.session_header] = sess
+            tp = current_traceparent()
+            if tp:
+                # ONE trace id spans router -> replica -> engine
+                headers["traceparent"] = tp
+            headers.setdefault("Content-Type", "application/json")
+            return headers
+
+        def _path_label(self) -> str:
+            """Bound the client-chosen path into a fixed label set —
+            an anonymous client cycling request paths must not mint
+            unbounded tpu_router_* series (the X-Tenant cardinality
+            discipline, applied to paths)."""
+            return self.path if self.path in _KNOWN_PATHS else "other"
+
+        def _observe(self, code: int, t0: float,
+                     replica: Optional[Replica] = None) -> None:
+            path = self._path_label()
+            metrics.requests.inc(path, str(code))
+            metrics.latency.observe(time.perf_counter() - t0, path)
+            if replica is not None:
+                metrics.replica_requests.inc(replica.name, str(code))
+
+        def _no_replica(self, t0: float, what: str = "") -> None:
+            metrics.shed.inc(REASON_NO_REPLICA)
+            retry = max(1, int(router.probe_interval_s * 2))
+            body, headers = _shed_body(
+                REASON_NO_REPLICA, retry,
+                f"no routable {what or 'replica'} (fleet draining or "
+                f"unhealthy); retry shortly")
+            self._observe(503, t0)
+            self._send(503, body, headers=headers)
+
+        def _decide(self, session, role=ROLE_DECODE,
+                    exclude=()) -> Optional[Replica]:
+            t0 = time.perf_counter()
+            rep = router.decide(session, role)
+            if rep is not None and rep in exclude:
+                # the decision is affinity/score-driven; after a
+                # failure we need ANY other replica
+                view = [r for r in (router._view_prefill
+                                    if role == ROLE_PREFILL
+                                    else router._view_decode)
+                        if r not in exclude]
+                rep = route_decision(tuple(view), None)
+            metrics.decision.observe(time.perf_counter() - t0)
+            return rep
+
+        def _proxy(self, path: str, body: bytes, *,
+                   session: Optional[str], t0: float) -> None:
+            """Plain JSON proxy with health-aware retries and typed 503
+            passthrough."""
+            headers = self._forward_headers()
+            # FAILOVER, not retry: each attempt goes to a DIFFERENT
+            # replica (the failed one is ejected and excluded), so
+            # there is deliberately no backoff — the capacity-shed
+            # path below never re-sends at all
+            tried: list[Replica] = []
+            rep = self._decide(session)
+            while rep is not None and len(tried) <= router.retries:
+                cur = rep
+                tried.append(cur)
+                router.begin_request(cur)
+                try:
+                    status, rhdrs, data = cur.client.request(
+                        "POST", path, body=body, headers=headers)
+                except (http.client.HTTPException, OSError) as exc:
+                    router.note_request_failure(cur, "transport")
+                    metrics.retries.inc("transport")
+                    klog.warning("router: replica request failed",
+                                 replica=cur.name, err=repr(exc)[:120])
+                    rep = self._decide(session, exclude=tuple(tried))
+                    continue
+                finally:
+                    router.end_request(cur)
+                if status == 503:
+                    reason = ""
+                    try:
+                        reason = json.loads(data).get("reason", "")
+                    except (json.JSONDecodeError, AttributeError):
+                        pass
+                    if reason == "draining":
+                        # rolling restart: the work never started —
+                        # re-route instead of bouncing the client
+                        router.note_request_failure(cur, "draining")
+                        metrics.retries.inc("draining")
+                        rep = self._decide(session,
+                                           exclude=tuple(tried))
+                        continue
+                    # capacity shed: pass through verbatim, honoring
+                    # the replica's Retry-After — the router must not
+                    # convert an honest backpressure signal into a
+                    # retry storm
+                    metrics.shed.inc(reason or "unknown")
+                    out_headers = {}
+                    ra = rhdrs.get("Retry-After")
+                    if ra is not None:
+                        out_headers["Retry-After"] = ra
+                    self._observe(503, t0, cur)
+                    self._send(503, data, headers=out_headers)
+                    return
+                self._observe(status, t0, cur)
+                self._send(status, data,
+                           rhdrs.get("Content-Type",
+                                     "application/json"))
+                return
+            self._no_replica(t0)
+
+        def _hop_with_failover(self, role: str, path: str,
+                               payload: dict, session, headers):
+            """One disaggregation hop with the SAME failover contract
+            as _proxy: draining 503s and transport errors fail over to
+            another replica and eject the source; capacity sheds pass
+            through.  Returns ``("ok", parsed)`` or
+            ``("error", status, body_bytes, out_headers)``."""
+            body = json.dumps(payload).encode()
+            tried: list[Replica] = []
+            rep = self._decide(session, role=role)
+            while rep is not None and len(tried) <= router.retries:
+                cur = rep
+                tried.append(cur)
+                router.begin_request(cur)
+                try:
+                    status, rhdrs, data = cur.client.request(
+                        "POST", path, body=body, headers=headers)
+                except (http.client.HTTPException, OSError) as exc:
+                    router.note_request_failure(cur, "transport")
+                    metrics.retries.inc("transport")
+                    klog.warning("router: handoff hop failed",
+                                 replica=cur.name, path=path,
+                                 err=repr(exc)[:120])
+                    rep = self._decide(session, role=role,
+                                       exclude=tuple(tried))
+                    continue
+                finally:
+                    router.end_request(cur)
+                if status == 503:
+                    reason = ""
+                    try:
+                        reason = json.loads(data).get("reason", "")
+                    except (json.JSONDecodeError, AttributeError):
+                        pass
+                    if reason == "draining":
+                        router.note_request_failure(cur, "draining")
+                        metrics.retries.inc("draining")
+                        rep = self._decide(session, role=role,
+                                           exclude=tuple(tried))
+                        continue
+                if status != 200:
+                    metrics.handoffs.inc(
+                        "prefill_error" if path == "/prefill"
+                        else "decode_error")
+                    out_headers = {}
+                    ra = rhdrs.get("Retry-After")
+                    if ra is not None:
+                        out_headers["Retry-After"] = ra
+                    return ("error", status, data, out_headers)
+                return ("ok", json.loads(data))
+            metrics.handoffs.inc("transport_error")
+            return ("error", 503, *_shed_body(
+                REASON_NO_REPLICA,
+                max(1, int(router.probe_interval_s * 2)),
+                f"no routable replica for {path}"))
+
+        def _handoff_row(self, row, req: dict, session, headers):
+            """One row's prefill -> decode chain; same return shape as
+            :meth:`_hop_with_failover` (``("ok", tokens)`` on
+            success)."""
+            out = self._hop_with_failover(
+                ROLE_PREFILL, "/prefill", {"tokens": row}, None,
+                headers)
+            if out[0] != "ok":
+                return out
+            payload = dict(req)
+            payload.pop("tokens", None)
+            payload["blob"] = out[1]["blob"]
+            payload["prompt_len"] = out[1]["length"]
+            out = self._hop_with_failover(
+                ROLE_DECODE, "/decode_handoff", payload, session,
+                headers)
+            if out[0] != "ok":
+                return out
+            metrics.handoffs.inc("ok")
+            return ("ok", out[1]["tokens"][0])
+
+        def _disagg_generate(self, req: dict, *, session, t0) -> None:
+            """Disaggregated /generate: prefill-pool ``/prefill`` ->
+            blob -> decode-pool ``/decode_handoff`` per row.  Output is
+            byte-identical to a single engine's (kv_handoff contract).
+            Rows fan out concurrently, mirroring the single-engine
+            handler's submit_async row fan-in — a 4-row request must
+            not pay 4 serial prefill+decode chains."""
+            headers = self._forward_headers()
+            rows = req.get("tokens")
+            if not isinstance(rows, list) or not rows:
+                self._observe(400, t0)
+                self._send(400, json.dumps(
+                    {"error": "tokens must be a non-empty list of "
+                              "rows"}).encode())
+                return
+            if len(rows) == 1:
+                results = [self._handoff_row(rows[0], req, session,
+                                             headers)]
+            else:
+                results = [None] * len(rows)
+
+                def run(i, row):
+                    results[i] = self._handoff_row(row, req, session,
+                                                   headers)
+                workers = [threading.Thread(target=run, args=(i, row),
+                                            daemon=True)
+                           for i, row in enumerate(rows)]
+                for t in workers:
+                    t.start()
+                for t in workers:
+                    t.join()
+            for out in results:
+                if out is None or out[0] != "ok":
+                    # the first failing row answers for the request
+                    # (other rows' chip work is already spent — same
+                    # as a single engine failing one row of a batch)
+                    if out is None:
+                        self._observe(500, t0)
+                        self._send(500, json.dumps(
+                            {"error": "handoff row failed"}).encode())
+                    else:
+                        _, status, data, out_headers = out
+                        self._observe(status, t0)
+                        self._send(status, data, headers=out_headers)
+                    return
+            self._observe(200, t0)
+            self._send(200, json.dumps(
+                {"tokens": [out[1] for out in results]}).encode())
+
+        def _stream_proxy(self, body: bytes, *, session,
+                          t0: float) -> None:
+            """/stream passthrough: the replica's chunked NDJSON is
+            re-chunked to the client as it arrives (affinity applies —
+            a stream lives on one engine's KV)."""
+            headers = self._forward_headers()
+            rep = self._decide(session)
+            if rep is None:
+                self._no_replica(t0)
+                return
+            router.begin_request(rep)
+            done = None
+            try:
+                status, rhdrs, resp, done = rep.client.request(
+                    "POST", "/stream", body=body, headers=headers,
+                    stream=True)
+                if status != 200:
+                    data = resp.read()
+                    out_headers = {}
+                    ra = rhdrs.get("Retry-After")
+                    if ra is not None:
+                        out_headers["Retry-After"] = ra
+                    self._observe(status, t0, rep)
+                    self._send(status, data, headers=out_headers)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 rhdrs.get("Content-Type",
+                                           "application/x-ndjson"))
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    data = resp.read1(65536)
+                    if not data:
+                        break
+                    try:
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode())
+                        self.wfile.write(data + b"\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        break               # client went away
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+                self._observe(200, t0, rep)
+            except (http.client.HTTPException, OSError) as exc:
+                router.note_request_failure(rep, "transport")
+                self._observe(502, t0, rep)
+                try:
+                    self._send(502, json.dumps(
+                        {"error": repr(exc)[:160]}).encode())
+                except OSError:
+                    pass
+            finally:
+                router.end_request(rep)
+                if done is not None:
+                    done()
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                snap_ok = bool(router._view_decode)
+                self._send(200 if snap_ok else 503,
+                           b"ok" if snap_ok
+                           else b"no routable replicas", "text/plain")
+            elif self.path == "/metrics":
+                text, ctype = negotiate_exposition(
+                    self.headers.get("Accept", ""), metrics.registry)
+                self._send(200, text.encode(), ctype)
+            elif self.path == "/debug/fleet":
+                self._send(200, json.dumps(
+                    router.fleet_snapshot()).encode())
+            else:
+                self._send(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            t0 = time.perf_counter()
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                n = 0
+                self.close_connection = True
+            body = self.rfile.read(n) if n > 0 else b""
+            session = self.headers.get(router.session_header)
+            tenant = self.headers.get("X-Tenant", "default")
+            with get_tracer().start_span(
+                    "router.request",
+                    parent=self.headers.get("traceparent"),
+                    attributes={"path": self.path, "tenant": tenant}):
+                if self.path == "/stream":
+                    self._stream_proxy(body, session=session, t0=t0)
+                    return
+                if self.path == "/generate" and router.disaggregate \
+                        and router._view_prefill:
+                    try:
+                        req = json.loads(body)
+                    except json.JSONDecodeError as exc:
+                        self._observe(400, t0)
+                        self._send(400, json.dumps(
+                            {"error": str(exc)[:200]}).encode())
+                        return
+                    if "prefix_id" not in req:
+                        self._disagg_generate(req, session=session,
+                                              t0=t0)
+                        return
+                    # prefix contexts live on one replica's KV —
+                    # affinity-proxy instead of disaggregating
+                self._proxy(self.path, body, session=session, t0=t0)
+
+    return Handler
+
+
+def serve_router(router: Router, host: str = "127.0.0.1",
+                 port: int = 0) -> ThreadingHTTPServer:
+    """Bind the front-end and start the prober; returns the live server
+    (``.shutdown()`` stops it, ``.router`` reaches the registry)."""
+    srv = ThreadingHTTPServer((host, port), make_router_handler(router))
+    srv.router = router
+    router.start()
+    orig_shutdown = srv.shutdown
+
+    def shutdown():
+        orig_shutdown()
+        router.stop()
+    srv.shutdown = shutdown
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+# --------------------------------------------------------------------------
+# Autoscaler: burn-rate + shed signals -> prepare/unprepare
+# --------------------------------------------------------------------------
+
+
+class Autoscaler:
+    """Converts fleet signals into replica lifecycle actions through a
+    pluggable launcher — whose production implementation speaks the
+    REAL DRA claim path (plugin gRPC NodePrepare/UnprepareResources;
+    hack/drive_fleet.py).
+
+    ``launcher`` duck-type::
+
+        prepare() -> replica name        # claim + spawn + register
+        drain(name) -> bool              # graceful: SIGTERM / HTTP drain
+        unprepare(name) -> None          # release the claim
+
+    Policy (docs/scaling.md "Cluster serving"):
+
+    - **replace**: routable < target ⇒ prepare (a drained, killed, or
+      ejected replica is replaced through the claim path — the fleet
+      heals to its target without operator action);
+    - **scale up**: sustained shed rate or availability burn over the
+      thresholds ⇒ target += 1 up to ``max_replicas`` (the fleet is
+      refusing work it advertises capacity for);
+    - **scale down**: mean occupancy under ``occupancy_low`` with an
+      empty queue for ``low_evals`` consecutive evaluations ⇒ target
+      -= 1 down to ``min_replicas``, and the victim ALWAYS leaves via
+      graceful drain: ``drain()`` must complete before ``unprepare()``
+      runs — in-flight work finishes, the claim releases after
+      (tests/test_router.py asserts the ordering).
+    """
+
+    def __init__(self, fleet_state: Callable[[], dict], launcher, *,
+                 target_replicas: int, min_replicas: int = 1,
+                 max_replicas: int = 8,
+                 shed_rate_up: float = 0.5, burn_up: float = 1.0,
+                 occupancy_low: float = 0.15, low_evals: int = 3,
+                 interval_s: float = 1.0) -> None:
+        self.fleet_state = fleet_state
+        self.launcher = launcher
+        self.target = target_replicas
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.shed_rate_up = shed_rate_up
+        self.burn_up = burn_up
+        self.occupancy_low = occupancy_low
+        self.low_evals = low_evals
+        self.interval_s = interval_s
+        self._low_streak = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: list[dict] = []    # action audit trail (drives)
+
+    def _record(self, action: str, **kw) -> None:
+        ev = {"action": action, "at": time.monotonic(), **kw}
+        self.events.append(ev)
+        klog.info(f"autoscaler: {action}", **kw)
+
+    def evaluate(self, state: dict) -> list[tuple]:
+        """Pure policy: fleet snapshot -> actions.  One scaling action
+        per evaluation (the fleet settles between moves)."""
+        routable = state.get("routable", 0)
+        agg = state.get("aggregate") or {}
+        shed_rate = agg.get("shed_rate") or 0.0
+        occupancy = agg.get("mean_occupancy") or 0.0
+        queued = agg.get("queued") or 0
+        burn = agg.get("burn_rate") or 0.0
+        if routable < self.target:
+            # heal first: a missing replica is missing capacity NOW
+            self._low_streak = 0
+            return [("prepare", "heal")]
+        if (shed_rate > self.shed_rate_up or burn > self.burn_up) \
+                and self.target < self.max_replicas:
+            self._low_streak = 0
+            self.target += 1
+            return [("prepare", "scale_up")]
+        if occupancy < self.occupancy_low and queued == 0 \
+                and routable > self.min_replicas \
+                and self.target > self.min_replicas:
+            self._low_streak += 1
+            if self._low_streak >= self.low_evals:
+                self._low_streak = 0
+                self.target -= 1
+                victim = self._pick_idle(state)
+                if victim:
+                    return [("drain_down", victim)]
+        else:
+            self._low_streak = 0
+        return []
+
+    @staticmethod
+    def _pick_idle(state: dict) -> Optional[str]:
+        """Scale-down victim: the most idle routable replica."""
+        best, best_key = None, None
+        for rep in state.get("replicas", []):
+            if rep.get("state") != STATE_HEALTHY:
+                continue
+            key = ((rep.get("batch_occupancy") or 0.0),
+                   rep.get("inflight") or 0)
+            if best_key is None or key < best_key:
+                best, best_key = rep.get("name"), key
+        return best
+
+    def tick(self) -> None:
+        try:
+            state = self.fleet_state()
+        except Exception as exc:  # noqa: BLE001 — no state, no action
+            klog.warning("autoscaler: fleet state unavailable",
+                         err=repr(exc)[:160])
+            return
+        for action in self.evaluate(state):
+            kind = action[0]
+            if kind == "prepare":
+                name = self.launcher.prepare()
+                self._record("prepare", reason=action[1], replica=name)
+            elif kind == "drain_down":
+                victim = action[1]
+                # THE ordering contract: drain COMPLETES before the
+                # claim releases — in-flight work is never lost to a
+                # scale-down.  An incomplete drain keeps the claim: the
+                # replica may still be serving on those chips, and a
+                # released claim under live work is exactly the loss
+                # this gate exists to prevent (the victim stays
+                # eligible for the next scale-down evaluation).
+                drained = self.launcher.drain(victim)
+                self._record("drain", replica=victim, complete=drained)
+                if drained:
+                    self.launcher.unprepare(victim)
+                    self._record("unprepare", replica=victim)
+                else:
+                    self.target += 1        # the capacity never left
+                    self._record("drain_failed", replica=victim)
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as exc:  # noqa: BLE001 — must survive
+                klog.error("autoscaler: tick failed",
+                           err=repr(exc)[:200])
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def fleet_state_http(url: str, timeout_s: float = 5.0) -> dict:
+    """Fetch a router's /debug/fleet — the autoscaler's fleet_state
+    when it runs out-of-process (the drive harness shape)."""
+    import urllib.request
+    with urllib.request.urlopen(f"{url}/debug/fleet",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+# --------------------------------------------------------------------------
+# binary
+# --------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    """``python -m tpu_dra.workloads.router --replica a=http://... …``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8476)
+    ap.add_argument("--replica", action="append", default=[],
+                    help="static replica: name=url[;role=any|prefill|"
+                         "decode][;claim=UID][;weight=W] (repeatable)")
+    ap.add_argument("--fleet-file", default="",
+                    help="autoscaler-maintained replica list "
+                         "(JSON {replicas: [{name,url,role,claim_uid,"
+                         "weight}]}); watched by mtime")
+    ap.add_argument("--claims-checkpoint", default="",
+                    help="kubelet plugin checkpoint.json: replicas "
+                         "whose claim_uid is no longer prepared are "
+                         "ejected within one probe interval, and claim "
+                         "device counts become capacity weights")
+    ap.add_argument("--probe-interval", type=float, default=1.0,
+                    help="seconds between replica health/signal probes "
+                         "— also the ejection latency bound")
+    ap.add_argument("--probe-timeout", type=float, default=2.0)
+    ap.add_argument("--request-timeout", type=float, default=630.0,
+                    help="per-proxied-request client timeout; keep "
+                         "above the replica's engine request timeout "
+                         "so the replica's typed error wins")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="re-routes after a transport error or "
+                         "draining 503 (capacity 503s never retry)")
+    ap.add_argument("--session-header", default="X-Session-Id")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="split /generate into prefill-pool /prefill "
+                         "-> decode-pool /decode_handoff when "
+                         "prefill-role replicas exist")
+    from tpu_dra.util.flags import tracing_flags
+    tracing_flags().add_to(ap)
+    args = ap.parse_args(argv)
+
+    from tpu_dra.trace import configure_from_args
+    configure_from_args(args, service="tpu-router")
+    router = Router(probe_interval_s=args.probe_interval,
+                    probe_timeout_s=args.probe_timeout,
+                    request_timeout_s=args.request_timeout,
+                    retries=args.retries,
+                    session_header=args.session_header,
+                    fleet_file=args.fleet_file,
+                    claims_checkpoint=args.claims_checkpoint,
+                    disaggregate=args.disaggregate)
+    for value in args.replica:
+        router.add_replica(parse_replica_flag(value))
+    srv = serve_router(router, args.host, args.port)
+    stop = threading.Event()
+
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    print(f"routing on {srv.server_address}", flush=True)
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,6 +35,17 @@ POST /speculative {"tokens": [[...]], "steps": N, "k": 4,
                  greedy: tokens EXACTLY equal /generate's greedy output;
                  steps/M ≈ tokens committed per serving-model pass.
                  Needs --draft-checkpoint-dir; equal-length rows)
+POST /prefill   (continuous + paged) {"tokens": [...]} — ONE sequence
+             → {"blob": base64, "length": n}: the prompt's KV as a
+                 serialized page blob (kv_handoff.py) plus its
+                 last-position logits, for a DECODE-pool replica to
+                 continue from (disaggregated serving; the router
+                 performs the prefill→decode hop)
+POST /decode_handoff  (continuous + paged) {"blob": base64,
+                 "prompt_len": n, "steps": N, ...sampling knobs}
+             → {"tokens": [[...]]}: import a /prefill blob and decode —
+                 byte-identical to what /generate would have produced
+                 for the original prompt on one engine
 GET  /healthz → 200 "ok" while the engine decode loop is live (and any
              wired chip-health monitor agrees); 503 + reason when the
              batcher died/wedged, so k8s probes restart a wedged server
@@ -327,13 +338,14 @@ class ServeMetrics:
         self._tenant_mu = threading.Lock()
         # tpu_serve_* is the TENANT-side serving namespace on a private
         # registry (the workload's own endpoint, not the driver fleet's
-        # /metrics) — exempt from the driver's tpu_dra_* naming contract
-        self.requests = self.registry.counter(  # vet: ignore[metric-hygiene]
+        # /metrics) — a first-class namespace under the metric-hygiene
+        # workloads carve-out, cataloged in docs/observability.md
+        self.requests = self.registry.counter(
             "tpu_serve_requests_total", "HTTP requests",
             ("path", "code", "tenant"))
-        self.tokens = self.registry.counter(  # vet: ignore[metric-hygiene]
+        self.tokens = self.registry.counter(
             "tpu_serve_generated_tokens_total", "tokens generated")
-        self.latency = self.registry.histogram(  # vet: ignore[metric-hygiene]
+        self.latency = self.registry.histogram(
             "tpu_serve_request_seconds", "request wall time",
             # cold requests include JIT compile (tens of seconds) and the
             # engine timeout is 600s — default buckets top out at 10s and
@@ -341,13 +353,13 @@ class ServeMetrics:
             buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
                      5, 10, 30, 60, 120, 300, 600),
             labels=("path", "tenant"))
-        self.ttft = self.registry.histogram(  # vet: ignore[metric-hygiene]
+        self.ttft = self.registry.histogram(
             "tpu_serve_ttft_seconds",
             "time to first generated token (continuous engine requests)",
             buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
                      5, 10, 30, 60),
             labels=("tenant",))
-        self.itl = self.registry.histogram(  # vet: ignore[metric-hygiene]
+        self.itl = self.registry.histogram(
             "tpu_serve_inter_token_seconds",
             "mean gap between generated tokens, one observation per "
             "continuous-engine request of 2+ tokens",
@@ -360,7 +372,7 @@ class ServeMetrics:
         # burns the availability SLO budget; deadline_expired (504) is
         # the client abandoning the request and is attributed distinctly
         # (tests/test_slo.py)
-        self.shed = self.registry.counter(  # vet: ignore[metric-hygiene]
+        self.shed = self.registry.counter(
             "tpu_serve_shed_total",
             "requests shed instead of served, by typed reason",
             ("reason",))
@@ -455,7 +467,7 @@ class ServeMetrics:
                 self.registry.gauge(name, help_).set(float(value))
         badput = stats.get("badput_slot_s") or {}
         if badput:
-            g = self.registry.gauge(  # vet: ignore[metric-hygiene]
+            g = self.registry.gauge(
                 "tpu_serve_engine_badput_slot_seconds",
                 "cumulative slot residency of aborted requests (chip "
                 "time nobody waited for), by reason", ("reason",))
@@ -466,7 +478,8 @@ class ServeMetrics:
 def make_handler(pool: DecoderPool, engine=None, metrics=None,
                  health=None, health_stale_after: float = 600.0,
                  slo=None, admission=None,
-                 default_deadline_s: float | None = None):
+                 default_deadline_s: float | None = None,
+                 prefill_exporter=None, role: str = "any"):
     """``engine`` (a ContinuousEngine) takes over /generate when given:
     every row becomes its own engine request, fanned in via submit_async
     so one HTTP call's rows still decode concurrently.
@@ -485,7 +498,10 @@ AdmissionController` — every decode endpoint acquires a cost ticket
     before touching the engine, so overload produces a fast typed 503
     with ``Retry-After`` (and drain closes admission) instead of an
     unbounded queue.  ``default_deadline_s``: deadline applied to
-    requests that carry no ``X-Deadline-Ms`` header (None = none)."""
+    requests that carry no ``X-Deadline-Ms`` header (None = none).
+    ``prefill_exporter`` (a kv_handoff.PrefillExporter) arms /prefill;
+    ``role`` is this replica's pool role (any|prefill|decode),
+    advertised on /debug/overload so the router's probe discovers it."""
 
     def _draining_shed(detail: str) -> ShedError:
         retry = int(admission.drain_grace_s) if admission is not None \
@@ -574,6 +590,69 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                 metrics.observe_engine_timing(tenant, h)
             out.append(h.tokens)
         return {"tokens": out}
+
+    def handoff_generate(req, tenant: str = "default",
+                         deadline: float | None = None) -> dict:
+        """POST /decode_handoff: import a /prefill blob and decode —
+        the decode-pool half of disaggregated serving.  The response
+        shape matches /generate's for one row, so the router can splice
+        the two hops into one client-visible /generate."""
+        import base64
+        import binascii
+
+        from tpu_dra.workloads.continuous import DEADLINE_ERROR
+        from tpu_dra.workloads.kv_handoff import decode_blob
+        try:
+            blob = base64.b64decode(req["blob"], validate=True)
+        except (binascii.Error, TypeError) as exc:
+            raise ValueError(f"blob must be base64: {exc}") from None
+        handoff = decode_blob(blob)
+        reject_engine_knobs(req)
+        eos = req.get("eos_id")
+        stop = req.get("stop")
+        if stop is not None:
+            stop = [[int(t) for t in seq] for seq in stop]
+        try:
+            handle = engine.submit_handoff(
+                handoff, int(req.get("steps", 16)),
+                eos_id=None if eos is None else int(eos),
+                temperature=float(req.get("temperature", 0.0)),
+                seed=int(req.get("seed", 0)), stop=stop,
+                deadline=deadline)
+        except RuntimeError as exc:
+            if "draining" in str(exc):
+                raise _draining_shed(str(exc))
+            raise
+        if not handle.done.wait(ENGINE_REQUEST_TIMEOUT_S):
+            engine.cancel(handle)
+            raise RuntimeError(
+                f"request not done within {ENGINE_REQUEST_TIMEOUT_S}s")
+        if handle.error:
+            if handle.error == DEADLINE_ERROR:
+                raise DeadlineExceeded(handle.error)
+            raise RuntimeError(handle.error)
+        if metrics is not None:
+            metrics.observe_engine_timing(tenant, handle)
+        return {"tokens": [handle.tokens]}
+
+    def handoff_cost(req) -> int:
+        """Admission cost of a /decode_handoff request, priced from the
+        BLOB's own header (kv_handoff.peek_prompt_len — a few hundred
+        base64 chars, never the arrays): a client-asserted field could
+        undercharge an arbitrarily large KV import past the admission
+        gate.  ``prompt_len`` is only the fallback when the blob is
+        unparseable (such a request 400s downstream anyway).  Tolerant
+        of garbage — a malformed request should shed or 400, never
+        crash the gate."""
+        from tpu_dra.workloads.kv_handoff import peek_prompt_len
+        try:
+            steps = max(1, int(req.get("steps", 16)))
+            length = peek_prompt_len(req.get("blob") or "")
+            if length is None:
+                length = int(req.get("prompt_len", 0))
+            return max(1, length + steps)
+        except (TypeError, ValueError):
+            return 1
 
     class Handler(BaseHTTPRequestHandler):
         # chunked transfer (the /stream endpoint) is an HTTP/1.1
@@ -679,6 +758,9 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                     # same verdict as /healthz: an engine-only drain
                     # (no --admission-max-cost) is still draining
                     "state": "draining" if draining else "running",
+                    # pool role (any|prefill|decode): how the router's
+                    # probe discovers which pool this replica serves
+                    "role": role,
                     "admission": (admission.snapshot()
                                   if admission is not None else None),
                 }
@@ -958,7 +1040,7 @@ AdmissionController` — every decode endpoint acquires a cost ticket
             return metrics.tenant_label(raw) if metrics is not None \
                 else raw
 
-        def _json_post(self, handle, admit: bool = False):
+        def _json_post(self, handle, admit: bool = False, cost_of=None):
             """Shared /generate + /beam plumbing: parse the JSON body,
             call ``handle(req, tenant, deadline) -> response dict``, map
             bad input to a 400 JSON error.  Every request lands in the
@@ -989,16 +1071,21 @@ AdmissionController` — every decode endpoint acquires a cost ticket
             try:
                 with get_tracer().start_span(
                         "serve.request",
+                        # join the caller's trace (the router forwards
+                        # its traceparent): ONE trace id spans client
+                        # -> router -> replica -> engine
+                        parent=self.headers.get("traceparent"),
                         attributes={"path": self.path, "tenant": tenant}):
                     try:
                         n = int(self.headers.get("Content-Length", 0))
                         req = json.loads(self.rfile.read(n))
                         deadline = self._deadline()
                         if admit and admission is not None:
-                            ticket = admission.acquire(
-                                tenant,
-                                request_cost(req.get("tokens") or [],
-                                             req.get("steps", 16)))
+                            cost = cost_of(req) if cost_of is not None \
+                                else request_cost(
+                                    req.get("tokens") or [],
+                                    req.get("steps", 16))
+                            ticket = admission.acquire(tenant, cost)
                         if deadline is not None and \
                                 time.perf_counter() > deadline:
                             raise DeadlineExceeded(
@@ -1046,9 +1133,11 @@ AdmissionController` — every decode endpoint acquires a cost ticket
             if self.path == "/stream":
                 # span opened out here so every metrics observation the
                 # stream makes (latency, TTFT, ITL) can carry its trace
-                # id as an exemplar
+                # id as an exemplar; parented on the caller's
+                # traceparent (router propagation) like _json_post's
                 with get_tracer().start_span(
                         "serve.request",
+                        parent=self.headers.get("traceparent"),
                         attributes={"path": self.path,
                                     "tenant": self._tenant()}):
                     self._stream()
@@ -1085,6 +1174,41 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                         seed=int(req.get("seed", 0)))
                     return {"tokens": toks, "target_passes": passes}
                 self._json_post(handle, admit=True)
+            elif self.path == "/prefill":
+                if prefill_exporter is None:
+                    self._drain_body()
+                    self._send(400, json.dumps(
+                        {"error": "prefill export needs --continuous "
+                                  "with --kv-layout paged (the page "
+                                  "table makes the KV addressable)"}
+                    ).encode())
+                    return
+
+                def handle(req, tenant, deadline):
+                    import base64
+                    toks = req["tokens"]
+                    if toks and isinstance(toks[0], list):
+                        if len(toks) != 1:
+                            raise ValueError(
+                                "/prefill takes exactly one sequence; "
+                                "the router fans rows")
+                        toks = toks[0]
+                    h = prefill_exporter.export(
+                        [int(t) for t in toks])
+                    from tpu_dra.workloads.kv_handoff import encode
+                    return {"blob": base64.b64encode(
+                        encode(h)).decode(), "length": h.length}
+                self._json_post(handle, admit=True)
+            elif self.path == "/decode_handoff":
+                if engine is None or engine.kv_layout != "paged":
+                    self._drain_body()
+                    self._send(400, json.dumps(
+                        {"error": "KV-handoff decode needs "
+                                  "--continuous with --kv-layout "
+                                  "paged"}).encode())
+                    return
+                self._json_post(handoff_generate, admit=True,
+                                cost_of=handoff_cost)
             elif self.path == "/generate":
                 if engine is not None:
                     self._json_post(engine_generate, admit=True)
@@ -1205,6 +1329,7 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
           admission_burst_fraction: float = 0.7,
           default_deadline_s: float | None = None,
           drain_grace_s: float = 25.0,
+          pool_role: str = "any",
           ) -> ThreadingHTTPServer:
     """Start the server on a daemon thread; returns it (``.shutdown()`` to
     stop).  ``port`` 0 picks a free port (``server.server_address``).
@@ -1287,12 +1412,28 @@ def serve(cfg: ModelConfig, params, *, host: str = "127.0.0.1",
         admission = AdmissionController(
             admission_max_cost, burst_fraction=admission_burst_fraction,
             drain_grace_s=drain_grace_s)
+    if pool_role not in ("any", "prefill", "decode"):
+        raise ValueError(f"pool_role must be any|prefill|decode, got "
+                         f"{pool_role!r}")
+    prefill_exporter = None
+    if engine is not None and engine.kv_layout == "paged":
+        # disaggregation surface (docs/scaling.md "Cluster serving"):
+        # /prefill exports page blobs, /decode_handoff imports them —
+        # armed whenever the KV is paged, whatever the advertised role
+        # (an "any" replica serves both pools)
+        from tpu_dra.workloads.kv_handoff import PrefillExporter
+        prefill_exporter = PrefillExporter(
+            cfg, params, page_size=engine.pool.page_size,
+            max_len=engine.max_len)
     srv = ThreadingHTTPServer((host, port),
                               make_handler(pool, engine, metrics, health,
                                            health_stale_after, slo=slo,
                                            admission=admission,
                                            default_deadline_s=(
-                                               default_deadline_s)))
+                                               default_deadline_s),
+                                           prefill_exporter=(
+                                               prefill_exporter),
+                                           role=pool_role))
     srv.engine = engine               # reachable for stats
     srv.metrics = metrics
     srv.slo = slo
@@ -1439,6 +1580,13 @@ def main(argv=None):
                          "X-Deadline-Ms header; past it the engine "
                          "aborts generation and frees the KV slot "
                          "(504).  Unset = no default deadline")
+    ap.add_argument("--pool-role", default="any",
+                    choices=("any", "prefill", "decode"),
+                    help="disaggregated-serving pool role advertised "
+                         "on /debug/overload: the router sends whole "
+                         "requests to 'any', prefill-only work to "
+                         "'prefill', and KV-handoff decodes to "
+                         "'decode' (docs/scaling.md)")
     ap.add_argument("--drain-grace", type=float, default=25.0,
                     help="SIGTERM drain budget in seconds: admission "
                          "closes and /healthz goes not-ready "
@@ -1601,7 +1749,8 @@ def main(argv=None):
                 default_deadline_s=(
                     None if args.default_deadline_ms is None
                     else args.default_deadline_ms / 1e3),
-                drain_grace_s=args.drain_grace)
+                drain_grace_s=args.drain_grace,
+                pool_role=args.pool_role)
     if args.warmup:
         if srv.engine is None:
             ap.error("--warmup needs --continuous")
