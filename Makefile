@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-fleet drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-fleet drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -119,6 +119,18 @@ drive-serve:
 # zero in-flight losses
 drive-overload:
 	$(PYTHON) hack/drive_overload.py
+
+# hostile-input acceptance (docs/static-analysis.md "Runtime
+# counterpart"): a deterministic corpus of crafted KV blobs, hostile
+# tenants/paths/traceparents, and malformed opaque configs replayed
+# against the REAL serve + router binaries (plugin config probes run
+# in-process) — every probe declares which static taint sink it
+# exercises, every hostile payload must draw a TYPED rejection, the
+# engine must still decode afterward, and tpu_serve_*/tpu_router_*
+# series counts must stay bounded.  tests/test_taint.py pins the probe
+# registry against tpu_dra/analysis/taint.py's sink catalog.
+drive-hostile:
+	$(PYTHON) hack/drive_hostile.py
 
 # cluster-serving acceptance (docs/scaling.md "Cluster serving",
 # ISSUE 14): REAL kubelet plugin + REAL serve replicas on REAL gRPC-

@@ -1,0 +1,590 @@
+"""Hostile-input drive: the RUNTIME counterpart of the taint checker
+(``make drive-hostile``, docs/static-analysis.md).
+
+The static sink catalog (``tpu_dra/analysis/taint.py`` SINKS) declares
+where untrusted input becomes dangerous; this drive replays crafted
+hostile inputs against each of those sinks ON THE REAL BINARIES and
+asserts the declared sanitizers actually hold at runtime:
+
+- every hostile request gets a TYPED rejection (a 400/413/404 with a
+  JSON error body, a ``ConfigError`` on the plugin config path) — never
+  a 500, a hang, or a stack trace on the wire;
+- the engine is STILL ALIVE afterward (a well-formed request returns
+  200 with the right tokens) — one crafted payload must never kill the
+  replica (the PR-14 incident shape);
+- cycling hostile ``X-Tenant`` headers and request paths leaves the
+  ``tpu_serve_*``/``tpu_router_*`` series counts BOUNDED — the
+  cardinality sanitizer (``util/metrics.bounded_label``) holds under
+  adversarial load, not just in unit tests.
+
+Every probe declares which static sink kind it exercises; the
+registry-pinned test (``tests/test_taint.py::test_hostile_probe_
+completeness``) fails if a sink is declared in the static catalog with
+no hostile probe here — the two lanes cannot drift apart silently.
+
+The corpus is DETERMINISTIC (a fixed list, no randomness): a failure
+reproduces with the same payload every run.
+"""
+
+import base64
+import json
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL_FLAGS = ["--vocab", "64", "--d-model", "32", "--n-heads", "2",
+               "--n-layers", "2", "--d-ff", "64", "--max-seq", "64"]
+
+# serve caps tenant series at ServeMetrics.MAX_TENANTS (+ overflow);
+# the drive cycles strictly more hostile values than that
+HOSTILE_TENANTS = 96
+HOSTILE_PATHS = 24
+
+
+def log(msg: str) -> None:
+    print(f"[drive-hostile] {msg}", flush=True)
+
+
+def die(msg: str) -> None:
+    print(f"[drive-hostile] FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+def free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_until(pred, timeout=180.0, step=0.1, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        val = pred()
+        if val:
+            return val
+        time.sleep(step)
+    die(f"timeout waiting for {what}")
+
+
+def post(url: str, body, headers=None, timeout=30.0):
+    """-> (status, decoded-json-or-None).  ``body`` bytes are sent raw
+    (malformed-JSON probes); anything else is JSON-encoded."""
+    data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as exc:
+        raw = exc.read()
+        try:
+            return exc.code, json.loads(raw or b"null")
+        except json.JSONDecodeError:
+            return exc.code, None
+
+
+def get(url: str, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+# --------------------------------------------------------------------------
+# probe registry — cross-wired to tpu_dra.analysis.taint.SINKS
+# --------------------------------------------------------------------------
+
+PROBES: list = []   # (sink_kind, name, fn(ctx))
+
+
+def probe(sink: str, name: str):
+    def wrap(fn):
+        PROBES.append((sink, name, fn))
+        return fn
+    return wrap
+
+
+class Ctx:
+    """Live endpoints the HTTP probes target."""
+
+    def __init__(self, serve_url: str, router_url: str):
+        self.serve_url = serve_url
+        self.router_url = router_url
+
+    def assert_alive(self, where: str) -> None:
+        """The non-negotiable post-condition of every probe: a
+        well-formed request still decodes end to end."""
+        code, body = post(f"{self.serve_url}/generate",
+                          {"tokens": [[1, 2, 3]], "steps": 2})
+        if code != 200 or not body.get("tokens"):
+            die(f"engine dead after {where}: /generate -> {code} {body}")
+
+
+def expect_typed(ctx, url, payload, what, headers=None,
+                 codes=(400, 404, 413, 503)):
+    """A hostile payload must be refused with a TYPED error: one of the
+    expected codes AND a JSON body carrying ``error`` (or a 404 with no
+    body) — never a 200, a 5xx, or an opaque non-JSON response."""
+    code, body = post(url, payload, headers=headers)
+    if code == 200:
+        die(f"{what}: hostile payload was ACCEPTED (200): "
+            f"{str(payload)[:120]}")
+    if code not in codes:
+        die(f"{what}: expected typed rejection {codes}, got {code} "
+            f"(body {str(body)[:200]}) for {str(payload)[:120]}")
+    if code != 404 and (not isinstance(body, dict) or "error" not in body):
+        die(f"{what}: rejection {code} carries no typed JSON error: "
+            f"{str(body)[:200]}")
+    return code, body
+
+
+# -- jit-entry: crafted KV-handoff blobs ------------------------------------
+
+def _valid_blob(ctx) -> str:
+    """One REAL /prefill blob to mutate — crafted variants differ from
+    a working one by exactly the corrupted field."""
+    code, body = post(f"{ctx.serve_url}/prefill",
+                      {"tokens": [[1, 2, 3, 4]], "steps": 1})
+    if code != 200 or "blob" not in body:
+        die(f"/prefill seed request failed: {code} {body}")
+    return body["blob"]
+
+
+def _corrupt_header(blob_b64: str, mutate) -> str:
+    """Decode the wire header, let ``mutate(header_dict)`` lie about
+    it, re-encode with the original array bytes."""
+    raw = base64.b64decode(blob_b64)
+    (hlen,) = struct.unpack("<I", raw[4:8])
+    header = json.loads(raw[8:8 + hlen])
+    mutate(header)
+    hdr = json.dumps(header).encode()
+    return base64.b64encode(
+        raw[:4] + struct.pack("<I", len(hdr)) + hdr +
+        raw[8 + hlen:]).decode()
+
+
+def _swap_kv_dims(header) -> None:
+    """The canonical hostile shape: transpose the Hkv and S_pad dims of
+    both ks and vs.  The byte count is identical, ks/vs still agree, so
+    ``decode_blob`` accepts it — only ``validate_handoff``'s exact
+    [L, 1, Hkv, S_pad, Dh] layout check stands between this blob and a
+    page-pool scatter with transposed KV (the PR-14 incident shape)."""
+    for idx in (0, 1):
+        name, shape, dtype = header["arrays"][idx]
+        shape = list(shape)
+        shape[2], shape[3] = shape[3], shape[2]
+        header["arrays"][idx] = [name, shape, dtype]
+
+
+@probe("jit-entry", "crafted KV-handoff blobs against /decode_handoff")
+def probe_jit_entry(ctx):
+    good = _valid_blob(ctx)
+    url = f"{ctx.serve_url}/decode_handoff"
+    hostile = [
+        ("not base64", {"blob": "!!!not-base64!!!", "steps": 2}),
+        ("bad magic", {"blob": base64.b64encode(
+            b"XXXX" + b"\0" * 64).decode(), "steps": 2}),
+        ("truncated", {"blob": base64.b64encode(
+            base64.b64decode(good)[:40]).decode(), "steps": 2}),
+        ("shape-lying arrays", {"blob": _corrupt_header(
+            good, _swap_kv_dims), "steps": 2}),
+        ("wrong model dims", {"blob": _corrupt_header(
+            good, lambda h: h["model"].__setitem__("n_layers", 99)),
+            "steps": 2}),
+        ("length lies about prompt", {"blob": _corrupt_header(
+            good, lambda h: h.__setitem__("length", 3)), "steps": 2}),
+        ("oversized decode", {"blob": good, "steps": 10 ** 6}),
+        ("steps as string", {"blob": good, "steps": "many"}),
+    ]
+    for name, payload in hostile:
+        expect_typed(ctx, url, payload, f"jit-entry/{name}")
+    # the canonical seeded-vulnerability witness: a blob whose header
+    # passes pricing but whose ARRAYS are rewritten to a hostile shape
+    # must die in validate_handoff on the caller's thread, and the
+    # batcher must still be stepping afterward
+    code, body = post(url, {"blob": good, "steps": 2})
+    if code != 200:
+        die(f"jit-entry: pristine blob refused: {code} {body}")
+    ctx.assert_alive("jit-entry probes")
+
+
+# -- admission-cost: client-asserted pricing --------------------------------
+
+@probe("admission-cost", "client-asserted cost fields cannot crash or "
+                         "free-ride the admission gate")
+def probe_admission_cost(ctx):
+    url = f"{ctx.serve_url}/generate"
+    for name, payload in [
+            ("negative steps", {"tokens": [[1, 2]], "steps": -5}),
+            ("steps NaN-ish", {"tokens": [[1, 2]], "steps": "NaN"}),
+            ("tokens not rows", {"tokens": "AAAA", "steps": 2}),
+            ("tokens dict", {"tokens": {"a": 1}, "steps": 2}),
+            ("absurd steps", {"tokens": [[1, 2]], "steps": 10 ** 9}),
+    ]:
+        expect_typed(ctx, url, payload, f"admission-cost/{name}")
+    # a prompt_len lie on /decode_handoff must not underprice: the gate
+    # prices from the blob header itself (peek_prompt_len), so the lie
+    # is simply ignored — the request still succeeds, priced honestly
+    good = _valid_blob(ctx)
+    code, body = post(f"{ctx.serve_url}/decode_handoff",
+                      {"blob": good, "steps": 2, "prompt_len": 0})
+    if code != 200:
+        die(f"admission-cost: honest blob with lying prompt_len "
+            f"refused: {code} {body}")
+    ctx.assert_alive("admission-cost probes")
+
+
+# -- metric-label: cardinality under hostile headers/paths ------------------
+
+def _series_labels(metrics_text: str, prefix: str, label: str) -> set:
+    out = set()
+    for line in metrics_text.splitlines():
+        if not line.startswith(prefix) or f"{label}=" not in line:
+            continue
+        val = line.split(f'{label}="', 1)[1].split('"', 1)[0]
+        out.add(val)
+    return out
+
+
+@probe("metric-label", "hostile tenants/paths/traceparents keep series "
+                       "counts bounded")
+def probe_metric_label(ctx):
+    # hostile tenants: more distinct values than MAX_TENANTS, plus
+    # injection-shaped ones (quotes, newlines, the overflow sentinel)
+    evil = ['a"b', "new\nline", "~overflow~", "x" * 500, "", "{}"]
+    for i in range(HOSTILE_TENANTS):
+        tenant = evil[i % len(evil)] + f"-{i}" if i % 3 == 0 else \
+            f"hostile-tenant-{i}"
+        post(f"{ctx.serve_url}/generate",
+             {"tokens": [[1, 2]], "steps": 1},
+             headers={"X-Tenant": tenant,
+                      "traceparent": f"00-garbage-{i}"})
+    # hostile paths through serve AND the router (router proxies
+    # unknown paths; both must collapse them into "other")
+    for i in range(HOSTILE_PATHS):
+        post(f"{ctx.serve_url}/endpoint-{i}", {"x": 1})
+        post(f"{ctx.router_url}/endpoint-{i}", {"x": 1})
+    from tpu_dra.workloads.serve import ServeMetrics
+    _, text = get(f"{ctx.serve_url}/metrics")
+    tenants = _series_labels(text, "tpu_serve_", "tenant")
+    if len(tenants) > ServeMetrics.MAX_TENANTS + 2:
+        die(f"metric-label: {len(tenants)} tenant label values exceed "
+            f"MAX_TENANTS={ServeMetrics.MAX_TENANTS} (+default/"
+            f"overflow): cardinality cap failed under hostile load")
+    for t in tenants:
+        if '"' in t or "\n" in t:
+            die(f"metric-label: unescaped hostile tenant leaked into "
+                f"the exposition: {t!r}")
+    paths = _series_labels(text, "tpu_serve_", "path")
+    from tpu_dra.workloads.serve import _SERVE_PATHS
+    bad = paths - set(_SERVE_PATHS) - {"other"}
+    if bad:
+        die(f"metric-label: client-chosen serve paths minted series: "
+            f"{sorted(bad)[:5]}")
+    _, rtext = get(f"{ctx.router_url}/metrics")
+    from tpu_dra.workloads.router import _KNOWN_PATHS
+    rbad = _series_labels(rtext, "tpu_router_", "path") \
+        - set(_KNOWN_PATHS) - {"other"}
+    if rbad:
+        die(f"metric-label: client-chosen router paths minted series: "
+            f"{sorted(rbad)[:5]}")
+    ctx.assert_alive("metric-label probes")
+
+
+# -- opaque-config: the kubelet-plugin claim-config path --------------------
+
+@probe("opaque-config", "crafted claim opaque configs die as typed "
+                        "ConfigError, never TypeError")
+def probe_opaque_config(ctx):
+    from tpu_dra.api.configs import (ConfigError, SliceChannelConfig,
+                                     TpuConfig)
+    from tpu_dra.api import decoder
+    hostile = [
+        {"apiVersion": "bogus/v1", "kind": "TpuConfig"},
+        {"apiVersion": decoder.GROUP_VERSION, "kind": "NoSuchKind"},
+        {"apiVersion": decoder.GROUP_VERSION, "kind": "TpuConfig",
+         "sharing": {"strategy": "MultiProcess",
+                     "multiProcess": {"maxProcesses": "64"}}},
+        {"apiVersion": decoder.GROUP_VERSION, "kind": "TpuConfig",
+         "sharing": {"strategy": "MultiProcess",
+                     "multiProcess": {"maxProcesses": True}}},
+        {"apiVersion": decoder.GROUP_VERSION, "kind": "TpuConfig",
+         "sharing": {"strategy": "MultiProcess",
+                     "multiProcess": {"maxProcesses": [64]}}},
+        {"apiVersion": decoder.GROUP_VERSION,
+         "kind": "SliceChannelConfig", "domainID": {"nested": "dict"}},
+        {"apiVersion": decoder.GROUP_VERSION,
+         "kind": "SliceChannelConfig", "unknownField": 1},
+    ]
+    for data in hostile:
+        try:
+            cfg = decoder.decode(data)
+            cfg.normalize()
+            cfg.validate()
+        except ConfigError:
+            continue        # the typed rejection the plugin maps to a
+        except Exception as exc:  # noqa: BLE001 — the finding itself
+            die(f"opaque-config: {json.dumps(data)[:120]} raised "
+                f"untyped {type(exc).__name__}: {exc}")
+        die(f"opaque-config: hostile config ACCEPTED: "
+            f"{json.dumps(data)[:120]}")
+    # a pristine config still decodes (the gate rejects, not the path)
+    ok = decoder.decode({"apiVersion": decoder.GROUP_VERSION,
+                         "kind": "TpuConfig"})
+    assert isinstance(ok, TpuConfig)
+    ok2 = decoder.decode({"apiVersion": decoder.GROUP_VERSION,
+                          "kind": "SliceChannelConfig",
+                          "domainID": "domain-1"})
+    assert isinstance(ok2, SliceChannelConfig)
+    ok2.validate()
+
+
+# -- fs-path: claim-chosen strings that become filesystem paths -------------
+
+@probe("fs-path", "path-traversal domainIDs are refused before any "
+                  "directory is created")
+def probe_fs_path(ctx):
+    from tpu_dra.api.configs import ConfigError, SliceChannelConfig, \
+        SliceDaemonConfig
+    for cls in (SliceChannelConfig, SliceDaemonConfig):
+        for domain_id in ("../../etc/cron.d", "..", ".",
+                          "/etc/passwd", "a/b", "a\x00b", ".hidden",
+                          "-", "x" * 300):
+            cfg = cls.from_dict({"apiVersion": "tpu.example.com/v1",
+                                 "kind": cls.KIND,
+                                 "domainID": domain_id})
+            try:
+                cfg.validate()
+            except ConfigError:
+                continue
+            except Exception as exc:  # noqa: BLE001 — the finding
+                die(f"fs-path: {cls.KIND} domainID={domain_id!r} "
+                    f"raised untyped {type(exc).__name__}: {exc}")
+            die(f"fs-path: {cls.KIND} accepted traversal domainID "
+                f"{domain_id!r} — it names a directory under the "
+                f"plugin root")
+
+
+# -- cdi-env: claim-chosen values bound for container env injection --------
+
+@probe("cdi-env", "hostile HBM-limit maps die before reaching CDI env "
+                  "edits")
+def probe_cdi_env(ctx):
+    from tpu_dra.api.configs import ConfigError, TpuSharing
+    for limits in ({"*": "not-a-quantity"}, {"*": ""},
+                   {"evil key": "1Gi"}, {"*": "1GiB;export X=1"}):
+        sharing = TpuSharing.from_dict(
+            {"strategy": "MultiProcess",
+             "multiProcess": {"hbmLimitPerProcess": limits}})
+        try:
+            sharing.validate()
+        except ConfigError:
+            continue
+        except Exception as exc:  # noqa: BLE001 — the finding
+            die(f"cdi-env: {limits} raised untyped "
+                f"{type(exc).__name__}: {exc}")
+        die(f"cdi-env: hostile HBM limit map accepted: {limits} — "
+            f"these values become TPU_* env in container edits")
+
+
+# -- exec: operator env that selects a binary to run ------------------------
+
+@probe("exec", "a hostile SLICE_COORDD never gets exec'd without "
+               "passing the self-test gate")
+def probe_exec(ctx):
+    from tpu_dra.daemon import main as daemon_main
+    with tempfile.TemporaryDirectory() as td:
+        evil = os.path.join(td, "evil")
+        with open(evil, "w") as f:
+            # exits 1 on --version: the self-test must refuse it
+            f.write("#!/bin/sh\nexit 1\n")
+        os.chmod(evil, 0o755)
+        daemon_main._coordd_selftest_cache.clear()
+        old = os.environ.get("SLICE_COORDD")
+        os.environ["SLICE_COORDD"] = evil
+        try:
+            argv = daemon_main.coordservice_argv(td, 0)
+        finally:
+            if old is None:
+                os.environ.pop("SLICE_COORDD", None)
+            else:
+                os.environ["SLICE_COORDD"] = old
+            daemon_main._coordd_selftest_cache.clear()
+        if argv[0] == evil:
+            die("exec: a binary that FAILS the --version self-test was "
+                "selected for supervision")
+        # missing file: must also fall back, not raise
+        daemon_main._coordd_selftest_cache.clear()
+        os.environ["SLICE_COORDD"] = os.path.join(td, "nonexistent")
+        try:
+            argv = daemon_main.coordservice_argv(td, 0)
+        finally:
+            if old is None:
+                os.environ.pop("SLICE_COORDD", None)
+            else:
+                os.environ["SLICE_COORDD"] = old
+            daemon_main._coordd_selftest_cache.clear()
+        # trusted fallbacks: the repo's own self-tested native coordd
+        # (when built) or the pure-Python service — anything else means
+        # the hostile path leaked through
+        trusted_native = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "native", "coordd")
+        if argv[0] not in (sys.executable, trusted_native):
+            die(f"exec: nonexistent SLICE_COORDD did not fall back to "
+                f"a trusted service: {argv}")
+
+
+# -- http-request is the SOURCE bundle: raw wire garbage --------------------
+
+@probe("http-request", "raw wire garbage (bad JSON, huge bodies, "
+                       "hostile traceparents) gets typed 400s")
+def probe_http_request(ctx):
+    url = f"{ctx.serve_url}/generate"
+    expect_typed(ctx, url, b"{not json", "http-request/bad json")
+    expect_typed(ctx, url, b"\x00\x01\x02\xff", "http-request/binary")
+    expect_typed(ctx, url, {"tokens": None}, "http-request/null rows")
+    # a hostile traceparent must not break span handling (200 expected:
+    # the garbage parent is simply not joined)
+    code, body = post(url, {"tokens": [[1, 2]], "steps": 1},
+                      headers={"traceparent": "00-zz-zz-zz-zz-\x7f"})
+    if code != 200:
+        die(f"http-request: hostile traceparent broke a valid request: "
+            f"{code} {body}")
+    # hostile deadline header: typed rejection or ignored, never 500
+    code, body = post(url, {"tokens": [[1, 2]], "steps": 1},
+                      headers={"X-Deadline-Ms": "soon"})
+    if code not in (200, 400):
+        die(f"http-request: hostile X-Deadline-Ms -> {code} {body}")
+    ctx.assert_alive("http-request probes")
+
+
+# -- handoff-blob source rides the jit-entry probe (same corpus) ------------
+
+@probe("handoff-blob", "blob source corpus (see jit-entry probe)")
+def probe_handoff_blob(ctx):
+    # the handoff-blob SOURCE and the jit-entry SINK are two ends of
+    # one flow; the corpus lives in probe_jit_entry.  This probe adds
+    # the router-side traversal: a blob submitted through the ROUTER
+    # must meet the same wall.
+    good = _valid_blob(ctx)
+    bad = _corrupt_header(good, lambda h: h["model"].__setitem__(
+        "d_head", 7))
+    expect_typed(ctx, f"{ctx.router_url}/decode_handoff",
+                 {"blob": bad, "steps": 2}, "handoff-blob via router")
+    ctx.assert_alive("handoff-blob probes")
+
+
+# -- env-external source: covered in-process by probe_exec ------------------
+
+@probe("env-external", "externally-writable env cannot select code "
+                       "paths without validation (see exec probe)")
+def probe_env_external(ctx):
+    from tpu_dra.analysis import contracts
+    # the static catalog and the runtime corpus agree on what
+    # "external env" means
+    if "SLICE_COORDD" not in contracts.EXTERNAL_ENV:
+        die("env-external: SLICE_COORDD missing from the declared "
+            "EXTERNAL_ENV contract")
+
+
+# --------------------------------------------------------------------------
+# harness
+# --------------------------------------------------------------------------
+
+def make_checkpoint(base: str) -> str:
+    ckpt = os.path.join(base, "ckpt")
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "from tpu_dra.workloads.train import ModelConfig, init_params\n"
+        "from tpu_dra.workloads.checkpointing import save_train_state\n"
+        "cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,"
+        " d_ff=64, max_seq=64, pos_emb='rope')\n"
+        f"save_train_state({ckpt!r}, 1,"
+        " init_params(cfg, jax.random.PRNGKey(0)))\n")
+    subprocess.run([sys.executable, "-c", script], check=True,
+                   timeout=300)
+    return ckpt
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="drive-hostile-")
+    log("training the tiny checkpoint")
+    ckpt = make_checkpoint(base)
+    serve_port, router_port = free_port(), free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    serve = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.workloads.serve",
+         "--checkpoint-dir", ckpt, "--host", "127.0.0.1",
+         "--port", str(serve_port), "--pos-emb", "rope", *MODEL_FLAGS,
+         "--continuous", "--slots", "4", "--chunk", "2",
+         "--kv-layout", "paged", "--page-size", "16"],
+        env=env, cwd=REPO)
+    router = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.workloads.router",
+         "--host", "127.0.0.1", "--port", str(router_port),
+         "--replica", f"r0=http://127.0.0.1:{serve_port}",
+         "--probe-interval", "0.3"],
+        env=env, cwd=REPO)
+    serve_url = f"http://127.0.0.1:{serve_port}"
+    router_url = f"http://127.0.0.1:{router_port}"
+    ctx = Ctx(serve_url, router_url)
+    try:
+        def up():
+            try:
+                return get(f"{serve_url}/healthz")[0] == 200
+            except OSError:
+                return False
+        wait_until(up, what="serve /healthz")
+
+        def routed():
+            try:
+                code, _ = post(f"{router_url}/generate",
+                               {"tokens": [[1, 2]], "steps": 1},
+                               timeout=60)
+                return code == 200
+            except OSError:
+                return False
+        wait_until(routed, what="router routing to the replica")
+        log(f"serve up on {serve_port}, router on {router_port}; "
+            f"running {len(PROBES)} probes over "
+            f"{len({p[0] for p in PROBES})} sink kinds")
+        for sink, name, fn in PROBES:
+            t0 = time.perf_counter()
+            fn(ctx)
+            log(f"probe [{sink}] {name}: ok "
+                f"({time.perf_counter() - t0:.1f}s)")
+        # final liveness + a bounded-series recheck after EVERYTHING
+        ctx.assert_alive("the full hostile corpus")
+        from tpu_dra.analysis import taint
+        covered = {p[0] for p in PROBES}
+        missing = set(taint.SINKS) - covered
+        if missing:
+            die(f"declared static sinks with no hostile probe: "
+                f"{sorted(missing)}")
+        log(f"PASS: {len(PROBES)} probes, sinks covered: "
+            f"{sorted(covered & set(taint.SINKS))}")
+        return 0
+    finally:
+        for proc in (router, serve):
+            proc.terminate()
+        for proc in (router, serve):
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

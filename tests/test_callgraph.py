@@ -397,3 +397,25 @@ def test_cli_max_seconds_gate(tmp_path):
         capture_output=True, text=True, env=env, timeout=120)
     assert slow.returncode == 1
     assert "--max-seconds" in slow.stderr
+
+
+def test_fingerprint_covers_the_taint_and_lifecycle_modules():
+    """The extractor fingerprint must walk the NEW analysis modules too:
+    an edit to taint.py (a new sink) or checkers/lifecycle.py (a new
+    resource kind) invalidates cached facts exactly like an edit to the
+    extractor core.  Pinned by touching each file's mtime (restored
+    exactly) and requiring the digest to move."""
+    from tpu_dra.analysis import cache as cache_mod
+
+    base = os.path.dirname(os.path.abspath(cache_mod.__file__))
+    for rel in ("taint.py", os.path.join("checkers", "lifecycle.py"),
+                os.path.join("checkers", "taintflow.py")):
+        target = os.path.join(base, rel)
+        st = os.stat(target)
+        before = cache_mod._extractor_fingerprint()
+        try:
+            os.utime(target, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+            assert cache_mod._extractor_fingerprint() != before, rel
+        finally:
+            os.utime(target, ns=(st.st_atime_ns, st.st_mtime_ns))
+        assert cache_mod._extractor_fingerprint() == before, rel

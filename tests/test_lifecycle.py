@@ -1,0 +1,245 @@
+"""lifecycle (tpu_dra/analysis/checkers/lifecycle.py): must-release
+resources over the CFG, exception edges included.
+
+One leaking and one clean fixture per tracked resource kind (admission
+tickets, pooled connections, KV page allocations, flocked fds,
+prepare/unprepare pairs), plus the precision cases that distinguish
+this checker from a grep: exception-edge leaks, the acquiring
+statement's own raise edge (no binding yet — must NOT report), None-
+guarded releases, tuple unpacking, escape analysis, and with-statement
+exclusion.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpu_dra.analysis import run_paths
+import pytest
+
+pytestmark = pytest.mark.core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lifecycle_snippet(tmp_path, source: str, relpath="tpu_dra/x.py"):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return run_paths([str(path)], checks=["lifecycle"])
+
+
+def fired(diags) -> list[str]:
+    return [d.check for d in diags]
+
+
+# -------------------------------------------------------------------------
+# per-resource leak / clean pairs
+# -------------------------------------------------------------------------
+
+
+def test_admission_ticket_leak_and_clean(tmp_path):
+    leak = ("def f(admission, shed):\n"
+            "    t = admission.acquire('x', 3)\n"
+            "    if shed:\n"
+            "        return 1\n"          # held at exit on this path
+            "    admission.release(t)\n")
+    diags = lifecycle_snippet(tmp_path, leak)
+    assert fired(diags) == ["lifecycle"]
+    assert "admission ticket" in diags[0].message
+    clean = ("def f(admission, work):\n"
+             "    t = admission.acquire('x', 3)\n"
+             "    try:\n"
+             "        work()\n"
+             "    finally:\n"
+             "        admission.release(t)\n")
+    assert lifecycle_snippet(tmp_path, clean) == []
+
+
+def test_pooled_connection_leak_and_clean(tmp_path):
+    leak = ("def f(self, body):\n"
+            "    conn, idx = self._get_conn()\n"
+            "    resp = conn.request(body)\n"   # can raise: conn leaks
+            "    self._put_conn(conn, idx)\n"
+            "    return resp\n")
+    diags = lifecycle_snippet(tmp_path, leak)
+    assert fired(diags) == ["lifecycle"]
+    assert "pooled connection" in diags[0].message
+    clean = ("def f(self, body):\n"
+             "    conn, idx = self._get_conn()\n"
+             "    try:\n"
+             "        resp = conn.request(body)\n"
+             "    except OSError:\n"
+             "        conn.close()\n"
+             "        raise\n"
+             "    self._put_conn(conn, idx)\n"
+             "    return resp\n")
+    assert lifecycle_snippet(tmp_path, clean) == []
+
+
+def test_kv_pages_leak_and_clean(tmp_path):
+    leak = ("def f(pool, empty):\n"
+            "    pages, n = pool.alloc(4)\n"
+            "    if empty:\n"
+            "        return None\n"
+            "    pool.free(pages)\n")
+    diags = lifecycle_snippet(tmp_path, leak)
+    assert fired(diags) == ["lifecycle"]
+    assert "KV page allocation" in diags[0].message
+    clean = leak.replace("        return None\n",
+                         "        pool.free(pages)\n"
+                         "        return None\n")
+    assert lifecycle_snippet(tmp_path, clean) == []
+
+
+def test_flocked_fd_leak_and_clean(tmp_path):
+    leak = ("import os\n"
+            "def f(path):\n"
+            "    fd = os.open(path, 0)\n"
+            "    os.ftruncate(fd, 0)\n"      # can raise: fd leaks
+            "    os.close(fd)\n")
+    diags = lifecycle_snippet(tmp_path, leak)
+    assert fired(diags) == ["lifecycle"]
+    assert "flocked fd" in diags[0].message
+    clean = ("import os\n"
+             "def f(path):\n"
+             "    fd = os.open(path, 0)\n"
+             "    try:\n"
+             "        os.ftruncate(fd, 0)\n"
+             "    except OSError:\n"
+             "        os.close(fd)\n"
+             "        raise\n"
+             "    os.close(fd)\n")
+    assert lifecycle_snippet(tmp_path, clean) == []
+
+
+def test_prepare_pair_exception_edge(tmp_path):
+    # pairs only report the exception-edge rule: the matching release
+    # lives in unprepare, but an in-function rollback must cover raises
+    leak = ("def prepare(self, claim):\n"
+            "    prepare_settings(claim)\n"
+            "    self.publish(claim)\n"       # raise -> settings stay
+            "    unprepare_settings(claim)\n")
+    diags = lifecycle_snippet(tmp_path, leak)
+    assert fired(diags) == ["lifecycle"]
+    assert "prepare_settings" in diags[0].message
+    clean = ("def prepare(self, claim):\n"
+             "    prepare_settings(claim)\n"
+             "    try:\n"
+             "        self.publish(claim)\n"
+             "    except Exception:\n"
+             "        rollback_settings(claim)\n"
+             "        raise\n")
+    assert lifecycle_snippet(tmp_path, clean) == []
+    # held-at-exit alone is NOT a pair finding (unprepare is elsewhere)
+    no_closer = ("def prepare(self, claim):\n"
+                 "    prepare_settings(claim)\n")
+    assert lifecycle_snippet(tmp_path, no_closer) == []
+
+
+# -------------------------------------------------------------------------
+# precision cases
+# -------------------------------------------------------------------------
+
+
+def test_acquire_own_raise_edge_is_not_a_leak(tmp_path):
+    # os.open raising means there IS no fd — the except edge must see
+    # the pre-acquisition state (the shim's probe_flock shape)
+    src = ("import os\n"
+           "def f(path):\n"
+           "    try:\n"
+           "        fd = os.open(path, 0)\n"
+           "    except OSError:\n"
+           "        return False\n"
+           "    os.close(fd)\n"
+           "    return True\n")
+    assert lifecycle_snippet(tmp_path, src) == []
+
+
+def test_none_guard_release_kills(tmp_path):
+    src = ("def f(admission, work):\n"
+           "    t = None\n"
+           "    try:\n"
+           "        t = admission.acquire('x', 1)\n"
+           "        work()\n"
+           "    finally:\n"
+           "        if t is not None:\n"
+           "            admission.release(t)\n")
+    assert lifecycle_snippet(tmp_path, src) == []
+
+
+def test_escaped_resources_are_not_tracked(tmp_path):
+    # returned / attribute-stored / handed to a non-release call:
+    # someone else's to release
+    returned = ("def f(admission):\n"
+                "    t = admission.acquire('x', 1)\n"
+                "    return t\n")
+    assert lifecycle_snippet(tmp_path, returned) == []
+    stored = ("def f(self, admission):\n"
+              "    t = admission.acquire('x', 1)\n"
+              "    self.ticket = t\n")
+    assert lifecycle_snippet(tmp_path, stored) == []
+    handed = ("def f(admission, registry):\n"
+              "    t = admission.acquire('x', 1)\n"
+              "    registry.track(t)\n")
+    assert lifecycle_snippet(tmp_path, handed) == []
+
+
+def test_fd_byte_ops_are_not_escapes(tmp_path):
+    # writing through a flocked fd is the launcher's normal use, not a
+    # handoff — the leak must still be visible past them
+    src = ("import os\n"
+           "def f(path, pid):\n"
+           "    fd = os.open(path, 0)\n"
+           "    os.write(fd, pid)\n"
+           "    return True\n")            # never closed
+    diags = lifecycle_snippet(tmp_path, src)
+    assert fired(diags) == ["lifecycle"]
+
+
+def test_with_managed_resources_excluded(tmp_path):
+    src = ("def f(admission, work):\n"
+           "    with admission.acquire('x', 1) as t:\n"
+           "        work(t)\n")
+    assert lifecycle_snippet(tmp_path, src) == []
+
+
+def test_suppression_comment(tmp_path):
+    src = ("def f(admission, work):\n"
+           "    # vet: ignore[lifecycle] — released by the reaper\n"
+           "    t = admission.acquire('x', 1)\n"
+           "    work()\n")
+    assert lifecycle_snippet(tmp_path, src) == []
+
+
+def test_exception_edge_requires_protocol_elsewhere(tmp_path):
+    # rule 2 fires only when the function DOES release the resource on
+    # some path (the protocol exists; this edge bypasses it).  With no
+    # release at all, rule 1 (held at exit) is the single finding.
+    src = ("def f(admission, work):\n"
+           "    t = admission.acquire('x', 1)\n"
+           "    work()\n")
+    diags = lifecycle_snippet(tmp_path, src)
+    assert fired(diags) == ["lifecycle"]
+    assert "never be released" in diags[0].message
+
+
+def test_test_files_are_skipped(tmp_path):
+    src = ("def f(admission):\n"
+           "    t = admission.acquire('x', 1)\n")
+    assert lifecycle_snippet(tmp_path, src,
+                             relpath="tests/test_x.py") == []
+
+
+# -------------------------------------------------------------------------
+# the real tree (the serve ticket-release fixes of this PR stay fixed)
+# -------------------------------------------------------------------------
+
+
+def test_real_serve_and_router_have_no_lifecycle_leaks():
+    diags = run_paths(
+        [os.path.join(REPO_ROOT, "tpu_dra", "workloads", "serve.py"),
+         os.path.join(REPO_ROOT, "tpu_dra", "workloads", "router.py"),
+         os.path.join(REPO_ROOT, "tpu_dra", "workloads", "launcher.py")],
+        checks=["lifecycle"])
+    assert diags == []

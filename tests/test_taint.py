@@ -1,0 +1,449 @@
+"""taint-flow (tpu_dra/analysis/taint.py): trust-boundary dataflow.
+
+Fixture layers, mirroring tests/test_vet.py's shape:
+
+1. One seeded true positive and one sanitized/clean negative per
+   source kind and per sink kind — a catalog entry that stops firing
+   (or a sanitizer that stops clearing) is caught immediately.
+2. Interprocedural composition — a two-file fixture where the source
+   and the sink live in different functions/modules, joined only by
+   the callgraph.
+3. The suppression surface — ``# vet: sanitized[<kind>]`` on the sink
+   line (and on a preceding comment block), the ``sanitized:<kind>``
+   ratchet keys, SARIF codeFlows.
+4. PR-14 regression fixtures: the two incident shapes (a crafted
+   handoff blob reaching the batcher queue; a client-asserted number
+   pricing admission) distilled from the real serve/continuous code.
+5. Cross-wiring with the DYNAMIC lane: every declared SINK kind must
+   have a probe in hack/drive_hostile.py (the exact pinning the
+   guarded-by/racecheck pair uses), so the static catalog and the
+   hostile-input corpus cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from tpu_dra.analysis import run_paths, taint
+import pytest
+
+pytestmark = pytest.mark.core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def vet_files(tmp_path, files: dict[str, str],
+              checks: list[str] | None = None):
+    """Write each relpath -> source under tmp_path and run the
+    analyzers over all of them (one whole-program Program)."""
+    paths = []
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+        paths.append(str(path))
+    return run_paths(paths, checks=checks or ["taint-flow"])
+
+
+def taint_snippet(tmp_path, relpath: str, source: str):
+    return vet_files(tmp_path, {relpath: source})
+
+
+# -------------------------------------------------------------------------
+# source kinds
+# -------------------------------------------------------------------------
+
+
+def test_source_http_request_attribute(tmp_path):
+    # self.headers IS the boundary inside the handler files
+    src = ("class H:\n"
+           "    def do(self, metrics):\n"
+           "        tenant = self.headers.get('X-Tenant')\n"
+           "        metrics.observe(tenant)\n")
+    diags = taint_snippet(tmp_path, "tpu_dra/workloads/serve.py", src)
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "http-request" in diags[0].message
+    # the same code OUTSIDE the handler files has no http boundary
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/other.py", src) == []
+
+
+def test_source_declared_tainted_param(tmp_path):
+    # submit_handoff's handoff parameter is tainted by declaration
+    src = ("class Engine:\n"
+           "    def submit_handoff(self, handoff, steps):\n"
+           "        self._pending.append(handoff)\n")
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/continuous.py", src)
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "handoff-blob" in diags[0].message
+    # another parameter name in the same function is NOT a source
+    clean = src.replace("append(handoff)", "append(steps)")
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/continuous.py", clean) == []
+
+
+def test_source_opaque_config_decode(tmp_path):
+    src = ("import subprocess\n"
+           "from tpu_dra.api import decoder\n"
+           "def go(raw):\n"
+           "    cfg = decoder.decode(raw)\n"
+           "    subprocess.run(cfg)\n")
+    diags = taint_snippet(tmp_path, "tpu_dra/plugins/x.py", src)
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "opaque-config" in diags[0].message
+
+
+def test_source_bare_decode_is_not_the_opaque_decoder(tmp_path):
+    # workloads/decode.py's decode() is a different function; the bare
+    # unresolved name must not count as the config boundary
+    src = ("import subprocess\n"
+           "def go(raw):\n"
+           "    toks = decode(raw)\n"
+           "    subprocess.run(toks)\n")
+    assert taint_snippet(tmp_path, "tpu_dra/workloads/x.py", src) == []
+
+
+def test_source_external_env(tmp_path):
+    # SLICE_COORDD is in contracts.EXTERNAL_ENV; a made-up var is not
+    src = ("import os, subprocess\n"
+           "def go():\n"
+           "    path = os.environ.get('SLICE_COORDD', '')\n"
+           "    subprocess.run([path])\n")
+    diags = taint_snippet(tmp_path, "tpu_dra/daemon/x.py", src)
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "env-external" in diags[0].message
+    internal = src.replace("SLICE_COORDD", "TPU_DRA_NOT_A_REAL_VAR")
+    assert taint_snippet(tmp_path, "tpu_dra/daemon/y.py", internal) == []
+
+
+# -------------------------------------------------------------------------
+# sink kinds
+# -------------------------------------------------------------------------
+
+
+def _req_handler(body: str) -> str:
+    """A serve-file function whose ``req`` parameter is the source."""
+    return "def handle(req, metrics, admission, edits, pool):\n" + body
+
+
+def test_sink_exec(tmp_path):
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        "import subprocess\n" + _req_handler(
+            "    subprocess.run(req['cmd'])\n"))
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "exec" in diags[0].message
+
+
+def test_sink_fs_path(tmp_path):
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        "import os\n" + _req_handler("    os.makedirs(req['dir'])\n"))
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert len(diags) == 1
+
+
+def test_sink_cdi_env(tmp_path):
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        _req_handler("    edits.env['TPU_X'] = req['limit']\n"))
+    assert [d.check for d in diags] == ["taint-flow"]
+
+
+def test_sink_metric_label(tmp_path):
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        _req_handler("    metrics.observe(req.get('path'), 200)\n"))
+    assert [d.check for d in diags] == ["taint-flow"]
+
+
+def test_sink_admission_cost(tmp_path):
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        _req_handler(
+            "    t = admission.acquire('x', req.get('cost'))\n"
+            "    admission.release(t)\n"))
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "admission-cost" in diags[0].message
+
+
+def test_sink_jit_entry(tmp_path):
+    src = ("class Engine:\n"
+           "    def submit_handoff(self, handoff):\n"
+           "        self._pending.append(handoff)\n")
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/continuous.py", src)
+    assert "jit-entry" in diags[0].message
+
+
+# -------------------------------------------------------------------------
+# sanitizers
+# -------------------------------------------------------------------------
+
+
+def test_sanitizer_call_clears(tmp_path):
+    # routing the label through bounded_label() is the declared fix
+    clean = _req_handler(
+        "    metrics.observe(bounded_label(req.get('path')), 200)\n")
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        "from tpu_dra.util.metrics import bounded_label\n" + clean) == []
+
+
+def test_sanitizer_statement_clears_argument(tmp_path):
+    # validate_handoff(h, ...) raises on bad input: the fall-through
+    # edge carries trusted data
+    src = ("from tpu_dra.workloads.kv_handoff import validate_handoff\n"
+           "class Engine:\n"
+           "    def submit_handoff(self, handoff, cfg):\n"
+           "        validate_handoff(handoff, cfg)\n"
+           "        self._pending.append(handoff)\n")
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/continuous.py", src) == []
+
+
+def test_sanitizer_validate_method_clears_receiver(tmp_path):
+    src = ("import subprocess\n"
+           "from tpu_dra.api import decoder\n"
+           "def go(raw):\n"
+           "    cfg = decoder.decode(raw)\n"
+           "    cfg.validate()\n"
+           "    subprocess.run(cfg)\n")
+    assert taint_snippet(tmp_path, "tpu_dra/plugins/x.py", src) == []
+
+
+def test_numeric_cast_launders_shape_sinks_only(tmp_path):
+    # int() kills a string-shaped attack (metric labels) but a client-
+    # chosen NUMBER still prices admission
+    base = ("def handle(req, metrics, admission):\n"
+            "    n = int(req.get('steps'))\n")
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        base + "    metrics.observe(n, 200)\n") == []
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py",
+        base + "    t = admission.acquire('x', n)\n"
+               "    admission.release(t)\n")
+    assert [d.check for d in diags] == ["taint-flow"]
+
+
+# -------------------------------------------------------------------------
+# interprocedural composition
+# -------------------------------------------------------------------------
+
+
+def test_interprocedural_two_files(tmp_path):
+    # source in serve.py, sink two calls deep in another module: the
+    # flow exists only through the callgraph
+    helper = ("import subprocess\n"
+              "def deeper(argv):\n"
+              "    subprocess.run(argv)\n"
+              "def launch(cmd):\n"
+              "    deeper(cmd)\n")
+    entry = ("from tpu_dra.workloads.helper import launch\n"
+             "def handle(req):\n"
+             "    launch(req['cmd'])\n")
+    diags = vet_files(tmp_path, {
+        "tpu_dra/workloads/helper.py": helper,
+        "tpu_dra/workloads/serve.py": entry,
+    })
+    assert [d.check for d in diags] == ["taint-flow"]
+    # the finding lands at the SINK, with the flow walking back to the
+    # source through both calls
+    assert diags[0].path.endswith("helper.py")
+    assert len(diags[0].flow) >= 3
+    flow_text = " ".join(desc for _p, _l, desc in diags[0].flow)
+    assert "source" in flow_text and "sink" in flow_text
+
+
+def test_interprocedural_return_taint(tmp_path):
+    files = {
+        "tpu_dra/workloads/helper.py":
+            "def pick(req):\n    return req.get('tenant')\n",
+        "tpu_dra/workloads/serve.py":
+            ("from tpu_dra.workloads.helper import pick\n"
+             "def handle(req, metrics):\n"
+             "    metrics.observe(pick(req), 200)\n"),
+    }
+    diags = vet_files(tmp_path, files)
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert diags[0].path.endswith("serve.py")
+
+
+def test_unresolved_call_does_not_launder(tmp_path):
+    # an unknown helper conservatively returns its arguments' taint
+    src = _req_handler(
+        "    x = some_unknown_helper(req.get('path'))\n"
+        "    metrics.observe(x, 200)\n")
+    diags = taint_snippet(tmp_path, "tpu_dra/workloads/serve.py", src)
+    assert [d.check for d in diags] == ["taint-flow"]
+
+
+# -------------------------------------------------------------------------
+# suppression + ratchet
+# -------------------------------------------------------------------------
+
+_FLOW = ("def handle(req, metrics):\n"
+         "    metrics.observe(req.get('path'), 200)\n")
+
+
+def test_sanitized_suppression_on_sink_line(tmp_path):
+    ok = _FLOW.replace(
+        ", 200)", ", 200)  # vet: sanitized[metric-label] why: test")
+    assert taint_snippet(tmp_path, "tpu_dra/workloads/serve.py", ok) == []
+    # the WRONG kind does not suppress
+    wrong = _FLOW.replace(", 200)", ", 200)  # vet: sanitized[exec]")
+    assert len(taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py", wrong)) == 1
+
+
+def test_sanitized_suppression_on_preceding_comment_block(tmp_path):
+    src = ("def handle(req, metrics):\n"
+           "    # vet: sanitized[metric-label] — a justification that\n"
+           "    # spans several comment lines still targets the next\n"
+           "    # statement, not the next physical line\n"
+           "    metrics.observe(req.get('path'), 200)\n")
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py", src) == []
+
+
+def test_sanitized_markers_ratchet_per_kind(tmp_path):
+    # count_suppressions buckets typed markers as sanitized:<kind>
+    from tpu_dra.analysis.core import count_suppressions
+    path = tmp_path / "x.py"
+    path.write_text(
+        "a = 1  # vet: sanitized[exec] why\n"
+        "b = 2  # vet: sanitized[exec] why\n"
+        "c = 3  # vet: sanitized[metric-label] why\n"
+        "d = 4  # vet: ignore[lifecycle]\n")
+    counts = count_suppressions([str(path)])
+    assert counts["sanitized:exec"] == 2
+    assert counts["sanitized:metric-label"] == 1
+    assert counts["lifecycle"] == 1
+
+
+def test_baseline_ratchets_sanitized_keys(tmp_path):
+    path = tmp_path / "tpu_dra" / "x.py"
+    path.parent.mkdir(parents=True)
+    path.write_text("a = 1  # vet: sanitized[exec] why\n"
+                    "b = 2  # vet: sanitized[exec] why\n")
+    baseline = tmp_path / "base.json"
+    baseline.write_text(json.dumps(
+        {"schema_version": 1, "ignores": {"sanitized:exec": 1}}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis", "--stats",
+         "--baseline", str(baseline), str(path)],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    assert "sanitized:exec" in proc.stdout
+
+
+def test_sarif_carries_code_flows(tmp_path):
+    from tpu_dra.analysis import all_analyzers
+    from tpu_dra.analysis.report import render_sarif
+    path = tmp_path / "tpu_dra" / "workloads" / "serve.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(_FLOW)
+    diags = run_paths([str(path)], checks=["taint-flow"])
+    assert len(diags) == 1 and diags[0].flow
+    sarif = json.loads(render_sarif(diags, all_analyzers()))
+    result = sarif["runs"][0]["results"][0]
+    locs = result["codeFlows"][0]["threadFlows"][0]["locations"]
+    assert len(locs) == len(diags[0].flow)
+    assert len(result["relatedLocations"]) == len(diags[0].flow)
+    texts = [loc["location"]["message"]["text"] for loc in locs]
+    assert any("source" in t for t in texts)
+    assert any("sink" in t for t in texts)
+
+
+# -------------------------------------------------------------------------
+# PR-14 regression shapes (distilled from the real incident code)
+# -------------------------------------------------------------------------
+
+
+def test_regression_unvalidated_handoff_reaches_batcher(tmp_path):
+    # the PR-14 incident: submit_handoff queues the blob for the jit-
+    # stepping batcher without the shape contract
+    bad = ("class Engine:\n"
+           "    def submit_handoff(self, handoff, steps):\n"
+           "        handle = object()\n"
+           "        self._pending.append((handle, handoff))\n"
+           "        return handle\n")
+    diags = taint_snippet(
+        tmp_path, "tpu_dra/workloads/continuous.py", bad)
+    assert [d.check for d in diags] == ["taint-flow"]
+    assert "handoff-blob" in diags[0].message
+    assert "jit-entry" in diags[0].message or "_pending" in \
+        diags[0].message
+
+
+def test_regression_client_asserted_cost_prices_admission(tmp_path):
+    # the cost must come from a server-side pricing helper, not the
+    # client's own claim
+    bad = _req_handler(
+        "    t = admission.acquire('x', int(req.get('prompt_len')))\n"
+        "    admission.release(t)\n")
+    assert len(taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py", bad)) == 1
+    good = bad.replace("int(req.get('prompt_len'))",
+                       "handoff_cost(req)")
+    assert taint_snippet(
+        tmp_path, "tpu_dra/workloads/serve.py", good) == []
+
+
+def test_real_tree_is_clean_of_taint_findings():
+    # the shipped serve/router/continuous/plugin code carries no
+    # unsanitized flows (annotated suppressions excepted) — the same
+    # gate `make vet` enforces, pinned here so the unit suite catches
+    # a regression without the full vet run
+    diags = run_paths(
+        [os.path.join(REPO_ROOT, "tpu_dra", "workloads", "serve.py"),
+         os.path.join(REPO_ROOT, "tpu_dra", "workloads", "router.py"),
+         os.path.join(REPO_ROOT, "tpu_dra", "workloads",
+                      "continuous.py")],
+        checks=["taint-flow"])
+    assert diags == []
+
+
+# -------------------------------------------------------------------------
+# cross-wiring with the hostile-input drive
+# -------------------------------------------------------------------------
+
+
+def _load_drive_probes():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "drive_hostile", os.path.join(REPO_ROOT, "hack",
+                                      "drive_hostile.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.PROBES
+
+
+def test_hostile_probe_completeness():
+    """Every declared static SINK kind has a hostile probe — the exact
+    pinning that keeps the static catalog and the runtime corpus from
+    drifting (the guarded-by/racecheck discipline, applied here)."""
+    probes = _load_drive_probes()
+    covered = {sink for sink, _name, _fn in probes}
+    missing = set(taint.SINKS) - covered
+    assert not missing, (
+        f"static sinks with no hostile probe in hack/drive_hostile.py: "
+        f"{sorted(missing)} — add a probe() for each")
+    sources_covered = covered - set(taint.SINKS)
+    assert set(taint.SOURCES) <= sources_covered | set(taint.SINKS), (
+        f"declared sources without a probe: "
+        f"{sorted(set(taint.SOURCES) - sources_covered)}")
+
+
+def test_catalog_entries_are_documented():
+    doc = open(os.path.join(REPO_ROOT, "docs",
+                            "static-analysis.md")).read()
+    for kind in list(taint.SOURCES) + list(taint.SINKS):
+        assert kind in doc, f"{kind} missing from docs/static-analysis.md"
+    for name in taint.SANITIZERS:
+        assert name in doc, f"sanitizer {name} missing from docs"

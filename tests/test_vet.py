@@ -38,7 +38,8 @@ EXPECTED_CHECKS = {"guarded-by", "reconcile-hygiene", "jit-purity",
                    "string-constant-drift", "exception-hygiene",
                    "metric-hygiene", "retry-hygiene", "lock-order",
                    "blocking-under-lock", "hotpath",
-                   "deadline-hygiene", "contract-drift"}
+                   "deadline-hygiene", "contract-drift",
+                   "taint-flow", "lifecycle"}
 
 
 def vet_snippet(tmp_path, relpath: str, source: str,

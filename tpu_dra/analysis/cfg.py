@@ -53,7 +53,8 @@ class Node:
     """One CFG node: a simple statement, a header expression, or a
     ``with`` enter/exit event."""
 
-    __slots__ = ("kind", "ast", "items", "partner", "succs", "idx")
+    __slots__ = ("kind", "ast", "items", "partner", "succs", "exc_succs",
+                 "idx")
 
     def __init__(self, kind: str, ast_node: Optional[ast.AST] = None,
                  items: Optional[list[ast.withitem]] = None):
@@ -62,6 +63,10 @@ class Node:
         self.items = items or []
         self.partner: Optional["Node"] = None   # with_enter <-> with_exit
         self.succs: list["Node"] = []
+        # the subset of succs reached only by RAISING here.  Empty on a
+        # node that may raise means the exception leaves the function —
+        # the edge the resource-lifecycle checker flags leaks on.
+        self.exc_succs: list["Node"] = []
         self.idx = -1
 
     @property
@@ -75,6 +80,11 @@ class Node:
     def link(self, succ: "Node") -> None:
         if succ not in self.succs:
             self.succs.append(succ)
+
+    def link_exc(self, succ: "Node") -> None:
+        self.link(succ)
+        if succ not in self.exc_succs:
+            self.exc_succs.append(succ)
 
     def scan_asts(self) -> list[ast.AST]:
         """The AST subtrees that execute *at* this node (headers only for
@@ -196,7 +206,7 @@ class _Builder:
         for p in preds:
             p.link(node)
         for t in self._exc_targets():
-            node.link(t)
+            node.link_exc(t)
         return node
 
     # -- statement sequencing ---------------------------------------------
@@ -262,11 +272,11 @@ class _Builder:
                 p.link(enter)
             # acquiring may raise -> unwind to the OUTER context
             for t in self._exc_targets():
-                enter.link(t)
+                enter.link_exc(t)
             # exceptions inside the body unwind through this exit into
             # the outer context (the __exit__ release runs first)
             for t in self._exc_targets():
-                exit_node.link(t)
+                exit_node.link_exc(t)
             self.frames.append(("with", exit_node))
             body_out = self._seq(stmt.body, [enter])
             self.frames.pop()
@@ -298,7 +308,7 @@ class _Builder:
             handler_outs: list[Node] = []
             for hnode, handler in zip(handler_nodes, stmt.handlers):
                 for t in self._exc_targets():
-                    hnode.link(t)           # a handler body may re-raise
+                    hnode.link_exc(t)       # a handler body may re-raise
                 handler_outs += self._seq(handler.body, [hnode])
             if fin_head is not None:
                 self.frames.pop()                  # the "finally" frame

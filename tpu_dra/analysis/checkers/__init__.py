@@ -15,8 +15,10 @@ from tpu_dra.analysis.checkers import (  # noqa: F401
     guardedby,
     hotpath,
     jitpurity,
+    lifecycle,
     lockorder,
     metrichygiene,
     reconcile,
     retryhygiene,
+    taintflow,
 )

@@ -1,0 +1,396 @@
+"""lifecycle: a resource acquired on one line must be released on every
+path out of the function — exception edges included.
+
+The serving plane's must-release resources all follow the same
+acquire/release protocol without a context manager (the release site is
+conditional, cross-thread, or deferred): admission tickets
+(``admission.acquire`` / ``.release``), pooled router sockets
+(``_get_conn`` / ``_put_conn``/``.close``), paged-KV page allocations
+(``pool.alloc`` / ``pool.free``), flocked fds (``os.open`` /
+``os.close``), and the claim prepare/unprepare pairs
+(``prepare_settings``/``unprepare_settings``,
+``add_node_label``/``remove_node_label``,
+``start_health_heartbeat``/``stop_health_heartbeat``).  A leak on an
+exception edge is invisible to review — the happy path releases — and
+permanent at runtime: a leaked admission ticket deflates capacity until
+restart, a leaked flocked fd wedges the slot pool.
+
+Two rules, over the PR-5 CFGs with exception-edge tagging
+(``Node.exc_succs``):
+
+- **plain leak** — a tracked resource may still be held at function
+  exit (no release, no escape on some path);
+- **exception-edge leak** — a call that can raise OUT of the function
+  (no enclosing handler/finally) while a resource is held, in a
+  function that DOES release it elsewhere: the protocol exists, this
+  edge bypasses it.
+
+Escape analysis is deliberately conservative: a resource that is
+returned, yielded, stored into an attribute/container, passed to a
+non-release call (fd byte ops excepted), or captured by a nested def is
+someone else's to release and is not tracked.  ``if x is not None:
+release(x)`` guards release the resource at the test (held implies
+non-None).  Prepare/unprepare pairs only report the exception-edge rule
+— the matching release legitimately lives in another function
+(``unprepare``), but an in-function rollback must cover raising edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpu_dra.analysis import lockset
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+from tpu_dra.analysis.cfg import STMT, WITH_ENTER, build_cfg
+
+_CHECK = "lifecycle"
+
+# value resources: how an Assign's value call is classified.
+# (attr name, receiver substring or None, kind, tuple index or None)
+_ACQUIRES: tuple[tuple[str, Optional[str], str, Optional[int]], ...] = (
+    ("acquire", "admission", "admission ticket", None),
+    ("_get_conn", None, "pooled connection", 0),
+    ("alloc", "pool", "KV page allocation", 0),
+)
+# method/function names that release their receiver or first argument
+_RELEASE_NAMES = {"release", "close", "free", "_put_conn", "put_conn",
+                  "unlock"}
+# fd byte ops that do NOT take ownership (passing an fd to them is not
+# an escape — the launcher writes the pid through a flocked fd)
+_FD_OPS = {"write", "read", "ftruncate", "truncate", "set_inheritable",
+           "fstat", "lseek", "seek", "fsync", "flock", "lockf", "fchmod",
+           "pread", "pwrite", "dup"}
+
+# prepare/unprepare pairs: openers -> closers, tracked by NAME (no
+# value).  Only the exception-edge rule applies; "rollback" helpers
+# count as closers.
+_PAIRS = {
+    "prepare_settings": ("unprepare_settings",),
+    "add_node_label": ("remove_node_label",),
+    "start_health_heartbeat": ("stop_health_heartbeat",),
+}
+
+
+def _call_name(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return ""
+
+
+def _classify_acquire(call: ast.Call) -> Optional[tuple[str, Optional[int]]]:
+    """(kind, tuple-index) when ``call`` acquires a value resource."""
+    name = _call_name(call)
+    tok = lockset.token_of(call.func) or ""
+    if tok == "os.open":
+        return ("flocked fd", None)
+    for attr, recv_sub, kind, ti in _ACQUIRES:
+        if name != attr:
+            continue
+        if recv_sub is not None:
+            recv = ""
+            if isinstance(call.func, ast.Attribute):
+                recv = lockset.token_of(call.func.value) or ""
+            if recv_sub not in recv:
+                continue
+        return (kind, ti)
+    return None
+
+
+class _Resource:
+    __slots__ = ("var", "kind", "line", "released_somewhere", "is_pair")
+
+    def __init__(self, var: str, kind: str, line: int, is_pair: bool):
+        self.var = var              # local name, or opener name for pairs
+        self.kind = kind
+        self.line = line
+        self.released_somewhere = False
+        self.is_pair = is_pair
+
+
+def _assign_acquire_var(stmt) -> Optional[str]:
+    """The local acquired by ``stmt`` when it is an acquiring Assign."""
+    if not (isinstance(stmt, ast.Assign)
+            and isinstance(stmt.value, ast.Call)):
+        return None
+    cls = _classify_acquire(stmt.value)
+    if cls is None:
+        return None
+    tgt = stmt.targets[0]
+    if cls[1] is not None and isinstance(tgt, (ast.Tuple, ast.List)) \
+            and len(tgt.elts) > cls[1]:
+        tgt = tgt.elts[cls[1]]
+    return lockset.token_of(tgt)
+
+
+def _release_targets(call: ast.Call) -> set[str]:
+    """Variable tokens this call releases (receiver and first arg of a
+    release-named call)."""
+    name = _call_name(call)
+    if name not in _RELEASE_NAMES:
+        return set()
+    out: set[str] = set()
+    if isinstance(call.func, ast.Attribute):
+        tok = lockset.token_of(call.func.value)
+        if tok is not None:
+            out.add(tok)
+    if call.args:
+        tok = lockset.token_of(call.args[0])
+        if tok is not None:
+            out.add(tok)
+    return out
+
+
+def _escapes(func: ast.AST, var: str) -> bool:
+    """Conservative: the resource leaves this function's custody."""
+    def mentions(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id == var:
+                return True
+        return False
+
+    for sub in lockset.walk_scan(func):
+        if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and sub.value is not None and mentions(sub.value):
+            return True
+        if isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                        and mentions(sub.value):
+                    return True
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name in _RELEASE_NAMES or name in _FD_OPS:
+                continue
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if mentions(arg):
+                    return True
+    # captured by a nested def: released later, on someone else's path
+    for sub in ast.walk(func):
+        if sub is not func and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if mentions(sub):
+                return True
+    return False
+
+
+def _none_guard_var(test: ast.AST) -> Optional[str]:
+    """``if x is not None:`` / ``if x:`` — the guarded variable token.
+    An if/while header's CFG node carries the raw TEST expression as its
+    ast; a held resource implies a non-None truthy value, so the
+    releasing branch is the one taken and the resource dies at the
+    test (must-release soundness, not branch sensitivity)."""
+    if not isinstance(test, ast.expr):
+        return None
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.ops[0], (ast.IsNot, ast.NotEq)) and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None:
+        return lockset.token_of(test.left)
+    if isinstance(test, (ast.Name, ast.Attribute)):
+        return lockset.token_of(test)
+    return None
+
+
+def _calls_in(node) -> list[ast.Call]:
+    out = []
+    for tree in node.scan_asts():
+        for sub in lockset.walk_scan(tree):
+            if isinstance(sub, ast.Call):
+                out.append(sub)
+    return out
+
+
+def _check_function(ctx: FileContext, func: ast.AST,
+                    diags: list[Diagnostic]) -> None:
+    # ---- discover resources -------------------------------------------
+    resources: dict[str, _Resource] = {}
+    with_managed: set[int] = set()      # id() of with-item context calls
+    for sub in lockset.walk_scan(func):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            for item in sub.items:
+                for c in ast.walk(item.context_expr):
+                    with_managed.add(id(c))
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call) \
+                and id(sub.value) not in with_managed:
+            cls = _classify_acquire(sub.value)
+            if cls is None:
+                continue
+            kind, ti = cls
+            tgt = sub.targets[0]
+            if ti is not None and isinstance(tgt, (ast.Tuple, ast.List)) \
+                    and len(tgt.elts) > ti:
+                tgt = tgt.elts[ti]
+            var = lockset.token_of(tgt)
+            if var is None or "." in var:   # attr-stored: escapes
+                continue
+            resources.setdefault(var, _Resource(
+                var, kind, sub.value.lineno, is_pair=False))
+        elif isinstance(sub, ast.Call) and id(sub) not in with_managed:
+            name = _call_name(sub)
+            if name in _PAIRS:
+                resources.setdefault(name, _Resource(
+                    name, f"{name}() pairing", sub.lineno, is_pair=True))
+    if not resources:
+        return
+
+    # releases present anywhere in the function?
+    closer_names = {c for cs in _PAIRS.values() for c in cs}
+    for sub in lockset.walk_scan(func):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = _call_name(sub)
+        for var in _release_targets(sub):
+            if var in resources:
+                resources[var].released_somewhere = True
+        for opener, closers in _PAIRS.items():
+            if opener in resources and \
+                    (name in closers or "rollback" in name):
+                resources[opener].released_somewhere = True
+
+    tracked = {v: r for v, r in resources.items()
+               if r.is_pair or not _escapes(func, v)}
+    if not tracked:
+        return
+
+    # ``if x is not None: ...release(x)...`` — the test expression node
+    # kills x (held implies non-None implies the releasing branch runs)
+    guard_kills: dict[int, str] = {}
+    for sub in lockset.walk_scan(func):
+        if not isinstance(sub, (ast.If, ast.While)):
+            continue
+        var = _none_guard_var(sub.test)
+        if var is None or var not in tracked:
+            continue
+        for inner in sub.body:
+            for c in ast.walk(inner):
+                if isinstance(c, ast.Call) and var in _release_targets(c):
+                    guard_kills[id(sub.test)] = var
+                    break
+
+    # ---- dataflow: may-hold over the CFG ------------------------------
+    cache = getattr(ctx, "_flow_cache", None)
+    if cache is None:
+        cache = {}
+        ctx._flow_cache = cache
+    cfg = cache.get(id(func))
+    if cfg is None:
+        cfg = build_cfg(func)
+        cache[id(func)] = cfg
+
+    def transfer(node, held: frozenset) -> frozenset:
+        out = set(held)
+        stmt = node.ast if node.kind == STMT else None
+        if stmt is not None:
+            guard = guard_kills.get(id(stmt))
+            if guard in out:
+                out.discard(guard)
+        for call in _calls_in(node):
+            name = _call_name(call)
+            for var in _release_targets(call):
+                out.discard(var)
+            for opener, closers in _PAIRS.items():
+                if opener in out and (name in closers
+                                      or "rollback" in name):
+                    out.discard(opener)
+            if name in _PAIRS and name in tracked:
+                out.add(name)
+        var = _assign_acquire_var(stmt) if stmt is not None else None
+        if var in tracked:
+            out.add(var)
+        return frozenset(out)
+
+    instate: dict = {cfg.entry: frozenset()}
+    worklist = [cfg.entry]
+    budget = 20 * len(cfg.nodes) + 100
+    outstate: dict = {}
+    while worklist and budget > 0:
+        budget -= 1
+        node = worklist.pop()
+        held = instate.get(node)
+        if held is None:
+            continue
+        out = transfer(node, held)
+        outstate[node] = out
+        # the acquiring statement's OWN exception edge predates the
+        # binding (``fd = os.open(...)`` raising means there is no fd):
+        # exception successors see the pre-acquisition state
+        stmt = node.ast if node.kind == STMT else None
+        acq = _assign_acquire_var(stmt) if stmt is not None else None
+        exc_out = frozenset(out - {acq}) if acq in out else out
+        for succ in node.succs:
+            flow = exc_out if succ in node.exc_succs else out
+            cur = instate.get(succ)
+            new = flow if cur is None else (cur | flow)
+            if cur is None or new != cur:
+                instate[succ] = new
+                worklist.append(succ)
+
+    # ---- rule 1: plain leak (held at exit) ----------------------------
+    for var in instate.get(cfg.exit, frozenset()):
+        r = tracked.get(var)
+        if r is None or r.is_pair:
+            continue
+        diags.append(ctx.diag(
+            r.line, _CHECK,
+            f"{r.kind} `{var}` may never be released on some path to "
+            f"function exit — release it in a finally (or hand it off "
+            f"explicitly)"))
+
+    # ---- rule 2: exception-edge leak ----------------------------------
+    reported: set[tuple] = set()
+    for node in cfg.nodes:
+        held = instate.get(node)
+        if not held or node.exc_succs or node.kind == WITH_ENTER:
+            continue
+        # inside a with: the with-exit edge is the exception route and
+        # exc_succs on the statement node carries it, so exc_succs == []
+        # really means "raises straight out of the function"
+        calls = _calls_in(node)
+        if not calls:
+            continue
+        # a node that itself releases the resource is the protocol, not
+        # the leak (and the in-state of the acquiring node predates the
+        # acquisition, so that node never reports its own resource)
+        released_here: set[str] = set()
+        for call in calls:
+            name = _call_name(call)
+            released_here |= _release_targets(call)
+            for opener, closers in _PAIRS.items():
+                if name in closers or "rollback" in name:
+                    released_here.add(opener)
+        for var in sorted(held - frozenset(released_here)):
+            r = tracked.get(var)
+            if r is None or not r.released_somewhere:
+                continue
+            key = (node.line, var)
+            if key in reported:
+                continue
+            reported.add(key)
+            diags.append(ctx.diag(
+                node.line, _CHECK,
+                f"a raise here leaves the function with {r.kind} "
+                f"`{var}` (acquired at line {r.line}) still held — no "
+                f"enclosing handler or finally releases it",
+                col=0))
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test():
+        return []
+    diags: list[Diagnostic] = []
+    for func, _cls in lockset.functions_in(ctx.tree):
+        _check_function(ctx, func, diags)
+    return diags
+
+
+register(Analyzer(
+    name=_CHECK,
+    doc="must-release resources (admission tickets, pooled sockets, KV "
+        "page allocations, flocked fds, prepare/unprepare pairs) are "
+        "released on every path out of the function, exception edges "
+        "included (CFG dataflow with exception-edge tagging)",
+    run=_run,
+))

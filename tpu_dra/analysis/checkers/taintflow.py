@@ -1,0 +1,59 @@
+"""taint-flow: untrusted input must pass a declared sanitizer before a
+privileged sink.
+
+The mechanized form of the review rule every recent pass applied by
+hand (PR 14's crafted handoff blob, client-asserted ``prompt_len``
+pricing admission, client-chosen metric labels): data from a declared
+trust boundary — HTTP request bytes, pre-validation KV handoff blobs,
+claim opaque-config dicts, externally-writable ``TPU_*`` env vars —
+must flow through one of the repo's real validators before it reaches a
+privileged operation (subprocess/exec, filesystem paths, CDI env
+injection, metric labels, admission cost, the jit-stepping batcher
+entry).  The source/sink/sanitizer catalogs live in
+:mod:`tpu_dra.analysis.taint`; the hostile-input fuzz lane
+(``hack/drive_hostile.py``) probes the same sink catalog dynamically.
+
+Findings carry the full source→sink flow (SARIF ``codeFlows``).  The
+per-flow suppression is ``# vet: sanitized[<sink-kind>]`` ON THE SINK
+LINE, for validation the engine cannot see (a conditional membership
+test, a caller-side contract) — justify it in the same comment.  Plain
+``# vet: ignore[taint-flow]`` also works but spends the generic ignore
+budget; prefer the typed form, which ratchets per sink kind.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.analysis import taint
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_CHECK = "taint-flow"
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.program is None:
+        return []
+    _taints, findings = taint.taints_of(ctx.program)
+    diags: list[Diagnostic] = []
+    for f in findings:
+        if f.path != ctx.path:
+            continue
+        if ctx.sanitized_on(f.line, f.sink):
+            continue
+        diags.append(Diagnostic(
+            f.path, f.line, f.col, _CHECK,
+            f"{f.message} (suppress a vetted flow with "
+            f"`# vet: sanitized[{f.sink}]` + justification)",
+            flow=f.flow))
+    return diags
+
+
+register(Analyzer(
+    name=_CHECK,
+    doc="untrusted input (HTTP bytes, handoff blobs, opaque configs, "
+        "external env) must pass a declared sanitizer before a "
+        "privileged sink (exec, fs paths, CDI env, metric labels, "
+        "admission cost, jit entries) — interprocedural, with full "
+        "source→sink flows",
+    run=_run,
+    whole_program=True,
+))

@@ -50,6 +50,16 @@ _IGNORE_RE = re.compile(r"#\s*vet:\s*ignore(?:\[([^\]]*)\])?")
 # by the guarded-by checker.
 _HOLDS_RE = re.compile(r"#\s*vet:\s*holds\[([^\]]*)\]")
 
+# The ``sanitized[sink-kind]`` vet marker on a taint sink line: the
+# flow into this sink is validated by means the engine cannot see (a
+# conditional membership test, a caller-side contract) — the per-FLOW
+# suppression the taint checker honors, counted separately from
+# ``ignore`` in the suppression ratchet (``sanitized:<kind>`` keys in
+# vet-baseline.json).  Justification goes in the same comment, after
+# the bracket.  (Spelled without its leading marker here so this very
+# comment does not count in the ratchet.)
+_SANITIZED_RE = re.compile(r"#\s*vet:\s*sanitized\[([^\]]*)\]")
+
 
 @dataclass(frozen=True)
 class Diagnostic:
@@ -60,14 +70,25 @@ class Diagnostic:
     col: int
     check: str
     message: str
+    # source -> sink step list for flow findings (taint): tuples of
+    # (path, line, description), rendered as SARIF codeFlows so the CI
+    # annotation shows the whole path, not just the sink line
+    flow: tuple = ()
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.check}] " \
-               f"{self.message}"
+        out = f"{self.path}:{self.line}:{self.col}: [{self.check}] " \
+              f"{self.message}"
+        for path, line, desc in self.flow:
+            out += f"\n    {path}:{line}: {desc}"
+        return out
 
     def to_dict(self) -> dict:
-        return {"path": self.path, "line": self.line, "col": self.col,
-                "check": self.check, "message": self.message}
+        d = {"path": self.path, "line": self.line, "col": self.col,
+             "check": self.check, "message": self.message}
+        if self.flow:
+            d["flow"] = [{"path": p, "line": ln, "message": m}
+                         for p, ln, m in self.flow]
+        return d
 
 
 class FileContext:
@@ -83,6 +104,7 @@ class FileContext:
         self.comments: dict[int, str] = {}
         self.suppressions: dict[int, set[str]] = {}
         self.holds: dict[int, list[str]] = {}
+        self.sanitized: dict[int, set[str]] = {}
         # the whole-program layer; run_paths attaches it after every
         # file has parsed (None for contexts built outside the driver)
         self.program = None
@@ -102,15 +124,18 @@ class FileContext:
                 if m:
                     names = {"*"} if m.group(1) is None else {
                         n.strip() for n in m.group(1).split(",") if n.strip()}
-                    target = line
-                    # a comment alone on its line suppresses the next line
-                    if self.is_comment_line(line):
-                        target = line + 1
-                    self.suppressions.setdefault(target, set()).update(names)
+                    self.suppressions.setdefault(
+                        self._anno_target(line), set()).update(names)
                 h = _HOLDS_RE.search(tok.string)
                 if h:
                     self.holds[line] = [
                         n.strip() for n in h.group(1).split(",") if n.strip()]
+                s = _SANITIZED_RE.search(tok.string)
+                if s:
+                    kinds = {k.strip() for k in s.group(1).split(",")
+                             if k.strip()}
+                    self.sanitized.setdefault(
+                        self._anno_target(line), set()).update(kinds)
         except (tokenize.TokenError, SyntaxError):
             pass  # a parseable file that won't tokenize cleanly is rare
             # (3.12's C tokenizer raises SyntaxError); analyzers still
@@ -123,6 +148,17 @@ class FileContext:
         text = self.lines[line - 1] if 1 <= line <= len(self.lines) else ""
         return text.lstrip().startswith("#")
 
+    def _anno_target(self, line: int) -> int:
+        """The code line an annotation on ``line`` applies to: the line
+        itself (trailing comment) or the next non-comment line — a
+        justification may span a comment BLOCK above its target."""
+        if not self.is_comment_line(line):
+            return line
+        target = line + 1
+        while self.is_comment_line(target):
+            target += 1
+        return target
+
     def suppressed(self, line: int, check: str) -> bool:
         names = self.suppressions.get(line)
         return bool(names) and ("*" in names or check in names)
@@ -132,6 +168,12 @@ class FileContext:
 
     def holds_on(self, line: int) -> list[str]:
         return self.holds.get(line, [])
+
+    def sanitized_on(self, line: int, kind: str) -> bool:
+        """True when the line carries ``# vet: sanitized[kind]`` (or a
+        ``*`` wildcard) — the per-flow taint suppression."""
+        kinds = self.sanitized.get(line)
+        return bool(kinds) and ("*" in kinds or kind in kinds)
 
     # -- path scoping ---------------------------------------------------
     def in_dir(self, *prefixes: str) -> bool:
@@ -309,12 +351,20 @@ def count_suppressions(paths: Iterable[str]) -> dict[str, int]:
                 if tok.type != tokenize.COMMENT:
                     continue
                 m = _IGNORE_RE.search(tok.string)
-                if not m:
-                    continue
-                names = {"*"} if m.group(1) is None else {
-                    n.strip() for n in m.group(1).split(",") if n.strip()}
-                for name in names:
-                    counts[name] = counts.get(name, 0) + 1
+                if m:
+                    names = {"*"} if m.group(1) is None else {
+                        n.strip() for n in m.group(1).split(",") if n.strip()}
+                    for name in names:
+                        counts[name] = counts.get(name, 0) + 1
+                s = _SANITIZED_RE.search(tok.string)
+                if s:
+                    # sanitized[] suppressions ratchet under their own
+                    # ``sanitized:<kind>`` keys so taint suppressions
+                    # can't hide inside the plain-ignore budget.
+                    for kind in {k.strip() for k in s.group(1).split(",")
+                                 if k.strip()}:
+                        key = f"sanitized:{kind}"
+                        counts[key] = counts.get(key, 0) + 1
         # 3.12's C tokenizer raises SyntaxError (IndentationError
         # included) where older ones raised TokenError
         except (UnicodeDecodeError, SyntaxError, tokenize.TokenError):

@@ -35,6 +35,18 @@ def render_json(diags: list[Diagnostic]) -> str:
     }, indent=2, sort_keys=True)
 
 
+def _flow_location(path: str, line: int, desc: str) -> dict:
+    """One SARIF location for a taint-flow step (codeFlows and
+    relatedLocations share the shape)."""
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path, "uriBaseId": "SRCROOT"},
+            "region": {"startLine": max(1, line)},
+        },
+        "message": {"text": desc},
+    }
+
+
 def render_sarif(diags: list[Diagnostic],
                  analyzers: list[Analyzer]) -> str:
     """SARIF 2.1.0: one run, one rule per registered analyzer, one result
@@ -49,19 +61,34 @@ def render_sarif(diags: list[Diagnostic],
     rules += [{"id": name,
                "shortDescription": {"text": "driver-synthesized finding"}}
               for name in extra]
-    results = [{
-        "ruleId": d.check,
-        "level": "error",
-        "message": {"text": d.message},
-        "locations": [{
-            "physicalLocation": {
-                "artifactLocation": {"uri": d.path,
-                                     "uriBaseId": "SRCROOT"},
-                "region": {"startLine": max(1, d.line),
-                           "startColumn": d.col + 1},
-            },
-        }],
-    } for d in diags]
+    results = []
+    for d in diags:
+        result = {
+            "ruleId": d.check,
+            "level": "error",
+            "message": {"text": d.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": d.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, d.line),
+                               "startColumn": d.col + 1},
+                },
+            }],
+        }
+        if d.flow:
+            # taint findings carry the whole source→sink path: SARIF
+            # codeFlows renders each step in sequence on the PR diff,
+            # relatedLocations makes every step a clickable anchor
+            steps = [_flow_location(path, line, desc)
+                     for path, line, desc in d.flow]
+            result["codeFlows"] = [{
+                "threadFlows": [{
+                    "locations": [{"location": loc} for loc in steps],
+                }],
+            }]
+            result["relatedLocations"] = steps
+        results.append(result)
     return json.dumps({
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,

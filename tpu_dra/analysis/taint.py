@@ -1,0 +1,794 @@
+"""Interprocedural trust-boundary taint analysis.
+
+Every recent review pass found the same bug shape by hand: bytes from an
+untrusted boundary reaching a privileged operation without passing the
+validator that exists for it — PR 14's crafted handoff blob killing the
+batcher thread, client-asserted ``prompt_len`` pricing admission,
+client-chosen strings growing metric cardinality.  This module turns
+that review rule into dataflow over the same whole-program layer the
+effect summaries use (:mod:`tpu_dra.analysis.callgraph`): per-function
+forward taint propagation over the PR-5 CFGs, composed bottom-up per SCC
+like :func:`tpu_dra.analysis.effects.solve`.
+
+The model is three declared catalogs (the ``taint-flow`` checker and the
+hostile-input fuzz lane ``hack/drive_hostile.py`` are both pinned to
+them, so the static and dynamic halves cannot drift):
+
+- :data:`SOURCES` — trust boundaries.  HTTP request objects/headers/
+  bodies in the serve/router handlers, ``kv_handoff.decode_blob``
+  results pre-validation, claim opaque-config dicts (``api.decoder
+  .decode`` results and ``from_dict`` inputs), and reads of ``TPU_*``
+  env vars the PR-12 contract registry marks external;
+- :data:`SINKS` — privilege points.  subprocess/exec, filesystem paths,
+  CDI ``edits.env`` injection, metric label values, admission cost
+  arithmetic, and the jit-stepping batcher entry points;
+- :data:`SANITIZERS` — the repo's REAL validators.  A call through one
+  returns untainted data; ``validate_handoff(x)`` / ``x.validate()``
+  statements additionally clear their argument/receiver in place.
+
+Propagation is deliberately syntactic, matching the callgraph's honesty
+rules: labels are dotted-token keyed; a call that resolves in-project
+maps the callee's summary (return labels, parameter→sink reachability)
+through the argument list; an unresolved call conservatively returns
+the union of its argument and receiver taints (untrusted data does not
+launder itself through an unknown helper).  Nested defs — the serve/
+router handler methods live inside ``make_handler`` — are analyzed
+standalone after the callgraph pass, exactly like lockset analysis.
+
+Findings carry the full source→sink flow (rendered as SARIF
+``codeFlows``); the per-flow suppression is ``# vet: sanitized[kind]``
+on the sink line, ratcheted separately in vet-baseline.json as
+``sanitized:<kind>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import NamedTuple, Optional
+
+from tpu_dra.analysis import contracts, lockset
+from tpu_dra.analysis.cfg import STMT, WITH_ENTER, build_cfg
+from tpu_dra.analysis.effects import _sccs
+
+__all__ = [
+    "SOURCES",
+    "SINKS",
+    "SANITIZERS",
+    "Finding",
+    "FuncTaint",
+    "solve",
+    "taints_of",
+]
+
+# ---------------------------------------------------------------------------
+# The declared trust model.  docs/static-analysis.md documents how to add
+# entries; tests/test_hostile_completeness.py pins every SINK kind to a
+# probe in hack/drive_hostile.py.
+
+SOURCES: dict[str, str] = {
+    "http-request": "HTTP request bytes in the serve/router handlers "
+                    "(self.headers/path/rfile and decoded req bodies)",
+    "handoff-blob": "a /prefill KV handoff blob before shape validation "
+                    "(kv_handoff.decode_blob results, submit_handoff's "
+                    "handoff argument)",
+    "opaque-config": "a claim opaque-config dict on the kubelet plugin "
+                     "path (api.decoder.decode results, from_dict "
+                     "inputs) before .validate()",
+    "env-external": "a TPU_* environment variable the contract registry "
+                    "declares externally writable",
+}
+
+SINKS: dict[str, str] = {
+    "exec": "subprocess/exec argument in the launcher/daemon",
+    "fs-path": "a filesystem path under the checkpoint/CDI/heartbeat "
+               "roots (open, makedirs, remove, rmtree, atomic_write)",
+    "cdi-env": "CDI container-edit env injection (edits.env[...] = ...)",
+    "metric-label": "a metric label value (unbounded client strings grow "
+                    "series cardinality without limit)",
+    "admission-cost": "the cost argument of admission.acquire() (client-"
+                      "asserted numbers price their own admission)",
+    "jit-entry": "a jax.jit-ed entry point (the batcher request queue, "
+                 "prefill scatter) — a shape-lying payload aborts the "
+                 "stepping thread",
+}
+
+# call names (last dotted component) whose RESULT is trusted: the repo's
+# real validators.  A sanitizer must REJECT or CLAMP, not merely copy.
+SANITIZERS: dict[str, str] = {
+    "bounded_label": "util.metrics.bounded_label — the shared label-"
+                     "cardinality cap (known-set or counted modes)",
+    "tenant_label": "ServeMetrics.tenant_label — X-Tenant cap via "
+                    "bounded_label",
+    "_path_label": "serve/router path collapse onto the known-path set "
+                   "via bounded_label",
+    "peek_prompt_len": "kv_handoff.peek_prompt_len — server-derived "
+                       "prompt length from the blob header",
+    "request_cost": "serve.request_cost — admission cost clamped from "
+                    "server-side parameters",
+    "handoff_cost": "serve.handoff_cost — admission cost priced from "
+                    "peek_prompt_len, never the client's claim",
+    "parse_topology": "topology string parsed into checked integers",
+    "validate_handoff": "kv_handoff.validate_handoff — the full shape/"
+                        "dims/page contract from submit_handoff",
+    "parse_deadline_ms": "deadline header parsed into a clamped number",
+}
+
+# statement-position sanitizers that clear their FIRST ARGUMENT in place
+# (``validate_handoff(h, ...)`` raises on bad input, so `h` is trusted
+# on the fall-through edge); ``x.validate()`` clears its receiver the
+# same way.
+_CLEARING_CALLS = {"validate_handoff"}
+_CLEARING_METHODS = {"validate"}
+
+# (path suffix, function name or None=any, param name, source kind):
+# parameters that are tainted at entry by declaration — the trust
+# boundary where the callgraph cannot see the caller (HTTP dispatch,
+# the decoder registry).
+TAINTED_PARAMS: tuple[tuple[str, Optional[str], str, str], ...] = (
+    ("workloads/serve.py", None, "req", "http-request"),
+    ("workloads/router.py", None, "req", "http-request"),
+    ("workloads/continuous.py", "submit_handoff", "handoff",
+     "handoff-blob"),
+    ("api/configs.py", "from_dict", "data", "opaque-config"),
+)
+
+# attribute reads that ARE the http boundary, inside the handler files
+_HTTP_FILES = ("workloads/serve.py", "workloads/router.py")
+_HTTP_TOKENS = ("self.headers", "self.path", "self.rfile",
+                "self.requestline", "self.command")
+
+# call names that RETURN tainted data
+_SOURCE_CALLS = {
+    "decode_blob": "handoff-blob",     # kv_handoff.decode_blob
+    "decode": "opaque-config",         # api.decoder.decode (see below)
+    "decode_all": "opaque-config",
+}
+# `decode` is a common name (workloads/decode.py); only the opaque-
+# config decoder counts.  Accept the bare name when it resolves into
+# api/decoder.py or is written module-qualified.
+_OPAQUE_DECODER_SUFFIX = "api/decoder.py"
+
+_SUBPROCESS_FNS = {"run", "Popen", "call", "check_call", "check_output"}
+
+# int()/float()/len() casts launder STRING-shaped attacks (a number
+# cannot name a path, smuggle an argv, or carry a CDI payload) but not
+# NUMERIC ones (a client-chosen number still prices admission and
+# still reaches jit entries) — labels passing through a cast are
+# wrapped ("num", label) and shape-based sinks ignore them.  sum() is
+# included on this repo's usage (token counts); summing lists of
+# attacker strings back into a string sink would evade this.
+_NUMERIC_CASTS = {"int", "float", "bool", "len", "sum"}
+_SHAPE_SINKS = {"exec", "fs-path", "cdi-env", "metric-label"}
+_FS_FNS = {"open", "makedirs", "remove", "replace", "unlink", "rmdir",
+           "rmtree", "atomic_write"}
+_METRIC_METHODS = {"inc", "observe", "set"}
+
+_CHAIN_CAP = 6          # flow steps kept per finding
+_PARAM_HIT_CAP = 3      # sink hits remembered per parameter
+_FINDING_CAP = 40       # findings kept per function
+
+
+class Finding(NamedTuple):
+    """One concrete source→sink flow, ready to become a Diagnostic."""
+    path: str
+    line: int
+    col: int
+    sink: str           # SINKS kind
+    source: str         # SOURCES kind
+    message: str
+    flow: tuple         # ((path, line, message), ...) source → sink
+
+
+class SinkHit(NamedTuple):
+    """A sink reachable from a PARAMETER of the summarized function —
+    the half of a flow waiting for a caller to supply the source."""
+    sink: str
+    detail: str
+    path: str
+    line: int
+    col: int
+    steps: tuple        # flow steps from function entry to the sink
+
+
+class FuncTaint:
+    """Taint summary of one function."""
+
+    __slots__ = ("params", "ret", "param_sinks", "findings")
+
+    def __init__(self, params: tuple[str, ...] = ()):
+        self.params = params
+        self.ret: frozenset = frozenset()
+        # param name -> [SinkHit, ...] (capped)
+        self.param_sinks: dict[str, list[SinkHit]] = {}
+        self.findings: list[Finding] = []
+
+    def fingerprint(self) -> tuple:
+        return (self.ret,
+                tuple(sorted((p, len(h))
+                             for p, h in self.param_sinks.items())),
+                len(self.findings))
+
+
+def _path_matches(path: str, suffix: str) -> bool:
+    return path == suffix or path.endswith("/" + suffix)
+
+
+def _is_http_file(path: str) -> bool:
+    return any(_path_matches(path, s) for s in _HTTP_FILES)
+
+
+def _src_label(kind: str, path: str, node: ast.AST, detail: str) -> tuple:
+    return ("src", kind, path, getattr(node, "lineno", 0), detail)
+
+
+def _numeric(lab: tuple) -> tuple:
+    return lab if lab[0] == "num" else ("num", lab)
+
+
+def _src_step(label: tuple) -> tuple:
+    _, kind, path, line, detail = label
+    return (path, line, f"{detail} ({kind} source)")
+
+
+def _cfg_for(ctx, func):
+    """The per-function CFG, shared with the lockset engine's cache."""
+    cache = getattr(ctx, "_flow_cache", None)
+    if cache is None:
+        cache = {}
+        ctx._flow_cache = cache
+    cfg = cache.get(id(func))
+    if cfg is None:
+        cfg = build_cfg(func)
+        cache[id(func)] = cfg
+    return cfg
+
+
+def _param_names(func: ast.AST) -> tuple[str, ...]:
+    a = func.args
+    names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    if a.vararg is not None:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg is not None:
+        names.append(a.kwarg.arg)
+    return tuple(names)
+
+
+def _positional_params(func_params: tuple[str, ...]) -> list[str]:
+    """Positional mapping for a call: drop a leading self/cls."""
+    ps = list(func_params)
+    if ps and ps[0] in ("self", "cls"):
+        ps = ps[1:]
+    return ps
+
+
+class _FuncAnalysis:
+    """One forward may-taint pass over one function's CFG."""
+
+    def __init__(self, ctx, func: ast.AST, cls: Optional[str],
+                 taints: dict, resolve):
+        self.ctx = ctx
+        self.func = func
+        self.cls = cls
+        self.taints = taints        # qualname -> FuncTaint (callee lookup)
+        self.resolve = resolve      # (dotted) -> qualname or None
+        self.out = FuncTaint(_param_names(func))
+        self._seen_findings: set[tuple] = set()
+        self._entry = self._entry_state()
+
+    # -- entry --------------------------------------------------------------
+    def _entry_state(self) -> dict[str, frozenset]:
+        state: dict[str, frozenset] = {}
+        for name in self.out.params:
+            labels = {("param", name)}
+            for suffix, fname, pname, kind in TAINTED_PARAMS:
+                if pname == name and _path_matches(self.ctx.path, suffix) \
+                        and (fname is None or fname == self.func.name):
+                    labels.add(_src_label(
+                        kind, self.ctx.path, self.func,
+                        f"parameter `{name}` of {self.func.name}()"))
+            state[name] = frozenset(labels)
+        return state
+
+    # -- lattice ------------------------------------------------------------
+    @staticmethod
+    def _join(a: dict, b: dict) -> dict:
+        out = dict(a)
+        for k, v in b.items():
+            cur = out.get(k)
+            out[k] = v if cur is None else (cur | v)
+        return out
+
+    @staticmethod
+    def _lookup(state: dict, tok: str) -> frozenset:
+        out = frozenset()
+        t = tok
+        while True:
+            s = state.get(t)
+            if s:
+                out |= s
+            i = t.rfind(".")
+            if i < 0:
+                return out
+            t = t[:i]
+
+    # -- expression evaluation ----------------------------------------------
+    def _eval(self, expr: ast.AST, state: dict) -> frozenset:
+        if isinstance(expr, (ast.Constant, ast.Lambda)):
+            return frozenset()
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return frozenset()
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            tok = lockset.token_of(expr)
+            if tok is None:
+                # e.g. call().attr — evaluate the innermost value
+                if isinstance(expr, ast.Attribute):
+                    return self._eval(expr.value, state)
+                return frozenset()
+            out = self._lookup(state, tok)
+            if _is_http_file(self.ctx.path):
+                for h in _HTTP_TOKENS:
+                    if tok == h or tok.startswith(h + "."):
+                        out |= {_src_label("http-request", self.ctx.path,
+                                           expr, h)}
+                        break
+            return out
+        if isinstance(expr, ast.Subscript):
+            base = self._eval(expr.value, state)
+            base |= self._env_subscript(expr)
+            return base | self._eval(expr.slice, state)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, state)
+        # generic: union over child expressions (BinOp, BoolOp, Compare,
+        # f-strings, displays, comprehensions, IfExp, Starred, ...)
+        out = frozenset()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.comprehension,
+                                  ast.keyword)):
+                if isinstance(child, ast.comprehension):
+                    out |= self._eval(child.iter, state)
+                elif isinstance(child, ast.keyword):
+                    out |= self._eval(child.value, state)
+                else:
+                    out |= self._eval(child, state)
+        return out
+
+    def _env_subscript(self, expr: ast.Subscript) -> frozenset:
+        base_tok = lockset.token_of(expr.value)
+        if base_tok is None or not base_tok.endswith("environ"):
+            return frozenset()
+        if isinstance(expr.slice, ast.Constant) and \
+                isinstance(expr.slice.value, str) and \
+                expr.slice.value in contracts.EXTERNAL_ENV:
+            return frozenset({_src_label("env-external", self.ctx.path,
+                                         expr, expr.slice.value)})
+        return frozenset()
+
+    def _env_call(self, call: ast.Call, dotted: str) -> frozenset:
+        last = dotted.rsplit(".", 1)[-1]
+        is_env = (last == "getenv"
+                  or (last == "get" and ".environ." in "." + dotted))
+        if not is_env or not call.args:
+            return frozenset()
+        name = call.args[0]
+        if isinstance(name, ast.Constant) and isinstance(name.value, str) \
+                and name.value in contracts.EXTERNAL_ENV:
+            return frozenset({_src_label("env-external", self.ctx.path,
+                                         call, name.value)})
+        return frozenset()
+
+    def _eval_call(self, call: ast.Call, state: dict) -> frozenset:
+        dotted = lockset.token_of(call.func)
+        arg_labels = [self._eval(a, state) for a in call.args]
+        kw_labels = {kw.arg: self._eval(kw.value, state)
+                     for kw in call.keywords}
+        # a call classified as a sink contributes its CLASSIFICATION,
+        # not its implementation: don't also descend into its summary
+        # (the same flow would be reported twice, once per location)
+        is_sink = self._check_call_sinks(call, dotted, arg_labels,
+                                         kw_labels, state)
+
+        if dotted is None:
+            # call through an arbitrary expression: evaluate it, union
+            # everything (the callee is unknown)
+            out = self._eval(call.func, state)
+            for al in arg_labels:
+                out |= al
+            for kl in kw_labels.values():
+                out |= kl
+            return out
+
+        last = dotted.rsplit(".", 1)[-1]
+        if last in SANITIZERS:
+            return frozenset()
+        if dotted in _NUMERIC_CASTS:
+            out = frozenset()
+            for al in arg_labels:
+                out |= al
+            return frozenset(_numeric(lab) for lab in out)
+
+        env = self._env_call(call, dotted)
+        if env:
+            return env
+
+        resolved = self.resolve(dotted)
+        if last in _SOURCE_CALLS:
+            kind = _SOURCE_CALLS[last]
+            decoder = (last != "decode"
+                       or "." in dotted
+                       or (resolved is not None and _path_matches(
+                           resolved.split("::", 1)[0],
+                           _OPAQUE_DECODER_SUFFIX)))
+            if decoder:
+                return frozenset({_src_label(kind, self.ctx.path, call,
+                                             f"{dotted}() result")})
+
+        if resolved is not None and not is_sink:
+            t = self.taints.get(resolved)
+            if t is not None:
+                return self._apply_summary(call, dotted, resolved, t,
+                                           arg_labels, kw_labels)
+
+        # unresolved: taint in, taint out (receiver included)
+        out = frozenset()
+        recv = call.func
+        if isinstance(recv, ast.Attribute):
+            out |= self._eval(recv.value, state)
+        for al in arg_labels:
+            out |= al
+        for kl in kw_labels.values():
+            out |= kl
+        return out
+
+    # -- interprocedural composition ----------------------------------------
+    def _apply_summary(self, call: ast.Call, dotted: str, qual: str,
+                       t: FuncTaint, arg_labels: list,
+                       kw_labels: dict) -> frozenset:
+        # map callee param name -> labels flowing in at this call
+        pos = _positional_params(t.params)
+        flowing: dict[str, frozenset] = {}
+        for i, al in enumerate(arg_labels):
+            if i < len(pos):
+                flowing[pos[i]] = al
+        for name, kl in kw_labels.items():
+            if name is not None and name in t.params:
+                flowing[name] = kl
+
+        short = qual.split("::", 1)[-1]
+        call_step = (self.ctx.path, call.lineno, f"into {short}()")
+
+        # a tainted argument reaching a sink inside the callee
+        for pname, hits in t.param_sinks.items():
+            labels = flowing.get(pname)
+            if not labels:
+                continue
+            for hit in hits:
+                steps = ((call_step,) + hit.steps)[-_CHAIN_CAP:]
+                for lab in labels:
+                    if lab[0] == "num":
+                        if hit.sink in _SHAPE_SINKS:
+                            continue
+                        lab = lab[1]
+                    if lab[0] == "src":
+                        self._finding(lab, hit.sink, hit.detail, hit.path,
+                                      hit.line, hit.col,
+                                      (_src_step(lab),) + steps)
+                    else:
+                        self._param_hit(lab[1], SinkHit(
+                            hit.sink, hit.detail, hit.path, hit.line,
+                            hit.col, steps))
+
+        # the callee's return labels, with its params substituted
+        out = frozenset()
+        for lab in t.ret:
+            wrap = lab[0] == "num"
+            inner = lab[1] if wrap else lab
+            if inner[0] == "src":
+                subst = frozenset({inner})
+            else:
+                subst = flowing.get(inner[1], frozenset())
+            if wrap:
+                subst = frozenset(_numeric(x) for x in subst)
+            out |= subst
+        return out
+
+    # -- sinks --------------------------------------------------------------
+    def _check_call_sinks(self, call: ast.Call, dotted: Optional[str],
+                          arg_labels: list, kw_labels: dict,
+                          state: dict) -> bool:
+        """Sink-classify this call; True when it IS a declared sink
+        (tainted or not) — such calls are not descended into."""
+        if dotted is None:
+            return False
+        parts = dotted.split(".")
+        last = parts[-1]
+
+        def hit(kind: str, detail: str, labels: frozenset) -> None:
+            self._sink(kind, detail, call, labels)
+
+        if (parts[0] == "subprocess" and last in _SUBPROCESS_FNS) \
+                or (last.startswith("exec") and parts[0] == "os"):
+            if arg_labels:
+                hit("exec", f"{dotted}() argv", arg_labels[0])
+            for name in ("args", "cmd"):
+                if name in kw_labels:
+                    hit("exec", f"{dotted}() argv", kw_labels[name])
+            return True
+        if last in _FS_FNS and (last != "open" or dotted == "open"
+                                or parts[0] == "os"):
+            if arg_labels:
+                hit("fs-path", f"{dotted}() path", arg_labels[0])
+            return True
+        if last in _METRIC_METHODS and isinstance(call.func,
+                                                  ast.Attribute):
+            # label values travel positionally (Counter.inc(*labels),
+            # ServeMetrics.observe(path, code, dur)); `by`/amount
+            # keywords are numeric
+            for al in arg_labels:
+                hit("metric-label", f".{last}() label value", al)
+            return True
+        if last == "acquire" and isinstance(call.func, ast.Attribute):
+            recv = lockset.token_of(call.func.value) or ""
+            if "admission" in recv:
+                cost = None
+                if len(arg_labels) >= 2:
+                    cost = arg_labels[1]
+                if "cost" in kw_labels:
+                    cost = (cost or frozenset()) | kw_labels["cost"]
+                if cost:
+                    hit("admission-cost", f"{recv}.acquire() cost", cost)
+                return True
+            return False
+        if dotted.endswith("_pending.append") or last == "scatter_prefill":
+            for al in arg_labels:
+                hit("jit-entry", f"{dotted}()", al)
+            return True
+        return False
+
+    def _sink(self, kind: str, detail: str, node: ast.AST,
+              labels: frozenset) -> None:
+        if not labels:
+            return
+        path, line = self.ctx.path, node.lineno
+        col = getattr(node, "col_offset", 0)
+        sink_step = (path, line, f"{detail} ({kind} sink)")
+        for lab in labels:
+            if lab[0] == "num":
+                if kind in _SHAPE_SINKS:
+                    continue        # a number cannot carry this attack
+                lab = lab[1]
+            if lab[0] == "src":
+                self._finding(lab, kind, detail, path, line, col,
+                              (_src_step(lab), sink_step))
+            else:
+                self._param_hit(lab[1], SinkHit(kind, detail, path, line,
+                                                col, (sink_step,)))
+
+    def _finding(self, src_label: tuple, sink: str, detail: str,
+                 path: str, line: int, col: int, flow: tuple) -> None:
+        source = src_label[1]
+        key = (path, line, sink, source)
+        if key in self._seen_findings or \
+                len(self.out.findings) >= _FINDING_CAP:
+            return
+        self._seen_findings.add(key)
+        self.out.findings.append(Finding(
+            path, line, col, sink, source,
+            f"{source} data reaches {detail} without a declared "
+            f"sanitizer — {SINKS[sink]}",
+            flow[-_CHAIN_CAP:]))
+
+    def _param_hit(self, pname: str, hit: SinkHit) -> None:
+        hits = self.out.param_sinks.setdefault(pname, [])
+        if len(hits) >= _PARAM_HIT_CAP or \
+                any(h.sink == hit.sink and h.path == hit.path and
+                    h.line == hit.line for h in hits):
+            return
+        hits.append(hit)
+
+    # -- transfer -----------------------------------------------------------
+    def _assign(self, target: ast.AST, labels: frozenset,
+                state: dict) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, labels, state)
+            return
+        if isinstance(target, ast.Starred):
+            self._assign(target.value, labels, state)
+            return
+        if isinstance(target, ast.Subscript):
+            # d[k] = v taints d; edits.env[...] = ... is the cdi sink
+            base_tok = lockset.token_of(target.value)
+            if base_tok is not None and base_tok.endswith(".env"):
+                key_labels = self._eval(target.slice, state)
+                self._sink("cdi-env", f"{base_tok}[...] assignment",
+                           target, labels | key_labels)
+            if base_tok is not None:
+                state[base_tok] = self._lookup(state, base_tok) | labels
+            return
+        tok = lockset.token_of(target)
+        if tok is not None:
+            state[tok] = labels
+
+    def _assign_value(self, target: ast.AST, value: ast.AST,
+                      state: dict) -> None:
+        """Assign with structure: ``code, body = 500, dumps(err)`` keeps
+        ``code`` clean — a same-arity tuple/tuple assign pairs
+        elementwise instead of spreading every value label to every
+        target."""
+        if isinstance(target, (ast.Tuple, ast.List)) and \
+                isinstance(value, (ast.Tuple, ast.List)) and \
+                len(target.elts) == len(value.elts) and \
+                not any(isinstance(e, ast.Starred) for e in target.elts):
+            for t_el, v_el in zip(target.elts, value.elts):
+                self._assign_value(t_el, v_el, state)
+            return
+        self._assign(target, self._eval(value, state), state)
+
+    def _transfer(self, node, state: dict) -> dict:
+        state = dict(state)
+        if node.kind == WITH_ENTER:
+            for item in node.items:
+                labels = self._eval(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, labels, state)
+            return state
+        if node.kind != STMT or node.ast is None:
+            return state
+        stmt = node.ast
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._assign_value(tgt, stmt.value, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign(stmt.target, self._eval(stmt.value, state),
+                         state)
+        elif isinstance(stmt, ast.AugAssign):
+            labels = self._eval(stmt.value, state)
+            tok = lockset.token_of(stmt.target)
+            if tok is not None:
+                state[tok] = self._lookup(state, tok) | labels
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._assign(stmt.target, self._eval(stmt.iter, state), state)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.out.ret = self.out.ret | \
+                    self._eval(stmt.value, state)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, state)
+            self._clearing(stmt.value, state)
+        else:
+            # headers (if/while tests), raise/assert/delete/...: evaluate
+            # every expression executing at this node for sink checks
+            for tree in node.scan_asts():
+                if isinstance(tree, ast.expr):
+                    self._eval(tree, state)
+                elif isinstance(tree, ast.stmt):
+                    for child in ast.iter_child_nodes(tree):
+                        if isinstance(child, ast.expr):
+                            self._eval(child, state)
+        return state
+
+    def _clearing(self, value: ast.AST, state: dict) -> None:
+        """Statement-position validators: ``validate_handoff(h, ...)``
+        clears ``h``; ``cfg.validate()`` clears ``cfg`` — they raise on
+        bad input, so the fall-through edge carries trusted data."""
+        if not isinstance(value, ast.Call):
+            return
+        dotted = lockset.token_of(value.func)
+        if dotted is None:
+            return
+        last = dotted.rsplit(".", 1)[-1]
+        if last in _CLEARING_CALLS and value.args:
+            tok = lockset.token_of(value.args[0])
+            if tok is not None:
+                state[tok] = frozenset()
+        elif last in _CLEARING_METHODS and not value.args and \
+                isinstance(value.func, ast.Attribute):
+            tok = lockset.token_of(value.func.value)
+            if tok is not None:
+                state[tok] = frozenset()
+
+    # -- driver -------------------------------------------------------------
+    def run(self) -> FuncTaint:
+        cfg = _cfg_for(self.ctx, self.func)
+        instate: dict = {cfg.entry: self._entry}
+        worklist = [cfg.entry]
+        budget = 20 * len(cfg.nodes) + 100
+        while worklist and budget > 0:
+            budget -= 1
+            node = worklist.pop()
+            state = instate.get(node)
+            if state is None:
+                continue
+            out = self._transfer(node, state)
+            for succ in node.succs:
+                cur = instate.get(succ)
+                new = out if cur is None else self._join(cur, out)
+                if cur is None or new != cur:
+                    instate[succ] = new
+                    worklist.append(succ)
+        return self.out
+
+
+# ---------------------------------------------------------------------------
+# whole-program solve
+
+
+def solve(program) -> tuple[dict[str, FuncTaint], list[Finding]]:
+    """Per-function taint summaries (callgraph functions) plus the
+    project-wide concrete findings, nested defs included."""
+    from tpu_dra.analysis.callgraph import toplevel_functions
+
+    # live ASTs by qualname (facts records don't carry trees)
+    index: dict[str, tuple] = {}
+    order: list[str] = []
+    edges: dict[str, list[str]] = {}
+    for path, ctx in program.ctxs.items():
+        rec = program.facts.get(path)
+        if rec is None:
+            continue
+        by_line = {}
+        for func, cls in toplevel_functions(ctx.tree):
+            by_line[(cls, func.name, func.lineno)] = (func, cls)
+        for qual, ent in rec["functions"].items():
+            hit = by_line.get((ent["cls"], ent["name"], ent["line"]))
+            if hit is None:
+                continue
+            index[qual] = (ctx, hit[0], hit[1])
+            order.append(qual)
+            succ = []
+            for dotted, _line, _col, _skip in ent["calls"]:
+                target = program.resolve(path, ent["cls"], dotted)
+                if target is not None and target != qual:
+                    succ.append(target)
+            edges[qual] = succ
+
+    taints: dict[str, FuncTaint] = {}
+    findings: list[Finding] = []
+
+    def analyze(qual: str) -> FuncTaint:
+        ctx, func, cls = index[qual]
+        resolve = lambda dotted: program.resolve(ctx.path, cls, dotted)
+        return _FuncAnalysis(ctx, func, cls, taints, resolve).run()
+
+    for scc in _sccs(order, edges):
+        for qual in scc:            # seed (callees outside are solved)
+            taints[qual] = analyze(qual)
+        if len(scc) > 1:            # in-SCC fixpoint, bounded
+            for _ in range(4):
+                changed = False
+                for qual in scc:
+                    new = analyze(qual)
+                    if new.fingerprint() != taints[qual].fingerprint():
+                        taints[qual] = new
+                        changed = True
+                if not changed:
+                    break
+    for t in taints.values():
+        findings.extend(t.findings)
+
+    # nested defs (serve/router handler methods, closures): standalone,
+    # exactly like the lockset engine — entry is their own declared taint
+    analyzed = {id(index[q][1]) for q in index}
+    for path, ctx in program.ctxs.items():
+        for func, cls in lockset.functions_in(ctx.tree):
+            if id(func) in analyzed:
+                continue
+            resolve = (lambda p, c: lambda dotted:
+                       program.resolve(p, c, dotted))(path, cls)
+            t = _FuncAnalysis(ctx, func, cls, taints, resolve).run()
+            findings.extend(t.findings)
+
+    # stable report order
+    findings.sort(key=lambda f: (f.path, f.line, f.sink, f.source))
+    return taints, findings
+
+
+def taints_of(program) -> tuple[dict[str, FuncTaint], list[Finding]]:
+    """solve(), cached per Program (one run per vet invocation)."""
+    cached = getattr(program, "_taints", None)
+    if cached is None:
+        cached = solve(program)
+        program._taints = cached
+    return cached

@@ -58,6 +58,30 @@ def _check_unknown(data: dict, allowed: set[str], ctx: str) -> None:
         raise ConfigError(f"{ctx}: unknown field(s) {sorted(unknown)}")
 
 
+# one SAFE PATH SEGMENT: the domain id names the per-domain settings
+# directory (slicedomain.py joins it under domains/), so a traversal
+# payload ("../..", an absolute path, a separator) must die in
+# validate() — first char alphanumeric also rules out "." and ".."
+_DOMAIN_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def _validate_domain_id(kind: str, domain_id) -> None:
+    """Shared domainID validation for the slice-domain handles: the
+    value comes from a CLAIM's opaque config (workload-author
+    controlled) and ends up as a directory name under the plugin root —
+    type and path-segment safety are load-bearing, not cosmetic."""
+    if not isinstance(domain_id, str) or not domain_id:
+        raise ConfigError(f"{kind}: domainID must be a non-empty string")
+    if len(domain_id) > 253:
+        raise ConfigError(
+            f"{kind}: domainID exceeds 253 characters")
+    if not _DOMAIN_ID_RE.match(domain_id):
+        raise ConfigError(
+            f"{kind}: domainID {domain_id!r} must be a single safe "
+            f"path segment (alphanumeric start, then [A-Za-z0-9._-]) — "
+            f"it names the per-domain settings directory")
+
+
 SCHEDULING_PRIORITIES = ("Default", "Low", "Normal", "High")
 
 
@@ -173,11 +197,22 @@ class TpuSharing:
                 "sharing.multiProcess set but strategy is Exclusive")
         if self.multi_process:
             mp = self.multi_process
-            if mp.max_processes is not None and not (
-                    1 <= mp.max_processes <= 64):
-                raise ConfigError(
-                    f"multiProcess.maxProcesses {mp.max_processes} outside "
-                    f"[1, 64]")
+            if mp.max_processes is not None:
+                # type BEFORE range: a crafted opaque config carrying
+                # maxProcesses: "64" (or true, which IS an int to
+                # Python) must be a typed ConfigError on the kubelet
+                # plugin path, not a TypeError escaping as an
+                # unclassified prepare failure
+                if isinstance(mp.max_processes, bool) or \
+                        not isinstance(mp.max_processes, int):
+                    raise ConfigError(
+                        f"multiProcess.maxProcesses: expected an "
+                        f"integer, got "
+                        f"{type(mp.max_processes).__name__}")
+                if not 1 <= mp.max_processes <= 64:
+                    raise ConfigError(
+                        f"multiProcess.maxProcesses {mp.max_processes} "
+                        f"outside [1, 64]")
             if mp.scheduling_priority not in SCHEDULING_PRIORITIES:
                 raise ConfigError(
                     f"multiProcess.schedulingPriority "
@@ -295,8 +330,7 @@ class SliceChannelConfig:
         return self
 
     def validate(self) -> None:
-        if not self.domain_id:
-            raise ConfigError(f"{self.KIND}: domainID must be set")
+        _validate_domain_id(self.KIND, self.domain_id)
 
 
 @dataclass
@@ -321,5 +355,4 @@ class SliceDaemonConfig:
         return self
 
     def validate(self) -> None:
-        if not self.domain_id:
-            raise ConfigError(f"{self.KIND}: domainID must be set")
+        _validate_domain_id(self.KIND, self.domain_id)

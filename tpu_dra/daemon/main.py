@@ -153,6 +153,10 @@ def _coordd_runnable(path: str) -> bool:
     if cached is not None and cached[1] == mtime_ns and now < cached[2]:
         return cached[0]
     try:
+        # vet: sanitized[exec] — SLICE_COORDD is an OPERATOR knob (set
+        # by whoever launches the root-owned daemon, same trust domain
+        # as argv), gated by os.access(X_OK); this --version probe IS
+        # the validation the taint engine asks for
         ok = subprocess.run([path, "--version"], capture_output=True,
                             timeout=5).returncode == 0
     except (OSError, subprocess.SubprocessError):

@@ -51,6 +51,55 @@ def negotiate_exposition(accept: str, registry: "Registry"
     return registry.expose(), TEXT_CONTENT_TYPE
 
 
+def bounded_label(raw: Optional[str], *,
+                  allowed: Optional[frozenset] = None,
+                  seen: Optional[set] = None,
+                  cap: int = 64,
+                  lock: Optional[threading.Lock] = None,
+                  overflow: str = "other",
+                  empty: str = "default",
+                  max_len: int = 64) -> str:
+    """Bound an untrusted string into a safe metric-label value.
+
+    THE cardinality sanitizer: every client-controlled string that
+    becomes a label (the serve ``X-Tenant`` header, the router's
+    client-chosen request path) must pass through here, in one of two
+    modes — the taint checker (``tpu_dra/analysis/taint.py``) declares
+    this function a ``metric-label`` sanitizer on exactly that contract.
+
+    - **allowlist** (``allowed=``): values outside the fixed set
+      collapse into ``overflow``.  For labels whose legitimate values
+      are known up front (request paths).
+    - **first-come registry** (``seen=``): the first ``cap`` distinct
+      values keep their own series, everything later collapses into
+      ``overflow``; pass the owning ``lock`` when callers race.  For
+      labels that are legitimately open-ended but must not grow without
+      bound (tenants).
+
+    Either way the value is length-clamped and stripped of ``~`` first,
+    so an overflow sentinel containing ``~`` can never be claimed by a
+    client-chosen value (strangers' post-cap traffic must not merge
+    into a real series' SLOs)."""
+    value = (raw or empty).replace("~", "_")[:max_len] or empty
+    if allowed is not None:
+        return value if value in allowed else overflow
+    if seen is None:
+        return value
+    if lock is not None:
+        with lock:
+            return _admit_label(seen, value, cap, overflow)
+    return _admit_label(seen, value, cap, overflow)
+
+
+def _admit_label(seen: set, value: str, cap: int, overflow: str) -> str:
+    if value in seen:
+        return value
+    if len(seen) < cap:
+        seen.add(value)
+        return value
+    return overflow
+
+
 def _current_exemplar() -> Optional[dict]:
     """``{"trace_id": …}`` of the current SAMPLED span, else None.
     Unsampled spans are the shared NOOP_SPAN (identity compare, no

@@ -1162,60 +1162,16 @@ class ContinuousEngine:
                 "speculative engines cannot import a handoff: the "
                 "draft model's cache has no context for the imported "
                 "pages; serve the decode pool without a draft")
-        from tpu_dra.workloads.kv_handoff import KVHandoff, model_dims
-        if not isinstance(handoff, KVHandoff):
-            raise ValueError(f"handoff must be a KVHandoff, got "
-                             f"{type(handoff).__name__}")
-        mine = model_dims(cfg)
-        if handoff.model != mine:
-            raise ValueError(
-                f"handoff was prefilled by a different model "
-                f"({handoff.model} != {mine}); decoding its pages "
-                f"would be silent garbage")
-        if handoff.page_size != self.pool.page_size:
-            raise ValueError(
-                f"handoff page_size {handoff.page_size} != engine "
-                f"page_size {self.pool.page_size}")
-        # array-shape validation HERE, on the caller's thread: a
+        from tpu_dra.workloads.kv_handoff import validate_handoff
+        # shape/capacity validation HERE, on the caller's thread: a
         # malformed blob must 400 the one request — reaching the jit'd
         # scatter on the batcher thread would _fail_all the ENGINE
-        # (one crafted request = a dead replica)
-        ks_shape = tuple(np.asarray(handoff.ks).shape)
-        if ks_shape != tuple(np.asarray(handoff.vs).shape):
-            raise ValueError(
-                f"handoff k/v shapes disagree: {ks_shape} vs "
-                f"{tuple(np.asarray(handoff.vs).shape)}")
-        want = (cfg.n_layers, 1, cfg.kv_heads)
-        if len(ks_shape) != 5 or ks_shape[:3] != want or \
-                ks_shape[4] != cfg.d_head:
-            raise ValueError(
-                f"handoff KV shape {ks_shape} does not match this "
-                f"model's [L={cfg.n_layers}, 1, Hkv={cfg.kv_heads}, "
-                f"S_pad, Dh={cfg.d_head}] layout")
-        s_pad = ks_shape[3]
-        if s_pad % handoff.page_size or s_pad < handoff.length:
-            raise ValueError(
-                f"handoff KV columns {s_pad} must be a page multiple "
-                f"covering length {handoff.length}")
-        logits_shape = tuple(np.asarray(handoff.last_logits).shape)
-        if logits_shape != (cfg.vocab,):
-            raise ValueError(
-                f"handoff last_logits shape {logits_shape} != "
-                f"({cfg.vocab},)")
-        if steps < 1:
-            raise ValueError(f"steps must be >= 1, got {steps}")
-        if eos_id is not None and not 0 <= eos_id < cfg.vocab:
-            raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
-        if handoff.length + steps > self.max_len:
-            raise ValueError(
-                f"handoff length {handoff.length} + steps {steps} "
-                f"exceeds the engine's max_len {self.max_len}")
-        if self.pool.pages_for(handoff.length + steps) > \
-                self.pool.total_pages:
-            raise ValueError(
-                f"handoff needs "
-                f"{self.pool.pages_for(handoff.length + steps)} KV "
-                f"pages but the pool only has {self.pool.total_pages}")
+        # (one crafted request = a dead replica).  validate_handoff is
+        # the declared handoff-blob sanitizer; removing this call makes
+        # `make vet` flag the _pending.append flow below and `make
+        # drive-hostile` kill a live replica with one crafted blob.
+        validate_handoff(handoff, cfg, self.pool, self.max_len,
+                         steps, eos_id)
         if stop is not None:
             stop = [list(seq) for seq in stop]
         req = _Request(prompt=list(handoff.prompt), steps=steps,

@@ -174,6 +174,71 @@ def decode_blob(data: bytes) -> KVHandoff:
                      last_logits=out["last_logits"])
 
 
+def validate_handoff(handoff: Any, cfg: ModelConfig, pool: Any,
+                     max_len: int, steps: int,
+                     eos_id: Optional[int] = None) -> None:
+    """Reject a handoff the TARGET engine cannot decode — ``ValueError``
+    with the exact message the HTTP layer turns into a 400.
+
+    THE trust boundary for cross-engine KV import (the taint engine
+    declares this function the ``handoff-blob`` sanitizer): a malformed
+    blob must fail HERE, on the submitting caller's thread, because past
+    this point the pages reach the jit'd scatter on the batcher thread
+    where a shape lie ``_fail_all``s the whole ENGINE — one crafted
+    request would be a dead replica (PR 14's incident shape).  Checks:
+    type, producing model, page geometry, k/v array shapes against the
+    target model's layout, logits shape, step/eos bounds, and pool
+    capacity."""
+    if not isinstance(handoff, KVHandoff):
+        raise ValueError(f"handoff must be a KVHandoff, got "
+                         f"{type(handoff).__name__}")
+    mine = model_dims(cfg)
+    if handoff.model != mine:
+        raise ValueError(
+            f"handoff was prefilled by a different model "
+            f"({handoff.model} != {mine}); decoding its pages "
+            f"would be silent garbage")
+    if handoff.page_size != pool.page_size:
+        raise ValueError(
+            f"handoff page_size {handoff.page_size} != engine "
+            f"page_size {pool.page_size}")
+    ks_shape = tuple(np.asarray(handoff.ks).shape)
+    if ks_shape != tuple(np.asarray(handoff.vs).shape):
+        raise ValueError(
+            f"handoff k/v shapes disagree: {ks_shape} vs "
+            f"{tuple(np.asarray(handoff.vs).shape)}")
+    want = (cfg.n_layers, 1, cfg.kv_heads)
+    if len(ks_shape) != 5 or ks_shape[:3] != want or \
+            ks_shape[4] != cfg.d_head:
+        raise ValueError(
+            f"handoff KV shape {ks_shape} does not match this "
+            f"model's [L={cfg.n_layers}, 1, Hkv={cfg.kv_heads}, "
+            f"S_pad, Dh={cfg.d_head}] layout")
+    s_pad = ks_shape[3]
+    if s_pad % handoff.page_size or s_pad < handoff.length:
+        raise ValueError(
+            f"handoff KV columns {s_pad} must be a page multiple "
+            f"covering length {handoff.length}")
+    logits_shape = tuple(np.asarray(handoff.last_logits).shape)
+    if logits_shape != (cfg.vocab,):
+        raise ValueError(
+            f"handoff last_logits shape {logits_shape} != "
+            f"({cfg.vocab},)")
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(f"eos_id must be in [0, {cfg.vocab})")
+    if handoff.length + steps > max_len:
+        raise ValueError(
+            f"handoff length {handoff.length} + steps {steps} "
+            f"exceeds the engine's max_len {max_len}")
+    if pool.pages_for(handoff.length + steps) > pool.total_pages:
+        raise ValueError(
+            f"handoff needs "
+            f"{pool.pages_for(handoff.length + steps)} KV "
+            f"pages but the pool only has {pool.total_pages}")
+
+
 def peek_prompt_len(blob_b64: str) -> Optional[int]:
     """The prompt length from a base64 wire blob WITHOUT decoding the
     arrays — the admission gate prices /decode_handoff requests from

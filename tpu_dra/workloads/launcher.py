@@ -217,9 +217,15 @@ def _acquire_in_pool(pool_dir: str, fallback_max: int,
         except OSError:
             os.close(fd)
             continue
-        os.ftruncate(fd, 0)   # clear a crashed holder's longer pid
-        os.write(fd, f"{os.getpid()}\n".encode())
-        os.set_inheritable(fd, True)   # hold must survive os.exec*()
+        try:
+            os.ftruncate(fd, 0)   # clear a crashed holder's longer pid
+            os.write(fd, f"{os.getpid()}\n".encode())
+            os.set_inheritable(fd, True)  # hold must survive os.exec*()
+        except OSError:
+            # a failed pid-stamp must not wedge the slot for this
+            # process's lifetime: close releases the flock too
+            os.close(fd)
+            raise
         _HELD_SLOTS.append(fd)   # keep open: lock lives with the process
         _ACQUIRED_POOLS[key] = slot
         # record for the shim (reverse interop: launcher first, then a

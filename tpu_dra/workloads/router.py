@@ -64,7 +64,8 @@ from typing import Callable, Optional
 from tpu_dra.trace import get_tracer
 from tpu_dra.trace.span import current_traceparent
 from tpu_dra.util import klog
-from tpu_dra.util.metrics import Registry, negotiate_exposition
+from tpu_dra.util.metrics import (Registry, bounded_label,
+                                  negotiate_exposition)
 
 # typed router-origin shed reasons (the replica-origin reasons pass
 # through verbatim — admission.SHED_REASONS)
@@ -168,7 +169,14 @@ class PooledClient:
                     else:
                         self._put_conn(c)
                 return resp.status, dict(resp.getheaders()), resp, done
-            data = resp.read()
+            try:
+                data = resp.read()
+            except (http.client.HTTPException, OSError):
+                # a replica dying mid-body must not strand the socket:
+                # close (it is half-read, unpoolable) and surface the
+                # transport error to the eject/retry logic upstream
+                conn.close()
+                raise
             if resp.will_close:
                 conn.close()
             else:
@@ -760,8 +768,9 @@ def make_router_handler(router: Router):
             """Bound the client-chosen path into a fixed label set —
             an anonymous client cycling request paths must not mint
             unbounded tpu_router_* series (the X-Tenant cardinality
-            discipline, applied to paths)."""
-            return self.path if self.path in _KNOWN_PATHS else "other"
+            discipline, applied to paths; allowlist mode of the shared
+            :func:`tpu_dra.util.metrics.bounded_label` sanitizer)."""
+            return bounded_label(self.path, allowed=_KNOWN_PATHS)
 
         def _observe(self, code: int, t0: float,
                      replica: Optional[Replica] = None) -> None:

@@ -88,7 +88,8 @@ import jax.numpy as jnp
 from tpu_dra.trace import get_tracer
 from tpu_dra.trace.export import debug_traces_body
 from tpu_dra.util import klog
-from tpu_dra.util.metrics import Registry, negotiate_exposition
+from tpu_dra.util.metrics import (Registry, bounded_label,
+                                  negotiate_exposition)
 from tpu_dra.workloads.admission import (
     REASON_DEADLINE,
     REASON_DRAINING,
@@ -109,6 +110,15 @@ from tpu_dra.workloads.train import ModelConfig
 
 # upper bound on one continuous-mode request's wall time (compile included)
 ENGINE_REQUEST_TIMEOUT_S = 600
+
+# the endpoint surface — client-chosen paths outside this set still get
+# their 404, but collapse into one "other" label so cycling request
+# paths cannot mint unbounded tpu_serve_* series (the router's
+# _KNOWN_PATHS discipline; Handler._path_label)
+_SERVE_PATHS = frozenset((
+    "/healthz", "/metrics", "/debug/slo", "/debug/overload",
+    "/debug/traces", "/debug/jax-trace", "/stream", "/prefix", "/beam",
+    "/speculative", "/prefill", "/decode_handoff", "/generate"))
 
 
 def _count_leaf_tokens(tokens) -> int:
@@ -379,15 +389,11 @@ class ServeMetrics:
 
     def tenant_label(self, raw: str) -> str:
         """Bound the untrusted ``X-Tenant`` header into a safe label
-        value (see class docstring)."""
-        tenant = (raw or "default").replace("~", "_")[:64] or "default"
-        with self._tenant_mu:
-            if tenant in self._tenants:
-                return tenant
-            if len(self._tenants) < self.MAX_TENANTS:
-                self._tenants.add(tenant)
-                return tenant
-        return self.OVERFLOW_TENANT
+        value (see class docstring) — first-come registry mode of the
+        shared :func:`tpu_dra.util.metrics.bounded_label` sanitizer."""
+        return bounded_label(
+            raw, seen=self._tenants, cap=self.MAX_TENANTS,
+            lock=self._tenant_mu, overflow=self.OVERFLOW_TENANT)
 
     def observe(self, path: str, code: int, secs: float,
                 tokens: int = 0, tenant: str = "default") -> None:
@@ -663,6 +669,13 @@ AdmissionController` — every decode endpoint acquires a cost ticket
         def log_message(self, *a):             # quiet by default
             pass
 
+        def _path_label(self) -> str:
+            """Bound the client-chosen request path into the fixed
+            endpoint set before it becomes a tpu_serve_* label — the
+            router's ``_path_label`` discipline, through the shared
+            :func:`tpu_dra.util.metrics.bounded_label` sanitizer."""
+            return bounded_label(self.path, allowed=_SERVE_PATHS)
+
         def _drain_body(self) -> None:
             """Consume the request body before an early response: with
             HTTP/1.1 keep-alive, unread body bytes would be parsed as
@@ -715,7 +728,7 @@ AdmissionController` — every decode endpoint acquires a cost ticket
             so the surfaces cannot drift."""
             self._count_shed(shed.reason)
             if metrics is not None:
-                metrics.observe(self.path, 503,
+                metrics.observe(self._path_label(), 503,
                                 time.perf_counter() - t0, tenant=tenant)
             body, headers = self._shed_payload(shed)
             self._send(503, body, headers=headers)
@@ -889,7 +902,7 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                 if ticket is not None:
                     admission.release(ticket, completed=False)
                 if metrics is not None:
-                    metrics.observe(self.path, 400,
+                    metrics.observe(self._path_label(), 400,
                                     time.perf_counter() - t0,
                                     tenant=tenant)
                 self._send(400, json.dumps(
@@ -904,7 +917,7 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                     self._shed_503(_draining_shed(str(exc)), t0, tenant)
                     return
                 if metrics is not None:
-                    metrics.observe(self.path, 500,
+                    metrics.observe(self._path_label(), 500,
                                     time.perf_counter() - t0,
                                     tenant=tenant)
                 self._send(500, json.dumps(
@@ -916,35 +929,44 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                 # would read hex size lines as body.  Degrade to the
                 # buffered /generate behavior instead of corrupting it.
                 code, body = 200, b""
-                if not handle.done.wait(ENGINE_REQUEST_TIMEOUT_S):
-                    # same as the chunked path's timeout: abort so the
-                    # slot and its pages free instead of the zombie
-                    # decoding on while its admission cost is returned
-                    engine.cancel(handle)
-                    code, body = 500, json.dumps(
-                        {"error": "request not done within "
-                                  f"{ENGINE_REQUEST_TIMEOUT_S}s"}).encode()
-                elif handle.error == DEADLINE_ERROR:
-                    code, body = 504, json.dumps(
-                        {"error": handle.error,
-                         "reason": REASON_DEADLINE}).encode()
-                    self._count_shed(REASON_DEADLINE)
-                elif handle.error:
-                    code, body = 500, json.dumps(
-                        {"error": handle.error[:300]}).encode()
-                else:
-                    body = json.dumps(
-                        {"done": True, "tokens": handle.tokens}).encode()
-                if metrics is not None:
-                    metrics.observe_engine_timing(tenant, handle)
-                    metrics.observe(self.path, code,
-                                    time.perf_counter() - t0,
-                                    len(handle.tokens), tenant)
+                responded = False
                 try:
+                    if not handle.done.wait(ENGINE_REQUEST_TIMEOUT_S):
+                        # same as the chunked path's timeout: abort so
+                        # the slot and its pages free instead of the
+                        # zombie decoding on while its admission cost is
+                        # returned
+                        engine.cancel(handle)
+                        code, body = 500, json.dumps(
+                            {"error": "request not done within "
+                                      f"{ENGINE_REQUEST_TIMEOUT_S}s"
+                             }).encode()
+                    elif handle.error == DEADLINE_ERROR:
+                        code, body = 504, json.dumps(
+                            {"error": handle.error,
+                             "reason": REASON_DEADLINE}).encode()
+                        self._count_shed(REASON_DEADLINE)
+                    elif handle.error:
+                        code, body = 500, json.dumps(
+                            {"error": handle.error[:300]}).encode()
+                    else:
+                        body = json.dumps(
+                            {"done": True,
+                             "tokens": handle.tokens}).encode()
+                    if metrics is not None:
+                        metrics.observe_engine_timing(tenant, handle)
+                        metrics.observe(self._path_label(), code,
+                                        time.perf_counter() - t0,
+                                        len(handle.tokens), tenant)
                     self._send(code, body)
+                    responded = True
                 finally:
-                    if ticket is not None:   # after the response write
-                        admission.release(ticket, completed=code == 200)
+                    # the whole branch, not just the response write: a
+                    # raise anywhere above (cancel, metrics, a broken
+                    # pipe) must not strand the ticket until restart
+                    if ticket is not None:
+                        admission.release(
+                            ticket, completed=code == 200 and responded)
                 return
             try:
                 self.send_response(200)
@@ -1017,7 +1039,7 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                         completed=code == 200 and alive and not timed_out)
                 if metrics is not None:
                     metrics.observe_engine_timing(tenant, handle)
-                    metrics.observe(self.path, code,
+                    metrics.observe(self._path_label(), code,
                                     time.perf_counter() - t0, toks,
                                     tenant)
             finally:
@@ -1026,10 +1048,14 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                 # or the admission ticket.  cancel() is a no-op once
                 # the request is done; release() is idempotent, so the
                 # normal path's release above (with its accurate
-                # ``completed`` flag) wins when it ran.
-                engine.cancel(handle)
-                if ticket is not None:
-                    admission.release(ticket, completed=False)
+                # ``completed`` flag) wins when it ran.  The ticket
+                # release is nested so a cancel() that raises cannot
+                # strand it.
+                try:
+                    engine.cancel(handle)
+                finally:
+                    if ticket is not None:
+                        admission.release(ticket, completed=False)
 
         def _tenant(self) -> str:
             """Per-tenant SLO attribution: the ``X-Tenant`` header names
@@ -1085,6 +1111,12 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                                 else request_cost(
                                     req.get("tokens") or [],
                                     req.get("steps", 16))
+                            # vet: sanitized[admission-cost] — both cost
+                            # functions price from the SERVER-side parse
+                            # of the payload (row/step counts the engine
+                            # will actually run, clamped by the gate's
+                            # max_cost), not from a client-asserted
+                            # number; cost_of is operator-supplied
                             ticket = admission.acquire(tenant, cost)
                         if deadline is not None and \
                                 time.perf_counter() > deadline:
@@ -1114,7 +1146,7 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                         body = json.dumps(
                             {"error": str(exc)[:300]}).encode()
                     if metrics is not None:
-                        metrics.observe(self.path, code,
+                        metrics.observe(self._path_label(), code,
                                         time.perf_counter() - t0, toks,
                                         tenant)
                 self._send(code, body, headers=headers)
