@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-fleet drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-share drive-fleet drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -131,6 +131,17 @@ drive-overload:
 # registry against tpu_dra/analysis/taint.py's sink catalog.
 drive-hostile:
 	$(PYTHON) hack/drive_hostile.py
+
+# multi-tenant sharing acceptance (docs/sharing.md, ISSUE 17): REAL
+# plugin with --shared-partitions 4 packs four fractional tenants onto
+# ONE chip over the gRPC prepare path — per-tenant isolation edits
+# (scoped visibility, HBM budget, fair-share weight, slot pool) in each
+# claim CDI spec, >=2x chip-seconds utilization vs the exclusive arm,
+# then one tenant blows its HBM budget and is evicted ALONE (typed
+# Event + unprepare for that claim only) while the chip stays published
+# and the co-tenants finish with zero errors
+drive-share:
+	$(PYTHON) hack/drive_share.py
 
 # cluster-serving acceptance (docs/scaling.md "Cluster serving",
 # ISSUE 14): REAL kubelet plugin + REAL serve replicas on REAL gRPC-

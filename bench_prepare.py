@@ -204,6 +204,38 @@ def bench_alloc_score(n: int = 5_000, repeats: int = 3) -> dict:
     return {"n": n, "per_score_us": round(best / n * 1e6, 4)}
 
 
+def bench_tenancy_setup(base: str, n: int = 2_000,
+                        repeats: int = 3) -> dict:
+    """ISSUE 17 tenancy gate: ``tenant_edits`` — the incremental cost a
+    SHARED claim adds to ``_group_edits`` (HBM budget math, per-tenant
+    env assembly, slot-pool creation; the ``prepare.tenancy_setup``
+    span) — must stay well inside the warm-prepare overhead budget, or
+    fractional claims quietly become slower to prepare than the whole
+    chips they subdivide.  Measured in the shape prepare actually runs:
+    a FRESH claim uid per call (cold slot pool — tenancy setup happens
+    once per claim, never warm).  Best-of-``repeats`` like the other
+    gates; ~80µs here is dominated by the two non-durable slot-pool
+    file ops, so an accidental ``durable=True`` fsync (a >=1ms cliff)
+    or a per-partition O(n^2) blowup fails the ratchet."""
+    from tpu_dra.api.configs import TpuSharedConfig
+    from tpu_dra.plugins.tpu.tenancy import tenant_edits
+    from tpu_dra.tpulib.fake import FakeTpuLib
+
+    chip = FakeTpuLib().enumerate_chips()[0]
+    part = chip.partitions(4)[0]
+    parents = {chip.uuid: chip}
+    config = TpuSharedConfig(weight=10)
+    slots = os.path.join(base, "bench-tenancy-slots")
+    best = float("inf")
+    for r in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(n):
+            tenant_edits(config, [part], parents, f"bench-{r}-{i}",
+                         slots_root=slots)
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_setup_us": round(best / n * 1e6, 4)}
+
+
 def bench_router_decision(n: int = 50_000, repeats: int = 3) -> dict:
     """ISSUE 14 router gate: ``Router.decide`` — the per-request
     routing decision (replica scoring scan + session-affinity lookup)
@@ -476,6 +508,7 @@ def run_all() -> dict:
         "observe_idle": bench_observe_idle(),
         "admission_idle": bench_admission_idle(),
         "alloc_score": bench_alloc_score(),
+        "tenancy_setup": bench_tenancy_setup(base),
         "router_decision": bench_router_decision(),
         "kernels": bench_kernel_throughput(),
         "direct": bench_direct(base),
@@ -519,6 +552,8 @@ def _gates(report: dict) -> dict[str, float]:
             report["admission_idle"]["per_check_us"],
         "alloc_score_us":
             report["alloc_score"]["per_score_us"],
+        "tenancy_setup_us":
+            report["tenancy_setup"]["per_setup_us"],
         "router_decision_us":
             report["router_decision"]["per_decision_us"],
     }

@@ -1,0 +1,308 @@
+"""Drive multi-tenant chip sharing against the REAL plugin binary
+(ISSUE 17, docs/sharing.md).
+
+Same harness as hack/drive_plugin.py / drive_health.py (HTTP facade over
+the in-memory fake apiserver, real `tpu_dra.plugins.tpu.main` subprocess,
+synthetic driver root), exercising the fractional-claim path end to end:
+
+1. a node started with ``--shared-partitions 4`` publishes
+   ``chip-<i>-part-<j>`` partition devices alongside the chips;
+2. FOUR tenants are packed onto ONE chip's partitions via the real
+   NodePrepareResources gRPC path, each getting per-tenant isolation
+   edits in its claim CDI spec (scoped visibility, HBM budget,
+   fair-share weight, slot pool);
+3. chip-seconds utilization is measured from the plugin's own
+   ``tpu_dra_chip_seconds_total`` counters: the shared arm must deliver
+   the same four tenant-seconds-per-second for >= 2x fewer busy
+   chip-seconds than the exclusive arm (it achieves ~4x on this node);
+4. one tenant blows its HBM budget (the real
+   ``launcher.report_hbm_oom`` drops the ``oom`` sentinel) and is
+   evicted ALONE — typed SharedTenantEvicted Warning Event, node-side
+   unprepare, claim deleted — while the chip stays published, no
+   DeviceUnhealthy fires, and the three co-tenants finish their
+   unprepare over gRPC with zero errors.
+"""
+
+import json
+import os
+import pathlib
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import grpc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_dra.api.configs import GROUP_VERSION                # noqa: E402
+from tpu_dra.k8s.testserver import KubeTestServer            # noqa: E402
+from tpu_dra.k8s import EVENTS, RESOURCE_CLAIMS              # noqa: E402
+from tpu_dra.kubeletplugin.proto import (                    # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.version import DRIVER_NAME                      # noqa: E402
+from tpu_dra.workloads import launcher                       # noqa: E402
+
+NUM_TENANTS = 4
+ARM_SECONDS = 3.0
+
+
+def rpc(sock, method, request, response_cls, timeout=10.0):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with grpc.insecure_channel(f"unix:{sock}") as ch:
+                fn = ch.unary_unary(
+                    method,
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=response_cls.FromString)
+                return fn(request, timeout=5)
+        except grpc.RpcError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def wait_until(pred, timeout=20.0, what=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def metric(body: str, name: str, labels: str = "") -> float:
+    pat = re.escape(name) + (re.escape("{" + labels + "}") if labels
+                             else "") + r" ([0-9.e+-]+)"
+    m = re.search(pat, body)
+    return float(m.group(1)) if m else 0.0
+
+
+def main():
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="drive-share-"))
+    srv = KubeTestServer().start()
+    try:
+        kcfg = srv.write_kubeconfig(str(tmp / "kubeconfig"))
+        root = tmp / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(4):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "etc").mkdir()
+        (root / "etc" / "machine-id").write_text("deadbeefcafe\n")
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            "TPU_ACCELERATOR_TYPE: 'v5litepod-4'\nTPU_TOPOLOGY: '2x2'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            mport = s.getsockname()[1]
+        env = {**os.environ, "PYTHONPATH": REPO}
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+             "--kubeconfig", kcfg, "--node-name", "node-a",
+             "--tpu-driver-root", str(root),
+             "--kubelet-plugins-dir", str(tmp / "plugins"),
+             "--kubelet-registry-dir", str(tmp / "registry"),
+             "--cdi-root", str(tmp / "cdi"),
+             "--http-endpoint", f"127.0.0.1:{mport}",
+             "--shared-partitions", str(NUM_TENANTS),
+             "--health-interval", "0.3",
+             "--ignore-host-tpu-env"], cwd=REPO, env=env)
+        try:
+            dra_sock = tmp / "plugins" / DRIVER_NAME / "dra.sock"
+            hb_root = tmp / "plugins" / DRIVER_NAME / "heartbeats"
+            wait_until(dra_sock.exists, what="plugin socket")
+
+            def slice_devices():
+                url = (f"http://127.0.0.1:{srv.port}/apis/resource.k8s.io/"
+                       "v1beta1/resourceslices")
+                items = json.load(
+                    urllib.request.urlopen(url, timeout=10))["items"]
+                return [d["name"] for s in items
+                        for d in s["spec"]["devices"]]
+
+            def metrics_body():
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/metrics", timeout=5
+                ).read().decode()
+
+            def busy_chip_seconds():
+                body = metrics_body()
+                return (metric(body, "tpu_dra_chip_seconds_total",
+                               'state="active"')
+                        + metric(body, "tpu_dra_chip_seconds_total",
+                                 'state="allocated"'))
+
+            # -- 1. partitions are published ------------------------------
+            wait_until(
+                lambda: len(slice_devices()) == 4 + 4 * NUM_TENANTS,
+                what="slice with 4 chips + 16 partitions")
+            names = slice_devices()
+            for j in range(NUM_TENANTS):
+                assert f"chip-0-part-{j}" in names, names
+            print(f"OK slice publishes {len(names)} devices "
+                  f"(4 chips + {4 * NUM_TENANTS} partitions)")
+
+            def make_claim(name, device, config=None):
+                claim = {"metadata": {"name": name, "namespace": "default"},
+                         "spec": {},
+                         "status": {"allocation": {"devices": {"results": [
+                             {"request": "tpus", "driver": DRIVER_NAME,
+                              "pool": "node-a", "device": device}]}}}}
+                if config is not None:
+                    claim["status"]["allocation"]["devices"]["config"] = [
+                        {"source": "FromClass",
+                         "opaque": {"driver": DRIVER_NAME,
+                                    "parameters": config}}]
+                return srv.fake.create(RESOURCE_CLAIMS,
+                                       claim)["metadata"]["uid"]
+
+            def grpc_prepare(uid, name):
+                req = dra_pb.NodePrepareResourcesRequest()
+                c = req.claims.add()
+                c.uid, c.name, c.namespace = uid, name, "default"
+                res = rpc(str(dra_sock),
+                          "/v1beta1.DRAPlugin/NodePrepareResources",
+                          req, dra_pb.NodePrepareResourcesResponse)
+                assert res.claims[uid].error == "", res.claims[uid].error
+
+            def grpc_unprepare(uid, name):
+                req = dra_pb.NodeUnprepareResourcesRequest()
+                c = req.claims.add()
+                c.uid, c.name, c.namespace = uid, name, "default"
+                res = rpc(str(dra_sock),
+                          "/v1beta1.DRAPlugin/NodeUnprepareResources",
+                          req, dra_pb.NodeUnprepareResourcesResponse)
+                assert res.claims[uid].error == "", res.claims[uid].error
+
+            def beat(uid):
+                d = hb_root / uid
+                d.mkdir(parents=True, exist_ok=True)
+                (d / "beat").touch()
+
+            # -- 2a. exclusive arm: 4 tenants burn 4 whole chips ----------
+            excl = [(make_claim(f"c-x{i}", f"tpu-{i}"), f"c-x{i}")
+                    for i in range(NUM_TENANTS)]
+            for uid, name in excl:
+                grpc_prepare(uid, name)
+                beat(uid)
+            b0 = busy_chip_seconds()
+            time.sleep(ARM_SECONDS)
+            busy_exclusive = busy_chip_seconds() - b0
+            for uid, name in excl:
+                grpc_unprepare(uid, name)
+            assert busy_exclusive > 0
+            print(f"OK exclusive arm: {NUM_TENANTS} tenants burned "
+                  f"{busy_exclusive:.1f} busy chip-seconds")
+
+            # -- 2b. shared arm: the same 4 tenants pack onto ONE chip ----
+            weights = [10, 10, 10, 20]
+            shared = []
+            for j in range(NUM_TENANTS):
+                uid = make_claim(
+                    f"c-t{j}", f"chip-0-part-{j}",
+                    config={"apiVersion": GROUP_VERSION,
+                            "kind": "TpuSharedConfig",
+                            "weight": weights[j]})
+                shared.append((uid, f"c-t{j}"))
+            for uid, name in shared:
+                grpc_prepare(uid, name)
+                beat(uid)
+            print(f"OK packed {NUM_TENANTS} tenants onto chip 0 via "
+                  f"NodePrepareResources")
+
+            # per-tenant isolation edits landed in the claim CDI specs
+            for j, (uid, _) in enumerate(shared):
+                spec_path = (tmp / "cdi" /
+                             f"k8s.tpu.google.com-claim_{uid}.json")
+                with open(spec_path) as f:
+                    spec = json.dumps(json.load(f))
+                for needle in ('"TPU_VISIBLE_CHIPS=0"',
+                               '"TPU_HBM_LIMIT_BYTES_0=',
+                               f'"TPU_SHARE_WEIGHT={weights[j]}"',
+                               '"TPU_MULTIPROCESS_MAX=1"'):
+                    assert needle in spec, (uid, needle)
+            body = metrics_body()
+            assert metric(body, "tpu_dra_shared_tenants") == NUM_TENANTS
+            print("OK per-tenant isolation edits: scoped visibility, HBM "
+                  "budget, weight, slot cap; shared_tenants gauge = 4")
+
+            b1 = busy_chip_seconds()
+            time.sleep(ARM_SECONDS)
+            busy_shared = busy_chip_seconds() - b1
+            assert busy_shared > 0
+            gain = busy_exclusive / busy_shared
+            assert gain >= 2.0, (
+                f"expected >=2x chip-seconds utilization from sharing, "
+                f"got {gain:.2f}x (exclusive {busy_exclusive:.1f} vs "
+                f"shared {busy_shared:.1f} busy chip-s for the same "
+                f"{NUM_TENANTS} tenant arms)")
+            print(f"OK utilization: same tenant-seconds for "
+                  f"{gain:.1f}x fewer busy chip-seconds (>=2x required)")
+
+            # -- 3. tenant 3 blows its HBM budget; evicted ALONE ----------
+            victim_uid, victim_name = shared[3]
+            launcher.report_hbm_oom(
+                env={"TPU_HEALTH_HEARTBEAT_FILE":
+                     str(hb_root / victim_uid / "beat")},
+                detail="RESOURCE_EXHAUSTED: HBM budget exceeded")
+
+            def evicted():
+                return any(e["reason"] == "SharedTenantEvicted" and
+                           e["involvedObject"]["name"] == victim_name
+                           for e in srv.fake.list(EVENTS)["items"])
+            wait_until(evicted, what="SharedTenantEvicted event")
+            wait_until(
+                lambda: victim_name not in
+                [c["metadata"]["name"]
+                 for c in srv.fake.list(RESOURCE_CLAIMS)["items"]],
+                what="evicted tenant's claim deleted")
+            body = metrics_body()
+            assert metric(body, "tpu_dra_tenant_evictions_total",
+                          'reason="oom"') == 1.0
+            assert metric(body, "tpu_dra_shared_tenants") == NUM_TENANTS - 1
+            print("OK OOM tenant evicted alone: typed Event, claim "
+                  "deleted, evictions{reason=oom}=1")
+
+            # the chip was never condemned: still published, no
+            # DeviceUnhealthy, co-tenant claims alive
+            assert "tpu-0" in slice_devices()
+            assert "chip-0-part-3" in slice_devices()
+            assert not any(e["reason"] == "DeviceUnhealthy"
+                           for e in srv.fake.list(EVENTS)["items"])
+            live = [c["metadata"]["name"]
+                    for c in srv.fake.list(RESOURCE_CLAIMS)["items"]]
+            for _, name in shared[:3]:
+                assert name in live, (name, live)
+            print("OK chip-0 stays published and healthy; co-tenants "
+                  "untouched")
+
+            # -- 4. the three co-tenants finish unharmed ------------------
+            for uid, name in shared[:3]:
+                beat(uid)
+                grpc_unprepare(uid, name)
+            body = metrics_body()
+            assert metric(body, "tpu_dra_shared_tenants") == 0
+            print("OK co-tenants completed and unprepared with zero "
+                  "errors")
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(5)
+    finally:
+        srv.stop()
+    print("DRIVE SHARE: ALL OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,7 @@ from tpu_dra.plugins.tpu.placement import (  # noqa: E402
     claim_score,
     device_coords,
     fragmentation_ratio,
+    pack_tenant,
 )
 from tpu_dra.resilience import failpoint  # noqa: E402
 from tpu_dra.resilience.breaker import CircuitBreaker, ResilientKubeClient  # noqa: E402
@@ -842,6 +843,126 @@ def run_alloc_schedule(boards: list[Board], schedule: list,
     }
 
 
+SHARED_PARTS_PER_CHIP = 4          # mirrors --shared-partitions 4, the
+# drive-share lane's partition count (docs/sharing.md)
+SHARED_FRACTION = 0.5              # every other size-1 claim is a small
+# shareable tenant — the ISSUE-17 mix
+
+
+def run_shared_schedule(boards: list[Board], schedule: list,
+                        parts_per_chip: int = SHARED_PARTS_PER_CHIP,
+                        shared_fraction: float = SHARED_FRACTION) -> dict:
+    """Replay the SAME churn schedule with a fraction of the size-1
+    claims flagged shareable: those route through the REAL
+    :func:`pack_tenant` bin-packer onto fractional partitions (a chip
+    leaves the selector's free set while it hosts tenants and returns
+    when the last one expires), everything else through the best-fit
+    selector as before.  ``shared_fraction=0.0`` is the exclusive-only
+    baseline arm with identical busy accounting, so the two reports
+    compare apples to apples: packing density (tenants per shared
+    chip), busy chip-steps for the same offered load, fragmentation,
+    and multi-chip failures."""
+    selector = TopologySelector()
+    expiries: dict[int, list] = {}
+    live: list[tuple[int, int, int, frozenset]] = []
+    tenants: dict[str, list[int]] = {}   # chip key -> tenant expiries
+    chip_of: dict[str, tuple[int, tuple]] = {}
+    attempts = {s: 0 for s in ALLOC_SIZES}
+    failures = {s: 0 for s in ALLOC_SIZES}
+    busy_chip_steps = 0
+    density: list[float] = []
+    frag: list[float] = []
+    small_seen = 0
+    tenants_packed = 0
+    shared_chips_peak = 0
+    shared_every = round(1 / shared_fraction) if shared_fraction else 0
+    for step, (arrivals, preempt) in enumerate(schedule):
+        for bi, cells in expiries.pop(step, []):
+            boards[bi].free |= cells
+        live = [c for c in live if c[0] > step]
+        for key in list(tenants):
+            left = [e for e in tenants[key] if e > step]
+            if left:
+                tenants[key] = left
+            else:                       # last tenant out: the chip is
+                del tenants[key]        # whole again for the selector
+                bi, coords = chip_of[key]
+                boards[bi].free.add(coords)
+        if preempt and live:
+            victim = min(range(len(live)), key=lambda i: live[i][1])
+            exp, _, bi, cells = live.pop(victim)
+            expiries[exp] = [e for e in expiries.get(exp, [])
+                             if not (e[0] == bi and e[1] == cells)]
+            boards[bi].free |= cells
+        for size, ttl in arrivals:
+            attempts[size] += 1
+            shareable = False
+            if size == 1:
+                shareable = bool(shared_every) and \
+                    small_seen % shared_every == 0
+                small_seen += 1
+            if shareable:
+                # pack_tenant arbitrates among STARTED chips (fill the
+                # fullest first); when none has room, the best-fit
+                # selector — the same fragmentation-aware single-chip
+                # policy the exclusive path uses — picks WHICH pristine
+                # chip to break
+                free_parts = {k: parts_per_chip - len(v)
+                              for k, v in tenants.items()
+                              if len(v) < parts_per_chip}
+                pick = pack_tenant(free_parts, parts_per_chip)
+                if pick is None:
+                    placed = selector.select_board(1, boards)
+                    if placed is None:
+                        failures[1] += 1
+                        continue
+                    bi, (coords,) = placed
+                    pick = f"b{bi:03d}:{coords}"
+                    chip_of[pick] = (bi, coords)
+                    boards[bi].free.discard(coords)
+                    tenants[pick] = []
+                tenants[pick].append(step + ttl)
+                tenants_packed += 1
+                continue
+            placed = selector.select_board(size, boards)
+            if placed is None:
+                failures[size] += 1
+                continue
+            bi, cells = placed
+            cellset = frozenset(cells)
+            boards[bi].free -= cellset
+            expiries.setdefault(step + ttl, []).append((bi, cellset))
+            live.append((step + ttl, step, bi, cellset))
+        busy_chip_steps += sum(len(b.chips) - len(b.free)
+                               for b in boards)
+        shared_chips_peak = max(shared_chips_peak, len(tenants))
+        if tenants:
+            density.append(sum(len(v) for v in tenants.values())
+                           / len(tenants))
+        if step % 5 == 0:
+            frag.append(round(sum(
+                fragmentation_ratio(b.free, b.shape) for b in boards)
+                / len(boards), 4))
+    multi_att = sum(attempts[s] for s in ALLOC_SIZES if s > 1)
+    multi_fail = sum(failures[s] for s in ALLOC_SIZES if s > 1)
+    return {
+        "shared_fraction": shared_fraction,
+        "parts_per_chip": parts_per_chip,
+        "attempts": attempts,
+        "failures": failures,
+        "multi_attempts": multi_att,
+        "multi_failures": multi_fail,
+        "tenants_packed": tenants_packed,
+        "shared_chips_peak": shared_chips_peak,
+        "packing_density_mean": round(
+            sum(density) / max(len(density), 1), 3),
+        "busy_chip_steps": busy_chip_steps,
+        "fragmentation_mean": round(
+            sum(frag) / max(len(frag), 1), 4),
+        "fragmentation_final": frag[-1] if frag else 0.0,
+    }
+
+
 def alloc_controller_packing(cfg: Config, checks: list[Check]) -> dict:
     """Drive the REAL controller through the ISSUE-13 packing path:
     workers at ids {0, 4..8} must arbitrate to the COMPACT window
@@ -979,6 +1100,42 @@ def phase_alloc(cfg: Config, checks: list[Check]) -> dict:
         bf["score_p50_us"] <= budget_us,
         f"claim_score p50 {bf['score_p50_us']}us vs alloc_score_us "
         f"budget {budget_us}us"))
+    # shared-tenant arm (ISSUE 17, docs/sharing.md): same schedule, 50%
+    # of the size-1 claims shareable through the REAL pack_tenant
+    # bin-packer, vs an exclusive-only baseline with identical busy
+    # accounting
+    shared = run_shared_schedule(build_boards(cfg.nodes), schedule)
+    excl = run_shared_schedule(build_boards(cfg.nodes), schedule,
+                               shared_fraction=0.0)
+    out["shared-tenant"] = shared
+    out["exclusive-baseline"] = excl
+    checks.append(Check(
+        "alloc: shared tenants pack >=2 per shared chip on average",
+        shared["packing_density_mean"] >= 2.0,
+        f"packing density {shared['packing_density_mean']} tenants/"
+        f"shared chip (peak {shared['shared_chips_peak']} shared "
+        f"chips, {shared['tenants_packed']} tenants packed)"))
+    checks.append(Check(
+        "alloc: sharing burns fewer busy chip-steps for the same load",
+        shared["busy_chip_steps"] < excl["busy_chip_steps"],
+        f"busy chip-steps shared {shared['busy_chip_steps']} vs "
+        f"exclusive-only {excl['busy_chip_steps']}"))
+    # a shared chip stays out of the free set until its LAST tenant
+    # expires, so its hole outlives any single small claim's — the
+    # guarantee is that sharing keeps fragmentation in best-fit's
+    # regime, far below the first-fit baseline, not that it beats the
+    # exclusive best-fit arm
+    checks.append(Check(
+        "alloc: sharing keeps the best-fit fragmentation win",
+        shared["fragmentation_mean"] < 0.5 * ff["fragmentation_mean"],
+        f"mean fragmentation shared {shared['fragmentation_mean']} vs "
+        f"exclusive best-fit {excl['fragmentation_mean']}, first-fit "
+        f"{ff['fragmentation_mean']}"))
+    checks.append(Check(
+        "alloc: sharing does not add multi-chip allocation failures",
+        shared["multi_failures"] <= excl["multi_failures"],
+        f"multi-chip failures shared {shared['multi_failures']} vs "
+        f"exclusive-only {excl['multi_failures']}"))
     out["packing"] = alloc_controller_packing(cfg, checks)
     return out
 

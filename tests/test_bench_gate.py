@@ -18,7 +18,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
             grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
             observe_us=0.8, admission_us=4.0, alloc_us=15.0,
-            router_us=2.0):
+            router_us=2.0, tenancy_us=90.0):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
@@ -26,6 +26,7 @@ def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
         "observe_idle": {"n": 50000, "per_observe_us": observe_us},
         "admission_idle": {"n": 20000, "per_check_us": admission_us},
         "alloc_score": {"n": 5000, "per_score_us": alloc_us},
+        "tenancy_setup": {"n": 2000, "per_setup_us": tenancy_us},
         "router_decision": {"n": 50000, "per_decision_us": router_us},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
@@ -51,6 +52,7 @@ def _budget(**overrides):
             "histogram_observe_idle_us": 2.5,
             "admission_check_idle_us": 12.0,
             "alloc_score_us": 40.0,
+            "tenancy_setup_us": 400.0,
             "router_decision_us": 10.0,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
@@ -138,6 +140,17 @@ def test_router_decision_gate():
     violations = bench_prepare.gate(_report(router_us=120.0), _budget())
     assert any("router_decision_us" in v for v in violations)
     assert bench_prepare.gate(_report(router_us=1.5), _budget()) == []
+
+
+def test_tenancy_setup_gate():
+    """ISSUE 17: the shared-claim setup cost added to _group_edits is
+    budgeted like every other prepare-path cost — an accidental durable
+    fsync landing on the slot-pool write (a >=1ms cliff) must fail the
+    ratchet."""
+    violations = bench_prepare.gate(_report(tenancy_us=1500.0),
+                                    _budget())
+    assert any("tenancy_setup_us" in v for v in violations)
+    assert bench_prepare.gate(_report(tenancy_us=85.0), _budget()) == []
 
 
 def test_idle_observe_gate():

@@ -30,8 +30,10 @@ import sys
 
 import pytest
 
+from tpu_dra.api.configs import GROUP_VERSION
 from tpu_dra.plugins.tpu.checkpoint import Checkpoint
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
+from tpu_dra.plugins.tpu.sharing import _group_id
 from tpu_dra.resilience import failpoint
 from tpu_dra.tpulib import FakeTpuLib
 from tpu_dra.version import DRIVER_NAME
@@ -41,6 +43,8 @@ pytestmark = pytest.mark.core
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 UID = "sweep-claim-uid"
+COTENANT_UID = "sweep-cotenant-uid"
+PARTS = 4   # shared_partitions on the swept node
 
 # every crash-safe point and the op that drives execution through it
 PREPARE_POINTS = (
@@ -59,6 +63,25 @@ UNPREPARE_POINTS = (
     "tpu.unprepare.after_cdi_delete",
     "tpu.unprepare.after_checkpoint",
 )
+# shared-tenancy sweep (ISSUE 17): the tenancy failpoints only fire for
+# claims holding partition devices, so they get their own op driven by a
+# shared claim — alongside the generic points re-swept under sharing to
+# prove a mid-prepare/mid-unprepare kill never orphans a CO-TENANT
+SHARED_PREPARE_POINTS = (
+    "tpu.prepare.begin",
+    "tpu.prepare.after_select",
+    "tpu.prepare.after_cdi_write",
+    "tpu.prepare.after_tenant_pin",
+    "tpu.prepare.after_checkpoint",
+)
+SHARED_UNPREPARE_POINTS = (
+    "tpu.unprepare.begin",
+    "tpu.unprepare.after_heartbeat_rm",
+    "tpu.unprepare.after_slot_cleanup",
+    "tpu.unprepare.after_cdi_delete",
+    "tpu.unprepare.after_tenant_unpin",
+    "tpu.unprepare.after_checkpoint",
+)
 
 _HARNESS = """
 import json, os, sys
@@ -69,6 +92,7 @@ from tpu_dra.tpulib import FakeTpuLib
 plugin_dir, cdi_root, op, claim_json = sys.argv[1:5]
 state = DeviceState(DeviceStateConfig(
     tpulib=FakeTpuLib(), plugin_dir=plugin_dir, cdi_root=cdi_root,
+    shared_partitions=int(os.environ.get("SWEEP_SHARED_PARTITIONS", "0")),
     checkpoint_quiesce_s=float(os.environ.get("SWEEP_QUIESCE_S", "0"))))
 claim = json.loads(claim_json)
 if op == "prepare":
@@ -89,15 +113,38 @@ def _claim(uid=UID):
     }
 
 
-def _mk_state(base) -> DeviceState:
+def _shared_claim(uid, part_index, name="c-tenant"):
+    """A shared-tenancy claim holding one partition of chip 1 (chip 0 is
+    left pristine for the sweep's exclusive convergence claim)."""
+    return {
+        "metadata": {"uid": uid, "namespace": "default", "name": name},
+        "status": {"allocation": {"devices": {
+            "results": [
+                {"request": "r0", "driver": DRIVER_NAME, "pool": "node-a",
+                 "device": f"chip-1-part-{part_index}"},
+            ],
+            "config": [
+                {"source": "FromClass",
+                 "opaque": {"driver": DRIVER_NAME,
+                            "parameters": {"apiVersion": GROUP_VERSION,
+                                           "kind": "TpuSharedConfig",
+                                           "weight": 10}}},
+            ],
+        }}},
+    }
+
+
+def _mk_state(base, shared_partitions: int = 0) -> DeviceState:
     return DeviceState(DeviceStateConfig(
         tpulib=FakeTpuLib(),
         plugin_dir=os.path.join(base, "plugin"),
-        cdi_root=os.path.join(base, "cdi")))
+        cdi_root=os.path.join(base, "cdi"),
+        shared_partitions=shared_partitions))
 
 
-def _run_child(base, op: str, point: str,
-               quiesce_s: float = 0.0) -> subprocess.CompletedProcess:
+def _run_child(base, op: str, point: str, quiesce_s: float = 0.0,
+               claim: dict = None,
+               shared_partitions: int = 0) -> subprocess.CompletedProcess:
     harness = os.path.join(base, "harness.py")
     if not os.path.exists(harness):
         with open(harness, "w") as f:
@@ -105,10 +152,12 @@ def _run_child(base, op: str, point: str,
     env = {**os.environ,
            "PYTHONPATH": REPO,
            "SWEEP_QUIESCE_S": str(quiesce_s),
+           "SWEEP_SHARED_PARTITIONS": str(shared_partitions),
            failpoint.ENV_VAR: f"{point}=crash"}
     return subprocess.run(
         [sys.executable, harness, os.path.join(base, "plugin"),
-         os.path.join(base, "cdi"), op, json.dumps(_claim())],
+         os.path.join(base, "cdi"), op,
+         json.dumps(claim if claim is not None else _claim())],
         env=env, capture_output=True, text=True, timeout=60)
 
 
@@ -167,6 +216,107 @@ def test_crash_during_unprepare_converges(tmp_path, point):
     _assert_converged(base, point)
 
 
+def _assert_cotenant_intact(state: DeviceState, base: str,
+                            point: str) -> None:
+    """The co-tenant invariant (ISSUE 17): whatever the crash did to the
+    OTHER tenant, this one's checkpoint entry, heartbeat dir, slot pool,
+    and CDI spec must all have survived the restart's reconcile pass."""
+    assert COTENANT_UID in state.checkpoint.prepared, \
+        f"{point}: co-tenant lost its checkpoint entry"
+    assert COTENANT_UID in state.tenancy.shared_uids(), \
+        f"{point}: co-tenant missing from the rebuilt tenancy ledger"
+    assert os.path.isdir(os.path.join(base, "plugin", "heartbeats",
+                                      COTENANT_UID)), \
+        f"{point}: co-tenant heartbeat dir reconciled away"
+    rec = state.tenancy.record(COTENANT_UID)
+    group = _group_id(COTENANT_UID, list(rec.partition_uuids))
+    assert os.path.isdir(os.path.join(base, "plugin", "mp-slots", group)), \
+        f"{point}: co-tenant slot pool reconciled away"
+    with open(state.cdi.claim_spec_path(COTENANT_UID)) as f:
+        json.load(f)   # co-tenant claim spec present and parseable
+
+
+@pytest.mark.parametrize("point", SHARED_PREPARE_POINTS)
+def test_crash_during_shared_prepare_spares_cotenant(tmp_path, point):
+    """Kill a shared-claim prepare at every crash-safe point while a
+    co-tenant of the SAME chip is already prepared: the restart must
+    keep every co-tenant artifact, the crashed tenant's re-prepare must
+    be clean, and its unprepare must not touch the co-tenant."""
+    base = str(tmp_path)
+    state = _mk_state(base, shared_partitions=PARTS)
+    state.prepare(_shared_claim(COTENANT_UID, 0, name="c-cotenant"))
+    res = _run_child(base, "prepare", point,
+                     claim=_shared_claim(UID, 1), shared_partitions=PARTS)
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, \
+        f"{point}: child did not crash at the failpoint\n{res.stderr}"
+    assert "OP_COMPLETED" not in res.stdout
+    state2 = _mk_state(base, shared_partitions=PARTS)
+    _assert_cotenant_intact(state2, base, point)
+    devices = state2.prepare(_shared_claim(UID, 1))
+    assert [d.canonical_name for d in devices] == ["chip-1-part-1"], point
+    assert state2.tenancy.shared_uids() == {UID, COTENANT_UID}, point
+    state2.unprepare(UID)
+    assert UID not in state2.tenancy.shared_uids(), point
+    _assert_cotenant_intact(state2, base, point)
+    state2.unprepare(COTENANT_UID)
+    assert state2.cdi.list_claim_specs() == [], point
+    assert state2.tenancy.count() == 0, point
+
+
+@pytest.mark.parametrize("point", SHARED_UNPREPARE_POINTS)
+def test_crash_during_shared_unprepare_spares_cotenant(tmp_path, point):
+    """Same invariant for the teardown half: killing one tenant's
+    unprepare anywhere must leave its co-tenant fully intact, and the
+    retried unprepare must converge on exactly the crashed claim."""
+    base = str(tmp_path)
+    state = _mk_state(base, shared_partitions=PARTS)
+    state.prepare(_shared_claim(COTENANT_UID, 0, name="c-cotenant"))
+    state.prepare(_shared_claim(UID, 1))
+    res = _run_child(base, "unprepare", point,
+                     claim=_shared_claim(UID, 1), shared_partitions=PARTS)
+    assert res.returncode == failpoint.CRASH_EXIT_CODE, \
+        f"{point}: child did not crash at the failpoint\n{res.stderr}"
+    assert "OP_COMPLETED" not in res.stdout
+    state2 = _mk_state(base, shared_partitions=PARTS)
+    _assert_cotenant_intact(state2, base, point)
+    state2.unprepare(UID)
+    assert UID not in state2.prepared_claims(), point
+    assert UID not in state2.tenancy.shared_uids(), point
+    _assert_cotenant_intact(state2, base, point)
+    state2.unprepare(COTENANT_UID)
+    assert state2.cdi.list_claim_specs() == [], point
+    assert state2.tenancy.count() == 0, point
+
+
+def test_reconcile_removes_killed_tenant_slot_pool(tmp_path):
+    """``MultiProcessManager.reconcile()`` must reclaim a per-tenant
+    slot pool whose claim is no longer checkpointed (the debris a
+    SIGKILLed shared claim leaves when it dies between slot-pool
+    creation and checkpoint.put) — and must NOT touch the pool of a
+    claim that is still live."""
+    from tpu_dra.api.configs import TpuSharedConfig
+    from tpu_dra.plugins.tpu.sharing import MultiProcessManager
+    from tpu_dra.plugins.tpu.tenancy import tenant_edits
+
+    base = str(tmp_path)
+    state = _mk_state(base, shared_partitions=PARTS)
+    slots_root = os.path.join(base, "plugin")
+    part = state.allocatable["chip-1-part-0"].partition
+    chip = next(d.chip for d in state.allocatable.values()
+                if d.chip is not None and d.chip.uuid == part.parent_uuid)
+    for uid in ("dead-tenant-uid", "live-tenant-uid"):
+        tenant_edits(TpuSharedConfig(), [part], {chip.uuid: chip}, uid,
+                     slots_root=slots_root)
+    dead = _group_id("dead-tenant-uid", [part.uuid])
+    live = _group_id("live-tenant-uid", [part.uuid])
+    mgr = MultiProcessManager(slots_root=slots_root)
+    removed = list(mgr.reconcile({"live-tenant-uid"}))
+    assert dead in removed
+    assert not os.path.isdir(os.path.join(slots_root, "mp-slots", dead))
+    assert os.path.isdir(os.path.join(slots_root, "mp-slots", live)), \
+        "reconcile reclaimed a LIVE tenant's slot pool"
+
+
 def test_crash_sweep_restart_is_lockdep_clean(tmp_path):
     """Runtime lockdep over the sweep's restart/converge half: with the
     lock-acquisition graph recorded, the restarted DeviceState's full
@@ -196,7 +346,8 @@ def test_sweep_covers_every_crash_safe_failpoint():
     import tpu_dra.plugins.tpu.device_state  # noqa: F401
 
     registry = {fp.name for fp in failpoint.registered() if fp.crash_safe}
-    swept = set(PREPARE_POINTS) | set(UNPREPARE_POINTS)
+    swept = (set(PREPARE_POINTS) | set(UNPREPARE_POINTS)
+             | set(SHARED_PREPARE_POINTS) | set(SHARED_UNPREPARE_POINTS))
     assert swept == registry, (
         f"crash sweep out of sync with the failpoint registry: "
         f"missing={sorted(registry - swept)} stale={sorted(swept - registry)}")

@@ -1,0 +1,24 @@
+"""The multi-tenant sharing drive as a suite-runnable e2e.
+
+``slow`` (NOT ``core``): real kubelet plugin subprocess with
+``--shared-partitions 4``, two timed utilization arms, and the OOM
+eviction scene — excluded from tier-1 (``-m 'not slow'``) and from the
+fast lane; the dedicated CI lane is ``make drive-share``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_drive_share_full_e2e():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "drive_share.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]

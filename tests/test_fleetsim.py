@@ -128,6 +128,31 @@ def test_alloc_schedule_run_small():
 
 
 @pytest.mark.core
+def test_shared_schedule_packs_and_frees_chips():
+    """The ISSUE-17 shared-tenant arm: shareable size-1 claims route
+    through the real pack_tenant bin-packer, a chip leaves the free set
+    while it hosts tenants and returns when the last one expires, and
+    the zero-fraction arm is a faithful exclusive-only baseline."""
+    boards = fleetsim.build_boards(8)
+    total = sum(len(b.chips) for b in boards)
+    sched = fleetsim.gen_alloc_schedule(total, 120, seed=3)
+    shared = fleetsim.run_shared_schedule(boards, sched)
+    assert shared["tenants_packed"] > 0
+    assert shared["shared_chips_peak"] >= 1
+    # bin-packing works: strictly fewer chips broken than tenants
+    # placed, i.e. density above 1 tenant per shared chip
+    assert shared["packing_density_mean"] > 1.0
+    excl = fleetsim.run_shared_schedule(
+        fleetsim.build_boards(8), sched, shared_fraction=0.0)
+    assert excl["tenants_packed"] == 0
+    assert excl["shared_chips_peak"] == 0
+    # same offered load, fewer chip-steps burned when tenants share
+    assert shared["busy_chip_steps"] < excl["busy_chip_steps"]
+    # every schedule claim was attempted in both arms
+    assert shared["attempts"] == excl["attempts"]
+
+
+@pytest.mark.core
 def test_fleet_topology_construction():
     cfg = fleetsim.Config(nodes=30, domain_size=8, spares=2)
     fleet = fleetsim.Fleet(cfg)
@@ -180,6 +205,14 @@ def test_fleetsim_alloc_1000_nodes(tmp_path):
     assert bf["fragmentation_mean"] < ff["fragmentation_mean"]
     assert bf["multi_success_rate"] > ff["multi_success_rate"]
     assert data["alloc"]["packing"]["healed_active"] == [4, 6, 7, 8]
+    # ISSUE-17 shared-tenant arm at fleet scale: dense packing, fewer
+    # busy chip-steps than the exclusive-only baseline, fragmentation
+    # still in the best-fit regime
+    sh = data["alloc"]["shared-tenant"]
+    ex = data["alloc"]["exclusive-baseline"]
+    assert sh["packing_density_mean"] >= 2.0
+    assert sh["busy_chip_steps"] < ex["busy_chip_steps"]
+    assert sh["fragmentation_mean"] < 0.5 * ff["fragmentation_mean"]
 
 
 @pytest.mark.slow

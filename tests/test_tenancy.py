@@ -1,0 +1,548 @@
+"""Fractional TPU claims — the multi-tenant tenancy subsystem
+(plugins/tpu/tenancy.py, ISSUE 17, docs/sharing.md).
+
+Covers the subsystem bottom-up: fair-share weight mapping, the
+tighten-only HBM budget math, the per-tenant isolation edits
+(visibility, budget, weight, slot pool), the derived tenancy ledger
+(pin/unpin/rebuild from checkpoint records), partition publication and
+the chip-vs-partition overlap rules through DeviceState, the
+pack_tenant bin-packer, the weighted chip-seconds split, the
+HeartbeatProbe shared-tenant skip, and the driver's per-tenant fault
+sweep: an OOM or heartbeat-stale tenant evicted ALONE while the chip
+stays published and co-tenants keep running.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tpu_dra.api.configs import (
+    ConfigError,
+    FAIR_SHARE_DEFAULT_WEIGHT,
+    GROUP_VERSION,
+    TpuSharedConfig,
+)
+from tpu_dra.health.probes import HeartbeatProbe
+from tpu_dra.health.state import HEALTHY
+from tpu_dra.k8s import EVENTS, FakeKube, RESOURCE_CLAIMS, RESOURCE_SLICES
+from tpu_dra.plugins.tpu.allocatable import (
+    PreparedClaim,
+    PreparedDevice,
+    TYPE_CHIP,
+    TYPE_PARTITION,
+)
+from tpu_dra.plugins.tpu.device_state import PrepareError
+from tpu_dra.plugins.tpu.driver import TpuDriver, TpuDriverConfig
+from tpu_dra.plugins.tpu.placement import pack_tenant
+from tpu_dra.plugins.tpu.sharing import _group_id
+from tpu_dra.plugins.tpu.tenancy import (
+    EVICT_REASON_OOM,
+    EVICT_REASON_STALE,
+    OOM_MARKER,
+    TenancyLedger,
+    effective_limits,
+    priority_for_weight,
+    tenant_edits,
+)
+from tpu_dra.plugins.tpu.utilization import ChipSecondsAccountant
+from tpu_dra.tpulib import FakeTpuLib
+from tpu_dra.version import DRIVER_NAME
+
+pytestmark = pytest.mark.core
+
+
+def wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -------------------------------------------------------------------------
+# Fair share and HBM budget math
+# -------------------------------------------------------------------------
+
+
+def test_priority_for_weight_buckets():
+    d = FAIR_SHARE_DEFAULT_WEIGHT
+    assert priority_for_weight(d) == "Normal"
+    assert priority_for_weight(2 * d) == "High"
+    assert priority_for_weight(d // 2) == "Low"
+    assert priority_for_weight(d + 1) == "Normal"
+
+
+def _chip_and_parts(n_parts=4, chip_index=0):
+    chip = FakeTpuLib().enumerate_chips()[chip_index]
+    return chip, chip.partitions(n_parts)
+
+
+def test_effective_limits_sums_partitions_per_minor():
+    chip, parts = _chip_and_parts()
+    limits = effective_limits(TpuSharedConfig(), parts[:2],
+                              {chip.uuid: chip})
+    assert limits == {chip.minor: 2 * parts[0].hbm_bytes}
+
+
+def test_effective_limits_hbm_limit_tightens_only():
+    chip, parts = _chip_and_parts()
+    budget = parts[0].hbm_bytes
+    tightened = effective_limits(
+        TpuSharedConfig(hbm_limit=str(budget // 2)), parts[:1],
+        {chip.uuid: chip})
+    assert tightened == {chip.minor: budget // 2}
+    with pytest.raises(ConfigError, match="cannot loosen"):
+        effective_limits(
+            TpuSharedConfig(hbm_limit=str(budget * 2)), parts[:1],
+            {chip.uuid: chip})
+
+
+# -------------------------------------------------------------------------
+# Per-tenant isolation edits
+# -------------------------------------------------------------------------
+
+
+def test_tenant_edits_env_and_slot_pool(tmp_path):
+    chip, parts = _chip_and_parts()
+    edits = tenant_edits(TpuSharedConfig(weight=30), parts[:2],
+                         {chip.uuid: chip}, "uid-t1",
+                         slots_root=str(tmp_path))
+    env = edits.env
+    assert env["TPU_ALLOW_MULTIPLE_LIBTPU_LOAD"] == "1"
+    assert env[f"TPU_HBM_LIMIT_BYTES_{chip.minor}"] == \
+        str(2 * parts[0].hbm_bytes)
+    assert env["TPU_SHARE_WEIGHT"] == "30"
+    assert env["TPU_PROCESS_PRIORITY"] == "High"   # 30 >= 2*10
+    # per-tenant slot pool: one slot per held partition, max file on
+    # disk, mounted rw, shim mounted ro
+    group = _group_id("uid-t1", [p.uuid for p in parts[:2]])
+    pool = tmp_path / "mp-slots" / group
+    assert (pool / "max").read_text() == "2"
+    assert env["TPU_MULTIPROCESS_MAX"] == "2"
+    mounts = {m["hostPath"] for m in edits.mounts}
+    assert str(pool) in mounts
+
+
+def test_tenant_edits_default_weight_is_normal_priority(tmp_path):
+    chip, parts = _chip_and_parts()
+    edits = tenant_edits(TpuSharedConfig(), parts[:1],
+                         {chip.uuid: chip}, "uid-t2",
+                         slots_root=str(tmp_path))
+    assert "TPU_PROCESS_PRIORITY" not in edits.env
+    assert edits.env["TPU_SHARE_WEIGHT"] == \
+        str(FAIR_SHARE_DEFAULT_WEIGHT)
+
+
+def test_tenant_edits_defense_in_depth_hook(tmp_path):
+    chip, parts = _chip_and_parts()
+    seen = {}
+
+    def defense(limits):
+        seen.update(limits)
+        return {"LIBTPU_INIT_ARGS": "--hbm_cap=test"}
+
+    edits = tenant_edits(TpuSharedConfig(), parts[:1],
+                         {chip.uuid: chip}, "uid-t3",
+                         slots_root=str(tmp_path),
+                         hbm_defense_env=defense)
+    assert seen == {chip.minor: parts[0].hbm_bytes}
+    assert edits.env["LIBTPU_INIT_ARGS"] == "--hbm_cap=test"
+
+
+# -------------------------------------------------------------------------
+# Tenancy ledger
+# -------------------------------------------------------------------------
+
+
+def _prepared(uid, devices):
+    return PreparedClaim(claim_uid=uid, namespace="default",
+                         name=f"c-{uid}", devices=devices)
+
+
+def _part_dev(chip, part, weight=0):
+    return PreparedDevice(
+        type=TYPE_PARTITION, uuid=part.uuid,
+        canonical_name=part.canonical_name(),
+        parent_uuid=chip.uuid, share_weight=weight,
+        hbm_bytes=part.hbm_bytes)
+
+
+def _chip_dev(chip):
+    return PreparedDevice(type=TYPE_CHIP, uuid=chip.uuid,
+                          canonical_name=f"tpu-{chip.index}")
+
+
+def test_ledger_pin_unpin_and_reads():
+    chip, parts = _chip_and_parts()
+    ledger = TenancyLedger()
+    assert not ledger.pin(_prepared("u-excl", [_chip_dev(chip)])), \
+        "an exclusive chip claim is not a shared tenant"
+    assert ledger.pin(_prepared(
+        "u-1", [_part_dev(chip, parts[0], weight=10)]))
+    assert ledger.pin(_prepared(
+        "u-2", [_part_dev(chip, parts[1], weight=30)]))
+    assert ledger.shared_uids() == frozenset({"u-1", "u-2"})
+    assert ledger.claim_weights() == {"u-1": 10.0, "u-2": 30.0}
+    rec = ledger.record("u-2")
+    assert rec.chip_uuids == (chip.uuid,)
+    assert rec.hbm_bytes == parts[1].hbm_bytes
+    by_chip = ledger.tenants_by_chip()
+    assert {r.claim_uid for r in by_chip[chip.uuid]} == {"u-1", "u-2"}
+    assert ledger.unpin("u-1")
+    assert not ledger.unpin("u-1"), "second unpin is a no-op"
+    assert not ledger.unpin("u-excl")
+    assert ledger.count() == 1
+
+
+def test_ledger_rebuild_from_checkpoint_records():
+    """The ledger is DERIVED state: rebuilding from the checkpoint's
+    PreparedClaim records must reproduce weights and membership, and a
+    record with no shareWeight (a pre-ISSUE-17 payload) defaults to the
+    fair-share default."""
+    chip, parts = _chip_and_parts()
+    claims = [
+        _prepared("u-a", [_part_dev(chip, parts[0], weight=20)]),
+        _prepared("u-b", [_part_dev(chip, parts[1])]),   # v1 payload
+        _prepared("u-excl", [_chip_dev(chip)]),
+    ]
+    ledger = TenancyLedger()
+    ledger.rebuild(claims)
+    assert ledger.shared_uids() == frozenset({"u-a", "u-b"})
+    assert ledger.claim_weights()["u-a"] == 20.0
+    assert ledger.claim_weights()["u-b"] == \
+        float(FAIR_SHARE_DEFAULT_WEIGHT)
+
+
+# -------------------------------------------------------------------------
+# pack_tenant bin-packing
+# -------------------------------------------------------------------------
+
+
+def test_pack_tenant_prefers_fullest_started_chip():
+    assert pack_tenant({"tpu-0": 2, "tpu-1": 1, "tpu-2": 4}, 4) == "tpu-1"
+
+
+def test_pack_tenant_breaks_pristine_only_when_forced():
+    assert pack_tenant({"tpu-3": 4, "tpu-1": 4}, 4) == "tpu-1"
+    assert pack_tenant({}, 4) is None
+
+
+def test_pack_tenant_ties_by_name():
+    assert pack_tenant({"tpu-2": 1, "tpu-0": 1}, 4) == "tpu-0"
+
+
+# -------------------------------------------------------------------------
+# Driver integration: publication, overlap, profile rules
+# -------------------------------------------------------------------------
+
+
+def make_driver(tmp_path, kube, lib, **overrides):
+    cfg = dict(
+        node_name="node-a", tpulib=lib, kube=kube,
+        plugins_dir=str(tmp_path / "plugins"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        flock_timeout=2.0,
+        shared_partitions=4,
+        health_interval=0,           # poll manually: deterministic tests
+        health_fail_threshold=2, health_pass_threshold=1)
+    cfg.update(overrides)
+    return TpuDriver(TpuDriverConfig(**cfg))
+
+
+def make_claim(kube, uid="uid-c1", name="claim1", devices=("tpu-0",),
+               config=None):
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default", "uid": uid},
+        "spec": {},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER_NAME, "pool": "node-a",
+             "device": d} for d in devices]}}},
+    }
+    if config is not None:
+        claim["status"]["allocation"]["devices"]["config"] = [
+            {"source": "FromClass",
+             "opaque": {"driver": DRIVER_NAME, "parameters": config}}]
+    kube.create(RESOURCE_CLAIMS, claim)
+    stored = kube.get(RESOURCE_CLAIMS, name, "default")
+    stored["metadata"]["uid"] = uid
+    kube.update(RESOURCE_CLAIMS, stored)
+    return stored
+
+
+def shared_cfg(weight=FAIR_SHARE_DEFAULT_WEIGHT):
+    return {"apiVersion": GROUP_VERSION, "kind": "TpuSharedConfig",
+            "weight": weight}
+
+
+def slice_device_names(kube):
+    slices = kube.list(RESOURCE_SLICES)["items"]
+    assert len(slices) == 1
+    return [d["name"] for d in slices[0]["spec"]["devices"]]
+
+
+def test_shared_partitions_published_with_attributes(tmp_path):
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib())
+    drv.start()
+    try:
+        names = slice_device_names(kube)
+        parts = [n for n in names if "-part-" in n]
+        assert len(parts) == 4 * 4
+        assert "chip-0-part-3" in parts
+        devices = {d["name"]: d for s in
+                   kube.list(RESOURCE_SLICES)["items"]
+                   for d in s["spec"]["devices"]}
+        attrs = devices["chip-1-part-2"]["basic"]["attributes"]
+        assert attrs["type"]["string"] == TYPE_PARTITION
+        assert attrs["partOf"]["string"] == "tpu-1"
+        assert attrs["partitionsPerChip"]["int"] == 4
+        hbm = devices["chip-1-part-2"]["basic"]["capacity"]
+    finally:
+        drv.stop()
+    assert hbm, "partitions must advertise an HBM capacity share"
+
+
+def test_partition_and_chip_claims_exclude_each_other(tmp_path):
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib())
+    drv.start()
+    try:
+        drv.state.prepare(make_claim(
+            kube, uid="u-t1", name="t1", devices=("chip-0-part-0",),
+            config=shared_cfg()))
+        # whole chip 0 now conflicts with its tenant
+        with pytest.raises(PrepareError, match="chip-0-part-0|tpu-0"):
+            drv.state.prepare(make_claim(kube, uid="u-x", name="x",
+                                         devices=("tpu-0",)))
+        # the same partition conflicts; a sibling partition does not
+        with pytest.raises(PrepareError):
+            drv.state.prepare(make_claim(
+                kube, uid="u-dup", name="dup",
+                devices=("chip-0-part-0",), config=shared_cfg()))
+        drv.state.prepare(make_claim(
+            kube, uid="u-t2", name="t2", devices=("chip-0-part-1",),
+            config=shared_cfg()))
+        # an exclusively-held chip rejects new tenants
+        drv.state.prepare(make_claim(kube, uid="u-chip1", name="c1",
+                                     devices=("tpu-1",)))
+        with pytest.raises(PrepareError):
+            drv.state.prepare(make_claim(
+                kube, uid="u-t3", name="t3", devices=("chip-1-part-0",),
+                config=shared_cfg()))
+    finally:
+        drv.stop()
+
+
+def test_partition_requires_shared_config(tmp_path):
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib())
+    drv.start()
+    try:
+        with pytest.raises(ConfigError, match="TpuSharedConfig"):
+            drv.state.prepare(make_claim(
+                kube, uid="u-bare", name="bare",
+                devices=("chip-0-part-0",)))
+    finally:
+        drv.stop()
+
+
+def test_shared_prepare_pins_ledger_and_emits_tenant_env(tmp_path):
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib())
+    drv.start()
+    try:
+        drv.state.prepare(make_claim(
+            kube, uid="u-t1", name="t1", devices=("chip-2-part-0",),
+            config=shared_cfg(weight=30)))
+        assert drv.state.tenancy.shared_uids() == frozenset({"u-t1"})
+        assert drv.state.tenancy.claim_weights() == {"u-t1": 30.0}
+        spec = json.dumps(json.load(open(os.path.join(
+            str(tmp_path / "cdi"),
+            f"k8s.tpu.google.com-claim_u-t1.json"))))
+        assert '"TPU_VISIBLE_CHIPS=2"' in spec
+        assert '"TPU_SHARE_WEIGHT=30"' in spec
+        assert '"TPU_HBM_LIMIT_BYTES_2=' in spec
+        drv.state.unprepare("u-t1")
+        assert drv.state.tenancy.count() == 0
+    finally:
+        drv.stop()
+
+
+# -------------------------------------------------------------------------
+# Weighted chip-seconds split
+# -------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_chip_seconds_split_by_weight(tmp_path):
+    clock = FakeClock()
+    acc = ChipSecondsAccountant(
+        chips_fn=lambda: ["chip-0"],
+        pinned_fn=lambda: {"chip-0": ["u-1", "u-2"]},
+        state_of=lambda uuid: HEALTHY,
+        heartbeat_dir=str(tmp_path),
+        weights_fn=lambda: {"u-1": 10.0, "u-2": 30.0},
+        clock=clock)
+    acc.tick()
+    clock.t += 8.0
+    acc.tick()
+    per = acc.report()["per_claim"]
+    # ONE chip-second per wall second, split 10:30 across the tenants
+    assert per["u-1"]["allocated_s"] == pytest.approx(2.0)
+    assert per["u-2"]["allocated_s"] == pytest.approx(6.0)
+    # chip-level totals unchanged by sharing
+    assert acc.report()["totals_s"]["allocated"] == pytest.approx(8.0)
+
+
+def test_chip_seconds_absent_weight_defaults_to_one(tmp_path):
+    """An exclusive claim (absent from the weights map) weighs 1.0, so a
+    single-claim chip accrues its full dt exactly as before ISSUE 17."""
+    clock = FakeClock()
+    acc = ChipSecondsAccountant(
+        chips_fn=lambda: ["chip-0"],
+        pinned_fn=lambda: {"chip-0": ["u-solo"]},
+        state_of=lambda uuid: HEALTHY,
+        heartbeat_dir=str(tmp_path),
+        weights_fn=lambda: {},
+        clock=clock)
+    acc.tick()
+    clock.t += 5.0
+    acc.tick()
+    assert acc.report()["per_claim"]["u-solo"]["allocated_s"] == \
+        pytest.approx(5.0)
+
+
+# -------------------------------------------------------------------------
+# HeartbeatProbe skips shared tenants
+# -------------------------------------------------------------------------
+
+
+def test_heartbeat_probe_skips_shared_tenants(tmp_path):
+    """A wedged shared tenant must never condemn the chip: per-tenant
+    staleness belongs to the driver's sweep, which evicts exactly that
+    claim while co-tenants keep running."""
+    chip = FakeTpuLib().enumerate_chips()[0]
+    stale = tmp_path / "u-shared"
+    stale.mkdir()
+    beat = stale / "beat"
+    beat.write_text("1")
+    os.utime(beat, (1.0, 1.0))       # 1970: long stale
+    probe = HeartbeatProbe(
+        str(tmp_path), pinned_fn=lambda: {chip.uuid: ["u-shared"]},
+        stale_after=10.0, shared_fn=lambda: ["u-shared"])
+    assert probe.check(chip).healthy, \
+        "a stale SHARED tenant must not fail the chip probe"
+    exclusive = HeartbeatProbe(
+        str(tmp_path), pinned_fn=lambda: {chip.uuid: ["u-shared"]},
+        stale_after=10.0)
+    assert not exclusive.check(chip).healthy, \
+        "the same staleness still condemns an exclusive claim's chip"
+
+
+# -------------------------------------------------------------------------
+# Per-tenant fault sweep: solo eviction
+# -------------------------------------------------------------------------
+
+
+def _beat(drv, uid):
+    d = os.path.join(drv.heartbeat_dir, uid)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "beat"), "w") as f:
+        f.write("1")
+
+
+def _events(kube, reason):
+    return [e for e in kube.list(EVENTS)["items"]
+            if e["reason"] == reason]
+
+
+def test_oom_tenant_evicted_alone(tmp_path):
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib())
+    drv.start()
+    try:
+        for j, uid in enumerate(["u-t0", "u-t1", "u-t2"]):
+            drv.state.prepare(make_claim(
+                kube, uid=uid, name=f"t{j}",
+                devices=(f"chip-0-part-{j}",), config=shared_cfg()))
+            _beat(drv, uid)
+        # tenant 1 blows its HBM budget: the launcher drops the sentinel
+        with open(os.path.join(drv.heartbeat_dir, "u-t1", OOM_MARKER),
+                  "w") as f:
+            f.write("HBM budget exceeded")
+        drv.health.poll_once()
+        assert drv.state.tenancy.shared_uids() == \
+            frozenset({"u-t0", "u-t2"}), "only the OOM tenant evicted"
+        evs = _events(kube, "SharedTenantEvicted")
+        assert len(evs) == 1
+        assert evs[0]["involvedObject"]["name"] == "t1"
+        assert EVICT_REASON_OOM in evs[0]["message"]
+        # the claim is deleted; co-tenant claims survive
+        names = [c["metadata"]["name"]
+                 for c in kube.list(RESOURCE_CLAIMS)["items"]]
+        assert "t1" not in names and {"t0", "t2"} <= set(names)
+        # the chip is never condemned: still published with partitions
+        assert "tpu-0" in slice_device_names(kube)
+        assert "chip-0-part-1" in slice_device_names(kube)
+        assert _events(kube, "DeviceUnhealthy") == []
+        # eviction is idempotent: the sentinel died with the hb dir
+        drv.health.poll_once()
+        assert len(_events(kube, "SharedTenantEvicted")) == 1
+    finally:
+        drv.stop()
+
+
+def test_stale_heartbeat_tenant_evicted_alone(tmp_path):
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib(),
+                      heartbeat_stale_after=10.0)
+    drv.start()
+    try:
+        for j, uid in enumerate(["u-t0", "u-t1"]):
+            drv.state.prepare(make_claim(
+                kube, uid=uid, name=f"t{j}",
+                devices=(f"chip-0-part-{j}",), config=shared_cfg()))
+            _beat(drv, uid)
+        beat = os.path.join(drv.heartbeat_dir, "u-t1", "beat")
+        os.utime(beat, (1.0, 1.0))           # 1970: long stale
+        drv.health.poll_once()
+        assert drv.state.tenancy.shared_uids() == frozenset({"u-t0"})
+        evs = _events(kube, "SharedTenantEvicted")
+        assert len(evs) == 1
+        assert EVICT_REASON_STALE in evs[0]["message"]
+        assert _events(kube, "DeviceUnhealthy") == [], \
+            "shared-tenant staleness must not condemn the chip"
+    finally:
+        drv.stop()
+
+
+def test_tenant_without_beat_is_left_alone(tmp_path):
+    """No heartbeat at all = not every workload opts into the shim; the
+    sweep only acts on explicit fault evidence (oom sentinel or a beat
+    that went stale)."""
+    kube = FakeKube()
+    drv = make_driver(tmp_path, kube, FakeTpuLib(),
+                      heartbeat_stale_after=0.01)
+    drv.start()
+    try:
+        drv.state.prepare(make_claim(
+            kube, uid="u-quiet", name="quiet",
+            devices=("chip-0-part-0",), config=shared_cfg()))
+        drv.health.poll_once()
+        assert drv.state.tenancy.shared_uids() == frozenset({"u-quiet"})
+        assert _events(kube, "SharedTenantEvicted") == []
+    finally:
+        drv.stop()

@@ -137,6 +137,15 @@ EXPORTED_ENV: dict[str, str] = {
     "TPU_CHIPS_PER_PROCESS_BOUNDS": "consumed by libtpu (topology "
                                     "bounds)",
     "TPU_PROCESS_BOUNDS": "consumed by libtpu (topology bounds)",
+    # shared-tenancy isolation surface (docs/sharing.md): per-tenant
+    # edits emitted by plugins/tpu/tenancy.py for libtpu/the workload
+    "TPU_SHARE_WEIGHT": "tenant's fair-share weight, exported for "
+                        "workload introspection (docs/sharing.md)",
+    "TPU_PROCESS_PRIORITY": "consumed by libtpu (scheduling priority "
+                            "mapped from the fair-share weight)",
+    "TPU_HBM_LIMIT_BYTES": "per-minor HBM budget prefix — the real vars "
+                           "are TPU_HBM_LIMIT_BYTES_<minor>, consumed "
+                           "by libtpu (docs/sharing.md)",
 }
 
 # standard k8s condition keys: the CRD schema leaves conditions as

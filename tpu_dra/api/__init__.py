@@ -1,9 +1,10 @@
 """``resource.tpu.google.com/v1beta1`` — the driver's importable API surface.
 
 Analog of reference ``api/nvidia.com/resource/v1beta1`` (api.go:26-75): the
-``TpuSliceDomain`` CRD type, four opaque-config kinds (``TpuConfig``,
-``TpuSubSliceConfig``, ``SliceChannelConfig``, ``SliceDaemonConfig``), a
-strict decoder registry, and the common ``Normalize()/Validate()`` interface.
+``TpuSliceDomain`` CRD type, five opaque-config kinds (``TpuConfig``,
+``TpuSubSliceConfig``, ``TpuSharedConfig``, ``SliceChannelConfig``,
+``SliceDaemonConfig``), a strict decoder registry, and the common
+``Normalize()/Validate()`` interface.
 """
 
 from tpu_dra.api.configs import (  # noqa: F401
@@ -11,8 +12,10 @@ from tpu_dra.api.configs import (  # noqa: F401
     SliceDaemonConfig,
     TpuConfig,
     TpuMultiProcessConfig,
+    TpuSharedConfig,
     TpuSharing,
     TpuSubSliceConfig,
+    FAIR_SHARE_DEFAULT_WEIGHT,
     SHARING_STRATEGY_EXCLUSIVE,
     SHARING_STRATEGY_MULTI_PROCESS,
 )

@@ -38,6 +38,13 @@ GROUP_VERSION = f"{API_GROUP}/{API_VERSION}"
 SHARING_STRATEGY_EXCLUSIVE = "Exclusive"
 SHARING_STRATEGY_MULTI_PROCESS = "MultiProcess"
 
+# fair-share weight bounds for shared-tenancy claims (TpuSharedConfig):
+# the weight is relative — a tenant's share of the chip's host dispatch
+# and of the per-tenant chip-seconds split is weight / sum(weights)
+FAIR_SHARE_DEFAULT_WEIGHT = 10
+FAIR_SHARE_WEIGHT_MIN = 1
+FAIR_SHARE_WEIGHT_MAX = 100
+
 _UUID_RE = re.compile(r"^tpu-[0-9a-f]{8}(-[0-9a-f]{4}){3}-[0-9a-f]{12}$")
 _INDEX_RE = re.compile(r"^[0-9]+$")
 
@@ -306,6 +313,75 @@ class TpuSubSliceConfig:
                 f"{SUBSLICE_PROFILES}")
         if self.sharing is not None:
             self.sharing.validate()
+
+
+@dataclass
+class TpuSharedConfig:
+    """Fractional shared-tenancy opaque config (ISSUE 17) — the second
+    MIG-profile analog next to :class:`TpuSubSliceConfig`, but for
+    *multi-tenant* sharing: it applies to the ``chip-<i>-part-<j>``
+    partition devices a shared-enabled node publishes, so N independent
+    ResourceClaims can each bind a fraction of one physical chip.
+
+    ``weight`` is the tenant's fair share: it sets the tenant's slice of
+    the per-tenant chip-seconds split (``utilization.py``) and maps onto
+    ``TPU_PROCESS_PRIORITY`` for the host-side dispatch path (the same
+    TimeSlicing-interval analog MultiProcess uses).  ``hbmLimit``
+    optionally tightens the tenant's HBM budget below its partitions'
+    advertised ``hbmBytes`` share; it can never loosen it (validated at
+    prepare against the actual partition capacity)."""
+
+    KIND = "TpuSharedConfig"
+
+    weight: int = FAIR_SHARE_DEFAULT_WEIGHT
+    hbm_limit: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        _check_unknown(data, {"apiVersion", "kind", "weight", "hbmLimit"},
+                       cls.KIND)
+        return cls(weight=data.get("weight", FAIR_SHARE_DEFAULT_WEIGHT),
+                   hbm_limit=data.get("hbmLimit"))
+
+    def to_dict(self) -> dict:
+        out = {"apiVersion": GROUP_VERSION, "kind": self.KIND}
+        if self.weight != FAIR_SHARE_DEFAULT_WEIGHT:
+            out["weight"] = self.weight
+        if self.hbm_limit is not None:
+            out["hbmLimit"] = self.hbm_limit
+        return out
+
+    def normalize(self) -> "TpuSharedConfig":
+        return self
+
+    def validate(self) -> None:
+        # type BEFORE range, like maxProcesses: this is workload-author
+        # controlled input on the kubelet plugin path — weight: "10" or
+        # weight: true must die as a typed ConfigError, not a TypeError
+        if isinstance(self.weight, bool) or \
+                not isinstance(self.weight, int):
+            raise ConfigError(
+                f"{self.KIND}.weight: expected an integer, got "
+                f"{type(self.weight).__name__}")
+        if not FAIR_SHARE_WEIGHT_MIN <= self.weight \
+                <= FAIR_SHARE_WEIGHT_MAX:
+            raise ConfigError(
+                f"{self.KIND}.weight {self.weight} outside "
+                f"[{FAIR_SHARE_WEIGHT_MIN}, {FAIR_SHARE_WEIGHT_MAX}]")
+        if self.hbm_limit is not None:
+            if not isinstance(self.hbm_limit, str):
+                raise ConfigError(
+                    f"{self.KIND}.hbmLimit: expected a quantity string, "
+                    f"got {type(self.hbm_limit).__name__}")
+            try:
+                limit = parse_quantity(self.hbm_limit)
+            except ValueError as exc:
+                raise ConfigError(
+                    f"{self.KIND}.hbmLimit: {exc}") from exc
+            if limit <= 0:
+                raise ConfigError(
+                    f"{self.KIND}.hbmLimit must be positive, got "
+                    f"{self.hbm_limit!r}")
 
 
 @dataclass

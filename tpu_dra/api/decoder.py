@@ -16,6 +16,7 @@ from tpu_dra.api.configs import (
     SliceChannelConfig,
     SliceDaemonConfig,
     TpuConfig,
+    TpuSharedConfig,
     TpuSubSliceConfig,
 )
 
@@ -30,8 +31,8 @@ def registered_kinds() -> list[str]:
     return sorted(_REGISTRY)
 
 
-for _cls in (TpuConfig, TpuSubSliceConfig, SliceChannelConfig,
-             SliceDaemonConfig):
+for _cls in (TpuConfig, TpuSubSliceConfig, TpuSharedConfig,
+             SliceChannelConfig, SliceDaemonConfig):
     register(_cls)
 
 

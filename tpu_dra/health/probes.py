@@ -88,7 +88,13 @@ class HeartbeatProbe(HealthProbe):
     """Workload heartbeat files: a claim pinned to this chip whose
     heartbeat file exists but stopped updating means the workload wedged
     on the chip.  A missing file passes — not every workload opts into the
-    launcher shim."""
+    launcher shim.
+
+    ``shared_fn`` (ISSUE 17) names claim uids that are shared tenants of
+    their chip: those are SKIPPED here, because one wedged tenant must
+    not condemn the whole chip and its co-tenants — per-tenant staleness
+    is the driver's tenant sweep, which evicts exactly the stale claim
+    while the chip stays Healthy and published."""
 
     name = "workload-heartbeat"
 
@@ -96,10 +102,12 @@ class HeartbeatProbe(HealthProbe):
                  pinned_fn: Optional[Callable[
                      [], Mapping[str, Iterable[str]]]] = None,
                  stale_after: float = 600.0,
+                 shared_fn: Optional[Callable[[], Iterable[str]]] = None,
                  clock: Callable[[], float] = time.time) -> None:
         self.heartbeat_dir = heartbeat_dir
         self.pinned_fn = pinned_fn
         self.stale_after = stale_after
+        self.shared_fn = shared_fn
         self.clock = clock
 
     def check(self, chip: ChipInfo) -> ProbeResult:
@@ -107,9 +115,13 @@ class HeartbeatProbe(HealthProbe):
             return self.ok("no claim mapping")
         try:
             pinned = self.pinned_fn().get(chip.uuid, ())
+            shared = frozenset(self.shared_fn()) if self.shared_fn \
+                else frozenset()
         except Exception as exc:  # noqa: BLE001 — a probe crash IS a verdict
             return self.fail(f"claim lookup raised: {exc!r}")
         for claim_uid in pinned:
+            if claim_uid in shared:
+                continue   # shared tenant: per-tenant sweep owns staleness
             # host view of the per-claim rw bind mount the claim edits
             # set up (device_state.py _claim_edits): <dir>/<uid>/beat
             path = os.path.join(self.heartbeat_dir, claim_uid, "beat")
@@ -169,6 +181,7 @@ def default_probes(tpulib: TpuLib,
                    pinned_fn: Optional[Callable[
                        [], Mapping[str, Iterable[str]]]] = None,
                    heartbeat_stale_after: float = 600.0,
+                   shared_fn: Optional[Callable[[], Iterable[str]]] = None,
                    ecc_threshold: int = 8) -> list[HealthProbe]:
     """The standard probe set, in check order (cheapest first).
 
@@ -183,6 +196,7 @@ def default_probes(tpulib: TpuLib,
     probes.append(LivenessProbe(tpulib))
     if heartbeat_dir:
         probes.append(HeartbeatProbe(heartbeat_dir, pinned_fn=pinned_fn,
-                                     stale_after=heartbeat_stale_after))
+                                     stale_after=heartbeat_stale_after,
+                                     shared_fn=shared_fn))
     probes.append(EccProbe(tpulib, threshold=ecc_threshold))
     return probes

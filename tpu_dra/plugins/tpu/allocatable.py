@@ -11,46 +11,63 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo
+from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo, PartitionInfo
 
 TYPE_CHIP = "chip"
 TYPE_CORE = "core"
+TYPE_PARTITION = "partition"
 
 
 @dataclass
 class AllocatableDevice:
-    """Tagged union — exactly one of chip/core is set (allocatable.go:25-99)."""
+    """Tagged union — exactly one of chip/core/partition is set
+    (allocatable.go:25-99; partitions are the ISSUE 17 shared-tenancy
+    member)."""
 
     chip: Optional[ChipInfo] = None
     core: Optional[CoreInfo] = None
+    partition: Optional[PartitionInfo] = None
 
     def __post_init__(self) -> None:
-        if (self.chip is None) == (self.core is None):
-            raise ValueError("exactly one of chip/core must be set")
+        if sum(x is not None
+               for x in (self.chip, self.core, self.partition)) != 1:
+            raise ValueError(
+                "exactly one of chip/core/partition must be set")
 
     @property
     def type(self) -> str:
-        return TYPE_CHIP if self.chip is not None else TYPE_CORE
+        if self.chip is not None:
+            return TYPE_CHIP
+        if self.core is not None:
+            return TYPE_CORE
+        return TYPE_PARTITION
 
     @property
     def uuid(self) -> str:
-        return self.chip.uuid if self.chip else self.core.uuid
+        return (self.chip or self.core or self.partition).uuid
 
     def canonical_name(self) -> str:
-        return (self.chip or self.core).canonical_name()
+        return (self.chip or self.core or self.partition).canonical_name()
 
 
-def enumerate_allocatable(tpulib, enable_subslices: bool = False
+def enumerate_allocatable(tpulib, enable_subslices: bool = False,
+                          shared_partitions: int = 0
                           ) -> dict[str, AllocatableDevice]:
     """Build the allocatable set keyed by canonical device name — analog of
     ``enumerateAllPossibleDevices`` (gpu nvlib.go:103-154).  Cores are only
-    advertised when sub-slicing is enabled (the MIG-enabled gate analog)."""
+    advertised when sub-slicing is enabled (the MIG-enabled gate analog);
+    ``shared_partitions`` > 1 additionally cuts every chip into that many
+    shared-tenancy partitions (ISSUE 17 — the multi-tenant gate)."""
     out: dict[str, AllocatableDevice] = {}
     for chip in tpulib.enumerate_chips():
         out[chip.canonical_name()] = AllocatableDevice(chip=chip)
         if enable_subslices and chip.family.cores_per_chip > 1:
             for core in chip.cores():
                 out[core.canonical_name()] = AllocatableDevice(core=core)
+        if shared_partitions > 1:
+            for part in chip.partitions(shared_partitions):
+                out[part.canonical_name()] = \
+                    AllocatableDevice(partition=part)
     return out
 
 
@@ -66,9 +83,15 @@ class PreparedDevice:
     request_names: list[str] = field(default_factory=list)
     cdi_device_ids: list[str] = field(default_factory=list)
     parent_uuid: str = ""
+    # shared-tenancy ledger fields (ISSUE 17; additive with from_dict
+    # defaults so checkpoint payloads stay v1-compatible): the tenant's
+    # fair-share weight and the partition's effective HBM budget, so the
+    # tenancy ledger rebuilds losslessly from the checkpoint after a crash
+    share_weight: int = 0
+    hbm_bytes: int = 0
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "type": self.type,
             "uuid": self.uuid,
             "canonicalName": self.canonical_name,
@@ -76,6 +99,11 @@ class PreparedDevice:
             "cdiDeviceIDs": list(self.cdi_device_ids),
             "parentUUID": self.parent_uuid,
         }
+        if self.share_weight:
+            out["shareWeight"] = self.share_weight
+        if self.hbm_bytes:
+            out["hbmBytes"] = self.hbm_bytes
+        return out
 
     @classmethod
     def from_dict(cls, data: dict) -> "PreparedDevice":
@@ -86,6 +114,8 @@ class PreparedDevice:
             request_names=list(data.get("requestNames", [])),
             cdi_device_ids=list(data.get("cdiDeviceIDs", [])),
             parent_uuid=data.get("parentUUID", ""),
+            share_weight=int(data.get("shareWeight", 0)),
+            hbm_bytes=int(data.get("hbmBytes", 0)),
         )
 
 

@@ -22,6 +22,7 @@ from tpu_dra.api import decode
 from tpu_dra.api.configs import (
     ConfigError,
     TpuConfig,
+    TpuSharedConfig,
     TpuSubSliceConfig,
 )
 from tpu_dra.cdi.spec import CDIHandler, ContainerEdits
@@ -31,11 +32,13 @@ from tpu_dra.plugins.tpu.allocatable import (
     PreparedDevice,
     TYPE_CHIP,
     TYPE_CORE,
+    TYPE_PARTITION,
     enumerate_allocatable,
 )
 from tpu_dra.plugins.tpu.checkpoint import Checkpoint
 from tpu_dra.plugins.tpu.placement import claim_score, placement_metrics
 from tpu_dra.plugins.tpu.sharing import MultiProcessManager, hbm_defense_env
+from tpu_dra.plugins.tpu.tenancy import TenancyLedger, tenant_edits
 from tpu_dra.resilience import failpoint
 from tpu_dra.tpulib.discovery import TpuLib
 from tpu_dra.trace import propagation, start_span
@@ -67,6 +70,12 @@ _PREPARE_FPS = (
         "per-claim CDI spec on disk, checkpoint entry NOT yet written "
         "(the orphan-spec reconcile window)", crash_safe=True),
     failpoint.register(
+        "tpu.prepare.after_tenant_pin",
+        "checkpoint entry staged and the tenancy ledger pinned (shared "
+        "claims); durability pending — a crash here must rebuild the "
+        "ledger from the checkpoint without orphaning co-tenant state",
+        crash_safe=True),
+    failpoint.register(
         "tpu.prepare.after_checkpoint",
         "claim fully checkpointed; crash before returning means the "
         "kubelet retries an already-prepared claim", crash_safe=True),
@@ -88,6 +97,11 @@ _UNPREPARE_FPS = (
         "tpu.unprepare.after_cdi_delete",
         "claim CDI spec deleted; checkpoint entry still present "
         "(a retried unprepare must converge)", crash_safe=True),
+    failpoint.register(
+        "tpu.unprepare.after_tenant_unpin",
+        "checkpoint removal staged and the tenant unpinned from the "
+        "tenancy ledger; co-tenants of the same chip must be untouched",
+        crash_safe=True),
     failpoint.register(
         "tpu.unprepare.after_checkpoint",
         "claim fully unprepared and checkpoint saved", crash_safe=True),
@@ -131,6 +145,10 @@ class DeviceStateConfig:
     cdi_root: str
     driver_root: str = "/"
     enable_subslices: bool = True
+    # shared tenancy (ISSUE 17): cut every chip into this many fractional
+    # partitions and publish them as chip-<i>-part-<j> devices; 0 (the
+    # default) keeps the node exclusive/sub-slice only
+    shared_partitions: int = 0
     driver_name: str = DRIVER_NAME
     # duck-typed health veto (tpu_dra.health.HealthMonitor): is_serving
     # (uuid) + state_of(uuid); None disables the gate
@@ -149,18 +167,25 @@ class DeviceState:
         self.tpulib = cfg.tpulib
         self.fabric_id = self.tpulib.fabric_id()
         self.allocatable = enumerate_allocatable(
-            cfg.tpulib, enable_subslices=cfg.enable_subslices)
+            cfg.tpulib, enable_subslices=cfg.enable_subslices,
+            shared_partitions=cfg.shared_partitions)
         self.cdi = CDIHandler(cfg.cdi_root, cfg.driver_root)
-        # every allocatable device — chips AND cores — needs a base-spec
-        # entry, since prepare hands out a standard CDI ID for each (cores
-        # carry their parent chip's device nodes)
+        # every allocatable device — chips, cores AND partitions — needs a
+        # base-spec entry, since prepare hands out a standard CDI ID for
+        # each (cores and partitions carry their parent chip's device nodes)
         self.cdi.create_standard_spec(
-            [d.chip or d.core for d in self.allocatable.values()])
+            [d.chip or d.core or d.partition
+             for d in self.allocatable.values()])
+        self.tenancy = TenancyLedger()
         self.mp_manager = MultiProcessManager(slots_root=cfg.plugin_dir)
         self.checkpoint = Checkpoint(f"{cfg.plugin_dir}/checkpoint.json",
                                      quiesce_s=cfg.checkpoint_quiesce_s)
         if not self.checkpoint.load():
             self.checkpoint.save()  # create-if-missing, device_state.go:94-125
+        # the tenancy ledger is DERIVED state: rebuild it wholesale from
+        # the checkpoint so a crash at any failpoint above/below converges
+        # to the same shared-tenant view the pre-crash process had
+        self.tenancy.rebuild(self.checkpoint.prepared.values())
         # reconcile on-disk claim specs against the checkpoint: a crash
         # between create_claim_spec and checkpoint.put leaves an orphan
         for uid in self.cdi.list_claim_specs():
@@ -200,6 +225,7 @@ class DeviceState:
         """
         uid = claim["metadata"]["uid"]
         fresh = False
+        pinned_shared = False
         with self._mu:
             failpoint.hit("tpu.prepare.begin")  # vet: ignore[blocking-under-lock]
             existing = self.checkpoint.get(uid)
@@ -243,6 +269,13 @@ class DeviceState:
                     name=claim["metadata"].get("name", ""),
                     devices=devices)
                 self.checkpoint.put(prepared, flush=False)
+                pinned_shared = self.tenancy.pin(prepared)
+        # the pin itself must happen under the lock (ledger and
+        # checkpoint move together); the crash point sits just outside
+        # it — the on-disk state a kill observes here is identical
+        # (checkpoint unflushed, CDI spec on disk, slot pool created)
+        if pinned_shared:
+            failpoint.hit("tpu.prepare.after_tenant_pin")
         # group commit, off the state lock: everything mutated above —
         # and by any concurrent prepare/unprepare — becomes durable with
         # one fsync pair before prepare reports success.  The idempotent
@@ -278,6 +311,12 @@ class DeviceState:
             self.cdi.delete_claim_spec(claim_uid)
             failpoint.hit("tpu.unprepare.after_cdi_delete")  # vet: ignore[blocking-under-lock]
             self.checkpoint.remove(claim_uid, flush=False)
+            unpinned_shared = self.tenancy.unpin(claim_uid)
+        # crash point outside the lock, same rationale as
+        # tpu.prepare.after_tenant_pin: disk state at a kill here is
+        # what a kill before lock release would have observed
+        if unpinned_shared:
+            failpoint.hit("tpu.unprepare.after_tenant_unpin")
         self.checkpoint.barrier()
         failpoint.hit("tpu.unprepare.after_checkpoint")
 
@@ -383,6 +422,7 @@ class DeviceState:
             edits = self._group_edits(config, devices, uid)
             for dev, result in zip(devices, state.results):
                 name = dev.canonical_name()
+                sub = dev.core or dev.partition
                 prepared.append(PreparedDevice(
                     type=dev.type,
                     uuid=dev.uuid,
@@ -392,8 +432,17 @@ class DeviceState:
                         self.cdi.standard_device_id(name),
                         self.cdi.claim_device_id(uid, name),
                     ],
-                    parent_uuid=(dev.core.parent_uuid
-                                 if dev.core is not None else ""),
+                    parent_uuid=(sub.parent_uuid if sub is not None
+                                 else ""),
+                    # tenancy ledger facts ride the checkpoint (crash-safe
+                    # rebuild): the fair-share weight and the partition's
+                    # advertised HBM budget
+                    share_weight=(config.weight
+                                  if dev.partition is not None
+                                  and isinstance(config, TpuSharedConfig)
+                                  else 0),
+                    hbm_bytes=(dev.partition.hbm_bytes
+                               if dev.partition is not None else 0),
                 ))
                 edits_out[name] = edits
         self._check_overlap(uid, all_devices)
@@ -441,7 +490,7 @@ class DeviceState:
             return
         for dev in devices:
             chip_uuid = (dev.chip.uuid if dev.chip is not None
-                         else dev.core.parent_uuid)
+                         else (dev.core or dev.partition).parent_uuid)
             if not health.is_serving(chip_uuid):
                 raise DeviceUnhealthyError(
                     f"claim {uid}: device {dev.canonical_name()} is on "
@@ -449,12 +498,14 @@ class DeviceState:
                     f"{health.state_of(chip_uuid)}; refusing to prepare "
                     f"a claim on an unhealthy chip")
 
-    def _parent_chip(self, core) -> object:
+    def _parent_chip(self, sub) -> object:
+        """Parent ChipInfo of a sub-chip device (CoreInfo or
+        PartitionInfo — both carry ``parent_uuid``)."""
         for d in self.allocatable.values():
-            if d.chip is not None and d.chip.uuid == core.parent_uuid:
+            if d.chip is not None and d.chip.uuid == sub.parent_uuid:
                 return d.chip
         raise PrepareError(
-            f"core {core.uuid}: parent chip {core.parent_uuid} not "
+            f"device {sub.uuid}: parent chip {sub.parent_uuid} not "
             f"allocatable on this node")
 
     def _group_edits(self, config, devices: list[AllocatableDevice],
@@ -483,8 +534,10 @@ class DeviceState:
         edits = ContainerEdits()
         chips = {d.chip.uuid: d.chip for d in devices if d.type == TYPE_CHIP}
         cores = [d.core for d in devices if d.type == TYPE_CORE]
+        parts = [d.partition for d in devices if d.type == TYPE_PARTITION]
         parent_chips = {c.parent_uuid: self._parent_chip(c) for c in cores}
-        visible = sorted({**chips, **parent_chips}.values(),
+        part_parents = {p.parent_uuid: self._parent_chip(p) for p in parts}
+        visible = sorted({**chips, **parent_chips, **part_parents}.values(),
                          key=lambda c: c.minor)
         if visible:
             edits.env.update(self.tpulib.visible_chips_env(visible))
@@ -502,6 +555,18 @@ class DeviceState:
                 # exclusive chip to the core's share (sharing.py
                 # hbm_defense_env owns the uniformity rule)
                 edits.env.update(hbm_defense_env(limits))
+        if parts:
+            # shared tenancy (ISSUE 17): _check_profile guarantees a
+            # TpuSharedConfig group is partitions-only, so no chip/core
+            # env can collide — the tenant gets its HBM budget, weight,
+            # priority, and a per-tenant slot pool on top of the parent
+            # chip visibility env built above
+            with start_span("prepare.tenancy_setup",
+                            attributes={"claim": claim_uid}):
+                edits = edits.merge(tenant_edits(
+                    config, parts, part_parents, claim_uid,
+                    slots_root=self.cfg.plugin_dir,
+                    hbm_defense_env=hbm_defense_env))
         sharing = getattr(config, "sharing", None)
         if sharing is not None and sharing.is_multi_process():
             with start_span("prepare.sharing_setup",
@@ -561,10 +626,15 @@ class DeviceState:
         claims AND within the claim being prepared."""
         chips_in_use: set[str] = set()
         cores_parent_in_use: set[str] = set()
+        parts_in_use: set[str] = set()
+        parts_parent_in_use: set[str] = set()
         for c in self.checkpoint.prepared.values():
             for d in c.devices:
                 if d.type == TYPE_CHIP:
                     chips_in_use.add(d.uuid)
+                elif d.type == TYPE_PARTITION:
+                    parts_in_use.add(d.uuid)
+                    parts_parent_in_use.add(d.parent_uuid)
                 else:
                     cores_parent_in_use.add(d.parent_uuid)
         seen: set[str] = set()
@@ -579,13 +649,44 @@ class DeviceState:
                     raise PrepareError(
                         f"claim {uid}: chip {dev.uuid} has sub-slice cores "
                         f"prepared by another claim")
+                if dev.uuid in parts_parent_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: chip {dev.uuid} has shared-tenant "
+                        f"partitions prepared by another claim")
                 chips_in_use.add(dev.uuid)
+            elif dev.type == TYPE_PARTITION:
+                # a partition is an exclusive slice of the HBM budget —
+                # double-booking it would hand two tenants one budget —
+                # and mixing accounting models on one chip (cores use
+                # memorySlice capacities, partitions do not) would
+                # double-count the HBM both ways
+                if dev.uuid in parts_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: partition {dev.canonical_name()} is "
+                        f"already prepared for another claim")
+                parent = dev.partition.parent_uuid
+                if parent in chips_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: parent chip {parent} is prepared as "
+                        f"a full chip (by another claim or this one)")
+                if parent in cores_parent_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: parent chip {parent} has sub-slice "
+                        f"cores prepared; cores and shared partitions "
+                        f"cannot co-reside on one chip")
+                parts_in_use.add(dev.uuid)
+                parts_parent_in_use.add(parent)
             else:
                 parent = dev.core.parent_uuid
                 if parent in chips_in_use:
                     raise PrepareError(
                         f"claim {uid}: parent chip {parent} is prepared as "
                         f"a full chip (by another claim or this one)")
+                if parent in parts_parent_in_use:
+                    raise PrepareError(
+                        f"claim {uid}: parent chip {parent} has shared-"
+                        f"tenant partitions prepared; cores and shared "
+                        f"partitions cannot co-reside on one chip")
                 cores_parent_in_use.add(parent)
 
     @staticmethod
@@ -595,8 +696,24 @@ class DeviceState:
             if bad:
                 raise ConfigError(
                     f"TpuSubSliceConfig applies to sub-chip cores; got {bad}")
+        elif isinstance(config, TpuSharedConfig):
+            bad = [d.canonical_name() for d in devices
+                   if d.type != TYPE_PARTITION]
+            if bad:
+                raise ConfigError(
+                    f"TpuSharedConfig applies to shared-tenant partitions; "
+                    f"got {bad}")
         elif isinstance(config, TpuConfig):
-            pass
+            # partition devices REQUIRE a TpuSharedConfig: a tenant
+            # prepared under the exclusive default would get no HBM
+            # budget, weight, or slot cap — silent isolation loss
+            bad = [d.canonical_name() for d in devices
+                   if d.type == TYPE_PARTITION]
+            if bad:
+                raise ConfigError(
+                    f"shared-tenant partitions require a TpuSharedConfig "
+                    f"(DeviceClass or claim opaque config); got {bad} "
+                    f"under {type(config).__name__}")
         else:
             raise ConfigError(
                 f"config kind {type(config).__name__} is not valid for "

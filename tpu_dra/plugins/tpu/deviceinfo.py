@@ -15,7 +15,7 @@ from __future__ import annotations
 from typing import Union
 
 from tpu_dra.api.quantity import format_quantity
-from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo
+from tpu_dra.tpulib.discovery import ChipInfo, CoreInfo, PartitionInfo
 from tpu_dra.tpulib.topology import (
     coords_to_index,
     parse_topology,
@@ -106,4 +106,39 @@ def core_device(core: CoreInfo, chip: ChipInfo, fabric_id: str = "") -> dict:
             "basic": {"attributes": attributes, "capacity": capacity}}
 
 
-AllocatableInfo = Union[ChipInfo, CoreInfo]
+def partition_device(part: PartitionInfo, chip: ChipInfo,
+                     fabric_id: str = "") -> dict:
+    """Fractional shared-tenant partition Device (ISSUE 17) — the
+    multi-tenant MIG-profile analog: ``chip-<i>-part-<j>`` entries the
+    standard DRA allocator can bind to independent claims.  ``partOf``
+    names the parent chip device (the ``matchAttribute`` handle a
+    scheduler uses to keep or avoid co-residency) and ``hbmBytes``
+    carries the partition's budget for CEL capacity selectors.  Like
+    cores, partitions are capacity-backed, not hardware-isolated; the
+    node-side overlap check is what makes a partition and its full chip
+    mutually exclusive."""
+    attributes = {
+        "type": _attr_str("partition"),
+        "uuid": _attr_str(part.uuid),
+        "partOf": _attr_str(chip.canonical_name()),
+        "parentUUID": _attr_str(part.parent_uuid),
+        "parentIndex": _attr_int(part.parent_index),
+        "partitionIndex": _attr_int(part.part_index),
+        "partitionsPerChip": _attr_int(part.count),
+        "hbmBytes": _attr_int(part.hbm_bytes),
+        "family": _attr_str(chip.family.name),
+        "acceleratorType": _attr_str(chip.accelerator_type),
+        "topology": _attr_str(chip.topology),
+        "workerID": _attr_int(chip.worker_id),
+        "multiHostCapable": _attr_bool(bool(fabric_id)),
+    }
+    if fabric_id:
+        attributes["fabricID"] = _attr_str(fabric_id)
+    capacity = {
+        "hbm": {"value": format_quantity(part.hbm_bytes)},
+    }
+    return {"name": part.canonical_name(),
+            "basic": {"attributes": attributes, "capacity": capacity}}
+
+
+AllocatableInfo = Union[ChipInfo, CoreInfo, PartitionInfo]

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -38,15 +39,25 @@ from tpu_dra.kubeletplugin import (
     PrepareResult,
 )
 from tpu_dra.plugins.metrics import observe_prepare, observe_unprepare
-from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP
+from tpu_dra.plugins.tpu.allocatable import TYPE_CHIP, TYPE_PARTITION
 from tpu_dra.plugins.tpu.device_state import DeviceState, DeviceStateConfig
 from tpu_dra.plugins.tpu.placement import (
     board_from_chips,
     fragmentation_ratio,
     placement_metrics,
 )
+from tpu_dra.plugins.tpu.tenancy import (
+    EVICT_REASON_OOM,
+    EVICT_REASON_STALE,
+    OOM_MARKER,
+    tenancy_metrics,
+)
 from tpu_dra.plugins.tpu.utilization import ChipSecondsAccountant
-from tpu_dra.plugins.tpu.deviceinfo import chip_device, core_device
+from tpu_dra.plugins.tpu.deviceinfo import (
+    chip_device,
+    core_device,
+    partition_device,
+)
 from tpu_dra.tpulib.discovery import TpuLib
 from tpu_dra.trace import get_tracer, propagation
 from tpu_dra.util import klog
@@ -67,6 +78,9 @@ class TpuDriverConfig:
     cdi_root: str = "/var/run/cdi"
     driver_root: str = "/"
     enable_subslices: bool = True
+    # shared tenancy (ISSUE 17): publish this many fractional partitions
+    # per chip (0 = exclusive/sub-slice only)
+    shared_partitions: int = 0
     flock_timeout: float = 10.0   # driver.go:121 uses 10s
     # -- health monitoring -------------------------------------------------
     health_interval: float = 10.0       # <= 0 disables the poll loop
@@ -100,7 +114,11 @@ class TpuDriver:
                 cfg.tpulib,
                 heartbeat_dir=self.heartbeat_dir,
                 pinned_fn=self._pinned_claims,
-                heartbeat_stale_after=cfg.heartbeat_stale_after),
+                heartbeat_stale_after=cfg.heartbeat_stale_after,
+                # a shared tenant's stale beat must not condemn the chip
+                # and its co-tenants: the probe skips tenants, the tenant
+                # sweep below evicts exactly the stale claim (ISSUE 17)
+                shared_fn=self._shared_tenant_uids),
             fail_threshold=cfg.health_fail_threshold,
             pass_threshold=cfg.health_pass_threshold)
         # last successfully published exclusion set; None until the first
@@ -114,6 +132,7 @@ class TpuDriver:
             cdi_root=cfg.cdi_root,
             driver_root=cfg.driver_root,
             enable_subslices=cfg.enable_subslices,
+            shared_partitions=cfg.shared_partitions,
             health=self.health,
             checkpoint_quiesce_s=cfg.checkpoint_quiesce_s))
         # remediations suppressed during an API blackout, replayed once
@@ -132,8 +151,16 @@ class TpuDriver:
             pinned_fn=self._pinned_claims,
             state_of=self.health.state_of,
             heartbeat_dir=self.heartbeat_dir,
-            active_stale_after=cfg.heartbeat_stale_after)
+            active_stale_after=cfg.heartbeat_stale_after,
+            # shared chips split their chip-second across tenants by
+            # fair-share weight (ISSUE 17)
+            weights_fn=lambda: self.state.tenancy.claim_weights())
         self.health.add_poll_listener(self.utilization.tick)
+        # per-tenant eviction sweep (ISSUE 17): an OOM-flagged or
+        # heartbeat-stale shared tenant is evicted ALONE — typed Event +
+        # unprepare + claim delete for that claim only; the chip stays
+        # Healthy and published and co-tenants keep running
+        self.health.add_poll_listener(self._sweep_tenants)
         # torus fragmentation (ISSUE 13): how much of this node's free
         # board is still reachable through one contiguous sub-mesh —
         # computed off the poll loop (never the prepare hot path) from
@@ -161,9 +188,10 @@ class TpuDriver:
         self.server.stop()
 
     def publish_resources(self) -> None:
-        """driver.go:71-84 — advertise chips (and cores when sub-slicing),
-        minus anything the health monitor holds Unhealthy (a drained chip
-        takes its sub-chip cores with it)."""
+        """driver.go:71-84 — advertise chips (and cores when sub-slicing,
+        partitions when shared tenancy is on), minus anything the health
+        monitor holds Unhealthy (a drained chip takes its sub-chip cores
+        and shared partitions with it)."""
         devices = []
         fabric = self.state.fabric_id
         down = self.health.unhealthy_uuids()
@@ -173,13 +201,18 @@ class TpuDriver:
                     continue
                 devices.append(chip_device(dev.chip, fabric))
             else:
-                if dev.core.parent_uuid in down:
+                sub = dev.core or dev.partition
+                if sub.parent_uuid in down:
                     continue
                 parent = next(
                     d.chip for d in self.state.allocatable.values()
                     if d.chip is not None and
-                    d.chip.uuid == dev.core.parent_uuid)
-                devices.append(core_device(dev.core, parent, fabric))
+                    d.chip.uuid == sub.parent_uuid)
+                if dev.type == TYPE_PARTITION:
+                    devices.append(
+                        partition_device(dev.partition, parent, fabric))
+                else:
+                    devices.append(core_device(dev.core, parent, fabric))
         if down:
             klog.warning("publishing ResourceSlice minus unhealthy chips",
                          node=self.cfg.node_name,
@@ -374,6 +407,105 @@ class TpuDriver:
                              err=repr(exc))
             klog.warning("unprepared and evicted claim on unhealthy chip",
                          claim=uid, chip=t.device)
+
+    # -- shared-tenant eviction (ISSUE 17) ---------------------------------
+    def _shared_tenant_uids(self) -> frozenset:
+        """Claim uids currently pinned as shared tenants (tenancy
+        ledger snapshot; lock-free, poll-thread safe)."""
+        return self.state.tenancy.shared_uids()
+
+    def _tenant_fault(self, uid: str) -> Optional[tuple[str, str]]:
+        """(reason, detail) when tenant ``uid`` violated its contract:
+        an ``oom`` sentinel next to its heartbeat (launcher
+        ``report_hbm_oom`` — the HBM budget was blown), or a beat that
+        exists but went stale past the node threshold.  A tenant with no
+        beat file at all is left alone — not every workload opts into
+        the launcher shim, same contract as the HeartbeatProbe."""
+        claim_dir = os.path.join(self.heartbeat_dir, uid)
+        oom = os.path.join(claim_dir, OOM_MARKER)
+        if os.path.exists(oom):
+            try:
+                with open(oom) as f:
+                    detail = f.read(256).strip()
+            except OSError:
+                detail = ""
+            return (EVICT_REASON_OOM,
+                    detail or "workload reported HBM budget exceeded")
+        try:
+            age = time.time() - os.stat(
+                os.path.join(claim_dir, "beat")).st_mtime
+        except OSError:
+            return None
+        if age > self.cfg.heartbeat_stale_after:
+            return (EVICT_REASON_STALE,
+                    f"tenant heartbeat stale for {age:.0f}s "
+                    f"(limit {self.cfg.heartbeat_stale_after:.0f}s)")
+        return None
+
+    def _sweep_tenants(self) -> None:
+        """Poll listener: evict shared tenants that blew their HBM
+        budget or wedged — each ALONE.  Unlike chip remediation this is
+        not policy-gated: freeing the partition is what protects the
+        co-tenants, and the blast radius is exactly one claim.  During
+        an API blackout the sweep skips (the fault condition persists on
+        disk, so the next closed-breaker poll retries)."""
+        shared = self._shared_tenant_uids()
+        if not shared:
+            return
+        if self._api_blackout():
+            return
+        for uid in sorted(shared):
+            fault = self._tenant_fault(uid)
+            if fault is not None:
+                try:
+                    self._evict_tenant(uid, *fault)
+                except Exception as exc:  # noqa: BLE001 — per-tenant:
+                    # one stuck eviction must not block the others or
+                    # kill the poll loop
+                    klog.error("tenant eviction failed", claim=uid,
+                               err=repr(exc))
+
+    def _evict_tenant(self, uid: str, reason: str, detail: str) -> None:
+        claim = self.state.prepared_claims().get(uid)
+        rec = self.state.tenancy.record(uid)
+        if claim is None or rec is None:
+            return
+        involved = {
+            "apiVersion":
+                f"{RESOURCE_CLAIMS.group}/{RESOURCE_CLAIMS.version}",
+            "kind": "ResourceClaim",
+            "metadata": {"name": claim.name,
+                         "namespace": claim.namespace,
+                         "uid": uid},
+        }
+        emit_event(
+            self.cfg.kube, involved, "SharedTenantEvicted",
+            f"shared tenant evicted from chip(s) "
+            f"{','.join(rec.chip_uuids)}: {detail} (reason={reason}); "
+            f"co-tenants are unaffected and the chip stays published",
+            EVENT_TYPE_WARNING)
+        # unprepare removes the tenant's heartbeat dir (and with it the
+        # oom sentinel), so the sweep cannot re-trigger on this uid
+        with locked(self.flock_path, timeout=self.cfg.flock_timeout):
+            self.state.unprepare(uid)
+        tenancy_metrics()["tenant_evictions"].inc(reason)
+        try:
+            # uid-guarded delete, same rationale as _remediate: never
+            # evict a same-name successor claim
+            current = self.cfg.kube.get(RESOURCE_CLAIMS, claim.name,
+                                        claim.namespace)
+            if current.get("metadata", {}).get("uid") == uid:
+                self.cfg.kube.delete(RESOURCE_CLAIMS, claim.name,
+                                     claim.namespace)
+        except NotFound:
+            pass
+        except Exception as exc:  # noqa: BLE001 — eviction is
+            # best-effort; the unprepare already freed the partition
+            klog.warning("tenant claim delete failed", claim=uid,
+                         err=repr(exc))
+        klog.warning("evicted shared tenant; co-tenants unaffected",
+                     claim=uid, reason=reason,
+                     chips=list(rec.chip_uuids))
 
     # -- DRA callbacks -----------------------------------------------------
     def prepare_resource_claims(self, claims: list[dict]

@@ -22,6 +22,10 @@ def plugin_flags() -> FlagGroup:
     return FlagGroup("TPU plugin", [
         Flag("enable-subslices", "ENABLE_SUBSLICES",
              "advertise per-core sub-chip devices", True, bool),
+        Flag("shared-partitions", "SHARED_PARTITIONS",
+             "publish this many fractional shared-tenancy partitions per "
+             "chip (chip-<i>-part-<j> devices; 0 disables multi-tenant "
+             "sharing — docs/sharing.md)", 0, int),
         Flag("ignore-host-tpu-env", "IGNORE_HOST_TPU_ENV",
              "discover topology only from the node metadata file, ignoring "
              "TPU_* variables in the plugin's own environment", False, bool),
@@ -77,6 +81,7 @@ def main(argv=None) -> int:
         cdi_root=args.cdi_root,
         driver_root=args.tpu_driver_root,
         enable_subslices=args.enable_subslices,
+        shared_partitions=args.shared_partitions,
         health_interval=args.health_interval,
         health_fail_threshold=args.health_fail_threshold,
         health_pass_threshold=args.health_pass_threshold,

@@ -102,6 +102,30 @@ def fragmentation_ratio(free: "set[tuple[int, ...]]",
     return fragmentation(free, shape)
 
 
+# -- shared-tenant packing (ISSUE 17) ---------------------------------------
+
+def pack_tenant(free_parts: "dict[str, int]",
+                parts_per_chip: int) -> Optional[str]:
+    """Pick the chip a new small shared claim should land on:
+    ``free_parts`` maps chip name -> free partition count (only chips
+    with at least one free partition).  Bin-pack: prefer the
+    partially-occupied chip with the FEWEST free partitions (ties by
+    name for determinism), so small tenants fill started chips before
+    breaking a pristine one — a pristine chip (all ``parts_per_chip``
+    partitions free) is still a candidate for an exclusive full-chip or
+    contiguous multi-chip claim, and every avoidably-broken one shrinks
+    the largest allocatable sub-mesh (``fragmentation_ratio``).  Returns
+    None when nothing has a free partition."""
+    started = [(n, f) for n, f in free_parts.items()
+               if 0 < f < parts_per_chip]
+    if started:
+        return min(started, key=lambda nf: (nf[1], nf[0]))[0]
+    pristine = [n for n, f in free_parts.items() if f == parts_per_chip]
+    if pristine:
+        return min(pristine)
+    return None
+
+
 # -- hot-path claim scoring -------------------------------------------------
 
 def claim_score(chips: list[ChipInfo]) -> float:

@@ -1,0 +1,262 @@
+"""Multi-tenant chip sharing: the fractional-claim tenancy subsystem
+(ISSUE 17, docs/sharing.md).
+
+The reference driver's TimeSlicing/MPS templates let *independent*
+workloads share one GPU; the seed's :mod:`sharing` MultiProcessManager
+only shares a chip within one claim.  This module is the cross-claim
+half: a shared-enabled node publishes ``chip-<i>-part-<j>`` partition
+devices (``deviceinfo.partition_device``), the standard DRA allocator
+binds independent claims to them, and prepare pins several claim UIDs to
+one physical chip with *per-tenant* isolation edits:
+
+- scoped visibility — the tenant sees only its parent chip
+  (``TPU_VISIBLE_CHIPS`` et al., same env contract as every claim type);
+- an HBM budget — the partition's ``hbmBytes`` share (optionally
+  tightened by ``TpuSharedConfig.hbmLimit``, never loosened) through the
+  existing ``TPU_HBM_LIMIT_BYTES_<minor>`` + ``LIBTPU_INIT_ARGS``
+  defense-in-depth path;
+- a per-tenant slot pool — one flock slot per held partition, so a
+  tenant cannot fan out more processes than its fraction covers;
+- a fair-share weight — ``TPU_SHARE_WEIGHT`` (cooperative signal +
+  the per-tenant chip-seconds split) mapped onto ``TPU_PROCESS_PRIORITY``
+  for the host-side dispatch path.
+
+The :class:`TenancyLedger` tracks which claims share which chip.  It is
+*derived* state: every fact lives in the checkpoint's PreparedDevice
+records (``shareWeight``/``hbmBytes`` ride the v1 payload additively), so
+a crash rebuilds the ledger losslessly from the checkpoint — the ledger
+itself never needs a second durability mechanism.  Mutations happen under
+``DeviceState._mu``; readers (health poll listeners) get lock-free
+consistent snapshots via whole-dict replacement, so no new lock-order
+edge exists.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from tpu_dra.api.configs import (
+    ConfigError,
+    FAIR_SHARE_DEFAULT_WEIGHT,
+    TpuSharedConfig,
+)
+from tpu_dra.api.quantity import parse_quantity
+from tpu_dra.cdi.spec import ContainerEdits
+from tpu_dra.plugins.tpu.allocatable import TYPE_PARTITION
+from tpu_dra.plugins.tpu.sharing import SLOT_DIR_CONTAINER_PATH, _group_id
+from tpu_dra.plugins.tpu.shim import SHIM_CONTAINER_PATH, write_shim_dir
+from tpu_dra.tpulib.discovery import ChipInfo, PartitionInfo
+from tpu_dra.util.fsutil import atomic_write
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+# OOM sentinel the workload launcher drops next to its heartbeat when
+# libtpu reports the HBM budget blown (workloads/launcher.py
+# report_hbm_oom): <heartbeat_dir>/<claim_uid>/oom on the host side.
+# The driver's tenant sweep evicts the writing tenant ALONE.
+OOM_MARKER = "oom"
+
+EVICT_REASON_OOM = "oom"
+EVICT_REASON_STALE = "stale-heartbeat"
+
+_METRICS = None
+
+
+def tenancy_metrics():
+    global _METRICS
+    if _METRICS is None:
+        _METRICS = {
+            "shared_tenants": DEFAULT_REGISTRY.gauge(
+                "tpu_dra_shared_tenants",
+                "shared-tenancy claims currently prepared on this node "
+                "(claims bound to fractional chip partitions)"),
+            "tenant_evictions": DEFAULT_REGISTRY.counter(
+                "tpu_dra_tenant_evictions_total",
+                "shared tenants evicted alone — the chip stays published "
+                "and co-tenants keep running — by trigger reason",
+                ("reason",)),
+        }
+    return _METRICS
+
+
+def priority_for_weight(weight: int) -> str:
+    """Map a fair-share weight onto the ``TPU_PROCESS_PRIORITY`` buckets
+    the launcher already understands (Low/Normal/High niceness): a tenant
+    weighted at least twice the default gets the dispatch path favored,
+    one at half or less yields it.  The raw weight still travels as
+    ``TPU_SHARE_WEIGHT`` for cooperative schedulers that can use more
+    than three buckets."""
+    if weight >= 2 * FAIR_SHARE_DEFAULT_WEIGHT:
+        return "High"
+    if 2 * weight <= FAIR_SHARE_DEFAULT_WEIGHT:
+        return "Low"
+    return "Normal"
+
+
+def effective_limits(config: TpuSharedConfig,
+                     parts: list[PartitionInfo],
+                     parent_chips: dict[str, ChipInfo]) -> dict[int, int]:
+    """Per-parent-minor HBM budget for one tenant's partition group: the
+    sum of its partitions' advertised budgets, optionally *tightened* by
+    ``hbmLimit``.  Loosening is a typed error — the advertised
+    ``hbmBytes`` is what the scheduler packed against, so a config that
+    exceeds it would steal co-tenant headroom."""
+    budgets: dict[int, int] = {}
+    for part in parts:
+        minor = parent_chips[part.parent_uuid].minor
+        budgets[minor] = budgets.get(minor, 0) + part.hbm_bytes
+    if config.hbm_limit is not None:
+        limit = parse_quantity(config.hbm_limit)
+        for minor, budget in budgets.items():
+            if limit > budget:
+                raise ConfigError(
+                    f"{config.KIND}.hbmLimit {config.hbm_limit!r} exceeds "
+                    f"the claim's partition budget {budget} bytes on chip "
+                    f"minor {minor}; a tenant cannot loosen its share")
+            budgets[minor] = limit
+    return budgets
+
+
+def tenant_edits(config: TpuSharedConfig,
+                 parts: list[PartitionInfo],
+                 parent_chips: dict[str, ChipInfo],
+                 claim_uid: str,
+                 slots_root: Optional[str] = None,
+                 hbm_defense_env=None) -> ContainerEdits:
+    """The tenant-specific CDI edits for one TpuSharedConfig group
+    (visibility env for the parent chips is the caller's job — it is
+    shared with the chip/core paths in ``DeviceState._group_edits``).
+
+    Every edit here is per-tenant: co-tenants of one chip each get their
+    own budget, weight, priority, and slot pool; nothing is shared but
+    the physical device nodes."""
+    config.validate()
+    edits = ContainerEdits(env={"TPU_ALLOW_MULTIPLE_LIBTPU_LOAD": "1"})
+    limits = effective_limits(config, parts, parent_chips)
+    for minor, budget in sorted(limits.items()):
+        edits.env[f"TPU_HBM_LIMIT_BYTES_{minor}"] = str(budget)
+    if hbm_defense_env is not None:
+        edits.env.update(hbm_defense_env(limits))
+    weight = config.weight
+    edits.env["TPU_SHARE_WEIGHT"] = str(weight)
+    priority = priority_for_weight(weight)
+    if priority != "Normal":
+        edits.env["TPU_PROCESS_PRIORITY"] = priority
+    if slots_root and claim_uid:
+        # per-tenant slot pool: one flock slot per held partition, so a
+        # tenant's process fan-out is bounded by its fraction of the chip
+        # — same pool mechanics (and launcher/shim consumers) as the
+        # MultiProcess cap, same _group_id naming so the existing
+        # cleanup()/reconcile() sweeps cover tenant pools for free
+        group = _group_id(claim_uid, [p.uuid for p in parts])
+        host_dir = os.path.join(slots_root, "mp-slots", group)
+        os.makedirs(host_dir, exist_ok=True)
+        atomic_write(os.path.join(host_dir, "max"), str(len(parts)),
+                     durable=False)
+        edits.add_mount(host_dir, f"{SLOT_DIR_CONTAINER_PATH}/{group}",
+                        options=["rw", "nosuid", "nodev", "bind"])
+        edits.env["TPU_MULTIPROCESS_SLOT_DIR"] = SLOT_DIR_CONTAINER_PATH
+        edits.env["TPU_MULTIPROCESS_MAX"] = str(len(parts))
+        # non-cooperative enforcement, same as MultiProcess: the
+        # sitecustomize shim applies slot gate + HBM bound + priority
+        # before libtpu init even when the tenant never imports tpu_dra
+        shim_dir = write_shim_dir(slots_root)
+        edits.add_mount(shim_dir, SHIM_CONTAINER_PATH,
+                        options=["ro", "nosuid", "nodev", "bind"])
+        edits.env["PYTHONPATH"] = SHIM_CONTAINER_PATH
+    return edits
+
+
+@dataclass(frozen=True)
+class TenantRecord:
+    """One shared tenant as pinned in the ledger: which chip(s) it
+    shares, through which partitions, at what weight and budget."""
+
+    claim_uid: str
+    chip_uuids: tuple[str, ...]
+    partition_uuids: tuple[str, ...]
+    weight: int
+    hbm_bytes: int
+
+
+class TenancyLedger:
+    """Claim UID → :class:`TenantRecord` for every prepared claim that
+    holds partition devices.
+
+    Derived from the checkpoint (see module docstring): ``rebuild`` at
+    startup, ``pin``/``unpin`` under the DeviceState lock.  Readers are
+    lock-free: every mutation replaces ``_by_claim`` wholesale, so the
+    health poll thread always sees one consistent snapshot and no lock
+    order involving ``DeviceState._mu`` is introduced."""
+
+    def __init__(self) -> None:
+        self._by_claim: dict[str, TenantRecord] = {}
+
+    @staticmethod
+    def _record(prepared) -> Optional[TenantRecord]:
+        parts = [d for d in prepared.devices if d.type == TYPE_PARTITION]
+        if not parts:
+            return None
+        return TenantRecord(
+            claim_uid=prepared.claim_uid,
+            chip_uuids=tuple(sorted({d.parent_uuid for d in parts})),
+            partition_uuids=tuple(sorted(d.uuid for d in parts)),
+            weight=max((d.share_weight for d in parts), default=0)
+                   or FAIR_SHARE_DEFAULT_WEIGHT,
+            hbm_bytes=sum(d.hbm_bytes for d in parts),
+        )
+
+    def rebuild(self, prepared_claims: Iterable) -> None:
+        by_claim = {}
+        for claim in prepared_claims:
+            rec = self._record(claim)
+            if rec is not None:
+                by_claim[claim.claim_uid] = rec
+        self._by_claim = by_claim
+        tenancy_metrics()["shared_tenants"].set(len(by_claim))
+
+    def pin(self, prepared) -> bool:
+        """Pin a freshly-prepared claim; True iff it is a shared tenant."""
+        rec = self._record(prepared)
+        if rec is None:
+            return False
+        by_claim = dict(self._by_claim)
+        by_claim[prepared.claim_uid] = rec
+        self._by_claim = by_claim
+        tenancy_metrics()["shared_tenants"].set(len(by_claim))
+        return True
+
+    def unpin(self, claim_uid: str) -> bool:
+        """Drop a claim on unprepare; True iff it was a shared tenant."""
+        if claim_uid not in self._by_claim:
+            return False
+        by_claim = dict(self._by_claim)
+        del by_claim[claim_uid]
+        self._by_claim = by_claim
+        tenancy_metrics()["shared_tenants"].set(len(by_claim))
+        return True
+
+    # -- lock-free read surface (health poll thread) ----------------------
+    def record(self, claim_uid: str) -> Optional[TenantRecord]:
+        return self._by_claim.get(claim_uid)
+
+    def shared_uids(self) -> frozenset:
+        return frozenset(self._by_claim)
+
+    def claim_weights(self) -> dict[str, float]:
+        """uid → fair-share weight, for the per-tenant chip-seconds
+        split (``utilization.ChipSecondsAccountant``)."""
+        return {uid: float(rec.weight)
+                for uid, rec in self._by_claim.items()}
+
+    def tenants_by_chip(self) -> dict[str, list[TenantRecord]]:
+        out: dict[str, list[TenantRecord]] = {}
+        snapshot = self._by_claim
+        for rec in snapshot.values():
+            for chip in rec.chip_uuids:
+                out.setdefault(chip, []).append(rec)
+        return out
+
+    def count(self) -> int:
+        return len(self._by_claim)

@@ -71,6 +71,12 @@ class ChipSecondsAccountant:
     ``heartbeat_dir`` — the PR-2 per-claim heartbeat root
     (``<dir>/<claim-uid>/beat``); a beat younger than
     ``active_stale_after`` marks the claim's chips active.
+    ``weights_fn`` — claim uid -> fair-share weight (the tenancy
+    ledger's ``claim_weights``, ISSUE 17).  A chip shared by several
+    tenants contributes ONE chip-second per wall second, split across
+    its tenants proportionally to weight; a claim absent from the map
+    weighs 1, which leaves single-claim (exclusive) chips accruing the
+    full ``dt`` exactly as before.
 
     The per-claim split is bounded: a long-lived plugin sees unbounded
     claim churn, so once :data:`MAX_CLAIM_ENTRIES` is reached, entries
@@ -85,10 +91,13 @@ class ChipSecondsAccountant:
                  state_of: Optional[Callable[[str], str]] = None,
                  heartbeat_dir: str = "",
                  active_stale_after: float = 120.0,
+                 weights_fn: Optional[Callable[
+                     [], dict[str, float]]] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._chips_fn = chips_fn
         self._pinned_fn = pinned_fn
         self._state_of = state_of
+        self._weights_fn = weights_fn
         self._heartbeat_dir = heartbeat_dir
         self._active_stale_after = active_stale_after
         self._clock = clock
@@ -133,6 +142,7 @@ class ChipSecondsAccountant:
             if dt <= 0:
                 return
             pinned = self._pinned_fn()
+            weights = self._weights_fn() if self._weights_fn else {}
             # heartbeat freshness per CLAIM, checked once even when the
             # claim spans several chips
             fresh: dict[str, bool] = {}
@@ -148,12 +158,19 @@ class ChipSecondsAccountant:
                     state = STATE_ACTIVE if any(
                         fresh.get(uid) for uid in pinned[chip]) \
                         else STATE_ALLOCATED
+                    # one chip-second per wall second, split across the
+                    # chip's claims by fair-share weight: co-tenants of a
+                    # shared chip divide it; an exclusively-held chip has
+                    # one claim, whose share is the whole dt as before
+                    total_w = sum(weights.get(uid, 1.0)
+                                  for uid in pinned[chip]) or 1.0
                     for uid in pinned[chip]:
+                        share = dt * weights.get(uid, 1.0) / total_w
                         per = self._per_claim.setdefault(
                             uid, {"allocated_s": 0.0, "active_s": 0.0})
-                        per["allocated_s"] += dt
+                        per["allocated_s"] += share
                         if fresh.get(uid):
-                            per["active_s"] += dt
+                            per["active_s"] += share
                 else:
                     state = STATE_IDLE
                 self._totals[state] += dt

@@ -69,6 +69,32 @@ class CoreInfo:
 
 
 @dataclass
+class PartitionInfo:
+    """One fractional shared-tenant partition of a chip (ISSUE 17) — the
+    multi-tenant MIG-profile analog next to :class:`CoreInfo`.
+
+    A partition is a *synthesized* allocation unit, not discovered
+    hardware: a shared-enabled node cuts each chip into ``count`` equal
+    HBM budgets so N independent ResourceClaims can each bind one slice
+    of the chip.  Isolation is capacity-backed like cores (HBM budget via
+    the launcher/libtpu enforcement path), never hardware-partitioned."""
+
+    parent_uuid: str
+    parent_index: int
+    part_index: int           # within the chip
+    count: int                # partitions the chip was cut into
+    hbm_bytes: int            # this partition's HBM budget
+    device_paths: list[str] = field(default_factory=list)  # parent's nodes
+
+    @property
+    def uuid(self) -> str:
+        return f"{self.parent_uuid}-part-{self.part_index}"
+
+    def canonical_name(self) -> str:
+        return f"chip-{self.parent_index}-part-{self.part_index}"
+
+
+@dataclass
 class ChipInfo:
     """One TPU chip and its place in the ICI mesh."""
 
@@ -92,6 +118,19 @@ class ChipInfo:
                      memory_slices=(c,),
                      device_paths=list(self.device_paths))
             for c in range(n)
+        ]
+
+    def partitions(self, count: int) -> list[PartitionInfo]:
+        """Cut the chip into ``count`` equal shared-tenant partitions
+        (``chip-<i>-part-<j>``): each gets 1/count of the chip's HBM as
+        its budget and the parent's device nodes (visibility scoping is
+        per-chip — libtpu has no per-partition device surface)."""
+        per = self.family.hbm_bytes // count
+        return [
+            PartitionInfo(parent_uuid=self.uuid, parent_index=self.index,
+                          part_index=p, count=count, hbm_bytes=per,
+                          device_paths=list(self.device_paths))
+            for p in range(count)
         ]
 
     def canonical_name(self) -> str:

@@ -382,6 +382,33 @@ def start_health_heartbeat(env: Optional[dict[str, str]] = None,
     return list(paths)
 
 
+def report_hbm_oom(env: Optional[dict[str, str]] = None,
+                   detail: str = "") -> list[str]:
+    """Shared-tenancy OOM half of the eviction contract (ISSUE 17,
+    docs/sharing.md): a workload that catches its HBM-budget failure
+    (jax RESOURCE_EXHAUSTED under a ``TPU_HBM_LIMIT_BYTES_*`` budget)
+    drops an ``oom`` sentinel next to each of its ``beat`` files.  On
+    the host side that is ``<heartbeats>/<claim_uid>/oom`` — the
+    driver's tenant sweep evicts exactly this tenant (typed Event +
+    unprepare + claim delete) while co-tenants of the chip keep
+    running.  Advisory like the heartbeat itself: missing env or
+    unwritable paths return an empty list, never raise."""
+    e = os.environ if env is None else env
+    written = []
+    for beat in _heartbeat_paths(e):
+        path = os.path.join(os.path.dirname(beat), "oom")
+        try:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(detail or "workload reported HBM budget exceeded")
+            written.append(path)
+        except OSError:
+            continue   # advisory: never mask the workload's own OOM
+    return written
+
+
 def stop_health_heartbeat() -> None:
     global _HEARTBEAT_THREAD, _HEARTBEAT_STOP, _HEARTBEAT_PATHS
     if _HEARTBEAT_STOP is not None:
