@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-share drive-fleet drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-share drive-fleet drive-obs drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -152,6 +152,18 @@ drive-share:
 # claim path with zero in-flight losses
 drive-fleet:
 	$(PYTHON) hack/drive_fleet.py
+
+# fleet-observability acceptance (docs/observability.md "Fleet
+# observability", ISSUE 18): REAL plugin + router + replicas all
+# spooling spans — one hero trace id merged across >=4 processes from
+# spool files AND live /debug/traces, critical-path self-times
+# telescoping to the root wall time within 10%, the tail-vs-median
+# differential naming the armed serve.engine.slow_decode failpoint's
+# span as the p99 culprit (in-process and via `python -m tpu_dra.obs
+# report`), and a SIGQUIT'd replica leaving a readable flight-recorder
+# postmortem (spans + klog tail + metric deltas)
+drive-obs:
+	$(PYTHON) hack/drive_obs.py
 
 proto:
 	cd tpu_dra/kubeletplugin/proto && \

@@ -265,6 +265,58 @@ def bench_router_decision(n: int = 50_000, repeats: int = 3) -> dict:
     return {"n": n, "per_decision_us": round(best / n * 1e6, 4)}
 
 
+def bench_obs_ingest_idle(n: int = 20_000, repeats: int = 3) -> dict:
+    """ISSUE 18 collector gate: accepting one already-parsed span into
+    the collector (dedup probe + bounded-store append + rolling anomaly
+    baseline check) is the per-span cost of the whole observability
+    plane — at fleet scale it runs for every span every binary emits.
+    The anomaly detector's percentile recompute is amortised over
+    ``REFRESH_EVERY`` admitted samples; this gate is what keeps that
+    amortisation honest.  Spans all share one name so the measurement
+    covers the WARM baseline path, not the silent warmup."""
+    from tpu_dra.obs.collector import Collector
+
+    batches = []
+    for r in range(repeats):
+        batches.append([{
+            "name": "bench.op", "service": "bench", "thread": "t",
+            "trace_id": f"{r:02d}", "span_id": f"{r:02d}-{i:08d}",
+            "parent_id": "", "start": float(i), "duration": 0.004,
+            "status": "ok", "attributes": {}, "events": [],
+        } for i in range(n)])
+    best = float("inf")
+    for batch in batches:
+        col = Collector(max_spans=n + 1)
+        col.add_spans(batch[:1])            # windows + series minted
+        t0 = time.perf_counter()
+        col.add_spans(batch)
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_span_us": round(best / n * 1e6, 4)}
+
+
+def bench_flight_recorder_idle(n: int = 200_000, repeats: int = 3) -> dict:
+    """ISSUE 18 black-box gate: the flight recorder is ALWAYS on, so
+    its per-log-line cost while healthy — the klog tap appending into
+    the bounded tail deque — lands on every log statement in every
+    binary.  It must stay a single bounded append (GIL-atomic, no
+    lock, no formatting); a regression here taxes hot paths that merely
+    log.  The recorder is constructed directly (not installed) so the
+    bench does not hook this process's excepthooks or signal handlers."""
+    from tpu_dra.obs.recorder import FlightRecorder
+    from tpu_dra.util.metrics import Registry
+
+    rec = FlightRecorder("bench", registry=Registry(), dump_dir="")
+    tap = rec._tap
+    line = "I2026-01-01T00:00:00.000000Z bench idle probe key='value'"
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tap(line)
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_line_us": round(best / n * 1e6, 4)}
+
+
 def bench_kernel_throughput() -> dict:
     """Kernel-throughput ratchet section (ISSUE 10): floors for the
     Pallas kernel family (matmul, flash, the fused collective matmuls),
@@ -510,6 +562,8 @@ def run_all() -> dict:
         "alloc_score": bench_alloc_score(),
         "tenancy_setup": bench_tenancy_setup(base),
         "router_decision": bench_router_decision(),
+        "obs_ingest": bench_obs_ingest_idle(),
+        "flight_recorder": bench_flight_recorder_idle(),
         "kernels": bench_kernel_throughput(),
         "direct": bench_direct(base),
         "concurrent": bench_concurrent(base),
@@ -556,6 +610,10 @@ def _gates(report: dict) -> dict[str, float]:
             report["tenancy_setup"]["per_setup_us"],
         "router_decision_us":
             report["router_decision"]["per_decision_us"],
+        "obs_ingest_idle_us":
+            report["obs_ingest"]["per_span_us"],
+        "flight_recorder_idle_us":
+            report["flight_recorder"]["per_line_us"],
     }
 
 

@@ -1,0 +1,468 @@
+"""Fleet observability acceptance drive (``make drive-obs``, ISSUE 18,
+docs/observability.md "Fleet observability").
+
+Everything real: the kubelet plugin runs as a subprocess over its DRA
+unix socket, two REAL serve replicas run on claims prepared through
+REAL gRPC ``NodePrepareResources``, the REAL router fronts them, and
+every process spools its finished spans into one shared
+``--trace-spool-dir`` while also serving them on ``/debug/traces``.
+This script plays the client (its own tracer, spooled like any other
+binary) and then turns the collector loose on the wreckage.
+
+Asserted:
+
+1. **Cross-binary merge** — ONE hero trace id (client root span →
+   traceparent-stamped ResourceClaim → plugin prepare → traceparent
+   HTTP header → router → replica engine) merges across >= 4 distinct
+   processes, pulled from BOTH transports (spool files + live
+   endpoints) with exact-id dedup.
+2. **Critical-path accounting is honest** — the hero trace's
+   self-times sum to its root wall time within 10% (the telescoping
+   identity: every nanosecond is attributed exactly once, across
+   process boundaries, without trusting any clock comparison).
+3. **The differential finds the planted culprit** — one replica is
+   armed with a count-limited ``serve.engine.slow_decode`` failpoint;
+   after a scripted load the tail-vs-median differential must name
+   ``serve.engine.decode`` (the failpoint's span) as the p99 culprit,
+   in-process AND through ``python -m tpu_dra.obs report``.
+4. **The black box survives the crash** — the armed replica is
+   SIGQUIT'd mid-flight and must leave a readable postmortem (recent
+   spans, klog tail, metric deltas) in ``--flight-recorder-dir``.
+
+    python hack/drive_obs.py
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from drive_plugin import rpc  # noqa: E402 — the shared gRPC helper
+from drive_serve import (  # noqa: E402
+    free_port,
+    http_get,
+    make_checkpoint,
+    wait_until,
+)
+from tpu_dra import trace  # noqa: E402
+from tpu_dra.k8s import RESOURCE_CLAIMS  # noqa: E402
+from tpu_dra.k8s.testserver import KubeTestServer  # noqa: E402
+from tpu_dra.kubeletplugin.proto import (  # noqa: E402
+    dra_v1beta1_pb2 as dra_pb,
+)
+from tpu_dra.obs import Collector, differential, self_times  # noqa: E402
+from tpu_dra.trace import propagation  # noqa: E402
+from tpu_dra.trace.span import current_traceparent  # noqa: E402
+from tpu_dra.trace.tracer import get_tracer, spool_path_for  # noqa: E402
+from tpu_dra.version import DRIVER_NAME  # noqa: E402
+
+N_CHIPS = 4
+N_REPLICAS = 2
+STEPS = 3
+# the planted tail: a count-limited failpoint on ONE replica makes a
+# known slice of the load slow by an unmistakable amount — the
+# differential must attribute the tail to the decode span, not to CPU
+# weather (0.3s dwarfs any small-model pass on any host).  It is armed
+# through the LIVE plan file only after warmup (warmup passes would
+# silently burn the count) and count-limited so at most ~1/3 of the
+# requests slow down — a majority-slow load would drag the BODY median
+# up and erase the very tail-vs-body delta being asserted.
+SLOW_FIRES = 48
+SLOW_MS = 300
+N_REQUESTS = 40
+# every 4th request goes straight at the armed replica: the router's
+# probe scoring steers AWAY from an overloaded replica (correctly!),
+# so routed traffic alone would give the differential too few slow
+# samples to converge on
+PIN_EVERY = 4
+SELF_TIME_TOLERANCE = 0.10      # the 10% telescoping gate
+PROBE_INTERVAL_S = 0.5
+
+MODEL_FLAGS = ["--vocab", "64", "--d-model", "32", "--n-heads", "2",
+               "--n-layers", "2", "--d-ff", "64", "--max-seq", "64"]
+
+
+def log(msg: str) -> None:
+    print(f"[drive-obs] {msg}", flush=True)
+
+
+def die(msg: str) -> None:
+    print(f"[drive-obs] FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+class LineReader:
+    """Drain a child's stdout on a thread (a full pipe wedges the
+    child) and expose the lines for readiness scanning."""
+
+    def __init__(self, proc: subprocess.Popen) -> None:
+        self.lines: list[str] = []
+        self._mu = threading.Lock()
+
+        def pump():
+            for line in proc.stdout:
+                with self._mu:
+                    self.lines.append(line.rstrip())
+        threading.Thread(target=pump, daemon=True).start()
+
+    def saw(self, needle: str) -> bool:
+        with self._mu:
+            return any(needle in ln for ln in self.lines)
+
+
+def _post(url: str, payload: dict, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class Drive:
+    """Plugin + cluster context, with the observability env (shared
+    span spool + flight-recorder dir) stamped onto every child."""
+
+    def __init__(self, base: str) -> None:
+        self.base = pathlib.Path(base)
+        self.spool_dir = str(self.base / "spool")
+        self.recorder_dir = str(self.base / "flight")
+        os.makedirs(self.spool_dir)
+        os.makedirs(self.recorder_dir)
+        self.obs_env = {
+            "TRACE_SAMPLE_RATIO": "1.0",
+            "TRACE_SPOOL_DIR": self.spool_dir,
+            "FLIGHT_RECORDER_DIR": self.recorder_dir,
+        }
+        self.srv = KubeTestServer().start()
+        self.kcfg = self.srv.write_kubeconfig(str(self.base / "kubeconfig"))
+        root = self.base / "driver-root"
+        (root / "dev").mkdir(parents=True)
+        for i in range(N_CHIPS):
+            (root / "dev" / f"accel{i}").touch()
+        (root / "etc").mkdir()
+        (root / "etc" / "machine-id").write_text("deadbeefcafe\n")
+        (root / "var/lib/tpu").mkdir(parents=True)
+        (root / "var/lib/tpu/tpu-env").write_text(
+            f"TPU_ACCELERATOR_TYPE: 'v5litepod-{N_CHIPS}'\n"
+            f"TPU_TOPOLOGY: '2x2'\n"
+            "TPU_WORKER_ID: '0'\nTPU_WORKER_HOSTNAMES: 'node-a'\n")
+        env = {**os.environ, "PYTHONPATH": REPO, **self.obs_env}
+        self.plugin = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.plugins.tpu.main",
+             "--kubeconfig", self.kcfg, "--node-name", "node-a",
+             "--tpu-driver-root", str(root),
+             "--kubelet-plugins-dir", str(self.base / "plugins"),
+             "--kubelet-registry-dir", str(self.base / "registry"),
+             "--cdi-root", str(self.base / "cdi"),
+             "--ignore-host-tpu-env"], cwd=REPO, env=env)
+        self.dra_sock = str(self.base / "plugins" / DRIVER_NAME /
+                            "dra.sock")
+        wait_until(lambda: os.path.exists(self.dra_sock), timeout=60,
+                   what="plugin DRA socket")
+        self.model_ckpt = make_checkpoint(str(self.base))
+        self.compile_cache = str(self.base / "jax-cache")
+        self.counter = 0
+
+    def grpc_prepare(self, name: str, device: str,
+                     stamp_trace: bool = False) -> str:
+        """Create a ResourceClaim (optionally carrying the CURRENT
+        span's traceparent annotation — how the plugin joins the hero
+        trace) and prepare it over real gRPC."""
+        claim = {"metadata": {"name": name, "namespace": "default"},
+                 "spec": {},
+                 "status": {"allocation": {"devices": {"results": [
+                     {"request": "tpus", "driver": DRIVER_NAME,
+                      "pool": "node-a", "device": device}]}}}}
+        if stamp_trace:
+            propagation.stamp(claim)
+        uid = self.srv.fake.create(
+            RESOURCE_CLAIMS, claim)["metadata"]["uid"]
+        req = dra_pb.NodePrepareResourcesRequest()
+        c = req.claims.add()
+        c.uid, c.name, c.namespace = uid, name, "default"
+        res = rpc(self.dra_sock,
+                  "/v1beta1.DRAPlugin/NodePrepareResources",
+                  req, dra_pb.NodePrepareResourcesResponse)
+        if res.claims[uid].error:
+            die(f"claim prepare failed: {res.claims[uid].error}")
+        return uid
+
+    def grpc_unprepare(self, name: str, uid: str) -> None:
+        req = dra_pb.NodeUnprepareResourcesRequest()
+        c = req.claims.add()
+        c.uid, c.name, c.namespace = uid, name, "default"
+        res = rpc(self.dra_sock,
+                  "/v1beta1.DRAPlugin/NodeUnprepareResources",
+                  req, dra_pb.NodeUnprepareResourcesResponse)
+        if res.claims[uid].error:
+            die(f"claim unprepare failed: {res.claims[uid].error}")
+        self.srv.fake.delete(RESOURCE_CLAIMS, name, namespace="default")
+
+    def spawn_replica(self, name: str, device: int,
+                      plan_file: str = "") -> dict:
+        uid = self.grpc_prepare(name, f"tpu-{device}")
+        port = free_port()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   JAX_COMPILATION_CACHE_DIR=self.compile_cache,
+                   **self.obs_env)
+        if plan_file:
+            env["TPU_DRA_FAILPOINTS_FILE"] = plan_file
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_dra.workloads.serve",
+             "--checkpoint-dir", self.model_ckpt,
+             "--host", "127.0.0.1", "--port", str(port),
+             "--pos-emb", "rope", *MODEL_FLAGS,
+             "--continuous", "--slots", "2", "--chunk", "2",
+             "--kv-layout", "paged", "--page-size", "8", "--warmup"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+        reader = LineReader(proc)
+        wait_until(lambda: reader.saw("serving on") or
+                   proc.poll() is not None,
+                   timeout=420, what=f"{name} warmed up")
+        if proc.poll() is not None:
+            die(f"{name} exited {proc.returncode} during startup")
+        log(f"replica {name} (pid {proc.pid}) on :{port}"
+            + (f" watching plan file {plan_file}" if plan_file else ""))
+        return {"name": name, "proc": proc, "uid": uid, "port": port,
+                "url": f"http://127.0.0.1:{port}"}
+
+    def stop(self) -> None:
+        self.plugin.terminate()
+        try:
+            self.plugin.wait(10)
+        except subprocess.TimeoutExpired:
+            self.plugin.kill()
+            self.plugin.wait(5)
+        self.srv.stop()
+
+
+def start_router(drive: Drive, fleet_file: str) -> tuple:
+    port = free_port()
+    env = dict(os.environ, PYTHONPATH=REPO, **drive.obs_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.workloads.router",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--fleet-file", fleet_file,
+         "--probe-interval", str(PROBE_INTERVAL_S)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, text=True)
+    reader = LineReader(proc)
+    wait_until(lambda: reader.saw("routing on"), timeout=60,
+               what="router up")
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def stop_proc(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def routable(router_url: str) -> int:
+    _, _, body = http_get(f"{router_url}/debug/fleet")
+    return json.loads(body).get("routable", 0)
+
+
+def main() -> int:
+    base = tempfile.mkdtemp(prefix="drive-obs-")
+    log(f"workdir {base}")
+    drive = Drive(base)
+    router = None
+    replicas = []
+    try:
+        # the client is a traced fleet citizen like any other binary:
+        # it spools its root spans into the shared spool dir
+        trace.configure(
+            service="drive-obs-client", sample_ratio=1.0,
+            spool_path=spool_path_for(drive.spool_dir,
+                                      "drive-obs-client"))
+
+        plan_file = str(drive.base / "failpoints.plan")
+        replicas.append(drive.spawn_replica("rep0", 0))
+        replicas.append(drive.spawn_replica("rep1", 1,
+                                            plan_file=plan_file))
+        fleet_file = str(drive.base / "fleet.json")
+        with open(fleet_file, "w") as f:
+            json.dump({"replicas": [
+                {"name": r["name"], "url": r["url"],
+                 "claim_uid": r["uid"]} for r in replicas]}, f)
+        router, router_url = start_router(drive, fleet_file)
+        wait_until(lambda: routable(router_url) == N_REPLICAS,
+                   timeout=30, what="both replicas routable")
+
+        # ---- the hero trace: ONE id across client, plugin, router,
+        # replica.  The claim prepare and the HTTP request both run
+        # inside the client's root span; the claim carries the context
+        # as an annotation, the request as a traceparent header.
+        with get_tracer().start_span("drive.e2e") as root_span:
+            hero_tid = root_span.context.trace_id
+            hero_uid = drive.grpc_prepare("obs-hero", "tpu-2",
+                                          stamp_trace=True)
+            _post(f"{router_url}/generate",
+                  {"tokens": [[3, 5, 7]], "steps": STEPS},
+                  headers={"traceparent": current_traceparent()})
+        drive.grpc_unprepare("obs-hero", hero_uid)
+        log(f"hero trace {hero_tid}")
+
+        # ---- scripted load for the differential: each request under
+        # its own sampled client root span -> its own trace id.  The
+        # armed replica picks up the failpoint from the live plan file
+        # (first armed hit logs "failpoint FIRED" on its stdout)
+        with open(plan_file, "w") as f:
+            f.write(f"serve.engine.slow_decode="
+                    f"{SLOW_FIRES}*sleep({SLOW_MS})\n")
+        request_tids = []
+        for i in range(N_REQUESTS):
+            with get_tracer().start_span("drive.request") as sp:
+                request_tids.append(sp.context.trace_id)
+                target = replicas[1]["url"] \
+                    if i % PIN_EVERY == PIN_EVERY - 1 else router_url
+                _post(f"{target}/generate",
+                      {"tokens": [[(i % 60) + 1, 2, 3]],
+                       "steps": STEPS},
+                      headers={"traceparent": current_traceparent()})
+
+        # ---- collect from BOTH transports: the shared spool dir AND
+        # the live /debug/traces endpoints (router + replicas serve
+        # the same spans they spooled — the dedup must hold)
+        col = Collector(
+            spool_dir=drive.spool_dir,
+            endpoints=tuple([router_url] + [r["url"] for r in replicas]))
+        n = col.ingest_once()
+        snap = col.registry.snapshot()
+        log(f"collector ingested {n} spans "
+            f"({int(snap.get('tpu_dra_obs_spans_dropped_total', 0))} "
+            f"dropped)")
+
+        # assert 1: the hero trace merged across >= 4 processes
+        hero = col.merged(hero_tid)
+        services = {s.get("service", "") for s in hero.spans.values()}
+        names = {s.get("name", "") for s in hero.spans.values()}
+        if len(services) < 4:
+            die(f"hero trace spans {len(services)} services, need >= 4: "
+                f"{sorted(services)} (names {sorted(names)})")
+        for expect in ("drive.e2e", "plugin.prepare", "router.request",
+                       "serve.request", "serve.engine.decode"):
+            if expect not in names:
+                die(f"hero trace is missing its '{expect}' span: "
+                    f"{sorted(names)}")
+        if hero.orphans:
+            die(f"hero trace has orphan spans: {hero.orphans}")
+        log(f"hero trace merged: {len(hero.spans)} spans across "
+            f"{len(services)} processes: {sorted(services)}")
+
+        # assert 2: self-times telescope to the root wall time — every
+        # nanosecond of the cross-binary trace attributed exactly once
+        root = hero.root()
+        root_dur = float(root["duration"])
+        total_self = sum(self_times(hero).values())
+        drift = abs(total_self - root_dur) / root_dur
+        if drift > SELF_TIME_TOLERANCE:
+            die(f"self-time telescoping broke: sum {total_self:.4f}s "
+                f"vs root {root_dur:.4f}s ({drift:.1%} > "
+                f"{SELF_TIME_TOLERANCE:.0%})")
+        log(f"critical-path accounting: self-times sum {total_self:.4f}s"
+            f" vs root {root_dur:.4f}s (drift {drift:.1%})")
+
+        # assert 3: the differential names the planted culprit
+        merged = [col.merged(t) for t in request_tids]
+        merged = [m for m in merged if m.root() is not None]
+        if len(merged) < N_REQUESTS:
+            die(f"only {len(merged)}/{N_REQUESTS} request traces have "
+                f"a client root span in the collector")
+        diff = differential(merged)
+        if diff["culprit"] != "serve.engine.decode":
+            die(f"differential blamed {diff['culprit']!r}, expected "
+                f"'serve.engine.decode': {json.dumps(diff['spans'])}")
+        delta = diff["spans"]["serve.engine.decode"]["delta_s"]
+        if delta < SLOW_MS / 1e3 * 0.5:
+            die(f"culprit delta {delta:.3f}s implausibly small for a "
+                f"{SLOW_MS}ms failpoint")
+        log(f"differential: p99 culprit serve.engine.decode "
+            f"(+{delta * 1e3:.0f}ms tail-vs-body), as planted")
+
+        # assert 3b: the CLI sees the same story from the spool alone
+        out = subprocess.run(
+            [sys.executable, "-m", "tpu_dra.obs", "report",
+             "--spool-dir", drive.spool_dir],
+            cwd=REPO, env={**os.environ, "PYTHONPATH": REPO},
+            capture_output=True, text=True, timeout=120)
+        if out.returncode != 0:
+            die(f"obs report failed: {out.stderr[-2000:]}")
+        if "serve.engine.decode" not in out.stdout:
+            die(f"obs report lacks the decode attribution:\n"
+                f"{out.stdout[-2000:]}")
+        if "p99 culprit is 'serve.engine.decode'" not in out.stdout:
+            die(f"obs report differential did not name the culprit:\n"
+                f"{out.stdout[-2000:]}")
+        perfetto = subprocess.run(
+            [sys.executable, "-m", "tpu_dra.obs", "report",
+             "--spool-dir", drive.spool_dir, "--trace-id", hero_tid,
+             "--format", "perfetto"],
+            cwd=REPO, env={**os.environ, "PYTHONPATH": REPO},
+            capture_output=True, text=True, timeout=120)
+        events = json.loads(perfetto.stdout)["traceEvents"]
+        if not any(e.get("name") == "serve.engine.decode"
+                   for e in events):
+            die("perfetto export of the hero trace lacks the engine "
+                "span")
+        log("obs report CLI: attribution + culprit + perfetto export "
+            "all coherent")
+
+        # assert 4: SIGQUIT the armed replica -> readable postmortem
+        victim = replicas[1]
+        pid = victim["proc"].pid
+        victim["proc"].send_signal(signal.SIGQUIT)
+        rc = victim["proc"].wait(30)
+        if rc == 0:
+            die("SIGQUIT'd replica exited 0 — the recorder must "
+                "re-deliver the signal after dumping")
+        dump_path = os.path.join(drive.recorder_dir,
+                                 f"tpu-serve-{pid}-sigquit.json")
+        if not os.path.exists(dump_path):
+            die(f"no postmortem at {dump_path}; dir has "
+                f"{os.listdir(drive.recorder_dir)}")
+        with open(dump_path) as f:
+            post = json.load(f)
+        if post["service"] != "tpu-serve" or post["reason"] != "sigquit":
+            die(f"postmortem header wrong: {post['service']} "
+                f"{post['reason']}")
+        span_names = {s.get("name") for s in post["spans"]}
+        if "serve.request" not in span_names:
+            die(f"postmortem has no recent serve.request span: "
+                f"{sorted(span_names)}")
+        if not post["log_tail"]:
+            die("postmortem log tail is empty")
+        if not post["metric_deltas"]:
+            die("postmortem metric deltas are empty")
+        log(f"flight recorder: {dump_path} holds {len(post['spans'])} "
+            f"spans, {len(post['log_tail'])} log lines, "
+            f"{len(post['metric_deltas'])} metric deltas")
+
+        drive.grpc_unprepare(victim["name"], victim["uid"])
+    finally:
+        if router is not None:
+            stop_proc(router)
+        for r in replicas:
+            stop_proc(r["proc"])
+        drive.stop()
+    log("OK: one trace merged across >=4 processes, self-times "
+        "telescope within 10%, the differential named the planted "
+        "culprit, and the SIGQUIT black box was readable")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
             grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
             observe_us=0.8, admission_us=4.0, alloc_us=15.0,
-            router_us=2.0, tenancy_us=90.0):
+            router_us=2.0, tenancy_us=90.0, obs_us=3.0, fr_us=0.1):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
@@ -28,6 +28,8 @@ def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
         "alloc_score": {"n": 5000, "per_score_us": alloc_us},
         "tenancy_setup": {"n": 2000, "per_setup_us": tenancy_us},
         "router_decision": {"n": 50000, "per_decision_us": router_us},
+        "obs_ingest": {"n": 20000, "per_span_us": obs_us},
+        "flight_recorder": {"n": 200000, "per_line_us": fr_us},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
                      "overhead_p50_ms": direct_warm_oh},
@@ -54,6 +56,8 @@ def _budget(**overrides):
             "alloc_score_us": 40.0,
             "tenancy_setup_us": 400.0,
             "router_decision_us": 10.0,
+            "obs_ingest_idle_us": 8.0,
+            "flight_recorder_idle_us": 2.0,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
                      "fs_floor_ceiling_ms": 0.4,
@@ -159,6 +163,20 @@ def test_idle_observe_gate():
     violations = bench_prepare.gate(_report(observe_us=6.0), _budget())
     assert any("histogram_observe_idle_us" in v for v in violations)
     assert bench_prepare.gate(_report(observe_us=0.4), _budget()) == []
+
+
+def test_obs_ingest_and_flight_recorder_gates():
+    """ISSUE 18: the observability plane's two always-on costs —
+    per-span collector ingest and the flight recorder's per-log-line
+    tap — are ratcheted like every other idle path.  An unamortised
+    percentile sort landing on ingest (a >=30µs cliff at window 512)
+    or formatting/locking landing on the tap must fail the gate."""
+    violations = bench_prepare.gate(_report(obs_us=35.0), _budget())
+    assert any("obs_ingest_idle_us" in v for v in violations)
+    violations = bench_prepare.gate(_report(fr_us=5.0), _budget())
+    assert any("flight_recorder_idle_us" in v for v in violations)
+    assert bench_prepare.gate(_report(obs_us=3.0, fr_us=0.1),
+                              _budget()) == []
 
 
 def test_write_budget_round_trips_and_caps_ratios(tmp_path):

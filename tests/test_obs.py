@@ -1,0 +1,519 @@
+"""Fleet observability plane tests (tpu_dra/obs, ISSUE 18): trace
+merge edge cases (orphans, clock skew, duplicate ids, generation
+bumps), self-time / critical-path / differential math, the bounded
+collector store with honest drop accounting, spool + endpoint ingest,
+anomaly baselines, the flight recorder, spool rotation, the
+``/debug/traces`` limit/404 contract, and ``Registry.snapshot``."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.obs import (
+    AnomalyDetector,
+    Collector,
+    FlightRecorder,
+    attribution,
+    critical_path,
+    differential,
+    merge_trace,
+    self_times,
+    serve_collector,
+)
+from tpu_dra.trace import DEFAULT_RING, SpoolExporter, Tracer
+from tpu_dra.trace.export import (
+    DEBUG_TRACES_DEFAULT_LIMIT,
+    chrome_trace,
+    debug_traces_body,
+    spans_from_chrome,
+)
+from tpu_dra.trace.span import SpanContext
+from tpu_dra.util.metrics import Registry
+
+# DRA-core fast lane: observability machinery, no JAX compiles
+pytestmark = pytest.mark.core
+
+
+def span(name, sid, parent="", trace="t1", start=0.0, dur=1.0,
+         service="svc", **attrs):
+    return {"name": name, "service": service, "trace_id": trace,
+            "span_id": sid, "parent_id": parent, "sampled": True,
+            "thread": "main", "start": start, "duration": dur,
+            "status": "ok", "attributes": attrs, "events": []}
+
+
+# -------------------------------------------------------------------------
+# merge_trace edge cases
+# -------------------------------------------------------------------------
+
+
+def test_merge_builds_tree_from_parent_edges():
+    spans = [span("root", "r", dur=10.0),
+             span("mid", "m", parent="r", dur=8.0),
+             span("leaf", "l", parent="m", dur=5.0)]
+    m = merge_trace(spans, "t1")
+    assert m.roots == ["r"]
+    assert m.children["r"] == ["m"] and m.children["m"] == ["l"]
+    assert m.orphans == 0 and m.duplicates == 0
+
+
+def test_merge_orphan_spans_become_roots_not_garbage():
+    spans = [span("root", "r", dur=10.0),
+             span("stray", "s", parent="never-arrived", dur=2.0)]
+    m = merge_trace(spans, "t1")
+    assert sorted(m.roots) == ["r", "s"]
+    assert m.orphans == 1
+    # the best-root heuristic picks the enclosing span, not the orphan
+    assert m.root()["span_id"] == "r"
+
+
+def test_merge_orders_by_parent_edges_never_wall_clock():
+    """A child from a clock-skewed process can START before its parent
+    on the wall clock; the parent edge must still win."""
+    spans = [span("parent", "p", start=100.0, dur=4.0),
+             # skewed process: start is 50s "before" the parent
+             span("child", "c", parent="p", start=50.0, dur=3.0,
+                  service="other")]
+    m = merge_trace(spans, "t1")
+    assert m.roots == ["p"]
+    assert m.children["p"] == ["c"]
+    st = self_times(m)
+    assert st["p"] == pytest.approx(1.0)   # 4 − 3, skew-immune
+    assert st["c"] == pytest.approx(3.0)
+
+
+def test_merge_duplicate_span_ids_first_occurrence_wins():
+    """A respawned worker re-rolling ids already exported: keep the
+    first, count the rest."""
+    spans = [span("first", "x", dur=1.0),
+             span("imposter", "x", dur=99.0),
+             span("root", "r", dur=5.0)]
+    m = merge_trace(spans, "t1")
+    assert m.spans["x"]["name"] == "first"
+    assert m.duplicates == 1
+
+
+def test_merge_trace_spanning_generation_bump():
+    """A trace crossing a spool rotation (generation bump) arrives as
+    two batches; merging the concatenation reconstructs one tree."""
+    gen0 = [span("root", "r", dur=10.0),
+            span("phase1", "a", parent="r", dur=3.0)]
+    gen1 = [span("phase2", "b", parent="r", dur=4.0),
+            span("leaf", "c", parent="b", dur=2.0)]
+    m = merge_trace(gen0 + gen1, "t1")
+    assert m.roots == ["r"]
+    assert sorted(m.children["r"]) == ["a", "b"]
+    assert m.children["b"] == ["c"]
+
+
+def test_merge_filters_foreign_trace_ids():
+    spans = [span("root", "r"), span("other", "o", trace="t2")]
+    m = merge_trace(spans, "t1")
+    assert list(m.spans) == ["r"]
+
+
+# -------------------------------------------------------------------------
+# self time / critical path / attribution / differential
+# -------------------------------------------------------------------------
+
+
+def test_self_times_subtract_direct_children_floor_zero():
+    spans = [span("root", "r", dur=10.0),
+             span("a", "a", parent="r", dur=6.0),
+             span("b", "b", parent="r", dur=7.0)]   # overlap: 6+7 > 10
+    st = self_times(merge_trace(spans, "t1"))
+    assert st["r"] == 0.0                # floored, not negative
+    assert st["a"] == 6.0 and st["b"] == 7.0
+
+
+def test_critical_path_descends_longest_child_and_telescopes():
+    spans = [span("root", "r", dur=10.0),
+             span("fast", "f", parent="r", dur=2.0),
+             span("slow", "s", parent="r", dur=7.0),
+             span("inner", "i", parent="s", dur=4.0)]
+    m = merge_trace(spans, "t1")
+    path = critical_path(m)
+    assert [s["span_id"] for s in path] == ["r", "s", "i"]
+    # path self-times: 1 (root minus BOTH children) + 3 + 4
+    assert sum(s["self_time"] for s in path) == pytest.approx(8.0)
+    # the telescoping identity is over ALL spans: when children nest
+    # within parents, total self time == root duration — the invariant
+    # make drive-obs asserts within 10%
+    assert sum(self_times(m).values()) == pytest.approx(10.0)
+
+
+def test_attribution_percentiles_per_name():
+    traces = []
+    for i in range(10):
+        traces.append(merge_trace([
+            span("root", f"r{i}", trace=f"t{i}", dur=2.0 + i),
+            span("work", f"w{i}", parent=f"r{i}", trace=f"t{i}",
+                 dur=1.0 + i)], f"t{i}"))
+    att = attribution(traces)
+    assert att["root"]["count"] == 10
+    assert att["root"]["p50_s"] == pytest.approx(1.0)   # self time
+    assert att["work"]["max_s"] == pytest.approx(10.0)
+
+
+def test_differential_names_the_span_that_grew():
+    """40 traces, 4 of them slow because 'decode' inflated: the
+    differential must name decode, not the always-large 'request'."""
+    traces = []
+    for i in range(40):
+        slow = i >= 36
+        decode = 5.0 if slow else 0.5
+        root_dur = decode + 1.0
+        tid = f"t{i}"
+        traces.append(merge_trace([
+            span("request", f"r{i}", trace=tid, dur=root_dur),
+            span("decode", f"d{i}", parent=f"r{i}", trace=tid,
+                 dur=decode)], tid))
+    diff = differential(traces)
+    assert diff["culprit"] == "decode"
+    assert diff["tail_traces"] >= 4
+    assert diff["spans"]["decode"]["delta_s"] > 1.0
+    # 'request' self time stayed flat (1.0 either way)
+    assert abs(diff["spans"]["request"]["delta_s"]) < 0.1
+
+
+def test_differential_needs_enough_traces():
+    assert differential([])["culprit"] is None
+    one = merge_trace([span("r", "r")], "t1")
+    assert differential([one])["culprit"] is None
+
+
+# -------------------------------------------------------------------------
+# collector: bounded store, dedup, spool + endpoint ingest
+# -------------------------------------------------------------------------
+
+
+def test_collector_bounded_store_counts_drops_honestly():
+    col = Collector(max_spans=4)
+    col.add_spans([span("s", f"s{i}", trace=f"t{i}") for i in range(7)])
+    assert len(col.spans()) == 4
+    reg = col.registry.snapshot()
+    assert reg["tpu_dra_obs_spans_dropped_total"] == 3.0
+    assert reg['tpu_dra_obs_spans_ingested_total{source="direct"}'] == 7.0
+
+
+def test_collector_dedups_across_sources():
+    col = Collector()
+    s = span("s", "s1")
+    assert col.add_spans([s], source="spool") == 1
+    assert col.add_spans([dict(s)], source="endpoint") == 0
+    assert len(col.spans()) == 1
+
+
+def test_collector_spool_ingest_incremental_and_rotation(tmp_path):
+    spool = tmp_path / "svc-1.jsonl"
+    col = Collector(spool_dir=str(tmp_path))
+    with open(spool, "w") as f:
+        f.write(json.dumps(span("a", "a")) + "\n")
+    assert col.ingest_once() == 1
+    # incremental: nothing new, nothing re-read
+    assert col.ingest_once() == 0
+    with open(spool, "a") as f:
+        f.write(json.dumps(span("b", "b")) + "\n")
+    assert col.ingest_once() == 1
+    # rotation: file shrinks → re-read from zero; dedup absorbs overlap
+    with open(spool, "w") as f:
+        f.write(json.dumps(span("c", "c")) + "\n")
+    assert col.ingest_once() == 1
+    assert {s["span_id"] for s in col.spans()} == {"a", "b", "c"}
+
+
+def test_collector_spool_tolerates_torn_tail_line(tmp_path):
+    spool = tmp_path / "svc-2.jsonl"
+    with open(spool, "w") as f:
+        f.write(json.dumps(span("a", "a")) + "\n")
+        f.write('{"name": "torn')            # writer died mid-append
+    col = Collector(spool_dir=str(tmp_path))
+    assert col.ingest_once() == 1
+    snap = col.registry.snapshot()
+    assert snap['tpu_dra_obs_ingest_errors_total{source="spool"}'] == 1.0
+
+
+def test_collector_live_endpoint_ingest_and_http_views(tmp_path):
+    """End-to-end over real HTTP: a process ring served as Chrome JSON,
+    pulled back via spans_from_chrome, analyzed on /debug/attribution."""
+    DEFAULT_RING.clear()
+    tracer = Tracer(service="ep", exporters=(DEFAULT_RING,))
+    for i in range(5):
+        with tracer.start_span(f"op"):
+            pass
+    from tpu_dra.util.metrics import serve_http_endpoint
+    victim = serve_http_endpoint("127.0.0.1", 0)
+    vport = victim.server_address[1]
+    col = Collector(endpoints=(f"http://127.0.0.1:{vport}",))
+    try:
+        assert col.ingest_once() == 5
+        srv = serve_collector(col)
+        port = srv.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/attribution") as r:
+                body = json.loads(r.read())
+            assert body["attribution"]["op"]["count"] == 5
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/anomalies") as r:
+                body = json.loads(r.read())
+            assert body["baselines"]["op"]["samples"] == 5
+            # unknown trace id on the attribution view: typed 404
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/attribution"
+                    f"?trace_id={'9' * 32}")
+            assert exc.value.code == 404
+        finally:
+            srv.shutdown()
+    finally:
+        victim.shutdown()
+        DEFAULT_RING.clear()
+
+
+def test_collector_fleet_file_discovery(tmp_path):
+    fleet = tmp_path / "fleet.json"
+    fleet.write_text(json.dumps({"replicas": [
+        {"name": "a", "url": "http://127.0.0.1:1/"},
+        {"name": "b", "url": "http://127.0.0.1:2"},
+        {"name": "bad"},                       # no url: skipped
+    ]}))
+    col = Collector(fleet_file=str(fleet),
+                    endpoints=("http://127.0.0.1:2",))
+    assert col._endpoint_urls() == [
+        "http://127.0.0.1:2", "http://127.0.0.1:1"]
+
+
+# -------------------------------------------------------------------------
+# anomaly detection
+# -------------------------------------------------------------------------
+
+
+def test_anomaly_flags_envelope_escape_after_warmup():
+    det = AnomalyDetector(Registry())
+    base = [span("op", f"s{i}", dur=0.010 + (i % 5) * 0.001)
+            for i in range(30)]
+    assert not any(det.observe(s) for s in base)
+    assert det.observe(span("op", "slow", dur=1.0)) is True
+    assert det.baselines()["op"]["warm"] is True
+    assert det.recent[-1]["span"] == "op"
+    assert det.recent[-1]["duration_s"] == 1.0
+
+
+def test_anomaly_warmup_is_silent_and_outliers_not_learned():
+    det = AnomalyDetector(Registry())
+    # under min_samples: never flags, whatever the value
+    assert det.observe(span("x", "a", dur=100.0)) is False
+    det2 = AnomalyDetector(Registry())
+    for i in range(30):
+        det2.observe(span("op", f"s{i}", dur=0.01))
+    assert det2.observe(span("op", "o1", dur=5.0)) is True
+    # the outlier was NOT admitted into the baseline
+    assert det2.baselines()["op"]["p99_s"] < 0.1
+
+
+def test_anomaly_metric_and_bounded_names():
+    reg = Registry()
+    det = AnomalyDetector(reg)
+    for i in range(25):
+        det.observe(span("op", f"s{i}", dur=0.01))
+    det.observe(span("op", "slow", dur=2.0))
+    assert reg.snapshot()['tpu_dra_obs_anomalies_total{span="op"}'] == 1.0
+
+
+# -------------------------------------------------------------------------
+# flight recorder
+# -------------------------------------------------------------------------
+
+
+def test_flight_recorder_dump_contains_spans_logs_metric_deltas(tmp_path):
+    from tpu_dra.util import klog
+    DEFAULT_RING.clear()
+    reg = Registry()
+    c = reg.counter("tpu_dra_fr_test_total", "x")  # vet: ignore[contract-drift]
+    rec = FlightRecorder("test-svc", registry=reg,
+                         dump_dir=str(tmp_path)).install()
+    try:
+        tracer = Tracer(service="test-svc", exporters=(DEFAULT_RING,))
+        with tracer.start_span("fatal.work"):
+            pass
+        klog.info("something happened", key="val")
+        c.inc(by=3)
+        path = rec.dump("sigquit")
+        assert path and os.path.exists(path)
+        doc = json.loads(open(path).read())
+        assert doc["service"] == "test-svc"
+        assert doc["reason"] == "sigquit"
+        assert any(s["name"] == "fatal.work" for s in doc["spans"])
+        assert any("something happened" in ln for ln in doc["log_tail"])
+        assert doc["metric_deltas"]["tpu_dra_fr_test_total"] == 3.0
+        # once per reason: a second dump for the same cause is a no-op
+        assert rec.dump("sigquit") is None
+    finally:
+        klog.set_tap(None)
+        DEFAULT_RING.clear()
+
+
+def test_flight_recorder_stderr_fallback_without_dir(capsys):
+    from tpu_dra.util import klog
+    rec = FlightRecorder("svc", registry=Registry()).install()
+    try:
+        assert rec.dump("uncaught-exception") is None
+        err = capsys.readouterr().err
+        assert "FLIGHT-RECORDER" in err
+        assert '"reason": "uncaught-exception"' in err
+    finally:
+        klog.set_tap(None)
+
+
+def test_flight_recorder_sigquit_subprocess_postmortem(tmp_path):
+    """The real contract: a SIGQUIT'd process leaves a readable
+    postmortem and still dies by SIGQUIT."""
+    prog = (
+        "import os, signal, sys, time\n"
+        "from tpu_dra.obs import recorder\n"
+        "from tpu_dra.trace import tracer as T\n"
+        "t = T.configure(service='victim', sample_ratio=1.0)\n"
+        f"recorder.install('victim', dump_dir={str(tmp_path)!r})\n"
+        "with t.start_span('victim.work'):\n"
+        "    pass\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(30)\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", prog],
+                            stdout=subprocess.PIPE, text=True,
+                            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        proc.send_signal(signal.SIGQUIT)
+        rc = proc.wait(timeout=30)
+        assert rc != 0                     # died BY the signal
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("victim-") and f.endswith("-sigquit.json")]
+        assert len(dumps) == 1
+        doc = json.loads((tmp_path / dumps[0]).read_text())
+        assert any(s["name"] == "victim.work" for s in doc["spans"])
+    finally:
+        proc.kill()
+
+
+# -------------------------------------------------------------------------
+# spool exporter rotation + round trip
+# -------------------------------------------------------------------------
+
+
+def test_spool_exporter_rotates_at_size_bound(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    sp = SpoolExporter(path, max_bytes=400)
+    for i in range(20):
+        sp.export(span("op", f"s{i:02d}"))
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 400
+    # every line in both generations parses
+    for p in (path, path + ".1"):
+        for line in open(p):
+            json.loads(line)
+
+
+def test_chrome_trace_round_trip_preserves_merge_fields():
+    spans = [span("root", "r", dur=2.0, phase="x"),
+             span("kid", "k", parent="r", dur=1.0)]
+    back = spans_from_chrome(chrome_trace(spans))
+    m = merge_trace(back, "t1")
+    assert m.roots == ["r"] and m.children["r"] == ["k"]
+    assert back[0]["attributes"]["phase"] == "x"
+    assert back[0]["duration"] == pytest.approx(2.0, abs=1e-6)
+
+
+# -------------------------------------------------------------------------
+# /debug/traces limit + typed 404
+# -------------------------------------------------------------------------
+
+
+def test_debug_traces_limit_and_typed_404():
+    DEFAULT_RING.clear()
+    tracer = Tracer(service="x", exporters=(DEFAULT_RING,))
+    for _ in range(10):
+        with tracer.start_span("op"):
+            pass
+    try:
+        status, body = debug_traces_body("/debug/traces?limit=3")
+        assert status == 200
+        doc = json.loads(body)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 3
+        # default limit bounds the uncapped request
+        status, body = debug_traces_body("/debug/traces")
+        assert status == 200
+        assert DEBUG_TRACES_DEFAULT_LIMIT == 1024
+        # bad limit: typed 400
+        status, body = debug_traces_body("/debug/traces?limit=abc")
+        assert status == 400
+        # unknown trace id: typed 404 naming the cause + ring facts
+        status, body = debug_traces_body(
+            "/debug/traces?trace_id=" + "9" * 32)
+        assert status == 404
+        err = json.loads(body)
+        assert "evicted" in err["error"]
+        assert err["ring_capacity"] == DEFAULT_RING.capacity
+        assert "ring_dropped_total" in err
+    finally:
+        DEFAULT_RING.clear()
+
+
+def test_ring_eviction_counts_drops():
+    from tpu_dra.trace.export import RingBufferExporter
+    ring = RingBufferExporter(3)
+    for i in range(5):
+        ring.export(span("s", f"s{i}"))
+    assert ring.dropped == 2
+    assert len(ring) == 3
+
+
+# -------------------------------------------------------------------------
+# Registry.snapshot
+# -------------------------------------------------------------------------
+
+
+def test_registry_snapshot_flattens_all_kinds():
+    reg = Registry()
+    c = reg.counter("tpu_dra_snap_total", "c", labels=("k",))  # vet: ignore[contract-drift]
+    g = reg.gauge("tpu_dra_snap_depth", "g")  # vet: ignore[contract-drift]
+    h = reg.histogram("tpu_dra_snap_seconds", "h")  # vet: ignore[contract-drift]
+    c.inc("a"); c.inc("b", by=2)
+    g.set(7)
+    h.observe(0.3); h.observe(0.4)
+    snap = reg.snapshot()
+    assert snap['tpu_dra_snap_total{k="a"}'] == 1.0
+    assert snap['tpu_dra_snap_total{k="b"}'] == 2.0
+    assert snap["tpu_dra_snap_depth"] == 7
+    assert snap["tpu_dra_snap_seconds_count"] == 2.0
+    assert snap["tpu_dra_snap_seconds_sum"] == pytest.approx(0.7)
+
+
+def test_record_span_exports_with_explicit_timing():
+    from tpu_dra.trace.export import RingBufferExporter
+    ring = RingBufferExporter(16)
+    tracer = Tracer(service="eng", exporters=(ring,))
+    parent = SpanContext(trace_id="ab" * 16, span_id="cd" * 8,
+                         sampled=True)
+    t0 = time.time() - 2.0
+    tracer.record_span("serve.engine.decode", parent, start=t0,
+                       duration=1.5, attributes={"tokens": 7})
+    [s] = ring.spans()
+    assert s["name"] == "serve.engine.decode"
+    assert s["parent_id"] == "cd" * 8
+    assert s["trace_id"] == "ab" * 16
+    assert s["duration"] == 1.5
+    assert s["start"] == t0
+    # unsampled parent: one compare, no export
+    tracer.record_span("x", SpanContext("ef" * 16, "01" * 8, False),
+                       start=t0, duration=1.0)
+    assert len(ring.spans()) == 1

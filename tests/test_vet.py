@@ -839,6 +839,42 @@ def test_metric_hygiene_workload_namespaces_allowed_in_workloads(
                            rogue, checks=["metric-hygiene"])) == 1
 
 
+_METRIC_OBS = """\
+from tpu_dra.util.metrics import DEFAULT_REGISTRY
+
+_ingested = DEFAULT_REGISTRY.counter(
+    "tpu_dra_obs_spans_ingested_total", "spans accepted",
+    labels=("source",))
+
+_dropped = DEFAULT_REGISTRY.counter(
+    "tpu_dra_obs_spans_dropped_total", "spans evicted before analysis")
+"""
+
+
+def test_metric_hygiene_obs_namespace_only_under_obs(tmp_path):
+    """tpu_dra_obs_* is the fleet observability plane's sub-namespace:
+    legal under tpu_dra/obs/, a finding anywhere else — a driver-side
+    series must not masquerade as collector accounting."""
+    assert vet_snippet(tmp_path, "tpu_dra/obs/mh10.py", _METRIC_OBS,
+                       checks=["metric-hygiene"]) == []
+    diags = vet_snippet(tmp_path, "tpu_dra/plugins/mh10.py", _METRIC_OBS,
+                        checks=["metric-hygiene"])
+    assert len(diags) == 2, diags
+    assert all("tpu_dra_obs_ only under tpu_dra/obs/" in d.message
+               for d in diags)
+    # workloads/ gets no carve-out for the obs namespace either
+    diags = vet_snippet(tmp_path, "tpu_dra/workloads/mh10.py",
+                        _METRIC_OBS, checks=["metric-hygiene"])
+    assert len(diags) == 2, diags
+
+
+def test_metric_hygiene_real_obs_metrics_conform():
+    """The live collector/anomaly registrations pass with ZERO ignores."""
+    diags = run_paths([os.path.join(REPO_ROOT, "tpu_dra", "obs")],
+                      checks=["metric-hygiene"])
+    assert diags == [], "\n".join(str(d) for d in diags)
+
+
 def test_metric_hygiene_real_workload_metrics_conform():
     """The live serve/goodput/router registrations pass with ZERO
     ignores — the namespaces are first-class, not exemptions."""

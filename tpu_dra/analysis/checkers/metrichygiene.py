@@ -16,7 +16,11 @@ checked over registry registration calls in non-test ``tpu_dra/`` code:
    tenant-facing series are a first-class contract documented in
    docs/observability.md, not an exemption.  Outside workloads/ the
    driver prefix stays mandatory: a fleet-side series sneaking into a
-   workload namespace would vanish from the driver dashboards;
+   workload namespace would vanish from the driver dashboards.
+   The ``tpu_dra_obs_*`` sub-namespace belongs to the fleet
+   observability plane and may be registered ONLY under
+   ``tpu_dra/obs/`` — a collector-side series minted elsewhere would
+   masquerade as the collector's own honest-drop accounting;
 2. the help text argument must be a non-empty string;
 3. the metric classes (``Counter``/``Gauge``/``Histogram`` *imported
    from* ``util/metrics`` — ``collections.Counter`` is not ours) must
@@ -51,6 +55,9 @@ _NAME_RE = re.compile(r"^tpu_dra_[a-z0-9_]+$")
 # namespaces on private registries — legal ONLY under tpu_dra/workloads/
 _WORKLOAD_NAME_RE = re.compile(
     r"^tpu_(serve|goodput|router)_[a-z0-9_]+$")
+# the fleet observability plane's sub-namespace: collector/anomaly/
+# flight-recorder accounting, legal ONLY under tpu_dra/obs/
+_OBS_PREFIX = "tpu_dra_obs_"
 _REGISTRY_METHODS = {"counter", "gauge", "histogram"}
 _METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # the registry implementation itself registers nothing and legitimately
@@ -158,13 +165,17 @@ def _metric_class_imports(tree: ast.AST) -> set[str]:
 
 
 def _name_ok(path: str, name: str) -> bool:
-    """Rule 1 with the workloads carve-out: driver prefix everywhere,
-    plus the serve/goodput/router namespaces under tpu_dra/workloads/
-    (their catalog of record is still docs/observability.md — the
-    contract-drift checker pairs every registration with it)."""
+    """Rule 1 with the namespace carve-outs: driver prefix everywhere;
+    the serve/goodput/router namespaces only under tpu_dra/workloads/;
+    the observability-plane sub-namespace ``tpu_dra_obs_*`` only under
+    tpu_dra/obs/ (their catalog of record is still
+    docs/observability.md — the contract-drift checker pairs every
+    registration with it)."""
+    norm = path.replace("\\", "/")
+    if name.startswith(_OBS_PREFIX):
+        return "/obs/" in norm and bool(_NAME_RE.match(name))
     if _NAME_RE.match(name):
         return True
-    norm = path.replace("\\", "/")
     return "/workloads/" in norm and \
         bool(_WORKLOAD_NAME_RE.match(name))
 
@@ -206,7 +217,8 @@ def _run(ctx: FileContext) -> list[Diagnostic]:
                 node, "metric-hygiene",
                 f"metric name {name!r} must match tpu_dra_[a-z0-9_]+ "
                 f"(lowercase, driver-prefixed; tpu_serve_/tpu_goodput_/"
-                f"tpu_router_ allowed only under tpu_dra/workloads/) "
+                f"tpu_router_ allowed only under tpu_dra/workloads/, "
+                f"tpu_dra_obs_ only under tpu_dra/obs/) "
                 f"so dashboards and alerts can find it"))
         help_node = None
         if len(node.args) >= 2:

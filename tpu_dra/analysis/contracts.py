@@ -113,6 +113,12 @@ EXTERNAL_ENV: dict[str, str] = {
     "MEGASCALE_NUM_SLICES": "operator override (multislice triple)",
     "MEGASCALE_SLICE_ID": "operator override (multislice triple)",
     "MEGASCALE_COORDINATOR_ADDRESS": "operator override (multislice)",
+    "TRACE_SPOOL_DIR": "operator knob: span spool dir for the fleet "
+                       "collector (tracing_flags env alias; the daemon "
+                       "reads it directly, having no argparse)",
+    "FLIGHT_RECORDER_DIR": "operator knob: flight-recorder postmortem "
+                           "dir (tracing_flags env alias; the daemon "
+                           "reads it directly, having no argparse)",
 }
 
 # Environment variables written for OUT-OF-TREE consumers: libtpu, JAX,

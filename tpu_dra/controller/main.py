@@ -47,6 +47,8 @@ def main(argv=None) -> int:
     klog.configure(args.v, args.logging_format)
     from tpu_dra import trace
     trace.configure_from_args(args, service="tpu-slice-controller")
+    from tpu_dra.obs import recorder
+    recorder.install_from_args(args, service="tpu-slice-controller")
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
     if metrics.serve_from_flag(args.http_endpoint,

@@ -221,9 +221,19 @@ def run(argv=None) -> int:
     port = int(env.get("SLICE_COORDINATOR_PORT", "51000"))
     kubeconfig = env.get("KUBECONFIG", "")
     klog.configure(int(env.get("VERBOSITY", "2")))
+    spool_dir = env.get("TRACE_SPOOL_DIR", "")
+    spool_path = None
+    if spool_dir:
+        from tpu_dra.trace.tracer import spool_path_for
+        os.makedirs(spool_dir, exist_ok=True)
+        spool_path = spool_path_for(spool_dir, "slice-domain-daemon")
     trace_configure(service="slice-domain-daemon",
                     sample_ratio=float(env.get("TRACE_SAMPLE_RATIO", "1")),
-                    jsonl_path=env.get("TRACE_FILE") or None)
+                    jsonl_path=env.get("TRACE_FILE") or None,
+                    spool_path=spool_path)
+    from tpu_dra.obs import recorder
+    recorder.install("slice-domain-daemon",
+                     dump_dir=env.get("FLIGHT_RECORDER_DIR", ""))
 
     tpulib = RealTpuLib(
         driver_root=env.get("TPU_DRIVER_ROOT", "/"),

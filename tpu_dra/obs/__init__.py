@@ -1,0 +1,54 @@
+"""Fleet observability plane (ISSUE 18).
+
+One request's trace crosses four binaries; this package is where the
+pieces come back together:
+
+- :mod:`tpu_dra.obs.collector` — merge spans per trace id from spool
+  files and live ``/debug/traces`` endpoints into a bounded store with
+  honest dropped-span accounting.
+- :mod:`tpu_dra.obs.critical_path` — self-time and critical-path
+  attribution (parent edges, never wall clock) plus the tail-vs-median
+  differential that names the p99 culprit.
+- :mod:`tpu_dra.obs.anomaly` — rolling per-span-name p50/p99 baselines
+  and envelope flagging.
+- :mod:`tpu_dra.obs.recorder` — the always-on flight recorder every
+  binary arms at startup; dumps a postmortem on crash/SIGQUIT.
+
+CLI: ``python -m tpu_dra.obs report`` (text or Perfetto JSON) and
+``python -m tpu_dra.obs collect`` (long-running collector with
+``/debug/attribution`` + ``/debug/anomalies``).  See
+docs/observability.md "Fleet observability".
+"""
+
+from tpu_dra.obs.anomaly import AnomalyDetector  # noqa: F401
+from tpu_dra.obs.collector import Collector, serve_collector  # noqa: F401
+from tpu_dra.obs.critical_path import (  # noqa: F401
+    MergedTrace,
+    attribution,
+    critical_path,
+    differential,
+    merge_trace,
+    self_times,
+)
+from tpu_dra.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    install,
+    install_from_args,
+)
+
+__all__ = [
+    "AnomalyDetector",
+    "Collector",
+    "FlightRecorder",
+    "MergedTrace",
+    "attribution",
+    "critical_path",
+    "differential",
+    "get_recorder",
+    "install",
+    "install_from_args",
+    "merge_trace",
+    "self_times",
+    "serve_collector",
+]

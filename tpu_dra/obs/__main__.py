@@ -1,0 +1,135 @@
+"""``python -m tpu_dra.obs`` — the fleet observability CLI.
+
+Two subcommands:
+
+- ``report`` — one-shot: ingest spool files and/or endpoints, then
+  print per-phase critical-path attribution + the tail-vs-median
+  differential as text, or the merged spans as Perfetto-loadable
+  Chrome trace JSON (``--format perfetto``).
+- ``collect`` — long-running collector: poll loop + HTTP endpoint
+  serving ``/metrics``, ``/debug/attribution``, ``/debug/anomalies``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+from tpu_dra.obs.collector import Collector, serve_collector
+from tpu_dra.obs.critical_path import critical_path
+from tpu_dra.trace.export import chrome_trace
+from tpu_dra.util import klog
+
+
+def _add_source_flags(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--spool-dir", default="",
+                    help="directory of per-process span spool files "
+                         "(the binaries' --trace-spool-dir)")
+    ap.add_argument("--endpoint", action="append", default=[],
+                    help="base URL of a live /debug/traces endpoint "
+                         "(repeatable)")
+    ap.add_argument("--fleet-file", default="",
+                    help="router fleet file; every replica URL in it "
+                         "is pulled as an endpoint")
+
+
+def _collector(args) -> Collector:
+    return Collector(spool_dir=args.spool_dir,
+                     endpoints=tuple(args.endpoint),
+                     fleet_file=args.fleet_file)
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:9.3f}ms"
+
+
+def cmd_report(args) -> int:
+    col = _collector(args)
+    n = col.ingest_once()
+    if args.format == "perfetto":
+        spans = col.spans(args.trace_id or None)
+        json.dump(chrome_trace(spans), sys.stdout, default=str)
+        print()
+        return 0
+    rep = col.attribution_report(args.trace_id or None)
+    print(f"ingested {n} spans, {rep['traces']} trace(s), "
+          f"{rep['spans']} merged spans")
+    print()
+    print("per-phase self-time attribution:")
+    print(f"  {'span':40s} {'count':>6s} {'p50':>11s} {'p90':>11s} "
+          f"{'p99':>11s} {'total':>11s}")
+    for name, a in rep["attribution"].items():
+        print(f"  {name:40s} {a['count']:6d} {_fmt_s(a['p50_s'])} "
+              f"{_fmt_s(a['p90_s'])} {_fmt_s(a['p99_s'])} "
+              f"{_fmt_s(a['total_s'])}")
+    diff = rep["differential"]
+    print()
+    if diff.get("culprit"):
+        c = diff["culprit"]
+        d = diff["spans"][c]
+        print(f"tail-vs-median differential ({diff['tail_traces']} tail "
+              f"/ {diff['body_traces']} body traces): "
+              f"p99 culprit is '{c}' "
+              f"(tail p50 {_fmt_s(d['tail_p50_s'])} vs body p50 "
+              f"{_fmt_s(d['body_p50_s'])}, +{_fmt_s(d['delta_s'])})")
+    else:
+        print("tail-vs-median differential: no culprit "
+              f"({diff.get('error') or 'tail and body look alike'})")
+    if args.trace_id:
+        path = critical_path(col.merged(args.trace_id))
+        print()
+        print(f"critical path for {args.trace_id}:")
+        for s in path:
+            print(f"  {s.get('service', ''):12s} {s.get('name', ''):36s} "
+                  f"dur {_fmt_s(float(s.get('duration') or 0.0))} "
+                  f"self {_fmt_s(s['self_time'])}")
+    return 0
+
+
+def cmd_collect(args) -> int:
+    col = _collector(args)
+    server = serve_collector(col, address=args.address, port=args.port)
+    host, port = server.server_address[:2]
+    # the ready line drives wait for (same contract as serve/router)
+    print(f"collecting on ('{host}', {port})", flush=True)
+    klog.info("obs collector up", spool_dir=args.spool_dir,
+              endpoints=len(col._endpoint_urls()))
+    stop = threading.Event()
+    try:
+        col.run(interval_s=args.interval, stop=stop)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dra.obs",
+        description="fleet trace collector / critical-path reporter")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("report", help="one-shot attribution report")
+    _add_source_flags(rp)
+    rp.add_argument("--trace-id", default="",
+                    help="restrict to one trace (also prints its "
+                         "critical path)")
+    rp.add_argument("--format", choices=("text", "perfetto"),
+                    default="text")
+    rp.set_defaults(fn=cmd_report)
+
+    cp = sub.add_parser("collect", help="long-running collector + HTTP")
+    _add_source_flags(cp)
+    cp.add_argument("--address", default="127.0.0.1")
+    cp.add_argument("--port", type=int, default=0)
+    cp.add_argument("--interval", type=float, default=2.0,
+                    help="ingest poll interval seconds")
+    cp.set_defaults(fn=cmd_collect)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Rolling-baseline span anomaly detection.
+
+The collector feeds every ingested span through one
+:class:`AnomalyDetector`: per span name it keeps a bounded rolling
+window of recent durations, derives a p50/p99 baseline from it, and
+flags spans whose duration escapes the envelope — "this span was 8x
+its own p99" is actionable the moment it happens, hours before a
+human stares at a percentile dashboard.
+
+Flagged spans increment ``tpu_dra_obs_anomalies_total{span=}`` (span
+names pass through :func:`~tpu_dra.util.metrics.bounded_label`'s
+first-come registry, so a hostile or buggy tracer cannot mint
+unbounded series) and land in a bounded recent-anomalies list served
+by the collector's ``/debug/anomalies``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Optional
+
+from tpu_dra.obs.critical_path import percentile
+from tpu_dra.util.metrics import Registry, bounded_label
+
+# baselines need mass before they mean anything: flagging against a
+# 3-sample "p99" would page on noise, so the detector warms up silently
+MIN_SAMPLES = 20
+WINDOW = 512               # rolling durations kept per span name
+MAX_NAMES = 128            # distinct span-name baselines (bounded_label cap)
+RECENT_ANOMALIES = 256     # /debug/anomalies backlog
+REFRESH_EVERY = 32         # admitted samples between baseline recomputes
+
+
+class AnomalyDetector:
+    """Per-span-name rolling p50/p99 baselines + envelope check.
+
+    The envelope: a span is anomalous when its duration exceeds
+    ``max(p99 * p99_factor, p50 * p50_factor)`` of its own name's
+    window.  Two thresholds because tails differ: a tight distribution
+    (p99 ≈ p50) still needs headroom over p50 before tiny absolute
+    wobbles page, and a wide one must compare against its real p99,
+    not a multiple of its median.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 window: int = WINDOW, min_samples: int = MIN_SAMPLES,
+                 p99_factor: float = 2.0, p50_factor: float = 8.0):
+        self.window = window
+        self.min_samples = min_samples
+        self.p99_factor = p99_factor
+        self.p50_factor = p50_factor
+        self._mu = threading.Lock()
+        self._windows: dict[str, collections.deque] = {}
+        # cached (p50, p99) per name, recomputed every REFRESH_EVERY
+        # admitted samples: sorting the window on EVERY span would put
+        # an O(window log window) tax on the collector's ingest loop —
+        # the obs_ingest_idle_us ratchet is what keeps this honest
+        self._stats: dict[str, list] = {}   # name -> [p50, p99, stale]
+        self._seen_names: set[str] = set()
+        self.recent: collections.deque = collections.deque(
+            maxlen=RECENT_ANOMALIES)
+        if registry is not None:
+            self._anomalies = registry.counter(
+                "tpu_dra_obs_anomalies_total",
+                "ingested spans whose duration escaped their own "
+                "name's rolling p50/p99 envelope", ("span",))
+        else:
+            self._anomalies = None
+
+    def observe(self, span: dict[str, Any]) -> bool:
+        """Feed one span; True iff it was flagged anomalous.  The
+        baseline only learns from NON-anomalous durations — an outlier
+        admitted into the window would drag p99 up and teach the
+        detector that slow is normal."""
+        name = bounded_label(span.get("name"), seen=self._seen_names,
+                             cap=MAX_NAMES, lock=self._mu,
+                             overflow="other", empty="span")
+        dur = float(span.get("duration") or 0.0)
+        with self._mu:
+            win = self._windows.get(name)
+            if win is None:
+                win = self._windows[name] = collections.deque(
+                    maxlen=self.window)
+            flagged = False
+            if len(win) >= self.min_samples:
+                stats = self._stats.get(name)
+                if stats is None or stats[2] >= REFRESH_EVERY:
+                    vals = list(win)
+                    stats = self._stats[name] = [
+                        percentile(vals, 0.50), percentile(vals, 0.99), 0]
+                p50, p99 = stats[0], stats[1]
+                envelope = max(p99 * self.p99_factor,
+                               p50 * self.p50_factor)
+                flagged = dur > envelope
+                if flagged:
+                    self.recent.append({
+                        "span": name,
+                        "service": span.get("service", ""),
+                        "trace_id": span.get("trace_id", ""),
+                        "span_id": span.get("span_id", ""),
+                        "duration_s": round(dur, 6),
+                        "baseline_p50_s": round(p50, 6),
+                        "baseline_p99_s": round(p99, 6),
+                        "envelope_s": round(envelope, 6),
+                    })
+            if not flagged:
+                win.append(dur)
+                stats = self._stats.get(name)
+                if stats is not None:
+                    stats[2] += 1
+        if flagged and self._anomalies is not None:
+            self._anomalies.inc(name)
+        return flagged
+
+    def baselines(self) -> dict[str, dict]:
+        """Current per-name baselines (``/debug/anomalies`` body)."""
+        with self._mu:
+            snap = {n: list(w) for n, w in self._windows.items()}
+        out = {}
+        for name, vals in sorted(snap.items()):
+            out[name] = {"samples": len(vals),
+                         "p50_s": round(percentile(vals, 0.50), 6),
+                         "p99_s": round(percentile(vals, 0.99), 6),
+                         "warm": len(vals) >= self.min_samples}
+        return out

@@ -1,0 +1,271 @@
+"""The fleet trace collector: merge one request's spans across binaries.
+
+A single request's trace is scattered across four processes (client,
+router, replica serve, engine batcher) and two transports: per-process
+**spool files** (``--trace-spool-dir``, SpoolExporter's size-bounded
+rotating JSONL) and live **``/debug/traces`` endpoints** (pulled as
+Chrome trace JSON and inverted back to span dicts by
+``spans_from_chrome`` — the same merge path either way).  Endpoints are
+enumerated the way the router already discovers its fleet: the same
+``{"replicas": [{name, url, …}]}`` fleet file, plus explicitly-given
+URLs.
+
+The collector's own store is bounded and HONEST about it: spans
+evicted from the store before analysis read them increment
+``tpu_dra_obs_spans_dropped_total`` — a merged trace with a hole in it
+is a capacity fact the operator can see on ``/metrics``, never a
+silent gap.  Every ingested span also feeds the rolling anomaly
+detector (``tpu_dra/obs/anomaly.py``).
+
+Serving: :func:`serve_collector` mounts ``/debug/attribution`` and
+``/debug/anomalies`` onto the shared metrics HTTP endpoint
+(util/metrics.py ``extra_handlers``) next to the standard
+``/metrics`` + ``/healthz`` surface.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+from tpu_dra.obs.anomaly import AnomalyDetector
+from tpu_dra.obs.critical_path import (
+    MergedTrace,
+    attribution,
+    differential,
+    merge_trace,
+)
+from tpu_dra.trace.export import spans_from_chrome
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import Registry, serve_http_endpoint
+
+MAX_SPANS = 65536          # bounded store: spans kept across all traces
+ENDPOINT_TIMEOUT_S = 5.0
+ENDPOINT_PULL_LIMIT = 4096  # ?limit= asked of each /debug/traces pull
+
+
+class Collector:
+    def __init__(self, spool_dir: str = "",
+                 endpoints: tuple[str, ...] = (),
+                 fleet_file: str = "",
+                 registry: Optional[Registry] = None,
+                 max_spans: int = MAX_SPANS) -> None:
+        self.spool_dir = spool_dir
+        self.endpoints = list(endpoints)
+        self.fleet_file = fleet_file
+        self.max_spans = max_spans
+        self._mu = threading.Lock()
+        self._spans: collections.deque = collections.deque(maxlen=max_spans)
+        self._seen: set[tuple[str, str]] = set()   # (trace_id, span_id)
+        self._offsets: dict[str, int] = {}         # spool file → bytes read
+        self.registry = registry or Registry()
+        self.anomalies = AnomalyDetector(self.registry)
+        self._ingested = self.registry.counter(
+            "tpu_dra_obs_spans_ingested_total",
+            "spans accepted into the collector's bounded store",
+            ("source",))
+        self._dropped = self.registry.counter(
+            "tpu_dra_obs_spans_dropped_total",
+            "spans evicted from the collector's bounded store before "
+            "analysis read them — holes in merged traces are visible "
+            "capacity facts, not silence")
+        self._ingest_errors = self.registry.counter(
+            "tpu_dra_obs_ingest_errors_total",
+            "unreadable spool lines / unreachable endpoints skipped "
+            "during an ingest pass", ("source",))
+
+    # -- ingestion -----------------------------------------------------
+
+    def add_spans(self, spans: list[dict[str, Any]],
+                  source: str = "direct") -> int:
+        """Merge a batch into the store, deduplicating on
+        (trace_id, span_id) — the same span arrives via a spool file
+        AND a live pull, and must count once.  Returns accepted count."""
+        accepted = 0
+        for s in spans:
+            key = (s.get("trace_id") or "", s.get("span_id") or "")
+            with self._mu:
+                if key[1] and key in self._seen:
+                    continue
+                evicting = len(self._spans) == self.max_spans
+                self._spans.append(s)
+                if key[1]:
+                    self._seen.add(key)
+                    if len(self._seen) > 4 * self.max_spans:
+                        # dedup memory is bounded too: rebuild from the
+                        # live store (evicted spans become re-ingestable,
+                        # which dedup-by-store-membership tolerates)
+                        self._seen = {
+                            (x.get("trace_id") or "",
+                             x.get("span_id") or "")
+                            for x in self._spans}
+            if evicting:
+                self._dropped.inc()
+            accepted += 1
+            self.anomalies.observe(s)
+        if accepted:
+            self._ingested.inc(source, by=accepted)
+        return accepted
+
+    def ingest_spool_dir(self) -> int:
+        """Incrementally read every ``*.jsonl`` (and rotated
+        ``*.jsonl.1``) file in the spool directory.  Per-file byte
+        offsets make polling cheap; a file that SHRANK was rotated
+        under us, so it re-reads from zero (dedup absorbs any overlap)."""
+        if not self.spool_dir:
+            return 0
+        try:
+            names = sorted(os.listdir(self.spool_dir))
+        except OSError:
+            return 0
+        total = 0
+        for name in names:
+            if not (name.endswith(".jsonl") or name.endswith(".jsonl.1")):
+                continue
+            total += self._ingest_spool_file(
+                os.path.join(self.spool_dir, name))
+        return total
+
+    def _ingest_spool_file(self, path: str) -> int:
+        offset = self._offsets.get(path, 0)
+        try:
+            size = os.path.getsize(path)
+            if size < offset:
+                offset = 0               # rotated: start over
+            with open(path, "r", encoding="utf-8") as f:
+                f.seek(offset)
+                data = f.read()
+                self._offsets[path] = f.tell()
+        except OSError:
+            self._ingest_errors.inc("spool")
+            return 0
+        spans = []
+        for line in data.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spans.append(json.loads(line))
+            except json.JSONDecodeError:
+                # a torn tail line (writer mid-append at rotation) is
+                # expected; count it, keep going
+                self._ingest_errors.inc("spool")
+        return self.add_spans(spans, source="spool")
+
+    def _endpoint_urls(self) -> list[str]:
+        urls = list(self.endpoints)
+        if self.fleet_file:
+            # the router's own discovery contract: autoscaler-written
+            # {"replicas": [{name, url, …}]} — one file enumerates the
+            # fleet for routing AND for observability
+            try:
+                with open(self.fleet_file) as f:
+                    entries = json.load(f).get("replicas") or []
+            except (OSError, json.JSONDecodeError):
+                entries = []
+            for ent in entries:
+                url = (ent.get("url") or "").rstrip("/")
+                if url:
+                    urls.append(url)
+        return list(dict.fromkeys(urls))     # order-preserving dedup
+
+    def ingest_endpoints(self) -> int:
+        """Pull ``/debug/traces`` from every live endpoint and invert
+        the Chrome JSON back to span dicts."""
+        total = 0
+        for url in self._endpoint_urls():
+            full = f"{url}/debug/traces?limit={ENDPOINT_PULL_LIMIT}"
+            try:
+                with urllib.request.urlopen(full,
+                                            timeout=ENDPOINT_TIMEOUT_S) as r:
+                    doc = json.loads(r.read())
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                self._ingest_errors.inc("endpoint")
+                klog.info("obs: endpoint pull failed", level=4,
+                          url=url, err=str(exc)[:120])
+                continue
+            total += self.add_spans(spans_from_chrome(doc),
+                                    source="endpoint")
+        return total
+
+    def ingest_once(self) -> int:
+        return self.ingest_spool_dir() + self.ingest_endpoints()
+
+    def run(self, interval_s: float = 2.0,
+            stop: Optional[threading.Event] = None) -> None:
+        stop = stop or threading.Event()
+        while not stop.wait(interval_s):
+            self.ingest_once()
+
+    # -- analysis reads ------------------------------------------------
+
+    def spans(self, trace_id: Optional[str] = None) -> list[dict[str, Any]]:
+        with self._mu:
+            snap = list(self._spans)
+        if trace_id:
+            snap = [s for s in snap if s.get("trace_id") == trace_id]
+        return snap
+
+    def trace_ids(self) -> list[str]:
+        with self._mu:
+            snap = list(self._spans)
+        return list(dict.fromkeys(
+            s.get("trace_id") for s in snap if s.get("trace_id")))
+
+    def merged(self, trace_id: str) -> MergedTrace:
+        return merge_trace(self.spans(trace_id), trace_id)
+
+    def merged_all(self) -> list[MergedTrace]:
+        return [self.merged(tid) for tid in self.trace_ids()]
+
+    def attribution_report(self,
+                           trace_id: Optional[str] = None) -> dict:
+        traces = ([self.merged(trace_id)] if trace_id
+                  else self.merged_all())
+        return {
+            "traces": len(traces),
+            "spans": sum(len(m.spans) for m in traces),
+            "attribution": attribution(traces),
+            "differential": differential(traces),
+        }
+
+    # -- HTTP ----------------------------------------------------------
+
+    def _attribution_handler(self, path: str) -> tuple[int, str, bytes]:
+        from urllib.parse import parse_qs, urlparse
+        qs = parse_qs(urlparse(path).query)
+        trace_id = qs.get("trace_id", [""])[0] or None
+        if trace_id and not self.spans(trace_id):
+            return 404, "application/json", json.dumps({
+                "error": "trace_id not found: evicted from the "
+                         "collector's bounded store or never ingested",
+                "trace_id": trace_id,
+            }).encode()
+        body = json.dumps(self.attribution_report(trace_id),
+                          default=str).encode()
+        return 200, "application/json", body
+
+    def _anomalies_handler(self, path: str) -> tuple[int, str, bytes]:
+        body = json.dumps({
+            "recent": list(self.anomalies.recent),
+            "baselines": self.anomalies.baselines(),
+        }, default=str).encode()
+        return 200, "application/json", body
+
+
+def serve_collector(collector: Collector, address: str = "127.0.0.1",
+                    port: int = 0):
+    """The collector's HTTP surface on the shared endpoint plumbing:
+    ``/metrics`` (the obs registry), ``/healthz``, plus the two
+    analysis views mounted via ``extra_handlers``."""
+    return serve_http_endpoint(
+        address, port, registry=collector.registry,
+        extra_handlers={
+            "/debug/attribution": collector._attribution_handler,
+            "/debug/anomalies": collector._anomalies_handler,
+        })

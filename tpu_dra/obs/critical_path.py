@@ -1,0 +1,223 @@
+"""Cross-binary trace merge + critical-path attribution.
+
+A trace of one request crosses four processes (client → router →
+replica → engine), each exporting spans on its own clock.  This module
+turns the merged span soup into answers an operator can act on:
+
+- :func:`merge_trace` — one trace's spans (from any number of spool
+  files and live endpoints) into a parent-edge tree.  **Parent edges
+  order the tree, never wall clock**: two processes' clocks can
+  disagree by more than a span's duration, so any start-time-based
+  nesting would invent or destroy parent/child relationships.
+- :func:`self_times` — each span's *self time* (its duration minus its
+  direct children's durations, floored at zero).  Durations are
+  per-process monotonic measurements, so self time is clock-skew
+  immune even when absolute starts are not.
+- :func:`critical_path` — root-to-leaf walk descending into the
+  longest child at every step; the path's self times telescope back to
+  ≈ the root's wall time, which is the invariant ``make drive-obs``
+  asserts.
+- :func:`attribution` / :func:`differential` — per-span-name self-time
+  percentiles across traces, and the tail-vs-median comparison that
+  names which span *grew* in the slow traces (the p99 culprit).
+
+Merge edge cases are deliberate behavior, pinned by tests
+(tests/test_obs.py): duplicate span ids (a respawned worker re-rolled
+ids already exported) keep the FIRST occurrence; spans whose parent
+never arrived (dropped, unsampled fragment, or mid-merge) are orphans
+and become roots of their own subtree rather than being discarded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class MergedTrace:
+    """One trace's spans indexed for tree walks.
+
+    ``spans``: span_id → span dict (first occurrence wins on duplicate
+    ids).  ``children``: span_id → child ids, ordered by arrival.
+    ``roots``: ids with no parent edge into the merged set — the true
+    root plus any orphans.
+    """
+
+    __slots__ = ("trace_id", "spans", "children", "roots", "duplicates",
+                 "orphans")
+
+    def __init__(self, trace_id: str) -> None:
+        self.trace_id = trace_id
+        self.spans: dict[str, dict[str, Any]] = {}
+        self.children: dict[str, list[str]] = {}
+        self.roots: list[str] = []
+        self.duplicates = 0
+        self.orphans = 0
+
+    def root(self) -> Optional[dict[str, Any]]:
+        """The best root candidate: the parentless span with the
+        largest duration (orphans are roots too, but the true root
+        encloses everything)."""
+        if not self.roots:
+            return None
+        rid = max(self.roots,
+                  key=lambda r: self.spans[r].get("duration") or 0.0)
+        return self.spans[rid]
+
+
+def merge_trace(spans: list[dict[str, Any]],
+                trace_id: str = "") -> MergedTrace:
+    """Merge one trace's spans into a :class:`MergedTrace`.
+
+    ``spans`` may mix sources (spool files, live /debug/traces pulls)
+    and processes; entries whose ``trace_id`` differs from ``trace_id``
+    (when given) are ignored so callers can pass an unfiltered batch.
+    """
+    merged = MergedTrace(trace_id)
+    for s in spans:
+        tid = s.get("trace_id") or ""
+        if trace_id and tid != trace_id:
+            continue
+        if not merged.trace_id:
+            merged.trace_id = tid
+        sid = s.get("span_id") or ""
+        if not sid or sid in merged.spans:
+            # duplicate span id: a respawned worker re-rolled an id the
+            # old incarnation already exported, or the collector read
+            # the same span from a spool AND a live pull — keep the
+            # first, count the rest (honest accounting, not silence)
+            merged.duplicates += sid in merged.spans
+            continue
+        merged.spans[sid] = s
+    # parent edges second, over the complete id set: arrival order must
+    # not decide orphanhood (a child often lands before its parent when
+    # processes flush at different rates)
+    for sid, s in merged.spans.items():
+        pid = s.get("parent_id") or ""
+        if pid and pid in merged.spans:
+            merged.children.setdefault(pid, []).append(sid)
+        else:
+            merged.roots.append(sid)
+            if pid:
+                merged.orphans += 1
+    return merged
+
+
+def self_times(merged: MergedTrace) -> dict[str, float]:
+    """span_id → self time: duration minus direct children's durations,
+    floored at zero (a child measured on a skewed clock, or overlapping
+    parallel children, can sum past the parent — negative self time is
+    measurement noise, not credit)."""
+    out: dict[str, float] = {}
+    for sid, s in merged.spans.items():
+        dur = float(s.get("duration") or 0.0)
+        kids = sum(float(merged.spans[c].get("duration") or 0.0)
+                   for c in merged.children.get(sid, ()))
+        out[sid] = max(dur - kids, 0.0)
+    return out
+
+
+def critical_path(merged: MergedTrace) -> list[dict[str, Any]]:
+    """Root-to-leaf span list, descending into the longest-duration
+    child at every level — the chain that bounded the request's wall
+    time.  Each entry is the span dict plus a ``self_time`` key."""
+    root = merged.root()
+    if root is None:
+        return []
+    st = self_times(merged)
+    path = []
+    cur = root["span_id"]
+    while True:
+        span = dict(merged.spans[cur])
+        span["self_time"] = st.get(cur, 0.0)
+        path.append(span)
+        kids = merged.children.get(cur, ())
+        if not kids:
+            return path
+        cur = max(kids,
+                  key=lambda c: merged.spans[c].get("duration") or 0.0)
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]); 0.0 on empty input."""
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(int(q * len(vs)), len(vs) - 1)
+    return vs[idx]
+
+
+def attribution(merged_traces: list[MergedTrace]) -> dict[str, dict]:
+    """Per-span-name self-time aggregation across traces:
+    ``{name: {count, total_s, p50_s, p90_s, p99_s, max_s}}``, the body
+    of ``/debug/attribution`` and the ``report`` subcommand."""
+    by_name: dict[str, list[float]] = {}
+    for m in merged_traces:
+        st = self_times(m)
+        for sid, t in st.items():
+            name = m.spans[sid].get("name") or "span"
+            by_name.setdefault(name, []).append(t)
+    out = {}
+    for name, ts in sorted(by_name.items()):
+        out[name] = {
+            "count": len(ts),
+            "total_s": round(sum(ts), 6),
+            "p50_s": round(percentile(ts, 0.50), 6),
+            "p90_s": round(percentile(ts, 0.90), 6),
+            "p99_s": round(percentile(ts, 0.99), 6),
+            "max_s": round(max(ts), 6),
+        }
+    return out
+
+
+def differential(merged_traces: list[MergedTrace],
+                 tail_q: float = 0.9) -> dict[str, Any]:
+    """Tail-vs-median self-time differential: which span name explains
+    the slow traces?
+
+    Traces are ranked by root duration; those at or above the
+    ``tail_q`` quantile are the tail, the rest the body.  For every
+    span name the median self time is computed in each population, and
+    the name with the largest tail − body delta is the culprit — the
+    span that GREW when requests got slow, as opposed to one that is
+    merely always large.  ``make drive-obs`` asserts this names the
+    armed ``serve.engine.slow_decode`` failpoint's span.
+    """
+    rooted = [(m, m.root()) for m in merged_traces]
+    rooted = [(m, r) for m, r in rooted if r is not None]
+    if len(rooted) < 4:
+        return {"traces": len(rooted), "culprit": None, "spans": {},
+                "error": "need >= 4 rooted traces for a differential"}
+    durs = [float(r.get("duration") or 0.0) for _, r in rooted]
+    cut = percentile(durs, tail_q)
+    tail = [m for m, r in rooted
+            if float(r.get("duration") or 0.0) >= cut]
+    body = [m for m, r in rooted
+            if float(r.get("duration") or 0.0) < cut]
+    if not body:       # all durations equal: no tail to explain
+        body, tail = tail, []
+
+    def medians(traces: list[MergedTrace]) -> dict[str, float]:
+        by_name: dict[str, list[float]] = {}
+        for m in traces:
+            st = self_times(m)
+            for sid, t in st.items():
+                name = m.spans[sid].get("name") or "span"
+                by_name.setdefault(name, []).append(t)
+        return {n: percentile(ts, 0.5) for n, ts in by_name.items()}
+
+    tail_med = medians(tail)
+    body_med = medians(body)
+    spans = {}
+    for name in sorted(set(tail_med) | set(body_med)):
+        t, b = tail_med.get(name, 0.0), body_med.get(name, 0.0)
+        spans[name] = {"tail_p50_s": round(t, 6),
+                       "body_p50_s": round(b, 6),
+                       "delta_s": round(t - b, 6)}
+    culprit = None
+    if spans and tail:
+        culprit = max(spans, key=lambda n: spans[n]["delta_s"])
+        if spans[culprit]["delta_s"] <= 0.0:
+            culprit = None
+    return {"traces": len(rooted), "tail_traces": len(tail),
+            "body_traces": len(body), "tail_cut_s": round(cut, 6),
+            "culprit": culprit, "spans": spans}

@@ -1,0 +1,198 @@
+"""The flight recorder: an always-on in-process black box.
+
+Every binary installs one at startup (``--flight-recorder-dir``).  It
+keeps the recent past — the last seconds of finished spans, a bounded
+tail of klog lines, and metric deltas since install — at near-zero
+idle cost, and dumps it all to a postmortem JSON file when the process
+dies badly: crash (uncaught exception on any thread) or SIGQUIT (the
+operator's "tell me what you were doing" signal).  A crash report that
+says *what the process was doing in its final seconds* turns "the
+replica died" from an archaeology project into a read.
+
+Idle-cost budget (enforced by ``make bench-gate``,
+``flight_recorder_idle_us``): the ONLY per-event work while healthy is
+the klog tap's bounded-deque append — spans are read from the tracer's
+existing ring at dump time (zero added per-span cost), and metric
+deltas are two :meth:`~tpu_dra.util.metrics.Registry.snapshot` calls
+diffed at dump time.
+
+Dump destinations: ``<dir>/<service>-<pid>-<reason>.json`` when a
+directory was configured, else one JSON line to stderr (a containered
+binary with no writable volume still gets its black box into the log
+stream).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Optional
+
+from tpu_dra.trace.tracer import DEFAULT_RING
+from tpu_dra.util import klog
+from tpu_dra.util.metrics import DEFAULT_REGISTRY, Registry
+
+SPAN_WINDOW_S = 30.0       # how far back the span section reaches
+LOG_TAIL_LINES = 256       # klog lines kept
+MAX_DUMP_SPANS = 1024      # span-section cap (newest win)
+
+
+class FlightRecorder:
+    def __init__(self, service: str, registry: Optional[Registry] = None,
+                 dump_dir: str = "", window_s: float = SPAN_WINDOW_S,
+                 log_lines: int = LOG_TAIL_LINES) -> None:
+        self.service = service
+        self.registry = registry or DEFAULT_REGISTRY
+        self.dump_dir = dump_dir
+        self.window_s = window_s
+        self._log_tail: deque = deque(maxlen=log_lines)
+        self._baseline: dict[str, float] = {}
+        self._installed_at = 0.0
+        self._dump_mu = threading.Lock()
+        self._dumped_reasons: set[str] = set()
+
+    # -- recording (the always-on part) --------------------------------
+
+    def _tap(self, line: str) -> None:
+        # deque.append with maxlen is atomic under the GIL and O(1):
+        # this is the recorder's entire per-log-line cost
+        self._log_tail.append(line)
+
+    def install(self) -> "FlightRecorder":
+        """Arm the recorder: klog tap, crash hooks, SIGQUIT handler.
+        Metric deltas baseline from this moment."""
+        self._installed_at = time.time()
+        self._baseline = self.registry.snapshot()
+        klog.set_tap(self._tap)
+
+        prev_excepthook = sys.excepthook
+
+        def _excepthook(exc_type, exc, tb):
+            self.dump("uncaught-exception", exc_info=(exc_type, exc, tb))
+            prev_excepthook(exc_type, exc, tb)
+
+        sys.excepthook = _excepthook
+
+        prev_thook = threading.excepthook
+
+        def _thread_excepthook(hook_args):
+            if hook_args.exc_type is not SystemExit:
+                self.dump("uncaught-thread-exception",
+                          exc_info=(hook_args.exc_type, hook_args.exc_value,
+                                    hook_args.exc_traceback))
+            prev_thook(hook_args)
+
+        threading.excepthook = _thread_excepthook
+
+        try:
+            signal.signal(signal.SIGQUIT, self._on_sigquit)
+        except (ValueError, AttributeError, OSError):
+            # not the main thread, or a platform without SIGQUIT: the
+            # crash hooks still work; the operator signal does not
+            pass
+        return self
+
+    def _on_sigquit(self, signum, frame) -> None:
+        self.dump("sigquit")
+        # die WITH SIGQUIT semantics after the black box is on disk:
+        # restore the default action and re-deliver, so supervisors see
+        # the same kill-by-SIGQUIT they would without a recorder
+        signal.signal(signal.SIGQUIT, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGQUIT)
+
+    # -- dumping (the only expensive part, paid at death) --------------
+
+    def _recent_spans(self) -> list[dict[str, Any]]:
+        cutoff = time.time() - self.window_s
+        spans = [s for s in DEFAULT_RING.spans()
+                 if float(s.get("start") or 0.0)
+                 + float(s.get("duration") or 0.0) >= cutoff]
+        return spans[-MAX_DUMP_SPANS:]
+
+    def _metric_deltas(self) -> dict[str, float]:
+        now = self.registry.snapshot()
+        deltas = {}
+        for series, val in now.items():
+            d = val - self._baseline.get(series, 0.0)
+            if d != 0.0:
+                deltas[series] = round(d, 6)
+        return deltas
+
+    def dump(self, reason: str, exc_info: Optional[tuple] = None
+             ) -> Optional[str]:
+        """Write the postmortem; returns its path (None when it went to
+        stderr).  Re-entrant-safe and once-per-reason: a crash while
+        dumping, or N threads dying at once, must not recurse or shred
+        the file."""
+        with self._dump_mu:
+            if reason in self._dumped_reasons:
+                return None
+            self._dumped_reasons.add(reason)
+            doc: dict[str, Any] = {
+                "service": self.service,
+                "pid": os.getpid(),
+                "reason": reason,
+                "ts": time.time(),
+                "uptime_s": round(time.time() - self._installed_at, 3)
+                if self._installed_at else None,
+                "window_s": self.window_s,
+                "spans": self._recent_spans(),
+                "log_tail": list(self._log_tail),
+                "metric_deltas": self._metric_deltas(),
+            }
+            if exc_info is not None:
+                doc["exception"] = "".join(
+                    traceback.format_exception(*exc_info))[-8192:]
+            body = json.dumps(doc, default=str, indent=1)
+            if not self.dump_dir:
+                print(f"FLIGHT-RECORDER {body}", file=sys.stderr,
+                      flush=True)
+                return None
+            path = os.path.join(
+                self.dump_dir,
+                f"{self.service}-{os.getpid()}-{reason}.json")
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(body)
+            except OSError:
+                # last resort: the black box is worthless lost, so fall
+                # back to the log stream like the no-dir configuration
+                print(f"FLIGHT-RECORDER {body}", file=sys.stderr,
+                      flush=True)
+                return None
+            return path
+
+
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def install(service: str, registry: Optional[Registry] = None,
+            dump_dir: str = "") -> FlightRecorder:
+    """The one-liner every binary's main calls (after metrics exist, so
+    the baseline snapshot is meaningful).  Installing again replaces
+    the previous recorder — a test harness reconfiguring is not an
+    error."""
+    global _RECORDER
+    _RECORDER = FlightRecorder(service, registry=registry,
+                               dump_dir=dump_dir).install()
+    return _RECORDER
+
+
+def install_from_args(args, service: str,
+                      registry: Optional[Registry] = None
+                      ) -> FlightRecorder:
+    """Install from the shared tracing flag group
+    (``util/flags.py tracing_flags``, ``--flight-recorder-dir``)."""
+    return install(service, registry=registry,
+                   dump_dir=getattr(args, "flight_recorder_dir", "") or "")
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER

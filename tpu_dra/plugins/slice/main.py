@@ -23,6 +23,8 @@ def main(argv=None) -> int:
     klog.configure(args.v, args.logging_format)
     from tpu_dra import trace
     trace.configure_from_args(args, service="slice-domain-kubelet-plugin")
+    from tpu_dra.obs import recorder
+    recorder.install_from_args(args, service="slice-domain-kubelet-plugin")
     from tpu_dra.util.metrics import serve_from_flag
     serve_from_flag(args.http_endpoint)
     kube = new_clients(args.kubeconfig, args.kube_api_qps,

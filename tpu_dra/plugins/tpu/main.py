@@ -69,6 +69,8 @@ def main(argv=None) -> int:
     klog.configure(args.v, args.logging_format)
     from tpu_dra import trace
     trace.configure_from_args(args, service="tpu-kubelet-plugin")
+    from tpu_dra.obs import recorder
+    recorder.install_from_args(args, service="tpu-kubelet-plugin")
     kube = new_clients(args.kubeconfig, args.kube_api_qps,
                        args.kube_api_burst)
     driver = TpuDriver(TpuDriverConfig(

@@ -15,7 +15,9 @@ from tpu_dra.trace import propagation  # noqa: F401
 from tpu_dra.trace.export import (  # noqa: F401
     JsonlExporter,
     RingBufferExporter,
+    SpoolExporter,
     chrome_trace,
+    spans_from_chrome,
 )
 from tpu_dra.trace.propagation import (  # noqa: F401
     TRACEPARENT_ANNOTATION,
@@ -48,10 +50,12 @@ __all__ = [
     "RingBufferExporter",
     "Span",
     "SpanContext",
+    "SpoolExporter",
     "TRACEPARENT_ANNOTATION",
     "TRACEPARENT_ENV",
     "Tracer",
     "chrome_trace",
+    "spans_from_chrome",
     "configure",
     "configure_from_args",
     "current_context",

@@ -20,7 +20,11 @@ import sys
 from contextlib import contextmanager
 from typing import Any, Iterator, Optional, Union
 
-from tpu_dra.trace.export import JsonlExporter, RingBufferExporter
+from tpu_dra.trace.export import (
+    JsonlExporter,
+    RingBufferExporter,
+    SpoolExporter,
+)
 from tpu_dra.trace.span import (
     _CURRENT,
     NOOP_SPAN,
@@ -143,20 +147,47 @@ class Tracer:
             for exporter in self.exporters:
                 exporter.export(span.to_dict())
 
+    def record_span(self, name: str, parent: ParentLike,
+                    start: float, duration: float,
+                    attributes: Optional[dict[str, Any]] = None,
+                    status: str = "ok") -> None:
+        """Export an already-finished operation as a span, with explicit
+        wall-clock ``start`` and ``duration`` — for work whose lifetime
+        was measured by someone else (the continuous engine retires a
+        request on the batcher thread long after admission timed it).
+        No contextvar is touched; unsampled parents cost one compare."""
+        pctx = _resolve_parent(parent)
+        if pctx is None or not pctx.sampled:
+            return
+        ctx = SpanContext(trace_id=pctx.trace_id, span_id=new_span_id(),
+                          sampled=True)
+        span = Span(name, ctx, parent_id=pctx.span_id,
+                    service=self.service, attributes=attributes)
+        span.start_time = start
+        span.duration = max(duration, 0.0)
+        span.status = status
+        for exporter in self.exporters:
+            exporter.export(span.to_dict())
+
 
 _DEFAULT = Tracer(exporters=(DEFAULT_RING,))
 
 
 def configure(service: Optional[str] = None,
               sample_ratio: Optional[float] = None,
-              jsonl_path: Optional[str] = None) -> Tracer:
+              jsonl_path: Optional[str] = None,
+              spool_path: Optional[str] = None) -> Tracer:
     """(Re)configure the process-wide default tracer; each binary calls
     this once at startup with its own service name.  The ring buffer
-    exporter is always kept; ``jsonl_path`` adds a file sink."""
+    exporter is always kept; ``jsonl_path`` adds an unbounded file
+    sink, ``spool_path`` a size-bounded rotating one for the fleet
+    collector (tpu_dra/obs)."""
     global _DEFAULT
     exporters: list = [DEFAULT_RING]
     if jsonl_path:
         exporters.append(JsonlExporter(jsonl_path))
+    if spool_path:
+        exporters.append(SpoolExporter(spool_path))
     _DEFAULT = Tracer(
         service=service or _DEFAULT.service,
         sample_ratio=(sample_ratio if sample_ratio is not None
@@ -165,13 +196,26 @@ def configure(service: Optional[str] = None,
     return _DEFAULT
 
 
+def spool_path_for(spool_dir: str, service: str) -> str:
+    """The per-process spool file the collector's directory scan will
+    find: service + pid disambiguate concurrent binaries AND a
+    respawned worker reusing the service name."""
+    return os.path.join(spool_dir, f"{service}-{os.getpid()}.jsonl")
+
+
 def configure_from_args(args, service: str) -> Tracer:
     """Configure the default tracer from the shared tracing flag group
     (``util/flags.py tracing_flags``) — the one-liner every binary's
     main calls so the setup cannot drift between them."""
+    spool_dir = getattr(args, "trace_spool_dir", "") or ""
+    spool_path = None
+    if spool_dir:
+        os.makedirs(spool_dir, exist_ok=True)
+        spool_path = spool_path_for(spool_dir, service)
     return configure(service=service,
                      sample_ratio=args.trace_sample_ratio,
-                     jsonl_path=args.trace_file or None)
+                     jsonl_path=args.trace_file or None,
+                     spool_path=spool_path)
 
 
 def get_tracer() -> Tracer:

@@ -86,6 +86,14 @@ def tracing_flags() -> FlagGroup:
         Flag("trace-file", "TRACE_FILE",
              "append finished spans to this JSONL file (empty = off; "
              "the in-memory /debug/traces ring is always on)", ""),
+        Flag("trace-spool-dir", "TRACE_SPOOL_DIR",
+             "write finished spans to a size-bounded rotating spool "
+             "file in this directory for the fleet collector "
+             "(python -m tpu_dra.obs; empty = off)", ""),
+        Flag("flight-recorder-dir", "FLIGHT_RECORDER_DIR",
+             "dump the always-on flight recorder (last spans, klog "
+             "tail, metric deltas) to a postmortem file in this "
+             "directory on crash/SIGQUIT (empty = dump to stderr)", ""),
     ])
 
 

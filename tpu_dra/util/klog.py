@@ -23,6 +23,17 @@ _VERBOSITY = 2
 _JSON = False
 _lock = threading.Lock()
 _logger = logging.getLogger("tpu-dra")
+# the flight recorder's log tail (tpu_dra/obs/recorder.py): every
+# formatted line is ALSO handed to the tap, which appends it to a
+# bounded deque — one None check per line when no recorder is installed
+_tap = None
+
+
+def set_tap(fn) -> None:
+    """Install (or with None, remove) the single line tap.  Taps must
+    be bounded-cost and never raise: they run on every log line."""
+    global _tap
+    _tap = fn
 
 
 def configure(verbosity: int = 2, fmt: str = "text") -> None:
@@ -60,6 +71,9 @@ def _emit(severity: str, msg: str, kv: dict[str, Any]) -> None:
     else:
         kvs = " ".join(f"{k}={v!r}" for k, v in kv.items())
         line = f"{severity[0]}{ts} {msg}" + (f" {kvs}" if kvs else "")
+    tap = _tap
+    if tap is not None:
+        tap(line)
     with _lock:
         _logger.info(line)
 

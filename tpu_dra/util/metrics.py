@@ -265,6 +265,12 @@ class Gauge:
         with self._mu:
             return self._values.get(label_values, 0.0)
 
+    def values(self) -> dict[tuple[str, ...], float]:
+        """All label sets with their current values — the registry
+        snapshot's read path (flight recorder deltas)."""
+        with self._mu:
+            return dict(self._values)
+
     def collect(self, openmetrics: bool = False) -> str:
         with self._mu:
             items = list(self._values.items())
@@ -529,6 +535,37 @@ class Registry:
         return any(isinstance(m, Histogram) and m.has_exemplars()
                    for m in metrics)
 
+    def snapshot(self) -> dict[str, float]:
+        """Flat ``{series: value}`` view of every registered metric —
+        counters and gauges one entry per label set, histograms their
+        ``_count``/``_sum`` — cheap enough to take twice and diff,
+        which is exactly what the flight recorder
+        (``tpu_dra/obs/recorder.py``) does for its metric-deltas
+        postmortem section."""
+        with self._mu:
+            metrics = [m for m, _ in self._metrics.values()]
+        out: dict[str, float] = {}
+
+        def key(name: str, labels: tuple, lv: tuple) -> str:
+            lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                           for k, v in zip(labels, lv))
+            return f"{name}{{{lbl}}}" if lbl else name
+
+        for m in metrics:
+            if isinstance(m, Counter):
+                for lv, val in m.totals().items():
+                    out[key(m.name, m.labels, lv)] = val
+            elif isinstance(m, Gauge):
+                for lv, val in m.values().items():
+                    out[key(m.name, m.labels, lv)] = val
+            elif isinstance(m, Histogram):
+                for lv, snap in m.snapshot().items():
+                    out[key(m.name + "_count", m.labels, lv)] = \
+                        float(snap["count"])
+                    out[key(m.name + "_sum", m.labels, lv)] = \
+                        float(snap["sum"])
+        return out
+
     def expose(self, openmetrics: bool = False) -> str:
         """Text exposition of every registered metric.  The default is
         the Prometheus 0.0.4 text format (unchanged, exemplar-free);
@@ -624,16 +661,24 @@ def serve_http_endpoint(
     traces_path: str = "/debug/traces",
     registry: Optional[Registry] = None,
     healthz: Optional[Callable[[], bool]] = None,
+    extra_handlers: Optional[dict[str, Callable[[str],
+                                  tuple[int, str, bytes]]]] = None,
 ) -> ThreadingHTTPServer:
     """Start the metrics/pprof/traces HTTP server in a daemon thread;
     returns the server (``server.server_address`` carries the bound
     port).  ``traces_path`` serves the default trace ring buffer as
     Chrome trace-event JSON (Perfetto-loadable), filterable with
-    ``?trace_id=``."""
+    ``?trace_id=`` and size-capped with ``?limit=``.
+    ``extra_handlers`` maps a path prefix to
+    ``fn(full_path) -> (status, content_type, body)`` — how the fleet
+    collector (tpu_dra/obs) mounts ``/debug/attribution`` and
+    ``/debug/anomalies`` without forking this server."""
     reg = registry or DEFAULT_REGISTRY
+    extras = dict(extra_handlers or {})
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self) -> None:  # noqa: N802 (http.server API)
+            status = 200
             if self.path == metrics_path:
                 text, ctype = negotiate_exposition(
                     self.headers.get("Accept", ""), reg)
@@ -644,7 +689,7 @@ def serve_http_endpoint(
                 # body builder is shared with serve.py's handler so the
                 # exemplar→trace contract cannot drift between them
                 from tpu_dra.trace.export import debug_traces_body
-                body = debug_traces_body(self.path)
+                status, body = debug_traces_body(self.path)
                 ctype = "application/json"
             elif self.path.startswith(pprof_path + "/profile"):
                 qs = parse_qs(urlparse(self.path).query)
@@ -687,10 +732,15 @@ def serve_http_endpoint(
                 self.wfile.write(b"ok" if ok else b"unhealthy")
                 return
             else:
-                self.send_response(404)
-                self.end_headers()
-                return
-            self.send_response(200)
+                for prefix, fn in extras.items():
+                    if self.path.startswith(prefix):
+                        status, ctype, body = fn(self.path)
+                        break
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -59,6 +59,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_dra.resilience import failpoint
+# span.py is stdlib-only (the cycle-safety contract klog relies on too):
+# submit captures the caller's sampled trace context so retirement —
+# which happens later, on the batcher thread, outside any contextvar —
+# can export the slot residency as a "serve.engine.decode" child span
+from tpu_dra.trace.span import current_context as _current_trace_context
 from tpu_dra.workloads.decode import (
     _chunk_hidden,
     _chunk_logits,
@@ -129,6 +134,11 @@ class _Request:
     first_token_at: float = 0.0
     finished: float = 0.0
     error: Optional[str] = None
+    # the submitter's SAMPLED trace context (None when unsampled):
+    # retirement runs on the batcher thread where the request's span is
+    # long gone from the contextvar, so the engine-time child span
+    # ("serve.engine.decode") parents on this captured context instead
+    trace_ctx: Optional[Any] = None
 
     @property
     def latency_s(self) -> float:
@@ -1124,6 +1134,9 @@ class ContinuousEngine:
         req = _Request(prompt=list(prompt), steps=steps, eos_id=eos_id,
                        temperature=float(temperature), seed=seed,
                        prefix_id=prefix_id, stop=stop, deadline=deadline)
+        ctx = _current_trace_context()
+        if ctx is not None and ctx.sampled:
+            req.trace_ctx = ctx
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -1178,6 +1191,9 @@ class ContinuousEngine:
                        eos_id=eos_id, temperature=float(temperature),
                        seed=seed, stop=stop, deadline=deadline,
                        handoff=handoff)
+        ctx = _current_trace_context()
+        if ctx is not None and ctx.sampled:
+            req.trace_ctx = ctx
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -1807,6 +1823,7 @@ class ContinuousEngine:
         self.completed += 1
         self.tokens_out += len(req.tokens)
         self.latencies_s.append(req.latency_s)
+        self._export_decode_span(req, "ok")
         req.done.set()
 
     def _abort_slot(self, slot: int, req: _Request, error: str,
@@ -1824,9 +1841,27 @@ class ContinuousEngine:
             self.badput_slot_s[badput_reason] = (
                 self.badput_slot_s.get(badput_reason, 0.0)
                 + req.finished - req.admitted_at)
+        self._export_decode_span(req, "error")
         req.done.set()
         self._requests[slot] = None
         self._done = self._done.at[slot].set(True)
+
+    @staticmethod
+    def _export_decode_span(req: _Request, status: str) -> None:
+        """Export the slot residency (admission → retirement) as a
+        ``serve.engine.decode`` child of the submitter's span — the
+        engine-time leg the fleet collector's critical-path attribution
+        (tpu_dra/obs) needs to tell queueing from decoding.  Unsampled
+        or never-admitted requests cost one None check."""
+        if req.trace_ctx is None or not req.admitted_at:
+            return
+        from tpu_dra.trace.tracer import get_tracer
+        dur = req.finished - req.admitted_at
+        get_tracer().record_span(
+            "serve.engine.decode", req.trace_ctx,
+            start=time.time() - dur, duration=dur,
+            attributes={"tokens": len(req.tokens), "steps": req.steps},
+            status=status)
 
     def _fail_all(self, exc: BaseException) -> None:
         """A dead batcher must never strand a waiter: every in-flight and

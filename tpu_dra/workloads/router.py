@@ -1341,6 +1341,9 @@ def main(argv=None) -> int:
                     disaggregate=args.disaggregate)
     for value in args.replica:
         router.add_replica(parse_replica_flag(value))
+    from tpu_dra.obs import recorder
+    recorder.install_from_args(args, service="tpu-router",
+                               registry=router.metrics.registry)
     srv = serve_router(router, args.host, args.port)
     stop = threading.Event()
 

@@ -800,7 +800,8 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                 # contract as the driver binaries' endpoint; the
                 # exemplar trace ids on /metrics resolve HERE, on the
                 # same process
-                self._send(200, debug_traces_body(self.path))
+                status, body = debug_traces_body(self.path)
+                self._send(status, body)
             elif self.path.split("?", 1)[0] == "/debug/jax-trace":
                 self._jax_trace()
             else:
@@ -1783,6 +1784,11 @@ def main(argv=None):
                     else args.default_deadline_ms / 1e3),
                 drain_grace_s=args.drain_grace,
                 pool_role=args.pool_role)
+    # armed AFTER serve() so the metric-deltas baseline includes the
+    # full registry (ServeMetrics registers at construction)
+    from tpu_dra.obs import recorder
+    recorder.install_from_args(args, service="tpu-serve",
+                               registry=srv.metrics.registry)
     if args.warmup:
         if srv.engine is None:
             ap.error("--warmup needs --continuous")
