@@ -3,7 +3,7 @@
 PYTHON ?= python3
 IMAGE ?= tpu-dra-driver:latest
 
-.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-share drive-fleet drive-obs drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
+.PHONY: all native test test-core bench bench-gate drive drive-trace drive-health drive-chaos drive-preempt drive-serve drive-overload drive-hostile drive-retrace drive-share drive-fleet drive-obs drive-fleetsim drive-fleetsim-alloc image proto check-proto stress racecheck vet clean
 
 all: native
 
@@ -131,6 +131,17 @@ drive-overload:
 # registry against tpu_dra/analysis/taint.py's sink catalog.
 drive-hostile:
 	$(PYTHON) hack/drive_hostile.py
+
+# retrace lane acceptance (docs/static-analysis.md, ISSUE 20): seeds
+# the exact bug the retrace-risk checker exists for — deleting the
+# bucket rounding on the admission key — into a COPY of the tree and
+# proves the lane both ways: the static checker flags the line with
+# its flow to the _loop_inner hot path, AND the runtime retrace guard
+# observes the live per-request recompile storm on a real engine
+# (clean tree: no finding, zero post-warmup recompiles, one
+# out-of-bucket control compile proving the instrument is live)
+drive-retrace:
+	$(PYTHON) hack/drive_retrace.py
 
 # multi-tenant sharing acceptance (docs/sharing.md, ISSUE 17): REAL
 # plugin with --shared-partitions 4 packs four fractional tenants onto

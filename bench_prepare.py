@@ -317,6 +317,96 @@ def bench_flight_recorder_idle(n: int = 200_000, repeats: int = 3) -> dict:
     return {"n": n, "per_line_us": round(best / n * 1e6, 4)}
 
 
+def bench_retrace_guard_idle(n: int = 200_000, repeats: int = 3) -> dict:
+    """ISSUE 20: the retrace guard rides inside ``engine.stats()``,
+    which serve.py's /metrics and /debug/overload hit on every scrape
+    and router probe — when DISABLED (the default) its entire footprint
+    must stay one attribute test per call.  This section ratchets the
+    disabled path (``retrace_guard_idle_us``); enabled-mode cost is a
+    diagnostic choice the operator opted into."""
+    from tpu_dra.workloads.retrace_guard import RetraceGuard
+
+    guard = RetraceGuard(enabled=False)
+    poll = guard.recompiles_since_mark
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            poll()
+        best = min(best, time.perf_counter() - t0)
+    return {"n": n, "per_call_us": round(best / n * 1e6, 4)}
+
+
+def _decode_recompile_probe() -> dict:
+    """Runs IN THE SUBPROCESS bench_engine_decode_recompiles spawns:
+    tiny engine, warmup one prompt bucket, then decode a spread of
+    prompt lengths that all round into that bucket — the steady-state
+    recompile count MUST be zero (every compile after warmup means a
+    shape key escaped its bucket; see analysis/checkers/retrace.py for
+    the static twin).  A final out-of-bucket submit double-checks the
+    instrument itself: it must observe that compile, or a zero above is
+    the guard being blind, not the engine being stable."""
+    import jax
+
+    from tpu_dra.workloads.continuous import ContinuousEngine
+    from tpu_dra.workloads.train import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                      d_ff=64, max_seq=64, pos_emb="rope")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousEngine(cfg, params, slots=2, chunk=2)
+    try:
+        eng.warmup(buckets=[16], burst=1)
+        for n in (3, 5, 9, 12):              # all bucket <= 16
+            eng.submit([1] * n, 2, timeout=600)
+        steady = eng.retrace_guard.recompiles_since_mark()
+        eng.submit([1] * 30, 2, timeout=600)  # bucket 32: fresh compile
+        control = eng.retrace_guard.recompiles_since_mark() - steady
+        stats = eng.retrace_guard.stats()
+    finally:
+        eng.shutdown()
+    return {"recompiles": steady,
+            "control_recompiles": control,
+            "instrument_live": control >= 1,
+            "compile_cache_entries": stats["compile_cache_entries"],
+            "jit_callables_tracked": stats["jit_callables_tracked"]}
+
+
+def bench_engine_decode_recompiles() -> dict:
+    """ISSUE 20 compile-count ratchet: N decode steps after warmup must
+    compile ZERO new programs (``engine_decode_recompiles`` gate).
+    Subprocess-isolated like the kernel sections so the JAX runtime
+    (and its compiles) never leak into this process's idle
+    measurements; CPU backend is forced — the count is a property of
+    the trace cache, not the chip.  Disarms (gate reads 0.0, reason
+    recorded) only if the probe itself fails to run — jax is part of
+    the toolchain image, so an unarmed run on CI is itself a finding
+    a human should read."""
+    import subprocess as sp
+
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", TPU_DRA_RETRACE_GUARD="1")
+    code = ("import bench_prepare, json\n"
+            "print(json.dumps(bench_prepare._decode_recompile_probe()))\n")
+    try:
+        proc = sp.run([sys.executable, "-c", code], capture_output=True,
+                      text=True, timeout=600, cwd=REPO, env=env)
+        lines = [ln for ln in proc.stdout.strip().splitlines()
+                 if ln.strip()]
+        out = json.loads(lines[-1])
+    except Exception as exc:  # noqa: BLE001 — disarm, never flake
+        return {"armed": False, "recompiles": 0.0,
+                "reason": f"probe failed: {repr(exc)[:160]}"}
+    if not out.get("instrument_live"):
+        # the control compile was NOT observed: the guard is blind
+        # (e.g. jit stopped exposing _cache_size) — report a positive
+        # sentinel so the gate fails loudly instead of passing blind
+        out["recompiles"] = 1.0
+        out["reason"] = "control compile not observed: guard is blind"
+    out["armed"] = True
+    return out
+
+
 def bench_kernel_throughput() -> dict:
     """Kernel-throughput ratchet section (ISSUE 10): floors for the
     Pallas kernel family (matmul, flash, the fused collective matmuls),
@@ -564,6 +654,8 @@ def run_all() -> dict:
         "router_decision": bench_router_decision(),
         "obs_ingest": bench_obs_ingest_idle(),
         "flight_recorder": bench_flight_recorder_idle(),
+        "retrace_guard": bench_retrace_guard_idle(),
+        "decode_recompiles": bench_engine_decode_recompiles(),
         "kernels": bench_kernel_throughput(),
         "direct": bench_direct(base),
         "concurrent": bench_concurrent(base),
@@ -614,6 +706,10 @@ def _gates(report: dict) -> dict[str, float]:
             report["obs_ingest"]["per_span_us"],
         "flight_recorder_idle_us":
             report["flight_recorder"]["per_line_us"],
+        "retrace_guard_idle_us":
+            report["retrace_guard"]["per_call_us"],
+        "engine_decode_recompiles":
+            float(report["decode_recompiles"]["recompiles"]),
     }
 
 
@@ -729,7 +825,11 @@ def write_budget(report: dict, path: str, headroom: float = 1.6) -> None:
             # microsecond-scale microbench gates get a 2us floor — they
             # exist to catch a lock/allocation landing on the idle path
             # (a >=5us cliff), not 0.2us of scheduler weather
-            name: (min(round(max(value, 0.02) * headroom, 3), 1.0)
+            # engine_decode_recompiles is NOT a latency: it is a count
+            # with a correct value, zero — no headroom, ever (one
+            # steady-state recompile is a retrace bug, not jitter)
+            name: (0.0 if name == "engine_decode_recompiles"
+                   else min(round(max(value, 0.02) * headroom, 3), 1.0)
                    if name == "flushes_per_mutation"
                    else round(max(value * headroom, 2.0), 3)
                    if name.endswith("_us")

@@ -1,0 +1,179 @@
+"""Retrace drive: proves the retrace lane catches a real recompile bug
+BOTH WAYS — statically and at runtime — by seeding one
+(``make drive-retrace``, docs/static-analysis.md).
+
+The seeded bug is the exact mistake the ``retrace-risk`` checker
+exists for: deleting the ``self._bucket(...)`` rounding around the
+admission-coalescing dict key in ``ContinuousEngine._admit``
+(continuous.py), so every distinct prompt length becomes its own
+shape key and every admission compiles a fresh prefill program on the
+serving path.  The drive never mutates the working tree — the bug is
+applied to a COPY under a tmpdir.
+
+Four legs, all required:
+
+1. static/clean:  ``python -m tpu_dra.analysis --checks retrace-risk``
+   over the real tree exits 0 with no findings;
+2. static/buggy:  the same checker over the mutated copy exits 1 and
+   prints the FLOW — ``len(req.prompt)`` -> shape-key parameter ``Sb``
+   of ``_admit_plain`` -> the ``_loop_inner`` hot path;
+3. runtime/clean: a tiny engine (retrace guard armed) warms one
+   bucket, decodes a spread of same-bucket prompt lengths, and
+   observes ZERO post-warmup recompiles — plus one out-of-bucket
+   control submit the guard MUST see, proving the instrument is live;
+4. runtime/buggy: the same traffic against the mutated copy observes
+   one live recompile PER DISTINCT LENGTH (>= 3 here) — the compile
+   storm the static finding predicted, measured on the real engine.
+
+A lane that only proved leg 2 would trust the analyzer's model; a lane
+that only proved leg 4 would trust the guard's discovery.  Together
+they pin the static model to runtime reality: the checker names the
+line, the guard counts the cost.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the bucket-rounding guard the seeded bug deletes (must match the
+# working tree exactly once, or the tree drifted and the drive is
+# seeding a different bug than it claims)
+GUARD_SRC = "self._bucket(len(req.prompt)), []).append"
+GUARD_BUG = "len(req.prompt), []).append"
+TARGET = "tpu_dra/workloads/continuous.py"
+
+# runtime probe, run via ``python -c`` so the cwd decides which tree
+# ``import tpu_dra`` resolves (REPO = clean, tmpdir = buggy): warm one
+# prompt bucket, decode a same-bucket spread, then one out-of-bucket
+# control the guard must observe
+PROBE = """
+import json, jax
+from tpu_dra.workloads.continuous import ContinuousEngine
+from tpu_dra.workloads.train import ModelConfig, init_params
+
+cfg = ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=2,
+                  d_ff=64, max_seq=64, pos_emb="rope")
+params = init_params(cfg, jax.random.PRNGKey(0))
+eng = ContinuousEngine(cfg, params, slots=2, chunk=2)
+try:
+    eng.warmup(buckets=[16], burst=1)
+    for n in (3, 5, 9, 12):                  # all round into bucket 16
+        eng.submit([1] * n, 2, timeout=600)
+    steady = eng.retrace_guard.recompiles_since_mark()
+    eng.submit([1] * 30, 2, timeout=600)     # bucket 32: control compile
+    control = eng.retrace_guard.recompiles_since_mark() - steady
+finally:
+    eng.shutdown()
+print("RETRACE_PROBE " + json.dumps(
+    {"steady_recompiles": steady, "control_recompiles": control}))
+"""
+
+
+def log(msg: str) -> None:
+    print(f"[drive-retrace] {msg}", flush=True)
+
+
+def die(msg: str) -> None:
+    print(f"[drive-retrace] FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+def run_vet(tree: str) -> tuple[int, dict]:
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.analysis",
+         "--checks", "retrace-risk", "--format", "json",
+         os.path.join(tree, "tpu_dra")],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    try:
+        out = json.loads(proc.stdout)
+    except ValueError:
+        die(f"vet did not emit JSON:\n{proc.stdout}\n{proc.stderr}")
+    return proc.returncode, out
+
+
+def run_probe(tree: str) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPU_DRA_RETRACE_GUARD="1")
+    proc = subprocess.run([sys.executable, "-c", PROBE],
+                          capture_output=True, text=True, timeout=900,
+                          cwd=tree, env=env)
+    if proc.returncode != 0:
+        die(f"runtime probe crashed in {tree}:\n{proc.stderr[-2000:]}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("RETRACE_PROBE "):
+            return json.loads(line.split(" ", 1)[1])
+    die(f"runtime probe printed no result:\n{proc.stdout[-2000:]}")
+    raise AssertionError  # unreachable
+
+
+def main() -> None:
+    # -- leg 1: static, clean tree ------------------------------------
+    code, out = run_vet(REPO)
+    if code != 0 or out["count"] != 0:
+        die(f"clean tree has retrace-risk findings (exit {code}): "
+            f"{json.dumps(out['diagnostics'], indent=2)}")
+    log("leg 1/4 ok: clean tree, retrace-risk exits 0 with no findings")
+
+    # -- seed the bug into a copy -------------------------------------
+    tmp = tempfile.mkdtemp(prefix="tpu-dra-drive-retrace-")
+    try:
+        shutil.copytree(os.path.join(REPO, "tpu_dra"),
+                        os.path.join(tmp, "tpu_dra"))
+        target = os.path.join(tmp, TARGET)
+        with open(target, encoding="utf-8") as fh:
+            src = fh.read()
+        if src.count(GUARD_SRC) != 1:
+            die(f"expected exactly one bucket guard at the seed site in "
+                f"{TARGET} (found {src.count(GUARD_SRC)}) — the tree "
+                f"drifted; update GUARD_SRC")
+        with open(target, "w", encoding="utf-8") as fh:
+            fh.write(src.replace(GUARD_SRC, GUARD_BUG, 1))
+        log(f"seeded bug: dropped self._bucket(...) from the admission "
+            f"key in {TARGET} (copy under {tmp})")
+
+        # -- leg 2: static, buggy copy --------------------------------
+        code, out = run_vet(tmp)
+        if code != 1 or out["count"] < 1:
+            die(f"retrace-risk MISSED the seeded bug (exit {code}, "
+                f"{out['count']} findings)")
+        diag = out["diagnostics"][0]
+        msg, flow = diag["message"], diag.get("flow") or []
+        if "unbucketed shape key" not in msg or "_loop_inner" not in msg:
+            die(f"finding does not name the bug/hot loop: {msg}")
+        if not any("_admit_plain" in step["message"] for step in flow):
+            die(f"finding carries no flow through _admit_plain: {flow}")
+        log(f"leg 2/4 ok: retrace-risk flags {diag['path']}:"
+            f"{diag['line']} with a {len(flow)}-step flow to the "
+            f"_loop_inner hot path")
+
+        # -- leg 3: runtime, clean tree -------------------------------
+        res = run_probe(REPO)
+        if res["control_recompiles"] < 1:
+            die(f"guard did not observe the control compile — the "
+                f"instrument is blind: {res}")
+        if res["steady_recompiles"] != 0:
+            die(f"clean engine recompiled post-warmup: {res}")
+        log(f"leg 3/4 ok: clean engine, 0 post-warmup recompiles "
+            f"(control compile observed: {res['control_recompiles']})")
+
+        # -- leg 4: runtime, buggy copy -------------------------------
+        res = run_probe(tmp)
+        if res["steady_recompiles"] < 3:
+            die(f"buggy engine should recompile per distinct length "
+                f"(>=3), guard saw: {res}")
+        log(f"leg 4/4 ok: seeded bug recompiles live — "
+            f"{res['steady_recompiles']} post-warmup compiles for 4 "
+            f"same-bucket lengths")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    log("PASS: static finding and runtime recompiles agree, both ways")
+
+
+if __name__ == "__main__":
+    main()

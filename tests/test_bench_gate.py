@@ -18,7 +18,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
             grpc_p50=5.0, grpc_floor=1.0, flushes=0.9, cpu=0.03,
             observe_us=0.8, admission_us=4.0, alloc_us=15.0,
-            router_us=2.0, tenancy_us=90.0, obs_us=3.0, fr_us=0.1):
+            router_us=2.0, tenancy_us=90.0, obs_us=3.0, fr_us=0.1,
+            rg_us=0.1, recompiles=0):
     return {
         "schema": "bench_prepare/v1",
         "fs": {"floor_per_prepare_ms": grpc_floor},
@@ -30,6 +31,10 @@ def _report(direct_warm_oh=0.5, direct_idle_oh=0.3, grpc_oh=2.0,
         "router_decision": {"n": 50000, "per_decision_us": router_us},
         "obs_ingest": {"n": 20000, "per_span_us": obs_us},
         "flight_recorder": {"n": 200000, "per_line_us": fr_us},
+        "retrace_guard": {"n": 200000, "per_call_us": rg_us},
+        "decode_recompiles": {"armed": True, "recompiles": recompiles,
+                              "control_recompiles": 1,
+                              "instrument_live": True},
         "direct": {
             "warm": {"p50_ms": grpc_floor + direct_warm_oh,
                      "overhead_p50_ms": direct_warm_oh},
@@ -58,6 +63,8 @@ def _budget(**overrides):
             "router_decision_us": 10.0,
             "obs_ingest_idle_us": 8.0,
             "flight_recorder_idle_us": 2.0,
+            "retrace_guard_idle_us": 2.0,
+            "engine_decode_recompiles": 0.0,
         },
         "absolute": {"grpc_warm_p50_ms": 1.2,
                      "fs_floor_ceiling_ms": 0.4,
@@ -177,6 +184,38 @@ def test_obs_ingest_and_flight_recorder_gates():
     assert any("flight_recorder_idle_us" in v for v in violations)
     assert bench_prepare.gate(_report(obs_us=3.0, fr_us=0.1),
                               _budget()) == []
+
+
+def test_retrace_guard_idle_gate():
+    """ISSUE 20: the disabled retrace guard rides inside engine.stats()
+    (every /metrics scrape, every router probe) — a discovery scan or
+    allocation landing on the disabled path (a >=5µs cliff) must fail
+    the ratchet."""
+    violations = bench_prepare.gate(_report(rg_us=6.0), _budget())
+    assert any("retrace_guard_idle_us" in v for v in violations)
+    assert bench_prepare.gate(_report(rg_us=0.1), _budget()) == []
+
+
+def test_engine_decode_recompiles_gate():
+    """ISSUE 20: the compile-count ratchet has a correct value — zero.
+    ONE steady-state recompile means a shape key escaped its bucket
+    (the seeded drive-retrace bug); there is no jitter headroom to
+    hide behind."""
+    violations = bench_prepare.gate(_report(recompiles=1), _budget())
+    assert any("engine_decode_recompiles" in v for v in violations)
+    assert bench_prepare.gate(_report(recompiles=0), _budget()) == []
+
+
+def test_write_budget_pins_recompiles_to_zero(tmp_path):
+    """A re-baseline run must never learn to tolerate recompiles: even
+    if the baselining host observed some, the written budget pins the
+    gate at 0.0 (a count with a correct value, unlike the latency
+    maxima which take jitter headroom)."""
+    report = _report(recompiles=2)
+    path = tmp_path / "budget.json"
+    bench_prepare.write_budget(report, str(path))
+    budget = json.loads(path.read_text())
+    assert budget["gates"]["engine_decode_recompiles"] == 0.0
 
 
 def test_write_budget_round_trips_and_caps_ratios(tmp_path):

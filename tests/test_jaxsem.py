@@ -1,0 +1,530 @@
+"""Traced-region analysis (ISSUE 20): the jaxsem model and its four
+flow-aware checkers — retrace-risk, host-sync-hot-path, jit-donation,
+pytree-stability.
+
+Same three-layer pattern as test_vet.py: a seeded true positive and a
+clean negative per rule, the interprocedural proof that a wrapper file
+cannot hide a host sync from a hot loop, and the SARIF surface for the
+new rule ids.  The runtime twin (the retrace guard) is covered in
+tests/test_retrace_guard.py; the seeded-bug end-to-end proof is
+``make drive-retrace``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpu_dra.analysis import all_analyzers, run_paths
+from tpu_dra.analysis.report import render_sarif
+
+# DRA-core fast lane: pure AST analysis, no JAX workload compiles
+pytestmark = pytest.mark.core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def vet_tree(tmp_path, files: dict[str, str],
+             checks: list[str] | None = None):
+    """Write a fixture tree (relpaths carry the scope, e.g.
+    ``tpu_dra/workloads/eng.py``) and run the analyzers over ALL of it
+    — the whole-program pass sees every file at once."""
+    paths = []
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(source)
+        paths.append(str(path))
+    return run_paths(paths, checks=checks)
+
+
+def vet_one(tmp_path, relpath: str, source: str,
+            checks: list[str] | None = None):
+    return vet_tree(tmp_path, {relpath: source}, checks)
+
+
+# -------------------------------------------------------------------------
+# retrace-risk: branch-on-traced / data-dependent shapes (entry rules)
+# -------------------------------------------------------------------------
+
+_BRANCH = """import jax
+
+@jax.jit
+def bad(x):
+    if x > 0:
+        return x
+    return -x
+"""
+
+_BRANCH_OK = """import jax
+
+@jax.jit
+def ok(x, mask=None):
+    if x.shape[0] > 2:
+        return x
+    if mask is None:
+        return x * 2
+    if len(x.shape) == 1:
+        return x + 1
+    return x
+"""
+
+
+def test_retrace_flags_branch_on_traced_param(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/r1.py", _BRANCH,
+                    checks=["retrace-risk"])
+    assert len(diags) == 1
+    assert "branches on traced parameter 'x'" in diags[0].message
+
+
+def test_retrace_accepts_static_properties_under_trace(tmp_path):
+    """.shape/.ndim/len()/`is None` are Python-level constants during
+    tracing — branching on them is the sanctioned idiom."""
+    assert vet_one(tmp_path, "tpu_dra/workloads/r1ok.py", _BRANCH_OK,
+                   checks=["retrace-risk"]) == []
+
+
+_SHAPE = """import jax
+import jax.numpy as jnp
+
+@jax.jit
+def bad(n):
+    return jnp.arange(n)
+
+@jax.jit
+def ok(x):
+    return jnp.arange(x.shape[0])
+"""
+
+
+def test_retrace_flags_data_dependent_shape(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/r2.py", _SHAPE,
+                    checks=["retrace-risk"])
+    assert len(diags) == 1
+    assert "takes its shape from traced parameter 'n'" in diags[0].message
+
+
+def test_retrace_respects_static_argnums(tmp_path):
+    """A parameter pinned static is a Python value — branching on it is
+    legal (each value compiles once, deliberately)."""
+    src = ("import jax\n"
+           "from functools import partial\n\n"
+           "@partial(jax.jit, static_argnums=(1,))\n"
+           "def f(x, mode):\n"
+           "    if mode > 1:\n"
+           "        return x\n"
+           "    return -x\n")
+    assert vet_one(tmp_path, "tpu_dra/workloads/r2s.py", src,
+                   checks=["retrace-risk"]) == []
+
+
+# -------------------------------------------------------------------------
+# retrace-risk: binding-call rules (static args, literal drift)
+# -------------------------------------------------------------------------
+
+_STATICS = """import jax
+
+def _impl(x, k):
+    return x * k
+
+_fn = jax.jit(_impl, static_argnums=(1,))
+
+def call_list(x):
+    return _fn(x, [1, 2])
+
+def call_fresh(x):
+    return _fn(x, tuple(x))
+
+def call_ok(x):
+    return _fn(x, 3)
+"""
+
+
+def test_retrace_flags_unhashable_and_fresh_static_args(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/r3.py", _STATICS,
+                    checks=["retrace-risk"])
+    msgs = sorted(d.message for d in diags)
+    assert len(diags) == 2, msgs
+    assert any("unhashable list literal" in m for m in msgs)
+    assert any("never compares equal" in m for m in msgs)
+
+
+_DRIFT = """import jax
+
+_g = jax.jit(lambda x, s: x * s)
+
+def a(x):
+    return _g(x, 2)
+
+def b(x):
+    return _g(x, 2.0)
+"""
+
+
+def test_retrace_flags_int_float_literal_drift(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/r4.py", _DRIFT,
+                    checks=["retrace-risk"])
+    assert len(diags) == 1
+    assert "weak-type promotion keys two compiled programs" in \
+        diags[0].message
+    # the flow cites BOTH call sites
+    assert len(diags[0].flow) == 2
+
+
+def test_retrace_consistent_literals_are_clean(tmp_path):
+    src = _DRIFT.replace("2.0", "4")
+    assert vet_one(tmp_path, "tpu_dra/workloads/r4ok.py", src,
+                   checks=["retrace-risk"]) == []
+
+
+# -------------------------------------------------------------------------
+# retrace-risk: the hot-path shape-key rule (the drive-retrace bug)
+# -------------------------------------------------------------------------
+
+_HOT_COMMON = """import jax
+
+_BUCKETS = (8, 16)
+
+def _round(n: int) -> int:  # vet: shape-bucket
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return _BUCKETS[-1]
+
+def _prefill_fn(Sb: int):
+    return jax.jit(lambda p: p * Sb)
+"""
+
+_HOT_BAD = _HOT_COMMON + """
+class Eng:
+    def loop(self):  # vet: hot-loop -- fixture decode loop
+        return self.step(len(self.prompt))
+
+    def step(self, n):
+        return _prefill_fn(n)
+"""
+
+_HOT_OK = _HOT_COMMON + """
+class Eng:
+    def loop(self):  # vet: hot-loop -- fixture decode loop
+        return self.step(_round(len(self.prompt)))
+
+    def step(self, n):
+        return _prefill_fn(n)
+"""
+
+_HOT_DICT = _HOT_COMMON + """
+class Eng:
+    def loop(self):  # vet: hot-loop -- fixture decode loop
+        groups = {}
+        for req in self.pending:
+            groups.setdefault(len(req.prompt), []).append(req)
+        for Sb, group in groups.items():
+            self.step(Sb, group)
+
+    def step(self, n, group):
+        return _prefill_fn(n)
+"""
+
+
+def test_retrace_flags_unbucketed_shape_key_on_hot_path(tmp_path):
+    """A per-request len() flowing through a helper's shape-key param
+    into a jit factory — flagged AT THE HOT LOOP'S CALL with the flow."""
+    diags = vet_one(tmp_path, "tpu_dra/workloads/hot.py", _HOT_BAD,
+                    checks=["retrace-risk"])
+    assert len(diags) == 1
+    d = diags[0]
+    assert "unbucketed shape key" in d.message
+    assert "len(self.prompt)" in d.message
+    assert "hot path from Eng.loop" in d.message
+    assert len(d.flow) == 2
+
+
+def test_retrace_bucket_rounding_sanctions_the_shape_key(tmp_path):
+    """The same flow through a `# vet: shape-bucket` function is the
+    engine's sanctioned idiom — clean."""
+    assert vet_one(tmp_path, "tpu_dra/workloads/hotok.py", _HOT_OK,
+                   checks=["retrace-risk"]) == []
+
+
+def test_retrace_tracks_provenance_through_dict_coalescing(tmp_path):
+    """The admission idiom: values keyed into a dict carry provenance
+    to ``for Sb, group in d.items()`` loop targets — the exact shape of
+    the drive-retrace seeded bug."""
+    diags = vet_one(tmp_path, "tpu_dra/workloads/hotd.py", _HOT_DICT,
+                    checks=["retrace-risk"])
+    assert len(diags) == 1
+    assert "unbucketed shape key" in diags[0].message
+
+
+# -------------------------------------------------------------------------
+# host-sync-hot-path
+# -------------------------------------------------------------------------
+
+_SYNC_BAD = """import jax
+import numpy as np
+
+_fused = jax.jit(lambda x: x * 2)
+
+class Eng:
+    def loop(self, xs):  # vet: hot-loop -- fixture decode loop
+        out = []
+        for x in xs:
+            y = _fused(x)
+            out.append(np.asarray(y))
+        return out
+"""
+
+_SYNC_OK = """import jax
+import numpy as np
+
+_fused = jax.jit(lambda x: x * 2)
+
+class Eng:
+    def loop(self, xs):  # vet: hot-loop -- fixture decode loop
+        out = []
+        for x in xs:
+            y = list(x)
+            out.append(np.asarray(y))
+        return out
+
+    def retire(self, y):
+        return float(y)
+"""
+
+
+def test_hostsync_flags_device_readback_in_hot_loop(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/hs.py", _SYNC_BAD,
+                    checks=["host-sync-hot-path"])
+    assert len(diags) == 1
+    assert "np.asarray" in diags[0].message
+    assert "hot loop Eng.loop" in diags[0].message
+
+
+def test_hostsync_is_flow_aware_about_operands(tmp_path):
+    """np.asarray over a HOST value (list(x)) is a copy, not a sync —
+    and syncs outside any declared hot loop never fire."""
+    assert vet_one(tmp_path, "tpu_dra/workloads/hsok.py", _SYNC_OK,
+                   checks=["host-sync-hot-path"]) == []
+
+
+_WRAPPER = """import jax
+import numpy as np
+
+_fused = jax.jit(lambda x: x * 2)
+
+def pull(x):
+    y = _fused(x)
+    return np.asarray(y)
+"""
+
+_CALLER = """from tpu_dra.workloads.helper import pull
+
+
+class Eng:
+    def loop(self, xs):  # vet: hot-loop -- fixture decode loop
+        return [pull(x) for x in xs]
+"""
+
+
+def test_hostsync_interprocedural_wrapper_cannot_hide_the_sync(tmp_path):
+    """The two-file proof: the caller file ALONE is clean (the wrapper
+    is invisible), but the whole program flags the call site with a
+    flow citing the sync's origin in the other file."""
+    caller_only = vet_tree(
+        tmp_path / "solo", {"tpu_dra/workloads/eng.py": _CALLER},
+        checks=["host-sync-hot-path"])
+    assert caller_only == []
+
+    diags = vet_tree(
+        tmp_path / "both",
+        {"tpu_dra/workloads/helper.py": _WRAPPER,
+         "tpu_dra/workloads/eng.py": _CALLER},
+        checks=["host-sync-hot-path"])
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.path.endswith("eng.py"), d
+    assert "call to pull() inside hot loop Eng.loop" in d.message
+    assert "np.asarray" in d.message
+    # the flow's second step lands at the origin in helper.py
+    assert d.flow[1][0].endswith("helper.py")
+    assert "sync origin" in d.flow[1][2]
+
+
+def test_hostsync_origin_suppression_covers_all_callers(tmp_path):
+    """A justified ignore at the sync ORIGIN silences the hot-loop call
+    sites too — one deliberate readback, one ignore."""
+    wrapper = _WRAPPER.replace(
+        "return np.asarray(y)",
+        "return np.asarray(y)  # vet: ignore[host-sync-hot-path]")
+    diags = vet_tree(
+        tmp_path,
+        {"tpu_dra/workloads/helper.py": wrapper,
+         "tpu_dra/workloads/eng.py": _CALLER},
+        checks=["host-sync-hot-path"])
+    assert diags == []
+
+
+# -------------------------------------------------------------------------
+# jit-donation
+# -------------------------------------------------------------------------
+
+_DONATE = """import jax
+
+def _step(c, x):
+    return c + x, x
+
+step = jax.jit(_step, donate_argnums=(0,))
+step2 = jax.jit(_step, donate_argnums=(0, 1))
+
+def ok(c, x):
+    c, y = step(c, x)
+    return c, y
+
+def bad_reuse(c, x):
+    y = step(c, x)
+    return y, c.sum()
+
+def bad_double(c):
+    return step2(c, c)
+"""
+
+
+def test_donation_reuse_after_donation(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/d1.py", _DONATE,
+                    checks=["jit-donation"])
+    msgs = [d.message for d in diags]
+    assert any("bad_reuse" in m or "c" in m and "donated" in m
+               for m in msgs), msgs
+    assert any("both" in m or "twice" in m or "positions" in m
+               for m in msgs), msgs
+    assert len(diags) == 2, msgs  # ok() self-feed is clean
+
+
+def test_donation_drift_and_static_overlap(tmp_path):
+    src = ("import jax\n\n"
+           "def _step(c, x):\n"
+           "    return c\n\n"
+           "wide = jax.jit(_step, donate_argnums=(2,))\n"
+           "conflict = jax.jit(_step, donate_argnums=(0,),\n"
+           "                   static_argnums=(0,))\n\n"
+           "def call(c, x):\n"
+           "    return wide(c, x)\n")
+    diags = vet_one(tmp_path, "tpu_dra/workloads/d2.py", src,
+                    checks=["jit-donation"])
+    msgs = [d.message for d in diags]
+    assert any("donate" in m and "static" in m for m in msgs), msgs
+    assert any("2" in m for m in msgs), msgs  # the drifted position
+
+
+# -------------------------------------------------------------------------
+# pytree-stability
+# -------------------------------------------------------------------------
+
+_PYTREE = """import jax
+
+@jax.jit
+def bad(x):
+    if x.ndim > 1:
+        return {"a": x, "b": x}
+    return {"a": x}
+
+@jax.jit
+def ok(x):
+    if x.ndim > 1:
+        return {"a": x, "b": x}
+    return {"a": x, "b": None}
+
+@jax.jit
+def bad_insert(x):
+    out = {"a": x}
+    if x.ndim > 1:
+        out["b"] = x
+    return out
+"""
+
+
+def test_pytree_stability_rules(tmp_path):
+    diags = vet_one(tmp_path, "tpu_dra/workloads/pt.py", _PYTREE,
+                    checks=["pytree-stability"])
+    msgs = sorted(d.message for d in diags)
+    assert len(diags) == 2, msgs
+    assert any("different key sets" in m and "b" in m for m in msgs)
+    assert any("conditionally inserts key 'b'" in m for m in msgs)
+
+
+# -------------------------------------------------------------------------
+# jit-purity rides the model (the rebase): traced closure, not regex
+# -------------------------------------------------------------------------
+
+def test_jitpurity_reaches_helpers_through_the_traced_closure(tmp_path):
+    """print() in a helper REACHED FROM a jit entry fires, citing the
+    entry — the model's transitive closure, not decorator matching."""
+    src = ("import jax\n\n"
+           "def _helper(x):\n"
+           "    print(x)\n"
+           "    return x\n\n"
+           "@jax.jit\n"
+           "def entry(x):\n"
+           "    return _helper(x) * 2\n")
+    diags = vet_one(tmp_path, "tpu_dra/workloads/jp.py", src,
+                    checks=["jit-purity"])
+    assert len(diags) == 1
+    assert "reached from" in diags[0].message
+
+
+# -------------------------------------------------------------------------
+# SARIF surface for the new rules
+# -------------------------------------------------------------------------
+
+def test_sarif_carries_new_rules_and_code_flows(tmp_path):
+    diags = vet_tree(
+        tmp_path,
+        {"tpu_dra/workloads/helper.py": _WRAPPER,
+         "tpu_dra/workloads/eng.py": _CALLER,
+         "tpu_dra/workloads/hot.py": _HOT_BAD},
+        checks=["host-sync-hot-path", "retrace-risk"])
+    assert len(diags) == 2
+    sarif = json.loads(render_sarif(diags, all_analyzers()))
+    run = sarif["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"retrace-risk", "host-sync-hot-path", "jit-donation",
+            "pytree-stability"} <= rule_ids
+    # every flow-carrying diagnostic renders a SARIF codeFlow whose
+    # thread locations mirror the flow steps
+    for res in run["results"]:
+        flows = res.get("codeFlows")
+        assert flows, res["ruleId"]
+        locs = flows[0]["threadFlows"][0]["locations"]
+        assert len(locs) == 2
+
+
+# -------------------------------------------------------------------------
+# registry + in-tree wiring
+# -------------------------------------------------------------------------
+
+def test_registry_has_the_traced_region_checkers():
+    names = {a.name for a in all_analyzers()}
+    assert {"retrace-risk", "host-sync-hot-path", "jit-donation",
+            "pytree-stability"} <= names
+
+
+def test_hot_loop_registry_names_live_functions():
+    """Every seeded HOT_LOOPS suffix must still resolve to a real
+    function — a rename would otherwise silently shrink the checked
+    surface."""
+    from tpu_dra.analysis import jaxsem
+    from tpu_dra.analysis.callgraph import toplevel_functions
+    import ast
+    for suffix, why in jaxsem.HOT_LOOPS:
+        relpath, funcname = suffix.split("::", 1)
+        # HOT_LOOPS entries are qual SUFFIXES; in this repo they all
+        # live under tpu_dra/
+        path = os.path.join(REPO_ROOT, "tpu_dra", relpath)
+        assert os.path.exists(path), suffix
+        tree = ast.parse(open(path, encoding="utf-8").read())
+        names = {(f"{cls}.{fn.name}" if cls else fn.name)
+                 for fn, cls in toplevel_functions(tree)}
+        assert funcname in names, (suffix, why)

@@ -1,0 +1,127 @@
+"""Retrace guard (ISSUE 20): the runtime recompile ratchet — exact
+compile counts across jit cache hits and misses, discovery of lazily
+compiled programs, and the disabled guard's no-op contract (its idle
+cost is ratcheted separately by bench_prepare's ``retrace_guard_idle_us``
+gate; the seeded-bug end-to-end proof is ``make drive-retrace``)."""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.retrace_guard import (
+    ENV_FLAG,
+    RetraceGuard,
+    cache_size_of,
+)
+
+
+def test_cache_size_of_rejects_non_jitted_callables():
+    assert cache_size_of(lambda x: x) is None
+    assert cache_size_of(3) is None
+    assert cache_size_of(None) is None
+    assert cache_size_of(jax.jit(lambda x: x)) == 0
+
+
+def test_disabled_guard_is_inert():
+    g = RetraceGuard(enabled=False)
+    g.attach("eng", object())
+    g.watch("f", jax.jit(lambda x: x))
+    g.mark()
+    assert g.counts() == {}
+    assert g.recompiles_since_mark() == 0
+    assert g.total_entries() == 0
+    assert g.tracked() == 0
+    assert g.stats() == {}
+
+
+def test_env_flag_controls_default(monkeypatch):
+    monkeypatch.setenv(ENV_FLAG, "1")
+    assert RetraceGuard().enabled
+    monkeypatch.setenv(ENV_FLAG, "false")
+    assert not RetraceGuard().enabled
+    monkeypatch.setenv(ENV_FLAG, "0")
+    assert not RetraceGuard().enabled
+    monkeypatch.delenv(ENV_FLAG)
+    assert not RetraceGuard().enabled
+    # explicit flag beats the environment
+    monkeypatch.setenv(ENV_FLAG, "1")
+    assert not RetraceGuard(enabled=False).enabled
+
+
+def test_exact_counts_across_cache_hits_and_misses():
+    """The whole point: deltas count COMPILES, not calls — a cache hit
+    moves nothing, a new shape/dtype moves the counter by exactly 1."""
+    f = jax.jit(lambda x: x + 1)
+    g = RetraceGuard(enabled=True)
+    g.watch("f", f)
+    g.mark()
+    assert g.recompiles_since_mark() == 0
+
+    f(jnp.zeros((2,)))                      # miss: first compile
+    assert g.recompiles_since_mark() == 1
+    f(jnp.ones((2,)))                       # hit: same shape+dtype
+    f(jnp.zeros((2,)))                      # hit
+    assert g.recompiles_since_mark() == 1
+    f(jnp.zeros((3,)))                      # miss: new shape
+    assert g.recompiles_since_mark() == 2
+    f(jnp.zeros((3,), jnp.int32))           # miss: new dtype
+    assert g.recompiles_since_mark() == 3
+
+    g.mark()                                # re-baseline
+    assert g.recompiles_since_mark() == 0
+    f(jnp.zeros((3,)))                      # hit against the warm cache
+    assert g.recompiles_since_mark() == 0
+
+
+def test_compiles_before_mark_are_not_findings():
+    """Warmup compiles precede the mark — the counter starts at the
+    marked baseline, and an unmarked guard reports zero."""
+    f = jax.jit(lambda x: x * 2)
+    g = RetraceGuard(enabled=True)
+    g.watch("f", f)
+    f(jnp.zeros((4,)))
+    assert g.recompiles_since_mark() == 0   # no mark yet
+    g.mark()
+    assert g.recompiles_since_mark() == 0
+    assert g.total_entries() == 1
+
+
+def test_attach_discovers_attrs_and_lazy_dict_values():
+    """The engine idiom: jitted callables live as instance attributes
+    AND as values of lazily-populated dicts — a program that first
+    compiles after the mark counts fully."""
+    class Holder:
+        pass
+
+    h = Holder()
+    h.step = jax.jit(lambda x: x * 2)
+    h.fns = {}
+    g = RetraceGuard(enabled=True)
+    g.attach("eng", h)
+    h.step(jnp.ones((2,)))
+    g.mark()
+    assert g.recompiles_since_mark() == 0
+
+    h.fns[16] = jax.jit(lambda x: x - 1)    # lazy factory product
+    h.fns[16](jnp.ones((2,)))
+    assert g.recompiles_since_mark() == 1
+    labels = set(g.counts())
+    assert "eng.step" in labels
+    assert "eng.fns[16]" in labels
+
+    stats = g.stats()
+    assert stats["recompiles_since_mark"] == 1
+    assert stats["compile_cache_entries"] == 2
+    assert stats["jit_callables_tracked"] == 2
+
+
+def test_non_jit_attrs_and_dict_values_are_ignored():
+    class Holder:
+        pass
+
+    h = Holder()
+    h.name = "engine"
+    h.counters = {"completed": 3}
+    h.step = jax.jit(lambda x: x)
+    g = RetraceGuard(enabled=True)
+    g.attach("eng", h)
+    assert set(g.counts()) == {"eng.step"}

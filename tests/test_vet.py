@@ -546,7 +546,7 @@ def host_side(x):
 """
 
 
-def test_jit_purity_flags_host_syncs_and_donation_reuse(tmp_path):
+def test_jit_purity_flags_host_syncs(tmp_path):
     diags = vet_snippet(tmp_path, "tpu_dra/workloads/jp.py", _JIT_BAD,
                         checks=["jit-purity"])
     msgs = "\n".join(d.message for d in diags)
@@ -555,8 +555,16 @@ def test_jit_purity_flags_host_syncs_and_donation_reuse(tmp_path):
     assert ".item()" in msgs
     assert "jax.device_get()" in msgs
     assert "Pallas kernel add_kernel" in msgs
-    assert "donated" in msgs
-    assert len(diags) == 6
+    assert len(diags) == 5
+
+
+def test_donation_reuse_moved_to_jit_donation(tmp_path):
+    """ISSUE 20: the donation half of jit-purity now lives in the
+    jit-donation checker over the project-wide binding table."""
+    diags = vet_snippet(tmp_path, "tpu_dra/workloads/jp.py", _JIT_BAD,
+                        checks=["jit-donation"])
+    assert len(diags) == 1
+    assert "donated" in diags[0].message
 
 
 def test_jit_purity_clean_code_and_host_code_pass(tmp_path):

@@ -26,7 +26,7 @@ __all__ = ["FactsCache"]
 # bump whenever the facts record SHAPE changes (new extraction fields,
 # different call-tuple arity, …): entries from other versions are
 # ignored wholesale, so a stale cache can never feed a newer extractor
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 def _extractor_fingerprint() -> str:

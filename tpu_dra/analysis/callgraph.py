@@ -168,9 +168,11 @@ class Program:
         self.facts: dict[str, dict] = {}    # path -> facts record
         self._summaries = None
         self._contracts = None
+        self._jaxsem = None
         self._mod_index: dict[str, list[str]] = {}
         from tpu_dra.analysis import contracts as _contracts
         from tpu_dra.analysis import effects as _effects
+        from tpu_dra.analysis import jaxsem as _jaxsem
         for path, ctx in ctxs.items():
             cached = cache.get(path) if cache is not None else None
             if cached is not None:
@@ -180,6 +182,7 @@ class Program:
                     "symbols": extract_symbols(ctx.tree, path),
                     "functions": extract_functions(ctx),
                     "contracts": _contracts.extract_file(ctx),
+                    "jax": _jaxsem.extract_file(ctx),
                 }
                 _effects.extract_direct(ctx, rec)
                 if cache is not None:
@@ -322,3 +325,12 @@ class Program:
             from tpu_dra.analysis import contracts
             self._contracts = contracts.Registry(self)
         return self._contracts
+
+    def jaxsem(self):
+        """The traced-region model (:class:`tpu_dra.analysis.jaxsem
+        .JaxModel`): jit entry points, the traced closure, host-sync
+        summaries, and the hot-loop registry."""
+        if self._jaxsem is None:
+            from tpu_dra.analysis import jaxsem
+            self._jaxsem = jaxsem.JaxModel(self)
+        return self._jaxsem

@@ -11,14 +11,17 @@ from tpu_dra.analysis.checkers import (  # noqa: F401
     constants,
     contractdrift,
     deadlinehygiene,
+    donation,
     excepts,
     guardedby,
+    hostsync,
     hotpath,
     jitpurity,
     lifecycle,
     lockorder,
     metrichygiene,
     reconcile,
+    retrace,
     retryhygiene,
     taintflow,
 )

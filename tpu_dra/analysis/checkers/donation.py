@@ -1,0 +1,142 @@
+"""jit-donation: donated buffers die at the call — project-wide.
+
+``donate_argnums`` tells XLA it may alias the input buffer into the
+output; after the call the Python reference points at memory the
+program may already have overwritten.  The engine leans on this hard
+(every ``_step_fn`` pass donates the KV cache and sampling state), so
+the rules are mechanized over the traced-region model's project-wide
+binding table (:mod:`tpu_dra.analysis.jaxsem` — the
+``self._step_fn = jax.jit(..., donate_argnums=...)`` assignment may
+live in another file than the call):
+
+- *reuse after donation* — a name passed at a donated position and read
+  again after the call with no intervening reassignment: use-after-free
+  at worst, a silent defensive copy at best.  The reassignment kill is
+  start-line based, so the engine's multiline
+  ``(self._cache, ...) = self._step_fn(self._cache, ...)`` self-feed
+  idiom — where the donated buffer is replaced by the very statement
+  that donates it — stays clean.
+- *double donation* — the same name at two donated positions of one
+  call: XLA would alias two parameters onto one buffer.
+- *donation drift* — a call passing fewer positional args than the
+  binding's highest donated index (the donation silently stops
+  happening — the classic symptom after an argument is added or
+  removed), and ``static_argnums`` ∩ ``donate_argnums`` at the binding
+  (static args have no buffer to donate).
+
+This check SUBSUMES the donation half the ``jit-purity`` checker
+carried before the traced-region model existed; ``jit-purity`` now
+judges only traced-body purity.  Scope: ``tpu_dra/workloads/``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_dra.analysis import jaxsem
+from tpu_dra.analysis.callgraph import dotted_of, toplevel_functions
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_CHECK = "jit-donation"
+_SCOPE = ("tpu_dra/workloads",)
+
+
+def _short(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _check_function(ctx: FileContext, fn, model,
+                    diags: list[Diagnostic]) -> None:
+    # (name, call start line, call end line, binding)
+    donated_uses: list[tuple[str, int, int, jaxsem.Binding]] = []
+    loads: list[tuple[str, int]] = []
+    stores: list[tuple[str, int]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dotted = dotted_of(node.func)
+            b = model.binding_for(_short(dotted)) if dotted else None
+            if b is not None and b.donates:
+                _check_call(ctx, node, b, donated_uses, diags)
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            name = dotted_of(node)
+            if name is None:
+                continue
+            target = stores if isinstance(node.ctx, ast.Store) else loads
+            target.append((name, node.lineno))
+    for name, start, end, b in donated_uses:
+        later = [ln for n, ln in loads if n == name and ln > end]
+        # any store from the call statement onward kills: the self-feed
+        # idiom reassigns the donated name on the call's own first line
+        killed = any(n == name and ln >= start for n, ln in stores)
+        if later and not killed:
+            diags.append(ctx.diag(
+                min(later), _CHECK,
+                f"{name} was donated to {b.name}() on line {start} "
+                f"(donate_argnums at {b.path}:{b.line}) and is read "
+                f"again here — the buffer is dead after the call (XLA "
+                f"may alias its memory into the output); rebind the "
+                f"name from the call's result or drop the donation"))
+
+
+def _check_call(ctx: FileContext, call: ast.Call, b: jaxsem.Binding,
+                donated_uses: list, diags: list[Diagnostic]) -> None:
+    start = call.lineno
+    end = call.end_lineno or call.lineno
+    seen: dict[str, int] = {}
+    for i in b.donates:
+        if i >= len(call.args):
+            continue
+        name = dotted_of(call.args[i])
+        if name is None:
+            continue
+        if name in seen:
+            diags.append(ctx.diag(
+                call.args[i], _CHECK,
+                f"{name} passed at two donated positions ({seen[name]} "
+                f"and {i}) of {b.name}() — XLA would alias two "
+                f"parameters onto one buffer; donate it once"))
+        else:
+            seen[name] = i
+            donated_uses.append((name, start, end, b))
+    if b.donates and call.args and max(b.donates) >= len(call.args):
+        lost = sorted(i for i in b.donates if i >= len(call.args))
+        diags.append(ctx.diag(
+            call, _CHECK,
+            f"{b.name}() is called with {len(call.args)} positional "
+            f"args but donates position(s) {lost} "
+            f"(donate_argnums at {b.path}:{b.line}) — the donation "
+            f"silently stops; realign donate_argnums with the call"))
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.program is None or not ctx.in_dir(*_SCOPE):
+        return []
+    model = ctx.program.jaxsem()
+    diags: list[Diagnostic] = []
+    # binding-site rule: static ∩ donated is a jit error at trace time
+    for raw in (ctx.program.facts[ctx.path].get("jax") or {}).get(
+            "bindings", ()):
+        name, line = raw[0], raw[1]
+        donates, statics = set(raw[6]), set(raw[7])
+        both = sorted(donates & statics)
+        if both:
+            diags.append(ctx.diag(
+                line, _CHECK,
+                f"binding {name}: position(s) {both} are both static "
+                f"and donated — static args are Python values with no "
+                f"device buffer to donate"))
+    for fn, _cls in toplevel_functions(ctx.tree):
+        if fn.name != "__init__":
+            _check_function(ctx, fn, model, diags)
+    return list(dict.fromkeys(diags))
+
+
+register(Analyzer(
+    name=_CHECK,
+    doc="donated buffers die at the call: no reuse after donation "
+        "(project-wide binding table), no double donation, no "
+        "donate_argnums drift between a binding and its call sites",
+    run=_run,
+    scope=_SCOPE,
+    whole_program=True,
+))

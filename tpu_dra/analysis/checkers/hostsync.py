@@ -1,0 +1,100 @@
+"""host-sync-hot-path: no device synchronization reachable from a
+declared hot loop — including one hidden behind helper calls.
+
+The serving/training plane has a handful of loops where latency is the
+product (the engine decode loop, ``Router.decide``, the train step —
+the :data:`tpu_dra.analysis.jaxsem.HOT_LOOPS` registry, extensible
+with ``# vet: hot-loop — why`` on a def line).  A host sync there —
+``.block_until_ready()``, ``jax.device_get``, ``.item()``, or
+``np.asarray``/``float()``/``int()``/``.tolist()`` applied to a value
+that came off a jitted callable — stalls the dispatch pipeline: the
+host waits for the device instead of queueing the next step, and every
+request in the batch pays.
+
+**Interprocedural:** the sync summaries come from the traced-region
+model (:mod:`tpu_dra.analysis.jaxsem`), solved bottom-up per SCC like
+the effect summaries, so a wrapper in another file does not hide the
+sync.  A call site inside a hot loop whose callee reaches a sync is
+flagged AT THE CALL, citing the origin and the helper chain (the
+blocking-under-lock convention).  A justified
+``# vet: ignore[host-sync-hot-path]`` at the sync ORIGIN covers every
+hot loop that reaches it — one deliberate readback, one ignore; an
+ignore at the call site covers just that loop.
+
+The judgment is flow-aware about readbacks: ``toks = step_fn(...)``
+makes ``toks`` device-valued, but after ``toks = jax.device_get(toks)``
+the SAME name is a host value, so host-side ``np.asarray`` over the
+already-fetched copy is not a second sync.  Unresolved calls and
+unprovable operands are never guessed syncing.
+"""
+
+from __future__ import annotations
+
+from tpu_dra.analysis import jaxsem
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_CHECK = "host-sync-hot-path"
+_SCOPE = ("tpu_dra/workloads",)
+
+
+def _origin_suppressed(program, sync) -> bool:
+    octx = program.ctxs.get(sync.path)
+    return octx is not None and octx.suppressed(sync.line, _CHECK)
+
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.program is None or not ctx.in_dir(*_SCOPE):
+        return []
+    model = ctx.program.jaxsem()
+    diags: list[Diagnostic] = []
+    for qual, ent in ctx.program.facts[ctx.path]["functions"].items():
+        if qual not in model.hot_loops:
+            continue
+        _line, why = model.hot_loops[qual]
+        loop = qual.split("::", 1)[-1]
+        seen: set[tuple] = set()
+        # direct syncs in the loop body itself
+        for sync in model.sync_summary(qual):
+            if sync.chain:
+                continue
+            diags.append(ctx.diag(
+                sync.line, _CHECK,
+                f"{sync.detail} inside hot loop {loop} ({why}) — "
+                f"keep the value on device or batch the readback "
+                f"outside the loop"))
+        # calls whose callee summary reaches a sync
+        for dotted, line, col, _skip in ent["calls"]:
+            target = ctx.program.resolve(ctx.path, ent["cls"], dotted)
+            if target is None or target == qual:
+                continue
+            for sync in model.sync_summary(target):
+                origin = (sync.kind, sync.path, sync.line)
+                if origin in seen or _origin_suppressed(ctx.program,
+                                                        sync):
+                    continue
+                seen.add(origin)
+                via = jaxsem.chain_str(sync)
+                where = f"{sync.path}:{sync.line}" + \
+                        (f" ({via})" if via else "")
+                diags.append(Diagnostic(
+                    ctx.path, line, col, _CHECK,
+                    f"call to {dotted}() inside hot loop {loop} "
+                    f"reaches {sync.detail} at {where} — {why}; keep "
+                    f"the sync out of the loop or justify it at the "
+                    f"origin",
+                    flow=((ctx.path, line,
+                           f"hot loop {loop} calls {dotted}()"),
+                          (sync.path, sync.line,
+                           f"sync origin: {sync.detail}"))))
+    return diags
+
+
+register(Analyzer(
+    name=_CHECK,
+    doc="no device sync (block_until_ready, device_get, .item, "
+        "np.asarray/float/int/tolist of device values) reachable from "
+        "a declared hot loop — interprocedural, origin+chain cited",
+    run=_run,
+    scope=_SCOPE,
+    whole_program=True,
+))

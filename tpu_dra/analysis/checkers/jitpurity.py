@@ -1,9 +1,15 @@
-"""jit-purity: no host syncs inside jitted code; donated buffers die.
+"""jit-purity: no host syncs inside traced code.
 
-Inside a function decorated ``@jax.jit`` / ``@partial(jax.jit, ...)`` /
-``@functools.partial(jax.jit, ...)`` and inside Pallas kernel bodies
-(any function taking ``*_ref`` parameters), the following force a trace
-break or a device->host transfer on the hot path and are flagged:
+"Inside traced code" is the traced-region fact from
+:mod:`tpu_dra.analysis.jaxsem` — jit entry points (decorations,
+``jax.jit`` bindings, ``custom_vjp``, ``pallas_call``/``shard_map``
+wrappers, Pallas kernel bodies) plus everything reachable from them
+through the project call graph.  A helper two files away from the
+``@jax.jit`` line is traced all the same, and is scanned all the same
+(the decorator-only view this checker shipped with missed exactly
+those helpers).
+
+Flagged inside traced code:
 
 - ``x.item()`` — blocks on the device and pulls a scalar;
 - ``np.asarray(...)`` / ``np.array(...)`` — materializes a traced value
@@ -12,12 +18,9 @@ break or a device->host transfer on the hot path and are flagged:
 - ``print(...)`` — evaluates (and on trace, leaks) traced values; use
   ``jax.debug.print`` / ``pl.debug_print``.
 
-Separately, for ``jax.jit(..., donate_argnums=...)`` callables bound in
-the same file, a call site that passes a named buffer at a donated
-position and then *reads that name again* (with no intervening
-re-assignment) is flagged: the donated buffer is dead after the call —
-XLA may have aliased its memory into the output — so any later read is
-use-after-free at worst and a silent copy at best.  Scope:
+Donation rules moved to the ``jit-donation`` checker
+(:mod:`tpu_dra.analysis.checkers.donation`), which judges the
+project-wide binding table instead of same-file assignments.  Scope:
 ``tpu_dra/workloads/``.
 """
 
@@ -26,48 +29,18 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
+from tpu_dra.analysis.callgraph import dotted_of, qualname, \
+    toplevel_functions
 from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
 
 _SCOPE = ("tpu_dra/workloads",)
-
-
-def _dotted(node: ast.expr) -> Optional[str]:
-    """``a.b.c`` / ``name`` -> dotted string, else None."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        base = _dotted(node.value)
-        return f"{base}.{node.attr}" if base else None
-    return None
-
-
-def _is_jax_jit(node: ast.expr) -> bool:
-    return _dotted(node) == "jax.jit"
-
-
-def _jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
-    for dec in fn.decorator_list:
-        if _is_jax_jit(dec):
-            return True
-        if isinstance(dec, ast.Call):
-            if _is_jax_jit(dec.func):
-                return True
-            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
-            if _dotted(dec.func) in ("partial", "functools.partial") and \
-                    dec.args and _is_jax_jit(dec.args[0]):
-                return True
-    return False
-
-
-def _is_pallas_kernel(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
-    return any(a.arg.endswith("_ref") for a in fn.args.args)
 
 
 def _host_sync(node: ast.Call) -> Optional[str]:
     fn = node.func
     if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
         return ".item() blocks on the device and syncs to host"
-    name = _dotted(fn)
+    name = dotted_of(fn)
     if name in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
         return f"{name}() materializes a traced value on the host; " \
                f"use jnp inside jitted code"
@@ -79,122 +52,38 @@ def _host_sync(node: ast.Call) -> Optional[str]:
     return None
 
 
-def _check_traced_body(ctx: FileContext, fn, kind: str) -> list[Diagnostic]:
-    diags = []
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            why = _host_sync(node)
-            if why:
-                diags.append(ctx.diag(
-                    node, "jit-purity", f"in {kind} {fn.name}: {why}"))
-    return diags
-
-
-def _donated_indices(call: ast.Call) -> Optional[set[int]]:
-    """``jax.jit(..., donate_argnums=<const>)`` -> donated positions."""
-    if not _is_jax_jit(call.func):
-        return None
-    for kw in call.keywords:
-        if kw.arg != "donate_argnums":
-            continue
-        try:
-            val = ast.literal_eval(kw.value)
-        except ValueError:
-            return None
-        if isinstance(val, int):
-            return {val}
-        if isinstance(val, (tuple, list)):
-            return {int(v) for v in val}
-    return None
-
-
-def _donating_callees(tree: ast.AST) -> dict[str, set[int]]:
-    """name (bare or attribute) bound to a donating jax.jit -> indices."""
-    out: dict[str, set[int]] = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign) or \
-                not isinstance(node.value, ast.Call):
-            continue
-        donated = _donated_indices(node.value)
-        if not donated:
-            continue
-        for tgt in node.targets:
-            if isinstance(tgt, ast.Name):
-                out[tgt.id] = donated
-            elif isinstance(tgt, ast.Attribute):
-                out[tgt.attr] = donated
-    return out
-
-
-def _callee_key(fn: ast.expr) -> Optional[str]:
-    if isinstance(fn, ast.Name):
-        return fn.id
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    return None
-
-
-def _check_donation_reuse(ctx: FileContext, fn: ast.FunctionDef,
-                          donating: dict[str, set[int]]
-                          ) -> list[Diagnostic]:
-    if not donating:
-        return []
-    # (donated dotted arg name, call end line)
-    donated_uses: list[tuple[str, int]] = []
-    loads: list[tuple[str, int]] = []
-    stores: list[tuple[str, int]] = []
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            key = _callee_key(node.func)
-            indices = donating.get(key) if key else None
-            if indices:
-                for i, arg in enumerate(node.args):
-                    name = _dotted(arg)
-                    if i in indices and name:
-                        donated_uses.append(
-                            (name, node.end_lineno or node.lineno))
-        elif isinstance(node, (ast.Name, ast.Attribute)):
-            name = _dotted(node)
-            if name is None:
-                continue
-            target = stores if isinstance(node.ctx, ast.Store) else loads
-            target.append((name, node.lineno))
-    diags = []
-    for name, call_end in donated_uses:
-        later_loads = [ln for n, ln in loads if n == name and ln > call_end]
-        reassigned = any(n == name and ln >= call_end for n, ln in stores)
-        if later_loads and not reassigned:
-            diags.append(ctx.diag(
-                min(later_loads), "jit-purity",
-                f"{name} was donated to a jitted call on line "
-                f"~{call_end} and is read again here: a donated buffer "
-                f"is dead after the call (XLA may alias its memory)"))
-    return diags
-
-
 def _run(ctx: FileContext) -> list[Diagnostic]:
-    if ctx.is_test() or not ctx.in_dir(*_SCOPE):
+    if ctx.is_test() or ctx.program is None or not ctx.in_dir(*_SCOPE):
         return []
+    model = ctx.program.jaxsem()
     diags: list[Diagnostic] = []
-    donating = _donating_callees(ctx.tree)
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+    for fn, cls in toplevel_functions(ctx.tree):
+        fact = model.traced.get(qualname(ctx.path, cls, fn.name))
+        if fact is None:
             continue
-        if _jit_decorated(node):
-            diags.extend(_check_traced_body(ctx, node, "jitted function"))
-        elif _is_pallas_kernel(node):
-            diags.extend(_check_traced_body(ctx, node, "Pallas kernel"))
-        if node.name not in ("__init__",):
-            diags.extend(_check_donation_reuse(ctx, node, donating))
-    # ast.walk reaches nested defs both standalone and via their parent;
-    # identical findings collapse
-    return list(dict.fromkeys(diags))
+        if fact.chain:
+            where = f"traced {fn.name} (reached from " \
+                    f"{fact.entry.split('::', 1)[-1]})"
+        else:
+            kind = {"pallas-kernel": "Pallas kernel"}.get(
+                fact.how, "jitted function")
+            where = f"{kind} {fn.name}"
+        # nested defs trace with their parent: full walk on purpose
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                why = _host_sync(node)
+                if why:
+                    diags.append(ctx.diag(
+                        node, "jit-purity", f"in {where}: {why}"))
+    return diags
 
 
 register(Analyzer(
     name="jit-purity",
-    doc="no host syncs (.item, np.asarray, jax.device_get, print) inside "
-        "jitted/Pallas code; no reuse of donated buffers after the call",
+    doc="no host syncs (.item, np.asarray, jax.device_get, print) "
+        "inside traced code — entry points AND everything reachable "
+        "from them via the traced-region model",
     run=_run,
     scope=_SCOPE,
+    whole_program=True,
 ))

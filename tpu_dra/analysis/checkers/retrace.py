@@ -1,0 +1,563 @@
+"""retrace-risk + pytree-stability: nothing on the serving path may
+silently recompile.
+
+A ``jax.jit`` cache key is (shapes, dtypes, static-arg values, pytree
+structure).  Anything that varies one of those per call turns a warm
+program into a fresh XLA compile — seconds of stall on the serving
+path, invisible in tests because the first call always compiles.  Over
+the traced-region model (:mod:`tpu_dra.analysis.jaxsem`) this file
+mechanizes the review rules:
+
+**retrace-risk**
+
+- *branch on traced* — Python ``if``/``while`` over a traced parameter
+  of a jit entry raises ``ConcretizationError`` (or, under
+  ``static_argnums``, compiles per value).  ``.shape``/``.dtype``/
+  ``.ndim``/``.size``/``len()``/``isinstance``/``is None`` reads are
+  static under trace and do not count.
+- *value-dependent shape* — ``jnp.arange(n)`` / ``jnp.zeros(n)`` /
+  ``range(n)`` where ``n`` is a traced value: the output shape would
+  depend on data.
+- *unhashable / non-constant static args* — a ``list``/``dict``/``set``
+  literal at a ``static_argnums`` position is a ``TypeError`` at call
+  time; a fresh call expression there never compares equal, so every
+  call recompiles.
+- *dtype-promoting bare literals* — the same traced position of one jit
+  binding fed an ``int`` literal at one call site and a ``float`` at
+  another weak-types two distinct programs.
+- *unbucketed shape key* (hot path only) — a per-request value
+  (``len(prompt)``) flowing into a jit factory's shape-key parameter
+  compiles one program per distinct request, exactly the failure the
+  engine's ``_bucket`` rounding exists to prevent.  Sanctioned sources:
+  constants, ``# vet: shape-bucket`` function results, ``.bucket``
+  attributes, and the caller's own shape-key parameters (judged at
+  *its* call sites).  The flow is cited source → sink, and the
+  propagation follows the engine's coalescing idiom: values keyed into
+  a dict carry their provenance to ``for k, v in d.items()`` loops, and
+  shape-key parameters propagate bottom-up through helpers like
+  ``_admit_plain``.
+
+**pytree-stability** — a traced function returning dicts with
+branch-dependent key sets (two ``return {...}`` with different keys, or
+a conditional ``d[k] = ...`` into the returned dict) retraces per
+structure and hands callers a shape-shifting pytree.
+
+Scope: ``tpu_dra/workloads/``.  Only *proven* facts fire: unresolved
+calls, unknown provenance, and non-literal static args are never
+guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpu_dra.analysis import jaxsem, lockset
+from tpu_dra.analysis.callgraph import dotted_of, qualname, \
+    toplevel_functions
+from tpu_dra.analysis.core import Analyzer, Diagnostic, FileContext, register
+
+_CHECK = "retrace-risk"
+_PYTREE = "pytree-stability"
+_SCOPE = ("tpu_dra/workloads",)
+
+# attribute reads that are Python-level constants under trace
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+# shape-constructing callables whose first arguments ARE shapes
+_SHAPE_CTORS = {
+    "jnp.arange", "jnp.zeros", "jnp.ones", "jnp.full", "jnp.empty",
+    "jnp.linspace", "jnp.eye", "jnp.tri", "jnp.tril", "jnp.triu",
+    "jax.numpy.arange", "jax.numpy.zeros", "jax.numpy.ones", "range",
+}
+# calls whose result is a host int even over traced operands
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+
+# -- entry-function rules (branch-on-traced, shapes) ---------------------
+
+def _traced_params(fn, cls: Optional[str], info: jaxsem.Entry) -> set[str]:
+    """Parameter names of ``fn`` that are traced values at run time:
+    the callable-view positionals minus static/bound ones."""
+    params = jaxsem.jit_params(fn, cls is not None, info.bound)
+    statics = {params[i] for i in info.statics if 0 <= i < len(params)}
+    return set(params) - statics - set(info.static_names) \
+        - set(info.bound_kw)
+
+
+def _traced_leak(expr: ast.AST, traced: set[str]) -> Optional[ast.Name]:
+    """The first traced Name whose VALUE (not a static property of it)
+    the expression observes, or None."""
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return None
+        return _traced_leak(expr.value, traced)
+    if isinstance(expr, ast.Call):
+        name = dotted_of(expr.func)
+        if name in _STATIC_CALLS:
+            return None
+        for sub in list(expr.args) + [kw.value for kw in expr.keywords]:
+            leak = _traced_leak(sub, traced)
+            if leak is not None:
+                return leak
+        return None
+    if isinstance(expr, ast.Compare):
+        # ``x is None`` / ``x is not None`` tests presence, not value
+        if len(expr.ops) == 1 and isinstance(expr.ops[0],
+                                             (ast.Is, ast.IsNot)):
+            return None
+        for sub in [expr.left] + expr.comparators:
+            leak = _traced_leak(sub, traced)
+            if leak is not None:
+                return leak
+        return None
+    if isinstance(expr, ast.Name):
+        return expr if expr.id in traced else None
+    for sub in ast.iter_child_nodes(expr):
+        leak = _traced_leak(sub, traced)
+        if leak is not None:
+            return leak
+    return None
+
+
+def _check_entry(ctx: FileContext, fn, cls, info: jaxsem.Entry,
+                 diags: list[Diagnostic]) -> None:
+    traced = _traced_params(fn, cls, info)
+    if not traced:
+        return
+    for node in lockset.walk_scan(fn):
+        test = None
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+        elif isinstance(node, ast.IfExp):
+            test = node.test
+        elif isinstance(node, ast.Assert):
+            test = node.test
+        if test is not None:
+            leak = _traced_leak(test, traced)
+            if leak is not None:
+                kw = "assert" if isinstance(node, ast.Assert) else \
+                    ("while" if isinstance(node, ast.While) else "if")
+                diags.append(ctx.diag(
+                    test, _CHECK,
+                    f"`{kw}` in jitted {fn.name} branches on traced "
+                    f"parameter '{leak.id}': Python control flow over "
+                    f"device values is a ConcretizationError (or a "
+                    f"compile per value under static_argnums) — use "
+                    f"jnp.where / lax.cond / lax.while_loop"))
+        if isinstance(node, ast.Call) and \
+                dotted_of(node.func) in _SHAPE_CTORS:
+            for arg in node.args:
+                leak = _traced_leak(arg, traced)
+                if leak is not None:
+                    diags.append(ctx.diag(
+                        node, _CHECK,
+                        f"{dotted_of(node.func)}() in jitted {fn.name} "
+                        f"takes its shape from traced parameter "
+                        f"'{leak.id}': data-dependent shapes cannot "
+                        f"trace — pad to a bucket or hoist the size to "
+                        f"a static arg"))
+                    break
+
+
+# -- call-site rules (static args, literal drift) ------------------------
+
+def _short(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+# (binding name, position) -> {literal kind: (path, line)} — reset per run
+_literal_sites: dict[tuple, dict] = {}
+
+
+def _check_binding_call(ctx: FileContext, call: ast.Call,
+                        b: jaxsem.Binding,
+                        diags: list[Diagnostic]) -> None:
+    static_pos = set(b.statics)
+    for i, arg in enumerate(call.args):
+        if i in static_pos:
+            if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                diags.append(ctx.diag(
+                    arg, _CHECK,
+                    f"unhashable {type(arg).__name__.lower()} literal "
+                    f"at static position {i} of {b.name}() — "
+                    f"static_argnums values must hash (TypeError at "
+                    f"call time); pass a tuple or hoist it"))
+            elif isinstance(arg, ast.Call):
+                diags.append(ctx.diag(
+                    arg, _CHECK,
+                    f"fresh {dotted_of(arg.func) or 'call'}() result "
+                    f"at static position {i} of {b.name}() — a new "
+                    f"object never compares equal to the cached key, "
+                    f"so every call recompiles"))
+            continue
+        if isinstance(arg, ast.Constant) and \
+                type(arg.value) in (int, float):
+            kind = type(arg.value).__name__
+            sites = _literal_sites.setdefault((b.name, i), {})
+            sites.setdefault(kind, (ctx.path, arg.lineno))
+    for kw in call.keywords:
+        if kw.arg in b.static_names and \
+                isinstance(kw.value, (ast.List, ast.Dict, ast.Set)):
+            diags.append(ctx.diag(
+                kw.value, _CHECK,
+                f"unhashable literal for static_argname "
+                f"'{kw.arg}' of {b.name}() — static values must hash"))
+
+
+def _finish() -> list[Diagnostic]:
+    diags = []
+    for (name, pos), sites in sorted(_literal_sites.items()):
+        if "int" in sites and "float" in sites:
+            ipath, iline = sites["int"]
+            fpath, fline = sites["float"]
+            diags.append(Diagnostic(
+                fpath, fline, 0, _CHECK,
+                f"traced position {pos} of jit binding {name}() takes "
+                f"a bare float literal here but an int literal at "
+                f"{ipath}:{iline} — weak-type promotion keys two "
+                f"compiled programs; pick one dtype "
+                f"(jnp.asarray(x, dtype) or a consistent literal)",
+                flow=((ipath, iline, f"{name}() called with int "
+                       f"literal at position {pos}"),
+                      (fpath, fline, f"same position called with "
+                       f"float literal"))))
+    return diags
+
+
+def _begin() -> None:
+    _literal_sites.clear()
+    _SKP_STATE.clear()
+
+
+# -- hot-path shape-key provenance (the bucket-guard rule) ---------------
+
+# per-run memo: id(program) -> (skp table, def_params table)
+_SKP_STATE: dict = {}
+
+_VALDEP, _BUCKET, _CONST, _UNKNOWN = "valuedep", "bucket", "const", "?"
+
+
+def _def_tables(program):
+    """qual -> (param names incl. self, is_method) over every analyzed
+    file, plus the function AST index the fixpoint below walks."""
+    params: dict[str, tuple] = {}
+    fns: list[tuple] = []
+    for path, octx in program.ctxs.items():
+        for fn, cls in toplevel_functions(octx.tree):
+            qual = qualname(path, cls, fn.name)
+            names = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            params[qual] = (tuple(names), cls is not None)
+            fns.append((qual, fn, cls, path))
+    return params, fns
+
+
+def _skp_table(program, model) -> tuple[dict, dict]:
+    """qual -> set of def-view param indices that are SHAPE KEYS:
+    passed (possibly transitively) to a jit factory's shape-key
+    position.  Bottom-up fixpoint over the call graph."""
+    state = _SKP_STATE.get(id(program))
+    if state is not None:
+        return state
+    def_params, fns = _def_tables(program)
+    skp: dict[str, set] = {}
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn, cls, path in fns:
+            params = def_params[qual][0]
+            mine = skp.setdefault(qual, set())
+            for call in lockset.walk_scan(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                dotted = dotted_of(call.func)
+                if dotted is None:
+                    continue
+                sinks = _sink_positions(program, model, path, cls,
+                                        dotted, call, skp, def_params)
+                for pos, _what in sinks:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if isinstance(arg, ast.Name) and arg.id in params:
+                        idx = params.index(arg.id)
+                        if idx not in mine:
+                            mine.add(idx)
+                            changed = True
+    state = (skp, def_params)
+    _SKP_STATE[id(program)] = state
+    return state
+
+
+def _sink_positions(program, model, path, cls, dotted, call, skp,
+                    def_params) -> list[tuple[int, str]]:
+    """Call-site positional indices of ``call`` that feed a shape key:
+    factory shape-key params directly, or a callee's (transitive)
+    shape-key params."""
+    fac = model.factories.get(_short(dotted))
+    if fac is not None:
+        _q, _p, _l, params, keys = fac
+        return [(k, f"shape key '{params[k]}' of jit factory "
+                 f"{_short(dotted)}()") for k in keys]
+    target = program.resolve(path, cls, dotted)
+    if target is None or not skp.get(target):
+        return []
+    params, is_method = def_params[target]
+    off = 1 if is_method and isinstance(call.func, ast.Attribute) else 0
+    out = []
+    for idx in skp[target]:
+        pos = idx - off
+        if pos >= 0:
+            out.append((pos, f"shape-key parameter '{params[idx]}' of "
+                        f"{target.split('::', 1)[-1]}"))
+    return out
+
+
+class _Prov:
+    """Local provenance of names inside one function: what flows into a
+    shape key — a bucketed value, a constant, or a raw per-request
+    value (``len(...)``)."""
+
+    def __init__(self, fn, params: set[str], model):
+        self.model = model
+        self.params = params
+        self.assigns: dict[str, list] = {}   # name -> [(kind, line, desc)]
+        self.dict_keys: dict[str, list] = {} # dict name -> same
+        self._scan(fn)
+
+    def _scan(self, fn) -> None:
+        # two passes: walk_scan is breadth-first, so a ``for Sb in
+        # d.items()`` header can be visited before the deeper-nested
+        # ``d.setdefault(key, ...)`` that defines the dict's key
+        # provenance — loop targets are resolved only after every
+        # assignment/insert in the function has been recorded
+        fors: list[ast.For] = []
+        for node in lockset.walk_scan(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.assigns.setdefault(tgt.id, []).append(
+                            self.of(node.value))
+                    elif isinstance(tgt, ast.Subscript) and \
+                            isinstance(tgt.value, ast.Name):
+                        # d[K] = ... gives the dict key provenance
+                        self.dict_keys.setdefault(
+                            tgt.value.id, []).append(self.of(tgt.slice))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "setdefault" and node.args and \
+                    isinstance(node.func.value, ast.Name):
+                self.dict_keys.setdefault(
+                    node.func.value.id, []).append(self.of(node.args[0]))
+            elif isinstance(node, ast.For):
+                fors.append(node)
+        for node in fors:
+            self._for_target(node)
+
+    def _for_target(self, node: ast.For) -> None:
+        """``for Sb, group in plain.items()`` — loop keys inherit the
+        dict's key provenance (the admission-coalescing idiom)."""
+        it = node.iter
+        dname = None
+        if isinstance(it, ast.Call) and \
+                isinstance(it.func, ast.Attribute) and \
+                it.func.attr in ("items", "keys") and \
+                isinstance(it.func.value, ast.Name):
+            dname = it.func.value.id
+        elif isinstance(it, ast.Name):
+            dname = it.id
+        if dname is None or dname not in self.dict_keys:
+            return
+        key_prov = self._join(self.dict_keys[dname])
+        tgt = node.target
+        key_tgt = tgt.elts[0] if isinstance(tgt, ast.Tuple) and \
+            tgt.elts else tgt
+        if isinstance(key_tgt, ast.Name):
+            self.assigns.setdefault(key_tgt.id, []).append(key_prov)
+
+    @staticmethod
+    def _join(provs: list) -> tuple:
+        for kind in (_VALDEP, _BUCKET, _CONST):
+            for p in provs:
+                if p[0] == kind:
+                    return p
+        return (_UNKNOWN, 0, "")
+
+    def of(self, expr: ast.AST) -> tuple:
+        """(kind, origin line, description) of an expression."""
+        if isinstance(expr, ast.Constant):
+            return (_CONST, expr.lineno, "")
+        if isinstance(expr, ast.Call):
+            dotted = dotted_of(expr.func)
+            short = _short(dotted) if dotted else ""
+            if short in self.model.bucket_fns:
+                return (_BUCKET, expr.lineno, "")
+            if short == "len":
+                src = (dotted_of(expr.args[0]) if expr.args
+                       else None) or "..."
+                return (_VALDEP, expr.lineno,
+                        f"len({src}) — a per-request value")
+            if short in ("min", "max"):
+                return self._join([self.of(a) for a in expr.args])
+            return (_UNKNOWN, expr.lineno, "")
+        if isinstance(expr, ast.Attribute):
+            if expr.attr == "bucket":
+                return (_BUCKET, expr.lineno, "")
+            return (_UNKNOWN, expr.lineno, "")
+        if isinstance(expr, ast.Name):
+            if expr.id in self.assigns:
+                p = self._join(self.assigns[expr.id])
+                return p if p[0] != _UNKNOWN else (_UNKNOWN,
+                                                   expr.lineno, "")
+            # a bare parameter: the CALLER is judged at its call site
+            return (_UNKNOWN, expr.lineno, "")
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.BoolOp)):
+            kids = [self.of(v) for v in ast.iter_child_nodes(expr)
+                    if isinstance(v, ast.expr)]
+            return self._join(kids) if kids else (_UNKNOWN,
+                                                  expr.lineno, "")
+        return (_UNKNOWN, getattr(expr, "lineno", 0), "")
+
+
+def _check_hot_function(ctx: FileContext, fn, cls, qual: str,
+                        model, diags: list[Diagnostic]) -> None:
+    program = ctx.program
+    skp, def_params = _skp_table(program, model)
+    params = set(def_params.get(qual, ((), False))[0])
+    prov = _Prov(fn, params, model)
+    loop, _chain = model.hot_reach[qual]
+    loop_name = loop.split("::", 1)[-1]
+    for call in lockset.walk_scan(fn):
+        if not isinstance(call, ast.Call):
+            continue
+        dotted = dotted_of(call.func)
+        if dotted is None:
+            continue
+        for pos, what in _sink_positions(program, model, ctx.path, cls,
+                                         dotted, call, skp, def_params):
+            if pos >= len(call.args):
+                continue
+            kind, line, desc = prov.of(call.args[pos])
+            if kind != _VALDEP:
+                continue
+            diags.append(Diagnostic(
+                ctx.path, call.lineno, call.col_offset, _CHECK,
+                f"unbucketed shape key: {desc} reaches {what} on the "
+                f"hot path from {loop_name} — every distinct value "
+                f"compiles a new program on the serving path; round it "
+                f"through a `# vet: shape-bucket` function first",
+                flow=((ctx.path, line, desc or "value-dependent "
+                       "expression"),
+                      (ctx.path, call.lineno, f"flows into {what}"))))
+    # direct value-dependent factory args written inline
+    # (``self._prefill_fn(len(p))``) are covered by the same loop: the
+    # provenance of the literal expression is judged by prov.of
+
+
+# -- drivers -------------------------------------------------------------
+
+def _run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.program is None or not ctx.in_dir(*_SCOPE):
+        return []
+    model = ctx.program.jaxsem()
+    diags: list[Diagnostic] = []
+    for fn, cls in toplevel_functions(ctx.tree):
+        qual = qualname(ctx.path, cls, fn.name)
+        fact = model.traced.get(qual)
+        if fact is not None and fact.info is not None:
+            _check_entry(ctx, fn, cls, fact.info, diags)
+        if qual in model.hot_reach:
+            _check_hot_function(ctx, fn, cls, qual, model, diags)
+        for call in lockset.walk_scan(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            dotted = dotted_of(call.func)
+            if dotted is None:
+                continue
+            b = model.binding_for(_short(dotted))
+            if b is not None:
+                _check_binding_call(ctx, call, b, diags)
+    return diags
+
+
+def _pytree_run(ctx: FileContext) -> list[Diagnostic]:
+    if ctx.is_test() or ctx.program is None or not ctx.in_dir(*_SCOPE):
+        return []
+    model = ctx.program.jaxsem()
+    diags: list[Diagnostic] = []
+    for fn, cls in toplevel_functions(ctx.tree):
+        qual = qualname(ctx.path, cls, fn.name)
+        if qual not in model.traced:
+            continue
+        returns: list[tuple[frozenset, int]] = []
+        returned_names: set[str] = set()
+        dict_names: dict[str, int] = {}
+        cond_inserts: list[tuple[str, str, int]] = []
+        for node in lockset.walk_scan(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if isinstance(node.value, ast.Dict):
+                    keys = frozenset(
+                        k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant))
+                    returns.append((keys, node.lineno))
+                elif isinstance(node.value, ast.Name):
+                    returned_names.add(node.value.id)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        dict_names[tgt.id] = node.lineno
+            elif isinstance(node, ast.If):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Subscript) and \
+                                    isinstance(tgt.value, ast.Name) and \
+                                    isinstance(tgt.slice, ast.Constant):
+                                cond_inserts.append(
+                                    (tgt.value.id, str(tgt.slice.value),
+                                     sub.lineno))
+        for (keys_a, line_a), (keys_b, line_b) in zip(returns,
+                                                      returns[1:]):
+            if keys_a != keys_b:
+                only = sorted(keys_a ^ keys_b)
+                diags.append(ctx.diag(
+                    line_b, _PYTREE,
+                    f"traced {fn.name} returns dicts with different "
+                    f"key sets (line {line_a} vs {line_b}; differing: "
+                    f"{', '.join(only)}) — pytree structure is part of "
+                    f"the jit cache key, so each branch compiles its "
+                    f"own program; return the same keys (use a None/"
+                    f"empty value) on every path"))
+        for name, key, line in cond_inserts:
+            if name in returned_names and name in dict_names:
+                diags.append(ctx.diag(
+                    line, _PYTREE,
+                    f"traced {fn.name} conditionally inserts key "
+                    f"'{key}' into returned dict '{name}' — the "
+                    f"returned pytree structure differs per branch "
+                    f"and keys a retrace; insert the key "
+                    f"unconditionally"))
+    return diags
+
+
+register(Analyzer(
+    name=_CHECK,
+    doc="nothing on the serving path may silently recompile: no Python "
+        "branches on traced values, no data-dependent shapes, hashable "
+        "static args, consistent literal dtypes, and per-request "
+        "values rounded through a shape bucket before reaching a jit "
+        "factory",
+    run=_run,
+    scope=_SCOPE,
+    begin=_begin,
+    finish=_finish,
+    whole_program=True,
+))
+
+register(Analyzer(
+    name=_PYTREE,
+    doc="traced functions must return structurally stable pytrees: no "
+        "branch-dependent dict key sets",
+    run=_pytree_run,
+    scope=_SCOPE,
+    whole_program=True,
+))

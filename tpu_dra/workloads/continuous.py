@@ -74,6 +74,7 @@ from tpu_dra.workloads.decode import (
     init_kv_cache,
     _prefill_trunk,
 )
+from tpu_dra.workloads.retrace_guard import RetraceGuard
 from tpu_dra.workloads.train import ModelConfig
 
 _PROMPT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
@@ -374,6 +375,12 @@ class ContinuousEngine:
                 partial(spec_impl, cfg, draft[0], sampled=True),
                 donate_argnums=(2, 3))
             self._spec_prefill_fns: dict[int, Any] = {}
+        # runtime recompile ratchet (off unless TPU_DRA_RETRACE_GUARD):
+        # discovery-based, so the lazily-compiled per-bucket programs
+        # that land in the *_fns dicts above are picked up as they
+        # appear; warmup() marks, stats() reports the delta
+        self.retrace_guard = RetraceGuard()
+        self.retrace_guard.attach("engine", self)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="continuous-batcher")
         self._thread.start()
@@ -1252,6 +1259,9 @@ class ContinuousEngine:
                         raise RuntimeError(req.error)
             warmed += 1
         self.reset_stats()
+        # warmup compiles are the point of warmup: snapshot the jit
+        # caches so any compile AFTER this is a steady-state finding
+        self.retrace_guard.mark()
         return warmed
 
     def cancel(self, req: _Request) -> None:
@@ -1319,6 +1329,12 @@ class ContinuousEngine:
             out["spec_accept_rate"] = round(
                 self.spec_drafted_accepted
                 / max(1, self.spec_drafted_proposed), 4)
+        if self.retrace_guard.enabled:
+            # the runtime recompile ratchet: nonzero
+            # recompiles_since_mark after warmup means a live retrace
+            # bug (a shape key escaped its bucket) — the dynamic twin
+            # of the static retrace-risk checker
+            out.update(self.retrace_guard.stats())
         if lat:
             out["latency_p50_ms"] = round(
                 1e3 * lat[len(lat) // 2], 3)
@@ -1391,7 +1407,11 @@ class ContinuousEngine:
 
     # -- scheduler loop -----------------------------------------------------
 
-    def _bucket(self, n: int) -> int:
+    # Rounds per-request prompt lengths onto _PROMPT_BUCKETS, so the
+    # downstream jit factories key on finitely many shapes instead of
+    # compiling one program per distinct length — the declaration the
+    # retrace-risk checker's unbucketed-shape-key rule trusts.
+    def _bucket(self, n: int) -> int:  # vet: shape-bucket
         for b in _PROMPT_BUCKETS:
             if n <= b:
                 # never pad past the cache: a bucket wider than max_len
@@ -1629,7 +1649,10 @@ class ContinuousEngine:
                 self._cache, first = self._prefill_fn(Sb)(
                     self.params, self._cache, prompts, lengths, slots,
                     temps, keys0)
-        firsts = [int(t) for t in first.tolist()]   # ONE device readback
+        # deliberate: admission pulls each request's first token ONCE —
+        # per admission, not per decode step, and batched for the chunk
+        firsts = [int(t) for t in
+                  first.tolist()]  # vet: ignore[host-sync-hot-path]
         for (slot, req), key, first_host in zip(group, base_keys, firsts):
             self._finish_admission(slot, req, first_host,
                                    len(req.prompt), key)
@@ -1669,7 +1692,10 @@ class ContinuousEngine:
             self._table[slot][None],
             jnp.asarray([req.temperature], jnp.float32),
             jax.random.fold_in(key, 0)[None])
-        self._finish_admission(slot, req, int(first), h.length, key)
+        # deliberate: the handoff's first token is read back ONCE at
+        # admission (not per step) — the client needs it immediately
+        first_host = int(first)  # vet: ignore[host-sync-hot-path]
+        self._finish_admission(slot, req, first_host, h.length, key)
 
     def _admit_prefix(self, slot: int, req: "_Request") -> None:
         """Shared-prefix join: copy the prefix KV, prefill only the
@@ -1739,7 +1765,10 @@ class ContinuousEngine:
             start_page = len(self._shared_ids[slot])
             if self.draft is not None:
                 (self._cache, self._dcache,
-                 first) = self._paged_spec_join_fn(Sb, pref.bucket,
+                 # start_page is finite: register_prefix buckets the
+                 # prefix, so its page count takes one value per bucket
+                 first) = self._paged_spec_join_fn(  # vet: ignore[retrace-risk]
+                     Sb, pref.bucket,
                                                    start_page)(
                     self.params, self.draft[1], self._cache,
                     self._dcache, pref.kv, pref.dkv, prompt,
@@ -1748,7 +1777,9 @@ class ContinuousEngine:
                     jnp.float32(req.temperature),
                     jax.random.fold_in(key, 0))
             else:
-                self._cache, first = self._paged_join_fn(
+                # start_page is finite: register_prefix buckets the
+                # prefix, so its page count takes one value per bucket
+                self._cache, first = self._paged_join_fn(  # vet: ignore[retrace-risk]
                     Sb, pref.bucket, start_page)(
                     self.params, self._cache, pref.kv, prompt,
                     jnp.asarray([len(req.prompt)], jnp.int32),
@@ -1771,7 +1802,9 @@ class ContinuousEngine:
                 jnp.int32(pref.length), jnp.int32(slot),
                 jnp.float32(req.temperature),
                 jax.random.fold_in(key, 0))
-        self._finish_admission(slot, req, int(first),
+        # deliberate: first-token readback ONCE at prefix-join admission
+        first_host = int(first)  # vet: ignore[host-sync-hot-path]
+        self._finish_admission(slot, req, first_host,
                                pref.length + len(req.prompt), key)
 
     def _finish_admission(self, slot: int, req: "_Request",
@@ -1913,7 +1946,8 @@ class ContinuousEngine:
                  self._keys) = fn(*spec_args)
                 # ONE device readback for both outputs (admission-path
                 # discipline)
-                toks, counts_host = jax.device_get((toks, counts))
+                toks, counts_host = jax.device_get(  # vet: ignore[host-sync-hot-path]
+                    (toks, counts))  # the loop's ONE designed readback
                 counts_host = counts_host.tolist()
                 self.target_passes += 1
                 live = [(c, r) for c, r in zip(counts_host,
@@ -1941,7 +1975,9 @@ class ContinuousEngine:
                     self._temp, self._eos, self._done, self._keys)
                 counts_host = [self.chunk] * self.slots
             failpoint.hit("serve.engine.slow_decode")
-            toks_host = np.asarray(toks)            # [slots, chunk]
+            # the loop's ONE designed readback: every committed token of
+            # every live request crosses in this single transfer
+            toks_host = np.asarray(toks)  # vet: ignore[host-sync-hot-path]
             now = time.perf_counter()
             for slot, req in enumerate(self._requests):
                 if req is None:

@@ -51,7 +51,9 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int,
     shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.d_head)
     if cache_dtype == "int8":
         s_shape = shape[:-1] + (1,)
-        return {"k": jnp.zeros(shape, jnp.int8),
+        # structure varies by cache_dtype CONFIG, fixed per engine —
+        # never by traced data, so no runtime retrace
+        return {"k": jnp.zeros(shape, jnp.int8),  # vet: ignore[pytree-stability]
                 "v": jnp.zeros(shape, jnp.int8),
                 "k_s": jnp.zeros(s_shape, jnp.float32),
                 "v_s": jnp.zeros(s_shape, jnp.float32)}
@@ -505,7 +507,9 @@ def decode(cfg: ModelConfig, params, prompt, *, steps: int,
         lengths = lengths.astype(jnp.int32)
         if not isinstance(lengths, jax.core.Tracer):
             import numpy as np
-            ln = np.asarray(lengths)
+            # host-only validation: the Tracer guard above proves this
+            # branch never runs under trace
+            ln = np.asarray(lengths)  # vet: ignore[jit-purity]
             if (ln < 1).any() or (ln > S).any():
                 raise ValueError(
                     f"lengths must lie in [1, {S}], got {ln.tolist()}")

@@ -153,7 +153,9 @@ def init_paged_cache(cfg: ModelConfig, total_pages: int,
              cfg.d_head)
     if cache_dtype == "int8":
         s_shape = shape[:-1] + (1,)
-        return {"k": jnp.zeros(shape, jnp.int8),
+        # structure varies by cache_dtype CONFIG, fixed per engine —
+        # never by traced data, so no runtime retrace
+        return {"k": jnp.zeros(shape, jnp.int8),  # vet: ignore[pytree-stability]
                 "v": jnp.zeros(shape, jnp.int8),
                 "k_s": jnp.zeros(s_shape, jnp.float32),
                 "v_s": jnp.zeros(s_shape, jnp.float32)}

@@ -1,0 +1,175 @@
+"""Runtime recompile ratchet: the dynamic counterpart of the static
+``retrace-risk`` checker (analysis/checkers/retrace.py).
+
+The static analyzer proves (or flags) that every value reaching a jit
+shape-key position is bucket-rounded or constant.  This module is the
+belt to that suspenders: it counts *actual* compiles per jitted
+callable via ``_cache_size()`` deltas, so a retrace bug that slips past
+the analyzer (a dynamic code path, a monkeypatch, an operator config
+nobody modeled) still shows up as a nonzero ``recompiles_since_mark``
+in the engine's ``stats()`` — and fails the
+``engine_decode_recompiles`` bench gate (bench_prepare.py).
+
+Design constraints:
+
+* **Off by default, free when off.**  Serving hot paths call
+  ``recompiles_since_mark()`` indirectly through ``stats()``; when the
+  guard is disabled every method is a single attribute test.  The
+  ``retrace_guard_idle_us`` bench gate ratchets exactly this path.
+* **Discovery, not registration.**  The engine compiles lazily — the
+  per-bucket prefill/join/handoff programs land in dict attributes
+  (``_prefill_fns``, ``_join_fns``, ...) as traffic arrives.  The
+  guard therefore re-scans its attached objects on every ``counts()``
+  call instead of asking call sites to register each new program;
+  a callable counts as jitted iff it exposes a callable
+  ``_cache_size`` (the probe jax's own ``jax.jit`` wrappers carry,
+  including through ``functools.partial``-bound impls).
+* **Marks, not absolutes.**  Warmup compiles are the point of warmup;
+  ``ContinuousEngine.warmup`` calls ``mark()`` after its burst so the
+  steady-state counter starts at zero and any later compile is a
+  finding.
+
+Enable with ``TPU_DRA_RETRACE_GUARD=1`` (any value but ``0``/``false``/
+empty) or construct with ``RetraceGuard(enabled=True)``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Tuple
+
+ENV_FLAG = "TPU_DRA_RETRACE_GUARD"
+
+__all__ = ["ENV_FLAG", "RetraceGuard", "cache_size_of"]
+
+
+def cache_size_of(fn: Any) -> "int | None":
+    """The jit cache entry count of ``fn``, or None when ``fn`` is not a
+    jitted callable (no ``_cache_size`` probe) or the probe errors —
+    the guard must never take the serving loop down."""
+    probe = getattr(fn, "_cache_size", None)
+    if not callable(probe):
+        return None
+    try:
+        return int(probe())
+    except (TypeError, ValueError):
+        # not a zero-arg int probe — some unrelated attr happens to be
+        # named _cache_size; treat as "not jitted"
+        return None
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "").strip().lower() not in (
+        "", "0", "false", "no")
+
+
+class RetraceGuard:
+    """Counts compiles across a set of attached objects' jitted callables.
+
+    ``attach(label, obj)`` records an object root; every ``counts()``
+    re-scans its instance attributes — direct jitted callables and
+    dict-valued attributes whose values are jitted (the engine's lazy
+    per-bucket program caches) — so programs compiled after attach are
+    discovered automatically.  ``watch(label, fn)`` pins a single
+    callable that isn't reachable from any attached object.
+    """
+
+    def __init__(self, enabled: "bool | None" = None) -> None:
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self._objs: List[Tuple[str, Any]] = []
+        self._fns: List[Tuple[str, Any]] = []
+        self._marked: Dict[str, int] = {}
+        self._has_mark = False
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self, label: str, obj: Any) -> None:
+        """Scan ``obj`` (now and on every poll) for jitted callables."""
+        if not self.enabled:
+            return
+        self._objs.append((label, obj))
+
+    def watch(self, label: str, fn: Any) -> None:
+        """Pin one callable the attribute scan can't reach."""
+        if not self.enabled:
+            return
+        self._fns.append((label, fn))
+
+    # -- discovery ---------------------------------------------------------
+
+    def _iter_live(self) -> Iterator[Tuple[str, Any]]:
+        for label, fn in self._fns:
+            yield label, fn
+        for root, obj in self._objs:
+            attrs = getattr(obj, "__dict__", None)
+            if not isinstance(attrs, dict):
+                continue
+            for name, value in list(attrs.items()):
+                if cache_size_of(value) is not None:
+                    yield f"{root}.{name}", value
+                elif isinstance(value, dict):
+                    for key, member in list(value.items()):
+                        if cache_size_of(member) is not None:
+                            yield f"{root}.{name}[{key!r}]", member
+
+    def counts(self) -> Dict[str, int]:
+        """label -> current jit cache entry count, freshly discovered."""
+        if not self.enabled:
+            return {}
+        out: Dict[str, int] = {}
+        for label, fn in self._iter_live():
+            size = cache_size_of(fn)
+            if size is not None:
+                out[label] = size
+        return out
+
+    # -- the ratchet -------------------------------------------------------
+
+    def mark(self) -> None:
+        """Snapshot current counts; compiles before a mark are expected
+        (warmup), compiles after it are findings."""
+        if not self.enabled:
+            return
+        self._marked = self.counts()
+        self._has_mark = True
+
+    def recompiles_since_mark(self) -> int:
+        """Total NEW compiles since ``mark()`` — cache growth on every
+        known callable plus the full cache of callables that appeared
+        after the mark (a lazily-compiled program that first fires
+        post-warmup is itself a post-warmup compile).  0 before any
+        mark: warmup compiles are not findings."""
+        if not self.enabled or not self._has_mark:
+            return 0
+        total = 0
+        for label, size in self.counts().items():
+            total += max(0, size - self._marked.get(label, 0))
+        return total
+
+    def total_entries(self) -> int:
+        """Sum of all live jit cache entries (compile volume, not delta)."""
+        if not self.enabled:
+            return 0
+        return sum(self.counts().values())
+
+    def tracked(self) -> int:
+        """How many jitted callables discovery currently sees."""
+        if not self.enabled:
+            return 0
+        return len(self.counts())
+
+    def stats(self) -> Dict[str, int]:
+        """The fields the engine merges into its ``stats()`` dict (and
+        serve.py surfaces on /debug/overload) when the guard is on."""
+        if not self.enabled:
+            return {}
+        counts = self.counts()
+        since = 0
+        if self._has_mark:
+            for label, size in counts.items():
+                since += max(0, size - self._marked.get(label, 0))
+        return {
+            "recompiles_since_mark": since,
+            "compile_cache_entries": sum(counts.values()),
+            "jit_callables_tracked": len(counts),
+        }

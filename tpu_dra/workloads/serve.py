@@ -794,6 +794,14 @@ AdmissionController` — every decode endpoint acquires a cost ticket
                         "goodput_slot_s": stats.get("goodput_slot_s"),
                         "badput_slot_s": stats.get("badput_slot_s"),
                     }
+                    if "recompiles_since_mark" in stats:
+                        # retrace guard armed (TPU_DRA_RETRACE_GUARD):
+                        # nonzero post-warmup recompiles = a live
+                        # retrace bug; hack/drive_retrace.py reads this
+                        out["engine"]["recompiles_since_mark"] = \
+                            stats["recompiles_since_mark"]
+                        out["engine"]["compile_cache_entries"] = \
+                            stats["compile_cache_entries"]
                 self._send(200, json.dumps(out).encode())
             elif self.path.split("?", 1)[0] == "/debug/traces":
                 # the SHARED body builder (trace/export.py) — same
